@@ -1,0 +1,140 @@
+"""Headline benchmark: pods/sec scheduled at 10k simulated nodes.
+
+BASELINE.md: the reference publishes no numbers, so the baseline is *measured*
+here — a scalar per-pod sequential loop (``sim.golden.sequential_assign``)
+that is architecture-faithful to the reference scheduler's one-pod-at-a-time
+Filter→Score cycle over all nodes, run on this host's CPU. The TPU number is
+the batched round solver over the same fixture.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": pods/sec, "unit": "pods/s", "vs_baseline": ratio}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_NODES = 10_000
+N_PODS = 32_768          # solved in priority order, one device batch at a time
+BATCH = 8_192
+BASELINE_PODS = 512      # scalar loop sample size (extrapolated to pods/sec)
+THRESHOLDS = (65.0, 95.0)
+
+
+def build_fixture(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    shapes = np.array([[32_000, 128 * 1024], [64_000, 256 * 1024], [96_000, 384 * 1024]])
+    alloc = shapes[rng.integers(0, 3, N_NODES)].astype(np.float32)
+    util = rng.uniform(0.1, 0.55, (N_NODES, 1)).astype(np.float32)
+    est_used = alloc * util
+    req_cpu = rng.choice([500, 1000, 2000, 4000], N_PODS, p=[0.4, 0.3, 0.2, 0.1])
+    req_mem = req_cpu * rng.choice([2, 4, 8], N_PODS)
+    req = np.stack([req_cpu, req_mem], 1).astype(np.float32)
+    est = (req * np.array([0.85, 0.70], np.float32)).astype(np.float32)
+    prio = rng.integers(5000, 9999, N_PODS).astype(np.int32)
+    return dict(
+        alloc=alloc,
+        est_used=est_used,
+        prod_used=est_used * 0.6,
+        req=req,
+        est=est,
+        prio=prio,
+        is_prod=prio >= 9000,
+    )
+
+
+def bench_solver(fix) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from koordinator_tpu.ops.solver import NodeState, PodBatch, SolverParams, assign
+
+    nodes = NodeState(
+        allocatable=jnp.asarray(fix["alloc"]),
+        requested=jnp.zeros_like(jnp.asarray(fix["alloc"])),
+        estimated_used=jnp.asarray(fix["est_used"]),
+        prod_used=jnp.asarray(fix["prod_used"]),
+        metric_fresh=jnp.ones(N_NODES, bool),
+        schedulable=jnp.ones(N_NODES, bool),
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.asarray(THRESHOLDS, jnp.float32),
+        prod_thresholds=jnp.zeros(2, jnp.float32),
+        score_weights=jnp.ones(2, jnp.float32),
+    )
+
+    def batch_at(start):
+        sl = slice(start, start + BATCH)
+        return PodBatch(
+            requests=jnp.asarray(fix["req"][sl]),
+            estimate=jnp.asarray(fix["est"][sl]),
+            priority=jnp.asarray(fix["prio"][sl]),
+            is_prod=jnp.asarray(fix["is_prod"][sl]),
+            valid=jnp.ones(BATCH, bool),
+            gang_id=jnp.full(BATCH, -1, jnp.int32),
+        )
+
+    # warmup / compile
+    warm = assign(batch_at(0), nodes, params)
+    warm.assignment.block_until_ready()
+
+    t0 = time.perf_counter()
+    placed = 0
+    cur = nodes
+    for start in range(0, N_PODS, BATCH):
+        res = assign(batch_at(start), cur, params)
+        cur = cur.replace(
+            requested=res.node_requested, estimated_used=res.node_estimated_used
+        )
+        placed += int((np.asarray(res.assignment) >= 0).sum())
+    elapsed = time.perf_counter() - t0
+    if placed < 0.5 * N_PODS:
+        print(f"warning: only {placed}/{N_PODS} pods placed", file=sys.stderr)
+    return N_PODS / elapsed
+
+
+def bench_baseline(fix) -> float:
+    from koordinator_tpu.sim import golden
+
+    sl = slice(0, BASELINE_PODS)
+    t0 = time.perf_counter()
+    golden.sequential_assign(
+        pod_req=fix["req"][sl],
+        pod_estimate=fix["est"][sl],
+        pod_priority=fix["prio"][sl],
+        pod_is_prod=fix["is_prod"][sl],
+        allocatable=fix["alloc"],
+        requested0=np.zeros_like(fix["alloc"]),
+        estimated_used0=fix["est_used"],
+        prod_used0=fix["prod_used"],
+        metric_fresh=np.ones(N_NODES, bool),
+        schedulable=np.ones(N_NODES, bool),
+        usage_thresholds=np.asarray(THRESHOLDS, np.float32),
+        prod_thresholds=np.zeros(2, np.float32),
+        score_weights=np.ones(2, np.float32),
+    )
+    return BASELINE_PODS / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    fix = build_fixture()
+    baseline_pps = bench_baseline(fix)
+    solver_pps = bench_solver(fix)
+    print(
+        json.dumps(
+            {
+                "metric": "sched_pods_per_sec_10k_nodes",
+                "value": round(solver_pps, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(solver_pps / baseline_pps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Annotation/label protocol: QoS classes, priority bands, extended resources.
+
+This is the TPU-native rebuild of the reference's ``apis/extension`` package —
+the de-facto wire format between components (reference:
+``apis/extension/qos.go:23-27`` for QoS classes,
+``apis/extension/priority.go:29-48`` for priority bands,
+``apis/extension/resource.go:26-28`` for batch/mid extended resources).
+
+Unlike the reference (string annotations parsed per pod per plugin), the rebuild
+normalizes the protocol once at snapshot build time into small integer enums so
+that the solver works on dense int8/int32 tensors.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Mapping, Optional
+
+DOMAIN = "koordinator.sh"
+
+# --- Labels / annotations (reference: apis/extension/constants.go) ---
+LABEL_POD_QOS = f"{DOMAIN}/qosClass"
+LABEL_POD_PRIORITY = f"{DOMAIN}/priority"
+LABEL_QUOTA_NAME = f"quota.scheduling.{DOMAIN}/name"
+LABEL_QUOTA_PARENT = f"quota.scheduling.{DOMAIN}/parent"
+LABEL_QUOTA_IS_PARENT = f"quota.scheduling.{DOMAIN}/is-parent"
+LABEL_QUOTA_TREE_ID = f"quota.scheduling.{DOMAIN}/tree-id"
+LABEL_GANG_NAME = "pod-group.scheduling.sigs.k8s.io/name"
+LABEL_GANG_MIN_AVAILABLE = "pod-group.scheduling.sigs.k8s.io/min-available"
+ANNOTATION_RESOURCE_SPEC = f"scheduling.{DOMAIN}/resource-spec"
+ANNOTATION_RESOURCE_STATUS = f"scheduling.{DOMAIN}/resource-status"
+ANNOTATION_DEVICE_ALLOCATED = f"scheduling.{DOMAIN}/device-allocated"
+ANNOTATION_RESERVATION_AFFINITY = f"scheduling.{DOMAIN}/reservation-affinity"
+ANNOTATION_GANG_GROUPS = f"gang.scheduling.{DOMAIN}/groups"
+ANNOTATION_NODE_CPU_TOPOLOGY = f"node.{DOMAIN}/cpu-topology"
+ANNOTATION_NODE_RAW_ALLOCATABLE = f"node.{DOMAIN}/raw-allocatable"
+ANNOTATION_NODE_AMPLIFICATION = f"node.{DOMAIN}/resource-amplification-ratio"
+
+
+class QoSClass(enum.IntEnum):
+    """Koordinator QoS classes (reference ``apis/extension/qos.go:23-27``).
+
+    Encoded as small ints so pod QoS is a dense int8 column in the snapshot.
+    Order encodes strictness: SYSTEM > LSE > LSR > LS > BE > NONE.
+    """
+
+    NONE = 0
+    BE = 1       # best effort, runs on batch-* overcommitted resources
+    LS = 2       # latency sensitive (shared cpus)
+    LSR = 3      # latency sensitive reserved (exclusive cpuset)
+    LSE = 4      # latency sensitive exclusive (no BE sharing at all)
+    SYSTEM = 5
+
+    @classmethod
+    def parse(cls, value: Optional[str]) -> "QoSClass":
+        if not value:
+            return cls.NONE
+        try:
+            return cls[value.upper()]
+        except KeyError:
+            return cls.NONE
+
+
+class PriorityClass(enum.IntEnum):
+    """Koord priority bands (reference ``apis/extension/priority.go:29-48``)."""
+
+    NONE = 0
+    FREE = 1     # 3000-3999
+    BATCH = 2    # 5000-5999
+    MID = 3      # 7000-7999
+    PROD = 4     # 9000-9999
+
+    @classmethod
+    def from_priority(cls, priority: Optional[int]) -> "PriorityClass":
+        """Map a k8s pod priority value to a koord priority band.
+
+        Mirrors ``apis/extension/priority.go`` ``GetPodPriorityClassByPriority``:
+        inclusive band boundaries, anything outside the bands is NONE.
+        """
+        if priority is None:
+            return cls.NONE
+        if 9000 <= priority <= 9999:
+            return cls.PROD
+        if 7000 <= priority <= 7999:
+            return cls.MID
+        if 5000 <= priority <= 5999:
+            return cls.BATCH
+        if 3000 <= priority <= 3999:
+            return cls.FREE
+        return cls.NONE
+
+
+PRIORITY_BANDS: Mapping[PriorityClass, tuple[int, int]] = {
+    PriorityClass.PROD: (9000, 9999),
+    PriorityClass.MID: (7000, 7999),
+    PriorityClass.BATCH: (5000, 5999),
+    PriorityClass.FREE: (3000, 3999),
+}
+
+# --- Resource names (reference: apis/extension/resource.go:26-28) ---
+RES_CPU = "cpu"                      # milli-cores
+RES_MEMORY = "memory"                # MiB in the snapshot (bytes on the wire)
+RES_BATCH_CPU = "kubernetes.io/batch-cpu"
+RES_BATCH_MEMORY = "kubernetes.io/batch-memory"
+RES_MID_CPU = "kubernetes.io/mid-cpu"
+RES_MID_MEMORY = "kubernetes.io/mid-memory"
+RES_GPU = "nvidia.com/gpu"           # whole GPUs ×1000 (gpu-milli)
+RES_GPU_CORE = f"{DOMAIN}/gpu-core"
+RES_GPU_MEMORY = f"{DOMAIN}/gpu-memory"
+RES_GPU_MEMORY_RATIO = f"{DOMAIN}/gpu-memory-ratio"
+RES_RDMA = f"{DOMAIN}/rdma"
+
+#: Canonical dense resource axis for the solver. Extended resources used by a
+#: deployment append here; the solver is shape-polymorphic in D.
+DEFAULT_RESOURCES = (RES_CPU, RES_MEMORY, RES_BATCH_CPU, RES_BATCH_MEMORY)
+
+
+def qos_for_priority(prio: PriorityClass) -> QoSClass:
+    """Default QoS when unspecified, by priority band (reference
+    ``apis/extension/qos.go`` ``GetPodQoSClassByName`` fallback semantics)."""
+    if prio in (PriorityClass.BATCH, PriorityClass.FREE):
+        return QoSClass.BE
+    if prio in (PriorityClass.PROD, PriorityClass.MID):
+        return QoSClass.LS
+    return QoSClass.NONE

@@ -1,0 +1,333 @@
+"""Core API object model (the rebuild's "CRDs").
+
+Lightweight dataclasses standing in for the reference's CRD Go types under
+``apis/`` (reference: ``apis/slo/v1alpha1/nodemetric_types.go``,
+``apis/scheduling/v1alpha1/reservation_types.go``, ``device_types.go:104``,
+``pod_migration_job_types.go:27-40``, thirdparty ElasticQuota/PodGroup).
+
+These objects are the *host-side* representation; the solver never sees them.
+``core.snapshot.SnapshotBuilder`` lowers them into dense arrays once, and all
+hot-path decisions happen on tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .extension import DEFAULT_RESOURCES, PriorityClass, QoSClass
+
+ResourceList = Dict[str, float]
+
+
+def _res(d: Optional[Mapping[str, float]]) -> ResourceList:
+    return dict(d) if d else {}
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+
+@dataclasses.dataclass
+class PodSpec:
+    """Flattened pod scheduling spec.
+
+    ``requests``/``limits`` use snapshot units: cpu in milli-cores, memory in
+    MiB, extended resources in their native integer unit.
+    """
+
+    requests: ResourceList = dataclasses.field(default_factory=dict)
+    limits: ResourceList = dataclasses.field(default_factory=dict)
+    priority: Optional[int] = None
+    scheduler_name: str = "koord-scheduler"
+    node_name: Optional[str] = None
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    affinity_required_nodes: Optional[Sequence[str]] = None  # simplified nodeAffinity
+
+
+class PodPhase(enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class Pod:
+    meta: ObjectMeta
+    spec: PodSpec = dataclasses.field(default_factory=PodSpec)
+    phase: PodPhase = PodPhase.PENDING
+
+    @property
+    def qos(self) -> QoSClass:
+        from .extension import LABEL_POD_QOS
+
+        explicit = QoSClass.parse(self.meta.labels.get(LABEL_POD_QOS))
+        if explicit is not QoSClass.NONE:
+            return explicit
+        from .extension import qos_for_priority
+
+        return qos_for_priority(self.priority_class)
+
+    @property
+    def priority_class(self) -> PriorityClass:
+        return PriorityClass.from_priority(self.spec.priority)
+
+
+@dataclasses.dataclass
+class NodeStatus:
+    allocatable: ResourceList = dataclasses.field(default_factory=dict)
+    capacity: ResourceList = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Node:
+    meta: ObjectMeta
+    status: NodeStatus = dataclasses.field(default_factory=NodeStatus)
+    unschedulable: bool = False
+
+
+# --- slo.koordinator.sh/NodeMetric (nodemetric_types.go) ---
+
+#: aggregation percentile keys reported by the node agent
+AGG_P50, AGG_P90, AGG_P95, AGG_P99 = "p50", "p90", "p95", "p99"
+AGG_TYPES = (AGG_P50, AGG_P90, AGG_P95, AGG_P99)
+
+
+@dataclasses.dataclass
+class ResourceMetric:
+    usage: ResourceList = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PodMetricInfo:
+    namespace: str
+    name: str
+    usage: ResourceList = dataclasses.field(default_factory=dict)
+    priority_class: PriorityClass = PriorityClass.NONE
+
+
+@dataclasses.dataclass
+class NodeMetric:
+    """Node + pod usage report (reference ``nodemetric_types.go``).
+
+    ``aggregated`` maps percentile key → usage over the aggregation window;
+    ``prod_usage`` mirrors the reference's SystemUsage+ProdUsage split used by
+    LoadAware's prod-usage thresholds.
+    """
+
+    meta: ObjectMeta
+    node_usage: ResourceMetric = dataclasses.field(default_factory=ResourceMetric)
+    prod_usage: ResourceMetric = dataclasses.field(default_factory=ResourceMetric)
+    sys_usage: ResourceMetric = dataclasses.field(default_factory=ResourceMetric)
+    aggregated: Dict[str, ResourceMetric] = dataclasses.field(default_factory=dict)
+    pod_metrics: List[PodMetricInfo] = dataclasses.field(default_factory=list)
+    update_time: float = dataclasses.field(default_factory=time.time)
+    report_interval_s: float = 60.0  # states_nodemetric.go:61-66
+    aggregate_window_s: float = 300.0
+
+    def expired(self, now: float, expiry_s: float = 180.0) -> bool:
+        """LoadAware degrades to request-based estimation when the metric is
+        stale (reference ``load_aware.go:143-149``)."""
+        return (now - self.update_time) > expiry_s
+
+
+# --- scheduling.koordinator.sh/Reservation (reservation_types.go) ---
+
+
+class ReservationPhase(enum.Enum):
+    PENDING = "Pending"
+    AVAILABLE = "Available"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class ReservationOwner:
+    """Owner matching: label selector and/or controller reference."""
+
+    label_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    namespace: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Reservation:
+    meta: ObjectMeta
+    requests: ResourceList = dataclasses.field(default_factory=dict)
+    owners: List[ReservationOwner] = dataclasses.field(default_factory=list)
+    allocate_once: bool = True
+    ttl_s: Optional[float] = None
+    phase: ReservationPhase = ReservationPhase.PENDING
+    node_name: Optional[str] = None          # set once scheduled
+    allocated: ResourceList = dataclasses.field(default_factory=dict)
+    current_owners: List[str] = dataclasses.field(default_factory=list)  # pod uids
+
+
+# --- scheduling.koordinator.sh/Device (device_types.go:104) ---
+
+
+@dataclasses.dataclass
+class DeviceInfo:
+    dev_type: str               # "gpu" | "rdma"
+    minor: int
+    resources: ResourceList = dataclasses.field(default_factory=dict)
+    numa_node: int = -1
+    pcie_bus: str = ""
+
+
+@dataclasses.dataclass
+class Device:
+    """Per-node device inventory reported by the node agent."""
+
+    meta: ObjectMeta            # name == node name
+    devices: List[DeviceInfo] = dataclasses.field(default_factory=list)
+
+
+# --- thirdparty PodGroup (gang) ---
+
+
+@dataclasses.dataclass
+class PodGroup:
+    meta: ObjectMeta
+    min_member: int = 1
+    total_member: Optional[int] = None
+    schedule_timeout_s: float = 600.0
+
+
+# --- thirdparty ElasticQuota ---
+
+
+@dataclasses.dataclass
+class ElasticQuota:
+    meta: ObjectMeta
+    min: ResourceList = dataclasses.field(default_factory=dict)
+    max: ResourceList = dataclasses.field(default_factory=dict)
+    shared_weight: ResourceList = dataclasses.field(default_factory=dict)
+    parent: str = ""            # quota tree edge (label quota.scheduling.../parent)
+    is_parent: bool = False
+    tree_id: str = ""
+
+
+# --- scheduling.koordinator.sh/PodMigrationJob (pod_migration_job_types.go:27-40) ---
+
+
+class MigrationPhase(enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class MigrationMode(enum.Enum):
+    RESERVATION_FIRST = "ReservationFirst"
+    EVICT_DIRECTLY = "EvictDirectly"
+
+
+@dataclasses.dataclass
+class PodMigrationJob:
+    meta: ObjectMeta
+    pod_uid: str = ""
+    mode: MigrationMode = MigrationMode.RESERVATION_FIRST
+    phase: MigrationPhase = MigrationPhase.PENDING
+    reservation_name: Optional[str] = None
+    reason: str = ""
+
+
+# --- config.koordinator.sh/ClusterColocationProfile ---
+
+
+@dataclasses.dataclass
+class ClusterColocationProfile:
+    """Admission-time pod mutation profile (reference
+    ``cluster_colocation_profile_types.go`` + webhook
+    ``pod/mutating/cluster_colocation_profile.go``)."""
+
+    meta: ObjectMeta
+    selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    namespace_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    qos_class: Optional[QoSClass] = None
+    priority: Optional[int] = None
+    scheduler_name: Optional[str] = None
+    #: resource name rewrite map, e.g. cpu -> kubernetes.io/batch-cpu
+    resource_translation: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+# --- slo.koordinator.sh/NodeSLO (nodeslo_types.go) ---
+
+
+@dataclasses.dataclass
+class ResourceThresholdStrategy:
+    """Per-node BE suppression thresholds (reference
+    ``apis/slo/v1alpha1/nodeslo_types.go`` ResourceThresholdStrategy)."""
+
+    enable: bool = False
+    cpu_suppress_threshold_percent: float = 65.0
+    cpu_evict_be_usage_threshold_percent: float = 90.0
+    memory_evict_threshold_percent: float = 70.0
+    memory_evict_lower_percent: Optional[float] = None
+
+
+@dataclasses.dataclass
+class CPUBurstStrategy:
+    policy: str = "none"        # none|cpuBurstOnly|cfsQuotaBurstOnly|auto
+    cpu_burst_percent: float = 1000.0
+    cfs_quota_burst_percent: float = 300.0
+
+
+@dataclasses.dataclass
+class NodeSLO:
+    meta: ObjectMeta            # name == node name
+    threshold: ResourceThresholdStrategy = dataclasses.field(
+        default_factory=ResourceThresholdStrategy
+    )
+    cpu_burst: CPUBurstStrategy = dataclasses.field(default_factory=CPUBurstStrategy)
+    #: per-QoS-class resource QoS knobs, keyed by QoSClass
+    resource_qos: Dict[QoSClass, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+__all__ = [
+    "AGG_TYPES",
+    "AGG_P50",
+    "AGG_P90",
+    "AGG_P95",
+    "AGG_P99",
+    "ClusterColocationProfile",
+    "CPUBurstStrategy",
+    "Device",
+    "DeviceInfo",
+    "ElasticQuota",
+    "MigrationMode",
+    "MigrationPhase",
+    "Node",
+    "NodeMetric",
+    "NodeSLO",
+    "NodeStatus",
+    "ObjectMeta",
+    "Pod",
+    "PodGroup",
+    "PodMetricInfo",
+    "PodMigrationJob",
+    "PodPhase",
+    "PodSpec",
+    "Reservation",
+    "ReservationOwner",
+    "ReservationPhase",
+    "ResourceMetric",
+    "ResourceThresholdStrategy",
+    "ResourceList",
+]

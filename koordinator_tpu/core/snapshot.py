@@ -1,0 +1,347 @@
+"""Tensor state snapshot: host objects → dense arrays.
+
+The rebuild's analog of the reference scheduler's in-memory state (scheduler
+cache NodeInfos + ``podAssignCache`` ``pkg/scheduler/plugins/loadaware/
+pod_assign_cache.go`` + ``nodeDeviceCache`` + quota tree): one mutable
+host-side store of numpy arrays, lowered to device arrays per solver batch.
+
+Design notes (TPU-first):
+  * All shapes are padded to buckets (next power of two, min 128) so that
+    churn in pod/node counts does not recompile the jitted solver.
+  * Resources live on a canonical D axis (``SnapshotConfig.resources``);
+    cpu is milli-cores, memory is MiB, extended resources native units.
+  * Incremental updates (assume/forget, metric refresh) mutate numpy in
+    place — the device transfer happens once per solver batch, not per event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import extension as ext
+from ..api.types import Node, NodeMetric, Pod, ResourceList
+
+
+def bucket_size(n: int, minimum: int = 128) -> int:
+    """Round up to the next power of two (>= minimum) for stable jit shapes."""
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotConfig:
+    resources: Tuple[str, ...] = ext.DEFAULT_RESOURCES
+    min_bucket: int = 128
+
+    @property
+    def dims(self) -> int:
+        return len(self.resources)
+
+    def res_vector(self, rl: Mapping[str, float]) -> np.ndarray:
+        return np.array([float(rl.get(r, 0.0)) for r in self.resources], np.float32)
+
+
+@dataclasses.dataclass
+class NodeArrays:
+    """Dense per-node state, padded to ``n_bucket`` rows.
+
+    Mirrors what the reference spreads across NodeInfo + NodeMetric + the
+    LoadAware ``podAssignCache``:
+      allocatable      — Node.status.allocatable            [N, D]
+      requested        — sum of assigned pod requests       [N, D]
+      usage_avg        — NodeMetric avg node usage          [N, D]
+      usage_agg        — NodeMetric aggregated percentile   [N, D]
+      prod_usage       — NodeMetric prod-tier usage         [N, D]
+      assigned_pending — estimated usage of assigned-but-unreported pods
+                         (reference ``load_aware.go:315-358``)            [N, D]
+      assigned_pending_prod — the prod-band slice of assigned_pending
+                         (prod thresholds count only prod-tier pods)      [N, D]
+      metric_fresh     — NodeMetric not expired             [N] bool
+      schedulable      — not cordoned, padded rows False    [N] bool
+    """
+
+    allocatable: np.ndarray
+    requested: np.ndarray
+    usage_avg: np.ndarray
+    usage_agg: np.ndarray
+    prod_usage: np.ndarray
+    assigned_pending: np.ndarray
+    assigned_pending_prod: np.ndarray
+    metric_fresh: np.ndarray
+    schedulable: np.ndarray
+    n_real: int
+
+    @classmethod
+    def empty(cls, n_bucket: int, dims: int) -> "NodeArrays":
+        z = lambda: np.zeros((n_bucket, dims), np.float32)
+        return cls(
+            allocatable=z(),
+            requested=z(),
+            usage_avg=z(),
+            usage_agg=z(),
+            prod_usage=z(),
+            assigned_pending=z(),
+            assigned_pending_prod=z(),
+            metric_fresh=np.zeros((n_bucket,), bool),
+            schedulable=np.zeros((n_bucket,), bool),
+            n_real=0,
+        )
+
+
+@dataclasses.dataclass
+class PodArrays:
+    """Dense per-pending-pod state, padded to ``p_bucket`` rows.
+
+    requests    — scheduling requests                      [P, D]
+    priority    — raw k8s priority (sort key)              [P] int32
+    prio_class  — koord band (extension.PriorityClass)     [P] int8
+    qos         — koord QoS class                          [P] int8
+    gang_id     — row-group id for coscheduling, -1 = none [P] int32
+    quota_id    — leaf quota index, -1 = none              [P] int32
+    valid       — padded rows False                        [P] bool
+    """
+
+    requests: np.ndarray
+    priority: np.ndarray
+    prio_class: np.ndarray
+    qos: np.ndarray
+    gang_id: np.ndarray
+    quota_id: np.ndarray
+    valid: np.ndarray
+    p_real: int
+
+    @classmethod
+    def empty(cls, p_bucket: int, dims: int) -> "PodArrays":
+        return cls(
+            requests=np.zeros((p_bucket, dims), np.float32),
+            priority=np.zeros((p_bucket,), np.int32),
+            prio_class=np.zeros((p_bucket,), np.int8),
+            qos=np.zeros((p_bucket,), np.int8),
+            gang_id=np.full((p_bucket,), -1, np.int32),
+            quota_id=np.full((p_bucket,), -1, np.int32),
+            valid=np.zeros((p_bucket,), bool),
+            p_real=0,
+        )
+
+
+@dataclasses.dataclass
+class _AssumedPod:
+    """Bookkeeping for one assumed/bound pod (the reference's
+    ``podAssignCache`` entry)."""
+
+    node_idx: int
+    request: np.ndarray
+    estimate: np.ndarray
+    is_prod: bool
+    assume_time: float
+    absorbed: bool = False  # estimate already reflected in reported usage
+
+
+class ClusterSnapshot:
+    """Mutable host-side mirror of cluster state with index maps.
+
+    The write path (informer events in the reference) is `upsert_node`,
+    `set_node_metric`, `assume_pod`, `forget_pod`; the read path is
+    `node_arrays` / `build_pods`, which hand padded numpy blocks to the
+    jitted solver.
+    """
+
+    def __init__(
+        self,
+        config: Optional[SnapshotConfig] = None,
+        agg_type: str = "p95",
+        metric_expiry_s: float = 180.0,
+    ):
+        self.config = config or SnapshotConfig()
+        #: NodeMetric aggregation percentile / expiry used at ingest
+        #: (wired from LoadAwareSchedulingArgs by BatchScheduler)
+        self.agg_type = agg_type
+        self.metric_expiry_s = metric_expiry_s
+        self._node_index: Dict[str, int] = {}
+        self._node_names: List[str] = []
+        self._free_node_slots: List[int] = []
+        self.nodes = NodeArrays.empty(self.config.min_bucket, self.config.dims)
+        #: pod uid -> _AssumedPod for assumed/bound pods
+        self._assumed: Dict[str, "_AssumedPod"] = {}
+
+    # ---- node side ----
+
+    def _grow_nodes(self, need: int) -> None:
+        cur = self.nodes.allocatable.shape[0]
+        if need <= cur:
+            return
+        new = bucket_size(need, self.config.min_bucket)
+        old = self.nodes
+
+        def pad(a: np.ndarray) -> np.ndarray:
+            width = [(0, new - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, width)
+
+        self.nodes = NodeArrays(
+            allocatable=pad(old.allocatable),
+            requested=pad(old.requested),
+            usage_avg=pad(old.usage_avg),
+            usage_agg=pad(old.usage_agg),
+            prod_usage=pad(old.prod_usage),
+            assigned_pending=pad(old.assigned_pending),
+            assigned_pending_prod=pad(old.assigned_pending_prod),
+            metric_fresh=pad(old.metric_fresh),
+            schedulable=pad(old.schedulable),
+            n_real=old.n_real,
+        )
+
+    def upsert_node(self, node: Node) -> int:
+        idx = self._node_index.get(node.meta.name)
+        if idx is None:
+            if self._free_node_slots:
+                idx = self._free_node_slots.pop()
+                self._node_names[idx] = node.meta.name
+            else:
+                idx = len(self._node_names)
+                self._node_names.append(node.meta.name)
+                self._grow_nodes(idx + 1)
+            self._node_index[node.meta.name] = idx
+            self.nodes.n_real = max(self.nodes.n_real, idx + 1)
+        self.nodes.allocatable[idx] = self.config.res_vector(node.status.allocatable)
+        self.nodes.schedulable[idx] = not node.unschedulable
+        return idx
+
+    def remove_node(self, name: str) -> None:
+        idx = self._node_index.pop(name, None)
+        if idx is None:
+            return
+        for arr in (
+            self.nodes.allocatable,
+            self.nodes.requested,
+            self.nodes.usage_avg,
+            self.nodes.usage_agg,
+            self.nodes.prod_usage,
+            self.nodes.assigned_pending,
+            self.nodes.assigned_pending_prod,
+        ):
+            arr[idx] = 0
+        self.nodes.metric_fresh[idx] = False
+        self.nodes.schedulable[idx] = False
+        # Drop assumed-pod bookkeeping for the dead node so a later
+        # forget_pod cannot corrupt whichever node reuses this slot.
+        self._assumed = {
+            uid: ap for uid, ap in self._assumed.items() if ap.node_idx != idx
+        }
+        self._free_node_slots.append(idx)
+
+    def node_id(self, name: str) -> Optional[int]:
+        return self._node_index.get(name)
+
+    def node_name(self, idx: int) -> str:
+        return self._node_names[idx]
+
+    @property
+    def node_count(self) -> int:
+        return len(self._node_index)
+
+    def set_node_metric(
+        self,
+        metric: NodeMetric,
+        now: Optional[float] = None,
+        agg_type: Optional[str] = None,
+        expiry_s: Optional[float] = None,
+    ) -> None:
+        """Ingest a NodeMetric report (reference LoadAware reads the CRD at
+        Filter/Score time, ``load_aware.go:163-179``; we fold it into the
+        node block at informer time instead).
+
+        Pods assumed *before* the report's update_time are considered
+        reflected in the reported usage and their pending estimates are
+        absorbed; pods assumed after keep contributing (reference
+        ``load_aware.go:315-358`` compares assign time vs metric time).
+        """
+        idx = self._node_index.get(metric.meta.name)
+        if idx is None:
+            return
+        cfg = self.config
+        self.nodes.usage_avg[idx] = cfg.res_vector(metric.node_usage.usage)
+        agg = metric.aggregated.get(agg_type or self.agg_type)
+        self.nodes.usage_agg[idx] = cfg.res_vector(
+            agg.usage if agg is not None else metric.node_usage.usage
+        )
+        self.nodes.prod_usage[idx] = cfg.res_vector(metric.prod_usage.usage)
+        import time as _t
+
+        now = now if now is not None else _t.time()
+        fresh = not metric.expired(
+            now, expiry_s if expiry_s is not None else self.metric_expiry_s
+        )
+        self.nodes.metric_fresh[idx] = fresh
+        if fresh:
+            for ap in self._assumed.values():
+                if (
+                    ap.node_idx == idx
+                    and not ap.absorbed
+                    and ap.assume_time <= metric.update_time
+                ):
+                    self.nodes.assigned_pending[idx] -= ap.estimate
+                    if ap.is_prod:
+                        self.nodes.assigned_pending_prod[idx] -= ap.estimate
+                    ap.absorbed = True
+
+    # ---- assume / forget (reference scheduler cache + podAssignCache) ----
+
+    def assume_pod(
+        self,
+        pod: Pod,
+        node_name: str,
+        estimated: Optional[np.ndarray] = None,
+        now: Optional[float] = None,
+    ) -> None:
+        import time as _t
+
+        idx = self._node_index[node_name]
+        req = self.config.res_vector(pod.spec.requests)
+        self.nodes.requested[idx] += req
+        est = np.asarray(
+            estimated if estimated is not None else req, np.float32
+        )
+        is_prod = pod.priority_class == ext.PriorityClass.PROD
+        self.nodes.assigned_pending[idx] += est
+        if is_prod:
+            self.nodes.assigned_pending_prod[idx] += est
+        self._assumed[pod.meta.uid] = _AssumedPod(
+            node_idx=idx,
+            request=req,
+            estimate=est,
+            is_prod=is_prod,
+            assume_time=now if now is not None else _t.time(),
+        )
+
+    def forget_pod(self, pod_uid: str) -> None:
+        ap = self._assumed.pop(pod_uid, None)
+        if ap is None:
+            return
+        self.nodes.requested[ap.node_idx] -= ap.request
+        if not ap.absorbed:
+            self.nodes.assigned_pending[ap.node_idx] -= ap.estimate
+            if ap.is_prod:
+                self.nodes.assigned_pending_prod[ap.node_idx] -= ap.estimate
+
+    # ---- pod batch build ----
+
+    def build_pods(self, pods: Sequence[Pod]) -> PodArrays:
+        p_bucket = bucket_size(len(pods), self.config.min_bucket)
+        out = PodArrays.empty(p_bucket, self.config.dims)
+        gang_ids: Dict[str, int] = {}
+        for i, pod in enumerate(pods):
+            out.requests[i] = self.config.res_vector(pod.spec.requests)
+            out.priority[i] = pod.spec.priority or 0
+            out.prio_class[i] = int(pod.priority_class)
+            out.qos[i] = int(pod.qos)
+            gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
+            if gang:
+                key = f"{pod.meta.namespace}/{gang}"
+                out.gang_id[i] = gang_ids.setdefault(key, len(gang_ids))
+            out.valid[i] = True
+        out.p_real = len(pods)
+        return out

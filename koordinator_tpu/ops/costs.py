@@ -1,0 +1,126 @@
+"""Score plugins as vectorized cost terms over (pods × nodes).
+
+Lower cost = better node (the solver does masked argmin). Each reference
+Score plugin maps to one term here:
+  * LoadAwareScheduling.Score      → :func:`load_aware_cost`
+    (reference ``pkg/scheduler/plugins/loadaware/load_aware.go:387-406``)
+  * NodeResourcesFitPlus           → :func:`fit_plus_cost`
+    (reference ``pkg/scheduler/plugins/noderesourcefitplus/plugin.go``)
+  * ScarceResourceAvoidance        → :func:`scarce_resource_cost`
+    (reference ``pkg/scheduler/plugins/scarceresourceavoidance/plugin.go``)
+  * NUMA LeastAllocated/MostAllocated → :func:`least_allocated_cost` /
+    :func:`most_allocated_cost` (reference ``nodenumaresource/least_allocated.go``)
+
+Scores follow the reference's 0..100 convention, then negate into costs so
+terms combine by weighted addition exactly like the framework's weighted sum.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_SAFE = 1e-9
+
+
+def _utilization_free_score(
+    requested_like: jnp.ndarray, allocatable: jnp.ndarray, weights: jnp.ndarray
+) -> jnp.ndarray:
+    """score = Σ_d w_d · (alloc - used) · 100 / alloc / Σ_d w_d, clamped ≥ 0.
+
+    requested_like: [..., D] (estimated used or requested+req);
+    allocatable: broadcastable [..., D]; weights: [D].
+    """
+    free = jnp.maximum(allocatable - requested_like, 0.0)
+    per_dim = jnp.where(allocatable > 0, free * 100.0 / (allocatable + _SAFE), 0.0)
+    wsum = jnp.sum(weights) + _SAFE
+    # Elementwise multiply-reduce (not einsum/MXU): D is tiny and full f32
+    # accumulation keeps scores bit-comparable with the scalar golden model.
+    return jnp.sum(per_dim * weights, axis=-1) / wsum
+
+
+def load_aware_cost(
+    pod_estimate: jnp.ndarray,
+    node_estimated_used: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    weights: jnp.ndarray,
+) -> jnp.ndarray:
+    """LoadAware least-used score → cost ([P, N]).
+
+    Mirrors ``load_aware.go:387-406`` (``loadAwareSchedulingScorer``): per-dim
+    free-percentage after adding the pod's estimated usage, weighted-averaged.
+    """
+    after = node_estimated_used[None, :, :] + pod_estimate[:, None, :]  # [P,N,D]
+    score = _utilization_free_score(after, node_allocatable[None, :, :], weights)
+    return -score
+
+
+def least_allocated_cost(
+    pod_req: jnp.ndarray,
+    node_requested: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    weights: jnp.ndarray,
+) -> jnp.ndarray:
+    """Request-based least-allocated (NUMA scoring strategy LeastAllocated,
+    reference ``nodenumaresource/least_allocated.go``)."""
+    after = node_requested[None, :, :] + pod_req[:, None, :]
+    return -_utilization_free_score(after, node_allocatable[None, :, :], weights)
+
+
+def most_allocated_cost(
+    pod_req: jnp.ndarray,
+    node_requested: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    weights: jnp.ndarray,
+) -> jnp.ndarray:
+    """MostAllocated (bin-packing): score = Σ w_d · used·100/alloc
+    (reference ``nodenumaresource/most_allocated.go``)."""
+    after = node_requested[None, :, :] + pod_req[:, None, :]
+    free_score = _utilization_free_score(after, node_allocatable[None, :, :], weights)
+    return -(100.0 - free_score)
+
+
+def scarce_resource_cost(
+    pod_req: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    scarce_dims: jnp.ndarray,
+) -> jnp.ndarray:
+    """ScarceResourceAvoidance: penalize nodes that carry a scarce resource
+    (e.g. GPU) when the pod does not request it, so scarce capacity stays
+    free for pods that need it.
+
+    scarce_dims: [D] bool marking the scarce resource dims.
+    Returns [P, N] cost in 0..100.
+    """
+    node_has = (node_allocatable > 0) & scarce_dims[None, :]          # [N, D]
+    pod_wants = pod_req > 0                                           # [P, D]
+    wasted = node_has[None, :, :] & ~pod_wants[:, None, :]            # [P, N, D]
+    n_scarce = jnp.maximum(jnp.sum(scarce_dims), 1)
+    return jnp.sum(wasted, axis=-1) * (100.0 / n_scarce)
+
+
+def fit_plus_cost(
+    pod_req: jnp.ndarray,
+    node_requested: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    dim_weights: jnp.ndarray,
+    most_allocated_dims: jnp.ndarray,
+) -> jnp.ndarray:
+    """NodeResourcesFitPlus: per-resource-type choice of Least/MostAllocated
+    strategy with per-resource weights (reference
+    ``noderesourcefitplus/plugin.go``).
+
+    most_allocated_dims: [D] bool — dims scored MostAllocated; others Least.
+    """
+    after = node_requested[None, :, :] + pod_req[:, None, :]
+    frac_used = jnp.where(
+        node_allocatable[None, :, :] > 0,
+        jnp.clip(after / (node_allocatable[None, :, :] + _SAFE), 0.0, 1.0),
+        0.0,
+    )
+    per_dim_score = jnp.where(
+        most_allocated_dims[None, None, :], frac_used, 1.0 - frac_used
+    ) * 100.0
+    wants = (pod_req > 0).astype(per_dim_score.dtype)                 # [P, D]
+    w = dim_weights[None, None, :] * wants[:, None, :]
+    score = jnp.sum(per_dim_score * w, axis=-1) / (jnp.sum(w, axis=-1) + _SAFE)
+    return -score

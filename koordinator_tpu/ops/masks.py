@@ -1,0 +1,81 @@
+"""Filter predicates as boolean masks over (pods × nodes).
+
+Each reference Filter plugin becomes a mask builder:
+  * NodeResourcesFit            → :func:`fit_mask`
+  * LoadAwareScheduling.Filter  → :func:`usage_threshold_mask`
+    (reference ``pkg/scheduler/plugins/loadaware/load_aware.go:122-186,290-313``)
+
+Masks compose by logical AND; `True` means feasible. All functions are pure
+and jit-safe; the (P, N, D) intermediates are fused by XLA into the (P, N)
+reduction so nothing of rank 3 is materialized in HBM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+EPS = 1e-3  # float32 slack for large-magnitude resource dims (MiB, milli-cpu)
+
+
+def fit_mask(pod_req: jnp.ndarray, node_free: jnp.ndarray) -> jnp.ndarray:
+    """NodeResourcesFit: every requested dim fits in node free capacity.
+
+    pod_req:   [P, D]; node_free: [N, D] (allocatable - requested).
+    Returns [P, N] bool.
+    """
+    return jnp.all(pod_req[:, None, :] <= node_free[None, :, :] + EPS, axis=-1)
+
+
+def usage_threshold_mask(
+    pod_estimate: jnp.ndarray,
+    node_estimated_used: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    thresholds: jnp.ndarray,
+    metric_fresh: jnp.ndarray,
+) -> jnp.ndarray:
+    """LoadAware Filter: reject nodes whose estimated utilization after
+    placing the pod exceeds the per-resource threshold.
+
+    Mirrors ``load_aware.go:290-313``: for each dim with threshold > 0,
+    ``(estimatedUsed + podEstimate) > threshold% * allocatable`` ⇒ reject.
+    Nodes with an expired NodeMetric skip the usage check (degraded mode,
+    ``load_aware.go:143-149``) — the fit mask still applies.
+
+    pod_estimate: [P, D]; node_estimated_used/allocatable: [N, D];
+    thresholds: [D] in percent (0 disables the dim); metric_fresh: [N] bool.
+    Returns [P, N] bool.
+    """
+    limit = node_allocatable * (thresholds / 100.0)  # [N, D]
+    after = node_estimated_used[None, :, :] + pod_estimate[:, None, :]
+    over = (thresholds > 0.0) & (after > limit[None, :, :] + EPS)
+    ok = ~jnp.any(over, axis=-1)
+    return ok | ~metric_fresh[None, :]
+
+
+def prod_usage_threshold_mask(
+    pod_is_prod: jnp.ndarray,
+    pod_estimate: jnp.ndarray,
+    node_prod_used: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    prod_thresholds: jnp.ndarray,
+    metric_fresh: jnp.ndarray,
+) -> jnp.ndarray:
+    """LoadAware prod-usage thresholds: only prod-band pods are checked
+    against prod-tier utilization (``load_aware.go:163-179``).
+
+    pod_is_prod: [P] bool. Returns [P, N] bool.
+    """
+    base = usage_threshold_mask(
+        pod_estimate, node_prod_used, node_allocatable, prod_thresholds, metric_fresh
+    )
+    return base | ~pod_is_prod[:, None]
+
+
+def combine(*masks: jnp.ndarray) -> jnp.ndarray:
+    """AND-compose masks, broadcasting [N]→[P,N] as needed."""
+    out = None
+    for m in masks:
+        if m.ndim == 1:
+            m = m[None, :]
+        out = m if out is None else (out & m)
+    return out

@@ -1,0 +1,107 @@
+"""Multi-chip solver sharding over a (dp, tp) device mesh.
+
+The scale dimension the reference struggles with is nodes × pending pods
+(SURVEY §5 "long-context" analog — its only mitigations are
+``percentageOfNodesToScore`` and 16-way goroutine chunking). Here the
+(P, N) work is sharded over ICI: the pending-pod batch axis is "dp", the
+node-table axis is "tp". XLA's SPMD partitioner inserts the collectives
+(the top-k/argmin over the sharded node axis becomes an all-reduce-style
+combine riding ICI; DCN would only enter for multi-slice meshes).
+
+``sharded_assign`` is the GSPMD path: the *same* jitted program as the
+single-chip solver, with sharding constraints on inputs. A hand-scheduled
+``shard_map`` variant can replace it where the partitioner's choices are
+suboptimal; semantics are identical either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.solver import NodeState, PodBatch, SolverParams, SolveResult, assign
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Factor devices into a (dp, tp) mesh, tp (node axis) ≥ dp.
+
+    Falls back to the host CPU backend when the default backend has fewer
+    than ``n_devices`` chips (the virtual-device dry-run path: environments
+    pin ``jax_platforms="axon,cpu"`` so the cpu backend co-exists and honors
+    ``--xla_force_host_platform_device_count``).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None and len(devs) < n_devices:
+        try:
+            devs = jax.devices("cpu")
+        except RuntimeError:
+            pass
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    n = len(devs)
+    dp = 1
+    while n % (dp * 2) == 0 and (dp * 2) * (dp * 2) <= n:
+        dp *= 2
+    tp = n // dp
+    return Mesh(np.asarray(devs).reshape(dp, tp), ("dp", "tp"))
+
+
+def _pod_spec() -> PodBatch:
+    return PodBatch(
+        requests=P("dp", None),
+        estimate=P("dp", None),
+        priority=P("dp"),
+        is_prod=P("dp"),
+        valid=P("dp"),
+        gang_id=P("dp"),
+    )
+
+
+def _node_spec() -> NodeState:
+    return NodeState(
+        allocatable=P("tp", None),
+        requested=P("tp", None),
+        estimated_used=P("tp", None),
+        prod_used=P("tp", None),
+        metric_fresh=P("tp"),
+        schedulable=P("tp"),
+    )
+
+
+def sharded_assign(
+    mesh: Mesh,
+    pods: PodBatch,
+    nodes: NodeState,
+    params: SolverParams,
+    max_rounds: int = 24,
+) -> SolveResult:
+    """Run the round solver SPMD over the mesh.
+
+    Pod arrays are sharded on dp, the node table on tp, params replicated.
+    Output assignment is sharded on dp; node usage tensors on tp.
+    """
+    pod_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), _pod_spec())
+    node_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), _node_spec())
+    rep = NamedSharding(mesh, P())
+    param_sh = jax.tree.map(lambda _: rep, params)
+    out_sh = SolveResult(
+        assignment=NamedSharding(mesh, P("dp")),
+        node_requested=NamedSharding(mesh, P("tp", None)),
+        node_estimated_used=NamedSharding(mesh, P("tp", None)),
+        rounds_used=rep,
+    )
+
+    fn = jax.jit(
+        functools.partial(assign, max_rounds=max_rounds),
+        in_shardings=(pod_sh, node_sh, param_sh),
+        out_shardings=out_sh,
+    )
+    pods = jax.device_put(pods, pod_sh)
+    nodes = jax.device_put(nodes, node_sh)
+    params = jax.device_put(params, param_sh)
+    return fn(pods, nodes, params)

@@ -1,0 +1,103 @@
+"""Synthetic cluster/workload generator (the rebuild's stand-in for the
+reference's kind-based e2e rig, ``test/kind-conf.yaml`` — but at the 10k-node
+/ 100k-pod scale from BASELINE.json that kind cannot reach)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..api import extension as ext
+from ..api.types import (
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceMetric,
+)
+
+#: (cpu milli, memory MiB) node shapes, weighted toward 64-core boxes
+NODE_SHAPES = (
+    (32_000, 128 * 1024),
+    (64_000, 256 * 1024),
+    (96_000, 384 * 1024),
+)
+
+
+@dataclasses.dataclass
+class GenConfig:
+    n_nodes: int = 1000
+    n_pods: int = 10_000
+    seed: int = 0
+    prod_fraction: float = 0.3       # rest are batch (BE) pods
+    base_util: float = 0.35          # initial reported node utilization
+    util_spread: float = 0.2
+    gang_fraction: float = 0.0       # fraction of pods grouped into gangs
+    gang_size: int = 4
+
+
+def gen_nodes(cfg: GenConfig) -> Tuple[List[Node], List[NodeMetric]]:
+    rng = np.random.default_rng(cfg.seed)
+    shapes = rng.integers(0, len(NODE_SHAPES), cfg.n_nodes)
+    nodes, metrics = [], []
+    for i in range(cfg.n_nodes):
+        cpu, mem = NODE_SHAPES[int(shapes[i])]
+        name = f"node-{i:05d}"
+        nodes.append(
+            Node(
+                meta=ObjectMeta(name=name, namespace=""),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}
+                ),
+            )
+        )
+        util = float(
+            np.clip(
+                cfg.base_util + rng.normal(0, cfg.util_spread / 2), 0.02, 0.9
+            )
+        )
+        usage = {ext.RES_CPU: cpu * util, ext.RES_MEMORY: mem * util * 0.8}
+        metrics.append(
+            NodeMetric(
+                meta=ObjectMeta(name=name, namespace=""),
+                node_usage=ResourceMetric(usage=dict(usage)),
+                prod_usage=ResourceMetric(
+                    usage={k: v * 0.7 for k, v in usage.items()}
+                ),
+                aggregated={
+                    "p95": ResourceMetric(
+                        usage={k: v * 1.1 for k, v in usage.items()}
+                    )
+                },
+            )
+        )
+    return nodes, metrics
+
+
+def gen_pods(cfg: GenConfig) -> List[Pod]:
+    rng = np.random.default_rng(cfg.seed + 1)
+    pods: List[Pod] = []
+    gang_count = 0
+    for i in range(cfg.n_pods):
+        is_prod = rng.random() < cfg.prod_fraction
+        cpu = int(rng.choice([500, 1000, 2000, 4000], p=[0.4, 0.3, 0.2, 0.1]))
+        mem = cpu * int(rng.choice([2, 4, 8])) // 1  # MiB per milli-core ratio
+        prio = int(rng.integers(9000, 9999) if is_prod else rng.integers(5000, 5999))
+        labels = {}
+        if cfg.gang_fraction > 0 and rng.random() < cfg.gang_fraction:
+            labels[ext.LABEL_GANG_NAME] = f"gang-{gang_count // cfg.gang_size}"
+            gang_count += 1
+        pods.append(
+            Pod(
+                meta=ObjectMeta(name=f"pod-{i:06d}", namespace="sim", labels=labels),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem},
+                    priority=prio,
+                ),
+            )
+        )
+    return pods

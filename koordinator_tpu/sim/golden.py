@@ -1,0 +1,90 @@
+"""Scalar sequential reference scheduler — the golden model.
+
+A pure-numpy, one-pod-at-a-time re-implementation of the reference's
+scheduling semantics (Filter → Score → Reserve, upstream ``scheduleOne`` with
+LoadAware Filter ``load_aware.go:290-313`` and Score ``load_aware.go:387-406``).
+It is intentionally architecture-faithful to the reference — a per-pod loop
+over all nodes — which makes it both the correctness oracle for the batched
+TPU solver (SURVEY §4 "golden tests … vs a scalar re-implementation") and the
+measured stand-in baseline for bench.py (BASELINE.md: no published numbers,
+baselines must be measured).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+EPS = 1e-3
+
+
+def sequential_assign(
+    pod_req: np.ndarray,          # [P, D]
+    pod_estimate: np.ndarray,     # [P, D]
+    pod_priority: np.ndarray,     # [P]
+    pod_is_prod: np.ndarray,      # [P] bool
+    allocatable: np.ndarray,      # [N, D]
+    requested0: np.ndarray,       # [N, D]
+    estimated_used0: np.ndarray,  # [N, D]
+    prod_used0: np.ndarray,       # [N, D]
+    metric_fresh: np.ndarray,     # [N] bool
+    schedulable: np.ndarray,      # [N] bool
+    usage_thresholds: np.ndarray,  # [D] percent, 0 disables
+    prod_thresholds: np.ndarray,   # [D]
+    score_weights: np.ndarray,     # [D]
+) -> np.ndarray:
+    """Returns [P] node index per pod (-1 unschedulable), committing capacity
+    sequentially in (-priority, arrival) order."""
+    p, _ = pod_req.shape
+    requested = requested0.copy()
+    est_used = estimated_used0.copy()
+    prod_used = prod_used0.copy()
+    assignment = np.full(p, -1, np.int64)
+    order = np.lexsort((np.arange(p), -pod_priority))
+    wsum = score_weights.sum() + 1e-9
+    thr_on = usage_thresholds > 0
+    prod_thr_on = prod_thresholds > 0
+
+    for i in order:
+        req, est = pod_req[i], pod_estimate[i]
+        fit = np.all(requested + req <= allocatable + EPS, axis=1)
+        feas = fit & schedulable
+        if thr_on.any():
+            limit = allocatable * (usage_thresholds / 100.0)
+            over = thr_on[None, :] & (est_used + est > limit + EPS)
+            feas &= ~(metric_fresh & over.any(axis=1))
+        if pod_is_prod[i] and prod_thr_on.any():
+            limit = allocatable * (prod_thresholds / 100.0)
+            over = prod_thr_on[None, :] & (prod_used + est > limit + EPS)
+            feas &= ~(metric_fresh & over.any(axis=1))
+        if not feas.any():
+            continue
+        after = est_used + est
+        free = np.maximum(allocatable - after, 0.0)
+        per_dim = np.where(allocatable > 0, free * 100.0 / (allocatable + 1e-9), 0.0)
+        score = (per_dim * score_weights).sum(axis=1) / wsum
+        score[~feas] = -np.inf
+        best = int(np.argmax(score))
+        assignment[i] = best
+        requested[best] += req
+        est_used[best] += est
+        if pod_is_prod[i]:
+            prod_used[best] += est
+    return assignment
+
+
+def validate_assignment(
+    assignment: np.ndarray,
+    pod_req: np.ndarray,
+    allocatable: np.ndarray,
+    requested0: np.ndarray,
+    schedulable: np.ndarray,
+) -> None:
+    """Assert no node is over-committed and no pod landed on an unschedulable
+    node — the invariant any solver output must satisfy regardless of order."""
+    n, d = allocatable.shape
+    consumed = requested0.copy()
+    placed = assignment >= 0
+    np.add.at(consumed, assignment[placed], pod_req[placed])
+    over = consumed > allocatable + 1e-2
+    assert not over.any(), f"overcommitted nodes: {np.argwhere(over)[:10]}"
+    assert schedulable[assignment[placed]].all(), "pod on unschedulable node"

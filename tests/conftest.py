@@ -1,0 +1,26 @@
+"""Test harness: force an 8-device virtual CPU mesh before any computation.
+
+Mirrors the reference's strategy of testing multi-node behavior on fake
+substrates (kind containers, fake cgroupfs — SURVEY §4): sharding tests run
+against XLA's host-platform device partitioning instead of real TPU chips.
+
+Note: the environment may pre-import jax with a TPU platform pinned via
+JAX_PLATFORMS at interpreter startup (sitecustomize), so setting the env var
+here is too late — update jax.config directly instead.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,35 @@
+"""Annotation/priority protocol tests (reference table-driven style,
+``apis/extension/priority_test.go`` / ``qos_test.go``)."""
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.extension import PriorityClass, QoSClass
+
+
+def test_priority_bands():
+    cases = [
+        (9000, PriorityClass.PROD),
+        (9999, PriorityClass.PROD),
+        (7500, PriorityClass.MID),
+        (5000, PriorityClass.BATCH),
+        (5999, PriorityClass.BATCH),
+        (3000, PriorityClass.FREE),
+        (8999, PriorityClass.NONE),
+        (0, PriorityClass.NONE),
+        (None, PriorityClass.NONE),
+    ]
+    for prio, want in cases:
+        assert PriorityClass.from_priority(prio) is want, (prio, want)
+
+
+def test_qos_parse_and_defaults():
+    assert QoSClass.parse("LS") is QoSClass.LS
+    assert QoSClass.parse("lsr") is QoSClass.LSR
+    assert QoSClass.parse("bogus") is QoSClass.NONE
+    assert QoSClass.parse(None) is QoSClass.NONE
+    assert ext.qos_for_priority(PriorityClass.BATCH) is QoSClass.BE
+    assert ext.qos_for_priority(PriorityClass.PROD) is QoSClass.LS
+    assert ext.qos_for_priority(PriorityClass.NONE) is QoSClass.NONE
+
+
+def test_qos_strictness_order():
+    assert QoSClass.SYSTEM > QoSClass.LSE > QoSClass.LSR > QoSClass.LS > QoSClass.BE

@@ -1,0 +1,157 @@
+"""ClusterSnapshot incremental-update tests (analog of the reference's
+scheduler cache / podAssignCache unit tests)."""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceMetric,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot, bucket_size
+
+
+def mknode(name, cpu=64000, mem=256 * 1024):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}),
+    )
+
+
+def mkpod(name, cpu=1000, mem=2048, prio=9500):
+    return Pod(
+        meta=ObjectMeta(name=name),
+        spec=PodSpec(requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}, priority=prio),
+    )
+
+
+def test_bucket_size():
+    assert bucket_size(1) == 128
+    assert bucket_size(128) == 128
+    assert bucket_size(129) == 256
+    assert bucket_size(5000) == 8192
+
+
+def test_upsert_and_metric():
+    snap = ClusterSnapshot()
+    idx = snap.upsert_node(mknode("n1", cpu=32000))
+    assert snap.node_id("n1") == idx
+    assert snap.nodes.allocatable[idx][0] == 32000
+    assert snap.nodes.schedulable[idx]
+
+    metric = NodeMetric(
+        meta=ObjectMeta(name="n1"),
+        node_usage=ResourceMetric(usage={ext.RES_CPU: 8000}),
+        aggregated={"p95": ResourceMetric(usage={ext.RES_CPU: 10000})},
+        update_time=1000.0,
+    )
+    snap.set_node_metric(metric, now=1030.0)
+    assert snap.nodes.usage_avg[idx][0] == 8000
+    assert snap.nodes.usage_agg[idx][0] == 10000
+    assert snap.nodes.metric_fresh[idx]
+
+    # expiry (reference load_aware.go:143-149 degraded mode)
+    snap.set_node_metric(metric, now=1000.0 + 400.0)
+    assert not snap.nodes.metric_fresh[idx]
+
+
+def test_assume_forget_roundtrip():
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n1"))
+    pod = mkpod("p1", cpu=2000, mem=4096)
+    snap.assume_pod(pod, "n1")
+    idx = snap.node_id("n1")
+    assert snap.nodes.requested[idx][0] == 2000
+    assert snap.nodes.assigned_pending[idx][0] == 2000
+    snap.forget_pod(pod.meta.uid)
+    assert snap.nodes.requested[idx][0] == 0
+    assert snap.nodes.assigned_pending[idx][0] == 0
+
+
+def test_metric_report_absorbs_only_prior_assumptions():
+    """Pods assumed before the report's update_time are absorbed into the
+    reported usage; later assumptions keep contributing
+    (reference load_aware.go:315-358)."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n1"))
+    idx = snap.node_id("n1")
+    snap.assume_pod(mkpod("p-before"), "n1", now=90.0)
+    snap.assume_pod(mkpod("p-after"), "n1", now=105.0)
+    assert snap.nodes.assigned_pending[idx][0] == 2000
+    snap.set_node_metric(
+        NodeMetric(meta=ObjectMeta(name="n1"), update_time=100.0), now=110.0
+    )
+    # only p-before (assumed at t=90 < report t=100) is absorbed
+    assert snap.nodes.assigned_pending[idx][0] == 1000
+    # forgetting the absorbed pod must not drive pending negative
+    snap.forget_pod(mkpod("p-before").meta.uid)
+    assert snap.nodes.assigned_pending[idx][0] == 1000
+    assert snap.nodes.requested[idx][0] == 1000
+    snap.forget_pod(mkpod("p-after").meta.uid)
+    assert snap.nodes.assigned_pending[idx][0] == 0
+    assert snap.nodes.requested[idx][0] == 0
+
+
+def test_prod_pending_tracked_separately():
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n1"))
+    idx = snap.node_id("n1")
+    snap.assume_pod(mkpod("prod-pod", prio=9500), "n1")
+    snap.assume_pod(mkpod("batch-pod", prio=5500), "n1")
+    assert snap.nodes.assigned_pending[idx][0] == 2000
+    assert snap.nodes.assigned_pending_prod[idx][0] == 1000
+
+
+def test_remove_node_purges_assumed_entries():
+    """forget_pod after remove_node must not corrupt a reused slot."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n1"))
+    pod = mkpod("p1", cpu=2000)
+    snap.assume_pod(pod, "n1")
+    snap.remove_node("n1")
+    i3 = snap.upsert_node(mknode("n3"))
+    snap.forget_pod(pod.meta.uid)  # stale forget: must be a no-op
+    assert snap.nodes.requested[i3][0] == 0
+    assert snap.nodes.assigned_pending[i3][0] == 0
+
+
+def test_remove_node_and_slot_reuse():
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n1"))
+    snap.upsert_node(mknode("n2"))
+    i1 = snap.node_id("n1")
+    snap.remove_node("n1")
+    assert snap.node_id("n1") is None
+    assert not snap.nodes.schedulable[i1]
+    i3 = snap.upsert_node(mknode("n3"))
+    assert i3 == i1  # slot reused
+    assert snap.node_name(i3) == "n3"
+
+
+def test_node_growth_past_bucket():
+    snap = ClusterSnapshot()
+    for i in range(300):
+        snap.upsert_node(mknode(f"n{i}"))
+    assert snap.node_count == 300
+    assert snap.nodes.allocatable.shape[0] == 512
+    assert snap.nodes.schedulable[:300].all()
+    assert not snap.nodes.schedulable[300:].any()
+
+
+def test_build_pods_gangs_and_padding():
+    snap = ClusterSnapshot()
+    pods = [mkpod(f"p{i}") for i in range(5)]
+    pods[1].meta.labels[ext.LABEL_GANG_NAME] = "g1"
+    pods[3].meta.labels[ext.LABEL_GANG_NAME] = "g1"
+    pods[4].meta.labels[ext.LABEL_GANG_NAME] = "g2"
+    arr = snap.build_pods(pods)
+    assert arr.requests.shape[0] == 128
+    assert arr.valid[:5].all() and not arr.valid[5:].any()
+    assert arr.gang_id[1] == arr.gang_id[3] != arr.gang_id[4]
+    assert arr.gang_id[0] == -1
+    assert (arr.prio_class[:5] == int(ext.PriorityClass.PROD)).all()

@@ -1,0 +1,264 @@
+"""Golden tests: batched TPU solver vs scalar sequential reference.
+
+Follows the SURVEY §4 strategy: the reference's strongest pattern (pure
+cost/mask functions against synthetic fixtures) becomes golden comparisons
+between the vectorized kernels and ``sim.golden.sequential_assign``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from koordinator_tpu.ops.solver import (
+    NodeState,
+    PodBatch,
+    SolverParams,
+    assign,
+    assign_sequential,
+)
+from koordinator_tpu.sim import golden
+
+
+def make_fixture(
+    p=32,
+    n=16,
+    d=2,
+    seed=0,
+    base_util=0.0,
+    thresholds=(0.0, 0.0),
+    prod_thresholds=(0.0, 0.0),
+    pod_scale=1.0,
+):
+    rng = np.random.default_rng(seed)
+    alloc = rng.choice([32.0, 64.0, 96.0], (n, 1)) * np.ones((1, d), np.float32)
+    alloc = alloc.astype(np.float32)
+    requested = np.zeros((n, d), np.float32)
+    est_used = (alloc * base_util * rng.uniform(0.5, 1.5, (n, d))).astype(np.float32)
+    prod_used = est_used * 0.6
+    fresh = np.ones(n, bool)
+    sched = np.ones(n, bool)
+
+    req = (rng.choice([1.0, 2.0, 4.0, 8.0], (p, d)) * pod_scale).astype(np.float32)
+    est = (req * 0.85).astype(np.float32)
+    prio = rng.integers(5000, 9999, p).astype(np.int32)
+    is_prod = prio >= 9000
+
+    params = SolverParams(
+        usage_thresholds=jnp.asarray(thresholds, jnp.float32),
+        prod_thresholds=jnp.asarray(prod_thresholds, jnp.float32),
+        score_weights=jnp.ones(d, jnp.float32),
+    )
+    pods = PodBatch(
+        requests=jnp.asarray(req),
+        estimate=jnp.asarray(est),
+        priority=jnp.asarray(prio),
+        is_prod=jnp.asarray(is_prod),
+        valid=jnp.ones(p, bool),
+        gang_id=jnp.full(p, -1, jnp.int32),
+    )
+    nodes = NodeState(
+        allocatable=jnp.asarray(alloc),
+        requested=jnp.asarray(requested),
+        estimated_used=jnp.asarray(est_used),
+        prod_used=jnp.asarray(prod_used),
+        metric_fresh=jnp.asarray(fresh),
+        schedulable=jnp.asarray(sched),
+    )
+    np_fix = dict(
+        pod_req=req,
+        pod_estimate=est,
+        pod_priority=prio,
+        pod_is_prod=is_prod,
+        allocatable=alloc,
+        requested0=requested,
+        estimated_used0=est_used,
+        prod_used0=prod_used,
+        metric_fresh=fresh,
+        schedulable=sched,
+        usage_thresholds=np.asarray(thresholds, np.float32),
+        prod_thresholds=np.asarray(prod_thresholds, np.float32),
+        score_weights=np.ones(d, np.float32),
+    )
+    return pods, nodes, params, np_fix
+
+
+def run_both(pods, nodes, params, np_fix, solver=assign_sequential):
+    result = solver(pods, nodes, params)
+    got = np.asarray(result.assignment)
+    want = golden.sequential_assign(**np_fix)
+    return got, want
+
+
+def test_exact_match_low_contention():
+    """With ample capacity the batched solver must reproduce the sequential
+    reference exactly (every pod gets its argmin in round one)."""
+    pods, nodes, params, np_fix = make_fixture(p=24, n=12, seed=1)
+    got, want = run_both(pods, nodes, params, np_fix)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_exact_match_with_usage_thresholds():
+    pods, nodes, params, np_fix = make_fixture(
+        p=24, n=12, seed=2, base_util=0.5, thresholds=(65.0, 95.0)
+    )
+    got, want = run_both(pods, nodes, params, np_fix)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_invariants_under_contention():
+    """Heavy contention: allow order divergence from the sequential oracle but
+    require feasibility invariants and comparable placement count."""
+    pods, nodes, params, np_fix = make_fixture(
+        p=256, n=8, seed=3, pod_scale=4.0, thresholds=(80.0, 80.0), base_util=0.3
+    )
+    got, want = run_both(pods, nodes, params, np_fix)
+    golden.validate_assignment(
+        got,
+        np_fix["pod_req"],
+        np_fix["allocatable"],
+        np_fix["requested0"],
+        np_fix["schedulable"],
+    )
+    n_got, n_want = (got >= 0).sum(), (want >= 0).sum()
+    assert n_got >= 0.95 * n_want, (n_got, n_want)
+
+
+def test_all_infeasible():
+    pods, nodes, params, np_fix = make_fixture(p=8, n=4, seed=4, pod_scale=1000.0)
+    got, want = run_both(pods, nodes, params, np_fix)
+    assert (got == -1).all()
+    assert (want == -1).all()
+
+
+def test_unschedulable_nodes_excluded():
+    pods, nodes, params, np_fix = make_fixture(p=16, n=6, seed=5)
+    sched = np.zeros(6, bool)
+    sched[2] = True
+    nodes = nodes.replace(schedulable=jnp.asarray(sched))
+    np_fix["schedulable"] = sched
+    got, want = run_both(pods, nodes, params, np_fix)
+    placed = got >= 0
+    assert (got[placed] == 2).all()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_stale_metric_degrades_to_fit_only():
+    """Expired NodeMetric skips usage checks (load_aware.go:143-149)."""
+    pods, nodes, params, np_fix = make_fixture(
+        p=16, n=6, seed=6, base_util=0.9, thresholds=(50.0, 50.0)
+    )
+    # fresh metrics + over-threshold usage => nothing schedulable
+    got_fresh, want_fresh = run_both(pods, nodes, params, np_fix)
+    assert (got_fresh == -1).all() and (want_fresh == -1).all()
+    # stale metrics => usage ignored, fit admits everything
+    stale = np.zeros(6, bool)
+    nodes = nodes.replace(metric_fresh=jnp.asarray(stale))
+    np_fix["metric_fresh"] = stale
+    got, want = run_both(pods, nodes, params, np_fix)
+    assert (got >= 0).all()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_priority_order_wins_capacity():
+    """When one node fits exactly one pod, the higher-priority pod gets it."""
+    d = 2
+    alloc = np.array([[8.0, 8.0]], np.float32)
+    req = np.array([[8.0, 8.0], [8.0, 8.0]], np.float32)
+    prio = np.array([5000, 9500], np.int32)
+    pods = PodBatch(
+        requests=jnp.asarray(req),
+        estimate=jnp.asarray(req * 0.85),
+        priority=jnp.asarray(prio),
+        is_prod=jnp.asarray(prio >= 9000),
+        valid=jnp.ones(2, bool),
+        gang_id=jnp.full(2, -1, jnp.int32),
+    )
+    nodes = NodeState(
+        allocatable=jnp.asarray(alloc),
+        requested=jnp.zeros((1, d)),
+        estimated_used=jnp.zeros((1, d)),
+        prod_used=jnp.zeros((1, d)),
+        metric_fresh=jnp.ones(1, bool),
+        schedulable=jnp.ones(1, bool),
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.zeros(d),
+        prod_thresholds=jnp.zeros(d),
+        score_weights=jnp.ones(d),
+    )
+    got = np.asarray(assign(pods, nodes, params).assignment)
+    assert got[1] == 0 and got[0] == -1
+
+
+def test_padded_pods_never_assigned():
+    pods, nodes, params, _ = make_fixture(p=16, n=6, seed=7)
+    valid = np.zeros(16, bool)
+    valid[:3] = True
+    pods = pods.replace(valid=jnp.asarray(valid))
+    got = np.asarray(assign(pods, nodes, params).assignment)
+    assert (got[3:] == -1).all()
+    assert (got[:3] >= 0).all()
+
+
+# ---- round-based fast solver (ops.solver.assign) ----
+
+
+def test_round_solver_invariants_and_quality():
+    """The fast solver must satisfy feasibility invariants, place a
+    comparable number of pods, and keep LoadAware balance close to the
+    sequential oracle (its nominations are revalidated host-side anyway)."""
+    pods, nodes, params, np_fix = make_fixture(
+        p=128, n=16, seed=11, thresholds=(80.0, 80.0), base_util=0.2
+    )
+    got, want = run_both(pods, nodes, params, np_fix, solver=assign)
+    golden.validate_assignment(
+        got,
+        np_fix["pod_req"],
+        np_fix["allocatable"],
+        np_fix["requested0"],
+        np_fix["schedulable"],
+    )
+    assert (got >= 0).sum() >= 0.95 * (want >= 0).sum()
+
+    def peak_util(assignment):
+        used = np_fix["estimated_used0"].copy()
+        placed = assignment >= 0
+        np.add.at(used, assignment[placed], np_fix["pod_estimate"][placed])
+        return float((used / np_fix["allocatable"]).max())
+
+    # balance: peak estimated utilization within 15 points of the oracle
+    assert peak_util(got) <= peak_util(want) + 0.15, (
+        peak_util(got),
+        peak_util(want),
+    )
+
+
+def test_round_solver_matches_sequential_on_tiny_case():
+    pods, nodes, params, np_fix = make_fixture(p=4, n=8, seed=12)
+    got = np.asarray(assign(pods, nodes, params).assignment)
+    want = golden.sequential_assign(**np_fix)
+    golden.validate_assignment(
+        got,
+        np_fix["pod_req"],
+        np_fix["allocatable"],
+        np_fix["requested0"],
+        np_fix["schedulable"],
+    )
+    assert (got >= 0).sum() == (want >= 0).sum()
+
+
+def test_scan_solver_agrees_with_round_solver_feasibility():
+    pods, nodes, params, np_fix = make_fixture(
+        p=64, n=8, seed=13, pod_scale=2.0, thresholds=(75.0, 90.0), base_util=0.4
+    )
+    seq = np.asarray(assign_sequential(pods, nodes, params).assignment)
+    fast = np.asarray(assign(pods, nodes, params).assignment)
+    for a in (seq, fast):
+        golden.validate_assignment(
+            a,
+            np_fix["pod_req"],
+            np_fix["allocatable"],
+            np_fix["requested0"],
+            np_fix["schedulable"],
+        )
