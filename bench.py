@@ -53,13 +53,10 @@ def bench_solver(fix) -> float:
 
     from koordinator_tpu.ops.solver import NodeState, PodBatch, SolverParams, assign
 
-    nodes = NodeState(
-        allocatable=jnp.asarray(fix["alloc"]),
-        requested=jnp.zeros_like(jnp.asarray(fix["alloc"])),
-        estimated_used=jnp.asarray(fix["est_used"]),
-        prod_used=jnp.asarray(fix["prod_used"]),
-        metric_fresh=jnp.ones(N_NODES, bool),
-        schedulable=jnp.ones(N_NODES, bool),
+    nodes = NodeState.create(
+        allocatable=fix["alloc"],
+        estimated_used=fix["est_used"],
+        prod_used=fix["prod_used"],
     )
     params = SolverParams(
         usage_thresholds=jnp.asarray(THRESHOLDS, jnp.float32),
@@ -69,13 +66,11 @@ def bench_solver(fix) -> float:
 
     def batch_at(start):
         sl = slice(start, start + BATCH)
-        return PodBatch(
-            requests=jnp.asarray(fix["req"][sl]),
-            estimate=jnp.asarray(fix["est"][sl]),
-            priority=jnp.asarray(fix["prio"][sl]),
-            is_prod=jnp.asarray(fix["is_prod"][sl]),
-            valid=jnp.ones(BATCH, bool),
-            gang_id=jnp.full(BATCH, -1, jnp.int32),
+        return PodBatch.create(
+            requests=fix["req"][sl],
+            estimate=fix["est"][sl],
+            priority=fix["prio"][sl],
+            is_prod=fix["is_prod"][sl],
         )
 
     # warmup / compile

@@ -112,7 +112,11 @@ class PodArrays:
     gang_id: np.ndarray
     quota_id: np.ndarray
     valid: np.ndarray
+    #: row g: minMember of gang g (0 = unconstrained), indexed by gang_id
+    gang_min: np.ndarray
     p_real: int
+    #: gang id -> "namespace/name" key, parallel to gang_min rows
+    gang_keys: List[str] = dataclasses.field(default_factory=list)
 
     @classmethod
     def empty(cls, p_bucket: int, dims: int) -> "PodArrays":
@@ -124,6 +128,7 @@ class PodArrays:
             gang_id=np.full((p_bucket,), -1, np.int32),
             quota_id=np.full((p_bucket,), -1, np.int32),
             valid=np.zeros((p_bucket,), bool),
+            gang_min=np.zeros((p_bucket,), np.int32),
             p_real=0,
         )
 
@@ -329,10 +334,23 @@ class ClusterSnapshot:
 
     # ---- pod batch build ----
 
-    def build_pods(self, pods: Sequence[Pod]) -> PodArrays:
+    def build_pods(
+        self,
+        pods: Sequence[Pod],
+        min_member_by_gang: Optional[Mapping[str, int]] = None,
+    ) -> PodArrays:
+        """Lower pending pods to dense arrays.
+
+        Gang minMember resolution order (reference: PodGroup CRD or the
+        ``pod-group.scheduling.sigs.k8s.io/min-available`` annotation,
+        ``pkg/scheduler/plugins/coscheduling/core/core.go``):
+        explicit mapping > pod label > member count in this batch.
+        """
         p_bucket = bucket_size(len(pods), self.config.min_bucket)
         out = PodArrays.empty(p_bucket, self.config.dims)
         gang_ids: Dict[str, int] = {}
+        gang_members: Dict[int, int] = {}
+        gang_label_min: Dict[int, int] = {}
         for i, pod in enumerate(pods):
             out.requests[i] = self.config.res_vector(pod.spec.requests)
             out.priority[i] = pod.spec.priority or 0
@@ -341,7 +359,24 @@ class ClusterSnapshot:
             gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
             if gang:
                 key = f"{pod.meta.namespace}/{gang}"
-                out.gang_id[i] = gang_ids.setdefault(key, len(gang_ids))
+                gid = gang_ids.setdefault(key, len(gang_ids))
+                out.gang_id[i] = gid
+                gang_members[gid] = gang_members.get(gid, 0) + 1
+                label_min = pod.meta.labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
+                if label_min is not None:
+                    try:
+                        gang_label_min[gid] = int(label_min)
+                    except ValueError:
+                        pass
             out.valid[i] = True
+        out.gang_keys = [k for k, _ in sorted(gang_ids.items(), key=lambda kv: kv[1])]
+        for key, gid in gang_ids.items():
+            explicit = (min_member_by_gang or {}).get(key)
+            if explicit is not None:
+                out.gang_min[gid] = explicit
+            elif gid in gang_label_min:
+                out.gang_min[gid] = gang_label_min[gid]
+            else:
+                out.gang_min[gid] = gang_members[gid]
         out.p_real = len(pods)
         return out

@@ -56,6 +56,34 @@ class NodeState:
     metric_fresh: jnp.ndarray     # [N] bool
     schedulable: jnp.ndarray      # [N] bool
 
+    @classmethod
+    def create(
+        cls,
+        allocatable,
+        requested=None,
+        estimated_used=None,
+        prod_used=None,
+        metric_fresh=None,
+        schedulable=None,
+    ) -> "NodeState":
+        allocatable = jnp.asarray(allocatable, jnp.float32)
+        n = allocatable.shape[0]
+        z = jnp.zeros_like(allocatable)
+        return cls(
+            allocatable=allocatable,
+            requested=z if requested is None else jnp.asarray(requested, jnp.float32),
+            estimated_used=(
+                z if estimated_used is None else jnp.asarray(estimated_used, jnp.float32)
+            ),
+            prod_used=z if prod_used is None else jnp.asarray(prod_used, jnp.float32),
+            metric_fresh=(
+                jnp.ones(n, bool) if metric_fresh is None else jnp.asarray(metric_fresh)
+            ),
+            schedulable=(
+                jnp.ones(n, bool) if schedulable is None else jnp.asarray(schedulable)
+            ),
+        )
+
 
 @struct.dataclass
 class PodBatch:
@@ -65,6 +93,75 @@ class PodBatch:
     is_prod: jnp.ndarray          # [P] bool
     valid: jnp.ndarray            # [P] bool
     gang_id: jnp.ndarray          # [P] int32, -1 = no gang
+    #: row g holds minMember of gang g (PodGroup.spec.minMember); rows
+    #: beyond the number of gangs are 0. Indexed by gang_id, sized [P].
+    gang_min: jnp.ndarray
+    #: leaf-to-root quota index path per pod, [P, L] int32, -1 = none
+    #: (ElasticQuota tree; level 0 is the leaf)
+    quota_chain: jnp.ndarray
+
+    @classmethod
+    def create(
+        cls,
+        requests,
+        priority,
+        estimate=None,
+        is_prod=None,
+        valid=None,
+        gang_id=None,
+        gang_min=None,
+        quota_chain=None,
+        quota_levels: int = 4,
+    ) -> "PodBatch":
+        requests = jnp.asarray(requests, jnp.float32)
+        priority = jnp.asarray(priority, jnp.int32)
+        p = requests.shape[0]
+        return cls(
+            requests=requests,
+            estimate=(
+                requests if estimate is None else jnp.asarray(estimate, jnp.float32)
+            ),
+            priority=priority,
+            is_prod=(priority >= 9000) if is_prod is None else jnp.asarray(is_prod),
+            valid=jnp.ones(p, bool) if valid is None else jnp.asarray(valid),
+            gang_id=(
+                jnp.full(p, -1, jnp.int32)
+                if gang_id is None
+                else jnp.asarray(gang_id, jnp.int32)
+            ),
+            gang_min=(
+                jnp.zeros(p, jnp.int32)
+                if gang_min is None
+                else jnp.asarray(gang_min, jnp.int32)
+            ),
+            quota_chain=(
+                jnp.full((p, quota_levels), -1, jnp.int32)
+                if quota_chain is None
+                else jnp.asarray(quota_chain, jnp.int32)
+            ),
+        )
+
+
+@struct.dataclass
+class QuotaState:
+    """Device-side ElasticQuota accounting ([Q, D] each).
+
+    ``runtime`` is the fair-share entitlement computed host-side by the
+    GroupQuotaManager (reference ``core/runtime_quota_calculator.go``);
+    ``used`` is the sum of admitted pod requests charged to each quota
+    (admission rule used+request ≤ runtime along the whole chain,
+    reference ``plugin_helper.go:281-317``).
+    """
+
+    runtime: jnp.ndarray
+    used: jnp.ndarray
+
+    @classmethod
+    def disabled(cls, dims: int) -> "QuotaState":
+        return cls(
+            runtime=jnp.full((1, dims), jnp.inf, jnp.float32),
+            used=jnp.zeros((1, dims), jnp.float32),
+        )
 
 
 @struct.dataclass
@@ -85,7 +182,70 @@ class SolveResult:
     assignment: jnp.ndarray       # [P] int32 node index, -1 = unschedulable
     node_requested: jnp.ndarray   # [N, D] post-commit
     node_estimated_used: jnp.ndarray  # [N, D] post-commit
+    quota_used: jnp.ndarray       # [Q, D] post-commit
     rounds_used: jnp.ndarray      # [] int32
+
+
+def _quota_headroom(
+    requests: jnp.ndarray, chain: jnp.ndarray, quotas: QuotaState
+) -> jnp.ndarray:
+    """Per-pod admission mask: used + request ≤ runtime along the whole
+    quota chain (reference ``plugin_helper.go:281-317``). [P] bool."""
+    q_cap = quotas.runtime.shape[0]
+    q = jnp.clip(chain, 0, q_cap - 1)                       # [P, L]
+    valid = chain >= 0
+    head = jnp.all(
+        quotas.used[q] + requests[:, None, :] <= quotas.runtime[q] + EPS,
+        axis=-1,
+    )                                                        # [P, L]
+    return jnp.all(head | ~valid, axis=-1)
+
+
+def _quota_commit(
+    accepted: jnp.ndarray,
+    requests: jnp.ndarray,
+    chain: jnp.ndarray,
+    quotas: QuotaState,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Cumulative in-round quota admission, pods given in priority order.
+
+    For each chain level, charge node-accepted pods against their quota in
+    priority order via segmented prefix sums; a pod must clear every level.
+    Rejections at a deeper level may leave shallower prefix sums
+    conservative for this round — safe (under-admission), corrected next
+    round. Returns (final_accept [P], new_used [Q, D])."""
+    p, levels = chain.shape
+    q_cap = quotas.runtime.shape[0]
+    ok = jnp.ones((p,), bool)
+    for level in range(levels):
+        key_raw = chain[:, level]
+        participating = accepted & (key_raw >= 0)
+        key = jnp.where(participating, key_raw, q_cap)
+        sidx = jnp.argsort(key, stable=True).astype(jnp.int32)
+        skey = key[sidx]
+        sreq = jnp.where(participating[sidx][:, None], requests[sidx], 0.0)
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), skey[1:] != skey[:-1]]
+        )
+        seg = _segment_prefix_sums(sreq, is_start)
+        gq = jnp.minimum(skey, q_cap - 1)
+        fits = jnp.all(
+            quotas.used[gq] + seg <= quotas.runtime[gq] + EPS, axis=-1
+        )
+        ok_sorted = (skey >= q_cap) | fits
+        ok &= jnp.zeros((p,), bool).at[sidx].set(ok_sorted)
+    final = accepted & ok
+    new_used = quotas.used
+    for level in range(levels):
+        key_raw = chain[:, level]
+        charge = final & (key_raw >= 0)
+        seg_ids = jnp.where(charge, key_raw, q_cap - 1)
+        new_used = new_used + jax.ops.segment_sum(
+            jnp.where(charge[:, None], requests, 0.0),
+            seg_ids,
+            num_segments=q_cap,
+        )
+    return final, new_used
 
 
 def _segment_prefix_sums(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -137,6 +297,7 @@ def assign(
     pods: PodBatch,
     nodes: NodeState,
     params: SolverParams,
+    quotas: QuotaState | None = None,
     max_rounds: int = 24,
     round_quantum: float = 0.15,
     topk: int = 8,
@@ -148,12 +309,17 @@ def assign(
     fan-out per pod per round (see round_body)."""
     p = pods.requests.shape[0]
     n = nodes.allocatable.shape[0]
+    # Static specialization: with no quota tree the per-level sort/prefix
+    # passes are dead weight — trace them out entirely.
+    quota_enabled = quotas is not None
+    if quotas is None:
+        quotas = QuotaState.disabled(pods.requests.shape[1])
 
     order = _priority_order(pods)
     spods = jax.tree.map(lambda a: a[order], pods)
 
     def round_body(carry):
-        assigned, requested, est_used, prod_used, active, _progress, r = carry
+        assigned, requested, est_used, prod_used, qused, active, _progress, r = carry
         work = NodeState(
             allocatable=nodes.allocatable,
             requested=requested,
@@ -162,7 +328,14 @@ def assign(
             metric_fresh=nodes.metric_fresh,
             schedulable=nodes.schedulable,
         )
-        feas = _feasible(spods, work, params, active)
+        round_quotas = QuotaState(runtime=quotas.runtime, used=qused)
+        if quota_enabled:
+            q_head = _quota_headroom(
+                spods.requests, spods.quota_chain, round_quotas
+            )
+            feas = _feasible(spods, work, params, active & q_head)
+        else:
+            feas = _feasible(spods, work, params, active)
         cost = cost_ops.load_aware_cost(
             spods.estimate, est_used, nodes.allocatable, params.score_weights
         )
@@ -225,32 +398,44 @@ def assign(
         prior_est = seg_est - sest
         accept &= jnp.all(prior_est <= round_quantum * alloc_g + EPS, axis=-1)
 
-        accepted = jnp.zeros((p,), bool).at[sortidx].set(accept)
-        assigned = jnp.where(accepted, choice, assigned)
+        # Quota admission: cumulative along the chain in priority order;
+        # a node-accepted pod must also clear every quota level.
+        accepted_prio = jnp.zeros((p,), bool).at[sortidx].set(accept)
+        if quota_enabled:
+            final_prio, qused_new = _quota_commit(
+                accepted_prio, spods.requests, spods.quota_chain, round_quotas
+            )
+        else:
+            final_prio, qused_new = accepted_prio, qused
+        final_node = final_prio[sortidx]
+        assigned = jnp.where(final_prio, choice, assigned)
 
-        seg_ids = jnp.where(accept, snode, n - 1)
+        seg_ids = jnp.where(final_node, snode, n - 1)
         zero = jnp.zeros_like(sreq)
         dreq = jax.ops.segment_sum(
-            jnp.where(accept[:, None], sreq, zero), seg_ids, num_segments=n
+            jnp.where(final_node[:, None], sreq, zero), seg_ids, num_segments=n
         )
         dest = jax.ops.segment_sum(
-            jnp.where(accept[:, None], sest, zero), seg_ids, num_segments=n
+            jnp.where(final_node[:, None], sest, zero), seg_ids, num_segments=n
         )
         dprod = jax.ops.segment_sum(
-            jnp.where((accept & sprod)[:, None], sest, zero), seg_ids, num_segments=n
+            jnp.where((final_node & sprod)[:, None], sest, zero),
+            seg_ids,
+            num_segments=n,
         )
         return (
             assigned,
             requested + dreq,
             est_used + dest,
             prod_used + dprod,
+            qused_new,
             active & (assigned < 0),
-            jnp.any(accept),
+            jnp.any(final_prio),
             r + 1,
         )
 
     def round_cond(carry):
-        _assigned, _req, _est, _prod, active, progress, r = carry
+        _assigned, _req, _est, _prod, _qused, active, progress, r = carry
         return (r < max_rounds) & progress & jnp.any(active)
 
     init = (
@@ -258,27 +443,97 @@ def assign(
         nodes.requested,
         nodes.estimated_used,
         nodes.prod_used,
+        quotas.used,
         pods.valid[order],
         jnp.array(True),
         jnp.array(0, jnp.int32),
     )
-    assigned_s, req_f, est_f, _prod_f, _active, _prog, rounds = jax.lax.while_loop(
-        round_cond, round_body, init
-    )
+    (
+        assigned_s,
+        req_f,
+        est_f,
+        _prod_f,
+        qused_f,
+        _active,
+        _prog,
+        rounds,
+    ) = jax.lax.while_loop(round_cond, round_body, init)
 
     # Scatter back to original pod order.
     assignment = jnp.full((p,), -1, jnp.int32).at[order].set(assigned_s)
-    return SolveResult(
+    result = SolveResult(
         assignment=assignment,
         node_requested=req_f,
         node_estimated_used=est_f,
+        quota_used=qused_f,
         rounds_used=rounds,
+    )
+    return enforce_gangs(result, pods)
+
+
+@jax.jit
+def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
+    """All-or-nothing gang rollback (Coscheduling Permit semantics,
+    reference ``pkg/scheduler/plugins/coscheduling/core/core.go:346-465``:
+    bound-ready pods are held until the whole gang passes, otherwise the
+    gang group is rejected and re-queued).
+
+    Gangs whose placed-member count is below ``minMember`` have all their
+    placements rolled back and their capacity returned, exactly like the
+    reference rejecting a gang at Permit and cycling it back to the queue.
+    """
+    p = pods.requests.shape[0]
+    n = result.node_requested.shape[0]
+    assignment = result.assignment
+    placed = assignment >= 0
+    has_gang = pods.gang_id >= 0
+    gid = jnp.clip(pods.gang_id, 0, p - 1)
+    counts = jax.ops.segment_sum(
+        (placed & has_gang).astype(jnp.int32), gid, num_segments=p
+    )
+    gang_ok = counts >= pods.gang_min
+    keep = placed & (~has_gang | gang_ok[gid])
+    rollback = placed & ~keep
+
+    node_of = jnp.clip(assignment, 0, n - 1)
+    zero = jnp.zeros_like(pods.requests)
+    dreq = jax.ops.segment_sum(
+        jnp.where(rollback[:, None], pods.requests, zero),
+        jnp.where(rollback, node_of, n - 1),
+        num_segments=n,
+    )
+    dest = jax.ops.segment_sum(
+        jnp.where(rollback[:, None], pods.estimate, zero),
+        jnp.where(rollback, node_of, n - 1),
+        num_segments=n,
+    )
+    # Refund quota charges of rolled-back pods along their chains.
+    # (Q == 1 is the disabled sentinel — real trees are padded to Q ≥ 2.)
+    quota_used = result.quota_used
+    q_cap = quota_used.shape[0]
+    for level in range(pods.quota_chain.shape[1] if q_cap > 1 else 0):
+        key_raw = pods.quota_chain[:, level]
+        refund = rollback & (key_raw >= 0)
+        quota_used = quota_used - jax.ops.segment_sum(
+            jnp.where(refund[:, None], pods.requests, zero),
+            jnp.where(refund, key_raw, q_cap - 1),
+            num_segments=q_cap,
+        )
+    return SolveResult(
+        assignment=jnp.where(keep, assignment, -1),
+        node_requested=result.node_requested - dreq,
+        node_estimated_used=result.node_estimated_used - dest,
+        quota_used=quota_used,
+        rounds_used=result.rounds_used,
     )
 
 
 @jax.jit
 def assign_sequential(
-    pods: PodBatch, nodes: NodeState, params: SolverParams
+    pods: PodBatch,
+    nodes: NodeState,
+    params: SolverParams,
+    quotas: QuotaState | None = None,
 ) -> SolveResult:
     """Exact sequential-commit solver: ``lax.scan`` over pods in priority
     order, vectorized over nodes inside each step. Bit-faithful to the
@@ -287,14 +542,30 @@ def assign_sequential(
     commit (scan)")."""
     p = pods.requests.shape[0]
     n = nodes.allocatable.shape[0]
+    quota_enabled = quotas is not None
+    if quotas is None:
+        quotas = QuotaState.disabled(pods.requests.shape[1])
+    q_cap = quotas.runtime.shape[0]
     order = _priority_order(pods)
     spods = jax.tree.map(lambda a: a[order], pods)
 
     def step(carry, xs):
-        requested, est_used, prod_used = carry
-        req, est, is_prod, valid = xs
+        requested, est_used, prod_used, qused = carry
+        req, est, is_prod, valid, qchain = xs
         free = nodes.allocatable - requested
         feas = jnp.all(req[None, :] <= free + EPS, axis=-1)
+        # quota admission along the chain (pod-level, node-independent)
+        qidx = jnp.clip(qchain, 0, q_cap - 1)
+        q_valid = qchain >= 0
+        if quota_enabled:
+            q_ok = jnp.all(
+                jnp.all(
+                    qused[qidx] + req[None, :] <= quotas.runtime[qidx] + EPS,
+                    axis=-1,
+                )
+                | ~q_valid
+            )
+            feas &= q_ok
         thr = params.usage_thresholds
         limit = nodes.allocatable * (thr / 100.0)
         over = (thr > 0.0) & (est_used + est[None, :] > limit + EPS)
@@ -322,17 +593,32 @@ def assign_sequential(
         requested = requested + jnp.where(onehot, req[None, :], 0.0)
         est_used = est_used + jnp.where(onehot, est[None, :], 0.0)
         prod_used = prod_used + jnp.where(onehot & is_prod, est[None, :], 0.0)
-        return (requested, est_used, prod_used), jnp.where(has, best, -1)
+        if quota_enabled:
+            charge = (
+                (jnp.arange(q_cap)[:, None] == qidx[None, :])
+                & q_valid[None, :]
+                & has
+            )
+            qused = qused + jnp.any(charge, axis=1)[:, None] * req[None, :]
+        return (requested, est_used, prod_used, qused), jnp.where(has, best, -1)
 
-    (req_f, est_f, _), assigned_s = jax.lax.scan(
+    (req_f, est_f, _, qused_f), assigned_s = jax.lax.scan(
         step,
-        (nodes.requested, nodes.estimated_used, nodes.prod_used),
-        (spods.requests, spods.estimate, spods.is_prod, spods.valid),
+        (nodes.requested, nodes.estimated_used, nodes.prod_used, quotas.used),
+        (
+            spods.requests,
+            spods.estimate,
+            spods.is_prod,
+            spods.valid,
+            spods.quota_chain,
+        ),
     )
     assignment = jnp.full((p,), -1, jnp.int32).at[order].set(assigned_s)
-    return SolveResult(
+    result = SolveResult(
         assignment=assignment,
         node_requested=req_f,
         node_estimated_used=est_f,
+        quota_used=qused_f,
         rounds_used=jnp.array(p, jnp.int32),
     )
+    return enforce_gangs(result, pods)

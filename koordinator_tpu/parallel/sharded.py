@@ -59,6 +59,10 @@ def _pod_spec() -> PodBatch:
         is_prod=P("dp"),
         valid=P("dp"),
         gang_id=P("dp"),
+        # gang_min/quota arrays are indexed by gang/quota id (batch-global),
+        # not pod row: replicate so segment ops stay local.
+        gang_min=P(),
+        quota_chain=P("dp", None),
     )
 
 
@@ -93,6 +97,7 @@ def sharded_assign(
         assignment=NamedSharding(mesh, P("dp")),
         node_requested=NamedSharding(mesh, P("tp", None)),
         node_estimated_used=NamedSharding(mesh, P("tp", None)),
+        quota_used=rep,
         rounds_used=rep,
     )
 

@@ -21,7 +21,14 @@ from ..api import extension as ext
 from ..api.types import Pod
 from ..core.snapshot import ClusterSnapshot, SnapshotConfig, bucket_size
 from ..ops import estimator
-from ..ops.solver import NodeState, PodBatch, SolverParams, SolveResult, assign
+from ..ops.solver import (
+    NodeState,
+    PodBatch,
+    QuotaState,
+    SolverParams,
+    SolveResult,
+    assign,
+)
 
 
 @dataclasses.dataclass
@@ -79,7 +86,12 @@ class BatchScheduler:
         args: Optional[LoadAwareArgs] = None,
         batch_bucket: int = 4096,
         max_rounds: int = 16,
+        pod_groups: Optional["PodGroupManager"] = None,
+        quotas: Optional["GroupQuotaManager"] = None,
     ):
+        from .plugins.coscheduling import PodGroupManager
+        from .plugins.elasticquota import GroupQuotaManager
+
         self.snapshot = snapshot or ClusterSnapshot()
         self.args = args or LoadAwareArgs()
         # wire plugin args into metric ingest (agg percentile + expiry)
@@ -87,6 +99,8 @@ class BatchScheduler:
         self.snapshot.metric_expiry_s = self.args.node_metric_expiration_s
         self.batch_bucket = batch_bucket
         self.max_rounds = max_rounds
+        self.pod_groups = pod_groups or PodGroupManager()
+        self.quotas = quotas or GroupQuotaManager(self.snapshot.config)
         self._params = self.args.solver_params(self.snapshot.config)
         self._scales = self.args.scale_vector(self.snapshot.config)
 
@@ -105,42 +119,114 @@ class BatchScheduler:
         )
 
     def pod_batch(self, pods: Sequence[Pod], bucket: Optional[int] = None) -> PodBatch:
-        arrays = self.snapshot.build_pods(list(pods))
+        arrays = self.snapshot.build_pods(
+            list(pods), min_member_by_gang=self.pod_groups.min_member_map()
+        )
         b = bucket or bucket_size(len(pods), self.snapshot.config.min_bucket)
         if arrays.requests.shape[0] != b:
             raise ValueError("pod bucket mismatch")
         est = arrays.requests * self._scales[None, :]
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
-        return PodBatch(
-            requests=jnp.asarray(arrays.requests),
-            estimate=jnp.asarray(est),
-            priority=jnp.asarray(arrays.priority),
-            is_prod=jnp.asarray(is_prod),
-            valid=jnp.asarray(arrays.valid),
-            gang_id=jnp.asarray(arrays.gang_id),
+        chains = self.quotas.chains_for_pods(list(pods), b)
+        return PodBatch.create(
+            requests=arrays.requests,
+            estimate=est,
+            priority=arrays.priority,
+            is_prod=is_prod,
+            valid=arrays.valid,
+            gang_id=arrays.gang_id,
+            gang_min=arrays.gang_min,
+            quota_chain=chains,
         )
 
     # ---- scheduling cycle ----
 
     def schedule(self, pending: Sequence[Pod]) -> ScheduleOutcome:
+        # PreEnqueue gate + gang-adjacent ordering (coscheduling NextPod):
+        # whole gangs land in one solver batch.
+        self.pod_groups.begin_cycle(pending)
+        eligible = self.pod_groups.order_pending(pending)
+        eligible_uids = {p.meta.uid for p in eligible}
+        gated = [p for p in pending if p.meta.uid not in eligible_uids]
+
         bound: List[Tuple[Pod, str]] = []
-        unsched: List[Pod] = []
+        unsched: List[Pod] = list(gated)
         rounds = 0
-        for start in range(0, max(len(pending), 1), self.batch_bucket):
-            chunk = list(pending[start : start + self.batch_bucket])
-            if not chunk:
-                break
+        for chunk in self._chunks(eligible):
             result = self.solve(chunk)
             rounds += int(result.rounds_used)
             b, u = self._commit(chunk, np.asarray(result.assignment))
             bound.extend(b)
             unsched.extend(u)
+        for pod, _node in bound:
+            self.pod_groups.remove_pod(pod, bound=True)
         return ScheduleOutcome(bound=bound, unschedulable=unsched, rounds_used=rounds)
+
+    def _chunks(self, eligible: Sequence[Pod]) -> List[List[Pod]]:
+        """Split into solver batches of ~batch_bucket without splitting a
+        gang across chunks (a split gang would be rolled back on both
+        sides). A gang larger than the bucket becomes its own chunk —
+        bucketed padding handles the odd size."""
+        from .plugins.coscheduling import gang_key_of
+
+        blocks: List[List[Pod]] = []
+        i = 0
+        n = len(eligible)
+        while i < n:
+            key = gang_key_of(eligible[i])
+            j = i + 1
+            if key is not None:
+                while j < n and gang_key_of(eligible[j]) == key:
+                    j += 1
+            blocks.append(list(eligible[i:j]))
+            i = j
+        chunks: List[List[Pod]] = []
+        cur: List[Pod] = []
+        for block in blocks:
+            if cur and len(cur) + len(block) > self.batch_bucket:
+                chunks.append(cur)
+                cur = []
+            cur.extend(block)
+        if cur:
+            chunks.append(cur)
+        return chunks
 
     def solve(self, chunk: Sequence[Pod]) -> SolveResult:
         pods = self.pod_batch(chunk)
         nodes = self.node_state()
-        return assign(pods, nodes, self._params, max_rounds=self.max_rounds)
+        quotas = self.quota_state(chunk)
+        return assign(
+            pods, nodes, self._params, quotas=quotas, max_rounds=self.max_rounds
+        )
+
+    def quota_state(self, chunk: Sequence[Pod]) -> Optional[QuotaState]:
+        """Lowered QuotaState, or None when no quota tree exists (the solver
+        traces the quota passes out entirely)."""
+        from .plugins.elasticquota import quota_name_of
+
+        if self.quotas.quota_count == 0:
+            return None
+        # Propagate desired requests (pending + admitted) up the tree so
+        # fair sharing reflects demand, then refresh runtime.
+        by_leaf: Dict[str, np.ndarray] = {}
+        for pod in chunk:
+            leaf = quota_name_of(pod)
+            if leaf is None:
+                continue
+            vec = self.snapshot.config.res_vector(pod.spec.requests)
+            by_leaf[leaf] = by_leaf.get(leaf, 0) + vec
+        for leaf in list(by_leaf):
+            idx = self.quotas.index_of(leaf)
+            if idx is not None and idx < self.quotas.used.shape[0]:
+                by_leaf[leaf] = by_leaf[leaf] + self.quotas.used[idx]
+        self.quotas.set_leaf_requests(by_leaf)
+        runtime, used = self.quotas.quota_arrays()
+        if runtime.shape[0] == 1:
+            # pad: Q == 1 is reserved as the disabled sentinel
+            pad = np.zeros((1, runtime.shape[1]), np.float32)
+            runtime = np.concatenate([runtime, pad])
+            used = np.concatenate([used, pad])
+        return QuotaState(runtime=jnp.asarray(runtime), used=jnp.asarray(used))
 
     def _commit(
         self, chunk: Sequence[Pod], assignment: np.ndarray
@@ -149,15 +235,14 @@ class BatchScheduler:
         state (the reference's Reserve mutates the scheduler cache the same
         way, ``framework_extender.go:546``)."""
         na = self.snapshot.nodes
-        bound: List[Tuple[Pod, str]] = []
-        unsched: List[Pod] = []
+        results: List[Tuple[Pod, Optional[str]]] = []
         order = sorted(
             range(len(chunk)), key=lambda i: (-(chunk[i].spec.priority or 0), i)
         )
         for i in order:
             pod, node_idx = chunk[i], int(assignment[i])
             if node_idx < 0:
-                unsched.append(pod)
+                results.append((pod, None))
                 continue
             req = self.snapshot.config.res_vector(pod.spec.requests)
             if not bool(
@@ -167,9 +252,22 @@ class BatchScheduler:
                 )
                 and na.schedulable[node_idx]
             ):
-                unsched.append(pod)
+                results.append((pod, None))
                 continue
             est = req * self._scales
             self.snapshot.assume_pod(pod, self.snapshot.node_name(node_idx), est)
-            bound.append((pod, self.snapshot.node_name(node_idx)))
+            results.append((pod, self.snapshot.node_name(node_idx)))
+        # Permit: all-or-nothing over gangs; roll back assumes of rejects.
+        bound, unsched = self.pod_groups.permit(results)
+        bound_uids = {p.meta.uid for p, _ in bound}
+        for pod, node in results:
+            if node is not None and pod.meta.uid not in bound_uids:
+                self.snapshot.forget_pod(pod.meta.uid)
+        # Durable quota accounting for what actually bound.
+        from .plugins.elasticquota import quota_name_of
+
+        for pod, _node in bound:
+            leaf = quota_name_of(pod)
+            if leaf is not None:
+                self.quotas.charge(leaf, pod.spec.requests)
         return bound, unsched

@@ -1,0 +1,202 @@
+"""Coscheduling (gang) host-side manager.
+
+Rebuild of the reference Coscheduling plugin's control-plane half
+(``pkg/scheduler/plugins/coscheduling/``): the PodGroupManager tracks gangs
+(PodGroup CRD or ``pod-group.scheduling.sigs.k8s.io`` labels), gates pods at
+PreEnqueue until minMember members exist (``core/core.go:183-263``), keeps
+gang members adjacent in the pending queue so they land in the same solver
+batch (the NextPod semantics, ``core/core.go:135-176``), and enforces
+all-or-nothing at Permit (``core/core.go:346-465``).
+
+The data-plane half — rejecting under-filled gangs and rolling their
+capacity back — runs inside the solver (``ops.solver.enforce_gangs``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from ...api import extension as ext
+from ...api.types import Pod, PodGroup
+
+
+def gang_key_of(pod: Pod) -> Optional[str]:
+    gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
+    if not gang:
+        return None
+    return f"{pod.meta.namespace}/{gang}"
+
+
+@dataclasses.dataclass
+class _GangState:
+    #: None = minMember unknown (label-only gang without min-available):
+    #: all-or-nothing over whichever members are present in the batch.
+    min_member: Optional[int]
+    create_time: float
+    schedule_timeout_s: float
+    #: uids of pending members currently known (rebuilt every cycle)
+    pending: Dict[str, Pod] = dataclasses.field(default_factory=dict)
+    #: uids of members already bound
+    bound: int = 0
+
+    def effective_min(self, fallback: int) -> int:
+        return self.min_member if self.min_member is not None else fallback
+
+
+class PodGroupManager:
+    """Tracks gangs and decides scheduling eligibility."""
+
+    def __init__(self, default_timeout_s: float = 600.0):
+        self._gangs: Dict[str, _GangState] = {}
+        self.default_timeout_s = default_timeout_s
+
+    def upsert_pod_group(self, pg: PodGroup) -> None:
+        key = f"{pg.meta.namespace}/{pg.meta.name}"
+        state = self._gangs.get(key)
+        if state is None:
+            self._gangs[key] = _GangState(
+                min_member=pg.min_member,
+                create_time=time.time(),
+                schedule_timeout_s=pg.schedule_timeout_s,
+            )
+        else:
+            state.min_member = pg.min_member
+            state.schedule_timeout_s = pg.schedule_timeout_s
+
+    def _gang_for_pod(self, key: str, pod: Pod) -> _GangState:
+        state = self._gangs.get(key)
+        if state is None:
+            label_min = pod.meta.labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
+            min_member: Optional[int] = None
+            if label_min is not None:
+                try:
+                    min_member = int(label_min)
+                except ValueError:
+                    min_member = None
+            state = _GangState(
+                min_member=min_member,
+                create_time=time.time(),
+                schedule_timeout_s=self.default_timeout_s,
+            )
+            self._gangs[key] = state
+        return state
+
+    def begin_cycle(self, pending: Sequence[Pod]) -> None:
+        """Rebuild gang pending membership from the live pending set so
+        deleted/ghost members don't count forever, then register the
+        current pods."""
+        for state in self._gangs.values():
+            state.pending.clear()
+        for pod in pending:
+            self.add_pending_pod(pod)
+
+    def add_pending_pod(self, pod: Pod) -> None:
+        key = gang_key_of(pod)
+        if key is None:
+            return
+        self._gang_for_pod(key, pod).pending[pod.meta.uid] = pod
+
+    def remove_pod(self, pod: Pod, bound: bool) -> None:
+        key = gang_key_of(pod)
+        if key is None:
+            return
+        state = self._gangs.get(key)
+        if state is None:
+            return
+        state.pending.pop(pod.meta.uid, None)
+        if bound:
+            state.bound += 1
+
+    def pre_enqueue(self, pod: Pod, now: Optional[float] = None) -> Tuple[bool, str]:
+        """Gate: a gang pod may enter scheduling only once the gang has at
+        least minMember known members (pending + bound), reference
+        ``core/core.go:183-263``. A gang stuck past its schedule timeout is
+        gated for one cycle and its clock reset (the reference's Permit
+        timeout rejects the gang group and re-queues it with backoff)."""
+        key = gang_key_of(pod)
+        if key is None:
+            return True, ""
+        state = self._gang_for_pod(key, pod)
+        now = now if now is not None else time.time()
+        if (
+            state.bound < state.effective_min(len(state.pending))
+            and now - state.create_time > state.schedule_timeout_s
+        ):
+            state.create_time = now
+            return False, f"gang {key} timed out; backing off one cycle"
+        total = len(state.pending) + state.bound
+        need = state.effective_min(total)
+        if total < need:
+            return False, f"gang {key} has {total}/{need} members"
+        return True, ""
+
+    def min_member_map(self) -> Mapping[str, int]:
+        """Per-gang minMember still outstanding for the solver: already
+        bound members reduce the requirement, so stragglers joining a
+        satisfied gang schedule individually. Gangs with unknown minMember
+        are omitted (build_pods falls back to batch member count)."""
+        out: Dict[str, int] = {}
+        for k, s in self._gangs.items():
+            if s.min_member is not None:
+                out[k] = max(s.min_member - s.bound, 0)
+        return out
+
+    def order_pending(self, pods: Sequence[Pod]) -> List[Pod]:
+        """NextPod semantics: keep gang members adjacent, ordered by the
+        gang's highest member priority, so whole gangs land in one solver
+        batch (``core/core.go:135-176``)."""
+        def sort_key(pod_with_index):
+            i, pod = pod_with_index
+            key = gang_key_of(pod)
+            prio = pod.spec.priority or 0
+            if key is None:
+                return (-prio, 0, str(pod.meta.uid), i)
+            gang_prio = max(
+                (m.spec.priority or 0)
+                for m in self._gangs[key].pending.values()
+            ) if self._gangs.get(key) and self._gangs[key].pending else prio
+            return (-gang_prio, 1, key, i)
+
+        eligible = []
+        for i, pod in enumerate(pods):
+            ok, _ = self.pre_enqueue(pod)
+            if ok:
+                eligible.append((i, pod))
+        return [p for _, p in sorted(eligible, key=sort_key)]
+
+    def permit(
+        self, results: Iterable[Tuple[Pod, Optional[str]]]
+    ) -> Tuple[List[Tuple[Pod, str]], List[Pod]]:
+        """All-or-nothing Permit over one batch's commit results: gangs with
+        fewer than minMember surviving placements are rejected whole."""
+        results = list(results)
+        placed_per_gang: Dict[str, int] = {}
+        members_per_gang: Dict[str, int] = {}
+        for pod, node in results:
+            key = gang_key_of(pod)
+            if key is None:
+                continue
+            members_per_gang[key] = members_per_gang.get(key, 0) + 1
+            if node is not None:
+                placed_per_gang[key] = placed_per_gang.get(key, 0) + 1
+        allowed: List[Tuple[Pod, str]] = []
+        rejected: List[Pod] = []
+        for pod, node in results:
+            key = gang_key_of(pod)
+            if node is None:
+                rejected.append(pod)
+                continue
+            if key is not None:
+                state = self._gangs.get(key)
+                fallback = members_per_gang.get(key, 0)
+                need = state.effective_min(fallback) if state else fallback
+                have = placed_per_gang.get(key, 0) + (
+                    state.bound if state else 0
+                )
+                if have < need:
+                    rejected.append(pod)
+                    continue
+            allowed.append((pod, node))
+        return allowed, rejected

@@ -1,0 +1,264 @@
+"""ElasticQuota host-side manager: hierarchical quota tree + fair sharing.
+
+Rebuild of the reference's GroupQuotaManager
+(``pkg/scheduler/plugins/elasticquota/core/group_quota_manager.go:37-95``)
+and RuntimeQuotaCalculator (``core/runtime_quota_calculator.go``): quotas
+form trees via the ``quota.scheduling.koordinator.sh/parent`` label; each
+parent's runtime is distributed to children as
+
+    runtime = guaranteed(min ∧ request) + weighted fair share of the
+              remainder (sharedWeight), capped by max ∧ request
+
+via iterative water-filling (children hitting their cap release surplus to
+the rest — the reference's refreshRuntime loop). Admission (used + request
+≤ runtime along the chain) runs vectorized inside the solver
+(``ops.solver._quota_commit``); this class owns the tree, the runtime
+refresh, and durable used accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...api import extension as ext
+from ...api.types import ElasticQuota, Pod
+from ...core.snapshot import SnapshotConfig
+
+#: maximum quota tree depth lowered to the solver (leaf..root)
+MAX_LEVELS = 4
+ROOT = ""  # pseudo-parent of tree roots
+
+
+def quota_name_of(pod: Pod) -> Optional[str]:
+    return pod.meta.labels.get(ext.LABEL_QUOTA_NAME)
+
+
+def water_fill(
+    total: np.ndarray,
+    guaranteed: np.ndarray,
+    caps: np.ndarray,
+    weights: np.ndarray,
+) -> np.ndarray:
+    """Distribute ``total`` [D] among C children: each gets ``guaranteed``
+    [C, D] first, the remainder proportionally to ``weights`` [C, D] capped
+    by ``caps`` [C, D]. Iterative water-filling, per dim, ≤ C passes
+    (each pass saturates at least one child or exhausts the pool)."""
+    c, d = guaranteed.shape
+    runtime = np.minimum(guaranteed, caps).astype(np.float64)
+    remaining = np.maximum(total - runtime.sum(axis=0), 0.0).astype(np.float64)
+    for _ in range(c):
+        need = np.maximum(caps - runtime, 0.0)
+        active = need > 1e-9
+        w = np.where(active, np.maximum(weights, 0.0), 0.0)
+        wsum = w.sum(axis=0)
+        distributable = (remaining > 1e-9) & (wsum > 1e-9)
+        if not distributable.any():
+            break
+        give = np.where(
+            distributable[None, :], remaining[None, :] * w / np.maximum(wsum, 1e-9), 0.0
+        )
+        inc = np.minimum(give, need)
+        runtime += inc
+        remaining = remaining - inc.sum(axis=0)
+    return runtime.astype(np.float32)
+
+
+@dataclasses.dataclass
+class _QuotaNode:
+    quota: ElasticQuota
+    index: int
+    children: List[str] = dataclasses.field(default_factory=list)
+
+
+class GroupQuotaManager:
+    """Quota tree with fair-share runtime refresh and used accounting."""
+
+    def __init__(
+        self,
+        config: Optional[SnapshotConfig] = None,
+        cluster_total: Optional[Mapping[str, float]] = None,
+    ):
+        self.config = config or SnapshotConfig()
+        self._nodes: Dict[str, _QuotaNode] = {}
+        self._order: List[str] = []
+        self._cluster_total = self.config.res_vector(cluster_total or {})
+        d = self.config.dims
+        self.runtime = np.zeros((1, d), np.float32)
+        self.used = np.zeros((1, d), np.float32)
+        self.requests = np.zeros((1, d), np.float32)
+        self._dirty = True
+
+    # ---- tree maintenance ----
+
+    def upsert_quota(self, eq: ElasticQuota) -> None:
+        name = eq.meta.name
+        node = self._nodes.get(name)
+        if node is None:
+            node = _QuotaNode(quota=eq, index=len(self._order))
+            self._nodes[name] = node
+            self._order.append(name)
+        else:
+            old_parent = node.quota.parent
+            if old_parent != eq.parent and old_parent in self._nodes:
+                self._nodes[old_parent].children.remove(name)
+            node.quota = eq
+        parent = eq.parent or ROOT
+        if parent != ROOT:
+            pnode = self._nodes.get(parent)
+            if pnode is not None and name not in pnode.children:
+                pnode.children.append(name)
+        # adopt any pre-registered children pointing at us
+        for other, onode in self._nodes.items():
+            if (onode.quota.parent or ROOT) == name and other not in node.children:
+                node.children.append(other)
+        self._dirty = True
+
+    def remove_quota(self, name: str) -> None:
+        node = self._nodes.pop(name, None)
+        if node is None:
+            return
+        old_index = {n: self._nodes[n].index for n in self._nodes}
+        old_index[name] = node.index
+        self._order.remove(name)
+        q = max(len(self._order), 1)
+        d = self.config.dims
+        new_used = np.zeros((q, d), np.float32)
+        new_req = np.zeros((q, d), np.float32)
+        for new_i, nm in enumerate(self._order):
+            n = self._nodes[nm]
+            if name in n.children:
+                n.children.remove(name)
+            oi = old_index[nm]
+            if oi < self.used.shape[0]:
+                new_used[new_i] = self.used[oi]
+            if oi < self.requests.shape[0]:
+                new_req[new_i] = self.requests[oi]
+            n.index = new_i
+        self.used, self.requests = new_used, new_req
+        self._dirty = True
+
+    def set_cluster_total(self, total: Mapping[str, float]) -> None:
+        self._cluster_total = self.config.res_vector(total)
+        self._dirty = True
+
+    def index_of(self, name: str) -> Optional[int]:
+        node = self._nodes.get(name)
+        return node.index if node else None
+
+    def chain_of(self, name: Optional[str]) -> List[int]:
+        """Leaf-to-root index path for a pod's quota label (≤ MAX_LEVELS)."""
+        chain: List[int] = []
+        while name and name in self._nodes and len(chain) < MAX_LEVELS:
+            node = self._nodes[name]
+            chain.append(node.index)
+            name = node.quota.parent or None
+        return chain
+
+    @property
+    def quota_count(self) -> int:
+        return len(self._order)
+
+    # ---- accounting ----
+
+    def _ensure_capacity(self) -> None:
+        q = max(self.quota_count, 1)
+        d = self.config.dims
+        for attr in ("used", "requests", "runtime"):
+            arr = getattr(self, attr)
+            if arr.shape[0] < q:
+                grown = np.zeros((q, d), np.float32)
+                grown[: arr.shape[0]] = arr
+                setattr(self, attr, grown)
+
+    def charge(self, quota_name: str, requests: Mapping[str, float]) -> None:
+        self._ensure_capacity()
+        vec = self.config.res_vector(requests)
+        for idx in self.chain_of(quota_name):
+            self.used[idx] += vec
+
+    def refund(self, quota_name: str, requests: Mapping[str, float]) -> None:
+        self._ensure_capacity()
+        vec = self.config.res_vector(requests)
+        for idx in self.chain_of(quota_name):
+            self.used[idx] -= vec
+
+    def set_leaf_requests(self, by_leaf: Mapping[str, np.ndarray]) -> None:
+        """Aggregate desired request per quota (pending + admitted), rolled
+        up the tree — drives the fair-sharing split like the reference's
+        request propagation (``group_quota_manager.go`` updateGroupDeltaReq)."""
+        q = max(self.quota_count, 1)
+        d = self.config.dims
+        req = np.zeros((q, d), np.float32)
+        for leaf, vec in by_leaf.items():
+            for idx in self.chain_of(leaf):
+                req[idx] += vec
+        self.requests = req
+        self._dirty = True
+
+    # ---- runtime refresh (water-filling down the tree) ----
+
+    def refresh_runtime(self) -> np.ndarray:
+        q = max(self.quota_count, 1)
+        d = self.config.dims
+        runtime = np.zeros((q, d), np.float32)
+        self._ensure_capacity()
+
+        roots = [
+            n for n in self._order if (self._nodes[n].quota.parent or ROOT) == ROOT
+        ]
+        self._fill_level(roots, self._cluster_total, runtime)
+        self.runtime = runtime
+        self._dirty = False
+        return runtime
+
+    def _fill_level(
+        self, names: Sequence[str], total: np.ndarray, runtime: np.ndarray
+    ) -> None:
+        if not names:
+            return
+        idxs = [self._nodes[n].index for n in names]
+        mins = np.stack(
+            [self.config.res_vector(self._nodes[n].quota.min) for n in names]
+        )
+        maxs = np.stack(
+            [self.config.res_vector(self._nodes[n].quota.max) for n in names]
+        )
+        maxs = np.where(maxs <= 0, np.inf, maxs)  # absent max = unbounded
+        weights = np.stack(
+            [
+                self.config.res_vector(self._nodes[n].quota.shared_weight)
+                for n in names
+            ]
+        )
+        # absent sharedWeight defaults to max (reference getSharedWeight)
+        weights = np.where(weights <= 0, np.where(np.isinf(maxs), 1.0, maxs), weights)
+        requests = self.requests[idxs]
+        guaranteed = np.minimum(mins, requests)
+        caps = np.minimum(maxs, requests)
+        shares = water_fill(total, guaranteed, caps, weights)
+        for row, n in enumerate(names):
+            runtime[self._nodes[n].index] = shares[row]
+            kids = self._nodes[n].children
+            if kids:
+                self._fill_level(kids, shares[row], runtime)
+
+    # ---- solver lowering ----
+
+    def quota_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(runtime [Q, D], used [Q, D]) for ops.solver.QuotaState."""
+        if self._dirty:
+            self.refresh_runtime()
+        if self.quota_count == 0:
+            d = self.config.dims
+            return np.full((1, d), np.inf, np.float32), np.zeros((1, d), np.float32)
+        return self.runtime, self.used
+
+    def chains_for_pods(self, pods: Sequence[Pod], p_bucket: int) -> np.ndarray:
+        chains = np.full((p_bucket, MAX_LEVELS), -1, np.int32)
+        for i, pod in enumerate(pods):
+            for level, idx in enumerate(self.chain_of(quota_name_of(pod))):
+                chains[i, level] = idx
+        return chains

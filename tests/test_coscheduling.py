@@ -1,0 +1,231 @@
+"""Gang / coscheduling tests: solver rollback + PodGroupManager semantics
+(reference ``pkg/scheduler/plugins/coscheduling`` PreEnqueue/Permit)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodSpec,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.ops.solver import (
+    NodeState,
+    PodBatch,
+    SolverParams,
+    assign,
+    enforce_gangs,
+    SolveResult,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.coscheduling import PodGroupManager
+
+
+def gang_pod(name, gang, cpu=4.0, prio=9000, ns="default", min_avail=None):
+    labels = {ext.LABEL_GANG_NAME: gang}
+    if min_avail is not None:
+        labels[ext.LABEL_GANG_MIN_AVAILABLE] = str(min_avail)
+    return Pod(
+        meta=ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=PodSpec(requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}, priority=prio),
+    )
+
+
+def test_enforce_gangs_rollback():
+    # 4 pods: gang 0 = pods 0,1 (min 2, one unplaced), gang 1 = pods 2,3 ok
+    assignment = jnp.asarray([0, -1, 1, 1], jnp.int32)
+    req = jnp.full((4, 1), 2.0)
+    node_req = jnp.asarray([[2.0], [4.0]])
+    result = SolveResult(
+        assignment=assignment,
+        node_requested=node_req,
+        node_estimated_used=node_req,
+        quota_used=jnp.zeros((1, 1)),
+        rounds_used=jnp.array(1, jnp.int32),
+    )
+    pods = PodBatch.create(
+        requests=req,
+        estimate=req,
+        priority=jnp.zeros(4, jnp.int32),
+        is_prod=jnp.zeros(4, bool),
+        gang_id=[0, 0, 1, 1],
+        gang_min=[2, 2, 0, 0],
+    )
+    out = enforce_gangs(result, pods)
+    got = np.asarray(out.assignment)
+    assert got[0] == -1 and got[1] == -1          # gang 0 rolled back
+    assert got[2] == 1 and got[3] == 1            # gang 1 kept
+    np.testing.assert_allclose(np.asarray(out.node_requested), [[0.0], [4.0]])
+
+
+def test_solver_all_or_nothing_gang():
+    """A gang that cannot fully fit must not be partially placed."""
+    d = 1
+    alloc = jnp.asarray([[8.0]])
+    # gang of 3, each 4 cpu -> only 2 fit on the single node
+    req = jnp.full((3, d), 4.0)
+    pods = PodBatch.create(
+        requests=req,
+        estimate=req * 0.85,
+        priority=jnp.full(3, 9000, jnp.int32),
+        gang_id=jnp.zeros(3, jnp.int32),
+        gang_min=[3, 0, 0],
+    )
+    nodes = NodeState.create(allocatable=alloc)
+    params = SolverParams(
+        usage_thresholds=jnp.zeros(d),
+        prod_thresholds=jnp.zeros(d),
+        score_weights=jnp.ones(d),
+    )
+    out = assign(pods, nodes, params)
+    assert (np.asarray(out.assignment) == -1).all()
+    np.testing.assert_allclose(np.asarray(out.node_requested), [[0.0]])
+
+
+def test_pre_enqueue_gating():
+    mgr = PodGroupManager()
+    mgr.upsert_pod_group(
+        PodGroup(meta=ObjectMeta(name="g1"), min_member=3)
+    )
+    p1 = gang_pod("p1", "g1")
+    p2 = gang_pod("p2", "g1")
+    mgr.add_pending_pod(p1)
+    ok, reason = mgr.pre_enqueue(p1)
+    assert not ok and "1/3" in reason
+    mgr.add_pending_pod(p2)
+    p3 = gang_pod("p3", "g1")
+    mgr.add_pending_pod(p3)
+    ok, _ = mgr.pre_enqueue(p1)
+    assert ok
+
+
+def test_end_to_end_gang_scheduling():
+    """Whole gang fits -> bound together; oversized gang -> nothing bound."""
+    snap = ClusterSnapshot()
+    for i in range(4):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 16.0, ext.RES_MEMORY: 16.0}
+                ),
+            )
+        )
+    sched = BatchScheduler(snap)
+    # gang of 4 x 4cpu over 4 x 16cpu nodes: fits
+    gang_ok = [gang_pod(f"a{i}", "ok-gang", cpu=4.0, min_avail=4) for i in range(4)]
+    # gang of 3 x 16cpu pods: needs 3 whole nodes' remaining capacity; make
+    # it impossible by requesting more than any node can offer twice over
+    gang_big = [gang_pod(f"b{i}", "big-gang", cpu=40.0, min_avail=3) for i in range(3)]
+    out = sched.schedule(gang_ok + gang_big)
+    bound_names = {p.meta.name for p, _ in out.bound}
+    assert bound_names == {"a0", "a1", "a2", "a3"}
+    assert {p.meta.name for p in out.unschedulable} == {"b0", "b1", "b2"}
+
+
+def test_gang_gated_until_min_members_pending():
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(allocatable={ext.RES_CPU: 64.0, ext.RES_MEMORY: 64.0}),
+        )
+    )
+    sched = BatchScheduler(snap)
+    sched.pod_groups.upsert_pod_group(
+        PodGroup(meta=ObjectMeta(name="g"), min_member=2)
+    )
+    lone = gang_pod("solo", "g")
+    out = sched.schedule([lone])
+    assert out.bound == []
+    assert [p.meta.name for p in out.unschedulable] == ["solo"]
+    # second member arrives -> both go through
+    mate = gang_pod("mate", "g")
+    out2 = sched.schedule([lone, mate])
+    assert {p.meta.name for p, _ in out2.bound} == {"solo", "mate"}
+
+
+def _cluster(n_nodes=4, cpu=16.0):
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}
+                ),
+            )
+        )
+    return snap
+
+
+def test_straggler_after_gang_satisfied_schedules():
+    """A member arriving after the gang already met minMember schedules
+    alone (bound members reduce the outstanding requirement)."""
+    sched = BatchScheduler(_cluster())
+    sched.pod_groups.upsert_pod_group(
+        PodGroup(meta=ObjectMeta(name="g"), min_member=2)
+    )
+    first = [gang_pod("p1", "g"), gang_pod("p2", "g")]
+    out1 = sched.schedule(first)
+    assert len(out1.bound) == 2
+    straggler = gang_pod("p3", "g")
+    out2 = sched.schedule([straggler])
+    assert [p.meta.name for p, _ in out2.bound] == ["p3"]
+
+
+def test_gang_larger_than_batch_bucket_not_split():
+    """Chunking must keep a gang whole even when it exceeds batch_bucket."""
+    sched = BatchScheduler(_cluster(n_nodes=8, cpu=64.0), batch_bucket=2)
+    gang = [gang_pod(f"p{i}", "wide", cpu=2.0, min_avail=5) for i in range(5)]
+    out = sched.schedule(gang)
+    assert len(out.bound) == 5, [p.meta.name for p in out.unschedulable]
+
+
+def test_label_only_gang_all_or_nothing_by_member_count():
+    """Gang labels without min-available: all-or-nothing over the members
+    present (the build_pods member-count fallback)."""
+    sched = BatchScheduler(_cluster(n_nodes=1, cpu=8.0))
+    gang = [gang_pod(f"p{i}", "nolabel", cpu=4.0) for i in range(3)]  # 2 fit
+    out = sched.schedule(gang)
+    assert out.bound == []
+    assert len(out.unschedulable) == 3
+
+
+def test_ghost_members_pruned_between_cycles():
+    """Members that vanish from the pending set stop counting toward the
+    gang's PreEnqueue gate."""
+    sched = BatchScheduler(_cluster(n_nodes=1, cpu=2.0))  # nothing fits
+    sched.pod_groups.upsert_pod_group(
+        PodGroup(meta=ObjectMeta(name="g"), min_member=3)
+    )
+    trio = [gang_pod(f"p{i}", "g", cpu=4.0) for i in range(3)]
+    out1 = sched.schedule(trio)
+    assert len(out1.unschedulable) == 3
+    # two members deleted; the lone survivor must be gated, not solved
+    lone = trio[0]
+    out2 = sched.schedule([lone])
+    assert out2.bound == []
+    ok, reason = sched.pod_groups.pre_enqueue(lone)
+    assert not ok and "1/3" in reason
+
+
+def test_gang_timeout_backoff():
+    mgr = PodGroupManager(default_timeout_s=10.0)
+    pod = gang_pod("p", "g", min_avail=1)
+    mgr.add_pending_pod(pod)
+    ok, _ = mgr.pre_enqueue(pod, now=1000.0)
+    # create_time is wall-clock; simulate passage beyond timeout
+    state = mgr._gangs["default/g"]
+    state.create_time = 0.0
+    ok, reason = mgr.pre_enqueue(pod, now=1000.0)
+    assert not ok and "timed out" in reason
+    # clock reset -> next cycle eligible again
+    ok, _ = mgr.pre_enqueue(pod, now=1001.0)
+    assert ok

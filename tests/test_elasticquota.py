@@ -1,0 +1,252 @@
+"""ElasticQuota tests: fair-share water-filling, quota tree runtime,
+solver admission (reference ``pkg/scheduler/plugins/elasticquota``)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    ElasticQuota,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot, SnapshotConfig
+from koordinator_tpu.ops.solver import (
+    NodeState,
+    PodBatch,
+    QuotaState,
+    SolverParams,
+    assign,
+    assign_sequential,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.elasticquota import (
+    GroupQuotaManager,
+    water_fill,
+)
+
+
+def quota(name, minv=None, maxv=None, weight=None, parent=""):
+    def rl(v):
+        return {ext.RES_CPU: v[0], ext.RES_MEMORY: v[1]} if v else {}
+
+    return ElasticQuota(
+        meta=ObjectMeta(name=name),
+        min=rl(minv),
+        max=rl(maxv),
+        shared_weight=rl(weight),
+        parent=parent,
+    )
+
+
+def quota_pod(name, q, cpu=4.0, prio=9000):
+    return Pod(
+        meta=ObjectMeta(name=name, labels={ext.LABEL_QUOTA_NAME: q}),
+        spec=PodSpec(requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}, priority=prio),
+    )
+
+
+# ---- water filling ----
+
+
+def test_water_fill_min_guarantee_and_weight_share():
+    total = np.array([100.0], np.float32)
+    guaranteed = np.array([[20.0], [10.0], [0.0]], np.float32)
+    caps = np.array([[100.0], [100.0], [100.0]], np.float32)
+    weights = np.array([[1.0], [1.0], [2.0]], np.float32)
+    rt = water_fill(total, guaranteed, caps, weights)
+    # guarantees honored
+    assert (rt >= guaranteed - 1e-4).all()
+    # everything distributed
+    np.testing.assert_allclose(rt.sum(axis=0), total, rtol=1e-5)
+    # remainder 70 split 1:1:2 => +17.5, +17.5, +35
+    np.testing.assert_allclose(rt[:, 0], [37.5, 27.5, 35.0], rtol=1e-5)
+
+
+def test_water_fill_cap_redistribution():
+    total = np.array([90.0], np.float32)
+    guaranteed = np.zeros((3, 1), np.float32)
+    caps = np.array([[10.0], [100.0], [100.0]], np.float32)
+    weights = np.ones((3, 1), np.float32)
+    rt = water_fill(total, guaranteed, caps, weights)
+    # child 0 saturates at 10; surplus goes to the others equally
+    np.testing.assert_allclose(rt[:, 0], [10.0, 40.0, 40.0], rtol=1e-5)
+
+
+def test_water_fill_total_smaller_than_guarantees():
+    total = np.array([10.0], np.float32)
+    guaranteed = np.array([[20.0], [10.0]], np.float32)
+    caps = np.array([[50.0], [50.0]], np.float32)
+    rt = water_fill(total, guaranteed, caps, np.ones((2, 1), np.float32))
+    # guarantees kept (reference keeps min even when over-committed;
+    # min scaling is a separate mechanism)
+    np.testing.assert_allclose(rt[:, 0], [20.0, 10.0])
+
+
+# ---- GroupQuotaManager ----
+
+
+def make_tree():
+    cfg = SnapshotConfig()
+    mgr = GroupQuotaManager(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    mgr.upsert_quota(quota("root-a", minv=(40, 40), maxv=(100, 100), weight=(1, 1)))
+    mgr.upsert_quota(quota("root-b", minv=(20, 20), maxv=(60, 60), weight=(1, 1)))
+    mgr.upsert_quota(
+        quota("a-child-1", minv=(10, 10), maxv=(50, 50), weight=(1, 1), parent="root-a")
+    )
+    mgr.upsert_quota(
+        quota("a-child-2", minv=(0, 0), maxv=(50, 50), weight=(3, 3), parent="root-a")
+    )
+    return mgr
+
+
+def test_chain_resolution():
+    mgr = make_tree()
+    chain = mgr.chain_of("a-child-2")
+    assert chain == [mgr.index_of("a-child-2"), mgr.index_of("root-a")]
+    assert mgr.chain_of("missing") == []
+
+
+def test_runtime_respects_demand_and_hierarchy():
+    mgr = make_tree()
+    big = np.array([80.0, 80.0, 0, 0], np.float32)
+    mgr.set_leaf_requests(
+        {"a-child-1": big, "a-child-2": big, "root-b": np.array([80.0, 80.0, 0, 0], np.float32)}
+    )
+    rt = mgr.refresh_runtime()
+    ia, ib = mgr.index_of("root-a"), mgr.index_of("root-b")
+    i1, i2 = mgr.index_of("a-child-1"), mgr.index_of("a-child-2")
+    # children never exceed parent's runtime
+    assert rt[i1][0] + rt[i2][0] <= rt[ia][0] + 1e-3
+    # mins guaranteed
+    assert rt[ia][0] >= 40 - 1e-3 and rt[ib][0] >= 20 - 1e-3
+    # root-b capped by max
+    assert rt[ib][0] <= 60 + 1e-3
+    # total within cluster
+    assert rt[ia][0] + rt[ib][0] <= 100 + 1e-3
+    # weighted sharing: a-child-2 (w=3) gets more of the surplus than
+    # a-child-1 (w=1) beyond its guarantee
+    assert (rt[i2][0] - 0) > (rt[i1][0] - 10) - 1e-3
+
+
+def test_charge_refund_roundtrip():
+    mgr = make_tree()
+    mgr.refresh_runtime()
+    mgr.charge("a-child-1", {ext.RES_CPU: 5, ext.RES_MEMORY: 5})
+    i1, ia = mgr.index_of("a-child-1"), mgr.index_of("root-a")
+    assert mgr.used[i1][0] == 5 and mgr.used[ia][0] == 5
+    mgr.refund("a-child-1", {ext.RES_CPU: 5, ext.RES_MEMORY: 5})
+    assert mgr.used[i1][0] == 0 and mgr.used[ia][0] == 0
+
+
+# ---- solver admission ----
+
+
+def _quota_fixture(runtime, used, chains, reqs, prios=None):
+    p, d = reqs.shape
+    pods = PodBatch.create(
+        requests=reqs,
+        priority=np.full(p, 9000, np.int32) if prios is None else prios,
+        quota_chain=chains,
+    )
+    nodes = NodeState.create(allocatable=np.full((4, d), 1e6, np.float32))
+    params = SolverParams(
+        usage_thresholds=jnp.zeros(d),
+        prod_thresholds=jnp.zeros(d),
+        score_weights=jnp.ones(d),
+    )
+    quotas = QuotaState(
+        runtime=jnp.asarray(runtime, jnp.float32), used=jnp.asarray(used, jnp.float32)
+    )
+    return pods, nodes, params, quotas
+
+
+def test_solver_quota_admission_caps_usage():
+    """Quota 0 has runtime 10; four pods of 4 cpu each -> only 2 admitted."""
+    d = 1
+    reqs = np.full((4, d), 4.0, np.float32)
+    chains = np.full((4, 4), -1, np.int32)
+    chains[:, 0] = 0
+    runtime = np.array([[10.0]], np.float32)
+    used = np.zeros((1, d), np.float32)
+    for solver in (assign, assign_sequential):
+        pods, nodes, params, quotas = _quota_fixture(runtime, used, chains, reqs)
+        out = solver(pods, nodes, params, quotas)
+        a = np.asarray(out.assignment)
+        assert (a >= 0).sum() == 2, a
+        np.testing.assert_allclose(np.asarray(out.quota_used)[0], [8.0])
+
+
+def test_solver_quota_priority_order():
+    """Higher-priority pods win the contended quota."""
+    d = 1
+    reqs = np.full((3, d), 4.0, np.float32)
+    chains = np.full((3, 4), -1, np.int32)
+    chains[:, 0] = 0
+    prios = np.array([5000, 9500, 7000], np.int32)
+    runtime = np.array([[8.0]], np.float32)
+    used = np.zeros((1, d), np.float32)
+    for solver in (assign, assign_sequential):
+        pods, nodes, params, quotas = _quota_fixture(
+            runtime, used, chains, reqs, prios
+        )
+        a = np.asarray(solver(pods, nodes, params, quotas).assignment)
+        assert a[1] >= 0 and a[2] >= 0 and a[0] == -1
+
+
+def test_solver_quota_hierarchy_parent_cap():
+    """Two leaves under one parent: parent runtime caps their sum."""
+    d = 1
+    reqs = np.full((4, d), 4.0, np.float32)
+    chains = np.full((4, 4), -1, np.int32)
+    chains[0:2, 0] = 0   # leaf A -> parent 2
+    chains[2:4, 0] = 1   # leaf B -> parent 2
+    chains[:, 1] = 2
+    # leaves individually generous, parent tight (8 = two pods)
+    runtime = np.array([[16.0], [16.0], [8.0]], np.float32)
+    used = np.zeros((3, d), np.float32)
+    for solver in (assign, assign_sequential):
+        pods, nodes, params, quotas = _quota_fixture(runtime, used, chains, reqs)
+        out = solver(pods, nodes, params, quotas)
+        a = np.asarray(out.assignment)
+        assert (a >= 0).sum() == 2
+        qu = np.asarray(out.quota_used)
+        assert qu[2][0] <= 8.0 + 1e-3
+
+
+# ---- end to end ----
+
+
+def test_end_to_end_quota_scheduling():
+    snap = ClusterSnapshot()
+    for i in range(4):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 100.0, ext.RES_MEMORY: 100.0}
+                ),
+            )
+        )
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 400, ext.RES_MEMORY: 400}
+    )
+    mgr.upsert_quota(quota("tenant-a", minv=(8, 8), maxv=(12, 12), weight=(1, 1)))
+    mgr.upsert_quota(quota("tenant-b", minv=(8, 8), maxv=(400, 400), weight=(1, 1)))
+    sched = BatchScheduler(snap, quotas=mgr)
+    pods = [quota_pod(f"a{i}", "tenant-a", cpu=4.0) for i in range(5)] + [
+        quota_pod(f"b{i}", "tenant-b", cpu=4.0) for i in range(5)
+    ]
+    out = sched.schedule(pods)
+    bound = {p.meta.name for p, _ in out.bound}
+    a_bound = [n for n in bound if n.startswith("a")]
+    b_bound = [n for n in bound if n.startswith("b")]
+    # tenant-a capped at max 12 cpu -> 3 pods; tenant-b unconstrained -> all 5
+    assert len(a_bound) == 3, sorted(bound)
+    assert len(b_bound) == 5
+    # durable accounting
+    assert mgr.used[mgr.index_of("tenant-a")][0] == 12.0

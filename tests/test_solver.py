@@ -49,21 +49,16 @@ def make_fixture(
         prod_thresholds=jnp.asarray(prod_thresholds, jnp.float32),
         score_weights=jnp.ones(d, jnp.float32),
     )
-    pods = PodBatch(
-        requests=jnp.asarray(req),
-        estimate=jnp.asarray(est),
-        priority=jnp.asarray(prio),
-        is_prod=jnp.asarray(is_prod),
-        valid=jnp.ones(p, bool),
-        gang_id=jnp.full(p, -1, jnp.int32),
+    pods = PodBatch.create(
+        requests=req, estimate=est, priority=prio, is_prod=is_prod
     )
-    nodes = NodeState(
-        allocatable=jnp.asarray(alloc),
-        requested=jnp.asarray(requested),
-        estimated_used=jnp.asarray(est_used),
-        prod_used=jnp.asarray(prod_used),
-        metric_fresh=jnp.asarray(fresh),
-        schedulable=jnp.asarray(sched),
+    nodes = NodeState.create(
+        allocatable=alloc,
+        requested=requested,
+        estimated_used=est_used,
+        prod_used=prod_used,
+        metric_fresh=fresh,
+        schedulable=sched,
     )
     np_fix = dict(
         pod_req=req,
@@ -166,22 +161,8 @@ def test_priority_order_wins_capacity():
     alloc = np.array([[8.0, 8.0]], np.float32)
     req = np.array([[8.0, 8.0], [8.0, 8.0]], np.float32)
     prio = np.array([5000, 9500], np.int32)
-    pods = PodBatch(
-        requests=jnp.asarray(req),
-        estimate=jnp.asarray(req * 0.85),
-        priority=jnp.asarray(prio),
-        is_prod=jnp.asarray(prio >= 9000),
-        valid=jnp.ones(2, bool),
-        gang_id=jnp.full(2, -1, jnp.int32),
-    )
-    nodes = NodeState(
-        allocatable=jnp.asarray(alloc),
-        requested=jnp.zeros((1, d)),
-        estimated_used=jnp.zeros((1, d)),
-        prod_used=jnp.zeros((1, d)),
-        metric_fresh=jnp.ones(1, bool),
-        schedulable=jnp.ones(1, bool),
-    )
+    pods = PodBatch.create(requests=req, estimate=req * 0.85, priority=prio)
+    nodes = NodeState.create(allocatable=alloc)
     params = SolverParams(
         usage_thresholds=jnp.zeros(d),
         prod_thresholds=jnp.zeros(d),
