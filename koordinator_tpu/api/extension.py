@@ -104,7 +104,7 @@ RES_BATCH_CPU = "kubernetes.io/batch-cpu"
 RES_BATCH_MEMORY = "kubernetes.io/batch-memory"
 RES_MID_CPU = "kubernetes.io/mid-cpu"
 RES_MID_MEMORY = "kubernetes.io/mid-memory"
-RES_GPU = "nvidia.com/gpu"           # whole GPUs ×1000 (gpu-milli)
+RES_GPU = "nvidia.com/gpu"           # whole GPU count (integer)
 RES_GPU_CORE = f"{DOMAIN}/gpu-core"
 RES_GPU_MEMORY = f"{DOMAIN}/gpu-memory"
 RES_GPU_MEMORY_RATIO = f"{DOMAIN}/gpu-memory-ratio"
@@ -113,6 +113,24 @@ RES_RDMA = f"{DOMAIN}/rdma"
 #: Canonical dense resource axis for the solver. Extended resources used by a
 #: deployment append here; the solver is shape-polymorphic in D.
 DEFAULT_RESOURCES = (RES_CPU, RES_MEMORY, RES_BATCH_CPU, RES_BATCH_MEMORY)
+
+
+def parse_gpu_request(requests: Mapping[str, float]) -> tuple[int, float]:
+    """(whole_gpus, share_percent) from a pod's resource requests.
+
+    ``nvidia.com/gpu: k`` → k whole GPUs; ``koordinator.sh/gpu-memory-ratio``
+    (or gpu-core) of r → r<100: fraction of one GPU, r≥100: r//100 whole
+    plus the remainder (reference ``apis/extension/device_share.go``
+    validation rules).
+    """
+    whole = int(requests.get(RES_GPU, 0))
+    ratio = float(
+        requests.get(RES_GPU_MEMORY_RATIO, requests.get(RES_GPU_CORE, 0.0))
+    )
+    if ratio >= 100.0:
+        whole += int(ratio // 100.0)
+        ratio = ratio % 100.0
+    return whole, ratio
 
 
 def qos_for_priority(prio: PriorityClass) -> QoSClass:

@@ -114,6 +114,9 @@ class PodArrays:
     valid: np.ndarray
     #: row g: minMember of gang g (0 = unconstrained), indexed by gang_id
     gang_min: np.ndarray
+    #: whole GPUs / fractional GPU percent per pod (DeviceShare)
+    gpu_whole: np.ndarray
+    gpu_share: np.ndarray
     p_real: int
     #: gang id -> "namespace/name" key, parallel to gang_min rows
     gang_keys: List[str] = dataclasses.field(default_factory=list)
@@ -129,6 +132,8 @@ class PodArrays:
             quota_id=np.full((p_bucket,), -1, np.int32),
             valid=np.zeros((p_bucket,), bool),
             gang_min=np.zeros((p_bucket,), np.int32),
+            gpu_whole=np.zeros((p_bucket,), np.int32),
+            gpu_share=np.zeros((p_bucket,), np.float32),
             p_real=0,
         )
 
@@ -356,6 +361,9 @@ class ClusterSnapshot:
             out.priority[i] = pod.spec.priority or 0
             out.prio_class[i] = int(pod.priority_class)
             out.qos[i] = int(pod.qos)
+            out.gpu_whole[i], out.gpu_share[i] = ext.parse_gpu_request(
+                pod.spec.requests
+            )
             gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
             if gang:
                 key = f"{pod.meta.namespace}/{gang}"

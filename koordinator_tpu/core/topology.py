@@ -1,0 +1,240 @@
+"""CPU topology model + cpuset accumulator.
+
+Rebuild of the reference's CPU orchestration core
+(``pkg/scheduler/plugins/nodenumaresource/cpu_accumulator.go:87-245,345-800``
+and koordlet's NodeResourceTopology reporting): a node's CPUs form a
+socket → NUMA-node → physical-core → logical-CPU hierarchy; exclusive
+cpusets for LSR/LSE pods are taken greedily — whole sockets first, then
+whole cores, then single threads — honoring the FullPCPUs / SpreadByPCPUs
+bind policies.
+
+Zone-level *feasibility* is decided on TPU (``ops.numa``); the exact CPU id
+selection here is per-winner host work (SURVEY §7 step 6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+class CPUBindPolicy(enum.Enum):
+    """Pod-requested bind policy (reference ``apis/extension/numa_aware.go``
+    CPUBindPolicy*)."""
+
+    DEFAULT = "Default"
+    FULL_PCPUS = "FullPCPUs"           # whole physical cores only
+    SPREAD_BY_PCPUS = "SpreadByPCPUs"  # spread threads across cores
+    CONSTRAINED_BURST = "ConstrainedBurst"
+
+
+class NUMAPolicy(enum.IntEnum):
+    """Node topology manager policy (reference
+    ``frameworkext/topologymanager/policy_*.go``)."""
+
+    NONE = 0
+    BEST_EFFORT = 1
+    RESTRICTED = 2
+    SINGLE_NUMA_NODE = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUInfo:
+    cpu_id: int
+    core_id: int
+    numa_node: int
+    socket: int
+
+
+@dataclasses.dataclass
+class CPUTopology:
+    """Logical CPU inventory of one node."""
+
+    cpus: List[CPUInfo]
+
+    @classmethod
+    def uniform(
+        cls,
+        sockets: int = 2,
+        numa_per_socket: int = 1,
+        cores_per_numa: int = 8,
+        threads_per_core: int = 2,
+    ) -> "CPUTopology":
+        cpus: List[CPUInfo] = []
+        cpu_id = 0
+        core_id = 0
+        for s in range(sockets):
+            for n in range(numa_per_socket):
+                numa = s * numa_per_socket + n
+                for _ in range(cores_per_numa):
+                    for _t in range(threads_per_core):
+                        cpus.append(CPUInfo(cpu_id, core_id, numa, s))
+                        cpu_id += 1
+                    core_id += 1
+        return cls(cpus=cpus)
+
+    @property
+    def num_cpus(self) -> int:
+        return len(self.cpus)
+
+    @property
+    def num_numa_nodes(self) -> int:
+        return max((c.numa_node for c in self.cpus), default=-1) + 1
+
+    def cpus_in_numa(self, numa: int) -> List[CPUInfo]:
+        return [c for c in self.cpus if c.numa_node == numa]
+
+
+class CPUAccumulator:
+    """Greedy exclusive-cpuset allocator over one node's topology.
+
+    Mirrors ``takeCPUs`` (``cpu_accumulator.go``): satisfy a request of
+    ``n`` CPUs preferring (1) whole free sockets, (2) whole free cores,
+    (3) single free threads; FullPCPUs requires the result to consist of
+    whole physical cores; SpreadByPCPUs picks one thread per core across
+    cores before doubling up.
+    """
+
+    def __init__(self, topology: CPUTopology):
+        self.topology = topology
+        self._allocated: Set[int] = set()
+        #: pod uid -> cpu ids
+        self._owners: Dict[str, Set[int]] = {}
+
+    @property
+    def available(self) -> List[CPUInfo]:
+        return [c for c in self.topology.cpus if c.cpu_id not in self._allocated]
+
+    def free_count(self, numa: Optional[int] = None) -> int:
+        return sum(
+            1
+            for c in self.available
+            if numa is None or c.numa_node == numa
+        )
+
+    def take(
+        self,
+        owner: str,
+        n_cpus: int,
+        policy: CPUBindPolicy = CPUBindPolicy.DEFAULT,
+        numa: Optional[int] = None,
+    ) -> Optional[Set[int]]:
+        """Allocate ``n_cpus`` exclusive CPUs, optionally pinned to one NUMA
+        node. Returns the cpu-id set or None if unsatisfiable."""
+        avail = [
+            c for c in self.available if numa is None or c.numa_node == numa
+        ]
+        if len(avail) < n_cpus:
+            return None
+
+        by_core: Dict[int, List[CPUInfo]] = {}
+        for c in avail:
+            by_core.setdefault(c.core_id, []).append(c)
+        threads_per_core = max(
+            (sum(1 for x in self.topology.cpus if x.core_id == cid))
+            for cid in by_core
+        )
+        full_cores = {
+            cid: cs for cid, cs in by_core.items() if len(cs) == threads_per_core
+        }
+
+        taken: List[int] = []
+        if policy == CPUBindPolicy.FULL_PCPUS:
+            if n_cpus % threads_per_core != 0:
+                return None
+            need_cores = n_cpus // threads_per_core
+            if len(full_cores) < need_cores:
+                return None
+            for cid in sorted(full_cores)[:need_cores]:
+                taken.extend(c.cpu_id for c in full_cores[cid])
+        elif policy == CPUBindPolicy.SPREAD_BY_PCPUS:
+            # round-robin one thread per core, widest spread first
+            cores_sorted = sorted(
+                by_core.items(), key=lambda kv: (-len(kv[1]), kv[0])
+            )
+            ring = [sorted(cs, key=lambda c: c.cpu_id) for _, cs in cores_sorted]
+            depth = 0
+            while len(taken) < n_cpus:
+                progressed = False
+                for cs in ring:
+                    if depth < len(cs) and len(taken) < n_cpus:
+                        taken.append(cs[depth].cpu_id)
+                        progressed = True
+                if not progressed:
+                    return None
+                depth += 1
+        else:
+            # default: whole sockets, then whole cores, then loose threads
+            by_socket: Dict[int, List[CPUInfo]] = {}
+            for c in avail:
+                by_socket.setdefault(c.socket, []).append(c)
+            socket_size = max(
+                sum(1 for x in self.topology.cpus if x.socket == s)
+                for s in by_socket
+            )
+            for s in sorted(by_socket):
+                cs = by_socket[s]
+                if len(cs) == socket_size and n_cpus - len(taken) >= socket_size:
+                    taken.extend(c.cpu_id for c in cs)
+            remaining = n_cpus - len(taken)
+            if remaining > 0:
+                taken_set = set(taken)
+                rem_cores = {
+                    cid: [c for c in cs if c.cpu_id not in taken_set]
+                    for cid, cs in by_core.items()
+                }
+                for cid in sorted(rem_cores):
+                    cs = rem_cores[cid]
+                    if len(cs) == threads_per_core and remaining >= threads_per_core:
+                        taken.extend(c.cpu_id for c in cs)
+                        remaining -= threads_per_core
+                if remaining > 0:
+                    taken_set = set(taken)
+                    loose = [c.cpu_id for c in avail if c.cpu_id not in taken_set]
+                    taken.extend(loose[:remaining])
+                    remaining = 0
+        if len(taken) < n_cpus:
+            return None
+        result = set(taken[:n_cpus])
+        self._allocated |= result
+        self._owners.setdefault(owner, set()).update(result)
+        return result
+
+    def release(self, owner: str) -> None:
+        cpus = self._owners.pop(owner, set())
+        self._allocated -= cpus
+
+    def cpuset_of(self, owner: str) -> Optional[Set[int]]:
+        return self._owners.get(owner)
+
+
+def format_cpuset(cpus: Sequence[int]) -> str:
+    """Render a cpuset in kernel list format (e.g. "0-3,8,10-11")."""
+    ids = sorted(set(cpus))
+    if not ids:
+        return ""
+    parts: List[str] = []
+    start = prev = ids[0]
+    for c in ids[1:]:
+        if c == prev + 1:
+            prev = c
+            continue
+        parts.append(f"{start}-{prev}" if prev > start else str(start))
+        start = prev = c
+    parts.append(f"{start}-{prev}" if prev > start else str(start))
+    return ",".join(parts)
+
+
+def parse_cpuset(text: str) -> Set[int]:
+    out: Set[int] = set()
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            a, b = part.split("-", 1)
+            out.update(range(int(a), int(b) + 1))
+        else:
+            out.add(int(part))
+    return out

@@ -1,0 +1,89 @@
+"""DeviceShare data plane: GPU slot feasibility masks, vectorized.
+
+Rebuild of the reference DeviceShare plugin's accounting
+(``pkg/scheduler/plugins/deviceshare/device_cache.go`` per-node slot
+totals/allocations + ``allocator_gpu.go:1-451``): each node carries G GPU
+slots in percent units (100 = one whole free GPU, matching the
+``koordinator.sh/gpu-memory-ratio`` convention of
+``apis/extension/device_share.go``). A pod requests either K whole GPUs
+(``nvidia.com/gpu``) or a fraction of one (ratio < 100).
+
+The solver masks feasibility from the exact per-slot state lowered at
+batch start; intra-batch consumption uses conservative node aggregates
+(whole-slot count + total percent) — the host DeviceManager revalidates
+winners against exact slots, so approximation can only under-place within
+one batch, never overcommit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from .masks import EPS
+
+FULL = 100.0  # one whole GPU in ratio units
+
+
+@struct.dataclass
+class DeviceState:
+    """Per-node GPU slot state: slot_free [N, G] in percent units.
+
+    Nodes without GPUs have all-zero rows; a row of 100s is an idle GPU.
+    """
+
+    slot_free: jnp.ndarray
+
+    def aggregates(self):
+        """(full_count [N], partial_max [N], total [N])."""
+        full = jnp.sum(self.slot_free >= FULL - EPS, axis=1).astype(jnp.float32)
+        partial = jnp.max(
+            jnp.where(self.slot_free >= FULL - EPS, 0.0, self.slot_free), axis=1
+        )
+        total = jnp.sum(self.slot_free, axis=1)
+        return full, partial, total
+
+
+def device_fit_mask(
+    gpu_whole: jnp.ndarray,    # [P] int32 — whole GPUs requested
+    gpu_share: jnp.ndarray,    # [P] float32 — percent of one GPU (0 = none)
+    full_count: jnp.ndarray,   # [N]
+    partial_max: jnp.ndarray,  # [N]
+) -> jnp.ndarray:
+    """[P, N] GPU feasibility (reference Filter, ``plugin.go:311``).
+
+    Whole-GPU pods need that many fully-free slots; fractional pods need a
+    partial slot with enough headroom or one fully-free slot to open.
+    """
+    whole_ok = gpu_whole[:, None].astype(jnp.float32) <= full_count[None, :] + EPS
+    frac = gpu_share[:, None]
+    frac_ok = (
+        (frac <= partial_max[None, :] + EPS)
+        | (full_count[None, :] >= 1.0 - EPS)
+        | (frac <= EPS)
+    )
+    # pods requesting both whole + share (K GPUs and a remainder) need
+    # whole_ok for K and frac capacity beyond those K slots; approximate
+    # by requiring an extra full slot when both are present.
+    both = (gpu_whole[:, None] > 0) & (frac > EPS)
+    both_ok = (
+        gpu_whole[:, None].astype(jnp.float32) + 1.0 <= full_count[None, :] + EPS
+    ) | (frac <= partial_max[None, :] + EPS)
+    ok = whole_ok & jnp.where(both, both_ok, frac_ok)
+    return ok
+
+
+def device_consumption(
+    gpu_whole: jnp.ndarray, gpu_share: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-pod in-round consumption: (full_slots [P], total_percent [P]).
+
+    Fractional pods charge only the total-percent axis (optimistic about
+    slot fragmentation): the cumulative total check bounds overcommit per
+    node and the host DeviceManager revalidates winners against exact
+    slots, so optimism costs at most a host-side reject, while pessimism
+    would silently under-place whole batches.
+    """
+    full = gpu_whole.astype(jnp.float32)
+    total = gpu_whole.astype(jnp.float32) * FULL + gpu_share
+    return full, total

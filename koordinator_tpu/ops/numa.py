@@ -1,0 +1,130 @@
+"""NUMA topology feasibility/scoring masks + hint merge, vectorized.
+
+Rebuild of NodeNUMAResource's data plane
+(``pkg/scheduler/plugins/nodenumaresource/plugin.go:318-442`` Filter,
+``scoring.go:66-120`` Score) and the scheduler-level topology manager
+(``pkg/scheduler/frameworkext/topologymanager/policy_*.go``).
+
+Zone convention: the zone resource axis is the *prefix* of the snapshot's
+dense resource axis (dims 0..DN-1, i.e. cpu and memory), so pod zone
+requests are a slice of the existing request tensor — no extra pod arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .masks import EPS
+
+# NUMAPolicy enum values (keep in sync with core.topology.NUMAPolicy)
+POLICY_NONE = 0
+POLICY_BEST_EFFORT = 1
+POLICY_RESTRICTED = 2
+POLICY_SINGLE_NUMA_NODE = 3
+
+
+@struct.dataclass
+class NumaState:
+    """Device-side NUMA zone block.
+
+    zone_free — remaining allocatable per zone        [N, Z, DN]
+    zone_cap  — zone allocatable capacity             [N, Z, DN]
+    policy    — node topology manager policy          [N] int8
+    """
+
+    zone_free: jnp.ndarray
+    zone_cap: jnp.ndarray
+    policy: jnp.ndarray
+
+
+def numa_fit_mask(
+    pod_requests: jnp.ndarray,   # [P, D] full resource axis
+    pod_wants_numa: jnp.ndarray,  # [P] bool (LSR/LSE-style alignment need)
+    numa: NumaState,
+) -> jnp.ndarray:
+    """[P, N] feasibility under each node's topology policy.
+
+    single-numa-node: the pod's zone-scoped request must fit in ONE zone
+    (``policy_single_numa_node.go``); restricted/best-effort/none: the sum
+    across zones suffices (alignment is then a scoring preference). Pods
+    not requesting alignment are always NUMA-feasible, as are nodes
+    reporting no zones.
+    """
+    dn = numa.zone_free.shape[-1]
+    req = pod_requests[:, :dn]                                 # [P, DN]
+    # dims a node's zones don't report (zero capacity, e.g. memory left
+    # unregistered) are not checked — like a disabled threshold
+    dim_on = jnp.sum(numa.zone_cap, axis=1) > 0                 # [N, DN]
+    req_b = req[:, None, None, :]
+    zone_fit = jnp.all(
+        (req_b <= numa.zone_free[None, :, :, :] + EPS)
+        | ~dim_on[None, :, None, :],
+        axis=-1,
+    )                                                           # [P, N, Z]
+    any_zone = jnp.any(zone_fit, axis=-1)                       # [P, N]
+    total_free = jnp.sum(numa.zone_free, axis=1)                # [N, DN]
+    total_fit = jnp.all(
+        (req[:, None, :] <= total_free[None, :, :] + EPS) | ~dim_on[None, :, :],
+        axis=-1,
+    )                                                           # [P, N]
+    # topology presence comes from capacity, not remaining free space — an
+    # exhausted node must stay infeasible, not fall back to "no topology"
+    has_zones = jnp.any(jnp.sum(numa.zone_cap, axis=-1) > 0, axis=-1)  # [N]
+    strict = numa.policy == POLICY_SINGLE_NUMA_NODE
+    # strict nodes align every pod (kubelet would reject otherwise); on
+    # other nodes only alignment-requesting pods are zone-checked.
+    ok = jnp.where(
+        strict[None, :], any_zone, total_fit | ~pod_wants_numa[:, None]
+    )
+    return ok | ~has_zones[None, :]
+
+
+def numa_alignment_cost(
+    pod_requests: jnp.ndarray,
+    numa: NumaState,
+    most_allocated: bool = False,
+) -> jnp.ndarray:
+    """[P, N] score→cost over the best-fitting zone.
+
+    LeastAllocated (default): prefer the node whose best zone has the most
+    headroom after placement; MostAllocated (bin-packing): the least
+    (reference ``scoring.go`` + ``least_allocated.go``/``most_allocated.go``).
+    Nodes where no single zone fits score worst-but-finite so strict
+    feasibility stays the mask's job.
+    """
+    dn = numa.zone_free.shape[-1]
+    req = pod_requests[:, :dn]
+    after = numa.zone_free[None, :, :, :] - req[:, None, None, :]  # [P,N,Z,DN]
+    fits = jnp.all(after >= -EPS, axis=-1)                          # [P,N,Z]
+    total = jnp.maximum(jnp.max(numa.zone_free, axis=1), 1e-9)      # [N, DN]
+    frac_free = jnp.clip(after / total[None, :, None, :], 0.0, 1.0)
+    zone_score = jnp.mean(frac_free, axis=-1) * 100.0               # [P,N,Z]
+    if most_allocated:
+        zone_score = 100.0 - zone_score
+    zone_score = jnp.where(fits, zone_score, -1.0)
+    best = jnp.max(zone_score, axis=-1)                             # [P, N]
+    return -best
+
+
+def merge_hints(
+    provider_masks: jnp.ndarray,   # [H, M] bool — per provider, allowed zone bitmask ids
+    n_zones: int,
+) -> jnp.ndarray:
+    """Topology-manager hint merge over bitmask space (vectorized analog of
+    ``policy.go`` mergePermutations): M = 2^Z candidate zone sets; a
+    candidate is feasible iff every provider allows a superset of it; the
+    *narrowest* feasible candidate (fewest zones, then lowest id) wins.
+
+    Returns the winning bitmask id (int32), or -1 if none feasible.
+    """
+    m = 1 << n_zones
+    ids = jnp.arange(m, dtype=jnp.int32)
+    feasible = jnp.all(provider_masks, axis=0)                  # [M]
+    bits = jnp.sum(
+        (ids[:, None] >> jnp.arange(n_zones)[None, :]) & 1, axis=1
+    )
+    key = jnp.where(feasible & (ids > 0), bits * m + ids, jnp.iinfo(jnp.int32).max)
+    best = jnp.argmin(key).astype(jnp.int32)
+    return jnp.where(jnp.min(key) == jnp.iinfo(jnp.int32).max, -1, best)

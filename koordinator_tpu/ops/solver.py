@@ -99,6 +99,14 @@ class PodBatch:
     #: leaf-to-root quota index path per pod, [P, L] int32, -1 = none
     #: (ElasticQuota tree; level 0 is the leaf)
     quota_chain: jnp.ndarray
+    #: koord QoS class (extension.QoSClass), [P] int8 — drives NUMA
+    #: alignment need (LSR/LSE) and BE suppression semantics
+    qos: jnp.ndarray
+    #: whole GPUs requested (nvidia.com/gpu), [P] int32
+    gpu_whole: jnp.ndarray
+    #: fractional GPU requested in percent of one device
+    #: (koordinator.sh/gpu-memory-ratio < 100), [P] float32
+    gpu_share: jnp.ndarray
 
     @classmethod
     def create(
@@ -111,6 +119,9 @@ class PodBatch:
         gang_id=None,
         gang_min=None,
         quota_chain=None,
+        qos=None,
+        gpu_whole=None,
+        gpu_share=None,
         quota_levels: int = 4,
     ) -> "PodBatch":
         requests = jnp.asarray(requests, jnp.float32)
@@ -138,6 +149,21 @@ class PodBatch:
                 jnp.full((p, quota_levels), -1, jnp.int32)
                 if quota_chain is None
                 else jnp.asarray(quota_chain, jnp.int32)
+            ),
+            qos=(
+                jnp.zeros(p, jnp.int8)
+                if qos is None
+                else jnp.asarray(qos, jnp.int8)
+            ),
+            gpu_whole=(
+                jnp.zeros(p, jnp.int32)
+                if gpu_whole is None
+                else jnp.asarray(gpu_whole, jnp.int32)
+            ),
+            gpu_share=(
+                jnp.zeros(p, jnp.float32)
+                if gpu_share is None
+                else jnp.asarray(gpu_share, jnp.float32)
             ),
         )
 
@@ -298,6 +324,8 @@ def assign(
     nodes: NodeState,
     params: SolverParams,
     quotas: QuotaState | None = None,
+    numa: "NumaState | None" = None,
+    devices: "DeviceState | None" = None,
     max_rounds: int = 24,
     round_quantum: float = 0.15,
     topk: int = 8,
@@ -318,8 +346,44 @@ def assign(
     order = _priority_order(pods)
     spods = jax.tree.map(lambda a: a[order], pods)
 
+    # NUMA zone feasibility is round-invariant at solver granularity (zone
+    # consumption is a host-side PreBind concern) — compute once.
+    if numa is not None:
+        from .numa import numa_fit_mask
+
+        # Alignment need mirrors the host predicate (nodenumaresource
+        # wants_numa): LSR or LSE QoS with a positive whole-core request.
+        QOS_LSR, QOS_LSE = 3, 4  # extension.QoSClass values
+        cpu_req = spods.requests[:, 0]
+        wants = (
+            ((spods.qos == QOS_LSR) | (spods.qos == QOS_LSE))
+            & (cpu_req > 0)
+            & (jnp.mod(cpu_req, 1000.0) == 0)
+        )
+        numa_mask = numa_fit_mask(spods.requests, wants, numa)
+    if devices is not None:
+        from .device import device_consumption, device_fit_mask
+
+        dev_full0, dev_partial, dev_total0 = devices.aggregates()
+        sdev_full, sdev_total = device_consumption(
+            spods.gpu_whole, spods.gpu_share
+        )
+    else:
+        dev_full0 = dev_total0 = jnp.zeros((n,), jnp.float32)
+
     def round_body(carry):
-        assigned, requested, est_used, prod_used, qused, active, _progress, r = carry
+        (
+            assigned,
+            requested,
+            est_used,
+            prod_used,
+            qused,
+            dev_full,
+            dev_total,
+            active,
+            _progress,
+            r,
+        ) = carry
         work = NodeState(
             allocatable=nodes.allocatable,
             requested=requested,
@@ -336,6 +400,12 @@ def assign(
             feas = _feasible(spods, work, params, active & q_head)
         else:
             feas = _feasible(spods, work, params, active)
+        if numa is not None:
+            feas &= numa_mask
+        if devices is not None:
+            feas &= device_fit_mask(
+                spods.gpu_whole, spods.gpu_share, dev_full, dev_partial
+            )
         cost = cost_ops.load_aware_cost(
             spods.estimate, est_used, nodes.allocatable, params.score_weights
         )
@@ -383,6 +453,14 @@ def assign(
 
         accept = snode < n
         accept &= jnp.all(req0_g + seg_req <= alloc_g + EPS, axis=-1)
+        if devices is not None:
+            # conservative intra-round GPU accounting (see ops.device)
+            sfull_g = sdev_full[sortidx]
+            stotal_g = sdev_total[sortidx]
+            seg_full = _segment_prefix_sums(sfull_g[:, None], is_start)[:, 0]
+            seg_total = _segment_prefix_sums(stotal_g[:, None], is_start)[:, 0]
+            accept &= seg_full <= dev_full[gnode] + EPS
+            accept &= seg_total <= dev_total[gnode] + EPS
         # Intra-round cumulative usage-threshold check keeps the commit
         # faithful to sequential Filter semantics (load_aware.go:290-313).
         thr = params.usage_thresholds
@@ -423,19 +501,33 @@ def assign(
             seg_ids,
             num_segments=n,
         )
+        if devices is not None:
+            ddev = jax.ops.segment_sum(
+                jnp.where(
+                    final_node[:, None],
+                    jnp.stack([sdev_full[sortidx], sdev_total[sortidx]], 1),
+                    jnp.zeros((p, 2)),
+                ),
+                seg_ids,
+                num_segments=n,
+            )
+            dev_full = dev_full - ddev[:, 0]
+            dev_total = dev_total - ddev[:, 1]
         return (
             assigned,
             requested + dreq,
             est_used + dest,
             prod_used + dprod,
             qused_new,
+            dev_full,
+            dev_total,
             active & (assigned < 0),
             jnp.any(final_prio),
             r + 1,
         )
 
     def round_cond(carry):
-        _assigned, _req, _est, _prod, _qused, active, progress, r = carry
+        active, progress, r = carry[-3:]
         return (r < max_rounds) & progress & jnp.any(active)
 
     init = (
@@ -444,6 +536,8 @@ def assign(
         nodes.estimated_used,
         nodes.prod_used,
         quotas.used,
+        dev_full0,
+        dev_total0,
         pods.valid[order],
         jnp.array(True),
         jnp.array(0, jnp.int32),
@@ -454,6 +548,8 @@ def assign(
         est_f,
         _prod_f,
         qused_f,
+        _dev_full_f,
+        _dev_total_f,
         _active,
         _prog,
         rounds,

@@ -63,6 +63,9 @@ def _pod_spec() -> PodBatch:
         # not pod row: replicate so segment ops stay local.
         gang_min=P(),
         quota_chain=P("dp", None),
+        qos=P("dp"),
+        gpu_whole=P("dp"),
+        gpu_share=P("dp"),
     )
 
 

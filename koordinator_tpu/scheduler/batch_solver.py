@@ -88,6 +88,8 @@ class BatchScheduler:
         max_rounds: int = 16,
         pod_groups: Optional["PodGroupManager"] = None,
         quotas: Optional["GroupQuotaManager"] = None,
+        numa: Optional["NUMAManager"] = None,
+        devices: Optional["DeviceManager"] = None,
     ):
         from .plugins.coscheduling import PodGroupManager
         from .plugins.elasticquota import GroupQuotaManager
@@ -101,6 +103,8 @@ class BatchScheduler:
         self.max_rounds = max_rounds
         self.pod_groups = pod_groups or PodGroupManager()
         self.quotas = quotas or GroupQuotaManager(self.snapshot.config)
+        self.numa = numa
+        self.devices = devices
         self._params = self.args.solver_params(self.snapshot.config)
         self._scales = self.args.scale_vector(self.snapshot.config)
 
@@ -137,6 +141,9 @@ class BatchScheduler:
             gang_id=arrays.gang_id,
             gang_min=arrays.gang_min,
             quota_chain=chains,
+            qos=arrays.qos,
+            gpu_whole=arrays.gpu_whole,
+            gpu_share=arrays.gpu_share,
         )
 
     # ---- scheduling cycle ----
@@ -195,8 +202,31 @@ class BatchScheduler:
         pods = self.pod_batch(chunk)
         nodes = self.node_state()
         quotas = self.quota_state(chunk)
+        numa_state = None
+        if self.numa is not None and self.numa.has_topology:
+            from ..ops.numa import NumaState
+
+            zone_free, zone_cap, policy = self.numa.arrays()
+            numa_state = NumaState(
+                zone_free=jnp.asarray(zone_free),
+                zone_cap=jnp.asarray(zone_cap),
+                policy=jnp.asarray(policy),
+            )
+        device_state = None
+        if self.devices is not None and self.devices.has_devices:
+            from ..ops.device import DeviceState
+
+            device_state = DeviceState(
+                slot_free=jnp.asarray(self.devices.slot_array())
+            )
         return assign(
-            pods, nodes, self._params, quotas=quotas, max_rounds=self.max_rounds
+            pods,
+            nodes,
+            self._params,
+            quotas=quotas,
+            numa=numa_state,
+            devices=device_state,
+            max_rounds=self.max_rounds,
         )
 
     def quota_state(self, chunk: Sequence[Pod]) -> Optional[QuotaState]:
@@ -236,6 +266,7 @@ class BatchScheduler:
         way, ``framework_extender.go:546``)."""
         na = self.snapshot.nodes
         results: List[Tuple[Pod, Optional[str]]] = []
+        pending_patches: Dict[str, Dict[str, str]] = {}
         order = sorted(
             range(len(chunk)), key=lambda i: (-(chunk[i].spec.priority or 0), i)
         )
@@ -254,15 +285,42 @@ class BatchScheduler:
             ):
                 results.append((pod, None))
                 continue
+            node_name = self.snapshot.node_name(node_idx)
+            # Reserve: exact NUMA zone + cpuset + device minors for the
+            # winner (reference plugin.go:579-627); failure = failed
+            # Reserve. Annotation patches are held back until Permit so a
+            # rolled-back pod carries no stale placement claims.
+            patch: Dict[str, str] = {}
+            if self.numa is not None:
+                numa_patch = self.numa.allocate(pod, node_name)
+                if numa_patch is None:
+                    results.append((pod, None))
+                    continue
+                patch.update(numa_patch)
+            if self.devices is not None:
+                dev_patch = self.devices.allocate(pod, node_name)
+                if dev_patch is None:
+                    if self.numa is not None:
+                        self.numa.release(pod.meta.uid, node_name)
+                    results.append((pod, None))
+                    continue
+                patch.update(dev_patch)
+            pending_patches[pod.meta.uid] = patch
             est = req * self._scales
-            self.snapshot.assume_pod(pod, self.snapshot.node_name(node_idx), est)
-            results.append((pod, self.snapshot.node_name(node_idx)))
+            self.snapshot.assume_pod(pod, node_name, est)
+            results.append((pod, node_name))
         # Permit: all-or-nothing over gangs; roll back assumes of rejects.
         bound, unsched = self.pod_groups.permit(results)
         bound_uids = {p.meta.uid for p, _ in bound}
+        for pod, _node in bound:
+            pod.meta.annotations.update(pending_patches.get(pod.meta.uid, {}))
         for pod, node in results:
             if node is not None and pod.meta.uid not in bound_uids:
                 self.snapshot.forget_pod(pod.meta.uid)
+                if self.numa is not None:
+                    self.numa.release(pod.meta.uid, node)
+                if self.devices is not None:
+                    self.devices.release(pod.meta.uid, node)
         # Durable quota accounting for what actually bound.
         from .plugins.elasticquota import quota_name_of
 

@@ -147,17 +147,26 @@ class PodGroupManager:
         """NextPod semantics: keep gang members adjacent, ordered by the
         gang's highest member priority, so whole gangs land in one solver
         batch (``core/core.go:135-176``)."""
+        # First-arrival index per gang: gangs sort by their highest member
+        # priority then first arrival, members stay adjacent; non-gang pods
+        # keep plain (-priority, arrival) — the reference activeQ order.
+        first_arrival: Dict[str, int] = {}
+        for i, pod in enumerate(pods):
+            key = gang_key_of(pod)
+            if key is not None and key not in first_arrival:
+                first_arrival[key] = i
+
         def sort_key(pod_with_index):
             i, pod = pod_with_index
             key = gang_key_of(pod)
             prio = pod.spec.priority or 0
             if key is None:
-                return (-prio, 0, str(pod.meta.uid), i)
+                return (-prio, i, "", i)
             gang_prio = max(
                 (m.spec.priority or 0)
                 for m in self._gangs[key].pending.values()
             ) if self._gangs.get(key) and self._gangs[key].pending else prio
-            return (-gang_prio, 1, key, i)
+            return (-gang_prio, first_arrival[key], key, i)
 
         eligible = []
         for i, pod in enumerate(pods):

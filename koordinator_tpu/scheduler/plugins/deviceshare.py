@@ -1,0 +1,152 @@
+"""DeviceShare host-side manager: exact GPU slot (minor) assignment.
+
+Rebuild of the reference plugin's control plane
+(``pkg/scheduler/plugins/deviceshare/plugin.go:179-556``,
+``device_cache.go``, ``device_allocator.go``): ingests per-node Device
+inventories, lowers per-slot free state to the solver
+(``ops.device.DeviceState``), and for each winner picks concrete device
+minors — best-fit partial slot for fractional requests, fully-free slots
+for whole-GPU requests — writing the
+``scheduling.koordinator.sh/device-allocated`` annotation
+(``plugin.go:556-630``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ...api import extension as ext
+from ...api.types import Device, Pod
+from ...core.snapshot import ClusterSnapshot
+
+FULL = 100.0
+
+
+def parse_gpu_request(pod: Pod) -> Tuple[int, float]:
+    """(whole_gpus, share_percent) — see api.extension.parse_gpu_request."""
+    return ext.parse_gpu_request(pod.spec.requests)
+
+
+@dataclasses.dataclass
+class _NodeDevices:
+    #: free percent per GPU minor
+    gpu_free: List[float]
+    #: rdma device count free
+    rdma_free: int = 0
+    #: pod uid -> [(minor, percent)]
+    owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class DeviceManager:
+    """Per-node device inventories + exact allocation (nodeDeviceCache)."""
+
+    def __init__(self, snapshot: ClusterSnapshot, max_gpus: int = 8):
+        self.snapshot = snapshot
+        self.max_gpus = max_gpus
+        self._nodes: Dict[str, _NodeDevices] = {}
+
+    def upsert_device(self, device: Device) -> None:
+        """Ingest/refresh a node's inventory. Live allocations survive a
+        re-sync: the slot table is rebuilt from capacity and every owner's
+        picks are re-applied (the reference nodeDeviceCache reconciles
+        allocations from pod annotations the same way)."""
+        gpus = [d for d in device.devices if d.dev_type == "gpu"]
+        rdma = [d for d in device.devices if d.dev_type == "rdma"]
+        old = self._nodes.get(device.meta.name)
+        st = _NodeDevices(gpu_free=[FULL] * len(gpus), rdma_free=len(rdma))
+        if old is not None:
+            for uid, picks in old.owners.items():
+                kept = [(m, pct) for m, pct in picks if m < len(st.gpu_free)]
+                for minor, pct in kept:
+                    st.gpu_free[minor] = max(st.gpu_free[minor] - pct, 0.0)
+                if kept:
+                    st.owners[uid] = kept
+        self._nodes[device.meta.name] = st
+
+    def node(self, name: str) -> Optional[_NodeDevices]:
+        return self._nodes.get(name)
+
+    @property
+    def has_devices(self) -> bool:
+        return bool(self._nodes)
+
+    # ---- solver lowering ----
+
+    def slot_array(self) -> np.ndarray:
+        """slot_free [N, G] aligned to snapshot rows (ops.device.DeviceState).
+        G grows with the largest node inventory — no silent truncation."""
+        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        g = max(
+            (len(st.gpu_free) for st in self._nodes.values()),
+            default=self.max_gpus,
+        )
+        g = max(g, 1)
+        slots = np.zeros((n_bucket, g), np.float32)
+        for name, st in self._nodes.items():
+            idx = self.snapshot.node_id(name)
+            if idx is None:
+                continue
+            for minor, free in enumerate(st.gpu_free):
+                slots[idx, minor] = free
+        return slots
+
+    # ---- exact assignment (Reserve/PreBind) ----
+
+    def allocate(self, pod: Pod, node_name: str) -> Optional[Mapping[str, str]]:
+        """Pick concrete minors for the winner; None = failed Reserve."""
+        whole, share = parse_gpu_request(pod)
+        if whole == 0 and share <= 0:
+            return {}
+        st = self._nodes.get(node_name)
+        if st is None:
+            return None
+        picks: List[Tuple[int, float]] = []
+        free = list(st.gpu_free)
+        full_minors = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
+        if len(full_minors) < whole:
+            return None
+        for minor in full_minors[:whole]:
+            picks.append((minor, FULL))
+            free[minor] = 0.0
+        if share > 0:
+            # best-fit: smallest partial slot that still fits, else a
+            # fresh full slot (reference allocator_gpu.go scoring)
+            candidates = [
+                (f, i)
+                for i, f in enumerate(free)
+                if f >= share - 1e-6 and f < FULL - 1e-6
+            ]
+            if candidates:
+                _, minor = min(candidates)
+            else:
+                fresh = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
+                if not fresh:
+                    return None
+                minor = fresh[0]
+            picks.append((minor, share))
+            free[minor] -= share
+        st.gpu_free = free
+        st.owners[pod.meta.uid] = picks
+        payload = {
+            "gpu": [
+                {
+                    "minor": minor,
+                    "resources": {ext.RES_GPU_MEMORY_RATIO: pct},
+                }
+                for minor, pct in picks
+            ]
+        }
+        return {ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(payload)}
+
+    def release(self, pod_uid: str, node_name: str) -> None:
+        st = self._nodes.get(node_name)
+        if st is None:
+            return
+        for minor, pct in st.owners.pop(pod_uid, []):
+            st.gpu_free[minor] = min(st.gpu_free[minor] + pct, FULL)

@@ -1,0 +1,185 @@
+"""NodeNUMAResource host-side manager: zone accounting + exact cpusets.
+
+Rebuild of the reference plugin's control plane
+(``pkg/scheduler/plugins/nodenumaresource/plugin.go:60-74,251-313,579-627``
+and ``resource_manager.go:194-225``): parses the pod's
+``scheduling.koordinator.sh/resource-spec`` annotation (CPU bind policy),
+keeps per-node zone allocations + a CPU accumulator, and at PreBind writes
+``scheduling.koordinator.sh/resource-status`` with the exclusive cpuset and
+chosen NUMA zone. Zone *feasibility* for all (pod, node) pairs is computed
+on TPU (``ops.numa``); this class owns the per-winner exact assignment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ...api import extension as ext
+from ...api.types import Pod
+from ...core.snapshot import ClusterSnapshot
+from ...core.topology import (
+    CPUAccumulator,
+    CPUBindPolicy,
+    CPUTopology,
+    NUMAPolicy,
+    format_cpuset,
+)
+
+#: zone resource dims lowered to the solver (prefix of the snapshot axis)
+ZONE_DIMS = 2  # cpu milli, memory MiB
+
+
+def parse_resource_spec(pod: Pod) -> CPUBindPolicy:
+    raw = pod.meta.annotations.get(ext.ANNOTATION_RESOURCE_SPEC)
+    if not raw:
+        return CPUBindPolicy.DEFAULT
+    try:
+        spec = json.loads(raw)
+        return CPUBindPolicy(spec.get("preferredCPUBindPolicy", "Default"))
+    except (ValueError, KeyError, AttributeError, TypeError):
+        # user-supplied annotation: any malformed shape degrades to Default
+        return CPUBindPolicy.DEFAULT
+
+
+def wants_numa(pod: Pod) -> bool:
+    """LSR/LSE pods with integer CPU requests need exclusive, aligned CPUs
+    (reference ``plugin.go:251-313`` requiredCPUBindPolicy resolution)."""
+    from ...api.extension import QoSClass
+
+    if pod.qos not in (QoSClass.LSR, QoSClass.LSE):
+        return False
+    cpu = pod.spec.requests.get(ext.RES_CPU, 0.0)
+    return cpu > 0 and cpu % 1000 == 0
+
+
+@dataclasses.dataclass
+class _NodeNUMA:
+    topology: CPUTopology
+    policy: NUMAPolicy
+    #: [Z, ZONE_DIMS] allocatable per zone
+    zone_alloc: np.ndarray
+    #: [Z, ZONE_DIMS] allocated per zone
+    zone_used: np.ndarray
+    accumulator: CPUAccumulator
+    #: pod uid -> (zone, request vec)
+    owners: Dict[str, Tuple[int, np.ndarray]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class NUMAManager:
+    """Per-node NUMA state; lowers zone arrays aligned to snapshot indices."""
+
+    def __init__(self, snapshot: ClusterSnapshot, max_zones: int = 4):
+        self.snapshot = snapshot
+        self.max_zones = max_zones
+        self._nodes: Dict[str, _NodeNUMA] = {}
+
+    def register_node(
+        self,
+        node_name: str,
+        topology: CPUTopology,
+        policy: NUMAPolicy = NUMAPolicy.NONE,
+        memory_per_zone_mib: float = 0.0,
+    ) -> None:
+        z = topology.num_numa_nodes
+        zone_alloc = np.zeros((self.max_zones, ZONE_DIMS), np.float32)
+        for zone in range(min(z, self.max_zones)):
+            n_cpus = len(topology.cpus_in_numa(zone))
+            zone_alloc[zone, 0] = n_cpus * 1000.0
+            zone_alloc[zone, 1] = memory_per_zone_mib
+        self._nodes[node_name] = _NodeNUMA(
+            topology=topology,
+            policy=policy,
+            zone_alloc=zone_alloc,
+            zone_used=np.zeros_like(zone_alloc),
+            accumulator=CPUAccumulator(topology),
+        )
+
+    def node(self, name: str) -> Optional[_NodeNUMA]:
+        return self._nodes.get(name)
+
+    # ---- solver lowering ----
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(zone_free [N, Z, DN], zone_cap [N, Z, DN], policy [N]) aligned
+        to snapshot rows. Unregistered nodes report zero capacity (always
+        NUMA-feasible)."""
+        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        zone_free = np.zeros((n_bucket, self.max_zones, ZONE_DIMS), np.float32)
+        zone_cap = np.zeros((n_bucket, self.max_zones, ZONE_DIMS), np.float32)
+        policy = np.zeros((n_bucket,), np.int8)
+        for name, st in self._nodes.items():
+            idx = self.snapshot.node_id(name)
+            if idx is None:
+                continue
+            zone_free[idx] = st.zone_alloc - st.zone_used
+            zone_cap[idx] = st.zone_alloc
+            policy[idx] = int(st.policy)
+        return zone_free, zone_cap, policy
+
+    @property
+    def has_topology(self) -> bool:
+        return bool(self._nodes)
+
+    # ---- per-winner exact assignment (PreBind) ----
+
+    def allocate(self, pod: Pod, node_name: str) -> Optional[Mapping[str, str]]:
+        """Commit a pod onto a node: choose a zone, take an exclusive cpuset
+        if required, and return the resource-status annotation patch
+        (``plugin.go:579-627``). Returns None when NUMA placement fails —
+        the caller treats it like a failed Reserve."""
+        st = self._nodes.get(node_name)
+        if st is None:
+            return {}
+        req = np.zeros((ZONE_DIMS,), np.float32)
+        req[0] = float(pod.spec.requests.get(ext.RES_CPU, 0.0))
+        req[1] = float(pod.spec.requests.get(ext.RES_MEMORY, 0.0))
+
+        need_alignment = wants_numa(pod)
+        zone = -1
+        if st.policy == NUMAPolicy.SINGLE_NUMA_NODE or need_alignment:
+            free = st.zone_alloc - st.zone_used
+            fits = np.all(free >= req[None, :] - 1e-3, axis=1)
+            if not fits.any():
+                if st.policy == NUMAPolicy.SINGLE_NUMA_NODE:
+                    return None
+            else:
+                # least-allocated fitting zone
+                util = (st.zone_used[:, 0] + 1.0) / (st.zone_alloc[:, 0] + 1.0)
+                util[~fits] = np.inf
+                zone = int(np.argmin(util))
+
+        status: Dict[str, object] = {}
+        if need_alignment:
+            n_cpus = int(req[0] // 1000)
+            cpuset = st.accumulator.take(
+                pod.meta.uid,
+                n_cpus,
+                policy=parse_resource_spec(pod),
+                numa=zone if zone >= 0 else None,
+            )
+            if cpuset is None:
+                return None
+            status["cpuset"] = format_cpuset(sorted(cpuset))
+        if zone >= 0:
+            st.zone_used[zone] += req
+            st.owners[pod.meta.uid] = (zone, req)
+            status["numaNodeResources"] = [{"node": zone}]
+        if not status:
+            return {}
+        return {ext.ANNOTATION_RESOURCE_STATUS: json.dumps(status)}
+
+    def release(self, pod_uid: str, node_name: str) -> None:
+        st = self._nodes.get(node_name)
+        if st is None:
+            return
+        st.accumulator.release(pod_uid)
+        entry = st.owners.pop(pod_uid, None)
+        if entry is not None:
+            zone, req = entry
+            st.zone_used[zone] -= req

@@ -1,0 +1,182 @@
+"""DeviceShare tests: gpu request parsing, slot masks, exact allocation,
+gang+device e2e (the BASELINE config #4 shape: 8-GPU nodes, multi-GPU
+all-or-nothing pods)."""
+
+import json
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Device,
+    DeviceInfo,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.ops.device import DeviceState, device_fit_mask
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.deviceshare import (
+    DeviceManager,
+    parse_gpu_request,
+)
+
+
+def gpu_pod(name, whole=0, ratio=0.0, cpu=1000, gang=None, min_avail=None):
+    requests = {ext.RES_CPU: cpu, ext.RES_MEMORY: 1024}
+    if whole:
+        requests[ext.RES_GPU] = whole
+    if ratio:
+        requests[ext.RES_GPU_MEMORY_RATIO] = ratio
+    labels = {}
+    if gang:
+        labels[ext.LABEL_GANG_NAME] = gang
+        labels[ext.LABEL_GANG_MIN_AVAILABLE] = str(min_avail)
+    return Pod(
+        meta=ObjectMeta(name=name, labels=labels),
+        spec=PodSpec(requests=requests, priority=9000),
+    )
+
+
+def test_parse_gpu_request():
+    assert parse_gpu_request(gpu_pod("a", whole=2)) == (2, 0.0)
+    assert parse_gpu_request(gpu_pod("b", ratio=50)) == (0, 50.0)
+    assert parse_gpu_request(gpu_pod("c", ratio=250)) == (2, 50.0)
+    assert parse_gpu_request(gpu_pod("d")) == (0, 0.0)
+
+
+def test_device_fit_mask():
+    # node 0: 2 full gpus; node 1: one 40% partial; node 2: none
+    state = DeviceState(
+        slot_free=jnp.asarray(
+            [[100.0, 100.0], [40.0, 0.0], [0.0, 0.0]], jnp.float32
+        )
+    )
+    full, partial, total = state.aggregates()
+    whole = jnp.asarray([1, 2, 0, 0], jnp.int32)
+    share = jnp.asarray([0.0, 0.0, 30.0, 60.0], jnp.float32)
+    mask = np.asarray(device_fit_mask(whole, share, full, partial))
+    assert mask[0].tolist() == [True, False, False]   # 1 whole
+    assert mask[1].tolist() == [True, False, False]   # 2 whole
+    assert mask[2].tolist() == [True, True, False]    # 30% fits partial
+    assert mask[3].tolist() == [True, False, False]   # 60% needs fresh
+
+
+def make_cluster(n_nodes=2, gpus=8):
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    for i in range(n_nodes):
+        name = f"n{i}"
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+                ),
+            )
+        )
+        dm.upsert_device(
+            Device(
+                meta=ObjectMeta(name=name),
+                devices=[
+                    DeviceInfo(dev_type="gpu", minor=g) for g in range(gpus)
+                ],
+            )
+        )
+    return snap, dm
+
+
+def test_exact_allocation_and_release():
+    snap, dm = make_cluster(n_nodes=1, gpus=2)
+    p1 = gpu_pod("p1", ratio=30)
+    patch = dm.allocate(p1, "n0")
+    alloc = json.loads(patch[ext.ANNOTATION_DEVICE_ALLOCATED])
+    assert alloc["gpu"][0]["resources"][ext.RES_GPU_MEMORY_RATIO] == 30
+    # second fractional goes best-fit onto the same partial slot
+    p2 = gpu_pod("p2", ratio=50)
+    alloc2 = json.loads(
+        dm.allocate(p2, "n0")[ext.ANNOTATION_DEVICE_ALLOCATED]
+    )
+    assert alloc2["gpu"][0]["minor"] == alloc["gpu"][0]["minor"]
+    # whole-gpu request takes the remaining full slot
+    p3 = gpu_pod("p3", whole=1)
+    assert dm.allocate(p3, "n0") is not None
+    # nothing left for another whole gpu
+    assert dm.allocate(gpu_pod("p4", whole=1), "n0") is None
+    dm.release(p3.meta.uid, "n0")
+    assert dm.allocate(gpu_pod("p5", whole=1), "n0") is not None
+
+
+def test_end_to_end_gpu_scheduling():
+    snap, dm = make_cluster(n_nodes=2, gpus=8)
+    sched = BatchScheduler(snap, devices=dm)
+    pods = [gpu_pod(f"w{i}", whole=4) for i in range(4)]  # 16 gpus over 2 nodes
+    out = sched.schedule(pods)
+    assert len(out.bound) == 4
+    # every gpu allocated exactly once
+    assert all(len(st.owners) == 2 for st in dm._nodes.values())
+    # a 5th whole-gpu pod finds nothing
+    out2 = sched.schedule([gpu_pod("extra", whole=1)])
+    assert out2.bound == []
+
+
+def test_end_to_end_gang_multi_gpu_all_or_nothing():
+    """BASELINE config #4: multi-GPU gang across 8-GPU nodes."""
+    snap, dm = make_cluster(n_nodes=2, gpus=8)
+    sched = BatchScheduler(snap, devices=dm)
+    # gang of 3 pods x 8 gpus needs 3 full nodes but only 2 exist
+    gang = [
+        gpu_pod(f"g{i}", whole=8, gang="train", min_avail=3) for i in range(3)
+    ]
+    out = sched.schedule(gang)
+    assert out.bound == []
+    # no leaked device allocations after rollback
+    assert all(not st.owners for st in dm._nodes.values())
+    # a 2-pod gang fits and lands on distinct nodes
+    gang2 = [
+        gpu_pod(f"h{i}", whole=8, gang="train2", min_avail=2) for i in range(2)
+    ]
+    out2 = sched.schedule(gang2)
+    assert len(out2.bound) == 2
+    assert {node for _, node in out2.bound} == {"n0", "n1"}
+
+
+def test_fractional_gpu_packing_e2e():
+    snap, dm = make_cluster(n_nodes=1, gpus=1)
+    sched = BatchScheduler(snap, devices=dm)
+    pods = [gpu_pod(f"f{i}", ratio=40) for i in range(3)]  # 120% > 1 gpu
+    out = sched.schedule(pods)
+    assert len(out.bound) == 2
+    assert len(out.unschedulable) == 1
+
+
+def test_device_resync_preserves_allocations():
+    """Re-upserting a node's Device inventory must not wipe live
+    allocations (watch re-sync)."""
+    snap, dm = make_cluster(n_nodes=1, gpus=2)
+    p1 = gpu_pod("p1", whole=1)
+    assert dm.allocate(p1, "n0") is not None
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="n0"),
+            devices=[DeviceInfo(dev_type="gpu", minor=g) for g in range(2)],
+        )
+    )
+    st = dm.node("n0")
+    assert p1.meta.uid in st.owners
+    assert sorted(st.gpu_free) == [0.0, 100.0]
+    # releasing after re-sync returns the capacity
+    dm.release(p1.meta.uid, "n0")
+    assert st.gpu_free == [100.0, 100.0]
+
+
+def test_slot_array_grows_beyond_default():
+    snap, dm = make_cluster(n_nodes=1, gpus=16)
+    slots = dm.slot_array()
+    assert slots.shape[1] == 16
+    assert (slots[snap.node_id("n0")] == 100.0).all()

@@ -1,0 +1,280 @@
+"""NodeNUMAResource tests: cpu accumulator, zone masks, hint merge, e2e
+(reference ``pkg/scheduler/plugins/nodenumaresource`` +
+``frameworkext/topologymanager``)."""
+
+import json
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.extension import QoSClass
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.core.topology import (
+    CPUAccumulator,
+    CPUBindPolicy,
+    CPUTopology,
+    NUMAPolicy,
+    format_cpuset,
+    parse_cpuset,
+)
+from koordinator_tpu.ops.numa import (
+    NumaState,
+    merge_hints,
+    numa_alignment_cost,
+    numa_fit_mask,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.nodenumaresource import NUMAManager
+
+
+# ---- cpuset formatting ----
+
+
+def test_cpuset_roundtrip():
+    assert format_cpuset([0, 1, 2, 3, 8, 10, 11]) == "0-3,8,10-11"
+    assert parse_cpuset("0-3,8,10-11") == {0, 1, 2, 3, 8, 10, 11}
+    assert format_cpuset([]) == ""
+    assert parse_cpuset("") == set()
+
+
+# ---- accumulator (reference cpu_accumulator.go takeCPUs) ----
+
+
+def topo():
+    # 2 sockets x 1 numa x 4 cores x 2 threads = 16 cpus
+    return CPUTopology.uniform(
+        sockets=2, numa_per_socket=1, cores_per_numa=4, threads_per_core=2
+    )
+
+
+def test_take_full_socket_first():
+    acc = CPUAccumulator(topo())
+    got = acc.take("p1", 8)
+    # one whole socket (cpus 0-7)
+    assert got == set(range(8))
+
+
+def test_take_full_cores_when_less_than_socket():
+    acc = CPUAccumulator(topo())
+    got = acc.take("p1", 4)
+    # two whole physical cores
+    cores = {c // 2 for c in got}
+    assert len(got) == 4 and len(cores) == 2
+
+
+def test_full_pcpus_policy_rejects_odd():
+    acc = CPUAccumulator(topo())
+    assert acc.take("p1", 3, policy=CPUBindPolicy.FULL_PCPUS) is None
+    got = acc.take("p1", 4, policy=CPUBindPolicy.FULL_PCPUS)
+    assert len(got) == 4 and len({c // 2 for c in got}) == 2
+
+
+def test_spread_by_pcpus_one_thread_per_core():
+    acc = CPUAccumulator(topo())
+    got = acc.take("p1", 4, policy=CPUBindPolicy.SPREAD_BY_PCPUS)
+    # 4 cpus over 4 distinct cores
+    assert len({c // 2 for c in got}) == 4
+
+
+def test_numa_pinning_and_exhaustion():
+    acc = CPUAccumulator(topo())
+    got = acc.take("p1", 8, numa=0)
+    assert {c for c in got} == set(range(8))
+    assert acc.take("p2", 1, numa=0) is None
+    assert acc.take("p2", 8, numa=1) == set(range(8, 16))
+
+
+def test_release_returns_capacity():
+    acc = CPUAccumulator(topo())
+    acc.take("p1", 16)
+    assert acc.take("p2", 1) is None
+    acc.release("p1")
+    assert len(acc.take("p2", 16)) == 16
+
+
+# ---- zone masks ----
+
+
+def numa_state(policy):
+    # 2 nodes x 2 zones; node 0 zones: 4000/2000 cpu free
+    zone_free = np.array(
+        [
+            [[4000.0, 8192.0], [2000.0, 8192.0]],
+            [[8000.0, 8192.0], [8000.0, 8192.0]],
+        ],
+        np.float32,
+    )
+    return NumaState(
+        zone_free=jnp.asarray(zone_free),
+        zone_cap=jnp.asarray(zone_free),  # fresh zones: cap == free
+        policy=jnp.asarray(np.array([policy, policy], np.int8)),
+    )
+
+
+def test_single_numa_mask():
+    ns = numa_state(3)  # SINGLE_NUMA_NODE
+    req = np.zeros((2, 4), np.float32)
+    req[0, :2] = [3000.0, 1024.0]   # fits zone 0 of node 0, any of node 1
+    req[1, :2] = [6000.0, 1024.0]   # no single zone on node 0; node 1 ok
+    wants = np.array([True, True])
+    mask = np.asarray(numa_fit_mask(jnp.asarray(req), jnp.asarray(wants), ns))
+    assert mask[0].tolist() == [True, True]
+    assert mask[1].tolist() == [False, True]
+
+
+def test_best_effort_mask_allows_spanning():
+    ns = numa_state(1)  # BEST_EFFORT
+    req = np.zeros((1, 4), np.float32)
+    req[0, :2] = [6000.0, 1024.0]   # spans node 0's zones (4000+2000)
+    mask = np.asarray(
+        numa_fit_mask(jnp.asarray(req), jnp.asarray(np.array([True])), ns)
+    )
+    assert mask[0].tolist() == [True, True]
+
+
+def test_alignment_cost_prefers_headroom():
+    ns = numa_state(3)
+    req = np.zeros((1, 4), np.float32)
+    req[0, :2] = [1000.0, 512.0]
+    cost = np.asarray(numa_alignment_cost(jnp.asarray(req), ns))
+    assert cost[0, 1] < cost[0, 0]  # node 1 zones have more headroom
+
+
+# ---- hint merge ----
+
+
+def test_merge_hints_narrowest_wins():
+    # 2 zones -> candidates {01, 10, 11}; provider A allows zone0 or both,
+    # provider B allows anything containing zone0
+    m = 4
+    a = np.zeros(m, bool); a[[1, 3]] = True          # {z0}, {z0,z1}
+    b = np.zeros(m, bool); b[[1, 3]] = True
+    best = int(merge_hints(jnp.asarray(np.stack([a, b])), 2))
+    assert best == 1  # single zone 0 preferred over both
+    # no overlap -> -1
+    c = np.zeros(m, bool); c[2] = True               # {z1} only
+    best = int(merge_hints(jnp.asarray(np.stack([a, c])), 2))
+    assert best == -1
+
+
+# ---- end to end ----
+
+
+def lsr_pod(name, cpu_milli, bind=None):
+    labels = {ext.LABEL_POD_QOS: "LSR"}
+    annotations = {}
+    if bind:
+        annotations[ext.ANNOTATION_RESOURCE_SPEC] = json.dumps(
+            {"preferredCPUBindPolicy": bind}
+        )
+    return Pod(
+        meta=ObjectMeta(name=name, labels=labels, annotations=annotations),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu_milli, ext.RES_MEMORY: 1024},
+            priority=9500,
+        ),
+    )
+
+
+def test_end_to_end_lsr_cpuset():
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 16000, ext.RES_MEMORY: 32768}
+            ),
+        )
+    )
+    numa = NUMAManager(snap)
+    numa.register_node(
+        "n0",
+        CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=4),
+        policy=NUMAPolicy.SINGLE_NUMA_NODE,
+        memory_per_zone_mib=16384,
+    )
+    sched = BatchScheduler(snap, numa=numa)
+    pod = lsr_pod("lsr-1", 4000, bind="FullPCPUs")
+    out = sched.schedule([pod])
+    assert len(out.bound) == 1
+    status = json.loads(
+        out.bound[0][0].meta.annotations[ext.ANNOTATION_RESOURCE_STATUS]
+    )
+    cpus = parse_cpuset(status["cpuset"])
+    assert len(cpus) == 4
+    assert len({c // 2 for c in cpus}) == 2  # whole physical cores
+    assert status["numaNodeResources"] == [{"node": 0}]
+
+    # second LSR pod of 6 cpus: zone 0 has 4 left -> goes to zone 1
+    pod2 = lsr_pod("lsr-2", 6000)
+    out2 = sched.schedule([pod2])
+    assert len(out2.bound) == 1
+    status2 = json.loads(
+        out2.bound[0][0].meta.annotations[ext.ANNOTATION_RESOURCE_STATUS]
+    )
+    assert status2["numaNodeResources"] == [{"node": 1}]
+    # and its cpuset is disjoint from pod 1's
+    assert not (parse_cpuset(status2["cpuset"]) & cpus)
+
+
+def test_end_to_end_single_numa_infeasible():
+    """A pod too big for any single zone on a strict node is unschedulable."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 16000, ext.RES_MEMORY: 32768}
+            ),
+        )
+    )
+    numa = NUMAManager(snap)
+    numa.register_node(
+        "n0",
+        CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=4),
+        policy=NUMAPolicy.SINGLE_NUMA_NODE,
+        memory_per_zone_mib=16384,
+    )
+    sched = BatchScheduler(snap, numa=numa)
+    out = sched.schedule([lsr_pod("big", 12000)])  # > 8 cpus per zone
+    assert out.bound == []
+    assert len(out.unschedulable) == 1
+
+
+def test_exhausted_zones_stay_infeasible():
+    """A node whose zones are fully allocated must not become feasible via
+    the 'no topology' fallback (capacity, not free, drives has_zones)."""
+    zone_free = np.zeros((1, 2, 2), np.float32)
+    zone_cap = np.full((1, 2, 2), 100.0, np.float32)
+    ns = NumaState(
+        zone_free=jnp.asarray(zone_free),
+        zone_cap=jnp.asarray(zone_cap),
+        policy=jnp.asarray(np.array([3], np.int8)),
+    )
+    req = np.zeros((1, 4), np.float32)
+    req[0, :2] = [10.0, 10.0]
+    mask = np.asarray(
+        numa_fit_mask(jnp.asarray(req), jnp.asarray(np.array([True])), ns)
+    )
+    assert mask[0, 0] == False  # noqa: E712
+
+
+def test_unreported_memory_dim_ignored():
+    """Zones registered with zero memory capacity skip the memory check
+    (like a disabled threshold) instead of rejecting every pod."""
+    zone_free = np.zeros((1, 2, 2), np.float32)
+    zone_free[0, :, 0] = 8000.0  # cpu only; memory unreported
+    ns = NumaState(
+        zone_free=jnp.asarray(zone_free),
+        zone_cap=jnp.asarray(zone_free),
+        policy=jnp.asarray(np.array([3], np.int8)),
+    )
+    req = np.zeros((1, 4), np.float32)
+    req[0, :2] = [4000.0, 2048.0]
+    mask = np.asarray(
+        numa_fit_mask(jnp.asarray(req), jnp.asarray(np.array([True])), ns)
+    )
+    assert mask[0, 0] == True  # noqa: E712
