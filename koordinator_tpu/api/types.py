@@ -172,6 +172,7 @@ class Reservation:
     node_name: Optional[str] = None          # set once scheduled
     allocated: ResourceList = dataclasses.field(default_factory=dict)
     current_owners: List[str] = dataclasses.field(default_factory=list)  # pod uids
+    available_time: Optional[float] = None   # when it became Available (TTL base)
 
 
 # --- scheduling.koordinator.sh/Device (device_types.go:104) ---
@@ -242,6 +243,7 @@ class PodMigrationJob:
     phase: MigrationPhase = MigrationPhase.PENDING
     reservation_name: Optional[str] = None
     reason: str = ""
+    create_time: float = dataclasses.field(default_factory=time.time)
 
 
 # --- config.koordinator.sh/ClusterColocationProfile ---

@@ -1,0 +1,165 @@
+"""LowNodeLoad descheduler plugin: utilization-driven rebalancing.
+
+Rebuild of ``pkg/descheduler/framework/plugins/loadaware/low_node_load.go:
+137-265`` + ``utilization_util.go``: nodes are classified low/high against
+NodeMetric utilization thresholds (total and prod tiers), a debouncing
+anomaly detector (``low_node_load.go:286-312``) requires a node to stay
+overutilized for N consecutive rounds before action, then victims are
+picked from high nodes — lowest priority band / QoS first, highest usage
+first — but only if they fit on some underutilized node (checked with the
+same fit masks the scheduler uses, SURVEY §7 step 7: "reusing the same
+cost tensor for eviction selection").
+
+Classification and target-fit checks are vectorized over the node axis;
+victim ordering is a host-side sort over the (small) candidate set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import extension as ext
+from ..api.types import Pod
+from ..core.snapshot import ClusterSnapshot
+
+
+@dataclasses.dataclass
+class LowNodeLoadArgs:
+    """Thresholds in percent of allocatable (reference LowNodeLoadArgs)."""
+
+    high_thresholds: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: {ext.RES_CPU: 65.0, ext.RES_MEMORY: 80.0}
+    )
+    low_thresholds: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: {ext.RES_CPU: 45.0, ext.RES_MEMORY: 60.0}
+    )
+    prod_high_thresholds: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    #: consecutive overutilized rounds before a node is actionable
+    #: (anomaly detector debounce, low_node_load.go:286-312)
+    anomaly_condition_count: int = 2
+    #: stop evicting once the node is projected below high thresholds
+    target_margin_percent: float = 5.0
+    max_evictions_per_node: int = 5
+
+
+@dataclasses.dataclass
+class NodeClassification:
+    low: np.ndarray     # [N] bool
+    high: np.ndarray    # [N] bool (debounced)
+    raw_high: np.ndarray  # [N] bool (before debounce)
+    utilization: np.ndarray  # [N, D] percent
+
+
+class LowNodeLoad:
+    def __init__(self, snapshot: ClusterSnapshot, args: Optional[LowNodeLoadArgs] = None):
+        self.snapshot = snapshot
+        self.args = args or LowNodeLoadArgs()
+        self._over_counts: Dict[int, int] = {}
+
+    def _vec(self, table: Mapping[str, float]) -> np.ndarray:
+        return np.array(
+            [float(table.get(r, 0.0)) for r in self.snapshot.config.resources],
+            np.float32,
+        )
+
+    def classify(self) -> NodeClassification:
+        na = self.snapshot.nodes
+        alloc = np.maximum(na.allocatable, 1e-9)
+        used = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
+        util = used * 100.0 / alloc
+        hi = self._vec(self.args.high_thresholds)
+        lo = self._vec(self.args.low_thresholds)
+        active = na.schedulable & na.metric_fresh
+        hi_on, lo_on = hi > 0, lo > 0
+        raw_high = active & np.any(hi_on[None, :] & (util > hi[None, :]), axis=1)
+        low = active & np.all(~lo_on[None, :] | (util < lo[None, :]), axis=1)
+        # prod tier: a node can be overutilized on prod usage alone
+        phi = self._vec(self.args.prod_high_thresholds)
+        if (phi > 0).any():
+            prod_util = (na.prod_usage + na.assigned_pending_prod) * 100.0 / alloc
+            raw_high |= active & np.any(
+                (phi > 0)[None, :] & (prod_util > phi[None, :]), axis=1
+            )
+
+        # debounce
+        high = np.zeros_like(raw_high)
+        for idx in np.nonzero(raw_high)[0]:
+            self._over_counts[idx] = self._over_counts.get(idx, 0) + 1
+            if self._over_counts[idx] >= self.args.anomaly_condition_count:
+                high[idx] = True
+        for idx in list(self._over_counts):
+            if not raw_high[idx]:
+                del self._over_counts[idx]
+        return NodeClassification(
+            low=low, high=high, raw_high=raw_high, utilization=util
+        )
+
+    def select_victims(
+        self, bound_pods: Sequence[Pod], classification: Optional[NodeClassification] = None
+    ) -> List[Pod]:
+        """Pick eviction candidates from debounced-high nodes.
+
+        Order per node: lowest priority band first, then BE before LS,
+        then largest estimated usage — and only pods that fit on at least
+        one low node (utilization_util.go's sortPodsOnOneOverloadedNode).
+        """
+        cls = classification or self.classify()
+        if not cls.high.any() or not cls.low.any():
+            return []
+        cfg = self.snapshot.config
+        na = self.snapshot.nodes
+        low_idx = np.nonzero(cls.low)[0]
+        low_free = na.allocatable[low_idx] - na.requested[low_idx]  # [L, D]
+
+        by_node: Dict[int, List[Pod]] = {}
+        for pod in bound_pods:
+            if pod.spec.node_name is None:
+                continue
+            idx = self.snapshot.node_id(pod.spec.node_name)
+            if idx is not None and cls.high[idx]:
+                by_node.setdefault(idx, []).append(pod)
+
+        victims: List[Pod] = []
+        hi = self._vec(self.args.high_thresholds)
+        from ..ops.estimator import scale_vector
+
+        relief = scale_vector(cfg.resources)
+        # shared across all high nodes: a low node's free capacity is
+        # consumed once, not once per overloaded source
+        free = low_free.copy()
+        for idx, pods in by_node.items():
+            alloc = np.maximum(na.allocatable[idx], 1e-9)
+            used = (
+                np.maximum(na.usage_agg[idx], na.usage_avg[idx])
+                + na.assigned_pending[idx]
+            )
+            target = alloc * np.where(
+                hi > 0, (hi - self.args.target_margin_percent) / 100.0, np.inf
+            )
+            pods_sorted = sorted(
+                pods,
+                key=lambda p: (
+                    int(p.priority_class),
+                    -int(p.qos == ext.QoSClass.BE),
+                    -sum(p.spec.requests.values()),
+                ),
+            )
+            evicted = 0
+            for pod in pods_sorted:
+                if evicted >= self.args.max_evictions_per_node:
+                    break
+                if np.all(used <= target + 1e-3):
+                    break
+                req = cfg.res_vector(pod.spec.requests)
+                fits = np.all(req[None, :] <= free + 1e-3, axis=1)
+                if not fits.any():
+                    continue
+                tgt = int(np.argmax(fits))
+                free[tgt] -= req
+                used = used - req * relief  # estimator-scaled relief per dim
+                victims.append(pod)
+                evicted += 1
+        return victims

@@ -1,0 +1,190 @@
+"""PodMigrationJob controller + arbitrator.
+
+Rebuild of ``pkg/descheduler/controllers/migration/`` (controller.go) and
+its arbitrator (``arbitrator/arbitrator.go``, ``filter.go``, ``sort.go``):
+migration jobs are sorted (lowest priority band / BE victims first),
+filtered by per-namespace and global in-flight limits, then executed —
+ReservationFirst mode creates a Reservation shaped like the victim's
+replacement, waits until the scheduler binds it, and only then evicts
+(``evictor/evictor_{native,delete,soft}.go`` → the ``evict_fn`` callback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..api import extension as ext
+from ..api.types import (
+    MigrationMode,
+    MigrationPhase,
+    ObjectMeta,
+    Pod,
+    PodMigrationJob,
+    Reservation,
+    ReservationOwner,
+    ReservationPhase,
+)
+from ..scheduler.plugins.reservation import ReservationManager
+
+EvictFn = Callable[[Pod, str], bool]  # (victim, reason) -> evicted?
+
+
+@dataclasses.dataclass
+class ArbitratorArgs:
+    """Reference ``arbitrator/filter.go`` limits."""
+
+    max_migrating_global: int = 10
+    max_migrating_per_namespace: int = 2
+
+
+class Arbitrator:
+    """Sort + filter candidate jobs (``arbitrator/arbitrator.go``)."""
+
+    def __init__(self, args: Optional[ArbitratorArgs] = None):
+        self.args = args or ArbitratorArgs()
+
+    def arbitrate(
+        self,
+        jobs: Sequence[PodMigrationJob],
+        pods_by_uid: Dict[str, Pod],
+        in_flight: int,
+        running_per_ns: Optional[Dict[str, int]] = None,
+    ) -> List[PodMigrationJob]:
+        def sort_key(job: PodMigrationJob):
+            pod = pods_by_uid.get(job.pod_uid)
+            if pod is None:
+                return (99, 0)
+            # lowest band first, BE before LS within a band
+            return (
+                int(pod.priority_class),
+                0 if pod.qos == ext.QoSClass.BE else 1,
+            )
+
+        budget = max(self.args.max_migrating_global - in_flight, 0)
+        # namespace caps count already-running migrations too
+        per_ns: Dict[str, int] = dict(running_per_ns or {})
+        selected: List[PodMigrationJob] = []
+        for job in sorted(jobs, key=sort_key):
+            if len(selected) >= budget:
+                break
+            pod = pods_by_uid.get(job.pod_uid)
+            ns = pod.meta.namespace if pod else ""
+            if per_ns.get(ns, 0) >= self.args.max_migrating_per_namespace:
+                continue
+            per_ns[ns] = per_ns.get(ns, 0) + 1
+            selected.append(job)
+        return selected
+
+
+class MigrationController:
+    """Drives PodMigrationJobs to completion."""
+
+    def __init__(
+        self,
+        reservations: ReservationManager,
+        evict_fn: EvictFn,
+        arbitrator: Optional[Arbitrator] = None,
+        job_timeout_s: float = 300.0,
+    ):
+        self.reservations = reservations
+        self.evict_fn = evict_fn
+        self.arbitrator = arbitrator or Arbitrator()
+        self.job_timeout_s = job_timeout_s
+        self.jobs: Dict[str, PodMigrationJob] = {}
+        self._victims: Dict[str, Pod] = {}
+
+    def submit(self, victim: Pod, mode: MigrationMode = MigrationMode.RESERVATION_FIRST) -> PodMigrationJob:
+        name = f"migrate-{victim.meta.uid.replace('/', '-')}"
+        if name in self.jobs and self.jobs[name].phase in (
+            MigrationPhase.PENDING,
+            MigrationPhase.RUNNING,
+        ):
+            return self.jobs[name]
+        job = PodMigrationJob(
+            meta=ObjectMeta(name=name), pod_uid=victim.meta.uid, mode=mode
+        )
+        self.jobs[name] = job
+        self._victims[victim.meta.uid] = victim
+        return job
+
+    @property
+    def in_flight(self) -> int:
+        return sum(
+            1 for j in self.jobs.values() if j.phase == MigrationPhase.RUNNING
+        )
+
+    def reconcile(self, now: Optional[float] = None) -> None:
+        """One controller pass: arbitrate pending jobs, advance running ones.
+
+        ReservationFirst (``controller.go`` reconcile): create a Reservation
+        mirroring the victim (owners = the victim's labels, so the
+        replacement matches), wait for it to become Available, then evict.
+        Jobs stuck past ``job_timeout_s`` fail and release their
+        reservation so the in-flight budget cannot leak away.
+        """
+        import time as _t
+
+        now = now if now is not None else _t.time()
+        running_per_ns: Dict[str, int] = {}
+        for j in self.jobs.values():
+            if j.phase == MigrationPhase.RUNNING:
+                pod = self._victims.get(j.pod_uid)
+                ns = pod.meta.namespace if pod else ""
+                running_per_ns[ns] = running_per_ns.get(ns, 0) + 1
+
+        pending = [
+            j for j in self.jobs.values() if j.phase == MigrationPhase.PENDING
+        ]
+        for job in self.arbitrator.arbitrate(
+            pending, self._victims, self.in_flight, running_per_ns
+        ):
+            victim = self._victims[job.pod_uid]
+            # A victim with no labels yields an owner selector matching
+            # every pod in the namespace — fall back to direct eviction
+            # instead of creating a promiscuous reservation.
+            if job.mode == MigrationMode.EVICT_DIRECTLY or not victim.meta.labels:
+                ok = self.evict_fn(victim, "descheduled")
+                job.phase = (
+                    MigrationPhase.SUCCEEDED if ok else MigrationPhase.FAILED
+                )
+                continue
+            r = Reservation(
+                meta=ObjectMeta(name=f"{job.meta.name}-res"),
+                requests=dict(victim.spec.requests),
+                owners=[
+                    ReservationOwner(
+                        label_selector=dict(victim.meta.labels),
+                        namespace=victim.meta.namespace,
+                    )
+                ],
+                allocate_once=True,
+            )
+            self.reservations.add(r)
+            job.reservation_name = r.meta.name
+            job.phase = MigrationPhase.RUNNING
+
+        self.reservations.schedule_pending()
+
+        for job in self.jobs.values():
+            if job.phase != MigrationPhase.RUNNING:
+                continue
+            r = self.reservations.get(job.reservation_name or "")
+            victim = self._victims.get(job.pod_uid)
+            if r is None or victim is None:
+                job.phase = MigrationPhase.FAILED
+                continue
+            if now - job.create_time > self.job_timeout_s:
+                self.reservations.expire_reservation(r.meta.name)
+                job.phase = MigrationPhase.FAILED
+                job.reason = "timed out waiting for replacement reservation"
+                continue
+            if r.phase == ReservationPhase.AVAILABLE:
+                ok = self.evict_fn(victim, "descheduled; replacement reserved")
+                job.phase = (
+                    MigrationPhase.SUCCEEDED if ok else MigrationPhase.FAILED
+                )
+                if not ok:
+                    self.reservations.expire_reservation(r.meta.name)
+            elif r.phase == ReservationPhase.FAILED:
+                job.phase = MigrationPhase.FAILED

@@ -1,0 +1,60 @@
+"""NodeSLO controller: render per-node QoS strategy from cluster config.
+
+Rebuild of ``pkg/slo-controller/nodeslo/``: the dynamic-config channel —
+a cluster-level strategy (the reference's ``slo-controller-config``
+ConfigMap, ``apis/configuration/slo_controller_config.go``) merged with
+per-node overrides, rendered into one NodeSLO object per node that the
+node agent enforces (qosmanager/runtimehooks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional
+
+from ..api.types import (
+    CPUBurstStrategy,
+    NodeSLO,
+    ObjectMeta,
+    ResourceThresholdStrategy,
+)
+
+
+@dataclasses.dataclass
+class SLOControllerConfig:
+    """Cluster default strategies + per-node-label overrides."""
+
+    threshold: ResourceThresholdStrategy = dataclasses.field(
+        default_factory=lambda: ResourceThresholdStrategy(enable=True)
+    )
+    cpu_burst: CPUBurstStrategy = dataclasses.field(default_factory=CPUBurstStrategy)
+    #: node-label-selector -> override strategies (first match wins)
+    node_overrides: Dict[str, ResourceThresholdStrategy] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class NodeSLOController:
+    def __init__(self, config: Optional[SLOControllerConfig] = None):
+        self.config = config or SLOControllerConfig()
+        self._rendered: Dict[str, NodeSLO] = {}
+
+    def render(
+        self, node_name: str, node_labels: Optional[Mapping[str, str]] = None
+    ) -> NodeSLO:
+        threshold = self.config.threshold
+        for selector, override in self.config.node_overrides.items():
+            key, _, value = selector.partition("=")
+            if (node_labels or {}).get(key) == value:
+                threshold = override
+                break
+        slo = NodeSLO(
+            meta=ObjectMeta(name=node_name),
+            threshold=dataclasses.replace(threshold),
+            cpu_burst=dataclasses.replace(self.config.cpu_burst),
+        )
+        self._rendered[node_name] = slo
+        return slo
+
+    def get(self, node_name: str) -> Optional[NodeSLO]:
+        return self._rendered.get(node_name)

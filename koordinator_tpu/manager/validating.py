@@ -1,0 +1,67 @@
+"""Pod validating webhook checks.
+
+Rebuild of ``pkg/webhook/pod/validating/`` (``verify_annotations.go``,
+QoS/priority consistency): reject pods whose QoS class, priority band and
+resource spec disagree with the annotation protocol before they reach the
+scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api import extension as ext
+from ..api.extension import PriorityClass, QoSClass
+from ..api.types import Pod
+
+
+def validate_pod(pod: Pod) -> List[str]:
+    """Returns a list of violation messages (empty = valid).
+
+    Rules (reference ``pod/validating``):
+      * BE pods must not request exclusive cpus (integer cpu + LSR/LSE only)
+      * LSE/LSR requires prod priority band
+      * BE pods should request batch-tier resources, not raw cpu/memory
+        limits beyond requests
+      * priority value must lie in the band implied by any explicit
+        koord priority class label
+    """
+    errors: List[str] = []
+    qos = pod.qos
+    band = pod.priority_class
+
+    if qos in (QoSClass.LSE, QoSClass.LSR):
+        if band is not PriorityClass.PROD:
+            errors.append(
+                f"{qos.name} pods require prod priority (9000-9999), got "
+                f"{pod.spec.priority}"
+            )
+    if qos is QoSClass.BE:
+        if band in (PriorityClass.PROD, PriorityClass.MID):
+            errors.append(
+                f"BE pods must use batch/free priority bands, got {pod.spec.priority}"
+            )
+        cpu = pod.spec.requests.get(ext.RES_CPU, 0.0)
+        limit_cpu = pod.spec.limits.get(ext.RES_CPU)
+        if limit_cpu is not None and cpu > 0 and limit_cpu < cpu:
+            errors.append("cpu limit below request")
+    explicit = pod.meta.labels.get(ext.LABEL_POD_PRIORITY)
+    if explicit is not None:
+        try:
+            explicit_band = PriorityClass[explicit.upper()]
+        except KeyError:
+            errors.append(f"unknown priority class label {explicit!r}")
+        else:
+            if (
+                pod.spec.priority is not None
+                and PriorityClass.from_priority(pod.spec.priority)
+                is not explicit_band
+            ):
+                errors.append(
+                    f"priority {pod.spec.priority} outside the "
+                    f"{explicit_band.name} band"
+                )
+    gpu_whole, gpu_share = ext.parse_gpu_request(pod.spec.requests)
+    if gpu_whole > 0 and gpu_share > 0:
+        errors.append("multi-GPU pods cannot also request a fractional share")
+    return errors

@@ -105,6 +105,8 @@ class BatchScheduler:
         self.quotas = quotas or GroupQuotaManager(self.snapshot.config)
         self.numa = numa
         self.devices = devices
+        #: set by plugins.reservation.ReservationManager when attached
+        self.reservations = None
         self._params = self.args.solver_params(self.snapshot.config)
         self._scales = self.args.scale_vector(self.snapshot.config)
 
@@ -151,12 +153,64 @@ class BatchScheduler:
     def schedule(self, pending: Sequence[Pod]) -> ScheduleOutcome:
         # PreEnqueue gate + gang-adjacent ordering (coscheduling NextPod):
         # whole gangs land in one solver batch.
+        # Reservation pre-match: pods owned by an Available reservation
+        # commit directly against its hold (the reference transformer
+        # restores reserved resources before Filter; the ghost hold makes
+        # the direct commit capacity-safe). Pods needing the full pipeline
+        # fall through to the solver: gang members (Permit), and matched
+        # pods whose NUMA/device/quota Reserve fails.
+        reserved_bound: List[Tuple[Pod, str]] = []
+        if self.reservations is not None:
+            from .plugins.coscheduling import gang_key_of
+            from .plugins.elasticquota import quota_name_of
+
+            remaining_pending = []
+            for pod in pending:
+                r = (
+                    self.reservations.match(pod)
+                    if gang_key_of(pod) is None
+                    else None
+                )
+                if r is None:
+                    remaining_pending.append(pod)
+                    continue
+                node = r.node_name
+                leaf = quota_name_of(pod)
+                if leaf is not None and not self.quotas.has_headroom(
+                    leaf, pod.spec.requests
+                ):
+                    remaining_pending.append(pod)
+                    continue
+                patch: Dict[str, str] = {}
+                if self.numa is not None:
+                    numa_patch = self.numa.allocate(pod, node)
+                    if numa_patch is None:
+                        remaining_pending.append(pod)
+                        continue
+                    patch.update(numa_patch)
+                if self.devices is not None:
+                    dev_patch = self.devices.allocate(pod, node)
+                    if dev_patch is None:
+                        if self.numa is not None:
+                            self.numa.release(pod.meta.uid, node)
+                        remaining_pending.append(pod)
+                        continue
+                    patch.update(dev_patch)
+                self.reservations.allocate(r, pod)
+                if leaf is not None:
+                    self.quotas.charge(leaf, pod.spec.requests)
+                est = self.snapshot.config.res_vector(pod.spec.requests) * self._scales
+                self.snapshot.assume_pod(pod, node, est)
+                pod.meta.annotations.update(patch)
+                reserved_bound.append((pod, node))
+            pending = remaining_pending
+
         self.pod_groups.begin_cycle(pending)
         eligible = self.pod_groups.order_pending(pending)
         eligible_uids = {p.meta.uid for p in eligible}
         gated = [p for p in pending if p.meta.uid not in eligible_uids]
 
-        bound: List[Tuple[Pod, str]] = []
+        bound: List[Tuple[Pod, str]] = list(reserved_bound)
         unsched: List[Pod] = list(gated)
         rounds = 0
         for chunk in self._chunks(eligible):

@@ -173,6 +173,18 @@ class GroupQuotaManager:
                 grown[: arr.shape[0]] = arr
                 setattr(self, attr, grown)
 
+    def has_headroom(self, quota_name: str, requests: Mapping[str, float]) -> bool:
+        """used + request ≤ runtime along the whole chain (host-side mirror
+        of the solver's admission for bypass paths like reservations)."""
+        self._ensure_capacity()
+        if self._dirty:
+            self.refresh_runtime()
+        vec = self.config.res_vector(requests)
+        for idx in self.chain_of(quota_name):
+            if np.any(self.used[idx] + vec > self.runtime[idx] + 1e-3):
+                return False
+        return True
+
     def charge(self, quota_name: str, requests: Mapping[str, float]) -> None:
         self._ensure_capacity()
         vec = self.config.res_vector(requests)

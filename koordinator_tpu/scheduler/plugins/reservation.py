@@ -1,0 +1,184 @@
+"""Reservation plugin: capacity held by ghost pods, consumed by owners.
+
+Rebuild of ``pkg/scheduler/plugins/reservation/`` + the frameworkext
+reservation cache (``reservation_info.go:1-495``): a Reservation is
+scheduled like a pod (the "reserve pod"), holds its capacity on the chosen
+node, and later pods matching its owner selectors allocate *from* the
+reservation instead of from node free capacity (the reference restores
+reserved resources into NodeInfo via transformers before Filter;
+here the ghost hold + pre-match commit achieves the same accounting).
+AllocateOnce reservations are consumed whole; TTL expiry releases holds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ...api.types import (
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Reservation,
+    ReservationPhase,
+)
+
+GHOST_PRIORITY = 9800  # reserve pods schedule in the prod band
+
+
+def _ghost_uid(reservation: Reservation) -> str:
+    return f"reservation-ghost/{reservation.meta.name}"
+
+
+def matches_owner(reservation: Reservation, pod: Pod) -> bool:
+    """Owner matching (reference ``apis/scheduling/v1alpha1/reservation_types
+    .go`` ReservationOwner: label selector and/or namespace)."""
+    if not reservation.owners:
+        return False
+    for owner in reservation.owners:
+        if not owner.label_selector and owner.namespace is None:
+            continue  # an empty owner matches nothing, not everything
+        if owner.namespace is not None and owner.namespace != pod.meta.namespace:
+            continue
+        if all(
+            pod.meta.labels.get(k) == v
+            for k, v in owner.label_selector.items()
+        ):
+            return True
+    return False
+
+
+class ReservationManager:
+    """Schedules pending reservations as ghost pods and brokers matches."""
+
+    def __init__(self, scheduler: "BatchScheduler"):
+        self.scheduler = scheduler
+        scheduler.reservations = self  # enable the pre-match commit path
+        self._reservations: Dict[str, Reservation] = {}
+
+    def add(self, reservation: Reservation) -> None:
+        self._reservations[reservation.meta.name] = reservation
+
+    def get(self, name: str) -> Optional[Reservation]:
+        return self._reservations.get(name)
+
+    def list(self) -> List[Reservation]:
+        return list(self._reservations.values())
+
+    # ---- scheduling the reserve pods ----
+
+    def _ghost_pod(self, r: Reservation) -> Pod:
+        return Pod(
+            meta=ObjectMeta(
+                name=f"reserve-{r.meta.name}",
+                namespace="koordinator-reservation",
+                uid=_ghost_uid(r),
+            ),
+            spec=PodSpec(requests=dict(r.requests), priority=GHOST_PRIORITY),
+        )
+
+    def schedule_pending(self) -> int:
+        """Run pending reservations through the solver; returns how many
+        became Available (reference Bind updates Reservation status
+        instead of pod binding, ``plugin.go:849-888``)."""
+        pending = [
+            r
+            for r in self._reservations.values()
+            if r.phase == ReservationPhase.PENDING
+        ]
+        if not pending:
+            return 0
+        ghosts = {_ghost_uid(r): r for r in pending}
+        outcome = self.scheduler.schedule([self._ghost_pod(r) for r in pending])
+        import time as _t
+
+        for pod, node in outcome.bound:
+            r = ghosts[pod.meta.uid]
+            r.phase = ReservationPhase.AVAILABLE
+            r.node_name = node
+            r.available_time = _t.time()
+        return len(outcome.bound)
+
+    def expire(self, now: Optional[float] = None) -> List[str]:
+        """Fail Available reservations past their TTL with no owners,
+        releasing their holds. Returns the expired names."""
+        import time as _t
+
+        now = now if now is not None else _t.time()
+        expired: List[str] = []
+        for r in list(self._reservations.values()):
+            if (
+                r.phase == ReservationPhase.AVAILABLE
+                and r.ttl_s is not None
+                and not r.current_owners
+                and r.available_time is not None
+                and now - r.available_time > r.ttl_s
+            ):
+                self.expire_reservation(r.meta.name)
+                expired.append(r.meta.name)
+        return expired
+
+    # ---- owner matching / allocation ----
+
+    def remaining(self, r: Reservation) -> Dict[str, float]:
+        return {
+            k: v - r.allocated.get(k, 0.0) for k, v in r.requests.items()
+        }
+
+    def match(self, pod: Pod) -> Optional[Reservation]:
+        """First Available, unexpired reservation whose owners match and
+        whose remaining capacity covers the pod (the reference nominator
+        picks the best per node, ``nominator.go:1-357``)."""
+        for r in self._reservations.values():
+            if r.phase != ReservationPhase.AVAILABLE or r.node_name is None:
+                continue
+            if r.allocate_once and r.current_owners:
+                continue
+            if not matches_owner(r, pod):
+                continue
+            remaining = self.remaining(r)
+            if all(
+                pod.spec.requests.get(k, 0.0) <= remaining.get(k, 0.0) + 1e-6
+                for k in pod.spec.requests
+            ):
+                return r
+        return None
+
+    def allocate(self, reservation: Reservation, pod: Pod) -> str:
+        """Commit a pod against a reservation.
+
+        The full ghost hold is forgotten, the pod is assumed normally by
+        the caller, and (unless AllocateOnce) a new ghost hold is assumed
+        for the remainder — all through the snapshot's assume/forget API so
+        node accounting stays consistent. Returns the node name."""
+        node = reservation.node_name
+        assert node is not None
+        snap = self.scheduler.snapshot
+        snap.forget_pod(_ghost_uid(reservation))
+        for k, v in pod.spec.requests.items():
+            reservation.allocated[k] = reservation.allocated.get(k, 0.0) + v
+        reservation.current_owners.append(pod.meta.uid)
+        if reservation.allocate_once:
+            reservation.allocated = dict(reservation.requests)
+            reservation.phase = ReservationPhase.SUCCEEDED
+        else:
+            remaining = {
+                k: v for k, v in self.remaining(reservation).items() if v > 1e-6
+            }
+            if remaining:
+                ghost = self._ghost_pod(reservation)
+                ghost.spec.requests = remaining
+                snap.assume_pod(ghost, node)
+        return node
+
+    def expire_reservation(self, name: str) -> bool:
+        """Explicitly fail/expire a reservation, releasing its hold."""
+        r = self._reservations.get(name)
+        if r is None or r.phase not in (
+            ReservationPhase.PENDING,
+            ReservationPhase.AVAILABLE,
+        ):
+            return False
+        if r.phase == ReservationPhase.AVAILABLE:
+            self.scheduler.snapshot.forget_pod(_ghost_uid(r))
+        r.phase = ReservationPhase.FAILED
+        return True

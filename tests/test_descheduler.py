@@ -1,0 +1,324 @@
+"""Descheduler + Reservation tests: LowNodeLoad classification/victims,
+reservation lifecycle, reservation-first migration e2e
+(reference ``pkg/descheduler`` + ``pkg/scheduler/plugins/reservation``)."""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    MigrationPhase,
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Reservation,
+    ReservationOwner,
+    ReservationPhase,
+    ResourceMetric,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.descheduler.low_node_load import LowNodeLoad, LowNodeLoadArgs
+from koordinator_tpu.descheduler.migration import (
+    Arbitrator,
+    ArbitratorArgs,
+    MigrationController,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+
+def mknode(name, cpu=64000, mem=262144):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}),
+    )
+
+
+def set_util(snap, name, cpu_pct, mem_pct=None):
+    idx = snap.node_id(name)
+    alloc = snap.nodes.allocatable[idx]
+    mem_pct = mem_pct if mem_pct is not None else cpu_pct
+    snap.set_node_metric(
+        NodeMetric(
+            meta=ObjectMeta(name=name),
+            node_usage=ResourceMetric(
+                usage={
+                    ext.RES_CPU: alloc[0] * cpu_pct / 100,
+                    ext.RES_MEMORY: alloc[1] * mem_pct / 100,
+                }
+            ),
+            update_time=1000.0,
+        ),
+        now=1010.0,
+    )
+
+
+def bound_pod(name, node, cpu=4000, prio=5500, labels=None):
+    return Pod(
+        meta=ObjectMeta(name=name, labels=labels or {}),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu},
+            priority=prio,
+            node_name=node,
+        ),
+    )
+
+
+def make_cluster(utils):
+    snap = ClusterSnapshot()
+    for i, u in enumerate(utils):
+        snap.upsert_node(mknode(f"n{i}"))
+        set_util(snap, f"n{i}", u)
+    return snap
+
+
+def test_classification_with_debounce():
+    snap = make_cluster([90, 30, 55])
+    lnl = LowNodeLoad(snap, LowNodeLoadArgs(anomaly_condition_count=2))
+    c1 = lnl.classify()
+    assert c1.raw_high[0] and not c1.high[0]   # debounced on first sight
+    assert c1.low[1] and not c1.low[0]
+    c2 = lnl.classify()
+    assert c2.high[0]                           # second consecutive round
+    # node recovers -> counter resets
+    set_util(snap, "n0", 30)
+    c3 = lnl.classify()
+    assert not c3.raw_high[0] and not c3.high[0]
+    set_util(snap, "n0", 90)
+    assert not lnl.classify().high[0]           # needs 2 rounds again
+
+
+def test_victim_selection_prefers_batch_pods():
+    snap = make_cluster([90, 20])
+    lnl = LowNodeLoad(snap, LowNodeLoadArgs(anomaly_condition_count=1))
+    pods = [
+        bound_pod("prod-1", "n0", prio=9500),
+        bound_pod("batch-1", "n0", prio=5500),
+        bound_pod("batch-2", "n0", prio=5500),
+    ]
+    victims = lnl.select_victims(pods)
+    assert victims, "overutilized node must yield victims"
+    assert victims[0].meta.name.startswith("batch")
+    assert all(v.meta.name != "prod-1" for v in victims[:2])
+
+
+def test_no_victims_without_low_nodes():
+    snap = make_cluster([90, 85])
+    lnl = LowNodeLoad(snap, LowNodeLoadArgs(anomaly_condition_count=1))
+    assert lnl.select_victims([bound_pod("b", "n0")]) == []
+
+
+# ---- reservation lifecycle ----
+
+
+def test_reservation_hold_and_consume():
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0", cpu=16000, mem=16000))
+    sched = BatchScheduler(snap)
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="r1"),
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8000},
+            owners=[ReservationOwner(label_selector={"app": "web"})],
+            allocate_once=True,
+        )
+    )
+    assert rm.schedule_pending() == 1
+    r = rm.get("r1")
+    assert r.phase == ReservationPhase.AVAILABLE and r.node_name == "n0"
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx][0] == 8000   # hold in place
+
+    # a non-matching pod cannot use the hold; node has 8000 free
+    filler = bound_pod("filler", None, cpu=10000, prio=9000)
+    filler.spec.node_name = None
+    out = sched.schedule([filler])
+    assert out.bound == []                        # 10000 > 8000 free
+
+    # matching pod commits against the reservation directly
+    owner_pod = Pod(
+        meta=ObjectMeta(name="web-1", labels={"app": "web"}),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 6000, ext.RES_MEMORY: 6000}, priority=9000
+        ),
+    )
+    out2 = sched.schedule([owner_pod])
+    assert [(p.meta.name, n) for p, n in out2.bound] == [("web-1", "n0")]
+    # AllocateOnce: remainder released; node now holds only the pod
+    assert snap.nodes.requested[idx][0] == 6000
+    assert r.phase == ReservationPhase.SUCCEEDED
+
+
+def test_reservation_expiry_releases_hold():
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0", cpu=8000, mem=8000))
+    sched = BatchScheduler(snap)
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="r1"),
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8000},
+            owners=[ReservationOwner(label_selector={"app": "x"})],
+        )
+    )
+    rm.schedule_pending()
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx][0] == 8000
+    assert rm.expire_reservation("r1")
+    assert snap.nodes.requested[idx][0] == 0
+    assert rm.get("r1").phase == ReservationPhase.FAILED
+
+
+# ---- arbitrator ----
+
+
+def test_arbitrator_limits_and_order():
+    args = ArbitratorArgs(max_migrating_global=3, max_migrating_per_namespace=1)
+    arb = Arbitrator(args)
+    pods = {}
+    jobs = []
+    from koordinator_tpu.api.types import PodMigrationJob
+
+    for i, (ns, prio) in enumerate(
+        [("a", 9500), ("a", 5500), ("b", 5500), ("c", 7500)]
+    ):
+        pod = Pod(
+            meta=ObjectMeta(name=f"p{i}", namespace=ns),
+            spec=PodSpec(priority=prio),
+        )
+        pods[pod.meta.uid] = pod
+        jobs.append(
+            PodMigrationJob(meta=ObjectMeta(name=f"j{i}"), pod_uid=pod.meta.uid)
+        )
+    picked = arb.arbitrate(jobs, pods, in_flight=0)
+    names = [j.meta.name for j in picked]
+    # batch pods first; ns 'a' capped at 1 so j0 (prod, same ns) dropped
+    assert names == ["j1", "j2", "j3"]
+
+
+# ---- reservation-first migration e2e ----
+
+
+def test_reservation_first_migration_e2e():
+    """Overloaded node -> victim -> reservation on a low node -> evict."""
+    snap = make_cluster([92, 15])
+    sched = BatchScheduler(snap)
+    rm = ReservationManager(sched)
+    lnl = LowNodeLoad(snap, LowNodeLoadArgs(anomaly_condition_count=1))
+
+    victim = bound_pod("batch-victim", "n0", cpu=8000, prio=5500, labels={"job": "spark"})
+    evicted = []
+
+    def evict(pod, reason):
+        evicted.append(pod.meta.name)
+        snap.forget_pod(pod.meta.uid)
+        return True
+
+    ctrl = MigrationController(rm, evict)
+    victims = lnl.select_victims([victim])
+    assert victims
+    for v in victims:
+        ctrl.submit(v)
+    ctrl.reconcile()
+    job = next(iter(ctrl.jobs.values()))
+    assert job.phase == MigrationPhase.SUCCEEDED, job
+    assert evicted == ["batch-victim"]
+    r = rm.get(job.reservation_name)
+    assert r.phase == ReservationPhase.AVAILABLE
+    assert r.node_name == "n1"  # replacement capacity on the low node
+
+    # the replacement pod (same labels) consumes the reservation
+    replacement = Pod(
+        meta=ObjectMeta(name="batch-replacement", labels={"job": "spark"}),
+        spec=PodSpec(
+            requests=dict(victim.spec.requests), priority=5500
+        ),
+    )
+    out = sched.schedule([replacement])
+    assert [(p.meta.name, n) for p, n in out.bound] == [
+        ("batch-replacement", "n1")
+    ]
+
+
+def test_victims_share_low_node_capacity():
+    """Two overloaded nodes must not both count the same low-node free
+    capacity when selecting victims."""
+    snap = make_cluster([90, 91, 20])
+    # low node n2 can absorb ~40k cpu of victims (45% low threshold)
+    idx = snap.node_id("n2")
+    snap.nodes.requested[idx][0] = 60_000  # only 40k requested-free
+    snap.nodes.requested[idx][1] = 60_000
+    lnl = LowNodeLoad(snap, LowNodeLoadArgs(anomaly_condition_count=1))
+    pods = [
+        bound_pod(f"a{i}", "n0", cpu=20_000, prio=5500) for i in range(3)
+    ] + [bound_pod(f"b{i}", "n1", cpu=20_000, prio=5500) for i in range(3)]
+    victims = lnl.select_victims(pods)
+    # 40k free => at most 2 x 20k victims total across BOTH high nodes
+    assert len(victims) <= 2, [v.meta.name for v in victims]
+
+
+def test_unlabeled_victim_falls_back_to_direct_eviction():
+    snap = make_cluster([92, 15])
+    sched = BatchScheduler(snap)
+    rm = ReservationManager(sched)
+    victim = bound_pod("plain", "n0", cpu=8000, prio=5500)  # no labels
+    evicted = []
+    ctrl = MigrationController(rm, lambda p, r: evicted.append(p.meta.name) or True)
+    ctrl.submit(victim)
+    ctrl.reconcile()
+    job = next(iter(ctrl.jobs.values()))
+    assert job.phase == MigrationPhase.SUCCEEDED
+    assert job.reservation_name is None  # no promiscuous reservation created
+    assert evicted == ["plain"]
+
+
+def test_stuck_migration_times_out():
+    snap = make_cluster([92, 90])  # nowhere to reserve a replacement
+    sched = BatchScheduler(snap)
+    rm = ReservationManager(sched)
+    victim = bound_pod("stuck", "n0", cpu=90_000, prio=5500, labels={"j": "x"})
+    ctrl = MigrationController(rm, lambda p, r: True, job_timeout_s=10.0)
+    ctrl.submit(victim)
+    ctrl.reconcile(now=victim and 1000.0)
+    job = next(iter(ctrl.jobs.values()))
+    job.create_time = 0.0
+    ctrl.reconcile(now=1000.0)
+    assert job.phase == MigrationPhase.FAILED
+    assert "timed out" in job.reason
+
+
+def test_running_migrations_count_toward_namespace_cap():
+    from koordinator_tpu.api.types import PodMigrationJob
+    from koordinator_tpu.descheduler.migration import Arbitrator, ArbitratorArgs
+
+    arb = Arbitrator(ArbitratorArgs(max_migrating_per_namespace=2))
+    pods, jobs = {}, []
+    for i in range(3):
+        pod = Pod(meta=ObjectMeta(name=f"p{i}", namespace="a"), spec=PodSpec(priority=5500))
+        pods[pod.meta.uid] = pod
+        jobs.append(PodMigrationJob(meta=ObjectMeta(name=f"j{i}"), pod_uid=pod.meta.uid))
+    picked = arb.arbitrate(jobs, pods, in_flight=2, running_per_ns={"a": 2})
+    assert picked == []  # namespace already at cap
+
+
+def test_reservation_ttl_expiry():
+    snap = make_cluster([20, 20])
+    sched = BatchScheduler(snap)
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="ttl-res"),
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1000},
+            owners=[ReservationOwner(label_selector={"a": "b"})],
+            ttl_s=60.0,
+        )
+    )
+    rm.schedule_pending()
+    r = rm.get("ttl-res")
+    assert r.phase == ReservationPhase.AVAILABLE
+    assert rm.expire(now=r.available_time + 30) == []      # not yet
+    assert rm.expire(now=r.available_time + 90) == ["ttl-res"]
+    assert r.phase == ReservationPhase.FAILED

@@ -1,0 +1,217 @@
+"""Manager layer tests: batch/mid resource calc, colocation profile
+mutation, pod validation, NodeSLO rendering, and the full colocation
+feedback loop (SURVEY §3.3)."""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.extension import PriorityClass, QoSClass
+from koordinator_tpu.api.types import (
+    ClusterColocationProfile,
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceMetric,
+    ResourceThresholdStrategy,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.manager.noderesource import (
+    ColocationStrategy,
+    NodeResourceController,
+)
+from koordinator_tpu.manager.nodeslo import NodeSLOController, SLOControllerConfig
+from koordinator_tpu.manager.profile import ProfileMutator
+from koordinator_tpu.manager.validating import validate_pod
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+
+def make_node(snap, name, cpu=100_000, mem=100_000, prod_cpu=30_000):
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name=name),
+            status=NodeStatus(allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}),
+        )
+    )
+    snap.set_node_metric(
+        NodeMetric(
+            meta=ObjectMeta(name=name),
+            node_usage=ResourceMetric(
+                usage={ext.RES_CPU: prod_cpu + 5000, ext.RES_MEMORY: prod_cpu}
+            ),
+            prod_usage=ResourceMetric(
+                usage={ext.RES_CPU: prod_cpu, ext.RES_MEMORY: prod_cpu}
+            ),
+            update_time=1000.0,
+        ),
+        now=1010.0,
+    )
+
+
+def test_batch_resource_formula():
+    snap = ClusterSnapshot()
+    make_node(snap, "n0", cpu=100_000, prod_cpu=30_000)
+    # prod pods requested 50k but peak at 30k -> 20k reclaimable
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 100_000, ext.RES_MEMORY: 100_000}
+            ),
+        )
+    )
+    prod = Pod(
+        meta=ObjectMeta(name="prod-1"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 50_000, ext.RES_MEMORY: 50_000}, priority=9500
+        ),
+    )
+    snap.assume_pod(prod, "n0", now=900.0)
+    # re-ingest the metric so the assumed-pending estimate is absorbed
+    make_node(snap, "n0", cpu=100_000, prod_cpu=30_000)
+    ctrl = NodeResourceController(
+        snap, ColocationStrategy(reserve_ratio=0.1, mid_reclaim_ratio=0.5)
+    )
+    batch, mid = ctrl.calculate()
+    idx = snap.node_id("n0")
+    # batch = 100k * 0.9 - 30k = 60k
+    assert abs(batch[idx][0] - 60_000) < 1e-2
+    # mid = reclaimable prod = (50k requested - 30k peak) * 0.5 = 10k
+    assert abs(mid[idx][0] - 10_000) < 1e-2
+
+
+def test_batch_degrades_on_stale_metric():
+    snap = ClusterSnapshot()
+    make_node(snap, "n0")
+    snap.nodes.metric_fresh[snap.node_id("n0")] = False
+    batch, mid = NodeResourceController(snap).calculate()
+    assert batch[snap.node_id("n0")][0] == 0.0
+
+
+def test_reconcile_updates_allocatable_tensor():
+    snap = ClusterSnapshot()
+    make_node(snap, "n0")
+    ctrl = NodeResourceController(snap)
+    updates = ctrl.reconcile()
+    assert ext.RES_BATCH_CPU in updates["n0"]
+    col = snap.config.resources.index(ext.RES_BATCH_CPU)
+    assert snap.nodes.allocatable[snap.node_id("n0"), col] == updates["n0"][
+        ext.RES_BATCH_CPU
+    ]
+
+
+def test_profile_mutation_spark_to_be():
+    """The reference's flagship example: Spark pods become BE/batch."""
+    profile = ClusterColocationProfile(
+        meta=ObjectMeta(name="spark"),
+        selector={"spark-role": "executor"},
+        qos_class=QoSClass.BE,
+        priority=5500,
+        scheduler_name="koord-scheduler",
+        resource_translation={
+            ext.RES_CPU: ext.RES_BATCH_CPU,
+            ext.RES_MEMORY: ext.RES_BATCH_MEMORY,
+        },
+        labels={"mutated": "yes"},
+    )
+    mutator = ProfileMutator([profile])
+    pod = Pod(
+        meta=ObjectMeta(name="exec-1", labels={"spark-role": "executor"}),
+        spec=PodSpec(requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192}),
+    )
+    mutator.mutate(pod)
+    assert pod.qos is QoSClass.BE
+    assert pod.priority_class is PriorityClass.BATCH
+    assert pod.spec.scheduler_name == "koord-scheduler"
+    assert pod.spec.requests == {
+        ext.RES_BATCH_CPU: 4000,
+        ext.RES_BATCH_MEMORY: 8192,
+    }
+    assert pod.meta.labels["mutated"] == "yes"
+    # non-matching pod untouched
+    other = Pod(meta=ObjectMeta(name="web"), spec=PodSpec(requests={ext.RES_CPU: 1}))
+    mutator.mutate(other)
+    assert other.spec.requests == {ext.RES_CPU: 1}
+
+
+def test_validation_rules():
+    ok = Pod(
+        meta=ObjectMeta(name="p", labels={ext.LABEL_POD_QOS: "LSR"}),
+        spec=PodSpec(requests={ext.RES_CPU: 2000}, priority=9500),
+    )
+    assert validate_pod(ok) == []
+    bad_lsr = Pod(
+        meta=ObjectMeta(name="p", labels={ext.LABEL_POD_QOS: "LSR"}),
+        spec=PodSpec(requests={ext.RES_CPU: 2000}, priority=5000),
+    )
+    assert any("prod priority" in e for e in validate_pod(bad_lsr))
+    bad_be = Pod(
+        meta=ObjectMeta(name="p", labels={ext.LABEL_POD_QOS: "BE"}),
+        spec=PodSpec(priority=9500),
+    )
+    assert any("batch/free" in e for e in validate_pod(bad_be))
+
+
+def test_nodeslo_override():
+    cfg = SLOControllerConfig(
+        threshold=ResourceThresholdStrategy(
+            enable=True, cpu_suppress_threshold_percent=65
+        ),
+        node_overrides={
+            "pool=sensitive": ResourceThresholdStrategy(
+                enable=True, cpu_suppress_threshold_percent=45
+            )
+        },
+    )
+    ctrl = NodeSLOController(cfg)
+    default = ctrl.render("n0", {})
+    assert default.threshold.cpu_suppress_threshold_percent == 65
+    override = ctrl.render("n1", {"pool": "sensitive"})
+    assert override.threshold.cpu_suppress_threshold_percent == 45
+
+
+def test_colocation_feedback_loop_e2e():
+    """koordlet metrics -> batch resource -> BE pod schedules on batch tier
+    (the cross-process loop of SURVEY §3.3, in-process here)."""
+    snap = ClusterSnapshot()
+    make_node(snap, "n0", cpu=100_000, mem=100_000, prod_cpu=30_000)
+    NodeResourceController(snap).reconcile()
+
+    profile = ClusterColocationProfile(
+        meta=ObjectMeta(name="spark"),
+        selector={"spark-role": "executor"},
+        qos_class=QoSClass.BE,
+        priority=5500,
+        resource_translation={
+            ext.RES_CPU: ext.RES_BATCH_CPU,
+            ext.RES_MEMORY: ext.RES_BATCH_MEMORY,
+        },
+    )
+    mutator = ProfileMutator([profile])
+    sched = BatchScheduler(snap)
+
+    pod = Pod(
+        meta=ObjectMeta(name="exec-1", labels={"spark-role": "executor"}),
+        spec=PodSpec(requests={ext.RES_CPU: 20_000, ext.RES_MEMORY: 20_000}),
+    )
+    assert mutator.admit(pod) == []
+    out = sched.schedule([pod])
+    assert [(p.meta.name, n) for p, n in out.bound] == [("exec-1", "n0")]
+    # batch tier consumed, prod cpu untouched
+    idx = snap.node_id("n0")
+    bcol = snap.config.resources.index(ext.RES_BATCH_CPU)
+    ccol = snap.config.resources.index(ext.RES_CPU)
+    assert snap.nodes.requested[idx, bcol] == 20_000
+    assert snap.nodes.requested[idx, ccol] == 0
+
+    # an oversized BE pod is rejected by the batch tier, even though raw
+    # cpu would have fit
+    big = Pod(
+        meta=ObjectMeta(name="exec-2", labels={"spark-role": "executor"}),
+        spec=PodSpec(requests={ext.RES_CPU: 50_000, ext.RES_MEMORY: 50_000}),
+    )
+    mutator.admit(big)
+    out2 = sched.schedule([big])
+    assert out2.bound == []
