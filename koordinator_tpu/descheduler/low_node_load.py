@@ -58,6 +58,7 @@ class LowNodeLoad:
         self.snapshot = snapshot
         self.args = args or LowNodeLoadArgs()
         self._over_counts: Dict[int, int] = {}
+        self._last_cls: Optional[NodeClassification] = None
 
     def _vec(self, table: Mapping[str, float]) -> np.ndarray:
         return np.array(
@@ -65,7 +66,10 @@ class LowNodeLoad:
             np.float32,
         )
 
-    def classify(self) -> NodeClassification:
+    def classify(self, update_debounce: bool = True) -> NodeClassification:
+        """Classify nodes; ``update_debounce=True`` advances the anomaly
+        counters (call once per descheduling round). ``peek`` via
+        update_debounce=False is side-effect-free."""
         na = self.snapshot.nodes
         alloc = np.maximum(na.allocatable, 1e-9)
         used = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
@@ -87,15 +91,21 @@ class LowNodeLoad:
         # debounce
         high = np.zeros_like(raw_high)
         for idx in np.nonzero(raw_high)[0]:
-            self._over_counts[idx] = self._over_counts.get(idx, 0) + 1
-            if self._over_counts[idx] >= self.args.anomaly_condition_count:
+            count = self._over_counts.get(idx, 0) + (1 if update_debounce else 0)
+            if update_debounce:
+                self._over_counts[idx] = count
+            if count >= self.args.anomaly_condition_count:
                 high[idx] = True
-        for idx in list(self._over_counts):
-            if not raw_high[idx]:
-                del self._over_counts[idx]
-        return NodeClassification(
+        if update_debounce:
+            for idx in list(self._over_counts):
+                if not raw_high[idx]:
+                    del self._over_counts[idx]
+        cls = NodeClassification(
             low=low, high=high, raw_high=raw_high, utilization=util
         )
+        if update_debounce:
+            self._last_cls = cls
+        return cls
 
     def select_victims(
         self, bound_pods: Sequence[Pod], classification: Optional[NodeClassification] = None
@@ -106,7 +116,10 @@ class LowNodeLoad:
         then largest estimated usage — and only pods that fit on at least
         one low node (utilization_util.go's sortPodsOnOneOverloadedNode).
         """
-        cls = classification or self.classify()
+        # reuse this round's classification when the caller already ran
+        # classify() — selecting victims must not advance the debounce
+        # counters a second time
+        cls = classification or self._last_cls or self.classify()
         if not cls.high.any() or not cls.low.any():
             return []
         cfg = self.snapshot.config

@@ -1,0 +1,301 @@
+"""Metrics-advisor collectors: node cpu/memory/PSI via the native library.
+
+Rebuild of ``pkg/koordlet/metricsadvisor/`` (``framework/plugin.go:28-45``
+Collector interface + the 12 collectors under ``collectors/``): each
+collector samples a source on a timer and appends to the MetricCache. The
+procfs/PSI readers are the native C++ component
+(``runtime/csrc/telemetry.cpp``, the analog of the reference's cgo→libpfm4
+binding) loaded over ctypes, with a pure-Python fallback when the shared
+library hasn't been built (or on non-Linux dev machines).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import metriccache as mc
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "runtime",
+    "build",
+    "libkoordtelemetry.so",
+)
+
+
+class _CpuTimes(ctypes.Structure):
+    _fields_ = [
+        (name, ctypes.c_double)
+        for name in (
+            "user",
+            "nice_",
+            "system_",
+            "idle",
+            "iowait",
+            "irq",
+            "softirq",
+            "steal",
+        )
+    ]
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.koord_read_cpu_times.argtypes = [ctypes.POINTER(_CpuTimes)]
+    lib.koord_read_cpu_times.restype = ctypes.c_int
+    lib.koord_read_meminfo.argtypes = [
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.koord_read_meminfo.restype = ctypes.c_int
+    lib.koord_read_psi.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.koord_read_psi.restype = ctypes.c_int
+    lib.koord_read_cgroup_cpu_ns.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.koord_read_cgroup_cpu_ns.restype = ctypes.c_int
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+def native_available() -> bool:
+    return _NATIVE is not None
+
+
+@dataclasses.dataclass
+class CpuTimes:
+    busy: float
+    total: float
+
+
+def read_cpu_times() -> Optional[CpuTimes]:
+    if _NATIVE is not None:
+        out = _CpuTimes()
+        if _NATIVE.koord_read_cpu_times(ctypes.byref(out)) == 0:
+            busy = (
+                out.user
+                + out.nice_
+                + out.system_
+                + out.irq
+                + out.softirq
+                + out.steal
+            )
+            total = busy + out.idle + out.iowait
+            return CpuTimes(busy=busy, total=total)
+        return None
+    try:
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("cpu "):
+                    v = [float(x) for x in line.split()[1:9]]
+                    busy = v[0] + v[1] + v[2] + v[5] + v[6] + v[7]
+                    return CpuTimes(busy=busy, total=busy + v[3] + v[4])
+    except OSError:
+        pass
+    return None
+
+
+def read_meminfo() -> Optional[Tuple[float, float]]:
+    """(total_mib, available_mib)."""
+    if _NATIVE is not None:
+        total = ctypes.c_double()
+        avail = ctypes.c_double()
+        if (
+            _NATIVE.koord_read_meminfo(
+                ctypes.byref(total), ctypes.byref(avail)
+            )
+            == 0
+        ):
+            return total.value / 1024.0, avail.value / 1024.0
+        return None
+    try:
+        total = avail = None
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = float(line.split()[1]) / 1024.0
+                elif line.startswith("MemAvailable:"):
+                    avail = float(line.split()[1]) / 1024.0
+        if total is not None and avail is not None:
+            return total, avail
+    except OSError:
+        pass
+    return None
+
+
+def read_psi(resource: str) -> Optional[Tuple[float, float]]:
+    """(some_avg10, full_avg10) from /proc/pressure/<resource>."""
+    if _NATIVE is not None:
+        some = ctypes.c_double()
+        full = ctypes.c_double()
+        if (
+            _NATIVE.koord_read_psi(
+                resource.encode(), ctypes.byref(some), ctypes.byref(full)
+            )
+            == 0
+        ):
+            return some.value, full.value
+        return None
+    try:
+        some = full = 0.0
+        with open(f"/proc/pressure/{resource}") as f:
+            found = False
+            for line in f:
+                parts = dict(
+                    kv.split("=") for kv in line.split()[1:] if "=" in kv
+                )
+                if line.startswith("some"):
+                    some = float(parts.get("avg10", 0.0))
+                    found = True
+                elif line.startswith("full"):
+                    full = float(parts.get("avg10", 0.0))
+        return (some, full) if found else None
+    except OSError:
+        return None
+
+
+def read_cgroup_cpu_ns(root: str, group: str) -> Optional[float]:
+    """Cumulative cpu usage of a cgroup in nanoseconds (v1 cpuacct.usage
+    or v2 cpu.stat usage_usec)."""
+    if _NATIVE is not None and hasattr(_NATIVE, "koord_read_cgroup_cpu_ns"):
+        out = ctypes.c_double()
+        if (
+            _NATIVE.koord_read_cgroup_cpu_ns(
+                root.encode(), group.encode(), ctypes.byref(out)
+            )
+            == 0
+        ):
+            return out.value
+        return None
+    for path, scale in (
+        (os.path.join(root, group, "cpuacct.usage"), 1.0),
+        (os.path.join(root, group, "cpu.stat"), 1000.0),
+    ):
+        try:
+            with open(path) as f:
+                if path.endswith("cpuacct.usage"):
+                    return float(f.read().strip()) * scale
+                for line in f:
+                    if line.startswith("usage_usec"):
+                        return float(line.split()[1]) * scale
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def read_cgroup_memory_mib(root: str, group: str) -> Optional[float]:
+    for name in ("memory.current", "memory.usage_in_bytes"):
+        try:
+            with open(os.path.join(root, group, name)) as f:
+                return float(f.read().strip()) / (1024.0 * 1024.0)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+class BETierCollector:
+    """beresource collector: the BE tier cgroup's cpu/memory usage
+    (collectors/beresource). Prod usage is derived as node − BE — exact
+    when the tiers partition all pods, which is how the reference's
+    kubepods hierarchy is laid out."""
+
+    BE_GROUP = "kubepods/besteffort"
+
+    def __init__(self, cache: mc.MetricCache, cgroup_root: str):
+        self.cache = cache
+        self.cgroup_root = cgroup_root
+        self._last: Optional[Tuple[float, float]] = None  # (ts, cpu_ns)
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        ok = False
+        cpu_ns = read_cgroup_cpu_ns(self.cgroup_root, self.BE_GROUP)
+        if cpu_ns is not None:
+            if self._last is not None:
+                last_ts, last_ns = self._last
+                dt = now - last_ts
+                if dt > 0 and cpu_ns >= last_ns:
+                    milli = (cpu_ns - last_ns) / dt / 1e6  # ns/s → milli-cores
+                    self.cache.append(mc.BE_CPU_USAGE, "node", now, milli)
+                    ok = True
+            self._last = (now, cpu_ns)
+        mem = read_cgroup_memory_mib(self.cgroup_root, self.BE_GROUP)
+        if mem is not None:
+            self.cache.append("be_memory_usage", "node", now, mem)
+            ok = True
+        return ok
+
+
+class NodeResourceCollector:
+    """noderesource collector: cpu (delta of jiffies → milli-cores) and
+    memory usage into the cache (collectors/noderesource)."""
+
+    def __init__(self, cache: mc.MetricCache, n_cpus: Optional[int] = None):
+        self.cache = cache
+        self.n_cpus = n_cpus or os.cpu_count() or 1
+        self._last: Optional[Tuple[float, CpuTimes]] = None
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        times = read_cpu_times()
+        mem = read_meminfo()
+        ok = False
+        if times is not None:
+            if self._last is not None:
+                _last_ts, last = self._last
+                dbusy = times.busy - last.busy
+                dtotal = times.total - last.total
+                if dtotal > 0:
+                    util = max(min(dbusy / dtotal, 1.0), 0.0)
+                    self.cache.append(
+                        mc.NODE_CPU_USAGE,
+                        "node",
+                        now,
+                        util * self.n_cpus * 1000.0,
+                    )
+                    ok = True
+            self._last = (now, times)
+        if mem is not None:
+            total, avail = mem
+            self.cache.append(
+                mc.NODE_MEMORY_USAGE, "node", now, max(total - avail, 0.0)
+            )
+            ok = True
+        return ok
+
+
+class PerformanceCollector:
+    """performance collector: PSI pressure gauges (the CPI half of the
+    reference needs perf_event_open privileges; PSI is the portable part)."""
+
+    def __init__(self, cache: mc.MetricCache):
+        self.cache = cache
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        ok = False
+        for resource, metric in (
+            ("cpu", mc.NODE_PSI_CPU),
+            ("memory", mc.NODE_PSI_MEM),
+            ("io", mc.NODE_PSI_IO),
+        ):
+            psi = read_psi(resource)
+            if psi is not None:
+                self.cache.append(metric, "node", now, psi[0])
+                ok = True
+        return ok

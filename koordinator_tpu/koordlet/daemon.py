@@ -1,0 +1,235 @@
+"""Koordlet daemon: wiring of collectors → cache → reporter → QoS loops.
+
+Rebuild of ``pkg/koordlet/koordlet.go:63-210`` (construct in dependency
+order: executor → metriccache → statesinformer → metricsadvisor →
+predictServer → qosmanager → runtimehooks) and the NodeMetric reporter
+(``statesinformer/impl/states_nodemetric.go:212``: every report interval,
+aggregate the TSDB window into NodeMetric.status).
+
+The daemon is tick-driven rather than timer-thread-driven so tests (and
+the simulator) advance it deterministically; ``run()`` wraps ticks in a
+wall-clock loop for real deployment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import (
+    AGG_TYPES,
+    NodeMetric,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    ResourceMetric,
+)
+from ..api import extension as ext
+from . import collectors as col
+from . import metriccache as mc
+from . import qosmanager as qos
+from . import resourceexecutor as rex
+from . import runtimehooks as hooks
+from .prediction import PeakPredictor
+
+
+@dataclasses.dataclass
+class KoordletConfig:
+    node_name: str = "node-local"
+    collect_interval_s: float = 1.0
+    report_interval_s: float = 60.0          # states_nodemetric.go:61-66
+    aggregate_window_s: float = 300.0
+    cgroup_root: str = "/sys/fs/cgroup"
+    n_cpus: Optional[int] = None
+    node_allocatable_milli: float = 0.0      # 0 = n_cpus × 1000
+    node_memory_capacity_mib: float = 0.0
+
+
+class NodeMetricReporter:
+    """Aggregates the cache window into a NodeMetric object."""
+
+    def __init__(self, cache: mc.MetricCache, config: KoordletConfig):
+        self.cache = cache
+        self.config = config
+
+    def report(self, now: Optional[float] = None) -> Optional[NodeMetric]:
+        now = now if now is not None else time.time()
+        start = now - self.config.aggregate_window_s
+        cpu = self.cache.aggregate(mc.NODE_CPU_USAGE, "node", start, now)
+        mem = self.cache.aggregate(mc.NODE_MEMORY_USAGE, "node", start, now)
+        if cpu is None and mem is None:
+            return None
+
+        def usage(res, agg):
+            return {} if agg is None else {res: agg.avg}
+
+        aggregated = {}
+        for pct in AGG_TYPES:
+            aggregated[pct] = ResourceMetric(
+                usage={
+                    **(
+                        {ext.RES_CPU: cpu.percentiles[pct]}
+                        if cpu is not None
+                        else {}
+                    ),
+                    **(
+                        {ext.RES_MEMORY: mem.percentiles[pct]}
+                        if mem is not None
+                        else {}
+                    ),
+                }
+            )
+        prod_cpu = self.cache.aggregate(mc.PROD_CPU_USAGE, "node", start, now)
+        prod_mem = self.cache.aggregate(mc.PROD_MEMORY_USAGE, "node", start, now)
+        return NodeMetric(
+            meta=ObjectMeta(name=self.config.node_name),
+            node_usage=ResourceMetric(
+                usage={
+                    **usage(ext.RES_CPU, cpu),
+                    **usage(ext.RES_MEMORY, mem),
+                }
+            ),
+            prod_usage=ResourceMetric(
+                usage={
+                    **usage(ext.RES_CPU, prod_cpu),
+                    **usage(ext.RES_MEMORY, prod_mem),
+                }
+            ),
+            aggregated=aggregated,
+            update_time=now,
+            report_interval_s=self.config.report_interval_s,
+            aggregate_window_s=self.config.aggregate_window_s,
+        )
+
+
+class Koordlet:
+    """The node agent. Construction order mirrors koordlet.go:75-137."""
+
+    def __init__(self, config: Optional[KoordletConfig] = None):
+        self.config = config or KoordletConfig()
+        import os
+
+        n_cpus = self.config.n_cpus or os.cpu_count() or 1
+        alloc_milli = self.config.node_allocatable_milli or n_cpus * 1000.0
+        mem_cap = self.config.node_memory_capacity_mib
+        if mem_cap <= 0:
+            info = col.read_meminfo()
+            mem_cap = info[0] if info else 1024.0
+
+        self.executor = rex.ResourceExecutor(self.config.cgroup_root)
+        self.metric_cache = mc.MetricCache()
+        self.collectors = [
+            col.NodeResourceCollector(self.metric_cache, n_cpus),
+            col.PerformanceCollector(self.metric_cache),
+            col.BETierCollector(self.metric_cache, self.config.cgroup_root),
+        ]
+        self.predictor = PeakPredictor()
+        self.reporter = NodeMetricReporter(self.metric_cache, self.config)
+        self.qos = qos.QoSManager(
+            self.executor,
+            total_cpus=n_cpus,
+            node_allocatable_milli=alloc_milli,
+            node_memory_capacity_mib=mem_cap,
+        )
+        self.reconciler = hooks.Reconciler(self.executor)
+        self.node_slo: NodeSLO = NodeSLO(meta=ObjectMeta(name=self.config.node_name))
+        self.pods: List[Pod] = []
+        self._last_report = 0.0
+
+    # ---- state inputs (statesinformer callbacks) ----
+
+    def update_node_slo(self, slo: NodeSLO) -> None:
+        self.node_slo = slo
+
+    def update_pods(self, pods: Sequence[Pod]) -> None:
+        self.pods = list(pods)
+        self.reconciler.reconcile(self.pods)
+
+    # ---- loops ----
+
+    def collect_tick(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        for collector in self.collectors:
+            collector.collect(now)
+        latest = self.metric_cache.latest(mc.NODE_CPU_USAGE, "node")
+        if latest is not None:
+            self.predictor.observe(f"node/{self.config.node_name}", latest[1], now)
+        # derive prod tier = node − BE (exact when the kubepods hierarchy
+        # partitions pods into tiers, as the reference's layout does)
+        be = self.metric_cache.latest(mc.BE_CPU_USAGE, "node")
+        if latest is not None:
+            be_v = be[1] if be is not None and be[0] >= latest[0] - 5 else 0.0
+            self.metric_cache.append(
+                mc.PROD_CPU_USAGE, "node", now, max(latest[1] - be_v, 0.0)
+            )
+        node_mem = self.metric_cache.latest(mc.NODE_MEMORY_USAGE, "node")
+        be_mem = self.metric_cache.latest("be_memory_usage", "node")
+        if node_mem is not None:
+            be_v = (
+                be_mem[1]
+                if be_mem is not None and be_mem[0] >= node_mem[0] - 5
+                else 0.0
+            )
+            self.metric_cache.append(
+                mc.PROD_MEMORY_USAGE, "node", now, max(node_mem[1] - be_v, 0.0)
+            )
+
+    def qos_tick(self, now: Optional[float] = None) -> Dict[str, object]:
+        now = now if now is not None else time.time()
+        window = now - 30.0
+        cpu = self.metric_cache.aggregate(mc.NODE_CPU_USAGE, "node", window, now)
+        mem = self.metric_cache.aggregate(mc.NODE_MEMORY_USAGE, "node", window, now)
+        be = self.metric_cache.aggregate(mc.BE_CPU_USAGE, "node", window, now)
+        be_pods = [p for p in self.pods if p.qos == ext.QoSClass.BE]
+        be_pods_mem = [
+            (
+                p.meta.uid,
+                p.spec.requests.get(ext.RES_BATCH_MEMORY, 0.0),
+                p.spec.priority or 0,
+            )
+            for p in be_pods
+        ]
+        be_pods_cpu = [
+            (
+                p.meta.uid,
+                p.spec.requests.get(
+                    ext.RES_BATCH_CPU, p.spec.requests.get(ext.RES_CPU, 0.0)
+                ),
+                p.spec.priority or 0,
+            )
+            for p in be_pods
+        ]
+        from . import runtimehooks as hooks
+
+        ls_pod_limits = [
+            (hooks.pod_cgroup(p), p.spec.limits.get(ext.RES_CPU, 0.0))
+            for p in self.pods
+            if p.qos == ext.QoSClass.LS and p.spec.limits.get(ext.RES_CPU, 0.0) > 0
+        ]
+        return self.qos.run_once(
+            self.node_slo,
+            node_used_milli=cpu.avg if cpu else 0.0,
+            be_used_milli=be.avg if be else 0.0,
+            node_memory_used_mib=mem.avg if mem else 0.0,
+            be_pods_mem=be_pods_mem,
+            be_pods_cpu=be_pods_cpu,
+            ls_pod_limits=ls_pod_limits,
+        )
+
+    def report_tick(self, now: Optional[float] = None) -> Optional[NodeMetric]:
+        now = now if now is not None else time.time()
+        if now - self._last_report < self.config.report_interval_s:
+            return None
+        self._last_report = now
+        return self.reporter.report(now)
+
+    def run(self, duration_s: float = float("inf")) -> None:
+        """Wall-clock loop for real deployment."""
+        deadline = time.time() + duration_s
+        while time.time() < deadline:
+            now = time.time()
+            self.collect_tick(now)
+            self.qos_tick(now)
+            self.report_tick(now)
+            time.sleep(self.config.collect_interval_s)

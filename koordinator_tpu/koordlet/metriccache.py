@@ -1,0 +1,153 @@
+"""Metric cache: in-memory TSDB with vectorized percentile aggregation.
+
+Rebuild of ``pkg/koordlet/metriccache/`` (``tsdb_storage.go:28-115`` embeds
+a Prometheus TSDB; ``kv_storage.go`` holds latest values): here a fixed-size
+numpy ring buffer per series gives O(1) append and vectorized window
+queries — the percentile aggregation the reference computes per query
+(p50/p90/p95/p99 for NodeMetric, ``states_nodemetric.go``) is one
+``np.percentile`` call over the window slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.types import AGG_TYPES
+
+#: metric ids (reference metric_resources.go typed resources)
+NODE_CPU_USAGE = "node_cpu_usage"          # milli-cores
+NODE_MEMORY_USAGE = "node_memory_usage"    # MiB
+POD_CPU_USAGE = "pod_cpu_usage"
+POD_MEMORY_USAGE = "pod_memory_usage"
+BE_CPU_USAGE = "be_cpu_usage"
+PROD_CPU_USAGE = "prod_cpu_usage"
+PROD_MEMORY_USAGE = "prod_memory_usage"
+NODE_CPI = "node_cpi"                      # cycles per instruction
+NODE_PSI_CPU = "node_psi_cpu_some_avg10"
+NODE_PSI_MEM = "node_psi_mem_some_avg10"
+NODE_PSI_IO = "node_psi_io_some_avg10"
+
+
+class _Ring:
+    __slots__ = ("ts", "values", "head", "count")
+
+    def __init__(self, capacity: int):
+        self.ts = np.zeros(capacity, np.float64)
+        self.values = np.zeros(capacity, np.float32)
+        self.head = 0
+        self.count = 0
+
+    def append(self, ts: float, value: float) -> None:
+        cap = self.ts.shape[0]
+        self.ts[self.head] = ts
+        self.values[self.head] = value
+        self.head = (self.head + 1) % cap
+        self.count = min(self.count + 1, cap)
+
+    def window(self, start: float, end: float) -> np.ndarray:
+        mask = (self.ts >= start) & (self.ts <= end)
+        if self.count < self.ts.shape[0]:
+            valid = np.zeros_like(mask)
+            valid[: self.count] = True
+            mask &= valid
+        return self.values[mask]
+
+    def latest(self) -> Optional[Tuple[float, float]]:
+        if self.count == 0:
+            return None
+        idx = (self.head - 1) % self.ts.shape[0]
+        return float(self.ts[idx]), float(self.values[idx])
+
+
+@dataclasses.dataclass
+class AggregateResult:
+    avg: float
+    count: int
+    percentiles: Dict[str, float]
+
+
+class MetricCache:
+    """Thread-safe series store keyed by (metric, subject)."""
+
+    def __init__(self, capacity_per_series: int = 4096):
+        self.capacity = capacity_per_series
+        self._series: Dict[Tuple[str, str], _Ring] = {}
+        self._kv: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _ring(self, metric: str, subject: str) -> _Ring:
+        key = (metric, subject)
+        ring = self._series.get(key)
+        if ring is None:
+            ring = _Ring(self.capacity)
+            self._series[key] = ring
+        return ring
+
+    def append(
+        self, metric: str, subject: str, ts: float, value: float
+    ) -> None:
+        with self._lock:
+            self._ring(metric, subject).append(ts, value)
+
+    def append_many(
+        self, samples: Sequence[Tuple[str, str, float, float]]
+    ) -> None:
+        with self._lock:
+            for metric, subject, ts, value in samples:
+                self._ring(metric, subject).append(ts, value)
+
+    def latest(self, metric: str, subject: str) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            ring = self._series.get((metric, subject))
+            return ring.latest() if ring else None
+
+    def aggregate(
+        self,
+        metric: str,
+        subject: str,
+        start: float,
+        end: float,
+        percentiles: Sequence[str] = AGG_TYPES,
+    ) -> Optional[AggregateResult]:
+        """Windowed aggregate: avg + requested percentiles (p50..p99)."""
+        with self._lock:
+            ring = self._series.get((metric, subject))
+            if ring is None:
+                return None
+            values = ring.window(start, end)
+        if values.size == 0:
+            return None
+        pcts = [float(p[1:]) for p in percentiles]
+        results = np.percentile(values, pcts) if pcts else []
+        return AggregateResult(
+            avg=float(values.mean()),
+            count=int(values.size),
+            percentiles={
+                name: float(v) for name, v in zip(percentiles, results)
+            },
+        )
+
+    # KV store (reference kv_storage.go) for non-timeseries state
+    def set_kv(self, key: str, value: object) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def get_kv(self, key: str) -> Optional[object]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def gc(self, before: float) -> int:
+        """Drop series whose newest sample predates ``before``."""
+        with self._lock:
+            dead = [
+                k
+                for k, ring in self._series.items()
+                if (ring.latest() or (0.0, 0.0))[0] < before
+            ]
+            for k in dead:
+                del self._series[k]
+            return len(dead)

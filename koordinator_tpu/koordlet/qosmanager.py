@@ -1,0 +1,248 @@
+"""QoS manager: BE suppression / eviction / burst strategies.
+
+Rebuild of ``pkg/koordlet/qosmanager/`` strategy plugins:
+  * CPUSuppress (``plugins/cpusuppress/cpu_suppress.go:100-108``):
+    shrink the BE tier's cpuset/cfs quota so prod keeps headroom:
+        beAllowance = nodeAllocatable × threshold% − (nodeUsed − beUsed)
+  * CPUEvict / MemoryEvict (``cpuevict``, ``memoryevict``): evict BE pods
+    when BE satisfaction or node memory utilization crosses thresholds.
+  * CPUBurst (``cpuburst``): grant cfs burst to latency-sensitive pods.
+
+Each strategy is a pure decision function (fixture-testable exactly like
+the reference's table-driven tests) plus a thin applier that renders the
+decision into a ResourceExecutor write plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..api.types import NodeSLO
+from . import resourceexecutor as rex
+
+BE_GROUP = "kubepods/besteffort"
+
+
+@dataclasses.dataclass
+class CPUSuppressDecision:
+    #: BE tier cpu allowance in milli-cores (cfs quota basis)
+    be_allowance_milli: float
+    #: number of cpus for the BE cpuset (ceil of allowance)
+    be_cpuset_cpus: int
+    suppressed: bool
+
+
+def cpu_suppress(
+    node_allocatable_milli: float,
+    node_used_milli: float,
+    be_used_milli: float,
+    threshold_percent: float,
+    min_be_cpus: int = 1,
+) -> CPUSuppressDecision:
+    """``suppressBECPU`` (cpu_suppress.go): the BE tier may use what is left
+    of the suppression budget after non-BE usage."""
+    budget = node_allocatable_milli * threshold_percent / 100.0
+    non_be_used = max(node_used_milli - be_used_milli, 0.0)
+    allowance = max(budget - non_be_used, min_be_cpus * 1000.0)
+    n_cpus = max(int(-(-allowance // 1000)), min_be_cpus)  # ceil
+    return CPUSuppressDecision(
+        be_allowance_milli=allowance,
+        be_cpuset_cpus=n_cpus,
+        suppressed=allowance < node_allocatable_milli,
+    )
+
+
+def cpu_suppress_plan(
+    decision: CPUSuppressDecision,
+    total_cpus: int,
+    period_us: int = 100_000,
+) -> List[Tuple[str, str, str]]:
+    """Render the decision as cgroup writes: cfs quota + cpuset width."""
+    quota = int(decision.be_allowance_milli / 1000.0 * period_us)
+    cpus = min(decision.be_cpuset_cpus, total_cpus)
+    cpuset = f"0-{cpus - 1}" if cpus > 1 else "0"
+    return [
+        (BE_GROUP, rex.CPU_CFS_PERIOD, str(period_us)),
+        (BE_GROUP, rex.CPU_CFS_QUOTA, str(quota)),
+        (BE_GROUP, rex.CPUSET_CPUS, cpuset),
+    ]
+
+
+@dataclasses.dataclass
+class EvictDecision:
+    evict: bool
+    victims: List[str]          # pod uids, lowest priority first
+    reason: str = ""
+
+
+def memory_evict(
+    node_memory_used_mib: float,
+    node_memory_capacity_mib: float,
+    threshold_percent: float,
+    lower_percent: Optional[float],
+    be_pods: Sequence[Tuple[str, float, int]],  # (uid, mem_mib, priority)
+) -> EvictDecision:
+    """``memoryevict``: when node memory crosses the threshold, evict BE
+    pods (lowest priority, largest usage first) until below the lower
+    watermark (default threshold − 2, reference memory_evict.go)."""
+    if node_memory_capacity_mib <= 0:
+        return EvictDecision(False, [])
+    util = node_memory_used_mib * 100.0 / node_memory_capacity_mib
+    if util < threshold_percent:
+        return EvictDecision(False, [])
+    lower = lower_percent if lower_percent is not None else threshold_percent - 2.0
+    target_mib = node_memory_capacity_mib * lower / 100.0
+    victims: List[str] = []
+    used = node_memory_used_mib
+    for uid, mem, _prio in sorted(be_pods, key=lambda x: (x[2], -x[1])):
+        if used <= target_mib:
+            break
+        victims.append(uid)
+        used -= mem
+    return EvictDecision(
+        bool(victims),
+        victims,
+        reason=f"node memory {util:.1f}% >= {threshold_percent:.1f}%",
+    )
+
+
+def cpu_evict(
+    be_cpu_request_milli: float,
+    be_cpu_usage_milli: float,
+    be_cpu_limit_milli: float,
+    satisfaction_threshold: float,
+    usage_threshold_percent: float,
+    be_pods: Sequence[Tuple[str, float, int]],
+) -> EvictDecision:
+    """``cpuevict``: evict when BE satisfaction (limit/request) collapses
+    below threshold while BE usage saturates its shrunken limit."""
+    if be_cpu_request_milli <= 0 or be_cpu_limit_milli <= 0:
+        return EvictDecision(False, [])
+    satisfaction = be_cpu_limit_milli / be_cpu_request_milli
+    usage_ratio = be_cpu_usage_milli * 100.0 / be_cpu_limit_milli
+    if satisfaction >= satisfaction_threshold or usage_ratio < usage_threshold_percent:
+        return EvictDecision(False, [])
+    # release enough BE request to restore satisfaction
+    need_release = be_cpu_request_milli - be_cpu_limit_milli / satisfaction_threshold
+    victims: List[str] = []
+    released = 0.0
+    for uid, req, _prio in sorted(be_pods, key=lambda x: (x[2], -x[1])):
+        if released >= need_release:
+            break
+        victims.append(uid)
+        released += req
+    return EvictDecision(
+        bool(victims),
+        victims,
+        reason=f"BE satisfaction {satisfaction:.2f} < {satisfaction_threshold:.2f}",
+    )
+
+
+def cpu_burst_plan(
+    pod_group: str,
+    cpu_limit_milli: float,
+    burst_percent: float,
+    period_us: int = 100_000,
+) -> List[Tuple[str, str, str]]:
+    """``cpuburst``: grant cfs burst of burst_percent × limit."""
+    burst_us = int(cpu_limit_milli / 1000.0 * period_us * burst_percent / 100.0)
+    return [(pod_group, rex.CPU_BURST, str(burst_us))]
+
+
+from typing import Callable
+
+
+class QoSManager:
+    """Timer-driven strategy runner wiring NodeSLO → decisions → executor.
+
+    ``evict_cb`` performs the actual eviction (kills the pod / calls the
+    eviction API); the manager dedups so a pod is evicted once even while
+    the pressure condition persists across ticks.
+    """
+
+    def __init__(
+        self,
+        executor: rex.ResourceExecutor,
+        total_cpus: int,
+        node_allocatable_milli: float,
+        node_memory_capacity_mib: float,
+        evict_cb: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.executor = executor
+        self.total_cpus = total_cpus
+        self.node_allocatable_milli = node_allocatable_milli
+        self.node_memory_capacity_mib = node_memory_capacity_mib
+        self.evict_cb = evict_cb
+        self.evicted: List[str] = []
+        self._evicted_set: set = set()
+
+    def _evict(self, victims: Sequence[str], reason: str) -> None:
+        for uid in victims:
+            if uid in self._evicted_set:
+                continue
+            self._evicted_set.add(uid)
+            self.evicted.append(uid)
+            if self.evict_cb is not None:
+                self.evict_cb(uid, reason)
+
+    def run_once(
+        self,
+        slo: NodeSLO,
+        node_used_milli: float,
+        be_used_milli: float,
+        node_memory_used_mib: float,
+        be_pods_mem: Sequence[Tuple[str, float, int]] = (),
+        be_pods_cpu: Sequence[Tuple[str, float, int]] = (),
+        ls_pod_limits: Sequence[Tuple[str, float]] = (),
+    ) -> Dict[str, object]:
+        """One qosmanager tick (the reference runs each strategy on its own
+        wait.Until timer; a single tick keeps tests deterministic).
+
+        be_pods_cpu: (uid, cpu_request_milli, priority) for BE pods;
+        ls_pod_limits: (cgroup, cpu_limit_milli) for burst-eligible pods.
+        """
+        out: Dict[str, object] = {}
+        if slo.threshold.enable:
+            dec = cpu_suppress(
+                self.node_allocatable_milli,
+                node_used_milli,
+                be_used_milli,
+                slo.threshold.cpu_suppress_threshold_percent,
+            )
+            self.executor.apply(
+                cpu_suppress_plan(dec, self.total_cpus), reason="cpusuppress"
+            )
+            out["cpu_suppress"] = dec
+            mev = memory_evict(
+                node_memory_used_mib,
+                self.node_memory_capacity_mib,
+                slo.threshold.memory_evict_threshold_percent,
+                slo.threshold.memory_evict_lower_percent,
+                be_pods_mem,
+            )
+            if mev.evict:
+                self._evict(mev.victims, mev.reason)
+            out["memory_evict"] = mev
+            # BE satisfaction collapse → CPU eviction (cpuevict)
+            be_request = sum(req for _, req, _ in be_pods_cpu)
+            cev = cpu_evict(
+                be_cpu_request_milli=be_request,
+                be_cpu_usage_milli=be_used_milli,
+                be_cpu_limit_milli=dec.be_allowance_milli,
+                satisfaction_threshold=0.6,
+                usage_threshold_percent=slo.threshold.cpu_evict_be_usage_threshold_percent,
+                be_pods=be_pods_cpu,
+            )
+            if cev.evict:
+                self._evict(cev.victims, cev.reason)
+            out["cpu_evict"] = cev
+        if slo.cpu_burst.policy != "none":
+            for group, limit_milli in ls_pod_limits:
+                self.executor.apply(
+                    cpu_burst_plan(
+                        group, limit_milli, slo.cpu_burst.cpu_burst_percent
+                    ),
+                    reason="cpuburst",
+                )
+        return out

@@ -1,0 +1,136 @@
+"""Resource executor: serialized, audited cgroup reads/writes.
+
+Rebuild of ``pkg/koordlet/resourceexecutor/`` (``executor.go``,
+``updater.go`` merge/leveled updates, ``cgroup.go``) + the audit subsystem
+(``pkg/koordlet/audit/auditor.go:56,130-160,230``): every cgroup mutation
+goes through one executor that caches current values (skip no-op writes),
+records an audit event in a ring buffer, and writes through a pluggable
+cgroup root — tests point it at a temp dir exactly like the reference's
+fake cgroupfs helpers (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from collections import deque
+
+# cgroup v1-style resource files (reference util/system resource types)
+CPU_SHARES = "cpu.shares"
+CPU_CFS_QUOTA = "cpu.cfs_quota_us"
+CPU_CFS_PERIOD = "cpu.cfs_period_us"
+CPU_BURST = "cpu.cfs_burst_us"
+CPU_BVT = "cpu.bvt_warp_ns"            # group identity (Anolis bvt)
+CPUSET_CPUS = "cpuset.cpus"
+MEMORY_LIMIT = "memory.limit_in_bytes"
+MEMORY_WMARK_RATIO = "memory.wmark_ratio"
+CORE_SCHED_COOKIE = "core_sched.cookie"
+
+
+@dataclasses.dataclass
+class AuditEvent:
+    ts: float
+    group: str       # cgroup relative dir (e.g. kubepods/burstable/pod-x)
+    file: str
+    old: Optional[str]
+    new: str
+    reason: str
+
+
+class Auditor:
+    """Ring-buffer audit log with query API (auditor.go)."""
+
+    def __init__(self, capacity: int = 2048):
+        self._events: Deque[AuditEvent] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, event: AuditEvent) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def query(
+        self, since: float = 0.0, group_prefix: str = ""
+    ) -> List[AuditEvent]:
+        with self._lock:
+            return [
+                e
+                for e in self._events
+                if e.ts >= since and e.group.startswith(group_prefix)
+            ]
+
+
+class ResourceExecutor:
+    """Cached, audited writer over a cgroup filesystem root."""
+
+    def __init__(self, cgroup_root: str, auditor: Optional[Auditor] = None):
+        self.cgroup_root = cgroup_root
+        self.auditor = auditor or Auditor()
+        self._cache: Dict[Tuple[str, str], str] = {}
+        self._lock = threading.Lock()
+
+    def _path(self, group: str, file: str) -> str:
+        return os.path.join(self.cgroup_root, group, file)
+
+    def read(self, group: str, file: str) -> Optional[str]:
+        try:
+            with open(self._path(group, file)) as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    def write(
+        self, group: str, file: str, value: str, reason: str = ""
+    ) -> bool:
+        """Write-through with no-op suppression; returns True if written."""
+        value = str(value)
+        with self._lock:
+            key = (group, file)
+            cached = self._cache.get(key)
+            if cached is None:
+                cached = self.read(group, file)
+            if cached == value:
+                self._cache[key] = value
+                return False
+            path = self._path(group, file)
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "w") as f:
+                    f.write(value)
+            except OSError as e:
+                # a kernel/cgroup rejection (EINVAL on cpuset, missing
+                # cfs_burst support, …) must not kill the QoS loops —
+                # record it and move on (the reference logs + continues)
+                self.auditor.record(
+                    AuditEvent(
+                        ts=time.time(),
+                        group=group,
+                        file=file,
+                        old=cached,
+                        new=value,
+                        reason=f"WRITE-FAILED: {e}",
+                    )
+                )
+                return False
+            self._cache[key] = value
+            self.auditor.record(
+                AuditEvent(
+                    ts=time.time(),
+                    group=group,
+                    file=file,
+                    old=cached,
+                    new=value,
+                    reason=reason,
+                )
+            )
+            return True
+
+    def apply(self, plan: Sequence[Tuple[str, str, str]], reason: str = "") -> int:
+        """Apply a write plan [(group, file, value)]; returns writes done."""
+        done = 0
+        for group, file, value in plan:
+            if self.write(group, file, value, reason=reason):
+                done += 1
+        return done

@@ -1,0 +1,138 @@
+// Node telemetry collector (native).
+//
+// Rebuild of the reference's single native component — the cgo binding to
+// libpfm4 for perf-based CPI collection
+// (pkg/koordlet/util/perf_group/perf_group_linux.go:39-43) plus the PSI /
+// procfs readers of the performance collector
+// (pkg/koordlet/metricsadvisor/collectors/performance). perf_event_open is
+// unavailable in unprivileged containers, so the hot sources here are the
+// procfs surfaces every collector tick reads: /proc/stat (cpu jiffies),
+// /proc/meminfo, and /proc/pressure/{cpu,memory,io} (PSI). Parsing them in
+// C++ keeps the per-tick cost flat as tick rates rise (the reference runs
+// 12 collectors on 1s-5s timers) and is exposed to Python over ctypes.
+//
+// Build: make -C koordinator_tpu/runtime (produces libkoordtelemetry.so).
+
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+
+extern "C" {
+
+typedef struct {
+  double user, nice_, system_, idle, iowait, irq, softirq, steal;
+} koord_cpu_times;
+
+// Reads the aggregate "cpu " line of /proc/stat in USER_HZ jiffies.
+// Returns 0 on success.
+int koord_read_cpu_times(koord_cpu_times* out) {
+  FILE* f = std::fopen("/proc/stat", "r");
+  if (!f) return -1;
+  char line[512];
+  int rc = -1;
+  while (std::fgets(line, sizeof(line), f)) {
+    if (std::strncmp(line, "cpu ", 4) == 0) {
+      unsigned long long v[8] = {0};
+      int n = std::sscanf(line + 4,
+                          "%llu %llu %llu %llu %llu %llu %llu %llu",
+                          &v[0], &v[1], &v[2], &v[3], &v[4], &v[5], &v[6],
+                          &v[7]);
+      if (n >= 4) {
+        out->user = (double)v[0];
+        out->nice_ = (double)v[1];
+        out->system_ = (double)v[2];
+        out->idle = (double)v[3];
+        out->iowait = (double)v[4];
+        out->irq = (double)v[5];
+        out->softirq = (double)v[6];
+        out->steal = (double)v[7];
+        rc = 0;
+      }
+      break;
+    }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+// MemTotal / MemAvailable in KiB. Returns 0 on success.
+int koord_read_meminfo(double* total_kib, double* available_kib) {
+  FILE* f = std::fopen("/proc/meminfo", "r");
+  if (!f) return -1;
+  char line[256];
+  int found = 0;
+  *total_kib = 0;
+  *available_kib = 0;
+  while (std::fgets(line, sizeof(line), f) && found < 2) {
+    unsigned long long kb;
+    if (std::sscanf(line, "MemTotal: %llu kB", &kb) == 1) {
+      *total_kib = (double)kb;
+      found++;
+    } else if (std::sscanf(line, "MemAvailable: %llu kB", &kb) == 1) {
+      *available_kib = (double)kb;
+      found++;
+    }
+  }
+  std::fclose(f);
+  return found == 2 ? 0 : -1;
+}
+
+// PSI avg10 for "cpu", "memory" or "io". full_avg10 is 0 for cpu (the
+// kernel reports no full line for cpu before 5.13). Returns 0 on success.
+int koord_read_psi(const char* resource, double* some_avg10,
+                   double* full_avg10) {
+  char path[64];
+  std::snprintf(path, sizeof(path), "/proc/pressure/%s", resource);
+  FILE* f = std::fopen(path, "r");
+  if (!f) return -1;
+  char line[256];
+  *some_avg10 = 0;
+  *full_avg10 = 0;
+  int rc = -1;
+  while (std::fgets(line, sizeof(line), f)) {
+    double avg10;
+    if (std::sscanf(line, "some avg10=%lf", &avg10) == 1) {
+      *some_avg10 = avg10;
+      rc = 0;
+    } else if (std::sscanf(line, "full avg10=%lf", &avg10) == 1) {
+      *full_avg10 = avg10;
+    }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+// Per-cgroup cpu usage from cpuacct (v1) or cpu.stat (v2), nanoseconds.
+// root: cgroupfs mount, group: relative dir. Returns 0 on success.
+int koord_read_cgroup_cpu_ns(const char* root, const char* group,
+                             double* usage_ns) {
+  char path[512];
+  std::snprintf(path, sizeof(path), "%s/%s/cpuacct.usage", root, group);
+  FILE* f = std::fopen(path, "r");
+  if (f) {
+    unsigned long long ns = 0;
+    int ok = std::fscanf(f, "%llu", &ns) == 1;
+    std::fclose(f);
+    if (ok) {
+      *usage_ns = (double)ns;
+      return 0;
+    }
+  }
+  std::snprintf(path, sizeof(path), "%s/%s/cpu.stat", root, group);
+  f = std::fopen(path, "r");
+  if (!f) return -1;
+  char line[256];
+  int rc = -1;
+  while (std::fgets(line, sizeof(line), f)) {
+    unsigned long long usec;
+    if (std::sscanf(line, "usage_usec %llu", &usec) == 1) {
+      *usage_ns = (double)usec * 1000.0;
+      rc = 0;
+      break;
+    }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+}  // extern "C"

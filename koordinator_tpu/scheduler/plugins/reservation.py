@@ -131,6 +131,12 @@ class ReservationManager:
         for r in self._reservations.values():
             if r.phase != ReservationPhase.AVAILABLE or r.node_name is None:
                 continue
+            if self.scheduler.snapshot.node_id(r.node_name) is None:
+                # node removed from the cluster: the ghost hold died with
+                # it (remove_node purges assumed pods) — fail the
+                # reservation instead of nominating a dead node
+                r.phase = ReservationPhase.FAILED
+                continue
             if r.allocate_once and r.current_owners:
                 continue
             if not matches_owner(r, pod):
