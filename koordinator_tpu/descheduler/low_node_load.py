@@ -118,8 +118,11 @@ class LowNodeLoad:
         """
         # reuse this round's classification when the caller already ran
         # classify() — selecting victims must not advance the debounce
-        # counters a second time
+        # counters a second time. The cached classification is consumed
+        # (one round = one classify), so a bare select_victims() next round
+        # recomputes instead of acting on stale utilization.
         cls = classification or self._last_cls or self.classify()
+        self._last_cls = None
         if not cls.high.any() or not cls.low.any():
             return []
         cfg = self.snapshot.config

@@ -318,7 +318,9 @@ def _priority_order(pods: PodBatch) -> jnp.ndarray:
     return jnp.argsort(-pods.priority, stable=True).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("max_rounds", "topk"))
+@functools.partial(
+    jax.jit, static_argnames=("max_rounds", "topk", "cost_transform")
+)
 def assign(
     pods: PodBatch,
     nodes: NodeState,
@@ -329,6 +331,7 @@ def assign(
     max_rounds: int = 24,
     round_quantum: float = 0.15,
     topk: int = 8,
+    cost_transform=None,
 ) -> SolveResult:
     """Round-based fast solver. ``round_quantum`` is the fraction of a node's
     allocatable (per dim, measured in estimated usage) it may accept per
@@ -409,6 +412,10 @@ def assign(
         cost = cost_ops.load_aware_cost(
             spods.estimate, est_used, nodes.allocatable, params.score_weights
         )
+        if cost_transform is not None:
+            # BeforeScore transformer chain (frameworkext.interface.go:84-109):
+            # a static, jit-traced rewrite of the cost tensor.
+            cost = cost_transform(cost)
         cost = jnp.where(feas, cost, jnp.inf)
         # Top-K nomination with rank-modular spreading: if every pod
         # nominated its single argmin, one node would absorb the whole

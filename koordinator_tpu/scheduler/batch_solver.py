@@ -90,7 +90,9 @@ class BatchScheduler:
         quotas: Optional["GroupQuotaManager"] = None,
         numa: Optional["NUMAManager"] = None,
         devices: Optional["DeviceManager"] = None,
+        extender: Optional["FrameworkExtender"] = None,
     ):
+        from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
         from .plugins.elasticquota import GroupQuotaManager
 
@@ -107,6 +109,8 @@ class BatchScheduler:
         self.devices = devices
         #: set by plugins.reservation.ReservationManager when attached
         self.reservations = None
+        #: frameworkext spine: transformers, monitor, errors, debug, services
+        self.extender = extender or FrameworkExtender()
         self._params = self.args.solver_params(self.snapshot.config)
         self._scales = self.args.scale_vector(self.snapshot.config)
 
@@ -151,6 +155,15 @@ class BatchScheduler:
     # ---- scheduling cycle ----
 
     def schedule(self, pending: Sequence[Pod]) -> ScheduleOutcome:
+        import time as _time
+
+        fwext = self.extender
+        for pod in pending:
+            fwext.monitor.start_monitor(pod)
+        # BeforePreFilter analog: pod transformers may rewrite or drop.
+        # (Dropped pods are error-handled inside the transformer run.)
+        pending, dropped = fwext.run_pre_batch_transformers(pending)
+        dropped_uids = {p.meta.uid for p in dropped}
         # PreEnqueue gate + gang-adjacent ordering (coscheduling NextPod):
         # whole gangs land in one solver batch.
         # Reservation pre-match: pods owned by an Available reservation
@@ -211,17 +224,64 @@ class BatchScheduler:
         gated = [p for p in pending if p.meta.uid not in eligible_uids]
 
         bound: List[Tuple[Pod, str]] = list(reserved_bound)
-        unsched: List[Pod] = list(gated)
+        unsched: List[Pod] = list(gated) + list(dropped)
         rounds = 0
         for chunk in self._chunks(eligible):
+            t0 = _time.perf_counter()
             result = self.solve(chunk)
             rounds += int(result.rounds_used)
-            b, u = self._commit(chunk, np.asarray(result.assignment))
+            fwext.registry.get("solver_batch_latency_seconds").observe(
+                _time.perf_counter() - t0
+            )
+            assignment = np.asarray(result.assignment)
+            if fwext.scores.top_n > 0:
+                self._debug_capture(chunk, assignment)
+            b, u = self._commit(chunk, assignment)
             bound.extend(b)
             unsched.extend(u)
         for pod, _node in bound:
             self.pod_groups.remove_pod(pod, bound=True)
+        for pod in unsched:
+            if pod.meta.uid not in dropped_uids:
+                fwext.errors.handle(pod, "unschedulable in batch cycle")
+        # The attempt is over for every pod in this cycle, whatever the
+        # outcome — the reference monitor wraps scheduleOne the same way.
+        for pod, _node in bound:
+            fwext.monitor.complete(pod)
+        for pod in unsched:
+            fwext.monitor.complete(pod)
+        from .plugins.coscheduling import gang_key_of
+
+        gated_groups = {gang_key_of(p) for p in gated} - {None}
+        fwext.registry.get("scheduled_pods_total").inc(len(bound))
+        fwext.registry.get("unschedulable_pods_total").inc(len(unsched))
+        fwext.registry.get("waiting_gang_group_number").set(float(len(gated_groups)))
+        fwext.monitor.sweep()
         return ScheduleOutcome(bound=bound, unschedulable=unsched, rounds_used=rounds)
+
+    def _debug_capture(self, chunk: Sequence[Pod], assignment: np.ndarray) -> None:
+        """Host-side recompute of the LoadAware cost for the debug score
+        table (reference /debug/flags/s) — only when dumping is enabled."""
+        na = self.snapshot.nodes
+        est_used = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
+        n_real = self.snapshot.node_count
+        na_alloc = na.allocatable[:n_real]
+        est_used = est_used[:n_real]
+        names = [self.snapshot.node_name(i) for i in range(n_real)]
+        w = np.asarray(self._params.score_weights)
+        costs = np.zeros((len(chunk), n_real), np.float32)
+        for i, pod in enumerate(chunk):
+            est = self.snapshot.config.res_vector(pod.spec.requests) * self._scales
+            after = est_used + est[None, :]
+            free = np.maximum(na_alloc - after, 0.0)
+            per = np.where(na_alloc > 0, free * 100.0 / (na_alloc + 1e-9), 0)
+            costs[i] = -np.sum(per * w, -1) / (np.sum(w) + 1e-9)
+        # Mirror what the solver actually ranked: apply the BeforeScore
+        # chain to the table too.
+        transform = self.extender.cost_transform
+        if transform is not None:
+            costs = np.asarray(transform(costs), np.float32)
+        self.extender.scores.capture(chunk, names, costs, assignment[: len(chunk)])
 
     def _chunks(self, eligible: Sequence[Pod]) -> List[List[Pod]]:
         """Split into solver batches of ~batch_bucket without splitting a
@@ -255,6 +315,8 @@ class BatchScheduler:
     def solve(self, chunk: Sequence[Pod]) -> SolveResult:
         pods = self.pod_batch(chunk)
         nodes = self.node_state()
+        # BeforeFilter analog: device-batch transformers.
+        pods, nodes = self.extender.run_batch_transformers(pods, nodes)
         quotas = self.quota_state(chunk)
         numa_state = None
         if self.numa is not None and self.numa.has_topology:
@@ -281,6 +343,7 @@ class BatchScheduler:
             numa=numa_state,
             devices=device_state,
             max_rounds=self.max_rounds,
+            cost_transform=self.extender.cost_transform,
         )
 
     def quota_state(self, chunk: Sequence[Pod]) -> Optional[QuotaState]:

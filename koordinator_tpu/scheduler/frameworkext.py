@@ -1,0 +1,427 @@
+"""Framework extension machinery around the batch solver.
+
+Rebuild of ``pkg/scheduler/frameworkext/`` — the reference's "framework of
+the framework" that wraps every scheduling profile
+(``frameworkext/interface.go:37-76``):
+
+* **Transformer chain** (``interface.go:84-109``, impl
+  ``framework_extender.go:222-315``): ``BeforePreFilter`` /
+  ``BeforeFilter`` / ``BeforeScore`` hooks that may rewrite the pod or the
+  cluster view before the built-in phases. Here the phases are tensor
+  programs, so transformers rewrite host ``Pod`` objects before lowering
+  (:meth:`FrameworkExtender.run_pre_batch_transformers`) or the lowered
+  device batch/cost tensors (:meth:`run_batch_transformers`,
+  :meth:`run_cost_transformers`).
+* **SchedulerMonitor** (``scheduler_monitor.go:43-47,60+``): watchdog that
+  records when each pod's scheduling attempt started; a sweep (default
+  every 10 s) flags pods stuck longer than the 30 s timeout into the
+  ``scheduling_timeout_total`` metric and the slow-pod log.
+* **Error-handler dispatcher** (``errorhandler_dispatcher.go``, registered
+  at ``app/server.go:439,450``): chained handlers intercept scheduling
+  failures; the first handler returning True consumes the failure (the
+  reference's reservation error handler works this way), otherwise the
+  default handler records it.
+* **Debug score/filter dump** (``frameworkext/debug.go:1-90``, flags at
+  ``app/server.go:334-335``): per-batch top-N score tables and filter
+  failure tallies, exposed over the services engine as
+  ``/debug/flags/s``-style output.
+* **Services engine** (``frameworkext/services/``): an HTTP server where
+  plugins install handlers (``InstallAPIHandler``); serves ``/metrics``
+  (Prometheus text), debug dumps, and per-plugin endpoints.
+* **Scheduler metrics** (``pkg/scheduler/metrics/metrics.go:38-83``).
+
+The NextPod hook (``interface.go:226-230``) lives in
+``plugins.coscheduling.PodGroupManager.order_pending`` and the reservation
+extension points in ``plugins.reservation`` — this module is the shared
+spine they plug into.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.types import Pod
+from ..utils.metrics import Registry
+
+# ---------------------------------------------------------------------------
+# Scheduler metrics (reference pkg/scheduler/metrics/metrics.go:38-83)
+# ---------------------------------------------------------------------------
+
+
+def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
+    """Create (or populate a caller-supplied) registry with the scheduler
+    metric set — callers passing their own Registry still get every metric
+    the batch cycle touches."""
+    reg = reg or Registry(namespace="koord_scheduler")
+    reg.counter(
+        "scheduling_timeout_total",
+        "pods whose scheduling attempt exceeded the monitor timeout",
+    )
+    reg.histogram(
+        "elastic_quota_process_latency_seconds",
+        "latency of elastic-quota admission passes",
+    )
+    reg.gauge(
+        "waiting_gang_group_number",
+        "gang groups currently gated before the solver",
+    )
+    reg.histogram(
+        "solver_batch_latency_seconds",
+        "device latency of one solver batch",
+    )
+    reg.counter("scheduled_pods_total", "pods bound by the batch scheduler")
+    reg.counter("unschedulable_pods_total", "pods left unschedulable")
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# SchedulerMonitor (reference frameworkext/scheduler_monitor.go)
+# ---------------------------------------------------------------------------
+
+
+class SchedulerMonitor:
+    """Watchdog over in-flight scheduling attempts.
+
+    ``start_monitor(pod)`` when an attempt begins, ``complete(pod)`` when it
+    ends (the reference wraps scheduleOne the same way); :meth:`sweep`
+    (reference: every 10 s) counts attempts older than ``timeout_s``
+    (reference: 30 s) into the timeout metric and returns them for logging.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        period_s: float = 10.0,
+        timeout_s: float = 30.0,
+    ):
+        self.period_s = period_s
+        self.timeout_s = timeout_s
+        self.registry = scheduler_registry(registry)
+        self._inflight: Dict[str, Tuple[str, float]] = {}
+        self._lock = threading.Lock()
+        self._last_sweep = 0.0
+
+    def start_monitor(self, pod: Pod, now: Optional[float] = None) -> None:
+        with self._lock:
+            self._inflight[pod.meta.uid] = (
+                pod.meta.name,
+                time.monotonic() if now is None else now,
+            )
+
+    def complete(self, pod: Pod) -> None:
+        with self._lock:
+            self._inflight.pop(pod.meta.uid, None)
+
+    def sweep(self, now: Optional[float] = None) -> List[str]:
+        """Returns names of timed-out pods; call at period_s cadence."""
+        now = time.monotonic() if now is None else now
+        if now - self._last_sweep < self.period_s:
+            return []
+        self._last_sweep = now
+        timed_out = []
+        with self._lock:
+            for uid, (name, started) in list(self._inflight.items()):
+                if now - started > self.timeout_s:
+                    timed_out.append(name)
+                    del self._inflight[uid]
+        c = self.registry.get("scheduling_timeout_total")
+        for _ in timed_out:
+            c.inc()
+        return timed_out
+
+
+# ---------------------------------------------------------------------------
+# Error-handler dispatcher (reference frameworkext/errorhandler_dispatcher.go)
+# ---------------------------------------------------------------------------
+
+ErrorHandler = Callable[[Pod, str], bool]
+
+
+class ErrorHandlerDispatcher:
+    """Chain of scheduling-failure interceptors.
+
+    ``register_pre`` handlers run before the default handler; the first
+    returning True consumes the failure (e.g. the reservation error handler
+    re-queues the reserve pod instead of marking it failed). ``set_default``
+    replaces the terminal handler.
+    """
+
+    def __init__(self, max_failures: int = 512):
+        import collections
+
+        self._pre: List[ErrorHandler] = []
+        self._post: List[ErrorHandler] = []
+        self._default: ErrorHandler = lambda pod, msg: False
+        #: bounded recent-failure log (a standing set of unschedulable pods
+        #: appends per cycle — same ring-buffer shape as the koordlet
+        #: auditor)
+        self.failures = collections.deque(maxlen=max_failures)
+
+    def register_pre(self, handler: ErrorHandler) -> None:
+        self._pre.append(handler)
+
+    def register_post(self, handler: ErrorHandler) -> None:
+        self._post.append(handler)
+
+    def set_default(self, handler: ErrorHandler) -> None:
+        self._default = handler
+
+    def handle(self, pod: Pod, message: str) -> None:
+        self.failures.append((pod.meta.name, message))
+        for h in self._pre:
+            if h(pod, message):
+                return
+        self._default(pod, message)
+        for h in self._post:
+            h(pod, message)
+
+
+# ---------------------------------------------------------------------------
+# Debug dumps (reference frameworkext/debug.go, /debug/flags/s|f)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DebugScoresDumper:
+    """Captures per-batch top-N nominations like the reference's score table
+    (``debug.go:1-90``); enabled/size-controlled at runtime via the services
+    engine (the reference's POST /debug/flags/s)."""
+
+    top_n: int = 0  # 0 = disabled
+    last_table: List[Dict[str, object]] = field(default_factory=list)
+
+    def capture(
+        self,
+        pods: Sequence[Pod],
+        node_names: Sequence[str],
+        cost: np.ndarray,
+        assignment: np.ndarray,
+    ) -> None:
+        if self.top_n <= 0 or cost.size == 0:
+            return
+        table: List[Dict[str, object]] = []
+        k = min(self.top_n, cost.shape[1])
+        for i, pod in enumerate(pods):
+            row = cost[i]
+            idx = np.argsort(row)[:k]
+            table.append(
+                {
+                    "pod": pod.meta.name,
+                    "assigned": (
+                        node_names[assignment[i]] if assignment[i] >= 0 else ""
+                    ),
+                    "topScores": [
+                        {"node": node_names[j], "cost": float(row[j])}
+                        for j in idx
+                        if np.isfinite(row[j])
+                    ],
+                }
+            )
+        self.last_table = table
+
+    def render(self) -> str:
+        return json.dumps(self.last_table, indent=1)
+
+
+@dataclass
+class DebugFiltersDumper:
+    """Filter-failure tally per mask stage (reference logs which plugin
+    filtered each node; the batched analog is a per-stage rejected-node
+    count captured at solve time)."""
+
+    enabled: bool = False
+    last_tally: Dict[str, int] = field(default_factory=dict)
+
+    def capture(self, stage_rejections: Dict[str, int]) -> None:
+        if self.enabled:
+            self.last_tally = dict(stage_rejections)
+
+    def render(self) -> str:
+        return json.dumps(self.last_tally, indent=1)
+
+
+# ---------------------------------------------------------------------------
+# Services engine (reference frameworkext/services/)
+# ---------------------------------------------------------------------------
+
+
+class ServicesEngine:
+    """Plugin-installable HTTP API (reference gin engine,
+    ``InstallAPIHandler`` at ``app/server.go:337``). Routes:
+      /metrics            — Prometheus exposition
+      /debug/scores       — last score table (GET), top-N (POST body int)
+      /debug/filters      — filter tally
+      /apis/v1/<plugin>/… — handlers installed by plugins
+    """
+
+    def __init__(
+        self,
+        registry: Registry,
+        scores: DebugScoresDumper,
+        filters: DebugFiltersDumper,
+    ):
+        self.registry = registry
+        self.scores = scores
+        self.filters = filters
+        self._routes: Dict[str, Callable[[str], Tuple[int, str]]] = {}
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+
+    def install(
+        self, plugin: str, path: str, handler: Callable[[str], Tuple[int, str]]
+    ) -> None:
+        self._routes[f"/apis/v1/{plugin}{path}"] = handler
+
+    def dispatch(self, method: str, path: str, body: str = "") -> Tuple[int, str]:
+        if path == "/metrics":
+            return 200, self.registry.expose()
+        if path == "/debug/scores":
+            if method == "POST":
+                try:
+                    self.scores.top_n = int(body.strip() or "0")
+                except ValueError:
+                    return 400, "bad top-n"
+                return 200, str(self.scores.top_n)
+            return 200, self.scores.render()
+        if path == "/debug/filters":
+            if method == "POST":
+                self.filters.enabled = body.strip() in ("1", "true")
+                return 200, str(self.filters.enabled)
+            return 200, self.filters.render()
+        handler = self._routes.get(path)
+        if handler is None:
+            return 404, "not found"
+        return handler(body)
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        engine = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _run(self, method: str):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length).decode() if length else ""
+                code, text = engine.dispatch(method, self.path, body)
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def log_message(self, *args):
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+# ---------------------------------------------------------------------------
+# FrameworkExtender
+# ---------------------------------------------------------------------------
+
+PodTransformer = Callable[[Pod], Optional[Pod]]
+
+
+class FrameworkExtender:
+    """The shared spine: transformer chains + monitor + error dispatch +
+    debug + services, attached to a BatchScheduler.
+
+    The reference builds one of these per scheduling profile and swaps it
+    into ``sched.Profiles`` (``app/server.go:431-437``) so every framework
+    call routes through it; here the BatchScheduler calls the hooks at the
+    equivalent cycle points.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = scheduler_registry(registry)
+        self.monitor = SchedulerMonitor(registry=self.registry)
+        self.errors = ErrorHandlerDispatcher()
+        self.scores = DebugScoresDumper()
+        self.filters = DebugFiltersDumper()
+        self.services = ServicesEngine(self.registry, self.scores, self.filters)
+        self._pre_batch: List[PodTransformer] = []
+        self._batch_transformers: List[Callable] = []
+        self._cost_transformers: List[Callable] = []
+        self._composed_cost: Optional[Callable] = None
+
+    # -- registration (reference PluginFactoryProxy interception:
+    # frameworkext/framework_extender_factory.go intercepts plugin
+    # construction; plugins implementing transformer interfaces register)
+
+    def register_pod_transformer(self, fn: PodTransformer) -> None:
+        """BeforePreFilter analog: rewrite the host pod before lowering.
+        Returning None drops the pod from the batch (unschedulable)."""
+        self._pre_batch.append(fn)
+
+    def register_batch_transformer(self, fn) -> None:
+        """BeforeFilter analog: fn(PodBatch, NodeState) -> (PodBatch, NodeState)."""
+        self._batch_transformers.append(fn)
+
+    def register_cost_transformer(self, fn) -> None:
+        """BeforeScore analog: fn(cost[P,N]) -> cost[P,N] (device-side)."""
+        self._cost_transformers.append(fn)
+        self._composed_cost = None
+
+    @property
+    def cost_transform(self):
+        """Composed BeforeScore chain with a stable identity so the jitted
+        solver (which hashes it as a static arg) does not retrace per call."""
+        if not self._cost_transformers:
+            return None
+        if self._composed_cost is None:
+            chain = tuple(self._cost_transformers)
+
+            def composed(cost, _chain=chain):
+                for fn in _chain:
+                    cost = fn(cost)
+                return cost
+
+            self._composed_cost = composed
+        return self._composed_cost
+
+    # -- hook invocation from the batch cycle
+
+    def run_pre_batch_transformers(
+        self, pods: Sequence[Pod]
+    ) -> Tuple[List[Pod], List[Pod]]:
+        kept: List[Pod] = []
+        dropped: List[Pod] = []
+        for pod in pods:
+            out: Optional[Pod] = pod
+            for fn in self._pre_batch:
+                out = fn(out)
+                if out is None:
+                    break
+            if out is None:
+                dropped.append(pod)
+                self.errors.handle(pod, "rejected by pod transformer")
+            else:
+                kept.append(out)
+        return kept, dropped
+
+    def run_batch_transformers(self, pod_batch, node_state):
+        for fn in self._batch_transformers:
+            pod_batch, node_state = fn(pod_batch, node_state)
+        return pod_batch, node_state
+
+    def run_cost_transformers(self, cost):
+        for fn in self._cost_transformers:
+            cost = fn(cost)
+        return cost

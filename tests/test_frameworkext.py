@@ -1,0 +1,190 @@
+"""frameworkext: transformers, monitor, error dispatch, debug, services,
+metrics (reference pkg/scheduler/frameworkext/framework_extender_test.go
+exercises the same seams)."""
+
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.frameworkext import (
+    ErrorHandlerDispatcher,
+    FrameworkExtender,
+    SchedulerMonitor,
+)
+from koordinator_tpu.utils.metrics import Registry
+
+
+def mkpod(name, cpu=1000, mem=1 << 30, priority=9500):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name),
+        spec=PodSpec(
+            requests={ext.RES_CPU: float(cpu), ext.RES_MEMORY: float(mem)},
+            priority=priority,
+        ),
+    )
+
+
+@pytest.fixture
+def sched():
+    s = BatchScheduler()
+    for i in range(4):
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"node-{i}"),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: 32000.0,
+                        ext.RES_MEMORY: float(64 << 30),
+                    }
+                ),
+            )
+        )
+    return s
+
+
+class TestTransformers:
+    def test_pod_transformer_rewrites_before_lowering(self, sched):
+        # BeforePreFilter analog: double the CPU request.
+        def double_cpu(pod):
+            pod.spec.requests[ext.RES_CPU] *= 2
+            return pod
+
+        sched.extender.register_pod_transformer(double_cpu)
+        pod = mkpod("p1", cpu=1000)
+        out = sched.schedule([pod])
+        assert len(out.bound) == 1
+        assert pod.spec.requests[ext.RES_CPU] == 2000.0
+
+    def test_pod_transformer_drop_marks_unschedulable(self, sched):
+        sched.extender.register_pod_transformer(
+            lambda pod: None if pod.meta.name == "bad" else pod
+        )
+        out = sched.schedule([mkpod("bad"), mkpod("ok")])
+        assert [p.meta.name for p, _ in out.bound] == ["ok"]
+        assert [p.meta.name for p in out.unschedulable] == ["bad"]
+        assert sched.extender.errors.failures[0][0] == "bad"
+
+    def test_batch_transformer_sees_device_arrays(self, sched):
+        seen = {}
+
+        def spy(pods, nodes):
+            seen["p"] = int(pods.requests.shape[0])
+            return pods, nodes
+
+        sched.extender.register_batch_transformer(spy)
+        sched.schedule([mkpod("p1")])
+        assert seen["p"] >= 1
+
+    def test_cost_transformer_steers_choice(self, sched):
+        # Make node 0 infinitely expensive: nothing lands there (the solver
+        # treats non-finite cost as infeasible, like a BeforeScore veto).
+        def avoid_node0(cost):
+            return jnp.where(
+                (jnp.arange(cost.shape[1]) == 0)[None, :], jnp.inf, cost
+            )
+
+        sched.extender.register_cost_transformer(avoid_node0)
+        out = sched.schedule([mkpod(f"p{i}") for i in range(8)])
+        assert len(out.bound) == 8
+        assert all(node != "node-0" for _, node in out.bound)
+
+
+class TestMonitor:
+    def test_timeout_sweep(self):
+        reg = Registry(namespace="koord_scheduler")
+        reg.counter("scheduling_timeout_total", "")
+        mon = SchedulerMonitor(registry=reg, period_s=10.0, timeout_s=30.0)
+        pod = mkpod("slow")
+        mon.start_monitor(pod, now=0.0)
+        # inside period: no sweep
+        assert mon.sweep(now=5.0) == []
+        mon._last_sweep = 0.0
+        # past period but inside timeout
+        assert mon.sweep(now=11.0) == []
+        mon._last_sweep = 0.0
+        assert mon.sweep(now=31.0) == ["slow"]
+        assert reg.get("scheduling_timeout_total").value() == 1
+
+    def test_complete_clears(self):
+        mon = SchedulerMonitor(period_s=0.0, timeout_s=0.0)
+        pod = mkpod("fast")
+        mon.start_monitor(pod, now=0.0)
+        mon.complete(pod)
+        assert mon.sweep(now=100.0) == []
+
+
+class TestErrorDispatcher:
+    def test_pre_handler_consumes(self):
+        d = ErrorHandlerDispatcher()
+        calls = []
+        d.register_pre(lambda p, m: calls.append(("pre", p.meta.name)) or True)
+        d.set_default(lambda p, m: calls.append(("default", p.meta.name)) or False)
+        d.handle(mkpod("x"), "boom")
+        assert calls == [("pre", "x")]
+
+    def test_falls_through_to_default_and_post(self):
+        d = ErrorHandlerDispatcher()
+        calls = []
+        d.register_pre(lambda p, m: False)
+        d.set_default(lambda p, m: calls.append("default") or False)
+        d.register_post(lambda p, m: calls.append("post") or False)
+        d.handle(mkpod("x"), "boom")
+        assert calls == ["default", "post"]
+
+
+class TestDebugAndServices:
+    def test_score_dump_via_services(self, sched):
+        eng = sched.extender.services
+        code, body = eng.dispatch("POST", "/debug/scores", "3")
+        assert (code, body) == (200, "3")
+        out = sched.schedule([mkpod("p1")])
+        assert len(out.bound) == 1
+        code, body = eng.dispatch("GET", "/debug/scores")
+        assert code == 200 and "p1" in body and "topScores" in body
+
+    def test_metrics_exposition(self, sched):
+        sched.schedule([mkpod("p1")])
+        code, body = sched.extender.services.dispatch("GET", "/metrics")
+        assert code == 200
+        assert "koord_scheduler_scheduled_pods_total 1" in body
+        assert "koord_scheduler_solver_batch_latency_seconds_count 1" in body
+
+    def test_plugin_endpoint_install_and_http(self, sched):
+        eng = sched.extender.services
+        eng.install("loadaware", "/estimate", lambda body: (200, "ok:" + body))
+        code, body = eng.dispatch("POST", "/apis/v1/loadaware/estimate", "x")
+        assert (code, body) == (200, "ok:x")
+        port = eng.serve()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as resp:
+                assert resp.status == 200
+                assert b"koord_scheduler" in resp.read()
+        finally:
+            eng.shutdown()
+
+    def test_unknown_route_404(self, sched):
+        assert sched.extender.services.dispatch("GET", "/nope")[0] == 404
+
+
+class TestRegistryPrimitives:
+    def test_counter_gauge_histogram(self):
+        reg = Registry(namespace="t")
+        c = reg.counter("c", "help", labels=("a",))
+        c.labels(a="x").inc(2)
+        assert c.value(a="x") == 2
+        g = reg.gauge("g", "help")
+        g.set(7.5)
+        assert g.value() == 7.5
+        h = reg.histogram("h", "help")
+        for v in (0.002, 0.002, 0.2, 3.0):
+            h.observe(v)
+        assert h.quantile(0.5) == pytest.approx(0.0025)
+        text = reg.expose()
+        assert "t_c" in text and "t_h_bucket" in text and 't_h_count 4' in text
