@@ -35,6 +35,8 @@ ANNOTATION_GANG_GROUPS = f"gang.scheduling.{DOMAIN}/groups"
 ANNOTATION_NODE_CPU_TOPOLOGY = f"node.{DOMAIN}/cpu-topology"
 ANNOTATION_NODE_RAW_ALLOCATABLE = f"node.{DOMAIN}/raw-allocatable"
 ANNOTATION_NODE_AMPLIFICATION = f"node.{DOMAIN}/resource-amplification-ratio"
+ANNOTATION_NETWORK_QOS = f"{DOMAIN}/networkQOS"
+ANNOTATION_NODE_CPU_NORMALIZATION = f"node.{DOMAIN}/cpu-normalization-ratio"
 
 
 class QoSClass(enum.IntEnum):

@@ -195,6 +195,37 @@ class Device:
     devices: List[DeviceInfo] = dataclasses.field(default_factory=list)
 
 
+@dataclasses.dataclass
+class TopologyZone:
+    """One NUMA zone of a node (external NodeResourceTopology CRD,
+    ``k8stopologyawareschedwg``; reported by koordlet's
+    ``statesinformer/impl/states_noderesourcetopology.go``)."""
+
+    name: str                   # e.g. "node-0"
+    zone_type: str = "Node"
+    allocatable: ResourceList = dataclasses.field(default_factory=dict)
+    capacity: ResourceList = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class NodeResourceTopology:
+    """Per-node NUMA topology + kubelet CPU-manager state report.
+
+    ``cpu_topology`` maps logical cpu id → (core, numa node, socket);
+    ``kubelet_reserved_cpus`` mirrors the kubelet cpu-manager state the
+    reference reads back through annotations
+    (``statesinformer/impl/states_noderesourcetopology.go``).
+    """
+
+    meta: ObjectMeta            # name == node name
+    zones: List[TopologyZone] = dataclasses.field(default_factory=list)
+    cpu_topology: Dict[int, Tuple[int, int, int]] = dataclasses.field(
+        default_factory=dict
+    )
+    kubelet_reserved_cpus: List[int] = dataclasses.field(default_factory=list)
+    topology_policy: str = "None"
+
+
 # --- thirdparty PodGroup (gang) ---
 
 
@@ -283,6 +314,53 @@ class ResourceThresholdStrategy:
 
 
 @dataclasses.dataclass
+class SystemStrategy:
+    """Node-level kernel tuning (nodeslo_types.go SystemStrategy →
+    koordlet sysreconcile strategy)."""
+
+    enable: bool = False
+    min_free_kbytes_factor: float = 100.0   # per-mille of total memory
+    watermark_scale_factor: float = 150.0
+    memcg_reap_background: int = 0
+
+
+@dataclasses.dataclass
+class ResctrlStrategy:
+    """RDT L3/MB partitioning per QoS tier (nodeslo_types.go ResourceQOS
+    resctrlQOS → koordlet resctrl strategy + qosmanager/resctrl)."""
+
+    enable: bool = False
+    #: percent of LLC ways each tier may use
+    llc_percent: Dict[QoSClass, float] = dataclasses.field(
+        default_factory=lambda: {
+            QoSClass.LSR: 100.0,
+            QoSClass.LS: 100.0,
+            QoSClass.BE: 30.0,
+        }
+    )
+    #: percent of memory bandwidth each tier may use
+    mba_percent: Dict[QoSClass, float] = dataclasses.field(
+        default_factory=lambda: {
+            QoSClass.LSR: 100.0,
+            QoSClass.LS: 100.0,
+            QoSClass.BE: 100.0,
+        }
+    )
+
+
+@dataclasses.dataclass
+class BlkIOStrategy:
+    """Block IO throttles per tier (nodeslo_types.go blkioQOS →
+    qosmanager blkio strategy). Limits are bytes/s or IOs/s; 0 = no limit."""
+
+    enable: bool = False
+    be_read_bps: int = 0
+    be_write_bps: int = 0
+    be_read_iops: int = 0
+    be_write_iops: int = 0
+
+
+@dataclasses.dataclass
 class CPUBurstStrategy:
     policy: str = "none"        # none|cpuBurstOnly|cfsQuotaBurstOnly|auto
     cpu_burst_percent: float = 1000.0
@@ -299,6 +377,19 @@ class NodeSLO:
     #: per-QoS-class resource QoS knobs, keyed by QoSClass
     resource_qos: Dict[QoSClass, Dict[str, float]] = dataclasses.field(
         default_factory=dict
+    )
+    system: SystemStrategy = dataclasses.field(
+        default_factory=lambda: SystemStrategy()
+    )
+    resctrl: ResctrlStrategy = dataclasses.field(
+        default_factory=lambda: ResctrlStrategy()
+    )
+    blkio: BlkIOStrategy = dataclasses.field(
+        default_factory=lambda: BlkIOStrategy()
+    )
+    #: out-of-band host daemons: (name, cgroup dir, qos class name)
+    host_applications: List[Tuple[str, str, str]] = dataclasses.field(
+        default_factory=list
     )
 
 

@@ -67,6 +67,18 @@ def _load_native() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_double),
     ]
     lib.koord_read_cgroup_cpu_ns.restype = ctypes.c_int
+    dbl = ctypes.POINTER(ctypes.c_double)
+    for name, argtypes in (
+        ("koord_cpi_open", []),
+        ("koord_cpi_read", [dbl, dbl]),
+        ("koord_read_pagecache_kib", [dbl]),
+        ("koord_read_cgroup_throttled", [ctypes.c_char_p, ctypes.c_char_p, dbl, dbl]),
+        ("koord_read_diskstats", [dbl, dbl]),
+    ):
+        if hasattr(lib, name):
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = ctypes.c_int
     return lib
 
 
@@ -280,11 +292,18 @@ class NodeResourceCollector:
 
 
 class PerformanceCollector:
-    """performance collector: PSI pressure gauges (the CPI half of the
-    reference needs perf_event_open privileges; PSI is the portable part)."""
+    """performance collector: PSI pressure gauges + CPI via the native
+    perf_event_open group (reference
+    ``collectors/performance`` — CPI through cgo→libpfm4, PSI through
+    /proc/pressure). CPI silently degrades when perf is unavailable
+    (unprivileged container), exactly like the reference's feature gate."""
 
     def __init__(self, cache: mc.MetricCache):
         self.cache = cache
+        self._cpi_armed = False
+        self._cpi_last: Optional[Tuple[float, float]] = None  # (cycles, instr)
+        if _NATIVE is not None and hasattr(_NATIVE, "koord_cpi_open"):
+            self._cpi_armed = _NATIVE.koord_cpi_open() == 0
 
     def collect(self, now: Optional[float] = None) -> bool:
         now = now if now is not None else time.time()
@@ -298,4 +317,381 @@ class PerformanceCollector:
             if psi is not None:
                 self.cache.append(metric, "node", now, psi[0])
                 ok = True
+        if self._cpi_armed:
+            cycles = ctypes.c_double()
+            instr = ctypes.c_double()
+            if _NATIVE.koord_cpi_read(ctypes.byref(cycles), ctypes.byref(instr)) == 0:
+                if self._cpi_last is not None:
+                    dc = cycles.value - self._cpi_last[0]
+                    di = instr.value - self._cpi_last[1]
+                    if di > 0:
+                        self.cache.append(mc.NODE_CPI, "node", now, dc / di)
+                        ok = True
+                self._cpi_last = (cycles.value, instr.value)
+        return ok
+
+
+class PodResourceCollector:
+    """podresource collector: per-pod cgroup cpu/memory usage
+    (``collectors/podresource``). Pods come from the statesinformer via a
+    callable so the collector never holds a stale list."""
+
+    def __init__(self, cache: mc.MetricCache, cgroup_root: str, pods_fn):
+        self.cache = cache
+        self.cgroup_root = cgroup_root
+        self.pods_fn = pods_fn
+        self._last: Dict[str, Tuple[float, float]] = {}  # uid -> (ts, cpu_ns)
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        from .runtimehooks import pod_cgroup
+
+        now = now if now is not None else time.time()
+        ok = False
+        live = set()
+        for pod in self.pods_fn():
+            uid = pod.meta.uid
+            live.add(uid)
+            group = pod_cgroup(pod)
+            cpu_ns = read_cgroup_cpu_ns(self.cgroup_root, group)
+            if cpu_ns is not None:
+                last = self._last.get(uid)
+                if last is not None and now > last[0] and cpu_ns >= last[1]:
+                    milli = (cpu_ns - last[1]) / (now - last[0]) / 1e6
+                    self.cache.append(mc.POD_CPU_USAGE, uid, now, milli)
+                    ok = True
+                self._last[uid] = (now, cpu_ns)
+            mem = read_cgroup_memory_mib(self.cgroup_root, group)
+            if mem is not None:
+                self.cache.append(mc.POD_MEMORY_USAGE, uid, now, mem)
+                ok = True
+        for uid in list(self._last):
+            if uid not in live:
+                del self._last[uid]
+        return ok
+
+
+class SysResourceCollector:
+    """sysresource collector: system (non-pod) usage = node usage − kubepods
+    tier usage (``collectors/sysresource`` computes the same residual)."""
+
+    KUBEPODS = "kubepods"
+
+    def __init__(self, cache: mc.MetricCache, cgroup_root: str):
+        self.cache = cache
+        self.cgroup_root = cgroup_root
+        self._last: Optional[Tuple[float, float]] = None
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        cpu_ns = read_cgroup_cpu_ns(self.cgroup_root, self.KUBEPODS)
+        pods_milli = None
+        if cpu_ns is not None:
+            if self._last is not None and now > self._last[0] and cpu_ns >= self._last[1]:
+                pods_milli = (cpu_ns - self._last[1]) / (now - self._last[0]) / 1e6
+            self._last = (now, cpu_ns)
+        node = self.cache.latest(mc.NODE_CPU_USAGE, "node")
+        if pods_milli is None or node is None:
+            return False
+        self.cache.append(
+            mc.SYS_CPU_USAGE, "node", now, max(node[1] - pods_milli, 0.0)
+        )
+        return True
+
+
+class ResctrlCollector:
+    """resctrl collector: RDT last-level-cache occupancy and memory
+    bandwidth from the resctrl filesystem (``collectors/resctrl`` reading
+    ``mon_data/mon_L3_**/{llc_occupancy,mbm_total_bytes}``)."""
+
+    def __init__(self, cache: mc.MetricCache, resctrl_root: str = "/sys/fs/resctrl"):
+        self.cache = cache
+        self.resctrl_root = resctrl_root
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        mon = os.path.join(self.resctrl_root, "mon_data")
+        try:
+            domains = sorted(os.listdir(mon))
+        except OSError:
+            return False
+        llc_total = 0.0
+        mbm_total = 0.0
+        found = False
+        for dom in domains:
+            for fname, acc in (("llc_occupancy", "llc"), ("mbm_total_bytes", "mbm")):
+                try:
+                    with open(os.path.join(mon, dom, fname)) as f:
+                        v = float(f.read().strip())
+                except (OSError, ValueError):
+                    continue
+                found = True
+                if acc == "llc":
+                    llc_total += v
+                else:
+                    mbm_total += v
+        if not found:
+            return False
+        self.cache.append(mc.NODE_LLC_OCCUPANCY, "node", now, llc_total)
+        self.cache.append(mc.NODE_MBM_TOTAL, "node", now, mbm_total)
+        return True
+
+
+class ColdMemoryCollector:
+    """coldmemoryresource collector: kidled idle-page stats
+    (``collectors/coldmemoryresource`` reads
+    ``memory.idle_page_stats`` exported by the Anolis kidled kernel); cold
+    memory feeds the batchresource overcommit as reclaimable."""
+
+    def __init__(self, cache: mc.MetricCache, cgroup_root: str):
+        self.cache = cache
+        self.cgroup_root = cgroup_root
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        path = os.path.join(self.cgroup_root, "memory.idle_page_stats")
+        try:
+            cold_bytes = 0.0
+            with open(path) as f:
+                for line in f:
+                    # kidled rows: csei/dsei/cfei/dfei <age buckets…>; cold
+                    # = pages idle longer than the youngest bucket
+                    parts = line.split()
+                    if len(parts) > 2 and parts[0] in ("csei", "dsei", "cfei", "dfei"):
+                        cold_bytes += sum(float(x) for x in parts[2:])
+        except OSError:
+            return False
+        self.cache.append(
+            mc.NODE_COLD_MEMORY, "node", now, cold_bytes / (1024.0 * 1024.0)
+        )
+        return True
+
+
+class PagecacheCollector:
+    """pagecache collector: Cached bytes from /proc/meminfo
+    (``collectors/pagecache``)."""
+
+    def __init__(self, cache: mc.MetricCache):
+        self.cache = cache
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        cached_mib: Optional[float] = None
+        if _NATIVE is not None and hasattr(_NATIVE, "koord_read_pagecache_kib"):
+            out = ctypes.c_double()
+            if _NATIVE.koord_read_pagecache_kib(ctypes.byref(out)) == 0:
+                cached_mib = out.value / 1024.0
+        else:
+            try:
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        if line.startswith("Cached:"):
+                            cached_mib = float(line.split()[1]) / 1024.0
+                            break
+            except OSError:
+                pass
+        if cached_mib is None:
+            return False
+        self.cache.append(mc.NODE_PAGECACHE, "node", now, cached_mib)
+        return True
+
+
+class PodThrottledCollector:
+    """podthrottled collector: per-pod CFS throttle ratio
+    (``collectors/podthrottled``: nr_throttled / nr_periods deltas)."""
+
+    def __init__(self, cache: mc.MetricCache, cgroup_root: str, pods_fn):
+        self.cache = cache
+        self.cgroup_root = cgroup_root
+        self.pods_fn = pods_fn
+        self._last: Dict[str, Tuple[float, float]] = {}  # uid -> (periods, throttled)
+
+    def _read(self, group: str) -> Optional[Tuple[float, float]]:
+        if _NATIVE is not None and hasattr(_NATIVE, "koord_read_cgroup_throttled"):
+            periods = ctypes.c_double()
+            throttled = ctypes.c_double()
+            if (
+                _NATIVE.koord_read_cgroup_throttled(
+                    self.cgroup_root.encode(),
+                    group.encode(),
+                    ctypes.byref(periods),
+                    ctypes.byref(throttled),
+                )
+                == 0
+            ):
+                return periods.value, throttled.value
+            return None
+        try:
+            periods = throttled = None
+            with open(os.path.join(self.cgroup_root, group, "cpu.stat")) as f:
+                for line in f:
+                    if line.startswith("nr_periods"):
+                        periods = float(line.split()[1])
+                    elif line.startswith("nr_throttled"):
+                        throttled = float(line.split()[1])
+            if periods is not None and throttled is not None:
+                return periods, throttled
+        except OSError:
+            pass
+        return None
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        from .runtimehooks import pod_cgroup
+
+        now = now if now is not None else time.time()
+        ok = False
+        live = set()
+        for pod in self.pods_fn():
+            uid = pod.meta.uid
+            live.add(uid)
+            stat = self._read(pod_cgroup(pod))
+            if stat is None:
+                continue
+            last = self._last.get(uid)
+            if last is not None:
+                dp = stat[0] - last[0]
+                dt = stat[1] - last[1]
+                if dp > 0:
+                    self.cache.append(
+                        mc.POD_THROTTLED_RATIO, uid, now, min(dt / dp, 1.0)
+                    )
+                    ok = True
+            self._last[uid] = stat
+        for uid in list(self._last):
+            if uid not in live:
+                del self._last[uid]
+        return ok
+
+
+class HostApplicationCollector:
+    """hostapplication collector: usage of out-of-band host daemons whose
+    cgroups are declared in NodeSLO ``hostApplications``
+    (``collectors/hostapplication``)."""
+
+    def __init__(self, cache: mc.MetricCache, cgroup_root: str, apps_fn):
+        self.cache = cache
+        self.cgroup_root = cgroup_root
+        self.apps_fn = apps_fn          # () -> [(name, cgroup_dir)]
+        self._last: Dict[str, Tuple[float, float]] = {}
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        ok = False
+        for name, group in self.apps_fn():
+            cpu_ns = read_cgroup_cpu_ns(self.cgroup_root, group)
+            if cpu_ns is None:
+                continue
+            last = self._last.get(name)
+            if last is not None and now > last[0] and cpu_ns >= last[1]:
+                milli = (cpu_ns - last[1]) / (now - last[0]) / 1e6
+                self.cache.append(mc.HOST_APP_CPU_USAGE, name, now, milli)
+                ok = True
+            self._last[name] = (now, cpu_ns)
+            mem = read_cgroup_memory_mib(self.cgroup_root, group)
+            if mem is not None:
+                self.cache.append(mc.HOST_APP_MEMORY_USAGE, name, now, mem)
+                ok = True
+        return ok
+
+
+class NodeInfoCollector:
+    """nodeinfo collector: static node facts (cpu count, memory capacity)
+    into the KV side of the cache (``collectors/nodeinfo``)."""
+
+    def __init__(self, cache: mc.MetricCache, n_cpus: Optional[int] = None):
+        self.cache = cache
+        self.n_cpus = n_cpus or os.cpu_count() or 1
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        self.cache.set_kv("node_info/num_cpus", float(self.n_cpus))
+        info = read_meminfo()
+        if info is not None:
+            self.cache.set_kv("node_info/memory_total_mib", info[0])
+        self.cache.set_kv("node_info/last_update", now)
+        return True
+
+
+def _diskstats_skip(name: str) -> bool:
+    """Partition / stacked-device rows whose IO the whole-disk row already
+    counts (sda1, nvme0n1p1, dm-0, …) — mirror of the native filter."""
+    if name.startswith(("loop", "ram", "dm-", "md")):
+        return True
+    stripped = name.rstrip("0123456789")
+    if stripped == name:
+        return False
+    if stripped.endswith("p") and name.startswith(("nvme", "mmcblk")):
+        return True
+    return name.startswith(("sd", "hd", "vd", "xvd"))
+
+
+class NodeStorageInfoCollector:
+    """nodestorageinfo collector: disk IO throughput deltas from
+    /proc/diskstats (``collectors/nodestorageinfo``)."""
+
+    SECTOR_BYTES = 512.0
+
+    def __init__(self, cache: mc.MetricCache):
+        self.cache = cache
+        self._last: Optional[Tuple[float, float, float]] = None
+
+    def _read(self) -> Optional[Tuple[float, float]]:
+        if _NATIVE is not None and hasattr(_NATIVE, "koord_read_diskstats"):
+            r = ctypes.c_double()
+            w = ctypes.c_double()
+            if _NATIVE.koord_read_diskstats(ctypes.byref(r), ctypes.byref(w)) == 0:
+                return r.value, w.value
+            return None
+        try:
+            r_total = w_total = 0.0
+            found = False
+            with open("/proc/diskstats") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) < 10 or _diskstats_skip(parts[2]):
+                        continue
+                    r_total += float(parts[5])
+                    w_total += float(parts[9])
+                    found = True
+            return (r_total, w_total) if found else None
+        except OSError:
+            return None
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        stat = self._read()
+        if stat is None:
+            return False
+        ok = False
+        if self._last is not None and now > self._last[0]:
+            dt = now - self._last[0]
+            read_bps = max(stat[0] - self._last[1], 0.0) * self.SECTOR_BYTES / dt
+            write_bps = max(stat[1] - self._last[2], 0.0) * self.SECTOR_BYTES / dt
+            self.cache.append(mc.NODE_DISK_READ_BPS, "node", now, read_bps)
+            self.cache.append(mc.NODE_DISK_WRITE_BPS, "node", now, write_bps)
+            ok = True
+        self._last = (now, stat[0], stat[1])
+        return ok
+
+
+class DeviceCollector:
+    """devices/{gpu,rdma} collectors: per-device utilization via the
+    injectable prober (the reference polls NVML; TPU hosts expose usage
+    through their own runtime — both reduce to a (minor, util, mem) sample
+    stream)."""
+
+    def __init__(self, cache: mc.MetricCache, sample_fn):
+        self.cache = cache
+        self.sample_fn = sample_fn      # () -> [(dev_type, minor, util_pct, mem_mib)]
+
+    def collect(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        ok = False
+        for dev_type, minor, util, mem in self.sample_fn():
+            self.cache.append(
+                mc.DEVICE_UTIL, f"{dev_type}-{minor}", now, float(util)
+            )
+            self.cache.append(
+                mc.DEVICE_MEMORY_USED, f"{dev_type}-{minor}", now, float(mem)
+            )
+            ok = True
         return ok

@@ -31,7 +31,10 @@ from . import metriccache as mc
 from . import qosmanager as qos
 from . import resourceexecutor as rex
 from . import runtimehooks as hooks
+from .pleg import Pleg
 from .prediction import PeakPredictor
+from .server import KoordletServer, koordlet_registry
+from .statesinformer import StatesInformer, StateType
 
 
 @dataclasses.dataclass
@@ -119,10 +122,39 @@ class Koordlet:
 
         self.executor = rex.ResourceExecutor(self.config.cgroup_root)
         self.metric_cache = mc.MetricCache()
+        self.registry = koordlet_registry()
+        self.server = KoordletServer(self.registry, self.executor.auditor)
+        self.pleg = Pleg(self.config.cgroup_root)
+        # statesinformer is the single state source; the daemon's loops are
+        # its registered consumers (koordlet.go wires the same dependency).
+        self.informer = StatesInformer(self.config.node_name)
+        self.informer.callbacks.register(
+            StateType.ALL_PODS, "qos-reconciler", self._on_pods
+        )
+        self.informer.callbacks.register(
+            StateType.NODE_SLO, "qos-strategy", self._on_node_slo
+        )
+        #: out-of-band host daemon cgroups (NodeSLO hostApplications) and
+        #: accelerator samplers are injectable; defaults are empty.
+        self.host_apps: List[Tuple[str, str]] = []
+        self.device_sampler = lambda: []
+        root = self.config.cgroup_root
         self.collectors = [
             col.NodeResourceCollector(self.metric_cache, n_cpus),
             col.PerformanceCollector(self.metric_cache),
-            col.BETierCollector(self.metric_cache, self.config.cgroup_root),
+            col.BETierCollector(self.metric_cache, root),
+            col.PodResourceCollector(self.metric_cache, root, self.informer.pods),
+            col.SysResourceCollector(self.metric_cache, root),
+            col.ResctrlCollector(self.metric_cache),
+            col.ColdMemoryCollector(self.metric_cache, root),
+            col.PagecacheCollector(self.metric_cache),
+            col.PodThrottledCollector(self.metric_cache, root, self.informer.pods),
+            col.HostApplicationCollector(
+                self.metric_cache, root, lambda: self.host_apps
+            ),
+            col.NodeInfoCollector(self.metric_cache, n_cpus),
+            col.NodeStorageInfoCollector(self.metric_cache),
+            col.DeviceCollector(self.metric_cache, lambda: self.device_sampler()),
         ]
         self.predictor = PeakPredictor()
         self.reporter = NodeMetricReporter(self.metric_cache, self.config)
@@ -139,22 +171,49 @@ class Koordlet:
 
     # ---- state inputs (statesinformer callbacks) ----
 
+    def _on_node_slo(self, slo: object) -> None:
+        self.node_slo = slo  # type: ignore[assignment]
+
+    def _on_pods(self, pods: object) -> None:
+        self.pods = list(pods)  # type: ignore[arg-type]
+        self.reconciler.reconcile(self.pods)
+
     def update_node_slo(self, slo: NodeSLO) -> None:
-        self.node_slo = slo
+        self.informer.set_node_slo(slo)
 
     def update_pods(self, pods: Sequence[Pod]) -> None:
-        self.pods = list(pods)
-        self.reconciler.reconcile(self.pods)
+        self.informer.set_pods(pods)
 
     # ---- loops ----
 
     def collect_tick(self, now: Optional[float] = None) -> None:
         now = now if now is not None else time.time()
+        self.pleg.tick()
         for collector in self.collectors:
-            collector.collect(now)
+            name = type(collector).__name__
+            # False means "nothing to collect" (no RDT, first delta tick,
+            # empty sampler, …) — only an exception is a collector failure.
+            try:
+                ok = collector.collect(now)
+            except Exception:
+                self.registry.get("collect_errors_total").labels(
+                    collector=name
+                ).inc()
+                continue
+            if ok:
+                self.registry.get("collector_last_collect_ts").set(
+                    now, collector=name
+                )
         latest = self.metric_cache.latest(mc.NODE_CPU_USAGE, "node")
         if latest is not None:
             self.predictor.observe(f"node/{self.config.node_name}", latest[1], now)
+            self.registry.get("node_cpu_usage_milli").set(latest[1])
+        mem_latest = self.metric_cache.latest(mc.NODE_MEMORY_USAGE, "node")
+        if mem_latest is not None:
+            self.registry.get("node_memory_usage_bytes").set(mem_latest[1])
+        be_latest = self.metric_cache.latest(mc.BE_CPU_USAGE, "node")
+        if be_latest is not None:
+            self.registry.get("be_cpu_usage_milli").set(be_latest[1])
         # derive prod tier = node − BE (exact when the kubepods hierarchy
         # partitions pods into tiers, as the reference's layout does)
         be = self.metric_cache.latest(mc.BE_CPU_USAGE, "node")

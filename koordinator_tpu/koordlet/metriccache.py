@@ -30,6 +30,18 @@ NODE_CPI = "node_cpi"                      # cycles per instruction
 NODE_PSI_CPU = "node_psi_cpu_some_avg10"
 NODE_PSI_MEM = "node_psi_mem_some_avg10"
 NODE_PSI_IO = "node_psi_io_some_avg10"
+SYS_CPU_USAGE = "sys_cpu_usage"            # non-pod system daemons, milli
+NODE_LLC_OCCUPANCY = "node_llc_occupancy"  # RDT LLC bytes
+NODE_MBM_TOTAL = "node_mbm_total_bytes"    # RDT memory bandwidth
+NODE_COLD_MEMORY = "node_cold_memory"      # kidled cold pages, MiB
+NODE_PAGECACHE = "node_pagecache"          # Cached, MiB
+POD_THROTTLED_RATIO = "pod_throttled_ratio"
+HOST_APP_CPU_USAGE = "host_app_cpu_usage"
+HOST_APP_MEMORY_USAGE = "host_app_memory_usage"
+NODE_DISK_READ_BPS = "node_disk_read_bps"
+NODE_DISK_WRITE_BPS = "node_disk_write_bps"
+DEVICE_UTIL = "device_util_pct"
+DEVICE_MEMORY_USED = "device_memory_used_mib"
 
 
 class _Ring:

@@ -1,4 +1,4 @@
-"""QoS manager: BE suppression / eviction / burst strategies.
+"""QoS manager: BE suppression / eviction / burst / reconcile strategies.
 
 Rebuild of ``pkg/koordlet/qosmanager/`` strategy plugins:
   * CPUSuppress (``plugins/cpusuppress/cpu_suppress.go:100-108``):
@@ -7,6 +7,13 @@ Rebuild of ``pkg/koordlet/qosmanager/`` strategy plugins:
   * CPUEvict / MemoryEvict (``cpuevict``, ``memoryevict``): evict BE pods
     when BE satisfaction or node memory utilization crosses thresholds.
   * CPUBurst (``cpuburst``): grant cfs burst to latency-sensitive pods.
+  * CgReconcile (``cgreconcile``): hold the QoS tier root cgroups at their
+    baseline knobs so one-off kernel/kubelet drift is healed every tick.
+  * Resctrl (``resctrl``): render per-tier RDT L3 way masks + MBA percent
+    into resctrl schemata writes.
+  * BlkIO (``blkio``): per-tier block-IO throttles.
+  * SysReconcile (``sysreconcile``): node-level vm knobs from the NodeSLO
+    system strategy.
 
 Each strategy is a pure decision function (fixture-testable exactly like
 the reference's table-driven tests) plus a thin applier that renders the
@@ -150,6 +157,83 @@ def cpu_burst_plan(
     return [(pod_group, rex.CPU_BURST, str(burst_us))]
 
 
+def cg_reconcile_plan(total_cpus: int) -> List[Tuple[str, str, str]]:
+    """``cgreconcile``: baseline tier-root knobs (burstable unrestricted,
+    besteffort at minimum shares) re-asserted every tick; the executor's
+    no-op suppression makes the steady state free."""
+    return [
+        ("kubepods", rex.CPU_SHARES, str(total_cpus * 1024)),
+        ("kubepods/burstable", rex.CPU_CFS_QUOTA, "-1"),
+        ("kubepods/besteffort", rex.CPU_SHARES, "2"),
+        ("kubepods/besteffort", rex.MEMORY_WMARK_RATIO, "95"),
+    ]
+
+
+def _llc_mask(percent: float, cache_ways: int) -> str:
+    """Contiguous low-order way mask covering ``percent`` of the LLC
+    (resctrl requires contiguous masks; the reference computes the same
+    ceil(ways×pct) low mask)."""
+    ways = max(int(-(-cache_ways * min(percent, 100.0) // 100.0)), 1)
+    return format((1 << ways) - 1, "x")
+
+
+def resctrl_schemata_plan(
+    strategy, cache_ways: int = 11, n_l3_domains: int = 1
+) -> List[Tuple[str, str, str]]:
+    """``resctrl`` strategy: one control group per QoS tier with an L3 way
+    mask + MB percent line per cache domain (resource_manager writing
+    ``/sys/fs/resctrl/<tier>/schemata``). Group dirs here are relative to
+    the executor root so tests run on a temp dir."""
+    from ..api.extension import QoSClass
+
+    plan: List[Tuple[str, str, str]] = []
+    for qos, tier in ((QoSClass.LSR, "LSR"), (QoSClass.LS, "LS"), (QoSClass.BE, "BE")):
+        llc = strategy.llc_percent.get(qos, 100.0)
+        mba = strategy.mba_percent.get(qos, 100.0)
+        l3_line = "L3:" + ";".join(
+            f"{d}={_llc_mask(llc, cache_ways)}" for d in range(n_l3_domains)
+        )
+        mb_line = "MB:" + ";".join(
+            f"{d}={int(min(mba, 100.0))}" for d in range(n_l3_domains)
+        )
+        plan.append((f"resctrl/{tier}", "schemata", l3_line + "\n" + mb_line))
+    return plan
+
+
+def blkio_plan(strategy, device: str = "8:0") -> List[Tuple[str, str, str]]:
+    """``blkio``: throttle the BE tier's block IO (blk-throttle knobs keyed
+    by major:minor, reference blkio strategy)."""
+    group = BE_GROUP
+    plan: List[Tuple[str, str, str]] = []
+    for limit, fname in (
+        (strategy.be_read_bps, "blkio.throttle.read_bps_device"),
+        (strategy.be_write_bps, "blkio.throttle.write_bps_device"),
+        (strategy.be_read_iops, "blkio.throttle.read_iops_device"),
+        (strategy.be_write_iops, "blkio.throttle.write_iops_device"),
+    ):
+        if limit > 0:
+            plan.append((group, fname, f"{device} {int(limit)}"))
+    return plan
+
+
+def sys_reconcile_plan(
+    strategy, node_memory_capacity_mib: float
+) -> List[Tuple[str, str, str]]:
+    """``sysreconcile``: vm knobs from NodeSLO systemStrategy; paths are
+    relative to the executor root ("proc/sys/vm" under a real root)."""
+    min_free_kbytes = int(
+        node_memory_capacity_mib * 1024.0 * strategy.min_free_kbytes_factor / 10000.0
+    )
+    return [
+        ("proc/sys/vm", "min_free_kbytes", str(min_free_kbytes)),
+        (
+            "proc/sys/vm",
+            "watermark_scale_factor",
+            str(int(strategy.watermark_scale_factor)),
+        ),
+    ]
+
+
 from typing import Callable
 
 
@@ -245,4 +329,17 @@ class QoSManager:
                     ),
                     reason="cpuburst",
                 )
+        # tier-root baseline reassertion (cgreconcile)
+        self.executor.apply(cg_reconcile_plan(self.total_cpus), reason="cgreconcile")
+        if slo.resctrl.enable:
+            self.executor.apply(
+                resctrl_schemata_plan(slo.resctrl), reason="resctrl"
+            )
+        if slo.blkio.enable:
+            self.executor.apply(blkio_plan(slo.blkio), reason="blkio")
+        if slo.system.enable:
+            self.executor.apply(
+                sys_reconcile_plan(slo.system, self.node_memory_capacity_mib),
+                reason="sysreconcile",
+            )
         return out

@@ -1,6 +1,6 @@
 """Runtime hooks: QoS enforcement at pod/container lifecycle.
 
-Rebuild of ``pkg/koordlet/runtimehooks/`` hook plugins:
+Rebuild of ``pkg/koordlet/runtimehooks/`` — all ten hook plugins:
   * groupidentity (``hooks/groupidentity/bvt.go:39-64``): per-QoS bvt
     (group identity) values so the CPU scheduler favors latency-sensitive
     groups: LSE/LSR/LS → 2, BE → −1, others → 0.
@@ -10,14 +10,32 @@ Rebuild of ``pkg/koordlet/runtimehooks/`` hook plugins:
   * cpuset (``hooks/cpuset``): apply the exclusive cpuset the scheduler
     wrote into ``scheduling.koordinator.sh/resource-status``.
   * coresched (``hooks/coresched``): per-QoS core scheduling cookies.
+  * cpunormalization (``hooks/cpunormalization``): scale cfs quota by the
+    node's CPU-model performance ratio annotation.
+  * resctrl (``hooks/resctrl``): assign the pod to its QoS tier's RDT
+    control group (schemata content is the qosmanager's job).
+  * tc (``hooks/tc``): net_cls classid per QoS tier for the tc/HTB
+    hierarchy.
+  * terwayqos (``hooks/terwayqos``): pod ingress/egress bandwidth from the
+    ``koordinator.sh/networkQOS`` annotation.
+  * gpu (``hooks/gpu``): container env from the scheduler's
+    ``device-allocated`` annotation (visible-device minors).
+  * rdma (``hooks/rdma``): RDMA device mounts from the same annotation.
+
+Cgroup-level hooks render write plans; container-spec-level hooks (gpu,
+rdma, terwayqos) render :class:`ContainerMutation` env/device patches —
+the NRI adjustment payload of the reference.
 
 The reference delivers hooks over three paths (CRI proxy gRPC, NRI, and a
 periodic reconciler); here every path funnels into the same pure
-``pod_plan`` rendering, and :class:`Reconciler` is the periodic driver.
+``pod_plan`` / ``pod_mutation`` rendering: :class:`Reconciler` is the
+periodic driver and :class:`NRIServer` (``nri/server.go``) the lifecycle
+driver (the CRI-proxy gRPC path lives in ``runtimeproxy``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -102,19 +120,164 @@ def core_sched_plan(pod: Pod) -> List[Tuple[str, str, str]]:
     return [(pod_cgroup(pod), rex.CORE_SCHED_COOKIE, str(cookie))]
 
 
+#: net_cls classids by QoS tier (tc hook: HTB classes 1:2 prod / 1:3 mid /
+#: 1:4 BE; classid wire format is 0xMAJOR0000|MINOR)
+NET_CLS_BY_QOS = {
+    QoSClass.LSE: 0x10002,
+    QoSClass.LSR: 0x10002,
+    QoSClass.LS: 0x10002,
+    QoSClass.BE: 0x10004,
+}
+
+NET_CLS_CLASSID = "net_cls.classid"
+
+
+def cpu_normalization_plan(
+    pod: Pod, ratio: float, period_us: int = 100_000
+) -> List[Tuple[str, str, str]]:
+    """cpunormalization hook: divide the cfs quota by the node's CPU
+    performance ratio so a "normalized milli" buys the same work on fast
+    and slow CPU models (the reference scales the batch/LS quota the same
+    way from the node annotation)."""
+    if ratio <= 0 or ratio == 1.0:
+        return []
+    # Only pods with an explicit CPU limit have a quota to normalize — a
+    # limitless pod runs at cfs quota -1 and must stay unthrottled.
+    cpu_limit = pod.spec.limits.get(ext.RES_CPU, 0.0)
+    if cpu_limit <= 0:
+        return []
+    # batchresource already derived this pod's quota from batch-cpu; the
+    # batch quota wins (the reference normalizes inside batchresource).
+    if pod.spec.requests.get(ext.RES_BATCH_CPU, 0.0) > 0:
+        return []
+    quota = int(cpu_limit / ratio / 1000.0 * period_us)
+    return [(pod_cgroup(pod), rex.CPU_CFS_QUOTA, str(quota))]
+
+
+def resctrl_group_plan(pod: Pod) -> List[Tuple[str, str, str]]:
+    """resctrl hook: record the pod's RDT control-group membership (the
+    reference moves container pids into /sys/fs/resctrl/<tier>/tasks; the
+    pid move is the runtime's side — the decision is the tier name)."""
+    tier = {
+        QoSClass.LSE: "LSR",
+        QoSClass.LSR: "LSR",
+        QoSClass.LS: "LS",
+        QoSClass.BE: "BE",
+    }.get(pod.qos)
+    if tier is None:
+        return []
+    return [(pod_cgroup(pod), "resctrl.group", tier)]
+
+
+def tc_plan(pod: Pod) -> List[Tuple[str, str, str]]:
+    classid = NET_CLS_BY_QOS.get(pod.qos)
+    if classid is None:
+        return []
+    return [(pod_cgroup(pod), NET_CLS_CLASSID, str(classid))]
+
+
+def terway_qos_plan(pod: Pod) -> List[Tuple[str, str, str]]:
+    """terwayqos hook: pod network bandwidth limits from the
+    ``koordinator.sh/networkQOS`` annotation (IngressLimit/EgressLimit in
+    bytes/s), written where the terway dataplane reads them."""
+    raw = pod.meta.annotations.get(ext.ANNOTATION_NETWORK_QOS)
+    if not raw:
+        return []
+    # a malformed user-supplied annotation must never break the node-wide
+    # reconcile pass — ignore the pod's network QoS instead
+    plan: List[Tuple[str, str, str]] = []
+    try:
+        spec = json.loads(raw)
+        if not isinstance(spec, dict):
+            return []
+        for key, fname in (
+            ("IngressLimit", "net_qos.ingress_bps"),
+            ("EgressLimit", "net_qos.egress_bps"),
+        ):
+            if key in spec:
+                plan.append((pod_cgroup(pod), fname, str(int(spec[key]))))
+    except (ValueError, TypeError):
+        return []
+    return plan
+
+
+@dataclasses.dataclass
+class ContainerMutation:
+    """Container-spec patch (the NRI ContainerAdjustment payload): env
+    vars + device nodes to expose."""
+
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    devices: List[str] = dataclasses.field(default_factory=list)
+
+
+def _parse_device_allocation(pod: Pod) -> Dict[str, List[Dict]]:
+    raw = pod.meta.annotations.get(ext.ANNOTATION_DEVICE_ALLOCATED)
+    if not raw:
+        return {}
+    try:
+        alloc = json.loads(raw)
+    except ValueError:
+        return {}
+    return alloc if isinstance(alloc, dict) else {}
+
+
+def gpu_mutation(pod: Pod) -> ContainerMutation:
+    """gpu hook: visible-device env from the scheduler's allocation
+    annotation (the reference writes NVIDIA_VISIBLE_DEVICES; accelerator-
+    neutral names carry the same minors for TPU hosts)."""
+    alloc = _parse_device_allocation(pod).get("gpu", [])
+    minors = [str(e.get("minor", -1)) for e in alloc if e.get("minor", -1) >= 0]
+    if not minors:
+        return ContainerMutation()
+    joined = ",".join(minors)
+    return ContainerMutation(
+        env={
+            "KOORD_VISIBLE_DEVICES": joined,
+            "NVIDIA_VISIBLE_DEVICES": joined,
+        },
+        devices=[f"/dev/accel{m}" for m in minors],
+    )
+
+
+def rdma_mutation(pod: Pod) -> ContainerMutation:
+    """rdma hook: expose allocated RDMA devices (/dev/infiniband/uverbsN)."""
+    alloc = _parse_device_allocation(pod).get("rdma", [])
+    minors = [e.get("minor", -1) for e in alloc if e.get("minor", -1) >= 0]
+    if not minors:
+        return ContainerMutation()
+    return ContainerMutation(
+        devices=[f"/dev/infiniband/uverbs{m}" for m in minors]
+    )
+
+
 ALL_HOOKS = (
     group_identity_plan,
     batch_resource_plan,
     cpuset_plan,
     core_sched_plan,
+    resctrl_group_plan,
+    tc_plan,
+    terway_qos_plan,
 )
 
+MUTATION_HOOKS = (gpu_mutation, rdma_mutation)
 
-def pod_plan(pod: Pod) -> List[Tuple[str, str, str]]:
+
+def pod_plan(pod: Pod, cpu_norm_ratio: float = 1.0) -> List[Tuple[str, str, str]]:
     plan: List[Tuple[str, str, str]] = []
     for hook in ALL_HOOKS:
         plan.extend(hook(pod))
+    plan.extend(cpu_normalization_plan(pod, cpu_norm_ratio))
     return plan
+
+
+def pod_mutation(pod: Pod) -> ContainerMutation:
+    merged = ContainerMutation()
+    for hook in MUTATION_HOOKS:
+        m = hook(pod)
+        merged.env.update(m.env)
+        merged.devices.extend(m.devices)
+    return merged
 
 
 class Reconciler:
@@ -124,9 +287,43 @@ class Reconciler:
 
     def __init__(self, executor: rex.ResourceExecutor):
         self.executor = executor
+        #: node CPU-model performance ratio (cpunormalization hook input,
+        #: published by the manager's cpunormalization plugin)
+        self.cpu_norm_ratio = 1.0
 
     def reconcile(self, pods: Sequence[Pod]) -> int:
         writes = 0
         for pod in pods:
-            writes += self.executor.apply(pod_plan(pod), reason="runtimehooks")
+            writes += self.executor.apply(
+                pod_plan(pod, self.cpu_norm_ratio), reason="runtimehooks"
+            )
         return writes
+
+
+class NRIServer:
+    """NRI-path delivery (``nri/server.go``): the container runtime calls
+    in at pod/container lifecycle points; responses carry cgroup writes
+    applied synchronously plus the container adjustment. The reference
+    registers RunPodSandbox / CreateContainer / UpdateContainerResources;
+    the PLEG-independent synchronous path is what distinguishes it from
+    the reconciler."""
+
+    def __init__(self, executor: rex.ResourceExecutor):
+        self.executor = executor
+        self.cpu_norm_ratio = 1.0
+
+    def run_pod_sandbox(self, pod: Pod) -> int:
+        """Pre-start: tier/bvt/netcls knobs must exist before containers."""
+        return self.executor.apply(
+            pod_plan(pod, self.cpu_norm_ratio), reason="nri:RunPodSandbox"
+        )
+
+    def create_container(self, pod: Pod) -> ContainerMutation:
+        """CreateContainer: return the spec adjustment (env/devices)."""
+        return pod_mutation(pod)
+
+    def update_container_resources(self, pod: Pod) -> int:
+        return self.executor.apply(
+            pod_plan(pod, self.cpu_norm_ratio),
+            reason="nri:UpdateContainerResources",
+        )

@@ -1,0 +1,94 @@
+"""Koordlet HTTP surface: metrics exposition + audit pull API.
+
+Rebuild of the koordlet's observability endpoints — the Prometheus metrics
+registry (``pkg/koordlet/metrics/``) and the audit log's HTTP pull API
+(``pkg/koordlet/audit/auditor.go:130-160,230``: GET with ``since`` /
+``group`` filters over the ring buffer).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import urllib.parse
+from typing import Optional
+
+from ..utils.metrics import Registry
+from .resourceexecutor import Auditor
+
+
+def koordlet_registry(reg: Optional[Registry] = None) -> Registry:
+    """The koordlet metric set (pkg/koordlet/metrics/): node/pod usage
+    gauges, BE suppression state, collector health."""
+    reg = reg or Registry(namespace="koordlet")
+    reg.gauge("node_cpu_usage_milli", "node CPU usage in millicores")
+    reg.gauge("node_memory_usage_bytes", "node memory usage")
+    reg.gauge("be_cpu_usage_milli", "best-effort tier CPU usage")
+    reg.gauge("be_cpu_limit_milli", "current BE suppression allowance")
+    reg.counter("be_evictions_total", "BE pods evicted by QoS strategies")
+    reg.counter(
+        "collect_errors_total", "collector failures", labels=("collector",)
+    )
+    reg.gauge(
+        "collector_last_collect_ts", "last success per collector",
+        labels=("collector",),
+    )
+    return reg
+
+
+class KoordletServer:
+    """Serves /metrics and /apis/v1/audit over HTTP."""
+
+    def __init__(self, registry: Registry, auditor: Auditor):
+        self.registry = registry
+        self.auditor = auditor
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+
+    def dispatch(self, path: str) -> tuple[int, str]:
+        parsed = urllib.parse.urlparse(path)
+        if parsed.path == "/metrics":
+            return 200, self.registry.expose()
+        if parsed.path == "/apis/v1/audit":
+            qs = urllib.parse.parse_qs(parsed.query)
+            since = float(qs.get("since", ["0"])[0])
+            group = qs.get("group", [""])[0]
+            events = self.auditor.query(since=since, group_prefix=group)
+            return 200, json.dumps(
+                [
+                    {
+                        "ts": e.ts,
+                        "group": e.group,
+                        "file": e.file,
+                        "old": e.old,
+                        "new": e.new,
+                        "reason": e.reason,
+                    }
+                    for e in events
+                ]
+            )
+        return 404, "not found"
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        srv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                code, text = srv.dispatch(self.path)
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

@@ -1,0 +1,204 @@
+"""States informer: single source of node-local state + callback registry.
+
+Rebuild of ``pkg/koordlet/statesinformer/`` — the one component every other
+koordlet subsystem reads state through (``statesinformer/api.go:117-132``
+callback registry, ``impl/callback_runner.go`` fan-out): Node, Pods (the
+reference pulls from the kubelet API via ``impl/kubelet_stub.go``; here a
+pluggable ``pod_source``), NodeSLO, NodeMetric collect spec,
+NodeResourceTopology (CPU topology + kubelet cpu-manager state,
+``impl/states_noderesourcetopology.go``) and the Device inventory
+(NVML GPU discovery in ``impl/states_device_linux.go`` — here an
+injectable prober, since TPU hosts enumerate accelerators differently).
+
+Consumers register callbacks per state type; every setter synchronously
+fans out to registered callbacks in registration order, exactly like the
+reference's callback runner draining its channel per update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
+
+from ..api.types import (
+    Device,
+    DeviceInfo,
+    Node,
+    NodeMetric,
+    NodeResourceTopology,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    TopologyZone,
+)
+from ..api import extension as ext
+from ..core.topology import CPUTopology
+
+
+class StateType(enum.Enum):
+    """Registered callback channels (reference RegisterTypeNodeSLOSpec /
+    ...NodeTopology / ...AllPods / ...NodeMetricSpec, api.go:117-132)."""
+
+    NODE = "node"
+    ALL_PODS = "all_pods"
+    NODE_SLO = "node_slo_spec"
+    NODE_METRIC_SPEC = "node_metric_spec"
+    NODE_TOPOLOGY = "node_topology"
+    DEVICE = "device"
+
+
+Callback = Callable[[object], None]
+
+
+class CallbackRunner:
+    """Per-state-type callback fan-out (impl/callback_runner.go)."""
+
+    def __init__(self):
+        self._callbacks: Dict[StateType, List[tuple]] = {t: [] for t in StateType}
+        self._lock = threading.Lock()
+
+    def register(self, state: StateType, name: str, fn: Callback) -> None:
+        with self._lock:
+            self._callbacks[state].append((name, fn))
+
+    def fire(self, state: StateType, value: object) -> List[str]:
+        with self._lock:
+            cbs = list(self._callbacks[state])
+        fired = []
+        for name, fn in cbs:
+            fn(value)
+            fired.append(name)
+        return fired
+
+
+class DeviceProber(Protocol):
+    """Injectable accelerator discovery (the reference's NVML binding)."""
+
+    def probe(self) -> List[DeviceInfo]: ...
+
+
+@dataclasses.dataclass
+class FakeDeviceProber:
+    """Test/simulator prober; the production analog shells out to the
+    platform's accelerator enumeration."""
+
+    devices: List[DeviceInfo] = dataclasses.field(default_factory=list)
+
+    def probe(self) -> List[DeviceInfo]:
+        return list(self.devices)
+
+
+class StatesInformer:
+    """Holds the latest node-local state; setters fire callbacks."""
+
+    def __init__(self, node_name: str = "node-local"):
+        self.node_name = node_name
+        self.callbacks = CallbackRunner()
+        self._lock = threading.Lock()
+        self._node: Optional[Node] = None
+        self._pods: List[Pod] = []
+        self._node_slo: Optional[NodeSLO] = None
+        self._node_metric_spec: Optional[NodeMetric] = None
+        self._topology: Optional[NodeResourceTopology] = None
+        self._device: Optional[Device] = None
+
+    # ---- setters (watch-stream analogs) ----
+
+    def set_node(self, node: Node) -> None:
+        with self._lock:
+            self._node = node
+        self.callbacks.fire(StateType.NODE, node)
+
+    def set_pods(self, pods: Sequence[Pod]) -> None:
+        with self._lock:
+            self._pods = list(pods)
+        self.callbacks.fire(StateType.ALL_PODS, list(pods))
+
+    def set_node_slo(self, slo: NodeSLO) -> None:
+        with self._lock:
+            self._node_slo = slo
+        self.callbacks.fire(StateType.NODE_SLO, slo)
+
+    def set_node_metric_spec(self, spec: NodeMetric) -> None:
+        with self._lock:
+            self._node_metric_spec = spec
+        self.callbacks.fire(StateType.NODE_METRIC_SPEC, spec)
+
+    # ---- reporters (status writes in the reference) ----
+
+    def report_topology(
+        self,
+        topo: CPUTopology,
+        kubelet_reserved: Sequence[int] = (),
+        policy: str = "None",
+        mem_per_numa_bytes: float = 0.0,
+    ) -> NodeResourceTopology:
+        """Build + publish the NodeResourceTopology report
+        (states_noderesourcetopology.go: zones from sysfs topology, kubelet
+        cpu-manager state read back so the scheduler never double-allocates
+        kubelet-reserved CPUs)."""
+        by_numa: Dict[int, int] = {}
+        for info in topo.cpus:
+            by_numa[info.numa_node] = by_numa.get(info.numa_node, 0) + 1
+        zones = [
+            TopologyZone(
+                name=f"node-{numa}",
+                allocatable={
+                    ext.RES_CPU: 1000.0 * cnt,
+                    ext.RES_MEMORY: mem_per_numa_bytes,
+                },
+                capacity={
+                    ext.RES_CPU: 1000.0 * cnt,
+                    ext.RES_MEMORY: mem_per_numa_bytes,
+                },
+            )
+            for numa, cnt in sorted(by_numa.items())
+        ]
+        report = NodeResourceTopology(
+            meta=ObjectMeta(name=self.node_name),
+            zones=zones,
+            cpu_topology={
+                c.cpu_id: (c.core_id, c.numa_node, c.socket) for c in topo.cpus
+            },
+            kubelet_reserved_cpus=list(kubelet_reserved),
+            topology_policy=policy,
+        )
+        with self._lock:
+            self._topology = report
+        self.callbacks.fire(StateType.NODE_TOPOLOGY, report)
+        return report
+
+    def report_devices(self, prober: DeviceProber) -> Device:
+        """Probe accelerators and publish the Device inventory
+        (states_device_linux.go NVML walk)."""
+        report = Device(
+            meta=ObjectMeta(name=self.node_name), devices=prober.probe()
+        )
+        with self._lock:
+            self._device = report
+        self.callbacks.fire(StateType.DEVICE, report)
+        return report
+
+    # ---- getters ----
+
+    def node(self) -> Optional[Node]:
+        with self._lock:
+            return self._node
+
+    def pods(self) -> List[Pod]:
+        with self._lock:
+            return list(self._pods)
+
+    def node_slo(self) -> Optional[NodeSLO]:
+        with self._lock:
+            return self._node_slo
+
+    def topology(self) -> Optional[NodeResourceTopology]:
+        with self._lock:
+            return self._topology
+
+    def device(self) -> Optional[Device]:
+        with self._lock:
+            return self._device

@@ -17,6 +17,13 @@
 #include <cstring>
 #include <cstdlib>
 
+#if defined(__linux__)
+#include <linux/perf_event.h>
+#include <sys/ioctl.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+#endif
+
 extern "C" {
 
 typedef struct {
@@ -132,6 +139,201 @@ int koord_read_cgroup_cpu_ns(const char* root, const char* group,
     }
   }
   std::fclose(f);
+  return rc;
+}
+
+// --- CPI via perf_event_open --------------------------------------------
+//
+// The reference's only cgo component binds libpfm4 to set up
+// perf_event_open counter groups for cycles/instructions per cgroup
+// (perf_group_linux.go). The two generic hardware events need no event-
+// encoding library, so this rebuild calls the syscall directly: one
+// counter group (cycles leader + instructions) per CPU-wide session.
+// Unprivileged containers typically get EPERM/EACCES — callers must treat
+// rc != 0 as "CPI unavailable" (the reference gates the collector behind
+// a feature flag for the same reason).
+
+#if defined(__linux__)
+// System-wide counting needs one fd pair per online CPU with pid=-1,
+// cpu=N (pid=-1 with cpu=-1 is EINVAL); reads are summed across CPUs.
+#define KOORD_CPI_MAX_CPUS 512
+static int cpi_n_cpus = 0;
+static int cpi_fd_cycles[KOORD_CPI_MAX_CPUS];
+static int cpi_fd_instr[KOORD_CPI_MAX_CPUS];
+
+void koord_cpi_close(void);
+
+static int perf_open_cpu(unsigned long long config, int cpu, int group_fd) {
+  struct perf_event_attr attr;
+  std::memset(&attr, 0, sizeof(attr));
+  attr.type = PERF_TYPE_HARDWARE;
+  attr.size = sizeof(attr);
+  attr.config = config;
+  attr.disabled = group_fd == -1 ? 1 : 0;
+  attr.exclude_hv = 1;
+  return (int)syscall(SYS_perf_event_open, &attr, -1 /*all pids*/, cpu,
+                      group_fd, 0);
+}
+
+// Open a cycles+instructions group on every online CPU. Returns 0 on
+// success (requires perf_event_paranoid <= 0 or CAP_PERFMON for
+// system-wide counters — unprivileged containers get EPERM/EACCES).
+int koord_cpi_open(void) {
+  if (cpi_n_cpus > 0) return 0;
+  long n = sysconf(_SC_NPROCESSORS_ONLN);
+  if (n <= 0) return -1;
+  if (n > KOORD_CPI_MAX_CPUS) n = KOORD_CPI_MAX_CPUS;
+  for (int cpu = 0; cpu < (int)n; cpu++) {
+    int fc = perf_open_cpu(PERF_COUNT_HW_CPU_CYCLES, cpu, -1);
+    if (fc < 0) {
+      koord_cpi_close();
+      return -1;
+    }
+    int fi = perf_open_cpu(PERF_COUNT_HW_INSTRUCTIONS, cpu, fc);
+    if (fi < 0) {
+      close(fc);
+      koord_cpi_close();
+      return -1;
+    }
+    ioctl(fc, PERF_EVENT_IOC_RESET, PERF_IOC_FLAG_GROUP);
+    ioctl(fc, PERF_EVENT_IOC_ENABLE, PERF_IOC_FLAG_GROUP);
+    cpi_fd_cycles[cpi_n_cpus] = fc;
+    cpi_fd_instr[cpi_n_cpus] = fi;
+    cpi_n_cpus++;
+  }
+  return 0;
+}
+
+// Cumulative node-wide cycles/instructions since open. 0 on success.
+int koord_cpi_read(double* cycles, double* instructions) {
+  if (cpi_n_cpus <= 0) return -1;
+  double c_total = 0, i_total = 0;
+  for (int k = 0; k < cpi_n_cpus; k++) {
+    unsigned long long c = 0, i = 0;
+    if (read(cpi_fd_cycles[k], &c, sizeof(c)) != sizeof(c)) return -1;
+    if (read(cpi_fd_instr[k], &i, sizeof(i)) != sizeof(i)) return -1;
+    c_total += (double)c;
+    i_total += (double)i;
+  }
+  *cycles = c_total;
+  *instructions = i_total;
+  return 0;
+}
+
+void koord_cpi_close(void) {
+  for (int k = 0; k < cpi_n_cpus; k++) {
+    close(cpi_fd_cycles[k]);
+    close(cpi_fd_instr[k]);
+  }
+  cpi_n_cpus = 0;
+}
+#else
+int koord_cpi_open(void) { return -1; }
+int koord_cpi_read(double* cycles, double* instructions) {
+  (void)cycles;
+  (void)instructions;
+  return -1;
+}
+void koord_cpi_close(void) {}
+#endif
+
+// Cached page bytes from /proc/meminfo (pagecache collector). 0 on success.
+int koord_read_pagecache_kib(double* cached_kib) {
+  FILE* f = std::fopen("/proc/meminfo", "r");
+  if (!f) return -1;
+  char line[256];
+  int rc = -1;
+  while (std::fgets(line, sizeof(line), f)) {
+    unsigned long long kb;
+    if (std::sscanf(line, "Cached: %llu kB", &kb) == 1) {
+      *cached_kib = (double)kb;
+      rc = 0;
+      break;
+    }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+// CFS throttling counters of a cgroup's cpu.stat (podthrottled collector).
+// Returns 0 on success.
+int koord_read_cgroup_throttled(const char* root, const char* group,
+                                double* nr_periods, double* nr_throttled) {
+  char path[512];
+  std::snprintf(path, sizeof(path), "%s/%s/cpu.stat", root, group);
+  FILE* f = std::fopen(path, "r");
+  if (!f) return -1;
+  char line[256];
+  *nr_periods = 0;
+  *nr_throttled = 0;
+  int found = 0;
+  while (std::fgets(line, sizeof(line), f)) {
+    unsigned long long v;
+    if (std::sscanf(line, "nr_periods %llu", &v) == 1) {
+      *nr_periods = (double)v;
+      found++;
+    } else if (std::sscanf(line, "nr_throttled %llu", &v) == 1) {
+      *nr_throttled = (double)v;
+      found++;
+    }
+  }
+  std::fclose(f);
+  return found == 2 ? 0 : -1;
+}
+
+// True for partition / stacked-device rows that would double-count IO
+// already reported by the whole-disk row: sdX1/vdX1/hdX1/xvdX1 (letters
+// then trailing digits), nvme0n1p1/mmcblk0p1 (pN suffix), and dm-/md
+// virtual devices layered over real disks.
+static int koord_diskstats_skip(const char* name) {
+  size_t len = std::strlen(name);
+  if (len == 0) return 1;
+  if (std::strncmp(name, "loop", 4) == 0 || std::strncmp(name, "ram", 3) == 0)
+    return 1;
+  if (std::strncmp(name, "dm-", 3) == 0 || std::strncmp(name, "md", 2) == 0)
+    return 1;
+  // pN suffix (nvme/mmcblk partitions)
+  size_t i = len;
+  while (i > 0 && name[i - 1] >= '0' && name[i - 1] <= '9') i--;
+  if (i < len) {
+    if (i > 0 && name[i - 1] == 'p' &&
+        (std::strncmp(name, "nvme", 4) == 0 ||
+         std::strncmp(name, "mmcblk", 6) == 0))
+      return 1;
+    // letters-then-digits partitions of sd/hd/vd/xvd disks
+    if (std::strncmp(name, "sd", 2) == 0 || std::strncmp(name, "hd", 2) == 0 ||
+        std::strncmp(name, "vd", 2) == 0 || std::strncmp(name, "xvd", 3) == 0)
+      return 1;
+  }
+  return 0;
+}
+
+// Aggregate sectors read/written across /proc/diskstats whole physical
+// disks (nodestorageinfo collector). Returns 0 on success.
+int koord_read_diskstats(double* sectors_read, double* sectors_written) {
+  FILE* f = std::fopen("/proc/diskstats", "r");
+  if (!f) return -1;
+  char line[512];
+  unsigned long long r_total = 0, w_total = 0;
+  int rc = -1;
+  while (std::fgets(line, sizeof(line), f)) {
+    unsigned major, minor;
+    char name[64];
+    unsigned long long rd_ios, rd_merges, rd_sectors, rd_ticks;
+    unsigned long long wr_ios, wr_merges, wr_sectors;
+    int n = std::sscanf(line, "%u %u %63s %llu %llu %llu %llu %llu %llu %llu",
+                        &major, &minor, name, &rd_ios, &rd_merges,
+                        &rd_sectors, &rd_ticks, &wr_ios, &wr_merges,
+                        &wr_sectors);
+    if (n == 10 && !koord_diskstats_skip(name)) {
+      r_total += rd_sectors;
+      w_total += wr_sectors;
+      rc = 0;
+    }
+  }
+  std::fclose(f);
+  *sectors_read = (double)r_total;
+  *sectors_written = (double)w_total;
   return rc;
 }
 

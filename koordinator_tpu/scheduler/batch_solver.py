@@ -111,6 +111,9 @@ class BatchScheduler:
         self.reservations = None
         #: frameworkext spine: transformers, monitor, errors, debug, services
         self.extender = extender or FrameworkExtender()
+        # the watchdog must sweep concurrently — a hung solve can't sweep
+        # itself (scheduler_monitor.go runs it on its own goroutine)
+        self.extender.monitor.start_background()
         self._params = self.args.solver_params(self.snapshot.config)
         self._scales = self.args.scale_vector(self.snapshot.config)
 
@@ -256,7 +259,6 @@ class BatchScheduler:
         fwext.registry.get("scheduled_pods_total").inc(len(bound))
         fwext.registry.get("unschedulable_pods_total").inc(len(unsched))
         fwext.registry.get("waiting_gang_group_number").set(float(len(gated_groups)))
-        fwext.monitor.sweep()
         return ScheduleOutcome(bound=bound, unschedulable=unsched, rounds_used=rounds)
 
     def _debug_capture(self, chunk: Sequence[Pod], assignment: np.ndarray) -> None:

@@ -107,6 +107,29 @@ class SchedulerMonitor:
         self._inflight: Dict[str, Tuple[str, float]] = {}
         self._lock = threading.Lock()
         self._last_sweep = 0.0
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start_background(self) -> None:
+        """Start the watchdog goroutine-analog: a daemon thread sweeping
+        every ``period_s`` (the reference's 10 s wait.Until). The batch
+        cycle is synchronous, so only a concurrent sweeper can flag a
+        solver hang — in-flight pods whose attempt started > timeout ago."""
+        if self._sweeper is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.period_s):
+                for name in self.sweep():
+                    print(f"koord-scheduler: pod {name} scheduling timeout")
+
+        self._sweeper = threading.Thread(target=loop, daemon=True)
+        self._sweeper.start()
+
+    def stop_background(self) -> None:
+        self._stop.set()
+        self._sweeper = None
 
     def start_monitor(self, pod: Pod, now: Optional[float] = None) -> None:
         with self._lock:

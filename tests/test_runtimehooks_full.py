@@ -1,0 +1,171 @@
+"""Full runtime-hook inventory + qosmanager reconcile strategies
+(reference pkg/koordlet/runtimehooks/hooks/* — 10 plugins — and
+pkg/koordlet/qosmanager plugins cgreconcile/resctrl/blkio/sysreconcile)."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    BlkIOStrategy,
+    NodeSLO,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResctrlStrategy,
+    SystemStrategy,
+)
+from koordinator_tpu.api.extension import QoSClass
+from koordinator_tpu.koordlet import qosmanager as qos
+from koordinator_tpu.koordlet import resourceexecutor as rex
+from koordinator_tpu.koordlet import runtimehooks as hooks
+
+
+def mkpod(name, qos_label="LS", annotations=None, requests=None, limits=None):
+    return Pod(
+        meta=ObjectMeta(
+            name=name,
+            uid=name,
+            labels={ext.LABEL_POD_QOS: qos_label},
+            annotations=annotations or {},
+        ),
+        spec=PodSpec(requests=requests or {}, limits=limits or {}),
+    )
+
+
+class TestNewCgroupHooks:
+    def test_cpu_normalization_scales_quota(self):
+        pod = mkpod("p", limits={ext.RES_CPU: 2000.0})
+        plan = hooks.cpu_normalization_plan(pod, ratio=1.25)
+        assert plan == [
+            (hooks.pod_cgroup(pod), rex.CPU_CFS_QUOTA, str(int(2000 / 1.25 / 1000 * 100_000)))
+        ]
+        assert hooks.cpu_normalization_plan(pod, ratio=1.0) == []
+
+    def test_resctrl_group_by_qos(self):
+        assert hooks.resctrl_group_plan(mkpod("a", "LSR"))[0][2] == "LSR"
+        assert hooks.resctrl_group_plan(mkpod("b", "BE"))[0][2] == "BE"
+
+    def test_tc_classid(self):
+        assert hooks.tc_plan(mkpod("a", "LS"))[0][2] == str(0x10002)
+        assert hooks.tc_plan(mkpod("b", "BE"))[0][2] == str(0x10004)
+
+    def test_terway_qos_from_annotation(self):
+        pod = mkpod(
+            "p",
+            annotations={
+                ext.ANNOTATION_NETWORK_QOS: json.dumps(
+                    {"IngressLimit": 1048576, "EgressLimit": 2097152}
+                )
+            },
+        )
+        plan = hooks.terway_qos_plan(pod)
+        assert (hooks.pod_cgroup(pod), "net_qos.ingress_bps", "1048576") in plan
+        assert (hooks.pod_cgroup(pod), "net_qos.egress_bps", "2097152") in plan
+        assert hooks.terway_qos_plan(mkpod("q")) == []
+
+
+class TestMutationHooks:
+    def test_gpu_mutation_env_and_devices(self):
+        alloc = {"gpu": [{"minor": 0, "resources": {}}, {"minor": 3, "resources": {}}]}
+        pod = mkpod(
+            "p", annotations={ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(alloc)}
+        )
+        m = hooks.gpu_mutation(pod)
+        assert m.env["KOORD_VISIBLE_DEVICES"] == "0,3"
+        assert m.env["NVIDIA_VISIBLE_DEVICES"] == "0,3"
+        assert m.devices == ["/dev/accel0", "/dev/accel3"]
+
+    def test_rdma_mutation(self):
+        alloc = {"rdma": [{"minor": 1}]}
+        pod = mkpod(
+            "p", annotations={ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(alloc)}
+        )
+        assert hooks.rdma_mutation(pod).devices == ["/dev/infiniband/uverbs1"]
+
+    def test_no_allocation_is_empty(self):
+        m = hooks.pod_mutation(mkpod("p"))
+        assert m.env == {} and m.devices == []
+
+
+class TestNRIServer:
+    def test_lifecycle_paths(self, tmp_path):
+        executor = rex.ResourceExecutor(str(tmp_path))
+        srv = hooks.NRIServer(executor)
+        pod = mkpod(
+            "p",
+            "BE",
+            requests={ext.RES_BATCH_CPU: 2000.0, ext.RES_BATCH_MEMORY: 1024.0},
+            annotations={
+                ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps({"gpu": [{"minor": 0}]})
+            },
+        )
+        writes = srv.run_pod_sandbox(pod)
+        assert writes > 0
+        # bvt applied for BE
+        assert executor.read(hooks.pod_cgroup(pod), rex.CPU_BVT) == "-1"
+        mut = srv.create_container(pod)
+        assert mut.env["KOORD_VISIBLE_DEVICES"] == "0"
+        assert srv.update_container_resources(pod) == 0  # steady state: no-op
+
+    def test_audit_records_nri_reason(self, tmp_path):
+        executor = rex.ResourceExecutor(str(tmp_path))
+        hooks.NRIServer(executor).run_pod_sandbox(mkpod("p", "BE"))
+        reasons = {e.reason for e in executor.auditor.query()}
+        assert "nri:RunPodSandbox" in reasons
+
+
+class TestQoSReconcileStrategies:
+    def test_cg_reconcile_baseline(self, tmp_path):
+        executor = rex.ResourceExecutor(str(tmp_path))
+        executor.apply(qos.cg_reconcile_plan(total_cpus=8), reason="cgreconcile")
+        assert executor.read("kubepods", rex.CPU_SHARES) == str(8 * 1024)
+        assert executor.read("kubepods/besteffort", rex.CPU_SHARES) == "2"
+
+    def test_resctrl_schemata_masks(self):
+        strategy = ResctrlStrategy(
+            enable=True,
+            llc_percent={QoSClass.LSR: 100.0, QoSClass.LS: 100.0, QoSClass.BE: 30.0},
+            mba_percent={QoSClass.LSR: 100.0, QoSClass.LS: 100.0, QoSClass.BE: 50.0},
+        )
+        plan = qos.resctrl_schemata_plan(strategy, cache_ways=10, n_l3_domains=2)
+        by_group = {g: v for g, _f, v in plan}
+        # BE: ceil(10*0.3)=3 ways -> 0x7; two domains
+        assert by_group["resctrl/BE"] == "L3:0=7;1=7\nMB:0=50;1=50"
+        assert by_group["resctrl/LS"].startswith("L3:0=3ff")
+
+    def test_llc_mask_minimum_one_way(self):
+        assert qos._llc_mask(0.0, 11) == "1"
+
+    def test_blkio_plan(self):
+        strategy = BlkIOStrategy(enable=True, be_read_bps=1 << 20, be_write_iops=100)
+        plan = qos.blkio_plan(strategy, device="253:0")
+        assert (qos.BE_GROUP, "blkio.throttle.read_bps_device", "253:0 1048576") in plan
+        assert (qos.BE_GROUP, "blkio.throttle.write_iops_device", "253:0 100") in plan
+        assert len(plan) == 2
+
+    def test_sys_reconcile_plan(self):
+        strategy = SystemStrategy(
+            enable=True, min_free_kbytes_factor=100.0, watermark_scale_factor=150.0
+        )
+        plan = qos.sys_reconcile_plan(strategy, node_memory_capacity_mib=1024.0)
+        assert ("proc/sys/vm", "min_free_kbytes", str(int(1024 * 1024 * 100 / 10000))) in plan
+        assert ("proc/sys/vm", "watermark_scale_factor", "150") in plan
+
+    def test_run_once_applies_enabled_strategies(self, tmp_path):
+        executor = rex.ResourceExecutor(str(tmp_path))
+        mgr = qos.QoSManager(
+            executor,
+            total_cpus=8,
+            node_allocatable_milli=8000.0,
+            node_memory_capacity_mib=1024.0,
+        )
+        slo = NodeSLO(meta=ObjectMeta(name="n"))
+        slo.resctrl.enable = True
+        slo.system.enable = True
+        slo.blkio.enable = True
+        slo.blkio.be_read_bps = 1000
+        mgr.run_once(slo, node_used_milli=0, be_used_milli=0, node_memory_used_mib=0)
+        reasons = {e.reason for e in executor.auditor.query()}
+        assert {"cgreconcile", "resctrl", "blkio", "sysreconcile"} <= reasons

@@ -1,0 +1,164 @@
+"""statesinformer + pleg + koordlet HTTP surface (reference
+pkg/koordlet/statesinformer, pkg/koordlet/pleg, pkg/koordlet/audit)."""
+
+import json
+import os
+import urllib.request
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    DeviceInfo,
+    Node,
+    NodeSLO,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.core.topology import CPUTopology
+from koordinator_tpu.koordlet.daemon import Koordlet, KoordletConfig
+from koordinator_tpu.koordlet.pleg import EventType, Pleg
+from koordinator_tpu.koordlet.resourceexecutor import AuditEvent, Auditor
+from koordinator_tpu.koordlet.server import KoordletServer, koordlet_registry
+from koordinator_tpu.koordlet.statesinformer import (
+    FakeDeviceProber,
+    StatesInformer,
+    StateType,
+)
+
+
+class TestStatesInformer:
+    def test_callbacks_fire_in_registration_order(self):
+        inf = StatesInformer("n1")
+        calls = []
+        inf.callbacks.register(StateType.ALL_PODS, "a", lambda v: calls.append("a"))
+        inf.callbacks.register(StateType.ALL_PODS, "b", lambda v: calls.append("b"))
+        inf.set_pods([])
+        assert calls == ["a", "b"]
+
+    def test_state_is_readable_back(self):
+        inf = StatesInformer("n1")
+        node = Node(meta=ObjectMeta(name="n1"), status=NodeStatus())
+        inf.set_node(node)
+        pod = Pod(meta=ObjectMeta(name="p"), spec=PodSpec())
+        inf.set_pods([pod])
+        slo = NodeSLO(meta=ObjectMeta(name="n1"))
+        inf.set_node_slo(slo)
+        assert inf.node() is node
+        assert inf.pods()[0].meta.name == "p"
+        assert inf.node_slo() is slo
+
+    def test_topology_report_builds_zones(self):
+        inf = StatesInformer("n1")
+        got = []
+        inf.callbacks.register(StateType.NODE_TOPOLOGY, "t", got.append)
+        topo = CPUTopology.uniform(
+            sockets=2, numa_per_socket=1, cores_per_numa=4, threads_per_core=2
+        )
+        report = inf.report_topology(
+            topo, kubelet_reserved=[0, 1], policy="SingleNUMANode",
+            mem_per_numa_bytes=float(32 << 30),
+        )
+        assert len(report.zones) == 2
+        # 8 logical CPUs per NUMA node → 8000 milli
+        assert report.zones[0].allocatable[ext.RES_CPU] == 8000.0
+        assert report.kubelet_reserved_cpus == [0, 1]
+        assert report.cpu_topology[0] == (0, 0, 0)
+        assert got == [report] and inf.topology() is report
+
+    def test_device_report_via_prober(self):
+        inf = StatesInformer("n1")
+        prober = FakeDeviceProber(
+            devices=[DeviceInfo(dev_type="gpu", minor=i, numa_node=i % 2) for i in range(4)]
+        )
+        report = inf.report_devices(prober)
+        assert len(report.devices) == 4
+        assert inf.device() is report
+
+
+class TestPleg:
+    def test_lifecycle_events(self, tmp_path):
+        root = str(tmp_path)
+        pleg = Pleg(root)
+        events = []
+        hid = pleg.register_handler(events.append)
+        assert pleg.tick() == []
+        os.makedirs(os.path.join(root, "kubepods/besteffort/pod-abc/ctr-1"))
+        got = pleg.tick()
+        assert [e.type for e in got] == [
+            EventType.POD_ADDED,
+            EventType.CONTAINER_ADDED,
+        ]
+        assert got[0].pod_dir == "kubepods/besteffort/pod-abc"
+        assert got[1].container_id == "ctr-1"
+        # container exits, then the pod dir vanishes
+        os.rmdir(os.path.join(root, "kubepods/besteffort/pod-abc/ctr-1"))
+        assert [e.type for e in pleg.tick()] == [EventType.CONTAINER_DELETED]
+        os.rmdir(os.path.join(root, "kubepods/besteffort/pod-abc"))
+        assert [e.type for e in pleg.tick()] == [EventType.POD_DELETED]
+        assert len(events) == 4
+        pleg.unregister_handler(hid)
+        os.makedirs(os.path.join(root, "kubepods/pod-x"))
+        pleg.tick()
+        assert len(events) == 4  # unregistered handler not called
+
+    def test_non_pod_dirs_ignored(self, tmp_path):
+        root = str(tmp_path)
+        os.makedirs(os.path.join(root, "kubepods/burstable"))
+        os.makedirs(os.path.join(root, "kubepods/someother"))
+        assert Pleg(root).tick() == []
+
+
+class TestKoordletServer:
+    def test_audit_pull_api(self):
+        auditor = Auditor()
+        auditor.record(
+            AuditEvent(ts=10.0, group="kubepods/pod-a", file="cpu.shares",
+                       old="1024", new="2", reason="suppress")
+        )
+        auditor.record(
+            AuditEvent(ts=20.0, group="kubepods/pod-b", file="cpu.shares",
+                       old=None, new="2", reason="suppress")
+        )
+        srv = KoordletServer(koordlet_registry(), auditor)
+        code, body = srv.dispatch("/apis/v1/audit?since=15")
+        assert code == 200
+        events = json.loads(body)
+        assert len(events) == 1 and events[0]["group"] == "kubepods/pod-b"
+        code, body = srv.dispatch("/apis/v1/audit?group=kubepods/pod-a")
+        assert json.loads(body)[0]["file"] == "cpu.shares"
+        assert srv.dispatch("/nope")[0] == 404
+
+    def test_metrics_over_http(self):
+        reg = koordlet_registry()
+        reg.get("node_cpu_usage_milli").set(1234.0)
+        srv = KoordletServer(reg, Auditor())
+        port = srv.serve()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as resp:
+                body = resp.read().decode()
+            assert "koordlet_node_cpu_usage_milli 1234.0" in body
+        finally:
+            srv.shutdown()
+
+
+class TestDaemonWiring:
+    def test_informer_drives_reconciler_and_metrics(self, tmp_path):
+        cfg = KoordletConfig(cgroup_root=str(tmp_path), n_cpus=4)
+        agent = Koordlet(cfg)
+        pod = Pod(
+            meta=ObjectMeta(
+                name="be-pod", uid="u1", labels={ext.LABEL_POD_QOS: "BE"}
+            ),
+            spec=PodSpec(requests={ext.RES_BATCH_CPU: 2000.0}),
+        )
+        agent.update_pods([pod])
+        assert agent.pods and agent.pods[0].meta.name == "be-pod"
+        agent.collect_tick(now=100.0)
+        # collector health metrics exist for every collector
+        text = agent.registry.expose()
+        assert "koordlet_collector_last_collect_ts" in text or (
+            "koordlet_collect_errors_total" in text
+        )
