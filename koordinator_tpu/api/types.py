@@ -277,6 +277,45 @@ class PodMigrationJob:
     create_time: float = dataclasses.field(default_factory=time.time)
 
 
+# --- quota.koordinator.sh/ElasticQuotaProfile ---
+
+
+@dataclasses.dataclass
+class ElasticQuotaProfile:
+    """Quota tree generator (reference
+    ``apis/quota/v1alpha1/elastic_quota_profile_types.go`` + reconciler
+    ``pkg/quota-controller/profile/``): selects a set of nodes by label and
+    maintains a root ElasticQuota whose min/max track the selected nodes'
+    total allocatable."""
+
+    meta: ObjectMeta
+    quota_name: str = ""
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    quota_labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: resource dims to sum over selected nodes; empty = all reported
+    resource_keys: List[str] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.quota_name:
+            self.quota_name = self.meta.name
+
+
+# --- analysis.koordinator.sh/Recommendation ---
+
+
+@dataclasses.dataclass
+class Recommendation:
+    """Resource recommendation scaffold (reference
+    ``apis/analysis/v1alpha1/recommendation_types.go``): target workload +
+    the p95-peak resource estimate produced from prediction histograms."""
+
+    meta: ObjectMeta
+    workload_kind: str = "Deployment"
+    workload_name: str = ""
+    recommended: ResourceList = dataclasses.field(default_factory=dict)
+    update_time: float = dataclasses.field(default_factory=time.time)
+
+
 # --- config.koordinator.sh/ClusterColocationProfile ---
 
 
@@ -404,6 +443,8 @@ __all__ = [
     "Device",
     "DeviceInfo",
     "ElasticQuota",
+    "ElasticQuotaProfile",
+    "Recommendation",
     "MigrationMode",
     "MigrationPhase",
     "Node",

@@ -1,0 +1,55 @@
+"""ClusterColocationProfile reconciler for existing pods.
+
+Rebuild of ``pkg/controller/colocationprofile/``: the mutating webhook only
+touches pods at admission; when a profile is created or changed, this
+controller walks already-admitted pods and applies the profile's mutations
+to those that match and are not yet consistent (the reference patches
+labels/annotations; scheduler-visible spec fields stay immutable on bound
+pods, so only pending pods get resource rewrites).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..api.types import ClusterColocationProfile, Pod, PodPhase
+from .profile import ProfileMutator
+
+
+class ColocationProfileController:
+    def __init__(self, mutator: ProfileMutator):
+        self.mutator = mutator
+
+    def reconcile(self, pods: Iterable[Pod]) -> List[Pod]:
+        """Returns the pods that were changed."""
+        changed: List[Pod] = []
+        for pod in pods:
+            matched = self.mutator.match(pod)
+            if not matched:
+                continue
+            before = (
+                dict(pod.meta.labels),
+                dict(pod.meta.annotations),
+                pod.spec.priority,
+                pod.spec.scheduler_name,
+                dict(pod.spec.requests),
+                dict(pod.spec.limits),
+            )
+            if pod.phase is PodPhase.PENDING and pod.spec.node_name is None:
+                self.mutator.mutate(pod)
+            else:
+                # bound pods: metadata-only reconcile
+                for p in sorted(matched, key=lambda p: p.meta.name):
+                    pod.meta.labels.update(p.labels)
+                    pod.meta.annotations.update(p.annotations)
+            after = (
+                dict(pod.meta.labels),
+                dict(pod.meta.annotations),
+                pod.spec.priority,
+                pod.spec.scheduler_name,
+                dict(pod.spec.requests),
+                dict(pod.spec.limits),
+            )
+            if before != after:
+                changed.append(pod)
+        return changed

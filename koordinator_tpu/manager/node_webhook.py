@@ -1,0 +1,65 @@
+"""Node + config validating webhooks.
+
+Rebuild of ``pkg/webhook/node/`` (resource-amplification annotation
+validation) and ``pkg/webhook/cm/`` (slo-controller-config ConfigMap
+validation): reject malformed dynamic config before controllers render it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Mapping
+
+from ..api import extension as ext
+from ..api.types import Node, ResourceThresholdStrategy
+from .noderesource import ColocationStrategy
+from .noderesource_plugins import parse_amplification
+
+
+def validate_node(node: Node) -> List[str]:
+    """Amplification ratios must parse and be ≥ 1.0 (reference
+    ``pkg/webhook/node/validating``)."""
+    errors: List[str] = []
+    raw = node.meta.annotations.get(ext.ANNOTATION_NODE_AMPLIFICATION)
+    if raw is None:
+        return errors
+    ratios = parse_amplification(node)
+    parts = [p for p in raw.split(",") if p]
+    if len(ratios) != len(parts):
+        errors.append(f"node {node.meta.name}: malformed amplification {raw!r}")
+    for key, val in ratios.items():
+        if val < 1.0:
+            errors.append(
+                f"node {node.meta.name}: amplification ratio {key}={val} < 1.0"
+            )
+    return errors
+
+
+def validate_colocation_strategy(strategy: ColocationStrategy) -> List[str]:
+    """slo-controller-config colocation sanity (reference
+    ``pkg/webhook/cm/`` plugin ``configmap_validate.go`` semantics)."""
+    errors: List[str] = []
+    if not 0.0 <= strategy.reserve_ratio < 1.0:
+        errors.append(f"reserveRatio {strategy.reserve_ratio} outside [0, 1)")
+    if strategy.prod_request_factor < 0.0:
+        errors.append("prodRequestFactor < 0")
+    if not 0.0 <= strategy.mid_reclaim_ratio <= 1.0:
+        errors.append(f"midReclaimRatio {strategy.mid_reclaim_ratio} outside [0, 1]")
+    return errors
+
+
+def validate_threshold_strategy(s: ResourceThresholdStrategy) -> List[str]:
+    errors: List[str] = []
+    for name in (
+        "cpu_suppress_threshold_percent",
+        "cpu_evict_be_usage_threshold_percent",
+        "memory_evict_threshold_percent",
+    ):
+        val = getattr(s, name)
+        if not 0.0 <= val <= 100.0:
+            errors.append(f"{name}={val} outside [0, 100]")
+    low = s.memory_evict_lower_percent
+    if low is not None and low >= s.memory_evict_threshold_percent:
+        errors.append(
+            "memoryEvictLowerPercent must be below memoryEvictThresholdPercent"
+        )
+    return errors

@@ -1,0 +1,50 @@
+"""NodeMetric spec controller.
+
+Rebuild of ``pkg/slo-controller/nodemetric/nodemetric_controller.go``: for
+every node, ensure a NodeMetric object exists whose *spec* carries the
+collect policy (report interval / aggregate window / node-memory collect
+policy) rendered from the cluster config — the node agent fills the
+*status* (see :mod:`koordinator_tpu.koordlet.daemon`). Defaults mirror
+``states_nodemetric.go:61-66``: 60 s report, 300 s aggregate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional
+
+from ..api.types import NodeMetric, ObjectMeta
+
+
+@dataclasses.dataclass
+class NodeMetricCollectPolicy:
+    report_interval_s: float = 60.0
+    aggregate_duration_s: float = 300.0
+    #: "usageWithoutPageCache" | "usageWithPageCache" (reference
+    #: nodemetric spec NodeMemoryCollectPolicy)
+    node_memory_policy: str = "usageWithoutPageCache"
+
+
+class NodeMetricController:
+    """Reconciles one NodeMetric per node; deletes orphans."""
+
+    def __init__(self, policy: Optional[NodeMetricCollectPolicy] = None):
+        self.policy = policy or NodeMetricCollectPolicy()
+        self.metrics: Dict[str, NodeMetric] = {}
+
+    def reconcile(self, node_names: Iterable[str]) -> Dict[str, NodeMetric]:
+        names = set(node_names)
+        for name in names:
+            nm = self.metrics.get(name)
+            if nm is None:
+                nm = NodeMetric(meta=ObjectMeta(name=name))
+                self.metrics[name] = nm
+            nm.report_interval_s = self.policy.report_interval_s
+            nm.aggregate_window_s = self.policy.aggregate_duration_s
+        for orphan in [n for n in self.metrics if n not in names]:
+            del self.metrics[orphan]
+        return self.metrics
+
+    def observe(self, report: NodeMetric) -> None:
+        """Accept a koordlet status report (the CRD status write path)."""
+        self.metrics[report.meta.name] = report

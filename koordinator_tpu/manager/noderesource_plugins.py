@@ -1,0 +1,234 @@
+"""Node resource plugin chain: annotation/label-level node decorations.
+
+Rebuild of the reference's noderesource plugin framework
+(``pkg/slo-controller/noderesource/framework/extender_plugin.go:45-263``)
+beyond the batch/mid tensors computed in :mod:`noderesource`:
+
+* **cpunormalization** — per-CPU-model performance ratio written to
+  ``node.koordinator.sh/cpu-normalization-ratio``
+  (``plugins/cpunormalization/plugin.go:129-263``).
+* **resourceamplification** — final amplification ratio from user config ×
+  normalization ratio (``plugins/resourceamplification/plugin.go:37-90``).
+* **gpudeviceresource / rdmadevicereource** — project the Device inventory
+  into node-level extended resources + device labels
+  (``plugins/gpudeviceresource/plugin.go``, ``plugins/rdmadevicereource/``).
+
+Each plugin is a pure function ``(node, inputs) -> ResourceItems`` so the
+chain stays unit-testable the way the reference's table tests are; the
+controller applies items as annotation/label/allocatable updates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from ..api import extension as ext
+from ..api.types import Device, Node
+
+#: annotation carrying the CPU basic info reported by koordlet
+#: (reference ``apis/extension/node.go`` AnnotationNodeCPUBasicInfo)
+ANNOTATION_CPU_BASIC_INFO = f"node.{ext.DOMAIN}/cpu-basic-info"
+
+
+@dataclasses.dataclass
+class ResourceItem:
+    """One node mutation produced by a plugin (reference
+    ``framework.ResourceItem``): extended resource values and/or
+    annotation/label writes."""
+
+    name: str
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    reset: bool = False          # degrade: clear owned keys
+
+
+@dataclasses.dataclass
+class CPUBasicInfo:
+    """Parsed koordlet-reported CPU model info (reference
+    ``apis/extension/node.go`` CPUBasicInfo)."""
+
+    cpu_model: str = ""
+    hyper_thread_enabled: bool = False
+    turbo_enabled: bool = False
+
+
+@dataclasses.dataclass
+class CPUNormalizationStrategy:
+    """slo-controller-config ``cpuNormalizationStrategy``: per-model ratio
+    table keyed like the reference's RatioModel
+    (``plugin.go:235-263``: pick the entry matching HT/turbo state)."""
+
+    enable: bool = False
+    #: model -> {"base": r, "ht": r, "turbo": r, "ht_turbo": r}
+    ratio_model: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class CPUNormalizationPlugin:
+    """Writes the cpu-normalization-ratio annotation."""
+
+    name = "CPUNormalization"
+
+    def __init__(self, strategy: Optional[CPUNormalizationStrategy] = None):
+        self.strategy = strategy or CPUNormalizationStrategy()
+
+    def ratio_for(self, info: CPUBasicInfo) -> float:
+        """Reference ``getCPUNormalizationRatioFromModel`` (plugin.go:235-263):
+        the (HT, turbo) state selects which calibrated ratio applies; a
+        missing entry is an error surfaced as ratio 1.0 + skip."""
+        model = self.strategy.ratio_model.get(info.cpu_model)
+        if model is None:
+            raise KeyError(f"no ratio for CPU {info.cpu_model!r}")
+        if info.hyper_thread_enabled and info.turbo_enabled:
+            key = "ht_turbo"
+        elif info.hyper_thread_enabled:
+            key = "ht"
+        elif info.turbo_enabled:
+            key = "turbo"
+        else:
+            key = "base"
+        if key not in model:
+            raise KeyError(f"missing {key} ratio for CPU {info.cpu_model!r}")
+        ratio = float(model[key])
+        if not (0.0 < ratio <= 10.0):
+            raise ValueError(f"cpu normalization ratio {ratio} out of range")
+        return ratio
+
+    def calculate(self, node: Node, info: Optional[CPUBasicInfo]) -> ResourceItem:
+        if not self.strategy.enable or info is None:
+            return ResourceItem(name=self.name, reset=True)
+        try:
+            ratio = self.ratio_for(info)
+        except (KeyError, ValueError):
+            return ResourceItem(name=self.name, reset=True)
+        return ResourceItem(
+            name=self.name,
+            annotations={ext.ANNOTATION_NODE_CPU_NORMALIZATION: f"{ratio:.4f}"},
+        )
+
+
+class ResourceAmplificationPlugin:
+    """Final amplification = user-configured ratio × normalization ratio
+    (reference ``plugins/resourceamplification/plugin.go:37-90``: the auto
+    path folds the normalization ratio into the cpu amplification)."""
+
+    name = "ResourceAmplification"
+
+    def __init__(self, user_ratios: Optional[Mapping[str, float]] = None):
+        #: resource name -> user amplification ratio (≥ 1.0)
+        self.user_ratios = dict(user_ratios or {})
+
+    def calculate(self, node: Node, normalization_ratio: float = 1.0) -> ResourceItem:
+        ratios = dict(self.user_ratios)
+        # final cpu ratio folds in normalization, but is only published when
+        # it amplifies (> 1) — reference plugin.go:107-109 — so a weak CPU
+        # model never shrinks allocatable below what kubelet reported.
+        cpu_ratio = ratios.get(ext.RES_CPU, 1.0) * normalization_ratio
+        if cpu_ratio > 1.0:
+            ratios[ext.RES_CPU] = cpu_ratio
+        else:
+            ratios.pop(ext.RES_CPU, None)
+        ratios = {k: v for k, v in ratios.items() if v != 1.0}
+        if not ratios:
+            return ResourceItem(name=self.name, reset=True)
+        enc = ",".join(f"{k}={v:.4f}" for k, v in sorted(ratios.items()))
+        return ResourceItem(
+            name=self.name,
+            annotations={ext.ANNOTATION_NODE_AMPLIFICATION: enc},
+        )
+
+
+LABEL_GPU_MODEL = f"node.{ext.DOMAIN}/gpu-model"
+LABEL_GPU_DRIVER = f"node.{ext.DOMAIN}/gpu-driver"
+
+
+class GPUDeviceResourcePlugin:
+    """Device CRD → node extended resources: total gpu-core/gpu-memory and
+    whole-GPU count (reference ``plugins/gpudeviceresource/plugin.go``)."""
+
+    name = "GPUDeviceResource"
+
+    def calculate(
+        self, node: Node, device: Optional[Device], gpu_model: str = ""
+    ) -> ResourceItem:
+        gpus = [d for d in (device.devices if device else []) if d.dev_type == "gpu"]
+        if not gpus:
+            return ResourceItem(name=self.name, reset=True)
+        total_core = sum(d.resources.get(ext.RES_GPU_CORE, 100.0) for d in gpus)
+        total_mem = sum(d.resources.get(ext.RES_GPU_MEMORY, 0.0) for d in gpus)
+        item = ResourceItem(
+            name=self.name,
+            resources={
+                ext.RES_GPU: float(len(gpus)),
+                ext.RES_GPU_CORE: total_core,
+                ext.RES_GPU_MEMORY: total_mem,
+            },
+        )
+        if gpu_model:
+            item.labels[LABEL_GPU_MODEL] = gpu_model
+        return item
+
+
+class RDMADeviceResourcePlugin:
+    name = "RDMADeviceResource"
+
+    def calculate(self, node: Node, device: Optional[Device]) -> ResourceItem:
+        rdmas = [
+            d for d in (device.devices if device else []) if d.dev_type == "rdma"
+        ]
+        if not rdmas:
+            return ResourceItem(name=self.name, reset=True)
+        return ResourceItem(
+            name=self.name, resources={ext.RES_RDMA: float(len(rdmas))}
+        )
+
+
+#: keys each plugin owns, cleared on reset (the reference's Reset() path
+#: returns zeroed ResourceItems for exactly these keys)
+_OWNED_ANNOTATIONS = {
+    "CPUNormalization": (ext.ANNOTATION_NODE_CPU_NORMALIZATION,),
+    "ResourceAmplification": (ext.ANNOTATION_NODE_AMPLIFICATION,),
+}
+_OWNED_RESOURCES = {
+    "GPUDeviceResource": (ext.RES_GPU, ext.RES_GPU_CORE, ext.RES_GPU_MEMORY),
+    "RDMADeviceResource": (ext.RES_RDMA,),
+}
+_OWNED_LABELS = {
+    "GPUDeviceResource": (LABEL_GPU_MODEL, LABEL_GPU_DRIVER),
+}
+
+
+def apply_items(node: Node, items: Sequence[ResourceItem]) -> Node:
+    """Apply plugin outputs to the node object (the reference's
+    ``updateNodeResource`` merge: reset clears owned keys, otherwise
+    annotations/labels/allocatable merge in)."""
+    for item in items:
+        if item.reset:
+            for key in _OWNED_ANNOTATIONS.get(item.name, ()):
+                node.meta.annotations.pop(key, None)
+            for key in _OWNED_RESOURCES.get(item.name, ()):
+                node.status.allocatable.pop(key, None)
+            for key in _OWNED_LABELS.get(item.name, ()):
+                node.meta.labels.pop(key, None)
+            continue
+        node.meta.annotations.update(item.annotations)
+        node.meta.labels.update(item.labels)
+        node.status.allocatable.update(item.resources)
+    return node
+
+
+def parse_amplification(node: Node) -> Dict[str, float]:
+    """Scheduler-side accessor for the amplification annotation (reference
+    ``apis/extension/node_resource_amplification.go``)."""
+    raw = node.meta.annotations.get(ext.ANNOTATION_NODE_AMPLIFICATION, "")
+    out: Dict[str, float] = {}
+    for part in filter(None, raw.split(",")):
+        key, _, val = part.partition("=")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out

@@ -1,0 +1,70 @@
+"""ElasticQuotaProfile controller: node-selector-scoped quota trees.
+
+Rebuild of ``pkg/quota-controller/profile/profile_controller.go:62-273``:
+each profile selects nodes by label and maintains a root ElasticQuota whose
+min/max equal the selected nodes' summed allocatable (optionally scaled by
+a resource ratio, ``DecorateResourceByResourceRatio``). This is how
+multi-pool clusters get one quota tree per hardware pool.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Mapping, Optional
+
+from ..api.types import ElasticQuota, ElasticQuotaProfile, Node, ObjectMeta
+from .profile import _selector_matches as _matches
+
+#: annotation holding the ratio applied to the summed totals
+ANNOTATION_RESOURCE_RATIO = "quota.koordinator.sh/resource-ratio"
+
+
+class QuotaProfileController:
+    """Reconciles root ElasticQuotas from profiles + the node inventory."""
+
+    def __init__(self) -> None:
+        self.profiles: Dict[str, ElasticQuotaProfile] = {}
+
+    def upsert(self, profile: ElasticQuotaProfile) -> None:
+        self.profiles[profile.meta.name] = profile
+
+    def remove(self, name: str) -> None:
+        self.profiles.pop(name, None)
+
+    def reconcile(self, nodes: Iterable[Node]) -> List[ElasticQuota]:
+        """One pass over the node inventory → updated root quotas."""
+        node_list = list(nodes)
+        out: List[ElasticQuota] = []
+        for profile in self.profiles.values():
+            selected = [
+                n
+                for n in node_list
+                if _matches(profile.node_selector, n.meta.labels)
+                and not n.unschedulable
+            ]
+            total: Dict[str, float] = {}
+            for n in selected:
+                for key, val in n.status.allocatable.items():
+                    if profile.resource_keys and key not in profile.resource_keys:
+                        continue
+                    total[key] = total.get(key, 0.0) + val
+            ratio = 1.0
+            raw = profile.meta.annotations.get(ANNOTATION_RESOURCE_RATIO)
+            if raw:
+                try:
+                    ratio = min(max(float(raw), 0.0), 1.0)
+                except ValueError:
+                    ratio = 1.0
+            if ratio != 1.0:
+                total = {k: v * ratio for k, v in total.items()}
+            eq = ElasticQuota(
+                meta=ObjectMeta(
+                    name=profile.quota_name,
+                    labels=dict(profile.quota_labels),
+                ),
+                min=dict(total),
+                max=dict(total),
+                is_parent=True,
+                tree_id=profile.meta.name,
+            )
+            out.append(eq)
+        return out

@@ -1,0 +1,198 @@
+"""ElasticQuota webhook: tree structural invariants + quota admission.
+
+Rebuild of ``pkg/webhook/elasticquota/`` (``quota_topology.go``,
+``quota_topology_check.go:39-120``) and the quota admission evaluator
+(``pkg/webhook/quotaevaluate/``): validates quota CRUD against the tree's
+structural invariants before the scheduler's GroupQuotaManager ever sees
+the object, and (optionally, ``EnableQuotaAdmission``) rejects pods whose
+requests exceed quota runtime at admission time instead of letting them
+queue forever.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional
+
+from ..api.types import ElasticQuota, Pod
+from ..scheduler.plugins.elasticquota import GroupQuotaManager, quota_name_of
+
+
+class QuotaTopologyValidator:
+    """Mirrors the reference's in-webhook shadow topology
+    (``quota_topology.go``: the webhook maintains its own quotaInfo map so
+    validation never races the scheduler's)."""
+
+    def __init__(self) -> None:
+        self.quotas: Dict[str, ElasticQuota] = {}
+        #: quota name -> number of pods currently bound to it
+        self.pod_counts: Dict[str, int] = {}
+
+    # ---- self-item checks (quota_topology_check.go:39-90) ----
+
+    @staticmethod
+    def validate_self(eq: ElasticQuota) -> List[str]:
+        errors: List[str] = []
+        for key, val in eq.max.items():
+            if val < 0:
+                errors.append(f"{eq.meta.name}: max[{key}] < 0")
+        for key, val in eq.min.items():
+            if val < 0:
+                errors.append(f"{eq.meta.name}: min[{key}] < 0")
+            if key in eq.max and val > eq.max[key]:
+                errors.append(
+                    f"{eq.meta.name}: min[{key}]={val} > max[{key}]={eq.max[key]}"
+                )
+            if key not in eq.max:
+                errors.append(
+                    f"{eq.meta.name}: min key {key} not included in max"
+                )
+        for key, val in eq.shared_weight.items():
+            if val < 0:
+                errors.append(f"{eq.meta.name}: sharedWeight[{key}] < 0")
+        return errors
+
+    # ---- topology checks (quota_topology_check.go:92-120) ----
+
+    def validate_create(self, eq: ElasticQuota) -> List[str]:
+        errors = self.validate_self(eq)
+        name = eq.meta.name
+        if name in self.quotas:
+            errors.append(f"quota {name} already exists")
+        errors += self._check_parent(eq)
+        errors += self._check_min_sum(eq, exclude=None)
+        return errors
+
+    def validate_update(self, eq: ElasticQuota) -> List[str]:
+        errors = self.validate_self(eq)
+        old = self.quotas.get(eq.meta.name)
+        if old is None:
+            errors.append(f"quota {eq.meta.name} not found")
+            return errors
+        if old.tree_id and old.tree_id != eq.tree_id:
+            # checkTreeID: a quota can never move between (or leave) trees
+            errors.append(
+                f"quota {eq.meta.name}: tree id is immutable "
+                f"({old.tree_id} -> {eq.tree_id or '<empty>'})"
+            )
+        if old.is_parent and not eq.is_parent and self._children_of(eq.meta.name):
+            # checkIsParentChange: cannot demote a parent that has children
+            errors.append(
+                f"quota {eq.meta.name}: cannot become leaf while it has children"
+            )
+        errors += self._check_parent(eq)
+        errors += self._check_min_sum(eq, exclude=eq.meta.name)
+        # shrinking a parent's min must still cover its children's min sum
+        child_sum: Dict[str, float] = {}
+        for kid in self._children_of(eq.meta.name):
+            for key, val in self.quotas[kid].min.items():
+                child_sum[key] = child_sum.get(key, 0.0) + val
+        for key, total in child_sum.items():
+            if total > eq.min.get(key, 0.0) + 1e-9:
+                errors.append(
+                    f"quota {eq.meta.name}: children min sum {total} exceeds "
+                    f"new min {eq.min.get(key, 0.0)} for {key}"
+                )
+        return errors
+
+    def validate_delete(self, name: str) -> List[str]:
+        errors: List[str] = []
+        if self._children_of(name):
+            errors.append(f"quota {name} still has child quotas")
+        if self.pod_counts.get(name, 0) > 0:
+            errors.append(f"quota {name} still has bound pods")
+        return errors
+
+    def _check_parent(self, eq: ElasticQuota) -> List[str]:
+        """checkParentQuotaInfo: parent must exist, be marked is-parent,
+        share the tree id, and the edge must not create a cycle."""
+        errors: List[str] = []
+        if not eq.parent:
+            return errors
+        parent = self.quotas.get(eq.parent)
+        if parent is None:
+            errors.append(f"quota {eq.meta.name}: parent {eq.parent} not found")
+            return errors
+        if not parent.is_parent:
+            errors.append(
+                f"quota {eq.meta.name}: parent {eq.parent} is not marked is-parent"
+            )
+        if parent.tree_id and eq.tree_id and parent.tree_id != eq.tree_id:
+            errors.append(
+                f"quota {eq.meta.name}: tree id {eq.tree_id} differs from "
+                f"parent's {parent.tree_id}"
+            )
+        seen = {eq.meta.name}
+        cursor: Optional[str] = eq.parent
+        while cursor:
+            if cursor in seen:
+                errors.append(f"quota {eq.meta.name}: parent chain has a cycle")
+                break
+            seen.add(cursor)
+            cur = self.quotas.get(cursor)
+            cursor = cur.parent if cur else None
+        return errors
+
+    def _check_min_sum(self, eq: ElasticQuota, exclude: Optional[str]) -> List[str]:
+        """checkMinQuotaValidate: Σ child min ≤ parent min per dimension."""
+        errors: List[str] = []
+        if not eq.parent:
+            return errors
+        parent = self.quotas.get(eq.parent)
+        if parent is None:
+            return errors
+        sums: Dict[str, float] = dict(eq.min)
+        for sib in self._children_of(eq.parent):
+            if sib == (exclude or eq.meta.name):
+                continue
+            for key, val in self.quotas[sib].min.items():
+                sums[key] = sums.get(key, 0.0) + val
+        for key, total in sums.items():
+            pmin = parent.min.get(key, 0.0)
+            if total > pmin + 1e-9:
+                errors.append(
+                    f"quota {eq.meta.name}: children min sum {total} exceeds "
+                    f"parent {eq.parent} min {pmin} for {key}"
+                )
+        return errors
+
+    def _children_of(self, name: str) -> List[str]:
+        return [q.meta.name for q in self.quotas.values() if q.parent == name]
+
+    # ---- state mirror ----
+
+    def admit(self, eq: ElasticQuota, is_update: bool = False) -> List[str]:
+        errors = (
+            self.validate_update(eq) if is_update else self.validate_create(eq)
+        )
+        if not errors:
+            self.quotas[eq.meta.name] = eq
+        return errors
+
+    def delete(self, name: str) -> List[str]:
+        errors = self.validate_delete(name)
+        if not errors:
+            self.quotas.pop(name, None)
+        return errors
+
+
+class QuotaAdmissionEvaluator:
+    """Pod-time quota admission (``pkg/webhook/quotaevaluate/``,
+    gated by ``EnableQuotaAdmission``): used + request ≤ runtime along the
+    pod's quota chain, checked against the scheduler's GroupQuotaManager."""
+
+    def __init__(self, manager: GroupQuotaManager, enabled: bool = True):
+        self.manager = manager
+        self.enabled = enabled
+
+    def admit(self, pod: Pod) -> List[str]:
+        if not self.enabled:
+            return []
+        quota = quota_name_of(pod)
+        if quota is None or self.manager.index_of(quota) is None:
+            return []
+        if not self.manager.has_headroom(quota, pod.spec.requests):
+            return [
+                f"pod {pod.meta.uid}: quota {quota} has no headroom for "
+                f"{pod.spec.requests}"
+            ]
+        return []

@@ -1,0 +1,335 @@
+"""Tests for the manager's remaining control-plane pieces: quota topology
+webhook, quota admission, quota profile controller, node/cm validation,
+nodemetric controller, noderesource plugin chain, and the colocation
+profile reconciler (SURVEY §2.5)."""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.extension import QoSClass
+from koordinator_tpu.api.types import (
+    ClusterColocationProfile,
+    Device,
+    DeviceInfo,
+    ElasticQuota,
+    ElasticQuotaProfile,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+)
+from koordinator_tpu.manager.colocation_controller import (
+    ColocationProfileController,
+)
+from koordinator_tpu.manager.node_webhook import (
+    validate_colocation_strategy,
+    validate_node,
+    validate_threshold_strategy,
+)
+from koordinator_tpu.manager.nodemetric import (
+    NodeMetricCollectPolicy,
+    NodeMetricController,
+)
+from koordinator_tpu.manager.noderesource import ColocationStrategy
+from koordinator_tpu.manager.noderesource_plugins import (
+    CPUBasicInfo,
+    CPUNormalizationPlugin,
+    CPUNormalizationStrategy,
+    GPUDeviceResourcePlugin,
+    RDMADeviceResourcePlugin,
+    ResourceAmplificationPlugin,
+    apply_items,
+    parse_amplification,
+)
+from koordinator_tpu.manager.profile import ProfileMutator
+from koordinator_tpu.manager.quota_profile import (
+    ANNOTATION_RESOURCE_RATIO,
+    QuotaProfileController,
+)
+from koordinator_tpu.manager.quota_webhook import (
+    QuotaAdmissionEvaluator,
+    QuotaTopologyValidator,
+)
+from koordinator_tpu.api.types import ResourceThresholdStrategy
+from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+
+def eq(name, parent="", minr=None, maxr=None, is_parent=False, tree=""):
+    return ElasticQuota(
+        meta=ObjectMeta(name=name),
+        min=minr or {},
+        max=maxr or {},
+        parent=parent,
+        is_parent=is_parent,
+        tree_id=tree,
+    )
+
+
+# ---- quota topology webhook ----
+
+
+def test_quota_self_validation():
+    v = QuotaTopologyValidator()
+    bad = eq("a", minr={"cpu": 10.0}, maxr={"cpu": 5.0})
+    errs = v.validate_self(bad)
+    assert any("min[cpu]" in e for e in errs)
+    assert v.validate_self(eq("b", minr={"cpu": -1.0}, maxr={"cpu": 5.0}))
+    # min key missing from max is rejected (quota_topology_check.go:69)
+    assert v.validate_self(eq("c", minr={"gpu": 1.0}, maxr={"cpu": 5.0}))
+    assert not v.validate_self(eq("d", minr={"cpu": 1.0}, maxr={"cpu": 5.0}))
+
+
+def test_quota_parent_invariants():
+    v = QuotaTopologyValidator()
+    assert not v.admit(eq("root", minr={"cpu": 100.0}, maxr={"cpu": 100.0}, is_parent=True))
+    # parent must exist
+    assert v.validate_create(eq("child", parent="ghost"))
+    # parent must be is-parent
+    assert not v.admit(eq("leafy", minr={}, maxr={}))
+    errs = v.validate_create(eq("child", parent="leafy"))
+    assert any("is-parent" in e for e in errs)
+    # child min sum must stay under parent min
+    assert not v.admit(eq("c1", parent="root", minr={"cpu": 60.0}, maxr={"cpu": 100.0}))
+    errs = v.validate_create(
+        eq("c2", parent="root", minr={"cpu": 60.0}, maxr={"cpu": 100.0})
+    )
+    assert any("min sum" in e for e in errs)
+    assert not v.admit(eq("c2", parent="root", minr={"cpu": 40.0}, maxr={"cpu": 100.0}))
+    # shrinking the parent's min below Σ child min is rejected
+    errs = v.validate_update(
+        eq("root", minr={"cpu": 50.0}, maxr={"cpu": 100.0}, is_parent=True)
+    )
+    assert any("new min" in e for e in errs)
+
+
+def test_quota_tree_id_immutable_and_delete_guard():
+    v = QuotaTopologyValidator()
+    assert not v.admit(eq("root", is_parent=True, tree="t1"))
+    updated = eq("root", is_parent=True, tree="t2")
+    errs = v.validate_update(updated)
+    assert any("immutable" in e for e in errs)
+    # two-step move t1 -> "" -> t2 is also rejected
+    errs = v.validate_update(eq("root", is_parent=True, tree=""))
+    assert any("immutable" in e for e in errs)
+    assert not v.admit(eq("kid", parent="root", tree="t1"))
+    assert v.validate_delete("root")  # has a child
+    assert not v.delete("kid")
+    v.pod_counts["root"] = 2
+    assert v.validate_delete("root")  # has pods
+    v.pod_counts["root"] = 0
+    assert not v.delete("root")
+
+
+def test_quota_admission_evaluator():
+    mgr = GroupQuotaManager(cluster_total={ext.RES_CPU: 1000.0, ext.RES_MEMORY: 1000.0})
+    mgr.upsert_quota(
+        eq("team", minr={ext.RES_CPU: 100.0}, maxr={ext.RES_CPU: 100.0})
+    )
+    mgr.set_leaf_requests(
+        {"team": mgr.config.res_vector({ext.RES_CPU: 100.0})}
+    )
+    ev = QuotaAdmissionEvaluator(mgr)
+    pod = Pod(
+        meta=ObjectMeta(name="p", labels={ext.LABEL_QUOTA_NAME: "team"}),
+        spec=PodSpec(requests={ext.RES_CPU: 50.0}),
+    )
+    assert ev.admit(pod) == []
+    mgr.charge("team", {ext.RES_CPU: 80.0})
+    assert ev.admit(pod)  # 80 + 50 > 100
+    ev.enabled = False
+    assert ev.admit(pod) == []
+
+
+# ---- quota profile controller ----
+
+
+def test_quota_profile_sums_selected_nodes():
+    ctrl = QuotaProfileController()
+    ctrl.upsert(
+        ElasticQuotaProfile(
+            meta=ObjectMeta(name="gpu-pool"),
+            node_selector={"pool": "gpu"},
+            resource_keys=[ext.RES_CPU],
+        )
+    )
+    nodes = [
+        Node(
+            meta=ObjectMeta(name=f"n{i}", labels={"pool": "gpu" if i < 2 else "cpu"}),
+            status=NodeStatus(allocatable={ext.RES_CPU: 100.0, ext.RES_MEMORY: 50.0}),
+        )
+        for i in range(4)
+    ]
+    (quota,) = ctrl.reconcile(nodes)
+    assert quota.meta.name == "gpu-pool"
+    assert quota.min == {ext.RES_CPU: 200.0}
+    assert quota.is_parent and quota.tree_id == "gpu-pool"
+
+
+def test_quota_profile_ratio_decoration():
+    ctrl = QuotaProfileController()
+    prof = ElasticQuotaProfile(
+        meta=ObjectMeta(
+            name="p", annotations={ANNOTATION_RESOURCE_RATIO: "0.5"}
+        ),
+        node_selector={},
+    )
+    ctrl.upsert(prof)
+    nodes = [
+        Node(
+            meta=ObjectMeta(name="n"),
+            status=NodeStatus(allocatable={ext.RES_CPU: 100.0}),
+        )
+    ]
+    (quota,) = ctrl.reconcile(nodes)
+    assert quota.min[ext.RES_CPU] == 50.0
+
+
+# ---- node / cm webhooks ----
+
+
+def test_node_amplification_validation():
+    node = Node(meta=ObjectMeta(name="n"))
+    assert validate_node(node) == []
+    node.meta.annotations[ext.ANNOTATION_NODE_AMPLIFICATION] = "cpu=1.5"
+    assert validate_node(node) == []
+    node.meta.annotations[ext.ANNOTATION_NODE_AMPLIFICATION] = "cpu=0.5"
+    assert any("< 1.0" in e for e in validate_node(node))
+    node.meta.annotations[ext.ANNOTATION_NODE_AMPLIFICATION] = "cpu=abc"
+    assert any("malformed" in e for e in validate_node(node))
+
+
+def test_config_validation():
+    assert validate_colocation_strategy(ColocationStrategy()) == []
+    assert validate_colocation_strategy(ColocationStrategy(reserve_ratio=1.5))
+    s = ResourceThresholdStrategy(memory_evict_threshold_percent=70.0,
+                                  memory_evict_lower_percent=80.0)
+    assert any("LowerPercent" in e for e in validate_threshold_strategy(s))
+    assert validate_threshold_strategy(ResourceThresholdStrategy()) == []
+
+
+# ---- nodemetric controller ----
+
+
+def test_nodemetric_reconcile_creates_and_prunes():
+    ctrl = NodeMetricController(NodeMetricCollectPolicy(report_interval_s=30.0))
+    out = ctrl.reconcile(["a", "b"])
+    assert set(out) == {"a", "b"}
+    assert out["a"].report_interval_s == 30.0
+    out = ctrl.reconcile(["b"])
+    assert set(out) == {"b"}
+
+
+# ---- noderesource plugin chain ----
+
+
+def test_cpu_normalization_ratio_selection():
+    strat = CPUNormalizationStrategy(
+        enable=True,
+        ratio_model={
+            "Xeon": {"base": 1.0, "ht": 0.65, "turbo": 1.2, "ht_turbo": 0.8}
+        },
+    )
+    plugin = CPUNormalizationPlugin(strat)
+    assert plugin.ratio_for(CPUBasicInfo("Xeon", True, True)) == 0.8
+    assert plugin.ratio_for(CPUBasicInfo("Xeon", False, False)) == 1.0
+    node = Node(meta=ObjectMeta(name="n"))
+    item = plugin.calculate(node, CPUBasicInfo("Xeon", True, False))
+    assert item.annotations[ext.ANNOTATION_NODE_CPU_NORMALIZATION] == "0.6500"
+    # unknown model degrades to reset
+    assert plugin.calculate(node, CPUBasicInfo("M1", False, False)).reset
+
+
+def test_amplification_chain_and_parse():
+    node = Node(meta=ObjectMeta(name="n"))
+    amp = ResourceAmplificationPlugin({ext.RES_CPU: 2.0})
+    item = amp.calculate(node, normalization_ratio=0.8)
+    apply_items(node, [item])
+    ratios = parse_amplification(node)
+    assert abs(ratios[ext.RES_CPU] - 1.6) < 1e-6
+    # sub-1.0 final ratio is never published (reference plugin.go:107-109),
+    # so the node webhook's ratio >= 1 rule always holds
+    item = ResourceAmplificationPlugin().calculate(node, normalization_ratio=0.8)
+    assert item.reset
+    apply_items(node, [item])
+    assert validate_node(node) == []
+    assert ext.ANNOTATION_NODE_AMPLIFICATION not in node.meta.annotations
+
+
+def test_device_resource_plugins():
+    node = Node(meta=ObjectMeta(name="n"))
+    dev = Device(
+        meta=ObjectMeta(name="n"),
+        devices=[
+            DeviceInfo("gpu", 0, {ext.RES_GPU_CORE: 100, ext.RES_GPU_MEMORY: 80_000}),
+            DeviceInfo("gpu", 1, {ext.RES_GPU_CORE: 100, ext.RES_GPU_MEMORY: 80_000}),
+            DeviceInfo("rdma", 0, {}),
+        ],
+    )
+    items = [
+        GPUDeviceResourcePlugin().calculate(node, dev, gpu_model="A100"),
+        RDMADeviceResourcePlugin().calculate(node, dev),
+    ]
+    apply_items(node, items)
+    assert node.status.allocatable[ext.RES_GPU] == 2.0
+    assert node.status.allocatable[ext.RES_GPU_MEMORY] == 160_000.0
+    assert node.status.allocatable[ext.RES_RDMA] == 1.0
+    assert node.meta.labels["node.koordinator.sh/gpu-model"] == "A100"
+    # device removal: reset clears the owned resources and labels too
+    reset_items = [
+        GPUDeviceResourcePlugin().calculate(node, None),
+        RDMADeviceResourcePlugin().calculate(node, None),
+    ]
+    assert all(i.reset for i in reset_items)
+    apply_items(node, reset_items)
+    assert ext.RES_GPU not in node.status.allocatable
+    assert ext.RES_GPU_MEMORY not in node.status.allocatable
+    assert ext.RES_RDMA not in node.status.allocatable
+    assert "node.koordinator.sh/gpu-model" not in node.meta.labels
+
+
+# ---- colocation profile reconciler ----
+
+
+def test_colocation_controller_reconciles_existing_pods():
+    profile = ClusterColocationProfile(
+        meta=ObjectMeta(name="spark"),
+        selector={"app": "spark"},
+        qos_class=QoSClass.BE,
+        priority=5500,
+        labels={"managed": "koord"},
+        resource_translation={ext.RES_CPU: ext.RES_BATCH_CPU},
+    )
+    ctrl = ColocationProfileController(ProfileMutator([profile]))
+    pending = Pod(
+        meta=ObjectMeta(name="exec-1", labels={"app": "spark"}),
+        spec=PodSpec(requests={ext.RES_CPU: 1000.0}),
+    )
+    bound = Pod(
+        meta=ObjectMeta(name="exec-2", labels={"app": "spark"}),
+        spec=PodSpec(requests={ext.RES_CPU: 1000.0}, node_name="n0"),
+        phase=PodPhase.RUNNING,
+    )
+    other = Pod(meta=ObjectMeta(name="web", labels={"app": "web"}))
+    changed = ctrl.reconcile([pending, bound, other])
+    assert {p.meta.name for p in changed} == {"exec-1", "exec-2"}
+    # a translation-only profile still reports the pending pod as changed
+    xlate_only = ClusterColocationProfile(
+        meta=ObjectMeta(name="xlate"),
+        selector={"app": "ml"},
+        resource_translation={ext.RES_MEMORY: ext.RES_BATCH_MEMORY},
+    )
+    ctrl2 = ColocationProfileController(ProfileMutator([xlate_only]))
+    p = Pod(
+        meta=ObjectMeta(name="ml-1", labels={"app": "ml"}),
+        spec=PodSpec(requests={ext.RES_MEMORY: 2048.0}),
+    )
+    assert [q.meta.name for q in ctrl2.reconcile([p])] == ["ml-1"]
+    # pending pod got the full mutation including resource rewrite
+    assert ext.RES_BATCH_CPU in pending.spec.requests
+    assert pending.spec.priority == 5500
+    # bound pod got metadata only — spec untouched
+    assert ext.RES_CPU in bound.spec.requests
+    assert bound.meta.labels["managed"] == "koord"
+    assert bound.spec.priority is None
