@@ -134,3 +134,26 @@ class ResourceExecutor:
             if self.write(group, file, value, reason=reason):
                 done += 1
         return done
+
+    def gc_group(self, group: str, reason: str = "") -> None:
+        """Drop cache entries for a removed cgroup (pod teardown GC —
+        the kernel dir is gone; stale cache must not suppress writes if
+        the same pod name reappears)."""
+        with self._lock:
+            # boundary-aware prefix: pod-web-1 must not GC pod-web-10
+            for key in [
+                k
+                for k in self._cache
+                if k[0] == group or k[0].startswith(group + "/")
+            ]:
+                del self._cache[key]
+            self.auditor.record(
+                AuditEvent(
+                    ts=time.time(),
+                    group=group,
+                    file="*",
+                    old=None,
+                    new="<gc>",
+                    reason=reason,
+                )
+            )

@@ -1,0 +1,45 @@
+"""CRI runtime proxy: kubelet ↔ hook servers ↔ backend runtime.
+
+Rebuild of ``pkg/runtimeproxy/`` + ``apis/runtime/v1alpha1/api.proto``
+(SURVEY §2.6). See :mod:`server` for the interposer, :mod:`hookserver`
+for the koordlet-side RuntimeHookService implementation.
+"""
+
+from .config import FailurePolicy, HookServerRegistration, parse_failure_policy
+from .dispatcher import Dispatcher, HookError
+from .hookserver import KoordletHookServer
+from .proto import (
+    ContainerMetadata,
+    ContainerResourceHookRequest,
+    ContainerResourceHookResponse,
+    LinuxContainerResources,
+    PodSandboxHookRequest,
+    PodSandboxHookResponse,
+    PodSandboxMetadata,
+    RuntimeHookType,
+)
+from .server import ContainerConfig, CRIProxy, PodSandboxConfig
+from .store import ContainerInfo, PodSandboxInfo, Store
+
+__all__ = [
+    "ContainerConfig",
+    "ContainerInfo",
+    "ContainerMetadata",
+    "ContainerResourceHookRequest",
+    "ContainerResourceHookResponse",
+    "CRIProxy",
+    "Dispatcher",
+    "FailurePolicy",
+    "HookError",
+    "HookServerRegistration",
+    "KoordletHookServer",
+    "LinuxContainerResources",
+    "parse_failure_policy",
+    "PodSandboxConfig",
+    "PodSandboxHookRequest",
+    "PodSandboxHookResponse",
+    "PodSandboxInfo",
+    "PodSandboxMetadata",
+    "RuntimeHookType",
+    "Store",
+]

@@ -1,0 +1,60 @@
+"""Hook server registration + failure policies.
+
+Rebuild of ``pkg/runtimeproxy/config/`` (``config.go:24-66``): each hook
+server registers which CRI lifecycle points it wants and what happens when
+it errors — ``Fail`` propagates the error to kubelet, ``Ignore`` (and the
+unset default ``None``) forwards the original request untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, FrozenSet, Iterable
+
+from .proto import RuntimeHookType
+
+
+class FailurePolicy(enum.Enum):
+    FAIL = "Fail"
+    IGNORE = "Ignore"
+    NONE = ""       # treated as Ignore (config.go:31)
+
+    @property
+    def fails_open(self) -> bool:
+        return self is not FailurePolicy.FAIL
+
+
+def parse_failure_policy(raw: str) -> FailurePolicy:
+    """config.go:35-43 GetFailurePolicyType (unknown values are errors
+    there; here they normalize to NONE to keep registration total)."""
+    for policy in FailurePolicy:
+        if policy.value == raw:
+            return policy
+    return FailurePolicy.NONE
+
+
+@dataclasses.dataclass(frozen=True)
+class HookServerRegistration:
+    """One registered hook server: a name, the lifecycle points it
+    subscribes to, its failure policy, and the handler callable
+    ``(RuntimeHookType, request) -> response | None``."""
+
+    name: str
+    hook_types: FrozenSet[RuntimeHookType]
+    handler: Callable
+    failure_policy: FailurePolicy = FailurePolicy.NONE
+
+    @staticmethod
+    def create(
+        name: str,
+        hook_types: Iterable[RuntimeHookType],
+        handler: Callable,
+        failure_policy: FailurePolicy = FailurePolicy.NONE,
+    ) -> "HookServerRegistration":
+        return HookServerRegistration(
+            name=name,
+            hook_types=frozenset(hook_types),
+            handler=handler,
+            failure_policy=failure_policy,
+        )

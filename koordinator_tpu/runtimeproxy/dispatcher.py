@@ -1,0 +1,60 @@
+"""Hook dispatch with failure policies.
+
+Rebuild of ``pkg/runtimeproxy/dispatcher/``: for a lifecycle point, call
+every registered hook server that subscribed to it, in registration
+order, folding each response into the accumulated one. A server error
+under ``Fail`` policy aborts the CRI call; under ``Ignore``/``None`` the
+request proceeds as if the hook had returned nothing
+(``config.go:27-31``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .config import HookServerRegistration
+from .proto import RuntimeHookType
+
+
+class HookError(RuntimeError):
+    """Raised to the CRI caller when a Fail-policy hook errors."""
+
+    def __init__(self, server: str, hook: RuntimeHookType, cause: Exception):
+        super().__init__(f"hook server {server} failed {hook.value}: {cause}")
+        self.server = server
+        self.hook = hook
+        self.cause = cause
+
+
+class Dispatcher:
+    def __init__(self) -> None:
+        self._servers: List[HookServerRegistration] = []
+
+    def register(self, registration: HookServerRegistration) -> None:
+        self._servers = [
+            s for s in self._servers if s.name != registration.name
+        ] + [registration]
+
+    def unregister(self, name: str) -> None:
+        self._servers = [s for s in self._servers if s.name != name]
+
+    @property
+    def servers(self) -> Tuple[HookServerRegistration, ...]:
+        return tuple(self._servers)
+
+    def dispatch(self, hook: RuntimeHookType, request) -> List[object]:
+        """Responses from each subscribed server (errors under fails-open
+        policies are dropped; a Fail-policy error raises HookError)."""
+        responses: List[object] = []
+        for server in self._servers:
+            if hook not in server.hook_types:
+                continue
+            try:
+                resp = server.handler(hook, request)
+            except Exception as exc:  # noqa: BLE001 — policy decides
+                if not server.failure_policy.fails_open:
+                    raise HookError(server.name, hook, exc) from exc
+                continue
+            if resp is not None:
+                responses.append(resp)
+        return responses

@@ -1,0 +1,129 @@
+"""Koordlet-side RuntimeHookService implementation.
+
+Rebuild of ``pkg/koordlet/runtimehooks/proxyserver/``: the hook-server end
+of the proxy protocol. Each RPC reconstructs the pod from the request's
+labels/annotations, renders the same pure hook plans as the NRI and
+reconciler paths (:mod:`koordinator_tpu.koordlet.runtimehooks`), applies
+cgroup writes through the serialized executor, and answers with the
+spec-level adjustments (envs/annotations) the proxy merges into the CRI
+request — one rendering, three delivery paths.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..api import extension as ext
+from ..api.types import ObjectMeta, Pod, PodSpec
+from ..koordlet import resourceexecutor as rex
+from ..koordlet.runtimehooks import pod_cgroup, pod_mutation, pod_plan
+from .config import FailurePolicy, HookServerRegistration
+from .proto import (
+    ContainerResourceHookRequest,
+    ContainerResourceHookResponse,
+    PodSandboxHookRequest,
+    PodSandboxHookResponse,
+    RuntimeHookType,
+)
+
+#: annotation carrying flattened pod requests on the hook request (the
+#: reference reconstructs these from the statesinformer; the wire path
+#: keeps the hook server stateless for tests)
+ANNOTATION_POD_REQUESTS = f"{ext.DOMAIN}/pod-requests"
+
+
+def _pod_from(meta_name: str, uid: str, labels, annotations) -> Pod:
+    requests = {}
+    raw = annotations.get(ANNOTATION_POD_REQUESTS)
+    if raw:
+        try:
+            requests = {k: float(v) for k, v in json.loads(raw).items()}
+        except (ValueError, TypeError, AttributeError):
+            requests = {}
+    pod = Pod(
+        meta=ObjectMeta(
+            name=meta_name,
+            uid=uid or meta_name,
+            labels=dict(labels),
+            annotations=dict(annotations),
+        ),
+        spec=PodSpec(requests=requests),
+    )
+    return pod
+
+
+class KoordletHookServer:
+    """Serves all seven RPCs; wire into a Dispatcher via :meth:`registration`."""
+
+    def __init__(self, executor: rex.ResourceExecutor):
+        self.executor = executor
+        self.cpu_norm_ratio = 1.0
+
+    def registration(
+        self, failure_policy: FailurePolicy = FailurePolicy.NONE
+    ) -> HookServerRegistration:
+        return HookServerRegistration.create(
+            name="koordlet",
+            hook_types=tuple(RuntimeHookType),
+            handler=self.handle,
+            failure_policy=failure_policy,
+        )
+
+    def handle(self, hook: RuntimeHookType, request):
+        if isinstance(request, PodSandboxHookRequest):
+            return self._handle_sandbox(hook, request)
+        if isinstance(request, ContainerResourceHookRequest):
+            return self._handle_container(hook, request)
+        return None
+
+    def _handle_sandbox(
+        self, hook: RuntimeHookType, request: PodSandboxHookRequest
+    ) -> Optional[PodSandboxHookResponse]:
+        pod = _pod_from(
+            request.pod_meta.name,
+            request.pod_meta.uid,
+            request.labels,
+            request.annotations,
+        )
+        if hook is RuntimeHookType.PRE_RUN_POD_SANDBOX:
+            self.executor.apply(
+                pod_plan(pod, self.cpu_norm_ratio),
+                reason="proxy:PreRunPodSandbox",
+            )
+            return PodSandboxHookResponse(
+                annotations={ext.LABEL_POD_QOS: pod.qos.name}
+            )
+        if hook is RuntimeHookType.POST_STOP_POD_SANDBOX:
+            # resource GC: the reference removes the pod's cgroup-level
+            # knobs; the executor's audit keeps the trail
+            self.executor.gc_group(
+                pod_cgroup(pod), reason="proxy:PostStopPodSandbox"
+            )
+            return PodSandboxHookResponse()
+        return None
+
+    def _handle_container(
+        self, hook: RuntimeHookType, request: ContainerResourceHookRequest
+    ) -> Optional[ContainerResourceHookResponse]:
+        pod = _pod_from(
+            request.pod_meta.name,
+            request.pod_meta.uid,
+            request.pod_labels,
+            request.pod_annotations,
+        )
+        if hook in (
+            RuntimeHookType.PRE_CREATE_CONTAINER,
+            RuntimeHookType.PRE_START_CONTAINER,
+        ):
+            mutation = pod_mutation(pod)
+            return ContainerResourceHookResponse(
+                container_envs=dict(mutation.env)
+            )
+        if hook is RuntimeHookType.PRE_UPDATE_CONTAINER_RESOURCES:
+            self.executor.apply(
+                pod_plan(pod, self.cpu_norm_ratio),
+                reason="proxy:PreUpdateContainerResources",
+            )
+            return ContainerResourceHookResponse()
+        return None

@@ -1,0 +1,118 @@
+"""RuntimeHookService wire types.
+
+Rebuild of ``apis/runtime/v1alpha1/api.proto``: the contract between the
+CRI interposer (:mod:`server`) and hook servers. The reference ships this
+as gRPC/proto3; the rebuild keeps the exact message shapes as dataclasses
+so the dispatcher, store, and merge semantics stay protocol-faithful while
+transport stays in-process (a real deployment would put these back on a
+unix-socket gRPC channel — the shapes are 1:1 with the proto).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, Optional
+
+
+class RuntimeHookType(enum.Enum):
+    """The seven RPCs of RuntimeHookService (api.proto:147-170)."""
+
+    PRE_RUN_POD_SANDBOX = "PreRunPodSandbox"
+    POST_STOP_POD_SANDBOX = "PostStopPodSandbox"
+    PRE_CREATE_CONTAINER = "PreCreateContainer"
+    PRE_START_CONTAINER = "PreStartContainer"
+    POST_START_CONTAINER = "PostStartContainer"
+    POST_STOP_CONTAINER = "PostStopContainer"
+    PRE_UPDATE_CONTAINER_RESOURCES = "PreUpdateContainerResources"
+
+
+#: hook types whose response is merged into the forwarded CRI request;
+#: post-hooks are observational (reference server/cri/runtime.go)
+PRE_HOOKS = frozenset(
+    {
+        RuntimeHookType.PRE_RUN_POD_SANDBOX,
+        RuntimeHookType.PRE_CREATE_CONTAINER,
+        RuntimeHookType.PRE_START_CONTAINER,
+        RuntimeHookType.PRE_UPDATE_CONTAINER_RESOURCES,
+    }
+)
+
+
+@dataclasses.dataclass
+class LinuxContainerResources:
+    """api.proto LinuxContainerResources (the CRI subset the hooks touch)."""
+
+    cpu_period: int = 0
+    cpu_quota: int = 0
+    cpu_shares: int = 0
+    memory_limit_in_bytes: int = 0
+    oom_score_adj: int = 0
+    cpuset_cpus: str = ""
+    cpuset_mems: str = ""
+    unified: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def merge_from(self, other: Optional["LinuxContainerResources"]) -> None:
+        """Non-zero fields of ``other`` win (the proxy's response merge)."""
+        if other is None:
+            return
+        for f in dataclasses.fields(self):
+            val = getattr(other, f.name)
+            if f.name == "unified":
+                self.unified.update(val)
+            elif val:
+                setattr(self, f.name, val)
+
+
+@dataclasses.dataclass
+class PodSandboxMetadata:
+    name: str
+    uid: str
+    namespace: str = "default"
+    attempt: int = 0
+
+
+@dataclasses.dataclass
+class PodSandboxHookRequest:
+    pod_meta: PodSandboxMetadata
+    runtime_handler: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cgroup_parent: str = ""
+    overhead: Optional[LinuxContainerResources] = None
+    resources: Optional[LinuxContainerResources] = None
+
+
+@dataclasses.dataclass
+class PodSandboxHookResponse:
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cgroup_parent: str = ""
+    resources: Optional[LinuxContainerResources] = None
+
+
+@dataclasses.dataclass
+class ContainerMetadata:
+    name: str
+    id: str = ""
+    attempt: int = 0
+
+
+@dataclasses.dataclass
+class ContainerResourceHookRequest:
+    pod_meta: PodSandboxMetadata
+    container_meta: ContainerMetadata
+    container_annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    container_resources: Optional[LinuxContainerResources] = None
+    pod_labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    pod_annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    pod_cgroup_parent: str = ""
+    container_envs: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ContainerResourceHookResponse:
+    container_annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    container_resources: Optional[LinuxContainerResources] = None
+    pod_cgroup_parent: str = ""
+    container_envs: Dict[str, str] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,200 @@
+"""CRI interposer: kubelet → proxy → backend runtime.
+
+Rebuild of ``pkg/runtimeproxy/server/cri/`` (``criserver.go:88``,
+``runtime.go:32-40``): every intercepted CRI call builds a hook request
+(from the call + the checkpoint store), dispatches it to the registered
+hook servers, merges their responses into the forwarded request
+(labels/annotations/cgroup parent/resources/envs — the proto's documented
+merge), then calls the backend runtime. Post-hooks run after the backend
+returns. The backend is injectable; production wires a CRI gRPC client,
+tests a fake (the reference's resexecutor/cri|docker split).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Protocol
+
+from .dispatcher import Dispatcher
+from .proto import (
+    ContainerMetadata,
+    ContainerResourceHookRequest,
+    ContainerResourceHookResponse,
+    LinuxContainerResources,
+    PodSandboxHookRequest,
+    PodSandboxHookResponse,
+    PodSandboxMetadata,
+    RuntimeHookType,
+)
+from .store import ContainerInfo, PodSandboxInfo, Store
+
+
+# ---- minimal CRI request shapes (the fields the proxy touches) ----
+
+
+@dataclasses.dataclass
+class PodSandboxConfig:
+    metadata: PodSandboxMetadata
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cgroup_parent: str = ""
+
+
+@dataclasses.dataclass
+class ContainerConfig:
+    metadata: ContainerMetadata
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    envs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    resources: LinuxContainerResources = dataclasses.field(
+        default_factory=LinuxContainerResources
+    )
+
+
+class BackendRuntime(Protocol):
+    """The real CRI runtime behind the proxy (containerd in the
+    reference; a fake in tests)."""
+
+    def run_pod_sandbox(self, config: PodSandboxConfig) -> str: ...
+    def stop_pod_sandbox(self, pod_id: str) -> None: ...
+    def create_container(self, pod_id: str, config: ContainerConfig) -> str: ...
+    def start_container(self, container_id: str) -> None: ...
+    def stop_container(self, container_id: str) -> None: ...
+    def update_container_resources(
+        self, container_id: str, resources: LinuxContainerResources
+    ) -> None: ...
+
+
+class CRIProxy:
+    """The man-in-the-middle server (one instance per runtime socket)."""
+
+    def __init__(
+        self,
+        backend: BackendRuntime,
+        dispatcher: Optional[Dispatcher] = None,
+        store: Optional[Store] = None,
+    ):
+        self.backend = backend
+        self.dispatcher = dispatcher or Dispatcher()
+        self.store = store or Store()
+
+    # ---- sandbox lifecycle ----
+
+    def run_pod_sandbox(self, config: PodSandboxConfig) -> str:
+        request = PodSandboxHookRequest(
+            pod_meta=config.metadata,
+            labels=dict(config.labels),
+            annotations=dict(config.annotations),
+            cgroup_parent=config.cgroup_parent,
+        )
+        for resp in self.dispatcher.dispatch(
+            RuntimeHookType.PRE_RUN_POD_SANDBOX, request
+        ):
+            self._merge_sandbox(config, resp)
+        pod_id = self.backend.run_pod_sandbox(config)
+        self.store.write_pod(
+            pod_id,
+            PodSandboxInfo(
+                request=request, effective_cgroup_parent=config.cgroup_parent
+            ),
+        )
+        return pod_id
+
+    def stop_pod_sandbox(self, pod_id: str) -> None:
+        self.backend.stop_pod_sandbox(pod_id)
+        info = self.store.get_pod(pod_id)
+        if info is not None:
+            # post-hook: resource GC after the sandbox is gone
+            self.dispatcher.dispatch(
+                RuntimeHookType.POST_STOP_POD_SANDBOX, info.request
+            )
+        self.store.delete_pod(pod_id)
+
+    # ---- container lifecycle ----
+
+    def _container_request(
+        self, pod_id: str, config: ContainerConfig
+    ) -> ContainerResourceHookRequest:
+        pod = self.store.get_pod(pod_id)
+        return ContainerResourceHookRequest(
+            pod_meta=pod.request.pod_meta
+            if pod
+            else PodSandboxMetadata(name="", uid=pod_id),
+            container_meta=config.metadata,
+            container_annotations=dict(config.annotations),
+            container_resources=config.resources,
+            pod_labels=dict(pod.request.labels) if pod else {},
+            pod_annotations=dict(pod.request.annotations) if pod else {},
+            pod_cgroup_parent=pod.effective_cgroup_parent if pod else "",
+            container_envs=dict(config.envs),
+        )
+
+    def create_container(self, pod_id: str, config: ContainerConfig) -> str:
+        request = self._container_request(pod_id, config)
+        for resp in self.dispatcher.dispatch(
+            RuntimeHookType.PRE_CREATE_CONTAINER, request
+        ):
+            self._merge_container(config, resp)
+        container_id = self.backend.create_container(pod_id, config)
+        config.metadata.id = container_id
+        request.container_meta = config.metadata
+        self.store.write_container(
+            container_id, ContainerInfo(pod_id=pod_id, request=request)
+        )
+        return container_id
+
+    def start_container(self, container_id: str) -> None:
+        info = self.store.get_container(container_id)
+        if info is not None:
+            self.dispatcher.dispatch(
+                RuntimeHookType.PRE_START_CONTAINER, info.request
+            )
+        self.backend.start_container(container_id)
+        if info is not None:
+            self.dispatcher.dispatch(
+                RuntimeHookType.POST_START_CONTAINER, info.request
+            )
+
+    def stop_container(self, container_id: str) -> None:
+        self.backend.stop_container(container_id)
+        info = self.store.get_container(container_id)
+        if info is not None:
+            self.dispatcher.dispatch(
+                RuntimeHookType.POST_STOP_CONTAINER, info.request
+            )
+        self.store.delete_container(container_id)
+
+    def update_container_resources(
+        self, container_id: str, resources: LinuxContainerResources
+    ) -> None:
+        info = self.store.get_container(container_id)
+        if info is not None:
+            request = dataclasses.replace(
+                info.request, container_resources=resources
+            )
+            for resp in self.dispatcher.dispatch(
+                RuntimeHookType.PRE_UPDATE_CONTAINER_RESOURCES, request
+            ):
+                if isinstance(resp, ContainerResourceHookResponse):
+                    resources.merge_from(resp.container_resources)
+            info.request.container_resources = resources
+            self.store.write_container(container_id, info)
+        self.backend.update_container_resources(container_id, resources)
+
+    # ---- response merges (api.proto's documented semantics) ----
+
+    @staticmethod
+    def _merge_sandbox(config: PodSandboxConfig, resp: object) -> None:
+        if not isinstance(resp, PodSandboxHookResponse):
+            return
+        config.labels.update(resp.labels)
+        config.annotations.update(resp.annotations)
+        if resp.cgroup_parent:
+            config.cgroup_parent = resp.cgroup_parent
+
+    @staticmethod
+    def _merge_container(config: ContainerConfig, resp: object) -> None:
+        if not isinstance(resp, ContainerResourceHookResponse):
+            return
+        config.annotations.update(resp.container_annotations)
+        config.envs.update(resp.container_envs)
+        config.resources.merge_from(resp.container_resources)

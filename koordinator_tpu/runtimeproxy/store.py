@@ -1,0 +1,134 @@
+"""Pod/container checkpoint store.
+
+Rebuild of ``pkg/runtimeproxy/store/manager.go``: the proxy checkpoints
+every sandbox and container it has seen so later lifecycle calls (which
+carry only ids in CRI) can reconstruct the hook request — and so a proxy
+restart does not orphan in-flight pods. Checkpoints serialize to JSON on
+disk when a path is configured, mirroring the reference's file-backed
+store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Optional
+
+from .proto import (
+    ContainerMetadata,
+    ContainerResourceHookRequest,
+    LinuxContainerResources,
+    PodSandboxHookRequest,
+    PodSandboxMetadata,
+)
+
+
+@dataclasses.dataclass
+class PodSandboxInfo:
+    request: PodSandboxHookRequest
+    #: cgroup parent after hook merges — what the runtime actually used
+    effective_cgroup_parent: str = ""
+
+
+@dataclasses.dataclass
+class ContainerInfo:
+    pod_id: str
+    request: ContainerResourceHookRequest
+
+
+class Store:
+    def __init__(self, checkpoint_path: Optional[str] = None):
+        self.pods: Dict[str, PodSandboxInfo] = {}
+        self.containers: Dict[str, ContainerInfo] = {}
+        self.checkpoint_path = checkpoint_path
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            self._load()
+
+    def write_pod(self, pod_id: str, info: PodSandboxInfo) -> None:
+        self.pods[pod_id] = info
+        self._persist()
+
+    def get_pod(self, pod_id: str) -> Optional[PodSandboxInfo]:
+        return self.pods.get(pod_id)
+
+    def delete_pod(self, pod_id: str) -> None:
+        self.pods.pop(pod_id, None)
+        self._persist()
+
+    def write_container(self, container_id: str, info: ContainerInfo) -> None:
+        self.containers[container_id] = info
+        self._persist()
+
+    def get_container(self, container_id: str) -> Optional[ContainerInfo]:
+        return self.containers.get(container_id)
+
+    def delete_container(self, container_id: str) -> None:
+        self.containers.pop(container_id, None)
+        self._persist()
+
+    # ---- persistence ----
+
+    def _persist(self) -> None:
+        if not self.checkpoint_path:
+            return
+        payload = {
+            "pods": {
+                pid: {
+                    "meta": dataclasses.asdict(info.request.pod_meta),
+                    "labels": info.request.labels,
+                    "annotations": info.request.annotations,
+                    "cgroup_parent": info.request.cgroup_parent,
+                    "effective_cgroup_parent": info.effective_cgroup_parent,
+                }
+                for pid, info in self.pods.items()
+            },
+            "containers": {
+                cid: {
+                    "pod_id": info.pod_id,
+                    "pod_meta": dataclasses.asdict(info.request.pod_meta),
+                    "container_meta": dataclasses.asdict(
+                        info.request.container_meta
+                    ),
+                    "annotations": info.request.container_annotations,
+                    "resources": dataclasses.asdict(info.request.container_resources)
+                    if info.request.container_resources
+                    else None,
+                }
+                for cid, info in self.containers.items()
+            },
+        }
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.checkpoint_path)
+
+    def _load(self) -> None:
+        try:
+            with open(self.checkpoint_path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return
+        for pid, raw in payload.get("pods", {}).items():
+            self.pods[pid] = PodSandboxInfo(
+                request=PodSandboxHookRequest(
+                    pod_meta=PodSandboxMetadata(**raw["meta"]),
+                    labels=raw.get("labels", {}),
+                    annotations=raw.get("annotations", {}),
+                    cgroup_parent=raw.get("cgroup_parent", ""),
+                ),
+                effective_cgroup_parent=raw.get("effective_cgroup_parent", ""),
+            )
+        for cid, raw in payload.get("containers", {}).items():
+            res = raw.get("resources")
+            self.containers[cid] = ContainerInfo(
+                pod_id=raw["pod_id"],
+                request=ContainerResourceHookRequest(
+                    pod_meta=PodSandboxMetadata(**raw["pod_meta"]),
+                    container_meta=ContainerMetadata(**raw["container_meta"]),
+                    container_annotations=raw.get("annotations", {}),
+                    container_resources=LinuxContainerResources(**res)
+                    if res
+                    else None,
+                ),
+            )

@@ -1,0 +1,234 @@
+"""Runtime proxy tests: CRI interposition, hook dispatch with failure
+policies, response merging, checkpoint store restore, and the koordlet
+hook server end of the protocol (SURVEY §2.6)."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.koordlet import resourceexecutor as rex
+from koordinator_tpu.runtimeproxy import (
+    ContainerConfig,
+    ContainerMetadata,
+    ContainerResourceHookResponse,
+    CRIProxy,
+    Dispatcher,
+    FailurePolicy,
+    HookError,
+    HookServerRegistration,
+    KoordletHookServer,
+    LinuxContainerResources,
+    PodSandboxConfig,
+    PodSandboxHookResponse,
+    PodSandboxMetadata,
+    RuntimeHookType,
+    Store,
+    parse_failure_policy,
+)
+from koordinator_tpu.runtimeproxy.hookserver import ANNOTATION_POD_REQUESTS
+
+
+class FakeRuntime:
+    """Backend CRI runtime double: records calls, mints ids."""
+
+    def __init__(self):
+        self.calls = []
+        self.sandboxes = {}
+        self.containers = {}
+
+    def run_pod_sandbox(self, config):
+        pod_id = f"sb-{len(self.sandboxes)}"
+        self.sandboxes[pod_id] = config
+        self.calls.append(("RunPodSandbox", pod_id))
+        return pod_id
+
+    def stop_pod_sandbox(self, pod_id):
+        self.calls.append(("StopPodSandbox", pod_id))
+
+    def create_container(self, pod_id, config):
+        cid = f"c-{len(self.containers)}"
+        self.containers[cid] = config
+        self.calls.append(("CreateContainer", cid))
+        return cid
+
+    def start_container(self, container_id):
+        self.calls.append(("StartContainer", container_id))
+
+    def stop_container(self, container_id):
+        self.calls.append(("StopContainer", container_id))
+
+    def update_container_resources(self, container_id, resources):
+        self.calls.append(("UpdateContainerResources", container_id, resources))
+
+
+def sandbox_cfg(name="pod-a", labels=None, annotations=None):
+    return PodSandboxConfig(
+        metadata=PodSandboxMetadata(name=name, uid=f"uid-{name}"),
+        labels=labels or {},
+        annotations=annotations or {},
+        cgroup_parent="kubepods/burstable",
+    )
+
+
+def test_proxy_forwards_and_checkpoints():
+    rt = FakeRuntime()
+    proxy = CRIProxy(rt)
+    pod_id = proxy.run_pod_sandbox(sandbox_cfg())
+    assert rt.calls[0] == ("RunPodSandbox", pod_id)
+    assert proxy.store.get_pod(pod_id).request.pod_meta.name == "pod-a"
+    cid = proxy.create_container(pod_id, ContainerConfig(ContainerMetadata("main")))
+    assert proxy.store.get_container(cid).pod_id == pod_id
+    proxy.stop_container(cid)
+    assert proxy.store.get_container(cid) is None
+    proxy.stop_pod_sandbox(pod_id)
+    assert proxy.store.get_pod(pod_id) is None
+
+
+def test_pre_hook_response_merges_into_request():
+    rt = FakeRuntime()
+    proxy = CRIProxy(rt)
+
+    def handler(hook, request):
+        if hook is RuntimeHookType.PRE_RUN_POD_SANDBOX:
+            return PodSandboxHookResponse(
+                labels={"injected": "yes"}, cgroup_parent="kubepods/besteffort"
+            )
+        if hook is RuntimeHookType.PRE_CREATE_CONTAINER:
+            return ContainerResourceHookResponse(
+                container_envs={"HOOKED": "1"},
+                container_resources=LinuxContainerResources(cpu_shares=2),
+            )
+        return None
+
+    proxy.dispatcher.register(
+        HookServerRegistration.create("t", tuple(RuntimeHookType), handler)
+    )
+    pod_id = proxy.run_pod_sandbox(sandbox_cfg())
+    fwd = rt.sandboxes[pod_id]
+    assert fwd.labels["injected"] == "yes"
+    assert fwd.cgroup_parent == "kubepods/besteffort"
+    # container request inherits the *effective* cgroup parent
+    cid = proxy.create_container(pod_id, ContainerConfig(ContainerMetadata("m")))
+    assert rt.containers[cid].envs == {"HOOKED": "1"}
+    assert rt.containers[cid].resources.cpu_shares == 2
+    assert (
+        proxy.store.get_container(cid).request.pod_cgroup_parent
+        == "kubepods/besteffort"
+    )
+
+
+def test_failure_policy_fail_vs_ignore():
+    def boom(hook, request):
+        raise RuntimeError("down")
+
+    rt = FakeRuntime()
+    proxy = CRIProxy(rt)
+    proxy.dispatcher.register(
+        HookServerRegistration.create(
+            "flaky", (RuntimeHookType.PRE_RUN_POD_SANDBOX,), boom,
+            FailurePolicy.IGNORE,
+        )
+    )
+    pod_id = proxy.run_pod_sandbox(sandbox_cfg())   # proceeds
+    assert pod_id in rt.sandboxes
+    proxy.dispatcher.register(
+        HookServerRegistration.create(
+            "strict", (RuntimeHookType.PRE_RUN_POD_SANDBOX,), boom,
+            FailurePolicy.FAIL,
+        )
+    )
+    with pytest.raises(HookError):
+        proxy.run_pod_sandbox(sandbox_cfg(name="pod-b"))
+    assert "sb-1" not in rt.sandboxes  # never reached the backend
+    assert parse_failure_policy("Fail") is FailurePolicy.FAIL
+    assert parse_failure_policy("whatever").fails_open
+
+
+def test_update_container_resources_merge():
+    rt = FakeRuntime()
+    proxy = CRIProxy(rt)
+
+    def handler(hook, request):
+        if hook is RuntimeHookType.PRE_UPDATE_CONTAINER_RESOURCES:
+            return ContainerResourceHookResponse(
+                container_resources=LinuxContainerResources(cpu_quota=50_000)
+            )
+        return None
+
+    proxy.dispatcher.register(
+        HookServerRegistration.create("t", tuple(RuntimeHookType), handler)
+    )
+    pod_id = proxy.run_pod_sandbox(sandbox_cfg())
+    cid = proxy.create_container(pod_id, ContainerConfig(ContainerMetadata("m")))
+    res = LinuxContainerResources(cpu_period=100_000, cpu_quota=200_000)
+    proxy.update_container_resources(cid, res)
+    # hook's non-zero quota overrode kubelet's
+    sent = rt.calls[-1][2]
+    assert sent.cpu_quota == 50_000 and sent.cpu_period == 100_000
+
+
+def test_store_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "proxy.json")
+    rt = FakeRuntime()
+    proxy = CRIProxy(rt, store=Store(checkpoint_path=path))
+    pod_id = proxy.run_pod_sandbox(sandbox_cfg(labels={"a": "b"}))
+    cid = proxy.create_container(
+        pod_id,
+        ContainerConfig(
+            ContainerMetadata("m"),
+            resources=LinuxContainerResources(cpu_shares=512),
+        ),
+    )
+    # simulate proxy restart
+    restored = Store(checkpoint_path=path)
+    assert restored.get_pod(pod_id).request.labels == {"a": "b"}
+    info = restored.get_container(cid)
+    assert info.pod_id == pod_id
+    assert info.request.container_resources.cpu_shares == 512
+
+
+def test_koordlet_hookserver_end_to_end(tmp_path):
+    """kubelet → proxy → koordlet hook server → cgroup writes + env."""
+    executor = rex.ResourceExecutor(cgroup_root=str(tmp_path))
+    hooks = KoordletHookServer(executor)
+    rt = FakeRuntime()
+    proxy = CRIProxy(rt)
+    proxy.dispatcher.register(hooks.registration())
+
+    alloc = {"gpu": [{"minor": 0}, {"minor": 1}]}
+    cfg = sandbox_cfg(
+        name="be-1",
+        labels={ext.LABEL_POD_QOS: "BE"},
+        annotations={
+            ANNOTATION_POD_REQUESTS: json.dumps(
+                {ext.RES_BATCH_CPU: 2000, ext.RES_BATCH_MEMORY: 1024}
+            ),
+            ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(alloc),
+        },
+    )
+    pod_id = proxy.run_pod_sandbox(cfg)
+    # bvt for BE was written before the sandbox started
+    bvt = executor.read("kubepods/besteffort/pod-be-1", rex.CPU_BVT)
+    assert bvt == "-1"
+    shares = executor.read("kubepods/besteffort/pod-be-1", rex.CPU_SHARES)
+    assert shares == str(int(2000 * 1024 / 1000))
+    # container gets the device env via PreCreateContainer
+    cid = proxy.create_container(pod_id, ContainerConfig(ContainerMetadata("m")))
+    assert rt.containers[cid].envs["KOORD_VISIBLE_DEVICES"] == "0,1"
+    # teardown GC clears the executor cache for the pod group
+    proxy.stop_pod_sandbox(pod_id)
+    events = executor.auditor.query(group_prefix="kubepods/besteffort/pod-be-1")
+    assert any(e.new == "<gc>" for e in events)
+
+
+def test_gc_group_is_path_boundary_aware(tmp_path):
+    """pod-web-1 teardown must not drop pod-web-10's write cache."""
+    executor = rex.ResourceExecutor(cgroup_root=str(tmp_path))
+    executor.write("kubepods/pod-web-1", rex.CPU_SHARES, "512")
+    executor.write("kubepods/pod-web-1/sub", rex.CPU_SHARES, "256")
+    executor.write("kubepods/pod-web-10", rex.CPU_SHARES, "1024")
+    executor.gc_group("kubepods/pod-web-1", reason="teardown")
+    assert ("kubepods/pod-web-1", rex.CPU_SHARES) not in executor._cache
+    assert ("kubepods/pod-web-1/sub", rex.CPU_SHARES) not in executor._cache
+    assert ("kubepods/pod-web-10", rex.CPU_SHARES) in executor._cache
