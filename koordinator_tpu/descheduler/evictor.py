@@ -1,0 +1,121 @@
+"""Evictors + evictability policy.
+
+Rebuild of the reference's three eviction mechanisms
+(``pkg/descheduler/evictions/`` + migration
+``evictor/evictor_{native,delete,soft}.go``) and the vendored
+DefaultEvictor evictability rules
+(``framework/plugins/kubernetes/defaultevictor``): which pods may be
+evicted at all, and how the eviction is delivered — eviction API
+(PDB-respecting), plain delete, or a soft label the workload controller
+reacts to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Protocol
+
+from ..api import extension as ext
+from ..api.types import Pod, PodPhase
+
+#: opt-out/opt-in annotation honored by the policy (sigs descheduler)
+ANNOTATION_EVICT_OPT_OUT = "descheduler.alpha.kubernetes.io/prefer-no-eviction"
+#: soft-eviction labels written by the soft evictor (reference
+#: evictor_soft.go: the workload controller watches these)
+LABEL_SOFT_EVICTION = f"scheduling.{ext.DOMAIN}/soft-eviction"
+ANNOTATION_SOFT_EVICTION_SPEC = f"scheduling.{ext.DOMAIN}/soft-eviction-spec"
+
+
+@dataclasses.dataclass
+class PodEvictionPolicy:
+    """DefaultEvictor-style evictability predicate."""
+
+    evict_system_critical: bool = False
+    evict_local_storage: bool = False
+    evict_ownerless: bool = False
+    ignore_pvc_pods: bool = False
+    #: pods at/above this priority are never evicted (system band default)
+    priority_threshold: int = 10000
+    #: extra label selector; empty matches all
+    label_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def evictable(self, pod: Pod) -> bool:
+        if pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            return False  # already terminal; nothing to evict
+        if pod.meta.annotations.get(ANNOTATION_EVICT_OPT_OUT) == "true":
+            return False
+        prio = pod.spec.priority or 0
+        if not self.evict_system_critical and prio >= self.priority_threshold:
+            return False
+        if not self.evict_ownerless and pod.meta.labels.get("owner-kind") is None:
+            # the reference inspects ownerReferences; the rebuild's Pod
+            # carries the controller kind in a label set by the informer
+            if "owner-kind" not in pod.meta.labels:
+                return False
+        if self.label_selector and not all(
+            pod.meta.labels.get(k) == v for k, v in self.label_selector.items()
+        ):
+            return False
+        return True
+
+
+class Evictor(Protocol):
+    name: str
+
+    def evict(self, pod: Pod, reason: str) -> bool: ...
+
+
+PDBCheck = Callable[[Pod], bool]  # True = disruption allowed
+
+
+class NativeEvictor:
+    """Eviction-API path (``evictor_native.go``): respects PDBs via the
+    injected check; the apiserver call is the ``delete_fn`` callback."""
+
+    name = "Eviction"
+
+    def __init__(
+        self,
+        delete_fn: Callable[[Pod], bool],
+        pdb_check: Optional[PDBCheck] = None,
+    ):
+        self.delete_fn = delete_fn
+        self.pdb_check = pdb_check
+
+    def evict(self, pod: Pod, reason: str) -> bool:
+        if self.pdb_check is not None and not self.pdb_check(pod):
+            return False
+        return self.delete_fn(pod)
+
+
+class DeleteEvictor:
+    """Plain pod delete (``evictor_delete.go``): no PDB protection."""
+
+    name = "Delete"
+
+    def __init__(self, delete_fn: Callable[[Pod], bool]):
+        self.delete_fn = delete_fn
+
+    def evict(self, pod: Pod, reason: str) -> bool:
+        return self.delete_fn(pod)
+
+
+class SoftEvictor:
+    """Label-only eviction (``evictor_soft.go``): annotate the pod and
+    let its workload controller do a graceful replace."""
+
+    name = "SoftEviction"
+
+    def __init__(self) -> None:
+        self.marked: List[Pod] = []
+
+    def evict(self, pod: Pod, reason: str) -> bool:
+        if pod.meta.labels.get(LABEL_SOFT_EVICTION) == "true":
+            return False  # already marked
+        pod.meta.labels[LABEL_SOFT_EVICTION] = "true"
+        pod.meta.annotations[ANNOTATION_SOFT_EVICTION_SPEC] = (
+            f'{{"timestamp": {time.time():.0f}, "reason": "{reason}"}}'
+        )
+        self.marked.append(pod)
+        return True

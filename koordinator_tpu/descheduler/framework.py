@@ -1,0 +1,152 @@
+"""Descheduler framework: plugin API, profiles, loop, dry-run.
+
+Rebuild of ``pkg/descheduler/framework/`` (plugin contracts
+``types.go:78-103``), ``framework/runtime/`` (registry + profiles,
+dry-run at ``framework/runtime:103-105``), and the top-level loop
+(``descheduler.go:243-283``): every interval, run each profile's
+Deschedule plugins then Balance plugins over the node set; evictions
+flow through the profile's Evictor, which Filter plugins and the
+evictability policy gate, and which dry-run mode turns into a recorder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
+
+from ..api.types import Node, Pod
+from .evictor import Evictor, PodEvictionPolicy
+
+
+class DeschedulePlugin(Protocol):
+    """Strategy evicting by per-pod policy violations (types.go:78-86)."""
+
+    name: str
+
+    def deschedule(self, ctx: "FrameworkContext") -> int: ...
+
+
+class BalancePlugin(Protocol):
+    """Strategy redistributing load across nodes (types.go:88-95)."""
+
+    name: str
+
+    def balance(self, ctx: "FrameworkContext") -> int: ...
+
+
+FilterFn = Callable[[Pod], bool]
+
+
+@dataclasses.dataclass
+class EvictionRecord:
+    pod: Pod
+    reason: str
+    plugin: str
+    executed: bool
+
+
+@dataclasses.dataclass
+class FrameworkContext:
+    """What plugins see each round: the node/pod inventory + the evict
+    entry point (the framework handle of the reference)."""
+
+    nodes: Sequence[Node]
+    pods: Sequence[Pod]
+    evict: Callable[[Pod, str, str], bool]     # (pod, reason, plugin)
+
+
+class Profile:
+    """One descheduling profile: ordered plugin lists + an evictor chain
+    (``framework/runtime/profile.go``)."""
+
+    def __init__(
+        self,
+        name: str,
+        deschedule_plugins: Sequence[DeschedulePlugin] = (),
+        balance_plugins: Sequence[BalancePlugin] = (),
+        evictor: Optional[Evictor] = None,
+        policy: Optional[PodEvictionPolicy] = None,
+        filters: Sequence[FilterFn] = (),
+        dry_run: bool = False,
+        max_evictions_per_round: int = 0,
+    ):
+        self.name = name
+        self.deschedule_plugins = list(deschedule_plugins)
+        self.balance_plugins = list(balance_plugins)
+        self.evictor = evictor
+        self.policy = policy or PodEvictionPolicy()
+        self.filters = list(filters)
+        self.dry_run = dry_run
+        self.max_evictions_per_round = max_evictions_per_round
+        self.records: List[EvictionRecord] = []
+        self._round_evictions = 0
+
+    def _evict(self, pod: Pod, reason: str, plugin: str) -> bool:
+        if (
+            self.max_evictions_per_round
+            and self._round_evictions >= self.max_evictions_per_round
+        ):
+            return False
+        if not self.policy.evictable(pod):
+            return False
+        for f in self.filters:
+            if not f(pod):
+                return False
+        executed = False
+        if not self.dry_run and self.evictor is not None:
+            executed = self.evictor.evict(pod, reason)
+        self.records.append(
+            EvictionRecord(pod=pod, reason=reason, plugin=plugin, executed=executed)
+        )
+        if executed or self.dry_run:
+            self._round_evictions += 1
+        return executed or self.dry_run
+
+    def run_once(self, nodes: Sequence[Node], pods: Sequence[Pod]) -> Dict[str, int]:
+        """One descheduler round: Deschedule plugins then Balance plugins
+        (descheduler.go:261-283 deschedulerOnce ordering)."""
+        self._round_evictions = 0
+        ctx = FrameworkContext(nodes=nodes, pods=pods, evict=self._evict)
+        counts: Dict[str, int] = {}
+        for plugin in self.deschedule_plugins:
+            counts[plugin.name] = plugin.deschedule(ctx)
+        for plugin in self.balance_plugins:
+            counts[plugin.name] = plugin.balance(ctx)
+        return counts
+
+
+class Registry:
+    """Plugin factory registry (``framework/runtime/registry.go``)."""
+
+    def __init__(self) -> None:
+        self._factories: Dict[str, Callable[..., object]] = {}
+
+    def register(self, name: str, factory: Callable[..., object]) -> None:
+        if name in self._factories:
+            raise ValueError(f"plugin {name} already registered")
+        self._factories[name] = factory
+
+    def build(self, name: str, *args, **kwargs) -> object:
+        if name not in self._factories:
+            raise KeyError(f"unknown descheduler plugin {name}")
+        return self._factories[name](*args, **kwargs)
+
+    def names(self) -> List[str]:
+        return sorted(self._factories)
+
+
+class Descheduler:
+    """The loop owner: profiles run in order every interval
+    (``descheduler.go:243-283``; time is injected — the reference uses
+    ``wait.Until``)."""
+
+    def __init__(self, profiles: Sequence[Profile], interval_s: float = 120.0):
+        self.profiles = list(profiles)
+        self.interval_s = interval_s
+        self.rounds = 0
+
+    def run_once(
+        self, nodes: Sequence[Node], pods: Sequence[Pod]
+    ) -> Dict[str, Dict[str, int]]:
+        self.rounds += 1
+        return {p.name: p.run_once(nodes, pods) for p in self.profiles}

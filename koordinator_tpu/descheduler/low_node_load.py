@@ -179,3 +179,23 @@ class LowNodeLoad:
                 victims.append(pod)
                 evicted += 1
         return victims
+
+
+class LowNodeLoadBalance:
+    """Framework adapter: runs LowNodeLoad as a Balance plugin
+    (``low_node_load.go:137`` Balance entry point) — classify, select
+    victims, push each through the profile's evictor chain."""
+
+    name = "LowNodeLoad"
+
+    def __init__(self, plugin: LowNodeLoad):
+        self.plugin = plugin
+
+    def balance(self, ctx) -> int:
+        cls = self.plugin.classify()
+        victims = self.plugin.select_victims(list(ctx.pods), cls)
+        evicted = 0
+        for pod in victims:
+            if ctx.evict(pod, "node overutilized", self.name):
+                evicted += 1
+        return evicted

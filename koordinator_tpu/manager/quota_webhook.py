@@ -177,15 +177,28 @@ class QuotaTopologyValidator:
 
 class QuotaAdmissionEvaluator:
     """Pod-time quota admission (``pkg/webhook/quotaevaluate/``,
-    gated by ``EnableQuotaAdmission``): used + request ≤ runtime along the
-    pod's quota chain, checked against the scheduler's GroupQuotaManager."""
+    gated by the ``EnableQuotaAdmission`` feature gate): used + request ≤
+    runtime along the pod's quota chain, checked against the scheduler's
+    GroupQuotaManager."""
 
-    def __init__(self, manager: GroupQuotaManager, enabled: bool = True):
+    def __init__(
+        self, manager: GroupQuotaManager, enabled: Optional[bool] = None
+    ):
         self.manager = manager
+        #: None = follow the feature gate live (queried per admit, so a
+        #: --feature-gates change after wiring takes effect immediately)
         self.enabled = enabled
 
+    @property
+    def _enabled_now(self) -> bool:
+        if self.enabled is not None:
+            return self.enabled
+        from ..utils.features import MANAGER_GATES
+
+        return MANAGER_GATES.enabled("EnableQuotaAdmission")
+
     def admit(self, pod: Pod) -> List[str]:
-        if not self.enabled:
+        if not self._enabled_now:
             return []
         quota = quota_name_of(pod)
         if quota is None or self.manager.index_of(quota) is None:

@@ -1,0 +1,210 @@
+"""Descheduler framework tests: plugin registry, profile loop, dry-run,
+evictability policy, the three evictor mechanisms, and LowNodeLoad wired
+through the framework (SURVEY §2.4)."""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    ResourceMetric,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.descheduler.evictor import (
+    ANNOTATION_EVICT_OPT_OUT,
+    DeleteEvictor,
+    LABEL_SOFT_EVICTION,
+    NativeEvictor,
+    PodEvictionPolicy,
+    SoftEvictor,
+)
+from koordinator_tpu.descheduler.framework import (
+    Descheduler,
+    Profile,
+    Registry,
+)
+from koordinator_tpu.descheduler.low_node_load import (
+    LowNodeLoad,
+    LowNodeLoadArgs,
+    LowNodeLoadBalance,
+)
+
+
+def pod(name, prio=5500, owner=True, node=None, cpu=1000.0, labels=None):
+    lab = dict(labels or {})
+    if owner:
+        lab.setdefault("owner-kind", "ReplicaSet")
+    return Pod(
+        meta=ObjectMeta(name=name, labels=lab),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu},
+            priority=prio,
+            node_name=node,
+        ),
+        phase=PodPhase.RUNNING if node else PodPhase.PENDING,
+    )
+
+
+# ---- evictability policy ----
+
+
+def test_policy_guards():
+    policy = PodEvictionPolicy()
+    assert policy.evictable(pod("ok"))
+    assert not policy.evictable(pod("sys", prio=10_000))
+    assert not policy.evictable(pod("orphan", owner=False))
+    opt_out = pod("optout")
+    opt_out.meta.annotations[ANNOTATION_EVICT_OPT_OUT] = "true"
+    assert not policy.evictable(opt_out)
+    done = pod("done")
+    done.phase = PodPhase.SUCCEEDED
+    assert not policy.evictable(done)
+    scoped = PodEvictionPolicy(label_selector={"tier": "batch"})
+    assert not scoped.evictable(pod("other"))
+    assert scoped.evictable(pod("batchy", labels={"tier": "batch"}))
+
+
+# ---- evictors ----
+
+
+def test_native_evictor_respects_pdb():
+    deleted = []
+    ev = NativeEvictor(
+        delete_fn=lambda p: (deleted.append(p.meta.name), True)[1],
+        pdb_check=lambda p: p.meta.name != "protected",
+    )
+    assert ev.evict(pod("free"), "test")
+    assert not ev.evict(pod("protected"), "test")
+    assert deleted == ["free"]
+
+
+def test_soft_evictor_marks_once():
+    ev = SoftEvictor()
+    p = pod("victim")
+    assert ev.evict(p, "rebalance")
+    assert p.meta.labels[LABEL_SOFT_EVICTION] == "true"
+    assert "rebalance" in p.meta.annotations["scheduling.koordinator.sh/soft-eviction-spec"]
+    assert not ev.evict(p, "again")
+    assert len(ev.marked) == 1
+
+
+# ---- registry / profile / dry-run ----
+
+
+class FakeDeschedule:
+    name = "FakePolicy"
+
+    def deschedule(self, ctx):
+        n = 0
+        for p in ctx.pods:
+            if p.meta.labels.get("bad") == "true":
+                if ctx.evict(p, "policy violation", self.name):
+                    n += 1
+        return n
+
+
+def test_registry_builds_and_rejects_dupes():
+    reg = Registry()
+    reg.register("FakePolicy", FakeDeschedule)
+    assert isinstance(reg.build("FakePolicy"), FakeDeschedule)
+    try:
+        reg.register("FakePolicy", FakeDeschedule)
+        raise AssertionError("dup registration allowed")
+    except ValueError:
+        pass
+    assert reg.names() == ["FakePolicy"]
+
+
+def test_profile_dry_run_records_without_evicting():
+    deleted = []
+    prof = Profile(
+        name="dry",
+        deschedule_plugins=[FakeDeschedule()],
+        evictor=DeleteEvictor(lambda p: (deleted.append(p), True)[1]),
+        dry_run=True,
+    )
+    pods = [pod("a", labels={"bad": "true"}), pod("b")]
+    counts = prof.run_once([], pods)
+    assert counts["FakePolicy"] == 1
+    assert deleted == []                      # dry-run: nothing deleted
+    assert len(prof.records) == 1
+    assert prof.records[0].executed is False
+
+
+def test_profile_eviction_budget_and_policy():
+    deleted = []
+    prof = Profile(
+        name="real",
+        deschedule_plugins=[FakeDeschedule()],
+        evictor=DeleteEvictor(lambda p: (deleted.append(p.meta.name), True)[1]),
+        max_evictions_per_round=1,
+    )
+    pods = [
+        pod("a", labels={"bad": "true"}),
+        pod("b", labels={"bad": "true"}),
+        pod("sys", prio=10_000, labels={"bad": "true"}),  # policy blocks
+    ]
+    counts = prof.run_once([], pods)
+    assert counts["FakePolicy"] == 1          # budget capped the second
+    assert deleted == ["a"]
+
+
+# ---- LowNodeLoad through the framework ----
+
+
+def make_cluster():
+    snap = ClusterSnapshot()
+    for i, util in enumerate([0.9, 0.9, 0.2, 0.2]):
+        name = f"n{i}"
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 10_000, ext.RES_MEMORY: 10_000}
+                ),
+            )
+        )
+        snap.set_node_metric(
+            NodeMetric(
+                meta=ObjectMeta(name=name),
+                node_usage=ResourceMetric(
+                    usage={ext.RES_CPU: 10_000 * util, ext.RES_MEMORY: 10_000 * util}
+                ),
+                update_time=1000.0,
+            ),
+            now=1001.0,
+        )
+    return snap
+
+
+def test_low_node_load_balance_plugin():
+    snap = make_cluster()
+    lnl = LowNodeLoad(
+        snap, LowNodeLoadArgs(anomaly_condition_count=2, max_evictions_per_node=2)
+    )
+    balance = LowNodeLoadBalance(lnl)
+    evictor = SoftEvictor()
+    prof = Profile(name="load", balance_plugins=[balance], evictor=evictor)
+    nodes, pods = [], [
+        pod("be-1", prio=5200, node="n0"),
+        pod("ls-1", prio=9200, node="n0"),
+        pod("be-2", prio=5200, node="n1"),
+    ]
+    desched = Descheduler([prof])
+    # round 1: debounce holds fire
+    out = desched.run_once(nodes, pods)
+    assert out["load"]["LowNodeLoad"] == 0
+    # round 2: overutilized nodes are actionable; batch pods go first
+    out = desched.run_once(nodes, pods)
+    assert out["load"]["LowNodeLoad"] >= 1
+    assert all(p.meta.labels.get(LABEL_SOFT_EVICTION) == "true" for p in evictor.marked)
+    # lowest priority band leaves n0 first; the prod pod may follow only
+    # because the node is still far above target after the batch eviction
+    n0_marked = [p.meta.name for p in evictor.marked if p.spec.node_name == "n0"]
+    assert n0_marked[0] == "be-1"

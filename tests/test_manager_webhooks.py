@@ -130,7 +130,7 @@ def test_quota_admission_evaluator():
     mgr.set_leaf_requests(
         {"team": mgr.config.res_vector({ext.RES_CPU: 100.0})}
     )
-    ev = QuotaAdmissionEvaluator(mgr)
+    ev = QuotaAdmissionEvaluator(mgr, enabled=True)
     pod = Pod(
         meta=ObjectMeta(name="p", labels={ext.LABEL_QUOTA_NAME: "team"}),
         spec=PodSpec(requests={ext.RES_CPU: 50.0}),
@@ -140,6 +140,14 @@ def test_quota_admission_evaluator():
     assert ev.admit(pod)  # 80 + 50 > 100
     ev.enabled = False
     assert ev.admit(pod) == []
+    # default follows the EnableQuotaAdmission feature gate LIVE (off
+    # upstream; flipping the gate affects an already-built evaluator)
+    from koordinator_tpu.utils.features import MANAGER_GATES
+
+    gated = QuotaAdmissionEvaluator(mgr)
+    assert gated.admit(pod) == []           # gate off -> no admission check
+    with MANAGER_GATES.override("EnableQuotaAdmission", True):
+        assert gated.admit(pod)             # same instance, gate now on
 
 
 # ---- quota profile controller ----
