@@ -383,9 +383,11 @@ class BatchScheduler:
         """Host-side Reserve: revalidate each nomination against live numpy
         state (the reference's Reserve mutates the scheduler cache the same
         way, ``framework_extender.go:546``)."""
+        from .prebind import DefaultPreBind
+
         na = self.snapshot.nodes
         results: List[Tuple[Pod, Optional[str]]] = []
-        pending_patches: Dict[str, Dict[str, str]] = {}
+        prebind = DefaultPreBind()
         order = sorted(
             range(len(chunk)), key=lambda i: (-(chunk[i].spec.priority or 0), i)
         )
@@ -424,17 +426,20 @@ class BatchScheduler:
                     results.append((pod, None))
                     continue
                 patch.update(dev_patch)
-            pending_patches[pod.meta.uid] = patch
+            prebind.stage_annotations(pod, patch)
             est = req * self._scales
             self.snapshot.assume_pod(pod, node_name, est)
             results.append((pod, node_name))
         # Permit: all-or-nothing over gangs; roll back assumes of rejects.
         bound, unsched = self.pod_groups.permit(results)
         bound_uids = {p.meta.uid for p, _ in bound}
+        # terminal PreBind: one merged patch per admitted pod
+        # (defaultprebind/plugin.go; rejected pods' patches evaporate)
         for pod, _node in bound:
-            pod.meta.annotations.update(pending_patches.get(pod.meta.uid, {}))
+            prebind.apply(pod)
         for pod, node in results:
             if node is not None and pod.meta.uid not in bound_uids:
+                prebind.discard(pod.meta.uid)
                 self.snapshot.forget_pod(pod.meta.uid)
                 if self.numa is not None:
                     self.numa.release(pod.meta.uid, node)

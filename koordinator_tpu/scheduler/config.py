@@ -1,0 +1,331 @@
+"""Versioned componentconfig: decode → default → validate plugin args.
+
+Rebuild of ``pkg/scheduler/apis/config/`` (``types.go:31-305`` canonical
+args, ``v1``/``v1beta3`` decoders with ``SetDefaults_*``, and
+``validation/validation_pluginargs.go``): a scheduler configuration is a
+mapping of profile → plugin → raw args dict; the version tag is checked
+(v1 and v1beta3 share spellings for these args), absent keys fall back to
+the canonical dataclass defaults, and validation rejects out-of-range
+values with field paths via :class:`ConfigError`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from ..api import extension as ext
+from ..descheduler.low_node_load import LowNodeLoadArgs
+from .batch_solver import LoadAwareArgs
+
+#: v1 and v1beta3 share field spellings for every arg this rebuild
+#: consumes; the version tag is validated (unknown versions rejected)
+#: but selects no distinct decode path.
+SUPPORTED_VERSIONS = ("v1", "v1beta3")
+
+#: reference defaults (v1beta3/defaults.go) applied only when the key is
+#: ABSENT — an explicit empty map stays empty ("0/absent disables the
+#: check"), matching the reference's nil-vs-empty distinction
+DEFAULT_ESTIMATED_SCALING = {ext.RES_CPU: 0.85, ext.RES_MEMORY: 0.70}
+AGG_TYPES = ("avg", "p50", "p90", "p95", "p99")
+
+
+class ConfigError(ValueError):
+    """Decode/validation failure with a field path (the reference's
+    field.Invalid errors)."""
+
+    def __init__(self, path: str, message: str):
+        super().__init__(f"{path}: {message}")
+        self.path = path
+
+
+@dataclasses.dataclass
+class NodeNUMAResourceArgs:
+    """types.go NodeNUMAResourceArgs subset the rebuild consumes."""
+
+    default_cpu_bind_policy: str = "FullPCPUs"
+    scoring_strategy: str = "LeastAllocated"    # or MostAllocated
+
+
+@dataclasses.dataclass
+class ElasticQuotaArgs:
+    delay_evict_time_s: float = 300.0
+    revoke_pods_interval_s: float = 60.0
+    default_quota_group_max: Mapping[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+    quota_group_namespace: str = "koordinator-system"
+    enable_check_parent_quota: bool = False
+    disable_default_quota_preemption: bool = True
+
+
+@dataclasses.dataclass
+class CoschedulingArgs:
+    default_timeout_s: float = 600.0
+    controller_workers: int = 1
+
+
+@dataclasses.dataclass
+class DeviceShareArgs:
+    allocator: str = ""
+    scoring_strategy: str = "LeastAllocated"
+
+
+@dataclasses.dataclass
+class ReservationArgs:
+    enable_preemption: bool = False
+    min_candidate_nodes_percentage: int = 10
+    gc_duration_s: float = 24 * 3600.0
+
+
+def _num(raw: Mapping[str, Any], key: str, default: float) -> float:
+    if key not in raw:
+        return default
+    try:
+        return float(raw[key])
+    except (TypeError, ValueError):
+        raise ConfigError(key, f"not a number: {raw[key]!r}") from None
+
+
+def _int(raw: Mapping[str, Any], key: str, default: int) -> int:
+    if key not in raw:
+        return default
+    try:
+        return int(raw[key])
+    except (TypeError, ValueError):
+        raise ConfigError(key, f"not an integer: {raw[key]!r}") from None
+
+
+def _table(raw: Any, key: str = "") -> Dict[str, float]:
+    if not isinstance(raw, Mapping):
+        return {}
+    try:
+        return {str(k): float(v) for k, v in raw.items()}
+    except (TypeError, ValueError):
+        raise ConfigError(key or "<map>", "values must be numbers") from None
+
+
+def _set_if_present(
+    kwargs: Dict[str, Any], raw: Mapping[str, Any], key: str, field: str
+) -> None:
+    """Map a raw map-valued field onto a dataclass kwarg only when the
+    user supplied it — absent keys fall through to the dataclass default
+    factory, keeping the defaults in ONE place (the args dataclass)."""
+    if key in raw:
+        kwargs[field] = _table(raw.get(key), key)
+
+
+def decode_load_aware(raw: Mapping[str, Any]) -> LoadAwareArgs:
+    """v1/v1beta3 LoadAwareSchedulingArgs → canonical, with the reference's
+    defaulting (defaults.go:89-116: merge estimator scales key-wise)."""
+    kwargs: Dict[str, Any] = {}
+    _set_if_present(kwargs, raw, "usageThresholds", "usage_thresholds")
+    _set_if_present(kwargs, raw, "prodUsageThresholds", "prod_usage_thresholds")
+    _set_if_present(kwargs, raw, "resourceWeights", "resource_weights")
+    # estimator scales: key-wise merge over the defaults (defaults.go:106-115)
+    scales = dict(DEFAULT_ESTIMATED_SCALING)
+    scales.update(_table(raw.get("estimatedScalingFactors"), "estimatedScalingFactors"))
+    kwargs["estimator_scales"] = scales
+    kwargs["node_metric_expiration_s"] = _num(
+        raw, "nodeMetricExpirationSeconds", 180.0
+    )
+    agg = raw.get("aggregated") or {}
+    kwargs["aggregated_usage_type"] = str(
+        agg.get("usageAggregationType", raw.get("usageAggregationType", "p95"))
+    )
+    return LoadAwareArgs(**kwargs)
+
+
+def validate_load_aware(args: LoadAwareArgs, path: str = "loadAware") -> None:
+    if args.node_metric_expiration_s <= 0:
+        raise ConfigError(
+            f"{path}.nodeMetricExpirationSeconds",
+            "should be a positive value",
+        )
+    for name, table in (
+        ("usageThresholds", args.usage_thresholds),
+        ("prodUsageThresholds", args.prod_usage_thresholds),
+    ):
+        for res, val in table.items():
+            if not 0.0 <= val <= 100.0:
+                raise ConfigError(
+                    f"{path}.{name}[{res}]", f"threshold {val} outside [0, 100]"
+                )
+    for res, val in args.resource_weights.items():
+        if val <= 0:
+            raise ConfigError(
+                f"{path}.resourceWeights[{res}]", "weight must be positive"
+            )
+    for res, val in args.estimator_scales.items():
+        if val <= 0:
+            raise ConfigError(
+                f"{path}.estimatedScalingFactors[{res}]",
+                "scaling factor must be positive",
+            )
+    if args.aggregated_usage_type not in AGG_TYPES:
+        raise ConfigError(
+            f"{path}.aggregated.usageAggregationType",
+            f"unknown aggregation {args.aggregated_usage_type!r}",
+        )
+
+
+def decode_node_numa(raw: Mapping[str, Any]) -> NodeNUMAResourceArgs:
+    return NodeNUMAResourceArgs(
+        default_cpu_bind_policy=str(
+            raw.get("defaultCPUBindPolicy", "FullPCPUs")
+        ),
+        scoring_strategy=str(
+            (raw.get("scoringStrategy") or {}).get("type", "LeastAllocated")
+        ),
+    )
+
+
+def validate_node_numa(args: NodeNUMAResourceArgs, path: str = "nodeNUMA") -> None:
+    if args.default_cpu_bind_policy not in ("FullPCPUs", "SpreadByPCPUs"):
+        raise ConfigError(
+            f"{path}.defaultCPUBindPolicy",
+            f"unknown policy {args.default_cpu_bind_policy!r}",
+        )
+    if args.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
+        raise ConfigError(
+            f"{path}.scoringStrategy.type",
+            f"unknown strategy {args.scoring_strategy!r}",
+        )
+
+
+def decode_elastic_quota(raw: Mapping[str, Any]) -> ElasticQuotaArgs:
+    return ElasticQuotaArgs(
+        delay_evict_time_s=_num(raw, "delayEvictTime", 300.0),
+        revoke_pods_interval_s=_num(raw, "revokePodInterval", 60.0),
+        default_quota_group_max=_table(
+            raw.get("defaultQuotaGroupMax"), "defaultQuotaGroupMax"
+        ),
+        quota_group_namespace=str(
+            raw.get("quotaGroupNamespace", "koordinator-system")
+        ),
+        enable_check_parent_quota=bool(raw.get("enableCheckParentQuota", False)),
+        disable_default_quota_preemption=bool(
+            raw.get("disableDefaultQuotaPreemption", True)
+        ),
+    )
+
+
+def validate_elastic_quota(args: ElasticQuotaArgs, path: str = "elasticQuota") -> None:
+    if args.delay_evict_time_s < 0:
+        raise ConfigError(f"{path}.delayEvictTime", "must be >= 0")
+    if args.revoke_pods_interval_s < 0:
+        raise ConfigError(f"{path}.revokePodInterval", "must be >= 0")
+    for res, val in args.default_quota_group_max.items():
+        if val < 0:
+            raise ConfigError(f"{path}.defaultQuotaGroupMax[{res}]", "must be >= 0")
+
+
+def decode_coscheduling(raw: Mapping[str, Any]) -> CoschedulingArgs:
+    return CoschedulingArgs(
+        default_timeout_s=_num(raw, "defaultTimeout", 600.0),
+        controller_workers=_int(raw, "controllerWorkers", 1),
+    )
+
+
+def validate_coscheduling(args: CoschedulingArgs, path: str = "coscheduling") -> None:
+    if args.default_timeout_s <= 0:
+        raise ConfigError(f"{path}.defaultTimeout", "must be positive")
+    if args.controller_workers < 1:
+        raise ConfigError(f"{path}.controllerWorkers", "must be >= 1")
+
+
+def decode_device_share(raw: Mapping[str, Any]) -> DeviceShareArgs:
+    return DeviceShareArgs(
+        allocator=str(raw.get("allocator", "")),
+        scoring_strategy=str(
+            (raw.get("scoringStrategy") or {}).get("type", "LeastAllocated")
+        ),
+    )
+
+
+def validate_device_share(args: DeviceShareArgs, path: str = "deviceShare") -> None:
+    if args.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
+        raise ConfigError(
+            f"{path}.scoringStrategy.type",
+            f"unknown strategy {args.scoring_strategy!r}",
+        )
+
+
+def decode_reservation(raw: Mapping[str, Any]) -> ReservationArgs:
+    return ReservationArgs(
+        enable_preemption=bool(raw.get("enablePreemption", False)),
+        min_candidate_nodes_percentage=_int(
+            raw, "minCandidateNodesPercentage", 10
+        ),
+        gc_duration_s=_num(raw, "gcDurationSeconds", 24 * 3600.0),
+    )
+
+
+def validate_reservation(args: ReservationArgs, path: str = "reservation") -> None:
+    if not 0 <= args.min_candidate_nodes_percentage <= 100:
+        raise ConfigError(
+            f"{path}.minCandidateNodesPercentage", "must be in [0, 100]"
+        )
+
+
+def decode_low_node_load(raw: Mapping[str, Any]) -> LowNodeLoadArgs:
+    kwargs: Dict[str, Any] = {}
+    _set_if_present(kwargs, raw, "highThresholds", "high_thresholds")
+    _set_if_present(kwargs, raw, "lowThresholds", "low_thresholds")
+    _set_if_present(kwargs, raw, "prodHighThresholds", "prod_high_thresholds")
+    kwargs["anomaly_condition_count"] = _int(
+        raw.get("anomalyCondition") or {}, "consecutiveAbnormalities", 2
+    )
+    return LowNodeLoadArgs(**kwargs)
+
+
+def validate_low_node_load(args: LowNodeLoadArgs, path: str = "lowNodeLoad") -> None:
+    for res, hi in dict(args.high_thresholds).items():
+        lo = dict(args.low_thresholds).get(res, 0.0)
+        if lo > hi:
+            raise ConfigError(
+                f"{path}.lowThresholds[{res}]",
+                f"low threshold {lo} above high threshold {hi}",
+            )
+    if args.anomaly_condition_count < 1:
+        raise ConfigError(
+            f"{path}.anomalyCondition.consecutiveAbnormalities", "must be >= 1"
+        )
+
+
+_PLUGINS = {
+    "LoadAwareScheduling": (decode_load_aware, validate_load_aware),
+    "NodeNUMAResource": (decode_node_numa, validate_node_numa),
+    "ElasticQuota": (decode_elastic_quota, validate_elastic_quota),
+    "Coscheduling": (decode_coscheduling, validate_coscheduling),
+    "DeviceShare": (decode_device_share, validate_device_share),
+    "Reservation": (decode_reservation, validate_reservation),
+    "LowNodeLoad": (decode_low_node_load, validate_low_node_load),
+}
+
+
+def decode_plugin_args(
+    plugin: str, raw: Mapping[str, Any], api_version: str = "v1"
+):
+    """Decode + default + validate one plugin's args. Raises ConfigError."""
+    if api_version not in SUPPORTED_VERSIONS:
+        raise ConfigError("apiVersion", f"unsupported version {api_version!r}")
+    if plugin not in _PLUGINS:
+        raise ConfigError("plugins", f"unknown plugin {plugin!r}")
+    decode, validate = _PLUGINS[plugin]
+    args = decode(raw or {})
+    validate(args)
+    return args
+
+
+def decode_profile(
+    profile: Mapping[str, Any], api_version: str = "v1"
+) -> Dict[str, Any]:
+    """One scheduler profile's pluginConfig list → {plugin: args}."""
+    out: Dict[str, Any] = {}
+    for entry in profile.get("pluginConfig", []):
+        name = entry.get("name", "")
+        out[name] = decode_plugin_args(
+            name, entry.get("args", {}), api_version
+        )
+    return out

@@ -356,6 +356,110 @@ class ServicesEngine:
 
 
 # ---------------------------------------------------------------------------
+# Scheduling queue + scheduler adapter
+# (reference frameworkext/scheduler_adapter.go:85-190)
+# ---------------------------------------------------------------------------
+
+
+class SchedulingQueue:
+    """Active / backoff / unschedulable pools with the queue operations the
+    reference adapter exposes to plugins: ``activate`` pulls named pods
+    back into the active pool (coscheduling uses this to co-activate a
+    gang), ``move_all_to_active_or_backoff`` is the cluster-event flush
+    (new node, reservation freed → every unschedulable pod retries)."""
+
+    def __init__(self, backoff_s: float = 5.0):
+        self.backoff_s = backoff_s
+        self._active: Dict[str, Pod] = {}
+        self._backoff: Dict[str, Tuple[Pod, float]] = {}
+        self._unschedulable: Dict[str, Pod] = {}
+
+    def remove(self, pod_uid: str) -> None:
+        self._active.pop(pod_uid, None)
+        self._backoff.pop(pod_uid, None)
+        self._unschedulable.pop(pod_uid, None)
+
+    def add(self, pod: Pod) -> None:
+        # a pod lives in exactly one pool — re-adding (pod update,
+        # forget_pod) must not leave a stale backoff/unschedulable entry
+        # that would drain it a second time
+        self.remove(pod.meta.uid)
+        self._active[pod.meta.uid] = pod
+
+    def mark_backoff(self, pod: Pod, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self.remove(pod.meta.uid)
+        self._backoff[pod.meta.uid] = (pod, now + self.backoff_s)
+
+    def mark_unschedulable(self, pod: Pod) -> None:
+        self.remove(pod.meta.uid)
+        self._unschedulable[pod.meta.uid] = pod
+
+    def activate(self, pod_uids: Sequence[str]) -> int:
+        """Adapter ``Activate``: named pods skip backoff/unschedulable."""
+        n = 0
+        for uid in pod_uids:
+            entry = self._backoff.pop(uid, (None, 0.0))[0]
+            entry = entry or self._unschedulable.pop(uid, None)
+            if entry is not None:
+                self._active[uid] = entry
+                n += 1
+        return n
+
+    def move_all_to_active_or_backoff(self) -> int:
+        """Adapter ``MoveAllToActiveOrBackoffQueue`` on a cluster event."""
+        n = len(self._unschedulable)
+        self._active.update(self._unschedulable)
+        self._unschedulable.clear()
+        return n
+
+    def drain_active(self, now: Optional[float] = None) -> List[Pod]:
+        """Pods ready for the next batch: active + expired backoff."""
+        now = time.monotonic() if now is None else now
+        for uid, (pod, until) in list(self._backoff.items()):
+            if now >= until:
+                del self._backoff[uid]
+                self._active[uid] = pod
+        out = list(self._active.values())
+        self._active.clear()
+        return out
+
+    @property
+    def pending_counts(self) -> Dict[str, int]:
+        return {
+            "active": len(self._active),
+            "backoff": len(self._backoff),
+            "unschedulable": len(self._unschedulable),
+        }
+
+
+class SchedulerAdapter:
+    """Plugin-facing facade over the snapshot (cache ops) and the queue
+    (reference ``scheduler_adapter.go``: AddPod/AssumePod/ForgetPod/
+    InvalidNodeInfo + queue Activate/MoveAll...). The snapshot's dense
+    arrays double as the scheduler cache, so cache ops delegate there."""
+
+    def __init__(self, snapshot, queue: Optional[SchedulingQueue] = None):
+        self.snapshot = snapshot
+        self.queue = queue or SchedulingQueue()
+
+    def assume_pod(self, pod: Pod, node_name: str) -> None:
+        self.snapshot.assume_pod(pod, node_name)
+        self.queue.remove(pod.meta.uid)
+
+    def forget_pod(self, pod: Pod) -> None:
+        self.snapshot.forget_pod(pod.meta.uid)
+        self.queue.add(pod)
+
+    def invalidate_node(self, node_name: str) -> None:
+        """InvalidNodeInfo: metric-derived state for the node is stale —
+        drop its freshness bit so masks degrade like an expired NodeMetric."""
+        idx = self.snapshot.node_id(node_name)
+        if idx is not None:
+            self.snapshot.nodes.metric_fresh[idx] = False
+
+
+# ---------------------------------------------------------------------------
 # FrameworkExtender
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,138 @@
+"""Versioned componentconfig + DefaultPreBind tests (reference
+pkg/scheduler/apis/config/{v1,v1beta3,validation} + defaultprebind)."""
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import ObjectMeta, Pod
+from koordinator_tpu.scheduler.config import (
+    ConfigError,
+    decode_plugin_args,
+    decode_profile,
+)
+from koordinator_tpu.scheduler.prebind import DefaultPreBind
+
+
+def test_load_aware_defaults_and_merge():
+    args = decode_plugin_args("LoadAwareScheduling", {}, "v1beta3")
+    assert args.usage_thresholds[ext.RES_CPU] == 65.0
+    assert args.estimator_scales[ext.RES_CPU] == 0.85
+    assert args.node_metric_expiration_s == 180.0
+    # user scales merge key-wise over the defaults (defaults.go:106-115)
+    args = decode_plugin_args(
+        "LoadAwareScheduling",
+        {"estimatedScalingFactors": {ext.RES_CPU: 0.5}},
+    )
+    assert args.estimator_scales[ext.RES_CPU] == 0.5
+    assert args.estimator_scales[ext.RES_MEMORY] == 0.70
+
+
+def test_load_aware_validation():
+    with pytest.raises(ConfigError, match="nodeMetricExpirationSeconds"):
+        decode_plugin_args(
+            "LoadAwareScheduling", {"nodeMetricExpirationSeconds": -1}
+        )
+    with pytest.raises(ConfigError, match="usageThresholds"):
+        decode_plugin_args(
+            "LoadAwareScheduling", {"usageThresholds": {ext.RES_CPU: 120}}
+        )
+    with pytest.raises(ConfigError, match="resourceWeights"):
+        decode_plugin_args(
+            "LoadAwareScheduling", {"resourceWeights": {ext.RES_CPU: 0}}
+        )
+    with pytest.raises(ConfigError, match="usageAggregationType"):
+        decode_plugin_args(
+            "LoadAwareScheduling", {"usageAggregationType": "p42"}
+        )
+
+
+def test_explicit_empty_map_disables_checks():
+    """usageThresholds: {} means 'no thresholds', not 'use defaults'
+    (the reference only defaults nil maps)."""
+    args = decode_plugin_args("LoadAwareScheduling", {"usageThresholds": {}})
+    assert dict(args.usage_thresholds) == {}
+    args = decode_plugin_args("LoadAwareScheduling", {"resourceWeights": {}})
+    assert dict(args.resource_weights) == {}
+
+
+def test_malformed_values_raise_config_error():
+    with pytest.raises(ConfigError, match="nodeMetricExpirationSeconds"):
+        decode_plugin_args(
+            "LoadAwareScheduling", {"nodeMetricExpirationSeconds": None}
+        )
+    with pytest.raises(ConfigError, match="controllerWorkers"):
+        decode_plugin_args("Coscheduling", {"controllerWorkers": "two"})
+    with pytest.raises(ConfigError, match="usageThresholds"):
+        decode_plugin_args(
+            "LoadAwareScheduling", {"usageThresholds": {"cpu": "lots"}}
+        )
+
+
+def test_device_share_scoring_validated():
+    with pytest.raises(ConfigError, match="scoringStrategy"):
+        decode_plugin_args("DeviceShare", {"scoringStrategy": {"type": "Bogus"}})
+    assert (
+        decode_plugin_args("DeviceShare", {}).scoring_strategy == "LeastAllocated"
+    )
+
+
+def test_unknown_plugin_and_version():
+    with pytest.raises(ConfigError, match="unknown plugin"):
+        decode_plugin_args("Nope", {})
+    with pytest.raises(ConfigError, match="unsupported version"):
+        decode_plugin_args("LoadAwareScheduling", {}, "v1alpha1")
+
+
+def test_numa_and_coscheduling_validation():
+    args = decode_plugin_args("NodeNUMAResource", {})
+    assert args.default_cpu_bind_policy == "FullPCPUs"
+    with pytest.raises(ConfigError, match="defaultCPUBindPolicy"):
+        decode_plugin_args(
+            "NodeNUMAResource", {"defaultCPUBindPolicy": "Diagonal"}
+        )
+    with pytest.raises(ConfigError, match="controllerWorkers"):
+        decode_plugin_args("Coscheduling", {"controllerWorkers": 0})
+    args = decode_plugin_args("ElasticQuota", {})
+    assert args.disable_default_quota_preemption is True
+
+
+def test_low_node_load_cross_field():
+    with pytest.raises(ConfigError, match="lowThresholds"):
+        decode_plugin_args(
+            "LowNodeLoad",
+            {
+                "highThresholds": {ext.RES_CPU: 50},
+                "lowThresholds": {ext.RES_CPU: 60},
+            },
+        )
+
+
+def test_decode_profile():
+    profile = {
+        "pluginConfig": [
+            {"name": "LoadAwareScheduling", "args": {}},
+            {"name": "Reservation", "args": {"enablePreemption": True}},
+        ]
+    }
+    out = decode_profile(profile)
+    assert out["Reservation"].enable_preemption is True
+    assert out["LoadAwareScheduling"].aggregated_usage_type == "p95"
+
+
+def test_default_prebind_single_patch():
+    pb = DefaultPreBind()
+    pod = Pod(meta=ObjectMeta(name="p"))
+    pb.stage_annotations(pod, {"a": "1"})
+    pb.stage_annotations(pod, {"b": "2"})
+    pb.stage_labels(pod, {"l": "x"})
+    assert pod.meta.annotations == {}          # staged, not applied
+    assert pb.apply(pod) is True
+    assert pod.meta.annotations == {"a": "1", "b": "2"}
+    assert pod.meta.labels["l"] == "x"
+    assert pb.apply(pod) is False              # one patch only
+    # Permit rejection: staged mutations evaporate
+    pod2 = Pod(meta=ObjectMeta(name="q"))
+    pb.stage_annotations(pod2, {"stale": "claim"})
+    pb.discard(pod2.meta.uid)
+    assert pb.apply(pod2) is False
+    assert pod2.meta.annotations == {}

@@ -188,3 +188,73 @@ class TestRegistryPrimitives:
         assert h.quantile(0.5) == pytest.approx(0.0025)
         text = reg.expose()
         assert "t_c" in text and "t_h_bucket" in text and 't_h_count 4' in text
+
+
+class TestSchedulingQueueAndAdapter:
+    """Reference frameworkext/scheduler_adapter.go:85-190 semantics."""
+
+    def test_queue_lifecycle(self):
+        from koordinator_tpu.scheduler.frameworkext import SchedulingQueue
+
+        q = SchedulingQueue(backoff_s=10.0)
+        a, b, c = mkpod("a"), mkpod("b"), mkpod("c")
+        for p in (a, b, c):
+            q.add(p)
+        q.mark_backoff(b, now=100.0)
+        q.mark_unschedulable(c)
+        # backoff not yet expired: only the active pod drains
+        assert [p.meta.name for p in q.drain_active(now=105.0)] == ["a"]
+        # activate pulls the unschedulable pod back by name
+        assert q.activate(["c"]) == 1
+        assert [p.meta.name for p in q.drain_active(now=105.0)] == ["c"]
+        # backoff expiry returns the pods (b from earlier, a just added)
+        q.add(a)
+        q.mark_backoff(a, now=100.0)
+        drained = q.drain_active(now=111.0)
+        assert {p.meta.name for p in drained} == {"a", "b"}
+
+    def test_pools_are_exclusive(self):
+        """Re-adding a backed-off pod must not leave a stale backoff entry
+        that drains it a second time."""
+        from koordinator_tpu.scheduler.frameworkext import SchedulingQueue
+
+        q = SchedulingQueue(backoff_s=5.0)
+        p = mkpod("dup")
+        q.add(p)
+        q.mark_backoff(p, now=0.0)
+        q.add(p)  # pod update / forget_pod re-queues it
+        assert [x.meta.name for x in q.drain_active(now=1.0)] == ["dup"]
+        # past the old backoff deadline: nothing left to drain
+        assert q.drain_active(now=10.0) == []
+
+    def test_move_all_on_cluster_event(self):
+        from koordinator_tpu.scheduler.frameworkext import SchedulingQueue
+
+        q = SchedulingQueue()
+        for i in range(3):
+            p = mkpod(f"u{i}")
+            q.add(p)
+            q.mark_unschedulable(p)
+        assert q.pending_counts["unschedulable"] == 3
+        assert q.move_all_to_active_or_backoff() == 3
+        assert len(q.drain_active()) == 3
+
+    def test_adapter_cache_ops(self, sched):
+        from koordinator_tpu.scheduler.frameworkext import SchedulerAdapter
+
+        adapter = SchedulerAdapter(sched.snapshot)
+        pod = mkpod("assumed")
+        idx = sched.snapshot.node_id("node-0")
+        before = sched.snapshot.nodes.requested[idx].copy()
+        adapter.assume_pod(pod, "node-0")
+        assert sched.snapshot.nodes.requested[idx][0] > before[0]
+        adapter.forget_pod(pod)
+        np.testing.assert_allclose(
+            sched.snapshot.nodes.requested[idx], before, atol=1e-3
+        )
+        # forget re-queues the pod
+        assert [p.meta.name for p in adapter.queue.drain_active()] == ["assumed"]
+        # invalidation drops metric freshness (masks degrade like expiry)
+        sched.snapshot.nodes.metric_fresh[idx] = True
+        adapter.invalidate_node("node-0")
+        assert not sched.snapshot.nodes.metric_fresh[idx]
