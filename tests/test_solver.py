@@ -243,3 +243,56 @@ def test_scan_solver_agrees_with_round_solver_feasibility():
             np_fix["requested0"],
             np_fix["schedulable"],
         )
+
+
+def test_round_solver_jitter_zero_is_strict_argmin():
+    """nomination_jitter=0.0 with topk=1 restores strict argmin
+    *nomination*: every placed pod sits on a node that was its exact
+    current-state argmin in some round (batched commit may still diverge
+    from the one-at-a-time oracle; the invariant tests own that). Here:
+    same feasibility + the same number of placements as the oracle."""
+    pods, nodes, params, np_fix = make_fixture(p=24, n=12, seed=1)
+    got = np.asarray(
+        assign(
+            pods, nodes, params, nomination_jitter=0.0, topk=1
+        ).assignment
+    )
+    want = golden.sequential_assign(**np_fix)
+    golden.validate_assignment(
+        got,
+        np_fix["pod_req"],
+        np_fix["allocatable"],
+        np_fix["requested0"],
+        np_fix["schedulable"],
+    )
+    assert (got >= 0).sum() == (want >= 0).sum()
+
+
+def test_round_solver_jitter_bounded_deviation():
+    """With jitter on, every placement stays within nomination_jitter score
+    points of that pod's best feasible node (the knob's contract)."""
+    pods, nodes, params, np_fix = make_fixture(p=32, n=16, seed=21)
+    amp = 4.0
+    got = np.asarray(
+        assign(pods, nodes, params, nomination_jitter=amp).assignment
+    )
+    # recompute true round-1 scores against the initial state; pods placed
+    # in later rounds face tighter state, so only check round-1-placeable
+    # pods loosely: every assigned node's initial score must be within amp
+    # of the pod's initial best.
+    from koordinator_tpu.ops import costs as cost_ops
+    import jax.numpy as jnp
+
+    cost = np.asarray(
+        cost_ops.load_aware_cost(
+            pods.estimate,
+            nodes.estimated_used,
+            nodes.allocatable,
+            params.score_weights,
+        )
+    )
+    for i, node in enumerate(got):
+        if node < 0:
+            continue
+        best = cost[i].min()
+        assert cost[i, node] <= best + amp + 1e-3
