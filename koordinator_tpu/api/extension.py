@@ -25,6 +25,15 @@ LABEL_QUOTA_NAME = f"quota.scheduling.{DOMAIN}/name"
 LABEL_QUOTA_PARENT = f"quota.scheduling.{DOMAIN}/parent"
 LABEL_QUOTA_IS_PARENT = f"quota.scheduling.{DOMAIN}/is-parent"
 LABEL_QUOTA_TREE_ID = f"quota.scheduling.{DOMAIN}/tree-id"
+LABEL_QUOTA_IS_ROOT = f"quota.scheduling.{DOMAIN}/is-root"
+LABEL_QUOTA_IGNORE_DEFAULT_TREE = f"quota.scheduling.{DOMAIN}/ignore-default-tree"
+LABEL_PREEMPTIBLE = f"quota.scheduling.{DOMAIN}/preemptible"
+ANNOTATION_QUOTA_TOTAL_RESOURCE = f"quota.scheduling.{DOMAIN}/total-resource"
+
+#: well-known quota names (reference apis/extension/elastic_quota.go:29-33)
+SYSTEM_QUOTA_NAME = "koordinator-system-quota"
+ROOT_QUOTA_NAME = "koordinator-root-quota"
+DEFAULT_QUOTA_NAME = "koordinator-default-quota"
 LABEL_GANG_NAME = "pod-group.scheduling.sigs.k8s.io/name"
 LABEL_GANG_MIN_AVAILABLE = "pod-group.scheduling.sigs.k8s.io/min-available"
 ANNOTATION_RESOURCE_SPEC = f"scheduling.{DOMAIN}/resource-spec"

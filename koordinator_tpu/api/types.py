@@ -249,6 +249,12 @@ class ElasticQuota:
     parent: str = ""            # quota tree edge (label quota.scheduling.../parent)
     is_parent: bool = False
     tree_id: str = ""
+    #: tree-root marker (label quota.scheduling.../is-root); a root quota with a
+    #: tree-id carries the tree's total capacity (annotation .../total-resource)
+    is_root: bool = False
+    total_resource: ResourceList = dataclasses.field(default_factory=dict)
+    #: when true, a tree root's capacity is NOT deducted from the default tree
+    ignore_default_tree: bool = False
 
 
 # --- scheduling.koordinator.sh/PodMigrationJob (pod_migration_job_types.go:27-40) ---

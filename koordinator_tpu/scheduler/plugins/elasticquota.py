@@ -36,6 +36,32 @@ def quota_name_of(pod: Pod) -> Optional[str]:
     return pod.meta.labels.get(ext.LABEL_QUOTA_NAME)
 
 
+def scale_mins_over_root(
+    mins: np.ndarray,
+    scale_enabled: np.ndarray,
+    total: np.ndarray,
+) -> np.ndarray:
+    """Proportionally shrink sibling min quotas when they oversubscribe the
+    parent's capacity (reference
+    ``core/scale_minquota_when_over_root_res.go:123-184``): on each dim where
+    Σ children-min > total, scale-disabled children keep their original min
+    and scale-enabled children split ``max(total - Σ disabled-min, 0)``
+    proportionally to their original min.
+
+    ``mins`` [C, D], ``scale_enabled`` [C] bool, ``total`` [D] → scaled [C, D].
+    """
+    mins = np.asarray(mins, np.float32)
+    en = np.asarray(scale_enabled, bool)[:, None]
+    need = mins.sum(axis=0) > np.asarray(total, np.float32) + 1e-6  # [D]
+    if not need.any():
+        return mins
+    disabled_sum = np.where(en, 0.0, mins).sum(axis=0)
+    enabled_sum = np.where(en, mins, 0.0).sum(axis=0)
+    avail = np.maximum(total - disabled_sum, 0.0)
+    factor = np.where(enabled_sum > 1e-9, avail / np.maximum(enabled_sum, 1e-9), 0.0)
+    return np.where(need[None, :] & en, mins * factor, mins).astype(np.float32)
+
+
 def water_fill(
     total: np.ndarray,
     guaranteed: np.ndarray,
@@ -80,10 +106,19 @@ class GroupQuotaManager:
         self,
         config: Optional[SnapshotConfig] = None,
         cluster_total: Optional[Mapping[str, float]] = None,
+        tree_id: str = "",
+        scale_min_enabled: bool = False,
     ):
         self.config = config or SnapshotConfig()
+        self.tree_id = tree_id
+        #: gate for min-quota scaling when Σ sibling mins > parent capacity
+        #: (reference group_quota_manager.go:52 scaleMinQuotaEnabled)
+        self.scale_min_enabled = scale_min_enabled
         self._nodes: Dict[str, _QuotaNode] = {}
         self._order: List[str] = []
+        #: leaf quota name → {pod uid: Pod} of admitted pods (reference
+        #: quota_info.go:550 GetPodThatIsAssigned)
+        self._assigned: Dict[str, Dict[str, "Pod"]] = {}
         self._cluster_total = self.config.res_vector(cluster_total or {})
         d = self.config.dims
         self.runtime = np.zeros((1, d), np.float32)
@@ -144,6 +179,18 @@ class GroupQuotaManager:
         self._cluster_total = self.config.res_vector(total)
         self._dirty = True
 
+    def update_cluster_total(self, delta: np.ndarray) -> None:
+        """Shift capacity by a delta vector (multi-tree rebalancing —
+        reference quota_handler.go:324 UpdateClusterTotalResource)."""
+        self._cluster_total = np.maximum(self._cluster_total + delta, 0.0).astype(
+            np.float32
+        )
+        self._dirty = True
+
+    @property
+    def cluster_total(self) -> np.ndarray:
+        return self._cluster_total
+
     def index_of(self, name: str) -> Optional[int]:
         node = self._nodes.get(name)
         return node.index if node else None
@@ -197,6 +244,29 @@ class GroupQuotaManager:
         for idx in self.chain_of(quota_name):
             self.used[idx] -= vec
 
+    def assign_pod(self, quota_name: str, pod: "Pod") -> None:
+        """Charge the chain and remember the pod at its leaf quota so the
+        overuse-revoke controller can pick eviction victims."""
+        self.charge(quota_name, pod.spec.requests)
+        self._assigned.setdefault(quota_name, {})[pod.meta.uid] = pod
+
+    def unassign_pod(self, quota_name: str, pod: "Pod") -> None:
+        if self._assigned.get(quota_name, {}).pop(pod.meta.uid, None) is not None:
+            self.refund(quota_name, pod.spec.requests)
+
+    def pods_assigned(self, quota_name: str) -> List["Pod"]:
+        return list(self._assigned.get(quota_name, {}).values())
+
+    def all_quota_names(self) -> List[str]:
+        return list(self._order)
+
+    def runtime_and_used_of(self, quota_name: str) -> Tuple[np.ndarray, np.ndarray]:
+        self._ensure_capacity()
+        if self._dirty:
+            self.refresh_runtime()
+        idx = self._nodes[quota_name].index
+        return self.runtime[idx], self.used[idx]
+
     def set_leaf_requests(self, by_leaf: Mapping[str, np.ndarray]) -> None:
         """Aggregate desired request per quota (pending + admitted), rolled
         up the tree — drives the fair-sharing split like the reference's
@@ -247,6 +317,10 @@ class GroupQuotaManager:
         )
         # absent sharedWeight defaults to max (reference getSharedWeight)
         weights = np.where(weights <= 0, np.where(np.isinf(maxs), 1.0, maxs), weights)
+        if self.scale_min_enabled:
+            mins = scale_mins_over_root(
+                mins, np.ones(len(names), bool), total
+            )
         requests = self.requests[idxs]
         guaranteed = np.minimum(mins, requests)
         caps = np.minimum(maxs, requests)
@@ -274,3 +348,275 @@ class GroupQuotaManager:
             for level, idx in enumerate(self.chain_of(quota_name_of(pod))):
                 chains[i, level] = idx
         return chains
+
+
+# ---------------------------------------------------------------------------
+# Overuse revoke (reference quota_overuse_revoke.go)
+# ---------------------------------------------------------------------------
+
+
+def is_pod_non_preemptible(pod: Pod) -> bool:
+    """Reference ``apis/extension/elastic_quota.go:85-87``."""
+    return pod.meta.labels.get(ext.LABEL_PREEMPTIBLE) == "false"
+
+
+@dataclasses.dataclass
+class _OveruseMonitor:
+    """Per-quota debounce: used > runtime must persist for
+    ``delay_evict_time`` before eviction triggers (reference
+    QuotaOverUsedGroupMonitor, quota_overuse_revoke.go:61-90)."""
+
+    manager: GroupQuotaManager
+    quota_name: str
+    delay_evict_time: float
+    last_under_used: float = 0.0
+
+    def check(self, now: float) -> bool:
+        if self.quota_name not in self.manager._nodes:
+            return False
+        runtime, used = self.manager.runtime_and_used_of(self.quota_name)
+        if np.all(used <= runtime + 1e-6):
+            self.last_under_used = now
+            return False
+        if now - self.last_under_used > self.delay_evict_time:
+            self.last_under_used = now
+            return True
+        return False
+
+
+class QuotaOverUsedRevokeController:
+    """Evicts pods from quotas whose used stays above runtime (fair share
+    shrank under them) — reference QuotaOverUsedRevokeController
+    (``quota_overuse_revoke.go:149-272``). Victim selection
+    (``getToRevokePodList`` :92-147): walk assigned pods least-important
+    first, skipping non-preemptible, subtracting requests until
+    used ≤ runtime; then try to re-admit from most-important down, keeping
+    only pods that no longer fit on the revoke list.
+
+    Defaults mirror v1beta3: delay 120 s, cycle 1 s
+    (``pkg/scheduler/apis/config/v1beta3/defaults.go:58-59``).
+    """
+
+    def __init__(
+        self,
+        managers_fn,
+        evict_fn,
+        delay_evict_time: float = 120.0,
+        revoke_pod_interval: float = 1.0,
+        monitor_all_quotas: bool = True,
+        now_fn=None,
+    ):
+        import time as _time
+
+        self._managers_fn = managers_fn
+        self._evict_fn = evict_fn
+        self.delay_evict_time = delay_evict_time
+        self.revoke_pod_interval = revoke_pod_interval
+        self.monitor_all_quotas = monitor_all_quotas
+        self._now = now_fn or _time.monotonic
+        self._monitors: Dict[str, _OveruseMonitor] = {}
+        self._last_cycle = -float("inf")
+
+    def sync_quotas(self) -> None:
+        """Track monitor set against live quotas (syncQuota :215-240)."""
+        now = self._now()
+        alive = set()
+        for mgr in self._managers_fn():
+            for name in mgr.all_quota_names():
+                if name in (ext.SYSTEM_QUOTA_NAME, ext.ROOT_QUOTA_NAME):
+                    continue
+                alive.add(name)
+                if name not in self._monitors:
+                    self._monitors[name] = _OveruseMonitor(
+                        manager=mgr,
+                        quota_name=name,
+                        delay_evict_time=self.delay_evict_time,
+                        last_under_used=now,
+                    )
+        for name in list(self._monitors):
+            if name not in alive:
+                del self._monitors[name]
+
+    def pods_to_revoke(self, quota_name: str) -> List[Pod]:
+        mon = self._monitors.get(quota_name)
+        if mon is None:
+            return []
+        mgr = mon.manager
+        runtime, used = mgr.runtime_and_used_of(quota_name)
+        used = used.copy()
+        cfg = mgr.config
+
+        # least important first: lowest priority, later-assigned breaking ties
+        pods = mgr.pods_assigned(quota_name)
+        order = sorted(
+            range(len(pods)),
+            key=lambda i: ((pods[i].spec.priority or 0), -i),
+        )
+        try_revoke: List[Pod] = []
+        for i in order:
+            if np.all(used <= runtime + 1e-6):
+                break
+            pod = pods[i]
+            if is_pod_non_preemptible(pod):
+                continue
+            used -= cfg.res_vector(pod.spec.requests)
+            try_revoke.append(pod)
+
+        if not np.all(used <= runtime + 1e-6):
+            return try_revoke  # still over: revoke everything we could
+
+        # re-admit from most important down (:131-141)
+        revoke: List[Pod] = []
+        for pod in reversed(try_revoke):
+            vec = cfg.res_vector(pod.spec.requests)
+            used += vec
+            if not np.all(used <= runtime + 1e-6):
+                used -= vec
+                revoke.append(pod)
+        return revoke
+
+    def step(self) -> List[Pod]:
+        """One controller cycle; returns the pods handed to the evictor."""
+        if not self.monitor_all_quotas:
+            return []
+        now = self._now()
+        if now - self._last_cycle < self.revoke_pod_interval:
+            return []
+        self._last_cycle = now
+        self.sync_quotas()
+        revoked: List[Pod] = []
+        for name, mon in list(self._monitors.items()):
+            if not mon.check(now):
+                continue
+            for pod in self.pods_to_revoke(name):
+                self._evict_fn(pod)
+                leaf = quota_name_of(pod) or name
+                mon.manager.unassign_pod(leaf, pod)
+                revoked.append(pod)
+        return revoked
+
+
+# ---------------------------------------------------------------------------
+# Multi-tree handling (reference quota_handler.go)
+# ---------------------------------------------------------------------------
+
+
+class QuotaTreeHandler:
+    """Routes quotas into per-tree GroupQuotaManagers keyed by the
+    ``tree-id`` label (reference ``quota_handler.go:34-63``
+    GetOrCreateGroupQuotaManagerForTree). A tree's root quota carries the
+    tree's capacity in its total-resource annotation; registering it moves
+    that capacity out of the default tree unless ignore-default-tree is set
+    (``handlerQuotaWhenRoot`` :303-327)."""
+
+    def __init__(
+        self,
+        config: Optional[SnapshotConfig] = None,
+        cluster_total: Optional[Mapping[str, float]] = None,
+        scale_min_enabled: bool = False,
+    ):
+        self.config = config or SnapshotConfig()
+        self.scale_min_enabled = scale_min_enabled
+        self.default_manager = GroupQuotaManager(
+            self.config, cluster_total, scale_min_enabled=scale_min_enabled
+        )
+        self._tree_managers: Dict[str, GroupQuotaManager] = {}
+        self._quota_to_tree: Dict[str, str] = {}
+        self._tree_totals: Dict[str, np.ndarray] = {}
+        #: capacity each tree ACTUALLY took from the default tree — the
+        #: give-back source of truth, so clamped deductions and later
+        #: ignore-default-tree / total-resource flips never mint capacity
+        self._tree_deducted: Dict[str, np.ndarray] = {}
+
+    def manager_for_tree(self, tree_id: str) -> GroupQuotaManager:
+        if not tree_id:
+            return self.default_manager
+        mgr = self._tree_managers.get(tree_id)
+        if mgr is None:
+            mgr = GroupQuotaManager(
+                self.config, tree_id=tree_id, scale_min_enabled=self.scale_min_enabled
+            )
+            self._tree_managers[tree_id] = mgr
+        return mgr
+
+    def manager_for_quota(self, quota_name: str) -> GroupQuotaManager:
+        return self.manager_for_tree(self._quota_to_tree.get(quota_name, ""))
+
+    def manager_for_pod(self, pod: Pod) -> GroupQuotaManager:
+        return self.manager_for_quota(quota_name_of(pod) or "")
+
+    def managers(self) -> List[GroupQuotaManager]:
+        return [self.default_manager, *self._tree_managers.values()]
+
+    def on_quota_upsert(self, eq: ElasticQuota) -> None:
+        name = eq.meta.name
+        old_tree = self._quota_to_tree.get(name)
+        if old_tree is not None and old_tree != eq.tree_id:
+            # the reference forbids moving a quota between trees
+            # (quota_handler.go:74); be defensive and migrate cleanly instead
+            # of leaving a stale double registration behind
+            old_mgr = (
+                self._tree_managers.get(old_tree) if old_tree else self.default_manager
+            )
+            if old_mgr is not None:
+                old_mgr.remove_quota(name)
+        mgr = self.manager_for_tree(eq.tree_id)
+        self._quota_to_tree[name] = eq.tree_id
+        self._handle_root(eq, mgr, is_delete=False)
+        mgr.upsert_quota(eq)
+
+    def on_quota_delete(self, eq: ElasticQuota) -> None:
+        self._quota_to_tree.pop(eq.meta.name, None)
+        mgr = (
+            self._tree_managers.get(eq.tree_id) if eq.tree_id else self.default_manager
+        )
+        if mgr is None:
+            return
+        mgr.remove_quota(eq.meta.name)
+        self._handle_root(eq, mgr, is_delete=True)
+
+    def _take_from_default(self, tree_id: str, target: np.ndarray) -> None:
+        """Reconcile the tree's default-tree deduction toward ``target``,
+        bounded by what the default tree can actually give (or has actually
+        taken) — capacity is conserved even when totals oversubscribe."""
+        deducted = self._tree_deducted.get(
+            tree_id, np.zeros(self.config.dims, np.float32)
+        )
+        want = target - deducted
+        if not np.any(want != 0):
+            return
+        before = self.default_manager.cluster_total.copy()
+        self.default_manager.update_cluster_total(-want)
+        applied = before - self.default_manager.cluster_total
+        self._tree_deducted[tree_id] = (deducted + applied).astype(np.float32)
+
+    def _handle_root(
+        self, eq: ElasticQuota, mgr: GroupQuotaManager, is_delete: bool
+    ) -> None:
+        if not eq.is_root or not eq.tree_id:
+            return
+        tree = eq.tree_id
+        if is_delete:
+            # give back exactly what this tree took, regardless of current
+            # annotations on the delete event
+            self._take_from_default(tree, np.zeros(self.config.dims, np.float32))
+            self._tree_totals.pop(tree, None)
+            self._tree_deducted.pop(tree, None)
+            live = self._tree_managers.get(tree)
+            if live is not None:
+                if live.quota_count == 0:
+                    self._tree_managers.pop(tree, None)
+                else:
+                    # children still registered: keep their accounting alive
+                    # but the tree no longer has capacity to hand out
+                    live.set_cluster_total({})
+            return
+        if not eq.total_resource:
+            return
+        new_total = self.config.res_vector(eq.total_resource)
+        self._tree_totals[tree] = new_total
+        mgr.set_cluster_total(eq.total_resource)
+        target = (
+            np.zeros_like(new_total) if eq.ignore_default_tree else new_total
+        )
+        self._take_from_default(tree, target)

@@ -250,3 +250,273 @@ def test_end_to_end_quota_scheduling():
     assert len(b_bound) == 5
     # durable accounting
     assert mgr.used[mgr.index_of("tenant-a")][0] == 12.0
+
+
+# ---- min-quota scaling when over root resource ----
+
+
+def test_scale_mins_noop_when_capacity_sufficient():
+    from koordinator_tpu.scheduler.plugins.elasticquota import scale_mins_over_root
+
+    mins = np.array([[30.0, 10.0], [40.0, 10.0]], np.float32)
+    out = scale_mins_over_root(mins, np.array([True, True]), np.array([100.0, 100.0]))
+    np.testing.assert_allclose(out, mins)
+
+
+def test_scale_mins_proportional_shrink():
+    from koordinator_tpu.scheduler.plugins.elasticquota import scale_mins_over_root
+
+    # Σ min = 150 > 100: each enabled child scaled by 100/150
+    mins = np.array([[100.0, 10.0], [50.0, 10.0]], np.float32)
+    out = scale_mins_over_root(mins, np.array([True, True]), np.array([100.0, 100.0]))
+    np.testing.assert_allclose(out[:, 0], [100.0 * 100 / 150, 50.0 * 100 / 150], rtol=1e-5)
+    np.testing.assert_allclose(out[:, 1], [10.0, 10.0])  # mem dim not oversubscribed
+
+
+def test_scale_mins_disabled_children_keep_full_min():
+    from koordinator_tpu.scheduler.plugins.elasticquota import scale_mins_over_root
+
+    # disabled child keeps 60; enabled children split 100-60=40 by min ratio
+    mins = np.array([[60.0], [60.0], [20.0]], np.float32)
+    out = scale_mins_over_root(
+        mins, np.array([False, True, True]), np.array([100.0])
+    )
+    np.testing.assert_allclose(out[:, 0], [60.0, 40.0 * 60 / 80, 40.0 * 20 / 80], rtol=1e-5)
+
+
+def test_manager_scale_min_enabled_shrinks_runtime():
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    mgr = GroupQuotaManager(
+        cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100},
+        scale_min_enabled=True,
+    )
+    mgr.upsert_quota(quota("a", minv=(80, 10), maxv=(100, 100)))
+    mgr.upsert_quota(quota("b", minv=(80, 10), maxv=(100, 100)))
+    mgr.set_leaf_requests({
+        "a": cfg.res_vector({ext.RES_CPU: 200, ext.RES_MEMORY: 5}),
+        "b": cfg.res_vector({ext.RES_CPU: 200, ext.RES_MEMORY: 5}),
+    })
+    rt = mgr.refresh_runtime()
+    # scaled min = 50 each; remainder shared evenly → 50/50 split of cpu
+    ia, ib = mgr.index_of("a"), mgr.index_of("b")
+    np.testing.assert_allclose(rt[ia][0], 50.0, atol=1e-3)
+    np.testing.assert_allclose(rt[ib][0], 50.0, atol=1e-3)
+
+
+# ---- overuse revoke controller ----
+
+
+def _revoke_fixture():
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        QuotaOverUsedRevokeController,
+    )
+
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    mgr = GroupQuotaManager(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    mgr.upsert_quota(quota("q1", minv=(10, 10), maxv=(100, 100)))
+    mgr.upsert_quota(quota("q2", minv=(10, 10), maxv=(100, 100)))
+    evicted = []
+    clock = {"t": 0.0}
+    ctl = QuotaOverUsedRevokeController(
+        managers_fn=lambda: [mgr],
+        evict_fn=evicted.append,
+        delay_evict_time=120.0,
+        revoke_pod_interval=1.0,
+        now_fn=lambda: clock["t"],
+    )
+    return cfg, mgr, ctl, evicted, clock
+
+
+def test_overuse_revoke_waits_for_delay():
+    cfg, mgr, ctl, evicted, clock = _revoke_fixture()
+    # q1 runtime shrinks to its share once q2 requests arrive; make q1 overused
+    for i in range(3):
+        mgr.assign_pod("q1", quota_pod(f"p{i}", "q1", cpu=30.0, prio=5000 + i))
+    mgr.set_leaf_requests({
+        "q1": cfg.res_vector({ext.RES_CPU: 90, ext.RES_MEMORY: 90}),
+        "q2": cfg.res_vector({ext.RES_CPU: 90, ext.RES_MEMORY: 90}),
+    })
+    assert ctl.step() == []          # overused but inside the debounce window
+    clock["t"] = 60.0
+    assert ctl.step() == []
+    clock["t"] = 121.0
+    revoked = ctl.step()
+    assert revoked, "overuse persisted past delay_evict_time, expected evictions"
+    assert evicted == revoked
+    # victims are the lowest-priority pods, and only enough to fit runtime
+    rt, used = mgr.runtime_and_used_of("q1")
+    assert np.all(used <= rt + 1e-5)
+
+
+def test_overuse_revoke_skips_non_preemptible():
+    cfg, mgr, ctl, evicted, clock = _revoke_fixture()
+    locked = quota_pod("locked", "q1", cpu=60.0, prio=5000)
+    locked.meta.labels[ext.LABEL_PREEMPTIBLE] = "false"
+    mgr.assign_pod("q1", locked)
+    mgr.assign_pod("q1", quota_pod("soft", "q1", cpu=30.0, prio=9000))
+    mgr.set_leaf_requests({
+        "q1": cfg.res_vector({ext.RES_CPU: 90, ext.RES_MEMORY: 90}),
+        "q2": cfg.res_vector({ext.RES_CPU: 90, ext.RES_MEMORY: 90}),
+    })
+    ctl.step()
+    clock["t"] = 121.0
+    revoked = ctl.step()
+    assert revoked, "preemptible pod should have been revoked"
+    assert all(p.meta.name != "locked" for p in revoked)
+
+
+def test_overuse_revoke_assign_back_keeps_fitting_pods():
+    cfg, mgr, ctl, evicted, clock = _revoke_fixture()
+    # runtime will be 50 cpu; pods: 40 + 20 + 20. Walk least-important first
+    # revokes p-low(20) then p-mid(20); assign-back readmits p-mid (40+20≤50? no)
+    # → readmits whichever fits. Verify final used ≤ runtime and minimal set.
+    mgr.assign_pod("q1", quota_pod("p-high", "q1", cpu=40.0, prio=9900))
+    mgr.assign_pod("q1", quota_pod("p-mid", "q1", cpu=10.0, prio=9000))
+    mgr.assign_pod("q1", quota_pod("p-low", "q1", cpu=20.0, prio=5000))
+    mgr.set_leaf_requests({
+        "q1": cfg.res_vector({ext.RES_CPU: 70, ext.RES_MEMORY: 70}),
+        "q2": cfg.res_vector({ext.RES_CPU: 70, ext.RES_MEMORY: 70}),
+    })
+    ctl.step()  # registers monitors at t=0; debounce runs from here
+    clock["t"] = 121.0
+    revoked = ctl.step()
+    names = {p.meta.name for p in revoked}
+    assert "p-high" not in names      # most important survives
+    rt, used = mgr.runtime_and_used_of("q1")
+    assert np.all(used <= rt + 1e-5)
+    # p-mid (10 cpu) fits back next to p-high (40) under runtime 50
+    assert "p-mid" not in names
+
+
+# ---- multi-tree handler ----
+
+
+def test_quota_tree_handler_routes_and_rebalances_totals():
+    from koordinator_tpu.scheduler.plugins.elasticquota import QuotaTreeHandler
+
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    h = QuotaTreeHandler(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    root = quota("tree-a-root", minv=(0, 0))
+    root.tree_id = "tree-a"
+    root.is_root = True
+    root.total_resource = {ext.RES_CPU: 40, ext.RES_MEMORY: 40}
+    h.on_quota_upsert(root)
+
+    # tree root capacity moved out of the default tree
+    np.testing.assert_allclose(h.default_manager.cluster_total, [60.0, 60.0])
+    np.testing.assert_allclose(
+        h.manager_for_tree("tree-a").cluster_total, [40.0, 40.0]
+    )
+
+    leaf = quota("team-x", minv=(10, 10), maxv=(40, 40))
+    leaf.tree_id = "tree-a"
+    leaf.parent = "tree-a-root"
+    h.on_quota_upsert(leaf)
+    assert h.manager_for_quota("team-x") is h.manager_for_tree("tree-a")
+
+    # shrinking the root total gives capacity back to the default tree
+    root2 = quota("tree-a-root", minv=(0, 0))
+    root2.tree_id = "tree-a"
+    root2.is_root = True
+    root2.total_resource = {ext.RES_CPU: 30, ext.RES_MEMORY: 30}
+    h.on_quota_upsert(root2)
+    np.testing.assert_allclose(h.default_manager.cluster_total, [70.0, 70.0])
+
+    # deleting the root returns everything
+    h.on_quota_delete(root2)
+    np.testing.assert_allclose(h.default_manager.cluster_total, [100.0, 100.0])
+
+
+def test_quota_tree_handler_ignore_default_tree():
+    from koordinator_tpu.scheduler.plugins.elasticquota import QuotaTreeHandler
+
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    h = QuotaTreeHandler(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    root = quota("iso-root", minv=(0, 0))
+    root.tree_id = "iso"
+    root.is_root = True
+    root.ignore_default_tree = True
+    root.total_resource = {ext.RES_CPU: 40, ext.RES_MEMORY: 40}
+    h.on_quota_upsert(root)
+    np.testing.assert_allclose(h.default_manager.cluster_total, [100.0, 100.0])
+
+
+def _tree_root(name, tree, cpu, ignore=False):
+    q = quota(name, minv=(0, 0))
+    q.tree_id = tree
+    q.is_root = True
+    q.ignore_default_tree = ignore
+    q.total_resource = {ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}
+    return q
+
+
+def test_tree_root_delete_keeps_children_and_accounting():
+    from koordinator_tpu.scheduler.plugins.elasticquota import QuotaTreeHandler
+
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    h = QuotaTreeHandler(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    h.on_quota_upsert(_tree_root("a-root", "tree-a", 40))
+    leaf = quota("team-x", minv=(10, 10), maxv=(40, 40))
+    leaf.tree_id = "tree-a"
+    leaf.parent = "a-root"
+    h.on_quota_upsert(leaf)
+    mgr = h.manager_for_tree("tree-a")
+    mgr.assign_pod("team-x", quota_pod("p0", "team-x", cpu=5.0))
+
+    h.on_quota_delete(_tree_root("a-root", "tree-a", 40))
+    # children + their used accounting survive in the SAME manager
+    assert h.manager_for_quota("team-x") is mgr
+    assert "team-x" in mgr.all_quota_names()
+    assert mgr.pods_assigned("team-x")
+    # but the orphaned tree has no capacity, and default got its 40 back
+    np.testing.assert_allclose(mgr.cluster_total, [0.0, 0.0])
+    np.testing.assert_allclose(h.default_manager.cluster_total, [100.0, 100.0])
+
+
+def test_tree_totals_conserved_when_oversubscribed():
+    from koordinator_tpu.scheduler.plugins.elasticquota import QuotaTreeHandler
+
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    h = QuotaTreeHandler(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    h.on_quota_upsert(_tree_root("a-root", "tree-a", 80))
+    np.testing.assert_allclose(h.default_manager.cluster_total, [20.0, 20.0])
+    # tree-b wants 80 but only 20 remains: deduction clamps at 20
+    h.on_quota_upsert(_tree_root("b-root", "tree-b", 80))
+    np.testing.assert_allclose(h.default_manager.cluster_total, [0.0, 0.0])
+    # deleting tree-b returns exactly the 20 it took, not its declared 80
+    h.on_quota_delete(_tree_root("b-root", "tree-b", 80))
+    np.testing.assert_allclose(h.default_manager.cluster_total, [20.0, 20.0])
+
+
+def test_quota_tree_change_migrates_registration():
+    from koordinator_tpu.scheduler.plugins.elasticquota import QuotaTreeHandler
+
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    h = QuotaTreeHandler(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    q = quota("mover", minv=(10, 10), maxv=(50, 50))
+    h.on_quota_upsert(q)
+    assert "mover" in h.default_manager.all_quota_names()
+    q2 = quota("mover", minv=(10, 10), maxv=(50, 50))
+    q2.tree_id = "tree-a"
+    h.on_quota_upsert(q2)
+    assert "mover" not in h.default_manager.all_quota_names()
+    assert "mover" in h.manager_for_tree("tree-a").all_quota_names()
+    assert h.manager_for_quota("mover") is h.manager_for_tree("tree-a")
+
+
+def test_ignore_default_tree_flag_flips_reconcile():
+    from koordinator_tpu.scheduler.plugins.elasticquota import QuotaTreeHandler
+
+    cfg = SnapshotConfig(resources=(ext.RES_CPU, ext.RES_MEMORY))
+    h = QuotaTreeHandler(cfg, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100})
+    h.on_quota_upsert(_tree_root("a-root", "tree-a", 40, ignore=False))
+    np.testing.assert_allclose(h.default_manager.cluster_total, [60.0, 60.0])
+    # flipping to ignore returns the deducted capacity
+    h.on_quota_upsert(_tree_root("a-root", "tree-a", 40, ignore=True))
+    np.testing.assert_allclose(h.default_manager.cluster_total, [100.0, 100.0])
+    # flipping back deducts again, and delete with the flag set still
+    # returns only what was actually taken
+    h.on_quota_upsert(_tree_root("a-root", "tree-a", 40, ignore=False))
+    np.testing.assert_allclose(h.default_manager.cluster_total, [60.0, 60.0])
+    h.on_quota_delete(_tree_root("a-root", "tree-a", 40, ignore=True))
+    np.testing.assert_allclose(h.default_manager.cluster_total, [100.0, 100.0])
