@@ -1,0 +1,14 @@
+"""Deployable entry points — the analog of the reference's five binaries
+under ``cmd/`` (koord-scheduler, koord-descheduler, koord-manager, koordlet,
+koord-runtime-proxy; SURVEY §2.1).
+
+Each module exposes ``main(argv) -> int`` and is runnable as
+``python -m koordinator_tpu.cmd.<name>``. Control-plane daemons
+(scheduler / manager / descheduler) gate their loops behind lease-based
+leader election like the reference (``app/server.go:247-281``), via
+``--leader-elect`` with a shared ``--lease-file`` lock.
+
+Without an apiserver, cluster state comes from the built-in simulator
+(``sim.cluster_gen``) or a JSON state file — the same substitution the
+reference's kind-based e2e makes for a real cluster.
+"""

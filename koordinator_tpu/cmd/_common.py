@@ -1,0 +1,206 @@
+"""Shared daemon plumbing: flags, feature gates, leader-election gating.
+
+Mirrors the option surface every reference binary shares (cobra+pflag
+componentconfig: ``--feature-gates``, ``--leader-elect``, pprof/metrics
+addresses) in argparse form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import threading
+from typing import Callable, Optional
+
+from ..core.snapshot import ClusterSnapshot
+from ..sim.cluster_gen import GenConfig, gen_nodes, gen_pods
+from ..utils.features import FeatureGate
+from ..utils.leaderelection import FileLeaseLock, InMemoryLeaseLock, LeaderElector
+
+
+def add_common_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--feature-gates",
+        default="",
+        help="comma-separated key=bool overrides, e.g. Foo=true,Bar=false",
+    )
+    parser.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="gate the loop behind lease-based leader election",
+    )
+    parser.add_argument(
+        "--lease-file",
+        default="",
+        help="lease lock path (cross-process); in-memory when empty",
+    )
+    parser.add_argument("--identity", default="", help="leader election identity")
+    parser.add_argument(
+        "--rounds", type=int, default=1, help="loop iterations (0 = forever)"
+    )
+    parser.add_argument(
+        "--interval", type=float, default=0.0, help="seconds between rounds"
+    )
+
+
+def add_sim_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--sim-nodes", type=int, default=100)
+    parser.add_argument("--sim-pods", type=int, default=500)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--state-file",
+        default="",
+        help="JSON cluster state (overrides the simulator)",
+    )
+
+
+def apply_feature_gates(gates: FeatureGate, raw: str) -> None:
+    if not raw:
+        return
+    overrides = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        overrides[key.strip()] = val.strip().lower() in ("true", "1", "yes")
+    gates.set_from_map(overrides)
+
+
+def build_snapshot(args: argparse.Namespace):
+    """(snapshot, nodes, pods) from --state-file or the simulator."""
+    snap = ClusterSnapshot()
+    if args.state_file:
+        with open(args.state_file) as f:
+            state = json.load(f)
+        from ..api.types import (
+            Node,
+            NodeMetric,
+            NodeStatus,
+            ObjectMeta,
+            Pod,
+            PodSpec,
+            ResourceMetric,
+        )
+
+        pods = []
+        nodes = []
+        for n in state.get("nodes", []):
+            node = Node(
+                meta=ObjectMeta(name=n["name"], labels=n.get("labels", {})),
+                status=NodeStatus(allocatable=n.get("allocatable", {})),
+            )
+            nodes.append(node)
+            snap.upsert_node(node)
+        for m in state.get("node_metrics", []):
+            snap.set_node_metric(
+                NodeMetric(
+                    meta=ObjectMeta(name=m["name"]),
+                    node_usage=ResourceMetric(usage=m.get("usage", {})),
+                    update_time=m.get("update_time", 0.0),
+                ),
+                now=m.get("update_time", 0.0),
+            )
+        for p in state.get("pods", []):
+            pods.append(
+                Pod(
+                    meta=ObjectMeta(
+                        name=p["name"],
+                        namespace=p.get("namespace", "default"),
+                        labels=p.get("labels", {}),
+                    ),
+                    spec=PodSpec(
+                        requests=p.get("requests", {}),
+                        priority=p.get("priority"),
+                        node_name=p.get("node_name", ""),
+                    ),
+                )
+            )
+        return snap, nodes, pods
+    cfg = GenConfig(n_nodes=args.sim_nodes, n_pods=args.sim_pods, seed=args.seed)
+    nodes, metrics = gen_nodes(cfg)
+    for n in nodes:
+        snap.upsert_node(n)
+    for m in metrics:
+        snap.set_node_metric(m, now=m.update_time + 1)
+    return snap, nodes, gen_pods(cfg)
+
+
+#: in-process lease locks, one per component — distinct daemons embedded in
+#: one process each get their own leadership, like their separate Lease
+#: objects in the reference
+_SHARED_LOCKS: dict = {}
+
+
+def run_elected(
+    args: argparse.Namespace,
+    component: str,
+    body: Callable[[threading.Event], int],
+) -> int:
+    """Run ``body(stop)`` — behind leader election when --leader-elect.
+
+    The body gets a stop event wired to SIGTERM/SIGINT; with election on,
+    losing the lease also sets it (the reference exits outright —
+    ``app/server.go`` leaderelection.RunOrDie OnStoppedLeading → klog.Fatal;
+    a library can't exit the interpreter, so stopping the loop is the
+    equivalent).
+    """
+    stop = threading.Event()
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not the main thread (tests)
+
+    try:
+        if not args.leader_elect:
+            return body(stop)
+
+        if args.lease_file:
+            lock = FileLeaseLock(args.lease_file)
+        else:
+            lock = _SHARED_LOCKS.setdefault(component, InMemoryLeaseLock())
+        import os
+
+        identity = args.identity or f"{component}-{os.getpid()}"
+        elector = LeaderElector(lock, identity)
+
+        if not elector.acquire(stop):
+            return 0
+
+        elector.on_stopped_leading = stop.set
+        renewer = threading.Thread(
+            target=elector.renew_loop, args=(stop,), daemon=True
+        )
+        renewer.start()
+        try:
+            return body(stop)
+        finally:
+            stop.set()
+            renewer.join(timeout=5.0)
+            elector.release()
+    finally:
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
+
+
+def loop_rounds(
+    args: argparse.Namespace,
+    stop: threading.Event,
+    step: Callable[[int], Optional[dict]],
+) -> int:
+    """Run ``step(i)`` every --interval for --rounds (0 = until stopped),
+    printing each round's summary as a JSON line."""
+    i = 0
+    while not stop.is_set():
+        out = step(i)
+        if out is not None:
+            print(json.dumps(out), flush=True)
+        i += 1
+        if args.rounds and i >= args.rounds:
+            break
+        if args.interval > 0 and stop.wait(args.interval):
+            break
+    return 0

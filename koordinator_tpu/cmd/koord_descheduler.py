@@ -1,0 +1,57 @@
+"""koord-descheduler binary (reference ``cmd/koord-descheduler/``):
+LowNodeLoad balancing over the utilization snapshot, leader-elected."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..descheduler.framework import Descheduler, Profile
+from ..descheduler.low_node_load import (
+    LowNodeLoad,
+    LowNodeLoadArgs,
+    LowNodeLoadBalance,
+)
+from . import _common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-descheduler")
+    _common.add_common_flags(parser)
+    _common.add_sim_flags(parser)
+    parser.add_argument("--low-threshold", type=float, default=45.0)
+    parser.add_argument("--high-threshold", type=float, default=70.0)
+    parser.add_argument("--dry-run", action="store_true")
+    parser.add_argument("--max-evictions-per-round", type=int, default=0)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    snap, nodes, pods = _common.build_snapshot(args)
+
+    la = LowNodeLoadArgs(
+        low_thresholds={"cpu": args.low_threshold},
+        high_thresholds={"cpu": args.high_threshold},
+    )
+    plugin = LowNodeLoadBalance(LowNodeLoad(snap, la))
+    profile = Profile(
+        name="koord-descheduler",
+        balance_plugins=[plugin],
+        dry_run=args.dry_run,
+        max_evictions_per_round=args.max_evictions_per_round,
+    )
+    desched = Descheduler([profile], interval_s=max(args.interval, 1.0))
+
+    def step(i: int):
+        counts = desched.run_once(nodes, pods)
+        return {"round": i, "profiles": counts}
+
+    return _common.run_elected(
+        args, "koord-descheduler", lambda stop: _common.loop_rounds(args, stop, step)
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

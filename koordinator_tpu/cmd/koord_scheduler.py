@@ -1,0 +1,65 @@
+"""koord-scheduler binary (reference ``cmd/koord-scheduler/main.go``):
+drains pending pods through the batched TPU solver, leader-elected."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from ..utils.features import SCHEDULER_GATES
+from . import _common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-scheduler")
+    _common.add_common_flags(parser)
+    _common.add_sim_flags(parser)
+    parser.add_argument(
+        "--batch-bucket", type=int, default=4096, help="solver batch shape"
+    )
+    parser.add_argument(
+        "--config", default="", help="versioned plugin-args JSON (scheduler.config)"
+    )
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    _common.apply_feature_gates(SCHEDULER_GATES, args.feature_gates)
+
+    la_args = LoadAwareArgs()
+    if args.config:
+        import json
+
+        from ..scheduler.config import decode_load_aware, validate_load_aware
+
+        with open(args.config) as f:
+            raw = json.load(f)
+        la_args = decode_load_aware(raw.get("loadAware", raw))
+        validate_load_aware(la_args)
+
+    snap, _nodes, pods = _common.build_snapshot(args)
+    sched = BatchScheduler(snap, la_args, batch_bucket=args.batch_bucket)
+    pending = [p for p in pods if not p.spec.node_name]
+
+    def step(i: int):
+        nonlocal pending
+        out = sched.schedule(pending)
+        summary = {
+            "round": i,
+            "bound": len(out.bound),
+            "unschedulable": len(out.unschedulable),
+            "solver_rounds": out.rounds_used,
+        }
+        pending = list(out.unschedulable)
+        return summary
+
+    return _common.run_elected(
+        args, "koord-scheduler", lambda stop: _common.loop_rounds(args, stop, step)
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""koordlet binary (reference ``cmd/koordlet/main.go``): the node agent
+daemon — collectors, QoS strategies, runtime hooks, metric reporting."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..koordlet.daemon import Koordlet, KoordletConfig
+from ..utils.features import KOORDLET_GATES
+from . import _common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # koordlet is a per-node DaemonSet in the reference — no leader
+    # election or reconcile rounds, so it takes only its own flags
+    parser = argparse.ArgumentParser(prog="koordlet")
+    parser.add_argument(
+        "--feature-gates",
+        default="",
+        help="comma-separated key=bool overrides, e.g. Foo=true,Bar=false",
+    )
+    parser.add_argument("--node-name", default="node-local")
+    parser.add_argument("--cgroup-root", default="/sys/fs/cgroup")
+    parser.add_argument(
+        "--duration",
+        type=float,
+        default=0.0,
+        help="run for N seconds then exit (0 = forever)",
+    )
+    parser.add_argument("--collect-interval", type=float, default=1.0)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    _common.apply_feature_gates(KOORDLET_GATES, args.feature_gates)
+
+    cfg = KoordletConfig(
+        node_name=args.node_name,
+        cgroup_root=args.cgroup_root,
+        collect_interval_s=args.collect_interval,
+    )
+    agent = Koordlet(cfg)
+    agent.run(duration_s=args.duration or float("inf"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

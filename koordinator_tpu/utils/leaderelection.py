@@ -1,0 +1,258 @@
+"""Lease-based leader election for the control-plane daemons.
+
+The reference's scheduler/manager/descheduler all gate their loops behind
+client-go leader election with a Lease lock
+(``cmd/koord-scheduler/app/server.go:247-281``,
+``cmd/koord-manager/main.go`` ``LeaderElection`` options). This is the same
+state machine — acquire by CAS on a lease record, renew within the renew
+deadline, surrender on failure — over a pluggable lock so a single-process
+simulation (in-memory) and a multi-process deployment (atomic file lock)
+both work without an apiserver.
+
+Defaults mirror client-go: 15 s lease, 10 s renew deadline, 2 s retry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional, Protocol
+
+LEASE_DURATION_S = 15.0
+RENEW_DEADLINE_S = 10.0
+RETRY_PERIOD_S = 2.0
+
+
+@dataclasses.dataclass
+class LeaseRecord:
+    """The contended record (client-go LeaderElectionRecord)."""
+
+    holder: str
+    acquire_time: float
+    renew_time: float
+    lease_duration: float
+    transitions: int = 0
+
+    def expired(self, now: float) -> bool:
+        return now - self.renew_time > self.lease_duration
+
+
+class LeaseLock(Protocol):
+    """CAS storage for one LeaseRecord."""
+
+    def get(self) -> Optional[LeaseRecord]: ...
+
+    def create(self, record: LeaseRecord) -> bool: ...
+
+    def update(self, old: LeaseRecord, new: LeaseRecord) -> bool: ...
+
+
+class InMemoryLeaseLock:
+    """Single-process lock — multiple elector instances (threads) contend."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._record: Optional[LeaseRecord] = None
+
+    def get(self) -> Optional[LeaseRecord]:
+        with self._lock:
+            return dataclasses.replace(self._record) if self._record else None
+
+    def create(self, record: LeaseRecord) -> bool:
+        with self._lock:
+            if self._record is not None:
+                return False
+            self._record = dataclasses.replace(record)
+            return True
+
+    def update(self, old: LeaseRecord, new: LeaseRecord) -> bool:
+        with self._lock:
+            cur = self._record
+            if cur is None or (cur.holder, cur.renew_time) != (
+                old.holder,
+                old.renew_time,
+            ):
+                return False
+            self._record = dataclasses.replace(new)
+            return True
+
+
+class FileLeaseLock:
+    """Cross-process lock: JSON record + atomic rename, with the
+    read-modify-write made a real CAS by a kernel advisory lock
+    (``flock``) on a guard file — held only for the microseconds of the
+    CAS, released automatically if the holder dies."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._guard = path + ".lock"
+
+    def _with_guard(self, fn):
+        import fcntl
+
+        fd = os.open(self._guard, os.O_CREAT | os.O_WRONLY)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            return fn()
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def _read(self) -> Optional[LeaseRecord]:
+        try:
+            with open(self.path) as f:
+                return LeaseRecord(**json.load(f))
+        except (FileNotFoundError, json.JSONDecodeError, TypeError):
+            return None
+
+    def _write(self, record: LeaseRecord) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dataclasses.asdict(record), f)
+        os.replace(tmp, self.path)
+
+    def get(self) -> Optional[LeaseRecord]:
+        return self._read()
+
+    def create(self, record: LeaseRecord) -> bool:
+        def op():
+            if self._read() is not None:
+                return False
+            self._write(record)
+            return True
+
+        return self._with_guard(op)
+
+    def update(self, old: LeaseRecord, new: LeaseRecord) -> bool:
+        def op():
+            cur = self._read()
+            if cur is None or (cur.holder, cur.renew_time) != (
+                old.holder,
+                old.renew_time,
+            ):
+                return False
+            self._write(new)
+            return True
+
+        return self._with_guard(op)
+
+
+class LeaderElector:
+    """client-go LeaderElector state machine with injectable clock/sleep."""
+
+    def __init__(
+        self,
+        lock: LeaseLock,
+        identity: str,
+        lease_duration: float = LEASE_DURATION_S,
+        renew_deadline: float = RENEW_DEADLINE_S,
+        retry_period: float = RETRY_PERIOD_S,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        # wall clock, like client-go: lease files persisted across a
+        # reboot must still expire (monotonic restarts near 0 at boot)
+        now_fn: Callable[[], float] = time.time,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ) -> None:
+        if renew_deadline >= lease_duration:
+            raise ValueError("renew_deadline must be < lease_duration")
+        self.lock = lock
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._now = now_fn
+        self._sleep = sleep_fn
+        self._observed: Optional[LeaseRecord] = None
+
+    # ---- single protocol step (unit-testable) ----
+
+    def try_acquire_or_renew(self) -> bool:
+        now = self._now()
+        mine = LeaseRecord(
+            holder=self.identity,
+            acquire_time=now,
+            renew_time=now,
+            lease_duration=self.lease_duration,
+        )
+        cur = self.lock.get()
+        if cur is None:
+            if self.lock.create(mine):
+                self._observed = mine
+                return True
+            return False
+        if cur.holder != self.identity:
+            if not cur.expired(now):
+                self._observed = cur
+                return False
+            # expired foreign lease: take over
+            mine.transitions = cur.transitions + 1
+            if self.lock.update(cur, mine):
+                self._observed = mine
+                return True
+            return False
+        # we hold it: renew, preserving acquire time
+        mine.acquire_time = cur.acquire_time
+        mine.transitions = cur.transitions
+        if self.lock.update(cur, mine):
+            self._observed = mine
+            return True
+        return False
+
+    def is_leader(self) -> bool:
+        return (
+            self._observed is not None and self._observed.holder == self.identity
+        )
+
+    def leader_identity(self) -> Optional[str]:
+        cur = self.lock.get()
+        return cur.holder if cur and not cur.expired(self._now()) else None
+
+    # ---- run loops ----
+
+    def acquire(self, stop: Optional[threading.Event] = None) -> bool:
+        """Block until leadership is acquired (or stop is set)."""
+        while stop is None or not stop.is_set():
+            if self.try_acquire_or_renew():
+                if self.on_started_leading:
+                    self.on_started_leading()
+                return True
+            self._sleep(self.retry_period)
+        return False
+
+    def renew_loop(self, stop: Optional[threading.Event] = None) -> None:
+        """Renew until the renew deadline is blown or stop is set; fires
+        on_stopped_leading when leadership is lost."""
+        deadline = self._now() + self.renew_deadline
+        while stop is None or not stop.is_set():
+            if self.try_acquire_or_renew():
+                deadline = self._now() + self.renew_deadline
+            elif self._now() > deadline:
+                break
+            self._sleep(self.retry_period)
+        self._observed = None
+        if self.on_stopped_leading:
+            self.on_stopped_leading()
+
+    def release(self) -> None:
+        """Voluntarily drop the lease (client-go ReleaseOnCancel)."""
+        cur = self.lock.get()
+        if cur and cur.holder == self.identity:
+            ended = dataclasses.replace(
+                cur, renew_time=self._now() - 2 * self.lease_duration
+            )
+            self.lock.update(cur, ended)
+        self._observed = None
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """acquire → renew loop → release, the client-go Run shape."""
+        if self.acquire(stop):
+            try:
+                self.renew_loop(stop)
+            finally:
+                self.release()

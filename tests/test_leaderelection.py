@@ -1,0 +1,135 @@
+"""Leader election tests (reference: client-go leaderelection as used at
+``cmd/koord-scheduler/app/server.go:247-281``)."""
+
+import os
+import threading
+
+import pytest
+
+from koordinator_tpu.utils.leaderelection import (
+    FileLeaseLock,
+    InMemoryLeaseLock,
+    LeaderElector,
+    LeaseRecord,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def elector(lock, ident, clock, **kw):
+    kw.setdefault("lease_duration", 15.0)
+    kw.setdefault("renew_deadline", 10.0)
+    kw.setdefault("retry_period", 2.0)
+    return LeaderElector(
+        lock, ident, now_fn=clock.now, sleep_fn=clock.sleep, **kw
+    )
+
+
+def test_acquire_then_contender_blocked():
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    b = elector(lock, "b", clock)
+    assert a.try_acquire_or_renew()
+    assert a.is_leader()
+    assert not b.try_acquire_or_renew()
+    assert b.leader_identity() == "a"
+
+
+def test_takeover_after_lease_expiry():
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    b = elector(lock, "b", clock)
+    assert a.try_acquire_or_renew()
+    clock.t = 14.0
+    assert not b.try_acquire_or_renew()  # still inside a's lease
+    clock.t = 15.1
+    assert b.try_acquire_or_renew()      # expired -> takeover
+    assert b.is_leader()
+    rec = lock.get()
+    assert rec.holder == "b" and rec.transitions == 1
+
+
+def test_renew_preserves_acquire_time():
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    assert a.try_acquire_or_renew()
+    t0 = lock.get().acquire_time
+    clock.t = 5.0
+    assert a.try_acquire_or_renew()
+    rec = lock.get()
+    assert rec.acquire_time == t0 and rec.renew_time == 5.0
+
+
+def test_release_lets_contender_in_immediately():
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    b = elector(lock, "b", clock)
+    assert a.try_acquire_or_renew()
+    a.release()
+    assert b.try_acquire_or_renew()
+    assert b.is_leader()
+
+
+def test_renew_deadline_must_be_shorter_than_lease():
+    with pytest.raises(ValueError):
+        LeaderElector(InMemoryLeaseLock(), "x", lease_duration=5, renew_deadline=5)
+
+
+def test_file_lock_cas_rejects_stale_update(tmp_path):
+    path = os.fspath(tmp_path / "lease.json")
+    lock = FileLeaseLock(path)
+    rec = LeaseRecord(holder="a", acquire_time=0, renew_time=0, lease_duration=15)
+    assert lock.create(rec)
+    newer = LeaseRecord(holder="a", acquire_time=0, renew_time=5, lease_duration=15)
+    assert lock.update(rec, newer)
+    # an update based on the outdated snapshot must fail (CAS)
+    stolen = LeaseRecord(holder="b", acquire_time=9, renew_time=9, lease_duration=15)
+    assert not lock.update(rec, stolen)
+    assert lock.get().holder == "a"
+
+
+def test_file_lock_survives_corrupt_file(tmp_path):
+    path = os.fspath(tmp_path / "lease.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    lock = FileLeaseLock(path)
+    assert lock.get() is None
+    assert lock.create(
+        LeaseRecord(holder="a", acquire_time=0, renew_time=0, lease_duration=15)
+    ) is False or lock.get().holder == "a"
+
+
+def test_run_acquire_renew_release_cycle():
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    started, stopped = [], []
+    a = elector(
+        lock,
+        "a",
+        clock,
+        on_started_leading=lambda: started.append(True),
+        on_stopped_leading=lambda: stopped.append(True),
+    )
+    stop = threading.Event()
+
+    orig_sleep = clock.sleep
+
+    def sleeper(dt):
+        orig_sleep(dt)
+        if clock.t > 30:
+            stop.set()
+
+    a._sleep = sleeper
+    a.run(stop)
+    assert started and stopped
+    # released: a fresh contender can take it at the current fake time
+    b = elector(lock, "b", clock)
+    assert b.try_acquire_or_renew()
