@@ -193,6 +193,9 @@ def loop_rounds(
 ) -> int:
     """Run ``step(i)`` every --interval for --rounds (0 = until stopped),
     printing each round's summary as a JSON line."""
+    # forever mode must pace itself even with the default --interval 0,
+    # or the loop busy-spins a core and floods stdout
+    interval = args.interval if args.interval > 0 else (1.0 if not args.rounds else 0.0)
     i = 0
     while not stop.is_set():
         out = step(i)
@@ -201,6 +204,6 @@ def loop_rounds(
         i += 1
         if args.rounds and i >= args.rounds:
             break
-        if args.interval > 0 and stop.wait(args.interval):
+        if interval > 0 and stop.wait(interval):
             break
     return 0

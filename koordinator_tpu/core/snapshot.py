@@ -310,21 +310,31 @@ class ClusterSnapshot:
         import time as _t
 
         idx = self._node_index[node_name]
+        # idempotent re-assume: a commit for a pod the solver already
+        # assumed (or a move to another node) replaces, never double-counts.
+        # A same-node re-assume of an absorbed pod stays absorbed — its load
+        # already lives in the reported usage baseline, not in pending.
+        prev = self._assumed.get(pod.meta.uid)
+        absorbed = prev is not None and prev.absorbed and prev.node_idx == idx
+        if prev is not None:
+            self.forget_pod(pod.meta.uid)
         req = self.config.res_vector(pod.spec.requests)
         self.nodes.requested[idx] += req
         est = np.asarray(
             estimated if estimated is not None else req, np.float32
         )
         is_prod = pod.priority_class == ext.PriorityClass.PROD
-        self.nodes.assigned_pending[idx] += est
-        if is_prod:
-            self.nodes.assigned_pending_prod[idx] += est
+        if not absorbed:
+            self.nodes.assigned_pending[idx] += est
+            if is_prod:
+                self.nodes.assigned_pending_prod[idx] += est
         self._assumed[pod.meta.uid] = _AssumedPod(
             node_idx=idx,
             request=req,
             estimate=est,
             is_prod=is_prod,
             assume_time=now if now is not None else _t.time(),
+            absorbed=absorbed,
         )
 
     def forget_pod(self, pod_uid: str) -> None:

@@ -80,6 +80,16 @@ class PeakPredictor:
         for subject, value in samples.items():
             self.observe(subject, value, ts)
 
+    def forget(self, subject: str) -> None:
+        """Drop a subject's histogram and recycle its slot (workload/pod
+        deletion; the reference GC's pod histograms the same way)."""
+        idx = self._index.pop(subject, None)
+        if idx is None:
+            return
+        self._weights[idx] = 0.0
+        self._last_decay[idx] = 0.0
+        self._free.append(idx)
+
     def peak(self, subject: str, percentile: float = 95.0) -> Optional[float]:
         idx = self._index.get(subject)
         if idx is None:

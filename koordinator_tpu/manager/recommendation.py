@@ -1,0 +1,91 @@
+"""Recommendation controller (``analysis.koordinator.sh``).
+
+The reference ships the CRD types only
+(``apis/analysis/v1alpha1/recommendation_types.go`` — SURVEY §2.7 calls it
+"largely scaffolding"); the natural owner of the data is the prediction
+subsystem, so here the controller is wired end-to-end: per-workload usage
+samples feed the same decayed-histogram PeakPredictor the koordlet uses
+(``pkg/koordlet/prediction``), and reconcile emits a Recommendation whose
+resources are the p95 peak with a safety margin — the shape the reference's
+RecommendedContainerStatus carries.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Mapping, Optional
+
+from ..api.types import ObjectMeta, Recommendation
+from ..koordlet.prediction import PeakPredictor, PredictorConfig
+
+
+def _subject(workload: str, resource: str) -> str:
+    return f"{workload}#{resource}"
+
+
+class RecommendationController:
+    """Aggregates workload usage into p95-peak resource recommendations."""
+
+    def __init__(
+        self,
+        predictor: Optional[PeakPredictor] = None,
+        percentile: float = 95.0,
+        safety_margin: float = 1.15,
+    ):
+        # margin is applied once, here — the embedded predictor's own
+        # safety factor is disabled so the two don't compound
+        self.predictor = predictor or PeakPredictor(
+            PredictorConfig(safety_margin=1.0)
+        )
+        self.percentile = percentile
+        self.safety_margin = safety_margin
+        self._workloads: Dict[str, set] = {}
+        self.recommendations: Dict[str, Recommendation] = {}
+
+    def observe(
+        self, workload: str, usage: Mapping[str, float], ts: Optional[float] = None
+    ) -> None:
+        """One usage sample for a workload (sum over its pods)."""
+        ts = ts if ts is not None else time.time()
+        resources = self._workloads.setdefault(workload, set())
+        for res, value in usage.items():
+            resources.add(res)
+            self.predictor.observe(_subject(workload, res), float(value), ts)
+
+    def recommend(self, workload: str) -> Optional[Recommendation]:
+        resources = self._workloads.get(workload)
+        if not resources:
+            return None
+        recommended: Dict[str, float] = {}
+        for res in sorted(resources):
+            peak = self.predictor.peak(_subject(workload, res), self.percentile)
+            if peak is not None:
+                recommended[res] = peak * self.safety_margin
+        if not recommended:
+            return None
+        return Recommendation(
+            meta=ObjectMeta(name=workload),
+            workload_name=workload,
+            recommended=recommended,
+        )
+
+    def reconcile(
+        self, workloads: Optional[Iterable[str]] = None
+    ) -> Dict[str, Recommendation]:
+        """Refresh Recommendation objects (all known workloads by default);
+        drops recommendations whose workload disappeared."""
+        names = set(workloads) if workloads is not None else set(self._workloads)
+        for name in list(self.recommendations):
+            if name not in names:
+                del self.recommendations[name]
+        # GC sample state too, or the next argument-less reconcile would
+        # resurrect the workload from stale histograms
+        for name in list(self._workloads):
+            if name not in names:
+                for res in self._workloads.pop(name):
+                    self.predictor.forget(_subject(name, res))
+        for name in names:
+            rec = self.recommend(name)
+            if rec is not None:
+                self.recommendations[name] = rec
+        return dict(self.recommendations)

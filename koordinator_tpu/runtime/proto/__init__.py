@@ -1,0 +1,1 @@
+"""Generated protobuf message code (protoc --python_out)."""

@@ -1,0 +1,223 @@
+"""gRPC snapshot/delta channel: control plane → TPU solver sidecar.
+
+The north-star architecture (SURVEY.md §2.8) keeps the Go scheduler shim
+and ships NodeInfo/PodInfo state to the JAX solver over gRPC — the same
+single-proto discipline the reference uses for its only RPC surface
+(``apis/runtime/v1alpha1/api.proto``). This module is the Python sidecar:
+
+- ``SolverService``  — applies ``SnapshotDelta`` batches to a live
+  ``ClusterSnapshot`` and answers ``Nominate`` with solver assignments.
+  Nominations are exactly that (SURVEY §7 "hard parts a"): the control
+  plane revalidates at Reserve time and failed pods re-enter the batch.
+- ``SolverClient``   — typed stubs for the Go-side role, used by tests
+  and the simulator.
+
+The image ships protoc without the grpc python plugin, so the service is
+registered through ``grpc.method_handlers_generic_handler`` instead of
+generated stubs; the wire contract lives in ``proto/snapshot.proto``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from ..api.types import Node, NodeMetric, NodeStatus, ObjectMeta, Pod, PodSpec, ResourceMetric
+from ..core.snapshot import ClusterSnapshot
+from ..scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from .proto import snapshot_pb2 as pb
+
+SERVICE_NAME = "koordinator_tpu.runtime.SolverService"
+
+
+def _vec_to_list(config, rl) -> list:
+    return [float(x) for x in config.res_vector(rl)]
+
+
+def _rl_from_vec(config, vec: pb.ResourceVector) -> dict:
+    return {
+        res: float(v)
+        for res, v in zip(config.resources, vec.values)
+        if v
+    }
+
+
+class SolverService:
+    """Server side: one live snapshot + solver, mutated by deltas."""
+
+    def __init__(
+        self,
+        snapshot: Optional[ClusterSnapshot] = None,
+        args: Optional[LoadAwareArgs] = None,
+        batch_bucket: int = 4096,
+    ):
+        self.snapshot = snapshot or ClusterSnapshot()
+        self.args = args or LoadAwareArgs()
+        self.scheduler = BatchScheduler(
+            self.snapshot, self.args, batch_bucket=batch_bucket
+        )
+        self.revision = 0
+        self._lock = threading.Lock()
+
+    # ---- rpc bodies ----
+
+    def sync(self, delta: pb.SnapshotDelta, _ctx=None) -> pb.SyncAck:
+        cfg = self.snapshot.config
+        now = delta.now or time.time()
+        with self._lock:
+            for up in delta.node_upserts:
+                self.snapshot.upsert_node(
+                    Node(
+                        meta=ObjectMeta(name=up.name),
+                        status=NodeStatus(
+                            allocatable=_rl_from_vec(cfg, up.allocatable)
+                        ),
+                        unschedulable=up.unschedulable,
+                    )
+                )
+            for name in delta.node_removes:
+                self.snapshot.remove_node(name)
+            for mu in delta.metric_updates:
+                self.snapshot.set_node_metric(
+                    NodeMetric(
+                        meta=ObjectMeta(name=mu.name),
+                        node_usage=ResourceMetric(usage=_rl_from_vec(cfg, mu.usage)),
+                        prod_usage=ResourceMetric(
+                            usage=_rl_from_vec(cfg, mu.prod_usage)
+                        ),
+                        update_time=mu.update_time or now,
+                    ),
+                    now=now,
+                )
+            for pa in delta.pod_assumed:
+                self.snapshot.assume_pod(
+                    Pod(
+                        meta=ObjectMeta(name=pa.uid, uid=pa.uid),
+                        spec=PodSpec(requests=_rl_from_vec(cfg, pa.requests)),
+                    ),
+                    pa.node,
+                    estimated=np.asarray(pa.estimated.values, np.float32)
+                    if pa.estimated.values
+                    else None,
+                    now=now,
+                )
+            for uid in delta.pod_forgotten:
+                self.snapshot.forget_pod(uid)
+            if delta.revision:
+                self.revision = delta.revision
+            else:
+                self.revision += 1
+            return pb.SyncAck(
+                applied_revision=self.revision,
+                node_count=self.snapshot.node_count,
+            )
+
+    def nominate(self, req: pb.NominateRequest, _ctx=None) -> pb.NominateResponse:
+        cfg = self.snapshot.config
+        pods = []
+        for pp in req.pods:
+            pods.append(
+                Pod(
+                    meta=ObjectMeta(name=pp.uid, uid=pp.uid),
+                    spec=PodSpec(
+                        requests=_rl_from_vec(cfg, pp.requests),
+                        priority=pp.priority
+                        or (9000 if pp.is_prod else 5000),
+                    ),
+                )
+            )
+        t0 = time.perf_counter()
+        with self._lock:
+            out = self.scheduler.schedule(pods)
+            rev = self.revision
+        resp = pb.NominateResponse(
+            at_revision=rev, solve_ms=(time.perf_counter() - t0) * 1e3
+        )
+        for pod, node in out.bound:
+            resp.nominations.add(pod_uid=pod.meta.uid, node=node)
+        for pod in out.unschedulable:
+            resp.nominations.add(pod_uid=pod.meta.uid, node="")
+        return resp
+
+    def get_config(self, _req: pb.SolverConfigRequest, _ctx=None) -> pb.SolverConfig:
+        cfg = self.snapshot.config
+        return pb.SolverConfig(
+            resources=list(cfg.resources),
+            usage_thresholds=pb.ResourceVector(
+                values=_vec_to_list(cfg, self.args.usage_thresholds)
+            ),
+        )
+
+    # ---- grpc wiring (no generated stubs: generic handler) ----
+
+    def generic_handler(self) -> grpc.GenericRpcHandler:
+        handlers = {
+            "Sync": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self.sync(req, ctx),
+                request_deserializer=pb.SnapshotDelta.FromString,
+                response_serializer=pb.SyncAck.SerializeToString,
+            ),
+            "Nominate": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self.nominate(req, ctx),
+                request_deserializer=pb.NominateRequest.FromString,
+                response_serializer=pb.NominateResponse.SerializeToString,
+            ),
+            "GetConfig": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self.get_config(req, ctx),
+                request_deserializer=pb.SolverConfigRequest.FromString,
+                response_serializer=pb.SolverConfig.SerializeToString,
+            ),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+
+def serve(
+    service: SolverService,
+    address: str = "127.0.0.1:0",
+    max_workers: int = 4,
+) -> tuple[grpc.Server, int]:
+    """Start the sidecar server; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((service.generic_handler(),))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port
+
+
+class SolverClient:
+    """The control-plane side of the channel (what the Go shim speaks)."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+        self._sync = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Sync",
+            request_serializer=pb.SnapshotDelta.SerializeToString,
+            response_deserializer=pb.SyncAck.FromString,
+        )
+        self._nominate = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Nominate",
+            request_serializer=pb.NominateRequest.SerializeToString,
+            response_deserializer=pb.NominateResponse.FromString,
+        )
+        self._get_config = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/GetConfig",
+            request_serializer=pb.SolverConfigRequest.SerializeToString,
+            response_deserializer=pb.SolverConfig.FromString,
+        )
+
+    def sync(self, delta: pb.SnapshotDelta) -> pb.SyncAck:
+        return self._sync(delta)
+
+    def nominate(self, req: pb.NominateRequest) -> pb.NominateResponse:
+        return self._nominate(req)
+
+    def get_config(self) -> pb.SolverConfig:
+        return self._get_config(pb.SolverConfigRequest())
+
+    def close(self) -> None:
+        self._channel.close()

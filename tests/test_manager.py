@@ -215,3 +215,52 @@ def test_colocation_feedback_loop_e2e():
     mutator.admit(big)
     out2 = sched.schedule([big])
     assert out2.bound == []
+
+
+# ---- Recommendation controller (analysis.koordinator.sh) ----
+
+
+def test_recommendation_tracks_p95_peak():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.manager.recommendation import RecommendationController
+
+    ctl = RecommendationController(safety_margin=1.0)
+    # 100 samples ramping 100..1090 milli-cpu: p95 ~ near the top
+    for i in range(100):
+        ctl.observe("web", {ext.RES_CPU: 100.0 + 10.0 * i}, ts=1000.0 + i)
+    recs = ctl.reconcile()
+    assert "web" in recs
+    cpu = recs["web"].recommended[ext.RES_CPU]
+    # p95 of the ramp is ~1040; exponential buckets round up one step
+    assert 900.0 <= cpu <= 1250.0, cpu
+
+
+def test_recommendation_margin_and_gc():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.manager.recommendation import RecommendationController
+
+    ctl = RecommendationController(safety_margin=1.5)
+    for i in range(50):
+        ctl.observe("a", {ext.RES_CPU: 1000.0}, ts=1000.0 + i)
+        ctl.observe("b", {ext.RES_MEMORY: 2048.0}, ts=1000.0 + i)
+    recs = ctl.reconcile()
+    assert recs["a"].recommended[ext.RES_CPU] >= 1400.0
+    assert ext.RES_MEMORY in recs["b"].recommended
+    # workload b disappears -> its recommendation is dropped
+    recs2 = ctl.reconcile(workloads=["a"])
+    assert "b" not in recs2 and "a" in recs2
+
+
+def test_recommendation_gc_forgets_samples():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.manager.recommendation import RecommendationController
+
+    ctl = RecommendationController()
+    for i in range(20):
+        ctl.observe("gone", {ext.RES_CPU: 500.0}, ts=1000.0 + i)
+    assert "gone" in ctl.reconcile()
+    ctl.reconcile(workloads=[])
+    # an argument-less reconcile must NOT resurrect the dropped workload
+    assert ctl.reconcile() == {}
+    # and the predictor slot was recycled
+    assert ctl.predictor.peak("gone#" + ext.RES_CPU) is None

@@ -1,0 +1,181 @@
+"""gRPC snapshot/delta channel tests: the control-plane↔solver contract
+(SURVEY §2.8, §7 step 1) over a real loopback server."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+from koordinator_tpu.runtime.snapshot_channel import (
+    SolverClient,
+    SolverService,
+    serve,
+)
+
+
+@pytest.fixture()
+def channel():
+    service = SolverService()
+    server, port = serve(service)
+    client = SolverClient(f"127.0.0.1:{port}")
+    yield service, client
+    client.close()
+    server.stop(grace=None)
+
+
+def _vec(cfg, **kw):
+    return pb.ResourceVector(
+        values=[float(kw.get(r.split("/")[-1].replace("-", "_"), 0.0)) for r in cfg.resources]
+    )
+
+
+def cpu_mem_vec(cfg, cpu, mem):
+    values = []
+    for r in cfg.resources:
+        if r == ext.RES_CPU:
+            values.append(float(cpu))
+        elif r == ext.RES_MEMORY:
+            values.append(float(mem))
+        else:
+            values.append(0.0)
+    return pb.ResourceVector(values=values)
+
+
+def test_sync_applies_nodes_and_metrics(channel):
+    service, client = channel
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(revision=7, now=1000.0)
+    for i in range(4):
+        delta.node_upserts.add(
+            name=f"n{i}", allocatable=cpu_mem_vec(cfg, 32000, 128 * 1024)
+        )
+        delta.metric_updates.add(
+            name=f"n{i}",
+            usage=cpu_mem_vec(cfg, 3200, 12 * 1024),
+            update_time=999.0,
+        )
+    ack = client.sync(delta)
+    assert ack.applied_revision == 7
+    assert ack.node_count == 4
+    assert service.snapshot.node_count == 4
+
+
+def test_nominate_round_trip(channel):
+    service, client = channel
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(now=1000.0)
+    for i in range(8):
+        delta.node_upserts.add(
+            name=f"n{i}", allocatable=cpu_mem_vec(cfg, 64000, 256 * 1024)
+        )
+        delta.metric_updates.add(
+            name=f"n{i}", usage=cpu_mem_vec(cfg, 6000, 24 * 1024), update_time=999.0
+        )
+    client.sync(delta)
+
+    req = pb.NominateRequest()
+    for i in range(32):
+        req.pods.add(
+            uid=f"pod-{i}",
+            requests=cpu_mem_vec(cfg, 1000, 4096),
+            priority=9000,
+            is_prod=True,
+        )
+    resp = client.nominate(req)
+    assert len(resp.nominations) == 32
+    placed = [n for n in resp.nominations if n.node]
+    assert len(placed) == 32
+    # spread over several nodes, and every named node exists
+    assert len({n.node for n in placed}) > 1
+    assert all(n.node.startswith("n") for n in placed)
+    assert resp.solve_ms > 0
+
+
+def test_nominations_consume_capacity_across_calls(channel):
+    """Nominate → control plane Reserves (pod_assumed delta) → next
+    Nominate sees the reduced capacity: the feedback loop of §3.3."""
+    service, client = channel
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="only", allocatable=cpu_mem_vec(cfg, 10000, 64 * 1024))
+    delta.metric_updates.add(
+        name="only", usage=cpu_mem_vec(cfg, 0, 0), update_time=999.0
+    )
+    client.sync(delta)
+
+    req = pb.NominateRequest()
+    req.pods.add(uid="big-1", requests=cpu_mem_vec(cfg, 6000, 1024), priority=9000)
+    resp = client.nominate(req)
+    assert resp.nominations[0].node == "only"
+
+    # control plane commits the assumption back over the channel
+    commit = pb.SnapshotDelta(now=1001.0)
+    commit.pod_assumed.add(
+        uid="big-1", node="only", requests=cpu_mem_vec(cfg, 6000, 1024)
+    )
+    client.sync(commit)
+
+    req2 = pb.NominateRequest()
+    req2.pods.add(uid="big-2", requests=cpu_mem_vec(cfg, 6000, 1024), priority=9000)
+    resp2 = client.nominate(req2)
+    assert resp2.nominations[0].node == ""  # no longer fits
+
+    # forget releases it again
+    release = pb.SnapshotDelta(now=1002.0)
+    release.pod_forgotten.append("big-1")
+    client.sync(release)
+    resp3 = client.nominate(req2)
+    assert resp3.nominations[0].node == "only"
+
+
+def test_node_remove_over_channel(channel):
+    service, client = channel
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="gone", allocatable=cpu_mem_vec(cfg, 32000, 1 << 17))
+    client.sync(delta)
+    assert service.snapshot.node_count == 1
+    rm = pb.SnapshotDelta(now=1001.0)
+    rm.node_removes.append("gone")
+    ack = client.sync(rm)
+    assert ack.node_count == 0
+
+
+def test_get_config_exposes_dimension_order(channel):
+    service, client = channel
+    cfg = client.get_config()
+    assert list(cfg.resources) == list(service.snapshot.config.resources)
+    assert len(cfg.usage_thresholds.values) == len(cfg.resources)
+
+
+def test_reassume_of_absorbed_pod_stays_absorbed(channel):
+    """A metric report absorbs the pod's pending estimate; a later commit
+    for the same uid must not re-add it (double count)."""
+    service, client = channel
+    cfg = service.snapshot.config
+    snap = service.snapshot
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="n0", allocatable=cpu_mem_vec(cfg, 32000, 1 << 17))
+    delta.metric_updates.add(name="n0", usage=cpu_mem_vec(cfg, 0, 0), update_time=999.0)
+    delta.pod_assumed.add(uid="p1", node="n0", requests=cpu_mem_vec(cfg, 4000, 8192))
+    client.sync(delta)
+    idx = snap.node_id("n0")
+    pend0 = snap.nodes.assigned_pending[idx].copy()
+    assert pend0.sum() > 0
+
+    # fresh metric AFTER the assume time absorbs the pending estimate
+    absorb = pb.SnapshotDelta(now=1100.0)
+    absorb.metric_updates.add(
+        name="n0", usage=cpu_mem_vec(cfg, 4000, 8192), update_time=1050.0
+    )
+    client.sync(absorb)
+    assert snap.nodes.assigned_pending[idx].sum() == 0
+
+    # idempotent recommit: still absorbed, pending must stay zero
+    recommit = pb.SnapshotDelta(now=1101.0)
+    recommit.pod_assumed.add(uid="p1", node="n0", requests=cpu_mem_vec(cfg, 4000, 8192))
+    client.sync(recommit)
+    assert snap.nodes.assigned_pending[idx].sum() == 0
+    # requested stays single-counted
+    req_cpu = snap.nodes.requested[idx][list(cfg.resources).index(ext.RES_CPU)]
+    assert req_cpu == 4000.0
