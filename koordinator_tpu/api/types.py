@@ -49,6 +49,11 @@ class PodSpec:
 
     requests: ResourceList = dataclasses.field(default_factory=dict)
     limits: ResourceList = dataclasses.field(default_factory=dict)
+    #: explicit usage estimate overriding the estimator's request scaling
+    #: (reference estimator framework, loadaware/estimator/estimator.go:
+    #: the default estimator scales requests, but callers with a measured
+    #: profile — e.g. the control plane's PendingPod.estimated — pass it)
+    estimated: Optional[ResourceList] = None
     priority: Optional[int] = None
     scheduler_name: str = "koord-scheduler"
     node_name: Optional[str] = None

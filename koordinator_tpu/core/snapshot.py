@@ -149,6 +149,12 @@ class _AssumedPod:
     is_prod: bool
     assume_time: float
     absorbed: bool = False  # estimate already reflected in reported usage
+    #: False while the assume is an optimistic scheduler-side charge not
+    #: yet confirmed by the control plane (bind / pod_assumed sync). The
+    #: reference scheduler cache expires such pods (kube-scheduler
+    #: durationToExpireAssumedPod) so a rejected-then-deleted nomination
+    #: can't leak capacity forever; see expire_assumed().
+    confirmed: bool = True
 
 
 class ClusterSnapshot:
@@ -306,10 +312,18 @@ class ClusterSnapshot:
         node_name: str,
         estimated: Optional[np.ndarray] = None,
         now: Optional[float] = None,
-    ) -> None:
+        confirmed: bool = True,
+    ) -> bool:
+        """Charge ``pod`` against ``node_name``; returns False (no-op) when
+        the node is absent — an assume racing a node delete is a
+        reconciliation matter for the caller, not an invariant violation
+        (the reference cache tolerates AssumePod on a deleted node the same
+        way: the informer's next sync repairs it)."""
         import time as _t
 
-        idx = self._node_index[node_name]
+        idx = self._node_index.get(node_name)
+        if idx is None:
+            return False
         # idempotent re-assume: a commit for a pod the solver already
         # assumed (or a move to another node) replaces, never double-counts.
         # A same-node re-assume of an absorbed pod stays absorbed — its load
@@ -335,7 +349,24 @@ class ClusterSnapshot:
             is_prod=is_prod,
             assume_time=now if now is not None else _t.time(),
             absorbed=absorbed,
+            confirmed=confirmed,
         )
+        return True
+
+    def expire_assumed(self, now: float, ttl: float) -> int:
+        """Forget optimistic (unconfirmed) assumes older than ``ttl``
+        seconds — the reference scheduler cache's assumed-pod expiration.
+        A confirmed assume (bind observed / pod_assumed sync) never
+        expires; its lifecycle belongs to pod_forgotten/delete events.
+        Returns the number of pods expired."""
+        stale = [
+            uid
+            for uid, ap in self._assumed.items()
+            if not ap.confirmed and now - ap.assume_time > ttl
+        ]
+        for uid in stale:
+            self.forget_pod(uid)
+        return len(stale)
 
     def forget_pod(self, pod_uid: str) -> None:
         ap = self._assumed.pop(pod_uid, None)

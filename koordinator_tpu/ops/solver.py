@@ -320,7 +320,13 @@ def _priority_order(pods: PodBatch) -> jnp.ndarray:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_rounds", "topk", "cost_transform", "nomination_jitter"),
+    static_argnames=(
+        "max_rounds",
+        "topk",
+        "cost_transform",
+        "nomination_jitter",
+        "approx_topk",
+    ),
 )
 def assign(
     pods: PodBatch,
@@ -334,6 +340,7 @@ def assign(
     topk: int = 4,
     cost_transform=None,
     nomination_jitter: float = 4.0,
+    approx_topk: bool = False,
 ) -> SolveResult:
     """Round-based fast solver. ``round_quantum`` is the fraction of a node's
     allocatable (per dim, measured in estimated usage) it may accept per
@@ -455,7 +462,22 @@ def assign(
         # each pod's K best nodes while the best nodes still go to the
         # highest priorities.
         k = min(topk, n)
-        neg_top, top_idx = jax.lax.top_k(-cost, k)          # [P, K]
+        if approx_topk:
+            # TPU-optimized partial reduction (avoids the full variadic
+            # sort lax.top_k lowers to). approx_max_k's recall < 1 could
+            # deterministically drop a pod's ONLY feasible node(s) — a
+            # device/NUMA-constrained pod with a handful of finite entries
+            # would then read as unschedulable every round — so slot 0 is
+            # pinned to the exact argmin (a cheap single reduction); the
+            # approximate set only provides the spread fan-out, where
+            # recall loss is covered by the nomination jitter.
+            neg_ap, idx_ap = jax.lax.approx_max_k(-cost, k)  # [P, K]
+            bidx = jnp.argmin(cost, axis=1).astype(idx_ap.dtype)
+            bval = -jnp.take_along_axis(cost, bidx[:, None], axis=1)
+            neg_top = jnp.concatenate([bval, neg_ap[:, : k - 1]], axis=1)
+            top_idx = jnp.concatenate([bidx[:, None], idx_ap[:, : k - 1]], axis=1)
+        else:
+            neg_top, top_idx = jax.lax.top_k(-cost, k)      # [P, K]
         finite = jnp.isfinite(neg_top)
         n_feas = jnp.sum(finite, axis=1).astype(jnp.int32)  # [P]
         rank = jnp.cumsum(active.astype(jnp.int32)) - 1
@@ -602,6 +624,77 @@ def assign(
         rounds_used=rounds,
     )
     return enforce_gangs(result, pods)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_rounds",
+        "topk",
+        "cost_transform",
+        "nomination_jitter",
+        "approx_topk",
+    ),
+)
+def solve_stream(
+    pods_stacked: PodBatch,
+    nodes: NodeState,
+    params: SolverParams,
+    quotas: QuotaState | None = None,
+    max_rounds: int = 24,
+    round_quantum: float = 0.35,
+    topk: int = 4,
+    cost_transform=None,
+    nomination_jitter: float = 4.0,
+    approx_topk: bool = False,
+) -> tuple[jnp.ndarray, NodeState, jnp.ndarray]:
+    """Pipelined multi-batch solve: ``lax.scan`` over a [B, P, ...] stacked
+    ``PodBatch``, threading consumed node (and quota) capacity between
+    batches entirely on device.
+
+    This is the dispatch-latency answer to the reference's continuous
+    ``scheduleOne`` loop: where the host round-trips once per *pod*
+    (apiserver bind), the batched path round-trips once per *stream* —
+    batch b+1's masks see batch b's commits without the host ever touching
+    the arrays in between.
+
+    Returns ``(assignments [B, P], final NodeState, placed-per-batch [B],
+    final QuotaState)`` — the quota state must come back out so a second
+    stream (next wave of pending pods) can thread consumption the same way
+    it threads node capacity.
+    """
+    quota_enabled = quotas is not None
+    if quotas is None:
+        quotas = QuotaState.disabled(pods_stacked.requests.shape[-1])
+
+    def step(carry, pb):
+        cur, qused = carry
+        res = assign(
+            pb,
+            cur,
+            params,
+            quotas=QuotaState(runtime=quotas.runtime, used=qused)
+            if quota_enabled
+            else None,
+            max_rounds=max_rounds,
+            round_quantum=round_quantum,
+            topk=topk,
+            cost_transform=cost_transform,
+            nomination_jitter=nomination_jitter,
+            approx_topk=approx_topk,
+        )
+        nxt = cur.replace(
+            requested=res.node_requested,
+            estimated_used=res.node_estimated_used,
+        )
+        placed = jnp.sum(res.assignment >= 0).astype(jnp.int32)
+        return (nxt, res.quota_used), (res.assignment, placed)
+
+    (final_nodes, final_qused), (assignments, placed) = jax.lax.scan(
+        step, (nodes, quotas.used), pods_stacked
+    )
+    final_quotas = QuotaState(runtime=quotas.runtime, used=final_qused)
+    return assignments, final_nodes, placed, final_quotas
 
 
 @jax.jit

@@ -55,6 +55,7 @@ class SolverService:
         snapshot: Optional[ClusterSnapshot] = None,
         args: Optional[LoadAwareArgs] = None,
         batch_bucket: int = 4096,
+        assume_ttl: float = 900.0,
     ):
         self.snapshot = snapshot or ClusterSnapshot()
         self.args = args or LoadAwareArgs()
@@ -62,6 +63,11 @@ class SolverService:
             self.snapshot, self.args, batch_bucket=batch_bucket
         )
         self.revision = 0
+        #: seconds an optimistic nominate-side assume survives without a
+        #: confirming pod_assumed sync (kube-scheduler's assumed-pod
+        #: expiration; bounds the capacity leak of a nomination the
+        #: control plane rejected and never reserved)
+        self.assume_ttl = assume_ttl
         self._lock = threading.Lock()
 
     # ---- rpc bodies ----
@@ -94,11 +100,15 @@ class SolverService:
                     ),
                     now=now,
                 )
+            skipped = 0
             for pa in delta.pod_assumed:
-                self.snapshot.assume_pod(
+                applied = self.snapshot.assume_pod(
                     Pod(
                         meta=ObjectMeta(name=pa.uid, uid=pa.uid),
-                        spec=PodSpec(requests=_rl_from_vec(cfg, pa.requests)),
+                        spec=PodSpec(
+                            requests=_rl_from_vec(cfg, pa.requests),
+                            priority=pa.priority or None,
+                        ),
                     ),
                     pa.node,
                     estimated=np.asarray(pa.estimated.values, np.float32)
@@ -106,6 +116,8 @@ class SolverService:
                     else None,
                     now=now,
                 )
+                if not applied:
+                    skipped += 1
             for uid in delta.pod_forgotten:
                 self.snapshot.forget_pod(uid)
             if delta.revision:
@@ -115,6 +127,7 @@ class SolverService:
             return pb.SyncAck(
                 applied_revision=self.revision,
                 node_count=self.snapshot.node_count,
+                assumes_skipped=skipped,
             )
 
     def nominate(self, req: pb.NominateRequest, _ctx=None) -> pb.NominateResponse:
@@ -126,6 +139,9 @@ class SolverService:
                     meta=ObjectMeta(name=pp.uid, uid=pp.uid),
                     spec=PodSpec(
                         requests=_rl_from_vec(cfg, pp.requests),
+                        estimated=_rl_from_vec(cfg, pp.estimated)
+                        if pp.estimated.values
+                        else None,
                         priority=pp.priority
                         or (9000 if pp.is_prod else 5000),
                     ),
@@ -133,6 +149,7 @@ class SolverService:
             )
         t0 = time.perf_counter()
         with self._lock:
+            self.snapshot.expire_assumed(time.time(), self.assume_ttl)
             out = self.scheduler.schedule(pods)
             rev = self.revision
         resp = pb.NominateResponse(
@@ -150,6 +167,9 @@ class SolverService:
             resources=list(cfg.resources),
             usage_thresholds=pb.ResourceVector(
                 values=_vec_to_list(cfg, self.args.usage_thresholds)
+            ),
+            prod_thresholds=pb.ResourceVector(
+                values=_vec_to_list(cfg, self.args.prod_usage_thresholds)
             ),
         )
 

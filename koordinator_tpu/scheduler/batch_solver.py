@@ -139,6 +139,9 @@ class BatchScheduler:
         if arrays.requests.shape[0] != b:
             raise ValueError("pod bucket mismatch")
         est = arrays.requests * self._scales[None, :]
+        for i, pod in enumerate(pods):
+            if pod.spec.estimated:
+                est[i] = self._estimate_of(pod)
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
         chains = self.quotas.chains_for_pods(list(pods), b)
         return PodBatch.create(
@@ -212,11 +215,20 @@ class BatchScheduler:
                         remaining_pending.append(pod)
                         continue
                     patch.update(dev_patch)
+                if not self.snapshot.assume_pod(
+                    pod, node, self._estimate_of(pod), confirmed=False
+                ):
+                    # reservation's node deleted this cycle: release the
+                    # per-winner allocations and retry via the full pipeline
+                    if self.devices is not None:
+                        self.devices.release(pod.meta.uid, node)
+                    if self.numa is not None:
+                        self.numa.release(pod.meta.uid, node)
+                    remaining_pending.append(pod)
+                    continue
                 self.reservations.allocate(r, pod)
                 if leaf is not None:
                     self.quotas.charge(leaf, pod.spec.requests)
-                est = self.snapshot.config.res_vector(pod.spec.requests) * self._scales
-                self.snapshot.assume_pod(pod, node, est)
                 pod.meta.annotations.update(patch)
                 reserved_bound.append((pod, node))
             pending = remaining_pending
@@ -377,6 +389,15 @@ class BatchScheduler:
             used = np.concatenate([used, pad])
         return QuotaState(runtime=jnp.asarray(runtime), used=jnp.asarray(used))
 
+    def _estimate_of(self, pod: Pod) -> np.ndarray:
+        """One estimate per pod everywhere — solver gating, Reserve commit
+        and reservation fast path must charge the same number, or a pod
+        admitted on its measured estimate gets re-charged at the ~5x
+        larger scaled request."""
+        if pod.spec.estimated:
+            return self.snapshot.config.res_vector(pod.spec.estimated)
+        return self.snapshot.config.res_vector(pod.spec.requests) * self._scales
+
     def _commit(
         self, chunk: Sequence[Pod], assignment: np.ndarray
     ) -> Tuple[List[Tuple[Pod, str]], List[Pod]]:
@@ -427,8 +448,17 @@ class BatchScheduler:
                     continue
                 patch.update(dev_patch)
             prebind.stage_annotations(pod, patch)
-            est = req * self._scales
-            self.snapshot.assume_pod(pod, node_name, est)
+            if not self.snapshot.assume_pod(
+                pod, node_name, self._estimate_of(pod), confirmed=False
+            ):
+                # node vanished between solve and Reserve (delete race):
+                # failed Reserve, roll back the per-winner allocations
+                if self.devices is not None:
+                    self.devices.release(pod.meta.uid, node_name)
+                if self.numa is not None:
+                    self.numa.release(pod.meta.uid, node_name)
+                results.append((pod, None))
+                continue
             results.append((pod, node_name))
         # Permit: all-or-nothing over gangs; roll back assumes of rejects.
         bound, unsched = self.pod_groups.permit(results)

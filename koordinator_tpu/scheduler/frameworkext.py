@@ -443,9 +443,11 @@ class SchedulerAdapter:
         self.snapshot = snapshot
         self.queue = queue or SchedulingQueue()
 
-    def assume_pod(self, pod: Pod, node_name: str) -> None:
-        self.snapshot.assume_pod(pod, node_name)
+    def assume_pod(self, pod: Pod, node_name: str) -> bool:
+        if not self.snapshot.assume_pod(pod, node_name):
+            return False
         self.queue.remove(pod.meta.uid)
+        return True
 
     def forget_pod(self, pod: Pod) -> None:
         self.snapshot.forget_pod(pod.meta.uid)

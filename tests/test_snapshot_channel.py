@@ -23,12 +23,6 @@ def channel():
     server.stop(grace=None)
 
 
-def _vec(cfg, **kw):
-    return pb.ResourceVector(
-        values=[float(kw.get(r.split("/")[-1].replace("-", "_"), 0.0)) for r in cfg.resources]
-    )
-
-
 def cpu_mem_vec(cfg, cpu, mem):
     values = []
     for r in cfg.resources:
@@ -146,6 +140,85 @@ def test_get_config_exposes_dimension_order(channel):
     cfg = client.get_config()
     assert list(cfg.resources) == list(service.snapshot.config.resources)
     assert len(cfg.usage_thresholds.values) == len(cfg.resources)
+    # prod thresholds travel too — both sides of the channel must agree on
+    # the prod-usage gate, not just the total-usage one
+    assert len(cfg.prod_thresholds.values) == len(cfg.resources)
+
+
+def test_get_config_round_trips_prod_thresholds():
+    from koordinator_tpu.scheduler.batch_solver import LoadAwareArgs
+
+    service = SolverService(
+        args=LoadAwareArgs(prod_usage_thresholds={ext.RES_CPU: 65.0})
+    )
+    server, port = serve(service)
+    client = SolverClient(f"127.0.0.1:{port}")
+    try:
+        cfg = client.get_config()
+        cpu_i = list(cfg.resources).index(ext.RES_CPU)
+        assert cfg.prod_thresholds.values[cpu_i] == 65.0
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
+def test_assume_on_unknown_node_is_skipped_not_fatal(channel):
+    """A pod_assumed racing a node delete (same delta or out-of-order
+    deltas) must not wedge the channel: the entry is skipped, counted in
+    the ack, and the rest of the delta still applies."""
+    service, client = channel
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="a", allocatable=cpu_mem_vec(cfg, 32000, 1 << 17))
+    delta.node_removes.append("ghost")
+    delta.pod_assumed.add(
+        uid="p-on-ghost", node="ghost", requests=cpu_mem_vec(cfg, 1000, 1024)
+    )
+    delta.pod_assumed.add(
+        uid="p-on-a", node="a", requests=cpu_mem_vec(cfg, 1000, 1024)
+    )
+    ack = client.sync(delta)
+    assert ack.assumes_skipped == 1
+    assert ack.node_count == 1
+    idx = service.snapshot.node_id("a")
+    cpu_i = list(cfg.resources).index(ext.RES_CPU)
+    assert service.snapshot.nodes.requested[idx][cpu_i] == 1000.0
+    # retrying the same delta stays idempotent and keeps succeeding
+    ack2 = client.sync(delta)
+    assert ack2.assumes_skipped == 1
+
+
+def test_nominate_honors_estimated_field(channel):
+    """PendingPod.estimated overrides the estimator's request scaling: an
+    overcommitted batch pod with a small measured estimate must pack more
+    densely than its raw requests would allow (usage thresholds gate on the
+    estimate, reference estimator framework)."""
+    service, client = channel
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="n0", allocatable=cpu_mem_vec(cfg, 10000, 1 << 16))
+    delta.metric_updates.add(
+        name="n0", usage=cpu_mem_vec(cfg, 5800, 0), update_time=999.0
+    )
+    client.sync(delta)
+    # node at 58% cpu; threshold 65% leaves 700m of estimate headroom.
+    # raw request 2000m (scaled est 1700m) would breach; explicit
+    # estimated 500m fits.
+    req = pb.NominateRequest()
+    req.pods.add(
+        uid="measured",
+        requests=cpu_mem_vec(cfg, 2000, 1024),
+        estimated=cpu_mem_vec(cfg, 500, 512),
+        priority=9000,
+    )
+    resp = client.nominate(req)
+    assert resp.nominations[0].node == "n0"
+    req2 = pb.NominateRequest()
+    req2.pods.add(
+        uid="unmeasured", requests=cpu_mem_vec(cfg, 2000, 1024), priority=9000
+    )
+    resp2 = client.nominate(req2)
+    assert resp2.nominations[0].node == ""
 
 
 def test_reassume_of_absorbed_pod_stays_absorbed(channel):
@@ -179,3 +252,83 @@ def test_reassume_of_absorbed_pod_stays_absorbed(channel):
     # requested stays single-counted
     req_cpu = snap.nodes.requested[idx][list(cfg.resources).index(ext.RES_CPU)]
     assert req_cpu == 4000.0
+
+
+def test_pod_assumed_priority_charges_prod_pending(channel):
+    """A committed PROD pod must raise the prod pending charge so the
+    prod_usage_thresholds gate sees it before the next NodeMetric report
+    (assigned_pending_prod accounting, reference pod_assign_cache)."""
+    service, client = channel
+    cfg = service.snapshot.config
+    snap = service.snapshot
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="n0", allocatable=cpu_mem_vec(cfg, 32000, 1 << 17))
+    delta.metric_updates.add(name="n0", usage=cpu_mem_vec(cfg, 0, 0), update_time=999.0)
+    delta.pod_assumed.add(
+        uid="prod-p",
+        node="n0",
+        requests=cpu_mem_vec(cfg, 4000, 8192),
+        priority=9500,
+    )
+    delta.pod_assumed.add(
+        uid="batch-p",
+        node="n0",
+        requests=cpu_mem_vec(cfg, 4000, 8192),
+        priority=5500,
+    )
+    client.sync(delta)
+    idx = snap.node_id("n0")
+    assert snap.nodes.assigned_pending_prod[idx].sum() > 0
+    # only the prod pod is charged to the prod tier
+    assert (
+        snap.nodes.assigned_pending_prod[idx].sum()
+        < snap.nodes.assigned_pending[idx].sum()
+    )
+
+
+def test_unconfirmed_nomination_expires(channel):
+    """A nominate-side optimistic assume the control plane never confirms
+    must expire after assume_ttl (kube-scheduler assumed-pod expiration) —
+    a rejected-then-deleted nomination cannot leak capacity forever."""
+    import time as _t
+
+    service, client = channel
+    service.assume_ttl = 0.05
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="only", allocatable=cpu_mem_vec(cfg, 10000, 1 << 16))
+    delta.metric_updates.add(name="only", usage=cpu_mem_vec(cfg, 0, 0), update_time=999.0)
+    client.sync(delta)
+
+    req = pb.NominateRequest()
+    req.pods.add(uid="big-1", requests=cpu_mem_vec(cfg, 6000, 1024), priority=9000)
+    assert client.nominate(req).nominations[0].node == "only"
+
+    # immediately: optimistic charge still present, a second big pod is out
+    req2 = pb.NominateRequest()
+    req2.pods.add(uid="big-2", requests=cpu_mem_vec(cfg, 6000, 1024), priority=9000)
+    assert client.nominate(req2).nominations[0].node == ""
+
+    # after ttl with no pod_assumed confirmation the charge evaporates
+    _t.sleep(0.06)
+    assert client.nominate(req2).nominations[0].node == "only"
+
+
+def test_confirmed_assume_never_expires(channel):
+    import time as _t
+
+    service, client = channel
+    service.assume_ttl = 0.05
+    cfg = service.snapshot.config
+    delta = pb.SnapshotDelta(now=1000.0)
+    delta.node_upserts.add(name="only", allocatable=cpu_mem_vec(cfg, 10000, 1 << 16))
+    delta.metric_updates.add(name="only", usage=cpu_mem_vec(cfg, 0, 0), update_time=999.0)
+    # confirmed via Sync (the control plane reserved it)
+    delta.pod_assumed.add(
+        uid="held", node="only", requests=cpu_mem_vec(cfg, 6000, 1024)
+    )
+    client.sync(delta)
+    _t.sleep(0.06)
+    req = pb.NominateRequest()
+    req.pods.add(uid="big", requests=cpu_mem_vec(cfg, 6000, 1024), priority=9000)
+    assert client.nominate(req).nominations[0].node == ""

@@ -296,3 +296,104 @@ def test_round_solver_jitter_bounded_deviation():
             continue
         best = cost[i].min()
         assert cost[i, node] <= best + amp + 1e-3
+
+
+def test_solve_stream_threads_capacity_between_batches():
+    """solve_stream must be equivalent to manually chaining assign() with
+    consumed capacity fed forward — the on-device scan is a pure dispatch
+    optimization, not a semantic change."""
+    import jax
+
+    from koordinator_tpu.ops.solver import solve_stream
+
+    pods, nodes, params, _ = make_fixture(p=64, n=16, base_util=0.2)
+    b, pp = 4, 16
+    stacked = jax.tree.map(lambda a: a.reshape((b, pp) + a.shape[1:]), pods)
+
+    assigns, final_nodes, placed, _ = solve_stream(stacked, nodes, params)
+    assigns = np.asarray(assigns)
+    placed = np.asarray(placed)
+
+    cur = nodes
+    for i in range(b):
+        batch = jax.tree.map(lambda a: a[i], stacked)
+        res = assign(batch, cur, params)
+        np.testing.assert_array_equal(np.asarray(res.assignment), assigns[i])
+        assert int((np.asarray(res.assignment) >= 0).sum()) == placed[i]
+        cur = cur.replace(
+            requested=res.node_requested,
+            estimated_used=res.node_estimated_used,
+        )
+    np.testing.assert_allclose(
+        np.asarray(final_nodes.requested), np.asarray(cur.requested), rtol=1e-6
+    )
+
+
+def test_solve_stream_respects_quota_across_batches():
+    """Quota used must accumulate across batches: a quota exhausted by batch
+    0 admits nothing in batch 1 (reference used+request<=runtime recursion,
+    plugin_helper.go:281-317, carried across scheduleOne cycles)."""
+    import jax
+
+    from koordinator_tpu.ops.solver import QuotaState, solve_stream
+
+    pods, nodes, params, _ = make_fixture(p=32, n=16)
+    # all pods charged to quota 0 with runtime for only ~6 pods' requests
+    chain = np.full((32, 4), -1, np.int32)
+    chain[:, 0] = 0
+    pods = pods.replace(quota_chain=jnp.asarray(chain))
+    total_req = np.asarray(pods.requests).sum(0)
+    runtime = np.stack([total_req * 0.2, np.full(2, np.inf)], 0).astype(np.float32)
+    quotas = QuotaState(
+        runtime=jnp.asarray(runtime), used=jnp.zeros((2, 2), jnp.float32)
+    )
+    stacked = jax.tree.map(lambda a: a.reshape((2, 16) + a.shape[1:]), pods)
+    assigns, _, placed, fq = solve_stream(stacked, nodes, params, quotas=quotas)
+    placed = np.asarray(placed)
+    # quota admits strictly fewer than everything, and batch 1 sees batch
+    # 0's charges (cannot place more than remaining headroom allows)
+    assert placed.sum() < 32
+    charged = np.asarray(stacked.requests).reshape(32, 2)[
+        np.asarray(assigns).reshape(32) >= 0
+    ].sum(0)
+    assert np.all(charged <= runtime[0] + 1e-4)
+    # the returned QuotaState carries cumulative consumption so a second
+    # stream threads it exactly like node capacity
+    np.testing.assert_allclose(np.asarray(fq.used)[0], charged, rtol=1e-5)
+
+
+def test_approx_topk_places_pod_with_single_feasible_node():
+    """approx_max_k recall < 1 must never cost a constrained pod its only
+    feasible node: slot 0 of the candidate set is pinned to the exact
+    argmin, so a pod feasible on exactly one node out of thousands still
+    places."""
+    p, n, d = 8, 4096, 2
+    alloc = np.full((n, d), 4.0, np.float32)
+    alloc[1234] = 1000.0  # the only node a big pod fits on
+    req = np.full((p, d), 8.0, np.float32)
+    pods = PodBatch.create(
+        requests=req, estimate=req, priority=np.full(p, 9000, np.int32)
+    )
+    nodes = NodeState.create(allocatable=alloc)
+    params = SolverParams(
+        usage_thresholds=jnp.zeros(d, jnp.float32),
+        prod_thresholds=jnp.zeros(d, jnp.float32),
+        score_weights=jnp.ones(d, jnp.float32),
+    )
+    res = assign(pods, nodes, params, approx_topk=True)
+    got = np.asarray(res.assignment)
+    assert np.all(got == 1234)
+
+
+def test_assign_approx_topk_matches_exact_quality():
+    """approx_max_k nomination must preserve solver invariants (no capacity
+    violation) and achieve the same placement count on an uncontended
+    fixture."""
+    pods, nodes, params, _ = make_fixture(p=48, n=24, base_util=0.1)
+    exact = assign(pods, nodes, params)
+    approx = assign(pods, nodes, params, approx_topk=True)
+    n_exact = int((np.asarray(exact.assignment) >= 0).sum())
+    n_approx = int((np.asarray(approx.assignment) >= 0).sum())
+    assert n_approx == n_exact == 48
+    req = np.asarray(approx.node_requested)
+    assert np.all(req <= np.asarray(nodes.allocatable) + 1e-4)
