@@ -41,6 +41,14 @@ ANNOTATION_RESOURCE_STATUS = f"scheduling.{DOMAIN}/resource-status"
 ANNOTATION_DEVICE_ALLOCATED = f"scheduling.{DOMAIN}/device-allocated"
 ANNOTATION_RESERVATION_AFFINITY = f"scheduling.{DOMAIN}/reservation-affinity"
 ANNOTATION_GANG_GROUPS = f"gang.scheduling.{DOMAIN}/groups"
+#: pod-side partition request (apis/extension/device_share.go:38
+#: AnnotationGPUPartitionSpec): {"allocatePolicy": "Restricted"|"BestEffort",
+#: "ringBusBandwidth": <GB/s>}
+ANNOTATION_GPU_PARTITION_SPEC = f"scheduling.{DOMAIN}/gpu-partition-spec"
+#: node-side partition table annotation (AnnotationGPUPartitions)
+ANNOTATION_GPU_PARTITIONS = f"scheduling.{DOMAIN}/gpu-partitions"
+#: node label choosing Honor/Prefer (LabelGPUPartitionPolicy)
+LABEL_GPU_PARTITION_POLICY = f"node.{DOMAIN}/gpu-partition-policy"
 ANNOTATION_NODE_CPU_TOPOLOGY = f"node.{DOMAIN}/cpu-topology"
 ANNOTATION_NODE_RAW_ALLOCATABLE = f"node.{DOMAIN}/raw-allocatable"
 ANNOTATION_NODE_AMPLIFICATION = f"node.{DOMAIN}/resource-amplification-ratio"
@@ -142,6 +150,25 @@ def parse_gpu_request(requests: Mapping[str, float]) -> tuple[int, float]:
         whole += int(ratio // 100.0)
         ratio = ratio % 100.0
     return whole, ratio
+
+
+def parse_gpu_partition_spec(annotations: Mapping[str, str]) -> tuple[bool, float]:
+    """(restricted, ring_bus_bandwidth) from the pod's partition-spec
+    annotation (``GPUPartitionSpec``: Restricted = only the best
+    allocation-score tier may be used; BestEffort = walk down tiers)."""
+    import json as _json
+
+    raw = annotations.get(ANNOTATION_GPU_PARTITION_SPEC)
+    if not raw:
+        return False, 0.0
+    try:
+        spec = _json.loads(raw)
+    except (ValueError, TypeError):
+        return False, 0.0
+    return (
+        spec.get("allocatePolicy") == "Restricted",
+        float(spec.get("ringBusBandwidth", 0.0)),
+    )
 
 
 def qos_for_priority(prio: PriorityClass) -> QoSClass:

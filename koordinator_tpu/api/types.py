@@ -193,11 +193,39 @@ class DeviceInfo:
 
 
 @dataclasses.dataclass
+class GPUPartition:
+    """One interconnect-complete GPU group (reference
+    ``apis/extension/device_share.go:217`` ``GPUPartition``): the minors
+    share a link domain (NVLink analog; for TPU hosts, an ICI ring), and
+    multi-device allocations should land entirely inside one partition."""
+
+    minors: List[int]
+    link_type: str = "NVLink"
+    ring_bus_bandwidth: float = 0.0     # GB/s; 0 = unspecified
+    allocation_score: int = 1
+
+    @property
+    def minors_mask(self) -> int:
+        m = 0
+        for minor in self.minors:
+            m |= 1 << minor
+        return m
+
+
+@dataclasses.dataclass
 class Device:
     """Per-node device inventory reported by the node agent."""
 
     meta: ObjectMeta            # name == node name
     devices: List[DeviceInfo] = dataclasses.field(default_factory=list)
+    #: size -> partitions of exactly that many minors (reference
+    #: ``GPUPartitionTable``, annotated on the Device CR)
+    partitions: Dict[int, List[GPUPartition]] = dataclasses.field(
+        default_factory=dict
+    )
+    #: "Honor" (partition table is binding) | "Prefer" (fall back to
+    #: topology packing when no partition fits) | "" (ignore table)
+    partition_policy: str = ""
 
 
 @dataclasses.dataclass

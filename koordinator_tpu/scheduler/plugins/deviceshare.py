@@ -41,6 +41,16 @@ class _NodeDevices:
     owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
         default_factory=dict
     )
+    #: size -> partitions (GPUPartitionTable); empty = no table
+    partitions: Dict[int, List["GPUPartition"]] = dataclasses.field(
+        default_factory=dict
+    )
+    #: "Honor" | "Prefer" | ""
+    partition_policy: str = ""
+    #: NUMA node per minor (topology fallback packing), -1 unknown
+    numa_of: List[int] = dataclasses.field(default_factory=list)
+    #: PCIe root per minor ("" unknown)
+    pcie_of: List[str] = dataclasses.field(default_factory=list)
 
 
 class DeviceManager:
@@ -59,7 +69,14 @@ class DeviceManager:
         gpus = [d for d in device.devices if d.dev_type == "gpu"]
         rdma = [d for d in device.devices if d.dev_type == "rdma"]
         old = self._nodes.get(device.meta.name)
-        st = _NodeDevices(gpu_free=[FULL] * len(gpus), rdma_free=len(rdma))
+        st = _NodeDevices(
+            gpu_free=[FULL] * len(gpus),
+            rdma_free=len(rdma),
+            partitions=dict(device.partitions),
+            partition_policy=device.partition_policy,
+            numa_of=[d.numa_node for d in gpus],
+            pcie_of=[d.pcie_bus for d in gpus],
+        )
         if old is not None:
             for uid, picks in old.owners.items():
                 kept = [(m, pct) for m, pct in picks if m < len(st.gpu_free)]
@@ -111,9 +128,13 @@ class DeviceManager:
         full_minors = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
         if len(full_minors) < whole:
             return None
-        for minor in full_minors[:whole]:
-            picks.append((minor, FULL))
-            free[minor] = 0.0
+        if whole > 0:
+            chosen = self._pick_whole_minors(st, free, whole, pod)
+            if chosen is None:
+                return None
+            for minor in chosen:
+                picks.append((minor, FULL))
+                free[minor] = 0.0
         if share > 0:
             # best-fit: smallest partial slot that still fits, else a
             # fresh full slot (reference allocator_gpu.go scoring)
@@ -143,6 +164,105 @@ class DeviceManager:
             ]
         }
         return {ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(payload)}
+
+    # ---- whole-GPU selection: partition table + topology packing ----
+    # Rebuild of the reference's partition allocator
+    # (``allocator_gpu.go:177-299`` allocateByPartition /
+    # selectPartitionByBinPack): multi-GPU allocations land inside one
+    # interconnect-complete partition; among feasible partitions, prefer
+    # the one that keeps the most high-value larger partitions intact.
+
+    def _pick_whole_minors(
+        self, st: _NodeDevices, free: List[float], whole: int, pod: Pod
+    ) -> Optional[List[int]]:
+        full_minors = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
+        if st.partitions and st.partition_policy in ("Honor", "Prefer"):
+            chosen = self._allocate_by_partition(st, full_minors, whole, pod)
+            if chosen is not None:
+                return chosen
+            if st.partition_policy == "Honor":
+                # table is binding: no feasible partition = failed Reserve
+                # (ErrInsufficientPartitionedDevice / unsupported size)
+                return None
+        return self._allocate_by_topology(st, full_minors, whole)
+
+    def _allocate_by_partition(
+        self, st: _NodeDevices, full_minors: List[int], whole: int, pod: Pod
+    ) -> Optional[List[int]]:
+        table = st.partitions.get(whole)
+        if not table:
+            return None
+        restricted, want_bw = ext.parse_gpu_partition_spec(pod.meta.annotations)
+        free_mask = 0
+        for m in full_minors:
+            free_mask |= 1 << m
+        # walk allocation-score tiers best-first; Restricted pods may only
+        # use the best tier, BestEffort walks down until one is feasible
+        tiers: Dict[int, List] = {}
+        for part in table:
+            tiers.setdefault(part.allocation_score, []).append(part)
+        feasible = []
+        for score in sorted(tiers, reverse=True):
+            for part in tiers[score]:
+                if part.minors_mask & ~free_mask:
+                    continue    # some minor busy or absent
+                if want_bw > 0 and part.ring_bus_bandwidth < want_bw:
+                    continue
+                feasible.append(part)
+            if feasible or restricted:
+                break
+        if not feasible:
+            return None
+        if len(feasible) == 1:
+            return list(feasible[0].minors)
+        return list(self._bin_pack_partition(st, free_mask, feasible, whole).minors)
+
+    def _bin_pack_partition(self, st, free_mask: int, feasible, whole: int):
+        """Choose the partition whose allocation preserves the most intact
+        larger partitions, weighted steeply by size (reference
+        selectPartitionByBinPack weights {8: 10000, 4: 100, 2: 1})."""
+        weight = {8: 10_000, 4: 100, 2: 1}
+
+        def preserve_score(candidate) -> int:
+            after_busy = ~free_mask | candidate.minors_mask
+            score = 0
+            for size, parts in st.partitions.items():
+                if size < whole or size not in weight:
+                    continue
+                for part in parts:
+                    if part.minors_mask & after_busy:
+                        continue
+                    score += weight[size] * part.allocation_score
+            return score
+
+        return max(feasible, key=preserve_score)
+
+    def _allocate_by_topology(
+        self, st: _NodeDevices, full_minors: List[int], whole: int
+    ) -> Optional[List[int]]:
+        """No (binding) partition table: pack onto the fewest NUMA/PCIe
+        domains, preferring the domain group with least leftover (the
+        reference's GPUTopologyScope bin-pack, ``allocator_gpu.go:300+``)."""
+        if len(full_minors) < whole:
+            return None
+        groups: Dict[Tuple[int, str], List[int]] = {}
+        for m in full_minors:
+            numa = st.numa_of[m] if m < len(st.numa_of) else -1
+            pcie = st.pcie_of[m] if m < len(st.pcie_of) else ""
+            groups.setdefault((numa, pcie), []).append(m)
+        # smallest group that satisfies the request = tightest fit
+        fitting = [g for g in groups.values() if len(g) >= whole]
+        if fitting:
+            best = min(fitting, key=len)
+            return best[:whole]
+        # spill across groups, draining the largest first
+        ordered = sorted(groups.values(), key=len, reverse=True)
+        out: List[int] = []
+        for g in ordered:
+            out.extend(g)
+            if len(out) >= whole:
+                return out[:whole]
+        return None
 
     def release(self, pod_uid: str, node_name: str) -> None:
         st = self._nodes.get(node_name)

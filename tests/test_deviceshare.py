@@ -180,3 +180,148 @@ def test_slot_array_grows_beyond_default():
     slots = dm.slot_array()
     assert slots.shape[1] == 16
     assert (slots[snap.node_id("n0")] == 100.0).all()
+
+
+# ---- partition / topology-aware whole-GPU allocation ----
+# (reference allocator_gpu.go allocateByPartition + selectPartitionByBinPack)
+
+
+def h800_partitions():
+    """8-GPU node with NVLink partition table: pairs, quads, and the full
+    octet, all at allocation score 1 except one 'preferred' quad tier."""
+    from koordinator_tpu.api.types import GPUPartition
+
+    return {
+        1: [GPUPartition(minors=[m]) for m in range(8)],
+        2: [
+            GPUPartition(minors=[0, 1]),
+            GPUPartition(minors=[2, 3]),
+            GPUPartition(minors=[4, 5]),
+            GPUPartition(minors=[6, 7]),
+        ],
+        4: [
+            GPUPartition(minors=[0, 1, 2, 3], ring_bus_bandwidth=400.0),
+            GPUPartition(minors=[4, 5, 6, 7], ring_bus_bandwidth=400.0),
+        ],
+        8: [
+            GPUPartition(
+                minors=list(range(8)), ring_bus_bandwidth=400.0
+            )
+        ],
+    }
+
+
+def partition_cluster(policy="Honor"):
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+            ),
+        )
+    )
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="n0"),
+            devices=[
+                DeviceInfo(dev_type="gpu", minor=g, numa_node=g // 4)
+                for g in range(8)
+            ],
+            partitions=h800_partitions(),
+            partition_policy=policy,
+        )
+    )
+    return snap, dm
+
+
+def minors_of(patch):
+    return sorted(
+        a["minor"] for a in json.loads(patch[ext.ANNOTATION_DEVICE_ALLOCATED])["gpu"]
+    )
+
+
+def test_partition_quad_stays_intact():
+    _, dm = partition_cluster()
+    patch = dm.allocate(gpu_pod("quad", whole=4), "n0")
+    assert minors_of(patch) in ([0, 1, 2, 3], [4, 5, 6, 7])
+
+
+def test_partition_binpack_preserves_intact_quad():
+    """After one GPU is taken from the first quad, a 2-GPU request must
+    land on the *broken* quad's remaining pair, keeping the second quad
+    fully intact (selectPartitionByBinPack weighting)."""
+    _, dm = partition_cluster()
+    # occupy minor 0 (breaks quad {0..3} and pair {0,1})
+    assert minors_of(dm.allocate(gpu_pod("single", whole=1), "n0")) == [0]
+    pair = minors_of(dm.allocate(gpu_pod("pair", whole=2), "n0"))
+    assert pair == [2, 3]
+    # quad {4..7} remains allocatable as a unit
+    quad = minors_of(dm.allocate(gpu_pod("quad", whole=4), "n0"))
+    assert quad == [4, 5, 6, 7]
+
+
+def test_partition_honor_rejects_unsupported_size():
+    """Honor policy: a size with no partition entry (3 GPUs) is
+    unschedulable on this node (ErrUnsupportedGPURequests)."""
+    _, dm = partition_cluster(policy="Honor")
+    assert dm.allocate(gpu_pod("three", whole=3), "n0") is None
+
+
+def test_partition_prefer_falls_back_to_topology():
+    """Prefer policy: the same 3-GPU request falls back to topology
+    packing and lands within one NUMA domain."""
+    _, dm = partition_cluster(policy="Prefer")
+    got = minors_of(dm.allocate(gpu_pod("three", whole=3), "n0"))
+    assert len(got) == 3
+    # all on one NUMA node (minors 0-3 are numa 0, 4-7 numa 1)
+    assert all(m < 4 for m in got) or all(m >= 4 for m in got)
+
+
+def test_partition_honor_rejects_fragmented_node():
+    """Honor: 4-GPU request with both quads broken fails even though 4
+    full GPUs remain (partition integrity is binding)."""
+    _, dm = partition_cluster(policy="Honor")
+    dm.allocate(gpu_pod("s1", whole=1), "n0")   # breaks quad 0-3
+    # break the second quad too
+    st = dm.node("n0")
+    st.gpu_free[4] = 0.0
+    assert dm.allocate(gpu_pod("quad", whole=4), "n0") is None
+
+
+def test_partition_ring_bandwidth_filter():
+    """A pod demanding more ring bandwidth than the pair partitions offer
+    cannot use them (pairs carry no bandwidth in the fixture)."""
+    pod = gpu_pod("bw", whole=2)
+    pod.meta.annotations[ext.ANNOTATION_GPU_PARTITION_SPEC] = json.dumps(
+        {"allocatePolicy": "BestEffort", "ringBusBandwidth": 100.0}
+    )
+    _, dm = partition_cluster(policy="Honor")
+    assert dm.allocate(pod, "n0") is None
+
+
+def test_topology_packing_without_table():
+    """No partition table: whole-GPU picks pack onto one NUMA domain."""
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(allocatable={ext.RES_CPU: 64000}),
+        )
+    )
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="n0"),
+            devices=[
+                DeviceInfo(dev_type="gpu", minor=g, numa_node=g // 4)
+                for g in range(8)
+            ],
+        )
+    )
+    # consume 3 of numa0; a 4-GPU request must go to intact numa1
+    for i in range(3):
+        dm.node("n0").gpu_free[i] = 0.0
+    got = minors_of(dm.allocate(gpu_pod("quad", whole=4), "n0"))
+    assert got == [4, 5, 6, 7]
