@@ -22,7 +22,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.solver import NodeState, PodBatch, SolverParams, SolveResult, assign
+from ..ops.solver import (
+    NodeState,
+    PodBatch,
+    QuotaState,
+    SolverParams,
+    SolveResult,
+    assign,
+)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -113,3 +120,162 @@ def sharded_assign(
     nodes = jax.device_put(nodes, node_sh)
     params = jax.device_put(params, param_sh)
     return fn(pods, nodes, params)
+
+
+def sharded_solve_stream(
+    mesh: Mesh,
+    pods_stacked: PodBatch,
+    nodes: NodeState,
+    params: SolverParams,
+    max_rounds: int = 24,
+    approx_topk: bool = False,
+):
+    """Pipelined multi-batch solve, SPMD over the mesh: batch axis
+    unsharded (scan), pod rows on dp, node table on tp. This is the
+    multi-chip serving configuration — one dispatch per stream, capacity
+    threaded on device, collectives riding ICI.
+
+    Returns ``(assignments [B, P], final NodeState, placed [B], quotas)``.
+    """
+    from ..ops.solver import solve_stream
+
+    pod_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, P(None, *s)), _pod_spec()
+    )
+    node_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), _node_spec())
+    rep = NamedSharding(mesh, P())
+    param_sh = jax.tree.map(lambda _: rep, params)
+
+    fn = jax.jit(
+        functools.partial(
+            solve_stream, max_rounds=max_rounds, approx_topk=approx_topk
+        ),
+        in_shardings=(pod_sh, node_sh, param_sh),
+        out_shardings=(
+            NamedSharding(mesh, P(None, "dp")),
+            jax.tree.map(lambda s: NamedSharding(mesh, s), _node_spec()),
+            rep,
+            jax.tree.map(lambda _: rep, QuotaState.disabled(1)),
+        ),
+    )
+    pods_stacked = jax.device_put(pods_stacked, pod_sh)
+    nodes = jax.device_put(nodes, node_sh)
+    params = jax.device_put(params, param_sh)
+    return fn(pods_stacked, nodes, params)
+
+
+def shard_map_nominate(
+    mesh: Mesh,
+    pods: PodBatch,
+    nodes: NodeState,
+    params: SolverParams,
+    topk: int = 4,
+    nomination_jitter: float = 4.0,
+):
+    """Hand-scheduled nomination for node tables too large for one chip's
+    HBM: each device holds a 1/tp shard of the node table, computes the
+    cost block + local top-k over its shard, and the [P, tp·K] candidate
+    sets are combined with one all-gather over ICI (the cross-device
+    reduction is K values per pod, not the [P, N] cost matrix — the same
+    communication shape as ring-attention's per-block softmax stats).
+
+    Pod arrays are replicated across tp (they're [P, D] — tiny); the
+    returned global candidates ([P, K] values + global node indices) feed
+    the host/replicated commit phase. Use when GSPMD's choice for the
+    fused cost+topk is suboptimal; semantics match the single-chip
+    nomination exactly (modulo the documented jitter hash, which uses
+    *global* node indices and is therefore shard-invariant).
+    """
+    from functools import partial
+
+    import jax.numpy as jnp
+
+    try:
+        from jax import shard_map as _smap
+
+        # the replication checker can't see through all_gather+top_k;
+        # outputs ARE replicated (identical candidate sets on every shard)
+        shard_map = partial(_smap, check_vma=False)
+    except (ImportError, TypeError):  # older jax
+        from jax.experimental.shard_map import shard_map as _smap_old
+
+        shard_map = partial(_smap_old, check_rep=False)
+
+    from ..ops import costs as cost_ops
+    from ..ops import masks as mask_ops
+
+    n = nodes.allocatable.shape[0]
+    tp = mesh.shape["tp"]
+    if n % tp:
+        raise ValueError(f"node count {n} not divisible by tp={tp}")
+    shard_w = n // tp
+    p = pods.requests.shape[0]
+
+    node_specs = NodeState(
+        allocatable=P("tp", None),
+        requested=P("tp", None),
+        estimated_used=P("tp", None),
+        prod_used=P("tp", None),
+        metric_fresh=P("tp"),
+        schedulable=P("tp"),
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(), pods),      # replicated pods
+            node_specs,
+            jax.tree.map(lambda _: P(), params),
+        ),
+        out_specs=(P(), P()),
+    )
+    def nominate(pods_l, nodes_l, params_l):
+        # global node index of this shard's rows — the jitter hash and the
+        # returned candidate indices must be shard-position-aware
+        tpi = jax.lax.axis_index("tp")
+        g0 = tpi * shard_w
+        free = nodes_l.allocatable - nodes_l.requested
+        feas = mask_ops.fit_mask(pods_l.requests, free)
+        feas &= mask_ops.usage_threshold_mask(
+            pods_l.estimate,
+            nodes_l.estimated_used,
+            nodes_l.allocatable,
+            params_l.usage_thresholds,
+            nodes_l.metric_fresh,
+        )
+        feas &= nodes_l.schedulable[None, :]
+        cost = cost_ops.load_aware_cost(
+            pods_l.estimate,
+            nodes_l.estimated_used,
+            nodes_l.allocatable,
+            params_l.score_weights,
+        )
+        if nomination_jitter > 0.0:
+            pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
+            ni = (g0.astype(jnp.uint32) + jnp.arange(shard_w, dtype=jnp.uint32))[
+                None, :
+            ]
+            h = (pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)) & jnp.uint32(
+                0xFFFF
+            )
+            cost = cost + h.astype(jnp.float32) * (nomination_jitter / 65536.0)
+        cost = jnp.where(feas, cost, jnp.inf)
+        k = min(topk, shard_w)
+        neg_local, idx_local = jax.lax.top_k(-cost, k)       # [P, K] per shard
+        gidx_local = (idx_local + g0).astype(jnp.int32)
+        # one all-gather of K candidates per pod per shard — O(P·K·tp),
+        # never O(P·N)
+        neg_all = jax.lax.all_gather(neg_local, "tp", axis=1, tiled=True)
+        gidx_all = jax.lax.all_gather(gidx_local, "tp", axis=1, tiled=True)
+        sel_neg, sel_pos = jax.lax.top_k(neg_all, k)          # [P, K] global
+        sel_idx = jnp.take_along_axis(gidx_all, sel_pos, axis=1)
+        return sel_neg, sel_idx
+
+    return nominate(
+        jax.device_put(pods, jax.tree.map(lambda _: NamedSharding(mesh, P()), pods)),
+        jax.device_put(
+            nodes, jax.tree.map(lambda s: NamedSharding(mesh, s), node_specs)
+        ),
+        params,
+    )

@@ -39,3 +39,66 @@ def test_dryrun_multichip_entry():
     fn, args = mod.entry()
     out = jax.jit(fn)(*args)
     assert out.shape == (256,)
+
+
+def test_sharded_solve_stream_matches_single_device():
+    from koordinator_tpu.parallel.sharded import sharded_solve_stream
+    from koordinator_tpu.ops.solver import solve_stream
+
+    mesh = make_mesh(8)
+    b, pp = 2, 16 * mesh.shape["dp"]
+    n = 16 * mesh.shape["tp"]
+    pods, nodes, params, _ = make_fixture(p=b * pp, n=n, seed=31, base_util=0.2)
+    stacked = jax.tree.map(lambda a: a.reshape((b, pp) + a.shape[1:]), pods)
+    want, want_nodes, want_placed, _ = solve_stream(stacked, nodes, params)
+    got, got_nodes, got_placed, _ = sharded_solve_stream(
+        mesh, stacked, nodes, params
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(
+        np.asarray(got_placed), np.asarray(want_placed)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_nodes.requested), np.asarray(want_nodes.requested),
+        rtol=1e-6,
+    )
+
+
+def test_shard_map_nominate_matches_replicated_topk():
+    """The hand-scheduled node-sharded nomination (local top-k +
+    all-gather combine) must produce exactly the candidates the
+    replicated cost+topk produces — including the jitter hash, which is
+    defined on global node indices and therefore shard-invariant."""
+    import jax.numpy as jnp
+
+    from koordinator_tpu.ops import costs as cost_ops, masks as mask_ops
+    from koordinator_tpu.parallel.sharded import shard_map_nominate
+
+    mesh = make_mesh(8)
+    tp = mesh.shape["tp"]
+    p, n = 24, 16 * tp
+    pods, nodes, params, _ = make_fixture(p=p, n=n, seed=41, base_util=0.3)
+
+    neg, idx = shard_map_nominate(mesh, pods, nodes, params, topk=4)
+    neg, idx = np.asarray(neg), np.asarray(idx)
+
+    # replicated reference
+    free = nodes.allocatable - nodes.requested
+    feas = mask_ops.fit_mask(pods.requests, free)
+    feas &= mask_ops.usage_threshold_mask(
+        pods.estimate, nodes.estimated_used, nodes.allocatable,
+        params.usage_thresholds, nodes.metric_fresh,
+    )
+    feas &= nodes.schedulable[None, :]
+    cost = cost_ops.load_aware_cost(
+        pods.estimate, nodes.estimated_used, nodes.allocatable,
+        params.score_weights,
+    )
+    pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
+    ni = jnp.arange(n, dtype=jnp.uint32)[None, :]
+    h = (pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)) & jnp.uint32(0xFFFF)
+    cost = cost + h.astype(jnp.float32) * (4.0 / 65536.0)
+    cost = jnp.where(feas, cost, jnp.inf)
+    wneg, widx = jax.lax.top_k(-cost, 4)
+    np.testing.assert_allclose(neg, np.asarray(wneg), rtol=1e-6)
+    np.testing.assert_array_equal(idx, np.asarray(widx))
