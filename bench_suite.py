@@ -1,0 +1,328 @@
+"""Extended benchmark suite: the BASELINE.md measurement configs beyond the
+headline metric (which stays in ``bench.py`` — the driver contract is ONE
+JSON line there).
+
+Scenarios (BASELINE.md "Numbers to measure"):
+  2. loadaware    — 10k nodes / 32k pods, cpu+mem dims, end-to-end host
+                    pipeline AND raw solver stream (the headline).
+  3. numa         — 2-socket nodes, LSR whole-core pods, cpuset-aware
+                    placement through the NUMA manager.
+  4. device_gang  — 8-GPU nodes, 4-GPU all-or-nothing gang pods.
+  5. quota_tree   — 3-level quota hierarchy, admission along the chain.
+
+Each prints one JSON line: pods/sec plus p50/p99 per-solver-batch latency
+(the per-pod scheduling-latency proxy: a pod's wait is at most one batch).
+Run: ``python bench_suite.py [scenario ...]``; results land in stdout and
+``BENCH_SUITE.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _percentiles(samples):
+    if not samples:
+        return 0.0, 0.0
+    arr = np.asarray(samples) * 1e3
+    return float(np.percentile(arr, 50)), float(np.percentile(arr, 99))
+
+
+def _run_scheduler(sched, pods, chunk=4096):
+    """Drive the host pipeline in chunks; returns (bound, total, batch_times)."""
+    times = []
+    bound = 0
+    for start in range(0, len(pods), chunk):
+        t0 = time.perf_counter()
+        out = sched.schedule(pods[start : start + chunk])
+        times.append(time.perf_counter() - t0)
+        bound += len(out.bound)
+    return bound, times
+
+
+def _measure(build, chunk, name):
+    """Warmup pass on a throwaway instance (fills the jit cache for the
+    bucket shapes), then measure on fresh state — mirrors bench.py's
+    warmup-pass discipline so compile time never lands in the p99."""
+    sched, pods = build()
+    # first solve of a new jit specialization can exceed the 30 s watchdog;
+    # that's the monitor doing its job, but it's noise here — silence it
+    sched.extender.monitor.stop_background()
+    _run_scheduler(sched, pods, chunk=chunk)
+    sched, pods = build()
+    sched.extender.monitor.stop_background()
+    t0 = time.perf_counter()
+    bound, times = _run_scheduler(sched, pods, chunk=chunk)
+    elapsed = time.perf_counter() - t0
+    p50, p99 = _percentiles(times)
+    return {
+        "scenario": name,
+        "pods_per_sec": round(len(pods) / elapsed, 1),
+        "placed": bound,
+        "total": len(pods),
+        "batch_p50_ms": round(p50, 2),
+        "batch_p99_ms": round(p99, 2),
+    }
+
+
+def bench_loadaware():
+    import jax.numpy as jnp
+
+    import bench as headline
+    from koordinator_tpu.ops.solver import (
+        NodeState,
+        PodBatch,
+        SolverParams,
+        solve_stream,
+    )
+
+    fix = headline.build_fixture()
+    nodes = NodeState.create(
+        allocatable=fix["alloc"],
+        estimated_used=fix["est_used"],
+        prod_used=fix["prod_used"],
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.asarray(headline.THRESHOLDS, jnp.float32),
+        prod_thresholds=jnp.zeros(2, jnp.float32),
+        score_weights=jnp.ones(2, jnp.float32),
+    )
+    import jax
+
+    b, p = 64, 512
+    stacked = PodBatch.create(
+        requests=fix["req"], estimate=fix["est"],
+        priority=fix["prio"], is_prod=fix["is_prod"],
+    )
+    stacked = jax.tree.map(lambda a: a.reshape((b, p) + a.shape[1:]), stacked)
+    solve_stream(stacked, nodes, params, max_rounds=12, approx_topk=True)
+    # per-batch latency: single 512-pod assign against the live table
+    from koordinator_tpu.ops.solver import assign
+
+    single = jax.tree.map(lambda a: a[0], stacked)
+    r = assign(single, nodes, params, max_rounds=12, approx_topk=True)
+    np.asarray(r.assignment)   # compile warmup for the single-batch shape
+    lat = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        r = assign(single, nodes, params, max_rounds=12, approx_topk=True)
+        np.asarray(r.assignment)
+        lat.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    _, _, placed, _ = solve_stream(
+        stacked, nodes, params, max_rounds=12, approx_topk=True
+    )
+    total_placed = int(np.asarray(placed).sum())
+    elapsed = time.perf_counter() - t0
+    p50, p99 = _percentiles(lat)
+    return {
+        "scenario": "loadaware_10k_nodes",
+        "pods_per_sec": round(32768 / elapsed, 1),
+        "placed": total_placed,
+        "total": 32768,
+        "batch_p50_ms": round(p50, 2),
+        "batch_p99_ms": round(p99, 2),
+    }
+
+
+def bench_numa():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.core.topology import CPUTopology
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+    from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+        NUMAManager,
+        NUMAPolicy,
+    )
+
+    n_nodes, n_pods = 500, 4000
+    topo = CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=16)
+
+    def build():
+        snap = ClusterSnapshot()
+        numa = NUMAManager(snap)
+        for i in range(n_nodes):
+            name = f"n{i:04d}"
+            snap.upsert_node(
+                Node(
+                    meta=ObjectMeta(name=name),
+                    status=NodeStatus(
+                        allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+                    ),
+                )
+            )
+            numa.register_node(
+                name, topo, NUMAPolicy.SINGLE_NUMA_NODE, memory_per_zone_mib=131072
+            )
+        pods = [
+            Pod(
+                meta=ObjectMeta(
+                    name=f"p{i:05d}",
+                    labels={ext.LABEL_POD_QOS: "LSR"},
+                ),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                    priority=9500,
+                ),
+            )
+            for i in range(n_pods)
+        ]
+        sched = BatchScheduler(snap, LoadAwareArgs(), numa=numa, batch_bucket=1024)
+        return sched, pods
+
+    return _measure(build, 1024, "numa_binpack_2socket")
+
+
+def bench_device_gang():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        Device,
+        DeviceInfo,
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+    from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+
+    n_nodes, n_gangs = 200, 200    # 2 members x 4 GPUs each = one node per gang
+
+    def build():
+        snap = ClusterSnapshot()
+        dm = DeviceManager(snap)
+        for i in range(n_nodes):
+            name = f"g{i:04d}"
+            snap.upsert_node(
+                Node(
+                    meta=ObjectMeta(name=name),
+                    status=NodeStatus(
+                        allocatable={ext.RES_CPU: 128000, ext.RES_MEMORY: 1 << 20}
+                    ),
+                )
+            )
+            dm.upsert_device(
+                Device(
+                    meta=ObjectMeta(name=name),
+                    devices=[
+                        DeviceInfo(dev_type="gpu", minor=g, numa_node=g // 4)
+                        for g in range(8)
+                    ],
+                )
+            )
+        pods = []
+        for g in range(n_gangs):
+            for m in range(2):
+                pods.append(
+                    Pod(
+                        meta=ObjectMeta(
+                            name=f"gang{g:04d}-{m}",
+                            labels={
+                                ext.LABEL_GANG_NAME: f"gang-{g}",
+                                ext.LABEL_GANG_MIN_AVAILABLE: "2",
+                            },
+                        ),
+                        spec=PodSpec(
+                            requests={
+                                ext.RES_CPU: 16000,
+                                ext.RES_MEMORY: 65536,
+                                ext.RES_GPU: 4,
+                            },
+                            priority=9000,
+                        ),
+                    )
+                )
+        sched = BatchScheduler(snap, LoadAwareArgs(), devices=dm, batch_bucket=512)
+        return sched, pods
+
+    return _measure(build, 512, "device_gang_8gpu")
+
+
+def bench_quota_tree():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import ElasticQuota, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+    from koordinator_tpu.sim.cluster_gen import GenConfig, gen_nodes
+
+    def build():
+        cfg = GenConfig(n_nodes=2000, n_pods=0, seed=5)
+        nodes, metrics = gen_nodes(cfg)
+        snap = ClusterSnapshot()
+        for n in nodes:
+            snap.upsert_node(n)
+        for m in metrics:
+            snap.set_node_metric(m, now=m.update_time + 1 if m.update_time else 1.0)
+        gqm = GroupQuotaManager(snap.config)
+        # 3-level tree: root -> 4 orgs -> 4 teams each
+        for org in range(4):
+            gqm.upsert_quota(
+                ElasticQuota(
+                    meta=ObjectMeta(name=f"org-{org}"),
+                    min={ext.RES_CPU: 2_000_000, ext.RES_MEMORY: 8 << 20},
+                    max={ext.RES_CPU: 16_000_000, ext.RES_MEMORY: 64 << 20},
+                    is_parent=True,
+                )
+            )
+            for team in range(4):
+                gqm.upsert_quota(
+                    ElasticQuota(
+                        meta=ObjectMeta(name=f"org-{org}-team-{team}"),
+                        min={ext.RES_CPU: 400_000, ext.RES_MEMORY: 2 << 20},
+                        max={ext.RES_CPU: 8_000_000, ext.RES_MEMORY: 32 << 20},
+                        parent=f"org-{org}",
+                    )
+                )
+        rng = np.random.default_rng(9)
+        n_pods = 16_384
+        pods = []
+        for i in range(n_pods):
+            org, team = rng.integers(0, 4), rng.integers(0, 4)
+            cpu = int(rng.choice([500, 1000, 2000]))
+            pods.append(
+                Pod(
+                    meta=ObjectMeta(
+                        name=f"q{i:05d}",
+                        labels={ext.LABEL_QUOTA_NAME: f"org-{org}-team-{team}"},
+                    ),
+                    spec=PodSpec(
+                        requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu * 2},
+                        priority=int(rng.integers(5000, 9999)),
+                    ),
+                )
+            )
+        sched = BatchScheduler(snap, LoadAwareArgs(), quotas=gqm, batch_bucket=4096)
+        return sched, pods
+
+    return _measure(build, 4096, "quota_tree_3level")
+
+
+SCENARIOS = {
+    "loadaware": bench_loadaware,
+    "numa": bench_numa,
+    "device_gang": bench_device_gang,
+    "quota_tree": bench_quota_tree,
+}
+
+
+def main() -> None:
+    wanted = sys.argv[1:] or list(SCENARIOS)
+    results = []
+    for name in wanted:
+        res = SCENARIOS[name]()
+        results.append(res)
+        print(json.dumps(res))
+    with open("BENCH_SUITE.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
