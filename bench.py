@@ -19,7 +19,8 @@ import time
 import numpy as np
 
 N_NODES = 10_000
-N_PODS = 32_768          # solved in priority order, one device batch at a time
+N_PODS = 98_304          # ~the BASELINE north-star scale (100k pending),
+                         # solved in priority order batch by batch
 BATCH = 512              # small batches ≈ sequential fidelity; the whole
                          # stream is one on-device scan, so batch count is
                          # free of host dispatch cost (see solve_stream)
