@@ -1,0 +1,194 @@
+"""End-to-end colocation flow — BASELINE config #1 (the reference's
+``examples/spark-jobs`` demo) run through the whole §3.3 feedback loop:
+
+  admission webhook (ClusterColocationProfile) mutates Spark pods to BE
+  → noderesource controller computes kubernetes.io/batch-* from prod peak
+  → scheduler places the BE pods against batch resources
+  → koordlet runtimehooks derive the on-node cgroup plan (bvt, shares)
+  → prod load rises → batch capacity shrinks, qosmanager suppresses BE,
+    descheduler LowNodeLoad selects BE victims and a migration job starts.
+
+One test per arrow would hide integration seams; this file drives the whole
+loop over a shared cluster state exactly like the reference e2e suite does
+over kind (``test/e2e/slocontroller``).
+"""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.extension import QoSClass
+from koordinator_tpu.api.types import (
+    ClusterColocationProfile,
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceMetric,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.descheduler.low_node_load import LowNodeLoad, LowNodeLoadArgs
+from koordinator_tpu.descheduler.migration import MigrationController
+from koordinator_tpu.koordlet import qosmanager, runtimehooks
+from koordinator_tpu.manager.noderesource import (
+    ColocationStrategy,
+    NodeResourceController,
+)
+from koordinator_tpu.manager.profile import ProfileMutator
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+
+N_NODES = 8
+ALLOC_CPU = 64_000.0
+ALLOC_MEM = 256 * 1024.0
+
+
+def build_cluster(prod_util=0.3):
+    snap = ClusterSnapshot()
+    for i in range(N_NODES):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: ALLOC_CPU, ext.RES_MEMORY: ALLOC_MEM}
+                ),
+            )
+        )
+        report_usage(snap, f"n{i}", prod_util, now=1000.0)
+    return snap
+
+
+def report_usage(snap, node, prod_util, now):
+    usage = {
+        ext.RES_CPU: ALLOC_CPU * prod_util,
+        ext.RES_MEMORY: ALLOC_MEM * prod_util * 0.8,
+    }
+    snap.set_node_metric(
+        NodeMetric(
+            meta=ObjectMeta(name=node),
+            node_usage=ResourceMetric(usage=dict(usage)),
+            prod_usage=ResourceMetric(usage=dict(usage)),
+            update_time=now - 1,
+        ),
+        now=now,
+    )
+
+
+def spark_profile():
+    return ClusterColocationProfile(
+        meta=ObjectMeta(name="colocation-spark"),
+        selector={"koordinator.sh/enable-colocation": "true"},
+        qos_class=QoSClass.BE,
+        priority=5500,
+        scheduler_name="koord-scheduler",
+        labels={"mutated-by": "colocation-profile"},
+        resource_translation={
+            ext.RES_CPU: ext.RES_BATCH_CPU,
+            ext.RES_MEMORY: ext.RES_BATCH_MEMORY,
+        },
+    )
+
+
+def spark_pod(i):
+    return Pod(
+        meta=ObjectMeta(
+            name=f"spark-executor-{i}",
+            namespace="spark",
+            labels={"koordinator.sh/enable-colocation": "true", "app": "spark"},
+        ),
+        spec=PodSpec(requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192}),
+    )
+
+
+def test_full_colocation_loop():
+    snap = build_cluster(prod_util=0.3)
+
+    # ---- 1. admission: profile turns Spark pods into BE batch pods ----
+    mutator = ProfileMutator()
+    mutator.upsert(spark_profile())
+    pods = [mutator.mutate(spark_pod(i)) for i in range(16)]
+    for p in pods:
+        assert p.qos is QoSClass.BE
+        assert p.spec.priority == 5500
+        assert ext.RES_BATCH_CPU in p.spec.requests
+        assert ext.RES_CPU not in p.spec.requests
+        assert p.meta.labels["mutated-by"] == "colocation-profile"
+
+    # ---- 2. slo-controller: batch capacity from prod peak ----
+    ctrl = NodeResourceController(snap, ColocationStrategy(reserve_ratio=0.1))
+    published = ctrl.reconcile()
+    bc = snap.config.resources.index(ext.RES_BATCH_CPU)
+    rows = [snap.node_id(f"n{i}") for i in range(N_NODES)]
+    # batch = alloc*(1-reserve) - prod_peak = 64000*0.9 - 19200 = 38400
+    np.testing.assert_allclose(
+        snap.nodes.allocatable[rows, bc], 38400.0, rtol=1e-5
+    )
+    assert published["n0"][ext.RES_BATCH_CPU] > 0
+
+    # ---- 3. scheduler: BE pods land against batch resources ----
+    sched = BatchScheduler(snap, LoadAwareArgs(), batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    out = sched.schedule(pods)
+    assert len(out.bound) == 16
+    assert len({n for _, n in out.bound}) > 1  # spread, not piled
+
+    # batch consumption is visible in the snapshot's requested tensor
+    assert snap.nodes.requested[rows, bc].sum() == 16 * 4000
+
+    # ---- 4. koordlet: cgroup plan for a bound BE pod ----
+    bound_pod, node = out.bound[0]
+    plan = runtimehooks.pod_plan(bound_pod)
+    # group identity: BE pods get the lowest bvt tier; batchresource: shares
+    rendered = str(plan)
+    assert "bvt" in rendered
+    assert "cpu" in rendered
+
+    # ---- 5. prod load rises: batch shrinks, BE suppressed, victims ----
+    for i in range(2):  # two hot nodes
+        report_usage(snap, f"n{i}", prod_util=0.85, now=2000.0)
+    ctrl.reconcile()
+    hot = snap.node_id("n0")
+    # batch capacity collapsed on the hot node (0.9*64000 - 0.85*64000)
+    assert snap.nodes.allocatable[hot, bc] < 4000
+
+    # qosmanager: BE allowance shrinks to the suppression leftovers
+    dec = qosmanager.cpu_suppress(
+        node_allocatable_milli=ALLOC_CPU,
+        node_used_milli=0.85 * ALLOC_CPU + 8000,
+        be_used_milli=8000,
+        threshold_percent=65.0,
+    )
+    assert dec.be_allowance_milli < 8000  # squeezed below current BE usage
+
+    # descheduler: hot nodes flagged (after debounce), BE pods are victims
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(
+            high_thresholds={ext.RES_CPU: 70.0},
+            low_thresholds={ext.RES_CPU: 45.0},
+            anomaly_condition_count=2,
+        ),
+    )
+    lnl.classify()               # debounce tick 1
+    cls = lnl.classify()         # tick 2: sticky-high now
+    assert cls.high[hot]
+    for p, n in out.bound:     # Bind writes spec.nodeName
+        p.spec.node_name = n
+    hot_bound = [p for p, n in out.bound if n in ("n0", "n1")]
+    victims = lnl.select_victims(hot_bound)
+    assert victims, "no victims selected from overloaded nodes"
+    assert all(v.qos is QoSClass.BE for v in victims)
+
+    # migration: reservation-first job submitted and driven — a
+    # Reservation for the replacement goes Available, then the victim is
+    # evicted (ReservationFirst mode, reference controllers/migration)
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+    evicted = []
+    rm = ReservationManager(sched)
+    mc = MigrationController(rm, evict_fn=lambda pod, reason: evicted.append(pod))
+    job = mc.submit(victims[0])
+    assert job is not None
+    mc.reconcile(now=3000.0)
+    mc.reconcile(now=3001.0)
+    assert evicted and evicted[0].meta.uid == victims[0].meta.uid
