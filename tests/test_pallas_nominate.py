@@ -1,0 +1,97 @@
+"""Fused Pallas nomination vs the XLA reference path (interpret mode on
+CPU; the same kernel compiles for TPU — see bench notes in the module)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.ops import costs as cost_ops, masks as mask_ops
+from koordinator_tpu.ops.pallas_nominate import nominate_fused
+
+from test_solver import make_fixture
+
+
+def reference_nomination(pods, nodes, params, topk, jitter):
+    p = pods.requests.shape[0]
+    n = nodes.allocatable.shape[0]
+    free = nodes.allocatable - nodes.requested
+    feas = mask_ops.fit_mask(pods.requests, free)
+    feas &= mask_ops.usage_threshold_mask(
+        pods.estimate, nodes.estimated_used, nodes.allocatable,
+        params.usage_thresholds, nodes.metric_fresh,
+    )
+    feas &= nodes.schedulable[None, :]
+    cost = cost_ops.load_aware_cost(
+        pods.estimate, nodes.estimated_used, nodes.allocatable,
+        params.score_weights,
+    )
+    if jitter > 0:
+        pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
+        ni = jnp.arange(n, dtype=jnp.uint32)[None, :]
+        h = (pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)) & jnp.uint32(
+            0xFFFF
+        )
+        cost = cost + h.astype(jnp.float32) * (jitter / 65536.0)
+    cost = jnp.where(feas, cost, jnp.inf)
+    return jax.lax.top_k(-cost, topk)
+
+
+def run_both(p, n, seed, jitter=4.0, topk=4, **fixture_kw):
+    pods, nodes, params, _ = make_fixture(p=p, n=n, seed=seed, **fixture_kw)
+    want_neg, want_idx = reference_nomination(pods, nodes, params, topk, jitter)
+    got_neg, got_idx = nominate_fused(
+        pods.requests, pods.estimate,
+        nodes.allocatable, nodes.requested, nodes.estimated_used,
+        nodes.schedulable, nodes.metric_fresh,
+        params.usage_thresholds, params.score_weights,
+        topk=topk, nomination_jitter=jitter, interpret=True,
+    )
+    return (
+        np.asarray(got_neg), np.asarray(got_idx),
+        np.asarray(want_neg), np.asarray(want_idx),
+    )
+
+
+def test_matches_xla_nomination():
+    got_neg, got_idx, want_neg, want_idx = run_both(
+        p=48, n=640, seed=3, base_util=0.3, thresholds=(65.0, 95.0)
+    )
+    finite = np.isfinite(want_neg)
+    np.testing.assert_allclose(
+        got_neg[finite], want_neg[finite], rtol=1e-5, atol=1e-4
+    )
+    np.testing.assert_array_equal(got_idx[finite], want_idx[finite])
+    # infeasible slots: kernel reports -1
+    assert (got_idx[~finite] == -1).all()
+
+
+def test_no_feasible_nodes_all_minus_one():
+    got_neg, got_idx, want_neg, _ = run_both(
+        p=16, n=512, seed=4, pod_scale=10_000.0
+    )
+    assert not np.isfinite(want_neg).any()
+    assert (got_idx == -1).all()
+
+
+def test_ragged_shapes_pad_correctly():
+    # P and N not multiples of the tile sizes: padded nodes must never
+    # be nominated, padded pods are sliced off
+    got_neg, got_idx, want_neg, want_idx = run_both(
+        p=33, n=700, seed=5, base_util=0.2
+    )
+    assert got_idx.shape == (33, 4)
+    assert (got_idx < 700).all()
+    finite = np.isfinite(want_neg)
+    np.testing.assert_allclose(
+        got_neg[finite], want_neg[finite], rtol=1e-5, atol=1e-4
+    )
+    np.testing.assert_array_equal(got_idx[finite], want_idx[finite])
+
+
+def test_zero_jitter_strict_argmin():
+    got_neg, got_idx, want_neg, want_idx = run_both(
+        p=24, n=512, seed=6, jitter=0.0, topk=1, base_util=0.1
+    )
+    finite = np.isfinite(want_neg)
+    np.testing.assert_array_equal(got_idx[finite], want_idx[finite])
