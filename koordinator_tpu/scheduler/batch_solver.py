@@ -367,6 +367,10 @@ class BatchScheduler:
 
         if self.quotas.quota_count == 0:
             return None
+        # The fair-sharing budget is the live cluster capacity (without it
+        # water-fill degenerates to min(min, request) and admission sticks
+        # at the guaranteed tier).
+        self.quotas.sync_cluster_total(self.snapshot)
         # Propagate desired requests (pending + admitted) up the tree so
         # fair sharing reflects demand, then refresh runtime.
         by_leaf: Dict[str, np.ndarray] = {}

@@ -176,8 +176,23 @@ class GroupQuotaManager:
         self._dirty = True
 
     def set_cluster_total(self, total: Mapping[str, float]) -> None:
+        """Explicit capacity budget (the multi-tree handler gives each tree
+        its slice this way). Disables snapshot auto-sync."""
         self._cluster_total = self.config.res_vector(total)
+        self._explicit_total = True
         self._dirty = True
+
+    def sync_cluster_total(self, snapshot) -> None:
+        """Track the cluster's aggregate allocatable as the fair-sharing
+        budget (the reference GroupQuotaManager recomputes its total from
+        node add/update/delete events, ``group_quota_manager.go``). No-op
+        once an explicit total was set (multi-tree budgets own it then)."""
+        if getattr(self, "_explicit_total", False):
+            return
+        total = snapshot.nodes.allocatable.sum(axis=0).astype(np.float32)
+        if not np.array_equal(total, self._cluster_total):
+            self._cluster_total = total
+            self._dirty = True
 
     def update_cluster_total(self, delta: np.ndarray) -> None:
         """Shift capacity by a delta vector (multi-tree rebalancing —

@@ -520,3 +520,60 @@ def test_ignore_default_tree_flag_flips_reconcile():
     np.testing.assert_allclose(h.default_manager.cluster_total, [60.0, 60.0])
     h.on_quota_delete(_tree_root("a-root", "tree-a", 40, ignore=True))
     np.testing.assert_allclose(h.default_manager.cluster_total, [100.0, 100.0])
+
+
+def test_scheduler_runtime_expands_beyond_min_with_cluster_capacity():
+    """The BatchScheduler path must feed cluster capacity into the
+    fair-sharing budget: with ample free capacity, a quota whose demand
+    exceeds its min gets runtime toward max, not min (reference
+    group_quota_manager recomputing total from node events — without the
+    sync, admission sticks at the guaranteed tier)."""
+    import jax
+
+    from koordinator_tpu.api.types import Node, NodeMetric, NodeStatus, ResourceMetric
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+
+    snap = ClusterSnapshot()
+    for i in range(8):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 1 << 18}
+                ),
+            )
+        )
+        snap.set_node_metric(
+            NodeMetric(
+                meta=ObjectMeta(name=f"n{i}"),
+                node_usage=ResourceMetric(usage={ext.RES_CPU: 0, ext.RES_MEMORY: 0}),
+                update_time=999.0,
+            ),
+            now=1000.0,
+        )
+    mgr = GroupQuotaManager(snap.config)
+    mgr.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="team"),
+            min={ext.RES_CPU: 8000, ext.RES_MEMORY: 1 << 14},
+            max={ext.RES_CPU: 256000, ext.RES_MEMORY: 1 << 20},
+        )
+    )
+    pods = [
+        Pod(
+            meta=ObjectMeta(
+                name=f"p{i}", labels={ext.LABEL_QUOTA_NAME: "team"}
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 2000, ext.RES_MEMORY: 4096},
+                priority=9000,
+            ),
+        )
+        for i in range(32)   # 64000m demand >> 8000m min
+    ]
+    sched = BatchScheduler(snap, LoadAwareArgs(), quotas=mgr, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    out = sched.schedule(pods)
+    # min admits only 4 pods; cluster-capacity fair sharing admits all 32
+    assert len(out.bound) == 32, (len(out.bound), len(out.unschedulable))
