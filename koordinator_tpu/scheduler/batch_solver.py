@@ -358,6 +358,10 @@ class BatchScheduler:
             devices=device_state,
             max_rounds=self.max_rounds,
             cost_transform=self.extender.cost_transform,
+            # TPU-optimized partial top-k with the exact argmin pinned in
+            # slot 0 (see ops.solver) — same nominations contract, avoids
+            # lax.top_k's full variadic sort per round
+            approx_topk=True,
         )
 
     def quota_state(self, chunk: Sequence[Pod]) -> Optional[QuotaState]:
