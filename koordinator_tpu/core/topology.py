@@ -101,6 +101,16 @@ class CPUAccumulator:
         self._allocated: Set[int] = set()
         #: pod uid -> cpu ids
         self._owners: Dict[str, Set[int]] = {}
+        # static topology facts, computed once — recomputing them per
+        # take() made the accumulator the host-path hot spot (O(cpus ×
+        # cores) scans per winner)
+        core_counts: Dict[int, int] = {}
+        socket_counts: Dict[int, int] = {}
+        for c in topology.cpus:
+            core_counts[c.core_id] = core_counts.get(c.core_id, 0) + 1
+            socket_counts[c.socket] = socket_counts.get(c.socket, 0) + 1
+        self._threads_per_core = max(core_counts.values(), default=1)
+        self._socket_size = max(socket_counts.values(), default=1)
 
     @property
     def available(self) -> List[CPUInfo]:
@@ -131,10 +141,7 @@ class CPUAccumulator:
         by_core: Dict[int, List[CPUInfo]] = {}
         for c in avail:
             by_core.setdefault(c.core_id, []).append(c)
-        threads_per_core = max(
-            (sum(1 for x in self.topology.cpus if x.core_id == cid))
-            for cid in by_core
-        )
+        threads_per_core = self._threads_per_core
         full_cores = {
             cid: cs for cid, cs in by_core.items() if len(cs) == threads_per_core
         }
@@ -169,10 +176,7 @@ class CPUAccumulator:
             by_socket: Dict[int, List[CPUInfo]] = {}
             for c in avail:
                 by_socket.setdefault(c.socket, []).append(c)
-            socket_size = max(
-                sum(1 for x in self.topology.cpus if x.socket == s)
-                for s in by_socket
-            )
+            socket_size = self._socket_size
             for s in sorted(by_socket):
                 cs = by_socket[s]
                 if len(cs) == socket_size and n_cpus - len(taken) >= socket_size:
