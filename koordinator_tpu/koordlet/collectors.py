@@ -43,7 +43,32 @@ class _CpuTimes(ctypes.Structure):
     ]
 
 
+def _build_native() -> bool:
+    """One-shot lazy build of the telemetry library (make -C runtime).
+    The .so is a build artifact (gitignored), so a fresh checkout arms the
+    native path on first use; failure is fine — the pure-Python readers
+    take over."""
+    import subprocess
+
+    runtime_dir = os.path.dirname(os.path.dirname(_LIB_PATH))
+    if not os.path.exists(os.path.join(runtime_dir, "Makefile")):
+        return False
+    try:
+        return (
+            subprocess.run(
+                ["make", "-C", runtime_dir],
+                capture_output=True,
+                timeout=60,
+            ).returncode
+            == 0
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
 def _load_native() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_LIB_PATH) and not _build_native():
+        return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
