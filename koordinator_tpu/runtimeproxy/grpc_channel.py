@@ -1,0 +1,282 @@
+"""gRPC transport for the RuntimeHookService channel.
+
+The reference's proxy talks to hook servers over gRPC on a unix socket
+(``pkg/runtimeproxy/dispatcher`` → registered ``RuntimeHookServer``
+addresses; koordlet's ``runtimehooks/proxyserver`` is the other end).
+This module is that wire path: :func:`serve_hooks` exposes a hook handler
+(e.g. :class:`..runtimeproxy.hookserver.KoordletHookServer`'s ``handle``)
+as a gRPC service, and :class:`RemoteHookHandler` is the proxy-side
+callable that plugs into a ``HookServerRegistration`` — the dispatcher
+cannot tell a remote server from an in-process one.
+
+Like the snapshot channel, the service is registered through
+``grpc.method_handlers_generic_handler`` (the image ships protoc without
+the grpc python plugin); the wire contract is
+``runtime/proto/runtimehook.proto``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+from ..runtime.proto import runtimehook_pb2 as pb
+from .proto import (
+    ContainerMetadata,
+    ContainerResourceHookRequest,
+    ContainerResourceHookResponse,
+    LinuxContainerResources,
+    PodSandboxHookRequest,
+    PodSandboxHookResponse,
+    PodSandboxMetadata,
+    RuntimeHookType,
+)
+
+SERVICE_NAME = "koordinator_tpu.runtimeproxy.RuntimeHookService"
+
+#: hook -> (rpc name, request kind); sandbox hooks ride
+#: PodSandboxHookRequest, container hooks ContainerResourceHookRequest
+_SANDBOX_HOOKS = (
+    RuntimeHookType.PRE_RUN_POD_SANDBOX,
+    RuntimeHookType.POST_STOP_POD_SANDBOX,
+)
+
+
+def _is_sandbox(hook: RuntimeHookType) -> bool:
+    return hook in _SANDBOX_HOOKS
+
+
+# ---- dataclass <-> pb codecs ----
+
+
+def _res_to_pb(res: Optional[LinuxContainerResources]) -> pb.LinuxContainerResources:
+    out = pb.LinuxContainerResources()
+    if res is None:
+        return out
+    for f in dataclasses.fields(res):
+        val = getattr(res, f.name)
+        if f.name == "unified":
+            out.unified.update(val)
+        elif val:
+            setattr(out, f.name, val)
+    return out
+
+
+def _res_from_pb(msg: pb.LinuxContainerResources) -> Optional[LinuxContainerResources]:
+    res = LinuxContainerResources(
+        cpu_period=msg.cpu_period,
+        cpu_quota=msg.cpu_quota,
+        cpu_shares=msg.cpu_shares,
+        memory_limit_in_bytes=msg.memory_limit_in_bytes,
+        oom_score_adj=msg.oom_score_adj,
+        cpuset_cpus=msg.cpuset_cpus,
+        cpuset_mems=msg.cpuset_mems,
+        unified=dict(msg.unified),
+    )
+    if not any(dataclasses.asdict(res).values()):
+        return None
+    return res
+
+
+def sandbox_req_to_pb(req: PodSandboxHookRequest) -> pb.PodSandboxHookRequest:
+    msg = pb.PodSandboxHookRequest(
+        runtime_handler=req.runtime_handler,
+        cgroup_parent=req.cgroup_parent,
+    )
+    msg.pod_meta.name = req.pod_meta.name
+    msg.pod_meta.uid = req.pod_meta.uid
+    msg.pod_meta.namespace = req.pod_meta.namespace
+    msg.pod_meta.attempt = req.pod_meta.attempt
+    msg.labels.update(req.labels)
+    msg.annotations.update(req.annotations)
+    msg.overhead.CopyFrom(_res_to_pb(req.overhead))
+    msg.resources.CopyFrom(_res_to_pb(req.resources))
+    return msg
+
+
+def sandbox_req_from_pb(msg: pb.PodSandboxHookRequest) -> PodSandboxHookRequest:
+    return PodSandboxHookRequest(
+        pod_meta=PodSandboxMetadata(
+            name=msg.pod_meta.name,
+            uid=msg.pod_meta.uid,
+            namespace=msg.pod_meta.namespace or "default",
+            attempt=msg.pod_meta.attempt,
+        ),
+        runtime_handler=msg.runtime_handler,
+        labels=dict(msg.labels),
+        annotations=dict(msg.annotations),
+        cgroup_parent=msg.cgroup_parent,
+        overhead=_res_from_pb(msg.overhead),
+        resources=_res_from_pb(msg.resources),
+    )
+
+
+def sandbox_resp_to_pb(
+    resp: Optional[PodSandboxHookResponse],
+) -> pb.PodSandboxHookResponse:
+    msg = pb.PodSandboxHookResponse()
+    if resp is None:
+        return msg
+    msg.labels.update(resp.labels)
+    msg.annotations.update(resp.annotations)
+    msg.cgroup_parent = resp.cgroup_parent
+    msg.resources.CopyFrom(_res_to_pb(resp.resources))
+    return msg
+
+
+def sandbox_resp_from_pb(msg: pb.PodSandboxHookResponse) -> PodSandboxHookResponse:
+    return PodSandboxHookResponse(
+        labels=dict(msg.labels),
+        annotations=dict(msg.annotations),
+        cgroup_parent=msg.cgroup_parent,
+        resources=_res_from_pb(msg.resources),
+    )
+
+
+def container_req_to_pb(
+    req: ContainerResourceHookRequest,
+) -> pb.ContainerResourceHookRequest:
+    msg = pb.ContainerResourceHookRequest(
+        pod_cgroup_parent=req.pod_cgroup_parent,
+    )
+    msg.pod_meta.name = req.pod_meta.name
+    msg.pod_meta.uid = req.pod_meta.uid
+    msg.pod_meta.namespace = req.pod_meta.namespace
+    msg.container_meta.name = req.container_meta.name
+    msg.container_meta.id = req.container_meta.id
+    msg.container_meta.attempt = req.container_meta.attempt
+    msg.container_annotations.update(req.container_annotations)
+    msg.pod_labels.update(req.pod_labels)
+    msg.pod_annotations.update(req.pod_annotations)
+    msg.container_envs.update(req.container_envs)
+    msg.container_resources.CopyFrom(_res_to_pb(req.container_resources))
+    return msg
+
+
+def container_req_from_pb(
+    msg: pb.ContainerResourceHookRequest,
+) -> ContainerResourceHookRequest:
+    return ContainerResourceHookRequest(
+        pod_meta=PodSandboxMetadata(
+            name=msg.pod_meta.name,
+            uid=msg.pod_meta.uid,
+            namespace=msg.pod_meta.namespace or "default",
+        ),
+        container_meta=ContainerMetadata(
+            name=msg.container_meta.name,
+            id=msg.container_meta.id,
+            attempt=msg.container_meta.attempt,
+        ),
+        container_annotations=dict(msg.container_annotations),
+        container_resources=_res_from_pb(msg.container_resources),
+        pod_labels=dict(msg.pod_labels),
+        pod_annotations=dict(msg.pod_annotations),
+        pod_cgroup_parent=msg.pod_cgroup_parent,
+        container_envs=dict(msg.container_envs),
+    )
+
+
+def container_resp_to_pb(
+    resp: Optional[ContainerResourceHookResponse],
+) -> pb.ContainerResourceHookResponse:
+    msg = pb.ContainerResourceHookResponse()
+    if resp is None:
+        return msg
+    msg.container_annotations.update(resp.container_annotations)
+    msg.pod_cgroup_parent = resp.pod_cgroup_parent
+    msg.container_envs.update(resp.container_envs)
+    msg.container_resources.CopyFrom(_res_to_pb(resp.container_resources))
+    return msg
+
+
+def container_resp_from_pb(
+    msg: pb.ContainerResourceHookResponse,
+) -> ContainerResourceHookResponse:
+    return ContainerResourceHookResponse(
+        container_annotations=dict(msg.container_annotations),
+        container_resources=_res_from_pb(msg.container_resources),
+        pod_cgroup_parent=msg.pod_cgroup_parent,
+        container_envs=dict(msg.container_envs),
+    )
+
+
+# ---- server side (koordlet hook server behind gRPC) ----
+
+
+def serve_hooks(
+    handler: Callable[[RuntimeHookType, object], object],
+    address: str = "127.0.0.1:0",
+    max_workers: int = 4,
+) -> tuple[grpc.Server, int]:
+    """Expose ``handler(hook_type, dataclass_request) -> dataclass|None``
+    as the RuntimeHookService; returns (server, bound_port)."""
+
+    def method(hook: RuntimeHookType):
+        if _is_sandbox(hook):
+            def call(req_pb, _ctx):
+                resp = handler(hook, sandbox_req_from_pb(req_pb))
+                return sandbox_resp_to_pb(resp)
+
+            return grpc.unary_unary_rpc_method_handler(
+                call,
+                request_deserializer=pb.PodSandboxHookRequest.FromString,
+                response_serializer=pb.PodSandboxHookResponse.SerializeToString,
+            )
+
+        def call(req_pb, _ctx):
+            resp = handler(hook, container_req_from_pb(req_pb))
+            return container_resp_to_pb(resp)
+
+        return grpc.unary_unary_rpc_method_handler(
+            call,
+            request_deserializer=pb.ContainerResourceHookRequest.FromString,
+            response_serializer=pb.ContainerResourceHookResponse.SerializeToString,
+        )
+
+    handlers = {hook.value: method(hook) for hook in RuntimeHookType}
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port
+
+
+# ---- proxy side (remote hook handler for the dispatcher) ----
+
+
+class RemoteHookHandler:
+    """Proxy-side callable for a remote hook server: drop-in for the
+    ``handler`` of a ``HookServerRegistration`` — serializes the request,
+    calls the RPC, returns the dataclass response. gRPC errors propagate
+    so the dispatcher's failure policy decides (Fail aborts the CRI call,
+    Ignore proceeds)."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+        self._stubs = {}
+        for hook in RuntimeHookType:
+            if _is_sandbox(hook):
+                self._stubs[hook] = self._channel.unary_unary(
+                    f"/{SERVICE_NAME}/{hook.value}",
+                    request_serializer=pb.PodSandboxHookRequest.SerializeToString,
+                    response_deserializer=pb.PodSandboxHookResponse.FromString,
+                )
+            else:
+                self._stubs[hook] = self._channel.unary_unary(
+                    f"/{SERVICE_NAME}/{hook.value}",
+                    request_serializer=pb.ContainerResourceHookRequest.SerializeToString,
+                    response_deserializer=pb.ContainerResourceHookResponse.FromString,
+                )
+
+    def __call__(self, hook: RuntimeHookType, request):
+        if _is_sandbox(hook):
+            return sandbox_resp_from_pb(self._stubs[hook](sandbox_req_to_pb(request)))
+        return container_resp_from_pb(self._stubs[hook](container_req_to_pb(request)))
+
+    def close(self) -> None:
+        self._channel.close()

@@ -232,3 +232,64 @@ def test_gc_group_is_path_boundary_aware(tmp_path):
     assert ("kubepods/pod-web-1", rex.CPU_SHARES) not in executor._cache
     assert ("kubepods/pod-web-1/sub", rex.CPU_SHARES) not in executor._cache
     assert ("kubepods/pod-web-10", rex.CPU_SHARES) in executor._cache
+
+
+def test_grpc_hook_channel_end_to_end(tmp_path):
+    """The reference topology over the real wire: kubelet → proxy →
+    (gRPC, runtimehook.proto) → koordlet hook server → cgroup writes —
+    the dispatcher can't tell a RemoteHookHandler from an in-process
+    registration, and the merged response rides the wire back."""
+    from koordinator_tpu.runtimeproxy.config import (
+        FailurePolicy,
+        HookServerRegistration,
+    )
+    from koordinator_tpu.runtimeproxy.grpc_channel import (
+        RemoteHookHandler,
+        serve_hooks,
+    )
+    from koordinator_tpu.runtimeproxy.proto import RuntimeHookType
+
+    executor = rex.ResourceExecutor(cgroup_root=str(tmp_path))
+    hooks = KoordletHookServer(executor)
+    server, port = serve_hooks(hooks.handle)
+    remote = RemoteHookHandler(f"127.0.0.1:{port}")
+    try:
+        rt = FakeRuntime()
+        proxy = CRIProxy(rt)
+        proxy.dispatcher.register(
+            HookServerRegistration(
+                name="koordlet-grpc",
+                hook_types=frozenset(RuntimeHookType),
+                handler=remote,
+                failure_policy=FailurePolicy.FAIL,
+            )
+        )
+        alloc = {"gpu": [{"minor": 3}]}
+        cfg = sandbox_cfg(
+            name="be-grpc",
+            labels={ext.LABEL_POD_QOS: "BE"},
+            annotations={
+                ANNOTATION_POD_REQUESTS: json.dumps(
+                    {ext.RES_BATCH_CPU: 1000, ext.RES_BATCH_MEMORY: 512}
+                ),
+                ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(alloc),
+            },
+        )
+        pod_id = proxy.run_pod_sandbox(cfg)
+        assert executor.read("kubepods/besteffort/pod-be-grpc", rex.CPU_BVT) == "-1"
+        cid = proxy.create_container(
+            pod_id, ContainerConfig(ContainerMetadata("main"))
+        )
+        assert rt.containers[cid].envs["KOORD_VISIBLE_DEVICES"] == "3"
+        # server down + Fail policy → the CRI call aborts (reference
+        # failure policy semantics over a real broken channel)
+        server.stop(grace=None)
+        import pytest as _pytest
+
+        from koordinator_tpu.runtimeproxy.dispatcher import HookError
+
+        with _pytest.raises(HookError):
+            proxy.run_pod_sandbox(sandbox_cfg(name="after-down"))
+    finally:
+        remote.close()
+        server.stop(grace=None)
