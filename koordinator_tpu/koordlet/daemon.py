@@ -47,6 +47,10 @@ class KoordletConfig:
     n_cpus: Optional[int] = None
     node_allocatable_milli: float = 0.0      # 0 = n_cpus × 1000
     node_memory_capacity_mib: float = 0.0
+    #: directory for TSDB + prediction checkpoints ("" disables — the
+    #: agent then restarts with empty history, like the reference without
+    #: its WAL dir); checkpoints land on every report tick
+    checkpoint_dir: str = ""
 
 
 class NodeMetricReporter:
@@ -281,7 +285,45 @@ class Koordlet:
         if now - self._last_report < self.config.report_interval_s:
             return None
         self._last_report = now
+        self._checkpoint()
         return self.reporter.report(now)
+
+    def _checkpoint(self) -> None:
+        """Persist TSDB rings + prediction histograms so a restart resumes
+        with history (reference: tsdb WAL + prediction/checkpoint.go)."""
+        import os
+
+        cdir = self.config.checkpoint_dir
+        if not cdir:
+            return
+        os.makedirs(cdir, exist_ok=True)
+        try:
+            self.metric_cache.checkpoint(os.path.join(cdir, "tsdb.npz"))
+            self.predictor.checkpoint(os.path.join(cdir, "prediction.npz"))
+        except OSError:
+            pass  # a full disk must not kill the QoS loops
+
+    def restore_checkpoints(self) -> bool:
+        """Adopt checkpointed state if present; returns True if any was."""
+        import os
+
+        cdir = self.config.checkpoint_dir
+        if not cdir:
+            return False
+        restored = False
+        tsdb = os.path.join(cdir, "tsdb.npz")
+        if os.path.exists(tsdb):
+            cache = mc.MetricCache.restore(tsdb)
+            self.metric_cache._series = cache._series
+            restored = True
+        pred = os.path.join(cdir, "prediction.npz")
+        if os.path.exists(pred):
+            try:
+                self.predictor = PeakPredictor.restore(pred)
+                restored = True
+            except (OSError, ValueError, KeyError):
+                pass
+        return restored
 
     def run(self, duration_s: float = float("inf")) -> None:
         """Wall-clock loop for real deployment."""

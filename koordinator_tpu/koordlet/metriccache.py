@@ -163,3 +163,53 @@ class MetricCache:
             for k in dead:
                 del self._series[k]
             return len(dead)
+
+    # ---- checkpoint / restore ----
+    # The reference embeds a Prometheus TSDB with an on-disk WAL
+    # (tsdb_storage.go), which is what makes koordlet stateless-restartable
+    # (SURVEY §5). The rebuild's analog: snapshot every ring to one
+    # atomic npz; the KV side is ephemeral (it mirrors /proc facts that
+    # re-collect on the first tick).
+
+    def checkpoint(self, path: str) -> None:
+        import json
+        import os
+
+        with self._lock:
+            keys = list(self._series)
+            arrays = {}
+            for i, key in enumerate(keys):
+                ring = self._series[key]
+                arrays[f"ts_{i}"] = ring.ts
+                arrays[f"values_{i}"] = ring.values
+                arrays[f"state_{i}"] = np.asarray([ring.head, ring.count])
+            arrays["keys"] = np.frombuffer(
+                json.dumps(keys).encode(), dtype=np.uint8
+            )
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+
+    @classmethod
+    def restore(
+        cls, path: str, capacity_per_series: int = 4096
+    ) -> "MetricCache":
+        """Rebuild from a checkpoint; an unreadable file yields an empty
+        cache (a restart must never be blocked on history)."""
+        import json
+
+        cache = cls(capacity_per_series=capacity_per_series)
+        try:
+            with np.load(path) as data:
+                keys = json.loads(bytes(data["keys"]).decode())
+                for i, key in enumerate(keys):
+                    ring = _Ring(data[f"ts_{i}"].shape[0])
+                    ring.ts = data[f"ts_{i}"].copy()
+                    ring.values = data[f"values_{i}"].copy()
+                    head, count = (int(x) for x in data[f"state_{i}"])
+                    ring.head, ring.count = head, count
+                    cache._series[tuple(key)] = ring
+        except (OSError, KeyError, ValueError):
+            return cls(capacity_per_series=capacity_per_series)
+        return cache

@@ -377,3 +377,54 @@ def test_reservation_on_removed_node_fails_safely():
     out = sched.schedule([owner])  # must not crash; falls back to solver
     assert rm.get("r").phase == ReservationPhase.FAILED
     assert len(out.bound) == 1  # placed on the surviving node
+
+
+def test_metric_cache_checkpoint_restore(tmp_path):
+    """Ring snapshots survive a koordlet restart (TSDB persistence analog,
+    reference tsdb_storage.go): aggregates over the restored cache match
+    the original, and a corrupt file restores to an empty cache."""
+    cache = mc.MetricCache(capacity_per_series=64)
+    for t in range(100):   # wraps the 64-slot ring
+        cache.append(mc.NODE_CPU_USAGE, "node", float(t), float(t))
+    path = str(tmp_path / "tsdb.npz")
+    cache.checkpoint(path)
+
+    back = mc.MetricCache.restore(path, capacity_per_series=64)
+    want = cache.aggregate(mc.NODE_CPU_USAGE, "node", 0.0, 100.0)
+    got = back.aggregate(mc.NODE_CPU_USAGE, "node", 0.0, 100.0)
+    assert got.count == want.count == 64
+    assert got.avg == want.avg
+    assert back.latest(mc.NODE_CPU_USAGE, "node") == (99.0, 99.0)
+    # appends continue at the right ring position
+    back.append(mc.NODE_CPU_USAGE, "node", 100.0, 100.0)
+    assert back.latest(mc.NODE_CPU_USAGE, "node") == (100.0, 100.0)
+
+    (tmp_path / "bad.npz").write_bytes(b"not a checkpoint")
+    empty = mc.MetricCache.restore(str(tmp_path / "bad.npz"))
+    assert empty.latest(mc.NODE_CPU_USAGE, "node") is None
+
+
+def test_daemon_checkpoint_restart_cycle(tmp_path):
+    """A koordlet restart adopts the TSDB + prediction checkpoints written
+    on report ticks (stateless-restartable agent, SURVEY §5)."""
+    cfg = KoordletConfig(
+        node_name="test-node",
+        cgroup_root=str(tmp_path),
+        report_interval_s=0.0,
+        aggregate_window_s=1000.0,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    agent = Koordlet(cfg)
+    for t in range(5):
+        agent.collect_tick(now=1000.0 + t)
+    agent.predictor.observe("node/test-node", 1234.0, 1000.0)
+    assert agent.report_tick(now=1005.0) is not None   # writes checkpoints
+
+    agent2 = Koordlet(cfg)
+    assert agent2.restore_checkpoints()
+    # restored history answers aggregates without any new collection
+    agg = agent2.metric_cache.aggregate(
+        mc.NODE_CPU_USAGE, "node", 0.0, 3000.0
+    )
+    assert agg.count >= 1
+    assert agent2.predictor.peak("node/test-node") is not None
