@@ -415,8 +415,12 @@ def test_daemon_checkpoint_restart_cycle(tmp_path):
         checkpoint_dir=str(tmp_path / "ckpt"),
     )
     agent = Koordlet(cfg)
+    # seed history directly — collection timing (procfs jiffy deltas) is
+    # not the subject here, persistence is
     for t in range(5):
-        agent.collect_tick(now=1000.0 + t)
+        agent.metric_cache.append(
+            mc.NODE_CPU_USAGE, "node", 1000.0 + t, 1000.0 + t
+        )
     agent.predictor.observe("node/test-node", 1234.0, 1000.0)
     assert agent.report_tick(now=1005.0) is not None   # writes checkpoints
 
