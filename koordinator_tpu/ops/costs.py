@@ -25,17 +25,26 @@ _SAFE = 1e-9
 def _utilization_free_score(
     requested_like: jnp.ndarray, allocatable: jnp.ndarray, weights: jnp.ndarray
 ) -> jnp.ndarray:
-    """score = Σ_d w_d · (alloc - used) · 100 / alloc / Σ_d w_d, clamped ≥ 0.
+    """score = ⌊Σ_d w_d · ⌊(alloc - used) · 100 / alloc⌋ / Σ_d w_d⌋, ≥ 0.
+
+    Integer-floor semantics are part of the reference contract, not an
+    implementation detail: ``leastUsedScore`` floors per resource and
+    ``loadAwareSchedulingScorer`` floors the weighted mean (int64
+    divisions, ``load_aware.go:387-406``) — its own test table
+    (``load_aware_test.go`` TestScore: 52.5/93.67 → (52+93)/2 → 72)
+    only reproduces under flooring.
 
     requested_like: [..., D] (estimated used or requested+req);
     allocatable: broadcastable [..., D]; weights: [D].
     """
     free = jnp.maximum(allocatable - requested_like, 0.0)
-    per_dim = jnp.where(allocatable > 0, free * 100.0 / (allocatable + _SAFE), 0.0)
+    per_dim = jnp.floor(
+        jnp.where(allocatable > 0, free * 100.0 / (allocatable + _SAFE), 0.0)
+    )
     wsum = jnp.sum(weights) + _SAFE
     # Elementwise multiply-reduce (not einsum/MXU): D is tiny and full f32
     # accumulation keeps scores bit-comparable with the scalar golden model.
-    return jnp.sum(per_dim * weights, axis=-1) / wsum
+    return jnp.floor(jnp.sum(per_dim * weights, axis=-1) / wsum)
 
 
 def load_aware_cost(
@@ -43,14 +52,20 @@ def load_aware_cost(
     node_estimated_used: jnp.ndarray,
     node_allocatable: jnp.ndarray,
     weights: jnp.ndarray,
+    metric_fresh: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """LoadAware least-used score → cost ([P, N]).
 
     Mirrors ``load_aware.go:387-406`` (``loadAwareSchedulingScorer``): per-dim
-    free-percentage after adding the pod's estimated usage, weighted-averaged.
+    free-percentage after adding the pod's estimated usage, weighted-averaged
+    with the reference's integer-floor semantics. A node whose NodeMetric is
+    expired or missing scores 0 — still schedulable, ranked last
+    (``TestScore`` "score node with expired nodeMetric" → 0).
     """
     after = node_estimated_used[None, :, :] + pod_estimate[:, None, :]  # [P,N,D]
     score = _utilization_free_score(after, node_allocatable[None, :, :], weights)
+    if metric_fresh is not None:
+        score = jnp.where(metric_fresh[None, :], score, 0.0)
     return -score
 
 

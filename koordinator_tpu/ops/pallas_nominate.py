@@ -87,13 +87,20 @@ def _kernel(
         limit = alloc * (thr / 100.0)
         over |= (thr > 0.0) & (after > limit + 1e-6)
         w = params_ref[1, d]
-        frac = jnp.where(
-            alloc > 0, jnp.maximum(alloc - after, 0.0) * 100.0 / (alloc + 1e-9), 0.0
+        frac = jnp.floor(
+            jnp.where(
+                alloc > 0,
+                jnp.maximum(alloc - after, 0.0) * 100.0 / (alloc + 1e-9),
+                0.0,
+            )
         )
         score = score + frac * w
         wsum = wsum + w
     feas = feas & ~(fresh & over)
-    cost = -(score / wsum)
+    # reference integer-floor scoring; expired metric scores 0 (see
+    # ops.costs.load_aware_cost)
+    score = jnp.where(fresh, jnp.floor(score / wsum), 0.0)
+    cost = -score
     if jitter > 0.0:
         # int32 wraparound arithmetic is bit-identical to the solver's
         # uint32 hash after the & 0xFFFF fold (two's complement low bits);

@@ -446,7 +446,11 @@ def assign(
                 spods.gpu_whole, spods.gpu_share, dev_full, dev_partial
             )
         cost = cost_ops.load_aware_cost(
-            spods.estimate, est_used, nodes.allocatable, params.score_weights
+            spods.estimate,
+            est_used,
+            nodes.allocatable,
+            params.score_weights,
+            metric_fresh=nodes.metric_fresh,
         )
         if cost_transform is not None:
             # BeforeScore transformer chain (frameworkext.interface.go:84-109):
@@ -804,14 +808,18 @@ def assign_sequential(
 
         after = est_used + est[None, :]
         frees = jnp.maximum(nodes.allocatable - after, 0.0)
-        per_dim = jnp.where(
-            nodes.allocatable > 0,
-            frees * 100.0 / (nodes.allocatable + 1e-9),
-            0.0,
+        per_dim = jnp.floor(
+            jnp.where(
+                nodes.allocatable > 0,
+                frees * 100.0 / (nodes.allocatable + 1e-9),
+                0.0,
+            )
         )
-        score = jnp.sum(per_dim * params.score_weights, axis=-1) / (
-            jnp.sum(params.score_weights) + 1e-9
+        score = jnp.floor(
+            jnp.sum(per_dim * params.score_weights, axis=-1)
+            / (jnp.sum(params.score_weights) + 1e-9)
         )
+        score = jnp.where(nodes.metric_fresh, score, 0.0)
         score = jnp.where(feas, score, -jnp.inf)
         best = jnp.argmax(score).astype(jnp.int32)
         has = feas[best]

@@ -250,6 +250,7 @@ def shard_map_nominate(
             nodes_l.estimated_used,
             nodes_l.allocatable,
             params_l.score_weights,
+            metric_fresh=nodes_l.metric_fresh,
         )
         if nomination_jitter > 0.0:
             pi = jnp.arange(p, dtype=jnp.uint32)[:, None]

@@ -60,8 +60,13 @@ def sequential_assign(
             continue
         after = est_used + est
         free = np.maximum(allocatable - after, 0.0)
-        per_dim = np.where(allocatable > 0, free * 100.0 / (allocatable + 1e-9), 0.0)
-        score = (per_dim * score_weights).sum(axis=1) / wsum
+        # integer-floor score semantics (reference leastUsedScore /
+        # loadAwareSchedulingScorer int64 divisions); expired metric → 0
+        per_dim = np.floor(
+            np.where(allocatable > 0, free * 100.0 / (allocatable + 1e-9), 0.0)
+        )
+        score = np.floor((per_dim * score_weights).sum(axis=1) / wsum)
+        score = np.where(metric_fresh, score, 0.0)
         score[~feas] = -np.inf
         best = int(np.argmax(score))
         assignment[i] = best

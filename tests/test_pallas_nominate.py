@@ -24,7 +24,7 @@ def reference_nomination(pods, nodes, params, topk, jitter):
     feas &= nodes.schedulable[None, :]
     cost = cost_ops.load_aware_cost(
         pods.estimate, nodes.estimated_used, nodes.allocatable,
-        params.score_weights,
+        params.score_weights, metric_fresh=nodes.metric_fresh,
     )
     if jitter > 0:
         pi = jnp.arange(p, dtype=jnp.uint32)[:, None]

@@ -92,7 +92,7 @@ def test_shard_map_nominate_matches_replicated_topk():
     feas &= nodes.schedulable[None, :]
     cost = cost_ops.load_aware_cost(
         pods.estimate, nodes.estimated_used, nodes.allocatable,
-        params.score_weights,
+        params.score_weights, metric_fresh=nodes.metric_fresh,
     )
     pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
     ni = jnp.arange(n, dtype=jnp.uint32)[None, :]
