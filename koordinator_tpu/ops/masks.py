@@ -17,6 +17,16 @@ import jax.numpy as jnp
 EPS = 1e-3  # float32 slack for large-magnitude resource dims (MiB, milli-cpu)
 
 
+def usage_percent(used: jnp.ndarray, allocatable: jnp.ndarray) -> jnp.ndarray:
+    """Utilization as the reference computes it for threshold checks:
+    ``int64(math.Round(used/total*100))`` (``load_aware.go
+    filterNodeUsage``) — a node at 65.4% passes a 65% threshold. Go's
+    math.Round is half-away-from-zero; values are non-negative here so
+    floor(x + 0.5) reproduces it (jnp.round would round half to even)."""
+    pct = jnp.where(allocatable > 0, used * 100.0 / allocatable, 0.0)
+    return jnp.floor(pct + 0.5)
+
+
 def fit_mask(pod_req: jnp.ndarray, node_free: jnp.ndarray) -> jnp.ndarray:
     """NodeResourcesFit: every requested dim fits in node free capacity.
 
@@ -37,17 +47,19 @@ def usage_threshold_mask(
     placing the pod exceeds the per-resource threshold.
 
     Mirrors ``load_aware.go:290-313``: for each dim with threshold > 0,
-    ``(estimatedUsed + podEstimate) > threshold% * allocatable`` ⇒ reject.
-    Nodes with an expired NodeMetric skip the usage check (degraded mode,
+    ``round((estimatedUsed + podEstimate)·100/allocatable) > threshold``
+    ⇒ reject (the rounded-percent comparison is the reference's boundary
+    semantics — see :func:`usage_percent`). Nodes with an expired
+    NodeMetric skip the usage check (degraded mode,
     ``load_aware.go:143-149``) — the fit mask still applies.
 
     pod_estimate: [P, D]; node_estimated_used/allocatable: [N, D];
     thresholds: [D] in percent (0 disables the dim); metric_fresh: [N] bool.
     Returns [P, N] bool.
     """
-    limit = node_allocatable * (thresholds / 100.0)  # [N, D]
     after = node_estimated_used[None, :, :] + pod_estimate[:, None, :]
-    over = (thresholds > 0.0) & (after > limit[None, :, :] + EPS)
+    pct = usage_percent(after, node_allocatable[None, :, :])
+    over = (thresholds > 0.0) & (pct > thresholds)
     ok = ~jnp.any(over, axis=-1)
     return ok | ~metric_fresh[None, :]
 

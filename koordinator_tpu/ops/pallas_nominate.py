@@ -84,8 +84,11 @@ def _kernel(
         feas = feas & (pod_req <= req_free + 1e-6)
         after = node_est_ref[d : d + 1, :] + pod_est      # [TP, TN]
         thr = params_ref[0, d]
-        limit = alloc * (thr / 100.0)
-        over |= (thr > 0.0) & (after > limit + 1e-6)
+        # rounded-percent threshold check (masks.usage_percent semantics)
+        pct = jnp.floor(
+            jnp.where(alloc > 0, after * 100.0 / alloc, 0.0) + 0.5
+        )
+        over |= (thr > 0.0) & (pct > thr)
         w = params_ref[1, d]
         frac = jnp.floor(
             jnp.where(

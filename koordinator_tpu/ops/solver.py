@@ -525,14 +525,17 @@ def assign(
             accept &= seg_full <= dev_full[gnode] + EPS
             accept &= seg_total <= dev_total[gnode] + EPS
         # Intra-round cumulative usage-threshold check keeps the commit
-        # faithful to sequential Filter semantics (load_aware.go:290-313).
+        # faithful to sequential Filter semantics (load_aware.go:290-313,
+        # rounded-percent comparison).
         thr = params.usage_thresholds
-        limit = alloc_g * (thr / 100.0)
-        over = (thr > 0.0) & (est0_g + seg_est > limit + EPS)
+        over = (thr > 0.0) & (
+            mask_ops.usage_percent(est0_g + seg_est, alloc_g) > thr
+        )
         accept &= ~(fresh_g & jnp.any(over, axis=-1))
         pthr = params.prod_thresholds
-        plimit = alloc_g * (pthr / 100.0)
-        pover = (pthr > 0.0) & (prod_used[gnode] + seg_prod > plimit + EPS)
+        pover = (pthr > 0.0) & (
+            mask_ops.usage_percent(prod_used[gnode] + seg_prod, alloc_g) > pthr
+        )
         accept &= ~(sprod & fresh_g & jnp.any(pover, axis=-1))
         # Spread quantum: prior intra-round acceptance on this node must stay
         # under quantum × allocatable (first pod of a segment always passes).
@@ -797,12 +800,16 @@ def assign_sequential(
             )
             feas &= q_ok
         thr = params.usage_thresholds
-        limit = nodes.allocatable * (thr / 100.0)
-        over = (thr > 0.0) & (est_used + est[None, :] > limit + EPS)
+        over = (thr > 0.0) & (
+            mask_ops.usage_percent(est_used + est[None, :], nodes.allocatable)
+            > thr
+        )
         feas &= ~(nodes.metric_fresh & jnp.any(over, axis=-1))
         pthr = params.prod_thresholds
-        plimit = nodes.allocatable * (pthr / 100.0)
-        pover = (pthr > 0.0) & (prod_used + est[None, :] > plimit + EPS)
+        pover = (pthr > 0.0) & (
+            mask_ops.usage_percent(prod_used + est[None, :], nodes.allocatable)
+            > pthr
+        )
         feas &= ~(is_prod & nodes.metric_fresh & jnp.any(pover, axis=-1)) | ~is_prod
         feas &= nodes.schedulable & valid
 

@@ -17,6 +17,13 @@ import numpy as np
 EPS = 1e-3
 
 
+def _usage_percent(used: np.ndarray, allocatable: np.ndarray) -> np.ndarray:
+    """Rounded integer percent, the reference's threshold-check unit
+    (``filterNodeUsage``: int64(math.Round(used/total*100)))."""
+    pct = np.where(allocatable > 0, used * 100.0 / allocatable, 0.0)
+    return np.floor(pct + 0.5)
+
+
 def sequential_assign(
     pod_req: np.ndarray,          # [P, D]
     pod_estimate: np.ndarray,     # [P, D]
@@ -49,12 +56,12 @@ def sequential_assign(
         fit = np.all(requested + req <= allocatable + EPS, axis=1)
         feas = fit & schedulable
         if thr_on.any():
-            limit = allocatable * (usage_thresholds / 100.0)
-            over = thr_on[None, :] & (est_used + est > limit + EPS)
+            pct = _usage_percent(est_used + est, allocatable)
+            over = thr_on[None, :] & (pct > usage_thresholds)
             feas &= ~(metric_fresh & over.any(axis=1))
         if pod_is_prod[i] and prod_thr_on.any():
-            limit = allocatable * (prod_thresholds / 100.0)
-            over = prod_thr_on[None, :] & (prod_used + est > limit + EPS)
+            pct = _usage_percent(prod_used + est, allocatable)
+            over = prod_thr_on[None, :] & (pct > prod_thresholds)
             feas &= ~(metric_fresh & over.any(axis=1))
         if not feas.any():
             continue

@@ -67,3 +67,69 @@ def test_score_usage_plus_assigned_is_63():
         score_of(EST_CPU, EST_MEM, 32_000.0 + EST_CPU, 10 * GI + EST_MEM)
         == 63.0
     )
+
+
+# ---- Filter (load_aware_test.go TestFilterUsage; default thresholds
+# cpu 65 / memory 95, node 96C/512Gi) ----
+
+from koordinator_tpu.ops.masks import (
+    prod_usage_threshold_mask,
+    usage_threshold_mask,
+)
+
+
+def filter_ok(used_cpu_milli, used_mem_mib, thr=(65.0, 95.0), fresh=True,
+              est=(0.0, 0.0)):
+    mask = usage_threshold_mask(
+        jnp.asarray([list(est)], jnp.float32),
+        jnp.asarray([[used_cpu_milli, used_mem_mib]], jnp.float32),
+        jnp.asarray(NODE_ALLOC),
+        jnp.asarray(thr, jnp.float32),
+        jnp.asarray([fresh]),
+    )
+    return bool(np.asarray(mask)[0, 0])
+
+
+def test_filter_normal_usage_passes():
+    """"filter normal usage": 60C (62.5%) / 256Gi (50%) -> schedulable."""
+    assert filter_ok(60_000.0, 256 * GI)
+
+
+def test_filter_exceed_cpu_usage_rejects():
+    """"filter exceed cpu usage": 70C -> 72.9% -> round 73 > 65."""
+    assert not filter_ok(70_000.0, 256 * GI)
+
+
+def test_filter_rounded_percent_boundary():
+    """The reference compares int64(round(pct)): 65.4% rounds to 65 and
+    PASSES a 65 threshold; 65.6% rounds to 66 and fails."""
+    assert filter_ok(0.654 * 96_000.0, 0.0)
+    assert not filter_ok(0.656 * 96_000.0, 0.0)
+
+
+def test_filter_zero_threshold_disables_dim():
+    """"disable filter exceed memory usage": memory threshold 0 admits a
+    97.6%-memory node."""
+    assert filter_ok(10_000.0, 500 * GI, thr=(65.0, 0.0))
+
+
+def test_filter_expired_metric_degrades_to_fit_only():
+    assert filter_ok(95_000.0, 500 * GI, fresh=False)
+
+
+def test_filter_prod_usage_only_gates_prod_pods():
+    """"filter prod cpu usage": prod usage 33C (34.4% -> 34 > 30) rejects
+    a prod pod under prodUsageThresholds cpu=30; a non-prod pod passes."""
+    def prod_ok(is_prod):
+        mask = prod_usage_threshold_mask(
+            jnp.asarray([is_prod]),
+            jnp.zeros((1, 2), jnp.float32),
+            jnp.asarray([[33_000.0, 0.0]], jnp.float32),   # prod-tier usage
+            jnp.asarray(NODE_ALLOC),
+            jnp.asarray([30.0, 0.0], jnp.float32),
+            jnp.asarray([True]),
+        )
+        return bool(np.asarray(mask)[0, 0])
+
+    assert not prod_ok(True)
+    assert prod_ok(False)
