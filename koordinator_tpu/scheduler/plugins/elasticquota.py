@@ -68,28 +68,40 @@ def water_fill(
     caps: np.ndarray,
     weights: np.ndarray,
 ) -> np.ndarray:
-    """Distribute ``total`` [D] among C children: each gets ``guaranteed``
-    [C, D] first, the remainder proportionally to ``weights`` [C, D] capped
-    by ``caps`` [C, D]. Iterative water-filling, per dim, ≤ C passes
-    (each pass saturates at least one child or exhausts the pool)."""
+    """Distribute ``total`` [D] among C children with the reference's
+    ``quotaTree.redistribution`` / ``iterationForRedistribution`` semantics
+    exactly: every child starts at ``guaranteed`` [C, D] (= min(min,
+    limited-request)); children still wanting more (cap > guaranteed) split
+    the remainder by shared weight with each round's delta ROUNDED to an
+    integer (``int64(w·total/totalW + 0.5)``); a child hitting its cap
+    (= limited request, min(max, request)) returns its excess to the next
+    round, which runs over the still-unsatisfied set only. Verified against
+    ``runtime_quota_calculator_test.go`` IterationAdjustQuota (case 1:
+    weights 40/60/50/80, requests 5/20/40/70, mins 10/15/20/15, total 100
+    → 5/20/35/40 — continuous water-filling would give 35.38/39.62)."""
     c, d = guaranteed.shape
-    runtime = np.minimum(guaranteed, caps).astype(np.float64)
-    remaining = np.maximum(total - runtime.sum(axis=0), 0.0).astype(np.float64)
-    for _ in range(c):
-        need = np.maximum(caps - runtime, 0.0)
-        active = need > 1e-9
-        w = np.where(active, np.maximum(weights, 0.0), 0.0)
-        wsum = w.sum(axis=0)
-        distributable = (remaining > 1e-9) & (wsum > 1e-9)
-        if not distributable.any():
-            break
-        give = np.where(
-            distributable[None, :], remaining[None, :] * w / np.maximum(wsum, 1e-9), 0.0
-        )
-        inc = np.minimum(give, need)
-        runtime += inc
-        remaining = remaining - inc.sum(axis=0)
-    return runtime.astype(np.float32)
+    out = np.minimum(guaranteed, caps).astype(np.float64)
+    caps64 = caps.astype(np.float64)
+    for dim in range(d):
+        runtime = out[:, dim].copy()
+        cap = caps64[:, dim]
+        w = np.maximum(weights[:, dim].astype(np.float64), 0.0)
+        adjust = cap > runtime
+        to_part = float(total[dim]) - runtime.sum()
+        while to_part > 0 and adjust.any():
+            tw = w[adjust].sum()
+            if tw <= 0:
+                break
+            delta = np.where(
+                adjust, np.floor(w * to_part / tw + 0.5), 0.0
+            )
+            runtime = runtime + delta
+            over = np.maximum(runtime - cap, 0.0)
+            to_part = float(over.sum())
+            runtime = np.minimum(runtime, cap)
+            adjust = adjust & (runtime < cap)
+        out[:, dim] = runtime
+    return out.astype(np.float32)
 
 
 @dataclasses.dataclass

@@ -133,3 +133,44 @@ def test_filter_prod_usage_only_gates_prod_pods():
 
     assert not prod_ok(True)
     assert prod_ok(False)
+
+
+# ---- ElasticQuota runtime fair sharing
+# (runtime_quota_calculator_test.go TestRuntimeQuotaCalculator_IterationAdjustQuota:
+# weights 40/60/50/80, limited requests 5/20/40/70, mins 10/15/20/15,
+# total 100; runtime starts at min(min, request), rounds of rounded-integer
+# weighted deltas, capped excess redistributed among the unsatisfied) ----
+
+from koordinator_tpu.scheduler.plugins.elasticquota import water_fill
+
+
+def _fill(guaranteed, caps, weights, total=100.0):
+    out = water_fill(
+        np.asarray([total], np.float32),
+        np.asarray([[g] for g in guaranteed], np.float32),
+        np.asarray([[c] for c in caps], np.float32),
+        np.asarray([[w] for w in weights], np.float32),
+    )
+    return out.ravel().tolist()
+
+
+def test_quota_iteration_case1_no_guarantee():
+    assert _fill([5, 15, 20, 15], [5, 20, 40, 70], [40, 60, 50, 80]) == [
+        5, 20, 35, 40,
+    ]
+
+
+def test_quota_iteration_case2_zero_weight():
+    """node4 sharedWeight=0: it keeps only its min; node3 reaches its
+    full request."""
+    assert _fill([5, 15, 20, 15], [5, 20, 40, 70], [40, 60, 50, 0]) == [
+        5, 20, 40, 15,
+    ]
+
+
+def test_quota_iteration_case3_guarantee_over_min():
+    """node4 guarantee 45 > min 15: starts at 45 and keeps it even with
+    zero weight."""
+    assert _fill([5, 15, 20, 45], [5, 20, 40, 70], [40, 60, 50, 0]) == [
+        5, 20, 30, 45,
+    ]
