@@ -56,6 +56,8 @@ class NodeArrays:
       usage_avg        — NodeMetric avg node usage          [N, D]
       usage_agg        — NodeMetric aggregated percentile   [N, D]
       prod_usage       — NodeMetric prod-tier usage         [N, D]
+      sys_usage        — NodeMetric system-tier usage (out-of-band
+                         daemons; batchresource subtracts it)            [N, D]
       assigned_pending — estimated usage of assigned-but-unreported pods
                          (reference ``load_aware.go:315-358``)            [N, D]
       assigned_pending_prod — the prod-band slice of assigned_pending
@@ -69,6 +71,7 @@ class NodeArrays:
     usage_avg: np.ndarray
     usage_agg: np.ndarray
     prod_usage: np.ndarray
+    sys_usage: np.ndarray
     assigned_pending: np.ndarray
     assigned_pending_prod: np.ndarray
     metric_fresh: np.ndarray
@@ -84,6 +87,7 @@ class NodeArrays:
             usage_avg=z(),
             usage_agg=z(),
             prod_usage=z(),
+            sys_usage=z(),
             assigned_pending=z(),
             assigned_pending_prod=z(),
             metric_fresh=np.zeros((n_bucket,), bool),
@@ -203,6 +207,7 @@ class ClusterSnapshot:
             usage_avg=pad(old.usage_avg),
             usage_agg=pad(old.usage_agg),
             prod_usage=pad(old.prod_usage),
+            sys_usage=pad(old.sys_usage),
             assigned_pending=pad(old.assigned_pending),
             assigned_pending_prod=pad(old.assigned_pending_prod),
             metric_fresh=pad(old.metric_fresh),
@@ -285,6 +290,7 @@ class ClusterSnapshot:
             agg.usage if agg is not None else metric.node_usage.usage
         )
         self.nodes.prod_usage[idx] = cfg.res_vector(metric.prod_usage.usage)
+        self.nodes.sys_usage[idx] = cfg.res_vector(metric.sys_usage.usage)
         import time as _t
 
         now = now if now is not None else _t.time()

@@ -31,7 +31,8 @@ class ColocationStrategy:
     ``apis/configuration/slo_controller_config.go`` ColocationStrategy)."""
 
     enable: bool = True
-    #: fraction of allocatable reserved from colocation (degradation buffer)
+    #: fraction of allocatable reserved from colocation (degradation buffer;
+    #: the reference's nodeSafetyMargin percent)
     reserve_ratio: float = 0.1
     #: prod peak = max(usage, requests × this safety factor)
     prod_request_factor: float = 0.0  # 0 = usage-only (usage policy)
@@ -39,6 +40,14 @@ class ColocationStrategy:
     mid_reclaim_ratio: float = 0.5
     #: degrade (zero batch resources) when NodeMetric is stale
     degrade_on_stale_metric: bool = True
+    #: node-reserved floor (max of kubelet/annotation reserved in the
+    #: reference; subtracted as max(systemUsed, reserved))
+    node_reserved: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    #: batch cpu policy: "usage" (default) | "maxUsageRequest"
+    #: (CalculateBatchResourceByPolicy, plugins/util/util.go:50-105)
+    cpu_calculate_policy: str = "usage"
+    #: batch memory policy: "usage" (default) | "request" | "maxUsageRequest"
+    memory_calculate_policy: str = "usage"
 
 
 class NodeResourceController:
@@ -66,27 +75,54 @@ class NodeResourceController:
         }
 
     def calculate(self) -> Tuple[np.ndarray, np.ndarray]:
-        """(batch [N, 2], mid [N, 2]) in (cpu, memory) units."""
+        """(batch [N, 2], mid [N, 2]) in (cpu, memory) units.
+
+        Reference formula (``CalculateBatchResourceByPolicy``,
+        ``plugins/util/util.go:50-105``), per policy:
+
+          usage:           cap − margin − max(sysUsed, reserved) − prodUsed
+          request:         cap − margin − reserved − prodRequested
+          maxUsageRequest: cap − margin − max(sysUsed, reserved)
+                               − max(prodUsed, prodRequested)
+
+        clamped ≥ 0; cpu selects usage|maxUsageRequest, memory any of the
+        three (the reference's per-resource CalculatePolicy knobs).
+        """
         na = self.snapshot.nodes
         s = self.strategy
-        base = na.allocatable[:, [self._cpu, self._mem]]
-        # prod peak per the usage policy; the reference additionally
-        # subtracts system-tier usage, which koordlet reports separately —
-        # here the reserve_ratio buffer covers it (NodeMetric.sys_usage is
-        # not folded into the snapshot arrays).
-        prod_peak = (
-            na.prod_usage[:, [self._cpu, self._mem]]
-            + na.assigned_pending_prod[:, [self._cpu, self._mem]]
+        cols = [self._cpu, self._mem]
+        base = na.allocatable[:, cols]
+        margin = base * s.reserve_ratio
+        reserved = self.snapshot.config.res_vector(s.node_reserved)[cols]
+        sys_used = np.maximum(na.sys_usage[:, cols], reserved[None, :])
+        prod_used = (
+            na.prod_usage[:, cols] + na.assigned_pending_prod[:, cols]
         )
         if s.prod_request_factor > 0:
-            prod_req = na.requested[:, [self._cpu, self._mem]]
-            prod_peak = np.maximum(prod_peak, prod_req * s.prod_request_factor)
-        batch = np.maximum(base * (1.0 - s.reserve_ratio) - prod_peak, 0.0)
+            prod_req_f = na.requested[:, cols] * s.prod_request_factor
+            prod_used = np.maximum(prod_used, prod_req_f)
+        prod_requested = na.requested[:, cols]
+
+        by_usage = np.maximum(base - margin - sys_used - prod_used, 0.0)
+        by_request = np.maximum(
+            base - margin - reserved[None, :] - prod_requested, 0.0
+        )
+        by_max = np.maximum(
+            base - margin - sys_used - np.maximum(prod_used, prod_requested),
+            0.0,
+        )
+        policies = {
+            "usage": by_usage,
+            "request": by_request,
+            "maxUsageRequest": by_max,
+        }
+        batch = by_usage.copy()
+        batch[:, 0] = policies.get(s.cpu_calculate_policy, by_usage)[:, 0]
+        batch[:, 1] = policies.get(s.memory_calculate_policy, by_usage)[:, 1]
         # mid = reclaimable prod capacity: what prod-tier pods requested but
         # do not actually use at peak (reference midresource plugin) — NOT
         # total allocatable headroom, which would overstate mid capacity.
-        prod_requested = na.requested[:, [self._cpu, self._mem]]
-        mid = np.maximum(prod_requested - prod_peak, 0.0) * s.mid_reclaim_ratio
+        mid = np.maximum(prod_requested - prod_used, 0.0) * s.mid_reclaim_ratio
         if not s.enable:
             batch = np.zeros_like(batch)
             mid = np.zeros_like(mid)

@@ -174,3 +174,88 @@ def test_quota_iteration_case3_guarantee_over_min():
     assert _fill([5, 15, 20, 45], [5, 20, 40, 70], [40, 60, 50, 0]) == [
         5, 20, 30, 45,
     ]
+
+
+# ---- batchresource calculation policies
+# (CalculateBatchResourceByPolicy, plugins/util/util.go:50-105) ----
+
+
+def test_batch_resource_policies():
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeMetric,
+        NodeStatus,
+        ObjectMeta,
+        ResourceMetric,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.manager.noderesource import (
+        ColocationStrategy,
+        NodeResourceController,
+    )
+    from koordinator_tpu.api import extension as ext2
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext2.RES_CPU: 100_000, ext2.RES_MEMORY: 100_000}
+            ),
+        )
+    )
+    snap.set_node_metric(
+        NodeMetric(
+            meta=ObjectMeta(name="n0"),
+            node_usage=ResourceMetric(
+                usage={ext2.RES_CPU: 50_000, ext2.RES_MEMORY: 40_000}
+            ),
+            prod_usage=ResourceMetric(
+                usage={ext2.RES_CPU: 40_000, ext2.RES_MEMORY: 30_000}
+            ),
+            sys_usage=ResourceMetric(
+                usage={ext2.RES_CPU: 7_000, ext2.RES_MEMORY: 5_000}
+            ),
+            update_time=999.0,
+        ),
+        now=1000.0,
+    )
+    idx = snap.node_id("n0")
+    # prod requests on the node: 60C/50G (assumed pods)
+    from koordinator_tpu.api.types import Pod, PodSpec
+
+    snap.assume_pod(
+        Pod(
+            meta=ObjectMeta(name="prod", uid="prod"),
+            spec=PodSpec(
+                requests={ext2.RES_CPU: 60_000, ext2.RES_MEMORY: 50_000},
+                priority=9500,
+            ),
+        ),
+        "n0",
+        estimated=np.zeros(snap.config.dims, np.float32),
+    )
+
+    def calc(cpu_policy, mem_policy):
+        ctrl = NodeResourceController(
+            snap,
+            ColocationStrategy(
+                reserve_ratio=0.1,
+                node_reserved={ext2.RES_CPU: 5_000, ext2.RES_MEMORY: 4_000},
+                cpu_calculate_policy=cpu_policy,
+                memory_calculate_policy=mem_policy,
+            ),
+        )
+        batch, _mid = ctrl.calculate()
+        return batch[idx]
+
+    # usage: 100k - 10k(margin) - max(7k sys, 5k reserved) - 40k prodUsed = 43k
+    # mem:   100k - 10k - max(5k, 4k) - 30k = 55k
+    b = calc("usage", "usage")
+    assert b[0] == 43_000 and b[1] == 55_000
+    # request (memory): 100k - 10k - 4k(reserved) - 50k(prodReq) = 36k
+    b = calc("usage", "request")
+    assert b[1] == 36_000
+    # maxUsageRequest (cpu): 100k - 10k - 7k - max(40k, 60k) = 23k
+    b = calc("maxUsageRequest", "usage")
+    assert b[0] == 23_000
