@@ -46,12 +46,33 @@ def cpu_suppress(
     be_used_milli: float,
     threshold_percent: float,
     min_be_cpus: int = 1,
+    sys_used_milli: float | None = None,
+    node_reserved_milli: float = 0.0,
+    min_threshold_percent: float | None = None,
 ) -> CPUSuppressDecision:
-    """``suppressBECPU`` (cpu_suppress.go): the BE tier may use what is left
-    of the suppression budget after non-BE usage."""
+    """``calculateBESuppressCPU`` (``cpu_suppress.go:136-170``)::
+
+        suppress(BE) = capacity × SLOPercent − pod(non-BE).Used
+                       − max(system.Used, node.reserved)
+
+    floored at ``capacity × beCPUMinThresholdPercent`` when that knob is
+    set (the reference's ``beCPUMinThreshold``); ``min_be_cpus`` is the
+    legacy whole-cpu floor used when no percent floor is given. When
+    ``sys_used_milli`` is None, system usage is whatever of
+    ``node_used − be_used`` isn't attributed elsewhere (aggregate-input
+    mode) and the reserved floor applies to that aggregate."""
     budget = node_allocatable_milli * threshold_percent / 100.0
-    non_be_used = max(node_used_milli - be_used_milli, 0.0)
-    allowance = max(budget - non_be_used, min_be_cpus * 1000.0)
+    if sys_used_milli is None:
+        non_be_used = max(node_used_milli - be_used_milli, 0.0)
+        allowance = budget - max(non_be_used, node_reserved_milli)
+    else:
+        pod_non_be = max(node_used_milli - be_used_milli - sys_used_milli, 0.0)
+        allowance = budget - pod_non_be - max(sys_used_milli, node_reserved_milli)
+    if min_threshold_percent is not None:
+        floor = node_allocatable_milli * min_threshold_percent / 100.0
+    else:
+        floor = min_be_cpus * 1000.0
+    allowance = max(allowance, floor)
     n_cpus = max(int(-(-allowance // 1000)), min_be_cpus)  # ceil
     return CPUSuppressDecision(
         be_allowance_milli=allowance,

@@ -259,3 +259,28 @@ def test_batch_resource_policies():
     # maxUsageRequest (cpu): 100k - 10k - 7k - max(40k, 60k) = 23k
     b = calc("maxUsageRequest", "usage")
     assert b[0] == 23_000
+
+
+# ---- BE CPU suppression (calculateBESuppressCPU, cpu_suppress.go:136-170) ----
+
+
+def test_be_suppress_formula():
+    from koordinator_tpu.koordlet.qosmanager import cpu_suppress
+
+    # suppress = 64C*65% - podNonBE 20C - max(sys 4C, reserved 2C) = 17.6C
+    dec = cpu_suppress(
+        64_000, 30_000, 6_000, 65.0,
+        sys_used_milli=4_000, node_reserved_milli=2_000,
+    )
+    assert dec.be_allowance_milli == 64_000 * 0.65 - 20_000 - 4_000
+    # reserved floor wins over smaller system usage
+    dec = cpu_suppress(
+        64_000, 30_000, 6_000, 65.0,
+        sys_used_milli=1_000, node_reserved_milli=2_000,
+    )
+    assert dec.be_allowance_milli == 64_000 * 0.65 - 23_000 - 2_000
+    # beCPUMinThreshold percent floor
+    dec = cpu_suppress(
+        64_000, 64_000, 0.0, 65.0, min_threshold_percent=10.0,
+    )
+    assert dec.be_allowance_milli == 6_400.0
