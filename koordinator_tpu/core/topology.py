@@ -123,6 +123,67 @@ class CPUAccumulator:
             if numa is None or c.numa_node == numa
         )
 
+    # ---- grouping helpers (reference cpu_accumulator.go freeCoresInNode /
+    # freeCoresInSocket / freeCPUsInNode: group free cpus by core, filter
+    # full-free cores, order domains by the NUMA allocate strategy —
+    # MostAllocated = least-remaining first (bin-packing), the default) ----
+
+    def _domain_cpu_lists(
+        self,
+        avail: List[CPUInfo],
+        domain_of,
+        full_cores_only: bool,
+        most_allocated: bool = True,
+    ) -> List[List[int]]:
+        by_core: Dict[int, List[CPUInfo]] = {}
+        for c in avail:
+            by_core.setdefault(c.core_id, []).append(c)
+        socket_free: Dict[int, int] = {}
+        for c in avail:
+            socket_free[c.socket] = socket_free.get(c.socket, 0) + 1
+        domains: Dict[int, List[Tuple[int, List[int]]]] = {}
+        dom_socket: Dict[int, int] = {}
+        for cid, cs in by_core.items():
+            if full_cores_only and len(cs) != self._threads_per_core:
+                continue
+            dom = domain_of(cs[0])
+            domains.setdefault(dom, []).append(
+                (cid, sorted(c.cpu_id for c in cs))
+            )
+            dom_socket[dom] = cs[0].socket
+        out = []
+        for dom, cores in domains.items():
+            # cores with more free cpus first, then core id (sortCores)
+            cores.sort(key=lambda kv: (-len(kv[1]), kv[0]))
+            cpus = [cpu for _cid, cs in cores for cpu in cs]
+            out.append((dom, cpus))
+        sign = 1 if most_allocated else -1
+        out.sort(
+            key=lambda kv: (
+                sign * len(kv[1]),
+                sign * socket_free.get(dom_socket.get(kv[0], -1), 0),
+                kv[0],
+            )
+        )
+        return [cpus for _dom, cpus in out]
+
+    def _spread(self, cpus: List[int]) -> List[int]:
+        """One thread per core across cores before doubling up
+        (``spreadCPUs``)."""
+        core_of = {c.cpu_id: c.core_id for c in self.topology.cpus}
+        by_core: Dict[int, List[int]] = {}
+        for cpu in cpus:
+            by_core.setdefault(core_of[cpu], []).append(cpu)
+        ring = [sorted(cs) for _cid, cs in sorted(by_core.items())]
+        out: List[int] = []
+        depth = 0
+        while len(out) < len(cpus):
+            for cs in ring:
+                if depth < len(cs):
+                    out.append(cs[depth])
+            depth += 1
+        return out
+
     def take(
         self,
         owner: str,
@@ -131,76 +192,109 @@ class CPUAccumulator:
         numa: Optional[int] = None,
     ) -> Optional[Set[int]]:
         """Allocate ``n_cpus`` exclusive CPUs, optionally pinned to one NUMA
-        node. Returns the cpu-id set or None if unsatisfiable."""
+        node, with the reference ``takeCPUs`` flow (cpu_accumulator.go:87-230):
+        FullPCPUs (or single-thread cores) tries whole-free-core cpus within
+        one NUMA node, then one socket (strategy-ordered, MostAllocated =
+        tightest fit first), then drains whole sockets largest-first and
+        tops up core-by-core from the tightest remainder; other policies
+        prefer one NUMA node / socket of free cpus with spread-by-core
+        ordering. Returns the cpu-id set or None if unsatisfiable."""
         avail = [
             c for c in self.available if numa is None or c.numa_node == numa
         ]
         if len(avail) < n_cpus:
             return None
-
-        by_core: Dict[int, List[CPUInfo]] = {}
-        for c in avail:
-            by_core.setdefault(c.core_id, []).append(c)
-        threads_per_core = self._threads_per_core
-        full_cores = {
-            cid: cs for cid, cs in by_core.items() if len(cs) == threads_per_core
-        }
+        tpc = self._threads_per_core
+        cpus_per_numa: Dict[int, int] = {}
+        cpus_per_socket: Dict[int, int] = {}
+        for c in self.topology.cpus:
+            cpus_per_numa[c.numa_node] = cpus_per_numa.get(c.numa_node, 0) + 1
+            cpus_per_socket[c.socket] = cpus_per_socket.get(c.socket, 0) + 1
+        numa_cap = max(cpus_per_numa.values(), default=0)
+        socket_cap = max(cpus_per_socket.values(), default=0)
 
         taken: List[int] = []
-        if policy == CPUBindPolicy.FULL_PCPUS:
-            if n_cpus % threads_per_core != 0:
+        # DEFAULT resolves to the defaulted preferred policy FullPCPUs
+        # (v1beta3/defaults.go defaultPreferredCPUBindPolicy) and may fall
+        # back to the spread path when full cores can't satisfy; explicit
+        # FULL_PCPUS is strict.
+        full_pcpus = (
+            policy in (CPUBindPolicy.FULL_PCPUS, CPUBindPolicy.DEFAULT)
+            or tpc == 1
+        )
+        if full_pcpus:
+            if policy == CPUBindPolicy.FULL_PCPUS and n_cpus % tpc != 0:
                 return None
-            need_cores = n_cpus // threads_per_core
-            if len(full_cores) < need_cores:
-                return None
-            for cid in sorted(full_cores)[:need_cores]:
-                taken.extend(c.cpu_id for c in full_cores[cid])
-        elif policy == CPUBindPolicy.SPREAD_BY_PCPUS:
-            # round-robin one thread per core, widest spread first
-            cores_sorted = sorted(
-                by_core.items(), key=lambda kv: (-len(kv[1]), kv[0])
-            )
-            ring = [sorted(cs, key=lambda c: c.cpu_id) for _, cs in cores_sorted]
-            depth = 0
-            while len(taken) < n_cpus:
-                progressed = False
-                for cs in ring:
-                    if depth < len(cs) and len(taken) < n_cpus:
-                        taken.append(cs[depth].cpu_id)
-                        progressed = True
-                if not progressed:
-                    return None
-                depth += 1
-        else:
-            # default: whole sockets, then whole cores, then loose threads
-            by_socket: Dict[int, List[CPUInfo]] = {}
-            for c in avail:
-                by_socket.setdefault(c.socket, []).append(c)
-            socket_size = self._socket_size
-            for s in sorted(by_socket):
-                cs = by_socket[s]
-                if len(cs) == socket_size and n_cpus - len(taken) >= socket_size:
-                    taken.extend(c.cpu_id for c in cs)
-            remaining = n_cpus - len(taken)
-            if remaining > 0:
-                taken_set = set(taken)
-                rem_cores = {
-                    cid: [c for c in cs if c.cpu_id not in taken_set]
-                    for cid, cs in by_core.items()
-                }
-                for cid in sorted(rem_cores):
-                    cs = rem_cores[cid]
-                    if len(cs) == threads_per_core and remaining >= threads_per_core:
-                        taken.extend(c.cpu_id for c in cs)
-                        remaining -= threads_per_core
-                if remaining > 0:
-                    taken_set = set(taken)
-                    loose = [c.cpu_id for c in avail if c.cpu_id not in taken_set]
-                    taken.extend(loose[:remaining])
-                    remaining = 0
+            if policy == CPUBindPolicy.DEFAULT and n_cpus % tpc != 0:
+                full_pcpus = False
+        if full_pcpus:
+            done = False
+            if n_cpus <= numa_cap:
+                for cpus in self._domain_cpu_lists(
+                    avail, lambda c: c.numa_node, full_cores_only=True
+                ):
+                    if len(cpus) >= n_cpus:
+                        taken = cpus[:n_cpus]
+                        done = True
+                        break
+            if not done and n_cpus <= socket_cap:
+                for cpus in self._domain_cpu_lists(
+                    avail, lambda c: c.socket, full_cores_only=True
+                ):
+                    if len(cpus) >= n_cpus:
+                        taken = cpus[:n_cpus]
+                        done = True
+                        break
+            if not done:
+                # drain whole sockets largest-first, then the tightest
+                # remainders core by core
+                socket_lists = self._domain_cpu_lists(
+                    avail, lambda c: c.socket, full_cores_only=True,
+                    most_allocated=False,
+                )
+                unsatisfied = []
+                for cpus in socket_lists:
+                    if n_cpus - len(taken) >= len(cpus):
+                        taken.extend(cpus)
+                    else:
+                        unsatisfied.append(cpus)
+                if len(taken) < n_cpus:
+                    unsatisfied.sort(key=len)
+                    for cpus in unsatisfied:
+                        for i in range(0, len(cpus), tpc):
+                            if n_cpus - len(taken) < tpc and policy == CPUBindPolicy.FULL_PCPUS:
+                                break
+                            if len(taken) >= n_cpus:
+                                break
+                            taken.extend(cpus[i : i + tpc])
+                taken = taken[:n_cpus]
+            if len(taken) < n_cpus and policy != CPUBindPolicy.FULL_PCPUS:
+                # preferred FullPCPUs unsatisfiable: fall back to spread
+                full_pcpus = False
+                taken = []
+        if not full_pcpus:
+            done = False
+            if n_cpus <= numa_cap:
+                for cpus in self._domain_cpu_lists(
+                    avail, lambda c: c.numa_node, full_cores_only=False
+                ):
+                    if len(cpus) >= n_cpus:
+                        taken = self._spread(cpus)[:n_cpus]
+                        done = True
+                        break
+            if not done and n_cpus <= socket_cap:
+                for cpus in self._domain_cpu_lists(
+                    avail, lambda c: c.socket, full_cores_only=False
+                ):
+                    if len(cpus) >= n_cpus:
+                        taken = self._spread(cpus)[:n_cpus]
+                        done = True
+                        break
+            if not done:
+                taken = self._spread([c.cpu_id for c in avail])[:n_cpus]
         if len(taken) < n_cpus:
             return None
-        result = set(taken[:n_cpus])
+        result = set(taken)
         self._allocated |= result
         self._owners.setdefault(owner, set()).update(result)
         return result

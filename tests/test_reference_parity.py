@@ -284,3 +284,64 @@ def test_be_suppress_formula():
         64_000, 64_000, 0.0, 65.0, min_threshold_percent=10.0,
     )
     assert dec.be_allowance_milli == 6_400.0
+
+
+# ---- takeCPUs FullPCPUs flow
+# (cpu_accumulator_test.go TestTakeFullPCPUs; topologies built like
+# buildCPUTopologyForTest(sockets, nodesPerSocket, coresPerNode,
+# cpusPerCore) with sequential cpu ids) ----
+
+from koordinator_tpu.core.topology import (
+    CPUAccumulator,
+    CPUBindPolicy,
+    CPUTopology,
+)
+
+
+def take_full(sockets, numa_per_socket, cores, threads, allocated, need):
+    topo = CPUTopology.uniform(
+        sockets=sockets,
+        numa_per_socket=numa_per_socket,
+        cores_per_numa=cores,
+        threads_per_core=threads,
+    )
+    acc = CPUAccumulator(topo)
+    if allocated:
+        acc._allocated |= set(allocated)
+    got = acc.take("p", need, policy=CPUBindPolicy.FULL_PCPUS)
+    return sorted(got) if got is not None else None
+
+
+def test_take_on_non_numa_node():
+    assert take_full(1, 1, 4, 2, [], 2) == [0, 1]
+
+
+def test_take_with_allocated_cpus():
+    assert take_full(1, 1, 4, 2, [0, 1], 2) == [2, 3]
+
+
+def test_take_whole_socket():
+    assert take_full(2, 1, 4, 2, [], 8) == list(range(8))
+
+
+def test_take_across_sockets():
+    assert take_full(2, 1, 4, 2, [], 12) == list(range(12))
+
+
+def test_take_whole_socket_skipping_partial():
+    assert take_full(2, 1, 4, 2, [0, 1], 8) == list(range(8, 16))
+
+
+def test_take_smallest_idle_socket():
+    """allocated 0-5,16-23: socket1 (8 free) is tighter than socket0 (10
+    free) — MostAllocated strategy bin-packs into it."""
+    assert take_full(2, 2, 4, 2, list(range(6)) + list(range(16, 24)), 6) == [
+        24, 25, 26, 27, 28, 29,
+    ]
+
+
+def test_take_most_cpus_on_same_socket():
+    """need exceeds any one socket: drain the largest free socket whole
+    (6-15), top up from the tightest remainder core-by-core (24-25)."""
+    got = take_full(2, 2, 4, 2, list(range(6)) + list(range(16, 24)), 12)
+    assert got == list(range(6, 16)) + [24, 25]
