@@ -93,7 +93,8 @@ def bench_loadaware():
     )
     import jax
 
-    b, p = 64, 512
+    p = 512
+    b = headline.N_PODS // p
     stacked = PodBatch.create(
         requests=fix["req"], estimate=fix["est"],
         priority=fix["prio"], is_prod=fix["is_prod"],
@@ -121,9 +122,9 @@ def bench_loadaware():
     p50, p99 = _percentiles(lat)
     return {
         "scenario": "loadaware_10k_nodes",
-        "pods_per_sec": round(32768 / elapsed, 1),
+        "pods_per_sec": round(headline.N_PODS / elapsed, 1),
         "placed": total_placed,
-        "total": 32768,
+        "total": headline.N_PODS,
         "batch_p50_ms": round(p50, 2),
         "batch_p99_ms": round(p99, 2),
     }
