@@ -37,6 +37,11 @@ class LowNodeLoadArgs:
         default_factory=lambda: {ext.RES_CPU: 45.0, ext.RES_MEMORY: 60.0}
     )
     prod_high_thresholds: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    #: deviation mode (reference UseDeviationThresholds / getNodeThresholds):
+    #: thresholds become offsets around the cluster-average utilization —
+    #: low = avg − low_thresholds, high = avg + high_thresholds, clamped to
+    #: [0, 100]. Spot-checks outliers instead of absolute levels.
+    use_deviation_thresholds: bool = False
     #: consecutive overutilized rounds before a node is actionable
     #: (anomaly detector debounce, low_node_load.go:286-312)
     anomaly_condition_count: int = 2
@@ -78,8 +83,16 @@ class LowNodeLoad:
         lo = self._vec(self.args.low_thresholds)
         active = na.schedulable & na.metric_fresh
         hi_on, lo_on = hi > 0, lo > 0
-        raw_high = active & np.any(hi_on[None, :] & (util > hi[None, :]), axis=1)
-        low = active & np.all(~lo_on[None, :] | (util < lo[None, :]), axis=1)
+        hi_eff = hi[None, :]
+        lo_eff = lo[None, :]
+        if self.args.use_deviation_thresholds and active.any():
+            # calcAverageResourceUsagePercent over active nodes; offsets
+            # around it, normalized to [0, 100]
+            avg = util[active].mean(axis=0)
+            hi_eff = np.clip(avg + hi, 0.0, 100.0)[None, :]
+            lo_eff = np.clip(avg - lo, 0.0, 100.0)[None, :]
+        raw_high = active & np.any(hi_on[None, :] & (util > hi_eff), axis=1)
+        low = active & np.all(~lo_on[None, :] | (util < lo_eff), axis=1)
         # prod tier: a node can be overutilized on prod usage alone
         phi = self._vec(self.args.prod_high_thresholds)
         if (phi > 0).any():

@@ -322,3 +322,31 @@ def test_reservation_ttl_expiry():
     assert rm.expire(now=r.available_time + 30) == []      # not yet
     assert rm.expire(now=r.available_time + 90) == ["ttl-res"]
     assert r.phase == ReservationPhase.FAILED
+
+
+def test_deviation_thresholds_track_cluster_average():
+    """UseDeviationThresholds (low_node_load.go getNodeThresholds): the
+    high/low lines float around the cluster-average utilization, so a
+    node is 'high' for standing out, not for an absolute level."""
+    from koordinator_tpu.descheduler.low_node_load import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+    )
+
+    snap = make_cluster([40.0] * 7 + [70.0])
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(
+            high_thresholds={ext.RES_CPU: 15.0},
+            low_thresholds={ext.RES_CPU: 5.0},
+            use_deviation_thresholds=True,
+            anomaly_condition_count=1,
+        ),
+    )
+    cls = lnl.classify()
+    names = [snap.node_id(f"n{i}") for i in range(8)]
+    assert cls.high[names[7]]
+    assert not cls.high[names[:7]].any()
+    # low band: avg - 5 ≈ 38.75; the 40% nodes are NOT low, and with an
+    # absolute interpretation they all would be (40 < 80)
+    assert not cls.low[names[7]]
