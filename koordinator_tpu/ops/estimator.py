@@ -40,3 +40,41 @@ def scale_vector(
 def estimate_pod_usage(requests: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     """Estimated usage of pending pods: ``requests * scale`` ([..., D])."""
     return requests * scale
+
+
+#: zero-request floors (default_estimator.go:35-39 DefaultMilliCPURequest /
+#: DefaultMemoryRequest = 200*1024*1024 bytes ≡ 200 MiB in snapshot units)
+DEFAULT_MILLI_CPU_REQUEST = 250.0
+DEFAULT_MEMORY_REQUEST_MIB = 200.0
+_DEFAULT_FLOORS: Mapping[str, float] = {
+    ext.RES_CPU: DEFAULT_MILLI_CPU_REQUEST,
+    ext.RES_BATCH_CPU: DEFAULT_MILLI_CPU_REQUEST,
+    ext.RES_MEMORY: DEFAULT_MEMORY_REQUEST_MIB,
+    ext.RES_BATCH_MEMORY: DEFAULT_MEMORY_REQUEST_MIB,
+}
+
+
+def estimate_pod(config, pod, scale: np.ndarray) -> np.ndarray:
+    """Reference-exact single-pod estimate (``estimatedUsedByResource``,
+    ``default_estimator.go:88-123``): base = max(request, limit), scaled
+    and rounded, capped at the limit; a dim with neither request nor limit
+    estimates at the default floor (250m cpu / 200Mi memory) — an
+    unspecified pod is never free. [D] numpy."""
+    req = config.res_vector(pod.spec.requests)
+    lim = config.res_vector(pod.spec.limits)
+    base = np.maximum(req, lim)
+    est = np.round(base * scale)
+    est = np.where(lim > 0, np.minimum(est, lim), est)
+    # The floor covers only the pod's own tier dims — the reference
+    # iterates resourceWeights (cpu, memory) with the resource name
+    # translated by priority class (TranslateResourceNameByPriorityClass),
+    # so a batch pod floors batch-cpu/batch-memory, everyone else cpu/memory.
+    if pod.priority_class == ext.PriorityClass.BATCH:
+        tier = (ext.RES_BATCH_CPU, ext.RES_BATCH_MEMORY)
+    else:
+        tier = (ext.RES_CPU, ext.RES_MEMORY)
+    floors = np.array(
+        [_DEFAULT_FLOORS.get(r, 0.0) if r in tier else 0.0 for r in config.resources],
+        np.float32,
+    )
+    return np.where(base > 0, est, floors).astype(np.float32)

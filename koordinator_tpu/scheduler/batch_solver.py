@@ -138,10 +138,15 @@ class BatchScheduler:
         b = bucket or bucket_size(len(pods), self.snapshot.config.min_bucket)
         if arrays.requests.shape[0] != b:
             raise ValueError("pod bucket mismatch")
-        est = arrays.requests * self._scales[None, :]
-        for i, pod in enumerate(pods):
-            if pod.spec.estimated:
-                est[i] = self._estimate_of(pod)
+        # one estimate per pod, shared with Reserve/reservation commits
+        # (reference estimator semantics live in _estimate_of)
+        est = np.stack([self._estimate_of(pod) for pod in pods]) if pods else (
+            np.zeros((0, arrays.requests.shape[1]), np.float32)
+        )
+        if est.shape[0] != b:
+            est = np.vstack(
+                [est, np.zeros((b - est.shape[0], est.shape[1]), np.float32)]
+            )
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
         chains = self.quotas.chains_for_pods(list(pods), b)
         return PodBatch.create(
@@ -404,7 +409,9 @@ class BatchScheduler:
         larger scaled request."""
         if pod.spec.estimated:
             return self.snapshot.config.res_vector(pod.spec.estimated)
-        return self.snapshot.config.res_vector(pod.spec.requests) * self._scales
+        from ..ops.estimator import estimate_pod
+
+        return estimate_pod(self.snapshot.config, pod, self._scales)
 
     def _commit(
         self, chunk: Sequence[Pod], assignment: np.ndarray

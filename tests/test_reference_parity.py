@@ -345,3 +345,48 @@ def test_take_most_cpus_on_same_socket():
     (6-15), top up from the tightest remainder core-by-core (24-25)."""
     got = take_full(2, 2, 4, 2, list(range(6)) + list(range(16, 24)), 12)
     assert got == list(range(6, 16)) + [24, 25]
+
+
+# ---- DefaultEstimator (default_estimator.go:59-123) ----
+
+
+def test_estimator_semantics():
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import SnapshotConfig
+    from koordinator_tpu.ops.estimator import estimate_pod, scale_vector
+
+    cfg = SnapshotConfig()
+    scales = scale_vector(cfg.resources)
+    cpu_i = cfg.resources.index("cpu")
+    mem_i = cfg.resources.index("memory")
+    bcpu_i = cfg.resources.index("kubernetes.io/batch-cpu")
+
+    # base = max(request, limit): limit 20C dominates request 10C
+    pod = Pod(
+        meta=ObjectMeta(name="p"),
+        spec=PodSpec(
+            requests={"cpu": 10_000, "memory": 1024},
+            limits={"cpu": 20_000},
+            priority=9500,
+        ),
+    )
+    est = estimate_pod(cfg, pod, scales)
+    assert est[cpu_i] == round(20_000 * 0.85)
+    # scaled value capped at the limit (factor > 100 scenario is the
+    # reference's cap case; with a tight limit, cap binds)
+    pod.spec.limits = {"cpu": 10_500}
+    est = estimate_pod(cfg, pod, scales)
+    assert est[cpu_i] == round(10_500 * 0.85)  # below cap, unchanged
+
+    # zero request+limit floors at 250m / 200Mi on the pod's own tier
+    empty_prod = Pod(
+        meta=ObjectMeta(name="e"), spec=PodSpec(priority=9500)
+    )
+    est = estimate_pod(cfg, empty_prod, scales)
+    assert est[cpu_i] == 250.0 and est[mem_i] == 200.0
+    assert est[bcpu_i] == 0.0
+    empty_batch = Pod(
+        meta=ObjectMeta(name="b"), spec=PodSpec(priority=5500)
+    )
+    est = estimate_pod(cfg, empty_batch, scales)
+    assert est[bcpu_i] == 250.0 and est[cpu_i] == 0.0
