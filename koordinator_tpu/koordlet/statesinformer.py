@@ -90,6 +90,34 @@ class FakeDeviceProber:
         return list(self.devices)
 
 
+class TpuDeviceProber:
+    """TPU-host device discovery — the TPU-native analog of the
+    reference's NVML GPU enumeration (``impl/states_device_linux.go``):
+    on a TPU node the Device CR inventories TPU chips, discovered through
+    the JAX runtime. Interconnect-complete groups (one chip's cores; a
+    host's chips sharing an ICI domain) surface through the Device
+    partition table just like NVLink groups do for GPUs."""
+
+    def probe(self) -> List[DeviceInfo]:
+        try:
+            import jax
+
+            devices = jax.devices()
+        except Exception:  # noqa: BLE001 — no runtime = no inventory
+            return []
+        out: List[DeviceInfo] = []
+        for d in devices:
+            out.append(
+                DeviceInfo(
+                    dev_type="tpu",
+                    minor=int(getattr(d, "id", len(out))),
+                    resources={"google.com/tpu": 1.0},
+                    numa_node=int(getattr(d, "process_index", -1)),
+                )
+            )
+        return out
+
+
 class StatesInformer:
     """Holds the latest node-local state; setters fire callbacks."""
 

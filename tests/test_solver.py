@@ -444,3 +444,48 @@ def test_fidelity_sweep_random_fixtures():
 
         band = 0.15 if thresholds[0] > 0 else 0.30
         assert peak(got) <= peak(want) + band, (ctx, peak(got), peak(want))
+
+
+def test_gang_rollback_refunds_quota():
+    """SURVEY hard part (c) — gang × quota joint constraint: when a gang
+    misses minMember and rolls back, its members' quota charges must be
+    refunded, or the next cycle sees phantom consumption (the reference
+    resolves the interplay with Permit-time rejection + Unreserve refunds)."""
+    from koordinator_tpu.ops.solver import QuotaState
+
+    d = 2
+    # node fits exactly 2 pods; gang of 3 with minMember 3 can never place
+    alloc = np.array([[8.0, 8.0]], np.float32)
+    req = np.full((4, d), 4.0, np.float32)
+    prio = np.array([9000, 9000, 9000, 5000], np.int32)
+    gang_id = np.array([0, 0, 0, -1], np.int32)
+    gang_min = np.array([3, 0, 0, 0], np.int32)
+    chain = np.full((4, 4), -1, np.int32)
+    chain[:, 0] = 0
+    pods = PodBatch.create(
+        requests=req,
+        estimate=req,
+        priority=prio,
+        gang_id=gang_id,
+        gang_min=gang_min,
+        quota_chain=chain,
+    )
+    nodes = NodeState.create(allocatable=alloc)
+    params = SolverParams(
+        usage_thresholds=jnp.zeros(d),
+        prod_thresholds=jnp.zeros(d),
+        score_weights=jnp.ones(d),
+    )
+    quotas = QuotaState(
+        runtime=jnp.full((2, d), 100.0, jnp.float32),
+        used=jnp.zeros((2, d), jnp.float32),
+    )
+    res = assign(pods, nodes, params, quotas=quotas)
+    got = np.asarray(res.assignment)
+    # gang rolled back entirely; the non-gang pod may hold the node
+    assert (got[:3] == -1).all()
+    # quota used reflects ONLY surviving placements — gang charges refunded
+    placed_req = req[got >= 0].sum(0) if (got >= 0).any() else np.zeros(d)
+    np.testing.assert_allclose(np.asarray(res.quota_used)[0], placed_req, atol=1e-4)
+    # node capacity also returned
+    assert np.asarray(res.node_requested)[0].max() <= 8.0 + 1e-4

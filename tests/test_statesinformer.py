@@ -162,3 +162,17 @@ class TestDaemonWiring:
         assert "koordlet_collector_last_collect_ts" in text or (
             "koordlet_collect_errors_total" in text
         )
+
+
+def test_tpu_device_prober_reports_chips():
+    """TPU chips surface through the same Device CR path GPUs do (the
+    NVML-analog discovery for TPU hosts)."""
+    from koordinator_tpu.koordlet.statesinformer import TpuDeviceProber
+
+    devs = TpuDeviceProber().probe()
+    # CPU test env: jax still enumerates >=1 device; each reports one chip
+    assert len(devs) >= 1
+    assert all(d.dev_type == "tpu" for d in devs)
+    assert all(d.resources == {"google.com/tpu": 1.0} for d in devs)
+    minors = [d.minor for d in devs]
+    assert len(set(minors)) == len(minors)
