@@ -128,3 +128,102 @@ def merge_hints(
     key = jnp.where(feasible & (ids > 0), bits * m + ids, jnp.iinfo(jnp.int32).max)
     best = jnp.argmin(key).astype(jnp.int32)
     return jnp.where(jnp.min(key) == jnp.iinfo(jnp.int32).max, -1, best)
+
+
+# ---- host-side provider-hint merge (reference policy.go mergeFilteredHints
+# / mergePermutation / iterateAllProviderTopologyHints) ----
+#
+# The vectorized merge_hints above serves the solver's zone feasibility;
+# this mirror reproduces the reference's per-winner hint negotiation
+# exactly (permutation AND-merge, preferred propagation, narrowest-wins
+# with score tie-break) for the host Reserve path and parity tests.
+
+import dataclasses as _dc
+from itertools import product as _product
+from typing import Optional as _Optional, Sequence as _Sequence
+
+
+@_dc.dataclass
+class TopologyHint:
+    """One provider hint: ``affinity`` is a zone bitmask (None = no
+    preference / any), ``preferred`` mirrors the reference flag."""
+
+    affinity: _Optional[int] = None
+    preferred: bool = True
+    score: float = 0.0
+    unsatisfied: bool = False
+
+
+def _popcount(x: int) -> int:
+    return bin(x).count("1")
+
+
+def _narrower(a: int, b: int) -> bool:
+    """bitmask.IsNarrowerThan: fewer bits set, or equal count and lower."""
+    ca, cb = _popcount(a), _popcount(b)
+    if ca != cb:
+        return ca < cb
+    return a < b
+
+
+def filter_provider_hints(
+    providers: _Sequence[_Optional[_Sequence[TopologyHint]]],
+) -> list:
+    """``filterProvidersHints``: a provider with no hints contributes a
+    single preferred any-NUMA hint; an empty hint list (resource cannot be
+    satisfied on any zone set) contributes an unsatisfied, unpreferred
+    hint."""
+    out = []
+    for hints in providers:
+        if hints is None:
+            out.append([TopologyHint(affinity=None, preferred=True)])
+        elif len(hints) == 0:
+            out.append(
+                [TopologyHint(affinity=None, preferred=False, unsatisfied=True)]
+            )
+        else:
+            out.append(list(hints))
+    return out
+
+
+def merge_provider_hints(
+    providers: _Sequence[_Optional[_Sequence[TopologyHint]]],
+    n_zones: int,
+) -> TopologyHint:
+    """``mergeFilteredHints``: iterate every one-hint-per-provider
+    permutation, AND the affinities, and keep the best merged hint —
+    preferred beats non-preferred, then narrowest affinity, then highest
+    accumulated score."""
+    default_mask = (1 << n_zones) - 1
+    filtered = filter_provider_hints(providers)
+    best = TopologyHint(affinity=default_mask, preferred=False)
+    for permutation in _product(*filtered):
+        affs = [h.affinity for h in permutation if h.affinity is not None]
+        preferred = all(h.preferred for h in permutation)
+        if affs and any(a != affs[0] for a in affs):
+            preferred = False
+        merged = default_mask
+        for a in affs:
+            merged &= a
+        if _popcount(merged) == 0:
+            continue
+        score = sum(
+            h.score
+            for h in permutation
+            if h.affinity is not None and h.affinity == merged
+        )
+        cand = TopologyHint(affinity=merged, preferred=preferred, score=score)
+        if cand.preferred and not best.preferred:
+            best = cand
+            continue
+        if not cand.preferred and best.preferred:
+            continue
+        if not _narrower(cand.affinity, best.affinity):
+            if (
+                _popcount(cand.affinity) == _popcount(best.affinity)
+                and cand.score > best.score
+            ):
+                best = cand
+            continue
+        best = cand
+    return best

@@ -390,3 +390,76 @@ def test_estimator_semantics():
     )
     est = estimate_pod(cfg, empty_batch, scales)
     assert est[bcpu_i] == 250.0 and est[cpu_i] == 0.0
+
+
+# ---- topology-manager hint merge
+# (policy_test.go commonPolicyMergeTestCases / policy.go mergeFilteredHints) ----
+
+from koordinator_tpu.ops.numa import TopologyHint, merge_provider_hints
+
+
+def test_hint_merge_same_mask_both_preferred():
+    """"Two providers, 1 hint each, same mask, both preferred": merged =
+    the shared mask, preferred."""
+    for mask in (0b01, 0b10):
+        got = merge_provider_hints(
+            [
+                [TopologyHint(affinity=mask, preferred=True)],
+                [TopologyHint(affinity=mask, preferred=True)],
+            ],
+            n_zones=2,
+        )
+        assert got.affinity == mask and got.preferred
+
+
+def test_hint_merge_no_preference_provider_passes_through():
+    """"Two providers, 1 no hints, 1 single hint preferred": the silent
+    provider contributes a preferred any-NUMA hint."""
+    got = merge_provider_hints(
+        [None, [TopologyHint(affinity=0b01, preferred=True)]], n_zones=2
+    )
+    assert got.affinity == 0b01 and got.preferred
+
+
+def test_hint_merge_conflicting_masks_fall_back_to_default():
+    """Disjoint single-zone hints AND to zero and are skipped; the best
+    hint stays the non-preferred any-NUMA default (bestEffort admits it,
+    restricted/single-numa reject non-preferred)."""
+    got = merge_provider_hints(
+        [
+            [TopologyHint(affinity=0b01, preferred=True)],
+            [TopologyHint(affinity=0b10, preferred=True)],
+        ],
+        n_zones=2,
+    )
+    assert got.affinity == 0b11 and not got.preferred
+
+
+def test_hint_merge_narrowest_preferred_wins():
+    """A provider offering {0} and {0,1} both preferred against an
+    any-NUMA provider: the narrower {0} wins."""
+    got = merge_provider_hints(
+        [
+            [
+                TopologyHint(affinity=0b11, preferred=True),
+                TopologyHint(affinity=0b01, preferred=True),
+            ],
+            None,
+        ],
+        n_zones=2,
+    )
+    assert got.affinity == 0b01 and got.preferred
+
+
+def test_hint_merge_cross_mask_permutation_unpreferred():
+    """{0} x {0,1}: the merged affinity {0} exists but mixes unequal
+    affinities, so it is NOT preferred — yet it still beats the default
+    when no preferred candidate exists (policy_best_effort admits it)."""
+    got = merge_provider_hints(
+        [
+            [TopologyHint(affinity=0b01, preferred=True)],
+            [TopologyHint(affinity=0b11, preferred=False)],
+        ],
+        n_zones=2,
+    )
+    assert got.affinity == 0b01 and not got.preferred
