@@ -227,3 +227,48 @@ def merge_provider_hints(
             continue
         best = cand
     return best
+
+
+def policy_merge(
+    providers: _Sequence[_Optional[_Sequence[TopologyHint]]],
+    n_zones: int,
+    policy: "NUMAPolicy | int",
+) -> tuple:
+    """Per-policy Merge + canAdmitPodResult (reference policy_*.go):
+
+    - none:             no merge, always admit.
+    - best-effort:      merged hint, always admit.
+    - restricted:       merged hint, admit iff preferred.
+    - single-numa-node: hints filtered to single-zone (or preferred
+      don't-care) before the merge; an all-NUMA result degrades to a
+      nil-affinity hint; admit iff preferred.
+
+    Returns (TopologyHint, admit: bool).
+    """
+    from ..core.topology import NUMAPolicy as _NP
+
+    policy = _NP(int(policy))
+    if policy == _NP.NONE:
+        return TopologyHint(affinity=None, preferred=True), True
+    if policy == _NP.SINGLE_NUMA_NODE:
+        filtered = []
+        for hints in providers:
+            if hints is None or len(hints) == 0:
+                filtered.append(hints)
+                continue
+            kept = [
+                h
+                for h in hints
+                if (h.affinity is None and h.preferred)
+                or (h.affinity is not None and _popcount(h.affinity) == 1)
+            ]
+            filtered.append(kept)
+        best = merge_provider_hints(filtered, n_zones)
+        default_mask = (1 << n_zones) - 1
+        if best.affinity == default_mask:
+            best = TopologyHint(affinity=None, preferred=best.preferred)
+        return best, best.preferred
+    best = merge_provider_hints(providers, n_zones)
+    if policy == _NP.RESTRICTED:
+        return best, best.preferred
+    return best, True   # BEST_EFFORT

@@ -463,3 +463,38 @@ def test_hint_merge_cross_mask_permutation_unpreferred():
         n_zones=2,
     )
     assert got.affinity == 0b01 and not got.preferred
+
+
+def test_policy_admission_rules():
+    """canAdmitPodResult per policy: restricted/single-numa admit only
+    preferred results; best-effort admits anything; single-numa filters
+    multi-zone hints before merging and degrades an all-NUMA result to a
+    nil affinity (policy_single_numa_node.go:47-84)."""
+    from koordinator_tpu.core.topology import NUMAPolicy
+    from koordinator_tpu.ops.numa import policy_merge
+
+    conflicting = [
+        [TopologyHint(affinity=0b01, preferred=True)],
+        [TopologyHint(affinity=0b10, preferred=True)],
+    ]
+    aligned = [
+        [TopologyHint(affinity=0b01, preferred=True)],
+        [TopologyHint(affinity=0b01, preferred=True)],
+    ]
+    multi_zone = [[TopologyHint(affinity=0b11, preferred=True)]]
+
+    best, admit = policy_merge(aligned, 2, NUMAPolicy.SINGLE_NUMA_NODE)
+    assert admit and best.affinity == 0b01
+    best, admit = policy_merge(conflicting, 2, NUMAPolicy.SINGLE_NUMA_NODE)
+    assert not admit
+    # multi-zone hint filtered out under single-numa: merge degrades to the
+    # nil-affinity default and the pod is rejected
+    best, admit = policy_merge(multi_zone, 2, NUMAPolicy.SINGLE_NUMA_NODE)
+    assert not admit and best.affinity is None
+
+    best, admit = policy_merge(conflicting, 2, NUMAPolicy.RESTRICTED)
+    assert not admit
+    best, admit = policy_merge(conflicting, 2, NUMAPolicy.BEST_EFFORT)
+    assert admit and best.affinity == 0b11 and not best.preferred
+    _best, admit = policy_merge(conflicting, 2, NUMAPolicy.NONE)
+    assert admit
