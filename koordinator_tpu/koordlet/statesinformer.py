@@ -112,7 +112,11 @@ class TpuDeviceProber:
                     dev_type="tpu",
                     minor=int(getattr(d, "id", len(out))),
                     resources={"google.com/tpu": 1.0},
-                    numa_node=int(getattr(d, "process_index", -1)),
+                    # real NUMA locality isn't exposed by the JAX runtime;
+                    # -1 = unknown (process_index is a host index, not a
+                    # NUMA domain — reporting it would mislead topology
+                    # packing)
+                    numa_node=-1,
                 )
             )
         return out

@@ -63,7 +63,9 @@ def estimate_pod(config, pod, scale: np.ndarray) -> np.ndarray:
     req = config.res_vector(pod.spec.requests)
     lim = config.res_vector(pod.spec.limits)
     base = np.maximum(req, lim)
-    est = np.round(base * scale)
+    # floor(x+0.5) = Go math.Round for non-negative values (np.round would
+    # round half to even — same convention note as masks.usage_percent)
+    est = np.floor(base * scale + 0.5)
     est = np.where(lim > 0, np.minimum(est, lim), est)
     # The floor covers only the pod's own tier dims — the reference
     # iterates resourceWeights (cpu, memory) with the resource name

@@ -81,7 +81,7 @@ def _kernel(
         req_free = alloc - node_req_ref[d : d + 1, :]
         pod_req = pod_req_ref[:, d : d + 1]               # [TP, 1]
         pod_est = pod_est_ref[:, d : d + 1]
-        feas = feas & (pod_req <= req_free + 1e-6)
+        feas = feas & (pod_req <= req_free + 1e-3)  # masks.EPS slack
         after = node_est_ref[d : d + 1, :] + pod_est      # [TP, TN]
         thr = params_ref[0, d]
         # rounded-percent threshold check (masks.usage_percent semantics)

@@ -539,8 +539,15 @@ def assign(
         accept &= ~(sprod & fresh_g & jnp.any(pover, axis=-1))
         # Spread quantum: prior intra-round acceptance on this node must stay
         # under quantum × allocatable (first pod of a segment always passes).
+        # Dims the node doesn't provide (alloc 0, e.g. batch tiers before
+        # the noderesource controller publishes them) are exempt — the
+        # estimator's tier floors would otherwise serialize every batch-band
+        # pod onto its own round.
         prior_est = seg_est - sest
-        accept &= jnp.all(prior_est <= round_quantum * alloc_g + EPS, axis=-1)
+        accept &= jnp.all(
+            (alloc_g <= 0) | (prior_est <= round_quantum * alloc_g + EPS),
+            axis=-1,
+        )
 
         # Quota admission: cumulative along the chain in priority order;
         # a node-accepted pod must also clear every quota level.
@@ -654,7 +661,7 @@ def solve_stream(
     cost_transform=None,
     nomination_jitter: float = 4.0,
     approx_topk: bool = False,
-) -> tuple[jnp.ndarray, NodeState, jnp.ndarray]:
+) -> tuple[jnp.ndarray, NodeState, jnp.ndarray, QuotaState]:
     """Pipelined multi-batch solve: ``lax.scan`` over a [B, P, ...] stacked
     ``PodBatch``, threading consumed node (and quota) capacity between
     batches entirely on device.

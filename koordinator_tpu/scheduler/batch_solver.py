@@ -138,15 +138,39 @@ class BatchScheduler:
         b = bucket or bucket_size(len(pods), self.snapshot.config.min_bucket)
         if arrays.requests.shape[0] != b:
             raise ValueError("pod bucket mismatch")
-        # one estimate per pod, shared with Reserve/reservation commits
-        # (reference estimator semantics live in _estimate_of)
-        est = np.stack([self._estimate_of(pod) for pod in pods]) if pods else (
-            np.zeros((0, arrays.requests.shape[1]), np.float32)
+        # One estimate per pod, shared with Reserve/reservation commits.
+        # The common case (requests only, no limits, no explicit estimate)
+        # vectorizes: round(requests × scale) with the zero-request tier
+        # floors; pods with overrides fall back to the per-pod estimator.
+        from ..ops.estimator import (
+            DEFAULT_MEMORY_REQUEST_MIB,
+            DEFAULT_MILLI_CPU_REQUEST,
         )
-        if est.shape[0] != b:
-            est = np.vstack(
-                [est, np.zeros((b - est.shape[0], est.shape[1]), np.float32)]
-            )
+
+        cfg = self.snapshot.config
+        est = np.floor(arrays.requests * self._scales[None, :] + 0.5).astype(
+            np.float32
+        )
+        floors_prod = cfg.res_vector(
+            {
+                ext.RES_CPU: DEFAULT_MILLI_CPU_REQUEST,
+                ext.RES_MEMORY: DEFAULT_MEMORY_REQUEST_MIB,
+            }
+        )
+        floors_batch = cfg.res_vector(
+            {
+                ext.RES_BATCH_CPU: DEFAULT_MILLI_CPU_REQUEST,
+                ext.RES_BATCH_MEMORY: DEFAULT_MEMORY_REQUEST_MIB,
+            }
+        )
+        is_batch_pod = arrays.prio_class == int(ext.PriorityClass.BATCH)
+        floors = np.where(
+            is_batch_pod[:, None], floors_batch[None, :], floors_prod[None, :]
+        ) * arrays.valid[:, None]
+        est = np.where(arrays.requests > 0, est, floors).astype(np.float32)
+        for i, pod in enumerate(pods):
+            if pod.spec.estimated or pod.spec.limits:
+                est[i] = self._estimate_of(pod)
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
         chains = self.quotas.chains_for_pods(list(pods), b)
         return PodBatch.create(
