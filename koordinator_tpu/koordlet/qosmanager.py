@@ -142,17 +142,30 @@ def cpu_evict(
     satisfaction_threshold: float,
     usage_threshold_percent: float,
     be_pods: Sequence[Tuple[str, float, int]],
+    satisfaction_upper_threshold: float | None = None,
 ) -> EvictDecision:
-    """``cpuevict``: evict when BE satisfaction (limit/request) collapses
-    below threshold while BE usage saturates its shrunken limit."""
+    """``cpuevict`` (``calculateResourceMilliToRelease``,
+    ``cpu_evict.go:262-282``): evict when BE satisfaction (realLimit /
+    request) collapses below the lower threshold while BE usage saturates
+    its shrunken limit; the release amount is
+    ``request × (upperPercent − satisfactionRate)`` — restore satisfaction
+    to the upper watermark, not merely the lower bound."""
     if be_cpu_request_milli <= 0 or be_cpu_limit_milli <= 0:
         return EvictDecision(False, [])
     satisfaction = be_cpu_limit_milli / be_cpu_request_milli
     usage_ratio = be_cpu_usage_milli * 100.0 / be_cpu_limit_milli
     if satisfaction >= satisfaction_threshold or usage_ratio < usage_threshold_percent:
         return EvictDecision(False, [])
-    # release enough BE request to restore satisfaction
-    need_release = be_cpu_request_milli - be_cpu_limit_milli / satisfaction_threshold
+    upper = (
+        satisfaction_upper_threshold
+        if satisfaction_upper_threshold is not None
+        else satisfaction_threshold
+    )
+    rate_gap = upper - satisfaction
+    if rate_gap <= 0:
+        return EvictDecision(False, [])
+    # int64(milliRelease) truncation, as the reference casts
+    need_release = float(int(be_cpu_request_milli * rate_gap))
     victims: List[str] = []
     released = 0.0
     for uid, req, _prio in sorted(be_pods, key=lambda x: (x[2], -x[1])):

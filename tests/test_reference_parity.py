@@ -498,3 +498,31 @@ def test_policy_admission_rules():
     assert admit and best.affinity == 0b11 and not best.preferred
     _best, admit = policy_merge(conflicting, 2, NUMAPolicy.NONE)
     assert admit
+
+
+def test_cpu_evict_release_amount():
+    """calculateResourceMilliToRelease: release = request x (upper% -
+    satisfactionRate); skip when satisfaction is above the lower bound or
+    the gap is non-positive."""
+    from koordinator_tpu.koordlet.qosmanager import cpu_evict
+
+    pods = [(f"p{i}", 2_000.0, 5000) for i in range(10)]
+    # request 20C, realLimit 6C -> satisfaction 0.3 < lower 0.35;
+    # release = 20C x (0.4 - 0.3) = 2C -> one 2C victim
+    dec = cpu_evict(
+        20_000, 5_900, 6_000, 0.35, 90.0, pods,
+        satisfaction_upper_threshold=0.40,
+    )
+    assert dec.evict and len(dec.victims) == 1
+    # satisfaction above lower bound: no eviction
+    dec = cpu_evict(
+        20_000, 5_900, 8_000, 0.35, 90.0, pods,
+        satisfaction_upper_threshold=0.40,
+    )
+    assert not dec.evict
+    # usage below the saturation gate: no eviction
+    dec = cpu_evict(
+        20_000, 1_000, 6_000, 0.35, 90.0, pods,
+        satisfaction_upper_threshold=0.40,
+    )
+    assert not dec.evict
