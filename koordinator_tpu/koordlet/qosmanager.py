@@ -191,6 +191,51 @@ def cpu_burst_plan(
     return [(pod_group, rex.CPU_BURST, str(burst_us))]
 
 
+class BurstLimiter:
+    """Token bucket gating sustained CFS quota bursting (reference
+    ``burstLimiter``, ``cpu_burst.go:112-163``): capacity =
+    burstPeriodSec × (maxScalePercent − 100); usage ≥ 100% consumes
+    ``(usage% − 100) × Δt`` tokens, usage < 60% saves ``(100 − usage%) ×
+    Δt``, both clamped to ±capacity; bursting is allowed while the token
+    count is positive. ``init_ratio`` replaces the reference's random
+    [0, 0.5) initial fill for determinism in tests."""
+
+    CONSUME_AT_PERCENT = 100
+    SAVE_BELOW_PERCENT = 60
+
+    def __init__(
+        self,
+        burst_period_s: float,
+        max_scale_percent: float,
+        now: float,
+        init_ratio: float = 0.25,
+    ):
+        self.capacity = int(burst_period_s * (max_scale_percent - 100))
+        self.tokens = int(self.capacity * init_ratio)
+        self.last_update = now
+        self.expire_s = 2 * burst_period_s
+
+    def allow(self, now: float, usage_scale_percent: float) -> Tuple[bool, int]:
+        past = now - self.last_update
+        if usage_scale_percent >= self.CONSUME_AT_PERCENT:
+            self.tokens -= int((usage_scale_percent - 100) * int(past))
+        elif usage_scale_percent < self.SAVE_BELOW_PERCENT:
+            self.tokens += int((100 - usage_scale_percent) * int(past))
+        self.tokens = max(min(self.tokens, self.capacity), -self.capacity)
+        self.last_update = now
+        return self.tokens > 0, self.tokens
+
+    def update_if_changed(
+        self, burst_period_s: float, max_scale_percent: float, now: float
+    ) -> None:
+        new_capacity = int(burst_period_s * (max_scale_percent - 100))
+        if new_capacity != self.capacity:
+            self.__init__(burst_period_s, max_scale_percent, now)
+
+    def expired(self, now: float) -> bool:
+        return now - self.last_update > self.expire_s
+
+
 def cg_reconcile_plan(total_cpus: int) -> List[Tuple[str, str, str]]:
     """``cgreconcile``: baseline tier-root knobs (burstable unrestricted,
     besteffort at minimum shares) re-asserted every tick; the executor's

@@ -526,3 +526,33 @@ def test_cpu_evict_release_amount():
         satisfaction_upper_threshold=0.40,
     )
     assert not dec.evict
+
+
+def test_burst_limiter_token_bucket():
+    """burstLimiter (cpu_burst.go:112-163): capacity = period x (scale -
+    100); overuse drains (usage - 100) x dt, usage < 60% refills
+    (100 - usage) x dt, clamped to +-capacity; burst allowed while
+    tokens > 0."""
+    from koordinator_tpu.koordlet.qosmanager import BurstLimiter
+
+    lim = BurstLimiter(
+        burst_period_s=300, max_scale_percent=200, now=0.0, init_ratio=0.25
+    )
+    assert lim.capacity == 300 * 100
+    assert lim.tokens == 7500
+    # sustained 150% usage: drains 50 tokens/s; 7500/50 = 150s to empty
+    ok, tokens = lim.allow(100.0, 150)     # -5000
+    assert ok and tokens == 2500
+    ok, tokens = lim.allow(160.0, 150)     # -3000 -> -500: burst denied
+    assert not ok and tokens == -500
+    # idle at 40%: refills 60 tokens/s
+    ok, tokens = lim.allow(260.0, 40)      # +6000 -> 5500
+    assert ok and tokens == 5500
+    # clamped at capacity
+    ok, tokens = lim.allow(5000.0, 0)
+    assert tokens == lim.capacity
+    # 80% usage neither drains nor saves (60 <= u < 100)
+    ok, tokens = lim.allow(5010.0, 80)
+    assert tokens == lim.capacity
+    assert not lim.expired(5020.0)
+    assert lim.expired(5011.0 + 600.0)
