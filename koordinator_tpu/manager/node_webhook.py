@@ -15,6 +15,35 @@ from .noderesource import ColocationStrategy
 from .noderesource_plugins import parse_amplification
 
 
+def mutate_node_status(node: Node) -> Node:
+    """Apply resource-amplification ratios to the node's allocatable
+    (reference ``pkg/webhook/node/mutating``): the RAW allocatable is
+    preserved in the raw-allocatable annotation (idempotent across
+    repeated status updates — ratios always apply to the raw base, never
+    compound), and the amplified values land in status.allocatable where
+    the scheduler's informer — here the snapshot — picks them up."""
+    import json
+
+    ratios = parse_amplification(node)
+    if not ratios:
+        return node
+    raw_s = node.meta.annotations.get(ext.ANNOTATION_NODE_RAW_ALLOCATABLE)
+    if raw_s:
+        try:
+            raw = {k: float(v) for k, v in json.loads(raw_s).items()}
+        except (ValueError, TypeError):
+            raw = dict(node.status.allocatable)
+    else:
+        raw = dict(node.status.allocatable)
+        node.meta.annotations[ext.ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
+            raw
+        )
+    for res, ratio in ratios.items():
+        if res in raw and ratio >= 1.0:
+            node.status.allocatable[res] = raw[res] * ratio
+    return node
+
+
 def validate_node(node: Node) -> List[str]:
     """Amplification ratios must parse and be ≥ 1.0 (reference
     ``pkg/webhook/node/validating``)."""

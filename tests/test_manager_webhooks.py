@@ -341,3 +341,39 @@ def test_colocation_controller_reconciles_existing_pods():
     assert ext.RES_CPU in bound.spec.requests
     assert bound.meta.labels["managed"] == "koord"
     assert bound.spec.priority is None
+
+
+def test_node_amplification_mutation_idempotent():
+    """pkg/webhook/node/mutating: amplified allocatable = raw x ratio with
+    the raw base preserved in the annotation, so repeated status updates
+    never compound the ratio; the scheduler snapshot then sees amplified
+    capacity."""
+    import json
+
+    from koordinator_tpu.manager.node_webhook import mutate_node_status
+
+    node = Node(
+        meta=ObjectMeta(
+            name="amp",
+            annotations={
+                ext.ANNOTATION_NODE_AMPLIFICATION: f"{ext.RES_CPU}=1.5"
+            },
+        ),
+        status=NodeStatus(allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 1024}),
+    )
+    mutate_node_status(node)
+    assert node.status.allocatable[ext.RES_CPU] == 96000
+    assert node.status.allocatable[ext.RES_MEMORY] == 1024
+    raw = json.loads(node.meta.annotations[ext.ANNOTATION_NODE_RAW_ALLOCATABLE])
+    assert raw[ext.RES_CPU] == 64000
+    # idempotent: a second webhook pass must not compound
+    mutate_node_status(node)
+    assert node.status.allocatable[ext.RES_CPU] == 96000
+
+    # the snapshot ingests the amplified capacity
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+
+    snap = ClusterSnapshot()
+    idx = snap.upsert_node(node)
+    cpu_i = list(snap.config.resources).index(ext.RES_CPU)
+    assert snap.nodes.allocatable[idx][cpu_i] == 96000
