@@ -29,6 +29,24 @@ def gang_key_of(pod: Pod) -> Optional[str]:
     return f"{pod.meta.namespace}/{gang}"
 
 
+def gang_group_of(pod: Pod, own_key: str) -> frozenset:
+    """The gang group this pod's gang belongs to: the gang-groups
+    annotation lists gang keys ("ns/name") that Permit treats atomically
+    (reference ``apis/extension/coscheduling.go`` AnnotationGangGroups).
+    Always includes the pod's own gang."""
+    import json
+
+    raw = pod.meta.annotations.get(ext.ANNOTATION_GANG_GROUPS)
+    keys = {own_key}
+    if raw:
+        try:
+            for item in json.loads(raw):
+                keys.add(str(item))
+        except (ValueError, TypeError):
+            pass
+    return frozenset(keys)
+
+
 @dataclasses.dataclass
 class _GangState:
     #: None = minMember unknown (label-only gang without min-available):
@@ -179,10 +197,15 @@ class PodGroupManager:
         self, results: Iterable[Tuple[Pod, Optional[str]]]
     ) -> Tuple[List[Tuple[Pod, str]], List[Pod]]:
         """All-or-nothing Permit over one batch's commit results: gangs with
-        fewer than minMember surviving placements are rejected whole."""
+        fewer than minMember surviving placements are rejected whole, and a
+        gang linked into a gang *group* (the gang-groups annotation,
+        reference ``core/core.go:346-465`` AllowGangGroup) passes only when
+        every gang in its group passes — one failing gang rejects the
+        whole group's placements."""
         results = list(results)
         placed_per_gang: Dict[str, int] = {}
         members_per_gang: Dict[str, int] = {}
+        groups_of_gang: Dict[str, frozenset] = {}
         for pod, node in results:
             key = gang_key_of(pod)
             if key is None:
@@ -190,6 +213,26 @@ class PodGroupManager:
             members_per_gang[key] = members_per_gang.get(key, 0) + 1
             if node is not None:
                 placed_per_gang[key] = placed_per_gang.get(key, 0) + 1
+            if key not in groups_of_gang:
+                groups_of_gang[key] = gang_group_of(pod, key)
+
+        def gang_passes(key: str) -> bool:
+            state = self._gangs.get(key)
+            fallback = members_per_gang.get(key, 0)
+            need = state.effective_min(fallback) if state else fallback
+            have = placed_per_gang.get(key, 0) + (state.bound if state else 0)
+            return have >= need
+
+        gang_ok = {key: gang_passes(key) for key in members_per_gang}
+        group_ok: Dict[str, bool] = {}
+        for key in members_per_gang:
+            # every linked gang that appears in this batch must pass;
+            # linked gangs absent from the batch gate via PreEnqueue
+            group_ok[key] = all(
+                gang_ok.get(linked, True)
+                for linked in groups_of_gang.get(key, frozenset({key}))
+            ) and gang_ok[key]
+
         allowed: List[Tuple[Pod, str]] = []
         rejected: List[Pod] = []
         for pod, node in results:
@@ -197,15 +240,8 @@ class PodGroupManager:
             if node is None:
                 rejected.append(pod)
                 continue
-            if key is not None:
-                state = self._gangs.get(key)
-                fallback = members_per_gang.get(key, 0)
-                need = state.effective_min(fallback) if state else fallback
-                have = placed_per_gang.get(key, 0) + (
-                    state.bound if state else 0
-                )
-                if have < need:
-                    rejected.append(pod)
-                    continue
+            if key is not None and not group_ok.get(key, True):
+                rejected.append(pod)
+                continue
             allowed.append((pod, node))
         return allowed, rejected

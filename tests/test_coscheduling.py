@@ -229,3 +229,39 @@ def test_gang_timeout_backoff():
     # clock reset -> next cycle eligible again
     ok, _ = mgr.pre_enqueue(pod, now=1001.0)
     assert ok
+
+
+def test_gang_group_atomicity_at_permit():
+    """AllowGangGroup (core/core.go:346-465): gangs linked by the
+    gang-groups annotation pass Permit together or not at all — a failing
+    member gang rejects the sibling gang's otherwise-complete placements."""
+    import json
+
+    mgr = PodGroupManager()
+    group = json.dumps(["default/ga", "default/gb"])
+
+    def member(gang, i, node):
+        p = gang_pod(f"{gang}-{i}", gang, min_avail=2)
+        p.meta.annotations[ext.ANNOTATION_GANG_GROUPS] = group
+        return (p, node)
+
+    # ga fully placed; gb placed only 1/2 -> the WHOLE group rejects
+    results = [
+        member("ga", 0, "n0"),
+        member("ga", 1, "n1"),
+        member("gb", 0, "n0"),
+        member("gb", 1, None),
+    ]
+    allowed, rejected = mgr.permit(results)
+    assert allowed == []
+    assert len(rejected) == 4
+
+    # both complete -> everything admits
+    results_ok = [
+        member("ga", 0, "n0"),
+        member("ga", 1, "n1"),
+        member("gb", 0, "n0"),
+        member("gb", 1, "n1"),
+    ]
+    allowed, rejected = mgr.permit(results_ok)
+    assert len(allowed) == 4 and rejected == []
