@@ -230,6 +230,9 @@ class BatchScheduler:
                     remaining_pending.append(pod)
                     continue
                 patch: Dict[str, str] = {}
+                # free the ghost's reserved cpuset/minors first so the
+                # owner can take exactly what was held for it
+                self.reservations.release_ghost_holds(r)
                 if self.numa is not None:
                     numa_patch = self.numa.allocate(pod, node)
                     if numa_patch is None:

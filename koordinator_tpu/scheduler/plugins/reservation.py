@@ -149,16 +149,35 @@ class ReservationManager:
                 return r
         return None
 
+    def release_ghost_holds(self, reservation: Reservation) -> None:
+        """Release the ghost's per-winner NUMA/device allocations (the
+        reservation's reserved cpuset + device minors). Called before an
+        owner pod's own Reserve so it can take the freed minors — the
+        reference restores reserved device resources into the node state
+        for owners the same way (deviceshare Reservation hooks)."""
+        node = reservation.node_name
+        if node is None:
+            return
+        uid = _ghost_uid(reservation)
+        if getattr(self.scheduler, "devices", None) is not None:
+            self.scheduler.devices.release(uid, node)
+        if getattr(self.scheduler, "numa", None) is not None:
+            self.scheduler.numa.release(uid, node)
+
     def allocate(self, reservation: Reservation, pod: Pod) -> str:
         """Commit a pod against a reservation.
 
         The full ghost hold is forgotten, the pod is assumed normally by
         the caller, and (unless AllocateOnce) a new ghost hold is assumed
         for the remainder — all through the snapshot's assume/forget API so
-        node accounting stays consistent. Returns the node name."""
+        node accounting stays consistent. Device/NUMA remainders are NOT
+        re-held: a reservation carrying device minors is consumed whole
+        (AllocateOnce semantics, the device-reservation mode the reference
+        migration path uses). Returns the node name."""
         node = reservation.node_name
         assert node is not None
         snap = self.scheduler.snapshot
+        self.release_ghost_holds(reservation)
         snap.forget_pod(_ghost_uid(reservation))
         for k, v in pod.spec.requests.items():
             reservation.allocated[k] = reservation.allocated.get(k, 0.0) + v
@@ -185,6 +204,7 @@ class ReservationManager:
         ):
             return False
         if r.phase == ReservationPhase.AVAILABLE:
+            self.release_ghost_holds(r)
             self.scheduler.snapshot.forget_pod(_ghost_uid(r))
         r.phase = ReservationPhase.FAILED
         return True

@@ -325,3 +325,51 @@ def test_topology_packing_without_table():
         dm.node("n0").gpu_free[i] = 0.0
     got = minors_of(dm.allocate(gpu_pod("quad", whole=4), "n0"))
     assert got == [4, 5, 6, 7]
+
+
+def test_device_holding_reservation_end_to_end():
+    """A reservation requesting GPUs holds real minors (the ghost flows
+    through the device allocator); non-owners cannot take them, the owner
+    consumes them through the fast path, and expiry releases them
+    (reference deviceshare Reservation{Restore,Filter,PreBind} hooks)."""
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationManager,
+        ReservationPhase,
+    )
+
+    snap, dm = make_cluster(n_nodes=1, gpus=2)
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="gpu-hold"),
+            requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096, ext.RES_GPU: 2},
+            owners=[ReservationOwner(label_selector={"app": "train"})],
+            allocate_once=True,
+        )
+    )
+    assert rm.schedule_pending() == 1
+    assert rm.get("gpu-hold").phase == ReservationPhase.AVAILABLE
+    # both minors are held by the ghost: a non-owner GPU pod finds none
+    out = sched.schedule([gpu_pod("intruder", whole=1)])
+    assert out.bound == []
+    # the owner consumes the held minors through the fast path
+    owner = gpu_pod("train-0", whole=2)
+    owner.meta.labels["app"] = "train"
+    out2 = sched.schedule([owner])
+    assert [(p.meta.name, n) for p, n in out2.bound] == [("train-0", "n0")]
+    assert dm.node("n0").owners.get("") is None
+    assert len(dm.node("n0").owners) == 1   # only the owner pod holds minors
+
+    # a fresh reservation whose hold expires releases its minors
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="gpu-hold-2"),
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1024, ext.RES_GPU: 1},
+            owners=[ReservationOwner(label_selector={"app": "never"})],
+        )
+    )
+    # owner released its pods? node has 0 free minors -> cannot reserve
+    assert rm.schedule_pending() == 0
