@@ -53,6 +53,37 @@ class _NodeDevices:
     pcie_of: List[str] = dataclasses.field(default_factory=list)
 
 
+#: machine models whose boards ship the NVLink-complete 1/2/4/8 partition
+#: layout (reference ``allocator_gpu_helper.go:157`` model dispatch)
+HOPPER_MODELS = ("H100", "H800", "H20")
+
+
+def hopper_partition_table() -> Dict[int, List["GPUPartition"]]:
+    """The canonical 8-GPU Hopper partition table (reference
+    ``GPUPartitionIndexOfNVIDIAHopper``): singles, NVLink pairs
+    (0,1)/(2,3)/(4,5)/(6,7), quads (0-3)/(4-7), and the full octet, all at
+    allocation score 1."""
+    from ...api.types import GPUPartition
+
+    def parts(groups):
+        return [GPUPartition(minors=list(g)) for g in groups]
+
+    return {
+        1: parts([[m] for m in range(8)]),
+        2: parts([[0, 1], [2, 3], [4, 5], [6, 7]]),
+        4: parts([[0, 1, 2, 3], [4, 5, 6, 7]]),
+        8: parts([list(range(8))]),
+    }
+
+
+def partition_table_for_model(model: str) -> Dict[int, List["GPUPartition"]]:
+    """Model-dispatched default table (``getGPUPartitionIndexer``); unknown
+    models get no table (topology packing applies instead)."""
+    if any(model.startswith(m) for m in HOPPER_MODELS):
+        return hopper_partition_table()
+    return {}
+
+
 class DeviceManager:
     """Per-node device inventories + exact allocation (nodeDeviceCache)."""
 

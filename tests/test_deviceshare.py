@@ -373,3 +373,22 @@ def test_device_holding_reservation_end_to_end():
     )
     # owner released its pods? node has 0 free minors -> cannot reserve
     assert rm.schedule_pending() == 0
+
+
+def test_hopper_partition_table_matches_reference_layout():
+    """GPUPartitionIndexOfNVIDIAHopper: singles, pairs (0,1)(2,3)(4,5)(6,7),
+    quads (0-3)(4-7), octet; dispatched for H100/H800/H20 models."""
+    from koordinator_tpu.scheduler.plugins.deviceshare import (
+        partition_table_for_model,
+    )
+
+    for model in ("H100", "H800", "H20", "H800-SXM"):
+        table = partition_table_for_model(model)
+        assert sorted(table) == [1, 2, 4, 8]
+        assert [p.minors for p in table[2]] == [[0, 1], [2, 3], [4, 5], [6, 7]]
+        assert [p.minors for p in table[4]] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert table[8][0].minors == list(range(8))
+        assert all(
+            p.allocation_score == 1 for ps in table.values() for p in ps
+        )
+    assert partition_table_for_model("A100") == {}
