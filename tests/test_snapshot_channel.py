@@ -332,3 +332,75 @@ def test_confirmed_assume_never_expires(channel):
     req = pb.NominateRequest()
     req.pods.add(uid="big", requests=cpu_mem_vec(cfg, 6000, 1024), priority=9000)
     assert client.nominate(req).nominations[0].node == ""
+
+
+def test_concurrent_sync_and_nominate_consistency():
+    """The sidecar's lock must keep interleaved Sync/Nominate consistent:
+    hammer both from threads, then verify the final snapshot accounting
+    equals the serial expectation (no torn deltas, no lost assumes)."""
+    import threading
+
+    service = SolverService()
+    server, port = serve(service, max_workers=8)
+    client = SolverClient(f"127.0.0.1:{port}")
+    try:
+        cfg = service.snapshot.config
+        base = pb.SnapshotDelta(now=1000.0)
+        for i in range(16):
+            base.node_upserts.add(
+                name=f"n{i}", allocatable=cpu_mem_vec(cfg, 64000, 1 << 18)
+            )
+            base.metric_updates.add(
+                name=f"n{i}", usage=cpu_mem_vec(cfg, 0, 0), update_time=999.0
+            )
+        client.sync(base)
+
+        errors = []
+
+        def syncer(tid):
+            try:
+                for k in range(20):
+                    d = pb.SnapshotDelta(now=1001.0 + k)
+                    d.pod_assumed.add(
+                        uid=f"t{tid}-p{k}",
+                        node=f"n{(tid * 7 + k) % 16}",
+                        requests=cpu_mem_vec(cfg, 100, 64),
+                    )
+                    client.sync(d)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def nominator():
+            try:
+                for k in range(5):
+                    req = pb.NominateRequest()
+                    req.pods.add(
+                        uid=f"nom-{k}",
+                        requests=cpu_mem_vec(cfg, 500, 256),
+                        priority=9000,
+                    )
+                    client.nominate(req)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=syncer, args=(t,)) for t in range(4)]
+        threads.append(threading.Thread(target=nominator))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        # forget the nominate-side optimistic assumes so only synced pods
+        # remain, then check exact accounting: 4 threads x 20 pods x 100m
+        service.snapshot.expire_assumed(now=float("inf"), ttl=0.0)
+        na = service.snapshot.nodes
+        cpu_i = list(cfg.resources).index(ext.RES_CPU)
+        total_cpu = sum(
+            na.requested[service.snapshot.node_id(f"n{i}")][cpu_i]
+            for i in range(16)
+        )
+        assert total_cpu == 4 * 20 * 100
+    finally:
+        client.close()
+        server.stop(grace=None)
