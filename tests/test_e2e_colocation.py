@@ -192,3 +192,53 @@ def test_full_colocation_loop():
     mc.reconcile(now=3000.0)
     mc.reconcile(now=3001.0)
     assert evicted and evicted[0].meta.uid == victims[0].meta.uid
+
+
+def test_nodeslo_config_channel_drives_qos(tmp_path):
+    """The §3.3 dynamic-config path: slo-controller-config with a
+    node-label override renders a per-node NodeSLO, koordlet adopts it via
+    the statesinformer callback, and the next QoS tick enforces the
+    overridden suppression threshold in cgroup writes."""
+    import dataclasses as dc
+
+    from koordinator_tpu.api.types import ResourceThresholdStrategy
+    from koordinator_tpu.koordlet.daemon import Koordlet, KoordletConfig
+    from koordinator_tpu.koordlet import resourceexecutor as rex
+    from koordinator_tpu.manager.nodeslo import NodeSLOController, SLOControllerConfig
+
+    ctrl = NodeSLOController(
+        SLOControllerConfig(
+            threshold=ResourceThresholdStrategy(
+                enable=True, cpu_suppress_threshold_percent=65.0
+            ),
+            node_overrides={
+                "node-pool=gold": ResourceThresholdStrategy(
+                    enable=True, cpu_suppress_threshold_percent=40.0
+                )
+            },
+        )
+    )
+    slo = ctrl.render("test-node", node_labels={"node-pool": "gold"})
+    assert slo.threshold.cpu_suppress_threshold_percent == 40.0
+
+    agent = Koordlet(
+        KoordletConfig(
+            node_name="test-node",
+            cgroup_root=str(tmp_path),
+            n_cpus=64,
+            node_allocatable_milli=64_000,
+            node_memory_capacity_mib=1 << 18,
+        )
+    )
+    agent.update_node_slo(slo)
+    # prod usage 30C, BE 8C: override budget 40% x 64C = 25.6C; leftover
+    # 25.6 - 22 (non-BE) = 3.6C allowance
+    from koordinator_tpu.koordlet import metriccache as mcache
+
+    agent.metric_cache.append(mcache.NODE_CPU_USAGE, "node", 1000.0, 30_000.0)
+    agent.metric_cache.append(mcache.BE_CPU_USAGE, "node", 1000.0, 8_000.0)
+    agent.qos_tick(now=1001.0)
+    quota = agent.executor.read("kubepods/besteffort", rex.CPU_CFS_QUOTA)
+    assert quota is not None
+    # allowance = 0.40*64000 - (30000-8000) = 3600m -> quota 360000us
+    assert int(quota) == int(3600 / 1000 * 100_000)
