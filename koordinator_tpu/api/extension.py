@@ -152,6 +152,27 @@ def parse_gpu_request(requests: Mapping[str, float]) -> tuple[int, float]:
     return whole, ratio
 
 
+def parse_reservation_affinity(
+    annotations: Mapping[str, str],
+) -> Optional[Mapping[str, object]]:
+    """ReservationAffinity from the pod annotation (reference
+    ``apis/extension/reservation.go:51-78``): ``{"name": ...}`` targets one
+    reservation directly (other fields ignored); ``{"reservationSelector":
+    {labels}}`` requires a matching reservation. Presence means REQUIRED —
+    a pod carrying this must allocate from a matching reservation or stay
+    unschedulable."""
+    import json as _json
+
+    raw = annotations.get(ANNOTATION_RESERVATION_AFFINITY)
+    if not raw:
+        return None
+    try:
+        spec = _json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    return spec if isinstance(spec, dict) else None
+
+
 def parse_gpu_partition_spec(annotations: Mapping[str, str]) -> tuple[bool, float]:
     """(restricted, ring_bus_bandwidth) from the pod's partition-spec
     annotation (``GPUPartitionSpec``: Restricted = only the best

@@ -213,6 +213,7 @@ class BatchScheduler:
             from .plugins.elasticquota import quota_name_of
 
             remaining_pending = []
+            affinity_unsched: List[Pod] = []
             for pod in pending:
                 r = (
                     self.reservations.match(pod)
@@ -220,6 +221,13 @@ class BatchScheduler:
                     else None
                 )
                 if r is None:
+                    # required reservation affinity: the pod may ONLY run
+                    # from a matching reservation — no fallthrough to
+                    # normal node scheduling (reference ReservationAffinity
+                    # RequiredDuringScheduling semantics)
+                    if ext.parse_reservation_affinity(pod.meta.annotations):
+                        affinity_unsched.append(pod)
+                        continue
                     remaining_pending.append(pod)
                     continue
                 node = r.node_name
@@ -264,6 +272,8 @@ class BatchScheduler:
                 pod.meta.annotations.update(patch)
                 reserved_bound.append((pod, node))
             pending = remaining_pending
+        else:
+            affinity_unsched = []
 
         self.pod_groups.begin_cycle(pending)
         eligible = self.pod_groups.order_pending(pending)
@@ -271,7 +281,7 @@ class BatchScheduler:
         gated = [p for p in pending if p.meta.uid not in eligible_uids]
 
         bound: List[Tuple[Pod, str]] = list(reserved_bound)
-        unsched: List[Pod] = list(gated) + list(dropped)
+        unsched: List[Pod] = list(gated) + list(dropped) + list(affinity_unsched)
         rounds = 0
         for chunk in self._chunks(eligible):
             t0 = _time.perf_counter()

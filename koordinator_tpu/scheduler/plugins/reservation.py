@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ...api import extension as ext
+
 from ...api.types import (
     ObjectMeta,
     Pod,
@@ -127,7 +129,10 @@ class ReservationManager:
     def match(self, pod: Pod) -> Optional[Reservation]:
         """First Available, unexpired reservation whose owners match and
         whose remaining capacity covers the pod (the reference nominator
-        picks the best per node, ``nominator.go:1-357``)."""
+        picks the best per node, ``nominator.go:1-357``). A pod carrying
+        the reservation-affinity annotation additionally restricts the
+        candidate set by name or reservation labels."""
+        affinity = ext.parse_reservation_affinity(pod.meta.annotations)
         for r in self._reservations.values():
             if r.phase != ReservationPhase.AVAILABLE or r.node_name is None:
                 continue
@@ -139,6 +144,17 @@ class ReservationManager:
                 continue
             if r.allocate_once and r.current_owners:
                 continue
+            if affinity is not None:
+                name = affinity.get("name")
+                if name:
+                    if r.meta.name != name:
+                        continue
+                else:
+                    selector = affinity.get("reservationSelector") or {}
+                    if not all(
+                        r.meta.labels.get(k) == v for k, v in selector.items()
+                    ):
+                        continue
             if not matches_owner(r, pod):
                 continue
             remaining = self.remaining(r)

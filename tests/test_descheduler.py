@@ -350,3 +350,59 @@ def test_deviation_thresholds_track_cluster_average():
     # low band: avg - 5 ≈ 38.75; the 40% nodes are NOT low, and with an
     # absolute interpretation they all would be (40 < 80)
     assert not cls.low[names[7]]
+
+
+def test_reservation_affinity_required_semantics():
+    """ReservationAffinity (apis/extension/reservation.go:51-78): a pod
+    carrying the annotation may ONLY allocate from a matching reservation —
+    by name or reservation labels — and is unschedulable when none
+    matches, never falling through to normal node scheduling."""
+    import json
+
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationPhase
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0", cpu=32000, mem=32000))
+    sched = BatchScheduler(snap)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="gold-res", labels={"tier": "gold"}),
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8000},
+            owners=[ReservationOwner(label_selector={"app": "web"})],
+            allocate_once=False,
+        )
+    )
+    assert rm.schedule_pending() == 1
+
+    def web_pod(name, affinity=None):
+        annotations = {}
+        if affinity is not None:
+            annotations[ext.ANNOTATION_RESERVATION_AFFINITY] = json.dumps(affinity)
+        return Pod(
+            meta=ObjectMeta(
+                name=name, labels={"app": "web"}, annotations=annotations
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 2000, ext.RES_MEMORY: 2000}, priority=9000
+            ),
+        )
+
+    # by-name affinity binds through the reservation
+    out = sched.schedule([web_pod("by-name", {"name": "gold-res"})])
+    assert [(p.meta.name, n) for p, n in out.bound] == [("by-name", "n0")]
+    # selector affinity matches the reservation's labels
+    out = sched.schedule(
+        [web_pod("by-selector", {"reservationSelector": {"tier": "gold"}})]
+    )
+    assert len(out.bound) == 1
+    # non-matching required affinity: unschedulable even with node capacity
+    out = sched.schedule(
+        [web_pod("no-match", {"reservationSelector": {"tier": "silver"}})]
+    )
+    assert out.bound == [] and len(out.unschedulable) == 1
+    # without affinity, normal scheduling still works
+    out = sched.schedule([web_pod("plain")])
+    assert len(out.bound) == 1
