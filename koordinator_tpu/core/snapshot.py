@@ -187,6 +187,8 @@ class ClusterSnapshot:
         self.nodes = NodeArrays.empty(self.config.min_bucket, self.config.dims)
         #: pod uid -> _AssumedPod for assumed/bound pods
         self._assumed: Dict[str, "_AssumedPod"] = {}
+        #: node name -> labels (nodeSelector/affinity masks read these)
+        self._node_labels: Dict[str, Dict[str, str]] = {}
 
     # ---- node side ----
 
@@ -229,10 +231,15 @@ class ClusterSnapshot:
             self.nodes.n_real = max(self.nodes.n_real, idx + 1)
         self.nodes.allocatable[idx] = self.config.res_vector(node.status.allocatable)
         self.nodes.schedulable[idx] = not node.unschedulable
+        self._node_labels[node.meta.name] = dict(node.meta.labels)
         return idx
+
+    def node_labels(self, name: str) -> Mapping[str, str]:
+        return self._node_labels.get(name, {})
 
     def remove_node(self, name: str) -> None:
         idx = self._node_index.pop(name, None)
+        self._node_labels.pop(name, None)
         if idx is None:
             return
         for arr in (

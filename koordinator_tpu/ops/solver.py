@@ -341,6 +341,7 @@ def assign(
     cost_transform=None,
     nomination_jitter: float = 4.0,
     approx_topk: bool = False,
+    node_mask: "jnp.ndarray | None" = None,
 ) -> SolveResult:
     """Round-based fast solver. ``round_quantum`` is the fraction of a node's
     allocatable (per dim, measured in estimated usage) it may accept per
@@ -370,6 +371,9 @@ def assign(
 
     order = _priority_order(pods)
     spods = jax.tree.map(lambda a: a[order], pods)
+    # per-pod node constraints (nodeSelector / required nodeAffinity /
+    # spec.nodeName), host-built [P, N] bool, permuted with the pods
+    smask = None if node_mask is None else node_mask[order]
 
     def add_jitter(cost: jnp.ndarray) -> jnp.ndarray:
         """Deterministic per-(pod, node) perturbation, Knuth multiplicative
@@ -439,6 +443,8 @@ def assign(
             feas = _feasible(spods, work, params, active & q_head)
         else:
             feas = _feasible(spods, work, params, active)
+        if smask is not None:
+            feas &= smask
         if numa is not None:
             feas &= numa_mask
         if devices is not None:

@@ -391,6 +391,7 @@ class BatchScheduler:
             device_state = DeviceState(
                 slot_free=jnp.asarray(self.devices.slot_array())
             )
+        node_mask = self._node_constraint_mask(chunk, pods.requests.shape[0])
         return assign(
             pods,
             nodes,
@@ -404,7 +405,51 @@ class BatchScheduler:
             # slot 0 (see ops.solver) — same nominations contract, avoids
             # lax.top_k's full variadic sort per round
             approx_topk=True,
+            node_mask=node_mask,
         )
+
+    def _node_constraint_mask(self, chunk: Sequence[Pod], p_bucket: int):
+        """[P, N] bool for pods carrying node constraints (nodeSelector /
+        required nodeAffinity names / spec.nodeName — the upstream
+        NodeAffinity+NodeName Filter plugins' semantics); None when no pod
+        in the chunk has any, so the solver traces the mask out."""
+        if not any(
+            p.spec.node_selector or p.spec.affinity_required_nodes or p.spec.node_name
+            for p in chunk
+        ):
+            return None
+        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        mask = np.ones((p_bucket, n_bucket), bool)
+        names: List[Optional[str]] = [None] * n_bucket
+        for i in range(self.snapshot.nodes.n_real):
+            try:
+                names[i] = self.snapshot.node_name(i)
+            except IndexError:
+                pass
+        for i, pod in enumerate(chunk):
+            spec = pod.spec
+            if not (
+                spec.node_selector or spec.affinity_required_nodes or spec.node_name
+            ):
+                continue
+            row = np.zeros((n_bucket,), bool)
+            allowed_names = None
+            if spec.node_name:
+                allowed_names = {spec.node_name}
+            elif spec.affinity_required_nodes is not None:
+                allowed_names = set(spec.affinity_required_nodes)
+            for j, name in enumerate(names):
+                if name is None:
+                    continue
+                if allowed_names is not None and name not in allowed_names:
+                    continue
+                labels = self.snapshot.node_labels(name)
+                if all(
+                    labels.get(k) == v for k, v in spec.node_selector.items()
+                ):
+                    row[j] = True
+            mask[i] = row
+        return jnp.asarray(mask)
 
     def quota_state(self, chunk: Sequence[Pod]) -> Optional[QuotaState]:
         """Lowered QuotaState, or None when no quota tree exists (the solver

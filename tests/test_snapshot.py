@@ -155,3 +155,52 @@ def test_build_pods_gangs_and_padding():
     assert arr.gang_id[1] == arr.gang_id[3] != arr.gang_id[4]
     assert arr.gang_id[0] == -1
     assert (arr.prio_class[:5] == int(ext.PriorityClass.PROD)).all()
+
+
+def test_node_constraint_masks_enforced():
+    """nodeSelector / required node-affinity / spec.nodeName restrict
+    placement (upstream NodeAffinity+NodeName Filter semantics folded into
+    the solver's feasibility mask)."""
+    import jax
+
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+    snap = ClusterSnapshot()
+    for i, pool in enumerate(["cpu", "cpu", "gpu", "gpu"]):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}", labels={"pool": pool}),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 65536}
+                ),
+            )
+        )
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+
+    def pod(name, **spec_kw):
+        return Pod(
+            meta=ObjectMeta(name=name),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1024},
+                priority=9000,
+                **spec_kw,
+            ),
+        )
+
+    out = sched.schedule(
+        [
+            pod("sel", node_selector={"pool": "gpu"}),
+            pod("named", node_name="n1"),
+            pod("aff", affinity_required_nodes=["n0", "n3"]),
+            pod("impossible", node_selector={"pool": "tpu"}),
+            pod("free"),
+        ]
+    )
+    nodes_of = {p.meta.name: n for p, n in out.bound}
+    assert nodes_of["sel"] in ("n2", "n3")
+    assert nodes_of["named"] == "n1"
+    assert nodes_of["aff"] in ("n0", "n3")
+    assert "impossible" not in nodes_of
+    assert [p.meta.name for p in out.unschedulable] == ["impossible"]
+    assert "free" in nodes_of
