@@ -186,10 +186,13 @@ def parse_gpu_partition_spec(annotations: Mapping[str, str]) -> tuple[bool, floa
         spec = _json.loads(raw)
     except (ValueError, TypeError):
         return False, 0.0
-    return (
-        spec.get("allocatePolicy") == "Restricted",
-        float(spec.get("ringBusBandwidth", 0.0)),
-    )
+    if not isinstance(spec, dict):
+        return False, 0.0
+    try:
+        bandwidth = float(spec.get("ringBusBandwidth", 0.0))
+    except (TypeError, ValueError):
+        bandwidth = 0.0
+    return spec.get("allocatePolicy") == "Restricted", bandwidth
 
 
 def qos_for_priority(prio: PriorityClass) -> QoSClass:

@@ -381,6 +381,16 @@ class ClusterSnapshot:
             self.forget_pod(uid)
         return len(stale)
 
+    def confirm_pod(self, pod_uid: str) -> bool:
+        """Promote an optimistic assume to confirmed (bind observed /
+        pod_assumed sync, or a ghost hold whose lifecycle is owned by the
+        ReservationManager) so ``expire_assumed`` never drops it."""
+        ap = self._assumed.get(pod_uid)
+        if ap is None:
+            return False
+        ap.confirmed = True
+        return True
+
     def forget_pod(self, pod_uid: str) -> None:
         ap = self._assumed.pop(pod_uid, None)
         if ap is None:

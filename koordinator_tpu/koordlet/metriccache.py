@@ -180,8 +180,11 @@ class MetricCache:
             arrays = {}
             for i, key in enumerate(keys):
                 ring = self._series[key]
-                arrays[f"ts_{i}"] = ring.ts
-                arrays[f"values_{i}"] = ring.values
+                # copy under the lock: serialization happens outside it,
+                # and a concurrent insert mutating the live rings would
+                # tear the checkpoint (values vs saved head/count)
+                arrays[f"ts_{i}"] = ring.ts.copy()
+                arrays[f"values_{i}"] = ring.values.copy()
                 arrays[f"state_{i}"] = np.asarray([ring.head, ring.count])
             arrays["keys"] = np.frombuffer(
                 json.dumps(keys).encode(), dtype=np.uint8

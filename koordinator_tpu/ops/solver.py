@@ -208,6 +208,7 @@ class SolveResult:
     assignment: jnp.ndarray       # [P] int32 node index, -1 = unschedulable
     node_requested: jnp.ndarray   # [N, D] post-commit
     node_estimated_used: jnp.ndarray  # [N, D] post-commit
+    node_prod_used: jnp.ndarray   # [N, D] post-commit
     quota_used: jnp.ndarray       # [Q, D] post-commit
     rounds_used: jnp.ndarray      # [] int32
 
@@ -625,7 +626,7 @@ def assign(
         assigned_s,
         req_f,
         est_f,
-        _prod_f,
+        prod_f,
         qused_f,
         _dev_full_f,
         _dev_total_f,
@@ -640,6 +641,7 @@ def assign(
         assignment=assignment,
         node_requested=req_f,
         node_estimated_used=est_f,
+        node_prod_used=prod_f,
         quota_used=qused_f,
         rounds_used=rounds,
     )
@@ -706,6 +708,7 @@ def solve_stream(
         nxt = cur.replace(
             requested=res.node_requested,
             estimated_used=res.node_estimated_used,
+            prod_used=res.node_prod_used,
         )
         placed = jnp.sum(res.assignment >= 0).astype(jnp.int32)
         return (nxt, res.quota_used), (res.assignment, placed)
@@ -753,6 +756,11 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
         jnp.where(rollback, node_of, n - 1),
         num_segments=n,
     )
+    dprod = jax.ops.segment_sum(
+        jnp.where((rollback & pods.is_prod)[:, None], pods.estimate, zero),
+        jnp.where(rollback & pods.is_prod, node_of, n - 1),
+        num_segments=n,
+    )
     # Refund quota charges of rolled-back pods along their chains.
     # (Q == 1 is the disabled sentinel — real trees are padded to Q ≥ 2.)
     quota_used = result.quota_used
@@ -769,6 +777,7 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
         assignment=jnp.where(keep, assignment, -1),
         node_requested=result.node_requested - dreq,
         node_estimated_used=result.node_estimated_used - dest,
+        node_prod_used=result.node_prod_used - dprod,
         quota_used=quota_used,
         rounds_used=result.rounds_used,
     )
@@ -856,7 +865,7 @@ def assign_sequential(
             qused = qused + jnp.any(charge, axis=1)[:, None] * req[None, :]
         return (requested, est_used, prod_used, qused), jnp.where(has, best, -1)
 
-    (req_f, est_f, _, qused_f), assigned_s = jax.lax.scan(
+    (req_f, est_f, prod_f, qused_f), assigned_s = jax.lax.scan(
         step,
         (nodes.requested, nodes.estimated_used, nodes.prod_used, quotas.used),
         (
@@ -872,6 +881,7 @@ def assign_sequential(
         assignment=assignment,
         node_requested=req_f,
         node_estimated_used=est_f,
+        node_prod_used=prod_f,
         quota_used=qused_f,
         rounds_used=jnp.array(p, jnp.int32),
     )

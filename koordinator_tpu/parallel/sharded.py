@@ -107,6 +107,7 @@ def sharded_assign(
         assignment=NamedSharding(mesh, P("dp")),
         node_requested=NamedSharding(mesh, P("tp", None)),
         node_estimated_used=NamedSharding(mesh, P("tp", None)),
+        node_prod_used=NamedSharding(mesh, P("tp", None)),
         quota_used=rep,
         rounds_used=rep,
     )

@@ -220,22 +220,25 @@ class BatchScheduler:
                     if gang_key_of(pod) is None
                     else None
                 )
+                # required reservation affinity: the pod may ONLY run
+                # from a matching reservation — no fallthrough to normal
+                # node scheduling, even when the match's Reserve fails
+                # (reference ReservationAffinity RequiredDuringScheduling
+                # semantics); it stays unschedulable and retries next cycle
+                required = (
+                    ext.parse_reservation_affinity(pod.meta.annotations)
+                    is not None
+                )
+                retry_queue = affinity_unsched if required else remaining_pending
                 if r is None:
-                    # required reservation affinity: the pod may ONLY run
-                    # from a matching reservation — no fallthrough to
-                    # normal node scheduling (reference ReservationAffinity
-                    # RequiredDuringScheduling semantics)
-                    if ext.parse_reservation_affinity(pod.meta.annotations):
-                        affinity_unsched.append(pod)
-                        continue
-                    remaining_pending.append(pod)
+                    retry_queue.append(pod)
                     continue
                 node = r.node_name
                 leaf = quota_name_of(pod)
                 if leaf is not None and not self.quotas.has_headroom(
                     leaf, pod.spec.requests
                 ):
-                    remaining_pending.append(pod)
+                    retry_queue.append(pod)
                     continue
                 patch: Dict[str, str] = {}
                 # free the ghost's reserved cpuset/minors first so the
@@ -244,7 +247,10 @@ class BatchScheduler:
                 if self.numa is not None:
                     numa_patch = self.numa.allocate(pod, node)
                     if numa_patch is None:
-                        remaining_pending.append(pod)
+                        # failed owner Reserve: the still-Available
+                        # reservation must get its cpuset/minor holds back
+                        self.reservations.reacquire_ghost_holds(r)
+                        retry_queue.append(pod)
                         continue
                     patch.update(numa_patch)
                 if self.devices is not None:
@@ -252,7 +258,8 @@ class BatchScheduler:
                     if dev_patch is None:
                         if self.numa is not None:
                             self.numa.release(pod.meta.uid, node)
-                        remaining_pending.append(pod)
+                        self.reservations.reacquire_ghost_holds(r)
+                        retry_queue.append(pod)
                         continue
                     patch.update(dev_patch)
                 if not self.snapshot.assume_pod(
@@ -264,7 +271,8 @@ class BatchScheduler:
                         self.devices.release(pod.meta.uid, node)
                     if self.numa is not None:
                         self.numa.release(pod.meta.uid, node)
-                    remaining_pending.append(pod)
+                    self.reservations.reacquire_ghost_holds(r)
+                    retry_queue.append(pod)
                     continue
                 self.reservations.allocate(r, pod)
                 if leaf is not None:

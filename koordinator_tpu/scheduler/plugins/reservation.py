@@ -98,6 +98,10 @@ class ReservationManager:
             r.phase = ReservationPhase.AVAILABLE
             r.node_name = node
             r.available_time = _t.time()
+            # the ghost hold's lifecycle is owned here, not by a
+            # pod_assumed sync — without confirmation expire_assumed()
+            # would silently drop an Available reservation's capacity
+            self.scheduler.snapshot.confirm_pod(pod.meta.uid)
         return len(outcome.bound)
 
     def expire(self, now: Optional[float] = None) -> List[str]:
@@ -180,6 +184,31 @@ class ReservationManager:
         if getattr(self.scheduler, "numa", None) is not None:
             self.scheduler.numa.release(uid, node)
 
+    def _remainder_ghost(self, reservation: Reservation) -> Pod:
+        """Ghost pod sized to the reservation's unconsumed remainder."""
+        ghost = self._ghost_pod(reservation)
+        ghost.spec.requests = {
+            k: v for k, v in self.remaining(reservation).items() if v > 1e-6
+        }
+        return ghost
+
+    def reacquire_ghost_holds(self, reservation: Reservation) -> None:
+        """Strict inverse of ``release_ghost_holds`` after a failed owner
+        commit: re-take the NUMA/device holds the ghost actually had. A
+        partially-consumed reservation holds none (``allocate`` does not
+        re-hold device/NUMA remainders — see its docstring), so this is a
+        no-op once any owner has allocated. The scheduling cycle is
+        single-threaded, so re-taking the just-released capacity (the
+        owner's partial allocations were rolled back first) succeeds."""
+        node = reservation.node_name
+        if node is None or reservation.current_owners:
+            return
+        ghost = self._remainder_ghost(reservation)
+        if getattr(self.scheduler, "numa", None) is not None:
+            self.scheduler.numa.allocate(ghost, node)
+        if getattr(self.scheduler, "devices", None) is not None:
+            self.scheduler.devices.allocate(ghost, node)
+
     def allocate(self, reservation: Reservation, pod: Pod) -> str:
         """Commit a pod against a reservation.
 
@@ -202,12 +231,8 @@ class ReservationManager:
             reservation.allocated = dict(reservation.requests)
             reservation.phase = ReservationPhase.SUCCEEDED
         else:
-            remaining = {
-                k: v for k, v in self.remaining(reservation).items() if v > 1e-6
-            }
-            if remaining:
-                ghost = self._ghost_pod(reservation)
-                ghost.spec.requests = remaining
+            ghost = self._remainder_ghost(reservation)
+            if ghost.spec.requests:
                 snap.assume_pod(ghost, node)
         return node
 

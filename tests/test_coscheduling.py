@@ -46,6 +46,7 @@ def test_enforce_gangs_rollback():
         assignment=assignment,
         node_requested=node_req,
         node_estimated_used=node_req,
+        node_prod_used=jnp.zeros_like(node_req),
         quota_used=jnp.zeros((1, 1)),
         rounds_used=jnp.array(1, jnp.int32),
     )

@@ -375,6 +375,129 @@ def test_device_holding_reservation_end_to_end():
     assert rm.schedule_pending() == 0
 
 
+def test_failed_owner_commit_reacquires_ghost_holds():
+    """When an owner pod matches a reservation but its own device Reserve
+    fails, the ghost's minor holds (released ahead of the owner's
+    allocation) must be re-acquired — otherwise the still-Available
+    reservation's GPUs leak to unrelated pods."""
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationManager,
+        ReservationPhase,
+        _ghost_uid,
+    )
+
+    snap, dm = partition_cluster(policy="Honor")
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    res = Reservation(
+        meta=ObjectMeta(name="pair-hold"),
+        requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096, ext.RES_GPU: 2},
+        owners=[ReservationOwner(label_selector={"app": "train"})],
+    )
+    rm.add(res)
+    assert rm.schedule_pending() == 1
+    ghost = _ghost_uid(res)
+    assert len(dm.node("n0").owners[ghost]) == 2
+
+    # owner demands ring bandwidth no pair partition offers: its device
+    # Reserve fails under Honor policy after the ghost hold was released
+    owner = gpu_pod("train-0", whole=2)
+    owner.meta.labels["app"] = "train"
+    owner.meta.annotations[ext.ANNOTATION_GPU_PARTITION_SPEC] = json.dumps(
+        {"allocatePolicy": "BestEffort", "ringBusBandwidth": 100.0}
+    )
+    out = sched.schedule([owner])
+    assert out.bound == []
+    # reservation still Available and the ghost holds its 2 minors again
+    assert res.phase == ReservationPhase.AVAILABLE
+    assert len(dm.node("n0").owners.get(ghost, [])) == 2
+    assert owner.meta.uid not in dm.node("n0").owners
+
+
+def test_required_affinity_no_fallthrough_on_failed_reserve():
+    """A required-reservation-affinity pod whose matched reservation's
+    Reserve fails must stay unschedulable — not fall through to normal
+    node scheduling on an unrelated node."""
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+
+    from koordinator_tpu.api.types import Device, DeviceInfo, Node, NodeStatus
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+    snap, dm = partition_cluster(policy="Honor")
+    # a second, unconstrained node that could host the pod normally (too
+    # small for the reservation itself, so the ghost lands on n0)
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n1"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 16000, ext.RES_MEMORY: 262144}
+            ),
+        )
+    )
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="n1"),
+            devices=[DeviceInfo(dev_type="gpu", minor=g) for g in range(8)],
+        )
+    )
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    res = Reservation(
+        meta=ObjectMeta(name="pair-hold"),
+        requests={ext.RES_CPU: 40000, ext.RES_MEMORY: 4096, ext.RES_GPU: 2},
+        owners=[ReservationOwner(label_selector={"app": "train"})],
+    )
+    rm.add(res)
+    assert rm.schedule_pending() == 1
+    assert res.node_name == "n0"  # the partitioned node fits the pair
+
+    owner = gpu_pod("train-0", whole=2)
+    owner.meta.labels["app"] = "train"
+    owner.meta.annotations[ext.ANNOTATION_RESERVATION_AFFINITY] = json.dumps(
+        {"name": "pair-hold"}
+    )
+    # bandwidth demand no pair partition offers -> owner Reserve fails
+    owner.meta.annotations[ext.ANNOTATION_GPU_PARTITION_SPEC] = json.dumps(
+        {"allocatePolicy": "BestEffort", "ringBusBandwidth": 100.0}
+    )
+    out = sched.schedule([owner])
+    assert out.bound == []
+    assert [p.meta.name for p in out.unschedulable] == ["train-0"]
+    # in particular it must NOT have bound on n1
+    assert owner.meta.uid not in dm.node("n1").owners
+
+
+def test_ghost_holds_survive_assumed_pod_expiry():
+    """Ghost assumes are owned by the ReservationManager, not a pod_assumed
+    sync: expire_assumed must never drop an Available reservation's
+    capacity hold."""
+    import time
+
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+    snap, dm = make_cluster(n_nodes=1, gpus=2)
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="gpu-hold"),
+            requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096, ext.RES_GPU: 2},
+            owners=[ReservationOwner(label_selector={"app": "train"})],
+        )
+    )
+    assert rm.schedule_pending() == 1
+    before = snap.nodes.requested[snap.node_id("n0")].copy()
+    assert snap.expire_assumed(now=time.time() + 10_000, ttl=300.0) == 0
+    np.testing.assert_allclose(
+        snap.nodes.requested[snap.node_id("n0")], before
+    )
+
+
 def test_hopper_partition_table_matches_reference_layout():
     """GPUPartitionIndexOfNVIDIAHopper: singles, pairs (0,1)(2,3)(4,5)(6,7),
     quads (0-3)(4-7), octet; dispatched for H100/H800/H20 models."""

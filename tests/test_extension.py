@@ -33,3 +33,22 @@ def test_qos_parse_and_defaults():
 
 def test_qos_strictness_order():
     assert QoSClass.SYSTEM > QoSClass.LSE > QoSClass.LSR > QoSClass.LS > QoSClass.BE
+
+
+def test_parse_gpu_partition_spec_malformed_payloads():
+    """Malformed user annotations must degrade to defaults, never crash the
+    scheduling cycle (mirrors parse_reservation_affinity's guards)."""
+    key = ext.ANNOTATION_GPU_PARTITION_SPEC
+    assert ext.parse_gpu_partition_spec({}) == (False, 0.0)
+    assert ext.parse_gpu_partition_spec({key: "not json"}) == (False, 0.0)
+    assert ext.parse_gpu_partition_spec({key: "[1]"}) == (False, 0.0)
+    assert ext.parse_gpu_partition_spec({key: '"str"'}) == (False, 0.0)
+    assert ext.parse_gpu_partition_spec(
+        {key: '{"ringBusBandwidth": "fast"}'}
+    ) == (False, 0.0)
+    assert ext.parse_gpu_partition_spec(
+        {key: '{"ringBusBandwidth": null}'}
+    ) == (False, 0.0)
+    assert ext.parse_gpu_partition_spec(
+        {key: '{"allocatePolicy": "Restricted", "ringBusBandwidth": 200}'}
+    ) == (True, 200.0)

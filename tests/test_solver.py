@@ -362,6 +362,72 @@ def test_solve_stream_respects_quota_across_batches():
     np.testing.assert_allclose(np.asarray(fq.used)[0], charged, rtol=1e-5)
 
 
+def test_solve_stream_threads_prod_usage_between_batches():
+    """prod_used must carry between batches: a prod threshold filled by
+    batch 0 blocks batch 1's prod pods (without SolveResult.node_prod_used
+    every batch would re-check against the initial prod usage)."""
+    import jax
+
+    from koordinator_tpu.ops.solver import solve_stream
+
+    d = 1
+    nodes = NodeState.create(
+        allocatable=np.full((1, d), 100.0, np.float32),
+        estimated_used=np.zeros((1, d), np.float32),
+        prod_used=np.zeros((1, d), np.float32),
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.zeros(d, jnp.float32),
+        prod_thresholds=jnp.asarray([50.0], jnp.float32),
+        score_weights=jnp.ones(d, jnp.float32),
+    )
+
+    def batch():
+        req = np.full((5, d), 10.0, np.float32)
+        return PodBatch.create(
+            requests=req,
+            estimate=req,
+            priority=np.full(5, 9500, np.int32),
+            is_prod=np.ones(5, bool),
+        )
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), batch(), batch())
+    _, final_nodes, placed, _ = solve_stream(stacked, nodes, params)
+    placed = np.asarray(placed)
+    # batch 0 fills prod usage exactly to the 50% threshold; batch 1's
+    # prod pods must all be rejected against the carried prod_used
+    assert placed[0] == 5
+    assert placed[1] == 0
+    np.testing.assert_allclose(np.asarray(final_nodes.prod_used), [[50.0]])
+
+
+def test_enforce_gangs_refunds_prod_used():
+    """Gang rollback must refund node_prod_used for prod members, or the
+    carried prod usage leaks capacity batch over batch."""
+    from koordinator_tpu.ops.solver import SolveResult, enforce_gangs
+
+    req = jnp.full((2, 1), 10.0)
+    result = SolveResult(
+        assignment=jnp.asarray([0, -1], jnp.int32),  # gang min 2, one missing
+        node_requested=jnp.asarray([[10.0]]),
+        node_estimated_used=jnp.asarray([[10.0]]),
+        node_prod_used=jnp.asarray([[10.0]]),
+        quota_used=jnp.zeros((1, 1)),
+        rounds_used=jnp.array(1, jnp.int32),
+    )
+    pods = PodBatch.create(
+        requests=req,
+        estimate=req,
+        priority=jnp.full(2, 9500, jnp.int32),
+        is_prod=jnp.ones(2, bool),
+        gang_id=[0, 0],
+        gang_min=[2, 0],
+    )
+    out = enforce_gangs(result, pods)
+    assert np.asarray(out.assignment).tolist() == [-1, -1]
+    np.testing.assert_allclose(np.asarray(out.node_prod_used), [[0.0]])
+
+
 def test_approx_topk_places_pod_with_single_feasible_node():
     """approx_max_k recall < 1 must never cost a constrained pod its only
     feasible node: slot 0 of the candidate set is pinned to the exact
