@@ -316,13 +316,23 @@ SCENARIOS = {
 
 def main() -> None:
     wanted = sys.argv[1:] or list(SCENARIOS)
-    results = []
+    # merge into the existing artifact: a partial run must never discard
+    # other scenarios' numbers (BASELINE.md cites this file as the source
+    # of record for every scenario); a full run resets it so renamed or
+    # removed scenarios can't leave stale entries behind
+    existing = {}
+    if sys.argv[1:]:
+        try:
+            with open("BENCH_SUITE.json") as f:
+                existing = {r["scenario"]: r for r in json.load(f)}
+        except (OSError, ValueError, KeyError, TypeError):
+            existing = {}
     for name in wanted:
         res = SCENARIOS[name]()
-        results.append(res)
+        existing[res["scenario"]] = res
         print(json.dumps(res))
-    with open("BENCH_SUITE.json", "w") as f:
-        json.dump(results, f, indent=1)
+        with open("BENCH_SUITE.json", "w") as f:
+            json.dump(list(existing.values()), f, indent=1)
 
 
 if __name__ == "__main__":

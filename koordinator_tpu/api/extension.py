@@ -40,6 +40,9 @@ ANNOTATION_RESOURCE_SPEC = f"scheduling.{DOMAIN}/resource-spec"
 ANNOTATION_RESOURCE_STATUS = f"scheduling.{DOMAIN}/resource-status"
 ANNOTATION_DEVICE_ALLOCATED = f"scheduling.{DOMAIN}/device-allocated"
 ANNOTATION_RESERVATION_AFFINITY = f"scheduling.{DOMAIN}/reservation-affinity"
+#: smaller non-zero order wins nomination outright (reference
+#: ``apis/extension/reservation.go:43-46`` LabelReservationOrder)
+LABEL_RESERVATION_ORDER = f"scheduling.{DOMAIN}/reservation-order"
 ANNOTATION_GANG_GROUPS = f"gang.scheduling.{DOMAIN}/groups"
 #: pod-side partition request (apis/extension/device_share.go:38
 #: AnnotationGPUPartitionSpec): {"allocatePolicy": "Restricted"|"BestEffort",

@@ -17,7 +17,13 @@ import enum
 import time
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
-from .extension import DEFAULT_RESOURCES, PriorityClass, QoSClass
+from .extension import (
+    DEFAULT_RESOURCES,
+    LABEL_POD_QOS,
+    PriorityClass,
+    QoSClass,
+    qos_for_priority,
+)
 
 ResourceList = Dict[str, float]
 
@@ -76,13 +82,9 @@ class Pod:
 
     @property
     def qos(self) -> QoSClass:
-        from .extension import LABEL_POD_QOS
-
         explicit = QoSClass.parse(self.meta.labels.get(LABEL_POD_QOS))
         if explicit is not QoSClass.NONE:
             return explicit
-        from .extension import qos_for_priority
-
         return qos_for_priority(self.priority_class)
 
     @property

@@ -326,6 +326,7 @@ class ClusterSnapshot:
         estimated: Optional[np.ndarray] = None,
         now: Optional[float] = None,
         confirmed: bool = True,
+        request: Optional[np.ndarray] = None,
     ) -> bool:
         """Charge ``pod`` against ``node_name``; returns False (no-op) when
         the node is absent — an assume racing a node delete is a
@@ -345,7 +346,12 @@ class ClusterSnapshot:
         absorbed = prev is not None and prev.absorbed and prev.node_idx == idx
         if prev is not None:
             self.forget_pod(pod.meta.uid)
-        req = self.config.res_vector(pod.spec.requests)
+        # callers that already lowered the request vector pass it in
+        req = (
+            np.asarray(request, np.float32)
+            if request is not None
+            else self.config.res_vector(pod.spec.requests)
+        )
         self.nodes.requested[idx] += req
         est = np.asarray(
             estimated if estimated is not None else req, np.float32

@@ -116,6 +116,10 @@ class BatchScheduler:
         self.extender.monitor.start_background()
         self._params = self.args.solver_params(self.snapshot.config)
         self._scales = self.args.scale_vector(self.snapshot.config)
+        # per-chunk lowered host rows, filled by pod_batch for _commit
+        self._lowered_uids: Tuple[str, ...] = ()
+        self._lowered_req = np.zeros((0, len(self.snapshot.config.resources)))
+        self._lowered_est = self._lowered_req
 
     # ---- device lowering ----
 
@@ -173,6 +177,14 @@ class BatchScheduler:
                 est[i] = self._estimate_of(pod)
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
         chains = self.quotas.chains_for_pods(list(pods), b)
+        # stash the host-side rows for _commit: Reserve revalidation and
+        # assume charges reuse these instead of recomputing res_vector /
+        # estimate_pod per winner (the recompute was a measurable slice of
+        # the per-batch host time); the uid tuple guards the temporal
+        # coupling — _commit refuses rows lowered for a different chunk
+        self._lowered_uids = tuple(p.meta.uid for p in pods)
+        self._lowered_req = arrays.requests
+        self._lowered_est = est
         return PodBatch.create(
             requests=arrays.requests,
             estimate=est,
@@ -514,6 +526,14 @@ class BatchScheduler:
         na = self.snapshot.nodes
         results: List[Tuple[Pod, Optional[str]]] = []
         prebind = DefaultPreBind()
+        if self._lowered_uids != tuple(p.meta.uid for p in chunk):
+            raise RuntimeError(
+                "_commit called with a chunk that does not match the last "
+                "pod_batch lowering — solve() and _commit() must run on "
+                "the same chunk"
+            )
+        req_rows = self._lowered_req
+        est_rows = self._lowered_est
         order = sorted(
             range(len(chunk)), key=lambda i: (-(chunk[i].spec.priority or 0), i)
         )
@@ -522,7 +542,7 @@ class BatchScheduler:
             if node_idx < 0:
                 results.append((pod, None))
                 continue
-            req = self.snapshot.config.res_vector(pod.spec.requests)
+            req = req_rows[i]
             if not bool(
                 np.all(
                     na.requested[node_idx] + req
@@ -554,7 +574,7 @@ class BatchScheduler:
                 patch.update(dev_patch)
             prebind.stage_annotations(pod, patch)
             if not self.snapshot.assume_pod(
-                pod, node_name, self._estimate_of(pod), confirmed=False
+                pod, node_name, est_rows[i], confirmed=False, request=req
             ):
                 # node vanished between solve and Reserve (delete race):
                 # failed Reserve, roll back the per-winner allocations

@@ -49,6 +49,37 @@ def matches_owner(reservation: Reservation, pod: Pod) -> bool:
     return False
 
 
+def _reservation_order(r: Reservation) -> Optional[int]:
+    """Non-zero integer order label, or None (reference
+    ``findMostPreferredReservationByOrder``: unparseable/zero = unordered)."""
+    raw = r.meta.labels.get(ext.LABEL_RESERVATION_ORDER, "")
+    if not raw:
+        return None
+    try:
+        order = int(raw)
+    except ValueError:
+        return None
+    return order if order != 0 else None
+
+
+def _score_reservation(pod: Pod, r: Reservation) -> float:
+    """MostAllocated fit score over the reservation's own resource dims
+    (reference ``scoring.go:196-209`` scoreReservation): mean of
+    ``100·min(req+allocated ≤ cap)/cap``; dims the pod would overflow
+    contribute 0."""
+    resources = {k: v for k, v in r.requests.items() if v > 0}
+    if not resources:
+        return 0.0
+    s = 0.0
+    for k, cap in resources.items():
+        req = pod.spec.requests.get(k, 0.0) + r.allocated.get(k, 0.0)
+        # same epsilon as the match() capacity filter: float accumulation
+        # noise must not zero the tightest dim of an exact-fit candidate
+        if req <= cap + 1e-6:
+            s += 100.0 * min(req, cap) / cap
+    return s / len(resources)
+
+
 class ReservationManager:
     """Schedules pending reservations as ghost pods and brokers matches."""
 
@@ -131,12 +162,22 @@ class ReservationManager:
         }
 
     def match(self, pod: Pod) -> Optional[Reservation]:
-        """First Available, unexpired reservation whose owners match and
-        whose remaining capacity covers the pod (the reference nominator
-        picks the best per node, ``nominator.go:1-357``). A pod carrying
-        the reservation-affinity annotation additionally restricts the
-        candidate set by name or reservation labels."""
+        """Nominate the best matching Available reservation for ``pod``
+        (reference nominator, ``nominator.go:207-279`` + ``scoring.go``):
+        collect every candidate whose owners match and whose remaining
+        capacity covers the pod, then (1) a reservation carrying the
+        smallest non-zero ``reservation-order`` label wins outright
+        (``findMostPreferredReservationByOrder``), else (2) pick the
+        highest MostAllocated fit score — mean over the reservation's
+        resource dims of ``100·(pod request + already allocated)/
+        allocatable`` (``scoreReservation``), i.e. the tightest fit, so
+        small pods drain small reservations before fragmenting big ones.
+        A pod carrying the reservation-affinity annotation additionally
+        restricts the candidate set by name or reservation labels."""
         affinity = ext.parse_reservation_affinity(pod.meta.annotations)
+        best: Optional[Reservation] = None
+        best_score = -1.0
+        best_order: Optional[int] = None
         for r in self._reservations.values():
             if r.phase != ReservationPhase.AVAILABLE or r.node_name is None:
                 continue
@@ -162,12 +203,28 @@ class ReservationManager:
             if not matches_owner(r, pod):
                 continue
             remaining = self.remaining(r)
-            if all(
+            if not all(
                 pod.spec.requests.get(k, 0.0) <= remaining.get(k, 0.0) + 1e-6
                 for k in pod.spec.requests
             ):
-                return r
-        return None
+                continue
+            order = _reservation_order(r)
+            if order is not None:
+                if best_order is None or order < best_order:
+                    best_order = order
+                    best = r
+                continue
+            if best_order is not None:
+                continue  # an ordered candidate always beats scored ones
+            score = _score_reservation(pod, r)
+            if score > best_score or (
+                score == best_score
+                and best is not None
+                and r.meta.name < best.meta.name
+            ):
+                best_score = score
+                best = r
+        return best
 
     def release_ghost_holds(self, reservation: Reservation) -> None:
         """Release the ghost's per-winner NUMA/device allocations (the

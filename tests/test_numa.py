@@ -278,3 +278,55 @@ def test_unreported_memory_dim_ignored():
         numa_fit_mask(jnp.asarray(req), jnp.asarray(np.array([True])), ns)
     )
     assert mask[0, 0] == True  # noqa: E712
+
+
+def test_accumulator_adversarial_take_release_invariants():
+    """Randomized take/release churn: ownership stays disjoint and equal to
+    the allocated set, FullPCPUs results stay core-aligned, numa pins hold.
+    (Guards the heap fast path against stale-cache bugs — an ABA length
+    match once left a freed core in the heap.)"""
+    import random
+
+    from koordinator_tpu.core.topology import CPUAccumulator, CPUBindPolicy
+
+    for seed in range(2):
+        rng = random.Random(seed)
+        t = CPUTopology.uniform(
+            sockets=2, numa_per_socket=2, cores_per_numa=4, threads_per_core=2
+        )
+        core_of = {c.cpu_id: c.core_id for c in t.cpus}
+        numa_of = {c.cpu_id: c.numa_node for c in t.cpus}
+        acc = CPUAccumulator(t)
+        owners = {}
+        for step in range(1500):
+            if owners and rng.random() < 0.45:
+                o = rng.choice(list(owners))
+                acc.release(o)
+                del owners[o]
+            else:
+                o = f"o{step}"
+                n = rng.choice([1, 2, 4, 6, 8])
+                pol = rng.choice(
+                    [
+                        CPUBindPolicy.DEFAULT,
+                        CPUBindPolicy.FULL_PCPUS,
+                        CPUBindPolicy.SPREAD_BY_PCPUS,
+                    ]
+                )
+                numa = rng.choice([None, 0, 1, 2, 3])
+                got = acc.take(o, n, policy=pol, numa=numa)
+                if got is not None:
+                    owners[o] = got
+                    assert len(got) == n
+                    if numa is not None:
+                        assert {numa_of[c] for c in got} == {numa}
+                    if pol == CPUBindPolicy.FULL_PCPUS:
+                        from collections import Counter
+
+                        cores = Counter(core_of[c] for c in got)
+                        assert all(v == 2 for v in cores.values())
+            all_owned = set()
+            for o, cpus in owners.items():
+                assert not (all_owned & cpus), "double allocation"
+                all_owned |= cpus
+            assert all_owned == acc._allocated

@@ -1,0 +1,177 @@
+"""Reservation nominator parity tests.
+
+Mirrors the reference nominator's selection behavior
+(``pkg/scheduler/plugins/reservation/nominator_test.go`` TestNominateReservation
+and ``scoring.go`` scoreReservation): an order-labeled reservation wins
+outright; otherwise the MostAllocated fit score picks the tightest-fitting
+reservation, with prior allocations counted toward the fill.
+"""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Reservation,
+    ReservationOwner,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.reservation import (
+    ReservationManager,
+    ReservationPhase,
+    _score_reservation,
+)
+
+
+def make_rm(n_nodes=1):
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+                ),
+            )
+        )
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    return ReservationManager(sched)
+
+
+def available(rm, name, requests, node="n0", labels=None, allocated=None):
+    r = Reservation(
+        meta=ObjectMeta(name=name, labels=labels or {}),
+        requests=requests,
+        owners=[ReservationOwner(label_selector={"app": "t"})],
+    )
+    r.phase = ReservationPhase.AVAILABLE
+    r.node_name = node
+    if allocated:
+        r.allocated = dict(allocated)
+    rm.add(r)
+    return r
+
+
+def owner_pod(cpu=2000, mem=4096):
+    return Pod(
+        meta=ObjectMeta(name="p", labels={"app": "t"}),
+        spec=PodSpec(requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}),
+    )
+
+
+def test_order_label_wins_over_score():
+    """'preferred reservation' case: reservation-order label beats any fit
+    score; among ordered ones the smallest order wins."""
+    rm = make_rm()
+    available(rm, "normal-exact-fit", {ext.RES_CPU: 2000, ext.RES_MEMORY: 4096})
+    preferred = available(
+        rm,
+        "preferred-reservation",
+        {ext.RES_CPU: 64000, ext.RES_MEMORY: 262144},
+        labels={ext.LABEL_RESERVATION_ORDER: "100"},
+    )
+    available(
+        rm,
+        "later-order",
+        {ext.RES_CPU: 64000, ext.RES_MEMORY: 262144},
+        labels={ext.LABEL_RESERVATION_ORDER: "200"},
+    )
+    assert rm.match(owner_pod()) is preferred
+
+
+def test_order_label_zero_or_garbage_is_unordered():
+    rm = make_rm()
+    exact = available(
+        rm, "exact", {ext.RES_CPU: 2000, ext.RES_MEMORY: 4096},
+        labels={ext.LABEL_RESERVATION_ORDER: "0"},
+    )
+    available(
+        rm, "big", {ext.RES_CPU: 64000, ext.RES_MEMORY: 262144},
+        labels={ext.LABEL_RESERVATION_ORDER: "nan"},
+    )
+    # both degrade to score-based selection; the exact fit wins
+    assert rm.match(owner_pod()) is exact
+
+
+def test_matched_reservations_tightest_fit_wins():
+    """'matched reservations' case: a 2C4G pod picks reservation2C4G (score
+    100) over reservation4C8G (score 50)."""
+    rm = make_rm()
+    available(rm, "reservation4C8G", {ext.RES_CPU: 4000, ext.RES_MEMORY: 8192})
+    r2 = available(
+        rm, "reservation2C4G", {ext.RES_CPU: 2000, ext.RES_MEMORY: 4096}
+    )
+    assert rm.match(owner_pod()) is r2
+
+
+def test_allocated_reservation_falls_back_to_free_one():
+    """'allocated reservation' case: with reservation2C4G fully consumed,
+    the pod nominates reservation4C8G."""
+    rm = make_rm()
+    r4 = available(rm, "reservation4C8G", {ext.RES_CPU: 4000, ext.RES_MEMORY: 8192})
+    available(
+        rm,
+        "reservation2C4G",
+        {ext.RES_CPU: 2000, ext.RES_MEMORY: 4096},
+        allocated={ext.RES_CPU: 2000, ext.RES_MEMORY: 4096},
+    )
+    assert rm.match(owner_pod()) is r4
+
+
+def test_partial_allocation_raises_fill_score():
+    """scoreReservation counts prior allocations: a half-filled big
+    reservation outscores an empty same-size one (MostAllocated packing)."""
+    rm = make_rm()
+    available(rm, "empty-8C", {ext.RES_CPU: 8000, ext.RES_MEMORY: 16384})
+    half = available(
+        rm,
+        "half-8C",
+        {ext.RES_CPU: 8000, ext.RES_MEMORY: 16384},
+        allocated={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+    )
+    assert rm.match(owner_pod()) is half
+
+
+def test_score_reservation_reference_values():
+    pod = owner_pod()  # 2C4G
+    r4 = Reservation(
+        meta=ObjectMeta(name="r4"),
+        requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+    )
+    r2 = Reservation(
+        meta=ObjectMeta(name="r2"),
+        requests={ext.RES_CPU: 2000, ext.RES_MEMORY: 4096},
+    )
+    assert _score_reservation(pod, r4) == 50.0
+    assert _score_reservation(pod, r2) == 100.0
+    # overflow dims contribute zero
+    r_small = Reservation(
+        meta=ObjectMeta(name="rs"),
+        requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 8192},
+    )
+    assert _score_reservation(pod, r_small) == 25.0
+
+
+def test_nomination_commits_through_fast_path():
+    """End to end: the nominated (tightest) reservation takes the owner,
+    leaving the big reservation untouched."""
+    rm = make_rm()
+    available(rm, "big", {ext.RES_CPU: 8000, ext.RES_MEMORY: 16384})
+    small = available(rm, "small", {ext.RES_CPU: 2000, ext.RES_MEMORY: 4096})
+    # charge their ghost holds so the fast-path accounting is real
+    for r in rm.list():
+        rm.scheduler.snapshot.assume_pod(
+            Pod(meta=ObjectMeta(name=f"reserve-{r.meta.name}",
+                                uid=f"reservation-ghost/{r.meta.name}"),
+                spec=PodSpec(requests=dict(r.requests))),
+            "n0",
+        )
+    out = rm.scheduler.schedule([owner_pod()])
+    assert len(out.bound) == 1
+    assert small.current_owners and not rm.get("big").current_owners
