@@ -45,9 +45,15 @@ def _run_scheduler(sched, pods, chunk=4096):
 
 
 def _measure(build, chunk, name):
-    """Warmup pass on a throwaway instance (fills the jit cache for the
-    bucket shapes), then measure on fresh state — mirrors bench.py's
-    warmup-pass discipline so compile time never lands in the p99."""
+    """Warmup passes on throwaway instances (fills the jit cache for both
+    the per-chunk and the pipelined specializations), then measure on
+    fresh state — mirrors bench.py's warmup-pass discipline so compile
+    time never lands in the p99.
+
+    Latency (p50/p99) comes from one-chunk-per-call scheduling — the wait
+    an individual pod's batch experiences. Throughput comes from draining
+    the whole backlog in one call, which pipelines all chunk solves
+    on-device (chained capacity) and overlaps host commits with them."""
     sched, pods = build()
     # first solve of a new jit specialization can exceed the 30 s watchdog;
     # that's the monitor doing its job, but it's noise here — silence it
@@ -55,10 +61,18 @@ def _measure(build, chunk, name):
     _run_scheduler(sched, pods, chunk=chunk)
     sched, pods = build()
     sched.extender.monitor.stop_background()
-    t0 = time.perf_counter()
-    bound, times = _run_scheduler(sched, pods, chunk=chunk)
-    elapsed = time.perf_counter() - t0
+    _run_scheduler(sched, pods, chunk=len(pods))
+
+    sched, pods = build()
+    sched.extender.monitor.stop_background()
+    _, times = _run_scheduler(sched, pods, chunk=chunk)
     p50, p99 = _percentiles(times)
+
+    sched, pods = build()
+    sched.extender.monitor.stop_background()
+    t0 = time.perf_counter()
+    bound, _ = _run_scheduler(sched, pods, chunk=len(pods))
+    elapsed = time.perf_counter() - t0
     return {
         "scenario": name,
         "pods_per_sec": round(len(pods) / elapsed, 1),
@@ -316,23 +330,27 @@ SCENARIOS = {
 
 def main() -> None:
     wanted = sys.argv[1:] or list(SCENARIOS)
-    # merge into the existing artifact: a partial run must never discard
-    # other scenarios' numbers (BASELINE.md cites this file as the source
-    # of record for every scenario); a full run resets it so renamed or
-    # removed scenarios can't leave stale entries behind
-    existing = {}
-    if sys.argv[1:]:
-        try:
-            with open("BENCH_SUITE.json") as f:
-                existing = {r["scenario"]: r for r in json.load(f)}
-        except (OSError, ValueError, KeyError, TypeError):
-            existing = {}
+    # merge into the existing artifact: a partial or interrupted run must
+    # never discard other scenarios' numbers (BASELINE.md cites this file
+    # as the source of record for every scenario)
+    try:
+        with open("BENCH_SUITE.json") as f:
+            existing = {r["scenario"]: r for r in json.load(f)}
+    except (OSError, ValueError, KeyError, TypeError):
+        existing = {}
+    ran = set()
     for name in wanted:
         res = SCENARIOS[name]()
         existing[res["scenario"]] = res
+        ran.add(res["scenario"])
         print(json.dumps(res))
         with open("BENCH_SUITE.json", "w") as f:
             json.dump(list(existing.values()), f, indent=1)
+    if not sys.argv[1:]:
+        # a COMPLETED full run prunes stale entries (renamed/removed
+        # scenarios); interruption keeps whatever was known
+        with open("BENCH_SUITE.json", "w") as f:
+            json.dump([existing[s] for s in existing if s in ran], f, indent=1)
 
 
 if __name__ == "__main__":

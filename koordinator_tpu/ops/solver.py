@@ -211,6 +211,12 @@ class SolveResult:
     node_prod_used: jnp.ndarray   # [N, D] post-commit
     quota_used: jnp.ndarray       # [Q, D] post-commit
     rounds_used: jnp.ndarray      # [] int32
+    #: post-commit conservative GPU aggregates ([N] free whole slots, [N]
+    #: free total percent) — zeros when the solve had no DeviceState; feed
+    #: back via ``assign(dev_carry=...)`` to chain device capacity across
+    #: chunks without a host round-trip
+    node_dev_full: jnp.ndarray = None
+    node_dev_total: jnp.ndarray = None
 
 
 def _quota_headroom(
@@ -343,6 +349,7 @@ def assign(
     nomination_jitter: float = 4.0,
     approx_topk: bool = False,
     node_mask: "jnp.ndarray | None" = None,
+    dev_carry: "tuple[jnp.ndarray, jnp.ndarray] | None" = None,
 ) -> SolveResult:
     """Round-based fast solver. ``round_quantum`` is the fraction of a node's
     allocatable (per dim, measured in estimated usage) it may accept per
@@ -409,6 +416,11 @@ def assign(
         from .device import device_consumption, device_fit_mask
 
         dev_full0, dev_partial, dev_total0 = devices.aggregates()
+        if dev_carry is not None:
+            # chained aggregates from a previous chunk's SolveResult (the
+            # per-slot partial_max stays from the lowering — conservative
+            # fragmentation estimate; the host DeviceManager revalidates)
+            dev_full0, dev_total0 = dev_carry
         sdev_full, sdev_total = device_consumption(
             spods.gpu_whole, spods.gpu_share
         )
@@ -628,8 +640,8 @@ def assign(
         est_f,
         prod_f,
         qused_f,
-        _dev_full_f,
-        _dev_total_f,
+        dev_full_f,
+        dev_total_f,
         _active,
         _prog,
         rounds,
@@ -644,6 +656,8 @@ def assign(
         node_prod_used=prod_f,
         quota_used=qused_f,
         rounds_used=rounds,
+        node_dev_full=dev_full_f,
+        node_dev_total=dev_total_f,
     )
     return enforce_gangs(result, pods)
 
@@ -761,6 +775,21 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
         jnp.where(rollback & pods.is_prod, node_of, n - 1),
         num_segments=n,
     )
+    # refund rolled-back pods' conservative GPU consumption so chained
+    # dev aggregates stay exact across chunks
+    node_dev_full = result.node_dev_full
+    node_dev_total = result.node_dev_total
+    if node_dev_full is not None:
+        seg = jnp.where(rollback, node_of, n - 1)
+        whole = pods.gpu_whole.astype(jnp.float32)
+        node_dev_full = node_dev_full + jax.ops.segment_sum(
+            jnp.where(rollback, whole, 0.0), seg, num_segments=n
+        )
+        node_dev_total = node_dev_total + jax.ops.segment_sum(
+            jnp.where(rollback, whole * 100.0 + pods.gpu_share, 0.0),
+            seg,
+            num_segments=n,
+        )
     # Refund quota charges of rolled-back pods along their chains.
     # (Q == 1 is the disabled sentinel — real trees are padded to Q ≥ 2.)
     quota_used = result.quota_used
@@ -780,6 +809,8 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
         node_prod_used=result.node_prod_used - dprod,
         quota_used=quota_used,
         rounds_used=result.rounds_used,
+        node_dev_full=node_dev_full,
+        node_dev_total=node_dev_total,
     )
 
 
@@ -884,5 +915,7 @@ def assign_sequential(
         node_prod_used=prod_f,
         quota_used=qused_f,
         rounds_used=jnp.array(p, jnp.int32),
+        node_dev_full=jnp.zeros((n,), jnp.float32),
+        node_dev_total=jnp.zeros((n,), jnp.float32),
     )
     return enforce_gangs(result, pods)

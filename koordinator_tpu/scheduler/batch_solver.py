@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -68,6 +69,20 @@ class LoadAwareArgs:
 
     def scale_vector(self, config: SnapshotConfig) -> np.ndarray:
         return estimator.scale_vector(config.resources, self.estimator_scales)
+
+
+@jax.jit
+def _chain_commit_deltas(cur, nodes_t, result):
+    """Carry only the solver's commit deltas onto the untransformed base
+    state (one fused dispatch): a node transformer's rewrite applies
+    exactly once per chunk, never compounded across the pipeline."""
+    return cur.replace(
+        requested=cur.requested + (result.node_requested - nodes_t.requested),
+        estimated_used=cur.estimated_used
+        + (result.node_estimated_used - nodes_t.estimated_used),
+        prod_used=cur.prod_used
+        + (result.node_prod_used - nodes_t.prod_used),
+    )
 
 
 @dataclasses.dataclass
@@ -303,17 +318,33 @@ class BatchScheduler:
         bound: List[Tuple[Pod, str]] = list(reserved_bound)
         unsched: List[Pod] = list(gated) + list(dropped) + list(affinity_unsched)
         rounds = 0
-        for chunk in self._chunks(eligible):
+        chunks = self._chunks(eligible)
+        if len(chunks) > 1:
+            solves = self._dispatch_pipelined(chunks)
+        else:
+            solves = [
+                (chunk, None, None, self.solve(chunk)) for chunk in chunks
+            ]
+        # start all device→host copies before the first blocking fetch:
+        # on tunneled backends every synchronous fetch is a full round
+        # trip (~100 ms regardless of size); prefetching overlaps them
+        # with each other and with still-running chunk solves
+        for _chunk, _r, _e, result in solves:
+            try:
+                result.assignment.copy_to_host_async()
+                result.rounds_used.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+        for chunk, req_rows, est_rows, result in solves:
             t0 = _time.perf_counter()
-            result = self.solve(chunk)
+            assignment = np.asarray(result.assignment)  # sync point
             rounds += int(result.rounds_used)
+            if fwext.scores.top_n > 0:
+                self._debug_capture(chunk, assignment)
+            b, u = self._commit(chunk, assignment, req_rows, est_rows)
             fwext.registry.get("solver_batch_latency_seconds").observe(
                 _time.perf_counter() - t0
             )
-            assignment = np.asarray(result.assignment)
-            if fwext.scores.top_n > 0:
-                self._debug_capture(chunk, assignment)
-            b, u = self._commit(chunk, assignment)
             bound.extend(b)
             unsched.extend(u)
         for pod, _node in bound:
@@ -388,12 +419,75 @@ class BatchScheduler:
             chunks.append(cur)
         return chunks
 
-    def solve(self, chunk: Sequence[Pod]) -> SolveResult:
-        pods = self.pod_batch(chunk)
-        nodes = self.node_state()
-        # BeforeFilter analog: device-batch transformers.
-        pods, nodes = self.extender.run_batch_transformers(pods, nodes)
-        quotas = self.quota_state(chunk)
+    def _dispatch_pipelined(
+        self, chunks: List[List[Pod]]
+    ) -> List[Tuple[List[Pod], np.ndarray, np.ndarray, SolveResult]]:
+        """Dispatch every chunk's solve back-to-back, chaining consumed
+        node/quota/device capacity on device (solve_stream's discipline
+        applied to the host pipeline): chunk k+1's masks see chunk k's
+        solver commits without waiting for the host Reserve of chunk k.
+        On tunneled TPU backends the per-dispatch round-trip dominated
+        the constrained scenarios — this overlaps all of them. NUMA zone
+        state and per-slot GPU fragmentation are lowered once and refined
+        only by conservative on-device aggregates; the host managers
+        still revalidate every winner at commit, so staleness can only
+        under-place within one call, never overcommit."""
+        quotas0 = self.quota_state([p for c in chunks for p in c])
+        qused = quotas0.used if quotas0 is not None else None
+        numa_state, device_state = self._constraint_states()
+
+        nodes0 = self.node_state()
+        cur = nodes0
+        dev_carry = None
+        out: List[Tuple[List[Pod], np.ndarray, np.ndarray, SolveResult]] = []
+        for chunk in chunks:
+            pods = self.pod_batch(chunk)
+            req_rows, est_rows = self._lowered_req, self._lowered_est
+            # transformers see the chained base state fresh each chunk;
+            # chaining carries only the solver's own commit DELTAS, so a
+            # transformer that rewrites node state (the BeforeFilter
+            # analog) is applied exactly once per chunk, never compounded
+            pods_t, nodes_t = self.extender.run_batch_transformers(pods, cur)
+            node_mask = self._node_constraint_mask(
+                chunk, pods_t.requests.shape[0]
+            )
+            result = assign(
+                pods_t,
+                nodes_t,
+                self._params,
+                quotas=(
+                    QuotaState(runtime=quotas0.runtime, used=qused)
+                    if quotas0 is not None
+                    else None
+                ),
+                numa=numa_state,
+                devices=device_state,
+                max_rounds=self.max_rounds,
+                cost_transform=self.extender.cost_transform,
+                approx_topk=True,
+                node_mask=node_mask,
+                dev_carry=dev_carry,
+            )
+            if nodes_t is cur:
+                # no node transformer ran: the solver outputs ARE the
+                # chained state (avoids extra dispatches on the tunnel)
+                cur = cur.replace(
+                    requested=result.node_requested,
+                    estimated_used=result.node_estimated_used,
+                    prod_used=result.node_prod_used,
+                )
+            else:
+                cur = _chain_commit_deltas(cur, nodes_t, result)
+            if quotas0 is not None:
+                qused = result.quota_used
+            if device_state is not None:
+                dev_carry = (result.node_dev_full, result.node_dev_total)
+            out.append((chunk, req_rows, est_rows, result))
+        return out
+
+    def _constraint_states(self):
+        """Lower the NUMA zone table and GPU slot table for the solver
+        (None for whichever manager is absent/empty)."""
         numa_state = None
         if self.numa is not None and self.numa.has_topology:
             from ..ops.numa import NumaState
@@ -411,6 +505,15 @@ class BatchScheduler:
             device_state = DeviceState(
                 slot_free=jnp.asarray(self.devices.slot_array())
             )
+        return numa_state, device_state
+
+    def solve(self, chunk: Sequence[Pod]) -> SolveResult:
+        pods = self.pod_batch(chunk)
+        nodes = self.node_state()
+        # BeforeFilter analog: device-batch transformers.
+        pods, nodes = self.extender.run_batch_transformers(pods, nodes)
+        quotas = self.quota_state(chunk)
+        numa_state, device_state = self._constraint_states()
         node_mask = self._node_constraint_mask(chunk, pods.requests.shape[0])
         return assign(
             pods,
@@ -516,24 +619,32 @@ class BatchScheduler:
         return estimate_pod(self.snapshot.config, pod, self._scales)
 
     def _commit(
-        self, chunk: Sequence[Pod], assignment: np.ndarray
+        self,
+        chunk: Sequence[Pod],
+        assignment: np.ndarray,
+        req_rows: Optional[np.ndarray] = None,
+        est_rows: Optional[np.ndarray] = None,
     ) -> Tuple[List[Tuple[Pod, str]], List[Pod]]:
         """Host-side Reserve: revalidate each nomination against live numpy
         state (the reference's Reserve mutates the scheduler cache the same
-        way, ``framework_extender.go:546``)."""
+        way, ``framework_extender.go:546``). ``req_rows``/``est_rows`` are
+        the rows lowered for this chunk (the pipelined path captures them
+        per chunk); when omitted the last ``pod_batch`` stash is used,
+        guarded by a uid check."""
         from .prebind import DefaultPreBind
 
         na = self.snapshot.nodes
         results: List[Tuple[Pod, Optional[str]]] = []
         prebind = DefaultPreBind()
-        if self._lowered_uids != tuple(p.meta.uid for p in chunk):
-            raise RuntimeError(
-                "_commit called with a chunk that does not match the last "
-                "pod_batch lowering — solve() and _commit() must run on "
-                "the same chunk"
-            )
-        req_rows = self._lowered_req
-        est_rows = self._lowered_est
+        if req_rows is None or est_rows is None:
+            if self._lowered_uids != tuple(p.meta.uid for p in chunk):
+                raise RuntimeError(
+                    "_commit called with a chunk that does not match the "
+                    "last pod_batch lowering — solve() and _commit() must "
+                    "run on the same chunk"
+                )
+            req_rows = self._lowered_req
+            est_rows = self._lowered_est
         order = sorted(
             range(len(chunk)), key=lambda i: (-(chunk[i].spec.priority or 0), i)
         )

@@ -515,3 +515,26 @@ def test_hopper_partition_table_matches_reference_layout():
             p.allocation_score == 1 for ps in table.values() for p in ps
         )
     assert partition_table_for_model("A100") == {}
+
+
+def test_pipelined_multichunk_schedule_consistency():
+    """A schedule() call spanning several solver chunks (batch_bucket <
+    pending) chains capacity on device; committed placements must respect
+    exact node/GPU capacity with zero overcommit, matching the per-chunk
+    path's totals."""
+    snap, dm = make_cluster(n_nodes=4, gpus=8)
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=8)
+    sched.extender.monitor.stop_background()
+    # 16 pods x 2 GPUs = exactly the cluster's 32 GPUs, across 2 chunks
+    pods = [gpu_pod(f"w{i:02d}", whole=2, cpu=4000) for i in range(16)]
+    out = sched.schedule(pods)
+    assert len(out.bound) == 16
+    per_node = {}
+    for pod, node in out.bound:
+        per_node[node] = per_node.get(node, 0) + 2
+    assert all(v <= 8 for v in per_node.values())
+    # exact slot accounting: every GPU allocated exactly once
+    for st in dm._nodes.values():
+        assert sum(st.gpu_free) == 0.0
+    # a 17th pod finds nothing
+    assert sched.schedule([gpu_pod("extra", whole=1)]).bound == []
