@@ -48,6 +48,9 @@ ANNOTATION_GANG_GROUPS = f"gang.scheduling.{DOMAIN}/groups"
 #: AnnotationGPUPartitionSpec): {"allocatePolicy": "Restricted"|"BestEffort",
 #: "ringBusBandwidth": <GB/s>}
 ANNOTATION_GPU_PARTITION_SPEC = f"scheduling.{DOMAIN}/gpu-partition-spec"
+#: joint multi-device allocation directive (reference
+#: ``apis/extension/device_share.go:35-36`` AnnotationDeviceJointAllocate)
+ANNOTATION_DEVICE_JOINT_ALLOCATE = f"scheduling.{DOMAIN}/device-joint-allocate"
 #: node-side partition table annotation (AnnotationGPUPartitions)
 ANNOTATION_GPU_PARTITIONS = f"scheduling.{DOMAIN}/gpu-partitions"
 #: node label choosing Honor/Prefer (LabelGPUPartitionPolicy)
@@ -131,6 +134,8 @@ RES_GPU_CORE = f"{DOMAIN}/gpu-core"
 RES_GPU_MEMORY = f"{DOMAIN}/gpu-memory"
 RES_GPU_MEMORY_RATIO = f"{DOMAIN}/gpu-memory-ratio"
 RES_RDMA = f"{DOMAIN}/rdma"
+RES_KOORD_GPU = f"{DOMAIN}/gpu"          # percentage-style whole/fractional
+RES_GPU_SHARED = f"{DOMAIN}/gpu.shared"  # shared-GPU instance count
 
 #: Canonical dense resource axis for the solver. Extended resources used by a
 #: deployment append here; the solver is shape-polymorphic in D.
@@ -153,6 +158,45 @@ def parse_gpu_request(requests: Mapping[str, float]) -> tuple[int, float]:
         whole += int(ratio // 100.0)
         ratio = ratio % 100.0
     return whole, ratio
+
+
+def parse_rdma_request(requests: Mapping[str, float]) -> int:
+    """Whole RDMA devices from ``koordinator.sh/rdma`` (the reference
+    allocates RDMA NICs in 100-unit instances, ``device_share.go:102``);
+    any positive fraction rounds up to a whole device."""
+    import math
+
+    try:
+        raw = float(requests.get(RES_RDMA, 0.0))
+    except (TypeError, ValueError):
+        return 0
+    return int(math.ceil(raw / 100.0)) if raw > 0 else 0
+
+
+def parse_device_joint_allocate(
+    annotations: Mapping[str, str],
+) -> Optional[tuple[tuple[str, ...], str]]:
+    """(device_types, required_scope) from the joint-allocate annotation
+    (``DeviceJointAllocate``: deviceTypes ordered primary-first;
+    requiredScope "SamePCIe" makes PCIe co-location binding)."""
+    import json as _json
+
+    raw = annotations.get(ANNOTATION_DEVICE_JOINT_ALLOCATE)
+    if not raw:
+        return None
+    try:
+        spec = _json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(spec, dict):
+        return None
+    types = spec.get("deviceTypes")
+    if not isinstance(types, list) or not all(
+        isinstance(t, str) for t in types
+    ):
+        return None
+    scope = spec.get("requiredScope")
+    return tuple(types), (scope if isinstance(scope, str) else "")
 
 
 def parse_reservation_affinity(

@@ -121,6 +121,8 @@ class PodArrays:
     #: whole GPUs / fractional GPU percent per pod (DeviceShare)
     gpu_whole: np.ndarray
     gpu_share: np.ndarray
+    #: whole RDMA NICs per pod (koordinator.sh/rdma, 100-unit instances)
+    rdma: np.ndarray
     p_real: int
     #: gang id -> "namespace/name" key, parallel to gang_min rows
     gang_keys: List[str] = dataclasses.field(default_factory=list)
@@ -138,6 +140,7 @@ class PodArrays:
             gang_min=np.zeros((p_bucket,), np.int32),
             gpu_whole=np.zeros((p_bucket,), np.int32),
             gpu_share=np.zeros((p_bucket,), np.float32),
+            rdma=np.zeros((p_bucket,), np.int32),
             p_real=0,
         )
 
@@ -189,6 +192,16 @@ class ClusterSnapshot:
         self._assumed: Dict[str, "_AssumedPod"] = {}
         #: node name -> labels (nodeSelector/affinity masks read these)
         self._node_labels: Dict[str, Dict[str, str]] = {}
+
+    def reset(self) -> None:
+        """Clear all state in place (full-resync path: the snapshot object
+        stays shared with the scheduler, so identity must survive)."""
+        self._node_index.clear()
+        self._node_names.clear()
+        self._free_node_slots.clear()
+        self.nodes = NodeArrays.empty(self.config.min_bucket, self.config.dims)
+        self._assumed.clear()
+        self._node_labels.clear()
 
     # ---- node side ----
 
@@ -434,6 +447,7 @@ class ClusterSnapshot:
             out.gpu_whole[i], out.gpu_share[i] = ext.parse_gpu_request(
                 pod.spec.requests
             )
+            out.rdma[i] = ext.parse_rdma_request(pod.spec.requests)
             gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
             if gang:
                 key = f"{pod.meta.namespace}/{gang}"

@@ -1,31 +1,41 @@
 """Pod validating webhook checks.
 
-Rebuild of ``pkg/webhook/pod/validating/`` (``verify_annotations.go``,
-QoS/priority consistency): reject pods whose QoS class, priority band and
-resource spec disagree with the annotation protocol before they reach the
-scheduler.
+Rebuild of ``pkg/webhook/pod/validating/`` — QoS/priority consistency,
+forbidden annotations (``verify_annotations.go:60-76``), device-resource
+declaration rules (``verify_device_resource.go:68-176``), and
+annotation-payload shape verification for the scheduling protocol
+annotations: reject pods whose QoS class, priority band, resource spec or
+annotations disagree with the protocol before they reach the scheduler.
 """
 
 from __future__ import annotations
 
+import json
 from typing import List
 
 from ..api import extension as ext
 from ..api.extension import PriorityClass, QoSClass
 from ..api.types import Pod
 
+#: annotations only the scheduler itself may write (the reference forbids
+#: the reserve-pod marker the same way, ``verify_annotations.go:60-63``)
+FORBIDDEN_ANNOTATIONS = (
+    f"scheduling.{ext.DOMAIN}/reserve-pod",
+)
+
 
 def validate_pod(pod: Pod) -> List[str]:
-    """Returns a list of violation messages (empty = valid).
+    """Returns a list of violation messages (empty = valid)."""
+    errors: List[str] = []
+    errors += _validate_qos_priority(pod)
+    errors += _validate_forbidden_annotations(pod)
+    errors += _validate_device_resources(pod)
+    errors += _validate_annotation_shapes(pod)
+    return errors
 
-    Rules (reference ``pod/validating``):
-      * BE pods must not request exclusive cpus (integer cpu + LSR/LSE only)
-      * LSE/LSR requires prod priority band
-      * BE pods should request batch-tier resources, not raw cpu/memory
-        limits beyond requests
-      * priority value must lie in the band implied by any explicit
-        koord priority class label
-    """
+
+def _validate_qos_priority(pod: Pod) -> List[str]:
+    """QoS/priority band consistency (the round-1 core rules)."""
     errors: List[str] = []
     qos = pod.qos
     band = pod.priority_class
@@ -61,7 +71,161 @@ def validate_pod(pod: Pod) -> List[str]:
                     f"priority {pod.spec.priority} outside the "
                     f"{explicit_band.name} band"
                 )
-    gpu_whole, gpu_share = ext.parse_gpu_request(pod.spec.requests)
-    if gpu_whole > 0 and gpu_share > 0:
+    return errors
+
+
+def _validate_forbidden_annotations(pod: Pod) -> List[str]:
+    """Scheduler-owned annotations may not be set at admission."""
+    return [
+        f"annotation {key} cannot be set on pod create/update"
+        for key in FORBIDDEN_ANNOTATIONS
+        if key in pod.meta.annotations
+    ]
+
+
+def _validate_device_resources(pod: Pod) -> List[str]:
+    """Device declaration rules (``verify_device_resource.go:68-176``):
+    the koord percentage-GPU API and the shared-GPU API are mutually
+    exclusive; percentage GPU must be >0 and, above 100, a multiple of
+    100; shared GPU needs exactly one of gpu-memory / gpu-memory-ratio,
+    with core/ratio multiples of the share count."""
+    errors: List[str] = []
+    req = pod.spec.requests
+    koord_gpu = req.get(ext.RES_KOORD_GPU)
+    gpu_shared = req.get(ext.RES_GPU_SHARED)
+
+    if koord_gpu is not None and gpu_shared is not None:
+        return ["cannot declare GPU and GPU share at the same time"]
+
+    if koord_gpu is not None:
+        if koord_gpu <= 0:
+            errors.append("the requested GPU must be greater than zero")
+        elif koord_gpu > 100 and koord_gpu % 100 != 0:
+            errors.append("the requested GPU must be a percentage of 100")
+
+    if gpu_shared is not None:
+        if gpu_shared <= 0:
+            errors.append("the requested GPU share must be greater than zero")
+        mem = req.get(ext.RES_GPU_MEMORY, 0.0)
+        ratio = req.get(ext.RES_GPU_MEMORY_RATIO, 0.0)
+        core = req.get(ext.RES_GPU_CORE, 0.0)
+        if mem == 0 and ratio == 0:
+            errors.append("GPU memory and GPU memory ratio are both zero")
+        if mem != 0 and ratio != 0:
+            errors.append(
+                "cannot declare GPU memory and GPU memory ratio at the same time"
+            )
+        if gpu_shared > 0:
+            if core and core % gpu_shared != 0:
+                errors.append("the requested gpu-core must be a multiple of shared")
+            if ratio and ratio % gpu_shared != 0:
+                errors.append(
+                    "the requested gpu-memory-ratio must be a multiple of shared"
+                )
+
+    whole, share = ext.parse_gpu_request(req)
+    if whole > 0 and share > 0:
         errors.append("multi-GPU pods cannot also request a fractional share")
+    rdma = req.get(ext.RES_RDMA)
+    if rdma is not None and rdma <= 0:
+        errors.append("the requested RDMA must be greater than zero")
+    return errors
+
+
+def _validate_annotation_shapes(pod: Pod) -> List[str]:
+    """Scheduling-protocol annotations must carry well-formed payloads —
+    a malformed shape silently degrades scheduling behavior otherwise
+    (resource-spec → Default bind policy, partition-spec → no bandwidth
+    demand, …), so admission rejects it loudly."""
+    errors: List[str] = []
+    ann = pod.meta.annotations
+
+    def parsed(key):
+        raw = ann.get(key)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except (ValueError, TypeError):
+            errors.append(f"annotation {key} is not valid JSON")
+            return None
+
+    spec = parsed(ext.ANNOTATION_RESOURCE_SPEC)
+    if spec is not None and not isinstance(spec, dict):
+        errors.append(f"annotation {ext.ANNOTATION_RESOURCE_SPEC} must be an object")
+    elif isinstance(spec, dict):
+        policy = spec.get("preferredCPUBindPolicy")
+        if policy is not None and policy not in (
+            "Default",
+            "FullPCPUs",
+            "SpreadByPCPUs",
+            "ConstrainedBurst",
+        ):
+            errors.append(f"unknown preferredCPUBindPolicy {policy!r}")
+
+    status = parsed(ext.ANNOTATION_RESOURCE_STATUS)
+    if status is not None:
+        # resource-status is scheduler-written; on user objects it must at
+        # least be the right shape (object with optional cpuset string /
+        # numaNodeResources list)
+        if not isinstance(status, dict):
+            errors.append(
+                f"annotation {ext.ANNOTATION_RESOURCE_STATUS} must be an object"
+            )
+        else:
+            if "cpuset" in status and not isinstance(status["cpuset"], str):
+                errors.append("resource-status cpuset must be a string")
+            nnr = status.get("numaNodeResources")
+            if nnr is not None and (
+                not isinstance(nnr, list)
+                or not all(isinstance(z, dict) and "node" in z for z in nnr)
+            ):
+                errors.append(
+                    "resource-status numaNodeResources must be a list of "
+                    "{node: ...} objects"
+                )
+
+    alloc = parsed(ext.ANNOTATION_DEVICE_ALLOCATED)
+    if alloc is not None:
+        if not isinstance(alloc, dict):
+            errors.append(
+                f"annotation {ext.ANNOTATION_DEVICE_ALLOCATED} must be an object"
+            )
+        else:
+            for dev_type, entries in alloc.items():
+                if not isinstance(entries, list) or not all(
+                    isinstance(e, dict) and isinstance(e.get("minor"), int)
+                    for e in entries
+                ):
+                    errors.append(
+                        f"device-allocated[{dev_type}] must be a list of "
+                        "{minor, resources} objects"
+                    )
+
+    affinity = parsed(ext.ANNOTATION_RESERVATION_AFFINITY)
+    if affinity is not None and not isinstance(affinity, dict):
+        errors.append(
+            f"annotation {ext.ANNOTATION_RESERVATION_AFFINITY} must be an object"
+        )
+
+    part = parsed(ext.ANNOTATION_GPU_PARTITION_SPEC)
+    if part is not None:
+        if not isinstance(part, dict):
+            errors.append(
+                f"annotation {ext.ANNOTATION_GPU_PARTITION_SPEC} must be an object"
+            )
+        else:
+            bw = part.get("ringBusBandwidth")
+            if bw is not None and not isinstance(bw, (int, float)):
+                errors.append("gpu-partition-spec ringBusBandwidth must be numeric")
+            pol = part.get("allocatePolicy")
+            if pol is not None and pol not in ("Restricted", "BestEffort"):
+                errors.append(f"unknown gpu-partition allocatePolicy {pol!r}")
+
+    if ext.ANNOTATION_DEVICE_JOINT_ALLOCATE in ann:
+        if ext.parse_device_joint_allocate(ann) is None:
+            errors.append(
+                f"annotation {ext.ANNOTATION_DEVICE_JOINT_ALLOCATE} must carry "
+                "deviceTypes: [string, ...]"
+            )
     return errors

@@ -139,3 +139,57 @@ def fit_plus_cost(
     w = dim_weights[None, None, :] * wants[:, None, :]
     score = jnp.sum(per_dim_score * w, axis=-1) / (jnp.sum(w, axis=-1) + _SAFE)
     return -score
+
+
+def numa_aligned_cost(
+    pod_req: jnp.ndarray,
+    wants_numa: jnp.ndarray,
+    zone_free: jnp.ndarray,
+    zone_cap: jnp.ndarray,
+    weights: jnp.ndarray,
+    most_allocated: bool = False,
+) -> jnp.ndarray:
+    """NUMA-aligned Least/MostAllocated scoring (reference
+    ``nodenumaresource/scoring.go:66-120`` → ``calculateAllocatableAndRequested``
+    + ``least_allocated.go``/``most_allocated.go``): for each (pod, node)
+    the pod's hypothetical allocation is placed into the zone the host
+    allocator would pick (the least-utilized zone that fits), and the node
+    is scored on THAT zone's requested/allocatable — so a node whose
+    aligned zone is tight scores poorly even when node totals look fine.
+
+    pod_req      [P, D]  (only the first DN zone dims are used)
+    wants_numa   [P] bool — pods without NUMA interest contribute 0
+                 (reference preFilterState.skip)
+    zone_free    [N, Z, DN], zone_cap [N, Z, DN]
+    weights      [DN] scoring-strategy resource weights
+    Returns [P, N] cost (= -score, reference scores are 0..100).
+    """
+    dn = zone_cap.shape[-1]
+    req = pod_req[:, :dn]                                   # [P, DN]
+    real = jnp.any(zone_cap > 0, axis=-1)                   # [N, Z]
+    fits = jnp.all(
+        req[:, None, None, :] <= zone_free[None, :, :, :] + 1e-6, axis=-1
+    ) & real[None, :, :]                                    # [P, N, Z]
+    used = zone_cap - zone_free                             # [N, Z, DN]
+    # host zone pick: least (used_cpu+1)/(cap_cpu+1) among fitting zones
+    util = (used[..., 0] + 1.0) / (zone_cap[..., 0] + 1.0)  # [N, Z]
+    key = jnp.where(fits, util[None, :, :], jnp.inf)
+    zstar = jnp.argmin(key, axis=-1)                        # [P, N]
+    has_zone = jnp.any(fits, axis=-1)                       # [P, N]
+    zoh = (
+        jnp.arange(zone_cap.shape[1])[None, None, :] == zstar[:, :, None]
+    )                                                       # [P, N, Z]
+    used_z = jnp.sum(used[None] * zoh[..., None], axis=2)   # [P, N, DN]
+    cap_z = jnp.sum(zone_cap[None] * zoh[..., None], axis=2)
+    after = used_z + req[:, None, :]
+    # integer-floor per-resource score, 0 when over capacity or cap==0
+    # (leastRequestedScore / mostRequestedScore int64 semantics)
+    if most_allocated:
+        raw = jnp.floor(after * 100.0 / (cap_z + _SAFE))
+    else:
+        raw = jnp.floor((cap_z - after) * 100.0 / (cap_z + _SAFE))
+    per_dim = jnp.where((cap_z > 0) & (after <= cap_z + 1e-6), raw, 0.0)
+    wsum = jnp.sum(weights[:dn]) + _SAFE
+    score = jnp.floor(jnp.sum(per_dim * weights[None, None, :dn], axis=-1) / wsum)
+    score = jnp.where(wants_numa[:, None] & has_zone, score, 0.0)
+    return -score

@@ -30,9 +30,14 @@ class DeviceState:
     """Per-node GPU slot state: slot_free [N, G] in percent units.
 
     Nodes without GPUs have all-zero rows; a row of 100s is an idle GPU.
+    ``rdma_free`` [N] counts idle RDMA NICs per node (None when the
+    deployment has no RDMA inventory) — feasibility only; exact NIC
+    minors and PCIe co-location are the host DeviceManager's joint
+    allocation at Reserve.
     """
 
     slot_free: jnp.ndarray
+    rdma_free: jnp.ndarray = None
 
     def aggregates(self):
         """(full_count [N], partial_max [N], total [N])."""
@@ -49,6 +54,8 @@ def device_fit_mask(
     gpu_share: jnp.ndarray,    # [P] float32 — percent of one GPU (0 = none)
     full_count: jnp.ndarray,   # [N]
     partial_max: jnp.ndarray,  # [N]
+    rdma_req: jnp.ndarray = None,   # [P] int32 — whole RDMA NICs
+    rdma_free: jnp.ndarray = None,  # [N] free NIC count
 ) -> jnp.ndarray:
     """[P, N] GPU feasibility (reference Filter, ``plugin.go:311``).
 
@@ -70,6 +77,11 @@ def device_fit_mask(
         gpu_whole[:, None].astype(jnp.float32) + 1.0 <= full_count[None, :] + EPS
     ) | (frac <= partial_max[None, :] + EPS)
     ok = whole_ok & jnp.where(both, both_ok, frac_ok)
+    if rdma_req is not None and rdma_free is not None:
+        ok &= (
+            rdma_req[:, None].astype(jnp.float32)
+            <= rdma_free[None, :] + EPS
+        )
     return ok
 
 

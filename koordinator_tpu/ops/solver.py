@@ -107,6 +107,8 @@ class PodBatch:
     #: fractional GPU requested in percent of one device
     #: (koordinator.sh/gpu-memory-ratio < 100), [P] float32
     gpu_share: jnp.ndarray
+    #: whole RDMA devices requested (koordinator.sh/rdma / 100), [P] int32
+    rdma: jnp.ndarray = None
 
     @classmethod
     def create(
@@ -122,6 +124,7 @@ class PodBatch:
         qos=None,
         gpu_whole=None,
         gpu_share=None,
+        rdma=None,
         quota_levels: int = 4,
     ) -> "PodBatch":
         requests = jnp.asarray(requests, jnp.float32)
@@ -164,6 +167,11 @@ class PodBatch:
                 jnp.zeros(p, jnp.float32)
                 if gpu_share is None
                 else jnp.asarray(gpu_share, jnp.float32)
+            ),
+            rdma=(
+                jnp.zeros(p, jnp.int32)
+                if rdma is None
+                else jnp.asarray(rdma, jnp.int32)
             ),
         )
 
@@ -333,6 +341,7 @@ def _priority_order(pods: PodBatch) -> jnp.ndarray:
         "cost_transform",
         "nomination_jitter",
         "approx_topk",
+        "numa_scoring",
     ),
 )
 def assign(
@@ -350,6 +359,7 @@ def assign(
     approx_topk: bool = False,
     node_mask: "jnp.ndarray | None" = None,
     dev_carry: "tuple[jnp.ndarray, jnp.ndarray] | None" = None,
+    numa_scoring: "str | None" = None,
 ) -> SolveResult:
     """Round-based fast solver. ``round_quantum`` is the fraction of a node's
     allocatable (per dim, measured in estimated usage) it may accept per
@@ -412,6 +422,22 @@ def assign(
             & (jnp.mod(cpu_req, 1000.0) == 0)
         )
         numa_mask = numa_fit_mask(spods.requests, wants, numa)
+        if numa_scoring is not None:
+            # NUMA-aligned Least/MostAllocated Score strategies
+            # (nodenumaresource/scoring.go:66-120): a static [P, N] score
+            # term over the zone the host allocator would pick
+            numa_score_term = cost_ops.numa_aligned_cost(
+                spods.requests,
+                wants,
+                numa.zone_free,
+                numa.zone_cap,
+                params.score_weights,
+                most_allocated=(numa_scoring == "MostAllocated"),
+            )
+        else:
+            numa_score_term = None
+    else:
+        numa_score_term = None
     if devices is not None:
         from .device import device_consumption, device_fit_mask
 
@@ -462,7 +488,12 @@ def assign(
             feas &= numa_mask
         if devices is not None:
             feas &= device_fit_mask(
-                spods.gpu_whole, spods.gpu_share, dev_full, dev_partial
+                spods.gpu_whole,
+                spods.gpu_share,
+                dev_full,
+                dev_partial,
+                rdma_req=spods.rdma,
+                rdma_free=devices.rdma_free,
             )
         cost = cost_ops.load_aware_cost(
             spods.estimate,
@@ -471,6 +502,8 @@ def assign(
             params.score_weights,
             metric_fresh=nodes.metric_fresh,
         )
+        if numa_score_term is not None:
+            cost = cost + numa_score_term
         if cost_transform is not None:
             # BeforeScore transformer chain (frameworkext.interface.go:84-109):
             # a static, jit-traced rewrite of the cost tensor.

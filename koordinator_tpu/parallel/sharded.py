@@ -73,6 +73,7 @@ def _pod_spec() -> PodBatch:
         qos=P("dp"),
         gpu_whole=P("dp"),
         gpu_share=P("dp"),
+        rdma=P("dp"),
     )
 
 

@@ -76,6 +76,43 @@ class SolverService:
         cfg = self.snapshot.config
         now = delta.now or time.time()
         with self._lock:
+            # Generation-gap detection (informer re-list analog): a delta
+            # that is not exactly the next revision was dropped/reordered
+            # in transit — applying it would silently diverge the solver's
+            # world view, so REJECT and demand a full resync instead. A
+            # fresh solver (revision 0) is mid-stream blind: it accepts
+            # only a stream head (revision ≤ 1) or a full re-list,
+            # otherwise a restarted solver would adopt one incremental
+            # delta as its entire world.
+            if (
+                delta.revision
+                and not delta.full
+                and delta.revision != self.revision + 1
+                and not (self.revision == 0 and delta.revision <= 1)
+            ):
+                return pb.SyncAck(
+                    applied_revision=self.revision,
+                    node_count=self.snapshot.node_count,
+                    resync_required=True,
+                    expected_revision=self.revision + 1,
+                )
+            if delta.full:
+                # complete world state follows: start from nothing. Quota
+                # charges and device/NUMA holds of pods that vanished with
+                # the old world must not leak — managers reset too, and
+                # exact holds are re-established as pods re-commit (the
+                # reference rebuilds its device cache from pod annotations
+                # on re-list; the channel's pod_assumed entries re-charge
+                # node capacity here).
+                self.snapshot.reset()
+                sched = self.scheduler
+                sched._bound_nodes.clear()
+                if sched.quotas is not None:
+                    sched.quotas.reset_usage()
+                if sched.devices is not None:
+                    sched.devices.reset_allocations()
+                if sched.numa is not None:
+                    sched.numa.reset_allocations()
             for up in delta.node_upserts:
                 self.snapshot.upsert_node(
                     Node(
@@ -232,6 +269,20 @@ class SolverClient:
 
     def sync(self, delta: pb.SnapshotDelta) -> pb.SyncAck:
         return self._sync(delta)
+
+    def sync_with_resync(self, delta: pb.SnapshotDelta, full_state_fn) -> pb.SyncAck:
+        """Send a delta; when the solver reports a generation gap, answer
+        with the full world state from ``full_state_fn() ->
+        SnapshotDelta`` (marked full=true, carrying this delta's
+        revision) — the informer re-list on disconnect."""
+        ack = self._sync(delta)
+        if not ack.resync_required:
+            return ack
+        full = full_state_fn()
+        full.full = True
+        if not full.revision:
+            full.revision = delta.revision
+        return self._sync(full)
 
     def nominate(self, req: pb.NominateRequest) -> pb.NominateResponse:
         return self._nominate(req)

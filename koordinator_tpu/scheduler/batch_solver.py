@@ -90,6 +90,9 @@ class ScheduleOutcome:
     bound: List[Tuple[Pod, str]]
     unschedulable: List[Pod]
     rounds_used: int = 0
+    #: victims evicted by quota preemption this cycle (the caller performs
+    #: the actual eviction, like the reference's evictor plugins)
+    preempted: List[Pod] = dataclasses.field(default_factory=list)
 
 
 class BatchScheduler:
@@ -135,6 +138,8 @@ class BatchScheduler:
         self._lowered_uids: Tuple[str, ...] = ()
         self._lowered_req = np.zeros((0, len(self.snapshot.config.resources)))
         self._lowered_est = self._lowered_req
+        #: pod uid → node for bound pods (preemption victim lookup)
+        self._bound_nodes: Dict[str, str] = {}
 
     # ---- device lowering ----
 
@@ -212,16 +217,28 @@ class BatchScheduler:
             qos=arrays.qos,
             gpu_whole=arrays.gpu_whole,
             gpu_share=arrays.gpu_share,
+            rdma=arrays.rdma,
         )
 
     # ---- scheduling cycle ----
 
-    def schedule(self, pending: Sequence[Pod]) -> ScheduleOutcome:
+    def schedule(
+        self, pending: Sequence[Pod], _retry: bool = False
+    ) -> ScheduleOutcome:
         import time as _time
 
         fwext = self.extender
-        for pod in pending:
-            fwext.monitor.start_monitor(pod)
+        if not _retry:
+            for pod in pending:
+                fwext.monitor.start_monitor(pod)
+            # amortized purge: pods forgotten through any path (delete
+            # sync, resync, eviction) must not accumulate here forever
+            if len(self._bound_nodes) > 64 + 2 * len(self.snapshot._assumed):
+                self._bound_nodes = {
+                    uid: node
+                    for uid, node in self._bound_nodes.items()
+                    if uid in self.snapshot._assumed
+                }
         # BeforePreFilter analog: pod transformers may rewrite or drop.
         # (Dropped pods are error-handled inside the transformer run.)
         pending, dropped = fwext.run_pre_batch_transformers(pending)
@@ -303,7 +320,8 @@ class BatchScheduler:
                     continue
                 self.reservations.allocate(r, pod)
                 if leaf is not None:
-                    self.quotas.charge(leaf, pod.spec.requests)
+                    self.quotas.assign_pod(leaf, pod)
+                self._bound_nodes[pod.meta.uid] = node
                 pod.meta.annotations.update(patch)
                 reserved_bound.append((pod, node))
             pending = remaining_pending
@@ -347,24 +365,124 @@ class BatchScheduler:
             )
             bound.extend(b)
             unsched.extend(u)
+        # PostFilter analog (reference elasticquota/preempt.go): a failed
+        # quota-labeled pod may evict lower-priority same-quota pods, then
+        # the batch retries once for the preemptors.
+        preempted: List[Pod] = []
+        if (
+            not _retry
+            and unsched
+            and self.quotas.enable_preemption
+            and self.quotas.quota_count > 0
+        ):
+            from .plugins.coscheduling import gang_key_of as _gang_of
+            from .plugins.elasticquota import ElasticQuotaPreemptor
+
+            preemptor = ElasticQuotaPreemptor(self, self.quotas)
+            retry_pods: List[Pod] = []
+            for pod in sorted(
+                unsched, key=lambda p: -(p.spec.priority or 0)
+            ):
+                if pod.meta.uid in dropped_uids or _gang_of(pod) is not None:
+                    continue
+                # required reservation affinity: the pod may only run from
+                # a matching reservation — evicting quota victims cannot
+                # help it, so never preempt on its behalf
+                if ext.parse_reservation_affinity(pod.meta.annotations):
+                    continue
+                sel = preemptor.select_victims(pod)
+                if sel is None:
+                    continue
+                _node, victims = sel
+                for victim in victims:
+                    self.evict_for_preemption(victim)
+                    preempted.append(victim)
+                retry_pods.append(pod)
+            if retry_pods:
+                again = self.schedule(retry_pods, _retry=True)
+                bound.extend(again.bound)
+                retried = {p.meta.uid for p in retry_pods}
+                unsched = [
+                    p for p in unsched if p.meta.uid not in retried
+                ] + list(again.unschedulable)
+
         for pod, _node in bound:
             self.pod_groups.remove_pod(pod, bound=True)
-        for pod in unsched:
-            if pod.meta.uid not in dropped_uids:
-                fwext.errors.handle(pod, "unschedulable in batch cycle")
-        # The attempt is over for every pod in this cycle, whatever the
-        # outcome — the reference monitor wraps scheduleOne the same way.
-        for pod, _node in bound:
-            fwext.monitor.complete(pod)
-        for pod in unsched:
-            fwext.monitor.complete(pod)
-        from .plugins.coscheduling import gang_key_of
+        # Tail bookkeeping runs once per external cycle: the preemption
+        # retry's inner call skips it (the outer call accounts the merged
+        # results) so retried pods are never double-counted and never get
+        # errors.handle/monitor.complete fired twice.
+        if not _retry:
+            for pod in unsched:
+                if pod.meta.uid not in dropped_uids:
+                    fwext.errors.handle(pod, "unschedulable in batch cycle")
+            # The attempt is over for every pod in this cycle, whatever
+            # the outcome — the reference monitor wraps scheduleOne the
+            # same way.
+            for pod, _node in bound:
+                fwext.monitor.complete(pod)
+            for pod in unsched:
+                fwext.monitor.complete(pod)
+            from .plugins.coscheduling import gang_key_of
 
-        gated_groups = {gang_key_of(p) for p in gated} - {None}
-        fwext.registry.get("scheduled_pods_total").inc(len(bound))
-        fwext.registry.get("unschedulable_pods_total").inc(len(unsched))
-        fwext.registry.get("waiting_gang_group_number").set(float(len(gated_groups)))
-        return ScheduleOutcome(bound=bound, unschedulable=unsched, rounds_used=rounds)
+            gated_groups = {gang_key_of(p) for p in gated} - {None}
+            fwext.registry.get("scheduled_pods_total").inc(len(bound))
+            fwext.registry.get("unschedulable_pods_total").inc(len(unsched))
+            fwext.registry.get("waiting_gang_group_number").set(
+                float(len(gated_groups))
+            )
+        return ScheduleOutcome(
+            bound=bound,
+            unschedulable=unsched,
+            rounds_used=rounds,
+            preempted=preempted,
+        )
+
+    def node_allowed(self, pod: Pod, node_name: str) -> bool:
+        """Single-node form of the node-constraint mask (nodeSelector /
+        required nodeAffinity names / spec.nodeName)."""
+        spec = pod.spec
+        if not (
+            spec.node_selector or spec.affinity_required_nodes or spec.node_name
+        ):
+            return True
+        if spec.node_name and spec.node_name != node_name:
+            return False
+        if (
+            spec.affinity_required_nodes is not None
+            and node_name not in set(spec.affinity_required_nodes)
+        ):
+            return False
+        labels = self.snapshot.node_labels(node_name)
+        return all(
+            labels.get(k) == v for k, v in spec.node_selector.items()
+        )
+
+    def bound_node_of(self, pod_uid: str) -> Optional[str]:
+        """Node a previously-bound pod is charged to, or None once the pod
+        is no longer assumed (deleted/forgotten externally)."""
+        node = self._bound_nodes.get(pod_uid)
+        if node is None or pod_uid not in self.snapshot._assumed:
+            return None
+        return node
+
+    def evict_for_preemption(self, victim: Pod) -> None:
+        """Release a preemption victim's holds everywhere: snapshot charge,
+        quota chain, NUMA cpuset, device minors (the caller is responsible
+        for the actual eviction API call, like the reference's evictor)."""
+        from .plugins.elasticquota import quota_name_of
+
+        uid = victim.meta.uid
+        node = self._bound_nodes.pop(uid, None)
+        self.snapshot.forget_pod(uid)
+        leaf = quota_name_of(victim)
+        if leaf is not None:
+            self.quotas.unassign_pod(leaf, victim)
+        if node is not None:
+            if self.numa is not None:
+                self.numa.release(uid, node)
+            if self.devices is not None:
+                self.devices.release(uid, node)
 
     def _debug_capture(self, chunk: Sequence[Pod], assignment: np.ndarray) -> None:
         """Host-side recompute of the LoadAware cost for the debug score
@@ -467,6 +585,7 @@ class BatchScheduler:
                 approx_topk=True,
                 node_mask=node_mask,
                 dev_carry=dev_carry,
+                numa_scoring=self._numa_scoring(),
             )
             if nodes_t is cur:
                 # no node transformer ran: the solver outputs ARE the
@@ -484,6 +603,12 @@ class BatchScheduler:
                 dev_carry = (result.node_dev_full, result.node_dev_total)
             out.append((chunk, req_rows, est_rows, result))
         return out
+
+    def _numa_scoring(self):
+        """NUMA-aligned Score strategy for the solver (static jit arg)."""
+        if self.numa is not None and self.numa.has_topology:
+            return self.numa.scoring_strategy
+        return None
 
     def _constraint_states(self):
         """Lower the NUMA zone table and GPU slot table for the solver
@@ -503,7 +628,8 @@ class BatchScheduler:
             from ..ops.device import DeviceState
 
             device_state = DeviceState(
-                slot_free=jnp.asarray(self.devices.slot_array())
+                slot_free=jnp.asarray(self.devices.slot_array()),
+                rdma_free=jnp.asarray(self.devices.rdma_array()),
             )
         return numa_state, device_state
 
@@ -529,6 +655,7 @@ class BatchScheduler:
             # lax.top_k's full variadic sort per round
             approx_topk=True,
             node_mask=node_mask,
+            numa_scoring=self._numa_scoring(),
         )
 
     def _node_constraint_mask(self, chunk: Sequence[Pod], p_bucket: int):
@@ -711,11 +838,14 @@ class BatchScheduler:
                     self.numa.release(pod.meta.uid, node)
                 if self.devices is not None:
                     self.devices.release(pod.meta.uid, node)
-        # Durable quota accounting for what actually bound.
+        # Durable quota accounting + victim bookkeeping for what actually
+        # bound (assign_pod remembers the pod at its leaf so the overuse
+        # revoker and the batch preemptor can pick eviction victims).
         from .plugins.elasticquota import quota_name_of
 
-        for pod, _node in bound:
+        for pod, node in bound:
+            self._bound_nodes[pod.meta.uid] = node
             leaf = quota_name_of(pod)
             if leaf is not None:
-                self.quotas.charge(leaf, pod.spec.requests)
+                self.quotas.assign_pod(leaf, pod)
         return bound, unsched

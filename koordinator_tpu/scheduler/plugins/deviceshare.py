@@ -35,10 +35,16 @@ def parse_gpu_request(pod: Pod) -> Tuple[int, float]:
 class _NodeDevices:
     #: free percent per GPU minor
     gpu_free: List[float]
-    #: rdma device count free
-    rdma_free: int = 0
-    #: pod uid -> [(minor, percent)]
+    #: free percent per RDMA minor (100 = idle NIC)
+    rdma_free: List[float] = dataclasses.field(default_factory=list)
+    #: PCIe root per RDMA minor ("" unknown)
+    rdma_pcie: List[str] = dataclasses.field(default_factory=list)
+    #: pod uid -> [(minor, percent)] of GPU picks
     owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
+        default_factory=dict
+    )
+    #: pod uid -> [(minor, percent)] of RDMA picks
+    rdma_owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
         default_factory=dict
     )
     #: size -> partitions (GPUPartitionTable); empty = no table
@@ -102,7 +108,8 @@ class DeviceManager:
         old = self._nodes.get(device.meta.name)
         st = _NodeDevices(
             gpu_free=[FULL] * len(gpus),
-            rdma_free=len(rdma),
+            rdma_free=[FULL] * len(rdma),
+            rdma_pcie=[d.pcie_bus for d in rdma],
             partitions=dict(device.partitions),
             partition_policy=device.partition_policy,
             numa_of=[d.numa_node for d in gpus],
@@ -115,6 +122,12 @@ class DeviceManager:
                     st.gpu_free[minor] = max(st.gpu_free[minor] - pct, 0.0)
                 if kept:
                     st.owners[uid] = kept
+            for uid, picks in old.rdma_owners.items():
+                kept = [(m, pct) for m, pct in picks if m < len(st.rdma_free)]
+                for minor, pct in kept:
+                    st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
+                if kept:
+                    st.rdma_owners[uid] = kept
         self._nodes[device.meta.name] = st
 
     def node(self, name: str) -> Optional[_NodeDevices]:
@@ -144,12 +157,30 @@ class DeviceManager:
                 slots[idx, minor] = free
         return slots
 
+    def rdma_array(self) -> np.ndarray:
+        """Free RDMA NIC count per node, [N] aligned to snapshot rows."""
+        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        out = np.zeros((n_bucket,), np.float32)
+        for name, st in self._nodes.items():
+            idx = self.snapshot.node_id(name)
+            if idx is None:
+                continue
+            out[idx] = sum(1 for f in st.rdma_free if f >= FULL - 1e-6)
+        return out
+
     # ---- exact assignment (Reserve/PreBind) ----
 
     def allocate(self, pod: Pod, node_name: str) -> Optional[Mapping[str, str]]:
-        """Pick concrete minors for the winner; None = failed Reserve."""
+        """Pick concrete minors for the winner; None = failed Reserve.
+
+        GPU and RDMA are allocated jointly: with the joint-allocate
+        annotation (``device_allocator.go:205-252`` tryJointAllocate), the
+        GPU picks' PCIe roots steer the RDMA picks — preferred by default,
+        binding under the SamePCIe required scope (the RDMA PCIe set must
+        equal the GPU PCIe set, ``validateJointAllocation``)."""
         whole, share = parse_gpu_request(pod)
-        if whole == 0 and share <= 0:
+        rdma_count = ext.parse_rdma_request(pod.spec.requests)
+        if whole == 0 and share <= 0 and rdma_count == 0:
             return {}
         st = self._nodes.get(node_name)
         if st is None:
@@ -183,18 +214,83 @@ class DeviceManager:
                 minor = fresh[0]
             picks.append((minor, share))
             free[minor] -= share
+        rdma_picks: List[Tuple[int, float]] = []
+        if rdma_count > 0:
+            gpu_pcies = {
+                st.pcie_of[m] for m, _ in picks if m < len(st.pcie_of)
+            }
+            chosen_rdma = self._pick_rdma(
+                st,
+                rdma_count,
+                ext.parse_device_joint_allocate(pod.meta.annotations),
+                gpu_pcies,
+            )
+            if chosen_rdma is None:
+                return None
+            rdma_picks = [(m, FULL) for m in chosen_rdma]
+        # all picks succeeded — commit atomically
         st.gpu_free = free
-        st.owners[pod.meta.uid] = picks
-        payload = {
-            "gpu": [
+        if picks:
+            st.owners[pod.meta.uid] = picks
+        for minor, pct in rdma_picks:
+            st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
+        if rdma_picks:
+            st.rdma_owners[pod.meta.uid] = rdma_picks
+        payload: Dict[str, List] = {}
+        if picks:
+            payload["gpu"] = [
                 {
                     "minor": minor,
                     "resources": {ext.RES_GPU_MEMORY_RATIO: pct},
                 }
                 for minor, pct in picks
             ]
-        }
+        if rdma_picks:
+            payload["rdma"] = [
+                {"minor": minor, "resources": {ext.RES_RDMA: pct}}
+                for minor, pct in rdma_picks
+            ]
         return {ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(payload)}
+
+    def _pick_rdma(
+        self,
+        st: _NodeDevices,
+        count: int,
+        joint: "Optional[Tuple[Tuple[str, ...], str]]",
+        gpu_pcies: set,
+    ) -> Optional[List[int]]:
+        """Choose RDMA minors. Joint allocation with GPUs prefers NICs on
+        the GPUs' PCIe roots; the SamePCIe scope requires the chosen NICs'
+        PCIe set to exactly equal the GPUs' (one per root, count bumped to
+        the root count like the reference's desiredCount adjustment)."""
+        free_minors = [
+            i for i, f in enumerate(st.rdma_free) if f >= FULL - 1e-6
+        ]
+        if len(free_minors) < count:
+            return None
+        joint_with_gpu = (
+            joint is not None and "rdma" in joint[0] and bool(gpu_pcies)
+        )
+        if not joint_with_gpu:
+            return free_minors[:count]
+        scope = joint[1]
+        in_pcie = [m for m in free_minors if st.rdma_pcie[m] in gpu_pcies]
+        if scope == "SamePCIe":
+            per_pcie: Dict[str, List[int]] = {}
+            for m in in_pcie:
+                per_pcie.setdefault(st.rdma_pcie[m], []).append(m)
+            if set(per_pcie) != gpu_pcies:
+                return None  # some GPU PCIe root has no free NIC
+            need = max(count, len(gpu_pcies))
+            chosen = [per_pcie[p][0] for p in sorted(per_pcie)]
+            extras = [m for p in sorted(per_pcie) for m in per_pcie[p][1:]]
+            for m in extras:
+                if len(chosen) >= need:
+                    break
+                chosen.append(m)
+            return chosen if len(chosen) >= need else None
+        ordered = in_pcie + [m for m in free_minors if m not in set(in_pcie)]
+        return ordered[:count]
 
     # ---- whole-GPU selection: partition table + topology packing ----
     # Rebuild of the reference's partition allocator
@@ -295,9 +391,19 @@ class DeviceManager:
                 return out[:whole]
         return None
 
+    def reset_allocations(self) -> None:
+        """Free every slot and drop all owners (full-resync path)."""
+        for st in self._nodes.values():
+            st.gpu_free = [FULL] * len(st.gpu_free)
+            st.rdma_free = [FULL] * len(st.rdma_free)
+            st.owners.clear()
+            st.rdma_owners.clear()
+
     def release(self, pod_uid: str, node_name: str) -> None:
         st = self._nodes.get(node_name)
         if st is None:
             return
         for minor, pct in st.owners.pop(pod_uid, []):
             st.gpu_free[minor] = min(st.gpu_free[minor] + pct, FULL)
+        for minor, pct in st.rdma_owners.pop(pod_uid, []):
+            st.rdma_free[minor] = min(st.rdma_free[minor] + pct, FULL)

@@ -120,12 +120,21 @@ class GroupQuotaManager:
         cluster_total: Optional[Mapping[str, float]] = None,
         tree_id: str = "",
         scale_min_enabled: bool = False,
+        enable_preemption: bool = True,
+        disable_default_quota_preemption: bool = True,
     ):
         self.config = config or SnapshotConfig()
         self.tree_id = tree_id
         #: gate for min-quota scaling when Σ sibling mins > parent capacity
         #: (reference group_quota_manager.go:52 scaleMinQuotaEnabled)
         self.scale_min_enabled = scale_min_enabled
+        #: batch-failure PostFilter preemption (reference preempt.go); the
+        #: reference plugin always registers PostFilter — the config
+        #: decode can still switch it off per deployment
+        self.enable_preemption = enable_preemption
+        #: never victimize pods in the default quota (reference
+        #: ``DisableDefaultQuotaPreemption``, defaults true in v1beta3)
+        self.disable_default_quota_preemption = disable_default_quota_preemption
         self._nodes: Dict[str, _QuotaNode] = {}
         self._order: List[str] = []
         #: leaf quota name → {pod uid: Pod} of admitted pods (reference
@@ -271,6 +280,13 @@ class GroupQuotaManager:
         for idx in self.chain_of(quota_name):
             self.used[idx] -= vec
 
+    def reset_usage(self) -> None:
+        """Zero all used charges and assigned-pod records (full-resync
+        path: the world state is being replaced wholesale)."""
+        self.used[:] = 0.0
+        self._assigned.clear()
+        self._dirty = True
+
     def assign_pod(self, quota_name: str, pod: "Pod") -> None:
         """Charge the chain and remember the pod at its leaf quota so the
         overuse-revoke controller can pick eviction victims."""
@@ -409,6 +425,157 @@ class _OveruseMonitor:
             self.last_under_used = now
             return True
         return False
+
+
+class ElasticQuotaPreemptor:
+    """PostFilter analog of the reference's cross-pod preemption
+    (``pkg/scheduler/plugins/elasticquota/preempt.go``): when a batch
+    leaves a quota-labeled pod unschedulable, find the minimal set of
+    lower-priority pods of the *same quota* (``canPreempt``:283-304)
+    whose eviction both frees node capacity for the pod and clears its
+    quota headroom, using the reference's remove-all-then-reprieve flow
+    (``SelectVictimsOnNode``:111-221: strip every eligible victim, check
+    fit, then reprieve most-important-first while the pod still fits and
+    the quota check still passes).
+    """
+
+    def __init__(
+        self,
+        scheduler: "BatchScheduler",
+        manager: GroupQuotaManager,
+    ):
+        self.scheduler = scheduler
+        self.manager = manager
+
+    def _can_preempt(self, pod: Pod, victim: Pod) -> bool:
+        """canPreempt: preemptible victim, strictly lower priority, same
+        quota (with the default-quota opt-out)."""
+        if is_pod_non_preemptible(victim):
+            return False
+        leaf = quota_name_of(pod)
+        vleaf = quota_name_of(victim) or ext.DEFAULT_QUOTA_NAME
+        if (
+            self.manager.disable_default_quota_preemption
+            and vleaf == ext.DEFAULT_QUOTA_NAME
+        ):
+            return False
+        return (pod.spec.priority or 0) > (victim.spec.priority or 0) and (
+            leaf == vleaf
+        )
+
+    def _quota_chain_clears(
+        self, leaf: str, freed: np.ndarray, req: np.ndarray
+    ) -> bool:
+        """used − freed + req ≤ runtime along the WHOLE chain (victims
+        share the preemptor's leaf, so the refund applies at every
+        level — a tight parent quota must clear too)."""
+        mgr = self.manager
+        mgr.runtime_and_used_of(leaf)  # refresh runtime if dirty
+        for idx in mgr.chain_of(leaf):
+            if np.any(mgr.used[idx] - freed + req > mgr.runtime[idx] + 1e-3):
+                return False
+        return True
+
+    def _devices_clear(
+        self, pod: Pod, node: str, victims: List[Pod]
+    ) -> bool:
+        """Coarse device feasibility: the pod's GPU/RDMA demand must fit
+        in the node's free devices plus everything the victims hold.
+        (Fragmentation-exact allocation is re-checked at the retry's
+        Reserve; this gate stops evictions that cannot possibly help.)"""
+        dm = self.scheduler.devices
+        whole, share = ext.parse_gpu_request(pod.spec.requests)
+        rdma = ext.parse_rdma_request(pod.spec.requests)
+        if whole == 0 and share <= 0 and rdma == 0:
+            return True
+        if dm is None:
+            return False
+        st = dm.node(node)
+        if st is None:
+            return False
+        victim_uids = {v.meta.uid for v in victims}
+        free_full = sum(1 for f in st.gpu_free if f >= 100.0 - 1e-6)
+        victim_full = sum(
+            1
+            for uid in victim_uids
+            for _m, pct in st.owners.get(uid, [])
+            if pct >= 100.0 - 1e-6
+        )
+        if whole + (1 if share > 0 else 0) > free_full + victim_full:
+            return False
+        free_rdma = sum(1 for f in st.rdma_free if f >= 100.0 - 1e-6)
+        victim_rdma = sum(
+            len(st.rdma_owners.get(uid, [])) for uid in victim_uids
+        )
+        return rdma <= free_rdma + victim_rdma
+
+    def select_victims(
+        self, pod: Pod
+    ) -> Optional[Tuple[str, List[Pod]]]:
+        """(node_name, victims) for the cheapest feasible preemption, or
+        None. Nodes are tried in ascending victim count (minimal
+        disruption), mirroring the reference preemption evaluator's
+        fewest-victims candidate ranking. Candidate nodes must pass the
+        pod's own node constraints and a coarse device-feasibility gate —
+        evicting running workloads must never happen when the preemptor
+        cannot possibly land afterwards."""
+        leaf = quota_name_of(pod)
+        if leaf is None or self.manager.index_of(leaf) is None:
+            return None
+        snap = self.scheduler.snapshot
+        cfg = self.manager.config
+        req = cfg.res_vector(pod.spec.requests)
+
+        by_node: Dict[str, List[Pod]] = {}
+        for victim in self.manager.pods_assigned(leaf):
+            if not self._can_preempt(pod, victim):
+                continue
+            node = self.scheduler.bound_node_of(victim.meta.uid)
+            if node is None:
+                continue
+            by_node.setdefault(node, []).append(victim)
+
+        best: Optional[Tuple[str, List[Pod]]] = None
+        for node in sorted(by_node, key=lambda n: len(by_node[n])):
+            idx = snap.node_id(node)
+            if idx is None:
+                continue
+            if not self.scheduler.node_allowed(pod, node):
+                continue
+            victims = by_node[node]
+            if not self._devices_clear(pod, node, victims):
+                continue
+            vecs = [cfg.res_vector(v.spec.requests) for v in victims]
+            freed = np.sum(vecs, axis=0)
+            na = snap.nodes
+            # step 1: all eligible victims gone — does the pod fit, and
+            # does the quota chain clear?
+            if np.any(
+                na.requested[idx] - freed + req > na.allocatable[idx] + 1e-3
+            ):
+                continue
+            if not self._quota_chain_clears(leaf, freed, req):
+                continue
+            # step 2: reprieve most-important-first while both still hold
+            order = sorted(
+                range(len(victims)),
+                key=lambda i: (-(victims[i].spec.priority or 0), i),
+            )
+            final: List[Pod] = []
+            for i in order:
+                trial = freed - vecs[i]
+                fits = np.all(
+                    na.requested[idx] - trial + req
+                    <= na.allocatable[idx] + 1e-3
+                )
+                clears = self._quota_chain_clears(leaf, trial, req)
+                if fits and clears:
+                    freed = trial  # reprieved
+                else:
+                    final.append(victims[i])
+            if final and (best is None or len(final) < len(best[1])):
+                best = (node, final)
+        return best
 
 
 class QuotaOverUsedRevokeController:

@@ -76,9 +76,17 @@ class _NodeNUMA:
 class NUMAManager:
     """Per-node NUMA state; lowers zone arrays aligned to snapshot indices."""
 
-    def __init__(self, snapshot: ClusterSnapshot, max_zones: int = 4):
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        max_zones: int = 4,
+        scoring_strategy: Optional[str] = None,
+    ):
         self.snapshot = snapshot
         self.max_zones = max_zones
+        #: "LeastAllocated" | "MostAllocated" | None — NUMA-aligned Score
+        #: strategy (reference NodeNUMAResourceArgs.ScoringStrategy)
+        self.scoring_strategy = scoring_strategy
         self._nodes: Dict[str, _NodeNUMA] = {}
 
     def register_node(
@@ -195,6 +203,15 @@ class NUMAManager:
         else:
             return {}
         return {ext.ANNOTATION_RESOURCE_STATUS: payload}
+
+    def reset_allocations(self) -> None:
+        """Free every zone and cpuset hold (full-resync path)."""
+        from ...core.topology import CPUAccumulator
+
+        for st in self._nodes.values():
+            st.zone_used = [[0.0] * ZONE_DIMS for _ in st.zone_alloc]
+            st.owners.clear()
+            st.accumulator = CPUAccumulator(st.topology)
 
     def release(self, pod_uid: str, node_name: str) -> None:
         st = self._nodes.get(node_name)

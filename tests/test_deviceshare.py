@@ -538,3 +538,102 @@ def test_pipelined_multichunk_schedule_consistency():
         assert sum(st.gpu_free) == 0.0
     # a 17th pod finds nothing
     assert sched.schedule([gpu_pod("extra", whole=1)]).bound == []
+
+
+# ---- RDMA + joint GPU/RDMA allocation (device_allocator.go:205-252) ----
+
+
+def rdma_cluster():
+    """One node: 4 GPUs + 4 NICs split over two PCIe roots."""
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+            ),
+        )
+    )
+    devs = [
+        DeviceInfo(dev_type="gpu", minor=g, numa_node=g // 2, pcie_bus=f"p{g//2}")
+        for g in range(4)
+    ] + [
+        DeviceInfo(dev_type="rdma", minor=r, pcie_bus=f"p{r//2}")
+        for r in range(4)
+    ]
+    dm.upsert_device(Device(meta=ObjectMeta(name="n0"), devices=devs))
+    return snap, dm
+
+
+def joint_pod(name, gpus=2, rdma=200, scope="SamePCIe"):
+    pod = gpu_pod(name, whole=gpus)
+    if rdma:
+        pod.spec.requests[ext.RES_RDMA] = rdma
+    pod.meta.annotations[ext.ANNOTATION_DEVICE_JOINT_ALLOCATE] = json.dumps(
+        {"deviceTypes": ["gpu", "rdma"], "requiredScope": scope}
+    )
+    return pod
+
+
+def test_joint_allocate_same_pcie():
+    """SamePCIe scope: the NICs' PCIe set must equal the GPUs' — both land
+    on one root (topology packing keeps the 2 GPUs together)."""
+    snap, dm = rdma_cluster()
+    patch = dm.allocate(joint_pod("j1"), "n0")
+    assert patch is not None
+    alloc = json.loads(patch[ext.ANNOTATION_DEVICE_ALLOCATED])
+    gpu_minors = [a["minor"] for a in alloc["gpu"]]
+    rdma_minors = [a["minor"] for a in alloc["rdma"]]
+    st = dm.node("n0")
+    gpu_pcies = {st.pcie_of[m] for m in gpu_minors}
+    rdma_pcies = {st.rdma_pcie[m] for m in rdma_minors}
+    assert len(gpu_pcies) == 1 and rdma_pcies == gpu_pcies
+    assert len(rdma_minors) == 2
+
+
+def test_joint_allocate_same_pcie_infeasible():
+    """If the GPUs' PCIe root has no free NIC, SamePCIe fails the Reserve
+    (validateJointAllocation rules violation)."""
+    snap, dm = rdma_cluster()
+    st = dm.node("n0")
+    st.rdma_free = [0.0, 0.0, 100.0, 100.0]   # p0 NICs busy
+    st.gpu_free = [100.0, 100.0, 0.0, 0.0]    # only p0 GPUs free
+    assert dm.allocate(joint_pod("j2"), "n0") is None
+    # preferred (non-binding) scope succeeds with cross-root NICs
+    assert dm.allocate(joint_pod("j3", scope=""), "n0") is not None
+
+
+def test_joint_allocate_covers_every_gpu_pcie():
+    """GPUs spanning two roots with SamePCIe need a NIC per root even when
+    the pod asked for just one (desiredCount bumped to the root count)."""
+    snap, dm = rdma_cluster()
+    st = dm.node("n0")
+    st.gpu_free = [100.0, 0.0, 100.0, 0.0]    # one free GPU per root
+    patch = dm.allocate(joint_pod("j4", gpus=2, rdma=100), "n0")
+    alloc = json.loads(patch[ext.ANNOTATION_DEVICE_ALLOCATED])
+    rdma_pcies = {st.rdma_pcie[a["minor"]] for a in alloc["rdma"]}
+    assert rdma_pcies == {"p0", "p1"}
+    assert len(alloc["rdma"]) == 2
+
+
+def test_rdma_capacity_e2e():
+    """Solver-level RDMA feasibility: three 2-NIC pods over a 4-NIC node
+    place exactly two; release restores capacity."""
+    snap, dm = rdma_cluster()
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    pods = []
+    for i in range(3):
+        p = gpu_pod(f"r{i}")
+        p.spec.requests[ext.RES_RDMA] = 200
+        pods.append(p)
+    out = sched.schedule(pods)
+    assert len(out.bound) == 2
+    assert len(out.unschedulable) == 1
+    st = dm.node("n0")
+    assert sum(st.rdma_free) == 0.0
+    # release one and the third pod fits on retry
+    dm.release(out.bound[0][0].meta.uid, "n0")
+    out2 = sched.schedule(out.unschedulable)
+    assert len(out2.bound) == 1

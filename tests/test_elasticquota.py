@@ -583,3 +583,84 @@ def test_scheduler_runtime_expands_beyond_min_with_cluster_capacity():
     out = sched.schedule(pods)
     # min admits only 4 pods; cluster-capacity fair sharing admits all 32
     assert len(out.bound) == 32, (len(out.bound), len(out.unschedulable))
+
+
+# ---- batch-failure preemption (reference elasticquota/preempt.go) ----
+
+
+def preempt_cluster(max_a=(12, 400)):
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 400.0, ext.RES_MEMORY: 400.0}
+            ),
+        )
+    )
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 400, ext.RES_MEMORY: 400}
+    )
+    mgr.upsert_quota(quota("team-a", minv=(8, 8), maxv=max_a, weight=(1, 1)))
+    mgr.upsert_quota(quota("team-b", minv=(8, 8), maxv=(400, 400), weight=(1, 1)))
+    sched = BatchScheduler(snap, quotas=mgr)
+    sched.extender.monitor.stop_background()
+    return snap, mgr, sched
+
+
+def test_preemption_admits_high_priority_over_quota():
+    """Quota team-a full of low-priority pods: a high-priority pod evicts
+    the least-important same-quota victim and binds in the same cycle."""
+    snap, mgr, sched = preempt_cluster()
+    low = [quota_pod(f"low{i}", "team-a", cpu=6.0, prio=5000) for i in range(2)]
+    out0 = sched.schedule(low)
+    assert len(out0.bound) == 2           # 12 cpu used = team-a max
+
+    high = quota_pod("high", "team-a", cpu=6.0, prio=9500)
+    out = sched.schedule([high])
+    assert [p.meta.name for p, _ in out.bound] == ["high"]
+    assert [p.meta.name for p in out.preempted] == ["low1"]  # stable order: later pod less important
+    # accounting: quota used unchanged at max (one out, one in)
+    assert mgr.used[mgr.index_of("team-a")][0] == 12.0
+    # snapshot charge for the victim is gone
+    assert sched.bound_node_of("default/low1") is None
+
+
+def test_preemption_never_crosses_quota_boundaries():
+    """canPreempt requires the same quota: team-b victims are untouchable
+    for a team-a preemptor even when nothing else can free headroom."""
+    snap, mgr, sched = preempt_cluster()
+    victim = quota_pod("b-low", "team-b", cpu=6.0, prio=5000)
+    filler = [quota_pod(f"a{i}", "team-a", cpu=6.0, prio=5000) for i in range(2)]
+    sched.schedule([victim] + filler)
+    high = quota_pod("a-high", "team-a", cpu=200.0, prio=9500)  # over max
+    out = sched.schedule([high])
+    assert out.bound == []
+    assert out.preempted == []            # b-low never considered
+
+
+def test_preemption_respects_non_preemptible_label():
+    snap, mgr, sched = preempt_cluster()
+    low = [quota_pod(f"low{i}", "team-a", cpu=6.0, prio=5000) for i in range(2)]
+    for p in low:
+        p.meta.labels[ext.LABEL_PREEMPTIBLE] = "false"
+    sched.schedule(low)
+    high = quota_pod("high", "team-a", cpu=6.0, prio=9500)
+    out = sched.schedule([high])
+    assert out.bound == [] and out.preempted == []
+
+
+def test_preemption_minimal_victim_set():
+    """Remove-all-then-reprieve: only as many victims as the preemptor
+    needs; more-important victims are reprieved first."""
+    snap, mgr, sched = preempt_cluster(max_a=(18, 400))
+    low = [
+        quota_pod(f"low{i}", "team-a", cpu=6.0, prio=5000 + i * 100)
+        for i in range(3)
+    ]
+    sched.schedule(low)                    # 18 cpu used = max
+    high = quota_pod("high", "team-a", cpu=6.0, prio=9500)
+    out = sched.schedule([high])
+    assert [p.meta.name for p, _ in out.bound] == ["high"]
+    # exactly one victim — the lowest-priority pod (low0 @ 5000)
+    assert [p.meta.name for p in out.preempted] == ["low0"]

@@ -1,0 +1,26 @@
+"""Long-lived cross-component loop (VERDICT r1 item 10): koordlet tick →
+NodeMetric report → noderesource batch capacity → scheduler batch →
+runtimehook plan, composed in ONE process for N simulated minutes, with
+per-tick consistency invariants (accounting drift, batch-capacity bounds)
+asserted inside the driver (examples/longrun_loop.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+from longrun_loop import run_loop
+
+
+def test_longrun_feedback_loop_stays_consistent():
+    stats = run_loop(minutes=10.0, n_nodes=6, seed=3)
+    assert stats["ticks"] == 40
+    assert stats["reports"] == 10 * 6
+    # the loop actually moved pods through their lifecycle
+    assert stats["bound"] > 30
+    assert stats["completed"] > 20
+    assert stats["live_at_end"] < stats["bound"]
+    # batch capacity breathed with the prod sinusoid
+    assert stats["max_batch_cap"] - stats["min_batch_cap"] > 10_000
+    # suppression engaged during the load peaks
+    assert stats["suppressions"] > 0

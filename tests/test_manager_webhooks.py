@@ -377,3 +377,122 @@ def test_node_amplification_mutation_idempotent():
     idx = snap.upsert_node(node)
     cpu_i = list(snap.config.resources).index(ext.RES_CPU)
     assert snap.nodes.allocatable[idx][cpu_i] == 96000
+
+
+# ---- device-resource + annotation-shape validation
+# (verify_device_resource.go:68-176, verify_annotations.go:60-76) ----
+
+
+def _vpod(requests=None, annotations=None, labels=None, prio=9000):
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+
+    return Pod(
+        meta=ObjectMeta(
+            name="v", labels=labels or {}, annotations=annotations or {}
+        ),
+        spec=PodSpec(requests=requests or {}, priority=prio),
+    )
+
+
+def test_validate_gpu_and_share_mutually_exclusive():
+    from koordinator_tpu.manager.validating import validate_pod
+
+    errs = validate_pod(
+        _vpod(requests={ext.RES_KOORD_GPU: 100, ext.RES_GPU_SHARED: 1})
+    )
+    assert errs == ["cannot declare GPU and GPU share at the same time"]
+
+
+def test_validate_percentage_gpu_rules():
+    from koordinator_tpu.manager.validating import validate_pod
+
+    assert validate_pod(_vpod(requests={ext.RES_KOORD_GPU: 0})) != []
+    assert any(
+        "percentage of 100" in e
+        for e in validate_pod(_vpod(requests={ext.RES_KOORD_GPU: 150}))
+    )
+    assert validate_pod(_vpod(requests={ext.RES_KOORD_GPU: 50})) == []
+    assert validate_pod(_vpod(requests={ext.RES_KOORD_GPU: 200})) == []
+
+
+def test_validate_gpu_share_rules():
+    from koordinator_tpu.manager.validating import validate_pod
+
+    # neither memory nor ratio declared
+    assert any(
+        "both zero" in e
+        for e in validate_pod(_vpod(requests={ext.RES_GPU_SHARED: 1}))
+    )
+    # both declared
+    assert any(
+        "at the same time" in e
+        for e in validate_pod(
+            _vpod(
+                requests={
+                    ext.RES_GPU_SHARED: 1,
+                    ext.RES_GPU_MEMORY: 1024,
+                    ext.RES_GPU_MEMORY_RATIO: 50,
+                }
+            )
+        )
+    )
+    # ratio not a multiple of the share count
+    assert any(
+        "multiple of shared" in e
+        for e in validate_pod(
+            _vpod(requests={ext.RES_GPU_SHARED: 2, ext.RES_GPU_MEMORY_RATIO: 101})
+        )
+    )
+    # valid shared declaration
+    assert (
+        validate_pod(
+            _vpod(requests={ext.RES_GPU_SHARED: 2, ext.RES_GPU_MEMORY_RATIO: 200})
+        )
+        == []
+    )
+
+
+def test_validate_forbidden_reserve_pod_annotation():
+    from koordinator_tpu.manager.validating import validate_pod
+
+    errs = validate_pod(
+        _vpod(annotations={f"scheduling.{ext.DOMAIN}/reserve-pod": "true"})
+    )
+    assert any("cannot be set" in e for e in errs)
+
+
+def test_validate_annotation_shapes():
+    from koordinator_tpu.manager.validating import validate_pod
+
+    cases = [
+        ({ext.ANNOTATION_RESOURCE_SPEC: "not json"}, "not valid JSON"),
+        ({ext.ANNOTATION_RESOURCE_SPEC: '{"preferredCPUBindPolicy": "Weird"}'},
+         "unknown preferredCPUBindPolicy"),
+        ({ext.ANNOTATION_RESOURCE_STATUS: "[1]"}, "must be an object"),
+        ({ext.ANNOTATION_RESOURCE_STATUS: '{"cpuset": 3}'}, "must be a string"),
+        ({ext.ANNOTATION_RESOURCE_STATUS: '{"numaNodeResources": [{}]}'},
+         "numaNodeResources"),
+        ({ext.ANNOTATION_DEVICE_ALLOCATED: '{"gpu": [{"resources": {}}]}'},
+         "device-allocated[gpu]"),
+        ({ext.ANNOTATION_RESERVATION_AFFINITY: "[1]"}, "must be an object"),
+        ({ext.ANNOTATION_GPU_PARTITION_SPEC:
+          '{"ringBusBandwidth": "fast"}'}, "must be numeric"),
+        ({ext.ANNOTATION_GPU_PARTITION_SPEC:
+          '{"allocatePolicy": "Always"}'}, "allocatePolicy"),
+        ({ext.ANNOTATION_DEVICE_JOINT_ALLOCATE: '{"deviceTypes": "gpu"}'},
+         "deviceTypes"),
+    ]
+    for ann, want in cases:
+        errs = validate_pod(_vpod(annotations=ann))
+        assert any(want in e for e in errs), (ann, errs)
+    # well-formed payloads pass
+    ok = _vpod(
+        annotations={
+            ext.ANNOTATION_RESOURCE_SPEC: '{"preferredCPUBindPolicy": "FullPCPUs"}',
+            ext.ANNOTATION_GPU_PARTITION_SPEC:
+                '{"allocatePolicy": "Restricted", "ringBusBandwidth": 200}',
+            ext.ANNOTATION_DEVICE_JOINT_ALLOCATE:
+                '{"deviceTypes": ["gpu", "rdma"], "requiredScope": "SamePCIe"}',
+        }
+    )
+    assert validate_pod(ok) == []

@@ -330,3 +330,95 @@ def test_accumulator_adversarial_take_release_invariants():
                 assert not (all_owned & cpus), "double allocation"
                 all_owned |= cpus
             assert all_owned == acc._allocated
+
+
+# ---- NUMA-aligned Least/MostAllocated scoring (scoring.go:66-120) ----
+
+
+def test_numa_aligned_cost_reference_values():
+    """leastRequestedScore / mostRequestedScore integer semantics over the
+    zone the host allocator would pick."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from koordinator_tpu.ops.costs import numa_aligned_cost
+
+    zone_cap = np.zeros((1, 2, 2), np.float32)
+    zone_cap[0, :, 0] = 16000.0
+    zone_cap[0, :, 1] = 1000.0
+    zone_free = zone_cap.copy()
+    zone_free[0, 0, 0] = 8000.0          # zone0 cpu half used
+    req = np.asarray([[4000.0, 0.0]], np.float32)
+    wants = np.asarray([True])
+    w = np.asarray([1.0, 0.0], np.float32)
+
+    def score(zfree, most):
+        c = numa_aligned_cost(
+            jnp.asarray(req), jnp.asarray(wants), jnp.asarray(zfree),
+            jnp.asarray(zone_cap), jnp.asarray(w), most_allocated=most,
+        )
+        return float(-np.asarray(c)[0, 0])
+
+    # empty zone1 is least utilized -> picked: least (16000-4000)*100/16000=75
+    assert score(zone_free, most=False) == 75.0
+    assert score(zone_free, most=True) == 25.0
+    # make zone1 unfit -> forced onto half-used zone0: (16000-12000)*100/16000
+    zf2 = zone_free.copy()
+    zf2[0, 1, 0] = 2000.0
+    assert score(zf2, most=False) == 25.0
+    assert score(zf2, most=True) == 75.0
+    # a pod without NUMA interest contributes zero
+    c = numa_aligned_cost(
+        jnp.asarray(req), jnp.asarray([False]), jnp.asarray(zone_free),
+        jnp.asarray(zone_cap), jnp.asarray(w),
+    )
+    assert float(np.asarray(c)[0, 0]) == 0.0
+
+
+def _scoring_cluster(strategy):
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+    from koordinator_tpu.scheduler.plugins.nodenumaresource import NUMAManager
+
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap, scoring_strategy=strategy)
+    topo = CPUTopology.uniform(sockets=1, numa_per_socket=1, cores_per_numa=16)
+    for name in ("n0", "n1"):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 262144}
+                ),
+            )
+        )
+        numa.register_node(name, topo, memory_per_zone_mib=131072.0)
+    sched = BatchScheduler(snap, numa=numa, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+
+    def lsr(name, cpu, node=None):
+        return Pod(
+            meta=ObjectMeta(name=name, labels={ext.LABEL_POD_QOS: "LSR"}),
+            spec=PodSpec(
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 8192},
+                priority=9500,
+                node_name=node,
+            ),
+        )
+
+    # pre-fill n0's single zone half-way
+    out = sched.schedule([lsr("filler", 16000, node="n0")])
+    assert [(p.meta.name, n) for p, n in out.bound] == [("filler", "n0")]
+    out2 = sched.schedule([lsr("probe", 4000)])
+    assert len(out2.bound) == 1
+    return out2.bound[0][1]
+
+
+def test_most_allocated_scoring_packs_fuller_zone():
+    assert _scoring_cluster("MostAllocated") == "n0"
+
+
+def test_least_allocated_scoring_spreads():
+    assert _scoring_cluster("LeastAllocated") == "n1"

@@ -38,7 +38,9 @@ def cpu_mem_vec(cfg, cpu, mem):
 def test_sync_applies_nodes_and_metrics(channel):
     service, client = channel
     cfg = service.snapshot.config
-    delta = pb.SnapshotDelta(revision=7, now=1000.0)
+    # first contact at a mid-stream revision must be a full re-list (a
+    # fresh solver cannot adopt one incremental delta as its world)
+    delta = pb.SnapshotDelta(revision=7, now=1000.0, full=True)
     for i in range(4):
         delta.node_upserts.add(
             name=f"n{i}", allocatable=cpu_mem_vec(cfg, 32000, 128 * 1024)
@@ -404,3 +406,128 @@ def test_concurrent_sync_and_nominate_consistency():
     finally:
         client.close()
         server.stop(grace=None)
+
+
+# ---- resync protocol: generation gaps force a full re-list ----
+
+
+def _world_deltas(cfg, n_nodes=4):
+    """An ordered sequence of deltas building a world state, plus a
+    function rendering the CURRENT full state (what the control plane's
+    cache would re-list)."""
+    deltas = []
+    d1 = pb.SnapshotDelta(revision=1, now=1000.0)
+    for i in range(n_nodes):
+        d1.node_upserts.add(
+            name=f"n{i}", allocatable=cpu_mem_vec(cfg, 32000, 128 * 1024)
+        )
+    deltas.append(d1)
+    d2 = pb.SnapshotDelta(revision=2, now=1001.0)
+    d2.node_removes.append("n0")
+    d2.pod_assumed.add(
+        uid="p-a", node="n1", requests=cpu_mem_vec(cfg, 4000, 4096)
+    )
+    deltas.append(d2)
+    d3 = pb.SnapshotDelta(revision=3, now=1002.0)
+    d3.metric_updates.add(
+        name="n1", usage=cpu_mem_vec(cfg, 8000, 9000), update_time=1002.0
+    )
+    deltas.append(d3)
+
+    def full_state():
+        full = pb.SnapshotDelta(now=1002.0)
+        for i in range(1, n_nodes):
+            full.node_upserts.add(
+                name=f"n{i}", allocatable=cpu_mem_vec(cfg, 32000, 128 * 1024)
+            )
+        full.pod_assumed.add(
+            uid="p-a", node="n1", requests=cpu_mem_vec(cfg, 4000, 4096)
+        )
+        full.metric_updates.add(
+            name="n1", usage=cpu_mem_vec(cfg, 8000, 9000), update_time=1002.0
+        )
+        return full
+
+    return deltas, full_state
+
+
+def test_dropped_delta_triggers_resync_and_converges(channel):
+    """Drop delta 2 entirely: delta 3 must be REJECTED (not applied), and
+    the full re-list converges the solver to the true world state."""
+    service, client = channel
+    cfg = service.snapshot.config
+    deltas, full_state = _world_deltas(cfg)
+    client.sync(deltas[0])
+    # delta 2 lost in transit; delta 3 arrives
+    ack = client.sync(deltas[2])
+    assert ack.resync_required and ack.expected_revision == 2
+    # the rejected delta changed nothing: n0 still present, no metric
+    assert service.snapshot.node_count == 4
+    # control plane answers with a full re-list
+    ack2 = client.sync_with_resync(deltas[2], full_state)
+    assert not ack2.resync_required
+    assert ack2.applied_revision == 3
+    snap = service.snapshot
+    assert snap.node_count == 3 and snap.node_id("n0") is None
+    idx = snap.node_id("n1")
+    assert snap.nodes.requested[idx][0] == 4000.0
+    assert snap.nodes.usage_avg[idx][0] == 8000.0
+
+
+def test_reordered_delta_rejected(channel):
+    """Deltas arriving out of order must not be applied out of order."""
+    service, client = channel
+    cfg = service.snapshot.config
+    deltas, full_state = _world_deltas(cfg)
+    client.sync(deltas[0])
+    ack3 = client.sync(deltas[2])          # rev 3 before rev 2
+    assert ack3.resync_required
+    ack2 = client.sync(deltas[1])          # rev 2 arrives late: in order
+    assert not ack2.resync_required and ack2.applied_revision == 2
+    # rev 3 can now apply normally
+    ack3b = client.sync(deltas[2])
+    assert not ack3b.resync_required and ack3b.applied_revision == 3
+    snap = service.snapshot
+    assert snap.node_count == 3
+    assert snap.nodes.usage_avg[snap.node_id("n1")][0] == 8000.0
+
+
+def test_fresh_solver_rejects_midstream_delta(channel):
+    """A restarted solver (revision 0) receiving an incremental delta at a
+    mid-stream revision must demand a resync — silently adopting it as the
+    whole world is the divergence this protocol exists to prevent."""
+    service, client = channel
+    cfg = service.snapshot.config
+    mid = pb.SnapshotDelta(revision=1001, now=1000.0)
+    mid.metric_updates.add(
+        name="n1", usage=cpu_mem_vec(cfg, 1000, 1000), update_time=1000.0
+    )
+    ack = client.sync(mid)
+    assert ack.resync_required
+    assert service.snapshot.node_count == 0  # nothing was applied
+    # a stream head (revision 1) is fine for a fresh solver
+    head = pb.SnapshotDelta(revision=1, now=1000.0)
+    head.node_upserts.add(name="n1", allocatable=cpu_mem_vec(cfg, 32000, 1024))
+    assert not client.sync(head).resync_required
+
+
+def test_full_resync_replaces_divergent_state(channel):
+    """A full delta replaces whatever the solver believed — stale nodes
+    and assumed pods vanish."""
+    service, client = channel
+    cfg = service.snapshot.config
+    deltas, full_state = _world_deltas(cfg)
+    for d in deltas[:2]:
+        client.sync(d)
+    # solver believes p-a is assumed on n1; control plane re-lists a world
+    # where only n9 exists
+    full = pb.SnapshotDelta(revision=9, full=True, now=2000.0)
+    full.node_upserts.add(
+        name="n9", allocatable=cpu_mem_vec(cfg, 64000, 256 * 1024)
+    )
+    ack = client.sync(full)
+    assert not ack.resync_required
+    assert ack.applied_revision == 9 and ack.node_count == 1
+    snap = service.snapshot
+    assert snap.node_id("n1") is None and snap.node_id("n9") is not None
+    assert not snap._assumed
