@@ -39,6 +39,9 @@ class ObjectMeta:
     uid: str = ""
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: controlling workload's UID (k8s ownerReferences controller=true);
+    #: "" = no controller (bare pod)
+    owner_uid: str = ""
 
     def __post_init__(self) -> None:
         if not self.uid:

@@ -30,12 +30,29 @@ from ..scheduler.plugins.reservation import ReservationManager
 EvictFn = Callable[[Pod, str], bool]  # (victim, reason) -> evicted?
 
 
+def resolve_int_or_percent(value, replicas: int) -> int:
+    """k8s intstr semantics (reference ``util.GetMaxMigrating`` /
+    ``GetMaxUnavailable``): an int is absolute; "20%" scales against the
+    workload's expected replicas (rounded up)."""
+    import math
+
+    if isinstance(value, str) and value.endswith("%"):
+        return int(math.ceil(replicas * float(value[:-1]) / 100.0))
+    return int(value)
+
+
 @dataclasses.dataclass
 class ArbitratorArgs:
-    """Reference ``arbitrator/filter.go`` limits."""
+    """Reference ``arbitrator/filter.go`` limits
+    (``MigrationControllerArgs``)."""
 
     max_migrating_global: int = 10
     max_migrating_per_namespace: int = 2
+    #: per-workload in-flight migration cap, int or "N%" of replicas
+    #: (``filterMaxMigratingOrUnavailablePerWorkload``); None = unlimited
+    max_migrating_per_workload: Optional[object] = None
+    #: per-workload unavailable cap (migrating + already-unavailable pods)
+    max_unavailable_per_workload: Optional[object] = None
 
 
 class Arbitrator:
@@ -50,7 +67,16 @@ class Arbitrator:
         pods_by_uid: Dict[str, Pod],
         in_flight: int,
         running_per_ns: Optional[Dict[str, int]] = None,
+        running_per_workload: Optional[Dict[str, int]] = None,
+        replicas_by_owner: Optional[Dict[str, int]] = None,
+        unavailable_by_owner: Optional[Dict[str, int]] = None,
     ) -> List[PodMigrationJob]:
+        """``replicas_by_owner`` / ``unavailable_by_owner`` play the
+        reference's controllerFinder role: expected replica count and
+        currently-unavailable pod count per workload (owner uid). A pod
+        without a controller (owner_uid "") skips workload limits, like
+        the reference's nil-ownerRef early return."""
+
         def sort_key(job: PodMigrationJob):
             pod = pods_by_uid.get(job.pod_uid)
             if pod is None:
@@ -62,8 +88,11 @@ class Arbitrator:
             )
 
         budget = max(self.args.max_migrating_global - in_flight, 0)
-        # namespace caps count already-running migrations too
+        # namespace/workload caps count already-running migrations too
         per_ns: Dict[str, int] = dict(running_per_ns or {})
+        per_wl: Dict[str, int] = dict(running_per_workload or {})
+        replicas = replicas_by_owner or {}
+        unavailable = unavailable_by_owner or {}
         selected: List[PodMigrationJob] = []
         for job in sorted(jobs, key=sort_key):
             if len(selected) >= budget:
@@ -72,9 +101,48 @@ class Arbitrator:
             ns = pod.meta.namespace if pod else ""
             if per_ns.get(ns, 0) >= self.args.max_migrating_per_namespace:
                 continue
+            owner = pod.meta.owner_uid if pod else ""
+            if owner and not self._workload_allows(
+                owner, per_wl, replicas, unavailable
+            ):
+                continue
             per_ns[ns] = per_ns.get(ns, 0) + 1
+            if owner:
+                per_wl[owner] = per_wl.get(owner, 0) + 1
             selected.append(job)
         return selected
+
+    def _workload_allows(
+        self,
+        owner: str,
+        per_wl: Dict[str, int],
+        replicas: Dict[str, int],
+        unavailable: Dict[str, int],
+    ) -> bool:
+        """filterMaxMigratingOrUnavailablePerWorkload: migrating-per-
+        workload below the cap AND migrating+unavailable below the
+        unavailable cap. Without replica info for the owner (no
+        controller-finder wired) the limits are not evaluable — allow,
+        like the reference's nil-ownerRef early return; a percent cap
+        against unknown replicas would otherwise resolve to 0 and block
+        every owned pod forever."""
+        if owner not in replicas:
+            return True
+        n_replicas = replicas[owner]
+        migrating = per_wl.get(owner, 0)
+        if self.args.max_migrating_per_workload is not None:
+            cap = resolve_int_or_percent(
+                self.args.max_migrating_per_workload, n_replicas
+            )
+            if migrating >= cap:
+                return False
+        if self.args.max_unavailable_per_workload is not None:
+            cap = resolve_int_or_percent(
+                self.args.max_unavailable_per_workload, n_replicas
+            )
+            if migrating + unavailable.get(owner, 0) >= cap:
+                return False
+        return True
 
 
 class MigrationController:
@@ -86,11 +154,15 @@ class MigrationController:
         evict_fn: EvictFn,
         arbitrator: Optional[Arbitrator] = None,
         job_timeout_s: float = 300.0,
+        workload_info_fn: Optional[Callable[[str], tuple]] = None,
     ):
         self.reservations = reservations
         self.evict_fn = evict_fn
         self.arbitrator = arbitrator or Arbitrator()
         self.job_timeout_s = job_timeout_s
+        #: controllerFinder analog: owner uid -> (expected_replicas,
+        #: unavailable_pod_count) for the per-workload migration limits
+        self.workload_info_fn = workload_info_fn
         self.jobs: Dict[str, PodMigrationJob] = {}
         self._victims: Dict[str, Pod] = {}
 
@@ -127,17 +199,40 @@ class MigrationController:
 
         now = now if now is not None else _t.time()
         running_per_ns: Dict[str, int] = {}
+        running_per_wl: Dict[str, int] = {}
         for j in self.jobs.values():
             if j.phase == MigrationPhase.RUNNING:
                 pod = self._victims.get(j.pod_uid)
                 ns = pod.meta.namespace if pod else ""
                 running_per_ns[ns] = running_per_ns.get(ns, 0) + 1
+                if pod is not None and pod.meta.owner_uid:
+                    wl = pod.meta.owner_uid
+                    running_per_wl[wl] = running_per_wl.get(wl, 0) + 1
 
         pending = [
             j for j in self.jobs.values() if j.phase == MigrationPhase.PENDING
         ]
+        replicas_by_owner: Dict[str, int] = {}
+        unavailable_by_owner: Dict[str, int] = {}
+        if self.workload_info_fn is not None:
+            owners = {
+                self._victims[j.pod_uid].meta.owner_uid
+                for j in pending
+                if j.pod_uid in self._victims
+                and self._victims[j.pod_uid].meta.owner_uid
+            }
+            for owner in owners:
+                replicas, unavail = self.workload_info_fn(owner)
+                replicas_by_owner[owner] = replicas
+                unavailable_by_owner[owner] = unavail
         for job in self.arbitrator.arbitrate(
-            pending, self._victims, self.in_flight, running_per_ns
+            pending,
+            self._victims,
+            self.in_flight,
+            running_per_ns,
+            running_per_workload=running_per_wl,
+            replicas_by_owner=replicas_by_owner,
+            unavailable_by_owner=unavailable_by_owner,
         ):
             victim = self._victims[job.pod_uid]
             # A victim with no labels yields an owner selector matching

@@ -44,6 +44,8 @@ class KoordletConfig:
     report_interval_s: float = 60.0          # states_nodemetric.go:61-66
     aggregate_window_s: float = 300.0
     cgroup_root: str = "/sys/fs/cgroup"
+    proc_root: str = "/proc"
+    sys_root: str = "/sys"
     n_cpus: Optional[int] = None
     node_allocatable_milli: float = 0.0      # 0 = n_cpus × 1000
     node_memory_capacity_mib: float = 0.0
@@ -168,7 +170,18 @@ class Koordlet:
             node_allocatable_milli=alloc_milli,
             node_memory_capacity_mib=mem_cap,
         )
-        self.reconciler = hooks.Reconciler(self.executor)
+        # kernel feature probes gate hook plans on host support
+        # (system.InitSupportConfigs analog, koordlet.go:84)
+        from .system import KernelProbes, SystemConfig
+
+        self.probes = KernelProbes(
+            SystemConfig(
+                proc_root=self.config.proc_root,
+                sys_root=self.config.sys_root,
+                cgroup_root=self.config.cgroup_root,
+            )
+        )
+        self.reconciler = hooks.Reconciler(self.executor, probes=self.probes)
         self.node_slo: NodeSLO = NodeSLO(meta=ObjectMeta(name=self.config.node_name))
         self.pods: List[Pod] = []
         self._last_report = 0.0

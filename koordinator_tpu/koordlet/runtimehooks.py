@@ -283,19 +283,35 @@ def pod_mutation(pod: Pod) -> ContainerMutation:
 class Reconciler:
     """Periodic cgroup reconciler (``reconciler/reconciler.go``): renders
     and applies every running pod's plan; statesinformer callbacks call
-    ``reconcile`` on pod updates."""
+    ``reconcile`` on pod updates.
 
-    def __init__(self, executor: rex.ResourceExecutor):
+    ``probes`` (koordlet.system.KernelProbes) gates plan entries on
+    kernel support — the reference enables core-sched/bvt/resctrl hooks
+    only after the util/system feature probe passes
+    (``core_sched.go:275-294``); without it the rebuild emitted those
+    writes unconditionally."""
+
+    def __init__(self, executor: rex.ResourceExecutor, probes=None):
         self.executor = executor
         #: node CPU-model performance ratio (cpunormalization hook input,
         #: published by the manager's cpunormalization plugin)
         self.cpu_norm_ratio = 1.0
+        self.probes = probes
+        self._blocked = (
+            probes.unsupported_plan_files() if probes is not None else None
+        )
+
+    def render(self, pod: Pod) -> List[Tuple[str, str, str]]:
+        plan = pod_plan(pod, self.cpu_norm_ratio)
+        if self._blocked:
+            plan = [e for e in plan if e[1] not in self._blocked]
+        return plan
 
     def reconcile(self, pods: Sequence[Pod]) -> int:
         writes = 0
         for pod in pods:
             writes += self.executor.apply(
-                pod_plan(pod, self.cpu_norm_ratio), reason="runtimehooks"
+                self.render(pod), reason="runtimehooks"
             )
         return writes
 

@@ -137,23 +137,47 @@ class StatesInformer:
         self._device: Optional[Device] = None
 
     # ---- setters (watch-stream analogs) ----
+    # Each setter validates its input before mutating state or firing
+    # callbacks: the reference's informer layer only delivers decoded,
+    # schema-valid objects, so a malformed object (None, wrong type, a
+    # node that isn't ours, pods with duplicate uids) must be dropped at
+    # the door instead of poisoning every downstream subsystem.
 
     def set_node(self, node: Node) -> None:
+        if not isinstance(node, Node) or not node.meta.name:
+            return
+        if node.meta.name != self.node_name:
+            return  # another node's object — a misrouted watch event
         with self._lock:
             self._node = node
         self.callbacks.fire(StateType.NODE, node)
 
     def set_pods(self, pods: Sequence[Pod]) -> None:
+        if pods is None:
+            return
+        clean: List[Pod] = []
+        seen = set()
+        for p in pods:
+            if not isinstance(p, Pod) or not p.meta.uid:
+                continue
+            if p.meta.uid in seen:
+                continue  # duplicate uid: keep the first, drop the echo
+            seen.add(p.meta.uid)
+            clean.append(p)
         with self._lock:
-            self._pods = list(pods)
-        self.callbacks.fire(StateType.ALL_PODS, list(pods))
+            self._pods = clean
+        self.callbacks.fire(StateType.ALL_PODS, list(clean))
 
     def set_node_slo(self, slo: NodeSLO) -> None:
+        if not isinstance(slo, NodeSLO):
+            return
         with self._lock:
             self._node_slo = slo
         self.callbacks.fire(StateType.NODE_SLO, slo)
 
     def set_node_metric_spec(self, spec: NodeMetric) -> None:
+        if not isinstance(spec, NodeMetric):
+            return
         with self._lock:
             self._node_metric_spec = spec
         self.callbacks.fire(StateType.NODE_METRIC_SPEC, spec)

@@ -30,6 +30,17 @@ Two solvers share the mask/cost kernels:
 The solver's output is a *nomination* (SURVEY §7 hard part (a)): the host
 Reserve step revalidates against live state and returns rejects to the next
 batch, preserving k8s semantics.
+
+On hand-written kernels: a Pallas nomination kernel (fused cost + jitter +
+streaming top-K over node tiles, flash-attention-style O(P·K) memory) was
+built and measured against this module's ``approx_max_k`` path on v5e.
+XLA fuses the masked cost directly into ``approx_max_k``'s reduction, so
+the [P, N] intermediate never materializes in HBM even at 8192×262144
+(a virtual 8 GiB block): the XLA path won at every shape tried
+(131k nodes: ~30 vs ~50 ms; 262k: ~60 vs ~150 ms, fetch-excluded). The
+kernel was removed rather than shipped as a slower alternative — the
+multi-chip ``parallel.sharded.shard_map_nominate`` covers node tables
+beyond one chip's HBM with the same O(P·K·tp) communication shape.
 """
 
 from __future__ import annotations

@@ -406,3 +406,135 @@ def test_reservation_affinity_required_semantics():
     # without affinity, normal scheduling still works
     out = sched.schedule([web_pod("plain")])
     assert len(out.bound) == 1
+
+
+def test_arbitrator_workload_level_limits():
+    """filterMaxMigratingOrUnavailablePerWorkload: per-workload in-flight
+    caps (int or percent of replicas) and the unavailable budget gate
+    candidate selection; bare pods (no controller) skip both."""
+    from koordinator_tpu.api.types import MigrationPhase, PodMigrationJob
+
+    args = ArbitratorArgs(
+        max_migrating_global=10,
+        max_migrating_per_namespace=10,
+        max_migrating_per_workload="20%",     # of replicas
+        max_unavailable_per_workload=3,
+    )
+    arb = Arbitrator(args)
+
+    def wpod(name, owner, prio=5000):
+        p = Pod(
+            meta=ObjectMeta(name=name, namespace="w"),
+            spec=PodSpec(requests={}, priority=prio),
+        )
+        p.meta.owner_uid = owner
+        return p
+
+    pods = {f"w/m{i}": wpod(f"m{i}", "deploy-a") for i in range(5)}
+    pods["w/bare"] = wpod("bare", "")
+    jobs = [
+        PodMigrationJob(meta=ObjectMeta(name=f"j{i}"), pod_uid=f"w/m{i}")
+        for i in range(5)
+    ] + [PodMigrationJob(meta=ObjectMeta(name="jb"), pod_uid="w/bare")]
+
+    # deploy-a has 10 replicas -> 20% cap = 2 migrating at once
+    picked = arb.arbitrate(
+        jobs,
+        pods,
+        in_flight=0,
+        replicas_by_owner={"deploy-a": 10},
+        unavailable_by_owner={"deploy-a": 0},
+    )
+    a_picked = [j for j in picked if j.pod_uid != "w/bare"]
+    assert len(a_picked) == 2
+    assert any(j.pod_uid == "w/bare" for j in picked)  # bare pod unlimited
+
+    # already one running migration for the workload: only one more
+    picked2 = arb.arbitrate(
+        jobs,
+        pods,
+        in_flight=1,
+        running_per_workload={"deploy-a": 1},
+        replicas_by_owner={"deploy-a": 10},
+    )
+    assert len([j for j in picked2 if j.pod_uid != "w/bare"]) == 1
+
+    # unavailable budget: 2 pods already down + cap 3 -> one slot left...
+    # but migrating cap (2) still applies; with 3 down, nothing fits
+    picked3 = arb.arbitrate(
+        jobs,
+        pods,
+        in_flight=0,
+        replicas_by_owner={"deploy-a": 10},
+        unavailable_by_owner={"deploy-a": 3},
+    )
+    assert [j for j in picked3 if j.pod_uid != "w/bare"] == []
+
+
+def test_migration_controller_workload_info_fn():
+    """The controllerFinder analog feeds per-workload limits end to end."""
+    from koordinator_tpu.api.types import MigrationPhase
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+            ),
+        )
+    )
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    evicted = []
+    mc = MigrationController(
+        rm,
+        evict_fn=lambda pod, reason: evicted.append(pod) or True,
+        arbitrator=Arbitrator(
+            ArbitratorArgs(max_migrating_per_workload=1)
+        ),
+        workload_info_fn=lambda owner: (4, 0),
+    )
+    victims = []
+    for i in range(3):
+        v = Pod(
+            meta=ObjectMeta(name=f"v{i}", labels={"app": "x"}),
+            spec=PodSpec(requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1024}),
+        )
+        v.meta.owner_uid = "rs-1"
+        victims.append(v)
+        mc.submit(v)
+    mc.reconcile(now=1000.0)
+    # only ONE of the three same-workload victims may migrate at a time
+    # (the single arbitrated job completes within the pass — its
+    # replacement reservation went Available immediately)
+    started = [
+        j
+        for j in mc.jobs.values()
+        if j.phase is not MigrationPhase.PENDING
+    ]
+    assert len(started) == 1
+    assert len(evicted) == 1
+
+
+def test_workload_percent_cap_without_replica_info_allows():
+    """A percent cap must not resolve against replicas=0 when no
+    controller-finder is wired — owned pods would be blocked forever."""
+    from koordinator_tpu.api.types import PodMigrationJob
+
+    arb = Arbitrator(
+        ArbitratorArgs(
+            max_migrating_global=10,
+            max_migrating_per_namespace=10,
+            max_migrating_per_workload="20%",
+        )
+    )
+    p = Pod(meta=ObjectMeta(name="m0", namespace="w"), spec=PodSpec(requests={}))
+    p.meta.owner_uid = "deploy-x"
+    jobs = [PodMigrationJob(meta=ObjectMeta(name="j0"), pod_uid=p.meta.uid)]
+    picked = arb.arbitrate(jobs, {p.meta.uid: p}, in_flight=0)
+    assert len(picked) == 1

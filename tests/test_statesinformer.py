@@ -176,3 +176,35 @@ def test_tpu_device_prober_reports_chips():
     assert all(d.resources == {"google.com/tpu": 1.0} for d in devs)
     minors = [d.minor for d in devs]
     assert len(set(minors)) == len(minors)
+
+
+def test_setters_drop_malformed_input():
+    """Malformed watch payloads must be dropped at the door (the
+    reference's informer only delivers schema-valid objects): None, wrong
+    types, misrouted node objects, and duplicate pod uids never reach
+    state or callbacks."""
+    si = StatesInformer(node_name="me")
+    fired = []
+    si.callbacks.register(StateType.NODE, "t", lambda n: fired.append(n))
+    si.callbacks.register(StateType.ALL_PODS, "t", lambda ps: fired.append(ps))
+
+    si.set_node(None)
+    si.set_node("not-a-node")
+    si.set_node(Node(meta=ObjectMeta(name="someone-else")))
+    assert si.node() is None and fired == []
+
+    me = Node(meta=ObjectMeta(name="me"))
+    si.set_node(me)
+    assert si.node() is me and fired == [me]
+
+    si.set_pods(None)
+    assert si.pods() == []
+    dup = Pod(meta=ObjectMeta(name="a"))
+    good = Pod(meta=ObjectMeta(name="b"))
+    si.set_pods([dup, "garbage", Pod(meta=ObjectMeta(name="a")), good, None])
+    assert [p.meta.name for p in si.pods()] == ["a", "b"]
+
+    si.set_node_slo("nope")
+    assert si.node_slo() is None
+    si.set_node_metric_spec(12)
+    assert si._node_metric_spec is None
