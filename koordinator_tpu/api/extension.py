@@ -134,6 +134,7 @@ RES_GPU_CORE = f"{DOMAIN}/gpu-core"
 RES_GPU_MEMORY = f"{DOMAIN}/gpu-memory"
 RES_GPU_MEMORY_RATIO = f"{DOMAIN}/gpu-memory-ratio"
 RES_RDMA = f"{DOMAIN}/rdma"
+RES_FPGA = f"{DOMAIN}/fpga"
 RES_KOORD_GPU = f"{DOMAIN}/gpu"          # percentage-style whole/fractional
 RES_GPU_SHARED = f"{DOMAIN}/gpu.shared"  # shared-GPU instance count
 
@@ -160,17 +161,27 @@ def parse_gpu_request(requests: Mapping[str, float]) -> tuple[int, float]:
     return whole, ratio
 
 
+def _count_request(requests: Mapping[str, float], key: str) -> int:
+    import math
+
+    try:
+        raw = float(requests.get(key, 0.0))
+    except (TypeError, ValueError):
+        return 0
+    return int(math.ceil(raw / 100.0)) if raw > 0 else 0
+
+
 def parse_rdma_request(requests: Mapping[str, float]) -> int:
     """Whole RDMA devices from ``koordinator.sh/rdma`` (the reference
     allocates RDMA NICs in 100-unit instances, ``device_share.go:102``);
     any positive fraction rounds up to a whole device."""
-    import math
+    return _count_request(requests, RES_RDMA)
 
-    try:
-        raw = float(requests.get(RES_RDMA, 0.0))
-    except (TypeError, ValueError):
-        return 0
-    return int(math.ceil(raw / 100.0)) if raw > 0 else 0
+
+def parse_fpga_request(requests: Mapping[str, float]) -> int:
+    """Whole FPGAs from ``koordinator.sh/fpga`` (``device_share.go:49``,
+    same 100-unit instance convention as RDMA)."""
+    return _count_request(requests, RES_FPGA)
 
 
 def parse_device_joint_allocate(

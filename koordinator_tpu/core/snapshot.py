@@ -123,6 +123,8 @@ class PodArrays:
     gpu_share: np.ndarray
     #: whole RDMA NICs per pod (koordinator.sh/rdma, 100-unit instances)
     rdma: np.ndarray
+    #: whole FPGAs per pod
+    fpga: np.ndarray
     p_real: int
     #: gang id -> "namespace/name" key, parallel to gang_min rows
     gang_keys: List[str] = dataclasses.field(default_factory=list)
@@ -141,6 +143,7 @@ class PodArrays:
             gpu_whole=np.zeros((p_bucket,), np.int32),
             gpu_share=np.zeros((p_bucket,), np.float32),
             rdma=np.zeros((p_bucket,), np.int32),
+            fpga=np.zeros((p_bucket,), np.int32),
             p_real=0,
         )
 
@@ -448,6 +451,7 @@ class ClusterSnapshot:
                 pod.spec.requests
             )
             out.rdma[i] = ext.parse_rdma_request(pod.spec.requests)
+            out.fpga[i] = ext.parse_fpga_request(pod.spec.requests)
             gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
             if gang:
                 key = f"{pod.meta.namespace}/{gang}"

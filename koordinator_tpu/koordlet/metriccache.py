@@ -189,10 +189,27 @@ class MetricCache:
             arrays["keys"] = np.frombuffer(
                 json.dumps(keys).encode(), dtype=np.uint8
             )
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as f:
-            np.savez_compressed(f, **arrays)
-        os.replace(tmp, path)
+        # unique temp name: concurrent checkpoints to the same path must
+        # not race on a shared ".tmp" (both writing, one os.replace
+        # winning and the other crashing on the vanished file)
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(path) + ".", dir=os.path.dirname(path) or "."
+        )
+        try:
+            # mkstemp creates 0600; restore open()'s umask-default mode so
+            # sidecar readers keep access after os.replace carries it over
+            os.fchmod(fd, 0o644)
+            with os.fdopen(fd, "wb") as f:
+                np.savez_compressed(f, **arrays)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     @classmethod
     def restore(

@@ -47,6 +47,7 @@ class StateType(enum.Enum):
     NODE_METRIC_SPEC = "node_metric_spec"
     NODE_TOPOLOGY = "node_topology"
     DEVICE = "device"
+    PVCS = "pvcs"
 
 
 Callback = Callable[[object], None]
@@ -135,6 +136,7 @@ class StatesInformer:
         self._node_metric_spec: Optional[NodeMetric] = None
         self._topology: Optional[NodeResourceTopology] = None
         self._device: Optional[Device] = None
+        self._pvcs: List["PersistentVolumeClaim"] = []
 
     # ---- setters (watch-stream analogs) ----
     # Each setter validates its input before mutating state or firing
@@ -181,6 +183,24 @@ class StatesInformer:
         with self._lock:
             self._node_metric_spec = spec
         self.callbacks.fire(StateType.NODE_METRIC_SPEC, spec)
+
+    def set_pvcs(self, pvcs: Sequence["PersistentVolumeClaim"]) -> None:
+        """PVC watch surface (the reference informer tracks claims so
+        storage capacity decisions see what is bound on this node)."""
+        if pvcs is None:
+            return
+        clean = [
+            p
+            for p in pvcs
+            if isinstance(p, PersistentVolumeClaim) and p.meta.name
+        ]
+        with self._lock:
+            self._pvcs = clean
+        self.callbacks.fire(StateType.PVCS, list(clean))
+
+    def pvcs(self) -> List["PersistentVolumeClaim"]:
+        with self._lock:
+            return list(self._pvcs)
 
     # ---- reporters (status writes in the reference) ----
 
@@ -258,3 +278,155 @@ class StatesInformer:
     def device(self) -> Optional[Device]:
         with self._lock:
             return self._device
+
+
+# ---------------------------------------------------------------------------
+# Kubelet stub + PVC surface (impl/kubelet_stub.go, impl/states_pvc.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PersistentVolumeClaim:
+    """Minimal PVC surface (the reference informer tracks PVCs so volume
+    capacity decisions can see bound claims)."""
+
+    meta: ObjectMeta
+    capacity_gib: float = 0.0
+    storage_class: str = ""
+    phase: str = "Bound"
+    volume_name: str = ""
+
+
+class KubeletStub:
+    """HTTP client for the kubelet's read-only ``/pods`` endpoint
+    (``impl/kubelet_stub.go:52-96``): the koordlet learns its pods from
+    the LOCAL kubelet instead of an apiserver watch — survives apiserver
+    partitions and sees exactly what the node runs.
+
+    The payload is the kubelet's PodList JSON; only the fields the
+    informer needs are decoded (name/namespace/uid/labels/annotations,
+    resource requests, priority, nodeName, phase).
+    """
+
+    def __init__(
+        self,
+        addr: str = "127.0.0.1",
+        port: int = 10255,
+        scheme: str = "http",
+        timeout_s: float = 10.0,
+        token: str = "",
+        verify_tls: bool = False,
+    ):
+        """Defaults target the kubelet's read-only HTTP endpoint (10255);
+        pair ``scheme="https"`` with port 10250 for the secure port (the
+        reference's serviceaccount-token + TLS flow; ``verify_tls=False``
+        mirrors its InsecureSkipTLSVerify default for self-signed kubelet
+        certs)."""
+        self.base = f"{scheme}://{addr}:{port}"
+        self.timeout_s = timeout_s
+        self.token = token
+        self.verify_tls = verify_tls
+
+    def get_all_pods(self) -> List[Pod]:
+        """GET /pods; raises OSError/ValueError on transport or decode
+        failure (the caller keeps its previous pod view — partial state
+        must never replace a healthy one)."""
+        import json as _json
+        import ssl
+        import urllib.request
+
+        req = urllib.request.Request(self.base + "/pods/")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        ctx = None
+        if self.base.startswith("https") and not self.verify_tls:
+            ctx = ssl._create_unverified_context()
+        with urllib.request.urlopen(
+            req, timeout=self.timeout_s, context=ctx
+        ) as resp:
+            payload = _json.loads(resp.read().decode())
+        return [
+            p
+            for item in payload.get("items", []) or []
+            if (p := self._decode_pod(item)) is not None
+        ]
+
+    @staticmethod
+    def _decode_pod(item) -> Optional[Pod]:
+        from ..api.types import PodSpec
+
+        if not isinstance(item, dict):
+            return None
+        meta = item.get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            return None
+        spec = item.get("spec") or {}
+        requests: Dict[str, float] = {}
+        for c in spec.get("containers") or []:
+            for res, val in (
+                (c.get("resources") or {}).get("requests") or {}
+            ).items():
+                try:
+                    requests[res] = requests.get(res, 0.0) + _parse_quantity(
+                        val, res
+                    )
+                except (TypeError, ValueError):
+                    continue
+        return Pod(
+            meta=ObjectMeta(
+                name=name,
+                namespace=meta.get("namespace", "default"),
+                uid=meta.get("uid", ""),
+                labels=dict(meta.get("labels") or {}),
+                annotations=dict(meta.get("annotations") or {}),
+            ),
+            spec=PodSpec(
+                requests=requests,
+                priority=spec.get("priority"),
+                node_name=spec.get("nodeName"),
+            ),
+        )
+
+    def sync_into(self, informer: "StatesInformer") -> bool:
+        """One kubelet pull → informer.set_pods; False (state untouched)
+        when the kubelet is unreachable or returns garbage."""
+        try:
+            pods = self.get_all_pods()
+        except (OSError, ValueError):
+            return False
+        informer.set_pods(pods)
+        return True
+
+
+def _parse_quantity(val, resource: str = "") -> float:
+    """k8s quantity → the snapshot's native units, per resource:
+
+    cpu     → milli-cores: '2'/2 → 2000, '500m' → 500
+    memory  → MiB: '1Gi' → 1024, '128974848' (bytes) → ~123, '128M'
+              (decimal) → ~122
+    other   → native count, passed through ('2' → 2.0)
+
+    Raises ValueError on unparseable strings (the caller drops that one
+    resource entry)."""
+    s = str(val).strip()
+    binary = {
+        "Ki": 2.0**10,
+        "Mi": 2.0**20,
+        "Gi": 2.0**30,
+        "Ti": 2.0**40,
+    }
+    decimal = {"k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12}
+    if resource == "cpu":
+        if s.endswith("m"):
+            return float(s[:-1])
+        return float(s) * 1000.0
+    if resource == "memory":
+        for suf, mult in binary.items():
+            if s.endswith(suf):
+                return float(s[: -len(suf)]) * mult / 2.0**20
+        for suf, mult in decimal.items():
+            if s.endswith(suf):
+                return float(s[: -len(suf)]) * mult / 2.0**20
+        return float(s) / 2.0**20  # plain bytes
+    return float(s)

@@ -186,6 +186,20 @@ class RDMADeviceResourcePlugin:
         )
 
 
+class FPGADeviceResourcePlugin:
+    name = "FPGADeviceResource"
+
+    def calculate(self, node: Node, device: Optional[Device]) -> ResourceItem:
+        fpgas = [
+            d for d in (device.devices if device else []) if d.dev_type == "fpga"
+        ]
+        if not fpgas:
+            return ResourceItem(name=self.name, reset=True)
+        return ResourceItem(
+            name=self.name, resources={ext.RES_FPGA: float(len(fpgas))}
+        )
+
+
 #: keys each plugin owns, cleared on reset (the reference's Reset() path
 #: returns zeroed ResourceItems for exactly these keys)
 _OWNED_ANNOTATIONS = {
@@ -195,6 +209,7 @@ _OWNED_ANNOTATIONS = {
 _OWNED_RESOURCES = {
     "GPUDeviceResource": (ext.RES_GPU, ext.RES_GPU_CORE, ext.RES_GPU_MEMORY),
     "RDMADeviceResource": (ext.RES_RDMA,),
+    "FPGADeviceResource": (ext.RES_FPGA,),
 }
 _OWNED_LABELS = {
     "GPUDeviceResource": (LABEL_GPU_MODEL, LABEL_GPU_DRIVER),

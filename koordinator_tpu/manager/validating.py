@@ -129,6 +129,9 @@ def _validate_device_resources(pod: Pod) -> List[str]:
     rdma = req.get(ext.RES_RDMA)
     if rdma is not None and rdma <= 0:
         errors.append("the requested RDMA must be greater than zero")
+    fpga = req.get(ext.RES_FPGA)
+    if fpga is not None and fpga <= 0:
+        errors.append("the requested FPGA must be greater than zero")
     return errors
 
 

@@ -38,6 +38,7 @@ class DeviceState:
 
     slot_free: jnp.ndarray
     rdma_free: jnp.ndarray = None
+    fpga_free: jnp.ndarray = None
 
     def aggregates(self):
         """(full_count [N], partial_max [N], total [N])."""
@@ -56,6 +57,8 @@ def device_fit_mask(
     partial_max: jnp.ndarray,  # [N]
     rdma_req: jnp.ndarray = None,   # [P] int32 — whole RDMA NICs
     rdma_free: jnp.ndarray = None,  # [N] free NIC count
+    fpga_req: jnp.ndarray = None,   # [P] int32 — whole FPGAs
+    fpga_free: jnp.ndarray = None,  # [N] free FPGA count
 ) -> jnp.ndarray:
     """[P, N] GPU feasibility (reference Filter, ``plugin.go:311``).
 
@@ -81,6 +84,11 @@ def device_fit_mask(
         ok &= (
             rdma_req[:, None].astype(jnp.float32)
             <= rdma_free[None, :] + EPS
+        )
+    if fpga_req is not None and fpga_free is not None:
+        ok &= (
+            fpga_req[:, None].astype(jnp.float32)
+            <= fpga_free[None, :] + EPS
         )
     return ok
 

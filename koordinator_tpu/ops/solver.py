@@ -120,6 +120,8 @@ class PodBatch:
     gpu_share: jnp.ndarray
     #: whole RDMA devices requested (koordinator.sh/rdma / 100), [P] int32
     rdma: jnp.ndarray = None
+    #: whole FPGAs requested (koordinator.sh/fpga / 100), [P] int32
+    fpga: jnp.ndarray = None
 
     @classmethod
     def create(
@@ -136,6 +138,7 @@ class PodBatch:
         gpu_whole=None,
         gpu_share=None,
         rdma=None,
+        fpga=None,
         quota_levels: int = 4,
     ) -> "PodBatch":
         requests = jnp.asarray(requests, jnp.float32)
@@ -183,6 +186,11 @@ class PodBatch:
                 jnp.zeros(p, jnp.int32)
                 if rdma is None
                 else jnp.asarray(rdma, jnp.int32)
+            ),
+            fpga=(
+                jnp.zeros(p, jnp.int32)
+                if fpga is None
+                else jnp.asarray(fpga, jnp.int32)
             ),
         )
 
@@ -505,6 +513,8 @@ def assign(
                 dev_partial,
                 rdma_req=spods.rdma,
                 rdma_free=devices.rdma_free,
+                fpga_req=spods.fpga,
+                fpga_free=devices.fpga_free,
             )
         cost = cost_ops.load_aware_cost(
             spods.estimate,

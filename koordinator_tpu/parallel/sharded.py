@@ -74,6 +74,7 @@ def _pod_spec() -> PodBatch:
         gpu_whole=P("dp"),
         gpu_share=P("dp"),
         rdma=P("dp"),
+        fpga=P("dp"),
     )
 
 

@@ -218,6 +218,7 @@ class BatchScheduler:
             gpu_whole=arrays.gpu_whole,
             gpu_share=arrays.gpu_share,
             rdma=arrays.rdma,
+            fpga=arrays.fpga,
         )
 
     # ---- scheduling cycle ----
@@ -630,6 +631,7 @@ class BatchScheduler:
             device_state = DeviceState(
                 slot_free=jnp.asarray(self.devices.slot_array()),
                 rdma_free=jnp.asarray(self.devices.rdma_array()),
+                fpga_free=jnp.asarray(self.devices.fpga_array()),
             )
         return numa_state, device_state
 

@@ -37,6 +37,8 @@ class _NodeDevices:
     gpu_free: List[float]
     #: free percent per RDMA minor (100 = idle NIC)
     rdma_free: List[float] = dataclasses.field(default_factory=list)
+    #: free percent per FPGA minor
+    fpga_free: List[float] = dataclasses.field(default_factory=list)
     #: PCIe root per RDMA minor ("" unknown)
     rdma_pcie: List[str] = dataclasses.field(default_factory=list)
     #: pod uid -> [(minor, percent)] of GPU picks
@@ -45,6 +47,10 @@ class _NodeDevices:
     )
     #: pod uid -> [(minor, percent)] of RDMA picks
     rdma_owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
+        default_factory=dict
+    )
+    #: pod uid -> [(minor, percent)] of FPGA picks
+    fpga_owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
         default_factory=dict
     )
     #: size -> partitions (GPUPartitionTable); empty = no table
@@ -105,11 +111,13 @@ class DeviceManager:
         allocations from pod annotations the same way)."""
         gpus = [d for d in device.devices if d.dev_type == "gpu"]
         rdma = [d for d in device.devices if d.dev_type == "rdma"]
+        fpga = [d for d in device.devices if d.dev_type == "fpga"]
         old = self._nodes.get(device.meta.name)
         st = _NodeDevices(
             gpu_free=[FULL] * len(gpus),
             rdma_free=[FULL] * len(rdma),
             rdma_pcie=[d.pcie_bus for d in rdma],
+            fpga_free=[FULL] * len(fpga),
             partitions=dict(device.partitions),
             partition_policy=device.partition_policy,
             numa_of=[d.numa_node for d in gpus],
@@ -128,6 +136,12 @@ class DeviceManager:
                     st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
                 if kept:
                     st.rdma_owners[uid] = kept
+            for uid, picks in old.fpga_owners.items():
+                kept = [(m, pct) for m, pct in picks if m < len(st.fpga_free)]
+                for minor, pct in kept:
+                    st.fpga_free[minor] = max(st.fpga_free[minor] - pct, 0.0)
+                if kept:
+                    st.fpga_owners[uid] = kept
         self._nodes[device.meta.name] = st
 
     def node(self, name: str) -> Optional[_NodeDevices]:
@@ -159,13 +173,22 @@ class DeviceManager:
 
     def rdma_array(self) -> np.ndarray:
         """Free RDMA NIC count per node, [N] aligned to snapshot rows."""
+        return self._count_array("rdma_free")
+
+    def fpga_array(self) -> np.ndarray:
+        """Free FPGA count per node, [N] aligned to snapshot rows."""
+        return self._count_array("fpga_free")
+
+    def _count_array(self, attr: str) -> np.ndarray:
         n_bucket = self.snapshot.nodes.allocatable.shape[0]
         out = np.zeros((n_bucket,), np.float32)
         for name, st in self._nodes.items():
             idx = self.snapshot.node_id(name)
             if idx is None:
                 continue
-            out[idx] = sum(1 for f in st.rdma_free if f >= FULL - 1e-6)
+            out[idx] = sum(
+                1 for f in getattr(st, attr) if f >= FULL - 1e-6
+            )
         return out
 
     # ---- exact assignment (Reserve/PreBind) ----
@@ -180,7 +203,8 @@ class DeviceManager:
         equal the GPU PCIe set, ``validateJointAllocation``)."""
         whole, share = parse_gpu_request(pod)
         rdma_count = ext.parse_rdma_request(pod.spec.requests)
-        if whole == 0 and share <= 0 and rdma_count == 0:
+        fpga_count = ext.parse_fpga_request(pod.spec.requests)
+        if whole == 0 and share <= 0 and rdma_count == 0 and fpga_count == 0:
             return {}
         st = self._nodes.get(node_name)
         if st is None:
@@ -228,6 +252,14 @@ class DeviceManager:
             if chosen_rdma is None:
                 return None
             rdma_picks = [(m, FULL) for m in chosen_rdma]
+        fpga_picks: List[Tuple[int, float]] = []
+        if fpga_count > 0:
+            free_fpga = [
+                i for i, f in enumerate(st.fpga_free) if f >= FULL - 1e-6
+            ]
+            if len(free_fpga) < fpga_count:
+                return None
+            fpga_picks = [(m, FULL) for m in free_fpga[:fpga_count]]
         # all picks succeeded — commit atomically
         st.gpu_free = free
         if picks:
@@ -236,6 +268,10 @@ class DeviceManager:
             st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
         if rdma_picks:
             st.rdma_owners[pod.meta.uid] = rdma_picks
+        for minor, pct in fpga_picks:
+            st.fpga_free[minor] = max(st.fpga_free[minor] - pct, 0.0)
+        if fpga_picks:
+            st.fpga_owners[pod.meta.uid] = fpga_picks
         payload: Dict[str, List] = {}
         if picks:
             payload["gpu"] = [
@@ -249,6 +285,11 @@ class DeviceManager:
             payload["rdma"] = [
                 {"minor": minor, "resources": {ext.RES_RDMA: pct}}
                 for minor, pct in rdma_picks
+            ]
+        if fpga_picks:
+            payload["fpga"] = [
+                {"minor": minor, "resources": {ext.RES_FPGA: pct}}
+                for minor, pct in fpga_picks
             ]
         return {ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(payload)}
 
@@ -396,8 +437,10 @@ class DeviceManager:
         for st in self._nodes.values():
             st.gpu_free = [FULL] * len(st.gpu_free)
             st.rdma_free = [FULL] * len(st.rdma_free)
+            st.fpga_free = [FULL] * len(st.fpga_free)
             st.owners.clear()
             st.rdma_owners.clear()
+            st.fpga_owners.clear()
 
     def release(self, pod_uid: str, node_name: str) -> None:
         st = self._nodes.get(node_name)
@@ -407,3 +450,5 @@ class DeviceManager:
             st.gpu_free[minor] = min(st.gpu_free[minor] + pct, FULL)
         for minor, pct in st.rdma_owners.pop(pod_uid, []):
             st.rdma_free[minor] = min(st.rdma_free[minor] + pct, FULL)
+        for minor, pct in st.fpga_owners.pop(pod_uid, []):
+            st.fpga_free[minor] = min(st.fpga_free[minor] + pct, FULL)

@@ -486,7 +486,8 @@ class ElasticQuotaPreemptor:
         dm = self.scheduler.devices
         whole, share = ext.parse_gpu_request(pod.spec.requests)
         rdma = ext.parse_rdma_request(pod.spec.requests)
-        if whole == 0 and share <= 0 and rdma == 0:
+        fpga = ext.parse_fpga_request(pod.spec.requests)
+        if whole == 0 and share <= 0 and rdma == 0 and fpga == 0:
             return True
         if dm is None:
             return False
@@ -507,7 +508,13 @@ class ElasticQuotaPreemptor:
         victim_rdma = sum(
             len(st.rdma_owners.get(uid, [])) for uid in victim_uids
         )
-        return rdma <= free_rdma + victim_rdma
+        if rdma > free_rdma + victim_rdma:
+            return False
+        free_fpga = sum(1 for f in st.fpga_free if f >= 100.0 - 1e-6)
+        victim_fpga = sum(
+            len(st.fpga_owners.get(uid, [])) for uid in victim_uids
+        )
+        return fpga <= free_fpga + victim_fpga
 
     def select_victims(
         self, pod: Pod

@@ -637,3 +637,41 @@ def test_rdma_capacity_e2e():
     dm.release(out.bound[0][0].meta.uid, "n0")
     out2 = sched.schedule(out.unschedulable)
     assert len(out2.bound) == 1
+
+
+def test_fpga_capacity_and_allocation_e2e():
+    """FPGA devices (device_share.go:49): count-based instances, solver
+    feasibility plus exact minor assignment and release."""
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+            ),
+        )
+    )
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="n0"),
+            devices=[DeviceInfo(dev_type="fpga", minor=f) for f in range(2)],
+        )
+    )
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    pods = []
+    for i in range(3):
+        p = gpu_pod(f"f{i}")
+        p.spec.requests[ext.RES_FPGA] = 100
+        pods.append(p)
+    out = sched.schedule(pods)
+    assert len(out.bound) == 2 and len(out.unschedulable) == 1
+    st = dm.node("n0")
+    assert sum(st.fpga_free) == 0.0
+    alloc = json.loads(
+        out.bound[0][0].meta.annotations[ext.ANNOTATION_DEVICE_ALLOCATED]
+    )
+    assert alloc["fpga"][0]["resources"][ext.RES_FPGA] == 100.0
+    dm.release(out.bound[0][0].meta.uid, "n0")
+    assert sorted(st.fpga_free) == [0.0, 100.0]

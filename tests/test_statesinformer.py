@@ -208,3 +208,81 @@ def test_setters_drop_malformed_input():
     assert si.node_slo() is None
     si.set_node_metric_spec(12)
     assert si._node_metric_spec is None
+
+
+def test_kubelet_stub_pulls_pods_over_http():
+    """KubeletStub: a real HTTP round trip against a fake kubelet /pods
+    endpoint (impl/kubelet_stub.go); failures leave the pod view intact."""
+    import http.server
+    import threading
+
+    payload = {
+        "items": [
+            {
+                "metadata": {"name": "web-1", "namespace": "prod",
+                             "uid": "u1", "labels": {"app": "web"}},
+                "spec": {
+                    "priority": 9500,
+                    "nodeName": "me",
+                    "containers": [
+                        {"resources": {"requests": {"cpu": "500m",
+                                                    "memory": "1Gi"}}},
+                        {"resources": {"requests": {"cpu": "2"}}},
+                    ],
+                },
+            },
+            {"metadata": {}},          # malformed item: dropped
+            "garbage",
+        ]
+    }
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path != "/pods/":
+                self.send_response(404); self.end_headers(); return
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True); t.start()
+    try:
+        from koordinator_tpu.koordlet.statesinformer import KubeletStub
+
+        stub = KubeletStub(addr="127.0.0.1", port=srv.server_address[1])
+        si = StatesInformer(node_name="me")
+        assert stub.sync_into(si)
+        pods = si.pods()
+        assert [p.meta.name for p in pods] == ["web-1"]
+        # quantities normalized: 500m + 2 cpus = 2500 milli; 1Gi = 1024 MiB
+        assert pods[0].spec.requests["cpu"] == 2500.0
+        assert pods[0].spec.requests["memory"] == 1024.0
+        assert pods[0].spec.priority == 9500
+
+        # unreachable kubelet: state untouched, False returned
+        dead = KubeletStub(addr="127.0.0.1", port=1, timeout_s=0.2)
+        assert not dead.sync_into(si)
+        assert [p.meta.name for p in si.pods()] == ["web-1"]
+    finally:
+        srv.shutdown()
+
+
+def test_pvc_surface():
+    from koordinator_tpu.koordlet.statesinformer import PersistentVolumeClaim
+
+    si = StatesInformer(node_name="me")
+    seen = []
+    si.callbacks.register(StateType.PVCS, "t", lambda v: seen.append(v))
+    claim = PersistentVolumeClaim(
+        meta=ObjectMeta(name="data-0", namespace="db"),
+        capacity_gib=100.0,
+        storage_class="ssd",
+    )
+    si.set_pvcs([claim, "junk", None])
+    assert si.pvcs() == [claim]
+    assert seen == [[claim]]
