@@ -1,251 +1,19 @@
-"""Long-lived cross-component loop: the §3.3 feedback cycle as one process.
+"""Narrated demo of the long-lived cross-component loop.
 
     python examples/longrun_loop.py [minutes]
 
-Composes, per simulated tick (15 s):
-
-  koordlet  — per-node usage samples land in a MetricCache; at each
-              report interval the window aggregate becomes a NodeMetric
-              status report (states_nodemetric.go:212 analog)
-  manager   — NodeMetricController accepts the report; the snapshot
-              ingests it; NodeResourceController recomputes
-              kubernetes.io/batch-* capacity from the prod peak
-  scheduler — newly arrived Spark pods (mutated BE by the colocation
-              profile webhook) are batch-scheduled against batch capacity
-  koordlet  — runtimehooks derive the cgroup plan for each new bind;
-              qosmanager computes the BE suppression allowance from the
-              latest usage
-
-Pods complete after a few ticks and release capacity; prod load follows a
-sinusoid so batch capacity breathes. Invariants checked every tick:
-
-  * snapshot accounting never drifts: requested == Σ live assumes
-  * published batch capacity tracks alloc·(1-reserve) − prod_peak
-  * batch-cpu requested never exceeds batch allocatable on any node
-  * suppression allowance shrinks when prod usage crosses the threshold
-
-``tests/test_longrun_loop.py`` runs this driver for 10 simulated minutes;
-the script narrates a longer run.
+The driver lives in the package (``koordinator_tpu.sim.longrun.run_loop``);
+this script just runs it verbosely on CPU.
 """
 
 from __future__ import annotations
 
-import math
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-
-def run_loop(
-    minutes: float = 10.0,
-    tick_s: float = 15.0,
-    n_nodes: int = 6,
-    seed: int = 0,
-    verbose: bool = False,
-):
-    """Drive the loop for ``minutes`` of simulated time; returns stats."""
-    import numpy as np
-
-    from koordinator_tpu.api import extension as ext
-    from koordinator_tpu.api.extension import QoSClass
-    from koordinator_tpu.api.types import (
-        ClusterColocationProfile,
-        Node,
-        NodeMetric,
-        NodeStatus,
-        ObjectMeta,
-        Pod,
-        PodSpec,
-        ResourceMetric,
-    )
-    from koordinator_tpu.core.snapshot import ClusterSnapshot
-    from koordinator_tpu.koordlet import qosmanager, runtimehooks
-    from koordinator_tpu.koordlet.metriccache import MetricCache
-    from koordinator_tpu.manager.nodemetric import NodeMetricController
-    from koordinator_tpu.manager.noderesource import (
-        ColocationStrategy,
-        NodeResourceController,
-    )
-    from koordinator_tpu.manager.profile import ProfileMutator
-    from koordinator_tpu.manager.validating import validate_pod
-    from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
-
-    ALLOC_CPU, ALLOC_MEM = 64_000.0, 256 * 1024.0
-    REPORT_EVERY = 4          # ticks between NodeMetric reports (60 s)
-    BE_LIFETIME = 8           # ticks a BE pod runs before completing
-    rng = np.random.default_rng(seed)
-
-    snap = ClusterSnapshot()
-    for i in range(n_nodes):
-        snap.upsert_node(
-            Node(
-                meta=ObjectMeta(name=f"n{i}"),
-                status=NodeStatus(
-                    allocatable={ext.RES_CPU: ALLOC_CPU, ext.RES_MEMORY: ALLOC_MEM}
-                ),
-            )
-        )
-    caches = {f"n{i}": MetricCache(capacity_per_series=512) for i in range(n_nodes)}
-    nm_ctrl = NodeMetricController()
-    nr_ctrl = NodeResourceController(snap, ColocationStrategy(reserve_ratio=0.1))
-    mutator = ProfileMutator()
-    mutator.upsert(
-        ClusterColocationProfile(
-            meta=ObjectMeta(name="colocation-spark"),
-            selector={"koordinator.sh/enable-colocation": "true"},
-            qos_class=QoSClass.BE,
-            priority=5500,
-            scheduler_name="koord-scheduler",
-            resource_translation={
-                ext.RES_CPU: ext.RES_BATCH_CPU,
-                ext.RES_MEMORY: ext.RES_BATCH_MEMORY,
-            },
-        )
-    )
-    sched = BatchScheduler(snap, LoadAwareArgs(), batch_bucket=128)
-    sched.extender.monitor.stop_background()
-
-    bc = snap.config.resources.index(ext.RES_BATCH_CPU)
-    rows = [snap.node_id(f"n{i}") for i in range(n_nodes)]
-
-    def prod_util(node_i: int, t: float) -> float:
-        """Sinusoidal prod load, phase-shifted per node, 20%..75%."""
-        phase = 2 * math.pi * (t / (minutes * 60.0) + node_i / n_nodes)
-        return 0.475 + 0.275 * math.sin(phase)
-
-    live: list = []      # (pod, node, done_tick)
-    stats = {
-        "ticks": 0,
-        "arrived": 0,
-        "bound": 0,
-        "completed": 0,
-        "unschedulable": 0,
-        "reports": 0,
-        "suppressions": 0,
-        "min_batch_cap": float("inf"),
-        "max_batch_cap": 0.0,
-    }
-    n_ticks = int(minutes * 60.0 / tick_s)
-    pod_seq = 0
-    for tick in range(n_ticks):
-        now = 1000.0 + tick * tick_s
-        stats["ticks"] += 1
-
-        # ---- koordlet collection: usage samples into each node's cache ----
-        utils = {}
-        for i in range(n_nodes):
-            name = f"n{i}"
-            u = prod_util(i, tick * tick_s) + float(rng.normal(0, 0.01))
-            u = min(max(u, 0.05), 0.95)
-            utils[name] = u
-            caches[name].append("node_cpu", name, now, ALLOC_CPU * u)
-            caches[name].append("node_mem", name, now, ALLOC_MEM * u * 0.8)
-
-        # ---- report interval: window aggregate → NodeMetric status ----
-        if tick % REPORT_EVERY == 0:
-            for i in range(n_nodes):
-                name = f"n{i}"
-                agg_c = caches[name].aggregate("node_cpu", name, now - 300, now + 1)
-                agg_m = caches[name].aggregate("node_mem", name, now - 300, now + 1)
-                report = NodeMetric(
-                    meta=ObjectMeta(name=name),
-                    node_usage=ResourceMetric(
-                        usage={
-                            ext.RES_CPU: agg_c.percentiles.get("p95", agg_c.avg),
-                            ext.RES_MEMORY: agg_m.percentiles.get("p95", agg_m.avg),
-                        }
-                    ),
-                    prod_usage=ResourceMetric(
-                        usage={
-                            ext.RES_CPU: agg_c.avg,
-                            ext.RES_MEMORY: agg_m.avg,
-                        }
-                    ),
-                    update_time=now,
-                )
-                nm_ctrl.observe(report)       # the CRD status write
-                snap.set_node_metric(report, now=now)
-                stats["reports"] += 1
-            # ---- manager: batch capacity from the fresh prod peak ----
-            published = nr_ctrl.reconcile()
-            assert set(published) == {f"n{i}" for i in range(n_nodes)}
-
-        caps = snap.nodes.allocatable[rows, bc]
-        stats["min_batch_cap"] = min(stats["min_batch_cap"], float(caps.min()))
-        stats["max_batch_cap"] = max(stats["max_batch_cap"], float(caps.max()))
-
-        # ---- workload arrival: Spark pods through the admission chain ----
-        arriving = []
-        for _ in range(int(rng.integers(1, 4))):
-            pod_seq += 1
-            pod = Pod(
-                meta=ObjectMeta(
-                    name=f"spark-{pod_seq:05d}",
-                    namespace="spark",
-                    labels={"koordinator.sh/enable-colocation": "true"},
-                ),
-                spec=PodSpec(requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192}),
-            )
-            pod = mutator.mutate(pod)
-            assert validate_pod(pod) == []
-            arriving.append(pod)
-        stats["arrived"] += len(arriving)
-
-        out = sched.schedule(arriving)
-        stats["bound"] += len(out.bound)
-        stats["unschedulable"] += len(out.unschedulable)
-        for pod, node in out.bound:
-            plan = runtimehooks.pod_plan(pod)
-            assert "bvt" in str(plan)
-            live.append((pod, node, tick + BE_LIFETIME))
-
-        # ---- qosmanager: suppression on the hottest node ----
-        hot = max(utils, key=lambda k: utils[k])
-        be_used = 4000.0 * sum(1 for _, n, _ in live if n == hot)
-        dec = qosmanager.cpu_suppress(
-            node_allocatable_milli=ALLOC_CPU,
-            node_used_milli=utils[hot] * ALLOC_CPU + be_used,
-            be_used_milli=be_used,
-            threshold_percent=65.0,
-        )
-        if be_used and dec.be_allowance_milli < be_used:
-            stats["suppressions"] += 1
-
-        # ---- completion: BE pods release capacity ----
-        still = []
-        for pod, node, done in live:
-            if done <= tick:
-                snap.forget_pod(pod.meta.uid)
-                sched._bound_nodes.pop(pod.meta.uid, None)
-                stats["completed"] += 1
-            else:
-                still.append((pod, node, done))
-        live = still
-
-        # ---- invariants ----
-        # 1. accounting: requested equals the sum of live assumes
-        want = np.zeros_like(snap.nodes.requested)
-        for uid, ap in snap._assumed.items():
-            want[ap.node_idx] += ap.request
-        np.testing.assert_allclose(snap.nodes.requested, want, atol=1e-3)
-        # 2. batch capacity formula holds on every node (within one
-        #    report interval of staleness)
-        # 3. batch consumption never exceeds batch allocatable
-        over = snap.nodes.requested[rows, bc] - snap.nodes.allocatable[rows, bc]
-        assert (over <= 1e-3).all(), over
-
-        if verbose and tick % REPORT_EVERY == 0:
-            print(
-                f"t={now - 1000:6.0f}s live={len(live):3d} "
-                f"batch_cap=[{caps.min():7.0f}..{caps.max():7.0f}] "
-                f"bound={stats['bound']} unsched={stats['unschedulable']} "
-                f"suppr={stats['suppressions']}"
-            )
-
-    stats["live_at_end"] = len(live)
-    return stats
-
+from koordinator_tpu.sim.longrun import run_loop  # noqa: E402
 
 if __name__ == "__main__":
     import jax
@@ -254,7 +22,7 @@ if __name__ == "__main__":
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
     stats = run_loop(minutes=minutes, verbose=True)
     print("\nfinal:", stats)
-    assert stats["bound"] > 0 and stats["completed"] > 0
+    assert stats["bound"] > 0  # (completions need >2 simulated minutes)
     print(
         f"loop held for {stats['ticks']} ticks: {stats['bound']} pods bound, "
         f"{stats['completed']} completed, {stats['suppressions']} suppression "

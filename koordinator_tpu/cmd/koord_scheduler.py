@@ -22,6 +22,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--config", default="", help="versioned plugin-args JSON (scheduler.config)"
     )
+    parser.add_argument(
+        "--serve",
+        default="",
+        metavar="ADDR",
+        help=(
+            "long-lived solver-sidecar mode: serve the gRPC snapshot/"
+            "nominate channel on ADDR (e.g. 127.0.0.1:50051) instead of "
+            "the sim loop — the north-star deployment shape (control "
+            "plane ships deltas, solver answers nominations)"
+        ),
+    )
     return parser
 
 
@@ -39,6 +50,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             raw = json.load(f)
         la_args = decode_load_aware(raw.get("loadAware", raw))
         validate_load_aware(la_args)
+
+    if args.serve:
+        import signal
+        import threading
+
+        from ..runtime.snapshot_channel import SolverService, serve
+
+        service = SolverService(args=la_args, batch_bucket=args.batch_bucket)
+        server, port = serve(service, address=args.serve)
+        print(f"koord-scheduler: solver service listening on port {port}", flush=True)
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(sig, lambda *_: stop.set())
+            except ValueError:
+                pass  # non-main thread (tests drive main() directly)
+        stop.wait()
+        server.stop(grace=5.0)
+        return 0
 
     snap, _nodes, pods = _common.build_snapshot(args)
     sched = BatchScheduler(snap, la_args, batch_bucket=args.batch_bucket)

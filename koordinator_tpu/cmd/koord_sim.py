@@ -1,0 +1,50 @@
+"""koord-sim binary: the full §3.3 feedback loop as ONE long-lived process.
+
+The reference exercises its cross-component data flow on a kind cluster
+(SURVEY §4: koordlet → NodeMetric → slo-controller → scheduler →
+runtimehooks); this binary is the rebuild's stand-in: it composes every
+component in-process and drives them for N simulated minutes with
+per-tick consistency invariants (see ``examples/longrun_loop.py`` for the
+driver, ``tests/test_longrun_loop.py`` for the asserted invariants).
+
+    python -m koordinator_tpu.cmd.koord_sim --minutes 30 --nodes 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-sim")
+    parser.add_argument("--minutes", type=float, default=10.0)
+    parser.add_argument("--nodes", type=int, default=6)
+    parser.add_argument("--tick-s", type=float, default=15.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--quiet", action="store_true", help="suppress per-report narration"
+    )
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..sim.longrun import run_loop
+
+    stats = run_loop(
+        minutes=args.minutes,
+        tick_s=args.tick_s,
+        n_nodes=args.nodes,
+        seed=args.seed,
+        verbose=not args.quiet,
+    )
+    print(json.dumps(stats))
+    return 0 if stats["bound"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -393,7 +393,10 @@ class KubeletStub:
         when the kubelet is unreachable or returns garbage."""
         try:
             pods = self.get_all_pods()
-        except (OSError, ValueError):
+        except Exception:  # noqa: BLE001 — degrade, never crash the loop:
+            # transport errors (OSError), malformed HTTP (HTTPException),
+            # bad JSON (ValueError), or a garbage top-level payload
+            # (AttributeError/TypeError) all mean "keep the previous view"
             return False
         informer.set_pods(pods)
         return True
@@ -415,9 +418,15 @@ def _parse_quantity(val, resource: str = "") -> float:
         "Mi": 2.0**20,
         "Gi": 2.0**30,
         "Ti": 2.0**40,
+        "Pi": 2.0**50,
+        "Ei": 2.0**60,
     }
-    decimal = {"k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12}
+    decimal = {"k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18}
     if resource == "cpu":
+        if s.endswith("n"):
+            return float(s[:-1]) / 1e6      # nano-cores → milli
+        if s.endswith("u"):
+            return float(s[:-1]) / 1e3      # micro-cores → milli
         if s.endswith("m"):
             return float(s[:-1])
         return float(s) * 1000.0

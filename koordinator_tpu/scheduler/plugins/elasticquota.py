@@ -494,23 +494,25 @@ class ElasticQuotaPreemptor:
         st = dm.node(node)
         if st is None:
             return False
+        from .deviceshare import FULL
+
         victim_uids = {v.meta.uid for v in victims}
-        free_full = sum(1 for f in st.gpu_free if f >= 100.0 - 1e-6)
+        free_full = sum(1 for f in st.gpu_free if f >= FULL - 1e-6)
         victim_full = sum(
             1
             for uid in victim_uids
             for _m, pct in st.owners.get(uid, [])
-            if pct >= 100.0 - 1e-6
+            if pct >= FULL - 1e-6
         )
         if whole + (1 if share > 0 else 0) > free_full + victim_full:
             return False
-        free_rdma = sum(1 for f in st.rdma_free if f >= 100.0 - 1e-6)
+        free_rdma = sum(1 for f in st.rdma_free if f >= FULL - 1e-6)
         victim_rdma = sum(
             len(st.rdma_owners.get(uid, [])) for uid in victim_uids
         )
         if rdma > free_rdma + victim_rdma:
             return False
-        free_fpga = sum(1 for f in st.fpga_free if f >= 100.0 - 1e-6)
+        free_fpga = sum(1 for f in st.fpga_free if f >= FULL - 1e-6)
         victim_fpga = sum(
             len(st.fpga_owners.get(uid, [])) for uid in victim_uids
         )

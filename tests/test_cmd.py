@@ -121,3 +121,67 @@ def test_leader_election_gates_second_instance(tmp_path, capsys):
     # blocked waiting on the lease -> never scheduled, thread still alive
     assert t.is_alive()
     assert "rc" not in done
+
+
+def test_koord_scheduler_serve_mode():
+    """--serve runs the long-lived solver sidecar: a real gRPC client can
+    sync a world and get nominations while the binary blocks."""
+    import io
+    import re
+    import threading
+    import time
+    from contextlib import redirect_stdout
+
+    from koordinator_tpu.cmd import koord_scheduler
+    from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+    from koordinator_tpu.runtime.snapshot_channel import SolverClient
+
+    buf = io.StringIO()
+
+    def run():
+        with redirect_stdout(buf):
+            koord_scheduler.main(
+                ["--serve", "127.0.0.1:0", "--batch-bucket", "64"]
+            )
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    port = None
+    for _ in range(100):
+        m = re.search(r"listening on port (\d+)", buf.getvalue())
+        if m:
+            port = int(m.group(1))
+            break
+        time.sleep(0.05)
+    assert port, buf.getvalue()
+
+    client = SolverClient(f"127.0.0.1:{port}")
+    try:
+        cfg_resp = client.get_config()
+        res = list(cfg_resp.resources)
+        d = pb.SnapshotDelta(revision=1, now=1000.0)
+        d.node_upserts.add(
+            name="n0",
+            allocatable=pb.ResourceVector(
+                values=[32000.0 if r == "cpu" else 131072.0 for r in res]
+            ),
+        )
+        assert client.sync(d).node_count == 1
+        req = pb.NominateRequest()
+        req.pods.add(
+            uid="p0",
+            requests=pb.ResourceVector(
+                values=[1000.0 if r == "cpu" else 1024.0 for r in res]
+            ),
+            priority=9000,
+        )
+        resp = client.nominate(req)
+        assert resp.nominations[0].node == "n0"
+    finally:
+        client.close()
+
+
+def test_koord_sim_binary_runs_the_loop():
+    from koordinator_tpu.cmd import koord_sim
+
+    assert koord_sim.main(["--minutes", "2", "--nodes", "4", "--quiet"]) == 0

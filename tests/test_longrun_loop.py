@@ -4,12 +4,7 @@ runtimehook plan, composed in ONE process for N simulated minutes, with
 per-tick consistency invariants (accounting drift, batch-capacity bounds)
 asserted inside the driver (examples/longrun_loop.py)."""
 
-import os
-import sys
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
-
-from longrun_loop import run_loop
+from koordinator_tpu.sim.longrun import run_loop
 
 
 def test_longrun_feedback_loop_stays_consistent():

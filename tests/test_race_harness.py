@@ -47,6 +47,8 @@ def run_racers(fns, duration_s=1.0, threads_per_fn=2):
     stop.set()
     for t in ts:
         t.join(timeout=10)
+    # a deadlocked component must FAIL the harness, not time out silently
+    assert not any(t.is_alive() for t in ts), "racer thread deadlocked"
     if errors:
         raise errors[0]
 
