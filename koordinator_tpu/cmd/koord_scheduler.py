@@ -36,7 +36,15 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def main(argv: Optional[List[str]] = None) -> int:
+def main(
+    argv: Optional[List[str]] = None,
+    _stop_event=None,
+    _on_serve=None,
+) -> int:
+    """``_stop_event``/``_on_serve`` are embedding hooks for --serve mode:
+    a threading.Event that ends the serve loop, and a callback receiving
+    (server, port) once listening — tests and embedders use them instead
+    of signals/stdout scraping."""
     args = build_parser().parse_args(argv)
     _common.apply_feature_gates(SCHEDULER_GATES, args.feature_gates)
 
@@ -60,12 +68,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         service = SolverService(args=la_args, batch_bucket=args.batch_bucket)
         server, port = serve(service, address=args.serve)
         print(f"koord-scheduler: solver service listening on port {port}", flush=True)
-        stop = threading.Event()
-        for sig in (signal.SIGINT, signal.SIGTERM):
-            try:
+        stop = _stop_event if _stop_event is not None else threading.Event()
+        try:
+            for sig in (signal.SIGINT, signal.SIGTERM):
                 signal.signal(sig, lambda *_: stop.set())
-            except ValueError:
-                pass  # non-main thread (tests drive main() directly)
+        except ValueError:
+            # non-main thread: the embedder must supply _stop_event —
+            # without one there would be no way to ever return
+            if _stop_event is None:
+                raise RuntimeError(
+                    "--serve from a non-main thread requires a stop event "
+                    "(main(..., _stop_event=...))"
+                )
+        if _on_serve is not None:
+            _on_serve(server, port)
         stop.wait()
         server.stop(grace=5.0)
         return 0

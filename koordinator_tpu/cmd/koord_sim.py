@@ -4,8 +4,9 @@ The reference exercises its cross-component data flow on a kind cluster
 (SURVEY §4: koordlet → NodeMetric → slo-controller → scheduler →
 runtimehooks); this binary is the rebuild's stand-in: it composes every
 component in-process and drives them for N simulated minutes with
-per-tick consistency invariants (see ``examples/longrun_loop.py`` for the
-driver, ``tests/test_longrun_loop.py`` for the asserted invariants).
+per-tick consistency invariants (driver:
+``koordinator_tpu/sim/longrun.py``; asserted invariants:
+``tests/test_longrun_loop.py``).
 
     python -m koordinator_tpu.cmd.koord_sim --minutes 30 --nodes 8
 """

@@ -30,6 +30,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="run for N seconds then exit (0 = forever)",
     )
     parser.add_argument("--collect-interval", type=float, default=1.0)
+    parser.add_argument(
+        "--kubelet-addr",
+        default="",
+        help="pull the pod list from this kubelet's /pods endpoint",
+    )
+    parser.add_argument("--kubelet-port", type=int, default=10255)
     return parser
 
 
@@ -41,6 +47,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         node_name=args.node_name,
         cgroup_root=args.cgroup_root,
         collect_interval_s=args.collect_interval,
+        kubelet_addr=args.kubelet_addr,
+        kubelet_port=args.kubelet_port,
     )
     agent = Koordlet(cfg)
     agent.run(duration_s=args.duration or float("inf"))

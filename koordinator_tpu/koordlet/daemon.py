@@ -46,6 +46,9 @@ class KoordletConfig:
     cgroup_root: str = "/sys/fs/cgroup"
     proc_root: str = "/proc"
     sys_root: str = "/sys"
+    #: kubelet /pods pull source ("" disables; see statesinformer.KubeletStub)
+    kubelet_addr: str = ""
+    kubelet_port: int = 10255
     n_cpus: Optional[int] = None
     node_allocatable_milli: float = 0.0      # 0 = n_cpus × 1000
     node_memory_capacity_mib: float = 0.0
@@ -339,10 +342,27 @@ class Koordlet:
         return restored
 
     def run(self, duration_s: float = float("inf")) -> None:
-        """Wall-clock loop for real deployment."""
+        """Wall-clock loop for real deployment. With a kubelet address
+        configured, each report interval also re-pulls the pod list from
+        the kubelet's /pods endpoint (impl/kubelet_stub.go flow); a failed
+        pull keeps the previous view."""
+        from .statesinformer import KubeletStub
+
+        stub = None
+        if self.config.kubelet_addr:
+            stub = KubeletStub(
+                addr=self.config.kubelet_addr, port=self.config.kubelet_port
+            )
         deadline = time.time() + duration_s
+        last_pull = 0.0
         while time.time() < deadline:
             now = time.time()
+            if stub is not None and now - last_pull >= self.config.report_interval_s:
+                # retry at the collect cadence until a pull succeeds — a
+                # transient kubelet outage must not blind the pod view
+                # for a whole report interval
+                if stub.sync_into(self.informer):
+                    last_pull = now
             self.collect_tick(now)
             self.qos_tick(now)
             self.report_tick(now)

@@ -247,4 +247,6 @@ def run_loop(
             )
 
     stats["live_at_end"] = len(live)
+    if stats["min_batch_cap"] == float("inf"):
+        stats["min_batch_cap"] = 0.0  # zero-tick run: keep JSON standard
     return stats

@@ -126,36 +126,31 @@ def test_leader_election_gates_second_instance(tmp_path, capsys):
 def test_koord_scheduler_serve_mode():
     """--serve runs the long-lived solver sidecar: a real gRPC client can
     sync a world and get nominations while the binary blocks."""
-    import io
-    import re
     import threading
-    import time
-    from contextlib import redirect_stdout
 
     from koordinator_tpu.cmd import koord_scheduler
     from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
     from koordinator_tpu.runtime.snapshot_channel import SolverClient
 
-    buf = io.StringIO()
+    stop = threading.Event()
+    ready = threading.Event()
+    state = {}
 
-    def run():
-        with redirect_stdout(buf):
-            koord_scheduler.main(
-                ["--serve", "127.0.0.1:0", "--batch-bucket", "64"]
-            )
+    def on_serve(server, port):
+        state["port"] = port
+        ready.set()
 
-    t = threading.Thread(target=run, daemon=True)
+    t = threading.Thread(
+        target=lambda: koord_scheduler.main(
+            ["--serve", "127.0.0.1:0", "--batch-bucket", "64"],
+            _stop_event=stop,
+            _on_serve=on_serve,
+        ),
+    )
     t.start()
-    port = None
-    for _ in range(100):
-        m = re.search(r"listening on port (\d+)", buf.getvalue())
-        if m:
-            port = int(m.group(1))
-            break
-        time.sleep(0.05)
-    assert port, buf.getvalue()
+    assert ready.wait(timeout=30)
 
-    client = SolverClient(f"127.0.0.1:{port}")
+    client = SolverClient(f"127.0.0.1:{state['port']}")
     try:
         cfg_resp = client.get_config()
         res = list(cfg_resp.resources)
@@ -179,6 +174,9 @@ def test_koord_scheduler_serve_mode():
         assert resp.nominations[0].node == "n0"
     finally:
         client.close()
+        stop.set()
+        t.join(timeout=30)
+        assert not t.is_alive(), "--serve did not shut down on stop event"
 
 
 def test_koord_sim_binary_runs_the_loop():

@@ -2,7 +2,7 @@
 NodeMetric report → noderesource batch capacity → scheduler batch →
 runtimehook plan, composed in ONE process for N simulated minutes, with
 per-tick consistency invariants (accounting drift, batch-capacity bounds)
-asserted inside the driver (examples/longrun_loop.py)."""
+asserted inside the driver (koordinator_tpu/sim/longrun.py)."""
 
 from koordinator_tpu.sim.longrun import run_loop
 
