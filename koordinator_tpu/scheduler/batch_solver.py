@@ -257,6 +257,9 @@ class BatchScheduler:
             from .plugins.coscheduling import gang_key_of
             from .plugins.elasticquota import quota_name_of
 
+            # refresh the Available candidate cache once per cycle (the
+            # per-pod match scan must not re-validate every reservation)
+            self.reservations.begin_cycle()
             remaining_pending = []
             affinity_unsched: List[Pod] = []
             for pod in pending:

@@ -87,9 +87,12 @@ class ReservationManager:
         self.scheduler = scheduler
         scheduler.reservations = self  # enable the pre-match commit path
         self._reservations: Dict[str, Reservation] = {}
+        #: per-cycle Available candidate cache (see begin_cycle)
+        self._cycle_candidates: Optional[List[Reservation]] = None
 
     def add(self, reservation: Reservation) -> None:
         self._reservations[reservation.meta.name] = reservation
+        self._cycle_candidates = None
 
     def get(self, name: str) -> Optional[Reservation]:
         return self._reservations.get(name)
@@ -124,6 +127,7 @@ class ReservationManager:
         outcome = self.scheduler.schedule([self._ghost_pod(r) for r in pending])
         import time as _t
 
+        self._cycle_candidates = None
         for pod, node in outcome.bound:
             r = ghosts[pod.meta.uid]
             r.phase = ReservationPhase.AVAILABLE
@@ -178,15 +182,9 @@ class ReservationManager:
         best: Optional[Reservation] = None
         best_score = -1.0
         best_order: Optional[int] = None
-        for r in self._reservations.values():
-            if r.phase != ReservationPhase.AVAILABLE or r.node_name is None:
-                continue
-            if self.scheduler.snapshot.node_id(r.node_name) is None:
-                # node removed from the cluster: the ghost hold died with
-                # it (remove_node purges assumed pods) — fail the
-                # reservation instead of nominating a dead node
-                r.phase = ReservationPhase.FAILED
-                continue
+        for r in self._candidates():
+            if r.phase != ReservationPhase.AVAILABLE:
+                continue  # consumed earlier in this same cycle
             if r.allocate_once and r.current_owners:
                 continue
             if affinity is not None:
@@ -225,6 +223,42 @@ class ReservationManager:
                 best_score = score
                 best = r
         return best
+
+    def begin_cycle(self) -> None:
+        """Cache the Available candidate set for one scheduling cycle
+        (r1 weak item: the per-pod ``match`` scan re-checked phase and
+        node liveness for EVERY reservation on EVERY pod — with a large
+        reservation population that was a host hot spot in exactly the
+        regime the TPU rebuild wins). Dead-node reservations are failed
+        here, once."""
+        candidates: List[Reservation] = []
+        for r in self._reservations.values():
+            if r.phase != ReservationPhase.AVAILABLE or r.node_name is None:
+                continue
+            if self.scheduler.snapshot.node_id(r.node_name) is None:
+                # node removed from the cluster: the ghost hold died with
+                # it (remove_node purges assumed pods) — fail the
+                # reservation instead of nominating a dead node
+                r.phase = ReservationPhase.FAILED
+                continue
+            candidates.append(r)
+        self._cycle_candidates = candidates
+
+    def _candidates(self) -> List[Reservation]:
+        """The cycle cache, with node liveness re-checked per use: a
+        direct ``match()`` after a node-remove delta (outside a
+        ``schedule()`` cycle) must never nominate a dead node. The
+        liveness check is one dict lookup per candidate — the cache still
+        saves the full-dict scan and phase bookkeeping."""
+        if self._cycle_candidates is None:
+            self.begin_cycle()
+        snap = self.scheduler.snapshot
+        return [
+            r
+            for r in self._cycle_candidates
+            if r.node_name is not None
+            and snap.node_id(r.node_name) is not None
+        ]
 
     def release_ghost_holds(self, reservation: Reservation) -> None:
         """Release the ghost's per-winner NUMA/device allocations (the
