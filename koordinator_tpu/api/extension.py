@@ -55,6 +55,7 @@ ANNOTATION_DEVICE_JOINT_ALLOCATE = f"scheduling.{DOMAIN}/device-joint-allocate"
 ANNOTATION_GPU_PARTITIONS = f"scheduling.{DOMAIN}/gpu-partitions"
 #: node label choosing Honor/Prefer (LabelGPUPartitionPolicy)
 LABEL_GPU_PARTITION_POLICY = f"node.{DOMAIN}/gpu-partition-policy"
+LABEL_GPU_MODEL = f"node.{DOMAIN}/gpu-model"
 ANNOTATION_NODE_CPU_TOPOLOGY = f"node.{DOMAIN}/cpu-topology"
 ANNOTATION_NODE_RAW_ALLOCATABLE = f"node.{DOMAIN}/raw-allocatable"
 ANNOTATION_NODE_AMPLIFICATION = f"node.{DOMAIN}/resource-amplification-ratio"
@@ -182,6 +183,78 @@ def parse_fpga_request(requests: Mapping[str, float]) -> int:
     """Whole FPGAs from ``koordinator.sh/fpga`` (``device_share.go:49``,
     same 100-unit instance convention as RDMA)."""
     return _count_request(requests, RES_FPGA)
+
+
+def parse_gpu_partition_table(annotations: Mapping[str, str]):
+    """Node-side partition table from the Device CR annotation
+    (``GetGPUPartitionTable``, ``device_share.go:354-367``): ``{"<size>":
+    [{"minors": [...], "gpuLinkType": ..., "ringBusBandwidth": ...,
+    "allocationScore": ...}]}`` → {size: [GPUPartition]}. Returns {} for
+    absent/malformed payloads (the allocator then falls back to the
+    model-dispatched default table or topology packing)."""
+    import json as _json
+
+    from .types import GPUPartition
+
+    raw = annotations.get(ANNOTATION_GPU_PARTITIONS)
+    if not raw:
+        return {}
+    try:
+        table = _json.loads(raw)
+    except (ValueError, TypeError):
+        return {}
+    if not isinstance(table, dict):
+        return {}
+    out = {}
+    for size_raw, parts in table.items():
+        try:
+            size = int(size_raw)
+        except (TypeError, ValueError):
+            continue
+        if not isinstance(parts, list):
+            continue
+        entries = []
+        for p in parts:
+            if not isinstance(p, dict):
+                continue
+            minors = p.get("minors")
+            if (
+                not isinstance(minors, list)
+                or len(minors) != size
+                or not all(isinstance(m, int) and m >= 0 for m in minors)
+            ):
+                # negative minors would crash minors_mask; a size/len
+                # mismatch would silently under-allocate
+                continue
+            try:
+                bw = float(p.get("ringBusBandwidth", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                bw = 0.0
+            try:
+                score = int(p.get("allocationScore", 1) or 1)
+            except (TypeError, ValueError):
+                score = 1
+            entries.append(
+                GPUPartition(
+                    minors=minors,
+                    link_type=str(p.get("gpuLinkType", "NVLink")),
+                    ring_bus_bandwidth=bw,
+                    allocation_score=score,
+                )
+            )
+        if entries:
+            out[size] = entries
+    return out
+
+
+def gpu_partition_policy(labels: Mapping[str, str]) -> str:
+    """Honor iff the node/device label says so; anything else is Prefer
+    (``GetGPUPartitionPolicy``, ``device_share.go:369-377``)."""
+    return (
+        "Honor"
+        if labels.get(LABEL_GPU_PARTITION_POLICY) == "Honor"
+        else "Prefer"
+    )
 
 
 def parse_device_joint_allocate(

@@ -190,6 +190,9 @@ class ClusterSnapshot:
         self._node_index: Dict[str, int] = {}
         self._node_names: List[str] = []
         self._free_node_slots: List[int] = []
+        #: bumped on any node add/remove — cheap staleness check for
+        #: consumers caching node-derived views (reservation candidates)
+        self.node_epoch = 0
         self.nodes = NodeArrays.empty(self.config.min_bucket, self.config.dims)
         #: pod uid -> _AssumedPod for assumed/bound pods
         self._assumed: Dict[str, "_AssumedPod"] = {}
@@ -205,6 +208,7 @@ class ClusterSnapshot:
         self.nodes = NodeArrays.empty(self.config.min_bucket, self.config.dims)
         self._assumed.clear()
         self._node_labels.clear()
+        self.node_epoch += 1
 
     # ---- node side ----
 
@@ -245,6 +249,7 @@ class ClusterSnapshot:
                 self._grow_nodes(idx + 1)
             self._node_index[node.meta.name] = idx
             self.nodes.n_real = max(self.nodes.n_real, idx + 1)
+            self.node_epoch += 1
         self.nodes.allocatable[idx] = self.config.res_vector(node.status.allocatable)
         self.nodes.schedulable[idx] = not node.unschedulable
         self._node_labels[node.meta.name] = dict(node.meta.labels)
@@ -258,6 +263,7 @@ class ClusterSnapshot:
         self._node_labels.pop(name, None)
         if idx is None:
             return
+        self.node_epoch += 1
         for arr in (
             self.nodes.allocatable,
             self.nodes.requested,

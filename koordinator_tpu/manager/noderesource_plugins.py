@@ -141,7 +141,7 @@ class ResourceAmplificationPlugin:
         )
 
 
-LABEL_GPU_MODEL = f"node.{ext.DOMAIN}/gpu-model"
+LABEL_GPU_MODEL = ext.LABEL_GPU_MODEL
 LABEL_GPU_DRIVER = f"node.{ext.DOMAIN}/gpu-driver"
 
 

@@ -112,14 +112,29 @@ class DeviceManager:
         gpus = [d for d in device.devices if d.dev_type == "gpu"]
         rdma = [d for d in device.devices if d.dev_type == "rdma"]
         fpga = [d for d in device.devices if d.dev_type == "fpga"]
+        # partition table resolution (reference GetGPUPartitionTable →
+        # getGPUPartitionIndexer): explicit field, else the Device CR's
+        # gpu-partitions annotation, else the model-dispatched default
+        partitions = dict(device.partitions)
+        if not partitions:
+            partitions = ext.parse_gpu_partition_table(device.meta.annotations)
+        if not partitions:
+            model = device.meta.labels.get(ext.LABEL_GPU_MODEL, "")
+            if model:
+                partitions = partition_table_for_model(model)
+        policy = device.partition_policy or (
+            ext.gpu_partition_policy(device.meta.labels)
+            if partitions
+            else ""
+        )
         old = self._nodes.get(device.meta.name)
         st = _NodeDevices(
             gpu_free=[FULL] * len(gpus),
             rdma_free=[FULL] * len(rdma),
             rdma_pcie=[d.pcie_bus for d in rdma],
             fpga_free=[FULL] * len(fpga),
-            partitions=dict(device.partitions),
-            partition_policy=device.partition_policy,
+            partitions=partitions,
+            partition_policy=policy,
             numa_of=[d.numa_node for d in gpus],
             pcie_of=[d.pcie_bus for d in gpus],
         )

@@ -89,6 +89,7 @@ class ReservationManager:
         self._reservations: Dict[str, Reservation] = {}
         #: per-cycle Available candidate cache (see begin_cycle)
         self._cycle_candidates: Optional[List[Reservation]] = None
+        self._cycle_epoch = -1
 
     def add(self, reservation: Reservation) -> None:
         self._reservations[reservation.meta.name] = reservation
@@ -243,22 +244,19 @@ class ReservationManager:
                 continue
             candidates.append(r)
         self._cycle_candidates = candidates
+        self._cycle_epoch = self.scheduler.snapshot.node_epoch
 
     def _candidates(self) -> List[Reservation]:
-        """The cycle cache, with node liveness re-checked per use: a
-        direct ``match()`` after a node-remove delta (outside a
-        ``schedule()`` cycle) must never nominate a dead node. The
-        liveness check is one dict lookup per candidate — the cache still
-        saves the full-dict scan and phase bookkeeping."""
-        if self._cycle_candidates is None:
+        """The cycle cache, rebuilt whenever the snapshot's node topology
+        changed since it was built (node_epoch): a direct ``match()``
+        after a node-remove delta must never nominate a dead node, and
+        the common path pays zero per-pod re-validation."""
+        if (
+            self._cycle_candidates is None
+            or self._cycle_epoch != self.scheduler.snapshot.node_epoch
+        ):
             self.begin_cycle()
-        snap = self.scheduler.snapshot
-        return [
-            r
-            for r in self._cycle_candidates
-            if r.node_name is not None
-            and snap.node_id(r.node_name) is not None
-        ]
+        return self._cycle_candidates
 
     def release_ghost_holds(self, reservation: Reservation) -> None:
         """Release the ghost's per-winner NUMA/device allocations (the

@@ -675,3 +675,70 @@ def test_fpga_capacity_and_allocation_e2e():
     assert alloc["fpga"][0]["resources"][ext.RES_FPGA] == 100.0
     dm.release(out.bound[0][0].meta.uid, "n0")
     assert sorted(st.fpga_free) == [0.0, 100.0]
+
+
+def test_partition_table_from_annotation_and_model():
+    """Partition resolution order (GetGPUPartitionTable → model dispatch):
+    the Device CR's gpu-partitions annotation wins, then the gpu-model
+    label's default table; the Honor/Prefer label is honored."""
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(allocatable={ext.RES_CPU: 64000}),
+        )
+    )
+    ann = {
+        ext.ANNOTATION_GPU_PARTITIONS: json.dumps(
+            {
+                "2": [
+                    {"minors": [0, 1], "ringBusBandwidth": 200,
+                     "allocationScore": 3},
+                    {"minors": [2, 3]},
+                ]
+            }
+        )
+    }
+    labels = {ext.LABEL_GPU_PARTITION_POLICY: "Honor"}
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="n0", annotations=ann, labels=labels),
+            devices=[DeviceInfo(dev_type="gpu", minor=g) for g in range(4)],
+        )
+    )
+    st = dm.node("n0")
+    assert st.partition_policy == "Honor"
+    assert [p.minors for p in st.partitions[2]] == [[0, 1], [2, 3]]
+    assert st.partitions[2][0].ring_bus_bandwidth == 200.0
+    # Honor is binding: the higher-score pair wins first
+    got = minors_of(dm.allocate(gpu_pod("pair", whole=2), "n0"))
+    assert got == [0, 1]
+    # unsupported size under Honor fails
+    assert dm.allocate(gpu_pod("tri", whole=3), "n0") is None
+
+    # model-label fallback: H800 gets the Hopper table, default Prefer
+    dm2 = DeviceManager(snap)
+    dm2.upsert_device(
+        Device(
+            meta=ObjectMeta(
+                name="n0", labels={ext.LABEL_GPU_MODEL: "H800"}
+            ),
+            devices=[DeviceInfo(dev_type="gpu", minor=g) for g in range(8)],
+        )
+    )
+    st2 = dm2.node("n0")
+    assert sorted(st2.partitions) == [1, 2, 4, 8]
+    assert st2.partition_policy == "Prefer"
+    # malformed annotation degrades to no table
+    dm3 = DeviceManager(snap)
+    dm3.upsert_device(
+        Device(
+            meta=ObjectMeta(
+                name="n0",
+                annotations={ext.ANNOTATION_GPU_PARTITIONS: "not json"},
+            ),
+            devices=[DeviceInfo(dev_type="gpu", minor=0)],
+        )
+    )
+    assert dm3.node("n0").partitions == {}
