@@ -326,6 +326,35 @@ def parse_gpu_partition_spec(annotations: Mapping[str, str]) -> tuple[bool, floa
     return spec.get("allocatePolicy") == "Restricted", bandwidth
 
 
+def parse_node_amplification(annotations: Mapping[str, str]) -> Mapping[str, float]:
+    """Resource → amplification ratio from the node annotation (reference
+    ``apis/extension/node_resource_amplification.go``
+    ``GetNodeResourceAmplificationRatio``). Wire format is
+    ``cpu=1.5,memory=1.2``; malformed entries are skipped."""
+    raw = annotations.get(ANNOTATION_NODE_AMPLIFICATION, "")
+    out = {}
+    for part in filter(None, raw.split(",")):
+        key, _, val = part.partition("=")
+        if not key:
+            continue
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def wants_cpu_bind(pod) -> bool:
+    """Pod takes an exclusive cpuset: LSR/LSE QoS with a positive
+    whole-core CPU request (reference ``nodenumaresource/plugin.go:251-313``
+    requiredCPUBindPolicy resolution). Shared across the snapshot's
+    amplified-CPU accounting and the NUMA manager."""
+    if pod.qos not in (QoSClass.LSR, QoSClass.LSE):
+        return False
+    cpu = pod.spec.requests.get(RES_CPU, 0.0)
+    return cpu > 0 and cpu % 1000 == 0
+
+
 def qos_for_priority(prio: PriorityClass) -> QoSClass:
     """Default QoS when unspecified, by priority band (reference
     ``apis/extension/qos.go`` ``GetPodQoSClassByName`` fallback semantics)."""

@@ -64,6 +64,9 @@ class NodeArrays:
                          (prod thresholds count only prod-tier pods)      [N, D]
       metric_fresh     — NodeMetric not expired             [N] bool
       schedulable      — not cordoned, padded rows False    [N] bool
+      cpu_amp          — CPU amplification ratio from the node annotation
+                         (``apis/extension/node_resource_amplification.go``),
+                         1.0 when unset                                   [N]
     """
 
     allocatable: np.ndarray
@@ -76,6 +79,7 @@ class NodeArrays:
     assigned_pending_prod: np.ndarray
     metric_fresh: np.ndarray
     schedulable: np.ndarray
+    cpu_amp: np.ndarray
     n_real: int
 
     @classmethod
@@ -92,6 +96,7 @@ class NodeArrays:
             assigned_pending_prod=z(),
             metric_fresh=np.zeros((n_bucket,), bool),
             schedulable=np.zeros((n_bucket,), bool),
+            cpu_amp=np.ones((n_bucket,), np.float32),
             n_real=0,
         )
 
@@ -183,6 +188,8 @@ class ClusterSnapshot:
         metric_expiry_s: float = 180.0,
     ):
         self.config = config or SnapshotConfig()
+        res = self.config.resources
+        self._cpu_dim = res.index(ext.RES_CPU) if ext.RES_CPU in res else 0
         #: NodeMetric aggregation percentile / expiry used at ingest
         #: (wired from LoadAwareSchedulingArgs by BatchScheduler)
         self.agg_type = agg_type
@@ -234,6 +241,9 @@ class ClusterSnapshot:
             assigned_pending_prod=pad(old.assigned_pending_prod),
             metric_fresh=pad(old.metric_fresh),
             schedulable=pad(old.schedulable),
+            cpu_amp=np.pad(
+                old.cpu_amp, (0, new - old.cpu_amp.shape[0]), constant_values=1.0
+            ),
             n_real=old.n_real,
         )
 
@@ -252,6 +262,8 @@ class ClusterSnapshot:
             self.node_epoch += 1
         self.nodes.allocatable[idx] = self.config.res_vector(node.status.allocatable)
         self.nodes.schedulable[idx] = not node.unschedulable
+        amp = ext.parse_node_amplification(node.meta.annotations)
+        self.nodes.cpu_amp[idx] = max(float(amp.get(ext.RES_CPU, 1.0)), 1.0)
         self._node_labels[node.meta.name] = dict(node.meta.labels)
         return idx
 
@@ -276,6 +288,7 @@ class ClusterSnapshot:
             arr[idx] = 0
         self.nodes.metric_fresh[idx] = False
         self.nodes.schedulable[idx] = False
+        self.nodes.cpu_amp[idx] = 1.0
         # Drop assumed-pod bookkeeping for the dead node so a later
         # forget_pod cannot corrupt whichever node reuses this slot.
         self._assumed = {
@@ -374,10 +387,21 @@ class ClusterSnapshot:
             if request is not None
             else self.config.res_vector(pod.spec.requests)
         )
-        self.nodes.requested[idx] += req
+        # the usage estimate defaults to the *physical* request — a bound
+        # pod on an amplified node still only burns its physical cores
         est = np.asarray(
             estimated if estimated is not None else req, np.float32
         )
+        # cpuset-bound pods consume physical cores: on an amplified node
+        # their CPU charge counts ×ratio (nodenumaresource/plugin.go:430-438
+        # — requested − allocated + amplify(allocated)). Charging here keeps
+        # every assume/forget path symmetric, with or without a registered
+        # NUMA topology.
+        amp = float(self.nodes.cpu_amp[idx])
+        if amp > 1.0 and ext.wants_cpu_bind(pod):
+            req = req.copy()
+            req[self._cpu_dim] *= amp
+        self.nodes.requested[idx] += req
         is_prod = pod.priority_class == ext.PriorityClass.PROD
         if not absorbed:
             self.nodes.assigned_pending[idx] += est

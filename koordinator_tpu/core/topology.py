@@ -433,6 +433,11 @@ class CPUAccumulator:
     def cpuset_of(self, owner: str) -> Optional[Set[int]]:
         return self._owners.get(owner)
 
+    def allocated_count(self) -> int:
+        """Number of exclusively-held CPUs (the reference's
+        ``GetAvailableCPUs`` allocated set size, ``plugin.go:430-433``)."""
+        return len(self._allocated)
+
 
 def format_cpuset(cpus: Sequence[int]) -> str:
     """Render a cpuset in kernel list format (e.g. "0-3,8,10-11")."""

@@ -238,12 +238,4 @@ def apply_items(node: Node, items: Sequence[ResourceItem]) -> Node:
 def parse_amplification(node: Node) -> Dict[str, float]:
     """Scheduler-side accessor for the amplification annotation (reference
     ``apis/extension/node_resource_amplification.go``)."""
-    raw = node.meta.annotations.get(ext.ANNOTATION_NODE_AMPLIFICATION, "")
-    out: Dict[str, float] = {}
-    for part in filter(None, raw.split(",")):
-        key, _, val = part.partition("=")
-        try:
-            out[key] = float(val)
-        except ValueError:
-            continue
-    return out
+    return dict(ext.parse_node_amplification(node.meta.annotations))

@@ -43,6 +43,7 @@ def numa_fit_mask(
     pod_requests: jnp.ndarray,   # [P, D] full resource axis
     pod_wants_numa: jnp.ndarray,  # [P] bool (LSR/LSE-style alignment need)
     numa: NumaState,
+    cpu_amp: jnp.ndarray | None = None,  # [N] node CPU amplification ratio
 ) -> jnp.ndarray:
     """[P, N] feasibility under each node's topology policy.
 
@@ -51,22 +52,41 @@ def numa_fit_mask(
     across zones suffices (alignment is then a scoring preference). Pods
     not requesting alignment are always NUMA-feasible, as are nodes
     reporting no zones.
+
+    ``cpu_amp`` mirrors the reference's ``AmplifyResourceList`` on the
+    request side (``nodenumaresource/plugin.go:630-645``): zone capacities
+    are expected already in *amplified* space (``amplifyNUMANodeResources``
+    — the NUMAManager registers them that way), so cpuset-bound pods' CPU
+    requests amplify ×ratio to match (net physical semantics for bound
+    pods; stretched shared capacity for everyone else).
     """
     dn = numa.zone_free.shape[-1]
+    n = numa.zone_free.shape[0]
     req = pod_requests[:, :dn]                                 # [P, DN]
+    if cpu_amp is None:
+        amp = jnp.ones((n,), jnp.float32)
+    else:
+        amp = jnp.maximum(cpu_amp, 1.0)
+    # bound pods' CPU requests amplify with the capacity space; [P, N, DN]
+    # (XLA fuses this into the zone_fit reduction — nothing rank-3/4
+    # materializes in HBM)
+    scale = jnp.ones((n, dn), jnp.float32).at[:, 0].set(amp)    # [N, DN]
+    req_scale = 1.0 + pod_wants_numa[:, None, None].astype(jnp.float32) * (
+        scale[None, :, :] - 1.0
+    )                                                           # [P, N, DN]
+    req_eff = req[:, None, :] * req_scale                       # [P, N, DN]
     # dims a node's zones don't report (zero capacity, e.g. memory left
     # unregistered) are not checked — like a disabled threshold
     dim_on = jnp.sum(numa.zone_cap, axis=1) > 0                 # [N, DN]
-    req_b = req[:, None, None, :]
     zone_fit = jnp.all(
-        (req_b <= numa.zone_free[None, :, :, :] + EPS)
+        (req_eff[:, :, None, :] <= numa.zone_free[None, :, :, :] + EPS)
         | ~dim_on[None, :, None, :],
         axis=-1,
     )                                                           # [P, N, Z]
     any_zone = jnp.any(zone_fit, axis=-1)                       # [P, N]
     total_free = jnp.sum(numa.zone_free, axis=1)                # [N, DN]
     total_fit = jnp.all(
-        (req[:, None, :] <= total_free[None, :, :] + EPS) | ~dim_on[None, :, :],
+        (req_eff <= total_free[None, :, :] + EPS) | ~dim_on[None, :, :],
         axis=-1,
     )                                                           # [P, N]
     # topology presence comes from capacity, not remaining free space — an

@@ -66,6 +66,12 @@ class NodeState:
     prod_used: jnp.ndarray        # [N, D]
     metric_fresh: jnp.ndarray     # [N] bool
     schedulable: jnp.ndarray      # [N] bool
+    #: CPU amplification ratio per node (reference
+    #: ``apis/extension/node_resource_amplification.go``). allocatable is
+    #: already amplified (node webhook); exclusive cpuset pods consume
+    #: physical CPUs, so their requests count ×ratio against it
+    #: (``nodenumaresource/plugin.go:408-443`` filterAmplifiedCPUs).
+    cpu_amp: jnp.ndarray = None   # [N]
 
     @classmethod
     def create(
@@ -76,6 +82,7 @@ class NodeState:
         prod_used=None,
         metric_fresh=None,
         schedulable=None,
+        cpu_amp=None,
     ) -> "NodeState":
         allocatable = jnp.asarray(allocatable, jnp.float32)
         n = allocatable.shape[0]
@@ -92,6 +99,11 @@ class NodeState:
             ),
             schedulable=(
                 jnp.ones(n, bool) if schedulable is None else jnp.asarray(schedulable)
+            ),
+            cpu_amp=(
+                jnp.ones(n, jnp.float32)
+                if cpu_amp is None
+                else jnp.asarray(cpu_amp, jnp.float32)
             ),
         )
 
@@ -321,11 +333,36 @@ def _segment_prefix_sums(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.nd
     return cums - base
 
 
+#: extension.QoSClass values used on device (LSR/LSE need exclusive CPUs)
+QOS_LSR, QOS_LSE = 3, 4
+
+
+def _cpu_bind(pods: PodBatch) -> jnp.ndarray:
+    """[P] bool — pod wants an exclusive cpuset (the host predicate
+    ``nodenumaresource.wants_numa``: LSR/LSE QoS with a positive
+    whole-core CPU request; reference ``plugin.go:251-313``
+    requiredCPUBindPolicy resolution)."""
+    cpu_req = pods.requests[:, 0]
+    return (
+        ((pods.qos == QOS_LSR) | (pods.qos == QOS_LSE))
+        & (cpu_req > 0)
+        & (jnp.mod(cpu_req, 1000.0) == 0)
+    )
+
+
 def _feasible(
     pods: PodBatch, nodes: NodeState, params: SolverParams, active: jnp.ndarray
 ) -> jnp.ndarray:
     free = nodes.allocatable - nodes.requested
     feas = mask_ops.fit_mask(pods.requests, free)
+    # Amplified-CPU filter (nodenumaresource/plugin.go:408-443): on nodes
+    # whose allocatable was amplified (ratio > 1), a cpuset-bound pod's
+    # CPU request counts ×ratio — physical cores don't stretch. The
+    # already-allocated exclusive CPUs' amplified surcharge is folded into
+    # nodes.requested host-side (BatchScheduler.node_state).
+    amp = jnp.maximum(nodes.cpu_amp, 1.0)
+    eff_cpu = pods.requests[:, 0][:, None] * amp[None, :]
+    feas &= ~_cpu_bind(pods)[:, None] | (eff_cpu <= free[:, 0][None, :] + EPS)
     feas &= mask_ops.usage_threshold_mask(
         pods.estimate,
         nodes.estimated_used,
@@ -426,6 +463,9 @@ def assign(
         ) & jnp.uint32(0xFFFF)
         return cost + h.astype(jnp.float32) * (nomination_jitter / 65536.0)
 
+    # round-invariant: which pods bind exclusive CPUs (NUMA alignment +
+    # amplified-CPU charging both key off it)
+    bind_mask = _cpu_bind(spods)
     # NUMA zone feasibility is round-invariant at solver granularity (zone
     # consumption is a host-side PreBind concern) — compute once.
     if numa is not None:
@@ -433,14 +473,10 @@ def assign(
 
         # Alignment need mirrors the host predicate (nodenumaresource
         # wants_numa): LSR or LSE QoS with a positive whole-core request.
-        QOS_LSR, QOS_LSE = 3, 4  # extension.QoSClass values
-        cpu_req = spods.requests[:, 0]
-        wants = (
-            ((spods.qos == QOS_LSR) | (spods.qos == QOS_LSE))
-            & (cpu_req > 0)
-            & (jnp.mod(cpu_req, 1000.0) == 0)
+        wants = bind_mask
+        numa_mask = numa_fit_mask(
+            spods.requests, wants, numa, cpu_amp=nodes.cpu_amp
         )
-        numa_mask = numa_fit_mask(spods.requests, wants, numa)
         if numa_scoring is not None:
             # NUMA-aligned Least/MostAllocated Score strategies
             # (nodenumaresource/scoring.go:66-120): a static [P, N] score
@@ -492,6 +528,7 @@ def assign(
             prod_used=prod_used,
             metric_fresh=nodes.metric_fresh,
             schedulable=nodes.schedulable,
+            cpu_amp=nodes.cpu_amp,
         )
         round_quotas = QuotaState(runtime=quotas.runtime, used=qused)
         if quota_enabled:
@@ -569,7 +606,16 @@ def assign(
         # Priority-ordered per-node commit via segmented prefix sums.
         sortidx = jnp.argsort(node_key, stable=True).astype(jnp.int32)
         snode = node_key[sortidx]
+        gnode = jnp.minimum(snode, n - 1)
         sreq = spods.requests[sortidx]
+        # cpuset-bound pods consume physical cores: charge CPU ×ratio on
+        # amplified nodes so later rounds see true remaining capacity
+        # (the reference reaches the same state one pod at a time through
+        # Reserve → cpuset allocate → next GetAvailableCPUs).
+        samp = jnp.where(
+            bind_mask[sortidx], jnp.maximum(nodes.cpu_amp, 1.0)[gnode], 1.0
+        )
+        sreq = sreq.at[:, 0].multiply(samp)
         sest = spods.estimate[sortidx]
         sprod = spods.is_prod[sortidx]
         is_start = jnp.concatenate(
@@ -581,7 +627,6 @@ def assign(
             jnp.where(sprod[:, None], sest, 0.0), is_start
         )
 
-        gnode = jnp.minimum(snode, n - 1)
         alloc_g = nodes.allocatable[gnode]
         req0_g = requested[gnode]
         est0_g = est_used[gnode]
@@ -889,11 +934,17 @@ def assign_sequential(
     order = _priority_order(pods)
     spods = jax.tree.map(lambda a: a[order], pods)
 
+    amp = jnp.maximum(nodes.cpu_amp, 1.0)
+
     def step(carry, xs):
         requested, est_used, prod_used, qused = carry
-        req, est, is_prod, valid, qchain = xs
+        req, est, is_prod, valid, qchain, bind = xs
         free = nodes.allocatable - requested
-        feas = jnp.all(req[None, :] <= free + EPS, axis=-1)
+        # per-node effective request: cpuset-bound pods' CPU ×ratio on
+        # amplified nodes (filterAmplifiedCPUs, plugin.go:408-443)
+        req_eff = jnp.broadcast_to(req[None, :], free.shape)
+        req_eff = req_eff.at[:, 0].multiply(jnp.where(bind, amp, 1.0))
+        feas = jnp.all(req_eff <= free + EPS, axis=-1)
         # quota admission along the chain (pod-level, node-independent)
         qidx = jnp.clip(qchain, 0, q_cap - 1)
         q_valid = qchain >= 0
@@ -938,7 +989,7 @@ def assign_sequential(
         best = jnp.argmax(score).astype(jnp.int32)
         has = feas[best]
         onehot = (jnp.arange(n) == best)[:, None] & has
-        requested = requested + jnp.where(onehot, req[None, :], 0.0)
+        requested = requested + jnp.where(onehot, req_eff, 0.0)
         est_used = est_used + jnp.where(onehot, est[None, :], 0.0)
         prod_used = prod_used + jnp.where(onehot & is_prod, est[None, :], 0.0)
         if quota_enabled:
@@ -959,6 +1010,7 @@ def assign_sequential(
             spods.is_prod,
             spods.valid,
             spods.quota_chain,
+            _cpu_bind(spods),
         ),
     )
     assignment = jnp.full((p,), -1, jnp.int32).at[order].set(assigned_s)

@@ -86,6 +86,7 @@ def _node_spec() -> NodeState:
         prod_used=P("tp", None),
         metric_fresh=P("tp"),
         schedulable=P("tp"),
+        cpu_amp=P("tp"),
     )
 
 
@@ -223,6 +224,7 @@ def shard_map_nominate(
         prod_used=P("tp", None),
         metric_fresh=P("tp"),
         schedulable=P("tp"),
+        cpu_amp=P("tp"),
     )
 
     @partial(

@@ -144,6 +144,9 @@ class BatchScheduler:
     # ---- device lowering ----
 
     def node_state(self) -> NodeState:
+        # NB: the amplified-CPU surcharge for exclusively-held cores
+        # (plugin.go:430-438) is charged by snapshot.assume_pod itself, so
+        # na.requested is already amplified-space for bound pods.
         na = self.snapshot.nodes
         est_used = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
         return NodeState(
@@ -153,6 +156,7 @@ class BatchScheduler:
             prod_used=jnp.asarray(na.prod_usage + na.assigned_pending_prod),
             metric_fresh=jnp.asarray(na.metric_fresh),
             schedulable=jnp.asarray(na.schedulable),
+            cpu_amp=jnp.asarray(na.cpu_amp),
         )
 
     def pod_batch(self, pods: Sequence[Pod], bucket: Optional[int] = None) -> PodBatch:
@@ -786,9 +790,16 @@ class BatchScheduler:
                 results.append((pod, None))
                 continue
             req = req_rows[i]
+            # the admission guard must check what assume_pod will charge:
+            # bound pods' CPU counts ×ratio on amplified nodes
+            check = req
+            amp = float(na.cpu_amp[node_idx])
+            if amp > 1.0 and ext.wants_cpu_bind(pod):
+                check = req.copy()
+                check[self.snapshot._cpu_dim] *= amp
             if not bool(
                 np.all(
-                    na.requested[node_idx] + req
+                    na.requested[node_idx] + check
                     <= na.allocatable[node_idx] + 1e-3
                 )
                 and na.schedulable[node_idx]

@@ -47,13 +47,9 @@ def parse_resource_spec(pod: Pod) -> CPUBindPolicy:
 
 def wants_numa(pod: Pod) -> bool:
     """LSR/LSE pods with integer CPU requests need exclusive, aligned CPUs
-    (reference ``plugin.go:251-313`` requiredCPUBindPolicy resolution)."""
-    from ...api.extension import QoSClass
-
-    if pod.qos not in (QoSClass.LSR, QoSClass.LSE):
-        return False
-    cpu = pod.spec.requests.get(ext.RES_CPU, 0.0)
-    return cpu > 0 and cpu % 1000 == 0
+    (reference ``plugin.go:251-313`` requiredCPUBindPolicy resolution) —
+    one predicate shared with the snapshot's amplified-CPU charging."""
+    return ext.wants_cpu_bind(pod)
 
 
 @dataclasses.dataclass
@@ -67,8 +63,13 @@ class _NodeNUMA:
     #: [Z][ZONE_DIMS] allocated per zone
     zone_used: List[List[float]]
     accumulator: CPUAccumulator
-    #: pod uid -> (zone, request vec)
-    owners: Dict[str, Tuple[int, List[float]]] = dataclasses.field(
+    #: CPU amplification ratio the zone capacities were registered with
+    cpu_amp: float = 1.0
+    #: physical (unamplified) zone CPU milli, for ratio re-sync
+    phys_zone_cpu: List[float] = dataclasses.field(default_factory=list)
+    #: pod uid -> (zone, charged vec, nominal bind cpu milli — 0 if the
+    #: charge was nominal/shared)
+    owners: Dict[str, Tuple[int, List[float], float]] = dataclasses.field(
         default_factory=dict
     )
 
@@ -95,12 +96,26 @@ class NUMAManager:
         topology: CPUTopology,
         policy: NUMAPolicy = NUMAPolicy.NONE,
         memory_per_zone_mib: float = 0.0,
+        cpu_amp: Optional[float] = None,
     ) -> None:
+        """``cpu_amp`` defaults to the snapshot's node amplification ratio;
+        zone CPU capacity is registered in *amplified* space (reference
+        ``amplifyNUMANodeResources``, ``plugin.go:630-632``) — bound pods'
+        zone charges amplify with it, the cpuset accumulator stays
+        physical."""
+        if cpu_amp is None:
+            idx = self.snapshot.node_id(node_name)
+            cpu_amp = (
+                float(self.snapshot.nodes.cpu_amp[idx]) if idx is not None else 1.0
+            )
+        cpu_amp = max(float(cpu_amp), 1.0)
         z = topology.num_numa_nodes
         zone_alloc = [[0.0] * ZONE_DIMS for _ in range(self.max_zones)]
+        phys = [0.0] * self.max_zones
         for zone in range(min(z, self.max_zones)):
             n_cpus = len(topology.cpus_in_numa(zone))
-            zone_alloc[zone][0] = n_cpus * 1000.0
+            phys[zone] = n_cpus * 1000.0
+            zone_alloc[zone][0] = phys[zone] * cpu_amp
             zone_alloc[zone][1] = memory_per_zone_mib
         self._nodes[node_name] = _NodeNUMA(
             topology=topology,
@@ -108,7 +123,31 @@ class NUMAManager:
             zone_alloc=zone_alloc,
             zone_used=[[0.0] * ZONE_DIMS for _ in range(self.max_zones)],
             accumulator=CPUAccumulator(topology),
+            cpu_amp=cpu_amp,
+            phys_zone_cpu=phys,
         )
+
+    def _sync_amp(self, node_name: str, st: _NodeNUMA) -> None:
+        """Re-base zone capacities and bound charges onto the snapshot's
+        *live* amplification ratio. register_node may have run before the
+        Node upsert (ratio unknown → 1.0) or the annotation may have
+        changed since; the solver always amplifies with the live ratio, so
+        the manager must live in the same space."""
+        idx = self.snapshot.node_id(node_name)
+        if idx is None:
+            return
+        live = max(float(self.snapshot.nodes.cpu_amp[idx]), 1.0)
+        if live == st.cpu_amp:
+            return
+        for zone in range(self.max_zones):
+            st.zone_alloc[zone][0] = st.phys_zone_cpu[zone] * live
+        for uid, (zone, charged, nominal_cpu) in list(st.owners.items()):
+            if nominal_cpu <= 0 or zone < 0:
+                continue
+            new_charge = nominal_cpu * live
+            st.zone_used[zone][0] += new_charge - charged[0]
+            st.owners[uid] = (zone, [new_charge] + charged[1:], nominal_cpu)
+        st.cpu_amp = live
 
     def node(self, name: str) -> Optional[_NodeNUMA]:
         return self._nodes.get(name)
@@ -127,6 +166,7 @@ class NUMAManager:
             idx = self.snapshot.node_id(name)
             if idx is None:
                 continue
+            self._sync_amp(name, st)
             alloc = np.asarray(st.zone_alloc, np.float32)
             zone_free[idx] = alloc - np.asarray(st.zone_used, np.float32)
             zone_cap[idx] = alloc
@@ -136,6 +176,7 @@ class NUMAManager:
     @property
     def has_topology(self) -> bool:
         return bool(self._nodes)
+
 
     # ---- per-winner exact assignment (PreBind) ----
 
@@ -147,6 +188,7 @@ class NUMAManager:
         st = self._nodes.get(node_name)
         if st is None:
             return {}
+        self._sync_amp(node_name, st)
         requests = pod.spec.requests
         req = [
             float(requests.get(ext.RES_CPU, 0.0)),
@@ -154,6 +196,14 @@ class NUMAManager:
         ]
 
         need_alignment = wants_numa(pod)
+        # record the nominal bind charge for every bound pod — even at
+        # ratio 1.0 — so a later annotation change can re-base it
+        nominal_cpu = req[0] if need_alignment else 0.0
+        if need_alignment and st.cpu_amp > 1.0:
+            # zone capacities are amplified space: a bound pod's physical
+            # cores charge ×ratio (AmplifyResourceList, plugin.go:636-640);
+            # the accumulator below still takes the physical core count
+            req = [req[0] * st.cpu_amp, req[1]]
         zone = -1
         if st.policy == NUMAPolicy.SINGLE_NUMA_NODE or need_alignment:
             # least-allocated fitting zone (pure-Python: Z is tiny and
@@ -174,7 +224,7 @@ class NUMAManager:
 
         cpuset_str = None
         if need_alignment:
-            n_cpus = int(req[0] // 1000)
+            n_cpus = int(float(requests.get(ext.RES_CPU, 0.0)) // 1000)
             cpuset = st.accumulator.take(
                 pod.meta.uid,
                 n_cpus,
@@ -188,7 +238,7 @@ class NUMAManager:
             used = st.zone_used[zone]
             for d in range(ZONE_DIMS):
                 used[d] += req[d]
-            st.owners[pod.meta.uid] = (zone, req)
+            st.owners[pod.meta.uid] = (zone, req, nominal_cpu)
         # hand-rendered resource-status JSON: json.dumps per winner was a
         # visible slice of the commit loop (payload shape is fixed)
         if cpuset_str is not None and zone >= 0:
@@ -220,7 +270,7 @@ class NUMAManager:
         st.accumulator.release(pod_uid)
         entry = st.owners.pop(pod_uid, None)
         if entry is not None:
-            zone, req = entry
+            zone, req, _nominal = entry
             used = st.zone_used[zone]
             for d in range(ZONE_DIMS):
                 used[d] -= req[d]

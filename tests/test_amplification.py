@@ -1,0 +1,275 @@
+"""Node resource amplification parity (reference
+``apis/extension/node_resource_amplification.go`` +
+``pkg/scheduler/plugins/nodenumaresource/plugin.go:408-443`` filterAmplifiedCPUs
+and ``plugin.go:630-645`` amplifyNUMANodeResources/getResourceOptions).
+
+Semantics under test: a node whose allocatable was amplified (ratio > 1)
+stretches *shared* CPU capacity, but cpuset-bound pods (LSR/LSE whole-core)
+consume physical cores — their requests count ×ratio against the amplified
+allocatable, and already-held exclusive CPUs surcharge node requested by
+(ratio−1)×held.
+"""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.core.topology import CPUTopology, NUMAPolicy
+from koordinator_tpu.ops.solver import (
+    NodeState,
+    PodBatch,
+    SolverParams,
+    assign,
+    assign_sequential,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.nodenumaresource import NUMAManager
+
+
+def params(d=2):
+    return SolverParams(
+        usage_thresholds=jnp.zeros(d, jnp.float32),
+        prod_thresholds=jnp.zeros(d, jnp.float32),
+        score_weights=jnp.ones(d, jnp.float32),
+    )
+
+
+def qos_pod_batch(cpu_milli, qos_values, d=2):
+    p = len(qos_values)
+    req = np.zeros((p, d), np.float32)
+    req[:, 0] = cpu_milli
+    req[:, 1] = 1024.0
+    return PodBatch.create(
+        requests=req,
+        estimate=req,
+        priority=np.full(p, 9500, np.int32),
+        qos=np.asarray(qos_values, np.int8),
+    )
+
+
+def test_parse_node_amplification():
+    ann = {ext.ANNOTATION_NODE_AMPLIFICATION: "cpu=1.5,memory=1.2"}
+    got = ext.parse_node_amplification(ann)
+    assert got == {"cpu": 1.5, "memory": 1.2}
+    assert ext.parse_node_amplification({}) == {}
+    bad = {ext.ANNOTATION_NODE_AMPLIFICATION: "cpu=abc,=2,junk"}
+    assert ext.parse_node_amplification(bad) == {}
+
+
+def test_bind_pod_request_amplified_in_filter():
+    """plugin.go:421-423: requestCPUBind ⇒ podRequest ×ratio; an 8-core
+    LSR pod needs 16000 amplified milli on a ratio-2 node — free 14000
+    rejects it while a shared LS pod of the same size passes."""
+    # amplified allocatable 64000, requested 50000 -> free 14000
+    nodes = NodeState.create(
+        allocatable=np.array([[64000.0, 1 << 20]], np.float32),
+        requested=np.array([[50000.0, 0.0]], np.float32),
+        cpu_amp=np.array([2.0], np.float32),
+    )
+    QOS_LS, QOS_LSR = 2, 3
+    pods = qos_pod_batch(8000.0, [QOS_LSR, QOS_LS])
+    res = assign(pods, nodes, params())
+    a = np.asarray(res.assignment)
+    assert a[0] == -1  # bound pod: 16000 > 14000
+    assert a[1] == 0   # shared pod: 8000 <= 14000
+
+
+def test_commit_charges_amplified_cpu():
+    """Within a batch, a committed bound pod consumes ×ratio so the next
+    bound pod sees true remaining capacity (the reference reaches this
+    state pod-at-a-time via Reserve → cpuset allocate)."""
+    QOS_LSR = 3
+    nodes = NodeState.create(
+        allocatable=np.array([[24000.0, 1 << 20]], np.float32),
+        cpu_amp=np.array([2.0], np.float32),
+    )
+    pods = qos_pod_batch(8000.0, [QOS_LSR, QOS_LSR])
+    res = assign(pods, nodes, params())
+    a = np.asarray(res.assignment)
+    # each charges 16000 against 24000: only one fits
+    assert sorted(a.tolist()) == [-1, 0]
+    req_f = np.asarray(res.node_requested)
+    assert req_f[0, 0] == 16000.0
+    # sequential golden agrees
+    res_seq = assign_sequential(pods, nodes, params())
+    a_seq = np.asarray(res_seq.assignment)
+    assert sorted(a_seq.tolist()) == [-1, 0]
+    assert np.asarray(res_seq.node_requested)[0, 0] == 16000.0
+
+
+def test_unamplified_node_unchanged():
+    QOS_LSR = 3
+    nodes = NodeState.create(
+        allocatable=np.array([[24000.0, 1 << 20]], np.float32),
+    )
+    pods = qos_pod_batch(8000.0, [QOS_LSR, QOS_LSR])
+    a = np.asarray(assign(pods, nodes, params()).assignment)
+    assert sorted(a.tolist()) == [0, 0]
+
+
+def amplified_node(name="n0", physical_cpus=16, ratio=1.5):
+    return Node(
+        meta=ObjectMeta(
+            name=name,
+            annotations={ext.ANNOTATION_NODE_AMPLIFICATION: f"cpu={ratio}"},
+        ),
+        status=NodeStatus(
+            allocatable={
+                ext.RES_CPU: physical_cpus * 1000 * ratio,
+                ext.RES_MEMORY: 32768,
+            }
+        ),
+    )
+
+
+def lsr_pod(name, cpu_milli):
+    return Pod(
+        meta=ObjectMeta(name=name, labels={ext.LABEL_POD_QOS: "LSR"}),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu_milli, ext.RES_MEMORY: 1024},
+            priority=9500,
+        ),
+    )
+
+
+def ls_pod(name, cpu_milli):
+    return Pod(
+        meta=ObjectMeta(name=name, labels={ext.LABEL_POD_QOS: "LS"}),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu_milli, ext.RES_MEMORY: 1024},
+            priority=9500,
+        ),
+    )
+
+
+def test_snapshot_parses_ratio_and_surcharge_fold():
+    """upsert_node reads the annotation; after an exclusive allocation the
+    BatchScheduler folds (ratio−1)×held into node requested
+    (plugin.go:430-438 requested − allocated + amplify(allocated))."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(amplified_node(ratio=1.5))
+    idx = snap.node_id("n0")
+    assert snap.nodes.cpu_amp[idx] == 1.5
+
+    numa = NUMAManager(snap)
+    numa.register_node(
+        "n0",
+        CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=4),
+        memory_per_zone_mib=16384,
+    )
+    # zone CPU capacity registered in amplified space: 8 cpus × 1.5
+    st = numa.node("n0")
+    assert st.zone_alloc[0][0] == 12000.0
+
+    sched = BatchScheduler(snap, numa=numa)
+    out = sched.schedule([lsr_pod("p1", 8000)])
+    assert len(out.bound) == 1
+    ns = sched.node_state()
+    # nominal assume 8000 + surcharge (1.5−1)×8000 = 12000
+    assert float(np.asarray(ns.requested)[idx, 0]) == 12000.0
+
+
+def test_e2e_amplified_packing():
+    """16 physical cores at ratio 2 (amplified 32000): two 8-core LSR pods
+    fill the node (each charges 16000); a third LSR and a shared LS pod
+    both reject. On an unamplified node of the same amplified size, four
+    LSR pods would fit."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(amplified_node(physical_cpus=16, ratio=2.0))
+    numa = NUMAManager(snap)
+    numa.register_node(
+        "n0",
+        CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=8),
+        memory_per_zone_mib=16384,
+    )
+    sched = BatchScheduler(snap, numa=numa)
+    out = sched.schedule([lsr_pod(f"p{i}", 8000) for i in range(3)])
+    assert len(out.bound) == 2
+    assert len(out.unschedulable) == 1
+    # exclusive holds: 16 physical cpus taken
+    assert numa.node("n0").accumulator.allocated_count() == 16
+    out2 = sched.schedule([ls_pod("shared", 4000)])
+    assert out2.bound == []  # amplified free is 0
+
+
+def test_shared_pods_ride_amplified_capacity():
+    """The point of amplification: shared (LS) pods overcommit CPU. 16
+    physical cores at ratio 2 accept 60000 milli of LS requests (< 32000
+    would be the physical cap)."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(amplified_node(physical_cpus=16, ratio=2.0))
+    sched = BatchScheduler(snap)
+    pods = [ls_pod(f"s{i}", 7500) for i in range(4)]  # 30000 > physical 16000
+    out = sched.schedule(pods)
+    assert len(out.bound) == 4
+
+
+def test_cross_cycle_surcharge_without_numa_manager():
+    """Code-review regression: the ×ratio charge must survive across
+    scheduling cycles even with no registered NUMA topology — assume_pod
+    itself charges amplified, so cycle 2 sees the true remaining
+    capacity (12 physical cores can't hold two 8-core LSR pods)."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(amplified_node(physical_cpus=12, ratio=2.0))  # 24000
+    sched = BatchScheduler(snap)
+    out1 = sched.schedule([lsr_pod("a", 8000)])
+    assert len(out1.bound) == 1
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 16000.0
+    out2 = sched.schedule([lsr_pod("b", 8000)])
+    assert out2.bound == []
+    # forget releases the amplified charge symmetrically
+    snap.forget_pod(out1.bound[0][0].meta.uid)
+    assert snap.nodes.requested[idx, 0] == 0.0
+
+
+def test_register_before_upsert_syncs_live_ratio():
+    """Code-review regression: register_node before the Node upsert froze
+    cpu_amp=1.0; the manager must re-base onto the live snapshot ratio so
+    an LSR pod amplified by the solver still fits its (amplified) zone."""
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    numa.register_node(
+        "n0",
+        CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=4),
+        policy=NUMAPolicy.SINGLE_NUMA_NODE,
+        memory_per_zone_mib=16384,
+    )
+    snap.upsert_node(amplified_node(physical_cpus=16, ratio=2.0))
+    sched = BatchScheduler(snap, numa=numa)
+    # 8-core LSR: amplified request 16000 == amplified zone capacity 16000
+    out = sched.schedule([lsr_pod("p1", 8000)])
+    assert len(out.bound) == 1
+    st = numa.node("n0")
+    assert st.cpu_amp == 2.0
+    assert st.zone_alloc[0][0] == 16000.0
+    # the bound charge lives in amplified space too
+    zone = st.owners[out.bound[0][0].meta.uid][0]
+    assert st.zone_used[zone][0] == 16000.0
+
+
+def test_strict_zone_stretches_for_shared_pods():
+    """amplifyNUMANodeResources: on a single-numa-node ratio-1.5 node a
+    shared pod larger than one physical zone (8000) but under the
+    amplified zone (12000) is feasible; a bound pod of the same size is
+    checked physically (amplified request vs amplified zone) and must
+    still fit real cores."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(amplified_node(physical_cpus=16, ratio=1.5))
+    numa = NUMAManager(snap)
+    numa.register_node(
+        "n0",
+        CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=4),
+        policy=NUMAPolicy.SINGLE_NUMA_NODE,
+        memory_per_zone_mib=16384,
+    )
+    sched = BatchScheduler(snap, numa=numa)
+    out = sched.schedule([ls_pod("big-shared", 10000)])
+    assert len(out.bound) == 1
+    # 10-core bound pod: amplified request 15000 > amplified zone 12000
+    out2 = sched.schedule([lsr_pod("big-bound", 10000)])
+    assert out2.bound == []
