@@ -44,6 +44,13 @@ ANNOTATION_RESERVATION_AFFINITY = f"scheduling.{DOMAIN}/reservation-affinity"
 #: ``apis/extension/reservation.go:43-46`` LabelReservationOrder)
 LABEL_RESERVATION_ORDER = f"scheduling.{DOMAIN}/reservation-order"
 ANNOTATION_GANG_GROUPS = f"gang.scheduling.{DOMAIN}/groups"
+#: which member states count toward gang satisfaction (reference
+#: ``apis/extension/coscheduling.go:55-64``); default once-satisfied
+ANNOTATION_GANG_MATCH_POLICY = f"gang.scheduling.{DOMAIN}/match-policy"
+ANNOTATION_ALIAS_GANG_MATCH_POLICY = "pod-group.scheduling.sigs.k8s.io/match-policy"
+GANG_MATCH_ONLY_WAITING = "only-waiting"
+GANG_MATCH_WAITING_AND_RUNNING = "waiting-and-running"
+GANG_MATCH_ONCE_SATISFIED = "once-satisfied"
 #: pod-side partition request (apis/extension/device_share.go:38
 #: AnnotationGPUPartitionSpec): {"allocatePolicy": "Restricted"|"BestEffort",
 #: "ringBusBandwidth": <GB/s>}

@@ -170,6 +170,10 @@ class _AssumedPod:
     #: durationToExpireAssumedPod) so a rejected-then-deleted nomination
     #: can't leak capacity forever; see expire_assumed().
     confirmed: bool = True
+    #: nominal (physical) CPU milli of a cpuset-bound pod whose charge was
+    #: amplified; 0 for shared pods. Lets an amplification-ratio change
+    #: re-base the live charge (upsert_node).
+    bind_nominal_cpu: float = 0.0
 
 
 class ClusterSnapshot:
@@ -263,7 +267,23 @@ class ClusterSnapshot:
         self.nodes.allocatable[idx] = self.config.res_vector(node.status.allocatable)
         self.nodes.schedulable[idx] = not node.unschedulable
         amp = ext.parse_node_amplification(node.meta.annotations)
-        self.nodes.cpu_amp[idx] = max(float(amp.get(ext.RES_CPU, 1.0)), 1.0)
+        new_amp = max(float(amp.get(ext.RES_CPU, 1.0)), 1.0)
+        old_amp = float(self.nodes.cpu_amp[idx])
+        self.nodes.cpu_amp[idx] = new_amp
+        if new_amp != old_amp:
+            # re-base live bound pods' amplified charges onto the new ratio
+            # (NUMAManager._sync_amp does the same for zone accounting) —
+            # without this the node-level requested array drifts for as
+            # long as the pods live
+            for ap in self._assumed.values():
+                if ap.node_idx != idx or ap.bind_nominal_cpu <= 0:
+                    continue
+                new_charge = ap.bind_nominal_cpu * new_amp
+                self.nodes.requested[idx, self._cpu_dim] += (
+                    new_charge - ap.request[self._cpu_dim]
+                )
+                ap.request = ap.request.copy()
+                ap.request[self._cpu_dim] = new_charge
         self._node_labels[node.meta.name] = dict(node.meta.labels)
         return idx
 
@@ -398,9 +418,12 @@ class ClusterSnapshot:
         # every assume/forget path symmetric, with or without a registered
         # NUMA topology.
         amp = float(self.nodes.cpu_amp[idx])
-        if amp > 1.0 and ext.wants_cpu_bind(pod):
-            req = req.copy()
-            req[self._cpu_dim] *= amp
+        bind_nominal = 0.0
+        if ext.wants_cpu_bind(pod):
+            bind_nominal = float(req[self._cpu_dim])
+            if amp > 1.0:
+                req = req.copy()
+                req[self._cpu_dim] *= amp
         self.nodes.requested[idx] += req
         is_prod = pod.priority_class == ext.PriorityClass.PROD
         if not absorbed:
@@ -415,6 +438,7 @@ class ClusterSnapshot:
             assume_time=now if now is not None else _t.time(),
             absorbed=absorbed,
             confirmed=confirmed,
+            bind_nominal_cpu=bind_nominal,
         )
         return True
 

@@ -47,6 +47,32 @@ def gang_group_of(pod: Pod, own_key: str) -> frozenset:
     return frozenset(keys)
 
 
+def explicit_match_policy(annotations: Mapping[str, str]) -> Optional[str]:
+    """The match-policy annotation value if present and valid, else None —
+    an *absent* annotation must not reset a gang whose policy was already
+    declared (by the PodGroup CRD or another member)."""
+    policy = annotations.get(
+        ext.ANNOTATION_GANG_MATCH_POLICY
+    ) or annotations.get(ext.ANNOTATION_ALIAS_GANG_MATCH_POLICY)
+    if policy in (
+        ext.GANG_MATCH_ONLY_WAITING,
+        ext.GANG_MATCH_WAITING_AND_RUNNING,
+        ext.GANG_MATCH_ONCE_SATISFIED,
+    ):
+        return policy
+    return None
+
+
+def match_policy_of(pod: Pod) -> str:
+    """Gang match policy from the pod annotation (or its sig-scheduling
+    alias), default once-satisfied (reference
+    ``apis/extension/coscheduling.go:86-93`` GetGangMatchPolicy)."""
+    return (
+        explicit_match_policy(pod.meta.annotations)
+        or ext.GANG_MATCH_ONCE_SATISFIED
+    )
+
+
 @dataclasses.dataclass
 class _GangState:
     #: None = minMember unknown (label-only gang without min-available):
@@ -58,9 +84,28 @@ class _GangState:
     pending: Dict[str, Pod] = dataclasses.field(default_factory=dict)
     #: uids of members already bound
     bound: int = 0
+    #: which member states count toward satisfaction
+    match_policy: str = ext.GANG_MATCH_ONCE_SATISFIED
+    #: sticky once-satisfied flag (reference ``gang.go:435-459``
+    #: setResourceSatisfied, set by Permit allow and addBoundPod)
+    satisfied: bool = False
 
     def effective_min(self, fallback: int) -> int:
         return self.min_member if self.min_member is not None else fallback
+
+    @property
+    def bound_credit(self) -> int:
+        """Bound members counting toward satisfaction: the only-waiting
+        policy counts waiting (this batch's placements) alone
+        (``gang.go:492-494`` — satisfaction from WaitingForBindChildren
+        only)."""
+        return 0 if self.match_policy == ext.GANG_MATCH_ONLY_WAITING else self.bound
+
+    @property
+    def once_satisfied(self) -> bool:
+        return (
+            self.match_policy == ext.GANG_MATCH_ONCE_SATISFIED and self.satisfied
+        )
 
 
 class PodGroupManager:
@@ -74,14 +119,21 @@ class PodGroupManager:
         key = f"{pg.meta.namespace}/{pg.meta.name}"
         state = self._gangs.get(key)
         if state is None:
-            self._gangs[key] = _GangState(
+            state = _GangState(
                 min_member=pg.min_member,
                 create_time=time.time(),
                 schedule_timeout_s=pg.schedule_timeout_s,
             )
+            self._gangs[key] = state
         else:
             state.min_member = pg.min_member
             state.schedule_timeout_s = pg.schedule_timeout_s
+        # the PodGroup CRD's own annotation declares the policy for the
+        # whole gang (reference GangFromPodGroupCrd); member pods may still
+        # override explicitly
+        explicit = explicit_match_policy(pg.meta.annotations)
+        if explicit is not None:
+            state.match_policy = explicit
 
     def _gang_for_pod(self, key: str, pod: Pod) -> _GangState:
         state = self._gangs.get(key)
@@ -99,6 +151,9 @@ class PodGroupManager:
                 schedule_timeout_s=self.default_timeout_s,
             )
             self._gangs[key] = state
+        explicit = explicit_match_policy(pod.meta.annotations)
+        if explicit is not None:
+            state.match_policy = explicit
         return state
 
     def begin_cycle(self, pending: Sequence[Pod]) -> None:
@@ -125,7 +180,11 @@ class PodGroupManager:
             return
         state.pending.pop(pod.meta.uid, None)
         if bound:
+            # PostBind (core/core.go:429-441 addBoundPod): record the bound
+            # member and mark the gang once-satisfied — any bind implies
+            # Permit already allowed the whole gang (gang.go:456-459)
             state.bound += 1
+            state.satisfied = True
 
     def pre_enqueue(self, pod: Pod, now: Optional[float] = None) -> Tuple[bool, str]:
         """Gate: a gang pod may enter scheduling only once the gang has at
@@ -137,14 +196,18 @@ class PodGroupManager:
         if key is None:
             return True, ""
         state = self._gang_for_pod(key, pod)
+        # once-satisfied gangs pass directly (core/core.go:199-201):
+        # stragglers and restarted members schedule individually
+        if state.once_satisfied:
+            return True, ""
         now = now if now is not None else time.time()
         if (
-            state.bound < state.effective_min(len(state.pending))
+            state.bound_credit < state.effective_min(len(state.pending))
             and now - state.create_time > state.schedule_timeout_s
         ):
             state.create_time = now
             return False, f"gang {key} timed out; backing off one cycle"
-        total = len(state.pending) + state.bound
+        total = len(state.pending) + state.bound_credit
         need = state.effective_min(total)
         if total < need:
             return False, f"gang {key} has {total}/{need} members"
@@ -157,8 +220,10 @@ class PodGroupManager:
         are omitted (build_pods falls back to batch member count)."""
         out: Dict[str, int] = {}
         for k, s in self._gangs.items():
-            if s.min_member is not None:
-                out[k] = max(s.min_member - s.bound, 0)
+            if s.once_satisfied:
+                out[k] = 0
+            elif s.min_member is not None:
+                out[k] = max(s.min_member - s.bound_credit, 0)
         return out
 
     def order_pending(self, pods: Sequence[Pod]) -> List[Pod]:
@@ -218,9 +283,15 @@ class PodGroupManager:
 
         def gang_passes(key: str) -> bool:
             state = self._gangs.get(key)
+            if state is not None and state.once_satisfied:
+                # core/core.go:393: a once-satisfied gang's members pass
+                # Permit individually
+                return True
             fallback = members_per_gang.get(key, 0)
             need = state.effective_min(fallback) if state else fallback
-            have = placed_per_gang.get(key, 0) + (state.bound if state else 0)
+            have = placed_per_gang.get(key, 0) + (
+                state.bound_credit if state else 0
+            )
             return have >= need
 
         gang_ok = {key: gang_passes(key) for key in members_per_gang}

@@ -134,11 +134,60 @@ class ReservationManager:
             r.phase = ReservationPhase.AVAILABLE
             r.node_name = node
             r.available_time = _t.time()
+            self._resize_to_allocation(r, pod)
             # the ghost hold's lifecycle is owned here, not by a
             # pod_assumed sync — without confirmation expire_assumed()
             # would silently drop an Available reservation's capacity
             self.scheduler.snapshot.confirm_pod(pod.meta.uid)
         return len(outcome.bound)
+
+    def _resize_to_allocation(self, r: Reservation, ghost: Pod) -> None:
+        """ResizePod extension point (reference
+        ``frameworkext/framework_extender_factory.go:280-298`` +
+        ``deviceshare/plugin.go:519-539``): after Reserve, a reserve pod
+        that got a concrete device allocation has its allocatable resized
+        to the allocated device resources
+        (``UpdateReservePodWithAllocatable`` merge semantics — allocated
+        names override, other requests stay). A reservation created with
+        ``nvidia.com/gpu: 2`` thereby exposes
+        ``koordinator.sh/gpu-memory-ratio: 200`` to owner matching. Gated
+        on the ResizePod scheduler feature (``scheduler_features.go``)."""
+        import json
+
+        from ...utils.features import SCHEDULER_GATES
+
+        if not SCHEDULER_GATES.enabled("ResizePod"):
+            return
+        raw = ghost.meta.annotations.get(ext.ANNOTATION_DEVICE_ALLOCATED)
+        if not raw:
+            return
+        try:
+            payload = json.loads(raw)
+        except (ValueError, TypeError):
+            return
+        if not isinstance(payload, dict):
+            return
+        allocated: Dict[str, float] = {}
+        for items in payload.values():
+            if not isinstance(items, list):
+                continue
+            for item in items:
+                if not isinstance(item, dict):
+                    continue
+                for name, qty in (item.get("resources") or {}).items():
+                    try:
+                        allocated[name] = allocated.get(name, 0.0) + float(qty)
+                    except (TypeError, ValueError):
+                        continue
+        for name, qty in allocated.items():
+            r.requests[name] = qty
+        if ext.RES_GPU_MEMORY_RATIO in allocated:
+            # the allocation IS the GPU capacity in normalized units —
+            # keeping the raw nvidia.com/gpu dim too would double the
+            # reservation's apparent GPU capacity for owner matching
+            # (the reference normalizes GPU requests at PreFilter,
+            # deviceshare/plugin.go preparePod)
+            r.requests.pop(ext.RES_GPU, None)
 
     def expire(self, now: Optional[float] = None) -> List[str]:
         """Fail Available reservations past their TTL with no owners,

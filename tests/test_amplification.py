@@ -273,3 +273,25 @@ def test_strict_zone_stretches_for_shared_pods():
     # 10-core bound pod: amplified request 15000 > amplified zone 12000
     out2 = sched.schedule([lsr_pod("big-bound", 10000)])
     assert out2.bound == []
+
+
+def test_ratio_change_rebases_live_charges():
+    """Code-review regression: raising/lowering the amplification
+    annotation re-bases already-assumed bound pods' charges in node
+    requested, keeping node accounting and zone accounting in one space."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(amplified_node(physical_cpus=12, ratio=2.0))  # 24000
+    sched = BatchScheduler(snap)
+    out = sched.schedule([lsr_pod("a", 8000)])
+    assert len(out.bound) == 1
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 16000.0
+    # ratio 2.0 -> 3.0: the live bound charge re-bases to 24000
+    snap.upsert_node(amplified_node(physical_cpus=12, ratio=3.0))
+    assert snap.nodes.requested[idx, 0] == 24000.0
+    # back down to 1.0: nominal charge
+    snap.upsert_node(amplified_node(physical_cpus=12, ratio=1.0))
+    assert snap.nodes.requested[idx, 0] == 8000.0
+    # forget stays symmetric after the re-base
+    snap.forget_pod(out.bound[0][0].meta.uid)
+    assert snap.nodes.requested[idx, 0] == 0.0

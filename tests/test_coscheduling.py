@@ -266,3 +266,83 @@ def test_gang_group_atomicity_at_permit():
     ]
     allowed, rejected = mgr.permit(results_ok)
     assert len(allowed) == 4 and rejected == []
+
+
+def gang_pod_policy(name, gang, policy, cpu=4.0, min_avail=None):
+    pod = gang_pod(name, gang, cpu=cpu, min_avail=min_avail)
+    pod.meta.annotations[ext.ANNOTATION_GANG_MATCH_POLICY] = policy
+    return pod
+
+
+def test_match_policy_default_and_alias():
+    from koordinator_tpu.scheduler.plugins.coscheduling import match_policy_of
+
+    assert match_policy_of(gang_pod("p", "g")) == ext.GANG_MATCH_ONCE_SATISFIED
+    p = gang_pod("p", "g")
+    p.meta.annotations[ext.ANNOTATION_ALIAS_GANG_MATCH_POLICY] = (
+        ext.GANG_MATCH_ONLY_WAITING
+    )
+    assert match_policy_of(p) == ext.GANG_MATCH_ONLY_WAITING
+    p.meta.annotations[ext.ANNOTATION_ALIAS_GANG_MATCH_POLICY] = "bogus"
+    assert match_policy_of(p) == ext.GANG_MATCH_ONCE_SATISFIED
+
+
+def test_only_waiting_policy_regathers_min_members():
+    """only-waiting (apis/extension/coscheduling.go:58): bound members do
+    NOT count toward satisfaction — a straggler must re-gather minMember
+    waiting members, unlike the once-satisfied default
+    (test_straggler_after_gang_satisfied_schedules)."""
+    sched = BatchScheduler(_cluster())
+    sched.pod_groups.upsert_pod_group(
+        PodGroup(meta=ObjectMeta(name="g"), min_member=2)
+    )
+    first = [
+        gang_pod_policy("p1", "g", ext.GANG_MATCH_ONLY_WAITING),
+        gang_pod_policy("p2", "g", ext.GANG_MATCH_ONLY_WAITING),
+    ]
+    out1 = sched.schedule(first)
+    assert len(out1.bound) == 2
+    straggler = gang_pod_policy("p3", "g", ext.GANG_MATCH_ONLY_WAITING)
+    out2 = sched.schedule([straggler])
+    assert out2.bound == []  # 1 waiting < minMember 2
+    # two stragglers together re-satisfy the gang
+    out3 = sched.schedule(
+        [straggler, gang_pod_policy("p4", "g", ext.GANG_MATCH_ONLY_WAITING)]
+    )
+    assert len(out3.bound) == 2
+
+
+def test_once_satisfied_sticky_flag_set_on_bind():
+    sched = BatchScheduler(_cluster())
+    sched.pod_groups.upsert_pod_group(
+        PodGroup(meta=ObjectMeta(name="g"), min_member=2)
+    )
+    out = sched.schedule([gang_pod("p1", "g"), gang_pod("p2", "g")])
+    assert len(out.bound) == 2
+    state = sched.pod_groups._gangs["default/g"]
+    assert state.satisfied and state.once_satisfied
+
+
+def test_unannotated_member_does_not_reset_policy():
+    """Code-review regression: a member without the match-policy annotation
+    must not reset an only-waiting gang to the once-satisfied default; the
+    PodGroup CRD's own annotation also declares the policy."""
+    sched = BatchScheduler(_cluster())
+    pg = PodGroup(meta=ObjectMeta(name="g"), min_member=2)
+    pg.meta.annotations[ext.ANNOTATION_GANG_MATCH_POLICY] = (
+        ext.GANG_MATCH_ONLY_WAITING
+    )
+    sched.pod_groups.upsert_pod_group(pg)
+    # p1 annotated, p2 plain: the gang stays only-waiting
+    out = sched.schedule(
+        [
+            gang_pod_policy("p1", "g", ext.GANG_MATCH_ONLY_WAITING),
+            gang_pod("p2", "g"),
+        ]
+    )
+    assert len(out.bound) == 2
+    state = sched.pod_groups._gangs["default/g"]
+    assert state.match_policy == ext.GANG_MATCH_ONLY_WAITING
+    # a lone straggler still re-gathers minMember under only-waiting
+    out2 = sched.schedule([gang_pod("p3", "g")])
+    assert out2.bound == []

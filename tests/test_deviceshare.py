@@ -742,3 +742,53 @@ def test_partition_table_from_annotation_and_model():
         )
     )
     assert dm3.node("n0").partitions == {}
+
+
+def test_resize_pod_reservation_allocatable():
+    """ResizePod (frameworkext/framework_extender_factory.go:280-298 +
+    deviceshare/plugin.go:519-539): with the gate on, an Available
+    reservation created with raw ``nvidia.com/gpu`` exposes the concrete
+    allocation in normalized units (gpu-memory-ratio), so owners
+    requesting normalized GPU units can draw from it."""
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationManager,
+        ReservationPhase,
+    )
+    from koordinator_tpu.utils.features import SCHEDULER_GATES
+
+    def build():
+        snap, dm = make_cluster(n_nodes=1, gpus=4)
+        sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+        sched.extender.monitor.stop_background()
+        rm = ReservationManager(sched)
+        rm.add(
+            Reservation(
+                meta=ObjectMeta(name="hold"),
+                requests={
+                    ext.RES_CPU: 4000,
+                    ext.RES_MEMORY: 4096,
+                    ext.RES_GPU: 2,
+                },
+                owners=[ReservationOwner(label_selector={"app": "train"})],
+            )
+        )
+        assert rm.schedule_pending() == 1
+        return sched, rm
+
+    # gate off (default): requests stay as created
+    _, rm0 = build()
+    assert ext.RES_GPU_MEMORY_RATIO not in rm0.get("hold").requests
+    assert rm0.get("hold").requests[ext.RES_GPU] == 2
+
+    with SCHEDULER_GATES.override("ResizePod", True):
+        sched, rm = build()
+        r = rm.get("hold")
+        assert r.phase == ReservationPhase.AVAILABLE
+        # resized: 2 whole GPUs -> 200 ratio, raw dim normalized away
+        assert r.requests[ext.RES_GPU_MEMORY_RATIO] == 200.0
+        assert ext.RES_GPU not in r.requests
+        # an owner requesting normalized units now matches the reservation
+        owner = gpu_pod("train-0", ratio=100)
+        owner.meta.labels["app"] = "train"
+        assert rm.match(owner) is r
