@@ -351,15 +351,32 @@ def parse_node_amplification(annotations: Mapping[str, str]) -> Mapping[str, flo
     return out
 
 
+#: QoS classes whose whole-core pods take exclusive cpusets — the single
+#: source of truth for the bind predicate in both its scalar and
+#: vectorized forms (and the solver's on-device ``_cpu_bind``)
+CPU_BIND_QOS = (QoSClass.LSR, QoSClass.LSE)
+
+
 def wants_cpu_bind(pod) -> bool:
     """Pod takes an exclusive cpuset: LSR/LSE QoS with a positive
     whole-core CPU request (reference ``nodenumaresource/plugin.go:251-313``
     requiredCPUBindPolicy resolution). Shared across the snapshot's
     amplified-CPU accounting and the NUMA manager."""
-    if pod.qos not in (QoSClass.LSR, QoSClass.LSE):
+    if pod.qos not in CPU_BIND_QOS:
         return False
     cpu = pod.spec.requests.get(RES_CPU, 0.0)
     return cpu > 0 and cpu % 1000 == 0
+
+
+def wants_cpu_bind_rows(qos_rows, cpu_milli_rows):
+    """Vectorized :func:`wants_cpu_bind` over lowered arrays
+    (``qos_rows`` int QoS values, ``cpu_milli_rows`` CPU requests)."""
+    import numpy as _np
+
+    bind = _np.zeros(qos_rows.shape, bool)
+    for q in CPU_BIND_QOS:
+        bind |= qos_rows == int(q)
+    return bind & (cpu_milli_rows > 0) & (_np.mod(cpu_milli_rows, 1000.0) == 0)
 
 
 def qos_for_priority(prio: PriorityClass) -> QoSClass:

@@ -25,6 +25,16 @@ from ..api import extension as ext
 from ..api.types import Node, NodeMetric, Pod, ResourceList
 
 
+#: fallback QoS per priority band (ext.qos_for_priority, vectorized)
+_QOS_BY_BAND = np.array(
+    [
+        int(ext.qos_for_priority(ext.PriorityClass(b)))
+        for b in range(len(ext.PriorityClass))
+    ],
+    np.int8,
+)
+
+
 def bucket_size(n: int, minimum: int = 128) -> int:
     """Round up to the next power of two (>= minimum) for stable jit shapes."""
     if n <= minimum:
@@ -194,6 +204,9 @@ class ClusterSnapshot:
         self.config = config or SnapshotConfig()
         res = self.config.resources
         self._cpu_dim = res.index(ext.RES_CPU) if ext.RES_CPU in res else 0
+        self._res_index = {r: j for j, r in enumerate(res)}
+        #: QoS label string → int, memoized across build_pods calls
+        self._qos_label_cache: Dict[str, int] = {}
         #: NodeMetric aggregation percentile / expiry used at ingest
         #: (wired from LoadAwareSchedulingArgs by BatchScheduler)
         self.agg_type = agg_type
@@ -382,6 +395,7 @@ class ClusterSnapshot:
         now: Optional[float] = None,
         confirmed: bool = True,
         request: Optional[np.ndarray] = None,
+        bind_nominal_cpu: Optional[float] = None,
     ) -> bool:
         """Charge ``pod`` against ``node_name``; returns False (no-op) when
         the node is absent — an assume racing a node delete is a
@@ -418,12 +432,17 @@ class ClusterSnapshot:
         # every assume/forget path symmetric, with or without a registered
         # NUMA topology.
         amp = float(self.nodes.cpu_amp[idx])
-        bind_nominal = 0.0
-        if ext.wants_cpu_bind(pod):
-            bind_nominal = float(req[self._cpu_dim])
-            if amp > 1.0:
-                req = req.copy()
-                req[self._cpu_dim] *= amp
+        # callers that lowered the bind predicate already (BatchScheduler's
+        # per-chunk arrays) pass bind_nominal_cpu to skip the recompute
+        if bind_nominal_cpu is not None:
+            bind_nominal = bind_nominal_cpu
+        else:
+            bind_nominal = (
+                float(req[self._cpu_dim]) if ext.wants_cpu_bind(pod) else 0.0
+            )
+        if bind_nominal > 0 and amp > 1.0:
+            req = req.copy()
+            req[self._cpu_dim] *= amp
         self.nodes.requested[idx] += req
         is_prod = pod.priority_class == ext.PriorityClass.PROD
         if not absorbed:
@@ -441,6 +460,48 @@ class ClusterSnapshot:
             bind_nominal_cpu=bind_nominal,
         )
         return True
+
+    def assume_pods_bulk(
+        self,
+        pods: Sequence[Pod],
+        node_idxs: np.ndarray,
+        charged_rows: np.ndarray,
+        est_rows: np.ndarray,
+        is_prod: np.ndarray,
+        bind_nominals: np.ndarray,
+        now: Optional[float] = None,
+        confirmed: bool = False,
+    ) -> None:
+        """Vectorized assume for a batch of fresh winners (the per-winner
+        ``assume_pod`` was the commit loop's hot spot). ``charged_rows``
+        are the rows to charge verbatim — the caller has already applied
+        the amplified-CPU surcharge for bound pods (``bind_nominals``
+        records their physical CPU for ratio re-basing). Callers must
+        route pods that may already be assumed through ``assume_pod``
+        (this path skips the idempotent-replace check)."""
+        import time as _t
+
+        if now is None:
+            now = _t.time()
+        np.add.at(self.nodes.requested, node_idxs, charged_rows)
+        np.add.at(self.nodes.assigned_pending, node_idxs, est_rows)
+        if is_prod.any():
+            np.add.at(
+                self.nodes.assigned_pending_prod,
+                node_idxs[is_prod],
+                est_rows[is_prod],
+            )
+        assumed = self._assumed
+        for k, pod in enumerate(pods):
+            assumed[pod.meta.uid] = _AssumedPod(
+                node_idx=int(node_idxs[k]),
+                request=charged_rows[k],
+                estimate=est_rows[k],
+                is_prod=bool(is_prod[k]),
+                assume_time=now,
+                confirmed=confirmed,
+                bind_nominal_cpu=float(bind_nominals[k]),
+            )
 
     def expire_assumed(self, now: float, ttl: float) -> int:
         """Forget optimistic (unconfirmed) assumes older than ``ttl``
@@ -496,29 +557,84 @@ class ClusterSnapshot:
         gang_ids: Dict[str, int] = {}
         gang_members: Dict[int, int] = {}
         gang_label_min: Dict[int, int] = {}
+        # Tight single-pass lowering: the per-pod res_vector / property /
+        # parse_* calls were a measurable slice of the per-batch host time
+        # (one dict walk over requests replaces 5 separate parses;
+        # priority-band and fallback-QoS resolution vectorize after).
+        res_index = self._res_index
+        req_rows = out.requests
+        priority = out.priority
+        n = len(pods)
+        explicit_qos: List[Tuple[int, int]] = []
+        qos_cache: Dict[str, int] = self._qos_label_cache
         for i, pod in enumerate(pods):
-            out.requests[i] = self.config.res_vector(pod.spec.requests)
-            out.priority[i] = pod.spec.priority or 0
-            out.prio_class[i] = int(pod.priority_class)
-            out.qos[i] = int(pod.qos)
-            out.gpu_whole[i], out.gpu_share[i] = ext.parse_gpu_request(
-                pod.spec.requests
-            )
-            out.rdma[i] = ext.parse_rdma_request(pod.spec.requests)
-            out.fpga[i] = ext.parse_fpga_request(pod.spec.requests)
-            gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
+            spec = pod.spec
+            labels = pod.meta.labels
+            priority[i] = spec.priority or 0
+            whole = 0
+            ratio_mem: Optional[float] = None
+            core = 0.0
+            for k, v in spec.requests.items():
+                j = res_index.get(k)
+                if j is not None:
+                    req_rows[i, j] = v
+                # device parsing is NOT exclusive with the dense axis: a
+                # deployment may append device resources to
+                # SnapshotConfig.resources (DEFAULT_RESOURCES invites it)
+                # and the device manager must still see the request
+                if k == ext.RES_GPU:
+                    whole = int(v)
+                elif k == ext.RES_GPU_MEMORY_RATIO:
+                    ratio_mem = float(v)
+                elif k == ext.RES_GPU_CORE:
+                    core = float(v)
+                elif k == ext.RES_RDMA:
+                    out.rdma[i] = ext._count_request(spec.requests, k)
+                elif k == ext.RES_FPGA:
+                    out.fpga[i] = ext._count_request(spec.requests, k)
+            ratio = ratio_mem if ratio_mem is not None else core
+            if ratio >= 100.0:
+                whole += int(ratio // 100.0)
+                ratio = ratio % 100.0
+            if whole or ratio:
+                out.gpu_whole[i] = whole
+                out.gpu_share[i] = ratio
+            qos_label = labels.get(ext.LABEL_POD_QOS)
+            if qos_label:
+                qv = qos_cache.get(qos_label)
+                if qv is None:
+                    qv = int(ext.QoSClass.parse(qos_label))
+                    qos_cache[qos_label] = qv
+                if qv != int(ext.QoSClass.NONE):
+                    explicit_qos.append((i, qv))
+            gang = labels.get(ext.LABEL_GANG_NAME)
             if gang:
                 key = f"{pod.meta.namespace}/{gang}"
                 gid = gang_ids.setdefault(key, len(gang_ids))
                 out.gang_id[i] = gid
                 gang_members[gid] = gang_members.get(gid, 0) + 1
-                label_min = pod.meta.labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
+                label_min = labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
                 if label_min is not None:
                     try:
                         gang_label_min[gid] = int(label_min)
                     except ValueError:
                         pass
-            out.valid[i] = True
+        out.valid[:n] = True
+        # vectorized priority-band resolution from the canonical band
+        # table (priority.go:29-48; same source as from_priority)
+        prio_n = priority[:n]
+        out.prio_class[:n] = np.select(
+            [
+                (prio_n >= lo) & (prio_n <= hi)
+                for lo, hi in ext.PRIORITY_BANDS.values()
+            ],
+            [int(band) for band in ext.PRIORITY_BANDS],
+            default=int(ext.PriorityClass.NONE),
+        ).astype(np.int8)
+        # fallback QoS by band (qos_for_priority), explicit labels override
+        out.qos[:n] = _QOS_BY_BAND[out.prio_class[:n]]
+        for i, qv in explicit_qos:
+            out.qos[i] = qv
         out.gang_keys = [k for k, _ in sorted(gang_ids.items(), key=lambda kv: kv[1])]
         for key, gid in gang_ids.items():
             explicit = (min_member_by_gang or {}).get(key)

@@ -288,24 +288,47 @@ def _quota_commit(
     round. Returns (final_accept [P], new_used [Q, D])."""
     p, levels = chain.shape
     q_cap = quotas.runtime.shape[0]
+    d = requests.shape[1]
     ok = jnp.ones((p,), bool)
-    for level in range(levels):
-        key_raw = chain[:, level]
-        participating = accepted & (key_raw >= 0)
-        key = jnp.where(participating, key_raw, q_cap)
-        sidx = jnp.argsort(key, stable=True).astype(jnp.int32)
-        skey = key[sidx]
-        sreq = jnp.where(participating[sidx][:, None], requests[sidx], 0.0)
-        is_start = jnp.concatenate(
-            [jnp.ones((1,), bool), skey[1:] != skey[:-1]]
-        )
-        seg = _segment_prefix_sums(sreq, is_start)
-        gq = jnp.minimum(skey, q_cap - 1)
-        fits = jnp.all(
-            quotas.used[gq] + seg <= quotas.runtime[gq] + EPS, axis=-1
-        )
-        ok_sorted = (skey >= q_cap) | fits
-        ok &= jnp.zeros((p,), bool).at[sidx].set(ok_sorted)
+    if q_cap * d <= 1024:
+        # Dense one-hot prefix path (static branch on the quota-table
+        # shape): a stable bitonic [P] argsort per level per round was the
+        # quota solve's dominant device cost; for small tables the same
+        # priority-ordered per-quota prefix is one [P, Q, D] cumsum —
+        # pods are already in priority order along P.
+        qids = jnp.arange(q_cap, dtype=chain.dtype)
+        for level in range(levels):
+            key_raw = chain[:, level]
+            participating = accepted & (key_raw >= 0)
+            onehot = participating[:, None] & (key_raw[:, None] == qids[None, :])
+            contrib = onehot[:, :, None] * requests[:, None, :]   # [P, Q, D]
+            prefix = jnp.cumsum(contrib, axis=0)                  # inclusive
+            gq = jnp.clip(key_raw, 0, q_cap - 1).astype(jnp.int32)
+            own = jnp.take_along_axis(
+                prefix, jnp.broadcast_to(gq[:, None, None], (p, 1, d)), axis=1
+            )[:, 0, :]                                            # [P, D]
+            fits = jnp.all(
+                quotas.used[gq] + own <= quotas.runtime[gq] + EPS, axis=-1
+            )
+            ok &= ~participating | fits
+    else:
+        for level in range(levels):
+            key_raw = chain[:, level]
+            participating = accepted & (key_raw >= 0)
+            key = jnp.where(participating, key_raw, q_cap)
+            sidx = jnp.argsort(key, stable=True).astype(jnp.int32)
+            skey = key[sidx]
+            sreq = jnp.where(participating[sidx][:, None], requests[sidx], 0.0)
+            is_start = jnp.concatenate(
+                [jnp.ones((1,), bool), skey[1:] != skey[:-1]]
+            )
+            seg = _segment_prefix_sums(sreq, is_start)
+            gq = jnp.minimum(skey, q_cap - 1)
+            fits = jnp.all(
+                quotas.used[gq] + seg <= quotas.runtime[gq] + EPS, axis=-1
+            )
+            ok_sorted = (skey >= q_cap) | fits
+            ok &= jnp.zeros((p,), bool).at[sidx].set(ok_sorted)
     final = accepted & ok
     new_used = quotas.used
     for level in range(levels):

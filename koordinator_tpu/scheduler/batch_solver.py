@@ -86,6 +86,22 @@ def _chain_commit_deltas(cur, nodes_t, result):
 
 
 @dataclasses.dataclass
+class LoweredRows:
+    """Host-side per-chunk lowering stash shared by solve() and _commit():
+    Reserve revalidation and assume charges reuse these instead of
+    recomputing res_vector / estimator / QoS predicates per winner (the
+    recompute was a measurable slice of the per-batch host time). ``uids``
+    guards the temporal coupling between pod_batch and _commit."""
+
+    uids: Tuple[str, ...]
+    req: np.ndarray       # [P, D] request rows (res_vector lowering)
+    est: np.ndarray       # [P, D] estimator rows
+    bind: np.ndarray      # [P] bool wants_cpu_bind
+    prio: np.ndarray      # [P] int32 raw priority
+    is_prod: np.ndarray   # [P] bool PROD band
+
+
+@dataclasses.dataclass
 class ScheduleOutcome:
     bound: List[Tuple[Pod, str]]
     unschedulable: List[Pod]
@@ -135,9 +151,15 @@ class BatchScheduler:
         self._params = self.args.solver_params(self.snapshot.config)
         self._scales = self.args.scale_vector(self.snapshot.config)
         # per-chunk lowered host rows, filled by pod_batch for _commit
-        self._lowered_uids: Tuple[str, ...] = ()
-        self._lowered_req = np.zeros((0, len(self.snapshot.config.resources)))
-        self._lowered_est = self._lowered_req
+        d = len(self.snapshot.config.resources)
+        self._lowered = LoweredRows(
+            uids=(),
+            req=np.zeros((0, d)),
+            est=np.zeros((0, d)),
+            bind=np.zeros((0,), bool),
+            prio=np.zeros((0,), np.int32),
+            is_prod=np.zeros((0,), bool),
+        )
         #: pod uid → node for bound pods (preemption victim lookup)
         self._bound_nodes: Dict[str, str] = {}
 
@@ -206,9 +228,18 @@ class BatchScheduler:
         # estimate_pod per winner (the recompute was a measurable slice of
         # the per-batch host time); the uid tuple guards the temporal
         # coupling — _commit refuses rows lowered for a different chunk
-        self._lowered_uids = tuple(p.meta.uid for p in pods)
-        self._lowered_req = arrays.requests
-        self._lowered_est = est
+        self._lowered = LoweredRows(
+            uids=tuple(p.meta.uid for p in pods),
+            req=arrays.requests,
+            est=est,
+            # vectorized wants_cpu_bind over the chunk (per-winner
+            # ext.wants_cpu_bind was a visible slice of the commit loop)
+            bind=ext.wants_cpu_bind_rows(
+                arrays.qos, arrays.requests[:, self.snapshot._cpu_dim]
+            ),
+            prio=arrays.priority,
+            is_prod=is_prod,
+        )
         return PodBatch.create(
             requests=arrays.requests,
             estimate=est,
@@ -348,26 +379,24 @@ class BatchScheduler:
         if len(chunks) > 1:
             solves = self._dispatch_pipelined(chunks)
         else:
-            solves = [
-                (chunk, None, None, self.solve(chunk)) for chunk in chunks
-            ]
+            solves = [(chunk, None, self.solve(chunk)) for chunk in chunks]
         # start all device→host copies before the first blocking fetch:
         # on tunneled backends every synchronous fetch is a full round
         # trip (~100 ms regardless of size); prefetching overlaps them
         # with each other and with still-running chunk solves
-        for _chunk, _r, _e, result in solves:
+        for _chunk, _rows, result in solves:
             try:
                 result.assignment.copy_to_host_async()
                 result.rounds_used.copy_to_host_async()
             except (AttributeError, RuntimeError):
                 pass
-        for chunk, req_rows, est_rows, result in solves:
+        for chunk, rows, result in solves:
             t0 = _time.perf_counter()
             assignment = np.asarray(result.assignment)  # sync point
             rounds += int(result.rounds_used)
             if fwext.scores.top_n > 0:
                 self._debug_capture(chunk, assignment)
-            b, u = self._commit(chunk, assignment, req_rows, est_rows)
+            b, u = self._commit(chunk, assignment, rows)
             fwext.registry.get("solver_batch_latency_seconds").observe(
                 _time.perf_counter() - t0
             )
@@ -547,7 +576,7 @@ class BatchScheduler:
 
     def _dispatch_pipelined(
         self, chunks: List[List[Pod]]
-    ) -> List[Tuple[List[Pod], np.ndarray, np.ndarray, SolveResult]]:
+    ) -> List[Tuple[List[Pod], LoweredRows, SolveResult]]:
         """Dispatch every chunk's solve back-to-back, chaining consumed
         node/quota/device capacity on device (solve_stream's discipline
         applied to the host pipeline): chunk k+1's masks see chunk k's
@@ -565,10 +594,10 @@ class BatchScheduler:
         nodes0 = self.node_state()
         cur = nodes0
         dev_carry = None
-        out: List[Tuple[List[Pod], np.ndarray, np.ndarray, SolveResult]] = []
+        out: List[Tuple[List[Pod], LoweredRows, SolveResult]] = []
         for chunk in chunks:
             pods = self.pod_batch(chunk)
-            req_rows, est_rows = self._lowered_req, self._lowered_est
+            rows = self._lowered
             # transformers see the chained base state fresh each chunk;
             # chaining carries only the solver's own commit DELTAS, so a
             # transformer that rewrites node state (the BeforeFilter
@@ -609,7 +638,7 @@ class BatchScheduler:
                 qused = result.quota_used
             if device_state is not None:
                 dev_carry = (result.node_dev_full, result.node_dev_total)
-            out.append((chunk, req_rows, est_rows, result))
+            out.append((chunk, rows, result))
         return out
 
     def _numa_scoring(self):
@@ -758,29 +787,200 @@ class BatchScheduler:
         self,
         chunk: Sequence[Pod],
         assignment: np.ndarray,
-        req_rows: Optional[np.ndarray] = None,
-        est_rows: Optional[np.ndarray] = None,
+        rows: Optional[LoweredRows] = None,
     ) -> Tuple[List[Tuple[Pod, str]], List[Pod]]:
         """Host-side Reserve: revalidate each nomination against live numpy
         state (the reference's Reserve mutates the scheduler cache the same
-        way, ``framework_extender.go:546``). ``req_rows``/``est_rows`` are
-        the rows lowered for this chunk (the pipelined path captures them
-        per chunk); when omitted the last ``pod_batch`` stash is used,
-        guarded by a uid check."""
+        way, ``framework_extender.go:546``). ``rows`` is the lowering for
+        this chunk (the pipelined path captures it per chunk); when omitted
+        the last ``pod_batch`` stash is used, guarded by a uid check.
+
+        Two Reserve paths: with NUMA/device managers each winner runs
+        per-pod exact allocation (``_reserve_loop``); without them the
+        admission + assume is fully vectorized (``_reserve_fast``) — the
+        per-winner Python loop was the dominant host cost of the quota and
+        loadaware scenarios."""
         from .prebind import DefaultPreBind
 
         na = self.snapshot.nodes
-        results: List[Tuple[Pod, Optional[str]]] = []
         prebind = DefaultPreBind()
-        if req_rows is None or est_rows is None:
-            if self._lowered_uids != tuple(p.meta.uid for p in chunk):
+        if rows is None:
+            if self._lowered.uids != tuple(p.meta.uid for p in chunk):
                 raise RuntimeError(
                     "_commit called with a chunk that does not match the "
                     "last pod_batch lowering — solve() and _commit() must "
                     "run on the same chunk"
                 )
-            req_rows = self._lowered_req
-            est_rows = self._lowered_est
+            rows = self._lowered
+        cpu_dim = self.snapshot._cpu_dim
+        # vectorized amplified admission rows: what assume will charge
+        # (bound pods' CPU ×ratio on amplified nodes)
+        n_chunk = len(chunk)
+        amp_col = na.cpu_amp[np.clip(assignment[:n_chunk], 0, None)]
+        factor = np.where(
+            rows.bind[:n_chunk] & (amp_col > 1.0), amp_col, 1.0
+        )
+        check_rows = rows.req
+        if np.any(factor != 1.0):
+            check_rows = rows.req.copy()
+            check_rows[:n_chunk, cpu_dim] *= factor
+
+        fast = self.numa is None and self.devices is None
+        if fast:
+            results = self._reserve_fast(chunk, assignment, rows, check_rows)
+        else:
+            results = self._reserve_loop(
+                chunk, assignment, rows, check_rows, prebind
+            )
+        # Permit: all-or-nothing over gangs; roll back assumes of rejects.
+        bound, unsched = self.pod_groups.permit(results)
+        bound_uids = {p.meta.uid for p, _ in bound}
+        # terminal PreBind: one merged patch per admitted pod
+        # (defaultprebind/plugin.go; rejected pods' patches evaporate).
+        # The fast path stages nothing (no NUMA/device annotations exist).
+        if not fast:
+            for pod, _node in bound:
+                prebind.apply(pod)
+        for pod, node in results:
+            if node is not None and pod.meta.uid not in bound_uids:
+                self.snapshot.forget_pod(pod.meta.uid)
+                if not fast:
+                    prebind.discard(pod.meta.uid)
+                    if self.numa is not None:
+                        self.numa.release(pod.meta.uid, node)
+                    if self.devices is not None:
+                        self.devices.release(pod.meta.uid, node)
+        # Durable quota accounting + victim bookkeeping for what actually
+        # bound (assign_pod remembers the pod at its leaf so the overuse
+        # revoker and the batch preemptor can pick eviction victims).
+        from .plugins.elasticquota import quota_name_of
+
+        uid_to_row = {p.meta.uid: i for i, p in enumerate(chunk)}
+        for pod, node in bound:
+            self._bound_nodes[pod.meta.uid] = node
+            leaf = quota_name_of(pod)
+            if leaf is not None:
+                row = uid_to_row.get(pod.meta.uid)
+                self.quotas.assign_pod(
+                    leaf,
+                    pod,
+                    vec=rows.req[row] if row is not None else None,
+                )
+        return bound, unsched
+
+    def _reserve_fast(
+        self,
+        chunk: Sequence[Pod],
+        assignment: np.ndarray,
+        rows: LoweredRows,
+        check_rows: np.ndarray,
+    ) -> List[Tuple[Pod, Optional[str]]]:
+        """Vectorized Reserve (no NUMA/device managers): per-node
+        capacity admission via segmented prefix sums in commit order, then
+        one bulk assume. Pods that may already be assumed (retry /
+        re-schedule) keep the idempotent per-pod path."""
+        na = self.snapshot.nodes
+        snap = self.snapshot
+        n_chunk = len(chunk)
+        assign_c = assignment[:n_chunk]
+        # commit order: (-priority, arrival), matching the loop path
+        order = np.lexsort((np.arange(n_chunk), -rows.prio[:n_chunk]))
+        placed = order[assign_c[order] >= 0]
+        accept = np.zeros(n_chunk, bool)
+        if placed.size:
+            nw = assign_c[placed]
+            perm = np.argsort(nw, kind="stable")
+            ws = placed[perm]           # chunk rows, grouped by node,
+            ns = nw[perm]               # commit order inside each group
+            crows = check_rows[ws]
+            starts = np.r_[True, ns[1:] != ns[:-1]]
+            cums = np.cumsum(crows, axis=0)
+            pos = np.arange(len(ns))
+            start_idx = np.maximum.accumulate(np.where(starts, pos, 0))
+            base = np.where(
+                (start_idx > 0)[:, None], cums[np.maximum(start_idx - 1, 0)], 0.0
+            )
+            seg = cums - base
+            ok = na.schedulable[ns] & np.all(
+                na.requested[ns] + seg <= na.allocatable[ns] + 1e-3, axis=1
+            )
+            if not ok.all():
+                # a rejected pod inside a segment polluted later cumsums:
+                # redo those nodes' pods sequentially (exact loop
+                # semantics — later smaller pods may still fit)
+                bad = np.unique(ns[~ok])
+                for node_idx in bad:
+                    sel = ns == node_idx
+                    if not na.schedulable[node_idx]:
+                        ok[sel] = False
+                        continue
+                    running = na.requested[node_idx].copy()
+                    alloc = na.allocatable[node_idx]
+                    for j in np.nonzero(sel)[0]:
+                        fits = bool(
+                            np.all(running + crows[j] <= alloc + 1e-3)
+                        )
+                        ok[j] = fits
+                        if fits:
+                            running += crows[j]
+            accept[ws[ok]] = True
+        # pods already assumed (idempotent re-assume) go one-by-one
+        acc_rows = np.nonzero(accept)[0]
+        fresh: List[int] = []
+        for i in acc_rows:
+            uid = rows.uids[i]
+            if uid in snap._assumed:
+                node_name = snap.node_name(int(assign_c[i]))
+                if not snap.assume_pod(
+                    chunk[i],
+                    node_name,
+                    rows.est[i],
+                    confirmed=False,
+                    request=rows.req[i],
+                    bind_nominal_cpu=(
+                        float(rows.req[i, self.snapshot._cpu_dim])
+                        if rows.bind[i]
+                        else 0.0
+                    ),
+                ):
+                    accept[i] = False
+            else:
+                fresh.append(i)
+        if fresh:
+            f = np.asarray(fresh)
+            bind_noms = np.where(
+                rows.bind[f], rows.req[f, self.snapshot._cpu_dim], 0.0
+            )
+            snap.assume_pods_bulk(
+                [chunk[i] for i in fresh],
+                assign_c[f],
+                check_rows[f],
+                rows.est[f],
+                rows.is_prod[f],
+                bind_noms,
+            )
+        results: List[Tuple[Pod, Optional[str]]] = []
+        node_name_of = snap.node_name
+        for i in order:
+            if accept[i]:
+                results.append((chunk[i], node_name_of(int(assign_c[i]))))
+            else:
+                results.append((chunk[i], None))
+        return results
+
+    def _reserve_loop(
+        self,
+        chunk: Sequence[Pod],
+        assignment: np.ndarray,
+        rows: LoweredRows,
+        check_rows: np.ndarray,
+        prebind: "DefaultPreBind",
+    ) -> List[Tuple[Pod, Optional[str]]]:
+        """Per-winner Reserve with exact NUMA/device allocation
+        (reference plugin.go:579-627)."""
+        na = self.snapshot.nodes
+        cpu_dim = self.snapshot._cpu_dim
+        results: List[Tuple[Pod, Optional[str]]] = []
         order = sorted(
             range(len(chunk)), key=lambda i: (-(chunk[i].spec.priority or 0), i)
         )
@@ -789,17 +989,10 @@ class BatchScheduler:
             if node_idx < 0:
                 results.append((pod, None))
                 continue
-            req = req_rows[i]
-            # the admission guard must check what assume_pod will charge:
-            # bound pods' CPU counts ×ratio on amplified nodes
-            check = req
-            amp = float(na.cpu_amp[node_idx])
-            if amp > 1.0 and ext.wants_cpu_bind(pod):
-                check = req.copy()
-                check[self.snapshot._cpu_dim] *= amp
+            req = rows.req[i]
             if not bool(
                 np.all(
-                    na.requested[node_idx] + check
+                    na.requested[node_idx] + check_rows[i]
                     <= na.allocatable[node_idx] + 1e-3
                 )
                 and na.schedulable[node_idx]
@@ -828,7 +1021,14 @@ class BatchScheduler:
                 patch.update(dev_patch)
             prebind.stage_annotations(pod, patch)
             if not self.snapshot.assume_pod(
-                pod, node_name, est_rows[i], confirmed=False, request=req
+                pod,
+                node_name,
+                rows.est[i],
+                confirmed=False,
+                request=req,
+                bind_nominal_cpu=(
+                    float(req[cpu_dim]) if rows.bind[i] else 0.0
+                ),
             ):
                 # node vanished between solve and Reserve (delete race):
                 # failed Reserve, roll back the per-winner allocations
@@ -839,29 +1039,4 @@ class BatchScheduler:
                 results.append((pod, None))
                 continue
             results.append((pod, node_name))
-        # Permit: all-or-nothing over gangs; roll back assumes of rejects.
-        bound, unsched = self.pod_groups.permit(results)
-        bound_uids = {p.meta.uid for p, _ in bound}
-        # terminal PreBind: one merged patch per admitted pod
-        # (defaultprebind/plugin.go; rejected pods' patches evaporate)
-        for pod, _node in bound:
-            prebind.apply(pod)
-        for pod, node in results:
-            if node is not None and pod.meta.uid not in bound_uids:
-                prebind.discard(pod.meta.uid)
-                self.snapshot.forget_pod(pod.meta.uid)
-                if self.numa is not None:
-                    self.numa.release(pod.meta.uid, node)
-                if self.devices is not None:
-                    self.devices.release(pod.meta.uid, node)
-        # Durable quota accounting + victim bookkeeping for what actually
-        # bound (assign_pod remembers the pod at its leaf so the overuse
-        # revoker and the batch preemptor can pick eviction victims).
-        from .plugins.elasticquota import quota_name_of
-
-        for pod, node in bound:
-            self._bound_nodes[pod.meta.uid] = node
-            leaf = quota_name_of(pod)
-            if leaf is not None:
-                self.quotas.assign_pod(leaf, pod)
-        return bound, unsched
+        return results

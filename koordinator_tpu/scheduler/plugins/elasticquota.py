@@ -146,6 +146,9 @@ class GroupQuotaManager:
         self.used = np.zeros((1, d), np.float32)
         self.requests = np.zeros((1, d), np.float32)
         self._dirty = True
+        #: memoized leaf-to-root index paths; rebuilt on tree mutations
+        #: (chain_of was a visible slice of the per-winner commit loop)
+        self._chain_cache: Dict[str, List[int]] = {}
 
     # ---- tree maintenance ----
 
@@ -171,6 +174,7 @@ class GroupQuotaManager:
             if (onode.quota.parent or ROOT) == name and other not in node.children:
                 node.children.append(other)
         self._dirty = True
+        self._chain_cache.clear()
 
     def remove_quota(self, name: str) -> None:
         node = self._nodes.pop(name, None)
@@ -193,6 +197,7 @@ class GroupQuotaManager:
             if oi < self.requests.shape[0]:
                 new_req[new_i] = self.requests[oi]
             n.index = new_i
+        self._chain_cache.clear()
         self.used, self.requests = new_used, new_req
         self._dirty = True
 
@@ -233,11 +238,19 @@ class GroupQuotaManager:
 
     def chain_of(self, name: Optional[str]) -> List[int]:
         """Leaf-to-root index path for a pod's quota label (≤ MAX_LEVELS)."""
+        if not name:
+            return []
+        cached = self._chain_cache.get(name)
+        if cached is not None:
+            return cached
         chain: List[int] = []
+        key = name
         while name and name in self._nodes and len(chain) < MAX_LEVELS:
             node = self._nodes[name]
             chain.append(node.index)
             name = node.quota.parent or None
+        if key in self._nodes:
+            self._chain_cache[key] = chain
         return chain
 
     @property
@@ -268,9 +281,15 @@ class GroupQuotaManager:
                 return False
         return True
 
-    def charge(self, quota_name: str, requests: Mapping[str, float]) -> None:
+    def charge(
+        self,
+        quota_name: str,
+        requests: Mapping[str, float],
+        vec: Optional[np.ndarray] = None,
+    ) -> None:
         self._ensure_capacity()
-        vec = self.config.res_vector(requests)
+        if vec is None:
+            vec = self.config.res_vector(requests)
         for idx in self.chain_of(quota_name):
             self.used[idx] += vec
 
@@ -287,10 +306,16 @@ class GroupQuotaManager:
         self._assigned.clear()
         self._dirty = True
 
-    def assign_pod(self, quota_name: str, pod: "Pod") -> None:
+    def assign_pod(
+        self,
+        quota_name: str,
+        pod: "Pod",
+        vec: Optional[np.ndarray] = None,
+    ) -> None:
         """Charge the chain and remember the pod at its leaf quota so the
-        overuse-revoke controller can pick eviction victims."""
-        self.charge(quota_name, pod.spec.requests)
+        overuse-revoke controller can pick eviction victims. ``vec`` is the
+        pod's already-lowered request row (skips a per-winner res_vector)."""
+        self.charge(quota_name, pod.spec.requests, vec=vec)
         self._assigned.setdefault(quota_name, {})[pod.meta.uid] = pod
 
     def unassign_pod(self, quota_name: str, pod: "Pod") -> None:

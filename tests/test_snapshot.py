@@ -204,3 +204,26 @@ def test_node_constraint_masks_enforced():
     assert "impossible" not in nodes_of
     assert [p.meta.name for p in out.unschedulable] == ["impossible"]
     assert "free" in nodes_of
+
+
+def test_device_resources_on_dense_axis_still_parsed():
+    """Code-review regression: when a deployment appends a device resource
+    to SnapshotConfig.resources, build_pods must both write the dense dim
+    AND surface the device request (gpu_whole) to the device manager."""
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import ClusterSnapshot, SnapshotConfig
+
+    cfg = SnapshotConfig(resources=ext.DEFAULT_RESOURCES + (ext.RES_GPU,))
+    snap = ClusterSnapshot(cfg)
+    pod = Pod(
+        meta=ObjectMeta(name="g"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 64, ext.RES_GPU: 2},
+            priority=9000,
+        ),
+    )
+    arrays = snap.build_pods([pod])
+    gpu_dim = cfg.resources.index(ext.RES_GPU)
+    assert arrays.requests[0, gpu_dim] == 2.0
+    assert arrays.gpu_whole[0] == 2
