@@ -47,6 +47,12 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
 def add_sim_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--sim-nodes", type=int, default=100)
     parser.add_argument("--sim-pods", type=int, default=500)
+    parser.add_argument(
+        "--sim-gpus",
+        type=int,
+        default=0,
+        help="GPUs per simulated node (used when deviceShare is configured)",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--state-file",

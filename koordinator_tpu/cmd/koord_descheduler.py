@@ -24,6 +24,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--high-threshold", type=float, default=70.0)
     parser.add_argument("--dry-run", action="store_true")
     parser.add_argument("--max-evictions-per-round", type=int, default=0)
+    parser.add_argument(
+        "--config",
+        default="",
+        help="LowNodeLoad plugin-args JSON (thresholds, nodePools, "
+        "resourceWeights, nodeFit)",
+    )
     return parser
 
 
@@ -35,7 +41,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         low_thresholds={"cpu": args.low_threshold},
         high_thresholds={"cpu": args.high_threshold},
     )
-    plugin = LowNodeLoadBalance(LowNodeLoad(snap, la))
+    pools = []
+    if getattr(args, "config", None):
+        import json
+
+        from ..scheduler.config import (
+            decode_low_node_load,
+            decode_low_node_load_pools,
+            validate_low_node_load,
+        )
+
+        with open(args.config) as f:
+            raw = json.load(f)
+        section = raw.get("lowNodeLoad", raw)
+        la = decode_low_node_load(section)
+        validate_low_node_load(la)
+        pools = decode_low_node_load_pools(section)
+    plugin = LowNodeLoadBalance(LowNodeLoad(snap, la), pools=pools)
     profile = Profile(
         name="koord-descheduler",
         balance_plugins=[plugin],

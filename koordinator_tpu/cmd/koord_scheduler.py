@@ -49,15 +49,30 @@ def main(
     _common.apply_feature_gates(SCHEDULER_GATES, args.feature_gates)
 
     la_args = LoadAwareArgs()
+    numa_scoring = device_scoring = None
     if args.config:
         import json
 
-        from ..scheduler.config import decode_load_aware, validate_load_aware
+        from ..scheduler.config import (
+            decode_device_share,
+            decode_load_aware,
+            decode_node_numa,
+            validate_device_share,
+            validate_load_aware,
+        )
 
         with open(args.config) as f:
             raw = json.load(f)
         la_args = decode_load_aware(raw.get("loadAware", raw))
         validate_load_aware(la_args)
+        if "deviceShare" in raw:
+            ds = decode_device_share(raw["deviceShare"])
+            validate_device_share(ds)
+            device_scoring = ds.scoring_strategy
+        if "nodeNUMAResource" in raw:
+            numa_scoring = decode_node_numa(
+                raw["nodeNUMAResource"]
+            ).scoring_strategy
 
     if args.serve:
         import signal
@@ -87,7 +102,47 @@ def main(
         return 0
 
     snap, _nodes, pods = _common.build_snapshot(args)
-    sched = BatchScheduler(snap, la_args, batch_bucket=args.batch_bucket)
+    numa = devices = None
+    if numa_scoring is not None:
+        import sys as _sys
+
+        from ..scheduler.plugins.nodenumaresource import NUMAManager
+
+        numa = NUMAManager(snap, scoring_strategy=numa_scoring)
+        print(
+            "koord-scheduler: nodeNUMAResource scoring configured but the "
+            "sim feed registers no CPU topology — strategy is inert until "
+            "topologies are registered",
+            file=_sys.stderr,
+        )
+    if device_scoring is not None:
+        from ..api.types import Device, DeviceInfo, ObjectMeta
+        from ..scheduler.plugins.deviceshare import DeviceManager
+
+        devices = DeviceManager(snap, scoring_strategy=device_scoring)
+        if args.sim_gpus > 0:
+            for node in _nodes:
+                devices.upsert_device(
+                    Device(
+                        meta=ObjectMeta(name=node.meta.name),
+                        devices=[
+                            DeviceInfo(dev_type="gpu", minor=g)
+                            for g in range(args.sim_gpus)
+                        ],
+                    )
+                )
+        else:
+            import sys as _sys
+
+            print(
+                "koord-scheduler: deviceShare scoring configured with no "
+                "device inventory — pass --sim-gpus N to give sim nodes "
+                "GPUs, or feed Device objects",
+                file=_sys.stderr,
+            )
+    sched = BatchScheduler(
+        snap, la_args, batch_bucket=args.batch_bucket, numa=numa, devices=devices
+    )
     pending = [p for p in pods if not p.spec.node_name]
 
     def step(i: int):

@@ -48,6 +48,25 @@ class LowNodeLoadArgs:
     #: stop evicting once the node is projected below high thresholds
     target_margin_percent: float = 5.0
     max_evictions_per_node: int = 5
+    #: per-resource victim-sort weights (reference ResourceWeights — both
+    #: 1 by default); only dims the source node actually overuses count
+    #: (``utilization_util.go:700-727`` sortPodsOnOneOverloadedNode)
+    resource_weights: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    #: check victims fit some low node before evicting (reference NodeFit,
+    #: default true)
+    node_fit: bool = True
+
+
+@dataclasses.dataclass
+class NodePool:
+    """One pool of a multi-pool config (reference LowNodeLoadNodePool,
+    ``types_loadaware.go:97-122``): nodes matching ``node_selector`` get
+    this pool's thresholds/weights; classification and victim selection
+    run per pool."""
+
+    name: str
+    node_selector: Mapping[str, str]
+    args: LowNodeLoadArgs
 
 
 @dataclasses.dataclass
@@ -56,6 +75,10 @@ class NodeClassification:
     high: np.ndarray    # [N] bool (debounced)
     raw_high: np.ndarray  # [N] bool (before debounce)
     utilization: np.ndarray  # [N, D] percent
+    #: effective high thresholds in percent ([D]) — deviation mode turns
+    #: the configured offsets into absolute levels around the mean, and
+    #: victim selection must use the SAME levels classification did
+    hi_eff: np.ndarray = None
 
 
 class LowNodeLoad:
@@ -71,10 +94,15 @@ class LowNodeLoad:
             np.float32,
         )
 
-    def classify(self, update_debounce: bool = True) -> NodeClassification:
+    def classify(
+        self,
+        update_debounce: bool = True,
+        node_mask: Optional[np.ndarray] = None,
+    ) -> NodeClassification:
         """Classify nodes; ``update_debounce=True`` advances the anomaly
         counters (call once per descheduling round). ``peek`` via
-        update_debounce=False is side-effect-free."""
+        update_debounce=False is side-effect-free. ``node_mask`` restricts
+        the pool of nodes considered (NodePool selector)."""
         na = self.snapshot.nodes
         alloc = np.maximum(na.allocatable, 1e-9)
         used = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
@@ -82,6 +110,8 @@ class LowNodeLoad:
         hi = self._vec(self.args.high_thresholds)
         lo = self._vec(self.args.low_thresholds)
         active = na.schedulable & na.metric_fresh
+        if node_mask is not None:
+            active = active & node_mask
         hi_on, lo_on = hi > 0, lo > 0
         hi_eff = hi[None, :]
         lo_eff = lo[None, :]
@@ -92,6 +122,7 @@ class LowNodeLoad:
             hi_eff = np.clip(avg + hi, 0.0, 100.0)[None, :]
             lo_eff = np.clip(avg - lo, 0.0, 100.0)[None, :]
         raw_high = active & np.any(hi_on[None, :] & (util > hi_eff), axis=1)
+        hi_eff_row = np.broadcast_to(hi_eff, (1, hi.shape[0]))[0].copy()
         low = active & np.all(~lo_on[None, :] | (util < lo_eff), axis=1)
         # prod tier: a node can be overutilized on prod usage alone
         phi = self._vec(self.args.prod_high_thresholds)
@@ -114,7 +145,11 @@ class LowNodeLoad:
                 if not raw_high[idx]:
                     del self._over_counts[idx]
         cls = NodeClassification(
-            low=low, high=high, raw_high=raw_high, utilization=util
+            low=low,
+            high=high,
+            raw_high=raw_high,
+            utilization=util,
+            hi_eff=np.where(hi_on, hi_eff_row, 0.0),
         )
         if update_debounce:
             self._last_cls = cls
@@ -152,7 +187,14 @@ class LowNodeLoad:
                 by_node.setdefault(idx, []).append(pod)
 
         victims: List[Pod] = []
-        hi = self._vec(self.args.high_thresholds)
+        # effective levels from the classification (deviation mode turns
+        # configured offsets into absolute levels; raw offsets here would
+        # weight the wrong dims and mis-project the eviction target)
+        hi = (
+            cls.hi_eff
+            if cls.hi_eff is not None
+            else self._vec(self.args.high_thresholds)
+        )
         from ..ops.estimator import scale_vector
 
         relief = scale_vector(cfg.resources)
@@ -168,12 +210,26 @@ class LowNodeLoad:
             target = alloc * np.where(
                 hi > 0, (hi - self.args.target_margin_percent) / 100.0, np.inf
             )
+            # weighted victim usage: only dims this node overuses count,
+            # at their configured weights (sortPodsOnOneOverloadedNode)
+            w = self._vec({r: 1.0 for r in cfg.resources})
+            if self.args.resource_weights:
+                w = self._vec(self.args.resource_weights)
+            overused = cls.utilization[idx] > np.where(hi > 0, hi, np.inf)
+
+            w_eff = np.where(overused, w, 0.0)
+            if not overused.any():
+                w_eff = w  # prod-tier-only overuse: fall back to all dims
+
+            def victim_usage(p: Pod) -> float:
+                return float(cfg.res_vector(p.spec.requests) @ w_eff)
+
             pods_sorted = sorted(
                 pods,
                 key=lambda p: (
                     int(p.priority_class),
                     -int(p.qos == ext.QoSClass.BE),
-                    -sum(p.spec.requests.values()),
+                    -victim_usage(p),
                 ),
             )
             evicted = 0
@@ -183,11 +239,12 @@ class LowNodeLoad:
                 if np.all(used <= target + 1e-3):
                     break
                 req = cfg.res_vector(pod.spec.requests)
-                fits = np.all(req[None, :] <= free + 1e-3, axis=1)
-                if not fits.any():
-                    continue
-                tgt = int(np.argmax(fits))
-                free[tgt] -= req
+                if self.args.node_fit:
+                    fits = np.all(req[None, :] <= free + 1e-3, axis=1)
+                    if not fits.any():
+                        continue
+                    tgt = int(np.argmax(fits))
+                    free[tgt] -= req
                 used = used - req * relief  # estimator-scaled relief per dim
                 victims.append(pod)
                 evicted += 1
@@ -197,17 +254,49 @@ class LowNodeLoad:
 class LowNodeLoadBalance:
     """Framework adapter: runs LowNodeLoad as a Balance plugin
     (``low_node_load.go:137`` Balance entry point) — classify, select
-    victims, push each through the profile's evictor chain."""
+    victims, push each through the profile's evictor chain. With
+    ``pools`` configured, each pool runs the cycle over its selected
+    nodes with its own thresholds/weights and debounce state
+    (reference NodePools)."""
 
     name = "LowNodeLoad"
 
-    def __init__(self, plugin: LowNodeLoad):
+    def __init__(
+        self,
+        plugin: LowNodeLoad,
+        pools: Sequence[NodePool] = (),
+    ):
         self.plugin = plugin
+        self.pools = list(pools)
+        #: pool name -> LowNodeLoad with the pool's args (debounce state
+        #: must persist across rounds per pool)
+        self._pool_plugins: Dict[str, LowNodeLoad] = {
+            pool.name: LowNodeLoad(plugin.snapshot, pool.args)
+            for pool in self.pools
+        }
+
+    def _pool_mask(self, pool: NodePool) -> np.ndarray:
+        snap = self.plugin.snapshot
+        n_bucket = snap.nodes.allocatable.shape[0]
+        mask = np.zeros((n_bucket,), bool)
+        for name, idx in snap._node_index.items():
+            labels = snap.node_labels(name)
+            if all(labels.get(k) == v for k, v in pool.node_selector.items()):
+                mask[idx] = True
+        return mask
 
     def balance(self, ctx) -> int:
+        evicted = 0
+        if self.pools:
+            for pool in self.pools:
+                plugin = self._pool_plugins[pool.name]
+                cls = plugin.classify(node_mask=self._pool_mask(pool))
+                for pod in plugin.select_victims(list(ctx.pods), cls):
+                    if ctx.evict(pod, f"node overutilized (pool {pool.name})", self.name):
+                        evicted += 1
+            return evicted
         cls = self.plugin.classify()
         victims = self.plugin.select_victims(list(ctx.pods), cls)
-        evicted = 0
         for pod in victims:
             if ctx.evict(pod, "node overutilized", self.name):
                 evicted += 1

@@ -141,6 +141,36 @@ def fit_plus_cost(
     return -score
 
 
+def device_cost(
+    gpu_units: jnp.ndarray,
+    dev_free_total: jnp.ndarray,
+    dev_cap_total: jnp.ndarray,
+    most_allocated: bool = False,
+) -> jnp.ndarray:
+    """DeviceShare Score strategy over GPU capacity (reference
+    ``deviceshare/scoring.go:45-110`` + ``resource_allocation.score`` —
+    Least/MostAllocated over the node's device resources). Pods without a
+    GPU request and nodes without GPUs contribute 0 (``state.skip`` /
+    missing nodeDeviceInfo return 0 in the reference).
+
+    gpu_units      [P] requested GPU percent-units (100 per whole GPU)
+    dev_free_total [N] free percent-units (round-carried)
+    dev_cap_total  [N] total percent-units
+    Returns [P, N] cost (= -score, scores 0..100, integer-floored).
+    """
+    used_after = (
+        (dev_cap_total[None, :] - dev_free_total[None, :]) + gpu_units[:, None]
+    )
+    cap = dev_cap_total[None, :]
+    if most_allocated:
+        raw = jnp.floor(used_after * 100.0 / (cap + _SAFE))
+    else:
+        raw = jnp.floor((cap - used_after) * 100.0 / (cap + _SAFE))
+    score = jnp.where((cap > 0) & (used_after <= cap + 1e-6), raw, 0.0)
+    score = jnp.where(gpu_units[:, None] > 0, score, 0.0)
+    return -score
+
+
 def numa_aligned_cost(
     pod_req: jnp.ndarray,
     wants_numa: jnp.ndarray,

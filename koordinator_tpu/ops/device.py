@@ -39,6 +39,10 @@ class DeviceState:
     slot_free: jnp.ndarray
     rdma_free: jnp.ndarray = None
     fpga_free: jnp.ndarray = None
+    #: total GPU percent-units per node ([N], 100 per installed GPU) —
+    #: needed by the Score strategy (free alone can't distinguish a full
+    #: node from a GPU-less one)
+    cap_total: jnp.ndarray = None
 
     def aggregates(self):
         """(full_count [N], partial_max [N], total [N])."""

@@ -421,6 +421,7 @@ def _priority_order(pods: PodBatch) -> jnp.ndarray:
         "nomination_jitter",
         "approx_topk",
         "numa_scoring",
+        "device_scoring",
     ),
 )
 def assign(
@@ -439,6 +440,7 @@ def assign(
     node_mask: "jnp.ndarray | None" = None,
     dev_carry: "tuple[jnp.ndarray, jnp.ndarray] | None" = None,
     numa_scoring: "str | None" = None,
+    device_scoring: "str | None" = None,
 ) -> SolveResult:
     """Round-based fast solver. ``round_quantum`` is the fraction of a node's
     allocatable (per dim, measured in estimated usage) it may accept per
@@ -585,6 +587,16 @@ def assign(
         )
         if numa_score_term is not None:
             cost = cost + numa_score_term
+        if devices is not None and device_scoring is not None:
+            # DeviceShare Least/MostAllocated over GPU capacity
+            # (deviceshare/scoring.go); dev_total is the round-carried
+            # free total, so intra-batch commits steer later rounds
+            cost = cost + cost_ops.device_cost(
+                sdev_total,
+                dev_total,
+                devices.cap_total,
+                most_allocated=(device_scoring == "MostAllocated"),
+            )
         if cost_transform is not None:
             # BeforeScore transformer chain (frameworkext.interface.go:84-109):
             # a static, jit-traced rewrite of the cost tensor.

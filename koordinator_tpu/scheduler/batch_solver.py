@@ -623,6 +623,7 @@ class BatchScheduler:
                 node_mask=node_mask,
                 dev_carry=dev_carry,
                 numa_scoring=self._numa_scoring(),
+                device_scoring=self._device_scoring(),
             )
             if nodes_t is cur:
                 # no node transformer ran: the solver outputs ARE the
@@ -647,6 +648,12 @@ class BatchScheduler:
             return self.numa.scoring_strategy
         return None
 
+    def _device_scoring(self):
+        """DeviceShare Score strategy for the solver (static jit arg)."""
+        if self.devices is not None and self.devices.has_devices:
+            return self.devices.scoring_strategy
+        return None
+
     def _constraint_states(self):
         """Lower the NUMA zone table and GPU slot table for the solver
         (None for whichever manager is absent/empty)."""
@@ -668,6 +675,7 @@ class BatchScheduler:
                 slot_free=jnp.asarray(self.devices.slot_array()),
                 rdma_free=jnp.asarray(self.devices.rdma_array()),
                 fpga_free=jnp.asarray(self.devices.fpga_array()),
+                cap_total=jnp.asarray(self.devices.cap_array()),
             )
         return numa_state, device_state
 
@@ -694,6 +702,7 @@ class BatchScheduler:
             approx_topk=True,
             node_mask=node_mask,
             numa_scoring=self._numa_scoring(),
+            device_scoring=self._device_scoring(),
         )
 
     def _node_constraint_mask(self, chunk: Sequence[Pod], p_bucket: int):

@@ -273,10 +273,37 @@ def decode_low_node_load(raw: Mapping[str, Any]) -> LowNodeLoadArgs:
     _set_if_present(kwargs, raw, "highThresholds", "high_thresholds")
     _set_if_present(kwargs, raw, "lowThresholds", "low_thresholds")
     _set_if_present(kwargs, raw, "prodHighThresholds", "prod_high_thresholds")
+    _set_if_present(kwargs, raw, "resourceWeights", "resource_weights")
+    if "useDeviationThresholds" in raw:
+        kwargs["use_deviation_thresholds"] = bool(raw["useDeviationThresholds"])
+    if "nodeFit" in raw:
+        kwargs["node_fit"] = bool(raw["nodeFit"])
     kwargs["anomaly_condition_count"] = _int(
         raw.get("anomalyCondition") or {}, "consecutiveAbnormalities", 2
     )
     return LowNodeLoadArgs(**kwargs)
+
+
+def decode_low_node_load_pools(raw: Mapping[str, Any]):
+    """NodePools (types_loadaware.go:93-122): each entry carries its own
+    thresholds decoded with the same rules as the top level."""
+    from ..descheduler.low_node_load import NodePool
+
+    pools = []
+    for entry in raw.get("nodePools") or []:
+        if not isinstance(entry, Mapping) or not entry.get("name"):
+            raise ConfigError("lowNodeLoad.nodePools", f"bad entry {entry!r}")
+        selector = (entry.get("nodeSelector") or {}).get("matchLabels") or {}
+        args = decode_low_node_load(entry)
+        validate_low_node_load(args, f"lowNodeLoad.nodePools[{entry['name']}]")
+        pools.append(
+            NodePool(
+                name=str(entry["name"]),
+                node_selector=dict(selector),
+                args=args,
+            )
+        )
+    return pools
 
 
 def validate_low_node_load(args: LowNodeLoadArgs, path: str = "lowNodeLoad") -> None:

@@ -99,9 +99,18 @@ def partition_table_for_model(model: str) -> Dict[int, List["GPUPartition"]]:
 class DeviceManager:
     """Per-node device inventories + exact allocation (nodeDeviceCache)."""
 
-    def __init__(self, snapshot: ClusterSnapshot, max_gpus: int = 8):
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        max_gpus: int = 8,
+        scoring_strategy: Optional[str] = None,
+    ):
         self.snapshot = snapshot
         self.max_gpus = max_gpus
+        #: "LeastAllocated" | "MostAllocated" | None — DeviceShare Score
+        #: strategy (reference DeviceShareArgs.ScoringStrategy,
+        #: deviceshare/scoring.go)
+        self.scoring_strategy = scoring_strategy
         self._nodes: Dict[str, _NodeDevices] = {}
 
     def upsert_device(self, device: Device) -> None:
@@ -185,6 +194,16 @@ class DeviceManager:
             for minor, free in enumerate(st.gpu_free):
                 slots[idx, minor] = free
         return slots
+
+    def cap_array(self) -> np.ndarray:
+        """Total GPU percent-units per node, [N] aligned to snapshot rows."""
+        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        out = np.zeros((n_bucket,), np.float32)
+        for name, st in self._nodes.items():
+            idx = self.snapshot.node_id(name)
+            if idx is not None:
+                out[idx] = len(st.gpu_free) * 100.0
+        return out
 
     def rdma_array(self) -> np.ndarray:
         """Free RDMA NIC count per node, [N] aligned to snapshot rows."""

@@ -183,3 +183,62 @@ def test_koord_sim_binary_runs_the_loop():
     from koordinator_tpu.cmd import koord_sim
 
     assert koord_sim.main(["--minutes", "2", "--nodes", "4", "--quiet"]) == 0
+
+
+def test_scheduler_config_wires_device_scoring(tmp_path, capsys):
+    """deviceShare.scoringStrategy in --config builds a DeviceManager and
+    --sim-gpus gives sim nodes inventory (not a silent no-op)."""
+    cfg = tmp_path / "sched.json"
+    cfg.write_text(
+        json.dumps(
+            {
+                "loadAware": {},
+                "deviceShare": {"scoringStrategy": {"type": "MostAllocated"}},
+            }
+        )
+    )
+    rc, lines = run_main(
+        koord_scheduler.main,
+        [
+            "--sim-nodes", "10", "--sim-pods", "20",
+            "--sim-gpus", "4", "--config", str(cfg), "--rounds", "1",
+        ],
+        capsys,
+    )
+    assert rc == 0 and lines[0]["bound"] == 20
+
+
+def test_descheduler_config_decodes_node_pools(tmp_path, capsys):
+    """nodePools/resourceWeights/nodeFit reach the Balance plugin from the
+    plugin-args JSON (decode_low_node_load_pools)."""
+    cfg = tmp_path / "desched.json"
+    cfg.write_text(
+        json.dumps(
+            {
+                "lowNodeLoad": {
+                    "highThresholds": {"cpu": 65},
+                    "lowThresholds": {"cpu": 30},
+                    "nodeFit": False,
+                    "resourceWeights": {"cpu": 2},
+                    "nodePools": [
+                        {
+                            "name": "batch",
+                            "nodeSelector": {"matchLabels": {"pool": "batch"}},
+                            "highThresholds": {"cpu": 90},
+                            "lowThresholds": {"cpu": 10},
+                        }
+                    ],
+                }
+            }
+        )
+    )
+    rc, lines = run_main(
+        koord_descheduler.main,
+        [
+            "--sim-nodes", "20", "--sim-pods", "60",
+            "--dry-run", "--config", str(cfg), "--rounds", "1",
+        ],
+        capsys,
+    )
+    assert rc == 0
+    assert "koord-descheduler" in lines[0]["profiles"]

@@ -538,3 +538,113 @@ def test_workload_percent_cap_without_replica_info_allows():
     jobs = [PodMigrationJob(meta=ObjectMeta(name="j0"), pod_uid=p.meta.uid)]
     picked = arb.arbitrate(jobs, {p.meta.uid: p}, in_flight=0)
     assert len(picked) == 1
+
+
+# ---- NodePools / ResourceWeights / NodeFit (types_loadaware.go:60-122) ----
+
+
+def test_resource_weights_order_victims_by_overused_dim():
+    """sortPodsOnOneOverloadedNode: only dims the node overuses count, at
+    their configured weights — a memory-hog pod outranks a CPU-hog when
+    only memory exceeds the threshold."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    snap.upsert_node(mknode("n1"))
+    set_util(snap, "n0", 30, mem_pct=90)   # only memory overused
+    set_util(snap, "n1", 10)
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(
+            anomaly_condition_count=1, max_evictions_per_node=1
+        ),
+    )
+    cpu_hog = Pod(
+        meta=ObjectMeta(name="cpu-hog"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 20000, ext.RES_MEMORY: 1024},
+            priority=5500, node_name="n0",
+        ),
+    )
+    mem_hog = Pod(
+        meta=ObjectMeta(name="mem-hog"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 120000},
+            priority=5500, node_name="n0",
+        ),
+    )
+    victims = lnl.select_victims([cpu_hog, mem_hog])
+    assert [v.meta.name for v in victims] == ["mem-hog"]
+
+
+def test_node_fit_false_skips_target_check():
+    """NodeFit=false (types_loadaware.go:60-62): victims are picked even
+    with no low node that fits them."""
+    snap = make_cluster([90, 85])  # no low nodes at all
+    args = LowNodeLoadArgs(anomaly_condition_count=1)
+    assert LowNodeLoad(snap, args).select_victims([bound_pod("b", "n0")]) == []
+    args_nofit = LowNodeLoadArgs(anomaly_condition_count=1, node_fit=False)
+    lnl = LowNodeLoad(snap, args_nofit)
+    cls = lnl.classify()
+    cls.low[1] = True  # balance still requires a low node to exist
+    assert lnl.select_victims([bound_pod("b", "n0")], cls)
+
+
+def test_node_pools_independent_thresholds():
+    """NodePools (types_loadaware.go:93-122): each pool classifies only
+    its selected nodes against its own thresholds."""
+    from koordinator_tpu.descheduler.low_node_load import (
+        LowNodeLoadBalance,
+        NodePool,
+    )
+
+    snap = ClusterSnapshot()
+    for name, labels in [
+        ("gp-0", {"pool": "general"}),
+        ("gp-1", {"pool": "general"}),
+        ("batch-0", {"pool": "batch"}),
+        ("batch-1", {"pool": "batch"}),
+    ]:
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name, labels=labels),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+                ),
+            )
+        )
+    set_util(snap, "gp-0", 70)     # over general's 65 but under batch's 90
+    set_util(snap, "gp-1", 10)
+    set_util(snap, "batch-0", 70)  # fine for the batch pool
+    set_util(snap, "batch-1", 10)
+    pools = [
+        NodePool(
+            name="general",
+            node_selector={"pool": "general"},
+            args=LowNodeLoadArgs(
+                high_thresholds={ext.RES_CPU: 65, ext.RES_MEMORY: 80},
+                anomaly_condition_count=1,
+            ),
+        ),
+        NodePool(
+            name="batch",
+            node_selector={"pool": "batch"},
+            args=LowNodeLoadArgs(
+                high_thresholds={ext.RES_CPU: 90, ext.RES_MEMORY: 95},
+                anomaly_condition_count=1,
+            ),
+        ),
+    ]
+    balance = LowNodeLoadBalance(LowNodeLoad(snap), pools=pools)
+    evicted = []
+
+    class Ctx:
+        pods = [bound_pod("on-gp0", "gp-0"), bound_pod("on-batch0", "batch-0")]
+
+        def evict(self, pod, reason, plugin):
+            evicted.append((pod.meta.name, reason))
+            return True
+
+    n = balance.balance(Ctx())
+    assert n == 1
+    assert evicted[0][0] == "on-gp0"
+    assert "pool general" in evicted[0][1]

@@ -792,3 +792,42 @@ def test_resize_pod_reservation_allocatable():
         owner = gpu_pod("train-0", ratio=100)
         owner.meta.labels["app"] = "train"
         assert rm.match(owner) is r
+
+
+def test_device_scoring_strategy():
+    """DeviceShare Score (scoring.go:45-110): LeastAllocated spreads GPU
+    pods to the emptier GPU node; MostAllocated packs onto the busier one.
+    CPU/memory are identical across nodes so the device term decides."""
+
+    def run(strategy):
+        snap = ClusterSnapshot()
+        dm = DeviceManager(snap, scoring_strategy=strategy)
+        for i in range(2):
+            snap.upsert_node(
+                Node(
+                    meta=ObjectMeta(name=f"n{i}"),
+                    status=NodeStatus(
+                        allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+                    ),
+                )
+            )
+            dm.upsert_device(
+                Device(
+                    meta=ObjectMeta(name=f"n{i}"),
+                    devices=[
+                        DeviceInfo(dev_type="gpu", minor=g) for g in range(4)
+                    ],
+                )
+            )
+        # n0 starts with 2 GPUs consumed
+        warm = gpu_pod("warm", whole=2)
+        warm.spec.node_name = "n0"
+        assert dm.allocate(warm, "n0") is not None
+        sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+        sched.extender.monitor.stop_background()
+        out = sched.schedule([gpu_pod("probe", whole=1, cpu=100)])
+        assert len(out.bound) == 1
+        return out.bound[0][1]
+
+    assert run("LeastAllocated") == "n1"
+    assert run("MostAllocated") == "n0"
