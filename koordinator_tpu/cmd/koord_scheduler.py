@@ -80,6 +80,14 @@ def main(
 
         from ..runtime.snapshot_channel import SolverService, serve
 
+        if numa_scoring is not None or device_scoring is not None:
+            print(
+                "koord-scheduler: deviceShare/nodeNUMAResource scoring "
+                "strategies are not yet applied in --serve mode (the "
+                "snapshot channel carries no device/topology inventory) — "
+                "config accepted but inert",
+                file=sys.stderr,
+            )
         service = SolverService(args=la_args, batch_bucket=args.batch_bucket)
         server, port = serve(service, address=args.serve)
         print(f"koord-scheduler: solver service listening on port {port}", flush=True)

@@ -122,7 +122,7 @@ class LowNodeLoad:
             hi_eff = np.clip(avg + hi, 0.0, 100.0)[None, :]
             lo_eff = np.clip(avg - lo, 0.0, 100.0)[None, :]
         raw_high = active & np.any(hi_on[None, :] & (util > hi_eff), axis=1)
-        hi_eff_row = np.broadcast_to(hi_eff, (1, hi.shape[0]))[0].copy()
+        hi_eff_row = np.array(hi_eff[0])
         low = active & np.all(~lo_on[None, :] | (util < lo_eff), axis=1)
         # prod tier: a node can be overutilized on prod usage alone
         phi = self._vec(self.args.prod_high_thresholds)
@@ -156,7 +156,11 @@ class LowNodeLoad:
         return cls
 
     def select_victims(
-        self, bound_pods: Sequence[Pod], classification: Optional[NodeClassification] = None
+        self,
+        bound_pods: Sequence[Pod],
+        classification: Optional[NodeClassification] = None,
+        shared_free: Optional[Dict[int, np.ndarray]] = None,
+        exclude_uids: Optional[set] = None,
     ) -> List[Pod]:
         """Pick eviction candidates from debounced-high nodes.
 
@@ -176,11 +180,23 @@ class LowNodeLoad:
         cfg = self.snapshot.config
         na = self.snapshot.nodes
         low_idx = np.nonzero(cls.low)[0]
-        low_free = na.allocatable[low_idx] - na.requested[low_idx]  # [L, D]
+        # a low node's headroom is shared across every pool that selects
+        # it in one round (shared_free) — otherwise overlapping pools each
+        # grant the same capacity twice and over-evict
+        if shared_free is None:
+            shared_free = {}
+        low_free = np.stack(
+            [
+                shared_free.get(int(i), na.allocatable[i] - na.requested[i])
+                for i in low_idx
+            ]
+        ) if low_idx.size else np.zeros((0, na.allocatable.shape[1]), np.float32)
 
         by_node: Dict[int, List[Pod]] = {}
         for pod in bound_pods:
             if pod.spec.node_name is None:
+                continue
+            if exclude_uids and pod.meta.uid in exclude_uids:
                 continue
             idx = self.snapshot.node_id(pod.spec.node_name)
             if idx is not None and cls.high[idx]:
@@ -211,10 +227,15 @@ class LowNodeLoad:
                 hi > 0, (hi - self.args.target_margin_percent) / 100.0, np.inf
             )
             # weighted victim usage: only dims this node overuses count,
-            # at their configured weights (sortPodsOnOneOverloadedNode)
-            w = self._vec({r: 1.0 for r in cfg.resources})
-            if self.args.resource_weights:
-                w = self._vec(self.args.resource_weights)
+            # at their configured weights (sortPodsOnOneOverloadedNode;
+            # the reference defaults every unlisted resource's weight to 1)
+            w = np.array(
+                [
+                    float(dict(self.args.resource_weights).get(r, 1.0))
+                    for r in cfg.resources
+                ],
+                np.float32,
+            )
             overused = cls.utilization[idx] > np.where(hi > 0, hi, np.inf)
 
             w_eff = np.where(overused, w, 0.0)
@@ -248,6 +269,8 @@ class LowNodeLoad:
                 used = used - req * relief  # estimator-scaled relief per dim
                 victims.append(pod)
                 evicted += 1
+        for k, i in enumerate(low_idx):
+            shared_free[int(i)] = free[k]
         return victims
 
 
@@ -268,12 +291,12 @@ class LowNodeLoadBalance:
     ):
         self.plugin = plugin
         self.pools = list(pools)
-        #: pool name -> LowNodeLoad with the pool's args (debounce state
-        #: must persist across rounds per pool)
-        self._pool_plugins: Dict[str, LowNodeLoad] = {
-            pool.name: LowNodeLoad(plugin.snapshot, pool.args)
-            for pool in self.pools
-        }
+        #: one LowNodeLoad per pool entry (debounce state must persist
+        #: across rounds per pool; keyed by position so duplicate names
+        #: cannot alias state)
+        self._pool_plugins: List[LowNodeLoad] = [
+            LowNodeLoad(plugin.snapshot, pool.args) for pool in self.pools
+        ]
 
     def _pool_mask(self, pool: NodePool) -> np.ndarray:
         snap = self.plugin.snapshot
@@ -288,10 +311,21 @@ class LowNodeLoadBalance:
     def balance(self, ctx) -> int:
         evicted = 0
         if self.pools:
-            for pool in self.pools:
-                plugin = self._pool_plugins[pool.name]
+            # overlapping pools share one view of low-node headroom and
+            # never pick the same victim twice in a round
+            shared_free: Dict[int, np.ndarray] = {}
+            chosen: set = set()
+            for k, pool in enumerate(self.pools):
+                plugin = self._pool_plugins[k]
                 cls = plugin.classify(node_mask=self._pool_mask(pool))
-                for pod in plugin.select_victims(list(ctx.pods), cls):
+                victims = plugin.select_victims(
+                    list(ctx.pods),
+                    cls,
+                    shared_free=shared_free,
+                    exclude_uids=chosen,
+                )
+                for pod in victims:
+                    chosen.add(pod.meta.uid)
                     if ctx.evict(pod, f"node overutilized (pool {pool.name})", self.name):
                         evicted += 1
             return evicted

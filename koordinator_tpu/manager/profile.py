@@ -52,25 +52,49 @@ class ProfileMutator:
             out.append(p)
         return out
 
+    @staticmethod
+    def _apply(p: ClusterColocationProfile, meta, resource_stores) -> None:
+        """One profile's mutation against any object's (meta,
+        resource dicts) — the single source of truth for both the pod and
+        the reservation webhook paths."""
+        meta.labels.update(p.labels)
+        meta.annotations.update(p.annotations)
+        if p.qos_class is not None:
+            meta.labels[ext.LABEL_POD_QOS] = p.qos_class.name
+        if p.resource_translation:
+            for store in resource_stores:
+                for src, dst in p.resource_translation.items():
+                    if src in store:
+                        store[dst] = store.pop(src)
+
     def mutate(self, pod: Pod) -> Pod:
         """Apply all matching profiles in name order (deterministic)."""
         for p in sorted(self.match(pod), key=lambda p: p.meta.name):
-            pod.meta.labels.update(p.labels)
-            pod.meta.annotations.update(p.annotations)
-            if p.qos_class is not None:
-                pod.meta.labels[ext.LABEL_POD_QOS] = p.qos_class.name
+            self._apply(p, pod.meta, (pod.spec.requests, pod.spec.limits))
             if p.priority is not None:
                 pod.spec.priority = p.priority
             if p.scheduler_name is not None:
                 pod.spec.scheduler_name = p.scheduler_name
-            if p.resource_translation:
-                for store in (pod.spec.requests, pod.spec.limits):
-                    for src, dst in p.resource_translation.items():
-                        if src in store:
-                            store[dst] = store.pop(src)
         return pod
 
     def admit(self, pod: Pod) -> List[str]:
         """Mutate then validate; returns validation errors (empty = admitted)."""
         self.mutate(pod)
         return validate_pod(pod)
+
+    def mutate_reservation(self, reservation) -> None:
+        """Reservation-create mutation (reference
+        ``pkg/webhook/reservation/mutating/cluster_colocation_profile.go``):
+        matching profiles rewrite the reservation's labels/annotations,
+        QoS label, and resource names the same way they rewrite pods, so a
+        reservation created for profile-managed workloads holds capacity
+        in the *translated* resource dims (e.g. batch-cpu). Reservations
+        do not support the namespaceSelector (reference comment)."""
+        matched = [
+            p
+            for p in self.profiles
+            if not p.selector
+            or _selector_matches(p.selector, reservation.meta.labels)
+        ]
+        for p in sorted(matched, key=lambda p: p.meta.name):
+            self._apply(p, reservation.meta, (reservation.requests,))

@@ -1,0 +1,261 @@
+"""slo-controller-config ConfigMap validating webhook.
+
+Rebuild of ``pkg/webhook/cm/`` (``validating_handler.go`` +
+``plugins/sloconfig/``): on a ConfigMap update, every *changed, non-empty*
+config key is checked — JSON must parse, values must sit in the ranges the
+reference's struct-validator tags declare (``apis/slo/v1alpha1/
+nodeslo_types.go``, ``apis/configuration/slo_controller_config.go``), and
+per-key ``nodeStrategies``/``nodeConfigs`` profiles must carry unique
+names, non-empty selectors, and must not overlap (two profiles whose
+selectors can match the same node make the rendered NodeSLO ambiguous,
+``checker.go:96-140`` CreateNodeConfigProfileChecker +
+``selector.go`` NodeSelectorOverlap).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+# keys in the configmap (slo_controller_config.go:26-37)
+COLOCATION_CONFIG_KEY = "colocation-config"
+RESOURCE_THRESHOLD_CONFIG_KEY = "resource-threshold-config"
+RESOURCE_QOS_CONFIG_KEY = "resource-qos-config"
+CPU_BURST_CONFIG_KEY = "cpu-burst-config"
+SYSTEM_CONFIG_KEY = "system-config"
+HOST_APPLICATION_CONFIG_KEY = "host-application-config"
+CPU_NORMALIZATION_CONFIG_KEY = "cpu-normalization-config"
+RESOURCE_AMPLIFICATION_CONFIG_KEY = "resource-amplification-config"
+
+#: (field, lo, hi) inclusive ranges per config key — the reference's
+#: validator tags (nodeslo_types.go; None bound = unbounded)
+_RANGES: Dict[str, Sequence[Tuple[str, Optional[float], Optional[float]]]] = {
+    COLOCATION_CONFIG_KEY: [
+        ("cpuReclaimThresholdPercent", 0, 100),
+        ("memoryReclaimThresholdPercent", 0, 100),
+        ("metricAggregateDurationSeconds", 1, None),
+        ("metricReportIntervalSeconds", 1, None),
+        ("degradeTimeMinutes", 1, None),
+        ("updateTimeThresholdSeconds", 1, None),
+        ("midCPUThresholdPercent", 0, 100),
+        ("midMemoryThresholdPercent", 0, 100),
+        ("midUnallocatedPercent", 0, 100),
+    ],
+    RESOURCE_THRESHOLD_CONFIG_KEY: [
+        ("cpuSuppressThresholdPercent", 0, 100),
+        ("cpuSuppressMinPercent", 0, 100),
+        ("memoryEvictThresholdPercent", 0, 100),
+        ("memoryEvictLowerPercent", 0, 100),
+        ("cpuEvictBESatisfactionUpperPercent", 0, 100),
+        ("cpuEvictBESatisfactionLowerPercent", 0, 100),
+        ("cpuEvictBEUsageThresholdPercent", 0, 100),
+    ],
+    CPU_BURST_CONFIG_KEY: [
+        ("cpuBurstPercent", 1, 10000),
+        ("cfsQuotaBurstPercent", 100, None),
+        ("sharePoolThresholdPercent", 0, 100),
+    ],
+    SYSTEM_CONFIG_KEY: [
+        ("minFreeKbytesFactor", 1, None),
+        ("watermarkScaleFactor", 1, 400),
+        ("memcgReapBackGround", 0, 1),
+    ],
+    RESOURCE_QOS_CONFIG_KEY: [],  # nested per-class checks below
+}
+
+#: ordered-pair constraints: field a must be < field b when both set
+#: (ltfield/gtfield tags)
+_ORDERINGS: Dict[str, Sequence[Tuple[str, str]]] = {
+    RESOURCE_THRESHOLD_CONFIG_KEY: [
+        ("memoryEvictLowerPercent", "memoryEvictThresholdPercent"),
+        (
+            "cpuEvictBESatisfactionLowerPercent",
+            "cpuEvictBESatisfactionUpperPercent",
+        ),
+    ],
+}
+
+#: resource-qos nested leaf ranges (cpuQOS/memoryQOS/resctrlQOS fields)
+_QOS_LEAF_RANGES: Sequence[Tuple[str, Optional[float], Optional[float]]] = [
+    ("groupIdentity", -1, 2),
+    ("schedIdle", 0, 1),
+    ("minLimitPercent", 0, 100),
+    ("lowLimitPercent", 0, 100),
+    ("throttlingPercent", 0, 100),
+    ("wmarkRatio", 0, 100),
+    ("wmarkScalePermill", 1, 1000),
+    ("wmarkMinAdj", -25, 50),
+    ("priorityEnable", 0, 1),
+    ("priority", 0, 12),
+    ("oomKillGroup", 0, 1),
+    ("catRangeStartPercent", 0, 100),
+    ("catRangeEndPercent", 0, 100),
+    ("mbaPercent", 0, 100),
+]
+
+
+def _check_ranges(
+    obj: Mapping, rules, path: str, errors: List[str]
+) -> None:
+    for field, lo, hi in rules:
+        if field not in obj or obj[field] is None:
+            continue
+        try:
+            val = float(obj[field])
+        except (TypeError, ValueError):
+            errors.append(f"{path}.{field}: not a number: {obj[field]!r}")
+            continue
+        if lo is not None and val < lo:
+            errors.append(f"{path}.{field}: {val:g} below minimum {lo:g}")
+        if hi is not None and val > hi:
+            errors.append(f"{path}.{field}: {val:g} above maximum {hi:g}")
+
+
+def _check_orderings(obj: Mapping, rules, path: str, errors: List[str]) -> None:
+    for low_field, high_field in rules:
+        lo, hi = obj.get(low_field), obj.get(high_field)
+        if lo is None or hi is None:
+            continue
+        try:
+            if float(lo) >= float(hi):
+                errors.append(
+                    f"{path}.{low_field}: {lo} must be below {high_field} {hi}"
+                )
+        except (TypeError, ValueError):
+            pass  # range check already reported it
+
+
+def _check_qos_classes(cfg: Mapping, path: str, errors: List[str]) -> None:
+    for cls in ("lsrClass", "lsClass", "beClass", "systemClass", "cgroupRoot"):
+        class_cfg = cfg.get(cls)
+        if not isinstance(class_cfg, Mapping):
+            continue
+        for sub in ("cpuQOS", "memoryQOS", "resctrlQOS", "blkioQOS", "networkQOS"):
+            sub_cfg = class_cfg.get(sub)
+            if isinstance(sub_cfg, Mapping):
+                _check_ranges(
+                    sub_cfg, _QOS_LEAF_RANGES, f"{path}.{cls}.{sub}", errors
+                )
+                _check_orderings(
+                    sub_cfg,
+                    [("catRangeStartPercent", "catRangeEndPercent")],
+                    f"{path}.{cls}.{sub}",
+                    errors,
+                )
+
+
+def _selectors_overlap(a: Mapping[str, str], b: Mapping[str, str]) -> bool:
+    """Two matchLabels selectors can match the same node unless they
+    *conflict* — demand different values for a shared key (the reference's
+    NodeSelectorOverlap uses the same requirement-conflict test)."""
+    for key, val in a.items():
+        if key in b and b[key] != val:
+            return False
+    return True
+
+
+def _check_profiles(cfg: Mapping, key: str, path: str, errors: List[str]) -> None:
+    profiles = cfg.get("nodeStrategies") or cfg.get("nodeConfigs") or []
+    if not isinstance(profiles, list):
+        errors.append(f"{path}: nodeStrategies must be a list")
+        return
+    seen_names: Dict[str, int] = {}
+    parsed: List[Tuple[str, Mapping[str, str]]] = []
+    for i, prof in enumerate(profiles):
+        if not isinstance(prof, Mapping):
+            errors.append(f"{path}[{i}]: not an object")
+            continue
+        name = prof.get("name") or f"#{i}"
+        if name in seen_names:
+            errors.append(f"{path}[{i}]: duplicate profile name {name!r}")
+        seen_names[name] = i
+        selector = (prof.get("nodeSelector") or {}).get("matchLabels") or {}
+        has_exprs = bool((prof.get("nodeSelector") or {}).get("matchExpressions"))
+        if not selector and not has_exprs:
+            errors.append(
+                f"{path}[{i}] ({name}): nodeSelector must not be empty"
+            )
+            continue
+        parsed.append((name, dict(selector)))
+        # per-profile strategy values obey the same ranges
+        _check_ranges(prof, _RANGES.get(key, ()), f"{path}[{i}]", errors)
+        _check_orderings(prof, _ORDERINGS.get(key, ()), f"{path}[{i}]", errors)
+    for i in range(len(parsed)):
+        for j in range(i + 1, len(parsed)):
+            if _selectors_overlap(parsed[i][1], parsed[j][1]):
+                errors.append(
+                    f"{path}: profiles {parsed[i][0]!r} and {parsed[j][0]!r} "
+                    "have overlapping node selectors"
+                )
+
+
+def validate_slo_configmap(
+    new_data: Mapping[str, str],
+    old_data: Optional[Mapping[str, str]] = None,
+) -> List[str]:
+    """Errors for the changed keys of a slo-controller-config update;
+    empty list = admit (``validating_handler.go`` Handle)."""
+    errors: List[str] = []
+    for key in (
+        COLOCATION_CONFIG_KEY,
+        RESOURCE_THRESHOLD_CONFIG_KEY,
+        RESOURCE_QOS_CONFIG_KEY,
+        CPU_BURST_CONFIG_KEY,
+        SYSTEM_CONFIG_KEY,
+        HOST_APPLICATION_CONFIG_KEY,
+        CPU_NORMALIZATION_CONFIG_KEY,
+        RESOURCE_AMPLIFICATION_CONFIG_KEY,
+    ):
+        raw = new_data.get(key, "")
+        if not raw:
+            continue
+        if old_data is not None and old_data.get(key, "") == raw:
+            continue  # unchanged keys are not re-validated (CommonChecker)
+        try:
+            cfg = json.loads(raw)
+        except (ValueError, TypeError) as e:
+            errors.append(f"{key}: invalid JSON: {e}")
+            continue
+        if not isinstance(cfg, Mapping):
+            errors.append(f"{key}: must be a JSON object")
+            continue
+        _check_ranges(cfg, _RANGES.get(key, ()), key, errors)
+        _check_orderings(cfg, _ORDERINGS.get(key, ()), key, errors)
+        if key == RESOURCE_QOS_CONFIG_KEY:
+            _check_qos_classes(cfg, key, errors)
+            for prof in cfg.get("nodeStrategies") or []:
+                if isinstance(prof, Mapping):
+                    _check_qos_classes(prof, f"{key}.nodeStrategies", errors)
+        _check_profiles(cfg, key, key, errors)
+    return errors
+
+
+def node_profile_conflicts(
+    new_data: Mapping[str, str], node_labels: Mapping[str, str]
+) -> List[str]:
+    """ExistNodeConflict (``checker.go:142-160``): for one concrete node,
+    more than one profile of a config key matching it is a conflict."""
+    errors: List[str] = []
+    for key, raw in new_data.items():
+        if not raw:
+            continue
+        try:
+            cfg = json.loads(raw)
+        except (ValueError, TypeError):
+            continue
+        if not isinstance(cfg, Mapping):
+            continue
+        matches = []
+        for prof in cfg.get("nodeStrategies") or cfg.get("nodeConfigs") or []:
+            if not isinstance(prof, Mapping):
+                continue
+            selector = (prof.get("nodeSelector") or {}).get("matchLabels") or {}
+            if selector and all(
+                node_labels.get(k) == v for k, v in selector.items()
+            ):
+                matches.append(prof.get("name") or "?")
+        if len(matches) > 1:
+            errors.append(
+                f"{key}: node matches multiple profiles {matches}"
+            )
+    return errors

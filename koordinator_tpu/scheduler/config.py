@@ -290,9 +290,15 @@ def decode_low_node_load_pools(raw: Mapping[str, Any]):
     from ..descheduler.low_node_load import NodePool
 
     pools = []
+    seen = set()
     for entry in raw.get("nodePools") or []:
         if not isinstance(entry, Mapping) or not entry.get("name"):
             raise ConfigError("lowNodeLoad.nodePools", f"bad entry {entry!r}")
+        if entry["name"] in seen:
+            raise ConfigError(
+                "lowNodeLoad.nodePools", f"duplicate pool name {entry['name']!r}"
+            )
+        seen.add(entry["name"])
         selector = (entry.get("nodeSelector") or {}).get("matchLabels") or {}
         args = decode_low_node_load(entry)
         validate_low_node_load(args, f"lowNodeLoad.nodePools[{entry['name']}]")

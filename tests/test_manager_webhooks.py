@@ -496,3 +496,179 @@ def test_validate_annotation_shapes():
         }
     )
     assert validate_pod(ok) == []
+
+
+# ---- slo-controller-config validating webhook (pkg/webhook/cm) ----
+
+
+def test_sloconfig_ranges_and_orderings():
+    import json
+
+    from koordinator_tpu.manager.sloconfig_webhook import (
+        RESOURCE_THRESHOLD_CONFIG_KEY,
+        validate_slo_configmap,
+    )
+
+    ok = {
+        RESOURCE_THRESHOLD_CONFIG_KEY: json.dumps(
+            {
+                "clusterStrategy": {},
+                "cpuSuppressThresholdPercent": 65,
+                "memoryEvictLowerPercent": 68,
+                "memoryEvictThresholdPercent": 70,
+            }
+        )
+    }
+    assert validate_slo_configmap(ok) == []
+    bad = {
+        RESOURCE_THRESHOLD_CONFIG_KEY: json.dumps(
+            {
+                "cpuSuppressThresholdPercent": 120,     # > 100
+                "memoryEvictLowerPercent": 80,
+                "memoryEvictThresholdPercent": 70,      # lower >= threshold
+            }
+        )
+    }
+    errs = validate_slo_configmap(bad)
+    assert any("cpuSuppressThresholdPercent" in e for e in errs)
+    assert any("memoryEvictLowerPercent" in e for e in errs)
+
+
+def test_sloconfig_unchanged_keys_skipped_and_bad_json():
+    import json
+
+    from koordinator_tpu.manager.sloconfig_webhook import (
+        CPU_BURST_CONFIG_KEY,
+        validate_slo_configmap,
+    )
+
+    bad = {CPU_BURST_CONFIG_KEY: "{not json"}
+    assert validate_slo_configmap(bad)
+    # unchanged (even invalid) keys are not re-validated (CommonChecker
+    # IsCfgNotEmptyAndChanged)
+    assert validate_slo_configmap(bad, old_data=bad) == []
+    changed = {CPU_BURST_CONFIG_KEY: json.dumps({"cfsQuotaBurstPercent": 50})}
+    errs = validate_slo_configmap(changed, old_data=bad)
+    assert any("cfsQuotaBurstPercent" in e for e in errs)
+
+
+def test_sloconfig_profile_checks():
+    import json
+
+    from koordinator_tpu.manager.sloconfig_webhook import (
+        COLOCATION_CONFIG_KEY,
+        node_profile_conflicts,
+        validate_slo_configmap,
+    )
+
+    cfg = {
+        COLOCATION_CONFIG_KEY: json.dumps(
+            {
+                "enable": True,
+                "nodeConfigs": [
+                    {"name": "a", "nodeSelector": {"matchLabels": {"pool": "x"}}},
+                    {"name": "a", "nodeSelector": {"matchLabels": {"pool": "y"}}},
+                    {"name": "c", "nodeSelector": {}},
+                ],
+            }
+        )
+    }
+    errs = validate_slo_configmap(cfg)
+    assert any("duplicate profile name" in e for e in errs)
+    assert any("must not be empty" in e for e in errs)
+    # overlap: {pool: x} and {pool: x, zone: z} can match the same node
+    overlap = {
+        COLOCATION_CONFIG_KEY: json.dumps(
+            {
+                "nodeConfigs": [
+                    {"name": "a", "nodeSelector": {"matchLabels": {"pool": "x"}}},
+                    {
+                        "name": "b",
+                        "nodeSelector": {
+                            "matchLabels": {"pool": "x", "zone": "z"}
+                        },
+                    },
+                ]
+            }
+        )
+    }
+    errs2 = validate_slo_configmap(overlap)
+    assert any("overlapping node selectors" in e for e in errs2)
+    # disjoint selectors are fine, and node-conflict check agrees
+    disjoint = {
+        COLOCATION_CONFIG_KEY: json.dumps(
+            {
+                "nodeConfigs": [
+                    {"name": "a", "nodeSelector": {"matchLabels": {"pool": "x"}}},
+                    {"name": "b", "nodeSelector": {"matchLabels": {"pool": "y"}}},
+                ]
+            }
+        )
+    }
+    assert validate_slo_configmap(disjoint) == []
+    assert node_profile_conflicts(disjoint, {"pool": "x"}) == []
+    assert node_profile_conflicts(overlap, {"pool": "x", "zone": "z"})
+
+
+def test_sloconfig_qos_class_leaf_ranges():
+    import json
+
+    from koordinator_tpu.manager.sloconfig_webhook import (
+        RESOURCE_QOS_CONFIG_KEY,
+        validate_slo_configmap,
+    )
+
+    bad = {
+        RESOURCE_QOS_CONFIG_KEY: json.dumps(
+            {
+                "beClass": {
+                    "cpuQOS": {"groupIdentity": 5},       # max 2
+                    "memoryQOS": {"wmarkMinAdj": -30},    # min -25
+                }
+            }
+        )
+    }
+    errs = validate_slo_configmap(bad)
+    assert any("groupIdentity" in e for e in errs)
+    assert any("wmarkMinAdj" in e for e in errs)
+
+
+def test_profile_mutates_reservation():
+    """Reservation mutating webhook
+    (pkg/webhook/reservation/mutating/cluster_colocation_profile.go):
+    matching profiles rewrite reservation labels/QoS/resource names."""
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        ClusterColocationProfile,
+        ObjectMeta,
+        Reservation,
+    )
+    from koordinator_tpu.api.extension import QoSClass
+    from koordinator_tpu.manager.profile import ProfileMutator
+
+    mutator = ProfileMutator(
+        [
+            ClusterColocationProfile(
+                meta=ObjectMeta(name="batch-profile"),
+                selector={"workload": "spark"},
+                labels={"injected": "yes"},
+                qos_class=QoSClass.BE,
+                resource_translation={
+                    ext.RES_CPU: ext.RES_BATCH_CPU,
+                    ext.RES_MEMORY: ext.RES_BATCH_MEMORY,
+                },
+            )
+        ]
+    )
+    r = Reservation(
+        meta=ObjectMeta(name="hold", labels={"workload": "spark"}),
+        requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096},
+    )
+    mutator.mutate_reservation(r)
+    assert r.meta.labels["injected"] == "yes"
+    assert r.meta.labels[ext.LABEL_POD_QOS] == "BE"
+    assert r.requests == {ext.RES_BATCH_CPU: 4000, ext.RES_BATCH_MEMORY: 4096}
+    # non-matching reservation untouched
+    r2 = Reservation(meta=ObjectMeta(name="other"), requests={ext.RES_CPU: 1})
+    mutator.mutate_reservation(r2)
+    assert r2.requests == {ext.RES_CPU: 1}
