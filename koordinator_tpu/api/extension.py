@@ -333,6 +333,90 @@ def parse_gpu_partition_spec(annotations: Mapping[str, str]) -> tuple[bool, floa
     return spec.get("allocatePolicy") == "Restricted", bandwidth
 
 
+#: node-level reserved resources (reference ``node_reservation.go``)
+ANNOTATION_NODE_RESERVATION = f"node.{DOMAIN}/reservation"
+NODE_RESERVATION_POLICY_DEFAULT = "Default"
+NODE_RESERVATION_POLICY_RESERVED_CPUS_ONLY = "ReservedCPUsOnly"
+#: per-node LoadAware threshold override (reference ``load_aware.go:30``)
+ANNOTATION_CUSTOM_USAGE_THRESHOLDS = f"scheduling.{DOMAIN}/usage-thresholds"
+#: descheduling protocol (reference ``apis/extension/descheduling.go``)
+ANNOTATION_EVICTION_COST = f"scheduling.{DOMAIN}/eviction-cost"
+ANNOTATION_SOFT_EVICTION = f"scheduling.{DOMAIN}/soft-eviction"
+EVICTION_COST_MAX = (1 << 31) - 1  # math.MaxInt32 = never evict
+
+
+def parse_node_reservation(annotations: Mapping[str, str]):
+    """NodeReservation from the node annotation (reference
+    ``node_reservation.go`` GetNodeReservation): ``{"resources": {...},
+    "reservedCPUs": "0-5", "applyPolicy": "Default"}``. None when absent
+    or malformed; non-numeric resource values are dropped."""
+    spec = _parse_dict_annotation(annotations, ANNOTATION_NODE_RESERVATION)
+    if spec is None:
+        return None
+    resources = spec.get("resources")
+    if resources is not None:
+        if not isinstance(resources, dict):
+            spec = dict(spec)
+            spec["resources"] = {}
+        else:
+            spec = dict(spec)
+            spec["resources"] = {
+                k: float(v)
+                for k, v in resources.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+    if not isinstance(spec.get("reservedCPUs", ""), str):
+        spec = dict(spec)
+        spec["reservedCPUs"] = ""
+    return spec
+
+
+def parse_custom_usage_thresholds(annotations: Mapping[str, str]):
+    """CustomUsageThresholds from the node annotation (reference
+    ``load_aware.go`` GetCustomUsageThresholds): per-node REPLACEMENT of
+    the LoadAware plugin's usage/prod thresholds (a non-empty custom map
+    supersedes the global wholesale — dims absent from it go unchecked).
+    None when absent/malformed."""
+    return _parse_dict_annotation(
+        annotations, ANNOTATION_CUSTOM_USAGE_THRESHOLDS
+    )
+
+
+def _parse_dict_annotation(annotations: Mapping[str, str], key: str):
+    """JSON-object annotation value, or None when absent/malformed/not a
+    dict — the shared guard for every dict-shaped protocol annotation."""
+    import json as _json
+
+    raw = annotations.get(key)
+    if not raw:
+        return None
+    try:
+        spec = _json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    return spec if isinstance(spec, dict) else None
+
+
+def parse_eviction_cost(annotations: Mapping[str, str]) -> int:
+    """Eviction cost from the pod annotation (reference
+    ``descheduling.go`` GetEvictionCost): implicit 0, negatives allowed,
+    MaxInt32 = never evict. Values with a leading plus sign or leading
+    zeros are invalid (→ 0), mirroring validFirstDigit."""
+    value = annotations.get(ANNOTATION_EVICTION_COST)
+    if not value:
+        return 0
+    first = value[0]
+    if not (first == "-" or value == "0" or "1" <= first <= "9"):
+        return 0
+    try:
+        cost = int(value)
+    except ValueError:
+        return 0
+    if cost > EVICTION_COST_MAX or cost < -(1 << 31):
+        return 0
+    return cost
+
+
 def parse_node_amplification(annotations: Mapping[str, str]) -> Mapping[str, float]:
     """Resource → amplification ratio from the node annotation (reference
     ``apis/extension/node_resource_amplification.go``

@@ -77,6 +77,10 @@ class NodeArrays:
       cpu_amp          — CPU amplification ratio from the node annotation
                          (``apis/extension/node_resource_amplification.go``),
                          1.0 when unset                                   [N]
+      custom_thresholds / custom_prod_thresholds — per-node LoadAware
+                         threshold overrides from the usage-thresholds
+                         annotation (``apis/extension/load_aware.go``);
+                         0 = use the plugin-args global            [N, D]
     """
 
     allocatable: np.ndarray
@@ -90,6 +94,8 @@ class NodeArrays:
     metric_fresh: np.ndarray
     schedulable: np.ndarray
     cpu_amp: np.ndarray
+    custom_thresholds: np.ndarray
+    custom_prod_thresholds: np.ndarray
     n_real: int
 
     @classmethod
@@ -107,6 +113,8 @@ class NodeArrays:
             metric_fresh=np.zeros((n_bucket,), bool),
             schedulable=np.zeros((n_bucket,), bool),
             cpu_amp=np.ones((n_bucket,), np.float32),
+            custom_thresholds=z(),
+            custom_prod_thresholds=z(),
             n_real=0,
         )
 
@@ -261,6 +269,8 @@ class ClusterSnapshot:
             cpu_amp=np.pad(
                 old.cpu_amp, (0, new - old.cpu_amp.shape[0]), constant_values=1.0
             ),
+            custom_thresholds=pad(old.custom_thresholds),
+            custom_prod_thresholds=pad(old.custom_prod_thresholds),
             n_real=old.n_real,
         )
 
@@ -277,7 +287,49 @@ class ClusterSnapshot:
             self._node_index[node.meta.name] = idx
             self.nodes.n_real = max(self.nodes.n_real, idx + 1)
             self.node_epoch += 1
-        self.nodes.allocatable[idx] = self.config.res_vector(node.status.allocatable)
+        alloc = self.config.res_vector(node.status.allocatable)
+        resv = ext.parse_node_reservation(node.meta.annotations)
+        if resv is not None and resv.get("applyPolicy") in (
+            None,
+            "",
+            ext.NODE_RESERVATION_POLICY_DEFAULT,
+        ):
+            # trim allocatable by the node-level reservation
+            # (util.TrimNodeAllocatableByNodeReservation): reservedCPUs
+            # overrides the cpu quantity; batch tiers already account the
+            # reservation at the koord-manager and keep their values
+            resources = dict(resv.get("resources") or {})
+            cpus_str = resv.get("reservedCPUs") or ""
+            if cpus_str:
+                from .topology import parse_cpuset
+
+                try:
+                    resources[ext.RES_CPU] = len(parse_cpuset(cpus_str)) * 1000.0
+                except ValueError:
+                    pass
+            reserved = self.config.res_vector(resources)
+            for batch_res in (ext.RES_BATCH_CPU, ext.RES_BATCH_MEMORY):
+                if batch_res in self._res_index:
+                    reserved[self._res_index[batch_res]] = 0.0
+            alloc = np.maximum(alloc - reserved, 0.0)
+        self.nodes.allocatable[idx] = alloc
+        custom = ext.parse_custom_usage_thresholds(node.meta.annotations)
+        self.nodes.custom_thresholds[idx] = 0.0
+        self.nodes.custom_prod_thresholds[idx] = 0.0
+        if custom is not None:
+            for field, arr in (
+                ("usageThresholds", self.nodes.custom_thresholds),
+                ("prodUsageThresholds", self.nodes.custom_prod_thresholds),
+            ):
+                table = custom.get(field)
+                if isinstance(table, dict):
+                    arr[idx] = self.config.res_vector(
+                        {
+                            k: v
+                            for k, v in table.items()
+                            if isinstance(v, (int, float))
+                        }
+                    )
         self.nodes.schedulable[idx] = not node.unschedulable
         amp = ext.parse_node_amplification(node.meta.annotations)
         new_amp = max(float(amp.get(ext.RES_CPU, 1.0)), 1.0)
@@ -322,6 +374,8 @@ class ClusterSnapshot:
         self.nodes.metric_fresh[idx] = False
         self.nodes.schedulable[idx] = False
         self.nodes.cpu_amp[idx] = 1.0
+        self.nodes.custom_thresholds[idx] = 0.0
+        self.nodes.custom_prod_thresholds[idx] = 0.0
         # Drop assumed-pod bookkeeping for the dead node so a later
         # forget_pod cannot corrupt whichever node reuses this slot.
         self._assumed = {

@@ -21,10 +21,9 @@ from ..api.types import Pod, PodPhase
 
 #: opt-out/opt-in annotation honored by the policy (sigs descheduler)
 ANNOTATION_EVICT_OPT_OUT = "descheduler.alpha.kubernetes.io/prefer-no-eviction"
-#: soft-eviction labels written by the soft evictor (reference
-#: evictor_soft.go: the workload controller watches these)
+#: soft-eviction marker label; the SoftEvictionSpec JSON itself goes
+#: under ext.ANNOTATION_SOFT_EVICTION (reference descheduling.go:40-54)
 LABEL_SOFT_EVICTION = f"scheduling.{ext.DOMAIN}/soft-eviction"
-ANNOTATION_SOFT_EVICTION_SPEC = f"scheduling.{ext.DOMAIN}/soft-eviction-spec"
 
 
 @dataclasses.dataclass
@@ -44,6 +43,9 @@ class PodEvictionPolicy:
         if pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
             return False  # already terminal; nothing to evict
         if pod.meta.annotations.get(ANNOTATION_EVICT_OPT_OUT) == "true":
+            return False
+        # MaxInt32 eviction cost = never evict (descheduling.go:33)
+        if ext.parse_eviction_cost(pod.meta.annotations) >= ext.EVICTION_COST_MAX:
             return False
         prio = pod.spec.priority or 0
         if not self.evict_system_critical and prio >= self.priority_threshold:
@@ -114,8 +116,16 @@ class SoftEvictor:
         if pod.meta.labels.get(LABEL_SOFT_EVICTION) == "true":
             return False  # already marked
         pod.meta.labels[LABEL_SOFT_EVICTION] = "true"
-        pod.meta.annotations[ANNOTATION_SOFT_EVICTION_SPEC] = (
-            f'{{"timestamp": {time.time():.0f}, "reason": "{reason}"}}'
+        # SoftEvictionSpec under the reference's annotation name
+        # (descheduling.go:40-54 GetSoftEvictionSpec)
+        import json
+
+        pod.meta.annotations[ext.ANNOTATION_SOFT_EVICTION] = json.dumps(
+            {
+                "timestamp": int(time.time()),
+                "reason": reason,
+                "initiator": "koord-descheduler",
+            }
         )
         self.marked.append(pod)
         return True

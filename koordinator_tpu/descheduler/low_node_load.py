@@ -245,11 +245,14 @@ class LowNodeLoad:
             def victim_usage(p: Pod) -> float:
                 return float(cfg.res_vector(p.spec.requests) @ w_eff)
 
+            # same priority band: lower eviction cost goes first
+            # (descheduling.go:34-36)
             pods_sorted = sorted(
                 pods,
                 key=lambda p: (
                     int(p.priority_class),
                     -int(p.qos == ext.QoSClass.BE),
+                    ext.parse_eviction_cost(p.meta.annotations),
                     -victim_usage(p),
                 ),
             )

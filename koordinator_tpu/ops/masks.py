@@ -36,12 +36,28 @@ def fit_mask(pod_req: jnp.ndarray, node_free: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(pod_req[:, None, :] <= node_free[None, :, :] + EPS, axis=-1)
 
 
+def effective_thresholds(
+    thresholds: jnp.ndarray,
+    node_custom: jnp.ndarray | None,
+) -> jnp.ndarray:
+    """[N, D] effective per-node thresholds: a node carrying a non-empty
+    usage-thresholds annotation replaces the plugin-args global map
+    WHOLESALE — dims absent from the custom map (0 here) go unchecked on
+    that node (reference ``load_aware.go`` GetCustomUsageThresholds /
+    filterNodeUsage replace the whole map)."""
+    if node_custom is None:
+        return thresholds[None, :]
+    has_custom = jnp.any(node_custom > 0.0, axis=-1, keepdims=True)  # [N, 1]
+    return jnp.where(has_custom, node_custom, thresholds[None, :])
+
+
 def usage_threshold_mask(
     pod_estimate: jnp.ndarray,
     node_estimated_used: jnp.ndarray,
     node_allocatable: jnp.ndarray,
     thresholds: jnp.ndarray,
     metric_fresh: jnp.ndarray,
+    node_custom: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """LoadAware Filter: reject nodes whose estimated utilization after
     placing the pod exceeds the per-resource threshold.
@@ -59,7 +75,8 @@ def usage_threshold_mask(
     """
     after = node_estimated_used[None, :, :] + pod_estimate[:, None, :]
     pct = usage_percent(after, node_allocatable[None, :, :])
-    over = (thresholds > 0.0) & (pct > thresholds)
+    thr = effective_thresholds(thresholds, node_custom)[None, :, :]
+    over = (thr > 0.0) & (pct > thr)
     ok = ~jnp.any(over, axis=-1)
     return ok | ~metric_fresh[None, :]
 
@@ -71,6 +88,7 @@ def prod_usage_threshold_mask(
     node_allocatable: jnp.ndarray,
     prod_thresholds: jnp.ndarray,
     metric_fresh: jnp.ndarray,
+    node_custom: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """LoadAware prod-usage thresholds: only prod-band pods are checked
     against prod-tier utilization (``load_aware.go:163-179``).
@@ -78,7 +96,12 @@ def prod_usage_threshold_mask(
     pod_is_prod: [P] bool. Returns [P, N] bool.
     """
     base = usage_threshold_mask(
-        pod_estimate, node_prod_used, node_allocatable, prod_thresholds, metric_fresh
+        pod_estimate,
+        node_prod_used,
+        node_allocatable,
+        prod_thresholds,
+        metric_fresh,
+        node_custom=node_custom,
     )
     return base | ~pod_is_prod[:, None]
 

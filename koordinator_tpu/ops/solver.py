@@ -72,6 +72,10 @@ class NodeState:
     #: physical CPUs, so their requests count ×ratio against it
     #: (``nodenumaresource/plugin.go:408-443`` filterAmplifiedCPUs).
     cpu_amp: jnp.ndarray = None   # [N]
+    #: per-node LoadAware threshold overrides from the usage-thresholds
+    #: annotation (0 = plugin-args global; ``apis/extension/load_aware.go``)
+    custom_thresholds: jnp.ndarray = None        # [N, D]
+    custom_prod_thresholds: jnp.ndarray = None   # [N, D]
 
     @classmethod
     def create(
@@ -83,6 +87,8 @@ class NodeState:
         metric_fresh=None,
         schedulable=None,
         cpu_amp=None,
+        custom_thresholds=None,
+        custom_prod_thresholds=None,
     ) -> "NodeState":
         allocatable = jnp.asarray(allocatable, jnp.float32)
         n = allocatable.shape[0]
@@ -104,6 +110,16 @@ class NodeState:
                 jnp.ones(n, jnp.float32)
                 if cpu_amp is None
                 else jnp.asarray(cpu_amp, jnp.float32)
+            ),
+            custom_thresholds=(
+                z
+                if custom_thresholds is None
+                else jnp.asarray(custom_thresholds, jnp.float32)
+            ),
+            custom_prod_thresholds=(
+                z
+                if custom_prod_thresholds is None
+                else jnp.asarray(custom_prod_thresholds, jnp.float32)
             ),
         )
 
@@ -392,6 +408,7 @@ def _feasible(
         nodes.allocatable,
         params.usage_thresholds,
         nodes.metric_fresh,
+        node_custom=nodes.custom_thresholds,
     )
     feas &= mask_ops.prod_usage_threshold_mask(
         pods.is_prod,
@@ -400,6 +417,7 @@ def _feasible(
         nodes.allocatable,
         params.prod_thresholds,
         nodes.metric_fresh,
+        node_custom=nodes.custom_prod_thresholds,
     )
     feas &= nodes.schedulable[None, :]
     feas &= active[:, None]
@@ -554,6 +572,8 @@ def assign(
             metric_fresh=nodes.metric_fresh,
             schedulable=nodes.schedulable,
             cpu_amp=nodes.cpu_amp,
+            custom_thresholds=nodes.custom_thresholds,
+            custom_prod_thresholds=nodes.custom_prod_thresholds,
         )
         round_quotas = QuotaState(runtime=quotas.runtime, used=qused)
         if quota_enabled:
@@ -680,12 +700,16 @@ def assign(
         # Intra-round cumulative usage-threshold check keeps the commit
         # faithful to sequential Filter semantics (load_aware.go:290-313,
         # rounded-percent comparison).
-        thr = params.usage_thresholds
+        thr = mask_ops.effective_thresholds(
+            params.usage_thresholds, nodes.custom_thresholds
+        )[gnode]
         over = (thr > 0.0) & (
             mask_ops.usage_percent(est0_g + seg_est, alloc_g) > thr
         )
         accept &= ~(fresh_g & jnp.any(over, axis=-1))
-        pthr = params.prod_thresholds
+        pthr = mask_ops.effective_thresholds(
+            params.prod_thresholds, nodes.custom_prod_thresholds
+        )[gnode]
         pover = (pthr > 0.0) & (
             mask_ops.usage_percent(prod_used[gnode] + seg_prod, alloc_g) > pthr
         )
@@ -992,13 +1016,17 @@ def assign_sequential(
                 | ~q_valid
             )
             feas &= q_ok
-        thr = params.usage_thresholds
+        thr = mask_ops.effective_thresholds(
+            params.usage_thresholds, nodes.custom_thresholds
+        )
         over = (thr > 0.0) & (
             mask_ops.usage_percent(est_used + est[None, :], nodes.allocatable)
             > thr
         )
         feas &= ~(nodes.metric_fresh & jnp.any(over, axis=-1))
-        pthr = params.prod_thresholds
+        pthr = mask_ops.effective_thresholds(
+            params.prod_thresholds, nodes.custom_prod_thresholds
+        )
         pover = (pthr > 0.0) & (
             mask_ops.usage_percent(prod_used + est[None, :], nodes.allocatable)
             > pthr

@@ -87,6 +87,8 @@ def _node_spec() -> NodeState:
         metric_fresh=P("tp"),
         schedulable=P("tp"),
         cpu_amp=P("tp"),
+        custom_thresholds=P("tp", None),
+        custom_prod_thresholds=P("tp", None),
     )
 
 
@@ -225,6 +227,8 @@ def shard_map_nominate(
         metric_fresh=P("tp"),
         schedulable=P("tp"),
         cpu_amp=P("tp"),
+        custom_thresholds=P("tp", None),
+        custom_prod_thresholds=P("tp", None),
     )
 
     @partial(
@@ -250,6 +254,7 @@ def shard_map_nominate(
             nodes_l.allocatable,
             params_l.usage_thresholds,
             nodes_l.metric_fresh,
+            node_custom=nodes_l.custom_thresholds,
         )
         feas &= nodes_l.schedulable[None, :]
         cost = cost_ops.load_aware_cost(

@@ -179,6 +179,8 @@ class BatchScheduler:
             metric_fresh=jnp.asarray(na.metric_fresh),
             schedulable=jnp.asarray(na.schedulable),
             cpu_amp=jnp.asarray(na.cpu_amp),
+            custom_thresholds=jnp.asarray(na.custom_thresholds),
+            custom_prod_thresholds=jnp.asarray(na.custom_prod_thresholds),
         )
 
     def pod_batch(self, pods: Sequence[Pod], bucket: Optional[int] = None) -> PodBatch:

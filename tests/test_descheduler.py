@@ -648,3 +648,29 @@ def test_node_pools_independent_thresholds():
     assert n == 1
     assert evicted[0][0] == "on-gp0"
     assert "pool general" in evicted[0][1]
+
+
+def test_eviction_cost_orders_and_protects():
+    """descheduling.go: lower eviction cost evicted first within a band;
+    MaxInt32 = never evict."""
+    from koordinator_tpu.descheduler.evictor import PodEvictionPolicy
+
+    assert ext.parse_eviction_cost({}) == 0
+    assert ext.parse_eviction_cost({ext.ANNOTATION_EVICTION_COST: "-10"}) == -10
+    assert ext.parse_eviction_cost({ext.ANNOTATION_EVICTION_COST: "+10"}) == 0
+    assert ext.parse_eviction_cost({ext.ANNOTATION_EVICTION_COST: "008"}) == 0
+
+    snap = make_cluster([90, 20])
+    lnl = LowNodeLoad(snap, LowNodeLoadArgs(anomaly_condition_count=1))
+    cheap = bound_pod("cheap", "n0", prio=5500)
+    cheap.meta.annotations[ext.ANNOTATION_EVICTION_COST] = "-5"
+    costly = bound_pod("costly", "n0", prio=5500)
+    costly.meta.annotations[ext.ANNOTATION_EVICTION_COST] = "100"
+    victims = lnl.select_victims([costly, cheap])
+    assert victims[0].meta.name == "cheap"
+
+    protected = bound_pod("protected", "n0", prio=5500, labels={"owner-kind": "rs"})
+    protected.meta.annotations[ext.ANNOTATION_EVICTION_COST] = str(
+        ext.EVICTION_COST_MAX
+    )
+    assert not PodEvictionPolicy(evict_ownerless=True).evictable(protected)

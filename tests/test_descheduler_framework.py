@@ -89,7 +89,9 @@ def test_soft_evictor_marks_once():
     p = pod("victim")
     assert ev.evict(p, "rebalance")
     assert p.meta.labels[LABEL_SOFT_EVICTION] == "true"
-    assert "rebalance" in p.meta.annotations["scheduling.koordinator.sh/soft-eviction-spec"]
+    # SoftEvictionSpec lives under the reference annotation name
+    # (descheduling.go AnnotationSoftEviction)
+    assert "rebalance" in p.meta.annotations["scheduling.koordinator.sh/soft-eviction"]
     assert not ev.evict(p, "again")
     assert len(ev.marked) == 1
 

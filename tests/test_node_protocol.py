@@ -1,0 +1,206 @@
+"""Node-level protocol annotations: reservation trim + custom usage
+thresholds (reference ``apis/extension/node_reservation.go`` +
+``apis/extension/load_aware.go`` / ``pkg/util/node.go``
+TrimNodeAllocatableByNodeReservation)."""
+
+import json
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceMetric,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+
+def mknode(name, cpu=32000, mem=65536, annotations=None):
+    return Node(
+        meta=ObjectMeta(name=name, annotations=annotations or {}),
+        status=NodeStatus(allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}),
+    )
+
+
+def test_node_reservation_trims_allocatable():
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        mknode(
+            "n0",
+            annotations={
+                ext.ANNOTATION_NODE_RESERVATION: json.dumps(
+                    {"resources": {ext.RES_CPU: 4000, ext.RES_MEMORY: 8192}}
+                )
+            },
+        )
+    )
+    idx = snap.node_id("n0")
+    assert snap.nodes.allocatable[idx, 0] == 28000.0
+    assert snap.nodes.allocatable[idx, 1] == 57344.0
+
+
+def test_node_reservation_reserved_cpus_override():
+    """reservedCPUs overrides the cpu quantity (GetNodeReservationResources:
+    cpuset size wins) and ReservedCPUsOnly does not trim."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        mknode(
+            "n0",
+            annotations={
+                ext.ANNOTATION_NODE_RESERVATION: json.dumps(
+                    {"resources": {ext.RES_CPU: 2000}, "reservedCPUs": "0-5"}
+                )
+            },
+        )
+    )
+    assert snap.nodes.allocatable[snap.node_id("n0"), 0] == 26000.0  # 6 cpus
+    snap.upsert_node(
+        mknode(
+            "n1",
+            annotations={
+                ext.ANNOTATION_NODE_RESERVATION: json.dumps(
+                    {"reservedCPUs": "0-5", "applyPolicy": "ReservedCPUsOnly"}
+                )
+            },
+        )
+    )
+    assert snap.nodes.allocatable[snap.node_id("n1"), 0] == 32000.0
+
+
+def test_node_reservation_malformed_ignored():
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        mknode("n0", annotations={ext.ANNOTATION_NODE_RESERVATION: "[broken"})
+    )
+    assert snap.nodes.allocatable[snap.node_id("n0"), 0] == 32000.0
+
+
+def set_usage(snap, name, cpu_pct):
+    idx = snap.node_id(name)
+    alloc = snap.nodes.allocatable[idx]
+    snap.set_node_metric(
+        NodeMetric(
+            meta=ObjectMeta(name=name),
+            node_usage=ResourceMetric(
+                usage={
+                    ext.RES_CPU: alloc[0] * cpu_pct / 100,
+                    ext.RES_MEMORY: alloc[1] * 0.1,
+                }
+            ),
+            update_time=1000.0,
+        ),
+        now=1010.0,
+    )
+
+
+def test_custom_usage_thresholds_per_node():
+    """A node carrying the usage-thresholds annotation filters with ITS
+    threshold while others keep the plugin-args global (load_aware.go
+    GetCustomUsageThresholds). Both nodes sit at 55% cpu: the global 65
+    admits, the custom 50 rejects."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        mknode(
+            "strict",
+            annotations={
+                ext.ANNOTATION_CUSTOM_USAGE_THRESHOLDS: json.dumps(
+                    {"usageThresholds": {ext.RES_CPU: 50}}
+                )
+            },
+        )
+    )
+    snap.upsert_node(mknode("lax"))
+    set_usage(snap, "strict", 55)
+    set_usage(snap, "lax", 55)
+    sched = BatchScheduler(snap, batch_bucket=128)
+    sched.extender.monitor.stop_background()
+    pods = [
+        Pod(
+            meta=ObjectMeta(name=f"p{i}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 100, ext.RES_MEMORY: 64}, priority=9000
+            ),
+        )
+        for i in range(4)
+    ]
+    out = sched.schedule(pods)
+    assert len(out.bound) == 4
+    assert {n for _, n in out.bound} == {"lax"}
+
+
+def test_node_reservation_quantity_strings_dropped():
+    """Code-review regression: non-numeric reservation values (k8s
+    quantity strings) must not crash upsert_node — they're dropped."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        mknode(
+            "n0",
+            annotations={
+                ext.ANNOTATION_NODE_RESERVATION: json.dumps(
+                    {"resources": {ext.RES_CPU: "300m", ext.RES_MEMORY: 1024}}
+                )
+            },
+        )
+    )
+    idx = snap.node_id("n0")
+    assert snap.nodes.allocatable[idx, 0] == 32000.0   # bad value dropped
+    assert snap.nodes.allocatable[idx, 1] == 64512.0   # numeric one applied
+    # non-dict resources / non-string reservedCPUs degrade safely too
+    snap.upsert_node(
+        mknode(
+            "n1",
+            annotations={
+                ext.ANNOTATION_NODE_RESERVATION: json.dumps(
+                    {"resources": 5, "reservedCPUs": 7}
+                )
+            },
+        )
+    )
+    assert snap.nodes.allocatable[snap.node_id("n1"), 0] == 32000.0
+
+
+def test_custom_thresholds_replace_wholesale():
+    """Code-review regression: a non-empty custom map supersedes the
+    global thresholds WHOLESALE — memory goes unchecked on the node whose
+    custom map only names cpu."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        mknode(
+            "custom",
+            annotations={
+                ext.ANNOTATION_CUSTOM_USAGE_THRESHOLDS: json.dumps(
+                    {"usageThresholds": {ext.RES_CPU: 90}}
+                )
+            },
+        )
+    )
+    idx = snap.node_id("custom")
+    alloc = snap.nodes.allocatable[idx]
+    # memory at 99% (over the global 95), cpu at 10%
+    snap.set_node_metric(
+        NodeMetric(
+            meta=ObjectMeta(name="custom"),
+            node_usage=ResourceMetric(
+                usage={
+                    ext.RES_CPU: alloc[0] * 0.10,
+                    ext.RES_MEMORY: alloc[1] * 0.99,
+                }
+            ),
+            update_time=1000.0,
+        ),
+        now=1010.0,
+    )
+    sched = BatchScheduler(snap, batch_bucket=128)
+    sched.extender.monitor.stop_background()
+    pod = Pod(
+        meta=ObjectMeta(name="p"),
+        spec=PodSpec(requests={ext.RES_CPU: 100, ext.RES_MEMORY: 1}, priority=9000),
+    )
+    out = sched.schedule([pod])
+    assert len(out.bound) == 1  # memory dim unchecked on this node
