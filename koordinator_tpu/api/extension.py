@@ -299,32 +299,15 @@ def parse_reservation_affinity(
     {labels}}`` requires a matching reservation. Presence means REQUIRED —
     a pod carrying this must allocate from a matching reservation or stay
     unschedulable."""
-    import json as _json
-
-    raw = annotations.get(ANNOTATION_RESERVATION_AFFINITY)
-    if not raw:
-        return None
-    try:
-        spec = _json.loads(raw)
-    except (ValueError, TypeError):
-        return None
-    return spec if isinstance(spec, dict) else None
+    return _parse_dict_annotation(annotations, ANNOTATION_RESERVATION_AFFINITY)
 
 
 def parse_gpu_partition_spec(annotations: Mapping[str, str]) -> tuple[bool, float]:
     """(restricted, ring_bus_bandwidth) from the pod's partition-spec
     annotation (``GPUPartitionSpec``: Restricted = only the best
     allocation-score tier may be used; BestEffort = walk down tiers)."""
-    import json as _json
-
-    raw = annotations.get(ANNOTATION_GPU_PARTITION_SPEC)
-    if not raw:
-        return False, 0.0
-    try:
-        spec = _json.loads(raw)
-    except (ValueError, TypeError):
-        return False, 0.0
-    if not isinstance(spec, dict):
+    spec = _parse_dict_annotation(annotations, ANNOTATION_GPU_PARTITION_SPEC)
+    if spec is None:
         return False, 0.0
     try:
         bandwidth = float(spec.get("ringBusBandwidth", 0.0))
@@ -339,6 +322,14 @@ NODE_RESERVATION_POLICY_DEFAULT = "Default"
 NODE_RESERVATION_POLICY_RESERVED_CPUS_ONLY = "ReservedCPUsOnly"
 #: per-node LoadAware threshold override (reference ``load_aware.go:30``)
 ANNOTATION_CUSTOM_USAGE_THRESHOLDS = f"scheduling.{DOMAIN}/usage-thresholds"
+#: per-node colocation overrides (reference ``node_colocation.go``):
+#: the annotation carries a ColocationStrategy JSON; the labels override
+#: the reclaim ratios with a float in (0, 1]
+ANNOTATION_NODE_COLOCATION_STRATEGY = f"node.{DOMAIN}/colocation-strategy"
+LABEL_CPU_RECLAIM_RATIO = f"node.{DOMAIN}/cpu-reclaim-ratio"
+LABEL_MEMORY_RECLAIM_RATIO = f"node.{DOMAIN}/memory-reclaim-ratio"
+#: reservation-preemption opt-out (reference ``preemption.go:28``)
+LABEL_DISABLE_PREEMPTIBLE = f"scheduling.{DOMAIN}/disable-preemptible"
 #: descheduling protocol (reference ``apis/extension/descheduling.go``)
 ANNOTATION_EVICTION_COST = f"scheduling.{DOMAIN}/eviction-cost"
 ANNOTATION_SOFT_EVICTION = f"scheduling.{DOMAIN}/soft-eviction"
@@ -395,6 +386,33 @@ def _parse_dict_annotation(annotations: Mapping[str, str], key: str):
     except (ValueError, TypeError):
         return None
     return spec if isinstance(spec, dict) else None
+
+
+def is_pod_preemptible(pod) -> bool:
+    """IsPodPreemptible (``preemption.go:47-56``): the disable-preemptible
+    label opts a pod out of being a preemption victim."""
+    return pod.meta.labels.get(LABEL_DISABLE_PREEMPTIBLE) != "true"
+
+
+def parse_node_colocation_strategy(annotations: Mapping[str, str]):
+    """Per-node ColocationStrategy override from the node annotation
+    (``node_colocation.go``), or None."""
+    return _parse_dict_annotation(
+        annotations, ANNOTATION_NODE_COLOCATION_STRATEGY
+    )
+
+
+def parse_reclaim_ratio(labels: Mapping[str, str], key: str):
+    """Float reclaim ratio from a node label; None when absent/illegal
+    (``node_colocation.go``: the illegal value will be ignored)."""
+    raw = labels.get(key)
+    if raw is None:
+        return None
+    try:
+        ratio = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return ratio if 0.0 < ratio <= 1.0 else None
 
 
 def parse_eviction_cost(annotations: Mapping[str, str]) -> int:

@@ -81,6 +81,12 @@ class NodeArrays:
                          threshold overrides from the usage-thresholds
                          annotation (``apis/extension/load_aware.go``);
                          0 = use the plugin-args global            [N, D]
+      colo_reclaim     — per-node (cpu, memory) reclaim-ratio override
+                         from the colocation-strategy annotation /
+                         reclaim-ratio labels (``node_colocation.go``);
+                         0 = use the cluster strategy              [N, 2]
+      colo_enable      — per-node colocation enable override: -1 follow
+                         the cluster strategy, 0 disable, 1 enable  [N]
     """
 
     allocatable: np.ndarray
@@ -96,6 +102,8 @@ class NodeArrays:
     cpu_amp: np.ndarray
     custom_thresholds: np.ndarray
     custom_prod_thresholds: np.ndarray
+    colo_reclaim: np.ndarray
+    colo_enable: np.ndarray
     n_real: int
 
     @classmethod
@@ -115,6 +123,8 @@ class NodeArrays:
             cpu_amp=np.ones((n_bucket,), np.float32),
             custom_thresholds=z(),
             custom_prod_thresholds=z(),
+            colo_reclaim=np.zeros((n_bucket, 2), np.float32),
+            colo_enable=np.full((n_bucket,), -1, np.int8),
             n_real=0,
         )
 
@@ -230,6 +240,8 @@ class ClusterSnapshot:
         self._assumed: Dict[str, "_AssumedPod"] = {}
         #: node name -> labels (nodeSelector/affinity masks read these)
         self._node_labels: Dict[str, Dict[str, str]] = {}
+        #: node name -> annotations (per-node strategy overrides read these)
+        self._node_annotations: Dict[str, Dict[str, str]] = {}
 
     def reset(self) -> None:
         """Clear all state in place (full-resync path: the snapshot object
@@ -240,6 +252,7 @@ class ClusterSnapshot:
         self.nodes = NodeArrays.empty(self.config.min_bucket, self.config.dims)
         self._assumed.clear()
         self._node_labels.clear()
+        self._node_annotations.clear()
         self.node_epoch += 1
 
     # ---- node side ----
@@ -271,6 +284,12 @@ class ClusterSnapshot:
             ),
             custom_thresholds=pad(old.custom_thresholds),
             custom_prod_thresholds=pad(old.custom_prod_thresholds),
+            colo_reclaim=pad(old.colo_reclaim),
+            colo_enable=np.pad(
+                old.colo_enable,
+                (0, new - old.colo_enable.shape[0]),
+                constant_values=-1,
+            ),
             n_real=old.n_real,
         )
 
@@ -328,8 +347,31 @@ class ClusterSnapshot:
                             k: v
                             for k, v in table.items()
                             if isinstance(v, (int, float))
+                            and not isinstance(v, bool)
                         }
                     )
+        # per-node colocation overrides (node_colocation.go), parsed once
+        # here so the manager's reconcile loop reads plain arrays
+        self.nodes.colo_reclaim[idx] = 0.0
+        self.nodes.colo_enable[idx] = -1
+        colo = ext.parse_node_colocation_strategy(node.meta.annotations)
+        if colo is not None:
+            if isinstance(colo.get("enable"), bool):
+                self.nodes.colo_enable[idx] = int(colo["enable"])
+            rr = colo.get("reserveRatio")
+            if (
+                isinstance(rr, (int, float))
+                and not isinstance(rr, bool)
+                and 0.0 <= rr < 1.0
+            ):
+                self.nodes.colo_reclaim[idx] = 1.0 - float(rr)
+        for col, key in (
+            (0, ext.LABEL_CPU_RECLAIM_RATIO),
+            (1, ext.LABEL_MEMORY_RECLAIM_RATIO),
+        ):
+            ratio = ext.parse_reclaim_ratio(node.meta.labels, key)
+            if ratio is not None:
+                self.nodes.colo_reclaim[idx, col] = ratio
         self.nodes.schedulable[idx] = not node.unschedulable
         amp = ext.parse_node_amplification(node.meta.annotations)
         new_amp = max(float(amp.get(ext.RES_CPU, 1.0)), 1.0)
@@ -350,14 +392,19 @@ class ClusterSnapshot:
                 ap.request = ap.request.copy()
                 ap.request[self._cpu_dim] = new_charge
         self._node_labels[node.meta.name] = dict(node.meta.labels)
+        self._node_annotations[node.meta.name] = dict(node.meta.annotations)
         return idx
 
     def node_labels(self, name: str) -> Mapping[str, str]:
         return self._node_labels.get(name, {})
 
+    def node_annotations(self, name: str) -> Mapping[str, str]:
+        return self._node_annotations.get(name, {})
+
     def remove_node(self, name: str) -> None:
         idx = self._node_index.pop(name, None)
         self._node_labels.pop(name, None)
+        self._node_annotations.pop(name, None)
         if idx is None:
             return
         self.node_epoch += 1
@@ -376,6 +423,8 @@ class ClusterSnapshot:
         self.nodes.cpu_amp[idx] = 1.0
         self.nodes.custom_thresholds[idx] = 0.0
         self.nodes.custom_prod_thresholds[idx] = 0.0
+        self.nodes.colo_reclaim[idx] = 0.0
+        self.nodes.colo_enable[idx] = -1
         # Drop assumed-pod bookkeeping for the dead node so a later
         # forget_pod cannot corrupt whichever node reuses this slot.
         self._assumed = {

@@ -21,9 +21,9 @@ from ..api.types import Pod, PodPhase
 
 #: opt-out/opt-in annotation honored by the policy (sigs descheduler)
 ANNOTATION_EVICT_OPT_OUT = "descheduler.alpha.kubernetes.io/prefer-no-eviction"
-#: soft-eviction marker label; the SoftEvictionSpec JSON itself goes
-#: under ext.ANNOTATION_SOFT_EVICTION (reference descheduling.go:40-54)
-LABEL_SOFT_EVICTION = f"scheduling.{ext.DOMAIN}/soft-eviction"
+#: soft-eviction marker label; same key as the spec annotation so the
+#: two can never diverge (reference descheduling.go:40-54)
+LABEL_SOFT_EVICTION = ext.ANNOTATION_SOFT_EVICTION
 
 
 @dataclasses.dataclass

@@ -198,6 +198,14 @@ class LowNodeLoad:
                 continue
             if exclude_uids and pod.meta.uid in exclude_uids:
                 continue
+            # MaxInt32 eviction cost = never evict: selecting such a pod
+            # would burn the per-node budget and low-node headroom on an
+            # eviction the evictor chain will refuse (descheduling.go:33)
+            if (
+                ext.parse_eviction_cost(pod.meta.annotations)
+                >= ext.EVICTION_COST_MAX
+            ):
+                continue
             idx = self.snapshot.node_id(pod.spec.node_name)
             if idx is not None and cls.high[idx]:
                 by_node.setdefault(idx, []).append(pod)

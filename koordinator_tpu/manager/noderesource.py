@@ -92,7 +92,15 @@ class NodeResourceController:
         s = self.strategy
         cols = [self._cpu, self._mem]
         base = na.allocatable[:, cols]
-        margin = base * s.reserve_ratio
+        # per-node overrides (node_colocation.go), parsed once at
+        # upsert_node into dense columns: reclaim ratio r keeps
+        # r×allocatable for colocation (margin = (1−r)×allocatable), and
+        # colo_enable is a tri-state that takes precedence over the
+        # cluster enable in BOTH directions
+        reclaim = np.where(
+            na.colo_reclaim > 0.0, na.colo_reclaim, 1.0 - s.reserve_ratio
+        )
+        margin = base * (1.0 - reclaim)
         reserved = self.snapshot.config.res_vector(s.node_reserved)[cols]
         sys_used = np.maximum(na.sys_usage[:, cols], reserved[None, :])
         prod_used = (
@@ -123,9 +131,11 @@ class NodeResourceController:
         # do not actually use at peak (reference midresource plugin) — NOT
         # total allocatable headroom, which would overstate mid capacity.
         mid = np.maximum(prod_requested - prod_used, 0.0) * s.mid_reclaim_ratio
-        if not s.enable:
-            batch = np.zeros_like(batch)
-            mid = np.zeros_like(mid)
+        enable_eff = np.where(
+            na.colo_enable >= 0, na.colo_enable.astype(bool), s.enable
+        )
+        batch[~enable_eff] = 0.0
+        mid[~enable_eff] = 0.0
         if s.degrade_on_stale_metric:
             stale = ~na.metric_fresh
             batch[stale] = 0.0

@@ -424,8 +424,12 @@ class GroupQuotaManager:
 
 
 def is_pod_non_preemptible(pod: Pod) -> bool:
-    """Reference ``apis/extension/elastic_quota.go:85-87``."""
-    return pod.meta.labels.get(ext.LABEL_PREEMPTIBLE) == "false"
+    """Reference ``apis/extension/elastic_quota.go:85-87`` (quota
+    preemptible label) + ``preemption.go:47-56`` (the scheduling-domain
+    disable-preemptible opt-out honored by every preemption path)."""
+    if pod.meta.labels.get(ext.LABEL_PREEMPTIBLE) == "false":
+        return True
+    return not ext.is_pod_preemptible(pod)
 
 
 @dataclasses.dataclass

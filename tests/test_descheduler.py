@@ -674,3 +674,20 @@ def test_eviction_cost_orders_and_protects():
         ext.EVICTION_COST_MAX
     )
     assert not PodEvictionPolicy(evict_ownerless=True).evictable(protected)
+
+
+def test_never_evict_pod_not_selected():
+    """Code-review regression: MaxInt32-cost pods are filtered out of
+    victim SELECTION (not just evictability), so they never consume the
+    per-node eviction budget."""
+    snap = make_cluster([90, 20])
+    lnl = LowNodeLoad(
+        snap, LowNodeLoadArgs(anomaly_condition_count=1, max_evictions_per_node=1)
+    )
+    protected = bound_pod("protected", "n0", prio=5500)
+    protected.meta.annotations[ext.ANNOTATION_EVICTION_COST] = str(
+        ext.EVICTION_COST_MAX
+    )
+    normal = bound_pod("normal", "n0", prio=9000)  # higher band
+    victims = lnl.select_victims([protected, normal])
+    assert [v.meta.name for v in victims] == ["normal"]

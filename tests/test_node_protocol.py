@@ -204,3 +204,112 @@ def test_custom_thresholds_replace_wholesale():
     )
     out = sched.schedule([pod])
     assert len(out.bound) == 1  # memory dim unchecked on this node
+
+
+def test_per_node_reclaim_ratio_and_strategy_override():
+    """node_colocation.go: the reclaim-ratio labels and the
+    colocation-strategy annotation override the cluster strategy per
+    node."""
+    from koordinator_tpu.manager.noderesource import (
+        ColocationStrategy,
+        NodeResourceController,
+    )
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("plain"))
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(
+                name="tight",
+                labels={ext.LABEL_CPU_RECLAIM_RATIO: "0.5"},
+            ),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 65536}
+            ),
+        )
+    )
+    snap.upsert_node(
+        mknode(
+            "off",
+            annotations={
+                ext.ANNOTATION_NODE_COLOCATION_STRATEGY: json.dumps(
+                    {"enable": False}
+                )
+            },
+        )
+    )
+    for name in ("plain", "tight", "off"):
+        set_usage(snap, name, 10)
+    ctl = NodeResourceController(snap, ColocationStrategy(reserve_ratio=0.1))
+    batch, _mid = ctl.calculate()
+    plain, tight, off = (
+        snap.node_id("plain"), snap.node_id("tight"), snap.node_id("off")
+    )
+    # plain keeps 90% of cpu for colocation, tight only 50%
+    assert batch[plain, 0] > batch[tight, 0] > 0
+    assert batch[tight, 0] < 32000 * 0.55
+    assert batch[off, 0] == 0 and batch[off, 1] == 0
+    # illegal label value is ignored
+    assert ext.parse_reclaim_ratio({ext.LABEL_CPU_RECLAIM_RATIO: "junk"},
+                                   ext.LABEL_CPU_RECLAIM_RATIO) is None
+    assert ext.parse_reclaim_ratio({ext.LABEL_CPU_RECLAIM_RATIO: "1.5"},
+                                   ext.LABEL_CPU_RECLAIM_RATIO) is None
+
+
+def test_disable_preemptible_label():
+    """preemption.go:28: the disable-preemptible label opts a pod out of
+    preemption victimhood."""
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        is_pod_non_preemptible,
+    )
+
+    p = Pod(meta=ObjectMeta(name="v"), spec=PodSpec())
+    assert not is_pod_non_preemptible(p)
+    p.meta.labels[ext.LABEL_DISABLE_PREEMPTIBLE] = "true"
+    assert is_pod_non_preemptible(p)
+
+
+def test_node_enable_true_overrides_cluster_disable():
+    """Code-review regression: '{\"enable\": true}' on a node re-enables
+    colocation past a cluster-wide disable (the annotation takes
+    precedence in BOTH directions)."""
+    from koordinator_tpu.manager.noderesource import (
+        ColocationStrategy,
+        NodeResourceController,
+    )
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("plain"))
+    snap.upsert_node(
+        mknode(
+            "optin",
+            annotations={
+                ext.ANNOTATION_NODE_COLOCATION_STRATEGY: json.dumps(
+                    {"enable": True}
+                )
+            },
+        )
+    )
+    for name in ("plain", "optin"):
+        set_usage(snap, name, 10)
+    ctl = NodeResourceController(snap, ColocationStrategy(enable=False))
+    batch, _ = ctl.calculate()
+    assert batch[snap.node_id("plain"), 0] == 0.0
+    assert batch[snap.node_id("optin"), 0] > 0.0
+
+
+def test_bool_threshold_value_dropped():
+    """Code-review regression: a bool in the custom-thresholds map (an int
+    subclass) must be dropped, not treated as 1%."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        mknode(
+            "n0",
+            annotations={
+                ext.ANNOTATION_CUSTOM_USAGE_THRESHOLDS: json.dumps(
+                    {"usageThresholds": {ext.RES_CPU: True}}
+                )
+            },
+        )
+    )
+    assert snap.nodes.custom_thresholds[snap.node_id("n0")].sum() == 0.0
