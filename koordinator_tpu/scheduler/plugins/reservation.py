@@ -83,15 +83,29 @@ def _score_reservation(pod: Pod, r: Reservation) -> float:
 class ReservationManager:
     """Schedules pending reservations as ghost pods and brokers matches."""
 
-    def __init__(self, scheduler: "BatchScheduler"):
+    def __init__(
+        self, scheduler: "BatchScheduler", gc_duration_s: float = 24 * 3600.0
+    ):
         self.scheduler = scheduler
         scheduler.reservations = self  # enable the pre-match commit path
         self._reservations: Dict[str, Reservation] = {}
         #: per-cycle Available candidate cache (see begin_cycle)
         self._cycle_candidates: Optional[List[Reservation]] = None
         self._cycle_epoch = -1
+        #: terminal reservations are deleted after this long (reference
+        #: controller/garbage_collection.go, ReservationArgs.GCDuration)
+        self.gc_duration_s = gc_duration_s
+        #: reservation name -> {pod uid: requests at allocate time}, for
+        #: owner-drift refunds (controller.go:221-260 syncStatus)
+        self._owner_requests: Dict[str, Dict[str, Dict[str, float]]] = {}
+        #: reservation name -> when it went FAILED/SUCCEEDED (GC base)
+        self._terminal_time: Dict[str, float] = {}
 
     def add(self, reservation: Reservation) -> None:
+        # a re-created name must not inherit the old incarnation's
+        # terminal clock or owner ledger (premature GC / stale refunds)
+        self._terminal_time.pop(reservation.meta.name, None)
+        self._owner_requests.pop(reservation.meta.name, None)
         self._reservations[reservation.meta.name] = reservation
         self._cycle_candidates = None
 
@@ -289,7 +303,7 @@ class ReservationManager:
                 # node removed from the cluster: the ghost hold died with
                 # it (remove_node purges assumed pods) — fail the
                 # reservation instead of nominating a dead node
-                r.phase = ReservationPhase.FAILED
+                self._set_terminal(r, ReservationPhase.FAILED)
                 continue
             candidates.append(r)
         self._cycle_candidates = candidates
@@ -365,9 +379,12 @@ class ReservationManager:
         for k, v in pod.spec.requests.items():
             reservation.allocated[k] = reservation.allocated.get(k, 0.0) + v
         reservation.current_owners.append(pod.meta.uid)
+        self._owner_requests.setdefault(reservation.meta.name, {})[
+            pod.meta.uid
+        ] = dict(pod.spec.requests)
         if reservation.allocate_once:
             reservation.allocated = dict(reservation.requests)
-            reservation.phase = ReservationPhase.SUCCEEDED
+            self._set_terminal(reservation, ReservationPhase.SUCCEEDED)
         else:
             ghost = self._remainder_ghost(reservation)
             if ghost.spec.requests:
@@ -385,5 +402,78 @@ class ReservationManager:
         if r.phase == ReservationPhase.AVAILABLE:
             self.release_ghost_holds(r)
             self.scheduler.snapshot.forget_pod(_ghost_uid(r))
-        r.phase = ReservationPhase.FAILED
+        self._set_terminal(r, ReservationPhase.FAILED)
         return True
+
+    def _set_terminal(self, r: Reservation, phase: ReservationPhase) -> None:
+        import time as _t
+
+        # callers only transition from non-terminal phases, so overwrite —
+        # setdefault would keep a GC'd-then-recreated name's old clock
+        r.phase = phase
+        self._terminal_time[r.meta.name] = _t.time()
+
+    def sync(self, now: Optional[float] = None) -> Dict[str, List[str]]:
+        """The reservation controller's periodic sweep (reference
+        ``plugins/reservation/controller/``): expire TTL'd reservations,
+        reconcile owner drift, and garbage-collect terminal ones.
+
+        Owner drift (``controller.go:221-260`` syncStatus): an owner pod
+        that vanished (no longer assumed in the snapshot) refunds its
+        allocation, and the freed remainder is re-held by the ghost so
+        other pods can't steal reserved capacity.
+
+        GC (``garbage_collection.go:38-55``): Failed/Succeeded
+        reservations older than ``gc_duration_s`` are deleted.
+        Returns {"expired": [...], "drifted": [...], "deleted": [...]}."""
+        import time as _t
+
+        now = now if now is not None else _t.time()
+        report: Dict[str, List[str]] = {
+            "expired": self.expire(now),
+            "drifted": [],
+            "deleted": [],
+        }
+        snap = self.scheduler.snapshot
+        for r in self._reservations.values():
+            if r.phase != ReservationPhase.AVAILABLE or not r.current_owners:
+                continue
+            gone = [u for u in r.current_owners if u not in snap._assumed]
+            if not gone:
+                continue
+            ledger = self._owner_requests.get(r.meta.name, {})
+            for uid in gone:
+                refund = ledger.pop(uid, {})
+                for k, v in refund.items():
+                    r.allocated[k] = max(r.allocated.get(k, 0.0) - v, 0.0)
+                r.current_owners.remove(uid)
+                # the dead owner's exact device/NUMA holds must free too —
+                # match() re-offers this capacity, and a stuck minor would
+                # fail every future owner's Reserve (the eviction path
+                # releases the same four holds)
+                if getattr(self.scheduler, "devices", None) is not None:
+                    self.scheduler.devices.release(uid, r.node_name)
+                if getattr(self.scheduler, "numa", None) is not None:
+                    self.scheduler.numa.release(uid, r.node_name)
+            # re-hold the freed remainder so it stays reserved
+            snap.forget_pod(_ghost_uid(r))
+            ghost = self._remainder_ghost(r)
+            if ghost.spec.requests:
+                snap.assume_pod(ghost, r.node_name)
+            report["drifted"].append(r.meta.name)
+            self._cycle_candidates = None
+        for name, t0 in list(self._terminal_time.items()):
+            r = self._reservations.get(name)
+            if r is None:
+                del self._terminal_time[name]
+                continue
+            if r.phase in (
+                ReservationPhase.FAILED,
+                ReservationPhase.SUCCEEDED,
+            ) and now - t0 > self.gc_duration_s:
+                del self._reservations[name]
+                del self._terminal_time[name]
+                self._owner_requests.pop(name, None)
+                self._cycle_candidates = None
+                report["deleted"].append(name)
+        return report

@@ -691,3 +691,68 @@ def test_never_evict_pod_not_selected():
     normal = bound_pod("normal", "n0", prio=9000)  # higher band
     victims = lnl.select_victims([protected, normal])
     assert [v.meta.name for v in victims] == ["normal"]
+
+
+# ---- reservation controller sweep (plugins/reservation/controller/) ----
+
+
+def test_reservation_owner_drift_refunds_and_reholds():
+    """syncStatus (controller.go:221-260): a vanished owner pod refunds
+    its allocation and the freed remainder is re-held by the ghost."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="hold"),
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8192},
+            owners=[ReservationOwner(label_selector={"app": "a"})],
+            allocate_once=False,
+        )
+    )
+    assert rm.schedule_pending() == 1
+    owner = bound_pod("owner-0", None, cpu=4000, prio=9000, labels={"app": "a"})
+    owner.spec.node_name = None
+    out = sched.schedule([owner])
+    assert len(out.bound) == 1
+    r = rm.get("hold")
+    assert r.allocated.get(ext.RES_CPU) == 4000
+    assert len(r.current_owners) == 1
+    # owner pod dies: forget it, then the controller sweep reconciles
+    snap.forget_pod(out.bound[0][0].meta.uid)
+    report = rm.sync()
+    assert report["drifted"] == ["hold"]
+    assert r.allocated.get(ext.RES_CPU, 0.0) == 0.0
+    assert r.current_owners == []
+    # freed capacity is re-held: node requested carries the full ghost
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 8000.0
+
+
+def test_reservation_gc_after_duration():
+    """garbage_collection.go: terminal reservations older than gcDuration
+    are deleted."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched, gc_duration_s=60.0)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="dead"),
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1024},
+            owners=[ReservationOwner(label_selector={"app": "x"})],
+        )
+    )
+    assert rm.schedule_pending() == 1
+    rm.expire_reservation("dead")
+    assert rm.get("dead").phase == ReservationPhase.FAILED
+    import time
+
+    assert rm.sync(now=time.time() + 30)["deleted"] == []   # too young
+    assert rm.sync(now=time.time() + 120)["deleted"] == ["dead"]
+    assert rm.get("dead") is None
