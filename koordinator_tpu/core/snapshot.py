@@ -606,6 +606,12 @@ class ClusterSnapshot:
                 bind_nominal_cpu=float(bind_nominals[k]),
             )
 
+    def is_assumed(self, pod_uid: str) -> bool:
+        """Whether a pod currently holds an assume/bound charge — the
+        liveness signal external reconcilers (reservation owner drift) key
+        off, without reaching into the internal store."""
+        return pod_uid in self._assumed
+
     def expire_assumed(self, now: float, ttl: float) -> int:
         """Forget optimistic (unconfirmed) assumes older than ``ttl``
         seconds — the reference scheduler cache's assumed-pod expiration.

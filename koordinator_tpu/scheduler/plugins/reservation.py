@@ -84,8 +84,17 @@ class ReservationManager:
     """Schedules pending reservations as ghost pods and brokers matches."""
 
     def __init__(
-        self, scheduler: "BatchScheduler", gc_duration_s: float = 24 * 3600.0
+        self,
+        scheduler: "BatchScheduler",
+        gc_duration_s: float = 24 * 3600.0,
+        clock=None,
     ):
+        import time as _t
+
+        #: every reservation timestamp (available/terminal) and every
+        #: default `now` comes from this one clock, so an injected
+        #: simulated clock measures TTL/GC windows consistently
+        self._clock = clock if clock is not None else _t.time
         self.scheduler = scheduler
         scheduler.reservations = self  # enable the pre-match commit path
         self._reservations: Dict[str, Reservation] = {}
@@ -111,6 +120,11 @@ class ReservationManager:
 
     def get(self, name: str) -> Optional[Reservation]:
         return self._reservations.get(name)
+
+    def owner_ledger(self, name: str) -> Dict[str, Dict[str, float]]:
+        """{pod uid: requests} recorded at allocate time for a
+        reservation's live owners (read-only view for invariant checks)."""
+        return dict(self._owner_requests.get(name, {}))
 
     def list(self) -> List[Reservation]:
         return list(self._reservations.values())
@@ -140,14 +154,13 @@ class ReservationManager:
             return 0
         ghosts = {_ghost_uid(r): r for r in pending}
         outcome = self.scheduler.schedule([self._ghost_pod(r) for r in pending])
-        import time as _t
 
         self._cycle_candidates = None
         for pod, node in outcome.bound:
             r = ghosts[pod.meta.uid]
             r.phase = ReservationPhase.AVAILABLE
             r.node_name = node
-            r.available_time = _t.time()
+            r.available_time = self._clock()
             self._resize_to_allocation(r, pod)
             # the ghost hold's lifecycle is owned here, not by a
             # pod_assumed sync — without confirmation expire_assumed()
@@ -206,9 +219,7 @@ class ReservationManager:
     def expire(self, now: Optional[float] = None) -> List[str]:
         """Fail Available reservations past their TTL with no owners,
         releasing their holds. Returns the expired names."""
-        import time as _t
-
-        now = now if now is not None else _t.time()
+        now = now if now is not None else self._clock()
         expired: List[str] = []
         for r in list(self._reservations.values()):
             if (
@@ -406,12 +417,10 @@ class ReservationManager:
         return True
 
     def _set_terminal(self, r: Reservation, phase: ReservationPhase) -> None:
-        import time as _t
-
         # callers only transition from non-terminal phases, so overwrite —
         # setdefault would keep a GC'd-then-recreated name's old clock
         r.phase = phase
-        self._terminal_time[r.meta.name] = _t.time()
+        self._terminal_time[r.meta.name] = self._clock()
 
     def sync(self, now: Optional[float] = None) -> Dict[str, List[str]]:
         """The reservation controller's periodic sweep (reference
@@ -426,9 +435,7 @@ class ReservationManager:
         GC (``garbage_collection.go:38-55``): Failed/Succeeded
         reservations older than ``gc_duration_s`` are deleted.
         Returns {"expired": [...], "drifted": [...], "deleted": [...]}."""
-        import time as _t
-
-        now = now if now is not None else _t.time()
+        now = now if now is not None else self._clock()
         report: Dict[str, List[str]] = {
             "expired": self.expire(now),
             "drifted": [],
@@ -438,7 +445,7 @@ class ReservationManager:
         for r in self._reservations.values():
             if r.phase != ReservationPhase.AVAILABLE or not r.current_owners:
                 continue
-            gone = [u for u in r.current_owners if u not in snap._assumed]
+            gone = [u for u in r.current_owners if not snap.is_assumed(u)]
             if not gone:
                 continue
             ledger = self._owner_requests.get(r.meta.name, {})

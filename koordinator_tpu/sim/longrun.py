@@ -12,6 +12,12 @@ Composes, per simulated tick (15 s):
               profile webhook) are batch-scheduled against batch capacity
   koordlet  — runtimehooks derive the cgroup plan for each new bind;
               qosmanager computes the BE suppression allowance
+  reservations — a rolling prod Reservation holds warm capacity; owner
+              pods consume it through the fast path; dead owners are
+              reconciled and TTL'd reservations expire via the
+              controller sweep (plugins/reservation/controller analog)
+  descheduler — LowNodeLoad classifies nodes each report interval and
+              soft-evicts BE pods from debounced-hot nodes
 
 Pods complete after a few ticks and release capacity; prod load follows a
 sinusoid so batch capacity breathes. Invariants checked every tick:
@@ -19,6 +25,7 @@ sinusoid so batch capacity breathes. Invariants checked every tick:
   * snapshot accounting never drifts: requested == Σ live assumes
   * batch-cpu requested never exceeds batch allocatable on any node
   * suppression allowance shrinks when prod crosses the threshold
+  * reservation ledger: allocated == Σ live owner requests
 
 Entry points: ``python -m koordinator_tpu.cmd.koord_sim`` (binary),
 ``examples/longrun_loop.py`` (narrated demo),
@@ -98,6 +105,39 @@ def run_loop(
     )
     sched = BatchScheduler(snap, LoadAwareArgs(), batch_bucket=128)
     sched.extender.monitor.stop_background()
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+    from koordinator_tpu.descheduler.evictor import SoftEvictor
+    from koordinator_tpu.descheduler.low_node_load import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+    )
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationManager,
+        ReservationPhase,
+    )
+
+    # the sim clock: wall-anchored, advancing by simulated time — every
+    # reservation timestamp and sweep comparison uses the same domain
+    import time as _time
+
+    _wall0 = _time.time()
+    sim_tick = [0]
+
+    def sim_clock() -> float:
+        return _wall0 + sim_tick[0] * tick_s
+
+    rm = ReservationManager(
+        sched, gc_duration_s=6 * tick_s, clock=sim_clock
+    )
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(
+            high_thresholds={ext.RES_CPU: 70.0},
+            low_thresholds={ext.RES_CPU: 50.0},
+            anomaly_condition_count=1,
+        ),
+    )
+    soft_evictor = SoftEvictor()
 
     bc = snap.config.resources.index(ext.RES_BATCH_CPU)
     rows = [snap.node_id(f"n{i}") for i in range(n_nodes)]
@@ -119,9 +159,21 @@ def run_loop(
         "min_batch_cap": float("inf"),
         "max_batch_cap": 0.0,
     }
+    stats.update(
+        reservations_created=0,
+        reservations_consumed=0,
+        reservations_expired=0,
+        reservations_drifted=0,
+        reservations_gced=0,
+        soft_evicted=0,
+    )
     n_ticks = int(minutes * 60.0 / tick_s)
     pod_seq = 0
+    resv_seq = 0
+    svc_seq = 0
+    svc_live: list = []   # (pod, done_tick)
     for tick in range(n_ticks):
+        sim_tick[0] = tick
         now = 1000.0 + tick * tick_s
         stats["ticks"] += 1
 
@@ -191,10 +243,47 @@ def run_loop(
             arriving.append(pod)
         stats["arrived"] += len(arriving)
 
+        # ---- reservations: rolling warm capacity for prod services ----
+        if tick % 12 == 0:
+            resv_seq += 1
+            rm.add(
+                Reservation(
+                    meta=ObjectMeta(name=f"svc-hold-{resv_seq}"),
+                    requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 16384},
+                    owners=[ReservationOwner(label_selector={"app": "svc"})],
+                    allocate_once=False,
+                    ttl_s=10 * tick_s,
+                )
+            )
+            if rm.schedule_pending():
+                stats["reservations_created"] += 1
+        if tick % 12 == 4 and any(
+            r.phase == ReservationPhase.AVAILABLE for r in rm.list()
+        ):
+            # an owner pod arrives and consumes from the reservation;
+            # it dies young (owner drift) half the time
+            svc_seq += 1
+            svc = Pod(
+                meta=ObjectMeta(
+                    name=f"svc-{svc_seq:04d}",
+                    labels={"app": "svc"},
+                ),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                    priority=9500,
+                ),
+            )
+            svc_out = sched.schedule([svc])
+            if svc_out.bound:
+                stats["reservations_consumed"] += 1
+                lifetime = 3 if svc_seq % 2 else 14
+                svc_live.append((svc_out.bound[0][0], tick + lifetime))
+
         out = sched.schedule(arriving)
         stats["bound"] += len(out.bound)
         stats["unschedulable"] += len(out.unschedulable)
         for pod, node in out.bound:
+            pod.spec.node_name = node  # the bind writes spec.nodeName
             plan = runtimehooks.pod_plan(pod)
             assert "bvt" in str(plan)
             live.append((pod, node, tick + BE_LIFETIME))
@@ -221,6 +310,48 @@ def run_loop(
             else:
                 still.append((pod, node, done))
         live = still
+        # svc owners complete/die the same way; the controller sweep then
+        # reconciles the drift and expires TTL'd reservations
+        svc_still = []
+        for pod, done in svc_live:
+            if done <= tick:
+                snap.forget_pod(pod.meta.uid)
+                sched._bound_nodes.pop(pod.meta.uid, None)
+            else:
+                svc_still.append((pod, done))
+        svc_live = svc_still
+        sweep = rm.sync()
+        stats["reservations_expired"] += len(sweep["expired"])
+        stats["reservations_drifted"] += len(sweep["drifted"])
+        stats["reservations_gced"] += len(sweep["deleted"])
+
+        # ---- descheduler: LowNodeLoad soft-evicts from debounced-hot ----
+        if tick % REPORT_EVERY == 0:
+            cls = lnl.classify()
+            evicted_uids = set()
+            for victim in lnl.select_victims([p for p, _, _ in live], cls):
+                if soft_evictor.evict(victim, "node overutilized"):
+                    stats["soft_evicted"] += 1
+                    evicted_uids.add(victim.meta.uid)
+            if evicted_uids:
+                # the workload controller reacts to the soft-eviction mark
+                # by replacing the pod: early-complete it next tick so the
+                # descheduling leg actually moves cluster state
+                live = [
+                    (p, n, min(d, tick + 1) if p.meta.uid in evicted_uids else d)
+                    for p, n, d in live
+                ]
+
+        # reservation ledger invariant: allocated == Σ live owner requests
+        for r in rm.list():
+            if r.phase != ReservationPhase.AVAILABLE:
+                continue
+            ledger = rm.owner_ledger(r.meta.name)
+            want_cpu = sum(
+                ledger.get(uid, {}).get(ext.RES_CPU, 0.0)
+                for uid in r.current_owners
+            )
+            assert abs(r.allocated.get(ext.RES_CPU, 0.0) - want_cpu) < 1e-3
 
         # ---- invariants ----
         # 1. accounting: requested equals the sum of live assumes

@@ -19,3 +19,12 @@ def test_longrun_feedback_loop_stays_consistent():
     assert stats["max_batch_cap"] - stats["min_batch_cap"] > 10_000
     # suppression engaged during the load peaks
     assert stats["suppressions"] > 0
+    # the reservation lifecycle ran end to end: created → consumed →
+    # owner-drift reconciled → TTL-expired → garbage-collected
+    assert stats["reservations_created"] >= 2
+    assert stats["reservations_consumed"] >= 1
+    assert stats["reservations_drifted"] >= 1
+    assert stats["reservations_expired"] >= 1
+    assert stats["reservations_gced"] >= 1
+    # the descheduler soft-evicted BE pods from debounced-hot nodes
+    assert stats["soft_evicted"] >= 1
