@@ -328,6 +328,14 @@ ANNOTATION_CUSTOM_USAGE_THRESHOLDS = f"scheduling.{DOMAIN}/usage-thresholds"
 ANNOTATION_NODE_COLOCATION_STRATEGY = f"node.{DOMAIN}/colocation-strategy"
 LABEL_CPU_RECLAIM_RATIO = f"node.{DOMAIN}/cpu-reclaim-ratio"
 LABEL_MEMORY_RECLAIM_RATIO = f"node.{DOMAIN}/memory-reclaim-ratio"
+#: pods operating as reservations (reference ``operating_pod.go``)
+LABEL_POD_OPERATING_MODE = f"scheduling.{DOMAIN}/operating-mode"
+POD_OPERATING_MODE_RUNNABLE = "Runnable"
+POD_OPERATING_MODE_RESERVATION = "Reservation"
+ANNOTATION_RESERVATION_OWNERS = f"scheduling.{DOMAIN}/reservation-owners"
+ANNOTATION_RESERVATION_CURRENT_OWNER = (
+    f"scheduling.{DOMAIN}/reservation-current-owner"
+)
 #: reservation-preemption opt-out (reference ``preemption.go:28``)
 LABEL_DISABLE_PREEMPTIBLE = f"scheduling.{DOMAIN}/disable-preemptible"
 #: descheduling protocol (reference ``apis/extension/descheduling.go``)
@@ -386,6 +394,32 @@ def _parse_dict_annotation(annotations: Mapping[str, str], key: str):
     except (ValueError, TypeError):
         return None
     return spec if isinstance(spec, dict) else None
+
+
+def is_reservation_operating_mode(pod) -> bool:
+    """IsReservationOperatingMode (``operating_pod.go:52-54``): the pod
+    represents a scheduling and resource reservation unit."""
+    return (
+        pod.meta.labels.get(LABEL_POD_OPERATING_MODE)
+        == POD_OPERATING_MODE_RESERVATION
+    )
+
+
+def parse_reservation_owners(annotations: Mapping[str, str]):
+    """ReservationOwner list from the reservation-owners annotation
+    (``operating_pod.go:70-79`` GetReservationOwners): a JSON list of
+    ``{"labelSelector": {"matchLabels": {...}}, "namespace": ...}``.
+    Returns [] when absent/malformed."""
+    import json as _json
+
+    raw = annotations.get(ANNOTATION_RESERVATION_OWNERS)
+    if not raw:
+        return []
+    try:
+        items = _json.loads(raw)
+    except (ValueError, TypeError):
+        return []
+    return items if isinstance(items, list) else []
 
 
 def is_pod_preemptible(pod) -> bool:

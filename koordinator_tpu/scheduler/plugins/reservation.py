@@ -21,6 +21,7 @@ from ...api.types import (
     Pod,
     PodSpec,
     Reservation,
+    ReservationOwner,
     ReservationPhase,
 )
 
@@ -29,6 +30,35 @@ GHOST_PRIORITY = 9800  # reserve pods schedule in the prod band
 
 def _ghost_uid(reservation: Reservation) -> str:
     return f"reservation-ghost/{reservation.meta.name}"
+
+
+def reservation_from_operating_pod(pod: Pod) -> Reservation:
+    """A Reservation view over a pod operating in Reservation mode
+    (reference ``operating_pod.go`` + ``reservation_info.go``
+    NewReservationInfoFromPod): requests are the pod's requests, owners
+    come from the reservation-owners annotation."""
+    owners = []
+    for item in ext.parse_reservation_owners(pod.meta.annotations):
+        if not isinstance(item, dict):
+            continue
+        selector = (item.get("labelSelector") or {}).get("matchLabels") or {}
+        owners.append(
+            ReservationOwner(
+                label_selector=dict(selector),
+                namespace=item.get("namespace"),
+            )
+        )
+    return Reservation(
+        meta=ObjectMeta(
+            name=pod.meta.name,
+            namespace=pod.meta.namespace,
+            labels=dict(pod.meta.labels),
+            annotations=dict(pod.meta.annotations),
+        ),
+        requests=dict(pod.spec.requests),
+        owners=owners,
+        allocate_once=True,
+    )
 
 
 def matches_owner(reservation: Reservation, pod: Pod) -> bool:
@@ -109,6 +139,9 @@ class ReservationManager:
         self._owner_requests: Dict[str, Dict[str, Dict[str, float]]] = {}
         #: reservation name -> when it went FAILED/SUCCEEDED (GC base)
         self._terminal_time: Dict[str, float] = {}
+        #: reservations backed by operating-mode pods: name -> the pod
+        #: whose own assume IS the capacity hold (operating_pod.go)
+        self._operating: Dict[str, Pod] = {}
 
     def add(self, reservation: Reservation) -> None:
         # a re-created name must not inherit the old incarnation's
@@ -128,6 +161,36 @@ class ReservationManager:
 
     def list(self) -> List[Reservation]:
         return list(self._reservations.values())
+
+    def _hold_uid(self, r: Reservation) -> str:
+        """Uid of the snapshot assume holding this reservation's capacity:
+        the operating pod's own uid when the reservation IS a pod, the
+        synthetic ghost uid otherwise."""
+        op = self._operating.get(r.meta.name)
+        return op.meta.uid if op is not None else _ghost_uid(r)
+
+    def ingest_operating_pod(self, pod: Pod) -> Optional[Reservation]:
+        """Register a Reservation-operating-mode pod as a reservation
+        (reference ``pod_eventhandler.go``): a bound pod's existing assume
+        becomes the capacity hold and the reservation is immediately
+        Available; a pending pod registers Pending and becomes Available
+        when its bind is ingested again."""
+        if not ext.is_reservation_operating_mode(pod):
+            return None
+        r = self._reservations.get(pod.meta.name)
+        if r is None:
+            r = reservation_from_operating_pod(pod)
+            self.add(r)
+        self._operating[r.meta.name] = pod
+        if pod.spec.node_name and r.phase == ReservationPhase.PENDING:
+            r.phase = ReservationPhase.AVAILABLE
+            r.node_name = pod.spec.node_name
+            r.available_time = self._clock()
+            # the pod's own charge is the hold — pin it against expiry
+            if self.scheduler.snapshot.is_assumed(pod.meta.uid):
+                self.scheduler.snapshot.confirm_pod(pod.meta.uid)
+            self._cycle_candidates = None
+        return r
 
     # ---- scheduling the reserve pods ----
 
@@ -149,6 +212,10 @@ class ReservationManager:
             r
             for r in self._reservations.values()
             if r.phase == ReservationPhase.PENDING
+            # operating-pod reservations become Available through their
+            # own pod's bind (ingest_operating_pod), never via a ghost —
+            # a ghost here would double-charge and leak a confirmed hold
+            and r.meta.name not in self._operating
         ]
         if not pending:
             return 0
@@ -341,7 +408,7 @@ class ReservationManager:
         node = reservation.node_name
         if node is None:
             return
-        uid = _ghost_uid(reservation)
+        uid = self._hold_uid(reservation)
         if getattr(self.scheduler, "devices", None) is not None:
             self.scheduler.devices.release(uid, node)
         if getattr(self.scheduler, "numa", None) is not None:
@@ -367,6 +434,9 @@ class ReservationManager:
         if node is None or reservation.current_owners:
             return
         ghost = self._remainder_ghost(reservation)
+        # re-take under the SAME uid release_ghost_holds released —
+        # the operating pod's own uid when the reservation is a pod
+        ghost.meta.uid = self._hold_uid(reservation)
         if getattr(self.scheduler, "numa", None) is not None:
             self.scheduler.numa.allocate(ghost, node)
         if getattr(self.scheduler, "devices", None) is not None:
@@ -386,13 +456,24 @@ class ReservationManager:
         assert node is not None
         snap = self.scheduler.snapshot
         self.release_ghost_holds(reservation)
-        snap.forget_pod(_ghost_uid(reservation))
+        snap.forget_pod(self._hold_uid(reservation))
         for k, v in pod.spec.requests.items():
             reservation.allocated[k] = reservation.allocated.get(k, 0.0) + v
         reservation.current_owners.append(pod.meta.uid)
         self._owner_requests.setdefault(reservation.meta.name, {})[
             pod.meta.uid
         ] = dict(pod.spec.requests)
+        op = self._operating.get(reservation.meta.name)
+        if op is not None:
+            # record the allocation on the operating pod
+            # (AnnotationReservationCurrentOwner, operating_pod.go:36)
+            import json as _json
+
+            op.meta.annotations[ext.ANNOTATION_RESERVATION_CURRENT_OWNER] = (
+                _json.dumps(
+                    {"namespace": pod.meta.namespace, "name": pod.meta.name}
+                )
+            )
         if reservation.allocate_once:
             reservation.allocated = dict(reservation.requests)
             self._set_terminal(reservation, ReservationPhase.SUCCEEDED)
@@ -412,7 +493,7 @@ class ReservationManager:
             return False
         if r.phase == ReservationPhase.AVAILABLE:
             self.release_ghost_holds(r)
-            self.scheduler.snapshot.forget_pod(_ghost_uid(r))
+            self.scheduler.snapshot.forget_pod(self._hold_uid(r))
         self._set_terminal(r, ReservationPhase.FAILED)
         return True
 
@@ -463,7 +544,8 @@ class ReservationManager:
                 if getattr(self.scheduler, "numa", None) is not None:
                     self.scheduler.numa.release(uid, r.node_name)
             # re-hold the freed remainder so it stays reserved
-            snap.forget_pod(_ghost_uid(r))
+            snap.forget_pod(_ghost_uid(r))  # ghost remainder, never the
+            # operating pod itself (its consumption forgot it already)
             ghost = self._remainder_ghost(r)
             if ghost.spec.requests:
                 snap.assume_pod(ghost, r.node_name)
@@ -481,6 +563,7 @@ class ReservationManager:
                 del self._reservations[name]
                 del self._terminal_time[name]
                 self._owner_requests.pop(name, None)
+                self._operating.pop(name, None)
                 self._cycle_candidates = None
                 report["deleted"].append(name)
         return report

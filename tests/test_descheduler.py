@@ -756,3 +756,86 @@ def test_reservation_gc_after_duration():
     assert rm.sync(now=time.time() + 30)["deleted"] == []   # too young
     assert rm.sync(now=time.time() + 120)["deleted"] == ["dead"]
     assert rm.get("dead") is None
+
+
+def test_operating_mode_pod_as_reservation():
+    """operating_pod.go: a pod labeled operating-mode=Reservation acts as
+    a reservation — its own assume is the capacity hold, owners consume
+    it through the fast path, and the current-owner annotation lands on
+    the operating pod."""
+    import json as _json
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    op = Pod(
+        meta=ObjectMeta(
+            name="placeholder-0",
+            labels={
+                ext.LABEL_POD_OPERATING_MODE: ext.POD_OPERATING_MODE_RESERVATION
+            },
+            annotations={
+                ext.ANNOTATION_RESERVATION_OWNERS: _json.dumps(
+                    [{"labelSelector": {"matchLabels": {"app": "svc"}}}]
+                )
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8192}, priority=9500
+        ),
+    )
+    # the operating pod schedules like any pod...
+    out = sched.schedule([op])
+    assert len(out.bound) == 1
+    op.spec.node_name = out.bound[0][1]
+    # ...and its bind turns it into an Available reservation
+    r = rm.ingest_operating_pod(op)
+    assert r is not None and r.phase == ReservationPhase.AVAILABLE
+    assert r.node_name == "n0"
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 8000.0  # the pod IS the hold
+    # an owner consumes it through the fast path (AllocateOnce)
+    owner = bound_pod("svc-0", None, cpu=8000, prio=9500, labels={"app": "svc"})
+    owner.spec.node_name = None
+    out2 = sched.schedule([owner])
+    assert [(p.meta.name, n) for p, n in out2.bound] == [("svc-0", "n0")]
+    assert r.phase == ReservationPhase.SUCCEEDED
+    # capacity swapped: the operating pod's charge was released, the
+    # owner's charge replaced it
+    assert snap.nodes.requested[idx, 0] == 8000.0
+    assert not snap.is_assumed(op.meta.uid)
+    cur = _json.loads(
+        op.meta.annotations[ext.ANNOTATION_RESERVATION_CURRENT_OWNER]
+    )
+    assert cur["name"] == "svc-0"
+    # a non-operating pod is ignored
+    assert rm.ingest_operating_pod(bound_pod("x", "n0")) is None
+
+
+def test_pending_operating_pod_gets_no_ghost():
+    """Code-review regression: schedule_pending must not schedule a ghost
+    for an operating-pod-backed reservation — the pod itself is the unit
+    of scheduling."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    op = Pod(
+        meta=ObjectMeta(
+            name="pending-op",
+            labels={
+                ext.LABEL_POD_OPERATING_MODE: ext.POD_OPERATING_MODE_RESERVATION
+            },
+        ),
+        spec=PodSpec(requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096}),
+    )
+    r = rm.ingest_operating_pod(op)  # still pending (no node)
+    assert r.phase == ReservationPhase.PENDING
+    assert rm.schedule_pending() == 0  # no ghost scheduled
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 0.0
