@@ -20,7 +20,10 @@ DOMAIN = "koordinator.sh"
 
 # --- Labels / annotations (reference: apis/extension/constants.go) ---
 LABEL_POD_QOS = f"{DOMAIN}/qosClass"
+#: numeric sub-priority within a band (reference constants.go:32)
 LABEL_POD_PRIORITY = f"{DOMAIN}/priority"
+#: priority band NAME (reference constants.go:36 LabelPodPriorityClass)
+LABEL_POD_PRIORITY_CLASS = f"{DOMAIN}/priority-class"
 LABEL_QUOTA_NAME = f"quota.scheduling.{DOMAIN}/name"
 LABEL_QUOTA_PARENT = f"quota.scheduling.{DOMAIN}/parent"
 LABEL_QUOTA_IS_PARENT = f"quota.scheduling.{DOMAIN}/is-parent"
@@ -381,9 +384,10 @@ def parse_custom_usage_thresholds(annotations: Mapping[str, str]):
     )
 
 
-def _parse_dict_annotation(annotations: Mapping[str, str], key: str):
-    """JSON-object annotation value, or None when absent/malformed/not a
-    dict — the shared guard for every dict-shaped protocol annotation."""
+def _parse_json_annotation(annotations: Mapping[str, str], key: str, shape):
+    """JSON annotation value of the given shape (dict/list), or None when
+    absent/malformed — the shared guard for every structured protocol
+    annotation."""
     import json as _json
 
     raw = annotations.get(key)
@@ -393,7 +397,11 @@ def _parse_dict_annotation(annotations: Mapping[str, str], key: str):
         spec = _json.loads(raw)
     except (ValueError, TypeError):
         return None
-    return spec if isinstance(spec, dict) else None
+    return spec if isinstance(spec, shape) else None
+
+
+def _parse_dict_annotation(annotations: Mapping[str, str], key: str):
+    return _parse_json_annotation(annotations, key, dict)
 
 
 def is_reservation_operating_mode(pod) -> bool:
@@ -410,16 +418,10 @@ def parse_reservation_owners(annotations: Mapping[str, str]):
     (``operating_pod.go:70-79`` GetReservationOwners): a JSON list of
     ``{"labelSelector": {"matchLabels": {...}}, "namespace": ...}``.
     Returns [] when absent/malformed."""
-    import json as _json
-
-    raw = annotations.get(ANNOTATION_RESERVATION_OWNERS)
-    if not raw:
-        return []
-    try:
-        items = _json.loads(raw)
-    except (ValueError, TypeError):
-        return []
-    return items if isinstance(items, list) else []
+    items = _parse_json_annotation(
+        annotations, ANNOTATION_RESERVATION_OWNERS, list
+    )
+    return items if items is not None else []
 
 
 def is_pod_preemptible(pod) -> bool:

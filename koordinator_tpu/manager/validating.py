@@ -55,7 +55,7 @@ def _validate_qos_priority(pod: Pod) -> List[str]:
         limit_cpu = pod.spec.limits.get(ext.RES_CPU)
         if limit_cpu is not None and cpu > 0 and limit_cpu < cpu:
             errors.append("cpu limit below request")
-    explicit = pod.meta.labels.get(ext.LABEL_POD_PRIORITY)
+    explicit = pod.meta.labels.get(ext.LABEL_POD_PRIORITY_CLASS)
     if explicit is not None:
         try:
             explicit_band = PriorityClass[explicit.upper()]
@@ -71,6 +71,14 @@ def _validate_qos_priority(pod: Pod) -> List[str]:
                     f"priority {pod.spec.priority} outside the "
                     f"{explicit_band.name} band"
                 )
+    # the koordinator.sh/priority label is the NUMERIC sub-priority
+    # (reference GetPodSubPriority, priority.go:103-113)
+    sub = pod.meta.labels.get(ext.LABEL_POD_PRIORITY)
+    if sub is not None:
+        try:
+            int(sub)
+        except ValueError:
+            errors.append(f"priority label must be an integer, got {sub!r}")
     return errors
 
 

@@ -180,6 +180,19 @@ class ReservationManager:
         r = self._reservations.get(pod.meta.name)
         if r is None:
             r = reservation_from_operating_pod(pod)
+            # a pod already stamped with a current owner was consumed in a
+            # previous incarnation (restart / resync after GC) — register
+            # it Succeeded, never as fresh capacity (the annotation exists
+            # precisely to make consumption durable, operating_pod.go:36)
+            if pod.meta.annotations.get(
+                ext.ANNOTATION_RESERVATION_CURRENT_OWNER
+            ):
+                r.allocated = dict(r.requests)
+                self.add(r)
+                self._operating[r.meta.name] = pod
+                r.node_name = pod.spec.node_name
+                self._set_terminal(r, ReservationPhase.SUCCEEDED)
+                return r
             self.add(r)
         self._operating[r.meta.name] = pod
         if pod.spec.node_name and r.phase == ReservationPhase.PENDING:
@@ -492,8 +505,16 @@ class ReservationManager:
         ):
             return False
         if r.phase == ReservationPhase.AVAILABLE:
-            self.release_ghost_holds(r)
-            self.scheduler.snapshot.forget_pod(self._hold_uid(r))
+            if r.meta.name in self._operating:
+                # pod-backed hold: the placeholder pod is still RUNNING on
+                # the node — forgetting its charge (or freeing its cpuset/
+                # minors) would advertise phantom capacity the kubelet is
+                # still committing. Expiry only stops the reservation from
+                # matching; the charge lives until the pod itself goes.
+                pass
+            else:
+                self.release_ghost_holds(r)
+                self.scheduler.snapshot.forget_pod(self._hold_uid(r))
         self._set_terminal(r, ReservationPhase.FAILED)
         return True
 

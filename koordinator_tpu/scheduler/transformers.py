@@ -1,0 +1,84 @@
+"""Informer-side pod transformers.
+
+Rebuild of ``pkg/util/transformer/pod_transformer.go`` (installed by
+``SetupCustomInformers`` / applied to every pod object before the
+scheduler sees it): deprecated resource names translate to current ones,
+the scheduler-name label overrides spec.schedulerName, and — behind the
+PriorityTransformer gate — the koordinator.sh/priority label overrides
+spec.priority. Register with
+``FrameworkExtender.register_pod_transformer`` (the BeforePreFilter-era
+slot) or call :func:`transform_pod` directly at ingest.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..api import extension as ext
+from ..api.types import Pod
+from ..utils.features import SCHEDULER_GATES
+
+#: deprecated → current resource names (reference
+#: ``apis/extension/deprecated.go:48-60``; the deprecated device names
+#: live under the kubernetes.io/ prefix, the deprecated batch tier under
+#: the bare koordinator.sh/ domain)
+DEPRECATED_RESOURCES: Dict[str, str] = {
+    f"{ext.DOMAIN}/batch-cpu": ext.RES_BATCH_CPU,
+    f"{ext.DOMAIN}/batch-memory": ext.RES_BATCH_MEMORY,
+    "kubernetes.io/gpu": ext.RES_GPU,
+    "kubernetes.io/rdma": ext.RES_RDMA,
+    "kubernetes.io/fpga": ext.RES_FPGA,
+    "kubernetes.io/gpu-core": ext.RES_GPU_CORE,
+    "kubernetes.io/gpu-memory": ext.RES_GPU_MEMORY,
+    "kubernetes.io/gpu-memory-ratio": ext.RES_GPU_MEMORY_RATIO,
+}
+
+#: the scheduler-name label wins over spec (``multi_scheduler.go:28-33``)
+LABEL_SCHEDULER_NAME = f"scheduling.{ext.DOMAIN}/scheduler-name"
+
+
+def transform_deprecated_resources(pod: Pod) -> Pod:
+    """``TransformDeprecatedBatchResources`` +
+    ``TransformDeprecatedDeviceResources``: rename in place; a current
+    name already present wins over its deprecated alias."""
+    for store in (pod.spec.requests, pod.spec.limits):
+        for old, new in DEPRECATED_RESOURCES.items():
+            if old in store:
+                value = store.pop(old)
+                store.setdefault(new, value)
+    return pod
+
+
+def transform_scheduler_name(pod: Pod) -> Pod:
+    """``TransformSchedulerName``: the label overrides spec."""
+    name = pod.meta.labels.get(LABEL_SCHEDULER_NAME)
+    if name:
+        pod.spec.scheduler_name = name
+    return pod
+
+
+def transform_koord_priority(pod: Pod) -> Pod:
+    """``TransformKoordPriorityClassFunc`` (PriorityTransformer gate): the
+    koordinator.sh/priority label value overrides spec.priority."""
+    if not SCHEDULER_GATES.enabled("PriorityTransformer"):
+        return pod
+    raw = pod.meta.labels.get(ext.LABEL_POD_PRIORITY)
+    if raw is not None:
+        try:
+            pod.spec.priority = int(raw)
+        except ValueError:
+            pass
+    return pod
+
+
+def transform_pod(pod: Pod) -> Optional[Pod]:
+    """The full chain, in the reference's installation order."""
+    pod = transform_deprecated_resources(pod)
+    pod = transform_scheduler_name(pod)
+    return transform_koord_priority(pod)
+
+
+def install(extender) -> None:
+    """Register the chain on a FrameworkExtender (the analog of
+    ``SetupCustomInformers`` at ``app/server.go:377-378``)."""
+    extender.register_pod_transformer(transform_pod)

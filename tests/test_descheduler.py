@@ -839,3 +839,72 @@ def test_pending_operating_pod_gets_no_ghost():
     assert rm.schedule_pending() == 0  # no ghost scheduled
     idx = snap.node_id("n0")
     assert snap.nodes.requested[idx, 0] == 0.0
+
+
+def test_consumed_operating_pod_reingest_stays_succeeded():
+    """Code-review regression: re-ingesting an operating pod that carries
+    the current-owner annotation (restart / post-GC resync) must register
+    it Succeeded, never as fresh Available capacity."""
+    import json as _json
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    op = Pod(
+        meta=ObjectMeta(
+            name="used-op",
+            labels={
+                ext.LABEL_POD_OPERATING_MODE: ext.POD_OPERATING_MODE_RESERVATION
+            },
+            annotations={
+                ext.ANNOTATION_RESERVATION_OWNERS: _json.dumps(
+                    [{"labelSelector": {"matchLabels": {"app": "svc"}}}]
+                ),
+                ext.ANNOTATION_RESERVATION_CURRENT_OWNER: _json.dumps(
+                    {"namespace": "default", "name": "svc-old"}
+                ),
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8192},
+            priority=9500,
+            node_name="n0",
+        ),
+    )
+    r = rm.ingest_operating_pod(op)
+    assert r.phase == ReservationPhase.SUCCEEDED
+    owner = bound_pod("svc-new", None, cpu=4000, prio=9500, labels={"app": "svc"})
+    owner.spec.node_name = None
+    assert rm.match(owner) is None  # never offered as capacity
+
+
+def test_expire_pod_backed_reservation_keeps_charge():
+    """Code-review regression: expiring a pod-backed reservation must not
+    forget the still-running placeholder pod's charge."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    op = Pod(
+        meta=ObjectMeta(
+            name="ph-0",
+            labels={
+                ext.LABEL_POD_OPERATING_MODE: ext.POD_OPERATING_MODE_RESERVATION
+            },
+        ),
+        spec=PodSpec(requests={ext.RES_CPU: 6000, ext.RES_MEMORY: 4096}),
+    )
+    out = sched.schedule([op])
+    op.spec.node_name = out.bound[0][1]
+    rm.ingest_operating_pod(op)
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 6000.0
+    assert rm.expire_reservation("ph-0")
+    # the placeholder still runs: its charge stays until the pod goes
+    assert snap.nodes.requested[idx, 0] == 6000.0
+    assert snap.is_assumed(op.meta.uid)

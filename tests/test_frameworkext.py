@@ -258,3 +258,68 @@ class TestSchedulingQueueAndAdapter:
         sched.snapshot.nodes.metric_fresh[idx] = True
         adapter.invalidate_node("node-0")
         assert not sched.snapshot.nodes.metric_fresh[idx]
+
+
+# ---- informer pod transformers (pkg/util/transformer/pod_transformer.go) ----
+
+
+def test_pod_transformers_chain():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.scheduler import transformers as tf
+    from koordinator_tpu.utils.features import SCHEDULER_GATES
+
+    pod = Pod(
+        meta=ObjectMeta(
+            name="p",
+            labels={
+                tf.LABEL_SCHEDULER_NAME: "my-sched",
+                ext.LABEL_POD_PRIORITY: "9500",
+            },
+        ),
+        spec=PodSpec(
+            requests={
+                f"{ext.DOMAIN}/batch-cpu": 4000,
+                "kubernetes.io/gpu": 1,
+                ext.RES_MEMORY: 1024,
+            },
+            priority=5000,
+        ),
+    )
+    out = tf.transform_pod(pod)
+    # deprecated names rename in place
+    assert out.spec.requests[ext.RES_BATCH_CPU] == 4000
+    assert out.spec.requests[ext.RES_GPU] == 1
+    assert f"{ext.DOMAIN}/batch-cpu" not in out.spec.requests
+    # scheduler-name label overrides spec
+    assert out.spec.scheduler_name == "my-sched"
+    # priority label only applies behind the gate
+    assert out.spec.priority == 5000
+    with SCHEDULER_GATES.override("PriorityTransformer", True):
+        assert tf.transform_pod(pod).spec.priority == 9500
+    # a current name already present wins over its deprecated alias
+    pod2 = Pod(
+        meta=ObjectMeta(name="q"),
+        spec=PodSpec(
+            requests={f"{ext.DOMAIN}/batch-cpu": 1000, ext.RES_BATCH_CPU: 2000}
+        ),
+    )
+    assert tf.transform_pod(pod2).spec.requests[ext.RES_BATCH_CPU] == 2000
+
+
+def test_pod_transformers_install_on_extender():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.scheduler import transformers as tf
+    from koordinator_tpu.scheduler.frameworkext import FrameworkExtender
+
+    fwext = FrameworkExtender()
+    fwext.monitor.stop_background()
+    tf.install(fwext)
+    pod = Pod(
+        meta=ObjectMeta(name="p"),
+        spec=PodSpec(requests={f"{ext.DOMAIN}/batch-memory": 2048}),
+    )
+    kept, dropped = fwext.run_pre_batch_transformers([pod])
+    assert dropped == []
+    assert kept[0].spec.requests == {ext.RES_BATCH_MEMORY: 2048}
