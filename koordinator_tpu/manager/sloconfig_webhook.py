@@ -144,14 +144,56 @@ def _check_qos_classes(cfg: Mapping, path: str, errors: List[str]) -> None:
                 )
 
 
-def _selectors_overlap(a: Mapping[str, str], b: Mapping[str, str]) -> bool:
-    """Two matchLabels selectors can match the same node unless they
-    *conflict* — demand different values for a shared key (the reference's
-    NodeSelectorOverlap uses the same requirement-conflict test)."""
-    for key, val in a.items():
-        if key in b and b[key] != val:
-            return False
-    return True
+def _selector_requirements(selector: Mapping) -> List[Tuple[str, str, frozenset]]:
+    """Lower a nodeSelector to (key, operator, values) requirements —
+    matchLabels become In requirements, matchExpressions pass through
+    (the reference's NodeSelectorOverlap expands expressions the same
+    way, ``pkg/webhook/cm/plugins/sloconfig/common_check.go``)."""
+    reqs: List[Tuple[str, str, frozenset]] = []
+    for k, v in (selector.get("matchLabels") or {}).items():
+        reqs.append((str(k), "In", frozenset([str(v)])))
+    for expr in selector.get("matchExpressions") or []:
+        if not isinstance(expr, Mapping):
+            continue
+        key, op = expr.get("key"), expr.get("operator")
+        if not key or not op:
+            continue
+        vals = frozenset(str(x) for x in expr.get("values") or [])
+        reqs.append((str(key), str(op), vals))
+    return reqs
+
+
+def _requirements_conflict(
+    a: List[Tuple[str, str, frozenset]], b: List[Tuple[str, str, frozenset]]
+) -> bool:
+    """True when no node's labels can satisfy both requirement sets
+    (k8s label-selector semantics: NotIn also matches an absent key,
+    In/Exists require the key present)."""
+    by_key: Dict[str, List[Tuple[str, frozenset]]] = {}
+    for k, op, vals in a + b:
+        by_key.setdefault(k, []).append((op, vals))
+    for items in by_key.values():
+        ins = [v for op, v in items if op == "In"]
+        notins = [v for op, v in items if op == "NotIn"]
+        absent = any(op == "DoesNotExist" for op, _ in items)
+        present = bool(ins) or any(op == "Exists" for op, _ in items)
+        if absent and present:
+            return True
+        if ins:
+            candidates = frozenset.intersection(*ins)
+            for nv in notins:
+                candidates -= nv
+            if not candidates:
+                return True
+    return False
+
+
+def _selectors_overlap(
+    a: List[Tuple[str, str, frozenset]], b: List[Tuple[str, str, frozenset]]
+) -> bool:
+    """Two node selectors can match the same node unless their merged
+    requirements conflict (the reference's NodeSelectorOverlap)."""
+    return not _requirements_conflict(a, b)
 
 
 def _check_profiles(cfg: Mapping, key: str, path: str, errors: List[str]) -> None:
@@ -160,7 +202,7 @@ def _check_profiles(cfg: Mapping, key: str, path: str, errors: List[str]) -> Non
         errors.append(f"{path}: nodeStrategies must be a list")
         return
     seen_names: Dict[str, int] = {}
-    parsed: List[Tuple[str, Mapping[str, str]]] = []
+    parsed: List[Tuple[str, List[Tuple[str, str, frozenset]]]] = []
     for i, prof in enumerate(profiles):
         if not isinstance(prof, Mapping):
             errors.append(f"{path}[{i}]: not an object")
@@ -169,14 +211,14 @@ def _check_profiles(cfg: Mapping, key: str, path: str, errors: List[str]) -> Non
         if name in seen_names:
             errors.append(f"{path}[{i}]: duplicate profile name {name!r}")
         seen_names[name] = i
-        selector = (prof.get("nodeSelector") or {}).get("matchLabels") or {}
-        has_exprs = bool((prof.get("nodeSelector") or {}).get("matchExpressions"))
-        if not selector and not has_exprs:
+        node_selector = prof.get("nodeSelector") or {}
+        reqs = _selector_requirements(node_selector)
+        if not reqs:
             errors.append(
                 f"{path}[{i}] ({name}): nodeSelector must not be empty"
             )
             continue
-        parsed.append((name, dict(selector)))
+        parsed.append((name, reqs))
         # per-profile strategy values obey the same ranges
         _check_ranges(prof, _RANGES.get(key, ()), f"{path}[{i}]", errors)
         _check_orderings(prof, _ORDERINGS.get(key, ()), f"{path}[{i}]", errors)
@@ -230,6 +272,32 @@ def validate_slo_configmap(
     return errors
 
 
+def _node_matches(selector: Mapping, labels: Mapping[str, str]) -> bool:
+    """Evaluate a nodeSelector (matchLabels + matchExpressions) against a
+    concrete node's labels; an empty selector matches nothing here (the
+    profile checks already rejected it)."""
+    ml = selector.get("matchLabels") or {}
+    exprs = [e for e in (selector.get("matchExpressions") or [])
+             if isinstance(e, Mapping)]
+    if not ml and not exprs:
+        return False
+    if any(labels.get(k) != v for k, v in ml.items()):
+        return False
+    for expr in exprs:
+        key, op = expr.get("key"), expr.get("operator")
+        vals = [str(x) for x in expr.get("values") or []]
+        has, val = key in labels, labels.get(key)
+        if op == "In" and (not has or val not in vals):
+            return False
+        if op == "NotIn" and has and val in vals:
+            return False
+        if op == "Exists" and not has:
+            return False
+        if op == "DoesNotExist" and has:
+            return False
+    return True
+
+
 def node_profile_conflicts(
     new_data: Mapping[str, str], node_labels: Mapping[str, str]
 ) -> List[str]:
@@ -249,10 +317,7 @@ def node_profile_conflicts(
         for prof in cfg.get("nodeStrategies") or cfg.get("nodeConfigs") or []:
             if not isinstance(prof, Mapping):
                 continue
-            selector = (prof.get("nodeSelector") or {}).get("matchLabels") or {}
-            if selector and all(
-                node_labels.get(k) == v for k, v in selector.items()
-            ):
+            if _node_matches(prof.get("nodeSelector") or {}, node_labels):
                 matches.append(prof.get("name") or "?")
         if len(matches) > 1:
             errors.append(

@@ -86,6 +86,9 @@ class _GangState:
     bound: int = 0
     #: which member states count toward satisfaction
     match_policy: str = ext.GANG_MATCH_ONCE_SATISFIED
+    #: whether the policy was explicitly declared (CRD or first declaring
+    #: member) — once declared, later member annotations cannot flip it
+    policy_declared: bool = False
     #: sticky once-satisfied flag (reference ``gang.go:435-459``
     #: setResourceSatisfied, set by Permit allow and addBoundPod)
     satisfied: bool = False
@@ -129,11 +132,12 @@ class PodGroupManager:
             state.min_member = pg.min_member
             state.schedule_timeout_s = pg.schedule_timeout_s
         # the PodGroup CRD's own annotation declares the policy for the
-        # whole gang (reference GangFromPodGroupCrd); member pods may still
-        # override explicitly
+        # whole gang with final authority (reference GangFromPodGroupCrd);
+        # once declared, member annotations are ignored
         explicit = explicit_match_policy(pg.meta.annotations)
         if explicit is not None:
             state.match_policy = explicit
+            state.policy_declared = True
 
     def _gang_for_pod(self, key: str, pod: Pod) -> _GangState:
         state = self._gangs.get(key)
@@ -151,9 +155,16 @@ class PodGroupManager:
                 schedule_timeout_s=self.default_timeout_s,
             )
             self._gangs[key] = state
-        explicit = explicit_match_policy(pod.meta.annotations)
-        if explicit is not None:
-            state.match_policy = explicit
+        # the FIRST member to register pins the gang's policy (its explicit
+        # annotation, else the once-satisfied default) — the reference
+        # parses the policy once at gang creation (from the CRD or the
+        # first pod), so a differently-annotated straggler can never flip
+        # an established gang's policy mid-lifecycle (last-writer-wins was
+        # an advisor finding); the CRD annotation retains authority via
+        # upsert_pod_group.
+        if not state.policy_declared:
+            state.match_policy = match_policy_of(pod)
+            state.policy_declared = True
         return state
 
     def begin_cycle(self, pending: Sequence[Pod]) -> None:

@@ -469,14 +469,32 @@ class ReservationManager:
         assert node is not None
         snap = self.scheduler.snapshot
         self.release_ghost_holds(reservation)
-        snap.forget_pod(self._hold_uid(reservation))
+        op = self._operating.get(reservation.meta.name)
+        if op is not None and snap.is_assumed(op.meta.uid):
+            # The RUNNING placeholder's physical footprint does not shrink
+            # because a (possibly smaller) owner consumed the reservation —
+            # the reference keeps the reserve pod charged and discounts the
+            # owner inside the reservation. Keep the node charged
+            # max(placeholder, owner): swap the pod's full assume for the
+            # remainder the owner does not cover; that remainder frees only
+            # when the placeholder pod itself is forgotten/deleted.
+            remainder = {
+                k: v - pod.spec.requests.get(k, 0.0)
+                for k, v in reservation.requests.items()
+                if v - pod.spec.requests.get(k, 0.0) > 1e-6
+            }
+            snap.forget_pod(op.meta.uid)
+            if remainder:
+                vec = snap.config.res_vector(remainder)
+                snap.assume_pod(op, node, vec, confirmed=True, request=vec)
+        else:
+            snap.forget_pod(self._hold_uid(reservation))
         for k, v in pod.spec.requests.items():
             reservation.allocated[k] = reservation.allocated.get(k, 0.0) + v
         reservation.current_owners.append(pod.meta.uid)
         self._owner_requests.setdefault(reservation.meta.name, {})[
             pod.meta.uid
         ] = dict(pod.spec.requests)
-        op = self._operating.get(reservation.meta.name)
         if op is not None:
             # record the allocation on the operating pod
             # (AnnotationReservationCurrentOwner, operating_pod.go:36)
@@ -495,6 +513,32 @@ class ReservationManager:
             if ghost.spec.requests:
                 snap.assume_pod(ghost, node)
         return node
+
+    def remove_operating_pod(self, pod_name: str) -> None:
+        """Ingest the deletion of a Reservation-operating-mode pod: its
+        physical footprint is gone, so its charge (full or remainder) and
+        its NUMA/device holds are dropped, and a still-open reservation it
+        backed is failed (the pod was the capacity). Live owners keep
+        their own assumes — the node charge degrades from
+        max(placeholder, owners) to sum(owners) exactly at pod death."""
+        op = self._operating.pop(pod_name, None)
+        if op is None:
+            return
+        snap = self.scheduler.snapshot
+        r = self._reservations.get(pod_name)
+        node = r.node_name if r is not None else op.spec.node_name
+        snap.forget_pod(op.meta.uid)
+        if node is not None:
+            if getattr(self.scheduler, "devices", None) is not None:
+                self.scheduler.devices.release(op.meta.uid, node)
+            if getattr(self.scheduler, "numa", None) is not None:
+                self.scheduler.numa.release(op.meta.uid, node)
+        if r is not None and r.phase in (
+            ReservationPhase.PENDING,
+            ReservationPhase.AVAILABLE,
+        ):
+            self._set_terminal(r, ReservationPhase.FAILED)
+            self._cycle_candidates = None
 
     def expire_reservation(self, name: str) -> bool:
         """Explicitly fail/expire a reservation, releasing its hold."""
@@ -572,6 +616,42 @@ class ReservationManager:
                 snap.assume_pod(ghost, r.node_name)
             report["drifted"].append(r.meta.name)
             self._cycle_candidates = None
+        # pod-backed SUCCEEDED reservations: an owner that died before the
+        # still-RUNNING placeholder must re-expand the placeholder's charge
+        # — without this, owner death leaves the node charged only the
+        # remainder while the kubelet still commits the full placeholder
+        # (the max(placeholder, owners) invariant, reviewer finding r3)
+        # (the placeholder is presumed RUNNING until its delete is
+        # ingested via remove_operating_pod — after full consumption it
+        # holds no assume, so is_assumed can't be the liveness signal)
+        for name, op in list(self._operating.items()):
+            r = self._reservations.get(name)
+            if (
+                r is None
+                or r.phase != ReservationPhase.SUCCEEDED
+                or r.node_name is None
+            ):
+                continue
+            ledger = self._owner_requests.get(name, {})
+            gone = [u for u in ledger if not snap.is_assumed(u)]
+            if not gone:
+                continue
+            for uid in gone:
+                ledger.pop(uid, None)
+                if uid in r.current_owners:
+                    r.current_owners.remove(uid)
+            remainder = dict(r.requests)
+            for owner_req in ledger.values():
+                for k, v in owner_req.items():
+                    remainder[k] = remainder.get(k, 0.0) - v
+            remainder = {k: v for k, v in remainder.items() if v > 1e-6}
+            snap.forget_pod(op.meta.uid)
+            if remainder:
+                vec = snap.config.res_vector(remainder)
+                snap.assume_pod(
+                    op, r.node_name, vec, confirmed=True, request=vec
+                )
+            report["drifted"].append(name)
         for name, t0 in list(self._terminal_time.items()):
             r = self._reservations.get(name)
             if r is None:

@@ -346,3 +346,34 @@ def test_unannotated_member_does_not_reset_policy():
     # a lone straggler still re-gathers minMember under only-waiting
     out2 = sched.schedule([gang_pod("p3", "g")])
     assert out2.bound == []
+
+
+def test_straggler_cannot_flip_established_policy():
+    """Advisor r2 regression: a differently-annotated straggler must not
+    flip an established gang's match policy mid-lifecycle — the policy is
+    parsed once at gang creation (reference parses from the CRD or the
+    first pod)."""
+    sched = BatchScheduler(_cluster())
+    out = sched.schedule(
+        [
+            gang_pod_policy("p1", "g", ext.GANG_MATCH_ONLY_WAITING, min_avail=2),
+            gang_pod_policy("p2", "g", ext.GANG_MATCH_ONLY_WAITING, min_avail=2),
+        ]
+    )
+    assert len(out.bound) == 2
+    state = sched.pod_groups._gangs["default/g"]
+    assert state.match_policy == ext.GANG_MATCH_ONLY_WAITING
+    # a straggler annotated once-satisfied does NOT flip the gang back
+    straggler = gang_pod_policy(
+        "p3", "g", ext.GANG_MATCH_ONCE_SATISFIED, min_avail=2
+    )
+    out2 = sched.schedule([straggler])
+    assert state.match_policy == ext.GANG_MATCH_ONLY_WAITING
+    assert out2.bound == []  # only-waiting: must re-gather minMember
+    # the CRD annotation still has authority to change the policy
+    pg = PodGroup(meta=ObjectMeta(name="g"), min_member=2)
+    pg.meta.annotations[ext.ANNOTATION_GANG_MATCH_POLICY] = (
+        ext.GANG_MATCH_ONCE_SATISFIED
+    )
+    sched.pod_groups.upsert_pod_group(pg)
+    assert state.match_policy == ext.GANG_MATCH_ONCE_SATISFIED

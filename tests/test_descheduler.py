@@ -803,10 +803,12 @@ def test_operating_mode_pod_as_reservation():
     out2 = sched.schedule([owner])
     assert [(p.meta.name, n) for p, n in out2.bound] == [("svc-0", "n0")]
     assert r.phase == ReservationPhase.SUCCEEDED
-    # capacity swapped: the operating pod's charge was released, the
-    # owner's charge replaced it
+    # capacity swapped on the CPU dim (owner covers it exactly); the
+    # placeholder keeps the uncovered memory remainder (8192 − 8000 MiB)
+    # charged under its own uid until the pod itself is deleted
     assert snap.nodes.requested[idx, 0] == 8000.0
-    assert not snap.is_assumed(op.meta.uid)
+    assert snap.nodes.requested[idx, 1] == 8192.0
+    assert snap.is_assumed(op.meta.uid)
     cur = _json.loads(
         op.meta.annotations[ext.ANNOTATION_RESERVATION_CURRENT_OWNER]
     )
@@ -908,3 +910,106 @@ def test_expire_pod_backed_reservation_keeps_charge():
     # the placeholder still runs: its charge stays until the pod goes
     assert snap.nodes.requested[idx, 0] == 6000.0
     assert snap.is_assumed(op.meta.uid)
+
+
+def test_operating_pod_partial_consumption_keeps_remainder_charge():
+    """Advisor r2 (medium) regression: a 4000m owner consuming an 8000m
+    pod-backed reservation must NOT free 4000m of phantom capacity — the
+    still-RUNNING placeholder physically occupies it. The node stays
+    charged max(placeholder, owner); the remainder frees only when the
+    placeholder pod itself is forgotten (deleted)."""
+    import json as _json
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    op = Pod(
+        meta=ObjectMeta(
+            name="big-ph",
+            labels={
+                ext.LABEL_POD_OPERATING_MODE: ext.POD_OPERATING_MODE_RESERVATION
+            },
+            annotations={
+                ext.ANNOTATION_RESERVATION_OWNERS: _json.dumps(
+                    [{"labelSelector": {"matchLabels": {"app": "svc"}}}]
+                )
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8192}, priority=9500
+        ),
+    )
+    out = sched.schedule([op])
+    assert len(out.bound) == 1
+    op.spec.node_name = out.bound[0][1]
+    rm.ingest_operating_pod(op)
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 8000.0
+    # a HALF-size owner consumes the reservation
+    owner = bound_pod("svc-0", None, cpu=4000, prio=9500, labels={"app": "svc"})
+    owner.spec.node_name = None
+    out2 = sched.schedule([owner])
+    assert [(p.meta.name, n) for p, n in out2.bound] == [("svc-0", "n0")]
+    # node stays charged the FULL placeholder size: owner 4000 + remainder
+    # 4000 still held under the placeholder's uid
+    assert snap.nodes.requested[idx, 0] == 8000.0
+    assert snap.is_assumed(op.meta.uid)
+    assert snap.is_assumed(owner.meta.uid)
+    # only when the placeholder pod itself is deleted does the remainder go
+    snap.forget_pod(op.meta.uid)
+    assert snap.nodes.requested[idx, 0] == 4000.0
+
+
+def test_operating_pod_owner_dies_first_reexpands_charge():
+    """Reviewer r3 regression: the owner pod dying BEFORE the still-running
+    placeholder must re-expand the placeholder's charge to its full
+    footprint at the next controller sweep; deleting the placeholder
+    itself (remove_operating_pod) then drops everything."""
+    import json as _json
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0"))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    op = Pod(
+        meta=ObjectMeta(
+            name="ph-exp",
+            labels={
+                ext.LABEL_POD_OPERATING_MODE: ext.POD_OPERATING_MODE_RESERVATION
+            },
+            annotations={
+                ext.ANNOTATION_RESERVATION_OWNERS: _json.dumps(
+                    [{"labelSelector": {"matchLabels": {"app": "svc"}}}]
+                )
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8192}, priority=9500
+        ),
+    )
+    out = sched.schedule([op])
+    op.spec.node_name = out.bound[0][1]
+    rm.ingest_operating_pod(op)
+    idx = snap.node_id("n0")
+    owner = bound_pod("svc-1", None, cpu=4000, prio=9500, labels={"app": "svc"})
+    owner.spec.node_name = None
+    out2 = sched.schedule([owner])
+    assert len(out2.bound) == 1
+    assert snap.nodes.requested[idx, 0] == 8000.0
+    # owner dies first: forget its assume, sweep re-expands the placeholder
+    snap.forget_pod(owner.meta.uid)
+    assert snap.nodes.requested[idx, 0] == 4000.0  # transiently degraded
+    report = rm.sync()
+    assert "ph-exp" in report["drifted"]
+    assert snap.nodes.requested[idx, 0] == 8000.0  # full footprint restored
+    # placeholder deletion drops the remaining charge
+    rm.remove_operating_pod("ph-exp")
+    assert snap.nodes.requested[idx, 0] == 0.0
+    # idempotent / no resurrection at the next sweep
+    rm.sync()
+    assert snap.nodes.requested[idx, 0] == 0.0

@@ -672,3 +672,63 @@ def test_profile_mutates_reservation():
     r2 = Reservation(meta=ObjectMeta(name="other"), requests={ext.RES_CPU: 1})
     mutator.mutate_reservation(r2)
     assert r2.requests == {ext.RES_CPU: 1}
+
+
+def test_sloconfig_match_expressions_overlap():
+    """Advisor r2 regression: profiles whose nodeSelector uses only
+    matchExpressions must go through the requirement-conflict test, not be
+    treated as match-all. Disjoint In sets on the same key do not overlap;
+    an In set vs a covering NotIn does not overlap; genuinely
+    co-satisfiable expressions do."""
+    import json
+
+    from koordinator_tpu.manager.sloconfig_webhook import (
+        COLOCATION_CONFIG_KEY,
+        node_profile_conflicts,
+        validate_slo_configmap,
+    )
+
+    def cfg_of(*profiles):
+        return {
+            COLOCATION_CONFIG_KEY: json.dumps({"nodeConfigs": list(profiles)})
+        }
+
+    def expr(key, op, *vals):
+        e = {"key": key, "operator": op}
+        if vals:
+            e["values"] = list(vals)
+        return e
+
+    # disjoint In sets on one key: no overlap — must be admitted
+    disjoint = cfg_of(
+        {"name": "a", "nodeSelector": {"matchExpressions": [expr("pool", "In", "x")]}},
+        {"name": "b", "nodeSelector": {"matchExpressions": [expr("pool", "In", "y")]}},
+    )
+    assert validate_slo_configmap(disjoint) == []
+    # In {x} vs NotIn {x}: no overlap
+    innotin = cfg_of(
+        {"name": "a", "nodeSelector": {"matchExpressions": [expr("pool", "In", "x")]}},
+        {"name": "b", "nodeSelector": {"matchExpressions": [expr("pool", "NotIn", "x")]}},
+    )
+    assert validate_slo_configmap(innotin) == []
+    # Exists vs DoesNotExist: no overlap
+    existence = cfg_of(
+        {"name": "a", "nodeSelector": {"matchExpressions": [expr("gpu", "Exists")]}},
+        {"name": "b", "nodeSelector": {"matchExpressions": [expr("gpu", "DoesNotExist")]}},
+    )
+    assert validate_slo_configmap(existence) == []
+    # overlapping: In {x, y} vs In {y, z} share y — rejected
+    shared = cfg_of(
+        {"name": "a", "nodeSelector": {"matchExpressions": [expr("pool", "In", "x", "y")]}},
+        {"name": "b", "nodeSelector": {"matchExpressions": [expr("pool", "In", "y", "z")]}},
+    )
+    assert any("overlapping" in e for e in validate_slo_configmap(shared))
+    # mixed: matchLabels {pool: x} vs matchExpressions In {x} — rejected
+    mixed = cfg_of(
+        {"name": "a", "nodeSelector": {"matchLabels": {"pool": "x"}}},
+        {"name": "b", "nodeSelector": {"matchExpressions": [expr("pool", "In", "x")]}},
+    )
+    assert any("overlapping" in e for e in validate_slo_configmap(mixed))
+    # the concrete-node conflict check also evaluates expressions
+    assert node_profile_conflicts(mixed, {"pool": "x"})
+    assert node_profile_conflicts(mixed, {"pool": "y"}) == []
