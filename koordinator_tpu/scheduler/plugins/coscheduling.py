@@ -197,6 +197,14 @@ class PodGroupManager:
             state.bound += 1
             state.satisfied = True
 
+    @property
+    def has_gangs(self) -> bool:
+        """Whether any gang state exists — callers combine this with their
+        own batch-lowered gang signal to skip Permit entirely (see
+        ``permit``'s internal bypass, which stays the source of truth for
+        correctness when called)."""
+        return bool(self._gangs)
+
     def pre_enqueue(self, pod: Pod, now: Optional[float] = None) -> Tuple[bool, str]:
         """Gate: a gang pod may enter scheduling only once the gang has at
         least minMember known members (pending + bound), reference
@@ -279,6 +287,15 @@ class PodGroupManager:
         every gang in its group passes — one failing gang rejects the
         whole group's placements."""
         results = list(results)
+        if not self._gangs and not any(
+            ext.LABEL_GANG_NAME in p.meta.labels for p, _ in results
+        ):
+            # no gang state and no gang-labeled pod in the batch: the
+            # per-pod gang bookkeeping is pure overhead (hot commit path)
+            return (
+                [(p, n) for p, n in results if n is not None],
+                [p for p, n in results if n is None],
+            )
         placed_per_gang: Dict[str, int] = {}
         members_per_gang: Dict[str, int] = {}
         groups_of_gang: Dict[str, frozenset] = {}

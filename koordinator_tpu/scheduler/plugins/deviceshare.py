@@ -63,6 +63,10 @@ class _NodeDevices:
     numa_of: List[int] = dataclasses.field(default_factory=list)
     #: PCIe root per minor ("" unknown)
     pcie_of: List[str] = dataclasses.field(default_factory=list)
+    #: static (numa, pcie) interconnect group id per minor — precomputed
+    #: at ingest so the per-winner topology packing is plain list ops
+    group_of: List[int] = dataclasses.field(default_factory=list)
+    n_groups: int = 0
 
 
 #: machine models whose boards ship the NVLink-complete 1/2/4/8 partition
@@ -147,6 +151,11 @@ class DeviceManager:
             numa_of=[d.numa_node for d in gpus],
             pcie_of=[d.pcie_bus for d in gpus],
         )
+        gids: Dict[Tuple[int, str], int] = {}
+        for d in gpus:
+            key = (d.numa_node, d.pcie_bus)
+            st.group_of.append(gids.setdefault(key, len(gids)))
+        st.n_groups = len(gids)
         if old is not None:
             for uid, picks in old.owners.items():
                 kept = [(m, pct) for m, pct in picks if m < len(st.gpu_free)]
@@ -236,10 +245,36 @@ class DeviceManager:
         binding under the SamePCIe required scope (the RDMA PCIe set must
         equal the GPU PCIe set, ``validateJointAllocation``)."""
         whole, share = parse_gpu_request(pod)
-        rdma_count = ext.parse_rdma_request(pod.spec.requests)
-        fpga_count = ext.parse_fpga_request(pod.spec.requests)
-        if whole == 0 and share <= 0 and rdma_count == 0 and fpga_count == 0:
+        payload = self.allocate_lowered(
+            pod.meta.uid,
+            pod.meta.annotations,
+            node_name,
+            whole,
+            share,
+            ext.parse_rdma_request(pod.spec.requests),
+            ext.parse_fpga_request(pod.spec.requests),
+        )
+        if payload is None:
+            return None
+        if not payload:
             return {}
+        return {ext.ANNOTATION_DEVICE_ALLOCATED: payload}
+
+    def allocate_lowered(
+        self,
+        uid: str,
+        annotations: Mapping[str, str],
+        node_name: str,
+        whole: int,
+        share: float,
+        rdma_count: int,
+        fpga_count: int,
+    ) -> Optional[str]:
+        """Lean core of ``allocate`` for the batched commit: requests are
+        pre-lowered by the caller. Returns the device-allocated JSON
+        payload, ``""`` when the pod wants no devices, None on failure."""
+        if whole == 0 and share <= 0 and rdma_count == 0 and fpga_count == 0:
+            return ""
         st = self._nodes.get(node_name)
         if st is None:
             return None
@@ -249,7 +284,7 @@ class DeviceManager:
         if len(full_minors) < whole:
             return None
         if whole > 0:
-            chosen = self._pick_whole_minors(st, free, whole, pod)
+            chosen = self._pick_whole_minors(st, free, whole, annotations)
             if chosen is None:
                 return None
             for minor in chosen:
@@ -280,7 +315,7 @@ class DeviceManager:
             chosen_rdma = self._pick_rdma(
                 st,
                 rdma_count,
-                ext.parse_device_joint_allocate(pod.meta.annotations),
+                ext.parse_device_joint_allocate(annotations),
                 gpu_pcies,
             )
             if chosen_rdma is None:
@@ -297,35 +332,46 @@ class DeviceManager:
         # all picks succeeded — commit atomically
         st.gpu_free = free
         if picks:
-            st.owners[pod.meta.uid] = picks
+            st.owners[uid] = picks
         for minor, pct in rdma_picks:
             st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
         if rdma_picks:
-            st.rdma_owners[pod.meta.uid] = rdma_picks
+            st.rdma_owners[uid] = rdma_picks
         for minor, pct in fpga_picks:
             st.fpga_free[minor] = max(st.fpga_free[minor] - pct, 0.0)
         if fpga_picks:
-            st.fpga_owners[pod.meta.uid] = fpga_picks
-        payload: Dict[str, List] = {}
+            st.fpga_owners[uid] = fpga_picks
+        # hand-rendered device-allocated JSON (shape is fixed; json.dumps
+        # per winner was a visible slice of the commit hot path)
+        parts: List[str] = []
         if picks:
-            payload["gpu"] = [
-                {
-                    "minor": minor,
-                    "resources": {ext.RES_GPU_MEMORY_RATIO: pct},
-                }
-                for minor, pct in picks
-            ]
+            parts.append(
+                '"gpu": [%s]'
+                % ", ".join(
+                    '{"minor": %d, "resources": {"%s": %s}}'
+                    % (minor, ext.RES_GPU_MEMORY_RATIO, pct)
+                    for minor, pct in picks
+                )
+            )
         if rdma_picks:
-            payload["rdma"] = [
-                {"minor": minor, "resources": {ext.RES_RDMA: pct}}
-                for minor, pct in rdma_picks
-            ]
+            parts.append(
+                '"rdma": [%s]'
+                % ", ".join(
+                    '{"minor": %d, "resources": {"%s": %s}}'
+                    % (minor, ext.RES_RDMA, pct)
+                    for minor, pct in rdma_picks
+                )
+            )
         if fpga_picks:
-            payload["fpga"] = [
-                {"minor": minor, "resources": {ext.RES_FPGA: pct}}
-                for minor, pct in fpga_picks
-            ]
-        return {ext.ANNOTATION_DEVICE_ALLOCATED: json.dumps(payload)}
+            parts.append(
+                '"fpga": [%s]'
+                % ", ".join(
+                    '{"minor": %d, "resources": {"%s": %s}}'
+                    % (minor, ext.RES_FPGA, pct)
+                    for minor, pct in fpga_picks
+                )
+            )
+        return "{%s}" % ", ".join(parts)
 
     def _pick_rdma(
         self,
@@ -375,11 +421,17 @@ class DeviceManager:
     # the one that keeps the most high-value larger partitions intact.
 
     def _pick_whole_minors(
-        self, st: _NodeDevices, free: List[float], whole: int, pod: Pod
+        self,
+        st: _NodeDevices,
+        free: List[float],
+        whole: int,
+        annotations: Mapping[str, str],
     ) -> Optional[List[int]]:
         full_minors = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
         if st.partitions and st.partition_policy in ("Honor", "Prefer"):
-            chosen = self._allocate_by_partition(st, full_minors, whole, pod)
+            chosen = self._allocate_by_partition(
+                st, full_minors, whole, annotations
+            )
             if chosen is not None:
                 return chosen
             if st.partition_policy == "Honor":
@@ -389,12 +441,16 @@ class DeviceManager:
         return self._allocate_by_topology(st, full_minors, whole)
 
     def _allocate_by_partition(
-        self, st: _NodeDevices, full_minors: List[int], whole: int, pod: Pod
+        self,
+        st: _NodeDevices,
+        full_minors: List[int],
+        whole: int,
+        annotations: Mapping[str, str],
     ) -> Optional[List[int]]:
         table = st.partitions.get(whole)
         if not table:
             return None
-        restricted, want_bw = ext.parse_gpu_partition_spec(pod.meta.annotations)
+        restricted, want_bw = ext.parse_gpu_partition_spec(annotations)
         free_mask = 0
         for m in full_minors:
             free_mask |= 1 << m
@@ -444,21 +500,26 @@ class DeviceManager:
     ) -> Optional[List[int]]:
         """No (binding) partition table: pack onto the fewest NUMA/PCIe
         domains, preferring the domain group with least leftover (the
-        reference's GPUTopologyScope bin-pack, ``allocator_gpu.go:300+``)."""
+        reference's GPUTopologyScope bin-pack, ``allocator_gpu.go:300+``).
+        Group membership is static per node (``group_of``, precomputed at
+        ingest), so the per-winner work is plain list bucketing."""
         if len(full_minors) < whole:
             return None
-        groups: Dict[Tuple[int, str], List[int]] = {}
+        if st.n_groups <= 1:
+            return full_minors[:whole]
+        group_of = st.group_of
+        buckets: List[List[int]] = [[] for _ in range(st.n_groups)]
         for m in full_minors:
-            numa = st.numa_of[m] if m < len(st.numa_of) else -1
-            pcie = st.pcie_of[m] if m < len(st.pcie_of) else ""
-            groups.setdefault((numa, pcie), []).append(m)
+            buckets[group_of[m] if m < len(group_of) else 0].append(m)
         # smallest group that satisfies the request = tightest fit
-        fitting = [g for g in groups.values() if len(g) >= whole]
-        if fitting:
-            best = min(fitting, key=len)
+        best: Optional[List[int]] = None
+        for b in buckets:
+            if len(b) >= whole and (best is None or len(b) < len(best)):
+                best = b
+        if best is not None:
             return best[:whole]
         # spill across groups, draining the largest first
-        ordered = sorted(groups.values(), key=len, reverse=True)
+        ordered = sorted(buckets, key=len, reverse=True)
         out: List[int] = []
         for g in ordered:
             out.extend(g)

@@ -316,6 +316,11 @@ class GroupQuotaManager:
         overuse-revoke controller can pick eviction victims. ``vec`` is the
         pod's already-lowered request row (skips a per-winner res_vector)."""
         self.charge(quota_name, pod.spec.requests, vec=vec)
+        self.record_assigned(quota_name, pod)
+
+    def record_assigned(self, quota_name: str, pod: "Pod") -> None:
+        """Remember a pod at its leaf without charging (the batched commit
+        charges once per leaf via ``charge`` with a summed vector)."""
         self._assigned.setdefault(quota_name, {})[pod.meta.uid] = pod
 
     def unassign_pod(self, quota_name: str, pod: "Pod") -> None:

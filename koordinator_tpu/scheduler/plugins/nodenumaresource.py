@@ -27,6 +27,7 @@ from ...core.topology import (
     CPUTopology,
     NUMAPolicy,
     format_cpuset,
+    format_cpuset_sorted,
 )
 
 #: zone resource dims lowered to the solver (prefix of the snapshot axis)
@@ -89,6 +90,9 @@ class NUMAManager:
         #: strategy (reference NodeNUMAResourceArgs.ScoringStrategy)
         self.scoring_strategy = scoring_strategy
         self._nodes: Dict[str, _NodeNUMA] = {}
+        #: policy_rows cache, invalidated on register_node / node churn
+        self._policy_cache: Optional[np.ndarray] = None
+        self._policy_cache_epoch = -1
 
     def register_node(
         self,
@@ -126,6 +130,7 @@ class NUMAManager:
             cpu_amp=cpu_amp,
             phys_zone_cpu=phys,
         )
+        self._policy_cache = None
 
     def _sync_amp(self, node_name: str, st: _NodeNUMA) -> None:
         """Re-base zone capacities and bound charges onto the snapshot's
@@ -177,6 +182,26 @@ class NUMAManager:
     def has_topology(self) -> bool:
         return bool(self._nodes)
 
+    def policy_rows(self) -> np.ndarray:
+        """int8 NUMA policy per snapshot row; -1 = unregistered node. The
+        batched commit uses this to split winners into the vectorized
+        no-NUMA path vs the per-winner exact-assignment path. Cached per
+        snapshot node-epoch (rebuilt on register_node / node churn)."""
+        epoch = self.snapshot.node_epoch
+        if (
+            self._policy_cache is not None
+            and self._policy_cache_epoch == epoch
+        ):
+            return self._policy_cache
+        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        out = np.full((n_bucket,), -1, np.int8)
+        for name, st in self._nodes.items():
+            idx = self.snapshot.node_id(name)
+            if idx is not None:
+                out[idx] = int(st.policy)
+        self._policy_cache = out
+        self._policy_cache_epoch = epoch
+        return out
 
     # ---- per-winner exact assignment (PreBind) ----
 
@@ -185,31 +210,58 @@ class NUMAManager:
         if required, and return the resource-status annotation patch
         (``plugin.go:579-627``). Returns None when NUMA placement fails —
         the caller treats it like a failed Reserve."""
-        st = self._nodes.get(node_name)
-        if st is None:
-            return {}
-        self._sync_amp(node_name, st)
         requests = pod.spec.requests
-        req = [
+        payload = self.allocate_lowered(
+            pod.meta.uid,
+            pod.meta.annotations,
+            node_name,
             float(requests.get(ext.RES_CPU, 0.0)),
             float(requests.get(ext.RES_MEMORY, 0.0)),
-        ]
+            wants_numa(pod),
+        )
+        if payload is None:
+            return None
+        if not payload:
+            return {}
+        return {ext.ANNOTATION_RESOURCE_STATUS: payload}
 
-        need_alignment = wants_numa(pod)
+    def allocate_lowered(
+        self,
+        uid: str,
+        annotations: Mapping[str, str],
+        node_name: str,
+        cpu_milli: float,
+        mem_mib: float,
+        bind: bool,
+        synced: bool = False,
+    ) -> Optional[str]:
+        """Lean core of ``allocate`` for the batched commit: all request
+        parsing is already lowered by the caller (BatchScheduler's chunk
+        rows). Returns the resource-status JSON payload, ``""`` when there
+        is nothing to record, or None on failed placement. ``synced=True``
+        asserts the caller ran ``arrays()`` (which re-bases every node's
+        amplification) earlier in the same single-threaded cycle, so the
+        per-winner ratio re-sync is skipped."""
+        st = self._nodes.get(node_name)
+        if st is None:
+            return ""
+        if not synced:
+            self._sync_amp(node_name, st)
+        req0, req1 = cpu_milli, mem_mib
         # record the nominal bind charge for every bound pod — even at
         # ratio 1.0 — so a later annotation change can re-base it
-        nominal_cpu = req[0] if need_alignment else 0.0
-        if need_alignment and st.cpu_amp > 1.0:
+        nominal_cpu = cpu_milli if bind else 0.0
+        if bind and st.cpu_amp > 1.0:
             # zone capacities are amplified space: a bound pod's physical
             # cores charge ×ratio (AmplifyResourceList, plugin.go:636-640);
             # the accumulator below still takes the physical core count
-            req = [req[0] * st.cpu_amp, req[1]]
+            req0 = cpu_milli * st.cpu_amp
         zone = -1
-        if st.policy == NUMAPolicy.SINGLE_NUMA_NODE or need_alignment:
+        if st.policy == NUMAPolicy.SINGLE_NUMA_NODE or bind:
             # least-allocated fitting zone (pure-Python: Z is tiny and
             # this runs once per winner; ZONE_DIMS is fixed at 2)
-            cpu_need = req[0] - 1e-3
-            mem_need = req[1] - 1e-3
+            cpu_need = req0 - 1e-3
+            mem_need = req1 - 1e-3
             best_util = None
             for z, alloc in enumerate(st.zone_alloc):
                 used = st.zone_used[z]
@@ -223,36 +275,44 @@ class NUMAManager:
                 return None
 
         cpuset_str = None
-        if need_alignment:
-            n_cpus = int(float(requests.get(ext.RES_CPU, 0.0)) // 1000)
+        if bind:
+            n_cpus = int(cpu_milli // 1000)
+            raw = annotations.get(ext.ANNOTATION_RESOURCE_SPEC)
+            if raw:
+                try:
+                    policy = CPUBindPolicy(
+                        json.loads(raw).get("preferredCPUBindPolicy", "Default")
+                    )
+                except (ValueError, KeyError, AttributeError, TypeError):
+                    policy = CPUBindPolicy.DEFAULT
+            else:
+                policy = CPUBindPolicy.DEFAULT
             cpuset = st.accumulator.take(
-                pod.meta.uid,
+                uid,
                 n_cpus,
-                policy=parse_resource_spec(pod),
+                policy=policy,
                 numa=zone if zone >= 0 else None,
             )
             if cpuset is None:
                 return None
-            cpuset_str = format_cpuset(sorted(cpuset))
+            cpuset_str = format_cpuset_sorted(sorted(cpuset))
         if zone >= 0:
             used = st.zone_used[zone]
-            for d in range(ZONE_DIMS):
-                used[d] += req[d]
-            st.owners[pod.meta.uid] = (zone, req, nominal_cpu)
+            used[0] += req0
+            used[1] += req1
+            st.owners[uid] = (zone, [req0, req1], nominal_cpu)
         # hand-rendered resource-status JSON: json.dumps per winner was a
         # visible slice of the commit loop (payload shape is fixed)
         if cpuset_str is not None and zone >= 0:
-            payload = (
+            return (
                 '{"cpuset": "%s", "numaNodeResources": [{"node": %d}]}'
                 % (cpuset_str, zone)
             )
-        elif cpuset_str is not None:
-            payload = '{"cpuset": "%s"}' % cpuset_str
-        elif zone >= 0:
-            payload = '{"numaNodeResources": [{"node": %d}]}' % zone
-        else:
-            return {}
-        return {ext.ANNOTATION_RESOURCE_STATUS: payload}
+        if cpuset_str is not None:
+            return '{"cpuset": "%s"}' % cpuset_str
+        if zone >= 0:
+            return '{"numaNodeResources": [{"node": %d}]}' % zone
+        return ""
 
     def reset_allocations(self) -> None:
         """Free every zone and cpuset hold (full-resync path)."""

@@ -62,3 +62,9 @@ class DefaultPreBind:
 
     def pending(self) -> List[str]:
         return list(self._patches)
+
+    @property
+    def has_patches(self) -> bool:
+        """Whether anything was staged this cycle — lets the commit skip
+        the per-pod terminal apply entirely on patch-free chunks."""
+        return bool(self._patches)

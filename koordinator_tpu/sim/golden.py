@@ -20,7 +20,8 @@ EPS = 1e-3
 def _usage_percent(used: np.ndarray, allocatable: np.ndarray) -> np.ndarray:
     """Rounded integer percent, the reference's threshold-check unit
     (``filterNodeUsage``: int64(math.Round(used/total*100)))."""
-    pct = np.where(allocatable > 0, used * 100.0 / allocatable, 0.0)
+    pct = np.zeros_like(used)
+    np.divide(used * 100.0, allocatable, out=pct, where=allocatable > 0)
     return np.floor(pct + 0.5)
 
 
