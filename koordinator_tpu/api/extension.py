@@ -54,6 +54,21 @@ ANNOTATION_ALIAS_GANG_MATCH_POLICY = "pod-group.scheduling.sigs.k8s.io/match-pol
 GANG_MATCH_ONLY_WAITING = "only-waiting"
 GANG_MATCH_WAITING_AND_RUNNING = "waiting-and-running"
 GANG_MATCH_ONCE_SATISFIED = "once-satisfied"
+#: gang failure handling (reference ``apis/extension/coscheduling.go:40-53``
+#: AnnotationGangMode): Strict rolls back the whole gang group on a member
+#: failure; NonStrict keeps successfully-placed members
+ANNOTATION_GANG_MODE = f"gang.scheduling.{DOMAIN}/mode"
+GANG_MODE_STRICT = "Strict"
+GANG_MODE_NONSTRICT = "NonStrict"
+
+
+def gang_mode_of(annotations: Mapping[str, str]) -> str:
+    """Gang mode from annotations; any illegal value degrades to Strict
+    (reference ``coscheduling/core/gang.go:128-132``)."""
+    mode = annotations.get(ANNOTATION_GANG_MODE)
+    if mode == GANG_MODE_NONSTRICT:
+        return GANG_MODE_NONSTRICT
+    return GANG_MODE_STRICT
 #: pod-side partition request (apis/extension/device_share.go:38
 #: AnnotationGPUPartitionSpec): {"allocatePolicy": "Restricted"|"BestEffort",
 #: "ringBusBandwidth": <GB/s>}

@@ -151,6 +151,9 @@ class PodArrays:
     valid: np.ndarray
     #: row g: minMember of gang g (0 = unconstrained), indexed by gang_id
     gang_min: np.ndarray
+    #: row g: True when gang g is NonStrict (placed members survive an
+    #: under-filled gang instead of rolling back), indexed by gang_id
+    gang_nonstrict: np.ndarray
     #: whole GPUs / fractional GPU percent per pod (DeviceShare)
     gpu_whole: np.ndarray
     gpu_share: np.ndarray
@@ -173,6 +176,7 @@ class PodArrays:
             quota_id=np.full((p_bucket,), -1, np.int32),
             valid=np.zeros((p_bucket,), bool),
             gang_min=np.zeros((p_bucket,), np.int32),
+            gang_nonstrict=np.zeros((p_bucket,), bool),
             gpu_whole=np.zeros((p_bucket,), np.int32),
             gpu_share=np.zeros((p_bucket,), np.float32),
             rdma=np.zeros((p_bucket,), np.int32),
@@ -672,19 +676,24 @@ class ClusterSnapshot:
         self,
         pods: Sequence[Pod],
         min_member_by_gang: Optional[Mapping[str, int]] = None,
+        nonstrict_by_gang: Optional[Mapping[str, bool]] = None,
     ) -> PodArrays:
         """Lower pending pods to dense arrays.
 
         Gang minMember resolution order (reference: PodGroup CRD or the
         ``pod-group.scheduling.sigs.k8s.io/min-available`` annotation,
         ``pkg/scheduler/plugins/coscheduling/core/core.go``):
-        explicit mapping > pod label > member count in this batch.
+        explicit mapping > pod label > member count in this batch. The gang
+        mode resolves the same way (``nonstrict_by_gang`` from the
+        PodGroupManager, else the first member's mode annotation —
+        gang.go:128-132 parses once at gang creation).
         """
         p_bucket = bucket_size(len(pods), self.config.min_bucket)
         out = PodArrays.empty(p_bucket, self.config.dims)
         gang_ids: Dict[str, int] = {}
         gang_members: Dict[int, int] = {}
         gang_label_min: Dict[int, int] = {}
+        gang_pod_mode: Dict[int, bool] = {}
         # Tight single-pass lowering: the per-pod res_vector / property /
         # parse_* calls were a measurable slice of the per-batch host time
         # (one dict walk over requests replaces 5 separate parses;
@@ -747,6 +756,11 @@ class ClusterSnapshot:
                         gang_label_min[gid] = int(label_min)
                     except ValueError:
                         pass
+                if gid not in gang_pod_mode:
+                    gang_pod_mode[gid] = (
+                        pod.meta.annotations.get(ext.ANNOTATION_GANG_MODE)
+                        == ext.GANG_MODE_NONSTRICT
+                    )
         out.valid[:n] = True
         # vectorized priority-band resolution from the canonical band
         # table (priority.go:29-48; same source as from_priority)
@@ -772,5 +786,11 @@ class ClusterSnapshot:
                 out.gang_min[gid] = gang_label_min[gid]
             else:
                 out.gang_min[gid] = gang_members[gid]
+            declared = (nonstrict_by_gang or {}).get(key)
+            out.gang_nonstrict[gid] = (
+                declared
+                if declared is not None
+                else gang_pod_mode.get(gid, False)
+            )
         out.p_real = len(pods)
         return out

@@ -150,6 +150,11 @@ class PodBatch:
     rdma: jnp.ndarray = None
     #: whole FPGAs requested (koordinator.sh/fpga / 100), [P] int32
     fpga: jnp.ndarray = None
+    #: row g: True when gang g is NonStrict — its placed members survive
+    #: an under-filled gang instead of rolling back (AnnotationGangMode,
+    #: reference apis/extension/coscheduling.go:40-53). Indexed by
+    #: gang_id like gang_min, sized [P].
+    gang_nonstrict: jnp.ndarray = None
 
     @classmethod
     def create(
@@ -167,6 +172,7 @@ class PodBatch:
         gpu_share=None,
         rdma=None,
         fpga=None,
+        gang_nonstrict=None,
         quota_levels: int = 4,
     ) -> "PodBatch":
         requests = jnp.asarray(requests, jnp.float32)
@@ -219,6 +225,11 @@ class PodBatch:
                 jnp.zeros(p, jnp.int32)
                 if fpga is None
                 else jnp.asarray(fpga, jnp.int32)
+            ),
+            gang_nonstrict=(
+                jnp.zeros(p, bool)
+                if gang_nonstrict is None
+                else jnp.asarray(gang_nonstrict, bool)
             ),
         )
 
@@ -901,7 +912,12 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
 
     Gangs whose placed-member count is below ``minMember`` have all their
     placements rolled back and their capacity returned, exactly like the
-    reference rejecting a gang at Permit and cycling it back to the queue.
+    reference rejecting a gang at Permit and cycling it back to the queue
+    — unless the gang is **NonStrict** (AnnotationGangMode,
+    ``apis/extension/coscheduling.go:40-53``): NonStrict gangs keep their
+    successfully-placed members on partial placement
+    (``coscheduling/core/core.go:333`` only rejects the group in Strict
+    mode).
     """
     p = pods.requests.shape[0]
     n = result.node_requested.shape[0]
@@ -912,7 +928,7 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
     counts = jax.ops.segment_sum(
         (placed & has_gang).astype(jnp.int32), gid, num_segments=p
     )
-    gang_ok = counts >= pods.gang_min
+    gang_ok = (counts >= pods.gang_min) | pods.gang_nonstrict
     keep = placed & (~has_gang | gang_ok[gid])
     rollback = placed & ~keep
 

@@ -196,7 +196,9 @@ class BatchScheduler:
 
     def pod_batch(self, pods: Sequence[Pod], bucket: Optional[int] = None) -> PodBatch:
         arrays = self.snapshot.build_pods(
-            list(pods), min_member_by_gang=self.pod_groups.min_member_map()
+            list(pods),
+            min_member_by_gang=self.pod_groups.min_member_map(),
+            nonstrict_by_gang=self.pod_groups.nonstrict_map(),
         )
         b = bucket or bucket_size(len(pods), self.snapshot.config.min_bucket)
         if arrays.requests.shape[0] != b:
@@ -272,6 +274,7 @@ class BatchScheduler:
             gpu_share=arrays.gpu_share,
             rdma=arrays.rdma,
             fpga=arrays.fpga,
+            gang_nonstrict=arrays.gang_nonstrict,
         )
 
     # ---- scheduling cycle ----

@@ -89,6 +89,11 @@ class _GangState:
     #: whether the policy was explicitly declared (CRD or first declaring
     #: member) — once declared, later member annotations cannot flip it
     policy_declared: bool = False
+    #: failure handling (AnnotationGangMode): Strict rolls the gang group
+    #: back on a member failure, NonStrict keeps placed members. Parsed
+    #: once at gang creation (CRD or first member), like match_policy.
+    mode: str = ext.GANG_MODE_STRICT
+    mode_declared: bool = False
     #: sticky once-satisfied flag (reference ``gang.go:435-459``
     #: setResourceSatisfied, set by Permit allow and addBoundPod)
     satisfied: bool = False
@@ -138,6 +143,9 @@ class PodGroupManager:
         if explicit is not None:
             state.match_policy = explicit
             state.policy_declared = True
+        if ext.ANNOTATION_GANG_MODE in pg.meta.annotations:
+            state.mode = ext.gang_mode_of(pg.meta.annotations)
+            state.mode_declared = True
 
     def _gang_for_pod(self, key: str, pod: Pod) -> _GangState:
         state = self._gangs.get(key)
@@ -165,6 +173,9 @@ class PodGroupManager:
         if not state.policy_declared:
             state.match_policy = match_policy_of(pod)
             state.policy_declared = True
+        if not state.mode_declared:
+            state.mode = ext.gang_mode_of(pod.meta.annotations)
+            state.mode_declared = True
         return state
 
     def begin_cycle(self, pending: Sequence[Pod]) -> None:
@@ -245,6 +256,16 @@ class PodGroupManager:
                 out[k] = max(s.min_member - s.bound_credit, 0)
         return out
 
+    def nonstrict_map(self) -> Mapping[str, bool]:
+        """Per-gang NonStrict flag for the solver lowering — only gangs
+        whose mode has been declared (CRD / first member); others resolve
+        from the batch's own pod annotations in build_pods."""
+        return {
+            k: s.mode == ext.GANG_MODE_NONSTRICT
+            for k, s in self._gangs.items()
+            if s.mode_declared
+        }
+
     def order_pending(self, pods: Sequence[Pod]) -> List[Pod]:
         """NextPod semantics: keep gang members adjacent, ordered by the
         gang's highest member priority, so whole gangs land in one solver
@@ -299,6 +320,7 @@ class PodGroupManager:
         placed_per_gang: Dict[str, int] = {}
         members_per_gang: Dict[str, int] = {}
         groups_of_gang: Dict[str, frozenset] = {}
+        mode_of_gang: Dict[str, str] = {}
         for pod, node in results:
             key = gang_key_of(pod)
             if key is None:
@@ -308,6 +330,13 @@ class PodGroupManager:
                 placed_per_gang[key] = placed_per_gang.get(key, 0) + 1
             if key not in groups_of_gang:
                 groups_of_gang[key] = gang_group_of(pod, key)
+            if key not in mode_of_gang:
+                state = self._gangs.get(key)
+                mode_of_gang[key] = (
+                    state.mode
+                    if state is not None and state.mode_declared
+                    else ext.gang_mode_of(pod.meta.annotations)
+                )
 
         def gang_passes(key: str) -> bool:
             state = self._gangs.get(key)
@@ -323,14 +352,24 @@ class PodGroupManager:
             return have >= need
 
         gang_ok = {key: gang_passes(key) for key in members_per_gang}
+        # Only a *Strict* failing gang rejects — and it rejects its whole
+        # gang group. A NonStrict gang's partial placement keeps its
+        # placed members and never cascades to the group (the reference's
+        # rejectGangGroupById runs only in Strict mode,
+        # core/core.go:333,394).
+        strict_fail = {
+            key: not gang_ok[key]
+            and mode_of_gang.get(key) != ext.GANG_MODE_NONSTRICT
+            for key in members_per_gang
+        }
         group_ok: Dict[str, bool] = {}
         for key in members_per_gang:
-            # every linked gang that appears in this batch must pass;
-            # linked gangs absent from the batch gate via PreEnqueue
-            group_ok[key] = all(
-                gang_ok.get(linked, True)
+            # every linked gang that appears in this batch must be free of
+            # Strict failures; linked gangs absent gate via PreEnqueue
+            group_ok[key] = not any(
+                strict_fail.get(linked, False)
                 for linked in groups_of_gang.get(key, frozenset({key}))
-            ) and gang_ok[key]
+            )
 
         allowed: List[Tuple[Pod, str]] = []
         rejected: List[Pod] = []

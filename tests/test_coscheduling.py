@@ -377,3 +377,80 @@ def test_straggler_cannot_flip_established_policy():
     )
     sched.pod_groups.upsert_pod_group(pg)
     assert state.match_policy == ext.GANG_MATCH_ONCE_SATISFIED
+
+
+def test_enforce_gangs_nonstrict_keeps_placed_members():
+    """GangModeNonStrict (apis/extension/coscheduling.go:40-53): an
+    under-filled NonStrict gang keeps its successfully-placed members —
+    no rollback, capacity stays committed (core/gang.go branches on mode;
+    rejectGangGroupById runs only in Strict, core/core.go:333)."""
+    assignment = jnp.asarray([0, -1, 1, 1], jnp.int32)
+    req = jnp.full((4, 1), 2.0)
+    node_req = jnp.asarray([[2.0], [4.0]])
+    result = SolveResult(
+        assignment=assignment,
+        node_requested=node_req,
+        node_estimated_used=node_req,
+        node_prod_used=jnp.zeros_like(node_req),
+        quota_used=jnp.zeros((1, 1)),
+        rounds_used=jnp.array(1, jnp.int32),
+    )
+    pods = PodBatch.create(
+        requests=req,
+        estimate=req,
+        priority=jnp.zeros(4, jnp.int32),
+        is_prod=jnp.zeros(4, bool),
+        gang_id=[0, 0, 1, 1],
+        gang_min=[2, 2, 0, 0],
+        gang_nonstrict=[True, False, False, False],  # gang 0 NonStrict
+    )
+    out = enforce_gangs(result, pods)
+    got = np.asarray(out.assignment)
+    assert got[0] == 0 and got[1] == -1           # placed member survives
+    assert got[2] == 1 and got[3] == 1
+    np.testing.assert_allclose(np.asarray(out.node_requested), [[2.0], [4.0]])
+
+
+def test_nonstrict_gang_e2e_partial_placement():
+    """End-to-end parity for both modes on a cluster that fits only 2 of
+    a 3-member gang: Strict binds nothing; NonStrict binds the 2 that fit
+    (the third stays unschedulable and retries)."""
+    def member(name, gang, nonstrict):
+        p = gang_pod(name, gang, cpu=8.0, min_avail=3)
+        if nonstrict:
+            p.meta.annotations[ext.ANNOTATION_GANG_MODE] = (
+                ext.GANG_MODE_NONSTRICT
+            )
+        return p
+
+    # 2 nodes x 16 cpu, members want 8 cpu: only 2 of 3 members can ever
+    # land with per-node estimated-usage headroom for exactly one each
+    sched = BatchScheduler(_cluster(n_nodes=2, cpu=8.0))
+    strict = [member(f"s{i}", "gs", False) for i in range(3)]
+    out = sched.schedule(strict)
+    assert out.bound == []                       # all-or-nothing
+    assert len(out.unschedulable) == 3
+
+    sched2 = BatchScheduler(_cluster(n_nodes=2, cpu=8.0))
+    nonstrict = [member(f"n{i}", "gn", True) for i in range(3)]
+    out2 = sched2.schedule(nonstrict)
+    assert len(out2.bound) == 2                  # placed members kept
+    assert len(out2.unschedulable) == 1
+    state = sched2.pod_groups._gangs["default/gn"]
+    assert state.mode == ext.GANG_MODE_NONSTRICT
+
+
+def test_nonstrict_mode_from_podgroup_crd():
+    """The PodGroup CRD's mode annotation declares NonStrict for the
+    whole gang even when member pods carry no mode annotation."""
+    sched = BatchScheduler(_cluster(n_nodes=2, cpu=8.0))
+    pg = PodGroup(meta=ObjectMeta(name="g"), min_member=3)
+    pg.meta.annotations[ext.ANNOTATION_GANG_MODE] = ext.GANG_MODE_NONSTRICT
+    sched.pod_groups.upsert_pod_group(pg)
+    pods = [gang_pod(f"p{i}", "g", cpu=8.0) for i in range(3)]
+    out = sched.schedule(pods)
+    assert len(out.bound) == 2
+    # an illegal mode value degrades to Strict (gang.go:128-132)
+    assert ext.gang_mode_of({ext.ANNOTATION_GANG_MODE: "bogus"}) == (
+        ext.GANG_MODE_STRICT
+    )
