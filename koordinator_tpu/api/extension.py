@@ -175,7 +175,9 @@ def parse_gpu_request(requests: Mapping[str, float]) -> tuple[int, float]:
     ``nvidia.com/gpu: k`` → k whole GPUs; ``koordinator.sh/gpu-memory-ratio``
     (or gpu-core) of r → r<100: fraction of one GPU, r≥100: r//100 whole
     plus the remainder (reference ``apis/extension/device_share.go``
-    validation rules).
+    validation rules). This is the *scalar* view the solver lowers; the
+    host allocator uses :func:`parse_gpu_request_vector` for independent
+    per-dimension accounting.
     """
     whole = int(requests.get(RES_GPU, 0))
     ratio = float(
@@ -185,6 +187,46 @@ def parse_gpu_request(requests: Mapping[str, float]) -> tuple[int, float]:
         whole += int(ratio // 100.0)
         ratio = ratio % 100.0
     return whole, ratio
+
+
+def parse_gpu_request_vector(
+    requests: Mapping[str, float],
+) -> tuple[int, float, float, Optional[float]]:
+    """(whole, core_percent, memory_ratio_percent, memory_bytes|None) —
+    the reference's normalized per-dimension GPU request
+    (``deviceshare/utils.go:125-200`` request-combination table):
+
+    - ``nvidia.com/gpu: k`` → k whole (core 100 / ratio 100 each)
+    - ``koordinator.sh/gpu: r`` → core=r, ratio=r (≥100 splits to whole)
+    - ``gpu-core`` + ``gpu-memory-ratio`` → the two dims INDEPENDENTLY
+      (a high-memory/low-core pod accounts correctly); equal multiples of
+      100 split to whole devices
+    - ``gpu-core`` + ``gpu-memory`` (bytes) → core percent + bytes; the
+      allocator converts bytes↔ratio per device capacity
+    - a single percentage dim alone charges only that dim
+    """
+    whole = int(requests.get(RES_GPU, 0))
+    koord = float(requests.get(RES_KOORD_GPU, 0.0))
+    core = float(requests.get(RES_GPU_CORE, 0.0))
+    ratio = float(requests.get(RES_GPU_MEMORY_RATIO, 0.0))
+    mem_bytes_raw = requests.get(RES_GPU_MEMORY)
+    mem_bytes: Optional[float] = (
+        float(mem_bytes_raw) if mem_bytes_raw else None
+    )
+    if koord > 0 and core == 0 and ratio == 0:
+        core = ratio = koord
+    if core >= 100.0 and core == ratio and core % 100.0 == 0.0:
+        whole += int(core // 100.0)
+        core = ratio = 0.0
+    elif core >= 100.0 and ratio == 0.0 and mem_bytes is None:
+        whole += int(core // 100.0)
+        core = core % 100.0
+        ratio = core
+    elif ratio >= 100.0 and core == 0.0:
+        whole += int(ratio // 100.0)
+        ratio = ratio % 100.0
+        core = ratio
+    return whole, core, ratio, mem_bytes
 
 
 def _count_request(requests: Mapping[str, float], key: str) -> int:

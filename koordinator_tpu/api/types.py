@@ -195,6 +195,10 @@ class DeviceInfo:
     resources: ResourceList = dataclasses.field(default_factory=dict)
     numa_node: int = -1
     pcie_bus: str = ""
+    #: SR-IOV virtual-function bus IDs exposed by this device (reference
+    #: ``apis/extension/device_share.go:126-139`` VirtualFunctions): a NIC
+    #: with VFs is shared across pods VF-by-VF, never allocated whole
+    vfs: List[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

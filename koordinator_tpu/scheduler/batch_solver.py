@@ -1078,6 +1078,10 @@ class BatchScheduler:
                             gs_l[i],
                             rd_l[i],
                             fp_l[i],
+                            # the full request dict re-derives the per-dim
+                            # GPU vector (core vs memory accounted
+                            # independently) — only device winners pay it
+                            requests=chunk[i].spec.requests,
                         )
                         if dev_payload is None:
                             if held_numa[i]:

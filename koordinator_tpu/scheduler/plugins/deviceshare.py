@@ -14,7 +14,6 @@ for whole-GPU requests — writing the
 from __future__ import annotations
 
 import dataclasses
-import json
 from typing import Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
@@ -33,21 +32,36 @@ def parse_gpu_request(pod: Pod) -> Tuple[int, float]:
 
 @dataclasses.dataclass
 class _NodeDevices:
-    #: free percent per GPU minor
+    #: free MEMORY percent per GPU minor (the memory-ratio dimension —
+    #: the authoritative "full minor" / solver-lowering view)
     gpu_free: List[float]
-    #: free percent per RDMA minor (100 = idle NIC)
+    #: free CORE percent per GPU minor, tracked INDEPENDENTLY (reference
+    #: ``device_cache.go`` resource-vector accounting: a high-memory/
+    #: low-core pod and a low-memory/high-core pod share one GPU)
+    gpu_core_free: List[float] = dataclasses.field(default_factory=list)
+    #: GPU memory capacity in bytes per minor (0 = not declared by the
+    #: Device CR; byte-denominated requests then cannot be converted)
+    gpu_mem_cap: List[float] = dataclasses.field(default_factory=list)
+    #: free percent per RDMA minor (100 = idle NIC; VF-carrying NICs are
+    #: shared VF-by-VF and never consumed whole)
     rdma_free: List[float] = dataclasses.field(default_factory=list)
     #: free percent per FPGA minor
     fpga_free: List[float] = dataclasses.field(default_factory=list)
     #: PCIe root per RDMA minor ("" unknown)
     rdma_pcie: List[str] = dataclasses.field(default_factory=list)
-    #: pod uid -> [(minor, percent)] of GPU picks
-    owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
+    #: free SR-IOV virtual-function bus IDs per RDMA minor (empty list =
+    #: the NIC exposes no VFs and is allocated whole)
+    rdma_vfs: List[List[str]] = dataclasses.field(default_factory=list)
+    #: full VF inventory per RDMA minor (distinguishes "no VFs" from
+    #: "VFs exhausted"; restores on reset)
+    rdma_vf_all: List[List[str]] = dataclasses.field(default_factory=list)
+    #: pod uid -> [(minor, mem_ratio_percent, core_percent)] of GPU picks
+    owners: Dict[str, List[Tuple[int, float, float]]] = dataclasses.field(
         default_factory=dict
     )
-    #: pod uid -> [(minor, percent)] of RDMA picks
-    rdma_owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
-        default_factory=dict
+    #: pod uid -> [(minor, percent, vf_bus_id|None)] of RDMA picks
+    rdma_owners: Dict[str, List[Tuple[int, float, Optional[str]]]] = (
+        dataclasses.field(default_factory=dict)
     )
     #: pod uid -> [(minor, percent)] of FPGA picks
     fpga_owners: Dict[str, List[Tuple[int, float]]] = dataclasses.field(
@@ -143,8 +157,14 @@ class DeviceManager:
         old = self._nodes.get(device.meta.name)
         st = _NodeDevices(
             gpu_free=[FULL] * len(gpus),
+            gpu_core_free=[FULL] * len(gpus),
+            gpu_mem_cap=[
+                float(d.resources.get(ext.RES_GPU_MEMORY, 0.0)) for d in gpus
+            ],
             rdma_free=[FULL] * len(rdma),
             rdma_pcie=[d.pcie_bus for d in rdma],
+            rdma_vfs=[list(d.vfs) for d in rdma],
+            rdma_vf_all=[list(d.vfs) for d in rdma],
             fpga_free=[FULL] * len(fpga),
             partitions=partitions,
             partition_policy=policy,
@@ -158,15 +178,24 @@ class DeviceManager:
         st.n_groups = len(gids)
         if old is not None:
             for uid, picks in old.owners.items():
-                kept = [(m, pct) for m, pct in picks if m < len(st.gpu_free)]
-                for minor, pct in kept:
+                kept = [p for p in picks if p[0] < len(st.gpu_free)]
+                for minor, pct, core in kept:
                     st.gpu_free[minor] = max(st.gpu_free[minor] - pct, 0.0)
+                    st.gpu_core_free[minor] = max(
+                        st.gpu_core_free[minor] - core, 0.0
+                    )
                 if kept:
                     st.owners[uid] = kept
             for uid, picks in old.rdma_owners.items():
-                kept = [(m, pct) for m, pct in picks if m < len(st.rdma_free)]
-                for minor, pct in kept:
-                    st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
+                kept = [p for p in picks if p[0] < len(st.rdma_free)]
+                for minor, pct, vf in kept:
+                    if vf is not None and minor < len(st.rdma_vfs):
+                        if vf in st.rdma_vfs[minor]:
+                            st.rdma_vfs[minor].remove(vf)
+                    else:
+                        st.rdma_free[minor] = max(
+                            st.rdma_free[minor] - pct, 0.0
+                        )
                 if kept:
                     st.rdma_owners[uid] = kept
             for uid, picks in old.fpga_owners.items():
@@ -200,8 +229,13 @@ class DeviceManager:
             idx = self.snapshot.node_id(name)
             if idx is None:
                 continue
+            core_free = st.gpu_core_free
             for minor, free in enumerate(st.gpu_free):
-                slots[idx, minor] = free
+                # conservative scalar per slot: the solver's share check
+                # must hold on BOTH dims (memory and core); the host
+                # allocator revalidates exactly per dim
+                c = core_free[minor] if minor < len(core_free) else free
+                slots[idx, minor] = free if free < c else c
         return slots
 
     def cap_array(self) -> np.ndarray:
@@ -215,8 +249,23 @@ class DeviceManager:
         return out
 
     def rdma_array(self) -> np.ndarray:
-        """Free RDMA NIC count per node, [N] aligned to snapshot rows."""
-        return self._count_array("rdma_free")
+        """Free RDMA allocation capacity per node, [N] aligned to snapshot
+        rows: a VF-carrying NIC contributes its free VF count (it hosts
+        one pod per VF), a plain NIC contributes 1 while idle."""
+        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        out = np.zeros((n_bucket,), np.float32)
+        for name, st in self._nodes.items():
+            idx = self.snapshot.node_id(name)
+            if idx is None:
+                continue
+            total = 0
+            for i, f in enumerate(st.rdma_free):
+                if i < len(st.rdma_vf_all) and st.rdma_vf_all[i]:
+                    total += len(st.rdma_vfs[i])
+                elif f >= FULL - 1e-6:
+                    total += 1
+            out[idx] = total
+        return out
 
     def fpga_array(self) -> np.ndarray:
         """Free FPGA count per node, [N] aligned to snapshot rows."""
@@ -253,6 +302,7 @@ class DeviceManager:
             share,
             ext.parse_rdma_request(pod.spec.requests),
             ext.parse_fpga_request(pod.spec.requests),
+            requests=pod.spec.requests,
         )
         if payload is None:
             return None
@@ -269,48 +319,99 @@ class DeviceManager:
         share: float,
         rdma_count: int,
         fpga_count: int,
+        requests: Optional[Mapping[str, float]] = None,
     ) -> Optional[str]:
         """Lean core of ``allocate`` for the batched commit: requests are
         pre-lowered by the caller. Returns the device-allocated JSON
-        payload, ``""`` when the pod wants no devices, None on failure."""
+        payload, ``""`` when the pod wants no devices, None on failure.
+
+        With ``requests``, the GPU demand is re-derived as an independent
+        (core%, memory) vector (:func:`ext.parse_gpu_request_vector`) so a
+        high-memory/low-core pod and a low-memory/high-core pod can share
+        one GPU; without it the scalar ``share`` charges both dims
+        equally (conservative)."""
         if whole == 0 and share <= 0 and rdma_count == 0 and fpga_count == 0:
             return ""
         st = self._nodes.get(node_name)
         if st is None:
             return None
-        picks: List[Tuple[int, float]] = []
+        if requests is not None:
+            whole, core, ratio, mem_bytes = ext.parse_gpu_request_vector(
+                requests
+            )
+        else:
+            core, ratio, mem_bytes = share, share, None
+        picks: List[Tuple[int, float, float]] = []
         free = list(st.gpu_free)
-        full_minors = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
+        core_free = list(st.gpu_core_free)
+        full_minors = [
+            i
+            for i, f in enumerate(free)
+            if f >= FULL - 1e-6 and core_free[i] >= FULL - 1e-6
+        ]
         if len(full_minors) < whole:
             return None
         if whole > 0:
-            chosen = self._pick_whole_minors(st, free, whole, annotations)
+            chosen = self._pick_whole_minors(
+                st, full_minors, whole, annotations
+            )
             if chosen is None:
                 return None
             for minor in chosen:
-                picks.append((minor, FULL))
+                picks.append((minor, FULL, FULL))
                 free[minor] = 0.0
-        if share > 0:
-            # best-fit: smallest partial slot that still fits, else a
+                core_free[minor] = 0.0
+        if core > 0 or ratio > 0 or mem_bytes is not None:
+            # per-minor memory need in ratio percent: byte-denominated
+            # requests convert via the minor's declared capacity
+            # (device_cache.go converts memory<->ratio the same way)
+            caps = st.gpu_mem_cap
+
+            def mem_need(i: int) -> Optional[float]:
+                if mem_bytes is None:
+                    return ratio
+                cap = caps[i] if i < len(caps) else 0.0
+                if cap <= 0:
+                    return None  # capacity undeclared: cannot account
+                return mem_bytes / cap * 100.0
+
+            # best-fit: tightest partial slot where BOTH dims fit, else a
             # fresh full slot (reference allocator_gpu.go scoring)
-            candidates = [
-                (f, i)
-                for i, f in enumerate(free)
-                if f >= share - 1e-6 and f < FULL - 1e-6
-            ]
-            if candidates:
-                _, minor = min(candidates)
+            best = None
+            for i, f in enumerate(free):
+                if f >= FULL - 1e-6 and core_free[i] >= FULL - 1e-6:
+                    continue  # fully-free slots are the fallback
+                need = mem_need(i)
+                if need is None or f < need - 1e-6:
+                    continue
+                if core_free[i] < core - 1e-6:
+                    continue
+                if best is None or f < best[0]:
+                    best = (f, i)
+            if best is not None:
+                minor = best[1]
             else:
-                fresh = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
-                if not fresh:
+                minor = next(
+                    (
+                        i
+                        for i, f in enumerate(free)
+                        if f >= FULL - 1e-6
+                        and core_free[i] >= FULL - 1e-6
+                        and mem_need(i) is not None
+                        and mem_need(i) <= FULL + 1e-6
+                    ),
+                    None,
+                )
+                if minor is None:
                     return None
-                minor = fresh[0]
-            picks.append((minor, share))
-            free[minor] -= share
-        rdma_picks: List[Tuple[int, float]] = []
+            need = mem_need(minor)
+            picks.append((minor, need, core))
+            free[minor] -= need
+            core_free[minor] -= core
+        rdma_picks: List[Tuple[int, float, Optional[str]]] = []
         if rdma_count > 0:
             gpu_pcies = {
-                st.pcie_of[m] for m, _ in picks if m < len(st.pcie_of)
+                st.pcie_of[p[0]] for p in picks if p[0] < len(st.pcie_of)
             }
             chosen_rdma = self._pick_rdma(
                 st,
@@ -320,7 +421,15 @@ class DeviceManager:
             )
             if chosen_rdma is None:
                 return None
-            rdma_picks = [(m, FULL) for m in chosen_rdma]
+            for m in chosen_rdma:
+                if st.rdma_vf_all[m] if m < len(st.rdma_vf_all) else False:
+                    # VF-carrying NIC: hand out one VF, never the whole
+                    # NIC (SR-IOV sharing, device_share.go:126-139)
+                    if not st.rdma_vfs[m]:
+                        return None
+                    rdma_picks.append((m, FULL, st.rdma_vfs[m][0]))
+                else:
+                    rdma_picks.append((m, FULL, None))
         fpga_picks: List[Tuple[int, float]] = []
         if fpga_count > 0:
             free_fpga = [
@@ -331,10 +440,14 @@ class DeviceManager:
             fpga_picks = [(m, FULL) for m in free_fpga[:fpga_count]]
         # all picks succeeded — commit atomically
         st.gpu_free = free
+        st.gpu_core_free = core_free
         if picks:
             st.owners[uid] = picks
-        for minor, pct in rdma_picks:
-            st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
+        for minor, pct, vf in rdma_picks:
+            if vf is not None:
+                st.rdma_vfs[minor].remove(vf)
+            else:
+                st.rdma_free[minor] = max(st.rdma_free[minor] - pct, 0.0)
         if rdma_picks:
             st.rdma_owners[uid] = rdma_picks
         for minor, pct in fpga_picks:
@@ -342,26 +455,49 @@ class DeviceManager:
         if fpga_picks:
             st.fpga_owners[uid] = fpga_picks
         # hand-rendered device-allocated JSON (shape is fixed; json.dumps
-        # per winner was a visible slice of the commit hot path)
+        # per winner was a visible slice of the commit hot path). GPU
+        # entries carry the full per-dim vector (gpu-core / memory-ratio /
+        # memory bytes when capacity is declared); RDMA entries carry the
+        # assigned VF in the reference's DeviceAllocationExtension shape.
         parts: List[str] = []
         if picks:
-            parts.append(
-                '"gpu": [%s]'
-                % ", ".join(
-                    '{"minor": %d, "resources": {"%s": %s}}'
-                    % (minor, ext.RES_GPU_MEMORY_RATIO, pct)
-                    for minor, pct in picks
+            gpu_items = []
+            for minor, pct, core_pct in picks:
+                res = '"%s": %s, "%s": %s' % (
+                    ext.RES_GPU_CORE,
+                    core_pct,
+                    ext.RES_GPU_MEMORY_RATIO,
+                    pct,
                 )
-            )
+                cap = (
+                    st.gpu_mem_cap[minor]
+                    if minor < len(st.gpu_mem_cap)
+                    else 0.0
+                )
+                if cap > 0:
+                    res += ', "%s": %d' % (
+                        ext.RES_GPU_MEMORY,
+                        int(pct / 100.0 * cap),
+                    )
+                gpu_items.append(
+                    '{"minor": %d, "resources": {%s}}' % (minor, res)
+                )
+            parts.append('"gpu": [%s]' % ", ".join(gpu_items))
         if rdma_picks:
-            parts.append(
-                '"rdma": [%s]'
-                % ", ".join(
-                    '{"minor": %d, "resources": {"%s": %s}}'
-                    % (minor, ext.RES_RDMA, pct)
-                    for minor, pct in rdma_picks
-                )
-            )
+            rdma_items = []
+            for minor, pct, vf in rdma_picks:
+                if vf is not None:
+                    rdma_items.append(
+                        '{"minor": %d, "resources": {"%s": %s}, '
+                        '"extension": {"vfs": [{"busID": "%s"}]}}'
+                        % (minor, ext.RES_RDMA, pct, vf)
+                    )
+                else:
+                    rdma_items.append(
+                        '{"minor": %d, "resources": {"%s": %s}}'
+                        % (minor, ext.RES_RDMA, pct)
+                    )
+            parts.append('"rdma": [%s]' % ", ".join(rdma_items))
         if fpga_picks:
             parts.append(
                 '"fpga": [%s]'
@@ -383,9 +519,17 @@ class DeviceManager:
         """Choose RDMA minors. Joint allocation with GPUs prefers NICs on
         the GPUs' PCIe roots; the SamePCIe scope requires the chosen NICs'
         PCIe set to exactly equal the GPUs' (one per root, count bumped to
-        the root count like the reference's desiredCount adjustment)."""
+        the root count like the reference's desiredCount adjustment).
+        A VF-carrying NIC is available while it has a free VF (it is
+        shared, never consumed whole); a plain NIC while idle."""
         free_minors = [
-            i for i, f in enumerate(st.rdma_free) if f >= FULL - 1e-6
+            i
+            for i in range(len(st.rdma_free))
+            if (
+                bool(st.rdma_vfs[i])
+                if i < len(st.rdma_vf_all) and st.rdma_vf_all[i]
+                else st.rdma_free[i] >= FULL - 1e-6
+            )
         ]
         if len(free_minors) < count:
             return None
@@ -423,11 +567,12 @@ class DeviceManager:
     def _pick_whole_minors(
         self,
         st: _NodeDevices,
-        free: List[float],
+        full_minors: List[int],
         whole: int,
         annotations: Mapping[str, str],
     ) -> Optional[List[int]]:
-        full_minors = [i for i, f in enumerate(free) if f >= FULL - 1e-6]
+        """``full_minors``: minors fully free on every dimension (the
+        caller computes them over both memory and core)."""
         if st.partitions and st.partition_policy in ("Honor", "Prefer"):
             chosen = self._allocate_by_partition(
                 st, full_minors, whole, annotations
@@ -531,7 +676,9 @@ class DeviceManager:
         """Free every slot and drop all owners (full-resync path)."""
         for st in self._nodes.values():
             st.gpu_free = [FULL] * len(st.gpu_free)
+            st.gpu_core_free = [FULL] * len(st.gpu_core_free)
             st.rdma_free = [FULL] * len(st.rdma_free)
+            st.rdma_vfs = [list(v) for v in st.rdma_vf_all]
             st.fpga_free = [FULL] * len(st.fpga_free)
             st.owners.clear()
             st.rdma_owners.clear()
@@ -541,9 +688,16 @@ class DeviceManager:
         st = self._nodes.get(node_name)
         if st is None:
             return
-        for minor, pct in st.owners.pop(pod_uid, []):
+        for minor, pct, core in st.owners.pop(pod_uid, []):
             st.gpu_free[minor] = min(st.gpu_free[minor] + pct, FULL)
-        for minor, pct in st.rdma_owners.pop(pod_uid, []):
-            st.rdma_free[minor] = min(st.rdma_free[minor] + pct, FULL)
+            st.gpu_core_free[minor] = min(
+                st.gpu_core_free[minor] + core, FULL
+            )
+        for minor, pct, vf in st.rdma_owners.pop(pod_uid, []):
+            if vf is not None:
+                if vf not in st.rdma_vfs[minor]:
+                    st.rdma_vfs[minor].append(vf)
+            else:
+                st.rdma_free[minor] = min(st.rdma_free[minor] + pct, FULL)
         for minor, pct in st.fpga_owners.pop(pod_uid, []):
             st.fpga_free[minor] = min(st.fpga_free[minor] + pct, FULL)

@@ -535,8 +535,8 @@ class ElasticQuotaPreemptor:
         victim_full = sum(
             1
             for uid in victim_uids
-            for _m, pct in st.owners.get(uid, [])
-            if pct >= FULL - 1e-6
+            for pick in st.owners.get(uid, [])
+            if pick[1] >= FULL - 1e-6
         )
         if whole + (1 if share > 0 else 0) > free_full + victim_full:
             return False
