@@ -35,7 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    snap, nodes, pods = _common.build_snapshot(args)
+    snap, nodes, pods, _hub = _common.build_snapshot(args)
 
     la = LowNodeLoadArgs(
         low_thresholds={"cpu": args.low_threshold},

@@ -26,7 +26,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     _common.apply_feature_gates(MANAGER_GATES, args.feature_gates)
 
-    snap, nodes, _pods = _common.build_snapshot(args)
+    snap, nodes, _pods, _hub = _common.build_snapshot(args)
     nodemetric = NodeMetricController()
     noderesource = NodeResourceController(snap)
     nodeslo = NodeSLOController()

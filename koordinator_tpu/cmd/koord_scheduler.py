@@ -109,7 +109,7 @@ def main(
         server.stop(grace=5.0)
         return 0
 
-    snap, _nodes, pods = _common.build_snapshot(args)
+    snap, _nodes, pods, hub = _common.build_snapshot(args)
     numa = devices = None
     if numa_scoring is not None:
         import sys as _sys
@@ -151,6 +151,10 @@ def main(
     sched = BatchScheduler(
         snap, la_args, batch_bucket=args.batch_bucket, numa=numa, devices=devices
     )
+    # the rest of the scheduler's world view (pods/devices/quotas/gangs)
+    # flows through the same informer hub that already feeds the snapshot
+    hub.wire_scheduler(sched, include_snapshot=False)
+    hub.start()
     pending = [p for p in pods if not p.spec.node_name]
 
     def step(i: int):

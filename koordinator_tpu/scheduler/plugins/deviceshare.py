@@ -209,6 +209,11 @@ class DeviceManager:
     def node(self, name: str) -> Optional[_NodeDevices]:
         return self._nodes.get(name)
 
+    def remove_device(self, node_name: str) -> None:
+        """Drop a node's device inventory (Device CR deleted / node gone);
+        held allocations die with it — owners release via pod lifecycle."""
+        self._nodes.pop(node_name, None)
+
     @property
     def has_devices(self) -> bool:
         return bool(self._nodes)

@@ -28,3 +28,99 @@ def test_longrun_feedback_loop_stays_consistent():
     assert stats["reservations_gced"] >= 1
     # the descheduler soft-evicted BE pods from debounced-hot nodes
     assert stats["soft_evicted"] >= 1
+
+
+def test_longrun_survives_watch_disconnects():
+    """VERDICT r2 #3 chaos test: every open watch is severed twice
+    mid-loop (apiserver restart); the informers must re-list and the
+    scheduler's world must re-converge — every per-tick invariant
+    (accounting drift, batch-capacity bounds, reservation ledger) is
+    asserted INSIDE run_loop after each disconnect."""
+    stats = run_loop(minutes=10.0, n_nodes=6, seed=3, chaos_ticks=(7, 23))
+    assert stats["watch_disconnects"] == 2
+    # each of the wired informers re-listed at least once beyond its
+    # initial sync (initial = 1 per informer; 5 informers wired: nodes,
+    # metrics, pods, reservations, pod groups)
+    assert stats["relists"] >= 5 + 2
+    # the loop kept scheduling and completing across the disconnects
+    assert stats["bound"] > 30
+    assert stats["completed"] > 20
+    assert stats["reservations_consumed"] >= 1
+
+
+def test_chaos_relist_converges_scheduler_state():
+    """Direct convergence proof: bind + delete events land while the
+    watch is DOWN; after re-list the snapshot charge matches the live
+    world exactly (the dropped events were reconciled by diff)."""
+    import numpy as np
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+    snap = ClusterSnapshot()
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    hub = ClusterStateHub()
+    hub.wire_scheduler(sched)
+    hub.start()
+    try:
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name="n0"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+                ),
+            ),
+        )
+        p1 = Pod(
+            meta=ObjectMeta(name="a"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096},
+                node_name="n0",
+            ),
+        )
+        hub.publish(hub.pods, p1)
+        assert hub.wait_synced()
+        idx = snap.node_id("n0")
+        assert snap.nodes.requested[idx, 0] == 4000.0
+
+        # sever every watch, THEN mutate: p1 deleted, p2 bound, and a
+        # second node appears — all while nobody is watching
+        hub.disconnect()
+        hub.delete(hub.pods, p1)
+        p2 = Pod(
+            meta=ObjectMeta(name="b"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 6000, ext.RES_MEMORY: 4096},
+                node_name="n0",
+            ),
+        )
+        hub.publish(hub.pods, p2)
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name="n1"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 131072}
+                ),
+            ),
+        )
+        # re-list convergence: the diff delivers p1's delete, p2's add,
+        # and n1's add
+        assert hub.wait_synced()
+        assert not snap.is_assumed(p1.meta.uid)
+        assert snap.is_assumed(p2.meta.uid)
+        assert snap.nodes.requested[idx, 0] == 6000.0
+        assert snap.node_id("n1") is not None
+        assert hub.relists() > len(hub.informers)  # recovery re-lists ran
+        # accounting invariant after recovery
+        want = np.zeros_like(snap.nodes.requested)
+        for _uid, ap in snap._assumed.items():
+            want[ap.node_idx] += ap.request
+        np.testing.assert_allclose(snap.nodes.requested, want, atol=1e-3)
+    finally:
+        hub.stop()
