@@ -172,6 +172,31 @@ def sharded_solve_stream(
     return fn(pods_stacked, nodes, params)
 
 
+def _pad_nodes(nodes: NodeState, pad: int) -> NodeState:
+    """Append ``pad`` infeasible node rows (zero capacity, unschedulable)
+    so the table divides evenly across the tp axis."""
+    import jax.numpy as jnp
+
+    def zrows(a):
+        return jnp.concatenate(
+            [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+        )
+
+    return NodeState(
+        allocatable=zrows(nodes.allocatable),
+        requested=zrows(nodes.requested),
+        estimated_used=zrows(nodes.estimated_used),
+        prod_used=zrows(nodes.prod_used),
+        metric_fresh=zrows(nodes.metric_fresh),
+        schedulable=zrows(nodes.schedulable),
+        cpu_amp=jnp.concatenate(
+            [nodes.cpu_amp, jnp.ones(pad, nodes.cpu_amp.dtype)]
+        ),
+        custom_thresholds=zrows(nodes.custom_thresholds),
+        custom_prod_thresholds=zrows(nodes.custom_prod_thresholds),
+    )
+
+
 def shard_map_nominate(
     mesh: Mesh,
     pods: PodBatch,
@@ -214,8 +239,14 @@ def shard_map_nominate(
 
     n = nodes.allocatable.shape[0]
     tp = mesh.shape["tp"]
-    if n % tp:
-        raise ValueError(f"node count {n} not divisible by tp={tp}")
+    pad = (-n) % tp
+    if pad:
+        # pad the node table to a multiple of tp with infeasible rows
+        # (schedulable=False → cost inf): a padded row can only surface
+        # as a candidate when every real node is infeasible for that pod,
+        # and then its -inf value marks it invalid to the commit phase
+        nodes = _pad_nodes(nodes, pad)
+        n += pad
     shard_w = n // tp
     p = pods.requests.shape[0]
 
