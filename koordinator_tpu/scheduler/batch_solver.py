@@ -136,6 +136,7 @@ class BatchScheduler:
         numa: Optional["NUMAManager"] = None,
         devices: Optional["DeviceManager"] = None,
         extender: Optional["FrameworkExtender"] = None,
+        defer_preemption: bool = False,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -173,6 +174,14 @@ class BatchScheduler:
         )
         #: pod uid → node for bound pods (preemption victim lookup)
         self._bound_nodes: Dict[str, str] = {}
+        #: True = quota preemption NOMINATES victims in
+        #: ScheduleOutcome.preempted without evicting or retrying — the
+        #: caller routes them through the descheduler's migration
+        #: machinery (PodMigrationJob → evictor) and the preemptor
+        #: retries next cycle once the evictions have landed. False
+        #: (default) keeps the synchronous PostFilter behavior: evict
+        #: internally and retry within the same call.
+        self.defer_preemption = defer_preemption
 
     # ---- device lowering ----
 
@@ -452,6 +461,17 @@ class BatchScheduler:
                 if sel is None:
                     continue
                 _node, victims = sel
+                if self.defer_preemption:
+                    # nominate only: the external migration controller
+                    # performs the (arbitrated, rate-limited) eviction and
+                    # the preemptor retries next cycle. Selections are not
+                    # applied between preemptors here, so overlapping
+                    # victim sets are deduped and re-resolved next cycle.
+                    seen = {v.meta.uid for v in preempted}
+                    preempted.extend(
+                        v for v in victims if v.meta.uid not in seen
+                    )
+                    continue
                 for victim in victims:
                     self.evict_for_preemption(victim)
                     preempted.append(victim)

@@ -102,7 +102,12 @@ def run_loop(
             },
         )
     )
-    sched = BatchScheduler(snap, LoadAwareArgs(), batch_bucket=128)
+    # defer_preemption: quota-preemption victims are NOMINATED and routed
+    # through the descheduler's PodMigrationJob machinery below — the
+    # preemptor lands the cycle after the arbitrated eviction
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), batch_bucket=128, defer_preemption=True
+    )
     sched.extender.monitor.stop_background()
     from koordinator_tpu.api.types import Reservation, ReservationOwner
     from koordinator_tpu.descheduler.evictor import SoftEvictor
@@ -159,6 +164,47 @@ def run_loop(
     )
     soft_evictor = SoftEvictor()
 
+    # ---- quota preemption → migration integration (VERDICT r2 #7):
+    # a saturated "frontend" quota, mid-priority web pods holding it, and
+    # periodic high-priority api pods whose arrival must evict a victim
+    # via PodMigrationJob and land the NEXT cycle ----
+    from koordinator_tpu.api.types import ElasticQuota, MigrationMode
+    from koordinator_tpu.descheduler.migration import MigrationController
+
+    hub.publish(
+        hub.quotas,
+        ElasticQuota(
+            meta=ObjectMeta(name="frontend"),
+            min={ext.RES_CPU: 16000, ext.RES_MEMORY: 65536},
+            max={ext.RES_CPU: 16000, ext.RES_MEMORY: 65536},
+        ),
+    )
+    assert hub.wait_synced()
+
+    def _preemption_evict(victim: Pod, reason: str) -> bool:
+        # the actual eviction is the pod DELETE on the API server; every
+        # component releases through the informer fan-out. A victim that
+        # vanished since nomination (completed meanwhile) is a FAILED
+        # eviction, not a silent success.
+        return hub.delete(hub.pods, victim) is not None
+
+    mig_ctrl = MigrationController(rm, _preemption_evict)
+    web_live: list = []       # mid-priority quota holders
+    preempt_retry: list = []  # high-prio preemptors awaiting their cycle
+
+    def _quota_pod(name: str, prio: int, app: str) -> Pod:
+        return Pod(
+            meta=ObjectMeta(
+                name=name,
+                namespace="frontend",
+                labels={"app": app, ext.LABEL_QUOTA_NAME: "frontend"},
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 16384},
+                priority=prio,
+            ),
+        )
+
     bc = snap.config.resources.index(ext.RES_BATCH_CPU)
     rows = [snap.node_id(f"n{i}") for i in range(n_nodes)]
 
@@ -186,6 +232,9 @@ def run_loop(
         reservations_drifted=0,
         reservations_gced=0,
         soft_evicted=0,
+        preemption_nominations=0,
+        preemption_jobs=0,
+        preemptors_landed=0,
     )
     n_ticks = int(minutes * 60.0 / tick_s)
     pod_seq = 0
@@ -321,6 +370,63 @@ def run_loop(
             plan = runtimehooks.pod_plan(pod)
             assert "bvt" in str(plan)
             live.append((pod, node, tick + BE_LIFETIME))
+
+        # ---- quota preemption leg: web pods hold the saturated quota;
+        # a high-priority api pod's arrival nominates a victim, the
+        # PodMigrationJob controller evicts it (EvictDirectly → pod
+        # DELETE → informer fan-out), and the api pod lands NEXT tick ----
+        quota_arrivals: list = []
+        if tick in (1, 21):
+            quota_arrivals.extend(
+                _quota_pod(f"web-{tick}-{j}", 7000, "web") for j in range(2)
+            )
+        if tick in (6, 26):
+            quota_arrivals.append(_quota_pod(f"api-{tick}", 9500, "api"))
+        if quota_arrivals or preempt_retry:
+            retry_uids = {p.meta.uid for p in preempt_retry}
+            qout = sched.schedule(quota_arrivals + preempt_retry)
+            for pod, node in qout.bound:
+                pod.spec.node_name = node
+                hub.publish(hub.pods, pod)
+                if (
+                    pod.meta.uid in retry_uids
+                    and pod.meta.labels.get("app") == "api"
+                ):
+                    # a high-priority preemptor landed the cycle AFTER
+                    # its victim's migration-job eviction
+                    stats["preemptors_landed"] += 1
+                if pod.meta.labels.get("app") == "web":
+                    web_live.append(pod)
+            stats["preemption_nominations"] += len(qout.preempted)
+            jobs_before = len(mig_ctrl.jobs)
+            for victim in qout.preempted:
+                # every nominated victim must be a live quota holder —
+                # preemption may never nominate arbitrary pods
+                assert any(
+                    p.meta.uid == victim.meta.uid for p in web_live
+                ), victim.meta.name
+                mig_ctrl.submit(victim, MigrationMode.EVICT_DIRECTLY)
+            stats["preemption_jobs"] += len(mig_ctrl.jobs) - jobs_before
+            # only high-priority api pods re-queue: an unschedulable web
+            # pod cannot preempt higher-priority holders and would churn
+            # the solver every remaining tick for nothing
+            preempt_retry = [
+                p
+                for p in qout.unschedulable
+                if p.meta.labels.get("app") == "api"
+            ]
+        # the migration controller reconciles EVERY tick like a real
+        # controller — jobs the arbitrator left pending are retried even
+        # on ticks with no new nominations
+        if mig_ctrl.jobs:
+            mig_ctrl.reconcile(now=sim_clock())
+            assert hub.wait_synced()   # evictions landed everywhere
+            alive_keys, _rv = hub.pods.list()
+            web_live = [
+                p
+                for p in web_live
+                if f"{p.meta.namespace}/{p.meta.name}" in alive_keys
+            ]
 
         # ---- qosmanager: suppression on the hottest node ----
         hot = max(utils, key=lambda k: utils[k])
