@@ -60,6 +60,55 @@ GANG_MATCH_ONCE_SATISFIED = "once-satisfied"
 ANNOTATION_GANG_MODE = f"gang.scheduling.{DOMAIN}/mode"
 GANG_MODE_STRICT = "Strict"
 GANG_MODE_NONSTRICT = "NonStrict"
+#: the koordinator-native gang annotation protocol (AnnotationGangPrefix,
+#: ``apis/extension/coscheduling.go:25-47``) — takes precedence over the
+#: deprecated lightweight-coscheduling labels below it
+ANNOTATION_GANG_NAME = f"gang.scheduling.{DOMAIN}/name"
+ANNOTATION_GANG_MIN_AVAILABLE = f"gang.scheduling.{DOMAIN}/min-available"
+ANNOTATION_GANG_TOTAL_NUM = f"gang.scheduling.{DOMAIN}/total-number"
+ANNOTATION_GANG_WAIT_TIME = f"gang.scheduling.{DOMAIN}/waiting-time"
+
+
+def gang_name_of(pod) -> Optional[str]:
+    """Gang name: native annotation first (reference GetGangName), the
+    deprecated lightweight label as fallback."""
+    return pod.meta.annotations.get(ANNOTATION_GANG_NAME) or pod.meta.labels.get(
+        LABEL_GANG_NAME
+    )
+
+
+def gang_min_available_of(pod) -> Optional[int]:
+    """minMember: native annotation (GetGangMinNumFromPod) first, the
+    lightweight label second; None when absent/unparseable."""
+    raw = pod.meta.annotations.get(
+        ANNOTATION_GANG_MIN_AVAILABLE
+    ) or pod.meta.labels.get(LABEL_GANG_MIN_AVAILABLE)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def parse_duration_s(raw: Optional[str]) -> Optional[float]:
+    """Go time.ParseDuration subset (h/m/s/ms components, e.g. "1h30m",
+    "90s"); None on absent/illegal/non-positive — callers fall back to
+    their default (gang.go:148-153 waitTime handling)."""
+    if not raw:
+        return None
+    import re
+
+    m = re.fullmatch(
+        r"(?:(\d+(?:\.\d+)?)h)?(?:(\d+(?:\.\d+)?)m)?"
+        r"(?:(\d+(?:\.\d+)?)s)?(?:(\d+(?:\.\d+)?)ms)?",
+        raw.strip(),
+    )
+    if m is None or not any(m.groups()):
+        return None
+    h, mi, s, ms = (float(g) if g else 0.0 for g in m.groups())
+    total = h * 3600.0 + mi * 60.0 + s + ms / 1000.0
+    return total if total > 0 else None
 
 
 def gang_mode_of(annotations: Mapping[str, str]) -> str:

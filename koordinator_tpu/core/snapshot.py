@@ -744,13 +744,17 @@ class ClusterSnapshot:
                     qos_cache[qos_label] = qv
                 if qv != int(ext.QoSClass.NONE):
                     explicit_qos.append((i, qv))
-            gang = labels.get(ext.LABEL_GANG_NAME)
+            gang = pod.meta.annotations.get(
+                ext.ANNOTATION_GANG_NAME
+            ) or labels.get(ext.LABEL_GANG_NAME)
             if gang:
                 key = f"{pod.meta.namespace}/{gang}"
                 gid = gang_ids.setdefault(key, len(gang_ids))
                 out.gang_id[i] = gid
                 gang_members[gid] = gang_members.get(gid, 0) + 1
-                label_min = labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
+                label_min = pod.meta.annotations.get(
+                    ext.ANNOTATION_GANG_MIN_AVAILABLE
+                ) or labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
                 if label_min is not None:
                     try:
                         gang_label_min[gid] = int(label_min)

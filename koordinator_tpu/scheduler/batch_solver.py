@@ -110,6 +110,9 @@ class LoweredRows:
     fpga: Optional[np.ndarray] = None        # [P] int32
     #: whether any pod in the chunk belongs to a gang (permit bypass)
     has_gangs: bool = True
+    #: [P, L] lowered leaf-to-root quota index paths (−1 padding); the
+    #: commit's quota accounting reuses them instead of re-walking names
+    quota_chain: Optional[np.ndarray] = None
 
 
 @dataclasses.dataclass
@@ -268,6 +271,7 @@ class BatchScheduler:
             rdma=arrays.rdma,
             fpga=arrays.fpga,
             has_gangs=bool((arrays.gang_id >= 0).any()),
+            quota_chain=chains,
         )
         return PodBatch.create(
             requests=arrays.requests,
@@ -801,14 +805,24 @@ class BatchScheduler:
         # at the guaranteed tier).
         self.quotas.sync_cluster_total(self.snapshot)
         # Propagate desired requests (pending + admitted) up the tree so
-        # fair sharing reflects demand, then refresh runtime.
+        # fair sharing reflects demand, then refresh runtime. Request
+        # vectors memoize on the request dict's items — clusters have few
+        # distinct pod shapes, and the per-pod res_vector walk was a
+        # visible slice of large quota batches.
         by_leaf: Dict[str, np.ndarray] = {}
+        vec_cache: Dict[tuple, np.ndarray] = {}
+        res_vector = self.snapshot.config.res_vector
         for pod in chunk:
             leaf = quota_name_of(pod)
             if leaf is None:
                 continue
-            vec = self.snapshot.config.res_vector(pod.spec.requests)
-            by_leaf[leaf] = by_leaf.get(leaf, 0) + vec
+            key = tuple(pod.spec.requests.items())
+            vec = vec_cache.get(key)
+            if vec is None:
+                vec = res_vector(pod.spec.requests)
+                vec_cache[key] = vec
+            acc = by_leaf.get(leaf)
+            by_leaf[leaf] = vec.copy() if acc is None else acc + vec
         for leaf in list(by_leaf):
             idx = self.quotas.index_of(leaf)
             if idx is not None and idx < self.quotas.used.shape[0]:
@@ -902,41 +916,52 @@ class BatchScheduler:
             for pod, _node in bound:
                 prebind.apply(pod)
         # Durable quota accounting + victim bookkeeping for what actually
-        # bound. Charges are summed per leaf and applied once per chain
-        # (the per-pod charge walk was a visible slice of the quota
-        # scenario's commit); the per-pod record still feeds the overuse
-        # revoker / preemptor victim selection.
+        # bound. Chains are reused from the chunk lowering and charged in
+        # one vectorized scatter (the per-pod name walk + chain charge
+        # was a visible slice of the quota scenario's commit); the
+        # per-pod record still feeds the overuse revoker / preemptor
+        # victim selection.
         from .plugins.elasticquota import quota_name_of
 
         bound_nodes = self._bound_nodes
         if self.quotas.quota_count == 0:
             for pod, node in bound:
                 bound_nodes[pod.meta.uid] = node
+        elif rows.quota_chain is None:
+            for pod, node in bound:
+                bound_nodes[pod.meta.uid] = node
+                leaf = quota_name_of(pod)
+                if leaf is not None:
+                    self.quotas.assign_pod(leaf, pod)
         else:
             uid_to_row = {u: i for i, u in enumerate(rows.uids)}
-            by_leaf: Dict[str, np.ndarray] = {}
             quotas = self.quotas
-            req = rows.req
+            name_of = quotas.name_of_index
+            b_rows: List[int] = []
+            b_pods: List[Pod] = []
             for pod, node in bound:
                 uid = pod.meta.uid
                 bound_nodes[uid] = node
-                leaf = quota_name_of(pod)
-                if leaf is None:
-                    continue
                 row = uid_to_row.get(uid)
-                vec = (
-                    req[row]
-                    if row is not None
-                    else self.snapshot.config.res_vector(pod.spec.requests)
-                )
-                acc = by_leaf.get(leaf)
-                if acc is None:
-                    by_leaf[leaf] = vec.copy()
-                else:
-                    acc += vec
-                quotas.record_assigned(leaf, pod)
-            for leaf, vec in by_leaf.items():
-                quotas.charge(leaf, {}, vec=vec)
+                if row is None:
+                    # not from this chunk's lowering (defensive)
+                    leaf = quota_name_of(pod)
+                    if leaf is not None:
+                        quotas.assign_pod(leaf, pod)
+                    continue
+                b_rows.append(row)
+                b_pods.append(pod)
+            if b_rows:
+                idx = np.asarray(b_rows)
+                chains = rows.quota_chain[idx]
+                leaf_l = chains[:, 0].tolist()
+                has = chains[:, 0] >= 0
+                if has.any():
+                    quotas.charge_rows(chains[has], rows.req[idx[has]])
+                for k, pod in enumerate(b_pods):
+                    li = leaf_l[k]
+                    if li >= 0:
+                        quotas.record_assigned(name_of(li), pod)
         return bound, unsched
 
     def _reserve_batch(

@@ -23,7 +23,9 @@ from ...api.types import Pod, PodGroup
 
 
 def gang_key_of(pod: Pod) -> Optional[str]:
-    gang = pod.meta.labels.get(ext.LABEL_GANG_NAME)
+    gang = pod.meta.annotations.get(
+        ext.ANNOTATION_GANG_NAME
+    ) or pod.meta.labels.get(ext.LABEL_GANG_NAME)
     if not gang:
         return None
     return f"{pod.meta.namespace}/{gang}"
@@ -94,6 +96,9 @@ class _GangState:
     #: once at gang creation (CRD or first member), like match_policy.
     mode: str = ext.GANG_MODE_STRICT
     mode_declared: bool = False
+    #: declared total children (AnnotationGangTotalNum, ≥ minMember when
+    #: both set; None = defaults to minMember per gang.go:114-125)
+    total_num: Optional[int] = None
     #: sticky once-satisfied flag (reference ``gang.go:435-459``
     #: setResourceSatisfied, set by Permit allow and addBoundPod)
     satisfied: bool = False
@@ -150,17 +155,32 @@ class PodGroupManager:
     def _gang_for_pod(self, key: str, pod: Pod) -> _GangState:
         state = self._gangs.get(key)
         if state is None:
-            label_min = pod.meta.labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
-            min_member: Optional[int] = None
-            if label_min is not None:
+            # native annotation protocol first (gang.go:100-175
+            # tryInitByPodConfig): min-available, waiting-time (Go
+            # duration; illegal → default), total-number (clamped to
+            # ≥ minMember)
+            min_member = ext.gang_min_available_of(pod)
+            wait = ext.parse_duration_s(
+                pod.meta.annotations.get(ext.ANNOTATION_GANG_WAIT_TIME)
+            )
+            total: Optional[int] = None
+            raw_total = pod.meta.annotations.get(
+                ext.ANNOTATION_GANG_TOTAL_NUM
+            )
+            if raw_total is not None:
                 try:
-                    min_member = int(label_min)
+                    total = int(raw_total)
                 except ValueError:
-                    min_member = None
+                    total = None
+            if total is not None and min_member is not None:
+                total = max(total, min_member)
             state = _GangState(
                 min_member=min_member,
                 create_time=time.time(),
-                schedule_timeout_s=self.default_timeout_s,
+                schedule_timeout_s=(
+                    wait if wait is not None else self.default_timeout_s
+                ),
+                total_num=total,
             )
             self._gangs[key] = state
         # the FIRST member to register pins the gang's policy (its explicit

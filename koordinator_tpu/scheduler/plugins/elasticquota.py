@@ -323,6 +323,32 @@ class GroupQuotaManager:
         charges once per leaf via ``charge`` with a summed vector)."""
         self._assigned.setdefault(quota_name, {})[pod.meta.uid] = pod
 
+    def name_of_index(self, idx: int) -> Optional[str]:
+        """Quota name for a lowered chain index (inverse of index_of)."""
+        return self._order[idx] if 0 <= idx < len(self._order) else None
+
+    def charge_rows(self, chains: np.ndarray, vecs: np.ndarray) -> None:
+        """Vectorized charge for a batch of pods: ``chains`` [B, L] are
+        lowered leaf-to-root index paths (−1 padding), ``vecs`` [B, D]
+        the request rows. One sort+reduceat scatter replaces B·L
+        per-level ``used[idx] += vec`` updates (the per-pod chain walk
+        was a visible slice of the quota scenario's commit)."""
+        if chains.size == 0:
+            return
+        self._ensure_capacity()
+        levels = chains.shape[1]
+        flat = chains.reshape(-1)
+        sel = flat >= 0
+        if not sel.any():
+            return
+        idxs = flat[sel]
+        rows = np.repeat(vecs, levels, axis=0)[sel]
+        perm = np.argsort(idxs, kind="stable")
+        si = idxs[perm]
+        sr = rows[perm]
+        starts = np.nonzero(np.r_[True, si[1:] != si[:-1]])[0]
+        self.used[si[starts]] += np.add.reduceat(sr, starts, axis=0)
+
     def unassign_pod(self, quota_name: str, pod: "Pod") -> None:
         if self._assigned.get(quota_name, {}).pop(pod.meta.uid, None) is not None:
             self.refund(quota_name, pod.spec.requests)

@@ -454,3 +454,52 @@ def test_nonstrict_mode_from_podgroup_crd():
     assert ext.gang_mode_of({ext.ANNOTATION_GANG_MODE: "bogus"}) == (
         ext.GANG_MODE_STRICT
     )
+
+
+def test_native_gang_annotation_protocol():
+    """The koordinator-native gang annotations (AnnotationGangPrefix,
+    apis/extension/coscheduling.go:25-47) drive gang formation end to
+    end: name, min-available, waiting-time (Go duration), total-number
+    (clamped >= minMember); the deprecated lightweight labels remain a
+    fallback."""
+    def native_pod(name, cpu=4.0):
+        p = Pod(
+            meta=ObjectMeta(name=name),
+            spec=PodSpec(
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu},
+                priority=9000,
+            ),
+        )
+        p.meta.annotations.update(
+            {
+                ext.ANNOTATION_GANG_NAME: "native-g",
+                ext.ANNOTATION_GANG_MIN_AVAILABLE: "2",
+                ext.ANNOTATION_GANG_WAIT_TIME: "90s",
+                ext.ANNOTATION_GANG_TOTAL_NUM: "1",  # illegal: < min
+            }
+        )
+        return p
+
+    sched = BatchScheduler(_cluster())
+    # one member alone gates at PreEnqueue (minMember 2 from annotation)
+    out1 = sched.schedule([native_pod("n1")])
+    assert out1.bound == []
+    state = sched.pod_groups._gangs["default/native-g"]
+    assert state.min_member == 2
+    assert state.schedule_timeout_s == 90.0
+    assert state.total_num == 2        # clamped up to minMember
+    # both members together schedule all-or-nothing
+    out2 = sched.schedule([native_pod("n1"), native_pod("n2")])
+    assert len(out2.bound) == 2
+
+
+def test_parse_duration_s():
+    from koordinator_tpu.api.extension import parse_duration_s
+
+    assert parse_duration_s("90s") == 90.0
+    assert parse_duration_s("1h30m") == 5400.0
+    assert parse_duration_s("250ms") == 0.25
+    assert parse_duration_s("2m3s") == 123.0
+    assert parse_duration_s("") is None
+    assert parse_duration_s("bogus") is None
+    assert parse_duration_s("0s") is None   # non-positive -> default
