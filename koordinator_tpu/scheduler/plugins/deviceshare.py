@@ -340,11 +340,18 @@ class DeviceManager:
         st = self._nodes.get(node_name)
         if st is None:
             return None
-        if requests is not None:
+        if requests is not None and (
+            ext.RES_GPU_CORE in requests
+            or ext.RES_GPU_MEMORY in requests
+            or ext.RES_GPU_MEMORY_RATIO in requests
+            or ext.RES_KOORD_GPU in requests
+        ):
             whole, core, ratio, mem_bytes = ext.parse_gpu_request_vector(
                 requests
             )
         else:
+            # whole-GPU-only request: the lowered scalars already say it
+            # all — skip the per-dim re-parse (commit hot path)
             core, ratio, mem_bytes = share, share, None
         picks: List[Tuple[int, float, float]] = []
         free = list(st.gpu_free)
