@@ -226,6 +226,13 @@ class ClusterSnapshot:
         metric_expiry_s: float = 180.0,
     ):
         self.config = config or SnapshotConfig()
+        #: coarse serialization between writers (informer handler threads)
+        #: and the scheduling cycle — the reference scheduler cache's lock
+        #: at batch granularity. Re-entrant: the cycle itself both reads
+        #: and writes under it.
+        import threading as _threading
+
+        self.lock = _threading.RLock()
         res = self.config.resources
         self._cpu_dim = res.index(ext.RES_CPU) if ext.RES_CPU in res else 0
         self._res_index = {r: j for j, r in enumerate(res)}

@@ -29,6 +29,19 @@ def _key(obj) -> str:
     return f"{ns}/{obj.meta.name}" if ns else obj.meta.name
 
 
+
+def _locked(lock, fn):
+    """Run an informer handler under the snapshot's coarse lock: handler
+    threads must never interleave with a scheduling cycle's reads/writes
+    (the reference serializes cache mutations the same way)."""
+
+    def handler(key, obj):
+        with lock:
+            fn(key, obj)
+
+    return handler
+
+
 class ClusterStateHub:
     """Versioned trackers per resource kind + informer wiring."""
 
@@ -71,11 +84,12 @@ class ClusterStateHub:
     def wire_snapshot(self, snap) -> List[Informer]:
         """Node + NodeMetric informers feeding a ClusterSnapshot — the
         minimal consumer set (manager/descheduler binaries)."""
+        lock = snap.lock
         node_inf = Informer(self.nodes, self.resync_interval_s)
         node_inf.add_handlers(
-            on_add=lambda k, o: snap.upsert_node(o),
-            on_update=lambda k, o: snap.upsert_node(o),
-            on_delete=lambda k, o: snap.remove_node(o.meta.name),
+            on_add=_locked(lock, lambda k, o: snap.upsert_node(o)),
+            on_update=_locked(lock, lambda k, o: snap.upsert_node(o)),
+            on_delete=_locked(lock, lambda k, o: snap.remove_node(o.meta.name)),
         )
 
         metric_inf = Informer(self.node_metrics, self.resync_interval_s)
@@ -86,7 +100,10 @@ class ClusterStateHub:
                 now=(m.update_time + 1 if m.update_time else _time.time()),
             )
 
-        metric_inf.add_handlers(on_add=_metric, on_update=_metric)
+        metric_inf.add_handlers(
+            on_add=_locked(lock, _metric),
+            on_update=_locked(lock, _metric),
+        )
         informers = [node_inf, metric_inf]
         self.informers.extend(informers)
         return informers
@@ -149,8 +166,11 @@ class ClusterStateHub:
             if reservations is not None:
                 reservations.remove_operating_pod(pod.meta.name)
 
+        lock = snap.lock
         pod_inf.add_handlers(
-            on_add=_pod_upsert, on_update=_pod_upsert, on_delete=_pod_delete
+            on_add=_locked(lock, _pod_upsert),
+            on_update=_locked(lock, _pod_upsert),
+            on_delete=_locked(lock, _pod_delete),
         )
         extras.append(pod_inf)
 
@@ -162,16 +182,19 @@ class ClusterStateHub:
                     pending_binds.pop(uid, None)
                     _pod_upsert(uid, pod)
 
-        drain_inf.add_handlers(on_add=_drain_binds, on_update=_drain_binds)
+        drain_inf.add_handlers(
+            on_add=_locked(lock, _drain_binds),
+            on_update=_locked(lock, _drain_binds),
+        )
         extras.append(drain_inf)
 
         if sched.devices is not None:
             dev_inf = Informer(self.devices, self.resync_interval_s)
             dev_inf.add_handlers(
-                on_add=lambda k, d: sched.devices.upsert_device(d),
-                on_update=lambda k, d: sched.devices.upsert_device(d),
-                on_delete=lambda k, d: sched.devices.remove_device(
-                    d.meta.name
+                on_add=_locked(lock, lambda k, d: sched.devices.upsert_device(d)),
+                on_update=_locked(lock, lambda k, d: sched.devices.upsert_device(d)),
+                on_delete=_locked(
+                    lock, lambda k, d: sched.devices.remove_device(d.meta.name)
                 ),
             )
             extras.append(dev_inf)
@@ -179,10 +202,10 @@ class ClusterStateHub:
         if sched.quotas is not None:
             quota_inf = Informer(self.quotas, self.resync_interval_s)
             quota_inf.add_handlers(
-                on_add=lambda k, q: sched.quotas.upsert_quota(q),
-                on_update=lambda k, q: sched.quotas.upsert_quota(q),
-                on_delete=lambda k, q: sched.quotas.remove_quota(
-                    q.meta.name
+                on_add=_locked(lock, lambda k, q: sched.quotas.upsert_quota(q)),
+                on_update=_locked(lock, lambda k, q: sched.quotas.upsert_quota(q)),
+                on_delete=_locked(
+                    lock, lambda k, q: sched.quotas.remove_quota(q.meta.name)
                 ),
             )
             extras.append(quota_inf)
@@ -196,18 +219,21 @@ class ClusterStateHub:
                     reservations.add(r)
 
             resv_inf.add_handlers(
-                on_add=_resv_upsert,
-                on_update=_resv_upsert,
-                on_delete=lambda k, r: reservations.expire_reservation(
-                    r.meta.name
+                on_add=_locked(lock, _resv_upsert),
+                on_update=_locked(lock, _resv_upsert),
+                on_delete=_locked(
+                    lock,
+                    lambda k, r: reservations.expire_reservation(r.meta.name),
                 ),
             )
             extras.append(resv_inf)
 
         pg_inf = Informer(self.pod_groups, self.resync_interval_s)
         pg_inf.add_handlers(
-            on_add=lambda k, pg: sched.pod_groups.upsert_pod_group(pg),
-            on_update=lambda k, pg: sched.pod_groups.upsert_pod_group(pg),
+            on_add=_locked(lock, lambda k, pg: sched.pod_groups.upsert_pod_group(pg)),
+            on_update=_locked(
+                lock, lambda k, pg: sched.pod_groups.upsert_pod_group(pg)
+            ),
         )
         extras.append(pg_inf)
 

@@ -295,6 +295,15 @@ class BatchScheduler:
     def schedule(
         self, pending: Sequence[Pod], _retry: bool = False
     ) -> ScheduleOutcome:
+        # one scheduling cycle is atomic w.r.t. informer writers (the
+        # reference cache lock at batch granularity); re-entrant for the
+        # preemption retry
+        with self.snapshot.lock:
+            return self._schedule_locked(pending, _retry)
+
+    def _schedule_locked(
+        self, pending: Sequence[Pod], _retry: bool = False
+    ) -> ScheduleOutcome:
         import time as _time
 
         fwext = self.extender

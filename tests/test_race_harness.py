@@ -243,3 +243,122 @@ def test_snapshot_channel_sync_nominate_races():
     finally:
         client.close()
         server.stop(grace=None)
+
+
+def test_statehub_informers_race_scheduling_cycles():
+    """Informer handler threads (node churn, metric updates, binds,
+    deletes) race live schedule() calls; the snapshot's coarse lock
+    serializes them like the reference cache lock. At quiesce the
+    accounting invariant must hold exactly: requested == Σ live assumes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+    snap = ClusterSnapshot()
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    hub = ClusterStateHub()
+    hub.wire_scheduler(sched)
+    hub.start()
+
+    def node(i, cpu=64000):
+        return Node(
+            meta=ObjectMeta(name=f"n{i}"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: 262144}
+            ),
+        )
+
+    try:
+        for i in range(8):
+            hub.publish(hub.nodes, node(i))
+        assert hub.wait_synced()
+        # warm the jit cache before the race (compile stalls would
+        # serialize everything and hide interleavings)
+        sched.schedule(
+            [
+                Pod(
+                    meta=ObjectMeta(name="warm"),
+                    spec=PodSpec(
+                        requests={ext.RES_CPU: 100, ext.RES_MEMORY: 128},
+                        priority=9000,
+                    ),
+                )
+            ]
+        )
+
+        stop = threading.Event()
+        errors: list = []
+        seq = {"n": 0}
+
+        def churner():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                # re-upsert nodes (same capacity) and bounce one node
+                hub.publish(hub.nodes, node(k % 8))
+                if k % 7 == 0:
+                    hub.delete(hub.nodes, node((k + 3) % 8))
+                    hub.publish(hub.nodes, node((k + 3) % 8))
+                time.sleep(0.001)
+
+        def external_binder():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                p = Pod(
+                    meta=ObjectMeta(name=f"ext-{k}"),
+                    spec=PodSpec(
+                        requests={ext.RES_CPU: 500, ext.RES_MEMORY: 512},
+                        priority=9000,
+                        node_name=f"n{k % 8}",
+                    ),
+                )
+                hub.publish(hub.pods, p)
+                time.sleep(0.002)
+                if k % 2 == 0:
+                    hub.delete(hub.pods, p)
+                time.sleep(0.001)
+
+        threads = [
+            threading.Thread(target=churner, daemon=True),
+            threading.Thread(target=external_binder, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(15):
+                seq["n"] += 1
+                pods = [
+                    Pod(
+                        meta=ObjectMeta(name=f"s{seq['n']}-{j}"),
+                        spec=PodSpec(
+                            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1024},
+                            priority=9000,
+                        ),
+                    )
+                    for j in range(8)
+                ]
+                out = sched.schedule(pods)
+                assert len(out.bound) + len(out.unschedulable) == 8
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors
+        assert hub.wait_synced()
+        # quiesce: the invariant must hold exactly under the lock
+        with snap.lock:
+            want = np.zeros_like(snap.nodes.requested)
+            for _uid, ap in snap._assumed.items():
+                want[ap.node_idx] += ap.request
+            np.testing.assert_allclose(
+                snap.nodes.requested, want, atol=1e-3
+            )
+    finally:
+        hub.stop()
