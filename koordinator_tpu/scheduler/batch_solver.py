@@ -140,6 +140,7 @@ class BatchScheduler:
         devices: Optional["DeviceManager"] = None,
         extender: Optional["FrameworkExtender"] = None,
         defer_preemption: bool = False,
+        enable_priority_preemption: bool = False,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -177,6 +178,13 @@ class BatchScheduler:
         )
         #: pod uid → node for bound pods (preemption victim lookup)
         self._bound_nodes: Dict[str, str] = {}
+        #: pod uid → Pod for bound pods (the reference cache's NodeInfo
+        #: pod inventory — priority preemption picks victims from it)
+        self._bound_pods: Dict[str, Pod] = {}
+        #: priority-based preemption at PostFilter (the reservation
+        #: plugin's preemption manager; ReservationArgs.EnablePreemption,
+        #: default false per v1beta3/defaults.go:52)
+        self.enable_priority_preemption = enable_priority_preemption
         #: True = quota preemption NOMINATES victims in
         #: ScheduleOutcome.preempted without evicting or retrying — the
         #: caller routes them through the descheduler's migration
@@ -318,6 +326,11 @@ class BatchScheduler:
                     for uid, node in self._bound_nodes.items()
                     if uid in self.snapshot._assumed
                 }
+                self._bound_pods = {
+                    uid: p
+                    for uid, p in self._bound_pods.items()
+                    if uid in self._bound_nodes
+                }
         # BeforePreFilter analog: pod transformers may rewrite or drop.
         # (Dropped pods are error-handled inside the transformer run.)
         pending, dropped = fwext.run_pre_batch_transformers(pending)
@@ -404,6 +417,7 @@ class BatchScheduler:
                 if leaf is not None:
                     self.quotas.assign_pod(leaf, pod)
                 self._bound_nodes[pod.meta.uid] = node
+                self._bound_pods[pod.meta.uid] = pod
                 pod.meta.annotations.update(patch)
                 reserved_bound.append((pod, node))
             pending = remaining_pending
@@ -449,6 +463,7 @@ class BatchScheduler:
         # quota-labeled pod may evict lower-priority same-quota pods, then
         # the batch retries once for the preemptors.
         preempted: List[Pod] = []
+        retry_pods: List[Pod] = []
         if (
             not _retry
             and unsched
@@ -459,7 +474,6 @@ class BatchScheduler:
             from .plugins.elasticquota import ElasticQuotaPreemptor
 
             preemptor = ElasticQuotaPreemptor(self, self.quotas)
-            retry_pods: List[Pod] = []
             for pod in sorted(
                 unsched, key=lambda p: -(p.spec.priority or 0)
             ):
@@ -489,13 +503,48 @@ class BatchScheduler:
                     self.evict_for_preemption(victim)
                     preempted.append(victim)
                 retry_pods.append(pod)
-            if retry_pods:
-                again = self.schedule(retry_pods, _retry=True)
-                bound.extend(again.bound)
-                retried = {p.meta.uid for p in retry_pods}
-                unsched = [
-                    p for p in unsched if p.meta.uid not in retried
-                ] + list(again.unschedulable)
+        # Priority preemption at PostFilter (the reservation plugin's
+        # preemption manager, reference reservation/preemption.go:105-250)
+        # for pods quota preemption could not help; gated by
+        # ReservationArgs.EnablePreemption (default false).
+        if not _retry and unsched and self.enable_priority_preemption:
+            from .plugins.coscheduling import gang_key_of as _gang_of
+            from .plugins.preemption import PriorityPreemptor
+
+            helped = {p.meta.uid for p in retry_pods}
+            pp = PriorityPreemptor(self)
+            for pod in sorted(
+                unsched, key=lambda p: -(p.spec.priority or 0)
+            ):
+                if (
+                    pod.meta.uid in dropped_uids
+                    or pod.meta.uid in helped
+                    or _gang_of(pod) is not None
+                ):
+                    continue
+                if ext.parse_reservation_affinity(pod.meta.annotations):
+                    continue
+                sel = pp.select_victims(pod)
+                if sel is None:
+                    continue
+                _node, victims = sel
+                if self.defer_preemption:
+                    seen = {v.meta.uid for v in preempted}
+                    preempted.extend(
+                        v for v in victims if v.meta.uid not in seen
+                    )
+                    continue
+                for victim in victims:
+                    self.evict_for_preemption(victim)
+                    preempted.append(victim)
+                retry_pods.append(pod)
+        if retry_pods:
+            again = self.schedule(retry_pods, _retry=True)
+            bound.extend(again.bound)
+            retried = {p.meta.uid for p in retry_pods}
+            unsched = [
+                p for p in unsched if p.meta.uid not in retried
+            ] + list(again.unschedulable)
 
         for pod, _node in bound:
             self.pod_groups.remove_pod(pod, bound=True)
@@ -565,6 +614,7 @@ class BatchScheduler:
 
         uid = victim.meta.uid
         node = self._bound_nodes.pop(uid, None)
+        self._bound_pods.pop(uid, None)
         self.snapshot.forget_pod(uid)
         leaf = quota_name_of(victim)
         if leaf is not None:
@@ -933,12 +983,15 @@ class BatchScheduler:
         from .plugins.elasticquota import quota_name_of
 
         bound_nodes = self._bound_nodes
+        bound_pods = self._bound_pods
         if self.quotas.quota_count == 0:
             for pod, node in bound:
                 bound_nodes[pod.meta.uid] = node
+                bound_pods[pod.meta.uid] = pod
         elif rows.quota_chain is None:
             for pod, node in bound:
                 bound_nodes[pod.meta.uid] = node
+                bound_pods[pod.meta.uid] = pod
                 leaf = quota_name_of(pod)
                 if leaf is not None:
                     self.quotas.assign_pod(leaf, pod)
@@ -951,6 +1004,7 @@ class BatchScheduler:
             for pod, node in bound:
                 uid = pod.meta.uid
                 bound_nodes[uid] = node
+                bound_pods[uid] = pod
                 row = uid_to_row.get(uid)
                 if row is None:
                     # not from this chunk's lowering (defensive)

@@ -664,3 +664,100 @@ def test_preemption_minimal_victim_set():
     assert [p.meta.name for p, _ in out.bound] == ["high"]
     # exactly one victim — the lowest-priority pod (low0 @ 5000)
     assert [p.meta.name for p in out.preempted] == ["low0"]
+
+
+# ---- priority preemption (reservation/preemption.go) ----
+
+
+def _prio_cluster(n_nodes=2, cpu=16000):
+    from koordinator_tpu.api.types import Node, NodeStatus
+
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}
+                ),
+            )
+        )
+    return snap
+
+
+def _prio_pod(name, cpu, prio, labels=None):
+    return Pod(
+        meta=ObjectMeta(name=name, labels=labels or {}),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}, priority=prio
+        ),
+    )
+
+
+def test_priority_preemption_evicts_lower_priority():
+    """reservation/preemption.go:132-250 SelectVictimsOnNode: a
+    high-priority pod failing scheduling evicts the minimal set of
+    strictly-lower-priority preemptible pods (remove-all then reprieve
+    most-important-first), then lands on retry."""
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+    snap = _prio_cluster(n_nodes=2, cpu=16000)
+    sched = BatchScheduler(
+        snap, batch_bucket=64, enable_priority_preemption=True
+    )
+    sched.extender.monitor.stop_background()
+    # fill both nodes with low-priority pods
+    fillers = [_prio_pod(f"low-{i}", 8000, 5500) for i in range(4)]
+    out = sched.schedule(fillers)
+    assert len(out.bound) == 4
+    # a high-priority pod arrives with nowhere to fit
+    hi = _prio_pod("hi", 8000, 9500)
+    out2 = sched.schedule([hi])
+    assert [(p.meta.name) for p, _ in out2.bound] == ["hi"]
+    assert len(out2.preempted) == 1          # minimal victim set
+    assert out2.preempted[0].meta.name.startswith("low-")
+
+
+def test_priority_preemption_respects_non_preemptible_and_gate():
+    """Non-preemptible victims (label preemptible=false) are never
+    selected, and the gate defaults OFF (v1beta3/defaults.go:52)."""
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+    # gate off: no preemption even though victims exist
+    snap = _prio_cluster(n_nodes=1, cpu=16000)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    assert len(sched.schedule([_prio_pod("low", 16000, 5500)]).bound) == 1
+    out = sched.schedule([_prio_pod("hi", 8000, 9500)])
+    assert out.bound == [] and out.preempted == []
+
+    # gate on, but the only victim is marked non-preemptible
+    snap2 = _prio_cluster(n_nodes=1, cpu=16000)
+    sched2 = BatchScheduler(
+        snap2, batch_bucket=64, enable_priority_preemption=True
+    )
+    sched2.extender.monitor.stop_background()
+    protected = _prio_pod(
+        "prot", 16000, 5500, labels={ext.LABEL_PREEMPTIBLE: "false"}
+    )
+    assert len(sched2.schedule([protected]).bound) == 1
+    out2 = sched2.schedule([_prio_pod("hi", 8000, 9500)])
+    assert out2.bound == [] and out2.preempted == []
+
+
+def test_priority_preemption_reprieves_most_important():
+    """Reprieve order: with three victims (5500, 5600, 5700) on one node
+    and 8000m needed, the two MOST important victims are reprieved and
+    only the least important is evicted."""
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+    snap = _prio_cluster(n_nodes=1, cpu=24000)
+    sched = BatchScheduler(
+        snap, batch_bucket=64, enable_priority_preemption=True
+    )
+    sched.extender.monitor.stop_background()
+    for name, prio in (("a", 5700), ("b", 5600), ("c", 5500)):
+        assert len(sched.schedule([_prio_pod(name, 8000, prio)]).bound) == 1
+    out = sched.schedule([_prio_pod("hi", 8000, 9500)])
+    assert [(p.meta.name) for p, _ in out.bound] == ["hi"]
+    assert [v.meta.name for v in out.preempted] == ["c"]
