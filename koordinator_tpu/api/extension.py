@@ -67,6 +67,9 @@ ANNOTATION_GANG_NAME = f"gang.scheduling.{DOMAIN}/name"
 ANNOTATION_GANG_MIN_AVAILABLE = f"gang.scheduling.{DOMAIN}/min-available"
 ANNOTATION_GANG_TOTAL_NUM = f"gang.scheduling.{DOMAIN}/total-number"
 ANNOTATION_GANG_WAIT_TIME = f"gang.scheduling.{DOMAIN}/waiting-time"
+#: stamped BY the scheduler on gang members when the gang times out at
+#: Permit (AnnotationGangTimeout, coscheduling.go:48-50)
+ANNOTATION_GANG_TIMEOUT = f"gang.scheduling.{DOMAIN}/timeout"
 
 
 def gang_name_of(pod) -> Optional[str]:

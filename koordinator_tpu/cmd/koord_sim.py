@@ -28,6 +28,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--quiet", action="store_true", help="suppress per-report narration"
     )
+    parser.add_argument(
+        "--chaos-ticks",
+        default="",
+        help="comma-separated tick numbers at which every informer watch "
+        "is severed (apiserver-restart chaos); the loop must re-converge",
+    )
     return parser
 
 
@@ -42,6 +48,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         n_nodes=args.nodes,
         seed=args.seed,
         verbose=not args.quiet,
+        chaos_ticks=tuple(
+            int(x) for x in args.chaos_ticks.split(",") if x.strip()
+        ),
     )
     print(json.dumps(stats))
     return 0 if stats["bound"] > 0 else 1

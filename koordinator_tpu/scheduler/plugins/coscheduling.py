@@ -256,6 +256,10 @@ class PodGroupManager:
             and now - state.create_time > state.schedule_timeout_s
         ):
             state.create_time = now
+            # the scheduler stamps the timeout annotation on the member
+            # (AnnotationGangTimeout, coscheduling.go:48-50) so operators
+            # and controllers can see WHY the gang is cycling
+            pod.meta.annotations[ext.ANNOTATION_GANG_TIMEOUT] = "true"
             return False, f"gang {key} timed out; backing off one cycle"
         total = len(state.pending) + state.bound_credit
         need = state.effective_min(total)
