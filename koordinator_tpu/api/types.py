@@ -171,6 +171,16 @@ class ReservationOwner:
     namespace: Optional[str] = None
 
 
+#: reservation allocate policies (reference
+#: ``apis/scheduling/v1alpha1/reservation_types.go:78-97``): "" (Default,
+#: deprecated — treated as Aligned) / Aligned: the pod allocates from the
+#: reservation FIRST and may spill to node free capacity; Restricted:
+#: resources the reservation declares may ONLY come from the reservation
+#: (undeclared dims still allocate from the node)
+RESERVATION_ALLOCATE_POLICY_ALIGNED = "Aligned"
+RESERVATION_ALLOCATE_POLICY_RESTRICTED = "Restricted"
+
+
 @dataclasses.dataclass
 class Reservation:
     meta: ObjectMeta
@@ -183,6 +193,8 @@ class Reservation:
     allocated: ResourceList = dataclasses.field(default_factory=dict)
     current_owners: List[str] = dataclasses.field(default_factory=list)  # pod uids
     available_time: Optional[float] = None   # when it became Available (TTL base)
+    #: "" | "Aligned" | "Restricted" (reservation_types.go:78-97)
+    allocate_policy: str = ""
 
 
 # --- scheduling.koordinator.sh/Device (device_types.go:104) ---

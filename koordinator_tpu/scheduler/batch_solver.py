@@ -379,6 +379,17 @@ class BatchScheduler:
                 ):
                     retry_queue.append(pod)
                     continue
+                # Aligned-policy spill and undeclared dims allocate from
+                # NODE free capacity (reservation_types.go:86-97) — the
+                # spill re-checks headroom at commit (node state may have
+                # moved since the per-cycle match), via the same helper
+                # the match filter and the allocation charge use
+                _consumed, spill = self.reservations.consumed_and_spill(
+                    r, pod
+                )
+                if not self.reservations.spill_fits_node(r, spill):
+                    retry_queue.append(pod)
+                    continue
                 patch: Dict[str, str] = {}
                 # free the ghost's reserved cpuset/minors first so the
                 # owner can take exactly what was held for it

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 from ...api import extension as ext
 
 from ...api.types import (
+    RESERVATION_ALLOCATE_POLICY_RESTRICTED,
     ObjectMeta,
     Pod,
     PodSpec,
@@ -320,6 +321,55 @@ class ReservationManager:
             k: v - r.allocated.get(k, 0.0) for k, v in r.requests.items()
         }
 
+    def consumed_and_spill(
+        self, r: Reservation, pod: Pod
+    ) -> tuple[Dict[str, float], Dict[str, float]]:
+        """Single source of truth for the allocate-policy arithmetic
+        (reservation_types.go:78-97): per dim, ``consumed`` is what the
+        owner takes FROM the reservation (min(request, remaining) of
+        declared dims) and ``spill`` what must come from node free
+        capacity (the Aligned overflow plus every undeclared dim). Used
+        by candidate matching, the commit headroom check, and the
+        allocation charge — they must never diverge."""
+        remaining = self.remaining(r)
+        consumed: Dict[str, float] = {}
+        spill: Dict[str, float] = {}
+        for k, v in pod.spec.requests.items():
+            credit = (
+                min(v, max(remaining.get(k, 0.0), 0.0))
+                if k in r.requests
+                else 0.0
+            )
+            if credit > 1e-9:
+                consumed[k] = credit
+            if v - credit > 1e-6:
+                spill[k] = v - credit
+        return consumed, spill
+
+    def spill_fits_node(
+        self, r: Reservation, spill: Dict[str, float]
+    ) -> bool:
+        """Whether the reservation's node has free capacity for the
+        owner's spill (beyond every live hold, the ghost included)."""
+        if not spill:
+            return True
+        if r.node_name is None:
+            return False
+        snap = self.scheduler.snapshot
+        idx = snap.node_id(r.node_name)
+        if idx is None:
+            return False
+        import numpy as np
+
+        na = snap.nodes
+        return bool(
+            na.schedulable[idx]
+            and np.all(
+                na.requested[idx] + snap.config.res_vector(spill)
+                <= na.allocatable[idx] + 1e-3
+            )
+        )
+
     def match(self, pod: Pod) -> Optional[Reservation]:
         """Nominate the best matching Available reservation for ``pod``
         (reference nominator, ``nominator.go:207-279`` + ``scoring.go``):
@@ -355,11 +405,19 @@ class ReservationManager:
                         continue
             if not matches_owner(r, pod):
                 continue
-            remaining = self.remaining(r)
-            if not all(
-                pod.spec.requests.get(k, 0.0) <= remaining.get(k, 0.0) + 1e-6
-                for k in pod.spec.requests
-            ):
+            # allocate-policy fit (reference plugin.go:405-415):
+            # Restricted — dims the reservation DECLARES must fit within
+            # its remaining capacity (fitsReservation, i.e. no spill on a
+            # declared dim); Aligned/Default — the pod allocates from the
+            # reservation first and may spill to node free capacity. A
+            # candidate whose spill cannot fit its node is skipped HERE so
+            # a drained-but-preferred reservation can never shadow a
+            # feasible one (reviewer finding r3).
+            consumed, spill = self.consumed_and_spill(r, pod)
+            if r.allocate_policy == RESERVATION_ALLOCATE_POLICY_RESTRICTED:
+                if any(k in r.requests for k in spill):
+                    continue
+            if not self.spill_fits_node(r, spill):
                 continue
             order = _reservation_order(r)
             if order is not None:
@@ -469,6 +527,19 @@ class ReservationManager:
         assert node is not None
         snap = self.scheduler.snapshot
         self.release_ghost_holds(reservation)
+        # The owner consumes min(request, remaining) of each dim the
+        # reservation DECLARES (Aligned/Restricted alike — the Aligned
+        # spill beyond remaining, and any undeclared dim, is the pod's
+        # own node charge, headroom-checked by the commit path).
+        consumed, _spill = self.consumed_and_spill(reservation, pod)
+        for k, take in consumed.items():
+            reservation.allocated[k] = reservation.allocated.get(k, 0.0) + take
+        reservation.current_owners.append(pod.meta.uid)
+        # the ledger records what was taken FROM the reservation — the
+        # drift refund restores exactly this much
+        self._owner_requests.setdefault(reservation.meta.name, {})[
+            pod.meta.uid
+        ] = consumed
         op = self._operating.get(reservation.meta.name)
         if op is not None and snap.is_assumed(op.meta.uid):
             # The RUNNING placeholder's physical footprint does not shrink
@@ -476,12 +547,12 @@ class ReservationManager:
             # the reference keeps the reserve pod charged and discounts the
             # owner inside the reservation. Keep the node charged
             # max(placeholder, owner): swap the pod's full assume for the
-            # remainder the owner does not cover; that remainder frees only
+            # remainder the owners do not cover; that remainder frees only
             # when the placeholder pod itself is forgotten/deleted.
             remainder = {
-                k: v - pod.spec.requests.get(k, 0.0)
+                k: v - reservation.allocated.get(k, 0.0)
                 for k, v in reservation.requests.items()
-                if v - pod.spec.requests.get(k, 0.0) > 1e-6
+                if v - reservation.allocated.get(k, 0.0) > 1e-6
             }
             snap.forget_pod(op.meta.uid)
             if remainder:
@@ -489,12 +560,6 @@ class ReservationManager:
                 snap.assume_pod(op, node, vec, confirmed=True, request=vec)
         else:
             snap.forget_pod(self._hold_uid(reservation))
-        for k, v in pod.spec.requests.items():
-            reservation.allocated[k] = reservation.allocated.get(k, 0.0) + v
-        reservation.current_owners.append(pod.meta.uid)
-        self._owner_requests.setdefault(reservation.meta.name, {})[
-            pod.meta.uid
-        ] = dict(pod.spec.requests)
         if op is not None:
             # record the allocation on the operating pod
             # (AnnotationReservationCurrentOwner, operating_pod.go:36)

@@ -1013,3 +1013,168 @@ def test_operating_pod_owner_dies_first_reexpands_charge():
     # idempotent / no resurrection at the next sweep
     rm.sync()
     assert snap.nodes.requested[idx, 0] == 0.0
+
+
+def test_reservation_aligned_policy_spills_to_node():
+    """reservation_types.go:86-90 Aligned: the owner allocates from the
+    reservation FIRST and spills the rest to node free capacity. A
+    6000m owner on a 4000m reservation consumes the full 4000m credit
+    and charges only the 2000m spill beyond the ghost swap."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0", cpu=16000, mem=16000))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="r-al"),
+            requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4000},
+            owners=[ReservationOwner(label_selector={"app": "al"})],
+            allocate_once=False,
+            allocate_policy="Aligned",
+        )
+    )
+    assert rm.schedule_pending() == 1
+    idx = snap.node_id("n0")
+    assert snap.nodes.requested[idx, 0] == 4000.0
+    owner = bound_pod("al-0", None, cpu=6000, prio=9500, labels={"app": "al"})
+    owner.spec.node_name = None
+    out = sched.schedule([owner])
+    assert [(p.meta.name, n) for p, n in out.bound] == [("al-0", "n0")]
+    r = rm.get("r-al")
+    # the reservation credit is fully consumed; the ledger records what
+    # came FROM the reservation (4000), not the pod's full request
+    assert r.allocated[ext.RES_CPU] == 4000.0
+    assert rm.owner_ledger("r-al")[owner.meta.uid][ext.RES_CPU] == 4000.0
+    # node charge: owner 6000 (no remainder ghost left on the cpu dim)
+    assert snap.nodes.requested[idx, 0] == 6000.0
+
+
+def test_reservation_aligned_spill_needs_node_headroom():
+    """An Aligned owner whose spill exceeds node free capacity must NOT
+    commit through the reservation fast path (it falls through to the
+    solver and stays unschedulable on a full node)."""
+    from koordinator_tpu.scheduler.batch_solver import LoadAwareArgs
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0", cpu=8000, mem=8000))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(usage_thresholds={}), batch_bucket=64
+    )
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="r-full"),
+            requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4000},
+            owners=[ReservationOwner(label_selector={"app": "al"})],
+            allocate_policy="Aligned",
+        )
+    )
+    assert rm.schedule_pending() == 1
+    # fill the rest of the node so the spill cannot fit
+    filler = bound_pod("filler", None, cpu=4000, prio=9000)
+    filler.spec.node_name = None
+    assert len(sched.schedule([filler]).bound) == 1
+    owner = bound_pod("al-1", None, cpu=6000, prio=9500, labels={"app": "al"})
+    owner.spec.node_name = None
+    out = sched.schedule([owner])
+    assert out.bound == []          # spill 2000 > 0 free: rejected
+    assert rm.get("r-full").phase == ReservationPhase.AVAILABLE
+
+
+def test_reservation_restricted_policy_requires_reservation_capacity():
+    """reservation_types.go:91-97 Restricted: dims the reservation
+    declares may ONLY come from the reservation — an owner exceeding the
+    declared remaining does not match; undeclared dims still allocate
+    from the node."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0", cpu=32000, mem=32000))
+    set_util(snap, "n0", 10)
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="r-res"),
+            requests={ext.RES_CPU: 4000},    # memory NOT declared
+            owners=[ReservationOwner(label_selector={"app": "rs"})],
+            allocate_once=False,
+            allocate_policy="Restricted",
+        )
+    )
+    assert rm.schedule_pending() == 1
+    # over-declared-dim owner: no match (binds via the solver instead,
+    # consuming nothing from the reservation)
+    big = bound_pod("rs-big", None, cpu=6000, prio=9500, labels={"app": "rs"})
+    big.spec.node_name = None
+    assert rm.match(big) is None
+    # fitting owner with an UNDECLARED memory dim: matches; memory comes
+    # from the node
+    ok = Pod(
+        meta=ObjectMeta(name="rs-ok", labels={"app": "rs"}),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 3000, ext.RES_MEMORY: 2048},
+            priority=9500,
+        ),
+    )
+    assert rm.match(ok) is not None
+    out = sched.schedule([ok])
+    assert [(p.meta.name, n) for p, n in out.bound] == [("rs-ok", "n0")]
+    assert rm.get("r-res").allocated[ext.RES_CPU] == 3000.0
+    assert ext.RES_MEMORY not in rm.get("r-res").allocated
+
+
+def test_drained_preferred_reservation_does_not_shadow_feasible_one():
+    """Reviewer r3 regression: an Aligned reservation whose spill cannot
+    fit its node must be SKIPPED at match time so a lower-preference but
+    feasible reservation (holding exactly the reserved capacity) wins."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(mknode("n0", cpu=8000, mem=8000))
+    snap.upsert_node(mknode("n1", cpu=8000, mem=8000))
+    set_util(snap, "n0", 10)
+    set_util(snap, "n1", 10)
+    from koordinator_tpu.scheduler.batch_solver import LoadAwareArgs
+
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(usage_thresholds={}), batch_bucket=64
+    )
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    # preferred (ordered) reservation: fully drained AND its node full
+    pref = Reservation(
+        meta=ObjectMeta(
+            name="pref",
+            labels={ext.LABEL_RESERVATION_ORDER: "1"},
+        ),
+        requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8000},
+        owners=[ReservationOwner(label_selector={"app": "x"})],
+        allocate_once=False,
+        allocate_policy="Aligned",
+    )
+    pref.phase = ReservationPhase.AVAILABLE
+    pref.node_name = "n0"
+    pref.allocated = {ext.RES_CPU: 8000, ext.RES_MEMORY: 8000}
+    # charge n0 full so any spill is infeasible there
+    blocker = bound_pod("blk", "n0", cpu=8000)
+    snap.assume_pod(blocker, "n0")
+    rm.add(pref)
+    # feasible unordered reservation with remaining capacity on n1
+    rm.add(
+        Reservation(
+            meta=ObjectMeta(name="feas"),
+            requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4000},
+            owners=[ReservationOwner(label_selector={"app": "x"})],
+            allocate_once=False,
+            allocate_policy="Aligned",
+        )
+    )
+    assert rm.schedule_pending() == 1
+    pod = bound_pod("x-0", None, cpu=4000, prio=9500, labels={"app": "x"})
+    pod.spec.node_name = None
+    got = rm.match(pod)
+    assert got is not None and got.meta.name == "feas"
+    out = sched.schedule([pod])
+    assert [(p.meta.name, n) for p, n in out.bound] == [("x-0", "n1")]
