@@ -46,6 +46,51 @@ ANNOTATION_RESERVATION_AFFINITY = f"scheduling.{DOMAIN}/reservation-affinity"
 #: smaller non-zero order wins nomination outright (reference
 #: ``apis/extension/reservation.go:43-46`` LabelReservationOrder)
 LABEL_RESERVATION_ORDER = f"scheduling.{DOMAIN}/reservation-order"
+#: "true" = the pod schedules IGNORING reservations entirely (reference
+#: ``reservation.go:31-36`` LabelReservationIgnored)
+LABEL_RESERVATION_IGNORED = f"scheduling.{DOMAIN}/reservation-ignored"
+#: stamped on an owner pod recording WHICH reservation it allocated from
+#: (``reservation.go:48-49`` AnnotationReservationAllocated, written at
+#: PreBind by SetReservationAllocated)
+ANNOTATION_RESERVATION_ALLOCATED = f"scheduling.{DOMAIN}/reservation-allocated"
+
+
+def is_reservation_ignored(pod) -> bool:
+    """reference ``reservation.go:97-99`` IsReservationIgnored."""
+    return pod.meta.labels.get(LABEL_RESERVATION_IGNORED) == "true"
+
+
+#: per-pod estimator scaling-factor override in percent per resource name
+#: (reference ``apis/extension/load_aware.go:31-32``
+#: AnnotationCustomEstimatedScalingFactors, e.g. '{"cpu": 100}')
+ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS = (
+    f"scheduling.{DOMAIN}/load-estimated-scaling-factors"
+)
+
+
+def parse_custom_estimated_scaling_factors(
+    annotations: Mapping[str, str],
+) -> Optional[Mapping[str, float]]:
+    """{resource: percent} from the pod annotation, or None
+    (``load_aware.go:74-82`` GetCustomEstimatedScalingFactors)."""
+    raw = annotations.get(ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS)
+    if not raw:
+        return None
+    import json
+
+    try:
+        payload = json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    out = {}
+    for k, v in payload.items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out or None
 ANNOTATION_GANG_GROUPS = f"gang.scheduling.{DOMAIN}/groups"
 #: which member states count toward gang satisfaction (reference
 #: ``apis/extension/coscheduling.go:55-64``); default once-satisfied

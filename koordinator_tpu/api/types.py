@@ -309,6 +309,11 @@ class ElasticQuota:
     total_resource: ResourceList = dataclasses.field(default_factory=dict)
     #: when true, a tree root's capacity is NOT deducted from the default tree
     ignore_default_tree: bool = False
+    #: when False, the quota's unused min is NEVER lent to siblings — the
+    #: full min stays reserved regardless of demand (reference label
+    #: ``quota.scheduling.koordinator.sh/allow-lent-resource``, quotaNode
+    #: AllowLentResource; default true)
+    allow_lent_resource: bool = True
 
 
 # --- scheduling.koordinator.sh/PodMigrationJob (pod_migration_job_types.go:27-40) ---

@@ -59,7 +59,17 @@ def estimate_pod(config, pod, scale: np.ndarray) -> np.ndarray:
     ``default_estimator.go:88-123``): base = max(request, limit), scaled
     and rounded, capped at the limit; a dim with neither request nor limit
     estimates at the default floor (250m cpu / 200Mi memory) — an
-    unspecified pod is never free. [D] numpy."""
+    unspecified pod is never free. A pod may override individual scaling
+    factors via the load-estimated-scaling-factors annotation, in percent
+    (``default_estimator.go:60-64``). [D] numpy."""
+    custom = ext.parse_custom_estimated_scaling_factors(
+        pod.meta.annotations
+    )
+    if custom:
+        scale = np.array(scale, np.float32, copy=True)
+        for name, pct in custom.items():
+            if name in config.resources:
+                scale[config.resources.index(name)] = pct / 100.0
     req = config.res_vector(pod.spec.requests)
     lim = config.res_vector(pod.spec.limits)
     base = np.maximum(req, lim)

@@ -254,7 +254,12 @@ class BatchScheduler:
         ) * arrays.valid[:, None]
         est = np.where(arrays.requests > 0, est, floors).astype(np.float32)
         for i, pod in enumerate(pods):
-            if pod.spec.estimated or pod.spec.limits:
+            if (
+                pod.spec.estimated
+                or pod.spec.limits
+                or ext.ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS
+                in pod.meta.annotations
+            ):
                 est[i] = self._estimate_of(pod)
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
         chains = self.quotas.chains_for_pods(list(pods), b)

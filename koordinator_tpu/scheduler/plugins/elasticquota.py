@@ -422,7 +422,14 @@ class GroupQuotaManager:
             )
         requests = self.requests[idxs]
         guaranteed = np.minimum(mins, requests)
-        caps = np.minimum(maxs, requests)
+        # allow-lent-resource=false: the quota's UNUSED min is never lent
+        # to siblings — the full min stays reserved regardless of demand
+        # (reference quotaNode.AllowLentResource in the redistribution)
+        lent_ok = np.asarray(
+            [self._nodes[n].quota.allow_lent_resource for n in names], bool
+        )
+        guaranteed = np.where(lent_ok[:, None], guaranteed, mins)
+        caps = np.maximum(np.minimum(maxs, requests), guaranteed)
         shares = water_fill(total, guaranteed, caps, weights)
         for row, n in enumerate(names):
             runtime[self._nodes[n].index] = shares[row]

@@ -382,7 +382,10 @@ class ReservationManager:
         allocatable`` (``scoreReservation``), i.e. the tightest fit, so
         small pods drain small reservations before fragmenting big ones.
         A pod carrying the reservation-affinity annotation additionally
-        restricts the candidate set by name or reservation labels."""
+        restricts the candidate set by name or reservation labels; a pod
+        labeled reservation-ignored never matches (reservation.go:97-99)."""
+        if ext.is_reservation_ignored(pod):
+            return None
         affinity = ext.parse_reservation_affinity(pod.meta.annotations)
         best: Optional[Reservation] = None
         best_score = -1.0
@@ -535,6 +538,11 @@ class ReservationManager:
         for k, take in consumed.items():
             reservation.allocated[k] = reservation.allocated.get(k, 0.0) + take
         reservation.current_owners.append(pod.meta.uid)
+        # stamp WHICH reservation the pod allocated from (reference
+        # SetReservationAllocated at PreBind, reservation.go:121-128)
+        pod.meta.annotations[ext.ANNOTATION_RESERVATION_ALLOCATED] = (
+            '{"name": "%s"}' % reservation.meta.name
+        )
         # the ledger records what was taken FROM the reservation — the
         # drift refund restores exactly this much
         self._owner_requests.setdefault(reservation.meta.name, {})[

@@ -761,3 +761,52 @@ def test_priority_preemption_reprieves_most_important():
     out = sched.schedule([_prio_pod("hi", 8000, 9500)])
     assert [(p.meta.name) for p, _ in out.bound] == ["hi"]
     assert [v.meta.name for v in out.preempted] == ["c"]
+
+
+def test_allow_lent_resource_false_reserves_full_min():
+    """quota.scheduling.koordinator.sh/allow-lent-resource=false: the
+    quota's unused min is NEVER redistributed to siblings (reference
+    quotaNode.AllowLentResource in the redistribution)."""
+    from koordinator_tpu.core.snapshot import SnapshotConfig
+
+    def build(lent: bool):
+        gqm = GroupQuotaManager(
+            SnapshotConfig(), cluster_total={ext.RES_CPU: 100}
+        )
+        gqm.upsert_quota(
+            ElasticQuota(
+                meta=ObjectMeta(name="hoarder"),
+                min={ext.RES_CPU: 60},
+                max={ext.RES_CPU: 100},
+                allow_lent_resource=lent,
+            )
+        )
+        gqm.upsert_quota(
+            ElasticQuota(
+                meta=ObjectMeta(name="hungry"),
+                min={ext.RES_CPU: 10},
+                max={ext.RES_CPU: 100},
+            )
+        )
+        # hoarder demands almost nothing; hungry wants everything
+        gqm.set_leaf_requests(
+            {
+                "hoarder": gqm.config.res_vector({ext.RES_CPU: 5}),
+                "hungry": gqm.config.res_vector({ext.RES_CPU: 100}),
+            }
+        )
+        gqm.refresh_runtime()
+        cpu = gqm.config.resources.index(ext.RES_CPU)
+        rt = {
+            n: float(gqm.runtime_and_used_of(n)[0][cpu])
+            for n in ("hoarder", "hungry")
+        }
+        return rt
+
+    lending = build(lent=True)
+    hoarding = build(lent=False)
+    # with lending, hungry gets ~95 (hoarder keeps only its demand)
+    assert lending["hungry"] >= 90.0
+    # with lending disabled, hoarder's full 60 min stays reserved
+    assert hoarding["hoarder"] >= 60.0
+    assert hoarding["hungry"] <= 40.0

@@ -52,3 +52,45 @@ def test_parse_gpu_partition_spec_malformed_payloads():
     assert ext.parse_gpu_partition_spec(
         {key: '{"allocatePolicy": "Restricted", "ringBusBandwidth": 200}'}
     ) == (True, 200.0)
+
+
+def test_reservation_ignored_and_allocated_annotations():
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+
+    p = Pod(meta=ObjectMeta(name="x"), spec=PodSpec())
+    assert not ext.is_reservation_ignored(p)
+    p.meta.labels[ext.LABEL_RESERVATION_IGNORED] = "true"
+    assert ext.is_reservation_ignored(p)
+
+
+def test_custom_estimated_scaling_factors():
+    import numpy as np
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import SnapshotConfig
+    from koordinator_tpu.ops.estimator import estimate_pod, scale_vector
+
+    cfg = SnapshotConfig()
+    scale = scale_vector(cfg.resources, {})
+    pod = Pod(
+        meta=ObjectMeta(
+            name="p",
+            annotations={
+                ext.ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS: (
+                    '{"%s": 100}' % ext.RES_CPU
+                )
+            },
+        ),
+        spec=PodSpec(requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 1024}),
+    )
+    est = estimate_pod(cfg, pod, scale)
+    cpu_dim = cfg.resources.index(ext.RES_CPU)
+    mem_dim = cfg.resources.index(ext.RES_MEMORY)
+    assert est[cpu_dim] == 4000.0          # 100% override, not the 85% default
+    assert est[mem_dim] == round(1024 * 0.7)  # memory keeps the default factor
+    # unparseable annotation falls back to defaults
+    pod.meta.annotations[ext.ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS] = "bogus"
+    est2 = estimate_pod(cfg, pod, scale)
+    assert est2[cpu_dim] == round(4000 * 0.85)
