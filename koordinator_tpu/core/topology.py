@@ -436,6 +436,20 @@ class CPUAccumulator:
         self._owners.setdefault(owner, set()).update(result)
         return result
 
+    def take_reserved(self, owner: str, cpu_ids: Set[int]) -> None:
+        """Pre-allocate an exact cpu-id set (kubelet-reserved CPUs from
+        the NodeResourceTopology report): unconditional — reserved CPUs
+        are facts, not requests. Invalidates the fast-path heaps."""
+        cpus = {int(c) for c in cpu_ids if int(c) in self._pos}
+        if not cpus:
+            return
+        self._free_mask()  # flush deferred clears first
+        self._allocated |= cpus
+        self._free[[self._pos[c] for c in cpus]] = False
+        self._free_alloc_count = len(self._allocated)
+        self._heaps = None
+        self._owners.setdefault(owner, set()).update(cpus)
+
     def release(self, owner: str) -> None:
         cpus = self._owners.pop(owner, set())
         if cpus:

@@ -53,6 +53,8 @@ class ClusterStateHub:
         self.quotas = ObjectTracker()
         self.reservations = ObjectTracker()
         self.pod_groups = ObjectTracker()
+        #: NodeResourceTopology reports (the koordlet's CR writes)
+        self.topologies = ObjectTracker()
         self.resync_interval_s = resync_interval_s
         self.informers: List[Informer] = []
         self._trackers = (
@@ -63,6 +65,7 @@ class ClusterStateHub:
             self.quotas,
             self.reservations,
             self.pod_groups,
+            self.topologies,
         )
 
     # ---- publish side (what the control plane / sim writes) ----
@@ -198,6 +201,22 @@ class ClusterStateHub:
                 ),
             )
             extras.append(dev_inf)
+
+        if sched.numa is not None:
+            topo_inf = Informer(self.topologies, self.resync_interval_s)
+            topo_inf.add_handlers(
+                on_add=_locked(
+                    lock, lambda k, t: sched.numa.register_from_topology(t)
+                ),
+                on_update=_locked(
+                    lock, lambda k, t: sched.numa.register_from_topology(t)
+                ),
+                on_delete=_locked(
+                    lock,
+                    lambda k, t: sched.numa.unregister_node(t.meta.name),
+                ),
+            )
+            extras.append(topo_inf)
 
         if sched.quotas is not None:
             quota_inf = Informer(self.quotas, self.resync_interval_s)

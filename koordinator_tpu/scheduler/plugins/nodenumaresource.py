@@ -132,6 +132,62 @@ class NUMAManager:
         )
         self._policy_cache = None
 
+    #: NodeResourceTopology.topologyPolicy string → solver policy
+    _POLICY_BY_NAME = {
+        "None": NUMAPolicy.NONE,
+        "BestEffort": NUMAPolicy.BEST_EFFORT,
+        "Restricted": NUMAPolicy.RESTRICTED,
+        "SingleNUMANode": NUMAPolicy.SINGLE_NUMA_NODE,
+    }
+
+    def register_from_topology(self, report) -> None:
+        """Ingest a NodeResourceTopology report (the koordlet's CR write,
+        ``states_noderesourcetopology.go``) — the reference's
+        NodeNUMAResource plugin consumes exactly this CRD via informer.
+        Rebuilds the node's zone tables and cpuset accumulator, and
+        pre-takes the kubelet-reserved CPUs so the scheduler can never
+        hand them out."""
+        from ...api.types import NodeResourceTopology  # noqa: F401 (doc)
+        from ...core.topology import CPUInfo
+
+        if not report.cpu_topology:
+            return
+        cpus = [
+            CPUInfo(cpu_id=cid, core_id=core, numa_node=numa, socket=sock)
+            for cid, (core, numa, sock) in sorted(
+                report.cpu_topology.items()
+            )
+        ]
+        topo = CPUTopology(cpus=cpus)
+        policy = self._POLICY_BY_NAME.get(
+            report.topology_policy, NUMAPolicy.NONE
+        )
+        mem_per_zone = 0.0
+        for zone in report.zones:
+            mem = float(zone.allocatable.get(ext.RES_MEMORY, 0.0))
+            mem_per_zone = max(mem_per_zone, mem)
+        self.register_node(
+            report.meta.name,
+            topo,
+            policy,
+            memory_per_zone_mib=mem_per_zone,
+        )
+        reserved = set(int(c) for c in report.kubelet_reserved_cpus)
+        if reserved:
+            st = self._nodes[report.meta.name]
+            st.accumulator.take_reserved("kubelet-reserved", reserved)
+            # zone feasibility must see the reserved cores as used too
+            zone_of = {c.cpu_id: c.numa_node for c in cpus}
+            for cid in reserved:
+                zone = zone_of.get(cid)
+                if zone is not None and zone < self.max_zones:
+                    st.zone_used[zone][0] += 1000.0 * st.cpu_amp
+
+    def unregister_node(self, node_name: str) -> None:
+        """Drop a node's topology (NodeResourceTopology deleted)."""
+        self._nodes.pop(node_name, None)
+        self._policy_cache = None
+
     def _sync_amp(self, node_name: str, st: _NodeNUMA) -> None:
         """Re-base zone capacities and bound charges onto the snapshot's
         *live* amplification ratio. register_node may have run before the

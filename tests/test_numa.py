@@ -422,3 +422,88 @@ def test_most_allocated_scoring_packs_fuller_zone():
 
 def test_least_allocated_scoring_spreads():
     assert _scoring_cluster("LeastAllocated") == "n1"
+
+
+def test_topology_report_flows_to_scheduler_numa_manager():
+    """The koordlet's NodeResourceTopology report reaches the scheduler's
+    NUMAManager through the informer hub (the reference NodeNUMAResource
+    plugin consumes the CRD the same way): policy, zones, and
+    kubelet-reserved CPUs all take effect."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.core.topology import CPUTopology
+    from koordinator_tpu.koordlet.statesinformer import StatesInformer
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+    from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+        NUMAManager,
+        NUMAPolicy,
+    )
+
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    sched = BatchScheduler(snap, batch_bucket=64, numa=numa)
+    sched.extender.monitor.stop_background()
+    hub = ClusterStateHub()
+    hub.wire_scheduler(sched)
+    hub.start()
+    try:
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name="n0"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 16000, ext.RES_MEMORY: 65536}
+                ),
+            ),
+        )
+        # the koordlet builds the report; the hub carries it over
+        si = StatesInformer(node_name="n0")
+        topo = CPUTopology.uniform(
+            sockets=2, numa_per_socket=1, cores_per_numa=4
+        )
+        report = si.report_topology(
+            topo,
+            kubelet_reserved=[0, 1],
+            policy="SingleNUMANode",
+            mem_per_numa_bytes=32768,
+        )
+        hub.publish(hub.topologies, report)
+        assert hub.wait_synced()
+        st = numa.node("n0")
+        assert st is not None
+        assert st.policy == NUMAPolicy.SINGLE_NUMA_NODE
+        # kubelet-reserved CPUs are pre-taken and zone-charged
+        assert st.accumulator.cpuset_of("kubelet-reserved") == {0, 1}
+        assert st.zone_used[0][0] == 2000.0
+        # an LSR pod scheduled through the hub-wired manager never gets
+        # the reserved CPUs in its exclusive cpuset
+        pod = Pod(
+            meta=ObjectMeta(
+                name="lsr", labels={ext.LABEL_POD_QOS: "LSR"}
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096},
+                priority=9500,
+            ),
+        )
+        out = sched.schedule([pod])
+        assert len(out.bound) == 1
+        from koordinator_tpu.core.topology import parse_cpuset
+        import json as _json
+
+        status = _json.loads(
+            out.bound[0][0].meta.annotations[ext.ANNOTATION_RESOURCE_STATUS]
+        )
+        cpus = parse_cpuset(status["cpuset"])
+        assert cpus.isdisjoint({0, 1})
+        # topology delete unregisters the node
+        hub.delete(hub.topologies, report)
+        assert hub.wait_synced()
+        assert numa.node("n0") is None
+    finally:
+        hub.stop()
