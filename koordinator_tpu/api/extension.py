@@ -60,6 +60,57 @@ def is_reservation_ignored(pod) -> bool:
     return pod.meta.labels.get(LABEL_RESERVATION_IGNORED) == "true"
 
 
+#: pod-side spec restricting nomination to reservations whose allocatable
+#: EXACTLY equals the pod's request on the listed resource names
+#: (reference ``reservation.go:188-241`` AnnotationExactMatchReservationSpec)
+ANNOTATION_EXACT_MATCH_RESERVATION_SPEC = (
+    f"scheduling.{DOMAIN}/exact-match-reservation"
+)
+
+
+def parse_exact_match_reservation_spec(
+    annotations: Mapping[str, str],
+) -> Optional[list]:
+    """The spec's resourceNames list, or None when absent/illegal
+    (GetExactMatchReservationSpec)."""
+    raw = annotations.get(ANNOTATION_EXACT_MATCH_RESERVATION_SPEC)
+    if not raw:
+        return None
+    import json
+
+    try:
+        payload = json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    names = payload.get("resourceNames")
+    if not isinstance(names, list):
+        return None
+    return [str(n) for n in names]
+
+
+def exact_match_reservation(
+    pod_requests: Mapping[str, float],
+    reservation_allocatable: Mapping[str, float],
+    names: Optional[list],
+) -> bool:
+    """Reference ``ExactMatchReservation`` (reservation.go:222-241),
+    including its quirk: a listed name absent on BOTH sides returns
+    matched for the WHOLE spec immediately; absent on one side only is
+    a mismatch; present on both must compare exactly equal."""
+    if not names:
+        return True
+    for name in names:
+        in_r = name in reservation_allocatable
+        in_p = name in pod_requests
+        if not in_r or not in_p:
+            return (not in_r) and (not in_p)
+        if float(reservation_allocatable[name]) != float(pod_requests[name]):
+            return False
+    return True
+
+
 #: per-pod estimator scaling-factor override in percent per resource name
 #: (reference ``apis/extension/load_aware.go:31-32``
 #: AnnotationCustomEstimatedScalingFactors, e.g. '{"cpu": 100}')

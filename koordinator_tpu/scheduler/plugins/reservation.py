@@ -387,6 +387,9 @@ class ReservationManager:
         if ext.is_reservation_ignored(pod):
             return None
         affinity = ext.parse_reservation_affinity(pod.meta.annotations)
+        exact_names = ext.parse_exact_match_reservation_spec(
+            pod.meta.annotations
+        )
         best: Optional[Reservation] = None
         best_score = -1.0
         best_order: Optional[int] = None
@@ -407,6 +410,13 @@ class ReservationManager:
                     ):
                         continue
             if not matches_owner(r, pod):
+                continue
+            # exact-match spec: the listed resource names must compare
+            # exactly equal between the pod's requests and the
+            # reservation's allocatable (transformer.go:122,138)
+            if exact_names is not None and not ext.exact_match_reservation(
+                pod.spec.requests, r.requests, exact_names
+            ):
                 continue
             # allocate-policy fit (reference plugin.go:405-415):
             # Restricted — dims the reservation DECLARES must fit within

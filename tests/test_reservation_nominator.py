@@ -175,3 +175,78 @@ def test_nomination_commits_through_fast_path():
     out = rm.scheduler.schedule([owner_pod()])
     assert len(out.bound) == 1
     assert small.current_owners and not rm.get("big").current_owners
+
+
+def test_exact_match_reservation_spec():
+    """reservation.go:188-241: the exact-match annotation restricts
+    nomination to reservations whose allocatable EXACTLY equals the
+    pod's request on the listed names — including the reference's
+    both-absent early-return quirk."""
+    from koordinator_tpu.api import extension as ext
+
+    em = ext.exact_match_reservation
+    assert em({"cpu": 4.0}, {"cpu": 4.0}, ["cpu"])
+    assert not em({"cpu": 4.0}, {"cpu": 8.0}, ["cpu"])
+    assert not em({"cpu": 4.0}, {}, ["cpu"])       # one side only
+    assert not em({}, {"cpu": 4.0}, ["cpu"])
+    assert em({}, {}, ["cpu"])                     # absent on BOTH: matched
+    assert em({"cpu": 4.0}, {"cpu": 8.0}, [])      # empty spec: no-op
+    # the quirk: the FIRST both-absent name short-circuits the whole spec
+    assert em({"cpu": 4.0}, {"cpu": 8.0}, ["gpu", "cpu"])
+
+    # end to end through match(): only the exactly-sized reservation wins
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        Reservation,
+        ReservationOwner,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 65536}
+            ),
+        )
+    )
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    for name, cpu in (("small", 4000), ("exact", 8000)):
+        rm.add(
+            Reservation(
+                meta=ObjectMeta(name=name),
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 8192},
+                owners=[ReservationOwner(label_selector={"app": "em"})],
+                allocate_once=False,
+            )
+        )
+    assert rm.schedule_pending() == 2
+    pod = Pod(
+        meta=ObjectMeta(
+            name="p",
+            labels={"app": "em"},
+            annotations={
+                ext.ANNOTATION_EXACT_MATCH_RESERVATION_SPEC: (
+                    '{"resourceNames": ["%s"]}' % ext.RES_CPU
+                )
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 2048},
+            priority=9500,
+        ),
+    )
+    got = rm.match(pod)
+    assert got is not None and got.meta.name == "exact"
