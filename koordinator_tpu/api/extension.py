@@ -224,6 +224,33 @@ ANNOTATION_GPU_PARTITION_SPEC = f"scheduling.{DOMAIN}/gpu-partition-spec"
 #: joint multi-device allocation directive (reference
 #: ``apis/extension/device_share.go:35-36`` AnnotationDeviceJointAllocate)
 ANNOTATION_DEVICE_JOINT_ALLOCATE = f"scheduling.{DOMAIN}/device-joint-allocate"
+#: per-device-type allocation hints (``device_share.go:147-190``
+#: DeviceAllocateHints): {"rdma": {"allocateStrategy": "ApplyForAll"|
+#: "RequestsAsCount", "requiredTopologyScope": "PCIe"|"NUMANode"}}
+ANNOTATION_DEVICE_ALLOCATE_HINT = f"scheduling.{DOMAIN}/device-allocate-hint"
+DEVICE_ALLOCATE_STRATEGY_APPLY_FOR_ALL = "ApplyForAll"
+DEVICE_ALLOCATE_STRATEGY_REQUESTS_AS_COUNT = "RequestsAsCount"
+
+
+def parse_device_allocate_hints(
+    annotations: Mapping[str, str],
+) -> Mapping[str, Mapping[str, str]]:
+    """{deviceType: hint dict} from the device-allocate-hint annotation;
+    empty on absent/illegal (GetDeviceAllocateHints)."""
+    raw = annotations.get(ANNOTATION_DEVICE_ALLOCATE_HINT)
+    if not raw:
+        return {}
+    import json
+
+    try:
+        payload = json.loads(raw)
+    except (ValueError, TypeError):
+        return {}
+    if not isinstance(payload, dict):
+        return {}
+    return {
+        str(k): v for k, v in payload.items() if isinstance(v, dict)
+    }
 #: node-side partition table annotation (AnnotationGPUPartitions)
 ANNOTATION_GPU_PARTITIONS = f"scheduling.{DOMAIN}/gpu-partitions"
 #: node label choosing Honor/Prefer (LabelGPUPartitionPolicy)

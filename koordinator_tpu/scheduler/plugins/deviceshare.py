@@ -49,6 +49,8 @@ class _NodeDevices:
     fpga_free: List[float] = dataclasses.field(default_factory=list)
     #: PCIe root per RDMA minor ("" unknown)
     rdma_pcie: List[str] = dataclasses.field(default_factory=list)
+    #: NUMA node per RDMA minor (-1 unknown; topology-scope hints)
+    rdma_numa: List[int] = dataclasses.field(default_factory=list)
     #: free SR-IOV virtual-function bus IDs per RDMA minor (empty list =
     #: the NIC exposes no VFs and is allocated whole)
     rdma_vfs: List[List[str]] = dataclasses.field(default_factory=list)
@@ -163,6 +165,7 @@ class DeviceManager:
             ],
             rdma_free=[FULL] * len(rdma),
             rdma_pcie=[d.pcie_bus for d in rdma],
+            rdma_numa=[d.numa_node for d in rdma],
             rdma_vfs=[list(d.vfs) for d in rdma],
             rdma_vf_all=[list(d.vfs) for d in rdma],
             fpga_free=[FULL] * len(fpga),
@@ -420,6 +423,25 @@ class DeviceManager:
             picks.append((minor, need, core))
             free[minor] -= need
             core_free[minor] -= core
+        # per-type allocation hints (device_share.go:147-190): the RDMA
+        # strategy may rewrite the count, and a required topology scope
+        # constrains which NICs may be grouped
+        hints = ext.parse_device_allocate_hints(annotations)
+        rdma_hint = hints.get("rdma", {})
+        strategy = rdma_hint.get("allocateStrategy", "")
+        if (
+            strategy == ext.DEVICE_ALLOCATE_STRATEGY_REQUESTS_AS_COUNT
+            and requests is not None
+        ):
+            # the raw request value IS the device count (not 100-units)
+            try:
+                rdma_count = int(float(requests.get(ext.RES_RDMA, 0.0)))
+            except (TypeError, ValueError):
+                pass
+        elif strategy == ext.DEVICE_ALLOCATE_STRATEGY_APPLY_FOR_ALL:
+            # one allocation on EVERY rdma device of the node (the
+            # machine-wide NIC pattern for distributed training pods)
+            rdma_count = max(rdma_count, len(st.rdma_free))
         rdma_picks: List[Tuple[int, float, Optional[str]]] = []
         if rdma_count > 0:
             gpu_pcies = {
@@ -430,6 +452,7 @@ class DeviceManager:
                 rdma_count,
                 ext.parse_device_joint_allocate(annotations),
                 gpu_pcies,
+                topology_scope=rdma_hint.get("requiredTopologyScope", ""),
             )
             if chosen_rdma is None:
                 return None
@@ -527,13 +550,16 @@ class DeviceManager:
         count: int,
         joint: "Optional[Tuple[Tuple[str, ...], str]]",
         gpu_pcies: set,
+        topology_scope: str = "",
     ) -> Optional[List[int]]:
         """Choose RDMA minors. Joint allocation with GPUs prefers NICs on
         the GPUs' PCIe roots; the SamePCIe scope requires the chosen NICs'
         PCIe set to exactly equal the GPUs' (one per root, count bumped to
         the root count like the reference's desiredCount adjustment).
         A VF-carrying NIC is available while it has a free VF (it is
-        shared, never consumed whole); a plain NIC while idle."""
+        shared, never consumed whole); a plain NIC while idle.
+        ``topology_scope`` (DeviceHint.RequiredTopologyScope): "PCIe" /
+        "NUMANode" restricts the chosen set to NICs sharing that scope."""
         free_minors = [
             i
             for i in range(len(st.rdma_free))
@@ -543,6 +569,20 @@ class DeviceManager:
                 else st.rdma_free[i] >= FULL - 1e-6
             )
         ]
+        if topology_scope in ("PCIe", "NUMANode"):
+            def scope_key(m: int):
+                if topology_scope == "PCIe":
+                    return st.rdma_pcie[m] if m < len(st.rdma_pcie) else ""
+                return st.rdma_numa[m] if m < len(st.rdma_numa) else -1
+
+            groups: Dict[object, List[int]] = {}
+            for m in free_minors:
+                groups.setdefault(scope_key(m), []).append(m)
+            fitting = [g for g in groups.values() if len(g) >= count]
+            if not fitting:
+                return None
+            # tightest fitting scope group (least leftover)
+            free_minors = min(fitting, key=len)
         if len(free_minors) < count:
             return None
         joint_with_gpu = (

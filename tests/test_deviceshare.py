@@ -1008,3 +1008,107 @@ def test_parse_gpu_request_vector():
     assert v({ext.RES_GPU_CORE: 40, ext.RES_GPU_MEMORY: 1024}) == (
         0, 40.0, 0.0, 1024.0,
     )
+
+
+def _rdma_node(n_nics=4, numa_split=True):
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144}
+            ),
+        )
+    )
+    dm = DeviceManager(snap)
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="n0"),
+            devices=[
+                DeviceInfo(
+                    dev_type="rdma",
+                    minor=i,
+                    numa_node=(i // 2 if numa_split else 0),
+                    pcie_bus=f"0000:{i:02d}",
+                )
+                for i in range(n_nics)
+            ],
+        )
+    )
+    return snap, dm
+
+
+def test_device_allocate_hint_apply_for_all():
+    """device_share.go:168 ApplyForAll: the pod gets EVERY rdma device of
+    the node (the machine-wide NIC pattern for distributed training)."""
+    snap, dm = _rdma_node(n_nics=4)
+    pod = Pod(
+        meta=ObjectMeta(
+            name="train",
+            annotations={
+                ext.ANNOTATION_DEVICE_ALLOCATE_HINT: (
+                    '{"rdma": {"allocateStrategy": "ApplyForAll"}}'
+                )
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_RDMA: 100}, priority=9000
+        ),
+    )
+    patch = dm.allocate(pod, "n0")
+    assert patch is not None
+    alloc = json.loads(patch[ext.ANNOTATION_DEVICE_ALLOCATED])
+    assert sorted(e["minor"] for e in alloc["rdma"]) == [0, 1, 2, 3]
+
+
+def test_device_allocate_hint_requests_as_count():
+    """device_share.go:169 RequestsAsCount: the raw request value IS the
+    device count (rdma: 2 = two NICs, not 2/100 of one)."""
+    snap, dm = _rdma_node(n_nics=4)
+    pod = Pod(
+        meta=ObjectMeta(
+            name="двa",
+            annotations={
+                ext.ANNOTATION_DEVICE_ALLOCATE_HINT: (
+                    '{"rdma": {"allocateStrategy": "RequestsAsCount"}}'
+                )
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_RDMA: 2}, priority=9000
+        ),
+    )
+    patch = dm.allocate(pod, "n0")
+    assert patch is not None
+    alloc = json.loads(patch[ext.ANNOTATION_DEVICE_ALLOCATED])
+    assert len(alloc["rdma"]) == 2
+
+
+def test_device_allocate_hint_numa_topology_scope():
+    """DeviceHint.RequiredTopologyScope=NUMANode: the chosen NICs must
+    share one NUMA node; an unsatisfiable scope fails the allocation."""
+    snap, dm = _rdma_node(n_nics=4, numa_split=True)   # numa 0: {0,1}, numa 1: {2,3}
+    def rdma_pod(name, count):
+        return Pod(
+            meta=ObjectMeta(
+                name=name,
+                annotations={
+                    ext.ANNOTATION_DEVICE_ALLOCATE_HINT: (
+                        '{"rdma": {"allocateStrategy": "RequestsAsCount", '
+                        '"requiredTopologyScope": "NUMANode"}}'
+                    )
+                },
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 1000, ext.RES_RDMA: count},
+                priority=9000,
+            ),
+        )
+
+    patch = dm.allocate(rdma_pod("two", 2), "n0")
+    assert patch is not None
+    alloc = json.loads(patch[ext.ANNOTATION_DEVICE_ALLOCATED])
+    numas = {e["minor"] // 2 for e in alloc["rdma"]}
+    assert len(numas) == 1                  # both NICs on one NUMA node
+    # 3 NICs cannot share a NUMA node on this box: failed Reserve
+    assert dm.allocate(rdma_pod("three", 3), "n0") is None
