@@ -32,6 +32,13 @@ LABEL_QUOTA_IS_ROOT = f"quota.scheduling.{DOMAIN}/is-root"
 LABEL_QUOTA_IGNORE_DEFAULT_TREE = f"quota.scheduling.{DOMAIN}/ignore-default-tree"
 LABEL_PREEMPTIBLE = f"quota.scheduling.{DOMAIN}/preemptible"
 ANNOTATION_QUOTA_TOTAL_RESOURCE = f"quota.scheduling.{DOMAIN}/total-resource"
+#: allow-lent-resource label (quotaNode.AllowLentResource; default true)
+LABEL_QUOTA_ALLOW_LENT = f"quota.scheduling.{DOMAIN}/allow-lent-resource"
+#: status annotations the quota controller stamps each sync (reference
+#: ``elasticquota/controller.go:170-178``)
+ANNOTATION_QUOTA_RUNTIME = f"quota.scheduling.{DOMAIN}/runtime"
+ANNOTATION_QUOTA_REQUEST = f"quota.scheduling.{DOMAIN}/request"
+ANNOTATION_QUOTA_GUARANTEED = f"quota.scheduling.{DOMAIN}/guaranteed"
 
 #: well-known quota names (reference apis/extension/elastic_quota.go:29-33)
 SYSTEM_QUOTA_NAME = "koordinator-system-quota"

@@ -154,6 +154,11 @@ class GroupQuotaManager:
 
     def upsert_quota(self, eq: ElasticQuota) -> None:
         name = eq.meta.name
+        # label protocol: allow-lent-resource=false pins the full min
+        # (quotaNode.AllowLentResource; the typed field wins when the
+        # label is absent)
+        if eq.meta.labels.get(ext.LABEL_QUOTA_ALLOW_LENT) == "false":
+            eq.allow_lent_resource = False
         node = self._nodes.get(name)
         if node is None:
             node = _QuotaNode(quota=eq, index=len(self._order))
@@ -447,6 +452,42 @@ class GroupQuotaManager:
             d = self.config.dims
             return np.full((1, d), np.inf, np.float32), np.zeros((1, d), np.float32)
         return self.runtime, self.used
+
+    def sync_status(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """The quota controller's status sync (reference
+        ``elasticquota/controller.go:160-180`` Start → syncHandler):
+        stamps runtime / request / used annotations onto every quota
+        object and returns {name: {"runtime": .., "request": ..,
+        "used": ..}} for callers that publish status elsewhere."""
+        import json as _json
+
+        if self._dirty:
+            self.refresh_runtime()
+        res = self.config.resources
+        report: Dict[str, Dict[str, Dict[str, float]]] = {}
+
+        def table(row: np.ndarray) -> Dict[str, float]:
+            return {
+                res[d]: float(row[d]) for d in range(len(res)) if row[d] > 0
+            }
+
+        for name in self._order:
+            node = self._nodes[name]
+            idx = node.index
+            summary = {
+                "runtime": table(self.runtime[idx]),
+                "request": table(self.requests[idx]),
+                "used": table(self.used[idx]),
+            }
+            report[name] = summary
+            ann = node.quota.meta.annotations
+            ann[ext.ANNOTATION_QUOTA_RUNTIME] = _json.dumps(
+                summary["runtime"]
+            )
+            ann[ext.ANNOTATION_QUOTA_REQUEST] = _json.dumps(
+                summary["request"]
+            )
+        return report
 
     def chains_for_pods(self, pods: Sequence[Pod], p_bucket: int) -> np.ndarray:
         chains = np.full((p_bucket, MAX_LEVELS), -1, np.int32)

@@ -810,3 +810,34 @@ def test_allow_lent_resource_false_reserves_full_min():
     # with lending disabled, hoarder's full 60 min stays reserved
     assert hoarding["hoarder"] >= 60.0
     assert hoarding["hungry"] <= 40.0
+
+
+def test_quota_status_sync_stamps_annotations():
+    """elasticquota/controller.go:160-180: the controller sync stamps
+    runtime/request annotations onto every quota object and returns the
+    summary; the allow-lent-resource LABEL is honored too."""
+    import json as _json
+
+    from koordinator_tpu.core.snapshot import SnapshotConfig
+
+    gqm = GroupQuotaManager(SnapshotConfig(), cluster_total={ext.RES_CPU: 100})
+    q = ElasticQuota(
+        meta=ObjectMeta(
+            name="team",
+            labels={ext.LABEL_QUOTA_ALLOW_LENT: "false"},
+        ),
+        min={ext.RES_CPU: 40},
+        max={ext.RES_CPU: 100},
+    )
+    gqm.upsert_quota(q)
+    assert q.allow_lent_resource is False      # label parsed
+    gqm.set_leaf_requests(
+        {"team": gqm.config.res_vector({ext.RES_CPU: 10})}
+    )
+    report = gqm.sync_status()
+    assert report["team"]["runtime"][ext.RES_CPU] >= 40.0  # full min kept
+    stamped = _json.loads(q.meta.annotations[ext.ANNOTATION_QUOTA_RUNTIME])
+    assert stamped[ext.RES_CPU] == report["team"]["runtime"][ext.RES_CPU]
+    assert _json.loads(q.meta.annotations[ext.ANNOTATION_QUOTA_REQUEST])[
+        ext.RES_CPU
+    ] == 10.0
