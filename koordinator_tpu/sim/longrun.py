@@ -292,6 +292,10 @@ def run_loop(
             # ---- manager: batch capacity from the fresh prod peak ----
             published = nr_ctrl.reconcile()
             assert set(published) == {f"n{i}" for i in range(n_nodes)}
+            # quota controller status sync (controller.go syncHandler):
+            # runtime/request stamped onto the quota objects each sweep
+            if sched.quotas.quota_count:
+                assert "frontend" in sched.quotas.sync_status()
 
         caps = snap.nodes.allocatable[rows, bc]
         stats["min_batch_cap"] = min(stats["min_batch_cap"], float(caps.min()))
