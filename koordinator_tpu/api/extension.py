@@ -67,6 +67,22 @@ def is_reservation_ignored(pod) -> bool:
     return pod.meta.labels.get(LABEL_RESERVATION_IGNORED) == "true"
 
 
+#: per-pod PreemptionPolicy override (reference
+#: ``apis/extension/preemption.go:22-41`` LabelPodPreemptionPolicy):
+#: "Never" = this pod never triggers preemption of other pods
+LABEL_POD_PREEMPTION_POLICY = f"scheduling.{DOMAIN}/preemption-policy"
+PREEMPTION_POLICY_NEVER = "Never"
+
+
+def pod_never_preempts(pod) -> bool:
+    """Whether the pod's preemption policy forbids preempting on its
+    behalf (GetPodKoordPreemptionPolicy == Never)."""
+    return (
+        pod.meta.labels.get(LABEL_POD_PREEMPTION_POLICY)
+        == PREEMPTION_POLICY_NEVER
+    )
+
+
 #: pod-side spec restricting nomination to reservations whose allocatable
 #: EXACTLY equals the pod's request on the listed resource names
 #: (reference ``reservation.go:188-241`` AnnotationExactMatchReservationSpec)

@@ -500,6 +500,9 @@ class BatchScheduler:
                 # help it, so never preempt on its behalf
                 if ext.parse_reservation_affinity(pod.meta.annotations):
                     continue
+                # preemption-policy=Never (preemption.go:22-41)
+                if ext.pod_never_preempts(pod):
+                    continue
                 sel = preemptor.select_victims(pod)
                 if sel is None:
                     continue
@@ -538,7 +541,9 @@ class BatchScheduler:
                     or _gang_of(pod) is not None
                 ):
                     continue
-                if ext.parse_reservation_affinity(pod.meta.annotations):
+                if ext.parse_reservation_affinity(
+                    pod.meta.annotations
+                ) or ext.pod_never_preempts(pod):
                     continue
                 sel = pp.select_victims(pod)
                 if sel is None:

@@ -841,3 +841,26 @@ def test_quota_status_sync_stamps_annotations():
     assert _json.loads(q.meta.annotations[ext.ANNOTATION_QUOTA_REQUEST])[
         ext.RES_CPU
     ] == 10.0
+
+
+def test_preemption_policy_never_blocks_both_preemptors():
+    """preemption.go:22-41 LabelPodPreemptionPolicy=Never: preemption is
+    never attempted on the pod's behalf — neither the quota preemptor
+    nor the priority preemptor fires."""
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+    snap = _prio_cluster(n_nodes=1, cpu=16000)
+    sched = BatchScheduler(
+        snap, batch_bucket=64, enable_priority_preemption=True
+    )
+    sched.extender.monitor.stop_background()
+    assert len(sched.schedule([_prio_pod("low", 16000, 5500)]).bound) == 1
+    never = _prio_pod(
+        "hi-never", 8000, 9500,
+        labels={ext.LABEL_POD_PREEMPTION_POLICY: "Never"},
+    )
+    out = sched.schedule([never])
+    assert out.bound == [] and out.preempted == []
+    # without the label the same pod preempts
+    out2 = sched.schedule([_prio_pod("hi", 8000, 9500)])
+    assert len(out2.bound) == 1 and len(out2.preempted) == 1
