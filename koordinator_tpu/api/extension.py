@@ -83,6 +83,36 @@ def pod_never_preempts(pod) -> bool:
     )
 
 
+#: reservation-side options narrowing WHICH resources the Restricted
+#: allocate policy binds (reference ``reservation.go:54-55,89-96``
+#: AnnotationReservationRestrictedOptions; default = every reserved dim)
+ANNOTATION_RESERVATION_RESTRICTED_OPTIONS = (
+    f"scheduling.{DOMAIN}/reservation-restricted-options"
+)
+
+
+def parse_reservation_restricted_resources(
+    annotations: Mapping[str, str],
+) -> Optional[list]:
+    """The restricted-options resources list, or None when absent/illegal
+    (GetReservationRestrictedOptions)."""
+    raw = annotations.get(ANNOTATION_RESERVATION_RESTRICTED_OPTIONS)
+    if not raw:
+        return None
+    import json
+
+    try:
+        payload = json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    resources = payload.get("resources")
+    if not isinstance(resources, list):
+        return None
+    return [str(r) for r in resources]
+
+
 #: pod-side spec restricting nomination to reservations whose allocatable
 #: EXACTLY equals the pod's request on the listed resource names
 #: (reference ``reservation.go:188-241`` AnnotationExactMatchReservationSpec)

@@ -428,7 +428,17 @@ class ReservationManager:
             # feasible one (reviewer finding r3).
             consumed, spill = self.consumed_and_spill(r, pod)
             if r.allocate_policy == RESERVATION_ALLOCATE_POLICY_RESTRICTED:
-                if any(k in r.requests for k in spill):
+                # restricted-options may narrow WHICH dims are binding
+                # (reservation.go:89-96); default = every reserved dim
+                restricted = ext.parse_reservation_restricted_resources(
+                    r.meta.annotations
+                )
+                binding = (
+                    set(restricted) & set(r.requests)
+                    if restricted is not None
+                    else set(r.requests)
+                )
+                if any(k in binding for k in spill):
                     continue
             if not self.spill_fits_node(r, spill):
                 continue

@@ -250,3 +250,75 @@ def test_exact_match_reservation_spec():
     )
     got = rm.match(pod)
     assert got is not None and got.meta.name == "exact"
+
+
+def test_reservation_restricted_options_narrow_binding_dims():
+    """reservation.go:89-96: restricted-options limits WHICH reserved
+    dims the Restricted policy binds — an over-remaining memory request
+    is allowed to spill when only cpu is listed as restricted."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        Reservation,
+        ReservationOwner,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 64000, ext.RES_MEMORY: 65536}
+            ),
+        )
+    )
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+
+    def reservation(name, options=None):
+        meta = ObjectMeta(name=name)
+        if options:
+            meta.annotations[
+                ext.ANNOTATION_RESERVATION_RESTRICTED_OPTIONS
+            ] = options
+        return Reservation(
+            meta=meta,
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 4096},
+            owners=[ReservationOwner(label_selector={"app": name})],
+            allocate_once=False,
+            allocate_policy="Restricted",
+        )
+
+    rm.add(reservation("strict"))
+    rm.add(
+        reservation(
+            "cpu-only", options='{"resources": ["%s"]}' % ext.RES_CPU
+        )
+    )
+    assert rm.schedule_pending() == 2
+
+    def owner(app):
+        return Pod(
+            meta=ObjectMeta(name=f"{app}-pod", labels={"app": app}),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                priority=9500,
+            ),
+        )
+
+    # memory 8192 > reserved 4096: fully-Restricted reservation refuses
+    assert rm.match(owner("strict")) is None
+    # cpu-only restriction: memory may spill to the node — matches
+    got = rm.match(owner("cpu-only"))
+    assert got is not None and got.meta.name == "cpu-only"
