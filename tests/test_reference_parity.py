@@ -556,3 +556,36 @@ def test_burst_limiter_token_bucket():
     assert tokens == lim.capacity
     assert not lim.expired(5020.0)
     assert lim.expired(5011.0 + 600.0)
+
+
+def test_device_request_conversion_parity():
+    """Reference ``deviceshare/utils_test.go:323+`` TestConvertDeviceRequest
+    — the request-normalization table, expressed through
+    parse_gpu_request_vector's (whole, core%, memory-ratio%, bytes)
+    vector: nvidia.com/gpu multiplies to core/ratio 100s per device,
+    koordinator.sh/gpu mirrors into both percentage dims, and the
+    explicit per-dim combinations pass through untouched."""
+    from koordinator_tpu.api import extension as ext
+
+    v = ext.parse_gpu_request_vector
+    # "nvidiaGPU": 2 -> gpu-core 200 / memory-ratio 200 == 2 whole devices
+    assert v({ext.RES_GPU: 2}) == (2, 0.0, 0.0, None)
+    # "koordGPU": gpu 50 -> core 50 / ratio 50
+    assert v({ext.RES_KOORD_GPU: 50}) == (0, 50.0, 50.0, None)
+    # "gpuCore | gpuMemoryRatio": 50/50 passes through
+    assert v({ext.RES_GPU_CORE: 50, ext.RES_GPU_MEMORY_RATIO: 50}) == (
+        0, 50.0, 50.0, None,
+    )
+    # "gpuCore | gpuMemory": core 50 + 32Gi bytes passes through
+    gib32 = 32 * 1024**3
+    assert v({ext.RES_GPU_CORE: 50, ext.RES_GPU_MEMORY: gib32}) == (
+        0, 50.0, 0.0, float(gib32),
+    )
+    # asymmetric dims stay independent (the r2 review's missing #3)
+    assert v({ext.RES_GPU_CORE: 20, ext.RES_GPU_MEMORY_RATIO: 70}) == (
+        0, 20.0, 70.0, None,
+    )
+    # whole-device split only on equal multiples of 100
+    assert v({ext.RES_GPU_CORE: 200, ext.RES_GPU_MEMORY_RATIO: 200}) == (
+        2, 0.0, 0.0, None,
+    )
