@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
@@ -26,7 +26,6 @@ from ...core.topology import (
     CPUBindPolicy,
     CPUTopology,
     NUMAPolicy,
-    format_cpuset,
     format_cpuset_sorted,
 )
 
