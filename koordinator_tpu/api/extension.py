@@ -39,6 +39,36 @@ LABEL_QUOTA_ALLOW_LENT = f"quota.scheduling.{DOMAIN}/allow-lent-resource"
 ANNOTATION_QUOTA_RUNTIME = f"quota.scheduling.{DOMAIN}/runtime"
 ANNOTATION_QUOTA_REQUEST = f"quota.scheduling.{DOMAIN}/request"
 ANNOTATION_QUOTA_GUARANTEED = f"quota.scheduling.{DOMAIN}/guaranteed"
+#: sum of children's requests (leaf: its pod requests) — AnnotationChildRequest
+ANNOTATION_QUOTA_CHILD_REQUEST = f"quota.scheduling.{DOMAIN}/child-request"
+#: allocated = sum of children's guaranteed (leaf: admitted pod usage) —
+#: reference ``elasticquota/core/quota_info.go:62-67``
+ANNOTATION_QUOTA_ALLOCATED = f"quota.scheduling.{DOMAIN}/allocated"
+#: non-preemptible pods' request/used accounted separately: they must fit
+#: inside quota MIN, not runtime (``quota_info.go:49-56``)
+ANNOTATION_QUOTA_NON_PREEMPTIBLE_REQUEST = (
+    f"quota.scheduling.{DOMAIN}/non-preemptible-request"
+)
+ANNOTATION_QUOTA_NON_PREEMPTIBLE_USED = (
+    f"quota.scheduling.{DOMAIN}/non-preemptible-used"
+)
+#: namespaces bound to this quota (pods in them default into it) —
+#: AnnotationQuotaNamespaces (``elastic_quota.go:52``)
+ANNOTATION_QUOTA_NAMESPACES = f"quota.scheduling.{DOMAIN}/namespaces"
+#: fair-sharing competition weight as a wire annotation; absent/zero →
+#: defaults to max (reference GetSharedWeight, ``elastic_quota.go:95-105``)
+ANNOTATION_QUOTA_SHARED_WEIGHT = f"quota.scheduling.{DOMAIN}/shared-weight"
+#: bypass the quota webhook's structural guards (LabelAllowForceUpdate)
+LABEL_QUOTA_ALLOW_FORCE_UPDATE = f"quota.scheduling.{DOMAIN}/allow-force-update"
+#: per-quota admission toggle + declared resource-key set
+ANNOTATION_QUOTA_ADMISSION = f"quota.scheduling.{DOMAIN}/admission"
+ANNOTATION_QUOTA_RESOURCE_KEYS = f"quota.scheduling.{DOMAIN}/resource-keys"
+ANNOTATION_QUOTA_UNSCHEDULABLE_RESOURCE = (
+    f"quota.scheduling.{DOMAIN}/unschedulable-resource"
+)
+ANNOTATION_QUOTA_MAX_STRICT_CHECK_RESOURCE_KEYS = (
+    f"quota.scheduling.{DOMAIN}/max-strict-check-resource-keys"
+)
 
 #: well-known quota names (reference apis/extension/elastic_quota.go:29-33)
 SYSTEM_QUOTA_NAME = "koordinator-system-quota"
@@ -687,6 +717,22 @@ def _parse_json_annotation(annotations: Mapping[str, str], key: str, shape):
 
 def _parse_dict_annotation(annotations: Mapping[str, str], key: str):
     return _parse_json_annotation(annotations, key, dict)
+
+
+def parse_quota_shared_weight(annotations: Mapping[str, str]):
+    """GetSharedWeight (``elastic_quota.go:95-105``): the quota's
+    fair-sharing competition weight from its wire annotation. Returns a
+    ``{resource: float}`` mapping, or None when the annotation is
+    absent, malformed, or all-zero (callers then fall back to the typed
+    field and ultimately to max)."""
+    spec = _parse_dict_annotation(annotations, ANNOTATION_QUOTA_SHARED_WEIGHT)
+    if spec is None:
+        return None
+    try:
+        parsed = {k: float(v) for k, v in spec.items()}
+    except (ValueError, TypeError):
+        return None
+    return parsed if any(v > 0 for v in parsed.values()) else None
 
 
 def is_reservation_operating_mode(pod) -> bool:

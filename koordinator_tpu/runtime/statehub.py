@@ -21,6 +21,7 @@ from __future__ import annotations
 import time as _time
 from typing import List, Optional
 
+from ..api.types import ReservationPhase
 from ..utils.informer import Informer, ObjectTracker
 
 
@@ -57,6 +58,11 @@ class ClusterStateHub:
         self.topologies = ObjectTracker()
         self.resync_interval_s = resync_interval_s
         self.informers: List[Informer] = []
+        #: snapshot-id → the node Informer that applies nodes into that
+        #: snapshot; lets wire_scheduler chain its pending-bind drain onto
+        #: the SAME informer (same thread, handler order = registration
+        #: order) instead of racing it from an independent stream
+        self._snapshot_node_informers: dict = {}
         self._trackers = (
             self.nodes,
             self.node_metrics,
@@ -107,6 +113,7 @@ class ClusterStateHub:
             on_add=_locked(lock, _metric),
             on_update=_locked(lock, _metric),
         )
+        self._snapshot_node_informers[id(snap)] = node_inf
         informers = [node_inf, metric_inf]
         self.informers.extend(informers)
         return informers
@@ -177,19 +184,35 @@ class ClusterStateHub:
         )
         extras.append(pod_inf)
 
-        drain_inf = Informer(self.nodes, self.resync_interval_s)
-
         def _drain_binds(_k, node):
             for uid, pod in list(pending_binds.items()):
                 if pod.spec.node_name == node.meta.name:
                     pending_binds.pop(uid, None)
                     _pod_upsert(uid, pod)
 
-        drain_inf.add_handlers(
-            on_add=_locked(lock, _drain_binds),
-            on_update=_locked(lock, _drain_binds),
-        )
-        extras.append(drain_inf)
+        snap_node_inf = self._snapshot_node_informers.get(id(snap))
+        if snap_node_inf is not None:
+            # chain the drain onto the informer that applies nodes into
+            # this snapshot: handlers run in registration order on ONE
+            # thread, so the drain always observes the node already
+            # upserted — no independent stream to race (a drain racing
+            # ahead of upsert_node could park a bind forever and leave the
+            # node permanently under-charged)
+            snap_node_inf.add_handlers(
+                on_add=_locked(lock, _drain_binds),
+                on_update=_locked(lock, _drain_binds),
+            )
+        else:
+            # snapshot wired elsewhere (e.g. a different hub): fall back
+            # to a dedicated informer — ordering vs that foreign wiring is
+            # not guaranteed, so hubs used this way should set a nonzero
+            # resync_interval_s as the repair backstop
+            drain_inf = Informer(self.nodes, self.resync_interval_s)
+            drain_inf.add_handlers(
+                on_add=_locked(lock, _drain_binds),
+                on_update=_locked(lock, _drain_binds),
+            )
+            extras.append(drain_inf)
 
         if sched.devices is not None:
             dev_inf = Informer(self.devices, self.resync_interval_s)
@@ -232,9 +255,46 @@ class ClusterStateHub:
         if reservations is not None:
             resv_inf = Informer(self.reservations, self.resync_interval_s)
 
+            from ..api import extension as _ext
+
+            #: only these annotations are spec-bearing for a reservation;
+            #: comparing the whole dict would let a purely informational
+            #: annotation expire a live AVAILABLE hold and wipe its owner
+            #: ledger
+            _RESV_SPEC_ANNOTATIONS = (
+                _ext.ANNOTATION_RESERVATION_RESTRICTED_OPTIONS,
+                _ext.ANNOTATION_EXACT_MATCH_RESERVATION_SPEC,
+                _ext.ANNOTATION_RESERVATION_OWNERS,
+            )
+
+            def _resv_spec(r):
+                ann = r.meta.annotations or {}
+                return (
+                    dict(r.requests),
+                    sorted(
+                        (tuple(sorted(o.label_selector.items())), o.namespace or "")
+                        for o in r.owners
+                    ),
+                    r.allocate_once,
+                    r.ttl_s,
+                    r.allocate_policy,
+                    tuple(ann.get(k) for k in _RESV_SPEC_ANNOTATIONS),
+                )
+
             def _resv_upsert(_k, r):
                 existing = reservations.get(r.meta.name)
                 if existing is None:
+                    reservations.add(r)
+                elif existing is not r and _resv_spec(existing) != _resv_spec(r):
+                    # spec change (requests/owners/TTL/policy/annotations):
+                    # release the old incarnation's hold and re-admit the
+                    # new spec from PENDING — the reference cache replaces
+                    # reservationInfo on update. Status-only republications
+                    # fall through untouched (expiring an AVAILABLE hold
+                    # for a no-op update would free capacity still in use).
+                    reservations.expire_reservation(r.meta.name)
+                    r.phase = ReservationPhase.PENDING
+                    r.node_name = None
                     reservations.add(r)
 
             resv_inf.add_handlers(

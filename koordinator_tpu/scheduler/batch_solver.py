@@ -480,6 +480,11 @@ class BatchScheduler:
         # the batch retries once for the preemptors.
         preempted: List[Pod] = []
         retry_pods: List[Pod] = []
+        #: pods that already nominated victims in defer mode this cycle:
+        #: the priority-preemption pass must skip them, or one pod could
+        #: nominate two disjoint victim sets (quota + priority) in a
+        #: single cycle and over-evict through the migration controller
+        nominated_uids: set = set()
         if (
             not _retry
             and unsched
@@ -517,6 +522,7 @@ class BatchScheduler:
                     preempted.extend(
                         v for v in victims if v.meta.uid not in seen
                     )
+                    nominated_uids.add(pod.meta.uid)
                     continue
                 for victim in victims:
                     self.evict_for_preemption(victim)
@@ -530,7 +536,7 @@ class BatchScheduler:
             from .plugins.coscheduling import gang_key_of as _gang_of
             from .plugins.preemption import PriorityPreemptor
 
-            helped = {p.meta.uid for p in retry_pods}
+            helped = {p.meta.uid for p in retry_pods} | nominated_uids
             pp = PriorityPreemptor(self)
             for pod in sorted(
                 unsched, key=lambda p: -(p.spec.priority or 0)

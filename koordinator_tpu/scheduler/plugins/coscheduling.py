@@ -333,7 +333,7 @@ class PodGroupManager:
         whole group's placements."""
         results = list(results)
         if not self._gangs and not any(
-            ext.LABEL_GANG_NAME in p.meta.labels for p, _ in results
+            gang_key_of(p) is not None for p, _ in results
         ):
             # no gang state and no gang-labeled pod in the batch: the
             # per-pod gang bookkeeping is pure overhead (hot commit path)

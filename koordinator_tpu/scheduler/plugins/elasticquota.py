@@ -145,6 +145,9 @@ class GroupQuotaManager:
         self.runtime = np.zeros((1, d), np.float32)
         self.used = np.zeros((1, d), np.float32)
         self.requests = np.zeros((1, d), np.float32)
+        #: uncapped Σ of children's requests per quota (the reference's
+        #: ChildRequest; ``requests`` holds the max-capped propagation)
+        self.child_requests = np.zeros((1, d), np.float32)
         self._dirty = True
         #: memoized leaf-to-root index paths; rebuilt on tree mutations
         #: (chain_of was a visible slice of the per-winner commit loop)
@@ -159,6 +162,13 @@ class GroupQuotaManager:
         # label is absent)
         if eq.meta.labels.get(ext.LABEL_QUOTA_ALLOW_LENT) == "false":
             eq.allow_lent_resource = False
+        # wire spelling of the competition weight (AnnotationSharedWeight,
+        # ``elastic_quota.go:95-105`` GetSharedWeight): a valid non-zero
+        # JSON resource list overrides; otherwise the typed field (and
+        # ultimately max) stands
+        wire_weight = ext.parse_quota_shared_weight(eq.meta.annotations)
+        if wire_weight is not None:
+            eq.shared_weight = wire_weight
         node = self._nodes.get(name)
         if node is None:
             node = _QuotaNode(quota=eq, index=len(self._order))
@@ -192,6 +202,7 @@ class GroupQuotaManager:
         d = self.config.dims
         new_used = np.zeros((q, d), np.float32)
         new_req = np.zeros((q, d), np.float32)
+        new_child = np.zeros((q, d), np.float32)
         for new_i, nm in enumerate(self._order):
             n = self._nodes[nm]
             if name in n.children:
@@ -201,9 +212,12 @@ class GroupQuotaManager:
                 new_used[new_i] = self.used[oi]
             if oi < self.requests.shape[0]:
                 new_req[new_i] = self.requests[oi]
+            if oi < self.child_requests.shape[0]:
+                new_child[new_i] = self.child_requests[oi]
             n.index = new_i
         self._chain_cache.clear()
         self.used, self.requests = new_used, new_req
+        self.child_requests = new_child
         self._dirty = True
 
     def set_cluster_total(self, total: Mapping[str, float]) -> None:
@@ -267,7 +281,7 @@ class GroupQuotaManager:
     def _ensure_capacity(self) -> None:
         q = max(self.quota_count, 1)
         d = self.config.dims
-        for attr in ("used", "requests", "runtime"):
+        for attr in ("used", "requests", "runtime", "child_requests"):
             arr = getattr(self, attr)
             if arr.shape[0] < q:
                 grown = np.zeros((q, d), np.float32)
@@ -374,14 +388,47 @@ class GroupQuotaManager:
     def set_leaf_requests(self, by_leaf: Mapping[str, np.ndarray]) -> None:
         """Aggregate desired request per quota (pending + admitted), rolled
         up the tree — drives the fair-sharing split like the reference's
-        request propagation (``group_quota_manager.go`` updateGroupDeltaReq)."""
+        request propagation (``group_quota_manager.go:196-224``
+        recursiveUpdateGroupTreeWithDeltaRequest). What travels upward is
+        each quota's **limitRequest = min(request, max)**: a child
+        demanding over its own max must not inflate its parent's share of
+        the grandparent's pool. ``child_requests`` keeps the uncapped sum
+        (the reference's ChildRequest annotation)."""
         q = max(self.quota_count, 1)
         d = self.config.dims
         req = np.zeros((q, d), np.float32)
-        for leaf, vec in by_leaf.items():
-            for idx in self.chain_of(leaf):
-                req[idx] += vec
+        child_req = np.zeros((q, d), np.float32)
+
+        def visit(name: str) -> np.ndarray:
+            node = self._nodes[name]
+            idx = node.index
+            # a quota's direct pod demand (the reference's SelfRequest) —
+            # pods may target non-leaf quotas too, so every level reads
+            # its own by_leaf entry on top of the children's propagation
+            vec = by_leaf.get(name)
+            cr = (
+                np.asarray(vec, np.float32)
+                if vec is not None
+                else np.zeros(d, np.float32)
+            )
+            for c in node.children:
+                cr = cr + visit(c)
+            child_req[idx] = cr
+            r = cr
+            if not node.quota.allow_lent_resource:
+                # request never drops below min: the unlent guarantee is
+                # always demanded from the parent (reference :208-221)
+                r = np.maximum(r, self.config.res_vector(node.quota.min))
+            req[idx] = r
+            maxv = self.config.res_vector(node.quota.max)
+            maxv = np.where(maxv <= 0, np.inf, maxv)
+            return np.minimum(r, maxv).astype(np.float32)
+
+        for n in self._order:
+            if (self._nodes[n].quota.parent or ROOT) == ROOT:
+                visit(n)
         self.requests = req
+        self.child_requests = child_req
         self._dirty = True
 
     # ---- runtime refresh (water-filling down the tree) ----
@@ -453,18 +500,54 @@ class GroupQuotaManager:
             return np.full((1, d), np.inf, np.float32), np.zeros((1, d), np.float32)
         return self.runtime, self.used
 
+    def guaranteed_allocated(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Bottom-up guaranteed/allocated pass (reference
+        ``elasticquota/core/quota_info.go:62-67`` +
+        ``group_quota_manager.go:1350-1352``): a leaf's allocated is its
+        admitted pod usage; every quota's guaranteed = max(allocated, min);
+        a parent's allocated = Σ children's guaranteed."""
+        self._ensure_capacity()
+        if self._dirty:
+            self.refresh_runtime()
+        q = max(self.quota_count, 1)
+        d = self.config.dims
+        allocated = np.zeros((q, d), np.float32)
+        guaranteed = np.zeros((q, d), np.float32)
+
+        def visit(name: str) -> np.ndarray:
+            node = self._nodes[name]
+            idx = node.index
+            if node.children:
+                alloc = np.zeros(d, np.float32)
+                for child in node.children:
+                    alloc += visit(child)
+            else:
+                alloc = self.used[idx].copy()
+            allocated[idx] = alloc
+            guaranteed[idx] = np.maximum(
+                alloc, self.config.res_vector(node.quota.min)
+            )
+            return guaranteed[idx]
+
+        for n in self._order:
+            if (self._nodes[n].quota.parent or ROOT) == ROOT:
+                visit(n)
+        return guaranteed, allocated
+
     def sync_status(self) -> Dict[str, Dict[str, Dict[str, float]]]:
         """The quota controller's status sync (reference
-        ``elasticquota/controller.go:160-180`` Start → syncHandler):
-        stamps runtime / request / used annotations onto every quota
-        object and returns {name: {"runtime": .., "request": ..,
-        "used": ..}} for callers that publish status elsewhere."""
+        ``elasticquota/controller.go:160-180`` Start → syncHandler,
+        updateElasticQuotaStatusIfChanged): stamps runtime / request /
+        child-request / guaranteed / allocated annotations onto every
+        quota object and returns {name: {"runtime": .., "request": ..,
+        "used": .., ...}} for callers that publish status elsewhere."""
         import json as _json
 
         if self._dirty:
             self.refresh_runtime()
         res = self.config.resources
         report: Dict[str, Dict[str, Dict[str, float]]] = {}
+        guaranteed, allocated = self.guaranteed_allocated()
 
         def table(row: np.ndarray) -> Dict[str, float]:
             return {
@@ -474,10 +557,21 @@ class GroupQuotaManager:
         for name in self._order:
             node = self._nodes[name]
             idx = node.index
+            # uncapped Σ of children's demand (AnnotationChildRequest) vs
+            # the max-capped ``request`` — distinct when a child demands
+            # over its own max
+            child_req = (
+                self.child_requests[idx]
+                if idx < self.child_requests.shape[0]
+                else self.requests[idx]
+            )
             summary = {
                 "runtime": table(self.runtime[idx]),
                 "request": table(self.requests[idx]),
                 "used": table(self.used[idx]),
+                "childRequest": table(child_req),
+                "guaranteed": table(guaranteed[idx]),
+                "allocated": table(allocated[idx]),
             }
             report[name] = summary
             ann = node.quota.meta.annotations
@@ -486,6 +580,15 @@ class GroupQuotaManager:
             )
             ann[ext.ANNOTATION_QUOTA_REQUEST] = _json.dumps(
                 summary["request"]
+            )
+            ann[ext.ANNOTATION_QUOTA_CHILD_REQUEST] = _json.dumps(
+                summary["childRequest"]
+            )
+            ann[ext.ANNOTATION_QUOTA_GUARANTEED] = _json.dumps(
+                summary["guaranteed"]
+            )
+            ann[ext.ANNOTATION_QUOTA_ALLOCATED] = _json.dumps(
+                summary["allocated"]
             )
         return report
 

@@ -1,0 +1,393 @@
+"""Regressions for the round-3 advisor findings (ADVICE.md r3).
+
+1. (medium) statehub cross-informer ordering: a bind parked because its
+   node had not yet reached the snapshot must drain from the SAME
+   informer thread that applies the node — a separate drain informer can
+   consume the node event first and strand the bind forever.
+2. (low) batch_solver defer_preemption: a pod helped by quota preemption
+   must not ALSO nominate a disjoint priority-preemption victim set in
+   the same cycle.
+3. (low) coscheduling permit: the gang-free early return must recognize
+   the native gang annotation, not just the legacy label.
+4. (low) elasticquota sync_status stamps guaranteed / allocated /
+   child-request like the reference controller.
+5. (low) statehub reservation informer applies spec UPDATES, not only
+   adds/deletes.
+"""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Reservation,
+    ReservationOwner,
+    ReservationPhase,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.runtime.statehub import ClusterStateHub
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.scheduler.plugins.reservation import ReservationManager
+
+
+def _node(name, cpu=64000, mem=262144):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}),
+    )
+
+
+def _bound_pod(name, node, cpu=4000):
+    return Pod(
+        meta=ObjectMeta(name=name),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}, node_name=node
+        ),
+    )
+
+
+def test_bind_before_node_drains_on_snapshot_informer():
+    """A pod bound to a node the snapshot has not seen yet parks; when the
+    node lands, the drain runs on the SAME node informer (registration
+    order after upsert_node), so the charge appears — and no independent
+    drain informer exists to race."""
+    snap = ClusterSnapshot()
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    hub = ClusterStateHub()
+    hub.wire_scheduler(sched)
+    # exactly ONE informer watches the nodes tracker: the snapshot node
+    # informer now carrying the drain handlers (the racing drain informer
+    # is gone)
+    assert sum(1 for inf in hub.informers if inf.tracker is hub.nodes) == 1
+    hub.start()
+    try:
+        # bind FIRST (node unknown → parked), node second
+        hub.publish(hub.pods, _bound_pod("early", "n0", cpu=4000))
+        hub.publish(hub.nodes, _node("n0"))
+        assert hub.wait_synced()
+        idx = snap.node_id("n0")
+        assert idx is not None
+        assert snap.nodes.requested[idx, 0] == 4000.0
+    finally:
+        hub.stop()
+
+
+def test_reservation_spec_update_via_informer():
+    """A Reservation republished with changed requests must take effect
+    (previously only add/delete did): the old hold is released and the
+    new spec re-enters as PENDING."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("n0"))
+    sched = BatchScheduler(snap, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    hub = ClusterStateHub()
+    hub.wire_scheduler(sched, reservations=rm)
+    hub.start()
+    try:
+        r1 = Reservation(
+            meta=ObjectMeta(name="resv"),
+            requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096},
+            owners=[ReservationOwner(label_selector={"app": "t"})],
+        )
+        hub.publish(hub.reservations, r1)
+        assert hub.wait_synced()
+        assert rm.get("resv").requests[ext.RES_CPU] == 4000
+
+        # spec change: double the request
+        r2 = Reservation(
+            meta=ObjectMeta(name="resv"),
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8192},
+            owners=[ReservationOwner(label_selector={"app": "t"})],
+        )
+        hub.publish(hub.reservations, r2)
+        assert hub.wait_synced()
+        assert rm.get("resv").requests[ext.RES_CPU] == 8000
+        assert rm.get("resv").phase == ReservationPhase.PENDING
+
+        # status-only republication (same spec object content) is a no-op
+        r3 = Reservation(
+            meta=ObjectMeta(name="resv"),
+            requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 8192},
+            owners=[ReservationOwner(label_selector={"app": "t"})],
+        )
+        r3.phase = ReservationPhase.AVAILABLE
+        r3.node_name = "n0"
+        before = rm.get("resv")
+        hub.publish(hub.reservations, r3)
+        assert hub.wait_synced()
+        assert rm.get("resv") is before
+    finally:
+        hub.stop()
+
+
+def _quota(name, minv, maxv, weight):
+    from koordinator_tpu.api.types import ElasticQuota
+
+    return ElasticQuota(
+        meta=ObjectMeta(name=name),
+        min={ext.RES_CPU: minv[0], ext.RES_MEMORY: minv[1]},
+        max={ext.RES_CPU: maxv[0], ext.RES_MEMORY: maxv[1]},
+        shared_weight={ext.RES_CPU: weight[0], ext.RES_MEMORY: weight[1]},
+    )
+
+
+def test_defer_preemption_no_double_nomination():
+    """defer mode + priority preemption both on: a pod whose quota
+    preemption already nominated victims must NOT also nominate a
+    (disjoint) priority victim set in the same cycle."""
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("n0", cpu=12, mem=400))
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 400, ext.RES_MEMORY: 400}
+    )
+    mgr.upsert_quota(_quota("team-a", (6, 6), (6, 400), (1, 1)))
+    mgr.upsert_quota(_quota("team-b", (6, 6), (400, 400), (1, 1)))
+    sched = BatchScheduler(
+        snap,
+        quotas=mgr,
+        defer_preemption=True,
+        enable_priority_preemption=True,
+    )
+    sched.extender.monitor.stop_background()
+
+    def qpod(name, q, cpu, prio):
+        return Pod(
+            meta=ObjectMeta(name=name, labels={ext.LABEL_QUOTA_NAME: q}),
+            spec=PodSpec(
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu},
+                priority=prio,
+            ),
+        )
+
+    # node full (12/12) AND team-a at max (6/6)
+    a_low = qpod("a-low", "team-a", 6.0, 5000)
+    b_low = qpod("b-low", "team-b", 6.0, 4000)
+    assert len(sched.schedule([a_low, b_low]).bound) == 2
+
+    high = qpod("high", "team-a", 6.0, 9500)
+    out = sched.schedule([high])
+    # deferred: nothing binds this cycle, ONE victim set is nominated —
+    # the quota preemptor's (a-low). Without the fix the priority pass
+    # would also nominate b-low (its reprieve keeps the higher-priority
+    # a-low), over-evicting through the migration controller.
+    assert out.bound == []
+    assert [v.meta.name for v in out.preempted] == ["a-low"]
+
+
+def test_permit_native_gang_annotation_not_bypassed():
+    """permit()'s gang-free early return must detect the NATIVE gang
+    annotation: an all-or-nothing gang with a failed member rejects the
+    placed member even when no gang state was pre-created."""
+    from koordinator_tpu.scheduler.plugins.coscheduling import PodGroupManager
+
+    pgm = PodGroupManager()
+
+    def gpod(name, node):
+        return (
+            Pod(
+                meta=ObjectMeta(
+                    name=name,
+                    annotations={
+                        ext.ANNOTATION_GANG_NAME: "g1",
+                        ext.ANNOTATION_GANG_MIN_AVAILABLE: "2",
+                    },
+                ),
+                spec=PodSpec(requests={ext.RES_CPU: 1000}),
+            ),
+            node,
+        )
+
+    allowed, rejected = pgm.permit([gpod("m0", "n0"), gpod("m1", None)])
+    assert allowed == []
+    assert {p.meta.name for p in rejected} == {"m0", "m1"}
+
+
+def test_sync_status_stamps_guaranteed_allocated_child_request():
+    """Reference updateElasticQuotaStatusIfChanged stamps runtime,
+    request, child-request, guaranteed and allocated
+    (quota_info.go:62-67: leaf allocated = admitted usage; guaranteed =
+    max(allocated, min); parent allocated = Σ children guaranteed)."""
+    import json
+
+    from koordinator_tpu.api.types import ElasticQuota
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+    snap = ClusterSnapshot()
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100}
+    )
+    parent = ElasticQuota(
+        meta=ObjectMeta(name="root-q"),
+        min={ext.RES_CPU: 40, ext.RES_MEMORY: 40},
+        max={ext.RES_CPU: 100, ext.RES_MEMORY: 100},
+        is_parent=True,
+    )
+    child = ElasticQuota(
+        meta=ObjectMeta(name="leaf-q"),
+        min={ext.RES_CPU: 10, ext.RES_MEMORY: 10},
+        max={ext.RES_CPU: 50, ext.RES_MEMORY: 50},
+        parent="root-q",
+    )
+    mgr.upsert_quota(parent)
+    mgr.upsert_quota(child)
+    # admit 20 cpu of usage into the leaf
+    mgr.set_leaf_requests(
+        {"leaf-q": snap.config.res_vector({ext.RES_CPU: 20, ext.RES_MEMORY: 20})}
+    )
+    mgr.refresh_runtime()
+    li = mgr.index_of("leaf-q")
+    mgr.used[li] = snap.config.res_vector(
+        {ext.RES_CPU: 20, ext.RES_MEMORY: 20}
+    )
+
+    report = mgr.sync_status()
+    # leaf: allocated = used (20); guaranteed = max(20, min 10) = 20
+    assert report["leaf-q"]["allocated"][ext.RES_CPU] == 20.0
+    assert report["leaf-q"]["guaranteed"][ext.RES_CPU] == 20.0
+    # parent: allocated = child guaranteed (20); guaranteed = max(20, 40) = 40
+    assert report["root-q"]["allocated"][ext.RES_CPU] == 20.0
+    assert report["root-q"]["guaranteed"][ext.RES_CPU] == 40.0
+    # parent child-request = leaf's rolled-up request
+    assert report["root-q"]["childRequest"][ext.RES_CPU] == 20.0
+    # annotations stamped with the wire keys
+    ann = parent.meta.annotations
+    assert json.loads(ann[ext.ANNOTATION_QUOTA_GUARANTEED])[ext.RES_CPU] == 40.0
+    assert json.loads(ann[ext.ANNOTATION_QUOTA_ALLOCATED])[ext.RES_CPU] == 20.0
+    assert ext.ANNOTATION_QUOTA_CHILD_REQUEST in ann
+
+
+def test_limit_request_propagation_caps_at_child_max():
+    """Reference recursiveUpdateGroupTreeWithDeltaRequest
+    (group_quota_manager.go:196-224): what a quota demands from its
+    parent is min(request, max) — a child requesting over its own max
+    must not inflate its parent's share against a sibling tree."""
+    from koordinator_tpu.api.types import ElasticQuota
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+    snap = ClusterSnapshot()
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100}
+    )
+
+    def quota(name, maxv, parent=""):
+        return ElasticQuota(
+            meta=ObjectMeta(name=name),
+            min={ext.RES_CPU: 0, ext.RES_MEMORY: 0},
+            max={ext.RES_CPU: maxv, ext.RES_MEMORY: maxv},
+            parent=parent,
+            is_parent=not parent,
+        )
+
+    # two sibling parents under the root pool; pa's only child is capped
+    # at max 20 but demands 90
+    mgr.upsert_quota(quota("pa", 100))
+    mgr.upsert_quota(quota("pb", 100))
+    mgr.upsert_quota(quota("leaf-a", 20, parent="pa"))
+    mgr.upsert_quota(quota("leaf-b", 100, parent="pb"))
+    mgr.set_leaf_requests(
+        {
+            "leaf-a": snap.config.res_vector(
+                {ext.RES_CPU: 90, ext.RES_MEMORY: 90}
+            ),
+            "leaf-b": snap.config.res_vector(
+                {ext.RES_CPU: 90, ext.RES_MEMORY: 90}
+            ),
+        }
+    )
+    rt = mgr.refresh_runtime()
+    # pa's effective demand is 20 (leaf-a's limitRequest), so pb gets the
+    # rest of the pool — not a 50/50 inflated split
+    assert rt[mgr.index_of("pa")][0] <= 21.0
+    assert rt[mgr.index_of("pb")][0] >= 79.0
+    # the leaf's own request/childRequest stay uncapped (raw pod demand);
+    # the parent sees only the capped propagation
+    report = mgr.sync_status()
+    assert report["leaf-a"]["childRequest"][ext.RES_CPU] == 90.0
+    assert report["leaf-a"]["request"][ext.RES_CPU] == 90.0
+    assert report["pa"]["request"][ext.RES_CPU] == 20.0
+
+
+def test_pods_on_non_leaf_quota_still_counted():
+    """A pod labeled with a PARENT quota (nothing forbids that) must
+    contribute to that quota's request — the bottom-up propagation reads
+    every level's own direct demand (the reference's SelfRequest), not
+    just childless quotas'."""
+    from koordinator_tpu.api.types import ElasticQuota
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+    snap = ClusterSnapshot()
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100}
+    )
+    mgr.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="par"),
+            min={ext.RES_CPU: 0, ext.RES_MEMORY: 0},
+            max={ext.RES_CPU: 100, ext.RES_MEMORY: 100},
+            is_parent=True,
+        )
+    )
+    mgr.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="kid"),
+            min={ext.RES_CPU: 0, ext.RES_MEMORY: 0},
+            max={ext.RES_CPU: 100, ext.RES_MEMORY: 100},
+            parent="par",
+        )
+    )
+    mgr.set_leaf_requests(
+        {
+            "par": snap.config.res_vector({ext.RES_CPU: 30, ext.RES_MEMORY: 30}),
+            "kid": snap.config.res_vector({ext.RES_CPU: 10, ext.RES_MEMORY: 10}),
+        }
+    )
+    rt = mgr.refresh_runtime()
+    # par's demand = own 30 + kid's 10
+    assert mgr.requests[mgr.index_of("par")][0] == 40.0
+    assert rt[mgr.index_of("par")][0] >= 40.0
+
+
+def test_shared_weight_wire_annotation_overrides():
+    """AnnotationSharedWeight (elastic_quota.go:95-105): a valid non-zero
+    JSON resource list on the quota object overrides the typed field."""
+    import json
+
+    from koordinator_tpu.api.types import ElasticQuota
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+    snap = ClusterSnapshot()
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 90, ext.RES_MEMORY: 90}
+    )
+    for name, weight in (("wa", 1.0), ("wb", 2.0)):
+        q = ElasticQuota(
+            meta=ObjectMeta(
+                name=name,
+                annotations={
+                    ext.ANNOTATION_QUOTA_SHARED_WEIGHT: json.dumps(
+                        {ext.RES_CPU: weight, ext.RES_MEMORY: weight}
+                    )
+                },
+            ),
+            min={ext.RES_CPU: 0, ext.RES_MEMORY: 0},
+            max={ext.RES_CPU: 90, ext.RES_MEMORY: 90},
+        )
+        mgr.upsert_quota(q)
+    mgr.set_leaf_requests(
+        {
+            "wa": snap.config.res_vector({ext.RES_CPU: 90, ext.RES_MEMORY: 90}),
+            "wb": snap.config.res_vector({ext.RES_CPU: 90, ext.RES_MEMORY: 90}),
+        }
+    )
+    rt = mgr.refresh_runtime()
+    # demand 90+90 over 90 total, weights 1:2 → 30 / 60
+    np.testing.assert_allclose(rt[mgr.index_of("wa")][0], 30.0, atol=1.5)
+    np.testing.assert_allclose(rt[mgr.index_of("wb")][0], 60.0, atol=1.5)

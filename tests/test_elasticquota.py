@@ -838,9 +838,16 @@ def test_quota_status_sync_stamps_annotations():
     assert report["team"]["runtime"][ext.RES_CPU] >= 40.0  # full min kept
     stamped = _json.loads(q.meta.annotations[ext.ANNOTATION_QUOTA_RUNTIME])
     assert stamped[ext.RES_CPU] == report["team"]["runtime"][ext.RES_CPU]
+    # allow-lent-resource=false pads the stamped request up to min — the
+    # unlent guarantee is always demanded from the parent (reference
+    # group_quota_manager.go:208-221); the raw demand survives as
+    # childRequest
     assert _json.loads(q.meta.annotations[ext.ANNOTATION_QUOTA_REQUEST])[
         ext.RES_CPU
-    ] == 10.0
+    ] == 40.0
+    assert _json.loads(
+        q.meta.annotations[ext.ANNOTATION_QUOTA_CHILD_REQUEST]
+    )[ext.RES_CPU] == 10.0
 
 
 def test_preemption_policy_never_blocks_both_preemptors():
