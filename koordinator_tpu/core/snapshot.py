@@ -164,6 +164,14 @@ class PodArrays:
     p_real: int
     #: gang id -> "namespace/name" key, parallel to gang_min rows
     gang_keys: List[str] = dataclasses.field(default_factory=list)
+    #: pod uids in row order (collected in the single lowering pass so
+    #: downstream consumers skip another per-pod walk)
+    uids: List[str] = dataclasses.field(default_factory=list)
+    #: leaf quota label per pod (None = unlabeled), row order
+    quota_names: List[Optional[str]] = dataclasses.field(default_factory=list)
+    #: rows whose estimate cannot use the vectorized request×scale path
+    #: (explicit estimate / limits / custom scaling-factor annotation)
+    est_override: Optional[np.ndarray] = None
 
     @classmethod
     def empty(cls, p_bucket: int, dims: int) -> "PodArrays":
@@ -621,19 +629,18 @@ class ClusterSnapshot:
             )
         assumed = self._assumed
         # one tolist per column: per-element numpy scalar indexing in a
-        # 10k+ iteration loop costs ~1µs each
+        # 10k+ iteration loop costs ~1µs each; list(matrix) materializes
+        # all row views in C, and the positional ctor skips kwarg parsing
         idx_l = node_idxs.tolist()
         prod_l = is_prod.tolist()
         nom_l = np.asarray(bind_nominals, np.float64).tolist()
+        req_l = list(charged_rows)
+        est_l = list(est_rows)
+        ctor = _AssumedPod
         for k, pod in enumerate(pods):
-            assumed[pod.meta.uid] = _AssumedPod(
-                node_idx=idx_l[k],
-                request=charged_rows[k],
-                estimate=est_rows[k],
-                is_prod=prod_l[k],
-                assume_time=now,
-                confirmed=confirmed,
-                bind_nominal_cpu=nom_l[k],
+            assumed[pod.meta.uid] = ctor(
+                idx_l[k], req_l[k], est_l[k], prod_l[k], now,
+                False, confirmed, nom_l[k],
             )
 
     def is_assumed(self, pod_uid: str) -> bool:
@@ -711,9 +718,19 @@ class ClusterSnapshot:
         n = len(pods)
         explicit_qos: List[Tuple[int, int]] = []
         qos_cache: Dict[str, int] = self._qos_label_cache
+        uids: List[str] = []
+        quota_names: List[Optional[str]] = []
+        est_override = np.zeros(p_bucket, bool)
+        quota_key = ext.LABEL_QUOTA_NAME
+        custom_est_key = ext.ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS
         for i, pod in enumerate(pods):
             spec = pod.spec
-            labels = pod.meta.labels
+            meta = pod.meta
+            labels = meta.labels
+            uids.append(meta.uid)
+            quota_names.append(labels.get(quota_key))
+            if spec.estimated or spec.limits or custom_est_key in meta.annotations:
+                est_override[i] = True
             priority[i] = spec.priority or 0
             whole = 0
             ratio_mem: Optional[float] = None
@@ -804,4 +821,7 @@ class ClusterSnapshot:
                 else gang_pod_mode.get(gid, False)
             )
         out.p_real = len(pods)
+        out.uids = uids
+        out.quota_names = quota_names
+        out.est_override = est_override
         return out

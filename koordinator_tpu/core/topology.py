@@ -436,6 +436,67 @@ class CPUAccumulator:
         self._owners.setdefault(owner, set()).update(result)
         return result
 
+    def take_bulk(
+        self,
+        reqs: Sequence[Tuple[str, int, CPUBindPolicy, Optional[int]]],
+    ) -> List[Optional[Set[int]]]:
+        """Batched :meth:`take` for one node's winners in commit order —
+        the per-winner cpuset assignment was the dominant host cost of
+        the NUMA bench (VERDICT r3 #1). Identical pick semantics; the
+        zone-pinned FullPCPUs hot path runs with every attribute lookup
+        and heap-validity check hoisted OUT of the per-winner loop, and a
+        winner that cannot use it falls back to :meth:`take` (after which
+        the hoisted state is re-synced)."""
+        out: List[Optional[Set[int]]] = []
+        tpc = self._threads_per_core
+        uniform = self._uniform_cores
+        numa_cap = self._numa_cap
+        heaps = self._numa_heaps()
+        starts = self._core_starts_list
+        cpu_list = self._cpu_list
+        dirty = self._dirty_positions
+        pop = heapq.heappop
+        allocated = self._allocated
+        owners = self._owners
+        default_pol = CPUBindPolicy.DEFAULT
+        full_pol = CPUBindPolicy.FULL_PCPUS
+        for owner, n_cpus, policy, numa in reqs:
+            if (
+                uniform
+                and numa is not None
+                and n_cpus <= numa_cap
+                and (policy is default_pol or policy is full_pol or tpc == 1)
+                and n_cpus % tpc == 0
+            ):
+                heap = heaps[numa]
+                k = n_cpus // tpc
+                if len(heap) >= k:
+                    result = set()
+                    for _ in range(k):
+                        base = starts[pop(heap)]
+                        for t in range(tpc):
+                            dirty.append(base + t)
+                            result.add(cpu_list[base + t])
+                    allocated |= result
+                    o = owners.get(owner)
+                    if o is None:
+                        owners[owner] = set(result)
+                    else:
+                        o |= result
+                    out.append(result)
+                    continue
+            # slow path: keep counters coherent for take(), then re-hoist
+            n_alloc = len(allocated)
+            self._free_alloc_count = n_alloc
+            self._heap_alloc_len = n_alloc
+            out.append(self.take(owner, n_cpus, policy=policy, numa=numa))
+            heaps = self._numa_heaps()
+            dirty = self._dirty_positions
+        n_alloc = len(allocated)
+        self._free_alloc_count = n_alloc
+        self._heap_alloc_len = n_alloc
+        return out
+
     def take_reserved(self, owner: str, cpu_ids: Set[int]) -> None:
         """Pre-allocate an exact cpu-id set (kubelet-reserved CPUs from
         the NodeResourceTopology report): unconditional — reserved CPUs

@@ -142,6 +142,21 @@ class SchedulerMonitor:
         with self._lock:
             self._inflight.pop(pod.meta.uid, None)
 
+    def start_batch(self, pods: Sequence[Pod], now: Optional[float] = None) -> None:
+        """One lock round for a whole cycle's admissions (the per-pod
+        lock/dict pair was a visible slice of large batches)."""
+        stamp = time.monotonic() if now is None else now
+        with self._lock:
+            inflight = self._inflight
+            for pod in pods:
+                inflight[pod.meta.uid] = (pod.meta.name, stamp)
+
+    def complete_batch(self, pods: Sequence[Pod]) -> None:
+        with self._lock:
+            pop = self._inflight.pop
+            for pod in pods:
+                pop(pod.meta.uid, None)
+
     def sweep(self, now: Optional[float] = None) -> List[str]:
         """Returns names of timed-out pods; call at period_s cadence."""
         now = time.monotonic() if now is None else now

@@ -23,12 +23,20 @@ from ...api.types import Pod, PodGroup
 
 
 def gang_key_of(pod: Pod) -> Optional[str]:
+    # memoized on the pod: gang membership is fixed at pod creation
+    # (the reference parses it once at gang creation, gang.go:128-132)
+    # and this accessor runs several times per pod per cycle on the hot
+    # commit path
+    try:
+        return pod._gang_key
+    except AttributeError:
+        pass
     gang = pod.meta.annotations.get(
         ext.ANNOTATION_GANG_NAME
     ) or pod.meta.labels.get(ext.LABEL_GANG_NAME)
-    if not gang:
-        return None
-    return f"{pod.meta.namespace}/{gang}"
+    key = None if not gang else f"{pod.meta.namespace}/{gang}"
+    pod._gang_key = key
+    return key
 
 
 def gang_group_of(pod: Pod, own_key: str) -> frozenset:

@@ -152,6 +152,8 @@ class GroupQuotaManager:
         #: memoized leaf-to-root index paths; rebuilt on tree mutations
         #: (chain_of was a visible slice of the per-winner commit loop)
         self._chain_cache: Dict[str, List[int]] = {}
+        #: name -> lowered [MAX_LEVELS] chain row (chains_for_names)
+        self._chain_row_cache: Dict[str, np.ndarray] = {}
 
     # ---- tree maintenance ----
 
@@ -190,6 +192,7 @@ class GroupQuotaManager:
                 node.children.append(other)
         self._dirty = True
         self._chain_cache.clear()
+        self._chain_row_cache.clear()
 
     def remove_quota(self, name: str) -> None:
         node = self._nodes.pop(name, None)
@@ -216,6 +219,7 @@ class GroupQuotaManager:
                 new_child[new_i] = self.child_requests[oi]
             n.index = new_i
         self._chain_cache.clear()
+        self._chain_row_cache.clear()
         self.used, self.requests = new_used, new_req
         self.child_requests = new_child
         self._dirty = True
@@ -519,8 +523,15 @@ class GroupQuotaManager:
             idx = node.index
             if node.children:
                 alloc = np.zeros(d, np.float32)
+                child_used = np.zeros(d, np.float32)
                 for child in node.children:
-                    alloc += visit(child)
+                    alloc = alloc + visit(child)
+                    child_used += self.used[self._nodes[child].index]
+                # a parent's own DIRECT pod usage (pods labeled with the
+                # parent itself — this tree supports them) counts too:
+                # used[parent] is the chain rollup, so self-used is the
+                # difference vs the children's rolled-up used
+                alloc = alloc + np.maximum(self.used[idx] - child_used, 0.0)
             else:
                 alloc = self.used[idx].copy()
             allocated[idx] = alloc
@@ -593,10 +604,37 @@ class GroupQuotaManager:
         return report
 
     def chains_for_pods(self, pods: Sequence[Pod], p_bucket: int) -> np.ndarray:
+        return self.chains_for_names(
+            [quota_name_of(p) for p in pods], p_bucket
+        )
+
+    def chains_for_names(
+        self, names: Sequence[Optional[str]], p_bucket: int
+    ) -> np.ndarray:
+        """Lowered chain rows from pre-collected quota labels. Clusters
+        have few distinct quotas, so rows are built once per distinct
+        name (memoized alongside the index-path cache) and scattered —
+        the per-pod ``chain_of`` walk was a visible slice of large quota
+        batches."""
         chains = np.full((p_bucket, MAX_LEVELS), -1, np.int32)
-        for i, pod in enumerate(pods):
-            for level, idx in enumerate(self.chain_of(quota_name_of(pod))):
-                chains[i, level] = idx
+        cache = self._chain_row_cache
+        groups: Dict[str, List[int]] = {}
+        for i, nm in enumerate(names):
+            if nm is None:
+                continue
+            lst = groups.get(nm)
+            if lst is None:
+                groups[nm] = [i]
+            else:
+                lst.append(i)
+        for nm, idxs in groups.items():
+            row = cache.get(nm)
+            if row is None:
+                row = np.full((MAX_LEVELS,), -1, np.int32)
+                for level, idx in enumerate(self.chain_of(nm)):
+                    row[level] = idx
+                cache[nm] = row
+            chains[idxs] = row
         return chains
 
 

@@ -369,6 +369,150 @@ class NUMAManager:
             return '{"numaNodeResources": [{"node": %d}]}' % zone
         return ""
 
+    def allocate_batch(
+        self,
+        uids: List[str],
+        annotations: List[Mapping[str, str]],
+        node_names: List[str],
+        cpu_milli: List[float],
+        mem_mib: List[float],
+        bind: List[bool],
+    ) -> List[Optional[str]]:
+        """Batched :meth:`allocate_lowered` over one chunk's winners in
+        commit order (VERDICT r3 #1: the per-winner Python loop was the
+        NUMA scenario's host wall). Winners are grouped by node — per-node
+        state is independent, so only the order WITHIN a node matters and
+        the input order is preserved there. Per node, the zone pick, zone
+        charge and cpuset take run with node state hoisted out of the
+        loop and cpusets taken through ``CPUAccumulator.take_bulk``.
+        Assumes the caller ran ``arrays()`` earlier this cycle
+        (``synced=True`` semantics of :meth:`allocate_lowered`)."""
+        n = len(uids)
+        results: List[Optional[str]] = [""] * n
+        by_node: Dict[str, List[int]] = {}
+        for i, name in enumerate(node_names):
+            lst = by_node.get(name)
+            if lst is None:
+                by_node[name] = [i]
+            else:
+                lst.append(i)
+        single = int(NUMAPolicy.SINGLE_NUMA_NODE)
+        spec_key = ext.ANNOTATION_RESOURCE_SPEC
+        default_pol = CPUBindPolicy.DEFAULT
+        for name, rows_i in by_node.items():
+            st = self._nodes.get(name)
+            if st is None:
+                continue
+            policy_single = int(st.policy) == single
+            amp = st.cpu_amp
+            zone_alloc = st.zone_alloc
+            zone_used = st.zone_used
+            owners = st.owners
+            # phase 1: zone pick + zone charge per winner (sequential
+            # within the node — later winners see earlier charges)
+            zones: List[int] = []
+            reqs0: List[float] = []
+            take_reqs = []
+            take_rows: List[int] = []
+            for i in rows_i:
+                b = bind[i]
+                if not (policy_single or b):
+                    zones.append(-1)
+                    reqs0.append(0.0)
+                    continue
+                req0 = cpu_milli[i]
+                if b and amp > 1.0:
+                    req0 *= amp
+                cpu_need = req0 - 1e-3
+                mem_need = mem_mib[i] - 1e-3
+                best_util = None
+                zone = -1
+                for z, alloc in enumerate(zone_alloc):
+                    used = zone_used[z]
+                    if (
+                        alloc[0] - used[0] < cpu_need
+                        or alloc[1] - used[1] < mem_need
+                    ):
+                        continue
+                    util = (used[0] + 1.0) / (alloc[0] + 1.0)
+                    if best_util is None or util < best_util:
+                        best_util = util
+                        zone = z
+                if zone < 0 and policy_single:
+                    results[i] = None
+                    zones.append(-2)        # rejected
+                    reqs0.append(0.0)
+                    continue
+                zones.append(zone)
+                reqs0.append(req0)
+                if zone >= 0:
+                    # charge now: the NEXT winner's pick must see it
+                    used = zone_used[zone]
+                    used[0] += req0
+                    used[1] += mem_mib[i]
+                if b:
+                    raw = annotations[i].get(spec_key)
+                    if raw:
+                        try:
+                            pol = CPUBindPolicy(
+                                json.loads(raw).get(
+                                    "preferredCPUBindPolicy", "Default"
+                                )
+                            )
+                        except (ValueError, KeyError, AttributeError, TypeError):
+                            pol = default_pol
+                    else:
+                        pol = default_pol
+                    take_reqs.append(
+                        (
+                            uids[i],
+                            int(cpu_milli[i] // 1000),
+                            pol,
+                            zone if zone >= 0 else None,
+                        )
+                    )
+                    take_rows.append(i)
+            # phase 2: bulk cpuset takes for this node's bind winners
+            if take_reqs:
+                cpusets = st.accumulator.take_bulk(take_reqs)
+            else:
+                cpusets = []
+            # phase 3: payloads + owner records (+ rollback of failed takes)
+            k = 0
+            for j, i in enumerate(rows_i):
+                zone = zones[j]
+                if zone == -2:
+                    continue
+                cpuset_str = None
+                if bind[i]:
+                    cpuset = cpusets[k]
+                    k += 1
+                    if cpuset is None:
+                        # roll the zone charge back — nothing was taken
+                        if zone >= 0:
+                            used = zone_used[zone]
+                            used[0] -= reqs0[j]
+                            used[1] -= mem_mib[i]
+                        results[i] = None
+                        continue
+                    cpuset_str = format_cpuset_sorted(sorted(cpuset))
+                if zone >= 0:
+                    owners[uids[i]] = (
+                        zone,
+                        [reqs0[j], mem_mib[i]],
+                        cpu_milli[i] if bind[i] else 0.0,
+                    )
+                if cpuset_str is not None and zone >= 0:
+                    results[i] = (
+                        '{"cpuset": "%s", "numaNodeResources": [{"node": %d}]}'
+                        % (cpuset_str, zone)
+                    )
+                elif cpuset_str is not None:
+                    results[i] = '{"cpuset": "%s"}' % cpuset_str
+                elif zone >= 0:
+                    results[i] = '{"numaNodeResources": [{"node": %d}]}' % zone
+        return results
+
     def reset_allocations(self) -> None:
         """Free every zone and cpuset hold (full-resync path)."""
         from ...core.topology import CPUAccumulator

@@ -355,6 +355,93 @@ def test_pods_on_non_leaf_quota_still_counted():
     assert rt[mgr.index_of("par")][0] >= 40.0
 
 
+def test_device_allocate_batch_mixed_fractional_and_whole():
+    """A batch mixing a fractional-GPU pod (fallback path, which rebinds
+    the node's free lists) and a whole-GPU pod (lean path) on one node
+    must keep one coherent accounting view — the lean path re-hoists
+    after every fallback."""
+    from koordinator_tpu.api.types import Device, DeviceInfo
+    from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("g0", cpu=128000, mem=1 << 20))
+    dm = DeviceManager(snap)
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="g0"),
+            devices=[DeviceInfo(dev_type="gpu", minor=m) for m in range(3)],
+        )
+    )
+    res = dm.allocate_batch(
+        uids=["frac", "whole"],
+        annotations=[{}, {}],
+        node_names=["g0", "g0"],
+        whole_l=[0, 2],
+        share_l=[50.0, 0.0],
+        rdma_l=[0, 0],
+        fpga_l=[0, 0],
+        requests_l=[None, None],
+    )
+    assert res[0] is not None and res[1] is not None
+    st = dm._nodes["g0"]
+    # the fractional pod holds 50% of one minor, the whole pod holds the
+    # two OTHER minors entirely: exactly one minor at 50, two at 0
+    assert sorted(st.gpu_free) == [0.0, 0.0, 50.0]
+    frac_minor = st.owners["frac"][0][0]
+    whole_minors = {p[0] for p in st.owners["whole"]}
+    assert frac_minor not in whole_minors
+    # a third whole-GPU pod must now fail — nothing fully free remains
+    res2 = dm.allocate_batch(
+        uids=["late"],
+        annotations=[{}],
+        node_names=["g0"],
+        whole_l=[1],
+        share_l=[0.0],
+        rdma_l=[0],
+        fpga_l=[0],
+        requests_l=[None],
+    )
+    assert res2[0] is None
+
+
+def test_guaranteed_allocated_counts_parent_direct_usage():
+    """A parent quota's own direct pod usage (pods labeled with the
+    parent) must appear in its allocated/guaranteed — not only the
+    children's rollup (quota_info.go:62-67 + this tree's SelfRequest
+    support)."""
+    from koordinator_tpu.api.types import ElasticQuota
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+    snap = ClusterSnapshot()
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 100, ext.RES_MEMORY: 100}
+    )
+    mgr.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="par"),
+            min={ext.RES_CPU: 5, ext.RES_MEMORY: 5},
+            max={ext.RES_CPU: 100, ext.RES_MEMORY: 100},
+            is_parent=True,
+        )
+    )
+    mgr.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="kid"),
+            min={ext.RES_CPU: 0, ext.RES_MEMORY: 0},
+            max={ext.RES_CPU: 100, ext.RES_MEMORY: 100},
+            parent="par",
+        )
+    )
+    # charge 10 into the child and 30 DIRECTLY into the parent
+    mgr.charge("kid", {ext.RES_CPU: 10, ext.RES_MEMORY: 10})
+    mgr.charge("par", {ext.RES_CPU: 30, ext.RES_MEMORY: 30})
+    guaranteed, allocated = mgr.guaranteed_allocated()
+    pi = mgr.index_of("par")
+    # parent allocated = child guaranteed (10) + own direct used (30)
+    assert allocated[pi][0] == 40.0
+    assert guaranteed[pi][0] == 40.0
+
+
 def test_shared_weight_wire_annotation_overrides():
     """AnnotationSharedWeight (elastic_quota.go:95-105): a valid non-zero
     JSON resource list on the quota object overrides the typed field."""
