@@ -340,6 +340,47 @@ ANNOTATION_GPU_PARTITIONS = f"scheduling.{DOMAIN}/gpu-partitions"
 LABEL_GPU_PARTITION_POLICY = f"node.{DOMAIN}/gpu-partition-policy"
 LABEL_GPU_MODEL = f"node.{DOMAIN}/gpu-model"
 ANNOTATION_NODE_CPU_TOPOLOGY = f"node.{DOMAIN}/cpu-topology"
+#: LS/K8s-Burstable CPU shared pools per NUMA node, computed by the
+#: koordlet from the topology minus every cpuset-bound pod's CPUs
+#: (reference ``apis/extension/numa_aware.go:46-48``,
+#: ``states_noderesourcetopology.go`` calCPUSharePools)
+ANNOTATION_NODE_CPU_SHARED_POOLS = f"node.{DOMAIN}/cpu-shared-pools"
+#: BE/K8s-BestEffort shared pools: like the LS pools but only LSE pods'
+#: CPUs are carved out (BE may ride LSR cores, never LSE cores)
+ANNOTATION_NODE_BE_CPU_SHARED_POOLS = f"node.{DOMAIN}/be-cpu-shared-pools"
+#: kubelet cpu-manager policy/options/reservedCPUs read back from the
+#: kubelet state file (AnnotationKubeletCPUManagerPolicy)
+ANNOTATION_KUBELET_CPU_MANAGER_POLICY = "kubelet.koordinator.sh/cpu-manager-policy"
+#: K8s Guaranteed pods' kubelet-static cpusets (AnnotationNodeCPUAllocs):
+#: the scheduler must not double-allocate these CPUs
+ANNOTATION_NODE_CPU_ALLOCS = f"node.{DOMAIN}/pod-cpu-allocs"
+#: node-level bind-policy constraint (LabelNodeCPUBindPolicy):
+#: FullPCPUsOnly forces whole physical cores for every cpuset pod
+LABEL_NODE_CPU_BIND_POLICY = f"node.{DOMAIN}/cpu-bind-policy"
+NODE_CPU_BIND_POLICY_FULL_PCPUS_ONLY = "FullPCPUsOnly"
+NODE_CPU_BIND_POLICY_SPREAD_BY_PCPUS = "SpreadByPCPUs"
+#: node-level NUMA allocate strategy (LabelNodeNUMAAllocateStrategy):
+#: MostAllocated = bin-pack zones, LeastAllocated = spread (the plugin
+#: default unless the scoring strategy is MostAllocated — reference
+#: GetDefaultNUMAAllocateStrategy, nodenumaresource/util.go:33-39)
+LABEL_NODE_NUMA_ALLOCATE_STRATEGY = f"node.{DOMAIN}/numa-allocate-strategy"
+NODE_NUMA_STRATEGY_MOST_ALLOCATED = "MostAllocated"
+NODE_NUMA_STRATEGY_LEAST_ALLOCATED = "LeastAllocated"
+#: pod-level NUMA requirement API (AnnotationNUMATopologySpec,
+#: ``numa_aware.go:29-31``): the pod's own topology policy (+ exclusive
+#: preference), overriding the node's label for this pod's admission
+ANNOTATION_NUMA_TOPOLOGY_SPEC = f"scheduling.{DOMAIN}/numa-topology-spec"
+#: SYSTEM-QoS cpuset carve-out (AnnotationNodeSystemQOSResource)
+ANNOTATION_NODE_SYSTEM_QOS_RESOURCE = f"node.{DOMAIN}/system-qos-resource"
+#: total node network bandwidth in bps (AnnotationNodeBandwidth)
+ANNOTATION_NODE_BANDWIDTH = f"node.{DOMAIN}/network-bandwidth"
+#: batch requests/limits per container, stamped by the pod mutating
+#: webhook so CRI-side consumers (runtime proxy, koordlet hooks) see the
+#: original extended-resource spec (AnnotationExtendedResourceSpec,
+#: ``apis/extension/resource.go:33-36``)
+ANNOTATION_EXTENDED_RESOURCE_SPEC = f"node.{DOMAIN}/extended-resource-spec"
+#: pods opting into in-place mutating updates (LabelPodMutatingUpdate)
+LABEL_POD_MUTATING_UPDATE = f"pod.{DOMAIN}/mutating-update"
 ANNOTATION_NODE_RAW_ALLOCATABLE = f"node.{DOMAIN}/raw-allocatable"
 ANNOTATION_NODE_AMPLIFICATION = f"node.{DOMAIN}/resource-amplification-ratio"
 ANNOTATION_NETWORK_QOS = f"{DOMAIN}/networkQOS"
@@ -856,3 +897,100 @@ def qos_for_priority(prio: PriorityClass) -> QoSClass:
     if prio in (PriorityClass.PROD, PriorityClass.MID):
         return QoSClass.LS
     return QoSClass.NONE
+
+
+# ---- CPU shared pools / kubelet state / NUMA spec wire accessors ----
+# (reference ``apis/extension/numa_aware.go`` GetNodeCPUSharePools /
+# GetNodeBECPUSharePools / GetKubeletCPUManagerPolicy /
+# GetNUMATopologySpec, ``system_qos.go`` GetSystemQOSResource,
+# ``node_qos.go`` GetNodeTotalBandwidth, ``resource.go``
+# Get/SetExtendedResourceSpec)
+
+
+def parse_cpu_shared_pools(annotations: Mapping[str, str], be: bool = False):
+    """[{"socket": s, "node": n, "cpuset": "0-3,8"}] — the LS (or BE)
+    shared pools the koordlet computed for this node; [] when absent or
+    malformed."""
+    key = (
+        ANNOTATION_NODE_BE_CPU_SHARED_POOLS
+        if be
+        else ANNOTATION_NODE_CPU_SHARED_POOLS
+    )
+    pools = _parse_json_annotation(annotations, key, list)
+    if pools is None:
+        return []
+    return [p for p in pools if isinstance(p, dict)]
+
+
+def format_cpu_shared_pools(pools) -> str:
+    import json as _json
+
+    return _json.dumps(pools, separators=(",", ":"))
+
+
+def parse_kubelet_cpu_manager_policy(annotations: Mapping[str, str]):
+    """{"policy": "none"|"static", "options": {..}, "reservedCPUs": ".."}
+    (KubeletCPUManagerPolicy); None when unset/malformed."""
+    return _parse_dict_annotation(
+        annotations, ANNOTATION_KUBELET_CPU_MANAGER_POLICY
+    )
+
+
+def parse_node_cpu_allocs(annotations: Mapping[str, str]):
+    """[{"namespace":.., "name":.., "uid":.., "cpuset": ".."}] — kubelet
+    static-policy Guaranteed pods' exclusive cpusets (PodCPUAlloc)."""
+    allocs = _parse_json_annotation(annotations, ANNOTATION_NODE_CPU_ALLOCS, list)
+    if allocs is None:
+        return []
+    return [a for a in allocs if isinstance(a, dict) and a.get("cpuset")]
+
+
+def parse_numa_topology_spec(annotations: Mapping[str, str]):
+    """Pod-level NUMA requirement (NUMATopologySpec): returns
+    {"numaTopologyPolicy": str, "singleNUMANodeExclusive": str} or None
+    when the annotation is absent/malformed."""
+    return _parse_dict_annotation(annotations, ANNOTATION_NUMA_TOPOLOGY_SPEC)
+
+
+def parse_system_qos_resource(annotations: Mapping[str, str]):
+    """SystemQOSResource {"cpuset": .., "cpusetExclusive": bool}; None
+    when unset. Exclusivity defaults True (system_qos.go:35-39)."""
+    spec = _parse_dict_annotation(
+        annotations, ANNOTATION_NODE_SYSTEM_QOS_RESOURCE
+    )
+    if spec is None or not spec.get("cpuset"):
+        return None
+    if "cpusetExclusive" not in spec:
+        spec = dict(spec)
+        spec["cpusetExclusive"] = True
+    return spec
+
+
+def parse_node_bandwidth(annotations: Mapping[str, str]) -> float:
+    """Total node network bandwidth in bps (0 = unset/malformed)."""
+    raw = annotations.get(ANNOTATION_NODE_BANDWIDTH)
+    if not raw:
+        return 0.0
+    try:
+        return max(float(raw), 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def parse_extended_resource_spec(annotations: Mapping[str, str]):
+    """ExtendedResourceSpec {"containers": {name: {"requests": {..},
+    "limits": {..}}}} — the batch requests/limits the mutating webhook
+    dumped for CRI-side consumers; {} when absent."""
+    spec = _parse_dict_annotation(
+        annotations, ANNOTATION_EXTENDED_RESOURCE_SPEC
+    )
+    if spec is None:
+        return {}
+    containers = spec.get("containers")
+    return containers if isinstance(containers, dict) else {}
+
+
+def format_extended_resource_spec(containers: Mapping[str, Mapping]) -> str:
+    import json as _json
+
+    return _json.dumps({"containers": dict(containers)}, separators=(",", ":"))

@@ -172,6 +172,13 @@ class PodArrays:
     #: rows whose estimate cannot use the vectorized request×scale path
     #: (explicit estimate / limits / custom scaling-factor annotation)
     est_override: Optional[np.ndarray] = None
+    #: pod REQUIRES single-NUMA placement via the numa-topology-spec
+    #: annotation (AnnotationNUMATopologySpec, ``numa_aware.go:29-31``) —
+    #: independent of the LSR/LSE cpu-bind predicate
+    numa_required: Optional[np.ndarray] = None
+    #: quota non-preemptible pods (LabelPreemptible=false): admission
+    #: additionally bounds them by quota MIN (``plugin.go:252-262``)
+    non_preemptible: Optional[np.ndarray] = None
 
     @classmethod
     def empty(cls, p_bucket: int, dims: int) -> "PodArrays":
@@ -721,16 +728,32 @@ class ClusterSnapshot:
         uids: List[str] = []
         quota_names: List[Optional[str]] = []
         est_override = np.zeros(p_bucket, bool)
+        numa_required = np.zeros(p_bucket, bool)
+        non_preemptible = np.zeros(p_bucket, bool)
+        preemptible_key = ext.LABEL_PREEMPTIBLE
         quota_key = ext.LABEL_QUOTA_NAME
         custom_est_key = ext.ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS
+        numa_spec_key = ext.ANNOTATION_NUMA_TOPOLOGY_SPEC
         for i, pod in enumerate(pods):
             spec = pod.spec
             meta = pod.meta
             labels = meta.labels
             uids.append(meta.uid)
             quota_names.append(labels.get(quota_key))
+            if labels.get(preemptible_key) == "false":
+                non_preemptible[i] = True
             if spec.estimated or spec.limits or custom_est_key in meta.annotations:
                 est_override[i] = True
+            if numa_spec_key in meta.annotations:
+                # pod-level NUMA requirement API (numa_aware.go:29-31):
+                # SingleNUMANode requires a single-zone fit for THIS pod
+                # regardless of the node's own policy label
+                numa_spec = ext.parse_numa_topology_spec(meta.annotations)
+                if (
+                    numa_spec
+                    and numa_spec.get("numaTopologyPolicy") == "SingleNUMANode"
+                ):
+                    numa_required[i] = True
             priority[i] = spec.priority or 0
             whole = 0
             ratio_mem: Optional[float] = None
@@ -824,4 +847,6 @@ class ClusterSnapshot:
         out.uids = uids
         out.quota_names = quota_names
         out.est_override = est_override
+        out.numa_required = numa_required
+        out.non_preemptible = non_preemptible
         return out

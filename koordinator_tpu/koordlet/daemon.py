@@ -185,6 +185,20 @@ class Koordlet:
             )
         )
         self.reconciler = hooks.Reconciler(self.executor, probes=self.probes)
+        #: lifecycle-path NRI server sharing the executor; kept in sync
+        #: with the reconciler's cpuset rule below so pre-start writes use
+        #: the same shared pools as the periodic reconcile
+        self.nri = hooks.NRIServer(self.executor)
+        # the cpuset shared-pool rule re-parses on every topology report
+        # (reference hooks/cpuset parseRule on the NodeTopology callback)
+
+        def _on_topology(topo):
+            self.reconciler.set_topology(topo)
+            self.nri.set_topology(topo)
+
+        self.informer.callbacks.register(
+            StateType.NODE_TOPOLOGY, "cpuset-rule", _on_topology
+        )
         self.node_slo: NodeSLO = NodeSLO(meta=ObjectMeta(name=self.config.node_name))
         self.pods: List[Pod] = []
         self._last_report = 0.0

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from ..api import extension as ext
 from ..api.extension import QoSClass
@@ -99,18 +99,107 @@ def batch_resource_plan(
     return plan
 
 
-def cpuset_plan(pod: Pod) -> List[Tuple[str, str, str]]:
+@dataclasses.dataclass
+class CpusetRule:
+    """hooks/cpuset rule state parsed from the NodeResourceTopology
+    annotations (reference ``hooks/cpuset/rule.go`` parseRule): the LS
+    and BE CPU shared pools the koordlet computed, the kubelet
+    cpu-manager policy, and the SYSTEM-QoS carve-out."""
+
+    share_pools: List[Mapping] = dataclasses.field(default_factory=list)
+    be_share_pools: List[Mapping] = dataclasses.field(default_factory=list)
+    kubelet_policy: str = "none"
+    system_qos_cpuset: str = ""
+    #: features.BECPUManager gate: BE pods with numa-aware allocations
+    #: use the BE pools instead of getting cleared
+    be_cpu_manager: bool = False
+
+    @classmethod
+    def from_topology(cls, topo, be_cpu_manager: bool = False) -> "CpusetRule":
+        ann = topo.meta.annotations or {}
+        kubelet = ext.parse_kubelet_cpu_manager_policy(ann) or {}
+        sysqos = ext.parse_system_qos_resource(ann) or {}
+        return cls(
+            share_pools=ext.parse_cpu_shared_pools(ann),
+            be_share_pools=ext.parse_cpu_shared_pools(ann, be=True),
+            kubelet_policy=str(kubelet.get("policy", "none")),
+            system_qos_cpuset=str(sysqos.get("cpuset", "")),
+            be_cpu_manager=be_cpu_manager,
+        )
+
+    def _pools_cpuset(self, pools: List[Mapping], numa_nodes=None) -> str:
+        return ",".join(
+            str(p.get("cpuset", ""))
+            for p in pools
+            if p.get("cpuset")
+            and (numa_nodes is None or p.get("node") in numa_nodes)
+        )
+
+    def container_cpuset(self, pod: Pod) -> Optional[str]:
+        """``rule.go:47-146`` getContainerCPUSet decision table:
+
+        - numa-aware allocation (scheduler stamped NUMA zones): LS-side
+          pods take the LS pools of THOSE zones; BE pods take the BE
+          pools of those zones when the BECPUManager gate is on;
+        - SYSTEM QoS with a configured carve-out: the system cpuset;
+        - LS: every LS shared pool;
+        - BE/besteffort: cleared ("" — cpu-suppress owns the group);
+        - no QoS label: all pools under the kubelet *none* policy, hands
+          off (None) under *static* (kubelet already pinned them).
+        """
+        alloc = {}
+        raw = pod.meta.annotations.get(ext.ANNOTATION_RESOURCE_STATUS)
+        if raw:
+            try:
+                alloc = json.loads(raw)
+            except (ValueError, TypeError):
+                alloc = {}
+        numa_nodes = {
+            e.get("node")
+            for e in alloc.get("numaNodeResources", []) or []
+            if isinstance(e, dict) and e.get("node") is not None
+        }
+        qos = pod.qos
+        if numa_nodes:
+            if qos == QoSClass.BE:
+                if self.be_cpu_manager:
+                    return self._pools_cpuset(self.be_share_pools, numa_nodes)
+            else:
+                return self._pools_cpuset(self.share_pools, numa_nodes)
+        if qos == QoSClass.SYSTEM and self.system_qos_cpuset:
+            return self.system_qos_cpuset
+        if qos == QoSClass.LS:
+            return self._pools_cpuset(self.share_pools)
+        if qos == QoSClass.BE:
+            return ""
+        if self.kubelet_policy == "static":
+            return None
+        return self._pools_cpuset(self.share_pools)
+
+
+def cpuset_plan(
+    pod: Pod, rule: Optional[CpusetRule] = None
+) -> List[Tuple[str, str, str]]:
+    """cpuset hook: an exclusive cpuset the scheduler stamped into
+    resource-status wins outright; otherwise the shared-pool rule decides
+    (LS pods → LS pools, BE → cleared, SYSTEM → carve-out, …). With no
+    rule (NodeResourceTopology not yet seen) only exclusive sets apply —
+    the pre-round-4 behavior."""
     raw = pod.meta.annotations.get(ext.ANNOTATION_RESOURCE_STATUS)
-    if not raw:
+    cpuset = ""
+    if raw:
+        try:
+            cpuset = json.loads(raw).get("cpuset", "")
+        except (ValueError, AttributeError, TypeError):
+            cpuset = ""
+    if cpuset:
+        return [(pod_cgroup(pod), rex.CPUSET_CPUS, cpuset)]
+    if rule is None:
         return []
-    try:
-        status = json.loads(raw)
-        cpuset = status.get("cpuset", "")
-    except (ValueError, AttributeError):
+    decided = rule.container_cpuset(pod)
+    if decided is None:
         return []
-    if not cpuset:
-        return []
-    return [(pod_cgroup(pod), rex.CPUSET_CPUS, cpuset)]
+    return [(pod_cgroup(pod), rex.CPUSET_CPUS, decided)]
 
 
 def core_sched_plan(pod: Pod) -> List[Tuple[str, str, str]]:
@@ -253,7 +342,6 @@ def rdma_mutation(pod: Pod) -> ContainerMutation:
 ALL_HOOKS = (
     group_identity_plan,
     batch_resource_plan,
-    cpuset_plan,
     core_sched_plan,
     resctrl_group_plan,
     tc_plan,
@@ -263,10 +351,15 @@ ALL_HOOKS = (
 MUTATION_HOOKS = (gpu_mutation, rdma_mutation)
 
 
-def pod_plan(pod: Pod, cpu_norm_ratio: float = 1.0) -> List[Tuple[str, str, str]]:
+def pod_plan(
+    pod: Pod,
+    cpu_norm_ratio: float = 1.0,
+    cpuset_rule: Optional[CpusetRule] = None,
+) -> List[Tuple[str, str, str]]:
     plan: List[Tuple[str, str, str]] = []
     for hook in ALL_HOOKS:
         plan.extend(hook(pod))
+    plan.extend(cpuset_plan(pod, cpuset_rule))
     plan.extend(cpu_normalization_plan(pod, cpu_norm_ratio))
     return plan
 
@@ -296,13 +389,21 @@ class Reconciler:
         #: node CPU-model performance ratio (cpunormalization hook input,
         #: published by the manager's cpunormalization plugin)
         self.cpu_norm_ratio = 1.0
+        #: shared-pool rule from the NodeResourceTopology report
+        #: (``rule.go`` parseRule); None until the first report lands
+        self.cpuset_rule: Optional[CpusetRule] = None
         self.probes = probes
         self._blocked = (
             probes.unsupported_plan_files() if probes is not None else None
         )
 
+    def set_topology(self, topo) -> None:
+        """statesinformer NODE_TOPOLOGY callback target (the reference
+        registers parseRule on the same callback)."""
+        self.cpuset_rule = CpusetRule.from_topology(topo)
+
     def render(self, pod: Pod) -> List[Tuple[str, str, str]]:
-        plan = pod_plan(pod, self.cpu_norm_ratio)
+        plan = pod_plan(pod, self.cpu_norm_ratio, self.cpuset_rule)
         if self._blocked:
             plan = [e for e in plan if e[1] not in self._blocked]
         return plan
@@ -327,11 +428,16 @@ class NRIServer:
     def __init__(self, executor: rex.ResourceExecutor):
         self.executor = executor
         self.cpu_norm_ratio = 1.0
+        self.cpuset_rule: Optional[CpusetRule] = None
+
+    def set_topology(self, topo) -> None:
+        self.cpuset_rule = CpusetRule.from_topology(topo)
 
     def run_pod_sandbox(self, pod: Pod) -> int:
         """Pre-start: tier/bvt/netcls knobs must exist before containers."""
         return self.executor.apply(
-            pod_plan(pod, self.cpu_norm_ratio), reason="nri:RunPodSandbox"
+            pod_plan(pod, self.cpu_norm_ratio, self.cpuset_rule),
+            reason="nri:RunPodSandbox",
         )
 
     def create_container(self, pod: Pod) -> ContainerMutation:

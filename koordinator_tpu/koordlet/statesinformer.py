@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-from typing import Callable, Dict, List, Optional, Protocol, Sequence
+from typing import Callable, Dict, List, Mapping, Optional, Protocol, Sequence, Tuple
 
 from ..api.types import (
     Device,
@@ -34,7 +34,7 @@ from ..api.types import (
     TopologyZone,
 )
 from ..api import extension as ext
-from ..core.topology import CPUTopology
+from ..core.topology import CPUTopology, format_cpuset_sorted
 
 
 class StateType(enum.Enum):
@@ -204,17 +204,59 @@ class StatesInformer:
 
     # ---- reporters (status writes in the reference) ----
 
+    def _cpu_shared_pools(
+        self,
+        topo: CPUTopology,
+        excluded_all: Sequence[int],
+        excluded_lse: Sequence[int],
+    ) -> Tuple[list, list]:
+        """(ls_pools, be_pools) — reference
+        ``states_noderesourcetopology.go`` calCPUSharePools: the LS pool
+        is every CPU minus ALL cpuset-bound pods' CPUs (and reserved /
+        exclusive system-QoS CPUs, already in ``excluded_all``); the BE
+        pool carves out only LSE pods' CPUs (BE may ride LSR cores,
+        never LSE). Pools are grouped per (socket, numa) with a cpuset
+        string (covertCPUsToSharePool)."""
+        excl_all = set(excluded_all)
+        excl_lse = set(excluded_lse)
+
+        def pools(excluded: set) -> list:
+            groups: Dict[Tuple[int, int], list] = {}
+            for c in topo.cpus:
+                if c.cpu_id in excluded:
+                    continue
+                groups.setdefault((c.socket, c.numa_node), []).append(c.cpu_id)
+            return [
+                {
+                    "socket": socket,
+                    "node": numa,
+                    "cpuset": format_cpuset_sorted(sorted(ids)),
+                }
+                for (socket, numa), ids in sorted(groups.items())
+            ]
+
+        return pools(excl_all), pools(excl_lse)
+
     def report_topology(
         self,
         topo: CPUTopology,
         kubelet_reserved: Sequence[int] = (),
         policy: str = "None",
         mem_per_numa_bytes: float = 0.0,
+        kubelet_policy_name: str = "none",
+        system_qos_cpuset: str = "",
+        kubelet_pod_allocs: Sequence[Mapping] = (),
     ) -> NodeResourceTopology:
         """Build + publish the NodeResourceTopology report
         (states_noderesourcetopology.go: zones from sysfs topology, kubelet
         cpu-manager state read back so the scheduler never double-allocates
-        kubelet-reserved CPUs)."""
+        kubelet-reserved CPUs). The report's annotations carry the full
+        numa-aware protocol: LS/BE CPU shared pools (computed from the
+        topology minus cpuset-bound pods — ``numa_aware.go:46-51``),
+        the kubelet cpu-manager policy, kubelet static pod-cpu-allocs,
+        and the system-QoS carve-out."""
+        from ..core.topology import parse_cpuset
+
         by_numa: Dict[int, int] = {}
         for info in topo.cpus:
             by_numa[info.numa_node] = by_numa.get(info.numa_node, 0) + 1
@@ -232,8 +274,62 @@ class StatesInformer:
             )
             for numa, cnt in sorted(by_numa.items())
         ]
+        # exclusions: kubelet-reserved + kubelet static allocs + exclusive
+        # system-QoS cpuset come out of BOTH pools; per-pod cpusets come
+        # out of the LS pool always and the BE pool only for LSE pods
+        import json as _json
+
+        base_excluded: set = set(kubelet_reserved)
+        for alloc in kubelet_pod_allocs:
+            base_excluded |= parse_cpuset(str(alloc.get("cpuset", "")))
+        if system_qos_cpuset:
+            base_excluded |= parse_cpuset(system_qos_cpuset)
+        excluded_all = set(base_excluded)
+        excluded_lse = set(base_excluded)
+        with self._lock:
+            pods = list(self._pods)
+        for pod in pods:
+            raw = pod.meta.annotations.get(ext.ANNOTATION_RESOURCE_STATUS)
+            if not raw:
+                continue
+            try:
+                cpus = parse_cpuset(_json.loads(raw).get("cpuset", ""))
+            except (ValueError, AttributeError, TypeError):
+                continue
+            if not cpus:
+                continue
+            excluded_all |= cpus
+            if pod.qos == ext.QoSClass.LSE:
+                excluded_lse |= cpus
+        ls_pools, be_pools = self._cpu_shared_pools(
+            topo, sorted(excluded_all), sorted(excluded_lse)
+        )
+        annotations = {
+            ext.ANNOTATION_NODE_CPU_SHARED_POOLS: ext.format_cpu_shared_pools(
+                ls_pools
+            ),
+            ext.ANNOTATION_NODE_BE_CPU_SHARED_POOLS: ext.format_cpu_shared_pools(
+                be_pools
+            ),
+            ext.ANNOTATION_KUBELET_CPU_MANAGER_POLICY: _json.dumps(
+                {
+                    "policy": kubelet_policy_name,
+                    "reservedCPUs": format_cpuset_sorted(
+                        sorted(set(kubelet_reserved))
+                    ),
+                }
+            ),
+        }
+        if kubelet_pod_allocs:
+            annotations[ext.ANNOTATION_NODE_CPU_ALLOCS] = _json.dumps(
+                list(kubelet_pod_allocs)
+            )
+        if system_qos_cpuset:
+            annotations[ext.ANNOTATION_NODE_SYSTEM_QOS_RESOURCE] = _json.dumps(
+                {"cpuset": system_qos_cpuset, "cpusetExclusive": True}
+            )
         report = NodeResourceTopology(
-            meta=ObjectMeta(name=self.node_name),
+            meta=ObjectMeta(name=self.node_name, annotations=annotations),
             zones=zones,
             cpu_topology={
                 c.cpu_id: (c.core_id, c.numa_node, c.socket) for c in topo.cpus

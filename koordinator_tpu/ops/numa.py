@@ -44,6 +44,7 @@ def numa_fit_mask(
     pod_wants_numa: jnp.ndarray,  # [P] bool (LSR/LSE-style alignment need)
     numa: NumaState,
     cpu_amp: jnp.ndarray | None = None,  # [N] node CPU amplification ratio
+    pod_required: jnp.ndarray | None = None,  # [P] bool single-NUMA REQUIRED
 ) -> jnp.ndarray:
     """[P, N] feasibility under each node's topology policy.
 
@@ -94,9 +95,15 @@ def numa_fit_mask(
     has_zones = jnp.any(jnp.sum(numa.zone_cap, axis=-1) > 0, axis=-1)  # [N]
     strict = numa.policy == POLICY_SINGLE_NUMA_NODE
     # strict nodes align every pod (kubelet would reject otherwise); on
-    # other nodes only alignment-requesting pods are zone-checked.
+    # other nodes only alignment-requesting pods are zone-checked. A pod
+    # whose numa-topology-spec REQUIRES SingleNUMANode needs a one-zone
+    # fit on EVERY node regardless of the node's own policy
+    # (numa_aware.go:29-31).
+    strict_pn = strict[None, :]
+    if pod_required is not None:
+        strict_pn = strict_pn | pod_required[:, None]
     ok = jnp.where(
-        strict[None, :], any_zone, total_fit | ~pod_wants_numa[:, None]
+        strict_pn, any_zone, total_fit | ~pod_wants_numa[:, None]
     )
     return ok | ~has_zones[None, :]
 

@@ -155,6 +155,10 @@ class PodBatch:
     #: reference apis/extension/coscheduling.go:40-53). Indexed by
     #: gang_id like gang_min, sized [P].
     gang_nonstrict: jnp.ndarray = None
+    #: pod requires single-NUMA placement via the numa-topology-spec
+    #: annotation ([P] bool; ORed with the LSR/LSE cpu-bind predicate in
+    #: the zone feasibility mask)
+    numa_required: jnp.ndarray = None
 
     @classmethod
     def create(
@@ -173,6 +177,7 @@ class PodBatch:
         rdma=None,
         fpga=None,
         gang_nonstrict=None,
+        numa_required=None,
         quota_levels: int = 4,
     ) -> "PodBatch":
         requests = jnp.asarray(requests, jnp.float32)
@@ -230,6 +235,11 @@ class PodBatch:
                 jnp.zeros(p, bool)
                 if gang_nonstrict is None
                 else jnp.asarray(gang_nonstrict, bool)
+            ),
+            numa_required=(
+                jnp.zeros(p, bool)
+                if numa_required is None
+                else jnp.asarray(numa_required, bool)
             ),
         )
 
@@ -526,10 +536,18 @@ def assign(
         from .numa import numa_fit_mask
 
         # Alignment need mirrors the host predicate (nodenumaresource
-        # wants_numa): LSR or LSE QoS with a positive whole-core request.
+        # wants_numa): LSR or LSE QoS with a positive whole-core request —
+        # plus pods whose numa-topology-spec annotation requires
+        # SingleNUMANode placement outright (numa_aware.go:29-31)
         wants = bind_mask
+        if spods.numa_required is not None:
+            wants = wants | spods.numa_required
         numa_mask = numa_fit_mask(
-            spods.requests, wants, numa, cpu_amp=nodes.cpu_amp
+            spods.requests,
+            wants,
+            numa,
+            cpu_amp=nodes.cpu_amp,
+            pod_required=spods.numa_required,
         )
         if numa_scoring is not None:
             # NUMA-aligned Least/MostAllocated Score strategies

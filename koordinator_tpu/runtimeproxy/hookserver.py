@@ -17,7 +17,7 @@ from typing import Optional
 from ..api import extension as ext
 from ..api.types import ObjectMeta, Pod, PodSpec
 from ..koordlet import resourceexecutor as rex
-from ..koordlet.runtimehooks import pod_cgroup, pod_mutation, pod_plan
+from ..koordlet.runtimehooks import CpusetRule, pod_cgroup, pod_mutation, pod_plan
 from .config import FailurePolicy, HookServerRegistration
 from .proto import (
     ContainerResourceHookRequest,
@@ -59,6 +59,12 @@ class KoordletHookServer:
     def __init__(self, executor: rex.ResourceExecutor):
         self.executor = executor
         self.cpu_norm_ratio = 1.0
+        #: shared-pool cpuset rule from the NodeResourceTopology report
+        #: (set by whoever wires this server to the statesinformer)
+        self.cpuset_rule: Optional[CpusetRule] = None
+
+    def set_topology(self, topo) -> None:
+        self.cpuset_rule = CpusetRule.from_topology(topo)
 
     def registration(
         self, failure_policy: FailurePolicy = FailurePolicy.NONE
@@ -88,7 +94,7 @@ class KoordletHookServer:
         )
         if hook is RuntimeHookType.PRE_RUN_POD_SANDBOX:
             self.executor.apply(
-                pod_plan(pod, self.cpu_norm_ratio),
+                pod_plan(pod, self.cpu_norm_ratio, self.cpuset_rule),
                 reason="proxy:PreRunPodSandbox",
             )
             return PodSandboxHookResponse(
@@ -122,7 +128,7 @@ class KoordletHookServer:
             )
         if hook is RuntimeHookType.PRE_UPDATE_CONTAINER_RESOURCES:
             self.executor.apply(
-                pod_plan(pod, self.cpu_norm_ratio),
+                pod_plan(pod, self.cpu_norm_ratio, self.cpuset_rule),
                 reason="proxy:PreUpdateContainerResources",
             )
             return ContainerResourceHookResponse()

@@ -71,6 +71,30 @@ class LoadAwareArgs:
         return estimator.scale_vector(config.resources, self.estimator_scales)
 
 
+#: upstream kube-scheduler's floor: clusters at or below this size are
+#: always fully scored (minFeasibleNodesToFind)
+MIN_FEASIBLE_NODES_TO_FIND = 100
+
+
+def num_nodes_to_score(n_nodes: int, percentage: int = 0) -> int:
+    """Upstream kube-scheduler ``numFeasibleNodesToFind``, which the
+    reference passes through verbatim
+    (``cmd/koord-scheduler/app/server.go:411``
+    WithPercentageOfNodesToScore): clusters ≤100 nodes are fully scored;
+    ``percentage`` 0 selects the adaptive ``50 − n/125`` (floored at 5%);
+    the sampled count never drops below 100 nodes."""
+    if n_nodes <= MIN_FEASIBLE_NODES_TO_FIND:
+        return n_nodes
+    pct = percentage
+    if pct <= 0:
+        pct = 50 - n_nodes // 125
+        if pct < 5:
+            pct = 5
+    if pct >= 100:
+        return n_nodes
+    return max(n_nodes * pct // 100, MIN_FEASIBLE_NODES_TO_FIND)
+
+
 @jax.jit
 def _chain_commit_deltas(cur, nodes_t, result):
     """Carry only the solver's commit deltas onto the untransformed base
@@ -113,6 +137,8 @@ class LoweredRows:
     #: [P, L] lowered leaf-to-root quota index paths (−1 padding); the
     #: commit's quota accounting reuses them instead of re-walking names
     quota_chain: Optional[np.ndarray] = None
+    #: [P] bool — pod requires single-NUMA placement (numa-topology-spec)
+    numa_required: Optional[np.ndarray] = None
 
 
 @dataclasses.dataclass
@@ -142,6 +168,7 @@ class BatchScheduler:
         defer_preemption: bool = False,
         enable_priority_preemption: bool = False,
         defer_gc: bool = True,
+        percentage_of_nodes_to_score: int = 100,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -201,26 +228,75 @@ class BatchScheduler:
         #: source of per-chunk commit p99 spikes — the pause-free
         #: equivalent of what the reference gets from Go's concurrent GC.
         self.defer_gc = defer_gc
+        #: kube-scheduler PercentageOfNodesToScore, passed through by the
+        #: reference (``cmd/koord-scheduler/app/server.go:411``): 100 =
+        #: score every node (default — full batched solve); 1-99 = score
+        #: a rotating window of that share per cycle; 0 = upstream's
+        #: adaptive 50 − n/125 (floor 5%). Sampling bounds the solve's
+        #: node axis, which is what a latency-oriented deployment wants
+        #: at 10k+ nodes (the upstream default at that scale is 5%).
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        #: rotating sample start (upstream nextStartNodeIndex analog)
+        self._score_start = 0
 
     # ---- device lowering ----
 
-    def node_state(self) -> NodeState:
+    def _select_nodes(self) -> Optional[np.ndarray]:
+        """Real node indices to lower this cycle, or None for all (the
+        kube-scheduler node-sampling pass: a rotating window of
+        ``num_nodes_to_score`` nodes, advanced per cycle like upstream's
+        nextStartNodeIndex so every node is visited fairly)."""
+        n_real = self.snapshot.node_count
+        want = num_nodes_to_score(n_real, self.percentage_of_nodes_to_score)
+        if want >= n_real:
+            return None
+        start = self._score_start
+        self._score_start = (start + want) % n_real
+        return (np.arange(want) + start) % n_real
+
+    def node_state(self, sub: Optional[np.ndarray] = None) -> NodeState:
         # NB: the amplified-CPU surcharge for exclusively-held cores
         # (plugin.go:430-438) is charged by snapshot.assume_pod itself, so
         # na.requested is already amplified-space for bound pods.
         na = self.snapshot.nodes
         est_used = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
+        if sub is None:
+            take = jnp.asarray
+        else:
+            b = bucket_size(len(sub), self.snapshot.config.min_bucket)
+
+            def take(a, _b=b, _sub=sub):
+                # pad rows stay all-zero → schedulable False → masked out
+                out = np.zeros((_b,) + a.shape[1:], a.dtype)
+                out[: len(_sub)] = a[_sub]
+                return jnp.asarray(out)
+
         return NodeState(
-            allocatable=jnp.asarray(na.allocatable),
-            requested=jnp.asarray(na.requested),
-            estimated_used=jnp.asarray(est_used),
-            prod_used=jnp.asarray(na.prod_usage + na.assigned_pending_prod),
-            metric_fresh=jnp.asarray(na.metric_fresh),
-            schedulable=jnp.asarray(na.schedulable),
-            cpu_amp=jnp.asarray(na.cpu_amp),
-            custom_thresholds=jnp.asarray(na.custom_thresholds),
-            custom_prod_thresholds=jnp.asarray(na.custom_prod_thresholds),
+            allocatable=take(na.allocatable),
+            requested=take(na.requested),
+            estimated_used=take(est_used),
+            prod_used=take(na.prod_usage + na.assigned_pending_prod),
+            metric_fresh=take(na.metric_fresh),
+            schedulable=take(na.schedulable),
+            cpu_amp=take(na.cpu_amp),
+            custom_thresholds=take(na.custom_thresholds),
+            custom_prod_thresholds=take(na.custom_prod_thresholds),
         )
+
+    def _map_assignment(
+        self, assignment: np.ndarray, sub: Optional[np.ndarray]
+    ) -> np.ndarray:
+        """Solver output indices → real snapshot node indices when the
+        cycle solved over a sampled window."""
+        if sub is None:
+            return assignment
+        lut = np.full(
+            bucket_size(len(sub), self.snapshot.config.min_bucket),
+            -1,
+            np.int32,
+        )
+        lut[: len(sub)] = sub
+        return np.where(assignment >= 0, lut[np.clip(assignment, 0, None)], -1)
 
     def pod_batch(self, pods: Sequence[Pod], bucket: Optional[int] = None) -> PodBatch:
         arrays = self.snapshot.build_pods(
@@ -268,6 +344,26 @@ class BatchScheduler:
                 est[i] = self._estimate_of(pods[i])
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
         chains = self.quotas.chains_for_names(arrays.quota_names, b)
+        # non-preemptible pods: append the leaf's SHADOW quota index
+        # (leaf + Q; runtime=min, used=nonPreemptibleUsed in the extended
+        # solver table) so ordinary chain admission enforces the MIN
+        # bound in-batch (plugin.go:252-262). A full 4-level chain has no
+        # free slot — those rare pods fall back to the host-side
+        # has_headroom check at Reserve.
+        nonpre = arrays.non_preemptible
+        if (
+            nonpre is not None
+            and self.quotas.quota_count > 0
+            and nonpre.any()
+        ):
+            q_count = self.quotas.quota_count
+            for i in np.nonzero(nonpre)[0].tolist():
+                row = chains[i]
+                if row[0] < 0:
+                    continue
+                free = np.nonzero(row < 0)[0]
+                if free.size:
+                    row[free[0]] = row[0] + q_count
         # stash the host-side rows for _commit: Reserve revalidation and
         # assume charges reuse these instead of recomputing res_vector /
         # estimate_pod per winner (the recompute was a measurable slice of
@@ -290,6 +386,7 @@ class BatchScheduler:
             fpga=arrays.fpga,
             has_gangs=bool((arrays.gang_id >= 0).any()),
             quota_chain=chains,
+            numa_required=arrays.numa_required,
         )
         return PodBatch.create(
             requests=arrays.requests,
@@ -306,6 +403,7 @@ class BatchScheduler:
             rdma=arrays.rdma,
             fpga=arrays.fpga,
             gang_nonstrict=arrays.gang_nonstrict,
+            numa_required=arrays.numa_required,
         )
 
     # ---- scheduling cycle ----
@@ -393,7 +491,11 @@ class BatchScheduler:
                 node = r.node_name
                 leaf = quota_name_of(pod)
                 if leaf is not None and not self.quotas.has_headroom(
-                    leaf, pod.spec.requests
+                    leaf,
+                    pod.spec.requests,
+                    non_preemptible=(
+                        pod.meta.labels.get(ext.LABEL_PREEMPTIBLE) == "false"
+                    ),
                 ):
                     retry_queue.append(pod)
                     continue
@@ -462,10 +564,14 @@ class BatchScheduler:
         unsched: List[Pod] = list(gated) + list(dropped) + list(affinity_unsched)
         rounds = 0
         chunks = self._chunks(eligible)
+        # kube-scheduler node sampling (PercentageOfNodesToScore): one
+        # rotating window per cycle, shared by every chunk so the
+        # on-device capacity chaining stays on a consistent node axis
+        sub = self._select_nodes() if chunks else None
         if len(chunks) > 1:
-            solves = self._dispatch_pipelined(chunks)
+            solves = self._dispatch_pipelined(chunks, sub)
         else:
-            solves = [(chunk, None, self.solve(chunk)) for chunk in chunks]
+            solves = [(chunk, None, self.solve(chunk, sub)) for chunk in chunks]
         # start all device→host copies before the first blocking fetch:
         # on tunneled backends every synchronous fetch is a full round
         # trip (~100 ms regardless of size); prefetching overlaps them
@@ -479,6 +585,7 @@ class BatchScheduler:
         for chunk, rows, result in solves:
             t0 = _time.perf_counter()
             assignment = np.asarray(result.assignment)  # sync point
+            assignment = self._map_assignment(assignment, sub)
             rounds += int(result.rounds_used)
             if fwext.scores.top_n > 0:
                 self._debug_capture(chunk, assignment)
@@ -717,7 +824,7 @@ class BatchScheduler:
         return chunks
 
     def _dispatch_pipelined(
-        self, chunks: List[List[Pod]]
+        self, chunks: List[List[Pod]], sub: Optional[np.ndarray] = None
     ) -> List[Tuple[List[Pod], LoweredRows, SolveResult]]:
         """Dispatch every chunk's solve back-to-back, chaining consumed
         node/quota/device capacity on device (solve_stream's discipline
@@ -731,9 +838,9 @@ class BatchScheduler:
         under-place within one call, never overcommit."""
         quotas0 = self.quota_state([p for c in chunks for p in c])
         qused = quotas0.used if quotas0 is not None else None
-        numa_state, device_state = self._constraint_states()
+        numa_state, device_state = self._constraint_states(sub)
 
-        nodes0 = self.node_state()
+        nodes0 = self.node_state(sub)
         cur = nodes0
         dev_carry = None
         out: List[Tuple[List[Pod], LoweredRows, SolveResult]] = []
@@ -746,7 +853,7 @@ class BatchScheduler:
             # analog) is applied exactly once per chunk, never compounded
             pods_t, nodes_t = self.extender.run_batch_transformers(pods, cur)
             node_mask = self._node_constraint_mask(
-                chunk, pods_t.requests.shape[0]
+                chunk, pods_t.requests.shape[0], sub
             )
             result = assign(
                 pods_t,
@@ -796,39 +903,55 @@ class BatchScheduler:
             return self.devices.scoring_strategy
         return None
 
-    def _constraint_states(self):
+    def _constraint_states(self, sub: Optional[np.ndarray] = None):
         """Lower the NUMA zone table and GPU slot table for the solver
-        (None for whichever manager is absent/empty)."""
+        (None for whichever manager is absent/empty). ``sub`` restricts
+        the node axis to the cycle's sampled window."""
+        if sub is None:
+            def take(a):
+                return jnp.asarray(a)
+        else:
+            b = bucket_size(len(sub), self.snapshot.config.min_bucket)
+
+            def take(a, _b=b, _sub=sub):
+                out = np.zeros((_b,) + a.shape[1:], np.asarray(a).dtype)
+                out[: len(_sub)] = np.asarray(a)[_sub]
+                return jnp.asarray(out)
+
         numa_state = None
         if self.numa is not None and self.numa.has_topology:
             from ..ops.numa import NumaState
 
             zone_free, zone_cap, policy = self.numa.arrays()
             numa_state = NumaState(
-                zone_free=jnp.asarray(zone_free),
-                zone_cap=jnp.asarray(zone_cap),
-                policy=jnp.asarray(policy),
+                zone_free=take(zone_free),
+                zone_cap=take(zone_cap),
+                policy=take(policy),
             )
         device_state = None
         if self.devices is not None and self.devices.has_devices:
             from ..ops.device import DeviceState
 
             device_state = DeviceState(
-                slot_free=jnp.asarray(self.devices.slot_array()),
-                rdma_free=jnp.asarray(self.devices.rdma_array()),
-                fpga_free=jnp.asarray(self.devices.fpga_array()),
-                cap_total=jnp.asarray(self.devices.cap_array()),
+                slot_free=take(self.devices.slot_array()),
+                rdma_free=take(self.devices.rdma_array()),
+                fpga_free=take(self.devices.fpga_array()),
+                cap_total=take(self.devices.cap_array()),
             )
         return numa_state, device_state
 
-    def solve(self, chunk: Sequence[Pod]) -> SolveResult:
+    def solve(
+        self, chunk: Sequence[Pod], sub: Optional[np.ndarray] = None
+    ) -> SolveResult:
         pods = self.pod_batch(chunk)
-        nodes = self.node_state()
+        nodes = self.node_state(sub)
         # BeforeFilter analog: device-batch transformers.
         pods, nodes = self.extender.run_batch_transformers(pods, nodes)
         quotas = self.quota_state(chunk)
-        numa_state, device_state = self._constraint_states()
-        node_mask = self._node_constraint_mask(chunk, pods.requests.shape[0])
+        numa_state, device_state = self._constraint_states(sub)
+        node_mask = self._node_constraint_mask(
+            chunk, pods.requests.shape[0], sub
+        )
         return assign(
             pods,
             nodes,
@@ -847,7 +970,12 @@ class BatchScheduler:
             device_scoring=self._device_scoring(),
         )
 
-    def _node_constraint_mask(self, chunk: Sequence[Pod], p_bucket: int):
+    def _node_constraint_mask(
+        self,
+        chunk: Sequence[Pod],
+        p_bucket: int,
+        sub: Optional[np.ndarray] = None,
+    ):
         """[P, N] bool for pods carrying node constraints (nodeSelector /
         required nodeAffinity names / spec.nodeName — the upstream
         NodeAffinity+NodeName Filter plugins' semantics); None when no pod
@@ -857,6 +985,13 @@ class BatchScheduler:
             for p in chunk
         ):
             return None
+        if sub is not None:
+            # build over the full axis, then slice the sampled window
+            full = self._node_constraint_mask(chunk, p_bucket, None)
+            b = bucket_size(len(sub), self.snapshot.config.min_bucket)
+            out = np.zeros((p_bucket, b), bool)
+            out[:, : len(sub)] = np.asarray(full)[:, sub]
+            return jnp.asarray(out)
         n_bucket = self.snapshot.nodes.allocatable.shape[0]
         mask = np.ones((p_bucket, n_bucket), bool)
         names: List[Optional[str]] = [None] * n_bucket
@@ -925,7 +1060,28 @@ class BatchScheduler:
             if idx is not None and idx < self.quotas.used.shape[0]:
                 by_leaf[leaf] = by_leaf[leaf] + self.quotas.used[idx]
         self.quotas.set_leaf_requests(by_leaf)
-        runtime, used = self.quotas.quota_arrays()
+        # non-preemptible demand ledger for status stamping (leaf-level)
+        np_by_leaf: Dict[str, np.ndarray] = {}
+        for pod in chunk:
+            if pod.meta.labels.get(ext.LABEL_PREEMPTIBLE) != "false":
+                continue
+            leaf = quota_name_of(pod)
+            if leaf is None:
+                continue
+            vec = res_vector(pod.spec.requests)
+            acc = np_by_leaf.get(leaf)
+            np_by_leaf[leaf] = vec.copy() if acc is None else acc + vec
+        if np_by_leaf or self.quotas.nonpre_requests.any():
+            self.quotas._ensure_capacity()
+            # request = admitted non-preemptible usage everywhere, plus
+            # this cycle's pending demand per leaf — request must stay a
+            # superset of used even for quotas with nothing pending now
+            self.quotas.nonpre_requests[:] = self.quotas.nonpre_used
+            for leaf, vec in np_by_leaf.items():
+                idx = self.quotas.index_of(leaf)
+                if idx is not None and idx < self.quotas.nonpre_requests.shape[0]:
+                    self.quotas.nonpre_requests[idx] += vec
+        runtime, used = self.quotas.quota_arrays_extended()
         if runtime.shape[0] == 1:
             # pad: Q == 1 is reserved as the disabled sentinel
             pad = np.zeros((1, runtime.shape[1]), np.float32)
@@ -1153,6 +1309,10 @@ class BatchScheduler:
                 (pol == int(NUMAPolicy.SINGLE_NUMA_NODE))
                 | rows.bind[:n_chunk]
             )
+            if rows.numa_required is not None:
+                # numa-topology-spec pods need exact zone assignment on
+                # any registered node
+                needs_numa |= accept & (pol >= 0) & rows.numa_required[:n_chunk]
         if dev_mgr is not None and rows.gpu_whole is not None:
             needs_dev = accept & (
                 (rows.gpu_whole[:n_chunk] > 0)
@@ -1203,6 +1363,11 @@ class BatchScheduler:
                 if numa_l is not None:
                     numa_rows = [i for i in con_rows if numa_l[i]]
                     if numa_rows:
+                        req_l = (
+                            rows.numa_required[:n_chunk].tolist()
+                            if rows.numa_required is not None
+                            else None
+                        )
                         payloads = numa_mgr.allocate_batch(
                             [uids[i] for i in numa_rows],
                             [chunk[i].meta.annotations for i in numa_rows],
@@ -1210,6 +1375,11 @@ class BatchScheduler:
                             [cpu_l[i] for i in numa_rows],
                             [mem_l[i] for i in numa_rows],
                             [bind_l[i] for i in numa_rows],
+                            required=(
+                                [req_l[i] for i in numa_rows]
+                                if req_l is not None
+                                else None
+                            ),
                         )
                         for i, payload in zip(numa_rows, payloads):
                             if payload is None:

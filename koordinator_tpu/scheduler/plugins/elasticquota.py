@@ -148,6 +148,12 @@ class GroupQuotaManager:
         #: uncapped Σ of children's requests per quota (the reference's
         #: ChildRequest; ``requests`` holds the max-capped propagation)
         self.child_requests = np.zeros((1, d), np.float32)
+        #: non-preemptible pods' admitted usage, tracked separately: such
+        #: pods must fit inside quota MIN, not runtime (reference
+        #: ``quota_info.go:49-56`` + ``plugin.go:252-262`` PreFilter)
+        self.nonpre_used = np.zeros((1, d), np.float32)
+        #: non-preemptible pods' rolled-up requests (status stamping)
+        self.nonpre_requests = np.zeros((1, d), np.float32)
         self._dirty = True
         #: memoized leaf-to-root index paths; rebuilt on tree mutations
         #: (chain_of was a visible slice of the per-winner commit loop)
@@ -206,6 +212,8 @@ class GroupQuotaManager:
         new_used = np.zeros((q, d), np.float32)
         new_req = np.zeros((q, d), np.float32)
         new_child = np.zeros((q, d), np.float32)
+        new_nonpre = np.zeros((q, d), np.float32)
+        new_nonpre_req = np.zeros((q, d), np.float32)
         for new_i, nm in enumerate(self._order):
             n = self._nodes[nm]
             if name in n.children:
@@ -217,11 +225,17 @@ class GroupQuotaManager:
                 new_req[new_i] = self.requests[oi]
             if oi < self.child_requests.shape[0]:
                 new_child[new_i] = self.child_requests[oi]
+            if oi < self.nonpre_used.shape[0]:
+                new_nonpre[new_i] = self.nonpre_used[oi]
+            if oi < self.nonpre_requests.shape[0]:
+                new_nonpre_req[new_i] = self.nonpre_requests[oi]
             n.index = new_i
         self._chain_cache.clear()
         self._chain_row_cache.clear()
         self.used, self.requests = new_used, new_req
         self.child_requests = new_child
+        self.nonpre_used = new_nonpre
+        self.nonpre_requests = new_nonpre_req
         self._dirty = True
 
     def set_cluster_total(self, total: Mapping[str, float]) -> None:
@@ -285,22 +299,37 @@ class GroupQuotaManager:
     def _ensure_capacity(self) -> None:
         q = max(self.quota_count, 1)
         d = self.config.dims
-        for attr in ("used", "requests", "runtime", "child_requests"):
+        for attr in ("used", "requests", "runtime", "child_requests", "nonpre_used", "nonpre_requests"):
             arr = getattr(self, attr)
             if arr.shape[0] < q:
                 grown = np.zeros((q, d), np.float32)
                 grown[: arr.shape[0]] = arr
                 setattr(self, attr, grown)
 
-    def has_headroom(self, quota_name: str, requests: Mapping[str, float]) -> bool:
+    def has_headroom(
+        self,
+        quota_name: str,
+        requests: Mapping[str, float],
+        non_preemptible: bool = False,
+    ) -> bool:
         """used + request ≤ runtime along the whole chain (host-side mirror
-        of the solver's admission for bypass paths like reservations)."""
+        of the solver's admission for bypass paths like reservations); a
+        non-preemptible pod additionally fits nonPreemptibleUsed + request
+        inside the LEAF's min (plugin.go:252-262)."""
         self._ensure_capacity()
         if self._dirty:
             self.refresh_runtime()
         vec = self.config.res_vector(requests)
-        for idx in self.chain_of(quota_name):
+        chain = self.chain_of(quota_name)
+        for idx in chain:
             if np.any(self.used[idx] + vec > self.runtime[idx] + 1e-3):
+                return False
+        if non_preemptible and chain:
+            leaf = chain[0]
+            leaf_min = self.config.res_vector(
+                self._nodes[quota_name].quota.min
+            )
+            if np.any(self.nonpre_used[leaf] + vec > leaf_min + 1e-3):
                 return False
         return True
 
@@ -309,23 +338,40 @@ class GroupQuotaManager:
         quota_name: str,
         requests: Mapping[str, float],
         vec: Optional[np.ndarray] = None,
+        non_preemptible: bool = False,
     ) -> None:
         self._ensure_capacity()
         if vec is None:
             vec = self.config.res_vector(requests)
-        for idx in self.chain_of(quota_name):
+        chain = self.chain_of(quota_name)
+        for idx in chain:
             self.used[idx] += vec
+        if non_preemptible and chain:
+            # leaf-only ledger: admission checks min at the LEAF
+            # (plugin.go:252-262); parents roll up at stamping time
+            self.nonpre_used[chain[0]] += vec
 
-    def refund(self, quota_name: str, requests: Mapping[str, float]) -> None:
+    def refund(
+        self,
+        quota_name: str,
+        requests: Mapping[str, float],
+        non_preemptible: bool = False,
+    ) -> None:
         self._ensure_capacity()
         vec = self.config.res_vector(requests)
-        for idx in self.chain_of(quota_name):
+        chain = self.chain_of(quota_name)
+        for idx in chain:
             self.used[idx] -= vec
+        if non_preemptible and chain:
+            self.nonpre_used[chain[0]] = np.maximum(
+                self.nonpre_used[chain[0]] - vec, 0.0
+            )
 
     def reset_usage(self) -> None:
         """Zero all used charges and assigned-pod records (full-resync
         path: the world state is being replaced wholesale)."""
         self.used[:] = 0.0
+        self.nonpre_used[:] = 0.0
         self._assigned.clear()
         self._dirty = True
 
@@ -338,7 +384,12 @@ class GroupQuotaManager:
         """Charge the chain and remember the pod at its leaf quota so the
         overuse-revoke controller can pick eviction victims. ``vec`` is the
         pod's already-lowered request row (skips a per-winner res_vector)."""
-        self.charge(quota_name, pod.spec.requests, vec=vec)
+        self.charge(
+            quota_name,
+            pod.spec.requests,
+            vec=vec,
+            non_preemptible=is_pod_non_preemptible(pod),
+        )
         self.record_assigned(quota_name, pod)
 
     def record_assigned(self, quota_name: str, pod: "Pod") -> None:
@@ -370,11 +421,24 @@ class GroupQuotaManager:
         si = idxs[perm]
         sr = rows[perm]
         starts = np.nonzero(np.r_[True, si[1:] != si[:-1]])[0]
-        self.used[si[starts]] += np.add.reduceat(sr, starts, axis=0)
+        sums = np.add.reduceat(sr, starts, axis=0)
+        heads = si[starts]
+        q = self.used.shape[0]
+        # shadow indices (≥ Q, from the extended solver table) route to
+        # the non-preemptible ledger; real indices to used
+        real = heads < q
+        if real.any():
+            self.used[heads[real]] += sums[real]
+        if (~real).any():
+            self.nonpre_used[heads[~real] - q] += sums[~real]
 
     def unassign_pod(self, quota_name: str, pod: "Pod") -> None:
         if self._assigned.get(quota_name, {}).pop(pod.meta.uid, None) is not None:
-            self.refund(quota_name, pod.spec.requests)
+            self.refund(
+                quota_name,
+                pod.spec.requests,
+                non_preemptible=is_pod_non_preemptible(pod),
+            )
 
     def pods_assigned(self, quota_name: str) -> List["Pod"]:
         return list(self._assigned.get(quota_name, {}).values())
@@ -504,6 +568,34 @@ class GroupQuotaManager:
             return np.full((1, d), np.inf, np.float32), np.zeros((1, d), np.float32)
         return self.runtime, self.used
 
+    def mins_array(self) -> np.ndarray:
+        """[Q, D] min vectors in index order (0 where unset)."""
+        self._ensure_capacity()
+        q = max(self.quota_count, 1)
+        d = self.config.dims
+        out = np.zeros((q, d), np.float32)
+        for name in self._order:
+            node = self._nodes[name]
+            out[node.index] = self.config.res_vector(node.quota.min)
+        return out
+
+    def quota_arrays_extended(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Doubled quota table for the solver: rows 0..Q-1 are the real
+        quotas (runtime/used); rows Q..2Q-1 are each quota's SHADOW whose
+        runtime is the quota's MIN and whose used is the non-preemptible
+        ledger. A non-preemptible pod's chain gains its leaf's shadow
+        index, so the solver's ordinary cumulative chain admission
+        enforces ``nonPreemptibleUsed + req ≤ min`` in-batch — the
+        reference's PreFilter check (``plugin.go:252-262``) with no extra
+        device pass."""
+        runtime, used = self.quota_arrays()
+        if self.quota_count == 0:
+            return runtime, used
+        return (
+            np.concatenate([runtime, self.mins_array()]),
+            np.concatenate([used, self.nonpre_used[: runtime.shape[0]]]),
+        )
+
     def guaranteed_allocated(self) -> Tuple[np.ndarray, np.ndarray]:
         """Bottom-up guaranteed/allocated pass (reference
         ``elasticquota/core/quota_info.go:62-67`` +
@@ -601,7 +693,28 @@ class GroupQuotaManager:
             ann[ext.ANNOTATION_QUOTA_ALLOCATED] = _json.dumps(
                 summary["allocated"]
             )
+            # non-preemptible ledger (AnnotationNonPreemptibleUsed /
+            # ...Request, quota_info.go:49-56): leaf values are direct;
+            # parents roll their subtree up
+            np_used = self._rollup(self.nonpre_used, name)
+            np_req = self._rollup(self.nonpre_requests, name)
+            summary["nonPreemptibleUsed"] = table(np_used)
+            summary["nonPreemptibleRequest"] = table(np_req)
+            ann[ext.ANNOTATION_QUOTA_NON_PREEMPTIBLE_USED] = _json.dumps(
+                summary["nonPreemptibleUsed"]
+            )
+            ann[ext.ANNOTATION_QUOTA_NON_PREEMPTIBLE_REQUEST] = _json.dumps(
+                summary["nonPreemptibleRequest"]
+            )
         return report
+
+    def _rollup(self, leaf_array: np.ndarray, name: str) -> np.ndarray:
+        """Subtree sum of a leaf-tracked ledger."""
+        node = self._nodes[name]
+        total = leaf_array[node.index].copy()
+        for child in node.children:
+            total += self._rollup(leaf_array, child)
+        return total
 
     def chains_for_pods(self, pods: Sequence[Pod], p_bucket: int) -> np.ndarray:
         return self.chains_for_names(
@@ -615,8 +728,10 @@ class GroupQuotaManager:
         have few distinct quotas, so rows are built once per distinct
         name (memoized alongside the index-path cache) and scattered —
         the per-pod ``chain_of`` walk was a visible slice of large quota
-        batches."""
-        chains = np.full((p_bucket, MAX_LEVELS), -1, np.int32)
+        batches. Rows are MAX_LEVELS+1 wide: the extra column is ALWAYS
+        free for a non-preemptible pod's shadow-leaf index, so the MIN
+        bound can never silently go unenforced on a full-depth chain."""
+        chains = np.full((p_bucket, MAX_LEVELS + 1), -1, np.int32)
         cache = self._chain_row_cache
         groups: Dict[str, List[int]] = {}
         for i, nm in enumerate(names):
@@ -630,8 +745,8 @@ class GroupQuotaManager:
         for nm, idxs in groups.items():
             row = cache.get(nm)
             if row is None:
-                row = np.full((MAX_LEVELS,), -1, np.int32)
-                for level, idx in enumerate(self.chain_of(nm)):
+                row = np.full((MAX_LEVELS + 1,), -1, np.int32)
+                for level, idx in enumerate(self.chain_of(nm)[:MAX_LEVELS]):
                     row[level] = idx
                 cache[nm] = row
             chains[idxs] = row

@@ -640,11 +640,14 @@ def test_preemption_never_crosses_quota_boundaries():
 
 
 def test_preemption_respects_non_preemptible_label():
+    # 4-cpu victims: non-preemptible pods must ALSO fit the quota min
+    # (8 cpu) under the r4 min-bounded admission (plugin.go:252-262)
     snap, mgr, sched = preempt_cluster()
-    low = [quota_pod(f"low{i}", "team-a", cpu=6.0, prio=5000) for i in range(2)]
+    low = [quota_pod(f"low{i}", "team-a", cpu=4.0, prio=5000) for i in range(2)]
     for p in low:
         p.meta.labels[ext.LABEL_PREEMPTIBLE] = "false"
-    sched.schedule(low)
+    out0 = sched.schedule(low)
+    assert len(out0.bound) == 2
     high = quota_pod("high", "team-a", cpu=6.0, prio=9500)
     out = sched.schedule([high])
     assert out.bound == [] and out.preempted == []

@@ -1,0 +1,453 @@
+"""Round-4 annotation-protocol tail (VERDICT r3 #4): behaviors, not just
+keys — LS/BE CPU shared pools end-to-end, quota non-preemptible
+min-bounded admission, numa-topology-spec, node-level
+cpu-bind-policy/numa-allocate-strategy labels, kubelet cpu-manager state
+consumption, extended-resource-spec."""
+
+import json
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    ElasticQuota,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.core.topology import CPUTopology, parse_cpuset
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+    NUMAManager,
+    NUMAPolicy,
+)
+
+
+# ---- LS/BE CPU shared pools: koordlet computes → annotation → cpuset hook ----
+
+
+def _informer_with_pods(pods):
+    inf = StatesInformer("n0")
+    inf.set_pods(pods)
+    return inf
+
+
+def _bound_lsr(name, cpuset, qos="LSR"):
+    return Pod(
+        meta=ObjectMeta(
+            name=name,
+            labels={ext.LABEL_POD_QOS: qos},
+            annotations={
+                ext.ANNOTATION_RESOURCE_STATUS: json.dumps({"cpuset": cpuset})
+            },
+        ),
+        spec=PodSpec(requests={ext.RES_CPU: 4000}, node_name="n0"),
+    )
+
+
+def test_shared_pools_computed_and_stamped():
+    """calCPUSharePools semantics: LS pools exclude EVERY cpuset-bound
+    pod's CPUs; BE pools exclude only LSE pods' CPUs (BE may ride LSR
+    cores, never LSE); pools group per (socket, numa)."""
+    topo = CPUTopology.uniform(
+        sockets=2, numa_per_socket=1, cores_per_numa=4, threads_per_core=1
+    )
+    inf = _informer_with_pods(
+        [
+            _bound_lsr("lsr", "0-1", qos="LSR"),    # numa 0
+            _bound_lsr("lse", "4-5", qos="LSE"),    # numa 1
+        ]
+    )
+    report = inf.report_topology(topo, policy="SingleNUMANode")
+    ann = report.meta.annotations
+    ls = ext.parse_cpu_shared_pools(ann)
+    be = ext.parse_cpu_shared_pools(ann, be=True)
+    ls_by_node = {p["node"]: p["cpuset"] for p in ls}
+    be_by_node = {p["node"]: p["cpuset"] for p in be}
+    # LS: both LSR and LSE cpus carved out
+    assert parse_cpuset(ls_by_node[0]) == {2, 3}
+    assert parse_cpuset(ls_by_node[1]) == {6, 7}
+    # BE: only the LSE cpus carved out — BE may ride the LSR cores
+    assert parse_cpuset(be_by_node[0]) == {0, 1, 2, 3}
+    assert parse_cpuset(be_by_node[1]) == {6, 7}
+    # kubelet policy annotation stamped
+    kubelet = ext.parse_kubelet_cpu_manager_policy(ann)
+    assert kubelet["policy"] == "none"
+
+
+def test_cpuset_rule_places_ls_and_be_pods():
+    """rule.go getContainerCPUSet: LS → all LS pools; BE → cleared;
+    SYSTEM → the system carve-out; numa-aware alloc → that zone's pool;
+    unlabeled under kubelet static → hands off."""
+    from koordinator_tpu.koordlet.runtimehooks import CpusetRule, cpuset_plan
+
+    topo = CPUTopology.uniform(
+        sockets=2, numa_per_socket=1, cores_per_numa=4, threads_per_core=1
+    )
+    inf = _informer_with_pods([_bound_lsr("lsr", "0-1")])
+    report = inf.report_topology(topo, system_qos_cpuset="7")
+    rule = CpusetRule.from_topology(report)
+
+    def qos_pod(qos, ann=None):
+        return Pod(
+            meta=ObjectMeta(
+                name=f"p-{qos}",
+                labels={ext.LABEL_POD_QOS: qos},
+                annotations=ann or {},
+            ),
+            spec=PodSpec(requests={ext.RES_CPU: 1000}),
+        )
+
+    # LS pod: every LS pool (exclusive LSR cpus + system carve-out gone)
+    ls_plan = cpuset_plan(qos_pod("LS"), rule)
+    assert len(ls_plan) == 1
+    got = set()
+    for part in ls_plan[0][2].split(","):
+        got |= parse_cpuset(part)
+    assert got == {2, 3, 4, 5, 6}
+    # BE pod: cleared (cpu-suppress owns the group)
+    be_plan = cpuset_plan(qos_pod("BE"), rule)
+    assert be_plan[0][2] == ""
+    # SYSTEM pod: the carve-out
+    sys_plan = cpuset_plan(qos_pod("SYSTEM"), rule)
+    assert sys_plan[0][2] == "7"
+    # numa-aware LS pod: zone-1 pool only
+    numa_pod = qos_pod(
+        "LS",
+        ann={
+            ext.ANNOTATION_RESOURCE_STATUS: json.dumps(
+                {"numaNodeResources": [{"node": 1}]}
+            )
+        },
+    )
+    numa_plan = cpuset_plan(numa_pod, rule)
+    assert parse_cpuset(numa_plan[0][2]) == {4, 5, 6}
+    # exclusive cpuset still wins outright
+    excl_plan = cpuset_plan(_bound_lsr("x", "0-1"), rule)
+    assert excl_plan[0][2] == "0-1"
+    # kubelet static + unlabeled pod: hands off
+    rule_static = CpusetRule.from_topology(report)
+    rule_static.kubelet_policy = "static"
+    none_pod = Pod(meta=ObjectMeta(name="plain"), spec=PodSpec())
+    assert cpuset_plan(none_pod, rule_static) == []
+
+
+# ---- quota non-preemptible min-bounded admission ----
+
+
+def _quota_cluster(min_cpu=8.0, max_cpu=100.0):
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 400.0, ext.RES_MEMORY: 400.0}
+            ),
+        )
+    )
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 400, ext.RES_MEMORY: 400}
+    )
+    mgr.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="team"),
+            min={ext.RES_CPU: min_cpu, ext.RES_MEMORY: min_cpu},
+            max={ext.RES_CPU: max_cpu, ext.RES_MEMORY: max_cpu},
+        )
+    )
+    sched = BatchScheduler(snap, quotas=mgr, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    return snap, mgr, sched
+
+
+def _npod(name, cpu, nonpre=False):
+    labels = {ext.LABEL_QUOTA_NAME: "team"}
+    if nonpre:
+        labels[ext.LABEL_PREEMPTIBLE] = "false"
+    return Pod(
+        meta=ObjectMeta(name=name, labels=labels),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu}, priority=9000
+        ),
+    )
+
+
+def test_non_preemptible_bounded_by_min_not_runtime():
+    """plugin.go:252-262: a non-preemptible pod must fit
+    nonPreemptibleUsed + request ≤ quota MIN even when runtime has room;
+    preemptible pods still use the full runtime."""
+    snap, mgr, sched = _quota_cluster(min_cpu=8.0, max_cpu=100.0)
+    # two non-preemptible 6-cpu pods: first fits min (6 ≤ 8), second
+    # (12 > 8) rejected despite abundant runtime
+    out = sched.schedule([_npod("a", 6.0, nonpre=True), _npod("b", 6.0, nonpre=True)])
+    assert len(out.bound) == 1
+    assert len(out.unschedulable) == 1
+    # a preemptible pod of the same size sails through on runtime
+    out2 = sched.schedule([_npod("c", 6.0)])
+    assert len(out2.bound) == 1
+    # ledger: nonpre_used == 6 at the leaf
+    idx = mgr.index_of("team")
+    assert mgr.nonpre_used[idx][0] == 6.0
+    # status sync stamps the non-preemptible annotations
+    report = mgr.sync_status()
+    assert report["team"]["nonPreemptibleUsed"][ext.RES_CPU] == 6.0
+    eq_ann = mgr._nodes["team"].quota.meta.annotations
+    assert ext.ANNOTATION_QUOTA_NON_PREEMPTIBLE_USED in eq_ann
+
+
+def test_non_preemptible_in_batch_sequencing():
+    """The shadow-level enforcement is cumulative WITHIN one batch: three
+    4-cpu non-preemptible pods against min=8 admit exactly two."""
+    snap, mgr, sched = _quota_cluster(min_cpu=8.0, max_cpu=100.0)
+    pods = [_npod(f"p{i}", 4.0, nonpre=True) for i in range(3)]
+    out = sched.schedule(pods)
+    assert len(out.bound) == 2
+    assert len(out.unschedulable) == 1
+
+
+def test_non_preemptible_refund_on_unassign():
+    snap, mgr, sched = _quota_cluster(min_cpu=8.0)
+    pod = _npod("a", 6.0, nonpre=True)
+    out = sched.schedule([pod])
+    assert len(out.bound) == 1
+    idx = mgr.index_of("team")
+    assert mgr.nonpre_used[idx][0] == 6.0
+    mgr.unassign_pod("team", pod)
+    assert mgr.nonpre_used[idx][0] == 0.0
+
+
+def test_non_preemptible_enforced_on_full_depth_chain():
+    """A quota at the maximum lowered tree depth still gets its shadow
+    slot (chains carry one spare column), so the MIN bound holds even
+    for the deepest leaves."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 400.0, ext.RES_MEMORY: 400.0}
+            ),
+        )
+    )
+    mgr = GroupQuotaManager(
+        snap.config, cluster_total={ext.RES_CPU: 400, ext.RES_MEMORY: 400}
+    )
+    # 4-level tree: root -> org -> team -> squad (leaf at MAX_LEVELS)
+    parent = ""
+    for name in ("root-q", "org-q", "team-q", "squad-q"):
+        mgr.upsert_quota(
+            ElasticQuota(
+                meta=ObjectMeta(name=name),
+                min={ext.RES_CPU: 8, ext.RES_MEMORY: 8},
+                max={ext.RES_CPU: 100, ext.RES_MEMORY: 100},
+                parent=parent,
+                is_parent=name != "squad-q",
+            )
+        )
+        parent = name
+    sched = BatchScheduler(snap, quotas=mgr, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+
+    def npod(name, cpu):
+        return Pod(
+            meta=ObjectMeta(
+                name=name,
+                labels={
+                    ext.LABEL_QUOTA_NAME: "squad-q",
+                    ext.LABEL_PREEMPTIBLE: "false",
+                },
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: cpu},
+                priority=9000,
+            ),
+        )
+
+    out = sched.schedule([npod("a", 6.0), npod("b", 6.0)])
+    # min=8 at the leaf: only one 6-cpu non-preemptible pod fits
+    assert len(out.bound) == 1
+    assert len(out.unschedulable) == 1
+
+
+# ---- numa-topology-spec ----
+
+
+def test_numa_topology_spec_requires_single_zone():
+    """AnnotationNUMATopologySpec SingleNUMANode: the pod needs a
+    one-zone fit on ANY node (even policy=None nodes); a pod too big for
+    one zone is unschedulable while a plain pod of the same size lands."""
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    topo = CPUTopology.uniform(
+        sockets=2, numa_per_socket=1, cores_per_numa=8, threads_per_core=1
+    )
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 16000, ext.RES_MEMORY: 65536}
+            ),
+        )
+    )
+    numa.register_node(
+        "n0", topo, NUMAPolicy.NONE, memory_per_zone_mib=32768
+    )
+    sched = BatchScheduler(snap, LoadAwareArgs(), numa=numa, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+
+    def spec_pod(name, cpu):
+        return Pod(
+            meta=ObjectMeta(
+                name=name,
+                annotations={
+                    ext.ANNOTATION_NUMA_TOPOLOGY_SPEC: json.dumps(
+                        {"numaTopologyPolicy": "SingleNUMANode"}
+                    )
+                },
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 1024},
+                priority=9000,
+            ),
+        )
+
+    # 12 cores > one 8-core zone: plain pod fits the node total, the
+    # single-numa-required pod does not
+    plain = Pod(
+        meta=ObjectMeta(name="plain"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 12000, ext.RES_MEMORY: 1024},
+            priority=9000,
+        ),
+    )
+    out = sched.schedule([spec_pod("req", 12000)])
+    assert out.bound == []
+    out2 = sched.schedule([plain])
+    assert len(out2.bound) == 1
+    # a zone-sized required pod lands and records its zone
+    snap2 = ClusterSnapshot()
+    numa2 = NUMAManager(snap2)
+    snap2.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 16000, ext.RES_MEMORY: 65536}
+            ),
+        )
+    )
+    numa2.register_node("n0", topo, NUMAPolicy.NONE, memory_per_zone_mib=32768)
+    sched2 = BatchScheduler(snap2, LoadAwareArgs(), numa=numa2, batch_bucket=64)
+    sched2.extender.monitor.stop_background()
+    out3 = sched2.schedule([spec_pod("ok", 6000)])
+    assert len(out3.bound) == 1
+    pod = out3.bound[0][0]
+    status = json.loads(pod.meta.annotations[ext.ANNOTATION_RESOURCE_STATUS])
+    assert status["numaNodeResources"][0]["node"] in (0, 1)
+
+
+# ---- node-level labels + kubelet allocs through the topology report ----
+
+
+def test_node_cpu_allocs_and_system_qos_reserved_in_scheduler():
+    """pod-cpu-allocs + kubelet reservedCPUs + exclusive system-qos CPUs
+    are pre-taken: a cpuset-bound pod can never receive them."""
+    from koordinator_tpu.api.types import NodeResourceTopology
+
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    topo = CPUTopology.uniform(
+        sockets=1, numa_per_socket=1, cores_per_numa=8, threads_per_core=1
+    )
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 8000, ext.RES_MEMORY: 32768}
+            ),
+        )
+    )
+    report = NodeResourceTopology(
+        meta=ObjectMeta(
+            name="n0",
+            annotations={
+                ext.ANNOTATION_NODE_CPU_ALLOCS: json.dumps(
+                    [{"namespace": "kube-system", "name": "g", "cpuset": "0-1"}]
+                ),
+                ext.ANNOTATION_NODE_SYSTEM_QOS_RESOURCE: json.dumps(
+                    {"cpuset": "2", "cpusetExclusive": True}
+                ),
+            },
+        ),
+        cpu_topology={
+            c.cpu_id: (c.core_id, c.numa_node, c.socket) for c in topo.cpus
+        },
+        topology_policy="SingleNUMANode",
+    )
+    numa.register_from_topology(report)
+    st = numa._nodes["n0"]
+    # 0,1 (kubelet alloc) + 2 (system qos) are gone
+    taken = st.accumulator._allocated
+    assert {0, 1, 2} <= taken
+    cpuset = st.accumulator.take("pod", 4)
+    assert cpuset is not None and not (cpuset & {0, 1, 2})
+
+
+def test_node_numa_allocate_strategy_least_allocated():
+    """LabelNodeNUMAAllocateStrategy=LeastAllocated spreads winners
+    across zones instead of bin-packing one zone first."""
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    topo = CPUTopology.uniform(
+        sockets=2, numa_per_socket=1, cores_per_numa=8, threads_per_core=1
+    )
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 16000, ext.RES_MEMORY: 65536}
+            ),
+        )
+    )
+    numa.register_node(
+        "n0", topo, NUMAPolicy.SINGLE_NUMA_NODE, memory_per_zone_mib=32768
+    )
+    numa._nodes["n0"].numa_allocate_strategy = (
+        ext.NODE_NUMA_STRATEGY_MOST_ALLOCATED
+    )
+    # MostAllocated: both pods pack into one (tighter) zone sequence:
+    # first pod zone 0, second pod joins zone 0 (more utilized)
+    res = numa.allocate_batch(
+        uids=["a", "b"],
+        annotations=[{}, {}],
+        node_names=["n0", "n0"],
+        cpu_milli=[2000.0, 2000.0],
+        mem_mib=[1024.0, 1024.0],
+        bind=[False, False],
+    )
+    assert all(r is not None for r in res)
+    zones = [numa._nodes["n0"].owners[u][0] for u in ("a", "b")]
+    assert zones[0] == zones[1]
+
+
+# ---- extended-resource-spec ----
+
+
+def test_extended_resource_spec_round_trip():
+    containers = {
+        "main": {
+            "requests": {ext.RES_BATCH_CPU: 2000, ext.RES_BATCH_MEMORY: 4096}
+        }
+    }
+    ann = {
+        ext.ANNOTATION_EXTENDED_RESOURCE_SPEC: ext.format_extended_resource_spec(
+            containers
+        )
+    }
+    parsed = ext.parse_extended_resource_spec(ann)
+    assert parsed["main"]["requests"][ext.RES_BATCH_CPU] == 2000
+    assert ext.parse_extended_resource_spec({}) == {}
