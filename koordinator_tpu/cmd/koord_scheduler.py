@@ -23,6 +23,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--config", default="", help="versioned plugin-args JSON (scheduler.config)"
     )
     parser.add_argument(
+        "--mesh",
+        type=int,
+        default=0,
+        metavar="N",
+        help=(
+            "multi-chip mode: shard the solver over an N-device (dp, tp) "
+            "mesh (parallel.sharded; pod rows on dp, node table on tp, "
+            "collectives over ICI). 0 = single-device. The reference "
+            "analog is the parallelism wired into the scheduler at "
+            "cmd/koord-scheduler/app/server.go:417"
+        ),
+    )
+    parser.add_argument(
         "--serve",
         default="",
         metavar="ADDR",
@@ -88,7 +101,19 @@ def main(
                 "config accepted but inert",
                 file=sys.stderr,
             )
-        service = SolverService(args=la_args, batch_bucket=args.batch_bucket)
+        mesh = None
+        if args.mesh > 0:
+            from ..parallel.sharded import make_mesh
+
+            mesh = make_mesh(args.mesh)
+            print(
+                f"koord-scheduler: solver sharded over mesh "
+                f"{dict(mesh.shape)}",
+                file=sys.stderr,
+            )
+        service = SolverService(
+            args=la_args, batch_bucket=args.batch_bucket, mesh=mesh
+        )
         server, port = serve(service, address=args.serve)
         print(f"koord-scheduler: solver service listening on port {port}", flush=True)
         stop = _stop_event if _stop_event is not None else threading.Event()
@@ -110,6 +135,15 @@ def main(
         return 0
 
     snap, _nodes, pods, hub = _common.build_snapshot(args)
+    mesh = None
+    if args.mesh > 0:
+        from ..parallel.sharded import make_mesh
+
+        mesh = make_mesh(args.mesh)
+        print(
+            f"koord-scheduler: solver sharded over mesh {dict(mesh.shape)}",
+            file=sys.stderr,
+        )
     numa = devices = None
     if numa_scoring is not None:
         import sys as _sys
@@ -149,7 +183,12 @@ def main(
                 file=_sys.stderr,
             )
     sched = BatchScheduler(
-        snap, la_args, batch_bucket=args.batch_bucket, numa=numa, devices=devices
+        snap,
+        la_args,
+        batch_bucket=args.batch_bucket,
+        numa=numa,
+        devices=devices,
+        mesh=mesh,
     )
     # the rest of the scheduler's world view (pods/devices/quotas/gangs)
     # flows through the same informer hub that already feeds the snapshot

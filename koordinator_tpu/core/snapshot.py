@@ -731,6 +731,7 @@ class ClusterSnapshot:
         numa_required = np.zeros(p_bucket, bool)
         non_preemptible = np.zeros(p_bucket, bool)
         preemptible_key = ext.LABEL_PREEMPTIBLE
+        disable_key = ext.LABEL_DISABLE_PREEMPTIBLE
         quota_key = ext.LABEL_QUOTA_NAME
         custom_est_key = ext.ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS
         numa_spec_key = ext.ANNOTATION_NUMA_TOPOLOGY_SPEC
@@ -740,7 +741,10 @@ class ClusterSnapshot:
             labels = meta.labels
             uids.append(meta.uid)
             quota_names.append(labels.get(quota_key))
-            if labels.get(preemptible_key) == "false":
+            if (
+                labels.get(preemptible_key) == "false"
+                or labels.get(disable_key) == "true"
+            ):
                 non_preemptible[i] = True
             if spec.estimated or spec.limits or custom_est_key in meta.annotations:
                 est_override[i] = True

@@ -163,18 +163,25 @@ class CpusetRule:
         if numa_nodes:
             if qos == QoSClass.BE:
                 if self.be_cpu_manager:
-                    return self._pools_cpuset(self.be_share_pools, numa_nodes)
+                    return (
+                        self._pools_cpuset(self.be_share_pools, numa_nodes)
+                        or None
+                    )
             else:
-                return self._pools_cpuset(self.share_pools, numa_nodes)
+                # empty/absent pools: hands off — '' is reserved for the
+                # deliberate BE clear, never for a missing report
+                return (
+                    self._pools_cpuset(self.share_pools, numa_nodes) or None
+                )
         if qos == QoSClass.SYSTEM and self.system_qos_cpuset:
             return self.system_qos_cpuset
         if qos == QoSClass.LS:
-            return self._pools_cpuset(self.share_pools)
+            return self._pools_cpuset(self.share_pools) or None
         if qos == QoSClass.BE:
             return ""
         if self.kubelet_policy == "static":
             return None
-        return self._pools_cpuset(self.share_pools)
+        return self._pools_cpuset(self.share_pools) or None
 
 
 def cpuset_plan(

@@ -75,7 +75,59 @@ def _pod_spec() -> PodBatch:
         gpu_share=P("dp"),
         rdma=P("dp"),
         fpga=P("dp"),
+        gang_nonstrict=P(),
+        numa_required=P("dp"),
     )
+
+
+def shard_solver_inputs(
+    mesh: Mesh,
+    pods: PodBatch | None = None,
+    nodes: NodeState | None = None,
+    quotas=None,
+    numa=None,
+    devices=None,
+    node_mask=None,
+    dev_carry=None,
+    params=None,
+):
+    """Place a production solve's inputs onto the mesh (pod rows on dp,
+    node-axis tables on tp, everything id-indexed replicated) and return
+    them in the same order. ``assign`` is jitted WITHOUT explicit
+    shardings, so GSPMD picks the layout up from these placements — the
+    BatchScheduler's mesh mode is exactly this call before dispatch
+    (reference analog: the parallelism wired into the real scheduler at
+    ``cmd/koord-scheduler/app/server.go:417``)."""
+
+    def put(tree, spec_fn):
+        if tree is None:
+            return None
+        return jax.device_put(
+            tree, jax.tree.map(lambda a: NamedSharding(mesh, spec_fn(a)), tree)
+        )
+
+    rep = lambda _a: P()
+    tp0 = lambda _a: P("tp")       # axis 0 on tp, rest replicated
+    out = (
+        put(pods, lambda a: _pod_leaf_spec(pods, a)),
+        put(nodes, tp0),
+        put(quotas, rep),
+        put(numa, tp0),
+        put(devices, tp0),
+        put(node_mask, lambda _a: P("dp", "tp")),
+        put(dev_carry, tp0),
+        put(params, rep),
+    )
+    return out
+
+
+def _pod_leaf_spec(pods: PodBatch, leaf) -> P:
+    """Per-leaf pod sharding: pod-row arrays on dp; gang/quota-id-indexed
+    arrays replicated (segment ops must stay local)."""
+    for name in ("gang_min", "gang_nonstrict"):
+        if getattr(pods, name) is leaf:
+            return P()
+    return P("dp") if leaf.ndim == 1 else P("dp", *([None] * (leaf.ndim - 1)))
 
 
 def _node_spec() -> NodeState:

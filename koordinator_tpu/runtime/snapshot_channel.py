@@ -56,11 +56,12 @@ class SolverService:
         args: Optional[LoadAwareArgs] = None,
         batch_bucket: int = 4096,
         assume_ttl: float = 900.0,
+        mesh=None,
     ):
         self.snapshot = snapshot or ClusterSnapshot()
         self.args = args or LoadAwareArgs()
         self.scheduler = BatchScheduler(
-            self.snapshot, self.args, batch_bucket=batch_bucket
+            self.snapshot, self.args, batch_bucket=batch_bucket, mesh=mesh
         )
         self.revision = 0
         #: seconds an optimistic nominate-side assume survives without a

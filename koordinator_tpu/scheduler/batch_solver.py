@@ -169,6 +169,7 @@ class BatchScheduler:
         enable_priority_preemption: bool = False,
         defer_gc: bool = True,
         percentage_of_nodes_to_score: int = 100,
+        mesh=None,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -238,6 +239,13 @@ class BatchScheduler:
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         #: rotating sample start (upstream nextStartNodeIndex analog)
         self._score_start = 0
+        #: multi-chip production mode: a jax.sharding.Mesh over ("dp",
+        #: "tp") — pod rows shard on dp, node-axis tables on tp, and
+        #: GSPMD inserts the ICI collectives inside the SAME jitted
+        #: solver (parallel.sharded; reference analog: parallelism wired
+        #: into the scheduler at cmd/koord-scheduler/app/server.go:417).
+        #: None = single-device dispatch.
+        self.mesh = mesh
 
     # ---- device lowering ----
 
@@ -347,9 +355,8 @@ class BatchScheduler:
         # non-preemptible pods: append the leaf's SHADOW quota index
         # (leaf + Q; runtime=min, used=nonPreemptibleUsed in the extended
         # solver table) so ordinary chain admission enforces the MIN
-        # bound in-batch (plugin.go:252-262). A full 4-level chain has no
-        # free slot — those rare pods fall back to the host-side
-        # has_headroom check at Reserve.
+        # bound in-batch (plugin.go:252-262). chains_for_names reserves a
+        # spare column beyond MAX_LEVELS, so a free slot always exists.
         nonpre = arrays.non_preemptible
         if (
             nonpre is not None
@@ -361,9 +368,7 @@ class BatchScheduler:
                 row = chains[i]
                 if row[0] < 0:
                     continue
-                free = np.nonzero(row < 0)[0]
-                if free.size:
-                    row[free[0]] = row[0] + q_count
+                row[np.nonzero(row < 0)[0][0]] = row[0] + q_count
         # stash the host-side rows for _commit: Reserve revalidation and
         # assume charges reuse these instead of recomputing res_vector /
         # estimate_pod per winner (the recompute was a measurable slice of
@@ -462,7 +467,10 @@ class BatchScheduler:
         reserved_bound: List[Tuple[Pod, str]] = []
         if self.reservations is not None:
             from .plugins.coscheduling import gang_key_of
-            from .plugins.elasticquota import quota_name_of
+            from .plugins.elasticquota import (
+                is_pod_non_preemptible as is_nonpre,
+                quota_name_of,
+            )
 
             # refresh the Available candidate cache once per cycle (the
             # per-pod match scan must not re-validate every reservation)
@@ -493,9 +501,7 @@ class BatchScheduler:
                 if leaf is not None and not self.quotas.has_headroom(
                     leaf,
                     pod.spec.requests,
-                    non_preemptible=(
-                        pod.meta.labels.get(ext.LABEL_PREEMPTIBLE) == "false"
-                    ),
+                    non_preemptible=is_nonpre(pod),
                 ):
                     retry_queue.append(pod)
                     continue
@@ -841,6 +847,27 @@ class BatchScheduler:
         numa_state, device_state = self._constraint_states(sub)
 
         nodes0 = self.node_state(sub)
+        if self.mesh is not None:
+            from ..parallel.sharded import shard_solver_inputs
+
+            (
+                _,
+                nodes0,
+                quotas0,
+                numa_state,
+                device_state,
+                _,
+                _,
+                _,
+            ) = shard_solver_inputs(
+                self.mesh,
+                nodes=nodes0,
+                quotas=quotas0,
+                numa=numa_state,
+                devices=device_state,
+            )
+            if quotas0 is not None:
+                qused = quotas0.used
         cur = nodes0
         dev_carry = None
         out: List[Tuple[List[Pod], LoweredRows, SolveResult]] = []
@@ -855,6 +882,12 @@ class BatchScheduler:
             node_mask = self._node_constraint_mask(
                 chunk, pods_t.requests.shape[0], sub
             )
+            if self.mesh is not None:
+                from ..parallel.sharded import shard_solver_inputs
+
+                (pods_t, _, _, _, _, node_mask, _, _) = shard_solver_inputs(
+                    self.mesh, pods=pods_t, node_mask=node_mask
+                )
             result = assign(
                 pods_t,
                 nodes_t,
@@ -952,6 +985,27 @@ class BatchScheduler:
         node_mask = self._node_constraint_mask(
             chunk, pods.requests.shape[0], sub
         )
+        if self.mesh is not None:
+            from ..parallel.sharded import shard_solver_inputs
+
+            (
+                pods,
+                nodes,
+                quotas,
+                numa_state,
+                device_state,
+                node_mask,
+                _,
+                _,
+            ) = shard_solver_inputs(
+                self.mesh,
+                pods=pods,
+                nodes=nodes,
+                quotas=quotas,
+                numa=numa_state,
+                devices=device_state,
+                node_mask=node_mask,
+            )
         return assign(
             pods,
             nodes,
@@ -1062,8 +1116,10 @@ class BatchScheduler:
         self.quotas.set_leaf_requests(by_leaf)
         # non-preemptible demand ledger for status stamping (leaf-level)
         np_by_leaf: Dict[str, np.ndarray] = {}
+        from .plugins.elasticquota import is_pod_non_preemptible
+
         for pod in chunk:
-            if pod.meta.labels.get(ext.LABEL_PREEMPTIBLE) != "false":
+            if not is_pod_non_preemptible(pod):
                 continue
             leaf = quota_name_of(pod)
             if leaf is None:

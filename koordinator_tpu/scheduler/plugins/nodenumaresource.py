@@ -183,10 +183,15 @@ class NUMAManager:
         zone_of = {c.cpu_id: c.numa_node for c in cpus}
         st = self._nodes[report.meta.name]
 
+        charged: set = set()
+
         def pre_take(owner: str, cpu_ids) -> None:
-            ids = set(int(c) for c in cpu_ids)
+            # overlapping reservations (system-QoS inside the kubelet
+            # reserved set is common) must charge each CPU's zone ONCE
+            ids = set(int(c) for c in cpu_ids) - charged
             if not ids:
                 return
+            charged.update(ids)
             st.accumulator.take_reserved(owner, ids)
             # zone feasibility must see the taken cores as used too
             for cid in ids:
@@ -210,8 +215,7 @@ class NUMAManager:
         if kubelet and kubelet.get("reservedCPUs"):
             pre_take(
                 "kubelet-policy-reserved",
-                parse_cpuset(str(kubelet["reservedCPUs"]))
-                - set(int(c) for c in report.kubelet_reserved_cpus),
+                parse_cpuset(str(kubelet["reservedCPUs"])),
             )
         sysqos = ext.parse_system_qos_resource(ann)
         if sysqos and sysqos.get("cpusetExclusive", True):

@@ -162,3 +162,63 @@ def test_shard_map_nominate_pads_ragged_node_table():
     np.testing.assert_array_equal(idx[finite], widx[finite])
     # no REAL finite candidate may ever point at a padded row
     assert (idx[np.isfinite(neg)] < n).all()
+
+
+def test_mesh_mode_production_scheduler_equality():
+    """VERDICT r3 #3: multi-chip as a production mode. The SAME
+    BatchScheduler pipeline (NUMA manager + DeviceManager + quota tree +
+    an Available reservation) run with mesh=(dp,tp) must place exactly
+    like the single-device path — including the per-winner cpusets,
+    device minors and reservation consumption."""
+    import __graft_entry__ as graft
+    from koordinator_tpu.parallel.sharded import make_mesh
+
+    mesh = make_mesh(8)
+    placed = graft._dryrun_production_scheduler(mesh)
+    assert placed == 49
+
+
+def test_mesh_mode_pipelined_multichunk():
+    """Mesh mode through the multi-chunk pipelined dispatch (chained
+    capacity on device): placements equal the single-device run."""
+    import copy
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.parallel.sharded import make_mesh
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+
+    def build(mesh):
+        snap = ClusterSnapshot()
+        for i in range(200):
+            snap.upsert_node(
+                Node(
+                    meta=ObjectMeta(name=f"n{i:03d}"),
+                    status=NodeStatus(
+                        allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 131072}
+                    ),
+                )
+            )
+        sched = BatchScheduler(
+            snap, LoadAwareArgs(), batch_bucket=128, mesh=mesh
+        )
+        sched.extender.monitor.stop_background()
+        return sched
+
+    pods = [
+        Pod(
+            meta=ObjectMeta(name=f"p{i:04d}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 2048},
+                priority=9000,
+            ),
+        )
+        for i in range(400)  # 4 chunks of 128 → pipelined dispatch
+    ]
+    single = build(None).schedule(copy.deepcopy(pods))
+    meshed = build(make_mesh(8)).schedule(copy.deepcopy(pods))
+    a = {p.meta.uid: n for p, n in single.bound}
+    b = {p.meta.uid: n for p, n in meshed.bound}
+    assert len(a) == len(pods)
+    assert a == b
