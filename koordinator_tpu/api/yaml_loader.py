@@ -1,0 +1,367 @@
+"""Koordinator-format YAML ingestion: manifests → ``api.types`` objects.
+
+The reference is driven by YAML (CRDs under ``config/crd/bases/``, demos
+under ``examples/spark-jobs/`` — e.g.
+``cluster-colocation-profile.yaml``); this module is the rebuild's front
+door for the same wire format: multi-document YAML in, typed objects out,
+dispatched by (apiVersion, kind). Resource quantities normalize to
+snapshot units (cpu → milli-cores, memory → MiB, extended resources
+native), matching ``PodSpec``'s documented convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from . import extension as ext
+from .types import (
+    ClusterColocationProfile,
+    Device,
+    DeviceInfo,
+    ElasticQuota,
+    ElasticQuotaProfile,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodSpec,
+    Reservation,
+    ReservationOwner,
+)
+
+#: well-known PriorityClass names → default priority values (reference
+#: ``apis/extension/priority.go:29-48`` band bases; the classes ship as
+#: PriorityClass objects with these values)
+PRIORITY_CLASS_VALUES = {
+    "koord-prod": 9000,
+    "koord-mid": 7000,
+    "koord-batch": 5000,
+    "koord-free": 3000,
+}
+
+_QUANTITY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+_BINARY = {"Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30, "Ti": 1 << 40}
+_DECIMAL = {"k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "m": 1e-3, "": 1.0}
+
+
+def parse_quantity(value) -> float:
+    """k8s resource.Quantity → float base units ("500m" → 0.5,
+    "2Gi" → 2147483648, "1" → 1.0)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _QUANTITY_RE.match(str(value).strip())
+    if not m:
+        raise ValueError(f"malformed quantity: {value!r}")
+    num, suffix = float(m.group(1)), m.group(2)
+    if suffix in _BINARY:
+        return num * _BINARY[suffix]
+    if suffix in _DECIMAL:
+        return num * _DECIMAL[suffix]
+    raise ValueError(f"unknown quantity suffix: {value!r}")
+
+
+def _cpu_milli(value) -> float:
+    return parse_quantity(value) * 1000.0
+
+
+def _mem_mib(value) -> float:
+    return parse_quantity(value) / (1 << 20)
+
+
+def convert_resource_list(rl: Mapping) -> Dict[str, float]:
+    """k8s ResourceList → snapshot units (cpu milli, memory MiB,
+    batch-cpu already milli-denominated, everything else native)."""
+    out: Dict[str, float] = {}
+    for name, raw in (rl or {}).items():
+        if name == ext.RES_CPU:
+            out[name] = _cpu_milli(raw)
+        elif name == ext.RES_MEMORY:
+            out[name] = _mem_mib(raw)
+        elif name == ext.RES_BATCH_MEMORY:
+            out[name] = _mem_mib(raw)
+        elif name in (ext.RES_BATCH_CPU,):
+            # batch-cpu is milli-denominated on the wire (resource.go)
+            out[name] = parse_quantity(raw)
+        else:
+            out[name] = parse_quantity(raw)
+    return out
+
+
+@dataclasses.dataclass
+class NamespaceInfo:
+    """v1/Namespace — carried for profile namespaceSelector matching."""
+
+    name: str
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _meta(doc: Mapping) -> ObjectMeta:
+    md = doc.get("metadata") or {}
+    return ObjectMeta(
+        name=str(md.get("name", "")),
+        namespace=str(md.get("namespace", "default")),
+        labels={str(k): str(v) for k, v in (md.get("labels") or {}).items()},
+        annotations={
+            str(k): str(v) for k, v in (md.get("annotations") or {}).items()
+        },
+    )
+
+
+def _pod(doc: Mapping) -> Pod:
+    meta = _meta(doc)
+    spec = doc.get("spec") or {}
+    requests: Dict[str, float] = {}
+    limits: Dict[str, float] = {}
+    for c in spec.get("containers") or []:
+        res = c.get("resources") or {}
+        for k, v in convert_resource_list(res.get("requests") or {}).items():
+            requests[k] = requests.get(k, 0.0) + v
+        for k, v in convert_resource_list(res.get("limits") or {}).items():
+            limits[k] = limits.get(k, 0.0) + v
+    priority = spec.get("priority")
+    if priority is None:
+        priority = PRIORITY_CLASS_VALUES.get(spec.get("priorityClassName", ""))
+    pod_spec = PodSpec(
+        requests=requests,
+        limits=limits,
+        priority=priority,
+        node_name=spec.get("nodeName"),
+        node_selector={
+            str(k): str(v)
+            for k, v in (spec.get("nodeSelector") or {}).items()
+        },
+    )
+    if spec.get("schedulerName"):
+        pod_spec.scheduler_name = str(spec["schedulerName"])
+    return Pod(meta=meta, spec=pod_spec)
+
+
+def _node(doc: Mapping) -> Node:
+    meta = _meta(doc)
+    status = doc.get("status") or {}
+    return Node(
+        meta=meta,
+        status=NodeStatus(
+            allocatable=convert_resource_list(status.get("allocatable") or {}),
+            capacity=convert_resource_list(status.get("capacity") or {}),
+        ),
+    )
+
+
+def _profile(doc: Mapping) -> ClusterColocationProfile:
+    spec = doc.get("spec") or {}
+    qos = spec.get("qosClass")
+    priority = spec.get("koordinatorPriority")
+    prio_class = spec.get("priorityClassName", "")
+    labels = {
+        str(k): str(v) for k, v in (spec.get("labels") or {}).items()
+    }
+    if prio_class:
+        # the reference profile sets the k8s PriorityClass; the priority
+        # VALUE that matters for banding comes from the class table
+        labels.setdefault(ext.LABEL_POD_PRIORITY_CLASS, prio_class)
+    base = PRIORITY_CLASS_VALUES.get(prio_class)
+    eff_priority = None
+    if base is not None:
+        # koordinatorPriority is the intra-band sub-priority (reference
+        # LabelPodPriority); the scheduling priority stays the band base
+        eff_priority = base
+    if priority is not None:
+        labels[ext.LABEL_POD_PRIORITY] = str(priority)
+    translation = {}
+    if qos == "BE" or prio_class == "koord-batch":
+        # batch-tier profiles run pods on the overcommitted batch
+        # resources (how Spark pods become BE — the webhook rewrites
+        # requests to kubernetes.io/batch-*)
+        translation = {
+            ext.RES_CPU: ext.RES_BATCH_CPU,
+            ext.RES_MEMORY: ext.RES_BATCH_MEMORY,
+        }
+    return ClusterColocationProfile(
+        meta=_meta(doc),
+        selector={
+            str(k): str(v)
+            for k, v in (
+                (spec.get("selector") or {}).get("matchLabels") or {}
+            ).items()
+        },
+        namespace_selector={
+            str(k): str(v)
+            for k, v in (
+                (spec.get("namespaceSelector") or {}).get("matchLabels") or {}
+            ).items()
+        },
+        labels=labels,
+        annotations={
+            str(k): str(v) for k, v in (spec.get("annotations") or {}).items()
+        },
+        qos_class=ext.QoSClass.parse(qos) if qos else None,
+        priority=eff_priority,
+        scheduler_name=spec.get("schedulerName"),
+        resource_translation=translation,
+    )
+
+
+def _reservation(doc: Mapping) -> Reservation:
+    spec = doc.get("spec") or {}
+    template = (spec.get("template") or {}).get("spec") or {}
+    requests: Dict[str, float] = {}
+    for c in template.get("containers") or []:
+        res = c.get("resources") or {}
+        for k, v in convert_resource_list(res.get("requests") or {}).items():
+            requests[k] = requests.get(k, 0.0) + v
+    owners = []
+    for o in spec.get("owners") or []:
+        sel = (o.get("labelSelector") or {}).get("matchLabels") or {}
+        owners.append(
+            ReservationOwner(
+                label_selector={str(k): str(v) for k, v in sel.items()},
+                namespace=(o.get("object") or {}).get("namespace"),
+            )
+        )
+    ttl = spec.get("ttl")
+    ttl_s = None
+    if ttl:
+        m = re.match(r"^(\d+)([smh])$", str(ttl))
+        if m:
+            ttl_s = float(m.group(1)) * {"s": 1, "m": 60, "h": 3600}[m.group(2)]
+    return Reservation(
+        meta=_meta(doc),
+        requests=requests,
+        owners=owners,
+        allocate_once=bool(spec.get("allocateOnce", True)),
+        ttl_s=ttl_s,
+        allocate_policy=spec.get("allocatePolicy", ""),
+    )
+
+
+def _device(doc: Mapping) -> Device:
+    spec = doc.get("spec") or {}
+    infos = []
+    for d in spec.get("devices") or []:
+        topo = d.get("topology") or {}
+        infos.append(
+            DeviceInfo(
+                dev_type=str(d.get("type", "gpu")).lower(),
+                minor=int(d.get("minor", 0)),
+                resources=convert_resource_list(d.get("resources") or {}),
+                numa_node=int(topo.get("nodeID", -1)),
+                pcie_bus=str(topo.get("pcieID", "")),
+                vfs=[
+                    str(vf.get("busID", ""))
+                    for vf in (d.get("vfGroups") or [{}])[0].get("vfs", [])
+                ]
+                if d.get("vfGroups")
+                else [],
+            )
+        )
+    return Device(meta=_meta(doc), devices=infos)
+
+
+def _elastic_quota(doc: Mapping) -> ElasticQuota:
+    meta = _meta(doc)
+    spec = doc.get("spec") or {}
+    eq = ElasticQuota(
+        meta=meta,
+        min=convert_resource_list(spec.get("min") or {}),
+        max=convert_resource_list(spec.get("max") or {}),
+        parent=meta.labels.get(ext.LABEL_QUOTA_PARENT, ""),
+        is_parent=meta.labels.get(ext.LABEL_QUOTA_IS_PARENT) == "true",
+        tree_id=meta.labels.get(ext.LABEL_QUOTA_TREE_ID, ""),
+        is_root=meta.labels.get(ext.LABEL_QUOTA_IS_ROOT) == "true",
+    )
+    return eq
+
+
+def _pod_group(doc: Mapping) -> PodGroup:
+    spec = doc.get("spec") or {}
+    return PodGroup(
+        meta=_meta(doc),
+        min_member=int(spec.get("minMember", 0)),
+    )
+
+
+def _quota_profile(doc: Mapping) -> ElasticQuotaProfile:
+    spec = doc.get("spec") or {}
+    return ElasticQuotaProfile(
+        meta=_meta(doc),
+        quota_name=spec.get("quotaName", ""),
+        node_selector={
+            str(k): str(v)
+            for k, v in (
+                (spec.get("nodeSelector") or {}).get("matchLabels") or {}
+            ).items()
+        },
+        quota_labels={
+            str(k): str(v)
+            for k, v in (spec.get("quotaLabels") or {}).items()
+        },
+        resource_keys=[str(r) for r in spec.get("resourceKeys") or []],
+    )
+
+
+def _namespace(doc: Mapping) -> NamespaceInfo:
+    md = doc.get("metadata") or {}
+    return NamespaceInfo(
+        name=str(md.get("name", "")),
+        labels={str(k): str(v) for k, v in (md.get("labels") or {}).items()},
+    )
+
+
+_CONVERTERS = {
+    ("v1", "Pod"): _pod,
+    ("v1", "Node"): _node,
+    ("v1", "Namespace"): _namespace,
+    ("config.koordinator.sh/v1alpha1", "ClusterColocationProfile"): _profile,
+    ("scheduling.koordinator.sh/v1alpha1", "Reservation"): _reservation,
+    ("scheduling.koordinator.sh/v1alpha1", "Device"): _device,
+    ("scheduling.sigs.k8s.io/v1alpha1", "ElasticQuota"): _elastic_quota,
+    ("scheduling.sigs.k8s.io/v1alpha1", "PodGroup"): _pod_group,
+    ("quota.koordinator.sh/v1alpha1", "ElasticQuotaProfile"): _quota_profile,
+}
+
+
+def load_objects(text: str) -> List[object]:
+    """Parse multi-document Koordinator YAML into typed objects.
+    Unrecognized (apiVersion, kind) documents are returned as raw dicts so
+    callers can dispatch further (e.g. the slo-controller-config
+    ConfigMap, third-party kinds like SparkApplication)."""
+    import yaml
+
+    out: List[object] = []
+    for doc in yaml.safe_load_all(text):
+        if not isinstance(doc, dict):
+            continue
+        key = (str(doc.get("apiVersion", "")), str(doc.get("kind", "")))
+        conv = _CONVERTERS.get(key)
+        out.append(conv(doc) if conv else doc)
+    return out
+
+
+def load_file(path: str) -> List[object]:
+    with open(path) as f:
+        return load_objects(f.read())
+
+
+def load_slo_controller_config(doc: Mapping) -> Optional[Dict]:
+    """Extract the slo-controller-config ConfigMap's strategy JSON blobs
+    (the dynamic-config channel the nodeslo controller renders from —
+    reference ``apis/configuration/slo_controller_config.go``). Returns
+    {key: parsed dict} or None when the doc is not that ConfigMap."""
+    if not isinstance(doc, Mapping) or doc.get("kind") != "ConfigMap":
+        return None
+    name = (doc.get("metadata") or {}).get("name", "")
+    if name != "slo-controller-config":
+        return None
+    import json
+
+    out: Dict[str, Dict] = {}
+    for key, raw in (doc.get("data") or {}).items():
+        try:
+            out[str(key)] = json.loads(raw)
+        except (ValueError, TypeError):
+            continue
+    return out
