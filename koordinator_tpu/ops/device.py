@@ -8,11 +8,16 @@ slots in percent units (100 = one whole free GPU, matching the
 ``apis/extension/device_share.go``). A pod requests either K whole GPUs
 (``nvidia.com/gpu``) or a fraction of one (ratio < 100).
 
-The solver masks feasibility from the exact per-slot state lowered at
-batch start; intra-batch consumption uses conservative node aggregates
-(whole-slot count + total percent) — the host DeviceManager revalidates
-winners against exact slots, so approximation can only under-place within
-one batch, never overcommit.
+The solver carries the exact per-slot table ``slot_free`` [N, G] through
+its commit rounds (the on-device analog of the reference's per-minor
+``deviceResources`` map in ``device_cache.go``): whole-GPU winners zero
+fully-free slots (interchangeable capacity — the host assigns concrete
+minors), and one fractional winner per node per round takes a best-fit
+bite matching the host allocator's tightest-partial-else-open-full rule
+(``allocator_gpu.go:1-451``). Intra-batch state is therefore exact; the
+host DeviceManager still revalidates winners at Reserve, but with
+matching selection rules a reject implies a real inventory change, not
+accounting drift.
 """
 
 from __future__ import annotations
@@ -46,12 +51,23 @@ class DeviceState:
 
     def aggregates(self):
         """(full_count [N], partial_max [N], total [N])."""
-        full = jnp.sum(self.slot_free >= FULL - EPS, axis=1).astype(jnp.float32)
-        partial = jnp.max(
-            jnp.where(self.slot_free >= FULL - EPS, 0.0, self.slot_free), axis=1
-        )
-        total = jnp.sum(self.slot_free, axis=1)
+        full, partial, _smax, total = slot_stats(self.slot_free)
         return full, partial, total
+
+
+def slot_stats(slot_free: jnp.ndarray):
+    """Round-start reductions over the slot table.
+
+    Returns ``(full_count [N], partial_max [N], slot_max [N], total [N])``
+    — the count of fully-free slots, the largest partially-free slot, the
+    largest slot of any kind, and the summed free percent.
+    """
+    is_full = slot_free >= FULL - EPS
+    full = jnp.sum(is_full, axis=1).astype(jnp.float32)
+    partial = jnp.max(jnp.where(is_full, 0.0, slot_free), axis=1)
+    smax = jnp.max(slot_free, axis=1)
+    total = jnp.sum(slot_free, axis=1)
+    return full, partial, smax, total
 
 
 def device_fit_mask(
@@ -59,26 +75,27 @@ def device_fit_mask(
     gpu_share: jnp.ndarray,    # [P] float32 — percent of one GPU (0 = none)
     full_count: jnp.ndarray,   # [N]
     partial_max: jnp.ndarray,  # [N]
+    slot_max: jnp.ndarray = None,  # [N] largest slot of any kind
     rdma_req: jnp.ndarray = None,   # [P] int32 — whole RDMA NICs
     rdma_free: jnp.ndarray = None,  # [N] free NIC count
     fpga_req: jnp.ndarray = None,   # [P] int32 — whole FPGAs
     fpga_free: jnp.ndarray = None,  # [N] free FPGA count
 ) -> jnp.ndarray:
-    """[P, N] GPU feasibility (reference Filter, ``plugin.go:311``).
+    """[P, N] GPU feasibility (reference Filter, ``plugin.go:311``), exact
+    against the per-slot table's round-start reductions.
 
-    Whole-GPU pods need that many fully-free slots; fractional pods need a
-    partial slot with enough headroom or one fully-free slot to open.
+    Whole-GPU pods need that many fully-free slots. Fractional-only pods
+    need any slot (partial or full) with enough headroom. Combined
+    whole+share pods need K fully-free slots *plus* either a (K+1)-th full
+    slot or a partial slot that fits the remainder.
     """
+    if slot_max is None:
+        slot_max = jnp.maximum(
+            partial_max, jnp.where(full_count >= 1.0 - EPS, FULL, 0.0)
+        )
     whole_ok = gpu_whole[:, None].astype(jnp.float32) <= full_count[None, :] + EPS
     frac = gpu_share[:, None]
-    frac_ok = (
-        (frac <= partial_max[None, :] + EPS)
-        | (full_count[None, :] >= 1.0 - EPS)
-        | (frac <= EPS)
-    )
-    # pods requesting both whole + share (K GPUs and a remainder) need
-    # whole_ok for K and frac capacity beyond those K slots; approximate
-    # by requiring an extra full slot when both are present.
+    frac_ok = (frac <= slot_max[None, :] + EPS) | (frac <= EPS)
     both = (gpu_whole[:, None] > 0) & (frac > EPS)
     both_ok = (
         gpu_whole[:, None].astype(jnp.float32) + 1.0 <= full_count[None, :] + EPS
@@ -100,14 +117,91 @@ def device_fit_mask(
 def device_consumption(
     gpu_whole: jnp.ndarray, gpu_share: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-pod in-round consumption: (full_slots [P], total_percent [P]).
+    """Per-pod total-percent demand: (full_slots [P], total_percent [P]).
 
-    Fractional pods charge only the total-percent axis (optimistic about
-    slot fragmentation): the cumulative total check bounds overcommit per
-    node and the host DeviceManager revalidates winners against exact
-    slots, so optimism costs at most a host-side reject, while pessimism
-    would silently under-place whole batches.
+    Used by the DeviceShare Score term (Least/MostAllocated over GPU
+    capacity) — commit accounting is per-slot (:func:`slot_commit`).
     """
     full = gpu_whole.astype(jnp.float32)
     total = gpu_whole.astype(jnp.float32) * FULL + gpu_share
     return full, total
+
+
+def slot_commit(
+    slot_free: jnp.ndarray,       # [N, G]
+    whole_taken: jnp.ndarray,     # [N] float — fully-free slots consumed
+    frac_share: jnp.ndarray,      # [N] float — the node's single fractional winner's share
+    frac_opens_full: jnp.ndarray,  # [N] bool — that winner bites a fully-free slot
+) -> jnp.ndarray:
+    """Apply one commit round's final winners to the slot table.
+
+    Mirrors the host allocator (``allocator_gpu.go``): whole-GPU demand
+    zeroes ``whole_taken`` fully-free slots (any — minors are
+    interchangeable capacity; the host picks concrete ones at Reserve);
+    the fractional winner either opens the next fully-free slot
+    (``frac_opens_full``) or takes a best-fit bite from the tightest
+    partial slot that still fits. At most one fractional winner per node
+    per round is admitted by the solver, so the best-fit target is
+    uncontended.
+    """
+    g = slot_free.shape[1]
+    is_full = slot_free >= FULL - EPS
+    # rank of each slot among the node's fully-free slots, by minor index
+    full_rank = jnp.cumsum(is_full.astype(jnp.int32), axis=1) - 1
+    w = whole_taken[:, None]
+    consumed = is_full & (full_rank.astype(jnp.float32) < w - 0.5)
+    opened = (
+        is_full
+        & (jnp.abs(full_rank.astype(jnp.float32) - w) < 0.5)
+        & frac_opens_full[:, None]
+    )
+    # best-fit partial: tightest partially-free slot with enough headroom
+    partial_free = jnp.where(is_full, jnp.inf, slot_free)
+    cand = jnp.where(
+        partial_free >= frac_share[:, None] - EPS, partial_free, jnp.inf
+    )
+    tgt = jnp.argmin(cand, axis=1)                                   # [N]
+    has_cand = jnp.isfinite(jnp.min(cand, axis=1))
+    take_partial = (frac_share > EPS) & ~frac_opens_full & has_cand
+    partial_hit = take_partial[:, None] & (
+        jnp.arange(g)[None, :] == tgt[:, None]
+    )
+    out = jnp.where(consumed, 0.0, slot_free)
+    out = jnp.where(opened, FULL - frac_share[:, None], out)
+    out = out - jnp.where(partial_hit, frac_share[:, None], 0.0)
+    return out
+
+
+def slot_refund(
+    slot_free: jnp.ndarray,
+    refund: jnp.ndarray,
+    slot_exists: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Water-fill ``refund`` [N] percent back onto the slot table,
+    emptiest slot first, each capped at FULL.
+
+    Gang rollback returns capacity in aggregate (the rolled-back pods'
+    concrete slots are not identifiable from carried state); filling the
+    emptiest slots first reconstructs the pre-consumption table exactly in
+    the common case (whole-GPU members zeroed slots that were fully free)
+    and is conservative otherwise — a refunded slot never exceeds FULL, so
+    the host revalidation at Reserve remains the overcommit backstop.
+
+    ``slot_exists`` [N, G] bool marks REAL slots: heterogeneous
+    inventories pad the table with zero rows (``slot_array``), and a
+    refund landing on a padding slot would both fabricate capacity and
+    strand the real slot's refund. Padding slots get zero headroom.
+    """
+    n, g = slot_free.shape
+    order = jnp.argsort(slot_free, axis=1)
+    s = jnp.take_along_axis(slot_free, order, axis=1)
+    headroom = FULL - s
+    if slot_exists is not None:
+        exists = jnp.take_along_axis(slot_exists, order, axis=1)
+        headroom = jnp.where(exists, headroom, 0.0)
+    cum_prev = jnp.cumsum(headroom, axis=1) - headroom
+    fill = jnp.clip(refund[:, None] - cum_prev, 0.0, headroom)
+    filled = s + fill
+    return jnp.zeros_like(slot_free).at[
+        jnp.arange(n)[:, None], order
+    ].set(filled)

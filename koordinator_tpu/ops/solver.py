@@ -287,12 +287,13 @@ class SolveResult:
     node_prod_used: jnp.ndarray   # [N, D] post-commit
     quota_used: jnp.ndarray       # [Q, D] post-commit
     rounds_used: jnp.ndarray      # [] int32
-    #: post-commit conservative GPU aggregates ([N] free whole slots, [N]
-    #: free total percent) — zeros when the solve had no DeviceState; feed
-    #: back via ``assign(dev_carry=...)`` to chain device capacity across
-    #: chunks without a host round-trip
-    node_dev_full: jnp.ndarray = None
-    node_dev_total: jnp.ndarray = None
+    #: post-commit exact per-slot GPU table [N, G] (placeholder [N, 1]
+    #: zeros when the solve had no DeviceState) plus free RDMA/FPGA counts
+    #: [N]; feed back via ``assign(dev_carry=...)`` to chain device
+    #: capacity across chunks without a host round-trip
+    node_dev_slots: jnp.ndarray = None
+    node_rdma_free: jnp.ndarray = None
+    node_fpga_free: jnp.ndarray = None
 
 
 def _quota_headroom(
@@ -477,7 +478,7 @@ def assign(
     nomination_jitter: float = 4.0,
     approx_topk: bool = False,
     node_mask: "jnp.ndarray | None" = None,
-    dev_carry: "tuple[jnp.ndarray, jnp.ndarray] | None" = None,
+    dev_carry: "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray] | None" = None,
     numa_scoring: "str | None" = None,
     device_scoring: "str | None" = None,
 ) -> SolveResult:
@@ -566,19 +567,32 @@ def assign(
     else:
         numa_score_term = None
     if devices is not None:
-        from .device import device_consumption, device_fit_mask
-
-        dev_full0, dev_partial, dev_total0 = devices.aggregates()
-        if dev_carry is not None:
-            # chained aggregates from a previous chunk's SolveResult (the
-            # per-slot partial_max stays from the lowering — conservative
-            # fragmentation estimate; the host DeviceManager revalidates)
-            dev_full0, dev_total0 = dev_carry
-        sdev_full, sdev_total = device_consumption(
-            spods.gpu_whole, spods.gpu_share
+        from .device import (
+            device_consumption,
+            device_fit_mask,
+            slot_commit,
+            slot_stats,
         )
+
+        slots0 = devices.slot_free
+        rdma_tracked = devices.rdma_free is not None
+        fpga_tracked = devices.fpga_free is not None
+        rdma0 = (
+            devices.rdma_free if rdma_tracked else jnp.zeros((n,), jnp.float32)
+        )
+        fpga0 = (
+            devices.fpga_free if fpga_tracked else jnp.zeros((n,), jnp.float32)
+        )
+        if dev_carry is not None:
+            # exact per-slot table (+ RDMA/FPGA counts) chained from a
+            # previous chunk's SolveResult — no host round-trip between
+            slots0, rdma0, fpga0 = dev_carry
+        _, sdev_total = device_consumption(spods.gpu_whole, spods.gpu_share)
+        sdev_rdma = spods.rdma.astype(jnp.float32)
+        sdev_fpga = spods.fpga.astype(jnp.float32)
     else:
-        dev_full0 = dev_total0 = jnp.zeros((n,), jnp.float32)
+        slots0 = jnp.zeros((n, 1), jnp.float32)
+        rdma0 = fpga0 = jnp.zeros((n,), jnp.float32)
 
     def round_body(carry):
         (
@@ -587,8 +601,9 @@ def assign(
             est_used,
             prod_used,
             qused,
-            dev_full,
-            dev_total,
+            dev_slots,
+            rdma_free,
+            fpga_free,
             active,
             _progress,
             r,
@@ -617,15 +632,18 @@ def assign(
         if numa is not None:
             feas &= numa_mask
         if devices is not None:
+            # exact round-start reductions over the carried slot table
+            dev_full, dev_partial, dev_smax, dev_total = slot_stats(dev_slots)
             feas &= device_fit_mask(
                 spods.gpu_whole,
                 spods.gpu_share,
                 dev_full,
                 dev_partial,
+                slot_max=dev_smax,
                 rdma_req=spods.rdma,
-                rdma_free=devices.rdma_free,
+                rdma_free=rdma_free if rdma_tracked else None,
                 fpga_req=spods.fpga,
-                fpga_free=devices.fpga_free,
+                fpga_free=fpga_free if fpga_tracked else None,
             )
         cost = cost_ops.load_aware_cost(
             spods.estimate,
@@ -719,13 +737,34 @@ def assign(
         accept = snode < n
         accept &= jnp.all(req0_g + seg_req <= alloc_g + EPS, axis=-1)
         if devices is not None:
-            # conservative intra-round GPU accounting (see ops.device)
-            sfull_g = sdev_full[sortidx]
-            stotal_g = sdev_total[sortidx]
-            seg_full = _segment_prefix_sums(sfull_g[:, None], is_start)[:, 0]
-            seg_total = _segment_prefix_sums(stotal_g[:, None], is_start)[:, 0]
+            # Exact intra-round GPU accounting over the slot table: whole
+            # demand is prefix-checked against the fully-free slot count
+            # (slots are interchangeable, so any K ≤ full_count commits
+            # are simultaneously satisfiable); a fractional pod whose
+            # share exceeds the node's best partial slot must open a full
+            # one and is charged for it; and only the FIRST fractional
+            # pod per node per round commits — its best-fit target is
+            # then uncontended, so the post-round slot_commit reproduces
+            # the host allocator's state exactly.
+            swhole = spods.gpu_whole[sortidx].astype(jnp.float32)
+            sshare = spods.gpu_share[sortidx]
+            s_is_frac = sshare > EPS
+            s_opens_full = s_is_frac & (sshare > dev_partial[gnode] + EPS)
+            full_charge = swhole + s_opens_full.astype(jnp.float32)
+            seg_full = _segment_prefix_sums(full_charge[:, None], is_start)[:, 0]
+            seg_frac = _segment_prefix_sums(
+                s_is_frac.astype(jnp.float32)[:, None], is_start
+            )[:, 0]
             accept &= seg_full <= dev_full[gnode] + EPS
-            accept &= seg_total <= dev_total[gnode] + EPS
+            accept &= ~s_is_frac | (seg_frac - s_is_frac.astype(jnp.float32) < 0.5)
+            if rdma_tracked:
+                s_rdma = sdev_rdma[sortidx]
+                seg_rdma = _segment_prefix_sums(s_rdma[:, None], is_start)[:, 0]
+                accept &= seg_rdma <= rdma_free[gnode] + EPS
+            if fpga_tracked:
+                s_fpga = sdev_fpga[sortidx]
+                seg_fpga = _segment_prefix_sums(s_fpga[:, None], is_start)[:, 0]
+                accept &= seg_fpga <= fpga_free[gnode] + EPS
         # Intra-round cumulative usage-threshold check keeps the commit
         # faithful to sequential Filter semantics (load_aware.go:290-313,
         # rounded-percent comparison).
@@ -781,25 +820,45 @@ def assign(
             num_segments=n,
         )
         if devices is not None:
-            ddev = jax.ops.segment_sum(
-                jnp.where(
-                    final_node[:, None],
-                    jnp.stack([sdev_full[sortidx], sdev_total[sortidx]], 1),
-                    jnp.zeros((p, 2)),
-                ),
+            # per-node winner aggregates: total whole slots zeroed, the
+            # (single) fractional winner's share + whether it opens a
+            # full slot — then one vectorized [N, G] slot_commit
+            whole_taken = jax.ops.segment_sum(
+                jnp.where(final_node, swhole, 0.0), seg_ids, num_segments=n
+            )
+            frac_share = jax.ops.segment_sum(
+                jnp.where(final_node & s_is_frac, sshare, 0.0),
                 seg_ids,
                 num_segments=n,
             )
-            dev_full = dev_full - ddev[:, 0]
-            dev_total = dev_total - ddev[:, 1]
+            frac_opens = (
+                jax.ops.segment_sum(
+                    jnp.where(
+                        final_node & s_opens_full, 1.0, 0.0
+                    ),
+                    seg_ids,
+                    num_segments=n,
+                )
+                > 0.5
+            )
+            dev_slots = slot_commit(dev_slots, whole_taken, frac_share, frac_opens)
+            if rdma_tracked:
+                rdma_free = rdma_free - jax.ops.segment_sum(
+                    jnp.where(final_node, s_rdma, 0.0), seg_ids, num_segments=n
+                )
+            if fpga_tracked:
+                fpga_free = fpga_free - jax.ops.segment_sum(
+                    jnp.where(final_node, s_fpga, 0.0), seg_ids, num_segments=n
+                )
         return (
             assigned,
             requested + dreq,
             est_used + dest,
             prod_used + dprod,
             qused_new,
-            dev_full,
-            dev_total,
+            dev_slots,
+            rdma_free,
+            fpga_free,
             active & (assigned < 0),
             jnp.any(final_prio),
             r + 1,
@@ -815,8 +874,9 @@ def assign(
         nodes.estimated_used,
         nodes.prod_used,
         quotas.used,
-        dev_full0,
-        dev_total0,
+        slots0,
+        rdma0,
+        fpga0,
         pods.valid[order],
         jnp.array(True),
         jnp.array(0, jnp.int32),
@@ -827,8 +887,9 @@ def assign(
         est_f,
         prod_f,
         qused_f,
-        dev_full_f,
-        dev_total_f,
+        slots_f,
+        rdma_f,
+        fpga_f,
         _active,
         _prog,
         rounds,
@@ -843,10 +904,21 @@ def assign(
         node_prod_used=prod_f,
         quota_used=qused_f,
         rounds_used=rounds,
-        node_dev_full=dev_full_f,
-        node_dev_total=dev_total_f,
+        node_dev_slots=slots_f,
+        node_rdma_free=rdma_f,
+        node_fpga_free=fpga_f,
     )
-    return enforce_gangs(result, pods)
+    if devices is not None and devices.cap_total is not None:
+        # heterogeneous inventories pad the slot table with zero rows —
+        # gang refunds must never water-fill onto a padding slot
+        g_slots = slots0.shape[1]
+        slot_exists = (
+            jnp.arange(g_slots)[None, :]
+            < (devices.cap_total / 100.0)[:, None]
+        )
+    else:
+        slot_exists = None
+    return enforce_gangs(result, pods, slot_exists)
 
 
 @functools.partial(
@@ -922,7 +994,11 @@ def solve_stream(
 
 
 @jax.jit
-def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
+def enforce_gangs(
+    result: SolveResult,
+    pods: PodBatch,
+    slot_exists: "jnp.ndarray | None" = None,
+) -> SolveResult:
     """All-or-nothing gang rollback (Coscheduling Permit semantics,
     reference ``pkg/scheduler/plugins/coscheduling/core/core.go:346-465``:
     bound-ready pods are held until the whole gang passes, otherwise the
@@ -967,21 +1043,35 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
         jnp.where(rollback & pods.is_prod, node_of, n - 1),
         num_segments=n,
     )
-    # refund rolled-back pods' conservative GPU consumption so chained
-    # dev aggregates stay exact across chunks
-    node_dev_full = result.node_dev_full
-    node_dev_total = result.node_dev_total
-    if node_dev_full is not None:
+    # refund rolled-back pods' GPU/RDMA/FPGA consumption so the chained
+    # per-slot table stays usable across chunks (water-fill: exact for
+    # whole-GPU members, conservative for fractional — see slot_refund)
+    node_dev_slots = result.node_dev_slots
+    node_rdma_free = result.node_rdma_free
+    node_fpga_free = result.node_fpga_free
+    if node_dev_slots is not None:
+        from .device import slot_refund
+
         seg = jnp.where(rollback, node_of, n - 1)
         whole = pods.gpu_whole.astype(jnp.float32)
-        node_dev_full = node_dev_full + jax.ops.segment_sum(
-            jnp.where(rollback, whole, 0.0), seg, num_segments=n
-        )
-        node_dev_total = node_dev_total + jax.ops.segment_sum(
+        refund = jax.ops.segment_sum(
             jnp.where(rollback, whole * 100.0 + pods.gpu_share, 0.0),
             seg,
             num_segments=n,
         )
+        node_dev_slots = slot_refund(node_dev_slots, refund, slot_exists)
+        if node_rdma_free is not None:
+            node_rdma_free = node_rdma_free + jax.ops.segment_sum(
+                jnp.where(rollback, pods.rdma.astype(jnp.float32), 0.0),
+                seg,
+                num_segments=n,
+            )
+        if node_fpga_free is not None:
+            node_fpga_free = node_fpga_free + jax.ops.segment_sum(
+                jnp.where(rollback, pods.fpga.astype(jnp.float32), 0.0),
+                seg,
+                num_segments=n,
+            )
     # Refund quota charges of rolled-back pods along their chains.
     # (Q == 1 is the disabled sentinel — real trees are padded to Q ≥ 2.)
     quota_used = result.quota_used
@@ -1001,8 +1091,9 @@ def enforce_gangs(result: SolveResult, pods: PodBatch) -> SolveResult:
         node_prod_used=result.node_prod_used - dprod,
         quota_used=quota_used,
         rounds_used=result.rounds_used,
-        node_dev_full=node_dev_full,
-        node_dev_total=node_dev_total,
+        node_dev_slots=node_dev_slots,
+        node_rdma_free=node_rdma_free,
+        node_fpga_free=node_fpga_free,
     )
 
 
@@ -1118,7 +1209,8 @@ def assign_sequential(
         node_prod_used=prod_f,
         quota_used=qused_f,
         rounds_used=jnp.array(p, jnp.int32),
-        node_dev_full=jnp.zeros((n,), jnp.float32),
-        node_dev_total=jnp.zeros((n,), jnp.float32),
+        node_dev_slots=jnp.zeros((n, 1), jnp.float32),
+        node_rdma_free=jnp.zeros((n,), jnp.float32),
+        node_fpga_free=jnp.zeros((n,), jnp.float32),
     )
     return enforce_gangs(result, pods)

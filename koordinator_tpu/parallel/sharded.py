@@ -167,8 +167,9 @@ def sharded_assign(
         node_prod_used=NamedSharding(mesh, P("tp", None)),
         quota_used=rep,
         rounds_used=rep,
-        node_dev_full=NamedSharding(mesh, P("tp")),
-        node_dev_total=NamedSharding(mesh, P("tp")),
+        node_dev_slots=NamedSharding(mesh, P("tp", None)),
+        node_rdma_free=NamedSharding(mesh, P("tp")),
+        node_fpga_free=NamedSharding(mesh, P("tp")),
     )
 
     fn = jax.jit(

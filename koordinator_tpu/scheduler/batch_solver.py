@@ -561,8 +561,7 @@ class BatchScheduler:
         else:
             affinity_unsched = []
 
-        self.pod_groups.begin_cycle(pending)
-        eligible = self.pod_groups.order_pending(pending)
+        eligible = self.pod_groups.begin_and_order(pending)
         eligible_uids = {p.meta.uid for p in eligible}
         gated = [p for p in pending if p.meta.uid not in eligible_uids]
 
@@ -592,7 +591,6 @@ class BatchScheduler:
             t0 = _time.perf_counter()
             assignment = np.asarray(result.assignment)  # sync point
             assignment = self._map_assignment(assignment, sub)
-            rounds += int(result.rounds_used)
             if fwext.scores.top_n > 0:
                 self._debug_capture(chunk, assignment)
             b, u = self._commit(chunk, assignment, rows)
@@ -601,6 +599,11 @@ class BatchScheduler:
             )
             bound.extend(b)
             unsched.extend(u)
+        # rounds_used is diagnostics only — fetch it AFTER the commit loop
+        # so it never adds a per-chunk tunnel round trip between commits
+        # (the async copies above have long since landed by now)
+        for _chunk, _rows, result in solves:
+            rounds += int(result.rounds_used)
         # PostFilter analog (reference elasticquota/preempt.go): a failed
         # quota-labeled pod may evict lower-priority same-quota pods, then
         # the batch retries once for the preemptors.
@@ -838,9 +841,11 @@ class BatchScheduler:
         solver commits without waiting for the host Reserve of chunk k.
         On tunneled TPU backends the per-dispatch round-trip dominated
         the constrained scenarios — this overlaps all of them. NUMA zone
-        state and per-slot GPU fragmentation are lowered once and refined
-        only by conservative on-device aggregates; the host managers
-        still revalidate every winner at commit, so staleness can only
+        state is lowered once and refined only by conservative on-device
+        aggregates; the per-slot GPU table is carried EXACTLY on device
+        across chunks (ops.device.slot_commit mirrors the host
+        allocator's best-fit rule). The host managers still revalidate
+        every winner at commit, so any residual staleness can only
         under-place within one call, never overcommit."""
         quotas0 = self.quota_state([p for c in chunks for p in c])
         qused = quotas0.used if quotas0 is not None else None
@@ -920,7 +925,11 @@ class BatchScheduler:
             if quotas0 is not None:
                 qused = result.quota_used
             if device_state is not None:
-                dev_carry = (result.node_dev_full, result.node_dev_total)
+                dev_carry = (
+                    result.node_dev_slots,
+                    result.node_rdma_free,
+                    result.node_fpga_free,
+                )
             out.append((chunk, rows, result))
         return out
 

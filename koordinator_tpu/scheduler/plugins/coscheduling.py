@@ -15,6 +15,7 @@ capacity back — runs inside the solver (``ops.solver.enforce_gangs``).
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
@@ -44,8 +45,6 @@ def gang_group_of(pod: Pod, own_key: str) -> frozenset:
     annotation lists gang keys ("ns/name") that Permit treats atomically
     (reference ``apis/extension/coscheduling.go`` AnnotationGangGroups).
     Always includes the pod's own gang."""
-    import json
-
     raw = pod.meta.annotations.get(ext.ANNOTATION_GANG_GROUPS)
     keys = {own_key}
     if raw:
@@ -127,6 +126,65 @@ class _GangState:
         return (
             self.match_policy == ext.GANG_MATCH_ONCE_SATISFIED and self.satisfied
         )
+
+
+class _MinMemberView:
+    """Read-through ``Mapping``-shaped view over live gang state (only
+    ``get``/``__getitem__``/``__contains__`` are needed by build_pods)."""
+
+    __slots__ = ("_gangs",)
+
+    def __init__(self, gangs: Dict[str, _GangState]):
+        self._gangs = gangs
+
+    def get(self, key, default=None):
+        s = self._gangs.get(key)
+        if s is None:
+            return default
+        if s.once_satisfied:
+            return 0
+        if s.min_member is None:
+            return default
+        return max(s.min_member - s.bound_credit, 0)
+
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __contains__(self, key):
+        return self.get(key) is not None
+
+    def __bool__(self):
+        return True
+
+
+class _NonStrictView:
+    """Read-through view for declared gang modes (see _MinMemberView)."""
+
+    __slots__ = ("_gangs",)
+
+    def __init__(self, gangs: Dict[str, _GangState]):
+        self._gangs = gangs
+
+    def get(self, key, default=None):
+        s = self._gangs.get(key)
+        if s is None or not s.mode_declared:
+            return default
+        return s.mode == ext.GANG_MODE_NONSTRICT
+
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __contains__(self, key):
+        return self.get(key) is not None
+
+    def __bool__(self):
+        return True
 
 
 class PodGroupManager:
@@ -254,11 +312,16 @@ class PodGroupManager:
         if key is None:
             return True, ""
         state = self._gang_for_pod(key, pod)
+        return self._gate(key, state, pod, now if now is not None else time.time())
+
+    def _gate(
+        self, key: str, state: _GangState, pod: Pod, now: float
+    ) -> Tuple[bool, str]:
+        """Per-member eligibility against already-resolved gang state."""
         # once-satisfied gangs pass directly (core/core.go:199-201):
         # stragglers and restarted members schedule individually
         if state.once_satisfied:
             return True, ""
-        now = now if now is not None else time.time()
         if (
             state.bound_credit < state.effective_min(len(state.pending))
             and now - state.create_time > state.schedule_timeout_s
@@ -275,60 +338,73 @@ class PodGroupManager:
             return False, f"gang {key} has {total}/{need} members"
         return True, ""
 
-    def min_member_map(self) -> Mapping[str, int]:
+    def min_member_map(self) -> "Mapping[str, int]":
         """Per-gang minMember still outstanding for the solver: already
         bound members reduce the requirement, so stragglers joining a
         satisfied gang schedule individually. Gangs with unknown minMember
-        are omitted (build_pods falls back to batch member count)."""
-        out: Dict[str, int] = {}
-        for k, s in self._gangs.items():
-            if s.once_satisfied:
-                out[k] = 0
-            elif s.min_member is not None:
-                out[k] = max(s.min_member - s.bound_credit, 0)
-        return out
+        are omitted (build_pods falls back to batch member count).
 
-    def nonstrict_map(self) -> Mapping[str, bool]:
+        Returns a LIVE read-through view — materializing a dict over
+        every known gang per chunk was a measured slice of the
+        device-gang commit wall, and the view keeps cross-chunk gangs
+        seeing bound-credit updates mid-drain."""
+        return _MinMemberView(self._gangs)
+
+    def nonstrict_map(self) -> "Mapping[str, bool]":
         """Per-gang NonStrict flag for the solver lowering — only gangs
         whose mode has been declared (CRD / first member); others resolve
-        from the batch's own pod annotations in build_pods."""
-        return {
-            k: s.mode == ext.GANG_MODE_NONSTRICT
-            for k, s in self._gangs.items()
-            if s.mode_declared
-        }
+        from the batch's own pod annotations in build_pods. Live
+        read-through view (see :meth:`min_member_map`)."""
+        return _NonStrictView(self._gangs)
+
+    def begin_and_order(self, pending: Sequence[Pod]) -> List[Pod]:
+        """Fused :meth:`begin_cycle` + :meth:`order_pending`: one pass
+        resolves each pod's gang key and state exactly once (the two
+        separate passes re-ran ``_gang_for_pod`` per member and were a
+        measured slice of the device-gang cycle's host wall)."""
+        for state in self._gangs.values():
+            state.pending.clear()
+        keys: List[Optional[str]] = []
+        states: Dict[str, _GangState] = {}
+        first_arrival: Dict[str, int] = {}
+        gang_prio: Dict[str, int] = {}
+        floor = -(1 << 62)
+        for i, pod in enumerate(pending):
+            key = gang_key_of(pod)
+            keys.append(key)
+            if key is None:
+                continue
+            st = states.get(key)
+            if st is None:
+                st = self._gang_for_pod(key, pod)
+                states[key] = st
+                first_arrival[key] = i
+            st.pending[pod.meta.uid] = pod
+            prio = pod.spec.priority or 0
+            if prio > gang_prio.get(key, floor):
+                gang_prio[key] = prio
+        now = time.time()
+        decorated = []
+        for i, pod in enumerate(pending):
+            key = keys[i]
+            prio = pod.spec.priority or 0
+            if key is None:
+                decorated.append((-prio, i, "", i, pod))
+                continue
+            ok, _ = self._gate(key, states[key], pod, now)
+            if ok:
+                decorated.append(
+                    (-gang_prio.get(key, prio), first_arrival[key], key, i, pod)
+                )
+        decorated.sort(key=lambda t: t[:4])
+        return [t[4] for t in decorated]
 
     def order_pending(self, pods: Sequence[Pod]) -> List[Pod]:
         """NextPod semantics: keep gang members adjacent, ordered by the
         gang's highest member priority, so whole gangs land in one solver
-        batch (``core/core.go:135-176``)."""
-        # First-arrival index per gang: gangs sort by their highest member
-        # priority then first arrival, members stay adjacent; non-gang pods
-        # keep plain (-priority, arrival) — the reference activeQ order.
-        first_arrival: Dict[str, int] = {}
-        for i, pod in enumerate(pods):
-            key = gang_key_of(pod)
-            if key is not None and key not in first_arrival:
-                first_arrival[key] = i
-
-        def sort_key(pod_with_index):
-            i, pod = pod_with_index
-            key = gang_key_of(pod)
-            prio = pod.spec.priority or 0
-            if key is None:
-                return (-prio, i, "", i)
-            gang_prio = max(
-                (m.spec.priority or 0)
-                for m in self._gangs[key].pending.values()
-            ) if self._gangs.get(key) and self._gangs[key].pending else prio
-            return (-gang_prio, first_arrival[key], key, i)
-
-        eligible = []
-        for i, pod in enumerate(pods):
-            ok, _ = self.pre_enqueue(pod)
-            if ok:
-                eligible.append((i, pod))
-        return [p for _, p in sorted(eligible, key=sort_key)]
+        batch (``core/core.go:135-176``). Re-registering the pending set
+        is idempotent, so this simply delegates to the fused pass."""
+        return self.begin_and_order(pods)
 
     def permit(
         self, results: Iterable[Tuple[Pod, Optional[str]]]

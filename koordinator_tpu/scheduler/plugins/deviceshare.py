@@ -615,6 +615,12 @@ class DeviceManager:
                         res += ', "%s": %d' % (ext.RES_GPU_MEMORY, int(cap))
                     frags.append('{"minor": %d, "resources": {%s}}' % (m, res))
                 st.whole_frags = frags
+            # free minors bucketed by topology group ONCE per node, drained
+            # across this node's winners (rebuilding the full-minor list per
+            # winner was the remaining per-pod scan in the lean path)
+            group_of = st.group_of
+            n_groups = max(st.n_groups, 1)
+            by_group: Optional[List[List[int]]] = None
             for i in rows_i:
                 whole = whole_l[i]
                 ann = annotations[i]
@@ -638,15 +644,14 @@ class DeviceManager:
                         )
                     )
                 ):
-                    full = [
-                        m
-                        for m in range(n_minors)
-                        if gpu_free[m] >= full_eps and core_free[m] >= full_eps
-                    ]
-                    if len(full) < whole:
-                        results[i] = None
-                        continue
-                    chosen = self._allocate_by_topology(st, full, whole)
+                    if by_group is None:
+                        by_group = [[] for _ in range(n_groups)]
+                        for m in range(n_minors):
+                            if gpu_free[m] >= full_eps and core_free[m] >= full_eps:
+                                by_group[
+                                    group_of[m] if m < len(group_of) else 0
+                                ].append(m)
+                    chosen = self._pick_grouped_free(by_group, whole)
                     if chosen is None:
                         results[i] = None
                         continue
@@ -681,6 +686,7 @@ class DeviceManager:
                     # (double-allocating minors and losing charges)
                     gpu_free = st.gpu_free
                     core_free = st.gpu_core_free
+                    by_group = None  # free set changed: rebuild lazily
         return results
 
     def _pick_rdma(
@@ -830,6 +836,40 @@ class DeviceManager:
             return score
 
         return max(feasible, key=preserve_score)
+
+    @staticmethod
+    def _pick_grouped_free(
+        by_group: List[List[int]], whole: int
+    ) -> Optional[List[int]]:
+        """Tightest-group whole-GPU pick over live free-minor buckets,
+        DRAINING the chosen minors in place (same policy as
+        :meth:`_allocate_by_topology`: smallest satisfying NUMA/PCIe
+        group, else spill across groups largest-first)."""
+        if len(by_group) == 1:
+            b = by_group[0]
+            if len(b) < whole:
+                return None
+            chosen = b[:whole]
+            del b[:whole]
+            return chosen
+        best: Optional[List[int]] = None
+        for b in by_group:
+            if len(b) >= whole and (best is None or len(b) < len(best)):
+                best = b
+        if best is not None:
+            chosen = best[:whole]
+            del best[:whole]
+            return chosen
+        if sum(len(b) for b in by_group) < whole:
+            return None
+        out: List[int] = []
+        for g in sorted(by_group, key=len, reverse=True):
+            need = whole - len(out)
+            if need <= 0:
+                break
+            out.extend(g[:need])
+            del g[:need]
+        return out
 
     def _allocate_by_topology(
         self, st: _NodeDevices, full_minors: List[int], whole: int
