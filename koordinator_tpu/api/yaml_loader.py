@@ -121,6 +121,20 @@ def _pod(doc: Mapping) -> Pod:
             requests[k] = requests.get(k, 0.0) + v
         for k, v in convert_resource_list(res.get("limits") or {}).items():
             limits[k] = limits.get(k, 0.0) + v
+    # Kubernetes effective pod requests: max(each initContainer,
+    # sum(containers)) per resource, plus spec.overhead (advisor r4 —
+    # an init container larger than the main containers must gate
+    # placement or the pod can land where it cannot start)
+    for c in spec.get("initContainers") or []:
+        res = c.get("resources") or {}
+        for k, v in convert_resource_list(res.get("requests") or {}).items():
+            if v > requests.get(k, 0.0):
+                requests[k] = v
+        for k, v in convert_resource_list(res.get("limits") or {}).items():
+            if v > limits.get(k, 0.0):
+                limits[k] = v
+    for k, v in convert_resource_list(spec.get("overhead") or {}).items():
+        requests[k] = requests.get(k, 0.0) + v
     priority = spec.get("priority")
     if priority is None:
         priority = PRIORITY_CLASS_VALUES.get(spec.get("priorityClassName", ""))

@@ -12,6 +12,7 @@ revalidation — the solver proposes, Reserve disposes (SURVEY §7 hard part
 from __future__ import annotations
 
 import dataclasses
+import threading as _threading
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
@@ -74,6 +75,37 @@ class LoadAwareArgs:
 #: upstream kube-scheduler's floor: clusters at or below this size are
 #: always fully scored (minFeasibleNodesToFind)
 MIN_FEASIBLE_NODES_TO_FIND = 100
+
+
+#: refcounted process-wide GC pause (advisor r4): two schedulers with
+#: overlapping cycles must keep the collector paused until the LAST cycle
+#: exits — a bare disable()/enable() pair re-enables GC in the middle of
+#: the other scheduler's cycle, silently losing its commit-p99 protection
+_gc_lock = _threading.Lock()
+_gc_depth = 0
+_gc_was_enabled = False
+
+
+def _gc_pause() -> None:
+    import gc
+
+    global _gc_depth, _gc_was_enabled
+    with _gc_lock:
+        if _gc_depth == 0:
+            _gc_was_enabled = gc.isenabled()
+            if _gc_was_enabled:
+                gc.disable()
+        _gc_depth += 1
+
+
+def _gc_resume() -> None:
+    import gc
+
+    global _gc_depth
+    with _gc_lock:
+        _gc_depth -= 1
+        if _gc_depth == 0 and _gc_was_enabled:
+            gc.enable()
 
 
 def num_nodes_to_score(n_nodes: int, percentage: int = 0) -> int:
@@ -239,6 +271,13 @@ class BatchScheduler:
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         #: rotating sample start (upstream nextStartNodeIndex analog)
         self._score_start = 0
+        #: node names the next _select_nodes call must include beyond the
+        #: rotating window — set by the preemption pass so the retry sees
+        #: the nodes its victims were evicted from (consumed once)
+        self._window_extra_nodes: set = set()
+        #: pod uid → consecutive preemption-skip count under a sampled
+        #: window (anti-starvation bookkeeping for the headroom gate)
+        self._preempt_skips: Dict[str, int] = {}
         #: multi-chip production mode: a jax.sharding.Mesh over ("dp",
         #: "tp") — pod rows shard on dp, node-axis tables on tp, and
         #: GSPMD inserts the ICI collectives inside the SAME jitted
@@ -249,18 +288,52 @@ class BatchScheduler:
 
     # ---- device lowering ----
 
-    def _select_nodes(self) -> Optional[np.ndarray]:
+    def _select_nodes(
+        self, pending: Sequence[Pod] = ()
+    ) -> Optional[np.ndarray]:
         """Real node indices to lower this cycle, or None for all (the
         kube-scheduler node-sampling pass: a rotating window of
         ``num_nodes_to_score`` nodes, advanced per cycle like upstream's
-        nextStartNodeIndex so every node is visited fairly)."""
+        nextStartNodeIndex so every node is visited fairly).
+
+        Hard-constrained pods must always reach their nodes (upstream's
+        sampling keeps scanning until enough FEASIBLE nodes are found, so
+        a pinned pod can never rotate out — advisor r4): node names
+        referenced by spec.nodeName / required node affinity are unioned
+        into the window; a label nodeSelector can match any node, so any
+        selector-carrying pod disables sampling for the cycle."""
         n_real = self.snapshot.node_count
         want = num_nodes_to_score(n_real, self.percentage_of_nodes_to_score)
         if want >= n_real:
+            self._window_extra_nodes = set()
             return None
+        # nodes nominated by the preemption pass (victims just evicted
+        # there) must be visible to the retry's window
+        named: set = self._window_extra_nodes
+        self._window_extra_nodes = set()
+        for p in pending:
+            spec = p.spec
+            if spec.node_selector:
+                return None
+            if spec.node_name:
+                named.add(spec.node_name)
+            elif spec.affinity_required_nodes:
+                named.update(spec.affinity_required_nodes)
         start = self._score_start
         self._score_start = (start + want) % n_real
-        return (np.arange(want) + start) % n_real
+        window = (np.arange(want) + start) % n_real
+        if named:
+            in_window = set(window.tolist())
+            extra = sorted(
+                idx
+                for idx in (self.snapshot.node_id(nm) for nm in named)
+                if idx is not None and idx not in in_window
+            )
+            if extra:
+                window = np.concatenate(
+                    [window, np.asarray(extra, window.dtype)]
+                )
+        return window
 
     def node_state(self, sub: Optional[np.ndarray] = None) -> NodeState:
         # NB: the amplified-CPU surcharge for exclusively-held cores
@@ -419,17 +492,15 @@ class BatchScheduler:
         # one scheduling cycle is atomic w.r.t. informer writers (the
         # reference cache lock at batch granularity); re-entrant for the
         # preemption retry
-        import gc
-
-        pause_gc = self.defer_gc and not _retry and gc.isenabled()
+        pause_gc = self.defer_gc and not _retry
         if pause_gc:
-            gc.disable()
+            _gc_pause()
         try:
             with self.snapshot.lock:
                 return self._schedule_locked(pending, _retry)
         finally:
             if pause_gc:
-                gc.enable()
+                _gc_resume()
 
     def _schedule_locked(
         self, pending: Sequence[Pod], _retry: bool = False
@@ -572,7 +643,7 @@ class BatchScheduler:
         # kube-scheduler node sampling (PercentageOfNodesToScore): one
         # rotating window per cycle, shared by every chunk so the
         # on-device capacity chaining stays on a consistent node axis
-        sub = self._select_nodes() if chunks else None
+        sub = self._select_nodes(eligible) if chunks else None
         if len(chunks) > 1:
             solves = self._dispatch_pipelined(chunks, sub)
         else:
@@ -637,6 +708,35 @@ class BatchScheduler:
                 # preemption-policy=Never (preemption.go:22-41)
                 if ext.pod_never_preempts(pod):
                     continue
+                # sampled node window + clear quota headroom: the failure
+                # is (possibly transient) node fit, not quota — upstream
+                # preemption only runs after a FULL feasibility scan, so
+                # evicting before the rotating window has been retried
+                # would be premature (and the scan was the latency
+                # stream's dominant PostFilter cost). The skip must not
+                # become starvation: hard-constrained pods (whose nodes
+                # are unioned into EVERY window) get preemption at once,
+                # and an unconstrained pod is only skipped until the
+                # window has fully rotated past it.
+                if sub is not None and self.quotas.headroom_clears(pod):
+                    spec = pod.spec
+                    if not (
+                        spec.node_name
+                        or spec.node_selector
+                        or spec.affinity_required_nodes
+                    ):
+                        uid = pod.meta.uid
+                        rotation = max(
+                            1,
+                            -(-self.snapshot.node_count // max(len(sub), 1)),
+                        )
+                        seen_skips = self._preempt_skips.get(uid, 0) + 1
+                        if seen_skips < rotation:
+                            if len(self._preempt_skips) > 100_000:
+                                self._preempt_skips.clear()
+                            self._preempt_skips[uid] = seen_skips
+                            continue
+                        self._preempt_skips.pop(uid, None)
                 sel = preemptor.select_victims(pod)
                 if sel is None:
                     continue
@@ -657,6 +757,7 @@ class BatchScheduler:
                     self.evict_for_preemption(victim)
                     preempted.append(victim)
                 retry_pods.append(pod)
+                self._window_extra_nodes.add(_node)
         # Priority preemption at PostFilter (the reservation plugin's
         # preemption manager, reference reservation/preemption.go:105-250)
         # for pods quota preemption could not help; gated by
@@ -694,7 +795,12 @@ class BatchScheduler:
                     self.evict_for_preemption(victim)
                     preempted.append(victim)
                 retry_pods.append(pod)
+                self._window_extra_nodes.add(_node)
         if retry_pods:
+            # the retry's sampled window must contain the nodes the
+            # victims were just evicted from (_window_extra_nodes — the
+            # rotated window would usually exclude them, wasting the
+            # evictions); _select_nodes consumes the set
             again = self.schedule(retry_pods, _retry=True)
             bound.extend(again.bound)
             retried = {p.meta.uid for p in retry_pods}

@@ -446,6 +446,24 @@ class GroupQuotaManager:
     def all_quota_names(self) -> List[str]:
         return list(self._order)
 
+    def headroom_clears(self, pod: "Pod") -> bool:
+        """Whether the pod's quota chain has headroom for its request
+        (used + req ≤ runtime at every level). True also for pods with no
+        (known) quota. Callers use this to tell quota-caused scheduling
+        failures from node-fit ones — when a sampled node window was
+        active and the chain clears, a failure is (possibly transient)
+        node fit, and quota preemption would be premature
+        (upstream preemption runs only after a full feasibility scan)."""
+        leaf = quota_name_of(pod)
+        if leaf is None or self.index_of(leaf) is None:
+            return True
+        self.runtime_and_used_of(leaf)  # refresh runtime if dirty
+        req = self.config.res_vector(pod.spec.requests)
+        for idx in self.chain_of(leaf):
+            if np.any(self.used[idx] + req > self.runtime[idx] + 1e-3):
+                return False
+        return True
+
     def runtime_and_used_of(self, quota_name: str) -> Tuple[np.ndarray, np.ndarray]:
         self._ensure_capacity()
         if self._dirty:
@@ -810,35 +828,37 @@ class ElasticQuotaPreemptor:
     ):
         self.scheduler = scheduler
         self.manager = manager
+        #: per-cycle candidate cache (one preemptor instance per cycle):
+        #: leaf → [(victim, preemptible, vleaf, priority, req_vec)] — the
+        #: label parsing + res_vector walk over every assigned pod was
+        #: re-run per failed pod and grew with cluster occupancy.
+        #: Bound-ness is NOT cached (bound_node_of is re-checked live, so
+        #: immediate-mode evictions between calls stay correct).
+        self._cand_cache: Dict[str, list] = {}
 
-    def _can_preempt(self, pod: Pod, victim: Pod) -> bool:
-        """canPreempt: preemptible victim, strictly lower priority, same
-        quota (with the default-quota opt-out)."""
-        if is_pod_non_preemptible(victim):
-            return False
-        leaf = quota_name_of(pod)
-        vleaf = quota_name_of(victim) or ext.DEFAULT_QUOTA_NAME
-        if (
-            self.manager.disable_default_quota_preemption
-            and vleaf == ext.DEFAULT_QUOTA_NAME
-        ):
-            return False
-        return (pod.spec.priority or 0) > (victim.spec.priority or 0) and (
-            leaf == vleaf
-        )
-
-    def _quota_chain_clears(
-        self, leaf: str, freed: np.ndarray, req: np.ndarray
-    ) -> bool:
-        """used − freed + req ≤ runtime along the WHOLE chain (victims
-        share the preemptor's leaf, so the refund applies at every
-        level — a tight parent quota must clear too)."""
-        mgr = self.manager
-        mgr.runtime_and_used_of(leaf)  # refresh runtime if dirty
-        for idx in mgr.chain_of(leaf):
-            if np.any(mgr.used[idx] - freed + req > mgr.runtime[idx] + 1e-3):
-                return False
-        return True
+    def _leaf_candidates(self, leaf: str) -> list:
+        cached = self._cand_cache.get(leaf)
+        if cached is None:
+            cfg = self.manager.config
+            vec_cache: Dict[tuple, np.ndarray] = {}
+            cached = []
+            for v in self.manager.pods_assigned(leaf):
+                key = tuple(v.spec.requests.items())
+                vec = vec_cache.get(key)
+                if vec is None:
+                    vec = cfg.res_vector(v.spec.requests)
+                    vec_cache[key] = vec
+                cached.append(
+                    (
+                        v,
+                        not is_pod_non_preemptible(v),
+                        quota_name_of(v) or ext.DEFAULT_QUOTA_NAME,
+                        v.spec.priority or 0,
+                        vec,
+                    )
+                )
+            self._cand_cache[leaf] = cached
+        return cached
 
     def _devices_clear(
         self, pod: Pod, node: str, victims: List[Pod]
@@ -899,35 +919,69 @@ class ElasticQuotaPreemptor:
         cfg = self.manager.config
         req = cfg.res_vector(pod.spec.requests)
 
-        by_node: Dict[str, List[Pod]] = {}
-        for victim in self.manager.pods_assigned(leaf):
-            if not self._can_preempt(pod, victim):
+        # The chain check "used − freed + req ≤ runtime at every level"
+        # collapses to ONE per-dim bound: freed ≥ max over levels of
+        # (used + req − runtime). Computing it once here replaces a
+        # per-victim per-level scan that dominated the latency-stream
+        # cycle's PostFilter cost.
+        mgr = self.manager
+        mgr.runtime_and_used_of(leaf)  # refresh runtime if dirty
+        chain = list(mgr.chain_of(leaf))
+        if not chain:
+            return None
+        # (an unbounded runtime level yields −inf need — never binding)
+        quota_needed = np.max(
+            [mgr.used[i] + req - mgr.runtime[i] for i in chain], axis=0
+        )
+
+        pod_prio = pod.spec.priority or 0
+        skip_default = (
+            self.manager.disable_default_quota_preemption
+        )
+        by_node: Dict[str, List[Tuple[Pod, np.ndarray]]] = {}
+        freed_all = np.zeros_like(req)
+        for victim, preemptible, vleaf, vprio, vec in self._leaf_candidates(
+            leaf
+        ):
+            # canPreempt: preemptible victim, strictly lower priority,
+            # same quota, default-quota opt-out — over precomputed fields
+            if (
+                not preemptible
+                or vprio >= pod_prio
+                or vleaf != leaf
+                or (skip_default and vleaf == ext.DEFAULT_QUOTA_NAME)
+            ):
                 continue
             node = self.scheduler.bound_node_of(victim.meta.uid)
             if node is None:
                 continue
-            by_node.setdefault(node, []).append(victim)
+            by_node.setdefault(node, []).append((victim, vec))
+            freed_all = freed_all + vec
+        # even evicting EVERY eligible victim cannot clear the chain →
+        # no node can succeed, skip the per-node scan entirely
+        if by_node and np.any(freed_all < quota_needed - 1e-3):
+            return None
 
         best: Optional[Tuple[str, List[Pod]]] = None
+        na = snap.nodes
         for node in sorted(by_node, key=lambda n: len(by_node[n])):
             idx = snap.node_id(node)
             if idx is None:
                 continue
             if not self.scheduler.node_allowed(pod, node):
                 continue
-            victims = by_node[node]
+            victims = [v for v, _vec in by_node[node]]
             if not self._devices_clear(pod, node, victims):
                 continue
-            vecs = [cfg.res_vector(v.spec.requests) for v in victims]
+            vecs = [vec for _v, vec in by_node[node]]
             freed = np.sum(vecs, axis=0)
-            na = snap.nodes
+            # node fit collapses the same way: freed ≥ requested + req −
+            # allocatable, per dim
+            node_needed = na.requested[idx] + req - na.allocatable[idx]
+            needed = np.maximum(quota_needed, node_needed)
             # step 1: all eligible victims gone — does the pod fit, and
             # does the quota chain clear?
-            if np.any(
-                na.requested[idx] - freed + req > na.allocatable[idx] + 1e-3
-            ):
-                continue
-            if not self._quota_chain_clears(leaf, freed, req):
+            if np.any(freed < needed - 1e-3):
                 continue
             # step 2: reprieve most-important-first while both still hold
             order = sorted(
@@ -937,12 +991,7 @@ class ElasticQuotaPreemptor:
             final: List[Pod] = []
             for i in order:
                 trial = freed - vecs[i]
-                fits = np.all(
-                    na.requested[idx] - trial + req
-                    <= na.allocatable[idx] + 1e-3
-                )
-                clears = self._quota_chain_clears(leaf, trial, req)
-                if fits and clears:
+                if np.all(trial >= needed - 1e-3):
                     freed = trial  # reprieved
                 else:
                     final.append(victims[i])

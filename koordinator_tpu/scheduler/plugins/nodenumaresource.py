@@ -101,6 +101,18 @@ class NUMAManager:
         #: policy_rows cache, invalidated on register_node / node churn
         self._policy_cache: Optional[np.ndarray] = None
         self._policy_cache_epoch = -1
+        #: incremental zone-array lowering cache (see DeviceManager's
+        #: ``_lowered``): full rebuild on node churn (node_epoch) or a
+        #: cpu_amp change (amp vector compared each call — re-upserts
+        #: don't bump the epoch); per-row refresh for allocation deltas
+        self._zone_cache: Optional[Tuple[np.ndarray, ...]] = None
+        self._zone_epoch = -1
+        self._zone_dirty: set = set()
+        self._amp_seen: Optional[np.ndarray] = None
+
+    def _mark_dirty(self, node_name: str) -> None:
+        if self._zone_cache is not None:
+            self._zone_dirty.add(node_name)
 
     def register_node(
         self,
@@ -139,6 +151,7 @@ class NUMAManager:
             phys_zone_cpu=phys,
         )
         self._policy_cache = None
+        self._mark_dirty(node_name)
 
     #: NodeResourceTopology.topologyPolicy string → solver policy
     _POLICY_BY_NAME = {
@@ -233,6 +246,9 @@ class NUMAManager:
         """Drop a node's topology (NodeResourceTopology deleted)."""
         self._nodes.pop(node_name, None)
         self._policy_cache = None
+        # the cached zone row must zero out (node_epoch doesn't bump —
+        # the Node itself may remain in the snapshot)
+        self._mark_dirty(node_name)
 
     def _sync_amp(self, node_name: str, st: _NodeNUMA) -> None:
         """Re-base zone capacities and bound charges onto the snapshot's
@@ -261,24 +277,73 @@ class NUMAManager:
 
     # ---- solver lowering ----
 
+    def _refresh_zone_row(self, name: str) -> None:
+        zone_free, zone_cap, policy = self._zone_cache
+        idx = self.snapshot.node_id(name)
+        if idx is None:
+            return
+        st = self._nodes.get(name)
+        if st is None:
+            zone_free[idx] = 0.0
+            zone_cap[idx] = 0.0
+            policy[idx] = 0
+            return
+        self._sync_amp(name, st)
+        alloc = np.asarray(st.zone_alloc, np.float32)
+        zone_free[idx] = alloc - np.asarray(st.zone_used, np.float32)
+        zone_cap[idx] = alloc
+        policy[idx] = int(st.policy)
+
     def arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(zone_free [N, Z, DN], zone_cap [N, Z, DN], policy [N]) aligned
         to snapshot rows. Unregistered nodes report zero capacity (always
-        NUMA-feasible)."""
+        NUMA-feasible). Incrementally cached: rebuilding every row each
+        scheduling cycle was the latency stream's dominant fixed cost.
+        Callers must treat the returned arrays as read-only snapshots
+        for immediate lowering."""
+        epoch = self.snapshot.node_epoch
         n_bucket = self.snapshot.nodes.allocatable.shape[0]
-        zone_free = np.zeros((n_bucket, self.max_zones, ZONE_DIMS), np.float32)
-        zone_cap = np.zeros((n_bucket, self.max_zones, ZONE_DIMS), np.float32)
-        policy = np.zeros((n_bucket,), np.int8)
-        for name, st in self._nodes.items():
-            idx = self.snapshot.node_id(name)
-            if idx is None:
-                continue
-            self._sync_amp(name, st)
-            alloc = np.asarray(st.zone_alloc, np.float32)
-            zone_free[idx] = alloc - np.asarray(st.zone_used, np.float32)
-            zone_cap[idx] = alloc
-            policy[idx] = int(st.policy)
-        return zone_free, zone_cap, policy
+        amp = self.snapshot.nodes.cpu_amp
+        if (
+            self._zone_cache is None
+            or self._zone_epoch != epoch
+            or self._zone_cache[0].shape[0] != n_bucket
+        ):
+            self._zone_cache = (
+                np.zeros((n_bucket, self.max_zones, ZONE_DIMS), np.float32),
+                np.zeros((n_bucket, self.max_zones, ZONE_DIMS), np.float32),
+                np.zeros((n_bucket,), np.int8),
+            )
+            self._zone_epoch = epoch
+            self._zone_dirty = set()
+            for name in self._nodes:
+                self._refresh_zone_row(name)
+            self._amp_seen = amp.copy()
+        else:
+            if self._amp_seen is None or not np.array_equal(
+                self._amp_seen, amp
+            ):
+                # re-upserts don't bump node_epoch, but an amplification
+                # change re-bases zone capacities — refresh changed rows
+                changed = (
+                    np.nonzero(amp != self._amp_seen)[0]
+                    if self._amp_seen is not None
+                    and self._amp_seen.shape == amp.shape
+                    else range(min(len(amp), n_bucket))
+                )
+                for idx in changed:
+                    try:
+                        name = self.snapshot.node_name(int(idx))
+                    except IndexError:
+                        continue
+                    if name in self._nodes:
+                        self._zone_dirty.add(name)
+                self._amp_seen = amp.copy()
+            if self._zone_dirty:
+                for name in self._zone_dirty:
+                    self._refresh_zone_row(name)
+                self._zone_dirty = set()
+        return self._zone_cache
 
     @property
     def has_topology(self) -> bool:
@@ -436,6 +501,8 @@ class NUMAManager:
             used[0] += req0
             used[1] += req1
             st.owners[uid] = (zone, [req0, req1], nominal_cpu)
+        if zone >= 0 or cpuset_str is not None:
+            self._mark_dirty(node_name)
         # hand-rendered resource-status JSON: json.dumps per winner was a
         # visible slice of the commit loop (payload shape is fixed)
         if cpuset_str is not None and zone >= 0:
@@ -484,6 +551,7 @@ class NUMAManager:
             st = self._nodes.get(name)
             if st is None:
                 continue
+            self._mark_dirty(name)
             policy_single = int(st.policy) == single
             amp = st.cpu_amp
             zone_alloc = st.zone_alloc
@@ -614,11 +682,13 @@ class NUMAManager:
             st.zone_used = [[0.0] * ZONE_DIMS for _ in st.zone_alloc]
             st.owners.clear()
             st.accumulator = CPUAccumulator(st.topology)
+        self._zone_cache = None
 
     def release(self, pod_uid: str, node_name: str) -> None:
         st = self._nodes.get(node_name)
         if st is None:
             return
+        self._mark_dirty(node_name)
         st.accumulator.release(pod_uid)
         entry = st.owners.pop(pod_uid, None)
         if entry is not None:

@@ -1,0 +1,215 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4)."""
+
+import gc
+import threading
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.yaml_loader import load_objects
+from koordinator_tpu.scheduler.batch_solver import _gc_pause, _gc_resume
+
+
+def test_yaml_pod_effective_requests_init_containers_and_overhead():
+    """Effective pod requests = max(initContainers, sum(containers)) +
+    overhead (advisor r4: an init container larger than the mains must
+    gate placement)."""
+    doc = """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: initpod
+spec:
+  overhead:
+    cpu: 100m
+  initContainers:
+  - name: init
+    resources:
+      requests:
+        cpu: "4"
+        memory: 1Gi
+  containers:
+  - name: a
+    resources:
+      requests:
+        cpu: "1"
+        memory: 2Gi
+  - name: b
+    resources:
+      requests:
+        cpu: "1"
+"""
+    objs = load_objects(doc)
+    pod = next(o for o in objs if hasattr(o, "spec") and hasattr(o.spec, "requests"))
+    # cpu: max(4000, 1000+1000) + 100 overhead; memory: max(1Gi, 2Gi)
+    assert pod.spec.requests[ext.RES_CPU] == 4100
+    assert pod.spec.requests[ext.RES_MEMORY] == 2048
+
+
+def test_gc_pause_refcounted_across_overlapping_cycles():
+    """Two overlapping schedulers keep the collector paused until the
+    LAST cycle exits (advisor r4: bare disable()/enable() re-enables GC
+    mid-cycle)."""
+    assert gc.isenabled()
+    _gc_pause()          # scheduler A enters
+    assert not gc.isenabled()
+    _gc_pause()          # scheduler B enters
+    _gc_resume()         # A exits — B still mid-cycle
+    assert not gc.isenabled(), "GC re-enabled while another cycle is live"
+    _gc_resume()         # B exits
+    assert gc.isenabled()
+
+
+def test_numa_unregister_invalidates_zone_cache():
+    """NodeResourceTopology deletion must zero the cached zone row even
+    though node_epoch doesn't bump (code-review r5)."""
+    import numpy as np
+
+    from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.core.topology import CPUTopology
+    from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+        NUMAManager,
+        NUMAPolicy,
+    )
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0"),
+            status=NodeStatus(allocatable={ext.RES_CPU: 32000}),
+        )
+    )
+    mgr = NUMAManager(snap)
+    topo = CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=8)
+    mgr.register_node("n0", topo, NUMAPolicy.SINGLE_NUMA_NODE, 65536)
+    zone_free, _cap, policy = mgr.arrays()
+    assert policy[snap.node_id("n0")] == int(NUMAPolicy.SINGLE_NUMA_NODE)
+    assert np.any(zone_free[snap.node_id("n0")] > 0)
+    mgr.unregister_node("n0")
+    zone_free, _cap, policy = mgr.arrays()
+    assert policy[snap.node_id("n0")] == 0
+    assert np.all(zone_free[snap.node_id("n0")] == 0)
+
+
+def _quota_sampled_cluster():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from koordinator_tpu.api.types import (
+        ElasticQuota,
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+    from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+
+    snap = ClusterSnapshot()
+    for i in range(150):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i:03d}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192}
+                ),
+            )
+        )
+    gqm = GroupQuotaManager(snap.config)
+    # max leaves headroom above full-cluster occupancy, so a scheduling
+    # failure with every node full is NODE fit, not quota — exactly the
+    # case the sampled-window preemption gate defers on
+    gqm.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="team"),
+            min={ext.RES_CPU: 600_000, ext.RES_MEMORY: 1 << 20},
+            max={ext.RES_CPU: 1_200_000, ext.RES_MEMORY: 2 << 20},
+        )
+    )
+    sched = BatchScheduler(
+        snap,
+        LoadAwareArgs(),
+        quotas=gqm,
+        batch_bucket=128,
+        percentage_of_nodes_to_score=67,  # window of 100/150 nodes
+    )
+    sched.extender.monitor.stop_background()
+
+    def mk(name, prio, node_name=None):
+        return Pod(
+            meta=ObjectMeta(
+                name=name, labels={ext.LABEL_QUOTA_NAME: "team"}
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 4096},
+                priority=prio,
+                node_name=node_name,
+            ),
+        )
+
+    return snap, sched, mk
+
+
+def test_sampled_window_preemption_not_starved_for_pinned_pod():
+    """A spec.nodeName-pinned pod whose node is full of lower-priority
+    same-quota pods must preempt IMMEDIATELY even under a sampled window
+    (its node is in every window, so the failure is never transient —
+    code-review r5)."""
+    snap, sched, mk = _quota_sampled_cluster()
+    out = sched.schedule([mk("low", 5000, node_name="n140")])
+    assert len(out.bound) == 1
+    out = sched.schedule([mk("high", 9000, node_name="n140")])
+    # the low-priority victim was evicted and the pinned pod landed on
+    # its node in the SAME cycle (retry window includes the target node)
+    assert [n for _p, n in out.bound] == ["n140"], (
+        out.bound,
+        out.unschedulable,
+        out.preempted,
+    )
+    assert [v.meta.name for v in out.preempted] == ["low"]
+
+
+def test_sampled_window_preemption_eventually_runs_for_unconstrained_pod():
+    """An unconstrained pod with clear quota headroom defers preemption
+    until the window has fully rotated, then preempts (anti-starvation
+    escape of the headroom gate)."""
+    snap, sched, mk = _quota_sampled_cluster()
+    # fill EVERY node with a low-priority pod: no free capacity anywhere
+    # (several cycles — the sampled window covers 100 of 150 nodes)
+    fillers = [mk(f"f{i:03d}", 5000) for i in range(150)]
+    total_bound = 0
+    for _ in range(4):
+        out = sched.schedule(fillers)
+        total_bound += len(out.bound)
+        fillers = list(out.unschedulable)
+        if not fillers:
+            break
+    assert total_bound == 150
+    high = mk("high", 9000)
+    preempted = []
+    for _cycle in range(4):  # rotation at 67% window = 2 cycles
+        out = sched.schedule([high])
+        preempted.extend(out.preempted)
+        if out.bound:
+            break
+    assert out.bound, "high-priority pod starved"
+    assert preempted and all(
+        (v.spec.priority or 0) == 5000 for v in preempted
+    )
+
+
+def test_gc_pause_thread_race():
+    """Hammer pause/resume from threads; depth bookkeeping must land the
+    collector back at enabled."""
+    def worker():
+        for _ in range(200):
+            _gc_pause()
+            _gc_resume()
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert gc.isenabled()

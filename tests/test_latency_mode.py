@@ -82,9 +82,9 @@ def test_node_sampling_places_and_accounts_correctly():
 
 
 def test_node_sampling_respects_node_name_constraint():
-    """A pod pinned via spec.nodeName to a node OUTSIDE the current
-    window simply fails that cycle (conservative) or lands on its node —
-    it must never land anywhere else."""
+    """A pod pinned via spec.nodeName always reaches its node: the
+    sampled window unions hard-constraint node indices (advisor r4), so
+    the pin binds EVERY cycle regardless of window rotation."""
     snap = _cluster(300)
     sched = BatchScheduler(
         snap, LoadAwareArgs(), batch_bucket=64,
@@ -100,8 +100,48 @@ def test_node_sampling_respects_node_name_constraint():
             ),
         )
         out = sched.schedule([pinned])
-        for _p, node in out.bound:
-            assert node == "n0007"
+        assert [node for _p, node in out.bound] == ["n0007"], (
+            f"cycle {cycle}: {out.bound} {out.unschedulable}"
+        )
+
+
+def test_node_sampling_affinity_names_and_selector():
+    """Required node-affinity names are unioned into the window; a label
+    nodeSelector (which can match any node) disables sampling for the
+    cycle — either way the constrained pod binds where it must."""
+    snap = _cluster(300)
+    # give one far node a label only selector pods can find
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name="n0250", labels={"disk": "ssd"}),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 8000, ext.RES_MEMORY: 8000}
+            ),
+        )
+    )
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), batch_bucket=64,
+        percentage_of_nodes_to_score=20,
+    )
+    sched.extender.monitor.stop_background()
+    aff = Pod(
+        meta=ObjectMeta(name="aff"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1000},
+            affinity_required_nodes=["n0280"],
+        ),
+    )
+    sel = Pod(
+        meta=ObjectMeta(name="sel"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1000},
+            node_selector={"disk": "ssd"},
+        ),
+    )
+    out = sched.schedule([aff, sel])
+    nodes = {p.meta.name: n for p, n in out.bound}
+    assert nodes.get("aff") == "n0280", (out.bound, out.unschedulable)
+    assert nodes.get("sel") == "n0250", (out.bound, out.unschedulable)
 
 
 def test_stream_scheduler_latency_and_retry():
