@@ -127,9 +127,9 @@ def _measure(build, chunk, name, passes: int = 3):
             orig = sched._commit
             marks: list = []
 
-            def timed(chunk_, assignment, rows=None, _o=orig):
+            def timed(*a, _o=orig, **kw):
                 c0 = time.perf_counter()
-                b, u = _o(chunk_, assignment, rows)
+                b, u = _o(*a, **kw)
                 c1 = time.perf_counter()
                 commit_times.append(c1 - c0)
                 marks.append((len(b) + len(u), c1))

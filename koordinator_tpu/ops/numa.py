@@ -32,11 +32,46 @@ class NumaState:
     zone_free — remaining allocatable per zone        [N, Z, DN]
     zone_cap  — zone allocatable capacity             [N, Z, DN]
     policy    — node topology manager policy          [N] int8
+    zone_most — per-node MostAllocated zone-pick strategy flag [N] bool
+                (None → LeastAllocated everywhere); mirrors the host's
+                ``_most_allocated`` label/default resolution so the
+                solver's on-device zone selection matches the host
+                allocator pick-for-pick (``util.go:33-47``)
     """
 
     zone_free: jnp.ndarray
     zone_cap: jnp.ndarray
     policy: jnp.ndarray
+    zone_most: jnp.ndarray = None
+
+
+def zone_pick(
+    zone_free_g: jnp.ndarray,   # [P, Z, DN] carried free at each pod's node
+    zone_cap_g: jnp.ndarray,    # [P, Z, DN]
+    req_eff: jnp.ndarray,       # [P, DN] amplified zone-scoped request
+    most_allocated: jnp.ndarray,  # [P] bool — node's pick strategy
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Strategy-ordered fitting-zone pick, the exact on-device mirror of
+    the host allocator's per-winner loop
+    (``NUMAManager.allocate_lowered``: both dims checked unconditionally,
+    utilization keyed on the CPU dim, LeastAllocated spreads /
+    MostAllocated packs). Returns ``(zone [P] int32, has_fit [P] bool)``;
+    zone is only meaningful where has_fit."""
+    fits = jnp.all(zone_free_g >= req_eff[:, None, :] - 1e-3, axis=-1)  # [P, Z]
+    # padded/unregistered zones (zero capacity) must never be picked —
+    # a near-zero request would otherwise "fit" them, and MostAllocated's
+    # util=1.0 would actively prefer them (code-review r5)
+    fits &= jnp.any(zone_cap_g > 0, axis=-1)
+    used0 = zone_cap_g[:, :, 0] - zone_free_g[:, :, 0]
+    util = (used0 + 1.0) / (zone_cap_g[:, :, 0] + 1.0)
+    key = jnp.where(
+        fits,
+        jnp.where(most_allocated[:, None], -util, util),
+        jnp.inf,
+    )
+    zone = jnp.argmin(key, axis=1).astype(jnp.int32)
+    has_fit = jnp.isfinite(jnp.min(key, axis=1))
+    return zone, has_fit
 
 
 def numa_fit_mask(

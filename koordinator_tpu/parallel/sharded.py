@@ -170,6 +170,9 @@ def sharded_assign(
         node_dev_slots=NamedSharding(mesh, P("tp", None)),
         node_rdma_free=NamedSharding(mesh, P("tp")),
         node_fpga_free=NamedSharding(mesh, P("tp")),
+        node_zone_free=NamedSharding(mesh, P("tp", None, None)),
+        pod_zone=NamedSharding(mesh, P("dp")),
+        pod_zone_charge=NamedSharding(mesh, P("dp", None)),
     )
 
     fn = jax.jit(

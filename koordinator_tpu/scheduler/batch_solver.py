@@ -652,19 +652,32 @@ class BatchScheduler:
         # on tunneled backends every synchronous fetch is a full round
         # trip (~100 ms regardless of size); prefetching overlaps them
         # with each other and with still-running chunk solves
+        use_zone_hints = self.numa is not None and self.numa.has_topology
+        packed: List[Optional[jnp.ndarray]] = []
         for _chunk, _rows, result in solves:
+            # assignment + device zone picks ride ONE fetch (a second
+            # per-chunk device→host read costs a full tunnel round trip)
+            pk = None
+            if use_zone_hints and result.pod_zone is not None:
+                pk = jnp.stack([result.assignment, result.pod_zone])
+            packed.append(pk)
             try:
-                result.assignment.copy_to_host_async()
+                (pk if pk is not None else result.assignment).copy_to_host_async()
                 result.rounds_used.copy_to_host_async()
             except (AttributeError, RuntimeError):
                 pass
-        for chunk, rows, result in solves:
+        for (chunk, rows, result), pk in zip(solves, packed):
             t0 = _time.perf_counter()
-            assignment = np.asarray(result.assignment)  # sync point
+            if pk is not None:
+                both = np.asarray(pk)  # sync point
+                assignment, pod_zone = both[0], both[1]
+            else:
+                assignment = np.asarray(result.assignment)  # sync point
+                pod_zone = None
             assignment = self._map_assignment(assignment, sub)
             if fwext.scores.top_n > 0:
                 self._debug_capture(chunk, assignment)
-            b, u = self._commit(chunk, assignment, rows)
+            b, u = self._commit(chunk, assignment, rows, pod_zone=pod_zone)
             fwext.registry.get("solver_batch_latency_seconds").observe(
                 _time.perf_counter() - t0
             )
@@ -981,6 +994,7 @@ class BatchScheduler:
                 qused = quotas0.used
         cur = nodes0
         dev_carry = None
+        numa_carry = None
         out: List[Tuple[List[Pod], LoweredRows, SolveResult]] = []
         for chunk in chunks:
             pods = self.pod_batch(chunk)
@@ -1015,6 +1029,7 @@ class BatchScheduler:
                 approx_topk=True,
                 node_mask=node_mask,
                 dev_carry=dev_carry,
+                numa_carry=numa_carry,
                 numa_scoring=self._numa_scoring(),
                 device_scoring=self._device_scoring(),
             )
@@ -1036,6 +1051,8 @@ class BatchScheduler:
                     result.node_rdma_free,
                     result.node_fpga_free,
                 )
+            if numa_state is not None:
+                numa_carry = result.node_zone_free
             out.append((chunk, rows, result))
         return out
 
@@ -1075,6 +1092,7 @@ class BatchScheduler:
                 zone_free=take(zone_free),
                 zone_cap=take(zone_cap),
                 policy=take(policy),
+                zone_most=take(self.numa.most_allocated_rows()),
             )
         device_state = None
         if self.devices is not None and self.devices.has_devices:
@@ -1276,6 +1294,7 @@ class BatchScheduler:
         chunk: Sequence[Pod],
         assignment: np.ndarray,
         rows: Optional[LoweredRows] = None,
+        pod_zone: Optional[np.ndarray] = None,
     ) -> Tuple[List[Tuple[Pod, str]], List[Pod]]:
         """Host-side Reserve: revalidate each nomination against live numpy
         state (the reference's Reserve mutates the scheduler cache the same
@@ -1315,7 +1334,7 @@ class BatchScheduler:
             check_rows[:n_chunk, cpu_dim] *= factor
 
         results = self._reserve_batch(
-            chunk, assignment, rows, check_rows, prebind
+            chunk, assignment, rows, check_rows, prebind, pod_zone=pod_zone
         )
         # Permit: all-or-nothing over gangs; roll back assumes of rejects.
         # Bypassed outright when neither the chunk nor the manager knows
@@ -1399,6 +1418,7 @@ class BatchScheduler:
         rows: LoweredRows,
         check_rows: np.ndarray,
         prebind: "DefaultPreBind",
+        pod_zone: Optional[np.ndarray] = None,
     ) -> List[Tuple[Pod, Optional[str]]]:
         """Batched Reserve for every winner (reference plugin.go:579-627
         semantics, host cost vectorized):
@@ -1539,6 +1559,11 @@ class BatchScheduler:
                             if rows.numa_required is not None
                             else None
                         )
+                        zone_l = (
+                            pod_zone.tolist()
+                            if pod_zone is not None
+                            else None
+                        )
                         payloads = numa_mgr.allocate_batch(
                             [uids[i] for i in numa_rows],
                             [chunk[i].meta.annotations for i in numa_rows],
@@ -1549,6 +1574,11 @@ class BatchScheduler:
                             required=(
                                 [req_l[i] for i in numa_rows]
                                 if req_l is not None
+                                else None
+                            ),
+                            zones_hint=(
+                                [zone_l[i] for i in numa_rows]
+                                if zone_l is not None
                                 else None
                             ),
                         )
