@@ -165,17 +165,22 @@ def test_shard_map_nominate_pads_ragged_node_table():
 
 
 def test_mesh_mode_production_scheduler_equality():
-    """VERDICT r3 #3: multi-chip as a production mode. The SAME
+    """VERDICT r3 #3 / r4 #3: multi-chip as a production mode. The SAME
     BatchScheduler pipeline (NUMA manager + DeviceManager + quota tree +
     an Available reservation) run with mesh=(dp,tp) must place exactly
     like the single-device path — including the per-winner cpusets,
-    device minors and reservation consumption."""
+    device minors and reservation consumption. Multiple solver chunks,
+    so the on-device zone/slot/capacity chaining crosses shard
+    boundaries (the driver dryrun runs the same check at 2048 pods ×
+    4096 nodes)."""
     import __graft_entry__ as graft
     from koordinator_tpu.parallel.sharded import make_mesh
 
     mesh = make_mesh(8)
-    placed = graft._dryrun_production_scheduler(mesh)
-    assert placed == 49
+    placed = graft._dryrun_production_scheduler(
+        mesh, n_nodes=1024, n_pods=512, batch_bucket=256
+    )
+    assert placed == 512
 
 
 def test_mesh_mode_pipelined_multichunk():
