@@ -79,6 +79,26 @@ LABEL_GANG_MIN_AVAILABLE = "pod-group.scheduling.sigs.k8s.io/min-available"
 ANNOTATION_RESOURCE_SPEC = f"scheduling.{DOMAIN}/resource-spec"
 ANNOTATION_RESOURCE_STATUS = f"scheduling.{DOMAIN}/resource-status"
 ANNOTATION_DEVICE_ALLOCATED = f"scheduling.{DOMAIN}/device-allocated"
+#: device-plugin adapter annotations (reference
+#: ``pkg/scheduler/plugins/deviceshare/device_plugin_adapter.go``):
+#: bind time in unix nanos — device plugins cannot read pod manifests
+#: from kubelet, so they disambiguate same-node same-time pods by it
+ANNOTATION_BIND_TIMESTAMP = f"scheduling.{DOMAIN}/bind-timestamp"
+#: comma-separated allocated GPU minors (env-ref override of
+#: NVIDIA_VISIBLE_DEVICES-style image defaults)
+ANNOTATION_GPU_MINORS = f"scheduling.{DOMAIN}/gpu-minors"
+#: Huawei NPU plugin protocol (vendor-dispatched adapter)
+ANNOTATION_PREDICATE_TIME = "predicate-time"
+ANNOTATION_HUAWEI_NPU_CORE = "huawei.com/npu-core"
+GPU_VENDOR_HUAWEI = "huawei"
+LABEL_GPU_VENDOR = f"node.{DOMAIN}/gpu-vendor"
+#: ClusterColocationProfile controller opt-in/opt-out
+#: (``apis/extension/cluster_colocation_profile.go:24-28``): the
+#: controller reconciles a profile only when ReconcileByDefault or this
+#: label is "true"; a profile carrying the skip annotation suppresses
+#: the webhook's resource mutation for matched pods
+LABEL_CONTROLLER_MANAGED = "config.koordinator.sh/controller-managed"
+ANNOTATION_SKIP_UPDATE_RESOURCES = "config.koordinator.sh/skip-update-resources"
 ANNOTATION_RESERVATION_AFFINITY = f"scheduling.{DOMAIN}/reservation-affinity"
 #: smaller non-zero order wins nomination outright (reference
 #: ``apis/extension/reservation.go:43-46`` LabelReservationOrder)
@@ -549,6 +569,22 @@ def parse_fpga_request(requests: Mapping[str, float]) -> int:
     """Whole FPGAs from ``koordinator.sh/fpga`` (``device_share.go:49``,
     same 100-unit instance convention as RDMA)."""
     return _count_request(requests, RES_FPGA)
+
+
+def should_skip_update_resource(meta) -> bool:
+    """``ShouldSkipUpdateResource``
+    (``apis/extension/cluster_colocation_profile.go:31-37``): presence of
+    the annotation — any value — suppresses the webhook's resource
+    mutation for pods matched by this profile."""
+    return ANNOTATION_SKIP_UPDATE_RESOURCES in (meta.annotations or {})
+
+
+def should_reconcile_profile(meta) -> bool:
+    """``ShouldReconcileProfile``
+    (``cluster_colocation_profile.go:39-41``): the controller reconciles
+    a profile only when this label is exactly "true" (or the global
+    ReconcileByDefault is on)."""
+    return (meta.labels or {}).get(LABEL_CONTROLLER_MANAGED) == "true"
 
 
 def parse_gpu_partition_table(annotations: Mapping[str, str]):

@@ -73,6 +73,11 @@ class NodeArrays:
       assigned_pending_prod — the prod-band slice of assigned_pending
                          (prod thresholds count only prod-tier pods)      [N, D]
       metric_fresh     — NodeMetric not expired             [N] bool
+      has_metric       — a NodeMetric was EVER reported for the node
+                         (filterExpiredNodeMetrics distinguishes
+                         stale-metric nodes, which it may reject, from
+                         never-reported ones, which the reference
+                         Filter always admits)                [N] bool
       schedulable      — not cordoned, padded rows False    [N] bool
       cpu_amp          — CPU amplification ratio from the node annotation
                          (``apis/extension/node_resource_amplification.go``),
@@ -98,6 +103,7 @@ class NodeArrays:
     assigned_pending: np.ndarray
     assigned_pending_prod: np.ndarray
     metric_fresh: np.ndarray
+    has_metric: np.ndarray
     schedulable: np.ndarray
     cpu_amp: np.ndarray
     custom_thresholds: np.ndarray
@@ -119,6 +125,7 @@ class NodeArrays:
             assigned_pending=z(),
             assigned_pending_prod=z(),
             metric_fresh=np.zeros((n_bucket,), bool),
+            has_metric=np.zeros((n_bucket,), bool),
             schedulable=np.zeros((n_bucket,), bool),
             cpu_amp=np.ones((n_bucket,), np.float32),
             custom_thresholds=z(),
@@ -306,6 +313,7 @@ class ClusterSnapshot:
             assigned_pending=pad(old.assigned_pending),
             assigned_pending_prod=pad(old.assigned_pending_prod),
             metric_fresh=pad(old.metric_fresh),
+            has_metric=pad(old.has_metric),
             schedulable=pad(old.schedulable),
             cpu_amp=np.pad(
                 old.cpu_amp, (0, new - old.cpu_amp.shape[0]), constant_values=1.0
@@ -447,6 +455,7 @@ class ClusterSnapshot:
         ):
             arr[idx] = 0
         self.nodes.metric_fresh[idx] = False
+        self.nodes.has_metric[idx] = False
         self.nodes.schedulable[idx] = False
         self.nodes.cpu_amp[idx] = 1.0
         self.nodes.custom_thresholds[idx] = 0.0
@@ -504,6 +513,7 @@ class ClusterSnapshot:
             now, expiry_s if expiry_s is not None else self.metric_expiry_s
         )
         self.nodes.metric_fresh[idx] = fresh
+        self.nodes.has_metric[idx] = True
         if fresh:
             for ap in self._assumed.values():
                 if (

@@ -31,7 +31,7 @@ from . import metriccache as mc
 from . import qosmanager as qos
 from . import resourceexecutor as rex
 from . import runtimehooks as hooks
-from .pleg import Pleg
+from .pleg import InotifyPleg
 from .prediction import PeakPredictor
 from .server import KoordletServer, koordlet_registry
 from .statesinformer import StatesInformer, StateType
@@ -133,7 +133,10 @@ class Koordlet:
         self.metric_cache = mc.MetricCache()
         self.registry = koordlet_registry()
         self.server = KoordletServer(self.registry, self.executor.auditor)
-        self.pleg = Pleg(self.config.cgroup_root)
+        # inotify watcher (kernel-latency lifecycle events, reference
+        # watcher_linux.go); collect_tick's polling diff stays as the
+        # periodic resync and as the full fallback when start() fails
+        self.pleg = InotifyPleg(self.config.cgroup_root)
         # statesinformer is the single state source; the daemon's loops are
         # its registered consumers (koordlet.go wires the same dependency).
         self.informer = StatesInformer(self.config.node_name)
@@ -369,15 +372,26 @@ class Koordlet:
             )
         deadline = time.time() + duration_s
         last_pull = 0.0
-        while time.time() < deadline:
-            now = time.time()
-            if stub is not None and now - last_pull >= self.config.report_interval_s:
-                # retry at the collect cadence until a pull succeeds — a
-                # transient kubelet outage must not blind the pod view
-                # for a whole report interval
-                if stub.sync_into(self.informer):
-                    last_pull = now
-            self.collect_tick(now)
-            self.qos_tick(now)
-            self.report_tick(now)
-            time.sleep(self.config.collect_interval_s)
+        # kernel-latency lifecycle events between ticks; the per-tick
+        # polling diff doubles as the periodic resync (and the only
+        # source when inotify is unavailable)
+        inotify_on = self.pleg.start()
+        try:
+            while time.time() < deadline:
+                now = time.time()
+                if (
+                    stub is not None
+                    and now - last_pull >= self.config.report_interval_s
+                ):
+                    # retry at the collect cadence until a pull succeeds —
+                    # a transient kubelet outage must not blind the pod
+                    # view for a whole report interval
+                    if stub.sync_into(self.informer):
+                        last_pull = now
+                self.collect_tick(now)
+                self.qos_tick(now)
+                self.report_tick(now)
+                time.sleep(self.config.collect_interval_s)
+        finally:
+            if inotify_on:
+                self.pleg.stop()

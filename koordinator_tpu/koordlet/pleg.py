@@ -6,20 +6,27 @@ cgroup directories appearing or vanishing under the QoS-tier hierarchy
 become PodAdded/PodDeleted/ContainerAdded/ContainerDeleted events fanned
 out to registered handlers.
 
-The reference registers inotify watches per tier dir; this rebuild diffs a
-directory scan per tick, which gives the identical event stream (tests and
-the simulator drive ticks; a production deployment ticks at the collect
-interval, bounding event latency the same way the reference's inotify
-queue drain does).
+:class:`Pleg` diffs a directory scan per tick (deterministic; tests and
+the simulator drive ticks). :class:`InotifyPleg` is the production
+watcher matching the reference's kernel-latency path
+(``watcher_linux.go:25-30`` ``inotify.NewWatcher``): ``inotify_init1``
+via ctypes, one watch per tier dir and per pod dir, a reader thread
+translating kernel events to the same handler stream — with the polling
+diff kept as the resync/fallback (non-Linux, fd exhaustion, overflow).
 """
 
 from __future__ import annotations
 
+import ctypes
+import ctypes.util
 import dataclasses
 import enum
+import errno
 import os
+import select
+import struct
 import threading
-from typing import Callable, Dict, List, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 # QoS-tier cgroup parents scanned for pod dirs (the reference watches
 # kubepods, kubepods/burstable, kubepods/besteffort).
@@ -56,6 +63,12 @@ class Pleg:
         self._next_id = 0
         self._known: Dict[str, Set[str]] = {}   # pod_dir -> container ids
         self._lock = threading.Lock()
+        #: serializes _known mutation AND the dispatch that follows it
+        #: between tick() resyncs and an inotify reader thread
+        #: (InotifyPleg) — dispatching outside the lock could deliver a
+        #: later delete before an earlier add (re-entrant: a handler may
+        #: call back into the pleg)
+        self._state_lock = threading.RLock()
 
     def register_handler(self, handler: Handler) -> int:
         """Returns a handler id usable with unregister (pleg.go HandlerID)."""
@@ -97,26 +110,244 @@ class Pleg:
         """Diff the hierarchy against the last scan; fire + return events."""
         seen = self._scan()
         events: List[Event] = []
-        for pod_dir, containers in seen.items():
-            old = self._known.get(pod_dir)
-            if old is None:
-                events.append(Event(EventType.POD_ADDED, pod_dir))
-                old = set()
-            for c in sorted(containers - old):
-                events.append(Event(EventType.CONTAINER_ADDED, pod_dir, c))
-            for c in sorted(old - containers):
-                events.append(Event(EventType.CONTAINER_DELETED, pod_dir, c))
-        for pod_dir in list(self._known):
-            if pod_dir not in seen:
-                for c in sorted(self._known[pod_dir]):
+        with self._state_lock:
+            for pod_dir, containers in seen.items():
+                old = self._known.get(pod_dir)
+                if old is None:
+                    events.append(Event(EventType.POD_ADDED, pod_dir))
+                    old = set()
+                for c in sorted(containers - old):
+                    events.append(Event(EventType.CONTAINER_ADDED, pod_dir, c))
+                for c in sorted(old - containers):
                     events.append(
                         Event(EventType.CONTAINER_DELETED, pod_dir, c)
                     )
-                events.append(Event(EventType.POD_DELETED, pod_dir))
-        self._known = seen
+            for pod_dir in list(self._known):
+                if pod_dir not in seen:
+                    for c in sorted(self._known[pod_dir]):
+                        events.append(
+                            Event(EventType.CONTAINER_DELETED, pod_dir, c)
+                        )
+                    events.append(Event(EventType.POD_DELETED, pod_dir))
+            self._known = seen
+            # dispatch INSIDE the state lock: an inotify reader racing in
+            # must not deliver a later event before these (causal order)
+            self._dispatch(events)
+        return events
+
+    def _dispatch(self, events: List[Event]) -> None:
         with self._lock:
             handlers = list(self._handlers)
         for event in events:
             for _hid, handler in handlers:
                 handler(event)
-        return events
+
+
+# ---- inotify constants (linux/inotify.h) ----
+IN_CREATE = 0x00000100
+IN_DELETE = 0x00000200
+IN_MOVED_FROM = 0x00000040
+IN_MOVED_TO = 0x00000080
+IN_DELETE_SELF = 0x00000400
+IN_ISDIR = 0x40000000
+IN_IGNORED = 0x00008000
+IN_Q_OVERFLOW = 0x00004000
+IN_CLOEXEC = 0x00080000
+
+_WATCH_MASK = IN_CREATE | IN_DELETE | IN_MOVED_FROM | IN_MOVED_TO
+_EVENT_HDR = struct.Struct("iIII")   # wd, mask, cookie, len
+
+
+class InotifyPleg(Pleg):
+    """Kernel-latency lifecycle watcher (reference
+    ``pkg/koordlet/pleg/watcher_linux.go:25-30``): inotify watches on the
+    QoS tier dirs and every pod dir, translated to the same handler
+    event stream as the polling diff. ``start()`` returns False when
+    inotify is unavailable (non-Linux libc, fd/watch exhaustion) — the
+    caller then drives :meth:`tick` as before, so polling remains the
+    portable fallback; a queue overflow triggers a full resync through
+    the same diff."""
+
+    def __init__(self, cgroup_root: str):
+        super().__init__(cgroup_root)
+        self._fd: Optional[int] = None
+        self._libc = None
+        self._wd_to_dir: Dict[int, str] = {}     # wd -> tier or pod rel dir
+        self._dir_to_wd: Dict[str, int] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake_r, self._wake_w = -1, -1
+
+    # -- libc plumbing --
+
+    def _load_libc(self):
+        if self._libc is None:
+            name = ctypes.util.find_library("c") or "libc.so.6"
+            self._libc = ctypes.CDLL(name, use_errno=True)
+        return self._libc
+
+    def _add_watch(self, rel_dir: str) -> Optional[int]:
+        path = os.path.join(self.cgroup_root, rel_dir) if rel_dir else self.cgroup_root
+        wd = self._libc.inotify_add_watch(
+            self._fd, os.fsencode(path), _WATCH_MASK
+        )
+        if wd < 0:
+            return None
+        self._wd_to_dir[wd] = rel_dir
+        self._dir_to_wd[rel_dir] = wd
+        return wd
+
+    def _rm_watch(self, rel_dir: str) -> None:
+        wd = self._dir_to_wd.pop(rel_dir, None)
+        if wd is not None:
+            self._wd_to_dir.pop(wd, None)
+            try:
+                self._libc.inotify_rm_watch(self._fd, wd)
+            except Exception:
+                pass
+
+    # -- lifecycle --
+
+    def start(self) -> bool:
+        """Initialize inotify, seed state with one scan, and start the
+        reader thread. False = unavailable (caller keeps polling)."""
+        try:
+            libc = self._load_libc()
+            fd = libc.inotify_init1(IN_CLOEXEC)
+        except (OSError, AttributeError):
+            return False
+        if fd < 0:
+            return False
+        self._fd = fd
+        ok = False
+        for tier in TIER_DIRS:
+            if self._add_watch(tier) is not None:
+                ok = True
+        if not ok:
+            os.close(fd)
+            self._fd = None
+            return False
+        # seed through tick() so pods already present at startup FIRE
+        # their PodAdded/ContainerAdded events (the polling Pleg's first
+        # tick delivered them; silent seeding would lose them), then
+        # watch each discovered pod dir; dirs raced during setup surface
+        # through the next resync tick
+        self.tick()
+        for pod_dir in list(self._known):
+            self._add_watch(pod_dir)
+        self._wake_r, self._wake_w = os.pipe()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="pleg-inotify", daemon=True
+        )
+        self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._wake_w >= 0:
+            try:
+                os.write(self._wake_w, b"x")
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        for fdesc in (self._fd, self._wake_r, self._wake_w):
+            if fdesc is not None and fdesc >= 0:
+                try:
+                    os.close(fdesc)
+                except OSError:
+                    pass
+        self._fd = None
+        self._wake_r = self._wake_w = -1
+
+    # -- reader --
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ready, _, _ = select.select(
+                    [self._fd, self._wake_r], [], [], 1.0
+                )
+            except (OSError, ValueError):
+                return
+            if self._stop.is_set():
+                return
+            if self._fd not in ready:
+                continue
+            try:
+                buf = os.read(self._fd, 65536)
+            except OSError as e:
+                if e.errno == errno.EAGAIN:
+                    continue
+                return
+            self._consume(buf)
+
+    def _consume(self, buf: bytes) -> None:
+        with self._state_lock:
+            events, overflow = self._consume_locked(buf)
+            # events parsed from this buffer already mutated _known, so
+            # they MUST be delivered even on overflow (a resync diff
+            # would no longer see them); the resync then recovers
+            # whatever the kernel dropped after the overflow marker
+            if events:
+                self._dispatch(events)
+            if overflow:
+                self.tick()
+
+    def _consume_locked(self, buf: bytes) -> Tuple[List[Event], bool]:
+        events: List[Event] = []
+        off = 0
+        overflow = False
+        while off + _EVENT_HDR.size <= len(buf):
+            wd, mask, _cookie, nlen = _EVENT_HDR.unpack_from(buf, off)
+            name = buf[
+                off + _EVENT_HDR.size : off + _EVENT_HDR.size + nlen
+            ].split(b"\0", 1)[0].decode(errors="replace")
+            off += _EVENT_HDR.size + nlen
+            if mask & IN_Q_OVERFLOW:
+                overflow = True
+                continue
+            if mask & IN_IGNORED:
+                continue
+            rel = self._wd_to_dir.get(wd)
+            if rel is None or not name:
+                continue
+            created = mask & (IN_CREATE | IN_MOVED_TO)
+            deleted = mask & (IN_DELETE | IN_MOVED_FROM)
+            if rel in TIER_DIRS:
+                if not _is_pod_dir(name):
+                    continue
+                pod_dir = os.path.join(rel, name)
+                if created and mask & IN_ISDIR:
+                    if pod_dir not in self._known:
+                        self._known[pod_dir] = set()
+                        self._add_watch(pod_dir)
+                        events.append(Event(EventType.POD_ADDED, pod_dir))
+                elif deleted:
+                    containers = self._known.pop(pod_dir, None)
+                    if containers is not None:
+                        for c in sorted(containers):
+                            events.append(
+                                Event(
+                                    EventType.CONTAINER_DELETED, pod_dir, c
+                                )
+                            )
+                        events.append(Event(EventType.POD_DELETED, pod_dir))
+                    self._rm_watch(pod_dir)
+            else:
+                containers = self._known.get(rel)
+                if containers is None:
+                    continue
+                if created and mask & IN_ISDIR and name not in containers:
+                    containers.add(name)
+                    events.append(
+                        Event(EventType.CONTAINER_ADDED, rel, name)
+                    )
+                elif deleted and name in containers:
+                    containers.discard(name)
+                    events.append(
+                        Event(EventType.CONTAINER_DELETED, rel, name)
+                    )
+        return events, overflow

@@ -17,14 +17,27 @@ from .profile import ProfileMutator
 
 
 class ColocationProfileController:
-    def __init__(self, mutator: ProfileMutator):
+    def __init__(self, mutator: ProfileMutator, reconcile_by_default: bool = True):
         self.mutator = mutator
+        #: the reference's ReconcileByDefault flag
+        #: (``colocationprofile_controller.go:86-91``): when off, only
+        #: profiles labeled controller-managed="true" are reconciled
+        self.reconcile_by_default = reconcile_by_default
+
+    def _enabled(self, profile) -> bool:
+        from ..api import extension as ext
+
+        return self.reconcile_by_default or ext.should_reconcile_profile(
+            profile.meta
+        )
 
     def reconcile(self, pods: Iterable[Pod]) -> List[Pod]:
         """Returns the pods that were changed."""
         changed: List[Pod] = []
         for pod in pods:
-            matched = self.mutator.match(pod)
+            matched = [
+                p for p in self.mutator.match(pod) if self._enabled(p)
+            ]
             if not matched:
                 continue
             before = (
@@ -36,7 +49,7 @@ class ColocationProfileController:
                 dict(pod.spec.limits),
             )
             if pod.phase is PodPhase.PENDING and pod.spec.node_name is None:
-                self.mutator.mutate(pod)
+                self.mutator.mutate_with(pod, matched)
             else:
                 # bound pods: metadata-only reconcile
                 for p in sorted(matched, key=lambda p: p.meta.name):

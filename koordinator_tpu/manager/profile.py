@@ -53,15 +53,23 @@ class ProfileMutator:
         return out
 
     @staticmethod
-    def _apply(p: ClusterColocationProfile, meta, resource_stores) -> None:
+    def _apply(
+        p: ClusterColocationProfile,
+        meta,
+        resource_stores,
+        skip_resources: bool = False,
+    ) -> None:
         """One profile's mutation against any object's (meta,
         resource dicts) — the single source of truth for both the pod and
-        the reservation webhook paths."""
+        the reservation webhook paths. ``skip_resources`` suppresses the
+        resource-name rewrite (the skip-update-resources annotation,
+        ``cluster_colocation_profile.go:94-115``: labels/QoS/priority
+        still apply; only the resource spec mutation is skipped)."""
         meta.labels.update(p.labels)
         meta.annotations.update(p.annotations)
         if p.qos_class is not None:
             meta.labels[ext.LABEL_POD_QOS] = p.qos_class.name
-        if p.resource_translation:
+        if p.resource_translation and not skip_resources:
             for store in resource_stores:
                 for src, dst in p.resource_translation.items():
                     if src in store:
@@ -69,8 +77,25 @@ class ProfileMutator:
 
     def mutate(self, pod: Pod) -> Pod:
         """Apply all matching profiles in name order (deterministic)."""
-        for p in sorted(self.match(pod), key=lambda p: p.meta.name):
-            self._apply(p, pod.meta, (pod.spec.requests, pod.spec.limits))
+        return self.mutate_with(pod, self.match(pod))
+
+    def mutate_with(self, pod: Pod, profiles) -> Pod:
+        """Apply the given (already-matched) profiles in name order.
+        ANY profile carrying the skip-update-resources annotation
+        suppresses the resource mutation for the whole pod (the webhook
+        accumulates the flag across profiles before mutating the
+        resource spec, ``cluster_colocation_profile.go:94-97,113-115``)."""
+        matched = sorted(profiles, key=lambda p: p.meta.name)
+        skip_resources = any(
+            ext.should_skip_update_resource(p.meta) for p in matched
+        )
+        for p in matched:
+            self._apply(
+                p,
+                pod.meta,
+                (pod.spec.requests, pod.spec.limits),
+                skip_resources=skip_resources,
+            )
             if p.priority is not None:
                 pod.spec.priority = p.priority
             if p.scheduler_name is not None:

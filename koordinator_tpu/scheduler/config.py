@@ -18,9 +18,12 @@ from ..api import extension as ext
 from ..descheduler.low_node_load import LowNodeLoadArgs
 from .batch_solver import LoadAwareArgs
 
-#: v1 and v1beta3 share field spellings for every arg this rebuild
-#: consumes; the version tag is validated (unknown versions rejected)
-#: but selects no distinct decode path.
+#: v1 and v1beta3 share field spellings for these args, but do NOT decode
+#: identically everywhere: the reference's hand-written v1beta3
+#: conversion overrides LoadAwareSchedulingArgs.FilterExpiredNodeMetrics
+#: to true regardless of the configured value
+#: (``v1beta3/conversion_plugin.go:25-33``), while v1 honors it
+#: (generated conversion). ``decode_load_aware`` implements that split.
 SUPPORTED_VERSIONS = ("v1", "v1beta3")
 
 #: reference defaults (v1beta3/defaults.go) applied only when the key is
@@ -115,9 +118,18 @@ def _set_if_present(
         kwargs[field] = _table(raw.get(key), key)
 
 
-def decode_load_aware(raw: Mapping[str, Any]) -> LoadAwareArgs:
+def decode_load_aware(
+    raw: Mapping[str, Any], api_version: str = "v1"
+) -> LoadAwareArgs:
     """v1/v1beta3 LoadAwareSchedulingArgs → canonical, with the reference's
-    defaulting (defaults.go:89-116: merge estimator scales key-wise)."""
+    defaulting (defaults.go:89-116: merge estimator scales key-wise).
+
+    The versions genuinely diverge on ``filterExpiredNodeMetrics``: the
+    v1beta3 conversion FORCES it true after the field copy
+    (``v1beta3/conversion_plugin.go:25-33``), while v1 passes the
+    configured value through (default true when absent,
+    ``v1/defaults.go:91-93``). ``enableScheduleWhenNodeMetricsExpired``
+    defaults false (strict) in both (``defaults.go:94-95``)."""
     kwargs: Dict[str, Any] = {}
     _set_if_present(kwargs, raw, "usageThresholds", "usage_thresholds")
     _set_if_present(kwargs, raw, "prodUsageThresholds", "prod_usage_thresholds")
@@ -132,6 +144,15 @@ def decode_load_aware(raw: Mapping[str, Any]) -> LoadAwareArgs:
     agg = raw.get("aggregated") or {}
     kwargs["aggregated_usage_type"] = str(
         agg.get("usageAggregationType", raw.get("usageAggregationType", "p95"))
+    )
+    if api_version == "v1beta3":
+        kwargs["filter_expired_node_metrics"] = True
+    else:
+        kwargs["filter_expired_node_metrics"] = bool(
+            raw.get("filterExpiredNodeMetrics", True)
+        )
+    kwargs["enable_schedule_when_node_metrics_expired"] = bool(
+        raw.get("enableScheduleWhenNodeMetricsExpired", False)
     )
     return LoadAwareArgs(**kwargs)
 
@@ -346,7 +367,12 @@ def decode_plugin_args(
     if plugin not in _PLUGINS:
         raise ConfigError("plugins", f"unknown plugin {plugin!r}")
     decode, validate = _PLUGINS[plugin]
-    args = decode(raw or {})
+    if plugin == "LoadAwareScheduling":
+        # the only args with a version-divergent decode (see
+        # decode_load_aware's conversion notes)
+        args = decode(raw or {}, api_version=api_version)
+    else:
+        args = decode(raw or {})
     validate(args)
     return args
 

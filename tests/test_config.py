@@ -136,3 +136,82 @@ def test_default_prebind_single_patch():
     pb.discard(pod2.meta.uid)
     assert pb.apply(pod2) is False
     assert pod2.meta.annotations == {}
+
+
+def test_filter_expired_node_metrics_version_divergence():
+    """v1beta3's hand-written conversion FORCES filterExpiredNodeMetrics
+    true regardless of the configured value (conversion_plugin.go:25-33);
+    v1 honors the field (default true when absent) — the same fixture
+    must decode DIFFERENTLY per version."""
+    from koordinator_tpu.scheduler.config import decode_plugin_args
+
+    fixture = {"filterExpiredNodeMetrics": False}
+    v1 = decode_plugin_args("LoadAwareScheduling", fixture, "v1")
+    beta = decode_plugin_args("LoadAwareScheduling", fixture, "v1beta3")
+    assert v1.filter_expired_node_metrics is False
+    assert beta.filter_expired_node_metrics is True
+    # absent key: both default true; the strict schedule-when-expired
+    # default is false in both (defaults.go:91-95)
+    for ver in ("v1", "v1beta3"):
+        args = decode_plugin_args("LoadAwareScheduling", {}, ver)
+        assert args.filter_expired_node_metrics is True
+        assert args.enable_schedule_when_node_metrics_expired is False
+
+
+def test_strict_expired_metric_filter_rejects_stale_nodes():
+    """With the componentconfig defaults (filter on, schedule-when-expired
+    off), a node whose NodeMetric went STALE is unschedulable while a
+    never-reported node stays admitted (load_aware.go:143-149 +
+    the nil-NodeMetric path)."""
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeMetric,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        ResourceMetric,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+    from koordinator_tpu.scheduler.config import decode_plugin_args
+
+    args = decode_plugin_args("LoadAwareScheduling", {}, "v1")
+    snap = ClusterSnapshot()
+    for name in ("stale", "fresh", "silent"):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 8000, ext.RES_MEMORY: 16384}
+                ),
+            )
+        )
+    mk_metric = lambda n, t: NodeMetric(
+        meta=ObjectMeta(name=n),
+        node_usage=ResourceMetric(usage={ext.RES_CPU: 100.0}),
+        update_time=t,
+    )
+    snap.set_node_metric(mk_metric("stale", 100.0), now=100.0 + 10_000)
+    snap.set_node_metric(mk_metric("fresh", 100.0), now=101.0)
+    sched = BatchScheduler(snap, args, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+
+    def where(pod_name, node_name=None):
+        out = sched.schedule(
+            [
+                Pod(
+                    meta=ObjectMeta(name=pod_name),
+                    spec=PodSpec(
+                        requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 1024},
+                        node_name=node_name,
+                    ),
+                )
+            ]
+        )
+        return out.bound[0][1] if out.bound else None
+
+    assert where("p-stale", "stale") is None       # stale metric: rejected
+    assert where("p-fresh", "fresh") == "fresh"    # fresh metric: fine
+    assert where("p-silent", "silent") == "silent"  # never reported: fine

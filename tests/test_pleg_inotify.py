@@ -1,0 +1,115 @@
+"""Inotify PLEG (VERDICT r4 #5, reference
+``pkg/koordlet/pleg/watcher_linux.go:25-30``): kernel-latency lifecycle
+events via ctypes inotify, with the polling diff as resync/fallback."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from koordinator_tpu.koordlet.pleg import (
+    Event,
+    EventType,
+    InotifyPleg,
+    Pleg,
+    TIER_DIRS,
+)
+
+
+def _mk_root(tmp_path):
+    for tier in TIER_DIRS:
+        os.makedirs(tmp_path / tier, exist_ok=True)
+    return str(tmp_path)
+
+
+@pytest.fixture
+def watcher(tmp_path):
+    p = InotifyPleg(_mk_root(tmp_path))
+    started = p.start()
+    if not started:
+        pytest.skip("inotify unavailable on this platform")
+    yield p, tmp_path
+    p.stop()
+
+
+def _wait_for(events, pred, timeout=2.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if any(pred(e) for e in list(events)):
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_event_latency_is_sub_interval(watcher):
+    """A pod cgroup dir appearing is reported well under any polling
+    interval — the inotify thread fires without a tick."""
+    p, tmp = watcher
+    events = []
+    lock = threading.Lock()
+
+    def handler(e: Event):
+        with lock:
+            events.append((e, time.time()))
+
+    p.register_handler(handler)
+    t0 = time.time()
+    os.makedirs(tmp / "kubepods" / "podabc")
+    assert _wait_for(
+        events, lambda et: et[0].type == EventType.POD_ADDED
+    ), events
+    _e, t_seen = next(
+        et for et in events if et[0].type == EventType.POD_ADDED
+    )
+    # sub-interval: a 1 s poller would average 500 ms; inotify lands in
+    # tens of milliseconds even on a loaded host
+    assert t_seen - t0 < 0.5, f"event latency {t_seen - t0:.3f}s"
+
+
+def test_container_and_delete_events(watcher):
+    p, tmp = watcher
+    events = []
+    p.register_handler(lambda e: events.append(e))
+    os.makedirs(tmp / "kubepods" / "podx")
+    assert _wait_for(events, lambda e: e.type == EventType.POD_ADDED)
+    os.makedirs(tmp / "kubepods" / "podx" / "c1")
+    assert _wait_for(
+        events,
+        lambda e: e.type == EventType.CONTAINER_ADDED and e.container_id == "c1",
+    ), events
+    os.rmdir(tmp / "kubepods" / "podx" / "c1")
+    assert _wait_for(
+        events,
+        lambda e: e.type == EventType.CONTAINER_DELETED
+        and e.container_id == "c1",
+    ), events
+    os.rmdir(tmp / "kubepods" / "podx")
+    assert _wait_for(events, lambda e: e.type == EventType.POD_DELETED), events
+
+
+def test_polling_resync_coexists(watcher):
+    """tick() remains a safe resync: after inotify has consumed events,
+    a tick fires nothing new; state stays consistent."""
+    p, tmp = watcher
+    events = []
+    p.register_handler(lambda e: events.append(e))
+    os.makedirs(tmp / "kubepods" / "burstable" / "podr")
+    assert _wait_for(events, lambda e: e.type == EventType.POD_ADDED)
+    n_before = len(events)
+    assert p.tick() == []
+    assert len(events) == n_before
+
+
+def test_polling_fallback_still_works(tmp_path):
+    """The base Pleg (and an InotifyPleg that was never started) keeps
+    the documented tick semantics."""
+    root = _mk_root(tmp_path)
+    p = Pleg(root)
+    assert p.tick() == []
+    os.makedirs(tmp_path / "kubepods" / "podz" / "c9")
+    evs = p.tick()
+    assert [e.type for e in evs] == [
+        EventType.POD_ADDED,
+        EventType.CONTAINER_ADDED,
+    ]

@@ -451,3 +451,151 @@ def test_extended_resource_spec_round_trip():
     parsed = ext.parse_extended_resource_spec(ann)
     assert parsed["main"]["requests"][ext.RES_BATCH_CPU] == 2000
     assert ext.parse_extended_resource_spec({}) == {}
+
+
+# ---- controller-managed / skip-update-resources (r5, the last two keys:
+# apis/extension/cluster_colocation_profile.go:24-41) ----
+
+
+def _profile(name, labels=None, annotations=None, translate=True):
+    from koordinator_tpu.api.types import ClusterColocationProfile, ObjectMeta
+
+    return ClusterColocationProfile(
+        meta=ObjectMeta(
+            name=name,
+            labels=dict(labels or {}),
+            annotations=dict(annotations or {}),
+        ),
+        selector={"app": "colo"},
+        labels={f"from-{name}": "yes"},
+        resource_translation=(
+            {ext.RES_CPU: ext.RES_BATCH_CPU} if translate else {}
+        ),
+    )
+
+
+def _colo_pod(name="c1", phase=None, node=None):
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodPhase, PodSpec
+
+    return Pod(
+        meta=ObjectMeta(name=name, labels={"app": "colo"}),
+        spec=PodSpec(requests={ext.RES_CPU: 1000}, node_name=node),
+        phase=phase or PodPhase.PENDING,
+    )
+
+
+def test_skip_update_resources_suppresses_resource_mutation():
+    """A matched profile carrying the skip annotation keeps labels/QoS
+    mutations but suppresses the resource rewrite for the WHOLE pod —
+    even when another matched profile has no such annotation
+    (cluster_colocation_profile.go:94-115)."""
+    from koordinator_tpu.manager.profile import ProfileMutator
+
+    mut = ProfileMutator(
+        [
+            _profile("a"),
+            _profile(
+                "b",
+                annotations={ext.ANNOTATION_SKIP_UPDATE_RESOURCES: ""},
+                translate=False,
+            ),
+        ]
+    )
+    pod = _colo_pod()
+    mut.mutate(pod)
+    assert pod.meta.labels["from-a"] == "yes"
+    assert pod.meta.labels["from-b"] == "yes"
+    # resource translation suppressed by b's annotation
+    assert ext.RES_CPU in pod.spec.requests
+    assert ext.RES_BATCH_CPU not in pod.spec.requests
+    # without the skip profile the translation applies
+    pod2 = _colo_pod("c2")
+    ProfileMutator([_profile("a")]).mutate(pod2)
+    assert ext.RES_BATCH_CPU in pod2.spec.requests
+
+
+def test_controller_managed_gates_reconcile():
+    """With ReconcileByDefault off, the controller reconciles only
+    profiles labeled controller-managed="true"
+    (colocationprofile_controller.go:86-91)."""
+    from koordinator_tpu.manager.colocation_controller import (
+        ColocationProfileController,
+    )
+    from koordinator_tpu.manager.profile import ProfileMutator
+
+    unmanaged = _profile("un")
+    managed = _profile(
+        "mg", labels={ext.LABEL_CONTROLLER_MANAGED: "true"}
+    )
+    mut = ProfileMutator([unmanaged, managed])
+    ctrl = ColocationProfileController(mut, reconcile_by_default=False)
+    pod = _colo_pod()
+    changed = ctrl.reconcile([pod])
+    assert changed == [pod]
+    assert pod.meta.labels.get("from-mg") == "yes"
+    assert "from-un" not in pod.meta.labels
+    # default-on reconciles both (the reference's ReconcileByDefault)
+    pod2 = _colo_pod("c3")
+    ColocationProfileController(mut).reconcile([pod2])
+    assert pod2.meta.labels.get("from-un") == "yes"
+
+
+def test_device_plugin_adapter_annotations():
+    """Device winners carry the vendor device-plugin protocol
+    (device_plugin_adapter.go): bind-timestamp always, gpu-minors for
+    GPU allocations, and the Huawei NPU pair on huawei-vendor nodes."""
+    from koordinator_tpu.api.types import (
+        Device,
+        DeviceInfo,
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+    from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    for name, labels in (("gen", {}), ("hw", {ext.LABEL_GPU_VENDOR: "huawei"})):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 65536}
+                ),
+            )
+        )
+        dm.upsert_device(
+            Device(
+                meta=ObjectMeta(name=name, labels=labels),
+                devices=[
+                    DeviceInfo(dev_type="gpu", minor=g) for g in range(4)
+                ],
+            )
+        )
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+
+    def place(name, node):
+        pod = Pod(
+            meta=ObjectMeta(name=name),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 1000, ext.RES_GPU: 2},
+                node_name=node,
+                priority=9000,
+            ),
+        )
+        out = sched.schedule([pod])
+        assert len(out.bound) == 1, out.unschedulable
+        return out.bound[0][0]
+
+    gen = place("p-gen", "gen")
+    assert ext.ANNOTATION_BIND_TIMESTAMP in gen.meta.annotations
+    assert gen.meta.annotations[ext.ANNOTATION_GPU_MINORS] == "0,1"
+    assert ext.ANNOTATION_HUAWEI_NPU_CORE not in gen.meta.annotations
+    hw = place("p-hw", "hw")
+    assert hw.meta.annotations[ext.ANNOTATION_HUAWEI_NPU_CORE] == "0,1"
+    assert ext.ANNOTATION_PREDICATE_TIME in hw.meta.annotations
