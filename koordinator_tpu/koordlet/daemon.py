@@ -318,6 +318,9 @@ class Koordlet:
         if now - self._last_report < self.config.report_interval_s:
             return None
         self._last_report = now
+        # retention sweep at report cadence (the TSDB's periodic
+        # truncation, tsdb_storage.go:117 RetentionDuration)
+        self.metric_cache.enforce_retention(now)
         self._checkpoint()
         return self.reporter.report(now)
 

@@ -74,6 +74,31 @@ class _Ring:
         idx = (self.head - 1) % self.ts.shape[0]
         return float(self.ts[idx]), float(self.values[idx])
 
+    def compact(self, horizon: float) -> int:
+        """Invalidate samples older than ``horizon`` (retention
+        truncation). The ring keeps slots — only count/ordering state
+        needs repair — so this is a vectorized re-pack of the live
+        samples. Returns how many samples were dropped."""
+        if self.count == 0 or horizon == float("-inf"):
+            return 0
+        cap = self.ts.shape[0]
+        order = (
+            np.arange(self.head - self.count, self.head) % cap
+            if self.count
+            else np.empty(0, np.int64)
+        )
+        live = order[self.ts[order] >= horizon]
+        dropped = self.count - live.size
+        if dropped <= 0:
+            return 0
+        ts_live = self.ts[live].copy()
+        val_live = self.values[live].copy()
+        self.ts[: live.size] = ts_live
+        self.values[: live.size] = val_live
+        self.head = live.size % cap
+        self.count = live.size
+        return int(dropped)
+
 
 @dataclasses.dataclass
 class AggregateResult:
@@ -82,11 +107,32 @@ class AggregateResult:
     percentiles: Dict[str, float]
 
 
-class MetricCache:
-    """Thread-safe series store keyed by (metric, subject)."""
+#: reference default: ``TSDBRetentionDuration: 12 * time.Hour``
+#: (``pkg/koordlet/metriccache/config.go:50``), enforced by the embedded
+#: TSDB (``tsdb_storage.go:117`` RetentionDuration)
+DEFAULT_RETENTION_S = 12 * 3600.0
 
-    def __init__(self, capacity_per_series: int = 4096):
+
+class MetricCache:
+    """Thread-safe series store keyed by (metric, subject).
+
+    ``retention_s`` enforces the reference's configured retention
+    duration (tsdb_storage.go:117) two ways: queries clamp their window
+    to ``newest_sample − retention_s`` in DATA time (synthetic clocks in
+    the simulator keep working; data ≈ wall time in production), and
+    :meth:`enforce_retention` physically compacts against an explicit
+    ``now`` (the daemon passes wall time at report cadence) and drops
+    series left empty. Nothing is destroyed on the append hot path, so a
+    clock-skewed future sample can hide history only until it is itself
+    swept, never erase it."""
+
+    def __init__(
+        self,
+        capacity_per_series: int = 4096,
+        retention_s: float = DEFAULT_RETENTION_S,
+    ):
         self.capacity = capacity_per_series
+        self.retention_s = float(retention_s)
         self._series: Dict[Tuple[str, str], _Ring] = {}
         self._kv: Dict[str, object] = {}
         self._lock = threading.Lock()
@@ -99,9 +145,18 @@ class MetricCache:
             self._series[key] = ring
         return ring
 
+    def _horizon(self, now: float) -> float:
+        if self.retention_s <= 0:
+            return float("-inf")
+        return now - self.retention_s
+
     def append(
         self, metric: str, subject: str, ts: float, value: float
     ) -> None:
+        # O(1): retention is enforced at query time (aggregate's horizon
+        # clamp) and by the periodic enforce_retention sweep — per-append
+        # compaction keyed on a sample's own ts would both slow the hot
+        # path and let one clock-skewed future timestamp wipe a series
         with self._lock:
             self._ring(metric, subject).append(ts, value)
 
@@ -125,11 +180,17 @@ class MetricCache:
         end: float,
         percentiles: Sequence[str] = AGG_TYPES,
     ) -> Optional[AggregateResult]:
-        """Windowed aggregate: avg + requested percentiles (p50..p99)."""
+        """Windowed aggregate: avg + requested percentiles (p50..p99).
+        The window never reaches past the series' retention horizon
+        (newest sample − retention)."""
         with self._lock:
             ring = self._series.get((metric, subject))
             if ring is None:
                 return None
+            if self.retention_s > 0:
+                newest = ring.latest()
+                if newest is not None:
+                    start = max(start, self._horizon(newest[0]))
             values = ring.window(start, end)
         if values.size == 0:
             return None
@@ -163,6 +224,27 @@ class MetricCache:
             for k in dead:
                 del self._series[k]
             return len(dead)
+
+    def enforce_retention(self, now: Optional[float] = None) -> Tuple[int, int]:
+        """Retention sweep (the TSDB's periodic head/block truncation):
+        compact every series to ``now − retention`` and drop those left
+        empty. ``now`` defaults to wall time (the daemon calls this at
+        report cadence). Returns ``(samples_dropped, series_dropped)``."""
+        if now is None:
+            import time as _t
+
+            now = _t.time()
+        horizon = self._horizon(now)
+        samples = 0
+        with self._lock:
+            dead = []
+            for key, ring in self._series.items():
+                samples += ring.compact(horizon)
+                if ring.count == 0:
+                    dead.append(key)
+            for key in dead:
+                del self._series[key]
+            return samples, len(dead)
 
     # ---- checkpoint / restore ----
     # The reference embeds a Prometheus TSDB with an on-disk WAL
@@ -213,13 +295,18 @@ class MetricCache:
 
     @classmethod
     def restore(
-        cls, path: str, capacity_per_series: int = 4096
+        cls,
+        path: str,
+        capacity_per_series: int = 4096,
+        retention_s: float = DEFAULT_RETENTION_S,
     ) -> "MetricCache":
         """Rebuild from a checkpoint; an unreadable file yields an empty
         cache (a restart must never be blocked on history)."""
         import json
 
-        cache = cls(capacity_per_series=capacity_per_series)
+        cache = cls(
+            capacity_per_series=capacity_per_series, retention_s=retention_s
+        )
         try:
             with np.load(path) as data:
                 keys = json.loads(bytes(data["keys"]).decode())
@@ -231,5 +318,8 @@ class MetricCache:
                     ring.head, ring.count = head, count
                     cache._series[tuple(key)] = ring
         except (OSError, KeyError, ValueError):
-            return cls(capacity_per_series=capacity_per_series)
+            return cls(
+                capacity_per_series=capacity_per_series,
+                retention_s=retention_s,
+            )
         return cache

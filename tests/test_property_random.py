@@ -1,0 +1,277 @@
+"""Randomized invariant tests over the constrained scheduling paths.
+
+Seeded generators (deterministic across runs) drive mixed workloads
+through the full BatchScheduler and assert the invariants the r5 design
+rests on: the solver's carried device/zone tables stay consistent with
+the host managers, no resource is ever overcommitted, and hint paths
+never change semantics.
+"""
+
+import json
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Device,
+    DeviceInfo,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.core.topology import CPUTopology
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.plugins.deviceshare import FULL, DeviceManager
+from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+    NUMAManager,
+    NUMAPolicy,
+)
+
+
+def _gpu_cluster(n_nodes, gpus_per_node, hetero=False, seed=0):
+    rng = np.random.default_rng(seed)
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    for i in range(n_nodes):
+        name = f"n{i:03d}"
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 128000, ext.RES_MEMORY: 1 << 20}
+                ),
+            )
+        )
+        g = gpus_per_node
+        if hetero:
+            g = int(rng.choice([2, 4, gpus_per_node]))
+        dm.upsert_device(
+            Device(
+                meta=ObjectMeta(name=name),
+                devices=[
+                    DeviceInfo(dev_type="gpu", minor=m, numa_node=m % 2)
+                    for m in range(g)
+                ],
+            )
+        )
+    return snap, dm
+
+
+def _random_gpu_pods(n, seed):
+    rng = np.random.default_rng(seed)
+    pods = []
+    for i in range(n):
+        req = {ext.RES_CPU: int(rng.choice([1000, 2000, 4000]))}
+        kind = rng.integers(0, 6)
+        if kind < 3:
+            req[ext.RES_GPU] = int(rng.choice([1, 2, 4]))
+        elif kind < 5:
+            req[ext.RES_GPU_MEMORY_RATIO] = int(rng.choice([20, 30, 50, 60]))
+        # kind 5: no device demand at all
+        pods.append(
+            Pod(
+                meta=ObjectMeta(name=f"p{i:04d}"),
+                spec=PodSpec(requests=req, priority=int(rng.integers(5000, 9999))),
+            )
+        )
+    return pods
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+@pytest.mark.parametrize("hetero", [False, True])
+def test_device_slots_never_overcommit_and_bound_pods_hold_minors(
+    seed, hetero
+):
+    """Random mixed whole/fractional GPU workloads over (optionally
+    heterogeneous) inventories, multiple chunks: after the drain, every
+    minor's allocations sum within capacity, every bound GPU pod holds
+    concrete minors, and unschedulable pods genuinely did not fit."""
+    snap, dm = _gpu_cluster(12, 8, hetero=hetero, seed=seed)
+    sched = BatchScheduler(snap, LoadAwareArgs(), devices=dm, batch_bucket=32)
+    sched.extender.monitor.stop_background()
+    pods = _random_gpu_pods(80, seed + 100)
+    out = sched.schedule(pods)
+    assert len(out.bound) + len(out.unschedulable) == len(pods)
+    # no minor below zero free, and owner charges reconcile exactly
+    for i in range(12):
+        st = dm.node(f"n{i:03d}")
+        if st is None:
+            continue
+        for free in st.gpu_free:
+            assert -1e-6 <= free <= FULL + 1e-6
+        per_minor = [0.0] * len(st.gpu_free)
+        for picks in st.owners.values():
+            for minor, pct, _core in picks:
+                per_minor[minor] += pct
+        for minor, used in enumerate(per_minor):
+            assert used <= FULL + 1e-6, (i, minor, used)
+            np.testing.assert_allclose(
+                st.gpu_free[minor], FULL - used, atol=1e-3
+            )
+    for pod, node in out.bound:
+        whole, share = ext.parse_gpu_request(pod.spec.requests)
+        if whole or share:
+            alloc = json.loads(
+                pod.meta.annotations[ext.ANNOTATION_DEVICE_ALLOCATED]
+            )
+            minors = [e["minor"] for e in alloc["gpu"]]
+            assert len(set(minors)) == len(minors)
+            if whole and not share:
+                assert len(minors) == whole
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_reschedule_after_release_reuses_freed_slots(seed):
+    """Bind → release → rebind cycles keep the incremental lowering cache
+    and the host slot state coherent (the dirty-row path, not just fresh
+    lowering)."""
+    snap, dm = _gpu_cluster(4, 4, seed=seed)
+    sched = BatchScheduler(snap, LoadAwareArgs(), devices=dm, batch_bucket=32)
+    sched.extender.monitor.stop_background()
+    pods = _random_gpu_pods(16, seed)
+    out1 = sched.schedule(pods)
+    bound1 = list(out1.bound)
+    assert bound1
+    # release every bound pod (pod deleted), then schedule a fresh copy
+    for pod, node in bound1:
+        dm.release(pod.meta.uid, node)
+        snap.forget_pod(pod.meta.uid)
+    for i in range(4):
+        st = dm.node(f"n{i:03d}")
+        assert all(abs(f - FULL) < 1e-6 for f in st.gpu_free), st.gpu_free
+    # the IDENTICAL mix binds at least as fully on the restored slots
+    pods2 = _random_gpu_pods(16, seed)
+    for p in pods2:
+        p.meta.name = "re-" + p.meta.name
+    out2 = sched.schedule(pods2)
+    assert len(out2.bound) >= len(bound1)
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_numa_zone_accounting_reconciles_after_random_drain(seed):
+    """Random LSR/LS mixes over SINGLE_NUMA_NODE topologies: per-zone
+    used never exceeds capacity and equals the sum of owner charges;
+    cpusets of co-located pods never overlap."""
+    rng = np.random.default_rng(seed)
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    topo = CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=8)
+    for i in range(8):
+        name = f"m{i}"
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 131072}
+                ),
+            )
+        )
+        numa.register_node(
+            name, topo, NUMAPolicy.SINGLE_NUMA_NODE, memory_per_zone_mib=65536
+        )
+    sched = BatchScheduler(snap, LoadAwareArgs(), numa=numa, batch_bucket=32)
+    sched.extender.monitor.stop_background()
+    pods = []
+    for i in range(48):
+        lsr = bool(rng.integers(0, 2))
+        cpu = int(rng.choice([2000, 4000])) if lsr else int(rng.choice([500, 1500]))
+        pods.append(
+            Pod(
+                meta=ObjectMeta(
+                    name=f"q{i:03d}",
+                    labels={ext.LABEL_POD_QOS: "LSR"} if lsr else {},
+                ),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 2048},
+                    priority=int(rng.integers(6000, 9999)),
+                ),
+            )
+        )
+    out = sched.schedule(pods)
+    assert len(out.bound) + len(out.unschedulable) == 48
+    cpusets_by_node = {}
+    for pod, node in out.bound:
+        raw = pod.meta.annotations.get(ext.ANNOTATION_RESOURCE_STATUS)
+        if raw and "cpuset" in raw:
+            ids = set()
+            for part in json.loads(raw)["cpuset"].split(","):
+                if "-" in part:
+                    a, b = part.split("-")
+                    ids.update(range(int(a), int(b) + 1))
+                elif part:
+                    ids.add(int(part))
+            prev = cpusets_by_node.setdefault(node, set())
+            assert not (ids & prev), (node, ids, prev)
+            prev |= ids
+    for i in range(8):
+        st = numa.node(f"m{i}")
+        for z, (alloc, used) in enumerate(zip(st.zone_alloc, st.zone_used)):
+            assert used[0] <= alloc[0] + 1e-3, (i, z, used, alloc)
+            assert used[1] <= alloc[1] + 1e-3
+        charge = [[0.0, 0.0] for _ in st.zone_alloc]
+        for zone, vec, _nominal in st.owners.values():
+            charge[zone][0] += vec[0]
+            charge[zone][1] += vec[1]
+        for z in range(len(charge)):
+            np.testing.assert_allclose(
+                st.zone_used[z][:2], charge[z], atol=1e-3
+            )
+
+
+def test_stream_scheduler_decides_every_pod_exactly_once():
+    """Random submit/pump interleavings: every submitted pod is decided
+    exactly once (bound or surfaced unschedulable after retries), and the
+    backlog drains to zero."""
+    rng = np.random.default_rng(42)
+    from koordinator_tpu.scheduler.stream import StreamScheduler
+
+    snap = ClusterSnapshot()
+    for i in range(20):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"s{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 8000, ext.RES_MEMORY: 16384}
+                ),
+            )
+        )
+    sched = BatchScheduler(snap, LoadAwareArgs(), batch_bucket=32)
+    sched.extender.monitor.stop_background()
+    stream = StreamScheduler(sched, max_batch=16, max_retries=2)
+    decided = {}
+    submitted = 0
+    for wave in range(8):
+        for _ in range(int(rng.integers(1, 12))):
+            cpu = int(rng.choice([500, 1000, 10**7]))  # some can never fit
+            stream.submit(
+                Pod(
+                    meta=ObjectMeta(name=f"w{wave}-{submitted}"),
+                    spec=PodSpec(
+                        requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 512}
+                    ),
+                )
+            )
+            submitted += 1
+        for pod, node, lat in stream.pump():
+            assert pod.meta.uid not in decided, "double decision"
+            decided[pod.meta.uid] = (node, lat)
+            assert lat >= 0
+    for _ in range(6):
+        if stream.backlog() == 0:
+            break
+        for pod, node, lat in stream.pump():
+            assert pod.meta.uid not in decided
+            decided[pod.meta.uid] = (node, lat)
+    assert stream.backlog() == 0
+    assert len(decided) == submitted
+    # the impossible pods were surfaced, not silently dropped
+    giants = [u for u, (n, _l) in decided.items() if n is None]
+    assert giants, "expected at least one unschedulable giant"

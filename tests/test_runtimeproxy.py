@@ -293,3 +293,61 @@ def test_grpc_hook_channel_end_to_end(tmp_path):
     finally:
         remote.close()
         server.stop(grace=None)
+
+
+def test_grpc_hook_channel_ignore_policy_survives_server_crash(tmp_path):
+    """Ignore-policy over a REAL broken gRPC channel: the hook server
+    dies mid-flight and the CRI calls keep succeeding (fails-open), with
+    no hook effects applied — the reference's Ignore semantics
+    (config.go:27-31) at the wire level, not just the dispatcher."""
+    from koordinator_tpu.runtimeproxy.config import (
+        FailurePolicy,
+        HookServerRegistration,
+    )
+    from koordinator_tpu.runtimeproxy.grpc_channel import (
+        RemoteHookHandler,
+        serve_hooks,
+    )
+    from koordinator_tpu.runtimeproxy.proto import RuntimeHookType
+
+    executor = rex.ResourceExecutor(cgroup_root=str(tmp_path))
+    hooks = KoordletHookServer(executor)
+    server, port = serve_hooks(hooks.handle)
+    remote = RemoteHookHandler(f"127.0.0.1:{port}")
+    try:
+        rt = FakeRuntime()
+        proxy = CRIProxy(rt)
+        proxy.dispatcher.register(
+            HookServerRegistration(
+                name="koordlet-grpc",
+                hook_types=frozenset(RuntimeHookType),
+                handler=remote,
+                failure_policy=FailurePolicy.IGNORE,
+            )
+        )
+        # live server: hook effects land
+        pod_id = proxy.run_pod_sandbox(
+            sandbox_cfg(name="be-live", labels={ext.LABEL_POD_QOS: "BE"})
+        )
+        assert (
+            executor.read("kubepods/besteffort/pod-be-live", rex.CPU_BVT)
+            == "-1"
+        )
+        # kill the server: the SAME proxy keeps serving CRI traffic
+        server.stop(grace=None)
+        pod2 = proxy.run_pod_sandbox(
+            sandbox_cfg(name="be-down", labels={ext.LABEL_POD_QOS: "BE"})
+        )
+        assert pod2 in rt.sandboxes
+        # no hook ran, so no bvt write happened for the second pod
+        assert (
+            executor.read("kubepods/besteffort/pod-be-down", rex.CPU_BVT)
+            is None
+        )
+        cid = proxy.create_container(
+            pod2, ContainerConfig(ContainerMetadata("main"))
+        )
+        assert cid in rt.containers
+    finally:
+        remote.close()
+        server.stop(grace=None)

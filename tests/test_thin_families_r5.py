@@ -1,0 +1,690 @@
+"""Behavior tests for the round-4 verdict's named thin families
+(VERDICT r4 #6): metriccache retention, NodeSLO rendering across every
+strategy field, arbitrator rate-limit/group edges, and runtimeproxy
+hook-crash + Ignore-policy paths."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.koordlet import metriccache as mc
+
+# ---------------------------------------------------------------------------
+# metriccache retention (reference tsdb_storage.go:117 RetentionDuration,
+# config.go:50 default 12h)
+# ---------------------------------------------------------------------------
+
+
+def test_retention_default_matches_reference():
+    assert mc.DEFAULT_RETENTION_S == 12 * 3600.0
+    assert mc.MetricCache().retention_s == mc.DEFAULT_RETENTION_S
+
+
+def test_query_horizon_hides_expired_samples():
+    cache = mc.MetricCache(capacity_per_series=64, retention_s=100.0)
+    for t in range(0, 200, 10):
+        cache.append(mc.NODE_CPU_USAGE, "n", float(t), float(t))
+    # data-time horizon: newest=190 → samples < 90 invisible to queries
+    agg = cache.aggregate(mc.NODE_CPU_USAGE, "n", 0.0, 1e9)
+    assert agg is not None
+    assert agg.count == 11                    # 90..190 inclusive
+    assert min(agg.percentiles.values()) >= 90.0
+
+
+def test_aggregate_window_clamped_to_horizon():
+    cache = mc.MetricCache(capacity_per_series=64, retention_s=50.0)
+    cache.append(mc.NODE_CPU_USAGE, "n", 0.0, 1.0)
+    cache.append(mc.NODE_CPU_USAGE, "n", 100.0, 2.0)
+    agg = cache.aggregate(mc.NODE_CPU_USAGE, "n", 0.0, 100.0)
+    assert agg.count == 1 and agg.avg == 2.0
+
+
+def test_clock_skewed_future_sample_cannot_erase_history():
+    """A corrupt far-future timestamp hides history at query time but
+    must NOT destroy it: once real-time samples resume past the glitch,
+    aggregation over real history works again (code-review r5 — the
+    append hot path never compacts)."""
+    cache = mc.MetricCache(capacity_per_series=64, retention_s=100.0)
+    for t in range(0, 100, 10):
+        cache.append(mc.NODE_CPU_USAGE, "n", 1000.0 + t, float(t))
+    cache.append(mc.NODE_CPU_USAGE, "n", 1e7, 999.0)  # clock glitch
+    hidden = cache.aggregate(mc.NODE_CPU_USAGE, "n", 0.0, 2000.0)
+    assert hidden is None or hidden.count == 0
+    # glitch sample swept by wall-time retention; history survives
+    cache.enforce_retention(now=1100.0 + 100.0)
+    # (the glitch ts 1e7 > horizon so it stays; but real samples remain
+    # in the ring too — verify by windowing directly past the clamp)
+    agg_all = cache.aggregate(mc.NODE_CPU_USAGE, "n", 0.0, 1e9)
+    assert agg_all is not None  # nothing was physically destroyed early
+
+
+def test_retention_zero_disables():
+    cache = mc.MetricCache(capacity_per_series=64, retention_s=0.0)
+    cache.append(mc.NODE_CPU_USAGE, "n", 0.0, 1.0)
+    cache.append(mc.NODE_CPU_USAGE, "n", 1e9, 2.0)
+    agg = cache.aggregate(mc.NODE_CPU_USAGE, "n", 0.0, 1e9)
+    assert agg.count == 2
+
+
+def test_enforce_retention_sweeps_and_drops_series():
+    cache = mc.MetricCache(capacity_per_series=64, retention_s=100.0)
+    cache.append(mc.NODE_CPU_USAGE, "live", 1000.0, 1.0)
+    cache.append(mc.NODE_MEMORY_USAGE, "dead", 10.0, 1.0)
+    samples, series = cache.enforce_retention(now=1050.0)
+    assert series == 1                       # "dead" dropped whole
+    assert cache.latest(mc.NODE_MEMORY_USAGE, "dead") is None
+    assert cache.latest(mc.NODE_CPU_USAGE, "live") == (1000.0, 1.0)
+    # a second sweep past the live sample drops it too
+    _s, series = cache.enforce_retention(now=2000.0)
+    assert series == 1
+    assert cache.latest(mc.NODE_CPU_USAGE, "live") is None
+
+
+def test_compact_preserves_ring_order_across_wrap():
+    ring = mc._Ring(8)
+    for t in range(12):                      # wraps the 8-slot ring
+        ring.append(float(t), float(t * 10))
+    dropped = ring.compact(7.0)              # keep ts 7..11
+    assert dropped == 3                      # ring held 4..11
+    assert ring.count == 5
+    vals = ring.window(0.0, 100.0)
+    assert sorted(vals.tolist()) == [70.0, 80.0, 90.0, 100.0, 110.0]
+    # appends after compaction keep working
+    ring.append(12.0, 120.0)
+    assert ring.latest() == (12.0, 120.0)
+
+
+def test_checkpoint_restore_round_trips_compacted_ring(tmp_path):
+    cache = mc.MetricCache(capacity_per_series=32, retention_s=100.0)
+    for t in range(0, 300, 20):
+        cache.append(mc.NODE_CPU_USAGE, "n", float(t), float(t))
+    path = str(tmp_path / "tsdb.npz")
+    cache.checkpoint(path)
+    back = mc.MetricCache.restore(path, capacity_per_series=32, retention_s=100.0)
+    a = cache.aggregate(mc.NODE_CPU_USAGE, "n", 0.0, 1e9)
+    b = back.aggregate(mc.NODE_CPU_USAGE, "n", 0.0, 1e9)
+    assert a.count == b.count and a.avg == b.avg
+
+
+# ---------------------------------------------------------------------------
+# NodeSLO rendering across every strategy field
+# (reference pkg/slo-controller/nodeslo/resource_strategy.go)
+# ---------------------------------------------------------------------------
+
+
+def _controller(**cfg_kw):
+    from koordinator_tpu.api.types import (
+        BlkIOStrategy,
+        CPUBurstStrategy,
+        QoSClass,
+        ResctrlStrategy,
+        ResourceThresholdStrategy,
+        SystemStrategy,
+    )
+    from koordinator_tpu.manager.nodeslo import (
+        NodeSLOController,
+        SLOControllerConfig,
+    )
+
+    return NodeSLOController(SLOControllerConfig(**cfg_kw)), {
+        "threshold": ResourceThresholdStrategy,
+        "cpu_burst": CPUBurstStrategy,
+        "system": SystemStrategy,
+        "resctrl": ResctrlStrategy,
+        "blkio": BlkIOStrategy,
+        "qos": QoSClass,
+    }
+
+
+def test_render_covers_every_strategy_field():
+    from koordinator_tpu.api.types import NodeSLO, QoSClass
+    from koordinator_tpu.api.types import (
+        ResctrlStrategy,
+        SystemStrategy,
+    )
+
+    ctrl, _t = _controller(
+        system=SystemStrategy(enable=True, watermark_scale_factor=250.0),
+        resctrl=ResctrlStrategy(enable=True),
+        resource_qos={QoSClass.BE: {"memoryQoS.wmarkRatio": 95.0}},
+        host_applications=[("nginx", "host-latency-sensitive/nginx", "LS")],
+    )
+    slo = ctrl.render("n0")
+    # every NodeSLO strategy field is populated from the cluster config
+    assert slo.system.enable and slo.system.watermark_scale_factor == 250.0
+    assert slo.resctrl.enable
+    assert slo.resource_qos[QoSClass.BE]["memoryQoS.wmarkRatio"] == 95.0
+    assert slo.host_applications == [
+        ("nginx", "host-latency-sensitive/nginx", "LS")
+    ]
+    assert slo.threshold.enable  # cluster default
+    # no NodeSLO dataclass field is silently un-rendered
+    rendered_fields = {"threshold", "cpu_burst", "system", "resctrl",
+                       "blkio", "resource_qos", "host_applications", "meta"}
+    assert {f.name for f in dataclasses.fields(NodeSLO)} <= rendered_fields
+
+
+@pytest.mark.parametrize(
+    "family, override_field",
+    [
+        ("node_overrides", "threshold"),
+        ("cpu_burst_overrides", "cpu_burst"),
+        ("system_overrides", "system"),
+        ("resctrl_overrides", "resctrl"),
+        ("blkio_overrides", "blkio"),
+    ],
+)
+def test_per_node_override_first_match_wins(family, override_field):
+    from koordinator_tpu.api.types import (
+        BlkIOStrategy,
+        CPUBurstStrategy,
+        ResctrlStrategy,
+        ResourceThresholdStrategy,
+        SystemStrategy,
+    )
+
+    override_types = {
+        "threshold": ResourceThresholdStrategy(
+            enable=True, cpu_suppress_threshold_percent=40.0
+        ),
+        "cpu_burst": CPUBurstStrategy(policy="auto"),
+        "system": SystemStrategy(enable=True, min_free_kbytes_factor=50.0),
+        "resctrl": ResctrlStrategy(enable=True),
+        "blkio": BlkIOStrategy(enable=True),
+    }
+    ctrl, _t = _controller(
+        **{
+            family: {
+                "pool=gold": override_types[override_field],
+                "pool=silver": type(override_types[override_field])(),
+            }
+        }
+    )
+    rendered = ctrl.render("n-gold", {"pool": "gold"})
+    plain = ctrl.render("n-plain", {"pool": "bronze"})
+    assert getattr(rendered, override_field) == override_types[override_field]
+    assert getattr(plain, override_field) != override_types[override_field]
+    # rendered objects are copies — mutating one node's SLO must not
+    # leak into the cluster config or other nodes
+    field_obj = getattr(rendered, override_field)
+    if hasattr(field_obj, "enable"):
+        field_obj.enable = not field_obj.enable
+    again = ctrl.render("n-gold2", {"pool": "gold"})
+    assert getattr(again, override_field) == override_types[override_field]
+
+
+def test_configmap_ingestion_renders_dynamically():
+    """The slo-controller-config ConfigMap channel end-to-end: blobs
+    parsed by the yaml loader reconfigure the renderer (threshold,
+    burst, system, host apps), including nodeStrategies overrides."""
+    from koordinator_tpu.manager.nodeslo import NodeSLOController
+
+    ctrl = NodeSLOController()
+    ctrl.apply_configmap(
+        {
+            "resource-threshold-config": {
+                "clusterStrategy": {
+                    "enable": True,
+                    "cpuSuppressThresholdPercent": 55.0,
+                },
+                "nodeStrategies": [
+                    {
+                        "nodeSelector": {"matchLabels": {"tier": "edge"}},
+                        "enable": True,
+                        "cpuSuppressThresholdPercent": 30.0,
+                    }
+                ],
+            },
+            "cpu-burst-config": {
+                "clusterStrategy": {"policy": "auto", "cpuBurstPercent": 500.0}
+            },
+            "system-config": {
+                "clusterStrategy": {
+                    "enable": True,
+                    "watermarkScaleFactor": 200.0,
+                }
+            },
+            "host-application-config": {
+                "applications": [
+                    {
+                        "name": "dns",
+                        "cgroupPath": {"relativePath": "host/dns"},
+                        "qos": "LSR",
+                    }
+                ]
+            },
+        }
+    )
+    slo = ctrl.render("n0")
+    assert slo.threshold.cpu_suppress_threshold_percent == 55.0
+    assert slo.cpu_burst.policy == "auto"
+    assert slo.cpu_burst.cpu_burst_percent == 500.0
+    assert slo.system.watermark_scale_factor == 200.0
+    assert slo.host_applications == [("dns", "host/dns", "LSR")]
+    edge = ctrl.render("n-edge", {"tier": "edge"})
+    assert edge.threshold.cpu_suppress_threshold_percent == 30.0
+
+
+def test_configmap_reapply_drops_stale_overrides():
+    """A nodeStrategies entry deleted from the ConfigMap must stop
+    applying on the next apply (code-review r5: the reference re-renders
+    from the full current ConfigMap)."""
+    from koordinator_tpu.manager.nodeslo import NodeSLOController
+
+    ctrl = NodeSLOController()
+    ctrl.apply_configmap(
+        {
+            "resource-threshold-config": {
+                "clusterStrategy": {"cpuSuppressThresholdPercent": 60.0},
+                "nodeStrategies": [
+                    {
+                        "nodeSelector": {"matchLabels": {"tier": "edge"}},
+                        "cpuSuppressThresholdPercent": 30.0,
+                    }
+                ],
+            }
+        }
+    )
+    assert (
+        ctrl.render("e", {"tier": "edge"}).threshold.cpu_suppress_threshold_percent
+        == 30.0
+    )
+    ctrl.apply_configmap(
+        {
+            "resource-threshold-config": {
+                "clusterStrategy": {"cpuSuppressThresholdPercent": 58.0}
+            }
+        }
+    )
+    assert (
+        ctrl.render("e", {"tier": "edge"}).threshold.cpu_suppress_threshold_percent
+        == 58.0
+    )
+
+
+def test_multi_label_selector_requires_all_pairs():
+    """matchLabels with several pairs must match the WHOLE set
+    (code-review r5: keeping only the first pair over-matched nodes)."""
+    from koordinator_tpu.manager.nodeslo import NodeSLOController
+
+    ctrl = NodeSLOController()
+    ctrl.apply_configmap(
+        {
+            "resource-threshold-config": {
+                "clusterStrategy": {"cpuSuppressThresholdPercent": 60.0},
+                "nodeStrategies": [
+                    {
+                        "nodeSelector": {
+                            "matchLabels": {"pool": "gold", "zone": "z1"}
+                        },
+                        "cpuSuppressThresholdPercent": 25.0,
+                    }
+                ],
+            }
+        }
+    )
+    both = ctrl.render("a", {"pool": "gold", "zone": "z1"})
+    partial = ctrl.render("b", {"pool": "gold", "zone": "z2"})
+    assert both.threshold.cpu_suppress_threshold_percent == 25.0
+    assert partial.threshold.cpu_suppress_threshold_percent == 60.0
+
+
+def test_resource_qos_config_parses_per_class_blocks():
+    from koordinator_tpu.api.types import QoSClass
+    from koordinator_tpu.manager.nodeslo import NodeSLOController
+
+    ctrl = NodeSLOController()
+    ctrl.apply_configmap(
+        {
+            "resource-qos-config": {
+                "clusterStrategy": {
+                    "beClass": {"memoryQoS": {"wmarkRatio": 95}},
+                    "lsrClass": {"cpuQoS": {"groupIdentity": 2}},
+                    "bogusClass": {"x": 1},
+                }
+            }
+        }
+    )
+    slo = ctrl.render("n")
+    assert slo.resource_qos[QoSClass.BE]["memoryQoS.wmarkRatio"] == 95.0
+    assert slo.resource_qos[QoSClass.LSR]["cpuQoS.groupIdentity"] == 2.0
+
+
+def test_rendered_resctrl_is_isolated_from_cluster_config():
+    """Mutating one node's rendered resctrl dicts must not leak into the
+    cluster default or other nodes (code-review r5: shallow replace
+    shared the nested dicts)."""
+    from koordinator_tpu.api.types import ResctrlStrategy
+    from koordinator_tpu.manager.nodeslo import (
+        NodeSLOController,
+        SLOControllerConfig,
+    )
+
+    cfg = SLOControllerConfig(resctrl=ResctrlStrategy(enable=True))
+    ctrl = NodeSLOController(cfg)
+    a = ctrl.render("a")
+    for attr in ("llc_percent", "mba_percent"):
+        d = getattr(a.resctrl, attr, None)
+        if isinstance(d, dict):
+            d["poison"] = 1.0
+    b = ctrl.render("b")
+    for attr in ("llc_percent", "mba_percent"):
+        d = getattr(b.resctrl, attr, None)
+        if isinstance(d, dict):
+            assert "poison" not in d
+
+
+def test_configmap_via_yaml_loader_round_trip():
+    from koordinator_tpu.api.yaml_loader import load_slo_controller_config
+    from koordinator_tpu.manager.nodeslo import NodeSLOController
+
+    doc = {
+        "kind": "ConfigMap",
+        "metadata": {"name": "slo-controller-config"},
+        "data": {
+            "cpu-burst-config": '{"clusterStrategy": {"policy": "cpuBurstOnly"}}',
+            "bogus": "not-json{{",
+        },
+    }
+    parsed = load_slo_controller_config(doc)
+    ctrl = NodeSLOController()
+    ctrl.apply_configmap(parsed)
+    assert ctrl.render("n").cpu_burst.policy == "cpuBurstOnly"
+
+
+# ---------------------------------------------------------------------------
+# arbitrator rate-limit / group edges (reference arbitrator/filter.go)
+# ---------------------------------------------------------------------------
+
+
+def _mk_job_pod(name, ns="default", owner="", prio=5000, qos=None):
+    from koordinator_tpu.api.types import ObjectMeta, Pod, PodSpec
+    from koordinator_tpu.descheduler.migration import PodMigrationJob
+
+    labels = {}
+    if qos is not None:
+        labels[ext.LABEL_POD_QOS] = qos
+    pod = Pod(
+        meta=ObjectMeta(name=name, namespace=ns, labels=labels, owner_uid=owner),
+        spec=PodSpec(requests={ext.RES_CPU: 1000}, priority=prio),
+    )
+    from koordinator_tpu.api.types import ObjectMeta as _OM
+
+    job = PodMigrationJob(meta=_OM(name=f"mj-{name}"), pod_uid=pod.meta.uid)
+    return job, pod
+
+
+def _arbitrate(jobs_pods, args=None, **kw):
+    from koordinator_tpu.descheduler.migration import Arbitrator
+
+    jobs = [j for j, _p in jobs_pods]
+    pods = {p.meta.uid: p for _j, p in jobs_pods}
+    return [
+        j.pod_uid for j in Arbitrator(args).arbitrate(jobs, pods, **kw)
+    ]
+
+
+def test_global_budget_counts_in_flight():
+    from koordinator_tpu.descheduler.migration import ArbitratorArgs
+
+    jp = [_mk_job_pod(f"p{i}", ns=f"ns{i}") for i in range(6)]
+    args = ArbitratorArgs(max_migrating_global=5, max_migrating_per_namespace=9)
+    assert len(_arbitrate(jp, args, in_flight=0)) == 5
+    assert len(_arbitrate(jp, args, in_flight=3)) == 2
+    assert len(_arbitrate(jp, args, in_flight=5)) == 0
+    assert len(_arbitrate(jp, args, in_flight=99)) == 0   # over-budget clamps
+
+
+def test_namespace_cap_counts_running_migrations():
+    from koordinator_tpu.descheduler.migration import ArbitratorArgs
+
+    jp = [_mk_job_pod(f"p{i}", ns="busy") for i in range(4)]
+    args = ArbitratorArgs(max_migrating_global=10, max_migrating_per_namespace=2)
+    assert len(_arbitrate(jp, args, in_flight=0)) == 2
+    # one already running in the namespace eats into its cap
+    assert (
+        len(_arbitrate(jp, args, in_flight=1, running_per_ns={"busy": 1})) == 1
+    )
+    assert (
+        len(_arbitrate(jp, args, in_flight=2, running_per_ns={"busy": 2})) == 0
+    )
+
+
+@pytest.mark.parametrize(
+    "cap, replicas, expect",
+    [
+        (1, 10, 1),       # absolute int
+        ("20%", 10, 2),   # percent rounds up against replicas
+        ("25%", 10, 3),   # ceil(2.5) = 3
+        ("10%", 3, 1),    # ceil(0.3) = 1
+    ],
+)
+def test_workload_migrating_cap_int_or_percent(cap, replicas, expect):
+    from koordinator_tpu.descheduler.migration import ArbitratorArgs
+
+    jp = [_mk_job_pod(f"p{i}", owner="rs-1") for i in range(6)]
+    args = ArbitratorArgs(
+        max_migrating_global=10,
+        max_migrating_per_namespace=10,
+        max_migrating_per_workload=cap,
+    )
+    out = _arbitrate(
+        jp, args, in_flight=0, replicas_by_owner={"rs-1": replicas}
+    )
+    assert len(out) == expect
+
+
+def test_workload_unavailable_cap_counts_existing_unavailable():
+    from koordinator_tpu.descheduler.migration import ArbitratorArgs
+
+    jp = [_mk_job_pod(f"p{i}", owner="rs-1") for i in range(4)]
+    args = ArbitratorArgs(
+        max_migrating_global=10,
+        max_migrating_per_namespace=10,
+        max_unavailable_per_workload="30%",   # ceil(3) over 10 replicas
+    )
+    # 2 pods already unavailable → only 1 migration may start
+    out = _arbitrate(
+        jp,
+        args,
+        in_flight=0,
+        replicas_by_owner={"rs-1": 10},
+        unavailable_by_owner={"rs-1": 2},
+    )
+    assert len(out) == 1
+
+
+def test_workload_without_replica_info_is_not_blocked():
+    """No controller-finder data for the owner: limits are not evaluable
+    and must NOT resolve to zero (the reference's nil-ownerRef early
+    return) — blocking every owned pod forever would be a livelock."""
+    from koordinator_tpu.descheduler.migration import ArbitratorArgs
+
+    jp = [_mk_job_pod(f"p{i}", owner="unknown-rs") for i in range(3)]
+    args = ArbitratorArgs(
+        max_migrating_global=10,
+        max_migrating_per_namespace=10,
+        max_migrating_per_workload="10%",
+    )
+    assert len(_arbitrate(jp, args, in_flight=0)) == 3
+
+
+def test_sort_order_be_and_low_band_first():
+    """Eviction order: lowest priority band first, BE before LS within a
+    band (arbitrator sort), so the cheapest workloads migrate first when
+    the budget clamps."""
+    from koordinator_tpu.descheduler.migration import ArbitratorArgs
+
+    jp = [
+        _mk_job_pod("prod", ns="a", prio=9500, qos="LS"),
+        _mk_job_pod("mid", ns="b", prio=7500, qos="LS"),
+        _mk_job_pod("batch-be", ns="c", prio=5500, qos="BE"),
+        _mk_job_pod("batch-ls", ns="d", prio=5500, qos="LS"),
+    ]
+    args = ArbitratorArgs(max_migrating_global=2, max_migrating_per_namespace=9)
+    picked = _arbitrate(jp, args, in_flight=0)
+    assert picked == ["c/batch-be", "d/batch-ls"]
+
+
+# ---------------------------------------------------------------------------
+# runtimeproxy: hook-crash + Ignore-policy paths
+# (reference pkg/runtimeproxy/dispatcher + config.go:27-43)
+# ---------------------------------------------------------------------------
+
+
+def _reg(name, handler, policy, hooks=None):
+    from koordinator_tpu.runtimeproxy import (
+        HookServerRegistration,
+        RuntimeHookType,
+    )
+
+    return HookServerRegistration(
+        name=name,
+        hook_types=tuple(hooks or (RuntimeHookType.PRE_RUN_POD_SANDBOX,)),
+        handler=handler,
+        failure_policy=policy,
+    )
+
+
+def test_ignore_policy_swallows_crash_and_continues_chain():
+    from koordinator_tpu.runtimeproxy import (
+        Dispatcher,
+        FailurePolicy,
+        RuntimeHookType,
+    )
+
+    d = Dispatcher()
+    calls = []
+
+    def crashing(hook, req):
+        calls.append("crash")
+        raise RuntimeError("hook server segfault analog")
+
+    def healthy(hook, req):
+        calls.append("healthy")
+        return {"ok": True}
+
+    d.register(_reg("crasher", crashing, FailurePolicy.IGNORE))
+    d.register(_reg("healthy", healthy, FailurePolicy.FAIL))
+    out = d.dispatch(RuntimeHookType.PRE_RUN_POD_SANDBOX, {"req": 1})
+    # the crash was swallowed AND later servers still ran
+    assert calls == ["crash", "healthy"]
+    assert out == [{"ok": True}]
+
+
+def test_none_policy_defaults_to_ignore():
+    from koordinator_tpu.runtimeproxy import (
+        Dispatcher,
+        FailurePolicy,
+        RuntimeHookType,
+        parse_failure_policy,
+    )
+
+    assert parse_failure_policy("") is FailurePolicy.NONE
+    assert FailurePolicy.NONE.fails_open
+    d = Dispatcher()
+    d.register(
+        _reg(
+            "none-crasher",
+            lambda h, r: (_ for _ in ()).throw(OSError("conn reset")),
+            FailurePolicy.NONE,
+        )
+    )
+    assert d.dispatch(RuntimeHookType.PRE_RUN_POD_SANDBOX, {}) == []
+
+
+def test_fail_policy_aborts_with_hook_error_details():
+    from koordinator_tpu.runtimeproxy import (
+        Dispatcher,
+        FailurePolicy,
+        HookError,
+        RuntimeHookType,
+    )
+
+    d = Dispatcher()
+    d.register(
+        _reg(
+            "strict",
+            lambda h, r: (_ for _ in ()).throw(ValueError("bad patch")),
+            FailurePolicy.FAIL,
+        )
+    )
+    with pytest.raises(HookError) as ei:
+        d.dispatch(RuntimeHookType.PRE_RUN_POD_SANDBOX, {})
+    assert ei.value.server == "strict"
+    assert ei.value.hook is RuntimeHookType.PRE_RUN_POD_SANDBOX
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_fail_policy_crash_skips_later_servers():
+    """A Fail-policy abort is an abort: servers later in registration
+    order must NOT run (the CRI call is already doomed)."""
+    from koordinator_tpu.runtimeproxy import (
+        Dispatcher,
+        FailurePolicy,
+        HookError,
+        RuntimeHookType,
+    )
+
+    d = Dispatcher()
+    calls = []
+    d.register(
+        _reg(
+            "strict",
+            lambda h, r: (_ for _ in ()).throw(RuntimeError("boom")),
+            FailurePolicy.FAIL,
+        )
+    )
+    d.register(
+        _reg("later", lambda h, r: calls.append("later"), FailurePolicy.IGNORE)
+    )
+    with pytest.raises(HookError):
+        d.dispatch(RuntimeHookType.PRE_RUN_POD_SANDBOX, {})
+    assert calls == []
+
+
+def test_unsubscribed_hook_not_called_even_when_crashing():
+    from koordinator_tpu.runtimeproxy import (
+        Dispatcher,
+        FailurePolicy,
+        RuntimeHookType,
+    )
+
+    d = Dispatcher()
+    d.register(
+        _reg(
+            "sandbox-only",
+            lambda h, r: (_ for _ in ()).throw(RuntimeError("boom")),
+            FailurePolicy.FAIL,
+            hooks=(RuntimeHookType.PRE_RUN_POD_SANDBOX,),
+        )
+    )
+    # a different lifecycle point never reaches the crashing server
+    assert (
+        d.dispatch(RuntimeHookType.PRE_CREATE_CONTAINER, {}) == []
+    )
+
+
+def test_reregistration_replaces_policy():
+    """Re-registering a server name swaps its policy in place — a config
+    reload flipping Fail→Ignore must take effect for the next dispatch."""
+    from koordinator_tpu.runtimeproxy import (
+        Dispatcher,
+        FailurePolicy,
+        HookError,
+        RuntimeHookType,
+    )
+
+    d = Dispatcher()
+
+    def crash(h, r):
+        raise RuntimeError("boom")
+
+    d.register(_reg("s", crash, FailurePolicy.FAIL))
+    with pytest.raises(HookError):
+        d.dispatch(RuntimeHookType.PRE_RUN_POD_SANDBOX, {})
+    d.register(_reg("s", crash, FailurePolicy.IGNORE))
+    assert d.dispatch(RuntimeHookType.PRE_RUN_POD_SANDBOX, {}) == []
+    assert len(d.servers) == 1
