@@ -708,8 +708,11 @@ class ClusterSnapshot:
         pods: Sequence[Pod],
         min_member_by_gang: Optional[Mapping[str, int]] = None,
         nonstrict_by_gang: Optional[Mapping[str, bool]] = None,
+        bucket: Optional[int] = None,
     ) -> PodArrays:
-        """Lower pending pods to dense arrays.
+        """Lower pending pods to dense arrays. ``bucket`` overrides the
+        padded row count (the scanned multi-chunk dispatch needs every
+        chunk on ONE shape); it must be ≥ the natural bucket.
 
         Gang minMember resolution order (reference: PodGroup CRD or the
         ``pod-group.scheduling.sigs.k8s.io/min-available`` annotation,
@@ -720,6 +723,13 @@ class ClusterSnapshot:
         gang.go:128-132 parses once at gang creation).
         """
         p_bucket = bucket_size(len(pods), self.config.min_bucket)
+        if bucket is not None:
+            if bucket < len(pods):
+                raise ValueError(
+                    f"bucket override {bucket} smaller than pod count "
+                    f"{len(pods)}"
+                )
+            p_bucket = max(p_bucket, bucket)
         out = PodArrays.empty(p_bucket, self.config.dims)
         gang_ids: Dict[str, int] = {}
         gang_members: Dict[int, int] = {}

@@ -692,6 +692,13 @@ def assign(
                 fpga_req=spods.fpga,
                 fpga_free=fpga_free if fpga_tracked else None,
             )
+            # an untracked resource axis (no node carries it) must still
+            # reject pods REQUESTING it — tracing the carry out is a
+            # compute optimization, not a feasibility change
+            if not rdma_tracked:
+                feas &= (spods.rdma == 0)[:, None]
+            if not fpga_tracked:
+                feas &= (spods.fpga == 0)[:, None]
         cost = cost_ops.load_aware_cost(
             spods.estimate,
             est_used,
@@ -1134,6 +1141,109 @@ def solve_stream(
     )
     final_quotas = QuotaState(runtime=quotas.runtime, used=final_qused)
     return assignments, final_nodes, placed, final_quotas
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_rounds",
+        "topk",
+        "nomination_jitter",
+        "approx_topk",
+        "numa_scoring",
+        "device_scoring",
+    ),
+)
+def solve_stream_full(
+    pods_stacked: PodBatch,
+    nodes: NodeState,
+    params: SolverParams,
+    quotas: QuotaState | None = None,
+    numa: "NumaState | None" = None,
+    devices: "DeviceState | None" = None,
+    max_rounds: int = 24,
+    round_quantum: float = 0.35,
+    topk: int = 4,
+    nomination_jitter: float = 4.0,
+    approx_topk: bool = False,
+    numa_scoring: "str | None" = None,
+    device_scoring: "str | None" = None,
+):
+    """Pipelined multi-chunk solve with the FULL constraint set: a
+    ``lax.scan`` over a [C, P, ...] stacked :class:`PodBatch` threading
+    node capacity, the quota table, the exact GPU slot table and the
+    exact NUMA zone table between chunks — ONE jitted program and one
+    device→host transfer per drain. On tunneled backends every program
+    launch and every fetch costs a fixed round trip, so the per-chunk
+    dispatch pipeline pays C× that overhead where this pays it once
+    (the per-chunk path remains for transformers/node-mask cases).
+
+    Returns ``(assignments [C, P], pod_zones [C, P], rounds [C])``.
+    """
+    quota_enabled = quotas is not None
+    if quotas is None:
+        quotas = QuotaState.disabled(pods_stacked.requests.shape[-1])
+    n = nodes.allocatable.shape[0]
+    if devices is not None:
+        rdma0 = (
+            devices.rdma_free
+            if devices.rdma_free is not None
+            else jnp.zeros((n,), jnp.float32)
+        )
+        fpga0 = (
+            devices.fpga_free
+            if devices.fpga_free is not None
+            else jnp.zeros((n,), jnp.float32)
+        )
+        dev_carry0 = (devices.slot_free, rdma0, fpga0)
+    else:
+        dev_carry0 = None
+    numa_carry0 = numa.zone_free if numa is not None else None
+
+    def step(carry, pb):
+        cur, qused, dev_carry, numa_carry = carry
+        res = assign(
+            pb,
+            cur,
+            params,
+            quotas=(
+                QuotaState(runtime=quotas.runtime, used=qused)
+                if quota_enabled
+                else None
+            ),
+            numa=numa,
+            devices=devices,
+            max_rounds=max_rounds,
+            round_quantum=round_quantum,
+            topk=topk,
+            nomination_jitter=nomination_jitter,
+            approx_topk=approx_topk,
+            dev_carry=dev_carry,
+            numa_carry=numa_carry,
+            numa_scoring=numa_scoring,
+            device_scoring=device_scoring,
+        )
+        nxt = cur.replace(
+            requested=res.node_requested,
+            estimated_used=res.node_estimated_used,
+            prod_used=res.node_prod_used,
+        )
+        new_dev = (
+            (res.node_dev_slots, res.node_rdma_free, res.node_fpga_free)
+            if devices is not None
+            else dev_carry
+        )
+        new_numa = res.node_zone_free if numa is not None else numa_carry
+        return (nxt, res.quota_used, new_dev, new_numa), (
+            res.assignment,
+            res.pod_zone,
+            res.rounds_used,
+        )
+
+    _final, (assignments, zones, rounds) = jax.lax.scan(
+        step, (nodes, quotas.used, dev_carry0, numa_carry0), pods_stacked
+    )
+    return assignments, zones, rounds
 
 
 @jax.jit

@@ -12,6 +12,7 @@ revalidation — the solver proposes, Reserve disposes (SURVEY §7 hard part
 from __future__ import annotations
 
 import dataclasses
+import queue as _queue
 import threading as _threading
 import time as _time
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
@@ -183,6 +184,16 @@ class LoweredRows:
     quota_chain: Optional[np.ndarray] = None
     #: [P] bool — pod requires single-NUMA placement (numa-topology-spec)
     numa_required: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class _HostSolve:
+    """Already-fetched solve outputs from the scanned dispatch — the
+    commit loop consumes these without further device round trips."""
+
+    assignment: np.ndarray
+    pod_zone: Optional[np.ndarray]
+    rounds_used: int
 
 
 @dataclasses.dataclass
@@ -405,6 +416,7 @@ class BatchScheduler:
             list(pods),
             min_member_by_gang=self.pod_groups.min_member_map(),
             nonstrict_by_gang=self.pod_groups.nonstrict_map(),
+            bucket=bucket,
         )
         b = bucket or bucket_size(len(pods), self.snapshot.config.min_bucket)
         if arrays.requests.shape[0] != b:
@@ -665,36 +677,102 @@ class BatchScheduler:
         # rotating window per cycle, shared by every chunk so the
         # on-device capacity chaining stays on a consistent node axis
         sub = self._select_nodes(eligible) if chunks else None
+        solves = None
         if len(chunks) > 1:
-            solves = self._dispatch_pipelined(chunks, sub)
+            solves = self._dispatch_scanned(chunks, sub)
+            if solves is None:
+                solves = self._dispatch_pipelined(chunks, sub)
         else:
             solves = [(chunk, None, self.solve(chunk, sub)) for chunk in chunks]
-        # start all device→host copies before the first blocking fetch:
-        # on tunneled backends every synchronous fetch is a full round
-        # trip (~100 ms regardless of size); prefetching overlaps them
-        # with each other and with still-running chunk solves
         use_zone_hints = self.numa is not None and self.numa.has_topology
-        packed: List[Optional[jnp.ndarray]] = []
-        for _chunk, _rows, result in solves:
+
+        def _pack(result):
             # assignment + device zone picks ride ONE fetch (a second
             # per-chunk device→host read costs a full tunnel round trip)
-            pk = None
             if use_zone_hints and result.pod_zone is not None:
-                pk = jnp.stack([result.assignment, result.pod_zone])
-            packed.append(pk)
+                return jnp.stack([result.assignment, result.pod_zone])
+            return result.assignment
+
+        def _host_arrays():
+            """Per-chunk host copies of the packed results. The scanned
+            dispatch already fetched everything in one transfer; the
+            per-chunk paths group chunks in PAIRS per transfer and
+            prefetch the next group on a worker thread while this thread
+            commits — on tunneled backends every device→host call costs
+            a fixed round trip and async copies are inert, so an unpiped
+            fetch→commit→fetch chain serializes the drain on the wire."""
+            if solves and isinstance(solves[0][2], _HostSolve):
+                for _c, _r, r in solves:
+                    if use_zone_hints and r.pod_zone is not None:
+                        yield np.stack([r.assignment, r.pod_zone])
+                    else:
+                        yield r.assignment
+                return
+            if len(solves) == 1:
+                yield np.asarray(_pack(solves[0][2]))
+                return
+            # group CONSECUTIVE equal-shaped results in pairs (the last
+            # chunk's bucket may be smaller — stacking across shapes
+            # would crash); singles transfer alone
+            packed = [_pack(r) for _c, _r, r in solves]
+            groups: List[Tuple[int, int]] = []  # (start, count)
+            i = 0
+            while i < len(packed):
+                if (
+                    i + 1 < len(packed)
+                    and packed[i].shape == packed[i + 1].shape
+                ):
+                    groups.append((i, 2))
+                    i += 2
+                else:
+                    groups.append((i, 1))
+                    i += 1
+            packed_groups = [
+                jnp.stack(packed[s : s + c]) if c > 1 else packed[s]
+                for s, c in groups
+            ]
+            fq: "_queue.Queue" = _queue.Queue(maxsize=2)
+            cancelled = _threading.Event()
+
+            def worker():
+                for pg in packed_groups:
+                    try:
+                        item = np.asarray(pg)
+                    except Exception as exc:  # noqa: BLE001 — re-raised below
+                        item = exc
+                    while not cancelled.is_set():
+                        try:
+                            fq.put(item, timeout=0.25)
+                            break
+                        except _queue.Full:
+                            continue
+                    if isinstance(item, Exception) or cancelled.is_set():
+                        return
+
+            _threading.Thread(
+                target=worker, name="solve-prefetch", daemon=True
+            ).start()
             try:
-                (pk if pk is not None else result.assignment).copy_to_host_async()
-                result.rounds_used.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
-        for (chunk, rows, result), pk in zip(solves, packed):
+                for s, c in groups:
+                    got = fq.get()
+                    if isinstance(got, Exception):
+                        raise got
+                    if c == 1:
+                        yield got
+                    else:
+                        for j in range(c):
+                            yield got[j]
+            finally:
+                # a consumer abandoning the generator (commit raised)
+                # must release the worker, not strand it on a full queue
+                cancelled.set()
+
+        for (chunk, rows, result), host_arr in zip(solves, _host_arrays()):
             t0 = _time.perf_counter()
-            if pk is not None:
-                both = np.asarray(pk)  # sync point
-                assignment, pod_zone = both[0], both[1]
+            if use_zone_hints and result.pod_zone is not None:
+                assignment, pod_zone = host_arr[0], host_arr[1]
             else:
-                assignment = np.asarray(result.assignment)  # sync point
-                pod_zone = None
+                assignment, pod_zone = host_arr, None
             assignment = self._map_assignment(assignment, sub)
             if fwext.scores.top_n > 0:
                 self._debug_capture(chunk, assignment)
@@ -704,11 +782,20 @@ class BatchScheduler:
             )
             bound.extend(b)
             unsched.extend(u)
-        # rounds_used is diagnostics only — fetch it AFTER the commit loop
-        # so it never adds a per-chunk tunnel round trip between commits
-        # (the async copies above have long since landed by now)
-        for _chunk, _rows, result in solves:
-            rounds += int(result.rounds_used)
+        # rounds_used is diagnostics only — fetched AFTER the commit loop
+        # and in ONE stacked transfer (per-chunk int() fetches each cost
+        # a tunnel round trip); the scanned path already holds host ints
+        if solves and isinstance(solves[0][2], _HostSolve):
+            for _chunk, _rows, result in solves:
+                rounds += result.rounds_used
+        elif len(solves) == 1:
+            rounds += int(solves[0][2].rounds_used)
+        elif solves:
+            rounds += int(
+                np.asarray(
+                    jnp.stack([r.rounds_used for _c, _r, r in solves])
+                ).sum()
+            )
         # PostFilter analog (reference elasticquota/preempt.go): a failed
         # quota-labeled pod may evict lower-priority same-quota pods, then
         # the batch retries once for the preemptors.
@@ -972,6 +1059,88 @@ class BatchScheduler:
             chunks.append(cur)
         return chunks
 
+    def _dispatch_scanned(
+        self, chunks: List[List[Pod]], sub: Optional[np.ndarray] = None
+    ):
+        """One jitted ``lax.scan`` over every chunk (solve_stream_full):
+        a single program launch and 1-2 device→host transfers per drain.
+        On tunneled backends each launch/fetch costs a fixed round trip,
+        which made the per-chunk pipeline's wall scale with chunk count
+        regardless of compute. Returns the same (chunk, rows, result)
+        shape with host-side results, or None when the cycle needs the
+        per-chunk path (mesh mode, batch transformers, or hard node
+        constraints that lower per-chunk [P, N] masks)."""
+        if self.mesh is not None:
+            return None
+        ex = self.extender
+        if ex._batch_transformers or ex.cost_transform is not None:
+            return None
+        for chunk in chunks:
+            if any(
+                p.spec.node_selector
+                or p.spec.affinity_required_nodes
+                or p.spec.node_name
+                for p in chunk
+            ):
+                return None
+        from ..ops.solver import solve_stream_full
+
+        quotas0 = self.quota_state([p for c in chunks for p in c])
+        numa_state, device_state = self._constraint_states(sub)
+        nodes0 = self.node_state(sub)
+        bucket = max(
+            bucket_size(len(c), self.snapshot.config.min_bucket)
+            for c in chunks
+        )
+        pods_list: List[PodBatch] = []
+        rows_list: List[LoweredRows] = []
+        for chunk in chunks:
+            pods_list.append(self.pod_batch(chunk, bucket=bucket))
+            rows_list.append(self._lowered)
+        # bucket the CHUNK axis too (next power of two): a drifting
+        # backlog would otherwise retrace the scanned program for every
+        # distinct chunk count. Padding chunks are all-invalid, so their
+        # scan steps exit on round one.
+        c_real = len(pods_list)
+        c_bucket = 1 << (c_real - 1).bit_length()
+        if c_bucket > c_real:
+            empty = jax.tree.map(jnp.zeros_like, pods_list[0])
+            pods_list.extend([empty] * (c_bucket - c_real))
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *pods_list)
+        assignments, zones, rounds = solve_stream_full(
+            stacked,
+            nodes0,
+            self._params,
+            quotas=quotas0,
+            numa=numa_state,
+            devices=device_state,
+            max_rounds=self.max_rounds,
+            approx_topk=True,
+            numa_scoring=self._numa_scoring(),
+            device_scoring=self._device_scoring(),
+        )
+        host_a = np.asarray(assignments)
+        host_z = (
+            np.asarray(zones)
+            if numa_state is not None
+            else None
+        )
+        host_r = np.asarray(rounds)
+        out = []
+        for i, (chunk, rows) in enumerate(zip(chunks, rows_list)):
+            out.append(
+                (
+                    chunk,
+                    rows,
+                    _HostSolve(
+                        assignment=host_a[i],
+                        pod_zone=host_z[i] if host_z is not None else None,
+                        rounds_used=int(host_r[i]),
+                    ),
+                )
+            )
+        return out
+
     def _dispatch_pipelined(
         self, chunks: List[List[Pod]], sub: Optional[np.ndarray] = None
     ) -> List[Tuple[List[Pod], LoweredRows, SolveResult]]:
@@ -1119,10 +1288,21 @@ class BatchScheduler:
         if self.devices is not None and self.devices.has_devices:
             from ..ops.device import DeviceState
 
+            # GPU-only clusters trace the RDMA/FPGA feasibility, carry
+            # and prefix checks OUT of the solver entirely (None pytree
+            # leaves are static structure)
             device_state = DeviceState(
                 slot_free=take(self.devices.slot_array()),
-                rdma_free=take(self.devices.rdma_array()),
-                fpga_free=take(self.devices.fpga_array()),
+                rdma_free=(
+                    take(self.devices.rdma_array())
+                    if self.devices.has_rdma
+                    else None
+                ),
+                fpga_free=(
+                    take(self.devices.fpga_array())
+                    if self.devices.has_fpga
+                    else None
+                ),
                 cap_total=take(self.devices.cap_array()),
             )
         return numa_state, device_state

@@ -315,6 +315,16 @@ class DeviceManager:
     def has_devices(self) -> bool:
         return bool(self._nodes)
 
+    @property
+    def has_rdma(self) -> bool:
+        """Whether ANY node carries RDMA NICs — lets the solver trace the
+        RDMA feasibility/carry out entirely on GPU-only clusters."""
+        return any(st.rdma_free for st in self._nodes.values())
+
+    @property
+    def has_fpga(self) -> bool:
+        return any(st.fpga_free for st in self._nodes.values())
+
     # ---- solver lowering ----
 
     def slot_array(self) -> np.ndarray:

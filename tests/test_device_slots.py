@@ -211,3 +211,52 @@ def test_chunked_device_carry_is_exact():
     assert len(out.bound) == 8
     for i in range(4):
         assert sum(dm.node(f"n{i}").gpu_free) == 0.0
+
+
+def test_uneven_chunks_scanned_and_pipelined_paths():
+    """A drain whose last chunk has a smaller natural bucket must work
+    through BOTH multi-chunk dispatch paths (code-review r5: the shared
+    bucket override and the pair-packing both assumed equal shapes)."""
+    snap, dm = _mixed_cluster(n_nodes=16, gpus=4)
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=256)
+    sched.extender.monitor.stop_background()
+
+    def mk(i, node_name=None):
+        return Pod(
+            meta=ObjectMeta(name=f"u{i:03d}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 1000},
+                priority=9000,
+                node_name=node_name,
+            ),
+        )
+
+    # 300 pods -> chunks of 256 + 44 (buckets 256 vs 128): scanned path
+    out = sched.schedule([mk(i) for i in range(300)])
+    assert len(out.bound) == 300, len(out.unschedulable)
+    # a node-pinned pod forces the per-chunk pipelined fallback with the
+    # same uneven chunking
+    snap2, dm2 = _mixed_cluster(n_nodes=16, gpus=4)
+    sched2 = BatchScheduler(snap2, devices=dm2, batch_bucket=256)
+    sched2.extender.monitor.stop_background()
+    pods2 = [mk(i) for i in range(299)] + [mk(299, node_name="n0")]
+    out2 = sched2.schedule(pods2)
+    assert len(out2.bound) == 300, len(out2.unschedulable)
+
+
+def test_rdma_request_unschedulable_on_gpu_only_cluster():
+    """No node carries RDMA: a pod requesting it must surface
+    unschedulable (code-review r5: tracing the carry out must not turn
+    into silent schedulability)."""
+    snap, dm = _mixed_cluster(n_nodes=2, gpus=2)
+    sched = BatchScheduler(snap, devices=dm, batch_bucket=64)
+    sched.extender.monitor.stop_background()
+    pod = Pod(
+        meta=ObjectMeta(name="rdma-wanter"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_RDMA: 100},
+            priority=9000,
+        ),
+    )
+    out = sched.schedule([pod])
+    assert len(out.bound) == 0 and len(out.unschedulable) == 1
