@@ -665,7 +665,17 @@ SCENARIOS = {
 
 
 def main() -> None:
-    wanted = sys.argv[1:] or list(SCENARIOS)
+    # --stream-note TEXT: attach a measurement_note to the latency_stream
+    # entry (used when the pure-host streams are re-measured standalone in
+    # a quiet window and the artifact must say so — BASELINE.md relies on
+    # the note surviving regeneration)
+    argv = list(sys.argv[1:])
+    stream_note = None
+    if "--stream-note" in argv:
+        i = argv.index("--stream-note")
+        stream_note = argv[i + 1]
+        del argv[i : i + 2]
+    wanted = argv or list(SCENARIOS)
     # merge into the existing artifact: a partial or interrupted run must
     # never discard other scenarios' numbers (BASELINE.md cites this file
     # as the source of record for every scenario)
@@ -677,6 +687,8 @@ def main() -> None:
     ran = set()
     for name in wanted:
         res = SCENARIOS[name]()
+        if stream_note and res["scenario"] == "latency_stream_10k":
+            res["measurement_note"] = stream_note
         existing[res["scenario"]] = res
         ran.add(res["scenario"])
         print(json.dumps(res))

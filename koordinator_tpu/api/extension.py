@@ -693,8 +693,18 @@ def parse_reservation_affinity(
     reservation directly (other fields ignored); ``{"reservationSelector":
     {labels}}`` requires a matching reservation. Presence means REQUIRED —
     a pod carrying this must allocate from a matching reservation or stay
-    unschedulable."""
-    return _parse_dict_annotation(annotations, ANNOTATION_RESERVATION_AFFINITY)
+    unschedulable. A dict with NO recognized field is treated as absent
+    — presence gates scheduling behavior (required affinity, preemption
+    opt-out), so junk must never read as a requirement."""
+    spec = _parse_dict_annotation(annotations, ANNOTATION_RESERVATION_AFFINITY)
+    if spec is None:
+        return None
+    if not any(
+        k in spec
+        for k in ("name", "reservationSelector", "required", "preferred")
+    ):
+        return None
+    return spec
 
 
 def parse_gpu_partition_spec(annotations: Mapping[str, str]) -> tuple[bool, float]:
@@ -770,10 +780,24 @@ def parse_custom_usage_thresholds(annotations: Mapping[str, str]):
     ``load_aware.go`` GetCustomUsageThresholds): per-node REPLACEMENT of
     the LoadAware plugin's usage/prod thresholds (a non-empty custom map
     supersedes the global wholesale — dims absent from it go unchecked).
-    None when absent/malformed."""
-    return _parse_dict_annotation(
+    None when absent/malformed or when no recognized field is present
+    (a truthy junk dict must not read as "custom thresholds exist")."""
+    spec = _parse_dict_annotation(
         annotations, ANNOTATION_CUSTOM_USAGE_THRESHOLDS
     )
+    if spec is None:
+        return None
+    if not any(
+        k in spec
+        for k in (
+            "usageThresholds",
+            "prodUsageThresholds",
+            "aggregatedUsage",
+            "usageAggregationType",
+        )
+    ):
+        return None
+    return spec
 
 
 def _parse_json_annotation(annotations: Mapping[str, str], key: str, shape):
@@ -984,8 +1008,17 @@ def parse_node_cpu_allocs(annotations: Mapping[str, str]):
 def parse_numa_topology_spec(annotations: Mapping[str, str]):
     """Pod-level NUMA requirement (NUMATopologySpec): returns
     {"numaTopologyPolicy": str, "singleNUMANodeExclusive": str} or None
-    when the annotation is absent/malformed."""
-    return _parse_dict_annotation(annotations, ANNOTATION_NUMA_TOPOLOGY_SPEC)
+    when the annotation is absent/malformed or carries no recognized
+    field."""
+    spec = _parse_dict_annotation(annotations, ANNOTATION_NUMA_TOPOLOGY_SPEC)
+    if spec is None:
+        return None
+    if not any(
+        k in spec
+        for k in ("numaTopologyPolicy", "singleNUMANodeExclusive")
+    ):
+        return None
+    return spec
 
 
 def parse_system_qos_resource(annotations: Mapping[str, str]):

@@ -1,0 +1,143 @@
+"""CPUAccumulator policy edges (reference
+``pkg/scheduler/plugins/nodenumaresource/cpu_accumulator.go:87-800``):
+SMT-aware FullPCPUs picks, strict-vs-default fallback, spread ordering,
+reserved-CPU interplay, zone pinning and release/retake cycles — the
+behavioral depth the r4 LoC diagnostic flagged inside NodeNUMAResource.
+"""
+
+import pytest
+
+from koordinator_tpu.core.topology import (
+    CPUAccumulator,
+    CPUBindPolicy,
+    CPUTopology,
+)
+
+
+def _smt_topo(sockets=2, cores=4):
+    # threads_per_core=2: cpu ids pair up per core
+    return CPUTopology.uniform(
+        sockets=sockets, numa_per_socket=1, cores_per_numa=cores
+    )
+
+
+def _cores_of(topo, cpus):
+    by_core = {}
+    for c in topo.cpus:
+        if c.cpu_id in cpus:
+            by_core.setdefault((c.socket, c.core_id), set()).add(c.cpu_id)
+    return by_core
+
+
+def test_full_pcpus_takes_whole_cores_only():
+    topo = _smt_topo()
+    acc = CPUAccumulator(topo)
+    got = acc.take("a", 4, policy=CPUBindPolicy.FULL_PCPUS)
+    assert got is not None and len(got) == 4
+    for _core, threads in _cores_of(topo, got).items():
+        assert len(threads) == 2, "partial core taken under FullPCPUs"
+
+
+def test_full_pcpus_strict_rejects_odd_count():
+    """Strict FullPCPUs cannot satisfy an odd CPU count on SMT
+    (cpu_accumulator: n % threadsPerCore != 0 → error); DEFAULT falls
+    back to the spread path instead."""
+    acc = CPUAccumulator(_smt_topo())
+    assert acc.take("odd", 3, policy=CPUBindPolicy.FULL_PCPUS) is None
+    got = acc.take("odd2", 3, policy=CPUBindPolicy.DEFAULT)
+    assert got is not None and len(got) == 3
+
+
+def test_default_falls_back_to_spread_when_cores_fragment():
+    """DEFAULT prefers whole cores but must still satisfy from partial
+    cores once fragmentation makes whole-core picks impossible."""
+    topo = _smt_topo(sockets=1, cores=4)     # 8 cpus / 4 cores
+    acc = CPUAccumulator(topo)
+    # fragment: take one THREAD from each of 3 cores via spread
+    first = acc.take("frag", 3, policy=CPUBindPolicy.SPREAD_BY_PCPUS)
+    assert len(_cores_of(topo, first)) == 3
+    # 4 cpus remain: 1 whole core + 3 lone threads; DEFAULT must take 4
+    got = acc.take("rest", 4, policy=CPUBindPolicy.DEFAULT)
+    assert got is not None and len(got) == 4
+    # nothing double-allocated
+    assert not (got & first)
+
+
+def test_spread_by_pcpus_prefers_distinct_cores():
+    topo = _smt_topo(sockets=1, cores=4)
+    acc = CPUAccumulator(topo)
+    got = acc.take("s", 4, policy=CPUBindPolicy.SPREAD_BY_PCPUS)
+    assert len(_cores_of(topo, got)) == 4, "threads stacked on one core"
+
+
+def test_numa_pinning_is_respected_until_exhausted():
+    topo = _smt_topo(sockets=2, cores=4)      # zone 0/1 = 8 cpus each
+    acc = CPUAccumulator(topo)
+    a = acc.take("a", 8, policy=CPUBindPolicy.FULL_PCPUS, numa=0)
+    assert a is not None
+    zones = {c.numa_node for c in topo.cpus if c.cpu_id in a}
+    assert zones == {0}
+    # zone 0 exhausted: a pinned request must fail, unpinned succeeds
+    assert acc.take("b", 2, policy=CPUBindPolicy.FULL_PCPUS, numa=0) is None
+    c = acc.take("c", 2, policy=CPUBindPolicy.FULL_PCPUS, numa=1)
+    assert c is not None
+
+
+def test_release_returns_capacity_and_heaps_recover():
+    topo = _smt_topo(sockets=1, cores=4)
+    acc = CPUAccumulator(topo)
+    a = acc.take("a", 8, policy=CPUBindPolicy.FULL_PCPUS, numa=0)
+    assert a is not None and len(a) == 8
+    assert acc.take("b", 2, policy=CPUBindPolicy.FULL_PCPUS, numa=0) is None
+    acc.release("a")
+    b = acc.take("b", 8, policy=CPUBindPolicy.FULL_PCPUS, numa=0)
+    assert b is not None and len(b) == 8
+
+
+def test_take_reserved_blocks_future_takes():
+    topo = _smt_topo(sockets=1, cores=2)      # 4 cpus
+    acc = CPUAccumulator(topo)
+    acc.take_reserved("kubelet", {0, 1})
+    got = acc.take("p", 2, policy=CPUBindPolicy.DEFAULT)
+    assert got is not None
+    assert not (got & {0, 1}), "handed out kubelet-reserved cpus"
+    assert acc.take("q", 4, policy=CPUBindPolicy.DEFAULT) is None
+
+
+def test_take_bulk_matches_sequential_takes():
+    """take_bulk's hot path must be pick-for-pick identical to repeated
+    take() calls on a fresh accumulator."""
+    topo = _smt_topo(sockets=2, cores=8)
+    reqs = [
+        (f"o{i}", n, CPUBindPolicy.DEFAULT, numa)
+        for i, (n, numa) in enumerate(
+            [(4, 0), (2, 1), (4, 0), (2, None), (6, 1), (4, None)]
+        )
+    ]
+    seq = CPUAccumulator(topo)
+    expected = [
+        seq.take(o, n, policy=p, numa=z) for o, n, p, z in reqs
+    ]
+    bulk = CPUAccumulator(topo).take_bulk(reqs)
+    assert bulk == expected
+
+
+@pytest.mark.parametrize("n", [2, 4, 6, 8])
+def test_full_pcpus_socket_locality_preference(n):
+    """Whole-core picks that fit one NUMA node stay on one NUMA node
+    (domain ordering: numa, then socket, then spill)."""
+    topo = _smt_topo(sockets=2, cores=4)
+    acc = CPUAccumulator(topo)
+    got = acc.take("x", n, policy=CPUBindPolicy.FULL_PCPUS)
+    assert got is not None
+    zones = {c.numa_node for c in topo.cpus if c.cpu_id in got}
+    assert len(zones) == 1, f"{n} cpus spilled across zones: {zones}"
+
+
+def test_oversized_request_spills_across_sockets_largest_first():
+    topo = _smt_topo(sockets=2, cores=2)      # 4 cpus per zone
+    acc = CPUAccumulator(topo)
+    got = acc.take("big", 6, policy=CPUBindPolicy.FULL_PCPUS)
+    assert got is not None and len(got) == 6
+    for _core, threads in _cores_of(topo, got).items():
+        assert len(threads) == 2

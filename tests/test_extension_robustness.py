@@ -1,0 +1,96 @@
+"""Annotation-protocol parser robustness: every parse_* helper must
+treat malformed operator input as absent (the reference's parsers return
+zero values + error, and callers proceed without the feature — a bad
+annotation must never crash an informer or a scheduling cycle)."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+
+#: (annotation key the parser reads, parser callable) — each is fed the
+#: same battery of malformed payloads
+_GARBAGE = [
+    "",
+    "not-json{{",
+    "[]",                      # wrong JSON shape (list where dict expected)
+    '{"unexpected": []}',
+    '"quoted-string"',
+    "\x00\xff",
+    "9" * 10_000,              # absurd but parseable number
+]
+
+
+@pytest.mark.parametrize(
+    "key, fn",
+    [
+        (ext.ANNOTATION_DEVICE_ALLOCATE_HINT, ext.parse_device_allocate_hints),
+        (ext.ANNOTATION_GPU_PARTITION_SPEC, ext.parse_gpu_partition_table),
+        (ext.ANNOTATION_DEVICE_JOINT_ALLOCATE, ext.parse_device_joint_allocate),
+        (ext.ANNOTATION_RESERVATION_AFFINITY, ext.parse_reservation_affinity),
+        (ext.ANNOTATION_CUSTOM_USAGE_THRESHOLDS, ext.parse_custom_usage_thresholds),
+        (ext.ANNOTATION_QUOTA_SHARED_WEIGHT, ext.parse_quota_shared_weight),
+        (ext.ANNOTATION_NUMA_TOPOLOGY_SPEC, ext.parse_numa_topology_spec),
+        (ext.ANNOTATION_EXTENDED_RESOURCE_SPEC, ext.parse_extended_resource_spec),
+    ],
+)
+@pytest.mark.parametrize("garbage", _GARBAGE)
+def test_parsers_survive_garbage(key, fn, garbage):
+    out = fn({key: garbage})
+    # absent-equivalent: never an exception AND never truthy garbage
+    # that could flow into a scheduling cycle as real config
+    assert not out, (key, garbage, out)
+
+
+def test_duration_parser_go_syntax_and_garbage():
+    assert ext.parse_duration_s("90s") == 90.0
+    assert ext.parse_duration_s("2m") == 120.0
+    assert ext.parse_duration_s("1h30m") == 5400.0
+    assert ext.parse_duration_s("1.5h") == 5400.0
+    for bad in ("", "abc", "12", "h", "-5x", None):
+        assert ext.parse_duration_s(bad) is None
+
+
+def test_gpu_request_parser_edge_values():
+    assert ext.parse_gpu_request({ext.RES_GPU: 0}) == (0, 0.0)
+    # ratio exactly at a whole-GPU boundary
+    assert ext.parse_gpu_request({ext.RES_GPU_MEMORY_RATIO: 100}) == (1, 0.0)
+    assert ext.parse_gpu_request({ext.RES_GPU_MEMORY_RATIO: 350}) == (3, 50.0)
+    # no device keys at all
+    assert ext.parse_gpu_request({ext.RES_CPU: 4000}) == (0, 0.0)
+
+
+def test_node_amplification_ignores_bad_ratios():
+    # wire format is key=ratio pairs; malformed entries are skipped
+    good = ext.parse_node_amplification(
+        {ext.ANNOTATION_NODE_AMPLIFICATION: "cpu=1.5,memory=1.2"}
+    )
+    assert good["cpu"] == 1.5 and good["memory"] == 1.2
+    for bad in ("cpu=x", "=1.5", ",,,", "cpu", "{json}"):
+        out = ext.parse_node_amplification(
+            {ext.ANNOTATION_NODE_AMPLIFICATION: bad}
+        )
+        assert all(isinstance(v, float) for v in out.values())
+    mixed = ext.parse_node_amplification(
+        {ext.ANNOTATION_NODE_AMPLIFICATION: "cpu=bogus,memory=2.0"}
+    )
+    assert mixed == {"memory": 2.0}
+
+
+def test_shared_pools_parser_garbage():
+    for bad in _GARBAGE:
+        out = ext.parse_cpu_shared_pools(
+            {ext.ANNOTATION_NODE_CPU_SHARED_POOLS: bad}
+        )
+        assert out is None or isinstance(out, (list, tuple))
+
+
+def test_eviction_cost_clamps_and_defaults():
+    assert ext.parse_eviction_cost({}) == 0
+    assert (
+        ext.parse_eviction_cost({ext.ANNOTATION_EVICTION_COST: "100"}) == 100
+    )
+    assert (
+        ext.parse_eviction_cost({ext.ANNOTATION_EVICTION_COST: "junk"}) == 0
+    )
