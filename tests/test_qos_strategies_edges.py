@@ -1,0 +1,227 @@
+"""QoS-manager strategy edge behavior (reference
+``pkg/koordlet/qosmanager/plugins/``): suppression floors/clamps,
+eviction ordering and watermark math, satisfaction-gap eviction, the
+burst token bucket, and the resctrl schemata renderer."""
+
+import pytest
+
+from koordinator_tpu.api.extension import QoSClass
+from koordinator_tpu.api.types import ResctrlStrategy
+from koordinator_tpu.koordlet.qosmanager import (
+    BurstLimiter,
+    _llc_mask,
+    cpu_evict,
+    cpu_suppress,
+    memory_evict,
+    resctrl_schemata_plan,
+)
+
+# ---- cpu_suppress (calculateBESuppressCPU, cpu_suppress.go:136-170) ----
+
+
+def test_cpu_suppress_formula_and_flag():
+    # budget 65% of 16 cores = 10400m; non-BE used = 8000m → 2400m for BE
+    d = cpu_suppress(
+        node_allocatable_milli=16000.0,
+        node_used_milli=10000.0,
+        be_used_milli=2000.0,
+        threshold_percent=65.0,
+    )
+    assert d.be_allowance_milli == pytest.approx(2400.0)
+    assert d.be_cpuset_cpus == 3        # ceil(2.4)
+    assert d.suppressed
+
+
+def test_cpu_suppress_reserved_floor_applies():
+    """max(system.Used, node.reserved): the larger of the two is
+    subtracted, never both."""
+    lo = cpu_suppress(
+        node_allocatable_milli=16000.0,
+        node_used_milli=6000.0,
+        be_used_milli=2000.0,
+        threshold_percent=65.0,
+        sys_used_milli=1000.0,
+        node_reserved_milli=3000.0,
+    )
+    # pod(non-BE) = 6000-2000-1000 = 3000; minus max(1000, 3000)=3000
+    assert lo.be_allowance_milli == pytest.approx(10400.0 - 3000.0 - 3000.0)
+
+
+def test_cpu_suppress_floors_never_negative():
+    d = cpu_suppress(
+        node_allocatable_milli=16000.0,
+        node_used_milli=20000.0,
+        be_used_milli=100.0,
+        threshold_percent=65.0,
+        min_be_cpus=2,
+    )
+    assert d.be_allowance_milli == 2000.0    # whole-cpu legacy floor
+    pct = cpu_suppress(
+        node_allocatable_milli=16000.0,
+        node_used_milli=20000.0,
+        be_used_milli=100.0,
+        threshold_percent=65.0,
+        min_threshold_percent=10.0,
+    )
+    assert pct.be_allowance_milli == pytest.approx(1600.0)  # percent floor
+
+
+# ---- memory_evict (memory_evict.go watermark math) ----
+
+
+def test_memory_evict_lowest_priority_largest_first_until_lower_watermark():
+    pods = [
+        ("be-big", 4000.0, 5000),
+        ("be-small", 1000.0, 5000),
+        ("be-mid", 2000.0, 5500),
+        ("prodish", 2000.0, 9000),
+    ]
+    d = memory_evict(
+        node_memory_used_mib=15000.0,
+        node_memory_capacity_mib=16000.0,
+        threshold_percent=70.0,
+        lower_percent=60.0,
+        be_pods=pods,
+    )
+    assert d.evict
+    # same priority: larger usage evicts first
+    assert d.victims[0] == "be-big"
+    freed = sum(m for n, m, _p in pods if n in d.victims)
+    assert 15000.0 - freed <= 16000.0 * 0.60 + 1e-6
+    # it stops as soon as the lower watermark is reached
+    assert "prodish" not in d.victims[:1]
+
+
+def test_memory_evict_default_lower_is_threshold_minus_two():
+    d = memory_evict(
+        node_memory_used_mib=11250.0,     # 70.3%
+        node_memory_capacity_mib=16000.0,
+        threshold_percent=70.0,
+        lower_percent=None,               # defaults to 68%
+        be_pods=[("be", 500.0, 5000)],
+    )
+    assert d.evict
+    assert 11250.0 - 500.0 <= 16000.0 * 0.68
+
+
+def test_memory_evict_under_threshold_noop():
+    d = memory_evict(
+        node_memory_used_mib=10000.0,
+        node_memory_capacity_mib=16000.0,
+        threshold_percent=70.0,
+        lower_percent=60.0,
+        be_pods=[("be", 1000.0, 5000)],
+    )
+    assert not d.evict and not d.victims
+
+
+# ---- cpu_evict (cpu_evict.go:262-282 release sizing) ----
+
+
+def test_cpu_evict_release_targets_upper_watermark():
+    """release = request × (upper − satisfaction), truncated like the
+    reference's int64 cast; victims accumulate lowest-priority first
+    until the release amount is covered."""
+    pods = [("a", 2000.0, 5000), ("b", 2000.0, 5500), ("c", 2000.0, 6000)]
+    d = cpu_evict(
+        be_cpu_request_milli=10000.0,
+        be_cpu_usage_milli=3800.0,
+        be_cpu_limit_milli=4000.0,       # satisfaction 0.4
+        satisfaction_threshold=0.6,
+        usage_threshold_percent=90.0,    # usage 95% of limit → saturated
+        be_pods=pods,
+        satisfaction_upper_threshold=0.8,
+    )
+    assert d.evict
+    # need 10000 × (0.8 − 0.4) = 4000m → two 2000m victims
+    assert d.victims == ["a", "b"]
+
+
+def test_cpu_evict_requires_both_conditions():
+    base = dict(
+        be_cpu_request_milli=10000.0,
+        be_cpu_limit_milli=4000.0,
+        satisfaction_threshold=0.6,
+        usage_threshold_percent=90.0,
+        be_pods=[("a", 2000.0, 5000)],
+    )
+    # usage saturates the limit but satisfaction is healthy → no evict
+    # (usage 7900/8000 = 98.75% ≥ 90%, satisfaction 0.8 ≥ 0.6 — this
+    # isolates the satisfaction clause)
+    ok_sat = cpu_evict(
+        be_cpu_usage_milli=7900.0, **{**base, "be_cpu_limit_milli": 8000.0}
+    )
+    assert not ok_sat.evict
+    # poor satisfaction but BE barely using its limit → no evict
+    idle = cpu_evict(be_cpu_usage_milli=1000.0, **base)
+    assert not idle.evict
+
+
+# ---- burst limiter token bucket (cpu_burst.go:112-163) ----
+
+
+def test_burst_limiter_consumes_and_recovers():
+    lim = BurstLimiter(
+        burst_period_s=100.0, max_scale_percent=200.0, now=0.0, init_ratio=0.25
+    )
+    assert lim.capacity == 100 * 100
+    ok0, t0 = lim.allow(now=1.0, usage_scale_percent=150.0)
+    assert t0 == 2500 - 50                 # consumed (150-100)×1s
+    # sustained overuse drains the bucket below zero → bursting blocked
+    ok, tokens = lim.allow(now=60.0, usage_scale_percent=200.0)
+    assert not ok and tokens <= 0
+    # long quiet stretch refills (clamped at capacity)
+    ok2, tokens2 = lim.allow(now=500.0, usage_scale_percent=10.0)
+    assert ok2 and tokens2 == lim.capacity
+
+
+def test_burst_limiter_midband_usage_neither_consumes_nor_saves():
+    lim = BurstLimiter(
+        burst_period_s=10.0, max_scale_percent=300.0, now=0.0, init_ratio=0.5
+    )
+    before = lim.tokens
+    lim.allow(now=5.0, usage_scale_percent=80.0)   # 60 ≤ u < 100
+    assert lim.tokens == before
+
+
+def test_burst_limiter_reconfigure_resets_only_on_change():
+    lim = BurstLimiter(
+        burst_period_s=10.0, max_scale_percent=300.0, now=0.0, init_ratio=0.5
+    )
+    lim.allow(now=1.0, usage_scale_percent=150.0)
+    drained = lim.tokens
+    lim.update_if_changed(10.0, 300.0, now=2.0)    # unchanged → keep state
+    assert lim.tokens == drained
+    lim.update_if_changed(20.0, 300.0, now=3.0)    # changed → re-init
+    assert lim.capacity == 20 * 200
+
+
+# ---- resctrl schemata ----
+
+
+def test_llc_mask_way_math():
+    assert _llc_mask(100.0, 12) == format((1 << 12) - 1, "x")
+    assert bin(int(_llc_mask(50.0, 12), 16)).count("1") == 6
+    assert bin(int(_llc_mask(1.0, 12), 16)).count("1") == 1   # floor 1 way
+
+
+def test_resctrl_schemata_tiers_and_domains():
+    strat = ResctrlStrategy(
+        enable=True,
+        llc_percent={QoSClass.LSR: 100.0, QoSClass.LS: 60.0, QoSClass.BE: 20.0},
+        mba_percent={QoSClass.LSR: 100.0, QoSClass.LS: 80.0, QoSClass.BE: 30.0},
+    )
+    plan = resctrl_schemata_plan(strat, cache_ways=10, n_l3_domains=2)
+    by_tier = {g.split("/")[-1]: line for g, _f, line in plan}
+    assert set(by_tier) == {"LSR", "LS", "BE"}
+
+    def ways(tier):
+        l3 = by_tier[tier].splitlines()[0]
+        mask = l3.split("=")[-1]
+        return bin(int(mask, 16)).count("1")
+
+    assert ways("BE") <= ways("LS") <= ways("LSR")
+    # every cache domain gets a mask + MB line
+    l3_line, mb_line = by_tier["BE"].splitlines()
+    assert l3_line.count("=") == 2 and mb_line.count("=") == 2
+    assert "MB:" in mb_line and "30" in mb_line

@@ -1,0 +1,144 @@
+"""ClusterSnapshot accounting lifecycle depth (reference scheduler cache
++ LoadAware podAssignCache, ``load_aware.go:315-358``): assume/absorb/
+forget interplay with metric reports, CPU amplification charging, node
+churn with slot reuse, and the has_metric/metric_fresh columns."""
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceMetric,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+
+
+def _node(name, cpu=16000, annotations=None):
+    return Node(
+        meta=ObjectMeta(name=name, annotations=dict(annotations or {})),
+        status=NodeStatus(allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: 32768}),
+    )
+
+
+def _pod(name, cpu=2000, qos=None):
+    labels = {ext.LABEL_POD_QOS: qos} if qos else {}
+    return Pod(
+        meta=ObjectMeta(name=name, labels=labels),
+        spec=PodSpec(requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 1024}),
+    )
+
+
+def _metric(name, t, cpu=0.0):
+    return NodeMetric(
+        meta=ObjectMeta(name=name),
+        node_usage=ResourceMetric(usage={ext.RES_CPU: cpu}),
+        update_time=t,
+    )
+
+
+def test_absorb_then_forget_does_not_double_refund():
+    """A pod absorbed by a metric report must not have its pending
+    estimate refunded AGAIN at forget (only the requested row is)."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("n1"))
+    idx = snap.node_id("n1")
+    pod = _pod("p1")
+    snap.assume_pod(pod, "n1", now=100.0)
+    pend0 = snap.nodes.assigned_pending[idx].copy()
+    assert pend0.sum() > 0
+    # report AFTER the assume: the usage reflects the pod → absorbed
+    snap.set_node_metric(_metric("n1", 150.0, cpu=2000.0), now=151.0)
+    assert snap.nodes.assigned_pending[idx].sum() == 0
+    req_after_absorb = snap.nodes.requested[idx].copy()
+    snap.forget_pod(pod.meta.uid)
+    # requested refunded, pending must NOT go negative
+    assert snap.nodes.requested[idx].sum() < req_after_absorb.sum()
+    assert (snap.nodes.assigned_pending[idx] >= -1e-6).all()
+
+
+def test_amplified_node_charges_bound_pods_scaled():
+    """cpu-amplification: an LSR (cpuset-bound) pod's CPU charge scales
+    by the node ratio; a plain LS pod's does not
+    (``AmplifyResourceList``, plugin.go:430-438)."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        _node(
+            "amp",
+            annotations={ext.ANNOTATION_NODE_AMPLIFICATION: "cpu=2.0"},
+        )
+    )
+    idx = snap.node_id("amp")
+    cpu_dim = snap._cpu_dim
+    base = snap.nodes.requested[idx, cpu_dim]
+    snap.assume_pod(_pod("ls", cpu=1000), "amp", now=1.0)
+    ls_charge = snap.nodes.requested[idx, cpu_dim] - base
+    snap.assume_pod(_pod("lsr", cpu=1000, qos="LSR"), "amp", now=2.0)
+    lsr_charge = snap.nodes.requested[idx, cpu_dim] - base - ls_charge
+    assert ls_charge == 1000.0
+    assert lsr_charge == 2000.0, "bound pod must charge ×ratio"
+
+
+def test_node_slot_reuse_resets_all_columns():
+    """Removing a node and upserting a different one may reuse the dense
+    row: every column (metrics, freshness, has_metric, amplification,
+    custom thresholds) must reset — stale state on a reused slot would
+    haunt the new node."""
+    snap = ClusterSnapshot()
+    snap.upsert_node(
+        _node(
+            "old",
+            annotations={ext.ANNOTATION_NODE_AMPLIFICATION: "cpu=3.0"},
+        )
+    )
+    snap.set_node_metric(_metric("old", 10.0, cpu=5000.0), now=11.0)
+    old_idx = snap.node_id("old")
+    assert snap.nodes.has_metric[old_idx]
+    snap.remove_node("old")
+    snap.upsert_node(_node("new"))
+    new_idx = snap.node_id("new")
+    assert new_idx == old_idx, "test assumes slot reuse"
+    assert not snap.nodes.has_metric[new_idx]
+    assert not snap.nodes.metric_fresh[new_idx]
+    assert snap.nodes.cpu_amp[new_idx] == 1.0
+    assert snap.nodes.usage_avg[new_idx].sum() == 0
+
+
+def test_expired_assume_refunds_everything():
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("n1"))
+    idx = snap.node_id("n1")
+    # optimistic assume (the scheduler's Reserve path) — confirmed=True
+    # assumes are bind-observed and exempt from TTL expiry
+    snap.assume_pod(_pod("ghost"), "n1", now=100.0, confirmed=False)
+    assert snap.nodes.requested[idx].sum() > 0
+    n = snap.expire_assumed(now=100.0 + 10_000, ttl=300.0)
+    assert n == 1
+    np.testing.assert_allclose(snap.nodes.requested[idx], 0.0, atol=1e-6)
+    np.testing.assert_allclose(
+        snap.nodes.assigned_pending[idx], 0.0, atol=1e-6
+    )
+
+
+def test_confirmed_assume_never_expires():
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("n1"))
+    pod = _pod("keeper")
+    snap.assume_pod(pod, "n1", now=100.0)
+    assert snap.confirm_pod(pod.meta.uid)
+    assert snap.expire_assumed(now=1e9, ttl=1.0) == 0
+    assert snap.nodes.requested[snap.node_id("n1")].sum() > 0
+
+
+def test_stale_then_fresh_metric_restores_freshness():
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("n1"))
+    idx = snap.node_id("n1")
+    snap.set_node_metric(_metric("n1", 100.0), now=100.0 + 10_000)
+    assert snap.nodes.has_metric[idx] and not snap.nodes.metric_fresh[idx]
+    snap.set_node_metric(_metric("n1", 20_000.0), now=20_001.0)
+    assert snap.nodes.metric_fresh[idx]
