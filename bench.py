@@ -52,16 +52,19 @@ def build_fixture(seed: int = 0):
     )
 
 
-def bench_solver(fix) -> tuple[float, list[float]]:
+def bench_solver(fix, tracer=None) -> tuple[float, list[float]]:
     import jax
     import jax.numpy as jnp
 
+    from koordinator_tpu.obs import NULL_TRACER
     from koordinator_tpu.ops.solver import (
         NodeState,
         PodBatch,
         SolverParams,
         solve_stream,
     )
+
+    tracer = tracer or NULL_TRACER
 
     nodes = NodeState.create(
         allocatable=fix["alloc"],
@@ -84,21 +87,22 @@ def bench_solver(fix) -> tuple[float, list[float]]:
         lambda a: a.reshape((n_batches, BATCH) + a.shape[1:]), stacked
     )
 
-    def run_pass() -> tuple[int, float]:
-        t0 = time.perf_counter()
-        _, _, placed, _ = solve_stream(
-            stacked,
-            nodes,
-            params,
-            max_rounds=MAX_ROUNDS,
-            approx_topk=True,
-        )
-        placed_total = int(np.asarray(placed).sum())  # forces device sync
-        return placed_total, time.perf_counter() - t0
+    def run_pass(span_name: str = "solve_pass") -> tuple[int, float]:
+        with tracer.span(span_name, cat="bench", pods=N_PODS):
+            t0 = time.perf_counter()
+            _, _, placed, _ = solve_stream(
+                stacked,
+                nodes,
+                params,
+                max_rounds=MAX_ROUNDS,
+                approx_topk=True,
+            )
+            placed_total = int(np.asarray(placed).sum())  # forces device sync
+            return placed_total, time.perf_counter() - t0
 
     # warmup pass covers compile + first host->device transfer; measured
     # passes then pay exactly one dispatch + one sync through the tunnel.
-    run_pass()
+    run_pass("compile_warmup")
 
     times = []
     placed = 0
@@ -138,22 +142,48 @@ def bench_baseline(fix) -> float:
     return BASELINE_PODS / (time.perf_counter() - t0)
 
 
-def main() -> None:
-    fix = build_fixture()
-    baseline_pps = bench_baseline(fix)
-    solver_pps, passes = bench_solver(fix)
-    print(
-        json.dumps(
-            {
-                "metric": "sched_pods_per_sec_10k_nodes",
-                "value": round(solver_pps, 1),
-                "unit": "pods/s",
-                "vs_baseline": round(solver_pps / baseline_pps, 2),
-                "passes": passes,
-                "baseline_pods_per_sec": round(baseline_pps, 1),
-            }
-        )
+def main(argv=None) -> None:
+    import argparse
+
+    from koordinator_tpu.obs import Tracer
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--trace",
+        nargs="?",
+        const="bench_trace.json",
+        default=None,
+        metavar="PATH",
+        help="write a Chrome trace_event JSON of the run (open in "
+        "Perfetto / chrome://tracing); default path bench_trace.json",
     )
+    args = ap.parse_args(argv)
+    tracer = Tracer(enabled=args.trace is not None)
+    with tracer.span("fixture", cat="bench"):
+        fix = build_fixture()
+    with tracer.span("baseline", cat="bench", pods=BASELINE_PODS):
+        baseline_pps = bench_baseline(fix)
+    solver_pps, passes = bench_solver(fix, tracer=tracer)
+    out = {
+        "metric": "sched_pods_per_sec_10k_nodes",
+        "value": round(solver_pps, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(solver_pps / baseline_pps, 2),
+        "passes": passes,
+        "baseline_pods_per_sec": round(baseline_pps, 1),
+    }
+    if args.trace is not None:
+        # per-stage wall breakdown (where the benchmark's time went —
+        # fixture build vs. XLA compile vs. measured solve passes) rides
+        # the bench JSON so perf PRs can show WHERE a win landed
+        out["stage_breakdown_ms"] = {
+            name: round(total * 1000.0, 2)
+            for name, total in sorted(tracer.stage_totals().items())
+        }
+        with open(args.trace, "w") as f:
+            json.dump(tracer.to_chrome_trace(), f)
+        out["trace_file"] = args.trace
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -69,7 +69,10 @@ class Profile:
         filters: Sequence[FilterFn] = (),
         dry_run: bool = False,
         max_evictions_per_round: int = 0,
+        tracer=None,
     ):
+        from ..obs import NULL_TRACER
+
         self.name = name
         self.deschedule_plugins = list(deschedule_plugins)
         self.balance_plugins = list(balance_plugins)
@@ -78,8 +81,10 @@ class Profile:
         self.filters = list(filters)
         self.dry_run = dry_run
         self.max_evictions_per_round = max_evictions_per_round
+        self.tracer = tracer or NULL_TRACER
         self.records: List[EvictionRecord] = []
         self._round_evictions = 0
+        self._round_seq = 0
 
     def _evict(self, pod: Pod, reason: str, plugin: str) -> bool:
         if (
@@ -104,14 +109,35 @@ class Profile:
 
     def run_once(self, nodes: Sequence[Node], pods: Sequence[Pod]) -> Dict[str, int]:
         """One descheduler round: Deschedule plugins then Balance plugins
-        (descheduler.go:261-283 deschedulerOnce ordering)."""
+        (descheduler.go:261-283 deschedulerOnce ordering); every plugin
+        run gets a child span under the round span, tagged with the
+        per-profile round id and the plugin's eviction count."""
         self._round_evictions = 0
+        self._round_seq += 1
+        rid = self._round_seq
+        tr = self.tracer
         ctx = FrameworkContext(nodes=nodes, pods=pods, evict=self._evict)
         counts: Dict[str, int] = {}
-        for plugin in self.deschedule_plugins:
-            counts[plugin.name] = plugin.deschedule(ctx)
-        for plugin in self.balance_plugins:
-            counts[plugin.name] = plugin.balance(ctx)
+        with tr.span(
+            f"round:{self.name}", cat="descheduler", cycle=rid,
+            nodes=len(nodes), pods=len(pods),
+        ):
+            for plugin in self.deschedule_plugins:
+                with tr.span(
+                    f"plugin:{plugin.name}:deschedule",
+                    cat="descheduler",
+                    cycle=rid,
+                ) as sp:
+                    counts[plugin.name] = plugin.deschedule(ctx)
+                    sp.set(evicted=counts[plugin.name])
+            for plugin in self.balance_plugins:
+                with tr.span(
+                    f"plugin:{plugin.name}:balance",
+                    cat="descheduler",
+                    cycle=rid,
+                ) as sp:
+                    counts[plugin.name] = plugin.balance(ctx)
+                    sp.set(evicted=counts[plugin.name])
         return counts
 
 

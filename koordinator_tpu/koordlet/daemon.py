@@ -129,10 +129,17 @@ class Koordlet:
             info = col.read_meminfo()
             mem_cap = info[0] if info else 1024.0
 
+        from ..obs import Tracer
+
         self.executor = rex.ResourceExecutor(self.config.cgroup_root)
         self.metric_cache = mc.MetricCache()
         self.registry = koordlet_registry()
-        self.server = KoordletServer(self.registry, self.executor.auditor)
+        #: agent-wide cycle tracer (sampling off by default; the server's
+        #: POST /trace flips it) — collector and QoS loops feed it
+        self.tracer = Tracer(enabled=False)
+        self.server = KoordletServer(
+            self.registry, self.executor.auditor, tracer=self.tracer
+        )
         # inotify watcher (kernel-latency lifecycle events, reference
         # watcher_linux.go); collect_tick's polling diff stays as the
         # periodic resync and as the full fallback when start() fails
@@ -175,7 +182,10 @@ class Koordlet:
             total_cpus=n_cpus,
             node_allocatable_milli=alloc_milli,
             node_memory_capacity_mib=mem_cap,
+            tracer=self.tracer,
         )
+        #: collect-tick counter: the cycle_id stamped on collector spans
+        self._collect_seq = 0
         # kernel feature probes gate hook plans on host support
         # (system.InitSupportConfigs analog, koordlet.go:84)
         from .system import KernelProbes, SystemConfig
@@ -225,22 +235,30 @@ class Koordlet:
 
     def collect_tick(self, now: Optional[float] = None) -> None:
         now = now if now is not None else time.time()
-        self.pleg.tick()
-        for collector in self.collectors:
-            name = type(collector).__name__
-            # False means "nothing to collect" (no RDT, first delta tick,
-            # empty sampler, …) — only an exception is a collector failure.
-            try:
-                ok = collector.collect(now)
-            except Exception:
-                self.registry.get("collect_errors_total").labels(
-                    collector=name
-                ).inc()
-                continue
-            if ok:
-                self.registry.get("collector_last_collect_ts").set(
-                    now, collector=name
-                )
+        self._collect_seq += 1
+        tick = self._collect_seq
+        tr = self.tracer
+        with tr.span("collect_tick", cat="koordlet", cycle=tick):
+            self.pleg.tick()
+            for collector in self.collectors:
+                name = type(collector).__name__
+                # False means "nothing to collect" (no RDT, first delta
+                # tick, empty sampler, …) — only an exception is a
+                # collector failure.
+                with tr.span(
+                    f"collect:{name}", cat="koordlet", cycle=tick
+                ):
+                    try:
+                        ok = collector.collect(now)
+                    except Exception:
+                        self.registry.get("collect_errors_total").labels(
+                            collector=name
+                        ).inc()
+                        continue
+                if ok:
+                    self.registry.get("collector_last_collect_ts").set(
+                        now, collector=name
+                    )
         latest = self.metric_cache.latest(mc.NODE_CPU_USAGE, "node")
         if latest is not None:
             self.predictor.observe(f"node/{self.config.node_name}", latest[1], now)

@@ -331,12 +331,19 @@ class QoSManager:
         node_allocatable_milli: float,
         node_memory_capacity_mib: float,
         evict_cb: Optional[Callable[[str, str], None]] = None,
+        tracer=None,
     ):
+        from ..obs import NULL_TRACER
+
         self.executor = executor
         self.total_cpus = total_cpus
         self.node_allocatable_milli = node_allocatable_milli
         self.node_memory_capacity_mib = node_memory_capacity_mib
         self.evict_cb = evict_cb
+        self.tracer = tracer or NULL_TRACER
+        #: qosmanager tick counter — the koordlet-side cycle_id joining
+        #: strategy spans with the tick that produced them
+        self.ticks = 0
         self.evicted: List[str] = []
         self._evicted_set: set = set()
 
@@ -365,60 +372,77 @@ class QoSManager:
         be_pods_cpu: (uid, cpu_request_milli, priority) for BE pods;
         ls_pod_limits: (cgroup, cpu_limit_milli) for burst-eligible pods.
         """
+        tr = self.tracer
+        self.ticks += 1
+        tick = self.ticks
         out: Dict[str, object] = {}
-        if slo.threshold.enable:
-            dec = cpu_suppress(
-                self.node_allocatable_milli,
-                node_used_milli,
-                be_used_milli,
-                slo.threshold.cpu_suppress_threshold_percent,
-            )
-            self.executor.apply(
-                cpu_suppress_plan(dec, self.total_cpus), reason="cpusuppress"
-            )
-            out["cpu_suppress"] = dec
-            mev = memory_evict(
-                node_memory_used_mib,
-                self.node_memory_capacity_mib,
-                slo.threshold.memory_evict_threshold_percent,
-                slo.threshold.memory_evict_lower_percent,
-                be_pods_mem,
-            )
-            if mev.evict:
-                self._evict(mev.victims, mev.reason)
-            out["memory_evict"] = mev
-            # BE satisfaction collapse → CPU eviction (cpuevict)
-            be_request = sum(req for _, req, _ in be_pods_cpu)
-            cev = cpu_evict(
-                be_cpu_request_milli=be_request,
-                be_cpu_usage_milli=be_used_milli,
-                be_cpu_limit_milli=dec.be_allowance_milli,
-                satisfaction_threshold=0.6,
-                usage_threshold_percent=slo.threshold.cpu_evict_be_usage_threshold_percent,
-                be_pods=be_pods_cpu,
-            )
-            if cev.evict:
-                self._evict(cev.victims, cev.reason)
-            out["cpu_evict"] = cev
-        if slo.cpu_burst.policy != "none":
-            for group, limit_milli in ls_pod_limits:
+        with tr.span("qos_tick", cat="koordlet", cycle=tick):
+            if slo.threshold.enable:
+                with tr.span("strategy:cpusuppress", cat="koordlet", cycle=tick):
+                    dec = cpu_suppress(
+                        self.node_allocatable_milli,
+                        node_used_milli,
+                        be_used_milli,
+                        slo.threshold.cpu_suppress_threshold_percent,
+                    )
+                    self.executor.apply(
+                        cpu_suppress_plan(dec, self.total_cpus),
+                        reason="cpusuppress",
+                    )
+                    out["cpu_suppress"] = dec
+                with tr.span("strategy:memoryevict", cat="koordlet", cycle=tick):
+                    mev = memory_evict(
+                        node_memory_used_mib,
+                        self.node_memory_capacity_mib,
+                        slo.threshold.memory_evict_threshold_percent,
+                        slo.threshold.memory_evict_lower_percent,
+                        be_pods_mem,
+                    )
+                    if mev.evict:
+                        self._evict(mev.victims, mev.reason)
+                    out["memory_evict"] = mev
+                # BE satisfaction collapse → CPU eviction (cpuevict)
+                with tr.span("strategy:cpuevict", cat="koordlet", cycle=tick):
+                    be_request = sum(req for _, req, _ in be_pods_cpu)
+                    cev = cpu_evict(
+                        be_cpu_request_milli=be_request,
+                        be_cpu_usage_milli=be_used_milli,
+                        be_cpu_limit_milli=dec.be_allowance_milli,
+                        satisfaction_threshold=0.6,
+                        usage_threshold_percent=slo.threshold.cpu_evict_be_usage_threshold_percent,
+                        be_pods=be_pods_cpu,
+                    )
+                    if cev.evict:
+                        self._evict(cev.victims, cev.reason)
+                    out["cpu_evict"] = cev
+            if slo.cpu_burst.policy != "none":
+                with tr.span("strategy:cpuburst", cat="koordlet", cycle=tick):
+                    for group, limit_milli in ls_pod_limits:
+                        self.executor.apply(
+                            cpu_burst_plan(
+                                group, limit_milli, slo.cpu_burst.cpu_burst_percent
+                            ),
+                            reason="cpuburst",
+                        )
+            # tier-root baseline reassertion (cgreconcile)
+            with tr.span("strategy:cgreconcile", cat="koordlet", cycle=tick):
                 self.executor.apply(
-                    cpu_burst_plan(
-                        group, limit_milli, slo.cpu_burst.cpu_burst_percent
-                    ),
-                    reason="cpuburst",
+                    cg_reconcile_plan(self.total_cpus), reason="cgreconcile"
                 )
-        # tier-root baseline reassertion (cgreconcile)
-        self.executor.apply(cg_reconcile_plan(self.total_cpus), reason="cgreconcile")
-        if slo.resctrl.enable:
-            self.executor.apply(
-                resctrl_schemata_plan(slo.resctrl), reason="resctrl"
-            )
-        if slo.blkio.enable:
-            self.executor.apply(blkio_plan(slo.blkio), reason="blkio")
-        if slo.system.enable:
-            self.executor.apply(
-                sys_reconcile_plan(slo.system, self.node_memory_capacity_mib),
-                reason="sysreconcile",
-            )
+            if slo.resctrl.enable:
+                with tr.span("strategy:resctrl", cat="koordlet", cycle=tick):
+                    self.executor.apply(
+                        resctrl_schemata_plan(slo.resctrl), reason="resctrl"
+                    )
+            if slo.blkio.enable:
+                with tr.span("strategy:blkio", cat="koordlet", cycle=tick):
+                    self.executor.apply(blkio_plan(slo.blkio), reason="blkio")
+            if slo.system.enable:
+                with tr.span("strategy:sysreconcile", cat="koordlet", cycle=tick):
+                    self.executor.apply(
+                        sys_reconcile_plan(
+                            slo.system, self.node_memory_capacity_mib
+                        ),
+                        reason="sysreconcile",
+                    )
         return out

@@ -38,17 +38,30 @@ def koordlet_registry(reg: Optional[Registry] = None) -> Registry:
 
 
 class KoordletServer:
-    """Serves /metrics and /apis/v1/audit over HTTP."""
+    """Serves /metrics, /trace and /apis/v1/audit over HTTP."""
 
-    def __init__(self, registry: Registry, auditor: Auditor):
+    def __init__(self, registry: Registry, auditor: Auditor, tracer=None):
+        from ..obs import Tracer
+
         self.registry = registry
         self.auditor = auditor
+        self.tracer = tracer or Tracer(enabled=False)
         self._server: Optional[http.server.ThreadingHTTPServer] = None
 
-    def dispatch(self, path: str) -> tuple[int, str]:
+    def dispatch(self, path: str, method: str = "GET", body: str = "") -> tuple[int, str]:
         parsed = urllib.parse.urlparse(path)
         if parsed.path == "/metrics":
             return 200, self.registry.expose()
+        if parsed.path == "/trace":
+            if method == "POST":
+                flag = body.strip()
+                if flag not in ("0", "1", "true", "false"):
+                    return 400, "bad sampling flag (want 0/1/true/false)"
+                self.tracer.enabled = flag in ("1", "true")
+                if not self.tracer.enabled:
+                    self.tracer.clear()
+                return 200, str(self.tracer.enabled)
+            return 200, self.tracer.export_json()
         if parsed.path == "/apis/v1/audit":
             qs = urllib.parse.parse_qs(parsed.query)
             since = float(qs.get("since", ["0"])[0])
@@ -73,13 +86,21 @@ class KoordletServer:
         srv = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):
-                code, text = srv.dispatch(self.path)
+            def _run(self, method: str):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length).decode() if length else ""
+                code, text = srv.dispatch(self.path, method, body)
                 data = text.encode()
                 self.send_response(code)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
 
             def log_message(self, *args):
                 pass

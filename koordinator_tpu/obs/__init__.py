@@ -1,0 +1,34 @@
+"""Observability: scheduling-cycle tracing and rejection attribution.
+
+A dependency-free tracing subsystem shared by the scheduler, koordlet,
+descheduler and the simulators:
+
+* :mod:`trace` — :class:`Span`/:class:`Tracer` (thread-safe, monotonic
+  clock, nestable), ring-buffer retention, Chrome ``trace_event`` JSON
+  export, and :class:`StageTimer` feeding a span and a
+  ``utils.metrics.Histogram`` from one timing.
+* :mod:`rejections` — first-class rejection-reason taxonomy
+  (:class:`RejectStage`/:class:`RejectReason`) and the
+  :class:`RejectionLog` ring buffer + ``rejections_total`` counter the
+  scheduler threads from boolean-mask construction through commit
+  revalidation.
+"""
+
+from .rejections import (
+    RejectionLog,
+    RejectionRecord,
+    RejectReason,
+    RejectStage,
+)
+from .trace import NULL_TRACER, Span, StageTimer, Tracer
+
+__all__ = [
+    "NULL_TRACER",
+    "RejectReason",
+    "RejectStage",
+    "RejectionLog",
+    "RejectionRecord",
+    "Span",
+    "StageTimer",
+    "Tracer",
+]

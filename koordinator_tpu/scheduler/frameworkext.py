@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..api.types import Pod
+from ..obs import RejectionLog, Tracer
 from ..utils.metrics import Registry
 
 # ---------------------------------------------------------------------------
@@ -78,6 +79,20 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
     )
     reg.counter("scheduled_pods_total", "pods bound by the batch scheduler")
     reg.counter("unschedulable_pods_total", "pods left unschedulable")
+    reg.histogram(
+        "cycle_latency_seconds",
+        "wall time of one scheduling cycle",
+    )
+    reg.histogram(
+        "stage_latency_seconds",
+        "wall time per scheduling-cycle stage",
+        labels=("stage",),
+    )
+    reg.counter(
+        "rejections_total",
+        "pods rejected, attributed to the killing stage/plugin/reason",
+        labels=("stage", "plugin", "reason"),
+    )
     return reg
 
 
@@ -294,8 +309,10 @@ class ServicesEngine:
     """Plugin-installable HTTP API (reference gin engine,
     ``InstallAPIHandler`` at ``app/server.go:337``). Routes:
       /metrics            — Prometheus exposition
+      /trace              — Chrome trace JSON (GET), sampling on/off (POST)
       /debug/scores       — last score table (GET), top-N (POST body int)
       /debug/filters      — filter tally
+      /debug/rejections   — rejection records + per-stage tally
       /apis/v1/<plugin>/… — handlers installed by plugins
     """
 
@@ -304,10 +321,14 @@ class ServicesEngine:
         registry: Registry,
         scores: DebugScoresDumper,
         filters: DebugFiltersDumper,
+        tracer: Optional[Tracer] = None,
+        rejections: Optional[RejectionLog] = None,
     ):
         self.registry = registry
         self.scores = scores
         self.filters = filters
+        self.tracer = tracer or Tracer(enabled=False)
+        self.rejections = rejections or RejectionLog()
         self._routes: Dict[str, Callable[[str], Tuple[int, str]]] = {}
         self._server: Optional[http.server.ThreadingHTTPServer] = None
 
@@ -319,6 +340,20 @@ class ServicesEngine:
     def dispatch(self, method: str, path: str, body: str = "") -> Tuple[int, str]:
         if path == "/metrics":
             return 200, self.registry.expose()
+        if path == "/trace":
+            if method == "POST":
+                flag = body.strip()
+                if flag not in ("0", "1", "true", "false"):
+                    return 400, "bad sampling flag (want 0/1/true/false)"
+                self.tracer.enabled = flag in ("1", "true")
+                if not self.tracer.enabled:
+                    self.tracer.clear()
+                return 200, str(self.tracer.enabled)
+            return 200, self.tracer.export_json()
+        if path == "/debug/rejections":
+            if method == "POST":
+                return 405, "rejection log is read-only"
+            return 200, self.rejections.render()
         if path == "/debug/scores":
             if method == "POST":
                 try:
@@ -499,11 +534,37 @@ class FrameworkExtender:
         self.errors = ErrorHandlerDispatcher()
         self.scores = DebugScoresDumper()
         self.filters = DebugFiltersDumper()
-        self.services = ServicesEngine(self.registry, self.scores, self.filters)
+        #: cycle tracer (sampling off by default; POST /trace flips it)
+        self.tracer = Tracer(enabled=False)
+        #: per-decision rejection attribution, counted into
+        #: rejections_total{stage,plugin,reason}
+        self.rejections = RejectionLog(
+            counter=self.registry.get("rejections_total")
+        )
+        self.services = ServicesEngine(
+            self.registry,
+            self.scores,
+            self.filters,
+            tracer=self.tracer,
+            rejections=self.rejections,
+        )
+        #: monotonically increasing scheduling-cycle id joining spans,
+        #: metrics and rejection records for one cycle
+        self._cycle_seq = 0
         self._pre_batch: List[PodTransformer] = []
         self._batch_transformers: List[Callable] = []
         self._cost_transformers: List[Callable] = []
         self._composed_cost: Optional[Callable] = None
+
+    def begin_cycle(self) -> int:
+        """Allocate the next cycle id (called once per external
+        scheduling cycle; the preemption retry reuses its parent's)."""
+        self._cycle_seq += 1
+        return self._cycle_seq
+
+    @property
+    def current_cycle_id(self) -> int:
+        return self._cycle_seq
 
     # -- registration (reference PluginFactoryProxy interception:
     # frameworkext/framework_extender_factory.go intercepts plugin

@@ -67,16 +67,24 @@ class StreamScheduler:
         for _ in range(min(self.max_batch, len(self._queue))):
             batch.append(self._queue.popleft())
         meta = {p.meta.uid: (t, tries) for p, t, tries in batch}
-        out = self.scheduler.schedule([p for p, _t, _n in batch])
-        t_done = _time.perf_counter()
-        results: List[Tuple[Pod, Optional[str], float]] = []
-        for pod, node in out.bound:
-            t_arr, _tries = meta[pod.meta.uid]
-            results.append((pod, node, t_done - t_arr))
-        for pod in out.unschedulable:
-            t_arr, tries = meta[pod.meta.uid]
-            if tries + 1 < self.max_retries:
-                self._queue.append((pod, t_arr, tries + 1))
-            else:
-                results.append((pod, None, t_done - t_arr))
+        with self.scheduler.extender.tracer.span(
+            "pump", cat="scheduler", batch=len(batch)
+        ) as sp:
+            out = self.scheduler.schedule([p for p, _t, _n in batch])
+            t_done = _time.perf_counter()
+            results: List[Tuple[Pod, Optional[str], float]] = []
+            for pod, node in out.bound:
+                t_arr, _tries = meta[pod.meta.uid]
+                results.append((pod, node, t_done - t_arr))
+            for pod in out.unschedulable:
+                t_arr, tries = meta[pod.meta.uid]
+                if tries + 1 < self.max_retries:
+                    self._queue.append((pod, t_arr, tries + 1))
+                else:
+                    results.append((pod, None, t_done - t_arr))
+            sp.set(
+                bound=len(out.bound),
+                unschedulable=len(out.unschedulable),
+                backlog=len(self._queue),
+            )
         return results

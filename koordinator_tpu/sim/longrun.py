@@ -44,6 +44,7 @@ def run_loop(
     seed: int = 0,
     verbose: bool = False,
     chaos_ticks: tuple = (),
+    trace: bool = True,
 ):
     """Drive the loop for ``minutes`` of simulated time; returns stats.
 
@@ -109,6 +110,13 @@ def run_loop(
         snap, LoadAwareArgs(), batch_bucket=128, defer_preemption=True
     )
     sched.extender.monitor.stop_background()
+    # cycle tracing on by default: the final stats carry the per-stage
+    # wall-time breakdown (snapshot/lower/solve/commit/postfilter) for
+    # BENCH artifacts. Tracing adds the solve-stage block_until_ready
+    # fence, so pass trace=False when the loop's own wall time is the
+    # number under study; the span ring is bounded (65536), so very long
+    # runs undercount stage_ms for the earliest cycles.
+    sched.extender.tracer.enabled = trace
     from koordinator_tpu.api.types import Reservation, ReservationOwner
     from koordinator_tpu.descheduler.evictor import SoftEvictor
     from koordinator_tpu.descheduler.low_node_load import (
@@ -527,4 +535,14 @@ def run_loop(
     hub.stop()
     if stats["min_batch_cap"] == float("inf"):
         stats["min_batch_cap"] = 0.0  # zero-tick run: keep JSON standard
+    # per-stage wall-time breakdown over every scheduling cycle the loop
+    # ran (depth ≤ 1: the cycle span and its four tiling stages; nested
+    # retry stages excluded so totals stay exclusive), plus the count of
+    # rejection records for attribution completeness checks
+    tracer = sched.extender.tracer
+    stats["stage_ms"] = {
+        name: round(total * 1000.0, 3)
+        for name, total in sorted(tracer.stage_totals(max_depth=1).items())
+    }
+    stats["rejection_records"] = len(sched.extender.rejections.records())
     return stats

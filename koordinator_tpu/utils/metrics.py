@@ -17,11 +17,39 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus text-exposition label-value escaping."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping (backslash and newline only, per the spec)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: Mapping[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
+
+
+def _check_labels(label_names: Tuple[str, ...], labels: Mapping[str, str]) -> None:
+    """Labels not declared at metric construction are a caller bug —
+    silently dropping them used to record into the wrong series."""
+    unknown = [k for k in labels if k not in label_names]
+    if unknown:
+        raise ValueError(
+            f"unknown label(s) {unknown!r}; declared label names are "
+            f"{list(label_names)!r}"
+        )
 
 
 @dataclass
@@ -38,6 +66,7 @@ class Counter:
         self._lock = threading.Lock()
 
     def labels(self, **labels: str) -> "_CounterChild":
+        _check_labels(self.label_names, labels)
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
         with self._lock:
             series = self._series.setdefault(key, _Series())
@@ -47,12 +76,16 @@ class Counter:
         self.labels().inc(amount)
 
     def value(self, **labels: str) -> float:
+        _check_labels(self.label_names, labels)
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
         with self._lock:
             return self._series.get(key, _Series()).value
 
     def expose(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} counter",
+        ]
         with self._lock:
             for key, s in sorted(self._series.items()):
                 labels = dict(zip(self.label_names, key))
@@ -72,6 +105,7 @@ class _CounterChild:
 
 class Gauge(Counter):
     def labels(self, **labels: str) -> "_GaugeChild":
+        _check_labels(self.label_names, labels)
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
         with self._lock:
             series = self._series.setdefault(key, _Series())
@@ -81,7 +115,10 @@ class Gauge(Counter):
         self.labels(**labels).set(value)
 
     def expose(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} gauge",
+        ]
         with self._lock:
             for key, s in sorted(self._series.items()):
                 labels = dict(zip(self.label_names, key))
@@ -123,6 +160,7 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels: str) -> None:
+        _check_labels(self.label_names, labels)
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
         with self._lock:
             s = self._series.setdefault(
@@ -138,8 +176,12 @@ class Histogram:
                 s.counts[-1] += 1
 
     def quantile(self, q: float, **labels: str) -> float:
-        """Approximate quantile from bucket counts (upper bound of the
-        bucket holding the q-th sample)."""
+        """Approximate quantile from bucket counts, linearly interpolated
+        within the winning bucket (Prometheus ``histogram_quantile``
+        semantics: the first bucket's lower edge is 0). A target landing
+        in the ``+Inf`` overflow bucket stays ``+Inf`` — there is no
+        upper edge to interpolate toward."""
+        _check_labels(self.label_names, labels)
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
         with self._lock:
             s = self._series.get(key)
@@ -148,13 +190,19 @@ class Histogram:
             target = q * s.n
             acc = 0
             for i, c in enumerate(s.counts[:-1]):
+                if acc + c >= target and c > 0:
+                    lower = self.buckets[i - 1] if i > 0 else 0.0
+                    upper = self.buckets[i]
+                    frac = (target - acc) / c
+                    return lower + frac * (upper - lower)
                 acc += c
-                if acc >= target:
-                    return self.buckets[i]
             return float("inf")
 
     def expose(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} histogram",
+        ]
         with self._lock:
             for key, s in sorted(self._series.items()):
                 labels = dict(zip(self.label_names, key))
@@ -182,10 +230,10 @@ class Registry:
         return f"{self.namespace}_{name}" if self.namespace else name
 
     def counter(self, name: str, help_: str = "", labels: Sequence[str] = ()) -> Counter:
-        return self._get(name, lambda n: Counter(n, help_, labels))
+        return self._get(name, Counter, lambda n: Counter(n, help_, labels))
 
     def gauge(self, name: str, help_: str = "", labels: Sequence[str] = ()) -> Gauge:
-        return self._get(name, lambda n: Gauge(n, help_, labels))
+        return self._get(name, Gauge, lambda n: Gauge(n, help_, labels))
 
     def histogram(
         self,
@@ -194,15 +242,24 @@ class Registry:
         labels: Sequence[str] = (),
         buckets: Sequence[float] = DEFAULT_BUCKETS,
     ) -> Histogram:
-        return self._get(name, lambda n: Histogram(n, help_, labels, buckets))
+        return self._get(
+            name, Histogram, lambda n: Histogram(n, help_, labels, buckets)
+        )
 
-    def _get(self, name, factory):
+    def _get(self, name, kind, factory):
         full = self._full(name)
         with self._lock:
             m = self._metrics.get(full)
             if m is None:
                 m = factory(full)
                 self._metrics[full] = m
+            elif type(m) is not kind:
+                # exact-type check: Gauge subclasses Counter, so isinstance
+                # would hand a Gauge to a counter() caller
+                raise ValueError(
+                    f"metric {full!r} already registered as "
+                    f"{type(m).__name__}, requested {kind.__name__}"
+                )
             return m
 
     def get(self, name: str) -> Optional[object]:

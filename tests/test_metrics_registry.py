@@ -1,0 +1,214 @@
+"""utils.metrics hardening (ISSUE 1 satellites): type-conflict detection
+in the registry, label-name validation, interpolated histogram quantiles,
+and valid Prometheus text exposition."""
+
+import math
+import re
+
+import pytest
+
+from koordinator_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+
+class TestRegistryTypeConflicts:
+    def test_same_name_different_type_raises(self):
+        reg = Registry()
+        reg.counter("x", "help")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.histogram("x")
+
+    def test_gauge_is_not_a_counter(self):
+        # Gauge subclasses Counter — an isinstance check would wrongly
+        # hand a Gauge back to a counter() caller
+        reg = Registry()
+        reg.gauge("g", "help")
+        with pytest.raises(ValueError):
+            reg.counter("g")
+
+    def test_same_type_is_idempotent(self):
+        reg = Registry(namespace="ns")
+        c1 = reg.counter("x", "help")
+        c2 = reg.counter("x")
+        assert c1 is c2
+
+
+class TestLabelValidation:
+    def test_unknown_label_raises_on_counter(self):
+        c = Counter("c", "h", label_names=("a",))
+        with pytest.raises(ValueError, match="unknown label"):
+            c.labels(b="oops")
+        with pytest.raises(ValueError, match="unknown label"):
+            c.value(b="oops")
+
+    def test_unknown_label_raises_on_gauge_and_histogram(self):
+        g = Gauge("g", "h", label_names=("a",))
+        with pytest.raises(ValueError):
+            g.set(1.0, b="oops")
+        h = Histogram("h", "h", label_names=("a",))
+        with pytest.raises(ValueError):
+            h.observe(0.1, b="oops")
+
+    def test_declared_labels_still_work(self):
+        c = Counter("c", "h", label_names=("a", "b"))
+        c.labels(a="1", b="2").inc()
+        # partial label sets keep the historic empty-string default
+        c.labels(a="1").inc()
+        assert c.value(a="1", b="2") == 1
+        assert c.value(a="1") == 1
+
+
+class TestQuantileInterpolation:
+    def test_uniform_samples_interpolate_within_bucket(self):
+        h = Histogram("h", "x", buckets=(1.0, 2.0, 4.0))
+        # 100 uniform samples in (1, 2]: p50 should land near 1.5, not
+        # snap to the bucket's upper bound 2.0
+        for i in range(100):
+            h.observe(1.0 + (i + 1) / 100.0)
+        assert h.quantile(0.5) == pytest.approx(1.5, abs=0.05)
+        assert h.quantile(0.0) == pytest.approx(1.0, abs=0.02)
+        assert h.quantile(1.0) == pytest.approx(2.0, abs=0.02)
+
+    def test_first_bucket_lower_edge_is_zero(self):
+        h = Histogram("h", "x", buckets=(1.0, 2.0))
+        for _ in range(4):
+            h.observe(0.5)
+        # all mass in (0, 1]: p50 interpolates from lower edge 0
+        assert h.quantile(0.5) == pytest.approx(0.5)
+
+    def test_inf_bucket_keeps_inf_semantics(self):
+        h = Histogram("h", "x", buckets=(1.0,))
+        h.observe(0.5)
+        h.observe(10.0)  # lands in +Inf bucket
+        assert math.isinf(h.quantile(0.99))
+        assert h.quantile(0.5) <= 1.0
+
+    def test_exact_test_vector_from_frameworkext(self):
+        # the pre-existing expectation: target at the top of the winning
+        # bucket returns the bucket bound
+        h = Histogram("h", "x")
+        for v in (0.002, 0.002, 0.2, 3.0):
+            h.observe(v)
+        assert h.quantile(0.5) == pytest.approx(0.0025)
+
+
+# ---- Prometheus text exposition validity ----
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? (?P<value>\S+)$"
+)
+_LABEL_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"$'
+)
+
+
+def _parse_exposition(text: str):
+    """Minimal validating parser: HELP then TYPE precede each family's
+    samples; sample names belong to the most recent family (plus the
+    _bucket/_sum/_count suffixes for histograms); label syntax is valid."""
+    families = {}
+    current = None
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"type": None, "samples": []}
+            current = name
+        elif line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert name == current, "TYPE must follow its HELP"
+            assert families[name]["type"] is None, f"duplicate TYPE {name}"
+            assert mtype in ("counter", "gauge", "histogram")
+            families[name]["type"] = mtype
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            name = m.group("name")
+            assert current is not None
+            if families[current]["type"] == "histogram":
+                assert name in (
+                    current,
+                    f"{current}_bucket",
+                    f"{current}_sum",
+                    f"{current}_count",
+                ), f"sample {name} outside family {current}"
+            else:
+                assert name == current, f"sample {name} outside {current}"
+            labels = {}
+            if m.group("labels"):
+                for pair in m.group("labels").split(","):
+                    assert _LABEL_RE.match(pair), f"bad label pair {pair!r}"
+                    k, v = pair.split("=", 1)
+                    labels[k] = v.strip('"')
+            float(m.group("value").replace("+Inf", "inf"))
+            families[current]["samples"].append((name, labels, m.group("value")))
+    return families
+
+
+class TestExpositionValidity:
+    def _full_registry(self):
+        reg = Registry(namespace="t")
+        c = reg.counter("req_total", "requests", labels=("code",))
+        c.labels(code="200").inc(3)
+        c.labels(code="500").inc()
+        g = reg.gauge("temp", "degrees")
+        g.set(-4.5)
+        h = reg.histogram("lat_seconds", "latency", labels=("op",))
+        for v in (0.002, 0.02, 0.2, 2.0, 20.0):
+            h.observe(v, op="read")
+        return reg
+
+    def test_help_type_ordering_and_sample_grouping(self):
+        fams = _parse_exposition(self._full_registry().expose())
+        assert fams["t_req_total"]["type"] == "counter"
+        assert fams["t_temp"]["type"] == "gauge"
+        assert fams["t_lat_seconds"]["type"] == "histogram"
+
+    def test_histogram_bucket_monotonicity_and_inf(self):
+        fams = _parse_exposition(self._full_registry().expose())
+        samples = fams["t_lat_seconds"]["samples"]
+        buckets = [
+            (float(lab["le"].replace("+Inf", "inf")), float(val))
+            for name, lab, val in samples
+            if name == "t_lat_seconds_bucket"
+        ]
+        les = [le for le, _ in buckets]
+        counts = [c for _, c in buckets]
+        assert les == sorted(les)
+        assert counts == sorted(counts), "cumulative counts must be monotone"
+        assert math.isinf(les[-1])
+        count = [
+            float(val)
+            for name, _, val in samples
+            if name == "t_lat_seconds_count"
+        ][0]
+        assert counts[-1] == count  # +Inf bucket equals _count
+
+    def test_label_value_escaping(self):
+        reg = Registry()
+        c = reg.counter("c", 'help with \\ and\nnewline', labels=("msg",))
+        c.labels(msg='quote " backslash \\ newline \n done').inc()
+        text = reg.expose()
+        # the exposition must parse despite hostile label values/help
+        fams = _parse_exposition(text)
+        assert len(fams["c"]["samples"]) == 1
+        # embedded newline in the help text stays on the HELP line, escaped
+        assert text.split("\n")[0] == "# HELP c help with \\\\ and\\nnewline"
+
+    def test_scheduler_registry_exposes_validly(self):
+        from koordinator_tpu.scheduler.frameworkext import scheduler_registry
+
+        reg = scheduler_registry()
+        reg.get("rejections_total").labels(
+            stage="filter", plugin="noderesources", reason="insufficient_resources"
+        ).inc()
+        reg.get("solver_batch_latency_seconds").observe(0.01)
+        fams = _parse_exposition(reg.expose())
+        assert "koord_scheduler_rejections_total" in fams

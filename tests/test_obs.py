@@ -1,0 +1,489 @@
+"""Observability layer: Span/Tracer semantics, Chrome trace export,
+rejection attribution across the batch cycle, and the services-engine
+/trace + /debug/rejections endpoints (ISSUE 1 acceptance criteria)."""
+
+import json
+import threading
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.obs import (
+    RejectionLog,
+    RejectReason,
+    RejectStage,
+    Tracer,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.utils.metrics import Registry
+
+
+def mkpod(name, cpu=1000, mem=1 << 20, priority=9500, **meta_kw):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, **meta_kw),
+        spec=PodSpec(
+            requests={ext.RES_CPU: float(cpu), ext.RES_MEMORY: float(mem)},
+            priority=priority,
+        ),
+    )
+
+
+@pytest.fixture
+def sched():
+    s = BatchScheduler()
+    s.extender.monitor.stop_background()
+    for i in range(4):
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"node-{i}"),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: 32000.0,
+                        ext.RES_MEMORY: float(64 << 30),
+                    }
+                ),
+            )
+        )
+    return s
+
+
+class TestTracer:
+    def test_span_records_nesting_and_duration(self):
+        tr = Tracer(enabled=True)
+        with tr.span("outer", cat="t"):
+            with tr.span("inner", cat="t", k=1):
+                pass
+        recs = tr.records()
+        assert [r.name for r in recs] == ["inner", "outer"]
+        inner, outer = recs
+        assert inner.depth == 1 and outer.depth == 0
+        assert inner.dur <= outer.dur
+        assert inner.t0 >= outer.t0
+        assert inner.args == {"k": 1}
+
+    def test_ring_retention(self):
+        tr = Tracer(enabled=True, capacity=4)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        names = [r.name for r in tr.records()]
+        assert names == ["s6", "s7", "s8", "s9"]
+
+    def test_thread_safety_and_lanes(self):
+        tr = Tracer(enabled=True)
+        gate = threading.Barrier(4)  # hold all threads live concurrently
+
+        def work(i):
+            gate.wait()
+            for _ in range(50):
+                with tr.span(f"t{i}"):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(tr.records()) == 200
+        trace = tr.to_chrome_trace()
+        lanes = {
+            e["tid"] for e in trace["traceEvents"] if e.get("ph") == "X"
+        }
+        assert len(lanes) == 4
+
+    def test_chrome_export_shape(self):
+        tr = Tracer(enabled=True)
+        with tr.span("a", cat="x", n=3):
+            pass
+        doc = json.loads(tr.export_json())
+        assert isinstance(doc["traceEvents"], list)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 1
+        (e,) = xs
+        assert e["name"] == "a" and e["cat"] == "x"
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert {"pid", "tid", "args"} <= set(e)
+        # metadata events name the process and each thread lane
+        phs = {ev["ph"] for ev in doc["traceEvents"]}
+        assert "M" in phs
+
+    def test_stage_timer_feeds_span_and_histogram(self):
+        tr = Tracer(enabled=True)
+        reg = Registry()
+        h = reg.histogram("stage_seconds", "x", labels=("stage",))
+        with tr.stage("work", h, labels={"stage": "work"}):
+            pass
+        assert [r.name for r in tr.records()] == ["work"]
+        text = reg.expose()
+        assert 'stage_seconds_count{stage="work"} 1' in text
+
+    def test_stage_timer_histogram_fires_even_when_disabled(self):
+        tr = Tracer(enabled=False)
+        reg = Registry()
+        h = reg.histogram("h", "x")
+        with tr.stage("work", h):
+            pass
+        assert tr.records() == []
+        assert "h_count 1" in reg.expose()
+
+
+class TestRejectionLog:
+    def test_record_counts_and_ring(self):
+        reg = Registry()
+        c = reg.counter("rej", "x", labels=("stage", "plugin", "reason"))
+        log = RejectionLog(counter=c, capacity=2)
+        for i in range(3):
+            log.record(
+                7,
+                mkpod(f"p{i}"),
+                RejectStage.FILTER,
+                "noderesources",
+                RejectReason.INSUFFICIENT_RESOURCES,
+            )
+        recs = log.records()
+        assert len(recs) == 2  # ring evicted the oldest
+        assert recs[0].pod == "p1"
+        assert (
+            c.value(
+                stage="filter",
+                plugin="noderesources",
+                reason="insufficient_resources",
+            )
+            == 3  # the counter survives ring eviction
+        )
+        assert log.stage_tally() == {"filter": 2}
+        doc = json.loads(log.render())
+        assert doc["tally"] == {"filter": 2}
+        assert doc["records"][0]["cycle"] == 7
+
+    def test_cycle_filter(self):
+        log = RejectionLog()
+        log.record(1, mkpod("a"), RejectStage.GATE, "x", RejectReason.GANG_NOT_READY)
+        log.record(2, mkpod("b"), RejectStage.GATE, "x", RejectReason.GANG_NOT_READY)
+        assert [r.pod for r in log.records(cycle_id=2)] == ["b"]
+        assert [r.cycle_id for r in log.for_uid("a")] == [1]
+
+
+class TestSchedulerCycleTrace:
+    """The ISSUE acceptance criterion: a BatchScheduler run over a
+    synthetic cluster produces a Chrome trace whose spans cover ≥95% of
+    the cycle's wall time with distinct snapshot/lower/solve/commit
+    stages, and every unscheduled pod has a retrievable, counted
+    rejection record."""
+
+    def test_trace_coverage_and_stages(self, sched):
+        sched.extender.tracer.enabled = True
+        pods = [mkpod(f"p{i}") for i in range(8)]
+        pods.append(mkpod("giant", cpu=999_000))  # cannot fit anywhere
+        out = sched.schedule(pods)
+        assert len(out.bound) == 8
+        assert [p.meta.name for p in out.unschedulable] == ["giant"]
+
+        recs = sched.extender.tracer.records()
+        by_name = {r.name for r in recs}
+        assert {"cycle", "snapshot", "lower", "solve", "commit"} <= by_name
+        (cycle,) = [r for r in recs if r.name == "cycle"]
+        stages = [
+            r
+            for r in recs
+            if r.depth == 1
+            and r.name in ("snapshot", "solve", "commit", "postfilter")
+        ]
+        coverage = sum(r.dur for r in stages) / cycle.dur
+        assert coverage >= 0.95, f"stage spans cover only {coverage:.1%}"
+        # cycle_id joins every span of the cycle
+        cid = cycle.args["cycle"]
+        assert all(r.args.get("cycle") == cid for r in stages)
+        # the trace round-trips as Chrome trace_event JSON
+        doc = json.loads(
+            sched.extender.services.dispatch("GET", "/trace")[1]
+        )
+        assert any(
+            e["name"] == "cycle" for e in doc["traceEvents"] if e["ph"] == "X"
+        )
+
+    def test_unscheduled_pods_have_attributed_records(self, sched):
+        impossible = mkpod("pinned")
+        impossible.spec.node_name = "no-such-node"
+        giant = mkpod("giant", cpu=999_000)
+        out = sched.schedule([mkpod("ok"), giant, impossible])
+        assert {p.meta.name for p in out.unschedulable} == {
+            "giant",
+            "pinned",
+        }
+        rej = sched.extender.rejections
+        (g,) = rej.for_uid("giant")
+        assert (g.stage, g.plugin, g.reason) == (
+            "filter",
+            "noderesources",
+            "insufficient_resources",
+        )
+        (p,) = rej.for_uid("pinned")
+        assert (p.stage, p.plugin, p.reason) == (
+            "filter",
+            "nodeaffinity",
+            "no_matching_node",
+        )
+        # retrievable over the services engine…
+        code, body = sched.extender.services.dispatch(
+            "GET", "/debug/rejections"
+        )
+        assert code == 200
+        doc = json.loads(body)
+        assert {r["pod"] for r in doc["records"]} == {"giant", "pinned"}
+        assert all(
+            {"stage", "plugin", "reason", "cycle"} <= set(r)
+            for r in doc["records"]
+        )
+        # …and counted in /metrics
+        metrics = sched.extender.services.dispatch("GET", "/metrics")[1]
+        assert (
+            'koord_scheduler_rejections_total{plugin="noderesources",'
+            'reason="insufficient_resources",stage="filter"} 1.0' in metrics
+        )
+
+    def test_usage_threshold_attribution(self, sched):
+        from koordinator_tpu.api.types import NodeMetric, ResourceMetric
+
+        # every node hot: estimated usage already above the 65% CPU
+        # threshold, so the fit succeeds but LoadAware masks all nodes
+        for i in range(4):
+            sched.snapshot.set_node_metric(
+                NodeMetric(
+                    meta=ObjectMeta(name=f"node-{i}"),
+                    node_usage=ResourceMetric(
+                        usage={
+                            ext.RES_CPU: 31000.0,
+                            ext.RES_MEMORY: float(1 << 30),
+                        }
+                    ),
+                    update_time=100.0,
+                ),
+                now=100.0,  # fresh at ingest time
+            )
+        out = sched.schedule([mkpod("hotput", cpu=4000)])
+        assert out.unschedulable
+        (r,) = sched.extender.rejections.for_uid("hotput")
+        assert (r.stage, r.plugin, r.reason) == (
+            "filter",
+            "loadaware",
+            "usage_exceeds_threshold",
+        )
+
+    def test_gang_gate_attribution(self, sched):
+        from koordinator_tpu.api.types import PodGroup
+
+        sched.pod_groups.upsert_pod_group(
+            PodGroup(
+                meta=ObjectMeta(name="gang-a", namespace="default"),
+                min_member=3,
+            )
+        )
+        member = mkpod(
+            "m0",
+            labels={ext.LABEL_GANG_NAME: "gang-a"},
+            namespace="default",
+        )
+        out = sched.schedule([member])
+        assert out.unschedulable
+        recs = sched.extender.rejections.for_uid("m0")
+        assert recs and recs[0].plugin == "coscheduling"
+
+    def test_bound_pods_leave_no_records(self, sched):
+        sched.schedule([mkpod(f"p{i}") for i in range(4)])
+        assert sched.extender.rejections.records() == []
+
+    def test_preemption_retry_bind_leaves_no_record(self):
+        """A pod that fails the first pass but binds via the postfilter
+        preemption retry was NOT rejected by the cycle — it must leave no
+        rejection record (and no rejections_total increment)."""
+        from koordinator_tpu.api.types import ElasticQuota
+        from koordinator_tpu.core.snapshot import ClusterSnapshot
+        from koordinator_tpu.scheduler.plugins.elasticquota import (
+            GroupQuotaManager,
+        )
+
+        snap = ClusterSnapshot()
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name="n0"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 400.0, ext.RES_MEMORY: 400.0}
+                ),
+            )
+        )
+        mgr = GroupQuotaManager(
+            snap.config,
+            cluster_total={ext.RES_CPU: 400, ext.RES_MEMORY: 400},
+        )
+        mgr.upsert_quota(
+            ElasticQuota(
+                meta=ObjectMeta(name="team-a"),
+                min={ext.RES_CPU: 8, ext.RES_MEMORY: 8},
+                max={ext.RES_CPU: 12, ext.RES_MEMORY: 400},
+            )
+        )
+
+        def qpod(name, prio):
+            return Pod(
+                meta=ObjectMeta(
+                    name=name,
+                    uid=name,
+                    labels={ext.LABEL_QUOTA_NAME: "team-a"},
+                ),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: 6.0, ext.RES_MEMORY: 6.0},
+                    priority=prio,
+                ),
+            )
+
+        s = BatchScheduler(snap, quotas=mgr)
+        s.extender.monitor.stop_background()
+        out0 = s.schedule([qpod("low0", 5000), qpod("low1", 5000)])
+        assert len(out0.bound) == 2  # team-a now at its 12-cpu max
+        out = s.schedule([qpod("high", 9500)])
+        assert [p.meta.name for p, _ in out.bound] == ["high"]
+        assert [p.meta.name for p in out.preempted] == ["low1"]
+        assert s.extender.rejections.for_uid("high") == []
+        assert (
+            s.extender.registry.get("rejections_total").value(
+                stage="quota", plugin="elasticquota", reason="quota_exhausted"
+            )
+            == 0
+        )
+
+    def test_stream_pump_span(self, sched):
+        from koordinator_tpu.scheduler.stream import StreamScheduler
+
+        sched.extender.tracer.enabled = True
+        stream = StreamScheduler(sched, max_batch=16)
+        for i in range(3):
+            stream.submit(mkpod(f"s{i}"))
+        results = stream.pump()
+        assert len(results) == 3
+        pumps = [
+            r for r in sched.extender.tracer.records() if r.name == "pump"
+        ]
+        assert len(pumps) == 1
+        assert pumps[0].args["batch"] == 3
+        assert pumps[0].args["bound"] == 3
+
+
+class TestServicesEngineEndpoints:
+    def test_trace_toggle_and_export(self, sched):
+        eng = sched.extender.services
+        assert sched.extender.tracer.enabled is False
+        code, body = eng.dispatch("POST", "/trace", "1")
+        assert (code, body) == (200, "True")
+        sched.schedule([mkpod("p")])
+        doc = json.loads(eng.dispatch("GET", "/trace")[1])
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+        # disabling clears the ring
+        code, body = eng.dispatch("POST", "/trace", "0")
+        assert (code, body) == (200, "False")
+        doc = json.loads(eng.dispatch("GET", "/trace")[1])
+        assert not any(e["ph"] == "X" for e in doc["traceEvents"])
+
+    def test_dispatch_error_paths(self, sched):
+        eng = sched.extender.services
+        assert eng.dispatch("GET", "/nope")[0] == 404
+        assert eng.dispatch("POST", "/trace", "banana")[0] == 400
+        assert eng.dispatch("POST", "/debug/scores", "not-an-int")[0] == 400
+        assert eng.dispatch("POST", "/debug/rejections", "x")[0] == 405
+        # plugin routes are exact-path: a prefix must not match
+        eng.install("demo", "/x", lambda body: (200, "ok"))
+        assert eng.dispatch("GET", "/apis/v1/demo/x")[0] == 200
+        assert eng.dispatch("GET", "/apis/v1/demo/x/y")[0] == 404
+
+    def test_filters_dump_carries_stage_tally(self, sched):
+        eng = sched.extender.services
+        assert eng.dispatch("POST", "/debug/filters", "1") == (200, "True")
+        sched.schedule([mkpod("giant", cpu=999_000)])
+        doc = json.loads(eng.dispatch("GET", "/debug/filters")[1])
+        assert doc == {"filter:noderesources": 1}
+
+    def test_rejections_served_over_http(self, sched):
+        import urllib.request
+
+        sched.schedule([mkpod("giant", cpu=999_000)])
+        port = sched.extender.services.serve()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/rejections", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["records"][0]["pod"] == "giant"
+        finally:
+            sched.extender.services.shutdown()
+
+
+class TestKoordletAndDeschedulerSpans:
+    def test_qosmanager_strategy_spans(self, tmp_path):
+        from koordinator_tpu.api.types import NodeSLO
+        from koordinator_tpu.koordlet import resourceexecutor as rex
+        from koordinator_tpu.koordlet.qosmanager import QoSManager
+
+        tr = Tracer(enabled=True)
+        qos = QoSManager(
+            rex.ResourceExecutor(str(tmp_path)),
+            total_cpus=8,
+            node_allocatable_milli=8000.0,
+            node_memory_capacity_mib=4096.0,
+            tracer=tr,
+        )
+        slo = NodeSLO(meta=ObjectMeta(name="n"))
+        slo.threshold.enable = True
+        qos.run_once(
+            slo,
+            node_used_milli=6000.0,
+            be_used_milli=1000.0,
+            node_memory_used_mib=1000.0,
+        )
+        names = {r.name for r in tr.records()}
+        assert "qos_tick" in names
+        assert "strategy:cpusuppress" in names
+        assert "strategy:cgreconcile" in names
+        tick = [r for r in tr.records() if r.name == "qos_tick"][0]
+        assert tick.args["cycle"] == 1
+
+    def test_koordlet_collect_tick_spans_and_trace_endpoint(self, tmp_path):
+        from koordinator_tpu.koordlet.daemon import Koordlet, KoordletConfig
+
+        agent = Koordlet(
+            KoordletConfig(
+                cgroup_root=str(tmp_path), n_cpus=2,
+                node_memory_capacity_mib=1024.0,
+            )
+        )
+        # sampling starts OFF and is armed over the server, like the
+        # scheduler's services engine
+        assert agent.tracer.enabled is False
+        code, body = agent.server.dispatch("/trace", "POST", "1")
+        assert (code, body) == (200, "True")
+        agent.collect_tick(now=100.0)
+        names = {r.name for r in agent.tracer.records()}
+        assert "collect_tick" in names
+        assert any(n.startswith("collect:") for n in names)
+        code, body = agent.server.dispatch("/trace")
+        assert code == 200
+        assert json.loads(body)["traceEvents"]
+        assert agent.server.dispatch("/trace", "POST", "bogus")[0] == 400
+        assert agent.server.dispatch("/trace", "POST", "0") == (200, "False")
+        assert agent.tracer.enabled is False
+
+    def test_descheduler_profile_spans(self):
+        from koordinator_tpu.descheduler.framework import Profile
+
+        class FakePlugin:
+            name = "lownodeload"
+
+            def balance(self, ctx):
+                return 0
+
+        tr = Tracer(enabled=True)
+        prof = Profile("default", balance_plugins=[FakePlugin()], tracer=tr)
+        prof.run_once(nodes=[], pods=[])
+        names = [r.name for r in tr.records()]
+        assert "plugin:lownodeload:balance" in names
+        assert "round:default" in names
