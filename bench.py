@@ -157,8 +157,32 @@ def main(argv=None) -> None:
         help="write a Chrome trace_event JSON of the run (open in "
         "Perfetto / chrome://tracing); default path bench_trace.json",
     )
+    ap.add_argument(
+        "--stage-report",
+        action="store_true",
+        help="print a per-stage total/p50/p99 table to stderr and embed "
+        "stage_breakdown_ms in the JSON (with --scenario, the suite "
+        "scenarios' BENCH_SUITE.json entries gain the breakdown too)",
+    )
+    ap.add_argument(
+        "--scenario",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="run bench_suite scenario(s) (loadaware / numa / device_gang "
+        "/ quota_tree / latency_stream) instead of the headline metric, "
+        "honoring --stage-report/--trace; results merge into "
+        "BENCH_SUITE.json",
+    )
     args = ap.parse_args(argv)
-    tracer = Tracer(enabled=args.trace is not None)
+    if args.scenario:
+        import bench_suite
+
+        bench_suite.run_scenarios(
+            args.scenario, stage_report=args.stage_report, trace=args.trace
+        )
+        return
+    tracer = Tracer(enabled=args.trace is not None or args.stage_report)
     with tracer.span("fixture", cat="bench"):
         fix = build_fixture()
     with tracer.span("baseline", cat="bench", pods=BASELINE_PODS):
@@ -172,7 +196,7 @@ def main(argv=None) -> None:
         "passes": passes,
         "baseline_pods_per_sec": round(baseline_pps, 1),
     }
-    if args.trace is not None:
+    if args.trace is not None or args.stage_report:
         # per-stage wall breakdown (where the benchmark's time went —
         # fixture build vs. XLA compile vs. measured solve passes) rides
         # the bench JSON so perf PRs can show WHERE a win landed
@@ -180,6 +204,13 @@ def main(argv=None) -> None:
             name: round(total * 1000.0, 2)
             for name, total in sorted(tracer.stage_totals().items())
         }
+    if args.stage_report:
+        import bench_suite
+
+        bench_suite._print_stage_table(
+            "headline", bench_suite._stage_stats(tracer.records())
+        )
+    if args.trace is not None:
         with open(args.trace, "w") as f:
             json.dump(tracer.to_chrome_trace(), f)
         out["trace_file"] = args.trace

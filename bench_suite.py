@@ -32,6 +32,75 @@ def _percentiles(samples):
     return float(np.percentile(arr, 50)), float(np.percentile(arr, 99))
 
 
+#: --stage-report / --trace state (set by run_scenarios): when on,
+#: _measure runs one extra TRACED drain per scenario and embeds the
+#: per-stage wall breakdown into the scenario's BENCH json entry, so
+#: stage regressions show in the perf trajectory without a Chrome trace
+STAGE_REPORT = False
+TRACE_PATH = None
+
+
+def _stage_stats(records):
+    """Per-span-name totals + p50/p99 (ms) from tracer records."""
+    per = {}
+    for s in records:
+        per.setdefault(s.name, []).append(s.dur * 1e3)
+    out = {}
+    for name, durs in sorted(per.items()):
+        arr = np.asarray(durs)
+        out[name] = {
+            "total_ms": round(float(arr.sum()), 2),
+            "count": len(durs),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        }
+    return out
+
+
+def _print_stage_table(scenario: str, stats) -> None:
+    print(f"--- stage report: {scenario} ---", file=sys.stderr)
+    print(
+        f"{'stage':<32} {'total_ms':>10} {'count':>6} {'p50_ms':>9} {'p99_ms':>9}",
+        file=sys.stderr,
+    )
+    for name, row in sorted(
+        stats.items(), key=lambda kv: -kv[1]["total_ms"]
+    ):
+        print(
+            f"{name:<32} {row['total_ms']:>10.2f} {row['count']:>6} "
+            f"{row['p50_ms']:>9.3f} {row['p99_ms']:>9.3f}",
+            file=sys.stderr,
+        )
+
+
+def _stage_report_pass(build, chunk, name, result) -> None:
+    """One extra drain with the scheduler's tracer ON (runs for
+    --stage-report AND/OR --trace): per-stage totals land in the scenario
+    entry (``stage_breakdown_ms``), the p50/p99 table goes to stderr
+    (stage-report only), and --trace dumps the Chrome trace. Runs after
+    the measured passes so tracing overhead never lands in them; the jit
+    caches are already warm, so no compile time pollutes the stages."""
+    sched, pods = build()
+    sched.extender.monitor.stop_background()
+    tracer = sched.extender.tracer
+    tracer.enabled = True
+    _run_scheduler(sched, pods, chunk=chunk)
+    stats = _stage_stats(tracer.records())
+    result["stage_breakdown_ms"] = {
+        k: v["total_ms"] for k, v in stats.items()
+    }
+    result["stage_p50_p99_ms"] = {
+        k: [v["p50_ms"], v["p99_ms"]] for k, v in stats.items()
+    }
+    if STAGE_REPORT:
+        _print_stage_table(name, stats)
+    if TRACE_PATH:
+        path = f"{TRACE_PATH.removesuffix('.json')}_{name}.json"
+        with open(path, "w") as f:
+            json.dump(tracer.to_chrome_trace(), f)
+        result["trace_file"] = path
+
+
 def _run_scheduler(sched, pods, chunk=4096):
     """Drive the host pipeline in chunks; returns (bound, total, batch_times)."""
     times = []
@@ -147,7 +216,7 @@ def _measure(build, chunk, name, passes: int = 3):
     baseline_pps = _golden_baseline(build)
     median_pps = sorted(pass_pps)[len(pass_pps) // 2]
     pod_arr = np.asarray(pod_lat) if pod_lat else np.zeros(1)
-    return {
+    result = {
         "scenario": name,
         "pods_per_sec": median_pps,
         "passes": pass_pps,
@@ -165,6 +234,9 @@ def _measure(build, chunk, name, passes: int = 3):
         "baseline_pods_per_sec": round(baseline_pps, 1),
         "vs_baseline": round(median_pps / baseline_pps, 2),
     }
+    if STAGE_REPORT or TRACE_PATH:
+        _stage_report_pass(build, chunk, name, result)
+    return result
 
 
 def bench_loadaware():
@@ -664,18 +736,26 @@ SCENARIOS = {
 }
 
 
-def main() -> None:
-    # --stream-note TEXT: attach a measurement_note to the latency_stream
-    # entry (used when the pure-host streams are re-measured standalone in
-    # a quiet window and the artifact must say so — BASELINE.md relies on
-    # the note surviving regeneration)
-    argv = list(sys.argv[1:])
-    stream_note = None
-    if "--stream-note" in argv:
-        i = argv.index("--stream-note")
-        stream_note = argv[i + 1]
-        del argv[i : i + 2]
-    wanted = argv or list(SCENARIOS)
+def run_scenarios(
+    wanted=None,
+    stage_report: bool = False,
+    trace=None,
+    stream_note=None,
+    prune: bool = False,
+) -> None:
+    """Run scenarios and merge results into BENCH_SUITE.json (also the
+    entry point for ``bench.py --scenario``). ``stage_report`` adds the
+    traced per-stage breakdown pass to each _measure scenario; ``trace``
+    is a Chrome-trace path prefix for those passes."""
+    global STAGE_REPORT, TRACE_PATH
+    STAGE_REPORT = stage_report
+    TRACE_PATH = trace
+    wanted = list(wanted) if wanted else list(SCENARIOS)
+    unknown = [n for n in wanted if n not in SCENARIOS]
+    if unknown:
+        sys.exit(
+            f"unknown scenario(s) {unknown}; valid: {', '.join(SCENARIOS)}"
+        )
     # merge into the existing artifact: a partial or interrupted run must
     # never discard other scenarios' numbers (BASELINE.md cites this file
     # as the source of record for every scenario)
@@ -694,11 +774,54 @@ def main() -> None:
         print(json.dumps(res))
         with open("BENCH_SUITE.json", "w") as f:
             json.dump(list(existing.values()), f, indent=1)
-    if not sys.argv[1:]:
+    if prune:
         # a COMPLETED full run prunes stale entries (renamed/removed
         # scenarios); interruption keeps whatever was known
         with open("BENCH_SUITE.json", "w") as f:
             json.dump([existing[s] for s in existing if s in ran], f, indent=1)
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "scenarios",
+        nargs="*",
+        help=f"scenarios to run (default: all; valid: {', '.join(SCENARIOS)})",
+    )
+    ap.add_argument(
+        "--stream-note",
+        default=None,
+        metavar="TEXT",
+        help="attach a measurement_note to the latency_stream entry (used "
+        "when the pure-host streams are re-measured standalone in a quiet "
+        "window and the artifact must say so — BASELINE.md relies on the "
+        "note surviving regeneration)",
+    )
+    ap.add_argument(
+        "--stage-report",
+        action="store_true",
+        help="print per-stage total/p50/p99 tables and embed "
+        "stage_breakdown_ms into the per-scenario BENCH_SUITE.json entries",
+    )
+    ap.add_argument(
+        "--trace",
+        nargs="?",
+        const="bench_suite_trace.json",
+        default=None,
+        metavar="PATH",
+        help="write a Chrome trace of each scenario's traced pass to "
+        "PATH_<scenario>.json",
+    )
+    args = ap.parse_args()
+    run_scenarios(
+        args.scenarios or None,
+        stage_report=args.stage_report,
+        trace=args.trace,
+        stream_note=args.stream_note,
+        prune=not args.scenarios,
+    )
 
 
 if __name__ == "__main__":

@@ -271,6 +271,24 @@ class ClusterSnapshot:
         #: consumers caching node-derived views (reservation candidates)
         self.node_epoch = 0
         self.nodes = NodeArrays.empty(self.config.min_bucket, self.config.dims)
+        #: bumped on EVERY node-block mutation (upsert/remove, metric
+        #: ingest, assume/forget). Device-resident consumers key their
+        #: caches off it and pull the touched rows via drain_dirty().
+        self.version = 0
+        #: node rows touched since the last drain; _dirty_all marks a
+        #: structural change (bucket growth / reset) that invalidates any
+        #: resident mirror wholesale
+        self._dirty_rows: set = set()
+        self._dirty_all = True
+        self._drain_owner: Optional[int] = None
+        #: [n_bucket] bool — rows currently holding a real node (freed
+        #: slots keep a stale name in _node_names; masks must not match it)
+        self._present = np.zeros((self.config.min_bucket,), bool)
+        #: inverted label index: (key, value) -> [n_bucket] bool rows.
+        #: Built lazily per queried pair, then maintained eagerly on node
+        #: upsert/remove — the vectorized node-constraint mask ANDs these
+        #: bitmaps instead of walking per-node label dicts (P×N loop).
+        self._label_rows: Dict[Tuple[str, str], np.ndarray] = {}
         #: pod uid -> _AssumedPod for assumed/bound pods
         self._assumed: Dict[str, "_AssumedPod"] = {}
         #: node name -> labels (nodeSelector/affinity masks read these)
@@ -288,7 +306,112 @@ class ClusterSnapshot:
         self._assumed.clear()
         self._node_labels.clear()
         self._node_annotations.clear()
+        self._present = np.zeros((self.config.min_bucket,), bool)
+        self._label_rows.clear()
         self.node_epoch += 1
+        self.touch_all()
+
+    # ---- dirty-row tracking (device-resident consumers) ----
+
+    def _touch(self, idx: int) -> None:
+        self.version += 1
+        if not self._dirty_all:
+            self._dirty_rows.add(int(idx))
+
+    def touch_rows(self, idxs: Iterable[int]) -> None:
+        """Mark node rows as mutated (for the rare external writers that
+        poke the node arrays directly instead of going through
+        upsert/assume/metric APIs)."""
+        self.version += 1
+        if not self._dirty_all:
+            self._dirty_rows.update(int(i) for i in idxs)
+
+    def touch_all(self) -> None:
+        """Invalidate any device-resident mirror wholesale (bucket growth,
+        reset, or a writer that cannot enumerate the rows it touched)."""
+        self.version += 1
+        self._dirty_all = True
+        self._dirty_rows.clear()
+
+    def drain_dirty(self, owner: Optional[int] = None) -> Optional[np.ndarray]:
+        """Consume the dirty-row marks: returns the sorted row indices
+        touched since the last drain, or None when the resident mirror
+        must be rebuilt from scratch (structural change). SINGLE-CONSUMER:
+        the marks are cleared on return, so exactly one resident mirror
+        may incrementally maintain itself per snapshot — pass a stable
+        ``owner`` token and a second drainer degrades both to full
+        re-lowers instead of silently missing rows."""
+        if owner is not None:
+            if self._drain_owner is None:
+                self._drain_owner = owner
+            elif self._drain_owner != owner:
+                # contested drain: neither consumer can trust partial marks
+                self._drain_owner = owner
+                self._dirty_all = False
+                self._dirty_rows.clear()
+                return None
+        if self._dirty_all:
+            self._dirty_all = False
+            self._dirty_rows.clear()
+            return None
+        rows = np.fromiter(
+            self._dirty_rows, np.int32, count=len(self._dirty_rows)
+        )
+        rows.sort()
+        self._dirty_rows.clear()
+        return rows
+
+    # ---- node-constraint inverted index ----
+
+    #: cap on cached label-pair bitmaps: high-cardinality selectors
+    #: (kubernetes.io/hostname=nodeX pins — one distinct value per node)
+    #: would otherwise grow the index O(N²); pairs beyond the cap are
+    #: built per query without caching (the pre-index cost, paid only by
+    #: the overflow tail)
+    _LABEL_INDEX_CAP = 8192
+
+    def label_rows(self, key: str, value: str) -> np.ndarray:
+        """[n_bucket] bool of nodes carrying ``key=value``. Built lazily
+        per queried pair (one O(N) scan), maintained eagerly afterwards.
+        Callers must treat the bitmap as read-only."""
+        bm = self._label_rows.get((key, value))
+        if bm is None:
+            bm = np.zeros((self.nodes.allocatable.shape[0],), bool)
+            for name, idx in self._node_index.items():
+                if self._node_labels.get(name, {}).get(key) == value:
+                    bm[idx] = True
+            if len(self._label_rows) < self._LABEL_INDEX_CAP:
+                self._label_rows[(key, value)] = bm
+        return bm
+
+    def constraint_row(
+        self,
+        node_name: Optional[str] = None,
+        affinity_names: Optional[Sequence[str]] = None,
+        selector: Optional[Mapping[str, str]] = None,
+    ) -> np.ndarray:
+        """[n_bucket] bool of nodes a pod's hard node constraints admit
+        (spec.nodeName / required node-affinity names / nodeSelector — the
+        upstream NodeName+NodeAffinity Filter semantics), built from the
+        inverted index instead of a per-node label walk. Returns a fresh
+        array the caller owns."""
+        if node_name:
+            row = np.zeros((self.nodes.allocatable.shape[0],), bool)
+            idx = self._node_index.get(node_name)
+            if idx is not None:
+                row[idx] = True
+        elif affinity_names is not None:
+            row = np.zeros((self.nodes.allocatable.shape[0],), bool)
+            for nm in affinity_names:
+                idx = self._node_index.get(nm)
+                if idx is not None:
+                    row[idx] = True
+        else:
+            row = self._present.copy()
+        if selector:
+            for k, v in selector.items():
+                row = row & self.label_rows(k, v)
+        return row
 
     # ---- node side ----
 
@@ -328,6 +451,11 @@ class ClusterSnapshot:
             ),
             n_real=old.n_real,
         )
+        self._present = np.pad(self._present, (0, new - self._present.shape[0]))
+        for pair, bm in self._label_rows.items():
+            self._label_rows[pair] = np.pad(bm, (0, new - bm.shape[0]))
+        # bucket growth changes every resident-mirror shape
+        self.touch_all()
 
     def upsert_node(self, node: Node) -> int:
         idx = self._node_index.get(node.meta.name)
@@ -427,8 +555,26 @@ class ClusterSnapshot:
                 )
                 ap.request = ap.request.copy()
                 ap.request[self._cpu_dim] = new_charge
-        self._node_labels[node.meta.name] = dict(node.meta.labels)
+        new_labels = dict(node.meta.labels)
+        old_labels = self._node_labels.get(node.meta.name)
+        if old_labels != new_labels:
+            # keep only bitmaps that already exist current — absent pairs
+            # rebuild lazily on first query
+            if self._label_rows:
+                for k, v in (old_labels or {}).items():
+                    if new_labels.get(k) != v:
+                        bm = self._label_rows.get((k, v))
+                        if bm is not None:
+                            bm[idx] = False
+                for k, v in new_labels.items():
+                    if old_labels is None or old_labels.get(k) != v:
+                        bm = self._label_rows.get((k, v))
+                        if bm is not None:
+                            bm[idx] = True
+        self._present[idx] = True
+        self._node_labels[node.meta.name] = new_labels
         self._node_annotations[node.meta.name] = dict(node.meta.annotations)
+        self._touch(idx)
         return idx
 
     def node_labels(self, name: str) -> Mapping[str, str]:
@@ -439,10 +585,17 @@ class ClusterSnapshot:
 
     def remove_node(self, name: str) -> None:
         idx = self._node_index.pop(name, None)
-        self._node_labels.pop(name, None)
+        old_labels = self._node_labels.pop(name, None)
         self._node_annotations.pop(name, None)
         if idx is None:
             return
+        if old_labels and self._label_rows:
+            for k, v in old_labels.items():
+                bm = self._label_rows.get((k, v))
+                if bm is not None:
+                    bm[idx] = False
+        self._present[idx] = False
+        self._touch(idx)
         self.node_epoch += 1
         for arr in (
             self.nodes.allocatable,
@@ -514,6 +667,7 @@ class ClusterSnapshot:
         )
         self.nodes.metric_fresh[idx] = fresh
         self.nodes.has_metric[idx] = True
+        self._touch(idx)
         if fresh:
             for ap in self._assumed.values():
                 if (
@@ -600,6 +754,7 @@ class ClusterSnapshot:
             confirmed=confirmed,
             bind_nominal_cpu=bind_nominal,
         )
+        self._touch(idx)
         return True
 
     def assume_pods_bulk(
@@ -644,6 +799,7 @@ class ClusterSnapshot:
                 node_idxs[is_prod],
                 est_rows[is_prod],
             )
+        self.touch_rows(np.unique(node_idxs))
         assumed = self._assumed
         # one tolist per column: per-element numpy scalar indexing in a
         # 10k+ iteration loop costs ~1µs each; list(matrix) materializes
@@ -700,6 +856,7 @@ class ClusterSnapshot:
             self.nodes.assigned_pending[ap.node_idx] -= ap.estimate
             if ap.is_prod:
                 self.nodes.assigned_pending_prod[ap.node_idx] -= ap.estimate
+        self._touch(ap.node_idx)
 
     # ---- pod batch build ----
 

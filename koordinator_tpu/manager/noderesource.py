@@ -150,10 +150,21 @@ class NodeResourceController:
         batch, mid = self.calculate()
         na = self.snapshot.nodes
         updates: Dict[str, Dict[str, float]] = {}
+        cols = list(self._batch.values()) + list(self._mid.values())
+        before = na.allocatable[:, cols].copy() if cols else None
         for res, col in self._batch.items():
             na.allocatable[:, col] = batch[:, 0 if "cpu" in res else 1]
         for res, col in self._mid.items():
             na.allocatable[:, col] = mid[:, 0 if "cpu" in res else 1]
+        if before is not None:
+            # mark only the rows the rewrite actually moved — a steady
+            # reconcile must not wipe the device-resident NodeState's
+            # dirty-row scatter path with a blanket invalidation
+            changed = np.nonzero(
+                (na.allocatable[:, cols] != before).any(axis=1)
+            )[0]
+            if len(changed):
+                self.snapshot.touch_rows(changed)
         for name, idx in list(self.snapshot._node_index.items()):
             row: Dict[str, float] = {}
             for res, col in self._batch.items():

@@ -244,6 +244,32 @@ class PodBatch:
         )
 
 
+@jax.jit
+def scatter_rows(full, idx, rows):
+    """Refresh a device-resident node-axis pytree in place of a full
+    re-upload: ``full`` is any pytree of ``[N, ...]`` arrays (NodeState,
+    NumaState, DeviceState), ``idx`` [K] int32 the node rows to replace
+    and ``rows`` the matching pytree of ``[K, ...]`` row blocks. ``idx``
+    may carry duplicate entries (callers pad to a stable K for jit-cache
+    stability) as long as duplicates carry identical row data."""
+    return jax.tree.map(lambda f, r: f.at[idx].set(r), full, rows)
+
+
+@jax.jit
+def gather_rows(full, idx, valid):
+    """Sampled-window lowering ON DEVICE: gather ``idx`` [B] node rows out
+    of a resident full-axis pytree, zeroing rows where ``valid`` [B] is
+    False (padding rows then read schedulable=False and mask out, the same
+    contract the host-side pad-and-upload path provided)."""
+
+    def take(f):
+        out = f[idx]
+        v = valid.reshape((-1,) + (1,) * (out.ndim - 1))
+        return jnp.where(v, out, jnp.zeros_like(out))
+
+    return jax.tree.map(take, full)
+
+
 @struct.dataclass
 class QuotaState:
     """Device-side ElasticQuota accounting ([Q, D] each).
@@ -1168,6 +1194,7 @@ def solve_stream_full(
     approx_topk: bool = False,
     numa_scoring: "str | None" = None,
     device_scoring: "str | None" = None,
+    node_mask: "jnp.ndarray | None" = None,
 ):
     """Pipelined multi-chunk solve with the FULL constraint set: a
     ``lax.scan`` over a [C, P, ...] stacked :class:`PodBatch` threading
@@ -1176,7 +1203,12 @@ def solve_stream_full(
     device→host transfer per drain. On tunneled backends every program
     launch and every fetch costs a fixed round trip, so the per-chunk
     dispatch pipeline pays C× that overhead where this pays it once
-    (the per-chunk path remains for transformers/node-mask cases).
+    (the per-chunk path remains for transformers/cost-transform cases).
+
+    ``node_mask`` [C, P, N] bool (optional) carries per-chunk hard node
+    constraints (nodeSelector / required nodeAffinity / spec.nodeName)
+    through the scan — constrained chunks no longer force the per-chunk
+    dispatch path. None traces the mask out entirely.
 
     Returns ``(assignments [C, P], pod_zones [C, P], rounds [C])``.
     """
@@ -1200,7 +1232,8 @@ def solve_stream_full(
         dev_carry0 = None
     numa_carry0 = numa.zone_free if numa is not None else None
 
-    def step(carry, pb):
+    def step(carry, xs):
+        pb, chunk_mask = xs if node_mask is not None else (xs, None)
         cur, qused, dev_carry, numa_carry = carry
         res = assign(
             pb,
@@ -1218,6 +1251,7 @@ def solve_stream_full(
             topk=topk,
             nomination_jitter=nomination_jitter,
             approx_topk=approx_topk,
+            node_mask=chunk_mask,
             dev_carry=dev_carry,
             numa_carry=numa_carry,
             numa_scoring=numa_scoring,
@@ -1240,8 +1274,11 @@ def solve_stream_full(
             res.rounds_used,
         )
 
+    xs = (
+        pods_stacked if node_mask is None else (pods_stacked, node_mask)
+    )
     _final, (assignments, zones, rounds) = jax.lax.scan(
-        step, (nodes, quotas.used, dev_carry0, numa_carry0), pods_stacked
+        step, (nodes, quotas.used, dev_carry0, numa_carry0), xs
     )
     return assignments, zones, rounds
 

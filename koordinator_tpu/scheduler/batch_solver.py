@@ -33,6 +33,8 @@ from ..ops.solver import (
     SolverParams,
     SolveResult,
     assign,
+    gather_rows,
+    scatter_rows,
 )
 
 
@@ -311,6 +313,24 @@ class BatchScheduler:
         #: pod uid → consecutive preemption-skip count under a sampled
         #: window (anti-starvation bookkeeping for the headroom gate)
         self._preempt_skips: Dict[str, int] = {}
+        #: device-resident cluster state (perf tentpole): the full-axis
+        #: NodeState lives on device across cycles and is refreshed by a
+        #: jitted scatter of only the snapshot rows touched since the last
+        #: refresh (full re-lower only on bucket growth / reset / flag
+        #: change); the quota and NUMA/device tables carry the same
+        #: versioned-upload cache, and sampled windows are gathered on
+        #: device from the resident arrays instead of re-padded host-side
+        self._resident_nodes: Optional[NodeState] = None
+        self._resident_key: Optional[tuple] = None
+        self._resident_version: int = -1
+        #: (key, NodeState) for the last sampled-window gather
+        self._window_cache: Optional[tuple] = None
+        #: (key, QuotaState) / (key, NumaState) / (key, DeviceState)
+        self._quota_dev_cache: Optional[tuple] = None
+        self._numa_dev_cache: Optional[tuple] = None
+        self._device_dev_cache: Optional[tuple] = None
+        #: (key, (NumaState, DeviceState)) for the sampled-window gather
+        self._constraint_window_cache: Optional[tuple] = None
         #: multi-chip production mode: a jax.sharding.Mesh over ("dp",
         #: "tp") — pod rows shard on dp, node-axis tables on tp, and
         #: GSPMD inserts the ICI collectives inside the SAME jitted
@@ -369,12 +389,30 @@ class BatchScheduler:
         return window
 
     def node_state(self, sub: Optional[np.ndarray] = None) -> NodeState:
-        # NB: the amplified-CPU surcharge for exclusively-held cores
-        # (plugin.go:430-438) is charged by snapshot.assume_pod itself, so
-        # na.requested is already amplified-space for bound pods.
+        """Device-side NodeState over the full node axis (``sub`` None) or
+        a sampled window. The full-axis state is RESIDENT: it persists on
+        device across cycles and only the snapshot rows touched since the
+        last refresh are re-lowered and scattered in (a full re-lower
+        happens only on bucket growth, reset, or an args-flag change);
+        window states are gathered on device from the resident arrays."""
+        full = self._resident_node_state()
+        if sub is None:
+            return full
+        return self._window_node_state(full, sub)
+
+    def _node_state_rows(self, rows: Optional[np.ndarray]) -> NodeState:
+        """Host lowering of the derived NodeState blocks for ``rows``
+        (None = the whole node axis). The amplified-CPU surcharge for
+        exclusively-held cores (plugin.go:430-438) is charged by
+        snapshot.assume_pod itself, so na.requested is already
+        amplified-space for bound pods."""
         na = self.snapshot.nodes
-        est_used = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
-        schedulable = na.schedulable
+        sl = slice(None) if rows is None else rows
+        est_used = (
+            np.maximum(na.usage_agg[sl], na.usage_avg[sl])
+            + na.assigned_pending[sl]
+        )
+        schedulable = na.schedulable[sl]
         if (
             self.args.filter_expired_node_metrics
             and not self.args.enable_schedule_when_node_metrics_expired
@@ -382,29 +420,108 @@ class BatchScheduler:
             # strict expired-metric filtering (load_aware.go:143-149):
             # a node that HAS reported but went stale is unschedulable;
             # a never-reported node stays admitted (nil-NodeMetric path)
-            schedulable = schedulable & (na.metric_fresh | ~na.has_metric)
-        if sub is None:
-            take = jnp.asarray
-        else:
-            b = bucket_size(len(sub), self.snapshot.config.min_bucket)
-
-            def take(a, _b=b, _sub=sub):
-                # pad rows stay all-zero → schedulable False → masked out
-                out = np.zeros((_b,) + a.shape[1:], a.dtype)
-                out[: len(_sub)] = a[_sub]
-                return jnp.asarray(out)
-
+            schedulable = schedulable & (
+                na.metric_fresh[sl] | ~na.has_metric[sl]
+            )
         return NodeState(
-            allocatable=take(na.allocatable),
-            requested=take(na.requested),
-            estimated_used=take(est_used),
-            prod_used=take(na.prod_usage + na.assigned_pending_prod),
-            metric_fresh=take(na.metric_fresh),
-            schedulable=take(schedulable),
-            cpu_amp=take(na.cpu_amp),
-            custom_thresholds=take(na.custom_thresholds),
-            custom_prod_thresholds=take(na.custom_prod_thresholds),
+            allocatable=jnp.asarray(na.allocatable[sl]),
+            requested=jnp.asarray(na.requested[sl]),
+            estimated_used=jnp.asarray(est_used),
+            prod_used=jnp.asarray(
+                na.prod_usage[sl] + na.assigned_pending_prod[sl]
+            ),
+            metric_fresh=jnp.asarray(na.metric_fresh[sl]),
+            schedulable=jnp.asarray(schedulable),
+            cpu_amp=jnp.asarray(na.cpu_amp[sl]),
+            custom_thresholds=jnp.asarray(na.custom_thresholds[sl]),
+            custom_prod_thresholds=jnp.asarray(na.custom_prod_thresholds[sl]),
         )
+
+    def _resident_node_state(self) -> NodeState:
+        snap = self.snapshot
+        reg = self.extender.registry
+        tr = self.extender.tracer
+        with snap.lock:
+            n_bucket = snap.nodes.allocatable.shape[0]
+            key = (
+                n_bucket,
+                self.args.filter_expired_node_metrics,
+                self.args.enable_schedule_when_node_metrics_expired,
+            )
+            cur = self._resident_nodes
+            if cur is not None and key == self._resident_key:
+                if snap.version == self._resident_version:
+                    reg.get("solver_state_cache_hits_total").labels(
+                        table="nodes"
+                    ).inc()
+                    return cur
+                rows = snap.drain_dirty(owner=id(self))
+                if rows is not None and 0 < len(rows) <= n_bucket // 2:
+                    # pad the dirty index vector to a power of two (min 8)
+                    # so the scatter jit-cache stays tiny; duplicate
+                    # indices carry identical row data, so the .set is
+                    # well-defined
+                    b = max(8, 1 << (len(rows) - 1).bit_length())
+                    idx = np.empty((b,), np.int32)
+                    idx[: len(rows)] = rows
+                    idx[len(rows) :] = rows[-1]
+                    with tr.span(
+                        "snapshot:node_scatter",
+                        cat="scheduler",
+                        dirty=len(rows),
+                        uploaded=b,
+                    ):
+                        blocks = self._node_state_rows(idx)
+                        new = scatter_rows(cur, jnp.asarray(idx), blocks)
+                    reg.get("solver_h2d_rows_total").inc(float(b))
+                    reg.get("solver_state_cache_hits_total").labels(
+                        table="nodes"
+                    ).inc()
+                    self._resident_nodes = new
+                    self._resident_version = snap.version
+                    return new
+                # too many dirty rows / structural change: fall through
+            else:
+                # bucket or flag change: stale marks are meaningless for
+                # the rebuilt mirror
+                snap.drain_dirty(owner=id(self))
+            with tr.span(
+                "snapshot:node_full_lower", cat="scheduler", uploaded=n_bucket
+            ):
+                new = self._node_state_rows(None)
+            reg.get("solver_h2d_rows_total").inc(float(n_bucket))
+            self._resident_nodes = new
+            self._resident_key = key
+            self._resident_version = snap.version
+            return new
+
+    def _window_node_state(self, full: NodeState, sub: np.ndarray) -> NodeState:
+        """Sampled-window NodeState, gathered ON DEVICE from the resident
+        full-axis arrays and memoized on (window, snapshot version) — the
+        scanned and pipelined dispatches both ask for it within a cycle,
+        and an unmoved window across cycles re-uses the gather outright."""
+        reg = self.extender.registry
+        b = bucket_size(len(sub), self.snapshot.config.min_bucket)
+        # _resident_key rides along: an args-flag change full-relowers the
+        # resident state WITHOUT bumping snap.version, and the window must
+        # not outlive it
+        key = (self._resident_version, self._resident_key, b, sub.tobytes())
+        cached = self._window_cache
+        if cached is not None and cached[0] == key:
+            reg.get("solver_state_cache_hits_total").labels(
+                table="nodes_window"
+            ).inc()
+            return cached[1]
+        idx = np.zeros((b,), np.int32)
+        idx[: len(sub)] = sub
+        valid = np.zeros((b,), bool)
+        valid[: len(sub)] = True
+        with self.extender.tracer.span(
+            "snapshot:window_gather", cat="scheduler", window=len(sub)
+        ):
+            out = gather_rows(full, jnp.asarray(idx), jnp.asarray(valid))
+        self._window_cache = (key, out)
+        return out
 
     def _map_assignment(
         self, assignment: np.ndarray, sub: Optional[np.ndarray]
@@ -951,7 +1068,7 @@ class BatchScheduler:
                         seen_skips = self._preempt_skips.get(uid, 0) + 1
                         if seen_skips < rotation:
                             if len(self._preempt_skips) > 100_000:
-                                self._preempt_skips.clear()
+                                self._trim_preempt_skips()
                             self._preempt_skips[uid] = seen_skips
                             continue
                         self._preempt_skips.pop(uid, None)
@@ -1078,6 +1195,25 @@ class BatchScheduler:
             rounds_used=rounds,
             preempted=preempted,
         )
+
+    def _trim_preempt_skips(self) -> None:
+        """Evict the OLDEST half of the preemption-skip ledger when it
+        overflows. A wholesale ``.clear()`` here reset the window-rotation
+        fairness clock for EVERY pending pod at once — each one restarted
+        its full-rotation wait and preemption stalled cluster-wide; dicts
+        preserve insertion order and re-assignment keeps a key's slot, so
+        the first half really is the longest-tracked half. Trade-off: the
+        longest-tracked entries carry the most accumulated progress, but
+        at >100k tracked pods they are also the likeliest to be stale
+        uids of pods long since bound or deleted (nothing else prunes
+        this dict), so age-first eviction sheds garbage before progress
+        — and a live evicted pod merely re-earns its rotation instead of
+        the whole cluster losing its clock."""
+        from itertools import islice
+
+        drop = max(len(self._preempt_skips) // 2, 1)
+        for uid in list(islice(self._preempt_skips, drop)):
+            del self._preempt_skips[uid]
 
     def node_allowed(self, pod: Pod, node_name: str) -> bool:
         """Single-node form of the node-constraint mask (nodeSelector /
@@ -1337,37 +1473,65 @@ class BatchScheduler:
         a single program launch and 1-2 device→host transfers per drain.
         On tunneled backends each launch/fetch costs a fixed round trip,
         which made the per-chunk pipeline's wall scale with chunk count
-        regardless of compute. Returns the same (chunk, rows, result)
-        shape with host-side results, or None when the cycle needs the
-        per-chunk path (mesh mode, batch transformers, or hard node
-        constraints that lower per-chunk [P, N] masks)."""
+        regardless of compute. Chunks carrying hard node constraints
+        (nodeSelector / affinity / nodeName) thread their lowered
+        [C, P, N] masks through the scan rather than forcing the
+        per-chunk path. Returns the same (chunk, rows, result) shape with
+        host-side results, or None when the cycle needs the per-chunk
+        path (mesh mode or batch/cost transformers)."""
         if self.mesh is not None:
             return None
         ex = self.extender
         if ex._batch_transformers or ex.cost_transform is not None:
             return None
-        for chunk in chunks:
-            if any(
-                p.spec.node_selector
-                or p.spec.affinity_required_nodes
-                or p.spec.node_name
-                for p in chunk
-            ):
+        bucket = max(
+            bucket_size(len(c), self.snapshot.config.min_bucket)
+            for c in chunks
+        )
+        if any(
+            p.spec.node_selector
+            or p.spec.affinity_required_nodes
+            or p.spec.node_name
+            for c in chunks
+            for p in c
+        ):
+            # constrained chunks thread a dense [C, P, N] bool mask
+            # through the scan (all-ones rows for unconstrained pods).
+            # Bound its footprint: past ~256 MiB the stacked mask would
+            # dominate H2D (or blow device memory), and the per-chunk
+            # path — one [P, N] mask in flight at a time — is the better
+            # trade there.
+            if sub is not None:
+                n_mask = bucket_size(len(sub), self.snapshot.config.min_bucket)
+            else:
+                n_mask = self.snapshot.nodes.allocatable.shape[0]
+            c_bucket_est = 1 << (len(chunks) - 1).bit_length()
+            if c_bucket_est * bucket * n_mask > (256 << 20):
                 return None
         from ..ops.solver import solve_stream_full
 
         quotas0 = self.quota_state([p for c in chunks for p in c])
         numa_state, device_state = self._constraint_states(sub)
         nodes0 = self.node_state(sub)
-        bucket = max(
-            bucket_size(len(c), self.snapshot.config.min_bucket)
-            for c in chunks
-        )
+        n_axis = nodes0.allocatable.shape[0]
         pods_list: List[PodBatch] = []
         rows_list: List[LoweredRows] = []
+        masks_list: List[Optional[jnp.ndarray]] = []
         for chunk in chunks:
             pods_list.append(self.pod_batch(chunk, bucket=bucket))
             rows_list.append(self._lowered)
+            masks_list.append(
+                self._node_constraint_mask(chunk, bucket, sub)
+            )
+        if any(m is not None for m in masks_list):
+            ones = None
+            for k, m in enumerate(masks_list):
+                if m is None:
+                    if ones is None:
+                        ones = jnp.ones((bucket, n_axis), bool)
+                    masks_list[k] = ones
+        else:
+            masks_list = None
         # bucket the CHUNK axis too (next power of two): a drifting
         # backlog would otherwise retrace the scanned program for every
         # distinct chunk count. Padding chunks are all-invalid, so their
@@ -1377,7 +1541,14 @@ class BatchScheduler:
         if c_bucket > c_real:
             empty = jax.tree.map(jnp.zeros_like, pods_list[0])
             pods_list.extend([empty] * (c_bucket - c_real))
+            if masks_list is not None:
+                if ones is None:
+                    ones = jnp.ones((bucket, n_axis), bool)
+                masks_list.extend([ones] * (c_bucket - c_real))
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *pods_list)
+        mask_stacked = (
+            jnp.stack(masks_list) if masks_list is not None else None
+        )
         with self.extender.tracer.span(
             "assign", cat="scheduler", mode="scanned", chunks=c_real
         ):
@@ -1392,6 +1563,7 @@ class BatchScheduler:
                 approx_topk=True,
                 numa_scoring=self._numa_scoring(),
                 device_scoring=self._device_scoring(),
+                node_mask=mask_stacked,
             )
             host_a = np.asarray(assignments)
             host_z = (
@@ -1537,52 +1709,119 @@ class BatchScheduler:
 
     def _constraint_states(self, sub: Optional[np.ndarray] = None):
         """Lower the NUMA zone table and GPU slot table for the solver
-        (None for whichever manager is absent/empty). ``sub`` restricts
-        the node axis to the cycle's sampled window."""
-        if sub is None:
-            def take(a):
-                return jnp.asarray(a)
-        else:
-            b = bucket_size(len(sub), self.snapshot.config.min_bucket)
-
-            def take(a, _b=b, _sub=sub):
-                out = np.zeros((_b,) + a.shape[1:], np.asarray(a).dtype)
-                out[: len(_sub)] = np.asarray(a)[_sub]
-                return jnp.asarray(out)
-
+        (None for whichever manager is absent/empty). Both uploads are
+        versioned on their manager's lowered_version — an unchanged table
+        re-uses the device-resident copy outright — and ``sub`` windows
+        are gathered on device from the resident full-axis arrays."""
         numa_state = None
         if self.numa is not None and self.numa.has_topology:
-            from ..ops.numa import NumaState
-
-            zone_free, zone_cap, policy = self.numa.arrays()
-            numa_state = NumaState(
-                zone_free=take(zone_free),
-                zone_cap=take(zone_cap),
-                policy=take(policy),
-                zone_most=take(self.numa.most_allocated_rows()),
-            )
+            numa_state = self._resident_numa_state()
         device_state = None
         if self.devices is not None and self.devices.has_devices:
-            from ..ops.device import DeviceState
+            device_state = self._resident_device_state()
+        if sub is None or (numa_state is None and device_state is None):
+            return numa_state, device_state
+        reg = self.extender.registry
+        b = bucket_size(len(sub), self.snapshot.config.min_bucket)
+        key = (
+            self.numa.lowered_version if numa_state is not None else None,
+            self.devices.lowered_version if device_state is not None else None,
+            b,
+            sub.tobytes(),
+        )
+        cached = self._constraint_window_cache
+        if cached is not None and cached[0] == key:
+            reg.get("solver_state_cache_hits_total").labels(
+                table="constraints_window"
+            ).inc()
+            return cached[1]
+        idx = np.zeros((b,), np.int32)
+        idx[: len(sub)] = sub
+        valid = np.zeros((b,), bool)
+        valid[: len(sub)] = True
+        idx_d, valid_d = jnp.asarray(idx), jnp.asarray(valid)
+        with self.extender.tracer.span(
+            "snapshot:constraint_window_gather", cat="scheduler",
+            window=len(sub),
+        ):
+            if numa_state is not None:
+                numa_state = gather_rows(numa_state, idx_d, valid_d)
+            if device_state is not None:
+                device_state = gather_rows(device_state, idx_d, valid_d)
+        self._constraint_window_cache = (key, (numa_state, device_state))
+        return numa_state, device_state
 
-            # GPU-only clusters trace the RDMA/FPGA feasibility, carry
-            # and prefix checks OUT of the solver entirely (None pytree
-            # leaves are static structure)
-            device_state = DeviceState(
-                slot_free=take(self.devices.slot_array()),
+    def _resident_numa_state(self):
+        """Device-resident full-axis NUMA zone table, re-uploaded only
+        when the manager's lowering actually changed."""
+        from ..ops.numa import NumaState
+
+        reg = self.extender.registry
+        zone_free, zone_cap, policy = self.numa.arrays()
+        most = self.numa.most_allocated_rows()
+        key = (self.numa.lowered_version, zone_free.shape)
+        cached = self._numa_dev_cache
+        if cached is not None and cached[0] == key:
+            reg.get("solver_state_cache_hits_total").labels(
+                table="numa"
+            ).inc()
+            return cached[1]
+        with self.extender.tracer.span(
+            "snapshot:numa_lower", cat="scheduler",
+            uploaded=zone_free.shape[0],
+        ):
+            state = NumaState(
+                zone_free=jnp.asarray(zone_free),
+                zone_cap=jnp.asarray(zone_cap),
+                policy=jnp.asarray(policy),
+                zone_most=jnp.asarray(most),
+            )
+        reg.get("solver_h2d_rows_total").inc(float(zone_free.shape[0]))
+        self._numa_dev_cache = (key, state)
+        return state
+
+    def _resident_device_state(self):
+        """Device-resident full-axis GPU slot table (+ RDMA/FPGA counts),
+        re-uploaded only when the manager's lowering actually changed."""
+        from ..ops.device import DeviceState
+
+        reg = self.extender.registry
+        slots = self.devices.slot_array()
+        # GPU-only clusters trace the RDMA/FPGA feasibility, carry
+        # and prefix checks OUT of the solver entirely (None pytree
+        # leaves are static structure)
+        key = (
+            self.devices.lowered_version,
+            slots.shape,
+            self.devices.has_rdma,
+            self.devices.has_fpga,
+        )
+        cached = self._device_dev_cache
+        if cached is not None and cached[0] == key:
+            reg.get("solver_state_cache_hits_total").labels(
+                table="device"
+            ).inc()
+            return cached[1]
+        with self.extender.tracer.span(
+            "snapshot:device_lower", cat="scheduler", uploaded=slots.shape[0]
+        ):
+            state = DeviceState(
+                slot_free=jnp.asarray(slots),
                 rdma_free=(
-                    take(self.devices.rdma_array())
+                    jnp.asarray(self.devices.rdma_array())
                     if self.devices.has_rdma
                     else None
                 ),
                 fpga_free=(
-                    take(self.devices.fpga_array())
+                    jnp.asarray(self.devices.fpga_array())
                     if self.devices.has_fpga
                     else None
                 ),
-                cap_total=take(self.devices.cap_array()),
+                cap_total=jnp.asarray(self.devices.cap_array()),
             )
-        return numa_state, device_state
+        reg.get("solver_h2d_rows_total").inc(float(slots.shape[0]))
+        self._device_dev_cache = (key, state)
+        return state
 
     def solve(
         self, chunk: Sequence[Pod], sub: Optional[np.ndarray] = None
@@ -1648,50 +1887,50 @@ class BatchScheduler:
         required nodeAffinity names / spec.nodeName — the upstream
         NodeAffinity+NodeName Filter plugins' semantics); None when no pod
         in the chunk has any, so the solver traces the mask out."""
-        if not any(
-            p.spec.node_selector or p.spec.affinity_required_nodes or p.spec.node_name
-            for p in chunk
-        ):
+        host = self._node_constraint_mask_host(chunk, p_bucket)
+        if host is None:
             return None
         if sub is not None:
             # build over the full axis, then slice the sampled window
-            full = self._node_constraint_mask(chunk, p_bucket, None)
             b = bucket_size(len(sub), self.snapshot.config.min_bucket)
             out = np.zeros((p_bucket, b), bool)
-            out[:, : len(sub)] = np.asarray(full)[:, sub]
+            out[:, : len(sub)] = host[:, sub]
             return jnp.asarray(out)
-        n_bucket = self.snapshot.nodes.allocatable.shape[0]
+        return jnp.asarray(host)
+
+    def _node_constraint_mask_host(
+        self, chunk: Sequence[Pod], p_bucket: int
+    ) -> Optional[np.ndarray]:
+        """Host build of the constraint mask, vectorized over the node
+        axis: each constrained pod's row is an AND of cached label→row
+        bitmaps (plus a name scatter for nodeName/affinity lists) from the
+        snapshot's inverted index — the former per-pod × per-node label
+        walk was the constrained scenarios' dominant lowering cost."""
+        specs = [p.spec for p in chunk]
+        if not any(
+            s.node_selector or s.affinity_required_nodes or s.node_name
+            for s in specs
+        ):
+            return None
+        snap = self.snapshot
+        n_bucket = snap.nodes.allocatable.shape[0]
         mask = np.ones((p_bucket, n_bucket), bool)
-        names: List[Optional[str]] = [None] * n_bucket
-        for i in range(self.snapshot.nodes.n_real):
-            try:
-                names[i] = self.snapshot.node_name(i)
-            except IndexError:
-                pass
-        for i, pod in enumerate(chunk):
-            spec = pod.spec
-            if not (
-                spec.node_selector or spec.affinity_required_nodes or spec.node_name
-            ):
-                continue
-            row = np.zeros((n_bucket,), bool)
-            allowed_names = None
-            if spec.node_name:
-                allowed_names = {spec.node_name}
-            elif spec.affinity_required_nodes is not None:
-                allowed_names = set(spec.affinity_required_nodes)
-            for j, name in enumerate(names):
-                if name is None:
-                    continue
-                if allowed_names is not None and name not in allowed_names:
-                    continue
-                labels = self.snapshot.node_labels(name)
-                if all(
-                    labels.get(k) == v for k, v in spec.node_selector.items()
+        with self.extender.tracer.span(
+            "lower:node_mask", cat="scheduler", pods=len(chunk)
+        ):
+            for i, spec in enumerate(specs):
+                if not (
+                    spec.node_selector
+                    or spec.affinity_required_nodes
+                    or spec.node_name
                 ):
-                    row[j] = True
-            mask[i] = row
-        return jnp.asarray(mask)
+                    continue
+                mask[i] = snap.constraint_row(
+                    node_name=spec.node_name,
+                    affinity_names=spec.affinity_required_nodes,
+                    selector=spec.node_selector,
+                )
+        return mask
 
     def quota_state(self, chunk: Sequence[Pod]) -> Optional[QuotaState]:
         """Lowered QuotaState, or None when no quota tree exists (the solver
@@ -1752,12 +1991,27 @@ class BatchScheduler:
                 if idx is not None and idx < self.quotas.nonpre_requests.shape[0]:
                     self.quotas.nonpre_requests[idx] += vec
         runtime, used = self.quotas.quota_arrays_extended()
+        reg = self.extender.registry
+        key = (self.quotas.state_version, runtime.shape)
+        cached = self._quota_dev_cache
+        if cached is not None and cached[0] == key:
+            reg.get("solver_state_cache_hits_total").labels(
+                table="quota"
+            ).inc()
+            return cached[1]
         if runtime.shape[0] == 1:
             # pad: Q == 1 is reserved as the disabled sentinel
             pad = np.zeros((1, runtime.shape[1]), np.float32)
             runtime = np.concatenate([runtime, pad])
             used = np.concatenate([used, pad])
-        return QuotaState(runtime=jnp.asarray(runtime), used=jnp.asarray(used))
+        with self.extender.tracer.span(
+            "snapshot:quota_lower", cat="scheduler", quotas=runtime.shape[0]
+        ):
+            state = QuotaState(
+                runtime=jnp.asarray(runtime), used=jnp.asarray(used)
+            )
+        self._quota_dev_cache = (key, state)
+        return state
 
     def _estimate_of(self, pod: Pod) -> np.ndarray:
         """One estimate per pod everywhere — solver gating, Reserve commit

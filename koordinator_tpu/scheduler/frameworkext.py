@@ -93,6 +93,17 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "pods rejected, attributed to the killing stage/plugin/reason",
         labels=("stage", "plugin", "reason"),
     )
+    reg.counter(
+        "solver_h2d_rows_total",
+        "node-axis rows uploaded to device for solver state (full "
+        "re-lowers plus dirty-row scatters plus table uploads)",
+    )
+    reg.counter(
+        "solver_state_cache_hits_total",
+        "solver state lowerings served from the device-resident cache "
+        "without a host re-lower/upload",
+        labels=("table",),
+    )
     return reg
 
 
@@ -509,6 +520,8 @@ class SchedulerAdapter:
         idx = self.snapshot.node_id(node_name)
         if idx is not None:
             self.snapshot.nodes.metric_fresh[idx] = False
+            # direct array poke: the device-resident NodeState must see it
+            self.snapshot.touch_rows([idx])
 
 
 # ---------------------------------------------------------------------------

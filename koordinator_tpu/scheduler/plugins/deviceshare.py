@@ -149,6 +149,10 @@ class DeviceManager:
         self._low_epoch: int = -1
         self._low_g: int = 0
         self._low_dirty: set = set()
+        #: bumped whenever _lowered() actually changes the cached arrays
+        #: (full rebuild or a dirty-row flush) — the scheduler keys its
+        #: device-resident DeviceState upload off it
+        self.lowered_version = 0
         #: widest GPU inventory ever ingested (monotone — shrink keeps
         #: harmless zero columns) so _lowered() needn't rescan every node
         self._max_minors: int = 0
@@ -213,10 +217,12 @@ class DeviceManager:
             self._low_dirty = set()
             for name in self._nodes:
                 self._refresh_row(name)
+            self.lowered_version += 1
         elif self._low_dirty:
             for name in self._low_dirty:
                 self._refresh_row(name)
             self._low_dirty = set()
+            self.lowered_version += 1
         return self._low
 
     def upsert_device(self, device: Device) -> None:

@@ -155,6 +155,11 @@ class GroupQuotaManager:
         #: non-preemptible pods' rolled-up requests (status stamping)
         self.nonpre_requests = np.zeros((1, d), np.float32)
         self._dirty = True
+        #: bumped whenever the SOLVER-VISIBLE tables (runtime / used /
+        #: nonpre_used / mins) actually change — the scheduler keys its
+        #: device-resident QuotaState upload off it, so a cycle whose
+        #: quota accounting didn't move re-uses the resident copy
+        self.state_version = 0
         #: memoized leaf-to-root index paths; rebuilt on tree mutations
         #: (chain_of was a visible slice of the per-winner commit loop)
         self._chain_cache: Dict[str, List[int]] = {}
@@ -197,6 +202,7 @@ class GroupQuotaManager:
             if (onode.quota.parent or ROOT) == name and other not in node.children:
                 node.children.append(other)
         self._dirty = True
+        self.state_version += 1
         self._chain_cache.clear()
         self._chain_row_cache.clear()
 
@@ -237,6 +243,7 @@ class GroupQuotaManager:
         self.nonpre_used = new_nonpre
         self.nonpre_requests = new_nonpre_req
         self._dirty = True
+        self.state_version += 1
 
     def set_cluster_total(self, total: Mapping[str, float]) -> None:
         """Explicit capacity budget (the multi-tree handler gives each tree
@@ -305,6 +312,7 @@ class GroupQuotaManager:
                 grown = np.zeros((q, d), np.float32)
                 grown[: arr.shape[0]] = arr
                 setattr(self, attr, grown)
+                self.state_version += 1
 
     def has_headroom(
         self,
@@ -350,6 +358,8 @@ class GroupQuotaManager:
             # leaf-only ledger: admission checks min at the LEAF
             # (plugin.go:252-262); parents roll up at stamping time
             self.nonpre_used[chain[0]] += vec
+        if chain:
+            self.state_version += 1
 
     def refund(
         self,
@@ -366,6 +376,8 @@ class GroupQuotaManager:
             self.nonpre_used[chain[0]] = np.maximum(
                 self.nonpre_used[chain[0]] - vec, 0.0
             )
+        if chain:
+            self.state_version += 1
 
     def reset_usage(self) -> None:
         """Zero all used charges and assigned-pod records (full-resync
@@ -374,6 +386,7 @@ class GroupQuotaManager:
         self.nonpre_used[:] = 0.0
         self._assigned.clear()
         self._dirty = True
+        self.state_version += 1
 
     def assign_pod(
         self,
@@ -431,6 +444,7 @@ class GroupQuotaManager:
             self.used[heads[real]] += sums[real]
         if (~real).any():
             self.nonpre_used[heads[~real] - q] += sums[~real]
+        self.state_version += 1
 
     def unassign_pod(self, quota_name: str, pod: "Pod") -> None:
         if self._assigned.get(quota_name, {}).pop(pod.meta.uid, None) is not None:
@@ -529,6 +543,13 @@ class GroupQuotaManager:
             n for n in self._order if (self._nodes[n].quota.parent or ROOT) == ROOT
         ]
         self._fill_level(roots, self._cluster_total, runtime)
+        if runtime.shape != self.runtime.shape or not np.array_equal(
+            runtime, self.runtime
+        ):
+            # only a VALUE change invalidates the device-resident quota
+            # table — steady-state refreshes (same demand, same capacity)
+            # keep the resident copy valid
+            self.state_version += 1
         self.runtime = runtime
         self._dirty = False
         return runtime

@@ -109,6 +109,10 @@ class NUMAManager:
         self._zone_epoch = -1
         self._zone_dirty: set = set()
         self._amp_seen: Optional[np.ndarray] = None
+        #: bumped whenever arrays() actually changes the lowered zone
+        #: tables (full rebuild or a dirty-row flush) — the scheduler keys
+        #: its device-resident NumaState upload off it
+        self.lowered_version = 0
 
     def _mark_dirty(self, node_name: str) -> None:
         if self._zone_cache is not None:
@@ -322,6 +326,7 @@ class NUMAManager:
             for name in self._nodes:
                 self._refresh_zone_row(name)
             self._amp_seen = amp.copy()
+            self.lowered_version += 1
         else:
             if self._amp_seen is None or not np.array_equal(
                 self._amp_seen, amp
@@ -346,6 +351,7 @@ class NUMAManager:
                 for name in self._zone_dirty:
                     self._refresh_zone_row(name)
                 self._zone_dirty = set()
+                self.lowered_version += 1
         return self._zone_cache[:3]
 
     def most_allocated_rows(self) -> np.ndarray:

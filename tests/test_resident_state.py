@@ -1,0 +1,282 @@
+"""Incremental device-resident state correctness: a longrun-style loop
+that mutates the cluster through every write path (assume/forget, metric
+ingest, node churn, quota charges, NUMA/device allocations) and every K
+cycles asserts the device-resident NodeState / quota table / zone and
+slot tables are BIT-EXACTLY what a from-scratch re-lower of the host
+snapshot would produce — a missed dirty mark anywhere shows up here as a
+stale resident row."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Device,
+    DeviceInfo,
+    ElasticQuota,
+    Node,
+    NodeMetric,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceMetric,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.core.topology import CPUTopology
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+from koordinator_tpu.scheduler.plugins.elasticquota import GroupQuotaManager
+from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+    NUMAManager,
+    NUMAPolicy,
+)
+
+
+def _add_node(snap, numa, dm, topo, name):
+    snap.upsert_node(
+        Node(
+            meta=ObjectMeta(name=name),
+            status=NodeStatus(
+                allocatable={ext.RES_CPU: 32000, ext.RES_MEMORY: 131072}
+            ),
+        )
+    )
+    numa.register_node(
+        name, topo, NUMAPolicy.SINGLE_NUMA_NODE, memory_per_zone_mib=65536
+    )
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name=name),
+            devices=[
+                DeviceInfo(dev_type="gpu", minor=g, numa_node=g % 2)
+                for g in range(4)
+            ],
+        )
+    )
+
+
+def _build():
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    dm = DeviceManager(snap)
+    topo = CPUTopology.uniform(sockets=2, numa_per_socket=1, cores_per_numa=8)
+    for i in range(40):
+        _add_node(snap, numa, dm, topo, f"n{i:03d}")
+    gqm = GroupQuotaManager(
+        snap.config,
+        cluster_total={ext.RES_CPU: 32000 * 40, ext.RES_MEMORY: 131072 * 40},
+    )
+    gqm.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="team-a"),
+            min={ext.RES_CPU: 100_000, ext.RES_MEMORY: 1 << 19},
+            max={ext.RES_CPU: 600_000, ext.RES_MEMORY: 2 << 20},
+        )
+    )
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), quotas=gqm, numa=numa, devices=dm,
+        batch_bucket=128,
+    )
+    sched.extender.monitor.stop_background()
+    return sched, topo
+
+
+def _wave(rng, cycle, n):
+    pods = []
+    for i in range(n):
+        kind = rng.integers(0, 4)
+        meta = ObjectMeta(name=f"c{cycle}-p{i:03d}")
+        spec = PodSpec(
+            requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 2048}, priority=9000
+        )
+        if kind == 0:
+            meta.labels[ext.LABEL_POD_QOS] = "LSR"
+            spec.requests[ext.RES_CPU] = 2000
+        elif kind == 1:
+            spec.requests[ext.RES_GPU] = 1
+        elif kind == 2:
+            meta.labels[ext.LABEL_QUOTA_NAME] = "team-a"
+        pods.append(Pod(meta=meta, spec=spec))
+    return pods
+
+
+def _assert_resident_equals_full(sched):
+    """Bit-exact: resident device state vs a from-scratch host lowering."""
+    snap = sched.snapshot
+    na = snap.nodes
+    ns = sched.node_state()  # refreshes the resident state first
+    est = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
+    sched_rows = na.schedulable
+    if (
+        sched.args.filter_expired_node_metrics
+        and not sched.args.enable_schedule_when_node_metrics_expired
+    ):
+        sched_rows = sched_rows & (na.metric_fresh | ~na.has_metric)
+    for got, want in (
+        (ns.allocatable, na.allocatable),
+        (ns.requested, na.requested),
+        (ns.estimated_used, est),
+        (ns.prod_used, na.prod_usage + na.assigned_pending_prod),
+        (ns.metric_fresh, na.metric_fresh),
+        (ns.schedulable, sched_rows),
+        (ns.cpu_amp, na.cpu_amp),
+        (ns.custom_thresholds, na.custom_thresholds),
+        (ns.custom_prod_thresholds, na.custom_prod_thresholds),
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # quota used table (rows 0..Q-1 real, Q..2Q-1 non-preemptible shadow):
+    # the resident copy refreshes at the next quota_state() call, so the
+    # contract is versioned invalidation — an UNCHANGED state_version must
+    # mean the resident table still equals the live one (a charge that
+    # forgot to bump the version would fail here)
+    if sched._quota_dev_cache is not None:
+        key = sched._quota_dev_cache[0]
+        _runtime, used = sched.quotas.quota_arrays_extended()
+        if key[0] == sched.quotas.state_version:
+            cached = np.asarray(sched._quota_dev_cache[1].used)
+            np.testing.assert_array_equal(cached[: used.shape[0]], used)
+    # NUMA zone table + GPU slot tables vs the managers' live host arrays
+    numa_state, dev_state = sched._constraint_states()
+    zone_free, zone_cap, policy = sched.numa.arrays()
+    np.testing.assert_array_equal(np.asarray(numa_state.zone_free), zone_free)
+    np.testing.assert_array_equal(np.asarray(numa_state.zone_cap), zone_cap)
+    np.testing.assert_array_equal(np.asarray(numa_state.policy), policy)
+    np.testing.assert_array_equal(
+        np.asarray(dev_state.slot_free), sched.devices.slot_array()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dev_state.cap_total), sched.devices.cap_array()
+    )
+
+
+def test_incremental_resident_state_matches_full_relower():
+    rng = np.random.default_rng(42)
+    sched, topo = _build()
+    snap = sched.snapshot
+    bound_pool = []
+    for cycle in range(9):
+        out = sched.schedule(_wave(rng, cycle, 48))
+        bound_pool.extend(out.bound)
+        # metric ingest for a random node subset (absorbs pending charges)
+        import time as _t
+
+        now = _t.time()
+        for idx in rng.choice(snap.node_count, size=8, replace=False):
+            name = snap.node_name(int(idx))
+            if snap.node_id(name) is None:
+                continue
+            snap.set_node_metric(
+                NodeMetric(
+                    meta=ObjectMeta(name=name),
+                    node_usage=ResourceMetric(
+                        usage={
+                            ext.RES_CPU: float(rng.integers(1000, 16000)),
+                            ext.RES_MEMORY: float(rng.integers(4096, 65536)),
+                        }
+                    ),
+                    update_time=now,
+                ),
+                now=now + 1,
+            )
+        # forget/evict a few bound pods (releases quota/NUMA/device holds)
+        rng.shuffle(bound_pool)
+        for _ in range(min(6, len(bound_pool))):
+            pod, _node = bound_pool.pop()
+            sched.evict_for_preemption(pod)
+        if cycle == 4:
+            # topology change mid-run: bucket-stable node add + a removal
+            _add_node(snap, sched.numa, sched.devices, topo, f"late{cycle}")
+            victim = snap.node_name(0)
+            sched.numa.unregister_node(victim)
+            sched.devices.remove_device(victim)
+            snap.remove_node(victim)
+        if cycle % 3 == 2:
+            _assert_resident_equals_full(sched)
+    _assert_resident_equals_full(sched)
+    reg = sched.extender.registry
+    hits = reg.get("solver_state_cache_hits_total")
+    total_hits = sum(
+        hits.value(table=t) for t in ("nodes", "quota", "numa", "device")
+    )
+    assert total_hits > 0, "resident-state cache never hit"
+    # uploads must be FAR below one full node-axis re-lower per refresh
+    n_bucket = snap.nodes.allocatable.shape[0]
+    h2d = reg.get("solver_h2d_rows_total").value()
+    assert h2d > 0
+
+
+def test_dirty_scatter_uploads_only_touched_rows():
+    """A small mutation between cycles must refresh the resident NodeState
+    via the dirty-row scatter (a handful of padded rows), not a full
+    node-axis re-lower."""
+    sched, _topo = _build()
+    snap = sched.snapshot
+    reg = sched.extender.registry
+    sched.node_state()  # initial full lower
+    n_bucket = snap.nodes.allocatable.shape[0]
+    h2d0 = reg.get("solver_h2d_rows_total").value()
+    pod = Pod(
+        meta=ObjectMeta(name="s0"),
+        spec=PodSpec(requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 512}),
+    )
+    assert snap.assume_pod(pod, snap.node_name(7))
+    ns = sched.node_state()
+    uploaded = reg.get("solver_h2d_rows_total").value() - h2d0
+    assert 0 < uploaded < n_bucket, uploaded
+    np.testing.assert_array_equal(
+        np.asarray(ns.requested), snap.nodes.requested
+    )
+
+
+def test_node_state_window_memoized():
+    sched, _topo = _build()
+    snap = sched.snapshot
+    sub = np.arange(16, dtype=np.int32)
+    a = sched.node_state(sub)
+    b = sched.node_state(sub)
+    assert a is b, "unchanged (window, version) must re-use the gather"
+    # the gathered window must equal the host-side pad-and-slice lowering
+    na = snap.nodes
+    est = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
+    got = np.asarray(a.estimated_used)
+    assert got.shape[0] >= len(sub)
+    np.testing.assert_array_equal(got[: len(sub)], est[sub])
+    assert not np.asarray(a.schedulable)[len(sub) :].any()
+    # a mutation invalidates: the next call re-gathers fresh values
+    pod = Pod(
+        meta=ObjectMeta(name="w0"),
+        spec=PodSpec(requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 512}),
+    )
+    assert snap.assume_pod(pod, snap.node_name(3))
+    c = sched.node_state(sub)
+    assert c is not a
+    np.testing.assert_array_equal(
+        np.asarray(c.requested)[: len(sub)], na.requested[sub]
+    )
+
+
+def test_window_cache_invalidated_by_flag_change():
+    """An args-flag change full-relowers the resident state WITHOUT a
+    snapshot mutation — the memoized window gather must not outlive it."""
+    sched, _topo = _build()
+    sub = np.arange(16, dtype=np.int32)
+    a = sched.node_state(sub)
+    sched.args.filter_expired_node_metrics = True
+    sched.args.enable_schedule_when_node_metrics_expired = False
+    b = sched.node_state(sub)
+    assert b is not a
+
+
+def test_preempt_skip_trim_evicts_oldest_half():
+    sched, _topo = _build()
+    sched._preempt_skips = {f"uid-{i}": i for i in range(10)}
+    # re-assignment keeps insertion order — uid-0..4 are oldest
+    sched._preempt_skips["uid-2"] = 99
+    sched._trim_preempt_skips()
+    assert list(sched._preempt_skips) == [f"uid-{i}" for i in range(5, 10)]
+    # rotation fairness state of the SURVIVORS is preserved, not reset
+    assert sched._preempt_skips["uid-7"] == 7
