@@ -858,6 +858,24 @@ class ClusterSnapshot:
                 self.nodes.assigned_pending_prod[ap.node_idx] -= ap.estimate
         self._touch(ap.node_idx)
 
+    def restore_assumed(self, pod_uid: str, entry: "_AssumedPod") -> None:
+        """Re-install a previously captured assume entry verbatim —
+        transactional-rollback support for the Reserve journal: a
+        re-assumed pod whose chunk commit failed mid-flight gets its
+        PRIOR charge (node, request, estimate, absorbed state) back
+        bit-exactly. Any current charge for the uid is removed first;
+        both paths touch the dirty-row ledger so the device-resident
+        mirror reconverges on the next refresh."""
+        if pod_uid in self._assumed:
+            self.forget_pod(pod_uid)
+        self.nodes.requested[entry.node_idx] += entry.request
+        if not entry.absorbed:
+            self.nodes.assigned_pending[entry.node_idx] += entry.estimate
+            if entry.is_prod:
+                self.nodes.assigned_pending_prod[entry.node_idx] += entry.estimate
+        self._assumed[pod_uid] = entry
+        self._touch(entry.node_idx)
+
     # ---- pod batch build ----
 
     def build_pods(

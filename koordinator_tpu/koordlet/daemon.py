@@ -118,8 +118,22 @@ class NodeMetricReporter:
 class Koordlet:
     """The node agent. Construction order mirrors koordlet.go:75-137."""
 
-    def __init__(self, config: Optional[KoordletConfig] = None):
+    def __init__(
+        self, config: Optional[KoordletConfig] = None, chaos=None
+    ):
+        from ..chaos import NULL_INJECTOR
+        from ..utils.retry import RetryPolicy
+
         self.config = config or KoordletConfig()
+        #: fault injector (chaos points ``koordlet.collect_tick`` /
+        #: ``koordlet.qos_tick``); NULL when no chaos is wired
+        self.chaos = chaos or NULL_INJECTOR
+        #: backoff for the wall-clock loop after consecutive tick
+        #: failures (shared RetryPolicy; effectively unlimited attempts
+        #: — the agent must keep trying, just not hot-spin)
+        self.tick_retry = RetryPolicy(
+            max_attempts=1 << 30, base_delay_s=0.5, max_delay_s=30.0
+        )
         import os
 
         n_cpus = self.config.n_cpus or os.cpu_count() or 1
@@ -143,7 +157,7 @@ class Koordlet:
         # inotify watcher (kernel-latency lifecycle events, reference
         # watcher_linux.go); collect_tick's polling diff stays as the
         # periodic resync and as the full fallback when start() fails
-        self.pleg = InotifyPleg(self.config.cgroup_root)
+        self.pleg = InotifyPleg(self.config.cgroup_root, registry=self.registry)
         # statesinformer is the single state source; the daemon's loops are
         # its registered consumers (koordlet.go wires the same dependency).
         self.informer = StatesInformer(self.config.node_name)
@@ -235,6 +249,7 @@ class Koordlet:
 
     def collect_tick(self, now: Optional[float] = None) -> None:
         now = now if now is not None else time.time()
+        self.chaos.fire("koordlet.collect_tick")
         self._collect_seq += 1
         tick = self._collect_seq
         tr = self.tracer
@@ -250,7 +265,14 @@ class Koordlet:
                 ):
                     try:
                         ok = collector.collect(now)
-                    except Exception:
+                    except Exception as exc:  # noqa: BLE001 — degrade, counted
+                        from ..obs.errors import report_exception
+
+                        report_exception(
+                            f"koordlet.collector.{name}",
+                            exc,
+                            registry=self.registry,
+                        )
                         self.registry.get("collect_errors_total").labels(
                             collector=name
                         ).inc()
@@ -291,6 +313,7 @@ class Koordlet:
 
     def qos_tick(self, now: Optional[float] = None) -> Dict[str, object]:
         now = now if now is not None else time.time()
+        self.chaos.fire("koordlet.qos_tick")
         window = now - 30.0
         cpu = self.metric_cache.aggregate(mc.NODE_CPU_USAGE, "node", window, now)
         mem = self.metric_cache.aggregate(mc.NODE_MEMORY_USAGE, "node", window, now)
@@ -389,7 +412,9 @@ class Koordlet:
         stub = None
         if self.config.kubelet_addr:
             stub = KubeletStub(
-                addr=self.config.kubelet_addr, port=self.config.kubelet_port
+                addr=self.config.kubelet_addr,
+                port=self.config.kubelet_port,
+                registry=self.registry,
             )
         deadline = time.time() + duration_s
         last_pull = 0.0
@@ -397,6 +422,10 @@ class Koordlet:
         # polling diff doubles as the periodic resync (and the only
         # source when inotify is unavailable)
         inotify_on = self.pleg.start()
+        #: consecutive tick failures — drives the RetryPolicy backoff (a
+        #: persistently failing tick must degrade to a slow retry loop,
+        #: never a hot spin and never a dead agent)
+        tick_failures = 0
         try:
             while time.time() < deadline:
                 now = time.time()
@@ -409,9 +438,25 @@ class Koordlet:
                     # view for a whole report interval
                     if stub.sync_into(self.informer):
                         last_pull = now
-                self.collect_tick(now)
-                self.qos_tick(now)
-                self.report_tick(now)
+                try:
+                    self.collect_tick(now)
+                    self.qos_tick(now)
+                    self.report_tick(now)
+                except Exception as exc:  # noqa: BLE001 — degrade, counted
+                    from ..obs.errors import report_exception
+
+                    report_exception(
+                        "koordlet.tick", exc, registry=self.registry
+                    )
+                    tick_failures += 1
+                    retries = self.registry.get("retry_attempts_total")
+                    if retries is not None:
+                        retries.labels(site="koordlet.tick").inc()
+                    time.sleep(
+                        self.tick_retry.delay_for(tick_failures - 1)
+                    )
+                    continue
+                tick_failures = 0
                 time.sleep(self.config.collect_interval_s)
         finally:
             if inotify_on:

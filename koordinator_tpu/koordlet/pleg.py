@@ -168,8 +168,10 @@ class InotifyPleg(Pleg):
     portable fallback; a queue overflow triggers a full resync through
     the same diff."""
 
-    def __init__(self, cgroup_root: str):
+    def __init__(self, cgroup_root: str, registry=None):
         super().__init__(cgroup_root)
+        #: component registry for exceptions_total{site}
+        self.registry = registry
         self._fd: Optional[int] = None
         self._libc = None
         self._wd_to_dir: Dict[int, str] = {}     # wd -> tier or pod rel dir
@@ -203,8 +205,12 @@ class InotifyPleg(Pleg):
             self._wd_to_dir.pop(wd, None)
             try:
                 self._libc.inotify_rm_watch(self._fd, wd)
-            except Exception:
-                pass
+            except Exception as exc:  # noqa: BLE001 — degrade, counted
+                from ..obs.errors import report_exception
+
+                report_exception(
+                    "koordlet.pleg.rm_watch", exc, registry=self.registry
+                )
 
     # -- lifecycle --
 

@@ -34,6 +34,14 @@ def koordlet_registry(reg: Optional[Registry] = None) -> Registry:
         "collector_last_collect_ts", "last success per collector",
         labels=("collector",),
     )
+    reg.counter(
+        "retry_attempts_total",
+        "retries performed by shared RetryPolicy call sites",
+        labels=("site",),
+    )
+    from ..obs import ensure_exceptions_counter
+
+    ensure_exceptions_counter(reg)
     return reg
 
 

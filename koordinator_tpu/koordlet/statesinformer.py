@@ -99,12 +99,22 @@ class TpuDeviceProber:
     host's chips sharing an ICI domain) surface through the Device
     partition table just like NVLink groups do for GPUs."""
 
+    def __init__(self, registry=None):
+        #: component registry for exceptions_total{site} (e.g. the
+        #: koordlet registry), mirroring KubeletStub
+        self.registry = registry
+
     def probe(self) -> List[DeviceInfo]:
         try:
             import jax
 
             devices = jax.devices()
-        except Exception:  # noqa: BLE001 — no runtime = no inventory
+        except Exception as exc:  # noqa: BLE001 — no runtime = no inventory
+            from ..obs.errors import report_exception
+
+            report_exception(
+                "koordlet.device_probe", exc, registry=self.registry
+            )
             return []
         out: List[DeviceInfo] = []
         for d in devices:
@@ -412,6 +422,7 @@ class KubeletStub:
         timeout_s: float = 10.0,
         token: str = "",
         verify_tls: bool = False,
+        registry=None,
     ):
         """Defaults target the kubelet's read-only HTTP endpoint (10255);
         pair ``scheme="https"`` with port 10250 for the secure port (the
@@ -420,6 +431,10 @@ class KubeletStub:
         certs)."""
         self.base = f"{scheme}://{addr}:{port}"
         self.timeout_s = timeout_s
+        #: component registry for exceptions_total{site} — pulls the
+        #: counts onto the koordlet's /metrics instead of the hidden
+        #: process-wide default registry
+        self.registry = registry
         self.token = token
         self.verify_tls = verify_tls
 
@@ -489,10 +504,15 @@ class KubeletStub:
         when the kubelet is unreachable or returns garbage."""
         try:
             pods = self.get_all_pods()
-        except Exception:  # noqa: BLE001 — degrade, never crash the loop:
+        except Exception as exc:  # noqa: BLE001 — degrade, never crash the loop:
             # transport errors (OSError), malformed HTTP (HTTPException),
             # bad JSON (ValueError), or a garbage top-level payload
             # (AttributeError/TypeError) all mean "keep the previous view"
+            from ..obs.errors import report_exception
+
+            report_exception(
+                "koordlet.kubelet_pull", exc, registry=self.registry
+            )
             return False
         informer.set_pods(pods)
         return True

@@ -14,6 +14,12 @@ descheduler and the simulators:
   revalidation.
 """
 
+from .errors import (
+    default_error_registry,
+    ensure_exceptions_counter,
+    report_exception,
+)
+from .health import HealthRegistry
 from .rejections import (
     RejectionLog,
     RejectionRecord,
@@ -24,6 +30,7 @@ from .trace import NULL_TRACER, Span, StageTimer, Tracer
 
 __all__ = [
     "NULL_TRACER",
+    "HealthRegistry",
     "RejectReason",
     "RejectStage",
     "RejectionLog",
@@ -31,4 +38,7 @@ __all__ = [
     "Span",
     "StageTimer",
     "Tracer",
+    "default_error_registry",
+    "ensure_exceptions_counter",
+    "report_exception",
 ]

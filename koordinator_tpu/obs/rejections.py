@@ -50,6 +50,18 @@ class RejectReason(str, enum.Enum):
     NUMA_ALLOCATION_FAILED = "numa_allocation_failed"
     DEVICE_ALLOCATION_FAILED = "device_allocation_failed"
     NODE_VANISHED = "node_vanished"
+    #: robustness hardening (fault-injection PR): non-finite request /
+    #: estimate rows quarantined before they can poison the cost tensors
+    NUMERIC_INVALID = "nan_inf_quarantined"
+    #: the solver-result feeder queue stalled past its fetch deadline —
+    #: the chunk's pods re-enter the next cycle instead of wedging it
+    SOLVE_RESULT_STALLED = "solve_result_stalled"
+    #: the per-cycle deadline expired with chunks left; the remainder is
+    #: deferred and the batch degrades for the next cycle
+    CYCLE_DEADLINE_EXCEEDED = "cycle_deadline_exceeded"
+    #: a mid-commit failure rolled the chunk's Reserve journal back —
+    #: every half-assumed pod was forgotten and retries next cycle
+    COMMIT_ROLLED_BACK = "commit_rolled_back"
 
 
 @dataclass

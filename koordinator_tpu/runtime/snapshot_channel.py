@@ -28,11 +28,56 @@ import grpc
 import numpy as np
 
 from ..api.types import Node, NodeMetric, NodeStatus, ObjectMeta, Pod, PodSpec, ResourceMetric
+from ..chaos import NULL_INJECTOR, FaultInjector
 from ..core.snapshot import ClusterSnapshot
 from ..scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from ..utils.retry import RetryPolicy
 from .proto import snapshot_pb2 as pb
 
 SERVICE_NAME = "koordinator_tpu.runtime.SolverService"
+
+
+# ---------------------------------------------------------------------------
+# Typed channel errors: callers branch on exception type, never on raw
+# grpc.RpcError status plumbing (robustness PR satellite).
+# ---------------------------------------------------------------------------
+
+
+class ChannelError(Exception):
+    """Base for all snapshot-channel failures; carries the gRPC status
+    code (None for injected/local failures)."""
+
+    def __init__(self, message: str, code: Optional[object] = None):
+        super().__init__(message)
+        self.code = code
+
+
+class ChannelUnavailable(ChannelError):
+    """Transport-level failure (UNAVAILABLE / dropped RPC) — retryable."""
+
+
+class ChannelTimeout(ChannelError):
+    """Per-call deadline exceeded — retryable."""
+
+
+class ChannelCallError(ChannelError):
+    """Any other gRPC status (INVALID_ARGUMENT, INTERNAL, …) — the call
+    reached the server and failed; retrying the same payload is the
+    caller's policy decision, not the transport's."""
+
+
+_RETRYABLE_ERRORS = (ChannelUnavailable, ChannelTimeout)
+
+
+def _map_rpc_error(call: str, exc: grpc.RpcError) -> ChannelError:
+    code = exc.code() if callable(getattr(exc, "code", None)) else None
+    detail = exc.details() if callable(getattr(exc, "details", None)) else ""
+    msg = f"{call}: {code} {detail or ''}".strip()
+    if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+        return ChannelTimeout(msg, code)
+    if code == grpc.StatusCode.UNAVAILABLE:
+        return ChannelUnavailable(msg, code)
+    return ChannelCallError(msg, code)
 
 
 def _vec_to_list(config, rl) -> list:
@@ -248,9 +293,37 @@ def serve(
 
 
 class SolverClient:
-    """The control-plane side of the channel (what the Go shim speaks)."""
+    """The control-plane side of the channel (what the Go shim speaks).
 
-    def __init__(self, target: str):
+    Hardened surface (robustness PR):
+
+    * every call can carry a per-call deadline (``timeout_s``; default
+      None = unbounded, because a cold solver's first Nominate pays the
+      JIT compile — set a deadline once the channel is warm) and maps
+      ``grpc.RpcError`` to the typed :class:`ChannelError` hierarchy —
+      callers never see raw status plumbing;
+    * an optional :class:`~..utils.retry.RetryPolicy` drives backoff over
+      the *retryable* subset (UNAVAILABLE / DEADLINE_EXCEEDED), counting
+      every retry into ``retry_attempts_total{site="channel.<call>"}``;
+    * named chaos points ``channel.{sync,nominate,get_config}.drop`` /
+      ``.delay`` inject dropped and delayed RPCs deterministically (a
+      drop raises :class:`ChannelUnavailable` *before* the wire, so the
+      delta genuinely never reached the server — the generation-gap
+      resync protocol is what repairs the stream afterwards).
+    """
+
+    def __init__(
+        self,
+        target: str,
+        timeout_s: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
+        chaos: Optional[FaultInjector] = None,
+        retry_counter=None,
+    ):
+        self.timeout_s = timeout_s
+        self.retry = retry
+        self.chaos = chaos or NULL_INJECTOR
+        self.retry_counter = retry_counter
         self._channel = grpc.insecure_channel(target)
         self._sync = self._channel.unary_unary(
             f"/{SERVICE_NAME}/Sync",
@@ -268,28 +341,51 @@ class SolverClient:
             response_deserializer=pb.SolverConfig.FromString,
         )
 
+    def _call(self, name: str, stub, req):
+        chaos = self.chaos
+
+        def once():
+            if chaos.fire(f"channel.{name}.drop"):
+                raise ChannelUnavailable(
+                    f"{name}: injected RPC drop", None
+                )
+            chaos.fire(f"channel.{name}.delay")
+            try:
+                return stub(req, timeout=self.timeout_s)
+            except grpc.RpcError as exc:
+                raise _map_rpc_error(name, exc) from exc
+
+        if self.retry is None:
+            return once()
+        return self.retry.run(
+            once,
+            retry_on=_RETRYABLE_ERRORS,
+            site=f"channel.{name}",
+            counter=self.retry_counter,
+        )
+
     def sync(self, delta: pb.SnapshotDelta) -> pb.SyncAck:
-        return self._sync(delta)
+        return self._call("sync", self._sync, delta)
 
     def sync_with_resync(self, delta: pb.SnapshotDelta, full_state_fn) -> pb.SyncAck:
         """Send a delta; when the solver reports a generation gap, answer
         with the full world state from ``full_state_fn() ->
         SnapshotDelta`` (marked full=true, carrying this delta's
         revision) — the informer re-list on disconnect."""
-        ack = self._sync(delta)
+        ack = self.sync(delta)
         if not ack.resync_required:
             return ack
         full = full_state_fn()
         full.full = True
         if not full.revision:
             full.revision = delta.revision
-        return self._sync(full)
+        return self.sync(full)
 
     def nominate(self, req: pb.NominateRequest) -> pb.NominateResponse:
-        return self._nominate(req)
+        return self._call("nominate", self._nominate, req)
 
     def get_config(self) -> pb.SolverConfig:
-        return self._get_config(pb.SolverConfigRequest())
+        return self._call("get_config", self._get_config, pb.SolverConfigRequest())
 
     def close(self) -> None:
         self._channel.close()

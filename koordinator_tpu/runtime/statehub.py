@@ -46,7 +46,24 @@ def _locked(lock, fn):
 class ClusterStateHub:
     """Versioned trackers per resource kind + informer wiring."""
 
-    def __init__(self, resync_interval_s: float = 0.0):
+    def __init__(
+        self,
+        resync_interval_s: float = 0.0,
+        chaos=None,
+        health=None,
+        error_registry=None,
+    ):
+        from ..chaos import NULL_INJECTOR
+
+        #: fault injector + health registry threaded into every informer
+        #: this hub creates (chaos points ``informer.*``; /healthz rows
+        #: ``informer.<kind>``)
+        self.chaos = chaos or NULL_INJECTOR
+        self.health = health
+        #: metrics registry for informer exceptions_total /
+        #: retry_attempts_total (e.g. the scheduler registry)
+        self.error_registry = error_registry
+        self._informer_seq = 0
         self.nodes = ObjectTracker()
         self.node_metrics = ObjectTracker()
         self.pods = ObjectTracker()
@@ -82,6 +99,17 @@ class ClusterStateHub:
     def delete(self, tracker: ObjectTracker, obj) -> Optional[int]:
         return tracker.delete(_key(obj))
 
+    def _informer(self, tracker: ObjectTracker, kind: str) -> Informer:
+        self._informer_seq += 1
+        return Informer(
+            tracker,
+            self.resync_interval_s,
+            chaos=self.chaos,
+            health=self.health,
+            name=f"informer.{kind}.{self._informer_seq}",
+            error_registry=self.error_registry,
+        )
+
     def disconnect(self) -> None:
         """Chaos lever: sever every open watch (apiserver restart). Each
         informer re-lists on its next poll and re-converges."""
@@ -94,14 +122,14 @@ class ClusterStateHub:
         """Node + NodeMetric informers feeding a ClusterSnapshot — the
         minimal consumer set (manager/descheduler binaries)."""
         lock = snap.lock
-        node_inf = Informer(self.nodes, self.resync_interval_s)
+        node_inf = self._informer(self.nodes, 'nodes')
         node_inf.add_handlers(
             on_add=_locked(lock, lambda k, o: snap.upsert_node(o)),
             on_update=_locked(lock, lambda k, o: snap.upsert_node(o)),
             on_delete=_locked(lock, lambda k, o: snap.remove_node(o.meta.name)),
         )
 
-        metric_inf = Informer(self.node_metrics, self.resync_interval_s)
+        metric_inf = self._informer(self.node_metrics, 'node_metrics')
 
         def _metric(_k, m):
             snap.set_node_metric(
@@ -133,7 +161,7 @@ class ClusterStateHub:
         if include_snapshot:
             informers.extend(self.wire_snapshot(snap))
 
-        pod_inf = Informer(self.pods, self.resync_interval_s)
+        pod_inf = self._informer(self.pods, 'pods')
         #: binds observed before their node (the pod and node informers
         #: are independent streams — cross-kind ordering is not
         #: guaranteed); drained when the node arrives
@@ -207,7 +235,7 @@ class ClusterStateHub:
             # to a dedicated informer — ordering vs that foreign wiring is
             # not guaranteed, so hubs used this way should set a nonzero
             # resync_interval_s as the repair backstop
-            drain_inf = Informer(self.nodes, self.resync_interval_s)
+            drain_inf = self._informer(self.nodes, 'nodes_drain')
             drain_inf.add_handlers(
                 on_add=_locked(lock, _drain_binds),
                 on_update=_locked(lock, _drain_binds),
@@ -215,7 +243,7 @@ class ClusterStateHub:
             extras.append(drain_inf)
 
         if sched.devices is not None:
-            dev_inf = Informer(self.devices, self.resync_interval_s)
+            dev_inf = self._informer(self.devices, 'devices')
             dev_inf.add_handlers(
                 on_add=_locked(lock, lambda k, d: sched.devices.upsert_device(d)),
                 on_update=_locked(lock, lambda k, d: sched.devices.upsert_device(d)),
@@ -226,7 +254,7 @@ class ClusterStateHub:
             extras.append(dev_inf)
 
         if sched.numa is not None:
-            topo_inf = Informer(self.topologies, self.resync_interval_s)
+            topo_inf = self._informer(self.topologies, 'topologies')
             topo_inf.add_handlers(
                 on_add=_locked(
                     lock, lambda k, t: sched.numa.register_from_topology(t)
@@ -242,7 +270,7 @@ class ClusterStateHub:
             extras.append(topo_inf)
 
         if sched.quotas is not None:
-            quota_inf = Informer(self.quotas, self.resync_interval_s)
+            quota_inf = self._informer(self.quotas, 'quotas')
             quota_inf.add_handlers(
                 on_add=_locked(lock, lambda k, q: sched.quotas.upsert_quota(q)),
                 on_update=_locked(lock, lambda k, q: sched.quotas.upsert_quota(q)),
@@ -253,7 +281,7 @@ class ClusterStateHub:
             extras.append(quota_inf)
 
         if reservations is not None:
-            resv_inf = Informer(self.reservations, self.resync_interval_s)
+            resv_inf = self._informer(self.reservations, 'reservations')
 
             from ..api import extension as _ext
 
@@ -307,7 +335,7 @@ class ClusterStateHub:
             )
             extras.append(resv_inf)
 
-        pg_inf = Informer(self.pod_groups, self.resync_interval_s)
+        pg_inf = self._informer(self.pod_groups, 'pod_groups')
         pg_inf.add_handlers(
             on_add=_locked(lock, lambda k, pg: sched.pod_groups.upsert_pod_group(pg)),
             on_update=_locked(

@@ -52,6 +52,11 @@ class Dispatcher:
             try:
                 resp = server.handler(hook, request)
             except Exception as exc:  # noqa: BLE001 — policy decides
+                from ..obs.errors import report_exception
+
+                report_exception(
+                    f"runtimeproxy.hook.{server.name}", exc
+                )
                 if not server.failure_policy.fails_open:
                     raise HookError(server.name, hook, exc) from exc
                 continue

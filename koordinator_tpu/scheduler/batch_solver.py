@@ -23,8 +23,9 @@ import numpy as np
 
 from ..api import extension as ext
 from ..api.types import Pod
+from ..chaos import NULL_INJECTOR, FaultInjector
 from ..core.snapshot import ClusterSnapshot, SnapshotConfig, bucket_size
-from ..obs import RejectReason, RejectStage
+from ..obs import RejectReason, RejectStage, report_exception
 from ..ops import estimator
 from ..ops.solver import (
     NodeState,
@@ -199,6 +200,49 @@ class _HostSolve:
     rounds_used: int
 
 
+class _FetchStalled(RuntimeError):
+    """The solver-result feeder queue produced nothing within the fetch
+    deadline — the prefetch worker wedged or died. The commit loop
+    surfaces the remaining chunks as a counted RejectReason and their
+    pods re-enter the next cycle (robustness PR satellite: a full
+    ``fq.put``/``fq.get`` pair must never silently stall the drain)."""
+
+
+class _ReserveJournal:
+    """Transactional journal for one chunk's host-side Reserve.
+
+    ``_reserve_batch`` records every mutation it makes — fresh assumes,
+    idempotent re-assumes (with the pod's PRIOR charge captured), and
+    NUMA/device holds — so a failure anywhere between assume and Permit
+    (the reference's crash-mid-commit window, injected via the
+    ``commit.crash`` chaos point) rolls the chunk back to its pre-commit
+    state. Rollback goes through ``forget_pod``/``restore_assumed``/
+    ``release``, all of which touch the snapshot's dirty-row ledger, so
+    the device-resident NodeState reconverges bit-exactly on the next
+    refresh (verified against a full re-lower by the chaos tests)."""
+
+    __slots__ = ("fresh", "reassumed", "numa_holds", "dev_holds")
+
+    def __init__(self):
+        self.fresh: List[str] = []                    # fresh assume uids
+        self.reassumed: List[tuple] = []              # (uid, prior entry)
+        self.numa_holds: Dict[str, str] = {}          # uid -> node
+        self.dev_holds: Dict[str, str] = {}           # uid -> node
+
+    def rollback(self, sched: "BatchScheduler") -> None:
+        snap = sched.snapshot
+        for uid, node in self.dev_holds.items():
+            if sched.devices is not None:
+                sched.devices.release(uid, node)
+        for uid, node in self.numa_holds.items():
+            if sched.numa is not None:
+                sched.numa.release(uid, node)
+        for uid in self.fresh:
+            snap.forget_pod(uid)
+        for uid, prior in self.reassumed:
+            snap.restore_assumed(uid, prior)
+
+
 @dataclasses.dataclass
 class ScheduleOutcome:
     bound: List[Tuple[Pod, str]]
@@ -228,6 +272,10 @@ class BatchScheduler:
         defer_gc: bool = True,
         percentage_of_nodes_to_score: int = 100,
         mesh=None,
+        chaos: Optional[FaultInjector] = None,
+        cycle_deadline_s: Optional[float] = None,
+        fallback_repromote_after: int = 3,
+        fetch_timeout_s: float = 30.0,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -338,6 +386,44 @@ class BatchScheduler:
         #: into the scheduler at cmd/koord-scheduler/app/server.go:417).
         #: None = single-device dispatch.
         self.mesh = mesh
+        #: fault injector (chaos points ``solver.dispatch``,
+        #: ``solver.nan_rows``, ``solver.fetch.stall``, ``commit.crash``);
+        #: the shared NULL injector costs one attribute read when unused
+        self.chaos = chaos or NULL_INJECTOR
+        if chaos is not None and chaos.counter is None:
+            chaos.counter = self.extender.registry.get(
+                "fault_injected_total"
+            )
+        #: per-cycle wall deadline (None = unbounded): a cycle that blows
+        #: it stops committing further chunks (their pods retry next
+        #: cycle) and degrades to a smaller batch bucket instead of
+        #: wedging the drain behind one oversized cycle
+        self.cycle_deadline_s = cycle_deadline_s
+        #: fallback ladder (0 = scanned multi-chunk, 1 = per-chunk,
+        #: 2 = host numpy reference). A dispatch failure demotes the
+        #: ladder for subsequent cycles; ``fallback_repromote_after``
+        #: consecutive clean cycles re-promote one level.
+        self.fallback_repromote_after = max(1, fallback_repromote_after)
+        self._fallback_level = 0
+        self._fallback_clean = 0
+        #: batch-bucket degradation exponent after deadline overruns
+        #: (effective bucket = batch_bucket >> degrade, floor 16)
+        self._bucket_degrade = 0
+        self._degrade_clean = 0
+        #: deadline the solver-result fetch may block before the chunk is
+        #: surfaced as SOLVE_RESULT_STALLED (feeder-queue satellite)
+        self.fetch_timeout_s = fetch_timeout_s
+        #: uid -> (stage, plugin, reason) for rows the NaN/Inf guard
+        #: quarantined this cycle (cleared per external cycle)
+        self._numeric_quarantine: Dict[str, tuple] = {}
+        #: per-cycle flags consumed by the tail bookkeeping
+        self._cycle_solver_failed = False
+        self._cycle_deadline_hit = False
+        self._cycle_commit_rolled_back = False
+        self._cycle_fetch_deferred = False
+        self._cycle_t0 = 0.0
+        self.extender.health.set("solver", True)
+        self.extender.health.set("commit", True)
 
     # ---- device lowering ----
 
@@ -547,6 +633,32 @@ class BatchScheduler:
     def _pod_batch(
         self, pods: Sequence[Pod], bucket: Optional[int] = None
     ) -> PodBatch:
+        arrays, est = self._lower_rows(pods, bucket)
+        return PodBatch.create(
+            requests=arrays.requests,
+            estimate=est,
+            priority=arrays.priority,
+            is_prod=self._lowered.is_prod,
+            valid=arrays.valid,
+            gang_id=arrays.gang_id,
+            gang_min=arrays.gang_min,
+            quota_chain=self._lowered.quota_chain,
+            qos=arrays.qos,
+            gpu_whole=arrays.gpu_whole,
+            gpu_share=arrays.gpu_share,
+            rdma=arrays.rdma,
+            fpga=arrays.fpga,
+            gang_nonstrict=arrays.gang_nonstrict,
+            numa_required=arrays.numa_required,
+        )
+
+    def _lower_rows(self, pods: Sequence[Pod], bucket: Optional[int] = None):
+        """Host-side lowering shared by the device dispatches and the
+        host reference path: builds the dense pod arrays + estimates,
+        stashes :class:`LoweredRows` for ``_commit``, and runs the
+        NaN/Inf guard (non-finite request/estimate rows are quarantined
+        as a counted RejectReason before they can poison a cost tensor).
+        Returns ``(arrays, est)``."""
         arrays = self.snapshot.build_pods(
             list(pods),
             min_member_by_gang=self.pod_groups.min_member_map(),
@@ -592,6 +704,34 @@ class BatchScheduler:
             for i in np.nonzero(arrays.est_override)[0].tolist():
                 est[i] = self._estimate_of(pods[i])
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
+        # chaos: corrupt one estimate row (emulates a poisoned upstream
+        # estimator / device readback); the guard below quarantines it
+        # exactly like a genuinely corrupt spec would be
+        if self.chaos.enabled and len(pods) and self.chaos.fire(
+            "solver.nan_rows"
+        ):
+            est[0, 0] = float("nan")
+        # NaN/Inf guard: a single non-finite row would propagate through
+        # the cost sums and corrupt EVERY pod's ranking in the chunk —
+        # quarantine the offending rows (valid=False, zeroed) and
+        # attribute them as NUMERIC_INVALID so they surface in
+        # rejections_total instead of as garbage placements
+        n_pods = len(pods)
+        if n_pods:
+            finite = np.isfinite(arrays.requests[:n_pods]).all(
+                axis=1
+            ) & np.isfinite(est[:n_pods]).all(axis=1)
+            if not finite.all():
+                bad = np.nonzero(~finite)[0]
+                for i in bad.tolist():
+                    self._numeric_quarantine[arrays.uids[i]] = (
+                        RejectStage.FILTER,
+                        "numeric_guard",
+                        RejectReason.NUMERIC_INVALID,
+                    )
+                arrays.requests[bad] = 0.0
+                est[bad] = 0.0
+                arrays.valid[bad] = False
         chains = self.quotas.chains_for_names(arrays.quota_names, b)
         # non-preemptible pods: append the leaf's SHADOW quota index
         # (leaf + Q; runtime=min, used=nonPreemptibleUsed in the extended
@@ -634,23 +774,7 @@ class BatchScheduler:
             quota_chain=chains,
             numa_required=arrays.numa_required,
         )
-        return PodBatch.create(
-            requests=arrays.requests,
-            estimate=est,
-            priority=arrays.priority,
-            is_prod=is_prod,
-            valid=arrays.valid,
-            gang_id=arrays.gang_id,
-            gang_min=arrays.gang_min,
-            quota_chain=chains,
-            qos=arrays.qos,
-            gpu_whole=arrays.gpu_whole,
-            gpu_share=arrays.gpu_share,
-            rdma=arrays.rdma,
-            fpga=arrays.fpga,
-            gang_nonstrict=arrays.gang_nonstrict,
-            numa_required=arrays.numa_required,
-        )
+        return arrays, est
 
     # ---- scheduling cycle ----
 
@@ -719,6 +843,12 @@ class BatchScheduler:
             # stale buffer from a cycle that raised mid-flight must not
             # leak records into this cycle
             self._cycle_rejects = []
+            self._numeric_quarantine = {}
+            self._cycle_solver_failed = False
+            self._cycle_deadline_hit = False
+            self._cycle_commit_rolled_back = False
+            self._cycle_fetch_deferred = False
+            self._cycle_t0 = _time.perf_counter()
             fwext.monitor.start_batch(pending)
             # amortized purge: pods forgotten through any path (delete
             # sync, resync, eviction) must not accumulate here forever
@@ -880,18 +1010,27 @@ class BatchScheduler:
         sub = self._select_nodes(eligible) if chunks else None
         seq.enter("solve")
         seq.set(chunks=len(chunks))
-        solves = None
-        if len(chunks) > 1:
-            solves = self._dispatch_scanned(chunks, sub)
-            if solves is None:
-                solves = self._dispatch_pipelined(chunks, sub)
-        else:
-            solves = [(chunk, None, self.solve(chunk, sub)) for chunk in chunks]
+        # fallback ladder: scanned multi-chunk → per-chunk → host numpy
+        # reference; a dispatch failure demotes the ladder for subsequent
+        # cycles instead of killing this one
+        solves = self._dispatch_with_fallback(chunks, sub)
+        fence_failed = False
         if tr.enabled and solves and not isinstance(solves[0][2], _HostSolve):
             # fence the async dispatches so the solve span's duration is
             # real device time, not enqueue time (the commit stage then
-            # measures pure transfer + host Reserve)
-            jax.block_until_ready([r.assignment for _c, _r, r in solves])
+            # measures pure transfer + host Reserve). The fence is where
+            # an async device failure surfaces when tracing is on, so it
+            # gets the same ladder treatment as a fetch-time failure —
+            # escaping here would kill the cycle un-demoted.
+            try:
+                jax.block_until_ready(
+                    [r.assignment for _c, _r, r in solves]
+                )
+            except Exception as exc:  # noqa: BLE001 — ladder absorbs
+                self._note_solver_failure(
+                    min(self._fallback_level, 1), exc
+                )
+                fence_failed = True
         use_zone_hints = self.numa is not None and self.numa.has_topology
 
         def _pack(result):
@@ -944,9 +1083,21 @@ class BatchScheduler:
 
             def worker():
                 for pg in packed_groups:
+                    if self.chaos.enabled and self.chaos.fire(
+                        "solver.fetch.stall"
+                    ):
+                        # simulated wedged device→host transfer: nothing
+                        # ever arrives; the consumer's fetch deadline
+                        # surfaces the stall as SOLVE_RESULT_STALLED
+                        return
                     try:
                         item = np.asarray(pg)
                     except Exception as exc:  # noqa: BLE001 — re-raised below
+                        report_exception(
+                            "scheduler.solve.prefetch",
+                            exc,
+                            registry=self.extender.registry,
+                        )
                         item = exc
                     while not cancelled.is_set():
                         try:
@@ -962,7 +1113,20 @@ class BatchScheduler:
             ).start()
             try:
                 for s, c in groups:
-                    got = fq.get()
+                    # bounded fetch: a dead/wedged worker must not block
+                    # the drain forever (feeder-queue satellite) — the
+                    # remaining chunks re-enter the next cycle instead
+                    deadline = _time.monotonic() + self.fetch_timeout_s
+                    while True:
+                        try:
+                            got = fq.get(timeout=0.25)
+                            break
+                        except _queue.Empty:
+                            if _time.monotonic() >= deadline:
+                                raise _FetchStalled(
+                                    f"solver result fetch stalled > "
+                                    f"{self.fetch_timeout_s}s"
+                                ) from None
                     if isinstance(got, Exception):
                         raise got
                     if c == 1:
@@ -976,31 +1140,93 @@ class BatchScheduler:
                 cancelled.set()
 
         seq.enter("commit")
-        for (chunk, rows, result), host_arr in zip(solves, _host_arrays()):
-            t0 = _time.perf_counter()
-            if use_zone_hints and result.pod_zone is not None:
-                assignment, pod_zone = host_arr[0], host_arr[1]
-            else:
-                assignment, pod_zone = host_arr, None
-            assignment = self._map_assignment(assignment, sub)
-            if fwext.scores.top_n > 0:
-                with tr.span(
-                    "plugin:loadaware:score", cat="scheduler", cycle=cid
+        # hardened commit loop: a stalled result fetch, an async device
+        # failure surfacing at transfer time, or a blown per-cycle
+        # deadline defers the REMAINING chunks to the next cycle (each
+        # pod gets a counted RejectReason) instead of wedging or killing
+        # the cycle; already-committed chunks stand.
+        deferred_from = len(solves)
+        deferred_reason = None
+        if fence_failed:
+            deferred_from = 0
+            deferred_reason = RejectReason.SOLVE_RESULT_STALLED
+        host_iter = _host_arrays()
+        try:
+            for k, (chunk, rows, result) in enumerate(
+                [] if fence_failed else solves
+            ):
+                if (
+                    self.cycle_deadline_s is not None
+                    and k > 0
+                    and _time.perf_counter() - self._cycle_t0
+                    > self.cycle_deadline_s
                 ):
-                    self._debug_capture(chunk, assignment)
-            b, u = self._commit(chunk, assignment, rows, pod_zone=pod_zone)
-            fwext.registry.get("solver_batch_latency_seconds").observe(
-                _time.perf_counter() - t0
-            )
-            self._record_chunk_rejections(chunk, rows, assignment, u)
-            bound.extend(b)
-            unsched.extend(u)
+                    deferred_from = k
+                    deferred_reason = RejectReason.CYCLE_DEADLINE_EXCEEDED
+                    self._cycle_deadline_hit = True
+                    fwext.registry.get("cycle_deadline_exceeded_total").inc()
+                    break
+                t0 = _time.perf_counter()
+                try:
+                    host_arr = next(host_iter)
+                except _FetchStalled as exc:
+                    report_exception(
+                        "scheduler.fetch_stall",
+                        exc,
+                        registry=fwext.registry,
+                    )
+                    deferred_from = k
+                    deferred_reason = RejectReason.SOLVE_RESULT_STALLED
+                    break
+                except StopIteration:
+                    raise RuntimeError(
+                        "solver host-transfer iterator exhausted early"
+                    ) from None
+                except Exception as exc:  # async device failure at fetch
+                    self._note_solver_failure(
+                        min(self._fallback_level, 1), exc
+                    )
+                    deferred_from = k
+                    deferred_reason = RejectReason.SOLVE_RESULT_STALLED
+                    break
+                if use_zone_hints and result.pod_zone is not None:
+                    assignment, pod_zone = host_arr[0], host_arr[1]
+                else:
+                    assignment, pod_zone = host_arr, None
+                assignment = self._map_assignment(assignment, sub)
+                if fwext.scores.top_n > 0:
+                    with tr.span(
+                        "plugin:loadaware:score", cat="scheduler", cycle=cid
+                    ):
+                        self._debug_capture(chunk, assignment)
+                b, u = self._commit(chunk, assignment, rows, pod_zone=pod_zone)
+                fwext.registry.get("solver_batch_latency_seconds").observe(
+                    _time.perf_counter() - t0
+                )
+                self._record_chunk_rejections(chunk, rows, assignment, u)
+                bound.extend(b)
+                unsched.extend(u)
+        finally:
+            host_iter.close()   # releases the prefetch worker
+        if deferred_reason is RejectReason.SOLVE_RESULT_STALLED:
+            self._cycle_fetch_deferred = True
+        for chunk, _rows, _result in solves[deferred_from:]:
+            for pod in chunk:
+                unsched.append(pod)
+                self._cycle_rejects.append(
+                    (pod, RejectStage.SOLVE, "scheduler", deferred_reason)
+                )
         # rounds_used is diagnostics only — fetched AFTER the commit loop
         # and in ONE stacked transfer (per-chunk int() fetches each cost
-        # a tunnel round trip); the scanned path already holds host ints
+        # a tunnel round trip); the scanned path already holds host ints.
+        # Skipped entirely when chunks were deferred: a stalled/failed
+        # fetch means the device may be wedged, and blocking here on
+        # another unbounded transfer would defeat the fetch deadline.
         if solves and isinstance(solves[0][2], _HostSolve):
             for _chunk, _rows, result in solves:
                 rounds += result.rounds_used
+        elif deferred_reason is not None:
+            pass
         elif len(solves) == 1:
             rounds += int(solves[0][2].rounds_used)
         elif solves:
@@ -1020,9 +1246,20 @@ class BatchScheduler:
         #: nominate two disjoint victim sets (quota + priority) in a
         #: single cycle and over-evict through the migration controller
         nominated_uids: set = set()
+        #: an infrastructure deferral (deadline, stalled fetch, commit
+        #: rollback) means these pods were never proven infeasible —
+        #: evicting victims on their behalf would be wrong, and the
+        #: in-cycle retry would re-dispatch against a possibly-wedged
+        #: device
+        infra_deferral = (
+            self._cycle_deadline_hit
+            or self._cycle_fetch_deferred
+            or self._cycle_commit_rolled_back
+        )
         if (
             not _retry
             and unsched
+            and not infra_deferral
             and self.quotas.enable_preemption
             and self.quotas.quota_count > 0
         ):
@@ -1097,7 +1334,12 @@ class BatchScheduler:
         # preemption manager, reference reservation/preemption.go:105-250)
         # for pods quota preemption could not help; gated by
         # ReservationArgs.EnablePreemption (default false).
-        if not _retry and unsched and self.enable_priority_preemption:
+        if (
+            not _retry
+            and unsched
+            and not infra_deferral
+            and self.enable_priority_preemption
+        ):
             from .plugins.coscheduling import gang_key_of as _gang_of
             from .plugins.preemption import PriorityPreemptor
 
@@ -1189,6 +1431,7 @@ class BatchScheduler:
                         tally.get(f"{r.stage}:{r.plugin}", 0) + 1
                     )
                 fwext.filters.capture(tally)
+            self._cycle_tail_bookkeeping()
         return ScheduleOutcome(
             bound=bound,
             unschedulable=unsched,
@@ -1214,6 +1457,232 @@ class BatchScheduler:
         drop = max(len(self._preempt_skips) // 2, 1)
         for uid in list(islice(self._preempt_skips, drop)):
             del self._preempt_skips[uid]
+
+    # ---- robustness: fallback ladder + deadline degrade bookkeeping ----
+
+    def _note_solver_failure(self, level: int, exc: BaseException) -> None:
+        """A dispatch at ladder ``level`` failed (compile/device error or
+        injected fault): demote for subsequent cycles, count it, surface
+        on /healthz. Commit-side Reserve means demoted cycles can only
+        under-place, never corrupt state."""
+        fallen_to = min(level + 1, 2)
+        reg = self.extender.registry
+        reg.get("solver_fallback_total").labels(level=str(fallen_to)).inc()
+        report_exception(f"scheduler.solve.l{level}", exc, registry=reg)
+        self._fallback_level = max(self._fallback_level, fallen_to)
+        self._fallback_clean = 0
+        self._cycle_solver_failed = True
+        self.extender.health.set(
+            "solver",
+            False,
+            f"fallback level {self._fallback_level} after: {exc!r}",
+        )
+
+    def _dispatch_with_fallback(self, chunks, sub):
+        """Fallback ladder (robustness tentpole): level 0 = scanned
+        multi-chunk, 1 = per-chunk dispatch, 2 = pure-numpy host
+        reference. Each level's failure falls through to the next within
+        the SAME cycle; the reached level persists for subsequent cycles
+        and ``fallback_repromote_after`` consecutive clean cycles
+        re-promote one level (see ``_cycle_tail_bookkeeping``)."""
+        if not chunks:
+            return []
+        if self.mesh is not None:
+            # multi-chip mode opted into strict decision identity across
+            # the mesh — a silent numpy fallback would violate it, so
+            # dispatch failures propagate to the operator instead
+            if len(chunks) > 1:
+                return self._dispatch_pipelined(chunks, sub)
+            return [(c, None, self.solve(c, sub)) for c in chunks]
+        level = self._fallback_level
+        if level == 0:
+            try:
+                self.chaos.fire("solver.dispatch")
+                if len(chunks) > 1:
+                    solves = self._dispatch_scanned(chunks, sub)
+                    if solves is None:
+                        solves = self._dispatch_pipelined(chunks, sub)
+                else:
+                    solves = [
+                        (c, None, self.solve(c, sub)) for c in chunks
+                    ]
+                return solves
+            except Exception as exc:  # noqa: BLE001 — ladder absorbs
+                self._note_solver_failure(0, exc)
+                level = 1
+        if level == 1:
+            try:
+                self.chaos.fire("solver.dispatch_chunk")
+                if len(chunks) > 1:
+                    return self._dispatch_pipelined(chunks, sub)
+                return [(c, None, self.solve(c, sub)) for c in chunks]
+            except Exception as exc:  # noqa: BLE001 — ladder absorbs
+                self._note_solver_failure(1, exc)
+        with self.extender.tracer.span(
+            "assign", cat="scheduler", mode="host_reference",
+            chunks=len(chunks),
+        ):
+            return self._dispatch_host_reference(chunks, sub)
+
+    def _dispatch_host_reference(self, chunks, sub: Optional[np.ndarray] = None):
+        """Level-2 degraded mode: a pure-numpy greedy assigner that keeps
+        the cluster draining when the device path is down. Decision-
+        APPROXIMATE, capacity-SAFE: pods commit in (-priority, arrival)
+        order against locally-charged copies of node capacity, LoadAware
+        thresholds and the quota chain table; NUMA/device exactness is
+        left to the commit-side Reserve revalidation (an infeasible pick
+        is rejected there and retries next cycle — under-placement,
+        never overcommit). Batch/cost transformers do not run here."""
+        snap = self.snapshot
+        na = snap.nodes
+        n_real = snap.node_count
+        rows_idx = (
+            np.arange(n_real, dtype=np.int64)
+            if sub is None
+            else np.asarray(sub, np.int64)
+        )
+        alloc = na.allocatable[rows_idx].copy()
+        requested = na.requested[rows_idx].copy()
+        est_used = (
+            np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
+        )[rows_idx].copy()
+        prod_used = (na.prod_usage + na.assigned_pending_prod)[
+            rows_idx
+        ].copy()
+        schedulable = na.schedulable[rows_idx].copy()
+        if (
+            self.args.filter_expired_node_metrics
+            and not self.args.enable_schedule_when_node_metrics_expired
+        ):
+            schedulable &= (
+                na.metric_fresh[rows_idx] | ~na.has_metric[rows_idx]
+            )
+        fresh = na.metric_fresh[rows_idx]
+        thr = np.asarray(self._params.usage_thresholds)
+        pthr = np.asarray(self._params.prod_thresholds)
+        w = np.asarray(self._params.score_weights)
+        cap = alloc * thr[None, :] / 100.0
+        pcap = alloc * pthr[None, :] / 100.0
+        runtime = used = None
+        host_quota = self._quota_host_arrays(
+            [p for c in chunks for p in c]
+        )
+        if host_quota is not None:
+            runtime, used = host_quota
+            runtime = np.asarray(runtime)
+            used = np.asarray(used).copy()
+        out = []
+        for chunk in chunks:
+            arrays, _est = self._lower_rows(chunk)
+            rows = self._lowered
+            n = len(chunk)
+            assignment = np.full(arrays.requests.shape[0], -1, np.int32)
+            mask_host = self._node_constraint_mask_host(chunk, n)
+            valid = arrays.valid
+            order = np.lexsort((np.arange(n), -rows.prio[:n]))
+            for i in order.tolist():
+                if not valid[i]:
+                    continue
+                req = rows.req[i]
+                est = rows.est[i]
+                chain: List[int] = []
+                if used is not None and rows.quota_chain is not None:
+                    chain = [
+                        int(q)
+                        for q in rows.quota_chain[i]
+                        if 0 <= q < used.shape[0]
+                    ]
+                    if any(
+                        np.any(used[q] + req > runtime[q] + 1e-3)
+                        for q in chain
+                    ):
+                        continue
+                feas = schedulable & np.all(
+                    req[None, :] <= alloc - requested + 1e-3, axis=1
+                )
+                if mask_host is not None:
+                    feas &= mask_host[i][rows_idx]
+                if feas.any():
+                    ok_thr = np.where(
+                        (thr[None, :] > 0) & fresh[:, None],
+                        est_used + est[None, :] <= cap + 1e-3,
+                        True,
+                    ).all(axis=1)
+                    if rows.is_prod[i] and pthr.any():
+                        ok_thr &= np.where(
+                            (pthr[None, :] > 0) & fresh[:, None],
+                            prod_used + est[None, :] <= pcap + 1e-3,
+                            True,
+                        ).all(axis=1)
+                    feas &= ok_thr
+                if not feas.any():
+                    continue
+                after = est_used + est[None, :]
+                free_pct = (
+                    np.maximum(alloc - after, 0.0) * 100.0 / (alloc + 1e-9)
+                )
+                cost = -np.sum(free_pct * w[None, :], axis=1) / (
+                    w.sum() + 1e-9
+                )
+                j = int(np.argmin(np.where(feas, cost, np.inf)))
+                assignment[i] = j
+                requested[j] += req
+                est_used[j] += est
+                if rows.is_prod[i]:
+                    prod_used[j] += est
+                for q in chain:
+                    used[q] += req
+            out.append(
+                (
+                    chunk,
+                    rows,
+                    _HostSolve(
+                        assignment=assignment, pod_zone=None, rounds_used=1
+                    ),
+                )
+            )
+        return out
+
+    def _cycle_tail_bookkeeping(self) -> None:
+        """Once per external cycle: re-promotion clocks for the fallback
+        ladder and the deadline-degraded batch bucket, plus /healthz
+        state transitions."""
+        health = self.extender.health
+        if self._fallback_level > 0 and not self._cycle_solver_failed:
+            self._fallback_clean += 1
+            if self._fallback_clean >= self.fallback_repromote_after:
+                self._fallback_level -= 1
+                self._fallback_clean = 0
+                if self._fallback_level == 0:
+                    health.set("solver", True)
+                else:
+                    health.set(
+                        "solver",
+                        False,
+                        f"fallback level {self._fallback_level} "
+                        "(re-promoting)",
+                    )
+        if self.cycle_deadline_s is not None:
+            if self._cycle_deadline_hit:
+                if self.effective_batch_bucket() > 16:
+                    self._bucket_degrade += 1
+                self._degrade_clean = 0
+                health.set(
+                    "cycle_deadline",
+                    False,
+                    f"deadline exceeded; batch degraded to "
+                    f"{self.effective_batch_bucket()}",
+                )
+            else:
+                self._degrade_clean += 1
+                if self._degrade_clean >= self.fallback_repromote_after:
+                    if self._bucket_degrade > 0:
+                        self._bucket_degrade -= 1
+                        self._degrade_clean = 0
+                    if self._bucket_degrade == 0:
+                        health.set("cycle_deadline", True)
+        if not self._cycle_commit_rolled_back:
+            health.set("commit", True)
 
     def node_allowed(self, pod: Pod, node_name: str) -> bool:
         """Single-node form of the node-constraint mask (nodeSelector /
@@ -1313,7 +1782,11 @@ class BatchScheduler:
         ):
             for pod in unsched:
                 uid = pod.meta.uid
-                hit = self._reserve_reject.get(uid)
+                # quarantined rows carry their NUMERIC_INVALID verdict
+                # from lowering time (the first stage that saw them)
+                hit = self._numeric_quarantine.get(
+                    uid
+                ) or self._reserve_reject.get(uid)
                 if hit is None:
                     i = idx.get(uid)
                     if i is not None and assignment[i] < 0:
@@ -1437,6 +1910,15 @@ class BatchScheduler:
             )
         return (RejectStage.SOLVE, "solver", RejectReason.NO_FEASIBLE_NODE)
 
+    def effective_batch_bucket(self) -> int:
+        """Chunk size this cycle: ``batch_bucket`` halved once per
+        deadline-degrade step (floor 16). A cycle that blows its
+        deadline degrades to smaller batches instead of wedging; clean
+        cycles re-promote (see the tail bookkeeping)."""
+        if self._bucket_degrade <= 0:
+            return self.batch_bucket
+        return max(16, self.batch_bucket >> self._bucket_degrade)
+
     def _chunks(self, eligible: Sequence[Pod]) -> List[List[Pod]]:
         """Split into solver batches of ~batch_bucket without splitting a
         gang across chunks (a split gang would be rolled back on both
@@ -1457,8 +1939,9 @@ class BatchScheduler:
             i = j
         chunks: List[List[Pod]] = []
         cur: List[Pod] = []
+        bucket = self.effective_batch_bucket()
         for block in blocks:
-            if cur and len(cur) + len(block) > self.batch_bucket:
+            if cur and len(cur) + len(block) > bucket:
                 chunks.append(cur)
                 cur = []
             cur.extend(block)
@@ -1935,6 +2418,37 @@ class BatchScheduler:
     def quota_state(self, chunk: Sequence[Pod]) -> Optional[QuotaState]:
         """Lowered QuotaState, or None when no quota tree exists (the solver
         traces the quota passes out entirely)."""
+        host = self._quota_host_arrays(chunk)
+        if host is None:
+            return None
+        runtime, used = host
+        reg = self.extender.registry
+        key = (self.quotas.state_version, runtime.shape)
+        cached = self._quota_dev_cache
+        if cached is not None and cached[0] == key:
+            reg.get("solver_state_cache_hits_total").labels(
+                table="quota"
+            ).inc()
+            return cached[1]
+        if runtime.shape[0] == 1:
+            # pad: Q == 1 is reserved as the disabled sentinel
+            pad = np.zeros((1, runtime.shape[1]), np.float32)
+            runtime = np.concatenate([runtime, pad])
+            used = np.concatenate([used, pad])
+        with self.extender.tracer.span(
+            "snapshot:quota_lower", cat="scheduler", quotas=runtime.shape[0]
+        ):
+            state = QuotaState(
+                runtime=jnp.asarray(runtime), used=jnp.asarray(used)
+            )
+        self._quota_dev_cache = (key, state)
+        return state
+
+    def _quota_host_arrays(self, chunk: Sequence[Pod]):
+        """Host-side quota refresh shared by the device lowering and the
+        host reference path: propagates this chunk's demand up the tree,
+        refreshes runtime, and returns the extended ``(runtime, used)``
+        numpy tables (None when no quota tree exists) — no device work."""
         from .plugins.elasticquota import quota_name_of
 
         if self.quotas.quota_count == 0:
@@ -1990,28 +2504,7 @@ class BatchScheduler:
                 idx = self.quotas.index_of(leaf)
                 if idx is not None and idx < self.quotas.nonpre_requests.shape[0]:
                     self.quotas.nonpre_requests[idx] += vec
-        runtime, used = self.quotas.quota_arrays_extended()
-        reg = self.extender.registry
-        key = (self.quotas.state_version, runtime.shape)
-        cached = self._quota_dev_cache
-        if cached is not None and cached[0] == key:
-            reg.get("solver_state_cache_hits_total").labels(
-                table="quota"
-            ).inc()
-            return cached[1]
-        if runtime.shape[0] == 1:
-            # pad: Q == 1 is reserved as the disabled sentinel
-            pad = np.zeros((1, runtime.shape[1]), np.float32)
-            runtime = np.concatenate([runtime, pad])
-            used = np.concatenate([used, pad])
-        with self.extender.tracer.span(
-            "snapshot:quota_lower", cat="scheduler", quotas=runtime.shape[0]
-        ):
-            state = QuotaState(
-                runtime=jnp.asarray(runtime), used=jnp.asarray(used)
-            )
-        self._quota_dev_cache = (key, state)
-        return state
+        return self.quotas.quota_arrays_extended()
 
     def _estimate_of(self, pod: Pod) -> np.ndarray:
         """One estimate per pod everywhere — solver gating, Reserve commit
@@ -2070,33 +2563,63 @@ class BatchScheduler:
             check_rows = rows.req.copy()
             check_rows[:n_chunk, cpu_dim] *= factor
 
-        with tr.span("plugin:noderesources:reserve", cat="scheduler"):
-            results = self._reserve_batch(
-                chunk, assignment, rows, check_rows, prebind, pod_zone=pod_zone
+        # transactional Reserve: every mutation inside the try below is
+        # journaled, so a failure anywhere between assume and Permit
+        # (the classic crash-mid-commit window, injected via
+        # ``commit.crash``) rolls the chunk back to its pre-commit state
+        # instead of leaking half-assumed pods; the chunk's pods then
+        # retry next cycle. The try deliberately ENDS at Permit: the
+        # prebind/quota-charge stages below mutate durable ledgers the
+        # journal does not record — absorbing their failures here would
+        # roll back assumes while the quota charges stood, double-
+        # charging on retry. Their failures propagate loudly instead.
+        journal = _ReserveJournal()
+        try:
+            with tr.span("plugin:noderesources:reserve", cat="scheduler"):
+                results = self._reserve_batch(
+                    chunk, assignment, rows, check_rows, prebind,
+                    pod_zone=pod_zone, journal=journal,
+                )
+            self.chaos.fire("commit.crash")
+            # Permit: all-or-nothing over gangs; roll back assumes of
+            # rejects. Bypassed outright when neither the chunk nor the
+            # manager knows any gang — permit can then reject nothing.
+            if rows.has_gangs or self.pod_groups.has_gangs:
+                with tr.span("plugin:coscheduling:permit", cat="scheduler"):
+                    bound, unsched = self.pod_groups.permit(results)
+                bound_uids = {p.meta.uid for p, _ in bound}
+                for pod, node in results:
+                    if node is not None and pod.meta.uid not in bound_uids:
+                        self._reserve_reject[pod.meta.uid] = (
+                            RejectStage.PERMIT,
+                            "coscheduling",
+                            RejectReason.GANG_INCOMPLETE,
+                        )
+                        self.snapshot.forget_pod(pod.meta.uid)
+                        prebind.discard(pod.meta.uid)
+                        if self.numa is not None:
+                            self.numa.release(pod.meta.uid, node)
+                        if self.devices is not None:
+                            self.devices.release(pod.meta.uid, node)
+            else:
+                bound = [(p, n) for p, n in results if n is not None]
+                unsched = [p for p, n in results if n is None]
+        except Exception as exc:  # noqa: BLE001 — journal rollback
+            journal.rollback(self)
+            reg = self.extender.registry
+            reg.get("commit_rollbacks_total").inc()
+            report_exception("scheduler.commit", exc, registry=reg)
+            self._cycle_commit_rolled_back = True
+            self.extender.health.set(
+                "commit", False, f"chunk rolled back: {exc!r}"
             )
-        # Permit: all-or-nothing over gangs; roll back assumes of rejects.
-        # Bypassed outright when neither the chunk nor the manager knows
-        # any gang — permit can then reject nothing.
-        if rows.has_gangs or self.pod_groups.has_gangs:
-            with tr.span("plugin:coscheduling:permit", cat="scheduler"):
-                bound, unsched = self.pod_groups.permit(results)
-            bound_uids = {p.meta.uid for p, _ in bound}
-            for pod, node in results:
-                if node is not None and pod.meta.uid not in bound_uids:
-                    self._reserve_reject[pod.meta.uid] = (
-                        RejectStage.PERMIT,
-                        "coscheduling",
-                        RejectReason.GANG_INCOMPLETE,
-                    )
-                    self.snapshot.forget_pod(pod.meta.uid)
-                    prebind.discard(pod.meta.uid)
-                    if self.numa is not None:
-                        self.numa.release(pod.meta.uid, node)
-                    if self.devices is not None:
-                        self.devices.release(pod.meta.uid, node)
-        else:
-            bound = [(p, n) for p, n in results if n is not None]
-            unsched = [p for p, n in results if n is None]
+            for pod in chunk:
+                self._reserve_reject[pod.meta.uid] = (
+                    RejectStage.RESERVE,
+                    "journal",
+                    RejectReason.COMMIT_ROLLED_BACK,
+                )
+            return [], list(chunk)
         # terminal PreBind: one merged patch per admitted pod
         # (defaultprebind/plugin.go; rejected pods' patches evaporate).
         if prebind.has_patches:
@@ -2169,6 +2692,7 @@ class BatchScheduler:
         check_rows: np.ndarray,
         prebind: "DefaultPreBind",
         pod_zone: Optional[np.ndarray] = None,
+        journal: Optional[_ReserveJournal] = None,
     ) -> List[Tuple[Pod, Optional[str]]]:
         """Batched Reserve for every winner (reference plugin.go:579-627
         semantics, host cost vectorized):
@@ -2350,6 +2874,10 @@ class BatchScheduler:
                                 )
                             else:
                                 held_numa[i] = True
+                                if journal is not None:
+                                    journal.numa_holds[uids[i]] = (
+                                        node_name_of(assign_l[i])
+                                    )
                                 if payload:
                                     numa_payloads[i] = payload
                 if dev_l is not None:
@@ -2377,6 +2905,10 @@ class BatchScheduler:
                                         uids[i], node_name_of(assign_l[i])
                                     )
                                     held_numa[i] = False
+                                    if journal is not None:
+                                        journal.numa_holds.pop(
+                                            uids[i], None
+                                        )
                                 accept[i] = False
                                 self._reserve_reject[uids[i]] = (
                                     RejectStage.RESERVE,
@@ -2385,6 +2917,10 @@ class BatchScheduler:
                                 )
                                 continue
                             held_dev[i] = True
+                            if journal is not None:
+                                journal.dev_holds[uids[i]] = node_name_of(
+                                    assign_l[i]
+                                )
                             if dev_payload:
                                 dev_payloads[i] = dev_payload
                 # annotation patches held back until Permit so a
@@ -2425,6 +2961,9 @@ class BatchScheduler:
             uid = rows.uids[i]
             if uid in snap._assumed:
                 node_name = snap.node_name(int(assign_c[i]))
+                # capture the PRIOR charge for the Reserve journal — a
+                # mid-commit failure restores it bit-exactly
+                prior = snap._assumed[uid]
                 if not snap.assume_pod(
                     chunk[i],
                     node_name,
@@ -2449,7 +2988,12 @@ class BatchScheduler:
                         dev_mgr.release(uid, node_name)
                     if held_numa is not None and held_numa[i]:
                         numa_mgr.release(uid, node_name)
+                    if journal is not None:
+                        journal.dev_holds.pop(uid, None)
+                        journal.numa_holds.pop(uid, None)
                     prebind.discard(uid)
+                elif journal is not None:
+                    journal.reassumed.append((uid, prior))
             else:
                 fresh.append(i)
         if fresh:
@@ -2465,6 +3009,8 @@ class BatchScheduler:
                 rows.is_prod[f],
                 bind_noms,
             )
+            if journal is not None:
+                journal.fresh.extend(rows.uids[i] for i in fresh)
         results: List[Tuple[Pod, Optional[str]]] = []
         node_name_of = snap.node_name
         accept_l = accept.tolist()

@@ -48,7 +48,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..api.types import Pod
-from ..obs import RejectionLog, Tracer
+from ..obs import HealthRegistry, RejectionLog, Tracer, ensure_exceptions_counter
 from ..utils.metrics import Registry
 
 # ---------------------------------------------------------------------------
@@ -104,6 +104,33 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "without a host re-lower/upload",
         labels=("table",),
     )
+    # robustness PR: fault-injection + hardening visibility
+    reg.counter(
+        "fault_injected_total",
+        "faults injected by the chaos layer, per named point",
+        labels=("point",),
+    )
+    reg.counter(
+        "solver_fallback_total",
+        "solver dispatch failures, labeled by the ladder level fallen to "
+        "(1 = per-chunk, 2 = host numpy reference)",
+        labels=("level",),
+    )
+    reg.counter(
+        "cycle_deadline_exceeded_total",
+        "scheduling cycles that hit the per-cycle deadline and deferred "
+        "their remaining chunks to the next cycle",
+    )
+    reg.counter(
+        "retry_attempts_total",
+        "retries performed by shared RetryPolicy call sites",
+        labels=("site",),
+    )
+    reg.counter(
+        "commit_rollbacks_total",
+        "chunk commits rolled back by the transactional Reserve journal",
+    )
+    ensure_exceptions_counter(reg)
     return reg
 
 
@@ -320,6 +347,7 @@ class ServicesEngine:
     """Plugin-installable HTTP API (reference gin engine,
     ``InstallAPIHandler`` at ``app/server.go:337``). Routes:
       /metrics            — Prometheus exposition
+      /healthz            — per-subsystem degraded/ok aggregate (200/503)
       /trace              — Chrome trace JSON (GET), sampling on/off (POST)
       /debug/scores       — last score table (GET), top-N (POST body int)
       /debug/filters      — filter tally
@@ -334,12 +362,14 @@ class ServicesEngine:
         filters: DebugFiltersDumper,
         tracer: Optional[Tracer] = None,
         rejections: Optional[RejectionLog] = None,
+        health: Optional[HealthRegistry] = None,
     ):
         self.registry = registry
         self.scores = scores
         self.filters = filters
         self.tracer = tracer or Tracer(enabled=False)
         self.rejections = rejections or RejectionLog()
+        self.health = health
         self._routes: Dict[str, Callable[[str], Tuple[int, str]]] = {}
         self._server: Optional[http.server.ThreadingHTTPServer] = None
 
@@ -351,6 +381,10 @@ class ServicesEngine:
     def dispatch(self, method: str, path: str, body: str = "") -> Tuple[int, str]:
         if path == "/metrics":
             return 200, self.registry.expose()
+        if path == "/healthz":
+            if self.health is None:
+                return 200, json.dumps({"ok": True, "subsystems": {}})
+            return (200 if self.health.ok() else 503), self.health.render()
         if path == "/trace":
             if method == "POST":
                 flag = body.strip()
@@ -554,12 +588,17 @@ class FrameworkExtender:
         self.rejections = RejectionLog(
             counter=self.registry.get("rejections_total")
         )
+        #: per-subsystem degraded/ok state served as /healthz — the
+        #: fallback ladder, deadline degrade, commit journal and (when
+        #: wired) the statehub informers all report here
+        self.health = HealthRegistry()
         self.services = ServicesEngine(
             self.registry,
             self.scores,
             self.filters,
             tracer=self.tracer,
             rejections=self.rejections,
+            health=self.health,
         )
         #: monotonically increasing scheduling-cycle id joining spans,
         #: metrics and rejection records for one cycle

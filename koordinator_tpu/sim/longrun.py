@@ -546,3 +546,413 @@ def run_loop(
     }
     stats["rejection_records"] = len(sched.extender.rejections.records())
     return stats
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak (robustness PR tentpole cap)
+# ---------------------------------------------------------------------------
+
+
+def assert_resident_state_converged(sched) -> None:
+    """The device-resident NodeState must be BIT-EXACT against a
+    from-scratch host lowering — after rollbacks, resyncs and fallback
+    cycles, a missed dirty mark anywhere shows up here as a stale row
+    (same contract as ``tests/test_resident_state.py``)."""
+    import numpy as np
+
+    snap = sched.snapshot
+    na = snap.nodes
+    ns = sched.node_state()   # refreshes the resident state (dirty scatter)
+    est = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
+    sched_rows = na.schedulable
+    if (
+        sched.args.filter_expired_node_metrics
+        and not sched.args.enable_schedule_when_node_metrics_expired
+    ):
+        sched_rows = sched_rows & (na.metric_fresh | ~na.has_metric)
+    for got, want in (
+        (ns.allocatable, na.allocatable),
+        (ns.requested, na.requested),
+        (ns.estimated_used, est),
+        (ns.prod_used, na.prod_usage + na.assigned_pending_prod),
+        (ns.metric_fresh, na.metric_fresh),
+        (ns.schedulable, sched_rows),
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def run_chaos_soak(
+    cycles: int = 200,
+    seed: int = 0,
+    n_nodes: int = 24,
+    max_arrivals: int = 12,
+    drain_limit: int = 60,
+    use_channel: bool = True,
+    verbose: bool = False,
+) -> dict:
+    """Longrun chaos soak: hundreds of scheduling cycles under a seeded
+    random fault schedule, asserting the failure-domain invariants the
+    hardening promises:
+
+    * **no pod is ever placed twice** (each uid binds exactly once);
+    * **quota is never exceeded** (leaf used ≤ max every cycle);
+    * **resident state reconverges exactly** (bit-exact vs a full host
+      re-lower at the end — rollbacks and fallbacks leave no stale row);
+    * **every pod eventually places** (failed cycles only defer);
+    * **same seed ⇒ same fault trace** (the returned ``fault_trace``).
+
+    Fault domains exercised per the schedule: RPC drops on the snapshot
+    channel (one-shot drops healed by the client RetryPolicy, persistent
+    drops creating generation gaps healed by the full-resync protocol),
+    watch disconnects (informer re-list), solver dispatch failures
+    (fallback ladder + re-promotion), NaN row corruption (numeric
+    quarantine), a solve-latency spike against the per-cycle deadline
+    (batch degrade), and exactly one mid-commit crash (Reserve journal
+    rollback).
+    """
+    import random as _random
+
+    import numpy as np
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        ElasticQuota,
+        Node,
+        NodeMetric,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        ResourceMetric,
+    )
+    from koordinator_tpu.chaos import FaultInjector
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        GroupQuotaManager,
+    )
+    from koordinator_tpu.utils.retry import RetryPolicy
+
+    ALLOC_CPU, ALLOC_MEM = 32_000.0, 128 * 1024.0
+    POD_CPU, POD_MEM = 2_000.0, 4_096.0
+    LIFETIME = 6            # cycles a pod runs before completing
+    rng = _random.Random(seed)
+
+    chaos = FaultInjector(seed=seed)
+    snap = ClusterSnapshot()
+    # preemption off: the soak's contract is that every pod binds exactly
+    # once and stays bound until completion — an evicted victim would be
+    # a legitimate second placement, muddying the duplicate-bind invariant
+    gqm = GroupQuotaManager(snap.config, enable_preemption=False)
+    # max sized so steady-state quota throughput (max/LIFETIME per cycle)
+    # covers the ~arrivals/5 quota-labeled arrival rate — bursts still
+    # hit QUOTA_EXHAUSTED transiently, but the backlog stays drainable
+    q_pods = max(6, (2 * max_arrivals * LIFETIME) // 5)
+    quota_max = {
+        ext.RES_CPU: q_pods * POD_CPU,
+        ext.RES_MEMORY: q_pods * POD_MEM,
+    }
+    gqm.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="soak-team"),
+            min={ext.RES_CPU: 2 * POD_CPU, ext.RES_MEMORY: 2 * POD_MEM},
+            max=dict(quota_max),
+        )
+    )
+    sched = BatchScheduler(
+        snap,
+        LoadAwareArgs(usage_thresholds={}),
+        quotas=gqm,
+        batch_bucket=16,
+        chaos=chaos,
+        cycle_deadline_s=0.6,
+        fallback_repromote_after=3,
+        fetch_timeout_s=2.0,
+    )
+    sched.extender.monitor.stop_background()
+    reg = sched.extender.registry
+    chaos.bind_counter(reg.get("fault_injected_total"))
+
+    hub = ClusterStateHub(
+        chaos=chaos, health=sched.extender.health, error_registry=reg
+    )
+    hub.wire_scheduler(sched)
+    hub.start()
+    for i in range(n_nodes):
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name=f"n{i:03d}"),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: ALLOC_CPU,
+                        ext.RES_MEMORY: ALLOC_MEM,
+                    }
+                ),
+            ),
+        )
+    assert hub.wait_synced()
+
+    # shadow solver sidecar over a real loopback gRPC channel: the soak
+    # mirrors its world over Sync deltas; dropped deltas create genuine
+    # generation gaps the resync protocol must heal
+    service = client = server = None
+    live_synced: dict = {}   # uid -> (node, requests) mirrored to sidecar
+    revision = 0
+    q_idx = gqm.index_of("soak-team")
+    quota_max_vec = snap.config.res_vector(quota_max)
+    if use_channel:
+        from koordinator_tpu.runtime.snapshot_channel import (
+            SolverClient,
+            SolverService,
+            serve,
+        )
+
+        service = SolverService()
+        service.scheduler.extender.monitor.stop_background()
+        server, port = serve(service)
+        client = SolverClient(
+            f"127.0.0.1:{port}",
+            timeout_s=5.0,
+            retry=RetryPolicy(
+                max_attempts=3, base_delay_s=0.005, max_delay_s=0.02,
+                jitter=0.0,
+            ),
+            chaos=chaos,
+            retry_counter=reg.get("retry_attempts_total"),
+        )
+        cfg = snap.config
+
+        def _vec(rl):
+            from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+
+            return pb.ResourceVector(
+                values=[float(x) for x in cfg.res_vector(rl)]
+            )
+
+        def full_state_fn():
+            from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+
+            full = pb.SnapshotDelta()
+            for i in range(n_nodes):
+                full.node_upserts.add(
+                    name=f"n{i:03d}",
+                    allocatable=_vec(
+                        {ext.RES_CPU: ALLOC_CPU, ext.RES_MEMORY: ALLOC_MEM}
+                    ),
+                )
+            for uid, (node, requests) in live_synced.items():
+                full.pod_assumed.add(
+                    uid=uid, node=node, requests=_vec(requests)
+                )
+            return full
+
+    stats = {
+        "cycles": 0,
+        "arrived": 0,
+        "placed": 0,
+        "completed": 0,
+        "sync_lost": 0,
+        "resyncs": 0,
+        "deferred_cycles": 0,
+        "faults": {},
+    }
+    placed: dict = {}        # uid -> node, forever (duplicate guard)
+    live: list = []          # (pod, node, done_cycle)
+    pending: list = []       # pods awaiting placement (retries ride along)
+    pod_seq = 0
+    crash_cycle = max(2, cycles // 3)
+    deadline_cycle = max(3, cycles // 2)
+
+    def _sync_cycle_delta(new_bound, forgotten):
+        """Mirror this cycle's bindings/completions to the sidecar; a
+        persistently-dropped delta is LOST (revision still advances) and
+        the next successful sync heals through the resync protocol."""
+        nonlocal revision
+        if client is None:
+            return
+        from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+        from koordinator_tpu.runtime.snapshot_channel import ChannelError
+
+        revision += 1
+        delta = pb.SnapshotDelta(revision=revision)
+        for pod, node in new_bound:
+            delta.pod_assumed.add(
+                uid=pod.meta.uid, node=node, requests=_vec(pod.spec.requests)
+            )
+        for uid in forgotten:
+            delta.pod_forgotten.append(uid)
+        # fold this delta into the authoritative ledger FIRST: when the
+        # server demands a resync, the full re-list must describe the
+        # world INCLUDING the rejected delta's content (a full state
+        # built from the pre-delta ledger would silently drop this
+        # cycle's changes while still advancing the revision)
+        for pod, node in new_bound:
+            live_synced[pod.meta.uid] = (node, dict(pod.spec.requests))
+        for uid in forgotten:
+            live_synced.pop(uid, None)
+        def counting_full_state():
+            # sync_with_resync asks for the full world only when the
+            # server reported a generation gap — count the heal here
+            stats["resyncs"] += 1
+            return full_state_fn()
+
+        try:
+            client.sync_with_resync(delta, counting_full_state)
+        except ChannelError:
+            # delta lost in transit: the sidecar now has a generation
+            # gap; the next successful sync heals it via the full
+            # re-list above (live_synced stays the authoritative ledger)
+            stats["sync_lost"] += 1
+
+    total_cycles = cycles + drain_limit
+    for cycle in range(total_cycles):
+        stats["cycles"] += 1
+        arriving = []
+        if cycle < cycles:
+            # ---- seeded fault schedule (arrivals stop at `cycles`;
+            # the drain tail runs fault-free so the backlog clears) ----
+            r = rng.random()
+            if r < 0.15:
+                chaos.arm("channel.sync.drop", times=1)          # retry heals
+            elif r < 0.20:
+                chaos.arm("channel.sync.drop", times=10)         # delta lost
+            if rng.random() < 0.10:
+                hub.disconnect()                                  # watch sever
+            if rng.random() < 0.06:
+                chaos.arm(
+                    "solver.dispatch", error=RuntimeError, times=1
+                )                                                 # ladder demote
+            if rng.random() < 0.05:
+                chaos.arm("solver.nan_rows", times=1)             # quarantine
+            if cycle == crash_cycle:
+                chaos.arm("commit.crash", error=RuntimeError, times=1)
+            surge = 0
+            if cycle == deadline_cycle:
+                # solve-latency spike + a surge so the cycle spans
+                # multiple chunks: the per-cycle deadline must defer the
+                # tail instead of wedging
+                chaos.arm("solver.dispatch", latency_s=1.0, times=1)
+                surge = 3 * sched.batch_bucket
+            for _ in range(rng.randint(1, max_arrivals) + surge):
+                pod_seq += 1
+                labels = {}
+                if pod_seq % 5 == 0:
+                    labels[ext.LABEL_QUOTA_NAME] = "soak-team"
+                arriving.append(
+                    Pod(
+                        meta=ObjectMeta(
+                            name=f"soak-{pod_seq:05d}", labels=labels
+                        ),
+                        spec=PodSpec(
+                            requests={
+                                ext.RES_CPU: POD_CPU,
+                                ext.RES_MEMORY: POD_MEM,
+                            },
+                            priority=9000 if pod_seq % 3 else 5500,
+                        ),
+                    )
+                )
+            stats["arrived"] += len(arriving)
+        pending.extend(arriving)
+        if not pending and cycle >= cycles:
+            break
+
+        out = sched.schedule(pending)
+        new_bound = []
+        for pod, node in out.bound:
+            # INVARIANT: a pod binds exactly once, ever
+            assert pod.meta.uid not in placed, (
+                f"pod {pod.meta.name} placed twice: "
+                f"{placed[pod.meta.uid]} then {node}"
+            )
+            placed[pod.meta.uid] = node
+            pod.spec.node_name = node
+            hub.publish(hub.pods, pod)
+            live.append((pod, node, cycle + LIFETIME))
+            new_bound.append((pod, node))
+        stats["placed"] += len(new_bound)
+        if sched._cycle_deadline_hit:
+            stats["deferred_cycles"] += 1
+        pending = list(out.unschedulable)
+
+        # ---- completions release capacity through the informer ----
+        forgotten = []
+        still = []
+        for pod, node, done in live:
+            if done <= cycle:
+                hub.delete(hub.pods, pod)
+                forgotten.append(pod.meta.uid)
+                stats["completed"] += 1
+            else:
+                still.append((pod, node, done))
+        live = still
+        assert hub.wait_synced()
+
+        _sync_cycle_delta(new_bound, forgotten)
+
+        # ---- per-cycle invariants ----
+        # quota never exceeded (leaf used ≤ max, chaos or not)
+        if q_idx is not None and q_idx < gqm.used.shape[0]:
+            assert np.all(gqm.used[q_idx] <= quota_max_vec + 1e-3), (
+                gqm.used[q_idx],
+                quota_max_vec,
+            )
+        # snapshot accounting never drifts (rollbacks included)
+        want = np.zeros_like(snap.nodes.requested)
+        for uid, ap in snap._assumed.items():
+            want[ap.node_idx] += ap.request
+        np.testing.assert_allclose(snap.nodes.requested, want, atol=1e-3)
+        if verbose and cycle % 25 == 0:
+            print(
+                f"cycle={cycle:4d} pending={len(pending):3d} "
+                f"placed={stats['placed']} lost_syncs={stats['sync_lost']} "
+                f"fallback_level={sched._fallback_level}"
+            )
+
+    # ---- end-state assertions ----
+    # every pod that ever arrived eventually placed
+    assert not pending, f"{len(pending)} pods never placed"
+    assert stats["placed"] == stats["arrived"] == len(placed)
+    # resident device state reconverged bit-exactly vs a full re-lower
+    assert_resident_state_converged(sched)
+    # capture the fault ledger BEFORE disarming for the final heal
+    stats["faults"] = chaos.fired_counts()
+    stats["fault_trace"] = list(chaos.trace)
+    chaos.disarm()
+    # the sidecar's world re-converged through the resync protocol
+    if client is not None:
+        _sync_cycle_delta([], [])   # fault-free final heal
+        side = service.snapshot
+        assert side.node_count == snap.node_count
+        # compare committed capacity per node name
+        for i in range(n_nodes):
+            name = f"n{i:03d}"
+            si, mi = side.node_id(name), snap.node_id(name)
+            np.testing.assert_allclose(
+                side.nodes.requested[si],
+                snap.nodes.requested[mi],
+                atol=1e-3,
+            )
+        client.close()
+        server.stop(grace=None)
+    hub.stop()
+    stats["fallback_level_final"] = sched._fallback_level
+    stats["health_ok"] = sched.extender.health.ok()
+    stats["metrics"] = {
+        "retry_attempts_channel_sync": reg.get(
+            "retry_attempts_total"
+        ).value(site="channel.sync"),
+        "commit_rollbacks_total": reg.get("commit_rollbacks_total").value(),
+        "cycle_deadline_exceeded_total": reg.get(
+            "cycle_deadline_exceeded_total"
+        ).value(),
+        "solver_fallback_l1": reg.get("solver_fallback_total").value(
+            level="1"
+        ),
+    }
+    return stats

@@ -22,8 +22,11 @@ kept in sync by informer handlers instead of direct setters (see
 from __future__ import annotations
 
 import dataclasses
+import random
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+from .retry import RetryPolicy
 
 #: watch event kinds
 ADDED = "ADDED"
@@ -171,12 +174,34 @@ class Informer:
         self,
         tracker: ObjectTracker,
         resync_interval_s: float = 0.0,
+        retry: Optional[RetryPolicy] = None,
+        chaos=None,
+        health=None,
+        name: str = "",
+        error_registry=None,
     ):
+        from ..chaos import NULL_INJECTOR
+
         self.tracker = tracker
         self.resync_interval_s = resync_interval_s
+        #: re-list backoff after REPEATED disconnects (the first re-list
+        #: is immediate — one disconnect is routine; a flapping stream
+        #: must not busy-spin LIST against the tracker)
+        self.retry = retry or RetryPolicy(
+            max_attempts=1 << 30, base_delay_s=0.02, max_delay_s=1.0
+        )
+        self.chaos = chaos or NULL_INJECTOR
+        #: optional obs.HealthRegistry + subsystem name for /healthz
+        self.health = health
+        self.name = name or f"informer-{id(self):x}"
+        self.error_registry = error_registry
         self._cache: Dict[str, object] = {}
         self._rv = 0
         self._lock = threading.Lock()
+        #: signalled whenever _rv advances (wait_synced blocks on it
+        #: instead of the former 5 ms busy-poll)
+        self._rv_cond = threading.Condition(self._lock)
+        self._backoff_rng = random.Random(0)
         self._on_add: List[Handler] = []
         self._on_update: List[Handler] = []
         self._on_delete: List[DeleteHandler] = []
@@ -184,6 +209,10 @@ class Informer:
         self._thread: Optional[threading.Thread] = None
         #: diagnostics: how many full re-lists ran (1 = initial sync)
         self.relists = 0
+        #: consecutive disconnects without a healthy event in between
+        self.consecutive_disconnects = 0
+        #: total seconds spent backing off before re-lists
+        self.backoff_total_s = 0.0
         #: (key, exception) pairs from handlers that raised (isolated)
         self.handler_errors: List[Tuple[str, Exception]] = []
 
@@ -222,7 +251,6 @@ class Informer:
         with self._lock:
             old = dict(self._cache)
             self._cache = dict(objects)
-            self._rv = rv
         for key, obj in objects.items():
             if key not in old:
                 self._call(self._on_add, key, obj)
@@ -231,6 +259,13 @@ class Informer:
         for key, obj in old.items():
             if key not in objects:
                 self._call(self._on_delete, key, obj)
+        # _rv becomes visible — and wait_synced wakes — only AFTER every
+        # handler ran: HasSynced means "consumers observed this state",
+        # not "the cache stored it" (a waiter woken between cache write
+        # and handler execution would read a consumer still behind)
+        with self._rv_cond:
+            self._rv = rv
+            self._rv_cond.notify_all()
         self.relists += 1
         return watch
 
@@ -241,13 +276,17 @@ class Informer:
             try:
                 h(key, obj)
             except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                from ..obs.errors import report_exception
+
+                report_exception(
+                    "informer.handler", e, registry=self.error_registry
+                )
                 self.handler_errors.append((key, e))
 
     def _apply(self, event: WatchEvent) -> None:
         if event.resource_version <= self._rv:
             return  # stale replay
         with self._lock:
-            self._rv = event.resource_version
             if event.kind == DELETED:
                 self._cache.pop(event.key, None)
             else:
@@ -258,22 +297,70 @@ class Informer:
             else self._on_add if event.kind == ADDED else self._on_update
         )
         self._call(handlers, event.key, event.obj)
+        # advance + notify only after handlers ran (see _relist): a
+        # wait_synced waiter woken at this rv must find every consumer
+        # already caught up, not mid-handler
+        with self._rv_cond:
+            self._rv = event.resource_version
+            self._rv_cond.notify_all()
 
     def run(self) -> None:
         """Blocking sync loop: initial list, then watch; any watch end
-        (disconnect/overflow) triggers a full re-list."""
+        (disconnect/overflow) triggers a full re-list. Repeated
+        disconnects back off per the shared RetryPolicy (a flapping
+        apiserver must not be hammered with LIST storms) and surface as
+        a degraded subsystem on the health registry; the chaos point
+        ``informer.watch_closed`` severs the live watch on demand."""
         import time
 
         watch = self._relist()
         last_resync = time.monotonic()
         while not self._stop.is_set():
+            if self.chaos.enabled and self.chaos.fire("informer.watch_closed"):
+                watch.close()   # injected disconnect: drain to WatchClosed
             try:
                 event = watch.next(timeout=0.05)
             except WatchClosed:
                 if self._stop.is_set():
                     break
+                self.consecutive_disconnects += 1
+                if self.consecutive_disconnects >= 2:
+                    # first re-list is immediate; a flapping stream backs
+                    # off (stop-aware wait so shutdown stays prompt)
+                    if self.health is not None:
+                        self.health.set(
+                            self.name,
+                            False,
+                            f"{self.consecutive_disconnects} consecutive "
+                            "watch disconnects; re-list backing off",
+                        )
+                    delay = self.retry.delay_for(
+                        self.consecutive_disconnects - 2, self._backoff_rng
+                    )
+                    self.backoff_total_s += delay
+                    if self.error_registry is not None:
+                        c = self.error_registry.get("retry_attempts_total")
+                        if c is None:
+                            c = self.error_registry.counter(
+                                "retry_attempts_total",
+                                "retries performed by shared RetryPolicy "
+                                "call sites",
+                                labels=("site",),
+                            )
+                        c.labels(site="informer.relist").inc()
+                    if self._stop.wait(delay):
+                        break
+                self.chaos.fire("informer.relist.delay")
                 watch = self._relist()   # informer re-list on disconnect
                 continue
+            if self.consecutive_disconnects:
+                # reaching here — an event OR a quiet poll timeout —
+                # proves the re-listed stream is alive again (a quiet
+                # tracker never emits events, so recovery must not
+                # depend on one arriving)
+                self.consecutive_disconnects = 0
+                if self.health is not None:
+                    self.health.set(self.name, True)
             if event is not None:
                 self._apply(event)
             if (
@@ -299,12 +386,17 @@ class Informer:
             self._thread.join(timeout=10)
 
     def wait_synced(self, rv: int, timeout: float = 10.0) -> bool:
-        """Block until the cache has observed ``rv`` (HasSynced analog)."""
+        """Block until the cache has observed ``rv`` (HasSynced analog).
+        Condition-variable wait: wakes exactly when ``_rv`` advances
+        (the former 5 ms ``time.sleep`` busy-poll burned a core-slice per
+        waiting consumer and added up to 5 ms latency per sync point)."""
         import time
 
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._rv >= rv:
-                return True
-            time.sleep(0.005)
-        return False
+        with self._rv_cond:
+            while self._rv < rv:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._rv_cond.wait(remaining)
+            return True

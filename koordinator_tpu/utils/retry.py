@@ -1,0 +1,100 @@
+"""Shared retry policy: exponential backoff + jitter + deadline.
+
+One policy object serves every retry site in the package — the gRPC
+``SolverClient`` calls, the informer re-list backoff after repeated watch
+disconnects, and the koordlet tick loop — so backoff behavior is tuned in
+one place and every attempt is visible in ``retry_attempts_total{site}``.
+
+The policy is a frozen value object; per-call state (attempt counter,
+deadline clock) lives in :meth:`run` / :meth:`delay_for` so one policy
+can be shared across threads. Jitter draws from a caller-supplied
+``random.Random`` so tests (and the chaos soak) stay deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+#: process-wide jitter source for callers that don't supply an RNG
+_MODULE_RNG = random.Random()
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: ``base * multiplier**attempt`` capped at
+    ``max_delay_s``, ±``jitter`` fraction, bounded by ``max_attempts``
+    and an optional overall ``deadline_s``."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    jitter: float = 0.1
+    deadline_s: Optional[float] = None
+
+    def delay_for(
+        self, attempt: int, rng: Optional[random.Random] = None
+    ) -> float:
+        """Backoff before retry number ``attempt`` (0-based). The
+        exponent is clamped before exponentiation: never-die loops feed
+        an unbounded attempt counter, and ``2.0 ** 1075`` would raise
+        OverflowError in exactly the loop backoff was meant to keep
+        alive (the min() against max_delay_s comes too late)."""
+        d = min(
+            self.base_delay_s * self.multiplier ** min(max(attempt, 0), 64),
+            self.max_delay_s,
+        )
+        if self.jitter > 0:
+            # jitter must apply even when the caller supplies no RNG —
+            # identical backoff schedules across a fleet recreate the
+            # thundering herd the jitter exists to break (tests pass a
+            # seeded rng or jitter=0 for determinism)
+            r = rng if rng is not None else _MODULE_RNG
+            d *= 1.0 + self.jitter * (2.0 * r.random() - 1.0)
+        return d
+
+    def run(
+        self,
+        fn: Callable,
+        retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+        site: str = "",
+        counter=None,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+        clock: Callable[[], float] = time.monotonic,
+        on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    ):
+        """Call ``fn`` until it succeeds, a non-retryable exception
+        escapes, attempts are exhausted, or the deadline would be blown
+        by the next backoff. ``counter`` is an optional
+        ``retry_attempts_total{site}`` Counter; ``on_retry(attempt,
+        exc)`` observes each retry decision."""
+        start = clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except retry_on as exc:
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    raise
+                delay = self.delay_for(attempt - 1, rng)
+                if (
+                    self.deadline_s is not None
+                    and clock() - start + delay > self.deadline_s
+                ):
+                    raise
+                if counter is not None:
+                    counter.labels(site=site).inc()
+                if on_retry is not None:
+                    on_retry(attempt, exc)
+                if delay > 0:
+                    sleep(delay)
+
+
+#: conservative default shared by call sites that don't tune their own
+DEFAULT_RETRY = RetryPolicy()

@@ -1,0 +1,657 @@
+"""Deterministic fault injection + failure-domain hardening (robustness
+PR tentpole): the injector's schedule/determinism/zero-overhead contract,
+the shared RetryPolicy, and the scheduler's defenses — NaN quarantine,
+solver fallback ladder with re-promotion, the transactional Reserve
+journal, the per-cycle deadline degrade, the feeder-queue stall guard —
+plus /healthz and the exceptions_total audit."""
+
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.chaos import (
+    NULL_INJECTOR,
+    ChaosError,
+    FaultInjector,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# injector mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_unarmed_point_is_inert(self):
+        inj = FaultInjector(seed=1)
+        assert inj.fire("anything") is False
+        assert inj.trace == []
+
+    def test_error_schedule_raises_and_traces(self):
+        inj = FaultInjector(seed=1)
+        inj.arm("p.err", error=ChaosError, times=2)
+        with pytest.raises(ChaosError):
+            inj.fire("p.err")
+        with pytest.raises(ChaosError):
+            inj.fire("p.err")
+        assert inj.fire("p.err") is False   # times exhausted
+        assert [(p, k) for _s, p, k in inj.trace] == [
+            ("p.err", "error"),
+            ("p.err", "error"),
+        ]
+
+    def test_latency_schedule_uses_injected_sleep(self):
+        slept = []
+        inj = FaultInjector(seed=1, sleep=slept.append)
+        inj.arm("p.slow", latency_s=0.5)
+        assert inj.fire("p.slow") is True
+        assert slept == [0.5]
+
+    def test_at_hits_fires_exactly_on_those_evaluations(self):
+        inj = FaultInjector(seed=1)
+        inj.arm("p", at_hits={2, 4})
+        assert [inj.fire("p") for _ in range(5)] == [
+            False, True, False, True, False,
+        ]
+
+    def test_probability_is_seed_deterministic(self):
+        def run(seed):
+            inj = FaultInjector(seed=seed)
+            inj.arm("p", probability=0.5)
+            return [inj.fire("p") for _ in range(32)]
+
+        assert run(3) == run(3)
+        assert run(3) != run(4)   # astronomically unlikely to collide
+
+    def test_disarm_restores_fast_path(self):
+        inj = FaultInjector()
+        inj.arm("p")
+        assert inj.enabled
+        inj.disarm("p")
+        assert not inj.enabled
+
+    def test_counter_records_fired_points(self):
+        from koordinator_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("fault_injected_total", "", labels=("point",))
+        inj = FaultInjector(counter=c)
+        inj.arm("p.x", times=3)
+        for _ in range(5):
+            inj.fire("p.x")
+        assert c.value(point="p.x") == 3.0
+
+
+class TestDisabledOverhead:
+    def test_null_injector_is_shared_and_disabled(self):
+        assert NULL_INJECTOR.enabled is False
+        assert NULL_INJECTOR.fire("any.point") is False
+
+    def test_disabled_fire_overhead_negligible(self):
+        # same guard shape as test_obs_overhead: 100k disabled fire()
+        # calls well under a second (one attribute read + return each)
+        inj = FaultInjector()
+        n = 100_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            inj.fire("hot.point")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"{n} disabled fires took {elapsed:.2f}s"
+        assert inj.trace == []
+
+    def test_scheduler_without_chaos_uses_null_injector(self):
+        s = BatchScheduler()
+        s.extender.monitor.stop_background()
+        assert s.chaos is NULL_INJECTOR
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_exponential_backoff_capped(self):
+        p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=0.35,
+                        jitter=0.0)
+        assert [p.delay_for(i) for i in range(4)] == [
+            0.1, 0.2, 0.35, 0.35,
+        ]
+
+    def test_delay_for_never_overflows_on_huge_attempt_counts(self):
+        # never-die loops (informer re-list, koordlet ticks) feed an
+        # unbounded attempt counter; 2.0**1075 would raise OverflowError
+        p = RetryPolicy(base_delay_s=0.5, multiplier=2.0, max_delay_s=30.0,
+                        jitter=0.0)
+        assert p.delay_for(2000) == 30.0
+        assert p.delay_for(10**9) == 30.0
+
+    def test_run_retries_then_succeeds(self):
+        calls = []
+        slept = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.01, jitter=0.0)
+        assert p.run(fn, retry_on=(OSError,), sleep=slept.append) == "ok"
+        assert len(calls) == 3 and len(slept) == 2
+
+    def test_run_exhausts_attempts(self):
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("always")
+
+        with pytest.raises(ValueError):
+            p.run(fn, retry_on=(ValueError,), sleep=lambda _s: None)
+        assert len(calls) == 3
+
+    def test_non_retryable_escapes_immediately(self):
+        p = RetryPolicy(max_attempts=5)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise KeyError("fatal")
+
+        with pytest.raises(KeyError):
+            p.run(fn, retry_on=(OSError,), sleep=lambda _s: None)
+        assert len(calls) == 1
+
+    def test_deadline_bounds_total_wait(self):
+        p = RetryPolicy(
+            max_attempts=100, base_delay_s=1.0, jitter=0.0, deadline_s=2.5
+        )
+        clock = [0.0]
+
+        def fake_sleep(s):
+            clock[0] += s
+
+        def fn():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            p.run(
+                fn,
+                retry_on=(OSError,),
+                sleep=fake_sleep,
+                clock=lambda: clock[0],
+            )
+        assert clock[0] <= 2.5
+
+    def test_counter_labels_site(self):
+        from koordinator_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("retry_attempts_total", "", labels=("site",))
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+        state = {"n": 0}
+
+        def fn():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise OSError()
+            return 1
+
+        p.run(fn, retry_on=(OSError,), site="s1", counter=c,
+              sleep=lambda _s: None)
+        assert c.value(site="s1") == 2.0
+
+
+# ---------------------------------------------------------------------------
+# scheduler hardening
+# ---------------------------------------------------------------------------
+
+
+def _mk_sched(n_nodes=4, **kw):
+    s = BatchScheduler(
+        args=LoadAwareArgs(usage_thresholds={}), batch_bucket=8, **kw
+    )
+    s.extender.monitor.stop_background()
+    for i in range(n_nodes):
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000.0, ext.RES_MEMORY: 65536.0}
+                ),
+            )
+        )
+    return s
+
+
+def _pods(n, prefix="p", cpu=1000.0):
+    return [
+        Pod(
+            meta=ObjectMeta(name=f"{prefix}{i}", uid=f"{prefix}{i}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 256.0},
+                priority=9000,
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _accounting_ok(snap):
+    want = np.zeros_like(snap.nodes.requested)
+    for _uid, ap in snap._assumed.items():
+        want[ap.node_idx] += ap.request
+    np.testing.assert_allclose(snap.nodes.requested, want, atol=1e-3)
+
+
+def _resident_ok(sched):
+    from koordinator_tpu.sim.longrun import assert_resident_state_converged
+
+    assert_resident_state_converged(sched)
+
+
+class TestNanQuarantine:
+    def test_injected_nan_row_is_quarantined_not_placed(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        chaos.arm("solver.nan_rows", times=1)
+        pods = _pods(4)
+        out = s.schedule(pods)
+        # the corrupted pod (row 0) is rejected with the new reason;
+        # everyone else places normally
+        assert len(out.bound) == 3
+        assert [p.meta.uid for p in out.unschedulable] == ["p0"]
+        recs = s.extender.rejections.for_uid("p0")
+        assert recs and recs[-1].reason == "nan_inf_quarantined"
+        assert recs[-1].plugin == "numeric_guard"
+        _accounting_ok(s.snapshot)
+
+    def test_genuinely_nonfinite_spec_is_quarantined(self):
+        s = _mk_sched()
+        bad = Pod(
+            meta=ObjectMeta(name="bad", uid="bad"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: float("inf"), ext.RES_MEMORY: 1.0},
+                priority=9000,
+            ),
+        )
+        out = s.schedule([bad] + _pods(2, prefix="ok"))
+        assert {p.meta.uid for p in out.unschedulable} == {"bad"}
+        assert len(out.bound) == 2
+
+    def test_quarantined_pod_retries_clean_next_cycle(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        chaos.arm("solver.nan_rows", times=1)
+        pods = _pods(2)
+        out1 = s.schedule(pods)
+        assert len(out1.unschedulable) == 1
+        out2 = s.schedule(out1.unschedulable)   # injection exhausted
+        assert len(out2.bound) == 1
+        _accounting_ok(s.snapshot)
+
+
+class TestFallbackLadder:
+    def test_dispatch_failure_falls_back_and_still_places(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos, fallback_repromote_after=2)
+        chaos.arm("solver.dispatch", error=RuntimeError, times=1)
+        pods = _pods(6)
+        out = s.schedule(pods)
+        # the host reference path placed everyone despite the failure
+        assert len(out.bound) == 6
+        assert s._fallback_level >= 1
+        reg = s.extender.registry
+        assert reg.get("solver_fallback_total").value(level="1") >= 1.0
+        assert not s.extender.health.ok()
+        _accounting_ok(s.snapshot)
+
+    def test_repromotion_after_clean_cycles(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos, fallback_repromote_after=2)
+        chaos.arm("solver.dispatch", error=RuntimeError, times=1)
+        s.schedule(_pods(2, prefix="a"))
+        assert s._fallback_level == 1
+        s.schedule(_pods(2, prefix="b"))
+        s.schedule(_pods(2, prefix="c"))
+        assert s._fallback_level == 0
+        assert s.extender.health.ok()
+
+    def test_both_device_levels_fail_host_reference_places(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        chaos.arm("solver.dispatch", error=RuntimeError, times=1)
+        chaos.arm("solver.dispatch_chunk", error=RuntimeError, times=1)
+        out = s.schedule(_pods(5))
+        assert len(out.bound) == 5
+        assert s._fallback_level == 2
+        _accounting_ok(s.snapshot)
+
+    def test_host_reference_respects_node_constraints(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        s._fallback_level = 2   # pin degraded mode
+        pods = _pods(3)
+        pods[1].spec.node_name = "n2"
+        out = s.schedule(pods)
+        nodes = {p.meta.uid: n for p, n in out.bound}
+        assert nodes["p1"] == "n2"
+        assert len(out.bound) == 3
+
+    def test_host_reference_respects_quota_max(self):
+        from koordinator_tpu.api.types import ElasticQuota
+        from koordinator_tpu.scheduler.plugins.elasticquota import (
+            GroupQuotaManager,
+        )
+        from koordinator_tpu.core.snapshot import ClusterSnapshot
+
+        snap = ClusterSnapshot()
+        gqm = GroupQuotaManager(snap.config, enable_preemption=False)
+        gqm.upsert_quota(
+            ElasticQuota(
+                meta=ObjectMeta(name="team"),
+                min={ext.RES_CPU: 1000, ext.RES_MEMORY: 256},
+                max={ext.RES_CPU: 2000, ext.RES_MEMORY: 512},
+            )
+        )
+        s = BatchScheduler(
+            snap,
+            LoadAwareArgs(usage_thresholds={}),
+            quotas=gqm,
+            batch_bucket=8,
+        )
+        s.extender.monitor.stop_background()
+        for i in range(4):
+            snap.upsert_node(
+                Node(
+                    meta=ObjectMeta(name=f"n{i}"),
+                    status=NodeStatus(
+                        allocatable={
+                            ext.RES_CPU: 32000.0,
+                            ext.RES_MEMORY: 65536.0,
+                        }
+                    ),
+                )
+            )
+        s._fallback_level = 2
+        pods = _pods(4)
+        for p in pods:
+            p.meta.labels[ext.LABEL_QUOTA_NAME] = "team"
+        out = s.schedule(pods)
+        # max of 2000 CPU admits exactly two 1000-CPU pods
+        assert len(out.bound) == 2
+        q = s.quotas.index_of("team")
+        assert np.all(
+            s.quotas.used[q] <= snap.config.res_vector(
+                {ext.RES_CPU: 2000, ext.RES_MEMORY: 512}
+            ) + 1e-3
+        )
+
+
+class TestReserveJournal:
+    def test_mid_commit_crash_rolls_back_bit_exactly(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        # prime: one normal cycle so the resident state exists
+        pre = s.schedule(_pods(2, prefix="pre"))
+        assert len(pre.bound) == 2
+        before_req = s.snapshot.nodes.requested.copy()
+        before_assumed = set(s.snapshot._assumed)
+        chaos.arm("commit.crash", error=RuntimeError, times=1)
+        out = s.schedule(_pods(4, prefix="x"))
+        # the whole chunk rolled back: nothing bound, nothing leaked
+        assert out.bound == []
+        assert len(out.unschedulable) == 4
+        np.testing.assert_array_equal(
+            s.snapshot.nodes.requested, before_req
+        )
+        assert set(s.snapshot._assumed) == before_assumed
+        reg = s.extender.registry
+        assert reg.get("commit_rollbacks_total").value() == 1.0
+        recs = s.extender.rejections.for_uid("x0")
+        assert recs and recs[-1].reason == "commit_rolled_back"
+        # the dirty-row ledger reconciled: resident state == full re-lower
+        _resident_ok(s)
+        _accounting_ok(s.snapshot)
+
+    def test_rolled_back_pods_place_next_cycle(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        chaos.arm("commit.crash", error=RuntimeError, times=1)
+        out1 = s.schedule(_pods(3))
+        assert out1.bound == []
+        out2 = s.schedule(out1.unschedulable)
+        assert len(out2.bound) == 3
+        _resident_ok(s)
+        assert s.extender.health.ok()   # commit recovered after clean cycle
+
+    def test_reassume_rollback_restores_prior_charge(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        pods = _pods(1)
+        out = s.schedule(pods)
+        assert len(out.bound) == 1
+        prior_req = s.snapshot.nodes.requested.copy()
+        # schedule the SAME pod again (retry/re-schedule path re-assumes)
+        chaos.arm("commit.crash", error=RuntimeError, times=1)
+        out2 = s.schedule(pods)
+        assert out2.bound == []
+        # prior charge restored bit-exactly, pod still assumed
+        np.testing.assert_array_equal(
+            s.snapshot.nodes.requested, prior_req
+        )
+        assert s.snapshot.is_assumed("p0")
+        _resident_ok(s)
+
+
+class TestCycleDeadline:
+    def test_deadline_defers_remaining_chunks_and_degrades(self):
+        chaos = FaultInjector()
+        s = _mk_sched(
+            n_nodes=8, chaos=chaos, cycle_deadline_s=0.05,
+            fallback_repromote_after=2,
+        )
+        s.batch_bucket = 64   # allow degrade room (floor is 16)
+        chaos.arm("solver.dispatch", latency_s=0.2, times=1)
+        # force multiple chunks via a tiny effective bucket: 70 pods over
+        # bucket 64 → 2 chunks; the injected latency blows the deadline
+        pods = _pods(70, cpu=100.0)
+        out = s.schedule(pods)
+        reg = s.extender.registry
+        assert reg.get("cycle_deadline_exceeded_total").value() == 1.0
+        # some pods deferred with the counted reason, none lost
+        deferred = [
+            r
+            for p in out.unschedulable
+            for r in s.extender.rejections.for_uid(p.meta.uid)
+            if r.reason == "cycle_deadline_exceeded"
+        ]
+        assert deferred
+        assert len(out.bound) + len(out.unschedulable) == 70
+        # batch degraded for the next cycle
+        assert s.effective_batch_bucket() < 64
+        # deferred pods place on the (fault-free) next cycles
+        pending = out.unschedulable
+        for _ in range(4):
+            nxt = s.schedule(pending)
+            pending = nxt.unschedulable
+            if not pending:
+                break
+        assert not pending
+        _accounting_ok(s.snapshot)
+
+    def test_clean_cycles_restore_bucket(self):
+        s = _mk_sched(cycle_deadline_s=10.0, fallback_repromote_after=1)
+        s.batch_bucket = 64
+        s._bucket_degrade = 1
+        s.schedule(_pods(2))
+        assert s._bucket_degrade == 0
+        assert s.effective_batch_bucket() == 64
+
+
+class TestFeederStall:
+    def test_stalled_fetch_surfaces_and_requeues(self):
+        chaos = FaultInjector()
+        s = _mk_sched(n_nodes=8, chaos=chaos, fetch_timeout_s=0.5)
+        s.batch_bucket = 4
+        # per-chunk pipelined path uses the prefetch feeder; stall it
+        s._fallback_level = 1
+        chaos.arm("solver.fetch.stall", times=1)
+        pods = _pods(12, cpu=100.0)
+        out = s.schedule(pods)
+        stalled = [
+            r
+            for p in out.unschedulable
+            for r in s.extender.rejections.for_uid(p.meta.uid)
+            if r.reason == "solve_result_stalled"
+        ]
+        assert stalled, "stall must surface as a counted RejectReason"
+        assert len(out.bound) + len(out.unschedulable) == 12
+        # re-enqueued pods drain next cycle
+        out2 = s.schedule(out.unschedulable)
+        assert not out2.unschedulable
+        _accounting_ok(s.snapshot)
+
+
+# ---------------------------------------------------------------------------
+# /healthz + exception accounting
+# ---------------------------------------------------------------------------
+
+
+class TestHealthz:
+    def test_healthy_engine_returns_200(self):
+        s = _mk_sched()
+        code, body = s.extender.services.dispatch("GET", "/healthz")
+        assert code == 200
+        import json
+
+        doc = json.loads(body)
+        assert doc["ok"] is True
+        assert doc["subsystems"]["solver"]["ok"] is True
+
+    def test_degraded_solver_returns_503_then_recovers(self):
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos, fallback_repromote_after=1)
+        chaos.arm("solver.dispatch", error=RuntimeError, times=1)
+        s.schedule(_pods(2, prefix="a"))
+        code, body = s.extender.services.dispatch("GET", "/healthz")
+        assert code == 503
+        assert '"ok": false' in body
+        s.schedule(_pods(2, prefix="b"))   # clean cycle re-promotes
+        code, _ = s.extender.services.dispatch("GET", "/healthz")
+        assert code == 200
+
+
+class TestExceptionAudit:
+    def test_report_exception_counts_into_registry(self):
+        from koordinator_tpu.obs import report_exception
+        from koordinator_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        report_exception("site.a", ValueError("x"), registry=reg)
+        report_exception("site.a", ValueError("y"), registry=reg)
+        assert reg.get("exceptions_total").value(site="site.a") == 2.0
+
+    def test_informer_handler_errors_are_counted(self):
+        from koordinator_tpu.utils.informer import Informer, ObjectTracker
+        from koordinator_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        tracker = ObjectTracker()
+        inf = Informer(tracker, error_registry=reg)
+        inf.add_handlers(on_add=lambda k, o: 1 / 0)
+        tracker.upsert("a", object())
+        inf._relist()
+        assert inf.handler_errors
+        assert reg.get("exceptions_total").value(site="informer.handler") >= 1.0
+
+    def test_koordlet_collector_failures_are_counted(self):
+        from koordinator_tpu.koordlet.daemon import Koordlet, KoordletConfig
+
+        k = Koordlet(KoordletConfig(n_cpus=2, cgroup_root="/nonexistent",
+                                    proc_root="/nonexistent"))
+
+        class Boom:
+            def collect(self, now):
+                raise RuntimeError("collector down")
+
+        k.collectors = [Boom()]
+        k.collect_tick(now=1000.0)
+        assert (
+            k.registry.get("collect_errors_total").value(collector="Boom")
+            == 1.0
+        )
+        assert (
+            k.registry.get("exceptions_total").value(
+                site="koordlet.collector.Boom"
+            )
+            == 1.0
+        )
+
+
+class TestInformerBackoff:
+    def test_repeated_disconnects_back_off_and_recover(self):
+        from koordinator_tpu.obs import HealthRegistry
+        from koordinator_tpu.utils.informer import Informer, ObjectTracker
+
+        chaos = FaultInjector()
+        health = HealthRegistry()
+        tracker = ObjectTracker()
+        inf = Informer(
+            tracker,
+            chaos=chaos,
+            health=health,
+            name="informer.test",
+            retry=RetryPolicy(
+                max_attempts=1 << 30, base_delay_s=0.01, max_delay_s=0.05,
+                jitter=0.0,
+            ),
+        )
+        tracker.upsert("a", object())
+        chaos.arm("informer.watch_closed", times=4)
+        inf.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while inf.relists < 5 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert inf.relists >= 5   # initial + 4 injected disconnects
+            assert inf.backoff_total_s > 0.0
+            # after the injection budget is spent the stream stabilizes
+            deadline = time.monotonic() + 5.0
+            while not health.ok() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert health.ok()
+            assert inf.consecutive_disconnects == 0
+        finally:
+            inf.stop()
+
+    def test_wait_synced_wakes_on_condition_not_poll(self):
+        from koordinator_tpu.utils.informer import Informer, ObjectTracker
+
+        tracker = ObjectTracker()
+        inf = Informer(tracker)
+        inf.start()
+        try:
+            rv = tracker.upsert("k", object())
+            t0 = time.perf_counter()
+            assert inf.wait_synced(rv, timeout=5.0)
+            assert time.perf_counter() - t0 < 2.0
+            # timeout path returns False promptly
+            assert inf.wait_synced(rv + 100, timeout=0.05) is False
+        finally:
+            inf.stop()

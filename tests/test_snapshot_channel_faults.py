@@ -1,0 +1,248 @@
+"""Snapshot-channel failure domain: typed errors, per-call deadlines,
+retry-healed drops, and generation-gap full-resync under injected RPC
+drops (robustness PR satellites — previously only the happy path of
+``sync_with_resync`` was exercised)."""
+
+import grpc
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.chaos import FaultInjector
+from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+from koordinator_tpu.runtime.snapshot_channel import (
+    ChannelCallError,
+    ChannelError,
+    ChannelTimeout,
+    ChannelUnavailable,
+    SolverClient,
+    SolverService,
+    _map_rpc_error,
+    serve,
+)
+from koordinator_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+
+def cpu_mem_vec(cfg, cpu, mem):
+    values = []
+    for r in cfg.resources:
+        if r == ext.RES_CPU:
+            values.append(float(cpu))
+        elif r == ext.RES_MEMORY:
+            values.append(float(mem))
+        else:
+            values.append(0.0)
+    return pb.ResourceVector(values=values)
+
+
+@pytest.fixture()
+def loopback():
+    service = SolverService()
+    service.scheduler.extender.monitor.stop_background()
+    server, port = serve(service)
+    yield service, port
+    server.stop(grace=None)
+
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, code, details=""):
+        self._code = code
+        self._details = details
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+
+class TestTypedErrors:
+    def test_status_codes_map_to_typed_errors(self):
+        e = _map_rpc_error(
+            "sync", _FakeRpcError(grpc.StatusCode.UNAVAILABLE, "conn reset")
+        )
+        assert isinstance(e, ChannelUnavailable)
+        assert e.code == grpc.StatusCode.UNAVAILABLE
+        e = _map_rpc_error(
+            "sync", _FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+        )
+        assert isinstance(e, ChannelTimeout)
+        e = _map_rpc_error(
+            "nominate", _FakeRpcError(grpc.StatusCode.INTERNAL, "boom")
+        )
+        assert isinstance(e, ChannelCallError)
+        assert isinstance(e, ChannelError)
+
+    def test_unreachable_target_raises_typed_not_raw(self):
+        # no server on this port; tight deadline turns it into a typed
+        # error instead of a raw grpc.RpcError
+        client = SolverClient("127.0.0.1:1", timeout_s=0.2)
+        try:
+            with pytest.raises(ChannelError) as ei:
+                client.get_config()
+            assert isinstance(
+                ei.value, (ChannelUnavailable, ChannelTimeout)
+            )
+            assert not isinstance(ei.value, grpc.RpcError)
+        finally:
+            client.close()
+
+    def test_per_call_deadline_times_out_hung_server(self, loopback):
+        service, port = loopback
+        # wedge the service lock so Sync can't answer
+        service._lock.acquire()
+        client = SolverClient(f"127.0.0.1:{port}", timeout_s=0.2)
+        try:
+            with pytest.raises(ChannelTimeout):
+                client.sync(pb.SnapshotDelta(revision=1))
+        finally:
+            service._lock.release()
+            client.close()
+
+
+class TestInjectedDrops:
+    def test_one_shot_drop_healed_by_retry(self, loopback):
+        from koordinator_tpu.utils.metrics import Registry
+
+        service, port = loopback
+        cfg = service.snapshot.config
+        reg = Registry()
+        counter = reg.counter("retry_attempts_total", "", labels=("site",))
+        chaos = FaultInjector()
+        client = SolverClient(
+            f"127.0.0.1:{port}",
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.001, jitter=0.0),
+            chaos=chaos,
+            retry_counter=counter,
+        )
+        try:
+            chaos.arm("channel.sync.drop", times=1)
+            delta = pb.SnapshotDelta(revision=1)
+            delta.node_upserts.add(
+                name="n0", allocatable=cpu_mem_vec(cfg, 32000, 1 << 17)
+            )
+            ack = client.sync(delta)
+            assert ack.applied_revision == 1
+            assert service.snapshot.node_count == 1
+            assert counter.value(site="channel.sync") == 1.0
+        finally:
+            client.close()
+
+    def test_persistent_drop_exhausts_retries(self, loopback):
+        _service, port = loopback
+        chaos = FaultInjector()
+        client = SolverClient(
+            f"127.0.0.1:{port}",
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.001, jitter=0.0),
+            chaos=chaos,
+        )
+        try:
+            chaos.arm("channel.sync.drop")   # unlimited
+            with pytest.raises(ChannelUnavailable):
+                client.sync(pb.SnapshotDelta(revision=1))
+            assert chaos.spec("channel.sync.drop").fired == 3
+        finally:
+            client.close()
+
+    def test_injected_delay_applies_schedule(self, loopback):
+        _service, port = loopback
+        slept = []
+        chaos = FaultInjector(sleep=slept.append)
+        client = SolverClient(f"127.0.0.1:{port}", chaos=chaos)
+        try:
+            chaos.arm("channel.get_config.delay", latency_s=0.3, times=1)
+            client.get_config()
+            assert slept == [0.3]
+        finally:
+            client.close()
+
+
+class TestGenerationGapUnderDrops:
+    """The satellite: the full-resync protocol exercised by genuinely
+    dropped RPCs (not just hand-built revision gaps)."""
+
+    def _world(self, cfg):
+        d1 = pb.SnapshotDelta(revision=1, now=1000.0)
+        for i in range(3):
+            d1.node_upserts.add(
+                name=f"n{i}", allocatable=cpu_mem_vec(cfg, 32000, 1 << 17)
+            )
+        d2 = pb.SnapshotDelta(revision=2, now=1001.0)
+        d2.pod_assumed.add(
+            uid="p-a", node="n1", requests=cpu_mem_vec(cfg, 4000, 4096)
+        )
+        d3 = pb.SnapshotDelta(revision=3, now=1002.0)
+        d3.pod_assumed.add(
+            uid="p-b", node="n2", requests=cpu_mem_vec(cfg, 2000, 2048)
+        )
+        d3.pod_forgotten.append("p-a")
+
+        def full_state():
+            full = pb.SnapshotDelta(now=1002.0)
+            for i in range(3):
+                full.node_upserts.add(
+                    name=f"n{i}",
+                    allocatable=cpu_mem_vec(cfg, 32000, 1 << 17),
+                )
+            full.pod_assumed.add(
+                uid="p-b", node="n2", requests=cpu_mem_vec(cfg, 2000, 2048)
+            )
+            return full
+
+        return [d1, d2, d3], full_state
+
+    def test_dropped_delta_forces_resync_and_converges(self, loopback):
+        service, port = loopback
+        cfg = service.snapshot.config
+        chaos = FaultInjector()
+        client = SolverClient(
+            f"127.0.0.1:{port}",
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.001, jitter=0.0),
+            chaos=chaos,
+        )
+        try:
+            deltas, full_state = self._world(cfg)
+            client.sync(deltas[0])
+            # delta 2 dropped beyond the retry budget: genuinely lost
+            chaos.arm("channel.sync.drop", times=2)
+            with pytest.raises(ChannelUnavailable):
+                client.sync(deltas[1])
+            # delta 3 arrives: the solver detects the generation gap and
+            # the client answers with the authoritative full re-list
+            ack = client.sync_with_resync(deltas[2], full_state)
+            assert not ack.resync_required
+            assert ack.applied_revision == 3
+            snap = service.snapshot
+            assert snap.node_count == 3
+            assert not snap.is_assumed("p-a")   # lost delta's assume absent
+            assert snap.is_assumed("p-b")
+            idx = snap.node_id("n2")
+            cpu_i = list(cfg.resources).index(ext.RES_CPU)
+            assert snap.nodes.requested[idx][cpu_i] == 2000.0
+        finally:
+            client.close()
+
+    def test_drop_during_resync_answer_retried(self, loopback):
+        service, port = loopback
+        cfg = service.snapshot.config
+        chaos = FaultInjector()
+        client = SolverClient(
+            f"127.0.0.1:{port}",
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.001, jitter=0.0),
+            chaos=chaos,
+        )
+        try:
+            deltas, full_state = self._world(cfg)
+            client.sync(deltas[0])
+            chaos.arm("channel.sync.drop", times=5)   # loses delta 2 (3 fires)
+            with pytest.raises(ChannelUnavailable):
+                client.sync(deltas[1])
+            # delta 3's first attempt burns fire 4, succeeds on 5's
+            # exhaustion... and the RESYNC answer itself survives the
+            # remaining drop budget through the same retry policy
+            ack = client.sync_with_resync(deltas[2], full_state)
+            assert not ack.resync_required
+            assert service.snapshot.is_assumed("p-b")
+        finally:
+            client.close()
