@@ -170,9 +170,9 @@ def main(argv=None) -> None:
         default=None,
         metavar="NAME",
         help="run bench_suite scenario(s) (loadaware / numa / device_gang "
-        "/ quota_tree / latency_stream) instead of the headline metric, "
-        "honoring --stage-report/--trace; results merge into "
-        "BENCH_SUITE.json",
+        "/ quota_tree / latency_stream / stream_pipelined) instead of the "
+        "headline metric, honoring --stage-report/--trace; results merge "
+        "into BENCH_SUITE.json",
     )
     args = ap.parse_args(argv)
     if args.scenario:

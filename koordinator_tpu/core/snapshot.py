@@ -186,6 +186,8 @@ class PodArrays:
     #: quota non-preemptible pods (LabelPreemptible=false): admission
     #: additionally bounds them by quota MIN (``plugin.go:252-262``)
     non_preemptible: Optional[np.ndarray] = None
+    #: rows served from the caller's interned-row cache this build
+    intern_hits: int = 0
 
     @classmethod
     def empty(cls, p_bucket: int, dims: int) -> "PodArrays":
@@ -205,6 +207,48 @@ class PodArrays:
             fpga=np.zeros((p_bucket,), np.int32),
             p_real=0,
         )
+
+
+@dataclasses.dataclass(slots=True)
+class InternedPodRow:
+    """Lowered row data for one pending pod, cached across cycles keyed on
+    (uid, spec fingerprint) — ROADMAP item (c): a retry-heavy stream
+    re-lowers the same still-pending pod every cycle, and the per-pod
+    parse chain (requests walk, device-resource split, QoS/gang/quota
+    label+annotation reads) was the measurable slice. The fingerprint is
+    three tuple-hashes (requests, labels, annotations — far cheaper than
+    the parse chain it replaces) so an in-place spec edit self-invalidates
+    the entry rather than resurrecting stale rows."""
+
+    fp: tuple
+    req: np.ndarray          # [D] request row (owned copy)
+    priority: int
+    qos_explicit: int        # -1 = no explicit label
+    gang: Optional[str]      # raw gang name (annotation/label), not ns-key
+    gang_min: Optional[str]  # raw min-available label value
+    gang_nonstrict: bool
+    gpu_whole: int
+    gpu_share: float
+    rdma: float
+    fpga: float
+    quota_name: Optional[str]
+    est_override: bool
+    numa_required: bool
+    non_preemptible: bool
+
+
+def pod_fingerprint(pod: Pod) -> tuple:
+    """Cheap content fingerprint of the spec fields ``build_pods`` reads."""
+    spec = pod.spec
+    meta = pod.meta
+    return (
+        spec.priority,
+        hash(tuple(spec.requests.items())),
+        hash(tuple(meta.labels.items())),
+        hash(tuple(meta.annotations.items())),
+        bool(spec.estimated),
+        bool(spec.limits),
+    )
 
 
 @dataclasses.dataclass(slots=True)
@@ -884,6 +928,7 @@ class ClusterSnapshot:
         min_member_by_gang: Optional[Mapping[str, int]] = None,
         nonstrict_by_gang: Optional[Mapping[str, bool]] = None,
         bucket: Optional[int] = None,
+        row_cache: Optional[Dict[str, "InternedPodRow"]] = None,
     ) -> PodArrays:
         """Lower pending pods to dense arrays. ``bucket`` overrides the
         padded row count (the scanned multi-chunk dispatch needs every
@@ -930,87 +975,142 @@ class ClusterSnapshot:
         quota_key = ext.LABEL_QUOTA_NAME
         custom_est_key = ext.ANNOTATION_CUSTOM_ESTIMATED_SCALING_FACTORS
         numa_spec_key = ext.ANNOTATION_NUMA_TOPOLOGY_SPEC
+        intern_hits = 0
         for i, pod in enumerate(pods):
             spec = pod.spec
             meta = pod.meta
             labels = meta.labels
             uids.append(meta.uid)
-            quota_names.append(labels.get(quota_key))
-            if (
-                labels.get(preemptible_key) == "false"
-                or labels.get(disable_key) == "true"
-            ):
-                non_preemptible[i] = True
-            if spec.estimated or spec.limits or custom_est_key in meta.annotations:
-                est_override[i] = True
-            if numa_spec_key in meta.annotations:
-                # pod-level NUMA requirement API (numa_aware.go:29-31):
-                # SingleNUMANode requires a single-zone fit for THIS pod
-                # regardless of the node's own policy label
-                numa_spec = ext.parse_numa_topology_spec(meta.annotations)
+            ent = fp = None
+            if row_cache is not None:
+                fp = pod_fingerprint(pod)
+                ent = row_cache.get(meta.uid)
+                if ent is not None and ent.fp != fp:
+                    # spec changed under the same uid: stale rows must
+                    # never resurrect — fall through to a fresh parse
+                    ent = None
+            if ent is not None:
+                # interned fast path (ROADMAP item c): restore the
+                # lowered row verbatim; gang GROUPING below still runs
+                # per batch (gang ids are batch-local)
+                intern_hits += 1
+                quota_names.append(ent.quota_name)
+                non_preemptible[i] = ent.non_preemptible
+                est_override[i] = ent.est_override
+                numa_required[i] = ent.numa_required
+                priority[i] = ent.priority
+                req_rows[i] = ent.req
+                out.gpu_whole[i] = ent.gpu_whole
+                out.gpu_share[i] = ent.gpu_share
+                out.rdma[i] = ent.rdma
+                out.fpga[i] = ent.fpga
+                if ent.qos_explicit >= 0:
+                    explicit_qos.append((i, ent.qos_explicit))
+                gang = ent.gang
+                label_min = ent.gang_min
+                gang_pod_nonstrict = ent.gang_nonstrict
+            else:
+                quota_names.append(labels.get(quota_key))
                 if (
-                    numa_spec
-                    and numa_spec.get("numaTopologyPolicy") == "SingleNUMANode"
+                    labels.get(preemptible_key) == "false"
+                    or labels.get(disable_key) == "true"
                 ):
-                    numa_required[i] = True
-            priority[i] = spec.priority or 0
-            whole = 0
-            ratio_mem: Optional[float] = None
-            core = 0.0
-            for k, v in spec.requests.items():
-                j = res_index.get(k)
-                if j is not None:
-                    req_rows[i, j] = v
-                # device parsing is NOT exclusive with the dense axis: a
-                # deployment may append device resources to
-                # SnapshotConfig.resources (DEFAULT_RESOURCES invites it)
-                # and the device manager must still see the request
-                if k == ext.RES_GPU:
-                    whole = int(v)
-                elif k == ext.RES_GPU_MEMORY_RATIO:
-                    ratio_mem = float(v)
-                elif k == ext.RES_GPU_CORE:
-                    core = float(v)
-                elif k == ext.RES_RDMA:
-                    out.rdma[i] = ext._count_request(spec.requests, k)
-                elif k == ext.RES_FPGA:
-                    out.fpga[i] = ext._count_request(spec.requests, k)
-            ratio = ratio_mem if ratio_mem is not None else core
-            if ratio >= 100.0:
-                whole += int(ratio // 100.0)
-                ratio = ratio % 100.0
-            if whole or ratio:
-                out.gpu_whole[i] = whole
-                out.gpu_share[i] = ratio
-            qos_label = labels.get(ext.LABEL_POD_QOS)
-            if qos_label:
-                qv = qos_cache.get(qos_label)
-                if qv is None:
-                    qv = int(ext.QoSClass.parse(qos_label))
-                    qos_cache[qos_label] = qv
-                if qv != int(ext.QoSClass.NONE):
-                    explicit_qos.append((i, qv))
-            gang = pod.meta.annotations.get(
-                ext.ANNOTATION_GANG_NAME
-            ) or labels.get(ext.LABEL_GANG_NAME)
+                    non_preemptible[i] = True
+                if spec.estimated or spec.limits or custom_est_key in meta.annotations:
+                    est_override[i] = True
+                if numa_spec_key in meta.annotations:
+                    # pod-level NUMA requirement API (numa_aware.go:29-31):
+                    # SingleNUMANode requires a single-zone fit for THIS pod
+                    # regardless of the node's own policy label
+                    numa_spec = ext.parse_numa_topology_spec(meta.annotations)
+                    if (
+                        numa_spec
+                        and numa_spec.get("numaTopologyPolicy") == "SingleNUMANode"
+                    ):
+                        numa_required[i] = True
+                priority[i] = spec.priority or 0
+                whole = 0
+                ratio_mem: Optional[float] = None
+                core = 0.0
+                for k, v in spec.requests.items():
+                    j = res_index.get(k)
+                    if j is not None:
+                        req_rows[i, j] = v
+                    # device parsing is NOT exclusive with the dense axis: a
+                    # deployment may append device resources to
+                    # SnapshotConfig.resources (DEFAULT_RESOURCES invites it)
+                    # and the device manager must still see the request
+                    if k == ext.RES_GPU:
+                        whole = int(v)
+                    elif k == ext.RES_GPU_MEMORY_RATIO:
+                        ratio_mem = float(v)
+                    elif k == ext.RES_GPU_CORE:
+                        core = float(v)
+                    elif k == ext.RES_RDMA:
+                        out.rdma[i] = ext._count_request(spec.requests, k)
+                    elif k == ext.RES_FPGA:
+                        out.fpga[i] = ext._count_request(spec.requests, k)
+                ratio = ratio_mem if ratio_mem is not None else core
+                if ratio >= 100.0:
+                    whole += int(ratio // 100.0)
+                    ratio = ratio % 100.0
+                if whole or ratio:
+                    out.gpu_whole[i] = whole
+                    out.gpu_share[i] = ratio
+                qv = -1
+                qos_label = labels.get(ext.LABEL_POD_QOS)
+                if qos_label:
+                    qv = qos_cache.get(qos_label)
+                    if qv is None:
+                        qv = int(ext.QoSClass.parse(qos_label))
+                        qos_cache[qos_label] = qv
+                    if qv != int(ext.QoSClass.NONE):
+                        explicit_qos.append((i, qv))
+                    else:
+                        qv = -1
+                gang = meta.annotations.get(
+                    ext.ANNOTATION_GANG_NAME
+                ) or labels.get(ext.LABEL_GANG_NAME)
+                label_min = None
+                gang_pod_nonstrict = False
+                if gang:
+                    label_min = meta.annotations.get(
+                        ext.ANNOTATION_GANG_MIN_AVAILABLE
+                    ) or labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
+                    gang_pod_nonstrict = (
+                        meta.annotations.get(ext.ANNOTATION_GANG_MODE)
+                        == ext.GANG_MODE_NONSTRICT
+                    )
+                if row_cache is not None:
+                    row_cache[meta.uid] = InternedPodRow(
+                        fp=fp,
+                        req=req_rows[i].copy(),
+                        priority=int(priority[i]),
+                        qos_explicit=qv,
+                        gang=gang,
+                        gang_min=label_min,
+                        gang_nonstrict=gang_pod_nonstrict,
+                        gpu_whole=int(out.gpu_whole[i]),
+                        gpu_share=float(out.gpu_share[i]),
+                        rdma=float(out.rdma[i]),
+                        fpga=float(out.fpga[i]),
+                        quota_name=quota_names[-1],
+                        est_override=bool(est_override[i]),
+                        numa_required=bool(numa_required[i]),
+                        non_preemptible=bool(non_preemptible[i]),
+                    )
             if gang:
-                key = f"{pod.meta.namespace}/{gang}"
+                key = f"{meta.namespace}/{gang}"
                 gid = gang_ids.setdefault(key, len(gang_ids))
                 out.gang_id[i] = gid
                 gang_members[gid] = gang_members.get(gid, 0) + 1
-                label_min = pod.meta.annotations.get(
-                    ext.ANNOTATION_GANG_MIN_AVAILABLE
-                ) or labels.get(ext.LABEL_GANG_MIN_AVAILABLE)
                 if label_min is not None:
                     try:
                         gang_label_min[gid] = int(label_min)
                     except ValueError:
                         pass
                 if gid not in gang_pod_mode:
-                    gang_pod_mode[gid] = (
-                        pod.meta.annotations.get(ext.ANNOTATION_GANG_MODE)
-                        == ext.GANG_MODE_NONSTRICT
-                    )
+                    gang_pod_mode[gid] = gang_pod_nonstrict
         out.valid[:n] = True
         # vectorized priority-band resolution from the canonical band
         # table (priority.go:29-48; same source as from_priority)
@@ -1048,4 +1148,5 @@ class ClusterSnapshot:
         out.est_override = est_override
         out.numa_required = numa_required
         out.non_preemptible = non_preemptible
+        out.intern_hits = intern_hits
         return out

@@ -244,14 +244,21 @@ class PodBatch:
         )
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=0)
 def scatter_rows(full, idx, rows):
     """Refresh a device-resident node-axis pytree in place of a full
     re-upload: ``full`` is any pytree of ``[N, ...]`` arrays (NodeState,
     NumaState, DeviceState), ``idx`` [K] int32 the node rows to replace
     and ``rows`` the matching pytree of ``[K, ...]`` row blocks. ``idx``
     may carry duplicate entries (callers pad to a stable K for jit-cache
-    stability) as long as duplicates carry identical row data."""
+    stability) as long as duplicates carry identical row data.
+
+    ``full`` is DONATED: the steady-state refresh updates the resident
+    buffers in place (zero fresh [N, ...] allocations — XLA writes the
+    scattered rows into the donated input's memory). The caller's old
+    reference is dead after the call; every call site replaces its
+    resident handle with the return value and never re-reads the input
+    (tests assert buffer-pointer stability on the refresh path)."""
     return jax.tree.map(lambda f, r: f.at[idx].set(r), full, rows)
 
 
@@ -260,7 +267,10 @@ def gather_rows(full, idx, valid):
     """Sampled-window lowering ON DEVICE: gather ``idx`` [B] node rows out
     of a resident full-axis pytree, zeroing rows where ``valid`` [B] is
     False (padding rows then read schedulable=False and mask out, the same
-    contract the host-side pad-and-upload path provided)."""
+    contract the host-side pad-and-upload path provided). ``full`` is NOT
+    donated: the resident arrays are re-read by later refreshes/windows
+    (donation audit, perf PR 4 — same reason ``assign`` never donates its
+    node/quota inputs)."""
 
     def take(f):
         out = f[idx]

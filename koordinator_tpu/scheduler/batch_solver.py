@@ -12,6 +12,7 @@ revalidation — the solver proposes, Reserve disposes (SURVEY §7 hard part
 from __future__ import annotations
 
 import dataclasses
+import functools
 import queue as _queue
 import threading as _threading
 import time as _time
@@ -158,6 +159,25 @@ def _chain_commit_deltas(cur, nodes_t, result):
     )
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _apply_commit_deltas_donated(
+    cur_req, cur_est, cur_prod, t_req, t_est, t_prod, r_req, r_est, r_prod
+):
+    """Donating form of the chained-state delta apply, used from the
+    SECOND chunk onward (donation follow-on, ROADMAP item a): by then the
+    carried requested/estimated/prod arrays are the previous chunk's
+    chain outputs — referenced only by the chain, never re-read — so XLA
+    writes the new chain state into the same [N, D] buffers instead of
+    allocating three fresh ones per chunk. Chunk 0's carry aliases the
+    device-RESIDENT arrays (re-read every cycle) and must go through the
+    non-donating :func:`_chain_commit_deltas`."""
+    return (
+        cur_req + (r_req - t_req),
+        cur_est + (r_est - t_est),
+        cur_prod + (r_prod - t_prod),
+    )
+
+
 @dataclasses.dataclass
 class LoweredRows:
     """Host-side per-chunk lowering stash shared by solve() and _commit():
@@ -244,6 +264,35 @@ class _ReserveJournal:
 
 
 @dataclasses.dataclass
+class SpeculativeSolve:
+    """An in-flight cross-cycle solve dispatched by the CyclePipeline:
+    chunked solves chained off the previous cycle's on-device commit
+    state, plus everything ``_schedule_locked`` needs to verify the
+    speculation still matches reality at consume time."""
+
+    #: per-chunk pod uid tuples — the consuming cycle's chunking must
+    #: reproduce them exactly
+    chunk_uids: Tuple[Tuple[str, ...], ...]
+    #: sampled node window the solves ran over (None = full axis; the
+    #: pipeline gates require None today)
+    sub: Optional[np.ndarray]
+    #: [(chunk, LoweredRows, SolveResult)] — the commit loop's shape
+    solves: list
+    #: post-solve chained NodeState (requested/estimated/prod carried on
+    #: device) — becomes the NEXT cycle's chain when the commit is clean
+    chain_out: object
+    #: snapshot version at dispatch (under the lock); any write since
+    #: invalidates
+    version: int
+    node_epoch: int
+    #: NaN-guard verdicts collected during the speculative lowering,
+    #: merged into the consuming cycle's quarantine
+    quarantine: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    #: wall instant of dispatch (for the pipeline's overlap span)
+    dispatched_at: float = 0.0
+
+
+@dataclasses.dataclass
 class ScheduleOutcome:
     bound: List[Tuple[Pod, str]]
     unschedulable: List[Pod]
@@ -276,6 +325,7 @@ class BatchScheduler:
         cycle_deadline_s: Optional[float] = None,
         fallback_repromote_after: int = 3,
         fetch_timeout_s: float = 30.0,
+        intern_pods: bool = True,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -416,6 +466,27 @@ class BatchScheduler:
         #: uid -> (stage, plugin, reason) for rows the NaN/Inf guard
         #: quarantined this cycle (cleared per external cycle)
         self._numeric_quarantine: Dict[str, tuple] = {}
+        #: resident PodBatch interning (ROADMAP item c): lowered per-pod
+        #: rows cached across cycles keyed on (uid, spec fingerprint) so a
+        #: retry-heavy stream doesn't re-parse the same still-pending pod
+        #: every cycle; evicted on bind/drop, trimmed oldest-half on
+        #: overflow. None disables (intern_pods=False).
+        self._pod_intern: Optional[Dict[str, object]] = (
+            {} if intern_pods else None
+        )
+        #: cross-cycle pipelining (perf PR 4): a CyclePipeline parks its
+        #: speculatively dispatched solves here; _schedule_locked consumes
+        #: them when the guards (uids / snapshot version / node epoch /
+        #: bucket) still hold, else falls back to a fresh dispatch
+        self._speculative = None
+        self._cycle_used_spec = False
+        self._cycle_reserve_rejected = False
+        self._cycle_preempted = False
+        #: snapshot versions at cycle entry/exit (under the cycle lock) —
+        #: the pipeline uses them to detect external writes racing the
+        #: prepare/solve stages
+        self._pre_cycle_version = -1
+        self._post_cycle_version = -1
         #: per-cycle flags consumed by the tail bookkeeping
         self._cycle_solver_failed = False
         self._cycle_deadline_hit = False
@@ -523,6 +594,31 @@ class BatchScheduler:
             custom_prod_thresholds=jnp.asarray(na.custom_prod_thresholds[sl]),
         )
 
+    def _scatter_refresh(
+        self, cached_state, rows: np.ndarray, make_blocks, span_name: str,
+        table: str,
+    ):
+        """Shared dirty-row scatter ladder for every device-resident
+        table (nodes / NUMA zones / GPU slots): pad the index vector to a
+        power of two (min 8) so the scatter jit-cache stays tiny
+        (duplicate indices carry identical row data, so the ``.set`` is
+        well-defined), scatter ``make_blocks(idx)`` into the DONATED
+        resident pytree, and account the upload + partial cache hit."""
+        reg = self.extender.registry
+        b = max(8, 1 << (len(rows) - 1).bit_length())
+        idx = np.empty((b,), np.int32)
+        idx[: len(rows)] = rows
+        idx[len(rows) :] = rows[-1]
+        with self.extender.tracer.span(
+            span_name, cat="scheduler", dirty=len(rows), uploaded=b
+        ):
+            state = scatter_rows(
+                cached_state, jnp.asarray(idx), make_blocks(idx)
+            )
+        reg.get("solver_h2d_rows_total").inc(float(b))
+        reg.get("solver_state_cache_hits_total").labels(table=table).inc()
+        return state
+
     def _resident_node_state(self) -> NodeState:
         snap = self.snapshot
         reg = self.extender.registry
@@ -543,26 +639,13 @@ class BatchScheduler:
                     return cur
                 rows = snap.drain_dirty(owner=id(self))
                 if rows is not None and 0 < len(rows) <= n_bucket // 2:
-                    # pad the dirty index vector to a power of two (min 8)
-                    # so the scatter jit-cache stays tiny; duplicate
-                    # indices carry identical row data, so the .set is
-                    # well-defined
-                    b = max(8, 1 << (len(rows) - 1).bit_length())
-                    idx = np.empty((b,), np.int32)
-                    idx[: len(rows)] = rows
-                    idx[len(rows) :] = rows[-1]
-                    with tr.span(
+                    new = self._scatter_refresh(
+                        cur,
+                        rows,
+                        self._node_state_rows,
                         "snapshot:node_scatter",
-                        cat="scheduler",
-                        dirty=len(rows),
-                        uploaded=b,
-                    ):
-                        blocks = self._node_state_rows(idx)
-                        new = scatter_rows(cur, jnp.asarray(idx), blocks)
-                    reg.get("solver_h2d_rows_total").inc(float(b))
-                    reg.get("solver_state_cache_hits_total").labels(
-                        table="nodes"
-                    ).inc()
+                        "nodes",
+                    )
                     self._resident_nodes = new
                     self._resident_version = snap.version
                     return new
@@ -633,16 +716,35 @@ class BatchScheduler:
     def _pod_batch(
         self, pods: Sequence[Pod], bucket: Optional[int] = None
     ) -> PodBatch:
-        arrays, est = self._lower_rows(pods, bucket)
-        return PodBatch.create(
+        batch, _rows = self._lower_chunk(pods, bucket)
+        return batch
+
+    def _lower_chunk(
+        self,
+        pods: Sequence[Pod],
+        bucket: Optional[int] = None,
+        stash: bool = True,
+        quarantine: Optional[Dict[str, tuple]] = None,
+        inject: bool = True,
+    ) -> Tuple[PodBatch, LoweredRows]:
+        """Lower one chunk to a device :class:`PodBatch` plus its host
+        :class:`LoweredRows`. ``stash=False`` keeps the instance stash
+        untouched — the pipeline's prepare worker lowers the NEXT cycle
+        on its own thread while the current cycle's commit still relies
+        on ``self._lowered`` (``quarantine`` then collects NaN-guard
+        verdicts for a later merge instead of writing the shared dict)."""
+        arrays, est, rows = self._lower_rows(
+            pods, bucket, stash=stash, quarantine=quarantine, inject=inject
+        )
+        batch = PodBatch.create(
             requests=arrays.requests,
             estimate=est,
             priority=arrays.priority,
-            is_prod=self._lowered.is_prod,
+            is_prod=rows.is_prod,
             valid=arrays.valid,
             gang_id=arrays.gang_id,
             gang_min=arrays.gang_min,
-            quota_chain=self._lowered.quota_chain,
+            quota_chain=rows.quota_chain,
             qos=arrays.qos,
             gpu_whole=arrays.gpu_whole,
             gpu_share=arrays.gpu_share,
@@ -651,20 +753,36 @@ class BatchScheduler:
             gang_nonstrict=arrays.gang_nonstrict,
             numa_required=arrays.numa_required,
         )
+        return batch, rows
 
-    def _lower_rows(self, pods: Sequence[Pod], bucket: Optional[int] = None):
+    def _lower_rows(
+        self,
+        pods: Sequence[Pod],
+        bucket: Optional[int] = None,
+        stash: bool = True,
+        quarantine: Optional[Dict[str, tuple]] = None,
+        inject: bool = True,
+    ):
         """Host-side lowering shared by the device dispatches and the
         host reference path: builds the dense pod arrays + estimates,
-        stashes :class:`LoweredRows` for ``_commit``, and runs the
-        NaN/Inf guard (non-finite request/estimate rows are quarantined
-        as a counted RejectReason before they can poison a cost tensor).
-        Returns ``(arrays, est)``."""
+        stashes :class:`LoweredRows` for ``_commit`` (unless
+        ``stash=False``), and runs the NaN/Inf guard (non-finite
+        request/estimate rows are quarantined as a counted RejectReason
+        before they can poison a cost tensor). Returns
+        ``(arrays, est, rows)``."""
+        if quarantine is None:
+            quarantine = self._numeric_quarantine
         arrays = self.snapshot.build_pods(
             list(pods),
             min_member_by_gang=self.pod_groups.min_member_map(),
             nonstrict_by_gang=self.pod_groups.nonstrict_map(),
             bucket=bucket,
+            row_cache=self._pod_intern,
         )
+        if arrays.intern_hits and self._pod_intern is not None:
+            self.extender.registry.get("pod_intern_hits_total").inc(
+                arrays.intern_hits
+            )
         b = bucket or bucket_size(len(pods), self.snapshot.config.min_bucket)
         if arrays.requests.shape[0] != b:
             raise ValueError("pod bucket mismatch")
@@ -706,8 +824,10 @@ class BatchScheduler:
         is_prod = arrays.prio_class == int(ext.PriorityClass.PROD)
         # chaos: corrupt one estimate row (emulates a poisoned upstream
         # estimator / device readback); the guard below quarantines it
-        # exactly like a genuinely corrupt spec would be
-        if self.chaos.enabled and len(pods) and self.chaos.fire(
+        # exactly like a genuinely corrupt spec would be. The pipeline's
+        # warm-only prepare passes inject=False — a throwaway lowering
+        # must not consume a scheduled fault hit
+        if inject and self.chaos.enabled and len(pods) and self.chaos.fire(
             "solver.nan_rows"
         ):
             est[0, 0] = float("nan")
@@ -724,7 +844,7 @@ class BatchScheduler:
             if not finite.all():
                 bad = np.nonzero(~finite)[0]
                 for i in bad.tolist():
-                    self._numeric_quarantine[arrays.uids[i]] = (
+                    quarantine[arrays.uids[i]] = (
                         RejectStage.FILTER,
                         "numeric_guard",
                         RejectReason.NUMERIC_INVALID,
@@ -755,7 +875,7 @@ class BatchScheduler:
         # estimate_pod per winner (the recompute was a measurable slice of
         # the per-batch host time); the uid tuple guards the temporal
         # coupling — _commit refuses rows lowered for a different chunk
-        self._lowered = LoweredRows(
+        rows = LoweredRows(
             uids=tuple(arrays.uids),
             req=arrays.requests,
             est=est,
@@ -774,7 +894,9 @@ class BatchScheduler:
             quota_chain=chains,
             numa_required=arrays.numa_required,
         )
-        return arrays, est
+        if stash:
+            self._lowered = rows
+        return arrays, est, rows
 
     # ---- scheduling cycle ----
 
@@ -848,6 +970,10 @@ class BatchScheduler:
             self._cycle_deadline_hit = False
             self._cycle_commit_rolled_back = False
             self._cycle_fetch_deferred = False
+            self._cycle_used_spec = False
+            self._cycle_reserve_rejected = False
+            self._cycle_preempted = False
+            self._pre_cycle_version = self.snapshot.version
             self._cycle_t0 = _time.perf_counter()
             fwext.monitor.start_batch(pending)
             # amortized purge: pods forgotten through any path (delete
@@ -1004,16 +1130,51 @@ class BatchScheduler:
         unsched: List[Pod] = list(gated) + list(dropped) + list(affinity_unsched)
         rounds = 0
         chunks = self._chunks(eligible)
-        # kube-scheduler node sampling (PercentageOfNodesToScore): one
-        # rotating window per cycle, shared by every chunk so the
-        # on-device capacity chaining stays on a consistent node axis
-        sub = self._select_nodes(eligible) if chunks else None
+        # cross-cycle pipelining (perf PR 4): a CyclePipeline may have
+        # dispatched this cycle's solves already, chained off the previous
+        # cycle's on-device commit state while that cycle's host Reserve
+        # trailed behind. Consume them only when the guards prove the
+        # speculative inputs equal what a fresh dispatch would see —
+        # identical chunking, no snapshot writes since dispatch, no node
+        # churn, ladder healthy — else fall back to a fresh dispatch
+        # (decision-identical either way; the discard is only lost work).
+        solves = None
+        sub = None
+        spec = self._speculative
+        self._speculative = None
+        if spec is not None and not _retry:
+            if (
+                chunks
+                and spec.chunk_uids
+                == tuple(tuple(p.meta.uid for p in c) for c in chunks)
+                and spec.version == self.snapshot.version
+                and spec.node_epoch == self.snapshot.node_epoch
+                and self._fallback_level == 0
+                and self._speculation_consume_ok()
+            ):
+                solves = spec.solves
+                sub = spec.sub
+                self._cycle_used_spec = True
+                self._numeric_quarantine.update(spec.quarantine)
+                fwext.registry.get("pipeline_speculation_total").labels(
+                    outcome="kept"
+                ).inc()
+            else:
+                fwext.registry.get("pipeline_speculation_total").labels(
+                    outcome="discarded"
+                ).inc()
+        if solves is None:
+            # kube-scheduler node sampling (PercentageOfNodesToScore): one
+            # rotating window per cycle, shared by every chunk so the
+            # on-device capacity chaining stays on a consistent node axis
+            sub = self._select_nodes(eligible) if chunks else None
         seq.enter("solve")
         seq.set(chunks=len(chunks))
-        # fallback ladder: scanned multi-chunk → per-chunk → host numpy
-        # reference; a dispatch failure demotes the ladder for subsequent
-        # cycles instead of killing this one
-        solves = self._dispatch_with_fallback(chunks, sub)
+        if solves is None:
+            # fallback ladder: scanned multi-chunk → per-chunk → host numpy
+            # reference; a dispatch failure demotes the ladder for
+            # subsequent cycles instead of killing this one
+            solves = self._dispatch_with_fallback(chunks, sub)
         fence_failed = False
         if tr.enabled and solves and not isinstance(solves[0][2], _HostSolve):
             # fence the async dispatches so the solve span's duration is
@@ -1373,6 +1534,10 @@ class BatchScheduler:
                     preempted.append(victim)
                 retry_pods.append(pod)
                 self._window_extra_nodes.add(_node)
+        if retry_pods or preempted:
+            # preemption moved window bookkeeping / evicted holders — the
+            # speculative chain (if any) no longer matches the snapshot
+            self._cycle_preempted = True
         if retry_pods:
             # the retry's sampled window must contain the nodes the
             # victims were just evicted from (_window_extra_nodes — the
@@ -1432,6 +1597,22 @@ class BatchScheduler:
                     )
                 fwext.filters.capture(tally)
             self._cycle_tail_bookkeeping()
+            # interned-row eviction (bind/drop): a bound pod never lowers
+            # again and a transformer-dropped pod must not resurrect; the
+            # overflow trim sheds the OLDEST half (insertion order — same
+            # discipline as _trim_preempt_skips)
+            cache = self._pod_intern
+            if cache is not None:
+                for pod, _node in bound:
+                    cache.pop(pod.meta.uid, None)
+                for uid in dropped_uids:
+                    cache.pop(uid, None)
+                if len(cache) > max(4096, 4 * self.batch_bucket):
+                    from itertools import islice
+
+                    for uid in list(islice(cache, len(cache) // 2)):
+                        del cache[uid]
+            self._post_cycle_version = self.snapshot.version
         return ScheduleOutcome(
             bound=bound,
             unschedulable=unsched,
@@ -1573,8 +1754,7 @@ class BatchScheduler:
             used = np.asarray(used).copy()
         out = []
         for chunk in chunks:
-            arrays, _est = self._lower_rows(chunk)
-            rows = self._lowered
+            arrays, _est, rows = self._lower_rows(chunk)
             n = len(chunk)
             assignment = np.full(arrays.requests.shape[0], -1, np.int32)
             mask_host = self._node_constraint_mask_host(chunk, n)
@@ -2157,14 +2337,40 @@ class BatchScheduler:
                 )
             if nodes_t is cur:
                 # no node transformer ran: the solver outputs ARE the
-                # chained state (avoids extra dispatches on the tunnel)
+                # chained state (avoids extra dispatches on the tunnel —
+                # and allocates nothing: the replace is pure aliasing)
                 cur = cur.replace(
                     requested=result.node_requested,
                     estimated_used=result.node_estimated_used,
                     prod_used=result.node_prod_used,
                 )
-            else:
+            elif cur is nodes0 or (
+                nodes_t.requested is cur.requested
+                or nodes_t.estimated_used is cur.estimated_used
+                or nodes_t.prod_used is cur.prod_used
+            ):
+                # chunk 0 carries the RESIDENT arrays (re-read next
+                # cycle), and a transformer may pass some carry leaves
+                # through unchanged (aliased) — donation would invalidate
+                # a buffer somebody still reads, so take the copying form
                 cur = _chain_commit_deltas(cur, nodes_t, result)
+            else:
+                # steady chain: the carry arrays belong exclusively to the
+                # chain — update them in place (donated)
+                req, est, prod = _apply_commit_deltas_donated(
+                    cur.requested,
+                    cur.estimated_used,
+                    cur.prod_used,
+                    nodes_t.requested,
+                    nodes_t.estimated_used,
+                    nodes_t.prod_used,
+                    result.node_requested,
+                    result.node_estimated_used,
+                    result.node_prod_used,
+                )
+                cur = cur.replace(
+                    requested=req, estimated_used=est, prod_used=prod
+                )
             if quotas0 is not None:
                 qused = result.quota_used
             if device_state is not None:
@@ -2177,6 +2383,102 @@ class BatchScheduler:
                 numa_carry = result.node_zone_free
             out.append((chunk, rows, result))
         return out
+
+    def _speculation_consume_ok(self) -> bool:
+        """State-bearing pipeline gates, re-checked at CONSUME time: a
+        gated subsystem can arrive through an informer WITHOUT bumping
+        ``snapshot.version`` (the first ElasticQuota CR, a device
+        inventory, a NUMA topology, a gang registration), and a
+        speculation lowered before that arrival must not be consumed —
+        its rows carry no quota chains and its solves ran without the
+        subsystem's admission. The CyclePipeline's dispatch gate reuses
+        this plus its batch-content and ladder checks."""
+        fwext = self.extender
+        return (
+            self.reservations is None
+            and self.mesh is None
+            and not (self.numa is not None and self.numa.has_topology)
+            and not (self.devices is not None and self.devices.has_devices)
+            and self.quotas.quota_count == 0
+            and not fwext._pre_batch
+            and not fwext._batch_transformers
+            and fwext.cost_transform is None
+            and not self.enable_priority_preemption
+            and not self.pod_groups.has_gangs
+            and num_nodes_to_score(
+                self.snapshot.node_count, self.percentage_of_nodes_to_score
+            )
+            >= self.snapshot.node_count
+        )
+
+    def last_cycle_spec_safe(self) -> bool:
+        """Whether the just-finished cycle left the speculative chain
+        valid: the host Reserve accepted every solver winner, nothing was
+        deferred, rolled back or ladder-demoted, and no preemption pass
+        ran — the on-device chained capacity state then equals what a
+        fresh host lowering would produce (bit-exact for the integral
+        milli-CPU / MiB values k8s specs carry)."""
+        return not (
+            self._cycle_solver_failed
+            or self._cycle_deadline_hit
+            or self._cycle_commit_rolled_back
+            or self._cycle_fetch_deferred
+            or self._cycle_reserve_rejected
+            or self._cycle_preempted
+        )
+
+    def _dispatch_chained(
+        self,
+        chunks: List[List[Pod]],
+        chain: NodeState,
+        quarantine: Optional[Dict[str, tuple]] = None,
+        prepared: Optional[list] = None,
+    ) -> Tuple[list, NodeState]:
+        """Cross-cycle chained dispatch (the pipeline's speculative fast
+        path): solve every chunk against the device-chained capacity
+        state carried from the PREVIOUS cycle's solve — dispatched while
+        that cycle's host Reserve still trails behind. The CyclePipeline
+        guarantees the gates (no quotas / NUMA / devices / transformers /
+        mesh / gangs / sampling / preemption), under which the serial
+        path's dispatch reduces to the same ``assign`` call chain, so a
+        kept speculation is decision-identical to a fresh post-commit
+        dispatch. ``prepared`` carries the prepare worker's
+        (PodBatch, LoweredRows, node_mask) triples when it finished in
+        time; otherwise lowering happens inline (cold, still correct).
+        Returns ``(solves, chain_out)``."""
+        cur = chain
+        out = []
+        for k, chunk in enumerate(chunks):
+            if prepared is not None:
+                pods, rows, node_mask = prepared[k]
+            else:
+                pods, rows = self._lower_chunk(
+                    chunk, stash=False, quarantine=quarantine
+                )
+                node_mask = self._node_constraint_mask(
+                    chunk, pods.requests.shape[0], None
+                )
+            with self.extender.tracer.span(
+                "assign", cat="scheduler", mode="chained", pods=len(chunk)
+            ):
+                result = assign(
+                    pods,
+                    cur,
+                    self._params,
+                    quotas=None,
+                    max_rounds=self.max_rounds,
+                    approx_topk=True,
+                    node_mask=node_mask,
+                )
+            # zero-copy chain replace (the solver outputs ARE the chained
+            # state; allocatable/flags leaves stay aliased)
+            cur = cur.replace(
+                requested=result.node_requested,
+                estimated_used=result.node_estimated_used,
+                prod_used=result.node_prod_used,
+            )
+            out.append((chunk, rows, result))
+        return out, cur
 
     def _numa_scoring(self):
         """NUMA-aligned Score strategy for the solver (static jit arg)."""
@@ -2235,8 +2537,12 @@ class BatchScheduler:
         return numa_state, device_state
 
     def _resident_numa_state(self):
-        """Device-resident full-axis NUMA zone table, re-uploaded only
-        when the manager's lowering actually changed."""
+        """Device-resident full-axis NUMA zone table. An unchanged
+        lowering re-uses the resident copy outright; a lowering whose
+        only changes are per-node allocation deltas is refreshed by a
+        jitted DIRTY-ROW SCATTER of just those rows (the managers track
+        dirty node names — ROADMAP item b); only structural changes
+        (shape growth, full rebuild, >50% dirty) pay a full re-upload."""
         from ..ops.numa import NumaState
 
         reg = self.extender.registry
@@ -2249,6 +2555,27 @@ class BatchScheduler:
                 table="numa"
             ).inc()
             return cached[1]
+        n_bucket = zone_free.shape[0]
+        if cached is not None and cached[0][1] == zone_free.shape:
+            rows = self.numa.drain_lowered_dirty()
+            if rows is not None and 0 < len(rows) <= n_bucket // 2:
+                state = self._scatter_refresh(
+                    cached[1],
+                    rows,
+                    lambda idx: NumaState(
+                        zone_free=jnp.asarray(zone_free[idx]),
+                        zone_cap=jnp.asarray(zone_cap[idx]),
+                        policy=jnp.asarray(policy[idx]),
+                        zone_most=jnp.asarray(most[idx]),
+                    ),
+                    "snapshot:numa_scatter",
+                    "numa",
+                )
+                self._numa_dev_cache = (key, state)
+                return state
+        else:
+            # first build or shape change: stale marks are meaningless
+            self.numa.drain_lowered_dirty()
         with self.extender.tracer.span(
             "snapshot:numa_lower", cat="scheduler",
             uploaded=zone_free.shape[0],
@@ -2264,8 +2591,10 @@ class BatchScheduler:
         return state
 
     def _resident_device_state(self):
-        """Device-resident full-axis GPU slot table (+ RDMA/FPGA counts),
-        re-uploaded only when the manager's lowering actually changed."""
+        """Device-resident full-axis GPU slot table (+ RDMA/FPGA counts).
+        Same refresh ladder as the NUMA table: resident re-use →
+        dirty-row scatter of just the allocation-touched rows (ROADMAP
+        item b) → full re-upload only on structural change."""
         from ..ops.device import DeviceState
 
         reg = self.extender.registry
@@ -2273,11 +2602,13 @@ class BatchScheduler:
         # GPU-only clusters trace the RDMA/FPGA feasibility, carry
         # and prefix checks OUT of the solver entirely (None pytree
         # leaves are static structure)
+        has_rdma = self.devices.has_rdma
+        has_fpga = self.devices.has_fpga
         key = (
             self.devices.lowered_version,
             slots.shape,
-            self.devices.has_rdma,
-            self.devices.has_fpga,
+            has_rdma,
+            has_fpga,
         )
         cached = self._device_dev_cache
         if cached is not None and cached[0] == key:
@@ -2285,6 +2616,36 @@ class BatchScheduler:
                 table="device"
             ).inc()
             return cached[1]
+        n_bucket = slots.shape[0]
+        if cached is not None and cached[0][1:] == key[1:]:
+            rows = self.devices.drain_lowered_dirty()
+            if rows is not None and 0 < len(rows) <= n_bucket // 2:
+                state = self._scatter_refresh(
+                    cached[1],
+                    rows,
+                    lambda idx: DeviceState(
+                        slot_free=jnp.asarray(slots[idx]),
+                        rdma_free=(
+                            jnp.asarray(self.devices.rdma_array()[idx])
+                            if has_rdma
+                            else None
+                        ),
+                        fpga_free=(
+                            jnp.asarray(self.devices.fpga_array()[idx])
+                            if has_fpga
+                            else None
+                        ),
+                        cap_total=jnp.asarray(
+                            self.devices.cap_array()[idx]
+                        ),
+                    ),
+                    "snapshot:device_scatter",
+                    "device",
+                )
+                self._device_dev_cache = (key, state)
+                return state
+        else:
+            self.devices.drain_lowered_dirty()
         with self.extender.tracer.span(
             "snapshot:device_lower", cat="scheduler", uploaded=slots.shape[0]
         ):
@@ -2292,12 +2653,12 @@ class BatchScheduler:
                 slot_free=jnp.asarray(slots),
                 rdma_free=(
                     jnp.asarray(self.devices.rdma_array())
-                    if self.devices.has_rdma
+                    if has_rdma
                     else None
                 ),
                 fpga_free=(
                     jnp.asarray(self.devices.fpga_array())
-                    if self.devices.has_fpga
+                    if has_fpga
                     else None
                 ),
                 cap_total=jnp.asarray(self.devices.cap_array()),
@@ -2620,6 +2981,11 @@ class BatchScheduler:
                     RejectReason.COMMIT_ROLLED_BACK,
                 )
             return [], list(chunk)
+        if self._reserve_reject:
+            # a Reserve/Permit rejection means the solver's on-device
+            # commit state over-counts vs the host — the speculative
+            # chain (if any) is no longer exact
+            self._cycle_reserve_rejected = True
         # terminal PreBind: one merged patch per admitted pod
         # (defaultprebind/plugin.go; rejected pods' patches evaporate).
         if prebind.has_patches:

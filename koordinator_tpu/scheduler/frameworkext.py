@@ -130,6 +130,27 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "commit_rollbacks_total",
         "chunk commits rolled back by the transactional Reserve journal",
     )
+    # perf PR 4: cross-cycle solve pipelining + resident PodBatch interning
+    reg.counter(
+        "pod_intern_hits_total",
+        "pod rows served from the interned (uid, spec-hash) lowering "
+        "cache instead of a fresh per-pod parse",
+    )
+    reg.counter(
+        "pipeline_speculation_total",
+        "speculatively dispatched cross-cycle solves, by consume outcome",
+        labels=("outcome",),
+    )
+    reg.counter(
+        "pipeline_prepare_stalls_total",
+        "prepare-worker stalls/deaths that degraded a pipelined cycle "
+        "to the serial path",
+    )
+    reg.gauge(
+        "solver_pipeline_depth",
+        "overlapped pipeline stages in flight at the last pump return "
+        "(0 = idle, 1 = solve in flight, 2 = solve + trailing commit)",
+    )
     ensure_exceptions_counter(reg)
     return reg
 

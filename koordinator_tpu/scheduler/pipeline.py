@@ -1,0 +1,471 @@
+"""Cross-cycle solve pipelining (perf PR 4 tentpole).
+
+The serial scheduling cycle is a strictly sequential host-lower →
+device-solve → host-commit chain: the device idles during Reserve and the
+host idles during the solve. Round-based cluster schedulers (Gavel,
+Synergy) get their throughput from keeping the solver saturated across
+rounds; this module applies the same overlap discipline to the batch
+scheduler, using the chaining trick ``_dispatch_pipelined`` already uses
+WITHIN a cycle — extended across the cycle boundary:
+
+* a **prepare worker** (host thread) lowers cycle N+1's pod batch and
+  constraint masks while cycle N's solve is still in flight on the
+  device (``prepare`` span);
+* cycle N+1's solves are **dispatched off the device-chained capacity
+  state** of cycle N's solve, before cycle N's host Reserve has run —
+  the solver's own commit state stands in for the not-yet-applied host
+  commit (``overlap`` span ties dispatch to consume);
+* cycle N's host Reserve then **trails behind** under the existing
+  transactional ``_ReserveJournal``: a mid-pipeline failure rolls the
+  chunk back bit-exactly and the speculation is discarded.
+
+Decision identity with the serial path is a *validation* property, not
+an assumption: the consuming cycle re-derives its chunking and compares
+it (plus snapshot version and node epoch) against what the speculation
+used — any mismatch, any Reserve rejection, rollback, deferral or
+preemption discards the in-flight solve and the cycle re-dispatches from
+the refreshed host state. A kept speculation used inputs equal to what
+the serial path would have lowered (bit-exact for the integral
+milli-CPU/MiB values k8s specs carry), so placements match either way.
+
+Failure domain (ROADMAP rule): the prepare worker is a named chaos point
+``pipeline.worker_stall``; a stalled/dead worker degrades the cycle to
+the serial path (counted in ``pipeline_prepare_stalls_total``, surfaced
+as the ``pipeline`` row on /healthz) instead of wedging the pump.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import threading as _threading
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import Pod
+from ..obs import report_exception
+from .batch_solver import (
+    BatchScheduler,
+    ScheduleOutcome,
+    SpeculativeSolve,
+    num_nodes_to_score,
+)
+
+
+@dataclasses.dataclass
+class PreparedCycle:
+    """The prepare worker's output for one upcoming batch: chunked device
+    batches + host rows + constraint masks, stamped with the snapshot
+    state they were lowered against."""
+
+    chunks: List[List[Pod]]
+    chunk_uids: Tuple[Tuple[str, ...], ...]
+    #: [(PodBatch, LoweredRows, node_mask)] per chunk
+    triples: list
+    #: NaN-guard verdicts collected during lowering (merged at consume)
+    quarantine: Dict[str, tuple]
+    version: int
+    node_epoch: int
+
+
+class _PrepareWorker:
+    """Single background thread lowering upcoming batches. Jobs flow
+    through a queue; results land in a dict under a condition variable.
+    The ``pipeline.worker_stall`` chaos point makes the thread wedge
+    (die without acking) so the pump's collect deadline is exercised."""
+
+    def __init__(self, sched: BatchScheduler):
+        self.sched = sched
+        self._req: "_queue.Queue" = _queue.Queue()
+        self._results: Dict[int, Optional[PreparedCycle]] = {}
+        self._cond = _threading.Condition()
+        self._seq = 0
+        self._thread: Optional[_threading.Thread] = None
+        self._spawn()
+
+    def _spawn(self) -> None:
+        self._thread = _threading.Thread(
+            target=self._run, name="pipeline-prepare", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    #: sentinel result for warm-only jobs (intern cache primed, nothing
+    #: to dispatch) — distinct from None, which means stall/error
+    WARMED = object()
+
+    def submit(
+        self,
+        batch: Sequence[Pod],
+        warm_only: bool = False,
+        stall: bool = False,
+    ) -> int:
+        """``stall=True`` (decided by the PUMP thread's chaos evaluation
+        — firing from the worker thread would make the injector's fault
+        trace order race the pump's own points and break same-seed
+        determinism) makes the worker wedge on this job: never acked,
+        thread dies."""
+        self._seq += 1
+        self._req.put((self._seq, list(batch), warm_only, stall))
+        return self._seq
+
+    def collect(
+        self, job: int, timeout_s: float
+    ) -> Optional[PreparedCycle]:
+        """Wait up to ``timeout_s`` for the prepared lowering; None on
+        stall/death/error (the caller degrades to the serial path)."""
+        deadline = _time.monotonic() + timeout_s
+        with self._cond:
+            # purge results nobody will ever collect (jobs abandoned when
+            # a chaos-killed worker was respawned mid-queue)
+            for stale in [k for k in self._results if k < job]:
+                del self._results[stale]
+            while job not in self._results:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not self.alive:
+                    return self._results.pop(job, None)
+                self._cond.wait(min(remaining, 0.05))
+            return self._results.pop(job, None)
+
+    def close(self) -> None:
+        """Stop the worker and wait for it: a daemon thread torn down by
+        interpreter exit while inside a device transfer aborts the whole
+        process (std::terminate in XLA) — the join drains any in-flight
+        prepare first."""
+        try:
+            while True:
+                self._req.get_nowait()
+        except _queue.Empty:
+            pass
+        self._req.put((None, None, False, False))
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10.0)
+
+    def _run(self) -> None:
+        sched = self.sched
+        while True:
+            job, batch, warm_only, stall = self._req.get()
+            if job is None:
+                return
+            if stall:
+                # simulated wedge: the job is never acked and the thread
+                # dies — the pump's collect deadline surfaces it and the
+                # cycle degrades to serial
+                return
+            try:
+                if warm_only:
+                    self._warm(batch)
+                    prep = self.WARMED
+                else:
+                    prep = self._prepare(batch)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't die
+                report_exception(
+                    "scheduler.pipeline.prepare",
+                    exc,
+                    registry=sched.extender.registry,
+                )
+                prep = None
+            with self._cond:
+                self._results[job] = prep
+                self._cond.notify_all()
+
+    def _warm(self, batch: Sequence[Pod]) -> None:
+        """Gated cycles (quotas/NUMA/devices/...) can't take the chained
+        fast path, but the prepare worker still pays their per-pod parse
+        ahead of time: one throwaway lowering primes the interned-row
+        cache so the serial cycle's own ``build_pods`` hits it.
+        ``inject=False`` keeps scheduled NaN faults for the real
+        lowering."""
+        sched = self.sched
+        with sched.snapshot.lock:
+            with sched.extender.tracer.span(
+                "prepare", cat="pipeline", pods=len(batch), warm_only=True
+            ):
+                sched._lower_rows(
+                    batch, stash=False, quarantine={}, inject=False
+                )
+
+    def _prepare(self, batch: Sequence[Pod]) -> PreparedCycle:
+        sched = self.sched
+        snap = sched.snapshot
+        with snap.lock:
+            with sched.extender.tracer.span(
+                "prepare", cat="pipeline", pods=len(batch)
+            ):
+                quarantine: Dict[str, tuple] = {}
+                # pure under the pipeline gates (no gangs anywhere): a
+                # priority sort, no gang-state mutation
+                eligible = sched.pod_groups.begin_and_order(batch)
+                chunks = sched._chunks(eligible)
+                triples = []
+                for chunk in chunks:
+                    # inject=False: chaos points must fire on the PUMP
+                    # thread in program order (same-seed trace
+                    # determinism), and a scheduled NaN hit consumed by a
+                    # lowering whose speculation is later discarded would
+                    # be silently spent — the serial/degrade paths keep
+                    # firing it
+                    pods, rows = sched._lower_chunk(
+                        chunk,
+                        stash=False,
+                        quarantine=quarantine,
+                        inject=False,
+                    )
+                    mask = sched._node_constraint_mask(
+                        chunk, pods.requests.shape[0], None
+                    )
+                    triples.append((pods, rows, mask))
+                return PreparedCycle(
+                    chunks=chunks,
+                    chunk_uids=tuple(
+                        tuple(p.meta.uid for p in c) for c in chunks
+                    ),
+                    triples=triples,
+                    quarantine=quarantine,
+                    version=snap.version,
+                    node_epoch=snap.node_epoch,
+                )
+
+
+class CyclePipeline:
+    """Pipelined cycle runner over a :class:`BatchScheduler`.
+
+    ``feed(batch)`` dispatches ``batch``'s solves (speculatively, off the
+    previous cycle's device-chained state when valid) and runs the
+    PREVIOUS batch's trailing commit, returning its
+    :class:`ScheduleOutcome` — i.e. results lag one feed. ``feed([])`` /
+    :meth:`flush` drain the tail. Cycles that fail any pipeline gate
+    (quotas, NUMA/devices, gangs, transformers, reservations, mesh, node
+    sampling, an unhealthy ladder) or whose prepare worker stalls simply
+    run the serial path — same decisions, no overlap."""
+
+    def __init__(
+        self,
+        sched: BatchScheduler,
+        prepare_timeout_s: float = 5.0,
+    ):
+        self.sched = sched
+        self.prepare_timeout_s = prepare_timeout_s
+        self._worker = _PrepareWorker(sched)
+        #: (batch, SpeculativeSolve | None, overlap_span | None)
+        self._inflight: Optional[tuple] = None
+        self._degraded = False
+        #: interpreter-exit safety net for pipelines nobody close()s —
+        #: the worker must never be torn down mid-device-transfer
+        import weakref
+
+        self._finalizer = weakref.finalize(self, self._worker.close)
+        sched.extender.health.set("pipeline", True)
+
+    # ---- public surface ----
+
+    @property
+    def inflight(self) -> bool:
+        return self._inflight is not None
+
+    def close(self) -> None:
+        self._finalizer()
+
+    def flush(self) -> Optional[ScheduleOutcome]:
+        """Complete the in-flight cycle (trailing commit) and return its
+        outcome; None when nothing was in flight."""
+        return self.feed([])
+
+    def feed(self, batch: Sequence[Pod]) -> Optional[ScheduleOutcome]:
+        sched = self.sched
+        reg = sched.extender.registry
+        tracer = sched.extender.tracer
+        batch = list(batch)
+        job = None
+        full_ok = False
+        if batch and self._prepare_ok(batch):
+            # prepare stage: the worker lowers THIS batch while the
+            # previous cycle's solve is still in flight on device and
+            # while its trailing commit runs below. Gated cycles still
+            # prepare in warm-only mode (intern-cache priming) so the
+            # serial path's own lowering gets the hit.
+            full_ok = self._gates_ok(batch)
+            stall = sched.chaos.enabled and sched.chaos.fire(
+                "pipeline.worker_stall"
+            )
+            job = self._worker.submit(
+                batch, warm_only=not full_ok, stall=stall
+            )
+        out: Optional[ScheduleOutcome] = None
+        spec_new: Optional[SpeculativeSolve] = None
+        if self._inflight is not None:
+            prev_batch, prev_spec, prev_span = self._inflight
+            if job is not None and full_ok and prev_spec is not None:
+                # deep speculation: dispatch batch k's solves off cycle
+                # k-1's chained state BEFORE its commit — the device works
+                # through solve(k) while the host Reserve of k-1 trails
+                prep = self._collect(job)
+                job = None
+                if prep is not None and prep is not _PrepareWorker.WARMED:
+                    spec_new = self._dispatch(
+                        prep,
+                        chain=prev_spec.chain_out,
+                        chain_version=prev_spec.version,
+                    )
+            # trailing commit of cycle k-1 under the Reserve journal; the
+            # scheduler consumes prev_spec's solves when the guards hold
+            sched._speculative = prev_spec
+            out = sched.schedule(prev_batch)
+            if prev_span is not None:
+                prev_span.__exit__(None, None, None)
+            kept = prev_spec is not None and sched._cycle_used_spec
+            clean = kept and sched.last_cycle_spec_safe()
+            if spec_new is not None:
+                if clean:
+                    # retroactively valid: the commit applied exactly the
+                    # deltas the chain already carried — re-stamp to the
+                    # post-commit version so the consume guard can match
+                    spec_new.version = sched._post_cycle_version
+                else:
+                    reg.get("pipeline_speculation_total").labels(
+                        outcome="discarded"
+                    ).inc()
+                    spec_new = None
+        if job is not None:
+            # collect regardless of whether a dispatch can use it: the
+            # warm-only ack IS the worker liveness probe (a stalled/dead
+            # worker must degrade visibly, not silently), and a full prep
+            # bootstraps speculation off the refreshed post-commit state
+            prep = self._collect(job)
+            if (
+                batch
+                and spec_new is None
+                and full_ok
+                and prep is not None
+                and prep is not _PrepareWorker.WARMED
+            ):
+                spec_new = self._dispatch(prep, chain=None)
+        span = None
+        if spec_new is not None:
+            # the overlap span ties dispatch to consume: its duration is
+            # the window the device solve ran concurrently with host work
+            span = tracer.span("overlap", cat="pipeline", pods=len(batch))
+            span.__enter__()
+        self._inflight = (batch, spec_new, span) if batch else None
+        depth = 0
+        if self._inflight is not None:
+            depth = 2 if spec_new is not None else 1
+        reg.get("solver_pipeline_depth").set(float(depth))
+        return out
+
+    # ---- internals ----
+
+    def _collect(self, job: int):
+        prep = self._worker.collect(job, self.prepare_timeout_s)
+        if prep is None:
+            self._on_stall()
+        elif self._degraded:
+            # a successful collect IS the worker liveness probe: the
+            # respawned worker is preparing again — recover /healthz
+            self._degraded = False
+            self.sched.extender.health.set("pipeline", True)
+        return prep
+
+    def _on_stall(self) -> None:
+        sched = self.sched
+        sched.extender.registry.get("pipeline_prepare_stalls_total").inc()
+        self._degraded = True
+        sched.extender.health.set(
+            "pipeline",
+            False,
+            "prepare worker stalled/died; cycle degraded to serial",
+        )
+        if not self._worker.alive:
+            self._worker._spawn()
+
+    def _dispatch(
+        self,
+        prep: PreparedCycle,
+        chain,
+        chain_version: Optional[int] = None,
+    ) -> Optional[SpeculativeSolve]:
+        """Dispatch the prepared chunks chained off ``chain`` (or off the
+        refreshed resident state when None), under the snapshot lock so
+        the version stamp is exact. Returns None when the prepared
+        lowering no longer matches the live snapshot."""
+        sched = self.sched
+        snap = sched.snapshot
+        if not prep.chunks:
+            return None
+        with snap.lock:
+            v = snap.version
+            if prep.node_epoch != snap.node_epoch:
+                return None
+            if chain is not None:
+                # pre-commit dispatch: the chain AND the prepared lowering
+                # must both describe the current (uncommitted) world
+                if chain_version != v or prep.version != v:
+                    return None
+            else:
+                # post-commit dispatch: prepared either after the commit
+                # (same version) or before it with no other write in
+                # between (the commit's own writes don't touch what the
+                # lowering read — labels, presence, pod specs)
+                if not (
+                    prep.version == v
+                    or (
+                        prep.version == sched._pre_cycle_version
+                        and v == sched._post_cycle_version
+                    )
+                ):
+                    return None
+                chain = sched.node_state(None)
+            with sched.extender.tracer.span(
+                "pipeline:dispatch",
+                cat="pipeline",
+                chunks=len(prep.chunks),
+            ):
+                solves, chain_out = sched._dispatch_chained(
+                    prep.chunks,
+                    chain,
+                    quarantine=prep.quarantine,
+                    prepared=prep.triples,
+                )
+            return SpeculativeSolve(
+                chunk_uids=prep.chunk_uids,
+                sub=None,
+                solves=solves,
+                chain_out=chain_out,
+                version=v,
+                node_epoch=prep.node_epoch,
+                quarantine=prep.quarantine,
+                dispatched_at=_time.perf_counter(),
+            )
+
+    def _prepare_ok(self, batch: Sequence[Pod]) -> bool:
+        """Whether the worker may touch this batch at all: prepare must
+        be a PURE read of the pods + snapshot (gang bookkeeping and pod
+        transformers mutate state the real cycle will mutate again)."""
+        from .plugins.coscheduling import gang_key_of
+
+        sched = self.sched
+        if sched.pod_groups.has_gangs or sched.extender._pre_batch:
+            return False
+        return all(gang_key_of(p) is None for p in batch)
+
+    def _gates_ok(self, batch: Sequence[Pod]) -> bool:
+        """Whether this batch may take the speculative fast path. Every
+        gate names a subsystem whose host-side commit state the device
+        chain cannot carry exactly (or whose bookkeeping the speculative
+        ordering would double-run); gated cycles run serial — identical
+        decisions, no overlap. The state-bearing subset
+        (``_speculation_consume_ok``) is re-checked by the scheduler at
+        consume time: a gated subsystem arriving mid-pipeline through an
+        informer invalidates the in-flight speculation."""
+        from .plugins.coscheduling import gang_key_of
+
+        sched = self.sched
+        if not sched._speculation_consume_ok():
+            return False
+        if sched._fallback_level != 0 or sched._bucket_degrade != 0:
+            return False
+        return all(gang_key_of(p) is None for p in batch)

@@ -153,6 +153,12 @@ class DeviceManager:
         #: (full rebuild or a dirty-row flush) — the scheduler keys its
         #: device-resident DeviceState upload off it
         self.lowered_version = 0
+        #: snapshot row indices whose lowered rows changed since the last
+        #: drain_lowered_dirty() — the scheduler scatters ONLY these into
+        #: its device-resident DeviceState instead of re-uploading the
+        #: whole [N, G] slot table (ROADMAP item b)
+        self._scatter_rows: set = set()
+        self._scatter_full = True
         #: widest GPU inventory ever ingested (monotone — shrink keeps
         #: harmless zero columns) so _lowered() needn't rescan every node
         self._max_minors: int = 0
@@ -218,12 +224,27 @@ class DeviceManager:
             for name in self._nodes:
                 self._refresh_row(name)
             self.lowered_version += 1
+            self._scatter_full = True
+            self._scatter_rows.clear()
         elif self._low_dirty:
             for name in self._low_dirty:
                 self._refresh_row(name)
+                idx = self.snapshot.node_id(name)
+                if idx is not None:
+                    self._scatter_rows.add(int(idx))
             self._low_dirty = set()
             self.lowered_version += 1
         return self._low
+
+    def drain_lowered_dirty(self) -> Optional[np.ndarray]:
+        """Snapshot row indices whose lowered device rows changed since
+        the last drain, or None for a full rebuild (see
+        :func:`..plugins.drain_scatter_marks`). Call AFTER
+        :meth:`_lowered` / ``slot_array`` (which flush pending dirty
+        names)."""
+        from . import drain_scatter_marks
+
+        return drain_scatter_marks(self)
 
     def upsert_device(self, device: Device) -> None:
         """Ingest/refresh a node's inventory. Live allocations survive a

@@ -113,6 +113,13 @@ class NUMAManager:
         #: tables (full rebuild or a dirty-row flush) — the scheduler keys
         #: its device-resident NumaState upload off it
         self.lowered_version = 0
+        #: snapshot row indices whose lowered rows changed since the last
+        #: drain_lowered_dirty() — the scheduler scatters ONLY these into
+        #: its device-resident NumaState instead of re-uploading the whole
+        #: [N, Z, DN] table (ROADMAP item b); a full rebuild sets the
+        #: wholesale flag instead
+        self._scatter_rows: set = set()
+        self._scatter_full = True
 
     def _mark_dirty(self, node_name: str) -> None:
         if self._zone_cache is not None:
@@ -327,6 +334,8 @@ class NUMAManager:
                 self._refresh_zone_row(name)
             self._amp_seen = amp.copy()
             self.lowered_version += 1
+            self._scatter_full = True
+            self._scatter_rows.clear()
         else:
             if self._amp_seen is None or not np.array_equal(
                 self._amp_seen, amp
@@ -350,9 +359,21 @@ class NUMAManager:
             if self._zone_dirty:
                 for name in self._zone_dirty:
                     self._refresh_zone_row(name)
+                    idx = self.snapshot.node_id(name)
+                    if idx is not None:
+                        self._scatter_rows.add(int(idx))
                 self._zone_dirty = set()
                 self.lowered_version += 1
         return self._zone_cache[:3]
+
+    def drain_lowered_dirty(self) -> Optional[np.ndarray]:
+        """Snapshot row indices whose lowered zone rows changed since the
+        last drain, or None for a full rebuild (see
+        :func:`..plugins.drain_scatter_marks`). Call AFTER :meth:`arrays`
+        (which flushes pending dirty names into the lowered cache)."""
+        from . import drain_scatter_marks
+
+        return drain_scatter_marks(self)
 
     def most_allocated_rows(self) -> np.ndarray:
         """[N] bool MostAllocated zone-pick strategy per snapshot row

@@ -22,10 +22,10 @@ from __future__ import annotations
 
 import time as _time
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..api.types import Pod
-from .batch_solver import BatchScheduler
+from .batch_solver import BatchScheduler, ScheduleOutcome
 
 
 class StreamScheduler:
@@ -35,18 +35,37 @@ class StreamScheduler:
     one adaptive-batch cycle and returns per-pod outcomes with measured
     enqueue→decision latency. Unschedulable pods are re-queued up to
     ``max_retries`` cycles (their latency clock keeps running — the
-    north-star latency is enqueue→bind, not attempt-scoped)."""
+    north-star latency is enqueue→bind, not attempt-scoped).
+
+    ``pipelined=True`` selects the cross-cycle pipelined pump mode (perf
+    PR 4): each ``pump`` hands its batch to a :class:`CyclePipeline` —
+    which dispatches the batch's solves chained off the previous cycle's
+    on-device commit state while that cycle's host Reserve trails behind
+    — and returns the PREVIOUS batch's decisions (one-pump lag; call
+    :meth:`flush` to drain the tail). Decisions are identical to the
+    serial pump; only the overlap differs."""
 
     def __init__(
         self,
         scheduler: BatchScheduler,
         max_batch: int = 256,
         max_retries: int = 3,
+        pipelined: bool = False,
+        prepare_timeout_s: float = 5.0,
     ):
         self.scheduler = scheduler
         self.max_batch = max_batch
         self.max_retries = max_retries
         self._queue: Deque[Tuple[Pod, float, int]] = deque()
+        self._pipe = None
+        #: uid -> (arrival stamp, tries) for pods inside the pipeline
+        self._inflight_meta: Dict[str, Tuple[float, int]] = {}
+        if pipelined:
+            from .pipeline import CyclePipeline
+
+            self._pipe = CyclePipeline(
+                scheduler, prepare_timeout_s=prepare_timeout_s
+            )
 
     def submit(self, pod: Pod, now: Optional[float] = None) -> None:
         self._queue.append(
@@ -56,11 +75,19 @@ class StreamScheduler:
     def backlog(self) -> int:
         return len(self._queue)
 
+    def close(self) -> None:
+        if self._pipe is not None:
+            self._pipe.close()
+
     def pump(self) -> List[Tuple[Pod, Optional[str], float]]:
         """One cycle: schedule up to ``max_batch`` queued pods. Returns
         ``(pod, node|None, latency_s)`` for every pod DECIDED this cycle
         — bound pods and pods that exhausted their retries; retried pods
-        return to the queue with their original arrival stamp."""
+        return to the queue with their original arrival stamp. In
+        pipelined mode the returned decisions belong to the PREVIOUS
+        pump's batch (the new batch's solve is in flight)."""
+        if self._pipe is not None:
+            return self._pump_pipelined()
         if not self._queue:
             return []
         batch: List[Tuple[Pod, float, int]] = []
@@ -88,3 +115,58 @@ class StreamScheduler:
                 backlog=len(self._queue),
             )
         return results
+
+    # ---- pipelined mode ----
+
+    def _pump_pipelined(self) -> List[Tuple[Pod, Optional[str], float]]:
+        if not self._queue and not self._pipe.inflight:
+            return []
+        batch: List[Tuple[Pod, float, int]] = []
+        for _ in range(min(self.max_batch, len(self._queue))):
+            batch.append(self._queue.popleft())
+        with self.scheduler.extender.tracer.span(
+            "pump", cat="scheduler", batch=len(batch), pipelined=True
+        ) as sp:
+            for pod, t_arr, tries in batch:
+                self._inflight_meta[pod.meta.uid] = (t_arr, tries)
+            out = self._pipe.feed([p for p, _t, _n in batch])
+            results = self._absorb(out)
+            sp.set(
+                decided=len(results),
+                backlog=len(self._queue),
+            )
+        return results
+
+    def _absorb(
+        self, out: Optional[ScheduleOutcome]
+    ) -> List[Tuple[Pod, Optional[str], float]]:
+        if out is None:
+            return []
+        t_done = _time.perf_counter()
+        results: List[Tuple[Pod, Optional[str], float]] = []
+        for pod, node in out.bound:
+            t_arr, _tries = self._inflight_meta.pop(pod.meta.uid)
+            results.append((pod, node, t_done - t_arr))
+        for pod in out.unschedulable:
+            t_arr, tries = self._inflight_meta.pop(pod.meta.uid)
+            if tries + 1 < self.max_retries:
+                self._queue.append((pod, t_arr, tries + 1))
+            else:
+                results.append((pod, None, t_done - t_arr))
+        return results
+
+    def flush(self) -> List[Tuple[Pod, Optional[str], float]]:
+        """Drain everything: pump until the queue is empty, then complete
+        the pipeline's in-flight cycle(s). Retried pods cycle back through
+        until decided. Serial mode simply pumps the queue dry."""
+        results: List[Tuple[Pod, Optional[str], float]] = []
+        if self._pipe is None:
+            while self._queue:
+                results.extend(self.pump())
+            return results
+        while True:
+            while self._queue:
+                results.extend(self.pump())
+            results.extend(self._absorb(self._pipe.flush()))
+            if not self._queue and not self._pipe.inflight:
+                return results

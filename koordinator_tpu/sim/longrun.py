@@ -607,8 +607,12 @@ def run_chaos_soak(
     watch disconnects (informer re-list), solver dispatch failures
     (fallback ladder + re-promotion), NaN row corruption (numeric
     quarantine), a solve-latency spike against the per-cycle deadline
-    (batch degrade), and exactly one mid-commit crash (Reserve journal
-    rollback).
+    (batch degrade), exactly one mid-commit crash (Reserve journal
+    rollback), and — scheduling runs through the cross-cycle
+    :class:`~koordinator_tpu.scheduler.pipeline.CyclePipeline` (perf
+    PR 4) — prepare-worker stalls/deaths (``pipeline.worker_stall``),
+    which must degrade the cycle to the serial path and recover, never
+    wedge the drain.
     """
     import random as _random
 
@@ -631,6 +635,7 @@ def run_chaos_soak(
     from koordinator_tpu.scheduler.batch_solver import (
         BatchScheduler,
         LoadAwareArgs,
+        ScheduleOutcome,
     )
     from koordinator_tpu.scheduler.plugins.elasticquota import (
         GroupQuotaManager,
@@ -641,6 +646,10 @@ def run_chaos_soak(
     POD_CPU, POD_MEM = 2_000.0, 4_096.0
     LIFETIME = 6            # cycles a pod runs before completing
     rng = _random.Random(seed)
+    # separate seeded stream for fault points added AFTER the original
+    # schedule shipped: drawing them from `rng` would shift every
+    # downstream draw and silently re-roll the whole historical schedule
+    rng_pipe = _random.Random(seed ^ 0x9E3779B9)
 
     chaos = FaultInjector(seed=seed)
     snap = ClusterSnapshot()
@@ -676,6 +685,17 @@ def run_chaos_soak(
     sched.extender.monitor.stop_background()
     reg = sched.extender.registry
     chaos.bind_counter(reg.get("fault_injected_total"))
+    # scheduling flows through the cross-cycle pipeline: decisions lag
+    # one cycle (solve in flight while the previous commit trails), the
+    # prepare worker is a live failure domain, and every invariant below
+    # must keep holding through stalls and degradations
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    # generous prepare deadline: a chaos-KILLED worker is detected
+    # promptly via thread death (collect returns early), so the timeout
+    # only bounds a genuinely slow prepare — a tight value makes the
+    # stall/health accounting flake under host CPU contention
+    pipe = CyclePipeline(sched, prepare_timeout_s=10.0)
 
     hub = ClusterStateHub(
         chaos=chaos, health=sched.extender.health, error_registry=reg
@@ -829,6 +849,8 @@ def run_chaos_soak(
                 )                                                 # ladder demote
             if rng.random() < 0.05:
                 chaos.arm("solver.nan_rows", times=1)             # quarantine
+            if rng_pipe.random() < 0.08:
+                chaos.arm("pipeline.worker_stall", times=1)       # serial degrade
             if cycle == crash_cycle:
                 chaos.arm("commit.crash", error=RuntimeError, times=1)
             surge = 0
@@ -859,10 +881,17 @@ def run_chaos_soak(
                 )
             stats["arrived"] += len(arriving)
         pending.extend(arriving)
-        if not pending and cycle >= cycles:
+        if not pending and not pipe.inflight and cycle >= cycles:
             break
 
-        out = sched.schedule(pending)
+        # pipelined feed: this batch's solve goes in flight, the
+        # PREVIOUS batch's trailing commit lands — its outcome is what
+        # the bookkeeping below sees (one-cycle lag; invariants are
+        # lag-agnostic: they compare live accounting, not batch identity)
+        out = pipe.feed(pending)
+        pending = []
+        if out is None:
+            out = ScheduleOutcome(bound=[], unschedulable=[])
         new_bound = []
         for pod, node in out.bound:
             # INVARIANT: a pod binds exactly once, ever
@@ -913,6 +942,26 @@ def run_chaos_soak(
                 f"placed={stats['placed']} lost_syncs={stats['sync_lost']} "
                 f"fallback_level={sched._fallback_level}"
             )
+
+    # drain the pipeline's in-flight tail (loop exhaustion may leave one
+    # batch mid-flight; a break can't — its condition requires an empty
+    # pipeline) and account it exactly like an in-loop cycle
+    final = pipe.flush()
+    if final is not None:
+        final_bound = []
+        for pod, node in final.bound:
+            assert pod.meta.uid not in placed, (
+                f"pod {pod.meta.name} placed twice"
+            )
+            placed[pod.meta.uid] = node
+            pod.spec.node_name = node
+            hub.publish(hub.pods, pod)
+            final_bound.append((pod, node))
+        stats["placed"] += len(final_bound)
+        pending.extend(final.unschedulable)
+        assert hub.wait_synced()
+        _sync_cycle_delta(final_bound, [])
+    pipe.close()
 
     # ---- end-state assertions ----
     # every pod that ever arrived eventually placed

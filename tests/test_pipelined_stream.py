@@ -1,0 +1,502 @@
+"""Cross-cycle solve pipelining (perf PR 4): the pipelined stream pump
+must be DECISION-IDENTICAL to the serial pump over a multi-cycle stream
+— including retries and node churn mid-stream — while overlapping the
+host prepare/commit stages with the device solve. Plus the satellites:
+donated in-place resident refresh (zero fresh full-axis buffers),
+resident PodBatch interning, and the ``pipeline.worker_stall`` failure
+domain (degrade to serial + /healthz + recovery, never a wedge)."""
+
+import warnings
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.chaos import FaultInjector
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.stream import StreamScheduler
+
+
+def _node(name, cpu=16000, mem=65536):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(
+            allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}
+        ),
+    )
+
+
+def _build(n_nodes=32, **kw):
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(_node(f"n{i:03d}"))
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), batch_bucket=kw.pop("batch_bucket", 64), **kw
+    )
+    sched.extender.monitor.stop_background()
+    return sched
+
+
+def _pods(n, cpu=1000, mem=2048, prefix="p", prio0=9000):
+    return [
+        Pod(
+            meta=ObjectMeta(name=f"{prefix}{i:04d}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem},
+                priority=prio0 - (i % 7),
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _drive(sched, pipelined, pods, waves=8, churn_at=None, **stream_kw):
+    """Stream ``pods`` in ``waves`` equal submissions, pumping after each;
+    ``churn_at`` removes one node and adds a fresh one before that wave
+    (mid-stream topology churn). Returns {pod name: node | None}."""
+    st = StreamScheduler(sched, pipelined=pipelined, **stream_kw)
+    per = max(1, len(pods) // waves)
+    decided = {}
+    i = 0
+    wave = 0
+    try:
+        while i < len(pods) or st.backlog() or (
+            pipelined and st._pipe.inflight
+        ):
+            if churn_at is not None and wave == churn_at:
+                # apply churn at a pipeline-QUIESCENT boundary: flush the
+                # in-flight cycle first so both modes see the topology
+                # change between the same two decided batches (an
+                # epoch-changing event mid-pipeline is the discard path —
+                # covered by its own test below — and re-times the
+                # affected batch's commit, which no lagged pump can make
+                # bit-identical to an eager one)
+                for pod, node, _lat in st.flush():
+                    decided[pod.meta.name] = node
+                snap = sched.snapshot
+                snap.remove_node(snap.node_name(3))
+                snap.upsert_node(_node("late-node"))
+            wave += 1
+            for _ in range(per):
+                if i < len(pods):
+                    st.submit(pods[i])
+                    i += 1
+            for pod, node, _lat in st.pump():
+                decided[pod.meta.name] = node
+            if i >= len(pods) and not st.backlog() and (
+                not pipelined or not st._pipe.inflight
+            ):
+                break
+        for pod, node, _lat in st.flush():
+            decided[pod.meta.name] = node
+    finally:
+        st.close()
+    return decided
+
+
+def test_pipelined_equals_serial_multi_cycle():
+    """Bit-exact decision equivalence over a plain multi-cycle stream —
+    and the speculative fast path must actually ENGAGE, or this verifies
+    nothing."""
+    a = _build()
+    da = _drive(a, pipelined=False, pods=_pods(300), waves=8, max_batch=64)
+    b = _build()
+    db = _drive(b, pipelined=True, pods=_pods(300), waves=8, max_batch=64)
+    kept = b.extender.registry.get("pipeline_speculation_total").value(
+        outcome="kept"
+    )
+    assert kept > 0, "speculative chained dispatch never engaged"
+    assert len(db) == len(da) == 300
+    assert da == db
+
+
+def test_pipelined_equals_serial_with_retries():
+    """An overloaded cluster forces unschedulable pods back through the
+    retry queue; decisions (including final give-ups) must still match
+    the serial pump, and the retried re-lowering must hit the intern
+    cache."""
+    a = _build(n_nodes=4)
+    pods_a = _pods(120, cpu=4000, mem=16384)
+    da = _drive(a, pipelined=False, pods=pods_a, waves=4, max_batch=64)
+    b = _build(n_nodes=4)
+    pods_b = _pods(120, cpu=4000, mem=16384)
+    db = _drive(b, pipelined=True, pods=pods_b, waves=4, max_batch=64)
+    assert da == db
+    assert any(v is None for v in db.values()), "fixture must overload"
+    hits = b.extender.registry.get("pod_intern_hits_total").value()
+    assert hits > 0, "retried pods must hit the interned rows"
+
+
+def test_pipelined_equals_serial_node_churn_mid_stream():
+    """Node churn mid-stream (applied at a pipeline-quiescent boundary,
+    see _drive) — decisions before AND after the topology change must
+    match the serial pump bit-exactly."""
+    a = _build()
+    da = _drive(
+        a, pipelined=False, pods=_pods(240), waves=6, churn_at=3,
+        max_batch=64,
+    )
+    b = _build()
+    db = _drive(
+        b, pipelined=True, pods=_pods(240), waves=6, churn_at=3,
+        max_batch=64,
+    )
+    assert da == db
+    assert "late-node" in set(db.values()), "churn must be load-bearing"
+
+
+def test_speculation_discarded_on_mid_pipeline_churn():
+    """Churn landing while a speculative solve is in flight must DISCARD
+    it (node-epoch/version guard), re-dispatch serially, and still place
+    every pod on a live node — never on the vanished one, never wedge."""
+    sched = _build()
+    st = StreamScheduler(sched, max_batch=64, pipelined=True)
+    pods = _pods(240)
+    decided = {}
+    i = 0
+    wave = 0
+    pre_churn: set = set()
+    try:
+        while i < len(pods) or st.backlog() or st._pipe.inflight:
+            if wave == 3:
+                # no flush: the in-flight speculation is now stale
+                pre_churn = set(decided)
+                snap = sched.snapshot
+                snap.remove_node(snap.node_name(3))
+                snap.upsert_node(_node("late-node"))
+            wave += 1
+            for _ in range(40):
+                if i < len(pods):
+                    st.submit(pods[i])
+                    i += 1
+            for pod, node, _lat in st.pump():
+                decided[pod.meta.name] = node
+        for pod, node, _lat in st.flush():
+            decided[pod.meta.name] = node
+    finally:
+        st.close()
+    discarded = sched.extender.registry.get(
+        "pipeline_speculation_total"
+    ).value(outcome="discarded")
+    assert discarded > 0, "mid-pipeline churn must discard the spec"
+    assert len(decided) == 240
+    for name, node in decided.items():
+        assert node is not None, f"{name} never placed"
+        if name not in pre_churn:
+            # a post-churn decision may never land on the vanished node
+            # (Reserve revalidation catches the stale nomination); pods
+            # bound BEFORE the churn legitimately sat on it, like any
+            # bound pod whose node later dies
+            assert node != "n003", name
+
+
+def test_speculation_discarded_when_quota_tree_arrives_mid_pipeline():
+    """A gated subsystem can arrive through an informer WITHOUT bumping
+    snapshot.version (the first ElasticQuota CR only bumps the quota
+    manager's own state_version): the in-flight speculation — whose rows
+    carry no quota chains — must be DISCARDED at consume, and the
+    re-dispatched serial cycle must charge the quota tree."""
+    from koordinator_tpu.api.types import ElasticQuota
+
+    sched = _build(n_nodes=16, batch_bucket=32)
+    st = StreamScheduler(sched, max_batch=32, pipelined=True)
+    decided = {}
+    try:
+        # pump 1: batch A in flight (speculation dispatched)
+        for p in _pods(32, prefix="a"):
+            st.submit(p)
+        st.pump()
+        # mid-pipeline: the first quota CR lands; snapshot.version is
+        # untouched but the pipeline gates no longer hold
+        sched.quotas.upsert_quota(
+            ElasticQuota(
+                meta=ObjectMeta(name="team-q"),
+                min={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                max={ext.RES_CPU: 8000, ext.RES_MEMORY: 16384},
+            )
+        )
+        # pump 2: batch B (quota-labeled) — commits batch A, which must
+        # NOT consume the pre-quota speculation
+        for i in range(16):
+            st.submit(
+                Pod(
+                    meta=ObjectMeta(
+                        name=f"q{i:03d}",
+                        labels={ext.LABEL_QUOTA_NAME: "team-q"},
+                    ),
+                    spec=PodSpec(
+                        requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 2048},
+                        priority=9000,
+                    ),
+                )
+            )
+        for pod, node, _lat in st.pump():
+            decided[pod.meta.name] = node
+        for pod, node, _lat in st.flush():
+            decided[pod.meta.name] = node
+    finally:
+        st.close()
+    discarded = sched.extender.registry.get(
+        "pipeline_speculation_total"
+    ).value(outcome="discarded")
+    assert discarded > 0, "pre-quota speculation must be discarded"
+    # quota admission actually engaged: at most max/1000m = 8 of the 16
+    # labeled pods admitted, and the manager's used ledger is charged
+    q_bound = [
+        n for k, n in decided.items() if k.startswith("q") and n is not None
+    ]
+    assert 0 < len(q_bound) <= 8, q_bound
+    q_idx = sched.quotas.index_of("team-q")
+    assert sched.quotas.used[q_idx][0] == 1000.0 * len(q_bound)
+
+
+def test_worker_stall_degrades_to_serial_and_recovers():
+    """A stalled/dead prepare worker must degrade the cycle to the serial
+    path with counted attribution and a /healthz transition — and the
+    pipeline must recover (worker respawn, health ok) instead of wedging
+    the drain."""
+    chaos = FaultInjector(seed=5)
+    sched = _build(n_nodes=16, batch_bucket=32, chaos=chaos)
+    chaos.arm("pipeline.worker_stall", at_hits=frozenset([2]))
+    st = StreamScheduler(
+        sched, max_batch=32, pipelined=True, prepare_timeout_s=0.3
+    )
+    pods = _pods(160, cpu=500, mem=512)
+    decided = {}
+    health_seen_bad = False
+    i = 0
+    try:
+        while i < len(pods):
+            for _ in range(32):
+                if i < len(pods):
+                    st.submit(pods[i])
+                    i += 1
+            for pod, node, _lat in st.pump():
+                decided[pod.meta.name] = node
+            row = sched.extender.health.snapshot().get("pipeline")
+            if row is not None and not row["ok"]:
+                health_seen_bad = True
+        for pod, node, _lat in st.flush():
+            decided[pod.meta.name] = node
+    finally:
+        st.close()
+    reg = sched.extender.registry
+    assert chaos.fired_counts()["pipeline.worker_stall"] == 1
+    assert reg.get("pipeline_prepare_stalls_total").value() == 1.0
+    assert health_seen_bad, "the stall must surface on /healthz"
+    row = sched.extender.health.snapshot()["pipeline"]
+    assert row["ok"], "the pipeline must recover after the respawn"
+    assert len(decided) == 160
+    assert all(v is not None for v in decided.values())
+
+
+def test_pipelined_smoke_three_cycles():
+    """Tier-1 smoke (CI satellite): three pipelined cycles end to end
+    under JAX_PLATFORMS=cpu — dispatch, trailing commit, flush."""
+    sched = _build(n_nodes=8, batch_bucket=16)
+    st = StreamScheduler(sched, max_batch=16, pipelined=True)
+    pods = _pods(48, cpu=500, mem=512)
+    decided = {}
+    try:
+        for c in range(3):
+            for p in pods[c * 16 : (c + 1) * 16]:
+                st.submit(p)
+            for pod, node, _lat in st.pump():
+                decided[pod.meta.name] = node
+        for pod, node, _lat in st.flush():
+            decided[pod.meta.name] = node
+    finally:
+        st.close()
+    assert len(decided) == 48
+    assert all(v is not None for v in decided.values())
+    depth = sched.extender.registry.get("solver_pipeline_depth")
+    assert depth is not None
+
+
+def test_donated_refresh_reuses_resident_buffers():
+    """Satellite (a): the steady-state dirty-row refresh donates the
+    resident buffers to the scatter — ownership transfers (the old
+    handles are DEAD, not copied), no donation warning fires, and the
+    steady state allocates zero net full-axis arrays (live device-buffer
+    count stays flat across many refreshes)."""
+    sched = _build(n_nodes=32)
+    snap = sched.snapshot
+    ns0 = sched.node_state()
+    jax.block_until_ready(ns0.requested)
+    pod = Pod(
+        meta=ObjectMeta(name="d0"),
+        spec=PodSpec(requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 512}),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any donation warning fails
+        assert snap.assume_pod(pod, snap.node_name(5))
+        ns1 = sched.node_state()
+        jax.block_until_ready(ns1.requested)
+    assert ns1 is not ns0
+    np.testing.assert_array_equal(
+        np.asarray(ns1.requested), snap.nodes.requested
+    )
+    # the donated input is dead — re-reading it must raise, proving the
+    # buffers changed hands (in-place update) instead of being copied
+    with pytest.raises(Exception):
+        np.asarray(ns0.requested)
+    del ns0, ns1
+    # steady state: many dirty-row refreshes leave the live device-array
+    # population flat — each scatter consumes the old resident buffers
+    # and hands back the updated ones, allocating nothing net
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        for k in range(3):  # warm every shape/jit path first
+            p = Pod(
+                meta=ObjectMeta(name=f"warm{k}"),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: 100, ext.RES_MEMORY: 64}
+                ),
+            )
+            assert snap.assume_pod(p, snap.node_name(k))
+            jax.block_until_ready(sched.node_state().requested)
+        live0 = len(jax.live_arrays())
+        for k in range(20):
+            p = Pod(
+                meta=ObjectMeta(name=f"s{k}"),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: 100, ext.RES_MEMORY: 64}
+                ),
+            )
+            assert snap.assume_pod(p, snap.node_name(k % 16))
+            jax.block_until_ready(sched.node_state().requested)
+        live1 = len(jax.live_arrays())
+    assert live1 <= live0, (live0, live1)
+
+
+def test_intern_cache_identity_and_eviction():
+    """Interned lowering must be byte-identical to a cold parse, and a
+    bound pod's entry must be evicted (bind/drop eviction contract)."""
+    sched_cold = _build(n_nodes=8, intern_pods=False)
+    sched_warm = _build(n_nodes=8, intern_pods=True)
+    pods_c = _pods(40, cpu=3000, mem=4096)
+    pods_w = _pods(40, cpu=3000, mem=4096)
+    # two identical schedules: the second warm pass lowers from cache
+    out_c1 = sched_cold.schedule(pods_c)
+    out_w1 = sched_warm.schedule(pods_w)
+    assert {p.meta.name: n for p, n in out_c1.bound} == {
+        p.meta.name: n for p, n in out_w1.bound
+    }
+    # bound pods evicted from the cache
+    cache = sched_warm._pod_intern
+    for pod, _n in out_w1.bound:
+        assert pod.meta.uid not in cache
+    # still-pending pods stay interned and hit on the retry
+    for pod in out_w1.unschedulable:
+        assert pod.meta.uid in cache
+    if out_w1.unschedulable:
+        hits0 = sched_warm.extender.registry.get(
+            "pod_intern_hits_total"
+        ).value()
+        out_c2 = sched_cold.schedule(out_c1.unschedulable)
+        out_w2 = sched_warm.schedule(out_w1.unschedulable)
+        assert {p.meta.name: n for p, n in out_c2.bound} == {
+            p.meta.name: n for p, n in out_w2.bound
+        }
+        assert (
+            sched_warm.extender.registry.get("pod_intern_hits_total").value()
+            > hits0
+        )
+
+
+def test_intern_entry_invalidated_by_spec_change():
+    """An in-place spec edit under the same uid must self-invalidate the
+    interned row (fingerprint mismatch), never resurrect stale data."""
+    sched = _build(n_nodes=8)
+    pod = Pod(
+        meta=ObjectMeta(name="mut0"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 64000, ext.RES_MEMORY: 512},
+            priority=9000,
+        ),
+    )
+    out = sched.schedule([pod])
+    assert not out.bound  # 64 cores fits nowhere (16-core nodes)
+    pod.spec.requests[ext.RES_CPU] = 1000.0
+    out2 = sched.schedule([pod])
+    assert len(out2.bound) == 1, "stale interned row blocked the re-lower"
+
+
+def test_numa_device_dirty_row_scatter():
+    """Satellite (b): an allocation delta on one node must refresh the
+    resident NUMA zone / GPU slot tables via the dirty-row scatter (a
+    handful of padded rows), not a full-axis re-upload — and stay
+    bit-exact vs the managers' live host arrays."""
+    from koordinator_tpu.api.types import Device, DeviceInfo
+    from koordinator_tpu.core.topology import CPUTopology
+    from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+    from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+        NUMAManager,
+        NUMAPolicy,
+    )
+
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    dm = DeviceManager(snap)
+    topo = CPUTopology.uniform(
+        sockets=2, numa_per_socket=1, cores_per_numa=8
+    )
+    for i in range(24):
+        name = f"n{i:03d}"
+        snap.upsert_node(_node(name, cpu=32000, mem=131072))
+        numa.register_node(
+            name, topo, NUMAPolicy.SINGLE_NUMA_NODE,
+            memory_per_zone_mib=65536,
+        )
+        dm.upsert_device(
+            Device(
+                meta=ObjectMeta(name=name),
+                devices=[
+                    DeviceInfo(dev_type="gpu", minor=g, numa_node=g % 2)
+                    for g in range(4)
+                ],
+            )
+        )
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), numa=numa, devices=dm, batch_bucket=32
+    )
+    sched.extender.monitor.stop_background()
+    sched._constraint_states()  # initial full uploads
+    reg = sched.extender.registry
+    h2d0 = reg.get("solver_h2d_rows_total").value()
+    # one pod's NUMA + GPU allocation dirties exactly one node's rows
+    pod = Pod(
+        meta=ObjectMeta(
+            name="g0", labels={ext.LABEL_POD_QOS: "LSR"}
+        ),
+        spec=PodSpec(
+            requests={
+                ext.RES_CPU: 2000,
+                ext.RES_MEMORY: 2048,
+                ext.RES_GPU: 1,
+            },
+            priority=9000,
+        ),
+    )
+    out = sched.schedule([pod])
+    assert len(out.bound) == 1
+    h2d1 = reg.get("solver_h2d_rows_total").value()
+    numa_state, dev_state = sched._constraint_states()
+    uploaded = reg.get("solver_h2d_rows_total").value() - h2d1
+    n_bucket = snap.nodes.allocatable.shape[0]
+    # the refresh must be a scatter of a few padded rows per table, far
+    # below two full-axis re-uploads
+    assert 0 < uploaded < n_bucket, uploaded
+    zone_free, zone_cap, policy = numa.arrays()
+    np.testing.assert_array_equal(
+        np.asarray(numa_state.zone_free), zone_free
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dev_state.slot_free), dm.slot_array()
+    )
