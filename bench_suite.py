@@ -888,6 +888,133 @@ def bench_stream_pipelined():
     return out
 
 
+def bench_recovery():
+    """Cold-restart vs warm-standby takeover time (HA failover PR).
+
+    One leader binds a cluster's worth of pods (journaled + published),
+    then commits a tail of bindings that are journal-ACKNOWLEDGED but
+    never published — the lost-ack window a takeover must replay. Two
+    recovery paths are then timed end-to-end (statehub sync + journal
+    replay + resident re-lower + bit-exactness verification):
+
+    * **warm standby** — a second instance that has been informer-synced
+      all along with its device-resident NodeState already lowered; its
+      takeover pays only the journal-tail replay and a dirty-row scatter
+      of the touched rows;
+    * **cold restart** — a fresh instance re-wiring the statehub from
+      nothing: full re-list (every node/metric/pod event), full replay,
+      full-axis re-lower.
+
+    The gap between the two is the number the HA design buys: recovery
+    cost proportional to the takeover DELTA, not to cluster size."""
+    import time as _t
+
+    from koordinator_tpu.core.journal import (
+        BindJournal,
+        EpochFence,
+        MemoryJournalStore,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.runtime.recovery import recover_scheduler
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+    from koordinator_tpu.sim.cluster_gen import GenConfig, gen_nodes, gen_pods
+
+    n_nodes, n_pods, tail = 2048, 4096, 256
+    fence = EpochFence()
+    store = MemoryJournalStore()
+
+    def make_sched():
+        s = BatchScheduler(
+            ClusterSnapshot(),
+            LoadAwareArgs(),
+            batch_bucket=1024,
+            max_rounds=8,
+            journal=BindJournal(store),
+            fence=fence,
+        )
+        s.extender.monitor.stop_background()
+        return s
+
+    hub = ClusterStateHub()
+    leader = make_sched()
+    standby = make_sched()
+    hub.wire_scheduler(leader)
+    hub.wire_scheduler(standby)
+    hub.start()
+    cfg = GenConfig(n_nodes=n_nodes, n_pods=n_pods + tail, seed=5)
+    nodes, metrics = gen_nodes(cfg)
+    for n in nodes:
+        hub.publish(hub.nodes, n)
+    for m in metrics:
+        hub.publish(hub.node_metrics, m)
+    assert hub.wait_synced()
+    pods = gen_pods(cfg)
+    leader.grant_leadership(fence.advance())
+    out_bound = leader.schedule(pods[:n_pods])
+    for pod, node in out_bound.bound:
+        pod.spec.node_name = node
+        hub.publish(hub.pods, pod)
+    assert hub.wait_synced()
+    # warm standby steady state: synced, resident tables lowered, and
+    # the dirty-scatter jit specializations warmed across the bucket
+    # sizes the takeover's replay can touch (a long-lived standby has
+    # refreshed through delta streams before; first-call compiles must
+    # not be billed to the takeover)
+    standby.node_state()
+    for warm_bucket in (8, 16, 32, 64, 128, 256, 512):
+        standby.snapshot.touch_rows(range(warm_bucket))
+        standby.node_state()
+    # the lost-ack tail: journaled binds the takeover must replay
+    out_tail = leader.schedule(pods[n_pods:])
+    # quiesce the (shared, on CPU) device stream: the dead leader's
+    # async solve tail must not be billed to the takeover timings
+    import jax as _jax
+
+    if leader._resident_nodes is not None:
+        _jax.block_until_ready(leader._resident_nodes.requested)
+
+    t0 = _t.perf_counter()
+    rep_warm = recover_scheduler(
+        standby,
+        standby.bind_journal,
+        hub=hub,
+        epoch=fence.advance(),
+        verify=True,
+    )
+    warm_ms = (_t.perf_counter() - t0) * 1e3
+
+    hub.detach_consumers()
+    cold = make_sched()
+    hub.wire_scheduler(cold)
+    hub.start()
+    t0 = _t.perf_counter()
+    rep_cold = recover_scheduler(
+        cold, cold.bind_journal, hub=hub, epoch=fence.advance(), verify=True
+    )
+    cold_ms = (_t.perf_counter() - t0) * 1e3
+    hub.stop()
+    assert rep_warm.bitexact and rep_cold.bitexact
+    return {
+        "scenario": "recovery",
+        "nodes": n_nodes,
+        "bound_published": len(out_bound.bound),
+        "journal_tail": len(out_tail.bound),
+        "warm_takeover_ms": round(warm_ms, 1),
+        "cold_restart_ms": round(cold_ms, 1),
+        "warm_replayed": rep_warm.replayed,
+        "warm_reconfirmed": rep_warm.reconfirmed,
+        "cold_replayed": rep_cold.replayed,
+        "cold_reconfirmed": rep_cold.reconfirmed,
+        "warm_relower_ms": round(rep_warm.warm_lower_s * 1e3, 2),
+        "cold_relower_ms": round(rep_cold.warm_lower_s * 1e3, 2),
+        "takeover_speedup": round(cold_ms / max(warm_ms, 1e-9), 2),
+    }
+
+
 SCENARIOS = {
     "loadaware": bench_loadaware,
     "numa": bench_numa,
@@ -895,6 +1022,7 @@ SCENARIOS = {
     "quota_tree": bench_quota_tree,
     "latency_stream": bench_latency_stream,
     "stream_pipelined": bench_stream_pipelined,
+    "recovery": bench_recovery,
 }
 
 

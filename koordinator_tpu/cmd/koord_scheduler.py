@@ -67,6 +67,20 @@ def build_parser() -> argparse.ArgumentParser:
             "plane ships deltas, solver answers nominations)"
         ),
     )
+    parser.add_argument(
+        "--journal-file",
+        default="",
+        metavar="PATH",
+        help=(
+            "durable write-ahead bind journal (HA failover): append one "
+            "JSONL record per commit intent/bind/forget to PATH so a "
+            "restart rebuilds acknowledged placements via journal replay "
+            "(runtime.recovery) instead of a cold resync; pairs with "
+            "--leader-elect + --lease-file for leader-elected "
+            "multi-process failover (epoch-FENCED commits additionally "
+            "need the library-level EpochFence/LeaderCoordinator wiring)"
+        ),
+    )
     return parser
 
 
@@ -203,6 +217,11 @@ def main(
                 "GPUs, or feed Device objects",
                 file=_sys.stderr,
             )
+    journal = None
+    if args.journal_file:
+        from ..core.journal import BindJournal, FileJournalStore
+
+        journal = BindJournal(FileJournalStore(args.journal_file))
     latency_mode = args.latency > 0
     sched = BatchScheduler(
         snap,
@@ -217,11 +236,25 @@ def main(
         numa=numa,
         devices=devices,
         mesh=mesh,
+        journal=journal,
     )
     # the rest of the scheduler's world view (pods/devices/quotas/gangs)
     # flows through the same informer hub that already feeds the snapshot
     hub.wire_scheduler(sched, include_snapshot=False)
     hub.start()
+    if journal is not None:
+        # restart recovery: replay acknowledged bindings the informer
+        # feed doesn't carry (assumed-but-unbound) before scheduling
+        from ..runtime.recovery import recover_scheduler
+
+        rep = recover_scheduler(sched, journal, hub=hub, verify=False)
+        if rep.replayed or rep.reconfirmed:
+            print(
+                f"koord-scheduler: journal recovery replayed="
+                f"{rep.replayed} reconfirmed={rep.reconfirmed} "
+                f"skipped={rep.skipped_missing_node}",
+                file=sys.stderr,
+            )
     pending = [p for p in pods if not p.spec.node_name]
 
     if latency_mode:

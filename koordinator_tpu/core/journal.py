@@ -1,0 +1,404 @@
+"""Durable write-ahead bind journal + leader fencing epochs (HA tentpole).
+
+The robustness PR made a *process* crash-safe within one commit (the
+transactional ``_ReserveJournal`` rolls a half-applied chunk back); this
+module makes the *scheduler role* crash-safe across processes:
+
+* :class:`BindJournal` — an append-only write-ahead log of commit
+  intents, acknowledged binds and forgets. The contract is **journal
+  before mutate**: a chunk whose intent record cannot be written is
+  rejected before any snapshot mutation, and a bind is *acknowledged*
+  only once its record is durably appended — so a takeover can rebuild
+  exactly the acknowledged world from the statehub resync plus a journal
+  replay (``runtime/recovery.py``), with zero lost acknowledged bindings
+  and zero duplicate placements.
+* :class:`EpochFence` — the monotonic fencing authority (the lease
+  record's epoch in a multi-process deployment; one shared object
+  in-process). Every leadership grant carries an epoch; the commit and
+  snapshot-channel boundaries check the caller's epoch against the
+  current grant, so a deposed leader's in-flight commit raises
+  :class:`StaleEpochError` instead of double-placing pods. The journal
+  itself enforces the same monotonicity at the storage boundary — a
+  write stamped with an epoch older than one already journaled is
+  refused, the classic fencing-token-on-shared-store discipline.
+
+Failure domain (ROADMAP rule): the named chaos point
+``journal.write_fail`` fires inside :meth:`BindJournal._append`; callers
+see :class:`JournalWriteError` and reject the chunk un-mutated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..chaos import NULL_INJECTOR
+
+
+class FencingError(RuntimeError):
+    """Base for leadership-fencing violations."""
+
+
+class StaleEpochError(FencingError):
+    """The caller's fencing epoch is no longer the current grant — its
+    leadership was superseded (or locally revoked) and the guarded
+    mutation must not proceed."""
+
+    def __init__(self, epoch: int, current: int, what: str = "epoch"):
+        super().__init__(
+            f"stale leadership {what}: held {epoch}, current {current}"
+        )
+        self.epoch = epoch
+        self.current = current
+
+
+class JournalWriteError(RuntimeError):
+    """A journal append failed (storage error or injected fault). The
+    guarded mutation must not proceed — journal before mutate."""
+
+
+class EpochFence:
+    """Thread-safe monotonic fencing authority.
+
+    ``advance()`` models a fresh leadership grant (the lease takeover
+    bumping the record's epoch); ``adopt(epoch)`` mirrors an externally
+    observed grant and refuses to move backwards; ``check(epoch)``
+    raises :class:`StaleEpochError` when the caller's grant is no longer
+    current (``epoch < 0`` is the locally-revoked sentinel a deposed
+    scheduler stamps on itself — it always fails the check).
+    """
+
+    def __init__(self, start: int = 0):
+        self._epoch = int(start)
+        self._lock = threading.Lock()
+
+    def advance(self) -> int:
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    def adopt(self, epoch: int) -> int:
+        with self._lock:
+            if epoch < self._epoch:
+                raise StaleEpochError(epoch, self._epoch, what="grant")
+            self._epoch = int(epoch)
+            return self._epoch
+
+    def current(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def check(self, epoch: int) -> None:
+        with self._lock:
+            if epoch < 0 or epoch != self._epoch:
+                raise StaleEpochError(epoch, self._epoch)
+
+
+# ---------------------------------------------------------------------------
+# Journal stores: same record API over an in-memory list (tests, sim) and
+# an append-only JSONL file (real durability across a process crash).
+# ---------------------------------------------------------------------------
+
+
+class MemoryJournalStore:
+    """Record list in memory — survives a *simulated* crash (the store
+    object outlives the scheduler it journals for), not a real one."""
+
+    def __init__(self) -> None:
+        self._records: List[dict] = []
+
+    def append(self, record: dict) -> None:
+        self._records.append(dict(record))
+
+    def load(self) -> List[dict]:
+        return [dict(r) for r in self._records]
+
+    def rewrite(self, records: Sequence[dict]) -> None:
+        self._records = [dict(r) for r in records]
+
+
+class FileJournalStore:
+    """Append-only JSON-lines file. Each record is one line, flushed on
+    append (``fsync=True`` additionally forces it to stable storage —
+    the real durability point; default off because per-record fsync
+    dominates commit latency and tests/benches exercise replay, not
+    media failure). ``load`` tolerates a torn final line: a crash mid-
+    append leaves a partial record, which is exactly an unacknowledged
+    write and is discarded."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._repair_torn_tail()
+        self._f = open(path, "a", encoding="utf-8")
+
+    def _repair_torn_tail(self) -> None:
+        """Truncate a partial final line left by a crash mid-append —
+        BEFORE the append handle opens. Without this the next append
+        would merge into the partial line, making one unparseable record
+        that load() stops at, silently discarding every post-restart
+        append behind it. The truncated bytes were never acknowledged."""
+        try:
+            with open(self.path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size == 0:
+                    return
+                f.seek(size - 1)
+                if f.read(1) == b"\n":
+                    return
+                f.seek(0)
+                data = f.read(size)
+                cut = data.rfind(b"\n") + 1  # 0 when no newline at all
+                f.truncate(cut)
+        except FileNotFoundError:
+            pass
+
+    def append(self, record: dict) -> None:
+        self._f.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+
+    def load(self) -> List[dict]:
+        out: List[dict] = []
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        # torn tail from a crash mid-append: everything
+                        # before it is intact, the partial write was
+                        # never acknowledged — stop here
+                        break
+        except FileNotFoundError:
+            pass
+        return out
+
+    def rewrite(self, records: Sequence[dict]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for r in records:
+                f.write(json.dumps(r, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# Replay view
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JournalReplay:
+    """What a takeover rebuilds from the log: the acknowledged live set
+    (binds minus forgets; an intent without a matching bind/abort —
+    a crash mid-commit — contributes nothing, because the dying
+    process's host mutations died with it)."""
+
+    #: uid -> bind entry dict (node/req/est/prod/nom/conf), last write wins
+    live: Dict[str, dict] = field(default_factory=dict)
+    epoch_high: int = 0
+    seq_high: int = 0
+    binds: int = 0
+    forgets: int = 0
+    intents: int = 0
+    aborts: int = 0
+    #: intents never closed by a bind/abort (crash-mid-commit windows)
+    open_intents: int = 0
+
+
+class BindJournal:
+    """Write-ahead bind journal over a pluggable store.
+
+    Record ops (one JSON object per record, ``seq`` strictly increasing):
+
+    ``intent``      — a chunk commit is about to mutate host state:
+                      ``planned`` carries the nominated (uid, node) pairs.
+    ``bind``        — the chunk's Reserve+Permit held: ``binds`` carries
+                      one entry per acknowledged pod with everything
+                      ``restore_assumed`` needs to re-install the charge.
+    ``abort``       — the chunk rolled back (the in-memory journal undid
+                      the mutations); the preceding intent is void.
+    ``forget``      — pods released (completion/eviction); replay drops
+                      them from the live set.
+    ``checkpoint``  — compaction marker carrying the full live set;
+                      replay restarts from it.
+
+    Every append is stamped with the writer's fencing epoch and refused
+    (:class:`StaleEpochError`) when an append from a NEWER epoch has
+    already landed — the journal is the fencing backstop at the storage
+    boundary even when the in-process fence was bypassed.
+    """
+
+    def __init__(
+        self,
+        store=None,
+        chaos=None,
+        writes_counter=None,
+        failures_counter=None,
+    ):
+        self.store = store if store is not None else MemoryJournalStore()
+        self.chaos = chaos or NULL_INJECTOR
+        #: optional ``journal_writes_total{op}`` / failure counters
+        self.writes_counter = writes_counter
+        self.failures_counter = failures_counter
+        self._lock = threading.Lock()
+        tail = self.store.load()
+        self._seq = max((r.get("seq", 0) for r in tail), default=0)
+        self._epoch_high = max((r.get("epoch", 0) for r in tail), default=0)
+
+    @property
+    def epoch_high(self) -> int:
+        with self._lock:
+            return self._epoch_high
+
+    # ---- append side ----
+
+    def _append(self, op: str, epoch: int, cycle: int, **fields) -> dict:
+        try:
+            if self.chaos.fire("journal.write_fail"):
+                raise JournalWriteError(
+                    f"injected journal write failure at op={op}"
+                )
+            with self._lock:
+                if epoch is None:
+                    # fence-exempt record (forgets): a release reflects
+                    # an apiserver-observed deletion, authoritative
+                    # regardless of who leads — stamp the current high
+                    epoch = self._epoch_high
+                if epoch < self._epoch_high:
+                    raise StaleEpochError(
+                        epoch, self._epoch_high, what="journal epoch"
+                    )
+                self._epoch_high = max(self._epoch_high, epoch)
+                self._seq += 1
+                rec = {
+                    "seq": self._seq,
+                    "epoch": int(epoch),
+                    "cycle": int(cycle),
+                    "op": op,
+                    **fields,
+                }
+                try:
+                    self.store.append(rec)
+                except OSError as exc:
+                    raise JournalWriteError(
+                        f"journal append failed: {exc!r}"
+                    ) from exc
+        except (JournalWriteError, StaleEpochError):
+            if self.failures_counter is not None:
+                self.failures_counter.inc()
+            raise
+        if self.writes_counter is not None:
+            self.writes_counter.labels(op=op).inc()
+        return rec
+
+    def append_intent(
+        self,
+        epoch: int,
+        cycle: int,
+        planned: Sequence[Tuple[str, str]],
+    ) -> dict:
+        return self._append(
+            "intent",
+            epoch,
+            cycle,
+            planned=[[uid, node] for uid, node in planned],
+        )
+
+    def append_bind(
+        self, epoch: int, cycle: int, entries: Sequence[dict]
+    ) -> dict:
+        """``entries``: per-pod dicts with keys ``uid``, ``node``,
+        ``req`` (list), ``est`` (list), ``prod`` (bool), ``nom``
+        (bind-nominal CPU milli), ``conf`` (confirmed flag)."""
+        return self._append(
+            "bind", epoch, cycle, binds=[dict(e) for e in entries]
+        )
+
+    def append_abort(self, epoch: int, cycle: int, reason: str = "") -> dict:
+        return self._append("abort", epoch, cycle, reason=reason)
+
+    def append_forget(
+        self, epoch: Optional[int], cycle: int, uids: Sequence[str]
+    ) -> dict:
+        """``epoch=None`` marks the record fence-exempt: forgets mirror
+        apiserver deletions, which a STANDBY must also journal (its
+        informers keep observing completions during a leaderless gap —
+        dropping them would let the next takeover's replay resurrect
+        dead pods' charges)."""
+        return self._append("forget", epoch, cycle, uids=list(uids))
+
+    # ---- replay / compaction ----
+
+    def replay(self) -> JournalReplay:
+        rep = JournalReplay()
+        open_intent = False
+        for rec in sorted(self.store.load(), key=lambda r: r.get("seq", 0)):
+            op = rec.get("op")
+            rep.epoch_high = max(rep.epoch_high, rec.get("epoch", 0))
+            rep.seq_high = max(rep.seq_high, rec.get("seq", 0))
+            if op == "checkpoint":
+                rep.live = {
+                    uid: dict(e) for uid, e in rec.get("live", {}).items()
+                }
+                open_intent = False
+            elif op == "intent":
+                if open_intent:
+                    rep.open_intents += 1
+                rep.intents += 1
+                open_intent = True
+            elif op == "bind":
+                rep.binds += 1
+                open_intent = False
+                for e in rec.get("binds", ()):
+                    rep.live[e["uid"]] = dict(e)
+            elif op == "abort":
+                rep.aborts += 1
+                open_intent = False
+            elif op == "forget":
+                rep.forgets += 1
+                for uid in rec.get("uids", ()):
+                    rep.live.pop(uid, None)
+        if open_intent:
+            rep.open_intents += 1
+        return rep
+
+    def compact(self, epoch: Optional[int] = None) -> JournalReplay:
+        """Collapse the log to one checkpoint carrying the current live
+        set (called after a successful recovery or on a maintenance
+        sweep so the log does not grow with cluster lifetime)."""
+        rep = self.replay()
+        with self._lock:
+            self._seq += 1
+            self.store.rewrite(
+                [
+                    {
+                        "seq": self._seq,
+                        "epoch": int(
+                            self._epoch_high if epoch is None else epoch
+                        ),
+                        "cycle": -1,
+                        "op": "checkpoint",
+                        "live": {u: dict(e) for u, e in rep.live.items()},
+                    }
+                ]
+            )
+        return rep
+
+    def records(self) -> List[dict]:
+        return self.store.load()

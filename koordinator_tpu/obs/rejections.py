@@ -62,6 +62,15 @@ class RejectReason(str, enum.Enum):
     #: a mid-commit failure rolled the chunk's Reserve journal back —
     #: every half-assumed pod was forgotten and retries next cycle
     COMMIT_ROLLED_BACK = "commit_rolled_back"
+    #: HA fencing (failover PR): the committing scheduler's leadership
+    #: epoch is no longer current — a deposed leader's in-flight commit
+    #: (including pipelined speculative dispatches) is rejected instead
+    #: of double-placing; the pods retry under the new leader
+    STALE_LEADER_EPOCH = "stale_leader_epoch"
+    #: the write-ahead bind journal could not append the chunk's intent/
+    #: bind record — journal-before-mutate means the chunk is rejected
+    #: un-mutated and retries once the journal recovers
+    JOURNAL_WRITE_FAILED = "journal_write_failed"
 
 
 @dataclass

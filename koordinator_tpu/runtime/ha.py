@@ -1,0 +1,115 @@
+"""Leadership state machine tying election, fencing, journal and
+recovery into one coordinator (HA failover PR).
+
+One :class:`LeaderCoordinator` per scheduler instance. ``tick()`` runs a
+single election protocol step (testable without threads or wall-clock
+sleeps — inject the elector's clock) and drives the transitions:
+
+* **takeover** — the lease is acquired under a fresh epoch; the shared
+  :class:`~..core.journal.EpochFence` adopts it (deposing every older
+  grant at the commit/channel boundaries), then
+  :func:`~.recovery.recover_scheduler` rebuilds the world from the
+  statehub resync + journal replay and only THEN grants the scheduler
+  its epoch — a half-recovered instance can never commit.
+* **loss** — the scheduler revokes its own epoch immediately (local
+  sentinel −1: every in-flight commit is fenced regardless of who holds
+  the new grant), then the pipeline drains for handoff: speculation
+  discarded, trailing commit flushed through the fencing check, state
+  surfaced on ``/healthz``.
+
+Named chaos point (ROADMAP rule): ``leader.lost`` — evaluated at the
+top of a leader's tick; firing force-releases the lease, so the same
+seed yields the same flap schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..chaos import NULL_INJECTOR
+from .recovery import RecoveryReport, recover_scheduler
+
+
+class LeaderCoordinator:
+    """Election steps + fenced grant/revoke for one scheduler instance."""
+
+    def __init__(
+        self,
+        sched,
+        elector,
+        fence,
+        journal,
+        hub=None,
+        pipeline=None,
+        verify_recovery: bool = True,
+        chaos=None,
+    ):
+        self.sched = sched
+        self.elector = elector
+        self.fence = fence
+        self.journal = journal
+        self.hub = hub
+        self.pipeline = pipeline
+        self.verify_recovery = verify_recovery
+        self.chaos = chaos or getattr(sched, "chaos", None) or NULL_INJECTOR
+        self.leading = False
+        #: report of the most recent takeover's recovery
+        self.last_recovery: Optional[RecoveryReport] = None
+        sched.extender.health.set("leader", True, "standby (no grant yet)")
+
+    # ---- transitions ----
+
+    def _on_takeover(self) -> None:
+        epoch = self.elector.current_epoch() or self.fence.advance()
+        # the shared fence mirrors the lease's epoch: adopting it is what
+        # deposes every older grant at the commit/channel boundaries
+        self.fence.adopt(epoch)
+        self.last_recovery = recover_scheduler(
+            self.sched,
+            self.journal,
+            hub=self.hub,
+            epoch=epoch,
+            verify=self.verify_recovery,
+        )
+        self.leading = True
+
+    def _on_loss(self, reason: str):
+        self.leading = False
+        self.sched.revoke_leadership(f"standby ({reason})")
+        drained = None
+        if self.pipeline is not None:
+            drained = self.pipeline.drain_for_handoff()
+        return drained
+
+    # ---- public surface ----
+
+    def tick(self) -> Tuple[bool, Optional[object]]:
+        """One election protocol step. Returns ``(is_leader,
+        drained_outcome)`` — ``drained_outcome`` is the pipeline's
+        handoff flush when leadership was lost this tick (its pods are
+        the new leader's to place), else None."""
+        drained = None
+        if self.leading and self.chaos.fire("leader.lost"):
+            # injected leadership loss: surrender the lease and step
+            # down THIS tick (the next tick may re-acquire — under a new
+            # epoch, through full recovery — or a contender takes over;
+            # either way the flap is a real grant boundary)
+            self.elector.release()
+            drained = self._on_loss("injected leadership loss")
+            return self.leading, drained
+        ok = self.elector.try_acquire_or_renew()
+        if self.leading and not ok:
+            # a leader's failed renew means the CAS lost: the record
+            # moved under us (taken over or released) — step down NOW;
+            # renewing later under the old epoch would be fenced anyway
+            drained = self._on_loss("lease renew lost")
+        elif ok and not self.leading:
+            self._on_takeover()
+        return self.leading, drained
+
+    def step_down(self):
+        """Voluntary handoff: release the lease and drain."""
+        if not self.leading:
+            return None
+        self.elector.release()
+        return self._on_loss("voluntary step-down")

@@ -66,7 +66,17 @@ class ChannelCallError(ChannelError):
     caller's policy decision, not the transport's."""
 
 
+class ChannelFenced(ChannelError):
+    """The server refused the call because the caller's leadership epoch
+    is stale (HA fencing at the snapshot-channel boundary) — NOT
+    retryable: the deposed leader must stand down, not re-send."""
+
+
 _RETRYABLE_ERRORS = (ChannelUnavailable, ChannelTimeout)
+
+#: metadata key carrying the caller's fencing epoch (the proto stays
+#: unchanged — fencing is transport-level, like an authz header)
+EPOCH_METADATA_KEY = "x-leader-epoch"
 
 
 def _map_rpc_error(call: str, exc: grpc.RpcError) -> ChannelError:
@@ -77,6 +87,8 @@ def _map_rpc_error(call: str, exc: grpc.RpcError) -> ChannelError:
         return ChannelTimeout(msg, code)
     if code == grpc.StatusCode.UNAVAILABLE:
         return ChannelUnavailable(msg, code)
+    if code == grpc.StatusCode.FAILED_PRECONDITION:
+        return ChannelFenced(msg, code)
     return ChannelCallError(msg, code)
 
 
@@ -115,6 +127,47 @@ class SolverService:
         #: control plane rejected and never reserved)
         self.assume_ttl = assume_ttl
         self._lock = threading.Lock()
+        #: highest leadership epoch observed over the channel (HA PR):
+        #: calls stamped with an OLDER epoch are refused
+        #: (FAILED_PRECONDITION → ChannelFenced client-side), so a
+        #: deposed leader's in-flight delta/nominate can never mutate or
+        #: read the solver's world after its successor has spoken.
+        #: Callers without the metadata (non-HA deployments) pass freely.
+        self.leader_epoch = 0
+
+    def _check_epoch(self, call: str, ctx) -> None:
+        """Adopt/enforce the caller's fencing epoch from gRPC metadata.
+        Must be called under ``self._lock`` so adopt-vs-refuse is atomic
+        with the guarded mutation."""
+        if ctx is None:
+            return
+        raw = None
+        try:
+            for k, v in ctx.invocation_metadata() or ():
+                if k == EPOCH_METADATA_KEY:
+                    raw = v
+                    break
+        except TypeError:
+            return
+        if raw is None:
+            return
+        try:
+            epoch = int(raw)
+        except (TypeError, ValueError):
+            # a PRESENT but unparseable epoch must not pass unfenced —
+            # the caller claims to be epoch-guarded, so an unprovable
+            # claim is rejected, not waved through
+            ctx.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"{call}: malformed {EPOCH_METADATA_KEY} {raw!r}",
+            )
+        if epoch < self.leader_epoch:
+            ctx.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{call}: stale leader epoch {epoch} "
+                f"(current {self.leader_epoch})",
+            )
+        self.leader_epoch = epoch
 
     # ---- rpc bodies ----
 
@@ -122,6 +175,7 @@ class SolverService:
         cfg = self.snapshot.config
         now = delta.now or time.time()
         with self._lock:
+            self._check_epoch("sync", _ctx)
             # Generation-gap detection (informer re-list analog): a delta
             # that is not exactly the next revision was dropped/reordered
             # in transit — applying it would silently diverge the solver's
@@ -232,6 +286,7 @@ class SolverService:
             )
         t0 = time.perf_counter()
         with self._lock:
+            self._check_epoch("nominate", _ctx)
             self.snapshot.expire_assumed(time.time(), self.assume_ttl)
             out = self.scheduler.schedule(pods)
             rev = self.revision
@@ -319,11 +374,20 @@ class SolverClient:
         retry: Optional[RetryPolicy] = None,
         chaos: Optional[FaultInjector] = None,
         retry_counter=None,
+        fence=None,
     ):
         self.timeout_s = timeout_s
         self.retry = retry
         self.chaos = chaos or NULL_INJECTOR
         self.retry_counter = retry_counter
+        #: HA fencing: optional EpochFence + the epoch this client's
+        #: leadership grant carries (set_epoch on takeover). When wired,
+        #: every call is (a) checked locally — a deposed leader's delta
+        #: never leaves the process — and (b) stamped into gRPC metadata
+        #: so the SERVER refuses stale writers even when the local fence
+        #: was bypassed (two independent layers, like journal fencing).
+        self.fence = fence
+        self.epoch: Optional[int] = None
         self._channel = grpc.insecure_channel(target)
         self._sync = self._channel.unary_unary(
             f"/{SERVICE_NAME}/Sync",
@@ -341,17 +405,31 @@ class SolverClient:
             response_deserializer=pb.SolverConfig.FromString,
         )
 
+    def set_epoch(self, epoch: Optional[int]) -> None:
+        """Adopt the leadership epoch this client's calls carry (None =
+        un-fenced, the non-HA default)."""
+        self.epoch = epoch
+
     def _call(self, name: str, stub, req):
         chaos = self.chaos
 
         def once():
+            if self.fence is not None and self.epoch is not None:
+                # local fencing: raises StaleEpochError when our grant
+                # was superseded — the delta never reaches the wire
+                self.fence.check(self.epoch)
             if chaos.fire(f"channel.{name}.drop"):
                 raise ChannelUnavailable(
                     f"{name}: injected RPC drop", None
                 )
             chaos.fire(f"channel.{name}.delay")
+            md = (
+                ((EPOCH_METADATA_KEY, str(self.epoch)),)
+                if self.epoch is not None
+                else None
+            )
             try:
-                return stub(req, timeout=self.timeout_s)
+                return stub(req, timeout=self.timeout_s, metadata=md)
             except grpc.RpcError as exc:
                 raise _map_rpc_error(name, exc) from exc
 

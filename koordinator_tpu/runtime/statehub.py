@@ -361,6 +361,18 @@ class ClusterStateHub:
         for inf in self.informers:
             inf.stop()
 
+    def detach_consumers(self) -> None:
+        """Simulated consumer-process death (HA failover PR): stop and
+        DROP every informer this hub wired — their watches die with the
+        process — while the trackers (the apiserver's world) survive, so
+        a recovering scheduler re-wires fresh informers and re-lists.
+        ``wait_synced`` afterwards sees only the new consumer's
+        informers; a stopped informer would otherwise wedge it."""
+        for inf in self.informers:
+            inf.stop()
+        self.informers = []
+        self._snapshot_node_informers.clear()
+
     def wait_synced(self, timeout: float = 10.0) -> bool:
         """Block until every informer observed its tracker's current rv
         (WaitForCacheSync analog)."""

@@ -25,6 +25,7 @@ import numpy as np
 from ..api import extension as ext
 from ..api.types import Pod
 from ..chaos import NULL_INJECTOR, FaultInjector
+from ..core.journal import JournalWriteError, StaleEpochError
 from ..core.snapshot import ClusterSnapshot, SnapshotConfig, bucket_size
 from ..obs import RejectReason, RejectStage, report_exception
 from ..ops import estimator
@@ -326,6 +327,8 @@ class BatchScheduler:
         fallback_repromote_after: int = 3,
         fetch_timeout_s: float = 30.0,
         intern_pods: bool = True,
+        journal=None,
+        fence=None,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -493,8 +496,53 @@ class BatchScheduler:
         self._cycle_commit_rolled_back = False
         self._cycle_fetch_deferred = False
         self._cycle_t0 = 0.0
+        self._cycle_journal_failed = False
+        #: HA layer (failover PR): write-ahead bind journal + leadership
+        #: fence. ``journal`` is a core.journal.BindJournal — every chunk
+        #: commit appends an intent record BEFORE mutating the snapshot
+        #: and a bind record before acknowledging; ``fence`` is the
+        #: EpochFence checked at the commit boundary so a deposed
+        #: leader's in-flight commit is rejected (STALE_LEADER_EPOCH)
+        #: instead of double-placing. ``_fence_epoch`` is the epoch of
+        #: the current grant (-1 = locally revoked).
+        self.bind_journal = journal
+        self.fence = fence
+        self._fence_epoch = 0
+        if journal is not None:
+            reg = self.extender.registry
+            if journal.writes_counter is None:
+                journal.writes_counter = reg.get("journal_writes_total")
+            if journal.failures_counter is None:
+                journal.failures_counter = reg.get(
+                    "journal_write_failures_total"
+                )
+            if journal.chaos is NULL_INJECTOR:
+                # journal.write_fail fires from the scheduler's injector
+                # unless the journal brought its own
+                journal.chaos = self.chaos
         self.extender.health.set("solver", True)
         self.extender.health.set("commit", True)
+
+    # ---- HA: leadership grant/revoke (driven by the LeaderCoordinator) ----
+
+    def grant_leadership(self, epoch: int) -> None:
+        """Adopt a fencing epoch: subsequent commits carry it and pass
+        the fence while it stays the current grant."""
+        self._fence_epoch = int(epoch)
+        reg = self.extender.registry
+        reg.get("leader_transitions_total").inc()
+        reg.get("leader_epoch").set(float(epoch))
+        self.extender.health.set("leader", True, f"leader epoch={epoch}")
+
+    def revoke_leadership(self, detail: str = "") -> None:
+        """Leadership lost: stamp the local revoked sentinel so every
+        in-flight commit fails the fence regardless of who (if anyone)
+        holds the new grant, and surface the standby state on /healthz."""
+        self._fence_epoch = -1
+        self.extender.registry.get("leader_epoch").set(-1.0)
+        self.extender.health.set(
+            "leader", True, detail or "standby (leadership revoked)"
+        )
 
     # ---- device lowering ----
 
@@ -969,6 +1017,7 @@ class BatchScheduler:
             self._cycle_solver_failed = False
             self._cycle_deadline_hit = False
             self._cycle_commit_rolled_back = False
+            self._cycle_journal_failed = False
             self._cycle_fetch_deferred = False
             self._cycle_used_spec = False
             self._cycle_reserve_rejected = False
@@ -1010,7 +1059,19 @@ class BatchScheduler:
         # fall through to the solver: gang members (Permit), and matched
         # pods whose NUMA/device/quota Reserve fails.
         reserved_bound: List[Tuple[Pod, str]] = []
-        if self.reservations is not None:
+        # HA fencing: the reservation fast path is a commit too (it
+        # assumes pods directly, bypassing _commit) — a deposed leader
+        # must not take it. The check here is fence-only (no chaos
+        # evaluation: ``leader.stale_commit`` belongs to the _commit
+        # boundary); fenced pods fall through to the solver path, whose
+        # _commit rejects them with STALE_LEADER_EPOCH.
+        fast_path_fenced = False
+        if self.reservations is not None and self.fence is not None:
+            try:
+                self.fence.check(self._fence_epoch)
+            except StaleEpochError:
+                fast_path_fenced = True
+        if self.reservations is not None and not fast_path_fenced:
             from .plugins.coscheduling import gang_key_of
             from .plugins.elasticquota import (
                 is_pod_non_preemptible as is_nonpre,
@@ -1103,6 +1164,32 @@ class BatchScheduler:
                 pod.meta.annotations.update(patch)
                 reserved_bound.append((pod, node))
             pending = remaining_pending
+            if self.bind_journal is not None and reserved_bound:
+                # reservation fast-path binds are acknowledged the moment
+                # this cycle returns them, so they must reach the journal
+                # too. Unlike _commit this records post-assume (the holds
+                # span reservation ghost state the Reserve journal does
+                # not model); a refused write degrades loudly and the
+                # immediate bind publish + statehub re-list is the
+                # recovery backstop for these entries.
+                try:
+                    self.bind_journal.append_bind(
+                        self._fence_epoch,
+                        cid,
+                        self._journal_bind_entries(reserved_bound),
+                    )
+                except (JournalWriteError, StaleEpochError) as exc:
+                    report_exception(
+                        "scheduler.journal.reservation",
+                        exc,
+                        registry=self.extender.registry,
+                    )
+                    self._cycle_journal_failed = True
+                    self.extender.health.set(
+                        "commit",
+                        False,
+                        f"reservation bind journal refused: {exc!r}",
+                    )
         else:
             affinity_unsched = []
 
@@ -1861,7 +1948,7 @@ class BatchScheduler:
                         self._degrade_clean = 0
                     if self._bucket_degrade == 0:
                         health.set("cycle_deadline", True)
-        if not self._cycle_commit_rolled_back:
+        if not (self._cycle_commit_rolled_back or self._cycle_journal_failed):
             health.set("commit", True)
 
     def node_allowed(self, pod: Pod, node_name: str) -> bool:
@@ -1901,7 +1988,26 @@ class BatchScheduler:
         uid = victim.meta.uid
         node = self._bound_nodes.pop(uid, None)
         self._bound_pods.pop(uid, None)
+        was_assumed = self.snapshot.is_assumed(uid)
         self.snapshot.forget_pod(uid)
+        if self.bind_journal is not None and (was_assumed or node is not None):
+            # journal the release so a replay does not resurrect the
+            # pod's charge. Fence-EXEMPT (epoch=None): deletions are
+            # apiserver-authoritative and a standby's informers keep
+            # observing them during a leaderless gap. Best-effort: a
+            # refused write cannot block the delete, but is visible.
+            try:
+                self.bind_journal.append_forget(
+                    None,
+                    self.extender.current_cycle_id,
+                    [uid],
+                )
+            except (JournalWriteError, StaleEpochError) as exc:
+                report_exception(
+                    "scheduler.journal.forget",
+                    exc,
+                    registry=self.extender.registry,
+                )
         leaf = quota_name_of(victim)
         if leaf is not None:
             self.quotas.unassign_pod(leaf, victim)
@@ -2878,6 +2984,75 @@ class BatchScheduler:
 
         return estimate_pod(self.snapshot.config, pod, self._scales)
 
+    # ---- HA: commit-boundary fencing + write-ahead journal helpers ----
+
+    def _fence_stale(self) -> Optional[str]:
+        """None when this scheduler's leadership grant is current (or no
+        fence is wired); otherwise a human-readable staleness detail.
+        The ``leader.stale_commit`` chaos point deterministically forces
+        the stale verdict for tests/soak."""
+        if self.chaos.fire("leader.stale_commit"):
+            return "injected"
+        if self.fence is None:
+            return None
+        try:
+            self.fence.check(self._fence_epoch)
+        except StaleEpochError as exc:
+            return str(exc)
+        return None
+
+    def _journal_bind_entries(
+        self, bound: Sequence[Tuple[Pod, str]]
+    ) -> List[dict]:
+        """Serialize the EXACT charge each bound pod holds in the
+        snapshot (post-amplification request, estimate, prod band,
+        bind-nominal CPU) so a replay re-installs it bit-identically via
+        ``restore_assumed``."""
+        from .plugins.elasticquota import quota_name_of
+
+        entries: List[dict] = []
+        assumed = self.snapshot._assumed
+        for pod, node in bound:
+            ap = assumed.get(pod.meta.uid)
+            if ap is None:  # defensive: permit raced a forget
+                continue
+            entries.append(
+                {
+                    "uid": pod.meta.uid,
+                    "node": node,
+                    "req": [float(x) for x in ap.request],
+                    "est": [float(x) for x in ap.estimate],
+                    "prod": bool(ap.is_prod),
+                    "nom": float(ap.bind_nominal_cpu),
+                    "conf": bool(ap.confirmed),
+                    # leaf quota (None = unlabeled): recovery re-charges
+                    # the quota chain for replayed entries without
+                    # needing the pod object back
+                    "quota": quota_name_of(pod),
+                }
+            )
+        return entries
+
+    def _reject_chunk_journal(
+        self, chunk: Sequence[Pod], exc: BaseException
+    ) -> Tuple[List[Tuple[Pod, str]], List[Pod]]:
+        """A journal append was refused before any mutation: reject the
+        chunk (pods retry next cycle) and surface the failure."""
+        reg = self.extender.registry
+        report_exception("scheduler.journal", exc, registry=reg)
+        self._cycle_journal_failed = True
+        self._cycle_reserve_rejected = True
+        self.extender.health.set(
+            "commit", False, f"journal write refused: {exc!r}"
+        )
+        for pod in chunk:
+            self._reserve_reject[pod.meta.uid] = (
+                RejectStage.RESERVE,
+                "journal",
+                RejectReason.JOURNAL_WRITE_FAILED,
+            )
+        return [], list(chunk)
+
     def _commit(
         self,
         chunk: Sequence[Pod],
@@ -2924,6 +3099,54 @@ class BatchScheduler:
             check_rows = rows.req.copy()
             check_rows[:n_chunk, cpu_dim] *= factor
 
+        # HA fencing (failover PR): a deposed leader's in-flight commit —
+        # including a CyclePipeline trailing commit whose solve was
+        # dispatched before leadership was lost — must be REJECTED here,
+        # at the last host boundary before the snapshot mutates, not
+        # double-placed. The ``leader.stale_commit`` chaos point forces
+        # the stale verdict deterministically.
+        fence_detail = self._fence_stale()
+        if fence_detail is not None:
+            reg = self.extender.registry
+            reg.get("leader_fenced_commits_total").inc()
+            report_exception(
+                "scheduler.commit.fenced",
+                StaleEpochError(self._fence_epoch, -1)
+                if fence_detail == "injected"
+                else RuntimeError(fence_detail),
+                registry=reg,
+            )
+            self.extender.health.set(
+                "leader",
+                True,
+                f"commit fenced (stale epoch {self._fence_epoch}): "
+                f"{fence_detail}",
+            )
+            self._cycle_reserve_rejected = True
+            for pod in chunk:
+                self._reserve_reject[pod.meta.uid] = (
+                    RejectStage.RESERVE,
+                    "leaderfence",
+                    RejectReason.STALE_LEADER_EPOCH,
+                )
+            return [], list(chunk)
+        # write-ahead intent: journal BEFORE mutate. A chunk whose intent
+        # cannot be durably recorded is rejected un-mutated (its pods
+        # retry), so journal replay after a crash can never miss a
+        # mutation it should have known about.
+        cid = self.extender.current_cycle_id
+        jnl = self.bind_journal
+        if jnl is not None:
+            n_chunk_j = len(chunk)
+            planned = [
+                (chunk[i].meta.uid, self.snapshot.node_name(int(a)))
+                for i, a in enumerate(assignment[:n_chunk_j])
+                if a >= 0
+            ]
+            try:
+                jnl.append_intent(self._fence_epoch, cid, planned)
+            except (JournalWriteError, StaleEpochError) as exc:
+                return self._reject_chunk_journal(chunk, exc)
         # transactional Reserve: every mutation inside the try below is
         # journaled, so a failure anywhere between assume and Permit
         # (the classic crash-mid-commit window, injected via
@@ -2965,6 +3188,16 @@ class BatchScheduler:
             else:
                 bound = [(p, n) for p, n in results if n is not None]
                 unsched = [p for p, n in results if n is None]
+            # acknowledge: the bind record IS the durable acknowledgement
+            # — a failure here (storage or injected) raises into the
+            # rollback below, so a binding is never acked without its
+            # journal record and never journaled without its charge.
+            if jnl is not None and bound:
+                jnl.append_bind(
+                    self._fence_epoch,
+                    cid,
+                    self._journal_bind_entries(bound),
+                )
         except Exception as exc:  # noqa: BLE001 — journal rollback
             journal.rollback(self)
             reg = self.extender.registry
@@ -2974,6 +3207,15 @@ class BatchScheduler:
             self.extender.health.set(
                 "commit", False, f"chunk rolled back: {exc!r}"
             )
+            if jnl is not None:
+                # void the intent so replay treats the chunk as never
+                # applied (which, after the rollback above, it wasn't).
+                # Best-effort: a failed abort write leaves an open intent,
+                # which replay ALSO treats as not-applied.
+                try:
+                    jnl.append_abort(self._fence_epoch, cid, repr(exc))
+                except (JournalWriteError, StaleEpochError):
+                    pass
             for pod in chunk:
                 self._reserve_reject[pod.meta.uid] = (
                     RejectStage.RESERVE,

@@ -151,6 +151,37 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "overlapped pipeline stages in flight at the last pump return "
         "(0 = idle, 1 = solve in flight, 2 = solve + trailing commit)",
     )
+    # HA PR: fenced leader failover + write-ahead bind journal
+    reg.counter(
+        "leader_fenced_commits_total",
+        "chunk commits rejected by the leadership fence (a deposed "
+        "leader's in-flight commit, or an injected stale epoch)",
+    )
+    reg.counter(
+        "leader_transitions_total",
+        "leadership grants observed by this scheduler (takeovers and "
+        "re-elections; renews do not count)",
+    )
+    reg.gauge(
+        "leader_epoch",
+        "fencing epoch of the current leadership grant "
+        "(-1 = revoked/standby)",
+    )
+    reg.counter(
+        "journal_writes_total",
+        "write-ahead bind-journal records appended, by op",
+        labels=("op",),
+    )
+    reg.counter(
+        "journal_write_failures_total",
+        "bind-journal appends refused (storage failure, injected "
+        "journal.write_fail, or a stale-epoch write)",
+    )
+    reg.counter(
+        "recovery_replayed_total",
+        "assumed/bound charges re-installed from the bind journal on "
+        "warm-standby takeover or crash restart",
+    )
     ensure_exceptions_counter(reg)
     return reg
 

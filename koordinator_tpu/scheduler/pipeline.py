@@ -275,6 +275,35 @@ class CyclePipeline:
         outcome; None when nothing was in flight."""
         return self.feed([])
 
+    def drain_for_handoff(self) -> Optional[ScheduleOutcome]:
+        """Leadership loss mid-pipeline (HA failover PR): the in-flight
+        speculative solve was dispatched under an epoch that no longer
+        holds — DISCARD it (counted in ``pipeline_speculation_total
+        {outcome="discarded"}``), then flush the trailing commit so it
+        runs through the commit-boundary fencing check: with the grant
+        revoked every chunk is rejected with STALE_LEADER_EPOCH and the
+        batch's pods surface as unschedulable for the new leader to
+        place. The /healthz ``pipeline`` row carries the handoff state
+        while the drain runs."""
+        sched = self.sched
+        health = sched.extender.health
+        if self._inflight is None:
+            return None
+        health.set("pipeline", False, "leadership handoff: draining")
+        batch, spec, span = self._inflight
+        if spec is not None:
+            sched.extender.registry.get(
+                "pipeline_speculation_total"
+            ).labels(outcome="discarded").inc()
+            if span is not None:
+                span.__exit__(None, None, None)
+            self._inflight = (batch, None, None)
+        try:
+            out = self.flush()
+        finally:
+            health.set("pipeline", True, "handoff drained")
+        return out
+
     def feed(self, batch: Sequence[Pod]) -> Optional[ScheduleOutcome]:
         sched = self.sched
         reg = sched.extender.registry

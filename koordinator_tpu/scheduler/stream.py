@@ -155,6 +155,30 @@ class StreamScheduler:
                 results.append((pod, None, t_done - t_arr))
         return results
 
+    def drain_for_handoff(self) -> List[Tuple[Pod, Optional[str], float]]:
+        """Leadership loss: discard pipeline speculation and flush the
+        trailing commit through the fencing check (see
+        :meth:`CyclePipeline.drain_for_handoff`); queued AND fence-
+        rejected pods stay queued for the next leader WITHOUT a retry
+        charge — a fencing rejection is not a scheduling verdict, so it
+        must never burn the pod's ``max_retries`` budget (repeated flaps
+        would otherwise fail pods that were never genuinely evaluated).
+        Serial mode has nothing in flight — returns []."""
+        if self._pipe is None:
+            return []
+        out = self._pipe.drain_for_handoff()
+        if out is None:
+            return []
+        t_done = _time.perf_counter()
+        results: List[Tuple[Pod, Optional[str], float]] = []
+        for pod, node in out.bound:  # fence still held: a real decision
+            t_arr, _tries = self._inflight_meta.pop(pod.meta.uid)
+            results.append((pod, node, t_done - t_arr))
+        for pod in out.unschedulable:
+            t_arr, tries = self._inflight_meta.pop(pod.meta.uid)
+            self._queue.append((pod, t_arr, tries))
+        return results
+
     def flush(self) -> List[Tuple[Pod, Optional[str], float]]:
         """Drain everything: pump until the queue is empty, then complete
         the pipeline's in-flight cycle(s). Retried pods cycle back through

@@ -555,30 +555,13 @@ def run_loop(
 
 def assert_resident_state_converged(sched) -> None:
     """The device-resident NodeState must be BIT-EXACT against a
-    from-scratch host lowering — after rollbacks, resyncs and fallback
-    cycles, a missed dirty mark anywhere shows up here as a stale row
-    (same contract as ``tests/test_resident_state.py``)."""
-    import numpy as np
+    from-scratch host lowering — after rollbacks, resyncs, fallback
+    cycles and HA takeovers, a missed dirty mark anywhere shows up here
+    as a stale row (same contract as ``tests/test_resident_state.py``;
+    the implementation lives with the recovery path that depends on it)."""
+    from koordinator_tpu.runtime.recovery import assert_resident_bitexact
 
-    snap = sched.snapshot
-    na = snap.nodes
-    ns = sched.node_state()   # refreshes the resident state (dirty scatter)
-    est = np.maximum(na.usage_agg, na.usage_avg) + na.assigned_pending
-    sched_rows = na.schedulable
-    if (
-        sched.args.filter_expired_node_metrics
-        and not sched.args.enable_schedule_when_node_metrics_expired
-    ):
-        sched_rows = sched_rows & (na.metric_fresh | ~na.has_metric)
-    for got, want in (
-        (ns.allocatable, na.allocatable),
-        (ns.requested, na.requested),
-        (ns.estimated_used, est),
-        (ns.prod_used, na.prod_usage + na.assigned_pending_prod),
-        (ns.metric_fresh, na.metric_fresh),
-        (ns.schedulable, sched_rows),
-    ):
-        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert_resident_bitexact(sched)
 
 
 def run_chaos_soak(
@@ -589,6 +572,7 @@ def run_chaos_soak(
     drain_limit: int = 60,
     use_channel: bool = True,
     verbose: bool = False,
+    ha: bool = False,
 ) -> dict:
     """Longrun chaos soak: hundreds of scheduling cycles under a seeded
     random fault schedule, asserting the failure-domain invariants the
@@ -613,6 +597,22 @@ def run_chaos_soak(
     PR 4) — prepare-worker stalls/deaths (``pipeline.worker_stall``),
     which must degrade the cycle to the serial path and recover, never
     wedge the drain.
+
+    ``ha=True`` (failover PR) adds the high-availability failure domain
+    on top, with its events drawn from a THIRD seeded stream so every
+    historical schedule stays bit-identical: scheduling runs under a
+    :class:`~koordinator_tpu.runtime.ha.LeaderCoordinator` (lease
+    election + epoch fence + write-ahead bind journal), ``leader.lost``
+    flaps force mid-pipeline handoffs (speculation discarded, trailing
+    commit fenced), and exactly one ``scheduler.crash_restart`` — armed
+    together with a second mid-commit ``commit.crash`` — kills the
+    scheduler process outright: snapshot, device-resident state, quota
+    ledgers and in-flight pipeline all die; a fresh instance re-wires
+    the statehub, waits out the dead leader's lease, and takes over
+    through journal replay with per-takeover bit-exact resident-state
+    verification. Additional HA invariants: every journal-acknowledged
+    binding survives the crash (zero lost), no pod is ever placed twice
+    across incarnations, and the leaderless gap only defers.
     """
     import random as _random
 
@@ -650,6 +650,8 @@ def run_chaos_soak(
     # schedule shipped: drawing them from `rng` would shift every
     # downstream draw and silently re-roll the whole historical schedule
     rng_pipe = _random.Random(seed ^ 0x9E3779B9)
+    # third stream for the HA failure domain (failover PR), same rule
+    rng_ha = _random.Random(seed ^ 0x51F15EED)
 
     chaos = FaultInjector(seed=seed)
     snap = ClusterSnapshot()
@@ -665,37 +667,59 @@ def run_chaos_soak(
         ext.RES_CPU: q_pods * POD_CPU,
         ext.RES_MEMORY: q_pods * POD_MEM,
     }
+    quota_min = {ext.RES_CPU: 2 * POD_CPU, ext.RES_MEMORY: 2 * POD_MEM}
     gqm.upsert_quota(
         ElasticQuota(
             meta=ObjectMeta(name="soak-team"),
-            min={ext.RES_CPU: 2 * POD_CPU, ext.RES_MEMORY: 2 * POD_MEM},
+            min=dict(quota_min),
             max=dict(quota_max),
         )
     )
-    sched = BatchScheduler(
-        snap,
-        LoadAwareArgs(usage_thresholds={}),
-        quotas=gqm,
-        batch_bucket=16,
-        chaos=chaos,
-        cycle_deadline_s=0.6,
-        fallback_repromote_after=3,
-        fetch_timeout_s=2.0,
-    )
-    sched.extender.monitor.stop_background()
-    reg = sched.extender.registry
-    chaos.bind_counter(reg.get("fault_injected_total"))
     # scheduling flows through the cross-cycle pipeline: decisions lag
     # one cycle (solve in flight while the previous commit trails), the
     # prepare worker is a live failure domain, and every invariant below
     # must keep holding through stalls and degradations
     from koordinator_tpu.scheduler.pipeline import CyclePipeline
 
-    # generous prepare deadline: a chaos-KILLED worker is detected
-    # promptly via thread death (collect returns early), so the timeout
-    # only bounds a genuinely slow prepare — a tight value makes the
-    # stall/health accounting flake under host CPU contention
-    pipe = CyclePipeline(sched, prepare_timeout_s=10.0)
+    # HA primitives (failover PR): the fence and journal STORE outlive
+    # any one scheduler incarnation — they are the durable substrate the
+    # crash-restart leg rebuilds from
+    fence = journal_store = None
+    if ha:
+        from koordinator_tpu.core.journal import (
+            BindJournal,
+            EpochFence,
+            MemoryJournalStore,
+        )
+
+        fence = EpochFence()
+        journal_store = MemoryJournalStore()
+
+    def _make_instance(snapshot, quotas):
+        """One scheduler 'process': BatchScheduler + CyclePipeline.
+        Called once at start and again after every crash-restart."""
+        s = BatchScheduler(
+            snapshot,
+            LoadAwareArgs(usage_thresholds={}),
+            quotas=quotas,
+            batch_bucket=16,
+            chaos=chaos,
+            cycle_deadline_s=0.6,
+            fallback_repromote_after=3,
+            fetch_timeout_s=2.0,
+            journal=BindJournal(journal_store) if ha else None,
+            fence=fence,
+        )
+        s.extender.monitor.stop_background()
+        r = s.extender.registry
+        chaos.bind_counter(r.get("fault_injected_total"))
+        # generous prepare deadline: a chaos-KILLED worker is detected
+        # promptly via thread death (collect returns early), so the
+        # timeout only bounds a genuinely slow prepare — a tight value
+        # makes the stall/health accounting flake under host contention
+        return s, CyclePipeline(s, prepare_timeout_s=10.0), r
+
+    sched, pipe, reg = _make_instance(snap, gqm)
 
     hub = ClusterStateHub(
         chaos=chaos, health=sched.extender.health, error_registry=reg
@@ -780,6 +804,10 @@ def run_chaos_soak(
         "resyncs": 0,
         "deferred_cycles": 0,
         "faults": {},
+        "takeovers": 0,
+        "crash_restarts": 0,
+        "recovered_bindings": 0,
+        "cycles_without_leader": 0,
     }
     placed: dict = {}        # uid -> node, forever (duplicate guard)
     live: list = []          # (pod, node, done_cycle)
@@ -787,6 +815,84 @@ def run_chaos_soak(
     pod_seq = 0
     crash_cycle = max(2, cycles // 3)
     deadline_cycle = max(3, cycles // 2)
+    # HA leg (failover PR): one scheduled kill-restart well after the
+    # other fault domains have fired, leader flaps from the rng_ha stream
+    restart_cycle = max(6, (3 * cycles) // 5) if ha else None
+
+    # ---- HA coordinator: lease election + epoch fence + recovery ----
+    coord = None
+    incarnation = 0
+    inflight_fed: list = []  # the batch currently inside the pipeline
+    lost_pods: list = []     # decided-or-inflight pods orphaned by a crash
+    recovered_sync: list = []  # journal-recovered binds awaiting sidecar sync
+    if ha:
+        from koordinator_tpu.runtime.ha import LeaderCoordinator
+        from koordinator_tpu.utils.leaderelection import (
+            InMemoryLeaseLock,
+            LeaderElector,
+        )
+
+        lease_lock = InMemoryLeaseLock()
+        sim_cycle = [0]
+
+        def _lease_now() -> float:
+            return float(sim_cycle[0])
+
+        def _make_coordinator():
+            # a fresh identity per incarnation: the dead process cannot
+            # renew, so the new one waits out the old lease (a real
+            # failover gap of ~lease_duration cycles) before taking over
+            elector = LeaderElector(
+                lease_lock,
+                f"soak-gen{incarnation}",
+                lease_duration=3.0,
+                renew_deadline=2.0,
+                retry_period=0.5,
+                now_fn=_lease_now,
+                sleep_fn=lambda _dt: None,
+            )
+            return LeaderCoordinator(
+                sched,
+                elector,
+                fence,
+                sched.bind_journal,
+                hub=hub,
+                pipeline=pipe,
+                chaos=chaos,
+            )
+
+        coord = _make_coordinator()
+
+    def _crash_restart(orphans):
+        """Kill the scheduler process: snapshot, device-resident state,
+        quota ledgers, pipeline and watches all die; only the statehub
+        (apiserver), lease lock, fence and journal store survive. A
+        fresh incarnation re-wires and will take over once the dead
+        leader's lease expires."""
+        nonlocal snap, gqm, sched, pipe, reg, coord, q_idx
+        nonlocal incarnation, inflight_fed, lost_pods
+        stats["crash_restarts"] += 1
+        pipe.close()   # resource hygiene only — all state is discarded
+        hub.detach_consumers()
+        lost_pods = [p for p in orphans if p.meta.uid not in placed]
+        inflight_fed = []
+        incarnation += 1
+        snap = ClusterSnapshot()
+        gqm = GroupQuotaManager(snap.config, enable_preemption=False)
+        gqm.upsert_quota(
+            ElasticQuota(
+                meta=ObjectMeta(name="soak-team"),
+                min=dict(quota_min),
+                max=dict(quota_max),
+            )
+        )
+        sched, pipe, reg = _make_instance(snap, gqm)
+        q_idx = gqm.index_of("soak-team")
+        hub.health = sched.extender.health
+        hub.error_registry = reg
+        hub.wire_scheduler(sched)
+        hub.start()
+        coord = _make_coordinator()
 
     def _sync_cycle_delta(new_bound, forgotten):
         """Mirror this cycle's bindings/completions to the sidecar; a
@@ -851,8 +957,16 @@ def run_chaos_soak(
                 chaos.arm("solver.nan_rows", times=1)             # quarantine
             if rng_pipe.random() < 0.08:
                 chaos.arm("pipeline.worker_stall", times=1)       # serial degrade
+            if ha and rng_ha.random() < 0.05:
+                chaos.arm("leader.lost", times=1)                 # leader flap
             if cycle == crash_cycle:
                 chaos.arm("commit.crash", error=RuntimeError, times=1)
+            if ha and cycle == restart_cycle:
+                # mid-commit crash-restart: this cycle's trailing commit
+                # crashes (journal abort) AND the process dies right
+                # after the commit stage — the lost-ack window
+                chaos.arm("commit.crash", error=RuntimeError, times=1)
+                chaos.arm("scheduler.crash_restart", times=1)
             surge = 0
             if cycle == deadline_cycle:
                 # solve-latency spike + a surge so the cycle spans
@@ -860,6 +974,13 @@ def run_chaos_soak(
                 # tail instead of wedging
                 chaos.arm("solver.dispatch", latency_s=1.0, times=1)
                 surge = 3 * sched.batch_bucket
+            if ha and restart_cycle is not None and cycle == restart_cycle - 1:
+                # multi-chunk batch for the crash cycle's trailing
+                # commit: the armed commit.crash rolls ONE chunk back
+                # (mid-commit abort) while later chunks COMMIT — their
+                # journaled-but-never-acknowledged binds are exactly
+                # what the takeover must recover, not re-place
+                surge += 2 * sched.batch_bucket
             for _ in range(rng.randint(1, max_arrivals) + surge):
                 pod_seq += 1
                 labels = {}
@@ -881,17 +1002,97 @@ def run_chaos_soak(
                 )
             stats["arrived"] += len(arriving)
         pending.extend(arriving)
-        if not pending and not pipe.inflight and cycle >= cycles:
+
+        # ---- HA: election step + crash-orphan reconciliation ----
+        leading = True
+        if coord is not None:
+            sim_cycle[0] = cycle
+            was_leading = coord.leading
+            leading, drained = coord.tick()
+            if leading and not was_leading:
+                stats["takeovers"] += 1
+                if client is not None:
+                    client.set_epoch(fence.current())
+            if drained is not None:
+                # mid-pipeline handoff flush: with the grant revoked the
+                # fence rejects every chunk, so the in-flight batch comes
+                # back unschedulable for the next leader (bound handled
+                # defensively — possible only if the fence still held)
+                for pod, node in drained.bound:
+                    assert pod.meta.uid not in placed, pod.meta.name
+                    placed[pod.meta.uid] = node
+                    pod.spec.node_name = node
+                    hub.publish(hub.pods, pod)
+                    live.append((pod, node, cycle + LIFETIME))
+                    recovered_sync.append((pod, node))
+                    stats["placed"] += 1
+                pending.extend(drained.unschedulable)
+                inflight_fed = []
+            if leading and lost_pods:
+                # reconcile the crash's orphans against the journal:
+                # an ACKNOWLEDGED (journaled) binding is recovered —
+                # never re-placed — everything else re-enters the backlog
+                rec = coord.last_recovery
+                bindings = rec.bindings if rec is not None else {}
+                for pod in lost_pods:
+                    node = bindings.get(pod.meta.uid)
+                    if node is not None and pod.meta.uid not in placed:
+                        placed[pod.meta.uid] = node
+                        pod.spec.node_name = node
+                        hub.publish(hub.pods, pod)
+                        live.append((pod, node, cycle + LIFETIME))
+                        recovered_sync.append((pod, node))
+                        stats["placed"] += 1
+                        stats["recovered_bindings"] += 1
+                    elif pod.meta.uid not in placed:
+                        pending.append(pod)
+                lost_pods = []
+
+        if (
+            not pending
+            and not pipe.inflight
+            and not lost_pods
+            and cycle >= cycles
+        ):
             break
 
         # pipelined feed: this batch's solve goes in flight, the
         # PREVIOUS batch's trailing commit lands — its outcome is what
         # the bookkeeping below sees (one-cycle lag; invariants are
         # lag-agnostic: they compare live accounting, not batch identity)
-        out = pipe.feed(pending)
-        pending = []
-        if out is None:
+        fed_this_cycle = False
+        if coord is not None and not leading:
+            # leaderless gap (waiting out the dead leader's lease, or a
+            # flap mid-recovery): no scheduling authority — the backlog
+            # carries over untouched
+            stats["cycles_without_leader"] += 1
+            out = ScheduleOutcome(bound=[], unschedulable=list(pending))
+            pending = []
+        else:
+            fed = list(pending)
+            pending = []
+            out = pipe.feed(fed)
+            inflight_fed = fed
+            fed_this_cycle = True
+            if out is None:
+                out = ScheduleOutcome(bound=[], unschedulable=[])
+        if (
+            coord is not None
+            and fed_this_cycle
+            and chaos.fire("scheduler.crash_restart")
+        ):
+            # the process dies AFTER the trailing commit journaled its
+            # binds but BEFORE the bind API writes go out: the driver
+            # never observes `out` (decided-but-unacknowledged), and the
+            # freshly fed batch dies in flight — both sets become the
+            # takeover's reconciliation problem
+            orphans = (
+                [p for p, _n in out.bound]
+                + list(out.unschedulable)
+                + list(inflight_fed)
+            )
             out = ScheduleOutcome(bound=[], unschedulable=[])
+            _crash_restart(orphans)
         new_bound = []
         for pod, node in out.bound:
             # INVARIANT: a pod binds exactly once, ever
@@ -905,7 +1106,7 @@ def run_chaos_soak(
             live.append((pod, node, cycle + LIFETIME))
             new_bound.append((pod, node))
         stats["placed"] += len(new_bound)
-        if sched._cycle_deadline_hit:
+        if fed_this_cycle and sched._cycle_deadline_hit:
             stats["deferred_cycles"] += 1
         pending = list(out.unschedulable)
 
@@ -922,6 +1123,11 @@ def run_chaos_soak(
         live = still
         assert hub.wait_synced()
 
+        if recovered_sync:
+            # journal-recovered / handoff-drained binds reach the sidecar
+            # with the next delta, like any other bind write
+            new_bound = recovered_sync + new_bound
+            recovered_sync = []
         _sync_cycle_delta(new_bound, forgotten)
 
         # ---- per-cycle invariants ----
@@ -990,6 +1196,26 @@ def run_chaos_soak(
         client.close()
         server.stop(grace=None)
     hub.stop()
+    if coord is not None:
+        from koordinator_tpu.core.journal import BindJournal as _BJ
+
+        # zero lost acknowledged bindings: every journal-live bind (acked
+        # binds minus forgets, across ALL incarnations) must have landed
+        # in the driver's placed ledger exactly once
+        ha_rep = _BJ(journal_store).replay()
+        lost_acked = [u for u in ha_rep.live if u not in placed]
+        assert not lost_acked, (
+            f"{len(lost_acked)} journal-acknowledged bindings lost "
+            f"across takeovers"
+        )
+        if coord.leading:
+            assert sched._fence_epoch == fence.current() > 0
+        stats["leader_epoch_final"] = fence.current()
+        stats["journal_records"] = len(journal_store.load())
+        stats["journal_open_intents"] = ha_rep.open_intents
+        stats["fenced_commits_total"] = reg.get(
+            "leader_fenced_commits_total"
+        ).value()
     stats["fallback_level_final"] = sched._fallback_level
     stats["health_ok"] = sched.extender.health.ok()
     stats["metrics"] = {

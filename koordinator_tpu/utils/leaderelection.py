@@ -28,16 +28,28 @@ RETRY_PERIOD_S = 2.0
 
 @dataclasses.dataclass
 class LeaseRecord:
-    """The contended record (client-go LeaderElectionRecord)."""
+    """The contended record (client-go LeaderElectionRecord).
+
+    ``epoch`` is the **fencing token** (HA PR): it increments on every
+    leadership *grant* — create, takeover of an expired lease, or
+    re-acquisition of one's own lapsed lease — and is preserved across
+    renews. Downstream commit/channel boundaries compare a worker's held
+    epoch against the current grant, so a deposed leader's in-flight
+    writes are rejected instead of double-applied (the Chubby/ZooKeeper
+    sequencer discipline client-go leaves to the caller)."""
 
     holder: str
     acquire_time: float
     renew_time: float
     lease_duration: float
     transitions: int = 0
+    epoch: int = 0
 
-    def expired(self, now: float) -> bool:
-        return now - self.renew_time > self.lease_duration
+    def expired(self, now: float, slack: float = 0.0) -> bool:
+        """``slack`` widens the expiry window (clock-skew tolerance): a
+        contender waiting ``slack`` extra seconds never steals a lease
+        whose holder's clock runs up to ``slack`` ahead of ours."""
+        return now - self.renew_time > self.lease_duration + slack
 
 
 class LeaseLock(Protocol):
@@ -156,6 +168,7 @@ class LeaderElector:
         # reboot must still expire (monotonic restarts near 0 at boot)
         now_fn: Callable[[], float] = time.time,
         sleep_fn: Callable[[float], None] = time.sleep,
+        clock_skew_s: float = 0.0,
     ) -> None:
         if renew_deadline >= lease_duration:
             raise ValueError("renew_deadline must be < lease_duration")
@@ -164,6 +177,11 @@ class LeaderElector:
         self.lease_duration = lease_duration
         self.renew_deadline = renew_deadline
         self.retry_period = retry_period
+        #: extra seconds a FOREIGN lease must be expired before takeover —
+        #: tolerates the holder's wall clock running ahead of ours (the
+        #: wall-clock analog of client-go's "leases are renewed by
+        #: duration, compared by local observation" note)
+        self.clock_skew_s = max(0.0, clock_skew_s)
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._now = now_fn
@@ -182,23 +200,37 @@ class LeaderElector:
         )
         cur = self.lock.get()
         if cur is None:
+            mine.epoch = 1
             if self.lock.create(mine):
                 self._observed = mine
                 return True
             return False
         if cur.holder != self.identity:
-            if not cur.expired(now):
+            if not cur.expired(now, self.clock_skew_s):
                 self._observed = cur
                 return False
-            # expired foreign lease: take over
+            # expired foreign lease: take over under a NEW fencing epoch
             mine.transitions = cur.transitions + 1
+            mine.epoch = cur.epoch + 1
             if self.lock.update(cur, mine):
                 self._observed = mine
                 return True
             return False
-        # we hold it: renew, preserving acquire time
+        if cur.expired(now):
+            # our own lease lapsed (force-release, or a pause past the
+            # lease duration): this is a RE-ACQUISITION, not a renew —
+            # the old fencing token must die with the lapse, because a
+            # contender may have legitimately treated the lease as free
+            mine.transitions = cur.transitions + 1
+            mine.epoch = cur.epoch + 1
+            if self.lock.update(cur, mine):
+                self._observed = mine
+                return True
+            return False
+        # we hold it: renew, preserving acquire time and epoch
         mine.acquire_time = cur.acquire_time
         mine.transitions = cur.transitions
+        mine.epoch = cur.epoch
         if self.lock.update(cur, mine):
             self._observed = mine
             return True
@@ -208,6 +240,11 @@ class LeaderElector:
         return (
             self._observed is not None and self._observed.holder == self.identity
         )
+
+    def current_epoch(self) -> Optional[int]:
+        """The fencing epoch of the lease we hold (None when not
+        leader). This is the token every guarded boundary must carry."""
+        return self._observed.epoch if self.is_leader() else None
 
     def leader_identity(self) -> Optional[str]:
         cur = self.lock.get()

@@ -198,6 +198,71 @@ class TestRetryPolicy:
             )
         assert clock[0] <= 2.5
 
+    def test_deadline_shorter_than_first_backoff_raises_without_sleep(self):
+        # HA recovery satellite: a takeover-path caller with a tight
+        # deadline must fail FAST — the first backoff alone would blow
+        # the budget, so the original error escapes with zero sleeping
+        p = RetryPolicy(
+            max_attempts=5, base_delay_s=1.0, jitter=0.0, deadline_s=0.5
+        )
+        calls, slept = [], []
+
+        def fn():
+            calls.append(1)
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            p.run(
+                fn,
+                retry_on=(OSError,),
+                sleep=slept.append,
+                clock=lambda: 0.0,
+            )
+        assert len(calls) == 1 and slept == []
+
+    def test_jitter_never_pushes_past_deadline(self):
+        # the deadline check runs on the JITTERED delay, so an unlucky
+        # +jitter draw can only shorten the retry budget, never sleep
+        # through the deadline — over many seeded draws the total slept
+        # time stays within deadline_s
+        import random as _random
+
+        p = RetryPolicy(
+            max_attempts=1000,
+            base_delay_s=0.4,
+            multiplier=1.0,
+            max_delay_s=0.4,
+            jitter=0.5,
+            deadline_s=2.0,
+        )
+        for seed in range(20):
+            clock = [0.0]
+
+            def fake_sleep(s):
+                clock[0] += s
+
+            def fn():
+                raise OSError("down")
+
+            with pytest.raises(OSError):
+                p.run(
+                    fn,
+                    retry_on=(OSError,),
+                    sleep=fake_sleep,
+                    clock=lambda: clock[0],
+                    rng=_random.Random(seed),
+                )
+            assert clock[0] <= 2.0, seed
+
+    def test_jitter_bounded_by_fraction(self):
+        import random as _random
+
+        p = RetryPolicy(base_delay_s=1.0, max_delay_s=1.0, jitter=0.25)
+        rng = _random.Random(0)
+        for _ in range(200):
+            d = p.delay_for(0, rng)
+            assert 0.75 <= d <= 1.25
+
     def test_counter_labels_site(self):
         from koordinator_tpu.utils.metrics import Registry
 

@@ -44,6 +44,60 @@ def test_chaos_soak_same_seed_same_fault_trace():
     assert c["fault_trace"] != a["fault_trace"]
 
 
+@pytest.mark.chaos
+def test_chaos_soak_ha_failover_arm():
+    """HA failure domain (failover PR): mid-commit crash-restart + leader
+    flaps over the full fault composition. Zero duplicate placements,
+    zero lost acknowledged bindings and per-takeover bit-exact
+    resident-state reconvergence are asserted INSIDE the soak; here we
+    pin the arm's shape: the crash really ran, the takeover gap really
+    existed, journal-acknowledged bindings really were recovered rather
+    than re-placed, and a deposed leader's commit really was fenced."""
+    stats = run_chaos_soak(
+        cycles=30, seed=7, n_nodes=12, max_arrivals=6, ha=True
+    )
+    _check(stats)
+    points = {p for _s, p, _k in stats["fault_trace"]}
+    assert "scheduler.crash_restart" in points
+    assert "leader.lost" in points
+    assert "commit.crash" in points
+    assert stats["crash_restarts"] == 1
+    assert stats["takeovers"] >= 2          # initial grant + post-crash
+    assert stats["cycles_without_leader"] > 0   # the lease gap is real
+    assert stats["recovered_bindings"] > 0  # journal acks survived
+    assert stats["fenced_commits_total"] >= 1.0
+    assert stats["journal_open_intents"] == 0
+    assert stats["leader_epoch_final"] >= 2
+
+
+@pytest.mark.chaos
+def test_chaos_soak_ha_same_seed_same_trace():
+    a = run_chaos_soak(
+        cycles=20, seed=13, n_nodes=10, max_arrivals=5, ha=True
+    )
+    b = run_chaos_soak(
+        cycles=20, seed=13, n_nodes=10, max_arrivals=5, ha=True
+    )
+    assert a["fault_trace"] == b["fault_trace"]
+    assert a["takeovers"] == b["takeovers"]
+    assert a["placed"] == b["placed"]
+
+
+@pytest.mark.slow
+def test_chaos_soak_ha_full_acceptance():
+    """≥200-cycle acceptance soak for the HA arm: kill-restart + leader
+    flaps on top of every prior fault domain, all invariants held."""
+    stats = run_chaos_soak(
+        cycles=200, seed=0, n_nodes=24, max_arrivals=12, ha=True
+    )
+    _check(stats)
+    assert stats["crash_restarts"] == 1
+    assert stats["recovered_bindings"] >= 0
+    assert stats["takeovers"] >= 2
+    points = {p for _s, p, _k in stats["fault_trace"]}
+    assert "scheduler.crash_restart" in points and "leader.lost" in points
+
+
 @pytest.mark.slow
 def test_chaos_soak_full_acceptance():
     """≥200 longrun cycles under the seeded random fault schedule: zero

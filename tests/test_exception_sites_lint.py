@@ -1,0 +1,99 @@
+"""Tier-1 enforcement of the PR 3 exception-accounting invariant:
+every broad ``except Exception`` in the package routes through
+``report_exception`` (directly or via a reporting helper) or re-raises
+— previously a review-only rule, now a failing test."""
+
+import importlib.util
+import pathlib
+import textwrap
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location(
+    "check_exception_sites", ROOT / "tools" / "check_exception_sites.py"
+)
+lint = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(lint)
+
+
+def test_package_has_no_unaccounted_broad_excepts():
+    violations = lint.check_paths([ROOT / "koordinator_tpu"], ROOT)
+    assert violations == [], "\n".join(
+        f"{f}:{line}: {msg}" for f, line, msg in violations
+    )
+
+
+def _check_src(tmp_path, src):
+    f = tmp_path / "koordinator_tpu_frag.py"
+    f.write_text(textwrap.dedent(src))
+    return lint.check_file(f, tmp_path)
+
+
+def test_lint_flags_silent_swallow(tmp_path):
+    bad = _check_src(
+        tmp_path,
+        """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        """,
+    )
+    assert len(bad) == 1 and "report_exception" in bad[0][2]
+
+
+def test_lint_flags_bare_except_and_tuple_form(tmp_path):
+    bad = _check_src(
+        tmp_path,
+        """
+        def f():
+            try:
+                g()
+            except:
+                x = 1
+            try:
+                g()
+            except (ValueError, Exception) as exc:
+                log(exc)
+        """,
+    )
+    assert len(bad) == 2
+
+
+def test_lint_accepts_report_reraise_and_helper(tmp_path):
+    good = _check_src(
+        tmp_path,
+        """
+        def f(self):
+            try:
+                g()
+            except Exception as exc:
+                report_exception("site", exc)
+            try:
+                g()
+            except Exception:
+                raise
+            try:
+                g()
+            except Exception as exc:
+                self._note_solver_failure(0, exc)
+        """,
+    )
+    assert good == []
+
+
+def test_lint_ignores_narrow_handlers(tmp_path):
+    assert (
+        _check_src(
+            tmp_path,
+            """
+            def f():
+                try:
+                    g()
+                except ValueError:
+                    pass
+            """,
+        )
+        == []
+    )

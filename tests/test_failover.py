@@ -1,0 +1,541 @@
+"""Fenced leader failover + warm-standby recovery tests (HA PR tentpole).
+
+The acceptance-criterion test drives TWO scheduler instances over one
+statehub and one lease lock, forces a leadership change mid-cycle (solve
+in flight in instance A's pipeline), and proves the deposed leader's
+trailing commit is rejected with the named STALE_LEADER_EPOCH reason and
+counted in ``leader_fenced_commits_total`` — never double-placed.
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.chaos import FaultInjector
+from koordinator_tpu.core.journal import (
+    BindJournal,
+    EpochFence,
+    MemoryJournalStore,
+)
+from koordinator_tpu.runtime.ha import LeaderCoordinator
+from koordinator_tpu.runtime.recovery import recover_scheduler
+from koordinator_tpu.runtime.statehub import ClusterStateHub
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.pipeline import CyclePipeline
+from koordinator_tpu.utils.leaderelection import InMemoryLeaseLock, LeaderElector
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def _node(name, cpu=32_000.0, mem=128 * 1024.0):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(
+            allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}
+        ),
+    )
+
+
+def _pod(name, cpu=2000.0, mem=4096.0, prio=9000):
+    return Pod(
+        meta=ObjectMeta(name=name),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}, priority=prio
+        ),
+    )
+
+
+def _sched(store=None, fence=None, chaos=None, **kw):
+    sched = BatchScheduler(
+        args=LoadAwareArgs(usage_thresholds={}),
+        batch_bucket=16,
+        chaos=chaos,
+        journal=BindJournal(store) if store is not None else None,
+        fence=fence,
+        **kw,
+    )
+    sched.extender.monitor.stop_background()
+    return sched
+
+
+def _hub_with_nodes(scheds, n_nodes=4):
+    hub = ClusterStateHub()
+    for s in scheds:
+        hub.wire_scheduler(s)
+    hub.start()
+    for i in range(n_nodes):
+        hub.publish(hub.nodes, _node(f"n{i}"))
+    assert hub.wait_synced()
+    return hub
+
+
+def _elector(lock, ident, clock):
+    return LeaderElector(
+        lock, ident, now_fn=clock.now, sleep_fn=clock.sleep
+    )
+
+
+# ---------------------------------------------------------------------------
+# acceptance criterion: deposed leader's in-flight commit is fenced
+# ---------------------------------------------------------------------------
+
+
+def test_deposed_leader_inflight_pipeline_commit_is_fenced():
+    store = MemoryJournalStore()
+    fence = EpochFence()
+    lock = InMemoryLeaseLock()
+    clock = FakeClock()
+    sched_a = _sched(store=store, fence=fence)
+    sched_b = _sched(store=store, fence=fence)
+    hub = _hub_with_nodes([sched_a, sched_b])
+    try:
+        pipe_a = CyclePipeline(sched_a)
+        coord_a = LeaderCoordinator(
+            sched_a,
+            _elector(lock, "instance-a", clock),
+            fence,
+            sched_a.bind_journal,
+            hub=hub,
+            pipeline=pipe_a,
+        )
+        coord_b = LeaderCoordinator(
+            sched_b,
+            _elector(lock, "instance-b", clock),
+            fence,
+            sched_b.bind_journal,
+            hub=hub,
+        )
+        leading, _ = coord_a.tick()
+        assert leading and sched_a._fence_epoch == 1
+        assert not coord_b.tick()[0]  # contender blocked inside the lease
+
+        # A's cycle goes in flight: the batch is fed, its solve is
+        # dispatched, the trailing commit has NOT run yet
+        batch = [_pod(f"p{i}") for i in range(6)]
+        assert pipe_a.feed(batch) is None
+
+        # leadership changes MID-CYCLE: the lease expires and B takes
+        # over under epoch 2 (running recovery before its grant)
+        clock.t = 20.0
+        leading_b, _ = coord_b.tick()
+        assert leading_b and fence.current() == 2
+        assert coord_b.last_recovery is not None
+        assert coord_b.last_recovery.bitexact is True
+
+        # A discovers the loss; its in-flight commit must drain through
+        # the fence and be REJECTED — not double-placed
+        leading_a, drained = coord_a.tick()
+        assert not leading_a
+        assert drained is not None
+        assert drained.bound == []
+        assert {p.meta.uid for p in drained.unschedulable} == {
+            p.meta.uid for p in batch
+        }
+        # the rejection is attributed with the NAMED reason + metric
+        recs = sched_a.extender.rejections.for_uid(batch[0].meta.uid)
+        assert any(r.reason == "stale_leader_epoch" for r in recs), recs
+        assert (
+            sched_a.extender.registry.get(
+                "leader_fenced_commits_total"
+            ).value()
+            >= 1.0
+        )
+        # the deposed leader charged nothing and journaled nothing
+        assert all(
+            not sched_a.snapshot.is_assumed(p.meta.uid) for p in batch
+        )
+        assert not any(
+            r["op"] == "bind" for r in sched_a.bind_journal.records()
+        )
+
+        # the new leader places the same pods exactly once
+        out_b = sched_b.schedule(batch)
+        assert len(out_b.bound) == len(batch)
+        pipe_a.close()
+    finally:
+        hub.stop()
+
+
+# ---------------------------------------------------------------------------
+# crash restart: journal replay + statehub resync rebuild the world
+# ---------------------------------------------------------------------------
+
+
+def test_crash_restart_recovers_acknowledged_bindings():
+    store = MemoryJournalStore()
+    fence = EpochFence()
+    sched1 = _sched(store=store, fence=fence)
+    hub = _hub_with_nodes([sched1])
+    try:
+        sched1.grant_leadership(fence.advance())
+        published = [_pod(f"pub{i}") for i in range(4)]
+        out1 = sched1.schedule(published)
+        assert len(out1.bound) == 4
+        for pod, node in out1.bound:
+            pod.spec.node_name = node
+            hub.publish(hub.pods, pod)  # the bind API write landed
+        # a second batch is committed + journal-ACKNOWLEDGED, but the
+        # process dies before the bind API writes go out
+        unpublished = [_pod(f"lost{i}", prio=7000) for i in range(3)]
+        out2 = sched1.schedule(unpublished)
+        assert len(out2.bound) == 3
+        assert hub.wait_synced()
+
+        # ---- crash: the process (snapshot, scheduler, watches) dies ----
+        hub.detach_consumers()
+        sched2 = _sched(store=store, fence=fence)
+        hub.wire_scheduler(sched2)
+        hub.start()
+
+        rep = recover_scheduler(
+            sched2,
+            sched2.bind_journal,
+            hub=hub,
+            epoch=fence.advance(),
+            verify=True,
+        )
+        # published binds came back through the resync; the unpublished
+        # (assumed-but-unbound) ones through restore_assumed replay
+        assert rep.reconfirmed == 4
+        assert rep.replayed == 3
+        assert rep.bitexact is True
+        assert rep.skipped_missing_node == 0
+        # every acknowledged binding is recoverable — zero lost
+        acked = {p.meta.uid for p, _ in out1.bound} | {
+            p.meta.uid for p, _ in out2.bound
+        }
+        assert set(rep.bindings) == acked
+        # the rebuilt charges equal the dead leader's, node by node
+        for i in range(4):
+            name = f"n{i}"
+            i1 = sched1.snapshot.node_id(name)
+            i2 = sched2.snapshot.node_id(name)
+            np.testing.assert_allclose(
+                sched2.snapshot.nodes.requested[i2],
+                sched1.snapshot.nodes.requested[i1],
+                atol=1e-3,
+            )
+        assert sched2._fence_epoch == 2
+    finally:
+        hub.stop()
+
+
+def test_recovery_skips_entries_for_vanished_nodes():
+    store = MemoryJournalStore()
+    journal = BindJournal(store)
+    journal.append_bind(
+        1,
+        0,
+        [
+            {
+                "uid": "ghost",
+                "node": "gone-node",
+                "req": [1000.0, 2048.0] + [0.0] * 8,
+                "est": [1000.0, 2048.0] + [0.0] * 8,
+                "prod": False,
+                "nom": 0.0,
+                "conf": True,
+                "quota": None,
+            }
+        ],
+    )
+    sched = _sched(store=store)
+    hub = _hub_with_nodes([sched])
+    try:
+        rep = recover_scheduler(sched, journal, hub=hub, epoch=None)
+        assert rep.skipped_missing_node == 1 and rep.replayed == 0
+        assert not sched.snapshot.is_assumed("ghost")
+    finally:
+        hub.stop()
+
+
+# ---------------------------------------------------------------------------
+# commit-boundary failure domains: journal.write_fail + leader.stale_commit
+# ---------------------------------------------------------------------------
+
+
+def test_journal_write_fail_rejects_chunk_unmutated():
+    chaos = FaultInjector(seed=0)
+    store = MemoryJournalStore()
+    sched = _sched(store=store, chaos=chaos)
+    for i in range(3):
+        sched.snapshot.upsert_node(_node(f"n{i}"))
+    pods = [_pod(f"p{i}") for i in range(4)]
+    chaos.arm("journal.write_fail", times=1)
+    before = sched.snapshot.nodes.requested.copy()
+    out = sched.schedule(pods)
+    # journal before mutate: the refused intent rejected the chunk with
+    # ZERO snapshot mutation and nothing in the log
+    assert out.bound == [] and len(out.unschedulable) == 4
+    np.testing.assert_array_equal(sched.snapshot.nodes.requested, before)
+    assert sched.bind_journal.records() == []
+    recs = sched.extender.rejections.for_uid(pods[0].meta.uid)
+    assert any(r.reason == "journal_write_failed" for r in recs), recs
+    assert not sched.extender.health.get("commit")["ok"]
+    # fault exhausted: the retry cycle binds and journals normally
+    out2 = sched.schedule(pods)
+    assert len(out2.bound) == 4
+    assert {r["op"] for r in sched.bind_journal.records()} == {
+        "intent",
+        "bind",
+    }
+    assert sched.extender.health.get("commit")["ok"]
+
+
+def test_stale_commit_chaos_point_fences_deterministically():
+    chaos = FaultInjector(seed=0)
+    sched = _sched(chaos=chaos)
+    for i in range(2):
+        sched.snapshot.upsert_node(_node(f"n{i}"))
+    pods = [_pod(f"p{i}") for i in range(2)]
+    chaos.arm("leader.stale_commit", times=1)
+    out = sched.schedule(pods)
+    assert out.bound == []
+    recs = sched.extender.rejections.for_uid(pods[0].meta.uid)
+    assert any(r.reason == "stale_leader_epoch" for r in recs), recs
+    assert (
+        sched.extender.registry.get("leader_fenced_commits_total").value()
+        == 1.0
+    )
+    assert chaos.fired_counts()["leader.stale_commit"] == 1
+    # next cycle is clean
+    assert len(sched.schedule(pods).bound) == 2
+
+
+def test_commit_crash_writes_abort_record():
+    chaos = FaultInjector(seed=0)
+    store = MemoryJournalStore()
+    sched = _sched(store=store, chaos=chaos)
+    sched.snapshot.upsert_node(_node("n0"))
+    chaos.arm("commit.crash", error=RuntimeError, times=1)
+    out = sched.schedule([_pod("p0")])
+    assert out.bound == []
+    ops = [r["op"] for r in sched.bind_journal.records()]
+    assert ops == ["intent", "abort"]
+    # replay sees nothing applied — matching the rolled-back host state
+    assert sched.bind_journal.replay().live == {}
+
+
+# ---------------------------------------------------------------------------
+# pipeline drain/handoff + leader.lost flap
+# ---------------------------------------------------------------------------
+
+
+def test_drain_for_handoff_fences_inflight_batch():
+    fence = EpochFence()
+    sched = _sched(store=MemoryJournalStore(), fence=fence)
+    for i in range(3):
+        sched.snapshot.upsert_node(_node(f"n{i}"))
+    sched.grant_leadership(fence.advance())
+    pipe = CyclePipeline(sched)
+    try:
+        batch1 = [_pod(f"a{i}") for i in range(3)]
+        batch2 = [_pod(f"b{i}") for i in range(3)]
+        assert pipe.feed(batch1) is None
+        out1 = pipe.feed(batch2)
+        assert out1 is not None and len(out1.bound) == 3
+        # leadership lost with batch2 in flight
+        sched.revoke_leadership()
+        drained = pipe.drain_for_handoff()
+        assert drained is not None and drained.bound == []
+        assert {p.meta.uid for p in drained.unschedulable} == {
+            p.meta.uid for p in batch2
+        }
+        assert sched.extender.health.get("pipeline")["ok"]
+        assert pipe.drain_for_handoff() is None  # idempotent when idle
+    finally:
+        pipe.close()
+
+
+def test_handoff_flaps_never_burn_retry_budget():
+    """A fencing rejection is not a scheduling verdict: pods caught
+    in flight by MORE leadership flaps than ``max_retries`` must still
+    be queued for the next leader, never reported unschedulable."""
+    from koordinator_tpu.scheduler.stream import StreamScheduler
+
+    fence = EpochFence()
+    sched = _sched(store=MemoryJournalStore(), fence=fence)
+    for i in range(3):
+        sched.snapshot.upsert_node(_node(f"n{i}"))
+    stream = StreamScheduler(sched, pipelined=True, max_retries=2)
+    try:
+        pod = _pod("flappy")
+        stream.submit(pod)
+        for flap in range(4):  # > max_retries flaps
+            sched.grant_leadership(fence.advance())
+            sched.revoke_leadership()
+            assert stream.pump() == []  # pod goes in flight
+            decided = stream.drain_for_handoff()
+            assert decided == [], f"flap {flap} decided {decided}"
+            assert stream.backlog() == 1
+        # a real leader finally places it
+        sched.grant_leadership(fence.advance())
+        results = stream.flush()
+        assert len(results) == 1 and results[0][1] is not None
+    finally:
+        stream.close()
+
+
+def test_fenceless_recovery_adopts_journal_epoch():
+    """The CLI restart path (no election wired, epoch=None) over a
+    journal written under coordinator epochs must ADOPT the journal's
+    last epoch — otherwise every append from the recovered writer is
+    refused as stale and the scheduler can never commit again."""
+    store = MemoryJournalStore()
+    BindJournal(store).append_bind(
+        3,
+        0,
+        [
+            {
+                "uid": "old",
+                "node": "n0",
+                "req": [100.0, 128.0, 0.0, 0.0],
+                "est": [100.0, 128.0, 0.0, 0.0],
+                "prod": False,
+                "nom": 0.0,
+                "conf": True,
+                "quota": None,
+            }
+        ],
+    )
+    sched = _sched(store=store)  # no fence — the CLI shape
+    hub = _hub_with_nodes([sched])
+    try:
+        rep = recover_scheduler(
+            sched, sched.bind_journal, hub=hub, epoch=None
+        )
+        assert rep.epoch == 3 and sched._fence_epoch == 3
+        out = sched.schedule([_pod("fresh")])
+        assert len(out.bound) == 1  # journal append accepted epoch 3
+        assert any(
+            r["op"] == "bind" and r["epoch"] == 3
+            for r in sched.bind_journal.records()[1:]
+        )
+    finally:
+        hub.stop()
+
+
+def test_snapshot_channel_rejects_malformed_epoch_metadata():
+    """A PRESENT but unparseable x-leader-epoch must be rejected
+    (INVALID_ARGUMENT), not waved through unfenced."""
+    import grpc
+
+    from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+    from koordinator_tpu.runtime.snapshot_channel import (
+        EPOCH_METADATA_KEY,
+        SERVICE_NAME,
+        SolverService,
+        serve,
+    )
+
+    service = SolverService()
+    service.scheduler.extender.monitor.stop_background()
+    server, port = serve(service)
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stub = channel.unary_unary(
+        f"/{SERVICE_NAME}/Sync",
+        request_serializer=pb.SnapshotDelta.SerializeToString,
+        response_deserializer=pb.SyncAck.FromString,
+    )
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            stub(
+                pb.SnapshotDelta(revision=1),
+                metadata=((EPOCH_METADATA_KEY, "epoch-7"),),
+            )
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert service.revision == 0  # nothing mutated
+    finally:
+        channel.close()
+        server.stop(grace=None)
+
+
+def test_snapshot_channel_fences_stale_epoch():
+    """Channel-boundary fencing: once the new leader's epoch has spoken
+    over the channel, a deposed leader's sync/nominate is refused
+    server-side (ChannelFenced), and a locally-wired fence stops the
+    call before it even reaches the wire (StaleEpochError)."""
+    from koordinator_tpu.core.journal import StaleEpochError
+    from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+    from koordinator_tpu.runtime.snapshot_channel import (
+        ChannelFenced,
+        SolverClient,
+        SolverService,
+        serve,
+    )
+
+    service = SolverService()
+    service.scheduler.extender.monitor.stop_background()
+    server, port = serve(service)
+    new_leader = SolverClient(f"127.0.0.1:{port}")
+    old_leader = SolverClient(f"127.0.0.1:{port}")
+    try:
+        new_leader.set_epoch(5)
+        old_leader.set_epoch(4)
+        delta = pb.SnapshotDelta(revision=1)
+        delta.node_upserts.add(
+            name="n0", allocatable=pb.ResourceVector(values=[32000.0])
+        )
+        ack = new_leader.sync(delta)
+        assert ack.applied_revision == 1
+        assert service.leader_epoch == 5
+        with pytest.raises(ChannelFenced):
+            old_leader.sync(pb.SnapshotDelta(revision=2))
+        with pytest.raises(ChannelFenced):
+            old_leader.nominate(pb.NominateRequest())
+        # the refused delta mutated nothing
+        assert service.revision == 1
+        # local fence layer: the call never leaves the process
+        fence = EpochFence()
+        fence.adopt(5)
+        local = SolverClient(f"127.0.0.1:{port}", fence=fence)
+        local.set_epoch(4)
+        with pytest.raises(StaleEpochError):
+            local.sync(pb.SnapshotDelta(revision=3))
+        local.close()
+    finally:
+        new_leader.close()
+        old_leader.close()
+        server.stop(grace=None)
+
+
+def test_leader_lost_chaos_flap_reacquires_under_new_epoch():
+    chaos = FaultInjector(seed=0)
+    fence = EpochFence()
+    store = MemoryJournalStore()
+    sched = _sched(store=store, fence=fence, chaos=chaos)
+    hub = _hub_with_nodes([sched])
+    try:
+        lock = InMemoryLeaseLock()
+        clock = FakeClock()
+        coord = LeaderCoordinator(
+            sched,
+            _elector(lock, "solo", clock),
+            fence,
+            sched.bind_journal,
+            hub=hub,
+        )
+        assert coord.tick()[0] and sched._fence_epoch == 1
+        chaos.arm("leader.lost", times=1)
+        leading, _ = coord.tick()
+        assert not leading and sched._fence_epoch == -1
+        # commits are fenced while revoked
+        out = sched.schedule([_pod("flap0")])
+        assert out.bound == []
+        # next tick re-acquires under a NEW epoch, through recovery
+        leading, _ = coord.tick()
+        assert leading and sched._fence_epoch == 2
+        assert fence.current() == 2
+        assert len(sched.schedule([_pod("flap1")]).bound) == 1
+    finally:
+        hub.stop()

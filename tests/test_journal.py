@@ -1,0 +1,201 @@
+"""Write-ahead bind journal + fencing-epoch unit tests (HA failover PR)."""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.chaos import FaultInjector
+from koordinator_tpu.core.journal import (
+    BindJournal,
+    EpochFence,
+    FileJournalStore,
+    JournalWriteError,
+    MemoryJournalStore,
+    StaleEpochError,
+)
+
+
+def _bind(uid, node, req=(1000.0, 2048.0)):
+    return {
+        "uid": uid,
+        "node": node,
+        "req": list(req),
+        "est": list(req),
+        "prod": False,
+        "nom": 0.0,
+        "conf": True,
+        "quota": None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# EpochFence
+# ---------------------------------------------------------------------------
+
+
+def test_fence_advance_adopt_check():
+    f = EpochFence()
+    assert f.current() == 0
+    assert f.advance() == 1
+    f.check(1)
+    with pytest.raises(StaleEpochError):
+        f.check(0)
+    assert f.adopt(3) == 3
+    with pytest.raises(StaleEpochError):
+        f.adopt(2)  # fencing tokens never move backwards
+    with pytest.raises(StaleEpochError):
+        f.check(1)
+
+
+def test_fence_revoked_sentinel_always_stale():
+    f = EpochFence()
+    with pytest.raises(StaleEpochError):
+        f.check(-1)
+
+
+# ---------------------------------------------------------------------------
+# BindJournal core protocol
+# ---------------------------------------------------------------------------
+
+
+def test_bind_then_forget_replay():
+    j = BindJournal()
+    j.append_intent(1, 0, [("a", "n0"), ("b", "n1")])
+    j.append_bind(1, 0, [_bind("a", "n0"), _bind("b", "n1")])
+    j.append_forget(1, 3, ["a"])
+    rep = j.replay()
+    assert set(rep.live) == {"b"}
+    assert rep.live["b"]["node"] == "n1"
+    assert rep.binds == 1 and rep.forgets == 1 and rep.open_intents == 0
+
+
+def test_crash_mid_commit_intent_is_void():
+    """An intent with no matching bind/abort (the process died between
+    journal-intent and journal-bind) contributes nothing to replay: the
+    dying process's host mutations died with it."""
+    j = BindJournal()
+    j.append_intent(1, 0, [("a", "n0")])
+    j.append_bind(1, 0, [_bind("a", "n0")])
+    j.append_intent(1, 1, [("b", "n0")])  # crash here
+    rep = j.replay()
+    assert set(rep.live) == {"a"}
+    assert rep.open_intents == 1
+
+
+def test_abort_voids_intent():
+    j = BindJournal()
+    j.append_intent(1, 0, [("a", "n0")])
+    j.append_abort(1, 0, "rolled back")
+    rep = j.replay()
+    assert rep.live == {} and rep.aborts == 1 and rep.open_intents == 0
+
+
+def test_rebind_last_write_wins():
+    j = BindJournal()
+    j.append_bind(1, 0, [_bind("a", "n0")])
+    j.append_bind(1, 4, [_bind("a", "n2")])
+    assert j.replay().live["a"]["node"] == "n2"
+
+
+def test_journal_epoch_fencing_refuses_stale_writer():
+    """The journal is the fencing backstop at the storage boundary: once
+    epoch 2 has written, an epoch-1 straggler is refused."""
+    j = BindJournal()
+    j.append_bind(1, 0, [_bind("a", "n0")])
+    j.append_bind(2, 0, [_bind("b", "n1")])
+    with pytest.raises(StaleEpochError):
+        j.append_bind(1, 1, [_bind("c", "n2")])
+    # the refused write left no record
+    assert set(j.replay().live) == {"a", "b"}
+    assert j.epoch_high == 2
+
+
+def test_compact_preserves_live_set():
+    j = BindJournal()
+    j.append_bind(1, 0, [_bind("a", "n0"), _bind("b", "n1")])
+    j.append_forget(1, 1, ["a"])
+    j.compact()
+    recs = j.records()
+    assert len(recs) == 1 and recs[0]["op"] == "checkpoint"
+    assert set(j.replay().live) == {"b"}
+    # appends continue after compaction, seq still monotonic
+    j.append_bind(1, 2, [_bind("c", "n0")])
+    assert set(j.replay().live) == {"b", "c"}
+
+
+def test_chaos_write_fail_raises_and_counts():
+    chaos = FaultInjector(seed=0)
+    chaos.arm("journal.write_fail", times=1)
+    j = BindJournal(chaos=chaos)
+    with pytest.raises(JournalWriteError):
+        j.append_intent(1, 0, [("a", "n0")])
+    # nothing landed; the next write (fault exhausted) succeeds
+    assert j.records() == []
+    j.append_intent(1, 0, [("a", "n0")])
+    assert len(j.records()) == 1
+
+
+# ---------------------------------------------------------------------------
+# FileJournalStore durability
+# ---------------------------------------------------------------------------
+
+
+def test_file_store_roundtrip_and_reopen(tmp_path):
+    path = os.fspath(tmp_path / "journal.jsonl")
+    j = BindJournal(FileJournalStore(path))
+    j.append_intent(1, 0, [("a", "n0")])
+    j.append_bind(1, 0, [_bind("a", "n0")])
+    # a fresh journal over the same file resumes seq + epoch_high
+    j2 = BindJournal(FileJournalStore(path))
+    assert j2.epoch_high == 1
+    rep = j2.replay()
+    assert set(rep.live) == {"a"}
+    j2.append_forget(1, 1, ["a"])
+    assert BindJournal(FileJournalStore(path)).replay().live == {}
+
+
+def test_file_store_tolerates_torn_tail(tmp_path):
+    path = os.fspath(tmp_path / "journal.jsonl")
+    j = BindJournal(FileJournalStore(path))
+    j.append_bind(1, 0, [_bind("a", "n0")])
+    with open(path, "a") as f:
+        f.write('{"seq": 99, "epoch": 1, "op": "bi')  # crash mid-append
+    rep = BindJournal(FileJournalStore(path)).replay()
+    assert set(rep.live) == {"a"}
+    assert rep.seq_high == 1
+
+
+def test_file_store_appends_cleanly_after_torn_tail(tmp_path):
+    """Reopening after a crash mid-append must TRUNCATE the partial
+    line first — otherwise the next append merges into it, producing
+    one unparseable record that load() stops at and silently discards
+    every post-restart append behind it."""
+    path = os.fspath(tmp_path / "journal.jsonl")
+    j = BindJournal(FileJournalStore(path))
+    j.append_bind(1, 0, [_bind("a", "n0")])
+    with open(path, "a") as f:
+        f.write('{"seq": 99, "epoch": 1, "op": "bi')  # crash mid-append
+    j2 = BindJournal(FileJournalStore(path))
+    j2.append_bind(1, 1, [_bind("b", "n1")])
+    j2.append_forget(1, 2, ["a"])
+    rep = BindJournal(FileJournalStore(path)).replay()
+    assert set(rep.live) == {"b"}
+    assert rep.binds == 2 and rep.forgets == 1
+
+
+def test_file_store_records_are_json_lines(tmp_path):
+    path = os.fspath(tmp_path / "journal.jsonl")
+    j = BindJournal(FileJournalStore(path))
+    j.append_bind(3, 7, [_bind("a", "n0")])
+    with open(path) as f:
+        rec = json.loads(f.readline())
+    assert rec["op"] == "bind" and rec["epoch"] == 3 and rec["cycle"] == 7
+
+
+def test_memory_store_survives_scheduler_death():
+    """The store object outliving its journal/scheduler is the simulated
+    crash: a second journal over the same store sees everything."""
+    store = MemoryJournalStore()
+    BindJournal(store).append_bind(1, 0, [_bind("a", "n0")])
+    assert set(BindJournal(store).replay().live) == {"a"}

@@ -108,6 +108,107 @@ def test_file_lock_survives_corrupt_file(tmp_path):
     ) is False or lock.get().holder == "a"
 
 
+# ---------------------------------------------------------------------------
+# failover-critical edges (HA PR satellite): renew-race at expiry, clock
+# skew tolerance, re-election after force-release, fencing epochs
+# ---------------------------------------------------------------------------
+
+
+def test_renew_race_at_lease_expiry_admits_exactly_one():
+    """At the expiry instant the holder's renew and a contender's
+    takeover race on the CAS: whichever lands first wins, the loser's
+    update (based on the now-stale record) MUST fail."""
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    b = elector(lock, "b", clock)
+    assert a.try_acquire_or_renew()
+    clock.t = 15.1  # a's lease just expired; both see the same record
+    stale = lock.get()
+    # b's takeover lands first...
+    assert b.try_acquire_or_renew()
+    # ...so a's renew — CAS'd against the record it observed before b
+    # moved it — must lose, not silently steal leadership back
+    assert not a.try_acquire_or_renew()
+    assert not a.is_leader() and b.is_leader()
+    rec = lock.get()
+    assert rec.holder == "b" and rec.epoch == 2
+    # and the direct stale-CAS form: an update based on the pre-takeover
+    # snapshot is rejected outright
+    import dataclasses as _dc
+
+    assert not lock.update(
+        stale, _dc.replace(stale, renew_time=clock.t)
+    )
+
+
+def test_clock_skew_tolerance_delays_foreign_takeover():
+    """With clock_skew_s=2 a contender waits 2 extra seconds past
+    nominal expiry before stealing — a holder whose clock runs ahead of
+    ours is not deposed while it still believes its lease is live."""
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    b = elector(lock, "b", clock, clock_skew_s=2.0)
+    c = elector(lock, "c", clock)  # no tolerance, for contrast
+    assert a.try_acquire_or_renew()
+    clock.t = 16.0  # nominally expired (15s lease)...
+    assert not b.try_acquire_or_renew()  # ...but inside b's skew window
+    clock.t = 17.5
+    assert b.try_acquire_or_renew()      # past lease + skew: takeover
+    assert b.is_leader()
+    # the skew window never blocks taking a DEAD lease eventually, and
+    # the no-tolerance contender would have taken it at 16.0 (sanity)
+    a.release()
+    b.release()
+    assert c.try_acquire_or_renew()
+
+
+def test_reelection_after_force_release_bumps_epoch():
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    b = elector(lock, "b", clock)
+    assert a.try_acquire_or_renew()
+    assert a.current_epoch() == 1
+    a.release()  # force-release: the held lease is surrendered
+    assert a.current_epoch() is None
+    # the next grant — whoever wins it — is a NEW fencing epoch
+    assert b.try_acquire_or_renew()
+    assert b.current_epoch() == 2
+    rec = lock.get()
+    assert rec.transitions == 1
+    # same for the original holder re-acquiring its OWN released lease:
+    # that is a re-acquisition, not a renew
+    b.release()
+    assert a.try_acquire_or_renew()
+    assert a.current_epoch() == 3
+
+
+def test_renew_preserves_epoch_takeover_bumps_it():
+    lock, clock = InMemoryLeaseLock(), FakeClock()
+    a = elector(lock, "a", clock)
+    b = elector(lock, "b", clock)
+    assert a.try_acquire_or_renew()
+    clock.t = 5.0
+    assert a.try_acquire_or_renew()  # renew
+    assert lock.get().epoch == 1 and a.current_epoch() == 1
+    clock.t = 30.0  # expired: b takes over
+    assert b.try_acquire_or_renew()
+    assert lock.get().epoch == 2
+    assert a.current_epoch() is None or a.current_epoch() == 1
+    # a's next protocol step observes the loss
+    assert not a.try_acquire_or_renew()
+    assert a.current_epoch() is None
+
+
+def test_file_lock_roundtrips_epoch(tmp_path):
+    path = os.fspath(tmp_path / "lease.json")
+    lock = FileLeaseLock(path)
+    rec = LeaseRecord(
+        holder="a", acquire_time=0, renew_time=0, lease_duration=15, epoch=7
+    )
+    assert lock.create(rec)
+    assert lock.get().epoch == 7
+
+
 def test_run_acquire_renew_release_cycle():
     lock, clock = InMemoryLeaseLock(), FakeClock()
     started, stopped = [], []
