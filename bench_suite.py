@@ -1015,12 +1015,305 @@ def bench_recovery():
     }
 
 
+def _build_sharded_streams(n_shards, n_pods, max_batch):
+    """Partition the 10k-node loadaware cluster into S shard-scoped
+    schedulers (PR 6): each shard owns a disjoint node subset, runs its
+    own fenced BatchScheduler + write-ahead journal, and streams its
+    routed share of the arrival process."""
+    from koordinator_tpu.core.journal import (
+        BindJournal,
+        EpochFence,
+        MemoryJournalStore,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.runtime.shards import ShardMap
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+    from koordinator_tpu.sim.cluster_gen import GenConfig, gen_nodes, gen_pods
+
+    cfg = GenConfig(n_nodes=10_000, n_pods=n_pods, seed=7)
+    nodes, metrics = gen_nodes(cfg)
+    pods = gen_pods(cfg)
+    smap = ShardMap(n_shards)
+    metric_of = {m.meta.name: m for m in metrics}
+    scheds, fences = [], []
+    for s in range(n_shards):
+        snap = ClusterSnapshot()
+        for n in nodes:
+            if smap.shard_of_node(n.meta.name) != s:
+                continue
+            snap.upsert_node(n)
+            m = metric_of.get(n.meta.name)
+            if m is not None:
+                snap.set_node_metric(
+                    m, now=m.update_time + 1 if m.update_time else 1.0
+                )
+        fence = EpochFence()
+        sched = BatchScheduler(
+            snap,
+            LoadAwareArgs(),
+            batch_bucket=max_batch,
+            max_rounds=8,
+            percentage_of_nodes_to_score=0,
+            journal=BindJournal(MemoryJournalStore(), shard=s),
+            fence=fence,
+        )
+        sched.extender.monitor.stop_background()
+        fence.adopt(1)
+        sched.grant_leadership(1)
+        scheds.append(sched)
+        fences.append(fence)
+    return smap, scheds, fences, pods
+
+
+def _sharded_stream_run(
+    backend_device,
+    n_shards,
+    rate,
+    n_target=6000,
+    max_batch=256,
+    churn_at=None,
+    churn_pause_s=0.15,
+    isolated=False,
+):
+    """One sharded latency run: ONE Poisson arrival process at the
+    aggregate ``rate``, routed to shards by uid hash, each shard pumping
+    its own StreamScheduler (the N-concurrent-leaders operating point).
+
+    ``isolated=False`` pumps every shard on its own THREAD inside this
+    one container — an honest floor, not the deployment shape: the
+    Python host path (lower/commit) serializes on the GIL and the XLA
+    CPU executions contend for the same cores, so added shards mostly
+    measure contention. ``isolated=True`` times each shard's pump ALONE
+    (sequentially, its own clock, its own arrival share at rate/S) and
+    reports wall = max(per-shard wall): the process-per-shard deployment
+    projection, where each scheduler is its own process exactly as the
+    partitioned control plane deploys.
+
+    ``churn_at`` (0..1 fraction of the pod budget) deposes shard 0's
+    leader mid-run — its epoch advances, in-flight commits are fenced
+    (STALE_LEADER_EPOCH), pods requeue — and re-grants after
+    ``churn_pause_s``, measuring the p99/backlog cost of leader churn.
+    Returns (latencies_ms, end_backlog_total, bound, wall_s)."""
+    import threading
+
+    import jax
+
+    from koordinator_tpu.scheduler.stream import StreamScheduler
+
+    with jax.default_device(backend_device):
+        smap, scheds, fences, pods = _build_sharded_streams(
+            n_shards, n_target + 2_048, max_batch
+        )
+        # warm every shard's jit specializations (bucket + partials)
+        for sched in scheds:
+            sched.schedule(pods[:max_batch])
+            sched.schedule(pods[max_batch : max_batch + 30])
+        streams = [
+            StreamScheduler(s, max_batch=max_batch, max_retries=200)
+            for s in scheds
+        ]
+        offset = max_batch + 30
+        rng = np.random.default_rng(3)
+        route = [[] for _ in range(n_shards)]
+        if isolated:
+            # each shard's own Poisson process at its arrival share
+            for pod in pods[offset : offset + n_target]:
+                route[smap.shard_of_key(pod.meta.uid)].append(pod)
+            route = [
+                [
+                    (p, t)
+                    for p, t in zip(
+                        mine,
+                        np.cumsum(
+                            rng.exponential(
+                                n_shards / rate, size=len(mine)
+                            )
+                        ),
+                    )
+                ]
+                for mine in route
+            ]
+        else:
+            next_arr = 0.0
+            for pod in pods[offset : offset + n_target]:
+                route[smap.shard_of_key(pod.meta.uid)].append(
+                    (pod, next_arr)
+                )
+                next_arr += rng.exponential(1.0 / rate)
+        lat_lock = threading.Lock()
+        lat: list = []
+        churn_stamp = (
+            route[0][int(len(route[0]) * churn_at)][1]
+            if churn_at is not None and route[0]
+            else None
+        )
+
+        def pump_shard(si, t0):
+            stream = streams[si]
+            mine = route[si]
+            i = 0
+            out: list = []
+            empty_streak = 0
+            while i < len(mine) or stream.backlog():
+                now = time.perf_counter() - t0
+                while i < len(mine) and mine[i][1] <= now:
+                    stream.submit(mine[i][0], now=t0 + mine[i][1])
+                    i += 1
+                res = stream.pump()
+                for _pod, node, l in res:
+                    if node is not None:
+                        out.append(l * 1e3)
+                if not res and i < len(mine):
+                    time.sleep(0.0005)
+                if not res and i >= len(mine) and stream.backlog():
+                    # no decisions while draining: either the fenced
+                    # churn window (pods re-queue charge-free and the
+                    # re-grant catches up) or genuine capacity
+                    # exhaustion — tolerate a generous streak before
+                    # stopping with the backlog reported
+                    empty_streak += 1
+                    if empty_streak > 200:
+                        break
+                else:
+                    empty_streak = 0
+            with lat_lock:
+                lat.extend(out)
+
+        def churn_shard0():
+            # depose shard 0's leader mid-run; re-grant under the
+            # next epoch after the pause — the backlog catches up
+            time.sleep(max(churn_stamp, 0.001))
+            new_epoch = fences[0].advance()
+            time.sleep(churn_pause_s)
+            scheds[0].grant_leadership(new_epoch)
+
+        if isolated:
+            walls = []
+            for si in range(n_shards):
+                t0 = time.perf_counter()
+                cth = None
+                if churn_stamp is not None and si == 0:
+                    # churn is timed against shard 0's own clock — the
+                    # other shards' solo runs are unaffected, exactly as
+                    # a real per-shard leader flap would be
+                    cth = threading.Thread(target=churn_shard0)
+                    cth.start()
+                pump_shard(si, t0)
+                if cth is not None:
+                    cth.join()
+                walls.append(time.perf_counter() - t0)
+            wall = max(walls)
+        else:
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=pump_shard, args=(si, t0))
+                for si in range(n_shards)
+            ]
+            if churn_stamp is not None:
+                threads.append(threading.Thread(target=churn_shard0))
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+        backlog = sum(st.backlog() for st in streams)
+    return lat, backlog, len(lat), wall
+
+
+def bench_latency_stream_sharded():
+    """PR 6 acceptance scenario: aggregate pods/s scaling with shard
+    count at ≥10x the single-leader arrival rate (latency_stream drives
+    3k pods/s into ONE leader; this drives 30k/s across shards), with
+    p99 and backlog reported under leader churn vs steady state. Every
+    shard runs the full HA configuration — per-shard fence + write-ahead
+    journal on the commit path."""
+    import jax
+
+    cpu_dev = jax.devices("cpu")[0]
+    out = {"scenario": "latency_stream_sharded"}
+    runs = []
+    AGG_RATE = 30_000.0  # 10x latency_stream_10k's 3k pods/s
+    for n_shards in (1, 2, 4):
+        # warmup pass on a throwaway budget: the adaptive-batch pump
+        # hits partial-chunk jit specializations the static warmup can't
+        # enumerate — standard warmup-pass discipline (see _measure), so
+        # compile time never lands in the measured wall/p99
+        _sharded_stream_run(
+            cpu_dev, n_shards, rate=AGG_RATE, n_target=1200, isolated=True
+        )
+        lat, backlog, bound, wall = _sharded_stream_run(
+            cpu_dev, n_shards, rate=AGG_RATE, n_target=6000, isolated=True
+        )
+        p50, p99 = _percentiles([l / 1e3 for l in lat])
+        runs.append(
+            {
+                "backend": "cpu_colocated_proxy",
+                "shards": n_shards,
+                "aggregate_rate_pods_per_sec": AGG_RATE,
+                "bound": bound,
+                "pods_per_sec": round(bound / wall, 1),
+                "pod_p50_ms": round(p50, 2),
+                "pod_p99_ms": round(p99, 2),
+                "end_backlog": backlog,
+                "mode": "steady",
+            }
+        )
+    # churn arm: same 4-shard process-per-shard config, shard 0's
+    # leader deposed mid-run (epoch advance → fenced commits → re-grant
+    # + catch-up); the aggregate and p99 show the churn cost vs steady
+    lat, backlog, bound, wall = _sharded_stream_run(
+        cpu_dev, 4, rate=AGG_RATE, n_target=6000, churn_at=0.4,
+        isolated=True,
+    )
+    p50, p99 = _percentiles([l / 1e3 for l in lat])
+    runs.append(
+        {
+            "backend": "cpu_colocated_proxy",
+            "shards": 4,
+            "aggregate_rate_pods_per_sec": AGG_RATE,
+            "bound": bound,
+            "pods_per_sec": round(bound / wall, 1),
+            "pod_p50_ms": round(p50, 2),
+            "pod_p99_ms": round(p99, 2),
+            "end_backlog": backlog,
+            "mode": "churn_1_of_4_shards",
+        }
+    )
+    out["runs"] = runs
+    by_shards = {
+        r["shards"]: r for r in runs if r["mode"] == "steady"
+    }
+    out["scaling_note"] = (
+        "aggregate throughput at 10x the single-leader arrival rate, "
+        "process-per-shard projection (wall = slowest shard): "
+        + ", ".join(
+            f"S={s}: {by_shards[s]['pods_per_sec']} pods/s "
+            f"(p99 {by_shards[s]['pod_p99_ms']}ms)"
+            for s in sorted(by_shards)
+        )
+    )
+    out["measurement_note"] = (
+        "process-per-shard timing: each shard's pump is measured ALONE "
+        "(its own arrival share at rate/S, wall = max shard wall) — "
+        "the deployment shape of the partitioned control plane. One "
+        "CPU container cannot host N schedulers concurrently without "
+        "measuring its own contention instead (GIL-serialized host "
+        "path + shared XLA cores), the same single-container caveat "
+        "PR 4's pipelining numbers carry"
+    )
+    return out
+
+
 SCENARIOS = {
     "loadaware": bench_loadaware,
     "numa": bench_numa,
     "device_gang": bench_device_gang,
     "quota_tree": bench_quota_tree,
     "latency_stream": bench_latency_stream,
+    "latency_stream_sharded": bench_latency_stream_sharded,
     "stream_pipelined": bench_stream_pipelined,
     "recovery": bench_recovery,
 }

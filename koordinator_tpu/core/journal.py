@@ -24,11 +24,25 @@ module makes the *scheduler role* crash-safe across processes:
 
 Failure domain (ROADMAP rule): the named chaos point
 ``journal.write_fail`` fires inside :meth:`BindJournal._append`; callers
-see :class:`JournalWriteError` and reject the chunk un-mutated.
+see :class:`JournalWriteError` and reject the chunk un-mutated. A second
+point, ``journal.compact_crash``, fires inside :meth:`BindJournal.compact`
+and simulates a process death mid-compaction: the live log stays intact
+(the rewrite is tmp-file + atomic rename), only a torn temp file is left
+behind, and a fresh store open repairs/ignores it.
+
+Horizontal partitioning (PR 6): a :class:`BindJournal` can be scoped to
+one **shard** (``shard=``) — every record is stamped with the shard id
+and the journal's epoch monotonicity then *is* the shard's fencing
+history, independent of every other shard's. :class:`ClaimTable` is the
+cross-shard arbiter: before a shard's pump may schedule a pod that was
+fanned out to several shards, it must win the pod's claim record —
+first-writer-wins, epoch-fenced per shard — so two shards can never
+bind the same pod.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -105,9 +119,16 @@ class EpochFence:
 
 class MemoryJournalStore:
     """Record list in memory — survives a *simulated* crash (the store
-    object outlives the scheduler it journals for), not a real one."""
+    object outlives the scheduler it journals for), not a real one.
+
+    ``lock`` serializes multi-writer access at the STORE: several
+    BindJournal instances legitimately share one store (the standby-
+    forget pattern journals through a fresh view of the owner's store),
+    and each instance's own lock cannot order their writes against a
+    compaction rewrite."""
 
     def __init__(self) -> None:
+        self.lock = threading.RLock()
         self._records: List[dict] = []
 
     def append(self, record: dict) -> None:
@@ -132,6 +153,15 @@ class FileJournalStore:
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
         self.fsync = fsync
+        #: same multi-writer contract as MemoryJournalStore.lock
+        self.lock = threading.RLock()
+        # a crash mid-compaction leaves a stale (possibly torn) temp file
+        # behind; the atomic-rename discipline means it was never the
+        # journal — drop it so it cannot shadow a later rewrite
+        try:
+            os.unlink(path + ".tmp")
+        except FileNotFoundError:
+            pass
         self._repair_torn_tail()
         self._f = open(path, "a", encoding="utf-8")
 
@@ -193,6 +223,22 @@ class FileJournalStore:
         os.replace(tmp, self.path)
         self._f = open(self.path, "a", encoding="utf-8")
 
+    def simulate_torn_rewrite(self, record: dict) -> None:
+        """Chaos helper (``journal.compact_crash``): model a process
+        death mid-rewrite — half of the checkpoint line reaches the temp
+        file, the live log is untouched, and the dying process never got
+        to the atomic rename. The next open must ignore the orphan."""
+        line = json.dumps(record, separators=(",", ":"))
+        with open(self.path + ".tmp", "w", encoding="utf-8") as f:
+            f.write(line[: max(1, len(line) // 2)])
+            f.flush()
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
     def close(self) -> None:
         self._f.close()
 
@@ -250,21 +296,39 @@ class BindJournal:
         chaos=None,
         writes_counter=None,
         failures_counter=None,
+        shard: Optional[int] = None,
     ):
         self.store = store if store is not None else MemoryJournalStore()
         self.chaos = chaos or NULL_INJECTOR
         #: optional ``journal_writes_total{op}`` / failure counters
         self.writes_counter = writes_counter
         self.failures_counter = failures_counter
+        #: shard this journal is scoped to (None = unsharded deployment);
+        #: stamped on every record so a mixed-store forensic read can
+        #: attribute writers, and epoch monotonicity is then per-shard
+        #: by construction (one journal per shard)
+        self.shard = shard
         self._lock = threading.Lock()
         tail = self.store.load()
         self._seq = max((r.get("seq", 0) for r in tail), default=0)
         self._epoch_high = max((r.get("epoch", 0) for r in tail), default=0)
+        #: appends since the last checkpoint — drives maybe_compact
+        #: without an O(records) store read per cycle
+        self._since_checkpoint = sum(
+            1 for r in tail if r.get("op") != "checkpoint"
+        )
 
     @property
     def epoch_high(self) -> int:
         with self._lock:
             return self._epoch_high
+
+    def _store_lock(self):
+        """The store's multi-writer lock (stores without one — custom
+        backends — fall back to no cross-instance ordering, same as
+        before the lock existed)."""
+        lock = getattr(self.store, "lock", None)
+        return lock if lock is not None else contextlib.nullcontext()
 
     # ---- append side ----
 
@@ -293,12 +357,16 @@ class BindJournal:
                     "op": op,
                     **fields,
                 }
+                if self.shard is not None:
+                    rec["shard"] = int(self.shard)
                 try:
-                    self.store.append(rec)
+                    with self._store_lock():
+                        self.store.append(rec)
                 except OSError as exc:
                     raise JournalWriteError(
                         f"journal append failed: {exc!r}"
                     ) from exc
+                self._since_checkpoint += 1
         except (JournalWriteError, StaleEpochError):
             if self.failures_counter is not None:
                 self.failures_counter.inc()
@@ -380,25 +448,193 @@ class BindJournal:
 
     def compact(self, epoch: Optional[int] = None) -> JournalReplay:
         """Collapse the log to one checkpoint carrying the current live
-        set (called after a successful recovery or on a maintenance
-        sweep so the log does not grow with cluster lifetime)."""
-        rep = self.replay()
-        with self._lock:
-            self._seq += 1
-            self.store.rewrite(
-                [
-                    {
-                        "seq": self._seq,
-                        "epoch": int(
-                            self._epoch_high if epoch is None else epoch
-                        ),
-                        "cycle": -1,
-                        "op": "checkpoint",
-                        "live": {u: dict(e) for u, e in rep.live.items()},
-                    }
-                ]
-            )
+        set (after a successful recovery, from the scheduler run loop via
+        :meth:`maybe_compact`, or on a maintenance sweep so the log does
+        not grow with cluster lifetime). A compaction stamped with an
+        epoch older than one already journaled is refused — a deposed
+        leader must not rewrite the log its successor is appending to.
+
+        Failure domain: the ``journal.compact_crash`` chaos point models
+        a process death mid-rewrite. The live log is untouched (the
+        rewrite is tmp-file + atomic rename, so a crash before the
+        rename loses only the unacknowledged checkpoint); callers see
+        :class:`JournalWriteError` and the next open repairs/ignores the
+        torn temp file."""
+        with self._lock, self._store_lock():
+            # replay INSIDE both locks: another BindJournal instance over
+            # the same store (the standby-forget pattern) may append
+            # between an outside-the-lock replay and the rewrite — the
+            # rewrite would silently erase its acknowledged record. The
+            # store lock orders this read-rewrite against those appends,
+            # and the seq fixup keeps the checkpoint sorting after
+            # records this instance never issued itself.
+            rep = self.replay()
+            if epoch is not None and epoch < self._epoch_high:
+                raise StaleEpochError(
+                    epoch, self._epoch_high, what="compaction epoch"
+                )
+            self._seq = max(self._seq, rep.seq_high) + 1
+            checkpoint = {
+                "seq": self._seq,
+                "epoch": int(self._epoch_high if epoch is None else epoch),
+                "cycle": -1,
+                "op": "checkpoint",
+                "live": {u: dict(e) for u, e in rep.live.items()},
+            }
+            if self.shard is not None:
+                checkpoint["shard"] = int(self.shard)
+            if self.chaos.fire("journal.compact_crash"):
+                torn = getattr(self.store, "simulate_torn_rewrite", None)
+                if torn is not None:
+                    torn(checkpoint)
+                raise JournalWriteError(
+                    "injected crash mid-compaction (torn rewrite)"
+                )
+            try:
+                self.store.rewrite([checkpoint])
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"journal compaction failed: {exc!r}"
+                ) from exc
+            self._since_checkpoint = 0
         return rep
+
+    def maybe_compact(
+        self,
+        epoch: Optional[int] = None,
+        min_records: int = 512,
+        min_bytes: Optional[int] = None,
+    ) -> Optional[JournalReplay]:
+        """Threshold-gated :meth:`compact` for the scheduler run loop
+        (ROADMAP queued follow-on): compacts when at least
+        ``min_records`` records landed since the last checkpoint, or —
+        for stores that report a size — when the log file exceeds
+        ``min_bytes``. Returns the replay when compaction ran, None when
+        below threshold."""
+        with self._lock:
+            due = self._since_checkpoint >= max(1, int(min_records))
+            if not due and min_bytes is not None:
+                size_fn = getattr(self.store, "size_bytes", None)
+                due = size_fn is not None and size_fn() >= min_bytes
+        if not due:
+            return None
+        return self.compact(epoch)
 
     def records(self) -> List[dict]:
         return self.store.load()
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard single-winner claims
+# ---------------------------------------------------------------------------
+
+
+class ClaimConflictError(RuntimeError):
+    """The pod's claim is already held by a different shard — the caller
+    must not schedule it (the winner shard will)."""
+
+
+class ClaimTable:
+    """Single-winner pod→shard claim arbiter (horizontal partitioning).
+
+    A pending pod whose feasible nodes span shards may be fanned out to
+    several shards' queues; before a shard's pump feeds the pod it must
+    :meth:`claim` it. The first claim wins and is durably recorded
+    (``op="claim"`` over the same store API the journals use), every
+    later claim from another shard loses (returns False), and a repeat
+    claim by the winner is idempotent — so two shards can never bind the
+    same pod. Claims are epoch-fenced **per shard**: a claim stamped
+    with an epoch older than the shard's highest already-claimed epoch
+    is refused outright (:class:`StaleEpochError`) — a deposed shard
+    owner cannot grab new work on its way down."""
+
+    def __init__(self, store=None):
+        self.store = store if store is not None else MemoryJournalStore()
+        self._lock = threading.Lock()
+        self._seq = 0
+        #: uid -> winning shard
+        self._winners: Dict[str, int] = {}
+        #: released (GC'd) uids — tombstones, NOT free slots: a release
+        #: happens at pod deletion, but a fanned-out copy of the pod can
+        #: still sit in some backlogged shard's queue; letting that copy
+        #: re-claim a freed uid would re-schedule a dead pod. Tombstone
+        #: GC belongs to claim-table compaction (ROADMAP follow-on).
+        self._settled: set = set()
+        #: shard -> highest epoch ever used to claim
+        self._epoch_high: Dict[int, int] = {}
+        for rec in sorted(self.store.load(), key=lambda r: r.get("seq", 0)):
+            op = rec.get("op")
+            self._seq = max(self._seq, rec.get("seq", 0))
+            if op == "claim":
+                uid, shard = rec.get("uid"), int(rec.get("shard", -1))
+                epoch = int(rec.get("epoch", 0))
+                if uid not in self._settled:
+                    self._winners.setdefault(uid, shard)
+                self._epoch_high[shard] = max(
+                    self._epoch_high.get(shard, 0), epoch
+                )
+            elif op == "claim_release":
+                self._winners.pop(rec.get("uid"), None)
+                self._settled.add(rec.get("uid"))
+
+    def claim(self, uid: str, shard: int, epoch: int) -> bool:
+        """True when ``shard`` owns (or now wins) the pod's claim; False
+        when another shard already won. Raises :class:`StaleEpochError`
+        when ``epoch`` is older than the shard's claim-epoch high — the
+        fencing check every claim flows through."""
+        with self._lock:
+            high = self._epoch_high.get(shard, 0)
+            if epoch < 0 or epoch < high:
+                raise StaleEpochError(epoch, high, what="claim epoch")
+            if uid in self._settled:
+                # the pod was decided AND GC'd — a claim now can only be
+                # a stale fanned-out queue copy; losing it (False) makes
+                # the caller drop the pod, which is correct: it is gone
+                return False
+            held = self._winners.get(uid)
+            if held is not None:
+                return held == shard
+            self._seq += 1
+            rec = {
+                "seq": self._seq,
+                "op": "claim",
+                "uid": uid,
+                "shard": int(shard),
+                "epoch": int(epoch),
+            }
+            try:
+                self.store.append(rec)
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"claim append failed: {exc!r}"
+                ) from exc
+            self._winners[uid] = int(shard)
+            self._epoch_high[shard] = max(high, epoch)
+            return True
+
+    def winner(self, uid: str) -> Optional[int]:
+        with self._lock:
+            return self._winners.get(uid)
+
+    def release(self, uid: str) -> None:
+        """Settle a claim at pod GC: the winner mapping is dropped but
+        the uid is TOMBSTONED, not freed — a stale fanned-out copy of
+        the pod may still sit in a backlogged shard's queue, and letting
+        it re-claim the uid would re-schedule a dead pod. A release is
+        recorded so a reload keeps the tombstone. A uid that was never
+        claimed needs no tombstone: fan-out copies must claim before
+        binding, and only a bound pod can complete — so no stale copy of
+        an unclaimed pod can exist."""
+        with self._lock:
+            if self._winners.pop(uid, None) is None:
+                return
+            self._settled.add(uid)
+            self._seq += 1
+            try:
+                self.store.append(
+                    {"seq": self._seq, "op": "claim_release", "uid": uid}
+                )
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"claim release append failed: {exc!r}"
+                ) from exc

@@ -31,36 +31,91 @@ from .recovery import RecoveryReport, recover_scheduler
 
 
 class LeaderCoordinator:
-    """Election steps + fenced grant/revoke for one scheduler instance."""
+    """Election steps + fenced grant/revoke for one scheduler instance.
+
+    Horizontal partitioning (PR 6) runs ONE coordinator per (incarnation,
+    shard) over the shard's own lease/fence/journal; three hooks make
+    that composition possible without subclassing:
+
+    * ``sched_factory`` — builds the scheduler lazily on takeover (a
+      standby for S shards must not pay S schedulers' worth of resident
+      state up front). It returns ``(sched, pipeline)`` or ``(sched,
+      pipeline, journal)``; ``pipeline`` may be None, and the 3-tuple
+      form supplies the journal recovery replays (required when none
+      was passed at construction).
+    * ``acquire_gate`` — multi-standby election: evaluated before a
+      NON-leader contends for the lease. The sharded election gates each
+      candidate on the rendezvous ranking over live members, so a free
+      shard is taken by its designated successor instead of whoever
+      ticks first (and leadership never thunders). A current leader
+      always renews regardless of the gate.
+    * ``on_loss(drained)`` — teardown hook after a loss drained the
+      pipeline (the sharded runtime detaches its informers and surfaces
+      its queue for re-routing).
+    * ``recovery_pod_filter`` — forwarded to recover_scheduler so a
+      shard owner's quota rebuild only charges pods of its partition.
+    """
 
     def __init__(
         self,
-        sched,
-        elector,
-        fence,
-        journal,
+        sched=None,
+        elector=None,
+        fence=None,
+        journal=None,
         hub=None,
         pipeline=None,
         verify_recovery: bool = True,
         chaos=None,
+        sched_factory=None,
+        acquire_gate=None,
+        on_loss=None,
+        recovery_pod_filter=None,
     ):
+        if sched is None and sched_factory is None:
+            raise ValueError("LeaderCoordinator needs sched or sched_factory")
         self.sched = sched
+        self.sched_factory = sched_factory
         self.elector = elector
         self.fence = fence
         self.journal = journal
         self.hub = hub
         self.pipeline = pipeline
         self.verify_recovery = verify_recovery
+        self.acquire_gate = acquire_gate
+        self.on_loss_cb = on_loss
+        self.recovery_pod_filter = recovery_pod_filter
         self.chaos = chaos or getattr(sched, "chaos", None) or NULL_INJECTOR
         self.leading = False
         #: report of the most recent takeover's recovery
         self.last_recovery: Optional[RecoveryReport] = None
-        sched.extender.health.set("leader", True, "standby (no grant yet)")
+        if sched is not None:
+            sched.extender.health.set(
+                "leader", True, "standby (no grant yet)"
+            )
 
     # ---- transitions ----
 
     def _on_takeover(self) -> None:
         epoch = self.elector.current_epoch() or self.fence.advance()
+        # the factory runs BEFORE the fence adopts the new epoch: a
+        # factory failure then leaves the previous grant un-deposed
+        # (the lease lapses and re-elects) instead of fencing the old
+        # leader with no recovered successor
+        if self.sched_factory is not None:
+            built = self.sched_factory()
+            if len(built) == 3:
+                # (sched, pipeline, journal): the factory supplies the
+                # journal recovery replays — necessarily the SAME
+                # instance the runtime appends to
+                self.sched, self.pipeline, self.journal = built
+            else:
+                self.sched, self.pipeline = built
+        if self.journal is None:
+            raise ValueError(
+                "LeaderCoordinator has no journal to recover from: pass "
+                "journal= at construction or return (sched, pipeline, "
+                "journal) from sched_factory"
+            )
         # the shared fence mirrors the lease's epoch: adopting it is what
         # deposes every older grant at the commit/channel boundaries
         self.fence.adopt(epoch)
@@ -70,15 +125,25 @@ class LeaderCoordinator:
             hub=self.hub,
             epoch=epoch,
             verify=self.verify_recovery,
+            pod_filter=self.recovery_pod_filter,
         )
         self.leading = True
 
     def _on_loss(self, reason: str):
         self.leading = False
-        self.sched.revoke_leadership(f"standby ({reason})")
+        if self.sched is not None:
+            self.sched.revoke_leadership(f"standby ({reason})")
         drained = None
         if self.pipeline is not None:
             drained = self.pipeline.drain_for_handoff()
+        if self.on_loss_cb is not None:
+            self.on_loss_cb(drained)
+        if self.sched_factory is not None:
+            # lazy-construction contract: a standby must not retain the
+            # lost shard's runtime (snapshot, resident device state) —
+            # the next takeover rebuilds it through the factory
+            self.sched = None
+            self.pipeline = None
         return drained
 
     # ---- public surface ----
@@ -97,6 +162,16 @@ class LeaderCoordinator:
             self.elector.release()
             drained = self._on_loss("injected leadership loss")
             return self.leading, drained
+        if (
+            not self.leading
+            and self.acquire_gate is not None
+            and not self.acquire_gate()
+        ):
+            # multi-standby election: another live candidate is the
+            # designated successor for this lease — stand down rather
+            # than race it (the gate is advisory; if the designee dies
+            # the ranking re-points and this candidate contends)
+            return False, None
         ok = self.elector.try_acquire_or_renew()
         if self.leading and not ok:
             # a leader's failed renew means the CAS lost: the record

@@ -85,6 +85,20 @@ def assert_resident_bitexact(sched) -> None:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def _restore_exact_holds(sched, uid: str, node: str, entry: dict) -> None:
+    """Re-install journaled NUMA zone / device-slot holds (PR 6
+    satellite). Idempotent via the managers' restore_hold guards; the
+    journaled indices are authoritative — a fresh allocate() could
+    legally pick DIFFERENT slots than the dead leader did, diverging
+    from the annotations the kubelet already acted on."""
+    numa_hold = entry.get("numa")
+    if numa_hold and sched.numa is not None:
+        sched.numa.restore_hold(uid, node, numa_hold)
+    dev_hold = entry.get("dev")
+    if dev_hold and sched.devices is not None:
+        sched.devices.restore_hold(uid, node, dev_hold)
+
+
 def recover_scheduler(
     sched,
     journal,
@@ -93,6 +107,7 @@ def recover_scheduler(
     verify: bool = True,
     sync_timeout_s: float = 10.0,
     rebuild_quotas: bool = True,
+    pod_filter=None,
 ) -> RecoveryReport:
     """Run the recovery sequence on ``sched`` and (optionally) grant it
     leadership epoch ``epoch`` once the world is provably rebuilt.
@@ -101,7 +116,11 @@ def recover_scheduler(
     leader wrote (its store survived the process); ``hub`` the shared
     :class:`~.statehub.ClusterStateHub` whose informers must re-sync
     first. ``verify=True`` asserts resident-state bit-exactness against
-    a cold re-lower before leadership is granted.
+    a cold re-lower before leadership is granted. ``pod_filter`` scopes
+    the quota rebuild to this scheduler's partition (horizontally
+    partitioned control plane: a shard owner must not charge its quota
+    ledger for pods bound on foreign shards' nodes — those shards'
+    owners rebuild them from their own journals).
     """
     import numpy as np
 
@@ -131,6 +150,8 @@ def recover_scheduler(
             for pod in pods.values():
                 if not pod.spec.node_name:
                     continue
+                if pod_filter is not None and not pod_filter(pod):
+                    continue
                 leaf = quota_name_of(pod)
                 if leaf is not None and sched.quotas.index_of(leaf) is not None:
                     sched.quotas.assign_pod(leaf, pod)
@@ -140,9 +161,13 @@ def recover_scheduler(
             rep.bindings[uid] = node
             if snap.is_assumed(uid):
                 # statehub resync already restored the charge (bound pod
-                # observed); the journal merely confirms it
+                # observed); the journal merely confirms it — but the
+                # exact NUMA zone / device-slot holds are NOT part of
+                # the resync (the informer path only re-charges node
+                # capacity), so re-install them from the journal too
                 snap.confirm_pod(uid)
                 sched._bound_nodes.setdefault(uid, node)
+                _restore_exact_holds(sched, uid, node, entry)
                 rep.reconfirmed += 1
                 continue
             idx = snap.node_id(node)
@@ -165,6 +190,7 @@ def recover_scheduler(
                 ),
             )
             sched._bound_nodes[uid] = node
+            _restore_exact_holds(sched, uid, node, entry)
             leaf = entry.get("quota")
             if (
                 rebuild_quotas

@@ -1,0 +1,536 @@
+"""Horizontally partitioned control plane (PR 6 tentpole).
+
+PR 5 made ONE leader survive crashes; production traffic needs N
+schedulers live at once. This module partitions node ownership into S
+**shards** — each with its own fencing epoch, lease, and write-ahead
+journal — so N scheduler incarnations each own a disjoint shard set and
+run their existing pipelined pumps concurrently, fenced per shard by the
+exact machinery PR 5 built globally:
+
+* :class:`ShardMap` — stable hash partition of node names (and quota
+  names: a quota's pods all route to its HOME shard so one ledger owns
+  the charge).
+* :class:`ShardFabric` — the durable substrate that outlives any
+  incarnation: per-shard :class:`~..core.journal.EpochFence` + journal
+  store + lease lock, the cross-shard :class:`~..core.journal.ClaimTable`
+  and the membership heartbeat table.
+* :class:`ShardedScheduler` — one incarnation. Per shard it runs a
+  :class:`~.ha.LeaderCoordinator` whose ``sched_factory`` builds the
+  shard runtime lazily on takeover (shard-scoped snapshot wired through
+  the statehub's ``node_filter``, a per-shard ``BindJournal``, the
+  pipelined :class:`~..scheduler.stream.StreamScheduler` pump) and whose
+  ``acquire_gate`` implements **multi-standby election**: candidates
+  rank themselves by rendezvous hash over the LIVE membership, so a dead
+  incarnation's shards spread deterministically across survivors instead
+  of dogpiling whoever ticks first.
+* :class:`ShardRouter` — routes a pending pod to the shard owning its
+  feasible nodes (explicit node → that node's shard; quota-labeled →
+  the quota's home shard; otherwise uid hash), optionally fanning out to
+  a spill shard under backlog pressure. Fan-out is safe because every
+  pump feeds a pod only after winning its **single-winner claim**
+  (:class:`~..core.journal.ClaimTable`, epoch-fenced per shard) — two
+  shards can never bind the same pod.
+
+**Shard handoff** is the PR 5 recovery path scoped to one shard: the
+donor drains its pump through the (already revoked) fence, surfaces its
+queue for re-routing, and detaches only its own informers; the new owner
+replays the shard's journal against a fresh shard-scoped snapshot and is
+granted the shard's next epoch only after the resident state proves
+bit-exact. The donor's OTHER shards keep serving throughout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..chaos import NULL_INJECTOR
+from ..core.journal import BindJournal, ClaimTable, EpochFence, StaleEpochError
+from ..utils import stable_hash as _stable_hash
+from ..utils.leaderelection import (
+    LeaderElector,
+    LeaseLockSet,
+    preferred_candidate,
+)
+from .ha import LeaderCoordinator
+
+
+class ShardMap:
+    """Stable partition of node ownership into ``n_shards`` shards."""
+
+    def __init__(self, n_shards: int):
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.n_shards = int(n_shards)
+
+    def shard_of_node(self, node_name: str) -> int:
+        return _stable_hash(f"node|{node_name}") % self.n_shards
+
+    def shard_of_key(self, key: str) -> int:
+        return _stable_hash(f"key|{key}") % self.n_shards
+
+    def node_filter(self, shard: int) -> Callable[[str], bool]:
+        """Predicate scoping a statehub wiring to one shard's nodes."""
+
+        def owned(name: str, _s: int = int(shard)) -> bool:
+            return self.shard_of_node(name) == _s
+
+        return owned
+
+    def partition(self, node_names: Sequence[str]) -> Dict[int, List[str]]:
+        out: Dict[int, List[str]] = {s: [] for s in range(self.n_shards)}
+        for name in node_names:
+            out[self.shard_of_node(name)].append(name)
+        return out
+
+
+class Membership:
+    """Heartbeat table of live scheduler incarnations (the analog of the
+    per-instance presence Lease every control-plane replica keeps). The
+    rendezvous election ranks only LIVE members, so a crashed
+    incarnation drops out of every shard's candidate ranking one TTL
+    after its last heartbeat — exactly when its shard leases start
+    lapsing."""
+
+    def __init__(self, ttl_s: float, clock: Callable[[], float] = _time.time):
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._beats: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def heartbeat(self, member: str) -> None:
+        with self._lock:
+            self._beats[member] = self._clock()
+
+    def alive(self) -> List[str]:
+        now = self._clock()
+        with self._lock:
+            return sorted(
+                m for m, t in self._beats.items() if now - t <= self.ttl_s
+            )
+
+    def forget(self, member: str) -> None:
+        with self._lock:
+            self._beats.pop(member, None)
+
+
+class ShardFabric:
+    """The durable substrate of a partitioned control plane — everything
+    that must outlive any single scheduler incarnation: per-shard
+    fences, journal stores and lease locks, the cross-shard claim table,
+    and the membership heartbeat table. In-process this is one shared
+    object; a real deployment backs the same shapes with files/leases."""
+
+    def __init__(
+        self,
+        n_shards: int,
+        clock: Callable[[], float] = _time.time,
+        journal_stores: Optional[Dict[int, object]] = None,
+        claim_store=None,
+        membership_ttl_s: float = 3.0,
+    ):
+        from ..core.journal import MemoryJournalStore
+
+        self.shard_map = ShardMap(n_shards)
+        self.n_shards = int(n_shards)
+        self.clock = clock
+        self.fences: Dict[int, EpochFence] = {
+            s: EpochFence() for s in range(n_shards)
+        }
+        self.journal_stores: Dict[int, object] = journal_stores or {
+            s: MemoryJournalStore() for s in range(n_shards)
+        }
+        self.locks = LeaseLockSet()
+        self.claims = ClaimTable(claim_store)
+        self.membership = Membership(membership_ttl_s, clock=clock)
+
+    def shard_lease_lock(self, shard: int):
+        return self.locks.lock(f"shard-{int(shard)}")
+
+
+class ShardRouter:
+    """Routes pending pods to shards.
+
+    * explicit ``spec.node_name`` → that node's shard (its only feasible
+      node lives there);
+    * quota-labeled → the quota's HOME shard (one ledger owns the
+      charge; reservations/quotas crossing shards are exactly why the
+      fast-path journal exception had to close);
+    * otherwise → uid hash, optionally fanned out to a spill shard when
+      the primary's backlog exceeds ``spill_backlog`` — safe because the
+      pumps' single-winner claim arbitrates feed time.
+    """
+
+    def __init__(
+        self,
+        shard_map: ShardMap,
+        quota_of=None,
+        spill_backlog: Optional[int] = None,
+    ):
+        self.shard_map = shard_map
+        if quota_of is None:
+            from ..scheduler.plugins.elasticquota import quota_name_of
+
+            quota_of = quota_name_of
+        self.quota_of = quota_of
+        self.spill_backlog = spill_backlog
+
+    def route(self, pod) -> int:
+        if pod.spec.node_name:
+            return self.shard_map.shard_of_node(pod.spec.node_name)
+        leaf = self.quota_of(pod)
+        if leaf is not None:
+            return self.shard_map.shard_of_key(f"quota:{leaf}")
+        return self.shard_map.shard_of_key(pod.meta.uid)
+
+    def targets(self, pod, backlog_of=None) -> List[int]:
+        """Shards to enqueue the pod on: ``[primary]`` normally,
+        ``[primary, spill]`` when the primary is backlogged and the pod
+        is free to move (not quota-homed, not node-pinned)."""
+        primary = self.route(pod)
+        if (
+            self.spill_backlog is None
+            or backlog_of is None
+            or self.shard_map.n_shards < 2
+            or pod.spec.node_name
+            or self.quota_of(pod) is not None
+            or backlog_of(primary) < self.spill_backlog
+        ):
+            return [primary]
+        spill = (primary + 1) % self.shard_map.n_shards
+        return [primary, spill]
+
+
+@dataclass
+class ShardRuntime:
+    """One shard being served by one incarnation."""
+
+    shard: int
+    sched: object
+    stream: object
+    informers: list
+    node_filter: Callable[[str], bool]
+
+
+@dataclass
+class ShardHandoff:
+    """What a donor surfaces when a shard's ownership leaves it."""
+
+    shard: int
+    #: decisions the drain still produced (fence held → real decisions)
+    decided: List[Tuple[object, Optional[str], float]] = field(
+        default_factory=list
+    )
+    #: (pod, arrival, tries) entries for the new owner's queue
+    queued: List[Tuple[object, float, int]] = field(default_factory=list)
+
+
+class ShardedScheduler:
+    """One scheduler incarnation of a horizontally partitioned control
+    plane: elects per-shard, builds shard runtimes lazily on takeover,
+    pumps every owned shard each cycle, and hands shards off — queue
+    intact, fence respected — when the rendezvous ranking or a lost
+    lease says so.
+
+    ``make_scheduler(shard, snapshot, fence, journal)`` builds the
+    shard-scoped BatchScheduler (the caller owns quotas/devices/numa
+    wiring); everything else — statehub informers, stream pump,
+    election, recovery — is composed here.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        hub,
+        fabric: ShardFabric,
+        make_scheduler,
+        pipelined: bool = True,
+        max_batch: int = 256,
+        max_retries: int = 8,
+        lease_duration: float = 3.0,
+        renew_deadline: float = 2.0,
+        retry_period: float = 0.5,
+        verify_recovery: bool = True,
+        chaos=None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.name = name
+        self.hub = hub
+        self.fabric = fabric
+        self.make_scheduler = make_scheduler
+        self.pipelined = pipelined
+        self.max_batch = max_batch
+        self.max_retries = max_retries
+        self.verify_recovery = verify_recovery
+        self.chaos = chaos or NULL_INJECTOR
+        self.clock = clock or fabric.clock
+        self.dead = False
+        self._runtimes: Dict[int, ShardRuntime] = {}
+        self._handoffs: Dict[int, ShardHandoff] = {}
+        self.stats = {
+            "takeovers": 0,
+            "handoffs": 0,
+            "claims_lost": 0,
+        }
+        self._coords: Dict[int, LeaderCoordinator] = {}
+        for s in range(fabric.n_shards):
+            elector = LeaderElector(
+                fabric.shard_lease_lock(s),
+                identity=name,
+                lease_duration=lease_duration,
+                renew_deadline=renew_deadline,
+                retry_period=retry_period,
+                now_fn=self.clock,
+                sleep_fn=lambda _dt: None,
+            )
+            self._coords[s] = LeaderCoordinator(
+                sched_factory=self._factory(s),
+                elector=elector,
+                fence=fabric.fences[s],
+                # no eager journal: _factory installs the runtime's own
+                # BindJournal before recovery ever reads it, and an eager
+                # instance would pay a full store.load() per (incarnation,
+                # shard) at construction for nothing
+                hub=hub,
+                verify_recovery=verify_recovery,
+                chaos=self.chaos,
+                acquire_gate=self._gate(s),
+                on_loss=self._teardown(s),
+                recovery_pod_filter=self._pod_filter(s),
+            )
+
+    # ---- per-shard closures ----
+
+    def _factory(self, shard: int):
+        def build():
+            rt = self._build_runtime(shard)
+            # 3-tuple: recovery replays through the SAME journal
+            # instance the runtime appends to (fresh view over the
+            # shared store); pipeline None — the stream drains its own
+            return rt.sched, None, rt.sched.bind_journal
+
+        return build
+
+    def _gate(self, shard: int):
+        def designated() -> bool:
+            alive = set(self.fabric.membership.alive())
+            alive.add(self.name)
+            return (
+                preferred_candidate(alive, f"shard-{shard}") == self.name
+            )
+
+        return designated
+
+    def _pod_filter(self, shard: int):
+        flt = self.fabric.shard_map.node_filter(shard)
+
+        def owned(pod) -> bool:
+            return bool(pod.spec.node_name) and flt(pod.spec.node_name)
+
+        return owned
+
+    def _teardown(self, shard: int):
+        def on_loss(_drained) -> None:
+            rt = self._runtimes.pop(shard, None)
+            if rt is None:
+                return
+            handoff = self._handoffs.setdefault(shard, ShardHandoff(shard))
+            # the stream drains its pipeline through the revoked fence
+            # (speculation discarded, trailing commit rejected with
+            # STALE_LEADER_EPOCH) and requeues without burning retries
+            handoff.decided.extend(rt.stream.drain_for_handoff())
+            handoff.queued.extend(rt.stream.extract_queued())
+            rt.stream.close()
+            # only THIS shard's informers die; the incarnation's other
+            # shards keep serving
+            self.hub.detach(rt.informers)
+            self.stats["handoffs"] += 1
+
+        return on_loss
+
+    def _build_runtime(self, shard: int) -> ShardRuntime:
+        from ..core.snapshot import ClusterSnapshot
+
+        flt = self.fabric.shard_map.node_filter(shard)
+        snap = ClusterSnapshot()
+        journal = BindJournal(
+            self.fabric.journal_stores[shard], chaos=self.chaos, shard=shard
+        )
+        sched = self.make_scheduler(
+            shard=shard,
+            snapshot=snap,
+            fence=self.fabric.fences[shard],
+            journal=journal,
+        )
+        informers = self.hub.wire_scheduler(sched, node_filter=flt)
+        self.hub.start()
+        stream_cls = self._stream_cls()
+        stream = stream_cls(
+            sched,
+            max_batch=self.max_batch,
+            max_retries=self.max_retries,
+            pipelined=self.pipelined,
+            feed_gate=lambda pod, _s=shard: self._claim(_s, pod),
+        )
+        rt = ShardRuntime(
+            shard=shard,
+            sched=sched,
+            stream=stream,
+            informers=informers,
+            node_filter=flt,
+        )
+        self._runtimes[shard] = rt
+        return rt
+
+    @staticmethod
+    def _stream_cls():
+        from ..scheduler.stream import StreamScheduler
+
+        return StreamScheduler
+
+    def _claim(self, shard: int, pod) -> bool:
+        """Single-winner claim at feed time, stamped with OUR held epoch
+        for the shard. Returns False ONLY when another shard genuinely
+        won the pod's claim (safe to drop — the winner schedules it).
+        A deposed owner's stamp raises :class:`StaleEpochError` instead,
+        which the stream's batch collection treats as "keep the pod
+        queued for the handoff": nobody else holds an unclaimed pod, so
+        dropping it here would lose it forever."""
+        rt = self._runtimes.get(shard)
+        if rt is None:
+            raise StaleEpochError(-1, 0, what="claim epoch")
+        won = self.fabric.claims.claim(
+            pod.meta.uid, shard, rt.sched._fence_epoch
+        )
+        if not won:
+            self.stats["claims_lost"] += 1
+        return won
+
+    # ---- public surface ----
+
+    def owned(self) -> List[int]:
+        return sorted(
+            s for s, c in self._coords.items() if c.leading
+        )
+
+    def owns(self, shard: int) -> bool:
+        return self._coords[shard].leading
+
+    def runtime(self, shard: int) -> Optional[ShardRuntime]:
+        return self._runtimes.get(shard)
+
+    def last_recovery(self, shard: int):
+        return self._coords[shard].last_recovery
+
+    def backlog(self, shard: int) -> int:
+        rt = self._runtimes.get(shard)
+        return rt.stream.backlog() if rt is not None else 0
+
+    def tick(self) -> Dict[int, ShardHandoff]:
+        """One election step across every shard: heartbeat, renew owned
+        leases, voluntarily hand off shards whose rendezvous-designated
+        owner is someone else alive, contend (gated) for free shards.
+        Returns the handoffs surfaced this tick — their queued pods are
+        the router's to re-place."""
+        if self.dead:
+            return {}
+        self.fabric.membership.heartbeat(self.name)
+        for s, coord in self._coords.items():
+            if coord.leading and not self._gate(s)():
+                # rebalance: a preferred live candidate exists (e.g. a
+                # restarted incarnation rejoined) — voluntary handoff
+                coord.step_down()
+                continue
+            was = coord.leading
+            coord.tick()
+            if coord.leading and not was:
+                self.stats["takeovers"] += 1
+        out, self._handoffs = self._handoffs, {}
+        return out
+
+    def submit(self, shard: int, pod, now: Optional[float] = None) -> bool:
+        rt = self._runtimes.get(shard)
+        if rt is None or not self._coords[shard].leading:
+            return False
+        rt.stream.submit(pod, now=now)
+        return True
+
+    def resubmit(
+        self, shard: int, pod, arrival: float, tries: int
+    ) -> bool:
+        """Handoff path: enqueue with the original arrival stamp/retry
+        budget from the donor's queue."""
+        rt = self._runtimes.get(shard)
+        if rt is None or not self._coords[shard].leading:
+            return False
+        rt.stream.resubmit(pod, arrival, tries)
+        return True
+
+    def pump(self) -> List[Tuple[int, object, Optional[str], float]]:
+        """One pump over every owned shard (deterministic shard order).
+        Returns ``(shard, pod, node|None, latency)`` decisions.
+
+        Decided pods' claims are deliberately NOT released here: a
+        fanned-out pod may still sit in another shard's queue, and a
+        released claim would let that stale copy re-claim and
+        double-schedule it. The driver releases at pod deletion (the
+        apiserver GC analog) — and even then the ClaimTable keeps a
+        TOMBSTONE, because a backlogged queue can hold a copy past the
+        pod's GC; a post-release claim loses, so the copy is dropped."""
+        decided: List[Tuple[int, object, Optional[str], float]] = []
+        for s in sorted(self._runtimes):
+            rt = self._runtimes[s]
+            for pod, node, lat in rt.stream.pump():
+                decided.append((s, pod, node, lat))
+        return decided
+
+    def flush(self) -> List[Tuple[int, object, Optional[str], float]]:
+        decided: List[Tuple[int, object, Optional[str], float]] = []
+        for s in sorted(self._runtimes):
+            rt = self._runtimes[s]
+            for pod, node, lat in rt.stream.flush():
+                decided.append((s, pod, node, lat))
+        return decided
+
+    def kill(self) -> List[Tuple[int, object]]:
+        """Simulated process death: every runtime's state dies WITHOUT a
+        drain (no handoff — that is the point), informers are detached
+        (the watches died with the process), leases are left to lapse.
+        Returns ``(shard, pod)`` for every pod that was queued in the
+        dead pumps — the driver reconciles them against the journals
+        once the shards' new owners recover."""
+        orphans: List[Tuple[int, object]] = []
+        for s, rt in sorted(self._runtimes.items()):
+            for pod, _arr, _tries in rt.stream.extract_queued():
+                orphans.append((s, pod))
+            rt.stream.close()
+            self.hub.detach(rt.informers)
+            self._coords[s].leading = False
+            self._coords[s].sched = None
+            self._coords[s].pipeline = None
+        self._runtimes.clear()
+        self._handoffs.clear()
+        self.dead = True
+        self.fabric.membership.forget(self.name)
+        return orphans
+
+    def close(self) -> Dict[int, ShardHandoff]:
+        """Graceful shutdown: step down from every owned shard (lease
+        RELEASED — successors take over immediately instead of waiting
+        out the TTL the way a crash forces), leave the membership, and
+        tear everything down. Returns the final handoffs — their queued
+        pods are the router's to re-place — so a graceful close never
+        strands work the way :meth:`kill` deliberately does."""
+        for s, coord in sorted(self._coords.items()):
+            if coord.leading:
+                coord.step_down()  # releases the lease; on_loss drains
+        for rt in self._runtimes.values():
+            rt.stream.close()
+            self.hub.detach(rt.informers)
+        self._runtimes.clear()
+        self.fabric.membership.forget(self.name)
+        self.dead = True
+        out, self._handoffs = self._handoffs, {}
+        return out

@@ -77,6 +77,11 @@ _RETRYABLE_ERRORS = (ChannelUnavailable, ChannelTimeout)
 #: metadata key carrying the caller's fencing epoch (the proto stays
 #: unchanged — fencing is transport-level, like an authz header)
 EPOCH_METADATA_KEY = "x-leader-epoch"
+#: metadata key scoping the epoch to ONE shard (PR 6, horizontally
+#: partitioned control plane): each shard's epoch history is
+#: independent, so the server keeps a per-shard high watermark — shard
+#: 3's takeover must not fence shard 1's still-live owner
+SHARD_METADATA_KEY = "x-shard-id"
 
 
 def _map_rpc_error(call: str, exc: grpc.RpcError) -> ChannelError:
@@ -134,33 +139,52 @@ class SolverService:
         #: read the solver's world after its successor has spoken.
         #: Callers without the metadata (non-HA deployments) pass freely.
         self.leader_epoch = 0
+        #: per-shard epoch high watermarks (PR 6): calls carrying
+        #: x-shard-id are fenced against THEIR shard's history only
+        self.shard_epochs: dict = {}
 
     def _check_epoch(self, call: str, ctx) -> None:
         """Adopt/enforce the caller's fencing epoch from gRPC metadata.
         Must be called under ``self._lock`` so adopt-vs-refuse is atomic
-        with the guarded mutation."""
+        with the guarded mutation. A call scoped with
+        ``x-shard-id`` fences against that shard's own watermark — the
+        per-shard analog of the global check."""
         if ctx is None:
             return
         raw = None
+        raw_shard = None
         try:
             for k, v in ctx.invocation_metadata() or ():
                 if k == EPOCH_METADATA_KEY:
                     raw = v
-                    break
+                elif k == SHARD_METADATA_KEY:
+                    raw_shard = v
         except TypeError:
             return
         if raw is None:
             return
         try:
             epoch = int(raw)
+            shard = int(raw_shard) if raw_shard is not None else None
         except (TypeError, ValueError):
-            # a PRESENT but unparseable epoch must not pass unfenced —
-            # the caller claims to be epoch-guarded, so an unprovable
-            # claim is rejected, not waved through
+            # a PRESENT but unparseable epoch/shard must not pass
+            # unfenced — the caller claims to be epoch-guarded, so an
+            # unprovable claim is rejected, not waved through
             ctx.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                f"{call}: malformed {EPOCH_METADATA_KEY} {raw!r}",
+                f"{call}: malformed fencing metadata "
+                f"epoch={raw!r} shard={raw_shard!r}",
             )
+        if shard is not None:
+            high = self.shard_epochs.get(shard, 0)
+            if epoch < high:
+                ctx.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"{call}: stale leader epoch {epoch} for shard "
+                    f"{shard} (current {high})",
+                )
+            self.shard_epochs[shard] = epoch
+            return
         if epoch < self.leader_epoch:
             ctx.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
@@ -388,6 +412,9 @@ class SolverClient:
         #: was bypassed (two independent layers, like journal fencing).
         self.fence = fence
         self.epoch: Optional[int] = None
+        #: shard scoping for the stamped epoch (PR 6): when set, the
+        #: server fences this client against ITS shard's watermark only
+        self.shard: Optional[int] = None
         self._channel = grpc.insecure_channel(target)
         self._sync = self._channel.unary_unary(
             f"/{SERVICE_NAME}/Sync",
@@ -405,10 +432,20 @@ class SolverClient:
             response_deserializer=pb.SolverConfig.FromString,
         )
 
-    def set_epoch(self, epoch: Optional[int]) -> None:
+    _SHARD_UNSET = object()
+
+    def set_epoch(self, epoch: Optional[int], shard=_SHARD_UNSET) -> None:
         """Adopt the leadership epoch this client's calls carry (None =
-        un-fenced, the non-HA default)."""
+        un-fenced, the non-HA default). ``shard`` scopes the epoch to
+        one shard's fencing history (PR 6): the server then compares it
+        against that shard's high watermark instead of the global one.
+        Omitting ``shard`` PRESERVES the current scoping — a re-granted
+        shard owner calling the PR 5-style ``set_epoch(epoch)`` must not
+        silently fall back to the global watermark; pass ``shard=None``
+        explicitly to clear the scope."""
         self.epoch = epoch
+        if shard is not SolverClient._SHARD_UNSET:
+            self.shard = shard
 
     def _call(self, name: str, stub, req):
         chaos = self.chaos
@@ -423,11 +460,11 @@ class SolverClient:
                     f"{name}: injected RPC drop", None
                 )
             chaos.fire(f"channel.{name}.delay")
-            md = (
-                ((EPOCH_METADATA_KEY, str(self.epoch)),)
-                if self.epoch is not None
-                else None
-            )
+            md = None
+            if self.epoch is not None:
+                md = ((EPOCH_METADATA_KEY, str(self.epoch)),)
+                if self.shard is not None:
+                    md += ((SHARD_METADATA_KEY, str(self.shard)),)
             try:
                 return stub(req, timeout=self.timeout_s, metadata=md)
             except grpc.RpcError as exc:

@@ -118,20 +118,39 @@ class ClusterStateHub:
 
     # ---- consume side ----
 
-    def wire_snapshot(self, snap) -> List[Informer]:
+    def wire_snapshot(self, snap, node_filter=None) -> List[Informer]:
         """Node + NodeMetric informers feeding a ClusterSnapshot — the
-        minimal consumer set (manager/descheduler binaries)."""
+        minimal consumer set (manager/descheduler binaries).
+
+        ``node_filter`` (PR 6, horizontal partitioning): a predicate on
+        node NAME scoping this snapshot to one shard of the cluster —
+        nodes (and their metrics) outside the shard never enter it, so
+        a shard owner's resident state is exactly its partition."""
         lock = snap.lock
+
+        def _owned(name: str) -> bool:
+            return node_filter is None or node_filter(name)
+
+        def _node_upsert(_k, o):
+            if _owned(o.meta.name):
+                snap.upsert_node(o)
+
+        def _node_delete(_k, o):
+            if _owned(o.meta.name):
+                snap.remove_node(o.meta.name)
+
         node_inf = self._informer(self.nodes, 'nodes')
         node_inf.add_handlers(
-            on_add=_locked(lock, lambda k, o: snap.upsert_node(o)),
-            on_update=_locked(lock, lambda k, o: snap.upsert_node(o)),
-            on_delete=_locked(lock, lambda k, o: snap.remove_node(o.meta.name)),
+            on_add=_locked(lock, _node_upsert),
+            on_update=_locked(lock, _node_upsert),
+            on_delete=_locked(lock, _node_delete),
         )
 
         metric_inf = self._informer(self.node_metrics, 'node_metrics')
 
         def _metric(_k, m):
+            if not _owned(m.meta.name):
+                return
             snap.set_node_metric(
                 m,
                 now=(m.update_time + 1 if m.update_time else _time.time()),
@@ -147,19 +166,36 @@ class ClusterStateHub:
         return informers
 
     def wire_scheduler(
-        self, sched, reservations=None, include_snapshot: bool = True
+        self,
+        sched,
+        reservations=None,
+        include_snapshot: bool = True,
+        node_filter=None,
     ) -> List[Informer]:
         """Informers driving a BatchScheduler's full component set. The
         returned informers are registered but not started — call
         :meth:`start`. ``include_snapshot=False`` when
-        :meth:`wire_snapshot` already wired this scheduler's snapshot."""
+        :meth:`wire_snapshot` already wired this scheduler's snapshot.
+
+        ``node_filter`` (PR 6): scopes the wiring to one shard — nodes,
+        node metrics, per-node devices/topologies and pods BOUND on
+        foreign nodes are skipped entirely (a foreign bind parked in
+        ``pending_binds`` would otherwise leak forever: its node never
+        arrives in this snapshot). Unbound pods are shard-agnostic at
+        this layer; routing decides who schedules them."""
         snap = sched.snapshot
+
+        def _owned(name) -> bool:
+            return node_filter is None or (
+                name is not None and node_filter(name)
+            )
+
         #: wire_snapshot self-registers; ``extras`` are registered at the
         #: end of this method — the returned list carries both
         informers: List[Informer] = []
         extras: List[Informer] = []
         if include_snapshot:
-            informers.extend(self.wire_snapshot(snap))
+            informers.extend(self.wire_snapshot(snap, node_filter))
 
         pod_inf = self._informer(self.pods, 'pods')
         #: binds observed before their node (the pod and node informers
@@ -168,6 +204,8 @@ class ClusterStateHub:
         pending_binds: dict = {}
 
         def _pod_upsert(_k, pod):
+            if pod.spec.node_name and not _owned(pod.spec.node_name):
+                return  # bound on a foreign shard — its owner tracks it
             # a pod observed bound (spec.nodeName set): if this scheduler
             # already assumed it, the bind CONFIRMS the existing charge
             # (estimates/amplification intact — the reference cache's
@@ -197,6 +235,8 @@ class ClusterStateHub:
                     reservations.ingest_operating_pod(pod)
 
         def _pod_delete(_k, pod):
+            if pod.spec.node_name and not _owned(pod.spec.node_name):
+                return  # foreign shard's bind — its owner releases it
             # full release across every component that may hold state for
             # the pod (scheduler cache RemovePod + plugin unreserve)
             pending_binds.pop(pod.meta.uid, None)
@@ -244,27 +284,43 @@ class ClusterStateHub:
 
         if sched.devices is not None:
             dev_inf = self._informer(self.devices, 'devices')
+
+            def _dev(fn):
+                # Device CRs are named by node — shard-scoped like nodes
+                def h(_k, d):
+                    if _owned(d.meta.name):
+                        fn(d)
+
+                return h
+
             dev_inf.add_handlers(
-                on_add=_locked(lock, lambda k, d: sched.devices.upsert_device(d)),
-                on_update=_locked(lock, lambda k, d: sched.devices.upsert_device(d)),
+                on_add=_locked(lock, _dev(sched.devices.upsert_device)),
+                on_update=_locked(lock, _dev(sched.devices.upsert_device)),
                 on_delete=_locked(
-                    lock, lambda k, d: sched.devices.remove_device(d.meta.name)
+                    lock,
+                    _dev(lambda d: sched.devices.remove_device(d.meta.name)),
                 ),
             )
             extras.append(dev_inf)
 
         if sched.numa is not None:
             topo_inf = self._informer(self.topologies, 'topologies')
+
+            def _topo(fn):
+                def h(_k, t):
+                    if _owned(t.meta.name):
+                        fn(t)
+
+                return h
+
             topo_inf.add_handlers(
-                on_add=_locked(
-                    lock, lambda k, t: sched.numa.register_from_topology(t)
-                ),
+                on_add=_locked(lock, _topo(sched.numa.register_from_topology)),
                 on_update=_locked(
-                    lock, lambda k, t: sched.numa.register_from_topology(t)
+                    lock, _topo(sched.numa.register_from_topology)
                 ),
                 on_delete=_locked(
                     lock,
-                    lambda k, t: sched.numa.unregister_node(t.meta.name),
+                    _topo(lambda t: sched.numa.unregister_node(t.meta.name)),
                 ),
             )
             extras.append(topo_inf)
@@ -372,6 +428,25 @@ class ClusterStateHub:
             inf.stop()
         self.informers = []
         self._snapshot_node_informers.clear()
+
+    def detach(self, informers: List[Informer]) -> None:
+        """Detach ONE consumer's informer set (PR 6: a shard handoff or
+        a single incarnation's death must not sever every other live
+        incarnation's watches the way :meth:`detach_consumers` does).
+        The listed informers are stopped and dropped from the hub's
+        registry — including the snapshot-node index — while everything
+        else keeps running."""
+        doomed = set(map(id, informers))
+        for inf in informers:
+            inf.stop()
+        self.informers = [
+            inf for inf in self.informers if id(inf) not in doomed
+        ]
+        self._snapshot_node_informers = {
+            k: inf
+            for k, inf in self._snapshot_node_informers.items()
+            if id(inf) not in doomed
+        }
 
     def wait_synced(self, timeout: float = 10.0) -> bool:
         """Block until every informer observed its tracker's current rv

@@ -104,6 +104,10 @@ _gc_lock = _threading.Lock()
 _gc_depth = 0
 _gc_was_enabled = False
 
+#: cache-miss sentinel for the reservation fast path's pre-pass match
+#: cache (None is a legitimate cached value: "no reservation matches")
+_PREMATCH_MISS = object()
+
 
 def _gc_pause() -> None:
     import gc
@@ -329,6 +333,8 @@ class BatchScheduler:
         intern_pods: bool = True,
         journal=None,
         fence=None,
+        journal_compact_records: Optional[int] = None,
+        journal_compact_bytes: Optional[int] = None,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -508,6 +514,12 @@ class BatchScheduler:
         self.bind_journal = journal
         self.fence = fence
         self._fence_epoch = 0
+        #: periodic journal compaction from the run loop (PR 6
+        #: satellite, ROADMAP queued follow-on): after a clean cycle,
+        #: compact once at least this many records (or bytes, for file
+        #: stores) accumulated since the last checkpoint. None = never.
+        self.journal_compact_records = journal_compact_records
+        self.journal_compact_bytes = journal_compact_bytes
         if journal is not None:
             reg = self.extender.registry
             if journal.writes_counter is None:
@@ -959,7 +971,13 @@ class BatchScheduler:
             _gc_pause()
         try:
             with self.snapshot.lock:
-                return self._traced_cycle(pending, _retry)
+                out = self._traced_cycle(pending, _retry)
+                if not _retry:
+                    # run-loop journal maintenance (PR 6 satellite):
+                    # threshold-gated compaction under the same lock the
+                    # commits hold, so a checkpoint never races a chunk
+                    self._maybe_compact_journal()
+                return out
         finally:
             if pause_gc:
                 _gc_resume()
@@ -1083,12 +1101,83 @@ class BatchScheduler:
             self.reservations.begin_cycle()
             remaining_pending = []
             affinity_unsched: List[Pod] = []
+            # HA (PR 6 satellite — the fast path's journal exception is
+            # CLOSED): ONE batched write-ahead intent for the whole fast
+            # path, from a read-only match pre-pass BEFORE any mutation
+            # (per-pod intent+bind pairs cost 2K fsyncs per cycle where
+            # _commit pays two per chunk). The planned list may overshoot:
+            # an earlier pod's allocation can steal a later pod's match,
+            # and the eventual bind node may differ from the nominated
+            # one — safe, because replay builds the live set from bind
+            # records alone; intents only mark crash-mid-commit windows.
+            fast_path_refused = False
+            # the pre-pass result doubles as a match CACHE for the bind
+            # loop (the per-pod match scan is the cost begin_cycle exists
+            # to amortize — running it twice per pod would give that
+            # back). Reuse is decision-identical ONLY until the first
+            # successful bind of the cycle: a bind swaps the ghost's hold
+            # for the owner's (possibly smaller) charge, so node free
+            # capacity can INCREASE, flipping a rival reservation's
+            # spill feasibility — after that, matches must be fresh.
+            # Failed attempts restore state exactly and invalidate
+            # nothing. Steady-state cycles with no fast-path bind keep
+            # the single scan they had before the batched intent.
+            prematch: Dict[str, object] = {}
+            prematch_valid = True
+            if self.bind_journal is not None:
+                planned_fast = []
+                for pod in pending:
+                    if gang_key_of(pod) is not None:
+                        continue
+                    r0 = self.reservations.match(pod)
+                    prematch[pod.meta.uid] = r0
+                    if r0 is not None and r0.node_name is not None:
+                        planned_fast.append((pod.meta.uid, r0.node_name))
+                if planned_fast:
+                    try:
+                        self.bind_journal.append_intent(
+                            self._fence_epoch,
+                            self.extender.current_cycle_id,
+                            planned_fast,
+                        )
+                    except (JournalWriteError, StaleEpochError) as exc:
+                        report_exception(
+                            "scheduler.journal.reservation",
+                            exc,
+                            registry=self.extender.registry,
+                        )
+                        self._cycle_journal_failed = True
+                        self.extender.health.set(
+                            "commit",
+                            False,
+                            f"reservation intent journal refused: {exc!r}",
+                        )
+                        fast_path_refused = True
+            if fast_path_refused:
+                # same outcome as every matched pod's own append having
+                # been refused: nothing mutates, required-affinity pods
+                # stay unschedulable, the rest take the solver path
+                # (whose journal boundary holds while the store is down)
+                for pod in pending:
+                    required = (
+                        ext.parse_reservation_affinity(pod.meta.annotations)
+                        is not None
+                    )
+                    (
+                        affinity_unsched if required else remaining_pending
+                    ).append(pod)
+                pending = []
             for pod in pending:
-                r = (
-                    self.reservations.match(pod)
-                    if gang_key_of(pod) is None
-                    else None
-                )
+                if gang_key_of(pod) is not None:
+                    r = None
+                else:
+                    r = (
+                        prematch.get(pod.meta.uid, _PREMATCH_MISS)
+                        if prematch_valid
+                        else _PREMATCH_MISS
+                    )
+                    if r is _PREMATCH_MISS:
+                        r = self.reservations.match(pod)
                 # required reservation affinity: the pod may ONLY run
                 # from a matching reservation — no fallthrough to normal
                 # node scheduling, even when the match's Reserve fails
@@ -1156,6 +1245,41 @@ class BatchScheduler:
                     self.reservations.reacquire_ghost_holds(r)
                     retry_queue.append(pod)
                     continue
+                # the bind record IS the acknowledgement (same contract
+                # as _commit): it lands BEFORE the reservation ledger /
+                # quota charge, while the unwind is still trivial — a
+                # refused write releases the assume + holds, re-arms the
+                # ghost, and the pod falls through to the solver path.
+                # A crash after this record replays the bind; the ghost
+                # swap + owner ledger rebuild from the reservation
+                # resync (ingest_operating_pod / informers).
+                if self.bind_journal is not None:
+                    try:
+                        self.bind_journal.append_bind(
+                            self._fence_epoch,
+                            self.extender.current_cycle_id,
+                            self._journal_bind_entries([(pod, node)]),
+                        )
+                    except (JournalWriteError, StaleEpochError) as exc:
+                        report_exception(
+                            "scheduler.journal.reservation",
+                            exc,
+                            registry=self.extender.registry,
+                        )
+                        self._cycle_journal_failed = True
+                        self.extender.health.set(
+                            "commit",
+                            False,
+                            f"reservation bind journal refused: {exc!r}",
+                        )
+                        self.snapshot.forget_pod(pod.meta.uid)
+                        if self.devices is not None:
+                            self.devices.release(pod.meta.uid, node)
+                        if self.numa is not None:
+                            self.numa.release(pod.meta.uid, node)
+                        self.reservations.reacquire_ghost_holds(r)
+                        retry_queue.append(pod)
+                        continue
                 self.reservations.allocate(r, pod)
                 if leaf is not None:
                     self.quotas.assign_pod(leaf, pod)
@@ -1163,33 +1287,8 @@ class BatchScheduler:
                 self._bound_pods[pod.meta.uid] = pod
                 pod.meta.annotations.update(patch)
                 reserved_bound.append((pod, node))
+                prematch_valid = False
             pending = remaining_pending
-            if self.bind_journal is not None and reserved_bound:
-                # reservation fast-path binds are acknowledged the moment
-                # this cycle returns them, so they must reach the journal
-                # too. Unlike _commit this records post-assume (the holds
-                # span reservation ghost state the Reserve journal does
-                # not model); a refused write degrades loudly and the
-                # immediate bind publish + statehub re-list is the
-                # recovery backstop for these entries.
-                try:
-                    self.bind_journal.append_bind(
-                        self._fence_epoch,
-                        cid,
-                        self._journal_bind_entries(reserved_bound),
-                    )
-                except (JournalWriteError, StaleEpochError) as exc:
-                    report_exception(
-                        "scheduler.journal.reservation",
-                        exc,
-                        registry=self.extender.registry,
-                    )
-                    self._cycle_journal_failed = True
-                    self.extender.health.set(
-                        "commit",
-                        False,
-                        f"reservation bind journal refused: {exc!r}",
-                    )
         else:
             affinity_unsched = []
 
@@ -2986,6 +3085,42 @@ class BatchScheduler:
 
     # ---- HA: commit-boundary fencing + write-ahead journal helpers ----
 
+    def _maybe_compact_journal(self) -> None:
+        """Threshold-gated journal compaction after a clean cycle. A
+        failure — including the ``journal.compact_crash`` chaos point's
+        simulated mid-rewrite death — is reported and swallowed: the
+        live log is intact by construction (tmp-file + atomic rename),
+        so a failed compaction only defers maintenance."""
+        jnl = self.bind_journal
+        if jnl is None or (
+            self.journal_compact_records is None
+            and self.journal_compact_bytes is None
+        ):
+            return
+        if self.fence is not None and self._fence_epoch < 0:
+            return  # revoked: maintenance is the current leader's job
+        try:
+            rep = jnl.maybe_compact(
+                epoch=(
+                    self._fence_epoch if self.fence is not None else None
+                ),
+                min_records=(
+                    self.journal_compact_records
+                    if self.journal_compact_records is not None
+                    else (1 << 62)
+                ),
+                min_bytes=self.journal_compact_bytes,
+            )
+        except (JournalWriteError, StaleEpochError) as exc:
+            report_exception(
+                "scheduler.journal.compact",
+                exc,
+                registry=self.extender.registry,
+            )
+            return
+        if rep is not None:
+            self.extender.registry.get("journal_compactions_total").inc()
+
     def _fence_stale(self) -> Optional[str]:
         """None when this scheduler's leadership grant is current (or no
         fence is wired); otherwise a human-readable staleness detail.
@@ -3016,21 +3151,32 @@ class BatchScheduler:
             ap = assumed.get(pod.meta.uid)
             if ap is None:  # defensive: permit raced a forget
                 continue
-            entries.append(
-                {
-                    "uid": pod.meta.uid,
-                    "node": node,
-                    "req": [float(x) for x in ap.request],
-                    "est": [float(x) for x in ap.estimate],
-                    "prod": bool(ap.is_prod),
-                    "nom": float(ap.bind_nominal_cpu),
-                    "conf": bool(ap.confirmed),
-                    # leaf quota (None = unlabeled): recovery re-charges
-                    # the quota chain for replayed entries without
-                    # needing the pod object back
-                    "quota": quota_name_of(pod),
-                }
-            )
+            entry = {
+                "uid": pod.meta.uid,
+                "node": node,
+                "req": [float(x) for x in ap.request],
+                "est": [float(x) for x in ap.estimate],
+                "prod": bool(ap.is_prod),
+                "nom": float(ap.bind_nominal_cpu),
+                "conf": bool(ap.confirmed),
+                # leaf quota (None = unlabeled): recovery re-charges
+                # the quota chain for replayed entries without
+                # needing the pod object back
+                "quota": quota_name_of(pod),
+            }
+            # exact NUMA zone / device-slot holds (PR 6 satellite): a
+            # replay restores the CHOSEN zone, cpuset and minors
+            # bit-exactly — a re-lower can rebuild capacity totals but
+            # not which slots were picked
+            if self.numa is not None:
+                numa_hold = self.numa.hold_of(pod.meta.uid, node)
+                if numa_hold:
+                    entry["numa"] = numa_hold
+            if self.devices is not None:
+                dev_hold = self.devices.hold_of(pod.meta.uid, node)
+                if dev_hold:
+                    entry["dev"] = dev_hold
+            entries.append(entry)
         return entries
 
     def _reject_chunk_journal(

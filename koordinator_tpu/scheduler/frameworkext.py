@@ -182,6 +182,12 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "assumed/bound charges re-installed from the bind journal on "
         "warm-standby takeover or crash restart",
     )
+    reg.counter(
+        "journal_compactions_total",
+        "run-loop journal compactions (threshold-gated checkpoint "
+        "rewrites; failed/crashed attempts are NOT counted — the live "
+        "log is intact and the next threshold retries)",
+    )
     ensure_exceptions_counter(reg)
     return reg
 

@@ -1050,3 +1050,79 @@ class DeviceManager:
                 st.rdma_free[minor] = min(st.rdma_free[minor] + pct, FULL)
         for minor, pct in st.fpga_owners.pop(pod_uid, []):
             st.fpga_free[minor] = min(st.fpga_free[minor] + pct, FULL)
+
+    # ---- exact-hold journal coverage (HA PR 6 satellite) ----
+
+    def hold_of(self, pod_uid: str, node_name: str) -> Optional[dict]:
+        """JSON-serializable snapshot of the pod's exact device hold —
+        concrete GPU minors (+share/core pct), RDMA minors (+VF), FPGA
+        minors — for the write-ahead bind journal, so a takeover
+        restores the EXACT slots via :meth:`restore_hold` instead of
+        re-picking (a re-pick could legally choose different minors and
+        silently diverge from the annotations the kubelet already
+        acted on)."""
+        st = self._nodes.get(node_name)
+        if st is None:
+            return None
+        gpu = st.owners.get(pod_uid)
+        rdma = st.rdma_owners.get(pod_uid)
+        fpga = st.fpga_owners.get(pod_uid)
+        if not gpu and not rdma and not fpga:
+            return None
+        hold: dict = {}
+        if gpu:
+            hold["gpu"] = [[int(m), float(p), float(c)] for m, p, c in gpu]
+        if rdma:
+            hold["rdma"] = [[int(m), float(p), vf] for m, p, vf in rdma]
+        if fpga:
+            hold["fpga"] = [[int(m), float(p)] for m, p in fpga]
+        return hold
+
+    def restore_hold(self, pod_uid: str, node_name: str, hold: dict) -> None:
+        """Re-install a journaled device hold on a recovering instance
+        (idempotent: a pod already owning slots on this node is left
+        alone — the statehub resync may have re-registered it first)."""
+        st = self._nodes.get(node_name)
+        if st is None:
+            return
+        if (
+            pod_uid in st.owners
+            or pod_uid in st.rdma_owners
+            or pod_uid in st.fpga_owners
+        ):
+            return
+        self._mark_dirty(node_name)
+        gpu = [
+            (int(m), float(p), float(c))
+            for m, p, c in hold.get("gpu", ())
+            if int(m) < len(st.gpu_free)
+        ]
+        if gpu:
+            for m, p, c in gpu:
+                st.gpu_free[m] = max(st.gpu_free[m] - p, 0.0)
+                st.gpu_core_free[m] = max(st.gpu_core_free[m] - c, 0.0)
+            st.owners[pod_uid] = gpu
+        rdma = [
+            (int(m), float(p), vf)
+            for m, p, vf in hold.get("rdma", ())
+            if int(m) < len(st.rdma_free)
+        ]
+        if rdma:
+            for m, p, vf in rdma:
+                if vf is not None:
+                    try:
+                        st.rdma_vfs[m].remove(vf)
+                    except ValueError:
+                        pass
+                else:
+                    st.rdma_free[m] = max(st.rdma_free[m] - p, 0.0)
+            st.rdma_owners[pod_uid] = rdma
+        fpga = [
+            (int(m), float(p))
+            for m, p in hold.get("fpga", ())
+            if int(m) < len(st.fpga_free)
+        ]
+        if fpga:
+            for m, p in fpga:
+                st.fpga_free[m] = max(st.fpga_free[m] - p, 0.0)
+            st.fpga_owners[pod_uid] = fpga

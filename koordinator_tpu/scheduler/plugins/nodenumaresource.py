@@ -761,3 +761,50 @@ class NUMAManager:
             used = st.zone_used[zone]
             for d in range(ZONE_DIMS):
                 used[d] -= req[d]
+
+    # ---- exact-hold journal coverage (HA PR 6 satellite) ----
+
+    def hold_of(self, pod_uid: str, node_name: str) -> Optional[dict]:
+        """JSON-serializable snapshot of the pod's exact NUMA hold —
+        zone charge (amplified request vector + bind-nominal CPU) and
+        exclusive cpuset — for the write-ahead bind journal, so a
+        takeover restores the hold bit-exactly via :meth:`restore_hold`
+        instead of relying on a re-lower (which cannot recover WHICH
+        zone/cpus were chosen)."""
+        st = self._nodes.get(node_name)
+        if st is None:
+            return None
+        entry = st.owners.get(pod_uid)
+        cpus = st.accumulator.cpuset_of(pod_uid)
+        if entry is None and not cpus:
+            return None
+        hold: dict = {}
+        if entry is not None:
+            zone, req, nominal = entry
+            hold["zone"] = int(zone)
+            hold["zreq"] = [float(x) for x in req]
+            hold["znom"] = float(nominal)
+        if cpus:
+            hold["cpus"] = sorted(int(c) for c in cpus)
+        return hold
+
+    def restore_hold(self, pod_uid: str, node_name: str, hold: dict) -> None:
+        """Re-install a journaled hold on a recovering instance
+        (idempotent: a pod already holding on this node is left alone —
+        the statehub resync may have re-registered it first)."""
+        st = self._nodes.get(node_name)
+        if st is None:
+            return
+        if pod_uid in st.owners or st.accumulator.cpuset_of(pod_uid):
+            return
+        self._mark_dirty(node_name)
+        cpus = hold.get("cpus")
+        if cpus:
+            st.accumulator.take_reserved(pod_uid, {int(c) for c in cpus})
+        zone = int(hold.get("zone", -1))
+        if zone >= 0 and zone < len(st.zone_used):
+            req = [float(x) for x in hold.get("zreq", [0.0] * ZONE_DIMS)]
+            used = st.zone_used[zone]
+            for d in range(min(ZONE_DIMS, len(req))):
+                used[d] += req[d]
+            st.owners[pod_uid] = (zone, req, float(hold.get("znom", 0.0)))

@@ -52,10 +52,16 @@ class StreamScheduler:
         max_retries: int = 3,
         pipelined: bool = False,
         prepare_timeout_s: float = 5.0,
+        feed_gate=None,
     ):
         self.scheduler = scheduler
         self.max_batch = max_batch
         self.max_retries = max_retries
+        #: optional predicate evaluated as queued pods are popped into a
+        #: cycle's batch (PR 6: the cross-shard single-winner CLAIM — a
+        #: pod fanned out to several shards' queues is fed only by the
+        #: shard that wins its claim; losers drop it here, silently)
+        self.feed_gate = feed_gate
         self._queue: Deque[Tuple[Pod, float, int]] = deque()
         self._pipe = None
         #: uid -> (arrival stamp, tries) for pods inside the pipeline
@@ -90,22 +96,34 @@ class StreamScheduler:
             return self._pump_pipelined()
         if not self._queue:
             return []
-        batch: List[Tuple[Pod, float, int]] = []
-        for _ in range(min(self.max_batch, len(self._queue))):
-            batch.append(self._queue.popleft())
+        batch = self._next_batch()
+        if not batch:
+            # every popped pod was claim-dropped (another shard won) or
+            # the feed gate went stale — don't burn a full scheduler
+            # cycle on zero pods
+            return []
         meta = {p.meta.uid: (t, tries) for p, t, tries in batch}
         with self.scheduler.extender.tracer.span(
             "pump", cat="scheduler", batch=len(batch)
         ) as sp:
             out = self.scheduler.schedule([p for p, _t, _n in batch])
             t_done = _time.perf_counter()
+            fenced = self._fenced_now()
             results: List[Tuple[Pod, Optional[str], float]] = []
             for pod, node in out.bound:
                 t_arr, _tries = meta[pod.meta.uid]
                 results.append((pod, node, t_done - t_arr))
             for pod in out.unschedulable:
                 t_arr, tries = meta[pod.meta.uid]
-                if tries + 1 < self.max_retries:
+                if fenced:
+                    # a fencing rejection is not a scheduling verdict:
+                    # the cycle ran under a revoked/superseded grant, so
+                    # the pod re-queues WITHOUT burning its retry budget
+                    # (same rule drain_for_handoff applies) — otherwise
+                    # leader churn terminally fails pods that were never
+                    # genuinely evaluated
+                    self._queue.append((pod, t_arr, tries))
+                elif tries + 1 < self.max_retries:
                     self._queue.append((pod, t_arr, tries + 1))
                 else:
                     results.append((pod, None, t_done - t_arr))
@@ -116,14 +134,45 @@ class StreamScheduler:
             )
         return results
 
+    def _next_batch(self) -> List[Tuple[Pod, float, int]]:
+        """Pop up to ``max_batch`` queue entries, dropping pods that fail
+        the ``feed_gate`` (their claim belongs to another shard — the
+        winner schedules them; this queue simply forgets them).
+
+        A gate that raises :class:`StaleEpochError` means OUR claim
+        authority is gone (this shard's owner was deposed), which is
+        very different from losing one pod's claim: nobody else holds
+        these pods, so dropping them would lose them forever. The item
+        goes back to the queue — intact, for the handoff — and batch
+        collection stops (the whole queue is under the same dead
+        epoch)."""
+        from ..core.journal import StaleEpochError
+
+        batch: List[Tuple[Pod, float, int]] = []
+        while len(batch) < self.max_batch and self._queue:
+            item = self._queue.popleft()
+            if self.feed_gate is not None:
+                try:
+                    admitted = self.feed_gate(item[0])
+                except StaleEpochError:
+                    self._queue.appendleft(item)
+                    break
+                if not admitted:
+                    continue
+            batch.append(item)
+        return batch
+
     # ---- pipelined mode ----
 
     def _pump_pipelined(self) -> List[Tuple[Pod, Optional[str], float]]:
         if not self._queue and not self._pipe.inflight:
             return []
-        batch: List[Tuple[Pod, float, int]] = []
-        for _ in range(min(self.max_batch, len(self._queue))):
-            batch.append(self._queue.popleft())
+        batch = self._next_batch()
+        if not batch and not self._pipe.inflight:
+            # nothing to feed and nothing in flight to absorb (the queue
+            # was non-empty but every pod was claim-dropped or the gate
+            # went stale) — skip the empty cycle
+            return []
         with self.scheduler.extender.tracer.span(
             "pump", cat="scheduler", batch=len(batch), pipelined=True
         ) as sp:
@@ -137,19 +186,41 @@ class StreamScheduler:
             )
         return results
 
+    def _fenced_now(self) -> bool:
+        """True while the underlying scheduler's leadership grant is
+        revoked or superseded — its rejections this cycle are fencing
+        artifacts, not scheduling verdicts (no retry charge). Read-only:
+        must NOT go through ``_fence_stale`` (that evaluates the
+        ``leader.stale_commit`` chaos point, which belongs to the commit
+        boundary)."""
+        sched = self.scheduler
+        if sched.fence is None:
+            return False
+        from ..core.journal import StaleEpochError
+
+        try:
+            sched.fence.check(sched._fence_epoch)
+        except StaleEpochError:
+            return True
+        return False
+
     def _absorb(
         self, out: Optional[ScheduleOutcome]
     ) -> List[Tuple[Pod, Optional[str], float]]:
         if out is None:
             return []
         t_done = _time.perf_counter()
+        fenced = self._fenced_now()
         results: List[Tuple[Pod, Optional[str], float]] = []
         for pod, node in out.bound:
             t_arr, _tries = self._inflight_meta.pop(pod.meta.uid)
             results.append((pod, node, t_done - t_arr))
         for pod in out.unschedulable:
             t_arr, tries = self._inflight_meta.pop(pod.meta.uid)
-            if tries + 1 < self.max_retries:
+            if fenced:
+                # fencing rejection ≠ scheduling verdict: no retry charge
+                self._queue.append((pod, t_arr, tries))
+            elif tries + 1 < self.max_retries:
                 self._queue.append((pod, t_arr, tries + 1))
             else:
                 results.append((pod, None, t_done - t_arr))
@@ -179,6 +250,22 @@ class StreamScheduler:
             self._queue.append((pod, t_arr, tries))
         return results
 
+    def extract_queued(self) -> List[Tuple[Pod, float, int]]:
+        """Shard handoff (PR 6): hand the ENTIRE queue — arrival stamps
+        and retry counts intact — to the caller, emptying it. Used when
+        a shard's ownership moves to another scheduler incarnation: the
+        donor's queued pods are re-routed to the new owner, keeping
+        their latency clocks running (the north-star latency is
+        enqueue→bind, and a handoff is not an enqueue)."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
+    def resubmit(self, pod: Pod, arrival: float, tries: int) -> None:
+        """Re-enqueue a pod handed off from another incarnation's queue
+        with its original arrival stamp and retry budget."""
+        self._queue.append((pod, arrival, tries))
+
     def flush(self) -> List[Tuple[Pod, Optional[str], float]]:
         """Drain everything: pump until the queue is empty, then complete
         the pipeline's in-flight cycle(s). Retried pods cycle back through
@@ -186,11 +273,20 @@ class StreamScheduler:
         results: List[Tuple[Pod, Optional[str], float]] = []
         if self._pipe is None:
             while self._queue:
-                results.extend(self.pump())
+                res = self.pump()
+                results.extend(res)
+                if not res and self._fenced_now():
+                    # revoked grant: every cycle re-queues the whole
+                    # batch (no retry charge) — the queue is the next
+                    # leader's to drain, not ours to spin on
+                    return results
             return results
         while True:
             while self._queue:
-                results.extend(self.pump())
+                res = self.pump()
+                results.extend(res)
+                if not res and self._fenced_now():
+                    return results
             results.extend(self._absorb(self._pipe.flush()))
             if not self._queue and not self._pipe.inflight:
                 return results

@@ -573,6 +573,8 @@ def run_chaos_soak(
     use_channel: bool = True,
     verbose: bool = False,
     ha: bool = False,
+    shards: int = 0,
+    incarnations: int = 3,
 ) -> dict:
     """Longrun chaos soak: hundreds of scheduling cycles under a seeded
     random fault schedule, asserting the failure-domain invariants the
@@ -613,7 +615,28 @@ def run_chaos_soak(
     verification. Additional HA invariants: every journal-acknowledged
     binding survives the crash (zero lost), no pod is ever placed twice
     across incarnations, and the leaderless gap only defers.
+
+    ``shards=S`` (PR 6, horizontally partitioned control plane) selects
+    the MULTI-SHARD arm instead: ``incarnations`` (3+) concurrently-live
+    :class:`~koordinator_tpu.runtime.shards.ShardedScheduler` instances
+    partition node ownership across S shards — per-shard leases, epochs
+    and journals, rendezvous multi-standby election, voluntary shard
+    handoffs on membership change, leader flaps, and one kill-restart
+    mid-schedule whose lost-ack window is recovered per shard — keeping
+    zero-duplicate / zero-lost-acknowledged / per-shard bit-exact
+    resident-state asserts green with same-seed-same-trace determinism.
     """
+    if shards:
+        return _run_sharded_soak(
+            cycles=cycles,
+            seed=seed,
+            n_nodes=n_nodes,
+            max_arrivals=max_arrivals,
+            drain_limit=drain_limit,
+            verbose=verbose,
+            shards=shards,
+            incarnations=incarnations,
+        )
     import random as _random
 
     import numpy as np
@@ -1230,4 +1253,544 @@ def run_chaos_soak(
             level="1"
         ),
     }
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Multi-shard chaos soak (PR 6: horizontally partitioned control plane)
+# ---------------------------------------------------------------------------
+
+
+def _run_sharded_soak(
+    cycles: int,
+    seed: int,
+    n_nodes: int,
+    max_arrivals: int,
+    drain_limit: int,
+    verbose: bool,
+    shards: int,
+    incarnations: int,
+) -> dict:
+    """The multi-shard arm of :func:`run_chaos_soak`: N concurrently-live
+    fenced scheduler incarnations partition node ownership across S
+    shards (per-shard lease + epoch + journal), with rendezvous
+    multi-standby election, voluntary shard handoffs, seeded leader
+    flaps, one mid-commit chunk crash and one kill-restart mid-schedule
+    whose lost-ack window is recovered per shard from the journals.
+
+    Invariants (asserted inside, per cycle or at the end):
+
+    * no pod is ever placed twice — across shards AND incarnations
+      (every pump feeds through the single-winner claim table);
+    * zero lost acknowledged bindings per shard (each shard journal's
+      live set ⊆ the driver's placed ledger, node-exact);
+    * quota never exceeded at its HOME shard's ledger;
+    * per-owned-runtime snapshot accounting never drifts; resident
+      device state bit-exact at every takeover (inside recovery) and at
+      the end;
+    * deletions on an OWNERLESS shard are journaled fence-exempt by the
+      observer (the driver here; a standby in a real deployment) — the
+      PR 5 standby-forget rule generalized per shard;
+    * same seed ⇒ same fault trace.
+    """
+    import random as _random
+
+    import numpy as np
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        ElasticQuota,
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.chaos import FaultInjector
+    from koordinator_tpu.core.journal import BindJournal
+    from koordinator_tpu.runtime.shards import (
+        ShardFabric,
+        ShardRouter,
+        ShardedScheduler,
+    )
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        GroupQuotaManager,
+    )
+
+    assert incarnations >= 2 and shards >= 2
+    ALLOC_CPU, ALLOC_MEM = 32_000.0, 128 * 1024.0
+    POD_CPU, POD_MEM = 2_000.0, 4_096.0
+    LIFETIME = 6
+    MAX_BATCH = 16
+    rng = _random.Random(seed)
+    rng_ha = _random.Random(seed ^ 0x51F15EED)
+
+    chaos = FaultInjector(seed=seed)
+    sim_cycle = [0]
+
+    def _clock() -> float:
+        return float(sim_cycle[0])
+
+    fabric = ShardFabric(shards, clock=_clock, membership_ttl_s=2.5)
+    hub = ClusterStateHub(chaos=chaos)
+    node_names = [f"n{i:03d}" for i in range(n_nodes)]
+    for name in node_names:
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: ALLOC_CPU,
+                        ext.RES_MEMORY: ALLOC_MEM,
+                    }
+                ),
+            ),
+        )
+    # every shard must own at least one node, else its owner's recovery
+    # has no world to verify against — with hashed partitioning this is
+    # a property of (names, S); assert it up front so a failure is loud
+    part = fabric.shard_map.partition(node_names)
+    assert all(part[s] for s in range(shards)), (
+        f"shard partition left an empty shard: "
+        f"{ {s: len(v) for s, v in part.items()} }"
+    )
+
+    q_pods = max(6, (2 * max_arrivals * LIFETIME) // 5)
+    quota_max = {
+        ext.RES_CPU: q_pods * POD_CPU,
+        ext.RES_MEMORY: q_pods * POD_MEM,
+    }
+    quota_min = {ext.RES_CPU: 2 * POD_CPU, ext.RES_MEMORY: 2 * POD_MEM}
+    hub.publish(
+        hub.quotas,
+        ElasticQuota(
+            meta=ObjectMeta(name="soak-team"),
+            min=dict(quota_min),
+            max=dict(quota_max),
+        ),
+    )
+    home_shard = fabric.shard_map.shard_of_key("quota:soak-team")
+
+    def make_scheduler(shard, snapshot, fence, journal):
+        gqm = GroupQuotaManager(snapshot.config, enable_preemption=False)
+        s = BatchScheduler(
+            snapshot,
+            LoadAwareArgs(usage_thresholds={}),
+            quotas=gqm,
+            batch_bucket=MAX_BATCH,
+            chaos=chaos,
+            journal=journal,
+            fence=fence,
+        )
+        s.extender.monitor.stop_background()
+        chaos.bind_counter(s.extender.registry.get("fault_injected_total"))
+        return s
+
+    def _make_incarnation(idx: int, gen: int) -> ShardedScheduler:
+        return ShardedScheduler(
+            f"inc{idx}-gen{gen}",
+            hub,
+            fabric,
+            make_scheduler,
+            pipelined=True,
+            max_batch=MAX_BATCH,
+            max_retries=8,
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            retry_period=0.5,
+            chaos=chaos,
+        )
+
+    incs = [_make_incarnation(i, 0) for i in range(incarnations)]
+    # everyone heartbeats BEFORE the first election step so the initial
+    # rendezvous ranking sees the full membership (otherwise the first
+    # ticker grabs every shard and immediately hands most back)
+    for inc in incs:
+        fabric.membership.heartbeat(inc.name)
+    router = ShardRouter(fabric.shard_map)
+
+    stats = {
+        "cycles": 0,
+        "arrived": 0,
+        "placed": 0,
+        "completed": 0,
+        "takeovers": 0,
+        "handoffs": 0,
+        "claims_lost": 0,
+        "crash_restarts": 0,
+        "recovered_bindings": 0,
+        "driver_forgets": 0,
+        "shard_cycles_without_owner": 0,
+        "faults": {},
+    }
+    placed: dict = {}          # uid -> node, forever (duplicate guard)
+    pod_by_uid: dict = {}
+    live: list = []            # (pod, node, done_cycle)
+    pending: list = []         # fresh/unrouted pods
+    pending_handoff: list = [] # (shard, pod, arrival, tries)
+    inflight: dict = {}        # uid -> (pod, shard, inc_name)
+    orphans: list = []         # (pod, shard) from the kill
+    pod_seq = 0
+    crash_cycle = max(2, cycles // 3)
+    restart_cycle = max(6, (3 * cycles) // 5)
+    quota_max_vec = None
+
+    def _owner_of(shard: int):
+        for inc in incs:
+            if not inc.dead and inc.owns(shard):
+                return inc
+        return None
+
+    def _place(pod, node, shard):
+        assert pod.meta.uid not in placed, (
+            f"pod {pod.meta.name} placed twice: "
+            f"{placed[pod.meta.uid]} then {node} (shard {shard})"
+        )
+        # shard-correctness: the binding must land on a node the shard
+        # owns — a cross-shard bind would mean the fencing/claim
+        # machinery let a foreign owner mutate this partition
+        assert fabric.shard_map.shard_of_node(node) == shard, (
+            f"{pod.meta.name} bound on {node} by shard {shard}"
+        )
+        placed[pod.meta.uid] = node
+        pod.spec.node_name = node
+        hub.publish(hub.pods, pod)
+        live.append((pod, node, sim_cycle[0] + LIFETIME))
+        stats["placed"] += 1
+
+    def _absorb_decided(inc, decided, acknowledged: bool = True):
+        for shard, pod, node, _lat in decided:
+            inflight.pop(pod.meta.uid, None)
+            if not acknowledged:
+                # the lost-ack window: the bind record is journaled but
+                # the process died before the bind API write went out —
+                # the takeover's replay must recover it, never re-place
+                orphans.append((pod, shard))
+                continue
+            if node is not None:
+                _place(pod, node, shard)
+            else:
+                # terminally unschedulable: re-enter the backlog (the
+                # soak's contract is eventual placement; capacity always
+                # frees as pods complete)
+                pending.append(pod)
+
+    def _absorb_handoffs(inc, handoffs):
+        for shard, hand in sorted(handoffs.items()):
+            stats["handoffs"] += 1
+            for pod, node, _lat in hand.decided:
+                inflight.pop(pod.meta.uid, None)
+                if node is not None:
+                    _place(pod, node, shard)
+                else:
+                    pending.append(pod)
+            for pod, arr, tries in hand.queued:
+                inflight.pop(pod.meta.uid, None)
+                pending_handoff.append((shard, pod, arr, tries))
+
+    total_cycles = cycles + drain_limit
+    for cycle in range(total_cycles):
+        sim_cycle[0] = cycle
+        stats["cycles"] += 1
+
+        # ---- seeded fault schedule (stops at `cycles`; drain is clean) ----
+        doomed = None
+        if cycle < cycles:
+            if rng_ha.random() < 0.05:
+                chaos.arm("leader.lost", times=1)      # per-shard flap
+            if cycle == crash_cycle:
+                chaos.arm("commit.crash", error=RuntimeError, times=1)
+            if cycle == restart_cycle:
+                # the incarnation owning the most shards dies THIS cycle,
+                # right after its pumps journaled their trailing commits
+                alive = [i for i in incs if not i.dead]
+                doomed = max(
+                    alive, key=lambda i: (len(i.owned()), i.name)
+                )
+
+        # ---- arrivals ----
+        arriving = []
+        if cycle < cycles:
+            n_arr = rng.randint(1, max_arrivals)
+            if cycle == restart_cycle - 1:
+                # surge: the doomed incarnation's trailing commit at the
+                # kill cycle must carry real binds (the lost-ack window)
+                n_arr += 3 * MAX_BATCH
+            for _ in range(n_arr):
+                pod_seq += 1
+                labels = {}
+                if pod_seq % 5 == 0:
+                    labels[ext.LABEL_QUOTA_NAME] = "soak-team"
+                pod = Pod(
+                    meta=ObjectMeta(
+                        name=f"soak-{pod_seq:05d}", labels=labels
+                    ),
+                    spec=PodSpec(
+                        requests={
+                            ext.RES_CPU: POD_CPU,
+                            ext.RES_MEMORY: POD_MEM,
+                        },
+                        priority=9000 if pod_seq % 3 else 5500,
+                    ),
+                )
+                arriving.append(pod)
+                pod_by_uid[pod.meta.uid] = pod
+            stats["arrived"] += len(arriving)
+        pending.extend(arriving)
+
+        # ---- election step on every live incarnation ----
+        for inc in incs:
+            if inc.dead:
+                continue
+            _absorb_handoffs(inc, inc.tick())
+
+        # ---- orphan reconciliation (after the kill): an ACKNOWLEDGED
+        # (journaled) binding is recovered from the shard's takeover
+        # replay — never re-placed; the rest re-enter the shard's queue
+        if orphans:
+            still_orphaned = []
+            for pod, shard in orphans:
+                if pod.meta.uid in placed:
+                    continue
+                owner = _owner_of(shard)
+                if owner is None:
+                    still_orphaned.append((pod, shard))
+                    continue
+                rec = owner.last_recovery(shard)
+                bindings = rec.bindings if rec is not None else {}
+                node = bindings.get(pod.meta.uid)
+                if node is not None:
+                    _place(pod, node, shard)
+                    stats["recovered_bindings"] += 1
+                else:
+                    pending_handoff.append((shard, pod, float(cycle), 0))
+            orphans = still_orphaned
+
+        # ---- routing: handoff pods back to their shard's new owner,
+        # fresh pods to their routed shard; ownerless shards defer ----
+        still_handoff = []
+        for shard, pod, arr, tries in pending_handoff:
+            owner = _owner_of(shard)
+            if owner is not None and owner.resubmit(shard, pod, arr, tries):
+                inflight[pod.meta.uid] = (pod, shard, owner.name)
+            else:
+                still_handoff.append((shard, pod, arr, tries))
+        pending_handoff = still_handoff
+        still_pending = []
+        for pod in pending:
+            shard = router.route(pod)
+            owner = _owner_of(shard)
+            if owner is not None and owner.submit(
+                shard, pod, now=float(cycle)
+            ):
+                inflight[pod.meta.uid] = (pod, shard, owner.name)
+            else:
+                still_pending.append(pod)
+        pending = still_pending
+        for s in range(shards):
+            if _owner_of(s) is None:
+                stats["shard_cycles_without_owner"] += 1
+
+        # ---- pump every owned shard on every live incarnation ----
+        for inc in incs:
+            if inc.dead:
+                continue
+            decided = inc.pump()
+            _absorb_decided(
+                inc, decided, acknowledged=(inc is not doomed)
+            )
+
+        # ---- the kill-restart: state dies, leases lapse, a fresh
+        # generation joins and the rendezvous ranking rebalances ----
+        if doomed is not None:
+            stats["crash_restarts"] += 1
+            for shard, pod in doomed.kill():
+                inflight.pop(pod.meta.uid, None)
+                orphans.append((pod, shard))
+            # pods fed into the dead pipelines (decided by nobody now)
+            for uid, (pod, shard, inc_name) in list(inflight.items()):
+                if inc_name == doomed.name:
+                    inflight.pop(uid)
+                    orphans.append((pod, shard))
+            # fold the doomed incarnation's counters into the run ledger
+            # NOW — the end-of-run sweep only sees survivors, and the
+            # doomed instance is by construction the one that performed
+            # the most initial takeovers
+            stats["takeovers"] += doomed.stats["takeovers"]
+            stats["claims_lost"] += doomed.stats["claims_lost"]
+            idx = incs.index(doomed)
+            incs[idx] = _make_incarnation(idx, gen=1)
+
+        # ---- completions release through the informer fan-out; on an
+        # OWNERLESS shard the driver journals the forget fence-exempt
+        # (the PR 5 standby-forget rule, per shard) ----
+        stillliving = []
+        for pod, node, done in live:
+            if done <= cycle:
+                hub.delete(hub.pods, pod)
+                shard = fabric.shard_map.shard_of_node(node)
+                if _owner_of(shard) is None:
+                    # a FRESH journal view per forget is deliberate, not
+                    # waste: its load picks up the interleaved owner
+                    # journals' seq high, so this forget sorts AFTER the
+                    # bind it releases in replay (a cached view's stale
+                    # seq would resurrect the pod). Ownerless-gap
+                    # forgets are rare; O(load) here is fine.
+                    BindJournal(
+                        fabric.journal_stores[shard], shard=shard
+                    ).append_forget(None, cycle, [pod.meta.uid])
+                    stats["driver_forgets"] += 1
+                fabric.claims.release(pod.meta.uid)
+                stats["completed"] += 1
+            else:
+                stillliving.append((pod, node, done))
+        live = stillliving
+        assert hub.wait_synced()
+
+        # ---- per-cycle invariants over every live runtime ----
+        for inc in incs:
+            if inc.dead:
+                continue
+            for s in inc.owned():
+                rt = inc.runtime(s)
+                if rt is None:
+                    continue
+                snap = rt.sched.snapshot
+                want = np.zeros_like(snap.nodes.requested)
+                for uid, ap in snap._assumed.items():
+                    want[ap.node_idx] += ap.request
+                np.testing.assert_allclose(
+                    snap.nodes.requested, want, atol=1e-3
+                )
+        home_owner = _owner_of(home_shard)
+        if home_owner is not None:
+            rt = home_owner.runtime(home_shard)
+            gqm = rt.sched.quotas
+            qi = gqm.index_of("soak-team")
+            if qi is not None and qi < gqm.used.shape[0]:
+                if quota_max_vec is None:
+                    quota_max_vec = rt.sched.snapshot.config.res_vector(
+                        quota_max
+                    )
+                assert np.all(gqm.used[qi] <= quota_max_vec + 1e-3), (
+                    gqm.used[qi],
+                    quota_max_vec,
+                )
+
+        if verbose and cycle % 10 == 0:
+            owned = {
+                inc.name: inc.owned() for inc in incs if not inc.dead
+            }
+            print(
+                f"cycle={cycle:4d} pending={len(pending):3d} "
+                f"inflight={len(inflight):3d} placed={stats['placed']} "
+                f"owned={owned}"
+            )
+
+        if (
+            cycle >= cycles
+            and not pending
+            and not pending_handoff
+            and not inflight
+            and not orphans
+        ):
+            break
+
+    # ---- drain every pipeline tail ----
+    for inc in incs:
+        if inc.dead:
+            continue
+        _absorb_decided(inc, inc.flush())
+    # a final routed pass for anything a flush returned unschedulable
+    for _ in range(drain_limit):
+        if not pending and not pending_handoff and not inflight:
+            break
+        sim_cycle[0] += 1
+        for inc in incs:
+            if not inc.dead:
+                _absorb_handoffs(inc, inc.tick())
+        still = []
+        for pod in pending:
+            shard = router.route(pod)
+            owner = _owner_of(shard)
+            if owner is not None and owner.submit(
+                shard, pod, now=float(sim_cycle[0])
+            ):
+                inflight[pod.meta.uid] = (pod, shard, owner.name)
+            else:
+                still.append(pod)
+        pending = still
+        still_handoff = []
+        for shard, pod, arr, tries in pending_handoff:
+            owner = _owner_of(shard)
+            if owner is not None and owner.resubmit(shard, pod, arr, tries):
+                inflight[pod.meta.uid] = (pod, shard, owner.name)
+            else:
+                still_handoff.append((shard, pod, arr, tries))
+        pending_handoff = still_handoff
+        for inc in incs:
+            if not inc.dead:
+                _absorb_decided(inc, inc.pump())
+    for inc in incs:
+        if not inc.dead:
+            _absorb_decided(inc, inc.flush())
+
+    # ---- end-state assertions ----
+    assert not pending and not pending_handoff and not inflight, (
+        f"{len(pending)} pending / {len(pending_handoff)} handoff / "
+        f"{len(inflight)} inflight pods never placed"
+    )
+    assert stats["placed"] == stats["arrived"] == len(placed)
+    # zero lost acknowledged bindings, PER SHARD: every journal-live
+    # bind (acked binds minus forgets, across every incarnation that
+    # ever owned the shard) landed in the placed ledger on ITS node
+    for s in range(shards):
+        rep = BindJournal(fabric.journal_stores[s]).replay()
+        for uid, entry in rep.live.items():
+            assert uid in placed, (
+                f"shard {s}: journal-acknowledged binding {uid} lost"
+            )
+            assert placed[uid] == entry.get("node"), (
+                f"shard {s}: {uid} journaled on {entry.get('node')} "
+                f"but placed on {placed[uid]}"
+            )
+    # per-shard resident state reconverged bit-exactly on every LIVE
+    # owner (takeover-time bit-exactness was asserted inside recovery)
+    for inc in incs:
+        if inc.dead:
+            continue
+        for s in inc.owned():
+            rt = inc.runtime(s)
+            if rt is not None:
+                assert_resident_state_converged(rt.sched)
+        stats["takeovers"] += inc.stats["takeovers"]
+        stats["claims_lost"] += inc.stats["claims_lost"]
+    stats["faults"] = chaos.fired_counts()
+    stats["fault_trace"] = list(chaos.trace)
+    chaos.disarm()
+    stats["owned_final"] = {
+        inc.name: inc.owned() for inc in incs if not inc.dead
+    }
+    stats["shard_epochs_final"] = {
+        s: fabric.fences[s].current() for s in range(shards)
+    }
+    stats["journal_records"] = {
+        s: len(fabric.journal_stores[s].load()) for s in range(shards)
+    }
+    stats["health_ok"] = all(
+        inc.runtime(s).sched.extender.health.ok()
+        for inc in incs
+        if not inc.dead
+        for s in inc.owned()
+        if inc.runtime(s) is not None
+    )
+    for inc in incs:
+        inc.close()
+    hub.stop()
     return stats

@@ -293,3 +293,58 @@ class LeaderElector:
                 self.renew_loop(stop)
             finally:
                 self.release()
+
+
+# ---------------------------------------------------------------------------
+# Multi-standby election (PR 6): rendezvous ranking over named leases
+# ---------------------------------------------------------------------------
+
+
+def rendezvous_score(candidate: str, key: str) -> int:
+    """Highest-random-weight (rendezvous) score of ``candidate`` for
+    ``key``. Deterministic across processes (no PYTHONHASHSEED
+    dependence), so every standby computes the SAME designated successor
+    for a freed shard lease without any coordination round."""
+    from . import stable_hash
+
+    return stable_hash(f"{candidate}|{key}")
+
+
+def preferred_candidate(candidates, key: str) -> Optional[str]:
+    """The rendezvous winner among ``candidates`` for ``key`` (None when
+    no candidates). Ties break lexicographically — identities are
+    unique, so the ranking is total and every observer agrees on it.
+    This is how N standbys elect takeover owners per shard: each shard
+    key ranks the live membership independently, so a dead incarnation's
+    shards spread across the survivors instead of dogpiling one."""
+    best = None
+    best_score = None
+    for c in sorted(candidates):
+        s = rendezvous_score(c, key)
+        if best_score is None or s > best_score:
+            best, best_score = c, s
+    return best
+
+
+class LeaseLockSet:
+    """Named in-memory lease locks sharing one registry — the per-shard
+    lease table of a horizontally partitioned control plane (one
+    :class:`InMemoryLeaseLock` per shard, plus member-presence leases).
+    A file-backed deployment uses one :class:`FileLeaseLock` per name
+    instead; the registry only exists so a simulation's incarnations
+    contend on the same objects."""
+
+    def __init__(self) -> None:
+        self._locks: dict = {}
+        self._guard = threading.Lock()
+
+    def lock(self, name: str) -> InMemoryLeaseLock:
+        with self._guard:
+            lk = self._locks.get(name)
+            if lk is None:
+                lk = self._locks[name] = InMemoryLeaseLock()
+            return lk
+
+    def names(self):
+        with self._guard:
+            return sorted(self._locks)

@@ -1,0 +1,109 @@
+"""Tier-1 enforcement of the fence-before-journal discipline (PR 6
+satellite): every ``append_intent``/``append_bind``/``append_abort``
+call site in ``koordinator_tpu/`` must evaluate an epoch check in the
+same function. See ``tools/check_fence_boundaries.py``."""
+
+import ast
+import sys
+import textwrap
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+import check_fence_boundaries as lint  # noqa: E402
+
+
+def test_repo_has_no_unfenced_journal_writes():
+    violations = lint.check_paths([ROOT / "koordinator_tpu"], ROOT)
+    assert not violations, "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in violations
+    )
+
+
+def _check_src(src: str, tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(src))
+    return lint.check_file(f, tmp_path)
+
+
+def test_lint_flags_unfenced_append(tmp_path):
+    out = _check_src(
+        """
+        def commit(jnl, epoch, cid, planned):
+            jnl.append_intent(epoch, cid, planned)
+        """,
+        tmp_path,
+    )
+    assert len(out) == 1 and "append_intent" in out[0][2]
+
+
+def test_lint_accepts_fence_check_and_helper(tmp_path):
+    out = _check_src(
+        """
+        def commit(self, jnl, epoch, cid, planned):
+            self.fence.check(epoch)
+            jnl.append_intent(epoch, cid, planned)
+
+        def commit2(self, jnl, epoch, cid, entries):
+            if self._fence_stale() is not None:
+                return
+            jnl.append_bind(epoch, cid, entries)
+
+        def commit3(self, fabric, jnl, s, epoch, cid, entries):
+            fabric.fences[s].check(epoch)
+            jnl.append_bind(epoch, cid, entries)
+        """,
+        tmp_path,
+    )
+    assert out == []
+
+
+def test_lint_forgets_are_exempt(tmp_path):
+    # forgets mirror apiserver-authoritative deletions: fence-EXEMPT
+    out = _check_src(
+        """
+        def release(jnl, cid, uid):
+            jnl.append_forget(None, cid, [uid])
+        """,
+        tmp_path,
+    )
+    assert out == []
+
+
+def test_lint_nested_closure_does_not_leak_check(tmp_path):
+    # a fence check inside a nested def does not guard the outer frame
+    out = _check_src(
+        """
+        def outer(self, jnl, epoch, cid, planned):
+            def gate():
+                self.fence.check(epoch)
+            jnl.append_intent(epoch, cid, planned)
+        """,
+        tmp_path,
+    )
+    assert len(out) == 1
+
+
+def test_guarded_call_set_is_pinned():
+    assert lint.GUARDED_APPENDS == {
+        "append_intent",
+        "append_bind",
+        "append_abort",
+    }
+
+
+def test_ast_walk_sees_real_commit_boundary():
+    """Self-check against silent rot: the scanner must actually FIND the
+    real _commit boundary's appends (if batch_solver's journal calls are
+    renamed, the lint must be updated, not silently pass-by-absence)."""
+    src = (ROOT / "koordinator_tpu/scheduler/batch_solver.py").read_text()
+    tree = ast.parse(src)
+    found = {
+        node.func.attr
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in lint.GUARDED_APPENDS
+    }
+    assert {"append_intent", "append_bind", "append_abort"} <= found

@@ -199,3 +199,221 @@ def test_memory_store_survives_scheduler_death():
     store = MemoryJournalStore()
     BindJournal(store).append_bind(1, 0, [_bind("a", "n0")])
     assert set(BindJournal(store).replay().live) == {"a"}
+
+
+# ---------------------------------------------------------------------------
+# Periodic compaction + crash-mid-compaction (PR 6 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_maybe_compact_threshold():
+    j = BindJournal()
+    for i in range(4):
+        j.append_bind(1, i, [_bind(f"p{i}", "n0")])
+    assert j.maybe_compact(min_records=10) is None  # below threshold
+    assert len(j.records()) == 4
+    rep = j.maybe_compact(min_records=4)
+    assert rep is not None and set(rep.live) == {"p0", "p1", "p2", "p3"}
+    recs = j.records()
+    assert len(recs) == 1 and recs[0]["op"] == "checkpoint"
+    # the counter reset: immediately re-running is below threshold again
+    assert j.maybe_compact(min_records=1) is None  # 0 since reset
+    # replay through the checkpoint + later appends
+    j.append_forget(1, 9, ["p0"])
+    assert set(j.replay().live) == {"p1", "p2", "p3"}
+
+
+def test_compact_refuses_stale_epoch():
+    j = BindJournal()
+    j.append_bind(5, 0, [_bind("a", "n0")])
+    with pytest.raises(StaleEpochError):
+        j.compact(epoch=3)  # a deposed leader must not rewrite the log
+
+
+def test_compact_crash_chaos_leaves_live_log_intact(tmp_path):
+    """``journal.compact_crash``: the process dies mid-rewrite — only a
+    torn TEMP file is left (atomic-rename discipline), the live log is
+    untouched, and a fresh open ignores/repairs the orphan and replays
+    the full pre-crash history."""
+    path = os.fspath(tmp_path / "journal.jsonl")
+    chaos = FaultInjector(seed=0)
+    chaos.arm("journal.compact_crash", times=1)
+    j = BindJournal(FileJournalStore(path), chaos=chaos)
+    for i in range(3):
+        j.append_bind(1, i, [_bind(f"p{i}", "n0")])
+    j.append_forget(1, 3, ["p1"])
+    with pytest.raises(JournalWriteError):
+        j.compact()
+    assert os.path.exists(path + ".tmp")  # the torn rewrite artifact
+    # "process restart": a fresh store repairs/ignores the torn tmp and
+    # the journal replays exactly the pre-crash world
+    j2 = BindJournal(FileJournalStore(path))
+    rep = j2.replay()
+    assert set(rep.live) == {"p0", "p2"}
+    assert not os.path.exists(path + ".tmp")
+    # the journal still appends and compacts cleanly afterwards
+    j2.append_bind(1, 4, [_bind("p4", "n1")])
+    rep2 = j2.compact()
+    assert set(rep2.live) == {"p0", "p2", "p4"}
+    recs = j2.records()
+    assert len(recs) == 1 and recs[0]["op"] == "checkpoint"
+
+
+def test_scheduler_run_loop_compacts(tmp_path):
+    """BatchScheduler(journal_compact_records=N) compacts from the run
+    loop once N records accumulate, and the compacted journal still
+    replays the full live set."""
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+
+    snap = ClusterSnapshot()
+    for i in range(4):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: 32000.0,
+                        ext.RES_MEMORY: 131072.0,
+                    }
+                ),
+            )
+        )
+    store = MemoryJournalStore()
+    sched = BatchScheduler(
+        snap,
+        LoadAwareArgs(usage_thresholds={}),
+        batch_bucket=8,
+        journal=BindJournal(store),
+        journal_compact_records=6,
+    )
+    sched.extender.monitor.stop_background()
+    bound = []
+    for c in range(4):
+        pods = [
+            Pod(
+                meta=ObjectMeta(name=f"p{c}-{k}"),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: 500.0, ext.RES_MEMORY: 1024.0}
+                ),
+            )
+            for k in range(3)
+        ]
+        out = sched.schedule(pods)
+        bound.extend(p.meta.uid for p, _n in out.bound)
+    assert (
+        sched.extender.registry.get("journal_compactions_total").value()
+        >= 1.0
+    )
+    # the log shrank to checkpoint + post-checkpoint tail, and replay
+    # still reconstructs every acknowledged bind
+    rep = BindJournal(store).replay()
+    assert set(rep.live) == set(bound)
+    assert any(r["op"] == "checkpoint" for r in store.load())
+
+
+# ---------------------------------------------------------------------------
+# Shard stamping + cross-shard single-winner claims (PR 6)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_stamped_records():
+    store = MemoryJournalStore()
+    BindJournal(store, shard=3).append_bind(1, 0, [_bind("a", "n0")])
+    assert store.load()[0]["shard"] == 3
+
+
+def test_claim_table_single_winner():
+    from koordinator_tpu.core.journal import ClaimTable
+
+    t = ClaimTable()
+    assert t.claim("pod-1", shard=0, epoch=1)
+    assert t.claim("pod-1", shard=0, epoch=1)      # idempotent for winner
+    assert not t.claim("pod-1", shard=2, epoch=1)  # loser shard
+    assert t.winner("pod-1") == 0
+
+
+def test_claim_table_epoch_fenced_per_shard():
+    from koordinator_tpu.core.journal import ClaimTable
+
+    t = ClaimTable()
+    assert t.claim("a", shard=0, epoch=2)
+    with pytest.raises(StaleEpochError):
+        t.claim("b", shard=0, epoch=1)  # deposed shard-0 owner
+    assert t.claim("c", shard=1, epoch=1)  # shard 1's history independent
+    with pytest.raises(StaleEpochError):
+        t.claim("d", shard=1, epoch=-1)  # revoked sentinel always stale
+
+
+def test_claim_table_reload_and_release():
+    from koordinator_tpu.core.journal import ClaimTable
+
+    store = MemoryJournalStore()
+    t = ClaimTable(store)
+    t.claim("a", shard=1, epoch=1)
+    t.claim("b", shard=0, epoch=1)
+    t.release("a")
+    t2 = ClaimTable(store)  # reload from the durable record stream
+    assert t2.winner("a") is None
+    assert t2.winner("b") == 0
+    with pytest.raises(StaleEpochError):
+        t2.claim("fresh", shard=0, epoch=0)  # epoch high survived reload
+
+
+def test_claim_table_release_tombstones_uid():
+    """A released (pod-GC'd) claim must never be re-claimable: a stale
+    fanned-out copy of the pod can sit in a backlogged shard's queue
+    past the pod's completion and deletion — a post-release claim must
+    LOSE (the copy is dropped), or that shard re-schedules a dead pod,
+    exactly the double-bind the ClaimTable exists to prevent."""
+    from koordinator_tpu.core.journal import ClaimTable
+
+    store = MemoryJournalStore()
+    t = ClaimTable(store)
+    assert t.claim("p", shard=0, epoch=1)
+    t.release("p")  # the pod was bound, completed, and GC'd
+    assert not t.claim("p", shard=1, epoch=1)  # backlogged copy loses
+    assert not t.claim("p", shard=0, epoch=1)  # even the old winner
+    t2 = ClaimTable(store)  # the tombstone survives a reload
+    assert not t2.claim("p", shard=1, epoch=1)
+    # a never-claimed uid is NOT tombstoned (no fan-out copy can exist)
+    t.release("never-claimed")
+    assert t.claim("never-claimed", shard=2, epoch=1)
+
+
+def test_compact_folds_sibling_instance_appends():
+    """compact() must fold records a SIBLING BindJournal instance wrote
+    over the same store (the standby-forget pattern journals through a
+    fresh view during ownerless gaps): the read-rewrite runs under the
+    store lock and re-derives seq from the replay, so an interleaved
+    acknowledged forget is neither erased by the rewrite nor sorted
+    after the checkpoint."""
+    store = MemoryJournalStore()
+    a = BindJournal(store)
+    a.append_bind(1, 0, [_bind("x", "n0"), _bind("y", "n1")])
+    # a standby's fresh view journals a fence-exempt forget that the
+    # compacting instance never observed in-memory
+    BindJournal(store).append_forget(None, 1, ["x"])
+    rep = a.compact()
+    assert "x" not in rep.live and "y" in rep.live
+    recs = store.load()
+    assert len(recs) == 1 and recs[0]["op"] == "checkpoint"
+    assert "x" not in recs[0]["live"] and "y" in recs[0]["live"]
+    # the checkpoint's seq sorts AFTER the sibling's append, and the
+    # compacting instance's next append after it in turn
+    assert recs[0]["seq"] >= 2
+    nxt = a.append_bind(1, 2, [_bind("z", "n2")])
+    assert nxt["seq"] > recs[0]["seq"]
+    fresh = BindJournal(store).replay()
+    assert set(fresh.live) == {"y", "z"}

@@ -1,0 +1,777 @@
+"""Horizontally partitioned control plane tests (PR 6 tentpole).
+
+Covers: stable shard partitioning; multi-standby election (3+ candidates
+racing a lapsed shard lease admit exactly one, per-shard epochs stay
+monotonic, a deposed owner's queued commit is fenced with
+STALE_LEADER_EPOCH); rendezvous rebalancing + shard handoff with queue
+continuity across owners; cross-shard single-winner claims under
+fan-out; per-shard channel fencing; and the exact NUMA-zone / GPU-slot
+hold journal coverage with bit-exact recovery (kill mid-commit with
+device holds outstanding).
+"""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Device,
+    DeviceInfo,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.chaos import FaultInjector
+from koordinator_tpu.core.journal import (
+    BindJournal,
+    MemoryJournalStore,
+    StaleEpochError,
+)
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.obs.rejections import RejectReason
+from koordinator_tpu.runtime.recovery import recover_scheduler
+from koordinator_tpu.runtime.shards import (
+    Membership,
+    ShardFabric,
+    ShardRouter,
+    ShardedScheduler,
+    ShardMap,
+)
+from koordinator_tpu.runtime.statehub import ClusterStateHub
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.utils.leaderelection import (
+    InMemoryLeaseLock,
+    LeaderElector,
+    preferred_candidate,
+)
+
+N_NODES = 12
+N_SHARDS = 4
+
+
+def _node(name, cpu=32_000.0, mem=128 * 1024.0):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(
+            allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}
+        ),
+    )
+
+
+def _pod(name, cpu=2000.0, mem=4096.0):
+    return Pod(
+        meta=ObjectMeta(name=name),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}, priority=9000
+        ),
+    )
+
+
+def _make_scheduler(shard, snapshot, fence, journal):
+    s = BatchScheduler(
+        snapshot,
+        LoadAwareArgs(usage_thresholds={}),
+        batch_bucket=16,
+        journal=journal,
+        fence=fence,
+    )
+    s.extender.monitor.stop_background()
+    return s
+
+
+class _World:
+    """Shared fabric + hub + a simulated cycle clock."""
+
+    def __init__(self, n_shards=N_SHARDS, n_nodes=N_NODES):
+        self.t = [0.0]
+        self.fabric = ShardFabric(
+            n_shards, clock=lambda: self.t[0], membership_ttl_s=2.5
+        )
+        self.hub = ClusterStateHub()
+        self.node_names = [f"n{i:03d}" for i in range(n_nodes)]
+        for name in self.node_names:
+            self.hub.publish(self.hub.nodes, _node(name))
+
+    def incarnation(self, name, pipelined=False):
+        return ShardedScheduler(
+            name,
+            self.hub,
+            self.fabric,
+            _make_scheduler,
+            pipelined=pipelined,
+            max_batch=32,
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            retry_period=0.5,
+        )
+
+    def advance(self, dt=1.0):
+        self.t[0] += dt
+
+
+# ---------------------------------------------------------------------------
+# ShardMap / router
+# ---------------------------------------------------------------------------
+
+
+def test_shard_map_partition_covers_and_is_stable():
+    m = ShardMap(N_SHARDS)
+    names = [f"n{i:03d}" for i in range(64)]
+    part = m.partition(names)
+    assert sorted(sum(part.values(), [])) == sorted(names)
+    # stable across instances (no process-seeded hashing)
+    m2 = ShardMap(N_SHARDS)
+    assert all(
+        m.shard_of_node(n) == m2.shard_of_node(n) for n in names
+    )
+    flt = m.node_filter(1)
+    assert all(flt(n) == (m.shard_of_node(n) == 1) for n in names)
+
+
+def test_router_quota_home_and_spill_targets():
+    m = ShardMap(N_SHARDS)
+    router = ShardRouter(m, spill_backlog=4)
+    q_pod = Pod(
+        meta=ObjectMeta(
+            name="q1", labels={ext.LABEL_QUOTA_NAME: "team-a"}
+        ),
+        spec=PodSpec(requests={ext.RES_CPU: 1000.0}),
+    )
+    home = m.shard_of_key("quota:team-a")
+    assert router.route(q_pod) == home
+    # quota-homed pods never spill — one ledger owns the charge
+    assert router.targets(q_pod, backlog_of=lambda s: 100) == [home]
+    free = _pod("free-1")
+    primary = router.route(free)
+    assert router.targets(free, backlog_of=lambda s: 0) == [primary]
+    spilled = router.targets(free, backlog_of=lambda s: 10)
+    assert spilled[0] == primary and len(spilled) == 2
+    assert spilled[1] != primary
+    # a node-pinned pod routes to its node's shard, never spills
+    pinned = _pod("pin-1")
+    pinned.spec.node_name = "n003"
+    assert router.targets(pinned, backlog_of=lambda s: 100) == [
+        m.shard_of_node("n003")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Multi-standby election (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_three_candidates_racing_lapsed_lease_admit_exactly_one():
+    """3+ candidates racing a lapsed shard lease: exactly one wins the
+    CAS, and the winner's epoch is the dead owner's + 1 (per-shard
+    monotonic)."""
+    t = [0.0]
+    lock = InMemoryLeaseLock()
+
+    def elector(ident):
+        return LeaderElector(
+            lock,
+            ident,
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            now_fn=lambda: t[0],
+            sleep_fn=lambda _dt: None,
+        )
+
+    old = elector("old-owner")
+    assert old.try_acquire_or_renew() and old.current_epoch() == 1
+    t[0] = 10.0  # the owner died; its lease lapsed
+    racers = [elector(f"standby-{i}") for i in range(3)]
+    results = [e.try_acquire_or_renew() for e in racers]
+    assert sum(results) == 1, "exactly one racer may win the CAS"
+    winner = racers[results.index(True)]
+    assert winner.current_epoch() == 2
+    # the losers observe the new grant; none of them holds an epoch
+    assert all(
+        e.current_epoch() is None for e in racers if e is not winner
+    )
+    # a second race while the fresh lease is live admits nobody
+    assert not any(
+        e.try_acquire_or_renew() for e in racers if e is not winner
+    )
+
+
+def test_rendezvous_election_spreads_dead_members_shards():
+    """The rendezvous ranking is deterministic, total, and re-points to
+    survivors when a member dies — no coordination round needed."""
+    members = ["inc-a", "inc-b", "inc-c"]
+    assign = {
+        s: preferred_candidate(members, f"shard-{s}") for s in range(6)
+    }
+    assert set(assign.values()) == set(members)  # everyone got shards
+    survivors = ["inc-a", "inc-c"]
+    reassign = {
+        s: preferred_candidate(survivors, f"shard-{s}") for s in range(6)
+    }
+    for s in range(6):
+        if assign[s] in survivors:
+            assert reassign[s] == assign[s]  # stable for survivors
+        else:
+            assert reassign[s] in survivors  # dead member's spread
+    # the dead member's shards do not all dogpile one survivor
+    took = [s for s in range(6) if assign[s] == "inc-b"]
+    assert len({reassign[s] for s in took}) > 1 or len(took) <= 1
+
+
+def test_membership_ttl_expires_silent_members():
+    t = [0.0]
+    m = Membership(2.5, clock=lambda: t[0])
+    m.heartbeat("a")
+    m.heartbeat("b")
+    assert m.alive() == ["a", "b"]
+    t[0] = 2.0
+    m.heartbeat("b")
+    t[0] = 4.0
+    assert m.alive() == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# Sharded control plane end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _settle(world, incs, ticks=3):
+    for _ in range(ticks):
+        world.advance(1.0)
+        for inc in incs:
+            inc.tick()
+
+
+def test_concurrent_owners_schedule_disjoint_shards():
+    world = _World()
+    a = world.incarnation("inc-a")
+    b = world.incarnation("inc-b")
+    world.fabric.membership.heartbeat("inc-a")
+    world.fabric.membership.heartbeat("inc-b")
+    try:
+        _settle(world, [a, b])
+        owned_a, owned_b = set(a.owned()), set(b.owned())
+        assert owned_a and owned_b, "both incarnations must own shards"
+        assert not (owned_a & owned_b), "shard ownership must be disjoint"
+        assert owned_a | owned_b == set(range(N_SHARDS))
+        router = ShardRouter(world.fabric.shard_map)
+        placed = {}
+        pods = [_pod(f"p{i:03d}") for i in range(24)]
+        for pod in pods:
+            s = router.route(pod)
+            owner = a if a.owns(s) else b
+            assert owner.submit(s, pod)
+        for inc in (a, b):
+            for s, pod, node, _lat in inc.pump() + inc.flush():
+                assert node is not None
+                assert pod.meta.uid not in placed
+                placed[pod.meta.uid] = node
+                # shard-correct: bound on a node the serving shard owns
+                assert world.fabric.shard_map.shard_of_node(node) == s
+        assert len(placed) == len(pods)
+    finally:
+        a.close()
+        b.close()
+        world.hub.stop()
+
+
+def test_deposed_owner_queued_commit_fenced_stale_epoch():
+    """A deposed shard owner that missed its own deposition (partition:
+    it never saw the new grant) has its queued commit REJECTED at the
+    commit boundary with the named STALE_LEADER_EPOCH reason and the
+    leader_fenced_commits_total metric — never double-placed — while the
+    new owner schedules the same shard under the new epoch."""
+    world = _World()
+    a = world.incarnation("inc-a")
+    world.fabric.membership.heartbeat("inc-a")
+    try:
+        _settle(world, [a])
+        assert set(a.owned()) == set(range(N_SHARDS))
+        # b joins; a is partitioned (stops ticking/renewing/heartbeating)
+        b = world.incarnation("inc-b")
+        world.advance(4.0)  # a's leases lapse, its membership expires
+        for _ in range(3):
+            world.advance(1.0)
+            b.tick()
+        taken = set(b.owned())
+        assert taken, "the survivor must have taken over lapsed shards"
+        s = sorted(taken)[0]
+        assert world.fabric.fences[s].current() == 2
+        # the partitioned owner still BELIEVES it owns s…
+        assert a.owns(s)
+        pod = _pod("fenced-pod")
+        assert a.submit(s, pod)
+        decided = a.pump()
+        fenced = [
+            (sh, p, n) for sh, p, n, _l in decided if p.meta.uid == pod.meta.uid
+        ]
+        # …but its commit is fenced: the pod comes back undecided (it
+        # retries) or terminally unschedulable — NEVER bound
+        assert all(n is None for _sh, _p, n in fenced)
+        rt = a.runtime(s)
+        reg = rt.sched.extender.registry
+        assert reg.get("leader_fenced_commits_total").value() >= 1.0
+        reasons = {
+            r.reason for r in rt.sched.extender.rejections.records()
+        }
+        assert RejectReason.STALE_LEADER_EPOCH in reasons
+        # per-shard epochs stayed monotonic; untouched shards unaffected
+        assert world.fabric.fences[s].current() == 2
+        for other in range(N_SHARDS):
+            if other not in taken:
+                assert world.fabric.fences[other].current() == 1
+        b.close()
+    finally:
+        a.close()
+        world.hub.stop()
+
+
+def test_pump_skips_cycle_when_gate_drops_whole_batch():
+    """A queue whose every pod lost its claim to another shard must not
+    cost a scheduler cycle: pump() returns no decisions AND the cycle id
+    does not advance (no snapshot lock, no tracer span, no begin_cycle)
+    when the feed gate empties the batch."""
+    from koordinator_tpu.scheduler.stream import StreamScheduler
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("n000"))
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(usage_thresholds={}), batch_bucket=16
+    )
+    sched.extender.monitor.stop_background()
+    stream = StreamScheduler(sched, max_batch=8, feed_gate=lambda pod: False)
+    for i in range(4):
+        stream.submit(_pod(f"lost{i}"))
+    before = sched.extender.current_cycle_id
+    assert stream.pump() == []
+    assert sched.extender.current_cycle_id == before
+    assert stream.backlog() == 0  # the claim-lost pods were dropped
+
+
+def test_graceful_close_releases_leases_and_membership():
+    """Graceful ``close()`` must never behave worse than a crash: every
+    owned shard's lease is RELEASED (a successor acquires immediately
+    instead of waiting out the TTL) and the incarnation leaves the
+    membership table, so a driver's ``_owner_of`` stops routing pods at
+    the closed process."""
+    world = _World()
+    a = world.incarnation("inc-a")
+    world.fabric.membership.heartbeat("inc-a")
+    try:
+        _settle(world, [a])
+        assert set(a.owned()) == set(range(N_SHARDS))
+        handoffs = a.close()
+        assert set(handoffs) == set(range(N_SHARDS))
+        assert not any(a.owns(s) for s in range(N_SHARDS))
+        assert "inc-a" not in world.fabric.membership.alive()
+        # a successor takes every shard over while well inside the lease
+        # duration (3.0s): total elapsed below stays at 1.5s, so this
+        # only works because close() surrendered the leases
+        b = world.incarnation("inc-b")
+        world.fabric.membership.heartbeat("inc-b")
+        for _ in range(3):
+            world.advance(0.5)
+            b.tick()
+        assert set(b.owned()) == set(range(N_SHARDS))
+        for s in range(N_SHARDS):
+            assert world.fabric.fences[s].current() == 2
+        b.close()
+    finally:
+        world.hub.stop()
+
+
+def test_shard_handoff_queue_continuity_and_journal_across_owners():
+    """Voluntary handoff (rendezvous rebalance): the donor's queued pods
+    move to the new owner with arrival stamps intact, binds from BOTH
+    owners coexist in the shard journal under their respective epochs,
+    and nothing is placed twice. The donor's other shards keep serving."""
+    world = _World()
+    a = world.incarnation("inc-a")
+    world.fabric.membership.heartbeat("inc-a")
+    try:
+        _settle(world, [a])  # a owns everything (sole member)
+        # bind one pod per shard under epoch 1
+        router = ShardRouter(world.fabric.shard_map)
+        placed = {}
+        first = [_pod(f"early-{i:02d}") for i in range(8)]
+        for pod in first:
+            assert a.submit(router.route(pod), pod)
+        for s, pod, node, _l in a.pump() + a.flush():
+            assert node is not None
+            placed[pod.meta.uid] = node
+        # queue MORE pods, then b joins → rendezvous reassigns some
+        # shards → a voluntarily hands them off with queues intact
+        second = [_pod(f"late-{i:02d}") for i in range(12)]
+        for pod in second:
+            assert a.submit(router.route(pod), pod)
+        b = world.incarnation("inc-b")
+        world.fabric.membership.heartbeat("inc-b")
+        handed = {}
+        for _ in range(6):
+            world.advance(1.0)
+            for s, hand in a.tick().items():
+                for pod, arr, tries in hand.queued:
+                    handed[pod.meta.uid] = (s, pod, arr, tries)
+                for pod, node, _l in hand.decided:
+                    if node is not None:
+                        assert pod.meta.uid not in placed
+                        placed[pod.meta.uid] = node
+            b.tick()
+            # the new owner takes the queue over, stamps intact
+            for uid, (s, pod, arr, tries) in list(handed.items()):
+                if b.resubmit(s, pod, arr, tries):
+                    handed.pop(uid)
+        assert b.owned(), "the joiner must have taken over shards"
+        assert a.owned(), "the donor's other shards keep serving"
+        assert not handed, "every handed-off pod must re-enqueue"
+        for inc in (a, b):
+            for s, pod, node, _l in inc.pump() + inc.flush():
+                if node is not None:
+                    assert pod.meta.uid not in placed, "double placement"
+                    placed[pod.meta.uid] = node
+        assert len(placed) == len(first) + len(second)
+        # journal continuity per shard: replay live == placed-on-shard,
+        # with records under BOTH epochs where ownership moved
+        for s in b.owned():
+            rep = BindJournal(world.fabric.journal_stores[s]).replay()
+            for uid, entry in rep.live.items():
+                assert placed[uid] == entry["node"]
+            epochs = {
+                r["epoch"]
+                for r in world.fabric.journal_stores[s].load()
+                if r["op"] == "bind"
+            }
+            if any(
+                world.fabric.shard_map.shard_of_node(placed[p.meta.uid]) == s
+                for p in first
+                if p.meta.uid in placed
+            ):
+                assert 1 in epochs, "donor-era binds survive in the log"
+        b.close()
+    finally:
+        a.close()
+        world.hub.stop()
+
+
+def test_deposed_owner_queued_pods_survive_to_handoff():
+    """A deposed owner whose claim authority is gone (the new owner has
+    claimed under the next epoch) must KEEP its queued pods for the
+    handoff — dropping them like claim-losers would lose pods nobody
+    else holds."""
+    world = _World()
+    a = world.incarnation("inc-a")
+    world.fabric.membership.heartbeat("inc-a")
+    try:
+        _settle(world, [a])
+        b = world.incarnation("inc-b")
+        world.advance(4.0)  # a partitioned: leases lapse, membership out
+        for _ in range(3):
+            world.advance(1.0)
+            b.tick()
+        s = sorted(b.owned())[0]
+        # the new owner claims a pod on s → claim epoch high becomes 2
+        probe = _pod("b-probe")
+        assert b.submit(s, probe)
+        assert any(
+            n is not None for _s, p, n, _l in b.pump()
+            if p.meta.uid == probe.meta.uid
+        )
+        # the partitioned donor still queues pods for s…
+        stale_pods = [_pod(f"stale-{i}") for i in range(5)]
+        for pod in stale_pods:
+            assert a.submit(s, pod)
+        # …its pump must neither bind, drop, nor decide them
+        decided = {p.meta.uid for _s, p, _n, _l in a.pump()}
+        assert not ({p.meta.uid for p in stale_pods} & decided)
+        assert a.backlog(s) == len(stale_pods)
+        # the handoff surfaces every one of them for the new owner
+        world.advance(1.0)
+        handoffs = a.tick()
+        assert s in handoffs
+        handed = {p.meta.uid for p, _arr, _t in handoffs[s].queued}
+        assert handed == {p.meta.uid for p in stale_pods}
+        b.close()
+    finally:
+        a.close()
+        world.hub.stop()
+
+
+def test_fanout_claim_single_winner_binds_once():
+    """A pod fanned out to TWO shards' queues is bound exactly once: the
+    first pump wins the claim, the other shard's pump drops its copy."""
+    world = _World()
+    a = world.incarnation("inc-a")
+    b = world.incarnation("inc-b")
+    world.fabric.membership.heartbeat("inc-a")
+    world.fabric.membership.heartbeat("inc-b")
+    try:
+        _settle(world, [a, b])
+        sa, sb = sorted(a.owned())[0], sorted(b.owned())[0]
+        pod = _pod("fanout-1")
+        assert a.submit(sa, pod)
+        assert b.submit(sb, pod)  # fan-out: both queues hold it
+        decided_a = a.pump()
+        decided_b = b.pump()
+        bound = [
+            (s, n)
+            for s, p, n, _l in decided_a + decided_b
+            if p.meta.uid == pod.meta.uid and n is not None
+        ]
+        assert len(bound) == 1, f"bound {len(bound)} times: {bound}"
+        winner_shard = world.fabric.claims.winner(pod.meta.uid)
+        assert winner_shard == bound[0][0] == sa  # a pumped first
+        assert b.stats["claims_lost"] >= 1
+    finally:
+        a.close()
+        b.close()
+        world.hub.stop()
+
+
+# ---------------------------------------------------------------------------
+# Per-shard channel fencing
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_channel_per_shard_epoch_fencing():
+    """x-shard-id scopes the channel fence: shard 0's takeover (epoch 2)
+    must refuse shard 0's deposed owner but NOT shard 1's still-live
+    epoch-1 owner."""
+    from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+    from koordinator_tpu.runtime.snapshot_channel import (
+        ChannelFenced,
+        SolverClient,
+        SolverService,
+        serve,
+    )
+
+    service = SolverService()
+    service.scheduler.extender.monitor.stop_background()
+    server, port = serve(service)
+    s0_new = SolverClient(f"127.0.0.1:{port}")
+    s0_old = SolverClient(f"127.0.0.1:{port}")
+    s1 = SolverClient(f"127.0.0.1:{port}")
+    try:
+        s0_new.set_epoch(2, shard=0)
+        s0_old.set_epoch(1, shard=0)
+        s1.set_epoch(1, shard=1)
+        delta = pb.SnapshotDelta(revision=1)
+        delta.node_upserts.add(
+            name="n0", allocatable=pb.ResourceVector(values=[32000.0])
+        )
+        assert s0_new.sync(delta).applied_revision == 1
+        assert service.shard_epochs == {0: 2}
+        with pytest.raises(ChannelFenced):
+            s0_old.sync(pb.SnapshotDelta(revision=2))
+        # shard 1's epoch-1 owner is NOT fenced by shard 0's epoch 2
+        ack = s1.sync(pb.SnapshotDelta(revision=2))
+        assert ack.applied_revision == 2
+        assert service.shard_epochs == {0: 2, 1: 1}
+    finally:
+        s0_new.close()
+        s0_old.close()
+        s1.close()
+        server.stop(grace=None)
+
+
+# ---------------------------------------------------------------------------
+# Exact NUMA-zone / GPU-slot hold journal coverage (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _gpu_world(store):
+    from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("g0", cpu=64000.0, mem=262144.0))
+    dm = DeviceManager(snap)
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="g0"),
+            devices=[DeviceInfo(dev_type="gpu", minor=g) for g in range(4)],
+        )
+    )
+    sched = BatchScheduler(
+        snap,
+        LoadAwareArgs(usage_thresholds={}),
+        batch_bucket=8,
+        devices=dm,
+        journal=BindJournal(store),
+    )
+    sched.extender.monitor.stop_background()
+    return snap, dm, sched
+
+
+def _gpu_pod(name, whole):
+    return Pod(
+        meta=ObjectMeta(name=name),
+        spec=PodSpec(
+            requests={
+                ext.RES_CPU: 1000.0,
+                ext.RES_MEMORY: 1024.0,
+                ext.RES_GPU: whole,
+            },
+            priority=9000,
+        ),
+    )
+
+
+def test_bind_journal_carries_exact_gpu_slots_and_recovery_restores():
+    """Kill with device holds outstanding: the bind records carry the
+    EXACT minors, and a fresh instance's recovery restores them — a new
+    allocation cannot steal the dead leader's slots."""
+    store = MemoryJournalStore()
+    _snap, dm, sched = _gpu_world(store)
+    out = sched.schedule([_gpu_pod("gp-1", 2), _gpu_pod("gp-2", 1)])
+    assert len(out.bound) == 2
+    held = {
+        p.meta.uid: sorted(
+            m for m, _pct, _c in dm.node("g0").owners[p.meta.uid]
+        )
+        for p, _n in out.bound
+    }
+    # journal carries the exact slot indices
+    journaled = {}
+    for rec in store.load():
+        if rec["op"] == "bind":
+            for e in rec["binds"]:
+                journaled[e["uid"]] = sorted(
+                    m for m, _p, _c in e["dev"]["gpu"]
+                )
+    assert journaled == held
+    # process death: fresh snapshot/manager/scheduler, same store
+    snap2, dm2, sched2 = _gpu_world(store)
+    rep = recover_scheduler(sched2, BindJournal(store), hub=None)
+    assert rep.replayed == 2
+    st = dm2.node("g0")
+    for uid, minors in held.items():
+        assert sorted(m for m, _p, _c in st.owners[uid]) == minors
+    # 3 of 4 gpus held → a 2-gpu pod must NOT fit on the free remainder
+    assert dm2.allocate(_gpu_pod("thief", 2), "g0") is None
+    assert dm2.allocate(_gpu_pod("fits", 1), "g0") is not None
+
+
+def test_crash_mid_commit_device_holds_not_resurrected():
+    """commit.crash after Reserve: the chunk rolls back (abort record),
+    so recovery must restore NOTHING for it — the rolled-back pod's
+    minors stay free on the recovered instance."""
+    store = MemoryJournalStore()
+    chaos = FaultInjector(seed=0)
+    from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+
+    snap = ClusterSnapshot()
+    snap.upsert_node(_node("g0", cpu=64000.0, mem=262144.0))
+    dm = DeviceManager(snap)
+    dm.upsert_device(
+        Device(
+            meta=ObjectMeta(name="g0"),
+            devices=[DeviceInfo(dev_type="gpu", minor=g) for g in range(4)],
+        )
+    )
+    sched = BatchScheduler(
+        snap,
+        LoadAwareArgs(usage_thresholds={}),
+        batch_bucket=8,
+        devices=dm,
+        chaos=chaos,
+        journal=BindJournal(store),
+    )
+    sched.extender.monitor.stop_background()
+    chaos.arm("commit.crash", error=RuntimeError, times=1)
+    out = sched.schedule([_gpu_pod("doomed", 2)])
+    assert out.bound == []  # rolled back
+    assert "doomed" not in "".join(
+        e["uid"] for r in store.load() if r["op"] == "bind"
+        for e in r["binds"]
+    )
+    snap2, dm2, sched2 = _gpu_world(store)
+    rep = recover_scheduler(sched2, BindJournal(store), hub=None)
+    assert rep.replayed == 0
+    assert dm2.node("g0").gpu_free == [100.0] * 4
+    assert rep.open_intents == 0  # the abort record closed the intent
+
+
+def test_bind_journal_carries_numa_zone_and_cpuset_and_restores():
+    """LSR pod with an exclusive cpuset: the bind record carries the
+    chosen zone + cpu ids, and recovery re-installs the zone charge and
+    the cpuset reservation bit-exactly."""
+    from koordinator_tpu.core.topology import CPUTopology
+    from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+        NUMAManager,
+    )
+
+    def build(store):
+        snap = ClusterSnapshot()
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name="m0"),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: 16000.0,
+                        ext.RES_MEMORY: 32768.0,
+                    }
+                ),
+            )
+        )
+        numa = NUMAManager(snap)
+        numa.register_node(
+            "m0",
+            CPUTopology.uniform(
+                sockets=2, numa_per_socket=1, cores_per_numa=4
+            ),
+            memory_per_zone_mib=16384.0,
+        )
+        sched = BatchScheduler(
+            snap,
+            LoadAwareArgs(usage_thresholds={}),
+            batch_bucket=8,
+            numa=numa,
+            journal=BindJournal(store),
+        )
+        sched.extender.monitor.stop_background()
+        return snap, numa, sched
+
+    store = MemoryJournalStore()
+    _snap, numa, sched = build(store)
+    pod = Pod(
+        meta=ObjectMeta(
+            name="lsr-1", labels={ext.LABEL_POD_QOS: "LSR"}
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 4000.0, ext.RES_MEMORY: 2048.0},
+            priority=9500,
+        ),
+    )
+    out = sched.schedule([pod])
+    assert len(out.bound) == 1
+    hold = numa.hold_of(pod.meta.uid, "m0")
+    assert hold is not None and len(hold["cpus"]) == 4
+    entry = next(
+        e
+        for r in store.load()
+        if r["op"] == "bind"
+        for e in r["binds"]
+        if e["uid"] == pod.meta.uid
+    )
+    assert entry["numa"]["cpus"] == hold["cpus"]
+    assert entry["numa"]["zone"] == hold["zone"]
+    # fresh instance recovers the exact zone + cpuset
+    _snap2, numa2, sched2 = build(store)
+    recover_scheduler(sched2, BindJournal(store), hub=None)
+    hold2 = numa2.hold_of(pod.meta.uid, "m0")
+    assert hold2 == hold
+    st = numa2.node("m0")
+    assert st.zone_used[hold["zone"]][0] == pytest.approx(
+        hold["zreq"][0]
+    )
+    # the recovered cpuset is reserved: a full-node LSR pod that would
+    # need those cpus cannot take them
+    taken = set(hold["cpus"])
+    assert not (
+        set(
+            st.accumulator.take("probe", 8, policy=None)
+            or ()
+        )
+        & taken
+    )
