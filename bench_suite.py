@@ -1077,6 +1077,7 @@ def _sharded_stream_run(
     churn_at=None,
     churn_pause_s=0.15,
     isolated=False,
+    stage_stats_out=None,
 ):
     """One sharded latency run: ONE Poisson arrival process at the
     aggregate ``rate``, routed to shards by uid hash, each shard pumping
@@ -1096,6 +1097,13 @@ def _sharded_stream_run(
     leader mid-run — its epoch advances, in-flight commits are fenced
     (STALE_LEADER_EPOCH), pods requeue — and re-grants after
     ``churn_pause_s``, measuring the p99/backlog cost of leader churn.
+
+    ``stage_stats_out`` (a dict) turns each shard's tracer ON and fills
+    ``{shard: _stage_stats(...)}`` after the run — the per-shard stage
+    table pass (distributed-observability PR satellite). Only use on a
+    dedicated pass AFTER the measured ones: tracing overhead lands in
+    the pump. With ``TRACE_PATH`` set it also dumps ONE merged Chrome
+    trace, a process lane per shard (``obs.fleet.merge_chrome_traces``).
     Returns (latencies_ms, end_backlog_total, bound, wall_s)."""
     import threading
 
@@ -1111,6 +1119,9 @@ def _sharded_stream_run(
         for sched in scheds:
             sched.schedule(pods[:max_batch])
             sched.schedule(pods[max_batch : max_batch + 30])
+        if stage_stats_out is not None:
+            for sched in scheds:
+                sched.extender.tracer.enabled = True
         streams = [
             StreamScheduler(s, max_batch=max_batch, max_retries=200)
             for s in scheds
@@ -1220,6 +1231,28 @@ def _sharded_stream_run(
                 th.join()
             wall = time.perf_counter() - t0
         backlog = sum(st.backlog() for st in streams)
+        if stage_stats_out is not None:
+            for si, sched in enumerate(scheds):
+                stage_stats_out[si] = _stage_stats(
+                    sched.extender.tracer.records()
+                )
+            if TRACE_PATH:
+                from koordinator_tpu.obs.fleet import merge_chrome_traces
+
+                path = (
+                    f"{TRACE_PATH.removesuffix('.json')}"
+                    f"_latency_stream_sharded.json"
+                )
+                with open(path, "w") as f:
+                    json.dump(
+                        merge_chrome_traces(
+                            {
+                                si: s.extender.tracer
+                                for si, s in enumerate(scheds)
+                            }
+                        ),
+                        f,
+                    )
     return lat, backlog, len(lat), wall
 
 
@@ -1282,6 +1315,33 @@ def bench_latency_stream_sharded():
             "mode": "churn_1_of_4_shards",
         }
     )
+    if STAGE_REPORT or TRACE_PATH:
+        # dedicated traced pass AFTER the measured arms (same
+        # stage-table discipline as _stage_report_pass): per-SHARD
+        # stage breakdowns land in the BENCH entry so the sharded
+        # scenario cites stage structure like the single-leader ones,
+        # and --trace dumps one merged Chrome doc (a process lane per
+        # shard, obs.fleet)
+        per_shard: dict = {}
+        _sharded_stream_run(
+            cpu_dev, 4, rate=AGG_RATE, n_target=2000, isolated=True,
+            stage_stats_out=per_shard,
+        )
+        out["stage_breakdown_ms_per_shard"] = {
+            str(si): {k: v["total_ms"] for k, v in st.items()}
+            for si, st in sorted(per_shard.items())
+        }
+        out["stage_p50_p99_ms_per_shard"] = {
+            str(si): {
+                k: [v["p50_ms"], v["p99_ms"]] for k, v in st.items()
+            }
+            for si, st in sorted(per_shard.items())
+        }
+        if STAGE_REPORT:
+            for si, st in sorted(per_shard.items()):
+                _print_stage_table(
+                    f"latency_stream_sharded shard-{si}", st
+                )
     out["runs"] = runs
     by_shards = {
         r["shards"]: r for r in runs if r["mode"] == "steady"

@@ -393,7 +393,12 @@ class BindJournal:
     ) -> dict:
         """``entries``: per-pod dicts with keys ``uid``, ``node``,
         ``req`` (list), ``est`` (list), ``prod`` (bool), ``nom``
-        (bind-nominal CPU milli), ``conf`` (confirmed flag)."""
+        (bind-nominal CPU milli), ``conf`` (confirmed flag); optionally
+        ``numa``/``dev`` exact holds, ``quota`` leaf, and ``lc`` — the
+        pod's compact lifecycle-trace context (original submit stamp +
+        shard-hop count), carried durably so a takeover's replay can
+        bridge the pod's timeline across a dead incarnation
+        (fleet-tracing PR; consumed by ``runtime.recovery``)."""
         return self._append(
             "bind", epoch, cycle, binds=[dict(e) for e in entries]
         )

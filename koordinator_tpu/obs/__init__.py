@@ -19,26 +19,35 @@ from .errors import (
     ensure_exceptions_counter,
     report_exception,
 )
+from .flightrecorder import FlightRecorder
 from .health import HealthRegistry
+from .lifecycle import LifecycleEvent, PodLifecycle, validate_timeline
 from .rejections import (
     RejectionLog,
     RejectionRecord,
     RejectReason,
     RejectStage,
 )
+from .slo import SloTarget, SloTracker
 from .trace import NULL_TRACER, Span, StageTimer, Tracer
 
 __all__ = [
     "NULL_TRACER",
+    "FlightRecorder",
     "HealthRegistry",
+    "LifecycleEvent",
+    "PodLifecycle",
     "RejectReason",
     "RejectStage",
     "RejectionLog",
     "RejectionRecord",
+    "SloTarget",
+    "SloTracker",
     "Span",
     "StageTimer",
     "Tracer",
     "default_error_registry",
     "ensure_exceptions_counter",
     "report_exception",
+    "validate_timeline",
 ]

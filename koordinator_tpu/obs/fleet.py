@@ -1,0 +1,274 @@
+"""Fleet aggregation: one scrape, one trace, one health view per fleet.
+
+The partitioned control plane runs one registry/tracer/health instance
+per shard runtime; an operator (and the bench harness) wants ONE
+``/metrics`` scrape with a ``shard`` label, ONE Chrome trace with a
+process lane per shard (handoffs linked by flow arrows), and ONE
+``/healthz`` that says which shards this incarnation owns and at what
+epoch. This module merges without touching the per-shard instances —
+each shard's registry stays its own write path (no cross-shard lock
+contention on the hot cycle), aggregation happens at read time.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+
+def expose_with_labels(registry, extra: Mapping[str, str]) -> List[str]:
+    """Re-render a registry's text exposition with ``extra`` labels
+    injected into every sample line (HELP/TYPE lines pass through;
+    dedup happens in :func:`merged_metrics`)."""
+    inject = ",".join(
+        f'{k}="{v}"' for k, v in sorted(extra.items())
+    )
+    out: List[str] = []
+    for line in registry.expose().splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        name_part, _, value = line.rpartition(" ")
+        if "{" in name_part:
+            head, _, rest = name_part.partition("{")
+            out.append(f"{head}{{{inject},{rest} {value}")
+        else:
+            out.append(f"{name_part}{{{inject}}} {value}")
+    return out
+
+
+def merged_metrics(registries: Mapping[int, object]) -> str:
+    """One Prometheus exposition over per-shard registries: every sample
+    gains ``shard="<s>"``. Families are emitted METRIC-major — HELP/TYPE
+    once (first shard wins; the registries are homogeneous by
+    construction), then that family's samples across every shard — so
+    each family forms one contiguous group as the exposition format
+    requires (a shard-major interleave is rejected by strict parsers)."""
+    order: List[str] = []
+    meta: Dict[str, List[str]] = {}
+    samples: Dict[str, List[str]] = {}
+    seen_meta: set = set()
+    for shard in sorted(registries):
+        family = None
+        for line in expose_with_labels(
+            registries[shard], {"shard": str(shard)}
+        ):
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split(" ", 3)
+                family = parts[2] if len(parts) > 2 else line
+            else:
+                # headerless sample (foreign registry): group by the
+                # bare sample name so it still lands in ONE family
+                if family is None:
+                    family = line.split("{", 1)[0].split(" ", 1)[0]
+            if family not in meta:
+                order.append(family)
+                meta[family] = []
+                samples[family] = []
+            if line.startswith("#"):
+                key = tuple(line.split(" ", 3)[:3])
+                if key not in seen_meta:
+                    seen_meta.add(key)
+                    meta[family].append(line)
+            else:
+                samples[family].append(line)
+    out: List[str] = []
+    for family in order:
+        out.extend(meta[family])
+        out.extend(samples[family])
+    return "\n".join(out) + "\n"
+
+
+def merge_chrome_traces(
+    tracers: Mapping[int, object],
+    handoffs: Sequence[Mapping[str, object]] = (),
+) -> Dict[str, object]:
+    """One Chrome ``trace_event`` document over per-shard tracers: each
+    shard renders as its own PROCESS lane (``pid = shard + 1``, named
+    ``shard-<s>``), thread lanes keep their per-shard identity, and each
+    entry of ``handoffs`` — dicts with ``shard``, ``t_out``, ``t_in``
+    (ABSOLUTE readings on the tracers' shared clock; ``t_in`` None for a
+    drain whose successor has not been granted yet), ``from``/``to``
+    incarnation names — becomes a linked flow arrow (``ph "s"``→``"f"``)
+    from the donor's drain instant to the new owner's takeover on that
+    shard's lane, so a pod queue's journey across owners reads as one
+    arrow in Perfetto.
+
+    Clock alignment: each tracer exports span ``ts`` relative to its OWN
+    construction epoch, so lanes from tracers built at different times
+    would drift apart. All lanes (and the handoff stamps) are re-based
+    onto ONE fleet epoch — the earliest tracer epoch — which is valid
+    because every per-shard tracer in an incarnation reads the same
+    underlying monotonic clock."""
+    epoch0 = min(
+        (float(getattr(tr, "epoch", 0.0)) for tr in tracers.values()),
+        default=0.0,
+    )
+    events: List[dict] = []
+    for shard in sorted(tracers):
+        pid = int(shard) + 1
+        tr = tracers[shard]
+        offset_us = (float(getattr(tr, "epoch", 0.0)) - epoch0) * 1e6
+        doc = tr.to_chrome_trace()
+        for ev in doc["traceEvents"]:
+            ev = dict(ev, pid=pid)
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                ev["args"] = {"name": f"shard-{shard}"}
+            elif "ts" in ev:
+                ev["ts"] = round(ev["ts"] + offset_us, 3)
+            events.append(ev)
+    for i, hand in enumerate(handoffs):
+        shard = int(hand.get("shard", 0))
+        pid = shard + 1
+        t_out = (float(hand.get("t_out", epoch0)) - epoch0) * 1e6
+        raw_in = hand.get("t_in")
+        t_in = (
+            t_out
+            if raw_in is None
+            else (float(raw_in) - epoch0) * 1e6
+        )
+        flow_id = i + 1
+        common = {
+            "name": "shard-handoff",
+            "cat": "handoff",
+            "id": flow_id,
+            "pid": pid,
+            "tid": 0,
+        }
+        events.append(
+            dict(
+                common,
+                ph="s",
+                ts=round(t_out, 3),
+                args={"from": hand.get("from", "")},
+            )
+        )
+        events.append(
+            dict(
+                common,
+                ph="f",
+                bp="e",
+                ts=round(max(t_in, t_out + 1e-3), 3),
+                args={"to": hand.get("to", "")},
+            )
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+class FleetServices:
+    """HTTP-shaped dispatch over a :class:`ShardedScheduler` incarnation:
+
+      /metrics               — merged per-shard registries, shard label
+      /healthz               — ownership/epoch rows per shard (200/503)
+      /slo                   — the incarnation's SLO tracker state
+      /trace                 — merged Chrome trace, one lane per shard
+      /debug/flightrecorder  — every owned shard's recorder (recovered
+                               records of dead incarnations included)
+      /debug/pipeline        — per-shard speculation-gate verdicts
+                               (forwarded to each runtime's engine)
+
+    Built lazily by ``ShardedScheduler.fleet`` — read-only, no state of
+    its own, so it is always consistent with live ownership."""
+
+    def __init__(self, sharded):
+        self.sharded = sharded
+
+    # ---- views over live ownership ----
+
+    def _registries(self) -> Dict[int, object]:
+        return {
+            s: rt.sched.extender.registry
+            for s, rt in sorted(self.sharded._runtimes.items())
+        }
+
+    def _tracers(self) -> Dict[int, object]:
+        return {
+            s: rt.sched.extender.tracer
+            for s, rt in sorted(self.sharded._runtimes.items())
+        }
+
+    def healthz(self) -> Tuple[bool, dict]:
+        sh = self.sharded
+        rows: Dict[str, dict] = {}
+        ok = True
+        for s in range(sh.fabric.n_shards):
+            owned = sh.owns(s)
+            rt = sh.runtime(s)
+            row = {
+                "owned": owned,
+                "epoch": (
+                    rt.sched._fence_epoch
+                    if (owned and rt is not None)
+                    else sh.fabric.fences[s].current()
+                ),
+                "backlog": sh.backlog(s),
+            }
+            if owned and rt is not None:
+                sub_ok = rt.sched.extender.health.ok()
+                row["health_ok"] = sub_ok
+                ok = ok and sub_ok
+            rows[str(s)] = row
+        return ok, {
+            "ok": ok,
+            "incarnation": sh.name,
+            "owned": sh.owned(),
+            "shards": rows,
+        }
+
+    # ---- dispatch ----
+
+    def dispatch(
+        self, method: str, path: str, body: str = ""
+    ) -> Tuple[int, str]:
+        if path == "/metrics":
+            regs = self._registries()
+            text = merged_metrics(regs) if regs else "\n"
+            lc = self.sharded.lifecycle
+            if lc is not None and lc.registry is not None:
+                # the lifecycle tracker is incarnation-level and its
+                # histogram already labels by shard — append verbatim
+                # instead of routing through the shard-label injection
+                text += lc.registry.expose()
+            return 200, text
+        if path == "/healthz":
+            ok, doc = self.healthz()
+            return (200 if ok else 503), json.dumps(
+                doc, indent=1, sort_keys=True
+            )
+        if path == "/slo":
+            slo = self.sharded.slo
+            if slo is None:
+                return 404, "no SLO tracker wired"
+            return 200, slo.render()
+        if path == "/trace":
+            return 200, json.dumps(
+                merge_chrome_traces(
+                    self._tracers(), self.sharded.handoff_log
+                )
+            )
+        if path == "/debug/pipeline":
+            shards = {
+                str(s): json.loads(
+                    rt.sched.extender.services.dispatch(
+                        "GET", "/debug/pipeline"
+                    )[1]
+                )
+                for s, rt in sorted(self.sharded._runtimes.items())
+            }
+            return 200, json.dumps(
+                {"incarnation": self.sharded.name, "shards": shards},
+                indent=1,
+            )
+        if path == "/debug/flightrecorder":
+            shards = {}
+            for s, rt in sorted(self.sharded._runtimes.items()):
+                fr = getattr(rt.sched, "flight_recorder", None)
+                if fr is not None:
+                    shards[str(s)] = json.loads(fr.render())
+            return 200, json.dumps(
+                {"incarnation": self.sharded.name, "shards": shards},
+                indent=1,
+            )
+        return 404, "not found"

@@ -1,0 +1,369 @@
+"""Fleet-wide pod-lifecycle tracing (distributed-observability tentpole).
+
+PR 1's Span/Tracer answers "where did CYCLE time go" inside one process;
+this module answers "where did THIS POD's time go" across the partitioned
+control plane: a per-pod trace context threaded from
+``StreamScheduler.submit`` through ``ShardRouter`` route/fan-out, the
+single-winner claim, queue wait, solve dispatch, commit and bind-ack —
+with shard handoffs, crash orphaning and journal-replay recovery recorded
+as first-class events, so a pod that crossed three incarnations still has
+ONE contiguous timeline.
+
+Two consumers drive the design:
+
+* the ``placement_latency_seconds{shard,stage}`` histogram — the per-pod
+  placement-latency SLO signal (arrival→ack end to end, decomposed into
+  route/queue/claim/solve/commit), which the SLO layer (:mod:`.slo`) and
+  the learned-policy roadmap item both read;
+* the gap-free-timeline invariant the multi-shard chaos soak asserts:
+  every placed pod's events are time-ordered, start at ``submit``, end at
+  ``ack``, and every shard/incarnation transition is bracketed by
+  handoff/orphan/recover events (:func:`validate_timeline`).
+
+Crash survival: the tracker itself is in-memory, but the scheduler embeds
+each pod's compact context (:meth:`PodLifecycle.context`) into the bind
+journal's record, so a takeover's replay can emit a ``recover`` event
+carrying the ORIGINAL submit stamp — the timeline bridges the dead
+incarnation instead of restarting at the new one.
+
+``lifecycle=None`` stays the default everywhere it is threaded: the
+disabled path is one attribute-is-None check, same contract as the
+tracer's no-op singleton.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+#: terminal stages: the pod's placement story is over
+_TERMINAL = frozenset({"ack", "gone"})
+
+#: event stages a timeline may contain (validator vocabulary)
+STAGES = frozenset(
+    {
+        "submit",      # arrival at the control plane (the SLO clock start)
+        "route",       # ShardRouter picked the pod's primary shard
+        "fanout",      # backlog spill: also enqueued on a spill shard
+        "enqueue",     # landed in a shard owner's stream queue
+        "resubmit",    # re-enqueued from a handoff with original stamps
+        "claim",       # won the cross-shard single-winner claim
+        "claim_lost",  # lost the claim (another shard schedules it)
+        "dispatch",    # fed into a scheduling cycle's batch
+        "decide",      # cycle produced a verdict (node or None)
+        "handoff",     # surfaced from a donor's queue at shard handoff
+        "orphan",      # owner died with the pod queued/in flight
+        "recover",     # journal replay restored the acknowledged bind
+        "ack",         # bind acknowledged / published (terminal)
+        "gone",        # pod deleted before placement (terminal)
+    }
+)
+
+#: default histogram buckets (seconds): sub-ms in-process pumps up to the
+#: multi-cycle waits a leaderless gap produces
+LATENCY_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+@dataclass
+class LifecycleEvent:
+    """One step of a pod's placement journey."""
+
+    stage: str
+    t: float
+    #: shard the event happened on (-1 = not shard-scoped, e.g. submit)
+    shard: int = -1
+    #: free detail: node name on decide/ack, incarnation on orphan, …
+    detail: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "stage": self.stage,
+            "t": self.t,
+            "shard": self.shard,
+            "detail": self.detail,
+        }
+
+
+class PodLifecycle:
+    """Thread-safe per-pod event timeline + placement-latency histogram.
+
+    ``clock`` supplies timestamps when an event's caller has none (the
+    sharded soak injects its sim clock so timelines are deterministic);
+    callers that DO know the instant (StreamScheduler's arrival stamps)
+    pass ``t=`` explicitly so the latency math matches the stream's own.
+
+    ``registry`` (a ``utils.metrics.Registry``) receives
+    ``placement_latency_seconds{shard,stage}``; pass the fleet registry
+    to fold the histogram into the merged scrape.
+    """
+
+    def __init__(
+        self,
+        registry=None,
+        clock=time.perf_counter,
+        max_pods: int = 200_000,
+    ):
+        self.clock = clock
+        self._events: Dict[str, List[LifecycleEvent]] = {}
+        #: completed uids in COMPLETION order (dict-as-ordered-set), so
+        #: eviction under the max_pods bound drops the oldest finished
+        #: timelines first, deterministically
+        self._done: Dict[str, None] = {}
+        self._lock = threading.Lock()
+        self.max_pods = max_pods
+        #: kept so the fleet scrape can fold this incarnation-level
+        #: registry into /metrics verbatim (its samples already carry
+        #: their own shard label — no fleet-side injection)
+        self.registry = registry
+        self.histogram = None
+        if registry is not None:
+            self.histogram = registry.histogram(
+                "placement_latency_seconds",
+                "per-pod placement latency, arrival to bind-ack, "
+                "decomposed by lifecycle stage (stage=e2e is the whole "
+                "journey)",
+                labels=("shard", "stage"),
+                buckets=LATENCY_BUCKETS,
+            )
+
+    # ---- recording ----
+
+    def event(
+        self,
+        uid: str,
+        stage: str,
+        shard: int = -1,
+        t: Optional[float] = None,
+        detail: str = "",
+    ) -> None:
+        ev = LifecycleEvent(
+            stage=stage,
+            t=self.clock() if t is None else t,
+            shard=int(shard),
+            detail=detail,
+        )
+        with self._lock:
+            evs = self._events.get(uid)
+            if evs is None:
+                if len(self._events) >= self.max_pods:
+                    # bounded: drop the oldest COMPLETED timelines first
+                    # (an unbounded tracker would leak for the fleet's
+                    # lifetime); if none are left — a fleet whose churn
+                    # is dominated by never-placed pods, which have no
+                    # terminal event — fall back to the oldest OPEN
+                    # timelines so the bound still holds
+                    victims = list(self._done)[
+                        : max(1, self.max_pods // 10)
+                    ]
+                    if not victims:
+                        victims = [
+                            u
+                            for u in self._events
+                            if u not in self._done
+                        ][: max(1, self.max_pods // 10)]
+                    for old_uid in victims:
+                        self._events.pop(old_uid, None)
+                        self._done.pop(old_uid, None)
+                evs = self._events[uid] = []
+            evs.append(ev)
+            if stage in _TERMINAL:
+                self._done[uid] = None
+
+    # stage-specific helpers keep call sites short and the stage names
+    # in ONE vocabulary (typos would silently break the validator)
+
+    def submitted(self, uid: str, t: Optional[float] = None) -> None:
+        self.event(uid, "submit", t=t)
+
+    def routed(
+        self, uid: str, shard: int, t: Optional[float] = None,
+        detail: str = "",
+    ) -> None:
+        self.event(uid, "route", shard=shard, t=t, detail=detail)
+
+    def acked(
+        self,
+        uid: str,
+        shard: int,
+        node: str,
+        t: Optional[float] = None,
+    ) -> Optional[float]:
+        """Terminal acknowledgement: record the event AND observe the
+        per-stage latency decomposition into the histogram. Returns the
+        end-to-end latency (first submit → this ack, on the tracker's
+        clock domain) so the caller can feed its SLO sample without
+        mixing time domains, or None if the submit was never seen."""
+        t = self.clock() if t is None else t
+        self.event(uid, "ack", shard=shard, t=t, detail=node)
+        self._observe(uid, shard, t)
+        with self._lock:
+            evs = self._events.get(uid, ())
+            t0 = next((e.t for e in evs if e.stage == "submit"), None)
+        return None if t0 is None else max(0.0, t - t0)
+
+    def seen(self, uid: str) -> bool:
+        with self._lock:
+            return uid in self._events
+
+    # ---- the histogram decomposition ----
+
+    def _observe(self, uid: str, shard: int, t_ack: float) -> None:
+        if self.histogram is None:
+            return
+        with self._lock:
+            evs = list(self._events.get(uid, ()))
+        last: Dict[str, float] = {}
+        first_submit: Optional[float] = None
+        for ev in evs:
+            last[ev.stage] = ev.t
+            if first_submit is None and ev.stage == "submit":
+                first_submit = ev.t
+        if first_submit is None:
+            return
+        sh = str(shard)
+        obs = self.histogram.observe
+        obs(max(0.0, t_ack - first_submit), shard=sh, stage="e2e")
+        # stage spans from LAST occurrences (retries/handoffs re-enter
+        # earlier stages; the final successful pass is what the SLO sees)
+        enq = last.get("enqueue", last.get("resubmit"))
+        if enq is not None:
+            obs(max(0.0, enq - first_submit), shard=sh, stage="route")
+        claim = last.get("claim")
+        disp = last.get("dispatch")
+        # unsharded streams have no claim gate: queue wait then runs
+        # enqueue→dispatch instead of enqueue→claim
+        qref = claim if claim is not None else disp
+        if qref is not None and enq is not None:
+            obs(max(0.0, qref - enq), shard=sh, stage="queue")
+        if disp is not None and claim is not None:
+            obs(max(0.0, disp - claim), shard=sh, stage="claim")
+        dec = last.get("decide", last.get("recover"))
+        if dec is not None and disp is not None:
+            obs(max(0.0, dec - disp), shard=sh, stage="solve")
+        if dec is not None:
+            obs(max(0.0, t_ack - dec), shard=sh, stage="commit")
+
+    # ---- journal context (crash survival) ----
+
+    def context(self, uid: str) -> Optional[Dict[str, object]]:
+        """Compact context the scheduler embeds in the pod's bind-journal
+        record: the ORIGINAL submit stamp and the shard-hop count. A
+        takeover's replay hands it back to :meth:`recovered` so the
+        bridged timeline keeps the true arrival time."""
+        with self._lock:
+            evs = self._events.get(uid)
+            if not evs:
+                return None
+            t0 = next(
+                (e.t for e in evs if e.stage == "submit"), evs[0].t
+            )
+            hops = len({e.shard for e in evs if e.shard >= 0})
+        return {"t0": t0, "hops": hops}
+
+    def recovered(
+        self,
+        uid: str,
+        shard: int,
+        node: str,
+        ctx: Optional[Dict[str, object]] = None,
+        t: Optional[float] = None,
+    ) -> None:
+        """Journal replay restored this pod's acknowledged bind on a new
+        incarnation. If the tracker never saw the pod submit (a genuinely
+        fresh process), the journaled context re-seeds the timeline."""
+        with self._lock:
+            fresh = uid not in self._events
+            done = uid in self._done
+        if done:
+            return  # already terminal: replay of an old bind, no gap
+        if fresh and ctx and "t0" in ctx:
+            self.event(uid, "submit", t=float(ctx["t0"]))
+        self.event(uid, "recover", shard=shard, t=t, detail=node)
+
+    def is_done(self, uid: str) -> bool:
+        with self._lock:
+            return uid in self._done
+
+    # ---- inspection ----
+
+    def timeline(self, uid: str) -> List[LifecycleEvent]:
+        with self._lock:
+            return list(self._events.get(uid, ()))
+
+    def uids(self) -> List[str]:
+        with self._lock:
+            return list(self._events)
+
+    def render(self, uid: str) -> str:
+        return json.dumps(
+            [e.to_dict() for e in self.timeline(uid)], indent=1
+        )
+
+
+def validate_timeline(
+    events: Sequence[LifecycleEvent], require_terminal: bool = True
+) -> List[str]:
+    """Gap-free-timeline check (the chaos-soak invariant). Returns a
+    list of problems (empty = valid):
+
+    * non-empty, first event is ``submit``, timestamps non-decreasing;
+    * every stage is in the known vocabulary;
+    * ``dispatch`` only after the pod entered a queue (enqueue/resubmit)
+      — a dispatch with no enqueue means a shard fed a pod it never
+      admitted;
+    * ``ack`` only after a ``decide``/``recover`` produced a node — an
+      ack out of nowhere means the driver observed a bind the control
+      plane never decided (the lost-ack gap);
+    * after an ``orphan`` (owner died), the next placement-path event
+      must be ``resubmit``/``recover``/``enqueue`` — the bridge across
+      the dead incarnation;
+    * terminal: ends at ``ack``/``gone`` when ``require_terminal``.
+    """
+    problems: List[str] = []
+    if not events:
+        return ["empty timeline"]
+    if events[0].stage != "submit":
+        problems.append(f"starts at {events[0].stage!r}, not submit")
+    t_prev = events[0].t
+    queued = False
+    decided = False
+    orphaned = False
+    for i, ev in enumerate(events):
+        if ev.stage not in STAGES:
+            problems.append(f"[{i}] unknown stage {ev.stage!r}")
+            continue
+        if ev.t < t_prev - 1e-9:
+            problems.append(
+                f"[{i}] time went backwards: {ev.t} < {t_prev} "
+                f"at {ev.stage}"
+            )
+        t_prev = max(t_prev, ev.t)
+        if ev.stage in ("enqueue", "resubmit"):
+            queued = True
+            if orphaned and ev.stage == "enqueue":
+                orphaned = False  # driver re-routed the orphan
+        if ev.stage in ("decide", "recover"):
+            decided = True
+        if ev.stage == "dispatch" and not queued:
+            problems.append(f"[{i}] dispatch before any enqueue")
+        if ev.stage == "ack" and not decided:
+            problems.append(f"[{i}] ack without a decide/recover")
+        if orphaned and ev.stage in ("dispatch", "decide", "ack"):
+            problems.append(
+                f"[{i}] {ev.stage} after orphan without "
+                "resubmit/recover/enqueue bridge"
+            )
+        if ev.stage == "orphan":
+            orphaned = True
+            queued = False
+        if ev.stage in ("resubmit", "recover"):
+            orphaned = False
+    if require_terminal and events[-1].stage not in _TERMINAL:
+        problems.append(f"ends at {events[-1].stage!r}, not terminal")
+    return problems

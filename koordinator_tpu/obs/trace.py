@@ -78,12 +78,12 @@ class _LiveSpan:
         self._depth = getattr(local, "depth", 0)
         local.depth = self._depth + 1
         self._tid = threading.get_ident()
-        self._t0 = time.perf_counter()
+        self._t0 = tr.clock()
         return self
 
     def __exit__(self, *exc) -> None:
-        t1 = time.perf_counter()
         tr = self._tracer
+        t1 = tr.clock()
         tr._local.depth = self._depth
         tr._append(
             Span(
@@ -103,40 +103,50 @@ class StageTimer:
 
     The histogram is observed unconditionally — metric continuity must
     not depend on whether tracing is sampled on — while the span follows
-    the tracer's enabled state."""
+    the tracer's enabled state. Timing reads the tracer's injectable
+    monotonic ``clock`` (the default is ``time.perf_counter``), so tests
+    can pin stage durations with a fake clock instead of asserting
+    against contention-sensitive wall time. ``last_dur`` holds the most
+    recent stage duration for per-cycle consumers (flight recorder)."""
 
-    __slots__ = ("_span", "_histogram", "_labels", "_t0")
+    __slots__ = ("_tracer", "_span", "_histogram", "_labels", "_t0",
+                 "last_dur")
 
     def __init__(self, tracer: "Tracer", name: str, histogram=None,
                  cat: str = "", labels: Optional[Dict[str, str]] = None,
                  **args):
+        self._tracer = tracer
         self._span = tracer.span(name, cat=cat, **args)
         self._histogram = histogram
         self._labels = labels or {}
+        self.last_dur = 0.0
 
     def set(self, **args) -> None:
         self._span.set(**args)
 
     def __enter__(self) -> "StageTimer":
-        self._t0 = time.perf_counter()
+        self._t0 = self._tracer.clock()
         self._span.__enter__()
         return self
 
     def __exit__(self, *exc) -> None:
         self._span.__exit__(*exc)
+        self.last_dur = self._tracer.clock() - self._t0
         if self._histogram is not None:
-            self._histogram.observe(
-                time.perf_counter() - self._t0, **self._labels
-            )
+            self._histogram.observe(self.last_dur, **self._labels)
 
 
 class StageSequence:
     """Contiguous stage spans: ``enter(name)`` closes the previous stage
     and opens the next, so a cycle's stages tile its wall time (the
     ≥95%-coverage property the trace endpoint promises). Each stage also
-    observes ``histogram`` with a ``stage`` label when one is given."""
+    observes ``histogram`` with a ``stage`` label when one is given, and
+    accumulates into ``totals`` (stage → seconds for THIS sequence) so a
+    per-cycle consumer — the flight recorder — gets the cycle's own
+    stage breakdown without scraping the cumulative histogram."""
 
-    __slots__ = ("_tracer", "_histogram", "_cat", "_args", "_cur")
+    __slots__ = ("_tracer", "_histogram", "_cat", "_args", "_cur",
+                 "_cur_name", "totals")
 
     def __init__(self, tracer: "Tracer", histogram=None, cat: str = "", **args):
         self._tracer = tracer
@@ -144,6 +154,8 @@ class StageSequence:
         self._cat = cat
         self._args = args
         self._cur: Optional[StageTimer] = None
+        self._cur_name: Optional[str] = None
+        self.totals: Dict[str, float] = {}
 
     def enter(self, name: str) -> None:
         self.close()
@@ -157,6 +169,7 @@ class StageSequence:
         )
         st.__enter__()
         self._cur = st
+        self._cur_name = name
 
     def set(self, **args) -> None:
         if self._cur is not None:
@@ -165,7 +178,11 @@ class StageSequence:
     def close(self) -> None:
         if self._cur is not None:
             self._cur.__exit__(None, None, None)
+            self.totals[self._cur_name] = (
+                self.totals.get(self._cur_name, 0.0) + self._cur.last_dur
+            )
             self._cur = None
+            self._cur_name = None
 
 
 class Tracer:
@@ -173,16 +190,33 @@ class Tracer:
 
     ``enabled`` toggles sampling at runtime (the services engine's POST
     /trace flips it); the ring keeps the most recent ``capacity``
-    finished spans. The epoch is the tracer's construction instant on
-    ``time.perf_counter`` — every exported timestamp is relative to it.
+    finished spans. ``clock`` is the monotonic time source every span
+    and :class:`StageTimer` reads (default ``time.perf_counter``;
+    inject a fake for deterministic stage timing in tests). The epoch is
+    the tracer's construction instant on that clock — every exported
+    timestamp is relative to it.
     """
 
-    def __init__(self, enabled: bool = False, capacity: int = 65536):
+    def __init__(
+        self,
+        enabled: bool = False,
+        capacity: int = 65536,
+        clock=time.perf_counter,
+    ):
         self.enabled = enabled
-        self.epoch = time.perf_counter()
+        self.clock = clock
+        self.epoch = clock()
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._local = threading.local()
+
+    def set_clock(self, clock) -> None:
+        """Swap the time source (tests): re-anchors the epoch so exported
+        timestamps stay non-negative, and clears spans recorded on the
+        old clock — mixed-domain durations are meaningless."""
+        self.clock = clock
+        self.epoch = clock()
+        self.clear()
 
     # -- recording --
 

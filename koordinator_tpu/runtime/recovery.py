@@ -50,6 +50,9 @@ class RecoveryReport:
     quota_charges: int = 0
     open_intents: int = 0
     warm_lower_s: float = 0.0
+    #: wall time of the whole recovery sequence (resync + replay +
+    #: re-lower) — the time-to-recover SLI the SLO layer samples
+    duration_s: float = 0.0
     bitexact: Optional[bool] = None
     #: uid -> node for every acknowledged binding the journal preserved —
     #: the control plane reconciles its pending queue against this
@@ -132,6 +135,12 @@ def recover_scheduler(
     rep = RecoveryReport(epoch=epoch if epoch is not None else 0)
     health.set("recovery", False, "recovery in progress")
     t0 = _time.perf_counter()
+    # fleet-tracing PR: replayed bindings re-enter the lifecycle tracker
+    # as ``recover`` events, seeded from the journaled compact context
+    # ("lc": original submit stamp + hop count) so a pod that crossed
+    # the dead incarnation keeps ONE timeline with its TRUE arrival
+    lifecycle = getattr(sched, "lifecycle", None)
+    lc_shard = journal.shard if journal.shard is not None else -1
     if hub is not None:
         rep.synced = hub.wait_synced(sync_timeout_s)
     replay = journal.replay()
@@ -168,6 +177,10 @@ def recover_scheduler(
                 snap.confirm_pod(uid)
                 sched._bound_nodes.setdefault(uid, node)
                 _restore_exact_holds(sched, uid, node, entry)
+                if lifecycle is not None:
+                    lifecycle.recovered(
+                        uid, lc_shard, node, ctx=entry.get("lc")
+                    )
                 rep.reconfirmed += 1
                 continue
             idx = snap.node_id(node)
@@ -191,6 +204,10 @@ def recover_scheduler(
             )
             sched._bound_nodes[uid] = node
             _restore_exact_holds(sched, uid, node, entry)
+            if lifecycle is not None:
+                lifecycle.recovered(
+                    uid, lc_shard, node, ctx=entry.get("lc")
+                )
             leaf = entry.get("quota")
             if (
                 rebuild_quotas
@@ -248,10 +265,11 @@ def recover_scheduler(
         # and the scheduler could never commit again
         sched._fence_epoch = replay.epoch_high
         rep.epoch = replay.epoch_high
+    rep.duration_s = _time.perf_counter() - t0
     health.set(
         "recovery",
         True,
-        f"recovered in {(_time.perf_counter() - t0) * 1e3:.1f}ms: "
+        f"recovered in {rep.duration_s * 1e3:.1f}ms: "
         f"replayed={rep.replayed} reconfirmed={rep.reconfirmed} "
         f"skipped={rep.skipped_missing_node} "
         f"open_intents={rep.open_intents}",

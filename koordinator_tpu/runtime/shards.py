@@ -43,8 +43,9 @@ from __future__ import annotations
 
 import threading
 import time as _time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..chaos import NULL_INJECTOR
 from ..core.journal import BindJournal, ClaimTable, EpochFence, StaleEpochError
@@ -130,6 +131,8 @@ class ShardFabric:
         journal_stores: Optional[Dict[int, object]] = None,
         claim_store=None,
         membership_ttl_s: float = 3.0,
+        flight_stores: Optional[Dict[int, object]] = None,
+        handoff_log_cap: int = 1024,
     ):
         from ..core.journal import MemoryJournalStore
 
@@ -142,6 +145,28 @@ class ShardFabric:
         self.journal_stores: Dict[int, object] = journal_stores or {
             s: MemoryJournalStore() for s in range(n_shards)
         }
+        #: per-shard flight-recorder stores (fleet-tracing PR): the
+        #: crash-surviving black box lives BESIDE the shard's journal —
+        #: same durability substrate, so a takeover that can replay the
+        #: journal can also read the dead owner's last-N cycle summaries
+        self.flight_stores: Dict[int, object] = flight_stores or {
+            s: MemoryJournalStore() for s in range(n_shards)
+        }
+        #: fleet-tracing PR: seam-matched shard-handoff instants, shared
+        #: across incarnations like the stores — the donor logs its
+        #: drain (``t_out``, ``t_in`` None) and the takeover completes
+        #: the open seam (``t_in``/``to``), so the merged Chrome trace
+        #: draws ONE flow arrow spanning the ownership gap. Stamps read
+        #: the runtimes' TRACER clock (not the fabric's lease clock) so
+        #: arrows land on the span time axis. Bounded like every other
+        #: retention surface (tracer ring, flight recorder, lifecycle
+        #: eviction): the oldest seams fall off a full deque, so a
+        #: fleet rebalancing for months cannot grow the fabric.
+        self.handoff_log: Deque[dict] = deque(maxlen=int(handoff_log_cap))
+        #: guards the seam log's find-then-close read-modify-write: the
+        #: log is shared across incarnations (possibly on different
+        #: threads) and a deque raises if mutated mid-iteration
+        self.handoff_lock = threading.Lock()
         self.locks = LeaseLockSet()
         self.claims = ClaimTable(claim_store)
         self.membership = Membership(membership_ttl_s, clock=clock)
@@ -168,6 +193,7 @@ class ShardRouter:
         shard_map: ShardMap,
         quota_of=None,
         spill_backlog: Optional[int] = None,
+        lifecycle=None,
     ):
         self.shard_map = shard_map
         if quota_of is None:
@@ -176,14 +202,30 @@ class ShardRouter:
             quota_of = quota_name_of
         self.quota_of = quota_of
         self.spill_backlog = spill_backlog
+        #: fleet-tracing PR: when wired, route/fan-out decisions become
+        #: lifecycle events (pods the tracker never saw get their
+        #: ``submit`` anchor here — the router IS the control plane's
+        #: front door for fresh pods)
+        self.lifecycle = lifecycle
 
     def route(self, pod) -> int:
         if pod.spec.node_name:
-            return self.shard_map.shard_of_node(pod.spec.node_name)
-        leaf = self.quota_of(pod)
-        if leaf is not None:
-            return self.shard_map.shard_of_key(f"quota:{leaf}")
-        return self.shard_map.shard_of_key(pod.meta.uid)
+            shard = self.shard_map.shard_of_node(pod.spec.node_name)
+            detail = "node-pinned"
+        else:
+            leaf = self.quota_of(pod)
+            if leaf is not None:
+                shard = self.shard_map.shard_of_key(f"quota:{leaf}")
+                detail = f"quota-home:{leaf}"
+            else:
+                shard = self.shard_map.shard_of_key(pod.meta.uid)
+                detail = "uid-hash"
+        lc = self.lifecycle
+        if lc is not None:
+            if not lc.seen(pod.meta.uid):
+                lc.submitted(pod.meta.uid)
+            lc.routed(pod.meta.uid, shard, detail=detail)
+        return shard
 
     def targets(self, pod, backlog_of=None) -> List[int]:
         """Shards to enqueue the pod on: ``[primary]`` normally,
@@ -200,6 +242,11 @@ class ShardRouter:
         ):
             return [primary]
         spill = (primary + 1) % self.shard_map.n_shards
+        if self.lifecycle is not None:
+            self.lifecycle.event(
+                pod.meta.uid, "fanout", shard=spill,
+                detail=f"primary-backlog>{self.spill_backlog}",
+            )
         return [primary, spill]
 
 
@@ -255,6 +302,9 @@ class ShardedScheduler:
         verify_recovery: bool = True,
         chaos=None,
         clock: Optional[Callable[[], float]] = None,
+        lifecycle=None,
+        slo=None,
+        flight_capacity: int = 256,
     ):
         self.name = name
         self.hub = hub
@@ -267,6 +317,15 @@ class ShardedScheduler:
         self.chaos = chaos or NULL_INJECTOR
         self.clock = clock or fabric.clock
         self.dead = False
+        #: distributed observability (fleet-tracing PR): the shared
+        #: per-pod lifecycle tracker and per-shard SLO tracker this
+        #: incarnation's streams/recovery feed; per-shard crash-surviving
+        #: flight recorders (over ``fabric.flight_stores``) attach at
+        #: runtime build. All optional — None keeps every hot path on
+        #: the one-attribute-check disabled contract.
+        self.lifecycle = lifecycle
+        self.slo = slo
+        self.flight_capacity = int(flight_capacity)
         self._runtimes: Dict[int, ShardRuntime] = {}
         self._handoffs: Dict[int, ShardHandoff] = {}
         self.stats = {
@@ -347,11 +406,24 @@ class ShardedScheduler:
             # shards keep serving
             self.hub.detach(rt.informers)
             self.stats["handoffs"] += 1
+            # open the handoff seam on the shared log: the takeover side
+            # (_note_takeover, possibly on ANOTHER incarnation) closes it
+            with self.fabric.handoff_lock:
+                self.fabric.handoff_log.append(
+                    {
+                        "shard": shard,
+                        "t_out": rt.sched.extender.tracer.clock(),
+                        "t_in": None,
+                        "from": self.name,
+                        "to": "",
+                    }
+                )
 
         return on_loss
 
     def _build_runtime(self, shard: int) -> ShardRuntime:
         from ..core.snapshot import ClusterSnapshot
+        from ..obs.flightrecorder import FlightRecorder
 
         flt = self.fabric.shard_map.node_filter(shard)
         snap = ClusterSnapshot()
@@ -364,6 +436,20 @@ class ShardedScheduler:
             fence=self.fabric.fences[shard],
             journal=journal,
         )
+        # crash-surviving flight recorder: the per-cycle black box lives
+        # over the FABRIC's per-shard store (beside the journal), so
+        # building a runtime here ADOPTS whatever tail the shard's dead
+        # previous owner left — /debug/flightrecorder on the takeover
+        # serves the last-N cycles of the incarnation that crashed
+        sched.attach_flight_recorder(
+            FlightRecorder(
+                self.fabric.flight_stores[shard],
+                capacity=self.flight_capacity,
+                shard=shard,
+                incarnation=self.name,
+                clock=self.clock,
+            )
+        )
         informers = self.hub.wire_scheduler(sched, node_filter=flt)
         self.hub.start()
         stream_cls = self._stream_cls()
@@ -373,6 +459,9 @@ class ShardedScheduler:
             max_retries=self.max_retries,
             pipelined=self.pipelined,
             feed_gate=lambda pod, _s=shard: self._claim(_s, pod),
+            lifecycle=self.lifecycle,
+            slo=self.slo,
+            shard=shard,
         )
         rt = ShardRuntime(
             shard=shard,
@@ -406,6 +495,12 @@ class ShardedScheduler:
         )
         if not won:
             self.stats["claims_lost"] += 1
+        if self.lifecycle is not None:
+            self.lifecycle.event(
+                pod.meta.uid,
+                "claim" if won else "claim_lost",
+                shard=shard,
+            )
         return won
 
     # ---- public surface ----
@@ -428,6 +523,16 @@ class ShardedScheduler:
         rt = self._runtimes.get(shard)
         return rt.stream.backlog() if rt is not None else 0
 
+    def fleet(self):
+        """The incarnation's fleet-aggregation surface (one ``/metrics``
+        scrape with a ``shard`` label, merged Chrome trace, per-shard
+        ownership/epoch ``/healthz`` rows, ``/slo``,
+        ``/debug/flightrecorder``). Read-only over live ownership —
+        build on demand, never cached."""
+        from ..obs.fleet import FleetServices
+
+        return FleetServices(self)
+
     def tick(self) -> Dict[int, ShardHandoff]:
         """One election step across every shard: heartbeat, renew owned
         leases, voluntarily hand off shards whose rendezvous-designated
@@ -447,8 +552,60 @@ class ShardedScheduler:
             coord.tick()
             if coord.leading and not was:
                 self.stats["takeovers"] += 1
+                self._note_takeover(s, coord)
         out, self._handoffs = self._handoffs, {}
         return out
+
+    @property
+    def handoff_log(self) -> List[dict]:
+        """The FLEET's seam-matched handoff instants (shared on the
+        fabric): the flow-arrow feed for the merged Chrome trace.
+        A snapshot — another incarnation may be appending a seam while
+        a /trace render iterates, and a deque refuses that mix."""
+        with self.fabric.handoff_lock:
+            return [dict(e) for e in self.fabric.handoff_log]
+
+    def _note_takeover(self, shard: int, coord) -> None:
+        """Observability bookkeeping for a takeover that just recovered:
+        one time-to-recover SLO sample, and the takeover instant closing
+        the shard's OPEN handoff seam on the fabric's shared log (the
+        donor's ``t_out`` was logged — possibly by another incarnation —
+        at drain time; a crash takeover has no drained seam to close and
+        logs a point entry instead).
+
+        The shard's FIRST-ever grant (fence epoch 1) is a cold start,
+        not a takeover: no handoff entry (nothing was handed off — the
+        startup fleet would otherwise render one spurious arrow per
+        shard) and no ``recovery`` SLO sample (the cold statehub sync is
+        the slowest recovery there is; sampling it would burn the
+        failover error budget before any failover happened)."""
+        rt = self._runtimes.get(shard)
+        now = (
+            rt.sched.extender.tracer.clock()
+            if rt is not None
+            else self.clock()
+        )
+        with self.fabric.handoff_lock:
+            for entry in reversed(self.fabric.handoff_log):
+                if entry["shard"] == shard and entry["t_in"] is None:
+                    entry["t_in"] = max(now, entry["t_out"])
+                    entry["to"] = self.name
+                    break
+            else:
+                if self.fabric.fences[shard].current() <= 1:
+                    return  # cold start: not a takeover
+                self.fabric.handoff_log.append(
+                    {
+                        "shard": shard,
+                        "t_out": now,
+                        "t_in": now,
+                        "from": "",
+                        "to": self.name,
+                    }
+                )
+        rec = coord.last_recovery
+        if self.slo is not None and rec is not None:
+            self.slo.observe_recovery(shard, rec.duration_s)
 
     def submit(self, shard: int, pod, now: Optional[float] = None) -> bool:
         rt = self._runtimes.get(shard)
@@ -503,8 +660,17 @@ class ShardedScheduler:
         once the shards' new owners recover."""
         orphans: List[Tuple[int, object]] = []
         for s, rt in sorted(self._runtimes.items()):
-            for pod, _arr, _tries in rt.stream.extract_queued():
+            # event=None: a killed queue is NOT a graceful drain — the
+            # timeline records orphan (below), never a handoff
+            for pod, _arr, _tries in rt.stream.extract_queued(event=None):
                 orphans.append((s, pod))
+                if self.lifecycle is not None:
+                    # the owner died with the pod queued: the timeline
+                    # must bracket the dead incarnation (a later
+                    # resubmit/enqueue on the new owner bridges it)
+                    self.lifecycle.event(
+                        pod.meta.uid, "orphan", shard=s, detail=self.name
+                    )
             rt.stream.close()
             self.hub.detach(rt.informers)
             self._coords[s].leading = False

@@ -514,6 +514,20 @@ class BatchScheduler:
         self.bind_journal = journal
         self.fence = fence
         self._fence_epoch = 0
+        #: distributed observability (fleet-tracing PR): optional per-pod
+        #: lifecycle tracker (obs.lifecycle.PodLifecycle) — when wired,
+        #: bind-journal entries carry the pod's compact trace context so
+        #: a takeover's replay can bridge the timeline across the crash;
+        #: optional crash-surviving flight recorder (attach via
+        #: attach_flight_recorder) receiving one per-cycle summary; the
+        #: stream pump hints its backlog depth here for that record
+        self.lifecycle = None
+        self.flight_recorder = None
+        self._queue_depth_hint = 0
+        #: most recent pipeline gate evaluation (set by CyclePipeline)
+        self.last_gate_report: Dict[str, object] = {}
+        self._cycle_fenced = False
+        self._cycle_spec_outcome = ""
         #: periodic journal compaction from the run loop (PR 6
         #: satellite, ROADMAP queued follow-on): after a clean cycle,
         #: compact once at least this many records (or bytes, for file
@@ -534,6 +548,13 @@ class BatchScheduler:
                 journal.chaos = self.chaos
         self.extender.health.set("solver", True)
         self.extender.health.set("commit", True)
+
+    def attach_flight_recorder(self, recorder) -> None:
+        """Wire a crash-surviving flight recorder: every completed cycle
+        appends one summary record, and the services engine serves the
+        ring at ``/debug/flightrecorder``."""
+        self.flight_recorder = recorder
+        self.extender.services.flightrecorder = recorder
 
     # ---- HA: leadership grant/revoke (driven by the LeaderCoordinator) ----
 
@@ -1005,17 +1026,49 @@ class BatchScheduler:
                 return self._schedule_locked(pending, seq, _retry)
             finally:
                 seq.close()
-        with fwext.tracer.stage(
+        cycle_timer = fwext.tracer.stage(
             "cycle",
             fwext.registry.get("cycle_latency_seconds"),
             cat="scheduler",
             cycle=cid,
             pods=len(pending),
-        ):
+        )
+        with cycle_timer:
             try:
-                return self._schedule_locked(pending, seq, _retry)
+                out = self._schedule_locked(pending, seq, _retry)
             finally:
                 seq.close()
+        if self.flight_recorder is not None:
+            self._record_cycle(cid, seq.totals, cycle_timer.last_dur, out)
+        return out
+
+    def _record_cycle(
+        self, cid: int, stage_totals: Dict[str, float],
+        cycle_s: float, out: "ScheduleOutcome",
+    ) -> None:
+        """One flight-recorder record per completed cycle: the black-box
+        summary (per-cycle stage_ms, latest pipeline gate verdicts,
+        speculation outcome, fencing, queue depth) a post-mortem needs
+        when the process does not survive to be asked."""
+        gates = self.last_gate_report
+        self.flight_recorder.record(
+            cid,
+            stage_ms={
+                k: v * 1e3
+                for k, v in dict(
+                    stage_totals, cycle=cycle_s
+                ).items()
+            },
+            gates=dict(gates.get("gates", {})),
+            speculation=self._cycle_spec_outcome or "serial",
+            fenced=self._cycle_fenced,
+            queue_depth=self._queue_depth_hint,
+            bound=len(out.bound),
+            unschedulable=len(out.unschedulable),
+            epoch=self._fence_epoch,
+            rolled_back=self._cycle_commit_rolled_back,
+            deadline_hit=self._cycle_deadline_hit,
+        )
 
     def _schedule_locked(
         self, pending: Sequence[Pod], seq, _retry: bool = False
@@ -1040,6 +1093,8 @@ class BatchScheduler:
             self._cycle_used_spec = False
             self._cycle_reserve_rejected = False
             self._cycle_preempted = False
+            self._cycle_fenced = False
+            self._cycle_spec_outcome = ""
             self._pre_cycle_version = self.snapshot.version
             self._cycle_t0 = _time.perf_counter()
             fwext.monitor.start_batch(pending)
@@ -1341,11 +1396,13 @@ class BatchScheduler:
                 solves = spec.solves
                 sub = spec.sub
                 self._cycle_used_spec = True
+                self._cycle_spec_outcome = "kept"
                 self._numeric_quarantine.update(spec.quarantine)
                 fwext.registry.get("pipeline_speculation_total").labels(
                     outcome="kept"
                 ).inc()
             else:
+                self._cycle_spec_outcome = "discarded"
                 fwext.registry.get("pipeline_speculation_total").labels(
                     outcome="discarded"
                 ).inc()
@@ -2589,6 +2646,35 @@ class BatchScheduler:
             out.append((chunk, rows, result))
         return out
 
+    def speculation_gate_report(self) -> Dict[str, bool]:
+        """Named per-gate verdicts (True = OPEN, the subsystem is absent
+        and speculation may proceed) for the state-bearing speculation
+        gates. One vocabulary serves three consumers: the boolean
+        conjunction below (:meth:`_speculation_consume_ok`), the
+        CyclePipeline's ``pipeline_gate_closed_total{gate}`` attribution
+        and the ``/debug/pipeline`` introspection payload — the evidence
+        base for the "open the speculation gates" roadmap item (which
+        gate keeps each slow config serial)."""
+        fwext = self.extender
+        return {
+            "reservations": self.reservations is None,
+            "mesh": self.mesh is None,
+            "numa": not (self.numa is not None and self.numa.has_topology),
+            "devices": not (
+                self.devices is not None and self.devices.has_devices
+            ),
+            "quotas": self.quotas.quota_count == 0,
+            "transformers": not fwext._pre_batch
+            and not fwext._batch_transformers
+            and fwext.cost_transform is None,
+            "preemption": not self.enable_priority_preemption,
+            "gangs": not self.pod_groups.has_gangs,
+            "sampling": num_nodes_to_score(
+                self.snapshot.node_count, self.percentage_of_nodes_to_score
+            )
+            >= self.snapshot.node_count,
+        }
+
     def _speculation_consume_ok(self) -> bool:
         """State-bearing pipeline gates, re-checked at CONSUME time: a
         gated subsystem can arrive through an informer WITHOUT bumping
@@ -2597,24 +2683,9 @@ class BatchScheduler:
         speculation lowered before that arrival must not be consumed —
         its rows carry no quota chains and its solves ran without the
         subsystem's admission. The CyclePipeline's dispatch gate reuses
-        this plus its batch-content and ladder checks."""
-        fwext = self.extender
-        return (
-            self.reservations is None
-            and self.mesh is None
-            and not (self.numa is not None and self.numa.has_topology)
-            and not (self.devices is not None and self.devices.has_devices)
-            and self.quotas.quota_count == 0
-            and not fwext._pre_batch
-            and not fwext._batch_transformers
-            and fwext.cost_transform is None
-            and not self.enable_priority_preemption
-            and not self.pod_groups.has_gangs
-            and num_nodes_to_score(
-                self.snapshot.node_count, self.percentage_of_nodes_to_score
-            )
-            >= self.snapshot.node_count
-        )
+        this (via :meth:`speculation_gate_report`) plus its
+        batch-content and ladder checks."""
+        return all(self.speculation_gate_report().values())
 
     def last_cycle_spec_safe(self) -> bool:
         """Whether the just-finished cycle left the speculative chain
@@ -3176,6 +3247,14 @@ class BatchScheduler:
                 dev_hold = self.devices.hold_of(pod.meta.uid, node)
                 if dev_hold:
                     entry["dev"] = dev_hold
+            # fleet-tracing PR: the pod's compact lifecycle context rides
+            # in the durable bind record, so a takeover's replay can
+            # bridge the timeline across the dead incarnation with the
+            # ORIGINAL submit stamp (obs.lifecycle.PodLifecycle.context)
+            if self.lifecycle is not None:
+                ctx = self.lifecycle.context(pod.meta.uid)
+                if ctx is not None:
+                    entry["lc"] = ctx
             entries.append(entry)
         return entries
 
@@ -3269,6 +3348,7 @@ class BatchScheduler:
                 f"{fence_detail}",
             )
             self._cycle_reserve_rejected = True
+            self._cycle_fenced = True  # flight-recorder: fenced cycle
             for pod in chunk:
                 self._reserve_reject[pod.meta.uid] = (
                     RejectStage.RESERVE,

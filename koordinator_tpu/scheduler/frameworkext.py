@@ -146,6 +146,16 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "prepare-worker stalls/deaths that degraded a pipelined cycle "
         "to the serial path",
     )
+    # distributed-observability PR: gate introspection — which named
+    # _gates_ok gate kept a cycle serial (the evidence base the "open
+    # the speculation gates" roadmap item works from)
+    reg.counter(
+        "pipeline_gate_closed_total",
+        "pipelined cycles forced serial, attributed to the specific "
+        "closed speculation gate (one increment per closed gate per "
+        "gated cycle)",
+        labels=("gate",),
+    )
     reg.gauge(
         "solver_pipeline_depth",
         "overlapped pipeline stages in flight at the last pump return "
@@ -404,13 +414,18 @@ class DebugFiltersDumper:
 class ServicesEngine:
     """Plugin-installable HTTP API (reference gin engine,
     ``InstallAPIHandler`` at ``app/server.go:337``). Routes:
-      /metrics            — Prometheus exposition
-      /healthz            — per-subsystem degraded/ok aggregate (200/503)
-      /trace              — Chrome trace JSON (GET), sampling on/off (POST)
-      /debug/scores       — last score table (GET), top-N (POST body int)
-      /debug/filters      — filter tally
-      /debug/rejections   — rejection records + per-stage tally
-      /apis/v1/<plugin>/… — handlers installed by plugins
+      /metrics               — Prometheus exposition
+      /healthz               — per-subsystem degraded/ok aggregate (200/503)
+      /trace                 — Chrome trace JSON (GET), sampling (POST)
+      /slo                   — per-shard SLO state (targets, burn rates)
+      /debug/scores          — last score table (GET), top-N (POST body int)
+      /debug/filters         — filter tally
+      /debug/rejections      — rejection records + per-stage tally
+      /debug/pipeline        — speculation-gate introspection (which
+                               named gate keeps this config serial)
+      /debug/flightrecorder  — last-N per-cycle summaries (crash-
+                               surviving black box)
+      /apis/v1/<plugin>/…    — handlers installed by plugins
     """
 
     def __init__(
@@ -428,6 +443,14 @@ class ServicesEngine:
         self.tracer = tracer or Tracer(enabled=False)
         self.rejections = rejections or RejectionLog()
         self.health = health
+        #: wired post-construction by their owners: the SLO tracker
+        #: (ShardedScheduler), the flight recorder (BatchScheduler.
+        #: attach_flight_recorder) and the pipeline's gate-report
+        #: callable (CyclePipeline) — None until then, and the routes
+        #: answer accordingly
+        self.slo = None
+        self.flightrecorder = None
+        self.gate_info: Optional[Callable[[], Dict[str, object]]] = None
         self._routes: Dict[str, Callable[[str], Tuple[int, str]]] = {}
         self._server: Optional[http.server.ThreadingHTTPServer] = None
 
@@ -453,6 +476,18 @@ class ServicesEngine:
                     self.tracer.clear()
                 return 200, str(self.tracer.enabled)
             return 200, self.tracer.export_json()
+        if path == "/slo":
+            if self.slo is None:
+                return 404, "no SLO tracker wired"
+            return 200, self.slo.render()
+        if path == "/debug/pipeline":
+            if self.gate_info is None:
+                return 200, json.dumps({"pipelined": False})
+            return 200, json.dumps(self.gate_info(), indent=1)
+        if path == "/debug/flightrecorder":
+            if self.flightrecorder is None:
+                return 404, "no flight recorder wired"
+            return 200, self.flightrecorder.render()
         if path == "/debug/rejections":
             if method == "POST":
                 return 405, "rejection log is read-only"

@@ -254,6 +254,14 @@ class CyclePipeline:
         #: (batch, SpeculativeSolve | None, overlap_span | None)
         self._inflight: Optional[tuple] = None
         self._degraded = False
+        #: gate introspection (distributed-observability PR): the most
+        #: recent _gates_ok evaluation — which named gate kept the cycle
+        #: serial — served at /debug/pipeline and counted per gate in
+        #: pipeline_gate_closed_total{gate}
+        self.last_gate_report: Dict[str, object] = {}
+        self._gated_cycles = 0
+        self._fast_cycles = 0
+        sched.extender.services.gate_info = self.gate_info
         #: interpreter-exit safety net for pipelines nobody close()s —
         #: the worker must never be torn down mid-device-transfer
         import weakref
@@ -290,14 +298,14 @@ class CyclePipeline:
         if self._inflight is None:
             return None
         health.set("pipeline", False, "leadership handoff: draining")
-        batch, spec, span = self._inflight
+        batch, spec, span, gates = self._inflight
         if spec is not None:
             sched.extender.registry.get(
                 "pipeline_speculation_total"
             ).labels(outcome="discarded").inc()
             if span is not None:
                 span.__exit__(None, None, None)
-            self._inflight = (batch, None, None)
+            self._inflight = (batch, None, None, gates)
         try:
             out = self.flush()
         finally:
@@ -311,6 +319,7 @@ class CyclePipeline:
         batch = list(batch)
         job = None
         full_ok = False
+        this_gates: Dict[str, object] = {}
         if batch and self._prepare_ok(batch):
             # prepare stage: the worker lowers THIS batch while the
             # previous cycle's solve is still in flight on device and
@@ -318,6 +327,7 @@ class CyclePipeline:
             # prepare in warm-only mode (intern-cache priming) so the
             # serial path's own lowering gets the hit.
             full_ok = self._gates_ok(batch)
+            this_gates = self.last_gate_report
             stall = sched.chaos.enabled and sched.chaos.fire(
                 "pipeline.worker_stall"
             )
@@ -327,7 +337,7 @@ class CyclePipeline:
         out: Optional[ScheduleOutcome] = None
         spec_new: Optional[SpeculativeSolve] = None
         if self._inflight is not None:
-            prev_batch, prev_spec, prev_span = self._inflight
+            prev_batch, prev_spec, prev_span, prev_gates = self._inflight
             if job is not None and full_ok and prev_spec is not None:
                 # deep speculation: dispatch batch k's solves off cycle
                 # k-1's chained state BEFORE its commit — the device works
@@ -341,7 +351,12 @@ class CyclePipeline:
                         chain_version=prev_spec.version,
                     )
             # trailing commit of cycle k-1 under the Reserve journal; the
-            # scheduler consumes prev_spec's solves when the guards hold
+            # scheduler consumes prev_spec's solves when the guards hold.
+            # The gate verdicts handed to the flight recorder are the
+            # ones evaluated FOR this batch at its feed — not this
+            # call's fresher evaluation of batch k (off-by-one would put
+            # the next batch's gates on the completed cycle's record)
+            sched.last_gate_report = prev_gates
             sched._speculative = prev_spec
             out = sched.schedule(prev_batch)
             if prev_span is not None:
@@ -379,7 +394,9 @@ class CyclePipeline:
             # the window the device solve ran concurrently with host work
             span = tracer.span("overlap", cat="pipeline", pods=len(batch))
             span.__enter__()
-        self._inflight = (batch, spec_new, span) if batch else None
+        self._inflight = (
+            (batch, spec_new, span, this_gates) if batch else None
+        )
         depth = 0
         if self._inflight is not None:
             depth = 2 if spec_new is not None else 1
@@ -481,6 +498,21 @@ class CyclePipeline:
             return False
         return all(gang_key_of(p) is None for p in batch)
 
+    def gate_info(self) -> Dict[str, object]:
+        """/debug/pipeline payload: the latest per-gate verdicts plus
+        long-run gated/fast cycle counts and the live pipeline depth —
+        the evidence base for "which gate keeps the slow configs
+        (quota/NUMA/device/gang) serial"."""
+        reg = self.sched.extender.registry
+        depth = reg.get("solver_pipeline_depth")
+        return {
+            "pipelined": True,
+            "last": dict(self.last_gate_report),
+            "cycles_gated": self._gated_cycles,
+            "cycles_fast": self._fast_cycles,
+            "depth": depth.value() if depth is not None else 0.0,
+        }
+
     def _gates_ok(self, batch: Sequence[Pod]) -> bool:
         """Whether this batch may take the speculative fast path. Every
         gate names a subsystem whose host-side commit state the device
@@ -489,12 +521,34 @@ class CyclePipeline:
         decisions, no overlap. The state-bearing subset
         (``_speculation_consume_ok``) is re-checked by the scheduler at
         consume time: a gated subsystem arriving mid-pipeline through an
-        informer invalidates the in-flight speculation."""
+        informer invalidates the in-flight speculation.
+
+        Every evaluation records WHICH gates closed: per-gate counts in
+        ``pipeline_gate_closed_total{gate}`` and the latest full report
+        on :attr:`last_gate_report` (served at ``/debug/pipeline``)."""
         from .plugins.coscheduling import gang_key_of
 
         sched = self.sched
-        if not sched._speculation_consume_ok():
+        gates = sched.speculation_gate_report()
+        gates["ladder"] = (
+            sched._fallback_level == 0 and sched._bucket_degrade == 0
+        )
+        gates["batch_gangs"] = all(
+            gang_key_of(p) is None for p in batch
+        )
+        closed = sorted(g for g, open_ in gates.items() if not open_)
+        self.last_gate_report = {
+            "batch": len(batch),
+            "gates": gates,
+            "closed": closed,
+        }
+        if closed:
+            self._gated_cycles += 1
+            counter = sched.extender.registry.get(
+                "pipeline_gate_closed_total"
+            )
+            for g in closed:
+                counter.labels(gate=g).inc()
             return False
-        if sched._fallback_level != 0 or sched._bucket_degrade != 0:
-            return False
-        return all(gang_key_of(p) is None for p in batch)
+        self._fast_cycles += 1
+        return True

@@ -43,7 +43,17 @@ class StreamScheduler:
     on-device commit state while that cycle's host Reserve trails behind
     — and returns the PREVIOUS batch's decisions (one-pump lag; call
     :meth:`flush` to drain the tail). Decisions are identical to the
-    serial pump; only the overlap differs."""
+    serial pump; only the overlap differs.
+
+    Distributed observability (fleet-tracing PR): ``lifecycle`` (a
+    :class:`~..obs.lifecycle.PodLifecycle`) receives per-pod
+    enqueue/dispatch/decide/ack events stamped with ``shard``;
+    ``slo`` (a :class:`~..obs.slo.SloTracker`) gets one placement-latency
+    sample per bound pod and one queue-age sample (oldest queued pod)
+    per pump. Both default None — the disabled path is one
+    attribute-is-None check per site. Lifecycle event timestamps come
+    from the TRACKER's clock so a sim-clock soak and a wall-clock bench
+    each stay in one time domain."""
 
     def __init__(
         self,
@@ -53,6 +63,9 @@ class StreamScheduler:
         pipelined: bool = False,
         prepare_timeout_s: float = 5.0,
         feed_gate=None,
+        lifecycle=None,
+        slo=None,
+        shard: int = -1,
     ):
         self.scheduler = scheduler
         self.max_batch = max_batch
@@ -62,6 +75,17 @@ class StreamScheduler:
         #: pod fanned out to several shards' queues is fed only by the
         #: shard that wins its claim; losers drop it here, silently)
         self.feed_gate = feed_gate
+        self.lifecycle = lifecycle
+        self.slo = slo
+        self.shard = int(shard)
+        if lifecycle is not None and scheduler.lifecycle is None:
+            # the scheduler embeds each pod's compact trace context in
+            # its bind-journal records (crash-bridged timelines)
+            scheduler.lifecycle = lifecycle
+        if slo is not None and scheduler.extender.services.slo is None:
+            # single-leader deployments get their /slo from the stream's
+            # tracker (the sharded path serves the fleet-merged view)
+            scheduler.extender.services.slo = slo
         self._queue: Deque[Tuple[Pod, float, int]] = deque()
         self._pipe = None
         #: uid -> (arrival stamp, tries) for pods inside the pipeline
@@ -77,6 +101,13 @@ class StreamScheduler:
         self._queue.append(
             (pod, _time.perf_counter() if now is None else now, 0)
         )
+        lc = self.lifecycle
+        if lc is not None:
+            # a pod the tracker never saw gets its ``submit`` anchor here
+            # (unsharded deployments have no router to stamp it)
+            if not lc.seen(pod.meta.uid):
+                lc.submitted(pod.meta.uid)
+            lc.event(pod.meta.uid, "enqueue", shard=self.shard)
 
     def backlog(self) -> int:
         return len(self._queue)
@@ -96,23 +127,28 @@ class StreamScheduler:
             return self._pump_pipelined()
         if not self._queue:
             return []
+        self._observe_queue_age()
         batch = self._next_batch()
         if not batch:
             # every popped pod was claim-dropped (another shard won) or
             # the feed gate went stale — don't burn a full scheduler
             # cycle on zero pods
             return []
+        self._note_dispatch(batch)
         meta = {p.meta.uid: (t, tries) for p, t, tries in batch}
         with self.scheduler.extender.tracer.span(
             "pump", cat="scheduler", batch=len(batch)
         ) as sp:
+            self.scheduler._queue_depth_hint = len(self._queue)
             out = self.scheduler.schedule([p for p, _t, _n in batch])
             t_done = _time.perf_counter()
             fenced = self._fenced_now()
             results: List[Tuple[Pod, Optional[str], float]] = []
             for pod, node in out.bound:
                 t_arr, _tries = meta[pod.meta.uid]
-                results.append((pod, node, t_done - t_arr))
+                lat = t_done - t_arr
+                self._note_bound(pod, node, lat)
+                results.append((pod, node, lat))
             for pod in out.unschedulable:
                 t_arr, tries = meta[pod.meta.uid]
                 if fenced:
@@ -126,6 +162,7 @@ class StreamScheduler:
                 elif tries + 1 < self.max_retries:
                     self._queue.append((pod, t_arr, tries + 1))
                 else:
+                    self._note_exhausted(pod)
                     results.append((pod, None, t_done - t_arr))
             sp.set(
                 bound=len(out.bound),
@@ -133,6 +170,50 @@ class StreamScheduler:
                 backlog=len(self._queue),
             )
         return results
+
+    # ---- distributed-observability hooks (fleet-tracing PR) ----
+
+    def _observe_queue_age(self) -> None:
+        """One queue-age SLI sample per pump: the OLDEST queued pod's
+        wait — backlog growth shows here before throughput moves. Read
+        on the SLO tracker's clock, so callers must stamp arrivals in
+        the same time domain they built the tracker with."""
+        if self.slo is not None and self._queue:
+            self.slo.observe_queue_age(
+                self.shard,
+                max(0.0, self.slo.clock() - self._queue[0][1]),
+            )
+
+    def _note_dispatch(self, batch) -> None:
+        if self.lifecycle is not None:
+            for pod, _t, _tries in batch:
+                self.lifecycle.event(
+                    pod.meta.uid, "dispatch", shard=self.shard
+                )
+
+    def _note_bound(self, pod: Pod, node: str, lat: float) -> None:
+        """decide + terminal ack events, plus the placement-latency SLI
+        sample — taken from the LIFECYCLE clock's e2e span when a
+        tracker is wired (one time domain end to end), else from the
+        pump's own measured latency."""
+        lc = self.lifecycle
+        if lc is not None:
+            lc.event(pod.meta.uid, "decide", shard=self.shard, detail=node)
+            e2e = lc.acked(pod.meta.uid, self.shard, node)
+            if self.slo is not None and e2e is not None:
+                self.slo.observe_latency(self.shard, e2e)
+        elif self.slo is not None:
+            self.slo.observe_latency(self.shard, lat)
+
+    def _note_exhausted(self, pod: Pod) -> None:
+        """Terminally unschedulable (retry budget burned): a ``decide``
+        with no node — the timeline stays open for the caller to either
+        re-route (new enqueue) or delete (``gone``)."""
+        if self.lifecycle is not None:
+            self.lifecycle.event(
+                pod.meta.uid, "decide", shard=self.shard,
+                detail="unschedulable",
+            )
 
     def _next_batch(self) -> List[Tuple[Pod, float, int]]:
         """Pop up to ``max_batch`` queue entries, dropping pods that fail
@@ -167,17 +248,20 @@ class StreamScheduler:
     def _pump_pipelined(self) -> List[Tuple[Pod, Optional[str], float]]:
         if not self._queue and not self._pipe.inflight:
             return []
+        self._observe_queue_age()
         batch = self._next_batch()
         if not batch and not self._pipe.inflight:
             # nothing to feed and nothing in flight to absorb (the queue
             # was non-empty but every pod was claim-dropped or the gate
             # went stale) — skip the empty cycle
             return []
+        self._note_dispatch(batch)
         with self.scheduler.extender.tracer.span(
             "pump", cat="scheduler", batch=len(batch), pipelined=True
         ) as sp:
             for pod, t_arr, tries in batch:
                 self._inflight_meta[pod.meta.uid] = (t_arr, tries)
+            self.scheduler._queue_depth_hint = len(self._queue)
             out = self._pipe.feed([p for p, _t, _n in batch])
             results = self._absorb(out)
             sp.set(
@@ -214,7 +298,9 @@ class StreamScheduler:
         results: List[Tuple[Pod, Optional[str], float]] = []
         for pod, node in out.bound:
             t_arr, _tries = self._inflight_meta.pop(pod.meta.uid)
-            results.append((pod, node, t_done - t_arr))
+            lat = t_done - t_arr
+            self._note_bound(pod, node, lat)
+            results.append((pod, node, lat))
         for pod in out.unschedulable:
             t_arr, tries = self._inflight_meta.pop(pod.meta.uid)
             if fenced:
@@ -223,6 +309,7 @@ class StreamScheduler:
             elif tries + 1 < self.max_retries:
                 self._queue.append((pod, t_arr, tries + 1))
             else:
+                self._note_exhausted(pod)
                 results.append((pod, None, t_done - t_arr))
         return results
 
@@ -244,27 +331,44 @@ class StreamScheduler:
         results: List[Tuple[Pod, Optional[str], float]] = []
         for pod, node in out.bound:  # fence still held: a real decision
             t_arr, _tries = self._inflight_meta.pop(pod.meta.uid)
-            results.append((pod, node, t_done - t_arr))
+            lat = t_done - t_arr
+            self._note_bound(pod, node, lat)
+            results.append((pod, node, lat))
         for pod in out.unschedulable:
             t_arr, tries = self._inflight_meta.pop(pod.meta.uid)
             self._queue.append((pod, t_arr, tries))
         return results
 
-    def extract_queued(self) -> List[Tuple[Pod, float, int]]:
+    def extract_queued(
+        self, event: Optional[str] = "handoff"
+    ) -> List[Tuple[Pod, float, int]]:
         """Shard handoff (PR 6): hand the ENTIRE queue — arrival stamps
-        and retry counts intact — to the caller, emptying it. Used when
+        and retry counts intact — to the caller, emptying it. ``event``
+        names the lifecycle stage each extracted pod records (default
+        the graceful ``handoff``); a CRASH caller passes None and stamps
+        its own ``orphan`` events — a killed queue must never read as a
+        clean drain in the pod's post-mortem timeline. Used when
         a shard's ownership moves to another scheduler incarnation: the
         donor's queued pods are re-routed to the new owner, keeping
         their latency clocks running (the north-star latency is
         enqueue→bind, and a handoff is not an enqueue)."""
         out = list(self._queue)
         self._queue.clear()
+        if self.lifecycle is not None and event is not None:
+            for pod, _arr, _tries in out:
+                self.lifecycle.event(
+                    pod.meta.uid, event, shard=self.shard
+                )
         return out
 
     def resubmit(self, pod: Pod, arrival: float, tries: int) -> None:
         """Re-enqueue a pod handed off from another incarnation's queue
         with its original arrival stamp and retry budget."""
         self._queue.append((pod, arrival, tries))
+        if self.lifecycle is not None:
+            self.lifecycle.event(
+                pod.meta.uid, "resubmit", shard=self.shard
+            )
 
     def flush(self) -> List[Tuple[Pod, Optional[str], float]]:
         """Drain everything: pump until the queue is empty, then complete

@@ -1,0 +1,905 @@
+"""Distributed-observability layer tests (fleet-tracing PR tentpole).
+
+Covers: per-pod lifecycle tracing (event timelines, the gap-free
+validator, the placement-latency histogram decomposition, journal-
+context crash bridging); the per-shard SLO tracker (targets, violation
+counting, burn rates, ``/slo``); the crash-surviving flight recorder
+(per-cycle records, ring retention, dead-writer adoption over a shared
+store, ``/debug/flightrecorder``); fleet aggregation (merged ``/metrics``
+with a ``shard`` label, merged Chrome trace with per-shard process lanes
+and linked handoff flows, per-shard ownership/epoch ``/healthz`` rows);
+and speculation-gate introspection (``/debug/pipeline`` +
+``pipeline_gate_closed_total{gate}`` attribution).
+"""
+
+import json
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.core.journal import BindJournal, EpochFence, MemoryJournalStore
+from koordinator_tpu.core.snapshot import ClusterSnapshot
+from koordinator_tpu.obs.flightrecorder import FlightRecorder
+from koordinator_tpu.obs.lifecycle import (
+    LifecycleEvent,
+    PodLifecycle,
+    validate_timeline,
+)
+from koordinator_tpu.obs.slo import SloTarget, SloTracker
+from koordinator_tpu.obs import fleet
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.stream import StreamScheduler
+from koordinator_tpu.utils.metrics import Registry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def _node(name, cpu=16_000.0, mem=65_536.0):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(
+            allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}
+        ),
+    )
+
+
+def _pod(name, cpu=1000.0, mem=2048.0):
+    return Pod(
+        meta=ObjectMeta(name=name),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem},
+            priority=9000,
+        ),
+    )
+
+
+def _sched(n_nodes=4, **kw):
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(_node(f"n{i:02d}"))
+    s = BatchScheduler(snap, LoadAwareArgs(), batch_bucket=16, **kw)
+    s.extender.monitor.stop_background()
+    return s
+
+
+# ---------------------------------------------------------------------------
+# PodLifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestPodLifecycle:
+    def test_event_timeline_and_e2e_latency(self):
+        clk = FakeClock()
+        lc = PodLifecycle(clock=clk)
+        lc.submitted("u1")
+        clk.tick()
+        lc.routed("u1", shard=2, detail="uid-hash")
+        lc.event("u1", "enqueue", shard=2)
+        clk.tick()
+        lc.event("u1", "dispatch", shard=2)
+        lc.event("u1", "decide", shard=2, detail="n01")
+        clk.tick()
+        e2e = lc.acked("u1", 2, "n01")
+        assert e2e == pytest.approx(3.0)
+        stages = [e.stage for e in lc.timeline("u1")]
+        assert stages == [
+            "submit", "route", "enqueue", "dispatch", "decide", "ack",
+        ]
+        assert validate_timeline(lc.timeline("u1")) == []
+        assert lc.is_done("u1") and lc.seen("u1")
+
+    def test_histogram_decomposition_per_stage(self):
+        reg = Registry()
+        clk = FakeClock()
+        lc = PodLifecycle(registry=reg, clock=clk)
+        lc.submitted("u1")
+        clk.tick()                      # route span: 1s
+        lc.event("u1", "enqueue", shard=0)
+        clk.tick(2.0)                   # queue span: 2s
+        lc.event("u1", "claim", shard=0)
+        clk.tick(0.5)                   # claim→dispatch: 0.5s
+        lc.event("u1", "dispatch", shard=0)
+        clk.tick(3.0)                   # solve span: 3s
+        lc.event("u1", "decide", shard=0, detail="n00")
+        clk.tick(0.25)                  # commit span: 0.25s
+        lc.acked("u1", 0, "n00")
+        text = reg.expose()
+        assert 'placement_latency_seconds_count{shard="0",stage="e2e"} 1' in text
+        for stage in ("route", "queue", "claim", "solve", "commit"):
+            assert (
+                f'placement_latency_seconds_count{{shard="0",stage="{stage}"}} 1'
+                in text
+            ), stage
+        h = reg.get("placement_latency_seconds")
+        # e2e = 6.75s lands in the 10s bucket, not below 5s
+        assert h.quantile(0.5, shard="0", stage="e2e") > 5.0
+
+    def test_unsharded_queue_span_runs_enqueue_to_dispatch(self):
+        reg = Registry()
+        clk = FakeClock()
+        lc = PodLifecycle(registry=reg, clock=clk)
+        lc.submitted("u1")
+        lc.event("u1", "enqueue", shard=-1)
+        clk.tick(2.0)
+        lc.event("u1", "dispatch", shard=-1)
+        lc.event("u1", "decide", shard=-1, detail="n00")
+        lc.acked("u1", -1, "n00")
+        text = reg.expose()
+        # no claim gate: queue observed, claim absent
+        assert 'stage="queue"} 1' in text
+        assert 'stage="claim"}' not in text
+
+    def test_journal_context_bridges_a_fresh_tracker(self):
+        clk = FakeClock(5.0)
+        lc = PodLifecycle(clock=clk)
+        lc.submitted("u1")
+        clk.tick()
+        lc.event("u1", "enqueue", shard=1)
+        ctx = lc.context("u1")
+        assert ctx == {"t0": 5.0, "hops": 1}
+        # a genuinely fresh process: the journaled context re-seeds the
+        # timeline with the TRUE arrival, bridged by a recover event
+        clk2 = FakeClock(20.0)
+        lc2 = PodLifecycle(clock=clk2)
+        lc2.recovered("u1", 1, "n00", ctx=ctx)
+        evs = lc2.timeline("u1")
+        assert [e.stage for e in evs] == ["submit", "recover"]
+        assert evs[0].t == 5.0
+        e2e = lc2.acked("u1", 1, "n00")
+        assert e2e == pytest.approx(15.0)
+        assert validate_timeline(lc2.timeline("u1")) == []
+
+    def test_recover_after_terminal_ack_is_a_noop(self):
+        lc = PodLifecycle(clock=FakeClock())
+        lc.submitted("u1")
+        lc.event("u1", "enqueue", shard=0)
+        lc.event("u1", "dispatch", shard=0)
+        lc.event("u1", "decide", shard=0)
+        lc.acked("u1", 0, "n00")
+        before = [e.stage for e in lc.timeline("u1")]
+        lc.recovered("u1", 0, "n00", ctx={"t0": 0.0, "hops": 1})
+        assert [e.stage for e in lc.timeline("u1")] == before
+
+    def test_bounded_eviction_drops_completed_keeps_live(self):
+        lc = PodLifecycle(clock=FakeClock(), max_pods=10)
+        for i in range(10):
+            uid = f"done-{i}"
+            lc.submitted(uid)
+            lc.event(uid, "gone")
+        lc.submitted("live-0")  # at capacity: evicts oldest completed
+        assert lc.seen("live-0")
+        assert not lc.seen("done-0")
+
+    def test_bounded_eviction_falls_back_to_open_timelines(self):
+        # a fleet dominated by never-placed pods has NO completed
+        # timelines to evict — the bound must hold anyway
+        lc = PodLifecycle(clock=FakeClock(), max_pods=10)
+        for i in range(25):
+            lc.submitted(f"open-{i}")  # never acked, never 'gone'
+        with lc._lock:
+            n = len(lc._events)
+        assert n <= 10 + 1
+        assert not lc.seen("open-0")  # oldest open evicted first
+        assert lc.seen("open-24")
+
+
+class TestValidateTimeline:
+    def _ev(self, stage, t, shard=0):
+        return LifecycleEvent(stage=stage, t=t, shard=shard)
+
+    def test_flags_missing_submit_and_non_terminal(self):
+        probs = validate_timeline([self._ev("enqueue", 0.0)])
+        assert any("not submit" in p for p in probs)
+        assert any("not terminal" in p for p in probs)
+
+    def test_flags_time_regression(self):
+        probs = validate_timeline(
+            [
+                self._ev("submit", 5.0),
+                self._ev("enqueue", 4.0),
+                self._ev("dispatch", 6.0),
+                self._ev("decide", 6.0),
+                self._ev("ack", 7.0),
+            ]
+        )
+        assert any("time went backwards" in p for p in probs)
+
+    def test_flags_dispatch_before_enqueue_and_bare_ack(self):
+        probs = validate_timeline(
+            [
+                self._ev("submit", 0.0),
+                self._ev("dispatch", 1.0),
+                self._ev("ack", 2.0),
+            ]
+        )
+        assert any("dispatch before any enqueue" in p for p in probs)
+        assert any("ack without a decide/recover" in p for p in probs)
+
+    def test_flags_unbridged_orphan(self):
+        # the dead-incarnation gap: decide after orphan with no
+        # resubmit/recover/enqueue bridge
+        probs = validate_timeline(
+            [
+                self._ev("submit", 0.0),
+                self._ev("enqueue", 1.0),
+                self._ev("orphan", 2.0),
+                self._ev("dispatch", 3.0),
+                self._ev("decide", 3.0),
+                self._ev("ack", 4.0),
+            ]
+        )
+        assert any("after orphan without" in p for p in probs)
+
+    def test_accepts_bridged_orphan(self):
+        assert (
+            validate_timeline(
+                [
+                    self._ev("submit", 0.0),
+                    self._ev("enqueue", 1.0),
+                    self._ev("orphan", 2.0),
+                    self._ev("resubmit", 3.0),
+                    self._ev("dispatch", 4.0),
+                    self._ev("decide", 4.0),
+                    self._ev("ack", 5.0),
+                ]
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
+# SloTracker
+# ---------------------------------------------------------------------------
+
+
+class TestSloTracker:
+    def test_violations_count_and_burn_rate(self):
+        reg = Registry()
+        slo = SloTracker(
+            registry=reg,
+            targets=(
+                SloTarget("p99_latency", threshold_s=1.0, budget=0.5,
+                          window=10),
+            ),
+            clock=FakeClock(),
+        )
+        for _ in range(8):
+            assert not slo.observe_latency(0, 0.1)
+        for _ in range(2):
+            assert slo.observe_latency(0, 5.0)
+        ev = slo.evaluate()["0"]["p99_latency"]
+        assert ev["samples"] == 10 and ev["violations"] == 2
+        # 2/10 of the window violate / 0.5 budget = 0.4 burn: within
+        assert ev["burn_rate"] == pytest.approx(0.4)
+        assert ev["ok"] and slo.ok()
+        assert (
+            reg.get("slo_violations_total").value(
+                shard="0", slo="p99_latency"
+            )
+            == 2
+        )
+        # four more bad samples push burn past 1.0: budget overdrawn
+        for _ in range(4):
+            slo.observe_latency(0, 5.0)
+        assert not slo.ok()
+
+    def test_three_objectives_and_render(self):
+        slo = SloTracker(clock=FakeClock())
+        slo.observe_latency(0, 0.1)
+        slo.observe_queue_age(0, 99.0)  # violates the 5s default
+        slo.observe_recovery(1, 0.2)
+        doc = json.loads(slo.render())
+        assert set(doc["targets"]) == {
+            "p99_latency", "queue_age", "recovery",
+        }
+        assert doc["shards"]["0"]["queue_age"]["violations"] == 1
+        assert doc["shards"]["1"]["recovery"]["ok"]
+
+    def test_unknown_slo_raises(self):
+        with pytest.raises(ValueError):
+            SloTracker()._observe(0, "nope", 1.0)
+
+    def test_p99_nearest_rank_at_multiples_of_100(self):
+        # regression: int(0.99*100)=99 picks the MAX (p100); nearest-rank
+        # p99 of 100 samples is the 99th ranked, index 98
+        slo = SloTracker(
+            targets=(
+                SloTarget("p99_latency", threshold_s=100.0, window=128),
+            ),
+            clock=FakeClock(),
+        )
+        for _ in range(99):
+            slo.observe_latency(0, 0.001)
+        slo.observe_latency(0, 60.0)  # one outlier
+        ev = slo.evaluate()["0"]["p99_latency"]
+        assert ev["window_p99_s"] == pytest.approx(0.001)
+        assert ev["worst_s"] == pytest.approx(60.0)
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_record_ring_and_render(self):
+        fr = FlightRecorder(capacity=4, incarnation="inc-a",
+                            clock=FakeClock())
+        for c in range(6):
+            fr.record(c, stage_ms={"solve": 1.5}, gates={"quotas": True},
+                      speculation="kept", queue_depth=c, bound=2)
+        recs = fr.last()
+        assert len(recs) == 4  # ring bound
+        assert [r["cycle"] for r in recs] == [2, 3, 4, 5]
+        doc = json.loads(fr.render(2))
+        assert doc["cycles"] == 2 and doc["recovered"] == 0
+        assert doc["records"][-1]["stage_ms"] == {"solve": 1.5}
+
+    def test_takeover_adopts_dead_writers_tail(self):
+        store = MemoryJournalStore()
+        dead = FlightRecorder(store, capacity=8, shard=1,
+                              incarnation="inc-dead", clock=FakeClock())
+        for c in range(5):
+            dead.record(c, stage_ms={"cycle": 2.0})
+        # the process dies; a takeover builds its recorder over the SAME
+        # store and serves the dead incarnation's tail
+        fr2 = FlightRecorder(store, capacity=8, shard=1,
+                             incarnation="inc-new", clock=FakeClock())
+        assert len(fr2.recovered_records()) == 5
+        fr2.record(99, stage_ms={"cycle": 1.0})
+        doc = json.loads(fr2.render())
+        assert doc["recovered"] == 5
+        flags = [r["recovered"] for r in doc["records"]]
+        assert flags == [True] * 5 + [False]
+        # seq continues past the dead writer's (no collision on replay)
+        assert doc["records"][-1]["seq"] == 6
+
+    def test_record_never_raises_into_scheduling_path(self):
+        class ExplodingStore:
+            def load(self):
+                return []
+
+            def append(self, rec):
+                raise TypeError("not JSON serializable")
+
+            def rewrite(self, recs):
+                raise TypeError("boom")
+
+        fr = FlightRecorder(ExplodingStore(), capacity=4,
+                            incarnation="inc-a", clock=FakeClock())
+        rec = fr.record(0, stage_ms={"solve": 1.0})  # must not raise
+        assert rec["cycle"] == 0
+        assert len(fr.last()) == 1  # ring retention degrades gracefully
+
+    def test_store_compaction_bounds_reader_exposure(self):
+        store = MemoryJournalStore()
+        fr = FlightRecorder(store, capacity=4, incarnation="a",
+                            clock=FakeClock())
+        for c in range(8):  # 2*capacity appends triggers rewrite
+            fr.record(c)
+        assert len(store.load()) == 4  # rewritten to ring content
+        assert [r["cycle"] for r in store.load()] == [4, 5, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation
+# ---------------------------------------------------------------------------
+
+
+class TestFleetAggregation:
+    def _regs(self):
+        out = {}
+        for s in (0, 1):
+            reg = Registry()
+            reg.counter("cycles_total", "cycles").inc(s + 1)
+            reg.counter(
+                "rej_total", "rejections", labels=("reason",)
+            ).labels(reason="quota").inc()
+            out[s] = reg
+        return out
+
+    def test_merged_metrics_injects_shard_label_once_per_meta(self):
+        text = fleet.merged_metrics(self._regs())
+        assert 'cycles_total{shard="0"} 1' in text
+        assert 'cycles_total{shard="1"} 2' in text
+        assert 'rej_total{shard="0",reason="quota"} 1' in text
+        assert text.count("# HELP cycles_total") == 1
+        assert text.count("# TYPE cycles_total") == 1
+
+    def test_merged_metrics_groups_each_family_contiguously(self):
+        # the exposition format requires ALL lines of a family in one
+        # group: metric-major merge, not shard-major interleave
+        lines = [
+            ln
+            for ln in fleet.merged_metrics(self._regs()).splitlines()
+            if ln and not ln.startswith("#")
+        ]
+        fam = [ln.split("{", 1)[0] for ln in lines]
+        assert fam == sorted(fam, key=fam.index)  # no family repeats
+        # both shards' samples sit adjacent inside each family
+        assert fam.count("cycles_total") == 2
+        i = fam.index("cycles_total")
+        assert fam[i + 1] == "cycles_total"
+
+    def test_merge_chrome_traces_lanes_and_handoff_flows(self):
+        from koordinator_tpu.obs.trace import Tracer
+
+        tracers = {}
+        for s in (0, 1):
+            tr = Tracer(enabled=True)
+            with tr.span("pump", cat="scheduler"):
+                pass
+            tracers[s] = tr
+        # handoff stamps are ABSOLUTE readings on the tracers' shared
+        # clock (perf_counter here), exactly as ShardedScheduler logs
+        # them — the merge re-bases them onto the fleet epoch
+        t_out = tracers[1].clock()
+        t_in = t_out + 0.4
+        doc = fleet.merge_chrome_traces(
+            tracers,
+            handoffs=[
+                {"shard": 1, "t_out": t_out, "t_in": t_in,
+                 "from": "inc-a", "to": "inc-b"},
+            ],
+        )
+        evs = doc["traceEvents"]
+        lanes = {
+            e["args"]["name"]
+            for e in evs
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert {"shard-0", "shard-1"} <= lanes
+        pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+        assert pids == {1, 2}  # one process lane per shard
+        flow = [e for e in evs if e.get("cat") == "handoff"]
+        assert [e["ph"] for e in flow] == ["s", "f"]
+        assert flow[0]["pid"] == flow[1]["pid"] == 2
+        assert flow[1]["ts"] - flow[0]["ts"] == pytest.approx(
+            0.4e6, rel=1e-3
+        )
+        # clock alignment: arrows AND spans share the fleet-epoch axis —
+        # the arrow lands at/after the spans, never at an absolute-clock
+        # offset light-years off screen
+        span_ts = [e["ts"] for e in evs if e.get("ph") == "X"]
+        assert all(ts >= 0 for ts in span_ts)
+        assert 0 <= flow[0]["ts"] < 60e6
+
+    def test_merge_handoff_open_seam_renders_degenerate_arrow(self):
+        from koordinator_tpu.obs.trace import Tracer
+
+        tr = Tracer(enabled=True)
+        doc = fleet.merge_chrome_traces(
+            {0: tr},
+            handoffs=[
+                # drained but no successor granted yet: t_in still None
+                {"shard": 0, "t_out": tr.clock(), "t_in": None,
+                 "from": "inc-a", "to": ""},
+            ],
+        )
+        flow = [
+            e for e in doc["traceEvents"] if e.get("cat") == "handoff"
+        ]
+        assert [e["ph"] for e in flow] == ["s", "f"]
+        assert flow[1]["ts"] >= flow[0]["ts"]
+
+
+# ---------------------------------------------------------------------------
+# services-engine surfaces (/slo, /debug/pipeline, /debug/flightrecorder)
+# ---------------------------------------------------------------------------
+
+
+class TestServicesEndpoints:
+    def test_slo_endpoint_wiring(self):
+        sched = _sched()
+        eng = sched.extender.services
+        assert eng.dispatch("GET", "/slo")[0] == 404
+        slo = SloTracker(clock=FakeClock())
+        slo.observe_latency(0, 0.1)
+        eng.slo = slo
+        code, body = eng.dispatch("GET", "/slo")
+        assert code == 200 and json.loads(body)["ok"]
+
+    def test_flightrecorder_endpoint_and_cycle_records(self):
+        sched = _sched()
+        eng = sched.extender.services
+        assert eng.dispatch("GET", "/debug/flightrecorder")[0] == 404
+        fr = FlightRecorder(capacity=16, incarnation="inc-a")
+        sched.attach_flight_recorder(fr)
+        out = sched.schedule([_pod("p0"), _pod("p1")])
+        assert len(out.bound) == 2
+        code, body = eng.dispatch("GET", "/debug/flightrecorder")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["cycles"] == 1
+        rec = doc["records"][0]
+        assert rec["bound"] == 2 and rec["unschedulable"] == 0
+        assert rec["speculation"] == "serial" and not rec["fenced"]
+        # per-cycle stage breakdown rides in the black box
+        assert {"cycle", "snapshot", "solve", "commit"} <= set(
+            rec["stage_ms"]
+        )
+        assert rec["stage_ms"]["cycle"] > 0
+
+    def test_debug_pipeline_defaults_to_not_pipelined(self):
+        sched = _sched()
+        code, body = sched.extender.services.dispatch(
+            "GET", "/debug/pipeline"
+        )
+        assert code == 200 and json.loads(body) == {"pipelined": False}
+
+
+# ---------------------------------------------------------------------------
+# gate introspection (/debug/pipeline + pipeline_gate_closed_total)
+# ---------------------------------------------------------------------------
+
+
+class TestGateIntrospection:
+    def test_gate_report_names_every_speculation_gate(self):
+        sched = _sched()
+        report = sched.speculation_gate_report()
+        assert set(report) == {
+            "reservations", "mesh", "numa", "devices", "quotas",
+            "transformers", "preemption", "gangs", "sampling",
+        }
+        assert all(report.values())  # bare config: everything open
+        assert sched._speculation_consume_ok()
+
+    def test_closed_gate_attributed_in_counter_and_endpoint(self):
+        # priority preemption is a state-bearing gate: the pipelined
+        # stream must fall back to serial AND name the gate that did it
+        sched = _sched(n_nodes=8, enable_priority_preemption=True)
+        stream = StreamScheduler(sched, max_batch=8, pipelined=True)
+        try:
+            for i in range(3):
+                stream.submit(_pod(f"p{i}"))
+            bound = [r for r in stream.flush() if r[1] is not None]
+            assert len(bound) == 3
+            reg = sched.extender.registry
+            assert (
+                reg.get("pipeline_gate_closed_total").value(
+                    gate="preemption"
+                )
+                > 0
+            )
+            code, body = sched.extender.services.dispatch(
+                "GET", "/debug/pipeline"
+            )
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["pipelined"] is True
+            assert doc["last"]["closed"] == ["preemption"]
+            assert doc["last"]["gates"]["preemption"] is False
+            assert doc["last"]["gates"]["quotas"] is True
+            assert doc["cycles_gated"] > 0 and doc["cycles_fast"] == 0
+        finally:
+            stream.close()
+
+    def test_flight_record_gates_are_the_cycles_own_not_the_next_feeds(self):
+        # regression: CyclePipeline.feed evaluates batch k's gates
+        # BEFORE running batch k-1's trailing commit — the flight record
+        # for cycle k-1 must carry k-1's feed-time verdicts, not k's
+        sched = _sched(n_nodes=8)
+        fr = FlightRecorder(capacity=16, incarnation="inc-a")
+        sched.attach_flight_recorder(fr)
+        stream = StreamScheduler(sched, max_batch=8, pipelined=True)
+        try:
+            stream.submit(_pod("p0"))
+            assert stream.pump() == []  # batch 1 fed, gates OPEN
+            # the world changes between feeds: preemption arms
+            sched.enable_priority_preemption = True
+            stream.submit(_pod("p1"))
+            stream.pump()  # batch 2 fed (gated) + batch 1's commit
+            recs = fr.last()
+            assert recs, "batch 1's cycle must have recorded"
+            assert recs[0]["gates"].get("preemption") is True, (
+                "cycle 1's record shows the NEXT feed's closed gate"
+            )
+            stream.flush()
+            recs = fr.last()
+            assert recs[-1]["gates"].get("preemption") is False
+        finally:
+            stream.close()
+
+    def test_open_gates_take_fast_path_and_count_fast_cycles(self):
+        sched = _sched(n_nodes=8)
+        stream = StreamScheduler(sched, max_batch=8, pipelined=True)
+        try:
+            for i in range(3):
+                stream.submit(_pod(f"p{i}"))
+            bound = [r for r in stream.flush() if r[1] is not None]
+            assert len(bound) == 3
+            doc = json.loads(
+                sched.extender.services.dispatch(
+                    "GET", "/debug/pipeline"
+                )[1]
+            )
+            assert doc["cycles_fast"] > 0
+            assert doc["last"]["closed"] == []
+        finally:
+            stream.close()
+
+
+# ---------------------------------------------------------------------------
+# FleetServices over a live ShardedScheduler
+# ---------------------------------------------------------------------------
+
+
+class TestFleetServices:
+    def _world(self, n_shards=2, n_nodes=8):
+        from koordinator_tpu.runtime.shards import (
+            ShardFabric,
+            ShardedScheduler,
+        )
+        from koordinator_tpu.runtime.statehub import ClusterStateHub
+
+        t = [0.0]
+        fabric = ShardFabric(
+            n_shards, clock=lambda: t[0], membership_ttl_s=2.5
+        )
+        hub = ClusterStateHub()
+        for i in range(n_nodes):
+            hub.publish(hub.nodes, _node(f"n{i:03d}"))
+
+        def factory(shard, snapshot, fence, journal):
+            s = BatchScheduler(
+                snapshot,
+                LoadAwareArgs(usage_thresholds={}),
+                batch_bucket=16,
+                journal=journal,
+                fence=fence,
+            )
+            s.extender.monitor.stop_background()
+            return s
+
+        inc = ShardedScheduler(
+            "inc-a",
+            hub,
+            fabric,
+            factory,
+            max_batch=16,
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            retry_period=0.5,
+            lifecycle=PodLifecycle(
+                registry=Registry(), clock=lambda: t[0]
+            ),
+            slo=SloTracker(clock=lambda: t[0]),
+        )
+        fabric.membership.heartbeat("inc-a")
+        for _ in range(2):
+            t[0] += 1.0
+            inc.tick()
+        return t, fabric, hub, inc
+
+    def test_healthz_rows_metrics_and_slo_surfaces(self):
+        t, fabric, hub, inc = self._world()
+        try:
+            assert set(inc.owned()) == {0, 1}
+            fs = inc.fleet()
+            # per-shard ownership/epoch rows (satellite): every owned
+            # shard reports owned=True at its CURRENT fence epoch
+            code, body = fs.dispatch("GET", "/healthz")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["ok"] and doc["incarnation"] == "inc-a"
+            assert doc["owned"] == [0, 1]
+            for s in (0, 1):
+                row = doc["shards"][str(s)]
+                assert row["owned"] is True
+                assert row["epoch"] == fabric.fences[s].current()
+                assert row["health_ok"] is True
+                assert row["backlog"] == 0
+            # a pod through shard routing feeds the merged surfaces
+            from koordinator_tpu.runtime.shards import ShardRouter
+
+            router = ShardRouter(
+                fabric.shard_map, lifecycle=inc.lifecycle
+            )
+            pod = _pod("p0")
+            s = router.route(pod)
+            assert inc.submit(s, pod, now=t[0])
+            decided = inc.pump() + inc.flush()
+            assert len(decided) == 1 and decided[0][2] is not None
+            code, body = fs.dispatch("GET", "/metrics")
+            assert code == 200
+            assert f'shard="{s}"' in body
+            assert (
+                body.count(
+                    "# HELP koord_scheduler_cycle_latency_seconds"
+                )
+                == 1
+            )
+            # the incarnation-level lifecycle histogram rides in the
+            # same scrape with its OWN shard label, not a fleet-side
+            # injected one (no doubled shard= on any sample line)
+            assert (
+                f'placement_latency_seconds_count{{shard="{s}",'
+                f'stage="e2e"}} 1' in body
+            )
+            assert 'shard="0",shard=' not in body
+            code, body = fs.dispatch("GET", "/slo")
+            assert code == 200
+            assert json.loads(body)["shards"][str(s)][
+                "p99_latency"
+            ]["samples"] == 1
+            # merged chrome trace: one process lane per OWNED shard
+            code, body = fs.dispatch("GET", "/trace")
+            doc = json.loads(body)
+            lanes = {
+                e["args"]["name"]
+                for e in doc["traceEvents"]
+                if e.get("ph") == "M"
+                and e.get("name") == "process_name"
+            }
+            assert lanes == {"shard-0", "shard-1"}
+            # fleet gate introspection: one verdict doc per owned shard,
+            # forwarded from each runtime's own services engine
+            code, body = fs.dispatch("GET", "/debug/pipeline")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["incarnation"] == "inc-a"
+            assert set(doc["shards"]) == {"0", "1"}
+            for row in doc["shards"].values():
+                assert "pipelined" in row
+            assert fs.dispatch("GET", "/nope")[0] == 404
+        finally:
+            inc.close()
+            hub.stop()
+
+    def test_voluntary_handoff_closes_one_seam_on_the_shared_log(self):
+        from koordinator_tpu.runtime.shards import ShardedScheduler
+
+        t, fabric, hub, inc = self._world()
+        b = None
+        try:
+            assert set(inc.owned()) == {0, 1}
+
+            def factory(shard, snapshot, fence, journal):
+                s = BatchScheduler(
+                    snapshot,
+                    LoadAwareArgs(usage_thresholds={}),
+                    batch_bucket=16,
+                    journal=journal,
+                    fence=fence,
+                )
+                s.extender.monitor.stop_background()
+                return s
+
+            b = ShardedScheduler(
+                "inc-b", hub, fabric, factory, max_batch=16,
+                lease_duration=3.0, renew_deadline=2.0,
+                retry_period=0.5,
+            )
+            fabric.membership.heartbeat("inc-b")
+            for _ in range(4):
+                t[0] += 1.0
+                fabric.membership.heartbeat("inc-a")
+                fabric.membership.heartbeat("inc-b")
+                inc.tick()
+                b.tick()
+            assert b.owned(), "joiner must win a rebalanced shard"
+            # the donor's drain opened a seam; the takeover CLOSED it:
+            # one entry spanning the ownership gap, not two point stubs
+            seams = [
+                h for h in fabric.handoff_log
+                if h["from"] == "inc-a" and h["to"] == "inc-b"
+            ]
+            assert seams, fabric.handoff_log
+            for h in seams:
+                assert h["t_in"] is not None
+                assert h["t_in"] >= h["t_out"]
+            # the property serves a locked SNAPSHOT of the shared log
+            # (another incarnation may append mid-iteration), same data
+            assert b.handoff_log == list(fabric.handoff_log)
+        finally:
+            if b is not None:
+                b.close()
+            inc.close()
+            hub.stop()
+
+    def test_unowned_shard_row_reports_fence_epoch(self):
+        t, fabric, hub, inc = self._world()
+        try:
+            # depose shard 1: the row flips to owned=False but still
+            # reports the shard's current fence epoch for the operator
+            inc._coords[1].leading = False
+            ok, doc = inc.fleet().healthz()
+            row = doc["shards"]["1"]
+            assert row["owned"] is False
+            assert row["epoch"] == fabric.fences[1].current()
+            assert "health_ok" not in row
+        finally:
+            inc.close()
+            hub.stop()
+
+
+# ---------------------------------------------------------------------------
+# stream lifecycle integration + journal context
+# ---------------------------------------------------------------------------
+
+
+class TestStreamLifecycleIntegration:
+    def test_crash_extract_does_not_fake_a_graceful_handoff(self):
+        # a killed queue must never read as a clean drain: kill() passes
+        # event=None and stamps its own orphan events, so the timeline
+        # brackets the crash — not a handoff that never happened
+        lc = PodLifecycle(clock=FakeClock())
+        sched = _sched()
+        stream = StreamScheduler(
+            sched, max_batch=8, lifecycle=lc, shard=0
+        )
+        pod = _pod("p0")
+        stream.submit(pod)
+        out = stream.extract_queued(event=None)
+        assert len(out) == 1
+        stages = [e.stage for e in lc.timeline(pod.meta.uid)]
+        assert "handoff" not in stages
+        # the graceful default still records the drain
+        stream.submit(pod)
+        stream.extract_queued()
+        assert [e.stage for e in lc.timeline(pod.meta.uid)][-1] == (
+            "handoff"
+        )
+
+    def test_pump_emits_full_timeline_and_slo_sample(self):
+        lc = PodLifecycle(clock=FakeClock())
+        slo = SloTracker(clock=FakeClock())
+        sched = _sched()
+        stream = StreamScheduler(
+            sched, max_batch=8, lifecycle=lc, slo=slo, shard=3
+        )
+        pod = _pod("p0")
+        stream.submit(pod)
+        results = stream.pump()
+        assert len(results) == 1 and results[0][1] is not None
+        stages = [e.stage for e in lc.timeline(pod.meta.uid)]
+        assert stages == ["submit", "enqueue", "dispatch", "decide", "ack"]
+        assert validate_timeline(lc.timeline(pod.meta.uid)) == []
+        assert all(
+            e.shard == 3 for e in lc.timeline(pod.meta.uid)
+            if e.stage != "submit"
+        )
+        ev = slo.evaluate()["3"]
+        assert ev["p99_latency"]["samples"] == 1
+        assert ev["queue_age"]["samples"] == 1
+
+    def test_bind_journal_records_carry_lifecycle_context(self):
+        lc = PodLifecycle(clock=FakeClock(7.0))
+        store = MemoryJournalStore()
+        fence = EpochFence()
+        epoch = fence.advance()
+        sched = _sched(
+            journal=BindJournal(store), fence=fence,
+        )
+        sched.grant_leadership(epoch)
+        stream = StreamScheduler(
+            sched, max_batch=8, lifecycle=lc, shard=2
+        )
+        pod = _pod("p0")
+        stream.submit(pod)
+        assert len(stream.pump()) == 1
+        binds = [
+            e
+            for r in store.load()
+            if r.get("op") == "bind"
+            for e in r["binds"]
+        ]
+        assert len(binds) == 1
+        # the compact trace context rides in the durable record: the
+        # takeover's replay bridges the timeline with the TRUE arrival
+        assert binds[0]["lc"]["t0"] == 7.0
+        assert binds[0]["lc"]["hops"] >= 1

@@ -1,0 +1,118 @@
+"""Tier-1 enforcement of the attributed-rejection-taxonomy discipline
+(distributed-observability PR satellite): every ``RejectReason`` member
+has a ``_classify_solver_reject`` arm or an explicit, still-true
+exemption naming its dedicated attribution site. See
+``tools/check_reject_reasons.py``."""
+
+import sys
+import textwrap
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+import check_reject_reasons as lint  # noqa: E402
+
+
+def test_repo_taxonomy_is_fully_attributed():
+    violations = lint.check(ROOT)
+    assert not violations, "\n".join(
+        f"{rel}:{line}: {msg}" for rel, line, msg in violations
+    )
+
+
+def _fake_repo(tmp_path, members, classifier_body, extra=""):
+    """Minimal tree the lint scans: the enum file, the classifier file,
+    and optionally another module carrying dedicated-site references."""
+    enum_f = tmp_path / "koordinator_tpu" / "obs" / "rejections.py"
+    enum_f.parent.mkdir(parents=True)
+    enum_f.write_text(
+        "import enum\n\nclass RejectReason(str, enum.Enum):\n"
+        + "".join(f'    {m} = "{m.lower()}"\n' for m in members)
+    )
+    cls_f = tmp_path / "koordinator_tpu" / "scheduler" / "batch_solver.py"
+    cls_f.parent.mkdir(parents=True)
+    cls_f.write_text(
+        "from ..obs.rejections import RejectReason\n\n"
+        "class BatchScheduler:\n"
+        "    def _classify_solver_reject(self, pod, req, est):\n"
+        + textwrap.indent(textwrap.dedent(classifier_body), " " * 8)
+    )
+    if extra:
+        site = tmp_path / "koordinator_tpu" / "other.py"
+        site.write_text(
+            "from .obs.rejections import RejectReason\n" + extra
+        )
+    return tmp_path
+
+
+def test_lint_flags_member_without_arm_or_exemption(tmp_path):
+    root = _fake_repo(
+        tmp_path,
+        ["INSUFFICIENT_RESOURCES", "BRAND_NEW_REASON"],
+        "return RejectReason.INSUFFICIENT_RESOURCES\n",
+    )
+    out = lint.check(root, exempt_table={})
+    assert len(out) == 1 and "BRAND_NEW_REASON" in out[0][2]
+    assert "no _classify_solver_reject arm" in out[0][2]
+
+
+def test_lint_accepts_classifier_arm(tmp_path):
+    root = _fake_repo(
+        tmp_path,
+        ["INSUFFICIENT_RESOURCES"],
+        "return RejectReason.INSUFFICIENT_RESOURCES\n",
+    )
+    assert lint.check(root, exempt_table={}) == []
+
+
+def test_lint_accepts_exempt_member_with_live_site(tmp_path):
+    root = _fake_repo(
+        tmp_path,
+        ["INSUFFICIENT_RESOURCES", "STALE_LEADER_EPOCH"],
+        "return RejectReason.INSUFFICIENT_RESOURCES\n",
+        extra="REASON = RejectReason.STALE_LEADER_EPOCH\n",
+    )
+    assert lint.check(
+        root, exempt_table={"STALE_LEADER_EPOCH": "fence boundary"}
+    ) == []
+
+
+def test_lint_flags_exempt_member_with_no_site(tmp_path):
+    # exempted, but nothing outside the enum file references it: the
+    # dedicated attribution site the exemption promises does not exist
+    root = _fake_repo(
+        tmp_path,
+        ["INSUFFICIENT_RESOURCES", "STALE_LEADER_EPOCH"],
+        "return RejectReason.INSUFFICIENT_RESOURCES\n",
+    )
+    out = lint.check(
+        root, exempt_table={"STALE_LEADER_EPOCH": "fence boundary"}
+    )
+    assert len(out) == 1 and "STALE_LEADER_EPOCH" in out[0][2]
+    assert "the site is gone" in out[0][2]
+
+
+def test_lint_flags_stale_exemption_for_covered_member(tmp_path):
+    # the classifier grew an arm for an exempted member: the exemption
+    # must be deleted, not silently shadow the arm
+    root = _fake_repo(
+        tmp_path,
+        ["STALE_LEADER_EPOCH"],
+        "return RejectReason.STALE_LEADER_EPOCH\n",
+        extra="REASON = RejectReason.STALE_LEADER_EPOCH\n",
+    )
+    out = lint.check(
+        root, exempt_table={"STALE_LEADER_EPOCH": "fence boundary"}
+    )
+    assert len(out) == 1 and "stale exemption" in out[0][2]
+
+
+def test_every_current_exemption_names_a_real_member():
+    members = set(lint.enum_members(ROOT))
+    assert set(lint.EXEMPT) <= members
+    # and the split is genuine: the classifier covers SOMETHING, and the
+    # exemptions cover everything else, disjointly
+    covered = lint.classifier_coverage(ROOT)
+    assert covered and covered.isdisjoint(lint.EXEMPT)
+    assert covered | set(lint.EXEMPT) == members

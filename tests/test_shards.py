@@ -350,6 +350,21 @@ def test_pump_skips_cycle_when_gate_drops_whole_batch():
     assert stream.backlog() == 0  # the claim-lost pods were dropped
 
 
+def test_handoff_log_is_bounded():
+    # the fabric outlives every incarnation; its seam log must not —
+    # unlike the shared stores — grow for the fabric's whole lifetime
+    from koordinator_tpu.runtime.shards import ShardFabric
+
+    fabric = ShardFabric(2, handoff_log_cap=4)
+    for i in range(10):
+        fabric.handoff_log.append(
+            {"shard": 0, "t_out": float(i), "t_in": float(i),
+             "from": "a", "to": "b"}
+        )
+    assert len(fabric.handoff_log) == 4
+    assert fabric.handoff_log[0]["t_out"] == 6.0  # oldest seams evicted
+
+
 def test_graceful_close_releases_leases_and_membership():
     """Graceful ``close()`` must never behave worse than a crash: every
     owned shard's lease is RELEASED (a successor acquires immediately
