@@ -73,18 +73,50 @@ def _print_stage_table(scenario: str, stats) -> None:
         )
 
 
-def _stage_report_pass(build, chunk, name, result) -> None:
+def _stage_report_pass(build, chunk, name, result, dp=None) -> None:
     """One extra drain with the scheduler's tracer ON (runs for
     --stage-report AND/OR --trace): per-stage totals land in the scenario
     entry (``stage_breakdown_ms``), the p50/p99 table goes to stderr
     (stage-report only), and --trace dumps the Chrome trace. Runs after
     the measured passes so tracing overhead never lands in them; the jit
-    caches are already warm, so no compile time pollutes the stages."""
+    caches are already warm, so no compile time pollutes the stages.
+
+    With a solver observatory (``dp``, shared with the warmup/measured
+    builds so the scenario's cold compiles were ledgered), the traced
+    pass runs inside an armed CAPTURE window: every solver dispatch is
+    fenced and recorded on the device lane, and the scenario entry gains
+    ``solve_breakdown_ms`` — the solve residual decomposed into compile
+    (scenario-wide jit wall, warmups included) vs fenced device-compute
+    vs host↔device transfer — plus the per-entry-point compile ledger.
+    The Chrome trace gains the ``device`` lane so device ops line up
+    under their host stage spans."""
     sched, pods = build()
     sched.extender.monitor.stop_background()
     tracer = sched.extender.tracer
     tracer.enabled = True
+    if dp is not None:
+        if sched.devprof is None:
+            # the measured-pass builds run unobserved (see _measure);
+            # the traced pass's own scheduler wires the observatory back
+            sched.attach_devprof(dp)
+        dp.capture(1 << 30)  # the whole traced drain
     _run_scheduler(sched, pods, chunk=chunk)
+    if dp is not None:
+        dp.capture(0)
+        result["solve_breakdown_ms"] = dp.breakdown_ms()
+        result["compiles"] = {
+            fn: {
+                "traces": row["traces"],
+                "compile_s": round(row["compile_seconds"], 3),
+            }
+            for fn, row in dp.ledger.report()["functions"].items()
+        }
+        result["solve_breakdown_note"] = (
+            "compile_ms is the scenario's total jit wall (warmup passes "
+            "included — the measured passes exclude it by the warmup "
+            "discipline); device_compute_ms/transfer_ms are fenced "
+            "dispatch windows from the traced pass only"
+        )
     stats = _stage_stats(tracer.records())
     result["stage_breakdown_ms"] = {
         k: v["total_ms"] for k, v in stats.items()
@@ -96,8 +128,11 @@ def _stage_report_pass(build, chunk, name, result) -> None:
         _print_stage_table(name, stats)
     if TRACE_PATH:
         path = f"{TRACE_PATH.removesuffix('.json')}_{name}.json"
+        doc = tracer.to_chrome_trace()
+        if dp is not None:
+            dp.extend_chrome(doc, tracer.epoch)
         with open(path, "w") as f:
-            json.dump(tracer.to_chrome_trace(), f)
+            json.dump(doc, f)
         result["trace_file"] = path
 
 
@@ -168,6 +203,28 @@ def _measure(build, chunk, name, passes: int = 3):
     distinguishable from regression, VERDICT r2), along with the host
     commit's own per-chunk p50/p99 (CPU-side cost, tunnel-independent)
     and the scenario's measured scalar baseline."""
+    dp = None
+    if STAGE_REPORT or TRACE_PATH:
+        # solver observatory shared between the WARMUP builds (their
+        # cold compiles land in one ledger, with watch signatures for
+        # attribution) and the traced pass's own build — never the
+        # measured or latency builds: a per-cycle census + per-dispatch
+        # watch inside the measured passes would make their recorded
+        # pods_per_sec incomparable to a plain run, exactly the drift
+        # bench_regress exists to catch
+        from koordinator_tpu.obs.devprof import DevProf
+
+        dp = DevProf()
+        _inner_build = build
+        _build_count = {"n": 0}
+
+        def build():
+            sched, pods = _inner_build()
+            _build_count["n"] += 1
+            if _build_count["n"] <= 2:  # the two warmup builds only
+                sched.attach_devprof(dp)
+            return sched, pods
+
     sched, pods = build()
     # first solve of a new jit specialization can exceed the 30 s watchdog;
     # that's the monitor doing its job, but it's noise here — silence it
@@ -235,7 +292,10 @@ def _measure(build, chunk, name, passes: int = 3):
         "vs_baseline": round(median_pps / baseline_pps, 2),
     }
     if STAGE_REPORT or TRACE_PATH:
-        _stage_report_pass(build, chunk, name, result)
+        try:
+            _stage_report_pass(build, chunk, name, result, dp=dp)
+        finally:
+            dp.uninstall()
     return result
 
 

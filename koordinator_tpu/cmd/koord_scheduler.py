@@ -81,6 +81,19 @@ def build_parser() -> argparse.ArgumentParser:
             "need the library-level EpochFence/LeaderCoordinator wiring)"
         ),
     )
+    parser.add_argument(
+        "--flight-file",
+        default="",
+        metavar="PATH",
+        help=(
+            "crash-surviving flight recorder (distributed-observability "
+            "follow-on): append one JSONL per-cycle summary record "
+            "(stage_ms, gate verdicts, speculation outcome, queue depth) "
+            "to PATH beside --journal-file, so a restarted process "
+            "adopts the dead incarnation's last-N cycles and serves them "
+            "at /debug/flightrecorder — the post-mortem black box"
+        ),
+    )
     return parser
 
 
@@ -238,6 +251,24 @@ def main(
         mesh=mesh,
         journal=journal,
     )
+    if args.flight_file:
+        import uuid
+
+        from ..core.journal import FileJournalStore
+        from ..obs.flightrecorder import FlightRecorder
+
+        recorder = FlightRecorder(
+            FileJournalStore(args.flight_file),
+            incarnation=f"koord-scheduler-{uuid.uuid4().hex[:8]}",
+        )
+        adopted = recorder.recovered_records()
+        if adopted:
+            print(
+                f"koord-scheduler: flight recorder adopted "
+                f"{len(adopted)} record(s) from previous incarnation(s)",
+                file=sys.stderr,
+            )
+        sched.attach_flight_recorder(recorder)
     # the rest of the scheduler's world view (pods/devices/quotas/gangs)
     # flows through the same informer hub that already feeds the snapshot
     hub.wire_scheduler(sched, include_snapshot=False)

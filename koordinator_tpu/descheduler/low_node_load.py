@@ -55,6 +55,17 @@ class LowNodeLoadArgs:
     #: check victims fit some low node before evicting (reference NodeFit,
     #: default true)
     node_fit: bool = True
+    #: SLO-driven actuation (distributed-observability follow-on, flag
+    #: OFF by default): when the plugin is wired with an
+    #: ``obs.slo.SloTracker`` and this flag is on, a shard burning its
+    #: placement-latency/queue-age error budget TIGHTENS the high
+    #: thresholds by the burn factor — overloaded nodes become
+    #: actionable sooner, raising migration pressure exactly while the
+    #: SLO is being spent. A healthy shard (burn ≤ 1) changes nothing.
+    slo_pressure: bool = False
+    #: cap on the threshold-tightening factor (burn rates are unbounded;
+    #: pressure must not collapse the thresholds to zero)
+    slo_pressure_cap: float = 4.0
 
 
 @dataclasses.dataclass
@@ -82,11 +93,41 @@ class NodeClassification:
 
 
 class LowNodeLoad:
-    def __init__(self, snapshot: ClusterSnapshot, args: Optional[LowNodeLoadArgs] = None):
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        args: Optional[LowNodeLoadArgs] = None,
+        slo=None,
+        shard: int = 0,
+    ):
         self.snapshot = snapshot
         self.args = args or LowNodeLoadArgs()
+        #: optional obs.slo.SloTracker + the shard this plugin reba-
+        #: lances for — the first consumer of the /slo layer (burn rate
+        #: → migration pressure, behind args.slo_pressure)
+        self.slo = slo
+        self.shard = int(shard)
         self._over_counts: Dict[int, int] = {}
         self._last_cls: Optional[NodeClassification] = None
+
+    def slo_pressure_factor(self) -> float:
+        """Threshold-tightening factor from the shard's SLO burn rate:
+        1.0 while healthy (or the flag/tracker is absent); the worst
+        latency/queue-age burn, capped, while the error budget burns
+        faster than it accrues."""
+        if not self.args.slo_pressure or self.slo is None:
+            return 1.0
+        rows = self.slo.evaluate().get(str(self.shard), {})
+        burn = max(
+            (
+                rows.get(name, {}).get("burn_rate", 0.0)
+                for name in ("p99_latency", "queue_age")
+            ),
+            default=0.0,
+        )
+        if burn <= 1.0:
+            return 1.0
+        return float(min(burn, self.args.slo_pressure_cap))
 
     def _vec(self, table: Mapping[str, float]) -> np.ndarray:
         return np.array(
@@ -121,6 +162,15 @@ class LowNodeLoad:
             avg = util[active].mean(axis=0)
             hi_eff = np.clip(avg + hi, 0.0, 100.0)[None, :]
             lo_eff = np.clip(avg - lo, 0.0, 100.0)[None, :]
+        pressure = self.slo_pressure_factor()
+        if pressure > 1.0:
+            # SLO-driven actuation: a burning shard tightens the high
+            # thresholds, so nodes become actionable at lower utilization
+            # while the error budget is being spent. Floored at the LOW
+            # thresholds: a node must never classify high and low at
+            # once (it would be an eviction source and a migration
+            # destination simultaneously — thrash, not relief).
+            hi_eff = np.maximum(hi_eff / pressure, lo_eff)
         raw_high = active & np.any(hi_on[None, :] & (util > hi_eff), axis=1)
         hi_eff_row = np.array(hi_eff[0])
         low = active & np.all(~lo_on[None, :] | (util < lo_eff), axis=1)

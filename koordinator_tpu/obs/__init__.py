@@ -12,8 +12,18 @@ descheduler and the simulators:
   :class:`RejectionLog` ring buffer + ``rejections_total`` counter the
   scheduler threads from boolean-mask construction through commit
   revalidation.
+* :mod:`devprof` — the solver observatory: compile/retrace ledger over
+  the jitted solver entry points (``/debug/compiles``), on-demand
+  device-timeline capture (``/debug/profile?cycles=N``) merged into the
+  Chrome trace, and the device-memory census + leak sentinel.
 """
 
+from .devprof import (
+    CompileLedger,
+    DeviceMemoryCensus,
+    DevProf,
+    LeakSentinel,
+)
 from .errors import (
     default_error_registry,
     ensure_exceptions_counter,
@@ -33,7 +43,11 @@ from .trace import NULL_TRACER, Span, StageTimer, Tracer
 
 __all__ = [
     "NULL_TRACER",
+    "CompileLedger",
+    "DevProf",
+    "DeviceMemoryCensus",
     "FlightRecorder",
+    "LeakSentinel",
     "HealthRegistry",
     "LifecycleEvent",
     "PodLifecycle",

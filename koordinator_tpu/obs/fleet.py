@@ -84,6 +84,7 @@ def merged_metrics(registries: Mapping[int, object]) -> str:
 def merge_chrome_traces(
     tracers: Mapping[int, object],
     handoffs: Sequence[Mapping[str, object]] = (),
+    pod_flows: Mapping[str, Sequence[Mapping[str, object]]] = (),
 ) -> Dict[str, object]:
     """One Chrome ``trace_event`` document over per-shard tracers: each
     shard renders as its own PROCESS lane (``pid = shard + 1``, named
@@ -95,6 +96,14 @@ def merge_chrome_traces(
     from the donor's drain instant to the new owner's takeover on that
     shard's lane, so a pod queue's journey across owners reads as one
     arrow in Perfetto.
+
+    ``pod_flows`` (uid → that pod's lifecycle events, dicts with
+    ``stage``/``t``/``shard``) additionally links each INDIVIDUAL pod's
+    journey — submit→route→dispatch→ack — as one flow chain across the
+    shard lanes it crossed (``ph "s"``/``"t"``/``"f"`` sharing one id
+    per pod). Events with no shard (submit) anchor on the pod's first
+    shard-scoped lane. Timestamps are lifecycle-clock readings on the
+    tracers' shared monotonic clock, re-based like everything else.
 
     Clock alignment: each tracer exports span ``ts`` relative to its OWN
     construction epoch, so lanes from tracers built at different times
@@ -154,7 +163,62 @@ def merge_chrome_traces(
                 args={"to": hand.get("to", "")},
             )
         )
+    # per-pod flow chains (distributed-observability satellite): one
+    # linked s→t→…→f arrow per pod across the shard lanes it crossed
+    flow_base = len(handoffs) + 1
+    for k, (uid, evs) in enumerate(sorted(dict(pod_flows or {}).items())):
+        points = _pod_flow_points(evs)
+        if len(points) < 2:
+            continue
+        flow_id = flow_base + k
+        last = len(points) - 1
+        t_prev = None
+        for i, (shard, t, stage) in enumerate(points):
+            ts = (float(t) - epoch0) * 1e6
+            if t_prev is not None and ts <= t_prev:
+                # Perfetto drops zero/negative-duration flow steps
+                ts = t_prev + 1e-3
+            t_prev = ts
+            events.append(
+                {
+                    "name": "pod-flow",
+                    "cat": "pod",
+                    "id": flow_id,
+                    "pid": int(shard) + 1,
+                    "tid": 0,
+                    "ph": "s" if i == 0 else ("f" if i == last else "t"),
+                    **({"bp": "e"} if i == last else {}),
+                    "ts": round(ts, 3),
+                    "args": {"uid": uid, "stage": stage},
+                }
+            )
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: lifecycle stages a pod's flow chain links (submit→route→dispatch→ack;
+#: resubmit/handoff ride along so cross-owner journeys stay connected)
+_FLOW_STAGES = ("submit", "route", "enqueue", "resubmit", "handoff",
+                "dispatch", "ack")
+
+
+def _pod_flow_points(evs) -> List[tuple]:
+    """(shard, t, stage) chain for one pod's flow arrows: flow-relevant
+    stages in event order, shardless events anchored on the pod's first
+    shard-scoped lane."""
+    raw = []
+    for ev in evs:
+        if isinstance(ev, Mapping):
+            stage, t, shard = ev.get("stage"), ev.get("t"), ev.get("shard", -1)
+        else:
+            stage, t, shard = ev.stage, ev.t, ev.shard
+        if stage in _FLOW_STAGES:
+            raw.append((int(shard), float(t), stage))
+    first_shard = next((s for s, _t, _st in raw if s >= 0), None)
+    if first_shard is None:
+        return []
+    return [
+        (s if s >= 0 else first_shard, t, st) for s, t, st in raw
+    ]
 
 
 class FleetServices:
@@ -222,6 +286,7 @@ class FleetServices:
     def dispatch(
         self, method: str, path: str, body: str = ""
     ) -> Tuple[int, str]:
+        path, _, query = path.partition("?")
         if path == "/metrics":
             regs = self._registries()
             text = merged_metrics(regs) if regs else "\n"
@@ -243,10 +308,46 @@ class FleetServices:
                 return 404, "no SLO tracker wired"
             return 200, slo.render()
         if path == "/trace":
-            return 200, json.dumps(
-                merge_chrome_traces(
-                    self._tracers(), self.sharded.handoff_log
+            lc = self.sharded.lifecycle
+            tracers = self._tracers()
+            doc = merge_chrome_traces(
+                tracers,
+                self.sharded.handoff_log,
+                pod_flows=(lc.flows() if lc is not None else {}),
+            )
+            # each shard's solver-observatory device lane rides in that
+            # shard's process lane, re-based on the same fleet epoch the
+            # merge used for the span lanes
+            epoch0 = min(
+                (
+                    float(getattr(tr, "epoch", 0.0))
+                    for tr in tracers.values()
+                ),
+                default=0.0,
+            )
+            for s, rt in sorted(self.sharded._runtimes.items()):
+                dp = getattr(rt.sched, "devprof", None)
+                if dp is not None and dp.device_events:
+                    doc["traceEvents"] = list(doc["traceEvents"]) + (
+                        dp.chrome_device_events(epoch0, pid=int(s) + 1)
+                    )
+            return 200, json.dumps(doc)
+        if path in ("/debug/compiles", "/debug/profile"):
+            # forwarded per owned shard (same shape as /debug/pipeline);
+            # shards without an observatory report their 404 body
+            shards = {}
+            fwd = path + (f"?{query}" if query else "")
+            for s, rt in sorted(self.sharded._runtimes.items()):
+                code, text = rt.sched.extender.services.dispatch(
+                    method, fwd, body
                 )
+                try:
+                    shards[str(s)] = json.loads(text)
+                except ValueError:
+                    shards[str(s)] = {"status": code, "body": text}
+            return 200, json.dumps(
+                {"incarnation": self.sharded.name, "shards": shards},
+                indent=1,
             )
         if path == "/debug/pipeline":
             shards = {

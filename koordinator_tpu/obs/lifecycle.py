@@ -33,11 +33,12 @@ tracer's no-op singleton.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 #: terminal stages: the pod's placement story is over
 _TERMINAL = frozenset({"ack", "gone"})
@@ -90,6 +91,20 @@ class LifecycleEvent:
         }
 
 
+class _ShardBuffer:
+    """One shard's event buffer: its own lock, its own uid→events map.
+    The hot ``event()`` path touches ONLY this lock — per-shard pump
+    threads sharing one tracker no longer serialize on a fleet-wide
+    mutex (PR 7 queued follow-on)."""
+
+    __slots__ = ("lock", "events")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        #: uid -> [(global seq, event), ...] in this shard's append order
+        self.events: Dict[str, List[Tuple[int, LifecycleEvent]]] = {}
+
+
 class PodLifecycle:
     """Thread-safe per-pod event timeline + placement-latency histogram.
 
@@ -101,7 +116,18 @@ class PodLifecycle:
     ``registry`` (a ``utils.metrics.Registry``) receives
     ``placement_latency_seconds{shard,stage}``; pass the fleet registry
     to fold the histogram into the merged scrape.
-    """
+
+    Storage is PER-SHARD buffers merged on read: each shard's events
+    append under that shard's own lock, so concurrent per-shard pump
+    threads contend only on their own buffer (plus a rare structure
+    lock at first sight of a uid and at terminal events). A global
+    atomic sequence number (``itertools.count`` — C-level, effectively
+    atomic under the GIL) preserves the fleet-wide arrival order a
+    single buffer used to give for free: a merged timeline sorts by
+    sequence, never by possibly-tied timestamps, so causal order across
+    shards (orphan before resubmit at the same sim-clock tick) survives
+    the split. Reads (timeline/validate/render) take every buffer lock —
+    they are the cold path by design."""
 
     def __init__(
         self,
@@ -110,12 +136,20 @@ class PodLifecycle:
         max_pods: int = 200_000,
     ):
         self.clock = clock
-        self._events: Dict[str, List[LifecycleEvent]] = {}
+        #: shard id (-1 = shardless submit lane) -> its buffer
+        self._bufs: Dict[int, _ShardBuffer] = {}
+        #: every known uid in FIRST-SIGHT order (dict-as-ordered-set);
+        #: the max_pods bound is over this registry
+        self._uids: Dict[str, None] = {}
         #: completed uids in COMPLETION order (dict-as-ordered-set), so
         #: eviction under the max_pods bound drops the oldest finished
         #: timelines first, deterministically
         self._done: Dict[str, None] = {}
+        #: STRUCTURE lock: buffer creation, uid registry, done set,
+        #: eviction. Never held while a caller holds a buffer lock
+        #: (lock order is always structure → buffer).
         self._lock = threading.Lock()
+        self._next_seq = itertools.count(1).__next__
         self.max_pods = max_pods
         #: kept so the fleet scrape can fold this incarnation-level
         #: registry into /metrics verbatim (its samples already carry
@@ -142,38 +176,62 @@ class PodLifecycle:
         t: Optional[float] = None,
         detail: str = "",
     ) -> None:
+        shard = int(shard)
         ev = LifecycleEvent(
             stage=stage,
             t=self.clock() if t is None else t,
-            shard=int(shard),
+            shard=shard,
             detail=detail,
         )
-        with self._lock:
-            evs = self._events.get(uid)
-            if evs is None:
-                if len(self._events) >= self.max_pods:
-                    # bounded: drop the oldest COMPLETED timelines first
-                    # (an unbounded tracker would leak for the fleet's
-                    # lifetime); if none are left — a fleet whose churn
-                    # is dominated by never-placed pods, which have no
-                    # terminal event — fall back to the oldest OPEN
-                    # timelines so the bound still holds
-                    victims = list(self._done)[
-                        : max(1, self.max_pods // 10)
-                    ]
-                    if not victims:
-                        victims = [
-                            u
-                            for u in self._events
-                            if u not in self._done
-                        ][: max(1, self.max_pods // 10)]
-                    for old_uid in victims:
-                        self._events.pop(old_uid, None)
-                        self._done.pop(old_uid, None)
-                evs = self._events[uid] = []
-            evs.append(ev)
-            if stage in _TERMINAL:
+        # first sight of a uid registers it (and maybe evicts) under the
+        # STRUCTURE lock — the membership pre-check is GIL-safe and keeps
+        # steady-state appends off that lock entirely
+        if uid not in self._uids:
+            with self._lock:
+                if uid not in self._uids:
+                    if len(self._uids) >= self.max_pods:
+                        self._evict_locked()
+                    self._uids[uid] = None
+        buf = self._bufs.get(shard)
+        if buf is None:
+            with self._lock:
+                buf = self._bufs.setdefault(shard, _ShardBuffer())
+        seq = self._next_seq()
+        with buf.lock:
+            buf.events.setdefault(uid, []).append((seq, ev))
+        # close the register→append race: a concurrent eviction may have
+        # purged this uid between the fast-path check and the append,
+        # leaving the fresh entry orphaned (in no registry, so no future
+        # eviction could ever reclaim it). The racy membership re-check
+        # is one GIL-atomic dict read; the slow path re-registers.
+        if uid not in self._uids:
+            with self._lock:
+                if uid not in self._uids:
+                    self._uids[uid] = None
+        if stage in _TERMINAL:
+            with self._lock:
                 self._done[uid] = None
+
+    def _evict_locked(self) -> None:
+        """Bounded retention: drop the oldest COMPLETED timelines first
+        (an unbounded tracker would leak for the fleet's lifetime); if
+        none are left — a fleet whose churn is dominated by never-placed
+        pods, which have no terminal event — fall back to the oldest
+        OPEN timelines so the bound still holds. Caller holds the
+        structure lock; buffer locks nest inside it (lock order)."""
+        victims = list(self._done)[: max(1, self.max_pods // 10)]
+        if not victims:
+            victims = [
+                u for u in self._uids if u not in self._done
+            ][: max(1, self.max_pods // 10)]
+        victim_set = set(victims)
+        for buf in self._bufs.values():
+            with buf.lock:
+                for old_uid in victim_set:
+                    buf.events.pop(old_uid, None)
+        for old_uid in victims:
+            self._uids.pop(old_uid, None)
+            self._done.pop(old_uid, None)
 
     # stage-specific helpers keep call sites short and the stage names
     # in ONE vocabulary (typos would silently break the validator)
@@ -202,22 +260,21 @@ class PodLifecycle:
         t = self.clock() if t is None else t
         self.event(uid, "ack", shard=shard, t=t, detail=node)
         self._observe(uid, shard, t)
-        with self._lock:
-            evs = self._events.get(uid, ())
-            t0 = next((e.t for e in evs if e.stage == "submit"), None)
+        t0 = next(
+            (e.t for e in self.timeline(uid) if e.stage == "submit"), None
+        )
         return None if t0 is None else max(0.0, t - t0)
 
     def seen(self, uid: str) -> bool:
         with self._lock:
-            return uid in self._events
+            return uid in self._uids
 
     # ---- the histogram decomposition ----
 
     def _observe(self, uid: str, shard: int, t_ack: float) -> None:
         if self.histogram is None:
             return
-        with self._lock:
-            evs = list(self._events.get(uid, ()))
+        evs = self.timeline(uid)
         last: Dict[str, float] = {}
         first_submit: Optional[float] = None
         for ev in evs:
@@ -256,14 +313,11 @@ class PodLifecycle:
         record: the ORIGINAL submit stamp and the shard-hop count. A
         takeover's replay hands it back to :meth:`recovered` so the
         bridged timeline keeps the true arrival time."""
-        with self._lock:
-            evs = self._events.get(uid)
-            if not evs:
-                return None
-            t0 = next(
-                (e.t for e in evs if e.stage == "submit"), evs[0].t
-            )
-            hops = len({e.shard for e in evs if e.shard >= 0})
+        evs = self.timeline(uid)
+        if not evs:
+            return None
+        t0 = next((e.t for e in evs if e.stage == "submit"), evs[0].t)
+        hops = len({e.shard for e in evs if e.shard >= 0})
         return {"t0": t0, "hops": hops}
 
     def recovered(
@@ -278,7 +332,7 @@ class PodLifecycle:
         incarnation. If the tracker never saw the pod submit (a genuinely
         fresh process), the journaled context re-seeds the timeline."""
         with self._lock:
-            fresh = uid not in self._events
+            fresh = uid not in self._uids
             done = uid in self._done
         if done:
             return  # already terminal: replay of an old bind, no gap
@@ -293,12 +347,32 @@ class PodLifecycle:
     # ---- inspection ----
 
     def timeline(self, uid: str) -> List[LifecycleEvent]:
+        """The pod's merged timeline: per-shard buffers joined and
+        ordered by the global arrival sequence (true fleet-wide append
+        order, not possibly-tied timestamps)."""
         with self._lock:
-            return list(self._events.get(uid, ()))
+            bufs = list(self._bufs.values())
+        merged: List[Tuple[int, LifecycleEvent]] = []
+        for buf in bufs:
+            with buf.lock:
+                merged.extend(buf.events.get(uid, ()))
+        merged.sort(key=lambda pair: pair[0])
+        return [ev for _seq, ev in merged]
 
     def uids(self) -> List[str]:
         with self._lock:
-            return list(self._events)
+            return list(self._uids)
+
+    def flows(self, max_pods: int = 256) -> Dict[str, List[dict]]:
+        """Per-pod flow-arrow feed for the merged Chrome trace
+        (``obs.fleet.merge_chrome_traces(pod_flows=...)``): the most
+        recently COMPLETED ``max_pods`` pods' timelines as event dicts."""
+        with self._lock:
+            done = list(self._done)[-max_pods:]
+        return {
+            uid: [e.to_dict() for e in self.timeline(uid)]
+            for uid in done
+        }
 
     def render(self, uid: str) -> str:
         return json.dumps(

@@ -53,6 +53,7 @@ from flax import struct
 
 from . import costs as cost_ops
 from . import masks as mask_ops
+from ..obs import devprof as _devprof
 from .masks import EPS
 
 
@@ -259,6 +260,7 @@ def scatter_rows(full, idx, rows):
     reference is dead after the call; every call site replaces its
     resident handle with the return value and never re-reads the input
     (tests assert buffer-pointer stability on the refresh path)."""
+    _devprof.tracing("scatter_rows")
     return jax.tree.map(lambda f, r: f.at[idx].set(r), full, rows)
 
 
@@ -271,6 +273,7 @@ def gather_rows(full, idx, valid):
     donated: the resident arrays are re-read by later refreshes/windows
     (donation audit, perf PR 4 — same reason ``assign`` never donates its
     node/quota inputs)."""
+    _devprof.tracing("gather_rows")
 
     def take(f):
         out = f[idx]
@@ -554,6 +557,7 @@ def assign(
     spread). ``nomination_jitter=0.0, topk=1`` restores strict per-pod
     argmin *nomination* (batched commit semantics are unchanged); the
     deviation-vs-throughput trade is these two knobs."""
+    _devprof.tracing("assign")
     p = pods.requests.shape[0]
     n = nodes.allocatable.shape[0]
     # Static specialization: with no quota tree the per-level sort/prefix
@@ -1144,6 +1148,7 @@ def solve_stream(
     stream (next wave of pending pods) can thread consumption the same way
     it threads node capacity.
     """
+    _devprof.tracing("solve_stream")
     quota_enabled = quotas is not None
     if quotas is None:
         quotas = QuotaState.disabled(pods_stacked.requests.shape[-1])
@@ -1222,6 +1227,7 @@ def solve_stream_full(
 
     Returns ``(assignments [C, P], pod_zones [C, P], rounds [C])``.
     """
+    _devprof.tracing("solve_stream_full")
     quota_enabled = quotas is not None
     if quotas is None:
         quotas = QuotaState.disabled(pods_stacked.requests.shape[-1])
@@ -1431,6 +1437,7 @@ def assign_sequential(
     reference's one-pod-at-a-time cycle (the golden contract; SURVEY §7
     step 2 "batched masked argmin with capacity-consuming sequential
     commit (scan)")."""
+    _devprof.tracing("assign_sequential")
     p = pods.requests.shape[0]
     n = nodes.allocatable.shape[0]
     quota_enabled = quotas is not None

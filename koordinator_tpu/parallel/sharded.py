@@ -16,12 +16,11 @@ suboptimal; semantics are identical either way.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import devprof as _devprof
 from ..ops.solver import (
     NodeState,
     PodBatch,
@@ -175,8 +174,13 @@ def sharded_assign(
         pod_zone_charge=NamedSharding(mesh, P("dp", None)),
     )
 
+    def _traced_assign(p, n, pr):
+        # retrace ledger hook (obs.devprof): runs at trace time only
+        _devprof.tracing("sharded_assign")
+        return assign(p, n, pr, max_rounds=max_rounds)
+
     fn = jax.jit(
-        functools.partial(assign, max_rounds=max_rounds),
+        _traced_assign,
         in_shardings=(pod_sh, node_sh, param_sh),
         out_shardings=out_sh,
     )
@@ -210,10 +214,14 @@ def sharded_solve_stream(
     rep = NamedSharding(mesh, P())
     param_sh = jax.tree.map(lambda _: rep, params)
 
+    def _traced_stream(p, n, pr):
+        _devprof.tracing("sharded_solve_stream")
+        return solve_stream(
+            p, n, pr, max_rounds=max_rounds, approx_topk=approx_topk
+        )
+
     fn = jax.jit(
-        functools.partial(
-            solve_stream, max_rounds=max_rounds, approx_topk=approx_topk
-        ),
+        _traced_stream,
         in_shardings=(pod_sh, node_sh, param_sh),
         out_shardings=(
             NamedSharding(mesh, P(None, "dp")),
@@ -329,6 +337,7 @@ def shard_map_nominate(
         out_specs=(P(), P()),
     )
     def nominate(pods_l, nodes_l, params_l):
+        _devprof.tracing("shard_map_nominate")
         # global node index of this shard's rows — the jitter hash and the
         # returned candidate indices must be shard-position-aware
         tpi = jax.lax.axis_index("tp")

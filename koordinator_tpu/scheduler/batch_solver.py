@@ -28,6 +28,7 @@ from ..chaos import NULL_INJECTOR, FaultInjector
 from ..core.journal import JournalWriteError, StaleEpochError
 from ..core.snapshot import ClusterSnapshot, SnapshotConfig, bucket_size
 from ..obs import RejectReason, RejectStage, report_exception
+from ..obs.devprof import NULL_WATCH as _NULL_WATCH
 from ..ops import estimator
 from ..ops.solver import (
     NodeState,
@@ -523,6 +524,12 @@ class BatchScheduler:
         #: stream pump hints its backlog depth here for that record
         self.lifecycle = None
         self.flight_recorder = None
+        #: solver observatory (obs.devprof.DevProf): compile/retrace
+        #: ledger + on-demand device-timeline capture + per-cycle
+        #: device-memory census. None = disabled; every hot-path site is
+        #: one attribute-is-None check (PR 1/PR 7 standing rule). Attach
+        #: via attach_devprof.
+        self.devprof = None
         self._queue_depth_hint = 0
         #: most recent pipeline gate evaluation (set by CyclePipeline)
         self.last_gate_report: Dict[str, object] = {}
@@ -555,6 +562,14 @@ class BatchScheduler:
         ring at ``/debug/flightrecorder``."""
         self.flight_recorder = recorder
         self.extender.services.flightrecorder = recorder
+
+    def attach_devprof(self, devprof) -> None:
+        """Wire the solver observatory (obs.devprof.DevProf): installs
+        the trace-time retrace hook, serves the ledger at
+        ``/debug/compiles`` and the capture window at ``/debug/profile``,
+        and samples the device-memory census every cycle."""
+        self.devprof = devprof.install()
+        self.extender.services.devprof = devprof
 
     # ---- HA: leadership grant/revoke (driven by the LeaderCoordinator) ----
 
@@ -690,12 +705,26 @@ class BatchScheduler:
         idx = np.empty((b,), np.int32)
         idx[: len(rows)] = rows
         idx[len(rows) :] = rows[-1]
+        dp = self.devprof
         with self.extender.tracer.span(
             span_name, cat="scheduler", dirty=len(rows), uploaded=b
         ):
-            state = scatter_rows(
-                cached_state, jnp.asarray(idx), make_blocks(idx)
-            )
+            with (
+                dp.watch(
+                    "scatter_rows", stage="snapshot", kind="transfer",
+                    table=table, rows=b,
+                )
+                if dp is not None
+                else _NULL_WATCH
+            ) as w:
+                state = scatter_rows(
+                    cached_state, jnp.asarray(idx), make_blocks(idx)
+                )
+                w.result(state)
+        if dp is not None:
+            # donation-effectiveness: the donated resident pytree must be
+            # DEAD after the scatter (a live leaf means XLA copied)
+            dp.census.check_donation(cached_state)
         reg.get("solver_h2d_rows_total").inc(float(b))
         reg.get("solver_state_cache_hits_total").labels(table=table).inc()
         return state
@@ -735,10 +764,20 @@ class BatchScheduler:
                 # bucket or flag change: stale marks are meaningless for
                 # the rebuilt mirror
                 snap.drain_dirty(owner=id(self))
+            dp = self.devprof
             with tr.span(
                 "snapshot:node_full_lower", cat="scheduler", uploaded=n_bucket
             ):
-                new = self._node_state_rows(None)
+                with (
+                    dp.watch(
+                        "node_full_lower", stage="snapshot",
+                        kind="transfer", n=n_bucket,
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    new = self._node_state_rows(None)
+                    w.result(new)
             reg.get("solver_h2d_rows_total").inc(float(n_bucket))
             self._resident_nodes = new
             self._resident_key = key
@@ -766,10 +805,20 @@ class BatchScheduler:
         idx[: len(sub)] = sub
         valid = np.zeros((b,), bool)
         valid[: len(sub)] = True
+        dp = self.devprof
         with self.extender.tracer.span(
             "snapshot:window_gather", cat="scheduler", window=len(sub)
         ):
-            out = gather_rows(full, jnp.asarray(idx), jnp.asarray(valid))
+            with (
+                dp.watch(
+                    "gather_rows", stage="snapshot", kind="transfer",
+                    window=b,
+                )
+                if dp is not None
+                else _NULL_WATCH
+            ) as w:
+                out = gather_rows(full, jnp.asarray(idx), jnp.asarray(valid))
+                w.result(out)
         self._window_cache = (key, out)
         return out
 
@@ -1033,11 +1082,16 @@ class BatchScheduler:
             cycle=cid,
             pods=len(pending),
         )
+        dp = self.devprof
+        if dp is not None:
+            dp.cycle_begin(cid)
         with cycle_timer:
             try:
                 out = self._schedule_locked(pending, seq, _retry)
             finally:
                 seq.close()
+                if dp is not None:
+                    dp.cycle_end(self)
         if self.flight_recorder is not None:
             self._record_cycle(cid, seq.totals, cycle_timer.last_dur, out)
         return out
@@ -2474,22 +2528,41 @@ class BatchScheduler:
         mask_stacked = (
             jnp.stack(masks_list) if masks_list is not None else None
         )
+        dp = self.devprof
         with self.extender.tracer.span(
             "assign", cat="scheduler", mode="scanned", chunks=c_real
         ):
-            assignments, zones, rounds = solve_stream_full(
-                stacked,
-                nodes0,
-                self._params,
-                quotas=quotas0,
-                numa=numa_state,
-                devices=device_state,
-                max_rounds=self.max_rounds,
-                approx_topk=True,
-                numa_scoring=self._numa_scoring(),
-                device_scoring=self._device_scoring(),
-                node_mask=mask_stacked,
-            )
+            with (
+                dp.watch(
+                    "solve_stream_full",
+                    chunks=c_bucket,
+                    bucket=bucket,
+                    n=n_axis,
+                    quotas=quotas0 is not None,
+                    numa=numa_state is not None,
+                    devices=device_state is not None,
+                    mask=mask_stacked is not None,
+                    numa_scoring=self._numa_scoring(),
+                    device_scoring=self._device_scoring(),
+                    max_rounds=self.max_rounds,
+                )
+                if dp is not None
+                else _NULL_WATCH
+            ) as w:
+                assignments, zones, rounds = solve_stream_full(
+                    stacked,
+                    nodes0,
+                    self._params,
+                    quotas=quotas0,
+                    numa=numa_state,
+                    devices=device_state,
+                    max_rounds=self.max_rounds,
+                    approx_topk=True,
+                    numa_scoring=self._numa_scoring(),
+                    device_scoring=self._device_scoring(),
+                    node_mask=mask_stacked,
+                )
+                w.result(assignments)
             host_a = np.asarray(assignments)
             host_z = (
                 np.asarray(zones)
@@ -2574,29 +2647,48 @@ class BatchScheduler:
                 (pods_t, _, _, _, _, node_mask, _, _) = shard_solver_inputs(
                     self.mesh, pods=pods_t, node_mask=node_mask
                 )
+            dp = self.devprof
             with self.extender.tracer.span(
                 "assign", cat="scheduler", mode="pipelined", pods=len(chunk)
             ):
-                result = assign(
-                    pods_t,
-                    nodes_t,
-                    self._params,
-                    quotas=(
-                        QuotaState(runtime=quotas0.runtime, used=qused)
-                        if quotas0 is not None
-                        else None
-                    ),
-                    numa=numa_state,
-                    devices=device_state,
-                    max_rounds=self.max_rounds,
-                    cost_transform=self.extender.cost_transform,
-                    approx_topk=True,
-                    node_mask=node_mask,
-                    dev_carry=dev_carry,
-                    numa_carry=numa_carry,
-                    numa_scoring=self._numa_scoring(),
-                    device_scoring=self._device_scoring(),
-                )
+                with (
+                    dp.watch(
+                        "assign",
+                        bucket=pods_t.requests.shape[0],
+                        n=nodes_t.allocatable.shape[0],
+                        quotas=quotas0 is not None,
+                        numa=numa_state is not None,
+                        devices=device_state is not None,
+                        mask=node_mask is not None,
+                        carry=dev_carry is not None or numa_carry is not None,
+                        numa_scoring=self._numa_scoring(),
+                        device_scoring=self._device_scoring(),
+                        max_rounds=self.max_rounds,
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    result = assign(
+                        pods_t,
+                        nodes_t,
+                        self._params,
+                        quotas=(
+                            QuotaState(runtime=quotas0.runtime, used=qused)
+                            if quotas0 is not None
+                            else None
+                        ),
+                        numa=numa_state,
+                        devices=device_state,
+                        max_rounds=self.max_rounds,
+                        cost_transform=self.extender.cost_transform,
+                        approx_topk=True,
+                        node_mask=node_mask,
+                        dev_carry=dev_carry,
+                        numa_carry=numa_carry,
+                        numa_scoring=self._numa_scoring(),
+                        device_scoring=self._device_scoring(),
+                    )
+                    w.result(result.assignment)
             if nodes_t is cur:
                 # no node transformer ran: the solver outputs ARE the
                 # chained state (avoids extra dispatches on the tunnel —
@@ -2734,18 +2826,38 @@ class BatchScheduler:
                 node_mask = self._node_constraint_mask(
                     chunk, pods.requests.shape[0], None
                 )
+            dp = self.devprof
             with self.extender.tracer.span(
                 "assign", cat="scheduler", mode="chained", pods=len(chunk)
             ):
-                result = assign(
-                    pods,
-                    cur,
-                    self._params,
-                    quotas=None,
-                    max_rounds=self.max_rounds,
-                    approx_topk=True,
-                    node_mask=node_mask,
-                )
+                with (
+                    dp.watch(
+                        "assign",
+                        stage="overlap",
+                        bucket=pods.requests.shape[0],
+                        n=cur.allocatable.shape[0],
+                        quotas=False,
+                        numa=False,
+                        devices=False,
+                        mask=node_mask is not None,
+                        carry=True,
+                        numa_scoring=None,
+                        device_scoring=None,
+                        max_rounds=self.max_rounds,
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    result = assign(
+                        pods,
+                        cur,
+                        self._params,
+                        quotas=None,
+                        max_rounds=self.max_rounds,
+                        approx_topk=True,
+                        node_mask=node_mask,
+                    )
+                    w.result(result.assignment)
             # zero-copy chain replace (the solver outputs ARE the chained
             # state; allocatable/flags leaves stay aliased)
             cur = cur.replace(
@@ -2976,26 +3088,47 @@ class BatchScheduler:
                 devices=device_state,
                 node_mask=node_mask,
             )
+        dp = self.devprof
         with self.extender.tracer.span(
             "assign", cat="scheduler", pods=len(chunk)
         ):
-            return assign(
-                pods,
-                nodes,
-                self._params,
-                quotas=quotas,
-                numa=numa_state,
-                devices=device_state,
-                max_rounds=self.max_rounds,
-                cost_transform=self.extender.cost_transform,
-                # TPU-optimized partial top-k with the exact argmin pinned
-                # in slot 0 (see ops.solver) — same nominations contract,
-                # avoids lax.top_k's full variadic sort per round
-                approx_topk=True,
-                node_mask=node_mask,
-                numa_scoring=self._numa_scoring(),
-                device_scoring=self._device_scoring(),
-            )
+            with (
+                dp.watch(
+                    "assign",
+                    bucket=pods.requests.shape[0],
+                    n=nodes.allocatable.shape[0],
+                    quotas=quotas is not None,
+                    numa=numa_state is not None,
+                    devices=device_state is not None,
+                    mask=node_mask is not None,
+                    carry=False,
+                    numa_scoring=self._numa_scoring(),
+                    device_scoring=self._device_scoring(),
+                    max_rounds=self.max_rounds,
+                )
+                if dp is not None
+                else _NULL_WATCH
+            ) as w:
+                result = assign(
+                    pods,
+                    nodes,
+                    self._params,
+                    quotas=quotas,
+                    numa=numa_state,
+                    devices=device_state,
+                    max_rounds=self.max_rounds,
+                    cost_transform=self.extender.cost_transform,
+                    # TPU-optimized partial top-k with the exact argmin
+                    # pinned in slot 0 (see ops.solver) — same nominations
+                    # contract, avoids lax.top_k's full variadic sort per
+                    # round
+                    approx_topk=True,
+                    node_mask=node_mask,
+                    numa_scoring=self._numa_scoring(),
+                    device_scoring=self._device_scoring(),
+                )
+                w.result(result.assignment)
+                return result
 
     def _node_constraint_mask(
         self,

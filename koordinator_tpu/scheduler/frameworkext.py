@@ -425,6 +425,10 @@ class ServicesEngine:
                                named gate keeps this config serial)
       /debug/flightrecorder  — last-N per-cycle summaries (crash-
                                surviving black box)
+      /debug/compiles        — solver compile/retrace ledger (traces per
+                               entry point, signature diffs, compile wall)
+      /debug/profile         — solver observatory status; ?cycles=N arms
+                               an on-demand device-timeline capture window
       /apis/v1/<plugin>/…    — handlers installed by plugins
     """
 
@@ -450,6 +454,7 @@ class ServicesEngine:
         #: answer accordingly
         self.slo = None
         self.flightrecorder = None
+        self.devprof = None
         self.gate_info: Optional[Callable[[], Dict[str, object]]] = None
         self._routes: Dict[str, Callable[[str], Tuple[int, str]]] = {}
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -460,6 +465,7 @@ class ServicesEngine:
         self._routes[f"/apis/v1/{plugin}{path}"] = handler
 
     def dispatch(self, method: str, path: str, body: str = "") -> Tuple[int, str]:
+        path, _, query = path.partition("?")
         if path == "/metrics":
             return 200, self.registry.expose()
         if path == "/healthz":
@@ -475,7 +481,13 @@ class ServicesEngine:
                 if not self.tracer.enabled:
                     self.tracer.clear()
                 return 200, str(self.tracer.enabled)
-            return 200, self.tracer.export_json()
+            doc = self.tracer.to_chrome_trace()
+            if self.devprof is not None:
+                # device-lane events from the observatory's capture
+                # window merge under their host stage spans (same
+                # monotonic clock, re-based on the tracer's epoch)
+                self.devprof.extend_chrome(doc, self.tracer.epoch)
+            return 200, json.dumps(doc)
         if path == "/slo":
             if self.slo is None:
                 return 404, "no SLO tracker wired"
@@ -488,6 +500,29 @@ class ServicesEngine:
             if self.flightrecorder is None:
                 return 404, "no flight recorder wired"
             return 200, self.flightrecorder.render()
+        if path == "/debug/compiles":
+            if self.devprof is None:
+                return 404, "no solver observatory wired"
+            return 200, self.devprof.ledger.render()
+        if path == "/debug/profile":
+            if self.devprof is None:
+                return 404, "no solver observatory wired"
+            # /debug/profile?cycles=N (or POST body N) arms an on-demand
+            # capture window: the next N cycles run with fenced,
+            # device-lane-recorded solver dispatches
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv
+            )
+            raw = params.get("cycles", body.strip() if method == "POST" else "")
+            if raw:
+                try:
+                    cycles = int(raw)
+                except ValueError:
+                    return 400, "bad cycles (want an integer)"
+                return 200, json.dumps(
+                    self.devprof.capture(cycles), indent=1
+                )
+            return 200, self.devprof.render()
         if path == "/debug/rejections":
             if method == "POST":
                 return 405, "rejection log is read-only"

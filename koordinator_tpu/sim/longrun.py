@@ -677,6 +677,17 @@ def run_chaos_soak(
     rng_ha = _random.Random(seed ^ 0x51F15EED)
 
     chaos = FaultInjector(seed=seed)
+    # solver observatory (devprof PR): the compile/retrace ledger rides
+    # the whole soak. Warmup and the scheduled structural faults (bucket
+    # degrade, surge, crash-restart) legitimately compile new shapes;
+    # once they are behind us the steady-state contract is RETRACE-FREE
+    # — a steady retrace means a shape/flag leak on the hot solve path.
+    # The leak sentinel samples live device arrays across incarnation
+    # boundaries (ha crash-restart): monotone growth fails.
+    from koordinator_tpu.obs.devprof import CompileLedger, LeakSentinel
+
+    ledger = CompileLedger().install()
+    leaks = LeakSentinel(tolerance_bytes=4 << 20)
     snap = ClusterSnapshot()
     # preemption off: the soak's contract is that every pod binds exactly
     # once and stays bound until completion — an evicted victim would be
@@ -841,6 +852,10 @@ def run_chaos_soak(
     # HA leg (failover PR): one scheduled kill-restart well after the
     # other fault domains have fired, leader flaps from the rng_ha stream
     restart_cycle = max(6, (3 * cycles) // 5) if ha else None
+    # retrace-free steady state starts once every scheduled structural
+    # fault (deadline surge/degrade, crash-restart) is behind + slack
+    # for the degrade to re-promote
+    steady_cycle = max(deadline_cycle, restart_cycle or 0, crash_cycle) + 8
 
     # ---- HA coordinator: lease election + epoch fence + recovery ----
     coord = None
@@ -916,6 +931,9 @@ def run_chaos_soak(
         hub.wire_scheduler(sched)
         hub.start()
         coord = _make_coordinator()
+        # incarnation boundary: the dead process's resident arrays must
+        # actually die (leak-detector arm)
+        leaks.sample(f"restart-{incarnation}")
 
     def _sync_cycle_delta(new_bound, forgotten):
         """Mirror this cycle's bindings/completions to the sidecar; a
@@ -961,6 +979,9 @@ def run_chaos_soak(
     total_cycles = cycles + drain_limit
     for cycle in range(total_cycles):
         stats["cycles"] += 1
+        if cycle == steady_cycle:
+            ledger.mark_steady()
+            leaks.sample("steady")
         arriving = []
         if cycle < cycles:
             # ---- seeded fault schedule (arrivals stop at `cycles`;
@@ -1239,6 +1260,26 @@ def run_chaos_soak(
         stats["fenced_commits_total"] = reg.get(
             "leader_fenced_commits_total"
         ).value()
+    # ---- solver-observatory arm (devprof PR) ----
+    try:
+        leaks.sample("end")
+        leak_problems = leaks.problems()
+        assert not leak_problems, leak_problems
+        stats["leak_samples"] = list(leaks.samples)
+        stats["solver_traces_total"] = ledger.total_traces()
+        stats["steady_retraces"] = ledger.steady_retraces()
+        if cycles >= 30:
+            # short determinism pairs may not reach a meaningful steady
+            # window; the fast subset and acceptance soaks must be
+            # retrace-free once warm (compile ledger tentpole assertion)
+            assert stats["steady_retraces"] == 0, (
+                f"{stats['steady_retraces']} steady-state retrace(s): "
+                f"{ledger.steady_causes()}"
+            )
+    finally:
+        # a failing assert must not leave the ledger installed in the
+        # process-global hook registry for the rest of the test session
+        ledger.uninstall()
     stats["fallback_level_final"] = sched._fallback_level
     stats["health_ok"] = sched.extender.health.ok()
     stats["metrics"] = {
@@ -1430,6 +1471,13 @@ def _run_sharded_soak(
         )
 
     incs = [_make_incarnation(i, 0) for i in range(incarnations)]
+    # leak-detector arm (devprof PR): live device arrays sampled at each
+    # incarnation boundary — a killed incarnation's resident tables must
+    # actually die; monotone growth across the samples fails the soak
+    from koordinator_tpu.obs.devprof import LeakSentinel
+
+    leaks = LeakSentinel(tolerance_bytes=4 << 20)
+    leaks.sample("gen0-built")
     # everyone heartbeats BEFORE the first election step so the initial
     # rendezvous ranking sees the full membership (otherwise the first
     # ticker grabs every shard and immediately hands most back)
@@ -1709,6 +1757,9 @@ def _run_sharded_soak(
             stats["claims_lost"] += doomed.stats["claims_lost"]
             idx = incs.index(doomed)
             incs[idx] = _make_incarnation(idx, gen=1)
+            # incarnation boundary: the dead incarnation's per-shard
+            # resident state must be collectable now (leak-detector arm)
+            leaks.sample("post-kill")
 
         # ---- completions release through the informer fan-out; on an
         # OWNERLESS shard the driver journals the forget fence-exempt
@@ -1921,6 +1972,12 @@ def _run_sharded_soak(
     )
     assert stats["slo_latency_samples"] > 0
     assert stats["slo_recovery_samples"] > 0
+    # leak-detector arm (devprof PR): monotone live-array growth across
+    # the incarnation boundaries fails the soak
+    leaks.sample("end")
+    leak_problems = leaks.problems()
+    assert not leak_problems, leak_problems
+    stats["leak_samples"] = list(leaks.samples)
     for inc in incs:
         inc.close()
     hub.stop()

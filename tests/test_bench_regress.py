@@ -1,0 +1,171 @@
+"""Bench regression gate self-test (devprof tentpole satellite): the
+noise-aware thresholds must flag a synthetic regression, pass a synthetic
+no-regression, and run clean over the COMMITTED round pair — the tool
+only ever compares committed JSON; no bench runs inside tier-1."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import bench_regress  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _entry(scenario, pps, passes=None, **extra):
+    out = {"scenario": scenario, "pods_per_sec": pps}
+    if passes is not None:
+        out["passes"] = passes
+    out.update(extra)
+    return out
+
+
+def _rows_by_scenario(rows):
+    return {r["scenario"]: r for r in rows}
+
+
+class TestCompare:
+    def test_flags_regression_and_improvement(self):
+        base = bench_regress.load_artifact(
+            [
+                _entry("a", 1000.0, [990.0, 1000.0, 1010.0]),
+                _entry("b", 1000.0, [990.0, 1000.0, 1010.0]),
+                _entry("c", 1000.0, [990.0, 1000.0, 1010.0]),
+            ]
+        )
+        cur = bench_regress.load_artifact(
+            [
+                _entry("a", 700.0, [690.0, 700.0, 710.0]),   # -30%
+                _entry("b", 1050.0, [1040.0, 1050.0, 1060.0]),  # +5%
+                _entry("c", 1400.0, [1390.0, 1400.0, 1410.0]),  # +40%
+            ]
+        )
+        rows = _rows_by_scenario(
+            bench_regress.compare(base, cur, threshold=0.10)
+        )
+        assert rows["a"]["verdict"] == "REGRESSION"
+        assert rows["b"]["verdict"] == "OK"
+        assert rows["c"]["verdict"] == "IMPROVED"
+
+    def test_noise_band_widens_with_pass_spread(self):
+        # a scenario whose own passes disagree by ±30% cannot flag a
+        # 20% delta as regression; a tight-passes scenario can
+        noisy_base = bench_regress.load_artifact(
+            [_entry("noisy", 1000.0, [700.0, 1000.0, 1300.0])]
+        )
+        noisy_cur = bench_regress.load_artifact(
+            [_entry("noisy", 800.0, [790.0, 800.0, 810.0])]
+        )
+        rows = bench_regress.compare(
+            noisy_base, noisy_cur, threshold=0.10
+        )
+        assert rows[0]["verdict"] == "OK"
+        assert rows[0]["band_pct"] > 10.0
+        tight_base = bench_regress.load_artifact(
+            [_entry("tight", 1000.0, [995.0, 1000.0, 1005.0])]
+        )
+        tight_cur = bench_regress.load_artifact(
+            [_entry("tight", 800.0, [795.0, 800.0, 805.0])]
+        )
+        rows = bench_regress.compare(
+            tight_base, tight_cur, threshold=0.10
+        )
+        assert rows[0]["verdict"] == "REGRESSION"
+
+    def test_new_missing_and_no_metric(self):
+        base = bench_regress.load_artifact(
+            [_entry("gone", 1000.0), {"scenario": "tableonly", "runs": []}]
+        )
+        cur = bench_regress.load_artifact(
+            [_entry("fresh", 1000.0), {"scenario": "tableonly", "runs": []}]
+        )
+        rows = _rows_by_scenario(bench_regress.compare(base, cur))
+        assert rows["gone"]["verdict"] == "MISSING"
+        assert rows["fresh"]["verdict"] == "NEW"
+        assert rows["tableonly"]["verdict"] == "NO_METRIC"
+
+    def test_metric_ladder_covers_suite_entry_shapes(self):
+        e = {"scenario": "s", "pipelined_pods_per_sec": 7644.8,
+             "pipelined_passes": [7531.7, 7644.8, 8091.3]}
+        m = bench_regress.extract_metric(e)
+        assert m["metric"] == "pipelined_pods_per_sec"
+        assert m["passes"] == [7531.7, 7644.8, 8091.3]
+        m = bench_regress.extract_metric(
+            {"scenario": "recovery", "takeover_speedup": 9.33}
+        )
+        assert m["metric"] == "takeover_speedup" and m["passes"] is None
+
+
+class TestArtifactShapes:
+    def test_round_file_and_headline_shapes(self):
+        round_doc = {
+            "n": 5,
+            "parsed": {
+                "metric": "sched_pods_per_sec_10k_nodes",
+                "value": 407363.6,
+                "passes": [407309.8, 407363.6, 407929.7],
+            },
+        }
+        art = bench_regress.load_artifact(round_doc)
+        assert "sched_pods_per_sec_10k_nodes" in art
+        headline = {"metric": "m", "value": 10.0, "passes": [9.0, 10.0]}
+        assert "m" in bench_regress.load_artifact(headline)
+        with pytest.raises(ValueError):
+            bench_regress.load_artifact({"nope": 1})
+
+
+class TestCommittedArtifacts:
+    def test_committed_round_pair_produces_verdict_table(self, capsys):
+        """Acceptance: the gate runs over the committed BENCH round pair
+        and the committed suite vs itself, emitting a verdict per
+        scenario and exit code 0 (no self-regression)."""
+        rc = bench_regress.main(
+            [
+                "--baseline", str(REPO / "BENCH_r04.json"),
+                "--current", str(REPO / "BENCH_r05.json"),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "sched_pods_per_sec_10k_nodes" in out and "OK" in out
+        rc = bench_regress.main(
+            [
+                "--baseline", str(REPO / "BENCH_SUITE.json"),
+                "--current", str(REPO / "BENCH_SUITE.json"),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        for scenario in (
+            "loadaware_10k_nodes",
+            "numa_binpack_2socket",
+            "device_gang_8gpu",
+            "quota_tree_3level",
+        ):
+            assert scenario in out
+
+    def test_synthetic_regression_exits_nonzero(self, tmp_path, capsys):
+        base = tmp_path / "base.json"
+        cur = tmp_path / "cur.json"
+        base.write_text(
+            json.dumps([_entry("s", 1000.0, [990.0, 1000.0, 1010.0])])
+        )
+        cur.write_text(
+            json.dumps([_entry("s", 500.0, [490.0, 500.0, 510.0])])
+        )
+        out_json = tmp_path / "rows.json"
+        rc = bench_regress.main(
+            [
+                "--baseline", str(base),
+                "--current", str(cur),
+                "--json", str(out_json),
+            ]
+        )
+        assert rc == 1
+        rows = json.loads(out_json.read_text())
+        assert rows[0]["verdict"] == "REGRESSION"
+        assert "regression(s)" in capsys.readouterr().err

@@ -1178,3 +1178,120 @@ def test_drained_preferred_reservation_does_not_shadow_feasible_one():
     assert got is not None and got.meta.name == "feas"
     out = sched.schedule([pod])
     assert [(p.meta.name, n) for p, n in out.bound] == [("x-0", "n1")]
+
+
+# ---------------------------------------------------------------------------
+# SLO-driven migration pressure (devprof PR satellite: first consumer of
+# the /slo layer — a burning shard tightens LowNodeLoad's high thresholds)
+# ---------------------------------------------------------------------------
+
+
+def _burning_slo(shard=0, n_bad=64):
+    from koordinator_tpu.obs.slo import SloTracker
+
+    class _Tick:
+        t = 0.0
+
+        def __call__(self):
+            self.t += 0.001
+            return self.t
+
+    slo = SloTracker(clock=_Tick())
+    for _ in range(n_bad):
+        slo.observe_latency(shard, 10.0)  # >> 1.0 s target: budget burns
+    return slo
+
+
+def _healthy_slo(shard=0, n=64):
+    from koordinator_tpu.obs.slo import SloTracker
+
+    class _Tick:
+        t = 0.0
+
+        def __call__(self):
+            self.t += 0.001
+            return self.t
+
+    slo = SloTracker(clock=_Tick())
+    for _ in range(n):
+        slo.observe_latency(shard, 0.01)
+    return slo
+
+
+def test_slo_pressure_flag_off_changes_nothing():
+    snap = make_cluster([55, 20])
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(anomaly_condition_count=1),  # flag defaults off
+        slo=_burning_slo(),
+        shard=0,
+    )
+    assert lnl.slo_pressure_factor() == 1.0
+    cls = lnl.classify()
+    assert not cls.raw_high[0]  # 55% < the 65% high threshold
+
+
+def test_burning_shard_raises_migration_pressure():
+    """A shard burning its latency error budget tightens the high
+    thresholds: a 55%-utilized node (under the 65% threshold when
+    healthy) becomes actionable, and victims flow to the low node."""
+    snap = make_cluster([55, 20])
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(anomaly_condition_count=1, slo_pressure=True),
+        slo=_burning_slo(shard=0),
+        shard=0,
+    )
+    factor = lnl.slo_pressure_factor()
+    assert factor > 1.0
+    cls = lnl.classify()
+    assert cls.raw_high[0] and cls.high[0]
+    assert cls.low[1]
+    victims = lnl.select_victims(
+        [bound_pod(f"v{i}", "n0", cpu=8000) for i in range(4)], cls
+    )
+    assert victims  # pressure actually produced migration work
+
+
+def test_healthy_shard_keeps_baseline_thresholds():
+    snap = make_cluster([55, 20])
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(anomaly_condition_count=1, slo_pressure=True),
+        slo=_healthy_slo(shard=0),
+        shard=0,
+    )
+    assert lnl.slo_pressure_factor() == 1.0
+    cls = lnl.classify()
+    assert not cls.raw_high[0]
+    assert not lnl.select_victims(
+        [bound_pod(f"v{i}", "n0", cpu=8000) for i in range(4)], cls
+    )
+
+
+def test_slo_pressure_is_capped():
+    snap = make_cluster([55, 20])
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(
+            anomaly_condition_count=1,
+            slo_pressure=True,
+            slo_pressure_cap=2.0,
+        ),
+        slo=_burning_slo(shard=0),
+        shard=0,
+    )
+    assert lnl.slo_pressure_factor() == 2.0
+
+
+def test_other_shards_burn_does_not_leak_pressure():
+    # the tracker burns on shard 3; this plugin rebalances shard 0
+    snap = make_cluster([55, 20])
+    lnl = LowNodeLoad(
+        snap,
+        LowNodeLoadArgs(anomaly_condition_count=1, slo_pressure=True),
+        slo=_burning_slo(shard=3),
+        shard=0,
+    )
+    assert lnl.slo_pressure_factor() == 1.0
+    assert not lnl.classify().raw_high[0]

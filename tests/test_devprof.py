@@ -1,0 +1,370 @@
+"""Solver observatory (devprof tentpole): compile/retrace ledger,
+on-demand device-timeline capture, device-memory census, leak sentinel,
+and the /debug/compiles + /debug/profile surfaces."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.obs.devprof import (
+    CompileLedger,
+    DevProf,
+    LeakSentinel,
+    donation_dead,
+)
+from koordinator_tpu.ops.solver import NodeState, PodBatch, SolverParams, assign
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+from koordinator_tpu.utils.metrics import Registry
+
+
+def _params(d=2):
+    return SolverParams(
+        usage_thresholds=jnp.zeros(d),
+        prod_thresholds=jnp.zeros(d),
+        score_weights=jnp.ones(d),
+    )
+
+
+def _nodes(n=8, d=2):
+    return NodeState.create(np.full((n, d), 100.0, np.float32))
+
+
+def _pods(p, d=2):
+    return PodBatch.create(
+        np.ones((p, d), np.float32), np.arange(p, dtype=np.int32)
+    )
+
+
+class TestCompileLedger:
+    def test_trace_hook_counts_only_cache_misses(self):
+        led = CompileLedger().install()
+        try:
+            # a NOVEL shape so the process-wide jit cache misses
+            r = assign(_pods(5), _nodes(7), _params())
+            np.asarray(r.assignment)
+            first = led.report()["functions"].get("assign", {}).get(
+                "traces", 0
+            )
+            assert first == 1
+            # warm call: cache hit, the hook body never runs
+            r = assign(_pods(5), _nodes(7), _params())
+            np.asarray(r.assignment)
+            assert (
+                led.report()["functions"]["assign"]["traces"] == first
+            )
+        finally:
+            led.uninstall()
+
+    def test_uninstalled_ledger_sees_nothing(self):
+        led = CompileLedger()
+        r = assign(_pods(3), _nodes(9), _params())
+        np.asarray(r.assignment)
+        assert led.total_traces() == 0
+
+    def test_watch_attributes_retrace_cause_and_compile_wall(self):
+        dp = DevProf(registry=Registry()).install()
+        try:
+            with dp.watch("assign", cycle=1, p=6, n=11) as w:
+                r = assign(_pods(6), _nodes(11), _params())
+                w.result(r.assignment)
+            with dp.watch("assign", cycle=2, p=12, n=11) as w:
+                r = assign(_pods(12), _nodes(11), _params())
+                w.result(r.assignment)
+            rep = dp.ledger.report()
+            row = rep["functions"]["assign"]
+            assert row["traces"] == 2 and row["calls"] == 2
+            assert row["signatures"] == 2
+            assert row["compile_seconds"] > 0
+            # the second trace's cause names the changed signature key
+            cause = rep["recent_causes"][-1]
+            assert cause["fn"] == "assign" and cause["cycle"] == 2
+            assert cause["delta"] == {"p": [6, 12]}
+            assert cause["wall_s"] > 0
+        finally:
+            dp.uninstall()
+
+    def test_steady_state_marking(self):
+        dp = DevProf().install()
+        try:
+            with dp.watch("assign", p=7, n=13) as w:
+                w.result(assign(_pods(7), _nodes(13), _params()).assignment)
+            dp.ledger.mark_steady()
+            assert dp.ledger.steady_retraces() == 0
+            # warm repeat: still retrace-free
+            with dp.watch("assign", p=7, n=13) as w:
+                w.result(assign(_pods(7), _nodes(13), _params()).assignment)
+            assert dp.ledger.steady_retraces() == 0
+            # a NEW shape after the mark is the violation the longrun
+            # assertion exists to catch
+            with dp.watch("assign", p=9, n=13) as w:
+                w.result(assign(_pods(9), _nodes(13), _params()).assignment)
+            assert dp.ledger.steady_retraces() == 1
+            assert any(
+                c.get("steady_state") for c in dp.ledger.steady_causes()
+            )
+        finally:
+            dp.uninstall()
+
+    def test_metrics_land_in_registry(self):
+        reg = Registry()
+        dp = DevProf(registry=reg).install()
+        try:
+            with dp.watch("assign", p=4, n=17) as w:
+                w.result(assign(_pods(4), _nodes(17), _params()).assignment)
+        finally:
+            dp.uninstall()
+        assert reg.get("solver_compiles_total").value(fn="assign") == 1.0
+        assert reg.get("solver_compile_seconds").value(fn="assign") > 0
+
+
+class TestDeviceMemoryCensus:
+    def test_table_bytes_and_live_totals(self):
+        dp = DevProf(registry=(reg := Registry()))
+        nodes = _nodes(16)
+        out = dp.census.sample({"nodes": nodes, "absent": None})
+        want = sum(
+            leaf.nbytes
+            for leaf in [
+                nodes.allocatable, nodes.requested, nodes.estimated_used,
+                nodes.prod_used, nodes.metric_fresh, nodes.schedulable,
+                nodes.cpu_amp, nodes.custom_thresholds,
+                nodes.custom_prod_thresholds,
+            ]
+        )
+        assert out == {"nodes": want}
+        assert reg.get("solver_device_bytes").value(table="nodes") == want
+        assert dp.census.last_live[0] > 0
+
+    def test_donation_effectiveness(self):
+        from koordinator_tpu.ops.solver import scatter_rows
+
+        def distinct(n):
+            # NodeState.create aliases one zeros buffer across fields,
+            # which donation rejects (same buffer donated twice) — build
+            # each field as its own array like the scheduler's lowering
+            return NodeState.create(
+                np.full((n, 2), 100.0, np.float32),
+                requested=np.zeros((n, 2), np.float32),
+                estimated_used=np.zeros((n, 2), np.float32),
+                prod_used=np.zeros((n, 2), np.float32),
+                custom_thresholds=np.zeros((n, 2), np.float32),
+                custom_prod_thresholds=np.zeros((n, 2), np.float32),
+            )
+
+        full = distinct(8)
+        rows = distinct(2)
+        idx = jnp.asarray([0, 1], jnp.int32)
+        census = DevProf().census
+        new = scatter_rows(full, idx, rows)
+        assert census.check_donation(full) is True  # donated input died
+        assert donation_dead(new) is False          # output is alive
+        assert census.donation_misses == 0
+
+    def test_leak_sentinel_flags_only_monotone_growth(self):
+        s = LeakSentinel(tolerance_bytes=100)
+        s.samples = [("a", 1, 1000), ("b", 2, 2000), ("c", 3, 3000)]
+        assert s.problems()
+        # non-monotone (a dip) is not a leak
+        s.samples = [("a", 1, 1000), ("b", 2, 500), ("c", 3, 3000)]
+        assert not s.problems()
+        # monotone but under tolerance is noise
+        s2 = LeakSentinel(tolerance_bytes=10_000)
+        s2.samples = [("a", 1, 1000), ("b", 2, 2000), ("c", 3, 3000)]
+        assert not s2.problems()
+        # too few samples: no verdict
+        s3 = LeakSentinel(tolerance_bytes=100)
+        s3.samples = [("a", 1, 1000), ("b", 2, 2000)]
+        assert not s3.problems()
+
+
+def _mini_sched(n_nodes=4, bucket=32):
+    s = BatchScheduler(batch_bucket=bucket)
+    s.extender.monitor.stop_background()
+    for i in range(n_nodes):
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000.0, ext.RES_MEMORY: 1e9}
+                ),
+            )
+        )
+    return s
+
+
+def _pod(name, cpu=1000.0):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 1e6}, priority=9500
+        ),
+    )
+
+
+class TestSchedulerIntegration:
+    def test_debug_compiles_and_profile_endpoints(self):
+        s = _mini_sched()
+        dp = DevProf(registry=s.extender.registry)
+        s.attach_devprof(dp)
+        try:
+            svc = s.extender.services
+            code, txt = svc.dispatch("GET", "/debug/profile?cycles=3")
+            assert code == 200
+            assert json.loads(txt)["cycles_remaining"] == 3
+            out = s.schedule([_pod("p0"), _pod("p1")])
+            assert len(out.bound) == 2
+            code, txt = svc.dispatch("GET", "/debug/compiles")
+            assert code == 200
+            rep = json.loads(txt)
+            # the watch records every CALL; whether it also traced
+            # depends on what the process-wide jit cache already holds
+            # (the full suite warms these shapes), so assert on calls
+            assert rep["functions"]["assign"]["calls"] >= 1
+            code, txt = svc.dispatch("GET", "/debug/profile")
+            doc = json.loads(txt)
+            assert doc["status"]["device_events"] > 0
+            assert doc["breakdown_ms"]["device_compute_ms"] > 0
+            assert doc["census"]["tables_bytes"]["nodes"] > 0
+            code, _ = svc.dispatch("GET", "/debug/profile?cycles=bogus")
+            assert code == 400
+        finally:
+            dp.uninstall()
+
+    def test_device_lane_merges_into_chrome_trace(self):
+        s = _mini_sched()
+        dp = DevProf()
+        s.attach_devprof(dp)
+        try:
+            s.extender.tracer.enabled = True
+            dp.capture(2)
+            s.schedule([_pod("q0")])
+            code, txt = s.extender.services.dispatch("GET", "/trace")
+            assert code == 200
+            doc = json.loads(txt)
+            lanes = {
+                e["args"]["name"]
+                for e in doc["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "thread_name"
+            }
+            assert "device" in lanes
+            dev = [
+                e for e in doc["traceEvents"] if e.get("cat") == "device"
+            ]
+            assert dev and all(
+                e["args"]["cycle"] >= 1 and e["ts"] >= 0 for e in dev
+            )
+            # device ops align under host spans: the solve-stage device
+            # op must fall inside the traced cycle's wall window
+            cycles = [
+                e
+                for e in doc["traceEvents"]
+                if e.get("ph") == "X" and e["name"] == "cycle"
+            ]
+            assert cycles
+            lo = min(c["ts"] for c in cycles)
+            hi = max(c["ts"] + c["dur"] for c in cycles)
+            solve_ops = [
+                e for e in dev if e["args"]["stage"] == "solve"
+            ]
+            assert solve_ops
+            assert all(
+                lo - 1000 <= e["ts"] <= hi + 1000 for e in solve_ops
+            )
+        finally:
+            dp.uninstall()
+
+    def test_capture_window_expires(self):
+        s = _mini_sched()
+        dp = DevProf()
+        s.attach_devprof(dp)
+        try:
+            dp.capture(1)
+            s.schedule([_pod("w0")])
+            n_after_first = len(dp.device_events)
+            assert n_after_first > 0
+            s.schedule([_pod("w1")])
+            assert len(dp.device_events) == n_after_first
+            assert dp.status()["capturing"] is False
+        finally:
+            dp.uninstall()
+
+    def test_scatter_refresh_records_transfer_and_donation(self):
+        s = _mini_sched(n_nodes=12)
+        dp = DevProf()
+        s.attach_devprof(dp)
+        try:
+            s.schedule([_pod("a0")])  # builds the resident state
+            dp.capture(4)
+            s.snapshot.touch_rows([1, 2])
+            s.schedule([_pod("a1")])
+            kinds = {ev["kind"] for ev in dp.device_events}
+            assert "transfer" in kinds
+            assert dp.census.donation_checks >= 1
+            assert dp.census.donation_misses == 0
+        finally:
+            dp.uninstall()
+
+
+class TestFleetForwarding:
+    def test_fleet_services_forward_debug_compiles(self):
+        """FleetServices forwards /debug/compiles and /debug/profile to
+        every owned shard's engine (same shape as /debug/pipeline)."""
+
+        class _Svc:
+            def dispatch(self, method, path, body=""):
+                return 200, json.dumps({"functions": {}, "path": path})
+
+        class _Ext:
+            services = _Svc()
+
+        class _Sched:
+            extender = _Ext()
+
+        class _Rt:
+            sched = _Sched()
+
+        class _Sharded:
+            name = "inc0"
+            _runtimes = {0: _Rt(), 2: _Rt()}
+            lifecycle = None
+
+        from koordinator_tpu.obs.fleet import FleetServices
+
+        fs = FleetServices(_Sharded())
+        code, txt = fs.dispatch("GET", "/debug/compiles")
+        assert code == 200
+        doc = json.loads(txt)
+        assert set(doc["shards"]) == {"0", "2"}
+        code, txt = fs.dispatch("GET", "/debug/profile?cycles=2")
+        assert code == 200
+        assert json.loads(txt)["shards"]["0"]["path"] == (
+            "/debug/profile?cycles=2"
+        )
+
+
+class TestNullWatchIdiom:
+    def test_null_watch_is_shared_and_inert(self):
+        from koordinator_tpu.obs.devprof import NULL_WATCH
+
+        with NULL_WATCH as w:
+            w.result(object())
+        with NULL_WATCH:
+            pass
+
+    def test_disabled_scheduler_has_no_observatory_cost(self):
+        s = _mini_sched()
+        assert s.devprof is None
+        out = s.schedule([_pod("z0")])
+        assert len(out.bound) == 1
+        # no observatory: none of its metric families exist
+        text = s.extender.services.dispatch("GET", "/metrics")[1]
+        assert "solver_compiles_total" not in text
+        assert "solver_device_bytes" not in text
+        code, _ = s.extender.services.dispatch("GET", "/debug/compiles")
+        assert code == 404
+        code, _ = s.extender.services.dispatch("GET", "/debug/profile")
+        assert code == 404

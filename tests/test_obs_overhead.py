@@ -138,3 +138,81 @@ class TestBenchTraceRoundTrip:
         out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert "trace_file" not in out
         assert not (tmp_path / "bench_trace.json").exists()
+
+
+class TestDevprofDisabledMode:
+    """Devprof PR (PR 7 standing rule): disabled-mode solver-observatory
+    instrumentation is ONE attribute-is-None check per hot-path site,
+    and the trace-time hooks cost nothing once compiled."""
+
+    def test_tracing_hook_is_free_without_a_ledger(self):
+        from koordinator_tpu.obs import devprof
+
+        assert not devprof._LEDGERS  # no test leaked an install
+        n = 100_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            devprof.tracing("hot")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"{n} uninstalled hooks took {elapsed:.2f}s"
+
+    def test_null_watch_is_shared_singleton(self):
+        from koordinator_tpu.obs.devprof import NULL_WATCH, _NullWatch
+
+        assert isinstance(NULL_WATCH, _NullWatch)
+        with NULL_WATCH as w:
+            w.result(None)  # arg sink is a no-op
+
+    def test_hot_path_sites_guard_on_attribute_is_none(self):
+        """Every batch-solver hot-path site reads ``self.devprof`` into
+        a local and branches on ``is not None`` — the same one-check
+        discipline the tracer/lifecycle sites follow. No other hot-path
+        spelling is allowed to creep in."""
+        import inspect
+
+        from koordinator_tpu.scheduler import batch_solver
+
+        src = inspect.getsource(batch_solver)
+        reads = src.count("dp = self.devprof")
+        guards = src.count("if dp is not None")
+        # every read is paired with at least one is-None guard; the
+        # cycle shell guards twice (begin + end) on one read
+        assert reads >= 6
+        assert guards >= reads
+
+    def test_scheduler_without_observatory_emits_nothing(self):
+        from koordinator_tpu.api import extension as ext
+        from koordinator_tpu.api.types import (
+            Node,
+            NodeStatus,
+            ObjectMeta,
+            Pod,
+            PodSpec,
+        )
+        from koordinator_tpu.obs import devprof
+        from koordinator_tpu.scheduler.batch_solver import BatchScheduler
+
+        s = BatchScheduler()
+        s.extender.monitor.stop_background()
+        assert s.devprof is None
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name="n0"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000.0, ext.RES_MEMORY: 1e9}
+                ),
+            )
+        )
+        pod = Pod(
+            meta=ObjectMeta(name="p", uid="p"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 1000.0, ext.RES_MEMORY: 1e6},
+                priority=9500,
+            ),
+        )
+        out = s.schedule([pod])
+        assert len(out.bound) == 1
+        assert not devprof._LEDGERS
+        text = s.extender.services.dispatch("GET", "/metrics")[1]
+        assert "solver_compiles_total" not in text
+        assert "solver_device_bytes" not in text
