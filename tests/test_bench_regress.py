@@ -166,6 +166,28 @@ class TestCommittedArtifacts:
             ]
         )
         assert rc == 1
-        rows = json.loads(out_json.read_text())
-        assert rows[0]["verdict"] == "REGRESSION"
+        artifact = json.loads(out_json.read_text())
+        assert artifact["rows"][0]["verdict"] == "REGRESSION"
+        assert artifact["counts"]["REGRESSION"] == 1
+        assert artifact["regressions"] == ["s"] and artifact["exit"] == 1
         assert "regression(s)" in capsys.readouterr().err
+
+    def test_json_to_stdout_is_one_artifact(self, tmp_path, capsys):
+        """--json - replaces the text table with the machine artifact:
+        CI and the verdict table consume ONE comparison."""
+        base = tmp_path / "base.json"
+        cur = tmp_path / "cur.json"
+        base.write_text(
+            json.dumps([_entry("s", 1000.0, [990.0, 1000.0, 1010.0])])
+        )
+        cur.write_text(
+            json.dumps([_entry("s", 1005.0, [995.0, 1005.0, 1015.0])])
+        )
+        rc = bench_regress.main(
+            ["--baseline", str(base), "--current", str(cur), "--json", "-"]
+        )
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["rows"][0]["verdict"] == "OK"
+        assert set(doc["counts"]) == set(bench_regress.VERDICTS)
+        assert doc["exit"] == 0

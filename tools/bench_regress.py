@@ -55,6 +55,14 @@ _METRIC_LADDER: Tuple[Tuple[str, Optional[str], bool], ...] = (
 #: measurement notes record ±30-50% host noise on contended windows)
 DEFAULT_THRESHOLD = 0.10
 
+#: the verdict vocabulary — the --json artifact's contract with CI.
+#: Every verdict the comparison emits MUST come from this set and every
+#: member must be reachable (enforced by the koordlint ``bench-verdicts``
+#: pass against this module's AST).
+VERDICTS = (
+    "OK", "REGRESSION", "IMPROVED", "NEW", "MISSING", "NO_METRIC",
+)
+
 
 def extract_metric(entry: dict) -> Optional[dict]:
     """Pull the comparable number out of one scenario entry, or None."""
@@ -208,7 +216,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument(
         "--json", default="", metavar="PATH",
-        help="also write the verdict rows as JSON",
+        help="also emit the verdict table as one machine-readable "
+        "artifact ('-' = stdout instead of the text table): rows + "
+        "per-verdict counts + exit code, so CI and the human table "
+        "consume the same comparison",
     )
     args = ap.parse_args(argv)
     with open(args.baseline) as f:
@@ -219,11 +230,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline, current,
         threshold=args.threshold, noise_mult=args.noise_mult,
     )
-    print(render_table(rows))
-    if args.json:
-        with open(args.json, "w") as f:
-            json.dump(rows, f, indent=1)
     regressions = [r for r in rows if r["verdict"] == "REGRESSION"]
+    if args.json:
+        counts = {v: 0 for v in VERDICTS}
+        for r in rows:
+            counts[r["verdict"]] += 1
+        artifact = {
+            "baseline": args.baseline,
+            "current": args.current,
+            "threshold": args.threshold,
+            "noise_mult": args.noise_mult,
+            "rows": rows,
+            "counts": counts,
+            "regressions": [r["scenario"] for r in regressions],
+            "exit": 1 if regressions else 0,
+        }
+        doc = json.dumps(artifact, indent=1, sort_keys=True)
+        if args.json == "-":
+            print(doc)
+        else:
+            with open(args.json, "w") as f:
+                f.write(doc + "\n")
+    if args.json != "-":
+        print(render_table(rows))
     if regressions:
         print(
             f"\n{len(regressions)} regression(s): "

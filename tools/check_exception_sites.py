@@ -1,138 +1,28 @@
 #!/usr/bin/env python3
-"""Repo lint: every broad ``except Exception`` must be *accounted*.
-
-The robustness PR established the invariant that no exception is
-swallowed silently: every degrade-don't-crash ``except Exception`` site
-routes through ``obs.errors.report_exception`` (directly or via a
-reporting helper like ``_note_solver_failure``) or re-raises. Until now
-that invariant was enforced only by review; this lint makes it a tier-1
-test (``tests/test_exception_sites_lint.py``) and a standalone command:
+"""Thin shim: the exception-accounting lint now lives in the koordlint
+framework (``tools/koordlint/passes/exception_sites.py``, pass
+``exception-sites``). This entry point keeps existing invocations and
+imports working with bit-identical verdicts:
 
     python tools/check_exception_sites.py [paths...]
-
-A handler passes when its body (including nested statements) contains
-at least one of:
-
-* a call whose name is ``report_exception``;
-* a call to a known reporting helper (``REPORTING_HELPERS``) that
-  itself calls ``report_exception``;
-* a ``raise`` statement (the exception is not swallowed).
-
-Narrow handlers (``except ValueError``, ``except (OSError, KeyError)``)
-are out of scope — the lint targets the catch-everything form that can
-hide real failures.
+    python -m tools.koordlint --select exception-sites
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import Iterable, List, Tuple
 
-#: helpers whose bodies call report_exception — a handler calling one of
-#: these is accounted (keep in sync when adding new reporting funnels)
-REPORTING_HELPERS = frozenset({"_note_solver_failure"})
+if __package__ in (None, ""):  # script invocation
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: the module that DEFINES the discipline (scanning it would be circular)
-EXEMPT_FILES = frozenset({"obs/errors.py"})
-
-Violation = Tuple[str, int, str]
-
-
-def _names_in_type(node) -> Iterable[str]:
-    """Exception-class names mentioned in an ``except`` clause type."""
-    if node is None:
-        # bare ``except:`` — broader than ``except Exception``
-        yield "Exception"
-        return
-    stack = [node]
-    while stack:
-        n = stack.pop()
-        if isinstance(n, ast.Name):
-            yield n.id
-        elif isinstance(n, ast.Attribute):
-            yield n.attr
-        elif isinstance(n, ast.Tuple):
-            stack.extend(n.elts)
-
-
-def _call_name(call: ast.Call) -> str:
-    f = call.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return ""
-
-
-def _handler_accounted(handler: ast.ExceptHandler) -> bool:
-    for stmt in handler.body:
-        for node in ast.walk(stmt):
-            if isinstance(node, ast.Raise):
-                return True
-            if isinstance(node, ast.Call):
-                name = _call_name(node)
-                if name == "report_exception" or name in REPORTING_HELPERS:
-                    return True
-    return False
-
-
-def check_file(path: Path, root: Path) -> List[Violation]:
-    rel = path.relative_to(root).as_posix()
-    try:
-        tree = ast.parse(path.read_text(encoding="utf-8"))
-    except SyntaxError as exc:  # a broken file is its own violation
-        return [(rel, exc.lineno or 0, f"unparsable: {exc.msg}")]
-    out: List[Violation] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if "Exception" not in set(_names_in_type(node.type)):
-            continue
-        if not _handler_accounted(node):
-            out.append(
-                (
-                    rel,
-                    node.lineno,
-                    "broad `except Exception` neither calls "
-                    "report_exception (or a reporting helper) nor "
-                    "re-raises",
-                )
-            )
-    return out
-
-
-def check_paths(paths: Iterable[Path], root: Path) -> List[Violation]:
-    violations: List[Violation] = []
-    for p in paths:
-        for f in sorted(p.rglob("*.py")) if p.is_dir() else [p]:
-            if f.relative_to(root).as_posix() in (
-                f"koordinator_tpu/{e}" for e in EXEMPT_FILES
-            ):
-                continue
-            violations.extend(check_file(f, root))
-    return violations
-
-
-def main(argv: List[str]) -> int:
-    root = Path(__file__).resolve().parent.parent
-    targets = (
-        [Path(a).resolve() for a in argv]
-        if argv
-        else [root / "koordinator_tpu"]
-    )
-    violations = check_paths(targets, root)
-    for rel, line, msg in violations:
-        print(f"{rel}:{line}: {msg}", file=sys.stderr)
-    if violations:
-        print(
-            f"{len(violations)} unaccounted `except Exception` site(s)",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
-
+from tools.koordlint.passes.exception_sites import (  # noqa: E402,F401
+    EXEMPT_FILES,
+    REPORTING_HELPERS,
+    check_file,
+    check_paths,
+    main,
+)
 
 if __name__ == "__main__":
     raise SystemExit(main(sys.argv[1:]))

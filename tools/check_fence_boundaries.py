@@ -1,164 +1,29 @@
 #!/usr/bin/env python3
-"""Repo lint: every bind-journal write boundary flows through an epoch
-check (PR 6 satellite).
+"""Thin shim: the fence-before-journal lint now lives in the koordlint
+framework (``tools/koordlint/passes/fence_boundaries.py``, pass
+``fence-boundaries``). This entry point keeps existing invocations and
+imports working with bit-identical verdicts:
 
-The HA work (PRs 5–6) established the fencing discipline: a deposed
-leader must be REFUSED at every boundary it could cross, and the
-write-ahead journal append is the last one before a mutation becomes
-durable. This lint makes the discipline mechanical: any function in
-``koordinator_tpu/`` that appends an ``intent``/``bind``/``abort``
-record (``append_intent``/``append_bind``/``append_abort``) must, in
-the SAME function body, evaluate an epoch check — one of:
-
-* a call to ``_fence_stale`` (the commit boundary's check helper);
-* a ``.check(...)`` call on something named ``fence`` (the
-  ``EpochFence.check`` form the fast path and channel client use).
-
-``append_forget`` is deliberately OUT of scope: forgets mirror
-apiserver-authoritative deletions, which standbys (and the sharded
-soak's driver, on ownerless shards) journal fence-EXEMPT by design.
-``core/journal.py`` itself is exempt — it IS the fencing authority (its
-``_append`` refuses stale epochs at the storage boundary, the backstop
-when every in-process check was bypassed), and :class:`ClaimTable`
-fences claims the same way.
-
-Usage:  python tools/check_fence_boundaries.py [paths...]
-Enforced as a tier-1 test by ``tests/test_fence_boundaries_lint.py``.
+    python tools/check_fence_boundaries.py [paths...]
+    python -m tools.koordlint --select fence-boundaries
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import Iterable, List, Tuple
 
-#: journal write ops that MUST be epoch-checked in the enclosing function
-GUARDED_APPENDS = frozenset(
-    {"append_intent", "append_bind", "append_abort"}
+if __package__ in (None, ""):  # script invocation
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.koordlint.passes.fence_boundaries import (  # noqa: E402,F401
+    EXEMPT_FILES,
+    FENCE_CHECK_HELPERS,
+    GUARDED_APPENDS,
+    check_file,
+    check_paths,
+    main,
 )
-
-#: calls that count as an epoch check
-FENCE_CHECK_HELPERS = frozenset({"_fence_stale"})
-
-#: files exempt from the scan (relative to koordinator_tpu/)
-EXEMPT_FILES = frozenset({"core/journal.py"})
-
-Violation = Tuple[str, int, str]
-
-
-def _call_attr(call: ast.Call) -> str:
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return ""
-
-
-def _is_fence_check(call: ast.Call) -> bool:
-    name = _call_attr(call)
-    if name in FENCE_CHECK_HELPERS:
-        return True
-    if name != "check":
-        return False
-    # ``<something>.check(...)`` counts only when the receiver path
-    # mentions a fence (``self.fence.check``, ``fence.check``,
-    # ``fabric.fences[s].check``) — a stray ``x.check()`` does not.
-    node = call.func.value if isinstance(call.func, ast.Attribute) else None
-    while node is not None:
-        if isinstance(node, ast.Attribute):
-            if "fence" in node.attr.lower():
-                return True
-            node = node.value
-        elif isinstance(node, ast.Subscript):
-            node = node.value
-        elif isinstance(node, ast.Name):
-            return "fence" in node.id.lower()
-        else:
-            return False
-    return False
-
-
-def _rel(path: Path, root: Path) -> str:
-    try:
-        return path.relative_to(root).as_posix()
-    except ValueError:  # target outside the repo (ad-hoc invocation)
-        return path.as_posix()
-
-
-def check_file(path: Path, root: Path) -> List[Violation]:
-    rel = _rel(path, root)
-    try:
-        tree = ast.parse(path.read_text(encoding="utf-8"))
-    except SyntaxError as exc:
-        return [(rel, exc.lineno or 0, f"unparsable: {exc.msg}")]
-    out: List[Violation] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        appends: List[ast.Call] = []
-        checked = False
-        # scan this function's body EXCLUDING nested function defs —
-        # a check inside a nested closure does not guard this frame's
-        # appends (and vice versa); nested defs are walked on their own
-        stack = list(node.body)
-        while stack:
-            stmt = stack.pop()
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            for sub in ast.iter_child_nodes(stmt):
-                stack.append(sub)
-            if isinstance(stmt, ast.Call):
-                if _call_attr(stmt) in GUARDED_APPENDS:
-                    appends.append(stmt)
-                elif _is_fence_check(stmt):
-                    checked = True
-        if appends and not checked:
-            for call in appends:
-                out.append(
-                    (
-                        rel,
-                        call.lineno,
-                        f"journal {_call_attr(call)} without an epoch "
-                        "check in the enclosing function "
-                        f"({node.name}) — fence before journal",
-                    )
-                )
-    return out
-
-
-def check_paths(paths: Iterable[Path], root: Path) -> List[Violation]:
-    violations: List[Violation] = []
-    for p in paths:
-        for f in sorted(p.rglob("*.py")) if p.is_dir() else [p]:
-            if _rel(f, root) in (
-                f"koordinator_tpu/{e}" for e in EXEMPT_FILES
-            ):
-                continue
-            violations.extend(check_file(f, root))
-    return violations
-
-
-def main(argv: List[str]) -> int:
-    root = Path(__file__).resolve().parent.parent
-    targets = (
-        [Path(a).resolve() for a in argv]
-        if argv
-        else [root / "koordinator_tpu"]
-    )
-    violations = check_paths(targets, root)
-    for rel, line, msg in violations:
-        print(f"{rel}:{line}: {msg}", file=sys.stderr)
-    if violations:
-        print(
-            f"{len(violations)} unfenced journal write boundar"
-            f"{'y' if len(violations) == 1 else 'ies'}",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
-
 
 if __name__ == "__main__":
     raise SystemExit(main(sys.argv[1:]))

@@ -1,236 +1,32 @@
 #!/usr/bin/env python3
-"""Repo lint: the rejection taxonomy stays fully attributed
-(distributed-observability PR satellite).
+"""Thin shim: the rejection-taxonomy lint now lives in the koordlint
+framework (``tools/koordlint/passes/reject_reasons.py``, pass
+``reject-reasons``). This entry point keeps existing invocations and
+imports working with bit-identical verdicts:
 
-``RejectReason`` is the vocabulary the whole attribution story hangs on:
-``rejections_total{stage,plugin,reason}``, ``/debug/rejections``, the
-flight recorder's per-cycle summaries and the SLO layer's outcome
-accounting all assume every member is REACHABLE — some code path
-actually attributes it. The host-side mask replay
-(``BatchScheduler._classify_solver_reject``) is the default attributor:
-it re-runs the solver's mask stages for a rejected pod and names the
-first stage that zeroed its row. A member it does not cover must be
-attributed at a DEDICATED site (fencing, journal, deadline, commit
-revalidation, …) and carry an explicit exemption HERE, with the site —
-so adding an enum member without wiring its attribution fails tier-1
-instead of silently minting a reason no record can ever carry.
-
-The lint enforces, mirroring ``check_exception_sites`` /
-``check_fence_boundaries``:
-
-* every ``RejectReason`` member is either referenced inside
-  ``_classify_solver_reject`` or listed in :data:`EXEMPT` with its
-  dedicated attribution site;
-* no member is BOTH (an exemption for a covered member is stale);
-* every exempt member really IS referenced somewhere in
-  ``koordinator_tpu/`` outside the enum definition (the dedicated site
-  exists), and every exemption names a member that still exists.
-
-Usage:  python tools/check_reject_reasons.py
-Enforced as a tier-1 test by ``tests/test_reject_reasons_lint.py``.
+    python tools/check_reject_reasons.py [root]
+    python -m tools.koordlint --select reject-reasons
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import Dict, List, Optional, Set, Tuple
 
-#: members attributed at a dedicated site instead of the solver-reject
-#: mask replay — member name -> where (and why) it is attributed
-EXEMPT: Dict[str, str] = {
-    "POD_TRANSFORMER_DROPPED": (
-        "gate stage: frameworkext pod-transformer drop, before any "
-        "solve runs"
-    ),
-    "GANG_NOT_READY": (
-        "gate stage: coscheduling holds the gang back pre-batch"
-    ),
-    "RESERVATION_UNAVAILABLE": (
-        "reserve stage: reservation fast-path match refusal"
-    ),
-    "NODE_CAPACITY_REVALIDATION": (
-        "commit stage: Reserve's host-side capacity recheck of a "
-        "solver winner"
-    ),
-    "NUMA_ALLOCATION_FAILED": (
-        "commit stage: NUMAManager zone allocation refusal"
-    ),
-    "DEVICE_ALLOCATION_FAILED": (
-        "commit stage: DeviceManager slot allocation refusal"
-    ),
-    "NODE_VANISHED": (
-        "commit stage: winner's node deleted between solve and Reserve"
-    ),
-    "NUMERIC_INVALID": (
-        "pre-solve quarantine: non-finite req/est rows never reach the "
-        "mask stages the replay re-runs"
-    ),
-    "SOLVE_RESULT_STALLED": (
-        "solve stage: bounded result fetch timed out — a feeder stall, "
-        "not a mask verdict"
-    ),
-    "CYCLE_DEADLINE_EXCEEDED": (
-        "cycle deadline: deferred chunks were never solved, so there "
-        "is no mask outcome to replay"
-    ),
-    "COMMIT_ROLLED_BACK": (
-        "commit stage: mid-commit crash unwound the chunk's Reserve "
-        "journal"
-    ),
-    "STALE_LEADER_EPOCH": (
-        "fence boundary: a deposed leader's commit refused by epoch "
-        "check, independent of solver feasibility"
-    ),
-    "JOURNAL_WRITE_FAILED": (
-        "journal boundary: intent/bind append refused — "
-        "journal-before-mutate rejects the chunk un-mutated"
-    ),
-}
+if __package__ in (None, ""):  # script invocation
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: where the enum and the classifier live
-ENUM_FILE = "koordinator_tpu/obs/rejections.py"
-CLASSIFIER_FILE = "koordinator_tpu/scheduler/batch_solver.py"
-CLASSIFIER_FUNC = "_classify_solver_reject"
-
-Violation = Tuple[str, int, str]
-
-
-def enum_members(root: Path) -> Dict[str, int]:
-    """``RejectReason`` member name -> definition line."""
-    tree = ast.parse((root / ENUM_FILE).read_text(encoding="utf-8"))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "RejectReason":
-            out: Dict[str, int] = {}
-            for stmt in node.body:
-                if (
-                    isinstance(stmt, ast.Assign)
-                    and len(stmt.targets) == 1
-                    and isinstance(stmt.targets[0], ast.Name)
-                ):
-                    out[stmt.targets[0].id] = stmt.lineno
-            return out
-    raise AssertionError(f"RejectReason class not found in {ENUM_FILE}")
-
-
-def _reason_refs(tree: ast.AST) -> Set[str]:
-    """Every ``RejectReason.X`` attribute access under ``tree``."""
-    return {
-        n.attr
-        for n in ast.walk(tree)
-        if isinstance(n, ast.Attribute)
-        and isinstance(n.value, ast.Name)
-        and n.value.id == "RejectReason"
-    }
-
-
-def classifier_coverage(root: Path) -> Set[str]:
-    """Members referenced inside ``_classify_solver_reject``."""
-    tree = ast.parse(
-        (root / CLASSIFIER_FILE).read_text(encoding="utf-8")
-    )
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.FunctionDef)
-            and node.name == CLASSIFIER_FUNC
-        ):
-            return _reason_refs(node)
-    raise AssertionError(
-        f"{CLASSIFIER_FUNC} not found in {CLASSIFIER_FILE}"
-    )
-
-
-def repo_refs(root: Path) -> Set[str]:
-    """Members referenced anywhere in koordinator_tpu/ OUTSIDE the enum
-    definition file (attribution sites)."""
-    refs: Set[str] = set()
-    for f in sorted((root / "koordinator_tpu").rglob("*.py")):
-        if f == root / ENUM_FILE:
-            continue
-        try:
-            refs |= _reason_refs(
-                ast.parse(f.read_text(encoding="utf-8"))
-            )
-        except SyntaxError:
-            pass  # unparsable files are another lint's problem
-    return refs
-
-
-def check(
-    root: Path, exempt_table: Optional[Dict[str, str]] = None
-) -> List[Violation]:
-    """``exempt_table`` overrides :data:`EXEMPT` (the lint's own tests
-    scan synthetic repos whose enums the real table does not match)."""
-    exemptions = EXEMPT if exempt_table is None else exempt_table
-    members = enum_members(root)
-    covered = classifier_coverage(root)
-    referenced = repo_refs(root)
-    out: List[Violation] = []
-    for name, line in sorted(members.items()):
-        in_classifier = name in covered
-        exempt = name in exemptions
-        if not in_classifier and not exempt:
-            out.append(
-                (
-                    ENUM_FILE,
-                    line,
-                    f"RejectReason.{name} has no "
-                    f"{CLASSIFIER_FUNC} arm and no exemption in "
-                    "tools/check_reject_reasons.py — wire its "
-                    "attribution or document its dedicated site",
-                )
-            )
-        elif in_classifier and exempt:
-            out.append(
-                (
-                    ENUM_FILE,
-                    line,
-                    f"RejectReason.{name} is covered by "
-                    f"{CLASSIFIER_FUNC} but still exempted — remove "
-                    "the stale exemption",
-                )
-            )
-        elif exempt and name not in referenced:
-            out.append(
-                (
-                    ENUM_FILE,
-                    line,
-                    f"RejectReason.{name} is exempted as attributed "
-                    "at a dedicated site, but nothing in "
-                    "koordinator_tpu/ references it — the site is "
-                    "gone (or never existed)",
-                )
-            )
-    for name in sorted(set(exemptions) - set(members)):
-        out.append(
-            (
-                "tools/check_reject_reasons.py",
-                0,
-                f"exemption for unknown member RejectReason.{name}",
-            )
-        )
-    return out
-
-
-def main(argv: List[str]) -> int:
-    root = (
-        Path(argv[0]).resolve()
-        if argv
-        else Path(__file__).resolve().parent.parent
-    )
-    violations = check(root)
-    for rel, line, msg in violations:
-        print(f"{rel}:{line}: {msg}", file=sys.stderr)
-    if violations:
-        print(
-            f"{len(violations)} unattributed / stale reject reason"
-            f"{'' if len(violations) == 1 else 's'}",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
-
+from tools.koordlint.passes.reject_reasons import (  # noqa: E402,F401
+    CLASSIFIER_FILE,
+    CLASSIFIER_FUNC,
+    ENUM_FILE,
+    EXEMPT,
+    check,
+    classifier_coverage,
+    enum_members,
+    main,
+    repo_refs,
+)
 
 if __name__ == "__main__":
     raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,461 @@
+"""koordlint: the repo's unified static-analysis framework.
+
+One pass registry, one shared AST walk, one suppression syntax, one CLI —
+replacing the three disconnected single-file lints (``check_exception_sites``,
+``check_fence_boundaries``, ``check_reject_reasons``, kept as thin shims)
+and adding the passes the standing rules demanded but review had to carry:
+
+* ``retrace-hazard`` — jitted solver entry points must carry the
+  ``_devprof.tracing`` trace-time hook, host dispatches must sit under a
+  signature-carrying ``dp.watch(...)``, watch signatures must be bucketed,
+  and jitted bodies must not branch/``int()``/``.item()``/iterate on
+  traced parameters;
+* ``donation-safety`` — a ``donate_argnums`` argument is DEAD after the
+  call: never re-read in the caller, never a stored ``self.`` attribute;
+* ``guarded-by`` — ``# guarded-by: self._lock`` annotations on shared
+  mutable attributes; annotated writes outside a ``with`` on the named
+  lock are flagged;
+* ``chaos-coverage`` — every named chaos point has a soak fault-schedule
+  arm (or a validated dedicated-test exemption), and vice versa;
+* ``bench-verdicts`` — ``tools/bench_regress.py``'s emitted verdict
+  strings stay inside its declared ``VERDICTS`` vocabulary.
+
+Suppression syntax (trailing comment on the finding's line)::
+
+    expr  # koordlint: disable=donation-safety        -- one line, one pass
+    # koordlint: disable-file=retrace-hazard          -- whole file
+    def f(self):  # koordlint: holds=self._lock       -- caller holds lock
+
+Unused suppressions are themselves findings: a ``disable`` that stopped
+matching anything is stale and must be deleted.
+
+Usage::
+
+    python -m tools.koordlint [--select p1,p2] [--ignore p1] [--json [-|PATH]]
+
+Exit 0 iff the tree carries zero unsuppressed findings. Enforced tier-1
+by ``tests/test_koordlint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: python package every pass walks by default
+PACKAGE = "koordinator_tpu"
+
+#: comment grammar: disable / disable-file take comma-separated pass
+#: names; holds takes a lock expression (guarded-by's caller-holds form)
+_SUPPRESS_RE = re.compile(
+    r"#\s*koordlint:\s*(disable-file|disable|holds)\s*=\s*([\w.,\-]+)"
+)
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([\w.]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One verdict. ``code`` is the stable finding ID cited in commit
+    messages and consumed by CI (e.g. ``RH003``)."""
+
+    pass_name: str
+    code: str
+    file: str     # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.code} {self.message} [{self.pass_name}]"
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One parsed module: text, lines, AST (lazily), suppressions.
+
+    ``suppression_scope`` is False for files loaded as DATA for a pass
+    (tests/ for chaos-exemption validation): their comment lines are
+    not koordlint suppressions and never count as unused/unknown."""
+
+    def __init__(self, path: Path, rel: str, suppression_scope: bool = True):
+        self.path = path
+        self.rel = rel
+        self.suppression_scope = suppression_scope
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self._parse_error: Optional[SyntaxError] = None
+        self._parsed = False
+        # line -> set of pass names disabled on that line
+        self.disabled_lines: Dict[int, Set[str]] = {}
+        #: pass names disabled for the whole file
+        self.disabled_file: Set[str] = set()
+        #: line -> lock expr the enclosing def's caller already holds
+        self.holds: Dict[int, str] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            kind, value = m.group(1), m.group(2)
+            if kind == "holds":
+                self.holds[i] = value
+            else:
+                names = {v.strip() for v in value.split(",") if v.strip()}
+                if kind == "disable-file":
+                    self.disabled_file |= names
+                else:
+                    self.disabled_lines.setdefault(i, set()).update(names)
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as exc:
+                self._parse_error = exc
+        return self._tree
+
+    @property
+    def parse_error(self) -> Optional[SyntaxError]:
+        self.tree  # noqa: B018 — force the parse
+        return self._parse_error
+
+    def guarded_by_on_line(self, line: int) -> Optional[str]:
+        if 1 <= line <= len(self.lines):
+            m = _GUARDED_BY_RE.search(self.lines[line - 1])
+            if m:
+                return m.group(1)
+        return None
+
+
+def want_file(path: Path) -> bool:
+    """The shared walk filter: generated protobuf modules and bytecode
+    caches are OUT of every lint's scope (a ``*_pb2.py`` tripping an AST
+    lint was the failure mode this centralizes away)."""
+    if path.suffix != ".py":
+        return False
+    if path.name.endswith("_pb2.py") or path.name.endswith("_pb2_grpc.py"):
+        return False
+    return "__pycache__" not in path.parts
+
+
+class RepoIndex:
+    """Shared, parse-once view of the repo every pass runs against."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self._cache: Dict[str, Optional[SourceFile]] = {}
+        self._package: Optional[List[SourceFile]] = None
+        self._tests: Optional[List[SourceFile]] = None
+
+    def _load(
+        self, path: Path, suppression_scope: bool = True
+    ) -> Optional[SourceFile]:
+        try:
+            rel = path.relative_to(self.root).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        if rel not in self._cache:
+            self._cache[rel] = (
+                SourceFile(path, rel, suppression_scope)
+                if path.is_file()
+                else None
+            )
+        return self._cache[rel]
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        """Load one repo-relative file (None when absent)."""
+        return self._load(self.root / rel)
+
+    def walk(
+        self, rel_dir: str, suppression_scope: bool = True
+    ) -> List[SourceFile]:
+        base = self.root / rel_dir
+        if not base.is_dir():
+            return []
+        out = []
+        for p in sorted(base.rglob("*.py")):
+            if want_file(p):
+                sf = self._load(p, suppression_scope)
+                if sf is not None:
+                    out.append(sf)
+        return out
+
+    @property
+    def package_files(self) -> List[SourceFile]:
+        if self._package is None:
+            self._package = self.walk(PACKAGE)
+        return self._package
+
+    @property
+    def test_files(self) -> List[SourceFile]:
+        if self._tests is None:
+            # data for passes (chaos-exemption validation), not lint
+            # subjects: their comments are not suppressions
+            self._tests = self.walk("tests", suppression_scope=False)
+        return self._tests
+
+    def scanned_files(self) -> List[SourceFile]:
+        """Every file any pass touched (suppression accounting)."""
+        return [sf for sf in self._cache.values() if sf is not None]
+
+
+class Pass:
+    """Base class: subclasses set ``name``/``code``/``description`` and
+    implement ``run``. ``code`` prefixes every finding ID the pass mints."""
+
+    name: str = ""
+    code: str = ""
+    description: str = ""
+    #: the standalone CLI this pass absorbed, if any (docs only)
+    legacy_cli: Optional[str] = None
+
+    def run(self, index: RepoIndex) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(
+        self, n: int, file: str, line: int, message: str
+    ) -> Finding:
+        return Finding(
+            pass_name=self.name,
+            code=f"{self.code}{n:03d}",
+            file=file,
+            line=line,
+            message=message,
+        )
+
+
+#: name -> Pass instance, in registration order
+REGISTRY: Dict[str, Pass] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a pass."""
+    inst = cls()
+    if not inst.name or not inst.code:
+        raise ValueError(f"pass {cls.__name__} must set name and code")
+    if inst.name in REGISTRY:
+        raise ValueError(f"duplicate pass name {inst.name!r}")
+    REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_passes() -> Dict[str, Pass]:
+    from . import passes  # noqa: F401 — registration side effect
+
+    return REGISTRY
+
+
+@dataclasses.dataclass
+class Report:
+    """One framework run: kept + suppressed findings, per-pass counts."""
+
+    findings: List[Finding]
+    suppressed: List[Finding]
+    passes_run: List[str]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        by_pass: Dict[str, int] = {}
+        for f in self.findings:
+            by_pass[f.pass_name] = by_pass.get(f.pass_name, 0) + 1
+        summary = (
+            f"{len(self.findings)} finding(s)"
+            + (
+                " (" + ", ".join(
+                    f"{k}={v}" for k, v in sorted(by_pass.items())
+                ) + ")"
+                if by_pass
+                else ""
+            )
+            + f", {len(self.suppressed)} suppressed, "
+            + f"{len(self.passes_run)} passes"
+        )
+        return "\n".join(lines + [summary])
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "findings": [f.to_dict() for f in self.findings],
+                "suppressed": [f.to_dict() for f in self.suppressed],
+                "passes": self.passes_run,
+                "exit": self.exit_code,
+            },
+            indent=1,
+            sort_keys=True,
+        )
+
+
+def select_passes(
+    select: Optional[Iterable[str]] = None,
+    ignore: Optional[Iterable[str]] = None,
+) -> List[Pass]:
+    table = all_passes()
+    names = list(table)
+    if select:
+        unknown = sorted(set(select) - set(names))
+        if unknown:
+            raise KeyError(f"unknown pass(es): {', '.join(unknown)}")
+        names = [n for n in names if n in set(select)]
+    if ignore:
+        unknown = sorted(set(ignore) - set(table))
+        if unknown:
+            raise KeyError(f"unknown pass(es): {', '.join(unknown)}")
+        names = [n for n in names if n not in set(ignore)]
+    return [table[n] for n in names]
+
+
+def run(
+    root: Path,
+    select: Optional[Iterable[str]] = None,
+    ignore: Optional[Iterable[str]] = None,
+    paths: Optional[Sequence[str]] = None,
+) -> Report:
+    """Run the selected passes over ``root``; apply suppressions; flag
+    unused suppressions. ``paths`` (repo-relative prefixes) optionally
+    restrict which files' findings are REPORTED — passes still see the
+    whole tree (cross-file passes need it)."""
+    index = RepoIndex(root)
+    chosen = select_passes(select, ignore)
+    raw: List[Finding] = []
+    for p in chosen:
+        raw.extend(p.run(index))
+
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    used: Set[Tuple[str, int, str]] = set()       # (file, line, pass)
+    used_file: Set[Tuple[str, str]] = set()       # (file, pass)
+    for f in raw:
+        sf = index.file(f.file)
+        if sf is not None:
+            if f.pass_name in sf.disabled_file:
+                used_file.add((f.file, f.pass_name))
+                suppressed.append(f)
+                continue
+            if f.pass_name in sf.disabled_lines.get(f.line, set()):
+                used.add((f.file, f.line, f.pass_name))
+                suppressed.append(f)
+                continue
+        kept.append(f)
+
+    # unused / unknown suppressions are findings in their own right
+    chosen_names = {p.name for p in chosen}
+    known = set(all_passes())
+    full_run = chosen_names == known
+    for sf in index.scanned_files():
+        if not sf.suppression_scope:
+            continue
+        for line, names in sorted(sf.disabled_lines.items()):
+            for name in sorted(names):
+                if name not in known:
+                    kept.append(Finding(
+                        "suppressions", "SUP002", sf.rel, line,
+                        f"suppression names unknown pass {name!r}",
+                    ))
+                elif (
+                    name in chosen_names
+                    and (sf.rel, line, name) not in used
+                ):
+                    kept.append(Finding(
+                        "suppressions", "SUP001", sf.rel, line,
+                        f"unused suppression: pass {name!r} reports "
+                        "nothing on this line — delete the stale disable",
+                    ))
+        for name in sorted(sf.disabled_file):
+            if name not in known:
+                kept.append(Finding(
+                    "suppressions", "SUP002", sf.rel, 1,
+                    f"suppression names unknown pass {name!r}",
+                ))
+            elif (
+                full_run
+                and name in chosen_names
+                and (sf.rel, name) not in used_file
+            ):
+                kept.append(Finding(
+                    "suppressions", "SUP003", sf.rel, 1,
+                    f"unused file-wide suppression for pass {name!r}",
+                ))
+
+    if paths:
+        prefixes = tuple(p.rstrip("/") for p in paths)
+
+        def _in_scope(f: Finding) -> bool:
+            return any(
+                f.file == pre or f.file.startswith(pre + "/")
+                for pre in prefixes
+            )
+
+        kept = [f for f in kept if _in_scope(f)]
+        suppressed = [f for f in suppressed if _in_scope(f)]
+
+    kept.sort(key=lambda f: (f.file, f.line, f.code))
+    return Report(
+        findings=kept,
+        suppressed=suppressed,
+        passes_run=[p.name for p in chosen],
+    )
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several passes)
+# ---------------------------------------------------------------------------
+
+
+def call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def dotted_path(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for pure Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Iterable[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover — defensive
+        return ""

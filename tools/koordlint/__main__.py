@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.koordlint [paths...] [--select ...] [--json]``.
+
+Exit 0 iff zero unsuppressed findings. ``--json -`` prints the
+machine-readable report to stdout; ``--json PATH`` writes it beside the
+human table. ``paths`` are repo-relative prefixes that restrict which
+files' findings are reported (passes still analyze the whole tree)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+if __package__ in (None, ""):  # `python tools/koordlint/__main__.py`
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+    from tools.koordlint import all_passes, repo_root, run
+else:
+    from . import all_passes, repo_root, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.koordlint",
+        description=__doc__.split("\n\n")[0],
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="repo-relative path prefixes to report on (default: all)",
+    )
+    ap.add_argument(
+        "--select", default="",
+        help="comma-separated pass names to run (default: all)",
+    )
+    ap.add_argument(
+        "--ignore", default="",
+        help="comma-separated pass names to skip",
+    )
+    ap.add_argument(
+        "--json", default="", metavar="PATH",
+        help="write the machine-readable report ('-' = stdout)",
+    )
+    ap.add_argument(
+        "--root", default="", metavar="DIR",
+        help="repo root to scan (default: this checkout)",
+    )
+    ap.add_argument(
+        "--list-passes", action="store_true",
+        help="list registered passes and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for name, p in all_passes().items():
+            legacy = f" (absorbs {p.legacy_cli})" if p.legacy_cli else ""
+            print(f"{name:<18} {p.code:<4} {p.description}{legacy}")
+        return 0
+
+    select = [s for s in args.select.split(",") if s.strip()] or None
+    ignore = [s for s in args.ignore.split(",") if s.strip()] or None
+    root = Path(args.root).resolve() if args.root else repo_root()
+    try:
+        report = run(
+            root, select=select, ignore=ignore, paths=args.paths or None
+        )
+    except KeyError as exc:
+        print(exc.args[0], file=sys.stderr)
+        return 2
+    if args.json == "-":
+        print(report.to_json())
+    else:
+        if args.json:
+            Path(args.json).write_text(report.to_json() + "\n")
+        print(report.render())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

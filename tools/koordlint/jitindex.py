@@ -1,0 +1,280 @@
+"""Shared jit-wrapping discovery for the retrace-hazard and
+donation-safety passes: which functions are jitted (decorator form,
+``jax.jit(fn, ...)`` wrapper form, ``shard_map`` form), their static
+argnames, donated positions, trace-time hook string, and how call sites
+resolve to them (module-level imports + in-function jitted bindings).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import RepoIndex, call_name, parent_map
+
+
+@dataclasses.dataclass
+class JittedFn:
+    file: str                 # repo-relative file of the def
+    name: str                 # the DISPATCH name (binding or def name)
+    node: ast.AST             # the FunctionDef whose body is traced
+    line: int
+    statics: Set[str]
+    donated: Tuple[int, ...]  # donated positional indices
+    hook: Optional[str]       # _devprof.tracing("<fn>") string, if any
+    kind: str                 # "decorator" | "wrapper" | "shard_map"
+    #: for wrapper-form bindings: the def (or None = module) the binding
+    #: lives in — the name only resolves for calls inside that scope
+    scope: Optional[ast.AST] = None
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    ) or (isinstance(node, ast.Name) and node.id == "jit")
+
+
+def _is_shard_map(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "shard_map"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "shard_map"
+    return False
+
+
+def _const_names(node: Optional[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    if node is None:
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+    return out
+
+
+def _const_ints(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _jit_call_opts(call: ast.Call) -> Tuple[Set[str], Tuple[int, ...]]:
+    statics: Set[str] = set()
+    donated: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            statics = _const_names(kw.value)
+        elif kw.arg == "donate_argnums":
+            donated = _const_ints(kw.value)
+    return statics, donated
+
+
+def find_hook(fn: ast.AST) -> Optional[str]:
+    """The ``tracing("<name>")`` string inside a (to-be-)jitted body."""
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and call_name(node) == "tracing"
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            return node.args[0].value
+    return None
+
+
+def _decorated_jit(fn) -> Optional[Tuple[Set[str], Tuple[int, ...], str]]:
+    """(statics, donated, kind) when ``fn`` is jitted by decorator."""
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return set(), (), "decorator"
+        if isinstance(dec, ast.Call):
+            fname = call_name(dec)
+            if fname == "partial" and dec.args:
+                if _is_jax_jit(dec.args[0]):
+                    statics, donated = _jit_call_opts(dec)
+                    return statics, donated, "decorator"
+                if _is_shard_map(dec.args[0]):
+                    return set(), (), "shard_map"
+            if _is_jax_jit(dec.func):
+                statics, donated = _jit_call_opts(dec)
+                return statics, donated, "decorator"
+            if _is_shard_map(dec.func):
+                return set(), (), "shard_map"
+    return None
+
+
+def collect_jitted(index: RepoIndex) -> List[JittedFn]:
+    """Every jit-wrapped function in the package. Memoized on the index
+    (retrace-hazard and donation-safety share one walk per run)."""
+    cached = getattr(index, "_jitindex_cache", None)
+    if cached is not None:
+        return cached
+    out: List[JittedFn] = []
+    for sf in index.package_files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        # decorator + shard_map forms
+        for node in ast.walk(tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            hit = _decorated_jit(node)
+            if hit is not None:
+                statics, donated, kind = hit
+                out.append(JittedFn(
+                    file=sf.rel, name=node.name, node=node,
+                    line=node.lineno, statics=statics, donated=donated,
+                    hook=find_hook(node), kind=kind,
+                ))
+        # wrapper form: ``X = jax.jit(local_def, ...)`` — the binding X
+        # is the dispatch name; the wrapped local def's body is traced
+        defs_by_scope: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        parents = parent_map(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = _enclosing_scope(node, parents)
+                defs_by_scope.setdefault(scope, {})[node.name] = node
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_jax_jit(node.value.func)
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Name)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            scope = _enclosing_scope(node, parents)
+            wrapped = defs_by_scope.get(scope, {}).get(
+                node.value.args[0].id
+            )
+            if wrapped is None:
+                continue
+            statics, donated = _jit_call_opts(node.value)
+            out.append(JittedFn(
+                file=sf.rel, name=node.targets[0].id, node=wrapped,
+                line=node.lineno, statics=statics, donated=donated,
+                hook=find_hook(wrapped), kind="wrapper",
+                scope=scope if not isinstance(scope, ast.Module) else None,
+            ))
+    index._jitindex_cache = out
+    return out
+
+
+def _enclosing_scope(node: ast.AST, parents) -> ast.AST:
+    cur = parents.get(node)
+    while cur is not None and not isinstance(
+        cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+    ):
+        cur = parents.get(cur)
+    return cur
+
+
+def traced_params(fn: JittedFn) -> Set[str]:
+    """Parameter names whose values are TRACED (non-static) at trace
+    time. ``self``-style params never appear on jitted fns here."""
+    a = fn.node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    return set(names) - fn.statics
+
+
+def module_of(rel: str) -> str:
+    """``koordinator_tpu/ops/solver.py`` -> ``koordinator_tpu.ops.solver``."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def import_map(sf) -> Dict[str, Tuple[str, str]]:
+    """local name -> (module, original name) for ``from X import a as b``
+    (absolute or relative, resolved against the file's package path)."""
+    tree = sf.tree
+    out: Dict[str, Tuple[str, str]] = {}
+    if tree is None:
+        return out
+    pkg_parts = module_of(sf.rel).split(".")[:-1]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or node.module is None:
+            continue
+        if node.level:
+            base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            mod = ".".join(base + node.module.split("."))
+        else:
+            mod = node.module
+        for alias in node.names:
+            out[alias.asname or alias.name] = (mod, alias.name)
+    return out
+
+
+def resolve_targets(
+    index: RepoIndex, jitted: List[JittedFn]
+) -> Dict[str, Dict[str, JittedFn]]:
+    """Per-file map: local callable name -> JittedFn it dispatches.
+
+    Covers (a) defs/wrappers in the same file, (b) ``from mod import
+    name`` of a jitted def in another module. Call sites the map cannot
+    resolve are simply out of scope."""
+    by_module: Dict[Tuple[str, str], JittedFn] = {
+        (module_of(j.file), j.name): j for j in jitted
+    }
+    out: Dict[str, Dict[str, JittedFn]] = {}
+    for sf in index.package_files:
+        local: Dict[str, JittedFn] = {}
+        for j in jitted:
+            if j.file == sf.rel and j.scope is None:
+                local[j.name] = j
+        for name, (mod, orig) in import_map(sf).items():
+            j = by_module.get((mod, orig))
+            if j is not None:
+                local[name] = j
+        out[sf.rel] = local
+    return out
+
+
+def resolve_call(
+    call: ast.Call,
+    local: Dict[str, JittedFn],
+    scoped: List[JittedFn],
+    anc: List[ast.AST],
+) -> Optional[JittedFn]:
+    """Resolve a ``Name(...)`` call against function-scoped jitted
+    bindings first (``fn = jax.jit(...)`` inside the enclosing def),
+    then the file/module-level map."""
+    if not isinstance(call.func, ast.Name):
+        return None
+    name = call.func.id
+    for j in scoped:
+        if j.name == name and j.scope is not None and j.scope in anc:
+            return j
+    return local.get(name)
+
+
+def traced_context_nodes(tree: ast.AST, jitted_in_file) -> Set[ast.AST]:
+    """Every def node lexically inside (or being) a jitted body — calls
+    from there run at TRACE time, not as host dispatches."""
+    out: Set[ast.AST] = set()
+    for j in jitted_in_file:
+        for node in ast.walk(j.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.add(node)
+    return out

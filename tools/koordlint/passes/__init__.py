@@ -1,0 +1,12 @@
+"""Pass modules. Importing this package registers every pass."""
+
+from . import (  # noqa: F401 — registration side effects
+    bench_verdicts,
+    chaos_coverage,
+    donation_safety,
+    exception_sites,
+    fence_boundaries,
+    guarded_by,
+    reject_reasons,
+    retrace_hazard,
+)

@@ -1,0 +1,111 @@
+"""Pass ``bench-verdicts`` (BV): ``tools/bench_regress.py`` declares its
+verdict vocabulary (``VERDICTS``) and the ``--json`` artifact is what CI
+consumes — an emitted verdict string outside the declared enum (or a
+declared member nothing emits) silently breaks every machine consumer.
+
+* **BV001** — a verdict string the module emits (``"verdict": "X"`` in a
+  dict literal, or ``verdict = "X"``) that is not in ``VERDICTS``;
+* **BV002** — a ``VERDICTS`` member the module never emits;
+* **BV003** — the ``VERDICTS`` declaration is missing entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .. import Finding, Pass, RepoIndex, register
+
+BENCH_FILE = "tools/bench_regress.py"
+
+
+def _declared(tree: ast.AST) -> Dict[str, int]:
+    """VERDICTS member -> declaration line ({} when absent)."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "VERDICTS"
+        ):
+            members: Dict[str, int] = {}
+            value = node.value
+            if isinstance(value, ast.Call) and value.args:
+                value = value.args[0]  # frozenset({...}) / tuple([...])
+            if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+                for e in value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str
+                    ):
+                        members[e.value] = node.lineno
+            return members
+    return {}
+
+
+def _emitted(tree: ast.AST) -> Dict[str, int]:
+    """Verdict strings the module produces -> first line."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "verdict":
+                    # the value may be conditional ("NEW" if ... else
+                    # "MISSING") — every string constant in it is an
+                    # emitted verdict
+                    for sub in ast.walk(v):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str
+                        ):
+                            out.setdefault(sub.value, sub.lineno)
+        elif (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "verdict"
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out.setdefault(node.value.value, node.lineno)
+    return out
+
+
+@register
+class BenchVerdictsPass(Pass):
+    name = "bench-verdicts"
+    code = "BV"
+    description = (
+        "bench_regress emits only its declared VERDICTS vocabulary"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        sf = index.file(BENCH_FILE)
+        if sf is None or sf.tree is None:
+            return [self.finding(
+                3, BENCH_FILE, 0,
+                "tools/bench_regress.py is missing or unparsable",
+            )]
+        declared = _declared(sf.tree)
+        emitted = _emitted(sf.tree)
+        out: List[Finding] = []
+        if not declared:
+            return [self.finding(
+                3, sf.rel, 0,
+                "no VERDICTS vocabulary declared — machine consumers "
+                "of the --json artifact have nothing to validate "
+                "against",
+            )]
+        for v, line in sorted(emitted.items()):
+            if v not in declared:
+                out.append(self.finding(
+                    1, sf.rel, line,
+                    f"emitted verdict {v!r} is not in the declared "
+                    "VERDICTS vocabulary",
+                ))
+        for v, line in sorted(declared.items()):
+            if v not in emitted:
+                out.append(self.finding(
+                    2, sf.rel, line,
+                    f"VERDICTS declares {v!r} but nothing emits it — "
+                    "stale vocabulary entry",
+                ))
+        return out

@@ -1,0 +1,229 @@
+"""Pass ``chaos-coverage`` (CC): every named chaos point is exercised —
+the PR 3 standing rule ("new failure domains add a named chaos point …
+and extend the soak's fault schedule"), until now enforced by review.
+
+Fire sites are ``<injector>.fire("domain.point")`` calls in the package
+(one positional string argument; f-string points become ``*`` patterns,
+e.g. ``channel.{name}.drop`` ⇒ ``channel.*.drop``). The soak fault
+schedule is the set of ``arm("...")`` calls in
+``koordinator_tpu/sim/longrun.py``.
+
+* **CC001** — a fired point that appears in no soak fault schedule and
+  carries no exemption: the failure domain exists but the composition
+  soak never exercises it.
+* **CC002** — a scheduled point no fire site can ever evaluate: the
+  schedule entry is stale (the point was renamed or removed).
+* **CC003** — an exemption for a point the soak ALSO arms: stale, delete
+  it.
+* **CC004** — an exemption naming a point with no fire site.
+* **CC005** — an exempt point whose promised dedicated test never arms
+  it: the exemption's site is gone (or never existed).
+
+Exemptions name points whose effects cannot ride the deterministic soak
+(they fire on background threads, racing the same-seed fault-trace
+order, or belong to components the soak does not run) and are covered by
+a DEDICATED fault test instead — validated against ``arm(...)`` calls in
+``tests/``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Set, Tuple
+
+from .. import Finding, Pass, RepoIndex, register
+
+#: the soak whose fault schedules define coverage
+SCHEDULE_FILE = "koordinator_tpu/sim/longrun.py"
+
+#: point -> (dedicated site, why it cannot ride the soak schedule)
+EXEMPT: Dict[str, Tuple[str, str]] = {
+    "solver.fetch.stall": (
+        "tests/test_chaos.py",
+        "fires on the result-fetch worker thread — arming it in the "
+        "soak would race the same-seed fault-trace order",
+    ),
+    "informer.watch_closed": (
+        "tests/test_chaos.py",
+        "fires on informer threads; the soak severs watches "
+        "deterministically via hub.disconnect() instead",
+    ),
+    "informer.relist.delay": (
+        "tests/test_chaos.py",
+        "fires on informer threads (same thread-order rule as "
+        "informer.watch_closed)",
+    ),
+    "koordlet.collect_tick": (
+        "tests/test_koordlet.py",
+        "the scheduler soak runs no koordlet daemon",
+    ),
+    "koordlet.qos_tick": (
+        "tests/test_koordlet.py",
+        "the scheduler soak runs no koordlet daemon",
+    ),
+    "journal.compact_crash": (
+        "tests/test_journal.py",
+        "compaction is driven by the scheduler run loop, which the "
+        "cycle-stepped soak does not spin",
+    ),
+}
+
+
+def _fire_points(index: RepoIndex) -> Dict[str, Tuple[str, int]]:
+    """point (or ``*`` pattern) -> first (file, line) firing it."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in index.package_files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "fire"
+                and len(node.args) == 1
+                and not node.keywords
+            ):
+                continue
+            arg = node.args[0]
+            point = None
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ):
+                point = arg.value
+            elif isinstance(arg, ast.JoinedStr):
+                parts = []
+                for v in arg.values:
+                    if isinstance(v, ast.Constant):
+                        parts.append(str(v.value))
+                    else:
+                        parts.append("*")
+                point = "".join(parts)
+            if point and "." in point:
+                out.setdefault(point, (sf.rel, node.lineno))
+    return out
+
+
+def _scheduled_points(index: RepoIndex) -> Dict[str, int]:
+    """soak-armed point -> first arm line."""
+    sf = index.file(SCHEDULE_FILE)
+    out: Dict[str, int] = {}
+    if sf is None or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "arm"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.setdefault(node.args[0].value, node.lineno)
+    return out
+
+
+def _test_armed_points(index: RepoIndex) -> Dict[str, Set[str]]:
+    """armed point -> test files arming it (the exemption's citation is
+    load-bearing: the point must be armed in the NAMED file)."""
+    out: Dict[str, Set[str]] = {}
+    for sf in index.test_files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "arm"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.setdefault(node.args[0].value, set()).add(sf.rel)
+    return out
+
+
+def _covered(point: str, scheduled: Dict[str, int]) -> bool:
+    if point in scheduled:
+        return True
+    if "*" in point:
+        return any(fnmatch.fnmatch(s, point) for s in scheduled)
+    return False
+
+
+@register
+class ChaosCoveragePass(Pass):
+    name = "chaos-coverage"
+    code = "CC"
+    description = (
+        "every chaos point rides a soak fault schedule (or a validated "
+        "dedicated-test exemption), and vice versa"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        fires = _fire_points(index)
+        scheduled = _scheduled_points(index)
+        test_armed = _test_armed_points(index)
+
+        for point, (rel, line) in sorted(fires.items()):
+            exempt = point in EXEMPT
+            covered = _covered(point, scheduled)
+            if not covered and not exempt:
+                out.append(self.finding(
+                    1, rel, line,
+                    f"chaos point {point!r} appears in no soak fault "
+                    f"schedule ({SCHEDULE_FILE}) and carries no "
+                    "exemption — extend the soak's schedule or document "
+                    "its dedicated fault test (PR 3 standing rule)",
+                ))
+            elif covered and exempt:
+                out.append(self.finding(
+                    3, rel, line,
+                    f"chaos point {point!r} is exempted as "
+                    "soak-unschedulable but the soak arms it — delete "
+                    "the stale exemption",
+                ))
+            elif exempt:
+                site = EXEMPT[point][0]
+                armed_in = set()
+                for t, files in test_armed.items():
+                    if (
+                        fnmatch.fnmatch(t, point)
+                        if "*" in point
+                        else t == point
+                    ):
+                        armed_in |= files
+                if site not in armed_in:
+                    out.append(self.finding(
+                        5, rel, line,
+                        f"chaos point {point!r} is exempted as covered "
+                        f"by a dedicated test ({site}), but that file "
+                        "does not arm it — the promised site is gone "
+                        "(or never existed)",
+                    ))
+
+        sched_sf = index.file(SCHEDULE_FILE)
+        sched_rel = sched_sf.rel if sched_sf else SCHEDULE_FILE
+        for point, line in sorted(scheduled.items()):
+            if not any(
+                point == f or ("*" in f and fnmatch.fnmatch(point, f))
+                for f in fires
+            ):
+                out.append(self.finding(
+                    2, sched_rel, line,
+                    f"soak schedule arms {point!r} but no fire site "
+                    "evaluates it — the schedule entry is stale",
+                ))
+
+        for point in sorted(set(EXEMPT) - set(fires)):
+            if any("*" in f and fnmatch.fnmatch(point, f) for f in fires):
+                continue
+            out.append(self.finding(
+                4, "tools/koordlint/passes/chaos_coverage.py", 0,
+                f"exemption names chaos point {point!r} but no fire "
+                "site evaluates it",
+            ))
+        return out

@@ -1,0 +1,186 @@
+"""Pass ``donation-safety`` (DS): dataflow check at every
+``donate_argnums`` call site — the PR 2/4 standing rule "never donate a
+buffer the caller re-reads", previously guarded only by whichever tests
+happened to exercise the path.
+
+After a donating dispatch the donated buffer is DEAD: XLA may have
+written the output into its memory. The pass verifies, in the calling
+function:
+
+* **DS001** — the donated binding (name or dotted path) is never READ
+  again after the call without an intervening rebind of the binding (or
+  of its root object);
+* **DS002** — the donated argument is not a directly-stored ``self.``
+  attribute: an object field outlives the call, so anything else holding
+  the object can re-read the donated buffer (pass a local handle and
+  re-store the result instead, the ``_scatter_refresh`` discipline).
+
+Scope: host call sites only (a donation inside an enclosing jit is
+inlined and its donate_argnums ignored), linear statement order within
+the calling function. Reads the checker cannot see (cross-function
+aliases) remain the donation-effectiveness census's job at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import (
+    Finding,
+    Pass,
+    RepoIndex,
+    ancestors,
+    dotted_path,
+    parent_map,
+    register,
+)
+from ..jitindex import (
+    collect_jitted,
+    resolve_call,
+    resolve_targets,
+    traced_context_nodes,
+)
+
+
+def _enclosing_function(node, parents):
+    for a in ancestors(node, parents):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def _store_paths(target: ast.AST) -> List[str]:
+    """Dotted paths (re)bound by an assignment target."""
+    out: List[str] = []
+    for node in ast.walk(target):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            p = dotted_path(node)
+            if p is not None and isinstance(
+                getattr(node, "ctx", None), ast.Store
+            ):
+                out.append(p)
+    return out
+
+
+def _reads_after(
+    fn: ast.AST,
+    path: str,
+    call: ast.Call,
+) -> Optional[int]:
+    """Line of the first Load of ``path`` after the donating call (its
+    END line — a multi-line call's own arguments are not "after") with
+    no intervening rebind of ``path``/its root/a prefix. None if clean."""
+    call_start = call.lineno
+    call_end = getattr(call, "end_lineno", call.lineno) or call.lineno
+    root = path.split(".", 1)[0]
+    rebinds: List[int] = []
+    loads: List[int] = []
+    for node in ast.walk(fn):
+        line = getattr(node, "lineno", None)
+        if line is None:
+            continue
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            # the call's own statement may rebind (x = f(x)): stores on
+            # the call's start line count as killing the binding
+            if line < call_start:
+                continue
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                for p in _store_paths(t):
+                    if p == path or p == root or path.startswith(p + "."):
+                        rebinds.append(line)
+        elif (
+            isinstance(node, (ast.Name, ast.Attribute))
+            and isinstance(getattr(node, "ctx", None), ast.Load)
+            and line > call_end
+        ):
+            p = dotted_path(node)
+            if p == path:
+                loads.append(line)
+    for ll in sorted(loads):
+        if not any(call_start <= rl <= ll for rl in rebinds):
+            return ll
+    return None
+
+
+@register
+class DonationSafetyPass(Pass):
+    name = "donation-safety"
+    code = "DS"
+    description = (
+        "donate_argnums buffers are dead after the call: no re-read, "
+        "no stored-attribute donation"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        jitted = [j for j in collect_jitted(index) if j.donated]
+        if not jitted:
+            return out
+        targets = resolve_targets(index, jitted)
+        donors = {
+            rel: {n: j for n, j in local.items() if j.donated}
+            for rel, local in targets.items()
+        }
+        all_jitted = collect_jitted(index)
+        for sf in index.package_files:
+            local = donors.get(sf.rel) or {}
+            tree = sf.tree
+            if tree is None:
+                continue
+            scoped = [
+                j for j in all_jitted
+                if j.file == sf.rel and j.scope is not None and j.donated
+            ]
+            if not local and not scoped:
+                continue
+            parents = parent_map(tree)
+            traced_ctx = traced_context_nodes(
+                tree, [j for j in all_jitted if j.file == sf.rel]
+            )
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                ):
+                    continue
+                anc = list(ancestors(node, parents))
+                j = resolve_call(node, local, scoped, anc)
+                if j is None:
+                    continue
+                if any(a in traced_ctx for a in anc):
+                    continue  # nested under jit: donation is inlined away
+                fn = _enclosing_function(node, parents)
+                for i in j.donated:
+                    if i >= len(node.args):
+                        continue
+                    arg = node.args[i]
+                    path = dotted_path(arg)
+                    if path is None:
+                        continue  # fresh temporary (e.g. jnp.asarray(x))
+                    if path.startswith("self."):
+                        out.append(self.finding(
+                            2, sf.rel, node.lineno,
+                            f"`{path}` donated to `{node.func.id}` is a "
+                            "stored attribute — anything holding the "
+                            "object can re-read the dead buffer; donate "
+                            "a local handle and re-store the result",
+                        ))
+                        continue
+                    if fn is None:
+                        continue
+                    bad = _reads_after(fn, path, node)
+                    if bad is not None:
+                        out.append(self.finding(
+                            1, sf.rel, bad,
+                            f"`{path}` is read after being donated to "
+                            f"`{node.func.id}` on line {node.lineno} — "
+                            "the buffer is dead there (never donate a "
+                            "buffer the caller re-reads)",
+                        ))
+        return out

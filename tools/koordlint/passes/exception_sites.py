@@ -1,0 +1,152 @@
+"""Pass ``exception-sites`` (EX): every broad ``except Exception`` is
+*accounted* — routes through ``report_exception`` (directly or via a
+reporting helper) or re-raises. Absorbed from the standalone
+``tools/check_exception_sites.py`` (PR 3 invariant) with bit-identical
+verdicts; the legacy module remains as a delegating shim.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Iterable, List, Tuple
+
+from .. import Finding, Pass, RepoIndex, register, want_file
+
+#: helpers whose bodies call report_exception — a handler calling one of
+#: these is accounted (keep in sync when adding new reporting funnels)
+REPORTING_HELPERS = frozenset({"_note_solver_failure"})
+
+#: the module that DEFINES the discipline (scanning it would be circular)
+EXEMPT_FILES = frozenset({"obs/errors.py"})
+
+Violation = Tuple[str, int, str]
+
+
+def _names_in_type(node) -> Iterable[str]:
+    """Exception-class names mentioned in an ``except`` clause type."""
+    if node is None:
+        # bare ``except:`` — broader than ``except Exception``
+        yield "Exception"
+        return
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr
+        elif isinstance(n, ast.Tuple):
+            stack.extend(n.elts)
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _handler_accounted(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name == "report_exception" or name in REPORTING_HELPERS:
+                    return True
+    return False
+
+
+def check_tree(tree: ast.AST, rel: str) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if "Exception" not in set(_names_in_type(node.type)):
+            continue
+        if not _handler_accounted(node):
+            out.append(
+                (
+                    rel,
+                    node.lineno,
+                    "broad `except Exception` neither calls "
+                    "report_exception (or a reporting helper) nor "
+                    "re-raises",
+                )
+            )
+    return out
+
+
+def check_file(path: Path, root: Path) -> List[Violation]:
+    rel = path.relative_to(root).as_posix()
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except SyntaxError as exc:  # a broken file is its own violation
+        return [(rel, exc.lineno or 0, f"unparsable: {exc.msg}")]
+    return check_tree(tree, rel)
+
+
+def check_paths(paths: Iterable[Path], root: Path) -> List[Violation]:
+    violations: List[Violation] = []
+    for p in paths:
+        for f in sorted(p.rglob("*.py")) if p.is_dir() else [p]:
+            if f.relative_to(root).as_posix() in (
+                f"koordinator_tpu/{e}" for e in EXEMPT_FILES
+            ):
+                continue
+            if p.is_dir() and not want_file(f):
+                continue
+            violations.extend(check_file(f, root))
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    from .. import repo_root
+
+    root = repo_root()
+    targets = (
+        [Path(a).resolve() for a in argv]
+        if argv
+        else [root / "koordinator_tpu"]
+    )
+    violations = check_paths(targets, root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}", file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} unaccounted `except Exception` site(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+@register
+class ExceptionSitesPass(Pass):
+    name = "exception-sites"
+    code = "EX"
+    description = (
+        "broad `except Exception` must report_exception or re-raise"
+    )
+    legacy_cli = "tools/check_exception_sites.py"
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        exempt = {f"koordinator_tpu/{e}" for e in EXEMPT_FILES}
+        for sf in index.package_files:
+            if sf.rel in exempt:
+                continue
+            if sf.tree is None:
+                exc = sf.parse_error
+                out.append(self.finding(
+                    0, sf.rel, exc.lineno or 0, f"unparsable: {exc.msg}"
+                ))
+                continue
+            for rel, line, msg in check_tree(sf.tree, sf.rel):
+                out.append(self.finding(1, rel, line, msg))
+        return out

@@ -1,0 +1,176 @@
+"""Pass ``fence-boundaries`` (FB): every bind-journal write boundary
+(``append_intent``/``append_bind``/``append_abort``) evaluates an epoch
+check in the SAME function (``_fence_stale`` or a ``.check(...)`` on
+something named ``fence``). ``append_forget`` stays out of scope (the
+standby-forget rule journals apiserver-authoritative deletions
+fence-exempt by design); ``core/journal.py`` is exempt — it IS the
+fencing authority. Absorbed from ``tools/check_fence_boundaries.py``
+(PR 6 satellite) with bit-identical verdicts.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Iterable, List, Tuple
+
+from .. import Finding, Pass, RepoIndex, register, want_file
+
+#: journal write ops that MUST be epoch-checked in the enclosing function
+GUARDED_APPENDS = frozenset(
+    {"append_intent", "append_bind", "append_abort"}
+)
+
+#: calls that count as an epoch check
+FENCE_CHECK_HELPERS = frozenset({"_fence_stale"})
+
+#: files exempt from the scan (relative to koordinator_tpu/)
+EXEMPT_FILES = frozenset({"core/journal.py"})
+
+Violation = Tuple[str, int, str]
+
+
+def _call_attr(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _is_fence_check(call: ast.Call) -> bool:
+    name = _call_attr(call)
+    if name in FENCE_CHECK_HELPERS:
+        return True
+    if name != "check":
+        return False
+    # ``<something>.check(...)`` counts only when the receiver path
+    # mentions a fence (``self.fence.check``, ``fence.check``,
+    # ``fabric.fences[s].check``) — a stray ``x.check()`` does not.
+    node = call.func.value if isinstance(call.func, ast.Attribute) else None
+    while node is not None:
+        if isinstance(node, ast.Attribute):
+            if "fence" in node.attr.lower():
+                return True
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            return "fence" in node.id.lower()
+        else:
+            return False
+    return False
+
+
+def check_tree(tree: ast.AST, rel: str) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        appends: List[ast.Call] = []
+        checked = False
+        # scan this function's body EXCLUDING nested function defs —
+        # a check inside a nested closure does not guard this frame's
+        # appends (and vice versa); nested defs are walked on their own
+        stack = list(node.body)
+        while stack:
+            stmt = stack.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.iter_child_nodes(stmt):
+                stack.append(sub)
+            if isinstance(stmt, ast.Call):
+                if _call_attr(stmt) in GUARDED_APPENDS:
+                    appends.append(stmt)
+                elif _is_fence_check(stmt):
+                    checked = True
+        if appends and not checked:
+            for call in appends:
+                out.append(
+                    (
+                        rel,
+                        call.lineno,
+                        f"journal {_call_attr(call)} without an epoch "
+                        "check in the enclosing function "
+                        f"({node.name}) — fence before journal",
+                    )
+                )
+    return out
+
+
+def _rel(path: Path, root: Path) -> str:
+    try:
+        return path.relative_to(root).as_posix()
+    except ValueError:  # target outside the repo (ad-hoc invocation)
+        return path.as_posix()
+
+
+def check_file(path: Path, root: Path) -> List[Violation]:
+    rel = _rel(path, root)
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except SyntaxError as exc:
+        return [(rel, exc.lineno or 0, f"unparsable: {exc.msg}")]
+    return check_tree(tree, rel)
+
+
+def check_paths(paths: Iterable[Path], root: Path) -> List[Violation]:
+    violations: List[Violation] = []
+    for p in paths:
+        for f in sorted(p.rglob("*.py")) if p.is_dir() else [p]:
+            if _rel(f, root) in (
+                f"koordinator_tpu/{e}" for e in EXEMPT_FILES
+            ):
+                continue
+            if p.is_dir() and not want_file(f):
+                continue
+            violations.extend(check_file(f, root))
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    from .. import repo_root
+
+    root = repo_root()
+    targets = (
+        [Path(a).resolve() for a in argv]
+        if argv
+        else [root / "koordinator_tpu"]
+    )
+    violations = check_paths(targets, root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}", file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} unfenced journal write boundar"
+            f"{'y' if len(violations) == 1 else 'ies'}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+@register
+class FenceBoundariesPass(Pass):
+    name = "fence-boundaries"
+    code = "FB"
+    description = "journal appends need an epoch check in-function"
+    legacy_cli = "tools/check_fence_boundaries.py"
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        exempt = {f"koordinator_tpu/{e}" for e in EXEMPT_FILES}
+        for sf in index.package_files:
+            if sf.rel in exempt:
+                continue
+            if sf.tree is None:
+                exc = sf.parse_error
+                out.append(self.finding(
+                    0, sf.rel, exc.lineno or 0, f"unparsable: {exc.msg}"
+                ))
+                continue
+            for rel, line, msg in check_tree(sf.tree, sf.rel):
+                out.append(self.finding(1, rel, line, msg))
+        return out

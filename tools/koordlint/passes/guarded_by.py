@@ -1,0 +1,297 @@
+"""Pass ``guarded-by`` (GB): lock-discipline annotations for the shared
+mutable state the pump / prepare-worker / shard / informer / HTTP
+threads all touch (CyclePipeline's worker, StreamScheduler's scheduler,
+PodLifecycle's buffers, ShardFabric's handoff log, FlightRecorder's
+ring, the obs trackers).
+
+Annotate the attribute where it is initialized::
+
+    self._ring: deque = deque(maxlen=cap)  # guarded-by: self._lock
+
+The pass then flags every WRITE to the annotated attribute (assignment,
+aug-assign, ``del``, or a mutating method call — append/pop/update/...)
+that is not lexically inside a ``with`` on the named lock:
+
+* **GB001** — write via ``self.<attr>`` inside the declaring class;
+* **GB002** — write via another object (``fabric.handoff_log.append``):
+  the lock is rebased onto the same owner path (annotation
+  ``self.handoff_lock`` ⇒ required ``with fabric.handoff_lock``).
+
+Exempt: ``__init__`` (construction happens-before publication), methods
+whose name ends in ``_locked`` (the repo's caller-holds convention), and
+defs annotated ``# koordlint: holds=self._lock`` on their ``def`` line.
+Reads are out of scope — lock-free snapshot reads of GIL-atomic
+structures are an intentional idiom here; it is the WRITES that must
+serialize.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Set, Tuple
+
+from .. import (
+    Finding,
+    Pass,
+    RepoIndex,
+    SourceFile,
+    ancestors,
+    dotted_path,
+    parent_map,
+    register,
+)
+
+#: method names that mutate their receiver
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "update", "setdefault",
+    "add", "discard", "rotate", "sort", "reverse",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Annotation:
+    file: str
+    cls: str
+    attr: str
+    lock: str      # e.g. "self._lock" (annotation form)
+    line: int
+
+    @property
+    def lock_attr(self) -> str:
+        return self.lock.split(".", 1)[1] if "." in self.lock else self.lock
+
+
+def collect_annotations(
+    index: RepoIndex,
+) -> Tuple[List[Annotation], Set[str]]:
+    """(annotations, ambiguous attr names). An attr name also declared
+    by a class that does NOT annotate it is AMBIGUOUS for the
+    cross-object rule — without types, ``other._series`` cannot be told
+    apart from the annotated class's ``_series``; only ``self.`` writes
+    in the annotated class stay enforced for those."""
+    out: List[Annotation] = []
+    declared_elsewhere: Set[str] = set()
+    annotated_cls: Set[Tuple[str, str]] = set()
+    for sf in index.package_files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    path = dotted_path(t)
+                    if path is None or not path.startswith("self."):
+                        continue
+                    attr = path[len("self."):]
+                    if "." in attr:
+                        continue
+                    lock = sf.guarded_by_on_line(node.lineno)
+                    if lock is not None:
+                        out.append(Annotation(
+                            file=sf.rel, cls=cls.name, attr=attr,
+                            lock=lock, line=node.lineno,
+                        ))
+                        annotated_cls.add((cls.name, attr))
+                    else:
+                        declared_elsewhere.add((cls.name, attr))
+    ambiguous = {
+        attr
+        for cls, attr in declared_elsewhere
+        if any(a.attr == attr for a in out)
+        and (cls, attr) not in annotated_cls
+    }
+    return out, ambiguous
+
+
+def _write_paths(node: ast.AST) -> List[Tuple[str, int]]:
+    """(dotted path written, line) pairs this statement/expr mutates."""
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        # tuple/list/starred unpacking targets write each element
+        flat: List[ast.AST] = []
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+            else:
+                flat.append(t)
+        for t in flat:
+            base = t
+            # self.x[k] = v / fabric.log[k] = v — the CONTAINER mutates
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            p = dotted_path(base)
+            if p is not None:
+                out.append((p, node.lineno))
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            p = dotted_path(base)
+            if p is not None:
+                out.append((p, node.lineno))
+    elif (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in MUTATORS
+    ):
+        p = dotted_path(node.func.value)
+        if p is not None:
+            out.append((p, node.lineno))
+    return out
+
+
+def _with_lock_exprs(stmt: ast.With) -> Set[str]:
+    out: Set[str] = set()
+    for item in stmt.items:
+        p = dotted_path(item.context_expr)
+        if p is not None:
+            out.add(p)
+    return out
+
+
+def _exempt_def(
+    fn: ast.AST, sf: SourceFile, required_lock: str
+) -> bool:
+    name = getattr(fn, "name", "")
+    if name == "__init__" or name.endswith("_locked"):
+        return True
+    held = sf.holds.get(getattr(fn, "lineno", -1))
+    return held is not None and held == required_lock
+
+
+def _locked(anc: List[ast.AST], required: str) -> bool:
+    return any(
+        isinstance(a, ast.With) and required in _with_lock_exprs(a)
+        for a in anc
+    )
+
+
+@register
+class GuardedByPass(Pass):
+    name = "guarded-by"
+    code = "GB"
+    description = (
+        "# guarded-by: annotated attributes are only written under "
+        "their named lock"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        annotations, ambiguous = collect_annotations(index)
+        if not annotations:
+            return out
+        # attr name -> annotations carrying it (cross-object rule keys
+        # on the terminal attribute name; collisions are resolved by
+        # requiring the rebased lock on the same owner path)
+        by_attr: Dict[str, List[Annotation]] = {}
+        for a in annotations:
+            by_attr.setdefault(a.attr, []).append(a)
+
+        for sf in index.package_files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            parents = parent_map(tree)
+            # class name active at each node (None at module level)
+            for node in ast.walk(tree):
+                for path, line in _write_paths(node):
+                    parts = path.split(".")
+                    if len(parts) < 2:
+                        continue
+                    attr = parts[-1]
+                    base = ".".join(parts[:-1])
+                    hits = by_attr.get(attr)
+                    if not hits:
+                        continue
+                    anc = list(ancestors(node, parents))
+                    fn = next(
+                        (
+                            a for a in anc
+                            if isinstance(
+                                a,
+                                (ast.FunctionDef, ast.AsyncFunctionDef),
+                            )
+                        ),
+                        None,
+                    )
+                    if base == "self":
+                        cls = next(
+                            (
+                                a.name for a in anc
+                                if isinstance(a, ast.ClassDef)
+                            ),
+                            None,
+                        )
+                        ann = next(
+                            (
+                                a for a in hits
+                                if a.file == sf.rel and a.cls == cls
+                            ),
+                            None,
+                        )
+                        if ann is None:
+                            continue  # same attr name, another class
+                        required = ann.lock
+                        if fn is not None and _exempt_def(
+                            fn, sf, required
+                        ):
+                            continue
+                        if not _locked(anc, required):
+                            out.append(self.finding(
+                                1, sf.rel, line,
+                                f"write to {ann.cls}.{attr} "
+                                f"(# guarded-by: {ann.lock}) outside "
+                                f"`with {required}`",
+                            ))
+                    else:
+                        # cross-object write: rebase the lock onto the
+                        # same owner path (self.handoff_lock ->
+                        # <base>.handoff_lock). Several annotated
+                        # classes may share the attr name with
+                        # DIFFERENT locks — without types the owner is
+                        # unknowable, so holding ANY candidate's
+                        # rebased lock satisfies the check (the
+                        # same-class GB001 rule stays exact).
+                        if attr in ambiguous:
+                            continue
+                        required_any = sorted({
+                            f"{base}.{a.lock_attr}" for a in hits
+                        })
+                        if fn is not None and any(
+                            _exempt_def(fn, sf, req)
+                            for req in required_any
+                        ):
+                            continue
+                        if not any(
+                            _locked(anc, req) for req in required_any
+                        ):
+                            ann = hits[0]
+                            out.append(self.finding(
+                                2, sf.rel, line,
+                                f"write to `{path}` "
+                                f"({ann.cls}.{attr} is # guarded-by: "
+                                f"{ann.lock}) outside "
+                                "`with "
+                                + (" | ".join(required_any))
+                                + "`",
+                            ))
+        return out

@@ -1,0 +1,262 @@
+"""Pass ``reject-reasons`` (RR): the rejection taxonomy stays fully
+attributed — every ``RejectReason`` member has a
+``_classify_solver_reject`` arm or an explicit, still-true exemption
+naming its dedicated attribution site. Absorbed from
+``tools/check_reject_reasons.py`` (distributed-observability PR
+satellite) with bit-identical verdicts.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import Finding, Pass, RepoIndex, register, want_file
+
+#: members attributed at a dedicated site instead of the solver-reject
+#: mask replay — member name -> where (and why) it is attributed
+EXEMPT: Dict[str, str] = {
+    "POD_TRANSFORMER_DROPPED": (
+        "gate stage: frameworkext pod-transformer drop, before any "
+        "solve runs"
+    ),
+    "GANG_NOT_READY": (
+        "gate stage: coscheduling holds the gang back pre-batch"
+    ),
+    "RESERVATION_UNAVAILABLE": (
+        "reserve stage: reservation fast-path match refusal"
+    ),
+    "NODE_CAPACITY_REVALIDATION": (
+        "commit stage: Reserve's host-side capacity recheck of a "
+        "solver winner"
+    ),
+    "NUMA_ALLOCATION_FAILED": (
+        "commit stage: NUMAManager zone allocation refusal"
+    ),
+    "DEVICE_ALLOCATION_FAILED": (
+        "commit stage: DeviceManager slot allocation refusal"
+    ),
+    "NODE_VANISHED": (
+        "commit stage: winner's node deleted between solve and Reserve"
+    ),
+    "NUMERIC_INVALID": (
+        "pre-solve quarantine: non-finite req/est rows never reach the "
+        "mask stages the replay re-runs"
+    ),
+    "SOLVE_RESULT_STALLED": (
+        "solve stage: bounded result fetch timed out — a feeder stall, "
+        "not a mask verdict"
+    ),
+    "CYCLE_DEADLINE_EXCEEDED": (
+        "cycle deadline: deferred chunks were never solved, so there "
+        "is no mask outcome to replay"
+    ),
+    "COMMIT_ROLLED_BACK": (
+        "commit stage: mid-commit crash unwound the chunk's Reserve "
+        "journal"
+    ),
+    "STALE_LEADER_EPOCH": (
+        "fence boundary: a deposed leader's commit refused by epoch "
+        "check, independent of solver feasibility"
+    ),
+    "JOURNAL_WRITE_FAILED": (
+        "journal boundary: intent/bind append refused — "
+        "journal-before-mutate rejects the chunk un-mutated"
+    ),
+}
+
+#: where the enum and the classifier live
+ENUM_FILE = "koordinator_tpu/obs/rejections.py"
+CLASSIFIER_FILE = "koordinator_tpu/scheduler/batch_solver.py"
+CLASSIFIER_FUNC = "_classify_solver_reject"
+
+#: the shim file exemptions point error messages at (kept stable so the
+#: migrated verdicts stay bit-identical with the legacy CLI)
+SELF_FILE = "tools/check_reject_reasons.py"
+
+Violation = Tuple[str, int, str]
+
+
+def _enum_members_tree(tree: ast.AST) -> Dict[str, int]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "RejectReason":
+            out: Dict[str, int] = {}
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    out[stmt.targets[0].id] = stmt.lineno
+            return out
+    raise AssertionError(f"RejectReason class not found in {ENUM_FILE}")
+
+
+def enum_members(root: Path) -> Dict[str, int]:
+    """``RejectReason`` member name -> definition line."""
+    return _enum_members_tree(
+        ast.parse((root / ENUM_FILE).read_text(encoding="utf-8"))
+    )
+
+
+def _reason_refs(tree: ast.AST) -> Set[str]:
+    """Every ``RejectReason.X`` attribute access under ``tree``."""
+    return {
+        n.attr
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Attribute)
+        and isinstance(n.value, ast.Name)
+        and n.value.id == "RejectReason"
+    }
+
+
+def _classifier_coverage_tree(tree: ast.AST) -> Set[str]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == CLASSIFIER_FUNC
+        ):
+            return _reason_refs(node)
+    raise AssertionError(
+        f"{CLASSIFIER_FUNC} not found in {CLASSIFIER_FILE}"
+    )
+
+
+def classifier_coverage(root: Path) -> Set[str]:
+    """Members referenced inside ``_classify_solver_reject``."""
+    return _classifier_coverage_tree(
+        ast.parse((root / CLASSIFIER_FILE).read_text(encoding="utf-8"))
+    )
+
+
+def repo_refs(root: Path) -> Set[str]:
+    """Members referenced anywhere in koordinator_tpu/ OUTSIDE the enum
+    definition file (attribution sites)."""
+    refs: Set[str] = set()
+    for f in sorted((root / "koordinator_tpu").rglob("*.py")):
+        if f == root / ENUM_FILE or not want_file(f):
+            continue
+        try:
+            refs |= _reason_refs(
+                ast.parse(f.read_text(encoding="utf-8"))
+            )
+        except SyntaxError:
+            pass  # unparsable files are another lint's problem
+    return refs
+
+
+def check(
+    root: Path,
+    exempt_table: Optional[Dict[str, str]] = None,
+    index: Optional[RepoIndex] = None,
+) -> List[Violation]:
+    """``exempt_table`` overrides :data:`EXEMPT` (the lint's own tests
+    scan synthetic repos whose enums the real table does not match).
+    ``index`` reuses a framework run's parse-once cache; without one
+    (the legacy shim path) the files are read directly."""
+    exemptions = EXEMPT if exempt_table is None else exempt_table
+    if index is not None:
+        enum_sf = index.file(ENUM_FILE)
+        cls_sf = index.file(CLASSIFIER_FILE)
+        if enum_sf is None or enum_sf.tree is None:
+            raise AssertionError(f"{ENUM_FILE} missing or unparsable")
+        if cls_sf is None or cls_sf.tree is None:
+            raise AssertionError(
+                f"{CLASSIFIER_FILE} missing or unparsable"
+            )
+        members = _enum_members_tree(enum_sf.tree)
+        covered = _classifier_coverage_tree(cls_sf.tree)
+        referenced = set()
+        for sf in index.package_files:
+            if sf.rel == ENUM_FILE or sf.tree is None:
+                continue
+            referenced |= _reason_refs(sf.tree)
+    else:
+        members = enum_members(root)
+        covered = classifier_coverage(root)
+        referenced = repo_refs(root)
+    out: List[Violation] = []
+    for name, line in sorted(members.items()):
+        in_classifier = name in covered
+        exempt = name in exemptions
+        if not in_classifier and not exempt:
+            out.append(
+                (
+                    ENUM_FILE,
+                    line,
+                    f"RejectReason.{name} has no "
+                    f"{CLASSIFIER_FUNC} arm and no exemption in "
+                    "tools/check_reject_reasons.py — wire its "
+                    "attribution or document its dedicated site",
+                )
+            )
+        elif in_classifier and exempt:
+            out.append(
+                (
+                    ENUM_FILE,
+                    line,
+                    f"RejectReason.{name} is covered by "
+                    f"{CLASSIFIER_FUNC} but still exempted — remove "
+                    "the stale exemption",
+                )
+            )
+        elif exempt and name not in referenced:
+            out.append(
+                (
+                    ENUM_FILE,
+                    line,
+                    f"RejectReason.{name} is exempted as attributed "
+                    "at a dedicated site, but nothing in "
+                    "koordinator_tpu/ references it — the site is "
+                    "gone (or never existed)",
+                )
+            )
+    for name in sorted(set(exemptions) - set(members)):
+        out.append(
+            (
+                SELF_FILE,
+                0,
+                f"exemption for unknown member RejectReason.{name}",
+            )
+        )
+    return out
+
+
+def main(argv: List[str]) -> int:
+    from .. import repo_root
+
+    root = Path(argv[0]).resolve() if argv else repo_root()
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}", file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} unattributed / stale reject reason"
+            f"{'' if len(violations) == 1 else 's'}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+@register
+class RejectReasonsPass(Pass):
+    name = "reject-reasons"
+    code = "RR"
+    description = (
+        "every RejectReason member has a classifier arm or a live "
+        "dedicated-site exemption"
+    )
+    legacy_cli = "tools/check_reject_reasons.py"
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        try:
+            violations = check(index.root, index=index)
+        except (AssertionError, OSError) as exc:
+            return [self.finding(0, ENUM_FILE, 0, str(exc))]
+        return [
+            self.finding(1, rel, line, msg)
+            for rel, line, msg in violations
+        ]

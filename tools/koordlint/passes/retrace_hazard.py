@@ -1,0 +1,276 @@
+"""Pass ``retrace-hazard`` (RH): static enforcement of the PR 8 solver
+observatory standing rule, which review previously carried by hand.
+
+* **RH001** — a HOST-DISPATCHED jit-wrapped function without the
+  ``_devprof.tracing`` trace-time hook: its (re)compiles are invisible
+  to the CompileLedger, so a steady-state retrace burns a bench round
+  before anyone notices. Jitted functions whose every call site sits
+  inside another jitted body are sub-jaxprs of that entry point — a
+  hook there would double-bill the outer trace, so none is required.
+* **RH002** — Python-level branching / ``int()`` / ``float()`` /
+  ``bool()`` / ``.item()`` / ``.tolist()`` / iteration on a TRACED
+  parameter inside a jitted body: a concretization error at best, a
+  silent per-value retrace at worst. ``x is None`` structure tests and
+  static argnames are exempt (None prunes at trace time).
+* **RH003** — a host-side dispatch of a jitted function outside a
+  signature-carrying ``dp.watch("<fn>", ...)`` context: a retrace fired
+  there cannot be attributed to the shape/flag delta that caused it.
+* **RH004** — a ``.watch(...)`` signature kwarg computed with a raw
+  ``len(...)``: the host signature mirror must carry the PADDED bucket
+  (``x.shape[0]`` of the lowered array, or the bucket variable), or
+  every batch-size wiggle reads as a distinct signature and the retrace
+  cause table turns to noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .. import (
+    Finding,
+    Pass,
+    RepoIndex,
+    ancestors,
+    call_name,
+    parent_map,
+    register,
+)
+from ..jitindex import (
+    JittedFn,
+    collect_jitted,
+    resolve_call,
+    resolve_targets,
+    traced_context_nodes,
+    traced_params,
+)
+
+#: host-forcing builtins on a traced value
+_FORCING_CALLS = frozenset({"int", "float", "bool"})
+#: host-forcing methods on a traced value
+_FORCING_METHODS = frozenset({"item", "tolist"})
+
+
+def _is_structure_test(node: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` (any operand shape) — a pytree
+    STRUCTURE test, resolved at trace time, not a traced-value branch."""
+    return isinstance(node, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+    )
+
+
+def _traced_names_in(expr: ast.AST, traced: Set[str]) -> List[ast.Name]:
+    """Traced-parameter Name loads in ``expr``, skipping structure
+    tests and ``.shape``/``.dtype``/``.ndim`` metadata reads (static
+    under jit)."""
+    hits: List[ast.Name] = []
+    skip: Set[ast.AST] = set()
+    for node in ast.walk(expr):
+        if node in skip:
+            continue
+        if _is_structure_test(node):
+            skip.update(ast.walk(node))
+            continue
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "shape", "dtype", "ndim", "size",
+        ):
+            skip.update(ast.walk(node.value))
+            continue
+        if isinstance(node, ast.Name) and node.id in traced:
+            hits.append(node)
+    return hits
+
+
+def _hazards_in_body(p: Pass, fn: JittedFn) -> List[Finding]:
+    traced = traced_params(fn)
+    out: List[Finding] = []
+    for node in ast.walk(fn.node):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            for hit in _traced_names_in(node.test, traced):
+                out.append(p.finding(
+                    2, fn.file, node.lineno,
+                    f"Python-level branch on traced parameter "
+                    f"{hit.id!r} inside jitted `{fn.name}` — "
+                    "concretization/retrace hazard (use jnp.where / "
+                    "lax.cond, or make it a static argname)",
+                ))
+        elif isinstance(node, ast.For):
+            it = node.iter
+            root = it
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in traced:
+                out.append(p.finding(
+                    2, fn.file, node.lineno,
+                    f"Python iteration over traced parameter "
+                    f"{root.id!r} inside jitted `{fn.name}` — the loop "
+                    "unrolls per element at trace time",
+                ))
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _FORCING_CALLS and any(
+                isinstance(a, ast.Name) and a.id in traced
+                for a in node.args
+            ):
+                out.append(p.finding(
+                    2, fn.file, node.lineno,
+                    f"host-forcing {name}() on a traced parameter "
+                    f"inside jitted `{fn.name}`",
+                ))
+            elif (
+                name in _FORCING_METHODS
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in traced
+            ):
+                out.append(p.finding(
+                    2, fn.file, node.lineno,
+                    f"host-forcing .{name}() on traced parameter "
+                    f"{node.func.value.id!r} inside jitted "
+                    f"`{fn.name}`",
+                ))
+    return out
+
+
+def _watch_names_in_withitems(stmt: ast.With) -> Set[str]:
+    """First-arg strings of every ``.watch("<fn>", ...)`` call reachable
+    in the with-items (the ``dp.watch(...) if dp is not None else
+    NULL_WATCH`` conditional form included)."""
+    names: Set[str] = set()
+    for item in stmt.items:
+        for node in ast.walk(item.context_expr):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "watch"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                names.add(node.args[0].value)
+    return names
+
+
+@register
+class RetraceHazardPass(Pass):
+    name = "retrace-hazard"
+    code = "RH"
+    description = (
+        "jitted entry points carry tracing hooks, watched bucketed "
+        "dispatches, and no traced-parameter host branching"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        jitted = collect_jitted(index)
+        by_file: Dict[str, List[JittedFn]] = {}
+        for j in jitted:
+            by_file.setdefault(j.file, []).append(j)
+
+        # RH002: traced-parameter hazards, every jitted body
+        seen_nodes: Set[int] = set()
+        for j in jitted:
+            if id(j.node) in seen_nodes:
+                continue
+            seen_nodes.add(id(j.node))
+            out.extend(_hazards_in_body(self, j))
+
+        # RH003: host dispatches outside a matching watch (and, as a
+        # byproduct, WHICH jitted fns are host-dispatched at all — the
+        # RH001 hook requirement applies to exactly those; a jit whose
+        # every call site is inside another jitted body is a sub-jaxpr
+        # of that entry point and must NOT carry its own hook)
+        host_dispatched: Set[int] = set()
+        targets = resolve_targets(index, jitted)
+        for sf in index.package_files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            local = targets.get(sf.rel, {})
+            scoped = [
+                j for j in by_file.get(sf.rel, []) if j.scope is not None
+            ]
+            if not local and not scoped and sf.rel not in by_file:
+                continue
+            parents = parent_map(tree)
+            traced_ctx = traced_context_nodes(
+                tree, by_file.get(sf.rel, [])
+            )
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                ):
+                    continue
+                anc = list(ancestors(node, parents))
+                j = resolve_call(node, local, scoped, anc)
+                if j is None:
+                    continue
+                if any(a in traced_ctx for a in anc):
+                    continue  # call happens at trace time, inlined
+                host_dispatched.add(id(j.node))
+                wanted = j.hook or j.name
+                watched = any(
+                    isinstance(a, ast.With)
+                    and wanted in _watch_names_in_withitems(a)
+                    for a in anc
+                )
+                if not watched:
+                    out.append(self.finding(
+                        3, sf.rel, node.lineno,
+                        f"host dispatch of jitted `{node.func.id}` "
+                        f"outside a dp.watch({wanted!r}, ...) window — "
+                        "retraces here have no signature to be "
+                        "attributed to (PR 8 standing rule)",
+                    ))
+
+        # RH001: host-dispatched jits must carry the trace-time hook
+        seen_nodes.clear()
+        for j in jitted:
+            if id(j.node) in seen_nodes:
+                continue
+            seen_nodes.add(id(j.node))
+            if j.hook is None and id(j.node) in host_dispatched:
+                out.append(self.finding(
+                    1, j.file, j.line,
+                    f"jitted solver entry point `{j.name}` carries no "
+                    "_devprof.tracing(...) trace-time hook — its "
+                    "(re)compiles are invisible to the CompileLedger "
+                    "(PR 8 standing rule)",
+                ))
+
+        # RH004: raw len() in watch signatures
+        for sf in index.package_files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "watch"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    continue
+                for kw in node.keywords:
+                    if kw.value is None:
+                        continue
+                    for sub in ast.walk(kw.value):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Name)
+                            and sub.func.id == "len"
+                        ):
+                            out.append(self.finding(
+                                4, sf.rel, node.lineno,
+                                f"watch({node.args[0].value!r}) "
+                                f"signature kwarg {kw.arg!r} carries a "
+                                "raw len() — pass the padded bucket "
+                                "(.shape[0] of the lowered array), or "
+                                "every batch-size wiggle reads as a "
+                                "retrace cause",
+                            ))
+                            break
+        return out
