@@ -1319,6 +1319,11 @@ def enforce_gangs(
     (``coscheduling/core/core.go:333`` only rejects the group in Strict
     mode).
     """
+    # no tracing hook on purpose: every call site is inside another
+    # jitted entry point's trace, so a hook here would double-bill each
+    # outer (re)trace in the CompileLedger — nested jits are sub-jaxprs
+    # of the entry point whose hook already fired (koordlint's
+    # retrace-hazard pass requires hooks on host-DISPATCHED jits only)
     p = pods.requests.shape[0]
     n = result.node_requested.shape[0]
     assignment = result.assignment

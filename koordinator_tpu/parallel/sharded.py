@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..obs import devprof as _devprof
+from ..obs.devprof import NULL_WATCH as _NULL_WATCH
 from ..ops.solver import (
     NodeState,
     PodBatch,
@@ -149,11 +150,16 @@ def sharded_assign(
     nodes: NodeState,
     params: SolverParams,
     max_rounds: int = 24,
+    devprof=None,
 ) -> SolveResult:
     """Run the round solver SPMD over the mesh.
 
     Pod arrays are sharded on dp, the node table on tp, params replicated.
     Output assignment is sharded on dp; node usage tensors on tp.
+
+    ``devprof`` (a :class:`~..obs.devprof.DevProf`) wraps the dispatch in
+    a signature-carrying watch window so mesh-path retraces land in the
+    CompileLedger with an attributable cause (PR 8 standing rule).
     """
     pod_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), _pod_spec())
     node_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), _node_spec())
@@ -187,7 +193,21 @@ def sharded_assign(
     pods = jax.device_put(pods, pod_sh)
     nodes = jax.device_put(nodes, node_sh)
     params = jax.device_put(params, param_sh)
-    return fn(pods, nodes, params)
+    with (
+        devprof.watch(
+            "sharded_assign",
+            dp=mesh.shape["dp"],
+            tp=mesh.shape["tp"],
+            bucket=pods.requests.shape[0],
+            n=nodes.allocatable.shape[0],
+            max_rounds=max_rounds,
+        )
+        if devprof is not None
+        else _NULL_WATCH
+    ) as w:
+        out = fn(pods, nodes, params)
+        w.result(out)
+    return out
 
 
 def sharded_solve_stream(
@@ -197,6 +217,7 @@ def sharded_solve_stream(
     params: SolverParams,
     max_rounds: int = 24,
     approx_topk: bool = False,
+    devprof=None,
 ):
     """Pipelined multi-batch solve, SPMD over the mesh: batch axis
     unsharded (scan), pod rows on dp, node table on tp. This is the
@@ -204,6 +225,8 @@ def sharded_solve_stream(
     threaded on device, collectives riding ICI.
 
     Returns ``(assignments [B, P], final NodeState, placed [B], quotas)``.
+    ``devprof`` wraps the dispatch in a watch window (see
+    :func:`sharded_assign`).
     """
     from ..ops.solver import solve_stream
 
@@ -233,7 +256,23 @@ def sharded_solve_stream(
     pods_stacked = jax.device_put(pods_stacked, pod_sh)
     nodes = jax.device_put(nodes, node_sh)
     params = jax.device_put(params, param_sh)
-    return fn(pods_stacked, nodes, params)
+    with (
+        devprof.watch(
+            "sharded_solve_stream",
+            dp=mesh.shape["dp"],
+            tp=mesh.shape["tp"],
+            batches=pods_stacked.requests.shape[0],
+            bucket=pods_stacked.requests.shape[1],
+            n=nodes.allocatable.shape[0],
+            max_rounds=max_rounds,
+            approx_topk=approx_topk,
+        )
+        if devprof is not None
+        else _NULL_WATCH
+    ) as w:
+        out = fn(pods_stacked, nodes, params)
+        w.result(out)
+    return out
 
 
 def _pad_nodes(nodes: NodeState, pad: int) -> NodeState:
@@ -268,6 +307,7 @@ def shard_map_nominate(
     params: SolverParams,
     topk: int = 4,
     nomination_jitter: float = 4.0,
+    devprof=None,
 ):
     """Hand-scheduled nomination for node tables too large for one chip's
     HBM: each device holds a 1/tp shard of the node table, computes the
@@ -381,10 +421,30 @@ def shard_map_nominate(
         sel_idx = jnp.take_along_axis(gidx_all, sel_pos, axis=1)
         return sel_neg, sel_idx
 
-    return nominate(
-        jax.device_put(pods, jax.tree.map(lambda _: NamedSharding(mesh, P()), pods)),
-        jax.device_put(
-            nodes, jax.tree.map(lambda s: NamedSharding(mesh, s), node_specs)
-        ),
-        params,
-    )
+    with (
+        devprof.watch(
+            "shard_map_nominate",
+            tp=tp,
+            bucket=p,
+            n=n,
+            topk=topk,
+            nomination_jitter=nomination_jitter,
+        )
+        if devprof is not None
+        else _NULL_WATCH
+    ) as w:
+        out = nominate(
+            jax.device_put(
+                pods,
+                jax.tree.map(lambda _: NamedSharding(mesh, P()), pods),
+            ),
+            jax.device_put(
+                nodes,
+                jax.tree.map(
+                    lambda s: NamedSharding(mesh, s), node_specs
+                ),
+            ),
+            params,
+        )
+        w.result(out)
+    return out

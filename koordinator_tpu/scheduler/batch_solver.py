@@ -28,6 +28,7 @@ from ..chaos import NULL_INJECTOR, FaultInjector
 from ..core.journal import JournalWriteError, StaleEpochError
 from ..core.snapshot import ClusterSnapshot, SnapshotConfig, bucket_size
 from ..obs import RejectReason, RejectStage, report_exception
+from ..obs import devprof as _devprof
 from ..obs.devprof import NULL_WATCH as _NULL_WATCH
 from ..ops import estimator
 from ..ops.solver import (
@@ -156,6 +157,7 @@ def _chain_commit_deltas(cur, nodes_t, result):
     """Carry only the solver's commit deltas onto the untransformed base
     state (one fused dispatch): a node transformer's rewrite applies
     exactly once per chunk, never compounded across the pipeline."""
+    _devprof.tracing("_chain_commit_deltas")
     return cur.replace(
         requested=cur.requested + (result.node_requested - nodes_t.requested),
         estimated_used=cur.estimated_used
@@ -177,6 +179,7 @@ def _apply_commit_deltas_donated(
     allocating three fresh ones per chunk. Chunk 0's carry aliases the
     device-RESIDENT arrays (re-read every cycle) and must go through the
     non-donating :func:`_chain_commit_deltas`."""
+    _devprof.tracing("_apply_commit_deltas_donated")
     return (
         cur_req + (r_req - t_req),
         cur_est + (r_est - t_est),
@@ -723,8 +726,9 @@ class BatchScheduler:
                 w.result(state)
         if dp is not None:
             # donation-effectiveness: the donated resident pytree must be
-            # DEAD after the scatter (a live leaf means XLA copied)
-            dp.census.check_donation(cached_state)
+            # DEAD after the scatter (a live leaf means XLA copied) — the
+            # census reads only leaf deadness, never buffer contents
+            dp.census.check_donation(cached_state)  # koordlint: disable=donation-safety
         reg.get("solver_h2d_rows_total").inc(float(b))
         reg.get("solver_state_cache_hits_total").labels(table=table).inc()
         return state
@@ -2707,21 +2711,39 @@ class BatchScheduler:
                 # cycle), and a transformer may pass some carry leaves
                 # through unchanged (aliased) — donation would invalidate
                 # a buffer somebody still reads, so take the copying form
-                cur = _chain_commit_deltas(cur, nodes_t, result)
+                with (
+                    dp.watch(
+                        "_chain_commit_deltas", stage="overlap",
+                        n=cur.requested.shape[0],
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    cur = _chain_commit_deltas(cur, nodes_t, result)
+                    w.result(cur)
             else:
                 # steady chain: the carry arrays belong exclusively to the
                 # chain — update them in place (donated)
-                req, est, prod = _apply_commit_deltas_donated(
-                    cur.requested,
-                    cur.estimated_used,
-                    cur.prod_used,
-                    nodes_t.requested,
-                    nodes_t.estimated_used,
-                    nodes_t.prod_used,
-                    result.node_requested,
-                    result.node_estimated_used,
-                    result.node_prod_used,
-                )
+                with (
+                    dp.watch(
+                        "_apply_commit_deltas_donated", stage="overlap",
+                        n=cur.requested.shape[0],
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    req, est, prod = _apply_commit_deltas_donated(
+                        cur.requested,
+                        cur.estimated_used,
+                        cur.prod_used,
+                        nodes_t.requested,
+                        nodes_t.estimated_used,
+                        nodes_t.prod_used,
+                        result.node_requested,
+                        result.node_estimated_used,
+                        result.node_prod_used,
+                    )
+                    w.result(req)
                 cur = cur.replace(
                     requested=req, estimated_used=est, prod_used=prod
                 )
@@ -2913,14 +2935,35 @@ class BatchScheduler:
         valid = np.zeros((b,), bool)
         valid[: len(sub)] = True
         idx_d, valid_d = jnp.asarray(idx), jnp.asarray(valid)
+        dp = self.devprof
         with self.extender.tracer.span(
             "snapshot:constraint_window_gather", cat="scheduler",
             window=len(sub),
         ):
             if numa_state is not None:
-                numa_state = gather_rows(numa_state, idx_d, valid_d)
+                with (
+                    dp.watch(
+                        "gather_rows", stage="snapshot",
+                        kind="transfer", table="numa", window=b,
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    numa_state = gather_rows(numa_state, idx_d, valid_d)
+                    w.result(numa_state)
             if device_state is not None:
-                device_state = gather_rows(device_state, idx_d, valid_d)
+                with (
+                    dp.watch(
+                        "gather_rows", stage="snapshot",
+                        kind="transfer", table="devices", window=b,
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    device_state = gather_rows(
+                        device_state, idx_d, valid_d
+                    )
+                    w.result(device_state)
         self._constraint_window_cache = (key, (numa_state, device_state))
         return numa_state, device_state
 

@@ -266,3 +266,47 @@ def test_mesh_mode_pipelined_multichunk():
     b = {p.meta.uid: n for p, n in meshed.bound}
     assert len(a) == len(pods)
     assert a == b
+
+
+def test_sharded_dispatch_watch_windows_feed_the_ledger():
+    """The devprof watch plumbing on the sharded dispatches (koordlint
+    retrace-hazard RH003 fix): every mesh-path dispatch lands in the
+    CompileLedger as a watched, signature-carrying call, and the watched
+    path's outputs are identical to the unwatched path's. shard_map
+    partitions on every toolchain; the GSPMD entry points get the same
+    assertion when this jaxlib's partitioner can compile them."""
+    from koordinator_tpu.obs.devprof import DevProf
+    from koordinator_tpu.parallel.sharded import shard_map_nominate
+
+    mesh = make_mesh(8)
+    p, n = 16, 16 * mesh.shape["tp"]
+    pods, nodes, params, _ = make_fixture(p=p, n=n, seed=51, base_util=0.2)
+
+    dp = DevProf().install()
+    try:
+        neg, idx = shard_map_nominate(
+            mesh, pods, nodes, params, topk=4, devprof=dp
+        )
+        neg2, idx2 = shard_map_nominate(mesh, pods, nodes, params, topk=4)
+        np.testing.assert_array_equal(np.asarray(idx), np.asarray(idx2))
+        np.testing.assert_array_equal(np.asarray(neg), np.asarray(neg2))
+        row = dp.ledger.report()["functions"]["shard_map_nominate"]
+        assert row["calls"] == 1 and row["traces"] >= 1
+        assert row["signatures"] == 1 and row["compile_seconds"] > 0
+        cause = next(
+            c
+            for c in dp.ledger.report()["recent_causes"]
+            if c.get("watched_fn") == "shard_map_nominate"
+        )
+        assert cause["delta"] == {"first_call": True}
+
+        if _gspmd_assign_compiles():
+            out = sharded_assign(mesh, pods, nodes, params, devprof=dp)
+            want = sharded_assign(mesh, pods, nodes, params)
+            np.testing.assert_array_equal(
+                np.asarray(out.assignment), np.asarray(want.assignment)
+            )
+            row = dp.ledger.report()["functions"]["sharded_assign"]
+            assert row["calls"] == 1 and row["traces"] >= 1
+    finally:
+        dp.uninstall()
