@@ -849,6 +849,16 @@ def run_chaos_soak(
     pod_seq = 0
     crash_cycle = max(2, cycles // 3)
     deadline_cycle = max(3, cycles // 2)
+    # chaos-coverage (koordlint chaos-coverage pass): the remaining
+    # MAIN-THREAD fault domains ride fixed-cycle arms — no rng stream is
+    # consumed, so every historical seeded schedule stays bit-identical.
+    # (Points that fire on background threads — informer, fetch worker —
+    # stay out: they would race the same-seed fault-trace order, and are
+    # exempted to their dedicated fault tests instead.)
+    ladder_cycle = max(1, cycles // 4)       # full fallback ladder
+    sync_delay_cycle = max(1, cycles // 6)   # channel latency injection
+    stale_commit_cycle = max(2, cycles // 5)     # ha: fenced commit
+    journal_fault_cycle = max(4, (2 * cycles) // 5)  # ha: append refusal
     # HA leg (failover PR): one scheduled kill-restart well after the
     # other fault domains have fired, leader flaps from the rng_ha stream
     restart_cycle = max(6, (3 * cycles) // 5) if ha else None
@@ -1003,6 +1013,22 @@ def run_chaos_soak(
                 chaos.arm("pipeline.worker_stall", times=1)       # serial degrade
             if ha and rng_ha.random() < 0.05:
                 chaos.arm("leader.lost", times=1)                 # leader flap
+            if cycle == ladder_cycle:
+                # both device levels fail in one cycle: level 0 demotes
+                # to the per-chunk path, whose own armed fault demotes to
+                # the numpy host reference — the full ladder under soak
+                chaos.arm("solver.dispatch", error=RuntimeError, times=1)
+                chaos.arm(
+                    "solver.dispatch_chunk", error=RuntimeError, times=1
+                )
+            if use_channel and cycle == sync_delay_cycle:
+                chaos.arm("channel.sync.delay", latency_s=0.01, times=1)
+            if ha and cycle == stale_commit_cycle:
+                chaos.arm("leader.stale_commit", times=1)  # fenced, no retry charge
+            if ha and cycle == journal_fault_cycle:
+                # journal-before-mutate: the refused append rejects the
+                # chunk un-mutated (JOURNAL_WRITE_FAILED), pods retry
+                chaos.arm("journal.write_fail", times=1)
             if cycle == crash_cycle:
                 chaos.arm("commit.crash", error=RuntimeError, times=1)
             if ha and cycle == restart_cycle:

@@ -689,6 +689,10 @@ class TestInformerBackoff:
         )
         tracker.upsert("a", object())
         chaos.arm("informer.watch_closed", times=4)
+        # the dedicated arm for the re-list latency point (chaos-coverage
+        # exemption: informer points fire on informer threads, so they
+        # cannot ride the deterministic soak schedule)
+        chaos.arm("informer.relist.delay", latency_s=0.01, times=2)
         inf.start()
         try:
             deadline = time.monotonic() + 5.0
@@ -696,6 +700,7 @@ class TestInformerBackoff:
                 time.sleep(0.01)
             assert inf.relists >= 5   # initial + 4 injected disconnects
             assert inf.backoff_total_s > 0.0
+            assert chaos.spec("informer.relist.delay").fired >= 1
             # after the injection budget is spent the stream stabilizes
             deadline = time.monotonic() + 5.0
             while not health.ok() and time.monotonic() < deadline:

@@ -258,6 +258,43 @@ def test_daemon_collect_and_report(tmp_path):
     agent.qos_tick(now=1006.0)
 
 
+def test_daemon_tick_chaos_points(tmp_path):
+    """Dedicated fault test for the koordlet tick chaos points (the
+    scheduler soak runs no koordlet daemon, so these cannot ride its
+    fault schedule — the chaos-coverage lint's exemption names THIS
+    test). Latency injection rides the injectable sleep; an armed error
+    propagates to the wall-clock loop's retry/backoff, so a tick raise
+    must surface, not wedge."""
+    from koordinator_tpu.chaos import ChaosError, FaultInjector
+
+    slept = []
+    chaos = FaultInjector(seed=3, sleep=slept.append)
+    cfg = KoordletConfig(
+        node_name="test-node",
+        cgroup_root=str(tmp_path),
+        report_interval_s=0.0,
+        aggregate_window_s=1000.0,
+    )
+    agent = Koordlet(cfg, chaos=chaos)
+    chaos.arm("koordlet.collect_tick", latency_s=0.25, times=1)
+    agent.collect_tick(now=1000.0)     # latency consumed, tick completes
+    assert slept == [0.25]
+    assert chaos.spec("koordlet.collect_tick").fired == 1
+    agent.collect_tick(now=1001.0)     # budget spent: clean tick
+
+    chaos.arm("koordlet.qos_tick", error=ChaosError, times=1)
+    agent.update_pods([be_pod("b1")])
+    with pytest.raises(ChaosError):
+        agent.qos_tick(now=1002.0)
+    out = agent.qos_tick(now=1003.0)   # next tick recovers
+    assert isinstance(out, dict)
+    # determinism contract: the injected faults land on the trace
+    assert [(p, k) for _s, p, k in chaos.trace] == [
+        ("koordlet.collect_tick", "latency"),
+        ("koordlet.qos_tick", "error"),
+    ]
+
+
 def test_write_failure_does_not_crash(tmp_path):
     """A cgroup write rejection must be audited, not raised."""
     ex = rex.ResourceExecutor(str(tmp_path))
