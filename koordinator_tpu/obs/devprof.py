@@ -214,10 +214,10 @@ class CompileLedger:
                  max_causes: int = 64):
         self.clock = clock
         self._lock = threading.Lock()
-        self._fns: Dict[str, _FnStats] = {}
+        self._fns: Dict[str, _FnStats] = {}  # guarded-by: self._lock
         #: recent retrace-cause records (which delta triggered each trace)
-        self._causes: deque = deque(maxlen=max_causes)
-        self._steady_mark: Optional[Dict[str, int]] = None
+        self._causes: deque = deque(maxlen=max_causes)  # guarded-by: self._lock
+        self._steady_mark: Optional[Dict[str, int]] = None  # guarded-by: self._lock
         self._compiles_counter = None
         self._compile_seconds = None
         if registry is not None:
@@ -509,10 +509,13 @@ class DevProf:
         self.clock = clock
         self.ledger = CompileLedger(registry=registry, clock=clock)
         self.census = DeviceMemoryCensus(registry=registry)
+        # NOT lock-guarded by design: a bounded deque with GIL-atomic
+        # appends — the capture hot path must not serialize on the
+        # capture-control lock
         self.device_events: deque = deque(maxlen=self.MAX_DEVICE_EVENTS)
-        self._capture_remaining = 0
-        self._capturing = False
-        self._cycle_id = 0
+        self._capture_remaining = 0  # guarded-by: self._lock
+        self._capturing = False  # guarded-by: self._lock
+        self._cycle_id = 0  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     # -- install / watch --
@@ -562,8 +565,11 @@ class DevProf:
         }
 
     def cycle_begin(self, cycle_id: int) -> None:
-        self._cycle_id = int(cycle_id)
+        # the cycle stamp moves WITH the capture arm-check (koordlint
+        # guarded-by finding GB001: the write raced a concurrently
+        # armed /debug/profile capture outside the lock)
         with self._lock:
+            self._cycle_id = int(cycle_id)
             if self._capture_remaining > 0:
                 self._capturing = True
 

@@ -53,10 +53,10 @@ class FlightRecorder:
         self.shard = shard
         self.incarnation = incarnation
         self.clock = clock
-        self._ring: deque = deque(maxlen=capacity)
+        self._ring: deque = deque(maxlen=capacity)  # guarded-by: self._lock
         self._lock = threading.Lock()
-        self._seq = 0
-        self._since_rewrite = 0
+        self._seq = 0  # guarded-by: self._lock
+        self._since_rewrite = 0  # guarded-by: self._lock
         # adopt the predecessor's tail: this IS the crash-survival story
         tail = sorted(self.store.load(), key=lambda r: r.get("seq", 0))
         for rec in tail[-capacity:]:

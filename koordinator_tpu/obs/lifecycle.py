@@ -102,7 +102,7 @@ class _ShardBuffer:
     def __init__(self):
         self.lock = threading.Lock()
         #: uid -> [(global seq, event), ...] in this shard's append order
-        self.events: Dict[str, List[Tuple[int, LifecycleEvent]]] = {}
+        self.events: Dict[str, List[Tuple[int, LifecycleEvent]]] = {}  # guarded-by: self.lock
 
 
 class PodLifecycle:
@@ -137,14 +137,14 @@ class PodLifecycle:
     ):
         self.clock = clock
         #: shard id (-1 = shardless submit lane) -> its buffer
-        self._bufs: Dict[int, _ShardBuffer] = {}
+        self._bufs: Dict[int, _ShardBuffer] = {}  # guarded-by: self._lock
         #: every known uid in FIRST-SIGHT order (dict-as-ordered-set);
         #: the max_pods bound is over this registry
-        self._uids: Dict[str, None] = {}
+        self._uids: Dict[str, None] = {}  # guarded-by: self._lock
         #: completed uids in COMPLETION order (dict-as-ordered-set), so
         #: eviction under the max_pods bound drops the oldest finished
         #: timelines first, deterministically
-        self._done: Dict[str, None] = {}
+        self._done: Dict[str, None] = {}  # guarded-by: self._lock
         #: STRUCTURE lock: buffer creation, uid registry, done set,
         #: eviction. Never held while a caller holds a buffer lock
         #: (lock order is always structure → buffer).

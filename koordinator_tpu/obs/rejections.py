@@ -107,7 +107,7 @@ class RejectionLog:
 
     def __init__(self, counter=None, capacity: int = 4096):
         self.counter = counter
-        self._ring: deque = deque(maxlen=capacity)
+        self._ring: deque = deque(maxlen=capacity)  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def record(

@@ -92,7 +92,7 @@ class SloTracker:
         self.targets: Dict[str, SloTarget] = {
             t.name: t for t in (targets or default_targets())
         }
-        self._series: Dict[Tuple[int, str], _Series] = {}
+        self._series: Dict[Tuple[int, str], _Series] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
         self.counter = None
         if registry is not None:

@@ -206,7 +206,7 @@ class Tracer:
         self.enabled = enabled
         self.clock = clock
         self.epoch = clock()
-        self._ring: deque = deque(maxlen=capacity)
+        self._ring: deque = deque(maxlen=capacity)  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._local = threading.local()
 

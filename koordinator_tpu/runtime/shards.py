@@ -98,7 +98,7 @@ class Membership:
     def __init__(self, ttl_s: float, clock: Callable[[], float] = _time.time):
         self.ttl_s = float(ttl_s)
         self._clock = clock
-        self._beats: Dict[str, float] = {}
+        self._beats: Dict[str, float] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def heartbeat(self, member: str) -> None:
@@ -162,7 +162,7 @@ class ShardFabric:
         #: retention surface (tracer ring, flight recorder, lifecycle
         #: eviction): the oldest seams fall off a full deque, so a
         #: fleet rebalancing for months cannot grow the fabric.
-        self.handoff_log: Deque[dict] = deque(maxlen=int(handoff_log_cap))
+        self.handoff_log: Deque[dict] = deque(maxlen=int(handoff_log_cap))  # guarded-by: self.handoff_lock
         #: guards the seam log's find-then-close read-modify-write: the
         #: log is shared across incarnations (possibly on different
         #: threads) and a deque raises if mutated mid-iteration

@@ -77,7 +77,8 @@ class _PrepareWorker:
     def __init__(self, sched: BatchScheduler):
         self.sched = sched
         self._req: "_queue.Queue" = _queue.Queue()
-        self._results: Dict[int, Optional[PreparedCycle]] = {}
+        #: worker thread writes results, pump thread collects them
+        self._results: Dict[int, Optional[PreparedCycle]] = {}  # guarded-by: self._cond
         self._cond = _threading.Condition()
         self._seq = 0
         self._thread: Optional[_threading.Thread] = None
