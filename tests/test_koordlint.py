@@ -1,0 +1,881 @@
+"""Tier-1 enforcement of the koordlint static-analysis framework.
+
+Replaces the three standalone lint test modules
+(``test_exception_sites_lint``, ``test_fence_boundaries_lint``,
+``test_reject_reasons_lint``) with one per-pass-parametrized suite:
+
+* the CURRENT TREE is clean under every registered pass (the framework's
+  acceptance bar: ``python -m tools.koordlint`` exits 0);
+* every pass FAILS on its seeded-violation fixture (a lint that cannot
+  fail enforces nothing);
+* golden migration — the three legacy lints, now registered passes,
+  produce verdicts identical to their standalone CLIs;
+* the suppression syntax works, and unused/unknown suppressions are
+  themselves findings;
+* generated ``*_pb2.py`` files and ``__pycache__`` are excluded from
+  every walk;
+* the structural self-checks the old modules carried (pinned guarded
+  append set, the scanner really sees the real commit boundary, the
+  reject-reason exemption table splits the enum exactly).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tools.koordlint import (  # noqa: E402
+    RepoIndex,
+    all_passes,
+    run as lint_run,
+)
+from tools.koordlint.__main__ import main as cli_main  # noqa: E402
+from tools.koordlint import jitindex  # noqa: E402
+from tools.koordlint.passes import (  # noqa: E402
+    chaos_coverage,
+    exception_sites,
+    fence_boundaries,
+    reject_reasons,
+)
+
+PASSES = all_passes()
+PASS_NAMES = sorted(PASSES)
+
+
+def _write_tree(root: Path, files: dict) -> Path:
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return root
+
+
+def _run_pass(root: Path, name: str):
+    return PASSES[name].run(RepoIndex(root))
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: the tree is clean, per pass and end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pass_name", PASS_NAMES)
+def test_current_tree_is_clean_per_pass(pass_name):
+    report = lint_run(ROOT, select=[pass_name])
+    assert not report.findings, "\n".join(
+        f.render() for f in report.findings
+    )
+
+
+def test_cli_exits_zero_on_tree(capsys):
+    rc = cli_main([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 finding(s)" in out and "8 passes" in out
+
+
+# ---------------------------------------------------------------------------
+# seeded-violation fixtures: every pass must be able to FAIL
+# ---------------------------------------------------------------------------
+
+#: pass name -> (fixture tree, finding code that must appear)
+FIXTURES = {
+    "exception-sites": (
+        {
+            "koordinator_tpu/mod.py": """
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+            """,
+        },
+        "EX001",
+    ),
+    "fence-boundaries": (
+        {
+            "koordinator_tpu/mod.py": """
+            def commit(jnl, epoch, cid, planned):
+                jnl.append_intent(epoch, cid, planned)
+            """,
+        },
+        "FB001",
+    ),
+    "reject-reasons": (
+        {
+            "koordinator_tpu/obs/rejections.py": """
+            import enum
+
+            class RejectReason(str, enum.Enum):
+                INSUFFICIENT_RESOURCES = "insufficient_resources"
+                BRAND_NEW_REASON = "brand_new_reason"
+            """,
+            "koordinator_tpu/scheduler/batch_solver.py": """
+            from ..obs.rejections import RejectReason
+
+            class BatchScheduler:
+                def _classify_solver_reject(self, pod, req, est):
+                    return RejectReason.INSUFFICIENT_RESOURCES
+            """,
+        },
+        "RR001",
+    ),
+    "retrace-hazard": (
+        {
+            "koordinator_tpu/ops/foo.py": """
+            import jax
+
+            @jax.jit
+            def hookless(x):
+                if x > 0:
+                    return x
+                return -x
+
+            def dispatch(x):
+                return hookless(x)
+            """,
+        },
+        "RH001",
+    ),
+    "donation-safety": (
+        {
+            "koordinator_tpu/ops/foo.py": """
+            import functools
+            import jax
+            from koordinator_tpu.obs import devprof as _devprof
+
+            @functools.partial(jax.jit, donate_argnums=0)
+            def donor(x):
+                _devprof.tracing("donor")
+                return x + 1
+
+            def caller(x):
+                y = donor(x)
+                return x + y
+            """,
+        },
+        "DS001",
+    ),
+    "guarded-by": (
+        {
+            "koordinator_tpu/obs/t.py": """
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: self._lock
+
+                def good(self):
+                    with self._lock:
+                        self._items.append(1)
+
+                def bad(self):
+                    self._items.append(2)
+            """,
+        },
+        "GB001",
+    ),
+    "chaos-coverage": (
+        {
+            "koordinator_tpu/mod.py": """
+            class C:
+                def f(self):
+                    self.chaos.fire("domain.lonely")
+            """,
+        },
+        "CC001",
+    ),
+    "bench-verdicts": (
+        {
+            "tools/bench_regress.py": """
+            VERDICTS = ("OK",)
+
+            def compare():
+                return [{"scenario": "s", "verdict": "WAT"},
+                        {"scenario": "t", "verdict": "OK"}]
+            """,
+        },
+        "BV001",
+    ),
+}
+
+
+def test_every_pass_has_a_fixture():
+    assert set(FIXTURES) == set(PASS_NAMES)
+
+
+@pytest.mark.parametrize("pass_name", PASS_NAMES)
+def test_pass_fails_on_seeded_violation(pass_name, tmp_path):
+    files, expected = FIXTURES[pass_name]
+    _write_tree(tmp_path, files)
+    findings = _run_pass(tmp_path, pass_name)
+    assert expected in _codes(findings), (
+        f"{pass_name} did not flag its seeded violation: "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_retrace_fixture_catches_all_three_hazards(tmp_path):
+    files, _ = FIXTURES["retrace-hazard"]
+    _write_tree(tmp_path, files)
+    codes = _codes(_run_pass(tmp_path, "retrace-hazard"))
+    # hookless (RH001), traced-param branch (RH002), unwatched host
+    # dispatch (RH003)
+    assert {"RH001", "RH002", "RH003"} <= codes
+
+
+def test_retrace_watch_len_signature_flagged(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/ops/foo.py": """
+        def site(dp, batch):
+            with dp.watch("assign", n=len(batch)) as w:
+                w.result(None)
+        """,
+    })
+    assert "RH004" in _codes(_run_pass(tmp_path, "retrace-hazard"))
+
+
+def test_retrace_nested_jit_needs_no_hook(tmp_path):
+    # a jit whose only call site is inside another jitted body is a
+    # sub-jaxpr of that entry point: no hook required, no RH001
+    _write_tree(tmp_path, {
+        "koordinator_tpu/ops/foo.py": """
+        import jax
+        from koordinator_tpu.obs import devprof as _devprof
+
+        @jax.jit
+        def inner(x):
+            return x * 2
+
+        @jax.jit
+        def outer(x):
+            _devprof.tracing("outer")
+            return inner(x)
+
+        def dispatch(dp, x):
+            with dp.watch("outer", n=x.shape[0]) as w:
+                w.result(outer(x))
+        """,
+    })
+    assert _run_pass(tmp_path, "retrace-hazard") == []
+
+
+def test_retrace_static_argnames_and_is_none_exempt(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/ops/foo.py": """
+        import functools
+        import jax
+        from koordinator_tpu.obs import devprof as _devprof
+
+        @functools.partial(jax.jit, static_argnames=("flag",))
+        def solver(x, mask=None, flag=False):
+            _devprof.tracing("solver")
+            if mask is None:
+                return x
+            if flag:
+                return x * 2
+            return x * mask
+        """,
+    })
+    assert _run_pass(tmp_path, "retrace-hazard") == []
+
+
+def test_donation_rebind_is_clean_and_self_attr_flagged(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/ops/foo.py": """
+        import functools
+        import jax
+        from koordinator_tpu.obs import devprof as _devprof
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def donor(x):
+            _devprof.tracing("donor")
+            return x + 1
+
+        def clean(x):
+            x = donor(x)
+            return x
+
+        class C:
+            def racy(self):
+                self.buf = donor(self.buf)
+        """,
+    })
+    findings = _run_pass(tmp_path, "donation-safety")
+    assert _codes(findings) == {"DS002"}   # the rebind path stays clean
+    assert any("self.buf" in f.message for f in findings)
+
+
+def test_guarded_by_cross_object_holds_and_locked_suffix(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/obs/t.py": """
+        import threading
+
+        class Fabric:
+            def __init__(self):
+                self.handoff_lock = threading.Lock()
+                self.seams = []  # guarded-by: self.handoff_lock
+
+        class User:
+            def good(self, fabric):
+                with fabric.handoff_lock:
+                    fabric.seams.append(1)
+
+            def bad(self, fabric):
+                fabric.seams.append(2)
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}  # guarded-by: self._lock
+
+            def _evict_locked(self):
+                self._items.clear()      # caller-holds convention
+
+            def helper(self):  # koordlint: holds=self._lock
+                self._items["k"] = 1
+        """,
+    })
+    findings = _run_pass(tmp_path, "guarded-by")
+    assert _codes(findings) == {"GB002"}
+    assert len(findings) == 1 and "fabric.seams" in findings[0].message
+
+
+def test_guarded_by_two_annotated_classes_any_lock_satisfies(tmp_path):
+    # two classes annotate the same attr name with DIFFERENT locks: a
+    # cross-object writer holding either rebased lock passes (types are
+    # unknowable statically); holding neither is still flagged
+    _write_tree(tmp_path, {
+        "koordinator_tpu/obs/t.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ring = []  # guarded-by: self._lock
+
+        class B:
+            def __init__(self):
+                self._ring_lock = threading.Lock()
+                self._ring = []  # guarded-by: self._ring_lock
+
+        class User:
+            def good_a(self, obj):
+                with obj._lock:
+                    obj._ring.append(1)
+
+            def good_b(self, obj):
+                with obj._ring_lock:
+                    obj._ring.append(2)
+
+            def bad(self, obj):
+                obj._ring.append(3)
+        """,
+    })
+    findings = _run_pass(tmp_path, "guarded-by")
+    assert len(findings) == 1 and findings[0].code == "GB002"
+    assert "obj._ring" in findings[0].message
+
+
+def test_chaos_coverage_stale_schedule_entry(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        class C:
+            def f(self):
+                self.chaos.fire("domain.covered")
+        """,
+        "koordinator_tpu/sim/longrun.py": """
+        def soak(chaos):
+            chaos.arm("domain.covered", times=1)
+            chaos.arm("ghost.point", times=1)
+        """,
+    })
+    findings = _run_pass(tmp_path, "chaos-coverage")
+    assert "CC002" in _codes(findings)
+    assert any("ghost.point" in f.message for f in findings)
+
+
+def test_chaos_coverage_fstring_pattern_matches(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        class C:
+            def f(self, name):
+                self.chaos.fire(f"channel.{name}.drop")
+        """,
+        "koordinator_tpu/sim/longrun.py": """
+        def soak(chaos):
+            chaos.arm("channel.sync.drop", times=1)
+        """,
+    })
+    findings = _run_pass(tmp_path, "chaos-coverage")
+    assert "CC001" not in _codes(findings)
+    assert "CC002" not in _codes(findings)
+
+
+# ---------------------------------------------------------------------------
+# migrated edge cases (carried from the deleted lint test modules — the
+# behaviors golden identity depends on must stay directly pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_exception_sites_bare_and_tuple_forms(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        def f():
+            try:
+                g()
+            except:
+                x = 1
+            try:
+                g()
+            except (ValueError, Exception) as exc:
+                log(exc)
+        """,
+    })
+    findings = _run_pass(tmp_path, "exception-sites")
+    assert len(findings) == 2   # bare except + tuple form both flagged
+
+
+def test_exception_sites_accepts_report_reraise_helper_and_narrow(
+    tmp_path,
+):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        def f(self):
+            try:
+                g()
+            except Exception as exc:
+                report_exception("site", exc)
+            try:
+                g()
+            except Exception:
+                raise
+            try:
+                g()
+            except Exception as exc:
+                self._note_solver_failure(0, exc)
+            try:
+                g()
+            except ValueError:
+                pass
+        """,
+    })
+    assert _run_pass(tmp_path, "exception-sites") == []
+
+
+def test_fence_nested_closure_does_not_leak_check(tmp_path):
+    # a fence check inside a nested def does not guard the outer frame
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        def outer(self, jnl, epoch, cid, planned):
+            def gate():
+                self.fence.check(epoch)
+            jnl.append_intent(epoch, cid, planned)
+        """,
+    })
+    assert len(_run_pass(tmp_path, "fence-boundaries")) == 1
+
+
+def test_fence_accepts_checks_and_forget_is_exempt(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        def commit(self, jnl, epoch, cid, planned):
+            self.fence.check(epoch)
+            jnl.append_intent(epoch, cid, planned)
+
+        def commit2(self, jnl, epoch, cid, entries):
+            if self._fence_stale() is not None:
+                return
+            jnl.append_bind(epoch, cid, entries)
+
+        def commit3(self, fabric, jnl, s, epoch, cid, entries):
+            fabric.fences[s].check(epoch)
+            jnl.append_bind(epoch, cid, entries)
+
+        def release(jnl, cid, uid):
+            jnl.append_forget(None, cid, [uid])
+        """,
+    })
+    assert _run_pass(tmp_path, "fence-boundaries") == []
+
+
+def _rr_repo(tmp_path, members, classifier_body, extra=""):
+    files = {
+        "koordinator_tpu/obs/rejections.py": (
+            "import enum\n\nclass RejectReason(str, enum.Enum):\n"
+            + "".join(f'    {m} = "{m.lower()}"\n' for m in members)
+        ),
+        "koordinator_tpu/scheduler/batch_solver.py": (
+            "from ..obs.rejections import RejectReason\n\n"
+            "class BatchScheduler:\n"
+            "    def _classify_solver_reject(self, pod, req, est):\n"
+            + textwrap.indent(textwrap.dedent(classifier_body), " " * 8)
+        ),
+    }
+    if extra:
+        files["koordinator_tpu/other.py"] = (
+            "from .obs.rejections import RejectReason\n" + extra
+        )
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return tmp_path
+
+
+def test_reject_reasons_stale_exemption_for_covered_member(tmp_path):
+    root = _rr_repo(
+        tmp_path,
+        ["STALE_LEADER_EPOCH"],
+        "return RejectReason.STALE_LEADER_EPOCH\n",
+        extra="REASON = RejectReason.STALE_LEADER_EPOCH\n",
+    )
+    out = reject_reasons.check(
+        root, exempt_table={"STALE_LEADER_EPOCH": "fence boundary"}
+    )
+    assert len(out) == 1 and "stale exemption" in out[0][2]
+
+
+def test_reject_reasons_exempt_member_with_no_site(tmp_path):
+    root = _rr_repo(
+        tmp_path,
+        ["INSUFFICIENT_RESOURCES", "STALE_LEADER_EPOCH"],
+        "return RejectReason.INSUFFICIENT_RESOURCES\n",
+    )
+    out = reject_reasons.check(
+        root, exempt_table={"STALE_LEADER_EPOCH": "fence boundary"}
+    )
+    assert len(out) == 1 and "the site is gone" in out[0][2]
+
+
+def test_reject_reasons_accepts_exempt_member_with_live_site(tmp_path):
+    root = _rr_repo(
+        tmp_path,
+        ["INSUFFICIENT_RESOURCES", "STALE_LEADER_EPOCH"],
+        "return RejectReason.INSUFFICIENT_RESOURCES\n",
+        extra="REASON = RejectReason.STALE_LEADER_EPOCH\n",
+    )
+    assert reject_reasons.check(
+        root, exempt_table={"STALE_LEADER_EPOCH": "fence boundary"}
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# golden migration: legacy CLIs == framework passes
+# ---------------------------------------------------------------------------
+
+
+def _shim(name, *args):
+    return subprocess.run(
+        [sys.executable, str(ROOT / "tools" / name), *map(str, args)],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+    )
+
+
+@pytest.mark.parametrize(
+    "shim,pass_name",
+    [
+        ("check_exception_sites.py", "exception-sites"),
+        ("check_fence_boundaries.py", "fence-boundaries"),
+        ("check_reject_reasons.py", "reject-reasons"),
+    ],
+)
+def test_golden_legacy_cli_clean_on_tree(shim, pass_name):
+    """Both surfaces agree on the current tree: zero verdicts, exit 0."""
+    proc = _shim(shim)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stderr.strip() == ""
+    assert _run_pass(ROOT, pass_name) == []
+
+
+def test_golden_fence_boundaries_on_seeded_tree(tmp_path):
+    files, _ = FIXTURES["fence-boundaries"]
+    _write_tree(tmp_path, files)
+    proc = _shim("check_fence_boundaries.py", tmp_path / "koordinator_tpu")
+    assert proc.returncode == 1
+    cli_lines = {
+        ln for ln in proc.stderr.splitlines()
+        if ln.endswith("fence before journal")
+    }
+    fw_lines = {
+        # the framework prefixes the finding ID; strip to the legacy form
+        f"{tmp_path / f.file}:{f.line}: {f.message}"
+        for f in _run_pass(tmp_path, "fence-boundaries")
+    }
+    assert cli_lines == fw_lines and len(fw_lines) == 1
+
+
+def test_golden_reject_reasons_on_seeded_tree(tmp_path):
+    files, _ = FIXTURES["reject-reasons"]
+    _write_tree(tmp_path, files)
+    proc = _shim("check_reject_reasons.py", tmp_path)
+    assert proc.returncode == 1
+    cli_lines = {
+        ln for ln in proc.stderr.splitlines()
+        if "RejectReason." in ln and not ln.endswith("reasons")
+    }
+    fw_lines = {
+        f"{f.file}:{f.line}: {f.message}"
+        for f in _run_pass(tmp_path, "reject-reasons")
+    }
+    assert cli_lines == fw_lines
+    assert any("BRAND_NEW_REASON" in ln for ln in fw_lines)
+
+
+def test_golden_exception_sites_functions_are_shared(tmp_path):
+    """The shim's importable surface IS the pass implementation — same
+    function, same verdicts (the delegation the golden contract rides)."""
+    import importlib
+
+    shim = importlib.import_module("tools.check_exception_sites")
+    assert shim.check_paths is exception_sites.check_paths
+    files, _ = FIXTURES["exception-sites"]
+    _write_tree(tmp_path, files)
+    legacy = shim.check_paths([tmp_path / "koordinator_tpu"], tmp_path)
+    fw = _run_pass(tmp_path, "exception-sites")
+    assert [(f.file, f.line, f.message) for f in fw] == legacy
+    assert len(legacy) == 1
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_line_suppression_silences_and_is_tracked(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        def f():
+            try:
+                g()
+            except Exception:  # koordlint: disable=exception-sites
+                pass
+        """,
+    })
+    report = lint_run(tmp_path, select=["exception-sites"])
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_unused_and_unknown_suppressions_are_findings(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        def f():
+            return 1  # koordlint: disable=exception-sites
+
+        def g():
+            return 2  # koordlint: disable=no-such-pass
+        """,
+    })
+    report = lint_run(tmp_path, select=["exception-sites"])
+    codes = _codes(report.findings)
+    assert codes == {"SUP001", "SUP002"}
+
+
+def test_file_wide_suppression(tmp_path):
+    _write_tree(tmp_path, {
+        "koordinator_tpu/mod.py": """
+        # koordlint: disable-file=exception-sites
+
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        """,
+    })
+    report = lint_run(tmp_path, select=["exception-sites"])
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# walk hygiene: generated files and bytecode caches are out of scope
+# ---------------------------------------------------------------------------
+
+
+def test_pb2_and_pycache_excluded_from_all_walks(tmp_path):
+    bad = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+
+    def commit(jnl, epoch, cid, planned):
+        jnl.append_intent(epoch, cid, planned)
+    """
+    _write_tree(tmp_path, {
+        "koordinator_tpu/runtime/proto/snapshot_pb2.py": bad,
+        "koordinator_tpu/__pycache__/mod.py": bad,
+        "koordinator_tpu/ok.py": "x = 1\n",
+    })
+    for name in ("exception-sites", "fence-boundaries"):
+        assert _run_pass(tmp_path, name) == []
+
+
+def test_pb2_syntax_error_does_not_trip_lints(tmp_path):
+    # the failure mode that motivated the shared walk: a generated file
+    # an AST lint cannot parse
+    _write_tree(tmp_path, {
+        "koordinator_tpu/runtime/proto/gen_pb2.py": "this is ) not python",
+        "koordinator_tpu/ok.py": "x = 1\n",
+    })
+    report = lint_run(tmp_path, select=[
+        "exception-sites", "fence-boundaries", "retrace-hazard",
+        "donation-safety", "guarded-by",
+    ])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_select_ignore_and_json(tmp_path, capsys):
+    files, _ = FIXTURES["exception-sites"]
+    _write_tree(tmp_path, files)
+    rc = cli_main([
+        "--root", str(tmp_path), "--select", "exception-sites",
+        "--json", "-",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["exit"] == 1 and doc["passes"] == ["exception-sites"]
+    assert doc["findings"][0]["code"] == "EX001"
+
+    rc = cli_main([
+        "--root", str(tmp_path), "--ignore", "exception-sites",
+        "--select", "exception-sites,fence-boundaries",
+    ])
+    capsys.readouterr()
+    assert rc == 0  # the only violating pass was ignored
+
+
+def test_cli_unknown_pass_is_an_error(capsys):
+    rc = cli_main(["--select", "no-such-pass"])
+    assert rc == 2
+    assert "unknown pass" in capsys.readouterr().err
+
+
+def test_cli_path_scoping(tmp_path, capsys):
+    files, _ = FIXTURES["exception-sites"]
+    _write_tree(tmp_path, files)
+    rc = cli_main([
+        "koordinator_tpu/other_dir",
+        "--root", str(tmp_path), "--select", "exception-sites",
+    ])
+    capsys.readouterr()
+    assert rc == 0  # finding exists, but outside the reported scope
+
+    rc = cli_main([
+        "koordinator_tpu",
+        "--root", str(tmp_path), "--select", "exception-sites",
+    ])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_list_passes(capsys):
+    rc = cli_main(["--list-passes"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for name in PASS_NAMES:
+        assert name in out
+
+
+# ---------------------------------------------------------------------------
+# structural self-checks (carried over from the legacy test modules, so
+# the scanners cannot rot into silent pass-by-absence)
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_call_set_is_pinned():
+    assert fence_boundaries.GUARDED_APPENDS == {
+        "append_intent",
+        "append_bind",
+        "append_abort",
+    }
+
+
+def test_ast_walk_sees_real_commit_boundary():
+    src = (ROOT / "koordinator_tpu/scheduler/batch_solver.py").read_text()
+    tree = ast.parse(src)
+    found = {
+        node.func.attr
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in fence_boundaries.GUARDED_APPENDS
+    }
+    assert {"append_intent", "append_bind", "append_abort"} <= found
+
+
+def test_reject_reason_exemptions_split_the_enum_exactly():
+    members = set(reject_reasons.enum_members(ROOT))
+    assert set(reject_reasons.EXEMPT) <= members
+    covered = reject_reasons.classifier_coverage(ROOT)
+    assert covered and covered.isdisjoint(reject_reasons.EXEMPT)
+    assert covered | set(reject_reasons.EXEMPT) == members
+
+
+def test_jit_registry_sees_the_real_solver_surface():
+    """Self-check against silent rot: the jit scanner must actually FIND
+    the real entry points (renames must update the lint, not silently
+    shrink its coverage)."""
+    jitted = jitindex.collect_jitted(RepoIndex(ROOT))
+    names = {j.name for j in jitted}
+    assert {
+        "assign",
+        "solve_stream",
+        "solve_stream_full",
+        "scatter_rows",
+        "gather_rows",
+        "_chain_commit_deltas",
+        "_apply_commit_deltas_donated",
+    } <= names
+    donated = {j.name: j.donated for j in jitted if j.donated}
+    assert donated["scatter_rows"] == (0,)
+    assert donated["_apply_commit_deltas_donated"] == (0, 1, 2)
+    hooks = {j.hook for j in jitted if j.hook}
+    assert {
+        "sharded_assign", "sharded_solve_stream", "shard_map_nominate",
+    } <= hooks
+
+
+def test_chaos_coverage_sees_real_points_and_schedule():
+    index = RepoIndex(ROOT)
+    fires = chaos_coverage._fire_points(index)
+    assert "pipeline.worker_stall" in fires
+    assert "channel.*.drop" in fires        # the f-string pattern form
+    scheduled = chaos_coverage._scheduled_points(index)
+    # the PR's schedule extensions (koordlint chaos-coverage findings)
+    for point in (
+        "solver.dispatch_chunk",
+        "channel.sync.delay",
+        "leader.stale_commit",
+        "journal.write_fail",
+    ):
+        assert point in scheduled, point
+    # every exemption's promised dedicated arm exists in the NAMED file
+    armed = chaos_coverage._test_armed_points(index)
+    for point, (site, _why) in chaos_coverage.EXEMPT.items():
+        assert site in armed.get(point, set()), (
+            f"{point} (promised by {site})"
+        )
