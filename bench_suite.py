@@ -426,7 +426,11 @@ def bench_numa():
     def build():
         return _build_numa(batch_bucket=2048)
 
-    return _measure(build, 2048, "numa_binpack_2socket")
+    result = _measure(build, 2048, "numa_binpack_2socket")
+    # open-the-gates PR: the NUMA carry A/B — speculation through the
+    # opened gate, engagement + per-gate evidence embedded in the entry
+    result["pipelined_ab"] = _pipelined_ab(build, max_batch=2048)
+    return result
 
 
 def _build_device_nodes(n_nodes):
@@ -539,7 +543,10 @@ def bench_device_gang():
 
     # latency at 1024-pod batches (a gang pair never splits); throughput
     # drains all 8k pods in ONE pipelined call
-    return _measure(build, 1024, "device_gang_8gpu")
+    result = _measure(build, 1024, "device_gang_8gpu")
+    # open-the-gates PR: device + warm-gang carry A/B
+    result["pipelined_ab"] = _pipelined_ab(build, max_batch=1024)
+    return result
 
 
 def _build_quota(n_nodes=4000, n_pods=32_768, oversubscribed=True, **sched_kw):
@@ -620,7 +627,10 @@ def bench_quota_tree():
     def build():
         return _build_quota(batch_bucket=4096)
 
-    return _measure(build, 4096, "quota_tree_3level")
+    result = _measure(build, 4096, "quota_tree_3level")
+    # open-the-gates PR: quota-table chaining A/B
+    result["pipelined_ab"] = _pipelined_ab(build, max_batch=4096)
+    return result
 
 
 def _build_loadaware_stream(n_pods, **sched_kw):
@@ -787,13 +797,16 @@ def bench_latency_stream():
     return out
 
 
-def _drain_stream(sched, pods, pipelined, max_batch=512):
+def _drain_stream(sched, pods, pipelined, max_batch=512, depth=1, info=None):
     """Drain ``pods`` through a StreamScheduler in ``max_batch`` waves;
-    returns (decided, bound, elapsed_s)."""
+    returns (decided, bound, elapsed_s). ``depth`` selects the pipeline
+    depth (open-the-gates PR); pass a dict as ``info`` to receive the
+    live ``/debug/pipeline`` payload before the stream closes."""
     from koordinator_tpu.scheduler.stream import StreamScheduler
 
     stream = StreamScheduler(
-        sched, max_batch=max_batch, pipelined=pipelined
+        sched, max_batch=max_batch, pipelined=pipelined,
+        pipeline_depth=depth,
     )
     try:
         for p in pods:
@@ -809,9 +822,108 @@ def _drain_stream(sched, pods, pipelined, max_batch=512):
             decided += 1
             bound += node is not None
         elapsed = time.perf_counter() - t0
+        if info is not None and pipelined:
+            info.update(stream._pipe.gate_info())
     finally:
         stream.close()
     return decided, bound, elapsed
+
+
+def _pipelined_ab(build, max_batch, depth=2, passes=3):
+    """Same-backend serial-vs-pipelined A/B for one CONSTRAINED scenario
+    (open-the-gates PR acceptance): the same cluster drained through the
+    StreamScheduler twice, with the speculative path now riding the
+    opened quota/NUMA/device/gang gates at ``depth`` in-flight solves.
+    The entry embeds the engagement evidence — speculation kept >
+    0, per-gate closed counts (the opened gates must read 0), the live
+    ``/debug/pipeline`` payload — plus a retrace-free steady-state check
+    over the measured passes (PR 8 standing rule: a perf claim must
+    cite compile-ledger evidence, not just wall clock)."""
+    from koordinator_tpu.obs.devprof import CompileLedger
+
+    out = {"max_batch": max_batch, "depth": depth}
+    # warm both jit specializations on throwaway instances — FULL drains,
+    # because the retry tail's bucket ladder (odd-sized re-batches of
+    # unschedulable pods) is part of the steady shape set and must not
+    # read as a measured-pass retrace
+    for pipelined in (False, True):
+        sched, pods = build()
+        sched.extender.monitor.stop_background()
+        _drain_stream(
+            sched, pods, pipelined=pipelined,
+            max_batch=max_batch, depth=depth,
+        )
+    ledger = CompileLedger().install()
+    ledger.mark_steady()
+    try:
+        for mode, pipelined in (("serial", False), ("pipelined", True)):
+            rates = []
+            kept = disc = 0.0
+            gate_closed: dict = {}
+            mismatches: dict = {}
+            info: dict = {}
+            for _ in range(passes):
+                sched, pods = build()
+                sched.extender.monitor.stop_background()
+                info = {}
+                decided, _bound, elapsed = _drain_stream(
+                    sched, pods, pipelined=pipelined,
+                    max_batch=max_batch, depth=depth, info=info,
+                )
+                rates.append(round(decided / elapsed, 1))
+                if pipelined:
+                    # aggregate the engagement counters over EVERY
+                    # measured pass — each pass builds a fresh scheduler
+                    # and last-pass-only evidence would under-report a
+                    # transient gate closure or carry mismatch
+                    reg = sched.extender.registry
+                    spec_c = reg.get("pipeline_speculation_total")
+                    kept += spec_c.value(outcome="kept")
+                    disc += spec_c.value(outcome="discarded")
+                    gc = reg.get("pipeline_gate_closed_total")
+                    for key, s in gc._series.items():
+                        gate_closed[key[0]] = (
+                            gate_closed.get(key[0], 0.0) + s.value
+                        )
+                    cm = reg.get("pipeline_carry_mismatch_total")
+                    for key, s in cm._series.items():
+                        mismatches[key[0]] = (
+                            mismatches.get(key[0], 0.0) + s.value
+                        )
+            out[f"{mode}_pods_per_sec"] = sorted(rates)[len(rates) // 2]
+            out[f"{mode}_passes"] = rates
+            if pipelined:
+                out["speculation_kept"] = kept
+                out["speculation_discarded"] = disc
+                out["gate_closed"] = gate_closed
+                out["carry_mismatches"] = mismatches
+                out["debug_pipeline"] = info
+    finally:
+        out["steady_retraces"] = ledger.steady_retraces()
+        ledger.uninstall()
+    out["speedup"] = round(
+        out["pipelined_pods_per_sec"]
+        / max(out["serial_pods_per_sec"], 1e-9),
+        3,
+    )
+    try:
+        import jax
+
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+    except RuntimeError:
+        accel = []
+    if not accel:
+        out["measurement_note"] = (
+            "CPU-only backend: the 'device' solve, the prepare worker "
+            "and the trailing commit all contend for the same host "
+            "cores, so the overlap's wall effect sits inside "
+            "measurement noise (often below 1.0x) — the engagement "
+            "evidence (speculation kept, opened-gate closed-counts 0, "
+            "retrace-free steady state) is the structural claim here; "
+            "the wall win belongs to accelerator backends where host "
+            "Reserve and device solve are different silicon"
+        )
+    return out
 
 
 def bench_stream_pipelined():

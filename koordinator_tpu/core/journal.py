@@ -46,6 +46,7 @@ import contextlib
 import json
 import os
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -553,18 +554,22 @@ class ClaimTable:
     is refused outright (:class:`StaleEpochError`) — a deposed shard
     owner cannot grab new work on its way down."""
 
-    def __init__(self, store=None):
+    def __init__(self, store=None, clock=_time.time):
         self.store = store if store is not None else MemoryJournalStore()
+        self.clock = clock
         self._lock = threading.Lock()
         self._seq = 0
         #: uid -> winning shard
         self._winners: Dict[str, int] = {}
-        #: released (GC'd) uids — tombstones, NOT free slots: a release
-        #: happens at pod deletion, but a fanned-out copy of the pod can
-        #: still sit in some backlogged shard's queue; letting that copy
-        #: re-claim a freed uid would re-schedule a dead pod. Tombstone
-        #: GC belongs to claim-table compaction (ROADMAP follow-on).
-        self._settled: set = set()
+        #: released (GC'd) uid -> settle timestamp — tombstones, NOT
+        #: free slots: a release happens at pod deletion, but a
+        #: fanned-out copy of the pod can still sit in some backlogged
+        #: shard's queue; letting that copy re-claim a freed uid would
+        #: re-schedule a dead pod. :meth:`gc_tombstones` compacts
+        #: tombstones OLDER than a retention window (rides the shard
+        #: journal's run-loop compaction) — inside the window a
+        #: post-release claim still loses.
+        self._settled: Dict[str, float] = {}
         #: shard -> highest epoch ever used to claim
         self._epoch_high: Dict[int, int] = {}
         for rec in sorted(self.store.load(), key=lambda r: r.get("seq", 0)):
@@ -580,7 +585,16 @@ class ClaimTable:
                 )
             elif op == "claim_release":
                 self._winners.pop(rec.get("uid"), None)
-                self._settled.add(rec.get("uid"))
+                self._settled[rec.get("uid")] = float(rec.get("ts", 0.0))
+            elif op == "claim_epoch_high":
+                # tombstone-GC checkpoint: per-shard epoch highs survive
+                # even when every claim record of a shard was compacted
+                # away (fencing must not weaken across a GC + reload)
+                for shard_s, epoch in (rec.get("highs") or {}).items():
+                    shard_i = int(shard_s)
+                    self._epoch_high[shard_i] = max(
+                        self._epoch_high.get(shard_i, 0), int(epoch)
+                    )
 
     def claim(self, uid: str, shard: int, epoch: int) -> bool:
         """True when ``shard`` owns (or now wins) the pod's claim; False
@@ -633,13 +647,89 @@ class ClaimTable:
         with self._lock:
             if self._winners.pop(uid, None) is None:
                 return
-            self._settled.add(uid)
+            ts = float(self.clock())
+            self._settled[uid] = ts
             self._seq += 1
             try:
                 self.store.append(
-                    {"seq": self._seq, "op": "claim_release", "uid": uid}
+                    {
+                        "seq": self._seq,
+                        "op": "claim_release",
+                        "uid": uid,
+                        "ts": ts,
+                    }
                 )
             except OSError as exc:
                 raise JournalWriteError(
                     f"claim release append failed: {exc!r}"
                 ) from exc
+
+    def tombstones_live(self) -> int:
+        """Settled uids currently retained (the ``claim_tombstones_live``
+        gauge's source)."""
+        with self._lock:
+            return len(self._settled)
+
+    def gc_tombstones(
+        self, retention_s: float, now: Optional[float] = None
+    ) -> int:
+        """Compact tombstones settled more than ``retention_s`` ago
+        (queued PR 6 follow-on, driven by the shard journal's run-loop
+        compaction). INSIDE the retention window a tombstone survives
+        compaction — a post-GC claim on such a uid still loses — so the
+        window must exceed the longest a fanned-out queue copy can
+        plausibly outlive its pod's GC. The store is rewritten to the
+        minimal equivalent log: one per-shard epoch-high checkpoint
+        (fencing survives even when a shard's every claim record is
+        dropped), the live claims, and the retained tombstones. Returns
+        the number of tombstones still live."""
+        if now is None:
+            now = float(self.clock())
+        cutoff = now - retention_s
+        with self._lock:
+            expired = [
+                uid for uid, ts in self._settled.items() if ts <= cutoff
+            ]
+            if not expired:
+                return len(self._settled)
+            for uid in expired:
+                del self._settled[uid]
+            records: List[dict] = []
+            self._seq += 1
+            records.append(
+                {
+                    "seq": self._seq,
+                    "op": "claim_epoch_high",
+                    "highs": {
+                        str(s): int(e) for s, e in self._epoch_high.items()
+                    },
+                }
+            )
+            for uid, shard in self._winners.items():
+                self._seq += 1
+                records.append(
+                    {
+                        "seq": self._seq,
+                        "op": "claim",
+                        "uid": uid,
+                        "shard": int(shard),
+                        "epoch": int(self._epoch_high.get(shard, 0)),
+                    }
+                )
+            for uid, ts in self._settled.items():
+                self._seq += 1
+                records.append(
+                    {
+                        "seq": self._seq,
+                        "op": "claim_release",
+                        "uid": uid,
+                        "ts": float(ts),
+                    }
+                )
+            try:
+                self.store.rewrite(records)
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"claim tombstone GC failed: {exc!r}"
+                ) from exc
+            return len(self._settled)

@@ -320,16 +320,33 @@ class SolverParams:
 
 @struct.dataclass
 class SolveResult:
+    """One solve's assignments plus its POST-COMMIT capacity tables.
+
+    The post-commit tables are the chaining currency: ``solve_stream``/
+    ``solve_stream_full`` thread them between chunks WITHIN a cycle, and
+    the scheduler's ``ChainCarry`` (open-the-gates PR) threads the very
+    same arrays ACROSS the cycle boundary into the next speculative
+    dispatch — zero extra device work either way, because the solver
+    outputs ARE the chained state. Consumers that keep a chained solve
+    must validate the carried tables against host truth at commit time
+    (``BatchScheduler._carry_consume_ok``)."""
+
     assignment: jnp.ndarray       # [P] int32 node index, -1 = unschedulable
     node_requested: jnp.ndarray   # [N, D] post-commit
     node_estimated_used: jnp.ndarray  # [N, D] post-commit
     node_prod_used: jnp.ndarray   # [N, D] post-commit
-    quota_used: jnp.ndarray       # [Q, D] post-commit
+    #: [Q, D] post-commit quota-used table (the extended shadow-row
+    #: layout when the caller lowered one) — chained across chunks by
+    #: the streams and across CYCLES by the pipeline's quota carry; the
+    #: quota RUNTIME stays host-computed (water-fill preview) and is
+    #: re-validated bit-exact at consume
+    quota_used: jnp.ndarray
     rounds_used: jnp.ndarray      # [] int32
     #: post-commit exact per-slot GPU table [N, G] (placeholder [N, 1]
     #: zeros when the solve had no DeviceState) plus free RDMA/FPGA counts
     #: [N]; feed back via ``assign(dev_carry=...)`` to chain device
-    #: capacity across chunks without a host round-trip
+    #: capacity across chunks — or across cycles — without a host
+    #: round-trip
     node_dev_slots: jnp.ndarray = None
     node_rdma_free: jnp.ndarray = None
     node_fpga_free: jnp.ndarray = None

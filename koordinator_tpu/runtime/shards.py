@@ -168,7 +168,7 @@ class ShardFabric:
         #: threads) and a deque raises if mutated mid-iteration
         self.handoff_lock = threading.Lock()
         self.locks = LeaseLockSet()
-        self.claims = ClaimTable(claim_store)
+        self.claims = ClaimTable(claim_store, clock=clock)
         self.membership = Membership(membership_ttl_s, clock=clock)
 
     def shard_lease_lock(self, shard: int):
@@ -305,6 +305,7 @@ class ShardedScheduler:
         lifecycle=None,
         slo=None,
         flight_capacity: int = 256,
+        claim_tombstone_retention_s: float = 3600.0,
     ):
         self.name = name
         self.hub = hub
@@ -326,6 +327,12 @@ class ShardedScheduler:
         self.lifecycle = lifecycle
         self.slo = slo
         self.flight_capacity = int(flight_capacity)
+        #: ClaimTable tombstone retention (PR 6 queued follow-on): when a
+        #: shard's run-loop journal compaction fires, settled claim
+        #: tombstones OLDER than this window are compacted away; inside
+        #: the window a post-GC claim on a settled uid still loses (a
+        #: backlogged queue can hold a fanned-out copy past pod GC)
+        self.claim_tombstone_retention_s = float(claim_tombstone_retention_s)
         self._runtimes: Dict[int, ShardRuntime] = {}
         self._handoffs: Dict[int, ShardHandoff] = {}
         self.stats = {
@@ -450,6 +457,19 @@ class ShardedScheduler:
                 clock=self.clock,
             )
         )
+        # ClaimTable tombstone GC rides the shard journal's run-loop
+        # compaction beat (PR 6 queued follow-on): compact settled
+        # tombstones past the retention window, then publish the live
+        # count so growth is observable (claim_tombstones_live)
+        def _gc_claims(_sched=sched):
+            live = self.fabric.claims.gc_tombstones(
+                self.claim_tombstone_retention_s, now=self.clock()
+            )
+            _sched.extender.registry.get("claim_tombstones_live").set(
+                float(live)
+            )
+
+        sched.on_journal_compacted = _gc_claims
         informers = self.hub.wire_scheduler(sched, node_filter=flt)
         self.hub.start()
         stream_cls = self._stream_cls()

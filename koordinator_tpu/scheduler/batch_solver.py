@@ -273,6 +273,81 @@ class _ReserveJournal:
 
 
 @dataclasses.dataclass
+class ChainCarry:
+    """Device-chained commit state spanning a cycle boundary (open the
+    speculation gates PR): the post-solve tables of one speculative
+    dispatch, handed to the NEXT cycle's dispatch as its chunk-0 inputs.
+    ``nodes`` is the PR-4 node-capacity chain; the constrained
+    subsystems ride beside it the same way ``solve_stream_full``'s scan
+    state already chains them WITHIN a cycle — the solver outputs ARE
+    the chained tables, so extending the carry across the boundary costs
+    zero extra dispatches."""
+
+    #: NodeState with post-solve requested/estimated_used/prod_used
+    #: (static leaves aliased)
+    nodes: object
+    #: [2Q, D] post-commit extended quota-used table (None = no tree)
+    quota_used: object = None
+    #: (slot_free [N, G], rdma_free [N], fpga_free [N]) or None
+    dev: object = None
+    #: [N, Z, DN] post-commit NUMA zone-free table or None
+    numa_zone: object = None
+
+
+@dataclasses.dataclass
+class _QuotaCarryMeta:
+    """Validation inputs for a quota-bearing speculative solve: the
+    exact (runtime, used) tables chunk 0 consumed, plus the tree shape
+    the quota chains were lowered against."""
+
+    used_in: object          # device [2Q, D] (chained) or host copy (fresh)
+    runtime_host: np.ndarray  # host [2Q, D] preview the solve uploaded
+    tree_version: int
+
+
+@dataclasses.dataclass
+class _NumaCarryMeta:
+    """Validation inputs for a NUMA-bearing speculative solve. The
+    structural tables are HOST COPIES taken at dispatch — the resident
+    device copies are donation targets of the next dirty-row scatter and
+    must never be re-read at consume time."""
+
+    zone_in: object          # device carry (chained) or host copy (fresh)
+    zone_cap: np.ndarray
+    policy: np.ndarray
+    zone_most: np.ndarray
+
+
+@dataclasses.dataclass
+class _DevCarryMeta:
+    """Validation inputs for a device-bearing speculative solve (same
+    host-copy discipline as :class:`_NumaCarryMeta`)."""
+
+    slots_in: object         # device carry (chained) or host copy (fresh)
+    rdma_in: object          # None when RDMA untracked
+    fpga_in: object          # None when FPGA untracked
+    cap: np.ndarray
+    has_rdma: bool
+    has_fpga: bool
+
+
+@dataclasses.dataclass
+class CarryMeta:
+    """Everything consume-time validation needs to prove the speculative
+    solve's inputs equal what a fresh serial dispatch would lower NOW —
+    bit-exact value comparison per carried table, not trust. One field
+    per opened gate; None means the subsystem was absent at dispatch
+    (and must still be absent at consume)."""
+
+    quota: Optional[_QuotaCarryMeta] = None
+    numa: Optional[_NumaCarryMeta] = None
+    dev: Optional[_DevCarryMeta] = None
+    #: frozen (key, outstanding_min, nonstrict) per gang in the batch,
+    #: as the lowering's live views read them (empty = gang-free batch)
+    gangs: tuple = ()
+
+
+@dataclasses.dataclass
 class SpeculativeSolve:
     """An in-flight cross-cycle solve dispatched by the CyclePipeline:
     chunked solves chained off the previous cycle's on-device commit
@@ -287,13 +362,15 @@ class SpeculativeSolve:
     sub: Optional[np.ndarray]
     #: [(chunk, LoweredRows, SolveResult)] — the commit loop's shape
     solves: list
-    #: post-solve chained NodeState (requested/estimated/prod carried on
-    #: device) — becomes the NEXT cycle's chain when the commit is clean
-    chain_out: object
+    #: post-solve chained state (nodes + quota/device/NUMA tables) —
+    #: becomes the NEXT cycle's chain when the commit is clean
+    chain_out: ChainCarry
     #: snapshot version at dispatch (under the lock); any write since
     #: invalidates
     version: int
     node_epoch: int
+    #: consume-time validation inputs for the carried subsystems
+    carry: CarryMeta = dataclasses.field(default_factory=CarryMeta)
     #: NaN-guard verdicts collected during the speculative lowering,
     #: merged into the consuming cycle's quarantine
     quarantine: Dict[str, tuple] = dataclasses.field(default_factory=dict)
@@ -544,6 +621,10 @@ class BatchScheduler:
         #: stores) accumulated since the last checkpoint. None = never.
         self.journal_compact_records = journal_compact_records
         self.journal_compact_bytes = journal_compact_bytes
+        #: invoked (no args) after a successful run-loop journal
+        #: compaction — the sharded runtime hangs ClaimTable tombstone
+        #: GC off it so claim compaction rides the same maintenance beat
+        self.on_journal_compacted = None
         if journal is not None:
             reg = self.extender.registry
             if journal.writes_counter is None:
@@ -1442,6 +1523,20 @@ class BatchScheduler:
         spec = self._speculative
         self._speculative = None
         if spec is not None and not _retry:
+            # chaos (pipeline.carry_mismatch): evaluated the moment a
+            # speculation reaches the consume guard. Deliberate
+            # trade-off: firing here guarantees the soak's fixed-cycle
+            # arm lands on the NEXT spec-present consume (placing it
+            # inside _carry_consume_ok starved it — most soak consumes
+            # discard on the version guard and the arm never fired);
+            # the cost is that a cheap-guard discard can subsume the
+            # corruption (same observable effect — a discard — without
+            # walking the comparison). The comparison path itself is
+            # pinned deterministically by the dedicated tier-1 arm
+            # (test_carry_mismatch_chaos_forces_redispatch).
+            carry_corrupt = self.chaos.enabled and self.chaos.fire(
+                "pipeline.carry_mismatch"
+            )
             if (
                 chunks
                 and spec.chunk_uids
@@ -1450,6 +1545,12 @@ class BatchScheduler:
                 and spec.node_epoch == self.snapshot.node_epoch
                 and self._fallback_level == 0
                 and self._speculation_consume_ok()
+                # LAST: the carry validation is the expensive check (it
+                # runs the real quota demand propagation and fetches the
+                # carried tables) — cheap guards short-circuit it
+                and self._carry_consume_ok(
+                    spec, chunks, corrupt=carry_corrupt
+                )
             ):
                 solves = spec.solves
                 sub = spec.sub
@@ -2761,28 +2862,35 @@ class BatchScheduler:
         return out
 
     def speculation_gate_report(self) -> Dict[str, bool]:
-        """Named per-gate verdicts (True = OPEN, the subsystem is absent
-        and speculation may proceed) for the state-bearing speculation
-        gates. One vocabulary serves three consumers: the boolean
-        conjunction below (:meth:`_speculation_consume_ok`), the
+        """Named per-gate verdicts (True = OPEN) for the pipeline's
+        speculation gates. One vocabulary serves three consumers: the
+        boolean conjunction below (:meth:`_speculation_consume_ok`), the
         CyclePipeline's ``pipeline_gate_closed_total{gate}`` attribution
-        and the ``/debug/pipeline`` introspection payload — the evidence
-        base for the "open the speculation gates" roadmap item (which
-        gate keeps each slow config serial)."""
+        and the ``/debug/pipeline`` introspection payload.
+
+        Open-the-gates PR: ``quotas`` / ``numa`` / ``devices`` report
+        OPEN unconditionally — their host commit state now rides the
+        device chain (:class:`ChainCarry`) with bit-exact retroactive
+        validation at consume (:meth:`_carry_consume_ok`), so presence
+        no longer forces the serial path. ``gangs`` likewise opens at
+        the manager level; the per-BATCH warm-gang check lives in the
+        CyclePipeline's ``batch_gangs`` gate. The remaining closed-on-
+        presence gates are the subsystems whose commit state the chain
+        still cannot carry: reservations (ghost-hold swaps), mesh
+        (sharded dispatch), transformers (host rewrites), priority
+        preemption, and node sampling (rotating sub-axis)."""
         fwext = self.extender
         return {
             "reservations": self.reservations is None,
             "mesh": self.mesh is None,
-            "numa": not (self.numa is not None and self.numa.has_topology),
-            "devices": not (
-                self.devices is not None and self.devices.has_devices
-            ),
-            "quotas": self.quotas.quota_count == 0,
+            "numa": True,
+            "devices": True,
+            "quotas": True,
             "transformers": not fwext._pre_batch
             and not fwext._batch_transformers
             and fwext.cost_transform is None,
             "preemption": not self.enable_priority_preemption,
-            "gangs": not self.pod_groups.has_gangs,
+            "gangs": True,
             "sampling": num_nodes_to_score(
                 self.snapshot.node_count, self.percentage_of_nodes_to_score
             )
@@ -2790,16 +2898,129 @@ class BatchScheduler:
         }
 
     def _speculation_consume_ok(self) -> bool:
-        """State-bearing pipeline gates, re-checked at CONSUME time: a
+        """Still-gated pipeline subsystems, re-checked at CONSUME time: a
         gated subsystem can arrive through an informer WITHOUT bumping
-        ``snapshot.version`` (the first ElasticQuota CR, a device
-        inventory, a NUMA topology, a gang registration), and a
-        speculation lowered before that arrival must not be consumed —
-        its rows carry no quota chains and its solves ran without the
-        subsystem's admission. The CyclePipeline's dispatch gate reuses
-        this (via :meth:`speculation_gate_report`) plus its
-        batch-content and ladder checks."""
+        ``snapshot.version`` (a reservation manager attach, a mesh, a
+        transformer registration), and a speculation dispatched before
+        that arrival must not be consumed. The CARRIED subsystems
+        (quota/NUMA/device/gang) are validated by value instead —
+        :meth:`_carry_consume_ok`."""
         return all(self.speculation_gate_report().values())
+
+    def _carry_consume_ok(
+        self, spec: "SpeculativeSolve", chunks, corrupt: bool = False
+    ) -> bool:
+        """Retroactive carry validation (open-the-gates PR): prove, by
+        BIT-EXACT value comparison, that every constrained table the
+        speculative solve consumed equals what a fresh serial dispatch
+        would lower right now. Divergence of any kind — an elastic-quota
+        runtime refresh landing differently, a host allocator picking a
+        different zone/slot than the device chain, a conservative
+        fractional-GPU gang refund, amplification or preemption moving
+        capacity — fails the comparison and the speculation is discarded
+        (counted per table in ``pipeline_carry_mismatch_total``), the
+        cycle re-dispatching from refreshed host state. A kept
+        speculation therefore used inputs EQUAL to the serial path's, so
+        placements match either way.
+
+        ``corrupt`` is the ``pipeline.carry_mismatch`` chaos point's
+        effect (evaluated by the caller at the consume guard's entry, so
+        the scheduled fault cannot be starved by an earlier guard
+        discarding first): the first carried table is corrupted before
+        the comparison, forcing the discard-and-redispatch path through
+        the REAL validation code (fixed-cycle soak arm; fires with
+        probability 1, so no rng-stream draw)."""
+        carry = spec.carry
+        reg = self.extender.registry
+
+        def _fail(table: str) -> bool:
+            reg.get("pipeline_carry_mismatch_total").labels(
+                table=table
+            ).inc()
+            return False
+        # presence must match what the solve lowered with: a subsystem
+        # arriving (or emptying) mid-pipeline invalidates rows that
+        # carry no quota chains / no device columns for it
+        if (self.quotas.quota_count > 0) != (carry.quota is not None):
+            return _fail("quota")
+        numa_live = self.numa is not None and self.numa.has_topology
+        if numa_live != (carry.numa is not None):
+            return _fail("numa")
+        dev_live = self.devices is not None and self.devices.has_devices
+        if dev_live != (carry.dev is not None):
+            return _fail("device")
+        all_pods = [p for c in chunks for p in c]
+        if self.pod_groups.gang_view(all_pods) != carry.gangs:
+            return _fail("gangs")
+        q = carry.quota
+        if q is not None:
+            if q.tree_version != self.quotas.tree_version:
+                # the tree was re-indexed — the rows' lowered chains no
+                # longer name the right quotas, whatever the tables say
+                return _fail("quota")
+            # run the REAL mutating demand propagation + runtime refresh
+            # exactly where the serial dispatch would (the speculative
+            # dispatch only previewed it), then compare
+            host = self._quota_host_arrays(all_pods)
+            if host is None:
+                return _fail("quota")
+            runtime_h, used_h = host
+            used_spec = np.asarray(q.used_in)
+            if corrupt:
+                used_spec = used_spec + 1.0
+                corrupt = False
+            if not (
+                runtime_h.shape == q.runtime_host.shape
+                and np.array_equal(runtime_h, q.runtime_host)
+                and used_h.shape == used_spec.shape
+                and np.array_equal(used_h, used_spec)
+            ):
+                return _fail("quota")
+        nm = carry.numa
+        if nm is not None:
+            zone_free_h, zone_cap_h, policy_h = self.numa.arrays()
+            most_h = self.numa.most_allocated_rows()
+            zin = np.asarray(nm.zone_in)
+            if corrupt:
+                zin = zin + 1.0
+                corrupt = False
+            if not (
+                zin.shape == zone_free_h.shape
+                and np.array_equal(zin, zone_free_h)
+                and np.array_equal(nm.zone_cap, zone_cap_h)
+                and np.array_equal(nm.policy, policy_h)
+                and np.array_equal(nm.zone_most, most_h)
+            ):
+                return _fail("numa")
+        dv = carry.dev
+        if dv is not None:
+            slots_h = self.devices.slot_array()
+            sin = np.asarray(dv.slots_in)
+            if corrupt:
+                sin = sin + 1.0
+                corrupt = False
+            ok = (
+                sin.shape == slots_h.shape
+                and np.array_equal(sin, slots_h)
+                and dv.has_rdma == self.devices.has_rdma
+                and dv.has_fpga == self.devices.has_fpga
+                and np.array_equal(dv.cap, self.devices.cap_array())
+            )
+            if ok and dv.has_rdma:
+                ok = np.array_equal(
+                    np.asarray(dv.rdma_in), self.devices.rdma_array()
+                )
+            if ok and dv.has_fpga:
+                ok = np.array_equal(
+                    np.asarray(dv.fpga_in), self.devices.fpga_array()
+                )
+            if not ok:
+                return _fail("device")
+        if corrupt:
+            # the chaos point fired against a carry-free cycle: force the
+            # discard anyway so a scheduled fault is never silently spent
+            return _fail("none")
+        return True
 
     def last_cycle_spec_safe(self) -> bool:
         """Whether the just-finished cycle left the speculative chain
@@ -2820,23 +3041,128 @@ class BatchScheduler:
     def _dispatch_chained(
         self,
         chunks: List[List[Pod]],
-        chain: NodeState,
+        carry: ChainCarry,
         quarantine: Optional[Dict[str, tuple]] = None,
         prepared: Optional[list] = None,
-    ) -> Tuple[list, NodeState]:
+        gang_view: tuple = (),
+    ) -> Optional[Tuple[list, ChainCarry, CarryMeta]]:
         """Cross-cycle chained dispatch (the pipeline's speculative fast
         path): solve every chunk against the device-chained capacity
         state carried from the PREVIOUS cycle's solve — dispatched while
-        that cycle's host Reserve still trails behind. The CyclePipeline
-        guarantees the gates (no quotas / NUMA / devices / transformers /
-        mesh / gangs / sampling / preemption), under which the serial
-        path's dispatch reduces to the same ``assign`` call chain, so a
-        kept speculation is decision-identical to a fresh post-commit
-        dispatch. ``prepared`` carries the prepare worker's
-        (PodBatch, LoweredRows, node_mask) triples when it finished in
-        time; otherwise lowering happens inline (cold, still correct).
-        Returns ``(solves, chain_out)``."""
-        cur = chain
+        that cycle's host Reserve still trails behind. Open-the-gates
+        PR: the constrained subsystems ride the chain too — the quota
+        used-table, the exact GPU slot table and the exact NUMA zone
+        table are chained across the cycle boundary exactly the way
+        ``solve_stream_full``'s scan state chains them across chunks,
+        and the quota RUNTIME is a pure host preview of the demand
+        propagation the consuming cycle will re-run for real. Decision
+        identity rests on :meth:`_carry_consume_ok`'s bit-exact
+        retroactive validation, not on gate-guaranteed absence.
+
+        ``prepared`` carries the prepare worker's (PodBatch,
+        LoweredRows, node_mask) triples when it finished in time;
+        otherwise lowering happens inline (cold, still correct).
+        Returns ``(solves, chain_out, carry_meta)``, or None when a
+        carried table no longer matches the live shapes (tree/topology
+        reshaped mid-chain — no speculation this cycle)."""
+        all_pods = [p for c in chunks for p in c]
+        # quota tables: pure preview (no manager mutation — the trailing
+        # cycle's PostFilter still reads the live requests/runtime); the
+        # used table is the device chain when one is carried
+        quotas0 = None
+        qmeta = None
+        if self.quotas.quota_count > 0:
+            used_rows = None
+            if carry.quota_used is not None:
+                # the demand propagation's used term must be the
+                # POST-commit ledger the consuming cycle will see — at a
+                # chained dispatch the host ledger is still pre-commit,
+                # so fold in the device carry's predicted rows instead
+                # (tiny [2Q, D] fetch; the producing solve completed
+                # during the inter-feed window, so this rarely blocks).
+                # Without this the runtime preview diverges whenever
+                # consecutive batches admit into the same leaf and every
+                # chained quota speculation discards at validation.
+                q_real = self.quotas.quota_count
+                carried = np.asarray(carry.quota_used)
+                if carried.shape[0] < q_real:
+                    return None
+                used_rows = carried[:q_real]
+            by_leaf, _nonpre = self._quota_pending_demand(
+                all_pods, used_rows=used_rows
+            )
+            runtime_ext, used_ext = self.quotas.preview_arrays_extended(
+                by_leaf,
+                self.quotas.effective_cluster_total(self.snapshot),
+            )
+            used0 = (
+                carry.quota_used
+                if carry.quota_used is not None
+                else jnp.asarray(used_ext)
+            )
+            if tuple(used0.shape) != runtime_ext.shape:
+                return None
+            quotas0 = QuotaState(
+                runtime=jnp.asarray(runtime_ext), used=used0
+            )
+            qmeta = _QuotaCarryMeta(
+                used_in=used0,
+                runtime_host=runtime_ext,
+                tree_version=self.quotas.tree_version,
+            )
+        numa_state, device_state = self._constraint_states(None)
+        nmeta = None
+        numa_zone = None
+        if numa_state is not None:
+            numa_zone = carry.numa_zone
+            if numa_zone is not None and tuple(numa_zone.shape) != tuple(
+                numa_state.zone_free.shape
+            ):
+                return None
+            # structural tables as HOST copies: the resident device
+            # arrays are donation targets of the next dirty-row scatter
+            # and must never be re-read at consume time
+            zone_free_h, zone_cap_h, policy_h = self.numa.arrays()
+            nmeta = _NumaCarryMeta(
+                zone_in=(
+                    numa_zone
+                    if numa_zone is not None
+                    else zone_free_h.copy()
+                ),
+                zone_cap=zone_cap_h.copy(),
+                policy=policy_h.copy(),
+                zone_most=self.numa.most_allocated_rows().copy(),
+            )
+        dmeta = None
+        dev_carry = None
+        if device_state is not None:
+            has_rdma = device_state.rdma_free is not None
+            has_fpga = device_state.fpga_free is not None
+            if carry.dev is not None:
+                slots_in, rdma_in, fpga_in = carry.dev
+                if tuple(slots_in.shape) != tuple(
+                    device_state.slot_free.shape
+                ):
+                    return None
+                dev_carry = (slots_in, rdma_in, fpga_in)
+            else:
+                slots_in = self.devices.slot_array().copy()
+                rdma_in = (
+                    self.devices.rdma_array().copy() if has_rdma else None
+                )
+                fpga_in = (
+                    self.devices.fpga_array().copy() if has_fpga else None
+                )
+            dmeta = _DevCarryMeta(
+                slots_in=slots_in,
+                rdma_in=rdma_in,
+                fpga_in=fpga_in,
+                cap=self.devices.cap_array().copy(),
+                has_rdma=has_rdma,
+                has_fpga=has_fpga,
+            )
+        cur = carry.nodes
+        qused = quotas0.used if quotas0 is not None else None
         out = []
         for k, chunk in enumerate(chunks):
             if prepared is not None:
@@ -2858,13 +3184,13 @@ class BatchScheduler:
                         stage="overlap",
                         bucket=pods.requests.shape[0],
                         n=cur.allocatable.shape[0],
-                        quotas=False,
-                        numa=False,
-                        devices=False,
+                        quotas=quotas0 is not None,
+                        numa=numa_state is not None,
+                        devices=device_state is not None,
                         mask=node_mask is not None,
                         carry=True,
-                        numa_scoring=None,
-                        device_scoring=None,
+                        numa_scoring=self._numa_scoring(),
+                        device_scoring=self._device_scoring(),
                         max_rounds=self.max_rounds,
                     )
                     if dp is not None
@@ -2874,10 +3200,24 @@ class BatchScheduler:
                         pods,
                         cur,
                         self._params,
-                        quotas=None,
+                        quotas=(
+                            QuotaState(
+                                runtime=quotas0.runtime, used=qused
+                            )
+                            if quotas0 is not None
+                            else None
+                        ),
+                        numa=numa_state,
+                        devices=device_state,
                         max_rounds=self.max_rounds,
                         approx_topk=True,
                         node_mask=node_mask,
+                        dev_carry=dev_carry,
+                        numa_carry=(
+                            numa_zone if numa_state is not None else None
+                        ),
+                        numa_scoring=self._numa_scoring(),
+                        device_scoring=self._device_scoring(),
                     )
                     w.result(result.assignment)
             # zero-copy chain replace (the solver outputs ARE the chained
@@ -2887,8 +3227,27 @@ class BatchScheduler:
                 estimated_used=result.node_estimated_used,
                 prod_used=result.node_prod_used,
             )
+            if quotas0 is not None:
+                qused = result.quota_used
+            if device_state is not None:
+                dev_carry = (
+                    result.node_dev_slots,
+                    result.node_rdma_free,
+                    result.node_fpga_free,
+                )
+            if numa_state is not None:
+                numa_zone = result.node_zone_free
             out.append((chunk, rows, result))
-        return out, cur
+        chain_out = ChainCarry(
+            nodes=cur,
+            quota_used=qused,
+            dev=dev_carry if device_state is not None else None,
+            numa_zone=numa_zone if numa_state is not None else None,
+        )
+        meta = CarryMeta(
+            quota=qmeta, numa=nmeta, dev=dmeta, gangs=gang_view
+        )
+        return out, chain_out, meta
 
     def _numa_scoring(self):
         """NUMA-aligned Score strategy for the solver (static jit arg)."""
@@ -3257,24 +3616,28 @@ class BatchScheduler:
         self._quota_dev_cache = (key, state)
         return state
 
-    def _quota_host_arrays(self, chunk: Sequence[Pod]):
-        """Host-side quota refresh shared by the device lowering and the
-        host reference path: propagates this chunk's demand up the tree,
-        refreshes runtime, and returns the extended ``(runtime, used)``
-        numpy tables (None when no quota tree exists) — no device work."""
-        from .plugins.elasticquota import quota_name_of
+    def _quota_pending_demand(
+        self, chunk: Sequence[Pod], used_rows: Optional[np.ndarray] = None
+    ):
+        """PURE per-leaf demand of this chunk: ``(by_leaf, np_by_leaf)``
+        request-vector sums (pending + already-admitted used per leaf) —
+        the inputs of the demand propagation, computed without touching
+        the manager. Shared by the real mutating refresh
+        (:meth:`_quota_host_arrays`) and the pipeline's speculative
+        PREVIEW (open-the-gates PR: the dispatch must not overwrite the
+        requests/runtime the trailing cycle's PostFilter still reads).
+        ``used_rows`` substitutes the admitted-used table ([≥Q, D]; the
+        chained dispatch passes the device carry's PREDICTED post-commit
+        rows, since the live host ledger is still pre-commit there)."""
+        from .plugins.elasticquota import (
+            is_pod_non_preemptible,
+            quota_name_of,
+        )
 
-        if self.quotas.quota_count == 0:
-            return None
-        # The fair-sharing budget is the live cluster capacity (without it
-        # water-fill degenerates to min(min, request) and admission sticks
-        # at the guaranteed tier).
-        self.quotas.sync_cluster_total(self.snapshot)
-        # Propagate desired requests (pending + admitted) up the tree so
-        # fair sharing reflects demand, then refresh runtime. Request
-        # vectors memoize on the request dict's items — clusters have few
-        # distinct pod shapes, and the per-pod res_vector walk was a
-        # visible slice of large quota batches.
+        used_src = used_rows if used_rows is not None else self.quotas.used
+        # Request vectors memoize on the request dict's items — clusters
+        # have few distinct pod shapes, and the per-pod res_vector walk
+        # was a visible slice of large quota batches.
         by_leaf: Dict[str, np.ndarray] = {}
         vec_cache: Dict[tuple, np.ndarray] = {}
         res_vector = self.snapshot.config.res_vector
@@ -3291,13 +3654,10 @@ class BatchScheduler:
             by_leaf[leaf] = vec.copy() if acc is None else acc + vec
         for leaf in list(by_leaf):
             idx = self.quotas.index_of(leaf)
-            if idx is not None and idx < self.quotas.used.shape[0]:
-                by_leaf[leaf] = by_leaf[leaf] + self.quotas.used[idx]
-        self.quotas.set_leaf_requests(by_leaf)
+            if idx is not None and idx < used_src.shape[0]:
+                by_leaf[leaf] = by_leaf[leaf] + used_src[idx]
         # non-preemptible demand ledger for status stamping (leaf-level)
         np_by_leaf: Dict[str, np.ndarray] = {}
-        from .plugins.elasticquota import is_pod_non_preemptible
-
         for pod in chunk:
             if not is_pod_non_preemptible(pod):
                 continue
@@ -3307,6 +3667,21 @@ class BatchScheduler:
             vec = res_vector(pod.spec.requests)
             acc = np_by_leaf.get(leaf)
             np_by_leaf[leaf] = vec.copy() if acc is None else acc + vec
+        return by_leaf, np_by_leaf
+
+    def _quota_host_arrays(self, chunk: Sequence[Pod]):
+        """Host-side quota refresh shared by the device lowering and the
+        host reference path: propagates this chunk's demand up the tree,
+        refreshes runtime, and returns the extended ``(runtime, used)``
+        numpy tables (None when no quota tree exists) — no device work."""
+        if self.quotas.quota_count == 0:
+            return None
+        # The fair-sharing budget is the live cluster capacity (without it
+        # water-fill degenerates to min(min, request) and admission sticks
+        # at the guaranteed tier).
+        self.quotas.sync_cluster_total(self.snapshot)
+        by_leaf, np_by_leaf = self._quota_pending_demand(chunk)
+        self.quotas.set_leaf_requests(by_leaf)
         if np_by_leaf or self.quotas.nonpre_requests.any():
             self.quotas._ensure_capacity()
             # request = admitted non-preemptible usage everywhere, plus
@@ -3367,6 +3742,17 @@ class BatchScheduler:
             return
         if rep is not None:
             self.extender.registry.get("journal_compactions_total").inc()
+            if self.on_journal_compacted is not None:
+                try:
+                    self.on_journal_compacted()
+                except JournalWriteError as exc:
+                    # same contract as a failed compaction: the live
+                    # claim log is intact, maintenance just deferred
+                    report_exception(
+                        "scheduler.journal.claim_gc",
+                        exc,
+                        registry=self.extender.registry,
+                    )
 
     def _fence_stale(self) -> Optional[str]:
         """None when this scheduler's leadership grant is current (or no

@@ -159,7 +159,23 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
     reg.gauge(
         "solver_pipeline_depth",
         "overlapped pipeline stages in flight at the last pump return "
-        "(0 = idle, 1 = solve in flight, 2 = solve + trailing commit)",
+        "(0 = idle; each in-flight batch counts 1 plus 1 more when its "
+        "speculative solve is on device — depth>1 pipelining holds "
+        "several)",
+    )
+    # open-the-gates PR: carried quota/NUMA/device/gang state validation
+    reg.counter(
+        "pipeline_carry_mismatch_total",
+        "speculations discarded by consume-time carry validation, "
+        "attributed to the diverging table (host/device divergence, a "
+        "mid-pipeline subsystem arrival, or the pipeline.carry_mismatch "
+        "chaos point)",
+        labels=("table",),
+    )
+    reg.gauge(
+        "claim_tombstones_live",
+        "settled (tombstoned) uids currently retained by the cross-"
+        "shard ClaimTable, sampled after each tombstone GC sweep",
     )
     # HA PR: fenced leader failover + write-ahead bind journal
     reg.counter(

@@ -40,6 +40,7 @@ import dataclasses
 import queue as _queue
 import threading as _threading
 import time as _time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api.types import Pod
@@ -66,6 +67,43 @@ class PreparedCycle:
     quarantine: Dict[str, tuple]
     version: int
     node_epoch: int
+    #: frozen per-gang lowering inputs (open-the-gates PR): what the
+    #: live min-member/nonstrict views said when the rows were lowered —
+    #: consume-time validation re-derives and compares
+    gang_view: tuple = ()
+    #: quota TREE shape the rows' chains were lowered against; a tree
+    #: mutation between prepare and dispatch refuses the speculation
+    quota_tree_version: int = -1
+
+
+def _merge_outcomes(outs: List[ScheduleOutcome]) -> Optional[ScheduleOutcome]:
+    """Fold several cycles' outcomes into one (feed's tail drain and the
+    handoff drain both return multiple commits per call at depth>1).
+    Single source of truth so a future ScheduleOutcome field cannot be
+    dropped by one of two hand-rolled merge loops."""
+    if not outs:
+        return None
+    if len(outs) == 1:
+        return outs[0]
+    merged = ScheduleOutcome(bound=[], unschedulable=[])
+    for o in outs:
+        merged.bound.extend(o.bound)
+        merged.unschedulable.extend(o.unschedulable)
+        merged.rounds_used += o.rounds_used
+        merged.preempted.extend(o.preempted)
+    return merged
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One pending pipeline entry: a fed batch whose trailing commit has
+    not run yet, plus its speculative solve (None = serial) and the gate
+    verdicts evaluated for it at feed time."""
+
+    batch: List[Pod]
+    spec: object
+    span: object
+    gates: Dict[str, object]
 
 
 class _PrepareWorker:
@@ -175,10 +213,11 @@ class _PrepareWorker:
                 self._cond.notify_all()
 
     def _warm(self, batch: Sequence[Pod]) -> None:
-        """Gated cycles (quotas/NUMA/devices/...) can't take the chained
-        fast path, but the prepare worker still pays their per-pod parse
-        ahead of time: one throwaway lowering primes the interned-row
-        cache so the serial cycle's own ``build_pods`` hits it.
+        """Gated cycles (transformers/reservations/mesh/sampling/cold
+        gangs/unhealthy ladder) can't take the chained fast path, but
+        the prepare worker still pays their per-pod parse ahead of time:
+        one throwaway lowering primes the interned-row cache so the
+        serial cycle's own ``build_pods`` hits it.
         ``inject=False`` keeps scheduled NaN faults for the real
         lowering."""
         sched = self.sched
@@ -198,8 +237,19 @@ class _PrepareWorker:
                 "prepare", cat="pipeline", pods=len(batch)
             ):
                 quarantine: Dict[str, tuple] = {}
-                # pure under the pipeline gates (no gangs anywhere): a
-                # priority sort, no gang-state mutation
+                # captured BEFORE lowering: a quota-tree mutation racing
+                # the prepare bumps it, and the dispatch-time compare
+                # then refuses the speculation (stale lowered chains)
+                tree_v = sched.quotas.tree_version
+                # idempotent for warm-gang batches (the _prepare_ok
+                # gate): pending registries rebuild from the same batch
+                # at consume, no state creation beyond what the serial
+                # cycle would do, and no timeout branch can fire. The
+                # gang-state mutation is also SERIALIZED against the
+                # pump thread's trailing commit: this whole prepare runs
+                # under snap.lock (above), the same lock schedule()
+                # holds for its begin_and_order/Permit — the two
+                # interleave atomically, never mid-rebuild
                 eligible = sched.pod_groups.begin_and_order(batch)
                 chunks = sched._chunks(eligible)
                 triples = []
@@ -229,6 +279,8 @@ class _PrepareWorker:
                     quarantine=quarantine,
                     version=snap.version,
                     node_epoch=snap.node_epoch,
+                    gang_view=sched.pod_groups.gang_view(eligible),
+                    quota_tree_version=tree_v,
                 )
 
 
@@ -236,24 +288,37 @@ class CyclePipeline:
     """Pipelined cycle runner over a :class:`BatchScheduler`.
 
     ``feed(batch)`` dispatches ``batch``'s solves (speculatively, off the
-    previous cycle's device-chained state when valid) and runs the
-    PREVIOUS batch's trailing commit, returning its
-    :class:`ScheduleOutcome` — i.e. results lag one feed. ``feed([])`` /
-    :meth:`flush` drain the tail. Cycles that fail any pipeline gate
-    (quotas, NUMA/devices, gangs, transformers, reservations, mesh, node
-    sampling, an unhealthy ladder) or whose prepare worker stalls simply
-    run the serial path — same decisions, no overlap."""
+    newest in-flight cycle's device-chained state when valid) and — once
+    ``depth`` batches are in flight — runs the OLDEST batch's trailing
+    commit, returning its :class:`ScheduleOutcome` (results lag up to
+    ``depth`` feeds). ``feed([])`` / :meth:`flush` drain one tail entry
+    per call. Cycles that fail any pipeline gate (transformers,
+    reservations, mesh, node sampling, cold gangs, an unhealthy ladder)
+    or whose prepare worker stalls simply run the serial path — same
+    decisions, no overlap. Open-the-gates PR: quota-, NUMA-, device-
+    and warm-gang-bearing batches take the speculative path too — their
+    tables ride the device chain with bit-exact consume-time validation
+    (``BatchScheduler._carry_consume_ok``).
+
+    ``depth`` > 1 (multi-queue streams) holds that many speculative
+    solves in flight: batch k+1 chains off batch k's post-solve tables
+    before EITHER trailing commit has run, and the trailing-commit
+    validation generalizes to a chain — an unclean commit (or any
+    consume-guard miss) discards EVERY pending speculation downstream of
+    it, never just the head. Observable via ``solver_pipeline_depth``."""
 
     def __init__(
         self,
         sched: BatchScheduler,
         prepare_timeout_s: float = 5.0,
+        depth: int = 1,
     ):
         self.sched = sched
         self.prepare_timeout_s = prepare_timeout_s
+        self.depth = max(1, int(depth))
         self._worker = _PrepareWorker(sched)
-        #: (batch, SpeculativeSolve | None, overlap_span | None)
-        self._inflight: Optional[tuple] = None
+        #: in-flight entries, oldest first (≤ depth of them)
+        self._pending: "deque[_InFlight]" = deque()
         self._degraded = False
         #: gate introspection (distributed-observability PR): the most
         #: recent _gates_ok evaluation — which named gate kept the cycle
@@ -274,44 +339,56 @@ class CyclePipeline:
 
     @property
     def inflight(self) -> bool:
-        return self._inflight is not None
+        return bool(self._pending)
+
+    def inflight_pods(self) -> List[Pod]:
+        """Every pod currently inside the pipeline (fed, trailing commit
+        not yet returned) — with depth>1 this spans SEVERAL batches, so
+        crash drivers must orphan all of them, not just the last fed."""
+        return [p for e in self._pending for p in e.batch]
 
     def close(self) -> None:
         self._finalizer()
 
     def flush(self) -> Optional[ScheduleOutcome]:
-        """Complete the in-flight cycle (trailing commit) and return its
-        outcome; None when nothing was in flight."""
+        """Complete the OLDEST in-flight cycle (trailing commit) and
+        return its outcome; None when nothing was in flight. With
+        depth>1 call repeatedly (``while pipe.inflight``) to drain."""
         return self.feed([])
 
     def drain_for_handoff(self) -> Optional[ScheduleOutcome]:
-        """Leadership loss mid-pipeline (HA failover PR): the in-flight
+        """Leadership loss mid-pipeline (HA failover PR): every in-flight
         speculative solve was dispatched under an epoch that no longer
-        holds — DISCARD it (counted in ``pipeline_speculation_total
-        {outcome="discarded"}``), then flush the trailing commit so it
-        runs through the commit-boundary fencing check: with the grant
-        revoked every chunk is rejected with STALE_LEADER_EPOCH and the
-        batch's pods surface as unschedulable for the new leader to
-        place. The /healthz ``pipeline`` row carries the handoff state
-        while the drain runs."""
+        holds — DISCARD the whole pending chain (counted in
+        ``pipeline_speculation_total{outcome="discarded"}``), then flush
+        every trailing commit so each runs through the commit-boundary
+        fencing check: with the grant revoked every chunk is rejected
+        with STALE_LEADER_EPOCH and the batches' pods surface as
+        unschedulable for the new leader to place. Returns the MERGED
+        outcome across the drained entries. The /healthz ``pipeline``
+        row carries the handoff state while the drain runs."""
         sched = self.sched
         health = sched.extender.health
-        if self._inflight is None:
+        if not self._pending:
             return None
         health.set("pipeline", False, "leadership handoff: draining")
-        batch, spec, span, gates = self._inflight
-        if spec is not None:
-            sched.extender.registry.get(
-                "pipeline_speculation_total"
-            ).labels(outcome="discarded").inc()
-            if span is not None:
-                span.__exit__(None, None, None)
-            self._inflight = (batch, None, None, gates)
+        counter = sched.extender.registry.get("pipeline_speculation_total")
+        for entry in self._pending:
+            if entry.spec is not None:
+                counter.labels(outcome="discarded").inc()
+                if entry.span is not None:
+                    entry.span.__exit__(None, None, None)
+                entry.spec = None
+                entry.span = None
+        drained: List[ScheduleOutcome] = []
         try:
-            out = self.flush()
+            while self._pending:
+                out = self.feed([])
+                if out is not None:
+                    drained.append(out)
         finally:
             health.set("pipeline", True, "handoff drained")
-        return out
+        return _merge_outcomes(drained)
 
     def feed(self, batch: Sequence[Pod]) -> Optional[ScheduleOutcome]:
         sched = self.sched
@@ -321,60 +398,105 @@ class CyclePipeline:
         job = None
         full_ok = False
         this_gates: Dict[str, object] = {}
-        if batch and self._prepare_ok(batch):
-            # prepare stage: the worker lowers THIS batch while the
-            # previous cycle's solve is still in flight on device and
-            # while its trailing commit runs below. Gated cycles still
-            # prepare in warm-only mode (intern-cache priming) so the
-            # serial path's own lowering gets the hit.
-            full_ok = self._gates_ok(batch)
-            this_gates = self.last_gate_report
-            stall = sched.chaos.enabled and sched.chaos.fire(
-                "pipeline.worker_stall"
-            )
-            job = self._worker.submit(
-                batch, warm_only=not full_ok, stall=stall
-            )
+        if batch:
+            if self._prepare_ok(batch):
+                # prepare stage: the worker lowers THIS batch while the
+                # in-flight cycles' solves are still on device and while
+                # the oldest one's trailing commit runs below. Gated
+                # cycles still prepare in warm-only mode (intern-cache
+                # priming) so the serial path's own lowering gets the
+                # hit.
+                full_ok = self._gates_ok(batch)
+                this_gates = self.last_gate_report
+                stall = sched.chaos.enabled and sched.chaos.fire(
+                    "pipeline.worker_stall"
+                )
+                job = self._worker.submit(
+                    batch, warm_only=not full_ok, stall=stall
+                )
+            else:
+                # prepare refused (cold gangs / pod transformers): still
+                # evaluate and record the gate verdicts so /debug/
+                # pipeline and pipeline_gate_closed_total name WHY the
+                # cycle ran serial — introspection must not go dark on
+                # exactly the cycles that need explaining
+                self._gates_ok(batch)
+                this_gates = self.last_gate_report
         out: Optional[ScheduleOutcome] = None
         spec_new: Optional[SpeculativeSolve] = None
-        if self._inflight is not None:
-            prev_batch, prev_spec, prev_span, prev_gates = self._inflight
-            if job is not None and full_ok and prev_spec is not None:
-                # deep speculation: dispatch batch k's solves off cycle
-                # k-1's chained state BEFORE its commit — the device works
-                # through solve(k) while the host Reserve of k-1 trails
+        if self._pending:
+            newest = self._pending[-1]
+            if job is not None and full_ok and newest.spec is not None:
+                # deep speculation: dispatch batch k's solves off the
+                # NEWEST in-flight cycle's chained state BEFORE any
+                # trailing commit — with depth>1 that chain is itself
+                # speculative, so this solve rides a chain of pending
+                # validations
                 prep = self._collect(job)
                 job = None
                 if prep is not None and prep is not _PrepareWorker.WARMED:
                     spec_new = self._dispatch(
                         prep,
-                        chain=prev_spec.chain_out,
-                        chain_version=prev_spec.version,
+                        chain=newest.spec.chain_out,
+                        chain_version=newest.spec.version,
                     )
-            # trailing commit of cycle k-1 under the Reserve journal; the
-            # scheduler consumes prev_spec's solves when the guards hold.
-            # The gate verdicts handed to the flight recorder are the
-            # ones evaluated FOR this batch at its feed — not this
-            # call's fresher evaluation of batch k (off-by-one would put
-            # the next batch's gates on the completed cycle's record)
-            sched.last_gate_report = prev_gates
-            sched._speculative = prev_spec
-            out = sched.schedule(prev_batch)
-            if prev_span is not None:
-                prev_span.__exit__(None, None, None)
-            kept = prev_spec is not None and sched._cycle_used_spec
+        outs: List[ScheduleOutcome] = []
+        while self._pending and (
+            not batch
+            or len(self._pending) >= self.depth
+            # a serial newest entry caps the chain: nothing can dispatch
+            # off it, so holding depth only delays results — drain the
+            # tail now so the NEXT feed re-bootstraps speculation off
+            # fully-committed state
+            or self._pending[-1].spec is None
+        ):
+            # trailing commit of the OLDEST entry under the Reserve
+            # journal; the scheduler consumes its solves when the guards
+            # hold. The gate verdicts handed to the flight recorder are
+            # the ones evaluated FOR that batch at its feed — not this
+            # call's fresher evaluation (off-by-one would put the next
+            # batch's gates on the completed cycle's record)
+            entry = self._pending.popleft()
+            sched.last_gate_report = entry.gates
+            sched._speculative = entry.spec
+            outs.append(sched.schedule(entry.batch))
+            if entry.span is not None:
+                entry.span.__exit__(None, None, None)
+            kept = entry.spec is not None and sched._cycle_used_spec
             clean = kept and sched.last_cycle_spec_safe()
-            if spec_new is not None:
-                if clean:
-                    # retroactively valid: the commit applied exactly the
-                    # deltas the chain already carried — re-stamp to the
-                    # post-commit version so the consume guard can match
+            if clean:
+                # retroactively valid: the commit applied exactly the
+                # deltas the chain already carried — re-stamp EVERY
+                # still-pending speculation (they chained transitively)
+                # to the post-commit version so the consume guards match
+                for e in self._pending:
+                    if e.spec is not None:
+                        e.spec.version = sched._post_cycle_version
+                if spec_new is not None:
                     spec_new.version = sched._post_cycle_version
-                else:
-                    reg.get("pipeline_speculation_total").labels(
-                        outcome="discarded"
-                    ).inc()
-                    spec_new = None
+            else:
+                # an unvalidated commit poisons the WHOLE chain: every
+                # pending speculation downstream consumed state this
+                # commit did not prove — discard them all, not just the
+                # head (depth>1 correctness rule)
+                discards = sum(
+                    1 for e in self._pending if e.spec is not None
+                ) + (1 if spec_new is not None else 0)
+                if discards:
+                    counter = reg.get("pipeline_speculation_total")
+                    for _ in range(discards):
+                        counter.labels(outcome="discarded").inc()
+                for e in self._pending:
+                    if e.span is not None:
+                        e.span.__exit__(None, None, None)
+                    e.spec = None
+                    e.span = None
+                spec_new = None
+            if not batch:
+                # flush contract: drain exactly one entry per call
+                break
+        if outs:
+            out = _merge_outcomes(outs)
         if job is not None:
             # collect regardless of whether a dispatch can use it: the
             # warm-only ack IS the worker liveness probe (a stalled/dead
@@ -385,6 +507,14 @@ class CyclePipeline:
                 batch
                 and spec_new is None
                 and full_ok
+                # a fresh (post-commit) dispatch consumes the RESIDENT
+                # host state, which is only the truth when no trailing
+                # commit is still pending. By construction this holds
+                # whenever control reaches here with a live job (a
+                # chained attempt consumes the job, and a serial newest
+                # entry drains the window) — the guard makes the
+                # invariant explicit rather than emergent
+                and not self._pending
                 and prep is not None
                 and prep is not _PrepareWorker.WARMED
             ):
@@ -395,12 +525,15 @@ class CyclePipeline:
             # the window the device solve ran concurrently with host work
             span = tracer.span("overlap", cat="pipeline", pods=len(batch))
             span.__enter__()
-        self._inflight = (
-            (batch, spec_new, span, this_gates) if batch else None
+        if batch:
+            self._pending.append(
+                _InFlight(
+                    batch=batch, spec=spec_new, span=span, gates=this_gates
+                )
+            )
+        depth = sum(
+            1 + (1 if e.spec is not None else 0) for e in self._pending
         )
-        depth = 0
-        if self._inflight is not None:
-            depth = 2 if spec_new is not None else 1
         reg.get("solver_pipeline_depth").set(float(depth))
         return out
 
@@ -435,10 +568,13 @@ class CyclePipeline:
         chain,
         chain_version: Optional[int] = None,
     ) -> Optional[SpeculativeSolve]:
-        """Dispatch the prepared chunks chained off ``chain`` (or off the
-        refreshed resident state when None), under the snapshot lock so
-        the version stamp is exact. Returns None when the prepared
-        lowering no longer matches the live snapshot."""
+        """Dispatch the prepared chunks chained off ``chain`` (a
+        :class:`~.batch_solver.ChainCarry`, or off the refreshed resident
+        state when None), under the snapshot lock so the version stamp is
+        exact. Returns None when the prepared lowering no longer matches
+        the live snapshot."""
+        from .batch_solver import ChainCarry
+
         sched = self.sched
         snap = sched.snapshot
         if not prep.chunks:
@@ -446,6 +582,12 @@ class CyclePipeline:
         with snap.lock:
             v = snap.version
             if prep.node_epoch != snap.node_epoch:
+                return None
+            if (
+                sched.quotas.quota_count > 0
+                and prep.quota_tree_version != sched.quotas.tree_version
+            ):
+                # the rows' lowered quota chains describe a dead tree
                 return None
             if chain is not None:
                 # pre-commit dispatch: the chain AND the prepared lowering
@@ -465,18 +607,24 @@ class CyclePipeline:
                     )
                 ):
                     return None
-                chain = sched.node_state(None)
+                chain = ChainCarry(nodes=sched.node_state(None))
             with sched.extender.tracer.span(
                 "pipeline:dispatch",
                 cat="pipeline",
                 chunks=len(prep.chunks),
             ):
-                solves, chain_out = sched._dispatch_chained(
+                dispatched = sched._dispatch_chained(
                     prep.chunks,
                     chain,
                     quarantine=prep.quarantine,
                     prepared=prep.triples,
+                    gang_view=prep.gang_view,
                 )
+            if dispatched is None:
+                # a carried table no longer matches the live shapes
+                # (tree/topology reshape mid-chain) — no speculation
+                return None
+            solves, chain_out, carry = dispatched
             return SpeculativeSolve(
                 chunk_uids=prep.chunk_uids,
                 sub=None,
@@ -484,20 +632,26 @@ class CyclePipeline:
                 chain_out=chain_out,
                 version=v,
                 node_epoch=prep.node_epoch,
+                carry=carry,
                 quarantine=prep.quarantine,
                 dispatched_at=_time.perf_counter(),
             )
 
     def _prepare_ok(self, batch: Sequence[Pod]) -> bool:
         """Whether the worker may touch this batch at all: prepare must
-        be a PURE read of the pods + snapshot (gang bookkeeping and pod
-        transformers mutate state the real cycle will mutate again)."""
-        from .plugins.coscheduling import gang_key_of
-
+        be an IDEMPOTENT read of the pods + snapshot (pod transformers
+        mutate state the real cycle will mutate again, so they stay
+        out). Open-the-gates PR: warm-gang batches qualify — for them
+        ``begin_and_order`` rebuilds the same pending registries the
+        consuming cycle will rebuild from the same batch, creates no
+        timeout mutation, and the lowered gang rows are validated
+        against the live views at consume. Cold gangs (members missing,
+        or a gang already past its schedule timeout) keep the prepare
+        out entirely, like before."""
         sched = self.sched
-        if sched.pod_groups.has_gangs or sched.extender._pre_batch:
+        if sched.extender._pre_batch:
             return False
-        return all(gang_key_of(p) is None for p in batch)
+        return sched.pod_groups.batch_gangs_warm(batch)
 
     def gate_info(self) -> Dict[str, object]:
         """/debug/pipeline payload: the latest per-gate verdicts plus
@@ -512,31 +666,34 @@ class CyclePipeline:
             "cycles_gated": self._gated_cycles,
             "cycles_fast": self._fast_cycles,
             "depth": depth.value() if depth is not None else 0.0,
+            "max_depth": self.depth,
         }
 
     def _gates_ok(self, batch: Sequence[Pod]) -> bool:
         """Whether this batch may take the speculative fast path. Every
-        gate names a subsystem whose host-side commit state the device
-        chain cannot carry exactly (or whose bookkeeping the speculative
-        ordering would double-run); gated cycles run serial — identical
-        decisions, no overlap. The state-bearing subset
-        (``_speculation_consume_ok``) is re-checked by the scheduler at
-        consume time: a gated subsystem arriving mid-pipeline through an
-        informer invalidates the in-flight speculation.
+        CLOSED gate names a subsystem whose host-side commit state the
+        device chain cannot carry exactly (or whose bookkeeping the
+        speculative ordering would double-run); gated cycles run serial
+        — identical decisions, no overlap. Open-the-gates PR: quotas,
+        NUMA, devices and warm gangs no longer close — their tables ride
+        the device chain and ``_carry_consume_ok`` proves the inputs
+        bit-exact at consume (any divergence discards, serial-identical
+        either way). The still-gated subset is re-checked by the
+        scheduler at consume time: a gated subsystem arriving
+        mid-pipeline through an informer invalidates the in-flight
+        speculation.
 
         Every evaluation records WHICH gates closed: per-gate counts in
         ``pipeline_gate_closed_total{gate}`` and the latest full report
         on :attr:`last_gate_report` (served at ``/debug/pipeline``)."""
-        from .plugins.coscheduling import gang_key_of
-
         sched = self.sched
         gates = sched.speculation_gate_report()
         gates["ladder"] = (
             sched._fallback_level == 0 and sched._bucket_degrade == 0
         )
-        gates["batch_gangs"] = all(
-            gang_key_of(p) is None for p in batch
-        )
+        # warm gangs ride the chain; cold gangs (members missing or a
+        # gang in timeout) keep the batch serial
+        gates["batch_gangs"] = sched.pod_groups.batch_gangs_warm(batch)
         closed = sorted(g for g, open_ in gates.items() if not open_)
         self.last_gate_report = {
             "batch": len(batch),

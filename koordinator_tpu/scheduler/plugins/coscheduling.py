@@ -338,6 +338,80 @@ class PodGroupManager:
             return False, f"gang {key} has {total}/{need} members"
         return True, ""
 
+    def batch_gangs_warm(self, batch: Sequence[Pod]) -> bool:
+        """Whether every gang-labeled pod in ``batch`` belongs to a WARM
+        gang — the cross-cycle pipeline's ``batch_gangs`` gate (open the
+        speculation gates PR). Warm means the gang's satisfaction verdict
+        is derivable from the batch alone and a speculative prepare is
+        harmless:
+
+        * a known once-satisfied gang (stragglers schedule individually);
+        * a gang — known or first-seen — whose minMember is met by this
+          batch's members plus already-bound credit;
+        * and, for known gangs, NOT currently past its schedule timeout
+          (the timeout branch of ``_gate`` mutates state and stamps the
+          member, which a discarded speculation must never double-run).
+
+        Read-only: unlike ``begin_and_order`` this registers nothing, so
+        the PUMP thread can evaluate it before deciding whether the
+        prepare worker may touch the batch. Cold gangs (members missing)
+        simply keep the gate closed — the serial cycle gates them like
+        before."""
+        members: Dict[str, int] = {}
+        first: Dict[str, Pod] = {}
+        for pod in batch:
+            key = gang_key_of(pod)
+            if key is None:
+                continue
+            members[key] = members.get(key, 0) + 1
+            first.setdefault(key, pod)
+        if not members:
+            return True
+        now = time.time()
+        for key, count in members.items():
+            state = self._gangs.get(key)
+            if state is None:
+                # first sight of the gang: warm iff the batch itself
+                # carries min-available (else unknowable) and meets it
+                mm = ext.gang_min_available_of(first[key])
+                if mm is None or count < mm:
+                    return False
+                continue
+            if state.once_satisfied:
+                continue
+            need = state.effective_min(count)
+            if (
+                state.bound_credit < need
+                and now - state.create_time > state.schedule_timeout_s
+            ):
+                return False
+            if count + state.bound_credit < need:
+                return False
+        return True
+
+    def gang_view(self, batch: Sequence[Pod]) -> tuple:
+        """Frozen per-gang lowering inputs for ``batch``, exactly as
+        ``build_pods`` would read them through the live
+        :meth:`min_member_map` / :meth:`nonstrict_map` views: one
+        ``(key, outstanding_min, nonstrict)`` triple per distinct gang.
+        The pipeline stamps this on a speculative solve at lowering time
+        and re-derives it at consume — a mid-pipeline change (a member
+        bound by the trailing commit shrinking the outstanding min, a
+        mode declaration arriving) makes the views diverge and the
+        speculation is discarded instead of consumed with stale gang
+        rows."""
+        mm = _MinMemberView(self._gangs)
+        ns = _NonStrictView(self._gangs)
+        seen = []
+        done = set()
+        for pod in batch:
+            key = gang_key_of(pod)
+            if key is None or key in done:
+                continue
+            done.add(key)
+            seen.append((key, mm.get(key), ns.get(key)))
+        return tuple(seen)
+
     def min_member_map(self) -> "Mapping[str, int]":
         """Per-gang minMember still outstanding for the solver: already
         bound members reduce the requirement, so stragglers joining a

@@ -160,6 +160,12 @@ class GroupQuotaManager:
         #: device-resident QuotaState upload off it, so a cycle whose
         #: quota accounting didn't move re-uses the resident copy
         self.state_version = 0
+        #: bumped ONLY on tree mutations (upsert/remove) — unlike
+        #: ``state_version`` it is untouched by per-cycle charges, so
+        #: the pipeline's speculative solves use it to prove the quota
+        #: chains they lowered (leaf-to-root index paths) still describe
+        #: the live tree at consume time (open-the-gates PR)
+        self.tree_version = 0
         #: memoized leaf-to-root index paths; rebuilt on tree mutations
         #: (chain_of was a visible slice of the per-winner commit loop)
         self._chain_cache: Dict[str, List[int]] = {}
@@ -203,6 +209,7 @@ class GroupQuotaManager:
                 node.children.append(other)
         self._dirty = True
         self.state_version += 1
+        self.tree_version += 1
         self._chain_cache.clear()
         self._chain_row_cache.clear()
 
@@ -244,6 +251,7 @@ class GroupQuotaManager:
         self.nonpre_requests = new_nonpre_req
         self._dirty = True
         self.state_version += 1
+        self.tree_version += 1
 
     def set_cluster_total(self, total: Mapping[str, float]) -> None:
         """Explicit capacity budget (the multi-tree handler gives each tree
@@ -494,6 +502,18 @@ class GroupQuotaManager:
         demanding over its own max must not inflate its parent's share of
         the grandparent's pool. ``child_requests`` keeps the uncapped sum
         (the reference's ChildRequest annotation)."""
+        req, child_req = self._propagate_requests(by_leaf)
+        self.requests = req
+        self.child_requests = child_req
+        self._dirty = True
+
+    def _propagate_requests(
+        self, by_leaf: Mapping[str, np.ndarray]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The pure propagation behind :meth:`set_leaf_requests` —
+        shared verbatim with the pipeline's speculative PREVIEW
+        (:meth:`preview_arrays_extended`), which must reproduce the
+        mutating path bit-exactly without touching manager state."""
         q = max(self.quota_count, 1)
         d = self.config.dims
         req = np.zeros((q, d), np.float32)
@@ -527,22 +547,13 @@ class GroupQuotaManager:
         for n in self._order:
             if (self._nodes[n].quota.parent or ROOT) == ROOT:
                 visit(n)
-        self.requests = req
-        self.child_requests = child_req
-        self._dirty = True
+        return req, child_req
 
     # ---- runtime refresh (water-filling down the tree) ----
 
     def refresh_runtime(self) -> np.ndarray:
-        q = max(self.quota_count, 1)
-        d = self.config.dims
-        runtime = np.zeros((q, d), np.float32)
         self._ensure_capacity()
-
-        roots = [
-            n for n in self._order if (self._nodes[n].quota.parent or ROOT) == ROOT
-        ]
-        self._fill_level(roots, self._cluster_total, runtime)
+        runtime = self._compute_runtime(self.requests, self._cluster_total)
         if runtime.shape != self.runtime.shape or not np.array_equal(
             runtime, self.runtime
         ):
@@ -554,8 +565,61 @@ class GroupQuotaManager:
         self._dirty = False
         return runtime
 
+    def _compute_runtime(
+        self, requests: np.ndarray, total: np.ndarray
+    ) -> np.ndarray:
+        """The pure water-fill behind :meth:`refresh_runtime`, shared
+        with the speculative preview (same code, same rounding — the
+        preview's bit-exactness against the later real refresh is what
+        lets a kept speculation claim decision identity)."""
+        q = max(self.quota_count, 1)
+        d = self.config.dims
+        runtime = np.zeros((q, d), np.float32)
+        roots = [
+            n for n in self._order if (self._nodes[n].quota.parent or ROOT) == ROOT
+        ]
+        self._fill_level(roots, total, runtime, requests)
+        return runtime
+
+    def preview_arrays_extended(
+        self, by_leaf: Mapping[str, np.ndarray], total: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """PURE preview of :meth:`quota_arrays_extended` as a future
+        cycle carrying ``by_leaf`` pending demand would see it — no
+        manager state is touched (open-the-gates PR: the pipeline's
+        speculative dispatch runs while the PREVIOUS cycle's PostFilter
+        still reads the live requests/runtime, so the real mutating
+        propagation must wait for consume time). Returns
+        ``(runtime_ext, used_ext)`` with the same shadow-row doubling as
+        the real lowering; the consuming cycle re-runs the mutating path
+        and keeps the speculation only when the tables match bit-exactly."""
+        self._ensure_capacity()
+        req, _child = self._propagate_requests(by_leaf)
+        runtime = self._compute_runtime(req, np.asarray(total, np.float32))
+        if self.quota_count == 0:
+            d = self.config.dims
+            return (
+                np.full((1, d), np.inf, np.float32),
+                np.zeros((1, d), np.float32),
+            )
+        return (
+            np.concatenate([runtime, self.mins_array()]),
+            np.concatenate([self.used, self.nonpre_used[: runtime.shape[0]]]),
+        )
+
+    def effective_cluster_total(self, snapshot) -> np.ndarray:
+        """The fair-sharing budget :meth:`sync_cluster_total` WOULD adopt
+        for ``snapshot`` — computed without mutating (preview side)."""
+        if getattr(self, "_explicit_total", False):
+            return self._cluster_total
+        return snapshot.nodes.allocatable.sum(axis=0).astype(np.float32)
+
     def _fill_level(
-        self, names: Sequence[str], total: np.ndarray, runtime: np.ndarray
+        self,
+        names: Sequence[str],
+        total: np.ndarray,
+        runtime: np.ndarray,
+        requests: Optional[np.ndarray] = None,
     ) -> None:
         if not names:
             return
@@ -579,8 +643,10 @@ class GroupQuotaManager:
             mins = scale_mins_over_root(
                 mins, np.ones(len(names), bool), total
             )
-        requests = self.requests[idxs]
-        guaranteed = np.minimum(mins, requests)
+        if requests is None:
+            requests = self.requests
+        level_requests = requests[idxs]
+        guaranteed = np.minimum(mins, level_requests)
         # allow-lent-resource=false: the quota's UNUSED min is never lent
         # to siblings — the full min stays reserved regardless of demand
         # (reference quotaNode.AllowLentResource in the redistribution)
@@ -588,13 +654,13 @@ class GroupQuotaManager:
             [self._nodes[n].quota.allow_lent_resource for n in names], bool
         )
         guaranteed = np.where(lent_ok[:, None], guaranteed, mins)
-        caps = np.maximum(np.minimum(maxs, requests), guaranteed)
+        caps = np.maximum(np.minimum(maxs, level_requests), guaranteed)
         shares = water_fill(total, guaranteed, caps, weights)
         for row, n in enumerate(names):
             runtime[self._nodes[n].index] = shares[row]
             kids = self._nodes[n].children
             if kids:
-                self._fill_level(kids, shares[row], runtime)
+                self._fill_level(kids, shares[row], runtime, requests)
 
     # ---- solver lowering ----
 

@@ -43,7 +43,10 @@ class StreamScheduler:
     on-device commit state while that cycle's host Reserve trails behind
     — and returns the PREVIOUS batch's decisions (one-pump lag; call
     :meth:`flush` to drain the tail). Decisions are identical to the
-    serial pump; only the overlap differs.
+    serial pump; only the overlap differs. ``pipeline_depth`` > 1
+    (open-the-gates PR) lets the pipeline hold that many speculative
+    solves in flight (decisions then lag up to ``pipeline_depth``
+    pumps; the flush loop drains them all).
 
     Distributed observability (fleet-tracing PR): ``lifecycle`` (a
     :class:`~..obs.lifecycle.PodLifecycle`) receives per-pod
@@ -61,6 +64,7 @@ class StreamScheduler:
         max_batch: int = 256,
         max_retries: int = 3,
         pipelined: bool = False,
+        pipeline_depth: int = 1,
         prepare_timeout_s: float = 5.0,
         feed_gate=None,
         lifecycle=None,
@@ -94,7 +98,9 @@ class StreamScheduler:
             from .pipeline import CyclePipeline
 
             self._pipe = CyclePipeline(
-                scheduler, prepare_timeout_s=prepare_timeout_s
+                scheduler,
+                prepare_timeout_s=prepare_timeout_s,
+                depth=pipeline_depth,
             )
 
     def submit(self, pod: Pod, now: Optional[float] = None) -> None:

@@ -750,8 +750,18 @@ def run_chaos_soak(
         # generous prepare deadline: a chaos-KILLED worker is detected
         # promptly via thread death (collect returns early), so the
         # timeout only bounds a genuinely slow prepare — a tight value
-        # makes the stall/health accounting flake under host contention
-        return s, CyclePipeline(s, prepare_timeout_s=10.0), r
+        # makes the stall/health accounting flake under host contention.
+        # depth=2 (open-the-gates PR): the plain arm runs the DEEP
+        # pipeline — two speculative solves in flight, quota-bearing
+        # cycles riding the opened gates — so every invariant below also
+        # proves the chain-of-validations discipline under chaos. The HA
+        # arm stays at depth 1: its crash-window calibration (the surge
+        # fed exactly one cycle before the kill so journaled-but-unacked
+        # binds land in the crash commit) is lag-1 by design, and the
+        # depth>1 discard-chain behavior has its own dedicated arms in
+        # tests/test_pipelined_stream.py.
+        depth = 1 if ha else 2
+        return s, CyclePipeline(s, prepare_timeout_s=10.0, depth=depth), r
 
     sched, pipe, reg = _make_instance(snap, gqm)
 
@@ -857,6 +867,9 @@ def run_chaos_soak(
     # exempted to their dedicated fault tests instead.)
     ladder_cycle = max(1, cycles // 4)       # full fallback ladder
     sync_delay_cycle = max(1, cycles // 6)   # channel latency injection
+    # open-the-gates PR: corrupt one chained quota/NUMA/device carry at
+    # consume — the discard-and-redispatch path under full soak load
+    carry_mismatch_cycle = max(3, (2 * cycles) // 7)
     stale_commit_cycle = max(2, cycles // 5)     # ha: fenced commit
     journal_fault_cycle = max(4, (2 * cycles) // 5)  # ha: append refusal
     # HA leg (failover PR): one scheduled kill-restart well after the
@@ -870,7 +883,6 @@ def run_chaos_soak(
     # ---- HA coordinator: lease election + epoch fence + recovery ----
     coord = None
     incarnation = 0
-    inflight_fed: list = []  # the batch currently inside the pipeline
     lost_pods: list = []     # decided-or-inflight pods orphaned by a crash
     recovered_sync: list = []  # journal-recovered binds awaiting sidecar sync
     if ha:
@@ -918,12 +930,11 @@ def run_chaos_soak(
         fresh incarnation re-wires and will take over once the dead
         leader's lease expires."""
         nonlocal snap, gqm, sched, pipe, reg, coord, q_idx
-        nonlocal incarnation, inflight_fed, lost_pods
+        nonlocal incarnation, lost_pods
         stats["crash_restarts"] += 1
         pipe.close()   # resource hygiene only — all state is discarded
         hub.detach_consumers()
         lost_pods = [p for p in orphans if p.meta.uid not in placed]
-        inflight_fed = []
         incarnation += 1
         snap = ClusterSnapshot()
         gqm = GroupQuotaManager(snap.config, enable_preemption=False)
@@ -1023,6 +1034,11 @@ def run_chaos_soak(
                 )
             if use_channel and cycle == sync_delay_cycle:
                 chaos.arm("channel.sync.delay", latency_s=0.01, times=1)
+            if cycle == carry_mismatch_cycle:
+                # fixed-cycle arm, probability 1: fires without drawing
+                # from any rng stream, so historical seeded schedules
+                # stay bit-identical (same rule as the other fixed arms)
+                chaos.arm("pipeline.carry_mismatch", times=1)
             if ha and cycle == stale_commit_cycle:
                 chaos.arm("leader.stale_commit", times=1)  # fenced, no retry charge
             if ha and cycle == journal_fault_cycle:
@@ -1097,7 +1113,6 @@ def run_chaos_soak(
                     recovered_sync.append((pod, node))
                     stats["placed"] += 1
                 pending.extend(drained.unschedulable)
-                inflight_fed = []
             if leading and lost_pods:
                 # reconcile the crash's orphans against the journal:
                 # an ACKNOWLEDGED (journaled) binding is recovered —
@@ -1142,7 +1157,6 @@ def run_chaos_soak(
             fed = list(pending)
             pending = []
             out = pipe.feed(fed)
-            inflight_fed = fed
             fed_this_cycle = True
             if out is None:
                 out = ScheduleOutcome(bound=[], unschedulable=[])
@@ -1156,10 +1170,12 @@ def run_chaos_soak(
             # never observes `out` (decided-but-unacknowledged), and the
             # freshly fed batch dies in flight — both sets become the
             # takeover's reconciliation problem
+            # depth>1: SEVERAL batches can be inside the pipeline —
+            # orphan them all, not just the last fed
             orphans = (
                 [p for p, _n in out.bound]
                 + list(out.unschedulable)
-                + list(inflight_fed)
+                + pipe.inflight_pods()
             )
             out = ScheduleOutcome(bound=[], unschedulable=[])
             _crash_restart(orphans)
@@ -1219,11 +1235,14 @@ def run_chaos_soak(
                 f"fallback_level={sched._fallback_level}"
             )
 
-    # drain the pipeline's in-flight tail (loop exhaustion may leave one
-    # batch mid-flight; a break can't — its condition requires an empty
-    # pipeline) and account it exactly like an in-loop cycle
-    final = pipe.flush()
-    if final is not None:
+    # drain the pipeline's in-flight tail (loop exhaustion may leave up
+    # to `depth` batches mid-flight; a break can't — its condition
+    # requires an empty pipeline) and account each exactly like an
+    # in-loop cycle
+    while pipe.inflight:
+        final = pipe.flush()
+        if final is None:
+            continue
         final_bound = []
         for pod, node in final.bound:
             assert pod.meta.uid not in placed, (

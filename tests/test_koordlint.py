@@ -84,7 +84,7 @@ def test_cli_exits_zero_on_tree(capsys):
     rc = cli_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "0 finding(s)" in out and "8 passes" in out
+    assert "0 finding(s)" in out and "9 passes" in out
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +210,19 @@ FIXTURES = {
             """,
         },
         "BV001",
+    ),
+    "gate-coverage": (
+        {
+            "koordinator_tpu/scheduler/batch_solver.py": """
+            class BatchScheduler:
+                def speculation_gate_report(self):
+                    return {"brand_new_gate": True}
+            """,
+            "tests/test_pipelined_stream.py": """
+            GATE_ARMS = {}
+            """,
+        },
+        "GT001",
     ),
 }
 

@@ -4,7 +4,17 @@ must be DECISION-IDENTICAL to the serial pump over a multi-cycle stream
 host prepare/commit stages with the device solve. Plus the satellites:
 donated in-place resident refresh (zero fresh full-axis buffers),
 resident PodBatch interning, and the ``pipeline.worker_stall`` failure
-domain (degrade to serial + /healthz + recovery, never a wedge)."""
+domain (degrade to serial + /healthz + recovery, never a wedge).
+
+Open-the-gates PR: one bit-exact EQUIVALENCE ARM per opened speculation
+gate (quota/NUMA/device/warm-gang carries), declared in ``GATE_ARMS``
+below and enforced by the koordlint ``gate-coverage`` pass — each arm
+drives the same fixed batch sequence through the pipelined and serial
+paths (with retries, mid-pipeline churn and a commit rollback) and
+asserts identical decisions AND identical end-state manager tables,
+with the speculative path proven ENGAGED. Depth>1 pipelining gets its
+own chain-discard arms: node churn, fence revocation and
+fallback-ladder demotion must each discard the ENTIRE pending chain."""
 
 import warnings
 
@@ -500,3 +510,633 @@ def test_numa_device_dirty_row_scatter():
     np.testing.assert_array_equal(
         np.asarray(dev_state.slot_free), dm.slot_array()
     )
+
+
+# ---------------------------------------------------------------------------
+# Open-the-gates PR: per-gate bit-exact equivalence arms (koordlint
+# gate-coverage pass: every named gate must appear here or carry a
+# written exemption in tools/koordlint/passes/gate_coverage.py)
+# ---------------------------------------------------------------------------
+
+#: gate name -> equivalence-arm test function(s) in THIS file
+GATE_ARMS = {
+    "quotas": "test_gate_quota_equivalence",
+    "numa": "test_gate_numa_equivalence",
+    "devices": "test_gate_device_equivalence",
+    "gangs": "test_gate_gang_equivalence",
+    "batch_gangs": (
+        "test_gate_gang_equivalence",
+        "test_cold_gang_batch_stays_serial",
+    ),
+    "ladder": "test_depth2_ladder_demotion_discards_chain",
+}
+
+
+def _drive_fixed(
+    sched,
+    batches,
+    pipelined,
+    depth=1,
+    churn_at=None,
+    rollback_at_commit=None,
+    chaos=None,
+    refeed_unsched=True,
+):
+    """Drive the SAME fixed batch sequence through the pipelined or the
+    serial path (the honest equivalence frame: the stream pump's retry
+    re-queue timing legitimately shifts batch composition between modes,
+    so equivalence is asserted cycle-for-cycle on identical batches).
+    ``churn_at`` removes one node + adds a fresh one before that batch
+    index WITHOUT flushing — in pipelined mode the in-flight speculation
+    goes stale and must be discarded, re-dispatching serial-identically.
+    ``rollback_at_commit`` arms ``commit.crash`` on that 1-based commit
+    evaluation (both modes hit the same commit sequence, so the same
+    chunk rolls back). Unschedulable pods are re-fed once at the end
+    (deterministic retry). Returns {pod name: node | None}."""
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    decided = {}
+
+    def absorb(out):
+        if out is None:
+            return
+        for p, nd in out.bound:
+            decided[p.meta.name] = nd
+        for p in out.unschedulable:
+            decided[p.meta.name] = None
+
+    if rollback_at_commit is not None:
+        chaos.arm(
+            "commit.crash",
+            error=RuntimeError,
+            at_hits=frozenset([rollback_at_commit]),
+            times=1,
+        )
+    pipe = CyclePipeline(sched, depth=depth) if pipelined else None
+    try:
+        for k, batch in enumerate(batches):
+            if churn_at is not None and k == churn_at:
+                snap = sched.snapshot
+                snap.remove_node(snap.node_name(1))
+                snap.upsert_node(_node("late-node"))
+            if pipe is not None:
+                absorb(pipe.feed(batch))
+            else:
+                absorb(sched.schedule(batch))
+        if pipe is not None:
+            while pipe.inflight:
+                absorb(pipe.flush())
+        if refeed_unsched:
+            retry = [
+                p
+                for batch in batches
+                for p in batch
+                if decided.get(p.meta.name) is None
+            ]
+            if retry:
+                if pipe is not None:
+                    absorb(pipe.feed(retry))
+                    while pipe.inflight:
+                        absorb(pipe.flush())
+                else:
+                    absorb(sched.schedule(retry))
+    finally:
+        if pipe is not None:
+            pipe.close()
+    return decided
+
+
+def _spec_counts(sched):
+    reg = sched.extender.registry
+    c = reg.get("pipeline_speculation_total")
+    return c.value(outcome="kept"), c.value(outcome="discarded")
+
+
+def _build_quota(n_nodes=32, chaos=None):
+    from koordinator_tpu.api.types import ElasticQuota
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        GroupQuotaManager,
+    )
+
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(_node(f"n{i:03d}"))
+    gqm = GroupQuotaManager(snap.config)
+    gqm.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="org"),
+            min={ext.RES_CPU: 8000, ext.RES_MEMORY: 32768},
+            max={ext.RES_CPU: 200000, ext.RES_MEMORY: 800000},
+            is_parent=True,
+        )
+    )
+    gqm.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="team"),
+            parent="org",
+            min={ext.RES_CPU: 4000, ext.RES_MEMORY: 16384},
+            max={ext.RES_CPU: 100000, ext.RES_MEMORY: 400000},
+        )
+    )
+    kw = {"chaos": chaos} if chaos is not None else {}
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), quotas=gqm, batch_bucket=64, **kw
+    )
+    sched.extender.monitor.stop_background()
+    return sched
+
+
+def _quota_pods(n):
+    return [
+        Pod(
+            meta=ObjectMeta(
+                name=f"q{i:04d}", labels={ext.LABEL_QUOTA_NAME: "team"}
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 1000, ext.RES_MEMORY: 2048},
+                priority=9000 - (i % 7),
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def test_gate_quota_equivalence():
+    """Quota-table chaining: quota-bearing batches take the speculative
+    path (kept > 0, quotas gate never closed) and stay bit-exact vs
+    serial — decisions, the used ledger and the runtime table — across
+    saturation (admission rejections), mid-pipeline node churn and a
+    Reserve-journal rollback."""
+    from koordinator_tpu.chaos import FaultInjector
+
+    batches = lambda: [  # noqa: E731
+        _quota_pods(300)[i * 50 : (i + 1) * 50] for i in range(6)
+    ]
+    ca = FaultInjector(seed=3)
+    a = _build_quota(chaos=ca)
+    da = _drive_fixed(
+        a, batches(), pipelined=False, churn_at=3,
+        rollback_at_commit=4, chaos=ca,
+    )
+    cb = FaultInjector(seed=3)
+    b = _build_quota(chaos=cb)
+    db = _drive_fixed(
+        b, batches(), pipelined=True, churn_at=3,
+        rollback_at_commit=4, chaos=cb,
+    )
+    kept, _disc = _spec_counts(b)
+    assert kept > 0, "quota-bearing speculation never engaged"
+    assert da == db
+    assert any(v is None for v in db.values()), (
+        "fixture must saturate the quota (admission arm untested)"
+    )
+    assert np.array_equal(a.quotas.used, b.quotas.used)
+    assert np.array_equal(
+        a.quotas.quota_arrays()[0], b.quotas.quota_arrays()[0]
+    )
+    closed = b.extender.registry.get("pipeline_gate_closed_total")
+    assert closed.value(gate="quotas") == 0.0
+
+
+def _build_numa(n_nodes=24, chaos=None):
+    from koordinator_tpu.core.topology import CPUTopology
+    from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+        NUMAManager,
+        NUMAPolicy,
+    )
+
+    topo = CPUTopology.uniform(
+        sockets=2, numa_per_socket=1, cores_per_numa=16
+    )
+    snap = ClusterSnapshot()
+    numa = NUMAManager(snap)
+    for i in range(n_nodes):
+        name = f"n{i:03d}"
+        snap.upsert_node(_node(name, cpu=64000, mem=262144))
+        numa.register_node(
+            name, topo, NUMAPolicy.SINGLE_NUMA_NODE,
+            memory_per_zone_mib=131072,
+        )
+
+    def register_late(node_name):
+        numa.register_node(
+            node_name, topo, NUMAPolicy.SINGLE_NUMA_NODE,
+            memory_per_zone_mib=131072,
+        )
+
+    kw = {"chaos": chaos} if chaos is not None else {}
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), numa=numa, batch_bucket=32, **kw
+    )
+    sched.extender.monitor.stop_background()
+    sched._register_late = register_late
+    return sched
+
+
+def _numa_pods(n):
+    return [
+        Pod(
+            meta=ObjectMeta(
+                name=f"m{i:04d}", labels={ext.LABEL_POD_QOS: "LSR"}
+            ),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                priority=9500 - (i % 5),
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def test_gate_numa_equivalence():
+    """NUMA cross-cycle carry: zone-bearing batches speculate (gate
+    never closed) and stay bit-exact vs serial — decisions AND the
+    managers' zone-free tables — including the exact cpuset host commit
+    and a mid-stream rollback."""
+    from koordinator_tpu.chaos import FaultInjector
+
+    batches = lambda: [  # noqa: E731
+        _numa_pods(192)[i * 32 : (i + 1) * 32] for i in range(6)
+    ]
+    ca = FaultInjector(seed=4)
+    a = _build_numa(chaos=ca)
+    da = _drive_fixed(
+        a, batches(), pipelined=False, rollback_at_commit=3, chaos=ca
+    )
+    cb = FaultInjector(seed=4)
+    b = _build_numa(chaos=cb)
+    db = _drive_fixed(
+        b, batches(), pipelined=True, rollback_at_commit=3, chaos=cb
+    )
+    kept, _disc = _spec_counts(b)
+    assert kept > 0, "NUMA-bearing speculation never engaged"
+    assert da == db
+    np.testing.assert_array_equal(a.numa.arrays()[0], b.numa.arrays()[0])
+    closed = b.extender.registry.get("pipeline_gate_closed_total")
+    assert closed.value(gate="numa") == 0.0
+
+
+def _build_devices(n_nodes=24, chaos=None, gpus=8):
+    from koordinator_tpu.api.types import Device, DeviceInfo
+    from koordinator_tpu.scheduler.plugins.deviceshare import DeviceManager
+
+    snap = ClusterSnapshot()
+    dm = DeviceManager(snap)
+    for i in range(n_nodes):
+        name = f"g{i:03d}"
+        snap.upsert_node(_node(name, cpu=128000, mem=1 << 20))
+        dm.upsert_device(
+            Device(
+                meta=ObjectMeta(name=name),
+                devices=[
+                    DeviceInfo(dev_type="gpu", minor=g, numa_node=g // 4)
+                    for g in range(gpus)
+                ],
+            )
+        )
+    kw = {"chaos": chaos} if chaos is not None else {}
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), devices=dm, batch_bucket=32, **kw
+    )
+    sched.extender.monitor.stop_background()
+    return sched
+
+
+def _device_pods(n):
+    pods = []
+    for i in range(n):
+        req = {ext.RES_CPU: 4000, ext.RES_MEMORY: 16384}
+        kind = i % 4
+        if kind == 0:
+            req[ext.RES_GPU] = 2
+        elif kind == 1:
+            req[ext.RES_GPU] = 1
+        elif kind == 2:
+            req[ext.RES_GPU_MEMORY_RATIO] = 50
+        else:
+            req[ext.RES_GPU_MEMORY_RATIO] = 30
+        pods.append(
+            Pod(
+                meta=ObjectMeta(name=f"d{i:04d}"),
+                spec=PodSpec(requests=req, priority=9000 - (i % 3)),
+            )
+        )
+    return pods
+
+
+def test_gate_device_equivalence():
+    """Device cross-cycle carry: GPU-bearing batches (whole AND
+    fractional shares) speculate and stay bit-exact vs serial —
+    decisions and the exact per-slot table — with a rollback arm."""
+    from koordinator_tpu.chaos import FaultInjector
+
+    batches = lambda: [  # noqa: E731
+        _device_pods(160)[i * 32 : (i + 1) * 32] for i in range(5)
+    ]
+    ca = FaultInjector(seed=5)
+    a = _build_devices(chaos=ca)
+    da = _drive_fixed(
+        a, batches(), pipelined=False, rollback_at_commit=2, chaos=ca
+    )
+    cb = FaultInjector(seed=5)
+    b = _build_devices(chaos=cb)
+    db = _drive_fixed(
+        b, batches(), pipelined=True, rollback_at_commit=2, chaos=cb
+    )
+    kept, _disc = _spec_counts(b)
+    assert kept > 0, "device-bearing speculation never engaged"
+    assert da == db
+    np.testing.assert_array_equal(
+        a.devices.slot_array(), b.devices.slot_array()
+    )
+    closed = b.extender.registry.get("pipeline_gate_closed_total")
+    assert closed.value(gate="devices") == 0.0
+
+
+def _gang_pods(n_gangs, members=2, gpu=4, start=0):
+    pods = []
+    for g in range(start, start + n_gangs):
+        for m in range(members):
+            pods.append(
+                Pod(
+                    meta=ObjectMeta(
+                        name=f"gang{g:04d}-{m}",
+                        labels={
+                            ext.LABEL_GANG_NAME: f"gang-{g}",
+                            ext.LABEL_GANG_MIN_AVAILABLE: str(members),
+                        },
+                    ),
+                    spec=PodSpec(
+                        requests={
+                            ext.RES_CPU: 16000,
+                            ext.RES_MEMORY: 65536,
+                            ext.RES_GPU: gpu,
+                        },
+                        priority=9000,
+                    ),
+                )
+            )
+    return pods
+
+
+def test_gate_gang_equivalence():
+    """Warm-gang carry: batches of complete gangs speculate
+    (batch_gangs gate open) and stay bit-exact vs serial — all-or-
+    nothing Permit included — with the exact device-slot state carried
+    across the boundary."""
+    batches = lambda: [  # noqa: E731
+        _gang_pods(8, start=k * 8) for k in range(5)
+    ]
+    a = _build_devices()
+    da = _drive_fixed(a, batches(), pipelined=False)
+    b = _build_devices()
+    db = _drive_fixed(b, batches(), pipelined=True)
+    kept, _disc = _spec_counts(b)
+    assert kept > 0, "warm-gang speculation never engaged"
+    assert da == db
+    np.testing.assert_array_equal(
+        a.devices.slot_array(), b.devices.slot_array()
+    )
+    closed = b.extender.registry.get("pipeline_gate_closed_total")
+    assert closed.value(gate="gangs") == 0.0
+    assert closed.value(gate="batch_gangs") == 0.0
+
+
+def test_cold_gang_batch_stays_serial():
+    """A batch carrying an INCOMPLETE gang (member missing) is cold: the
+    ``batch_gangs`` gate closes, the cycle runs serial, and decisions
+    still match the serial path (the missing member gates the gang
+    whole)."""
+    batches = lambda: [  # noqa: E731
+        _gang_pods(4, start=0) + _gang_pods(1, members=3, start=100)[:2]
+    ]
+    a = _build_devices()
+    da = _drive_fixed(a, batches(), pipelined=False, refeed_unsched=False)
+    b = _build_devices()
+    db = _drive_fixed(b, batches(), pipelined=True, refeed_unsched=False)
+    assert da == db
+    closed = b.extender.registry.get("pipeline_gate_closed_total")
+    assert closed.value(gate="batch_gangs") > 0.0
+    kept, _ = _spec_counts(b)
+    assert kept == 0.0
+
+
+def test_carry_mismatch_chaos_forces_redispatch():
+    """The ``pipeline.carry_mismatch`` chaos point corrupts a chained
+    carry at consume: the speculation must be DISCARDED through the real
+    validation comparison (counted in pipeline_carry_mismatch_total) and
+    the redispatched cycle must stay decision-identical to serial."""
+    from koordinator_tpu.chaos import FaultInjector
+
+    batches = lambda: [  # noqa: E731
+        _quota_pods(200)[i * 40 : (i + 1) * 40] for i in range(5)
+    ]
+    a = _build_quota()
+    da = _drive_fixed(a, batches(), pipelined=False)
+    chaos = FaultInjector(seed=9)
+    b = _build_quota(chaos=chaos)
+    # at_hits: fire on the 3rd consume evaluation — deterministic, and
+    # (like probability-1 arms) consumes no rng stream draw
+    chaos.arm("pipeline.carry_mismatch", at_hits=frozenset([3]), times=1)
+    db = _drive_fixed(b, batches(), pipelined=True)
+    assert chaos.fired_counts()["pipeline.carry_mismatch"] == 1
+    mism = b.extender.registry.get("pipeline_carry_mismatch_total")
+    assert mism.value(table="quota") >= 1.0
+    _kept, disc = _spec_counts(b)
+    assert disc > 0
+    assert da == db
+
+
+# ---------------------------------------------------------------------------
+# depth>1 pipelining: validation chains
+# ---------------------------------------------------------------------------
+
+
+def test_depth2_equivalence_and_depth_gauge():
+    """Two in-flight speculative solves (depth=2): decisions stay
+    bit-exact vs serial and the solver_pipeline_depth gauge reports the
+    deeper pipeline."""
+    batches = lambda: [  # noqa: E731
+        _pods(240)[i * 40 : (i + 1) * 40] for i in range(6)
+    ]
+    a = _build()
+    da = _drive_fixed(a, batches(), pipelined=False)
+    b = _build()
+    seen_depth = 0.0
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    pipe = CyclePipeline(b, depth=2)
+    decided = {}
+
+    def absorb(out):
+        if out is None:
+            return
+        for p, nd in out.bound:
+            decided[p.meta.name] = nd
+        for p in out.unschedulable:
+            decided[p.meta.name] = None
+
+    try:
+        gauge = b.extender.registry.get("solver_pipeline_depth")
+        for batch in batches():
+            absorb(pipe.feed(batch))
+            seen_depth = max(seen_depth, gauge.value())
+        while pipe.inflight:
+            absorb(pipe.flush())
+    finally:
+        pipe.close()
+    kept, _ = _spec_counts(b)
+    assert kept > 0
+    assert seen_depth >= 3.0, seen_depth  # 2 batches + ≥1 spec in flight
+    assert da == decided
+
+
+def _feed_depth2(sched, batches, poison=None):
+    """Feed ``batches`` through a depth-2 pipeline, invoking
+    ``poison(sched)`` just before the LAST feed (with two speculative
+    solves then in flight). Returns (decided, pipe_closed_stats)."""
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    pipe = CyclePipeline(sched, depth=2)
+    decided = {}
+
+    def absorb(out):
+        if out is None:
+            return
+        for p, nd in out.bound:
+            decided[p.meta.name] = nd
+        for p in out.unschedulable:
+            decided[p.meta.name] = None
+
+    try:
+        for k, batch in enumerate(batches):
+            if poison is not None and k == len(batches) - 1:
+                poison(sched)
+            absorb(pipe.feed(batch))
+        while pipe.inflight:
+            absorb(pipe.flush())
+    finally:
+        pipe.close()
+    return decided
+
+
+def test_depth2_node_churn_discards_entire_chain():
+    """Mid-pipeline node churn with TWO speculations in flight must
+    discard the ENTIRE pending chain (both solves, not just the head)
+    and re-dispatch decision-identically to serial. The serial frame
+    applies the churn before the first UNCOMMITTED batch (the pipeline
+    lags its commits by ``depth``), so both runs schedule the same
+    batches against the same world."""
+
+    def churn(sched):
+        snap = sched.snapshot
+        snap.remove_node(snap.node_name(2))
+        snap.upsert_node(_node("late-node"))
+
+    batches = lambda: [  # noqa: E731
+        _pods(200)[i * 40 : (i + 1) * 40] for i in range(5)
+    ]
+    a = _build()
+    serial = {}
+    for k, batch in enumerate(batches()):
+        if k == 2:
+            # the pipelined run poisons before feed(4), when batches 2-4
+            # are still uncommitted — serial-equivalent point: before
+            # batch 2's own schedule
+            churn(a)
+        out = a.schedule(batch)
+        for p, nd in out.bound:
+            serial[p.meta.name] = nd
+        for p in out.unschedulable:
+            serial[p.meta.name] = None
+    b = _build()
+    decided = _feed_depth2(b, batches(), poison=churn)
+    kept, disc = _spec_counts(b)
+    assert kept > 0
+    assert disc >= 2, (
+        f"churn with two in-flight solves must discard BOTH, got {disc}"
+    )
+    assert serial == decided
+    assert "late-node" in set(decided.values())
+
+
+def test_depth2_fence_revocation_discards_entire_chain():
+    """Fence revocation mid-pipeline (leadership lost with two
+    speculations in flight): drain_for_handoff discards the WHOLE chain
+    and every trailing commit is fenced — all pods come back
+    unschedulable, none half-committed."""
+    from koordinator_tpu.core.journal import EpochFence
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    fence = EpochFence()
+    snap = ClusterSnapshot()
+    for i in range(32):
+        snap.upsert_node(_node(f"n{i:03d}"))
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), batch_bucket=64, fence=fence
+    )
+    sched.extender.monitor.stop_background()
+    sched.grant_leadership(fence.advance())
+    pipe = CyclePipeline(sched, depth=2)
+    batches = [_pods(120)[i * 40 : (i + 1) * 40] for i in range(3)]
+    bound = {}
+    try:
+        for batch in batches:
+            out = pipe.feed(batch)
+            if out is not None:
+                for p, nd in out.bound:
+                    bound[p.meta.name] = nd
+        assert len(pipe._pending) == 2
+        assert sum(1 for e in pipe._pending if e.spec is not None) >= 1
+        # a rival takes the lease: our grant is stale from here on
+        fence.advance()
+        drained = pipe.drain_for_handoff()
+    finally:
+        pipe.close()
+    assert drained is not None
+    assert not drained.bound, "a fenced commit must never bind"
+    names = {p.meta.name for p in drained.unschedulable}
+    expect = {p.meta.name for b in batches[1:] for p in b}
+    assert names == expect, "both in-flight batches must come back whole"
+    disc = sched.extender.registry.get(
+        "pipeline_speculation_total"
+    ).value(outcome="discarded")
+    assert disc >= 1.0
+
+
+def test_depth2_ladder_demotion_discards_chain():
+    """A fallback-ladder demotion mid-pipeline poisons every pending
+    speculation: with two solves in flight, the whole chain is discarded
+    at its commits (consume guard: ladder != 0) and decisions remain
+    identical to serial — demotion moves dispatches to the per-chunk
+    level, which is decision-identical by the ladder's own contract, so
+    the serial frame needs no matching fault. The demotion is injected
+    through the REAL failure path (``_note_solver_failure``, what a
+    dispatch exception calls)."""
+    batches = lambda: [  # noqa: E731
+        _pods(200)[i * 40 : (i + 1) * 40] for i in range(5)
+    ]
+    a = _build()
+    serial = {}
+    for batch in batches():
+        out = a.schedule(batch)
+        for p, nd in out.bound:
+            serial[p.meta.name] = nd
+        for p in out.unschedulable:
+            serial[p.meta.name] = None
+    b = _build()
+
+    def demote(sched):
+        sched._note_solver_failure(0, RuntimeError("injected demotion"))
+
+    decided = _feed_depth2(b, batches(), poison=demote)
+    assert (
+        b.extender.registry.get("solver_fallback_total").value(level="1")
+        > 0
+    ), "the injected failure must demote the ladder"
+    kept, disc = _spec_counts(b)
+    assert kept > 0
+    assert disc >= 2, (
+        f"demotion with two in-flight solves must discard BOTH, got {disc}"
+    )
+    assert serial == decided

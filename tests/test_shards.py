@@ -790,3 +790,78 @@ def test_bind_journal_carries_numa_zone_and_cpuset_and_restores():
         )
         & taken
     )
+
+
+# ---------------------------------------------------------------------------
+# ClaimTable tombstone GC (open-the-gates PR satellite; PR 6 follow-on)
+# ---------------------------------------------------------------------------
+
+
+def test_claim_tombstone_gc_retention_and_reload():
+    """Tombstone GC: settled uids OLDER than the retention window are
+    compacted away; INSIDE the window a post-GC claim on a settled uid
+    still LOSES (a backlogged queue copy must never re-schedule a dead
+    pod), and a reload from the compacted store preserves both the
+    retained tombstones and every shard's claim-epoch high (fencing must
+    not weaken across GC + restart)."""
+    from koordinator_tpu.core.journal import ClaimTable
+
+    now = [1000.0]
+    store = MemoryJournalStore()
+    table = ClaimTable(store, clock=lambda: now[0])
+    assert table.claim("old-uid", 0, epoch=5)
+    assert table.claim("young-uid", 1, epoch=7)
+    assert table.claim("live-uid", 0, epoch=5)
+    table.release("old-uid")          # settled at t=1000
+    now[0] = 1900.0
+    table.release("young-uid")        # settled at t=1900
+    assert table.tombstones_live() == 2
+    now[0] = 2000.0
+    live = table.gc_tombstones(retention_s=500.0)  # cutoff t=1500
+    assert live == 1
+    # inside the window: the young tombstone still loses a claim
+    assert table.claim("young-uid", 2, epoch=1) is False
+    # outside the window: the uid is genuinely forgotten (fresh claims
+    # may win — the retention contract is the queue-lifetime bound)
+    assert table.claim("old-uid", 2, epoch=1) is True
+    # reload from the compacted store: tombstone + winners + epoch highs
+    reloaded = ClaimTable(store, clock=lambda: now[0])
+    assert reloaded.claim("young-uid", 2, epoch=1) is False
+    assert reloaded.winner("live-uid") == 0
+    with pytest.raises(StaleEpochError):
+        # shard 1's epoch high (7) survived even though its only claim
+        # record was for a tombstoned uid
+        reloaded.claim("new-uid", 1, epoch=6)
+
+
+def test_claim_tombstone_gc_rides_journal_compaction():
+    """Wiring: a shard's run-loop journal compaction fires the fabric's
+    claim tombstone GC and publishes claim_tombstones_live."""
+    world = _World()
+    a = world.incarnation("inc-a")
+    world.fabric.membership.heartbeat("inc-a")
+    try:
+        _settle(world, [a])
+        shard = sorted(a.owned())[0]
+        rt = a.runtime(shard)
+        sched = rt.sched
+        # aggressive threshold so one cycle's records trip compaction
+        sched.journal_compact_records = 1
+        claims = world.fabric.claims
+        now = world.fabric.clock()
+        assert claims.claim("dead-pod", shard, sched._fence_epoch)
+        claims.release("dead-pod")
+        assert claims.tombstones_live() == 1
+        # retention 0 with a clock far in the future: the tombstone is
+        # GC-eligible the moment compaction fires
+        a.claim_tombstone_retention_s = -1.0
+        pod = _pod("compact-driver")
+        assert a.submit(shard, pod)
+        a.pump()
+        a.flush()
+        assert claims.tombstones_live() == 0
+        gauge = sched.extender.registry.get("claim_tombstones_live")
+        assert gauge.value() == 0.0
+    finally:
+        a.close()
+        world.hub.stop()

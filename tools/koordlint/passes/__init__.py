@@ -6,6 +6,7 @@ from . import (  # noqa: F401 — registration side effects
     donation_safety,
     exception_sites,
     fence_boundaries,
+    gate_coverage,
     guarded_by,
     reject_reasons,
     retrace_hazard,

@@ -1,0 +1,211 @@
+"""Pass ``gate-coverage`` (GT): every named speculation gate has a
+bit-exact equivalence arm — the open-the-gates PR's standing rule,
+mirroring what ``chaos-coverage`` does for fault points.
+
+The gate vocabulary is extracted from the code itself: the dict literal
+``BatchScheduler.speculation_gate_report`` returns (batch_solver.py)
+plus every ``gates["<name>"] = ...`` assignment in
+``CyclePipeline._gates_ok`` (pipeline.py). The equivalence arms are
+declared in ``tests/test_pipelined_stream.py`` as a module-level
+``GATE_ARMS = {"<gate>": "test_fn" | ("test_fn", ...)}`` mapping; each
+named test must actually exist in that file. Gates that stay CLOSED
+(serial, decision-identical by construction) carry a written exemption
+here instead.
+
+* **GT001** — a named gate with neither a ``GATE_ARMS`` arm nor an
+  exemption: the gate can change behavior with no bit-exactness test.
+* **GT002** — a ``GATE_ARMS`` entry naming a test function that does not
+  exist in ``tests/test_pipelined_stream.py``.
+* **GT003** — a ``GATE_ARMS`` entry for a gate name the code no longer
+  declares (stale arm).
+* **GT004** — an exemption for a gate that ALSO has an arm: stale,
+  delete one.
+* **GT005** — an exemption naming a gate the code no longer declares.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import Finding, Pass, RepoIndex, register
+
+REPORT_FILE = "koordinator_tpu/scheduler/batch_solver.py"
+GATES_FILE = "koordinator_tpu/scheduler/pipeline.py"
+ARMS_FILE = "tests/test_pipelined_stream.py"
+
+#: gate -> why no speculative equivalence arm is required
+EXEMPT: Dict[str, str] = {
+    "reservations": (
+        "stays CLOSED: the reservation fast path swaps ghost holds for "
+        "owner charges outside the solver — the chain cannot carry it; "
+        "reservation-bearing cycles run serial (decision-identical by "
+        "construction)"
+    ),
+    "mesh": (
+        "stays CLOSED: sharded GSPMD dispatch has its own bit-exactness "
+        "suite (tests/test_sharded.py) and opts out of speculation"
+    ),
+    "transformers": (
+        "stays CLOSED: host batch/cost transformers rewrite solver "
+        "inputs per cycle — a speculative lowering cannot reproduce a "
+        "rewrite that has not happened yet"
+    ),
+    "preemption": (
+        "stays CLOSED: priority preemption mutates victim state at "
+        "PostFilter; preemption-bearing cycles run serial"
+    ),
+    "sampling": (
+        "stays CLOSED: the rotating sampled node window changes the "
+        "solve's node axis per cycle — the chain carries the full axis "
+        "only"
+    ),
+}
+
+
+def _report_gates(index: RepoIndex) -> Dict[str, int]:
+    """Gate names declared by speculation_gate_report's dict literal."""
+    out: Dict[str, int] = {}
+    sf = index.file(REPORT_FILE)
+    if sf is None or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "speculation_gate_report"
+        ):
+            for ret in ast.walk(node):
+                if isinstance(ret, ast.Return) and isinstance(
+                    ret.value, ast.Dict
+                ):
+                    for key in ret.value.keys:
+                        if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str
+                        ):
+                            out.setdefault(key.value, key.lineno)
+    return out
+
+
+def _pipeline_gates(index: RepoIndex) -> Dict[str, int]:
+    """Gate names assigned via ``gates["<name>"] = ...`` in _gates_ok."""
+    out: Dict[str, int] = {}
+    sf = index.file(GATES_FILE)
+    if sf is None or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_gates_ok":
+            for assign in ast.walk(node):
+                if not isinstance(assign, ast.Assign):
+                    continue
+                for tgt in assign.targets:
+                    if (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "gates"
+                        and isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)
+                    ):
+                        out.setdefault(tgt.slice.value, tgt.lineno)
+    return out
+
+
+def _arms(index: RepoIndex) -> Tuple[Dict[str, Tuple[tuple, int]], Set[str]]:
+    """(GATE_ARMS mapping gate -> (test names, line), defined test fns)."""
+    arms: Dict[str, Tuple[tuple, int]] = {}
+    fns: Set[str] = set()
+    sf = index.file(ARMS_FILE)
+    if sf is None or sf.tree is None:
+        return arms, fns
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            fns.add(node.name)
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "GATE_ARMS"
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    for key, val in zip(
+                        node.value.keys, node.value.values
+                    ):
+                        if not (
+                            isinstance(key, ast.Constant)
+                            and isinstance(key.value, str)
+                        ):
+                            continue
+                        names: List[str] = []
+                        vals = (
+                            val.elts
+                            if isinstance(val, (ast.Tuple, ast.List))
+                            else [val]
+                        )
+                        for v in vals:
+                            if isinstance(v, ast.Constant) and isinstance(
+                                v.value, str
+                            ):
+                                names.append(v.value)
+                        arms[key.value] = (tuple(names), key.lineno)
+    return arms, fns
+
+
+@register
+class GateCoveragePass(Pass):
+    name = "gate-coverage"
+    code = "GT"
+    description = (
+        "every named speculation gate has a bit-exact equivalence arm "
+        "in tests/test_pipelined_stream.py (or a written exemption)"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        gates: Dict[str, Tuple[str, int]] = {}
+        for name, line in _report_gates(index).items():
+            gates.setdefault(name, (REPORT_FILE, line))
+        for name, line in _pipeline_gates(index).items():
+            gates.setdefault(name, (GATES_FILE, line))
+        arms, fns = _arms(index)
+
+        for gate, (rel, line) in sorted(gates.items()):
+            armed = gate in arms
+            exempt = gate in EXEMPT
+            if not armed and not exempt:
+                out.append(self.finding(
+                    1, rel, line,
+                    f"speculation gate {gate!r} has no equivalence arm "
+                    f"in {ARMS_FILE} (GATE_ARMS) and no exemption — an "
+                    "opened gate must land with its bit-exactness test "
+                    "(open-the-gates standing rule)",
+                ))
+            elif armed and exempt:
+                out.append(self.finding(
+                    4, ARMS_FILE, arms[gate][1],
+                    f"gate {gate!r} is exempted as serial-only but "
+                    "GATE_ARMS also arms it — delete the stale "
+                    "exemption (or the arm)",
+                ))
+            if armed:
+                for fn in arms[gate][0]:
+                    if fn not in fns:
+                        out.append(self.finding(
+                            2, ARMS_FILE, arms[gate][1],
+                            f"GATE_ARMS[{gate!r}] names {fn!r}, which "
+                            f"does not exist in {ARMS_FILE} — the "
+                            "promised equivalence arm is gone",
+                        ))
+
+        for gate, (_names, line) in sorted(arms.items()):
+            if gate not in gates:
+                out.append(self.finding(
+                    3, ARMS_FILE, line,
+                    f"GATE_ARMS entry {gate!r} matches no gate declared "
+                    "by speculation_gate_report / _gates_ok — the arm "
+                    "is stale",
+                ))
+        for gate in sorted(set(EXEMPT) - set(gates)):
+            out.append(self.finding(
+                5, "tools/koordlint/passes/gate_coverage.py", 0,
+                f"exemption names gate {gate!r}, which no code declares",
+            ))
+        return out
