@@ -1539,8 +1539,495 @@ def bench_latency_stream_sharded():
     return out
 
 
+def _fleet_day_run(
+    n_shards,
+    n_incs,
+    day_cycles,
+    seed=0,
+    base_rate_per_shard=3.0,
+    elastic=False,
+    drain_limit=60,
+):
+    """Drive one compressed production 'day' through an in-process
+    sharded fleet: diurnal sinusoid arrivals, two burst storms, tenant
+    quota churn, node churn — the traffic SHAPE the per-scenario drains
+    never exercise (Tesserae's argument, arxiv 2508.04953). Returns the
+    measured run record; hard invariants (zero-dup, all placed,
+    gap-free timelines, cell-correct binds) are asserted inside."""
+    import math
+    import random as _random
+    import time as _time
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        ElasticQuota,
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.obs.lifecycle import PodLifecycle, validate_timeline
+    from koordinator_tpu.obs.slo import SloTarget, SloTracker
+    from koordinator_tpu.runtime.elastic import TopologyController
+    from koordinator_tpu.runtime.shards import (
+        ShardedScheduler,
+        ShardFabric,
+        ShardRouter,
+    )
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        GroupQuotaManager,
+    )
+
+    ALLOC_CPU, ALLOC_MEM = 32_000.0, 128 * 1024.0
+    POD_CPU, POD_MEM = 2_000.0, 4_096.0
+    LIFETIME = 8
+    MAX_BATCH = 32
+    rng = _random.Random(seed)
+    sim = [0.0]
+
+    fabric = ShardFabric(
+        n_shards, clock=lambda: sim[0], membership_ttl_s=2.5
+    )
+    lifecycle = PodLifecycle(clock=lambda: sim[0])
+    # SLO targets in SIM-CYCLE units (the tracker rides the sim clock):
+    # a pod should place within ~6 cycles of arrival even through the
+    # bursts; queue age past 3 cycles is backlog pressure — exactly the
+    # signal the elastic arm's controller scales on
+    slo = SloTracker(
+        clock=lambda: sim[0],
+        targets=(
+            SloTarget("p99_latency", threshold_s=12.0, budget=0.1, window=64),
+            SloTarget("queue_age", threshold_s=3.0, budget=0.05, window=64),
+            SloTarget("recovery", threshold_s=6.0, budget=0.5, window=16),
+        ),
+    )
+    hub = ClusterStateHub()
+    node_names = [f"n{i:03d}" for i in range(6 * n_shards)]
+
+    def _publish_node(name):
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: ALLOC_CPU,
+                        ext.RES_MEMORY: ALLOC_MEM,
+                    }
+                ),
+            ),
+        )
+
+    for name in node_names:
+        _publish_node(name)
+    tenants = ("tenant-a", "tenant-b")
+    # tenant caps scale with the fleet (arrivals do too): headroom of
+    # ~2x the tenant's steady arrival share so the day is drainable,
+    # with churn halving it — bursts still pile a real quota backlog
+    cap_hi = 6 * n_shards
+    quota_caps = {t: cap_hi for t in tenants}
+
+    def _publish_quota(tenant):
+        cap = quota_caps[tenant]
+        hub.publish(
+            hub.quotas,
+            ElasticQuota(
+                meta=ObjectMeta(name=tenant),
+                min={ext.RES_CPU: 2 * POD_CPU, ext.RES_MEMORY: 2 * POD_MEM},
+                max={
+                    ext.RES_CPU: cap * POD_CPU,
+                    ext.RES_MEMORY: cap * POD_MEM,
+                },
+            ),
+        )
+
+    for t in tenants:
+        _publish_quota(t)
+
+    def make_scheduler(shard, snapshot, fence, journal):
+        gqm = GroupQuotaManager(snapshot.config, enable_preemption=False)
+        s = BatchScheduler(
+            snapshot,
+            LoadAwareArgs(usage_thresholds={}),
+            quotas=gqm,
+            batch_bucket=MAX_BATCH,
+            journal=journal,
+            fence=fence,
+        )
+        s.extender.monitor.stop_background()
+        return s
+
+    incs = []
+
+    def _spawn():
+        inc = ShardedScheduler(
+            f"fd-inc{len(incs)}",
+            hub,
+            fabric,
+            make_scheduler,
+            pipelined=False,
+            max_batch=MAX_BATCH,
+            max_retries=8,
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            retry_period=0.5,
+            lifecycle=lifecycle,
+            slo=slo,
+        )
+        fabric.membership.heartbeat(inc.name)
+        incs.append(inc)
+        return inc
+
+    for _ in range(n_incs):
+        _spawn()
+    # plain route() — the fleet_day driver never consults backlogs for
+    # fan-out (spill/hysteresis has its own regression test; wiring it
+    # here would claim coverage the scenario doesn't actually exercise)
+    router = ShardRouter(fabric.shard_map, lifecycle=lifecycle)
+    ctrl = None
+    if elastic:
+        ctrl = TopologyController(
+            fabric,
+            slo=slo,
+            incarnations=lambda: [i for i in incs if not i.dead],
+            node_names=lambda: list(node_names),
+            split_burn=1.0,
+            merge_burn=0.02,
+            sustain=2,
+            cooldown=10,
+            max_shards=4 * n_shards,
+            lifecycle=lifecycle,
+            spawn=_spawn,
+        )
+
+    def _owner_of(shard):
+        for inc in incs:
+            if not inc.dead and inc.owns(shard):
+                return inc
+        return None
+
+    placed = {}
+    live = []
+    pending = []
+    pending_handoff = []
+    stats = {
+        "arrived": 0,
+        "placed": 0,
+        "completed": 0,
+        "handoffs": 0,
+        "nodes_added": 0,
+        "nodes_removed": 0,
+        "quota_updates": 0,
+        "burst_cycles": 0,
+    }
+    pod_seq = 0
+    node_seq = 0
+    churn_nodes = []
+    burst_windows = (
+        (int(0.35 * day_cycles), int(0.40 * day_cycles)),
+        (int(0.70 * day_cycles), int(0.74 * day_cycles)),
+    )
+
+    def _absorb_handoffs(handoffs):
+        for shard, hand in sorted(handoffs.items()):
+            stats["handoffs"] += 1
+            for pod, node, _lat in hand.decided:
+                if node is not None:
+                    _place(pod, node, shard)
+                else:
+                    pending.append(pod)
+            for pod, arr, tries in hand.queued:
+                pending_handoff.append((shard, pod, arr, tries))
+
+    def _place(pod, node, shard):
+        assert pod.meta.uid not in placed, (
+            f"{pod.meta.name} placed twice"
+        )
+        assert fabric.shard_map.cell_covers(shard, node)
+        placed[pod.meta.uid] = node
+        pod.spec.node_name = node
+        hub.publish(hub.pods, pod)
+        live.append((pod, node, sim[0] + LIFETIME))
+        stats["placed"] += 1
+
+    wall0 = _time.perf_counter()
+    for cycle in range(day_cycles + drain_limit):
+        sim[0] = float(cycle)
+        arriving = []
+        if cycle < day_cycles:
+            # diurnal arrival curve + burst storms
+            rate = base_rate_per_shard * n_shards * (
+                1.0 + 0.8 * math.sin(2.0 * math.pi * cycle / day_cycles)
+            )
+            if any(lo <= cycle < hi for lo, hi in burst_windows):
+                rate *= 5.0
+                stats["burst_cycles"] += 1
+            for _ in range(max(1, int(rate))):
+                pod_seq += 1
+                labels = {}
+                if pod_seq % 4 == 0:
+                    labels[ext.LABEL_QUOTA_NAME] = tenants[
+                        (pod_seq // 4) % len(tenants)
+                    ]
+                arriving.append(
+                    Pod(
+                        meta=ObjectMeta(
+                            name=f"day-{pod_seq:05d}", labels=labels
+                        ),
+                        spec=PodSpec(
+                            requests={
+                                ext.RES_CPU: POD_CPU,
+                                ext.RES_MEMORY: POD_MEM,
+                            },
+                            priority=9000 if pod_seq % 3 else 5500,
+                        ),
+                    )
+                )
+            # tenant quota churn: caps breathe every 8 cycles
+            if cycle % 8 == 4:
+                t = tenants[(cycle // 8) % len(tenants)]
+                quota_caps[t] = (
+                    cap_hi // 2 if quota_caps[t] == cap_hi else cap_hi
+                )
+                _publish_quota(t)
+                stats["quota_updates"] += 1
+            # node churn: a node joins every 12 cycles; a previously
+            # added node with no live pods leaves
+            if cycle % 12 == 6:
+                node_seq += 1
+                fresh = f"churn{node_seq:03d}"
+                _publish_node(fresh)
+                node_names.append(fresh)
+                churn_nodes.append(fresh)
+                stats["nodes_added"] += 1
+                busy = {n for _p, n, _d in live}
+                for cand in list(churn_nodes):
+                    # an EARLIER churn node with no live pods leaves —
+                    # never the one that just joined (that would make
+                    # the churn a same-cycle publish+delete no-op)
+                    if cand != fresh and cand not in busy:
+                        hub.delete(
+                            hub.nodes, Node(meta=ObjectMeta(name=cand))
+                        )
+                        churn_nodes.remove(cand)
+                        node_names.remove(cand)
+                        stats["nodes_removed"] += 1
+                        break
+        stats["arrived"] += len(arriving)
+        pending.extend(arriving)
+
+        if ctrl is not None and cycle < day_cycles:
+            ctrl.tick(cycle)
+        for inc in incs:
+            if not inc.dead:
+                _absorb_handoffs(inc.tick())
+        still = []
+        for shard, pod, arr, tries in pending_handoff:
+            if not fabric.shard_map.is_active(shard):
+                shard = router.route(pod)
+            owner = _owner_of(shard)
+            if owner is not None and owner.resubmit(shard, pod, arr, tries):
+                pass
+            else:
+                still.append((shard, pod, arr, tries))
+        pending_handoff = still
+        still = []
+        for pod in pending:
+            shard = router.route(pod)
+            owner = _owner_of(shard)
+            if not (
+                owner is not None
+                and owner.submit(shard, pod, now=float(cycle))
+            ):
+                still.append(pod)
+        pending = still
+        for inc in incs:
+            if inc.dead:
+                continue
+            for s, pod, node, _lat in inc.pump():
+                if node is not None:
+                    _place(pod, node, s)
+                else:
+                    pending.append(pod)
+        stillliving = []
+        for pod, node, done in live:
+            if done <= cycle:
+                hub.delete(hub.pods, pod)
+                fabric.claims.release(pod.meta.uid)
+                stats["completed"] += 1
+            else:
+                stillliving.append((pod, node, done))
+        live = stillliving
+        assert hub.wait_synced()
+        if (
+            cycle >= day_cycles
+            and not pending
+            and not pending_handoff
+            and stats["placed"] == stats["arrived"]
+        ):
+            break
+    for inc in incs:
+        if inc.dead:
+            continue
+        for s, pod, node, _lat in inc.flush():
+            if node is not None:
+                _place(pod, node, s)
+            else:
+                pending.append(pod)
+    wall = _time.perf_counter() - wall0
+
+    assert not pending and not pending_handoff, (
+        f"{len(pending)}/{len(pending_handoff)} pods never placed; "
+        f"pending labels: "
+        f"{[p.meta.labels for p in pending[:5]]}; backlogs: "
+        f"{ {s: _owner_of(s).backlog(s) for s in fabric.shard_map.active_shards() if _owner_of(s)} }"
+    )
+    assert stats["placed"] == stats["arrived"] == len(placed)
+    # gap-free lifecycle timelines END TO END — through bursts, churn
+    # and (elastic arm) live topology transitions
+    latencies = []
+    bad = 0
+    for uid in placed:
+        evs = lifecycle.timeline(uid)
+        if validate_timeline(evs):
+            bad += 1
+        t0 = next(e.t for e in evs if e.stage == "submit")
+        t_ack = next(e.t for e in reversed(evs) if e.stage == "ack")
+        latencies.append(t_ack - t0)
+    assert bad == 0, f"{bad} gap-ful timelines"
+    # latencies are SIM-CYCLE counts, not seconds — no ms conversion
+    p50 = float(np.percentile(np.asarray(latencies), 50))
+    p99 = float(np.percentile(np.asarray(latencies), 99))
+    slo_eval = slo.evaluate()
+    out = {
+        "shards_start": n_shards,
+        "shards_final": len(fabric.shard_map.active_shards()),
+        "incarnations": len([i for i in incs if not i.dead]),
+        "day_cycles": day_cycles,
+        "arrived": stats["arrived"],
+        "bound": stats["placed"],
+        "wall_s": round(wall, 3),
+        "pods_per_sec": round(stats["placed"] / wall, 1),
+        "pod_p50_cycles": round(p50, 2),
+        "pod_p99_cycles": round(p99, 2),
+        "handoffs": stats["handoffs"],
+        "quota_updates": stats["quota_updates"],
+        "nodes_added": stats["nodes_added"],
+        "nodes_removed": stats["nodes_removed"],
+        "burst_cycles": stats["burst_cycles"],
+        "slo": {
+            shard: {
+                k: {
+                    "burn_rate": row["burn_rate"],
+                    "window_p99_s": row["window_p99_s"],
+                }
+                for k, row in rows.items()
+            }
+            for shard, rows in slo_eval.items()
+        },
+    }
+    if ctrl is not None:
+        out["topology"] = dict(ctrl.stats)
+        out["generation_final"] = fabric.topology.generation
+    for inc in incs:
+        if not inc.dead:
+            inc.close()
+    hub.stop()
+    return out
+
+
+def bench_fleet_day():
+    """Elastic-topology PR acceptance scenario: one compressed
+    production day (diurnal arrivals, burst storms, tenant quota churn,
+    node churn) streamed through the sharded control plane — the
+    traffic shape the per-scenario drains never exercise — with p99
+    placement SLOs and gap-free lifecycle timelines asserted END TO
+    END, a throughput-vs-S curve past S=8, and an ELASTIC arm where the
+    SLO-burn topology controller splits shards under the burst storm.
+
+    Backend note: in-process fleet on whatever backend is attached —
+    all S points share the container, so the curve is a same-backend
+    A/B (the decision-bearing comparison on CPU per the bench-backend
+    standing rule); absolute pods/s carries the usual single-container
+    contention caveat (GIL-serialized host path, shared XLA cores)."""
+    out = {"scenario": "fleet_day"}
+    runs = []
+    DAY = 48
+    for n_shards in (2, 4, 8, 12):
+        n_incs = max(2, n_shards // 2)
+        # warmup fleet on a throwaway budget: the adaptive pumps hit
+        # partial-chunk jit specializations a static warmup can't
+        # enumerate (same discipline as every stream scenario)
+        _fleet_day_run(n_shards, n_incs, day_cycles=8, seed=1)
+        rec = _fleet_day_run(n_shards, n_incs, day_cycles=DAY, seed=0)
+        rec["mode"] = "static"
+        runs.append(rec)
+    # the SLO contract the day must hold at every S (sim-cycle units):
+    # steady-state placement is ONE pump (p50 within a cycle), and the
+    # burst storms' backlog clears inside ~1.5 days' worth of cycles at
+    # p99 — the tail IS burst-recovery time, which is the point of the
+    # scenario (a per-scenario drain never shows it)
+    for rec in runs:
+        assert rec["pod_p50_cycles"] <= 1.0, (
+            f"S={rec['shards_start']}: p50 {rec['pod_p50_cycles']} cycles"
+        )
+        assert rec["pod_p99_cycles"] <= 1.5 * DAY, (
+            f"S={rec['shards_start']}: p99 {rec['pod_p99_cycles']} cycles"
+        )
+    # ELASTIC arm: base S=4, the burn-driven controller splits under
+    # the burst storm and spawns incarnations to match
+    elastic = _fleet_day_run(
+        4, 2, day_cycles=DAY, seed=0, base_rate_per_shard=4.0,
+        elastic=True,
+    )
+    elastic["mode"] = "elastic"
+    assert elastic["topology"]["splits"] >= 1, (
+        "the burst storm must burn the SLO budget hard enough to split"
+    )
+    assert elastic["shards_final"] > elastic["shards_start"]
+    assert elastic["pod_p50_cycles"] <= 1.0
+    runs.append(elastic)
+    out["runs"] = runs
+    by_s = {r["shards_start"]: r for r in runs if r["mode"] == "static"}
+    out["pods_per_sec"] = by_s[12]["pods_per_sec"]  # headline: past S=8
+    out["passes"] = [r["pods_per_sec"] for r in runs if r["mode"] == "static"]
+    out["throughput_vs_shards"] = {
+        str(s): by_s[s]["pods_per_sec"] for s in sorted(by_s)
+    }
+    out["scaling_note"] = (
+        "fleet-day aggregate throughput vs shard count (same backend, "
+        "one container): "
+        + ", ".join(
+            f"S={s}: {by_s[s]['pods_per_sec']} pods/s "
+            f"(p99 {by_s[s]['pod_p99_cycles']} cycles)"
+            for s in sorted(by_s)
+        )
+        + f"; elastic arm: {elastic['shards_start']}->"
+        f"{elastic['shards_final']} shards, "
+        f"{elastic.get('topology', {}).get('splits', 0)} split(s)"
+    )
+    out["measurement_note"] = (
+        "in-process fleet: every shard's pump shares one container "
+        "(GIL-serialized host path + shared XLA cores), so the S curve "
+        "measures scheduling-work partitioning, not added hardware — "
+        "accelerator rounds with process-per-shard placement are where "
+        "absolute scaling lands. p50/p99 are SIM-CYCLE placement "
+        "latencies (arrival->ack on the sim clock); invariants "
+        "(zero-dup, 100% placement, gap-free timelines, cell-correct "
+        "binds) are asserted inside the run."
+    )
+    return out
+
+
 SCENARIOS = {
     "loadaware": bench_loadaware,
+    "fleet_day": bench_fleet_day,
     "numa": bench_numa,
     "device_gang": bench_device_gang,
     "quota_tree": bench_quota_tree,

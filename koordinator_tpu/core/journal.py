@@ -552,15 +552,49 @@ class ClaimTable:
     same pod. Claims are epoch-fenced **per shard**: a claim stamped
     with an epoch older than the shard's highest already-claimed epoch
     is refused outright (:class:`StaleEpochError`) — a deposed shard
-    owner cannot grab new work on its way down."""
+    owner cannot grab new work on its way down.
 
-    def __init__(self, store=None, clock=_time.time):
+    Cross-shard gangs (elastic-topology PR) add a TWO-PHASE protocol on
+    top: :meth:`gang_prepare` takes all-or-nothing HOLDS on every member
+    of a gang whose feasible nodes span shards (a hold makes rival
+    claims lose like a claim does, but the holder shard's own feed-time
+    :meth:`claim` still succeeds); :meth:`gang_commit` converts the
+    holds into ordinary claims once every member bound, and
+    :meth:`gang_abort` drops them entirely — no tombstone, because an
+    aborted member was never placed and MUST stay claimable for the
+    retry. Crash semantics: a ``gang_hold`` record with no matching
+    ``gang_commit`` is discarded on reload — a claim phase that died
+    mid-flight leaves ZERO holds behind.
+
+    Elastic topology (same PR): :meth:`rehome` re-points claims across
+    a shard split/merge — bound pods' claims follow their node to the
+    child shard; claims won by a RETIRED shard with no known
+    destination are voided (the pod re-claims at its next feed, which
+    is safe: single-winner arbitration still decides exactly one
+    feeder). Tombstones need no re-homing — they are shard-less by
+    construction (a settled uid loses everywhere)."""
+
+    def __init__(self, store=None, clock=_time.time, shard_live=None):
         self.store = store if store is not None else MemoryJournalStore()
         self.clock = clock
+        #: optional predicate ``shard_id -> bool`` (the topology's
+        #: ``is_active``): when wired, a claim held by a RETIRED shard
+        #: self-heals to the live claimant — the closing stitch for the
+        #: window between a topology commit and its claim re-home (a
+        #: crash there would otherwise strand queued pods on a winner
+        #: cell that can never schedule them). Safe because a retired
+        #: cell is not electable and its fence was advanced: nothing
+        #: can bind under it.
+        self.shard_live = shard_live
         self._lock = threading.Lock()
         self._seq = 0
         #: uid -> winning shard
         self._winners: Dict[str, int] = {}
+        #: uid -> (gang id, holder shard): two-phase gang HOLDS — not
+        #: yet claims, but rival shards' claims lose against them
+        self._holds: Dict[str, Tuple[str, int]] = {}  # guarded-by: self._lock
+        #: gang id -> {uid: holder shard} for commit/abort bookkeeping
+        self._gangs: Dict[str, Dict[str, int]] = {}  # guarded-by: self._lock
         #: released (GC'd) uid -> settle timestamp — tombstones, NOT
         #: free slots: a release happens at pod deletion, but a
         #: fanned-out copy of the pod can still sit in some backlogged
@@ -579,7 +613,15 @@ class ClaimTable:
                 uid, shard = rec.get("uid"), int(rec.get("shard", -1))
                 epoch = int(rec.get("epoch", 0))
                 if uid not in self._settled:
-                    self._winners.setdefault(uid, shard)
+                    held = self._winners.get(uid)
+                    if held is None or (
+                        self.shard_live is not None
+                        and not self.shard_live(held)
+                    ):
+                        # first claim wins — unless the first winner's
+                        # cell has since retired, in which case the
+                        # later self-healed claim record is the truth
+                        self._winners[uid] = shard
                 self._epoch_high[shard] = max(
                     self._epoch_high.get(shard, 0), epoch
                 )
@@ -595,6 +637,40 @@ class ClaimTable:
                     self._epoch_high[shard_i] = max(
                         self._epoch_high.get(shard_i, 0), int(epoch)
                     )
+            elif op == "gang_hold":
+                gang = rec.get("gang")
+                members = {
+                    u: int(s) for u, s in (rec.get("members") or {}).items()
+                }
+                self._gangs[gang] = members
+                for u, s in members.items():
+                    self._holds[u] = (gang, s)
+                for shard_s, epoch in (rec.get("epochs") or {}).items():
+                    shard_i = int(shard_s)
+                    self._epoch_high[shard_i] = max(
+                        self._epoch_high.get(shard_i, 0), int(epoch)
+                    )
+            elif op == "gang_commit":
+                members = self._gangs.pop(rec.get("gang"), {})
+                for u, s in members.items():
+                    self._holds.pop(u, None)
+                    if u not in self._settled:
+                        self._winners.setdefault(u, s)
+            elif op == "gang_abort":
+                for u in self._gangs.pop(rec.get("gang"), {}):
+                    self._holds.pop(u, None)
+            elif op == "claim_rehome":
+                moves = {
+                    u: int(s) for u, s in (rec.get("moves") or {}).items()
+                }
+                void = {int(s) for s in rec.get("void", ())}
+                self._apply_rehome_locked(moves, void)
+        # crash semantics: a gang whose hold record was never closed by a
+        # commit/abort belongs to a claim phase that DIED mid-flight —
+        # its holds evaporate here, leaving every member claimable again
+        for gang in list(self._gangs):
+            for u in self._gangs.pop(gang):
+                self._holds.pop(u, None)
 
     def claim(self, uid: str, shard: int, epoch: int) -> bool:
         """True when ``shard`` owns (or now wins) the pod's claim; False
@@ -610,9 +686,23 @@ class ClaimTable:
                 # a stale fanned-out queue copy; losing it (False) makes
                 # the caller drop the pod, which is correct: it is gone
                 return False
+            hold = self._holds.get(uid)
+            if hold is not None:
+                # a two-phase gang hold stands in for the claim until the
+                # gang commits: the holder shard's own feed proceeds, any
+                # rival loses (the gang decides the pod's fate, not the
+                # fan-out race)
+                return hold[1] == shard
             held = self._winners.get(uid)
             if held is not None:
-                return held == shard
+                if held == shard:
+                    return True
+                if self.shard_live is None or self.shard_live(held):
+                    return False
+                # orphaned claim: its winner cell RETIRED (a crash
+                # between a topology commit and the claim re-home
+                # leaves exactly these) — self-heal to the live
+                # claimant instead of dropping the pod forever
             self._seq += 1
             rec = {
                 "seq": self._seq,
@@ -663,6 +753,165 @@ class ClaimTable:
                 raise JournalWriteError(
                     f"claim release append failed: {exc!r}"
                 ) from exc
+
+    # ---- two-phase cross-shard gang claims (elastic-topology PR) ----
+
+    def gang_prepare(
+        self,
+        gang: str,
+        members: Dict[str, int],
+        epochs: Dict[int, int],
+        now: Optional[float] = None,
+    ) -> bool:
+        """Phase 1: take holds on EVERY member or none. ``members`` maps
+        uid → the shard that will schedule it; ``epochs`` carries each
+        involved shard's held fencing epoch (checked against the shard's
+        claim-epoch high exactly like :meth:`claim` — a deposed owner
+        cannot anchor a gang on its way down). Returns False — with zero
+        holds taken — when any member is settled, already claimed by a
+        shard other than its planned one, or held by another gang."""
+        with self._lock:
+            for shard in sorted(set(members.values())):
+                epoch = int(epochs.get(shard, -1))
+                high = self._epoch_high.get(shard, 0)
+                if epoch < 0 or epoch < high:
+                    raise StaleEpochError(
+                        epoch, high, what="gang claim epoch"
+                    )
+            for uid, shard in members.items():
+                if uid in self._settled:
+                    return False
+                hold = self._holds.get(uid)
+                if hold is not None and hold != (gang, shard):
+                    return False
+                won = self._winners.get(uid)
+                if won is not None and won != shard:
+                    return False
+            self._seq += 1
+            rec = {
+                "seq": self._seq,
+                "op": "gang_hold",
+                "gang": gang,
+                "members": {u: int(s) for u, s in members.items()},
+                "epochs": {str(s): int(e) for s, e in epochs.items()},
+                "ts": float(self.clock() if now is None else now),
+            }
+            try:
+                self.store.append(rec)
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"gang hold append failed: {exc!r}"
+                ) from exc
+            self._gangs[gang] = {u: int(s) for u, s in members.items()}
+            for uid, shard in members.items():
+                self._holds[uid] = (gang, int(shard))
+            for shard, epoch in epochs.items():
+                self._epoch_high[shard] = max(
+                    self._epoch_high.get(int(shard), 0), int(epoch)
+                )
+            return True
+
+    def gang_commit(self, gang: str) -> None:
+        """Phase 2 success: every member bound — holds become ordinary
+        claims (so pod-GC release/tombstone semantics apply from here)."""
+        with self._lock:
+            members = self._gangs.pop(gang, None)
+            if members is None:
+                return
+            self._seq += 1
+            try:
+                self.store.append(
+                    {"seq": self._seq, "op": "gang_commit", "gang": gang}
+                )
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"gang commit append failed: {exc!r}"
+                ) from exc
+            for uid, shard in members.items():
+                self._holds.pop(uid, None)
+                if uid not in self._settled:
+                    self._winners.setdefault(uid, shard)
+
+    def gang_abort(self, gang: str) -> None:
+        """Phase 2 failure: drop every hold ENTIRELY — no claim, no
+        tombstone. The members were never placed, so they must stay
+        claimable for whatever retry/re-route comes next; a tombstone
+        here would brick them forever (zero-zombie-holds contract)."""
+        with self._lock:
+            members = self._gangs.pop(gang, None)
+            if members is None:
+                return
+            self._seq += 1
+            try:
+                self.store.append(
+                    {"seq": self._seq, "op": "gang_abort", "gang": gang}
+                )
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"gang abort append failed: {exc!r}"
+                ) from exc
+            for uid in members:
+                self._holds.pop(uid, None)
+
+    def gang_holds(self, gang: Optional[str] = None) -> int:
+        """Live (uncommitted, unaborted) hold count — the zero-zombie
+        assertion surface."""
+        with self._lock:
+            if gang is not None:
+                return len(self._gangs.get(gang, {}))
+            return len(self._holds)
+
+    # ---- topology re-home (shard split/merge) ----
+
+    def _apply_rehome_locked(
+        self, moves: Dict[str, int], void: set
+    ) -> None:
+        for uid, dest in moves.items():
+            if uid in self._winners:
+                self._winners[uid] = int(dest)
+            if uid in self._holds:
+                gang, _s = self._holds[uid]
+                self._holds[uid] = (gang, int(dest))
+                if gang in self._gangs and uid in self._gangs[gang]:
+                    self._gangs[gang][uid] = int(dest)
+        if void:
+            for uid, shard in list(self._winners.items()):
+                if shard in void and uid not in moves:
+                    del self._winners[uid]
+            for uid, (gang, shard) in list(self._holds.items()):
+                if shard in void and uid not in moves:
+                    del self._holds[uid]
+                    if gang in self._gangs:
+                        self._gangs[gang].pop(uid, None)
+
+    def rehome(
+        self, moves: Dict[str, int], void_shards: Sequence[int] = ()
+    ) -> None:
+        """Shard split/merge commit: re-point claims to the shards that
+        now own their pods. ``moves`` maps uid → destination shard (the
+        child owning the pod's node, from the journal re-home);
+        ``void_shards`` names the RETIRED shard ids — any remaining
+        claim won by one of them (a queued, not-yet-bound pod) is voided
+        so the pod can re-claim wherever the new topology routes it.
+        One journaled record, so a reload replays the same state."""
+        with self._lock:
+            self._seq += 1
+            rec = {
+                "seq": self._seq,
+                "op": "claim_rehome",
+                "moves": {u: int(s) for u, s in moves.items()},
+                "void": [int(s) for s in void_shards],
+            }
+            try:
+                self.store.append(rec)
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"claim rehome append failed: {exc!r}"
+                ) from exc
+            self._apply_rehome_locked(
+                {u: int(s) for u, s in moves.items()},
+                {int(s) for s in void_shards},
+            )
 
     def tombstones_live(self) -> int:
         """Settled uids currently retained (the ``claim_tombstones_live``
@@ -724,6 +973,23 @@ class ClaimTable:
                         "op": "claim_release",
                         "uid": uid,
                         "ts": float(ts),
+                    }
+                )
+            for gang, members in self._gangs.items():
+                # live two-phase holds survive the rewrite — they are
+                # open state, not history (an in-flight gang's claim
+                # phase must not evaporate under a tombstone sweep)
+                self._seq += 1
+                records.append(
+                    {
+                        "seq": self._seq,
+                        "op": "gang_hold",
+                        "gang": gang,
+                        "members": {
+                            u: int(s) for u, s in members.items()
+                        },
+                        "epochs": {},
+                        "ts": float(now),
                     }
                 )
             try:

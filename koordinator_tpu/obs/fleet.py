@@ -257,7 +257,9 @@ class FleetServices:
         sh = self.sharded
         rows: Dict[str, dict] = {}
         ok = True
-        for s in range(sh.fabric.n_shards):
+        # ACTIVE shards (ids are sparse once the elastic topology has
+        # split/merged — a retired cell has no health to report)
+        for s in sh.fabric.shard_map.active_shards():
             owned = sh.owns(s)
             rt = sh.runtime(s)
             row = {
@@ -361,6 +363,27 @@ class FleetServices:
             return 200, json.dumps(
                 {"incarnation": self.sharded.name, "shards": shards},
                 indent=1,
+            )
+        if path == "/topology":
+            # elastic-topology PR: the live shard-map generation — the
+            # cell tree, the open transition (if a split/merge is in
+            # flight), and the journaled transition history tail
+            topo = self.sharded.fabric.topology
+            m = topo.map
+            return 200, json.dumps(
+                {
+                    "generation": topo.generation,
+                    "base_shards": m.base,
+                    "active": m.active_shards(),
+                    "cells": {
+                        "/".join(str(p) for p in path_): int(sid)
+                        for path_, sid in sorted(m._cells.items())
+                    },
+                    "open_transition": topo.open_transition(),
+                    "history": topo.history(limit=32),
+                },
+                indent=1,
+                sort_keys=True,
             )
         if path == "/debug/flightrecorder":
             shards = {}

@@ -57,11 +57,18 @@ STAGES = frozenset(
         "decide",      # cycle produced a verdict (node or None)
         "handoff",     # surfaced from a donor's queue at shard handoff
         "orphan",      # owner died with the pod queued/in flight
+        "shard_split", # re-homed by a live shard split (elastic topology)
+        "shard_merge", # re-homed by a live shard merge (elastic topology)
         "recover",     # journal replay restored the acknowledged bind
         "ack",         # bind acknowledged / published (terminal)
         "gone",        # pod deleted before placement (terminal)
     }
 )
+
+#: stages that DISPLACE a pod from its owner: until a bridge event
+#: (resubmit/recover/enqueue) lands, any placement-path progress is a
+#: timeline gap — the validator's cross-incarnation/cross-topology arm
+_DISPLACING = frozenset({"orphan", "shard_split", "shard_merge"})
 
 #: default histogram buckets (seconds): sub-ms in-process pumps up to the
 #: multi-cycle waits a leaderless gap produces
@@ -394,9 +401,12 @@ def validate_timeline(
     * ``ack`` only after a ``decide``/``recover`` produced a node — an
       ack out of nowhere means the driver observed a bind the control
       plane never decided (the lost-ack gap);
-    * after an ``orphan`` (owner died), the next placement-path event
-      must be ``resubmit``/``recover``/``enqueue`` — the bridge across
-      the dead incarnation;
+    * after a DISPLACING event — ``orphan`` (owner died) or a topology
+      bracket (``shard_split``/``shard_merge``: the pod's range moved
+      under it) — the next placement-path event must be
+      ``resubmit``/``recover``/``enqueue``: the bridge across the dead
+      incarnation or the retired cell. The multi-shard soak fails on a
+      gap across a split exactly here;
     * terminal: ends at ``ack``/``gone`` when ``require_terminal``.
     """
     problems: List[str] = []
@@ -407,7 +417,7 @@ def validate_timeline(
     t_prev = events[0].t
     queued = False
     decided = False
-    orphaned = False
+    displaced = ""   # the displacing stage name, "" when bridged
     for i, ev in enumerate(events):
         if ev.stage not in STAGES:
             problems.append(f"[{i}] unknown stage {ev.stage!r}")
@@ -420,24 +430,24 @@ def validate_timeline(
         t_prev = max(t_prev, ev.t)
         if ev.stage in ("enqueue", "resubmit"):
             queued = True
-            if orphaned and ev.stage == "enqueue":
-                orphaned = False  # driver re-routed the orphan
+            if displaced and ev.stage == "enqueue":
+                displaced = ""  # driver re-routed the displaced pod
         if ev.stage in ("decide", "recover"):
             decided = True
         if ev.stage == "dispatch" and not queued:
             problems.append(f"[{i}] dispatch before any enqueue")
         if ev.stage == "ack" and not decided:
             problems.append(f"[{i}] ack without a decide/recover")
-        if orphaned and ev.stage in ("dispatch", "decide", "ack"):
+        if displaced and ev.stage in ("dispatch", "decide", "ack"):
             problems.append(
-                f"[{i}] {ev.stage} after orphan without "
+                f"[{i}] {ev.stage} after {displaced} without "
                 "resubmit/recover/enqueue bridge"
             )
-        if ev.stage == "orphan":
-            orphaned = True
+        if ev.stage in _DISPLACING:
+            displaced = ev.stage
             queued = False
         if ev.stage in ("resubmit", "recover"):
-            orphaned = False
+            displaced = ""
     if require_terminal and events[-1].stage not in _TERMINAL:
         problems.append(f"ends at {events[-1].stage!r}, not terminal")
     return problems

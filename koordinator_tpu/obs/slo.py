@@ -179,6 +179,21 @@ class SloTracker:
             }
         return out
 
+    def burn_rate(self, shard: int, slo: str) -> float:
+        """One (shard, objective) burn rate without materializing the
+        whole :meth:`evaluate` payload — the topology controller's
+        per-tick read (elastic-topology PR): burn > 1 on a shard's
+        placement objectives is the scale-out signal."""
+        tgt = self.targets.get(slo)
+        if tgt is None:
+            raise ValueError(f"unknown SLO {slo!r}")
+        with self._lock:
+            s = self._series.get((int(shard), slo))
+            if s is None or not s.samples:
+                return 0.0
+            frac = sum(1 for _v, bad in s.samples if bad) / len(s.samples)
+        return frac / tgt.budget if tgt.budget > 0 else 0.0
+
     def ok(self) -> bool:
         """True while every shard's every objective burns within budget."""
         return all(

@@ -1,0 +1,735 @@
+"""Elastic shard topology (robustness tentpole): live shard SPLIT and
+MERGE transactions, SLO-burn-driven scale-out/in, and cross-shard gang
+scheduling.
+
+The PR 6 control plane fixed the shard count at deploy time; this module
+makes the partition a live, journaled quantity:
+
+* :func:`split_shard` / :func:`merge_shards` — the topology
+  transactions. Each is intent-before-mutate over the fabric's
+  :class:`~.shards.ShardTopology` journal (generation-monotonic,
+  fence-checked records): the donor(s) relinquish their cells through
+  the ordinary step-down drain (queue continuity via
+  ``extract_queued``/``resubmit``, trailing commits flushed through the
+  revoked fence), the donors' journal LIVE SETS are re-homed into the
+  child journals (so the children's first owners recover the parent's
+  acknowledged world bit-exactly), and only then does the commit record
+  swap the :class:`~.shards.ShardMap` cells — ClaimTable claims follow
+  in the same commit step, tombstones stay (they are shard-less). The
+  donor incarnation's OTHER shards serve throughout. The named chaos
+  points ``shard.split_crash`` / ``shard.merge_crash`` fire between the
+  re-home and the commit: the transaction journals a rollback and the
+  parent generation stays active — never a half-owned range (the
+  attempt's child ids stay burned so a stale child journal can never be
+  mistaken for a live shard's).
+* :class:`TopologyController` — the scale-out/in policy: it consumes
+  the :class:`~..obs.slo.SloTracker` burn rates (until now only the
+  descheduler read them) and splits a shard whose latency/queue-age
+  budget has burned hot for ``sustain`` consecutive evaluations,
+  re-merges sibling cells that have stayed cold, and spawns/retires
+  scheduler incarnations to track the live shard count — with cooldown
+  hysteresis so a burst cannot saw the topology back and forth.
+* :class:`CrossShardGangCoordinator` — two-phase claim-then-commit for
+  a gang whose feasible nodes SPAN shards (the PR 6 router routes gangs
+  whole to a home shard, so such a gang was simply unplaceable):
+  phase 1 takes all-or-nothing ClaimTable HOLDS on every member,
+  phase 2 schedules each shard's members as a local sub-gang and either
+  commits the holds into claims (every member bound) or aborts —
+  releasing the holds entirely and unbinding any members that made it,
+  so an abort leaves ZERO zombie holds and every member claimable for
+  the retry. A claim phase that crashes mid-flight leaves zero holds by
+  the ClaimTable's reload contract.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..chaos import NULL_INJECTOR
+from ..core.journal import BindJournal, StaleEpochError
+
+
+class TopologyChangeError(RuntimeError):
+    """A topology transaction failed and was rolled back: the parent
+    generation is still the active one (no range is half-owned)."""
+
+
+# ---------------------------------------------------------------------------
+# The split / merge transactions
+# ---------------------------------------------------------------------------
+
+
+def _relinquish_all(shard: int, incarnations, event: str, detail: str) -> None:
+    """Step the shard's owner (whichever incarnation holds it) down so
+    the journal re-home below sees a quiescent log. The surfaced queue
+    rides the incarnation's ordinary handoff path — the driver re-routes
+    it against whatever topology the transaction settles on."""
+    for inc in incarnations:
+        if not getattr(inc, "dead", False) and inc.owns(shard):
+            inc.relinquish(shard, event=event, detail=detail)
+
+
+def _rehome_journal(
+    fabric,
+    sources: Sequence[int],
+    dest_of: Callable[[str], int],
+    cycle: int,
+    lifecycle=None,
+    event: str = "shard_split",
+    detail: str = "",
+) -> Dict[str, int]:
+    """Re-home every source shard's acknowledged live set into the
+    destination journals (``dest_of(node) -> child shard``). Entries are
+    re-journaled verbatim — exact NUMA/device holds, quota leaf and
+    ``lc`` trace context included — so the child's takeover replay
+    re-installs them bit-exactly, same as any PR 5 recovery. Returns
+    ``uid -> destination`` (the ClaimTable re-home feed)."""
+    moved: Dict[int, List[dict]] = {}
+    dests: Dict[str, int] = {}
+    for src in sources:
+        rep = BindJournal(fabric.journal_stores[src], shard=src).replay()
+        for uid, entry in rep.live.items():
+            dest = int(dest_of(entry["node"]))
+            moved.setdefault(dest, []).append(dict(entry))
+            dests[uid] = dest
+    for dest, entries in sorted(moved.items()):
+        fabric.ensure_shard(dest)
+        # the destination must be VIRGIN territory: a fence that ever
+        # granted leadership means someone owns (or owned) this id and
+        # a re-home would race its appends — epoch 0 is the never-
+        # granted state, and check() raises on anything else
+        fabric.fences[dest].check(0)
+        BindJournal(
+            fabric.journal_stores[dest], shard=dest
+        ).append_bind(0, cycle, entries)
+    if lifecycle is not None:
+        for uid, dest in sorted(dests.items()):
+            if not lifecycle.is_done(uid):
+                # an acknowledged-but-unacked bind (a lost-ack window
+                # crossing the transition) gets its bracket here; the
+                # child's recovery `recover` event closes it. Terminal
+                # timelines stay terminal — their story is over.
+                lifecycle.event(
+                    uid, event, shard=dest, detail=detail
+                )
+    return dests
+
+
+def _rehome_claims(fabric, moves: Dict[str, int], void: List[int]) -> bool:
+    """Best-effort claim re-home AFTER a committed transition. Failure
+    is survivable — a claim stranded on a retired cell self-heals at
+    the pod's next feed via ``ClaimTable.shard_live`` — so the error is
+    swallowed and reported, never allowed to masquerade as a topology
+    rollback."""
+    try:
+        fabric.claims.rehome(moves, void_shards=void)
+        return True
+    except Exception as exc:
+        from ..obs.errors import report_exception
+
+        report_exception("topology.claims_rehome", exc)
+        return False
+
+
+def split_shard(
+    fabric,
+    parent: int,
+    incarnations: Sequence = (),
+    chaos=None,
+    lifecycle=None,
+    cycle: int = -1,
+) -> dict:
+    """Split a hot shard's node range into two child shards, live.
+
+    Transaction order (the invariants live in the order):
+
+    1. journal the split INTENT (generation-monotonic, refuses a second
+       open transition);
+    2. the donor relinquishes the parent (queue surfaced with
+       ``shard_split`` brackets, pipeline drained through the revoked
+       fence) and the parent fence advances — a deposed straggler can
+       cross no boundary;
+    3. the parent journal's live set is re-homed into the child
+       journals (children's fences must still be at epoch 0);
+    4. ``shard.split_crash`` fires HERE when armed — the rollback path
+       journals the abort, the parent stays the active cell, its owner
+       re-elects, and the surfaced queue re-routes straight back to it;
+    5. the COMMIT record swaps the map cells (routers now see the
+       children) and the ClaimTable re-homes: bound pods' claims follow
+       their node, a queued pod's claim on the retired parent is voided
+       so it can re-claim wherever the new topology routes it.
+    """
+    chaos = chaos or NULL_INJECTOR
+    topo = fabric.topology
+    intent = topo.begin_split(parent)
+    a, b = (int(i) for i in intent["children"])
+    detail = f"gen{intent['gen']}:{parent}->{a}/{b}"
+    try:
+        fabric.ensure_shard(a)
+        fabric.ensure_shard(b)
+        _relinquish_all(parent, incarnations, "shard_split", detail)
+        fabric.fences[parent].advance()
+        moved = _rehome_journal(
+            fabric,
+            [parent],
+            lambda node: fabric.shard_map.split_dest(parent, node, a, b),
+            cycle,
+            lifecycle=lifecycle,
+            event="shard_split",
+            detail=detail,
+        )
+        if chaos.fire("shard.split_crash"):
+            raise TopologyChangeError(
+                f"injected crash mid-split of shard {parent}"
+            )
+    except Exception as exc:
+        topo.rollback(intent, reason=repr(exc))
+        if isinstance(exc, TopologyChangeError):
+            raise
+        raise TopologyChangeError(
+            f"split of shard {parent} failed: {exc!r}"
+        ) from exc
+    try:
+        topo.commit(intent)
+    except Exception as exc:
+        # commit appends BEFORE swapping cells: a failed append leaves
+        # the map untouched, so this is still a clean rollback
+        topo.rollback(intent, reason=f"commit refused: {exc!r}")
+        raise TopologyChangeError(
+            f"split of shard {parent} could not commit: {exc!r}"
+        ) from exc
+    # past the commit the transition is FACT — a claims-journal failure
+    # here must never masquerade as a rollback. Claims stranded on the
+    # retired cell self-heal at their next feed (ClaimTable.shard_live),
+    # so the re-home is best-effort convenience, not correctness.
+    claims_rehomed = _rehome_claims(fabric, moved, [int(parent)])
+    return {
+        "op": "split",
+        "gen": int(intent["gen"]),
+        "parent": int(parent),
+        "children": (a, b),
+        "rehomed": len(moved),
+        "claims_rehomed": claims_rehomed,
+    }
+
+
+def merge_shards(
+    fabric,
+    a: int,
+    b: int,
+    incarnations: Sequence = (),
+    chaos=None,
+    lifecycle=None,
+    cycle: int = -1,
+) -> dict:
+    """Merge two cold SIBLING shards back into one (the inverse of
+    :func:`split_shard`, same transaction discipline; the named chaos
+    point is ``shard.merge_crash`` and its rollback re-opens BOTH
+    donors' elections)."""
+    chaos = chaos or NULL_INJECTOR
+    topo = fabric.topology
+    intent = topo.begin_merge(a, b)
+    merged = int(intent["merged"])
+    detail = f"gen{intent['gen']}:{a}+{b}->{merged}"
+    try:
+        fabric.ensure_shard(merged)
+        for donor in (int(a), int(b)):
+            fabric.ensure_shard(donor)
+            _relinquish_all(donor, incarnations, "shard_merge", detail)
+            fabric.fences[donor].advance()
+        moved = _rehome_journal(
+            fabric,
+            [int(a), int(b)],
+            lambda _node: merged,
+            cycle,
+            lifecycle=lifecycle,
+            event="shard_merge",
+            detail=detail,
+        )
+        if chaos.fire("shard.merge_crash"):
+            raise TopologyChangeError(
+                f"injected crash mid-merge of shards {a}+{b}"
+            )
+    except Exception as exc:
+        topo.rollback(intent, reason=repr(exc))
+        if isinstance(exc, TopologyChangeError):
+            raise
+        raise TopologyChangeError(
+            f"merge of shards {a}+{b} failed: {exc!r}"
+        ) from exc
+    try:
+        topo.commit(intent)
+    except Exception as exc:
+        topo.rollback(intent, reason=f"commit refused: {exc!r}")
+        raise TopologyChangeError(
+            f"merge of shards {a}+{b} could not commit: {exc!r}"
+        ) from exc
+    # committed: see split_shard — never roll back, claims self-heal
+    claims_rehomed = _rehome_claims(fabric, moved, [int(a), int(b)])
+    return {
+        "op": "merge",
+        "gen": int(intent["gen"]),
+        "donors": (int(a), int(b)),
+        "merged": merged,
+        "rehomed": len(moved),
+        "claims_rehomed": claims_rehomed,
+    }
+
+
+# ---------------------------------------------------------------------------
+# SLO-burn-driven scale-out/in
+# ---------------------------------------------------------------------------
+
+
+class TopologyController:
+    """Turns the PR 7 SLO layer's burn rates into topology actions.
+
+    Policy, per :meth:`tick`:
+
+    * a shard whose worst placement burn (max of ``p99_latency`` and
+      ``queue_age``) exceeds ``split_burn`` for ``sustain`` consecutive
+      ticks is SPLIT (hottest first, one transition per tick, cooldown
+      between transitions);
+    * a sibling cell pair whose burns have both stayed at or below
+      ``merge_burn`` for ``sustain`` ticks is MERGED back;
+    * ``spawn()`` / ``retire()`` callbacks (optional) keep the
+      incarnation count tracking ``ceil(active / shards_per_inc)`` so a
+      scale-out actually gains pump concurrency and a scale-in releases
+      standbys.
+
+    Degrades gracefully by construction: a split/merge that rolls back
+    (chaos, crash, or a guard refusal) counts in ``stats["rollbacks"]``
+    and the parent generation keeps serving. ``node_names`` (a callable
+    returning the fleet's node names) guards against splits that would
+    mint an EMPTY child — a shard with no nodes has no world for its
+    owner to recover against."""
+
+    def __init__(
+        self,
+        fabric,
+        slo=None,
+        incarnations: object = (),
+        *,
+        split_burn: float = 1.0,
+        merge_burn: float = 0.05,
+        sustain: int = 3,
+        cooldown: int = 6,
+        max_shards: int = 64,
+        node_names: Optional[Callable[[], Sequence[str]]] = None,
+        shards_per_incarnation: int = 2,
+        min_incarnations: int = 1,
+        spawn: Optional[Callable[[], object]] = None,
+        retire: Optional[Callable[[], object]] = None,
+        chaos=None,
+        lifecycle=None,
+    ):
+        self.fabric = fabric
+        self.slo = slo
+        self._incarnations = incarnations
+        self.split_burn = float(split_burn)
+        self.merge_burn = float(merge_burn)
+        self.sustain = int(sustain)
+        self.cooldown = int(cooldown)
+        self.max_shards = int(max_shards)
+        self.node_names = node_names
+        self.shards_per_incarnation = max(1, int(shards_per_incarnation))
+        self.min_incarnations = int(min_incarnations)
+        self.spawn = spawn
+        self.retire = retire
+        self.chaos = chaos or NULL_INJECTOR
+        self.lifecycle = lifecycle
+        self._hot: Dict[int, int] = {}
+        self._cold: Dict[int, int] = {}
+        self._ticks = 0
+        self._last_change = -(10**9)
+        self.stats = {
+            "splits": 0,
+            "merges": 0,
+            "rollbacks": 0,
+            "skipped": 0,
+            "spawned": 0,
+            "retired": 0,
+        }
+
+    # ---- plumbing ----
+
+    def _live(self) -> List:
+        incs = self._incarnations
+        if callable(incs):
+            incs = incs()
+        return [i for i in incs if not getattr(i, "dead", False)]
+
+    def shard_burn(self, shard: int) -> float:
+        """The shard's worst PLACEMENT burn rate — the signal that says
+        "this range needs more scheduler", which recovery burn does not."""
+        if self.slo is None:
+            return 0.0
+        return max(
+            self.slo.burn_rate(shard, "p99_latency"),
+            self.slo.burn_rate(shard, "queue_age"),
+        )
+
+    def _children_nonempty(
+        self, shard: int, names: Optional[Sequence[str]] = None
+    ) -> bool:
+        """A split that would mint an empty child is refused up front —
+        deterministic hash partitioning makes this a property of the
+        node-name set, so check it before burning a generation.
+        ``names`` (the shard's own nodes, when the caller already
+        partitioned) skips re-hashing the whole fleet."""
+        if self.node_names is None:
+            return True
+        m = self.fabric.shard_map
+        if names is None:
+            names = [
+                n for n in self.node_names() if m.shard_of_node(n) == shard
+            ]
+        if not names:
+            return False
+        sides = {m.split_dest(shard, n, 0, 1) for n in names}
+        return sides == {0, 1}
+
+    def pick_split_candidate(self) -> Optional[int]:
+        """The active shard owning the most nodes whose split yields two
+        non-empty children (ties break on shard id — deterministic, so
+        the seeded soak schedules the same split every run)."""
+        if self.node_names is None:
+            return None
+        part = self.fabric.shard_map.partition(list(self.node_names()))
+        for shard in sorted(part, key=lambda s: (-len(part[s]), s)):
+            if self._children_nonempty(shard, names=part[shard]):
+                return shard
+        return None
+
+    # ---- the actions ----
+
+    def split(self, shard: int, cycle: int = -1) -> Optional[dict]:
+        if not self._children_nonempty(shard):
+            self.stats["skipped"] += 1
+            return None
+        try:
+            out = split_shard(
+                self.fabric,
+                shard,
+                incarnations=self._live(),
+                chaos=self.chaos,
+                lifecycle=self.lifecycle,
+                cycle=cycle,
+            )
+        except TopologyChangeError:
+            self.stats["rollbacks"] += 1
+            self._last_change = self._ticks
+            return None
+        self.stats["splits"] += 1
+        self._last_change = self._ticks
+        self._hot.pop(shard, None)
+        self._cold.pop(shard, None)
+        return out
+
+    def merge(self, a: int, b: int, cycle: int = -1) -> Optional[dict]:
+        try:
+            out = merge_shards(
+                self.fabric,
+                a,
+                b,
+                incarnations=self._live(),
+                chaos=self.chaos,
+                lifecycle=self.lifecycle,
+                cycle=cycle,
+            )
+        except TopologyChangeError:
+            self.stats["rollbacks"] += 1
+            self._last_change = self._ticks
+            return None
+        self.stats["merges"] += 1
+        self._last_change = self._ticks
+        for s in (a, b):
+            self._hot.pop(s, None)
+            self._cold.pop(s, None)
+        return out
+
+    def tick(self, cycle: int = -1) -> List[dict]:
+        """One burn-driven evaluation: update hot/cold streaks from the
+        SLO tracker, take at most one topology action (cooldown-gated),
+        then true up the incarnation count. Returns the actions taken."""
+        self._ticks += 1
+        actions: List[dict] = []
+        active = self.fabric.shard_map.active_shards()
+        burns = {s: self.shard_burn(s) for s in active}
+        for s in active:
+            if burns[s] > self.split_burn:
+                self._hot[s] = self._hot.get(s, 0) + 1
+                self._cold.pop(s, None)
+            elif burns[s] <= self.merge_burn:
+                self._cold[s] = self._cold.get(s, 0) + 1
+                self._hot.pop(s, None)
+            else:
+                self._hot.pop(s, None)
+                self._cold.pop(s, None)
+        in_cooldown = self._ticks - self._last_change < self.cooldown
+        if not in_cooldown:
+            hot = sorted(
+                (s for s in active if self._hot.get(s, 0) >= self.sustain),
+                key=lambda s: (-burns[s], s),
+            )
+            if hot and len(active) < self.max_shards:
+                out = self.split(hot[0], cycle=cycle)
+                if out is not None:
+                    actions.append(out)
+            elif not hot:
+                for a, b in self.fabric.shard_map.siblings():
+                    if (
+                        self._cold.get(a, 0) >= self.sustain
+                        and self._cold.get(b, 0) >= self.sustain
+                    ):
+                        out = self.merge(a, b, cycle=cycle)
+                        if out is not None:
+                            actions.append(out)
+                        break
+        # incarnation scale-out/in tracks the live shard count
+        live = self._live()
+        target = max(
+            self.min_incarnations,
+            math.ceil(
+                len(self.fabric.shard_map.active_shards())
+                / self.shards_per_incarnation
+            ),
+        )
+        if self.spawn is not None and len(live) < target:
+            self.spawn()
+            self.stats["spawned"] += 1
+            actions.append({"op": "spawn", "target": target})
+        elif self.retire is not None and len(live) > target:
+            self.retire()
+            self.stats["retired"] += 1
+            actions.append({"op": "retire", "target": target})
+        return actions
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard gang scheduling (two-phase claim-then-commit)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GangTicket:
+    """One cross-shard gang placement attempt in flight."""
+
+    gang: str
+    attempt_id: str
+    #: uid -> the shard scheduled to bind it
+    members: Dict[str, int]
+    pods: Dict[str, object]
+    #: uid -> node (bound) | None (terminally unschedulable)
+    decided: Dict[str, Optional[str]] = field(default_factory=dict)
+    #: uid -> {annotation key: original value | None} — what the
+    #: sub-gang rewrite changed, so an abort can restore the pods to
+    #: their pre-attempt shape (a retry must see the ORIGINAL gang)
+    saved_annotations: Dict[str, Dict[str, Optional[str]]] = field(
+        default_factory=dict
+    )
+    committed: bool = False
+    aborted: bool = False
+
+    def complete(self) -> bool:
+        return len(self.decided) == len(self.members)
+
+
+class CrossShardGangCoordinator:
+    """All-or-nothing placement for a gang whose members span shards.
+
+    ``owner_of(shard)`` resolves the incarnation currently owning a
+    shard (None when ownerless — the attempt is refused with zero
+    holds). The driver pumps its shards as usual, reports each member's
+    decision via :meth:`note`, and calls :meth:`finish` once the ticket
+    completes; ``finish`` commits the holds (all bound) or aborts —
+    unbinding any members that made it via the caller's ``unbind``
+    callback (the bind-API delete, which releases snapshot/journal
+    charges through the ordinary informer fan-out) and dropping every
+    hold so nothing is left zombie-claimed."""
+
+    def __init__(self, fabric, router, owner_of, lifecycle=None):
+        self.fabric = fabric
+        self.router = router
+        self.owner_of = owner_of
+        self.lifecycle = lifecycle
+        self._attempts = 0
+        self.stats = {
+            "placed": 0,
+            "aborted": 0,
+            "refused": 0,
+            "unbound": 0,
+        }
+
+    def begin(self, pods: Sequence) -> Optional[GangTicket]:
+        """Phase 1: route the members, take all-or-nothing holds, and
+        submit each shard's members as a LOCAL sub-gang (min = that
+        shard's member count, so the in-shard Permit machinery keeps the
+        local subset atomic). Returns None — with zero holds — when a
+        member's shard is ownerless or any hold is refused."""
+        from ..scheduler.plugins.coscheduling import gang_key_of
+
+        gang = gang_key_of(pods[0]) or f"anon/{pods[0].meta.uid}"
+        members = {p.meta.uid: self.router.route(p) for p in pods}
+        owners = {}
+        epochs = {}
+        for shard in sorted(set(members.values())):
+            owner = self.owner_of(shard)
+            rt = owner.runtime(shard) if owner is not None else None
+            if rt is None:
+                # ownerless — or the owner stepped down between the
+                # lookup and this read (the runtime is the epoch's
+                # source of truth, so read it exactly once)
+                self.stats["refused"] += 1
+                return None
+            owners[shard] = owner
+            epochs[shard] = rt.sched._fence_epoch
+        self._attempts += 1
+        attempt_id = f"xsgang:{gang}#{self._attempts}"
+        try:
+            won = self.fabric.claims.gang_prepare(
+                attempt_id, members, epochs
+            )
+        except StaleEpochError:
+            self.stats["refused"] += 1
+            return None
+        if not won:
+            self.stats["refused"] += 1
+            return None
+        ticket = GangTicket(
+            gang=gang,
+            attempt_id=attempt_id,
+            members=dict(members),
+            pods={p.meta.uid: p for p in pods},
+        )
+        try:
+            by_shard: Dict[int, List] = {}
+            for p in pods:
+                by_shard.setdefault(members[p.meta.uid], []).append(p)
+            submit_failed = False
+            for shard, group in sorted(by_shard.items()):
+                if submit_failed:
+                    # an earlier shard refused: the gang is already
+                    # doomed — don't enqueue more members
+                    for p in group:
+                        ticket.decided[p.meta.uid] = None
+                    continue
+                self._rewrite_subgang(gang, shard, group, ticket)
+                for p in group:
+                    if submit_failed or not owners[shard].submit(shard, p):
+                        # the owner lost the shard between the
+                        # ownership check and the submit (lease lapse /
+                        # step-down): mark this member — and every
+                        # not-yet-submitted one — terminally undecided
+                        # so the ticket still COMPLETES and finish()
+                        # aborts through the ordinary path, unbinding
+                        # whatever the already-submitted members do
+                        # bind. Zero zombie holds either way.
+                        submit_failed = True
+                        ticket.decided[p.meta.uid] = None
+        except Exception:
+            # the claim phase crashed mid-submit: zero holds survive,
+            # and the pods go back to their original gang shape
+            self.fabric.claims.gang_abort(attempt_id)
+            self._restore_subgang(ticket)
+            self.stats["refused"] += 1
+            raise
+        if submit_failed and ticket.complete():
+            # NOTHING was submitted anywhere — abort immediately (no
+            # decisions will ever arrive to drive finish())
+            self.fabric.claims.gang_abort(attempt_id)
+            self._restore_subgang(ticket)
+            ticket.aborted = True
+            self.stats["refused"] += 1
+            return None
+        return ticket
+
+    @staticmethod
+    def _rewritten_keys():
+        from ..api import extension as ext
+
+        return (
+            ext.ANNOTATION_GANG_NAME,
+            ext.ANNOTATION_GANG_MIN_AVAILABLE,
+            ext.ANNOTATION_GANG_TOTAL_NUM,
+            ext.ANNOTATION_GANG_GROUPS,
+        )
+
+    @classmethod
+    def _rewrite_subgang(
+        cls, gang: str, shard: int, group: Sequence, ticket: GangTicket
+    ) -> None:
+        """Rewrite the members of one shard into a shard-local sub-gang
+        sized to exactly the local member count — the shard's own
+        PodGroupManager then enforces local atomicity while the
+        cross-shard holds enforce global atomicity. Everything touched
+        is SAVED on the ticket so an abort restores the pods to their
+        original gang shape (a retry must route and size by the
+        original gang, not a first attempt's sub-group residue)."""
+        from ..api import extension as ext
+
+        bare = gang.split("/", 1)[-1]
+        for pod in group:
+            ann = pod.meta.annotations
+            ticket.saved_annotations[pod.meta.uid] = {
+                k: ann.get(k) for k in cls._rewritten_keys()
+            }
+            ann[ext.ANNOTATION_GANG_NAME] = f"{bare}-xs{shard}"
+            ann[ext.ANNOTATION_GANG_MIN_AVAILABLE] = str(len(group))
+            ann[ext.ANNOTATION_GANG_TOTAL_NUM] = str(len(group))
+            ann.pop(ext.ANNOTATION_GANG_GROUPS, None)
+            try:
+                del pod._gang_key  # bust the memoized key
+            except AttributeError:
+                pass
+
+    @classmethod
+    def _restore_subgang(cls, ticket: GangTicket) -> None:
+        """Abort path: put every rewritten member back into its
+        original gang shape so the retry sees the true gang."""
+        for uid, saved in ticket.saved_annotations.items():
+            pod = ticket.pods[uid]
+            for key, value in saved.items():
+                if value is None:
+                    pod.meta.annotations.pop(key, None)
+                else:
+                    pod.meta.annotations[key] = value
+            try:
+                del pod._gang_key
+            except AttributeError:
+                pass
+
+    def note(
+        self, ticket: GangTicket, uid: str, node: Optional[str]
+    ) -> Optional[bool]:
+        """Record one member's decision. Returns None while incomplete,
+        else True (every member bound) / False (abort required)."""
+        if uid in ticket.members:
+            ticket.decided[uid] = node
+        if not ticket.complete():
+            return None
+        return all(n is not None for n in ticket.decided.values())
+
+    def finish(self, ticket: GangTicket, unbind=None) -> bool:
+        """Phase 2 close-out: commit when every member bound, else
+        abort — unbind the partial placements and drop every hold."""
+        if ticket.committed or ticket.aborted:
+            return ticket.committed
+        if all(n is not None for n in ticket.decided.values()) and (
+            ticket.complete()
+        ):
+            self.fabric.claims.gang_commit(ticket.attempt_id)
+            ticket.committed = True
+            self.stats["placed"] += 1
+            return True
+        for uid, node in sorted(ticket.decided.items()):
+            if node is not None and unbind is not None:
+                unbind(ticket.pods[uid], ticket.members[uid], node)
+                self.stats["unbound"] += 1
+        self.fabric.claims.gang_abort(ticket.attempt_id)
+        self._restore_subgang(ticket)
+        ticket.aborted = True
+        self.stats["aborted"] += 1
+        return False

@@ -59,18 +59,75 @@ from .ha import LeaderCoordinator
 
 
 class ShardMap:
-    """Stable partition of node ownership into ``n_shards`` shards."""
+    """Stable — but now ELASTIC — partition of node ownership.
+
+    The deploy-time shape is ``n_shards`` **base cells** (hash modulo,
+    bit-identical to the PR 6 static map). The elastic-topology PR makes
+    the partition a prefix-free CELL TREE over those cells: splitting an
+    active shard replaces its cell with two child cells (each node
+    descends by an independent per-depth hash bit, so exactly the
+    parent's nodes — and nothing else — re-home, split roughly in half),
+    and merging two SIBLING cells re-unifies them under a fresh shard
+    id. Shard ids are never reused: a retired id's cell path is kept so
+    :meth:`cell_covers` can answer "was this node ever that shard's?"
+    for decisions that raced a topology change.
+
+    Reads are lock-free (the cell dict is swapped copy-on-write under
+    ``_lock``); only topology transitions mutate.
+    """
+
+    #: a cell path: (base cell, bit, bit, ...) — prefix-free cover
+    MAX_DEPTH = 62
 
     def __init__(self, n_shards: int):
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
-        self.n_shards = int(n_shards)
+        self.base = int(n_shards)
+        self._cells: Dict[Tuple[int, ...], int] = {
+            (i,): i for i in range(self.base)
+        }  # guarded-by: self._lock
+        #: every shard id EVER (active and retired) -> its cell path
+        self._paths: Dict[int, Tuple[int, ...]] = {
+            i: (i,) for i in range(self.base)
+        }  # guarded-by: self._lock
+        self._next_id = self.base
+        #: topology generation: bumped by every committed split/merge
+        self.generation = 0
+        self._lock = threading.Lock()
+
+    # ---- routing ----
+
+    @property
+    def n_shards(self) -> int:
+        """ACTIVE shard count (== the deploy-time count until the first
+        split commits)."""
+        return len(self._cells)
+
+    @staticmethod
+    def _bit(kind: str, name: str, depth: int) -> int:
+        """The per-depth descent bit: an independent hash per depth so a
+        re-split of a merged range re-partitions the same way (stable
+        across processes, like every routing hash here)."""
+        return _stable_hash(f"{kind}|{name}|d{depth}") & 1
+
+    def _locate(self, kind: str, name: str) -> int:
+        cells = self._cells  # one read: topology swaps copy-on-write
+        path: Tuple[int, ...] = (_stable_hash(f"{kind}|{name}") % self.base,)
+        sid = cells.get(path)
+        while sid is None:
+            if len(path) > self.MAX_DEPTH:
+                raise RuntimeError(
+                    f"no cell covers {kind}|{name} (corrupt topology)"
+                )
+            path = path + (self._bit(kind, name, len(path) - 1),)
+            sid = cells.get(path)
+        return sid
 
     def shard_of_node(self, node_name: str) -> int:
-        return _stable_hash(f"node|{node_name}") % self.n_shards
+        return self._locate("node", node_name)
 
     def shard_of_key(self, key: str) -> int:
-        return _stable_hash(f"key|{key}") % self.n_shards
+        return self._locate("key", key)
 
     def node_filter(self, shard: int) -> Callable[[str], bool]:
         """Predicate scoping a statehub wiring to one shard's nodes."""
@@ -81,10 +138,339 @@ class ShardMap:
         return owned
 
     def partition(self, node_names: Sequence[str]) -> Dict[int, List[str]]:
-        out: Dict[int, List[str]] = {s: [] for s in range(self.n_shards)}
+        out: Dict[int, List[str]] = {
+            s: [] for s in self.active_shards()
+        }
         for name in node_names:
             out[self.shard_of_node(name)].append(name)
         return out
+
+    # ---- topology surface (elastic-topology PR) ----
+
+    def active_shards(self) -> List[int]:
+        return sorted(self._cells.values())
+
+    def is_active(self, shard: int) -> bool:
+        path = self._paths.get(int(shard))
+        return path is not None and self._cells.get(path) == int(shard)
+
+    def path_of(self, shard: int) -> Optional[Tuple[int, ...]]:
+        return self._paths.get(int(shard))
+
+    def cell_covers(self, shard: int, node_name: str) -> bool:
+        """True when ``node_name`` falls inside the (possibly retired)
+        shard's cell range — generation-independent truth, so a decision
+        produced by a donor just before a split still attributes to the
+        range it legitimately owned."""
+        path = self._paths.get(int(shard))
+        if path is None:
+            return False
+        if _stable_hash(f"node|{node_name}") % self.base != path[0]:
+            return False
+        return all(
+            self._bit("node", node_name, d) == bit
+            for d, bit in enumerate(path[1:])
+        )
+
+    def split_dest(
+        self, parent: int, name: str, child0: int, child1: int,
+        kind: str = "node",
+    ) -> int:
+        """Which child of a PLANNED split of ``parent`` will own
+        ``name`` — computable before the split commits (the journal
+        re-home and the non-empty-children guard both need the answer
+        while the parent is still the active cell)."""
+        path = self._paths[int(parent)]
+        return child0 if self._bit(kind, name, len(path) - 1) == 0 else child1
+
+    def allocate_ids(self, n: int) -> List[int]:
+        """Fresh, never-reused shard ids for a planned transition. Ids
+        burned by a rolled-back attempt stay burned — a stale child
+        journal can then never be mistaken for a live shard's."""
+        with self._lock:
+            out = list(range(self._next_id, self._next_id + int(n)))
+            self._next_id += int(n)
+            return out
+
+    def split_cells(
+        self, parent: int, child0: int, child1: int
+    ) -> None:
+        """COMMIT a split: the parent's cell is replaced by two child
+        cells (bit 0 → child0, bit 1 → child1). Only the topology
+        transaction (:mod:`..runtime.elastic`) calls this, after the
+        journal re-home succeeded."""
+        with self._lock:
+            path = self._paths.get(int(parent))
+            if path is None or self._cells.get(path) != int(parent):
+                raise ValueError(f"shard {parent} is not an active cell")
+            cells = dict(self._cells)
+            del cells[path]
+            cells[path + (0,)] = int(child0)
+            cells[path + (1,)] = int(child1)
+            self._paths[int(child0)] = path + (0,)
+            self._paths[int(child1)] = path + (1,)
+            self._cells = cells
+            self.generation += 1
+
+    def merge_cells(self, a: int, b: int, merged: int) -> None:
+        """COMMIT a merge of two SIBLING cells into one fresh shard id
+        owning the parent range."""
+        with self._lock:
+            pa, pb = self._paths.get(int(a)), self._paths.get(int(b))
+            if (
+                pa is None
+                or pb is None
+                or self._cells.get(pa) != int(a)
+                or self._cells.get(pb) != int(b)
+                or len(pa) < 2
+                or pa[:-1] != pb[:-1]
+                or {pa[-1], pb[-1]} != {0, 1}
+            ):
+                raise ValueError(
+                    f"shards {a}/{b} are not active sibling cells"
+                )
+            cells = dict(self._cells)
+            del cells[pa]
+            del cells[pb]
+            parent_path = pa[:-1]
+            cells[parent_path] = int(merged)
+            self._paths[int(merged)] = parent_path
+            self._cells = cells
+            self.generation += 1
+
+    def successors(self, shard: int) -> List[int]:
+        """The ACTIVE shards whose ranges overlap a (possibly retired)
+        shard's cell — where that shard's journal live set was re-homed
+        to. A merge has one successor (the merged cell), a split has
+        two; an active shard is its own sole successor. Crash-orphan
+        reconciliation reads this: a binding journaled on a since-
+        retired shard is recovered by whichever successor owns its
+        node."""
+        path = self._paths.get(int(shard))
+        if path is None:
+            return []
+        cells = self._cells
+        out = [
+            sid
+            for p, sid in cells.items()
+            if p[: len(path)] == path or path[: len(p)] == p
+        ]
+        return sorted(out)
+
+    def siblings(self) -> List[Tuple[int, int]]:
+        """Active sibling cell pairs ``(bit0_shard, bit1_shard)`` — the
+        merge candidates (only a split can be undone; the deploy-time
+        base cells are the scale-in floor)."""
+        cells = self._cells
+        out: List[Tuple[int, int]] = []
+        for path, sid in cells.items():
+            if len(path) >= 2 and path[-1] == 0:
+                other = cells.get(path[:-1] + (1,))
+                if other is not None:
+                    out.append((sid, other))
+        return sorted(out)
+
+
+def transition_shards(intent: dict) -> set:
+    """Every shard id an open topology transition touches (donors AND
+    planned children) — none of them is electable while it is open."""
+    out = set()
+    for key in ("parent", "a", "b", "merged"):
+        if intent.get(key) is not None:
+            out.add(int(intent[key]))
+    for child in intent.get("children", ()):
+        out.add(int(child))
+    return out
+
+
+class ShardTopology:
+    """Journaled, generation-numbered shard-map transitions (the
+    elastic-topology tentpole's durable record).
+
+    Every split/merge is a two-record transaction over the same store
+    API the bind journals use: an ``*_intent`` record (gen = highest
+    generation ever journaled + 1) BEFORE any re-homing mutates shared
+    state, then either a ``*_commit`` (the :class:`ShardMap` cells swap
+    and the generation advances) or a ``rollback`` (the attempt's child
+    ids stay burned, the parent generation stays active). Generations
+    are **epoch-monotonic at the storage boundary**: an intent stamped
+    at or below the journaled high raises :class:`StaleEpochError` —
+    the same fencing-token-on-shared-store discipline the bind journal
+    enforces — and only ONE transition may be open at a time (a
+    half-owned range can never exist, even across racing controllers).
+
+    Reload replays committed transitions onto the map; a trailing open
+    intent is VOID (the splitting process died mid-transaction — the
+    parent generation is still the active one, exactly the rollback the
+    in-process crash path journals explicitly)."""
+
+    def __init__(self, shard_map: ShardMap, store=None):
+        from ..core.journal import MemoryJournalStore
+
+        self.map = shard_map
+        self.store = store if store is not None else MemoryJournalStore()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._gen_high = 0
+        self._open: Optional[dict] = None  # guarded-by: self._lock
+        for rec in sorted(
+            self.store.load(), key=lambda r: r.get("seq", 0)
+        ):
+            self._seq = max(self._seq, int(rec.get("seq", 0)))
+            self._gen_high = max(self._gen_high, int(rec.get("gen", 0)))
+            op = rec.get("op")
+            if op in ("split_intent", "merge_intent"):
+                self._open = dict(rec)
+                # keep id allocation ahead of every journaled attempt
+                ids = [int(i) for i in rec.get("children", ())]
+                ids.append(int(rec.get("merged", -1)))
+                with self.map._lock:
+                    self.map._next_id = max(
+                        self.map._next_id, max(ids) + 1
+                    )
+            elif op == "split_commit" and self._open is not None:
+                a, b = (int(i) for i in self._open["children"])
+                self.map.split_cells(int(self._open["parent"]), a, b)
+                self._open = None
+            elif op == "merge_commit" and self._open is not None:
+                self.map.merge_cells(
+                    int(self._open["a"]),
+                    int(self._open["b"]),
+                    int(self._open["merged"]),
+                )
+                self._open = None
+            elif op == "rollback":
+                self._open = None
+        # trailing open intent = crash mid-transaction: void by design
+        self._open = None
+
+    def _append_locked(self, rec: dict) -> dict:
+        self._seq += 1
+        rec = {"seq": self._seq, **rec}
+        self.store.append(rec)
+        return rec
+
+    @property
+    def generation(self) -> int:
+        return self.map.generation
+
+    def open_transition(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._open) if self._open is not None else None
+
+    def begin_split(self, parent: int) -> dict:
+        """Journal a split intent (fence-checked generation, fresh child
+        ids). Raises :class:`StaleEpochError` on a stale generation and
+        refuses to open a second transition while one is in flight."""
+        from ..core.journal import StaleEpochError
+
+        with self._lock:
+            if self._open is not None:
+                raise StaleEpochError(
+                    self._gen_high + 1,
+                    self._gen_high,
+                    what="topology transition (one already open)",
+                )
+            if not self.map.is_active(int(parent)):
+                raise ValueError(f"shard {parent} is not active")
+            gen = self._gen_high + 1
+            a, b = self.map.allocate_ids(2)
+            rec = self._append_locked(
+                {
+                    "op": "split_intent",
+                    "gen": gen,
+                    "parent": int(parent),
+                    "children": [a, b],
+                    "path": list(self.map.path_of(int(parent))),
+                }
+            )
+            self._gen_high = gen
+            self._open = dict(rec)
+            return dict(rec)
+
+    def begin_merge(self, a: int, b: int) -> dict:
+        from ..core.journal import StaleEpochError
+
+        with self._lock:
+            if self._open is not None:
+                raise StaleEpochError(
+                    self._gen_high + 1,
+                    self._gen_high,
+                    what="topology transition (one already open)",
+                )
+            if (int(a), int(b)) not in self.map.siblings():
+                raise ValueError(
+                    f"shards {a}/{b} are not mergeable siblings"
+                )
+            gen = self._gen_high + 1
+            (merged,) = self.map.allocate_ids(1)
+            rec = self._append_locked(
+                {
+                    "op": "merge_intent",
+                    "gen": gen,
+                    "a": int(a),
+                    "b": int(b),
+                    "merged": merged,
+                }
+            )
+            self._gen_high = gen
+            self._open = dict(rec)
+            return dict(rec)
+
+    def commit(self, intent: dict) -> None:
+        """Close the open transition successfully: swap the map's cells
+        and journal the commit — the generation the routers see advances
+        HERE, never mid-re-home."""
+        with self._lock:
+            if self._open is None or self._open["gen"] != intent["gen"]:
+                raise ValueError("no matching open topology transition")
+            if self._open["op"] == "split_intent":
+                a, b = (int(i) for i in self._open["children"])
+                # record first, then swap: a failed append leaves the
+                # map untouched (the intent stays open for rollback); a
+                # crash between the two replays the commit on reload
+                self._append_locked(
+                    {
+                        "op": "split_commit",
+                        "gen": int(self._open["gen"]),
+                        "parent": int(self._open["parent"]),
+                        "children": [a, b],
+                    }
+                )
+                self.map.split_cells(int(self._open["parent"]), a, b)
+            else:
+                self._append_locked(
+                    {
+                        "op": "merge_commit",
+                        "gen": int(self._open["gen"]),
+                        "merged": int(self._open["merged"]),
+                    }
+                )
+                self.map.merge_cells(
+                    int(self._open["a"]),
+                    int(self._open["b"]),
+                    int(self._open["merged"]),
+                )
+            self._open = None
+
+    def rollback(self, intent: dict, reason: str = "") -> None:
+        """Close the open transition WITHOUT touching the map: the
+        parent generation stays active (never a half-owned range); the
+        attempt's ids stay burned."""
+        with self._lock:
+            if self._open is None or self._open["gen"] != intent["gen"]:
+                return  # already closed (idempotent crash cleanup)
+            self._append_locked(
+                {
+                    "op": "rollback",
+                    "gen": int(self._open["gen"]),
+                    "reason": reason,
+                }
+            )
+            self._open = None
+
+    def history(self, limit: int = 64) -> List[dict]:
+        return self.store.load()[-int(limit):]
 
 
 class Membership:
@@ -133,11 +519,19 @@ class ShardFabric:
         membership_ttl_s: float = 3.0,
         flight_stores: Optional[Dict[int, object]] = None,
         handoff_log_cap: int = 1024,
+        topology_store=None,
     ):
         from ..core.journal import MemoryJournalStore
 
         self.shard_map = ShardMap(n_shards)
-        self.n_shards = int(n_shards)
+        #: deploy-time base cell count (the scale-in floor); the LIVE
+        #: shard count is :attr:`n_shards` / ``shard_map.active_shards()``
+        self.base_shards = int(n_shards)
+        #: elastic-topology PR: the journaled split/merge transition log
+        #: — replaying it onto the fresh base map reconstructs the live
+        #: generation, so the topology outlives any incarnation exactly
+        #: like the per-shard journals do
+        self.topology = ShardTopology(self.shard_map, store=topology_store)
         self.clock = clock
         self.fences: Dict[int, EpochFence] = {
             s: EpochFence() for s in range(n_shards)
@@ -168,8 +562,33 @@ class ShardFabric:
         #: threads) and a deque raises if mutated mid-iteration
         self.handoff_lock = threading.Lock()
         self.locks = LeaseLockSet()
-        self.claims = ClaimTable(claim_store, clock=clock)
+        # shard_live: a claim held by a RETIRED cell self-heals to the
+        # live claimant (closes the commit→rehome crash window)
+        self.claims = ClaimTable(
+            claim_store, clock=clock, shard_live=self.shard_map.is_active
+        )
         self.membership = Membership(membership_ttl_s, clock=clock)
+
+    @property
+    def n_shards(self) -> int:
+        """LIVE shard count — tracks the topology generation (kept as a
+        property so every pre-elastic consumer keeps reading the truth)."""
+        return self.shard_map.n_shards
+
+    def ensure_shard(self, shard: int) -> None:
+        """Materialize the durable substrate for a shard id minted by a
+        topology transition (child shards get fresh fences/stores — a
+        fresh fence at epoch 0 is exactly what lets the journal re-home
+        assert "no owner was ever granted here")."""
+        from ..core.journal import MemoryJournalStore
+
+        s = int(shard)
+        if s not in self.fences:
+            self.fences[s] = EpochFence()
+        if s not in self.journal_stores:
+            self.journal_stores[s] = MemoryJournalStore()
+        if s not in self.flight_stores:
+            self.flight_stores[s] = MemoryJournalStore()
 
     def shard_lease_lock(self, shard: int):
         return self.locks.lock(f"shard-{int(shard)}")
@@ -194,6 +613,8 @@ class ShardRouter:
         quota_of=None,
         spill_backlog: Optional[int] = None,
         lifecycle=None,
+        gang_of=None,
+        spill_resume_frac: float = 0.5,
     ):
         self.shard_map = shard_map
         if quota_of is None:
@@ -201,7 +622,25 @@ class ShardRouter:
 
             quota_of = quota_name_of
         self.quota_of = quota_of
+        if gang_of is None:
+            from ..scheduler.plugins.coscheduling import gang_key_of
+
+            gang_of = gang_key_of
+        #: gang members route WHOLE to the gang's home shard (one
+        #: PodGroupManager must see the whole gang for its min-member
+        #: gate); a gang whose feasible nodes SPAN shards goes through
+        #: the two-phase :class:`~.elastic.CrossShardGangCoordinator`
+        #: instead of this router
+        self.gang_of = gang_of
         self.spill_backlog = spill_backlog
+        #: spill hysteresis (elastic-topology PR satellite): fan-out
+        #: DISENGAGES only once the primary's backlog falls below
+        #: ``spill_resume_frac * spill_backlog`` — a backlog oscillating
+        #: around the threshold would otherwise toggle fan-out per pod,
+        #: churning ClaimTable claims/tombstones for nothing
+        self.spill_resume_frac = float(spill_resume_frac)
+        self._spilling: Dict[int, bool] = {}  # guarded-by: self._spill_lock
+        self._spill_lock = threading.Lock()
         #: fleet-tracing PR: when wired, route/fan-out decisions become
         #: lifecycle events (pods the tracker never saw get their
         #: ``submit`` anchor here — the router IS the control plane's
@@ -213,8 +652,12 @@ class ShardRouter:
             shard = self.shard_map.shard_of_node(pod.spec.node_name)
             detail = "node-pinned"
         else:
+            gang = self.gang_of(pod)
             leaf = self.quota_of(pod)
-            if leaf is not None:
+            if gang is not None:
+                shard = self.shard_map.shard_of_key(f"gang:{gang}")
+                detail = f"gang-home:{gang}"
+            elif leaf is not None:
                 shard = self.shard_map.shard_of_key(f"quota:{leaf}")
                 detail = f"quota-home:{leaf}"
             else:
@@ -227,21 +670,38 @@ class ShardRouter:
             lc.routed(pod.meta.uid, shard, detail=detail)
         return shard
 
+    def _spill_engaged(self, primary: int, backlog: int) -> bool:
+        """Hysteresis band: engage at ``spill_backlog``, release only
+        below ``spill_resume_frac`` of it."""
+        low = self.spill_backlog * self.spill_resume_frac
+        with self._spill_lock:
+            engaged = self._spilling.get(primary, False)
+            if not engaged and backlog >= self.spill_backlog:
+                engaged = True
+            elif engaged and backlog < low:
+                engaged = False
+            self._spilling[primary] = engaged
+            return engaged
+
     def targets(self, pod, backlog_of=None) -> List[int]:
         """Shards to enqueue the pod on: ``[primary]`` normally,
         ``[primary, spill]`` when the primary is backlogged and the pod
-        is free to move (not quota-homed, not node-pinned)."""
+        is free to move (not quota-homed, not gang-homed, not
+        node-pinned). The spill target is the NEXT active shard in the
+        live topology (ids are sparse once splits happen)."""
         primary = self.route(pod)
         if (
             self.spill_backlog is None
             or backlog_of is None
             or self.shard_map.n_shards < 2
             or pod.spec.node_name
+            or self.gang_of(pod) is not None
             or self.quota_of(pod) is not None
-            or backlog_of(primary) < self.spill_backlog
+            or not self._spill_engaged(primary, backlog_of(primary))
         ):
             return [primary]
-        spill = (primary + 1) % self.shard_map.n_shards
+        active = self.shard_map.active_shards()
+        spill = active[(active.index(primary) + 1) % len(active)]
         if self.lifecycle is not None:
             self.lifecycle.event(
                 pod.meta.uid, "fanout", shard=spill,
@@ -340,34 +800,83 @@ class ShardedScheduler:
             "handoffs": 0,
             "claims_lost": 0,
         }
+        self._elect_kw = {
+            "lease_duration": lease_duration,
+            "renew_deadline": renew_deadline,
+            "retry_period": retry_period,
+        }
         self._coords: Dict[int, LeaderCoordinator] = {}
-        for s in range(fabric.n_shards):
-            elector = LeaderElector(
-                fabric.shard_lease_lock(s),
-                identity=name,
-                lease_duration=lease_duration,
-                renew_deadline=renew_deadline,
-                retry_period=retry_period,
-                now_fn=self.clock,
-                sleep_fn=lambda _dt: None,
-            )
-            self._coords[s] = LeaderCoordinator(
-                sched_factory=self._factory(s),
-                elector=elector,
-                fence=fabric.fences[s],
-                # no eager journal: _factory installs the runtime's own
-                # BindJournal before recovery ever reads it, and an eager
-                # instance would pay a full store.load() per (incarnation,
-                # shard) at construction for nothing
-                hub=hub,
-                verify_recovery=verify_recovery,
-                chaos=self.chaos,
-                acquire_gate=self._gate(s),
-                on_loss=self._teardown(s),
-                recovery_pod_filter=self._pod_filter(s),
-            )
+        for s in fabric.shard_map.active_shards():
+            self._coords[s] = self._make_coord(s)
 
     # ---- per-shard closures ----
+
+    def _make_coord(self, shard: int) -> LeaderCoordinator:
+        s = int(shard)
+        self.fabric.ensure_shard(s)
+        elector = LeaderElector(
+            self.fabric.shard_lease_lock(s),
+            identity=self.name,
+            now_fn=self.clock,
+            sleep_fn=lambda _dt: None,
+            **self._elect_kw,
+        )
+        return LeaderCoordinator(
+            sched_factory=self._factory(s),
+            elector=elector,
+            fence=self.fabric.fences[s],
+            # no eager journal: _factory installs the runtime's own
+            # BindJournal before recovery ever reads it, and an eager
+            # instance would pay a full store.load() per (incarnation,
+            # shard) at construction for nothing
+            hub=self.hub,
+            verify_recovery=self.verify_recovery,
+            chaos=self.chaos,
+            acquire_gate=self._gate(s),
+            on_loss=self._teardown(s),
+            recovery_pod_filter=self._pod_filter(s),
+        )
+
+    def _sync_topology(self) -> None:
+        """Track the live topology (elastic-topology PR): a committed
+        split/merge retires cells and mints new ones — every incarnation
+        grows coordinators for the fresh shards (so the rendezvous
+        election can seat their first owners) and retires coordinators
+        for dead cells. A retired cell's leader steps down here — the
+        controller normally relinquished it pre-commit, so this is the
+        backstop for an incarnation that raced the transition — and its
+        drained queue surfaces through the ordinary handoff path."""
+        active = set(self.fabric.shard_map.active_shards())
+        for s in sorted(active - set(self._coords)):
+            self._coords[s] = self._make_coord(s)
+        for s in sorted(set(self._coords) - active):
+            coord = self._coords[s]
+            if coord.leading:
+                coord.step_down()
+            del self._coords[s]
+
+    def relinquish(
+        self, shard: int, event: Optional[str] = None, detail: str = ""
+    ) -> bool:
+        """Voluntarily surrender a shard mid-topology-transition (called
+        by the split/merge transaction on the donor BEFORE the commit):
+        the coordinator steps down — the stream drains its pipeline
+        through the revoked fence, the queue surfaces with arrival
+        stamps/retry budgets intact — and each surfaced pod's timeline
+        gets the transition bracket (``shard_split``/``shard_merge``)
+        so the gap-free-timeline validator can demand the re-home's
+        ``resubmit``/``enqueue`` bridge on the other side."""
+        coord = self._coords.get(int(shard))
+        if coord is None or not coord.leading:
+            return False
+        coord.step_down()
+        hand = self._handoffs.get(int(shard))
+        if hand is not None and self.lifecycle is not None and event:
+            for pod, _arr, _tries in hand.queued:
+                self.lifecycle.event(
+                    pod.meta.uid, event, shard=int(shard), detail=detail
+                )
+        return True
 
     def _factory(self, shard: int):
         def build():
@@ -381,6 +890,15 @@ class ShardedScheduler:
 
     def _gate(self, shard: int):
         def designated() -> bool:
+            # a shard inside an OPEN topology transition is not
+            # electable: the donor relinquished it for the re-home, and
+            # seating a new owner mid-transaction would let two
+            # incarnations serve overlapping ranges (elastic-topology
+            # PR; a rollback closes the transition and re-opens the
+            # parent's election, a commit retires the cell entirely)
+            open_tx = self.fabric.topology.open_transition()
+            if open_tx is not None and shard in transition_shards(open_tx):
+                return False
             alive = set(self.fabric.membership.alive())
             alive.add(self.name)
             return (
@@ -531,13 +1049,15 @@ class ShardedScheduler:
         )
 
     def owns(self, shard: int) -> bool:
-        return self._coords[shard].leading
+        coord = self._coords.get(shard)
+        return coord is not None and coord.leading
 
     def runtime(self, shard: int) -> Optional[ShardRuntime]:
         return self._runtimes.get(shard)
 
     def last_recovery(self, shard: int):
-        return self._coords[shard].last_recovery
+        coord = self._coords.get(shard)
+        return coord.last_recovery if coord is not None else None
 
     def backlog(self, shard: int) -> int:
         rt = self._runtimes.get(shard)
@@ -562,7 +1082,8 @@ class ShardedScheduler:
         if self.dead:
             return {}
         self.fabric.membership.heartbeat(self.name)
-        for s, coord in self._coords.items():
+        self._sync_topology()
+        for s, coord in list(self._coords.items()):
             if coord.leading and not self._gate(s)():
                 # rebalance: a preferred live candidate exists (e.g. a
                 # restarted incarnation rejoined) — voluntary handoff
@@ -693,9 +1214,11 @@ class ShardedScheduler:
                     )
             rt.stream.close()
             self.hub.detach(rt.informers)
-            self._coords[s].leading = False
-            self._coords[s].sched = None
-            self._coords[s].pipeline = None
+            coord = self._coords.get(s)
+            if coord is not None:
+                coord.leading = False
+                coord.sched = None
+                coord.pipeline = None
         self._runtimes.clear()
         self._handoffs.clear()
         self.dead = True

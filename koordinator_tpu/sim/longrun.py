@@ -1386,7 +1386,15 @@ def _run_sharded_soak(
     * (fleet-tracing PR) the killed incarnation's crash-surviving
       flight recorder is READABLE after recovery: the shard's new owner
       adopts the dead writer's per-cycle tail from the fabric's store
-      and serves it at ``/debug/flightrecorder``.
+      and serves it at ``/debug/flightrecorder``;
+    * (elastic-topology PR) one shard SPLIT and one MERGE execute under
+      live traffic mid-schedule — each preceded by a crash-armed
+      attempt (``shard.split_crash`` / ``shard.merge_crash``) that must
+      roll back to the parent generation cleanly — with queue
+      continuity across the transition, journal live sets re-homed into
+      the child shards, claims following their pods, and every
+      invariant above (zero-dup, zero-lost-ack, bit-exact resident
+      state, gap-free timelines) green across the topology epoch bumps.
     """
     import json
     import random as _random
@@ -1516,6 +1524,20 @@ def _run_sharded_soak(
         )
 
     incs = [_make_incarnation(i, 0) for i in range(incarnations)]
+    # elastic-topology PR: the controller that executes the scheduled
+    # split/merge transactions (fixed cycles below — the burn-DRIVEN
+    # policy path has its own deterministic unit tests; the soak's job
+    # is the transactional invariants under full chaos load)
+    from koordinator_tpu.runtime.elastic import TopologyController
+
+    topo_ctrl = TopologyController(
+        fabric,
+        slo=slo,
+        incarnations=lambda: [i for i in incs if not i.dead],
+        node_names=lambda: list(node_names),
+        chaos=chaos,
+        lifecycle=lifecycle,
+    )
     # leak-detector arm (devprof PR): live device arrays sampled at each
     # incarnation boundary — a killed incarnation's resident tables must
     # actually die; monotone growth across the samples fails the soak
@@ -1561,6 +1583,14 @@ def _run_sharded_soak(
     pod_seq = 0
     crash_cycle = max(2, cycles // 3)
     restart_cycle = max(6, (3 * cycles) // 5)
+    # elastic-topology schedule (fixed cycles — no rng draws, so every
+    # historical seeded fault trace stays bit-identical): a crash-armed
+    # split attempt that must ROLL BACK, the real split two cycles
+    # later, then the same pattern for the merge of the new siblings
+    split_crash_cycle = max(3, cycles // 6)
+    split_cycle = split_crash_cycle + 2
+    merge_crash_cycle = max(split_cycle + 3, (7 * cycles) // 10)
+    merge_cycle = merge_crash_cycle + 2
     quota_max_vec = None
 
     def _owner_of(shard: int):
@@ -1574,10 +1604,14 @@ def _run_sharded_soak(
             f"pod {pod.meta.name} placed twice: "
             f"{placed[pod.meta.uid]} then {node} (shard {shard})"
         )
-        # shard-correctness: the binding must land on a node the shard
-        # owns — a cross-shard bind would mean the fencing/claim
-        # machinery let a foreign owner mutate this partition
-        assert fabric.shard_map.shard_of_node(node) == shard, (
+        # shard-correctness: the binding must land inside the shard's
+        # CELL RANGE — a cross-range bind would mean the fencing/claim
+        # machinery let a foreign owner mutate this partition.
+        # cell_covers (not shard_of_node equality) because a donor's
+        # drained decision can absorb AFTER a split committed: the node
+        # now routes to a child, but the parent legitimately owned the
+        # range when it decided
+        assert fabric.shard_map.cell_covers(shard, node), (
             f"{pod.meta.name} bound on {node} by shard {shard}"
         )
         placed[pod.meta.uid] = node
@@ -1636,6 +1670,42 @@ def _run_sharded_soak(
                     alive, key=lambda i: (len(i.owned()), i.name)
                 )
 
+        # ---- elastic topology schedule (elastic-topology PR): a split
+        # and a merge under LIVE traffic, each preceded by a crash-armed
+        # attempt whose rollback must leave the parent generation
+        # serving (never a half-owned range). The donor's surfaced
+        # queue rides the ordinary handoff path and re-routes against
+        # whatever topology the transaction settled on. ----
+        if cycle < cycles:
+            if cycle == split_crash_cycle:
+                target = topo_ctrl.pick_split_candidate()
+                if target is not None:
+                    chaos.arm("shard.split_crash", times=1)
+                    assert topo_ctrl.split(target, cycle=cycle) is None, (
+                        "crash-armed split must roll back"
+                    )
+                    assert fabric.topology.open_transition() is None
+                    assert fabric.shard_map.is_active(target), (
+                        "rolled-back split must keep the parent active"
+                    )
+            if cycle == split_cycle:
+                target = topo_ctrl.pick_split_candidate()
+                if target is not None:
+                    out = topo_ctrl.split(target, cycle=cycle)
+                    assert out is not None, "scheduled split failed"
+            if cycle == merge_crash_cycle and fabric.shard_map.siblings():
+                a_s, b_s = fabric.shard_map.siblings()[0]
+                chaos.arm("shard.merge_crash", times=1)
+                assert topo_ctrl.merge(a_s, b_s, cycle=cycle) is None, (
+                    "crash-armed merge must roll back"
+                )
+                assert fabric.shard_map.is_active(a_s)
+                assert fabric.shard_map.is_active(b_s)
+            if cycle == merge_cycle and fabric.shard_map.siblings():
+                a_s, b_s = fabric.shard_map.siblings()[0]
+                out = topo_ctrl.merge(a_s, b_s, cycle=cycle)
+                assert out is not None, "scheduled merge failed"
+
         # ---- arrivals ----
         arriving = []
         if cycle < cycles:
@@ -1679,6 +1749,13 @@ def _run_sharded_soak(
         # the live owner's bounded ring as it keeps recording) ----
         if doomed_flight_shards:
             for s in sorted(doomed_flight_shards):
+                if not fabric.shard_map.is_active(s):
+                    # the shard was merged/split away before a takeover
+                    # could serve the dead writer's tail — the records
+                    # live on in the fabric store, but there is no
+                    # owner surface left to assert against
+                    doomed_flight_shards.discard(s)
+                    continue
                 owner = _owner_of(s)
                 rt = owner.runtime(s) if owner is not None else None
                 if rt is None or rt.sched.flight_recorder is None:
@@ -1714,14 +1791,29 @@ def _run_sharded_soak(
             for pod, shard in orphans:
                 if pod.meta.uid in placed:
                     continue
-                owner = _owner_of(shard)
-                if owner is None:
+                # a topology transition may have retired the orphan's
+                # shard mid-reconciliation: its journal live set was
+                # re-homed, so the binding (if acknowledged) is in a
+                # SUCCESSOR's recovery — check whichever successors
+                # have owners, defer while any is still ownerless
+                cand_shards = fabric.shard_map.successors(shard)
+                owners = [
+                    (s, _owner_of(s)) for s in (cand_shards or [shard])
+                ]
+                if any(o is None for _s, o in owners):
                     still_orphaned.append((pod, shard))
                     continue
-                rec = owner.last_recovery(shard)
-                bindings = rec.bindings if rec is not None else {}
-                node = bindings.get(pod.meta.uid)
+                node = None
+                hit_shard = shard
+                for s, owner in owners:
+                    rec = owner.last_recovery(s)
+                    bindings = rec.bindings if rec is not None else {}
+                    node = bindings.get(pod.meta.uid)
+                    if node is not None:
+                        hit_shard = s
+                        break
                 if node is not None:
+                    shard = hit_shard
                     _place(pod, node, shard)
                     # the replay emitted ``recover``; the driver (the
                     # bind-API observer here) publishing the recovered
@@ -1740,6 +1832,11 @@ def _run_sharded_soak(
         # fresh pods to their routed shard; ownerless shards defer ----
         still_handoff = []
         for shard, pod, arr, tries in pending_handoff:
+            if not fabric.shard_map.is_active(shard):
+                # the shard retired under the pod (split/merge commit):
+                # re-route against the live topology, stamps intact —
+                # the route event is the timeline's bridge anchor
+                shard = router.route(pod)
             owner = _owner_of(shard)
             if owner is not None and owner.resubmit(shard, pod, arr, tries):
                 inflight[pod.meta.uid] = (pod, shard, owner.name)
@@ -1757,7 +1854,7 @@ def _run_sharded_soak(
             else:
                 still_pending.append(pod)
         pending = still_pending
-        for s in range(shards):
+        for s in fabric.shard_map.active_shards():
             if _owner_of(s) is None:
                 stats["shard_cycles_without_owner"] += 1
 
@@ -1847,6 +1944,9 @@ def _run_sharded_soak(
                 np.testing.assert_allclose(
                     snap.nodes.requested, want, atol=1e-3
                 )
+        # the quota HOME moves with the topology: a split of the home
+        # shard re-homes the ledger to the child now covering the key
+        home_shard = fabric.shard_map.shard_of_key("quota:soak-team")
         home_owner = _owner_of(home_shard)
         if home_owner is not None:
             rt = home_owner.runtime(home_shard)
@@ -1907,6 +2007,8 @@ def _run_sharded_soak(
         pending = still
         still_handoff = []
         for shard, pod, arr, tries in pending_handoff:
+            if not fabric.shard_map.is_active(shard):
+                shard = router.route(pod)
             owner = _owner_of(shard)
             if owner is not None and owner.resubmit(shard, pod, arr, tries):
                 inflight[pod.meta.uid] = (pod, shard, owner.name)
@@ -1928,8 +2030,11 @@ def _run_sharded_soak(
     assert stats["placed"] == stats["arrived"] == len(placed)
     # zero lost acknowledged bindings, PER SHARD: every journal-live
     # bind (acked binds minus forgets, across every incarnation that
-    # ever owned the shard) landed in the placed ledger on ITS node
-    for s in range(shards):
+    # ever owned the shard) landed in the placed ledger on ITS node.
+    # EVERY journal store ever minted is checked — retired donors'
+    # stores included (their live sets were re-homed, so the same entry
+    # also appears in a child journal; both must agree with `placed`)
+    for s in sorted(fabric.journal_stores):
         rep = BindJournal(fabric.journal_stores[s]).replay()
         for uid, entry in rep.live.items():
             assert uid in placed, (
@@ -1987,11 +2092,19 @@ def _run_sharded_soak(
         inc.name: inc.owned() for inc in incs if not inc.dead
     }
     stats["shard_epochs_final"] = {
-        s: fabric.fences[s].current() for s in range(shards)
+        s: fabric.fences[s].current() for s in sorted(fabric.fences)
     }
     stats["journal_records"] = {
-        s: len(fabric.journal_stores[s].load()) for s in range(shards)
+        s: len(fabric.journal_stores[s].load())
+        for s in sorted(fabric.journal_stores)
     }
+    # elastic-topology PR: the scheduled split + merge really executed
+    # (and their crash-armed attempts really rolled back)
+    stats["splits"] = topo_ctrl.stats["splits"]
+    stats["merges"] = topo_ctrl.stats["merges"]
+    stats["topology_rollbacks"] = topo_ctrl.stats["rollbacks"]
+    stats["generation_final"] = fabric.topology.generation
+    stats["active_shards_final"] = fabric.shard_map.active_shards()
     stats["health_ok"] = all(
         inc.runtime(s).sched.extender.health.ok()
         for inc in incs

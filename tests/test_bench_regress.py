@@ -172,6 +172,53 @@ class TestCommittedArtifacts:
         assert artifact["regressions"] == ["s"] and artifact["exit"] == 1
         assert "regression(s)" in capsys.readouterr().err
 
+    def test_scenario_filter_gates_one_entry_independently(
+        self, tmp_path, capsys
+    ):
+        """--scenario NAME compares only the named entries — the
+        fleet_day CPU artifact can be gated without dragging in
+        cross-backend rows from the accelerator suite (elastic-topology
+        PR satellite)."""
+        base = tmp_path / "base.json"
+        cur = tmp_path / "cur.json"
+        base.write_text(
+            json.dumps(
+                [
+                    _entry("fleet_day", 1000.0, [990.0, 1000.0, 1010.0]),
+                    _entry("axon_only", 9000.0, [8990.0, 9000.0, 9010.0]),
+                ]
+            )
+        )
+        cur.write_text(
+            json.dumps([_entry("fleet_day", 1005.0, [995.0, 1005.0, 1015.0])])
+        )
+        out_json = tmp_path / "rows.json"
+        rc = bench_regress.main(
+            [
+                "--baseline", str(base),
+                "--current", str(cur),
+                "--scenario", "fleet_day",
+                "--json", str(out_json),
+            ]
+        )
+        assert rc == 0
+        artifact = json.loads(out_json.read_text())
+        scenarios = [r["scenario"] for r in artifact["rows"]]
+        assert scenarios == ["fleet_day"], (
+            "the unfiltered axon_only row must not appear (it would "
+            "read MISSING and pollute the verdict counts)"
+        )
+        assert artifact["rows"][0]["verdict"] == "OK"
+        # an unknown scenario is a usage error, not a silent empty run
+        with pytest.raises(SystemExit):
+            bench_regress.main(
+                [
+                    "--baseline", str(base),
+                    "--current", str(cur),
+                    "--scenario", "no-such-scenario",
+                ]
+            )
+
     def test_json_to_stdout_is_one_artifact(self, tmp_path, capsys):
         """--json - replaces the text table with the machine artifact:
         CI and the verdict table consume ONE comparison."""

@@ -110,6 +110,20 @@ def test_chaos_soak_multi_shard_arm():
     assert "commit.crash" in points
     # per-shard epochs all advanced past the initial grant somewhere
     assert max(stats["shard_epochs_final"].values()) >= 2
+    # elastic topology (elastic-topology PR): one SPLIT and one MERGE
+    # executed under live traffic mid-schedule, each preceded by a
+    # crash-armed attempt that rolled back to the parent generation
+    # (the zero-dup / zero-lost-ack / bit-exact / gap-free-timeline
+    # invariants across the transitions are asserted INSIDE the soak)
+    assert stats["splits"] == 1 and stats["merges"] == 1
+    assert stats["topology_rollbacks"] == 2
+    assert stats["generation_final"] == 2
+    assert "shard.split_crash" in points
+    assert "shard.merge_crash" in points
+    # the cell count is back to the deploy-time base after the merge,
+    # but the merged cell carries a FRESH shard id (ids never recycle)
+    assert len(stats["active_shards_final"]) == 3
+    assert stats["active_shards_final"] != [0, 1, 2]
 
 
 @pytest.mark.chaos

@@ -1,0 +1,468 @@
+"""Cross-shard gang scheduling tests (elastic-topology PR).
+
+The PR 6 router routes gangs whole to a home shard, so a gang whose
+feasible nodes SPAN shards was unplaceable. The two-phase
+claim-then-commit protocol fixes that: phase 1 takes all-or-nothing
+ClaimTable HOLDS on every member, phase 2 schedules each shard's
+members as a local sub-gang and commits the holds into claims — or
+aborts, unbinding partial placements and dropping every hold.
+
+Covers: the ClaimTable hold protocol (all-or-nothing prepare, rival
+claims lose against holds, commit→claims, abort→fully claimable again,
+epoch fencing, CRASHED claim phase leaves zero holds on reload); and
+the end-to-end coordinator (a gang pinned across two shards places
+all-or-nothing; an infeasible member aborts the WHOLE gang with zero
+zombie holds and zero residual binds).
+"""
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.core.journal import (
+    ClaimTable,
+    MemoryJournalStore,
+    StaleEpochError,
+)
+from koordinator_tpu.runtime.elastic import CrossShardGangCoordinator
+from koordinator_tpu.runtime.shards import (
+    ShardedScheduler,
+    ShardFabric,
+    ShardRouter,
+)
+from koordinator_tpu.runtime.statehub import ClusterStateHub
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+
+N_SHARDS = 3
+N_NODES = 18
+
+
+# ---------------------------------------------------------------------------
+# ClaimTable: the two-phase hold protocol
+# ---------------------------------------------------------------------------
+
+
+def test_gang_prepare_is_all_or_nothing_and_holds_beat_rivals():
+    t = ClaimTable()
+    assert t.gang_prepare("g1", {"u1": 0, "u2": 1}, {0: 1, 1: 1})
+    assert t.gang_holds() == 2
+    # the holder shard's own feed-time claim proceeds; rivals lose
+    assert t.claim("u1", 0, 1) is True
+    assert t.claim("u1", 2, 1) is False
+    # a second gang touching a held member is refused with ZERO holds
+    assert not t.gang_prepare("g2", {"u2": 2, "u3": 2}, {2: 1})
+    assert t.gang_holds("g2") == 0 and t.gang_holds() == 2
+    # an already-claimed pod can only be prepared on its winning shard
+    assert t.claim("w1", 2, 1)
+    assert not t.gang_prepare("g3", {"w1": 0}, {0: 1})
+    assert t.gang_prepare("g4", {"w1": 2}, {2: 1})
+
+
+def test_gang_commit_converts_holds_to_claims():
+    store = MemoryJournalStore()
+    t = ClaimTable(store)
+    assert t.gang_prepare("g1", {"u1": 0, "u2": 1}, {0: 1, 1: 1})
+    t.gang_commit("g1")
+    assert t.gang_holds() == 0
+    assert t.winner("u1") == 0 and t.winner("u2") == 1
+    # committed claims survive a reload (ordinary claim semantics from
+    # here: release tombstones at pod GC, etc.)
+    t2 = ClaimTable(store)
+    assert t2.winner("u1") == 0 and t2.gang_holds() == 0
+    assert t2.claim("u1", 1, 1) is False
+
+
+def test_gang_abort_leaves_members_fully_claimable():
+    t = ClaimTable()
+    assert t.gang_prepare("g1", {"u1": 0, "u2": 1}, {0: 1, 1: 1})
+    t.gang_abort("g1")
+    assert t.gang_holds() == 0
+    # no tombstone: an aborted member is NOT settled — any shard may
+    # claim it for the retry
+    assert t.claim("u1", 2, 1) is True
+    assert t.winner("u2") is None
+
+
+def test_crashed_claim_phase_leaves_zero_holds_on_reload():
+    store = MemoryJournalStore()
+    t = ClaimTable(store)
+    assert t.gang_prepare("g1", {"u1": 0, "u2": 1, "u3": 2}, {0: 1, 1: 1, 2: 1})
+    assert t.gang_holds() == 3
+    # the claiming coordinator DIES here: a fresh table over the same
+    # store must see a hold record with no commit — and drop it
+    t2 = ClaimTable(store)
+    assert t2.gang_holds() == 0
+    assert t2.claim("u1", 2, 1) is True  # members claimable again
+    # …while a committed gang in the same store would have survived
+    assert t2.winner("u2") is None
+
+
+def test_gang_prepare_is_epoch_fenced_per_shard():
+    t = ClaimTable()
+    t.claim("x", 3, 5)  # shard 3's claim-epoch high is now 5
+    with pytest.raises(StaleEpochError):
+        t.gang_prepare("g1", {"u1": 3}, {3: 4})
+    assert t.gang_holds() == 0
+    # missing epoch for an involved shard is refused outright
+    with pytest.raises(StaleEpochError):
+        t.gang_prepare("g2", {"u2": 7}, {})
+
+
+def test_gang_holds_survive_tombstone_gc():
+    store = MemoryJournalStore()
+    t = ClaimTable(store, clock=lambda: 100.0)
+    t.claim("old", 0, 1)
+    t.release("old")  # tombstoned at t=100
+    assert t.gang_prepare("g1", {"u1": 1}, {1: 1})
+    t2 = ClaimTable(store, clock=lambda: 10_000.0)
+    # (reload drops the uncommitted hold per crash semantics; exercise
+    # GC on the ORIGINAL table where the hold is live)
+    live = t.gc_tombstones(retention_s=60.0, now=10_000.0)
+    assert live == 0
+    assert t.gang_holds() == 1, "GC must not drop live gang holds"
+    t.gang_commit("g1")
+    assert t.winner("u1") == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: a gang spanning shards places all-or-nothing
+# ---------------------------------------------------------------------------
+
+
+def _node(name, cpu=32_000.0, mem=128 * 1024.0):
+    return Node(
+        meta=ObjectMeta(name=name),
+        status=NodeStatus(
+            allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: mem}
+        ),
+    )
+
+
+def _gang_pod(name, gang, node=None, cpu=2000.0, mem=4096.0):
+    return Pod(
+        meta=ObjectMeta(
+            name=name,
+            namespace="team",
+            annotations={
+                ext.ANNOTATION_GANG_NAME: gang,
+                ext.ANNOTATION_GANG_MIN_AVAILABLE: "3",
+                ext.ANNOTATION_GANG_TOTAL_NUM: "3",
+            },
+        ),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: mem},
+            priority=9000,
+            node_name=node,
+        ),
+    )
+
+
+def _make_scheduler(shard, snapshot, fence, journal):
+    s = BatchScheduler(
+        snapshot,
+        LoadAwareArgs(usage_thresholds={}),
+        batch_bucket=16,
+        journal=journal,
+        fence=fence,
+    )
+    s.extender.monitor.stop_background()
+    return s
+
+
+class _World:
+    def __init__(self):
+        self.t = [0.0]
+        self.fabric = ShardFabric(
+            N_SHARDS, clock=lambda: self.t[0], membership_ttl_s=2.5
+        )
+        self.hub = ClusterStateHub()
+        self.node_names = [f"n{i:03d}" for i in range(N_NODES)]
+        for name in self.node_names:
+            self.hub.publish(self.hub.nodes, _node(name))
+        self.incs = []
+
+    def incarnation(self, name):
+        inc = ShardedScheduler(
+            name,
+            self.hub,
+            self.fabric,
+            _make_scheduler,
+            pipelined=False,
+            max_batch=32,
+            max_retries=3,
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            retry_period=0.5,
+        )
+        self.fabric.membership.heartbeat(name)
+        self.incs.append(inc)
+        return inc
+
+    def settle(self, ticks=3):
+        for _ in range(ticks):
+            self.t[0] += 1.0
+            for inc in self.incs:
+                if not inc.dead:
+                    inc.tick()
+
+    def owner_of(self, shard):
+        for inc in self.incs:
+            if not inc.dead and inc.owns(shard):
+                return inc
+        return None
+
+    def nodes_on(self, shard, count):
+        return [
+            n
+            for n in self.node_names
+            if self.fabric.shard_map.shard_of_node(n) == shard
+        ][:count]
+
+    def close(self):
+        for inc in self.incs:
+            if not inc.dead:
+                inc.close()
+        self.hub.stop()
+
+
+def _drive_gang(world, coord, ticket, publish=True, rounds=10):
+    """Pump until the ticket completes; the driver publishes bound
+    members (the bind-API ack) and reports every decision."""
+    verdict = None
+    bound_nodes = {}
+    for _ in range(rounds):
+        for inc in world.incs:
+            if inc.dead:
+                continue
+            for s, pod, node, _lat in inc.pump() + inc.flush():
+                if node is not None:
+                    bound_nodes[pod.meta.uid] = (s, node)
+                    if publish:
+                        pod.spec.node_name = node
+                        world.hub.publish(world.hub.pods, pod)
+                v = coord.note(ticket, pod.meta.uid, node)
+                if v is not None:
+                    verdict = v
+        world.settle(1)
+        if verdict is not None:
+            break
+    return verdict, bound_nodes
+
+
+def _requested_cpu(world):
+    """Total requested batch-CPU across every owned shard snapshot."""
+    total = 0.0
+    for inc in world.incs:
+        if inc.dead:
+            continue
+        for s in inc.owned():
+            rt = inc.runtime(s)
+            if rt is not None:
+                total += float(rt.sched.snapshot.nodes.requested.sum())
+    return total
+
+
+def test_cross_shard_gang_places_all_or_nothing_and_commits():
+    world = _World()
+    world.incarnation("inc-a")
+    world.incarnation("inc-b")
+    try:
+        world.settle(3)
+        # pin members across two DIFFERENT shards — the configuration
+        # the gang-home router cannot place at all
+        shards = world.fabric.shard_map.active_shards()
+        sa, sb = shards[0], shards[1]
+        na = world.nodes_on(sa, 2)
+        nb = world.nodes_on(sb, 1)
+        assert len(na) == 2 and len(nb) == 1
+        pods = [
+            _gang_pod("g-m0", "span", node=na[0]),
+            _gang_pod("g-m1", "span", node=na[1]),
+            _gang_pod("g-m2", "span", node=nb[0]),
+        ]
+        router = ShardRouter(world.fabric.shard_map)
+        coord = CrossShardGangCoordinator(
+            world.fabric, router, world.owner_of
+        )
+        ticket = coord.begin(pods)
+        assert ticket is not None
+        assert set(ticket.members.values()) == {sa, sb}, "gang spans shards"
+        assert world.fabric.claims.gang_holds() == 3
+        verdict, bound = _drive_gang(world, coord, ticket)
+        assert verdict is True, f"gang must fully place, got {ticket.decided}"
+        assert coord.finish(ticket) is True
+        # holds became ordinary claims on the binding shards
+        assert world.fabric.claims.gang_holds() == 0
+        for uid, shard in ticket.members.items():
+            assert world.fabric.claims.winner(uid) == shard
+        # every member on its pinned node
+        assert {n for _s, n in bound.values()} == set(na) | set(nb)
+        assert coord.stats["placed"] == 1
+    finally:
+        world.close()
+
+
+def test_cross_shard_gang_aborts_whole_with_zero_zombie_state():
+    world = _World()
+    world.incarnation("inc-a")
+    world.incarnation("inc-b")
+    try:
+        world.settle(3)
+        shards = world.fabric.shard_map.active_shards()
+        sa, sb = shards[0], shards[1]
+        na = world.nodes_on(sa, 2)
+        nb = world.nodes_on(sb, 1)
+        base_cpu = _requested_cpu(world)
+        pods = [
+            _gang_pod("g-m0", "doomed", node=na[0]),
+            _gang_pod("g-m1", "doomed", node=na[1]),
+            # infeasible member: requests more CPU than any node has
+            _gang_pod("g-m2", "doomed", node=nb[0], cpu=64_000.0),
+        ]
+        router = ShardRouter(world.fabric.shard_map)
+        coord = CrossShardGangCoordinator(
+            world.fabric, router, world.owner_of
+        )
+        ticket = coord.begin(pods)
+        assert ticket is not None
+        verdict, bound = _drive_gang(world, coord, ticket)
+        assert verdict is False, "an infeasible member fails the gang"
+        unbound = []
+
+        def unbind(pod, shard, node):
+            # the driver's bind-API delete: releases snapshot/journal
+            # charges through the ordinary informer fan-out
+            world.hub.delete(world.hub.pods, pod)
+            unbound.append((pod.meta.uid, shard, node))
+
+        assert coord.finish(ticket, unbind=unbind) is False
+        # the unbind deletes release through the informer fan-out —
+        # wait for delivery before reading the snapshots
+        assert world.hub.wait_synced()
+        world.settle(1)
+        # ZERO zombie holds, ZERO residual claims, ZERO residual binds
+        assert world.fabric.claims.gang_holds() == 0
+        for p in pods:
+            assert world.fabric.claims.winner(p.meta.uid) is None
+        assert len(unbound) == len(
+            [u for u, n in ticket.decided.items() if n is not None]
+        )
+        assert _requested_cpu(world) == pytest.approx(base_cpu)
+        # the abort restored every member to its ORIGINAL gang shape —
+        # a retry must route and size by the true gang, not a first
+        # attempt's sub-group residue
+        from koordinator_tpu.scheduler.plugins.coscheduling import (
+            gang_key_of,
+        )
+
+        for p in pods:
+            assert gang_key_of(p) == "team/doomed"
+            assert (
+                p.meta.annotations[ext.ANNOTATION_GANG_MIN_AVAILABLE]
+                == "3"
+            )
+        # …and the aborted members are RE-PLACEABLE: the two feasible
+        # ones re-enter as a plain 2-member gang and bind
+        retry = [
+            _gang_pod("r-m0", "retry", node=na[0]),
+            _gang_pod("r-m1", "retry", node=na[1]),
+        ]
+        for p in retry:
+            p.meta.annotations[ext.ANNOTATION_GANG_MIN_AVAILABLE] = "2"
+            p.meta.annotations[ext.ANNOTATION_GANG_TOTAL_NUM] = "2"
+        ticket2 = coord.begin(retry)
+        assert ticket2 is not None
+        verdict2, _ = _drive_gang(world, coord, ticket2)
+        assert verdict2 is True and coord.finish(ticket2) is True
+    finally:
+        world.close()
+
+
+def test_gang_submit_refusal_still_drains_to_abort_with_zero_holds():
+    """An owner can lose its shard between begin()'s ownership check
+    and the submit (lease lapse / step-down). The refused members are
+    marked terminally undecided so the ticket still completes and
+    finish() aborts through the ordinary path — zero zombie holds, the
+    already-submitted members unbound."""
+    world = _World()
+    world.incarnation("inc-a")
+    world.incarnation("inc-b")
+    try:
+        world.settle(3)
+        shards = world.fabric.shard_map.active_shards()
+        sa, sb = shards[0], shards[1]
+        na = world.nodes_on(sa, 1)
+        nb = world.nodes_on(sb, 1)
+        pods = [
+            _gang_pod("g-m0", "lost-owner", node=na[0]),
+            _gang_pod("g-m1", "lost-owner", node=nb[0]),
+        ]
+        for p in pods:
+            p.meta.annotations[ext.ANNOTATION_GANG_MIN_AVAILABLE] = "2"
+            p.meta.annotations[ext.ANNOTATION_GANG_TOTAL_NUM] = "2"
+
+        class _FlakyOwner:
+            """Looks owned at check time, refuses the submit."""
+
+            def __init__(self, real):
+                self.real = real
+
+            def runtime(self, shard):
+                return self.real.runtime(shard)
+
+            def submit(self, shard, pod, now=None):
+                return False
+
+        def owner_of(shard):
+            real = world.owner_of(shard)
+            if shard == sb and real is not None:
+                return _FlakyOwner(real)
+            return real
+
+        router = ShardRouter(world.fabric.shard_map)
+        coord = CrossShardGangCoordinator(world.fabric, router, owner_of)
+        ticket = coord.begin(pods)
+        assert ticket is not None
+        # the refused member is already terminally undecided
+        uid_b = pods[1].meta.uid
+        assert ticket.decided.get(uid_b, "") is None
+        verdict, _bound = _drive_gang(world, coord, ticket)
+        assert verdict is False
+        unbound = []
+        assert coord.finish(
+            ticket,
+            unbind=lambda p, s, n: (
+                world.hub.delete(world.hub.pods, p),
+                unbound.append(p.meta.uid),
+            ),
+        ) is False
+        assert world.fabric.claims.gang_holds() == 0
+        for p in pods:
+            assert world.fabric.claims.winner(p.meta.uid) is None
+    finally:
+        world.close()
+
+
+def test_gang_refused_when_a_member_shard_is_ownerless():
+    world = _World()
+    world.incarnation("inc-a")
+    try:
+        world.settle(1)  # some shards may still be ownerless
+        # force an ownerless member shard by killing the only owner
+        world.incs[0].kill()
+        shards = world.fabric.shard_map.active_shards()
+        na = world.nodes_on(shards[0], 1)
+        nb = world.nodes_on(shards[1], 1)
+        pods = [
+            _gang_pod("g-m0", "nobody", node=na[0]),
+            _gang_pod("g-m1", "nobody", node=nb[0]),
+        ]
+        router = ShardRouter(world.fabric.shard_map)
+        coord = CrossShardGangCoordinator(
+            world.fabric, router, world.owner_of
+        )
+        assert coord.begin(pods) is None
+        assert world.fabric.claims.gang_holds() == 0, "zero holds on refusal"
+        assert coord.stats["refused"] == 1
+    finally:
+        world.close()

@@ -215,6 +215,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="multiplier on the measured pass-spread noise band",
     )
     ap.add_argument(
+        "--scenario", action="append", default=None, metavar="NAME",
+        help="gate only the named scenario(s) (repeatable). Lets a "
+        "scenario whose committed artifact lives in a separate file "
+        "(e.g. the CPU-round fleet_day entry) be compared without "
+        "dragging in cross-backend rows from the accelerator artifact",
+    )
+    ap.add_argument(
         "--json", default="", metavar="PATH",
         help="also emit the verdict table as one machine-readable "
         "artifact ('-' = stdout instead of the text table): rows + "
@@ -226,6 +233,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline = load_artifact(json.load(f))
     with open(args.current) as f:
         current = load_artifact(json.load(f))
+    if args.scenario:
+        wanted = set(args.scenario)
+        missing = wanted - (set(baseline) | set(current))
+        if missing:
+            ap.error(
+                f"--scenario {sorted(missing)} not present in either "
+                "artifact"
+            )
+        baseline = {k: v for k, v in baseline.items() if k in wanted}
+        current = {k: v for k, v in current.items() if k in wanted}
     rows = compare(
         baseline, current,
         threshold=args.threshold, noise_mult=args.noise_mult,
