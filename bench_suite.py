@@ -1547,13 +1547,25 @@ def _fleet_day_run(
     base_rate_per_shard=3.0,
     elastic=False,
     drain_limit=60,
+    qos_mix=False,
+    storm=None,
+    overload=False,
 ):
     """Drive one compressed production 'day' through an in-process
     sharded fleet: diurnal sinusoid arrivals, two burst storms, tenant
     quota churn, node churn — the traffic SHAPE the per-scenario drains
     never exercise (Tesserae's argument, arxiv 2508.04953). Returns the
     measured run record; hard invariants (zero-dup, all placed,
-    gap-free timelines, cell-correct binds) are asserted inside."""
+    gap-free timelines, cell-correct binds) are asserted inside.
+
+    Overload-control PR arms: ``qos_mix`` spreads arrivals across all
+    four priority bands (3 PROD / 2 MID / 3 BATCH / 2 FREE per 10);
+    ``storm=(lo_frac, hi_frac, mult)`` replaces the two 5x bursts with
+    ONE ``mult``× storm window; ``overload=True`` wires the QoS-aware
+    AdmissionController + BrownoutController into every incarnation —
+    shed pods then count as terminal (placed + shed == arrived, shed
+    only ever BATCH/FREE, timelines ending at ``shed``), which is the
+    brownout-on arm of the storm A/B."""
     import math
     import random as _random
     import time as _time
@@ -1598,15 +1610,29 @@ def _fleet_day_run(
     # SLO targets in SIM-CYCLE units (the tracker rides the sim clock):
     # a pod should place within ~6 cycles of arrival even through the
     # bursts; queue age past 3 cycles is backlog pressure — exactly the
-    # signal the elastic arm's controller scales on
-    slo = SloTracker(
-        clock=lambda: sim[0],
-        targets=(
+    # signal the elastic arm's controller scales on. The overload arm
+    # adds burn time-horizons + evidence floors so the ladder can
+    # OBSERVE recovery once the storm passes (the non-overload arms
+    # keep the historical pure count-window targets bit-identical).
+    if overload:
+        slo_targets = (
+            SloTarget(
+                "p99_latency", threshold_s=12.0, budget=0.1, window=64,
+                max_age_s=16.0, min_samples=4,
+            ),
+            SloTarget(
+                "queue_age", threshold_s=3.0, budget=0.05, window=64,
+                max_age_s=16.0, min_samples=4,
+            ),
+            SloTarget("recovery", threshold_s=6.0, budget=0.5, window=16),
+        )
+    else:
+        slo_targets = (
             SloTarget("p99_latency", threshold_s=12.0, budget=0.1, window=64),
             SloTarget("queue_age", threshold_s=3.0, budget=0.05, window=64),
             SloTarget("recovery", threshold_s=6.0, budget=0.5, window=16),
-        ),
-    )
+        )
+    slo = SloTracker(clock=lambda: sim[0], targets=slo_targets)
     hub = ClusterStateHub()
     node_names = [f"n{i:03d}" for i in range(6 * n_shards)]
 
@@ -1664,6 +1690,38 @@ def _fleet_day_run(
         return s
 
     incs = []
+    admission = brownout = None
+    if overload:
+        from koordinator_tpu.api.extension import PriorityClass
+        from koordinator_tpu.runtime.overload import (
+            AdmissionController,
+            BrownoutController,
+            OverloadConfig,
+        )
+
+        brownout = BrownoutController(
+            slo=slo,
+            shards=lambda: fabric.shard_map.active_shards(),
+            thresholds=(1.0, 2.0, 4.0, 8.0),
+            sustain=2,
+            cooldown=4,
+            clock=lambda: sim[0],
+        )
+        admission = AdmissionController(
+            OverloadConfig(
+                band_budget={
+                    PriorityClass.BATCH: 2 * MAX_BATCH,
+                    PriorityClass.FREE: MAX_BATCH // 2,
+                },
+                band_age_limit_s={
+                    PriorityClass.BATCH: 12.0,
+                    PriorityClass.FREE: 5.0,
+                },
+            ),
+            brownout=brownout,
+            lifecycle=lifecycle,
+            clock=lambda: sim[0],
+        )
 
     def _spawn():
         inc = ShardedScheduler(
@@ -1679,6 +1737,7 @@ def _fleet_day_run(
             retry_period=0.5,
             lifecycle=lifecycle,
             slo=slo,
+            overload=admission,
         )
         fabric.membership.heartbeat(inc.name)
         incs.append(inc)
@@ -1729,10 +1788,21 @@ def _fleet_day_run(
     pod_seq = 0
     node_seq = 0
     churn_nodes = []
+    shed: dict = {}      # uid -> ShedTicket, terminal (overload arm)
+    prio_of: dict = {}   # uid -> priority (per-band latency split)
+    burst_mult = 5.0
     burst_windows = (
         (int(0.35 * day_cycles), int(0.40 * day_cycles)),
         (int(0.70 * day_cycles), int(0.74 * day_cycles)),
     )
+    if storm is not None:
+        lo_f, hi_f, mult = storm
+        burst_windows = (
+            (int(lo_f * day_cycles), int(hi_f * day_cycles)),
+        )
+        burst_mult = float(mult)
+    #: deterministic QoS mix: 3 PROD / 2 MID / 3 BATCH / 2 FREE per 10
+    QOS_PRIO = (9000, 9000, 9000, 7500, 7500, 5500, 5500, 5500, 3500, 3500)
 
     def _absorb_handoffs(handoffs):
         for shard, hand in sorted(handoffs.items()):
@@ -1766,29 +1836,38 @@ def _fleet_day_run(
                 1.0 + 0.8 * math.sin(2.0 * math.pi * cycle / day_cycles)
             )
             if any(lo <= cycle < hi for lo, hi in burst_windows):
-                rate *= 5.0
+                rate *= burst_mult
                 stats["burst_cycles"] += 1
             for _ in range(max(1, int(rate))):
                 pod_seq += 1
                 labels = {}
-                if pod_seq % 4 == 0:
+                # the QoS-mixed storm arms keep quota labels OUT: a 10x
+                # storm saturates any realistic tenant cap, and that
+                # quota backlog is orthogonal to what the admission A/B
+                # measures (band-differentiated queueing)
+                if pod_seq % 4 == 0 and not qos_mix:
                     labels[ext.LABEL_QUOTA_NAME] = tenants[
                         (pod_seq // 4) % len(tenants)
                     ]
-                arriving.append(
-                    Pod(
-                        meta=ObjectMeta(
-                            name=f"day-{pod_seq:05d}", labels=labels
-                        ),
-                        spec=PodSpec(
-                            requests={
-                                ext.RES_CPU: POD_CPU,
-                                ext.RES_MEMORY: POD_MEM,
-                            },
-                            priority=9000 if pod_seq % 3 else 5500,
-                        ),
-                    )
+                prio = (
+                    QOS_PRIO[pod_seq % len(QOS_PRIO)]
+                    if qos_mix
+                    else (9000 if pod_seq % 3 else 5500)
                 )
+                pod = Pod(
+                    meta=ObjectMeta(
+                        name=f"day-{pod_seq:05d}", labels=labels
+                    ),
+                    spec=PodSpec(
+                        requests={
+                            ext.RES_CPU: POD_CPU,
+                            ext.RES_MEMORY: POD_MEM,
+                        },
+                        priority=prio,
+                    ),
+                )
+                prio_of[pod.meta.uid] = prio
+                arriving.append(pod)
             # tenant quota churn: caps breathe every 8 cycles
             if cycle % 8 == 4:
                 t = tenants[(cycle // 8) % len(tenants)]
@@ -1865,11 +1944,19 @@ def _fleet_day_run(
                 stillliving.append((pod, node, done))
         live = stillliving
         assert hub.wait_synced()
+        if brownout is not None:
+            brownout.tick(cycle)
+        if admission is not None:
+            # the bench's drivers redeem nothing: every shed is
+            # terminal (the A/B's point is what the storm COSTS each
+            # band, not how drivers retry)
+            for t in admission.take_tickets():
+                shed[t.pod.meta.uid] = t
         if (
             cycle >= day_cycles
             and not pending
             and not pending_handoff
-            and stats["placed"] == stats["arrived"]
+            and stats["placed"] + len(shed) == stats["arrived"]
         ):
             break
     for inc in incs:
@@ -1880,6 +1967,9 @@ def _fleet_day_run(
                 _place(pod, node, s)
             else:
                 pending.append(pod)
+    if admission is not None:
+        for t in admission.take_tickets():
+            shed[t.pod.meta.uid] = t
     wall = _time.perf_counter() - wall0
 
     assert not pending and not pending_handoff, (
@@ -1888,10 +1978,26 @@ def _fleet_day_run(
         f"{[p.meta.labels for p in pending[:5]]}; backlogs: "
         f"{ {s: _owner_of(s).backlog(s) for s in fabric.shard_map.active_shards() if _owner_of(s)} }"
     )
-    assert stats["placed"] == stats["arrived"] == len(placed)
+    assert stats["placed"] == len(placed)
+    assert stats["placed"] + len(shed) == stats["arrived"], (
+        f"arrived {stats['arrived']} != placed {stats['placed']} + "
+        f"shed {len(shed)}"
+    )
+    if admission is None:
+        assert not shed
+    else:
+        # the QoS contract: only BATCH/FREE ever pay for the storm
+        from koordinator_tpu.api.extension import PriorityClass as _PC
+
+        assert set(admission.shed_counts) <= {
+            int(_PC.BATCH), int(_PC.FREE)
+        }, admission.shed_counts
     # gap-free lifecycle timelines END TO END — through bursts, churn
-    # and (elastic arm) live topology transitions
+    # and (elastic arm) live topology transitions; a shed pod's ends
+    # TERMINALLY at shed (the brownout-on arm's sacrifice is traced,
+    # never silent)
     latencies = []
+    lat_by_uid = {}
     bad = 0
     for uid in placed:
         evs = lifecycle.timeline(uid)
@@ -1900,6 +2006,11 @@ def _fleet_day_run(
         t0 = next(e.t for e in evs if e.stage == "submit")
         t_ack = next(e.t for e in reversed(evs) if e.stage == "ack")
         latencies.append(t_ack - t0)
+        lat_by_uid[uid] = t_ack - t0
+    for uid in shed:
+        evs = lifecycle.timeline(uid)
+        if validate_timeline(evs) or evs[-1].stage != "shed":
+            bad += 1
     assert bad == 0, f"{bad} gap-ful timelines"
     # latencies are SIM-CYCLE counts, not seconds — no ms conversion
     p50 = float(np.percentile(np.asarray(latencies), 50))
@@ -1935,6 +2046,40 @@ def _fleet_day_run(
     if ctrl is not None:
         out["topology"] = dict(ctrl.stats)
         out["generation_final"] = fabric.topology.generation
+    if qos_mix:
+        from koordinator_tpu.api.extension import PriorityClass as _PC
+
+        per_band: dict = {}
+        for uid, lat in lat_by_uid.items():
+            band = _PC.from_priority(prio_of[uid]).name
+            per_band.setdefault(band, []).append(lat)
+        shed_bands: dict = {}
+        for t in shed.values():
+            shed_bands[t.band.name] = shed_bands.get(t.band.name, 0) + 1
+        out["bands"] = {
+            band: {
+                "placed": len(lats),
+                "shed": shed_bands.get(band, 0),
+                "p50_cycles": round(
+                    float(np.percentile(np.asarray(lats), 50)), 2
+                ),
+                "p99_cycles": round(
+                    float(np.percentile(np.asarray(lats), 99)), 2
+                ),
+            }
+            for band, lats in sorted(per_band.items())
+        }
+        out["shed"] = len(shed)
+    if brownout is not None:
+        out["brownout"] = {
+            "peak": max(
+                [t["to"] for t in brownout.transitions()] or [0]
+            ),
+            "final": brownout.level,
+            "transitions": len(brownout.transitions()),
+            "stats": dict(brownout.stats),
+        }
+        out["deferred_total"] = admission.deferred_total
     for inc in incs:
         if not inc.dead:
             inc.close()
@@ -2025,9 +2170,79 @@ def bench_fleet_day():
     return out
 
 
+def bench_overload_storm():
+    """Overload-control PR acceptance A/B: ONE 10x arrival storm over a
+    QoS-mixed fleet day, run twice from the same seed — brownout OFF
+    (uniform FIFO queueing: every band, PROD included, waits behind the
+    flood) vs brownout ON (QoS-aware bounded admission + the brownout
+    ladder: BATCH/FREE are deferred then shed, PROD/MID sail through).
+    The decision-bearing number is PROD p99 placement latency through
+    the burst — it must be STRICTLY better with brownout on, bought
+    only with BATCH/FREE degradation (shed counts are in the entry,
+    each shed traced to a terminal ``shed`` timeline).
+
+    Backend note: in-process CPU fleet, same-backend A/B (the bench-
+    backend standing rule); latencies are SIM-CYCLE counts."""
+    out = {"scenario": "overload_storm"}
+    DAY = 48
+    kw = dict(
+        n_shards=4,
+        n_incs=2,
+        day_cycles=DAY,
+        seed=0,
+        base_rate_per_shard=3.0,
+        qos_mix=True,
+        storm=(0.35, 0.50, 10),
+    )
+    # warmup fleet on a throwaway budget (adaptive-pump jit shapes)
+    _fleet_day_run(4, 2, day_cycles=8, seed=1, qos_mix=True)
+    base = _fleet_day_run(overload=False, **kw)
+    base["mode"] = "brownout_off"
+    prot = _fleet_day_run(overload=True, **kw)
+    prot["mode"] = "brownout_on"
+    out["runs"] = [base, prot]
+    prod_off = base["bands"]["PROD"]["p99_cycles"]
+    prod_on = prot["bands"]["PROD"]["p99_cycles"]
+    # the acceptance bar: PROD's storm tail is strictly protected, paid
+    # for ONLY by the sheddable bands
+    assert prod_on < prod_off, (
+        f"brownout failed to protect PROD p99: on {prod_on} vs "
+        f"off {prod_off} cycles"
+    )
+    assert base.get("shed", 0) == 0
+    assert prot["bands"]["PROD"]["shed"] == 0
+    assert prot["bands"].get("MID", {}).get("shed", 0) == 0
+    out["pods_per_sec"] = prot["pods_per_sec"]
+    out["passes"] = [prot["pods_per_sec"]]
+    out["prod_p99_cycles"] = {
+        "brownout_off": prod_off, "brownout_on": prod_on
+    }
+    out["mid_p99_cycles"] = {
+        "brownout_off": base["bands"]["MID"]["p99_cycles"],
+        "brownout_on": prot["bands"]["MID"]["p99_cycles"],
+    }
+    out["ab_note"] = (
+        f"same-seed 10x storm A/B: PROD p99 {prod_off} -> {prod_on} "
+        f"sim-cycles with brownout on "
+        f"({prot['brownout']['peak']} peak ladder level, "
+        f"{prot['shed']} BATCH/FREE pods shed with terminal traced "
+        "timelines, 0 PROD/MID shed); brownout-off rides the storm "
+        "uniformly — every band pays the queueing tail"
+    )
+    out["measurement_note"] = (
+        "in-process CPU fleet (one container, GIL-shared): the "
+        "decision-bearing comparison is the same-backend same-seed "
+        "A/B between the two runs; latencies are SIM-CYCLE placement "
+        "counts (arrival->ack), throughput is wall-clock and carries "
+        "the usual single-container contention caveat"
+    )
+    return out
+
+
 SCENARIOS = {
     "loadaware": bench_loadaware,
     "fleet_day": bench_fleet_day,
+    "overload_storm": bench_overload_storm,
     "numa": bench_numa,
     "device_gang": bench_device_gang,
     "quota_tree": bench_quota_tree,

@@ -659,6 +659,12 @@ class ClaimTable:
             elif op == "gang_abort":
                 for u in self._gangs.pop(rec.get("gang"), {}):
                     self._holds.pop(u, None)
+            elif op == "claim_void":
+                for u in rec.get("uids", ()):
+                    self._winners.pop(u, None)
+                    hold = self._holds.pop(u, None)
+                    if hold is not None and hold[0] in self._gangs:
+                        self._gangs[hold[0]].pop(u, None)
             elif op == "claim_rehome":
                 moves = {
                     u: int(s) for u, s in (rec.get("moves") or {}).items()
@@ -883,6 +889,38 @@ class ClaimTable:
                     del self._holds[uid]
                     if gang in self._gangs:
                         self._gangs[gang].pop(uid, None)
+
+    def void_claims(self, uids: Sequence[str]) -> None:
+        """Drop any claim/hold records for these uids WITHOUT a
+        tombstone (overload-control PR, gang-abort hygiene): a topology
+        transition between ``gang_prepare`` and the abort can VOID a
+        queued member's hold (``rehome``), after which its feed
+        re-claims as an ordinary winner — ``gang_abort`` only drops
+        holds, so that re-established claim would otherwise pin the
+        aborted member to one shard forever (its resubmitted copy,
+        routed anywhere else, loses every claim and is never fed).
+        No-op (and no journal record) when nothing is held."""
+        with self._lock:
+            hit = [
+                u
+                for u in uids
+                if u in self._winners or u in self._holds
+            ]
+            if not hit:
+                return
+            self._seq += 1
+            rec = {"seq": self._seq, "op": "claim_void", "uids": hit}
+            try:
+                self.store.append(rec)
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"claim void append failed: {exc!r}"
+                ) from exc
+            for u in hit:
+                self._winners.pop(u, None)
+                hold = self._holds.pop(u, None)
+                if hold is not None and hold[0] in self._gangs:
+                    self._gangs[hold[0]].pop(u, None)
 
     def rehome(
         self, moves: Dict[str, int], void_shards: Sequence[int] = ()

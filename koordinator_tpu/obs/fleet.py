@@ -232,6 +232,9 @@ class FleetServices:
                                records of dead incarnations included)
       /debug/pipeline        — per-shard speculation-gate verdicts
                                (forwarded to each runtime's engine)
+      /debug/brownout        — the fleet's brownout-ladder state
+                               (overload-control PR; one controller,
+                               shared across shards)
 
     Built lazily by ``ShardedScheduler.fleet`` — read-only, no state of
     its own, so it is always consistent with live ownership."""
@@ -364,6 +367,11 @@ class FleetServices:
                 {"incarnation": self.sharded.name, "shards": shards},
                 indent=1,
             )
+        if path == "/debug/brownout":
+            bo = self.sharded.brownout
+            if bo is None:
+                return 404, "no brownout controller wired"
+            return 200, bo.render()
         if path == "/topology":
             # elastic-topology PR: the live shard-map generation — the
             # cell tree, the open transition (if a split/merge is in

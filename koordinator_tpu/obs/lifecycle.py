@@ -40,8 +40,11 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-#: terminal stages: the pod's placement story is over
-_TERMINAL = frozenset({"ack", "gone"})
+#: terminal stages: the pod's placement story is over. ``shed`` is
+#: terminal-but-redeemable (overload-control PR): the story ends there
+#: unless a driver redeems the resubmit ticket, which re-opens it with
+#: a ``resubmit``/``enqueue`` bridge
+_TERMINAL = frozenset({"ack", "gone", "shed"})
 
 #: event stages a timeline may contain (validator vocabulary)
 STAGES = frozenset(
@@ -60,6 +63,8 @@ STAGES = frozenset(
         "shard_split", # re-homed by a live shard split (elastic topology)
         "shard_merge", # re-homed by a live shard merge (elastic topology)
         "recover",     # journal replay restored the acknowledged bind
+        "shed",        # overload admission shed the pod (terminal unless
+                       # a resubmit ticket is redeemed)
         "ack",         # bind acknowledged / published (terminal)
         "gone",        # pod deleted before placement (terminal)
     }
@@ -67,8 +72,10 @@ STAGES = frozenset(
 
 #: stages that DISPLACE a pod from its owner: until a bridge event
 #: (resubmit/recover/enqueue) lands, any placement-path progress is a
-#: timeline gap — the validator's cross-incarnation/cross-topology arm
-_DISPLACING = frozenset({"orphan", "shard_split", "shard_merge"})
+#: timeline gap — the validator's cross-incarnation/cross-topology arm.
+#: ``shed`` rides the same machinery (overload-control PR): placement
+#: progress after a shed without a ticket-redemption bridge is a gap
+_DISPLACING = frozenset({"orphan", "shard_split", "shard_merge", "shed"})
 
 #: default histogram buckets (seconds): sub-ms in-process pumps up to the
 #: multi-cycle waits a leaderless gap produces
@@ -218,6 +225,13 @@ class PodLifecycle:
         if stage in _TERMINAL:
             with self._lock:
                 self._done[uid] = None
+        elif uid in self._done:
+            # a redeemed shed ticket (or any re-opened story) makes the
+            # pod live again: it must leave the completed set so the
+            # retention eviction prefers genuinely finished timelines.
+            # The membership pre-check keeps the steady path lock-free.
+            with self._lock:
+                self._done.pop(uid, None)
 
     def _evict_locked(self) -> None:
         """Bounded retention: drop the oldest COMPLETED timelines first
@@ -267,9 +281,27 @@ class PodLifecycle:
         t = self.clock() if t is None else t
         self.event(uid, "ack", shard=shard, t=t, detail=node)
         self._observe(uid, shard, t)
-        t0 = next(
-            (e.t for e in self.timeline(uid) if e.stage == "submit"), None
-        )
+        evs = self.timeline(uid)
+        t0 = next((e.t for e in evs if e.stage == "submit"), None)
+        # a redeemed shed ticket re-anchors the SLO clock (overload-
+        # control PR): the shed run was terminally accounted by
+        # overload_shed_total, so the redeemed run's latency story
+        # starts at its bridge (resubmit/enqueue after the last shed) —
+        # otherwise every redemption wave re-burns the latency budget
+        # for debt the shed metric already paid
+        last_shed = None
+        for i, e in enumerate(evs):
+            if e.stage == "shed":
+                last_shed = i
+        if last_shed is not None:
+            t0 = next(
+                (
+                    e.t
+                    for e in evs[last_shed + 1:]
+                    if e.stage in ("resubmit", "enqueue", "submit")
+                ),
+                t0,
+            )
         return None if t0 is None else max(0.0, t - t0)
 
     def seen(self, uid: str) -> bool:
@@ -407,7 +439,13 @@ def validate_timeline(
       ``resubmit``/``recover``/``enqueue``: the bridge across the dead
       incarnation or the retired cell. The multi-shard soak fails on a
       gap across a split exactly here;
-    * terminal: ends at ``ack``/``gone`` when ``require_terminal``.
+    * ``shed`` (overload-control PR) is terminal-but-redeemable: it may
+      END the timeline, or be bridged by ``resubmit``/``enqueue`` (a
+      redeemed resubmit ticket) — placement progress straight after a
+      shed is a gap, and a shed AFTER the bind was acknowledged means an
+      admission path dropped a pod the cluster already placed;
+    * terminal: ends at ``ack``/``gone``/``shed`` when
+      ``require_terminal``.
     """
     problems: List[str] = []
     if not events:
@@ -417,6 +455,7 @@ def validate_timeline(
     t_prev = events[0].t
     queued = False
     decided = False
+    acked = False
     displaced = ""   # the displacing stage name, "" when bridged
     for i, ev in enumerate(events):
         if ev.stage not in STAGES:
@@ -438,6 +477,13 @@ def validate_timeline(
             problems.append(f"[{i}] dispatch before any enqueue")
         if ev.stage == "ack" and not decided:
             problems.append(f"[{i}] ack without a decide/recover")
+        if ev.stage == "ack":
+            acked = True
+        if ev.stage == "shed" and acked:
+            problems.append(
+                f"[{i}] shed after the bind was acknowledged — an "
+                "admission path dropped an already-placed pod"
+            )
         if displaced and ev.stage in ("dispatch", "decide", "ack"):
             problems.append(
                 f"[{i}] {ev.stage} after {displaced} without "

@@ -71,6 +71,12 @@ class RejectReason(str, enum.Enum):
     #: bind record — journal-before-mutate means the chunk is rejected
     #: un-mutated and retries once the journal recovers
     JOURNAL_WRITE_FAILED = "journal_write_failed"
+    #: QoS-aware overload control (brownout PR): a BATCH/FREE pod shed at
+    #: the admission boundary — its band's queue budget and age limit
+    #: were both exceeded (or the brownout ladder reached its shed
+    #: level). Terminal ``shed`` lifecycle event + resubmit ticket; the
+    #: pod never reaches a solve
+    OVERLOAD_SHED = "overload_shed"
 
 
 @dataclass

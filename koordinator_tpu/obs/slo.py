@@ -49,6 +49,18 @@ class SloTarget:
     #: plane's cadence is cycles, and a cycle count is deterministic
     #: under the sim clock where a wall window is not)
     window: int = 512
+    #: optional TIME horizon (tracker-clock units) on top of the count
+    #: window (overload-control PR): samples older than this are
+    #: excluded from the burn/p99 evaluation. Without it, an objective
+    #: that stops receiving samples (e.g. placement latency once a
+    #: browning fleet defers everything) freezes at its WORST window
+    #: forever — and a burn-driven controller can never observe
+    #: recovery. None keeps the pure count-window semantics.
+    max_age_s: Optional[float] = None
+    #: burn evidence floor: fewer fresh samples than this evaluate to
+    #: burn 0 (a couple of stragglers in an otherwise-empty horizon
+    #: must not swing a burn-driven controller to its extremes)
+    min_samples: int = 1
 
 
 def default_targets() -> Tuple[SloTarget, ...]:
@@ -64,7 +76,8 @@ def default_targets() -> Tuple[SloTarget, ...]:
 
 @dataclass
 class _Series:
-    samples: Deque[Tuple[float, bool]] = field(default_factory=deque)
+    #: (value, violated, observed-at) on the tracker's clock
+    samples: Deque[Tuple[float, bool, float]] = field(default_factory=deque)
     violations: int = 0
     total: int = 0
     worst: float = 0.0
@@ -111,7 +124,7 @@ class SloTracker:
         bad = value_s > tgt.threshold_s
         with self._lock:
             s = self._series.setdefault((int(shard), slo), _Series())
-            s.samples.append((value_s, bad))
+            s.samples.append((value_s, bad, self.clock()))
             while len(s.samples) > tgt.window:
                 s.samples.popleft()
             s.total += 1
@@ -148,9 +161,18 @@ class SloTracker:
         rank = -((-99 * len(ordered)) // 100)  # ceil without math
         return ordered[max(0, rank - 1)]
 
+    def _fresh(self, samples, tgt: SloTarget, now: float):
+        """The evaluable slice of a window: all of it, or — when the
+        objective carries a time horizon — only samples young enough."""
+        if tgt.max_age_s is None:
+            return list(samples)
+        horizon = now - tgt.max_age_s
+        return [s for s in samples if s[2] >= horizon]
+
     def evaluate(self) -> Dict[str, Dict[str, dict]]:
         """Current state per shard per objective: target, window p99,
         last/worst sample, violation count, burn rate, ok flag."""
+        now = self.clock()
         with self._lock:
             series = {
                 k: (list(s.samples), s.violations, s.total, s.worst, s.last)
@@ -161,14 +183,19 @@ class SloTracker:
             series.items()
         ):
             tgt = self.targets[slo]
-            window_bad = sum(1 for _v, bad in samples if bad)
-            frac = window_bad / len(samples) if samples else 0.0
+            samples = self._fresh(samples, tgt, now)
+            window_bad = sum(1 for _v, bad, _t in samples if bad)
+            frac = (
+                window_bad / len(samples)
+                if len(samples) >= tgt.min_samples
+                else 0.0
+            )
             burn = frac / tgt.budget if tgt.budget > 0 else 0.0
             out.setdefault(str(shard), {})[slo] = {
                 "target_s": tgt.threshold_s,
                 "budget": tgt.budget,
                 "window_p99_s": round(
-                    self._p99([v for v, _b in samples]), 6
+                    self._p99([v for v, _b, _t in samples]), 6
                 ),
                 "last_s": round(last, 6),
                 "worst_s": round(worst, 6),
@@ -187,11 +214,15 @@ class SloTracker:
         tgt = self.targets.get(slo)
         if tgt is None:
             raise ValueError(f"unknown SLO {slo!r}")
+        now = self.clock()
         with self._lock:
             s = self._series.get((int(shard), slo))
             if s is None or not s.samples:
                 return 0.0
-            frac = sum(1 for _v, bad in s.samples if bad) / len(s.samples)
+            samples = self._fresh(s.samples, tgt, now)
+            if len(samples) < tgt.min_samples or not samples:
+                return 0.0
+            frac = sum(1 for _v, bad, _t in samples if bad) / len(samples)
         return frac / tgt.budget if tgt.budget > 0 else 0.0
 
     def ok(self) -> bool:

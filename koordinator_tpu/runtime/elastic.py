@@ -363,13 +363,38 @@ class TopologyController:
 
     def shard_burn(self, shard: int) -> float:
         """The shard's worst PLACEMENT burn rate — the signal that says
-        "this range needs more scheduler", which recovery burn does not."""
+        "this range needs more scheduler", which recovery burn does not.
+        Also the router's spill view (``ShardRouter(burn_of=...)``) and
+        the brownout ladder's per-shard input (overload-control PR)."""
         if self.slo is None:
             return 0.0
         return max(
             self.slo.burn_rate(shard, "p99_latency"),
             self.slo.burn_rate(shard, "queue_age"),
         )
+
+    @property
+    def in_cooldown(self) -> bool:
+        """True while the controller is inside the post-transition
+        cooldown window — the window the brownout ladder browns out in
+        (overload-control PR): capacity is NOT coming, degrade instead."""
+        return self._ticks - self._last_change < self.cooldown
+
+    def can_scale_out(self) -> bool:
+        """Whether a split could still relieve pressure: shards below
+        the cap, a node-name view to pick a candidate from, and no
+        transition already open. The brownout ladder YIELDS escalation
+        while this holds — prefer a split that adds capacity over a
+        brownout that sheds work."""
+        m = self.fabric.shard_map
+        if len(m.active_shards()) >= self.max_shards:
+            return False
+        if self.node_names is None:
+            return False
+        topo = getattr(self.fabric, "topology", None)
+        if topo is not None and topo.open_transition() is not None:
+            return False
+        return True
 
     def _children_nonempty(
         self, shard: int, names: Optional[Sequence[str]] = None
@@ -468,8 +493,7 @@ class TopologyController:
             else:
                 self._hot.pop(s, None)
                 self._cold.pop(s, None)
-        in_cooldown = self._ticks - self._last_change < self.cooldown
-        if not in_cooldown:
+        if not self.in_cooldown:
             hot = sorted(
                 (s for s in active if self._hot.get(s, 0) >= self.sustain),
                 key=lambda s: (-burns[s], s),
@@ -729,6 +753,10 @@ class CrossShardGangCoordinator:
                 unbind(ticket.pods[uid], ticket.members[uid], node)
                 self.stats["unbound"] += 1
         self.fabric.claims.gang_abort(ticket.attempt_id)
+        # a topology transition mid-attempt may have voided a member's
+        # hold and let its feed re-claim plainly — drop any such claim
+        # (tombstone-free) so every aborted member is fully claimable
+        self.fabric.claims.void_claims(sorted(ticket.members))
         self._restore_subgang(ticket)
         ticket.aborted = True
         self.stats["aborted"] += 1

@@ -1,0 +1,668 @@
+"""QoS-differentiated overload control (robustness tentpole).
+
+Koordinator's premise is that PROD survives pressure because BATCH/FREE
+absorb it — yet until this module the rebuild treated every pod
+identically under overload: the stream queue was unbounded, storms were
+ridden out purely by elastic splits, and a dead solver channel burned
+per-call retry budgets forever. The cluster literature is unanimous that
+graceful, priority-aware degradation beats uniform queueing under storm
+load (DAGOR-style priority admission in "Overload Control for Scaling
+WeChat Microservices", SoCC'18; Meta's utilization-aware load shedding).
+Three coordinated mechanisms, one module:
+
+* :class:`AdmissionController` — **bounded, QoS-aware admission** at
+  ``StreamScheduler.submit``: PROD/MID are always admitted; BATCH/FREE
+  are admitted up to a per-band live-queue budget, DEFERRED (parked, not
+  fed to cycles) past it, and SHED once deferral outlives the band's age
+  limit — with a terminal ``shed`` lifecycle event, a counted metric,
+  and a :class:`ShedTicket` so drivers can resubmit after the storm.
+
+* :class:`BrownoutController` — a **monotonic degradation ladder** driven
+  by the same SLO burn signals the elastic :class:`TopologyController`
+  reads:  L0 normal → L1 pipeline depth capped at 1 → L2 serial solve +
+  batch-bucket degrade → L3 defer all BATCH/FREE → L4 shed FREE.
+  Escalation needs ``sustain`` consecutive hot ticks, de-escalation
+  ``cooldown`` consecutive cold ticks (one step either way — no
+  flapping); transitions are journaled to the flight recorder(s),
+  surfaced as a ``/healthz`` row and the ``/debug/brownout`` endpoint.
+  When the topology controller still has scale-out budget, the ladder
+  YIELDS to a split for a bounded number of ticks before degrading —
+  prefer adding capacity when possible, brown out during transition
+  cooldowns.
+
+* :class:`CircuitBreaker` — a **solver-channel breaker** consulted by
+  :class:`~.snapshot_channel.SolverClient`: ``K`` consecutive channel
+  failures open it (calls fail fast with ``ChannelBreakerOpen`` instead
+  of paying per-call retry backoff), a half-open probe after
+  ``cooldown_s`` tests recovery, one success closes it. State rides the
+  ``solver_breaker_state`` gauge.
+
+Disabled-mode discipline (the ``test_obs_overhead`` contract): every hot
+path this module touches guards on one attribute-is-None check —
+``overload=None`` / ``brownout=None`` / ``breaker=None`` cost nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api.extension import PriorityClass
+from ..obs.rejections import RejectReason
+
+__all__ = [
+    "OverloadConfig",
+    "ShedTicket",
+    "AdmissionController",
+    "BrownoutController",
+    "CircuitBreaker",
+]
+
+
+# ---------------------------------------------------------------------------
+# Bounded, QoS-aware admission
+# ---------------------------------------------------------------------------
+
+
+#: the bands the admission controller may defer/shed; PROD, MID and
+#: unclassified pods are ALWAYS admitted (the whole point of QoS-
+#: differentiated co-location is that they never pay for a storm)
+SHEDDABLE_BANDS = (PriorityClass.BATCH, PriorityClass.FREE)
+
+
+@dataclass
+class OverloadConfig:
+    """Per-band admission budgets. ``band_budget`` bounds the LIVE queue
+    depth a band may occupy on one shard's stream (arrivals past it are
+    deferred); ``band_age_limit_s`` bounds how long a deferred pod may
+    wait for pressure to clear before it is shed (clock units are the
+    caller's — sim cycles in the soaks, seconds in production)."""
+
+    band_budget: Dict[PriorityClass, int] = field(
+        default_factory=lambda: {
+            PriorityClass.BATCH: 256,
+            PriorityClass.FREE: 128,
+        }
+    )
+    band_age_limit_s: Dict[PriorityClass, float] = field(
+        default_factory=lambda: {
+            PriorityClass.BATCH: 60.0,
+            PriorityClass.FREE: 20.0,
+        }
+    )
+
+
+@dataclass
+class ShedTicket:
+    """The resubmit ticket a shed pod leaves behind: everything a driver
+    needs to retry the pod once the storm passes — the pod itself, its
+    original arrival stamp (the north-star latency clock keeps running
+    across a redemption), and why/where it was shed."""
+
+    pod: object
+    band: PriorityClass
+    shard: int
+    arrival: float
+    shed_at: float
+    reason: str = RejectReason.OVERLOAD_SHED.value
+    detail: str = ""
+
+
+class AdmissionController:
+    """Fleet-shared admission policy + shed bookkeeping.
+
+    One instance serves every shard's stream: per-shard DEPTH is the
+    stream's own accounting (passed into :meth:`admit`), while the
+    policy knobs, the brownout coupling, the shed tickets and the
+    metrics are fleet-level here. Thread-safe — per-shard pump threads
+    shed concurrently."""
+
+    ADMIT = "admit"
+    DEFER = "defer"
+    SHED = "shed"
+
+    def __init__(
+        self,
+        config: Optional[OverloadConfig] = None,
+        brownout: Optional["BrownoutController"] = None,
+        lifecycle=None,
+        registry=None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        self.config = config or OverloadConfig()
+        self.brownout = brownout
+        self.lifecycle = lifecycle
+        self.clock = clock
+        self.registry = None
+        self._shed_counter = None
+        self._defer_counter = None
+        self._lock = threading.Lock()
+        self._tickets: List[ShedTicket] = []  # guarded-by: self._lock
+        #: band value -> pods shed, forever (the soak's PROD/MID-never-
+        #: shed assert reads this)
+        self.shed_counts: Dict[int, int] = {}  # guarded-by: self._lock
+        self.deferred_total = 0  # guarded-by: self._lock
+        if registry is not None:
+            self.bind_registry(registry)
+
+    def bind_registry(self, registry) -> None:
+        """Adopt a metrics registry (first caller wins — the sharded
+        fleet binds the first runtime's; the merged scrape carries it)."""
+        if self.registry is not None:
+            return
+        self.registry = registry
+        self._shed_counter = registry.get("overload_shed_total")
+        self._defer_counter = registry.get("overload_deferred_total")
+
+    # ---- the submit-time verdict ----
+
+    def admit(self, pod, band_depth: int) -> str:
+        """Admission verdict for one arriving pod given its band's
+        current live-queue depth on the submitting shard."""
+        band = pod.priority_class
+        if band not in SHEDDABLE_BANDS:
+            return self.ADMIT
+        bo = self.brownout
+        if bo is not None:
+            if bo.sheds(band):
+                return self.SHED
+            if bo.defers(band):
+                return self.DEFER
+        budget = self.config.band_budget.get(band)
+        if budget is not None and band_depth >= budget:
+            return self.DEFER
+        return self.ADMIT
+
+    # ---- the sweep-time policy (deferred parking lot) ----
+
+    def still_deferred(self, band: PriorityClass, live_depth: int) -> bool:
+        """Whether a parked pod must stay parked: its band is brownout-
+        deferred, or its band's live queue is still at budget."""
+        bo = self.brownout
+        if bo is not None and bo.defers(band):
+            return True
+        budget = self.config.band_budget.get(band)
+        return budget is not None and live_depth >= budget
+
+    def sheds_now(self, band: PriorityClass) -> bool:
+        """Brownout L4: the band is shed outright (deferred AND fresh)."""
+        bo = self.brownout
+        return bo is not None and bo.sheds(band)
+
+    def age_limit(self, band: PriorityClass) -> float:
+        return self.config.band_age_limit_s.get(band, float("inf"))
+
+    # ---- the terminal shed ----
+
+    def shed(
+        self, pod, shard: int, arrival: float, detail: str = ""
+    ) -> ShedTicket:
+        """The ONE canonical shed site (koordlint ``shed-paths`` pass):
+        terminal ``shed`` lifecycle event, ``overload_shed_total{band}``
+        metric, and the resubmit ticket. Every queue-drop path that
+        shedding introduces funnels here."""
+        band = pod.priority_class
+        now = self.clock()
+        ticket = ShedTicket(
+            pod=pod,
+            band=band,
+            shard=int(shard),
+            arrival=arrival,
+            shed_at=now,
+            detail=detail,
+        )
+        lc = self.lifecycle
+        if lc is not None:
+            if not lc.seen(pod.meta.uid):
+                lc.submitted(pod.meta.uid, t=arrival)
+            lc.event(
+                pod.meta.uid,
+                "shed",
+                shard=int(shard),
+                detail=detail or band.name.lower(),
+            )
+        if self._shed_counter is not None:
+            self._shed_counter.labels(band=band.name).inc()
+        with self._lock:
+            self._tickets.append(ticket)
+            self.shed_counts[int(band)] = (
+                self.shed_counts.get(int(band), 0) + 1
+            )
+        return ticket
+
+    def note_deferred(self, band: PriorityClass) -> None:
+        if self._defer_counter is not None:
+            self._defer_counter.labels(band=band.name).inc()
+        with self._lock:
+            self.deferred_total += 1
+
+    def take_tickets(self) -> List[ShedTicket]:
+        """Drain the accumulated resubmit tickets (driver-owned retry:
+        re-route/resubmit once the storm passes — the redeemed pod's
+        timeline bridges ``shed`` with the fresh ``resubmit``/
+        ``enqueue``)."""
+        with self._lock:
+            out, self._tickets = self._tickets, []
+        return out
+
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self.shed_counts.values())
+
+
+# ---------------------------------------------------------------------------
+# The brownout ladder
+# ---------------------------------------------------------------------------
+
+
+class BrownoutController:
+    """Monotonic, hysteresis-guarded degradation ladder over the fleet's
+    SLO burn rates.
+
+    Levels (each INCLUDES every lower level's degradation):
+
+    =====  ======================================================
+    L0     normal operation
+    L1     pipeline depth capped at 1 (no deep speculation)
+    L2     serial solve path + one batch-bucket degrade step
+    L3     defer all BATCH/FREE admission (park, don't feed)
+    L4     shed FREE outright (incoming and parked)
+    =====  ======================================================
+
+    The pressure signal is the same one the elastic
+    :class:`~.elastic.TopologyController` scales on: the fleet-worst
+    ``max(p99_latency, queue_age)`` burn rate. ``thresholds[i]`` is the
+    burn at which level ``i+1`` becomes the target; the ladder moves ONE
+    step per ``sustain`` consecutive hot ticks up and one step per
+    ``cooldown`` consecutive cold ticks down — monotonic with
+    hysteresis, never a jump, never a flap.
+
+    Topology coordination: while an escalation is due from L0 and the
+    topology controller still has scale-out budget (not cooling down
+    from a transition, below ``max_shards``, no open transition), the
+    ladder YIELDS for up to ``max_yield`` ticks — prefer a split that
+    adds capacity over a brownout that sheds work; once the topology is
+    inside its own transition cooldown (or out of budget), brown out.
+    """
+
+    L0, L1, L2, L3, L4 = range(5)
+    MAX_LEVEL = L4
+
+    def __init__(
+        self,
+        slo=None,
+        shards: Optional[Callable[[], Sequence[int]]] = None,
+        *,
+        thresholds: Tuple[float, float, float, float] = (1.0, 2.0, 4.0, 8.0),
+        sustain: int = 2,
+        cooldown: int = 4,
+        max_yield: Optional[int] = None,
+        clock: Callable[[], float] = time.perf_counter,
+        registry=None,
+        topology=None,
+        history: int = 64,
+    ):
+        if len(thresholds) != self.MAX_LEVEL or any(
+            b >= a for a, b in zip(thresholds[1:], thresholds)
+        ):
+            raise ValueError(
+                f"thresholds must be {self.MAX_LEVEL} ascending burns, "
+                f"got {thresholds!r}"
+            )
+        self.slo = slo
+        self.shards = shards
+        self.thresholds = tuple(float(t) for t in thresholds)
+        self.sustain = max(1, int(sustain))
+        self.cooldown = max(1, int(cooldown))
+        self.max_yield = self.sustain if max_yield is None else int(max_yield)
+        self.clock = clock
+        self.topology = topology
+        #: the current ladder level — the ONE attribute every hot-path
+        #: consumer reads (pipeline depth cap, serial gate, bucket
+        #: degrade, admission defers/sheds)
+        self.level = self.L0
+        self._hot = 0
+        self._cold = 0
+        self._yields = 0
+        self._ticks = 0
+        self._level_since = self.clock()
+        self._lock = threading.Lock()
+        self._transitions: "deque[dict]" = deque(maxlen=int(history))  # guarded-by: self._lock
+        self._healths: list = []
+        self._flights: list = []
+        self.registry = None
+        self._gauge = None
+        self._trans_counter = None
+        self.stats = {
+            "escalations": 0,
+            "deescalations": 0,
+            "yielded_to_split": 0,
+        }
+        if registry is not None:
+            self.bind_registry(registry)
+
+    # ---- wiring ----
+
+    def bind_registry(self, registry) -> None:
+        if self.registry is not None:
+            return
+        self.registry = registry
+        self._gauge = registry.get("brownout_level")
+        self._trans_counter = registry.get("brownout_transitions_total")
+        if self._gauge is not None:
+            self._gauge.set(float(self.level))
+
+    def attach_health(self, health) -> None:
+        """Register a /healthz surface (one per scheduler runtime): the
+        ``brownout`` row shows the live level; any level above L0 reads
+        degraded — load balancers and operators see the storm."""
+        if health is None or health in self._healths:
+            return
+        self._healths.append(health)
+        health.set("brownout", self.level == self.L0, f"L{self.level}")
+
+    def attach_flight(self, recorder) -> None:
+        """Register a flight recorder to journal transitions into (the
+        crash-surviving black box: a post-mortem must show WHEN the
+        ladder moved relative to the cycles around it)."""
+        if recorder is None or recorder in self._flights:
+            return
+        self._flights.append(recorder)
+
+    # ---- the pressure signal ----
+
+    def pressure(self) -> float:
+        """Fleet-worst placement burn — same signal, same accessor
+        (``SloTracker.burn_rate``) the topology controller reads; new
+        pressure signals join HERE, not as ad-hoc checks at call sites
+        (ROADMAP standing rule)."""
+        if self.slo is None:
+            return 0.0
+        shards = list(self.shards()) if self.shards is not None else None
+        if shards is None:
+            ev = self.slo.evaluate()
+            shards = [int(s) for s in ev]
+        worst = 0.0
+        for s in shards:
+            worst = max(
+                worst,
+                self.slo.burn_rate(s, "p99_latency"),
+                self.slo.burn_rate(s, "queue_age"),
+            )
+        return worst
+
+    def _target_for(self, burn: float) -> int:
+        target = self.L0
+        for i, thr in enumerate(self.thresholds):
+            if burn >= thr:
+                target = i + 1
+        return target
+
+    def _topology_can_relieve(self) -> bool:
+        t = self.topology
+        if t is None:
+            return False
+        return (not t.in_cooldown) and t.can_scale_out()
+
+    # ---- the tick ----
+
+    def tick(self, cycle: int = -1) -> Optional[dict]:
+        """One evaluation: read the burn, update the hot/cold streaks,
+        move at most ONE level. Returns the transition record when the
+        level moved, else None."""
+        self._ticks += 1
+        burn = self.pressure()
+        target = self._target_for(burn)
+        if target > self.level:
+            self._hot += 1
+            self._cold = 0
+            if self._hot >= self.sustain:
+                if (
+                    self.level == self.L0
+                    and self._yields < self.max_yield
+                    and self._topology_can_relieve()
+                ):
+                    # capacity budget remains: give the topology
+                    # controller a bounded window to split before the
+                    # ladder starts degrading work
+                    self._yields += 1
+                    self.stats["yielded_to_split"] += 1
+                    return None
+                self._hot = 0
+                return self._set_level(
+                    self.level + 1, cycle, burn, "escalate"
+                )
+        elif target < self.level:
+            self._cold += 1
+            self._hot = 0
+            self._yields = 0
+            if self._cold >= self.cooldown:
+                self._cold = 0
+                return self._set_level(
+                    self.level - 1, cycle, burn, "deescalate"
+                )
+        else:
+            # pressure matched the level (or a split relieved it before
+            # the ladder ever moved): the episode is over — the yield
+            # budget renews for the NEXT storm, not just the next
+            # transition
+            self._hot = 0
+            self._cold = 0
+            self._yields = 0
+        return None
+
+    def _set_level(
+        self, level: int, cycle: int, burn: float, direction: str
+    ) -> dict:
+        prev = self.level
+        now = self.clock()
+        rec = {
+            "t": now,
+            "cycle": int(cycle),
+            "from": prev,
+            "to": int(level),
+            "burn": round(float(burn), 4),
+            "direction": direction,
+        }
+        self.level = int(level)
+        self._yields = 0
+        self._level_since = now
+        self.stats[
+            "escalations" if direction == "escalate" else "deescalations"
+        ] += 1
+        with self._lock:
+            self._transitions.append(rec)
+        if self._gauge is not None:
+            self._gauge.set(float(self.level))
+        if self._trans_counter is not None:
+            self._trans_counter.labels(direction=direction).inc()
+        for health in self._healths:
+            health.set(
+                "brownout",
+                self.level == self.L0,
+                f"L{self.level} (burn {burn:.2f})",
+            )
+        for fr in self._flights:
+            # journaled beside the per-cycle records — never raises into
+            # the control loop (FlightRecorder.record's own contract)
+            fr.record(
+                cycle=int(cycle),
+                brownout={"from": prev, "to": self.level, "burn": burn},
+                speculation="brownout",
+            )
+        return rec
+
+    # ---- hot-path policy reads (one attribute check at each consumer) ----
+
+    def pipeline_depth_cap(self) -> int:
+        """L1+: no deep speculation — a storm's churn discards chained
+        speculations anyway; stop paying for dispatches it will throw
+        away."""
+        return 1 if self.level >= self.L1 else 1 << 30
+
+    def serial_only(self) -> bool:
+        """L2+: the pipeline's ``brownout`` gate closes — cycles run the
+        serial path (decision-identical by construction, no overlap)."""
+        return self.level >= self.L2
+
+    def bucket_degrade_steps(self) -> int:
+        """L2+: one extra batch-bucket degrade step (smaller chunks keep
+        per-cycle latency bounded under pressure, same mechanism as the
+        deadline degrade)."""
+        return 1 if self.level >= self.L2 else 0
+
+    def defers(self, band: PriorityClass) -> bool:
+        """L3+: BATCH/FREE admission parks instead of queueing."""
+        return self.level >= self.L3 and band in SHEDDABLE_BANDS
+
+    def sheds(self, band: PriorityClass) -> bool:
+        """L4: FREE is shed outright."""
+        return self.level >= self.L4 and band == PriorityClass.FREE
+
+    # ---- introspection ----
+
+    def transitions(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._transitions]
+
+    def report(self) -> dict:
+        return {
+            "level": self.level,
+            "level_name": f"L{self.level}",
+            "since": self._level_since,
+            "burn": round(self.pressure(), 4),
+            "thresholds": list(self.thresholds),
+            "sustain": self.sustain,
+            "cooldown": self.cooldown,
+            "stats": dict(self.stats),
+            "transitions": self.transitions(),
+        }
+
+    def render(self) -> str:
+        return json.dumps(self.report(), indent=1, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# Solver-channel circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Classic three-state breaker for the snapshot channel.
+
+    CLOSED: calls pass; ``threshold`` CONSECUTIVE failures open it.
+    OPEN: calls fail fast (``allow()`` is False) until ``cooldown_s``
+    elapses, then one HALF_OPEN probe is admitted; its success closes
+    the breaker, its failure re-opens (fresh cooldown). A persistent
+    channel death thus costs one probe per cooldown window instead of a
+    full retry-backoff ladder per call — the caller degrades to the
+    host-reference path fast and stays there until the probe heals."""
+
+    CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+    _NAMES = {0: "closed", 1: "open", 2: "half_open"}
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        cooldown_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        gauge=None,
+    ):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self.gauge = gauge
+        self._lock = threading.Lock()
+        self._state = self.CLOSED  # guarded-by: self._lock
+        self._failures = 0  # guarded-by: self._lock
+        self._opened_at = 0.0  # guarded-by: self._lock
+        self._probing = False  # guarded-by: self._lock
+        self.stats = {"trips": 0, "probes": 0, "closes": 0}
+        if gauge is not None:
+            gauge.set(float(self.CLOSED))
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_name(self) -> str:
+        return self._NAMES[self.state]
+
+    def _to(self, state: int) -> None:  # koordlint: holds=self._lock
+        """Caller holds the lock."""
+        self._state = state
+        if self.gauge is not None:
+            self.gauge.set(float(state))
+
+    def allow(self) -> bool:
+        """Whether a call may go out now. An OPEN breaker admits exactly
+        ONE probe per cooldown window (HALF_OPEN); concurrent callers
+        behind the probe fail fast until it settles."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self.clock() - self._opened_at >= self.cooldown_s:
+                    self._to(self.HALF_OPEN)
+                    self._probing = True
+                    self.stats["probes"] += 1
+                    return True
+                return False
+            # HALF_OPEN: the probe is in flight — admit nothing else
+            if not self._probing:
+                self._probing = True
+                self.stats["probes"] += 1
+                return True
+            return False
+
+    def abort_probe(self) -> None:
+        """An admitted call ended WITHOUT a channel verdict — e.g. a
+        local fencing refusal before the wire, or a server-side fencing
+        abort (neither says anything about channel health). Release the
+        probe slot uncounted so the next ``allow()`` can re-probe;
+        leaving ``_probing`` set would wedge a HALF_OPEN breaker
+        forever (every later call fails fast, nothing ever settles)."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != self.CLOSED:
+                self._to(self.CLOSED)
+                self.stats["closes"] += 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            if self._state == self.HALF_OPEN:
+                # the probe failed: straight back to OPEN, fresh window
+                self._to(self.OPEN)
+                self._opened_at = self.clock()
+                self.stats["trips"] += 1
+                return
+            self._failures += 1
+            if (
+                self._state == self.CLOSED
+                and self._failures >= self.threshold
+            ):
+                self._to(self.OPEN)
+                self._opened_at = self.clock()
+                self.stats["trips"] += 1
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._NAMES[self._state],
+                "failures": self._failures,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "stats": dict(self.stats),
+            }

@@ -615,6 +615,9 @@ class ShardRouter:
         lifecycle=None,
         gang_of=None,
         spill_resume_frac: float = 0.5,
+        burn_of=None,
+        brownout=None,
+        burn_spill_frac: float = 0.5,
     ):
         self.shard_map = shard_map
         if quota_of is None:
@@ -641,6 +644,20 @@ class ShardRouter:
         self.spill_resume_frac = float(spill_resume_frac)
         self._spilling: Dict[int, bool] = {}  # guarded-by: self._spill_lock
         self._spill_lock = threading.Lock()
+        #: overload-control PR (ROADMAP follow-on): fan-out consults the
+        #: topology controller's BURN VIEW, not raw backlog alone —
+        #: ``burn_of(shard)`` (e.g. ``TopologyController.shard_burn``)
+        #: lowers the engage threshold to ``burn_spill_frac`` of
+        #: ``spill_backlog`` while the primary burns its placement SLO
+        #: budget (burn > 1), so a burning primary spills EARLIER than a
+        #: merely busy one
+        self.burn_of = burn_of
+        self.burn_spill_frac = float(burn_spill_frac)
+        #: …and a BROWNING fleet stops fanning out BATCH/FREE claims it
+        #: is about to defer/shed (L3+): a spill claim for a pod the
+        #: admission controller will park would churn the ClaimTable for
+        #: nothing
+        self.brownout = brownout
         #: fleet-tracing PR: when wired, route/fan-out decisions become
         #: lifecycle events (pods the tracker never saw get their
         #: ``submit`` anchor here — the router IS the control plane's
@@ -672,11 +689,25 @@ class ShardRouter:
 
     def _spill_engaged(self, primary: int, backlog: int) -> bool:
         """Hysteresis band: engage at ``spill_backlog``, release only
-        below ``spill_resume_frac`` of it."""
-        low = self.spill_backlog * self.spill_resume_frac
+        below ``spill_resume_frac`` of it. A BURNING primary (its
+        placement burn rate > 1, read through the topology controller's
+        view) engages at ``burn_spill_frac * spill_backlog`` instead —
+        the burn says the backlog is not draining, so waiting for the
+        raw threshold just converts queue depth into SLO debt. The
+        RELEASE threshold is anchored at the burn-adjusted FLOOR
+        whenever a burn view is wired, so an oscillating burn signal
+        cannot move the release level and saw the band (the exact
+        claim-churn flap the hysteresis exists to prevent)."""
+        engage_at = self.spill_backlog
+        floor = engage_at
+        if self.burn_of is not None:
+            floor = max(1, int(engage_at * self.burn_spill_frac))
+            if self.burn_of(primary) > 1.0:
+                engage_at = floor
+        low = floor * self.spill_resume_frac
         with self._spill_lock:
             engaged = self._spilling.get(primary, False)
-            if not engaged and backlog >= self.spill_backlog:
+            if not engaged and backlog >= engage_at:
                 engaged = True
             elif engaged and backlog < low:
                 engaged = False
@@ -690,6 +721,7 @@ class ShardRouter:
         node-pinned). The spill target is the NEXT active shard in the
         live topology (ids are sparse once splits happen)."""
         primary = self.route(pod)
+        bo = self.brownout
         if (
             self.spill_backlog is None
             or backlog_of is None
@@ -697,6 +729,10 @@ class ShardRouter:
             or pod.spec.node_name
             or self.gang_of(pod) is not None
             or self.quota_of(pod) is not None
+            # a browning fleet stops fanning out claims it will
+            # defer/shed: the band's spill copy would be parked at the
+            # spill shard's admission gate anyway
+            or (bo is not None and bo.defers(pod.priority_class))
             or not self._spill_engaged(primary, backlog_of(primary))
         ):
             return [primary]
@@ -754,6 +790,7 @@ class ShardedScheduler:
         fabric: ShardFabric,
         make_scheduler,
         pipelined: bool = True,
+        pipeline_depth: int = 1,
         max_batch: int = 256,
         max_retries: int = 8,
         lease_duration: float = 3.0,
@@ -766,12 +803,15 @@ class ShardedScheduler:
         slo=None,
         flight_capacity: int = 256,
         claim_tombstone_retention_s: float = 3600.0,
+        overload=None,
+        brownout=None,
     ):
         self.name = name
         self.hub = hub
         self.fabric = fabric
         self.make_scheduler = make_scheduler
         self.pipelined = pipelined
+        self.pipeline_depth = int(pipeline_depth)
         self.max_batch = max_batch
         self.max_retries = max_retries
         self.verify_recovery = verify_recovery
@@ -786,6 +826,17 @@ class ShardedScheduler:
         #: the one-attribute-check disabled contract.
         self.lifecycle = lifecycle
         self.slo = slo
+        #: QoS-aware overload control (overload-control PR): the fleet-
+        #: shared AdmissionController each shard's stream consults at
+        #: submit, and the BrownoutController whose ladder level gates
+        #: the pipeline/bucket and the admission defers/sheds. Both
+        #: optional — None keeps every hot path one attribute check.
+        self.overload = overload
+        self.brownout = brownout
+        if overload is not None and brownout is None:
+            # one wiring knob: the admission controller usually carries
+            # its ladder
+            self.brownout = overload.brownout
         self.flight_capacity = int(flight_capacity)
         #: ClaimTable tombstone retention (PR 6 queued follow-on): when a
         #: shard's run-loop journal compaction fires, settled claim
@@ -988,6 +1039,17 @@ class ShardedScheduler:
             )
 
         sched.on_journal_compacted = _gc_claims
+        # overload control (overload-control PR): the fleet-shared
+        # brownout ladder gates this runtime's pipeline/bucket, journals
+        # into its flight recorder, and shows on its /healthz; the
+        # admission controller binds metrics to the first runtime's
+        # registry (the fleet scrape merges it once)
+        if self.brownout is not None:
+            sched.brownout = self.brownout
+            sched.extender.services.brownout = self.brownout
+            self.brownout.bind_registry(sched.extender.registry)
+            self.brownout.attach_health(sched.extender.health)
+            self.brownout.attach_flight(sched.flight_recorder)
         informers = self.hub.wire_scheduler(sched, node_filter=flt)
         self.hub.start()
         stream_cls = self._stream_cls()
@@ -996,10 +1058,12 @@ class ShardedScheduler:
             max_batch=self.max_batch,
             max_retries=self.max_retries,
             pipelined=self.pipelined,
+            pipeline_depth=self.pipeline_depth,
             feed_gate=lambda pod, _s=shard: self._claim(_s, pod),
             lifecycle=self.lifecycle,
             slo=self.slo,
             shard=shard,
+            overload=self.overload,
         )
         rt = ShardRuntime(
             shard=shard,

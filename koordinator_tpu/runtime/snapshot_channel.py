@@ -72,7 +72,23 @@ class ChannelFenced(ChannelError):
     retryable: the deposed leader must stand down, not re-send."""
 
 
+class ChannelBreakerOpen(ChannelError):
+    """The client's circuit breaker is OPEN (overload-control PR): K
+    consecutive channel failures tripped it, and the cooldown window
+    has not yet admitted a half-open probe. The call failed FAST —
+    nothing left the process — so the caller should degrade (host-
+    reference ladder, deferred sync) instead of paying retry backoff
+    against a dead channel. NOT retryable by the RetryPolicy: the
+    breaker IS the retry governor while it is open."""
+
+
 _RETRYABLE_ERRORS = (ChannelUnavailable, ChannelTimeout)
+
+#: failures that count toward opening the breaker: the channel itself
+#: misbehaved. Fencing is deliberately excluded (a deposed leader's
+#: refusal is a correctness verdict, not channel death), as is the
+#: breaker's own fast-fail.
+_BREAKER_COUNTED = (ChannelUnavailable, ChannelTimeout, ChannelCallError)
 
 #: metadata key carrying the caller's fencing epoch (the proto stays
 #: unchanged — fencing is transport-level, like an authz header)
@@ -399,11 +415,19 @@ class SolverClient:
         chaos: Optional[FaultInjector] = None,
         retry_counter=None,
         fence=None,
+        breaker=None,
     ):
         self.timeout_s = timeout_s
         self.retry = retry
         self.chaos = chaos or NULL_INJECTOR
         self.retry_counter = retry_counter
+        #: circuit breaker (overload-control PR): a
+        #: :class:`~.overload.CircuitBreaker`. K consecutive channel
+        #: failures open it; while open, calls raise
+        #: :class:`ChannelBreakerOpen` BEFORE the wire (and before the
+        #: RetryPolicy can spin) until the cooldown admits a half-open
+        #: probe. None = unmetered, the pre-PR behavior.
+        self.breaker = breaker
         #: HA fencing: optional EpochFence + the epoch this client's
         #: leadership grant carries (set_epoch on takeover). When wired,
         #: every call is (a) checked locally — a deposed leader's delta
@@ -449,8 +473,17 @@ class SolverClient:
 
     def _call(self, name: str, stub, req):
         chaos = self.chaos
+        breaker = self.breaker
 
         def once():
+            if breaker is not None and not breaker.allow():
+                # fail FAST: the channel is known-dead and the cooldown
+                # has not yet admitted a probe — no fence read, no wire,
+                # no retry backoff
+                raise ChannelBreakerOpen(
+                    f"{name}: circuit breaker open "
+                    f"({breaker.state_name})", None
+                )
             if self.fence is not None and self.epoch is not None:
                 # local fencing: raises StaleEpochError when our grant
                 # was superseded — the delta never reaches the wire
@@ -458,6 +491,15 @@ class SolverClient:
             if chaos.fire(f"channel.{name}.drop"):
                 raise ChannelUnavailable(
                     f"{name}: injected RPC drop", None
+                )
+            if chaos.fire("channel.breaker_storm"):
+                # named storm point (overload-control PR): a persistent
+                # channel brownout — every call fails at the transport
+                # until the schedule exhausts, which is exactly the
+                # shape that must trip the breaker instead of burning
+                # per-call retry ladders
+                raise ChannelUnavailable(
+                    f"{name}: injected channel storm", None
                 )
             chaos.fire(f"channel.{name}.delay")
             md = None
@@ -470,10 +512,38 @@ class SolverClient:
             except grpc.RpcError as exc:
                 raise _map_rpc_error(name, exc) from exc
 
+        def metered():
+            # one breaker verdict per ATTEMPT (the retry policy's
+            # attempts each count — K consecutive failures open it
+            # regardless of how they were grouped into calls)
+            try:
+                out = once()
+            except ChannelBreakerOpen:
+                # the breaker's own fast-fail: this call was never
+                # admitted, so it must not touch the probe slot a
+                # concurrent admitted call may hold
+                raise
+            except _BREAKER_COUNTED:
+                if breaker is not None:
+                    breaker.record_failure()
+                raise
+            except BaseException:
+                # an outcome that says nothing about channel health
+                # (fencing — local StaleEpochError or ChannelFenced —
+                # or any unexpected error): release the probe slot
+                # uncounted, or a HALF_OPEN breaker would wedge with
+                # its probe permanently in flight
+                if breaker is not None:
+                    breaker.abort_probe()
+                raise
+            if breaker is not None:
+                breaker.record_success()
+            return out
+
         if self.retry is None:
-            return once()
+            return metered()
         return self.retry.run(
-            once,
+            metered,
             retry_on=_RETRYABLE_ERRORS,
             site=f"channel.{name}",
             counter=self.retry_counter,

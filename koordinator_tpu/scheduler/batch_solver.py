@@ -610,6 +610,11 @@ class BatchScheduler:
         #: one attribute-is-None check (PR 1/PR 7 standing rule). Attach
         #: via attach_devprof.
         self.devprof = None
+        #: brownout ladder (overload-control PR): when wired, L2+ adds a
+        #: batch-bucket degrade step (effective_batch_bucket) and closes
+        #: the pipeline's ``brownout`` speculation gate. None = normal
+        #: operation; every consumer is one attribute-is-None check.
+        self.brownout = None
         self._queue_depth_hint = 0
         #: most recent pipeline gate evaluation (set by CyclePipeline)
         self.last_gate_report: Dict[str, object] = {}
@@ -2515,10 +2520,17 @@ class BatchScheduler:
         """Chunk size this cycle: ``batch_bucket`` halved once per
         deadline-degrade step (floor 16). A cycle that blows its
         deadline degrades to smaller batches instead of wedging; clean
-        cycles re-promote (see the tail bookkeeping)."""
-        if self._bucket_degrade <= 0:
+        cycles re-promote (see the tail bookkeeping). The brownout
+        ladder (L2+) contributes one more degrade step for as long as
+        it holds — pressure-bounded cycles, re-promoted by the ladder's
+        own de-escalation rather than the clean-cycle counter."""
+        degrade = self._bucket_degrade
+        bo = self.brownout
+        if bo is not None:
+            degrade += bo.bucket_degrade_steps()
+        if degrade <= 0:
             return self.batch_bucket
-        return max(16, self.batch_bucket >> self._bucket_degrade)
+        return max(16, self.batch_bucket >> degrade)
 
     def _chunks(self, eligible: Sequence[Pod]) -> List[List[Pod]]:
         """Split into solver batches of ~batch_bucket without splitting a

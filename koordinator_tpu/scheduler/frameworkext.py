@@ -214,6 +214,35 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "rewrites; failed/crashed attempts are NOT counted — the live "
         "log is intact and the next threshold retries)",
     )
+    # overload-control PR: QoS-aware admission + brownout ladder +
+    # solver-channel circuit breaker
+    reg.counter(
+        "overload_shed_total",
+        "queued/arriving pods shed by the QoS-aware admission "
+        "controller (terminal: a shed pod leaves a resubmit ticket), "
+        "per priority band",
+        labels=("band",),
+    )
+    reg.counter(
+        "overload_deferred_total",
+        "pod arrivals parked by QoS-aware admission (band over its "
+        "queue budget, or the brownout ladder defers the band)",
+        labels=("band",),
+    )
+    reg.gauge(
+        "brownout_level",
+        "current brownout-ladder level (0 = normal … 4 = shed FREE)",
+    )
+    reg.counter(
+        "brownout_transitions_total",
+        "brownout-ladder level transitions, by direction",
+        labels=("direction",),
+    )
+    reg.gauge(
+        "solver_breaker_state",
+        "snapshot-channel circuit-breaker state "
+        "(0 = closed, 1 = open, 2 = half-open probe)",
+    )
     ensure_exceptions_counter(reg)
     return reg
 
@@ -441,6 +470,7 @@ class ServicesEngine:
                                named gate keeps this config serial)
       /debug/flightrecorder  — last-N per-cycle summaries (crash-
                                surviving black box)
+      /debug/brownout        — brownout-ladder level, burn, transitions
       /debug/compiles        — solver compile/retrace ledger (traces per
                                entry point, signature diffs, compile wall)
       /debug/profile         — solver observatory status; ?cycles=N arms
@@ -471,6 +501,9 @@ class ServicesEngine:
         self.slo = None
         self.flightrecorder = None
         self.devprof = None
+        #: brownout-ladder controller (overload-control PR) — wired by
+        #: the stream/sharded scheduler when overload control is on
+        self.brownout = None
         self.gate_info: Optional[Callable[[], Dict[str, object]]] = None
         self._routes: Dict[str, Callable[[str], Tuple[int, str]]] = {}
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -516,6 +549,10 @@ class ServicesEngine:
             if self.flightrecorder is None:
                 return 404, "no flight recorder wired"
             return 200, self.flightrecorder.render()
+        if path == "/debug/brownout":
+            if self.brownout is None:
+                return 404, "no brownout controller wired"
+            return 200, self.brownout.render()
         if path == "/debug/compiles":
             if self.devprof is None:
                 return 404, "no solver observatory wired"

@@ -440,10 +440,17 @@ class CyclePipeline:
                         chain=newest.spec.chain_out,
                         chain_version=newest.spec.version,
                     )
+        # brownout L1 (overload-control PR): cap the in-flight window at
+        # 1 — a storm's churn discards chained speculation anyway, so
+        # stop paying for the deep dispatches it will throw away
+        depth_cap = self.depth
+        bo = sched.brownout
+        if bo is not None:
+            depth_cap = min(depth_cap, bo.pipeline_depth_cap())
         outs: List[ScheduleOutcome] = []
         while self._pending and (
             not batch
-            or len(self._pending) >= self.depth
+            or len(self._pending) >= depth_cap
             # a serial newest entry caps the chain: nothing can dispatch
             # off it, so holding depth only delays results — drain the
             # tail now so the NEXT feed re-bootstraps speculation off
@@ -691,6 +698,11 @@ class CyclePipeline:
         gates["ladder"] = (
             sched._fallback_level == 0 and sched._bucket_degrade == 0
         )
+        # brownout L2+ (overload-control PR): the ladder says SERIAL —
+        # no speculation while the fleet sheds load (decision-identical
+        # by construction, like every closed gate)
+        bo = sched.brownout
+        gates["brownout"] = bo is None or not bo.serial_only()
         # warm gangs ride the chain; cold gangs (members missing or a
         # gang in timeout) keep the batch serial
         gates["batch_gangs"] = sched.pod_groups.batch_gangs_warm(batch)

@@ -70,6 +70,7 @@ class StreamScheduler:
         lifecycle=None,
         slo=None,
         shard: int = -1,
+        overload=None,
     ):
         self.scheduler = scheduler
         self.max_batch = max_batch
@@ -82,6 +83,31 @@ class StreamScheduler:
         self.lifecycle = lifecycle
         self.slo = slo
         self.shard = int(shard)
+        #: QoS-aware bounded admission (overload-control PR): an
+        #: :class:`~..runtime.overload.AdmissionController`. PROD/MID
+        #: always enter the live queue; BATCH/FREE past their band's
+        #: budget (or a browning ladder) park in ``_deferred`` — fed
+        #: only once pressure clears — and are SHED (terminal lifecycle
+        #: event + resubmit ticket) once deferral outlives the band's
+        #: age limit. None = every path below is one attribute check.
+        self.overload = overload
+        #: parked BATCH/FREE arrivals, FIFO, stamps/tries intact
+        self._deferred: Deque[Tuple[Pod, float, int]] = deque()
+        #: live-queue depth per priority band (int(PriorityClass) keys),
+        #: maintained only while ``overload`` is wired
+        self._band_live: Dict[int, int] = {}
+        if overload is not None:
+            overload.bind_registry(scheduler.extender.registry)
+            bo = overload.brownout
+            if bo is not None:
+                if scheduler.brownout is None:
+                    scheduler.brownout = bo
+                bo.bind_registry(scheduler.extender.registry)
+                bo.attach_health(scheduler.extender.health)
+                if scheduler.extender.services.brownout is None:
+                    scheduler.extender.services.brownout = bo
+                if scheduler.flight_recorder is not None:
+                    bo.attach_flight(scheduler.flight_recorder)
         if lifecycle is not None and scheduler.lifecycle is None:
             # the scheduler embeds each pod's compact trace context in
             # its bind-journal records (crash-bridged timelines)
@@ -103,10 +129,36 @@ class StreamScheduler:
                 depth=pipeline_depth,
             )
 
-    def submit(self, pod: Pod, now: Optional[float] = None) -> None:
-        self._queue.append(
-            (pod, _time.perf_counter() if now is None else now, 0)
-        )
+    def submit(self, pod: Pod, now: Optional[float] = None) -> str:
+        """Enqueue one arrival. Returns the admission verdict —
+        ``"admit"`` (live queue), ``"defer"`` (parked until band
+        pressure clears) or ``"shed"`` (terminal: the pod left a
+        resubmit ticket on the overload controller). Without an
+        overload controller every submit is an admit."""
+        arrival = _time.perf_counter() if now is None else now
+        ov = self.overload
+        if ov is not None:
+            band = pod.priority_class
+            verdict = ov.admit(pod, self._band_live.get(int(band), 0))
+            if verdict == ov.SHED:
+                ov.shed(pod, self.shard, arrival, detail="admission")
+                return "shed"
+            if verdict == ov.DEFER:
+                self._deferred.append((pod, arrival, 0))
+                ov.note_deferred(band)
+                lc = self.lifecycle
+                if lc is not None:
+                    if not lc.seen(pod.meta.uid):
+                        lc.submitted(pod.meta.uid)
+                    lc.event(
+                        pod.meta.uid, "enqueue", shard=self.shard,
+                        detail="deferred",
+                    )
+                return "defer"
+            self._band_live[int(band)] = (
+                self._band_live.get(int(band), 0) + 1
+            )
+        self._queue.append((pod, arrival, 0))
         lc = self.lifecycle
         if lc is not None:
             # a pod the tracker never saw gets its ``submit`` anchor here
@@ -114,13 +166,67 @@ class StreamScheduler:
             if not lc.seen(pod.meta.uid):
                 lc.submitted(pod.meta.uid)
             lc.event(pod.meta.uid, "enqueue", shard=self.shard)
+        return "admit"
 
     def backlog(self) -> int:
         return len(self._queue)
 
+    def deferred_backlog(self) -> int:
+        """Parked BATCH/FREE arrivals awaiting band headroom (not part
+        of :meth:`backlog` — spill fan-out and queue-depth hints must
+        not treat deliberately parked pods as live pressure)."""
+        return len(self._deferred)
+
     def close(self) -> None:
         if self._pipe is not None:
             self._pipe.close()
+
+    # ---- QoS-aware admission plumbing (overload-control PR) ----
+
+    def _band_add(self, pod: Pod, d: int) -> None:
+        """Live-queue band accounting — called at every point a pod
+        enters or permanently leaves ``self._queue`` while admission
+        control is wired (one attribute check when it is not)."""
+        if self.overload is None:
+            return
+        b = int(pod.priority_class)
+        self._band_live[b] = self._band_live.get(b, 0) + d
+
+    def _overload_sweep(self) -> None:
+        """Once per pump: age the deferred parking lot. Each parked pod
+        is, in order — SHED when the brownout ladder sheds its band;
+        kept parked while its band is still deferred (over budget or
+        browning), unless its age passed the band's limit (then SHED:
+        budget AND age limits both exceeded); else PROMOTED into the
+        live queue with its original stamp/tries — the latency clock
+        never restarted."""
+        ov = self.overload
+        if ov is None or not self._deferred:
+            return
+        now = ov.clock()
+        keep: Deque[Tuple[Pod, float, int]] = deque()
+        while self._deferred:
+            pod, arr, tries = self._deferred.popleft()
+            band = pod.priority_class
+            if ov.sheds_now(band):
+                ov.shed(pod, self.shard, arr, detail="brownout")
+                continue
+            if ov.still_deferred(
+                band, self._band_live.get(int(band), 0)
+            ):
+                if now - arr > ov.age_limit(band):
+                    ov.shed(pod, self.shard, arr, detail="aged_out")
+                else:
+                    keep.append((pod, arr, tries))
+                continue
+            self._band_add(pod, +1)
+            self._queue.append((pod, arr, tries))
+            if self.lifecycle is not None:
+                self.lifecycle.event(
+                    pod.meta.uid, "enqueue", shard=self.shard,
+                    detail="promoted",
+                )
+        self._deferred = keep
 
     def pump(self) -> List[Tuple[Pod, Optional[str], float]]:
         """One cycle: schedule up to ``max_batch`` queued pods. Returns
@@ -131,9 +237,10 @@ class StreamScheduler:
         pump's batch (the new batch's solve is in flight)."""
         if self._pipe is not None:
             return self._pump_pipelined()
+        self._overload_sweep()
+        self._observe_queue_age()
         if not self._queue:
             return []
-        self._observe_queue_age()
         batch = self._next_batch()
         if not batch:
             # every popped pod was claim-dropped (another shard won) or
@@ -164,8 +271,10 @@ class StreamScheduler:
                     # (same rule drain_for_handoff applies) — otherwise
                     # leader churn terminally fails pods that were never
                     # genuinely evaluated
+                    self._band_add(pod, +1)
                     self._queue.append((pod, t_arr, tries))
                 elif tries + 1 < self.max_retries:
+                    self._band_add(pod, +1)
                     self._queue.append((pod, t_arr, tries + 1))
                 else:
                     self._note_exhausted(pod)
@@ -183,11 +292,17 @@ class StreamScheduler:
         """One queue-age SLI sample per pump: the OLDEST queued pod's
         wait — backlog growth shows here before throughput moves. Read
         on the SLO tracker's clock, so callers must stamp arrivals in
-        the same time domain they built the tracker with."""
-        if self.slo is not None and self._queue:
+        the same time domain they built the tracker with. An EMPTY
+        queue samples zero (overload-control PR): a drained backlog is
+        evidence of health, and without it a post-storm burn window
+        would freeze at its worst samples forever — the brownout ladder
+        (and the topology controller) could never observe recovery."""
+        if self.slo is not None:
             self.slo.observe_queue_age(
                 self.shard,
-                max(0.0, self.slo.clock() - self._queue[0][1]),
+                max(0.0, self.slo.clock() - self._queue[0][1])
+                if self._queue
+                else 0.0,
             )
 
     def _note_dispatch(self, batch) -> None:
@@ -245,16 +360,23 @@ class StreamScheduler:
                     self._queue.appendleft(item)
                     break
                 if not admitted:
+                    # claim loser: the WINNING shard schedules this pod
+                    # — a queue-drop, but not a terminal one (claim_lost
+                    # was stamped at the gate; koordlint shed-paths
+                    # exemption documents this site)
+                    self._band_add(item[0], -1)
                     continue
+            self._band_add(item[0], -1)
             batch.append(item)
         return batch
 
     # ---- pipelined mode ----
 
     def _pump_pipelined(self) -> List[Tuple[Pod, Optional[str], float]]:
+        self._overload_sweep()
+        self._observe_queue_age()
         if not self._queue and not self._pipe.inflight:
             return []
-        self._observe_queue_age()
         batch = self._next_batch()
         if not batch and not self._pipe.inflight:
             # nothing to feed and nothing in flight to absorb (the queue
@@ -311,8 +433,10 @@ class StreamScheduler:
             t_arr, tries = self._inflight_meta.pop(pod.meta.uid)
             if fenced:
                 # fencing rejection ≠ scheduling verdict: no retry charge
+                self._band_add(pod, +1)
                 self._queue.append((pod, t_arr, tries))
             elif tries + 1 < self.max_retries:
+                self._band_add(pod, +1)
                 self._queue.append((pod, t_arr, tries + 1))
             else:
                 self._note_exhausted(pod)
@@ -342,6 +466,7 @@ class StreamScheduler:
             results.append((pod, node, lat))
         for pod in out.unschedulable:
             t_arr, tries = self._inflight_meta.pop(pod.meta.uid)
+            self._band_add(pod, +1)
             self._queue.append((pod, t_arr, tries))
         return results
 
@@ -357,9 +482,13 @@ class StreamScheduler:
         a shard's ownership moves to another scheduler incarnation: the
         donor's queued pods are re-routed to the new owner, keeping
         their latency clocks running (the north-star latency is
-        enqueue→bind, and a handoff is not an enqueue)."""
-        out = list(self._queue)
+        enqueue→bind, and a handoff is not an enqueue). Deferred
+        (parked) pods ride along — a handoff must never strand the
+        admission parking lot on a dead owner."""
+        out = list(self._queue) + list(self._deferred)
         self._queue.clear()
+        self._deferred.clear()
+        self._band_live.clear()
         if self.lifecycle is not None and event is not None:
             for pod, _arr, _tries in out:
                 self.lifecycle.event(
@@ -370,6 +499,7 @@ class StreamScheduler:
     def resubmit(self, pod: Pod, arrival: float, tries: int) -> None:
         """Re-enqueue a pod handed off from another incarnation's queue
         with its original arrival stamp and retry budget."""
+        self._band_add(pod, +1)
         self._queue.append((pod, arrival, tries))
         if self.lifecycle is not None:
             self.lifecycle.event(
@@ -379,7 +509,15 @@ class StreamScheduler:
     def flush(self) -> List[Tuple[Pod, Optional[str], float]]:
         """Drain everything: pump until the queue is empty, then complete
         the pipeline's in-flight cycle(s). Retried pods cycle back through
-        until decided. Serial mode simply pumps the queue dry."""
+        until decided. Serial mode simply pumps the queue dry. A flush is
+        a TERMINAL drain: deferred pods are promoted unconditionally
+        first — the operator asked for every verdict, so admission
+        deferral (a wait-for-headroom policy) no longer applies."""
+        if self.overload is not None:
+            while self._deferred:
+                pod, arr, tries = self._deferred.popleft()
+                self._band_add(pod, +1)
+                self._queue.append((pod, arr, tries))
         results: List[Tuple[Pod, Optional[str], float]] = []
         if self._pipe is None:
             while self._queue:

@@ -1623,6 +1623,12 @@ def _run_sharded_soak(
     def _absorb_decided(inc, decided, acknowledged: bool = True):
         for shard, pod, node, _lat in decided:
             inflight.pop(pod.meta.uid, None)
+            if pod.meta.uid in gang_tickets:
+                # cross-shard gang member: the decision feeds the
+                # two-phase ticket; the LEDGER is written only at
+                # commit (all-or-nothing), never per member
+                _note_gang(pod, node, shard)
+                continue
             if not acknowledged:
                 # the lost-ack window: the bind record is journaled but
                 # the process died before the bind API write went out —
@@ -1642,13 +1648,145 @@ def _run_sharded_soak(
             stats["handoffs"] += 1
             for pod, node, _lat in hand.decided:
                 inflight.pop(pod.meta.uid, None)
-                if node is not None:
+                if pod.meta.uid in gang_tickets:
+                    _note_gang(pod, node, shard)
+                elif node is not None:
                     _place(pod, node, shard)
                 else:
                     pending.append(pod)
             for pod, arr, tries in hand.queued:
                 inflight.pop(pod.meta.uid, None)
                 pending_handoff.append((shard, pod, arr, tries))
+
+    # ---- cross-shard gang arm (overload-control PR satellite): the
+    # two-phase commit/abort path runs INSIDE the soak's placed-once
+    # ledger — a committed gang lands in `placed` all-or-nothing, an
+    # aborted gang's members must come back fully CLAIMABLE (no
+    # tombstone, no zombie hold) and re-place exactly once as plain
+    # pods, never duplicating and never getting lost. ----
+    from koordinator_tpu.runtime.elastic import CrossShardGangCoordinator
+
+    xs_coord = CrossShardGangCoordinator(
+        fabric, router, _owner_of, lifecycle=lifecycle
+    )
+    gang_tickets: dict = {}   # member uid -> live ticket
+    gang_nodes: dict = {}     # member uid -> (shard, node), pre-commit
+    xs_stats = {"committed": 0, "aborted": 0, "abort_resubmitted": 0}
+    gang_seq = [0]
+
+    def _xs_gang_pods(tag: str, doom: bool):
+        """Three members pinned across the two largest OWNED shards —
+        the span the gang-home router cannot place. ``doom`` makes the
+        third member infeasible (larger than any node) so the gang must
+        abort once its retries exhaust."""
+        part = fabric.shard_map.partition(list(node_names))
+        owned_cells = [
+            s
+            for s in sorted(part, key=lambda s: (-len(part[s]), s))
+            if part[s] and _owner_of(s) is not None
+        ]
+        if len(owned_cells) < 2 or len(part[owned_cells[0]]) < 2:
+            return None
+        sa, sb = owned_cells[0], owned_cells[1]
+        gang_seq[0] += 1
+        pods = []
+        pins = [
+            (part[sa][0], POD_CPU),
+            (part[sa][1], POD_CPU),
+            (part[sb][0], 2 * ALLOC_CPU if doom else POD_CPU),
+        ]
+        for i, (node, cpu) in enumerate(pins):
+            pod = Pod(
+                meta=ObjectMeta(
+                    name=f"xsg-{tag}{gang_seq[0]}-m{i}",
+                    annotations={
+                        ext.ANNOTATION_GANG_NAME: f"{tag}{gang_seq[0]}",
+                        ext.ANNOTATION_GANG_MIN_AVAILABLE: "3",
+                        ext.ANNOTATION_GANG_TOTAL_NUM: "3",
+                    },
+                ),
+                spec=PodSpec(
+                    node_name=node,
+                    requests={ext.RES_CPU: cpu, ext.RES_MEMORY: POD_MEM},
+                    priority=9000,
+                ),
+            )
+            pods.append(pod)
+        return pods
+
+    def _begin_xs_gang(tag: str, doom: bool) -> bool:
+        pods = _xs_gang_pods(tag, doom)
+        if pods is None:
+            return False
+        ticket = xs_coord.begin(pods)
+        if ticket is None:
+            # an ownerless member shard mid-chaos refused the attempt
+            # with zero holds — retry a later cycle
+            return False
+        stats["arrived"] += len(pods)
+        for p in pods:
+            pod_by_uid[p.meta.uid] = p
+            gang_tickets[p.meta.uid] = ticket
+        return True
+
+    def _note_gang(pod, node, shard) -> None:
+        uid = pod.meta.uid
+        ticket = gang_tickets[uid]
+        if node is not None:
+            gang_nodes[uid] = (shard, node)
+            pod.spec.node_name = node
+            hub.publish(hub.pods, pod)
+        verdict = xs_coord.note(ticket, uid, node)
+        if verdict is not None:
+            _finish_gang(ticket)
+
+    def _finish_gang(ticket) -> None:
+        def _unbind(pod, shard, node):
+            # the driver's bind-API delete: releases snapshot/journal
+            # charges through the ordinary informer fan-out
+            hub.delete(hub.pods, pod)
+            pod.spec.node_name = None
+            gang_nodes.pop(pod.meta.uid, None)
+
+        committed = xs_coord.finish(ticket, unbind=_unbind)
+        for uid in ticket.members:
+            gang_tickets.pop(uid, None)
+        if committed:
+            xs_stats["committed"] += 1
+            for uid in sorted(ticket.members):
+                shard, node = gang_nodes.pop(uid)
+                _place(ticket.pods[uid], node, shard)
+        else:
+            xs_stats["aborted"] += 1
+            # LEDGER integration: aborted members are CLAIMABLE — no
+            # winner, no tombstone, no residual hold — and re-enter the
+            # ordinary flow as rightsized plain pods
+            assert fabric.claims.gang_holds(ticket.attempt_id) == 0
+            for uid, pod in sorted(ticket.pods.items()):
+                assert fabric.claims.winner(uid) is None, (
+                    f"aborted gang member {uid} left a claim winner"
+                )
+                assert uid not in placed, (
+                    f"aborted gang member {uid} leaked into the ledger"
+                )
+                gang_nodes.pop(uid, None)
+                for key in (
+                    ext.ANNOTATION_GANG_NAME,
+                    ext.ANNOTATION_GANG_MIN_AVAILABLE,
+                    ext.ANNOTATION_GANG_TOTAL_NUM,
+                ):
+                    pod.meta.annotations.pop(key, None)
+                try:
+                    del pod._gang_key
+                except AttributeError:
+                    pass
+                pod.spec.node_name = None
+                pod.spec.requests = {
+                    ext.RES_CPU: POD_CPU,
+                    ext.RES_MEMORY: POD_MEM,
+                }
+                pending.append(pod)
+                xs_stats["abort_resubmitted"] += 1
 
     total_cycles = cycles + drain_limit
     for cycle in range(total_cycles):
@@ -1705,6 +1843,19 @@ def _run_sharded_soak(
                 a_s, b_s = fabric.shard_map.siblings()[0]
                 out = topo_ctrl.merge(a_s, b_s, cycle=cycle)
                 assert out is not None, "scheduled merge failed"
+
+        # ---- cross-shard gang schedule (overload-control PR
+        # satellite): one gang that must COMMIT through the ledger and
+        # one doomed gang that must ABORT with claimable members — each
+        # begun once two owned shards exist, retried on chaos refusal,
+        # one ticket in flight at a time ----
+        if cycle < cycles and not gang_tickets and cycle >= split_cycle + 2:
+            if xs_stats["committed"] == 0:
+                _begin_xs_gang("xc", doom=False)
+            elif (
+                xs_stats["aborted"] == 0 and cycle >= split_cycle + 4
+            ):
+                _begin_xs_gang("xa", doom=True)
 
         # ---- arrivals ----
         arriving = []
@@ -1812,6 +1963,16 @@ def _run_sharded_soak(
                     if node is not None:
                         hit_shard = s
                         break
+                if node is not None and pod.meta.uid in gang_tickets:
+                    # a gang member's journaled bind recovered from the
+                    # kill: the decision feeds the TICKET (commit writes
+                    # the ledger), and the replay's recover event gets
+                    # its ack bracket like any recovered binding
+                    if not lifecycle.is_done(pod.meta.uid):
+                        lifecycle.acked(pod.meta.uid, hit_shard, node)
+                    _note_gang(pod, node, hit_shard)
+                    stats["recovered_bindings"] += 1
+                    continue
                 if node is not None:
                     shard = hit_shard
                     _place(pod, node, shard)
@@ -1978,6 +2139,7 @@ def _run_sharded_soak(
             and not pending_handoff
             and not inflight
             and not orphans
+            and not gang_tickets
         ):
             break
 
@@ -1988,7 +2150,12 @@ def _run_sharded_soak(
         _absorb_decided(inc, inc.flush())
     # a final routed pass for anything a flush returned unschedulable
     for _ in range(drain_limit):
-        if not pending and not pending_handoff and not inflight:
+        if (
+            not pending
+            and not pending_handoff
+            and not inflight
+            and not gang_tickets
+        ):
             break
         sim_cycle[0] += 1
         for inc in incs:
@@ -2028,6 +2195,15 @@ def _run_sharded_soak(
         f"{len(inflight)} inflight pods never placed"
     )
     assert stats["placed"] == stats["arrived"] == len(placed)
+    # cross-shard gang arm (overload-control PR satellite): the commit
+    # path landed the gang in the ledger all-or-nothing, and at least
+    # one abort returned its members claimable (re-placed above — they
+    # are inside the placed==arrived accounting, never lost/duplicated)
+    assert not gang_tickets, f"gang tickets never settled: {gang_tickets}"
+    assert xs_stats["committed"] >= 1, xs_stats
+    assert xs_stats["aborted"] >= 1, xs_stats
+    assert fabric.claims.gang_holds() == 0, "zombie gang holds remain"
+    stats["xs_gangs"] = dict(xs_stats)
     # zero lost acknowledged bindings, PER SHARD: every journal-live
     # bind (acked binds minus forgets, across every incarnation that
     # ever owned the shard) landed in the placed ledger on ITS node.
@@ -2138,5 +2314,655 @@ def _run_sharded_soak(
     stats["leak_samples"] = list(leaks.samples)
     for inc in incs:
         inc.close()
+    hub.stop()
+    return stats
+
+
+def run_overload_storm_soak(
+    cycles: int = 56,
+    seed: int = 0,
+    n_nodes: int = 24,
+    base_arrivals: int = 4,
+    storm_mult: int = 10,
+    drain_limit: int = 80,
+    shards: int = 2,
+    incarnations: int = 2,
+    verbose: bool = False,
+) -> dict:
+    """Overload-control acceptance soak (brownout PR): a seeded arrival
+    STORM (``storm_mult``× the base rate, mixed PROD/MID/BATCH/FREE
+    QoS bands) plus a channel brownout (``channel.breaker_storm``
+    tripping the :class:`~koordinator_tpu.runtime.overload.
+    CircuitBreaker` on a live loopback gRPC mirror) plus one shard
+    SPLIT mid-storm, driven through the sharded control plane with
+    QoS-aware bounded admission and the brownout ladder wired.
+
+    Asserted inside:
+
+    * **zero duplicate placements** (the placed ledger, across the
+      split's topology epoch bump);
+    * **PROD/MID are never shed** — only BATCH/FREE pay for the storm;
+    * **every terminally shed pod has a gap-free timeline ending at
+      ``shed``** (and every placed pod one ending at ``ack``, including
+      redeemed-resubmit-ticket pods whose story bridges the shed);
+    * **the ladder is monotonic with hysteresis**: every transition is
+      ±1 level, the transition count is bounded (no flapping), the
+      storm actually engages it (≥ L3) and it walks back down after;
+    * **the breaker trips, fails fast, probes and recloses** — the
+      mirror heals by full resync, never by per-call retry grind;
+    * **same seed ⇒ same trace** (fault trace + ladder transitions +
+      shed counts, for the determinism arm).
+    """
+    import random as _random
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.extension import PriorityClass
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.chaos import FaultInjector
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.obs.lifecycle import PodLifecycle, validate_timeline
+    from koordinator_tpu.obs.slo import SloTarget, SloTracker
+    from koordinator_tpu.runtime.elastic import TopologyController
+    from koordinator_tpu.runtime.overload import (
+        AdmissionController,
+        BrownoutController,
+        CircuitBreaker,
+        OverloadConfig,
+    )
+    from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+    from koordinator_tpu.runtime.shards import (
+        ShardedScheduler,
+        ShardFabric,
+        ShardRouter,
+    )
+    from koordinator_tpu.runtime.snapshot_channel import (
+        ChannelBreakerOpen,
+        ChannelError,
+        SolverClient,
+        SolverService,
+        serve,
+    )
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+
+    assert shards >= 2 and incarnations >= 2
+    ALLOC_CPU, ALLOC_MEM = 32_000.0, 128 * 1024.0
+    POD_CPU, POD_MEM = 2_000.0, 4_096.0
+    LIFETIME = 6
+    MAX_BATCH = 8
+    rng = _random.Random(seed)
+    chaos = FaultInjector(seed=seed)
+    sim_cycle = [0]
+
+    def _clock() -> float:
+        return float(sim_cycle[0])
+
+    fabric = ShardFabric(shards, clock=_clock, membership_ttl_s=2.5)
+    lifecycle = PodLifecycle(clock=_clock)
+    # SLO targets in SIM-CYCLE units; small windows so the post-storm
+    # recovery is visible inside the run (stale violations age out)
+    slo = SloTracker(
+        clock=_clock,
+        targets=(
+            # time horizons (max_age_s, in cycles) so the post-storm
+            # burn decays even for objectives that stop sampling while
+            # the ladder defers their traffic — recovery must be
+            # OBSERVABLE or the ladder could never walk back down
+            SloTarget(
+                "p99_latency", threshold_s=6.0, budget=0.1, window=48,
+                max_age_s=16.0, min_samples=4,
+            ),
+            SloTarget(
+                "queue_age", threshold_s=2.0, budget=0.05, window=48,
+                max_age_s=16.0, min_samples=4,
+            ),
+            SloTarget("recovery", threshold_s=6.0, budget=0.5, window=16),
+        ),
+    )
+    hub = ClusterStateHub(chaos=chaos)
+    node_names = [f"n{i:03d}" for i in range(n_nodes)]
+    for name in node_names:
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name=name),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: ALLOC_CPU,
+                        ext.RES_MEMORY: ALLOC_MEM,
+                    }
+                ),
+            ),
+        )
+
+    def make_scheduler(shard, snapshot, fence, journal):
+        s = BatchScheduler(
+            snapshot,
+            LoadAwareArgs(usage_thresholds={}),
+            batch_bucket=MAX_BATCH,
+            chaos=chaos,
+            journal=journal,
+            fence=fence,
+        )
+        s.extender.monitor.stop_background()
+        chaos.bind_counter(s.extender.registry.get("fault_injected_total"))
+        return s
+
+    incs: list = []
+    topo_ctrl = TopologyController(
+        fabric,
+        slo=slo,
+        incarnations=lambda: [i for i in incs if not i.dead],
+        node_names=lambda: list(node_names),
+        chaos=chaos,
+        lifecycle=lifecycle,
+    )
+    brownout = BrownoutController(
+        slo=slo,
+        shards=lambda: fabric.shard_map.active_shards(),
+        thresholds=(1.0, 2.0, 4.0, 8.0),
+        sustain=2,
+        cooldown=3,
+        clock=_clock,
+        topology=topo_ctrl,
+    )
+    admission = AdmissionController(
+        OverloadConfig(
+            band_budget={
+                PriorityClass.BATCH: 3 * MAX_BATCH,
+                PriorityClass.FREE: MAX_BATCH,
+            },
+            band_age_limit_s={
+                PriorityClass.BATCH: 10.0,
+                PriorityClass.FREE: 4.0,
+            },
+        ),
+        brownout=brownout,
+        lifecycle=lifecycle,
+        clock=_clock,
+    )
+
+    def _make_incarnation(idx: int) -> ShardedScheduler:
+        inc = ShardedScheduler(
+            f"ov{idx}",
+            hub,
+            fabric,
+            make_scheduler,
+            pipelined=True,
+            pipeline_depth=2,
+            max_batch=MAX_BATCH,
+            max_retries=8,
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            retry_period=0.5,
+            chaos=chaos,
+            lifecycle=lifecycle,
+            slo=slo,
+            overload=admission,
+            flight_capacity=64,
+        )
+        fabric.membership.heartbeat(inc.name)
+        return inc
+
+    incs.extend(_make_incarnation(i) for i in range(incarnations))
+    router = ShardRouter(
+        fabric.shard_map,
+        lifecycle=lifecycle,
+        burn_of=topo_ctrl.shard_burn,
+        brownout=brownout,
+    )
+
+    # the channel mirror: a loopback gRPC sidecar the driver syncs its
+    # placed/completed world into — through the breaker. During the
+    # channel brownout the breaker trips and sync attempts FAIL FAST;
+    # the driver accumulates the un-mirrored state and flushes it as
+    # one delta when the half-open probe recloses the breaker.
+    service = SolverService(ClusterSnapshot())
+    service.scheduler.extender.monitor.stop_background()
+    server, port = serve(service)
+    breaker = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=_clock)
+    client = SolverClient(
+        f"127.0.0.1:{port}", timeout_s=5.0, chaos=chaos, breaker=breaker
+    )
+    cfg = ClusterSnapshot().config
+
+    def _vec(rl):
+        return pb.ResourceVector(
+            values=[float(x) for x in cfg.res_vector(rl)]
+        )
+
+    mirror_rev = 0
+    mirror_nodes_sent = False
+    pending_assumes: dict = {}   # uid -> node, not yet mirrored
+    pending_forgets: list = []
+
+    def _mirror_sync():
+        nonlocal mirror_rev, mirror_nodes_sent, pending_assumes
+        nonlocal pending_forgets
+        delta = pb.SnapshotDelta(
+            revision=mirror_rev + 1, now=float(sim_cycle[0])
+        )
+        if not mirror_nodes_sent:
+            for name in node_names:
+                delta.node_upserts.add(
+                    name=name,
+                    allocatable=_vec(
+                        {ext.RES_CPU: ALLOC_CPU, ext.RES_MEMORY: ALLOC_MEM}
+                    ),
+                )
+        for uid, node in sorted(pending_assumes.items()):
+            delta.pod_assumed.add(
+                uid=uid,
+                node=node,
+                requests=_vec(
+                    {ext.RES_CPU: POD_CPU, ext.RES_MEMORY: POD_MEM}
+                ),
+            )
+        for uid in pending_forgets:
+            delta.pod_forgotten.append(uid)
+        try:
+            ack = client.sync(delta)
+        except ChannelBreakerOpen:
+            stats["breaker_fast_fails"] += 1
+            return
+        except ChannelError:
+            stats["channel_failures"] += 1
+            return
+        assert not ack.resync_required, "accumulated deltas never gap"
+        mirror_rev = ack.applied_revision
+        mirror_nodes_sent = True
+        pending_assumes = {}
+        del pending_forgets[:]
+        stats["mirror_syncs"] += 1
+
+    stats = {
+        "cycles": 0,
+        "arrived": 0,
+        "placed": 0,
+        "completed": 0,
+        "shed_terminal": 0,
+        "tickets_redeemed": 0,
+        "mirror_syncs": 0,
+        "channel_failures": 0,
+        "breaker_fast_fails": 0,
+        "splits": 0,
+        "faults": {},
+    }
+    placed: dict = {}
+    pod_by_uid: dict = {}
+    live: list = []
+    pending: list = []
+    pending_handoff: list = []
+    held_tickets: list = []   # shed tickets awaiting post-storm triage
+    shed_final: dict = {}     # uid -> ticket, terminally shed
+    redeemed: set = set()
+    pod_seq = 0
+    storm_lo = max(4, cycles // 4)
+    storm_hi = storm_lo + max(6, cycles // 4)
+    split_cycle = storm_lo + max(2, cycles // 8)
+    #: deterministic QoS mix by sequence number: 3 PROD, 2 MID, 3 BATCH,
+    #: 2 FREE per 10 arrivals
+    BAND_PRIO = (9000, 9000, 9000, 7500, 7500, 5500, 5500, 5500, 3500, 3500)
+
+    def _owner_of(shard: int):
+        for inc in incs:
+            if not inc.dead and inc.owns(shard):
+                return inc
+        return None
+
+    def _place(pod, node, shard):
+        assert pod.meta.uid not in placed, (
+            f"pod {pod.meta.name} placed twice: "
+            f"{placed[pod.meta.uid]} then {node} (shard {shard})"
+        )
+        assert fabric.shard_map.cell_covers(shard, node), (
+            f"{pod.meta.name} bound on {node} by shard {shard}"
+        )
+        placed[pod.meta.uid] = node
+        pod.spec.node_name = node
+        hub.publish(hub.pods, pod)
+        live.append((pod, node, sim_cycle[0] + LIFETIME))
+        pending_assumes[pod.meta.uid] = node
+        stats["placed"] += 1
+
+    def _absorb_decided(decided):
+        for shard, pod, node, _lat in decided:
+            if node is not None:
+                _place(pod, node, shard)
+            else:
+                pending.append(pod)
+
+    def _absorb_handoffs(handoffs):
+        for shard, hand in sorted(handoffs.items()):
+            for pod, node, _lat in hand.decided:
+                if node is not None:
+                    _place(pod, node, shard)
+                else:
+                    pending.append(pod)
+            for pod, arr, tries in hand.queued:
+                pending_handoff.append((shard, pod, arr, tries))
+
+    def _triage_tickets():
+        """Post-storm ticket redemption: BATCH tickets are resubmitted
+        (the driver's retry — their timelines bridge the shed with a
+        fresh enqueue); FREE tickets stay terminally shed. Redemption
+        waits for the ladder to drop below L3 — resubmitting into a
+        still-deferring fleet would just shed the same pods again."""
+        held_tickets.extend(admission.take_tickets())
+        if (
+            sim_cycle[0] < storm_hi
+            or brownout.level >= BrownoutController.L3
+        ):
+            return
+        keep = []
+        budget = 2 * MAX_BATCH  # paced: a retry stampede would just
+        for t in held_tickets:  # re-burn the queue-age budget
+            uid = t.pod.meta.uid
+            if uid in placed:
+                # a fanned/requeued copy already placed — not terminal
+                continue
+            if t.band == PriorityClass.BATCH and budget > 0:
+                budget -= 1
+                redeemed.add(uid)
+                pending.append(t.pod)
+                stats["tickets_redeemed"] += 1
+            elif t.band == PriorityClass.BATCH:
+                keep.append(t)
+            else:
+                shed_final[uid] = t
+        held_tickets[:] = keep
+
+    level_trace: list = []
+    total_cycles = cycles + drain_limit
+    for cycle in range(total_cycles):
+        sim_cycle[0] = cycle
+        stats["cycles"] += 1
+
+        # ---- the storm schedule (fixed cycles: deterministic trace) ----
+        if cycle == storm_lo:
+            # channel brownout for the storm's duration: every channel
+            # attempt fails at the transport until the schedule runs
+            # out — the breaker must trip and meter the probes
+            chaos.arm("channel.breaker_storm", times=5)
+        if cycle == split_cycle:
+            target = topo_ctrl.pick_split_candidate()
+            if target is not None:
+                out = topo_ctrl.split(target, cycle=cycle)
+                assert out is not None, "mid-storm split failed"
+                stats["splits"] += 1
+
+        # ---- arrivals (QoS-mixed; storm window multiplies) ----
+        arriving = []
+        if cycle < cycles:
+            n_arr = rng.randint(max(1, base_arrivals - 1), base_arrivals + 1)
+            if storm_lo <= cycle < storm_hi:
+                n_arr *= storm_mult
+            for _ in range(n_arr):
+                pod_seq += 1
+                pod = Pod(
+                    meta=ObjectMeta(name=f"storm-{pod_seq:05d}"),
+                    spec=PodSpec(
+                        requests={
+                            ext.RES_CPU: POD_CPU,
+                            ext.RES_MEMORY: POD_MEM,
+                        },
+                        priority=BAND_PRIO[pod_seq % len(BAND_PRIO)],
+                    ),
+                )
+                arriving.append(pod)
+                pod_by_uid[pod.meta.uid] = pod
+            stats["arrived"] += len(arriving)
+        pending.extend(arriving)
+
+        # ---- election + handoffs ----
+        for inc in incs:
+            if not inc.dead:
+                _absorb_handoffs(inc.tick())
+
+        # ---- routing + submit (admission verdicts inside the streams) --
+        still = []
+        for shard, pod, arr, tries in pending_handoff:
+            if not fabric.shard_map.is_active(shard):
+                shard = router.route(pod)
+            owner = _owner_of(shard)
+            if owner is None or not owner.resubmit(shard, pod, arr, tries):
+                still.append((shard, pod, arr, tries))
+        pending_handoff = still
+        still = []
+        for pod in pending:
+            if pod.meta.uid in placed:
+                continue
+            shard = router.route(pod)
+            owner = _owner_of(shard)
+            if owner is None or not owner.submit(
+                shard, pod, now=float(cycle)
+            ):
+                still.append(pod)
+        pending = still
+
+        # ---- pump every owned shard ----
+        for inc in incs:
+            if not inc.dead:
+                _absorb_decided(inc.pump())
+
+        # ---- completions free capacity ----
+        stillliving = []
+        for pod, node, done in live:
+            if done <= cycle:
+                hub.delete(hub.pods, pod)
+                fabric.claims.release(pod.meta.uid)
+                pending_forgets.append(pod.meta.uid)
+                stats["completed"] += 1
+            else:
+                stillliving.append((pod, node, done))
+        live = stillliving
+        assert hub.wait_synced()
+
+        # ---- channel mirror + ladder tick + ticket triage ----
+        if pending_assumes or pending_forgets or not mirror_nodes_sent:
+            _mirror_sync()
+        brownout.tick(cycle)
+        level_trace.append(brownout.level)
+        _triage_tickets()
+
+        if verbose and cycle % 5 == 0:
+            backlogs = {
+                s: _owner_of(s).backlog(s)
+                for s in fabric.shard_map.active_shards()
+                if _owner_of(s)
+            }
+            print(
+                f"cycle={cycle:3d} L{brownout.level} "
+                f"pending={len(pending):4d} backlogs={backlogs} "
+                f"placed={stats['placed']} shed={admission.shed_total()} "
+                f"breaker={breaker.state_name}"
+            )
+
+        if (
+            cycle >= cycles
+            and not pending
+            and not pending_handoff
+            and not held_tickets
+            # the soak's contract includes RECOVERY: keep ticking until
+            # the ladder has walked all the way back down (the burn
+            # horizons guarantee it decays once the world is idle)
+            and brownout.level == BrownoutController.L0
+            and all(
+                _owner_of(s) is None
+                or (
+                    _owner_of(s).backlog(s) == 0
+                    and _owner_of(s)
+                    .runtime(s)
+                    .stream.deferred_backlog()
+                    == 0
+                )
+                for s in fabric.shard_map.active_shards()
+            )
+        ):
+            break
+
+    # ---- drain the pipeline tails ----
+    for inc in incs:
+        if not inc.dead:
+            _absorb_decided(inc.flush())
+    for _ in range(drain_limit):
+        if not pending and not pending_handoff:
+            break
+        sim_cycle[0] += 1
+        for inc in incs:
+            if not inc.dead:
+                _absorb_handoffs(inc.tick())
+        still = []
+        for pod in pending:
+            if pod.meta.uid in placed:
+                continue
+            shard = router.route(pod)
+            owner = _owner_of(shard)
+            if owner is None or not owner.submit(
+                shard, pod, now=float(sim_cycle[0])
+            ):
+                still.append(pod)
+        pending = still
+        still = []
+        for shard, pod, arr, tries in pending_handoff:
+            if not fabric.shard_map.is_active(shard):
+                shard = router.route(pod)
+            owner = _owner_of(shard)
+            if owner is None or not owner.resubmit(shard, pod, arr, tries):
+                still.append((shard, pod, arr, tries))
+        pending_handoff = still
+        for inc in incs:
+            if not inc.dead:
+                _absorb_decided(inc.pump())
+        stillliving = []
+        for pod, node, done in live:
+            if done <= sim_cycle[0]:
+                hub.delete(hub.pods, pod)
+                fabric.claims.release(pod.meta.uid)
+                pending_forgets.append(pod.meta.uid)
+                stats["completed"] += 1
+            else:
+                stillliving.append((pod, node, done))
+        live = stillliving
+        assert hub.wait_synced()
+        _triage_tickets()
+    for inc in incs:
+        if not inc.dead:
+            _absorb_decided(inc.flush())
+    _triage_tickets()
+    for t in held_tickets:
+        if t.pod.meta.uid not in placed:
+            shed_final[t.pod.meta.uid] = t
+
+    # ---- the storm's verdicts ----
+    stats["shed_terminal"] = len(shed_final)
+    # every pod is accounted for exactly once: placed or terminally shed
+    assert not pending and not pending_handoff, (
+        f"{len(pending)}/{len(pending_handoff)} pods lost in the storm"
+    )
+    accounted = set(placed) | set(shed_final)
+    assert len(placed) + len(shed_final) == stats["arrived"], (
+        f"arrived {stats['arrived']} != placed {len(placed)} + "
+        f"shed {len(shed_final)}"
+    )
+    assert accounted == set(pod_by_uid), "a pod vanished unaccounted"
+    # PROD/MID are NEVER shed — the QoS contract under storm
+    from koordinator_tpu.api.extension import PriorityClass as _PC
+
+    assert set(admission.shed_counts) <= {
+        int(_PC.BATCH), int(_PC.FREE)
+    }, f"PROD/MID shed: {admission.shed_counts}"
+    for t in shed_final.values():
+        assert t.band in (_PC.BATCH, _PC.FREE)
+    assert admission.shed_total() > 0, (
+        "the storm never engaged admission shedding"
+    )
+    assert stats["tickets_redeemed"] > 0, (
+        "no BATCH resubmit ticket was redeemed post-storm"
+    )
+    # gap-free timelines: placed pods end at ack (shed pods that were
+    # redeemed bridge shed→resubmit/enqueue inside the same story);
+    # terminally shed pods end at shed
+    bad = []
+    for uid in placed:
+        problems = validate_timeline(lifecycle.timeline(uid))
+        if problems:
+            bad.append((pod_by_uid[uid].meta.name, problems))
+    for uid in shed_final:
+        evs = lifecycle.timeline(uid)
+        problems = validate_timeline(evs)
+        if evs[-1].stage != "shed":
+            problems.append(f"terminally shed pod ends at {evs[-1].stage}")
+        if problems:
+            bad.append((pod_by_uid[uid].meta.name, problems))
+    assert not bad, (
+        f"{len(bad)} gap-ful storm timelines; first 3: {bad[:3]}"
+    )
+    # the ladder: engaged by the storm, monotonic ±1, bounded, recovered
+    transitions = brownout.transitions()
+    assert all(
+        abs(t["to"] - t["from"]) == 1 for t in transitions
+    ), f"non-monotonic ladder transition: {transitions}"
+    peak = max(level_trace)
+    assert peak >= BrownoutController.L3, (
+        f"storm never drove the ladder past L2 (peak L{peak}; "
+        f"trace {level_trace})"
+    )
+    assert len(transitions) <= 2 * peak + 4, (
+        f"ladder flapped: {len(transitions)} transitions for peak "
+        f"L{peak}: {transitions}"
+    )
+    assert brownout.level == BrownoutController.L0, (
+        f"ladder never recovered post-storm (final L{brownout.level}; "
+        f"trace {level_trace})"
+    )
+    assert brownout.stats["deescalations"] >= 1
+    # the breaker: tripped by the channel brownout, failed fast, and
+    # reclosed via the half-open probe; the mirror then caught up by
+    # one accumulated flush
+    assert breaker.stats["trips"] >= 1, "channel storm never tripped"
+    assert stats["breaker_fast_fails"] >= 1, (
+        "an open breaker never failed a sync fast"
+    )
+    assert breaker.state == CircuitBreaker.CLOSED, breaker.report()
+    if pending_assumes or pending_forgets:
+        _mirror_sync()
+    assert not pending_assumes and not pending_forgets
+    with service._lock:
+        mirrored = set(service.snapshot._assumed)
+    assert mirrored == {p.meta.uid for p, _n, _d in live}, (
+        "mirror diverged from the live set after breaker recovery"
+    )
+    # the mid-storm split really happened under load
+    assert stats["splits"] == 1 and fabric.topology.generation >= 1
+    stats["shed_counts"] = {
+        _PC(b).name: n for b, n in sorted(admission.shed_counts.items())
+    }
+    stats["deferred_total"] = admission.deferred_total
+    stats["brownout"] = {
+        "peak": peak,
+        "final": brownout.level,
+        "transitions": transitions,
+        "stats": dict(brownout.stats),
+    }
+    stats["breaker"] = breaker.report()
+    stats["level_trace"] = level_trace
+    stats["faults"] = chaos.fired_counts()
+    stats["fault_trace"] = list(chaos.trace)
+    chaos.disarm()
+    for inc in incs:
+        if not inc.dead:
+            inc.close()
+    client.close()
+    server.stop(None)
     hub.stop()
     return stats

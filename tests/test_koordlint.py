@@ -84,7 +84,7 @@ def test_cli_exits_zero_on_tree(capsys):
     rc = cli_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "0 finding(s)" in out and "9 passes" in out
+    assert "0 finding(s)" in out and "10 passes" in out
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +223,18 @@ FIXTURES = {
             """,
         },
         "GT001",
+    ),
+    "shed-paths": (
+        {
+            # the declared canonical shed site drops the pod silently:
+            # no shed lifecycle event, no metric, no delegation
+            "koordinator_tpu/runtime/overload.py": """
+            class AdmissionController:
+                def shed(self, pod, shard, arrival, detail=""):
+                    return None
+            """,
+        },
+        "SP001",
     ),
 }
 

@@ -216,3 +216,77 @@ class TestDevprofDisabledMode:
         text = s.extender.services.dispatch("GET", "/metrics")[1]
         assert "solver_compiles_total" not in text
         assert "solver_device_bytes" not in text
+
+
+class TestOverloadDisabledMode:
+    """Overload-control PR (PR 7 standing-rule discipline): with no
+    AdmissionController/BrownoutController wired, every hot-path site
+    is ONE attribute-is-None check — no band accounting, no sweep, no
+    deferred queue, no ladder reads."""
+
+    def test_hot_path_sites_guard_on_attribute_is_none(self):
+        import inspect
+
+        from koordinator_tpu.scheduler import (
+            batch_solver,
+            pipeline,
+            stream,
+        )
+
+        src = inspect.getsource(stream)
+        # the band accounting helper and the sweep both bail on the one
+        # attribute check; submit reads it into a local once
+        assert src.count("if self.overload is None") >= 1
+        assert src.count("ov = self.overload") >= 2
+        assert "if ov is None or not self._deferred" in src
+        # the scheduler's bucket degrade and the pipeline's depth cap /
+        # serial gate read `brownout` into a local and branch on is-None
+        bs = inspect.getsource(batch_solver)
+        assert "bo = self.brownout" in bs
+        pl = inspect.getsource(pipeline)
+        assert pl.count("bo = sched.brownout") >= 2
+        assert pl.count("if bo is not None") >= 1
+
+    def test_stream_without_overload_does_no_band_accounting(self):
+        from koordinator_tpu.api import extension as ext
+        from koordinator_tpu.api.types import (
+            Node,
+            NodeStatus,
+            ObjectMeta,
+            Pod,
+            PodSpec,
+        )
+        from koordinator_tpu.scheduler.batch_solver import (
+            BatchScheduler,
+            LoadAwareArgs,
+        )
+        from koordinator_tpu.scheduler.stream import StreamScheduler
+
+        s = BatchScheduler(args=LoadAwareArgs(usage_thresholds={}))
+        s.extender.monitor.stop_background()
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name="n0"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: 32000.0, ext.RES_MEMORY: 1e9}
+                ),
+            )
+        )
+        st = StreamScheduler(s)
+        assert st.overload is None and s.brownout is None
+        verdict = st.submit(
+            Pod(
+                meta=ObjectMeta(name="p", uid="p"),
+                spec=PodSpec(
+                    requests={ext.RES_CPU: 1000.0, ext.RES_MEMORY: 1e6},
+                    priority=3500,  # FREE — still always admitted
+                ),
+            )
+        )
+        assert verdict == "admit"
+        assert st._band_live == {} and st.deferred_backlog() == 0
+        out = st.pump()
+        assert len(out) == 1 and out[0][1] is not None
+        reg = s.extender.registry
+        assert reg.get("overload_shed_total").value(band="FREE") == 0.0
+        assert reg.get("brownout_level").value() == 0.0

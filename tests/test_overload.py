@@ -1,0 +1,939 @@
+"""QoS-differentiated overload control (brownout PR).
+
+Covers the three tentpole mechanisms and their wiring:
+
+* bounded, QoS-aware admission at ``StreamScheduler.submit`` — PROD/MID
+  always admitted, BATCH/FREE deferred past their band budget and SHED
+  (terminal lifecycle event + metric + resubmit ticket) once the age
+  limit passes too; deferred pods promote when pressure clears, ride
+  handoffs, and are promoted unconditionally by a terminal flush;
+* the ``BrownoutController`` ladder — monotonic ±1 transitions under
+  sustain/cooldown hysteresis, per-level policy (pipeline depth cap,
+  serial gate, bucket degrade, defers/sheds), topology yield, flight-
+  recorder journaling, ``/healthz`` row and ``/debug/brownout``;
+* the ``CircuitBreaker`` on ``SolverClient`` — K consecutive failures
+  open it, calls fail FAST (``ChannelBreakerOpen``), the half-open
+  probe recloses, the ``channel.breaker_storm`` chaos point trips it
+  deterministically;
+
+plus the satellites: burn/brownout-aware router spill, the
+``shed``-terminal ``validate_timeline`` arm, the storm-shaped lifecycle
+eviction regression, ``ClaimTable.void_claims``, and the SLO burn
+time-horizon/evidence-floor semantics the ladder leans on.
+"""
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.extension import PriorityClass
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.obs.lifecycle import (
+    LifecycleEvent,
+    PodLifecycle,
+    validate_timeline,
+)
+from koordinator_tpu.obs.slo import SloTarget, SloTracker
+from koordinator_tpu.runtime.overload import (
+    AdmissionController,
+    BrownoutController,
+    CircuitBreaker,
+    OverloadConfig,
+)
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.stream import StreamScheduler
+
+ALLOC = {ext.RES_CPU: 32_000.0, ext.RES_MEMORY: 128 * 1024.0}
+REQ = {ext.RES_CPU: 1_000.0, ext.RES_MEMORY: 2_048.0}
+
+PRIO = {
+    PriorityClass.PROD: 9000,
+    PriorityClass.MID: 7500,
+    PriorityClass.BATCH: 5500,
+    PriorityClass.FREE: 3500,
+}
+
+
+def _pod(name: str, band: PriorityClass = PriorityClass.PROD) -> Pod:
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name),
+        spec=PodSpec(requests=dict(REQ), priority=PRIO[band]),
+    )
+
+
+def _sched(n_nodes: int = 4) -> BatchScheduler:
+    s = BatchScheduler(
+        args=LoadAwareArgs(usage_thresholds={}), batch_bucket=16
+    )
+    s.extender.monitor.stop_background()
+    for i in range(n_nodes):
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(allocatable=dict(ALLOC)),
+            )
+        )
+    return s
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _admission(
+    clock,
+    budget_batch=2,
+    budget_free=1,
+    age_batch=5.0,
+    age_free=2.0,
+    brownout=None,
+    lifecycle=None,
+    registry=None,
+):
+    return AdmissionController(
+        OverloadConfig(
+            band_budget={
+                PriorityClass.BATCH: budget_batch,
+                PriorityClass.FREE: budget_free,
+            },
+            band_age_limit_s={
+                PriorityClass.BATCH: age_batch,
+                PriorityClass.FREE: age_free,
+            },
+        ),
+        brownout=brownout,
+        lifecycle=lifecycle,
+        clock=clock,
+    )
+
+
+# ---------------------------------------------------------------------------
+# bounded, QoS-aware admission
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_prod_and_mid_always_admitted(self):
+        clock = _Clock()
+        ov = _admission(clock, budget_batch=0, budget_free=0)
+        for band in (PriorityClass.PROD, PriorityClass.MID):
+            assert ov.admit(_pod("p", band), band_depth=10**6) == ov.ADMIT
+
+    def test_batch_defers_past_budget_then_age_sheds(self):
+        clock = _Clock()
+        lc = PodLifecycle(clock=clock)
+        ov = _admission(clock, lifecycle=lc)
+        sched = _sched()
+        st = StreamScheduler(
+            sched, max_batch=16, overload=ov, lifecycle=lc
+        )
+        # budget 2: two BATCH pods admit, the third defers
+        assert st.submit(_pod("b0", PriorityClass.BATCH), now=0.0) == "admit"
+        assert st.submit(_pod("b1", PriorityClass.BATCH), now=0.0) == "admit"
+        assert st.submit(_pod("b2", PriorityClass.BATCH), now=0.0) == "defer"
+        assert st.backlog() == 2 and st.deferred_backlog() == 1
+        # pumping drains the live queue; the deferred pod PROMOTES once
+        # its band is back under budget — original stamp intact
+        out = st.pump()
+        assert {p.meta.uid for p, n, _l in out if n} == {"b0", "b1"}
+        out = st.pump()
+        assert [p.meta.uid for p, n, _l in out if n] == ["b2"]
+        assert st.deferred_backlog() == 0
+        evs = [e.stage for e in lc.timeline("b2")]
+        assert evs[-1] == "ack"
+        # deferral + promotion both recorded as enqueue events
+        assert evs.count("enqueue") == 2
+
+    def test_deferred_pod_ages_out_to_terminal_shed_with_ticket(self):
+        clock = _Clock()
+        lc = PodLifecycle(clock=clock)
+        ov = _admission(clock, budget_batch=1, age_batch=3.0, lifecycle=lc)
+        sched = _sched(n_nodes=1)
+        st = StreamScheduler(sched, max_batch=1, overload=ov)
+        # b0 occupies the band budget FOREVER (max_batch=1 and a PROD
+        # stream ahead of it keeps the band full by re-submitting)
+        assert st.submit(_pod("b0", PriorityClass.BATCH), now=0.0) == "admit"
+        assert st.submit(_pod("b1", PriorityClass.BATCH), now=0.0) == "defer"
+        # keep the band AT budget by refilling as pumps drain it; b1's
+        # age crosses the limit while still unpromotable
+        for i in range(6):
+            clock.t = float(i)
+            st.pump()
+            if st._band_live.get(int(PriorityClass.BATCH), 0) == 0:
+                st.submit(
+                    _pod(f"fill{i}", PriorityClass.BATCH), now=clock.t
+                )
+        clock.t = 10.0
+        st.pump()
+        tickets = ov.take_tickets()
+        assert [t.pod.meta.uid for t in tickets] == ["b1"]
+        t = tickets[0]
+        assert t.band == PriorityClass.BATCH and t.arrival == 0.0
+        assert t.reason == "overload_shed"
+        evs = lc.timeline("b1")
+        assert evs[-1].stage == "shed"
+        assert validate_timeline(evs) == []
+        assert ov.shed_counts == {int(PriorityClass.BATCH): 1}
+
+    def test_shed_metric_counts_per_band(self):
+        clock = _Clock()
+        sched = _sched()
+        ov = _admission(clock, registry=None)
+        st = StreamScheduler(sched, overload=ov)
+        reg = sched.extender.registry
+        # L4 brownout sheds FREE at submit
+        bo = BrownoutController(clock=clock)
+        bo.level = BrownoutController.L4
+        ov.brownout = bo
+        assert st.submit(_pod("f0", PriorityClass.FREE), now=0.0) == "shed"
+        assert (
+            reg.get("overload_shed_total").value(band="FREE") == 1.0
+        )
+
+    def test_extract_queued_includes_deferred_and_resets_bands(self):
+        clock = _Clock()
+        ov = _admission(clock, budget_batch=1)
+        st = StreamScheduler(_sched(), overload=ov)
+        st.submit(_pod("b0", PriorityClass.BATCH), now=0.0)
+        st.submit(_pod("b1", PriorityClass.BATCH), now=1.0)
+        st.submit(_pod("p0", PriorityClass.PROD), now=2.0)
+        out = st.extract_queued()
+        assert {p.meta.uid for p, _a, _t in out} == {"b0", "b1", "p0"}
+        # stamps ride along; band accounting reset for the next owner
+        assert {a for _p, a, _t in out} == {0.0, 1.0, 2.0}
+        assert st.backlog() == 0 and st.deferred_backlog() == 0
+        assert st._band_live == {}
+
+    def test_flush_promotes_deferred_unconditionally(self):
+        clock = _Clock()
+        ov = _admission(clock, budget_batch=1)
+        st = StreamScheduler(_sched(), overload=ov)
+        st.submit(_pod("b0", PriorityClass.BATCH), now=0.0)
+        assert st.submit(_pod("b1", PriorityClass.BATCH), now=0.0) == "defer"
+        out = st.flush()
+        assert {p.meta.uid for p, n, _l in out if n} == {"b0", "b1"}
+
+    def test_band_accounting_matches_queue_contents(self):
+        clock = _Clock()
+        ov = _admission(clock, budget_batch=3, budget_free=2)
+        st = StreamScheduler(_sched(), max_batch=4, overload=ov)
+        for i in range(3):
+            st.submit(_pod(f"b{i}", PriorityClass.BATCH), now=0.0)
+        for i in range(2):
+            st.submit(_pod(f"f{i}", PriorityClass.FREE), now=0.0)
+        st.submit(_pod("p0", PriorityClass.PROD), now=0.0)
+        st.pump()
+        st.flush()
+
+        def _recount():
+            counts = {}
+            for p, _a, _t in st._queue:
+                b = int(p.priority_class)
+                counts[b] = counts.get(b, 0) + 1
+            return counts
+
+        live = {b: n for b, n in st._band_live.items() if n}
+        assert live == _recount()
+
+
+# ---------------------------------------------------------------------------
+# the brownout ladder
+# ---------------------------------------------------------------------------
+
+
+class _BurnStub:
+    """SloTracker stand-in: a settable per-call burn."""
+
+    def __init__(self):
+        self.burn = 0.0
+
+    def burn_rate(self, shard, slo):
+        return self.burn
+
+
+class _TopoStub:
+    def __init__(self, can=True, cooling=False):
+        self.can = can
+        self.cooling = cooling
+
+    @property
+    def in_cooldown(self):
+        return self.cooling
+
+    def can_scale_out(self):
+        return self.can
+
+
+def _ladder(burn, sustain=2, cooldown=2, topology=None, clock=None):
+    return BrownoutController(
+        slo=burn,
+        shards=lambda: [0],
+        sustain=sustain,
+        cooldown=cooldown,
+        clock=clock or _Clock(),
+        topology=topology,
+    )
+
+
+class TestBrownoutLadder:
+    def test_escalates_one_step_per_sustain_and_deescalates_on_cooldown(self):
+        burn = _BurnStub()
+        bo = _ladder(burn)
+        burn.burn = 100.0  # target L4 immediately
+        levels = []
+        for _ in range(10):
+            bo.tick()
+            levels.append(bo.level)
+        # one step per `sustain` ticks — NEVER a jump
+        assert levels == [0, 1, 1, 2, 2, 3, 3, 4, 4, 4]
+        burn.burn = 0.0
+        down = []
+        for _ in range(10):
+            bo.tick()
+            down.append(bo.level)
+        assert down == [4, 3, 3, 2, 2, 1, 1, 0, 0, 0]
+        assert all(
+            abs(t["to"] - t["from"]) == 1 for t in bo.transitions()
+        )
+
+    def test_hysteresis_no_flap_on_oscillating_burn(self):
+        burn = _BurnStub()
+        bo = _ladder(burn, sustain=3, cooldown=3)
+        # burn oscillates across the L1 threshold every tick: neither
+        # streak ever reaches sustain/cooldown — zero transitions
+        for i in range(20):
+            burn.burn = 1.5 if i % 2 else 0.0
+            bo.tick()
+        assert bo.level == 0 and bo.transitions() == []
+
+    def test_yields_to_topology_split_boundedly(self):
+        burn = _BurnStub()
+        topo = _TopoStub(can=True, cooling=False)
+        bo = _ladder(burn, sustain=2, topology=topo)
+        burn.burn = 100.0
+        bo.tick()
+        bo.tick()  # sustain met — but the topology can still split
+        assert bo.level == 0 and bo.stats["yielded_to_split"] == 1
+        bo.tick()  # yield budget (max_yield = sustain = 2) not yet spent
+        assert bo.level == 0 and bo.stats["yielded_to_split"] == 2
+        bo.tick()  # budget exhausted: brown out anyway
+        assert bo.level == 1
+        # during a transition cooldown there is NO yield
+        topo.cooling = True
+        bo2 = _ladder(burn, sustain=1, topology=topo)
+        bo2.tick()
+        assert bo2.level == 1 and bo2.stats["yielded_to_split"] == 0
+
+    def test_policy_accessors_per_level(self):
+        bo = _ladder(_BurnStub())
+        assert bo.pipeline_depth_cap() > 100
+        assert not bo.serial_only() and bo.bucket_degrade_steps() == 0
+        assert not bo.defers(PriorityClass.BATCH)
+        bo.level = BrownoutController.L1
+        assert bo.pipeline_depth_cap() == 1 and not bo.serial_only()
+        bo.level = BrownoutController.L2
+        assert bo.serial_only() and bo.bucket_degrade_steps() == 1
+        assert not bo.defers(PriorityClass.BATCH)
+        bo.level = BrownoutController.L3
+        assert bo.defers(PriorityClass.BATCH)
+        assert bo.defers(PriorityClass.FREE)
+        assert not bo.defers(PriorityClass.PROD)
+        assert not bo.sheds(PriorityClass.FREE)
+        bo.level = BrownoutController.L4
+        assert bo.sheds(PriorityClass.FREE)
+        assert not bo.sheds(PriorityClass.BATCH)
+
+    def test_thresholds_must_ascend(self):
+        with pytest.raises(ValueError):
+            BrownoutController(thresholds=(2.0, 1.0, 4.0, 8.0))
+        with pytest.raises(ValueError):
+            BrownoutController(thresholds=(1.0, 2.0, 4.0))
+
+    def test_transitions_journal_to_flight_recorder_and_health(self):
+        from koordinator_tpu.obs.flightrecorder import FlightRecorder
+        from koordinator_tpu.obs.health import HealthRegistry
+
+        burn = _BurnStub()
+        bo = _ladder(burn, sustain=1, cooldown=1)
+        fr = FlightRecorder(capacity=8)
+        health = HealthRegistry()
+        bo.attach_flight(fr)
+        bo.attach_health(health)
+        assert health.get("brownout")["ok"] is True
+        burn.burn = 1.5
+        bo.tick(cycle=7)
+        assert bo.level == 1
+        rec = fr.last(1)[0]
+        assert rec["cycle"] == 7
+        assert rec["brownout"] == {"from": 0, "to": 1, "burn": 1.5}
+        row = health.get("brownout")
+        assert row["ok"] is False and "L1" in row["detail"]
+
+    def test_debug_brownout_endpoint_and_gauge(self):
+        import json as _json
+
+        clock = _Clock()
+        burn = _BurnStub()
+        bo = BrownoutController(
+            slo=burn, shards=lambda: [0], sustain=1, clock=clock
+        )
+        ov = AdmissionController(brownout=bo, clock=clock)
+        sched = _sched()
+        StreamScheduler(sched, overload=ov)
+        services = sched.extender.services
+        code, body = services.dispatch("GET", "/debug/brownout")
+        assert code == 200
+        doc = _json.loads(body)
+        assert doc["level"] == 0 and doc["level_name"] == "L0"
+        reg = sched.extender.registry
+        assert reg.get("brownout_level").value() == 0.0
+        burn.burn = 3.0
+        bo.tick()
+        assert reg.get("brownout_level").value() == 1.0
+        assert (
+            reg.get("brownout_transitions_total").value(
+                direction="escalate"
+            )
+            == 1.0
+        )
+        doc = _json.loads(services.dispatch("GET", "/debug/brownout")[1])
+        assert doc["level"] == 1 and len(doc["transitions"]) == 1
+
+    def test_l2_closes_pipeline_gate_and_degrades_bucket(self):
+        clock = _Clock()
+        bo = BrownoutController(clock=clock)
+        ov = AdmissionController(brownout=bo, clock=clock)
+        sched = _sched()
+        st = StreamScheduler(
+            sched, max_batch=8, pipelined=True, pipeline_depth=2,
+            overload=ov,
+        )
+        try:
+            assert sched.brownout is bo
+            bucket0 = sched.effective_batch_bucket()
+            bo.level = BrownoutController.L2
+            assert sched.effective_batch_bucket() == max(16, bucket0 >> 1)
+            # the brownout gate keeps the cycle serial — and names itself
+            for i in range(3):
+                st.submit(_pod(f"p{i}"), now=float(i))
+            st.pump()
+            st.flush()
+            report = st._pipe.last_gate_report
+            assert report["gates"]["brownout"] is False
+            assert "brownout" in report["closed"]
+            bo.level = BrownoutController.L0
+            for i in range(3):
+                st.submit(_pod(f"q{i}"), now=float(i))
+            st.pump()
+            st.flush()
+            assert st._pipe.last_gate_report["gates"]["brownout"] is True
+        finally:
+            st.close()
+
+    def test_l1_caps_pipeline_depth_at_one(self):
+        clock = _Clock()
+        bo = BrownoutController(clock=clock)
+        ov = AdmissionController(brownout=bo, clock=clock)
+        sched = _sched()
+        st = StreamScheduler(
+            sched, max_batch=2, pipelined=True, pipeline_depth=2,
+            overload=ov,
+        )
+        try:
+            bo.level = BrownoutController.L1
+            # depth 2 would hold TWO fed batches before returning the
+            # first decision; the L1 cap forces the oldest trailing
+            # commit every feed — one-pump lag, like depth 1
+            st.submit(_pod("a0"), now=0.0)
+            assert st.pump() == []
+            st.submit(_pod("a1"), now=1.0)
+            out = st.pump()
+            assert [p.meta.uid for p, n, _l in out if n] == ["a0"]
+            assert len(st._pipe._pending) == 1
+        finally:
+            st.close()
+
+
+# ---------------------------------------------------------------------------
+# the circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trip_probe_reclose(self):
+        clock = _Clock()
+        b = CircuitBreaker(threshold=3, cooldown_s=10.0, clock=clock)
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == b.CLOSED and b.allow()
+        b.record_failure()
+        assert b.state == b.OPEN and not b.allow()
+        clock.t = 10.0
+        assert b.allow()  # the half-open probe
+        assert b.state == b.HALF_OPEN
+        assert not b.allow()  # only ONE probe at a time
+        b.record_success()
+        assert b.state == b.CLOSED and b.allow()
+        assert b.stats == {"trips": 1, "probes": 1, "closes": 1}
+
+    def test_probe_failure_reopens_with_fresh_window(self):
+        clock = _Clock()
+        b = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+        b.record_failure()
+        assert b.state == b.OPEN
+        clock.t = 5.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state == b.OPEN
+        clock.t = 9.0
+        assert not b.allow(), "the failed probe re-stamped the window"
+        clock.t = 10.0
+        assert b.allow()
+
+    def test_success_resets_consecutive_failures(self):
+        b = CircuitBreaker(threshold=3)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == b.CLOSED, "non-consecutive failures never trip"
+
+    def test_gauge_tracks_state(self):
+        from koordinator_tpu.scheduler.frameworkext import scheduler_registry
+
+        reg = scheduler_registry()
+        g = reg.get("solver_breaker_state")
+        clock = _Clock()
+        b = CircuitBreaker(threshold=1, cooldown_s=1.0, clock=clock, gauge=g)
+        assert g.value() == float(b.CLOSED)
+        b.record_failure()
+        assert g.value() == float(b.OPEN)
+        clock.t = 1.0
+        b.allow()
+        assert g.value() == float(b.HALF_OPEN)
+        b.record_success()
+        assert g.value() == float(b.CLOSED)
+
+
+class TestSolverClientBreaker:
+    def _serve(self):
+        from koordinator_tpu.core.snapshot import ClusterSnapshot
+        from koordinator_tpu.runtime.snapshot_channel import (
+            SolverService,
+            serve,
+        )
+
+        service = SolverService(ClusterSnapshot())
+        service.scheduler.extender.monitor.stop_background()
+        return serve(service)
+
+    def test_breaker_storm_trips_and_fails_fast_then_probe_heals(self):
+        from koordinator_tpu.chaos import FaultInjector
+        from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+        from koordinator_tpu.runtime.snapshot_channel import (
+            ChannelBreakerOpen,
+            ChannelUnavailable,
+            SolverClient,
+        )
+
+        server, port = self._serve()
+        clock = _Clock()
+        chaos = FaultInjector(seed=0)
+        breaker = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=clock)
+        client = SolverClient(
+            f"127.0.0.1:{port}", timeout_s=5.0, chaos=chaos,
+            breaker=breaker,
+        )
+        try:
+            assert client.sync(pb.SnapshotDelta()).applied_revision == 1
+            assert breaker.state == breaker.CLOSED
+            chaos.arm("channel.breaker_storm", times=2)
+            for _ in range(2):
+                with pytest.raises(ChannelUnavailable):
+                    client.sync(pb.SnapshotDelta())
+            assert breaker.state == breaker.OPEN
+            # fail FAST while open: no wire, no retry grind
+            with pytest.raises(ChannelBreakerOpen):
+                client.sync(pb.SnapshotDelta())
+            # cooldown admits ONE probe; the storm is over, it heals
+            clock.t = 5.0
+            ack = client.sync(pb.SnapshotDelta())
+            assert ack.applied_revision == 2
+            assert breaker.state == breaker.CLOSED
+            assert breaker.stats["trips"] == 1
+        finally:
+            client.close()
+            server.stop(None)
+
+    def test_breaker_open_is_not_retried_by_policy(self):
+        from koordinator_tpu.chaos import FaultInjector
+        from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+        from koordinator_tpu.runtime.snapshot_channel import (
+            ChannelBreakerOpen,
+            SolverClient,
+        )
+        from koordinator_tpu.utils.retry import RetryPolicy
+
+        server, port = self._serve()
+        clock = _Clock()
+        chaos = FaultInjector(seed=0)
+        breaker = CircuitBreaker(threshold=1, cooldown_s=99.0, clock=clock)
+        client = SolverClient(
+            f"127.0.0.1:{port}",
+            timeout_s=5.0,
+            chaos=chaos,
+            breaker=breaker,
+            retry=RetryPolicy(
+                max_attempts=4, base_delay_s=0.001, max_delay_s=0.002,
+                jitter=0.0,
+            ),
+        )
+        try:
+            chaos.arm("channel.breaker_storm", times=1)
+            # first attempt fails and trips (threshold 1); the retry
+            # policy's SECOND attempt hits the open breaker — which is
+            # NOT retryable, so the call surfaces it immediately
+            with pytest.raises(ChannelBreakerOpen):
+                client.sync(pb.SnapshotDelta())
+            assert breaker.stats["trips"] == 1
+        finally:
+            client.close()
+            server.stop(None)
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+
+class TestRouterOverloadAwareness:
+    def _router(self, **kw):
+        from koordinator_tpu.runtime.shards import ShardMap, ShardRouter
+
+        return ShardRouter(ShardMap(4), spill_backlog=10, **kw)
+
+    def test_burning_primary_spills_earlier(self):
+        burns = {0: 0.0, 1: 0.0, 2: 0.0, 3: 0.0}
+        r = self._router(burn_of=lambda s: burns[s])
+        pod = _pod("x")
+        primary = r.route(pod)
+        # below the raw threshold, healthy primary: no fan-out
+        assert r.targets(pod, backlog_of=lambda s: 6) == [primary]
+        # the same backlog on a BURNING primary fans out (engage point
+        # halves at burn > 1)
+        burns[primary] = 2.0
+        t = r.targets(pod, backlog_of=lambda s: 6)
+        assert len(t) == 2 and t[0] == primary
+
+    def test_browning_fleet_stops_fanning_out_sheddable_bands(self):
+        bo = BrownoutController(clock=_Clock())
+        r = self._router(brownout=bo)
+        batch = _pod("b", PriorityClass.BATCH)
+        prod = _pod("p", PriorityClass.PROD)
+        assert len(r.targets(batch, backlog_of=lambda s: 50)) == 2
+        bo.level = BrownoutController.L3
+        # BATCH would be deferred/shed at the spill shard — no claim
+        assert len(r.targets(batch, backlog_of=lambda s: 50)) == 1
+        # PROD still spills: it is never deferred
+        assert len(r.targets(prod, backlog_of=lambda s: 50)) == 2
+
+
+class TestValidateTimelineShedArm:
+    def _ev(self, stage, t, shard=0):
+        return LifecycleEvent(stage=stage, t=t, shard=shard)
+
+    def test_terminal_shed_is_valid(self):
+        evs = [
+            self._ev("submit", 0.0, -1),
+            self._ev("route", 0.0),
+            self._ev("enqueue", 1.0),
+            self._ev("shed", 5.0),
+        ]
+        assert validate_timeline(evs) == []
+
+    def test_progress_after_shed_without_bridge_is_a_gap(self):
+        evs = [
+            self._ev("submit", 0.0, -1),
+            self._ev("enqueue", 1.0),
+            self._ev("shed", 2.0),
+            self._ev("dispatch", 3.0),
+            self._ev("decide", 4.0),
+            self._ev("ack", 5.0),
+        ]
+        problems = validate_timeline(evs)
+        assert any("without" in p and "bridge" in p for p in problems)
+
+    def test_redeemed_ticket_bridges_shed(self):
+        evs = [
+            self._ev("submit", 0.0, -1),
+            self._ev("enqueue", 1.0),
+            self._ev("shed", 2.0),
+            self._ev("route", 6.0),
+            self._ev("enqueue", 6.0),
+            self._ev("dispatch", 7.0),
+            self._ev("decide", 8.0),
+            self._ev("ack", 8.0),
+        ]
+        assert validate_timeline(evs) == []
+
+    def test_shed_after_ack_is_a_problem(self):
+        evs = [
+            self._ev("submit", 0.0, -1),
+            self._ev("enqueue", 1.0),
+            self._ev("dispatch", 2.0),
+            self._ev("decide", 3.0),
+            self._ev("ack", 3.0),
+            self._ev("shed", 4.0),
+        ]
+        problems = validate_timeline(evs)
+        assert any("already-placed" in p for p in problems)
+
+
+class TestLifecycleStormEviction:
+    def test_storm_eviction_prefers_shed_timelines_over_open_ones(self):
+        """PR 7's eviction fallback, storm-shaped (satellite): a fleet
+        dominated by never-placed pods must evict SHED (completed)
+        timelines first — open stories survive, the bound holds."""
+        clock = _Clock()
+        lc = PodLifecycle(clock=clock, max_pods=40)
+        for i in range(20):
+            lc.submitted(f"open{i}")
+            lc.event(f"open{i}", "enqueue", shard=0)
+        for i in range(20):
+            lc.submitted(f"shed{i}")
+            lc.event(f"shed{i}", "shed", shard=0)
+        # the registry is full: the next arrivals evict — completed
+        # (shed) timelines go first, ALL open ones survive
+        for i in range(10):
+            lc.submitted(f"new{i}")
+        uids = set(lc.uids())
+        assert len(uids) <= 40, "max_pods bound leaked"
+        assert all(f"open{i}" in uids for i in range(20))
+        assert sum(1 for u in uids if u.startswith("shed")) < 20
+
+    def test_redeemed_shed_pod_leaves_the_completed_set(self):
+        lc = PodLifecycle(clock=_Clock())
+        lc.submitted("p")
+        lc.event("p", "shed", shard=0)
+        assert lc.is_done("p")
+        lc.event("p", "resubmit", shard=1)
+        assert not lc.is_done("p"), "a redeemed story is live again"
+        lc.event("p", "decide", shard=1, detail="n0")
+        lc.acked("p", 1, "n0")
+        assert lc.is_done("p")
+
+    def test_redeemed_pod_slo_clock_restarts_at_the_bridge(self):
+        clock = _Clock()
+        lc = PodLifecycle(clock=clock)
+        lc.submitted("p", t=0.0)
+        lc.event("p", "enqueue", shard=0, t=1.0)
+        lc.event("p", "shed", shard=0, t=10.0)
+        lc.event("p", "resubmit", shard=0, t=50.0)
+        lc.event("p", "decide", shard=0, t=52.0, detail="n0")
+        e2e = lc.acked("p", 0, "n0", t=53.0)
+        # anchored at the redemption bridge, not the pre-shed submit
+        assert e2e == pytest.approx(3.0)
+
+
+class TestClaimVoid:
+    def test_void_claims_drops_winner_without_tombstone(self):
+        from koordinator_tpu.core.journal import (
+            ClaimTable,
+            MemoryJournalStore,
+        )
+
+        store = MemoryJournalStore()
+        t = ClaimTable(store)
+        assert t.claim("u1", 2, 1)
+        t.void_claims(["u1", "unknown"])
+        assert t.winner("u1") is None
+        # NOT a tombstone: any shard may claim it afresh
+        assert t.claim("u1", 0, 1)
+        # the void is journaled: a reload replays the same state
+        t2 = ClaimTable(MemoryJournalStore())
+        assert t2.claim("a", 1, 1)
+        t2.void_claims(["a"])
+        reloaded = ClaimTable(store)
+        assert reloaded.winner("u1") == 0
+
+    def test_void_claims_noop_writes_no_record(self):
+        from koordinator_tpu.core.journal import (
+            ClaimTable,
+            MemoryJournalStore,
+        )
+
+        store = MemoryJournalStore()
+        t = ClaimTable(store)
+        before = len(store.load())
+        t.void_claims(["nobody"])
+        assert len(store.load()) == before
+
+
+class TestSloHorizons:
+    def test_max_age_excludes_stale_samples_from_burn(self):
+        clock = _Clock()
+        slo = SloTracker(
+            clock=clock,
+            targets=(
+                SloTarget(
+                    "p99_latency", threshold_s=1.0, budget=0.1,
+                    window=64, max_age_s=10.0,
+                ),
+            ),
+        )
+        for _ in range(10):
+            slo.observe_latency(0, 5.0)  # all violations at t=0
+        assert slo.burn_rate(0, "p99_latency") == pytest.approx(10.0)
+        clock.t = 20.0  # every sample is now past the horizon
+        assert slo.burn_rate(0, "p99_latency") == 0.0
+        slo.observe_latency(0, 0.1)  # one fresh OK sample
+        assert slo.burn_rate(0, "p99_latency") == 0.0
+        ev = slo.evaluate()["0"]["p99_latency"]
+        assert ev["burn_rate"] == 0.0 and ev["window_p99_s"] == 0.1
+
+    def test_min_samples_floor_suppresses_straggler_burn(self):
+        clock = _Clock()
+        slo = SloTracker(
+            clock=clock,
+            targets=(
+                SloTarget(
+                    "p99_latency", threshold_s=1.0, budget=0.1,
+                    window=64, min_samples=4,
+                ),
+            ),
+        )
+        slo.observe_latency(0, 99.0)
+        slo.observe_latency(0, 99.0)
+        assert slo.burn_rate(0, "p99_latency") == 0.0, (
+            "two stragglers are not evidence"
+        )
+        slo.observe_latency(0, 99.0)
+        slo.observe_latency(0, 99.0)
+        assert slo.burn_rate(0, "p99_latency") == pytest.approx(10.0)
+
+    def test_empty_queue_pump_samples_zero_age(self):
+        clock = _Clock()
+        slo = SloTracker(
+            clock=clock,
+            targets=(SloTarget("queue_age", threshold_s=1.0, budget=0.5),),
+        )
+        st = StreamScheduler(_sched(), slo=slo, shard=0)
+        st.pump()  # empty queue: still one (healthy) sample
+        ev = slo.evaluate()["0"]["queue_age"]
+        assert ev["samples"] == 1 and ev["last_s"] == 0.0
+
+
+class TestReviewHardening:
+    """Review-round fixes: probe-slot wedge, yield-budget renewal,
+    burn-stable spill hysteresis."""
+
+    def test_fenced_probe_does_not_wedge_the_breaker(self):
+        """A half-open probe that ends in a FENCING refusal (no channel
+        verdict) must release the probe slot — not leave the breaker
+        HALF_OPEN with its probe permanently in flight."""
+        from koordinator_tpu.chaos import FaultInjector
+        from koordinator_tpu.core.journal import EpochFence
+        from koordinator_tpu.core.snapshot import ClusterSnapshot
+        from koordinator_tpu.runtime.proto import snapshot_pb2 as pb
+        from koordinator_tpu.runtime.snapshot_channel import (
+            SolverClient,
+            SolverService,
+            serve,
+        )
+
+        service = SolverService(ClusterSnapshot())
+        service.scheduler.extender.monitor.stop_background()
+        server, port = serve(service)
+        clock = _Clock()
+        chaos = FaultInjector(seed=0)
+        breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+        fence = EpochFence()
+        client = SolverClient(
+            f"127.0.0.1:{port}", timeout_s=5.0, chaos=chaos,
+            breaker=breaker, fence=fence,
+        )
+        try:
+            from koordinator_tpu.core.journal import StaleEpochError
+
+            chaos.arm("channel.breaker_storm", times=1)
+            with pytest.raises(Exception):
+                client.sync(pb.SnapshotDelta())
+            assert breaker.state == breaker.OPEN
+            # depose the client, then let the cooldown admit a probe:
+            # the probe dies at the LOCAL fence — uncounted
+            fence.adopt(2)
+            client.set_epoch(1)
+            clock.t = 5.0
+            with pytest.raises(StaleEpochError):
+                client.sync(pb.SnapshotDelta())
+            assert breaker.state == breaker.HALF_OPEN
+            # the slot was released: a re-granted client can probe and
+            # heal instead of fast-failing forever
+            fence.adopt(3)
+            client.set_epoch(3)
+            ack = client.sync(pb.SnapshotDelta())
+            assert ack.applied_revision >= 1
+            assert breaker.state == breaker.CLOSED
+        finally:
+            client.close()
+            server.stop(None)
+
+    def test_yield_budget_renews_per_pressure_episode(self):
+        """A storm fully relieved by a topology split (no ladder
+        transition) must not consume the yield window for the NEXT
+        storm."""
+        burn = _BurnStub()
+        topo = _TopoStub(can=True, cooling=False)
+        bo = _ladder(burn, sustain=2, topology=topo)
+        burn.burn = 100.0
+        bo.tick()
+        bo.tick()  # yield 1
+        bo.tick()  # yield 2 — budget spent
+        assert bo.stats["yielded_to_split"] == 2 and bo.level == 0
+        burn.burn = 0.0  # the split relieved the pressure
+        bo.tick()
+        # storm 2: the budget renewed — the ladder yields again before
+        # degrading, instead of escalating on the first sustained tick
+        burn.burn = 100.0
+        bo.tick()
+        bo.tick()
+        assert bo.level == 0
+        assert bo.stats["yielded_to_split"] == 3
+
+    def test_spill_release_threshold_is_burn_stable(self):
+        """An oscillating burn signal must not move the RELEASE level
+        of the spill hysteresis band — engage may come early on a burn,
+        but disengage anchors at the burn floor, so a backlog sitting
+        inside the band never flaps claims."""
+        from koordinator_tpu.runtime.shards import ShardMap, ShardRouter
+
+        burns = {"v": 0.0}
+        r = ShardRouter(
+            ShardMap(4),
+            spill_backlog=8,
+            burn_of=lambda s: burns["v"],
+            burn_spill_frac=0.5,
+            spill_resume_frac=0.5,
+        )
+        pod = _pod("x")
+        primary = r.route(pod)
+        burns["v"] = 2.0
+        assert len(r.targets(pod, backlog_of=lambda s: 4)) == 2  # engaged
+        flips = 0
+        engaged = True
+        # backlog holds at 3 (inside [floor*resume=2, engage=4..8]) while
+        # the burn saws across 1.0 — the band must hold
+        for i in range(12):
+            burns["v"] = 2.0 if i % 2 else 0.0
+            now = len(r.targets(pod, backlog_of=lambda s: 3)) == 2
+            if now != engaged:
+                flips += 1
+                engaged = now
+        assert flips == 0
+        # a genuinely drained backlog still releases
+        burns["v"] = 0.0
+        assert len(r.targets(pod, backlog_of=lambda s: 1)) == 1

@@ -10,4 +10,5 @@ from . import (  # noqa: F401 — registration side effects
     guarded_by,
     reject_reasons,
     retrace_hazard,
+    shed_paths,
 )

@@ -60,6 +60,12 @@ EXEMPT: Dict[str, str] = {
         "solve's node axis per cycle — the chain carries the full axis "
         "only"
     ),
+    "brownout": (
+        "policy gate, not a carry gap: the brownout ladder (L2+) "
+        "forces the serial path while the fleet sheds load — "
+        "decision-identical by construction, and the ladder's own "
+        "tests cover the gate flipping with the level"
+    ),
 }
 
 

@@ -65,6 +65,13 @@ EXEMPT: Dict[str, str] = {
         "journal boundary: intent/bind append refused — "
         "journal-before-mutate rejects the chunk un-mutated"
     ),
+    "OVERLOAD_SHED": (
+        "admission boundary: QoS-band shed at StreamScheduler submit/"
+        "sweep — the pod never reaches a solve, so there is no mask "
+        "outcome to replay; attributed via overload_shed_total{band} "
+        "plus the terminal shed lifecycle event (koordlint shed-paths "
+        "pass enforces both)"
+    ),
 }
 
 #: where the enum and the classifier live

@@ -1,0 +1,216 @@
+"""Pass ``shed-paths`` (SP): every site that terminally drops a queued
+pod emits the ``shed`` lifecycle event and counts a named metric — the
+overload-control PR's standing rule, mirroring what ``chaos-coverage``
+does for fault points and ``reject-reasons`` for the taxonomy.
+
+The vocabulary is bidirectional:
+
+* ``SHED_SITES`` declares every function that may drop a queued pod
+  terminally. Each must either be a CANONICAL shed (its body both emits
+  a ``"shed"`` lifecycle event and increments a metric — today
+  ``AdmissionController.shed``) or DELEGATE to one (a ``.shed(...)``
+  call in its body).
+* ``EXEMPT`` declares queue-drop sites that deliberately do NOT shed —
+  each carries the written reason (e.g. a claim loser is scheduled by
+  the winning shard, so the drop is not terminal).
+
+* **SP001** — a declared shed site whose body neither shed-emits
+  (event + metric) nor delegates to a shed API: a silent pod drop.
+* **SP002** — an UNDECLARED function that emits a ``"shed"`` event or
+  calls a ``.shed(...)`` API: a new drop site must join ``SHED_SITES``
+  (or ``EXEMPT``, with its reason) so review sees it.
+* **SP003** — a stale table entry: the named file/function is gone.
+* **SP004** — an ``EXEMPT`` site that actually sheds: move it to
+  ``SHED_SITES`` and delete the stale exemption.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import Finding, Pass, RepoIndex, register
+
+Site = Tuple[str, str]  # (repo-relative file, dotted qualname)
+
+#: every function allowed to terminally drop a queued pod → why it is a
+#: shed site. New shed paths JOIN this table (SP002 forces it).
+SHED_SITES: Dict[Site, str] = {
+    (
+        "koordinator_tpu/runtime/overload.py",
+        "AdmissionController.shed",
+    ): (
+        "the canonical shed: terminal lifecycle event + "
+        "overload_shed_total{band} + the resubmit ticket"
+    ),
+    (
+        "koordinator_tpu/scheduler/stream.py",
+        "StreamScheduler.submit",
+    ): (
+        "submit-time shed (band over budget at L4 / brownout sheds the "
+        "band) — delegates to AdmissionController.shed"
+    ),
+    (
+        "koordinator_tpu/scheduler/stream.py",
+        "StreamScheduler._overload_sweep",
+    ): (
+        "deferred-parking-lot sweep (aged-out past the band limit, or "
+        "the ladder reached its shed level) — delegates to "
+        "AdmissionController.shed"
+    ),
+}
+
+#: queue-drop sites that deliberately do NOT shed → the written reason
+EXEMPT: Dict[Site, str] = {
+    (
+        "koordinator_tpu/scheduler/stream.py",
+        "StreamScheduler._next_batch",
+    ): (
+        "claim loser: the WINNING shard schedules the pod — the drop "
+        "is not terminal, and the claim gate already stamped "
+        "claim_lost on the timeline"
+    ),
+}
+
+#: call-attribute names that count as delegating to a shed API
+_DELEGATE_ATTRS = frozenset({"shed"})
+
+
+def _qualnames(tree: ast.AST) -> Dict[str, ast.AST]:
+    """Dotted qualname -> function node, for every (possibly nested)
+    function/method in the module."""
+    out: Dict[str, ast.AST] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[q] = child
+                visit(child, f"{q}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _emits_shed_event(fn: ast.AST) -> bool:
+    """A ``*.event(..., "shed", ...)`` call (positional or keyword) or a
+    stage-helper call carrying the literal ``"shed"``."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "event"
+        ):
+            continue
+        values = list(node.args) + [kw.value for kw in node.keywords]
+        for v in values:
+            if isinstance(v, ast.Constant) and v.value == "shed":
+                return True
+    return False
+
+
+def _increments_metric(fn: ast.AST) -> bool:
+    """Any ``.inc(...)`` call — the named-metric half of the rule."""
+    return any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "inc"
+        for node in ast.walk(fn)
+    )
+
+
+def _delegates_shed(fn: ast.AST) -> bool:
+    """A ``<expr>.shed(...)`` call in the body — delegation to a shed
+    API (the canonical site satisfies the stronger emit+metric test
+    first, so a recursive-looking match here changes nothing)."""
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _DELEGATE_ATTRS
+        ):
+            return True
+    return False
+
+
+@register
+class ShedPathsPass(Pass):
+    name = "shed-paths"
+    code = "SP"
+    description = (
+        "every terminal queued-pod drop emits the shed lifecycle event "
+        "and counts a named metric (or carries a written exemption)"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        declared = set(SHED_SITES) | set(EXEMPT)
+        #: (file, qualname) -> function node, package-wide
+        funcs: Dict[Site, ast.AST] = {}
+        files_seen: Set[str] = set()
+        for sf in index.package_files:
+            if sf.tree is None:
+                continue
+            files_seen.add(sf.rel)
+            for q, fn in _qualnames(sf.tree).items():
+                funcs[(sf.rel, q)] = fn
+
+        # SP001: declared shed sites must actually shed (or delegate)
+        for site, why in sorted(SHED_SITES.items()):
+            fn = funcs.get(site)
+            if fn is None:
+                out.append(self.finding(
+                    3, site[0], 0,
+                    f"shed-paths table names {site[1]!r} in {site[0]} "
+                    "but it does not exist — delete the stale entry",
+                ))
+                continue
+            canonical = _emits_shed_event(fn) and _increments_metric(fn)
+            if not canonical and not _delegates_shed(fn):
+                out.append(self.finding(
+                    1, site[0], fn.lineno,
+                    f"{site[1]} is a declared shed site but neither "
+                    "emits the terminal shed lifecycle event with a "
+                    "counted metric nor delegates to a shed API — a "
+                    "queued pod dropped here vanishes untraced "
+                    "(overload-control standing rule)",
+                ))
+
+        # SP004 / SP003 over the exemptions
+        for site, why in sorted(EXEMPT.items()):
+            fn = funcs.get(site)
+            if fn is None:
+                out.append(self.finding(
+                    3, site[0], 0,
+                    f"shed-paths exemption names {site[1]!r} in "
+                    f"{site[0]} but it does not exist — delete the "
+                    "stale exemption",
+                ))
+                continue
+            if _emits_shed_event(fn) or _delegates_shed(fn):
+                out.append(self.finding(
+                    4, site[0], fn.lineno,
+                    f"{site[1]} is exempted as a non-shedding drop "
+                    "site but its body sheds — move it to SHED_SITES "
+                    "and delete the stale exemption",
+                ))
+
+        # SP002: undeclared shedding functions anywhere in the package
+        for site, fn in sorted(funcs.items()):
+            if site in declared:
+                continue
+            if _emits_shed_event(fn) or _delegates_shed(fn):
+                out.append(self.finding(
+                    2, site[0], fn.lineno,
+                    f"{site[1]} sheds (emits the shed event or calls a "
+                    ".shed(...) API) but is not declared in the "
+                    "shed-paths SHED_SITES table — declare it (or "
+                    "exempt it with a written reason) so review sees "
+                    "every drop path",
+                ))
+        return out
