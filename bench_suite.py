@@ -926,6 +926,252 @@ def _pipelined_ab(build, max_batch, depth=2, passes=3):
     return out
 
 
+def _build_reservation_fastpath(
+    n_nodes=512, n_resv=384, n_owner=1024, n_plain=3072
+):
+    """Reservation-bearing constrained scenario (open the last gates
+    PR): a population of Available reservations whose owner pods bind
+    through the fast path, interleaved with plain solver pods. With the
+    ``reservations`` gate open, the pipelined stream PREDICTS each
+    cycle's fast-path binds at dispatch and validates them by value at
+    consume — the serial/pipelined A/B proves engagement (kept > 0,
+    zero reservations-gate closures) on exactly this shape."""
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        ElasticQuota,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        Reservation,
+        ReservationOwner,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        GroupQuotaManager,
+    )
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationManager,
+    )
+    from koordinator_tpu.sim.cluster_gen import GenConfig, gen_nodes
+
+    cfg = GenConfig(n_nodes=n_nodes, n_pods=0, seed=11)
+    nodes, metrics = gen_nodes(cfg)
+    snap = ClusterSnapshot()
+    for n in nodes:
+        snap.upsert_node(n)
+    for m in metrics:
+        snap.set_node_metric(
+            m, now=m.update_time + 1 if m.update_time else 1.0
+        )
+    gqm = GroupQuotaManager(snap.config)
+    # allow_lent_resource=False: the min stays reserved regardless of
+    # propagated demand, so the fast path's headroom check admits the
+    # labeled owners (a demand-driven runtime trails it by one cycle)
+    gqm.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="resv-team"),
+            min={ext.RES_CPU: 4_000_000, ext.RES_MEMORY: 16 << 20},
+            max={ext.RES_CPU: 8_000_000, ext.RES_MEMORY: 32 << 20},
+            allow_lent_resource=False,
+        )
+    )
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), quotas=gqm, batch_bucket=512
+    )
+    rm = ReservationManager(sched)
+    for k in range(n_resv):
+        rm.add(
+            Reservation(
+                meta=ObjectMeta(name=f"resv-{k:04d}"),
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                owners=[
+                    ReservationOwner(label_selector={"app": "resv-owner"})
+                ],
+                allocate_once=(k % 2 == 0),
+            )
+        )
+    assert rm.schedule_pending() == n_resv
+    rng = np.random.default_rng(13)
+    from koordinator_tpu.api import extension as _e
+
+    owners = [
+        Pod(
+            meta=ObjectMeta(
+                name=f"own{i:05d}",
+                labels={
+                    "app": "resv-owner",
+                    _e.LABEL_QUOTA_NAME: "resv-team",
+                },
+            ),
+            spec=PodSpec(
+                requests={_e.RES_CPU: 2000, _e.RES_MEMORY: 4096},
+                priority=9100,
+            ),
+        )
+        for i in range(n_owner)
+    ]
+    plain = [
+        Pod(
+            meta=ObjectMeta(name=f"pl{i:05d}"),
+            spec=PodSpec(
+                requests={
+                    _e.RES_CPU: int(rng.choice([500, 1000, 2000])),
+                    _e.RES_MEMORY: 2048,
+                },
+                priority=int(rng.integers(5000, 9000)),
+            ),
+        )
+        for i in range(n_plain)
+    ]
+    # interleave so fast-path binds spread across every pump
+    pods = []
+    oi = pi = 0
+    while oi < len(owners) or pi < len(plain):
+        if oi < len(owners):
+            pods.append(owners[oi])
+            oi += 1
+        for _ in range(3):
+            if pi < len(plain):
+                pods.append(plain[pi])
+                pi += 1
+    return sched, pods
+
+
+def bench_reservation_fastpath():
+    def build():
+        return _build_reservation_fastpath()
+
+    # engagement probe (serial, outside the measured passes): the fast
+    # path must actually consume reservations under this fixture, or
+    # the A/B proves nothing about the reservation carry
+    sched, pods = build()
+    sched.extender.monitor.stop_background()
+    _decided, bound, _el = _drain_stream(
+        sched, pods, pipelined=False, max_batch=256
+    )
+    consumed = sum(
+        1
+        for r in sched.reservations.list()
+        if r.current_owners or r.phase.value == "Succeeded"
+    )
+    assert consumed > 0, "fixture never exercised the fast path"
+    out = {
+        "scenario": "reservation_fastpath",
+        "total": len(pods),
+        "placed_serial_probe": bound,
+        "reservations_consumed": consumed,
+        "measurement_note_scenario": (
+            "with hundreds of simultaneously-Available reservations the "
+            "fast path is HOST match-bound (the per-pod nomination scan "
+            "dominates the serial drain too — profiled ~90% of its "
+            "wall); the dispatch-side preview necessarily runs that "
+            "scan a second time, which a 2-core CPU container pays "
+            "serially but an accelerator hides under the device solve "
+            "(prepare-worker overlap). The engagement evidence "
+            "(kept>0, zero reservation-gate closures, zero reservation "
+            "carry mismatches, retrace-free) is the structural claim "
+            "of this CPU round; vectorizing the nomination scan is the "
+            "follow-on that lifts BOTH paths"
+        ),
+    }
+    out.update(_pipelined_ab(build, max_batch=256, depth=2))
+    return out
+
+
+def _build_preempt_priority(n_nodes=256, n_low=1024, n_high=256):
+    """Priority-preemption constrained scenario (open the last gates
+    PR): low-priority filler saturates the cluster, then high-priority
+    arrivals can only place by evicting it — the PostFilter preemption
+    pass fires exactly in this overloaded regime, and with the
+    ``preemption`` gate open the non-preempting cycles still speculate
+    (an eager eviction discards only the downstream chain at its own
+    commit)."""
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+
+    # uniform SMALL nodes so the low-priority wave exactly saturates
+    # the cluster (heterogeneous gen_nodes shapes leave too much slack
+    # for preemption to ever fire): n_low * 4000 cpu == n_nodes * 16000
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"node-{i:05d}"),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: 16_000,
+                        ext.RES_MEMORY: 65_536,
+                    }
+                ),
+            )
+        )
+    sched = BatchScheduler(
+        snap,
+        LoadAwareArgs(usage_thresholds={}),
+        batch_bucket=256,
+        enable_priority_preemption=True,
+    )
+    low = [
+        Pod(
+            meta=ObjectMeta(name=f"low{i:05d}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                priority=4000 + (i % 7),
+            ),
+        )
+        for i in range(n_low)
+    ]
+    high = [
+        Pod(
+            meta=ObjectMeta(name=f"high{i:04d}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 16384},
+                priority=9500,
+            ),
+        )
+        for i in range(n_high)
+    ]
+    return sched, low + high
+
+
+def bench_preempt_priority():
+    def build():
+        return _build_preempt_priority()
+
+    # engagement probe: evictions really happen (bound decisions whose
+    # pods are no longer assumed at the end ARE the victims)
+    sched, pods = build()
+    sched.extender.monitor.stop_background()
+    _decided, bound, _el = _drain_stream(
+        sched, pods, pipelined=False, max_batch=128
+    )
+    evicted = bound - len(sched.snapshot._assumed)
+    assert evicted > 0, "fixture never triggered priority preemption"
+    out = {
+        "scenario": "preempt_priority",
+        "total": len(pods),
+        "placed_serial_probe": bound,
+        "preempted": evicted,
+    }
+    out.update(_pipelined_ab(build, max_batch=128, depth=2))
+    return out
+
+
 def bench_stream_pipelined():
     """Same-backend A/B of the cross-cycle solve pipeline (perf PR 4):
     one loadaware cluster drained through the StreamScheduler twice —
@@ -2246,6 +2492,8 @@ SCENARIOS = {
     "numa": bench_numa,
     "device_gang": bench_device_gang,
     "quota_tree": bench_quota_tree,
+    "reservation_fastpath": bench_reservation_fastpath,
+    "preempt_priority": bench_preempt_priority,
     "latency_stream": bench_latency_stream,
     "latency_stream_sharded": bench_latency_stream_sharded,
     "stream_pipelined": bench_stream_pipelined,

@@ -4,7 +4,11 @@
 while sampling was on" — neither answers "what were the last 200 cycles
 of the incarnation that just died DOING". This module is the black box:
 an append-only ring of per-cycle summaries (stage_ms, pipeline gate
-states, speculation outcome, fence rejections, queue depth, batch sizes)
+states, speculation outcome, the adaptive pipeline-depth decision and
+its discard-rate input — ``depth``/``depth_max``/``discard_rate``, so
+every depth choice is explainable post-hoc and a takeover inherits the
+dead writer's churn evidence — fence rejections, queue depth, batch
+sizes)
 persisted **beside the bind journal** over the same pluggable store API
 (``MemoryJournalStore`` in tests/sim, ``FileJournalStore`` for real
 durability), so a new incarnation taking over a shard loads the dead

@@ -292,6 +292,13 @@ class ChainCarry:
     dev: object = None
     #: [N, Z, DN] post-commit NUMA zone-free table or None
     numa_zone: object = None
+    #: predicted post-fast-path reservation overlay
+    #: (:class:`~.plugins.reservation.ResvView`) — the HOST-side leg of
+    #: the chain (open the last gates PR): a downstream chained dispatch
+    #: previews ITS fast path against this cycle's predicted reservation
+    #: ledger, exactly like ``quota_used`` chains the device ledger.
+    #: None = reservations absent (or a fresh dispatch's empty overlay)
+    resv_view: object = None
 
 
 @dataclasses.dataclass
@@ -332,6 +339,31 @@ class _DevCarryMeta:
 
 
 @dataclasses.dataclass
+class _ResvCarryMeta:
+    """Validation inputs for a reservation-bearing speculative solve
+    (open the last gates PR). The fast path runs at the START of the
+    consuming cycle — before the chunks the speculation solved — so the
+    dispatch PREDICTS its outcome (pure overlay preview) and the consume
+    guard proves the prediction by value: the table the preview started
+    from must equal the live table at cycle start, the actual fast-path
+    binds/affinity verdicts must equal the predicted ones, and the live
+    post-fast-path table must equal the predicted post table. Any bind
+    that flipped a rival's spill feasibility differently than predicted
+    shows up in one of the three and discards the speculation."""
+
+    #: predicted ordered fast-path binds: ((uid, reservation, node), ...)
+    binds: tuple = ()
+    #: predicted required-affinity unschedulable uids (excluded from the
+    #: solver chunks, like the real fast path excludes them)
+    affinity_unsched: tuple = ()
+    #: reservation table the preview started from (upstream predicted
+    #: post state for a chained dispatch; live state for a fresh one)
+    pre_table: tuple = ()
+    #: predicted post-fast-path table
+    post_table: tuple = ()
+
+
+@dataclasses.dataclass
 class CarryMeta:
     """Everything consume-time validation needs to prove the speculative
     solve's inputs equal what a fresh serial dispatch would lower NOW —
@@ -345,6 +377,13 @@ class CarryMeta:
     #: frozen (key, outstanding_min, nonstrict) per gang in the batch,
     #: as the lowering's live views read them (empty = gang-free batch)
     gangs: tuple = ()
+    #: reservation fast-path prediction (None = reservations absent)
+    resv: Optional[_ResvCarryMeta] = None
+    #: mode flags the dispatch baked in (reservation attachment,
+    #: defer/priority/quota preemption) — a mid-pipeline flip changes
+    #: PostFilter behavior without bumping any version, so it is
+    #: compared by value like the tables
+    modes: tuple = ()
 
 
 @dataclasses.dataclass
@@ -376,6 +415,74 @@ class SpeculativeSolve:
     quarantine: Dict[str, tuple] = dataclasses.field(default_factory=dict)
     #: wall instant of dispatch (for the pipeline's overlap span)
     dispatched_at: float = 0.0
+
+
+@dataclasses.dataclass
+class _ResvPlan:
+    """One dispatch-side fast-path preview run (see
+    :meth:`BatchScheduler._reservation_fastpath_preview`)."""
+
+    binds: tuple
+    affinity_unsched: tuple
+    #: uids leaving the solver path via a predicted fast-path bind
+    taken: frozenset
+    pre_table: tuple
+    post_table: tuple
+    #: post-prediction overlay (rides the ChainCarry for downstream
+    #: chained previews)
+    view: object
+    #: [(node idx, d_requested, d_estimated, d_prod)] — predicted
+    #: snapshot effects (owner assume + ghost forget + remainder assume)
+    node_deltas: list
+    #: the quota preview the plan charged into (reused by the dispatch
+    #: when it TRUSTS a prepare-time plan — see _dispatch_chained)
+    quota_prev: Optional[_QuotaFastpathPreview] = None
+
+
+class _QuotaFastpathPreview:
+    """Pure mirror of ``GroupQuotaManager.has_headroom`` for the
+    dispatch-side reservation preview: headroom answered against the
+    PREDICTED used/non-preemptible ledgers (the device carry's
+    post-commit rows for a chained dispatch, the live rows for a fresh
+    one) and the runtime the consuming cycle's fast path will actually
+    read (the PREVIOUS cycle's refreshed runtime — a fast-path headroom
+    check runs before the consuming cycle's own demand propagation).
+    Predicted fast-path charges accumulate in the copies so later pods
+    in the same preview — and the speculative solve's used table — see
+    them, exactly like the real path's ``assign_pod`` charges."""
+
+    __slots__ = ("quotas", "config", "used", "nonpre", "runtime", "charged")
+
+    def __init__(self, quotas, config, used, nonpre, runtime):
+        self.quotas = quotas
+        self.config = config
+        self.used = used          # [Q, D] mutable copy
+        self.nonpre = nonpre      # [Q, D] mutable copy
+        self.runtime = runtime    # [Q, D] read-only
+        self.charged = False
+
+    def headroom(self, leaf: str, requests, non_preemptible: bool) -> bool:
+        # delegates to the manager's shared chain-walk arithmetic —
+        # ONE copy of the admission math for the live check and the
+        # preview, so they cannot drift
+        return self.quotas.headroom_in(
+            leaf,
+            self.config.res_vector(requests),
+            non_preemptible,
+            self.used,
+            self.nonpre,
+            self.runtime,
+        )
+
+    def charge(self, leaf: str, requests, non_preemptible: bool) -> None:
+        if self.quotas.charge_in(
+            leaf,
+            self.config.res_vector(requests),
+            non_preemptible,
+            self.used,
+            self.nonpre,
+        ):
+            self.charged = True
 
 
 @dataclasses.dataclass
@@ -620,6 +727,20 @@ class BatchScheduler:
         self.last_gate_report: Dict[str, object] = {}
         self._cycle_fenced = False
         self._cycle_spec_outcome = ""
+        #: reservation-carry consume evidence (open the last gates PR):
+        #: the pre-fast-path snapshot version + reservation table and
+        #: the fast path's ACTUAL (uid, reservation, node) binds and
+        #: required-affinity refusals, captured per cycle and compared
+        #: by value against the speculation's predictions
+        self._cycle_prefast_version = -1
+        self._cycle_resv_binds: List[tuple] = []
+        self._cycle_resv_affinity: tuple = ()
+        self._cycle_resv_pre_table = None
+        #: adaptive-depth decision for this cycle — (chosen depth, max
+        #: depth, discard-rate input), stamped by the CyclePipeline
+        #: before the trailing commit so the flight recorder can explain
+        #: the choice post-hoc
+        self._depth_decision: Optional[tuple] = None
         #: periodic journal compaction from the run loop (PR 6
         #: satellite, ROADMAP queued follow-on): after a clean cycle,
         #: compact once at least this many records (or bytes, for file
@@ -1195,6 +1316,20 @@ class BatchScheduler:
         speculation outcome, fencing, queue depth) a post-mortem needs
         when the process does not survive to be asked."""
         gates = self.last_gate_report
+        extra: Dict[str, object] = {}
+        dd = self._depth_decision
+        # consume-once: the pipeline stamps a decision per trailing
+        # commit; a later SERIAL cycle (ghost scheduling, direct
+        # schedule() calls) must not record a stale pipelined choice
+        self._depth_decision = None
+        if dd is not None:
+            # adaptive-depth PR: the chosen depth + its discard-rate
+            # input per cycle — depth decisions must be explainable
+            # post-hoc, and a takeover adopting this recorder's tail
+            # inherits the dead writer's churn evidence with it
+            extra["depth"] = dd[0]
+            extra["depth_max"] = dd[1]
+            extra["discard_rate"] = dd[2]
         self.flight_recorder.record(
             cid,
             stage_ms={
@@ -1212,6 +1347,7 @@ class BatchScheduler:
             epoch=self._fence_epoch,
             rolled_back=self._cycle_commit_rolled_back,
             deadline_hit=self._cycle_deadline_hit,
+            **extra,
         )
 
     def _schedule_locked(
@@ -1239,6 +1375,9 @@ class BatchScheduler:
             self._cycle_preempted = False
             self._cycle_fenced = False
             self._cycle_spec_outcome = ""
+            self._cycle_resv_binds = []
+            self._cycle_resv_affinity = ()
+            self._cycle_resv_pre_table = None
             self._pre_cycle_version = self.snapshot.version
             self._cycle_t0 = _time.perf_counter()
             fwext.monitor.start_batch(pending)
@@ -1276,6 +1415,20 @@ class BatchScheduler:
         # fall through to the solver: gang members (Permit), and matched
         # pods whose NUMA/device/quota Reserve fails.
         reserved_bound: List[Tuple[Pod, str]] = []
+        # open the last gates PR: a pending speculation PREDICTED this
+        # cycle's fast-path outcome at dispatch. Capture what the
+        # consume guard compares by value — the snapshot version before
+        # the fast path's own sanctioned writes, and the reservation
+        # table before begin_cycle can touch it. The whole cycle runs
+        # under snapshot.lock, so every write between here and the
+        # consume guard IS the fast path's.
+        if not _retry:
+            self._cycle_prefast_version = self.snapshot.version
+            if (
+                self._speculative is not None
+                and self.reservations is not None
+            ):
+                self._cycle_resv_pre_table = self.reservations.table_view()
         # HA fencing: the reservation fast path is a commit too (it
         # assumes pods directly, bypassing _commit) — a deposed leader
         # must not take it. The check here is fence-only (no chaos
@@ -1486,10 +1639,17 @@ class BatchScheduler:
                 self._bound_pods[pod.meta.uid] = pod
                 pod.meta.annotations.update(patch)
                 reserved_bound.append((pod, node))
+                self._cycle_resv_binds.append(
+                    (pod.meta.uid, r.meta.name, node)
+                )
                 prematch_valid = False
             pending = remaining_pending
         else:
             affinity_unsched = []
+        if not _retry:
+            self._cycle_resv_affinity = tuple(
+                p.meta.uid for p in affinity_unsched
+            )
 
         eligible = self.pod_groups.begin_and_order(pending)
         eligible_uids = {p.meta.uid for p in eligible}
@@ -1546,7 +1706,10 @@ class BatchScheduler:
                 chunks
                 and spec.chunk_uids
                 == tuple(tuple(p.meta.uid for p in c) for c in chunks)
-                and spec.version == self.snapshot.version
+                # compared against the PRE-fast-path version: the fast
+                # path's own writes are sanctioned (predicted, validated
+                # by value below); any OTHER write since dispatch is not
+                and spec.version == self._cycle_prefast_version
                 and spec.node_epoch == self.snapshot.node_epoch
                 and self._fallback_level == 0
                 and self._speculation_consume_ok()
@@ -1941,9 +2104,14 @@ class BatchScheduler:
                     preempted.append(victim)
                 retry_pods.append(pod)
                 self._window_extra_nodes.add(_node)
-        if retry_pods or preempted:
-            # preemption moved window bookkeeping / evicted holders — the
-            # speculative chain (if any) no longer matches the snapshot
+        if retry_pods or (preempted and not self.defer_preemption):
+            # EAGER preemption moved window bookkeeping / evicted holders
+            # — the speculative chain (if any) no longer matches the
+            # snapshot. Nominate-only (defer_preemption) passes are pure
+            # reads and keep the chain (open the last gates PR): the
+            # external migration controller's eventual evictions bump
+            # snapshot.version and discard any in-flight speculation at
+            # the ordinary version guard.
             self._cycle_preempted = True
         if retry_pods:
             # the retry's sampled window must contain the nodes the
@@ -2880,20 +3048,37 @@ class BatchScheduler:
         CyclePipeline's ``pipeline_gate_closed_total{gate}`` attribution
         and the ``/debug/pipeline`` introspection payload.
 
-        Open-the-gates PR: ``quotas`` / ``numa`` / ``devices`` report
-        OPEN unconditionally — their host commit state now rides the
-        device chain (:class:`ChainCarry`) with bit-exact retroactive
+        Open-the-gates PRs: ``quotas`` / ``numa`` / ``devices`` report
+        OPEN unconditionally — their host commit state rides the device
+        chain (:class:`ChainCarry`) with bit-exact retroactive
         validation at consume (:meth:`_carry_consume_ok`), so presence
         no longer forces the serial path. ``gangs`` likewise opens at
         the manager level; the per-BATCH warm-gang check lives in the
-        CyclePipeline's ``batch_gangs`` gate. The remaining closed-on-
-        presence gates are the subsystems whose commit state the chain
-        still cannot carry: reservations (ghost-hold swaps), mesh
-        (sharded dispatch), transformers (host rewrites), priority
-        preemption, and node sampling (rotating sub-axis)."""
+        CyclePipeline's ``batch_gangs`` gate. ``reservations`` (open
+        the last gates PR) carries the fast path as a validated
+        PREDICTION — it closes only for the combination a pure preview
+        cannot reproduce: NUMA/device managers live AND an Available
+        reservation whose ghost-hold swap would pick cpusets/minors.
+        ``preemption`` is open: nominate-only (defer) passes are pure
+        reads and chain through; an EAGER eviction+retry sets
+        ``_cycle_preempted``, which discards the downstream chain at
+        that commit (decision-identical — the next dispatch re-reads
+        the post-eviction world). The remaining closed-on-presence
+        gates are mesh (sharded dispatch), transformers (host
+        rewrites), and node sampling (rotating sub-axis)."""
         fwext = self.extender
         return {
-            "reservations": self.reservations is None,
+            "reservations": self.reservations is None
+            or not (
+                (
+                    (self.numa is not None and self.numa.has_topology)
+                    or (
+                        self.devices is not None
+                        and self.devices.has_devices
+                    )
+                )
+                and self.reservations.has_available()
+            ),
             "mesh": self.mesh is None,
             "numa": True,
             "devices": True,
@@ -2901,7 +3086,7 @@ class BatchScheduler:
             "transformers": not fwext._pre_batch
             and not fwext._batch_transformers
             and fwext.cost_transform is None,
-            "preemption": not self.enable_priority_preemption,
+            "preemption": True,
             "gangs": True,
             "sampling": num_nodes_to_score(
                 self.snapshot.node_count, self.percentage_of_nodes_to_score
@@ -2950,11 +3135,35 @@ class BatchScheduler:
                 table=table
             ).inc()
             return False
+        # PostFilter/fast-path mode flags must not have flipped since
+        # dispatch (none of them bump a version)
+        if carry.modes != self._carry_modes():
+            return _fail("modes")
         # presence must match what the solve lowered with: a subsystem
         # arriving (or emptying) mid-pipeline invalidates rows that
         # carry no quota chains / no device columns for it
         if (self.quotas.quota_count > 0) != (carry.quota is not None):
             return _fail("quota")
+        if (self.reservations is not None) != (carry.resv is not None):
+            return _fail("reservation")
+        rm = carry.resv
+        if rm is not None:
+            # reservation carry (open the last gates PR): the dispatch
+            # PREDICTED this cycle's fast-path outcome — prove it. The
+            # table the preview started from must equal the live table
+            # at cycle start (no sync/expiry/informer drift since
+            # dispatch), the actual binds and required-affinity refusals
+            # must equal the predicted ones, and the live post-fast-path
+            # ledger must equal the predicted post table. A bind that
+            # flipped a rival's spill feasibility differently than
+            # predicted diverges in one of the three.
+            if (
+                self._cycle_resv_pre_table != rm.pre_table
+                or tuple(self._cycle_resv_binds) != rm.binds
+                or self._cycle_resv_affinity != rm.affinity_unsched
+                or self.reservations.table_view() != rm.post_table
+            ):
+                return _fail("reservation")
         numa_live = self.numa is not None and self.numa.has_topology
         if numa_live != (carry.numa is not None):
             return _fail("numa")
@@ -3050,6 +3259,240 @@ class BatchScheduler:
             or self._cycle_preempted
         )
 
+    def _carry_modes(self) -> tuple:
+        """PostFilter/fast-path mode flags a speculative dispatch bakes
+        in (compared by value at consume — a flip between dispatch and
+        consume changes scheduling behavior without bumping any
+        version)."""
+        return (
+            self.reservations is not None,
+            self.defer_preemption,
+            self.enable_priority_preemption,
+            self.quotas.enable_preemption,
+        )
+
+    def _quota_fastpath_preview_live(self) -> Optional[_QuotaFastpathPreview]:
+        """Live-state quota preview for the PREPARE-time reservation
+        plan (the prepare worker does not know which chain — if any —
+        the dispatch will pick; the dispatch re-previews chain-aware
+        and falls back to inline lowering when the plans disagree)."""
+        q = self.quotas.quota_count
+        if q == 0:
+            return None
+        self.quotas._ensure_capacity()
+        return _QuotaFastpathPreview(
+            self.quotas,
+            self.snapshot.config,
+            self.quotas.used[:q].copy(),
+            self.quotas.nonpre_used[:q].copy(),
+            self.quotas.runtime[:q],
+        )
+
+    def _quota_fastpath_preview_chain(
+        self, quota_used_dev, chain_meta: Optional[CarryMeta]
+    ) -> Optional[_QuotaFastpathPreview]:
+        """Chain-aware quota preview: headroom answered against the
+        upstream speculation's predicted post-commit used/non-preemptible
+        rows (the device carry) and ITS runtime preview — exactly the
+        ledgers the consuming cycle's fast path will read if the chain
+        validates. None when the carried shapes no longer line up (tree
+        reshaped mid-chain; the dispatch refuses speculation then)."""
+        q = self.quotas.quota_count
+        if q == 0:
+            return None
+        carried = np.asarray(quota_used_dev)
+        cm = chain_meta.quota if chain_meta is not None else None
+        if (
+            carried.shape[0] < 2 * q
+            or cm is None
+            or cm.runtime_host.shape[0] < q
+        ):
+            return None
+        off = carried.shape[0] // 2
+        return _QuotaFastpathPreview(
+            self.quotas,
+            self.snapshot.config,
+            carried[:q].copy(),
+            carried[off : off + q].copy(),
+            cm.runtime_host[:q],
+        )
+
+    def _reservation_fastpath_preview(
+        self,
+        batch: Sequence[Pod],
+        base_view=None,
+        quota_prev: Optional[_QuotaFastpathPreview] = None,
+        chain_nodes=None,
+    ) -> Optional[_ResvPlan]:
+        """PURE preview of the reservation fast path for ``batch`` (open
+        the last gates PR): the same match → quota headroom → spill →
+        allocate sequence ``_schedule_locked`` runs, executed against an
+        overlay view so neither the manager, the snapshot nor the quota
+        ledgers move. ``base_view`` chains the upstream speculation's
+        predicted post state (None = live); ``chain_nodes`` supplies the
+        chained device node table whose requested rows stand in for the
+        not-yet-committed upstream solver charges in spill checks.
+
+        Returns the plan, or None to REFUSE speculation: NUMA/device
+        managers with a live match (the ghost-hold cpuset/minor swap is
+        a host-allocator decision a pure preview cannot reproduce) and
+        operating-pod-backed reservations (charge reshaping) keep such
+        cycles serial. A wrong prediction is never a correctness hazard
+        — the consume guard compares every predicted outcome by value
+        and discards on divergence — it only costs the speculation."""
+        from .plugins.coscheduling import gang_key_of
+        from .plugins.elasticquota import (
+            is_pod_non_preemptible as is_nonpre,
+            quota_name_of,
+        )
+        from .plugins.reservation import ResvView
+
+        resv = self.reservations
+        snap = self.snapshot
+        view = base_view.clone() if base_view is not None else ResvView(resv)
+        if chain_nodes is not None:
+            # candidate nodes' requested rows come from the CHAIN (the
+            # upstream solver's post-commit charges are not in the host
+            # snapshot yet); REPLACING the per-node overlay keeps the
+            # upstream view's own predicted deltas from double-counting
+            # (they are already inside the chained rows)
+            idxs = sorted(
+                {
+                    snap.node_id(r.node_name)
+                    for r in view.candidates()
+                }
+                - {None}
+            )
+            if idxs:
+                rows = np.asarray(
+                    chain_nodes.requested[np.asarray(idxs, np.int32)]
+                )
+                for i, idx in enumerate(idxs):
+                    view.node_req[idx] = rows[i] - snap.nodes.requested[idx]
+        pre_table = resv.table_view(view)
+        numa_live = self.numa is not None and self.numa.has_topology
+        dev_live = self.devices is not None and self.devices.has_devices
+        binds: List[tuple] = []
+        affinity: List[str] = []
+        node_deltas: List[tuple] = []
+        cpu_dim = snap._cpu_dim
+        for pod in batch:
+            required = (
+                ext.parse_reservation_affinity(pod.meta.annotations)
+                is not None
+            )
+            if gang_key_of(pod) is not None:
+                # the real path never matches gang pods (r = None), but
+                # a gang pod with REQUIRED reservation affinity still
+                # routes to affinity_unsched there — mirror it, or the
+                # predicted chunks/affinity diverge structurally and
+                # every speculation over such a batch discards forever
+                if required:
+                    affinity.append(pod.meta.uid)
+                continue
+            r = resv.match(pod, view=view)
+            if r is None:
+                if required:
+                    affinity.append(pod.meta.uid)
+                continue
+            if (
+                numa_live
+                or dev_live
+                or resv.is_operating_backed(r.meta.name)
+            ):
+                return None
+            leaf = quota_name_of(pod)
+            nonpre = is_nonpre(pod)
+            if (
+                leaf is not None
+                and quota_prev is not None
+                and not quota_prev.headroom(leaf, pod.spec.requests, nonpre)
+            ):
+                if required:
+                    affinity.append(pod.meta.uid)
+                continue
+            _consumed, spill = resv.consumed_and_spill(r, pod, view)
+            if not resv.spill_fits_node(r, spill, view):
+                if required:
+                    affinity.append(pod.meta.uid)
+                continue
+            node = r.node_name
+            idx = snap.node_id(node)
+            if idx is None:
+                return None  # racing delete; epoch guard settles it
+            # the owner's own assume (assume_pod in the real path):
+            # request with the amplified-CPU surcharge for bound pods,
+            # estimate from the shared _estimate_of
+            req = snap.config.res_vector(pod.spec.requests)
+            est = np.asarray(self._estimate_of(pod), np.float32)
+            amp = float(snap.nodes.cpu_amp[idx])
+            if amp > 1.0 and req[cpu_dim] > 0 and ext.wants_cpu_bind(pod):
+                req = req.copy()
+                req[cpu_dim] *= amp
+            is_prod = pod.priority_class == ext.PriorityClass.PROD
+            node_deltas.append(
+                (idx, req, est, est if is_prod else np.zeros_like(est))
+            )
+            view.add_node_delta(idx, req)
+            view.assumed[pod.meta.uid] = (req, est, is_prod)
+            node_deltas.extend(resv.preview_allocate(r, pod, view))
+            if leaf is not None and quota_prev is not None:
+                quota_prev.charge(leaf, pod.spec.requests, nonpre)
+            binds.append((pod.meta.uid, r.meta.name, node))
+        return _ResvPlan(
+            binds=tuple(binds),
+            affinity_unsched=tuple(affinity),
+            taken=frozenset(u for u, _r, _n in binds),
+            pre_table=pre_table,
+            post_table=resv.table_view(view),
+            view=view,
+            node_deltas=node_deltas,
+            quota_prev=quota_prev,
+        )
+
+    def _fold_resv_node_deltas(self, nodes, deltas: List[tuple]):
+        """Fold the preview's predicted fast-path node deltas into the
+        chained NodeState. Functional ``.at[].add`` updates — the input
+        arrays stay live (the fresh-dispatch path hands in the RESIDENT
+        state, which must never be consumed). The index vector is padded
+        to a power of two (min 8, trailing duplicates carrying zero
+        rows, which ``.add`` tolerates) so the update op's jit cache
+        stays bounded — the ``_scatter_refresh`` discipline."""
+        agg: Dict[int, List[np.ndarray]] = {}
+        for idx, dreq, dest, dprod in deltas:
+            a = agg.get(idx)
+            if a is None:
+                agg[idx] = [
+                    np.asarray(dreq, np.float32).copy(),
+                    np.asarray(dest, np.float32).copy(),
+                    np.asarray(dprod, np.float32).copy(),
+                ]
+            else:
+                a[0] += dreq
+                a[1] += dest
+                a[2] += dprod
+        idxs = sorted(agg)
+        d = len(self.snapshot.config.resources)
+        b = max(8, 1 << (len(idxs) - 1).bit_length())
+        ii = np.empty((b,), np.int32)
+        ii[: len(idxs)] = idxs
+        ii[len(idxs):] = idxs[-1]
+        rows = np.zeros((3, b, d), np.float32)
+        for i, idx in enumerate(idxs):
+            rows[0, i], rows[1, i], rows[2, i] = agg[idx]
+        idx_dev = jnp.asarray(ii)
+        return nodes.replace(
+            requested=nodes.requested.at[idx_dev].add(
+                jnp.asarray(rows[0])
+            ),
+            estimated_used=nodes.estimated_used.at[idx_dev].add(
+                jnp.asarray(rows[1])
+            ),
+            prod_used=nodes.prod_used.at[idx_dev].add(
+                jnp.asarray(rows[2])
+            ),
+        )
+
     def _dispatch_chained(
         self,
         chunks: List[List[Pod]],
@@ -3057,6 +3500,11 @@ class BatchScheduler:
         quarantine: Optional[Dict[str, tuple]] = None,
         prepared: Optional[list] = None,
         gang_view: tuple = (),
+        batch: Optional[Sequence[Pod]] = None,
+        prep_plan: Optional[_ResvPlan] = None,
+        chain_meta: Optional[CarryMeta] = None,
+        chained: bool = False,
+        prep_chain: object = None,
     ) -> Optional[Tuple[list, ChainCarry, CarryMeta]]:
         """Cross-cycle chained dispatch (the pipeline's speculative fast
         path): solve every chunk against the device-chained capacity
@@ -3074,32 +3522,118 @@ class BatchScheduler:
         ``prepared`` carries the prepare worker's (PodBatch,
         LoweredRows, node_mask) triples when it finished in time;
         otherwise lowering happens inline (cold, still correct).
-        Returns ``(solves, chain_out, carry_meta)``, or None when a
-        carried table no longer matches the live shapes (tree/topology
-        reshaped mid-chain — no speculation this cycle)."""
+        ``batch``/``prep_plan``/``chain_meta``/``chained`` serve the
+        reservation carry (open the last gates PR): the FULL batch is
+        re-previewed against the chained reservation/quota state and the
+        prepared chunks are reused only when the plan still matches the
+        prepare-time one. Returns ``(solves, chain_out, carry_meta)``,
+        or None when a carried table no longer matches the live shapes
+        (tree/topology reshaped mid-chain) or the reservation preview
+        refuses (NUMA/device ghost-hold swaps, operating-pod holds) —
+        no speculation this cycle."""
+        q_real = self.quotas.quota_count
+        carried_ext = None
+        if q_real > 0 and carry.quota_used is not None:
+            # tiny [2Q, D] fetch of an already-completed solve's output;
+            # the producing solve finished during the inter-feed window,
+            # so this rarely blocks
+            carried_ext = np.asarray(carry.quota_used)
+            if carried_ext.shape[0] < 2 * q_real:
+                return None  # tree reshaped mid-chain
+        # ---- reservation fast-path preview (open the last gates PR):
+        # predict which pods the consuming cycle's fast path will bind
+        # (they leave the solver chunks; their node/quota charges fold
+        # into the chain inputs) — every prediction is validated by
+        # value at consume (_carry_consume_ok) ----
+        resv_plan: Optional[_ResvPlan] = None
+        quota_prev: Optional[_QuotaFastpathPreview] = None
+        if self.reservations is not None:
+            if batch is None:
+                batch = [p for c in chunks for p in c]
+            # TRUST the prepare-time plan when it was previewed against
+            # exactly this chain (object identity — the worker's
+            # resv_ctx was the same newest spec this dispatch chains
+            # off, or both are fresh): re-running the match scan here
+            # would triple the fast path's per-cycle cost, two of the
+            # three on the pump thread. Safe: any state drift a stale
+            # plan could hide is caught by the consume-time by-value
+            # comparison — a wrong reuse costs a discard, never a
+            # divergent decision.
+            if prep_plan is not None and (
+                (chained and prep_chain is carry)
+                or (not chained and prep_chain is None)
+            ):
+                resv_plan = prep_plan
+                quota_prev = prep_plan.quota_prev
+            else:
+                if q_real > 0:
+                    if carried_ext is not None:
+                        quota_prev = self._quota_fastpath_preview_chain(
+                            carried_ext, chain_meta
+                        )
+                        if quota_prev is None:
+                            return None
+                    else:
+                        # live rows + raw live runtime (NO refresh —
+                        # purity): the values the consuming fast path
+                        # reads unless its previous cycle left the
+                        # manager dirty, in which case the prediction
+                        # misses and the consume guard discards
+                        quota_prev = self._quota_fastpath_preview_live()
+                resv_plan = self._reservation_fastpath_preview(
+                    batch,
+                    base_view=carry.resv_view,
+                    quota_prev=quota_prev,
+                    chain_nodes=carry.nodes if chained else None,
+                )
+                if resv_plan is None:
+                    return None
+                plan_matches = (
+                    prep_plan is not None
+                    and prep_plan.binds == resv_plan.binds
+                    and prep_plan.affinity_unsched
+                    == resv_plan.affinity_unsched
+                )
+                if not plan_matches:
+                    # the chain-aware preview disagrees with the
+                    # prepare-time one (a different chain than the
+                    # worker previewed against): re-chunk the remaining
+                    # pods and lower inline — cold but correct
+                    excluded = resv_plan.taken | set(
+                        resv_plan.affinity_unsched
+                    )
+                    remaining = [
+                        p for p in batch if p.meta.uid not in excluded
+                    ]
+                    eligible = self.pod_groups.begin_and_order(remaining)
+                    chunks = self._chunks(eligible)
+                    prepared = None
+                    gang_view = self.pod_groups.gang_view(eligible)
+            if not chunks:
+                # every pod rides the fast path — nothing to solve, so
+                # nothing worth speculating on
+                return None
         all_pods = [p for c in chunks for p in c]
         # quota tables: pure preview (no manager mutation — the trailing
         # cycle's PostFilter still reads the live requests/runtime); the
-        # used table is the device chain when one is carried
+        # used table is the device chain when one is carried, plus the
+        # reservation preview's predicted fast-path charges
         quotas0 = None
         qmeta = None
-        if self.quotas.quota_count > 0:
+        if q_real > 0:
+            charged = quota_prev is not None and quota_prev.charged
+            # the demand propagation's used term must be the POST-commit
+            # (and post-fast-path) ledger the consuming cycle will see —
+            # at a chained dispatch the host ledger is still pre-commit,
+            # so the device carry's predicted rows stand in. Without
+            # this the runtime preview diverges whenever consecutive
+            # batches admit into the same leaf and every chained quota
+            # speculation discards at validation.
             used_rows = None
-            if carry.quota_used is not None:
-                # the demand propagation's used term must be the
-                # POST-commit ledger the consuming cycle will see — at a
-                # chained dispatch the host ledger is still pre-commit,
-                # so fold in the device carry's predicted rows instead
-                # (tiny [2Q, D] fetch; the producing solve completed
-                # during the inter-feed window, so this rarely blocks).
-                # Without this the runtime preview diverges whenever
-                # consecutive batches admit into the same leaf and every
-                # chained quota speculation discards at validation.
-                q_real = self.quotas.quota_count
-                carried = np.asarray(carry.quota_used)
-                if carried.shape[0] < q_real:
-                    return None
-                used_rows = carried[:q_real]
+            if charged:
+                used_rows = quota_prev.used
+            elif carried_ext is not None:
+                used_rows = carried_ext[:q_real]
             by_leaf, _nonpre = self._quota_pending_demand(
                 all_pods, used_rows=used_rows
             )
@@ -3107,11 +3641,22 @@ class BatchScheduler:
                 by_leaf,
                 self.quotas.effective_cluster_total(self.snapshot),
             )
-            used0 = (
-                carry.quota_used
-                if carry.quota_used is not None
-                else jnp.asarray(used_ext)
-            )
+            if charged:
+                ext_host = (
+                    carried_ext.copy()
+                    if carried_ext is not None
+                    else np.asarray(used_ext, np.float32).copy()
+                )
+                if ext_host.shape[0] < 2 * q_real:
+                    return None
+                off = ext_host.shape[0] // 2
+                ext_host[:q_real] = quota_prev.used
+                ext_host[off : off + q_real] = quota_prev.nonpre
+                used0 = jnp.asarray(ext_host)
+            elif carried_ext is not None:
+                used0 = carry.quota_used
+            else:
+                used0 = jnp.asarray(used_ext)
             if tuple(used0.shape) != runtime_ext.shape:
                 return None
             quotas0 = QuotaState(
@@ -3174,6 +3719,11 @@ class BatchScheduler:
                 has_fpga=has_fpga,
             )
         cur = carry.nodes
+        if resv_plan is not None and resv_plan.node_deltas:
+            # predicted fast-path node charges (owner assumes, ghost
+            # forget, remainder re-assume): the consuming cycle's serial
+            # dispatch would lower node state AFTER the fast path ran
+            cur = self._fold_resv_node_deltas(cur, resv_plan.node_deltas)
         qused = quotas0.used if quotas0 is not None else None
         out = []
         for k, chunk in enumerate(chunks):
@@ -3255,9 +3805,25 @@ class BatchScheduler:
             quota_used=qused,
             dev=dev_carry if device_state is not None else None,
             numa_zone=numa_zone if numa_state is not None else None,
+            resv_view=resv_plan.view if resv_plan is not None else None,
+        )
+        resv_meta = (
+            _ResvCarryMeta(
+                binds=resv_plan.binds,
+                affinity_unsched=resv_plan.affinity_unsched,
+                pre_table=resv_plan.pre_table,
+                post_table=resv_plan.post_table,
+            )
+            if resv_plan is not None
+            else None
         )
         meta = CarryMeta(
-            quota=qmeta, numa=nmeta, dev=dmeta, gangs=gang_view
+            quota=qmeta,
+            numa=nmeta,
+            dev=dmeta,
+            gangs=gang_view,
+            resv=resv_meta,
+            modes=self._carry_modes(),
         )
         return out, chain_out, meta
 

@@ -74,6 +74,15 @@ class PreparedCycle:
     #: quota TREE shape the rows' chains were lowered against; a tree
     #: mutation between prepare and dispatch refuses the speculation
     quota_tree_version: int = -1
+    #: prepare-time reservation fast-path plan (open the last gates PR):
+    #: the chunks above already EXCLUDE its predicted fast-path binds
+    #: and required-affinity refusals; the dispatch TRUSTS the plan when
+    #: ``resv_chain`` is the very chain it dispatches off (identity),
+    #: else re-previews and reuses these triples only when the plans
+    #: still agree. None = reservations absent or refused.
+    resv_plan: object = None
+    #: the ChainCarry the plan was previewed against (None = live/fresh)
+    resv_chain: object = None
 
 
 def _merge_outcomes(outs: List[ScheduleOutcome]) -> Optional[ScheduleOutcome]:
@@ -141,14 +150,21 @@ class _PrepareWorker:
         batch: Sequence[Pod],
         warm_only: bool = False,
         stall: bool = False,
+        resv_ctx: Optional[tuple] = None,
     ) -> int:
         """``stall=True`` (decided by the PUMP thread's chaos evaluation
         — firing from the worker thread would make the injector's fault
         trace order race the pump's own points and break same-seed
         determinism) makes the worker wedge on this job: never acked,
-        thread dies."""
+        thread dies. ``resv_ctx`` is the newest in-flight speculation's
+        ``(chain_out, carry)`` (open the last gates PR): the prepare-time
+        reservation preview runs against the CHAINED predicted state so
+        its plan agrees with the dispatch-time re-preview and the
+        prepared triples stay reusable (a live-state plan would diverge
+        every time the upstream cycle consumed a reservation, forcing
+        cold inline re-lowering on the pump thread)."""
         self._seq += 1
-        self._req.put((self._seq, list(batch), warm_only, stall))
+        self._req.put((self._seq, list(batch), warm_only, stall, resv_ctx))
         return self._seq
 
     def collect(
@@ -179,7 +195,7 @@ class _PrepareWorker:
                 self._req.get_nowait()
         except _queue.Empty:
             pass
-        self._req.put((None, None, False, False))
+        self._req.put((None, None, False, False, None))
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout=10.0)
@@ -187,7 +203,7 @@ class _PrepareWorker:
     def _run(self) -> None:
         sched = self.sched
         while True:
-            job, batch, warm_only, stall = self._req.get()
+            job, batch, warm_only, stall, resv_ctx = self._req.get()
             if job is None:
                 return
             if stall:
@@ -200,7 +216,7 @@ class _PrepareWorker:
                     self._warm(batch)
                     prep = self.WARMED
                 else:
-                    prep = self._prepare(batch)
+                    prep = self._prepare(batch, resv_ctx)
             except Exception as exc:  # noqa: BLE001 — degrade, don't die
                 report_exception(
                     "scheduler.pipeline.prepare",
@@ -229,7 +245,9 @@ class _PrepareWorker:
                     batch, stash=False, quarantine={}, inject=False
                 )
 
-    def _prepare(self, batch: Sequence[Pod]) -> PreparedCycle:
+    def _prepare(
+        self, batch: Sequence[Pod], resv_ctx: Optional[tuple] = None
+    ) -> PreparedCycle:
         sched = self.sched
         snap = sched.snapshot
         with snap.lock:
@@ -241,6 +259,55 @@ class _PrepareWorker:
                 # the prepare bumps it, and the dispatch-time compare
                 # then refuses the speculation (stale lowered chains)
                 tree_v = sched.quotas.tree_version
+                # reservation carry (open the last gates PR): the P1
+                # preview predicts which pods the consuming cycle's
+                # fast path will bind — they must not be lowered into
+                # the solver chunks. CHAIN-seeded when an upstream
+                # speculation is in flight (resv_ctx): the preview runs
+                # against the predicted post state (reservation overlay
+                # + carried quota rows + chained node table, fetched on
+                # THIS worker thread so the pump never blocks on them),
+                # which makes it agree with the dispatch-time re-preview
+                # in the common case — the prepared triples stay
+                # reusable. Pure either way (overlay view + copies).
+                resv_plan = None
+                resv_chain = None
+                pods_in = batch
+                if sched.reservations is not None:
+                    base_view = None
+                    chain_nodes = None
+                    quota_prev = None
+                    if resv_ctx is not None:
+                        chain_out, carry_meta = resv_ctx
+                        resv_chain = chain_out
+                        base_view = chain_out.resv_view
+                        chain_nodes = chain_out.nodes
+                        if chain_out.quota_used is not None:
+                            quota_prev = (
+                                sched._quota_fastpath_preview_chain(
+                                    chain_out.quota_used, carry_meta
+                                )
+                            )
+                    if (
+                        quota_prev is None
+                        and sched.quotas.quota_count > 0
+                    ):
+                        quota_prev = sched._quota_fastpath_preview_live()
+                    resv_plan = sched._reservation_fastpath_preview(
+                        batch,
+                        base_view=base_view,
+                        quota_prev=quota_prev,
+                        chain_nodes=chain_nodes,
+                    )
+                    if resv_plan is not None:
+                        excluded = resv_plan.taken | set(
+                            resv_plan.affinity_unsched
+                        )
+                        pods_in = [
+                            p
+                            for p in batch
+                            if p.meta.uid not in excluded
+                        ]
                 # idempotent for warm-gang batches (the _prepare_ok
                 # gate): pending registries rebuild from the same batch
                 # at consume, no state creation beyond what the serial
@@ -250,7 +317,7 @@ class _PrepareWorker:
                 # under snap.lock (above), the same lock schedule()
                 # holds for its begin_and_order/Permit — the two
                 # interleave atomically, never mid-rebuild
-                eligible = sched.pod_groups.begin_and_order(batch)
+                eligible = sched.pod_groups.begin_and_order(pods_in)
                 chunks = sched._chunks(eligible)
                 triples = []
                 for chunk in chunks:
@@ -281,7 +348,92 @@ class _PrepareWorker:
                     node_epoch=snap.node_epoch,
                     gang_view=sched.pod_groups.gang_view(eligible),
                     quota_tree_version=tree_v,
+                    resv_plan=resv_plan,
+                    resv_chain=resv_chain,
                 )
+
+
+class _DepthController:
+    """Per-cycle pipeline-depth feedback controller (adaptive-depth PR).
+
+    The configured depth is a CEILING, not a setpoint: each feed picks
+    an effective depth in ``1..max_depth`` from the recent speculation
+    discard rate — the same signal the flight recorder records per
+    cycle (``speculation`` kept/discarded), so every choice is
+    explainable post-hoc from the black box. A high-churn window (most
+    consumes discarding on the version/carry guards) degrades to depth
+    1 BEFORE more deep dispatches are wasted; a quiet stretch (no
+    discard for :data:`QUIET_FEEDS` consecutive feeds — idle feeds
+    count, so a drain tail recovers) restores the ceiling and expires
+    the stale churn evidence. Deterministic: no clocks, no randomness —
+    the same outcome sequence always yields the same depth trace
+    (same-seed soak contract)."""
+
+    #: sliding window of recent speculative consume outcomes
+    WINDOW = 12
+    #: minimum outcomes before the rate is trusted
+    EVIDENCE = 4
+    #: discard rate at/above which depth degrades to 1
+    DEGRADE_RATE = 0.5
+    #: discard rate at/below which the ceiling is restored
+    RESTORE_RATE = 0.2
+    #: consecutive discard-free feeds that restore the ceiling
+    QUIET_FEEDS = 8
+
+    def __init__(self, max_depth: int, seed_outcomes: Sequence[bool] = ()):
+        self.max_depth = max(1, int(max_depth))
+        self._win: "deque[bool]" = deque(maxlen=self.WINDOW)
+        for kept in seed_outcomes:
+            self._win.append(bool(kept))
+        self._quiet = 0
+        self._depth = self.max_depth
+
+    @property
+    def discard_rate(self) -> float:
+        if not self._win:
+            return 0.0
+        return sum(1 for k in self._win if not k) / len(self._win)
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def note_outcome(self, kept: bool) -> None:
+        """One speculative consume settled (kept / discarded)."""
+        self._win.append(bool(kept))
+
+    def note_feed(self, had_discard: bool) -> None:
+        """One feed() completed; quiet feeds accumulate toward
+        restoration, any discard resets the streak."""
+        self._quiet = 0 if had_discard else self._quiet + 1
+
+    def choose(self) -> int:
+        """Effective depth for the NEXT feed."""
+        if self.max_depth <= 1:
+            return 1
+        if self._quiet >= self.QUIET_FEEDS:
+            if self._depth < self.max_depth:
+                # quiet restoration also expires the window: the churn
+                # it recorded is evidence about a world that stopped
+                # producing discards QUIET_FEEDS feeds ago
+                self._win.clear()
+            self._depth = self.max_depth
+        elif len(self._win) >= self.EVIDENCE:
+            rate = self.discard_rate
+            if rate >= self.DEGRADE_RATE:
+                self._depth = 1
+            elif rate <= self.RESTORE_RATE:
+                self._depth = self.max_depth
+        return self._depth
+
+    def info(self) -> Dict[str, object]:
+        return {
+            "max_depth": self.max_depth,
+            "depth": self._depth,
+            "discard_rate": round(self.discard_rate, 4),
+            "window": len(self._win),
+            "quiet_feeds": self._quiet,
+        }
 
 
 class CyclePipeline:
@@ -305,17 +457,44 @@ class CyclePipeline:
     before EITHER trailing commit has run, and the trailing-commit
     validation generalizes to a chain — an unclean commit (or any
     consume-guard miss) discards EVERY pending speculation downstream of
-    it, never just the head. Observable via ``solver_pipeline_depth``."""
+    it, never just the head. Observable via ``solver_pipeline_depth``.
+
+    Adaptive depth (open the last gates PR): ``depth`` is the CEILING —
+    a :class:`_DepthController` picks the effective in-flight window
+    per feed from the recent discard rate (high churn degrades to 1
+    before wasting deep dispatches, a quiet drain restores the max),
+    composed with the brownout L1 cap (effective = min of both; the
+    ladder always dominates while browning). ``adaptive=False`` pins
+    the configured depth. The chosen depth + its discard-rate input are
+    stamped on every flight-recorder cycle record and served at
+    ``/debug/pipeline``."""
 
     def __init__(
         self,
         sched: BatchScheduler,
         prepare_timeout_s: float = 5.0,
         depth: int = 1,
+        adaptive: bool = True,
     ):
         self.sched = sched
         self.prepare_timeout_s = prepare_timeout_s
         self.depth = max(1, int(depth))
+        self.adaptive = bool(adaptive)
+        # seed the controller's window from an adopted flight-recorder
+        # tail (takeover: the dead writer's churn evidence carries over)
+        seed: list = []
+        fr = sched.flight_recorder
+        if fr is not None:
+            for rec in fr.last(_DepthController.WINDOW):
+                outcome = rec.get("speculation")
+                if outcome in ("kept", "discarded"):
+                    seed.append(outcome == "kept")
+        self._controller = _DepthController(self.depth, seed)
+        #: the cap the most recent feed ran under (min of the adaptive
+        #: choice and the brownout ladder's cap) + the adaptive choice
+        #: itself — sampled by the soaks' interplay assertions
+        self.last_depth_cap = self.depth
+        self.last_adaptive_depth = self.depth
         self._worker = _PrepareWorker(sched)
         #: in-flight entries, oldest first (≤ depth of them)
         self._pending: "deque[_InFlight]" = deque()
@@ -411,8 +590,23 @@ class CyclePipeline:
                 stall = sched.chaos.enabled and sched.chaos.fire(
                     "pipeline.worker_stall"
                 )
+                # chain context for the prepare-time reservation preview
+                # (the dispatch below will chain off this same newest
+                # spec, so the worker's plan and the dispatch's agree)
+                resv_ctx = None
+                if (
+                    full_ok
+                    and sched.reservations is not None
+                    and self._pending
+                    and self._pending[-1].spec is not None
+                ):
+                    spec0 = self._pending[-1].spec
+                    resv_ctx = (spec0.chain_out, spec0.carry)
                 job = self._worker.submit(
-                    batch, warm_only=not full_ok, stall=stall
+                    batch,
+                    warm_only=not full_ok,
+                    stall=stall,
+                    resv_ctx=resv_ctx,
                 )
             else:
                 # prepare refused (cold gangs / pod transformers): still
@@ -437,16 +631,25 @@ class CyclePipeline:
                 if prep is not None and prep is not _PrepareWorker.WARMED:
                     spec_new = self._dispatch(
                         prep,
+                        batch,
                         chain=newest.spec.chain_out,
                         chain_version=newest.spec.version,
+                        chain_meta=newest.spec.carry,
                     )
-        # brownout L1 (overload-control PR): cap the in-flight window at
-        # 1 — a storm's churn discards chained speculation anyway, so
-        # stop paying for the deep dispatches it will throw away
-        depth_cap = self.depth
+        # adaptive depth (open the last gates PR): the controller picks
+        # the in-flight window from the recent discard rate, composed
+        # with the brownout L1 cap (overload-control PR: a storm's churn
+        # discards chained speculation anyway — stop paying for deep
+        # dispatches it will throw away). The ladder's cap DOMINATES
+        # while browning; the controller's choice resumes at L0.
+        chosen = self._controller.choose() if self.adaptive else self.depth
+        depth_cap = chosen
         bo = sched.brownout
         if bo is not None:
             depth_cap = min(depth_cap, bo.pipeline_depth_cap())
+        self.last_adaptive_depth = chosen
+        self.last_depth_cap = depth_cap
+        had_discard = False
         outs: List[ScheduleOutcome] = []
         while self._pending and (
             not batch
@@ -466,11 +669,21 @@ class CyclePipeline:
             entry = self._pending.popleft()
             sched.last_gate_report = entry.gates
             sched._speculative = entry.spec
+            sched._depth_decision = (
+                depth_cap,
+                self.depth,
+                round(self._controller.discard_rate, 4),
+            )
             outs.append(sched.schedule(entry.batch))
             if entry.span is not None:
                 entry.span.__exit__(None, None, None)
             kept = entry.spec is not None and sched._cycle_used_spec
             clean = kept and sched.last_cycle_spec_safe()
+            if entry.spec is not None:
+                # feed the depth controller the same per-cycle outcome
+                # the flight recorder records
+                self._controller.note_outcome(kept)
+                had_discard = had_discard or not kept
             if clean:
                 # retroactively valid: the commit applied exactly the
                 # deltas the chain already carried — re-stamp EVERY
@@ -493,6 +706,8 @@ class CyclePipeline:
                     counter = reg.get("pipeline_speculation_total")
                     for _ in range(discards):
                         counter.labels(outcome="discarded").inc()
+                        self._controller.note_outcome(False)
+                    had_discard = True
                 for e in self._pending:
                     if e.span is not None:
                         e.span.__exit__(None, None, None)
@@ -525,7 +740,7 @@ class CyclePipeline:
                 and prep is not None
                 and prep is not _PrepareWorker.WARMED
             ):
-                spec_new = self._dispatch(prep, chain=None)
+                spec_new = self._dispatch(prep, batch, chain=None)
         span = None
         if spec_new is not None:
             # the overlap span ties dispatch to consume: its duration is
@@ -538,6 +753,7 @@ class CyclePipeline:
                     batch=batch, spec=spec_new, span=span, gates=this_gates
                 )
             )
+        self._controller.note_feed(had_discard)
         depth = sum(
             1 + (1 if e.spec is not None else 0) for e in self._pending
         )
@@ -572,19 +788,24 @@ class CyclePipeline:
     def _dispatch(
         self,
         prep: PreparedCycle,
+        batch: Sequence[Pod],
         chain,
         chain_version: Optional[int] = None,
+        chain_meta=None,
     ) -> Optional[SpeculativeSolve]:
         """Dispatch the prepared chunks chained off ``chain`` (a
         :class:`~.batch_solver.ChainCarry`, or off the refreshed resident
         state when None), under the snapshot lock so the version stamp is
-        exact. Returns None when the prepared lowering no longer matches
-        the live snapshot."""
+        exact. ``batch`` is the FULL fed batch — the reservation carry
+        re-previews the fast path against the chained state and may
+        re-chunk, so the final chunk uids come from the dispatch, not
+        the prepare. Returns None when the prepared lowering no longer
+        matches the live snapshot."""
         from .batch_solver import ChainCarry
 
         sched = self.sched
         snap = sched.snapshot
-        if not prep.chunks:
+        if not prep.chunks and sched.reservations is None:
             return None
         with snap.lock:
             v = snap.version
@@ -626,14 +847,24 @@ class CyclePipeline:
                     quarantine=prep.quarantine,
                     prepared=prep.triples,
                     gang_view=prep.gang_view,
+                    batch=list(batch),
+                    prep_plan=prep.resv_plan,
+                    chain_meta=chain_meta,
+                    chained=chain_meta is not None,
+                    prep_chain=prep.resv_chain,
                 )
             if dispatched is None:
                 # a carried table no longer matches the live shapes
-                # (tree/topology reshape mid-chain) — no speculation
+                # (tree/topology reshape mid-chain), or the reservation
+                # preview refused — no speculation
                 return None
             solves, chain_out, carry = dispatched
             return SpeculativeSolve(
-                chunk_uids=prep.chunk_uids,
+                # derived from the DISPATCHED chunks — the reservation
+                # re-preview may have re-chunked past the prepared ones
+                chunk_uids=tuple(
+                    tuple(p.meta.uid for p in c) for c, _r, _s in solves
+                ),
                 sub=None,
                 solves=solves,
                 chain_out=chain_out,
@@ -667,6 +898,7 @@ class CyclePipeline:
         (quota/NUMA/device/gang) serial"."""
         reg = self.sched.extender.registry
         depth = reg.get("solver_pipeline_depth")
+        bo = self.sched.brownout
         return {
             "pipelined": True,
             "last": dict(self.last_gate_report),
@@ -674,6 +906,18 @@ class CyclePipeline:
             "cycles_fast": self._fast_cycles,
             "depth": depth.value() if depth is not None else 0.0,
             "max_depth": self.depth,
+            # adaptive-depth PR: the controller's live choice and its
+            # discard-rate input, plus the effective cap after the
+            # brownout ladder's L1 composition — depth decisions must
+            # be explainable from this payload and the flight recorder
+            "depth_controller": dict(
+                self._controller.info(),
+                adaptive=self.adaptive,
+                effective_cap=self.last_depth_cap,
+                brownout_cap=(
+                    bo.pipeline_depth_cap() if bo is not None else None
+                ),
+            ),
         }
 
     def _gates_ok(self, batch: Sequence[Pod]) -> bool:

@@ -322,6 +322,57 @@ class GroupQuotaManager:
                 setattr(self, attr, grown)
                 self.state_version += 1
 
+    def headroom_in(
+        self,
+        quota_name: str,
+        vec: np.ndarray,
+        non_preemptible: bool,
+        used: np.ndarray,
+        nonpre: np.ndarray,
+        runtime: np.ndarray,
+    ) -> bool:
+        """The chain-walk admission arithmetic of :meth:`has_headroom`
+        against CALLER-SUPPLIED ledgers — the single source of truth
+        shared by the live check and the pipeline's pure fast-path
+        preview (open the last gates PR). A drift between the two would
+        make predicted fast-path binds silently diverge from real ones
+        (every reservation speculation discarding with no failing test),
+        so there must be exactly ONE copy of this arithmetic."""
+        chain = self.chain_of(quota_name)
+        for idx in chain:
+            if idx < used.shape[0] and np.any(
+                used[idx] + vec > runtime[idx] + 1e-3
+            ):
+                return False
+        if non_preemptible and chain:
+            leaf_min = self.config.res_vector(
+                self._nodes[quota_name].quota.min
+            )
+            if np.any(nonpre[chain[0]] + vec > leaf_min + 1e-3):
+                return False
+        return True
+
+    def charge_in(
+        self,
+        quota_name: str,
+        vec: np.ndarray,
+        non_preemptible: bool,
+        used: np.ndarray,
+        nonpre: np.ndarray,
+    ) -> bool:
+        """The chain-walk charge arithmetic of :meth:`charge` against
+        caller-supplied ledgers (shared with the preview — same rule as
+        :meth:`headroom_in`). Returns whether anything was charged."""
+        chain = self.chain_of(quota_name)
+        for idx in chain:
+            if idx < used.shape[0]:
+                used[idx] += vec
+        if non_preemptible and chain:
+            # leaf-only ledger: admission checks min at the LEAF
+            # (plugin.go:252-262); parents roll up at stamping time
+            nonpre[chain[0]] += vec
+        return bool(chain)
+
     def has_headroom(
         self,
         quota_name: str,
@@ -335,19 +386,14 @@ class GroupQuotaManager:
         self._ensure_capacity()
         if self._dirty:
             self.refresh_runtime()
-        vec = self.config.res_vector(requests)
-        chain = self.chain_of(quota_name)
-        for idx in chain:
-            if np.any(self.used[idx] + vec > self.runtime[idx] + 1e-3):
-                return False
-        if non_preemptible and chain:
-            leaf = chain[0]
-            leaf_min = self.config.res_vector(
-                self._nodes[quota_name].quota.min
-            )
-            if np.any(self.nonpre_used[leaf] + vec > leaf_min + 1e-3):
-                return False
-        return True
+        return self.headroom_in(
+            quota_name,
+            self.config.res_vector(requests),
+            non_preemptible,
+            self.used,
+            self.nonpre_used,
+            self.runtime,
+        )
 
     def charge(
         self,
@@ -359,14 +405,9 @@ class GroupQuotaManager:
         self._ensure_capacity()
         if vec is None:
             vec = self.config.res_vector(requests)
-        chain = self.chain_of(quota_name)
-        for idx in chain:
-            self.used[idx] += vec
-        if non_preemptible and chain:
-            # leaf-only ledger: admission checks min at the LEAF
-            # (plugin.go:252-262); parents roll up at stamping time
-            self.nonpre_used[chain[0]] += vec
-        if chain:
+        if self.charge_in(
+            quota_name, vec, non_preemptible, self.used, self.nonpre_used
+        ):
             self.state_version += 1
 
     def refund(

@@ -80,6 +80,122 @@ def matches_owner(reservation: Reservation, pod: Pod) -> bool:
     return False
 
 
+class ResvView:
+    """Pure overlay over the live reservation/snapshot state for the
+    pipeline's dispatch-side fast-path PREVIEW (open the last speculation
+    gates PR). Reads fall through to the live objects; predicted
+    mutations accumulate in the overlay dicts only — the manager and the
+    snapshot are never touched (the quota-preview purity discipline).
+    A chained dispatch seeds its view from the upstream speculation's
+    view (``clone``), so cycle N+1's preview runs against cycle N's
+    PREDICTED post-fast-path state; the consuming cycle validates every
+    prediction by value (``BatchScheduler._carry_consume_ok``) before a
+    speculation built on this view may be kept."""
+
+    __slots__ = (
+        "mgr", "phase", "allocated", "owners", "ledger", "assumed",
+        "node_req", "_cands",
+    )
+
+    def __init__(self, mgr: "ReservationManager"):
+        self.mgr = mgr
+        #: lazy per-PREVIEW candidate cache (see candidates()) — reset
+        #: on clone so a carried view never serves a stale list
+        self._cands: Optional[List[Reservation]] = None
+        #: name -> predicted phase (terminal transitions)
+        self.phase: Dict[str, ReservationPhase] = {}
+        #: name -> predicted allocated dict (full copy once touched)
+        self.allocated: Dict[str, Dict[str, float]] = {}
+        #: name -> predicted current_owners list (full copy once touched)
+        self.owners: Dict[str, List[str]] = {}
+        #: name -> predicted owner ledger {uid: consumed} (copy on touch)
+        self.ledger: Dict[str, Dict[str, Dict[str, float]]] = {}
+        #: uid -> predicted assume entry (None = predicted forgotten);
+        #: entries are (request_vec, estimate_vec, is_prod) host rows
+        self.assumed: Dict[str, Optional[tuple]] = {}
+        #: node idx -> predicted delta on snapshot.nodes.requested
+        self.node_req: Dict[int, "np.ndarray"] = {}
+
+    def clone(self) -> "ResvView":
+        out = ResvView(self.mgr)
+        out.phase = dict(self.phase)
+        out.allocated = {k: dict(v) for k, v in self.allocated.items()}
+        out.owners = {k: list(v) for k, v in self.owners.items()}
+        out.ledger = {
+            k: {u: dict(c) for u, c in v.items()}
+            for k, v in self.ledger.items()
+        }
+        out.assumed = dict(self.assumed)
+        out.node_req = {k: v.copy() for k, v in self.node_req.items()}
+        out._cands = None
+        return out
+
+    # ---- overlay reads ----
+
+    def phase_of(self, r: Reservation) -> ReservationPhase:
+        return self.phase.get(r.meta.name, r.phase)
+
+    def allocated_of(self, r: Reservation) -> Dict[str, float]:
+        return self.allocated.get(r.meta.name, r.allocated)
+
+    def owners_of(self, r: Reservation) -> List[str]:
+        return self.owners.get(r.meta.name, r.current_owners)
+
+    def assumed_entry(self, uid: str) -> Optional[tuple]:
+        """Predicted (request, estimate, is_prod) for ``uid``'s snapshot
+        assume, falling through to the live entry; None = no hold."""
+        if uid in self.assumed:
+            return self.assumed[uid]
+        ap = self.mgr.scheduler.snapshot._assumed.get(uid)
+        if ap is None or ap.absorbed:
+            # absorbed pods carry no pending estimate; the fast path
+            # never touches them — treat as no predictable hold
+            return None if ap is None else (ap.request, None, ap.is_prod)
+        return (ap.request, ap.estimate, ap.is_prod)
+
+    def node_requested(self, idx: int) -> "np.ndarray":
+        import numpy as np  # noqa: F811 — local like spill_fits_node
+
+        row = self.mgr.scheduler.snapshot.nodes.requested[idx]
+        delta = self.node_req.get(idx)
+        return row if delta is None else row + delta
+
+    # ---- overlay writes (predicted mutations) ----
+
+    def _alloc_mut(self, r: Reservation) -> Dict[str, float]:
+        return self.allocated.setdefault(r.meta.name, dict(r.allocated))
+
+    def _owners_mut(self, r: Reservation) -> List[str]:
+        return self.owners.setdefault(
+            r.meta.name, list(r.current_owners)
+        )
+
+    def _ledger_mut(self, name: str) -> Dict[str, Dict[str, float]]:
+        return self.ledger.setdefault(
+            name,
+            {
+                u: dict(c)
+                for u, c in self.mgr._owner_requests.get(name, {}).items()
+            },
+        )
+
+    def add_node_delta(self, idx: int, delta: "np.ndarray") -> None:
+        cur = self.node_req.get(idx)
+        self.node_req[idx] = delta.copy() if cur is None else cur + delta
+
+    def candidates(self) -> List[Reservation]:
+        """The preview's candidate list, built ONCE per preview run (a
+        clone resets the cache): rebuilding it inside every per-pod
+        ``match`` call re-creates exactly the O(R)-per-pod re-validation
+        hot spot ``begin_cycle``'s cycle cache exists to remove. Safe to
+        cache for one preview: nothing under ``snapshot.lock`` adds
+        reservations or removes nodes mid-preview, and predicted phase
+        transitions are filtered per candidate by ``phase_of`` at use."""
+        if self._cands is None:
+            self._cands = self.mgr._preview_candidates(self)
+        return self._cands
+
+
 def _reservation_order(r: Reservation) -> Optional[int]:
     """Non-zero integer order label, or None (reference
     ``findMostPreferredReservationByOrder``: unparseable/zero = unordered)."""
@@ -93,17 +209,22 @@ def _reservation_order(r: Reservation) -> Optional[int]:
     return order if order != 0 else None
 
 
-def _score_reservation(pod: Pod, r: Reservation) -> float:
+def _score_reservation(
+    pod: Pod, r: Reservation, allocated: Optional[Dict[str, float]] = None
+) -> float:
     """MostAllocated fit score over the reservation's own resource dims
     (reference ``scoring.go:196-209`` scoreReservation): mean of
     ``100·min(req+allocated ≤ cap)/cap``; dims the pod would overflow
-    contribute 0."""
+    contribute 0. ``allocated`` substitutes the live ledger (the
+    pipeline preview passes its overlay view's)."""
+    if allocated is None:
+        allocated = r.allocated
     resources = {k: v for k, v in r.requests.items() if v > 0}
     if not resources:
         return 0.0
     s = 0.0
     for k, cap in resources.items():
-        req = pod.spec.requests.get(k, 0.0) + r.allocated.get(k, 0.0)
+        req = pod.spec.requests.get(k, 0.0) + allocated.get(k, 0.0)
         # same epsilon as the match() capacity filter: float accumulation
         # noise must not zero the tightest dim of an exact-fit candidate
         if req <= cap + 1e-6:
@@ -316,13 +437,16 @@ class ReservationManager:
 
     # ---- owner matching / allocation ----
 
-    def remaining(self, r: Reservation) -> Dict[str, float]:
+    def remaining(
+        self, r: Reservation, view: Optional[ResvView] = None
+    ) -> Dict[str, float]:
+        alloc = r.allocated if view is None else view.allocated_of(r)
         return {
-            k: v - r.allocated.get(k, 0.0) for k, v in r.requests.items()
+            k: v - alloc.get(k, 0.0) for k, v in r.requests.items()
         }
 
     def consumed_and_spill(
-        self, r: Reservation, pod: Pod
+        self, r: Reservation, pod: Pod, view: Optional[ResvView] = None
     ) -> tuple[Dict[str, float], Dict[str, float]]:
         """Single source of truth for the allocate-policy arithmetic
         (reservation_types.go:78-97): per dim, ``consumed`` is what the
@@ -331,7 +455,7 @@ class ReservationManager:
         capacity (the Aligned overflow plus every undeclared dim). Used
         by candidate matching, the commit headroom check, and the
         allocation charge — they must never diverge."""
-        remaining = self.remaining(r)
+        remaining = self.remaining(r, view)
         consumed: Dict[str, float] = {}
         spill: Dict[str, float] = {}
         for k, v in pod.spec.requests.items():
@@ -347,10 +471,16 @@ class ReservationManager:
         return consumed, spill
 
     def spill_fits_node(
-        self, r: Reservation, spill: Dict[str, float]
+        self,
+        r: Reservation,
+        spill: Dict[str, float],
+        view: Optional[ResvView] = None,
     ) -> bool:
         """Whether the reservation's node has free capacity for the
-        owner's spill (beyond every live hold, the ghost included)."""
+        owner's spill (beyond every live hold, the ghost included).
+        ``view`` substitutes the predicted requested row (the preview's
+        node overlay — upstream speculative commits + predicted fast
+        binds) for the live snapshot row."""
         if not spill:
             return True
         if r.node_name is None:
@@ -362,15 +492,20 @@ class ReservationManager:
         import numpy as np
 
         na = snap.nodes
+        requested = (
+            na.requested[idx] if view is None else view.node_requested(idx)
+        )
         return bool(
             na.schedulable[idx]
             and np.all(
-                na.requested[idx] + snap.config.res_vector(spill)
+                requested + snap.config.res_vector(spill)
                 <= na.allocatable[idx] + 1e-3
             )
         )
 
-    def match(self, pod: Pod) -> Optional[Reservation]:
+    def match(
+        self, pod: Pod, view: Optional[ResvView] = None
+    ) -> Optional[Reservation]:
         """Nominate the best matching Available reservation for ``pod``
         (reference nominator, ``nominator.go:207-279`` + ``scoring.go``):
         collect every candidate whose owners match and whose remaining
@@ -383,7 +518,15 @@ class ReservationManager:
         small pods drain small reservations before fragmenting big ones.
         A pod carrying the reservation-affinity annotation additionally
         restricts the candidate set by name or reservation labels; a pod
-        labeled reservation-ignored never matches (reservation.go:97-99)."""
+        labeled reservation-ignored never matches (reservation.go:97-99).
+
+        ``view`` (open the last gates PR) runs the SAME nomination
+        against a pure overlay — predicted phases/allocations/owners and
+        predicted node capacity — without touching the live per-cycle
+        candidate cache; the pipeline's dispatch-side preview is exactly
+        this call, so a preview and the consuming cycle's real match can
+        only diverge when the state between them really changed (and the
+        consume-time table comparison then discards the speculation)."""
         if ext.is_reservation_ignored(pod):
             return None
         affinity = ext.parse_reservation_affinity(pod.meta.annotations)
@@ -393,10 +536,16 @@ class ReservationManager:
         best: Optional[Reservation] = None
         best_score = -1.0
         best_order: Optional[int] = None
-        for r in self._candidates():
-            if r.phase != ReservationPhase.AVAILABLE:
+        for r in (
+            self._candidates() if view is None else view.candidates()
+        ):
+            phase = r.phase if view is None else view.phase_of(r)
+            if phase != ReservationPhase.AVAILABLE:
                 continue  # consumed earlier in this same cycle
-            if r.allocate_once and r.current_owners:
+            owners = (
+                r.current_owners if view is None else view.owners_of(r)
+            )
+            if r.allocate_once and owners:
                 continue
             if affinity is not None:
                 name = affinity.get("name")
@@ -426,7 +575,7 @@ class ReservationManager:
             # candidate whose spill cannot fit its node is skipped HERE so
             # a drained-but-preferred reservation can never shadow a
             # feasible one (reviewer finding r3).
-            consumed, spill = self.consumed_and_spill(r, pod)
+            consumed, spill = self.consumed_and_spill(r, pod, view)
             if r.allocate_policy == RESERVATION_ALLOCATE_POLICY_RESTRICTED:
                 # restricted-options may narrow WHICH dims are binding
                 # (reservation.go:89-96); default = every reserved dim
@@ -440,7 +589,7 @@ class ReservationManager:
                 )
                 if any(k in binding for k in spill):
                     continue
-            if not self.spill_fits_node(r, spill):
+            if not self.spill_fits_node(r, spill, view):
                 continue
             order = _reservation_order(r)
             if order is not None:
@@ -450,7 +599,9 @@ class ReservationManager:
                 continue
             if best_order is not None:
                 continue  # an ordered candidate always beats scored ones
-            score = _score_reservation(pod, r)
+            score = _score_reservation(
+                pod, r, None if view is None else view.allocated_of(r)
+            )
             if score > best_score or (
                 score == best_score
                 and best is not None
@@ -493,6 +644,133 @@ class ReservationManager:
             self.begin_cycle()
         return self._cycle_candidates
 
+    def _preview_candidates(self, view: ResvView) -> List[Reservation]:
+        """Pure analog of :meth:`_candidates` for the pipeline preview:
+        Available (per the view's predicted phases) reservations on live
+        nodes. Dead-node reservations are SKIPPED, never failed — the
+        terminal transition belongs to the consuming cycle's
+        ``begin_cycle`` (and a removed node bumps ``node_epoch``, which
+        discards the speculation before any prediction here matters)."""
+        snap = self.scheduler.snapshot
+        return [
+            r
+            for r in self._reservations.values()
+            if view.phase_of(r) == ReservationPhase.AVAILABLE
+            and r.node_name is not None
+            and snap.node_id(r.node_name) is not None
+        ]
+
+    def has_available(self) -> bool:
+        """Any Available reservation at all — the cheap speculation-gate
+        input: with none, the fast path cannot bind and a preview is
+        trivially empty (NUMA/device ghost-hold swaps unreachable)."""
+        return any(
+            r.phase == ReservationPhase.AVAILABLE
+            for r in self._reservations.values()
+        )
+
+    def is_operating_backed(self, name: str) -> bool:
+        return name in self._operating
+
+    def table_view(self, view: Optional[ResvView] = None) -> tuple:
+        """Canonical by-value lowering of the reservation ledger —
+        phase, node, requests, allocated, owners and the owner-request
+        ledger per reservation, name-sorted. This is what the pipeline's
+        consume-time validation compares: the dispatch-time table, the
+        predicted post-fast-path table (``view`` applies the preview's
+        overlays) and the live table after the real fast path ran must
+        all line up bit-exactly or the speculation is discarded —
+        allocated values are produced by the same float arithmetic on
+        both sides, so equality is exact, not approximate."""
+        out = []
+        for name in sorted(self._reservations):
+            r = self._reservations[name]
+            if view is None:
+                phase, alloc, owners = r.phase, r.allocated, r.current_owners
+                ledger = self._owner_requests.get(name, {})
+            else:
+                phase = view.phase_of(r)
+                alloc = view.allocated_of(r)
+                owners = view.owners_of(r)
+                ledger = view.ledger.get(
+                    name, self._owner_requests.get(name, {})
+                )
+            out.append((
+                name,
+                phase.value,
+                r.node_name,
+                bool(r.allocate_once),
+                tuple(sorted((k, float(v)) for k, v in r.requests.items())),
+                tuple(sorted((k, float(v)) for k, v in alloc.items())),
+                tuple(owners),
+                tuple(sorted(
+                    (uid, tuple(sorted((k, float(v)) for k, v in c.items())))
+                    for uid, c in ledger.items()
+                )),
+            ))
+        return tuple(out)
+
+    def preview_allocate(
+        self, reservation: Reservation, pod: Pod, view: ResvView
+    ) -> List[tuple]:
+        """Pure mirror of :meth:`allocate` against the overlay view: the
+        predicted ledger mutations land in ``view`` and the predicted
+        SNAPSHOT effects (ghost forget, remainder-ghost assume) are
+        returned as ``(node_idx, d_requested, d_estimated, d_prod)``
+        delta rows for the dispatch to fold into the chained node table.
+        Callers must have refused operating-pod-backed reservations and
+        NUMA/device-bearing configs already (their ghost-hold swaps are
+        host-allocator decisions a pure preview cannot reproduce).
+        Divergence between this arithmetic and the real ``allocate`` is
+        caught by the consume-time ``table_view`` comparison — the
+        predicted post table is built HERE, the actual one by the real
+        call, and a kept speculation requires them equal."""
+        import numpy as np
+
+        assert reservation.meta.name not in self._operating
+        snap = self.scheduler.snapshot
+        node = reservation.node_name
+        idx = snap.node_id(node)
+        assert idx is not None
+        name = reservation.meta.name
+        consumed, _spill = self.consumed_and_spill(reservation, pod, view)
+        alloc = view._alloc_mut(reservation)
+        for k, take in consumed.items():
+            alloc[k] = alloc.get(k, 0.0) + take
+        view._owners_mut(reservation).append(pod.meta.uid)
+        view._ledger_mut(name)[pod.meta.uid] = dict(consumed)
+        deltas: List[tuple] = []
+        # the full ghost hold is forgotten (allocate's snap.forget_pod)
+        hold_uid = _ghost_uid(reservation)
+        entry = view.assumed_entry(hold_uid)
+        if entry is not None:
+            req, est, is_prod = entry
+            d_est = np.zeros_like(req) if est is None else -est
+            deltas.append((
+                idx, -req, d_est, d_est if is_prod else np.zeros_like(req)
+            ))
+            view.add_node_delta(idx, -req)
+        view.assumed[hold_uid] = None
+        if reservation.allocate_once:
+            view.allocated[name] = dict(reservation.requests)
+            view.phase[name] = ReservationPhase.SUCCEEDED
+        else:
+            ghost = self._remainder_ghost(reservation, view)
+            if ghost.spec.requests:
+                # assume_pod(ghost, node): request = estimate = the
+                # remainder vector, no CPU-bind amplification (ghosts
+                # carry no bind annotation), prod band per GHOST_PRIORITY
+                vec = snap.config.res_vector(ghost.spec.requests)
+                is_prod = (
+                    ghost.priority_class == ext.PriorityClass.PROD
+                )
+                deltas.append((
+                    idx, vec, vec, vec if is_prod else np.zeros_like(vec)
+                ))
+                view.add_node_delta(idx, vec)
+                view.assumed[hold_uid] = (vec, vec, is_prod)
+        return deltas
+
     def release_ghost_holds(self, reservation: Reservation) -> None:
         """Release the ghost's per-winner NUMA/device allocations (the
         reservation's reserved cpuset + device minors). Called before an
@@ -508,11 +786,15 @@ class ReservationManager:
         if getattr(self.scheduler, "numa", None) is not None:
             self.scheduler.numa.release(uid, node)
 
-    def _remainder_ghost(self, reservation: Reservation) -> Pod:
+    def _remainder_ghost(
+        self, reservation: Reservation, view: Optional[ResvView] = None
+    ) -> Pod:
         """Ghost pod sized to the reservation's unconsumed remainder."""
         ghost = self._ghost_pod(reservation)
         ghost.spec.requests = {
-            k: v for k, v in self.remaining(reservation).items() if v > 1e-6
+            k: v
+            for k, v in self.remaining(reservation, view).items()
+            if v > 1e-6
         }
         return ghost
 

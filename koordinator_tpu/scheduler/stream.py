@@ -44,9 +44,13 @@ class StreamScheduler:
     — and returns the PREVIOUS batch's decisions (one-pump lag; call
     :meth:`flush` to drain the tail). Decisions are identical to the
     serial pump; only the overlap differs. ``pipeline_depth`` > 1
-    (open-the-gates PR) lets the pipeline hold that many speculative
-    solves in flight (decisions then lag up to ``pipeline_depth``
-    pumps; the flush loop drains them all).
+    (open-the-gates PR) lets the pipeline hold up to that many
+    speculative solves in flight (decisions then lag up to
+    ``pipeline_depth`` pumps; the flush loop drains them all). The
+    value is a CEILING (open the last gates PR): the pipeline's
+    adaptive depth controller degrades the effective window to 1 under
+    sustained speculation churn and restores the max on quiet
+    stretches — see :class:`~.pipeline._DepthController`.
 
     Distributed observability (fleet-tracing PR): ``lifecycle`` (a
     :class:`~..obs.lifecycle.PodLifecycle`) receives per-pod

@@ -852,6 +852,12 @@ def run_chaos_soak(
         "crash_restarts": 0,
         "recovered_bindings": 0,
         "cycles_without_leader": 0,
+        #: adaptive-depth PR: the controller's per-cycle choice (plain
+        #: arm runs max depth 2 — the trace must flex 2→1 under the
+        #: fault-window churn and recover to 2 in the quiet tail).
+        #: Deterministic: the controller draws no randomness, so the
+        #: same seed yields the same trace (determinism arm compares it)
+        "depth_trace": [],
     }
     placed: dict = {}        # uid -> node, forever (duplicate guard)
     live: list = []          # (pod, node, done_cycle)
@@ -1179,6 +1185,7 @@ def run_chaos_soak(
             )
             out = ScheduleOutcome(bound=[], unschedulable=[])
             _crash_restart(orphans)
+        stats["depth_trace"].append(pipe.last_adaptive_depth)
         new_bound = []
         for pod, node in out.bound:
             # INVARIANT: a pod binds exactly once, ever
@@ -1256,6 +1263,16 @@ def run_chaos_soak(
         pending.extend(final.unschedulable)
         assert hub.wait_synced()
         _sync_cycle_delta(final_bound, [])
+    # adaptive-depth recovery leg (open the last gates PR): a FIXED
+    # quiet tail — no arrivals, no faults, no rng-stream draws — after
+    # the drain. The depth controller must re-deepen to the configured
+    # max once the churn evidence goes quiet ("a quiet drain deepens"),
+    # and the trace records it for the soak's 2→1→2 assertion.
+    from koordinator_tpu.scheduler.pipeline import _DepthController
+
+    for _ in range(2 * _DepthController.QUIET_FEEDS):
+        pipe.feed([])
+        stats["depth_trace"].append(pipe.last_adaptive_depth)
     pipe.close()
 
     # ---- end-state assertions ----
@@ -2682,6 +2699,9 @@ def run_overload_storm_soak(
         held_tickets[:] = keep
 
     level_trace: list = []
+    #: (ladder level at pump, effective depth cap, adaptive choice) per
+    #: owned pipeline per cycle — the brownout-interplay assertions
+    depth_cap_samples: list = []
     total_cycles = cycles + drain_limit
     for cycle in range(total_cycles):
         sim_cycle[0] = cycle
@@ -2750,9 +2770,26 @@ def run_overload_storm_soak(
         pending = still
 
         # ---- pump every owned shard ----
+        level_at_pump = brownout.level
         for inc in incs:
             if not inc.dead:
                 _absorb_decided(inc.pump())
+        # adaptive-depth × brownout interplay (open the last gates PR):
+        # sample every owned pipeline's effective cap against the ladder
+        # level the pumps ran under — L1+'s cap must DOMINATE the
+        # adaptive controller, and the controller's choice must be the
+        # effective cap again once the ladder is back at L0
+        for inc in incs:
+            if inc.dead:
+                continue
+            for s in inc.owned():
+                rt = inc.runtime(s)
+                pipe = rt.stream._pipe if rt is not None else None
+                if pipe is not None:
+                    depth_cap_samples.append(
+                        (level_at_pump, pipe.last_depth_cap,
+                         pipe.last_adaptive_depth)
+                    )
 
         # ---- completions free capacity ----
         stillliving = []
@@ -2926,6 +2963,26 @@ def run_overload_storm_soak(
         f"trace {level_trace})"
     )
     assert brownout.stats["deescalations"] >= 1
+    # adaptive depth × brownout interplay (open the last gates PR):
+    # while browning (L1+), the ladder's depth cap DOMINATES — the
+    # effective cap never exceeds 1 whatever the controller wants; at
+    # L0 the controller's own choice is the effective cap again, and
+    # the post-recovery tail actually runs at it (resumes cleanly)
+    assert depth_cap_samples, "no pipeline depth samples collected"
+    for level, cap, _adaptive in depth_cap_samples:
+        if level >= BrownoutController.L1:
+            assert cap <= 1, (level, cap)
+    l0_tail = [
+        (cap, adaptive)
+        for level, cap, adaptive in depth_cap_samples
+        if level == BrownoutController.L0
+    ]
+    assert l0_tail and all(cap == adaptive for cap, adaptive in l0_tail), (
+        "the adaptive controller's choice must be the effective cap at L0"
+    )
+    assert any(level >= BrownoutController.L1 for level, _c, _a in
+               depth_cap_samples), "storm never sampled a browning pump"
+    stats["depth_cap_samples"] = depth_cap_samples
     # the breaker: tripped by the channel brownout, failed fast, and
     # reclosed via the half-open probe; the mirror then caught up by
     # one accumulated flush

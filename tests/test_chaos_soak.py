@@ -32,6 +32,17 @@ def test_chaos_soak_fast_subset():
     assert "commit.crash" in points
     assert stats["metrics"]["commit_rollbacks_total"] == 1.0
     assert stats["sync_lost"] > 0 and stats["resyncs"] > 0
+    # adaptive depth (open the last gates PR): the controller must
+    # visibly FLEX under the existing fault schedule — start at the
+    # configured max (2), degrade to 1 inside the fault window (the
+    # completion churn + chaos discards), and return to 2 in the quiet
+    # steady tail — deterministically (no rng-stream draws feed it)
+    trace = stats["depth_trace"]
+    assert trace and trace[0] == 2, trace
+    assert 1 in trace, "depth never degraded under the fault schedule"
+    assert trace[-1] == 2, "depth never recovered in the quiet tail"
+    first_one = trace.index(1)
+    assert all(d == 2 for d in trace[:first_one]), trace
 
 
 @pytest.mark.chaos
@@ -40,6 +51,8 @@ def test_chaos_soak_same_seed_same_fault_trace():
     b = run_chaos_soak(cycles=25, seed=11, n_nodes=10, max_arrivals=5)
     assert a["fault_trace"] == b["fault_trace"]
     assert a["faults"] == b["faults"]
+    # the adaptive-depth trace is part of the deterministic contract
+    assert a["depth_trace"] == b["depth_trace"]
     c = run_chaos_soak(cycles=25, seed=12, n_nodes=10, max_arrivals=5)
     assert c["fault_trace"] != a["fault_trace"]
 

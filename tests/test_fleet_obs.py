@@ -712,9 +712,12 @@ class TestGateIntrospection:
         assert sched._speculation_consume_ok()
 
     def test_closed_gate_attributed_in_counter_and_endpoint(self):
-        # priority preemption is a state-bearing gate: the pipelined
-        # stream must fall back to serial AND name the gate that did it
-        sched = _sched(n_nodes=8, enable_priority_preemption=True)
+        # pod transformers are a state-bearing gate (preemption and the
+        # reservations fast path now ride the chain — open the last
+        # gates PR): the pipelined stream must fall back to serial AND
+        # name the gate that did it
+        sched = _sched(n_nodes=8)
+        sched.extender.register_pod_transformer(lambda pod: pod)
         stream = StreamScheduler(sched, max_batch=8, pipelined=True)
         try:
             for i in range(3):
@@ -724,7 +727,7 @@ class TestGateIntrospection:
             reg = sched.extender.registry
             assert (
                 reg.get("pipeline_gate_closed_total").value(
-                    gate="preemption"
+                    gate="transformers"
                 )
                 > 0
             )
@@ -734,8 +737,8 @@ class TestGateIntrospection:
             assert code == 200
             doc = json.loads(body)
             assert doc["pipelined"] is True
-            assert doc["last"]["closed"] == ["preemption"]
-            assert doc["last"]["gates"]["preemption"] is False
+            assert doc["last"]["closed"] == ["transformers"]
+            assert doc["last"]["gates"]["transformers"] is False
             assert doc["last"]["gates"]["quotas"] is True
             assert doc["cycles_gated"] > 0 and doc["cycles_fast"] == 0
         finally:
@@ -752,18 +755,20 @@ class TestGateIntrospection:
         try:
             stream.submit(_pod("p0"))
             assert stream.pump() == []  # batch 1 fed, gates OPEN
-            # the world changes between feeds: preemption arms
-            sched.enable_priority_preemption = True
+            # the world changes between feeds: a pod transformer lands
+            # (preemption no longer closes the gate — open the last
+            # gates PR — so the flip rides the transformers gate)
+            sched.extender.register_pod_transformer(lambda pod: pod)
             stream.submit(_pod("p1"))
             stream.pump()  # batch 2 fed (gated) + batch 1's commit
             recs = fr.last()
             assert recs, "batch 1's cycle must have recorded"
-            assert recs[0]["gates"].get("preemption") is True, (
+            assert recs[0]["gates"].get("transformers") is True, (
                 "cycle 1's record shows the NEXT feed's closed gate"
             )
             stream.flush()
             recs = fr.last()
-            assert recs[-1]["gates"].get("preemption") is False
+            assert recs[-1]["gates"].get("transformers") is False
         finally:
             stream.close()
 

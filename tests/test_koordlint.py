@@ -213,10 +213,14 @@ FIXTURES = {
     ),
     "gate-coverage": (
         {
+            # a REAL opened gate (reservations left the exemption table
+            # in the open-the-last-gates PR) with no GATE_ARMS arm: the
+            # pass must FAIL — an opened gate cannot land without its
+            # bit-exactness equivalence arm
             "koordinator_tpu/scheduler/batch_solver.py": """
             class BatchScheduler:
                 def speculation_gate_report(self):
-                    return {"brand_new_gate": True}
+                    return {"reservations": True, "preemption": True}
             """,
             "tests/test_pipelined_stream.py": """
             GATE_ARMS = {}

@@ -529,6 +529,15 @@ GATE_ARMS = {
         "test_cold_gang_batch_stays_serial",
     ),
     "ladder": "test_depth2_ladder_demotion_discards_chain",
+    # open the last gates PR
+    "reservations": (
+        "test_gate_reservation_equivalence",
+        "test_reservation_bind_flip_discards_speculation",
+    ),
+    "preemption": (
+        "test_gate_preemption_eager_equivalence",
+        "test_gate_preemption_defer_equivalence",
+    ),
 }
 
 
@@ -1140,3 +1149,486 @@ def test_depth2_ladder_demotion_discards_chain():
         f"demotion with two in-flight solves must discard BOTH, got {disc}"
     )
     assert serial == decided
+
+
+# ---------------------------------------------------------------------------
+# Open the LAST gates PR: reservation + preemption carries, adaptive depth
+# ---------------------------------------------------------------------------
+
+
+def _build_resv(n_nodes=16, chaos=None, n_resv=6):
+    """Scheduler with an attached ReservationManager (+quota tree): half
+    the reservations are allocate-once (consumed whole), half partial
+    (remainder ghost re-assumed) — the two snapshot-effect shapes the
+    preview must predict. Ghosts are scheduled Available up front."""
+    from koordinator_tpu.api.types import (
+        ElasticQuota,
+        Reservation,
+        ReservationOwner,
+    )
+    from koordinator_tpu.scheduler.plugins.elasticquota import (
+        GroupQuotaManager,
+    )
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationManager,
+    )
+
+    snap = ClusterSnapshot()
+    for i in range(n_nodes):
+        snap.upsert_node(_node(f"n{i:03d}", cpu=32000, mem=131072))
+    gqm = GroupQuotaManager(snap.config)
+    # allow_lent_resource=False keeps the full min reserved regardless
+    # of propagated demand — runtime ≥ min, so the fast path's quota
+    # headroom check actually ADMITS labeled owners (a demand-driven
+    # runtime trails the fast path by one cycle and would refuse every
+    # one, leaving the reservation-consumption legs untested)
+    gqm.upsert_quota(
+        ElasticQuota(
+            meta=ObjectMeta(name="resv-team"),
+            min={ext.RES_CPU: 32000, ext.RES_MEMORY: 65536},
+            max={ext.RES_CPU: 64000, ext.RES_MEMORY: 262144},
+            allow_lent_resource=False,
+        )
+    )
+    kw = {"chaos": chaos} if chaos is not None else {}
+    sched = BatchScheduler(
+        snap, LoadAwareArgs(), quotas=gqm, batch_bucket=32, **kw
+    )
+    sched.extender.monitor.stop_background()
+    rm = ReservationManager(sched)
+    for k in range(n_resv):
+        rm.add(
+            Reservation(
+                meta=ObjectMeta(name=f"resv-{k}"),
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+                owners=[
+                    ReservationOwner(label_selector={"app": "resv-owner"})
+                ],
+                allocate_once=(k % 2 == 0),
+            )
+        )
+    assert rm.schedule_pending() == n_resv
+    return sched
+
+
+def _resv_batches(n_batches=4, owners_per=2, plain_per=18):
+    """Fixed batches mixing fast-path owner pods (quota-labeled, so the
+    preview's headroom + charge legs run) with plain solver pods."""
+    batches = []
+    oi = pi = 0
+    for _b in range(n_batches):
+        batch = []
+        for _ in range(owners_per):
+            batch.append(
+                Pod(
+                    meta=ObjectMeta(
+                        name=f"own{oi:03d}",
+                        labels={
+                            "app": "resv-owner",
+                            ext.LABEL_QUOTA_NAME: "resv-team",
+                        },
+                    ),
+                    spec=PodSpec(
+                        requests={
+                            ext.RES_CPU: 2000,
+                            ext.RES_MEMORY: 4096,
+                        },
+                        priority=9100,
+                    ),
+                )
+            )
+            oi += 1
+        for _ in range(plain_per):
+            batch.append(
+                Pod(
+                    meta=ObjectMeta(name=f"pl{pi:04d}"),
+                    spec=PodSpec(
+                        requests={
+                            ext.RES_CPU: 1000,
+                            ext.RES_MEMORY: 2048,
+                        },
+                        priority=9000 - (pi % 5),
+                    ),
+                )
+            )
+            pi += 1
+        batches.append(batch)
+    return batches
+
+
+def test_gate_reservation_equivalence():
+    """Reservation carry (open the last gates PR): reservation-bearing
+    batches SPECULATE — the fast path's binds are predicted at dispatch
+    and validated by value at consume — and stay bit-exact vs serial
+    across mid-pipeline node churn and a Reserve-journal rollback.
+    End-state ReservationManager table (phase/allocated/owners/ledger),
+    quota used ledger and snapshot node accounting are compared by
+    value; engagement is proven (kept > 0, reservations gate closures
+    0) and the fast path really fired under speculation."""
+    from koordinator_tpu.chaos import FaultInjector
+
+    ca = FaultInjector(seed=6)
+    a = _build_resv(chaos=ca)
+    da = _drive_fixed(
+        a, _resv_batches(), pipelined=False, churn_at=2,
+        rollback_at_commit=3, chaos=ca,
+    )
+    cb = FaultInjector(seed=6)
+    b = _build_resv(chaos=cb)
+    db = _drive_fixed(
+        b, _resv_batches(), pipelined=True, churn_at=2,
+        rollback_at_commit=3, chaos=cb,
+    )
+    kept, _disc = _spec_counts(b)
+    assert kept > 0, "reservation-bearing speculation never engaged"
+    assert da == db
+    # the fast path really CONSUMED reservations (the carry carried
+    # something), and no discard was ever attributed to a wrong
+    # reservation prediction — together with kept>0 this pins
+    # speculation running over genuinely fast-path-bearing cycles
+    consumed = sum(
+        1
+        for r in b.reservations.list()
+        if r.current_owners or r.phase.value == "Succeeded"
+    )
+    assert consumed > 0, "no reservation was ever consumed"
+    mism = b.extender.registry.get("pipeline_carry_mismatch_total")
+    assert mism.value(table="reservation") == 0.0
+    assert a.reservations.table_view() == b.reservations.table_view()
+    assert np.array_equal(a.quotas.used, b.quotas.used)
+    np.testing.assert_array_equal(
+        a.snapshot.nodes.requested, b.snapshot.nodes.requested
+    )
+    closed = b.extender.registry.get("pipeline_gate_closed_total")
+    assert closed.value(gate="reservations") == 0.0
+
+
+def test_reservation_bind_flip_discards_speculation():
+    """Reservation-ledger drift OUTSIDE the pipeline between dispatch
+    and consume — an informer delivering a new reservation CR, which
+    touches no snapshot version — flips the table the preview started
+    from: the pre-table comparison must DISCARD the speculation
+    (attributed to the ``reservation`` table) and the redispatched
+    cycle must stay decision-identical. (Drift that releases holds,
+    e.g. expiry, is caught earlier by the cheap version guard — this
+    arm pins the BY-VALUE comparison itself.)"""
+    from koordinator_tpu.api.types import Reservation, ReservationOwner
+
+    def _late_resv():
+        return Reservation(
+            meta=ObjectMeta(name="resv-late"),
+            requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 8192},
+            owners=[
+                ReservationOwner(label_selector={"app": "resv-owner"})
+            ],
+        )
+
+    a = _build_resv()
+    batches = _resv_batches()
+    serial = {}
+    for k, batch in enumerate(batches):
+        if k == 2:
+            a.reservations.add(_late_resv())  # PENDING: decision-inert
+        out = a.schedule(batch)
+        for p, nd in out.bound:
+            serial[p.meta.name] = nd
+        for p in out.unschedulable:
+            serial[p.meta.name] = None
+    b = _build_resv()
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    pipe = CyclePipeline(b, depth=1)
+    decided = {}
+
+    def absorb(out):
+        if out is None:
+            return
+        for p, nd in out.bound:
+            decided[p.meta.name] = nd
+        for p in out.unschedulable:
+            decided[p.meta.name] = None
+
+    try:
+        for k, batch in enumerate(batches):
+            if k == 2:
+                # mid-pipeline: batch 1's speculation is in flight and
+                # its preview table does not know this reservation
+                b.reservations.add(_late_resv())
+            absorb(pipe.feed(batch))
+        while pipe.inflight:
+            absorb(pipe.flush())
+    finally:
+        pipe.close()
+    _kept, disc = _spec_counts(b)
+    assert disc > 0, "the late reservation must discard the spec"
+    mism = b.extender.registry.get("pipeline_carry_mismatch_total")
+    assert mism.value(table="reservation") >= 1.0
+    assert serial == decided
+    assert a.reservations.table_view() == b.reservations.table_view()
+
+
+def _build_preempt(chaos=None, defer=False):
+    snap = ClusterSnapshot()
+    for i in range(4):
+        snap.upsert_node(_node(f"n{i:03d}", cpu=16000, mem=65536))
+    kw = {"chaos": chaos} if chaos is not None else {}
+    sched = BatchScheduler(
+        snap,
+        LoadAwareArgs(),
+        batch_bucket=32,
+        enable_priority_preemption=True,
+        defer_preemption=defer,
+        **kw,
+    )
+    sched.extender.monitor.stop_background()
+    return sched
+
+
+def _preempt_batches():
+    """Low-priority filler first (binds, fills the cluster), then
+    high-priority arrivals that can only place by evicting them."""
+    low = [
+        Pod(
+            meta=ObjectMeta(name=f"low{i:03d}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 4000, ext.RES_MEMORY: 16384},
+                priority=4000 + (i % 3),
+            ),
+        )
+        for i in range(16)
+    ]
+    high = [
+        Pod(
+            meta=ObjectMeta(name=f"high{i:03d}"),
+            spec=PodSpec(
+                requests={ext.RES_CPU: 8000, ext.RES_MEMORY: 32768},
+                priority=9500,
+            ),
+        )
+        for i in range(4)
+    ]
+    return [low[:8], low[8:], high[:2], high[2:]]
+
+
+def test_gate_preemption_eager_equivalence():
+    """Priority preemption OPEN (open the last gates PR): cycles with
+    ``enable_priority_preemption`` speculate; an EAGER eviction+retry
+    sets ``_cycle_preempted`` and discards the downstream chain at that
+    commit, so decisions — including the evictions themselves and the
+    preemptors' retried placements — stay bit-exact vs serial, with
+    the victim ledgers compared by value."""
+    a = _build_preempt()
+    serial = {}
+    serial_victims = []
+    for batch in _preempt_batches():
+        out = a.schedule(batch)
+        for p, nd in out.bound:
+            serial[p.meta.name] = nd
+        for p in out.unschedulable:
+            serial[p.meta.name] = None
+        serial_victims.extend(p.meta.name for p in out.preempted)
+    b = _build_preempt()
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    pipe = CyclePipeline(b, depth=1)
+    decided = {}
+    victims = []
+    try:
+        for batch in _preempt_batches():
+            out = pipe.feed(batch)
+            if out is not None:
+                for p, nd in out.bound:
+                    decided[p.meta.name] = nd
+                for p in out.unschedulable:
+                    decided[p.meta.name] = None
+                victims.extend(p.meta.name for p in out.preempted)
+        while pipe.inflight:
+            out = pipe.flush()
+            if out is not None:
+                for p, nd in out.bound:
+                    decided[p.meta.name] = nd
+                for p in out.unschedulable:
+                    decided[p.meta.name] = None
+                victims.extend(p.meta.name for p in out.preempted)
+    finally:
+        pipe.close()
+    kept, _disc = _spec_counts(b)
+    assert kept > 0, "preemption-enabled speculation never engaged"
+    assert serial_victims, "fixture must actually preempt"
+    assert serial_victims == victims
+    assert serial == decided
+    # victim ledgers by value: the evicted uids are gone from both
+    assert a._bound_nodes == b._bound_nodes
+    np.testing.assert_array_equal(
+        a.snapshot.nodes.requested, b.snapshot.nodes.requested
+    )
+    closed = b.extender.registry.get("pipeline_gate_closed_total")
+    assert closed.value(gate="preemption") == 0.0
+
+
+def test_gate_preemption_defer_equivalence():
+    """defer_preemption (nominate-only) chains TRIVIALLY: the PostFilter
+    pass is a pure read, so a nominating cycle keeps the speculative
+    chain alive (zero discards) while the nominations stay bit-exact vs
+    serial."""
+    a = _build_preempt(defer=True)
+    serial = {}
+    serial_nom = []
+    for batch in _preempt_batches():
+        out = a.schedule(batch)
+        for p, nd in out.bound:
+            serial[p.meta.name] = nd
+        for p in out.unschedulable:
+            serial[p.meta.name] = None
+        serial_nom.extend(p.meta.name for p in out.preempted)
+    b = _build_preempt(defer=True)
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    pipe = CyclePipeline(b, depth=1)
+    decided = {}
+    nominated = []
+    try:
+        for batch in _preempt_batches():
+            out = pipe.feed(batch)
+            if out is not None:
+                for p, nd in out.bound:
+                    decided[p.meta.name] = nd
+                for p in out.unschedulable:
+                    decided[p.meta.name] = None
+                nominated.extend(p.meta.name for p in out.preempted)
+        while pipe.inflight:
+            out = pipe.flush()
+            if out is not None:
+                for p, nd in out.bound:
+                    decided[p.meta.name] = nd
+                for p in out.unschedulable:
+                    decided[p.meta.name] = None
+                nominated.extend(p.meta.name for p in out.preempted)
+    finally:
+        pipe.close()
+    kept, disc = _spec_counts(b)
+    assert kept > 0
+    assert disc == 0, (
+        "nominate-only preemption must not discard the chain"
+    )
+    assert serial_nom, "fixture must actually nominate victims"
+    assert serial_nom == nominated
+    assert serial == decided
+    # nominate-only: nothing was evicted anywhere
+    assert a._bound_nodes == b._bound_nodes
+    assert all(n in b._bound_nodes for n in [])  # ledger intact shape
+
+
+# ---------------------------------------------------------------------------
+# adaptive pipeline depth (open the last gates PR)
+# ---------------------------------------------------------------------------
+
+
+def _churn_version(sched):
+    """Net-zero snapshot churn: bumps the version (discarding any
+    in-flight speculation at its consume guard) without changing any
+    decision-bearing state."""
+    snap = sched.snapshot
+    dummy = Pod(
+        meta=ObjectMeta(name="churn-dummy"),
+        spec=PodSpec(requests={ext.RES_CPU: 1, ext.RES_MEMORY: 1}),
+    )
+    assert snap.assume_pod(dummy, snap.node_name(0))
+    snap.forget_pod(dummy.meta.uid)
+
+
+def test_adaptive_depth_degrades_and_recovers():
+    """The depth controller: sustained discards (version churn between
+    every feed) degrade the effective depth to 1 before more deep
+    dispatches are wasted; a quiet stretch restores the configured max.
+    The per-cycle depth decision + discard-rate input land on the
+    flight recorder (post-hoc explainability)."""
+    from koordinator_tpu.obs.flightrecorder import FlightRecorder
+    from koordinator_tpu.scheduler.pipeline import (
+        CyclePipeline,
+        _DepthController,
+    )
+
+    sched = _build(n_nodes=16, batch_bucket=32)
+    fr = FlightRecorder(capacity=64, incarnation="adaptive-test")
+    sched.attach_flight_recorder(fr)
+    pipe = CyclePipeline(sched, depth=2)
+    pods = _pods(400, cpu=200, mem=256)
+    i = 0
+    depth_trace = []
+    try:
+        assert pipe.last_adaptive_depth == 2
+        for _ in range(12):
+            batch = pods[i : i + 16]
+            i += 16
+            _churn_version(sched)   # every consume discards
+            pipe.feed(batch)
+            depth_trace.append(pipe.last_adaptive_depth)
+        assert pipe.last_adaptive_depth == 1, depth_trace
+        # quiet stretch: no churn, drain + idle feeds restore the max
+        while pipe.inflight:
+            pipe.flush()
+        for _ in range(_DepthController.QUIET_FEEDS + 1):
+            pipe.feed([])
+        pipe.feed(pods[i : i + 16])
+        assert pipe.last_adaptive_depth == 2
+    finally:
+        pipe.close()
+    recs = fr.last()
+    assert recs, "cycles must have recorded"
+    assert all("depth" in r and "discard_rate" in r for r in recs)
+    assert any(r["depth"] == 1 and r["discard_rate"] >= 0.5 for r in recs), (
+        "the degraded window must be explainable from the recorder"
+    )
+    # /debug/pipeline serves the controller's state
+    info = pipe.gate_info()
+    dc = info["depth_controller"]
+    assert dc["max_depth"] == 2 and dc["adaptive"] is True
+    assert "discard_rate" in dc and "effective_cap" in dc
+
+
+def test_brownout_cap_dominates_adaptive_depth():
+    """Brownout interplay (satellite): while the ladder sits at L1+ its
+    depth cap DOMINATES the adaptive controller — the effective cap
+    never exceeds 1 even though the controller wants the max — and the
+    controller's choice resumes as the effective cap at L0."""
+    from koordinator_tpu.scheduler.pipeline import CyclePipeline
+
+    class _Ladder:
+        level = 1
+
+        def pipeline_depth_cap(self):
+            return 1 if self.level >= 1 else 1 << 30
+
+        def serial_only(self):
+            return False
+
+        def bucket_degrade_steps(self):
+            return 0
+
+    sched = _build(n_nodes=16, batch_bucket=32)
+    ladder = _Ladder()
+    sched.brownout = ladder
+    pipe = CyclePipeline(sched, depth=2)
+    pods = _pods(200, cpu=200, mem=256)
+    i = 0
+    try:
+        for _ in range(4):
+            pipe.feed(pods[i : i + 16])
+            i += 16
+            # clean stream: the controller holds the max…
+            assert pipe.last_adaptive_depth == 2
+            # …but the ladder's cap dominates while browning
+            assert pipe.last_depth_cap == 1
+            assert len(pipe._pending) <= 1
+        ladder.level = 0   # brownout recovers to L0
+        for _ in range(3):
+            pipe.feed(pods[i : i + 16])
+            i += 16
+        assert pipe.last_depth_cap == 2, (
+            "the controller must resume as the effective cap at L0"
+        )
+        while pipe.inflight:
+            pipe.flush()
+    finally:
+        pipe.close()

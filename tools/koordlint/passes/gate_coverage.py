@@ -34,14 +34,12 @@ REPORT_FILE = "koordinator_tpu/scheduler/batch_solver.py"
 GATES_FILE = "koordinator_tpu/scheduler/pipeline.py"
 ARMS_FILE = "tests/test_pipelined_stream.py"
 
-#: gate -> why no speculative equivalence arm is required
+#: gate -> why no speculative equivalence arm is required.
+#: Open-the-last-gates PR: ``reservations`` and ``preemption`` left
+#: this table — they now carry (validated fast-path prediction /
+#: discard-on-eager-fire) and their bit-exactness arms live in
+#: tests/test_pipelined_stream.py::GATE_ARMS like every opened gate.
 EXEMPT: Dict[str, str] = {
-    "reservations": (
-        "stays CLOSED: the reservation fast path swaps ghost holds for "
-        "owner charges outside the solver — the chain cannot carry it; "
-        "reservation-bearing cycles run serial (decision-identical by "
-        "construction)"
-    ),
     "mesh": (
         "stays CLOSED: sharded GSPMD dispatch has its own bit-exactness "
         "suite (tests/test_sharded.py) and opts out of speculation"
@@ -50,10 +48,6 @@ EXEMPT: Dict[str, str] = {
         "stays CLOSED: host batch/cost transformers rewrite solver "
         "inputs per cycle — a speculative lowering cannot reproduce a "
         "rewrite that has not happened yet"
-    ),
-    "preemption": (
-        "stays CLOSED: priority preemption mutates victim state at "
-        "PostFilter; preemption-bearing cycles run serial"
     ),
     "sampling": (
         "stays CLOSED: the rotating sampled node window changes the "
