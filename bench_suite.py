@@ -1416,6 +1416,116 @@ def bench_recovery():
     cold_ms = (_t.perf_counter() - t0) * 1e3
     hub.stop()
     assert rep_warm.bitexact and rep_cold.bitexact
+
+    # ---- journal-length sweep (state-integrity PR): cold vs
+    # full-replay vs checkpoint+tail RTO as the journal grows. The
+    # decision-bearing property: checkpoint+tail stays ROUGHLY FLAT
+    # (recovery work = live set + tail; the bounded load never parses
+    # the prefix) while full replay grows with history. File-backed
+    # stores — the real durability path — with a churned live window
+    # so the live set stays constant across lengths. ----
+    import shutil
+    import tempfile
+
+    from koordinator_tpu.core.journal import FileJournalStore
+
+    def _sweep_sched():
+        snap = ClusterSnapshot()
+        for i in range(512):
+            snap.upsert_node(nodes[i])
+        s = BatchScheduler(
+            snap, LoadAwareArgs(), batch_bucket=512, max_rounds=8
+        )
+        s.extender.monitor.stop_background()
+        return s
+
+    def _recover_ms(sched, store_path, use_checkpoint=True):
+        jnl = BindJournal(FileJournalStore(store_path))
+        t0 = _t.perf_counter()
+        r = recover_scheduler(sched, jnl, hub=None, verify=True)
+        ms = (_t.perf_counter() - t0) * 1e3
+        assert r.used_checkpoint == use_checkpoint
+        return ms, r
+
+    sweep = []
+    sweep_dir = tempfile.mkdtemp(prefix="bench_recovery_sweep_")
+    try:
+        live_window, tail_len = 256, 32
+        for n_records in (256, 4096, 32768):
+            base = f"{sweep_dir}/j{n_records}.jsonl"
+            jnl = BindJournal(FileJournalStore(base))
+            entry = {
+                "node": "node-00000",
+                "req": [1000.0, 2048.0, 0.0, 0.0],
+                "est": [1000.0, 2048.0, 0.0, 0.0],
+                "prod": False,
+                "nom": 0.0,
+                "conf": True,
+                "quota": None,
+            }
+            seq = 0
+            while True:
+                jnl.append_bind(
+                    1, seq, [dict(entry, uid=f"s{seq:06d}",
+                                  node=f"node-{seq % 512:05d}")]
+                )
+                seq += 1
+                if seq > live_window:
+                    jnl.append_forget(
+                        1, seq, [f"s{seq - live_window - 1:06d}"]
+                    )
+                if 2 * seq - live_window >= n_records:
+                    break
+            jnl.store.close()
+            full = base + ".full"
+            shutil.copy(base, full)
+            jnl = BindJournal(FileJournalStore(base))
+            jnl.append_checkpoint(epoch=1)
+            jf = BindJournal(FileJournalStore(full))
+            for t in range(tail_len):
+                for j2 in (jnl, jf):
+                    j2.append_bind(
+                        1, seq + t,
+                        [dict(entry, uid=f"tail{t:03d}")],
+                    )
+            jnl.store.close()
+            jf.store.close()
+            # replay-only walls (the pure journal cost, 3-pass min)
+            def _replay_ms(path, **kw):
+                j3 = BindJournal(FileJournalStore(path))
+                best, rep3 = None, None
+                for _ in range(3):
+                    t0 = _t.perf_counter()
+                    rep3 = j3.replay(**kw)
+                    ms = (_t.perf_counter() - t0) * 1e3
+                    best = ms if best is None else min(best, ms)
+                j3.store.close()
+                return best, rep3
+
+            full_ms, rep_full = _replay_ms(full, use_checkpoint=False)
+            ck_ms, rep_ck = _replay_ms(base)
+            assert rep_ck.used_checkpoint
+            assert set(rep_ck.live) == set(rep_full.live)
+            # end-to-end RTO: cold scheduler + full replay, vs cold
+            # scheduler + checkpoint+tail (the resync/re-lower legs are
+            # identical, so the delta IS the replay discipline)
+            cold_full_ms, _ = _recover_ms(
+                _sweep_sched(), full, use_checkpoint=False
+            )
+            cold_ck_ms, _ = _recover_ms(_sweep_sched(), base)
+            sweep.append({
+                "records": n_records,
+                "live": len(rep_full.live),
+                "replay_full_ms": round(full_ms, 2),
+                "replay_ckpt_tail_ms": round(ck_ms, 2),
+                "applied_full": rep_full.applied,
+                "applied_ckpt_tail": rep_ck.applied,
+                "recover_full_ms": round(cold_full_ms, 1),
+                "recover_ckpt_tail_ms": round(cold_ck_ms, 1),
+            })
+    finally:
+        shutil.rmtree(sweep_dir, ignore_errors=True)
+
     return {
         "scenario": "recovery",
         "nodes": n_nodes,
@@ -1430,6 +1540,7 @@ def bench_recovery():
         "warm_relower_ms": round(rep_warm.warm_lower_s * 1e3, 2),
         "cold_relower_ms": round(rep_cold.warm_lower_s * 1e3, 2),
         "takeover_speedup": round(cold_ms / max(warm_ms, 1e-9), 2),
+        "journal_sweep": sweep,
     }
 
 

@@ -51,6 +51,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..chaos import NULL_INJECTOR
+from . import integrity
 
 
 class FencingError(RuntimeError):
@@ -118,6 +119,35 @@ class EpochFence:
 # ---------------------------------------------------------------------------
 
 
+def _fold_integrity(store, rep, new_desc) -> None:
+    """Fold one load's NEW findings into the store's cumulative report
+    and its wired ``journal_corrupt_records_total{store}`` counter.
+    ``new_desc`` lists descriptions of the NEWLY quarantined entries
+    only. Findings that persist in the stream across loads (write
+    holes, crash-retry duplicates) count via high-water deltas — one
+    event, one increment, however many loads re-observe it."""
+    newly_corrupt = len(new_desc)
+    new_gaps = max(0, rep.seq_gaps - store._gap_high)
+    store._gap_high = max(store._gap_high, rep.seq_gaps)
+    new_dups = max(0, rep.dup_seq - store._dup_high)
+    store._dup_high = max(store._dup_high, rep.dup_seq)
+    store.last_integrity = rep
+    total = store.integrity_total
+    total.corrupt += newly_corrupt
+    total.seq_gaps += new_gaps
+    total.dup_seq += new_dups
+    total.legacy = rep.legacy
+    # kept/total mirror the LATEST load (cumulative counts above carry
+    # the history; the size fields answer "what does the store hold now")
+    total.kept = rep.kept
+    total.total = rep.total
+    total.torn_tail |= rep.torn_tail
+    total.quarantined.extend(new_desc)
+    fresh = newly_corrupt + new_gaps
+    if fresh and store.corrupt_counter is not None:
+        store.corrupt_counter.inc(float(fresh))
+
+
 class MemoryJournalStore:
     """Record list in memory — survives a *simulated* crash (the store
     object outlives the scheduler it journals for), not a real one.
@@ -126,20 +156,106 @@ class MemoryJournalStore:
     BindJournal instances legitimately share one store (the standby-
     forget pattern journals through a fresh view of the owner's store),
     and each instance's own lock cannot order their writes against a
-    compaction rewrite."""
+    compaction rewrite.
 
-    def __init__(self) -> None:
+    State-integrity PR: every append/rewrite SEALS its record with the
+    shared CRC codec (:mod:`..core.integrity`) and every load screens —
+    an unverifiable record (the ``journal.corrupt_record`` chaos point's
+    simulated media fault) is moved into :attr:`quarantined`, counted,
+    and every verifiable record after it is kept."""
+
+    def __init__(self, name: str = "memory") -> None:
         self.lock = threading.RLock()
+        self.name = name
         self._records: List[dict] = []
+        #: corrupt records screened out of the live stream, in detection
+        #: order — the in-memory analog of the file store's sidecar
+        self.quarantined: List[dict] = []
+        #: optional ``journal_corrupt_records_total{store}`` child
+        #: counter, incremented once per NEWLY detected corrupt record
+        #: or write hole
+        self.corrupt_counter = None
+        #: last load's screening report / cumulative new findings
+        self.last_integrity = integrity.IntegrityReport(store=name)
+        self.integrity_total = integrity.IntegrityReport(store=name)
+        self._gap_high = 0
+        self._dup_high = 0
+        #: seqs of quarantined records still relevant to the CURRENT
+        #: stream's numbering (cleared on rewrite — a compaction
+        #: renumbers, and a stale low anchor would fabricate holes)
+        self._known_missing: set = set()
 
     def append(self, record: dict) -> None:
-        self._records.append(dict(record))
+        self._records.append(integrity.seal(record))
 
     def load(self) -> List[dict]:
-        return [dict(r) for r in self._records]
+        with self.lock:
+            kept, quarantine, rep = integrity.screen_records(
+                [(dict(r), None) for r in self._records],
+                store=self.name,
+                # seqs of records already MOVED to the quarantine ledger
+                # (this stream numbering's — see rewrite): their absence
+                # is explained corruption, not a write hole
+                known_missing_seqs=self._known_missing,
+            )
+            if quarantine:
+                # quarantine is a MOVE: the corrupt record leaves the
+                # live stream (so repeated loads do not re-count it) and
+                # lands in the sidecar list for forensics/fsck
+                bad = {pos for pos, _raw in quarantine}
+                for pos in sorted(bad):
+                    moved = self._records[pos]
+                    self.quarantined.append(moved)
+                    if isinstance(moved.get("seq"), int):
+                        self._known_missing.add(moved["seq"])
+                self._records = [
+                    r
+                    for pos, r in enumerate(self._records)
+                    if pos not in bad
+                ]
+            _fold_integrity(self, rep, list(rep.quarantined))
+            return kept
 
     def rewrite(self, records: Sequence[dict]) -> None:
-        self._records = [dict(r) for r in records]
+        self._records = integrity.seal_records(records)
+        # a rewrite renumbers the stream: stale gap/dup high-waters and
+        # quarantined-seq anchors from the OLD numbering would fabricate
+        # phantom write holes (and then mask real ones)
+        self._gap_high = 0
+        self._dup_high = 0
+        self._known_missing.clear()
+
+    def load_tail(self) -> Optional[List[dict]]:
+        """Bounded-RTO read path: the verified records from the LAST
+        checkpoint onward, or None when there is no usable checkpoint
+        anchor OR the tail is not clean (caller falls back to
+        :meth:`load`, which owns quarantine/counter/health accounting —
+        the fast path must never swallow a corrupt acked record
+        silently)."""
+        with self.lock:
+            start = None
+            for i in range(len(self._records) - 1, -1, -1):
+                if self._records[i].get("op") == "checkpoint":
+                    start = i
+                    break
+            if start is None or start == 0:
+                return None
+            kept, quarantine, rep = integrity.screen_records(
+                [(dict(r), None) for r in self._records[start:]],
+                store=self.name,
+            )
+            if quarantine or not rep.ok:
+                return None
+            if kept and kept[0].get("op") == "checkpoint":
+                return kept
+            return None
+
+    def corrupt_last_record(self) -> None:
+        """Chaos helper (``journal.corrupt_record``): flip the payload of
+        the most recent record WITHOUT re-sealing — the simulated media
+        fault the load-time screen must quarantine."""
+        if self._records:
+            self._records[-1]["__bitrot__"] = 1
 
 
 class FileJournalStore:
@@ -149,13 +265,34 @@ class FileJournalStore:
     dominates commit latency and tests/benches exercise replay, not
     media failure). ``load`` tolerates a torn final line: a crash mid-
     append leaves a partial record, which is exactly an unacknowledged
-    write and is discarded."""
+    write and is discarded.
 
-    def __init__(self, path: str, fsync: bool = False):
+    State-integrity PR: appends/rewrites SEAL each record with the
+    shared CRC codec and ``load`` screens — an unverifiable MID-FILE
+    line (media corruption, not a torn tail) is QUARANTINED into the
+    ``<path>.quarantine`` sidecar, counted
+    (``journal_corrupt_records_total{store}``), and every verifiable
+    line after it is kept instead of silently truncated. Records
+    without a ``crc`` field (pre-codec journals) load read-only."""
+
+    def __init__(self, path: str, fsync: bool = False,
+                 name: Optional[str] = None):
         self.path = path
         self.fsync = fsync
+        self.name = name if name is not None else os.path.basename(path)
         #: same multi-writer contract as MemoryJournalStore.lock
         self.lock = threading.RLock()
+        #: same integrity surface as MemoryJournalStore
+        self.corrupt_counter = None
+        self.last_integrity = integrity.IntegrityReport(store=self.name)
+        self.integrity_total = integrity.IntegrityReport(store=self.name)
+        self._gap_high = 0
+        self._dup_high = 0
+        #: line positions already quarantined (the sidecar write and the
+        #: counter must fire once per corrupt line, not once per load —
+        #: the file is append-only between rewrites, so positions are
+        #: stable; reset on rewrite/repair)
+        self._quarantined_pos: set = set()
         # a crash mid-compaction leaves a stale (possibly torn) temp file
         # behind; the atomic-rename discipline means it was never the
         # journal — drop it so it cannot shadow a later rewrite
@@ -189,40 +326,138 @@ class FileJournalStore:
             pass
 
     def append(self, record: dict) -> None:
-        self._f.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._f.write(
+            json.dumps(integrity.seal(record), separators=(",", ":")) + "\n"
+        )
         self._f.flush()
         if self.fsync:
             os.fsync(self._f.fileno())
 
     def load(self) -> List[dict]:
-        out: List[dict] = []
-        try:
-            with open(self.path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        out.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        # torn tail from a crash mid-append: everything
-                        # before it is intact, the partial write was
-                        # never acknowledged — stop here
-                        break
-        except FileNotFoundError:
-            pass
-        return out
+        with self.lock:
+            entries: List[tuple] = []
+            try:
+                with open(self.path, "r", encoding="utf-8") as f:
+                    for line in f:
+                        stripped = line.strip()
+                        if not stripped:
+                            continue
+                        try:
+                            entries.append((json.loads(stripped), stripped))
+                        except json.JSONDecodeError:
+                            # screen_records decides: torn tail when
+                            # final, quarantined corruption otherwise
+                            entries.append((None, stripped))
+            except FileNotFoundError:
+                return []
+            kept, quarantine, rep = integrity.screen_records(
+                entries, store=self.name
+            )
+            # quarantine and rep.quarantined are parallel: select the
+            # entries not seen before (positions are stable between
+            # rewrites in an append-only file), so the sidecar write,
+            # the counter and the cumulative descriptions each fire
+            # once per corrupt line
+            fresh_idx = [
+                i
+                for i, (pos, _raw) in enumerate(quarantine)
+                if pos not in self._quarantined_pos
+            ]
+            if fresh_idx:
+                with open(
+                    self.path + ".quarantine", "a", encoding="utf-8"
+                ) as q:
+                    for i in fresh_idx:
+                        q.write((quarantine[i][1] or "") + "\n")
+                self._quarantined_pos.update(
+                    quarantine[i][0] for i in fresh_idx
+                )
+            _fold_integrity(
+                self, rep, [rep.quarantined[i] for i in fresh_idx]
+            )
+            return kept
 
     def rewrite(self, records: Sequence[dict]) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             for r in records:
-                f.write(json.dumps(r, separators=(",", ":")) + "\n")
+                f.write(
+                    json.dumps(integrity.seal(r), separators=(",", ":"))
+                    + "\n"
+                )
             f.flush()
             os.fsync(f.fileno())
         self._f.close()
         os.replace(tmp, self.path)
         self._f = open(self.path, "a", encoding="utf-8")
+        # a rewrite re-numbers the file: stale quarantine positions must
+        # not mask corruption at re-used positions, and stale gap/dup
+        # high-waters would fabricate (or absorb) write holes
+        self._quarantined_pos.clear()
+        self._gap_high = 0
+        self._dup_high = 0
+
+    def load_tail(self) -> Optional[List[dict]]:
+        """Bounded-RTO read path (same contract as
+        ``MemoryJournalStore.load_tail``): split lines cheaply, find the
+        LAST line carrying a checkpoint marker by substring probe, and
+        json-parse + CRC-verify only from there — recovery work scales
+        with (live set + tail), not journal length. None when no usable
+        anchor exists (caller falls back to the full :meth:`load`)."""
+        with self.lock:
+            try:
+                with open(self.path, "r", encoding="utf-8") as f:
+                    lines = [
+                        ln.strip() for ln in f if ln.strip()
+                    ]
+            except FileNotFoundError:
+                return None
+            start = None
+            for i in range(len(lines) - 1, -1, -1):
+                if '"op":"checkpoint"' in lines[i]:
+                    start = i
+                    break
+            if start is None or start == 0:
+                return None
+            entries: List[tuple] = []
+            for raw in lines[start:]:
+                try:
+                    entries.append((json.loads(raw), raw))
+                except json.JSONDecodeError:
+                    entries.append((None, raw))
+            kept, quarantine, rep = integrity.screen_records(
+                entries, store=self.name
+            )
+            if quarantine or not rep.ok:
+                # an unclean tail must go through the full load, which
+                # owns quarantine/counter/health accounting — the fast
+                # path never swallows a corrupt acked record silently
+                return None
+            if kept and kept[0].get("op") == "checkpoint":
+                return kept
+            return None
+
+    def corrupt_last_record(self) -> None:
+        """Chaos helper (``journal.corrupt_record``): flip one byte in
+        the MIDDLE of the last line — a complete, newline-terminated,
+        CRC-failing record (media corruption), distinct from a torn
+        tail."""
+        with self.lock, open(self.path, "rb+") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size < 3:
+                return
+            f.seek(0)
+            raw = f.read(size)
+            cut = raw.rstrip(b"\n").rfind(b"\n") + 1
+            line = raw[cut:].rstrip(b"\n")
+            if not line:
+                return
+            mid = cut + len(line) // 2
+            f.seek(mid)
+            byte = raw[mid:mid + 1]
+            f.write(b"#" if byte != b"#" else b"@")
+            f.flush()
 
     def simulate_torn_rewrite(self, record: dict) -> None:
         """Chaos helper (``journal.compact_crash``): model a process
@@ -266,6 +501,21 @@ class JournalReplay:
     aborts: int = 0
     #: intents never closed by a bind/abort (crash-mid-commit windows)
     open_intents: int = 0
+    #: state-integrity PR: True when the replay fast-forwarded from a
+    #: digest-verified checkpoint recovery image (bounded RTO — the
+    #: pre-checkpoint history was neither parsed into the live set nor
+    #: re-applied); the count of records actually APPLIED is
+    #: ``applied`` (the RTO-bearing number the recovery bench sweeps)
+    used_checkpoint: bool = False
+    applied: int = 0
+    #: checkpoint images REJECTED (image digest mismatch, or the
+    #: ``checkpoint.digest_mismatch`` chaos point) — each rejection
+    #: falls the replay back toward full history
+    checkpoint_fallbacks: int = 0
+    #: corrupt records the store quarantined across its lifetime (the
+    #: zero-lost-ack soak reads it off the replay it already holds)
+    corrupt_records: int = 0
+    seq_gaps: int = 0
 
 
 class BindJournal:
@@ -298,31 +548,110 @@ class BindJournal:
         writes_counter=None,
         failures_counter=None,
         shard: Optional[int] = None,
+        health=None,
     ):
         self.store = store if store is not None else MemoryJournalStore()
         self.chaos = chaos or NULL_INJECTOR
         #: optional ``journal_writes_total{op}`` / failure counters
         self.writes_counter = writes_counter
         self.failures_counter = failures_counter
+        #: optional HealthRegistry: corruption detected at any load
+        #: flips the ``journal_integrity`` row to degraded (a state, not
+        #: an event — it stays degraded while quarantined records exist)
+        self.health = health
+        #: (corrupt, seq_gaps) high-water a successful verified recovery
+        #: has absorbed: the journal_integrity row re-promotes to ok once
+        #: a recovery proved the surviving records reconstruct a
+        #: consistent world (degraded is a state, not a tombstone)
+        self._integrity_resolved = (0, 0)
         #: shard this journal is scoped to (None = unsharded deployment);
         #: stamped on every record so a mixed-store forensic read can
         #: attribute writers, and epoch monotonicity is then per-shard
         #: by construction (one journal per shard)
         self.shard = shard
         self._lock = threading.Lock()
-        tail = self.store.load()
+        tail, _bounded = self._load_for_replay()
         self._seq = max((r.get("seq", 0) for r in tail), default=0)
-        self._epoch_high = max((r.get("epoch", 0) for r in tail), default=0)
+        self._epoch_high = max(
+            (self._record_epoch_high(r) for r in tail), default=0
+        )
         #: appends since the last checkpoint — drives maybe_compact
         #: without an O(records) store read per cycle
         self._since_checkpoint = sum(
-            1 for r in tail if r.get("op") != "checkpoint"
+            1
+            for r in tail
+            if r.get("op") not in ("checkpoint", "checkpoint_intent")
         )
+        self._note_integrity()
+
+    @staticmethod
+    def _record_epoch_high(rec: dict) -> int:
+        """A record's epoch evidence: its own stamp, plus — for a
+        checkpoint recovery image — the journal epoch high it archived
+        (the bounded tail load must not weaken fencing just because the
+        pre-checkpoint history was never parsed)."""
+        high = int(rec.get("epoch", 0))
+        if rec.get("op") == "checkpoint":
+            high = max(
+                high, int((rec.get("extras") or {}).get("epoch_high", 0))
+            )
+        return high
+
+    def _load_for_replay(self):
+        """(records, bounded): the store's checkpoint-anchored tail when
+        available — recovery work scales with (live set + tail), not
+        journal length — else the full screened load."""
+        tail_fn = getattr(self.store, "load_tail", None)
+        if tail_fn is not None:
+            tail = tail_fn()
+            if tail:
+                return tail, True
+        return self.store.load(), False
 
     @property
     def epoch_high(self) -> int:
         with self._lock:
             return self._epoch_high
+
+    # ---- integrity surface (state-integrity PR) ----
+
+    def integrity_report(self):
+        """The store's cumulative screening report (None for custom
+        stores that predate the codec)."""
+        return getattr(self.store, "integrity_total", None)
+
+    def _note_integrity(self) -> None:
+        """Reflect the store's cumulative integrity state onto the
+        wired ``journal_integrity`` health row (called after every
+        store load this journal performs)."""
+        if self.health is None:
+            return
+        rep = self.integrity_report()
+        if rep is None:
+            return
+        resolved = (
+            rep.corrupt <= self._integrity_resolved[0]
+            and rep.seq_gaps <= self._integrity_resolved[1]
+        )
+        if rep.ok:
+            detail = f"store={rep.store} clean"
+        elif resolved:
+            detail = (
+                f"store={rep.store} recovered past quarantine: "
+                f"{rep.detail()}"
+            )
+        else:
+            detail = f"store={rep.store} degraded: {rep.detail()}"
+        self.health.set("journal_integrity", rep.ok or resolved, detail)
+
+    def mark_integrity_recovered(self) -> None:
+        """A verified recovery absorbed everything quarantined so far:
+        re-promote the journal_integrity row (new corruption beyond this
+        high-water degrades it again)."""
+        rep = self.integrity_report()
+        if rep is not None:
+            self._integrity_resolved = (rep.corrupt, rep.seq_gaps)
+        self._note_integrity()
 
     def _store_lock(self):
         """The store's multi-writer lock (stores without one — custom
@@ -350,6 +679,17 @@ class BindJournal:
                         epoch, self._epoch_high, what="journal epoch"
                     )
                 self._epoch_high = max(self._epoch_high, epoch)
+                if self._seq > 0 and self.chaos.fire("journal.seq_gap"):
+                    # corruption fault domain: a WRITE HOLE — a seq
+                    # number consumed but its record never reaching the
+                    # store (lost sector). Load-time screening counts
+                    # the gap and degrades journal_integrity; no record
+                    # (and no acknowledged state) is behind it. Guarded
+                    # to an ESTABLISHED stream: a hole before the first
+                    # record is indistinguishable from a compacted
+                    # prefix, so injecting there would be undetectable
+                    # by design.
+                    self._seq += 1
                 self._seq += 1
                 rec = {
                     "seq": self._seq,
@@ -364,9 +704,30 @@ class BindJournal:
                     with self._store_lock():
                         self.store.append(rec)
                 except OSError as exc:
+                    # roll the seq back: the record never landed, and a
+                    # permanent hole here would read as a write hole at
+                    # every future load (seq-gap screening is exact)
+                    self._seq -= 1
                     raise JournalWriteError(
                         f"journal append failed: {exc!r}"
                     ) from exc
+                if op == "intent" and self.chaos.fire(
+                    "journal.corrupt_record"
+                ):
+                    # corruption fault domain: the record's bytes rot on
+                    # media AFTER the append was acknowledged. Applied
+                    # to the intent op (which contributes nothing to
+                    # replay) so the soak can assert the quarantine
+                    # machinery keeps every verifiable record AFTER the
+                    # corrupt one — the silent-truncation bug this PR
+                    # removes — while the zero-lost-ack ledger stays
+                    # assertable.
+                    bitrot = getattr(
+                        self.store, "corrupt_last_record", None
+                    )
+                    if bitrot is not None:
+                        with self._store_lock():
+                            bitrot()
                 self._since_checkpoint += 1
         except (JournalWriteError, StaleEpochError):
             if self.failures_counter is not None:
@@ -419,14 +780,76 @@ class BindJournal:
 
     # ---- replay / compaction ----
 
-    def replay(self) -> JournalReplay:
+    @staticmethod
+    def _checkpoint_image_ok(rec: dict) -> bool:
+        """A checkpoint record's recovery image is trustworthy when its
+        content digest verifies (legacy checkpoints without one are
+        trusted — the line-level CRC still covered them if sealed)."""
+        stamped = rec.get("image_digest")
+        if stamped is None:
+            return True
+        return stamped == integrity.payload_digest(
+            {"live": rec.get("live", {}), "extras": rec.get("extras", {})}
+        )
+
+    def replay(self, use_checkpoint: bool = True) -> JournalReplay:
+        """Rebuild the acknowledged live set.
+
+        ``use_checkpoint=True`` (default) fast-forwards from the LAST
+        digest-verified checkpoint recovery image and applies only the
+        tail behind it — recovery work bounded by (live set + tail), not
+        journal length. A checkpoint whose image digest fails is
+        REJECTED (counted in ``checkpoint_fallbacks``) and the replay
+        falls back to the next older verified image, or to full history.
+        ``use_checkpoint=False`` forces the full-history walk (the
+        recovery path's explicit fallback arm)."""
         rep = JournalReplay()
-        open_intent = False
-        for rec in sorted(self.store.load(), key=lambda r: r.get("seq", 0)):
-            op = rec.get("op")
-            rep.epoch_high = max(rep.epoch_high, rec.get("epoch", 0))
+        records = None
+        start = 0
+        if use_checkpoint:
+            tail_fn = getattr(self.store, "load_tail", None)
+            if tail_fn is not None:
+                tail = tail_fn()
+                if tail and self._checkpoint_image_ok(tail[0]):
+                    # bounded-RTO path: the pre-checkpoint prefix was
+                    # never even parsed — recovery work is O(live+tail)
+                    records = sorted(
+                        tail, key=lambda r: r.get("seq", 0)
+                    )
+                    rep.used_checkpoint = True
+        if records is None:
+            records = sorted(
+                self.store.load(), key=lambda r: r.get("seq", 0)
+            )
+            if use_checkpoint:
+                for i in range(len(records) - 1, -1, -1):
+                    if records[i].get("op") != "checkpoint":
+                        continue
+                    if self._checkpoint_image_ok(records[i]):
+                        start = i
+                        rep.used_checkpoint = True
+                        break
+                    # rejected images stay inside the applied window,
+                    # where the walk below counts each exactly once
+        # epoch/seq highs cover the WHOLE stream — fencing must not
+        # weaken because a checkpoint bounded the applied window (a
+        # checkpoint image archives the journal epoch high it covered)
+        for rec in records:
+            rep.epoch_high = max(
+                rep.epoch_high, self._record_epoch_high(rec)
+            )
             rep.seq_high = max(rep.seq_high, rec.get("seq", 0))
+        open_intent = False
+        for rec in records[start:]:
+            op = rec.get("op")
+            rep.applied += 1
             if op == "checkpoint":
+                if not self._checkpoint_image_ok(rec):
+                    # a rotted image inside the applied window: never
+                    # reset the live set from untrusted bytes — skip it
+                    # and keep folding the surrounding history
+                    rep.checkpoint_fallbacks += 1
+                    continue
                 rep.live = {
                     uid: dict(e) for uid, e in rec.get("live", {}).items()
                 }
@@ -450,15 +873,82 @@ class BindJournal:
                     rep.live.pop(uid, None)
         if open_intent:
             rep.open_intents += 1
+        integ = self.integrity_report()
+        if integ is not None:
+            rep.corrupt_records = integ.corrupt
+            rep.seq_gaps = integ.seq_gaps
+        self._note_integrity()
         return rep
 
-    def compact(self, epoch: Optional[int] = None) -> JournalReplay:
+    def _checkpoint_record(
+        self, rep: JournalReplay, epoch: Optional[int], extras: dict
+    ) -> dict:
+        """One checkpoint RECOVERY IMAGE (state-integrity PR): the exact
+        live set (bind entries already carry numa/dev holds, quota leaf
+        and lc context), the journal's epoch high, caller extras (e.g.
+        per-shard claim epoch-highs), and a content digest recovery
+        verifies before trusting the image."""
+        self._seq = max(self._seq, rep.seq_high) + 1
+        live = {u: dict(e) for u, e in rep.live.items()}
+        extras = dict(extras)
+        extras.setdefault("epoch_high", int(self._epoch_high))
+        checkpoint = {
+            "seq": self._seq,
+            "epoch": int(self._epoch_high if epoch is None else epoch),
+            "cycle": -1,
+            "op": "checkpoint",
+            "live": live,
+            "extras": extras,
+        }
+        checkpoint["image_digest"] = integrity.payload_digest(
+            {"live": live, "extras": extras}
+        )
+        if self.shard is not None:
+            checkpoint["shard"] = int(self.shard)
+        return checkpoint
+
+    def append_checkpoint(
+        self, epoch: Optional[int] = None, extras: Optional[dict] = None
+    ) -> JournalReplay:
+        """Append a checkpoint recovery image WITHOUT dropping history
+        (bounded-RTO acceleration): replay fast-forwards from it, but a
+        digest mismatch can still fall back to the full journal — the
+        belt :meth:`compact` cannot offer once it erased the prefix.
+        Epoch-fenced like compaction."""
+        with self._lock, self._store_lock():
+            rep = self.replay()
+            if epoch is not None and epoch < self._epoch_high:
+                raise StaleEpochError(
+                    epoch, self._epoch_high, what="checkpoint epoch"
+                )
+            checkpoint = self._checkpoint_record(rep, epoch, extras or {})
+            try:
+                self.store.append(checkpoint)
+            except OSError as exc:
+                self._seq -= 1
+                raise JournalWriteError(
+                    f"checkpoint append failed: {exc!r}"
+                ) from exc
+            self._since_checkpoint = 0
+        if self.writes_counter is not None:
+            self.writes_counter.labels(op="checkpoint").inc()
+        return rep
+
+    def compact(
+        self, epoch: Optional[int] = None, extras: Optional[dict] = None
+    ) -> JournalReplay:
         """Collapse the log to one checkpoint carrying the current live
         set (after a successful recovery, from the scheduler run loop via
         :meth:`maybe_compact`, or on a maintenance sweep so the log does
         not grow with cluster lifetime). A compaction stamped with an
         epoch older than one already journaled is refused — a deposed
         leader must not rewrite the log its successor is appending to.
+
+        Intent-before-commit (state-integrity PR): a ``checkpoint_intent``
+        record lands in the LIVE log before the rewrite, so a crash
+        mid-rewrite leaves evidence of the attempt (replay treats the
+        intent as a no-op); the checkpoint itself is a digest-stamped
+        recovery image (:meth:`_checkpoint_record`).
 
         Failure domain: the ``journal.compact_crash`` chaos point models
         a process death mid-rewrite. The live log is untouched (the
@@ -479,16 +969,23 @@ class BindJournal:
                 raise StaleEpochError(
                     epoch, self._epoch_high, what="compaction epoch"
                 )
-            self._seq = max(self._seq, rep.seq_high) + 1
-            checkpoint = {
-                "seq": self._seq,
-                "epoch": int(self._epoch_high if epoch is None else epoch),
-                "cycle": -1,
-                "op": "checkpoint",
-                "live": {u: dict(e) for u, e in rep.live.items()},
-            }
-            if self.shard is not None:
-                checkpoint["shard"] = int(self.shard)
+            try:
+                self.store.append(
+                    {
+                        "seq": max(self._seq, rep.seq_high) + 1,
+                        "epoch": int(
+                            self._epoch_high if epoch is None else epoch
+                        ),
+                        "cycle": -1,
+                        "op": "checkpoint_intent",
+                    }
+                )
+                self._seq = max(self._seq, rep.seq_high) + 1
+            except OSError as exc:
+                raise JournalWriteError(
+                    f"checkpoint intent append failed: {exc!r}"
+                ) from exc
+            checkpoint = self._checkpoint_record(rep, epoch, extras or {})
             if self.chaos.fire("journal.compact_crash"):
                 torn = getattr(self.store, "simulate_torn_rewrite", None)
                 if torn is not None:
@@ -720,6 +1217,7 @@ class ClaimTable:
             try:
                 self.store.append(rec)
             except OSError as exc:
+                self._seq -= 1  # no record landed: no write hole
                 raise JournalWriteError(
                     f"claim append failed: {exc!r}"
                 ) from exc
@@ -756,6 +1254,7 @@ class ClaimTable:
                     }
                 )
             except OSError as exc:
+                self._seq -= 1  # no record landed: no write hole
                 raise JournalWriteError(
                     f"claim release append failed: {exc!r}"
                 ) from exc
@@ -805,6 +1304,7 @@ class ClaimTable:
             try:
                 self.store.append(rec)
             except OSError as exc:
+                self._seq -= 1  # no record landed: no write hole
                 raise JournalWriteError(
                     f"gang hold append failed: {exc!r}"
                 ) from exc
@@ -830,6 +1330,7 @@ class ClaimTable:
                     {"seq": self._seq, "op": "gang_commit", "gang": gang}
                 )
             except OSError as exc:
+                self._seq -= 1  # no record landed: no write hole
                 raise JournalWriteError(
                     f"gang commit append failed: {exc!r}"
                 ) from exc
@@ -853,6 +1354,7 @@ class ClaimTable:
                     {"seq": self._seq, "op": "gang_abort", "gang": gang}
                 )
             except OSError as exc:
+                self._seq -= 1  # no record landed: no write hole
                 raise JournalWriteError(
                     f"gang abort append failed: {exc!r}"
                 ) from exc
@@ -913,6 +1415,7 @@ class ClaimTable:
             try:
                 self.store.append(rec)
             except OSError as exc:
+                self._seq -= 1  # no record landed: no write hole
                 raise JournalWriteError(
                     f"claim void append failed: {exc!r}"
                 ) from exc
@@ -943,6 +1446,7 @@ class ClaimTable:
             try:
                 self.store.append(rec)
             except OSError as exc:
+                self._seq -= 1  # no record landed: no write hole
                 raise JournalWriteError(
                     f"claim rehome append failed: {exc!r}"
                 ) from exc

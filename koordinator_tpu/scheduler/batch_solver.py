@@ -523,6 +523,7 @@ class BatchScheduler:
         fence=None,
         journal_compact_records: Optional[int] = None,
         journal_compact_bytes: Optional[int] = None,
+        scrub_rows: Optional[int] = None,
     ):
         from .frameworkext import FrameworkExtender
         from .plugins.coscheduling import PodGroupManager
@@ -763,6 +764,48 @@ class BatchScheduler:
                 # journal.write_fail fires from the scheduler's injector
                 # unless the journal brought its own
                 journal.chaos = self.chaos
+            # state-integrity PR: the journal's store counts quarantined
+            # records per store, and corruption flips the
+            # journal_integrity health row to degraded
+            store = journal.store
+            if hasattr(store, "integrity_total"):
+                # rewired UNCONDITIONALLY: a store surviving a crash
+                # restart still points at the dead incarnation's
+                # registry child, and this scheduler's /metrics must
+                # count. The fresh child is backfilled with the store's
+                # cumulative findings (detections that predate the
+                # wiring — the journal's own init load screens before
+                # the scheduler exists — and prior incarnations')
+                store.corrupt_counter = reg.get(
+                    "journal_corrupt_records_total"
+                ).labels(store=getattr(store, "name", "journal"))
+                backlog = (
+                    store.integrity_total.corrupt
+                    + store.integrity_total.seq_gaps
+                )
+                if backlog:
+                    store.corrupt_counter.inc(float(backlog))
+            if journal.health is None:
+                journal.health = self.extender.health
+                journal._note_integrity()
+        #: anti-entropy scrubber (state-integrity PR): rows audited per
+        #: scrub_step call (None = scrubbing disabled; the run loop's
+        #: tail bookkeeping then never audits). Each step re-lowers a
+        #: rotating window of host truth and compares it bit-exact
+        #: against the device-resident tables, self-healing divergence
+        #: through the dirty-row scatter.
+        self.scrub_rows = scrub_rows
+        self._scrub_cursor = 0
+        self._scrub_report: Dict[str, object] = {
+            "enabled": scrub_rows is not None,
+            "window": int(scrub_rows or 0),
+            "cursor": 0,
+            "steps": 0,
+            "rows_audited": 0,
+            "divergence": {},
+            "last": {},
+        }
+        self.extender.services.scrub = lambda: dict(self._scrub_report)
         self.extender.health.set("solver", True)
         self.extender.health.set("commit", True)
 
@@ -2438,6 +2481,276 @@ class BatchScheduler:
                         health.set("cycle_deadline", True)
         if not (self._cycle_commit_rolled_back or self._cycle_journal_failed):
             health.set("commit", True)
+        if self.scrub_rows:
+            # anti-entropy audit rides the cycle tail: one rotating
+            # window per cycle, never raising into the scheduling path
+            try:
+                self.scrub_step()
+            except Exception as exc:  # noqa: BLE001 — audit must not
+                # take down scheduling; a broken scrub is an error
+                # report, not an outage
+                report_exception(
+                    "scheduler.scrub", exc, registry=self.extender.registry
+                )
+
+    # ---- anti-entropy scrubber (state-integrity PR) ----
+
+    def _scrub_clean_rows(self, rows: np.ndarray) -> np.ndarray:
+        """The subset of ``rows`` whose resident node mirror must equal
+        CURRENT host truth: rows without a pending dirty mark. A marked
+        row legitimately lags (un-scattered truth, not rot); an
+        unmarked row was untouched since the mirror's version, so any
+        difference there is corruption. Empty when the whole mirror is
+        pending a rebuild."""
+        snap = self.snapshot
+        cur = self._resident_nodes
+        if (
+            cur is None
+            or snap._dirty_all
+            or cur.allocatable.shape[0] != snap.nodes.allocatable.shape[0]
+        ):
+            return np.zeros((0,), np.int32)
+        if snap._dirty_rows:
+            rows = rows[
+                ~np.isin(rows, np.fromiter(snap._dirty_rows, np.int64))
+            ]
+        return rows
+
+    def _scrub_nodes_window(self, rows: np.ndarray) -> np.ndarray:
+        """Host-truth vs resident comparison for one node-table window
+        (pre-filtered to clean rows by :meth:`_scrub_clean_rows`).
+        Returns the GLOBAL row indices that diverged."""
+        snap = self.snapshot
+        cur = self._resident_nodes
+        if len(rows) == 0:
+            return rows.astype(np.int32)
+        na = snap.nodes
+        est = (
+            np.maximum(na.usage_agg[rows], na.usage_avg[rows])
+            + na.assigned_pending[rows]
+        )
+        sched_rows = na.schedulable[rows]
+        if (
+            self.args.filter_expired_node_metrics
+            and not self.args.enable_schedule_when_node_metrics_expired
+        ):
+            sched_rows = sched_rows & (
+                na.metric_fresh[rows] | ~na.has_metric[rows]
+            )
+        idx = jnp.asarray(rows.astype(np.int32))
+        pairs = (
+            (na.allocatable[rows], cur.allocatable),
+            (na.requested[rows], cur.requested),
+            (est, cur.estimated_used),
+            (
+                na.prod_usage[rows] + na.assigned_pending_prod[rows],
+                cur.prod_used,
+            ),
+            (na.metric_fresh[rows], cur.metric_fresh),
+            (sched_rows, cur.schedulable),
+            (na.cpu_amp[rows], cur.cpu_amp),
+            (na.custom_thresholds[rows], cur.custom_thresholds),
+            (na.custom_prod_thresholds[rows], cur.custom_prod_thresholds),
+        )
+        bad = np.zeros((len(rows),), bool)
+        for host, res in pairs:
+            got = np.asarray(jnp.take(res, idx, axis=0))
+            diff = got != np.asarray(host)
+            bad |= (
+                diff
+                if diff.ndim == 1
+                else diff.reshape(len(rows), -1).any(axis=1)
+            )
+        return rows[bad]
+
+    def _scrub_constraint_window(
+        self, mgr, cache, arrays_of, rows: np.ndarray
+    ) -> np.ndarray:
+        """Window audit for a manager-backed resident table (NUMA zones
+        / device slots). ``cache`` is the (key, state) device cache,
+        ``arrays_of`` maps the manager to its ordered host arrays and
+        the cached state to the matching device arrays. Rows with a
+        pending scatter mark are excluded (they legitimately lag until
+        the next refresh); unmarked rows must match host truth
+        bit-exactly."""
+        if cache is None or mgr._scatter_full:
+            return np.zeros((0,), np.int32)
+        _key, state = cache
+        # arrays_of flushes the manager's pending dirty names into the
+        # scatter marks, and CAN raise the full-rebuild flag mid-flush
+        host_arrays, dev_arrays = arrays_of(mgr, state)
+        if mgr._scatter_full:
+            return np.zeros((0,), np.int32)
+        if mgr._scatter_rows:
+            rows = rows[
+                ~np.isin(
+                    rows, np.fromiter(mgr._scatter_rows, np.int64)
+                )
+            ]
+        if len(rows) == 0:
+            return rows.astype(np.int32)
+        idx = jnp.asarray(rows.astype(np.int32))
+        bad = np.zeros((len(rows),), bool)
+        for host, dev in zip(host_arrays, dev_arrays):
+            if dev is None:
+                continue
+            host = np.asarray(host)
+            dev_shape = tuple(dev.shape)
+            if dev_shape != host.shape or rows.max() >= host.shape[0]:
+                return np.zeros((0,), np.int32)
+            got = np.asarray(jnp.take(dev, idx, axis=0))
+            diff = got != host[rows]
+            bad |= (
+                diff
+                if diff.ndim == 1
+                else diff.reshape(len(rows), -1).any(axis=1)
+            )
+        return rows[bad]
+
+    def scrub_step(self, rows: Optional[int] = None) -> Dict[str, object]:
+        """One anti-entropy audit step (state-integrity PR): re-lower a
+        rotating window of HOST truth and compare it bit-exact against
+        the device-resident NodeState / NUMA / device / quota tables.
+        Divergence (cosmic bit rot, a missed scatter, or the
+        ``resident.bit_flip`` chaos point) is counted per table
+        (``resident_scrub_divergence_total{table}``), self-healed
+        through ``touch_rows`` + the dirty-row scatter, and surfaced at
+        ``/debug/scrub``. The audit is PASSIVE for tables mid-refresh:
+        a resident mirror legitimately behind its host version is
+        skipped, never "healed" against in-flight truth."""
+        reg = self.extender.registry
+        snap = self.snapshot
+        window = int(
+            rows if rows is not None else (self.scrub_rows or 64)
+        )
+        report = self._scrub_report
+        with snap.lock:
+            n_bucket = snap.nodes.allocatable.shape[0]
+            start = self._scrub_cursor % n_bucket
+            span = np.arange(start, start + min(window, n_bucket))
+            win = (span % n_bucket).astype(np.int32)
+            win = np.unique(win)
+            self._scrub_cursor = (start + min(window, n_bucket)) % n_bucket
+            # The audit is STRICTLY PASSIVE on the device side: it
+            # never re-lowers or scatters here, because an in-flight
+            # speculative solve (cross-cycle pipeline) may still read
+            # the current resident buffers and a scatter DONATES them.
+            # Rows with a pending dirty mark are excluded — a marked
+            # row legitimately lags host truth until the next refresh
+            # scatters it; an UNMARKED row must match bit-exactly.
+            clean = self._scrub_clean_rows(win)
+            if len(clean) and self.chaos.fire("resident.bit_flip"):
+                # corruption fault domain: one resident cell rots on
+                # device. Injected into a CLEAN row of the current
+                # window, so the audit that owns this step detects it
+                # immediately and the heal mark makes the next refresh
+                # scatter truth back (the soak separately asserts
+                # end-state bit-exactness). Evaluated only when this
+                # step can audit — an armed flip waits for a step with
+                # clean rows instead of rotting undetectably.
+                row = int(clean[0])
+                cur = self._resident_nodes
+                self._resident_nodes = cur.replace(
+                    requested=cur.requested.at[row, 0].add(1.0)
+                )
+            diverged: Dict[str, int] = {}
+            healed_rows: Dict[str, list] = {}
+            bad = self._scrub_nodes_window(clean)
+            if len(bad):
+                diverged["nodes"] = int(len(bad))
+                healed_rows["nodes"] = [int(r) for r in bad]
+                # heal by MARKING: the next cycle's normal refresh
+                # scatters host truth into exactly these rows (writing
+                # here would donate buffers an in-flight speculative
+                # solve may still read)
+                snap.touch_rows(bad)
+            if (
+                self.numa is not None
+                and getattr(self.numa, "has_topology", False)
+            ):
+                bad = self._scrub_constraint_window(
+                    self.numa,
+                    self._numa_dev_cache,
+                    lambda m, s: (
+                        (*m.arrays(), m.most_allocated_rows()),
+                        (s.zone_free, s.zone_cap, s.policy, s.zone_most),
+                    ),
+                    win,
+                )
+                if len(bad):
+                    diverged["numa"] = int(len(bad))
+                    healed_rows["numa"] = [int(r) for r in bad]
+                    self.numa.touch_lowered_rows(bad)
+            if (
+                self.devices is not None
+                and getattr(self.devices, "has_devices", False)
+            ):
+                bad = self._scrub_constraint_window(
+                    self.devices,
+                    self._device_dev_cache,
+                    lambda m, s: (
+                        (
+                            m.slot_array(),
+                            m.rdma_array() if m.has_rdma else None,
+                            m.fpga_array() if m.has_fpga else None,
+                            m.cap_array(),
+                        ),
+                        (s.slot_free, s.rdma_free, s.fpga_free, s.cap_total),
+                    ),
+                    win,
+                )
+                if len(bad):
+                    diverged["device"] = int(len(bad))
+                    healed_rows["device"] = [int(r) for r in bad]
+                    self.devices.touch_lowered_rows(bad)
+            n_quota = self._scrub_quota_table()
+            if n_quota:
+                diverged["quota"] = n_quota
+        reg.get("resident_scrub_rows_total").inc(float(len(win)))
+        for table, n in diverged.items():
+            reg.get("resident_scrub_divergence_total").labels(
+                table=table
+            ).inc(float(n))
+        report["steps"] = int(report["steps"]) + 1
+        report["cursor"] = int(self._scrub_cursor)
+        report["window"] = window
+        report["rows_audited"] = int(report["rows_audited"]) + len(win)
+        totals = dict(report["divergence"])
+        for table, n in diverged.items():
+            totals[table] = totals.get(table, 0) + n
+        report["divergence"] = totals
+        report["last"] = {
+            "rows": [int(win[0]), int(win[-1])] if len(win) else [],
+            "diverged": diverged,
+            "healed_rows": healed_rows,
+        }
+        return report["last"]
+
+    def _scrub_quota_table(self) -> int:
+        """Whole-table audit of the resident quota lowering (small:
+        [Q, D] twice). Diverged → drop the device cache (the next
+        quota_state re-lowers from host truth — the quota table's
+        normal full-upload path). Returns diverged row count."""
+        cache = self._quota_dev_cache
+        if cache is None or self.quotas is None:
+            return 0
+        key, state = cache
+        if key[0] != self.quotas.state_version:
+            return 0
+        runtime, used = self.quotas.quota_arrays_extended()
+        if runtime.shape[0] == 1:
+            pad = np.zeros((1, runtime.shape[1]), np.float32)
+            runtime = np.concatenate([runtime, pad])
+            used = np.concatenate([used, pad])
+        if runtime.shape != tuple(state.runtime.shape):
+            return 0
+        bad = (np.asarray(state.runtime) != runtime).any(axis=1) | (
+            np.asarray(state.used) != used
+        ).any(axis=1)
+        n = int(bad.sum())
+        if n:
+            self._quota_dev_cache = None
+        return n
 
     def node_allowed(self, pod: Pod, node_name: str) -> bool:
         """Single-node form of the node-constraint mask (nodeSelector /

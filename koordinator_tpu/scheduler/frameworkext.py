@@ -214,6 +214,33 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "rewrites; failed/crashed attempts are NOT counted — the live "
         "log is intact and the next threshold retries)",
     )
+    # state-integrity PR: checksummed journals, verified checkpoints,
+    # resident-state anti-entropy scrubbing
+    reg.counter(
+        "journal_corrupt_records_total",
+        "journal-store records quarantined by load-time CRC/seq "
+        "screening (media corruption or write holes; a torn final "
+        "line is an unacknowledged append, not corruption), per store",
+        labels=("store",),
+    )
+    reg.counter(
+        "recovery_checkpoint_fallback_total",
+        "recoveries that fell back to a full-history journal replay "
+        "because a checkpoint recovery image failed its digest check "
+        "(or the checkpoint.digest_mismatch chaos point forced it)",
+    )
+    reg.counter(
+        "resident_scrub_rows_total",
+        "device-resident rows audited by the anti-entropy scrubber's "
+        "rotating window (re-lowered from host truth and compared "
+        "bit-exact)",
+    )
+    reg.counter(
+        "resident_scrub_divergence_total",
+        "resident rows found diverged from host truth by the scrubber "
+        "and self-healed through the dirty-row scatter, per table",
+        labels=("table",),
+    )
     # overload-control PR: QoS-aware admission + brownout ladder +
     # solver-channel circuit breaker
     reg.counter(
@@ -471,6 +498,9 @@ class ServicesEngine:
       /debug/flightrecorder  — last-N per-cycle summaries (crash-
                                surviving black box)
       /debug/brownout        — brownout-ladder level, burn, transitions
+      /debug/scrub           — anti-entropy scrubber state (cursor,
+                               rows audited, divergences healed per
+                               table, last window digests)
       /debug/compiles        — solver compile/retrace ledger (traces per
                                entry point, signature diffs, compile wall)
       /debug/profile         — solver observatory status; ?cycles=N arms
@@ -504,6 +534,9 @@ class ServicesEngine:
         #: brownout-ladder controller (overload-control PR) — wired by
         #: the stream/sharded scheduler when overload control is on
         self.brownout = None
+        #: anti-entropy scrubber report callable (state-integrity PR) —
+        #: wired by BatchScheduler when scrubbing is enabled
+        self.scrub: Optional[Callable[[], Dict[str, object]]] = None
         self.gate_info: Optional[Callable[[], Dict[str, object]]] = None
         self._routes: Dict[str, Callable[[str], Tuple[int, str]]] = {}
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -553,6 +586,10 @@ class ServicesEngine:
             if self.brownout is None:
                 return 404, "no brownout controller wired"
             return 200, self.brownout.render()
+        if path == "/debug/scrub":
+            if self.scrub is None:
+                return 404, "no resident-state scrubber wired"
+            return 200, json.dumps(self.scrub(), indent=1)
         if path == "/debug/compiles":
             if self.devprof is None:
                 return 404, "no solver observatory wired"

@@ -246,6 +246,14 @@ class DeviceManager:
 
         return drain_scatter_marks(self)
 
+    def touch_lowered_rows(self, rows) -> None:
+        """Mark lowered rows stale for the resident mirror WITHOUT a
+        host-side change (anti-entropy scrubber heal path): the next
+        resident refresh re-scatters host truth into exactly these
+        rows."""
+        self._scatter_rows.update(int(r) for r in rows)
+        self.lowered_version += 1
+
     def upsert_device(self, device: Device) -> None:
         """Ingest/refresh a node's inventory. Live allocations survive a
         re-sync: the slot table is rebuilt from capacity and every owner's

@@ -375,6 +375,14 @@ class NUMAManager:
 
         return drain_scatter_marks(self)
 
+    def touch_lowered_rows(self, rows) -> None:
+        """Mark lowered rows stale for the resident mirror WITHOUT a
+        host-side change (anti-entropy scrubber heal path): the next
+        resident refresh re-scatters host truth into exactly these
+        rows."""
+        self._scatter_rows.update(int(r) for r in rows)
+        self.lowered_version += 1
+
     def most_allocated_rows(self) -> np.ndarray:
         """[N] bool MostAllocated zone-pick strategy per snapshot row
         (``_most_allocated`` resolution), for the solver's on-device zone

@@ -94,7 +94,7 @@ class ResvView:
 
     __slots__ = (
         "mgr", "phase", "allocated", "owners", "ledger", "assumed",
-        "node_req", "_cands",
+        "node_req", "_cands", "_nom", "version",
     )
 
     def __init__(self, mgr: "ReservationManager"):
@@ -102,6 +102,11 @@ class ResvView:
         #: lazy per-PREVIEW candidate cache (see candidates()) — reset
         #: on clone so a carried view never serves a stale list
         self._cands: Optional[List[Reservation]] = None
+        #: lazy vectorized nomination arrays over the overlay state
+        #: (state-integrity PR satellite), invalidated by ``version``
+        #: which bumps on every predicted mutation
+        self._nom = None
+        self.version = 0
         #: name -> predicted phase (terminal transitions)
         self.phase: Dict[str, ReservationPhase] = {}
         #: name -> predicted allocated dict (full copy once touched)
@@ -118,6 +123,8 @@ class ResvView:
 
     def clone(self) -> "ResvView":
         out = ResvView(self.mgr)
+        out._nom = None
+        out.version = 0
         out.phase = dict(self.phase)
         out.allocated = {k: dict(v) for k, v in self.allocated.items()}
         out.owners = {k: list(v) for k, v in self.owners.items()}
@@ -253,6 +260,13 @@ class ReservationManager:
         #: per-cycle Available candidate cache (see begin_cycle)
         self._cycle_candidates: Optional[List[Reservation]] = None
         self._cycle_epoch = -1
+        #: bumped on ANY nomination-relevant mutation (phase, allocated,
+        #: owners, requests, node assignment) — the vectorized match
+        #: arrays (state-integrity PR satellite) key their cache on it
+        self._ledger_version = 0
+        #: (candidate list object, ledger version, arrays) — strong ref
+        #: to the list keeps identity comparison sound
+        self._nom_cache = None
         #: terminal reservations are deleted after this long (reference
         #: controller/garbage_collection.go, ReservationArgs.GCDuration)
         self.gc_duration_s = gc_duration_s
@@ -265,6 +279,14 @@ class ReservationManager:
         #: whose own assume IS the capacity hold (operating_pod.go)
         self._operating: Dict[str, Pod] = {}
 
+    def _bump_ledger(self) -> None:
+        """Invalidate the vectorized nomination arrays: call after ANY
+        mutation of phase / allocated / owners / requests / node
+        assignment (node CAPACITY rows are read live at match time and
+        need no bump)."""
+        self._ledger_version += 1
+        self._nom_cache = None
+
     def add(self, reservation: Reservation) -> None:
         # a re-created name must not inherit the old incarnation's
         # terminal clock or owner ledger (premature GC / stale refunds)
@@ -272,6 +294,7 @@ class ReservationManager:
         self._owner_requests.pop(reservation.meta.name, None)
         self._reservations[reservation.meta.name] = reservation
         self._cycle_candidates = None
+        self._bump_ledger()
 
     def get(self, name: str) -> Optional[Reservation]:
         return self._reservations.get(name)
@@ -321,6 +344,7 @@ class ReservationManager:
             r.phase = ReservationPhase.AVAILABLE
             r.node_name = pod.spec.node_name
             r.available_time = self._clock()
+            self._bump_ledger()
             # the pod's own charge is the hold — pin it against expiry
             if self.scheduler.snapshot.is_assumed(pod.meta.uid):
                 self.scheduler.snapshot.confirm_pod(pod.meta.uid)
@@ -358,6 +382,7 @@ class ReservationManager:
         outcome = self.scheduler.schedule([self._ghost_pod(r) for r in pending])
 
         self._cycle_candidates = None
+        self._bump_ledger()
         for pod, node in outcome.bound:
             r = ghosts[pod.meta.uid]
             r.phase = ReservationPhase.AVAILABLE
@@ -408,6 +433,7 @@ class ReservationManager:
                         allocated[name] = allocated.get(name, 0.0) + float(qty)
                     except (TypeError, ValueError):
                         continue
+        self._bump_ledger()  # requests (owner-matching capacity) mutate
         for name, qty in allocated.items():
             r.requests[name] = qty
         if ext.RES_GPU_MEMORY_RATIO in allocated:
@@ -526,7 +552,23 @@ class ReservationManager:
         candidate cache; the pipeline's dispatch-side preview is exactly
         this call, so a preview and the consuming cycle's real match can
         only diverge when the state between them really changed (and the
-        consume-time table comparison then discards the speculation)."""
+        consume-time table comparison then discards the speculation).
+
+        State-integrity PR satellite: the per-pod scan is VECTORIZED —
+        numpy over the candidate axis for the capacity/spill/score
+        arithmetic (the host hot spot at hundreds of live reservations,
+        both on the serial drain and the fast-path preview), with the
+        candidate matrices cached per (candidate list, ledger version)
+        and owner selectors de-duplicated by signature.
+        :meth:`_match_scalar` keeps the reference loop; the equivalence
+        test holds them decision-identical over randomized populations.
+        """
+        return self._match_vector(pod, view)
+
+    def _match_scalar(
+        self, pod: Pod, view: Optional[ResvView] = None
+    ) -> Optional[Reservation]:
+        """Reference per-candidate loop (pre-vectorization semantics)."""
         if ext.is_reservation_ignored(pod):
             return None
         affinity = ext.parse_reservation_affinity(pod.meta.annotations)
@@ -610,6 +652,281 @@ class ReservationManager:
                 best_score = score
                 best = r
         return best
+
+    # ---- vectorized nomination (state-integrity PR satellite) ----
+
+    def _nom_arrays_for(self, cands: List[Reservation], view):
+        """Candidate matrices for the vectorized scan, cached on
+        (candidate list identity, ledger version[, view version]).
+        Resource axis = sorted union of the candidates' declared keys;
+        numeric dtype float64 end-to-end so every element op reproduces
+        the scalar loop's python-float arithmetic bit-exactly."""
+        import numpy as np
+
+        if view is None:
+            cache = self._nom_cache
+            key = (cands, self._ledger_version)
+            if cache is not None and cache[0] is key[0] and cache[1] == key[1]:
+                return cache[2]
+        else:
+            cache = view._nom
+            key = (cands, self._ledger_version, view.version)
+            if (
+                cache is not None
+                and cache[0] is key[0]
+                and cache[1:3] == key[1:3]
+            ):
+                return cache[3]
+        snap = self.scheduler.snapshot
+        keys = sorted({k for r in cands for k in r.requests})
+        kpos = {k: i for i, k in enumerate(keys)}
+        C, K = len(cands), len(keys)
+        req = np.zeros((C, K))
+        alloc = np.zeros((C, K))
+        declared = np.zeros((C, K), bool)
+        restricted = np.zeros((C, K), bool)
+        node_idx = np.zeros((C,), np.int64)
+        alloc_once = np.zeros((C,), bool)
+        blocked = np.zeros((C,), bool)  # allocate_once & has owners
+        order = np.full((C,), np.inf)
+        has_order = np.zeros((C,), bool)
+        names = [r.meta.name for r in cands]
+        name_rank = np.empty((C,), np.int64)
+        name_rank[sorted(range(C), key=lambda i: names[i])] = np.arange(C)
+        #: distinct owner-selector signatures -> candidate rows (owner
+        #: matching is string work; most fleets share a handful of
+        #: selector shapes, so evaluate each ONCE per pod)
+        sigs: Dict[tuple, List[int]] = {}
+        for c, r in enumerate(cands):
+            alloc_src = (
+                r.allocated if view is None else view.allocated_of(r)
+            )
+            owners_src = (
+                r.current_owners if view is None else view.owners_of(r)
+            )
+            for k, v in r.requests.items():
+                req[c, kpos[k]] = float(v)
+                declared[c, kpos[k]] = True
+            for k, v in alloc_src.items():
+                if k in kpos:
+                    alloc[c, kpos[k]] = float(v)
+            idx = (
+                snap.node_id(r.node_name)
+                if r.node_name is not None
+                else None
+            )
+            node_idx[c] = -1 if idx is None else int(idx)
+            alloc_once[c] = bool(r.allocate_once)
+            blocked[c] = bool(r.allocate_once and owners_src)
+            o = _reservation_order(r)
+            if o is not None:
+                order[c] = float(o)
+                has_order[c] = True
+            if r.allocate_policy == RESERVATION_ALLOCATE_POLICY_RESTRICTED:
+                opts = ext.parse_reservation_restricted_resources(
+                    r.meta.annotations
+                )
+                binding = (
+                    set(opts) & set(r.requests)
+                    if opts is not None
+                    else set(r.requests)
+                )
+                for k in binding:
+                    restricted[c, kpos[k]] = True
+            sig = tuple(
+                (
+                    o.namespace,
+                    tuple(sorted(o.label_selector.items())),
+                )
+                for o in r.owners
+            )
+            sigs.setdefault(sig, []).append(c)
+        #: union key -> config-resource column (None = not a node dim)
+        cfg_col = {
+            k: (
+                list(snap.config.resources).index(k)
+                if k in snap.config.resources
+                else None
+            )
+            for k in keys
+        }
+        arrays = {
+            "cands": cands, "keys": keys, "kpos": kpos,
+            "req": req, "alloc": alloc, "declared": declared,
+            "restricted": restricted, "node_idx": node_idx,
+            "alloc_once": alloc_once, "blocked": blocked,
+            "order": order, "has_order": has_order,
+            "names": names, "name_rank": name_rank, "sigs": sigs,
+            "cfg_col": cfg_col,
+        }
+        if view is None:
+            self._nom_cache = (cands, self._ledger_version, arrays)
+        else:
+            view._nom = (
+                cands, self._ledger_version, view.version, arrays
+            )
+        return arrays
+
+    @staticmethod
+    def _sig_matches(sig: tuple, pod: Pod) -> bool:
+        """`matches_owner` over one de-duplicated selector signature."""
+        for ns, items in sig:
+            if not items and ns is None:
+                continue  # an empty owner matches nothing
+            if ns is not None and ns != pod.meta.namespace:
+                continue
+            if all(pod.meta.labels.get(k) == v for k, v in items):
+                return True
+        return False
+
+    def _match_vector(
+        self, pod: Pod, view: Optional[ResvView] = None
+    ) -> Optional[Reservation]:
+        import numpy as np
+
+        if ext.is_reservation_ignored(pod):
+            return None
+        cands = self._candidates() if view is None else view.candidates()
+        if not cands:
+            return None
+        A = self._nom_arrays_for(cands, view)
+        C = len(cands)
+        snap = self.scheduler.snapshot
+        # ---- eligibility over the candidate axis ----
+        ok = ~A["blocked"]
+        if view is not None:
+            # predicted phase transitions (consumed earlier this chain)
+            for c, r in enumerate(cands):
+                if ok[c] and view.phase_of(r) != ReservationPhase.AVAILABLE:
+                    ok[c] = False
+        else:
+            # a candidate consumed earlier in this same cycle flipped
+            # terminal, which bumped the ledger version and rebuilt the
+            # arrays — but guard against direct phase pokes too
+            for c, r in enumerate(cands):
+                if ok[c] and r.phase != ReservationPhase.AVAILABLE:
+                    ok[c] = False
+        affinity = ext.parse_reservation_affinity(pod.meta.annotations)
+        if affinity is not None:
+            name = affinity.get("name")
+            if name:
+                ok &= np.fromiter(
+                    (n == name for n in A["names"]), bool, count=C
+                )
+            else:
+                selector = affinity.get("reservationSelector") or {}
+                for c, r in enumerate(cands):
+                    if ok[c] and not all(
+                        r.meta.labels.get(k) == v
+                        for k, v in selector.items()
+                    ):
+                        ok[c] = False
+        exact_names = ext.parse_exact_match_reservation_spec(
+            pod.meta.annotations
+        )
+        if exact_names is not None:
+            for c, r in enumerate(cands):
+                if ok[c] and not ext.exact_match_reservation(
+                    pod.spec.requests, r.requests, exact_names
+                ):
+                    ok[c] = False
+        # owner matching, one evaluation per distinct selector signature
+        owner_ok = np.zeros((C,), bool)
+        for sig, rows in A["sigs"].items():
+            if self._sig_matches(sig, pod):
+                owner_ok[rows] = True
+        ok &= owner_ok
+        if not ok.any():
+            return None
+        # ---- allocate-policy arithmetic, vectorized ----
+        # (same element ops as consumed_and_spill: float64 min/max/cmp,
+        # so filter decisions are bit-identical to the scalar loop)
+        keys, kpos = A["keys"], A["kpos"]
+        pod_vec = np.zeros((len(keys),))
+        extra_spill: Dict[str, float] = {}
+        for k, v in pod.spec.requests.items():
+            if k in kpos:
+                pod_vec[kpos[k]] = float(v)
+            elif float(v) > 1e-6:
+                extra_spill[k] = float(v)  # undeclared everywhere
+        remaining = A["req"] - A["alloc"]
+        credit = np.minimum(
+            pod_vec[None, :], np.maximum(remaining, 0.0)
+        ) * A["declared"]
+        spill = pod_vec[None, :] - credit
+        spill[spill <= 1e-6] = 0.0
+        # Restricted: no spill on a binding dim
+        ok &= ~((spill > 0.0) & A["restricted"]).any(axis=1)
+        # ---- node-fit for the spill (live node rows; view deltas) ----
+        has_spill = spill.any(axis=1) | bool(extra_spill)
+        need = ok & has_spill
+        if need.any():
+            na = snap.nodes
+            idxs = A["node_idx"]
+            valid = idxs >= 0
+            ok &= valid | ~has_spill
+            need &= valid
+            if need.any():
+                D = len(snap.config.resources)
+                spill_cfg = np.zeros((C, D), np.float32)
+                for k, col in A["cfg_col"].items():
+                    if col is not None:
+                        spill_cfg[:, col] += spill[:, kpos[k]].astype(
+                            np.float32
+                        )
+                if extra_spill:
+                    extra_vec = snap.config.res_vector(extra_spill)
+                    spill_cfg += extra_vec[None, :]
+                rows = idxs[need]
+                fits = np.zeros((C,), bool)
+                fits[need] = na.schedulable[rows] & np.all(
+                    na.requested[rows] + spill_cfg[need]
+                    <= na.allocatable[rows] + 1e-3,
+                    axis=1,
+                )
+                if view is not None and view.node_req:
+                    # patch the few overlaid rows with predicted deltas
+                    for c in np.nonzero(need)[0]:
+                        delta = view.node_req.get(int(idxs[c]))
+                        if delta is None:
+                            continue
+                        fits[c] = bool(
+                            na.schedulable[idxs[c]]
+                            and np.all(
+                                na.requested[idxs[c]]
+                                + delta
+                                + spill_cfg[c]
+                                <= na.allocatable[idxs[c]] + 1e-3
+                            )
+                        )
+                ok &= fits | ~has_spill
+        if not ok.any():
+            return None
+        # ---- order label dominates; else MostAllocated score ----
+        ordered = ok & A["has_order"]
+        if ordered.any():
+            vals = np.where(ordered, A["order"], np.inf)
+            return cands[int(np.argmin(vals))]  # first index on ties
+        cap = A["req"]
+        pos = A["declared"] & (cap > 0.0)
+        denom = pos.sum(axis=1)
+        req_tot = pod_vec[None, :] + A["alloc"]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            term = np.where(
+                pos & (req_tot <= cap + 1e-6),
+                100.0 * np.minimum(req_tot, cap) / np.where(
+                    cap > 0.0, cap, 1.0
+                ),
+                0.0,
+            )
+        score = np.where(denom > 0, term.sum(axis=1), 0.0) / np.maximum(
+            denom, 1
+        )
+        score = np.where(ok, score, -np.inf)
+        best = score.max()
+        tied = np.nonzero(score == best)[0]
+        # exact-equality tie-break: lexicographically smallest name
+        return cands[int(tied[np.argmin(A["name_rank"][tied])])]
 
     def begin_cycle(self) -> None:
         """Cache the Available candidate set for one scheduling cycle
@@ -728,6 +1045,8 @@ class ReservationManager:
         import numpy as np
 
         assert reservation.meta.name not in self._operating
+        view.version += 1
+        view._nom = None
         snap = self.scheduler.snapshot
         node = reservation.node_name
         idx = snap.node_id(node)
@@ -837,6 +1156,7 @@ class ReservationManager:
         # spill beyond remaining, and any undeclared dim, is the pod's
         # own node charge, headroom-checked by the commit path).
         consumed, _spill = self.consumed_and_spill(reservation, pod)
+        self._bump_ledger()
         for k, take in consumed.items():
             reservation.allocated[k] = reservation.allocated.get(k, 0.0) + take
         reservation.current_owners.append(pod.meta.uid)
@@ -942,6 +1262,7 @@ class ReservationManager:
         # setdefault would keep a GC'd-then-recreated name's old clock
         r.phase = phase
         self._terminal_time[r.meta.name] = self._clock()
+        self._bump_ledger()
 
     def sync(self, now: Optional[float] = None) -> Dict[str, List[str]]:
         """The reservation controller's periodic sweep (reference
@@ -991,6 +1312,7 @@ class ReservationManager:
                 snap.assume_pod(ghost, r.node_name)
             report["drifted"].append(r.meta.name)
             self._cycle_candidates = None
+            self._bump_ledger()
         # pod-backed SUCCEEDED reservations: an owner that died before the
         # still-RUNNING placeholder must re-expand the placeholder's charge
         # — without this, owner death leaves the node charged only the
@@ -1041,5 +1363,6 @@ class ReservationManager:
                 self._owner_requests.pop(name, None)
                 self._operating.pop(name, None)
                 self._cycle_candidates = None
+                self._bump_ledger()
                 report["deleted"].append(name)
         return report

@@ -743,6 +743,12 @@ def run_chaos_soak(
             fetch_timeout_s=2.0,
             journal=BindJournal(journal_store) if ha else None,
             fence=fence,
+            # state-integrity PR: the anti-entropy scrubber audits a
+            # rotating resident-row window every cycle tail — the
+            # resident.bit_flip arm below must be DETECTED and healed
+            # by it, and a clean soak proves the audit itself never
+            # perturbs scheduling (same-seed-same-trace still holds)
+            scrub_rows=8,
         )
         s.extender.monitor.stop_background()
         r = s.extender.registry
@@ -852,6 +858,13 @@ def run_chaos_soak(
         "crash_restarts": 0,
         "recovered_bindings": 0,
         "cycles_without_leader": 0,
+        #: state-integrity PR: corruption-domain evidence — scrub
+        #: divergences healed (folded across incarnations), checkpoint
+        #: usage/fallback on the post-crash recovery, and the journal
+        #: store's quarantine ledger (stamped at the end)
+        "scrub_divergence": {},
+        "recovery_used_checkpoint": 0,
+        "checkpoint_fallbacks": 0,
         #: adaptive-depth PR: the controller's per-cycle choice (plain
         #: arm runs max depth 2 — the trace must flex 2→1 under the
         #: fault-window churn and recover to 2 in the quiet tail).
@@ -878,9 +891,20 @@ def run_chaos_soak(
     carry_mismatch_cycle = max(3, (2 * cycles) // 7)
     stale_commit_cycle = max(2, cycles // 5)     # ha: fenced commit
     journal_fault_cycle = max(4, (2 * cycles) // 5)  # ha: append refusal
+    # state-integrity PR (corruption fault domain, fixed cycles — no rng
+    # draws, historical schedules stay bit-identical): one resident-table
+    # bit flip the scrubber must detect+heal, and — HA only, the arms
+    # need a journal — one mid-stream corrupt record (quarantined, zero
+    # acked binds lost), one seq write hole, and a checkpoint image whose
+    # digest the post-crash recovery must reject (full-replay fallback)
+    bit_flip_cycle = max(2, (3 * cycles) // 8)
+    corrupt_record_cycle = max(3, (4 * cycles) // 9)
+    seq_gap_cycle = max(4, (5 * cycles) // 11)
     # HA leg (failover PR): one scheduled kill-restart well after the
     # other fault domains have fired, leader flaps from the rng_ha stream
     restart_cycle = max(6, (3 * cycles) // 5) if ha else None
+    checkpoint_cycle = (restart_cycle - 2) if ha else None
+    ckpt_written = [False]
     # retrace-free steady state starts once every scheduled structural
     # fault (deadline surge/degrade, crash-restart) is behind + slack
     # for the degrade to re-promote
@@ -938,6 +962,7 @@ def run_chaos_soak(
         nonlocal snap, gqm, sched, pipe, reg, coord, q_idx
         nonlocal incarnation, lost_pods
         stats["crash_restarts"] += 1
+        _fold_scrub()   # the dying incarnation's audit ledger
         pipe.close()   # resource hygiene only — all state is discarded
         hub.detach_consumers()
         lost_pods = [p for p in orphans if p.meta.uid not in placed]
@@ -961,6 +986,14 @@ def run_chaos_soak(
         # incarnation boundary: the dead process's resident arrays must
         # actually die (leak-detector arm)
         leaks.sample(f"restart-{incarnation}")
+
+    def _fold_scrub():
+        """Fold the current incarnation's anti-entropy audit ledger into
+        the run stats (the per-scheduler report dies with its process)."""
+        for table, n in sched._scrub_report["divergence"].items():
+            stats["scrub_divergence"][table] = (
+                stats["scrub_divergence"].get(table, 0) + int(n)
+            )
 
     def _sync_cycle_delta(new_bound, forgotten):
         """Mirror this cycle's bindings/completions to the sidecar; a
@@ -1051,6 +1084,38 @@ def run_chaos_soak(
                 # journal-before-mutate: the refused append rejects the
                 # chunk un-mutated (JOURNAL_WRITE_FAILED), pods retry
                 chaos.arm("journal.write_fail", times=1)
+            if cycle == bit_flip_cycle:
+                # one resident cell rots on device; the cycle-tail
+                # scrub window owning the flipped row detects and heals
+                # it (end-state bit-exactness re-proves the heal)
+                chaos.arm("resident.bit_flip", times=1)
+            if ha and cycle == corrupt_record_cycle:
+                # media rot on an ACKED journal record (fires at the
+                # next intent append): load-time screening quarantines
+                # exactly that record and keeps every verifiable record
+                # after it — the zero-lost-ack assert at the end is the
+                # proof silent truncation is gone
+                chaos.arm("journal.corrupt_record", times=1)
+            if ha and cycle == seq_gap_cycle:
+                chaos.arm("journal.seq_gap", times=1)
+            if (
+                ha
+                and checkpoint_cycle is not None
+                and checkpoint_cycle <= cycle < restart_cycle
+                and not ckpt_written[0]
+                and coord.leading
+            ):
+                # a checkpoint recovery image lands before the kill
+                # (first LED cycle in the window, so a leader flap at
+                # the nominal cycle cannot skip it); the digest
+                # mismatch armed at the kill cycle below then forces
+                # the takeover's recovery to fall back to the
+                # full-history replay (same world, one counted
+                # fallback)
+                sched.bind_journal.append_checkpoint(
+                    epoch=sched._fence_epoch
+                )
+                ckpt_written[0] = True
             if cycle == crash_cycle:
                 chaos.arm("commit.crash", error=RuntimeError, times=1)
             if ha and cycle == restart_cycle:
@@ -1059,6 +1124,10 @@ def run_chaos_soak(
                 # after the commit stage — the lost-ack window
                 chaos.arm("commit.crash", error=RuntimeError, times=1)
                 chaos.arm("scheduler.crash_restart", times=1)
+                if ckpt_written[0]:
+                    # armed AT the kill so the next checkpoint-bearing
+                    # recovery — the post-crash takeover — consumes it
+                    chaos.arm("checkpoint.digest_mismatch", times=1)
             surge = 0
             if cycle == deadline_cycle:
                 # solve-latency spike + a surge so the cycle spans
@@ -1125,6 +1194,15 @@ def run_chaos_soak(
                 # never re-placed — everything else re-enters the backlog
                 rec = coord.last_recovery
                 bindings = rec.bindings if rec is not None else {}
+                if rec is not None:
+                    # state-integrity PR: the post-crash recovery's
+                    # checkpoint verdict (used, or digest-fallback)
+                    stats["recovery_used_checkpoint"] += int(
+                        rec.used_checkpoint
+                    )
+                    stats["checkpoint_fallbacks"] += int(
+                        rec.checkpoint_fallback
+                    )
                 for pod in lost_pods:
                     node = bindings.get(pod.meta.uid)
                     if node is not None and pod.meta.uid not in placed:
@@ -1301,6 +1379,19 @@ def run_chaos_soak(
             )
         client.close()
         server.stop(grace=None)
+    # informer re-list recovery is WALL-CLOCK backoff on background
+    # threads: once the fault schedule stops, give the streams their
+    # bounded window BEFORE hub.stop() freezes the health rows — the
+    # invariant is that every subsystem RECOVERS, not that it happened
+    # to recover inside however long this host took to run the drain
+    import time as _walltime
+
+    deadline = _walltime.monotonic() + 10.0
+    while (
+        not sched.extender.health.ok()
+        and _walltime.monotonic() < deadline
+    ):
+        _walltime.sleep(0.05)
     hub.stop()
     if coord is not None:
         from koordinator_tpu.core.journal import BindJournal as _BJ
@@ -1314,6 +1405,28 @@ def run_chaos_soak(
             f"{len(lost_acked)} journal-acknowledged bindings lost "
             f"across takeovers"
         )
+        # state-integrity PR: the corruption arms really fired and were
+        # CONTAINED — the corrupt record quarantined (zero acked binds
+        # lost is asserted just above, THROUGH the corruption), the
+        # write hole counted, and the store's live stream still replays
+        integ = journal_store.integrity_total
+        stats["journal_corrupt_quarantined"] = integ.corrupt
+        stats["journal_seq_gaps"] = integ.seq_gaps
+        # the post-corruption journal, quarantined records included, so
+        # the fsck acceptance test can round-trip EXACTLY what this
+        # soak's stores ended up holding
+        stats["journal_dump"] = [
+            dict(r) for r in journal_store._records
+        ] + [dict(r) for r in journal_store.quarantined]
+        stats["journal_live"] = sorted(ha_rep.live)
+        if cycles > corrupt_record_cycle:
+            assert integ.corrupt >= 1, (
+                "journal.corrupt_record armed but nothing was quarantined"
+            )
+        if cycles > seq_gap_cycle:
+            assert integ.seq_gaps >= 1, (
+                "journal.seq_gap armed but no write hole was detected"
+            )
         if coord.leading:
             assert sched._fence_epoch == fence.current() > 0
         stats["leader_epoch_final"] = fence.current()
@@ -1342,8 +1455,21 @@ def run_chaos_soak(
         # a failing assert must not leave the ledger installed in the
         # process-global hook registry for the rest of the test session
         ledger.uninstall()
+    _fold_scrub()
+    if cycles > bit_flip_cycle:
+        # the injected resident bit flip was DETECTED (divergence
+        # attributed to the nodes table) — and HEALED: the end-state
+        # bit-exactness assert above ran on the same resident tables
+        assert stats["scrub_divergence"].get("nodes", 0) >= 1, (
+            "resident.bit_flip armed but the scrubber saw no divergence"
+        )
     stats["fallback_level_final"] = sched._fallback_level
     stats["health_ok"] = sched.extender.health.ok()
+    stats["health_detail"] = {
+        k: v
+        for k, v in sched.extender.health.snapshot().items()
+        if not v["ok"]
+    }
     stats["metrics"] = {
         "retry_attempts_channel_sync": reg.get(
             "retry_attempts_total"
@@ -1517,6 +1643,10 @@ def _run_sharded_soak(
             chaos=chaos,
             journal=journal,
             fence=fence,
+            # state-integrity PR: per-shard anti-entropy audit (the
+            # resident.bit_flip arm below rides whichever shard's
+            # cycle-tail scrub evaluates it first — deterministically)
+            scrub_rows=8,
         )
         s.extender.monitor.stop_background()
         chaos.bind_counter(s.extender.registry.get("fault_injected_total"))
@@ -1583,8 +1713,54 @@ def _run_sharded_soak(
         "shard_cycles_without_owner": 0,
         "timelines_validated": 0,
         "flight_recovered_records": 0,
+        "scrub_divergence": {},
+        "recovery_used_checkpoint": 0,
+        "checkpoint_fallbacks": 0,
         "faults": {},
     }
+    #: (inc name, shard) -> last folded RecoveryReport. Folded PER CYCLE
+    #: because a topology transition deletes a retired shard's
+    #: coordinator (and with it the report a one-shot end sweep would
+    #: need); strong refs keep object identity stable
+    seen_recovery: dict = {}
+
+    def _fold_recoveries() -> None:
+        for inc in incs:
+            if inc.dead:
+                continue
+            for s in inc.owned():
+                rec = inc.last_recovery(s)
+                if rec is None or seen_recovery.get((inc.name, s)) is rec:
+                    continue
+                seen_recovery[(inc.name, s)] = rec
+                stats["recovery_used_checkpoint"] += int(
+                    rec.used_checkpoint
+                )
+                stats["checkpoint_fallbacks"] += int(
+                    rec.checkpoint_fallback
+                )
+
+    #: (inc name, shard) -> divergence totals already folded (reports
+    #: are cumulative per scheduler and die with their runtime — a kill
+    #: OR a topology retirement — so folding is per-cycle, delta-wise)
+    seen_scrub: dict = {}
+
+    def _fold_scrub(inc) -> None:
+        """Fold an incarnation's per-shard anti-entropy ledgers into the
+        run stats, delta-wise against what was already folded."""
+        for s in inc.owned():
+            rt = inc.runtime(s)
+            if rt is None:
+                continue
+            cur = rt.sched._scrub_report["divergence"]
+            prev = seen_scrub.get((inc.name, s), {})
+            for table, n in cur.items():
+                delta = int(n) - int(prev.get(table, 0))
+                if delta > 0:
+                    stats["scrub_divergence"][table] = (
+                        stats["scrub_divergence"].get(table, 0) + delta
+                    )
+            seen_scrub[(inc.name, s)] = dict(cur)
     #: flight-recorder readability check state: the shards the killed
     #: incarnation owned, pending a new owner whose adopted recorder
     #: must serve the dead writer's records
@@ -1600,6 +1776,16 @@ def _run_sharded_soak(
     pod_seq = 0
     crash_cycle = max(2, cycles // 3)
     restart_cycle = max(6, (3 * cycles) // 5)
+    # state-integrity PR (corruption fault domain, fixed cycles — no
+    # rng draws): one resident bit flip for the per-shard scrubbers,
+    # one mid-stream corrupt record + one seq write hole on whichever
+    # shard journal appends next (deterministic pump order), and a
+    # checkpoint recovery image per owned shard whose digest the
+    # post-kill takeover must reject (full-replay fallback)
+    bit_flip_cycle = max(2, (3 * cycles) // 8)
+    corrupt_record_cycle = max(3, (4 * cycles) // 9)
+    seq_gap_cycle = max(4, (5 * cycles) // 11)
+    checkpoint_cycle = restart_cycle - 1
     # elastic-topology schedule (fixed cycles — no rng draws, so every
     # historical seeded fault trace stays bit-identical): a crash-armed
     # split attempt that must ROLL BACK, the real split two cycles
@@ -1817,6 +2003,27 @@ def _run_sharded_soak(
                 chaos.arm("leader.lost", times=1)      # per-shard flap
             if cycle == crash_cycle:
                 chaos.arm("commit.crash", error=RuntimeError, times=1)
+            if cycle == bit_flip_cycle:
+                chaos.arm("resident.bit_flip", times=1)
+            if cycle == corrupt_record_cycle:
+                chaos.arm("journal.corrupt_record", times=1)
+            if cycle == seq_gap_cycle:
+                chaos.arm("journal.seq_gap", times=1)
+            if cycle == checkpoint_cycle:
+                # one checkpoint recovery image per OWNED shard (via
+                # the owner's own journal instance — seq-consistent);
+                # the digest mismatch armed with the kill below forces
+                # the first checkpoint-bearing takeover recovery to
+                # fall back to the full-history replay
+                for inc in incs:
+                    if inc.dead:
+                        continue
+                    for s in inc.owned():
+                        rt = inc.runtime(s)
+                        if rt is not None and rt.sched.bind_journal is not None:
+                            rt.sched.bind_journal.append_checkpoint(
+                                epoch=rt.sched._fence_epoch
+                            )
             if cycle == restart_cycle:
                 # the incarnation owning the most shards dies THIS cycle,
                 # right after its pumps journaled their trailing commits
@@ -1824,6 +2031,9 @@ def _run_sharded_soak(
                 doomed = max(
                     alive, key=lambda i: (len(i.owned()), i.name)
                 )
+                # armed WITH the kill: the first checkpoint-bearing
+                # takeover recovery rejects its image and falls back
+                chaos.arm("checkpoint.digest_mismatch", times=1)
 
         # ---- elastic topology schedule (elastic-topology PR): a split
         # and a merge under LIVE traffic, each preceded by a crash-armed
@@ -2049,6 +2259,7 @@ def _run_sharded_soak(
         # generation joins and the rendezvous ranking rebalances ----
         if doomed is not None:
             stats["crash_restarts"] += 1
+            _fold_scrub(doomed)   # its audit ledgers die with it
             # flight-recorder readability check state: the takeover
             # owners of these shards must serve THIS incarnation's
             # per-cycle tail after recovery (checked promptly below —
@@ -2108,6 +2319,10 @@ def _run_sharded_soak(
         assert hub.wait_synced()
 
         # ---- per-cycle invariants over every live runtime ----
+        _fold_recoveries()
+        for inc in incs:
+            if not inc.dead:
+                _fold_scrub(inc)
         for inc in incs:
             if inc.dead:
                 continue
@@ -2237,6 +2452,35 @@ def _run_sharded_soak(
                 f"shard {s}: {uid} journaled on {entry.get('node')} "
                 f"but placed on {placed[uid]}"
             )
+    # state-integrity PR: the corruption arms fired and were CONTAINED
+    # per shard — the corrupt record quarantined (the zero-lost-ack
+    # sweep above ran THROUGH it), the write hole counted, the doomed
+    # takeover's recovery rejected its checkpoint image and fell back
+    # to full replay, and a per-shard scrubber healed the bit flip
+    stats["journal_corrupt_quarantined"] = sum(
+        st.integrity_total.corrupt
+        for st in fabric.journal_stores.values()
+    )
+    stats["journal_seq_gaps"] = sum(
+        st.integrity_total.seq_gaps
+        for st in fabric.journal_stores.values()
+    )
+    if cycles > corrupt_record_cycle:
+        assert stats["journal_corrupt_quarantined"] >= 1, (
+            "journal.corrupt_record armed but nothing was quarantined"
+        )
+    if cycles > seq_gap_cycle:
+        assert stats["journal_seq_gaps"] >= 1, (
+            "journal.seq_gap armed but no write hole was detected"
+        )
+    _fold_recoveries()
+    for inc in incs:
+        if not inc.dead:
+            _fold_scrub(inc)
+    if cycles > restart_cycle:
+        assert stats["checkpoint_fallbacks"] >= 1, (
+            "checkpoint.digest_mismatch armed but no recovery fell back"
+        )
     # (fleet-tracing PR) GAP-FREE lifecycle timelines: every placed pod's
     # events are time-ordered on the sim clock, start at submit, end
     # terminal, and every shard/incarnation transition is bracketed by

@@ -74,7 +74,45 @@ def test_chaos_soak_ha_failover_arm():
     assert "scheduler.crash_restart" in points
     assert "leader.lost" in points
     assert "commit.crash" in points
+    # state-integrity PR: the corruption fault domain fired and was
+    # CONTAINED — a mid-stream corrupt record was quarantined with ZERO
+    # acked binds lost (the zero-lost-ack sweep inside the soak runs
+    # THROUGH the corruption), the injected write hole was counted, the
+    # post-crash recovery rejected its checkpoint image (digest
+    # mismatch) and fell back to full replay bit-exactly, and the
+    # resident bit flip was detected + healed by the scrubber (end-state
+    # bit-exactness is asserted inside the soak after the heal)
+    assert {
+        "journal.corrupt_record", "journal.seq_gap",
+        "checkpoint.digest_mismatch", "resident.bit_flip",
+    } <= points
+    assert stats["journal_corrupt_quarantined"] == 1
+    assert stats["journal_seq_gaps"] == 1
+    assert stats["checkpoint_fallbacks"] >= 1
+    assert stats["scrub_divergence"].get("nodes", 0) >= 1
     assert stats["crash_restarts"] == 1
+    # journal_fsck round-trips the soak's POST-CORRUPTION journal: the
+    # dump (quarantined records included) repairs to a clean file whose
+    # replay reconstructs exactly the soak's acknowledged live set
+    import json as _json
+    import os
+    import tempfile
+
+    from koordinator_tpu.core.journal import BindJournal, FileJournalStore
+    from tools.journal_fsck import check_file
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "soak.jsonl")
+        with open(path, "w", encoding="utf-8") as f:
+            for rec in stats["journal_dump"]:
+                f.write(_json.dumps(rec, separators=(",", ":")) + "\n")
+        report = check_file(path, repair=True)
+        assert report["repaired"] and not report["unrepairable"]
+        assert report["corrupt"] == stats["journal_corrupt_quarantined"]
+        clean = check_file(path)
+        assert clean["ok"], clean
+        rep = BindJournal(FileJournalStore(path)).replay()
+        assert sorted(rep.live) == stats["journal_live"]
     assert stats["takeovers"] >= 2          # initial grant + post-crash
     assert stats["cycles_without_leader"] > 0   # the lease gap is real
     assert stats["recovered_bindings"] > 0  # journal acks survived
@@ -94,6 +132,12 @@ def test_chaos_soak_ha_same_seed_same_trace():
     assert a["fault_trace"] == b["fault_trace"]
     assert a["takeovers"] == b["takeovers"]
     assert a["placed"] == b["placed"]
+    # the corruption arms are part of the deterministic contract too
+    for key in (
+        "journal_corrupt_quarantined", "journal_seq_gaps",
+        "checkpoint_fallbacks", "scrub_divergence",
+    ):
+        assert a[key] == b[key], key
 
 
 @pytest.mark.chaos
@@ -145,6 +189,17 @@ def test_chaos_soak_multi_shard_arm():
     assert stats["xs_gangs"]["committed"] >= 1
     assert stats["xs_gangs"]["aborted"] >= 1
     assert stats["xs_gangs"]["abort_resubmitted"] >= 3
+    # state-integrity arms, per shard (same contract as the HA arm:
+    # quarantined-not-truncated, write hole counted, checkpoint-digest
+    # fallback on the post-kill takeover, bit flip healed in rotation)
+    assert {
+        "journal.corrupt_record", "journal.seq_gap",
+        "checkpoint.digest_mismatch", "resident.bit_flip",
+    } <= points
+    assert stats["journal_corrupt_quarantined"] >= 1
+    assert stats["journal_seq_gaps"] >= 1
+    assert stats["checkpoint_fallbacks"] >= 1
+    assert stats["scrub_divergence"].get("nodes", 0) >= 1
 
 
 @pytest.mark.chaos

@@ -84,7 +84,7 @@ def test_cli_exits_zero_on_tree(capsys):
     rc = cli_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "0 finding(s)" in out and "10 passes" in out
+    assert "0 finding(s)" in out and "11 passes" in out
 
 
 # ---------------------------------------------------------------------------
@@ -239,6 +239,28 @@ FIXTURES = {
             """,
         },
         "SP001",
+    ),
+    "store-integrity": (
+        {
+            # a new durable store bypassing the checksummed codec: its
+            # append writes raw records and its load never screens —
+            # exactly the silent-truncation regression the pass blocks
+            "koordinator_tpu/core/kvstore.py": """
+            class KvJournalStore:
+                def __init__(self):
+                    self._records = []
+
+                def append(self, record):
+                    self._records.append(dict(record))
+
+                def load(self):
+                    return [dict(r) for r in self._records]
+
+                def rewrite(self, records):
+                    self._records = [dict(r) for r in records]
+            """,
+        },
+        "SI001",
     ),
 }
 

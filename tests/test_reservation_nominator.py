@@ -322,3 +322,77 @@ def test_reservation_restricted_options_narrow_binding_dims():
     # cpu-only restriction: memory may spill to the node — matches
     got = rm.match(owner("cpu-only"))
     assert got is not None and got.meta.name == "cpu-only"
+
+
+def test_vectorized_match_equivalent_to_scalar_randomized():
+    """State-integrity PR satellite: the numpy-over-the-candidate-axis
+    nomination must be DECISION-IDENTICAL to the reference per-candidate
+    loop (kept as ``_match_scalar``) across randomized populations —
+    mixed policies, partial allocations, order labels, owner selectors,
+    per-node spill headroom and affinity annotations."""
+    import json
+    import random
+
+    from koordinator_tpu.api.types import (
+        RESERVATION_ALLOCATE_POLICY_ALIGNED,
+        RESERVATION_ALLOCATE_POLICY_RESTRICTED,
+    )
+
+    rng = random.Random(20260804)
+    for trial in range(8):
+        rm = make_rm(n_nodes=6)
+        snap = rm.scheduler.snapshot
+        for c in range(rng.randint(4, 40)):
+            labels = {}
+            if rng.random() < 0.3:
+                labels[ext.LABEL_RESERVATION_ORDER] = str(
+                    rng.choice([0, 1, 5, 5, 100])
+                )
+            r = available(
+                rm,
+                f"r{trial}-{c:03d}",
+                {
+                    ext.RES_CPU: rng.choice([1000, 2000, 4000, 64000]),
+                    ext.RES_MEMORY: rng.choice([2048, 4096, 262144]),
+                },
+                node=f"n{rng.randrange(6)}",
+                labels=labels,
+                allocated=(
+                    {ext.RES_CPU: rng.choice([500, 1000, 2000])}
+                    if rng.random() < 0.4
+                    else None
+                ),
+            )
+            if rng.random() < 0.3:
+                r.allocate_policy = RESERVATION_ALLOCATE_POLICY_RESTRICTED
+            elif rng.random() < 0.3:
+                r.allocate_policy = RESERVATION_ALLOCATE_POLICY_ALIGNED
+            if rng.random() < 0.2:
+                r.allocate_once = False
+            if rng.random() < 0.25:
+                # second owner selector shape (sig de-dup must not merge)
+                r.owners.append(
+                    ReservationOwner(label_selector={"team": "x"})
+                )
+        # a couple of nodes near-full so spill-fit filtering matters
+        for i in (1, 3):
+            snap.nodes.requested[i] = snap.nodes.allocatable[i] - 10.0
+        snap.touch_all()
+        for p in range(12):
+            pod = owner_pod(
+                cpu=rng.choice([500, 2000, 8000, 70000]),
+                mem=rng.choice([1024, 4096, 300000]),
+            )
+            if rng.random() < 0.2:
+                pod.meta.labels["team"] = "x"
+            if rng.random() < 0.15:
+                pod.meta.annotations[
+                    ext.ANNOTATION_RESERVATION_AFFINITY
+                ] = json.dumps({"name": f"r{trial}-000"})
+            want = rm._match_scalar(pod)
+            got = rm.match(pod)
+            assert got is want, (
+                f"trial {trial} pod {p}: vector nominated "
+                f"{got.meta.name if got else None}, scalar "
+                f"{want.meta.name if want else None}"
+            )

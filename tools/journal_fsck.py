@@ -1,0 +1,248 @@
+"""journal_fsck — verify/repair/report for checksummed journal files.
+
+The offline arm of the state-integrity PR: the same codec + screening
+the stores run at load time (:mod:`koordinator_tpu.core.integrity`),
+usable against a journal file (or a directory of them) from the shell —
+before adopting a recovered volume, after a corruption incident, or in
+CI over soak artifacts.
+
+Usage::
+
+    python -m tools.journal_fsck [--repair] [--json [-|PATH]] PATH...
+
+``PATH`` is a journal file or a directory (every regular file except
+``*.tmp``/``*.quarantine`` sidecars is checked). Modes:
+
+* **verify** (default) — screen every record; report corruption, write
+  holes, duplicate seqs, torn tails and checkpoint-image digests. Exit
+  1 when anything is corrupt or unrepairable; the file is not touched.
+* **--repair** — additionally QUARANTINE corrupt lines into the
+  ``<file>.quarantine`` sidecar, trim a torn tail, and atomically
+  rewrite the file to the surviving records. Exit 0 when everything
+  found was repairable (quarantined), 1 when not.
+
+Unrepairable means recovery semantics were damaged beyond what
+quarantine restores: a checkpoint recovery image with a failed digest
+and NO earlier history to fall back to — the live set cannot be
+reconstructed from the remaining records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # direct `python tools/journal_fsck.py` use
+    sys.path.insert(0, _REPO_ROOT)
+
+from koordinator_tpu.core import integrity  # noqa: E402
+from koordinator_tpu.core.journal import BindJournal  # noqa: E402
+
+
+def _journal_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                full = os.path.join(p, name)
+                if not os.path.isfile(full):
+                    continue
+                if name.endswith(".tmp") or name.endswith(".quarantine"):
+                    continue
+                out.append(full)
+        else:
+            out.append(p)
+    return out
+
+
+def check_file(path: str, repair: bool = False) -> Dict[str, object]:
+    """Screen one journal file; optionally repair in place. Returns the
+    per-file report dict (shape shared by text and --json output)."""
+    entries = []
+    raw_lines: List[str] = []
+    try:
+        with open(path, "r", encoding="utf-8", newline="") as f:
+            for line in f:
+                stripped = line.strip()
+                if not stripped:
+                    continue
+                raw_lines.append(stripped)
+                try:
+                    entries.append((json.loads(stripped), stripped))
+                except json.JSONDecodeError:
+                    entries.append((None, stripped))
+    except OSError as exc:
+        return {"path": path, "error": repr(exc), "ok": False}
+    kept, quarantine, rep = integrity.screen_records(
+        entries, store=os.path.basename(path)
+    )
+    # checkpoint recovery images: a bad digest is repairable only while
+    # an older verified image (or raw pre-history) still covers it
+    ckpt_total = ckpt_bad = 0
+    unrepairable = False
+    first_seq = min(
+        (r.get("seq") for r in kept if isinstance(r.get("seq"), int)),
+        default=None,
+    )
+    for i, rec in enumerate(kept):
+        if rec.get("op") != "checkpoint":
+            continue
+        ckpt_total += 1
+        if not BindJournal._checkpoint_image_ok(rec):
+            ckpt_bad += 1
+            if i == 0 and rec.get("seq") == first_seq:
+                # the file STARTS at this image (compacted prefix):
+                # nothing earlier can rebuild the live set it carried
+                unrepairable = True
+    # a QUARANTINED head-of-stream checkpoint is the same loss through
+    # the other door: the line CRC failed, so the record never reached
+    # the image check, and a compacted store has no history behind it
+    for pos, raw in quarantine:
+        if pos != 0 or raw is None:
+            continue
+        try:
+            head = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(head, dict) and head.get("op") == "checkpoint":
+            unrepairable = True
+    report: Dict[str, object] = {
+        "path": path,
+        "records": rep.total,
+        "kept": rep.kept,
+        "legacy": rep.legacy,
+        "corrupt": rep.corrupt,
+        "dup_seq": rep.dup_seq,
+        "seq_gaps": rep.seq_gaps,
+        "torn_tail": rep.torn_tail,
+        "checkpoints": ckpt_total,
+        "checkpoint_digest_failures": ckpt_bad,
+        "quarantined": list(rep.quarantined),
+        "unrepairable": unrepairable,
+        "ok": rep.ok and ckpt_bad == 0,
+        "repaired": False,
+    }
+    if repair and (not rep.ok or rep.torn_tail or rep.dup_seq):
+        bad_raw = [raw for _pos, raw in quarantine if raw is not None]
+        if bad_raw:
+            with open(path + ".quarantine", "a", encoding="utf-8") as q:
+                for raw in bad_raw:
+                    q.write(raw + "\n")
+        out_records = list(kept)
+        # interior seqs now missing (quarantined records and write
+        # holes) are EXPLAINED by the repair: a sealed seq_tombstone
+        # record closes them, so the repaired file re-verifies clean
+        # and the runtime's gap screening stays exact
+        present = sorted(
+            {
+                r["seq"]
+                for r in kept
+                if isinstance(r.get("seq"), int)
+            }
+            | {
+                s
+                for r in kept
+                if r.get("op") == "seq_tombstone"
+                for s in r.get("seqs", ())
+                if isinstance(s, int)
+            }
+        )
+        holes = [
+            s
+            for a, b in zip(present, present[1:])
+            for s in range(a + 1, b)
+        ]
+        if holes:
+            out_records.append(
+                {
+                    "seq": present[-1] + 1,
+                    "op": "seq_tombstone",
+                    "seqs": holes,
+                }
+            )
+        tmp = path + ".fsck.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rec in out_records:
+                f.write(
+                    json.dumps(
+                        integrity.seal(rec), separators=(",", ":")
+                    )
+                    + "\n"
+                )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        report["repaired"] = True
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="journal_fsck", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("paths", nargs="+", help="journal file(s) or dir(s)")
+    ap.add_argument(
+        "--repair",
+        action="store_true",
+        help="quarantine corrupt lines and rewrite the file clean",
+    )
+    ap.add_argument(
+        "--json",
+        nargs="?",
+        const="-",
+        default=None,
+        metavar="PATH",
+        help="emit the report as JSON to PATH (default stdout)",
+    )
+    args = ap.parse_args(argv)
+    reports = [
+        check_file(p, repair=args.repair)
+        for p in _journal_files(args.paths)
+    ]
+    # exit contract: verify fails on ANY corruption; repair fails only
+    # on what quarantine cannot restore
+    if args.repair:
+        bad = any(
+            r.get("unrepairable") or r.get("error") for r in reports
+        )
+    else:
+        bad = any(not r.get("ok", False) for r in reports)
+    doc = {"files": reports, "ok": not bad}
+    if args.json is not None:
+        text = json.dumps(doc, indent=1, sort_keys=True)
+        if args.json == "-":
+            print(text)
+        else:
+            with open(args.json, "w", encoding="utf-8") as f:
+                f.write(text)
+    else:
+        for r in reports:
+            if r.get("error"):
+                print(f"{r['path']}: ERROR {r['error']}")
+                continue
+            state = (
+                "unrepairable"
+                if r["unrepairable"]
+                else (
+                    "repaired"
+                    if r["repaired"]
+                    else ("ok" if r["ok"] else "corrupt")
+                )
+            )
+            print(
+                f"{r['path']}: {state} — records={r['records']} "
+                f"kept={r['kept']} corrupt={r['corrupt']} "
+                f"seq_gaps={r['seq_gaps']} dup_seq={r['dup_seq']} "
+                f"torn_tail={r['torn_tail']} "
+                f"ckpt_digest_failures={r['checkpoint_digest_failures']}"
+            )
+        print("OK" if not bad else "CORRUPTION FOUND")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

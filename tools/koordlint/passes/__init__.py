@@ -11,4 +11,5 @@ from . import (  # noqa: F401 — registration side effects
     reject_reasons,
     retrace_hazard,
     shed_paths,
+    store_integrity,
 )

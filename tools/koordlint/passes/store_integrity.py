@@ -1,0 +1,109 @@
+"""Pass ``store-integrity`` (SI): every durable record stream goes
+through the checksummed codec — the state-integrity PR's standing rule.
+
+A *journal store* is any package class exposing the store protocol
+(``append`` + ``load`` + ``rewrite`` methods): ``MemoryJournalStore``,
+``FileJournalStore``, and whatever a future PR adds (a kv-backed store,
+an object-store journal). The rule: the store itself seals on write and
+screens on load, so EVERY ``store.append``/``store.rewrite`` call site —
+BindJournal, ClaimTable, the flight recorder, future writers — rides the
+codec without per-site discipline.
+
+* **SI001** — a store class whose ``append`` or ``rewrite`` never calls
+  ``integrity.seal``/``seal_records`` (records reach disk unchecksummed).
+* **SI002** — a store class whose ``load`` never calls
+  ``integrity.screen_records`` (corruption silently truncates again).
+* **SI003** — an ``EXEMPT`` entry naming a class that no longer exists
+  (stale exemption).
+
+Exemptions name store-protocol classes that are NOT durable record
+streams (with the written reason the standing rule demands).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from .. import Finding, Pass, RepoIndex, register
+
+#: class name -> written reason it may bypass the codec
+EXEMPT: Dict[str, str] = {}
+
+_STORE_METHODS = {"append", "load", "rewrite"}
+
+
+def _calls_any(fn: ast.AST, names: set) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            attr = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                else (f.id if isinstance(f, ast.Name) else "")
+            )
+            if attr in names:
+                return True
+    return False
+
+
+@register
+class StoreIntegrityPass(Pass):
+    name = "store-integrity"
+    code = "SI"
+    description = (
+        "journal-store classes seal every append/rewrite with the "
+        "shared CRC codec and screen every load (state-integrity PR "
+        "standing rule)"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        seen_classes: set = set()
+        for sf in index.package_files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                methods = {
+                    n.name: n
+                    for n in node.body
+                    if isinstance(
+                        n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                }
+                if not _STORE_METHODS <= set(methods):
+                    continue
+                seen_classes.add(node.name)
+                if node.name in EXEMPT:
+                    continue
+                for writer in ("append", "rewrite"):
+                    if not _calls_any(
+                        methods[writer], {"seal", "seal_records"}
+                    ):
+                        out.append(self.finding(
+                            1, sf.rel, methods[writer].lineno,
+                            f"store class {node.name}.{writer} does not "
+                            "seal its records with the shared CRC codec "
+                            "(core.integrity.seal/seal_records) — every "
+                            "durable record stream must be checksummed, "
+                            "or carry a written EXEMPT entry",
+                        ))
+                if not _calls_any(methods["load"], {"screen_records"}):
+                    out.append(self.finding(
+                        2, sf.rel, methods["load"].lineno,
+                        f"store class {node.name}.load does not screen "
+                        "records (core.integrity.screen_records) — "
+                        "corruption would silently truncate the stream "
+                        "again (the bug the state-integrity PR removed)",
+                    ))
+        for name in sorted(set(EXEMPT) - seen_classes):
+            out.append(self.finding(
+                3, "tools/koordlint/passes/store_integrity.py", 0,
+                f"EXEMPT names store class {name!r} but no package "
+                "class with the store protocol has that name — delete "
+                "the stale exemption",
+            ))
+        return out
