@@ -1896,6 +1896,56 @@ def bench_latency_stream_sharded():
     return out
 
 
+#: the sim-domain fields of a ``_fleet_day_run`` record — everything a
+#: same-seed pair must agree on bit-exactly regardless of whether the
+#: decision ledger is recording (wall_s / pods_per_sec are the only
+#: legitimately ledger-sensitive fields)
+_SIM_DOMAIN_KEYS = (
+    "shards_start", "shards_final", "incarnations", "day_cycles",
+    "arrived", "bound", "pod_p50_cycles", "pod_p99_cycles",
+    "handoffs", "quota_updates", "nodes_added", "nodes_removed",
+    "burst_cycles", "slo", "bands", "shed", "deferred_total",
+    "brownout", "topology", "generation_final",
+)
+
+
+def _ledger_ab(on: dict, off: dict) -> dict:
+    """Decision-ledger same-seed A/B entry (decision-observatory PR).
+
+    ``on`` ran with the per-shard DecisionLedgers recording every
+    controller decision (the default); ``off`` ran the SAME seed with
+    ``decisions=False``. Recording is observation, never actuation, so
+    every sim-domain outcome must be bit-identical — asserted here, the
+    bench-side twin of the soak-side shadow-non-perturbation checks.
+    What remains is the wall-clock cost of recording, the number the
+    r11 artifact gates through ``tools/bench_regress.py``.
+    """
+    drift = [k for k in _SIM_DOMAIN_KEYS if on.get(k) != off.get(k)]
+    assert not drift, (
+        "decision ledger perturbed sim-domain outcomes (recording must "
+        f"be pure observation); drifted keys: "
+        f"{ {k: (on.get(k), off.get(k)) for k in drift} }"
+    )
+    overhead = (1.0 - on["pods_per_sec"] / off["pods_per_sec"]) * 100.0
+    return {
+        "ledger_on_pods_per_sec": on["pods_per_sec"],
+        "ledger_off_pods_per_sec": off["pods_per_sec"],
+        "overhead_pct": round(overhead, 2),
+        "identical_sim_outcomes": True,
+        "note": (
+            "same-seed pair, ledger on vs off: all sim-domain outcomes "
+            "(placement counts, p50/p99 cycles, SLO burn rows, band "
+            "stats, shed/deferred, brownout transitions) bit-identical "
+            "— the ledger observes, never acts. overhead_pct is a "
+            "SINGLE-PAIR wall-clock delta and carries the full "
+            "single-container host noise (BENCH history: ±30-50% on "
+            "contended windows); the BENCH_DECISIONS artifact's "
+            "bench_regress rows pool multi-pass noise bands for the "
+            "gated comparison"
+        ),
+    }
+
+
 def _fleet_day_run(
     n_shards,
     n_incs,
@@ -1907,6 +1957,7 @@ def _fleet_day_run(
     qos_mix=False,
     storm=None,
     overload=False,
+    decisions=True,
 ):
     """Drive one compressed production 'day' through an in-process
     sharded fleet: diurnal sinusoid arrivals, two burst storms, tenant
@@ -1922,7 +1973,14 @@ def _fleet_day_run(
     AdmissionController + BrownoutController into every incarnation —
     shed pods then count as terminal (placed + shed == arrived, shed
     only ever BATCH/FREE, timelines ending at ``shed``), which is the
-    brownout-on arm of the storm A/B."""
+    brownout-on arm of the storm A/B.
+
+    Decision-observatory PR arm: ``decisions=False`` disables the
+    per-shard decision ledgers entirely (every controller site back to
+    one attribute-is-None check) — the OFF leg of the ledger-overhead
+    same-seed A/B. Recording is observation, never actuation, so the
+    sim-cycle outcomes of a same-seed on/off pair must be
+    bit-identical; only wall-clock may differ."""
     import math
     import random as _random
     import time as _time
@@ -2095,6 +2153,7 @@ def _fleet_day_run(
             lifecycle=lifecycle,
             slo=slo,
             overload=admission,
+            decisions=decisions,
         )
         fabric.membership.heartbeat(inc.name)
         incs.append(inc)
@@ -2482,6 +2541,18 @@ def bench_fleet_day():
         assert rec["pod_p99_cycles"] <= 1.5 * DAY, (
             f"S={rec['shards_start']}: p99 {rec['pod_p99_cycles']} cycles"
         )
+    # DECISION-LEDGER A/B (decision-observatory PR): rerun the S=4 day
+    # from the same seed with the per-shard decision ledgers disabled
+    # entirely. Sim-domain outcomes must be bit-identical (the ledger
+    # observes, never acts); the wall-clock delta is the recording
+    # overhead the BENCH_DECISIONS artifact gates via bench_regress.
+    ab_on = next(
+        r for r in runs if r["mode"] == "static" and r["shards_start"] == 4
+    )
+    ab_off = _fleet_day_run(4, 2, day_cycles=DAY, seed=0, decisions=False)
+    ab_off["mode"] = "ledger_off"
+    out["decisions_ab"] = _ledger_ab(ab_on, ab_off)
+    runs.append(ab_off)
     # ELASTIC arm: base S=4, the burn-driven controller splits under
     # the burst storm and spawns incarnations to match
     elastic = _fleet_day_run(
@@ -2557,7 +2628,15 @@ def bench_overload_storm():
     base["mode"] = "brownout_off"
     prot = _fleet_day_run(overload=True, **kw)
     prot["mode"] = "brownout_on"
-    out["runs"] = [base, prot]
+    # DECISION-LEDGER A/B (decision-observatory PR): the brownout-on
+    # storm is the decision-densest leg in the suite (ladder churn,
+    # per-cycle admission verdicts, breaker probes) — rerun it from the
+    # same seed with the ledgers disabled. Bit-identical sim outcomes
+    # asserted; the wall-clock delta is the recording overhead.
+    noledger = _fleet_day_run(overload=True, decisions=False, **kw)
+    noledger["mode"] = "brownout_on_ledger_off"
+    out["decisions_ab"] = _ledger_ab(prot, noledger)
+    out["runs"] = [base, prot, noledger]
     prod_off = base["bands"]["PROD"]["p99_cycles"]
     prod_on = prot["bands"]["PROD"]["p99_cycles"]
     # the acceptance bar: PROD's storm tail is strictly protected, paid

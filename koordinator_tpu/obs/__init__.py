@@ -18,6 +18,12 @@ descheduler and the simulators:
   Chrome trace, and the device-memory census + leak sentinel.
 """
 
+from .decisions import (
+    DecisionLedger,
+    action_label,
+    controller_gaps,
+    decision_trace,
+)
 from .devprof import (
     CompileLedger,
     DeviceMemoryCensus,
@@ -38,15 +44,28 @@ from .rejections import (
     RejectReason,
     RejectStage,
 )
+from .shadow import (
+    NO_PROPOSAL,
+    AlwaysDivergeShadow,
+    MirrorShadow,
+    ShadowPolicy,
+    ShadowRegistry,
+)
 from .slo import SloTarget, SloTracker
 from .trace import NULL_TRACER, Span, StageTimer, Tracer
 
 __all__ = [
+    "NO_PROPOSAL",
     "NULL_TRACER",
+    "AlwaysDivergeShadow",
     "CompileLedger",
+    "DecisionLedger",
     "DevProf",
     "DeviceMemoryCensus",
     "FlightRecorder",
+    "MirrorShadow",
+    "ShadowPolicy",
+    "ShadowRegistry",
     "LeakSentinel",
     "HealthRegistry",
     "LifecycleEvent",
@@ -60,6 +79,9 @@ __all__ = [
     "Span",
     "StageTimer",
     "Tracer",
+    "action_label",
+    "controller_gaps",
+    "decision_trace",
     "default_error_registry",
     "ensure_exceptions_counter",
     "report_exception",

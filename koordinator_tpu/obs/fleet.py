@@ -230,6 +230,9 @@ class FleetServices:
       /trace                 — merged Chrome trace, one lane per shard
       /debug/flightrecorder  — every owned shard's recorder (recovered
                                records of dead incarnations included)
+      /debug/decisions       — every owned shard's decision ledger
+                               (controller inputs → action → state,
+                               adopted tails included)
       /debug/pipeline        — per-shard speculation-gate verdicts
                                (forwarded to each runtime's engine)
       /debug/brownout        — the fleet's brownout-ladder state
@@ -399,6 +402,16 @@ class FleetServices:
                 fr = getattr(rt.sched, "flight_recorder", None)
                 if fr is not None:
                     shards[str(s)] = json.loads(fr.render())
+            return 200, json.dumps(
+                {"incarnation": self.sharded.name, "shards": shards},
+                indent=1,
+            )
+        if path == "/debug/decisions":
+            shards = {}
+            for s, rt in sorted(self.sharded._runtimes.items()):
+                dl = getattr(rt.sched, "decision_ledger", None)
+                if dl is not None:
+                    shards[str(s)] = json.loads(dl.render())
             return 200, json.dumps(
                 {"incarnation": self.sharded.name, "shards": shards},
                 indent=1,

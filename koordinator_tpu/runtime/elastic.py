@@ -344,6 +344,9 @@ class TopologyController:
         self._cold: Dict[int, int] = {}
         self._ticks = 0
         self._last_change = -(10**9)
+        #: decision observatory (obs.decisions.DecisionLedger). None =
+        #: disabled; the record site is one attribute-is-None check.
+        self.decisions = None
         self.stats = {
             "splits": 0,
             "merges": 0,
@@ -475,43 +478,96 @@ class TopologyController:
             self._cold.pop(s, None)
         return out
 
-    def tick(self, cycle: int = -1) -> List[dict]:
-        """One burn-driven evaluation: update hot/cold streaks from the
-        SLO tracker, take at most one topology action (cooldown-gated),
-        then true up the incarnation count. Returns the actions taken."""
-        self._ticks += 1
-        actions: List[dict] = []
-        active = self.fabric.shard_map.active_shards()
-        burns = {s: self.shard_burn(s) for s in active}
+    def attach_decisions(self, ledger) -> None:
+        """Wire the decision ledger (first caller wins)."""
+        if ledger is not None and self.decisions is None:
+            self.decisions = ledger
+
+    def snapshot(self) -> dict:
+        """The COMPLETE evidence :meth:`decide` reads, as one pure dict
+        (decision-observatory contract). Burns are recorded RAW —
+        rounding could flip a threshold comparison on replay; the hot/
+        cold streaks are the PRE-tick values (decide advances them)."""
+        active = [int(s) for s in self.fabric.shard_map.active_shards()]
+        return {
+            "active": active,
+            "burns": {int(s): self.shard_burn(s) for s in active},
+            "hot": dict(self._hot),
+            "cold": dict(self._cold),
+            "in_cooldown": self.in_cooldown,
+            "siblings": [
+                [int(a), int(b)]
+                for a, b in self.fabric.shard_map.siblings()
+            ],
+            "max_shards": self.max_shards,
+            "sustain": self.sustain,
+            "split_burn": self.split_burn,
+            "merge_burn": self.merge_burn,
+        }
+
+    @staticmethod
+    def decide(inputs: dict):
+        """Pure topology decision from a snapshot — ``(action, state)``.
+
+        Deterministic and side-effect-free. Keys are coerced back to
+        int because a snapshot replayed through the journal store (or
+        ``tools/decision_replay.py``) comes back JSON-shaped with
+        string dict keys."""
+        active = [int(s) for s in inputs["active"]]
+        burns = {int(k): float(v) for k, v in inputs["burns"].items()}
+        hot = {int(k): int(v) for k, v in inputs["hot"].items()}
+        cold = {int(k): int(v) for k, v in inputs["cold"].items()}
+        sustain = int(inputs["sustain"])
         for s in active:
-            if burns[s] > self.split_burn:
-                self._hot[s] = self._hot.get(s, 0) + 1
-                self._cold.pop(s, None)
-            elif burns[s] <= self.merge_burn:
-                self._cold[s] = self._cold.get(s, 0) + 1
-                self._hot.pop(s, None)
+            if burns[s] > float(inputs["split_burn"]):
+                hot[s] = hot.get(s, 0) + 1
+                cold.pop(s, None)
+            elif burns[s] <= float(inputs["merge_burn"]):
+                cold[s] = cold.get(s, 0) + 1
+                hot.pop(s, None)
             else:
-                self._hot.pop(s, None)
-                self._cold.pop(s, None)
-        if not self.in_cooldown:
-            hot = sorted(
-                (s for s in active if self._hot.get(s, 0) >= self.sustain),
+                hot.pop(s, None)
+                cold.pop(s, None)
+        action = {"op": "none"}
+        if not inputs["in_cooldown"]:
+            hot_list = sorted(
+                (s for s in active if hot.get(s, 0) >= sustain),
                 key=lambda s: (-burns[s], s),
             )
-            if hot and len(active) < self.max_shards:
-                out = self.split(hot[0], cycle=cycle)
-                if out is not None:
-                    actions.append(out)
-            elif not hot:
-                for a, b in self.fabric.shard_map.siblings():
+            if hot_list and len(active) < int(inputs["max_shards"]):
+                action = {"op": "split", "shard": hot_list[0]}
+            elif not hot_list:
+                for a, b in inputs["siblings"]:
+                    a, b = int(a), int(b)
                     if (
-                        self._cold.get(a, 0) >= self.sustain
-                        and self._cold.get(b, 0) >= self.sustain
+                        cold.get(a, 0) >= sustain
+                        and cold.get(b, 0) >= sustain
                     ):
-                        out = self.merge(a, b, cycle=cycle)
-                        if out is not None:
-                            actions.append(out)
+                        action = {"op": "merge", "pair": [a, b]}
                         break
+        state = {"hot": hot, "cold": cold}
+        return action, state
+
+    def tick(self, cycle: int = -1) -> List[dict]:
+        """One burn-driven evaluation: snapshot the evidence ONCE,
+        decide purely FROM the snapshot (update hot/cold streaks, pick
+        at most one cooldown-gated topology action), apply, record,
+        then true up the incarnation count. Returns the actions taken."""
+        self._ticks += 1
+        inputs = self.snapshot()
+        action, state = self.decide(inputs)
+        self._hot = dict(state["hot"])
+        self._cold = dict(state["cold"])
+        actions: List[dict] = []
+        if action["op"] == "split":
+            out = self.split(int(action["shard"]), cycle=cycle)
+            if out is not None:
+                actions.append(out)
+        elif action["op"] == "merge":
+            a, b = action["pair"]
+            out = self.merge(int(a), int(b), cycle=cycle)
+            if out is not None:
+                actions.append(out)
         # incarnation scale-out/in tracks the live shard count
         live = self._live()
         target = max(
@@ -529,6 +585,16 @@ class TopologyController:
             self.retire()
             self.stats["retired"] += 1
             actions.append({"op": "retire", "target": target})
+        dl = self.decisions
+        if dl is not None:
+            dl.record(
+                "topology",
+                self._ticks if cycle < 0 else int(cycle),
+                inputs,
+                action,
+                state,
+                outcome={"applied": len(actions)},
+            )
         return actions
 
 

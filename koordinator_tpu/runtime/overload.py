@@ -140,6 +140,10 @@ class AdmissionController:
         self.registry = None
         self._shed_counter = None
         self._defer_counter = None
+        #: decision observatory (obs.decisions.DecisionLedger). None =
+        #: disabled; the record site is one attribute-is-None check.
+        self.decisions = None
+        self._decision_ticks = 0
         self._lock = threading.Lock()
         self._tickets: List[ShedTicket] = []  # guarded-by: self._lock
         #: band value -> pods shed, forever (the soak's PROD/MID-never-
@@ -158,24 +162,69 @@ class AdmissionController:
         self._shed_counter = registry.get("overload_shed_total")
         self._defer_counter = registry.get("overload_deferred_total")
 
+    def attach_decisions(self, ledger) -> None:
+        """Wire the decision ledger (first caller wins — one fleet-level
+        admission policy records into one ledger)."""
+        if ledger is not None and self.decisions is None:
+            self.decisions = ledger
+
     # ---- the submit-time verdict ----
 
-    def admit(self, pod, band_depth: int) -> str:
-        """Admission verdict for one arriving pod given its band's
-        current live-queue depth on the submitting shard."""
+    def admission_snapshot(self, pod, band_depth: int) -> dict:
+        """The COMPLETE evidence :meth:`decide` reads for one arriving
+        pod, as one pure dict (decision-observatory contract)."""
         band = pod.priority_class
-        if band not in SHEDDABLE_BANDS:
-            return self.ADMIT
         bo = self.brownout
-        if bo is not None:
-            if bo.sheds(band):
-                return self.SHED
-            if bo.defers(band):
-                return self.DEFER
         budget = self.config.band_budget.get(band)
-        if budget is not None and band_depth >= budget:
-            return self.DEFER
-        return self.ADMIT
+        return {
+            "band": band.name,
+            "sheddable": band in SHEDDABLE_BANDS,
+            "band_depth": int(band_depth),
+            "budget": int(budget) if budget is not None else None,
+            "brownout_level": bo.level if bo is not None else None,
+            "brownout_sheds": bo.sheds(band) if bo is not None else False,
+            "brownout_defers": (
+                bo.defers(band) if bo is not None else False
+            ),
+        }
+
+    @staticmethod
+    def decide(inputs: dict):
+        """Pure admission verdict from a snapshot — ``(action, state)``.
+        Deterministic so a shadow or ``tools/decision_replay.py``
+        re-deciding from RECORDED inputs reproduces the verdict."""
+        if not inputs["sheddable"]:
+            verdict = AdmissionController.ADMIT
+        elif inputs["brownout_sheds"]:
+            verdict = AdmissionController.SHED
+        elif inputs["brownout_defers"]:
+            verdict = AdmissionController.DEFER
+        elif (
+            inputs["budget"] is not None
+            and inputs["band_depth"] >= inputs["budget"]
+        ):
+            verdict = AdmissionController.DEFER
+        else:
+            verdict = AdmissionController.ADMIT
+        return {"verdict": verdict}, {}
+
+    def admit(
+        self, pod, band_depth: int, shard: Optional[int] = None
+    ) -> str:
+        """Admission verdict for one arriving pod given its band's
+        current live-queue depth on the submitting shard: snapshot once,
+        decide purely FROM the snapshot, record."""
+        inputs = self.admission_snapshot(pod, band_depth)
+        action, state = self.decide(inputs)
+        dl = self.decisions
+        if dl is not None:
+            with self._lock:
+                self._decision_ticks += 1
+                tick = self._decision_ticks
+            dl.record(
+                "admission", tick, inputs, action, state, shard=shard
+            )
+        return action["verdict"]
 
     # ---- the sweep-time policy (deferred parking lot) ----
 
@@ -333,7 +382,14 @@ class BrownoutController:
         self._lock = threading.Lock()
         self._transitions: "deque[dict]" = deque(maxlen=int(history))  # guarded-by: self._lock
         self._healths: list = []
-        self._flights: list = []
+        #: decision observatory (obs.decisions.DecisionLedger) — the
+        #: SINGLE attachment point: per-tick decisions record here and
+        #: flight recorders attach THROUGH it (attach_flight), so a
+        #: takeover adopts journaled controller evidence via one code
+        #: path. None = disabled; every record site is one
+        #: attribute-is-None check.
+        self.decisions = None
+        self._owns_ledger = False
         self.registry = None
         self._gauge = None
         self._trans_counter = None
@@ -365,13 +421,38 @@ class BrownoutController:
         self._healths.append(health)
         health.set("brownout", self.level == self.L0, f"L{self.level}")
 
+    def attach_decisions(self, ledger) -> None:
+        """Wire the decision ledger. First EXTERNAL caller wins; an
+        internally-created default (see :meth:`attach_flight`) is
+        replaced and its flight attachments migrate, so the ledger a
+        runtime provides is always the one that records."""
+        if ledger is None or ledger is self.decisions:
+            return
+        if self.decisions is not None and self._owns_ledger:
+            for fr in self.decisions._flights:
+                ledger.attach_flight(fr)
+        elif self.decisions is not None:
+            return
+        self.decisions = ledger
+        self._owns_ledger = False
+
     def attach_flight(self, recorder) -> None:
         """Register a flight recorder to journal transitions into (the
         crash-surviving black box: a post-mortem must show WHEN the
-        ladder moved relative to the cycles around it)."""
-        if recorder is None or recorder in self._flights:
+        ladder moved relative to the cycles around it). Routed through
+        the decision ledger's single attachment point — a ladder with no
+        explicit ledger gets a default in-memory one so the journaled
+        fields keep flowing unchanged."""
+        if recorder is None:
             return
-        self._flights.append(recorder)
+        dl = self.decisions
+        if dl is None:
+            from ..obs.decisions import DecisionLedger
+
+            dl = DecisionLedger(clock=self.clock)
+            self.decisions = dl
+            self._owns_ledger = True
+        dl.attach_flight(recorder)
 
     # ---- the pressure signal ----
 
@@ -410,50 +491,119 @@ class BrownoutController:
 
     # ---- the tick ----
 
-    def tick(self, cycle: int = -1) -> Optional[dict]:
-        """One evaluation: read the burn, update the hot/cold streaks,
-        move at most ONE level. Returns the transition record when the
-        level moved, else None."""
-        self._ticks += 1
-        burn = self.pressure()
-        target = self._target_for(burn)
-        if target > self.level:
-            self._hot += 1
-            self._cold = 0
-            if self._hot >= self.sustain:
+    def snapshot(self) -> dict:
+        """The COMPLETE evidence :meth:`decide` reads, as one pure dict
+        (decision-observatory contract: the recorded inputs alone must
+        reproduce the decision). The burn is recorded RAW — rounding it
+        could flip a threshold comparison on replay."""
+        return {
+            "burn": self.pressure(),
+            "level": self.level,
+            "hot": self._hot,
+            "cold": self._cold,
+            "yields": self._yields,
+            "thresholds": list(self.thresholds),
+            "sustain": self.sustain,
+            "cooldown": self.cooldown,
+            "max_yield": self.max_yield,
+            "topology_can_relieve": self._topology_can_relieve(),
+        }
+
+    @staticmethod
+    def decide(inputs: dict):
+        """Pure ladder decision from a snapshot — ``(action, state)``.
+        Deterministic and side-effect-free (same-seed soak contract;
+        shadow/replay re-deciding from RECORDED inputs must reproduce
+        the acting move bit-exactly)."""
+        level = int(inputs["level"])
+        hot = int(inputs["hot"])
+        cold = int(inputs["cold"])
+        yields = int(inputs["yields"])
+        burn = float(inputs["burn"])
+        target = 0
+        for i, thr in enumerate(inputs["thresholds"]):
+            if burn >= thr:
+                target = i + 1
+        op = "hold"
+        if target > level:
+            hot += 1
+            cold = 0
+            if hot >= int(inputs["sustain"]):
                 if (
-                    self.level == self.L0
-                    and self._yields < self.max_yield
-                    and self._topology_can_relieve()
+                    level == BrownoutController.L0
+                    and yields < int(inputs["max_yield"])
+                    and inputs["topology_can_relieve"]
                 ):
                     # capacity budget remains: give the topology
                     # controller a bounded window to split before the
                     # ladder starts degrading work
-                    self._yields += 1
-                    self.stats["yielded_to_split"] += 1
-                    return None
-                self._hot = 0
-                return self._set_level(
-                    self.level + 1, cycle, burn, "escalate"
-                )
-        elif target < self.level:
-            self._cold += 1
-            self._hot = 0
-            self._yields = 0
-            if self._cold >= self.cooldown:
-                self._cold = 0
-                return self._set_level(
-                    self.level - 1, cycle, burn, "deescalate"
-                )
+                    yields += 1
+                    op = "yield"
+                else:
+                    hot = 0
+                    yields = 0
+                    op = "escalate"
+                    level += 1
+        elif target < level:
+            cold += 1
+            hot = 0
+            yields = 0
+            if cold >= int(inputs["cooldown"]):
+                cold = 0
+                op = "deescalate"
+                level -= 1
         else:
             # pressure matched the level (or a split relieved it before
             # the ladder ever moved): the episode is over — the yield
             # budget renews for the NEXT storm, not just the next
             # transition
-            self._hot = 0
-            self._cold = 0
-            self._yields = 0
-        return None
+            hot = 0
+            cold = 0
+            yields = 0
+        action = {"op": op, "to": level}
+        state = {
+            "level": level,
+            "hot": hot,
+            "cold": cold,
+            "yields": yields,
+            "target": target,
+        }
+        return action, state
+
+    def tick(self, cycle: int = -1) -> Optional[dict]:
+        """One evaluation: snapshot the evidence ONCE, decide purely
+        FROM the snapshot (update the hot/cold streaks, move at most ONE
+        level), apply, record. Returns the transition record when the
+        level moved, else None."""
+        self._ticks += 1
+        inputs = self.snapshot()
+        action, state = self.decide(inputs)
+        self._hot = state["hot"]
+        self._cold = state["cold"]
+        self._yields = state["yields"]
+        op = action["op"]
+        rec = None
+        if op == "yield":
+            self.stats["yielded_to_split"] += 1
+        elif op == "escalate":
+            rec = self._set_level(
+                self.level + 1, cycle, inputs["burn"], "escalate"
+            )
+        elif op == "deescalate":
+            rec = self._set_level(
+                self.level - 1, cycle, inputs["burn"], "deescalate"
+            )
+        dl = self.decisions
+        if dl is not None:
+            dl.record(
+                "brownout",
+                self._ticks if cycle < 0 else int(cycle),
+                inputs,
+                action,
+                state,
+                outcome={"burn": inputs["burn"]},
+            )
+        return rec
 
     def _set_level(
         self, level: int, cycle: int, burn: float, direction: str
@@ -486,10 +636,13 @@ class BrownoutController:
                 self.level == self.L0,
                 f"L{self.level} (burn {burn:.2f})",
             )
-        for fr in self._flights:
-            # journaled beside the per-cycle records — never raises into
-            # the control loop (FlightRecorder.record's own contract)
-            fr.record(
+        dl = self.decisions
+        if dl is not None:
+            # journaled beside the per-cycle records through the
+            # ledger's single attachment point — never raises into the
+            # control loop (FlightRecorder.record's own contract); the
+            # field shapes predate the ledger and stay byte-compatible
+            dl.flight_record(
                 cycle=int(cycle),
                 brownout={"from": prev, "to": self.level, "burn": burn},
                 speculation="brownout",
@@ -582,8 +735,17 @@ class CircuitBreaker:
         self._opened_at = 0.0  # guarded-by: self._lock
         self._probing = False  # guarded-by: self._lock
         self.stats = {"trips": 0, "probes": 0, "closes": 0}
+        #: decision observatory (obs.decisions.DecisionLedger). None =
+        #: disabled; every record site is one attribute-is-None check.
+        self.decisions = None
+        self._decision_ticks = 0  # guarded-by: self._lock
         if gauge is not None:
             gauge.set(float(self.CLOSED))
+
+    def attach_decisions(self, ledger) -> None:
+        """Wire the decision ledger (first caller wins)."""
+        if ledger is not None and self.decisions is None:
+            self.decisions = ledger
 
     @property
     def state(self) -> int:
@@ -600,26 +762,127 @@ class CircuitBreaker:
         if self.gauge is not None:
             self.gauge.set(float(state))
 
+    def _snapshot(self, op: str) -> dict:  # koordlint: holds=self._lock
+        """Op-tagged evidence snapshot (caller holds the lock). The
+        clock enters ONLY as the ``cooldown_elapsed`` boolean captured
+        here, so :meth:`decide` stays pure and replayable."""
+        return {
+            "op": op,
+            "state": self._NAMES[self._state],
+            "probing": self._probing,
+            "failures": self._failures,
+            "threshold": self.threshold,
+            "cooldown_elapsed": (
+                self._state == self.OPEN
+                and self.clock() - self._opened_at >= self.cooldown_s
+            ),
+        }
+
+    @staticmethod
+    def decide(inputs: dict):
+        """Pure breaker transition from an op-tagged snapshot —
+        ``(action, state)``. Deterministic: the snapshot already folded
+        the clock into ``cooldown_elapsed``."""
+        op = inputs["op"]
+        st = str(inputs["state"])
+        probing = bool(inputs["probing"])
+        failures = int(inputs["failures"])
+        reopen = False
+        if op == "allow":
+            probe = False
+            if st == "closed":
+                allowed = True
+            elif st == "open":
+                if inputs["cooldown_elapsed"]:
+                    st = "half_open"
+                    probing = True
+                    probe = True
+                    allowed = True
+                else:
+                    allowed = False
+            else:
+                # HALF_OPEN: the probe is in flight — admit nothing else
+                if not probing:
+                    probing = True
+                    probe = True
+                    allowed = True
+                else:
+                    allowed = False
+            action = {
+                "op": "allow" if allowed else "deny",
+                "probe": probe,
+            }
+        elif op == "failure":
+            probing = False
+            if st == "half_open":
+                # the probe failed: straight back to OPEN, fresh window
+                st = "open"
+                reopen = True
+                action = {"op": "trip"}
+            else:
+                failures += 1
+                if st == "closed" and failures >= int(
+                    inputs["threshold"]
+                ):
+                    st = "open"
+                    reopen = True
+                    action = {"op": "trip"}
+                else:
+                    action = {"op": "count_failure"}
+        else:  # success
+            failures = 0
+            probing = False
+            if st != "closed":
+                st = "closed"
+                action = {"op": "close"}
+            else:
+                action = {"op": "ok"}
+        state = {
+            "state": st,
+            "probing": probing,
+            "failures": failures,
+            "reopen": reopen,
+        }
+        return action, state
+
+    _STATE_NUMS = {"closed": CLOSED, "open": OPEN, "half_open": HALF_OPEN}
+
+    def _apply(self, action: dict, state: dict) -> None:  # koordlint: holds=self._lock
+        """Apply a decided transition (caller holds the lock)."""
+        num = self._STATE_NUMS[state["state"]]
+        if num != self._state:
+            self._to(num)
+        self._failures = state["failures"]
+        self._probing = state["probing"]
+        if state["reopen"]:
+            self._opened_at = self.clock()
+        op = action["op"]
+        if action.get("probe"):
+            self.stats["probes"] += 1
+        if op == "trip":
+            self.stats["trips"] += 1
+        elif op == "close":
+            self.stats["closes"] += 1
+
+    def _record(self, inputs: dict, action: dict, state: dict) -> None:
+        dl = self.decisions
+        if dl is not None:
+            with self._lock:
+                self._decision_ticks += 1
+                tick = self._decision_ticks
+            dl.record("breaker", tick, inputs, action, state)
+
     def allow(self) -> bool:
         """Whether a call may go out now. An OPEN breaker admits exactly
         ONE probe per cooldown window (HALF_OPEN); concurrent callers
-        behind the probe fail fast until it settles."""
+        behind the probe fail fast until it settles. Snapshot once,
+        decide purely FROM the snapshot, apply, record."""
         with self._lock:
-            if self._state == self.CLOSED:
-                return True
-            if self._state == self.OPEN:
-                if self.clock() - self._opened_at >= self.cooldown_s:
-                    self._to(self.HALF_OPEN)
-                    self._probing = True
-                    self.stats["probes"] += 1
-                    return True
-                return False
-            # HALF_OPEN: the probe is in flight — admit nothing else
-            if not self._probing:
-                self._probing = True
-                self.stats["probes"] += 1
-                return True
-            return False
+            inputs = self._snapshot("allow")
+            action, state = self.decide(inputs)
+            self._apply(action, state)
+        self._record(inputs, action, state)
+        return action["op"] == "allow"
 
     def abort_probe(self) -> None:
         """An admitted call ended WITHOUT a channel verdict — e.g. a
@@ -633,29 +896,17 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
-            self._failures = 0
-            self._probing = False
-            if self._state != self.CLOSED:
-                self._to(self.CLOSED)
-                self.stats["closes"] += 1
+            inputs = self._snapshot("success")
+            action, state = self.decide(inputs)
+            self._apply(action, state)
+        self._record(inputs, action, state)
 
     def record_failure(self) -> None:
         with self._lock:
-            self._probing = False
-            if self._state == self.HALF_OPEN:
-                # the probe failed: straight back to OPEN, fresh window
-                self._to(self.OPEN)
-                self._opened_at = self.clock()
-                self.stats["trips"] += 1
-                return
-            self._failures += 1
-            if (
-                self._state == self.CLOSED
-                and self._failures >= self.threshold
-            ):
-                self._to(self.OPEN)
-                self._opened_at = self.clock()
-                self.stats["trips"] += 1
+            inputs = self._snapshot("failure")
+            action, state = self.decide(inputs)
+            self._apply(action, state)
+        self._record(inputs, action, state)
 
     def report(self) -> dict:
         with self._lock:

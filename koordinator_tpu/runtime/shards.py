@@ -520,6 +520,7 @@ class ShardFabric:
         flight_stores: Optional[Dict[int, object]] = None,
         handoff_log_cap: int = 1024,
         topology_store=None,
+        decision_stores: Optional[Dict[int, object]] = None,
     ):
         from ..core.journal import MemoryJournalStore
 
@@ -544,6 +545,13 @@ class ShardFabric:
         #: same durability substrate, so a takeover that can replay the
         #: journal can also read the dead owner's last-N cycle summaries
         self.flight_stores: Dict[int, object] = flight_stores or {
+            s: MemoryJournalStore() for s in range(n_shards)
+        }
+        #: per-shard decision-ledger stores (decision-observatory PR):
+        #: controller decisions persist BESIDE the journal and the
+        #: flight recorder over the same sealed/screened store API, so a
+        #: takeover adopts the dead owner's decision tail too
+        self.decision_stores: Dict[int, object] = decision_stores or {
             s: MemoryJournalStore() for s in range(n_shards)
         }
         #: fleet-tracing PR: seam-matched shard-handoff instants, shared
@@ -589,6 +597,8 @@ class ShardFabric:
             self.journal_stores[s] = MemoryJournalStore()
         if s not in self.flight_stores:
             self.flight_stores[s] = MemoryJournalStore()
+        if s not in self.decision_stores:
+            self.decision_stores[s] = MemoryJournalStore()
 
     def shard_lease_lock(self, shard: int):
         return self.locks.lock(f"shard-{int(shard)}")
@@ -805,6 +815,8 @@ class ShardedScheduler:
         claim_tombstone_retention_s: float = 3600.0,
         overload=None,
         brownout=None,
+        decision_capacity: int = 512,
+        decisions: bool = True,
     ):
         self.name = name
         self.hub = hub
@@ -838,6 +850,13 @@ class ShardedScheduler:
             # its ladder
             self.brownout = overload.brownout
         self.flight_capacity = int(flight_capacity)
+        #: decision observatory (decision-observatory PR): per-shard
+        #: DecisionLedgers over ``fabric.decision_stores`` attach at
+        #: runtime build (adoption = crash survival, like the flight
+        #: recorder). ``decisions=False`` disables recording entirely —
+        #: every controller site is back to one attribute-is-None check.
+        self.decision_capacity = int(decision_capacity)
+        self.decisions_enabled = bool(decisions)
         #: ClaimTable tombstone retention (PR 6 queued follow-on): when a
         #: shard's run-loop journal compaction fires, settled claim
         #: tombstones OLDER than this window are compacted away; inside
@@ -1039,6 +1058,24 @@ class ShardedScheduler:
             )
 
         sched.on_journal_compacted = _gc_claims
+        # decision observatory (decision-observatory PR): the per-shard
+        # DecisionLedger lives over the FABRIC's store beside the
+        # journal and the flight recorder, so a takeover adopts the
+        # dead owner's decision tail too; attached BEFORE the stream is
+        # built so the pipeline's depth controller records from feed 1
+        if self.decisions_enabled:
+            from ..obs.decisions import DecisionLedger
+
+            self.fabric.ensure_shard(shard)
+            sched.attach_decision_ledger(
+                DecisionLedger(
+                    self.fabric.decision_stores[shard],
+                    capacity=self.decision_capacity,
+                    shard=shard,
+                    incarnation=self.name,
+                    clock=self.clock,
+                )
+            )
         # overload control (overload-control PR): the fleet-shared
         # brownout ladder gates this runtime's pipeline/bucket, journals
         # into its flight recorder, and shows on its /healthz; the
@@ -1049,6 +1086,8 @@ class ShardedScheduler:
             sched.extender.services.brownout = self.brownout
             self.brownout.bind_registry(sched.extender.registry)
             self.brownout.attach_health(sched.extender.health)
+            if sched.decision_ledger is not None:
+                self.brownout.attach_decisions(sched.decision_ledger)
             self.brownout.attach_flight(sched.flight_recorder)
         informers = self.hub.wire_scheduler(sched, node_filter=flt)
         self.hub.start()

@@ -712,6 +712,12 @@ class BatchScheduler:
         #: stream pump hints its backlog depth here for that record
         self.lifecycle = None
         self.flight_recorder = None
+        #: decision observatory (obs.decisions.DecisionLedger): when
+        #: wired, every controller decision (pipeline depth, brownout,
+        #: admission, breaker, topology) records its full input snapshot
+        #: here. None = disabled; every record site is one
+        #: attribute-is-None check. Attach via attach_decision_ledger.
+        self.decision_ledger = None
         #: solver observatory (obs.devprof.DevProf): compile/retrace
         #: ledger + on-demand device-timeline capture + per-cycle
         #: device-memory census. None = disabled; every hot-path site is
@@ -815,6 +821,18 @@ class BatchScheduler:
         ring at ``/debug/flightrecorder``."""
         self.flight_recorder = recorder
         self.extender.services.flightrecorder = recorder
+
+    def attach_decision_ledger(self, ledger) -> None:
+        """Wire the controller-decision ledger: the pipeline's depth
+        controller and any attached overload/topology controllers
+        record their decisions here, counters bind to this scheduler's
+        registry, and the services engine serves the ring at
+        ``/debug/decisions``."""
+        self.decision_ledger = ledger
+        ledger.bind_registry(self.extender.registry)
+        if self.flight_recorder is not None:
+            ledger.attach_flight(self.flight_recorder)
+        self.extender.services.decisions = ledger
 
     def attach_devprof(self, devprof) -> None:
         """Wire the solver observatory (obs.devprof.DevProf): installs

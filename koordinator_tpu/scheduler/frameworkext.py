@@ -270,6 +270,18 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "snapshot-channel circuit-breaker state "
         "(0 = closed, 1 = open, 2 = half-open probe)",
     )
+    reg.counter(
+        "controller_decisions_total",
+        "control-plane decisions recorded on the decision ledger, by "
+        "controller and action",
+        labels=("controller", "action"),
+    )
+    reg.counter(
+        "shadow_divergence_total",
+        "shadow-policy proposals that diverged from the acting "
+        "controller's decision (shadows never act)",
+        labels=("controller",),
+    )
     ensure_exceptions_counter(reg)
     return reg
 
@@ -495,6 +507,8 @@ class ServicesEngine:
       /debug/rejections      — rejection records + per-stage tally
       /debug/pipeline        — speculation-gate introspection (which
                                named gate keeps this config serial)
+      /debug/decisions       — controller decision ledger (inputs →
+                               action → state per tick, crash-surviving)
       /debug/flightrecorder  — last-N per-cycle summaries (crash-
                                surviving black box)
       /debug/brownout        — brownout-ladder level, burn, transitions
@@ -530,6 +544,7 @@ class ServicesEngine:
         #: answer accordingly
         self.slo = None
         self.flightrecorder = None
+        self.decisions = None
         self.devprof = None
         #: brownout-ladder controller (overload-control PR) — wired by
         #: the stream/sharded scheduler when overload control is on
@@ -582,6 +597,10 @@ class ServicesEngine:
             if self.flightrecorder is None:
                 return 404, "no flight recorder wired"
             return 200, self.flightrecorder.render()
+        if path == "/debug/decisions":
+            if self.decisions is None:
+                return 404, "no decision ledger wired"
+            return 200, self.decisions.render()
         if path == "/debug/brownout":
             if self.brownout is None:
                 return 404, "no brownout controller wired"

@@ -387,6 +387,11 @@ class _DepthController:
             self._win.append(bool(kept))
         self._quiet = 0
         self._depth = self.max_depth
+        #: decision observatory (obs.decisions.DecisionLedger), wired by
+        #: the pipeline from sched.decision_ledger each feed. None =
+        #: disabled; the record site is one attribute-is-None check.
+        self.decisions = None
+        self._ticks = 0
 
     @property
     def discard_rate(self) -> float:
@@ -407,23 +412,68 @@ class _DepthController:
         restoration, any discard resets the streak."""
         self._quiet = 0 if had_discard else self._quiet + 1
 
-    def choose(self) -> int:
-        """Effective depth for the NEXT feed."""
-        if self.max_depth <= 1:
-            return 1
-        if self._quiet >= self.QUIET_FEEDS:
-            if self._depth < self.max_depth:
+    def snapshot(self) -> Dict[str, object]:
+        """The COMPLETE evidence :meth:`decide` reads, as one pure dict
+        (decision-observatory contract: the recorded inputs alone must
+        reproduce the decision)."""
+        return {
+            "max_depth": self.max_depth,
+            "depth": self._depth,
+            "window": [bool(k) for k in self._win],
+            "discard_rate": round(self.discard_rate, 4),
+            "quiet_feeds": self._quiet,
+        }
+
+    @staticmethod
+    def decide(inputs: Dict[str, object]):
+        """Pure depth decision from a snapshot — ``(action, state)``.
+
+        Deterministic and side-effect-free so a shadow policy or
+        ``tools/decision_replay.py`` re-deciding from a RECORDED
+        snapshot reproduces the acting choice bit-exactly."""
+        max_depth = int(inputs["max_depth"])
+        depth = int(inputs["depth"])
+        window = list(inputs["window"])
+        quiet = int(inputs["quiet_feeds"])
+        clear_window = False
+        if max_depth <= 1:
+            depth = 1
+        elif quiet >= _DepthController.QUIET_FEEDS:
+            if depth < max_depth:
                 # quiet restoration also expires the window: the churn
                 # it recorded is evidence about a world that stopped
                 # producing discards QUIET_FEEDS feeds ago
-                self._win.clear()
-            self._depth = self.max_depth
-        elif len(self._win) >= self.EVIDENCE:
-            rate = self.discard_rate
-            if rate >= self.DEGRADE_RATE:
-                self._depth = 1
-            elif rate <= self.RESTORE_RATE:
-                self._depth = self.max_depth
+                clear_window = True
+            depth = max_depth
+        elif len(window) >= _DepthController.EVIDENCE:
+            rate = sum(1 for k in window if not k) / len(window)
+            if rate >= _DepthController.DEGRADE_RATE:
+                depth = 1
+            elif rate <= _DepthController.RESTORE_RATE:
+                depth = max_depth
+        action = {"depth": depth}
+        state = {
+            "depth": depth,
+            "cleared_window": clear_window,
+            "window_len": 0 if clear_window else len(window),
+        }
+        return action, state
+
+    def choose(self) -> int:
+        """Effective depth for the NEXT feed: snapshot once, decide
+        purely FROM the snapshot, apply, record."""
+        self._ticks += 1
+        inputs = self.snapshot()
+        action, state = self.decide(inputs)
+        if state["cleared_window"]:
+            self._win.clear()
+        self._depth = int(action["depth"])
+        dl = self.decisions
+        if dl is not None:
+            dl.record(
+                "depth", self._ticks, inputs, action, state,
+                outcome={"discard_rate": inputs["discard_rate"]},
+            )
         return self._depth
 
     def info(self) -> Dict[str, object]:
@@ -490,6 +540,7 @@ class CyclePipeline:
                 if outcome in ("kept", "discarded"):
                     seed.append(outcome == "kept")
         self._controller = _DepthController(self.depth, seed)
+        self._controller.decisions = sched.decision_ledger
         #: the cap the most recent feed ran under (min of the adaptive
         #: choice and the brownout ladder's cap) + the adaptive choice
         #: itself — sampled by the soaks' interplay assertions
@@ -642,6 +693,9 @@ class CyclePipeline:
         # discards chained speculation anyway — stop paying for deep
         # dispatches it will throw away). The ladder's cap DOMINATES
         # while browning; the controller's choice resumes at L0.
+        # late attach (a runtime may wire the ledger after pipeline
+        # construction): resync the controller's ledger handle per feed
+        self._controller.decisions = sched.decision_ledger
         chosen = self._controller.choose() if self.adaptive else self.depth
         depth_cap = chosen
         bo = sched.brownout

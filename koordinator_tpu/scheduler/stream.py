@@ -102,6 +102,10 @@ class StreamScheduler:
         self._band_live: Dict[int, int] = {}
         if overload is not None:
             overload.bind_registry(scheduler.extender.registry)
+            if scheduler.decision_ledger is not None:
+                # decision observatory: admission verdicts and brownout
+                # moves record beside the depth controller's choices
+                overload.attach_decisions(scheduler.decision_ledger)
             bo = overload.brownout
             if bo is not None:
                 if scheduler.brownout is None:
@@ -110,6 +114,8 @@ class StreamScheduler:
                 bo.attach_health(scheduler.extender.health)
                 if scheduler.extender.services.brownout is None:
                     scheduler.extender.services.brownout = bo
+                if scheduler.decision_ledger is not None:
+                    bo.attach_decisions(scheduler.decision_ledger)
                 if scheduler.flight_recorder is not None:
                     bo.attach_flight(scheduler.flight_recorder)
         if lifecycle is not None and scheduler.lifecycle is None:
@@ -143,7 +149,11 @@ class StreamScheduler:
         ov = self.overload
         if ov is not None:
             band = pod.priority_class
-            verdict = ov.admit(pod, self._band_live.get(int(band), 0))
+            verdict = ov.admit(
+                pod,
+                self._band_live.get(int(band), 0),
+                shard=self.shard if self.shard >= 0 else None,
+            )
             if verdict == ov.SHED:
                 ov.shed(pod, self.shard, arrival, detail="admission")
                 return "shed"

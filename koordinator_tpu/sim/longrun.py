@@ -564,6 +564,69 @@ def assert_resident_state_converged(sched) -> None:
     assert_resident_bitexact(sched)
 
 
+def _sweep_decisions(records, context: str):
+    """Decision-observatory soak sweep (decision-observatory PR) over
+    one store's collected records, sorted by ``seq``:
+
+    * **gap-free per-controller tick sequences** — a takeover adopted
+      the dead writer's tail and continued its ``cseq``, so no hole
+      marks where a kill landed;
+    * **recompute-replay clean** — every recorded action reproduces
+      bit-exactly from its JSON-round-tripped input snapshot through
+      the same pure ``decide`` the controller ran (the offline
+      counterfactual-replay contract, asserted in-soak so a drifting
+      snapshot is caught where it was written).
+
+    Returns the canonical trace (:func:`~koordinator_tpu.obs.decisions.
+    decision_trace`: wall times and shadow annotations dropped, so
+    same-seed runs with and without a shadow attached compare
+    bit-identical).
+    """
+    import json as _json
+
+    from koordinator_tpu.obs.decisions import (
+        controller_gaps,
+        decision_trace,
+    )
+    from koordinator_tpu.runtime.elastic import TopologyController
+    from koordinator_tpu.runtime.overload import (
+        AdmissionController,
+        BrownoutController,
+        CircuitBreaker,
+    )
+    from koordinator_tpu.scheduler.pipeline import _DepthController
+
+    deciders = {
+        "depth": _DepthController.decide,
+        "brownout": BrownoutController.decide,
+        "admission": AdmissionController.decide,
+        "breaker": CircuitBreaker.decide,
+        "topology": TopologyController.decide,
+    }
+    gaps = controller_gaps(records)
+    assert not gaps, (
+        f"{context}: per-controller decision sequences have holes "
+        f"(a controller's decisions were lost): {gaps}"
+    )
+    drifted = []
+    for rec in records:
+        decide = deciders.get(str(rec.get("controller")))
+        if decide is None:
+            continue
+        action, _state = decide(_json.loads(_json.dumps(rec["inputs"])))
+        if action != rec["action"]:
+            drifted.append(
+                (rec.get("controller"), rec.get("seq"),
+                 rec["action"], action)
+            )
+    assert not drifted, (
+        f"{context}: {len(drifted)} recorded decision(s) fail recompute "
+        f"replay — decide() is impure or the snapshot is incomplete; "
+        f"first 3: {drifted[:3]}"
+    )
+    return decision_trace(records)
+
+
 def run_chaos_soak(
     cycles: int = 200,
     seed: int = 0,
@@ -575,6 +638,7 @@ def run_chaos_soak(
     ha: bool = False,
     shards: int = 0,
     incarnations: int = 3,
+    shadow: bool = False,
 ) -> dict:
     """Longrun chaos soak: hundreds of scheduling cycles under a seeded
     random fault schedule, asserting the failure-domain invariants the
@@ -636,6 +700,7 @@ def run_chaos_soak(
             verbose=verbose,
             shards=shards,
             incarnations=incarnations,
+            shadow=shadow,
         )
     import random as _random
 
@@ -728,6 +793,32 @@ def run_chaos_soak(
 
         fence = EpochFence()
         journal_store = MemoryJournalStore()
+    # decision observatory (decision-observatory PR): like the bind
+    # journal's store, the decision STORE outlives any one scheduler
+    # incarnation — the crash-restart leg's fresh instance adopts the
+    # dead writer's decision tail from it, keeping per-controller tick
+    # sequences gap-free across the kill (swept at the end). Capacity
+    # sized so no soak-length record stream is ever ring-evicted: the
+    # end sweep replays the COMPLETE decision history.
+    from koordinator_tpu.core.journal import (
+        MemoryJournalStore as _DecisionStore,
+    )
+    from koordinator_tpu.obs.decisions import DecisionLedger
+
+    decision_store = _DecisionStore()
+    decision_gen = [0]   # bumped per instance: per-incarnation identity
+    shadow_registry = None
+    if shadow:
+        # the bit-exactness arm: an ALWAYS-diverging shadow consults on
+        # every depth record; same-seed scheduling must stay
+        # bit-identical with it attached (a shadow can never act)
+        from koordinator_tpu.obs.shadow import (
+            AlwaysDivergeShadow,
+            ShadowRegistry,
+        )
+
+        shadow_registry = ShadowRegistry()
+        shadow_registry.attach("depth", AlwaysDivergeShadow())
 
     def _make_instance(snapshot, quotas):
         """One scheduler 'process': BatchScheduler + CyclePipeline.
@@ -753,6 +844,19 @@ def run_chaos_soak(
         s.extender.monitor.stop_background()
         r = s.extender.registry
         chaos.bind_counter(r.get("fault_injected_total"))
+        # decision observatory: a per-incarnation ledger over the shared
+        # soak-lifetime store — a restarted instance's ledger adopts its
+        # predecessor's tail at construction, so the depth controller's
+        # tick sequence continues gap-free across the kill
+        dl = DecisionLedger(
+            decision_store,
+            capacity=4096,
+            incarnation=f"soak-gen{decision_gen[0]}",
+        )
+        decision_gen[0] += 1
+        if shadow_registry is not None:
+            dl.attach_shadow(shadow_registry)
+        s.attach_decision_ledger(dl)
         # generous prepare deadline: a chaos-KILLED worker is detected
         # promptly via thread death (collect returns early), so the
         # timeout only bounds a genuinely slow prepare — a tight value
@@ -1363,6 +1467,34 @@ def run_chaos_soak(
     stats["faults"] = chaos.fired_counts()
     stats["fault_trace"] = list(chaos.trace)
     chaos.disarm()
+    # decision observatory (decision-observatory PR): every controller
+    # decision the soak took is on the shared store. The sweep asserts
+    # gap-free per-controller sequences (the HA kill's successor adopted
+    # the dead writer's tail) and recompute-replay cleanliness, and the
+    # canonical trace rides the stats for the same-seed bit-exactness
+    # arms (wall times and shadow annotations dropped by construction,
+    # so a shadow-attached run compares bit-identical)
+    dec_records = sorted(
+        decision_store.load(), key=lambda r: r.get("seq", 0)
+    )
+    assert dec_records, "the soak recorded no controller decisions"
+    stats["decision_trace"] = _sweep_decisions(
+        dec_records, context="chaos-soak decisions"
+    )
+    stats["decisions_total"] = len(dec_records)
+    # proof the shadow arm really consulted: divergence annotations on
+    # the RAW records (decision_trace drops them — that is the point)
+    stats["shadow_divergences"] = sum(
+        1 for r in dec_records if r.get("shadow", {}).get("diverged")
+    )
+    if stats["crash_restarts"]:
+        # the kill really produced an adopted tail: the store carries
+        # records stamped by more than one writer incarnation
+        writers = {r.get("incarnation") for r in dec_records}
+        assert len(writers) >= 2, (
+            f"crash-restart fired but the decision store shows a "
+            f"single writer: {writers}"
+        )
     # the sidecar's world re-converged through the resync protocol
     if client is not None:
         _sync_cycle_delta([], [])   # fault-free final heal
@@ -1499,6 +1631,7 @@ def _run_sharded_soak(
     verbose: bool,
     shards: int,
     incarnations: int,
+    shadow: bool = False,
 ) -> dict:
     """The multi-shard arm of :func:`run_chaos_soak`: N concurrently-live
     fenced scheduler incarnations partition node ownership across S
@@ -1669,6 +1802,25 @@ def _run_sharded_soak(
             slo=slo,
             flight_capacity=64,
         )
+
+    # decision observatory (decision-observatory PR): the runtimes'
+    # per-shard DecisionLedgers live over fabric.decision_stores (the
+    # ShardedScheduler default), so a takeover adopts the dead owner's
+    # decision tail exactly like the journal and the flight recorder —
+    # swept gap-free + recompute-clean at the end. ``shadow=True`` is
+    # the bit-exactness arm: an always-diverging shadow consults on
+    # every depth record without ever acting (attached opportunistically
+    # per cycle — runtimes are born on takeover; attach_shadow is
+    # first-wins-idempotent per ledger).
+    shadow_registry = None
+    if shadow:
+        from koordinator_tpu.obs.shadow import (
+            AlwaysDivergeShadow,
+            ShadowRegistry,
+        )
+
+        shadow_registry = ShadowRegistry()
+        shadow_registry.attach("depth", AlwaysDivergeShadow())
 
     incs = [_make_incarnation(i, 0) for i in range(incarnations)]
     # elastic-topology PR: the controller that executes the scheduled
@@ -2330,6 +2482,13 @@ def _run_sharded_soak(
                 rt = inc.runtime(s)
                 if rt is None:
                     continue
+                if (
+                    shadow_registry is not None
+                    and rt.sched.decision_ledger is not None
+                ):
+                    rt.sched.decision_ledger.attach_shadow(
+                        shadow_registry
+                    )
                 snap = rt.sched.snapshot
                 want = np.zeros_like(snap.nodes.requested)
                 for uid, ap in snap._assumed.items():
@@ -2525,6 +2684,46 @@ def _run_sharded_soak(
     stats["faults"] = chaos.fired_counts()
     stats["fault_trace"] = list(chaos.trace)
     chaos.disarm()
+    # decision observatory (decision-observatory PR): sweep every
+    # shard's decision store — the stores outlive the incarnations, so
+    # the full history (kill-restart takeovers included) is here. Per
+    # shard: gap-free per-controller sequences (the takeover's ledger
+    # adopted the dead owner's tail and continued its cseq) and
+    # recompute-replay cleanliness; the canonical per-shard traces ride
+    # the stats for the same-seed / shadow bit-exactness arms.
+    dec_by_shard = {
+        s: sorted(
+            fabric.decision_stores[s].load(),
+            key=lambda r: r.get("seq", 0),
+        )
+        for s in sorted(fabric.decision_stores)
+    }
+    dec_by_shard = {s: recs for s, recs in dec_by_shard.items() if recs}
+    assert dec_by_shard, "no shard recorded any controller decisions"
+    stats["decision_trace"] = {
+        str(s): _sweep_decisions(
+            recs, context=f"sharded-soak shard {s} decisions"
+        )
+        for s, recs in dec_by_shard.items()
+    }
+    stats["decisions_total"] = sum(
+        len(recs) for recs in dec_by_shard.values()
+    )
+    stats["shadow_divergences"] = sum(
+        1
+        for recs in dec_by_shard.values()
+        for r in recs
+        if r.get("shadow", {}).get("diverged")
+    )
+    if doomed_name is not None:
+        # the kill-restart leg left an ADOPTED decision tail: at least
+        # one shard's store carries records from two writer
+        # incarnations, and the gap-free sweep above ran THROUGH the
+        # takeover boundary
+        assert any(
+            len({r.get("incarnation") for r in recs}) >= 2
+            for recs in dec_by_shard.values()
+        ), "kill-restart fired but no shard shows an adopted decision tail"
     stats["owned_final"] = {
         inc.name: inc.owned() for inc in incs if not inc.dead
     }
@@ -2589,6 +2788,7 @@ def run_overload_storm_soak(
     shards: int = 2,
     incarnations: int = 2,
     verbose: bool = False,
+    shadow: bool = False,
 ) -> dict:
     """Overload-control acceptance soak (brownout PR): a seeded arrival
     STORM (``storm_mult``× the base rate, mixed PROD/MID/BATCH/FREE
@@ -2751,6 +2951,40 @@ def run_overload_storm_soak(
         lifecycle=lifecycle,
         clock=_clock,
     )
+    # decision observatory (decision-observatory PR): ONE fleet-level
+    # ledger for the fleet-scoped controllers — ladder, admission,
+    # breaker (attached below, once built). Wired BEFORE the
+    # incarnations are constructed so the runtimes' per-shard ledgers
+    # can't claim the controllers' first-wins slot in _build_runtime;
+    # the per-shard depth records live on fabric.decision_stores as in
+    # every sharded run. ``shadow=True`` is the bit-exactness arm: an
+    # always-diverging shadow consults on EVERY fleet and depth record
+    # without ever acting.
+    from koordinator_tpu.core.journal import (
+        MemoryJournalStore as _DecisionStore,
+    )
+    from koordinator_tpu.obs.decisions import DecisionLedger
+
+    fleet_decisions = DecisionLedger(
+        _DecisionStore(),
+        capacity=4096,
+        incarnation="storm-fleet",
+        clock=_clock,
+    )
+    shadow_registry = None
+    if shadow:
+        from koordinator_tpu.obs.shadow import (
+            AlwaysDivergeShadow,
+            ShadowRegistry,
+        )
+
+        shadow_registry = ShadowRegistry()
+        for _name in ("depth", "brownout", "admission", "breaker"):
+            shadow_registry.attach(_name, AlwaysDivergeShadow())
+        fleet_decisions.attach_shadow(shadow_registry)
+    brownout.attach_decisions(fleet_decisions)
+    admission.attach_decisions(fleet_decisions)
+    topo_ctrl.attach_decisions(fleet_decisions)
 
     def _make_incarnation(idx: int) -> ShardedScheduler:
         inc = ShardedScheduler(
@@ -2791,6 +3025,7 @@ def run_overload_storm_soak(
     service.scheduler.extender.monitor.stop_background()
     server, port = serve(service)
     breaker = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=_clock)
+    breaker.attach_decisions(fleet_decisions)
     client = SolverClient(
         f"127.0.0.1:{port}", timeout_s=5.0, chaos=chaos, breaker=breaker
     )
@@ -3028,6 +3263,16 @@ def run_overload_storm_soak(
                 continue
             for s in inc.owned():
                 rt = inc.runtime(s)
+                if (
+                    shadow_registry is not None
+                    and rt is not None
+                    and rt.sched.decision_ledger is not None
+                ):
+                    # runtimes are born on takeover; attach_shadow is
+                    # first-wins-idempotent per ledger
+                    rt.sched.decision_ledger.attach_shadow(
+                        shadow_registry
+                    )
                 pipe = rt.stream._pipe if rt is not None else None
                 if pipe is not None:
                     depth_cap_samples.append(
@@ -3260,6 +3505,54 @@ def run_overload_storm_soak(
     stats["faults"] = chaos.fired_counts()
     stats["fault_trace"] = list(chaos.trace)
     chaos.disarm()
+    # decision observatory (decision-observatory PR): the storm's whole
+    # control-plane story is on the ledgers — every ladder move,
+    # admission verdict, breaker transition (fleet ledger) and depth
+    # choice (per-shard stores). Swept gap-free + recompute-clean, with
+    # the canonical traces stamped for the same-seed / shadow
+    # bit-exactness arms.
+    fleet_recs = sorted(
+        fleet_decisions.store.load(), key=lambda r: r.get("seq", 0)
+    )
+    assert fleet_recs, "the storm recorded no fleet controller decisions"
+    recorded_controllers = {str(r["controller"]) for r in fleet_recs}
+    assert {"brownout", "admission", "breaker"} <= recorded_controllers, (
+        f"storm fleet ledger is missing controllers: "
+        f"{recorded_controllers}"
+    )
+    shard_recs = {
+        s: sorted(
+            fabric.decision_stores[s].load(),
+            key=lambda r: r.get("seq", 0),
+        )
+        for s in sorted(fabric.decision_stores)
+    }
+    shard_recs = {s: recs for s, recs in shard_recs.items() if recs}
+    assert any(
+        str(r["controller"]) == "depth"
+        for recs in shard_recs.values()
+        for r in recs
+    ), "no per-shard depth decisions recorded under the storm"
+    stats["decision_trace"] = {
+        "fleet": _sweep_decisions(
+            fleet_recs, context="storm fleet decisions"
+        ),
+        "shards": {
+            str(s): _sweep_decisions(
+                recs, context=f"storm shard {s} decisions"
+            )
+            for s, recs in shard_recs.items()
+        },
+    }
+    stats["decisions_total"] = len(fleet_recs) + sum(
+        len(recs) for recs in shard_recs.values()
+    )
+    stats["shadow_divergences"] = sum(
+        1
+        for recs in [fleet_recs, *shard_recs.values()]
+        for r in recs
+        if r.get("shadow", {}).get("diverged")
+    )
     for inc in incs:
         if not inc.dead:
             inc.close()

@@ -4,7 +4,15 @@ The fast subset (tier-1) runs a shortened soak covering every fault
 domain — RPC drops + generation-gap resync, watch disconnects, solver
 dispatch failure, NaN quarantine, deadline deferral, one mid-commit
 crash — and the determinism contract (same seed ⇒ same fault trace).
-The ≥200-cycle acceptance soak is marked ``slow``."""
+The ≥200-cycle acceptance soak is marked ``slow``.
+
+Decision observatory (decision-observatory PR): every soak sweeps its
+decision ledgers in-run (gap-free per-controller sequences across the
+kill-restart's adopted tail, recompute-replay cleanliness) and stamps
+the canonical ``decision_trace``. The same-seed pairs here run their
+SECOND leg with an always-diverging shadow attached — same-seed ⇒
+bit-identical decision traces AND a shadow can never perturb the
+acting schedule, proved in one comparison."""
 
 import pytest
 
@@ -43,16 +51,45 @@ def test_chaos_soak_fast_subset():
     assert trace[-1] == 2, "depth never recovered in the quiet tail"
     first_one = trace.index(1)
     assert all(d == 2 for d in trace[:first_one]), trace
+    # decision observatory (decision-observatory PR): the gap-free and
+    # recompute-replay sweeps ran INSIDE the soak; here the recorded
+    # ledger must also replay clean through the OFFLINE tool — exit 0
+    # means every recorded action reproduced bit-exactly from its
+    # snapshot (the counterfactual-replay entry point works on real
+    # soak output, not just synthetic ledgers)
+    assert stats["decisions_total"] == len(stats["decision_trace"]) > 0
+    import json as _json
+    import os
+    import tempfile
+
+    from tools.decision_replay import main as replay_main
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "decisions.json")
+        with open(path, "w", encoding="utf-8") as f:
+            _json.dump({"records": stats["decision_trace"]}, f)
+        assert replay_main(["--ledger", path]) == 0
 
 
 @pytest.mark.chaos
 def test_chaos_soak_same_seed_same_fault_trace():
     a = run_chaos_soak(cycles=25, seed=11, n_nodes=10, max_arrivals=5)
-    b = run_chaos_soak(cycles=25, seed=11, n_nodes=10, max_arrivals=5)
+    # the second leg runs with an ALWAYS-diverging shadow consulting on
+    # every depth record: same seed must still yield a bit-identical
+    # schedule and decision trace (a shadow can never act), while the
+    # shadow's own divergences prove it really was consulted
+    b = run_chaos_soak(
+        cycles=25, seed=11, n_nodes=10, max_arrivals=5, shadow=True
+    )
     assert a["fault_trace"] == b["fault_trace"]
     assert a["faults"] == b["faults"]
     # the adaptive-depth trace is part of the deterministic contract
     assert a["depth_trace"] == b["depth_trace"]
+    # decision observatory: same seed ⇒ bit-identical decision traces
+    # (seq, cseq, tick, full input snapshots, actions, states)
+    assert a["decision_trace"] == b["decision_trace"]
+    assert a["shadow_divergences"] == 0
+    assert b["shadow_divergences"] == b["decisions_total"] > 0
     c = run_chaos_soak(cycles=25, seed=12, n_nodes=10, max_arrivals=5)
     assert c["fault_trace"] != a["fault_trace"]
 
@@ -91,6 +128,14 @@ def test_chaos_soak_ha_failover_arm():
     assert stats["checkpoint_fallbacks"] >= 1
     assert stats["scrub_divergence"].get("nodes", 0) >= 1
     assert stats["crash_restarts"] == 1
+    # decision observatory (decision-observatory PR): the fresh
+    # incarnation's ledger ADOPTED the dead writer's decision tail from
+    # the shared store — the trace shows both writers, and the in-soak
+    # sweep asserted the depth controller's sequence is gap-free
+    # THROUGH the kill
+    assert len(
+        {r["incarnation"] for r in stats["decision_trace"]}
+    ) >= 2, "decision trace does not span the crash-restart"
     # journal_fsck round-trips the soak's POST-CORRUPTION journal: the
     # dump (quarantined records included) repairs to a clean file whose
     # replay reconstructs exactly the soak's acknowledged live set
@@ -126,12 +171,19 @@ def test_chaos_soak_ha_same_seed_same_trace():
     a = run_chaos_soak(
         cycles=20, seed=13, n_nodes=10, max_arrivals=5, ha=True
     )
+    # shadow-attached second leg (decision-observatory PR): bit-exact
+    # through the crash-restart + takeover too
     b = run_chaos_soak(
-        cycles=20, seed=13, n_nodes=10, max_arrivals=5, ha=True
+        cycles=20, seed=13, n_nodes=10, max_arrivals=5, ha=True,
+        shadow=True,
     )
     assert a["fault_trace"] == b["fault_trace"]
     assert a["takeovers"] == b["takeovers"]
     assert a["placed"] == b["placed"]
+    # decision observatory: the decision trace — including the dead
+    # incarnation's adopted tail — is part of the deterministic contract
+    assert a["decision_trace"] == b["decision_trace"]
+    assert a["shadow_divergences"] == 0 and b["shadow_divergences"] > 0
     # the corruption arms are part of the deterministic contract too
     for key in (
         "journal_corrupt_quarantined", "journal_seq_gaps",
@@ -200,6 +252,14 @@ def test_chaos_soak_multi_shard_arm():
     assert stats["journal_seq_gaps"] >= 1
     assert stats["checkpoint_fallbacks"] >= 1
     assert stats["scrub_divergence"].get("nodes", 0) >= 1
+    # decision observatory (decision-observatory PR): at least one
+    # shard's decision trace spans both the dead owner and its takeover
+    # (the in-soak sweep asserted every shard's per-controller sequence
+    # is gap-free THROUGH the ownership boundary)
+    assert any(
+        len({r["incarnation"] for r in recs}) >= 2
+        for recs in stats["decision_trace"].values()
+    ), "no shard's decision trace spans the kill-restart takeover"
 
 
 @pytest.mark.chaos
@@ -209,11 +269,16 @@ def test_chaos_soak_multi_shard_same_seed_same_trace():
         shards=3, incarnations=3,
     )
     a = run_chaos_soak(**kw)
-    b = run_chaos_soak(**kw)
+    # shadow-attached second leg (decision-observatory PR): bit-exact
+    # across shard handoffs, the kill-restart and the split/merge
+    b = run_chaos_soak(**kw, shadow=True)
     assert a["fault_trace"] == b["fault_trace"]
     assert a["placed"] == b["placed"]
     assert a["takeovers"] == b["takeovers"]
     assert a["recovered_bindings"] == b["recovered_bindings"]
+    # decision observatory: per-shard decision traces bit-identical
+    assert a["decision_trace"] == b["decision_trace"]
+    assert a["shadow_divergences"] == 0 and b["shadow_divergences"] > 0
     c = run_chaos_soak(**{**kw, "seed": 12})
     assert c["fault_trace"] != a["fault_trace"]
 
@@ -285,6 +350,17 @@ def test_overload_storm_soak_fast_arm():
     assert stats["breaker_fast_fails"] >= 1
     points = {p for _s, p, _k in stats["fault_trace"]}
     assert "channel.breaker_storm" in points
+    # decision observatory (decision-observatory PR): the whole storm
+    # story is on the ledgers — every ladder move, admission verdict
+    # and breaker transition on the fleet ledger, every depth choice on
+    # the per-shard stores (swept gap-free + recompute-clean in-soak)
+    fleet = {r["controller"] for r in stats["decision_trace"]["fleet"]}
+    assert {"brownout", "admission", "breaker"} <= fleet
+    assert any(
+        r["controller"] == "depth"
+        for recs in stats["decision_trace"]["shards"].values()
+        for r in recs
+    )
 
 
 @pytest.mark.chaos
@@ -293,12 +369,18 @@ def test_overload_storm_soak_same_seed_same_trace():
 
     kw = dict(cycles=32, seed=11, n_nodes=16, base_arrivals=3)
     a = run_overload_storm_soak(**kw)
-    b = run_overload_storm_soak(**kw)
+    # shadow-attached second leg (decision-observatory PR): an
+    # always-diverging shadow consults on every ladder move, admission
+    # verdict, breaker transition and depth choice — the storm's
+    # schedule and decision traces must stay bit-identical
+    b = run_overload_storm_soak(**kw, shadow=True)
     for key in (
         "fault_trace", "level_trace", "shed_counts", "placed",
         "arrived", "shed_terminal", "tickets_redeemed",
+        "decision_trace", "decisions_total",
     ):
         assert a[key] == b[key], key
+    assert a["shadow_divergences"] == 0 and b["shadow_divergences"] > 0
     c = run_overload_storm_soak(**{**kw, "seed": 12})
     assert (
         c["fault_trace"] != a["fault_trace"]

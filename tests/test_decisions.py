@@ -1,0 +1,679 @@
+"""Control-plane decision observatory tests (decision-ledger PR).
+
+Covers: the crash-surviving :class:`obs.decisions.DecisionLedger`
+(seq/cseq stamping, dead-writer tail adoption over a shared journal
+store, the 2x-capacity store compaction bound, storage-failure
+containment); the shadow-policy harness (proposals recorded + diffed,
+``shadow_divergence_total``, a shadow can neither act nor perturb the
+acting controller's evidence); the complete-input-snapshot contract for
+all FIVE controllers — every recorded decision is recomputed from its
+RECORDED inputs alone, after a JSON round-trip, and must reproduce
+bit-exactly; the ``/debug/decisions`` surfaces (ServicesEngine + the
+fleet's per-shard aggregation); and the ``tools/decision_replay.py``
+offline counterfactual replay (self-replay exit 0, drift exit 1,
+candidate-policy divergence reports, reward sums).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.extension import PriorityClass
+from koordinator_tpu.api.types import Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from koordinator_tpu.core.journal import MemoryJournalStore
+from koordinator_tpu.obs.decisions import (
+    DecisionLedger,
+    action_label,
+    controller_gaps,
+    decision_trace,
+)
+from koordinator_tpu.obs.shadow import (
+    NO_PROPOSAL,
+    AlwaysDivergeShadow,
+    MirrorShadow,
+    ShadowPolicy,
+    ShadowRegistry,
+)
+from koordinator_tpu.runtime.elastic import TopologyController
+from koordinator_tpu.runtime.overload import (
+    AdmissionController,
+    BrownoutController,
+    CircuitBreaker,
+    OverloadConfig,
+)
+from koordinator_tpu.runtime.shards import ShardFabric
+from koordinator_tpu.scheduler.batch_solver import BatchScheduler, LoadAwareArgs
+from koordinator_tpu.scheduler.pipeline import _DepthController
+from koordinator_tpu.utils.metrics import Registry
+from tools.decision_replay import deterministic_policies, load_records, replay
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+class FakeSlo:
+    """Per-(shard, metric) burn rates, settable by the test."""
+
+    def __init__(self):
+        self.burns = {}
+
+    def set_burn(self, shard, burn):
+        self.burns[int(shard)] = float(burn)
+
+    def burn_rate(self, shard, metric):
+        return self.burns.get(int(shard), 0.0)
+
+    def evaluate(self):
+        return {s: {} for s in self.burns}
+
+
+PRIO = {
+    PriorityClass.PROD: 9000,
+    PriorityClass.MID: 7500,
+    PriorityClass.BATCH: 5500,
+    PriorityClass.FREE: 3500,
+}
+
+
+def _pod(name, band=PriorityClass.BATCH):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name),
+        spec=PodSpec(
+            requests={ext.RES_CPU: 1000.0, ext.RES_MEMORY: 2048.0},
+            priority=PRIO[band],
+        ),
+    )
+
+
+def _roundtrip(records):
+    """The wire shape: what the journal store / replay tool sees."""
+    return json.loads(json.dumps(records))
+
+
+def _recompute_all(records):
+    """The complete-input-snapshot contract: every recorded decision
+    must be reproducible from its RECORDED inputs alone — through the
+    acting controller's own pure decide(), after a JSON round-trip."""
+    deciders = deterministic_policies()
+    assert records, "no decisions recorded"
+    for rec in _roundtrip(records):
+        action, _state = deciders[rec["controller"]](rec["inputs"])
+        assert action == rec["action"], (
+            f"{rec['controller']} cseq={rec['cseq']}: recorded "
+            f"{rec['action']} but inputs recompute to {action}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the ledger core
+# ---------------------------------------------------------------------------
+
+
+class TestDecisionLedgerCore:
+    def test_record_stamps_seq_cseq_shard_and_outcome(self):
+        clk = FakeClock(5.0)
+        dl = DecisionLedger(shard=3, incarnation="inc-a", clock=clk)
+        r1 = dl.record("depth", 1, {"x": 1}, {"depth": 2}, {"depth": 2})
+        r2 = dl.record(
+            "brownout", 1, {"burn": 0.5}, {"op": "hold", "to": 0},
+            {"level": 0}, outcome={"burn": 0.5},
+        )
+        r3 = dl.record(
+            "depth", 2, {"x": 2}, {"depth": 1}, {"depth": 1}, shard=7
+        )
+        assert [r["seq"] for r in (r1, r2, r3)] == [1, 2, 3]
+        assert (r1["cseq"], r2["cseq"], r3["cseq"]) == (1, 1, 2)
+        assert r1["shard"] == 3 and r3["shard"] == 7  # explicit wins
+        assert r1["incarnation"] == "inc-a" and r1["t"] == 5.0
+        assert r2["outcome"] == {"burn": 0.5} and "outcome" not in r1
+        assert dl.last(1) == [r3] and len(dl.last()) == 3
+        assert controller_gaps(dl.last()) == {}
+
+    def test_action_label_vocabulary(self):
+        assert action_label({"op": "escalate", "to": 2}) == "escalate"
+        assert action_label({"verdict": "shed"}) == "shed"
+        assert action_label({"depth": 4}) == "depth=4"
+        assert action_label({"weird": 1}) == "other"
+        assert action_label("raw") == "raw"
+
+    def test_metrics_count_decisions_per_controller_and_action(self):
+        reg = Registry()
+        dl = DecisionLedger()
+        dl.bind_registry(reg)
+        dl.bind_registry(Registry())  # first caller wins
+        dl.record("depth", 1, {}, {"depth": 2}, {})
+        dl.record("depth", 2, {}, {"depth": 2}, {})
+        dl.record("brownout", 1, {}, {"op": "hold", "to": 0}, {})
+        ct = reg.get("controller_decisions_total")
+        assert ct.value(controller="depth", action="depth=2") == 2.0
+        assert ct.value(controller="brownout", action="hold") == 1.0
+
+    def test_takeover_adopts_tail_and_continues_cseq(self):
+        store = MemoryJournalStore()
+        a = DecisionLedger(store, incarnation="inc-a")
+        for i in range(3):
+            a.record("depth", i + 1, {"i": i}, {"depth": 1}, {})
+        a.record("brownout", 1, {}, {"op": "hold", "to": 0}, {})
+        # inc-a dies; inc-b adopts the shared store's tail
+        b = DecisionLedger(store, incarnation="inc-b")
+        assert len(b.last()) == 4
+        rec = b.record("depth", 4, {"i": 3}, {"depth": 1}, {})
+        assert rec["seq"] == 5 and rec["cseq"] == 4  # continues, no gap
+        assert controller_gaps(b.last()) == {}
+        adopted = b.recovered_records()
+        assert len(adopted) == 4
+        assert all(r["incarnation"] == "inc-a" for r in adopted)
+        doc = json.loads(b.render())
+        assert doc["decisions"] == 5 and doc["recovered"] == 4
+        assert doc["records"][0]["recovered"] is True
+        assert doc["records"][-1]["recovered"] is False
+
+    def test_store_compaction_bounded_by_2x_capacity(self):
+        store = MemoryJournalStore()
+        dl = DecisionLedger(store, capacity=8)
+        for i in range(100):
+            dl.record("depth", i + 1, {"i": i}, {"depth": 1}, {})
+        assert len(dl.last()) == 8  # ring holds the tail
+        assert len(store.load()) <= 2 * 8  # compaction keeps the bound
+        # and the survivors are the NEWEST records
+        survived = sorted(r["seq"] for r in store.load())
+        assert survived[-1] == 100
+
+    def test_storage_failure_degrades_to_ring_only(self):
+        class BadStore:
+            def load(self):
+                return []
+
+            def append(self, rec):
+                raise IOError("disk gone")
+
+            def rewrite(self, recs):
+                raise IOError("disk gone")
+
+        dl = DecisionLedger(BadStore())
+        rec = dl.record("depth", 1, {}, {"depth": 1}, {})
+        assert rec["seq"] == 1 and dl.last() == [rec]
+
+    def test_controller_gaps_flags_holes_and_duplicates(self):
+        ok = [
+            {"controller": "a", "cseq": 2},
+            {"controller": "a", "cseq": 3},
+            {"controller": "b", "cseq": 1},
+        ]
+        assert controller_gaps(ok) == {}
+        hole = ok + [{"controller": "a", "cseq": 6}]
+        assert controller_gaps(hole) == {"a": [4, 5]}
+        dupe = ok + [{"controller": "b", "cseq": 1}]
+        assert "b" in controller_gaps(dupe)
+
+    def test_decision_trace_drops_only_wall_time_shadow_and_crc(self):
+        dl = DecisionLedger(incarnation="inc-a")
+        dl.attach_shadow(ShadowRegistry())
+        dl.shadow.attach("depth", AlwaysDivergeShadow())
+        dl.record("depth", 1, {"x": 1}, {"depth": 2}, {"depth": 2})
+        (proj,) = decision_trace(dl.last())
+        assert "t" not in proj and "shadow" not in proj
+        assert proj["inputs"] == {"x": 1} and proj["cseq"] == 1
+        assert proj["incarnation"] == "inc-a"
+        # store-loaded records carry the codec's crc seal on top; the
+        # trace drops it too (the crc covers t/shadow, so it inherits
+        # their run-to-run variance) — the same record projects
+        # identically from the ring and from the store
+        (sproj,) = decision_trace(dl.store.load())
+        assert "crc" not in sproj
+        assert sproj == proj
+
+
+# ---------------------------------------------------------------------------
+# the shadow harness
+# ---------------------------------------------------------------------------
+
+
+class TestShadowHarness:
+    def _ledger(self, reg=None):
+        dl = DecisionLedger()
+        if reg is not None:
+            dl.bind_registry(reg)
+        dl.attach_shadow(ShadowRegistry())
+        return dl
+
+    def test_divergence_recorded_and_counted(self):
+        reg = Registry()
+        dl = self._ledger(reg)
+        dl.shadow.attach("depth", AlwaysDivergeShadow())
+        rec = dl.record("depth", 1, {"x": 1}, {"depth": 2}, {})
+        assert rec["shadow"]["diverged"] is True
+        assert rec["shadow"]["proposal"] == {"op": "__shadow_diverge__"}
+        assert reg.get("shadow_divergence_total").value(
+            controller="depth"
+        ) == 1.0
+
+    def test_mirror_shadow_agrees(self):
+        reg = Registry()
+        dl = self._ledger(reg)
+        dl.shadow.attach("depth", MirrorShadow(_DepthController.decide))
+        inputs = {
+            "max_depth": 4, "depth": 4, "window": [], "discard_rate": 0.0,
+            "quiet_feeds": 0,
+        }
+        action, state = _DepthController.decide(inputs)
+        rec = dl.record("depth", 1, inputs, action, state)
+        assert rec["shadow"]["diverged"] is False
+        assert rec["shadow"]["proposal"] == action
+        assert reg.get("shadow_divergence_total").value(
+            controller="depth"
+        ) == 0.0
+
+    def test_shadow_sees_a_copy_never_the_acting_evidence(self):
+        class Mutator(ShadowPolicy):
+            def propose(self, inputs):
+                inputs["window"].append(False)  # vandalize the snapshot
+                return {"depth": 1}
+
+        dl = self._ledger()
+        dl.shadow.attach("depth", Mutator())
+        inputs = {"window": [True]}
+        rec = dl.record("depth", 1, inputs, {"depth": 2}, {})
+        assert inputs == {"window": [True]}  # acting evidence untouched
+        assert rec["inputs"] is inputs
+
+    def test_shadow_crash_is_contained(self):
+        class Crasher(ShadowPolicy):
+            def propose(self, inputs):
+                raise RuntimeError("candidate policy bug")
+
+        dl = self._ledger()
+        dl.shadow.attach("depth", Crasher())
+        rec = dl.record("depth", 1, {}, {"depth": 1}, {})
+        assert "shadow" not in rec  # dropped, never raised
+
+    def test_unregistered_controller_gets_no_shadow_annotation(self):
+        dl = self._ledger()
+        dl.shadow.attach("depth", AlwaysDivergeShadow())
+        rec = dl.record("brownout", 1, {}, {"op": "hold", "to": 0}, {})
+        assert "shadow" not in rec
+
+    def test_registry_attach_detach(self):
+        sr = ShadowRegistry()
+        assert sr.propose("depth", {}) is NO_PROPOSAL
+        sr.attach("depth", AlwaysDivergeShadow())
+        assert "depth" in sr.policies()
+        assert sr.propose("depth", {}) == {"op": "__shadow_diverge__"}
+        sr.detach("depth")
+        assert sr.propose("depth", {}) is NO_PROPOSAL
+
+
+# ---------------------------------------------------------------------------
+# the complete-input-snapshot contract, per controller
+# ---------------------------------------------------------------------------
+
+
+class TestControllersRecordCompleteInputs:
+    def test_depth_controller(self):
+        dc = _DepthController(max_depth=4)
+        dc.decisions = DecisionLedger()
+        # churn: degrade to 1; then a quiet stretch restores the ceiling
+        for kept in (False, False, True, False, False, True):
+            dc.note_outcome(kept)
+            dc.choose()
+            dc.note_feed(had_discard=not kept)
+        assert dc.depth == 1
+        for _ in range(_DepthController.QUIET_FEEDS):
+            dc.note_feed(had_discard=False)
+        assert dc.choose() == 4
+        recs = dc.decisions.last()
+        assert [r["tick"] for r in recs] == list(range(1, len(recs) + 1))
+        assert {"max_depth", "depth", "window", "discard_rate",
+                "quiet_feeds"} <= set(recs[0]["inputs"])
+        _recompute_all(recs)
+
+    def test_brownout_controller(self):
+        slo = FakeSlo()
+        bo = BrownoutController(
+            slo, shards=lambda: [0], thresholds=(1.0, 2.0, 4.0, 8.0),
+            sustain=2, cooldown=2, clock=FakeClock(),
+        )
+        bo.attach_decisions(DecisionLedger())
+        burns = [0.0, 1.5, 1.5, 1.5, 2.5, 2.5, 0.1, 0.1, 0.1, 0.1, 0.0]
+        for cycle, burn in enumerate(burns):
+            slo.set_burn(0, burn)
+            bo.tick(cycle=cycle)
+        assert bo.stats["escalations"] >= 2
+        assert bo.stats["deescalations"] >= 1
+        recs = bo.decisions.last()
+        assert len(recs) == len(burns)
+        ops = [r["action"]["op"] for r in recs]
+        assert "escalate" in ops and "deescalate" in ops
+        # burns recorded RAW: the exact float the threshold compared
+        assert recs[1]["inputs"]["burn"] == 1.5
+        _recompute_all(recs)
+
+    def test_admission_controller(self):
+        clk = FakeClock()
+        slo = FakeSlo()
+        bo = BrownoutController(
+            slo, shards=lambda: [0], sustain=1, clock=clk
+        )
+        ac = AdmissionController(
+            OverloadConfig(
+                band_budget={PriorityClass.BATCH: 2,
+                             PriorityClass.FREE: 1},
+            ),
+            brownout=bo,
+            clock=clk,
+        )
+        dl = DecisionLedger()
+        ac.attach_decisions(dl)
+        bo.attach_decisions(dl)
+        assert ac.admit(_pod("p", PriorityClass.PROD), 99) == "admit"
+        assert ac.admit(_pod("b0"), 0, shard=1) == "admit"
+        assert ac.admit(_pod("b1"), 2) == "defer"  # budget breach
+        # push the ladder to L4: FREE sheds, BATCH defers
+        slo.set_burn(0, 100.0)
+        for cycle in range(4):
+            bo.tick(cycle=cycle)
+        assert bo.level == BrownoutController.L4
+        assert ac.admit(_pod("f0", PriorityClass.FREE), 0) == "shed"
+        assert ac.admit(_pod("b2"), 0) == "defer"
+        recs = dl.last()
+        adm = [r for r in recs if r["controller"] == "admission"]
+        assert [r["action"]["verdict"] for r in adm] == [
+            "admit", "admit", "defer", "shed", "defer",
+        ]
+        assert adm[1]["shard"] == 1 and "shard" not in adm[0]
+        assert controller_gaps(recs) == {}
+        _recompute_all(recs)
+
+    def test_circuit_breaker(self):
+        clk = FakeClock()
+        cb = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=clk)
+        cb.attach_decisions(DecisionLedger())
+        assert cb.allow()
+        cb.record_failure()
+        cb.record_failure()           # trips OPEN
+        assert not cb.allow()         # fail fast
+        clk.tick(10.0)
+        assert cb.allow()             # the half-open probe
+        assert not cb.allow()         # behind the probe: deny
+        cb.record_success()           # probe heals: CLOSED
+        assert cb.allow()
+        recs = cb.decisions.last()
+        ops = [r["action"]["op"] for r in recs]
+        assert ops == [
+            "allow", "count_failure", "trip", "deny", "allow", "deny",
+            "close", "allow",
+        ]
+        probe = recs[4]
+        assert probe["action"]["probe"] is True
+        assert probe["inputs"]["cooldown_elapsed"] is True
+        _recompute_all(recs)
+
+    def test_topology_controller(self):
+        clk = FakeClock()
+        fabric = ShardFabric(2, clock=clk)
+        slo = FakeSlo()
+        # max_shards == active count: decide records the full streak
+        # bookkeeping but never proposes a split this world can't take
+        tc = TopologyController(
+            fabric, slo, sustain=2, cooldown=2, max_shards=2,
+            split_burn=1.0, merge_burn=0.05,
+        )
+        tc.attach_decisions(DecisionLedger())
+        for burn0, burn1 in [(2.0, 0.0), (2.0, 0.0), (0.5, 0.5), (0.0, 0.0)]:
+            slo.set_burn(0, burn0)
+            slo.set_burn(1, burn1)
+            tc.tick()
+        recs = tc.decisions.last()
+        assert len(recs) == 4
+        # hot streak accumulated from the RECORDED burns
+        assert recs[1]["state"]["hot"] == {0: 2}
+        assert recs[0]["inputs"]["burns"] == {0: 2.0, 1: 0.0}
+        _recompute_all(recs)
+
+    def test_topology_decide_proposes_split_and_merge(self):
+        # the pure policy over synthetic wire-shaped (string-keyed)
+        # snapshots: capacity -> split hottest; all-cold siblings -> merge
+        base = {
+            "active": [0, 1], "hot": {}, "cold": {},
+            "in_cooldown": False, "siblings": [[0, 1]],
+            "max_shards": 8, "sustain": 1,
+            "split_burn": 1.0, "merge_burn": 0.05,
+        }
+        action, _ = TopologyController.decide(
+            dict(base, burns={"0": 3.0, "1": 9.0})
+        )
+        assert action == {"op": "split", "shard": 1}
+        action, _ = TopologyController.decide(
+            dict(base, burns={"0": 0.0, "1": 0.0})
+        )
+        assert action == {"op": "merge", "pair": [0, 1]}
+        action, _ = TopologyController.decide(
+            dict(base, burns={"0": 9.0, "1": 0.0}, in_cooldown=True)
+        )
+        assert action == {"op": "none"}
+
+
+# ---------------------------------------------------------------------------
+# /debug/decisions surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestDebugEndpoints:
+    def _sched(self):
+        s = BatchScheduler(
+            args=LoadAwareArgs(usage_thresholds={}), batch_bucket=16
+        )
+        s.extender.monitor.stop_background()
+        for i in range(4):
+            s.snapshot.upsert_node(
+                Node(
+                    meta=ObjectMeta(name=f"n{i}"),
+                    status=NodeStatus(allocatable={
+                        ext.RES_CPU: 16_000.0, ext.RES_MEMORY: 65_536.0,
+                    }),
+                )
+            )
+        return s
+
+    def test_services_engine_endpoint(self):
+        sched = self._sched()
+        eng = sched.extender.services
+        assert eng.dispatch("GET", "/debug/decisions")[0] == 404
+        dl = DecisionLedger(incarnation="inc-a")
+        sched.attach_decision_ledger(dl)
+        assert dl._registry is sched.extender.registry  # counting wired
+        dl.record("depth", 1, {"x": 1}, {"depth": 2}, {"depth": 2})
+        code, body = eng.dispatch("GET", "/debug/decisions")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["decisions"] == 1 and doc["incarnation"] == "inc-a"
+        assert doc["records"][0]["action"] == {"depth": 2}
+
+    def test_attach_wires_flight_recorder_through_ledger(self):
+        from koordinator_tpu.obs.flightrecorder import FlightRecorder
+
+        sched = self._sched()
+        fr = FlightRecorder(capacity=8, incarnation="inc-a")
+        sched.attach_flight_recorder(fr)
+        dl = DecisionLedger(incarnation="inc-a")
+        sched.attach_decision_ledger(dl)
+        assert fr in dl._flights  # single attachment point
+        dl.flight_record(cycle=7, brownout={"from": 0, "to": 1, "burn": 2.0})
+        assert fr.last(1)[0]["brownout"]["to"] == 1
+
+    def test_fleet_surface_serves_every_owned_shard(self):
+        from koordinator_tpu.obs.lifecycle import PodLifecycle
+        from koordinator_tpu.obs.slo import SloTracker
+        from koordinator_tpu.runtime.shards import ShardedScheduler
+        from koordinator_tpu.runtime.statehub import ClusterStateHub
+
+        t = [0.0]
+        fabric = ShardFabric(2, clock=lambda: t[0], membership_ttl_s=2.5)
+        hub = ClusterStateHub()
+        for i in range(8):
+            hub.publish(hub.nodes, Node(
+                meta=ObjectMeta(name=f"n{i:03d}"),
+                status=NodeStatus(allocatable={
+                    ext.RES_CPU: 16_000.0, ext.RES_MEMORY: 65_536.0,
+                }),
+            ))
+
+        def factory(shard, snapshot, fence, journal):
+            s = BatchScheduler(
+                snapshot, LoadAwareArgs(usage_thresholds={}),
+                batch_bucket=16, journal=journal, fence=fence,
+            )
+            s.extender.monitor.stop_background()
+            return s
+
+        inc = ShardedScheduler(
+            "inc-a", hub, fabric, factory, max_batch=16,
+            lease_duration=3.0, renew_deadline=2.0, retry_period=0.5,
+            lifecycle=PodLifecycle(registry=Registry(),
+                                   clock=lambda: t[0]),
+            slo=SloTracker(clock=lambda: t[0]),
+        )
+        fabric.membership.heartbeat("inc-a")
+        for _ in range(2):
+            t[0] += 1.0
+            inc.tick()
+        try:
+            assert set(inc.owned()) == {0, 1}
+            for s in (0, 1):
+                dl = inc._runtimes[s].sched.decision_ledger
+                assert dl is not None and dl.shard == s
+                assert dl.incarnation == "inc-a"
+                # the per-shard ledger persists over the fabric's
+                # decision store — the surface a takeover adopts from
+                assert dl.store is fabric.decision_stores[s]
+                dl.record("depth", 1, {"s": s}, {"depth": 1}, {})
+            code, body = inc.fleet().dispatch("GET", "/debug/decisions")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["incarnation"] == "inc-a"
+            assert set(doc["shards"]) == {"0", "1"}
+            for s in (0, 1):
+                row = doc["shards"][str(s)]
+                assert row["decisions"] == 1
+                assert row["records"][0]["inputs"] == {"s": s}
+            # disabled fleet: no ledgers, an empty (not erroring) doc
+            inc2 = ShardedScheduler(
+                "inc-b", hub, ShardFabric(1, clock=lambda: t[0]),
+                factory, max_batch=16, decisions=False,
+                lease_duration=3.0, renew_deadline=2.0,
+                retry_period=0.5,
+            )
+            code, body = inc2.fleet().dispatch(
+                "GET", "/debug/decisions"
+            )
+            assert code == 200 and json.loads(body)["shards"] == {}
+        finally:
+            inc.close()
+
+
+# ---------------------------------------------------------------------------
+# offline counterfactual replay (tools/decision_replay.py)
+# ---------------------------------------------------------------------------
+
+
+class TestDecisionReplay:
+    def _recorded_ledger(self):
+        """A real multi-controller trace: depth churn + a brownout
+        episode, all on one ledger."""
+        dl = DecisionLedger(incarnation="inc-a")
+        dc = _DepthController(max_depth=4)
+        dc.decisions = dl
+        for kept in (False, False, True, False, True, True):
+            dc.note_outcome(kept)
+            dc.choose()
+        slo = FakeSlo()
+        bo = BrownoutController(
+            slo, shards=lambda: [0], sustain=1, cooldown=1,
+            clock=FakeClock(),
+        )
+        bo.attach_decisions(dl)
+        for cycle, burn in enumerate([0.0, 3.0, 3.0, 0.0, 0.0]):
+            slo.set_burn(0, burn)
+            bo.tick(cycle=cycle)
+        return dl
+
+    def test_self_replay_exits_zero(self, tmp_path, capsys):
+        from tools.decision_replay import main
+
+        dl = self._recorded_ledger()
+        path = tmp_path / "decisions.json"
+        path.write_text(dl.render())
+        assert main(["--ledger", str(path)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["mode"] == "self" and doc["diverged"] == 0
+        for row in doc["controllers"].values():
+            assert row["agreement_pct"] == 100.0
+        # the brownout outcome burns summed as reward inputs
+        assert doc["reward"]["burn"] == pytest.approx(6.0)
+
+    def test_tampered_action_is_determinism_drift_exit_1(
+        self, tmp_path, capsys
+    ):
+        from tools.decision_replay import main
+
+        dl = self._recorded_ledger()
+        doc = json.loads(dl.render())
+        doc["records"][2]["action"] = {"depth": 999}
+        path = tmp_path / "tampered.json"
+        path.write_text(json.dumps(doc))
+        assert main(["--ledger", str(path)]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["diverged"] == 1
+
+    def test_candidate_policy_divergence_report(self):
+        dl = self._recorded_ledger()
+        records = _roundtrip(dl.last())
+        policies = dict(deterministic_policies())
+        policies["depth"] = lambda inputs: {"depth": 999}  # bare action
+        report = replay(records, policies)
+        depth = report["controllers"]["depth"]
+        assert depth["agreed"] == 0 and depth["agreement_pct"] == 0.0
+        fd = depth["first_divergence"]
+        assert fd["proposed"] == {"depth": 999} and fd["cseq"] == 1
+        assert fd["inputs"]  # the full snapshot rides in the report
+        # the acting brownout policy still agrees with itself
+        assert report["controllers"]["brownout"]["agreement_pct"] == 100.0
+        assert report["diverged"] == depth["total"]
+
+    def test_load_records_accepts_all_three_shapes(self):
+        recs = [{"controller": "depth", "cseq": 1}]
+        assert load_records(recs) == recs
+        assert load_records({"records": recs}) == recs
+        fleet_doc = {
+            "shards": {
+                "0": {"records": recs},
+                "1": {"records": recs},
+            }
+        }
+        assert load_records(fleet_doc) == recs + recs
+        with pytest.raises(ValueError):
+            load_records({"what": 1})
+
+    def test_unknown_controller_records_are_skipped_not_fatal(self):
+        report = replay([
+            {"controller": "mystery", "inputs": {}, "action": {}},
+        ])
+        assert report["skipped"] == 1 and report["diverged"] == 0

@@ -84,7 +84,7 @@ def test_cli_exits_zero_on_tree(capsys):
     rc = cli_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "0 finding(s)" in out and "11 passes" in out
+    assert "0 finding(s)" in out and "12 passes" in out
 
 
 # ---------------------------------------------------------------------------
@@ -239,6 +239,22 @@ FIXTURES = {
             """,
         },
         "SP001",
+    ),
+    "decision-ledger": (
+        {
+            # a brand-new controller whose tick() moves control state
+            # without recording on the decision ledger: invisible to the
+            # decision observatory until it joins CONTROLLER_SITES (or
+            # EXEMPT, with a written reason)
+            "koordinator_tpu/runtime/novel.py": """
+            class NovelController:
+                def tick(self):
+                    if self._hot >= self.sustain:
+                        self._level += 1
+                    self._hot += 1
+            """,
+        },
+        "DL002",
     ),
     "store-integrity": (
         {

@@ -290,3 +290,85 @@ class TestOverloadDisabledMode:
         reg = s.extender.registry
         assert reg.get("overload_shed_total").value(band="FREE") == 0.0
         assert reg.get("brownout_level").value() == 0.0
+
+
+class TestDecisionLedgerDisabledMode:
+    """Decision-observatory PR: with no DecisionLedger wired, every
+    controller record site is ONE attribute-is-None check — no snapshot
+    copies for shadows, no store writes, no metric labels. With one
+    wired, memory is bounded: the ring holds ``capacity`` records and
+    store compaction keeps the journal under the 2x-capacity rewrite
+    bound even through a storm-shaped burst."""
+
+    def test_record_sites_guard_on_attribute_is_none(self):
+        """Every controller record site reads ``self.decisions`` into a
+        local ``dl`` and branches on ``is not None`` — the same
+        one-check discipline as the devprof/overload sites."""
+        import inspect
+
+        from koordinator_tpu.runtime import elastic, overload
+        from koordinator_tpu.scheduler import pipeline
+
+        for mod, min_sites in ((pipeline, 1), (overload, 3), (elastic, 1)):
+            src = inspect.getsource(mod)
+            reads = src.count("dl = self.decisions")
+            # attach_flight's wiring path branches on the opposite
+            # polarity (creates the default ledger); every read still
+            # pairs with exactly one is-None branch
+            guards = src.count("if dl is not None") + src.count(
+                "if dl is None"
+            )
+            assert reads >= min_sites, mod.__name__
+            assert guards >= reads, mod.__name__
+
+    def test_controllers_without_ledger_record_nothing(self):
+        from koordinator_tpu.runtime.overload import (
+            BrownoutController,
+            CircuitBreaker,
+        )
+        from koordinator_tpu.scheduler.pipeline import _DepthController
+
+        dc = _DepthController(max_depth=4)
+        bo = BrownoutController(clock=lambda: 0.0)
+        cb = CircuitBreaker(clock=lambda: 0.0)
+        assert dc.decisions is None
+        assert bo.decisions is None and cb.decisions is None
+        for _ in range(5):
+            dc.choose()
+            bo.tick()
+            cb.allow()
+        assert dc.decisions is None  # nothing lazily created
+        assert bo.decisions is None and cb.decisions is None
+
+    def test_disabled_overhead_is_negligible(self):
+        from koordinator_tpu.scheduler.pipeline import _DepthController
+
+        dc = _DepthController(max_depth=4)
+        n = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            dc.choose()
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"{n} unledgered chooses took {elapsed:.2f}s"
+
+    def test_storm_burst_memory_is_bounded(self):
+        from koordinator_tpu.core.journal import MemoryJournalStore
+        from koordinator_tpu.obs.decisions import DecisionLedger
+
+        store = MemoryJournalStore()
+        cap = 32
+        dl = DecisionLedger(store, capacity=cap)
+        # a storm-shaped burst: ~100x capacity decisions in a tight loop
+        for i in range(100 * cap):
+            dl.record(
+                "admission", i + 1,
+                {"band": "FREE", "band_depth": i % 7},
+                {"verdict": "shed"}, {},
+            )
+        assert len(dl.last()) == cap            # ring: exactly capacity
+        assert len(store.load()) <= 2 * cap     # store: rewrite bound
+        # the retained tail is the newest, gap-free
+        from koordinator_tpu.obs.decisions import controller_gaps
+
+        assert controller_gaps(dl.last()) == {}
+        assert dl.last(1)[0]["cseq"] == 100 * cap

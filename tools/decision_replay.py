@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Offline counterfactual replay of a recorded decision ledger.
+
+Every controller records its decisions on the
+:class:`koordinator_tpu.obs.decisions.DecisionLedger` as
+``{controller, tick, inputs, action, state}`` where ``inputs`` is the
+COMPLETE evidence it decided from and the decision itself is a PURE
+function of that snapshot. That makes a recorded ledger a replayable
+dataset:
+
+* **Self-replay** (default): re-decide every record through the
+  deterministic controllers' own ``decide()`` functions. Every
+  recomputed action must match the recorded action bit-exactly — any
+  drift is a determinism bug (a controller read evidence outside its
+  snapshot), and the tool exits 1 with the first divergence's full
+  context.
+* **Candidate replay** (``--policy``): feed the SAME recorded inputs to
+  an alternate policy and report counterfactual divergence — per-
+  controller action agreement, the first divergence with its snapshot,
+  and the reward inputs (per-tick ``outcome`` fields: placement p99,
+  queue age, sheds, SLO violations — whatever the driver stamped)
+  summed over the trace. This is the offline half of the
+  :mod:`koordinator_tpu.obs.shadow` harness: the longrun sim + soaks
+  produce ledgers, this tool evaluates policies against them without
+  ever letting one act.
+
+Accepted ledger shapes: a ``DecisionLedger.render()`` document
+(``{"records": [...]}``), the fleet surface's ``/debug/decisions``
+document (``{"shards": {...}}`` — flattened), or a bare JSON list of
+records.
+
+Usage::
+
+    python tools/decision_replay.py --ledger /tmp/decisions.json
+    python tools/decision_replay.py --ledger ... --policy pkg.mod:POLICY
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+from typing import Callable, Dict, List, Optional
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # `python tools/decision_replay.py` from anywhere
+    sys.path.insert(0, _REPO)
+
+
+def deterministic_policies() -> Dict[str, Callable]:
+    """controller name -> the acting controller's pure decide()."""
+    from koordinator_tpu.runtime.elastic import TopologyController
+    from koordinator_tpu.runtime.overload import (
+        AdmissionController,
+        BrownoutController,
+        CircuitBreaker,
+    )
+    from koordinator_tpu.scheduler.pipeline import _DepthController
+
+    return {
+        "depth": _DepthController.decide,
+        "brownout": BrownoutController.decide,
+        "admission": AdmissionController.decide,
+        "breaker": CircuitBreaker.decide,
+        "topology": TopologyController.decide,
+    }
+
+
+def load_records(doc) -> List[dict]:
+    """Normalize any accepted ledger shape to a flat record list."""
+    if isinstance(doc, dict) and "records" in doc:
+        return list(doc["records"])
+    if isinstance(doc, dict) and "shards" in doc:
+        out: List[dict] = []
+        for _shard, sub in sorted(doc["shards"].items()):
+            out.extend(load_records(sub))
+        return out
+    if isinstance(doc, list):
+        return list(doc)
+    raise ValueError(
+        "unrecognized ledger shape (want a DecisionLedger.render() "
+        "document, a /debug/decisions fleet document, or a record list)"
+    )
+
+
+def _proposed_action(policy, inputs: dict):
+    """A policy entry may be a pure decide() returning (action, state)
+    or a plain inputs -> action function (ShadowPolicy.propose shape)."""
+    out = policy(inputs)
+    if isinstance(out, tuple):
+        return out[0]
+    return out
+
+
+def replay(
+    records: List[dict],
+    policies: Optional[Dict[str, Callable]] = None,
+) -> dict:
+    """Re-decide every record; per-controller agreement + reward sums."""
+    if policies is None:
+        policies = deterministic_policies()
+    per: Dict[str, dict] = {}
+    reward: Dict[str, float] = {}
+    skipped = 0
+    for rec in records:
+        controller = str(rec.get("controller"))
+        for key, val in (rec.get("outcome") or {}).items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                reward[key] = reward.get(key, 0.0) + float(val)
+        policy = policies.get(controller)
+        if policy is None:
+            skipped += 1
+            continue
+        row = per.setdefault(
+            controller,
+            {"total": 0, "agreed": 0, "first_divergence": None},
+        )
+        row["total"] += 1
+        proposed = _proposed_action(policy, rec["inputs"])
+        if proposed == rec["action"]:
+            row["agreed"] += 1
+        elif row["first_divergence"] is None:
+            row["first_divergence"] = {
+                "seq": rec.get("seq"),
+                "cseq": rec.get("cseq"),
+                "tick": rec.get("tick"),
+                "shard": rec.get("shard"),
+                "recorded": rec["action"],
+                "proposed": proposed,
+                "inputs": rec["inputs"],
+            }
+    for row in per.values():
+        row["agreement_pct"] = round(
+            100.0 * row["agreed"] / row["total"], 2
+        ) if row["total"] else 100.0
+    return {
+        "controllers": per,
+        "records": len(records),
+        "skipped": skipped,
+        "diverged": sum(
+            r["total"] - r["agreed"] for r in per.values()
+        ),
+        "reward": {k: round(v, 4) for k, v in sorted(reward.items())},
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument(
+        "--ledger", required=True,
+        help="recorded ledger JSON (DecisionLedger.render(), "
+        "/debug/decisions, or a bare record list)",
+    )
+    ap.add_argument(
+        "--policy", default="", metavar="MODULE:ATTR",
+        help="candidate policy: a dict {controller: decide} (or "
+        "inputs->action callables). Omitted = self-replay through the "
+        "deterministic controllers (any drift exits 1)",
+    )
+    ap.add_argument(
+        "--json", default="", metavar="PATH",
+        help="also write the replay report as JSON ('-' = stdout only)",
+    )
+    args = ap.parse_args(argv)
+    with open(args.ledger) as f:
+        records = load_records(json.load(f))
+    self_replay = not args.policy
+    if self_replay:
+        policies = deterministic_policies()
+    else:
+        mod_name, _, attr = args.policy.partition(":")
+        if not attr:
+            ap.error("--policy must be MODULE:ATTR")
+        policies = dict(getattr(importlib.import_module(mod_name), attr))
+    report = replay(records, policies)
+    report["mode"] = "self" if self_replay else f"candidate:{args.policy}"
+    doc = json.dumps(report, indent=1, sort_keys=True)
+    if args.json and args.json != "-":
+        with open(args.json, "w") as f:
+            f.write(doc + "\n")
+    print(doc)
+    if self_replay and report["diverged"]:
+        print(
+            f"DETERMINISM DRIFT: {report['diverged']} recorded "
+            "decision(s) did not reproduce from their own inputs",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

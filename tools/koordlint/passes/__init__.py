@@ -3,6 +3,7 @@
 from . import (  # noqa: F401 — registration side effects
     bench_verdicts,
     chaos_coverage,
+    decision_ledger,
     donation_safety,
     exception_sites,
     fence_boundaries,

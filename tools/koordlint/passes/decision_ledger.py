@@ -1,0 +1,229 @@
+"""Pass ``decision-ledger`` (DL): every controller tick()/decide entry
+point that mutates control state records its decision — full input
+snapshot, action, post-decision state — through the
+``obs.decisions.DecisionLedger``, or carries a written exemption. The
+decision-observatory PR's standing rule, mirroring what ``shed-paths``
+does for queue drops.
+
+The vocabulary is bidirectional:
+
+* ``CONTROLLER_SITES`` declares every control-state decision entry
+  point. Each body must record: read a ``.decisions`` ledger attribute
+  (the one-attribute-check disabled contract) or delegate to a
+  ``._record(...)`` helper that does.
+* ``EXEMPT`` declares tick-shaped methods that deliberately do NOT
+  record — each carries the written reason (e.g. a protocol pump that
+  makes no policy decision).
+
+* **DL001** — a declared controller site whose body neither reads a
+  decision ledger nor delegates to a recording helper: an invisible
+  control decision.
+* **DL002** — an UNDECLARED package method named ``tick``/``choose``
+  that mutates instance state without recording: a new controller must
+  join ``CONTROLLER_SITES`` (or ``EXEMPT``, with its reason) so review
+  sees it.
+* **DL003** — a stale table entry: the named file/function is gone.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .. import Finding, Pass, RepoIndex, register
+
+Site = Tuple[str, str]  # (repo-relative file, dotted qualname)
+
+#: every control-state decision entry point → why it is one. New
+#: controllers JOIN this table (DL002 forces it).
+CONTROLLER_SITES: Dict[Site, str] = {
+    (
+        "koordinator_tpu/scheduler/pipeline.py",
+        "_DepthController.choose",
+    ): "adaptive pipeline-depth choice from the discard-rate window",
+    (
+        "koordinator_tpu/runtime/overload.py",
+        "BrownoutController.tick",
+    ): "brownout-ladder move from the fleet-worst SLO burn",
+    (
+        "koordinator_tpu/runtime/overload.py",
+        "AdmissionController.admit",
+    ): "submit-time admission verdict from band occupancy + ladder",
+    (
+        "koordinator_tpu/runtime/overload.py",
+        "CircuitBreaker.allow",
+    ): "breaker admit/probe decision (delegates to _record)",
+    (
+        "koordinator_tpu/runtime/overload.py",
+        "CircuitBreaker.record_failure",
+    ): "breaker trip decision from the consecutive-failure count",
+    (
+        "koordinator_tpu/runtime/overload.py",
+        "CircuitBreaker.record_success",
+    ): "breaker close decision",
+    (
+        "koordinator_tpu/runtime/elastic.py",
+        "TopologyController.tick",
+    ): "split/merge choice from per-shard burn streaks",
+}
+
+#: tick-shaped methods that deliberately do NOT record → written reason
+EXEMPT: Dict[Site, str] = {
+    (
+        "koordinator_tpu/runtime/ha.py",
+        "LeaderCoordinator.tick",
+    ): (
+        "election protocol step: acquire/renew is lease mechanics, not "
+        "a control-state policy decision over SLO evidence"
+    ),
+    (
+        "koordinator_tpu/runtime/shards.py",
+        "ShardedScheduler.tick",
+    ): (
+        "ownership pump: drives per-shard election ticks and stream "
+        "pumps; the policy decisions live in the controllers it hosts"
+    ),
+    (
+        "koordinator_tpu/koordlet/pleg.py",
+        "Pleg.tick",
+    ): (
+        "event scanner: diffs container state into PLEG events, "
+        "decides nothing (InotifyPleg inherits this tick)"
+    ),
+}
+
+#: entry-point names the DL002 sweep considers controller-shaped
+_ENTRY_NAMES = frozenset({"tick", "choose"})
+
+#: call-attribute names that count as delegating to a recording helper
+_DELEGATE_ATTRS = frozenset({"_record"})
+
+
+def _qualnames(tree: ast.AST) -> Dict[str, ast.AST]:
+    """Dotted qualname -> function node, for every (possibly nested)
+    function/method in the module."""
+    out: Dict[str, ast.AST] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[q] = child
+                visit(child, f"{q}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _records_decision(fn: ast.AST) -> bool:
+    """A read of a ``.decisions`` ledger attribute (the record sites all
+    spell it ``dl = self.decisions`` / ``if dl is not None``) or a
+    delegation to a ``._record(...)`` helper."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == "decisions":
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _DELEGATE_ATTRS
+        ):
+            return True
+    return False
+
+
+def _mutates_self(fn: ast.AST) -> bool:
+    """Any assignment/augmented-assignment to a ``self.*`` attribute —
+    the 'mutates control state' half of the DL002 heuristic."""
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                return True
+    return False
+
+
+def _is_method(qualname: str) -> bool:
+    return "." in qualname
+
+
+@register
+class DecisionLedgerPass(Pass):
+    name = "decision-ledger"
+    code = "DL"
+    description = (
+        "every controller tick()/decide entry point that mutates "
+        "control state records inputs -> action -> state through the "
+        "decision ledger (or carries a written exemption)"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        declared = set(CONTROLLER_SITES) | set(EXEMPT)
+        funcs: Dict[Site, ast.AST] = {}
+        for sf in index.package_files:
+            if sf.tree is None:
+                continue
+            for q, fn in _qualnames(sf.tree).items():
+                funcs[(sf.rel, q)] = fn
+
+        # DL001: declared controller sites must actually record
+        for site, why in sorted(CONTROLLER_SITES.items()):
+            fn = funcs.get(site)
+            if fn is None:
+                out.append(self.finding(
+                    3, site[0], 0,
+                    f"decision-ledger table names {site[1]!r} in "
+                    f"{site[0]} but it does not exist — delete the "
+                    "stale entry",
+                ))
+                continue
+            if not _records_decision(fn):
+                out.append(self.finding(
+                    1, site[0], fn.lineno,
+                    f"{site[1]} is a declared controller decision site "
+                    "but neither reads a .decisions ledger nor "
+                    "delegates to a recording helper — a control "
+                    "decision made here is invisible to the decision "
+                    "observatory (decision-observatory standing rule)",
+                ))
+
+        # DL003 over the exemptions
+        for site, why in sorted(EXEMPT.items()):
+            if funcs.get(site) is None:
+                out.append(self.finding(
+                    3, site[0], 0,
+                    f"decision-ledger exemption names {site[1]!r} in "
+                    f"{site[0]} but it does not exist — delete the "
+                    "stale exemption",
+                ))
+
+        # DL002: undeclared controller-shaped methods anywhere in the
+        # package that mutate instance state without recording
+        for site, fn in sorted(funcs.items()):
+            if site in declared:
+                continue
+            name = site[1].rsplit(".", 1)[-1]
+            if name not in _ENTRY_NAMES or not _is_method(site[1]):
+                continue
+            if _mutates_self(fn) and not _records_decision(fn):
+                out.append(self.finding(
+                    2, site[0], fn.lineno,
+                    f"{site[1]} looks like a controller decision entry "
+                    "point (tick/choose mutating instance state) but "
+                    "records nothing on the decision ledger — declare "
+                    "it in CONTROLLER_SITES (or EXEMPT, with a written "
+                    "reason) so review sees every control decision",
+                ))
+        return out
