@@ -368,6 +368,99 @@ def bench_loadaware():
     }
 
 
+def bench_loadaware_100k():
+    """Region-scale raw-solver stream: the columnar fleet generator
+    (``sim.cluster_gen.gen_fleet_arrays``) at 100k heterogeneous nodes
+    across 8 region cohorts, drained with the same ``solve_stream``
+    discipline as ``loadaware_10k_nodes``. ``approx_topk`` + a shorter
+    round budget keep the top-k sort tractable at this node count."""
+    import jax
+    import jax.numpy as jnp
+
+    from koordinator_tpu.ops.solver import (
+        PodBatch,
+        SolverParams,
+        assign,
+        solve_stream,
+    )
+    from koordinator_tpu.sim.cluster_gen import (
+        FLEET_SHAPES,
+        FleetConfig,
+        fleet_node_state,
+        gen_fleet_pod_arrays,
+    )
+
+    cfg = FleetConfig(n_nodes=100_000)
+    nodes = fleet_node_state(cfg)
+    n_pods = 4096
+    fix = gen_fleet_pod_arrays(cfg, n_pods)
+    params = SolverParams(
+        # PERCENT scale, like bench.THRESHOLDS — fractional thresholds
+        # silently place nothing
+        usage_thresholds=jnp.asarray((65.0, 95.0), jnp.float32),
+        prod_thresholds=jnp.zeros(2, jnp.float32),
+        score_weights=jnp.ones(2, jnp.float32),
+    )
+    p = 512
+    b = n_pods // p
+    stacked = PodBatch.create(
+        requests=fix["requests"], estimate=fix["estimate"],
+        priority=fix["priority"], is_prod=fix["is_prod"],
+    )
+    stacked = jax.tree.map(lambda a: a.reshape((b, p) + a.shape[1:]), stacked)
+    solve_stream(stacked, nodes, params, max_rounds=8, approx_topk=True)
+    single = jax.tree.map(lambda a: a[0], stacked)
+    r = assign(single, nodes, params, max_rounds=8, approx_topk=True)
+    np.asarray(r.assignment)
+    lat = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        r = assign(single, nodes, params, max_rounds=8, approx_topk=True)
+        np.asarray(r.assignment)
+        lat.append(time.perf_counter() - t0)
+    pass_pps = []
+    total_placed = 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _, _, placed, _ = solve_stream(
+            stacked, nodes, params, max_rounds=8, approx_topk=True
+        )
+        total_placed = int(np.asarray(placed).sum())
+        pass_pps.append(round(n_pods / (time.perf_counter() - t0), 1))
+    p50, p99 = _percentiles(lat)
+    return {
+        "scenario": "loadaware_100k_nodes",
+        "pods_per_sec": sorted(pass_pps)[len(pass_pps) // 2],
+        "passes": pass_pps,
+        "placed": total_placed,
+        "total": n_pods,
+        "n_nodes": cfg.n_nodes,
+        "n_regions": cfg.n_regions,
+        "n_node_shapes": len(FLEET_SHAPES),
+        "batch_p50_ms": round(p50, 2),
+        "batch_p99_ms": round(p99, 2),
+        "measurement_note": (
+            "100k-node fleet on ONE CPU container: the [100k, 2] node "
+            "tables and their top-k reductions exceed host cache, so "
+            "wall clock here measures memory bandwidth of a single "
+            "shared host, not accelerator solve throughput; the "
+            "scenario exists to keep the region-scale shapes compiling "
+            "and placing — real fleet-scale numbers need real HBM"
+        ),
+    }
+
+
+def bench_loadaware_multichip():
+    """Pods/s-vs-device-count curve over the production mesh path
+    (S = 1/2/4/8 virtual CPU devices). Delegates to the
+    ``tools.bench_multichip`` driver — each arm needs its own process
+    to set the XLA device-count flag — which also writes the canonical
+    ``MULTICHIP_rNN.json`` artifact with the embedded curve."""
+    from tools.bench_multichip import run_curve
+
+    return run_curve()
+
+
 def _build_numa(n_nodes=2000, n_pods=16000, **sched_kw):
     """2-socket nodes + LSR whole-core pods; shared by the drain bench
     and the latency stream (the cpuset host commit sits on BOTH paths)."""
@@ -2677,6 +2770,8 @@ def bench_overload_storm():
 
 SCENARIOS = {
     "loadaware": bench_loadaware,
+    "loadaware_100k": bench_loadaware_100k,
+    "loadaware_multichip": bench_loadaware_multichip,
     "fleet_day": bench_fleet_day,
     "overload_storm": bench_overload_storm,
     "numa": bench_numa,

@@ -283,6 +283,123 @@ def gather_rows(full, idx, valid):
     return jax.tree.map(take, full)
 
 
+#: memoized sharded scatter/gather programs, keyed on (kind, treedef,
+#: per-leaf NamedShardings, rows treedef) — sharding-polymorphic jit
+#: would retrace per call otherwise, and a fresh ``jax.jit`` per call
+#: would defeat the compile cache outright (retrace-hazard discipline).
+_SHARDED_ROW_FNS: dict = {}
+
+
+def _tree_shardings(tree):
+    return jax.tree.map(lambda leaf: leaf.sharding, tree)
+
+
+def scatter_rows_sharded(mesh, full, idx, rows, devprof=None, **sig):
+    """Mesh-resident form of :func:`scatter_rows`: refresh a node-axis
+    pytree that lives SHARDED on the ``tp`` axis of a (dp, tp) mesh.
+
+    The donation contract is the hard part — a naive
+    ``scatter_rows(full, ...)`` on sharded operands would let the jit
+    re-infer output shardings and silently break buffer aliasing at the
+    resharding boundary. Here the program is compiled with explicit
+    ``in_shardings``/``out_shardings`` pinned EQUAL for the donated
+    ``full`` argument (the dirty index vector and row blocks ride in
+    replicated — they are K-row slivers, not [N, ...] tables), so XLA
+    aliases the resident shards in place; the donation-effectiveness
+    census verifies the input really died. Programs are memoized per
+    (treedef, leaf shardings) so the steady-state refresh never
+    re-lowers. ``devprof`` wraps the dispatch in a signature-carrying
+    watch window (PR 8 standing rule); ``sig`` feeds it."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = _tree_shardings(full)
+    leaves, treedef = jax.tree.flatten(full)
+    rows_def = jax.tree.structure(rows)
+    key = (
+        "scatter",
+        treedef,
+        tuple(leaf.sharding for leaf in leaves),
+        rows_def,
+    )
+    fn = _SHARDED_ROW_FNS.get(key)
+    rep = NamedSharding(mesh, PartitionSpec())
+    if fn is None:
+
+        def _traced_scatter(full_, idx_, rows_):
+            _devprof.tracing("scatter_rows_sharded")
+            return jax.tree.map(
+                lambda f, r: f.at[idx_].set(r), full_, rows_
+            )
+
+        fn = jax.jit(
+            _traced_scatter,
+            in_shardings=(sh, rep, jax.tree.map(lambda _: rep, rows)),
+            out_shardings=sh,
+            donate_argnums=0,
+        )
+        _SHARDED_ROW_FNS[key] = fn
+    idx = jax.device_put(idx, rep)
+    rows = jax.device_put(rows, jax.tree.map(lambda _: rep, rows))
+    with (
+        devprof.watch(
+            "scatter_rows_sharded", stage="snapshot", kind="transfer",
+            dp=mesh.shape["dp"], tp=mesh.shape["tp"], **sig,
+        )
+        if devprof is not None
+        else _devprof.NULL_WATCH
+    ) as w:
+        out = fn(full, idx, rows)
+        w.result(out)
+    return out
+
+
+def gather_rows_sharded(mesh, full, idx, valid, devprof=None, **sig):
+    """Mesh-resident form of :func:`gather_rows`: window-gather out of a
+    tp-sharded resident pytree, output pinned back onto the same tp
+    sharding so the windowed solve runs SPMD too. ``full`` is NOT
+    donated (same resident re-read contract as :func:`gather_rows`);
+    programs are memoized per (treedef, leaf shardings). ``devprof``
+    wraps the dispatch in a signature-carrying watch window."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = _tree_shardings(full)
+    leaves, treedef = jax.tree.flatten(full)
+    key = ("gather", treedef, tuple(leaf.sharding for leaf in leaves))
+    fn = _SHARDED_ROW_FNS.get(key)
+    rep = NamedSharding(mesh, PartitionSpec())
+    if fn is None:
+
+        def _traced_gather(full_, idx_, valid_):
+            _devprof.tracing("gather_rows_sharded")
+
+            def take(f):
+                out = f[idx_]
+                v = valid_.reshape((-1,) + (1,) * (out.ndim - 1))
+                return jnp.where(v, out, jnp.zeros_like(out))
+
+            return jax.tree.map(take, full_)
+
+        fn = jax.jit(
+            _traced_gather,
+            in_shardings=(sh, rep, rep),
+            out_shardings=sh,
+        )
+        _SHARDED_ROW_FNS[key] = fn
+    idx = jax.device_put(idx, rep)
+    valid = jax.device_put(valid, rep)
+    with (
+        devprof.watch(
+            "gather_rows_sharded", stage="snapshot", kind="transfer",
+            dp=mesh.shape["dp"], tp=mesh.shape["tp"], **sig,
+        )
+        if devprof is not None
+        else _devprof.NULL_WATCH
+    ) as w:
+        out = fn(full, idx, valid)
+        w.result(out)
+    return out
+
+
 @struct.dataclass
 class QuotaState:
     """Device-side ElasticQuota accounting ([Q, D] each).
@@ -1085,9 +1202,16 @@ def assign(
         rounds,
     ) = jax.lax.while_loop(round_cond, round_body, init)
 
-    # Scatter back to original pod order.
-    assignment = jnp.full((p,), -1, jnp.int32).at[order].set(assigned_s)
-    pod_zone = jnp.full((p,), -1, jnp.int32).at[order].set(azone_f)
+    # Back to original pod order. ``order`` is a permutation, so the
+    # un-sort is the gather by its inverse — exactly equal to the
+    # ``full(-1).at[order].set(...)`` scatter (every slot written once),
+    # but partition-friendly: GSPMD mis-sizes the all-gather/slice pair
+    # that 1-D permutation scatter lowers to on dp-sharded operands
+    # (the toolchain defect the sharded suite's probe documents), while
+    # the gather form partitions correctly everywhere.
+    inv_order = jnp.argsort(order).astype(jnp.int32)
+    assignment = assigned_s[inv_order]
+    pod_zone = azone_f[inv_order]
     if numa is not None:
         # the zone charge each zoned pod applied (for gang refunds):
         # zone-scoped request, CPU amplified for cpuset-bound pods

@@ -58,6 +58,20 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devs).reshape(dp, tp), ("dp", "tp"))
 
 
+def put_resident(mesh: Mesh, tree):
+    """Place a node-axis RESIDENT table (NodeState / NumaState /
+    DeviceState pytree of ``[N, ...]`` arrays) onto the mesh: axis 0
+    sharded on ``tp``, trailing axes replicated. This is the mesh-mode
+    lowering the ``BatchScheduler`` runs ONCE per full re-lower — the
+    steady state then refreshes these shards in place via
+    ``ops.solver.scatter_rows_sharded`` (donated, no resharding copy)
+    instead of re-placing per cycle."""
+    if tree is None:
+        return None
+    sh = NamedSharding(mesh, P("tp"))
+    return jax.device_put(tree, jax.tree.map(lambda _a: sh, tree))
+
+
 def _pod_spec() -> PodBatch:
     return PodBatch(
         requests=P("dp", None),

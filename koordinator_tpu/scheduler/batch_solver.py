@@ -39,7 +39,9 @@ from ..ops.solver import (
     SolveResult,
     assign,
     gather_rows,
+    gather_rows_sharded,
     scatter_rows,
+    scatter_rows_sharded,
 )
 
 
@@ -970,7 +972,11 @@ class BatchScheduler:
         power of two (min 8) so the scatter jit-cache stays tiny
         (duplicate indices carry identical row data, so the ``.set`` is
         well-defined), scatter ``make_blocks(idx)`` into the DONATED
-        resident pytree, and account the upload + partial cache hit."""
+        resident pytree, and account the upload + partial cache hit.
+        Mesh mode routes through ``scatter_rows_sharded``: the resident
+        shards are refreshed in place across the (dp, tp) mesh with
+        donation pinned through the resharding boundary (same census,
+        same discipline)."""
         reg = self.extender.registry
         b = max(8, 1 << (len(rows) - 1).bit_length())
         idx = np.empty((b,), np.int32)
@@ -980,18 +986,30 @@ class BatchScheduler:
         with self.extender.tracer.span(
             span_name, cat="scheduler", dirty=len(rows), uploaded=b
         ):
-            with (
-                dp.watch(
-                    "scatter_rows", stage="snapshot", kind="transfer",
-                    table=table, rows=b,
+            if self.mesh is not None:
+                # the sharded wrapper owns its watch window (PR 8 rule)
+                state = scatter_rows_sharded(
+                    self.mesh,
+                    cached_state,
+                    jnp.asarray(idx),
+                    make_blocks(idx),
+                    devprof=dp,
+                    table=table,
+                    nrows=b,
                 )
-                if dp is not None
-                else _NULL_WATCH
-            ) as w:
-                state = scatter_rows(
-                    cached_state, jnp.asarray(idx), make_blocks(idx)
-                )
-                w.result(state)
+            else:
+                with (
+                    dp.watch(
+                        "scatter_rows", stage="snapshot", kind="transfer",
+                        table=table, rows=b,
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    state = scatter_rows(
+                        cached_state, jnp.asarray(idx), make_blocks(idx)
+                    )
+                    w.result(state)
         if dp is not None:
             # donation-effectiveness: the donated resident pytree must be
             # DEAD after the scatter (a live leaf means XLA copied) — the
@@ -1007,10 +1025,14 @@ class BatchScheduler:
         tr = self.extender.tracer
         with snap.lock:
             n_bucket = snap.nodes.allocatable.shape[0]
+            # the mesh rides the key: attaching/detaching a mesh mid-run
+            # (no snapshot-version bump) must full-relower so the
+            # resident shards match the dispatch placement
             key = (
                 n_bucket,
                 self.args.filter_expired_node_metrics,
                 self.args.enable_schedule_when_node_metrics_expired,
+                self.mesh,
             )
             cur = self._resident_nodes
             if cur is not None and key == self._resident_key:
@@ -1049,6 +1071,10 @@ class BatchScheduler:
                     else _NULL_WATCH
                 ) as w:
                     new = self._node_state_rows(None)
+                    if self.mesh is not None:
+                        from ..parallel.sharded import put_resident
+
+                        new = put_resident(self.mesh, new)
                     w.result(new)
             reg.get("solver_h2d_rows_total").inc(float(n_bucket))
             self._resident_nodes = new
@@ -1081,16 +1107,28 @@ class BatchScheduler:
         with self.extender.tracer.span(
             "snapshot:window_gather", cat="scheduler", window=len(sub)
         ):
-            with (
-                dp.watch(
-                    "gather_rows", stage="snapshot", kind="transfer",
+            if self.mesh is not None:
+                out = gather_rows_sharded(
+                    self.mesh,
+                    full,
+                    jnp.asarray(idx),
+                    jnp.asarray(valid),
+                    devprof=dp,
                     window=b,
                 )
-                if dp is not None
-                else _NULL_WATCH
-            ) as w:
-                out = gather_rows(full, jnp.asarray(idx), jnp.asarray(valid))
-                w.result(out)
+            else:
+                with (
+                    dp.watch(
+                        "gather_rows", stage="snapshot", kind="transfer",
+                        window=b,
+                    )
+                    if dp is not None
+                    else _NULL_WATCH
+                ) as w:
+                    out = gather_rows(
+                        full, jnp.asarray(idx), jnp.asarray(valid)
+                    )
+                    w.result(out)
         self._window_cache = (key, out)
         return out
 
@@ -2301,16 +2339,19 @@ class BatchScheduler:
         reference. Each level's failure falls through to the next within
         the SAME cycle; the reached level persists for subsequent cycles
         and ``fallback_repromote_after`` consecutive clean cycles
-        re-promote one level (see ``_cycle_tail_bookkeeping``)."""
+        re-promote one level (see ``_cycle_tail_bookkeeping``).
+
+        Mesh mode rides the SAME ladder (first-class multi-chip PR):
+        level 0 is the pipelined sharded dispatch (the scanned program
+        declines meshes), a mesh dispatch fault degrades to the
+        per-chunk sharded path and then to the host reference — the
+        same capacity-safe approximate trade the single-chip ladder
+        already accepts (under-placement, never overcommit), instead of
+        crashing the cycle. Decision identity is guaranteed by the
+        sharded==single bit-exactness suite, not by refusing to
+        degrade."""
         if not chunks:
             return []
-        if self.mesh is not None:
-            # multi-chip mode opted into strict decision identity across
-            # the mesh — a silent numpy fallback would violate it, so
-            # dispatch failures propagate to the operator instead
-            if len(chunks) > 1:
-                return self._dispatch_pipelined(chunks, sub)
-            return [(c, None, self.solve(c, sub)) for c in chunks]
         level = self._fallback_level
         if level == 0:
             try:
@@ -3224,21 +3265,10 @@ class BatchScheduler:
         if self.mesh is not None:
             from ..parallel.sharded import shard_solver_inputs
 
-            (
-                _,
-                nodes0,
-                quotas0,
-                numa_state,
-                device_state,
-                _,
-                _,
-                _,
-            ) = shard_solver_inputs(
-                self.mesh,
-                nodes=nodes0,
-                quotas=quotas0,
-                numa=numa_state,
-                devices=device_state,
+            # nodes/NUMA/devices are mesh-resident already — only the
+            # replicated quota tables are placed per cycle (tiny [2Q, D])
+            (_, _, quotas0, _, _, _, _, _) = shard_solver_inputs(
+                self.mesh, quotas=quotas0
             )
             if quotas0 is not None:
                 qused = quotas0.used
@@ -3394,9 +3424,14 @@ class BatchScheduler:
         reads and chain through; an EAGER eviction+retry sets
         ``_cycle_preempted``, which discards the downstream chain at
         that commit (decision-identical — the next dispatch re-reads
-        the post-eviction world). The remaining closed-on-presence
-        gates are mesh (sharded dispatch), transformers (host
-        rewrites), and node sampling (rotating sub-axis)."""
+        the post-eviction world). ``mesh`` (first-class multi-chip PR)
+        is open: the resident tables are mesh-sharded and the chained
+        dispatch runs the SAME jitted program SPMD — the carry rides
+        sharded arrays, every carried table is still validated by
+        value at consume, and a mesh attach/detach between dispatch
+        and consume flips :meth:`_carry_modes` and discards. The
+        remaining closed-on-presence gates are transformers (host
+        rewrites) and node sampling (rotating sub-axis)."""
         fwext = self.extender
         return {
             "reservations": self.reservations is None
@@ -3410,7 +3445,7 @@ class BatchScheduler:
                 )
                 and self.reservations.has_available()
             ),
-            "mesh": self.mesh is None,
+            "mesh": True,
             "numa": True,
             "devices": True,
             "quotas": True,
@@ -3428,11 +3463,12 @@ class BatchScheduler:
     def _speculation_consume_ok(self) -> bool:
         """Still-gated pipeline subsystems, re-checked at CONSUME time: a
         gated subsystem can arrive through an informer WITHOUT bumping
-        ``snapshot.version`` (a reservation manager attach, a mesh, a
+        ``snapshot.version`` (a reservation manager attach, a
         transformer registration), and a speculation dispatched before
         that arrival must not be consumed. The CARRIED subsystems
         (quota/NUMA/device/gang) are validated by value instead —
-        :meth:`_carry_consume_ok`."""
+        :meth:`_carry_consume_ok` — and a mesh attach/detach is caught
+        by the mode-flag comparison (:meth:`_carry_modes`)."""
         return all(self.speculation_gate_report().values())
 
     def _carry_consume_ok(
@@ -3594,12 +3630,17 @@ class BatchScheduler:
         """PostFilter/fast-path mode flags a speculative dispatch bakes
         in (compared by value at consume — a flip between dispatch and
         consume changes scheduling behavior without bumping any
-        version)."""
+        version). The mesh rides along (open-the-mesh-gate PR):
+        ``jax.sharding.Mesh`` compares by value (devices + axis names),
+        so attaching, detaching or swapping the mesh between dispatch
+        and consume discards the speculation — the carried tables were
+        lowered under a different placement."""
         return (
             self.reservations is not None,
             self.defer_preemption,
             self.enable_priority_preemption,
             self.quotas.enable_preemption,
+            self.mesh,
         )
 
     def _quota_fastpath_preview_live(self) -> Optional[_QuotaFastpathPreview]:
@@ -4067,6 +4108,16 @@ class BatchScheduler:
                 node_mask = self._node_constraint_mask(
                     chunk, pods.requests.shape[0], None
                 )
+            if self.mesh is not None:
+                from ..parallel.sharded import shard_solver_inputs
+
+                # chained mesh dispatch: pod rows onto dp, the mask onto
+                # (dp, tp) — the chained node/constraint tables are
+                # already sharded (they are solver outputs of the
+                # previous sharded solve or the mesh-resident tables)
+                (pods, _, _, _, _, node_mask, _, _) = shard_solver_inputs(
+                    self.mesh, pods=pods, node_mask=node_mask
+                )
             dp = self.devprof
             with self.extender.tracer.span(
                 "assign", cat="scheduler", mode="chained", pods=len(chunk)
@@ -4191,6 +4242,7 @@ class BatchScheduler:
             self.devices.lowered_version if device_state is not None else None,
             b,
             sub.tobytes(),
+            self.mesh,
         )
         cached = self._constraint_window_cache
         if cached is not None and cached[0] == key:
@@ -4204,34 +4256,49 @@ class BatchScheduler:
         valid[: len(sub)] = True
         idx_d, valid_d = jnp.asarray(idx), jnp.asarray(valid)
         dp = self.devprof
+        sharded = self.mesh is not None
         with self.extender.tracer.span(
             "snapshot:constraint_window_gather", cat="scheduler",
             window=len(sub),
         ):
             if numa_state is not None:
-                with (
-                    dp.watch(
-                        "gather_rows", stage="snapshot",
-                        kind="transfer", table="numa", window=b,
+                if sharded:
+                    numa_state = gather_rows_sharded(
+                        self.mesh, numa_state, idx_d, valid_d,
+                        devprof=dp, table="numa", window=b,
                     )
-                    if dp is not None
-                    else _NULL_WATCH
-                ) as w:
-                    numa_state = gather_rows(numa_state, idx_d, valid_d)
-                    w.result(numa_state)
+                else:
+                    with (
+                        dp.watch(
+                            "gather_rows", stage="snapshot",
+                            kind="transfer", table="numa", window=b,
+                        )
+                        if dp is not None
+                        else _NULL_WATCH
+                    ) as w:
+                        numa_state = gather_rows(
+                            numa_state, idx_d, valid_d
+                        )
+                        w.result(numa_state)
             if device_state is not None:
-                with (
-                    dp.watch(
-                        "gather_rows", stage="snapshot",
-                        kind="transfer", table="devices", window=b,
+                if sharded:
+                    device_state = gather_rows_sharded(
+                        self.mesh, device_state, idx_d, valid_d,
+                        devprof=dp, table="devices", window=b,
                     )
-                    if dp is not None
-                    else _NULL_WATCH
-                ) as w:
-                    device_state = gather_rows(
-                        device_state, idx_d, valid_d
-                    )
-                    w.result(device_state)
+                else:
+                    with (
+                        dp.watch(
+                            "gather_rows", stage="snapshot",
+                            kind="transfer", table="devices", window=b,
+                        )
+                        if dp is not None
+                        else _NULL_WATCH
+                    ) as w:
+                        device_state = gather_rows(
+                            device_state, idx_d, valid_d
+                        )
+                        w.result(device_state)
         self._constraint_window_cache = (key, (numa_state, device_state))
         return numa_state, device_state
 
@@ -4247,7 +4314,7 @@ class BatchScheduler:
         reg = self.extender.registry
         zone_free, zone_cap, policy = self.numa.arrays()
         most = self.numa.most_allocated_rows()
-        key = (self.numa.lowered_version, zone_free.shape)
+        key = (self.numa.lowered_version, zone_free.shape, self.mesh)
         cached = self._numa_dev_cache
         if cached is not None and cached[0] == key:
             reg.get("solver_state_cache_hits_total").labels(
@@ -4255,7 +4322,7 @@ class BatchScheduler:
             ).inc()
             return cached[1]
         n_bucket = zone_free.shape[0]
-        if cached is not None and cached[0][1] == zone_free.shape:
+        if cached is not None and cached[0][1:] == key[1:]:
             rows = self.numa.drain_lowered_dirty()
             if rows is not None and 0 < len(rows) <= n_bucket // 2:
                 state = self._scatter_refresh(
@@ -4285,6 +4352,10 @@ class BatchScheduler:
                 policy=jnp.asarray(policy),
                 zone_most=jnp.asarray(most),
             )
+            if self.mesh is not None:
+                from ..parallel.sharded import put_resident
+
+                state = put_resident(self.mesh, state)
         reg.get("solver_h2d_rows_total").inc(float(zone_free.shape[0]))
         self._numa_dev_cache = (key, state)
         return state
@@ -4308,6 +4379,7 @@ class BatchScheduler:
             slots.shape,
             has_rdma,
             has_fpga,
+            self.mesh,
         )
         cached = self._device_dev_cache
         if cached is not None and cached[0] == key:
@@ -4362,6 +4434,10 @@ class BatchScheduler:
                 ),
                 cap_total=jnp.asarray(self.devices.cap_array()),
             )
+            if self.mesh is not None:
+                from ..parallel.sharded import put_resident
+
+                state = put_resident(self.mesh, state)
         reg.get("solver_h2d_rows_total").inc(float(slots.shape[0]))
         self._device_dev_cache = (key, state)
         return state
@@ -4381,22 +4457,23 @@ class BatchScheduler:
         if self.mesh is not None:
             from ..parallel.sharded import shard_solver_inputs
 
+            # node/NUMA/device tables are already MESH-RESIDENT (placed
+            # once at full lower, refreshed in place by the sharded
+            # scatter) — only the per-cycle pod rows, mask and the tiny
+            # replicated quota tables get placed here
             (
                 pods,
-                nodes,
+                _,
                 quotas,
-                numa_state,
-                device_state,
+                _,
+                _,
                 node_mask,
                 _,
                 _,
             ) = shard_solver_inputs(
                 self.mesh,
                 pods=pods,
-                nodes=nodes,
                 quotas=quotas,
-                numa=numa_state,
-                devices=device_state,
                 node_mask=node_mask,
             )
         dp = self.devprof

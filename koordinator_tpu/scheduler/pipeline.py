@@ -229,8 +229,8 @@ class _PrepareWorker:
                 self._cond.notify_all()
 
     def _warm(self, batch: Sequence[Pod]) -> None:
-        """Gated cycles (transformers/reservations/mesh/sampling/cold
-        gangs/unhealthy ladder) can't take the chained fast path, but
+        """Gated cycles (transformers/sampling/cold gangs/unhealthy
+        ladder) can't take the chained fast path, but
         the prepare worker still pays their per-pod parse ahead of time:
         one throwaway lowering primes the interned-row cache so the
         serial cycle's own ``build_pods`` hits it.
@@ -494,13 +494,16 @@ class CyclePipeline:
     ``depth`` batches are in flight — runs the OLDEST batch's trailing
     commit, returning its :class:`ScheduleOutcome` (results lag up to
     ``depth`` feeds). ``feed([])`` / :meth:`flush` drain one tail entry
-    per call. Cycles that fail any pipeline gate (transformers,
-    reservations, mesh, node sampling, cold gangs, an unhealthy ladder)
+    per call. Cycles that fail any pipeline gate (transformers, node
+    sampling, cold gangs, an unhealthy ladder)
     or whose prepare worker stalls simply run the serial path — same
     decisions, no overlap. Open-the-gates PR: quota-, NUMA-, device-
     and warm-gang-bearing batches take the speculative path too — their
     tables ride the device chain with bit-exact consume-time validation
-    (``BatchScheduler._carry_consume_ok``).
+    (``BatchScheduler._carry_consume_ok``); the first-class-multichip PR
+    opened ``mesh`` and ``reservations`` the same way (sharded carries
+    validated by value, a mesh attach/detach discards via the mode-flag
+    comparison).
 
     ``depth`` > 1 (multi-queue streams) holds that many speculative
     solves in flight: batch k+1 chains off batch k's post-solve tables

@@ -118,6 +118,104 @@ class TestArtifactShapes:
             bench_regress.load_artifact({"nope": 1})
 
 
+class TestCurveFamily:
+    """Multichip artifact family: an entry with an embedded
+    pods/s-vs-device-count ``curve`` fans out into per-arm
+    pseudo-scenarios so every device count gets its own noise band."""
+
+    def _curve_entry(self, pps_by_s, spread=10.0):
+        return _entry(
+            "loadaware_multichip",
+            pps_by_s[max(pps_by_s)],
+            curve=[
+                {
+                    "devices": s,
+                    "pods_per_sec": pps,
+                    "passes": [pps - spread, pps, pps + spread],
+                }
+                for s, pps in sorted(pps_by_s.items())
+            ],
+        )
+
+    def test_curve_expands_to_per_arm_pseudo_scenarios(self):
+        art = bench_regress.load_artifact(
+            [self._curve_entry({1: 900.0, 2: 1000.0, 8: 1200.0})]
+        )
+        assert set(art) == {
+            "loadaware_multichip",
+            "loadaware_multichip[S=1]",
+            "loadaware_multichip[S=2]",
+            "loadaware_multichip[S=8]",
+        }
+        # parent keeps the headline (widest-arm) metric; each arm
+        # carries its own value + passes
+        assert bench_regress.extract_metric(
+            art["loadaware_multichip"]
+        )["value"] == 1200.0
+        arm = bench_regress.extract_metric(art["loadaware_multichip[S=2]"])
+        assert arm["value"] == 1000.0 and len(arm["passes"]) == 3
+        # single-entry (MULTICHIP_rNN.json) shape expands the same way
+        single = bench_regress.load_artifact(
+            self._curve_entry({2: 1000.0})
+        )
+        assert "loadaware_multichip[S=2]" in single
+
+    def test_per_device_count_noise_bands_are_independent(self):
+        base = bench_regress.load_artifact(
+            [
+                _entry(
+                    "loadaware_multichip",
+                    1200.0,
+                    curve=[
+                        {"devices": 2, "pods_per_sec": 1000.0,
+                         "passes": [700.0, 1000.0, 1300.0]},   # ±30% noisy
+                        {"devices": 8, "pods_per_sec": 1200.0,
+                         "passes": [1195.0, 1200.0, 1205.0]},  # tight
+                    ],
+                )
+            ]
+        )
+        cur = bench_regress.load_artifact(
+            [
+                _entry(
+                    "loadaware_multichip",
+                    960.0,
+                    curve=[
+                        {"devices": 2, "pods_per_sec": 800.0,
+                         "passes": [790.0, 800.0, 810.0]},     # -20%
+                        {"devices": 8, "pods_per_sec": 960.0,
+                         "passes": [955.0, 960.0, 965.0]},     # -20%
+                    ],
+                )
+            ]
+        )
+        rows = _rows_by_scenario(
+            bench_regress.compare(base, cur, threshold=0.10)
+        )
+        # same -20% delta: absorbed by the noisy S=2 arm's own band,
+        # flagged by the tight S=8 arm (and the tight parent row)
+        assert rows["loadaware_multichip[S=2]"]["verdict"] == "OK"
+        assert rows["loadaware_multichip[S=8]"]["verdict"] == "REGRESSION"
+        assert rows["loadaware_multichip"]["verdict"] == "REGRESSION"
+
+    def test_committed_multichip_artifact_expands_and_self_compares(self):
+        path = REPO / "MULTICHIP_r06.json"
+        assert path.exists(), "committed multichip curve artifact missing"
+        art = bench_regress.load_artifact(json.loads(path.read_text()))
+        arms = [s for s in art if s.startswith("loadaware_multichip[S=")]
+        assert len(arms) >= 4, arms
+        for s in arms:
+            m = bench_regress.extract_metric(art[s])
+            assert m and m["value"] > 0 and m["passes"]
+        # evidence discipline: the committed artifact's perf claims ride
+        # a retrace-free steady state and an effective donation
+        entry = art["loadaware_multichip"]
+        assert entry["steady_retraces"] == 0
+        assert entry["donation_misses"] == 0
+        rows = bench_regress.compare(art, art)
+        assert {r["verdict"] for r in rows} <= {"OK", "NO_METRIC"}
+
+
 class TestCommittedArtifacts:
     def test_committed_round_pair_produces_verdict_table(self, capsys):
         """Acceptance: the gate runs over the committed BENCH round pair

@@ -519,11 +519,75 @@ def test_numa_device_dirty_row_scatter():
 # ---------------------------------------------------------------------------
 
 #: gate name -> equivalence-arm test function(s) in THIS file
+def test_gate_mesh_equivalence():
+    """Opened ``mesh`` gate (first-class multi-chip PR): the pipelined
+    speculative stream over a (dp, tp) mesh — resident tables sharded
+    on tp, ChainCarry riding sharded solver outputs — must decide
+    bit-exactly like the SERIAL single-device pump, and the speculative
+    chained dispatch must actually ENGAGE on the sharded path (a mesh
+    that silently re-closed the gate would pass the equality check
+    while verifying nothing)."""
+    from koordinator_tpu.parallel.sharded import make_mesh
+
+    a = _build()
+    da = _drive(a, pipelined=False, pods=_pods(300), waves=8, max_batch=64)
+    b = _build(mesh=make_mesh(8))
+    assert b.speculation_gate_report()["mesh"], "mesh gate must be OPEN"
+    db = _drive(b, pipelined=True, pods=_pods(300), waves=8, max_batch=64)
+    kept = b.extender.registry.get("pipeline_speculation_total").value(
+        outcome="kept"
+    )
+    assert kept > 0, "speculative mesh dispatch never engaged"
+    assert len(db) == len(da) == 300
+    assert da == db
+
+
+def test_gate_mesh_swap_discards_speculation():
+    """A mesh attach mid-pipeline (no version bump anywhere) must flip
+    ``_carry_modes`` and DISCARD the in-flight speculation at consume —
+    the carried tables were lowered under a different placement."""
+    from koordinator_tpu.parallel.sharded import make_mesh
+
+    sched = _build()
+    st = StreamScheduler(sched, max_batch=64, pipelined=True)
+    decided = {}
+    pods = _pods(192)
+    i = 0
+    wave = 0
+    try:
+        while i < len(pods) or st.backlog() or st._pipe.inflight:
+            if wave == 2:
+                # no flush: the in-flight speculation predates the mesh
+                sched.mesh = make_mesh(8)
+            wave += 1
+            for _ in range(48):
+                if i < len(pods):
+                    st.submit(pods[i])
+                    i += 1
+            for pod, node, _lat in st.pump():
+                decided[pod.meta.name] = node
+        for pod, node, _lat in st.flush():
+            decided[pod.meta.name] = node
+    finally:
+        st.close()
+    mism = sched.extender.registry.get(
+        "pipeline_carry_mismatch_total"
+    ).value(table="modes")
+    assert mism > 0, "mesh swap must discard via the modes comparison"
+    assert len(decided) == 192
+    assert all(v is not None for v in decided.values())
+
+
 GATE_ARMS = {
     "quotas": "test_gate_quota_equivalence",
     "numa": "test_gate_numa_equivalence",
     "devices": "test_gate_device_equivalence",
     "gangs": "test_gate_gang_equivalence",
+    # first-class multi-chip PR
+    "mesh": (
+        "test_gate_mesh_equivalence",
+        "test_gate_mesh_swap_discards_speculation",
+    ),
     "batch_gangs": (
         "test_gate_gang_equivalence",
         "test_cold_gang_batch_stays_serial",

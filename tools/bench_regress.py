@@ -84,6 +84,21 @@ def extract_metric(entry: dict) -> Optional[dict]:
     return None
 
 
+def _expand_curve(scenario: str, entry: dict, out: Dict[str, dict]) -> None:
+    """Multichip artifact family: an entry carrying a ``curve`` list of
+    per-device-count arms (``{"devices": S, "pods_per_sec": ...,
+    "passes": [...]}``, the MULTICHIP_rNN.json shape) contributes one
+    pseudo-scenario per arm — ``loadaware_multichip[S=8]`` — so each
+    device count gets its OWN noise band and verdict row. The parent
+    row stays (its metric is the widest arm's, the headline number)."""
+    curve = entry.get("curve")
+    if not isinstance(curve, list):
+        return
+    for arm in curve:
+        if isinstance(arm, dict) and "devices" in arm:
+            out[f"{scenario}[S={arm['devices']}]"] = dict(arm)
+
+
 def load_artifact(doc) -> Dict[str, dict]:
     """Normalize any accepted artifact shape to scenario -> entry."""
     if isinstance(doc, dict) and "parsed" in doc:
@@ -91,12 +106,15 @@ def load_artifact(doc) -> Dict[str, dict]:
     if isinstance(doc, dict) and "metric" in doc:
         return {str(doc["metric"]): dict(doc)}
     if isinstance(doc, dict) and "scenario" in doc:
-        return {str(doc["scenario"]): dict(doc)}
+        out = {str(doc["scenario"]): dict(doc)}
+        _expand_curve(str(doc["scenario"]), doc, out)
+        return out
     if isinstance(doc, list):
-        out: Dict[str, dict] = {}
+        out = {}
         for entry in doc:
             if isinstance(entry, dict) and "scenario" in entry:
                 out[str(entry["scenario"])] = dict(entry)
+                _expand_curve(str(entry["scenario"]), entry, out)
         return out
     raise ValueError(
         "unrecognized bench artifact shape (want a BENCH_SUITE scenario "

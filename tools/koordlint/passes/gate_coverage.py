@@ -39,11 +39,9 @@ ARMS_FILE = "tests/test_pipelined_stream.py"
 #: this table — they now carry (validated fast-path prediction /
 #: discard-on-eager-fire) and their bit-exactness arms live in
 #: tests/test_pipelined_stream.py::GATE_ARMS like every opened gate.
+#: First-class-multichip PR: ``mesh`` left too — the sharded dispatch
+#: now threads ChainCarry and carries a GATE_ARMS arm of its own.
 EXEMPT: Dict[str, str] = {
-    "mesh": (
-        "stays CLOSED: sharded GSPMD dispatch has its own bit-exactness "
-        "suite (tests/test_sharded.py) and opts out of speculation"
-    ),
     "transformers": (
         "stays CLOSED: host batch/cost transformers rewrite solver "
         "inputs per cycle — a speculative lowering cannot reproduce a "
