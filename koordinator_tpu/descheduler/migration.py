@@ -155,6 +155,8 @@ class MigrationController:
         arbitrator: Optional[Arbitrator] = None,
         job_timeout_s: float = 300.0,
         workload_info_fn: Optional[Callable[[str], tuple]] = None,
+        freshness: Optional[Callable[[], bool]] = None,
+        registry=None,
     ):
         self.reservations = reservations
         self.evict_fn = evict_fn
@@ -163,6 +165,14 @@ class MigrationController:
         #: controllerFinder analog: owner uid -> (expected_replicas,
         #: unavailable_pod_count) for the per-workload migration limits
         self.workload_info_fn = workload_info_fn
+        #: gray-failure containment: zero-arg callable (the staleness
+        #: watchdog's ``stale``) — eviction is evidence-hungry, so a
+        #: whole reconcile pass refuses while informer snapshots are
+        #: stale (jobs stay PENDING; nothing is lost, only delayed)
+        self.freshness = freshness
+        self.registry = registry
+        #: reconcile passes refused on stale evidence (soak assertion)
+        self.refused_stale = 0
         self.jobs: Dict[str, PodMigrationJob] = {}
         self._victims: Dict[str, Pod] = {}
 
@@ -196,6 +206,17 @@ class MigrationController:
         reservation so the in-flight budget cannot leak away.
         """
         import time as _t
+
+        # stale informer evidence: every eviction this pass would take is
+        # justified by snapshots a silent-stalled watch may have frozen —
+        # refuse the whole pass until events resume (pending jobs keep)
+        if self.freshness is not None and self.freshness():
+            self.refused_stale += 1
+            if self.registry is not None:
+                self.registry.get("stale_evidence_refusals_total").labels(
+                    action="descheduler_eviction"
+                ).inc()
+            return
 
         now = now if now is not None else _t.time()
         running_per_ns: Dict[str, int] = {}

@@ -77,6 +77,12 @@ class RejectReason(str, enum.Enum):
     #: level). Terminal ``shed`` lifecycle event + resubmit ticket; the
     #: pod never reaches a solve
     OVERLOAD_SHED = "overload_shed"
+    #: gray-failure containment PR: the pod is blamed on the poison
+    #: quarantine ledger (its lowering deterministically crashed a cycle
+    #: and bisection isolated it) — rejected at the cycle gate and shed
+    #: with a REDEEMABLE ticket: a changed spec fingerprint lifts the
+    #: blame and re-admits through the ordinary path
+    POISON_QUARANTINED = "poison_quarantined"
 
 
 @dataclass

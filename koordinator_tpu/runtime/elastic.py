@@ -324,6 +324,7 @@ class TopologyController:
         retire: Optional[Callable[[], object]] = None,
         chaos=None,
         lifecycle=None,
+        freshness=None,
     ):
         self.fabric = fabric
         self.slo = slo
@@ -340,6 +341,11 @@ class TopologyController:
         self.retire = retire
         self.chaos = chaos or NULL_INJECTOR
         self.lifecycle = lifecycle
+        #: gray-failure containment: zero-arg callable (the staleness
+        #: watchdog's ``stale``) folded into the snapshot — a topology
+        #: split/merge is evidence-hungry (it re-homes real queues on
+        #: burn-rate evidence) and must refuse on stale informer data
+        self.freshness = freshness
         self._hot: Dict[int, int] = {}
         self._cold: Dict[int, int] = {}
         self._ticks = 0
@@ -503,6 +509,14 @@ class TopologyController:
             "sustain": self.sustain,
             "split_burn": self.split_burn,
             "merge_burn": self.merge_burn,
+            # staleness-snapshot rule: decide() reads the freshness
+            # verdict FROM the snapshot, never live — replay sees the
+            # same refusal the acting controller made
+            "stale": (
+                bool(self.freshness())
+                if self.freshness is not None
+                else False
+            ),
         }
 
     @staticmethod
@@ -529,6 +543,14 @@ class TopologyController:
                 hot.pop(s, None)
                 cold.pop(s, None)
         action = {"op": "none"}
+        # stale informer evidence: burns computed over a silent-stalled
+        # snapshot must not re-home queues — streaks still advance (the
+        # evidence MAY be real; resuming events will confirm) but the
+        # topology action itself refuses until freshness returns.
+        # .get() keeps pre-containment recorded snapshots replayable.
+        if bool(inputs.get("stale", False)):
+            state = {"hot": hot, "cold": cold}
+            return {"op": "none", "stale_refused": True}, state
         if not inputs["in_cooldown"]:
             hot_list = sorted(
                 (s for s in active if hot.get(s, 0) >= sustain),

@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 from ..chaos import NULL_INJECTOR
+from .containment import BootCrashError, BootPlan
 from .recovery import RecoveryReport, recover_scheduler
 
 
@@ -70,6 +71,8 @@ class LeaderCoordinator:
         acquire_gate=None,
         on_loss=None,
         recovery_pod_filter=None,
+        quarantine=None,
+        governor=None,
     ):
         if sched is None and sched_factory is None:
             raise ValueError("LeaderCoordinator needs sched or sched_factory")
@@ -85,6 +88,19 @@ class LeaderCoordinator:
         self.on_loss_cb = on_loss
         self.recovery_pod_filter = recovery_pod_filter
         self.chaos = chaos or getattr(sched, "chaos", None) or NULL_INJECTOR
+        #: gray-failure containment: the poison-quarantine ledger this
+        #: incarnation adopts BEFORE replaying the journal on takeover —
+        #: a successor must reject the blamed pods from cycle one, not
+        #: re-crash on the same batch the predecessor died isolating
+        self.quarantine = quarantine
+        #: crash-loop governor: boot/death records + backoff gate. A
+        #: takeover that raises counts as a death; K rapid deaths impose
+        #: exponential boot backoff and a DEGRADED next boot.
+        self.governor = governor
+        #: the governor's plan for the CURRENT boot (None until a
+        #: governed takeover succeeds); the embedder applies knobs the
+        #: coordinator cannot reach (brownout cap on the stream)
+        self.boot_plan: Optional[BootPlan] = None
         self.leading = False
         #: report of the most recent takeover's recovery
         self.last_recovery: Optional[RecoveryReport] = None
@@ -96,6 +112,12 @@ class LeaderCoordinator:
     # ---- transitions ----
 
     def _on_takeover(self) -> None:
+        # chaos: a crash DURING boot/takeover — fires before the fence
+        # adopts the epoch, so the failed boot leaves no deposed grant
+        # behind (the lease lapses and re-elects, exactly like a factory
+        # failure). tick() turns the raise into a governed death record.
+        if self.chaos.enabled and self.chaos.fire("scheduler.boot_crash"):
+            raise BootCrashError("injected crash during takeover boot")
         epoch = self.elector.current_epoch() or self.fence.advance()
         # the factory runs BEFORE the fence adopts the new epoch: a
         # factory failure then leaves the previous grant un-deposed
@@ -119,6 +141,14 @@ class LeaderCoordinator:
         # the shared fence mirrors the lease's epoch: adopting it is what
         # deposes every older grant at the commit/channel boundaries
         self.fence.adopt(epoch)
+        # QUARANTINE BEFORE REPLAY: the blame ledger is adopted before
+        # the journal replays the queue, so a predecessor's poison pods
+        # are rejected at this incarnation's cycle gate from the very
+        # first cycle — the successor never re-runs the crash that
+        # produced the blame
+        if self.quarantine is not None:
+            self.quarantine.adopt()
+            self.sched.quarantine = self.quarantine
         self.last_recovery = recover_scheduler(
             self.sched,
             self.journal,
@@ -127,6 +157,26 @@ class LeaderCoordinator:
             verify=self.verify_recovery,
             pod_filter=self.recovery_pod_filter,
         )
+        if self.governor is not None:
+            self.governor.note_boot()
+            self.boot_plan = self.governor.boot_plan()
+            if self.boot_plan.degraded:
+                # DEGRADED boot: shallow pipeline (no deep speculation
+                # while crash cause is unknown) and the device ladder
+                # pre-demoted one level so the first cycles run the
+                # battle-tested paths; the quarantine attach above is
+                # what arms bisection from cycle one
+                if self.pipeline is not None:
+                    self.pipeline.depth = 1
+                self.sched._fallback_level = max(
+                    self.sched._fallback_level, 1
+                )
+                self.sched.extender.health.set(
+                    "leader",
+                    True,
+                    "leading (DEGRADED boot: %d rapid deaths)"
+                    % self.boot_plan.rapid_deaths,
+                )
         self.leading = True
 
     def _on_loss(self, reason: str):
@@ -164,6 +214,16 @@ class LeaderCoordinator:
             return self.leading, drained
         if (
             not self.leading
+            and self.governor is not None
+            and not self.governor.may_boot()
+        ):
+            # crash-loop governor: this incarnation died K times within
+            # the horizon — its boot backoff has not elapsed, so it must
+            # not even CONTEND for the lease (a crash-looping candidate
+            # that keeps winning elections starves healthy standbys)
+            return False, None
+        if (
+            not self.leading
             and self.acquire_gate is not None
             and not self.acquire_gate()
         ):
@@ -179,7 +239,23 @@ class LeaderCoordinator:
             # renewing later under the old epoch would be fenced anyway
             drained = self._on_loss("lease renew lost")
         elif ok and not self.leading:
-            self._on_takeover()
+            try:
+                self._on_takeover()
+            except BootCrashError as exc:
+                # the boot crashed: record a governed death (snapshot →
+                # decide → ledger; K rapid deaths impose backoff and a
+                # DEGRADED next boot), surrender the half-acquired lease
+                # and stay standby — the backoff gate above throttles
+                # the retry instead of letting the loop spin hot
+                if self.governor is not None:
+                    self.governor.note_death(reason=repr(exc))
+                self.elector.release()
+                self.leading = False
+                if self.sched is not None:
+                    self.sched.extender.health.set(
+                        "leader", False, f"boot crashed: {exc!r}"
+                    )
+                return False, None
         return self.leading, drained
 
     def step_down(self):

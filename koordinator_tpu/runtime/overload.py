@@ -248,12 +248,20 @@ class AdmissionController:
     # ---- the terminal shed ----
 
     def shed(
-        self, pod, shard: int, arrival: float, detail: str = ""
+        self,
+        pod,
+        shard: int,
+        arrival: float,
+        detail: str = "",
+        reason: Optional[str] = None,
     ) -> ShedTicket:
         """The ONE canonical shed site (koordlint ``shed-paths`` pass):
         terminal ``shed`` lifecycle event, ``overload_shed_total{band}``
         metric, and the resubmit ticket. Every queue-drop path that
-        shedding introduces funnels here."""
+        shedding introduces funnels here. ``reason`` overrides the
+        ticket's RejectReason value (gray-failure containment PR: a
+        POISON_QUARANTINED shed rides the same funnel — its ticket is
+        redeemable by a changed spec fingerprint, not by time)."""
         band = pod.priority_class
         now = self.clock()
         ticket = ShedTicket(
@@ -262,6 +270,7 @@ class AdmissionController:
             shard=int(shard),
             arrival=arrival,
             shed_at=now,
+            reason=reason or RejectReason.OVERLOAD_SHED.value,
             detail=detail,
         )
         lc = self.lifecycle

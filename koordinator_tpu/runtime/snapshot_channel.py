@@ -416,8 +416,17 @@ class SolverClient:
         retry_counter=None,
         fence=None,
         breaker=None,
+        timeout_warm_s: Optional[float] = None,
     ):
         self.timeout_s = timeout_s
+        #: warm-after-first-success deadline (gray-failure containment
+        #: PR): the COLD first call stays unbounded (it pays the JIT
+        #: compile — a deadline there would always fire), but once any
+        #: call has succeeded the channel is warm and a steady-state
+        #: call that hangs is a gray failure, not a compile. Ignored
+        #: while ``timeout_s`` is set (an explicit deadline wins).
+        self.timeout_warm_s = timeout_warm_s
+        self._warm = False
         self.retry = retry
         self.chaos = chaos or NULL_INJECTOR
         self.retry_counter = retry_counter
@@ -507,10 +516,15 @@ class SolverClient:
                 md = ((EPOCH_METADATA_KEY, str(self.epoch)),)
                 if self.shard is not None:
                     md += ((SHARD_METADATA_KEY, str(self.shard)),)
+            timeout = self.timeout_s
+            if timeout is None and self._warm:
+                timeout = self.timeout_warm_s
             try:
-                return stub(req, timeout=self.timeout_s, metadata=md)
+                out = stub(req, timeout=timeout, metadata=md)
             except grpc.RpcError as exc:
                 raise _map_rpc_error(name, exc) from exc
+            self._warm = True
+            return out
 
         def metered():
             # one breaker verdict per ATTEMPT (the retry policy's

@@ -64,15 +64,18 @@ class ClusterStateHub:
         #: retry_attempts_total (e.g. the scheduler registry)
         self.error_registry = error_registry
         self._informer_seq = 0
-        self.nodes = ObjectTracker()
-        self.node_metrics = ObjectTracker()
-        self.pods = ObjectTracker()
-        self.devices = ObjectTracker()
-        self.quotas = ObjectTracker()
-        self.reservations = ObjectTracker()
-        self.pod_groups = ObjectTracker()
+        # the trackers share the hub's injector so informer.silent_stall
+        # (gray-failure containment PR) can mute delivery at the source
+        # while every watch stays connected
+        self.nodes = ObjectTracker(chaos=self.chaos)
+        self.node_metrics = ObjectTracker(chaos=self.chaos)
+        self.pods = ObjectTracker(chaos=self.chaos)
+        self.devices = ObjectTracker(chaos=self.chaos)
+        self.quotas = ObjectTracker(chaos=self.chaos)
+        self.reservations = ObjectTracker(chaos=self.chaos)
+        self.pod_groups = ObjectTracker(chaos=self.chaos)
         #: NodeResourceTopology reports (the koordlet's CR writes)
-        self.topologies = ObjectTracker()
+        self.topologies = ObjectTracker(chaos=self.chaos)
         self.resync_interval_s = resync_interval_s
         self.informers: List[Informer] = []
         #: snapshot-id → the node Informer that applies nodes into that

@@ -31,6 +31,11 @@ from ..obs import RejectReason, RejectStage, report_exception
 from ..obs import devprof as _devprof
 from ..obs.devprof import NULL_WATCH as _NULL_WATCH
 from ..ops import estimator
+from ..runtime.containment import (
+    POISON_LABEL,
+    PoisonBatchError,
+    spec_fingerprint,
+)
 from ..ops.solver import (
     NodeState,
     PodBatch,
@@ -666,6 +671,23 @@ class BatchScheduler:
         #: uid -> (stage, plugin, reason) for rows the NaN/Inf guard
         #: quarantined this cycle (cleared per external cycle)
         self._numeric_quarantine: Dict[str, tuple] = {}
+        #: gray-failure containment PR: optional
+        #: runtime.containment.QuarantineLedger — when wired, the cycle
+        #: gate rejects blamed pods (POISON_QUARANTINED) before they can
+        #: re-crash a dispatch, and the bisection containment records
+        #: blame when the fallback ladder's floor raises. None = the
+        #: pre-PR behavior (a poison batch fails the whole cycle).
+        self.quarantine = None
+        #: gray-failure containment PR: optional zero-arg callable (the
+        #: StalenessWatchdog's ``stale`` bound method) snapshotted ONCE
+        #: per cycle into ``_cycle_stale`` — evidence-hungry actions
+        #: (preemption) refuse on stale informer evidence while plain
+        #: placement continues. None = always fresh.
+        self.staleness = None
+        self._cycle_stale = False
+        #: pods isolated by the poison bisection THIS dispatch (consumed
+        #: into unschedulable right after _dispatch_with_fallback)
+        self._cycle_poisoned: List[Pod] = []
         #: resident PodBatch interning (ROADMAP item c): lowered per-pod
         #: rows cached across cycles keyed on (uid, spec fingerprint) so a
         #: retry-heavy stream doesn't re-parse the same still-pending pod
@@ -1271,6 +1293,23 @@ class BatchScheduler:
             "solver.nan_rows"
         ):
             est[0, 0] = float("nan")
+        # chaos: a poison batch — lowering RAISES whenever a marked pod
+        # is present (emulates a spec that deterministically crashes the
+        # solver path, e.g. an adversarial topology constraint). Unlike
+        # nan_rows this is not a value corruption the numeric guard can
+        # absorb: the error escapes every ladder level and only the
+        # bisection containment (_contain_poison) can isolate WHICH pod
+        # is to blame — the error deliberately carries no uid.
+        if (
+            inject
+            and self.chaos.enabled
+            and any(POISON_LABEL in (p.meta.labels or {}) for p in pods)
+            and self.chaos.fire("solver.poison_batch")
+        ):
+            raise PoisonBatchError(
+                "lowering crashed: batch of %d contains a poison spec"
+                % len(pods)
+            )
         # NaN/Inf guard: a single non-finite row would propagate through
         # the cost sums and corrupt EVERY pod's ranking in the chunk —
         # quarantine the offending rows (valid=False, zeroed) and
@@ -1477,6 +1516,15 @@ class BatchScheduler:
             self._cycle_resv_binds = []
             self._cycle_resv_affinity = ()
             self._cycle_resv_pre_table = None
+            self._cycle_poisoned = []
+            # staleness snapshotted ONCE per cycle (snapshot-once →
+            # decide): every gate below reads the same verdict, and the
+            # decision replay sees one input, not a race
+            self._cycle_stale = (
+                bool(self.staleness())
+                if self.staleness is not None
+                else False
+            )
             self._pre_cycle_version = self.snapshot.version
             self._cycle_t0 = _time.perf_counter()
             fwext.monitor.start_batch(pending)
@@ -1505,6 +1553,34 @@ class BatchScheduler:
                 "frameworkext",
                 RejectReason.POD_TRANSFORMER_DROPPED,
             )
+        # gray-failure containment: pods blamed on the quarantine ledger
+        # are rejected AT THE GATE — a poison spec must not reach a solve
+        # and re-crash the cycle it already crashed once. The check runs
+        # post-transform so the fingerprint covers the spec that would
+        # actually be lowered; a CHANGED fingerprint redeems the blame
+        # inside ``blamed()`` and the pod proceeds normally.
+        quarantined_gated: List[Pod] = []
+        if self.quarantine is not None and self.quarantine.active():
+            kept: List[Pod] = []
+            for pod in pending:
+                if self.quarantine.blamed(
+                    pod.meta.uid, spec_fingerprint(pod)
+                ):
+                    quarantined_gated.append(pod)
+                    rej.record(
+                        cid,
+                        pod,
+                        RejectStage.GATE,
+                        "poison_quarantine",
+                        RejectReason.POISON_QUARANTINED,
+                    )
+                else:
+                    kept.append(pod)
+            if quarantined_gated:
+                fwext.registry.get("poison_quarantined_total").inc(
+                    len(quarantined_gated)
+                )
+                pending = kept
         # PreEnqueue gate + gang-adjacent ordering (coscheduling NextPod):
         # whole gangs land in one solver batch.
         # Reservation pre-match: pods owned by an Available reservation
@@ -1771,7 +1847,12 @@ class BatchScheduler:
             )
 
         bound: List[Tuple[Pod, str]] = list(reserved_bound)
-        unsched: List[Pod] = list(gated) + list(dropped) + list(affinity_unsched)
+        unsched: List[Pod] = (
+            list(gated)
+            + list(dropped)
+            + list(affinity_unsched)
+            + list(quarantined_gated)
+        )
         rounds = 0
         chunks = self._chunks(eligible)
         # cross-cycle pipelining (perf PR 4): a CyclePipeline may have
@@ -1844,6 +1925,13 @@ class BatchScheduler:
             # reference; a dispatch failure demotes the ladder for
             # subsequent cycles instead of killing this one
             solves = self._dispatch_with_fallback(chunks, sub)
+        # consume pods the poison bisection isolated during THIS dispatch:
+        # they were excluded from the re-dispatched healthy chunks and are
+        # unschedulable this cycle (the cycle gate rejects them from the
+        # next one; their _cycle_rejects records flush at the tail)
+        if self._cycle_poisoned:
+            unsched.extend(self._cycle_poisoned)
+            self._cycle_poisoned = []
         fence_failed = False
         if tr.enabled and solves and not isinstance(solves[0][2], _HostSolve):
             # fence the async dispatches so the solve span's duration is
@@ -2086,10 +2174,23 @@ class BatchScheduler:
             or self._cycle_fetch_deferred
             or self._cycle_commit_rolled_back
         )
+        # gray-failure containment: preemption is evidence-hungry — it
+        # evicts REAL victims based on what the informers claim the
+        # cluster looks like. A stale snapshot (silent watch stall) means
+        # the evidence may be minutes old; refuse eviction and let plain
+        # placement continue until events resume.
+        if self._cycle_stale and not _retry and unsched:
+            if (
+                self.quotas.enable_preemption and self.quotas.quota_count > 0
+            ) or self.enable_priority_preemption:
+                fwext.registry.get("stale_evidence_refusals_total").labels(
+                    action="preemption"
+                ).inc()
         if (
             not _retry
             and unsched
             and not infra_deferral
+            and not self._cycle_stale
             and self.quotas.enable_preemption
             and self.quotas.quota_count > 0
         ):
@@ -2168,6 +2269,7 @@ class BatchScheduler:
             not _retry
             and unsched
             and not infra_deferral
+            and not self._cycle_stale
             and self.enable_priority_preemption
         ):
             from .plugins.coscheduling import gang_key_of as _gang_of
@@ -2380,7 +2482,97 @@ class BatchScheduler:
             "assign", cat="scheduler", mode="host_reference",
             chunks=len(chunks),
         ):
-            return self._dispatch_host_reference(chunks, sub)
+            try:
+                return self._dispatch_host_reference(chunks, sub)
+            except Exception as exc:  # noqa: BLE001 — containment floor
+                # the ladder's floor ALSO raised: every level crashed on
+                # the same batch, which is the poison-batch signature.
+                # Bisect to isolate the minimal blame set instead of
+                # failing the whole cycle forever.
+                return self._contain_poison(chunks, sub, exc)
+
+    def _contain_poison(self, chunks, sub, exc: BaseException):
+        """Poison-batch bisection: every fallback level crashed on this
+        batch, so some pod's lowering deterministically raises. Probe
+        groups of pods through throwaway lowerings (binary search over
+        each failing chunk) until the failing singletons are isolated,
+        blame them on the quarantine ledger (sealed journal record — a
+        takeover adopts the blame BEFORE replaying its queue), and
+        re-dispatch the remaining healthy pods through the host
+        reference so the rest of the batch still places this cycle.
+
+        If no quarantine ledger is wired, or the probes cannot pin a
+        poison pod (the failure is not pod-deterministic), the original
+        error is re-raised — containment never masks a real outage."""
+        reg = self.extender.registry
+        probes = reg.get("poison_bisect_probes_total")
+
+        def _probe(grp):
+            """The exception this group's lowering raises, or None."""
+            probes.inc()
+            try:
+                # stash=False + private quarantine dict: a probe must
+                # not pollute commit state or the cycle's NaN records
+                self._lower_rows(grp, stash=False, quarantine={})
+                return None
+            except Exception as probe_exc:  # noqa: BLE001 — probing for this
+                if len(grp) == 1:
+                    # singleton isolation: THIS exception is the pod's
+                    # blame evidence — report it once per blamed pod
+                    # (per-probe reporting would count a dozen split
+                    # probes for one contained fault)
+                    report_exception(
+                        "scheduler.poison_probe", probe_exc, registry=reg
+                    )
+                return probe_exc
+
+        poison: List[tuple] = []   # (pod, its own lowering exception)
+        stack: List[List[Pod]] = [list(c) for c in chunks if len(c)]
+        while stack:
+            grp = stack.pop()
+            probe_exc = _probe(grp)
+            if probe_exc is None:
+                continue
+            if len(grp) == 1:
+                poison.append((grp[0], probe_exc))
+                continue
+            mid = len(grp) // 2
+            stack.append(grp[:mid])
+            stack.append(grp[mid:])
+        if not poison:
+            raise exc
+        cid = self.extender.current_cycle_id
+        for pod, pod_exc in poison:
+            if self.quarantine is not None:
+                self.quarantine.blame(
+                    pod.meta.uid,
+                    spec_fingerprint(pod),
+                    evidence=repr(pod_exc),
+                    cycle=cid,
+                )
+            self._cycle_rejects.append(
+                (
+                    pod,
+                    RejectStage.SOLVE,
+                    "poison_quarantine",
+                    RejectReason.POISON_QUARANTINED,
+                )
+            )
+        reg.get("poison_quarantined_total").inc(len(poison))
+        self._cycle_poisoned.extend(pod for pod, _e in poison)
+        report_exception("scheduler.poison_quarantine", exc, registry=reg)
+        poison_uids = {pod.meta.uid for pod, _e in poison}
+        healthy = [
+            kept
+            for kept in (
+                [p for p in c if p.meta.uid not in poison_uids]
+                for c in chunks
+            )
+            if kept
+        ]
+        if not healthy:
+            return []
+        return self._dispatch_host_reference(healthy, sub)
 
     def _dispatch_host_reference(self, chunks, sub: Optional[np.ndarray] = None):
         """Level-2 degraded mode: a pure-numpy greedy assigner that keeps

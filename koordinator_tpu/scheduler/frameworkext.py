@@ -282,6 +282,32 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         "controller's decision (shadows never act)",
         labels=("controller",),
     )
+    reg.counter(
+        "poison_quarantined_total",
+        "pods blamed on the poison-quarantine ledger or rejected at the "
+        "cycle gate because a live blame matched their spec fingerprint",
+    )
+    reg.counter(
+        "poison_bisect_probes_total",
+        "throwaway lowering probes run by the poison-batch bisection "
+        "while isolating the minimal blame set",
+    )
+    reg.gauge(
+        "snapshot_staleness_seconds",
+        "age of the oldest undelivered informer event (0 when every "
+        "watch is caught up; a connected-but-silent stall grows it)",
+    )
+    reg.counter(
+        "stale_evidence_refusals_total",
+        "evidence-hungry actions (preemption, descheduler eviction, "
+        "topology split) refused because informer snapshots were stale",
+        labels=("action",),
+    )
+    reg.counter(
+        "crash_loop_backoffs_total",
+        "boot backoffs imposed by the crash-loop governor after K rapid "
+        "deaths within its horizon",
+    )
     ensure_exceptions_counter(reg)
     return reg
 

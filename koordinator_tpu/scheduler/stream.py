@@ -25,6 +25,8 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ..api.types import Pod
+from ..obs.rejections import RejectReason
+from ..runtime.containment import spec_fingerprint
 from .batch_solver import BatchScheduler, ScheduleOutcome
 
 
@@ -287,6 +289,9 @@ class StreamScheduler:
                     # genuinely evaluated
                     self._band_add(pod, +1)
                     self._queue.append((pod, t_arr, tries))
+                elif self._shed_quarantined(pod, t_arr):
+                    # decided terminally via the ticketed shed path
+                    results.append((pod, None, t_done - t_arr))
                 elif tries + 1 < self.max_retries:
                     self._band_add(pod, +1)
                     self._queue.append((pod, t_arr, tries + 1))
@@ -339,6 +344,32 @@ class StreamScheduler:
                 self.slo.observe_latency(self.shard, e2e)
         elif self.slo is not None:
             self.slo.observe_latency(self.shard, lat)
+
+    def _shed_quarantined(self, pod: Pod, t_arr: float) -> bool:
+        """Poison-quarantined exit path (gray-failure containment PR):
+        a pod the quarantine ledger blames cannot place until its SPEC
+        changes — re-queueing it only burns retry budget on a verdict
+        that is deterministic. Shed it through the admission
+        controller's ticketed path with reason POISON_QUARANTINED: the
+        terminal lifecycle event fires and the resubmit ticket stays
+        REDEEMABLE (a changed fingerprint lifts the blame at the cycle
+        gate and the resubmitted pod schedules normally). Returns True
+        when the pod was shed; False (no overload controller, no
+        ledger, or no live blame) keeps the ordinary retry path."""
+        ov = self.overload
+        q = self.scheduler.quarantine
+        if ov is None or q is None:
+            return False
+        if not q.blamed(pod.meta.uid, spec_fingerprint(pod)):
+            return False
+        ov.shed(
+            pod,
+            self.shard,
+            t_arr,
+            detail="poison_quarantined",
+            reason=RejectReason.POISON_QUARANTINED.value,
+        )
+        return True
 
     def _note_exhausted(self, pod: Pod) -> None:
         """Terminally unschedulable (retry budget burned): a ``decide``
@@ -449,6 +480,9 @@ class StreamScheduler:
                 # fencing rejection ≠ scheduling verdict: no retry charge
                 self._band_add(pod, +1)
                 self._queue.append((pod, t_arr, tries))
+            elif self._shed_quarantined(pod, t_arr):
+                # decided terminally via the ticketed shed path
+                results.append((pod, None, t_done - t_arr))
             elif tries + 1 < self.max_retries:
                 self._band_add(pod, +1)
                 self._queue.append((pod, t_arr, tries + 1))

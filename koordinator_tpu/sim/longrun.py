@@ -588,6 +588,7 @@ def _sweep_decisions(records, context: str):
         controller_gaps,
         decision_trace,
     )
+    from koordinator_tpu.runtime.containment import CrashLoopGovernor
     from koordinator_tpu.runtime.elastic import TopologyController
     from koordinator_tpu.runtime.overload import (
         AdmissionController,
@@ -602,6 +603,7 @@ def _sweep_decisions(records, context: str):
         "admission": AdmissionController.decide,
         "breaker": CircuitBreaker.decide,
         "topology": TopologyController.decide,
+        "crashloop": CrashLoopGovernor.decide,
     }
     gaps = controller_gaps(records)
     assert not gaps, (
@@ -1614,6 +1616,566 @@ def run_chaos_soak(
             level="1"
         ),
     }
+    return stats
+
+
+#: (stats key, registry metric) — containment counters the gray-failure
+#: soak folds across incarnations (every restart builds a fresh
+#: scheduler registry, so per-incarnation values must be accumulated
+#: at the kill and again at the end)
+_CONTAINMENT_COUNTERS = (
+    ("poison_quarantined_total", "poison_quarantined_total"),
+    ("bisect_probes_total", "poison_bisect_probes_total"),
+    ("crash_backoffs_total", "crash_loop_backoffs_total"),
+)
+
+
+def run_gray_failure_soak(
+    cycles: int = 40,
+    seed: int = 0,
+    n_nodes: int = 12,
+    max_arrivals: int = 6,
+    drain_limit: int = 40,
+    verbose: bool = False,
+) -> dict:
+    """Gray-failure containment soak (gray-failure containment PR):
+    wrong-but-alive failure modes under a deterministic fixed-cycle
+    schedule, asserting the containment invariants end to end:
+
+    * **poison-batch quarantine** — two labeled poison pods arrive at
+      ``poison_cycle`` with ``solver.poison_batch`` armed: every ladder
+      level crashes, the bisection isolates EXACTLY the poison set,
+      blames it on the sealed quarantine ledger, and everything else in
+      the batch still places; every later cycle rejects the blamed pods
+      at the gate without lowering them (the fire count freezes at the
+      isolation cycle);
+    * **blame survives the kill** — a kill-restart after the quarantine
+      proves the successor adopts blame BEFORE replaying its queue: the
+      replayed poison pods are gate-rejected, never re-lowered, so the
+      successor does not re-crash (``solver.poison_batch`` never fires
+      again) and zero-dup / zero-lost-ack hold across the takeover;
+    * **crash-loop governor** — the kill plus ``scheduler.boot_crash``
+      (armed ``times=2``) produce K=3 rapid deaths on the shared crash
+      ledger: the third death decides exponential boot backoff
+      (snapshot-once → pure decide → DecisionLedger ``crashloop``
+      records, swept gap-free and recompute-replayed at the end), the
+      backed-off candidate does not even contend, and the eventual
+      takeover boots DEGRADED (ladder pre-demoted, bisection armed);
+    * **informer staleness watchdog** — ``informer.silent_stall`` mutes
+      every tracker fan-out for a window while the driver keeps
+      publishing: the watchdog's rv-lag check flips the
+      ``snapshot_freshness`` health row, the scheduler's captured
+      ``_cycle_stale`` goes true, and the descheduler refuses whole
+      reconcile passes (the submitted eviction stays PENDING) while
+      plain placement continues; disarm + re-list heal everything and
+      the eviction then proceeds;
+    * **same seed ⇒ same fault trace** (the returned ``fault_trace``).
+    """
+    import random as _random
+
+    import numpy as np
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.api.types import (
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from koordinator_tpu.chaos import FaultInjector
+    from koordinator_tpu.core.journal import (
+        BindJournal,
+        EpochFence,
+        MemoryJournalStore,
+    )
+    from koordinator_tpu.core.snapshot import ClusterSnapshot
+    from koordinator_tpu.descheduler.migration import (
+        MigrationController,
+        MigrationMode,
+        MigrationPhase,
+    )
+    from koordinator_tpu.obs.decisions import DecisionLedger
+    from koordinator_tpu.runtime.containment import (
+        POISON_LABEL,
+        CrashLoopGovernor,
+        QuarantineLedger,
+        StalenessWatchdog,
+        spec_fingerprint,
+    )
+    from koordinator_tpu.runtime.ha import LeaderCoordinator
+    from koordinator_tpu.runtime.statehub import ClusterStateHub
+    from koordinator_tpu.scheduler.batch_solver import (
+        BatchScheduler,
+        LoadAwareArgs,
+    )
+    from koordinator_tpu.utils.leaderelection import (
+        InMemoryLeaseLock,
+        LeaderElector,
+    )
+
+    if cycles < 30:
+        raise ValueError(
+            "the gray-failure schedule needs >= 30 cycles to order its "
+            "poison / kill / crash-loop / stall phases"
+        )
+
+    ALLOC_CPU, ALLOC_MEM = 32_000.0, 128 * 1024.0
+    POD_CPU, POD_MEM = 2_000.0, 4_096.0
+    LIFETIME = 6
+    K_DEATHS = 3             # governor threshold: kill + 2 boot crashes
+    rng = _random.Random(seed)
+    chaos = FaultInjector(seed=seed)
+
+    # ---- fixed-cycle schedule (no rng draws — the determinism rule) ----
+    poison_cycle = cycles // 5
+    restart_cycle = max(poison_cycle + 6, (2 * cycles) // 5 + 2)
+    stall_cycle = max(restart_cycle + 10, (7 * cycles) // 10)
+    stall_end = stall_cycle + 5
+
+    # ---- durable substrate: outlives every scheduler incarnation ----
+    fence = EpochFence()
+    journal_store = MemoryJournalStore(name="bind")
+    quarantine_store = MemoryJournalStore(name="quarantine")
+    crash_store = MemoryJournalStore(name="crashloop")
+    decision_store = MemoryJournalStore(name="decisions")
+    lease_lock = InMemoryLeaseLock()
+    sim_cycle = [0]
+
+    def _sim_now() -> float:
+        # one shared virtual clock: lease election, the crash-loop
+        # governor and the staleness watchdog all tick in cycle units
+        return float(sim_cycle[0])
+
+    gen = [0]
+
+    def _make_instance():
+        """One scheduler 'process' plus its containment organs. Called
+        at start and again after the kill-restart."""
+        snapshot = ClusterSnapshot()
+        s = BatchScheduler(
+            snapshot,
+            LoadAwareArgs(usage_thresholds={}),
+            batch_bucket=16,
+            chaos=chaos,
+            fallback_repromote_after=3,
+            journal=BindJournal(journal_store),
+            fence=fence,
+        )
+        s.extender.monitor.stop_background()
+        r = s.extender.registry
+        chaos.bind_counter(r.get("fault_injected_total"))
+        dl = DecisionLedger(
+            decision_store,
+            capacity=4096,
+            incarnation=f"gray-gen{gen[0]}",
+        )
+        s.attach_decision_ledger(dl)
+        quar = QuarantineLedger(
+            store=quarantine_store,
+            incarnation=f"gray-gen{gen[0]}",
+            registry=r,
+        )
+        gv = CrashLoopGovernor(
+            store=crash_store,
+            k=K_DEATHS,
+            horizon_s=10.0,
+            base_backoff_s=2.0,
+            max_backoff_s=8.0,
+            clock=_sim_now,
+            decisions=dl,
+            registry=r,
+            incarnation=f"gray-gen{gen[0]}",
+        )
+        wdog = StalenessWatchdog(
+            horizon_s=2.0, clock=_sim_now,
+            health=s.extender.health, registry=r,
+        )
+        # the scheduler captures the verdict once per cycle into
+        # _cycle_stale (koordlint staleness-snapshot capture site)
+        s.staleness = wdog.stale
+        gen[0] += 1
+        return snapshot, s, r, quar, gv, wdog
+
+    snap, sched, reg, quar, gov, wd = _make_instance()
+
+    hub = ClusterStateHub(
+        chaos=chaos, health=sched.extender.health, error_registry=reg
+    )
+    hub.wire_scheduler(sched)
+    hub.start()
+    wd.watch_hub(hub)
+    for i in range(n_nodes):
+        hub.publish(
+            hub.nodes,
+            Node(
+                meta=ObjectMeta(name=f"n{i:03d}"),
+                status=NodeStatus(
+                    allocatable={
+                        ext.RES_CPU: ALLOC_CPU,
+                        ext.RES_MEMORY: ALLOC_MEM,
+                    }
+                ),
+            ),
+        )
+    assert hub.wait_synced()
+
+    def _make_coordinator():
+        elector = LeaderElector(
+            lease_lock,
+            f"gray-gen{gen[0] - 1}",
+            lease_duration=3.0,
+            renew_deadline=2.0,
+            retry_period=0.5,
+            now_fn=_sim_now,
+            sleep_fn=lambda _dt: None,
+        )
+        return LeaderCoordinator(
+            sched,
+            elector,
+            fence,
+            sched.bind_journal,
+            hub=hub,
+            chaos=chaos,
+            quarantine=quar,
+            governor=gov,
+        )
+
+    coord = _make_coordinator()
+
+    # descheduler leg: one synthetic victim submitted once the stall is
+    # DETECTED (submitting earlier would evict before staleness gates it).
+    # The job is EVICT_DIRECTLY so the (empty) reservation manager never
+    # schedules anything — the leg under test is the stale-evidence gate.
+    from koordinator_tpu.scheduler.plugins.reservation import (
+        ReservationManager,
+    )
+
+    evictions: list = []
+    mig = MigrationController(
+        reservations=ReservationManager(sched, clock=_sim_now),
+        evict_fn=lambda pod, reason: evictions.append(pod.meta.uid)
+        or True,
+        freshness=lambda: wd_ref[0].stale(),
+    )
+    victim = Pod(
+        meta=ObjectMeta(name="victim-hot"),
+        spec=PodSpec(
+            requests={ext.RES_CPU: POD_CPU, ext.RES_MEMORY: POD_MEM}
+        ),
+    )
+    victim_job = None
+    wd_ref = [wd]   # rebound on restart: the live watchdog gates evictions
+
+    stats = {
+        "cycles": 0,
+        "arrived": 0,
+        "placed": 0,
+        "completed": 0,
+        "takeovers": 0,
+        "crash_restarts": 0,
+        "cycles_without_leader": 0,
+        "stale_cycles": 0,
+        "freshness_degraded_cycles": 0,
+        "stale_sched_cycles": 0,
+        "poison_fires_isolation": 0,
+        "degraded_boot": False,
+        "degraded_fallback_level": 0,
+        "poison_quarantined_total": 0.0,
+        "bisect_probes_total": 0.0,
+        "crash_backoffs_total": 0.0,
+    }
+    placed: dict = {}
+    live: list = []
+    pending: list = []
+    poison_uids: set = set()
+    poison_pods: list = []
+    pod_seq = 0
+
+    def _poison_fires() -> int:
+        return sum(
+            1 for _s, pt, _k in chaos.trace if pt == "solver.poison_batch"
+        )
+
+    total_cycles = cycles + drain_limit
+    for cycle in range(total_cycles):
+        sim_cycle[0] = cycle
+        stats["cycles"] += 1
+        arriving = []
+        if cycle < cycles:
+            if cycle == poison_cycle:
+                # the poison specs + the armed point (label-gated: it
+                # raises only while a carrier is in the lowered group,
+                # which is exactly what lets the bisection converge)
+                chaos.arm("solver.poison_batch")
+                for tag in ("a", "b"):
+                    poison = Pod(
+                        meta=ObjectMeta(
+                            name=f"poison-{tag}",
+                            labels={POISON_LABEL: "1"},
+                        ),
+                        spec=PodSpec(
+                            requests={
+                                ext.RES_CPU: POD_CPU,
+                                ext.RES_MEMORY: POD_MEM,
+                            },
+                            priority=9000,
+                        ),
+                    )
+                    poison_uids.add(poison.meta.uid)
+                    poison_pods.append(poison)
+                    arriving.append(poison)
+            if cycle == stall_cycle:
+                chaos.arm("informer.silent_stall")
+            for _ in range(rng.randint(1, max_arrivals)):
+                pod_seq += 1
+                arriving.append(
+                    Pod(
+                        meta=ObjectMeta(name=f"gray-{pod_seq:05d}"),
+                        spec=PodSpec(
+                            requests={
+                                ext.RES_CPU: POD_CPU,
+                                ext.RES_MEMORY: POD_MEM,
+                            },
+                            priority=9000 if pod_seq % 3 else 5500,
+                        ),
+                    )
+                )
+            stats["arrived"] += len(arriving)
+        pending.extend(arriving)
+
+        if cycle == stall_end:
+            # events resume; the suppressed ones are GONE from the watch
+            # streams, so recovery is a re-list (disarm FIRST — the
+            # background re-list threads must never race an armed point)
+            chaos.disarm("informer.silent_stall")
+            hub.disconnect()
+
+        if cycle == restart_cycle:
+            # kill -9: process state dies; the lease, fence, journal and
+            # BOTH containment ledgers survive. The dying incarnation's
+            # governor records the death (rapid-death #1); the armed
+            # boot_crash kills the next 2 takeover attempts, so the
+            # crash-loop governor sees K=3 rapid deaths and imposes
+            # backoff + a DEGRADED final boot.
+            stats["crash_restarts"] += 1
+            gov.note_death(reason="kill -9 (injected process death)")
+            hub.detach_consumers()
+            # per-incarnation counters die with the registry — fold the
+            # dying instance's containment tallies into the soak totals
+            for key, metric in _CONTAINMENT_COUNTERS:
+                stats[key] += reg.get(metric).value()
+            snap, sched, reg, quar, gov, wd = _make_instance()
+            hub.health = sched.extender.health
+            hub.error_registry = reg
+            hub.wire_scheduler(sched)
+            hub.start()
+            wd.watch_hub(hub)
+            wd_ref[0] = wd
+            coord = _make_coordinator()
+            chaos.arm("scheduler.boot_crash", times=2)
+
+        # ---- election step ----
+        was_leading = coord.leading
+        leading, _drained = coord.tick()
+        if leading and not was_leading:
+            stats["takeovers"] += 1
+            if cycle > restart_cycle:
+                # the governed post-crash-loop takeover: DEGRADED boot
+                plan = coord.boot_plan
+                stats["degraded_boot"] = bool(plan and plan.degraded)
+                stats["degraded_fallback_level"] = sched._fallback_level
+
+        if not leading:
+            stats["cycles_without_leader"] += 1
+        else:
+            fed = list(pending)
+            pending = []
+            out = sched.schedule(fed)
+            if sched._cycle_stale:
+                stats["stale_sched_cycles"] += 1
+            for pod, node in out.bound:
+                assert pod.meta.uid not in placed, (
+                    f"pod {pod.meta.name} placed twice: "
+                    f"{placed[pod.meta.uid]} then {node}"
+                )
+                placed[pod.meta.uid] = node
+                pod.spec.node_name = node
+                hub.publish(hub.pods, pod)
+                live.append((pod, node, cycle + LIFETIME))
+            stats["placed"] += len(out.bound)
+            pending = list(out.unschedulable)
+
+        if cycle == poison_cycle:
+            # the whole isolation happened THIS cycle (ladder crash →
+            # bisection → blame); the count must freeze here forever
+            stats["poison_fires_isolation"] = _poison_fires()
+            assert set(quar.entries()) == poison_uids, (
+                "bisection blamed the wrong set: "
+                f"{set(quar.entries())} != {poison_uids}"
+            )
+
+        # ---- completions release capacity through the informer ----
+        still = []
+        for pod, node, done in live:
+            if done <= cycle:
+                hub.delete(hub.pods, pod)
+                stats["completed"] += 1
+            else:
+                still.append((pod, node, done))
+        live = still
+
+        in_stall = stall_cycle <= cycle < stall_end
+        if in_stall:
+            # the armed stall suppresses every fan-out: nothing to wait
+            # for — the informers are exactly as far as they will get
+            hub.wait_synced(timeout=0.05)
+        else:
+            assert hub.wait_synced()
+
+        # ---- staleness watchdog sweep (virtual clock = cycle) ----
+        wd.check(float(cycle))
+        if wd.stale():
+            stats["stale_cycles"] += 1
+            row = sched.extender.health.snapshot().get(
+                "snapshot_freshness"
+            )
+            if row is not None and not row["ok"]:
+                stats["freshness_degraded_cycles"] += 1
+            if victim_job is None:
+                victim_job = mig.submit(
+                    victim, MigrationMode.EVICT_DIRECTLY
+                )
+        if victim_job is not None:
+            mig.reconcile(now=float(cycle))
+
+        # ---- per-cycle invariants ----
+        want = np.zeros_like(snap.nodes.requested)
+        for uid, ap in snap._assumed.items():
+            want[ap.node_idx] += ap.request
+        np.testing.assert_allclose(snap.nodes.requested, want, atol=1e-3)
+        if verbose and cycle % 10 == 0:
+            print(
+                f"cycle={cycle:3d} pending={len(pending):3d} "
+                f"placed={stats['placed']} leader={leading} "
+                f"stale={wd.stale()}"
+            )
+
+        if (
+            cycle >= cycles
+            and {p.meta.uid for p in pending} == poison_uids
+            and victim_job is not None
+            and victim_job.phase == MigrationPhase.SUCCEEDED
+        ):
+            break
+
+    # ---- end-state assertions ----
+    # exactly the poison set quarantined; 100% placement of the rest
+    assert {p.meta.uid for p in pending} == poison_uids, (
+        f"pending != poison set: {[p.meta.name for p in pending]}"
+    )
+    assert (
+        stats["placed"]
+        == stats["arrived"] - len(poison_uids)
+        == len(placed)
+    )
+    # blame ledger: exactly the poison pods, at their CURRENT spec
+    # fingerprints (the redeemable-ticket key a fixed spec would change)
+    entries = quar.entries()
+    assert set(entries) == poison_uids
+    for pod in poison_pods:
+        assert entries[pod.meta.uid]["fp"] == spec_fingerprint(pod)
+    # the successor adopted blame BEFORE replay: every fire happened at
+    # the isolation cycle — the kill-restart at restart_cycle (later)
+    # re-fed the poison pods and they were gate-rejected, never
+    # re-lowered, so the count never moved again
+    assert _poison_fires() == stats["poison_fires_isolation"] > 0, (
+        "solver.poison_batch fired after isolation — a successor "
+        "re-lowered quarantined pods"
+    )
+    # crash-loop: kill + exactly 2 injected boot crashes = K deaths,
+    # backoff recorded, bounded leaderless gap, DEGRADED final boot
+    boot_crashes = sum(
+        1 for _s, pt, _k in chaos.trace if pt == "scheduler.boot_crash"
+    )
+    assert boot_crashes == 2, boot_crashes
+    assert gov.deaths == K_DEATHS, gov.deaths
+    assert stats["takeovers"] >= 2
+    assert stats["cycles_without_leader"] <= 10, (
+        f"crash-loop governor let the leaderless gap run away: "
+        f"{stats['cycles_without_leader']} cycles"
+    )
+    assert stats["degraded_boot"], "post-crash-loop boot was not DEGRADED"
+    assert stats["degraded_fallback_level"] >= 1
+    # staleness: the watchdog flipped health, scheduling captured the
+    # verdict, the descheduler refused while stale and proceeded after
+    assert stats["stale_cycles"] >= 1
+    assert stats["freshness_degraded_cycles"] >= 1
+    assert stats["stale_sched_cycles"] >= 1
+    assert mig.refused_stale >= 1
+    assert victim_job is not None
+    assert victim_job.phase == MigrationPhase.SUCCEEDED
+    assert evictions == [victim.meta.uid]
+    assert not wd.stale(), "stall healed but the watchdog still reports stale"
+    # resident device state reconverged bit-exactly
+    assert_resident_state_converged(sched)
+    # capture the ledger BEFORE disarming (fired_counts of a disarmed
+    # point vanishes; the trace is the durable record)
+    stats["fault_trace"] = list(chaos.trace)
+    counts: dict = {}
+    for _s, pt, _k in chaos.trace:
+        counts[pt] = counts.get(pt, 0) + 1
+    stats["faults"] = counts
+    chaos.disarm()
+    # decision sweep: crashloop records gap-free and recompute-clean
+    dec_records = sorted(
+        decision_store.load(), key=lambda r: r.get("seq", 0)
+    )
+    crashloop_recs = [
+        r for r in dec_records if r.get("controller") == "crashloop"
+    ]
+    assert len(crashloop_recs) == K_DEATHS
+    assert any(
+        r["action"].get("op") == "backoff" for r in crashloop_recs
+    ), "K rapid deaths never decided a backoff"
+    stats["decision_trace"] = _sweep_decisions(
+        dec_records, context="gray-failure soak decisions"
+    )
+    stats["decisions_total"] = len(dec_records)
+    # zero lost acknowledged bindings across the takeover chain
+    ha_rep = BindJournal(journal_store).replay()
+    lost_acked = [u for u in ha_rep.live if u not in placed]
+    assert not lost_acked, (
+        f"{len(lost_acked)} journal-acknowledged bindings lost"
+    )
+    # ledger dumps (live + quarantined sidecars) so the fsck acceptance
+    # test round-trips EXACTLY what this soak's stores ended up holding
+    stats["quarantine_dump"] = [
+        dict(r) for r in quarantine_store.load()
+    ] + [dict(r) for r in quarantine_store.quarantined]
+    stats["crashloop_dump"] = [dict(r) for r in crash_store.load()] + [
+        dict(r) for r in crash_store.quarantined
+    ]
+    stats["bind_journal_live"] = sorted(ha_rep.live)
+    # every subsystem recovers before the health rows freeze (informer
+    # re-list backoff is wall-clock on background threads)
+    import time as _walltime
+
+    deadline = _walltime.monotonic() + 10.0
+    while (
+        not sched.extender.health.ok()
+        and _walltime.monotonic() < deadline
+    ):
+        _walltime.sleep(0.05)
+    hub.stop()
+    stats["health_ok"] = sched.extender.health.ok()
+    stats["health_detail"] = {
+        k: v
+        for k, v in sched.extender.health.snapshot().items()
+        if not v["ok"]
+    }
+    for key, metric in _CONTAINMENT_COUNTERS:
+        stats[key] += reg.get(metric).value()
     return stats
 
 

@@ -91,11 +91,17 @@ class _Watch:
 class ObjectTracker:
     """Versioned object store + watch fan-out (one per resource kind)."""
 
-    def __init__(self):
+    def __init__(self, chaos=None):
+        from ..chaos import NULL_INJECTOR
+
         self._lock = threading.Lock()
         self._objects: Dict[str, Tuple[object, int]] = {}
         self._rv = 0
         self._watches: List[_Watch] = []
+        #: fault injector for ``informer.silent_stall`` (gray-failure
+        #: containment PR): the tracker is where delivery can go silent
+        #: while every watch stays open
+        self.chaos = chaos or NULL_INJECTOR
 
     def _fanout(self, event: WatchEvent) -> None:
         """Deliver under the tracker lock: events reach every watch in
@@ -103,6 +109,13 @@ class ObjectTracker:
         consumer's stale-replay check drop a live event), and closed
         watches (overflow / abandoned after a re-list) are pruned here so
         they cannot accumulate."""
+        if self.chaos.enabled and self.chaos.fire("informer.silent_stall"):
+            # gray failure: the rv advanced, the watches stay OPEN, the
+            # event is never delivered — consumers' caches silently
+            # freeze with /healthz green. Recovery is a re-list (the
+            # suppressed events are gone from the watch stream); the
+            # staleness watchdog is what notices the rv gap.
+            return
         alive = []
         for w in self._watches:
             w.deliver(event)
@@ -131,6 +144,12 @@ class ObjectTracker:
         """(objects, resource_version) — the LIST verb."""
         with self._lock:
             return {k: o for k, (o, _v) in self._objects.items()}, self._rv
+
+    def version(self) -> int:
+        """Current resource version — the freshness watchdog's "how far
+        the world has moved" side of the lag comparison."""
+        with self._lock:
+            return self._rv
 
     def watch(self, since: int) -> _Watch:
         """Open a watch from ``since``; events older than ``since`` are
@@ -240,6 +259,13 @@ class Informer:
     def keys(self) -> List[str]:
         with self._lock:
             return list(self._cache)
+
+    def observed_rv(self) -> int:
+        """The rv every handler has fully observed — the consumer side
+        of the staleness watchdog's lag comparison (a tracker rv ahead
+        of this for longer than the horizon is a silent stream)."""
+        with self._lock:
+            return self._rv
 
     # ---- sync machinery ----
 

@@ -1,0 +1,767 @@
+"""Gray-failure containment PR: poison-batch bisection quarantine, the
+crash-loop governor, the informer staleness watchdog, the ticketed
+POISON_QUARANTINED shed path, the warm-call channel deadline, the
+journal_fsck exit-code contract, and the composition soak
+(``run_gray_failure_soak``) with its same-seed determinism pair."""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.types import (
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from koordinator_tpu.chaos import FaultInjector
+from koordinator_tpu.core import integrity
+from koordinator_tpu.core.journal import (
+    FileJournalStore,
+    MemoryJournalStore,
+)
+from koordinator_tpu.runtime.containment import (
+    POISON_LABEL,
+    CrashLoopGovernor,
+    QuarantineLedger,
+    StalenessWatchdog,
+    spec_fingerprint,
+)
+from koordinator_tpu.scheduler import frameworkext as fwext
+from koordinator_tpu.scheduler.batch_solver import (
+    BatchScheduler,
+    LoadAwareArgs,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _mk_sched(n_nodes=4, cpu=32000.0, **kw):
+    s = BatchScheduler(
+        args=LoadAwareArgs(usage_thresholds={}), batch_bucket=8, **kw
+    )
+    s.extender.monitor.stop_background()
+    for i in range(n_nodes):
+        s.snapshot.upsert_node(
+            Node(
+                meta=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(
+                    allocatable={ext.RES_CPU: cpu, ext.RES_MEMORY: 65536.0}
+                ),
+            )
+        )
+    return s
+
+
+def _pod(name, cpu=1000.0, labels=None, priority=9000):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        spec=PodSpec(
+            requests={ext.RES_CPU: cpu, ext.RES_MEMORY: 256.0},
+            priority=priority,
+        ),
+    )
+
+
+def _poison_pod(name, cpu=1000.0):
+    return _pod(name, cpu=cpu, labels={POISON_LABEL: "1"})
+
+
+# ---------------------------------------------------------------------------
+# spec fingerprints: the redemption key
+# ---------------------------------------------------------------------------
+
+
+class TestSpecFingerprint:
+    def test_identical_specs_share_a_fingerprint(self):
+        assert spec_fingerprint(_pod("a")) == spec_fingerprint(_pod("b"))
+
+    def test_spec_change_changes_the_fingerprint(self):
+        base = spec_fingerprint(_pod("a"))
+        assert spec_fingerprint(_pod("a", cpu=2000.0)) != base
+        assert spec_fingerprint(_pod("a", labels={"x": "1"})) != base
+        assert spec_fingerprint(_pod("a", priority=1)) != base
+
+
+# ---------------------------------------------------------------------------
+# the quarantine ledger
+# ---------------------------------------------------------------------------
+
+
+class TestQuarantineLedger:
+    def test_blame_is_idempotent_per_uid_and_fp(self):
+        q = QuarantineLedger(incarnation="gen0")
+        assert q.blame("ns/p", "fp1", evidence="boom", cycle=3)
+        assert not q.blame("ns/p", "fp1", evidence="boom", cycle=4)
+        assert q.active() and set(q.entries()) == {"ns/p"}
+        recs = q.store.load()
+        assert [r["op"] for r in recs] == ["blame"]
+        assert recs[0]["incarnation"] == "gen0"
+        assert recs[0]["cycle"] == 3
+
+    def test_changed_fingerprint_redeems(self):
+        q = QuarantineLedger()
+        q.blame("ns/p", "fp1", evidence="boom")
+        assert q.blamed("ns/p", "fp1"), "same bytes must stay out"
+        # the redeemable ticket: a CHANGED spec re-admits and journals
+        # the redeem decision
+        assert not q.blamed("ns/p", "fp2")
+        assert not q.active()
+        assert [r["op"] for r in q.store.load()] == ["blame", "redeem"]
+        # the fixed pod can be blamed afresh if it poisons again
+        assert q.blame("ns/p", "fp2", evidence="again")
+
+    def test_takeover_adopts_predecessor_blame(self):
+        store = MemoryJournalStore(name="quarantine")
+        a = QuarantineLedger(store=store, incarnation="gen0")
+        a.blame("ns/p", "fp1", evidence="boom")
+        b = QuarantineLedger(store=store, incarnation="gen1")
+        assert b.blamed("ns/p", "fp1")
+        assert b.adopt("gen2") == 1
+        # the successor's appends continue the predecessor's numbering
+        b.blame("ns/q", "fpX", evidence="other")
+        seqs = [r["seq"] for r in store.load()]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert store.load()[-1]["incarnation"] == "gen2"
+
+    def test_file_store_persistence_round_trip(self, tmp_path):
+        path = str(tmp_path / "quarantine.journal")
+        a = QuarantineLedger(store=FileJournalStore(path))
+        a.blame("ns/p", "fp1", evidence="boom")
+        b = QuarantineLedger(store=FileJournalStore(path))
+        assert b.blamed("ns/p", "fp1")
+
+    def test_corrupted_store_keeps_surviving_blames(self, tmp_path):
+        path = str(tmp_path / "quarantine.journal")
+        a = QuarantineLedger(store=FileJournalStore(path))
+        a.blame("ns/p", "fp1", evidence="boom")
+        a.blame("ns/q", "fp2", evidence="boom2")
+        lines = open(path, encoding="utf-8").read().splitlines()
+        lines[0] = lines[0][:-10] + "corrupted!"
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+        b = QuarantineLedger(store=FileJournalStore(path))
+        # the rotted blame is quarantined (PR 14 screening), the record
+        # behind it survives — and loading never raises
+        assert set(b.entries()) == {"ns/q"}
+
+
+# ---------------------------------------------------------------------------
+# the crash-loop governor
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    """Captures DecisionLedger.record calls."""
+
+    def __init__(self):
+        self.records = []
+
+    def record(self, controller, tick, inputs, action, state, outcome=None):
+        self.records.append(
+            {
+                "controller": controller,
+                "tick": tick,
+                "inputs": inputs,
+                "action": action,
+                "state": state,
+                "outcome": outcome,
+            }
+        )
+
+
+class TestCrashLoopGovernor:
+    def test_decide_is_pure(self):
+        inputs = {
+            "now": 10.0,
+            "deaths": [8.0, 9.0, 10.0],
+            "boots": 3,
+            "k": 3,
+            "horizon_s": 30.0,
+            "base_backoff_s": 0.5,
+            "max_backoff_s": 8.0,
+            "brownout_cap": 2,
+        }
+        frozen = json.dumps(inputs, sort_keys=True)
+        assert CrashLoopGovernor.decide(inputs) == CrashLoopGovernor.decide(
+            inputs
+        )
+        assert json.dumps(inputs, sort_keys=True) == frozen
+
+    def test_below_k_decides_nothing(self):
+        action, state = CrashLoopGovernor.decide(
+            {
+                "now": 10.0, "deaths": [9.0, 10.0], "boots": 2, "k": 3,
+                "horizon_s": 30.0, "base_backoff_s": 0.5,
+                "max_backoff_s": 8.0, "brownout_cap": 2,
+            }
+        )
+        assert action["op"] == "none" and action["backoff_s"] == 0.0
+        assert not state["degraded"]
+
+    def test_backoff_grows_exponentially_and_caps(self):
+        def backoff(n_deaths):
+            action, _state = CrashLoopGovernor.decide(
+                {
+                    "now": 0.0, "deaths": [0.0] * n_deaths, "boots": 0,
+                    "k": 3, "horizon_s": 30.0, "base_backoff_s": 0.5,
+                    "max_backoff_s": 8.0, "brownout_cap": 2,
+                }
+            )
+            return action["backoff_s"]
+
+        assert [backoff(n) for n in (3, 4, 5, 6, 7, 8)] == [
+            0.5, 1.0, 2.0, 4.0, 8.0, 8.0,
+        ]
+
+    def test_old_deaths_age_out_of_the_horizon(self):
+        action, _ = CrashLoopGovernor.decide(
+            {
+                "now": 100.0, "deaths": [1.0, 2.0, 99.0], "boots": 3,
+                "k": 3, "horizon_s": 30.0, "base_backoff_s": 0.5,
+                "max_backoff_s": 8.0, "brownout_cap": 2,
+            }
+        )
+        assert action["op"] == "none", "ancient deaths are not a loop"
+
+    def test_may_boot_gates_on_injected_clock(self):
+        t = [0.0]
+        gov = CrashLoopGovernor(
+            k=3, horizon_s=30.0, base_backoff_s=2.0, max_backoff_s=8.0,
+            clock=lambda: t[0],
+        )
+        for _ in range(2):
+            assert gov.note_death(reason="crash").backoff_s == 0.0
+        plan = gov.note_death(reason="crash")
+        assert plan.degraded and plan.backoff_s == 2.0
+        assert plan.pipeline_depth == 1 and plan.bisect_armed
+        assert plan.brownout_cap == 2
+        assert not gov.may_boot()
+        t[0] = 1.9
+        assert not gov.may_boot()
+        t[0] = 2.0
+        assert gov.may_boot()
+        assert gov.boot_plan().degraded, "the NEXT boot stays degraded"
+
+    def test_store_reload_adopts_history(self):
+        store = MemoryJournalStore(name="crashloop")
+        t = [0.0]
+        a = CrashLoopGovernor(store=store, clock=lambda: t[0], k=3)
+        a.note_boot("gen0")
+        a.note_death("gen0", reason="kill")
+        b = CrashLoopGovernor(store=store, clock=lambda: t[0], k=3)
+        assert b.boots == 1 and b.deaths == 1
+        b.note_death("gen1", reason="boot crash")
+        b.note_death("gen1", reason="boot crash")
+        assert b.boot_plan().degraded, (
+            "the adopted death must count toward K"
+        )
+        seqs = [r["seq"] for r in store.load()]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def test_deaths_record_on_the_decision_ledger(self):
+        dl = _Recorder()
+        t = [0.0]
+        gov = CrashLoopGovernor(clock=lambda: t[0], k=2, decisions=dl)
+        gov.note_death(reason="first")
+        gov.note_death(reason="second")
+        assert [r["controller"] for r in dl.records] == [
+            "crashloop", "crashloop",
+        ]
+        assert [r["tick"] for r in dl.records] == [1, 2]
+        assert dl.records[-1]["action"]["op"] == "backoff"
+        assert dl.records[-1]["outcome"] == {"reason": "second"}
+        # the recorded snapshot is complete: replaying decide over it
+        # reproduces the recorded action (PR 15 contract)
+        for r in dl.records:
+            action, state = CrashLoopGovernor.decide(r["inputs"])
+            assert action == r["action"] and state == r["state"]
+
+
+# ---------------------------------------------------------------------------
+# the staleness watchdog
+# ---------------------------------------------------------------------------
+
+
+class _FakeTracker:
+    def __init__(self):
+        self.rv = 0
+
+    def version(self):
+        return self.rv
+
+
+class _FakeInformer:
+    def __init__(self, name):
+        self.name = name
+        self.tracker = _FakeTracker()
+        self._observed = 0
+
+    def observed_rv(self):
+        return self._observed
+
+
+class _FakeHub:
+    def __init__(self, *informers):
+        self.informers = list(informers)
+
+
+class _FakeHealth:
+    def __init__(self):
+        self.rows = {}
+
+    def set(self, name, ok, detail=""):
+        self.rows[name] = (ok, detail)
+
+
+class TestStalenessWatchdog:
+    def _wd(self, horizon=2.0):
+        t = [0.0]
+        inf = _FakeInformer("pods")
+        health = _FakeHealth()
+        reg = fwext.scheduler_registry()
+        wd = StalenessWatchdog(
+            horizon_s=horizon, clock=lambda: t[0], health=health,
+            registry=reg,
+        ).watch_hub(_FakeHub(inf))
+        return t, inf, health, reg, wd
+
+    def test_caught_up_stream_is_fresh(self):
+        t, inf, health, _reg, wd = self._wd()
+        inf.tracker.rv = 5
+        inf._observed = 5
+        assert wd.check() == 0.0 and not wd.stale()
+        assert health.rows["snapshot_freshness"][0]
+
+    def test_quiet_stream_never_goes_stale(self):
+        # rv-based, not wall-clock-based: silence with no published
+        # events is health, not gray failure
+        t, _inf, _health, _reg, wd = self._wd()
+        t[0] = 1000.0
+        assert wd.check() == 0.0 and not wd.stale()
+
+    def test_persistent_lag_degrades_past_horizon(self):
+        t, inf, health, reg, wd = self._wd(horizon=2.0)
+        inf.tracker.rv = 7          # tracker moved, informer did not
+        wd.check()
+        assert not wd.stale(), "first sighting starts the age clock"
+        t[0] = 2.5
+        assert wd.check() == 2.5 and wd.stale()
+        ok, detail = health.rows["snapshot_freshness"]
+        assert not ok and "pods" in detail
+        assert reg.get("snapshot_staleness_seconds").value() == 2.5
+        assert wd.staleness_seconds == 2.5
+
+    def test_catching_up_heals(self):
+        t, inf, health, reg, wd = self._wd(horizon=2.0)
+        inf.tracker.rv = 7
+        wd.check()
+        t[0] = 3.0
+        wd.check()
+        assert wd.stale()
+        inf._observed = 7
+        assert wd.check() == 0.0 and not wd.stale()
+        assert health.rows["snapshot_freshness"][0]
+        assert reg.get("snapshot_staleness_seconds").value() == 0.0
+
+    def test_detached_informer_cannot_pin_staleness(self):
+        t, inf, _health, _reg, wd = self._wd(horizon=2.0)
+        inf.tracker.rv = 7
+        wd.check()
+        wd._hub.informers.remove(inf)
+        t[0] = 10.0
+        assert wd.check() == 0.0 and not wd.stale()
+
+
+# ---------------------------------------------------------------------------
+# poison bisection + the cycle gate (scheduler wiring)
+# ---------------------------------------------------------------------------
+
+
+class TestPoisonBisection:
+    def test_bisection_isolates_the_poison_and_places_the_rest(self):
+        chaos = FaultInjector()
+        quar = QuarantineLedger(incarnation="gen0")
+        s = _mk_sched(chaos=chaos)
+        s.quarantine = quar
+        chaos.arm("solver.poison_batch")
+        pods = [_pod(f"h{i}") for i in range(5)] + [_poison_pod("bad")]
+        out = s.schedule(pods)
+        assert {p.meta.uid for p in out.unschedulable} == {"bad"}
+        assert len(out.bound) == 5
+        entries = quar.entries()
+        assert set(entries) == {"bad"}
+        rec = entries["bad"]
+        assert rec["fp"] == spec_fingerprint(_poison_pod("bad"))
+        assert "PoisonBatchError" in rec["evidence"]
+        recs = s.extender.rejections.for_uid("bad")
+        assert recs and recs[-1].reason == "poison_quarantined"
+
+    def test_cycle_gate_rejects_resubmits_without_reprobing(self):
+        chaos = FaultInjector()
+        quar = QuarantineLedger()
+        s = _mk_sched(chaos=chaos)
+        s.quarantine = quar
+        chaos.arm("solver.poison_batch")
+        bad = _poison_pod("bad")
+        s.schedule([bad, _pod("h0")])
+        fires = len(chaos.trace)
+        # the resubmitted same-bytes pod is gated at cycle START — the
+        # poison never reaches a lowering again
+        out = s.schedule([bad])
+        assert {p.meta.uid for p in out.unschedulable} == {"bad"}
+        assert len(chaos.trace) == fires
+
+    def test_changed_spec_redeems_and_places(self):
+        chaos = FaultInjector()
+        quar = QuarantineLedger()
+        s = _mk_sched(chaos=chaos)
+        s.quarantine = quar
+        chaos.arm("solver.poison_batch")
+        s.schedule([_poison_pod("bad"), _pod("h0")])
+        assert quar.active()
+        chaos.disarm()
+        fixed = _pod("bad")     # the poison label is gone: new spec
+        out = s.schedule([fixed])
+        assert [p.meta.uid for p, _n in out.bound] == ["bad"]
+        assert not quar.active()
+
+
+# ---------------------------------------------------------------------------
+# stale evidence refuses evidence-hungry actions
+# ---------------------------------------------------------------------------
+
+
+class TestStaleEvidenceGates:
+    def test_preemption_refused_on_stale_snapshot(self):
+        s = _mk_sched(
+            n_nodes=1, cpu=1000.0, enable_priority_preemption=True
+        )
+        stale = [True]
+        s.staleness = lambda: stale[0]
+        c = s.extender.registry.get("stale_evidence_refusals_total")
+        v0 = c.value(action="preemption")
+        low = _pod("low", cpu=800.0, priority=1)
+        assert len(s.schedule([low]).bound) == 1
+        big = _pod("big", cpu=900.0, priority=9000)
+        out = s.schedule([big])
+        # plain placement cannot fit it and preemption REFUSED to evict
+        assert {p.meta.uid for p in out.unschedulable} == {"big"}
+        assert c.value(action="preemption") == v0 + 1
+        assert "low" in s.snapshot._assumed
+        # events resume: the same pod preempts normally
+        stale[0] = False
+        out2 = s.schedule([big])
+        assert [p.meta.uid for p, _n in out2.bound] == ["big"]
+        assert c.value(action="preemption") == v0 + 1
+
+    def test_descheduler_refuses_whole_pass_on_stale(self):
+        from koordinator_tpu.descheduler.migration import (
+            MigrationController,
+        )
+        from koordinator_tpu.scheduler.plugins.reservation import (
+            ReservationManager,
+        )
+
+        s = _mk_sched()
+        evicted = []
+        stale = [True]
+        reg = fwext.scheduler_registry()
+        mig = MigrationController(
+            ReservationManager(s),
+            evict_fn=evicted.append,
+            freshness=lambda: stale[0],
+            registry=reg,
+        )
+        mig.reconcile(now=0.0)
+        assert mig.refused_stale == 1 and not evicted
+        assert (
+            reg.get("stale_evidence_refusals_total").value(
+                action="descheduler_eviction"
+            )
+            == 1.0
+        )
+        stale[0] = False
+        mig.reconcile(now=1.0)
+        assert mig.refused_stale == 1
+
+
+# ---------------------------------------------------------------------------
+# the ticketed POISON_QUARANTINED shed path
+# ---------------------------------------------------------------------------
+
+
+class TestQuarantineShedFunnel:
+    def test_quarantined_pod_sheds_with_redeemable_ticket(self):
+        from koordinator_tpu.obs.rejections import RejectReason
+        from koordinator_tpu.runtime.overload import AdmissionController
+        from koordinator_tpu.scheduler.stream import StreamScheduler
+
+        chaos = FaultInjector()
+        s = _mk_sched(chaos=chaos)
+        s.quarantine = QuarantineLedger()
+        ov = AdmissionController()
+        st = StreamScheduler(s, max_batch=4, overload=ov)
+        chaos.arm("solver.poison_batch")
+        st.submit(_poison_pod("bad"), now=0.0)
+        st.submit(_pod("h0"), now=0.0)
+        results = st.pump()
+        chaos.disarm()
+        verdicts = {p.meta.uid: n for p, n, _l in results}
+        assert verdicts.get("h0") is not None
+        assert verdicts.get("bad", "queued") is None, (
+            "the blamed pod must shed terminally, not burn retries"
+        )
+        tickets = ov.take_tickets()
+        assert [t.reason for t in tickets] == [
+            RejectReason.POISON_QUARANTINED.value
+        ]
+        assert tickets[0].pod.meta.uid == "bad"
+        # redeem: the driver resubmits with a FIXED spec and it places
+        st.submit(_pod("bad"), now=1.0)
+        results2 = st.pump()
+        assert [(p.meta.uid, n is not None) for p, n, _l in results2] == [
+            ("bad", True)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# the warm-call channel deadline (timeout_warm_s)
+# ---------------------------------------------------------------------------
+
+
+class TestWarmCallDeadline:
+    def _client(self, chaos=None, **kw):
+        from koordinator_tpu.runtime.snapshot_channel import SolverClient
+
+        cli = SolverClient("localhost:1", chaos=chaos, **kw)
+        timeouts = []
+
+        def stub(req, timeout=None, metadata=None):
+            timeouts.append(timeout)
+            return object()
+
+        cli._sync = stub
+        return cli, timeouts
+
+    def test_cold_call_unbounded_then_warm_deadline(self):
+        cli, timeouts = self._client(timeout_warm_s=2.5)
+        cli.sync(object())
+        cli.sync(object())
+        cli.sync(object())
+        # the FIRST call pays the JIT compile — no deadline; every call
+        # after a success is steady-state and a hang is a gray failure
+        assert timeouts == [None, 2.5, 2.5]
+
+    def test_failed_cold_call_stays_cold(self):
+        from koordinator_tpu.runtime.snapshot_channel import (
+            ChannelUnavailable,
+        )
+
+        chaos = FaultInjector()
+        chaos.arm("channel.sync.drop", times=1)
+        cli, timeouts = self._client(chaos=chaos, timeout_warm_s=2.5)
+        with pytest.raises(ChannelUnavailable):
+            cli.sync(object())
+        cli.sync(object())
+        assert timeouts == [None], (
+            "the channel never succeeded — the compile may still be "
+            "ahead, so the deadline must not arm"
+        )
+
+    def test_explicit_timeout_wins_and_delay_rides_the_deadline(self):
+        slept = []
+        chaos = FaultInjector(sleep=slept.append)
+        chaos.arm("channel.sync.delay", latency_s=0.8)
+        cli, timeouts = self._client(
+            chaos=chaos, timeout_s=1.0, timeout_warm_s=9.0
+        )
+        cli.sync(object())
+        cli.sync(object())
+        # an explicit per-call deadline always wins over the warm one,
+        # and the injected delay fires BEFORE the wire — the stub still
+        # sees the deadline it must enforce
+        assert timeouts == [1.0, 1.0]
+        assert slept == [0.8, 0.8]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: half-open probe discipline under an injected clock
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerHalfOpenProbe:
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    def _tripped(self, threshold=2, cooldown=10.0):
+        from koordinator_tpu.runtime.overload import CircuitBreaker
+
+        clock = self._Clock()
+        b = CircuitBreaker(
+            threshold=threshold, cooldown_s=cooldown, clock=clock
+        )
+        for _ in range(threshold):
+            b.record_failure()
+        assert b.state == b.OPEN
+        return b, clock
+
+    def test_denied_while_open(self):
+        b, clock = self._tripped(cooldown=10.0)
+        for t in (0.0, 3.0, 9.99):
+            clock.t = t
+            assert not b.allow(), f"admitted at t={t} inside cooldown"
+
+    def test_exactly_one_probe_at_half_open(self):
+        b, clock = self._tripped(cooldown=10.0)
+        clock.t = 10.0
+        assert b.allow()
+        assert b.state == b.HALF_OPEN
+        assert not b.allow() and not b.allow(), "probe slot is single"
+        b.record_success()
+        assert b.state == b.CLOSED and b.allow()
+
+    def test_probe_failure_reopens_with_reset_backoff(self):
+        b, clock = self._tripped(cooldown=10.0)
+        clock.t = 10.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state == b.OPEN
+        clock.t = 19.9
+        assert not b.allow(), (
+            "the cooldown must restart from the FAILED probe, not the "
+            "original trip"
+        )
+        clock.t = 20.0
+        assert b.allow() and b.state == b.HALF_OPEN
+
+
+# ---------------------------------------------------------------------------
+# journal_fsck exit-code contract + containment ledger coverage
+# ---------------------------------------------------------------------------
+
+
+def _fsck(argv):
+    from tools.journal_fsck import main
+
+    return main(argv)
+
+
+class TestJournalFsckExitCodes:
+    def test_exit_0_on_clean_ledger(self, tmp_path):
+        path = str(tmp_path / "quarantine.journal")
+        q = QuarantineLedger(store=FileJournalStore(path))
+        q.blame("ns/p", "fp1", evidence="boom")
+        assert _fsck([path]) == 0
+
+    def test_exit_1_on_corruption(self, tmp_path, capsys):
+        path = str(tmp_path / "quarantine.journal")
+        q = QuarantineLedger(store=FileJournalStore(path))
+        q.blame("ns/p", "fp1", evidence="boom")
+        q.blame("ns/q", "fp2", evidence="boom2")
+        lines = open(path, encoding="utf-8").read().splitlines()
+        lines[0] = lines[0][:-8] + "rotted!!"
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+        assert _fsck([path]) == 1
+        assert "CORRUPTION FOUND" in capsys.readouterr().out
+        # repair quarantines + rewrites clean: verify then exits 0
+        assert _fsck([path, "--repair"]) == 0
+        assert os.path.exists(path + ".quarantine")
+        assert _fsck([path]) == 0
+
+    def test_exit_2_on_unreadable_store(self, tmp_path):
+        assert _fsck([str(tmp_path / "never_written.journal")]) == 2
+
+    def test_containment_ops_tally(self, tmp_path, capsys):
+        qpath = str(tmp_path / "quarantine.journal")
+        cpath = str(tmp_path / "crashloop.journal")
+        q = QuarantineLedger(store=FileJournalStore(qpath))
+        q.blame("ns/p", "fp1", evidence="boom")
+        assert not q.blamed("ns/p", "fp2")      # journals a redeem
+        t = [0.0]
+        gov = CrashLoopGovernor(
+            store=FileJournalStore(cpath), clock=lambda: t[0]
+        )
+        gov.note_boot("gen0")
+        gov.note_death("gen0", reason="kill")
+        assert _fsck([str(tmp_path), "--json", "-"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        ops = {
+            os.path.basename(f["path"]): f["containment_ops"]
+            for f in doc["files"]
+        }
+        assert ops["quarantine.journal"] == {"blame": 1, "redeem": 1}
+        assert ops["crashloop.journal"] == {"boot": 1, "death": 1}
+
+
+# ---------------------------------------------------------------------------
+# the composition soak
+# ---------------------------------------------------------------------------
+
+
+def _dump_sealed(path, records):
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(
+                json.dumps(integrity.seal(dict(rec)), separators=(",", ":"))
+                + "\n"
+            )
+
+
+class TestGrayFailureSoak:
+    def test_soak_green_and_ledgers_fsck_clean(self, tmp_path):
+        from koordinator_tpu.sim.longrun import run_gray_failure_soak
+
+        stats = run_gray_failure_soak(seed=0)
+        # the soak asserts the contract internally (exact quarantine
+        # across the kill-restart, 100% placement of the rest, bounded
+        # crash-loop boots, zero-dup/zero-lost-ack); spot-check the
+        # headline numbers and that all three points actually fired
+        assert stats["placed"] == stats["arrived"] - 2
+        assert stats["takeovers"] >= 2
+        assert stats["faults"]["solver.poison_batch"] >= 1
+        assert stats["faults"]["scheduler.boot_crash"] == 2
+        assert stats["faults"]["informer.silent_stall"] >= 1
+        assert stats["poison_quarantined_total"] >= 2.0
+        assert stats["bisect_probes_total"] >= 2.0
+        assert stats["crash_backoffs_total"] >= 1.0
+        assert stats["health_ok"], stats["health_detail"]
+        # the end-state ledgers round-trip through journal_fsck clean
+        qpath = str(tmp_path / "quarantine.journal")
+        cpath = str(tmp_path / "crashloop.journal")
+        _dump_sealed(qpath, stats["quarantine_dump"])
+        _dump_sealed(cpath, stats["crashloop_dump"])
+        assert _fsck([qpath, cpath]) == 0
+
+    def test_same_seed_same_trace(self):
+        from koordinator_tpu.sim.longrun import run_gray_failure_soak
+
+        a = run_gray_failure_soak(seed=7)
+        b = run_gray_failure_soak(seed=7)
+        assert a["fault_trace"] == b["fault_trace"]
+        assert a["decision_trace"] == b["decision_trace"]
+        assert a["quarantine_dump"] == b["quarantine_dump"]
+        assert a["crashloop_dump"] == b["crashloop_dump"]
+        assert a["placed"] == b["placed"]
+        assert a["bind_journal_live"] == b["bind_journal_live"]
+
+
+# ---------------------------------------------------------------------------
+# generated chaos catalog stays fresh
+# ---------------------------------------------------------------------------
+
+
+def test_readme_chaos_catalog_is_current():
+    from tools.gen_chaos_catalog import main as catalog_main
+
+    assert catalog_main(["--check"]) == 0, (
+        "README chaos-point catalog is stale — run "
+        "`python -m tools.gen_chaos_catalog`"
+    )

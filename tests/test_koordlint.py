@@ -84,7 +84,7 @@ def test_cli_exits_zero_on_tree(capsys):
     rc = cli_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "0 finding(s)" in out and "12 passes" in out
+    assert "0 finding(s)" in out and "13 passes" in out
 
 
 # ---------------------------------------------------------------------------
@@ -255,6 +255,22 @@ FIXTURES = {
             """,
         },
         "DL002",
+    ),
+    "staleness-snapshot": (
+        {
+            # a controller reading the freshness verdict LIVE mid-act,
+            # outside any declared capture site: a verdict flip between
+            # snapshot and act would make the recorded decision
+            # unexplainable on replay
+            "koordinator_tpu/runtime/novel.py": """
+            class NovelController:
+                def act(self):
+                    if self.freshness():
+                        return None
+                    return self.evict()
+            """,
+        },
+        "SS001",
     ),
     "store-integrity": (
         {
@@ -938,8 +954,18 @@ def test_chaos_coverage_sees_real_points_and_schedule():
         "channel.sync.delay",
         "leader.stale_commit",
         "journal.write_fail",
+        # gray-failure containment PR: the soak arm arms all three
+        "solver.poison_batch",
+        "informer.silent_stall",
+        "scheduler.boot_crash",
     ):
         assert point in scheduled, point
+    for point in (
+        "solver.poison_batch",
+        "informer.silent_stall",
+        "scheduler.boot_crash",
+    ):
+        assert point in fires, point
     # every exemption's promised dedicated arm exists in the NAMED file
     armed = chaos_coverage._test_armed_points(index)
     for point, (site, _why) in chaos_coverage.EXEMPT.items():

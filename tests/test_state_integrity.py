@@ -584,7 +584,9 @@ def test_fsck_flags_unrepairable_head_checkpoint(tmp_path, capsys):
     rec["crc"] = integrity.record_crc(rec)
     with open(path, "w", encoding="utf-8") as f:
         f.write(json.dumps(rec) + "\n")
-    assert main(["--repair", "--json", "-", path]) == 1
+    # containment PR exit contract: unrepairable loss is code 2 (code 1
+    # is reserved for repairable corruption found in verify mode)
+    assert main(["--repair", "--json", "-", path]) == 2
     doc = json.loads(capsys.readouterr().out)
     assert doc["files"][0]["unrepairable"]
 

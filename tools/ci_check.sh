@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# The single local CI gate: static analysis, generated-doc freshness,
+# and the tier-1 fast test suite as ONE fail-fast command. Mirrors what
+# the driver enforces; run it before pushing.
+#
+#   bash tools/ci_check.sh
+#
+# JAX_PLATFORMS defaults to cpu (the tier-1 environment); export it
+# first to gate on another backend.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== koordlint (all passes) =="
+python -m tools.koordlint
+
+echo "== chaos-point catalog freshness =="
+python -m tools.gen_chaos_catalog --check
+
+echo "== tier-1 fast tests (pytest -m 'not slow') =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+
+echo "ci_check: ALL GREEN"

@@ -14,12 +14,18 @@ Usage::
 ``*.tmp``/``*.quarantine`` sidecars is checked). Modes:
 
 * **verify** (default) — screen every record; report corruption, write
-  holes, duplicate seqs, torn tails and checkpoint-image digests. Exit
-  1 when anything is corrupt or unrepairable; the file is not touched.
+  holes, duplicate seqs, torn tails and checkpoint-image digests. The
+  file is not touched.
 * **--repair** — additionally QUARANTINE corrupt lines into the
   ``<file>.quarantine`` sidecar, trim a torn tail, and atomically
-  rewrite the file to the surviving records. Exit 0 when everything
-  found was repairable (quarantined), 1 when not.
+  rewrite the file to the surviving records.
+
+Exit codes: **0** clean (or every damaged record was repaired), **1**
+corruption / quarantined records found (verify mode), **2** the store
+could not be read at all (I/O error) or recovery semantics are damaged
+beyond repair. The containment ledgers (poison-quarantine blame/redeem,
+crash-loop boot/death) journal through the same codec — their op tallies
+appear as ``containment_ops`` in each file's report.
 
 Unrepairable means recovery semantics were damaged beyond what
 quarantine restores: a checkpoint recovery image with a failed digest
@@ -110,6 +116,14 @@ def check_file(path: str, repair: bool = False) -> Dict[str, object]:
             continue
         if isinstance(head, dict) and head.get("op") == "checkpoint":
             unrepairable = True
+    # gray-failure containment ledgers (quarantine + crash-loop) journal
+    # their records through the same codec — tally their ops so a fsck
+    # of a soak artifact shows the blame/boot history at a glance
+    containment_ops: Dict[str, int] = {}
+    for rec in kept:
+        op = rec.get("op")
+        if op in ("blame", "redeem", "boot", "death"):
+            containment_ops[op] = containment_ops.get(op, 0) + 1
     report: Dict[str, object] = {
         "path": path,
         "records": rep.total,
@@ -122,6 +136,7 @@ def check_file(path: str, repair: bool = False) -> Dict[str, object]:
         "checkpoints": ckpt_total,
         "checkpoint_digest_failures": ckpt_bad,
         "quarantined": list(rep.quarantined),
+        "containment_ops": containment_ops,
         "unrepairable": unrepairable,
         "ok": rep.ok and ckpt_bad == 0,
         "repaired": False,
@@ -203,15 +218,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         check_file(p, repair=args.repair)
         for p in _journal_files(args.paths)
     ]
-    # exit contract: verify fails on ANY corruption; repair fails only
-    # on what quarantine cannot restore
+    # exit contract (gray-failure containment PR split the old catch-all
+    # 1 into two distinguishable failures):
+    #   0 — clean, or repair restored everything repairable
+    #   1 — corruption / quarantined records found (verify mode)
+    #   2 — store unreadable (I/O error), or recovery semantics damaged
+    #       beyond repair (a compacted head checkpoint is gone)
+    unreadable = any(r.get("error") for r in reports)
+    unrepairable = any(r.get("unrepairable") for r in reports)
     if args.repair:
-        bad = any(
-            r.get("unrepairable") or r.get("error") for r in reports
-        )
+        code = 2 if (unreadable or unrepairable) else 0
+    elif unreadable:
+        code = 2
     else:
-        bad = any(not r.get("ok", False) for r in reports)
-    doc = {"files": reports, "ok": not bad}
+        code = 0 if all(r.get("ok", False) for r in reports) else 1
+    bad = code != 0
+    doc = {"files": reports, "ok": not bad, "exit_code": code}
     if args.json is not None:
         text = json.dumps(doc, indent=1, sort_keys=True)
         if args.json == "-":
@@ -241,7 +263,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"ckpt_digest_failures={r['checkpoint_digest_failures']}"
             )
         print("OK" if not bad else "CORRUPTION FOUND")
-    return 1 if bad else 0
+    return code
 
 
 if __name__ == "__main__":

@@ -12,5 +12,6 @@ from . import (  # noqa: F401 — registration side effects
     reject_reasons,
     retrace_hazard,
     shed_paths,
+    staleness_snapshot,
     store_integrity,
 )

@@ -15,8 +15,11 @@ from typing import Iterable, List, Tuple
 from .. import Finding, Pass, RepoIndex, register, want_file
 
 #: helpers whose bodies call report_exception — a handler calling one of
-#: these is accounted (keep in sync when adding new reporting funnels)
-REPORTING_HELPERS = frozenset({"_note_solver_failure"})
+#: these is accounted (keep in sync when adding new reporting funnels).
+#: _contain_poison (gray-failure containment PR) reports the contained
+#: ladder failure via report_exception, or re-raises it when bisection
+#: cannot pin a poison pod.
+REPORTING_HELPERS = frozenset({"_note_solver_failure", "_contain_poison"})
 
 #: the module that DEFINES the discipline (scanning it would be circular)
 EXEMPT_FILES = frozenset({"obs/errors.py"})

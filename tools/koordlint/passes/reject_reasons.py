@@ -72,6 +72,13 @@ EXEMPT: Dict[str, str] = {
         "plus the terminal shed lifecycle event (koordlint shed-paths "
         "pass enforces both)"
     ),
+    "POISON_QUARANTINED": (
+        "cycle gate: the quarantine ledger blames the pod (its lowering "
+        "deterministically crashed a dispatch and bisection isolated "
+        "it) — rejected at the batch scheduler's gate and shed through "
+        "StreamScheduler._shed_quarantined before any solve; "
+        "redeemable, a changed spec fingerprint re-admits"
+    ),
 }
 
 #: where the enum and the classifier live

@@ -58,6 +58,17 @@ SHED_SITES: Dict[Site, str] = {
         "the ladder reached its shed level) — delegates to "
         "AdmissionController.shed"
     ),
+    (
+        "koordinator_tpu/scheduler/stream.py",
+        "StreamScheduler._shed_quarantined",
+    ): (
+        "poison-quarantine exit (gray-failure containment PR): a pod "
+        "the quarantine ledger blames sheds terminally with reason "
+        "POISON_QUARANTINED instead of burning retries on a "
+        "deterministic rejection — delegates to AdmissionController."
+        "shed; the ticket stays redeemable (changed spec fingerprint "
+        "re-admits)"
+    ),
 }
 
 #: queue-drop sites that deliberately do NOT shed → the written reason

@@ -1,0 +1,191 @@
+"""Pass ``staleness-snapshot`` (SS): controllers that consult informer
+freshness must take the verdict FROM their decision snapshot, not as an
+ad-hoc live read — the gray-failure containment PR's standing rule.
+
+Why: the staleness watchdog's verdict gates evidence-hungry actions
+(preemption, descheduler eviction, topology split). If a controller
+reads it live mid-decision, a verdict flip between the snapshot and the
+act produces a decision the recorded inputs cannot explain — replay
+(`tools/decision_replay.py`) would disagree with what the acting
+controller did. Folding the verdict into the snapshot (or capturing it
+ONCE at cycle start) keeps decide() pure and the ledger replayable.
+
+The vocabulary is bidirectional, like ``shed-paths``:
+
+* ``SNAPSHOT_SITES`` — the functions ALLOWED to call the freshness
+  callable live, because they ARE the snapshot/capture point.
+* ``EXEMPT`` — live reads deliberately outside a snapshot, each with
+  the written reason.
+
+* **SS001** — an undeclared live ``.freshness()`` / ``.staleness()``
+  call: fold it into the controller's snapshot (or capture-once site),
+  or exempt it with a written reason.
+* **SS002** — a declared capture site that no longer reads freshness:
+  the fold moved — update the table.
+* **SS003** — a stale table entry: the named file/function is gone.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .. import Finding, Pass, RepoIndex, register
+
+Site = Tuple[str, str]  # (repo-relative file, dotted qualname)
+
+#: attribute names whose CALL is a live freshness read. The wiring
+#: convention passes the watchdog's bound ``stale`` method as a
+#: ``freshness=`` / ``staleness=`` ctor argument; calling that
+#: attribute is the read this pass polices.
+_FRESHNESS_ATTRS = frozenset({"freshness", "staleness"})
+
+#: the sanctioned capture points: each folds the verdict into a pure
+#: snapshot (or captures it once per cycle) that decide()/the gates
+#: read — the ONLY places a live read is the correct thing.
+SNAPSHOT_SITES: Dict[Site, str] = {
+    (
+        "koordinator_tpu/runtime/elastic.py",
+        "TopologyController.snapshot",
+    ): (
+        "folds the verdict into the topology decision snapshot as "
+        "inputs['stale']; decide() refuses split/merge FROM the "
+        "snapshot, so replay sees the same refusal"
+    ),
+    (
+        "koordinator_tpu/scheduler/batch_solver.py",
+        "BatchScheduler._schedule_locked",
+    ): (
+        "captures the verdict ONCE per cycle into _cycle_stale at "
+        "cycle init; both preemption gates read the captured value, "
+        "never the live callable"
+    ),
+}
+
+#: live reads deliberately outside a snapshot → the written reason
+EXEMPT: Dict[Site, str] = {
+    (
+        "koordinator_tpu/descheduler/migration.py",
+        "MigrationController.reconcile",
+    ): (
+        "the descheduler records no decision snapshot: the read gates "
+        "the WHOLE reconcile pass at its first statement, before any "
+        "evidence is consulted — there is no later act the verdict "
+        "could diverge from (refused passes count refused_stale + "
+        "stale_evidence_refusals_total)"
+    ),
+}
+
+
+def _qualnames(tree: ast.AST) -> Dict[str, ast.AST]:
+    """Dotted qualname -> function node, for every (possibly nested)
+    function/method in the module."""
+    out: Dict[str, ast.AST] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[q] = child
+                visit(child, f"{q}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function's OWN body — nested function/class definitions
+    belong to their own qualname and are skipped (each is checked under
+    its own table entry, so a read is attributed exactly once)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _freshness_call(fn: ast.AST):
+    """The first live ``<expr>.freshness()`` / ``<expr>.staleness()``
+    call in the function's own body, or None."""
+    for node in _own_nodes(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _FRESHNESS_ATTRS
+        ):
+            return node
+    return None
+
+
+@register
+class StalenessSnapshotPass(Pass):
+    name = "staleness-snapshot"
+    code = "SS"
+    description = (
+        "informer-freshness verdicts are read from decision snapshots "
+        "(or one capture per cycle), never ad-hoc mid-decision"
+    )
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        declared = set(SNAPSHOT_SITES) | set(EXEMPT)
+        funcs: Dict[Site, ast.AST] = {}
+        for sf in index.package_files:
+            if sf.tree is None:
+                continue
+            for q, fn in _qualnames(sf.tree).items():
+                funcs[(sf.rel, q)] = fn
+
+        # SS002 / SS003 over the declared capture sites
+        for site, why in sorted(SNAPSHOT_SITES.items()):
+            fn = funcs.get(site)
+            if fn is None:
+                out.append(self.finding(
+                    3, site[0], 0,
+                    f"staleness-snapshot table names {site[1]!r} in "
+                    f"{site[0]} but it does not exist — delete the "
+                    "stale entry",
+                ))
+                continue
+            if _freshness_call(fn) is None:
+                out.append(self.finding(
+                    2, site[0], fn.lineno,
+                    f"{site[1]} is a declared freshness capture site "
+                    "but no longer reads the freshness callable — the "
+                    "fold moved; update the staleness-snapshot table",
+                ))
+
+        # SS003 over the exemptions
+        for site, why in sorted(EXEMPT.items()):
+            if site not in funcs:
+                out.append(self.finding(
+                    3, site[0], 0,
+                    f"staleness-snapshot exemption names {site[1]!r} "
+                    f"in {site[0]} but it does not exist — delete the "
+                    "stale exemption",
+                ))
+
+        # SS001: undeclared live reads anywhere in the package
+        for site, fn in sorted(funcs.items()):
+            if site in declared:
+                continue
+            call = _freshness_call(fn)
+            if call is not None:
+                out.append(self.finding(
+                    1, site[0], call.lineno,
+                    f"{site[1]} reads informer freshness live "
+                    "(.freshness()/.staleness() call) outside a "
+                    "declared capture site — fold the verdict into the "
+                    "controller's decision snapshot (or its once-per-"
+                    "cycle capture) so replay sees the same refusal, "
+                    "or exempt the site with a written reason",
+                ))
+        return out
