@@ -2768,6 +2768,223 @@ def bench_overload_storm():
     return out
 
 
+def _fleet_constrained_fixture(n_nodes, seed=0):
+    """Columnar constrained state at fleet scale: the PR 17 fleet
+    generator's NodeState plus NUMA zone / GPU slot tables derived from
+    the same columns — no per-node Python objects anywhere."""
+    import jax.numpy as jnp
+
+    from koordinator_tpu.sim.cluster_gen import FleetConfig, fleet_node_state
+
+    cfg = FleetConfig(n_nodes=n_nodes, seed=seed)
+    nodes = fleet_node_state(cfg)
+    return cfg, nodes, jnp
+
+
+def _solver_ab(drain, n_pods, k, passes=3):
+    """Same-backend shortlist A/B over one solver-level drain callable:
+    ``drain(shortlist_k) -> (placed, fallbacks)``. Warms both arms (two
+    static specializations), measures each, and pins decision identity
+    between the arms — the A/B is only meaningful if the pruned solve
+    made the SAME decisions."""
+    placed_sl, fb = drain(k)        # warmup + placement (shortlist arm)
+    placed_full, _ = drain(None)    # warmup (full-axis arm)
+    assert placed_sl == placed_full, (placed_sl, placed_full)
+    sl_pps, full_pps = [], []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        drain(k)
+        sl_pps.append(round(n_pods / (time.perf_counter() - t0), 1))
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        drain(None)
+        full_pps.append(round(n_pods / (time.perf_counter() - t0), 1))
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    return {
+        "pods_per_sec": med(sl_pps),
+        "passes": sl_pps,
+        "placed": placed_sl,
+        "shortlist_k": k,
+        "shortlist_ab": {
+            "full_axis_pods_per_sec": med(full_pps),
+            "full_axis_passes": full_pps,
+            "speedup": round(med(sl_pps) / med(full_pps), 2),
+            "fallbacks": [int(v) for v in fb],
+            "identical_placements": True,
+        },
+    }
+
+
+def bench_numa_20k():
+    """Fleet-scale NUMA bin-pack: 20k heterogeneous columnar nodes with
+    2-zone tables split from the fleet allocatable columns, LSR
+    whole-core + SingleNUMANode-required pods, drained through
+    ``solve_stream_full``. The embedded A/B is the node-axis pruning
+    tentpole's headline: at 20k nodes the full-axis round body pays
+    [P, 20k] feasibility/cost every round where the shortlisted body
+    pays [P, 64]."""
+    import jax
+
+    from koordinator_tpu.ops.numa import NumaState
+    from koordinator_tpu.ops.solver import (
+        PodBatch,
+        SolverParams,
+        solve_stream_full,
+    )
+    from koordinator_tpu.sim.cluster_gen import gen_fleet_pod_arrays
+
+    n_nodes, n_pods, chunk = 20_000, 4096, 512
+    cfg, nodes, jnp = _fleet_constrained_fixture(n_nodes)
+    alloc = np.asarray(nodes.allocatable)
+    est = np.asarray(nodes.estimated_used)
+    zone_cap = np.repeat((alloc / 2.0)[:, None, :], 2, axis=1).astype(
+        np.float32
+    )
+    zone_free = np.clip(
+        zone_cap - (est / 2.0)[:, None, :], 0.0, None
+    ).astype(np.float32)
+    numa = NumaState(
+        zone_free=jnp.asarray(zone_free),
+        zone_cap=jnp.asarray(zone_cap),
+        policy=jnp.asarray(np.full(n_nodes, 3, np.int8)),  # SINGLE_NUMA
+    )
+    fix = gen_fleet_pod_arrays(cfg, n_pods)
+    rng = np.random.default_rng(7)
+    # whole-core pods carry LSR QoS (the cpuset-bind predicate), half the
+    # batch requires SingleNUMANode outright — both alignment triggers
+    qos = np.where(fix["requests"][:, 0] % 1000.0 == 0, 3, 0).astype(np.int8)
+    pods = PodBatch.create(
+        requests=fix["requests"],
+        estimate=fix["estimate"],
+        priority=fix["priority"],
+        is_prod=fix["is_prod"],
+        qos=qos,
+        numa_required=rng.random(n_pods) < 0.5,
+    )
+    b = n_pods // chunk
+    stacked = jax.tree.map(
+        lambda a: a.reshape((b, chunk) + a.shape[1:]), pods
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.asarray((65.0, 95.0), jnp.float32),
+        prod_thresholds=jnp.zeros(2, jnp.float32),
+        score_weights=jnp.ones(2, jnp.float32),
+    )
+
+    def drain(k):
+        a, _z, _r, fb = solve_stream_full(
+            stacked, nodes, params, numa=numa, max_rounds=12,
+            shortlist_k=k,
+        )
+        return int(np.sum(np.asarray(a) >= 0)), np.asarray(fb).sum(0)
+
+    result = {"scenario": "numa_binpack_20k"}
+    result.update(_solver_ab(drain, n_pods, k=64))
+    result.update(
+        {
+            "total": n_pods,
+            "n_nodes": n_nodes,
+            "measurement_note": (
+                "solver-level drain over the columnar fleet generator "
+                "(no host snapshot at this node count); both arms are "
+                "the same jit program family on the same backend, so "
+                "the A/B isolates the node-axis pruning"
+            ),
+        }
+    )
+    return result
+
+
+def bench_device_gang_20k():
+    """Fleet-scale device gangs: 20k columnar nodes with 8 free GPU
+    slots each, 2048 two-member gangs (mixed 1/2/4-GPU sizes — a
+    uniform all-4-GPU batch never converges early and every chunk burns
+    the whole round budget in BOTH arms, drowning the A/B in the
+    non-prunable commit machinery) drained chunk-by-chunk through
+    ``assign`` + ``enforce_gangs`` with the device slot table chained
+    between chunks — the per-chunk dispatch path the scheduler runs, at
+    a node count where the round body's [P, N] work dominates."""
+    import jax
+
+    from koordinator_tpu.ops.device import DeviceState
+    from koordinator_tpu.ops.solver import (
+        PodBatch,
+        SolverParams,
+        assign,
+        enforce_gangs,
+    )
+
+    n_nodes, n_gangs, chunk = 20_000, 2048, 512
+    _cfg, nodes, jnp = _fleet_constrained_fixture(n_nodes)
+    devices = DeviceState(
+        slot_free=jnp.asarray(np.full((n_nodes, 8), 100.0, np.float32)),
+        cap_total=jnp.asarray(np.full(n_nodes, 800.0, np.float32)),
+    )
+    p = n_gangs * 2
+    rng = np.random.default_rng(3)
+    gpu = np.repeat(
+        rng.choice([1, 2, 4], n_gangs), 2
+    ).astype(np.int32)  # both members of a gang share a size
+    cpu = gpu.astype(np.float32) * 2000.0 + 2000.0
+    req = np.stack([cpu, cpu * 4.0], 1).astype(np.float32)
+    pods = PodBatch.create(
+        requests=req,
+        priority=np.full(p, 9000, np.int32),
+        gang_id=np.repeat(np.arange(n_gangs, dtype=np.int32), 2),
+        gang_min=np.full(p, 2, np.int32),
+        gpu_whole=gpu,
+    )
+    b = p // chunk  # gang pairs are contiguous, chunk is even
+    stacked = jax.tree.map(
+        lambda a: a.reshape((b, chunk) + a.shape[1:]), pods
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.asarray((65.0, 95.0), jnp.float32),
+        prod_thresholds=jnp.zeros(2, jnp.float32),
+        score_weights=jnp.ones(2, jnp.float32),
+    )
+
+    def drain(k):
+        cur, dev_carry = nodes, None
+        placed, fb = 0, np.zeros(2, np.int64)
+        for c in range(b):
+            pb = jax.tree.map(lambda a: a[c], stacked)
+            res = assign(
+                pb, cur, params, devices=devices, dev_carry=dev_carry,
+                max_rounds=12, shortlist_k=k,
+            )
+            res = enforce_gangs(res, pb)
+            cur = cur.replace(
+                requested=res.node_requested,
+                estimated_used=res.node_estimated_used,
+                prod_used=res.node_prod_used,
+            )
+            dev_carry = (
+                res.node_dev_slots, res.node_rdma_free, res.node_fpga_free
+            )
+            placed += int(np.sum(np.asarray(res.assignment) >= 0))
+            if res.shortlist_fallbacks is not None:
+                fb += np.asarray(res.shortlist_fallbacks)
+        return placed, fb
+
+    result = {"scenario": "device_gang_20k"}
+    result.update(_solver_ab(drain, p, k=64))
+    result.update(
+        {
+            "total": p,
+            "n_nodes": n_nodes,
+            "n_gangs": n_gangs,
+            "measurement_note": (
+                "per-chunk assign + enforce_gangs with chained device "
+                "slot tables over the columnar fleet generator; both "
+                "arms share the dispatch path so the A/B isolates the "
+                "node-axis pruning"
+            ),
+        }
+    )
+    return result
+
+
 SCENARIOS = {
     "loadaware": bench_loadaware,
     "loadaware_100k": bench_loadaware_100k,
@@ -2775,7 +2992,9 @@ SCENARIOS = {
     "fleet_day": bench_fleet_day,
     "overload_storm": bench_overload_storm,
     "numa": bench_numa,
+    "numa_20k": bench_numa_20k,
     "device_gang": bench_device_gang,
+    "device_gang_20k": bench_device_gang_20k,
     "quota_tree": bench_quota_tree,
     "reservation_fastpath": bench_reservation_fastpath,
     "preempt_priority": bench_preempt_priority,

@@ -111,6 +111,7 @@ def main(
 
     la_args = LoadAwareArgs()
     numa_scoring = device_scoring = None
+    shortlist_k = 64
     if args.config:
         import json
 
@@ -118,8 +119,10 @@ def main(
             decode_device_share,
             decode_load_aware,
             decode_node_numa,
+            decode_solver_tuning,
             validate_device_share,
             validate_load_aware,
+            validate_solver_tuning,
         )
 
         with open(args.config) as f:
@@ -134,6 +137,10 @@ def main(
             numa_scoring = decode_node_numa(
                 raw["nodeNUMAResource"]
             ).scoring_strategy
+        if "solverTuning" in raw:
+            st = decode_solver_tuning(raw["solverTuning"])
+            validate_solver_tuning(st)
+            shortlist_k = st.shortlist_k
 
     if args.serve:
         import signal
@@ -250,6 +257,7 @@ def main(
         devices=devices,
         mesh=mesh,
         journal=journal,
+        shortlist_k=shortlist_k,
     )
     if args.flight_file:
         import uuid

@@ -669,16 +669,21 @@ class DevProf:
 
     # -- the solve-residual breakdown --
 
-    def breakdown_ms(self) -> Dict[str, float]:
+    def breakdown_ms(self) -> Dict[str, object]:
         """Decompose the captured windows' solve residual: compile wall
-        (from the ledger) vs fenced device-compute vs transfer."""
+        (from the ledger) vs fenced device-compute vs transfer, plus the
+        device-compute total keyed by watch stage (``stage_ms``) so
+        off-hot-path stages — e.g. the candidate-shortlist plan probe's
+        ``shortlist`` stage — are visible separately from ``solve``."""
         compute = transfer = 0.0
+        stages: Dict[str, float] = {}
         for ev in list(self.device_events):
             dur = (ev["t1"] - ev["t0"]) * 1e3
             if ev["kind"] == "transfer":
                 transfer += dur
             else:
                 compute += dur
+                stages[ev["stage"]] = stages.get(ev["stage"], 0.0) + dur
         compile_s = sum(
             row["compile_seconds"]
             for row in self.ledger.report()["functions"].values()
@@ -687,6 +692,9 @@ class DevProf:
             "compile_ms": round(compile_s * 1e3, 3),
             "device_compute_ms": round(compute, 3),
             "transfer_ms": round(transfer, 3),
+            "stage_ms": {
+                k: round(v, 3) for k, v in sorted(stages.items())
+            },
         }
 
     def render(self) -> str:

@@ -69,6 +69,24 @@ def load_aware_cost(
     return -score
 
 
+def load_aware_cost_cols(
+    pod_estimate: jnp.ndarray,
+    node_estimated_used: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    weights: jnp.ndarray,
+    metric_fresh: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Gathered-column :func:`load_aware_cost`: node args are [P, K, D] /
+    [P, K] candidate columns (the shortlist solve's per-pod sub-tensors).
+    Elementwise arithmetic is identical to the full-axis form — decision
+    identity requires bit-equal scores per (pod, node) pair. [P, K]."""
+    after = node_estimated_used + pod_estimate[:, None, :]          # [P,K,D]
+    score = _utilization_free_score(after, node_allocatable, weights)
+    if metric_fresh is not None:
+        score = jnp.where(metric_fresh, score, 0.0)
+    return -score
+
+
 def least_allocated_cost(
     pod_req: jnp.ndarray,
     node_requested: jnp.ndarray,
@@ -162,6 +180,26 @@ def device_cost(
         (dev_cap_total[None, :] - dev_free_total[None, :]) + gpu_units[:, None]
     )
     cap = dev_cap_total[None, :]
+    if most_allocated:
+        raw = jnp.floor(used_after * 100.0 / (cap + _SAFE))
+    else:
+        raw = jnp.floor((cap - used_after) * 100.0 / (cap + _SAFE))
+    score = jnp.where((cap > 0) & (used_after <= cap + 1e-6), raw, 0.0)
+    score = jnp.where(gpu_units[:, None] > 0, score, 0.0)
+    return -score
+
+
+def device_cost_cols(
+    gpu_units: jnp.ndarray,
+    dev_free_total: jnp.ndarray,
+    dev_cap_total: jnp.ndarray,
+    most_allocated: bool = False,
+) -> jnp.ndarray:
+    """Gathered-column :func:`device_cost`: ``dev_free_total`` /
+    ``dev_cap_total`` are [P, K] candidate columns. Same elementwise
+    arithmetic as the full-axis form. Returns [P, K] cost."""
+    used_after = (dev_cap_total - dev_free_total) + gpu_units[:, None]
+    cap = dev_cap_total
     if most_allocated:
         raw = jnp.floor(used_after * 100.0 / (cap + _SAFE))
     else:

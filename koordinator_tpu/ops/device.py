@@ -114,6 +114,40 @@ def device_fit_mask(
     return ok
 
 
+def device_fit_mask_cols(
+    gpu_whole: jnp.ndarray,        # [P] int32
+    gpu_share: jnp.ndarray,        # [P] float32
+    full_count: jnp.ndarray,       # [P, K] gathered candidate columns
+    partial_max: jnp.ndarray,      # [P, K]
+    slot_max: jnp.ndarray = None,  # [P, K]
+    rdma_req: jnp.ndarray = None,
+    rdma_free: jnp.ndarray = None,  # [P, K]
+    fpga_req: jnp.ndarray = None,
+    fpga_free: jnp.ndarray = None,  # [P, K]
+) -> jnp.ndarray:
+    """Gathered-column :func:`device_fit_mask`: the round-start slot
+    reductions arrive pre-gathered per pod ([P, K] candidate columns).
+    Same elementwise arithmetic as the full-axis form — the shortlist
+    solve's decision identity requires bit-equal booleans. [P, K]."""
+    if slot_max is None:
+        slot_max = jnp.maximum(
+            partial_max, jnp.where(full_count >= 1.0 - EPS, FULL, 0.0)
+        )
+    whole_ok = gpu_whole[:, None].astype(jnp.float32) <= full_count + EPS
+    frac = gpu_share[:, None]
+    frac_ok = (frac <= slot_max + EPS) | (frac <= EPS)
+    both = (gpu_whole[:, None] > 0) & (frac > EPS)
+    both_ok = (
+        gpu_whole[:, None].astype(jnp.float32) + 1.0 <= full_count + EPS
+    ) | (frac <= partial_max + EPS)
+    ok = whole_ok & jnp.where(both, both_ok, frac_ok)
+    if rdma_req is not None and rdma_free is not None:
+        ok &= rdma_req[:, None].astype(jnp.float32) <= rdma_free + EPS
+    if fpga_req is not None and fpga_free is not None:
+        ok &= fpga_req[:, None].astype(jnp.float32) <= fpga_free + EPS
+    return ok
+
+
 def device_consumption(
     gpu_whole: jnp.ndarray, gpu_share: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -106,6 +106,68 @@ def prod_usage_threshold_mask(
     return base | ~pod_is_prod[:, None]
 
 
+def fit_mask_cols(pod_req: jnp.ndarray, node_free: jnp.ndarray) -> jnp.ndarray:
+    """Gathered-column :func:`fit_mask`: ``node_free`` is [P, K, D] (each
+    pod's K candidate node columns already gathered). Elementwise
+    arithmetic is identical to the full-axis form — the shortlist solve's
+    decision-identity contract requires bit-equal booleans per
+    (pod, node) pair. Returns [P, K] bool."""
+    return jnp.all(pod_req[:, None, :] <= node_free + EPS, axis=-1)
+
+
+def effective_thresholds_cols(
+    thresholds: jnp.ndarray,
+    node_custom: jnp.ndarray | None,
+) -> jnp.ndarray:
+    """Gathered-column :func:`effective_thresholds`: ``node_custom`` is
+    [P, K, D] (or None). Returns [P, K, D] (broadcastable [1, 1, D] when
+    no custom table)."""
+    if node_custom is None:
+        return thresholds[None, None, :]
+    has_custom = jnp.any(node_custom > 0.0, axis=-1, keepdims=True)  # [P, K, 1]
+    return jnp.where(has_custom, node_custom, thresholds[None, None, :])
+
+
+def usage_threshold_mask_cols(
+    pod_estimate: jnp.ndarray,
+    node_estimated_used: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    thresholds: jnp.ndarray,
+    metric_fresh: jnp.ndarray,
+    node_custom: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Gathered-column :func:`usage_threshold_mask`: node args are
+    [P, K, D] / [P, K] candidate columns. Same elementwise arithmetic as
+    the full-axis form (bit-equal per pair). Returns [P, K] bool."""
+    after = node_estimated_used + pod_estimate[:, None, :]
+    pct = usage_percent(after, node_allocatable)
+    thr = effective_thresholds_cols(thresholds, node_custom)
+    over = (thr > 0.0) & (pct > thr)
+    ok = ~jnp.any(over, axis=-1)
+    return ok | ~metric_fresh
+
+
+def prod_usage_threshold_mask_cols(
+    pod_is_prod: jnp.ndarray,
+    pod_estimate: jnp.ndarray,
+    node_prod_used: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    prod_thresholds: jnp.ndarray,
+    metric_fresh: jnp.ndarray,
+    node_custom: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Gathered-column :func:`prod_usage_threshold_mask`. Returns [P, K]."""
+    base = usage_threshold_mask_cols(
+        pod_estimate,
+        node_prod_used,
+        node_allocatable,
+        prod_thresholds,
+        metric_fresh,
+        node_custom=node_custom,
+    )
+    return base | ~pod_is_prod[:, None]
+
+
 def combine(*masks: jnp.ndarray) -> jnp.ndarray:
     """AND-compose masks, broadcasting [N]→[P,N] as needed."""
     out = None

@@ -476,6 +476,14 @@ class SolveResult:
     #: zone-scoped charge each zoned pod applied ([P, DN], for refunds)
     pod_zone: jnp.ndarray = None
     pod_zone_charge: jnp.ndarray = None
+    #: [2] int32 — rounds in which the candidate-shortlist solve fell back
+    #: to full-axis nomination, by cause: [0] exactness-bound violation
+    #: (a chosen candidate's cost reached the best excluded node's
+    #: build-time lower bound), [1] shortlist exhaustion (a still-active
+    #: pod had zero feasible candidates while excluded nodes might fit).
+    #: Zeros when shortlisting ran clean or was statically off; None on
+    #: legacy construction sites.
+    shortlist_fallbacks: jnp.ndarray = None
 
 
 def _quota_headroom(
@@ -576,6 +584,19 @@ def _segment_prefix_sums(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.nd
     return cums - base
 
 
+def _jitter_hash(pi: jnp.ndarray, ni: jnp.ndarray) -> jnp.ndarray:
+    """Knuth multiplicative nomination-jitter hash, folded to 16 bits.
+
+    ``pi``/``ni`` are uint32 pod- and node-index arrays (broadcastable).
+    The hash is keyed on ORIGINAL node ids — the shortlist solve gathers
+    candidate columns and must reproduce the full-axis tie-break band
+    bit-exactly, so it feeds the gathered candidate ids (not shortlist
+    positions) through this same function."""
+    return (
+        pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)
+    ) & jnp.uint32(0xFFFF)
+
+
 #: extension.QoSClass values used on device (LSR/LSE need exclusive CPUs)
 QOS_LSR, QOS_LSE = 3, 4
 
@@ -652,6 +673,7 @@ def _priority_order(pods: PodBatch) -> jnp.ndarray:
         "approx_topk",
         "numa_scoring",
         "device_scoring",
+        "shortlist_k",
     ),
 )
 def assign(
@@ -672,6 +694,7 @@ def assign(
     numa_carry: "jnp.ndarray | None" = None,
     numa_scoring: "str | None" = None,
     device_scoring: "str | None" = None,
+    shortlist_k: "int | None" = None,
 ) -> SolveResult:
     """Round-based fast solver. ``round_quantum`` is the fraction of a node's
     allocatable (per dim, measured in estimated usage) it may accept per
@@ -715,9 +738,17 @@ def assign(
             return cost
         pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
         ni = jnp.arange(n, dtype=jnp.uint32)[None, :]
-        h = (
-            pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503)
-        ) & jnp.uint32(0xFFFF)
+        h = _jitter_hash(pi, ni)
+        return cost + h.astype(jnp.float32) * (nomination_jitter / 65536.0)
+
+    def add_jitter_cols(cost: jnp.ndarray, cand: jnp.ndarray) -> jnp.ndarray:
+        """Gathered-column jitter: ``cand`` [P, K] carries ORIGINAL node
+        ids, so each (pod, node) pair hashes to the same perturbation it
+        gets on the full axis — the tie-break band is gather-invariant."""
+        if nomination_jitter <= 0.0:
+            return cost
+        pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
+        h = _jitter_hash(pi, cand.astype(jnp.uint32))
         return cost + h.astype(jnp.float32) * (nomination_jitter / 65536.0)
 
     # round-invariant: which pods bind exclusive CPUs (NUMA alignment +
@@ -789,6 +820,7 @@ def assign(
         from .device import (
             device_consumption,
             device_fit_mask,
+            device_fit_mask_cols,
             slot_commit,
             slot_stats,
         )
@@ -813,22 +845,42 @@ def assign(
         slots0 = jnp.zeros((n, 1), jnp.float32)
         rdma0 = fpga0 = jnp.zeros((n,), jnp.float32)
 
-    def round_body(carry):
-        (
-            assigned,
-            requested,
-            est_used,
-            prod_used,
-            qused,
-            dev_slots,
-            rdma_free,
-            fpga_free,
-            zone_free,
-            azone_s,
-            active,
-            _progress,
-            r,
-        ) = carry
+    k = min(topk, n)
+    # Candidate-shortlist solve (perf): prune the round loop's per-pod
+    # node axis to each pod's top-K build-time candidates. Statically off
+    # when K covers the axis anyway, when the nomination fan-out exceeds
+    # K, or when a cost term breaks the exactness bound's monotonicity
+    # premise (arbitrary cost_transform; MostAllocated device scoring
+    # REWARDS usage, so an excluded node's cost can drop below its
+    # build-time bound as other pods commit).
+    shortlist_on = (
+        shortlist_k is not None
+        and 0 < shortlist_k < n
+        and shortlist_k >= k
+        and cost_transform is None
+        and device_scoring != "MostAllocated"
+    )
+
+    def full_feas_cost(
+        requested,
+        est_used,
+        prod_used,
+        dev_stats,
+        rdma_free,
+        fpga_free,
+        gate,
+        clamp_device=False,
+    ):
+        """The round loop's full-axis masked+jittered cost [P, N] at the
+        given carry state (``gate`` [P] = pod-level active/quota gates).
+        Shared by the non-shortlist round body, the shortlist build
+        (round-0 state, gates open) and the escape-hatch re-nomination,
+        so all three price a (pod, node) pair identically.
+
+        ``clamp_device=True`` (build only) clamps the DeviceShare
+        LeastAllocated term at ≤ 0: its over-capacity score cutoff can
+        lift a floor(-1) score back to 0 in a ~1e-6 float window, and the
+        excluded-node bound must LOWER-bound every future round's cost."""
         work = NodeState(
             allocatable=nodes.allocatable,
             requested=requested,
@@ -840,21 +892,13 @@ def assign(
             custom_thresholds=nodes.custom_thresholds,
             custom_prod_thresholds=nodes.custom_prod_thresholds,
         )
-        round_quotas = QuotaState(runtime=quotas.runtime, used=qused)
-        if quota_enabled:
-            q_head = _quota_headroom(
-                spods.requests, spods.quota_chain, round_quotas
-            )
-            feas = _feasible(spods, work, params, active & q_head)
-        else:
-            feas = _feasible(spods, work, params, active)
+        feas = _feasible(spods, work, params, gate)
         if smask is not None:
             feas &= smask
         if numa is not None:
             feas &= numa_mask
         if devices is not None:
-            # exact round-start reductions over the carried slot table
-            dev_full, dev_partial, dev_smax, dev_total = slot_stats(dev_slots)
+            dev_full, dev_partial, dev_smax, dev_total = dev_stats
             feas &= device_fit_mask(
                 spods.gpu_whole,
                 spods.gpu_share,
@@ -886,42 +930,277 @@ def assign(
             # DeviceShare Least/MostAllocated over GPU capacity
             # (deviceshare/scoring.go); dev_total is the round-carried
             # free total, so intra-batch commits steer later rounds
-            cost = cost + cost_ops.device_cost(
+            dterm = cost_ops.device_cost(
                 sdev_total,
-                dev_total,
+                dev_stats[3],
                 devices.cap_total,
                 most_allocated=(device_scoring == "MostAllocated"),
             )
+            if clamp_device:
+                dterm = jnp.minimum(dterm, 0.0)
+            cost = cost + dterm
         if cost_transform is not None:
             # BeforeScore transformer chain (frameworkext.interface.go:84-109):
             # a static, jit-traced rewrite of the cost tensor.
             cost = cost_transform(cost)
         cost = add_jitter(cost)
-        cost = jnp.where(feas, cost, jnp.inf)
-        # Top-K nomination with rank-modular spreading: if every pod
-        # nominated its single argmin, one node would absorb the whole
-        # round (the sequential loop avoids this only by paying O(P)
-        # steps). Pod with the r-th highest priority among active pods
-        # nominates its (r mod K)-th best node, so a round fans out over
-        # each pod's K best nodes while the best nodes still go to the
-        # highest priorities.
-        k = min(topk, n)
-        if approx_topk:
-            # TPU-optimized partial reduction (avoids the full variadic
-            # sort lax.top_k lowers to). approx_max_k's recall < 1 could
-            # deterministically drop a pod's ONLY feasible node(s) — a
-            # device/NUMA-constrained pod with a handful of finite entries
-            # would then read as unschedulable every round — so slot 0 is
-            # pinned to the exact argmin (a cheap single reduction); the
-            # approximate set only provides the spread fan-out, where
-            # recall loss is covered by the nomination jitter.
-            neg_ap, idx_ap = jax.lax.approx_max_k(-cost, k)  # [P, K]
-            bidx = jnp.argmin(cost, axis=1).astype(idx_ap.dtype)
-            bval = -jnp.take_along_axis(cost, bidx[:, None], axis=1)
-            neg_top = jnp.concatenate([bval, neg_ap[:, : k - 1]], axis=1)
-            top_idx = jnp.concatenate([bidx[:, None], idx_ap[:, : k - 1]], axis=1)
+        return jnp.where(feas, cost, jnp.inf)
+
+    if shortlist_on:
+        # Shortlist build from round-0 state, pod-level gates OPEN (a
+        # quota-blocked pod can free up mid-solve — its shortlist must
+        # already be there). Node-wise feasibility is monotone
+        # non-increasing across rounds and every cost term is monotone
+        # non-decreasing (or constant), so the (K+1)-th best build cost
+        # LOWER-bounds every excluded node's cost in every later round.
+        # Candidates are sorted ASCENDING by node id: lax.top_k/argmin
+        # break ties by lowest index, so positional tie-breaks over the
+        # gathered columns equal node-id tie-breaks on the full axis.
+        dev_stats0 = slot_stats(slots0) if devices is not None else None
+        cost_b = full_feas_cost(
+            nodes.requested,
+            nodes.estimated_used,
+            nodes.prod_used,
+            dev_stats0,
+            rdma0,
+            fpga0,
+            jnp.ones((p,), bool),
+            clamp_device=True,
+        )
+        neg_b, idx_b = jax.lax.top_k(-cost_b, shortlist_k + 1)
+        # Asymmetric slicing of top_k's two outputs ([:, :K] indices vs
+        # [:, K] value) defeats XLA's TopkRewriter — the sort+slice
+        # pattern stops matching and the build degrades to a full
+        # O(N log N) row sort (measured 50× at 20k nodes). The barrier
+        # pins the canonical sort+uniform-slice pattern so the rewrite to
+        # the O(N log K) TopK custom call survives.
+        neg_b, idx_b = jax.lax.optimization_barrier((neg_b, idx_b))
+        plan_cand = jnp.sort(idx_b[:, :shortlist_k], axis=1).astype(jnp.int32)
+        # +inf when fewer than K+1 nodes were feasible at build time: the
+        # shortlist is COMPLETE (excluded nodes can never become feasible)
+        plan_bound = -neg_b[:, shortlist_k]
+        s_custom = (
+            nodes.custom_thresholds[plan_cand]
+            if nodes.custom_thresholds is not None
+            else None
+        )
+        s_custom_prod = (
+            nodes.custom_prod_thresholds[plan_cand]
+            if nodes.custom_prod_thresholds is not None
+            else None
+        )
+        cand_alloc = nodes.allocatable[plan_cand]        # [P, K, D]
+        cand_fresh = nodes.metric_fresh[plan_cand]       # [P, K]
+        cand_sched = nodes.schedulable[plan_cand]
+        cand_amp = jnp.maximum(nodes.cpu_amp, 1.0)[plan_cand]
+        cand_smask = (
+            jnp.take_along_axis(smask, plan_cand, axis=1)
+            if smask is not None
+            else None
+        )
+        cand_numa = (
+            jnp.take_along_axis(numa_mask, plan_cand, axis=1)
+            if numa is not None
+            else None
+        )
+        cand_numa_score = (
+            jnp.take_along_axis(numa_score_term, plan_cand, axis=1)
+            if numa_score_term is not None
+            else None
+        )
+        cand_cap_total = (
+            devices.cap_total[plan_cand]
+            if devices is not None and device_scoring is not None
+            else None
+        )
+
+    def shortlist_feas_cost(
+        requested, est_used, prod_used, dev_stats, rdma_free, fpga_free, gate
+    ):
+        """Gathered-column round cost [P, K] over each pod's candidate
+        columns — the same elementwise arithmetic as
+        :func:`full_feas_cost` restricted to ``plan_cand``, so a
+        candidate prices identically on both paths (decision identity)."""
+        free_c = cand_alloc - requested[plan_cand]
+        feas = mask_ops.fit_mask_cols(spods.requests, free_c)
+        eff_cpu = spods.requests[:, 0][:, None] * cand_amp
+        feas &= ~bind_mask[:, None] | (eff_cpu <= free_c[..., 0] + EPS)
+        est_c = est_used[plan_cand]
+        feas &= mask_ops.usage_threshold_mask_cols(
+            spods.estimate,
+            est_c,
+            cand_alloc,
+            params.usage_thresholds,
+            cand_fresh,
+            node_custom=s_custom,
+        )
+        feas &= mask_ops.prod_usage_threshold_mask_cols(
+            spods.is_prod,
+            spods.estimate,
+            prod_used[plan_cand],
+            cand_alloc,
+            params.prod_thresholds,
+            cand_fresh,
+            node_custom=s_custom_prod,
+        )
+        feas &= cand_sched
+        feas &= gate[:, None]
+        if cand_smask is not None:
+            feas &= cand_smask
+        if cand_numa is not None:
+            feas &= cand_numa
+        if devices is not None:
+            dev_full, dev_partial, dev_smax, dev_total = dev_stats
+            feas &= device_fit_mask_cols(
+                spods.gpu_whole,
+                spods.gpu_share,
+                dev_full[plan_cand],
+                dev_partial[plan_cand],
+                slot_max=dev_smax[plan_cand],
+                rdma_req=spods.rdma,
+                rdma_free=rdma_free[plan_cand] if rdma_tracked else None,
+                fpga_req=spods.fpga,
+                fpga_free=fpga_free[plan_cand] if fpga_tracked else None,
+            )
+            if not rdma_tracked:
+                feas &= (spods.rdma == 0)[:, None]
+            if not fpga_tracked:
+                feas &= (spods.fpga == 0)[:, None]
+        cost = cost_ops.load_aware_cost_cols(
+            spods.estimate,
+            est_c,
+            cand_alloc,
+            params.score_weights,
+            metric_fresh=cand_fresh,
+        )
+        if cand_numa_score is not None:
+            cost = cost + cand_numa_score
+        if devices is not None and device_scoring is not None:
+            cost = cost + cost_ops.device_cost_cols(
+                sdev_total,
+                dev_stats[3][plan_cand],
+                cand_cap_total,
+                most_allocated=False,
+            )
+        cost = add_jitter_cols(cost, plan_cand)
+        return jnp.where(feas, cost, jnp.inf)
+
+    def round_body(carry):
+        (
+            assigned,
+            requested,
+            est_used,
+            prod_used,
+            qused,
+            dev_slots,
+            rdma_free,
+            fpga_free,
+            zone_free,
+            azone_s,
+            fb,
+            active,
+            _progress,
+            r,
+        ) = carry
+        round_quotas = QuotaState(runtime=quotas.runtime, used=qused)
+        if quota_enabled:
+            q_head = _quota_headroom(
+                spods.requests, spods.quota_chain, round_quotas
+            )
+            gate = active & q_head
         else:
-            neg_top, top_idx = jax.lax.top_k(-cost, k)      # [P, K]
+            gate = active
+        if devices is not None:
+            # exact round-start reductions over the carried slot table
+            # (kept full-axis: O(N·G) and the commit needs them anyway)
+            dev_stats = slot_stats(dev_slots)
+            dev_full, dev_partial, dev_smax, dev_total = dev_stats
+        else:
+            dev_stats = None
+
+        def _full_nominate(_):
+            """Full-axis nomination — the only path when shortlisting is
+            off, the escape hatch when a round's exactness check fails
+            (then it recomputes ALL pods' nominations, so the round is
+            decision-identical to the full solver by construction)."""
+            cost = full_feas_cost(
+                requested, est_used, prod_used, dev_stats,
+                rdma_free, fpga_free, gate,
+            )
+            # Top-K nomination with rank-modular spreading: if every pod
+            # nominated its single argmin, one node would absorb the whole
+            # round (the sequential loop avoids this only by paying O(P)
+            # steps). Pod with the r-th highest priority among active pods
+            # nominates its (r mod K)-th best node, so a round fans out
+            # over each pod's K best nodes while the best nodes still go
+            # to the highest priorities.
+            if approx_topk:
+                # TPU-optimized partial reduction (avoids the full
+                # variadic sort lax.top_k lowers to). approx_max_k's
+                # recall < 1 could deterministically drop a pod's ONLY
+                # feasible node(s) — a device/NUMA-constrained pod with a
+                # handful of finite entries would then read as
+                # unschedulable every round — so slot 0 is pinned to the
+                # exact argmin (a cheap single reduction); the approximate
+                # set only provides the spread fan-out, where recall loss
+                # is covered by the nomination jitter.
+                neg_ap, idx_ap = jax.lax.approx_max_k(-cost, k)  # [P, K]
+                bidx = jnp.argmin(cost, axis=1).astype(idx_ap.dtype)
+                bval = -jnp.take_along_axis(cost, bidx[:, None], axis=1)
+                neg_top = jnp.concatenate([bval, neg_ap[:, : k - 1]], axis=1)
+                top_idx = jnp.concatenate(
+                    [bidx[:, None], idx_ap[:, : k - 1]], axis=1
+                )
+            else:
+                neg_top, top_idx = jax.lax.top_k(-cost, k)      # [P, K]
+            return neg_top, top_idx.astype(jnp.int32)
+
+        if shortlist_on:
+            cost_g = shortlist_feas_cost(
+                requested, est_used, prod_used, dev_stats,
+                rdma_free, fpga_free, gate,
+            )                                                    # [P, K]
+            neg_s, pos_s = jax.lax.top_k(-cost_g, k)
+            idx_s = jnp.take_along_axis(plan_cand, pos_s, axis=1)
+            if approx_topk:
+                # replicate the full path's pinned-argmin construction
+                # ([best, exact top k-1]): where approx_max_k is exact
+                # (CPU lowers it to exact top_k) the nomination vectors
+                # are bit-identical; where it is genuinely approximate
+                # the fan-out band differs within the jitter window.
+                neg_top_s = jnp.concatenate(
+                    [neg_s[:, :1], neg_s[:, : k - 1]], axis=1
+                )
+                top_idx_s = jnp.concatenate(
+                    [idx_s[:, :1], idx_s[:, : k - 1]], axis=1
+                )
+            else:
+                neg_top_s, top_idx_s = neg_s, idx_s
+            # Exactness check: every nomination this round must beat the
+            # best EXCLUDED node's build-time lower bound, strictly (a tie
+            # could hand the full axis a lower node id). Pods with pod-
+            # level gates closed nominate nothing on either path — safe.
+            kth = -neg_s[:, k - 1]
+            safe = ~jnp.isfinite(plan_bound) | (
+                jnp.isfinite(kth) & (kth < plan_bound)
+            )
+            unsafe = gate & ~safe
+            trigger = jnp.any(unsafe)
+            neg_top, top_idx = jax.lax.cond(
+                trigger,
+                _full_nominate,
+                lambda _: (neg_top_s, top_idx_s.astype(jnp.int32)),
+                None,
+            )
+            cand_any = jnp.any(jnp.isfinite(cost_g), axis=1)
+            fb = fb + jnp.stack(
+                [
+                    jnp.any(unsafe & cand_any).astype(jnp.int32),
+                    jnp.any(unsafe & ~cand_any).astype(jnp.int32),
+                ]
+            )
+        else:
+            neg_top, top_idx = _full_nominate(None)
         finite = jnp.isfinite(neg_top)
         n_feas = jnp.sum(finite, axis=1).astype(jnp.int32)  # [P]
         rank = jnp.cumsum(active.astype(jnp.int32)) - 1
@@ -1162,6 +1441,7 @@ def assign(
             fpga_free,
             zone_free,
             azone_s,
+            fb,
             active & (assigned < 0),
             jnp.any(final_prio),
             r + 1,
@@ -1182,6 +1462,7 @@ def assign(
         fpga0,
         zfree0,
         jnp.full((p,), -1, jnp.int32),
+        jnp.zeros((2,), jnp.int32),
         pods.valid[order],
         jnp.array(True),
         jnp.array(0, jnp.int32),
@@ -1197,6 +1478,7 @@ def assign(
         fpga_f,
         zfree_f,
         azone_f,
+        fb_f,
         _active,
         _prog,
         rounds,
@@ -1238,6 +1520,7 @@ def assign(
         node_zone_free=zfree_f,
         pod_zone=pod_zone,
         pod_zone_charge=zone_charge,
+        shortlist_fallbacks=fb_f,
     )
     if devices is not None and devices.cap_total is not None:
         # heterogeneous inventories pad the slot table with zero rows —
@@ -1255,11 +1538,134 @@ def assign(
 @functools.partial(
     jax.jit,
     static_argnames=(
+        "shortlist_k",
+        "nomination_jitter",
+        "numa_scoring",
+        "device_scoring",
+    ),
+)
+def shortlist_plan(
+    pods: PodBatch,
+    nodes: NodeState,
+    params: SolverParams,
+    numa: "NumaState | None" = None,
+    devices: "DeviceState | None" = None,
+    node_mask: "jnp.ndarray | None" = None,
+    shortlist_k: int = 64,
+    nomination_jitter: float = 4.0,
+    numa_scoring: "str | None" = None,
+    device_scoring: "str | None" = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Standalone shortlist BUILD — the plan stage of the candidate-
+    shortlist solve as its own jitted entry, so the devprof ledger can
+    time it separately (the ``shortlist`` stage in ``solve_breakdown_ms``).
+
+    Replays ``assign``'s build block: round-0 masked cost with pod-level
+    gates OPEN and the DeviceShare LeastAllocated term clamped at ≤ 0,
+    then per-pod top-(K+1) with the sort/slice pattern pinned (see the
+    TopkRewriter note in ``assign``). Returns ``(plan_cand [P, K] int32,
+    candidates ascending by node id in SOLVER pod order, plan_bound [P]
+    — the (K+1)-th best build cost, +inf when the shortlist is
+    complete)``. Diagnostics only: ``assign`` traces its own copy of
+    this computation inside the solve jit (XLA fuses it with the round
+    loop; a separate plan dispatch would cost a device round-trip per
+    chunk on the hot path), so this entry never feeds decisions.
+    """
+    _devprof.tracing("shortlist_plan")
+    p = pods.requests.shape[0]
+    n = nodes.allocatable.shape[0]
+    order = _priority_order(pods)
+    spods = jax.tree.map(lambda a: a[order], pods)
+    smask = None if node_mask is None else node_mask[order]
+    feas = _feasible(spods, nodes, params, jnp.ones((p,), bool))
+    if smask is not None:
+        feas &= smask
+    numa_score_term = None
+    if numa is not None:
+        from .numa import numa_fit_mask
+
+        wants = _cpu_bind(spods)
+        if spods.numa_required is not None:
+            wants = wants | spods.numa_required
+        feas &= numa_fit_mask(
+            spods.requests,
+            wants,
+            numa,
+            cpu_amp=nodes.cpu_amp,
+            pod_required=spods.numa_required,
+        )
+        if numa_scoring is not None:
+            numa_score_term = cost_ops.numa_aligned_cost(
+                spods.requests,
+                wants,
+                numa.zone_free,
+                numa.zone_cap,
+                params.score_weights,
+                most_allocated=(numa_scoring == "MostAllocated"),
+            )
+    if devices is not None:
+        from .device import device_consumption, device_fit_mask, slot_stats
+
+        rdma_tracked = devices.rdma_free is not None
+        fpga_tracked = devices.fpga_free is not None
+        dev_full, dev_partial, dev_smax, dev_total = slot_stats(
+            devices.slot_free
+        )
+        feas &= device_fit_mask(
+            spods.gpu_whole,
+            spods.gpu_share,
+            dev_full,
+            dev_partial,
+            slot_max=dev_smax,
+            rdma_req=spods.rdma,
+            rdma_free=devices.rdma_free if rdma_tracked else None,
+            fpga_req=spods.fpga,
+            fpga_free=devices.fpga_free if fpga_tracked else None,
+        )
+        if not rdma_tracked:
+            feas &= (spods.rdma == 0)[:, None]
+        if not fpga_tracked:
+            feas &= (spods.fpga == 0)[:, None]
+    cost = cost_ops.load_aware_cost(
+        spods.estimate,
+        nodes.estimated_used,
+        nodes.allocatable,
+        params.score_weights,
+        metric_fresh=nodes.metric_fresh,
+    )
+    if numa_score_term is not None:
+        cost = cost + numa_score_term
+    if devices is not None and device_scoring is not None:
+        _, sdev_total = device_consumption(spods.gpu_whole, spods.gpu_share)
+        dterm = cost_ops.device_cost(
+            sdev_total,
+            dev_total,
+            devices.cap_total,
+            most_allocated=(device_scoring == "MostAllocated"),
+        )
+        cost = cost + jnp.minimum(dterm, 0.0)
+    if nomination_jitter > 0.0:
+        pi = jnp.arange(p, dtype=jnp.uint32)[:, None]
+        ni = jnp.arange(n, dtype=jnp.uint32)[None, :]
+        h = _jitter_hash(pi, ni)
+        cost = cost + h.astype(jnp.float32) * (nomination_jitter / 65536.0)
+    cost_b = jnp.where(feas, cost, jnp.inf)
+    neg_b, idx_b = jax.lax.top_k(-cost_b, shortlist_k + 1)
+    neg_b, idx_b = jax.lax.optimization_barrier((neg_b, idx_b))
+    plan_cand = jnp.sort(idx_b[:, :shortlist_k], axis=1).astype(jnp.int32)
+    plan_bound = -neg_b[:, shortlist_k]
+    return plan_cand, plan_bound
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
         "max_rounds",
         "topk",
         "cost_transform",
         "nomination_jitter",
         "approx_topk",
+        "shortlist_k",
     ),
 )
 def solve_stream(
@@ -1273,6 +1679,7 @@ def solve_stream(
     cost_transform=None,
     nomination_jitter: float = 4.0,
     approx_topk: bool = False,
+    shortlist_k: "int | None" = None,
 ) -> tuple[jnp.ndarray, NodeState, jnp.ndarray, QuotaState]:
     """Pipelined multi-batch solve: ``lax.scan`` over a [B, P, ...] stacked
     ``PodBatch``, threading consumed node (and quota) capacity between
@@ -1309,6 +1716,7 @@ def solve_stream(
             cost_transform=cost_transform,
             nomination_jitter=nomination_jitter,
             approx_topk=approx_topk,
+            shortlist_k=shortlist_k,
         )
         nxt = cur.replace(
             requested=res.node_requested,
@@ -1334,6 +1742,7 @@ def solve_stream(
         "approx_topk",
         "numa_scoring",
         "device_scoring",
+        "shortlist_k",
     ),
 )
 def solve_stream_full(
@@ -1351,6 +1760,7 @@ def solve_stream_full(
     numa_scoring: "str | None" = None,
     device_scoring: "str | None" = None,
     node_mask: "jnp.ndarray | None" = None,
+    shortlist_k: "int | None" = None,
 ):
     """Pipelined multi-chunk solve with the FULL constraint set: a
     ``lax.scan`` over a [C, P, ...] stacked :class:`PodBatch` threading
@@ -1366,7 +1776,10 @@ def solve_stream_full(
     through the scan — constrained chunks no longer force the per-chunk
     dispatch path. None traces the mask out entirely.
 
-    Returns ``(assignments [C, P], pod_zones [C, P], rounds [C])``.
+    Returns ``(assignments [C, P], pod_zones [C, P], rounds [C],
+    shortlist_fallbacks [C, 2])`` — the fallback counts are all-zero when
+    shortlisting is off (``assign`` emits a zeros sentinel so the scan's
+    stacked outputs are shape-stable across configs).
     """
     _devprof.tracing("solve_stream_full")
     quota_enabled = quotas is not None
@@ -1413,6 +1826,7 @@ def solve_stream_full(
             numa_carry=numa_carry,
             numa_scoring=numa_scoring,
             device_scoring=device_scoring,
+            shortlist_k=shortlist_k,
         )
         nxt = cur.replace(
             requested=res.node_requested,
@@ -1429,15 +1843,16 @@ def solve_stream_full(
             res.assignment,
             res.pod_zone,
             res.rounds_used,
+            res.shortlist_fallbacks,
         )
 
     xs = (
         pods_stacked if node_mask is None else (pods_stacked, node_mask)
     )
-    _final, (assignments, zones, rounds) = jax.lax.scan(
+    _final, (assignments, zones, rounds, fallbacks) = jax.lax.scan(
         step, (nodes, quotas.used, dev_carry0, numa_carry0), xs
     )
-    return assignments, zones, rounds
+    return assignments, zones, rounds, fallbacks
 
 
 @jax.jit
@@ -1568,21 +1983,33 @@ def enforce_gangs(
         node_zone_free=node_zone_free,
         pod_zone=pod_zone,
         pod_zone_charge=pod_zone_charge,
+        shortlist_fallbacks=result.shortlist_fallbacks,
     )
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("shortlist_k",))
 def assign_sequential(
     pods: PodBatch,
     nodes: NodeState,
     params: SolverParams,
     quotas: QuotaState | None = None,
+    shortlist_k: "int | None" = None,
 ) -> SolveResult:
     """Exact sequential-commit solver: ``lax.scan`` over pods in priority
     order, vectorized over nodes inside each step. Bit-faithful to the
     reference's one-pod-at-a-time cycle (the golden contract; SURVEY §7
     step 2 "batched masked argmin with capacity-consuming sequential
-    commit (scan)")."""
+    commit (scan)").
+
+    ``shortlist_k`` prunes each step's node axis to the pod's top-K
+    build-time candidates (the sequential analog of ``assign``'s
+    candidate shortlist). The exactness bound here is on the SCORE side:
+    usage only grows as pods commit, so an excluded node's build-time
+    score UPPER-bounds its score at every later step — a step whose best
+    shortlisted score strictly beats the (K+1)-th build score cannot
+    have lost to any excluded node (strict ``>`` so an excluded node
+    tying the winner, which could out-rank it by lower node id, forces
+    the full-axis step instead). Decisions are identical either way."""
     _devprof.tracing("assign_sequential")
     p = pods.requests.shape[0]
     n = nodes.allocatable.shape[0]
@@ -1594,65 +2021,173 @@ def assign_sequential(
     spods = jax.tree.map(lambda a: a[order], pods)
 
     amp = jnp.maximum(nodes.cpu_amp, 1.0)
+    thr_full = mask_ops.effective_thresholds(
+        params.usage_thresholds, nodes.custom_thresholds
+    )
+    pthr_full = mask_ops.effective_thresholds(
+        params.prod_thresholds, nodes.custom_prod_thresholds
+    )
+    w_sum = jnp.sum(params.score_weights) + 1e-9
+
+    def node_score(after, alloc, fresh):
+        """The step's LeastAllocated score over any (gathered or full)
+        node axis — elementwise, so gathering commutes with it."""
+        frees = jnp.maximum(alloc - after, 0.0)
+        per_dim = jnp.floor(
+            jnp.where(alloc > 0, frees * 100.0 / (alloc + 1e-9), 0.0)
+        )
+        score = jnp.floor(
+            jnp.sum(per_dim * params.score_weights, axis=-1) / w_sum
+        )
+        return jnp.where(fresh, score, 0.0)
+
+    shortlist_on = shortlist_k is not None and 0 < shortlist_k < n
+    if shortlist_on:
+        # Build from the initial tables, pod-level gates open. Usage only
+        # grows step over step, so every excluded node's build score is
+        # an upper bound on its score at any later step, and build
+        # infeasibility is permanent — the (K+1)-th best build score is
+        # the escape-hatch bound. -inf ⇒ the shortlist is COMPLETE.
+        free0 = nodes.allocatable - nodes.requested
+        bind0 = _cpu_bind(spods)
+        feas0 = mask_ops.fit_mask(spods.requests, free0)
+        eff_cpu0 = spods.requests[:, 0][:, None] * amp[None, :]
+        feas0 &= ~bind0[:, None] | (eff_cpu0 <= free0[:, 0][None, :] + EPS)
+        after0 = nodes.estimated_used[None, :, :] + spods.estimate[:, None, :]
+        over0 = (thr_full[None] > 0.0) & (
+            mask_ops.usage_percent(after0, nodes.allocatable[None])
+            > thr_full[None]
+        )
+        feas0 &= ~(nodes.metric_fresh[None, :] & jnp.any(over0, axis=-1))
+        pafter0 = nodes.prod_used[None, :, :] + spods.estimate[:, None, :]
+        pover0 = (pthr_full[None] > 0.0) & (
+            mask_ops.usage_percent(pafter0, nodes.allocatable[None])
+            > pthr_full[None]
+        )
+        feas0 &= (
+            ~(
+                spods.is_prod[:, None]
+                & nodes.metric_fresh[None, :]
+                & jnp.any(pover0, axis=-1)
+            )
+            | ~spods.is_prod[:, None]
+        )
+        feas0 &= nodes.schedulable[None, :]
+        score0 = node_score(
+            after0, nodes.allocatable[None], nodes.metric_fresh[None]
+        )
+        score0 = jnp.where(feas0, score0, -jnp.inf)
+        top_s, idx_s = jax.lax.top_k(score0, shortlist_k + 1)
+        # same TopkRewriter hazard as assign's build: asymmetric slicing
+        # of the two outputs defeats the sort+slice→TopK rewrite
+        top_s, idx_s = jax.lax.optimization_barrier((top_s, idx_s))
+        plan_cand = jnp.sort(idx_s[:, :shortlist_k], axis=1).astype(jnp.int32)
+        plan_bound = top_s[:, shortlist_k]
 
     def step(carry, xs):
-        requested, est_used, prod_used, qused = carry
-        req, est, is_prod, valid, qchain, bind = xs
-        free = nodes.allocatable - requested
-        # per-node effective request: cpuset-bound pods' CPU ×ratio on
-        # amplified nodes (filterAmplifiedCPUs, plugin.go:408-443)
-        req_eff = jnp.broadcast_to(req[None, :], free.shape)
-        req_eff = req_eff.at[:, 0].multiply(jnp.where(bind, amp, 1.0))
-        feas = jnp.all(req_eff <= free + EPS, axis=-1)
+        requested, est_used, prod_used, qused, fb = carry
+        if shortlist_on:
+            req, est, is_prod, valid, qchain, bind, cand, bound = xs
+        else:
+            req, est, is_prod, valid, qchain, bind = xs
         # quota admission along the chain (pod-level, node-independent)
         qidx = jnp.clip(qchain, 0, q_cap - 1)
         q_valid = qchain >= 0
+        pod_gate = valid
         if quota_enabled:
-            q_ok = jnp.all(
+            pod_gate &= jnp.all(
                 jnp.all(
                     qused[qidx] + req[None, :] <= quotas.runtime[qidx] + EPS,
                     axis=-1,
                 )
                 | ~q_valid
             )
-            feas &= q_ok
-        thr = mask_ops.effective_thresholds(
-            params.usage_thresholds, nodes.custom_thresholds
-        )
-        over = (thr > 0.0) & (
-            mask_ops.usage_percent(est_used + est[None, :], nodes.allocatable)
-            > thr
-        )
-        feas &= ~(nodes.metric_fresh & jnp.any(over, axis=-1))
-        pthr = mask_ops.effective_thresholds(
-            params.prod_thresholds, nodes.custom_prod_thresholds
-        )
-        pover = (pthr > 0.0) & (
-            mask_ops.usage_percent(prod_used + est[None, :], nodes.allocatable)
-            > pthr
-        )
-        feas &= ~(is_prod & nodes.metric_fresh & jnp.any(pover, axis=-1)) | ~is_prod
-        feas &= nodes.schedulable & valid
 
-        after = est_used + est[None, :]
-        frees = jnp.maximum(nodes.allocatable - after, 0.0)
-        per_dim = jnp.floor(
-            jnp.where(
-                nodes.allocatable > 0,
-                frees * 100.0 / (nodes.allocatable + 1e-9),
-                0.0,
+        def full_nominate(_):
+            free = nodes.allocatable - requested
+            # per-node effective request: cpuset-bound pods' CPU ×ratio
+            # on amplified nodes (filterAmplifiedCPUs, plugin.go:408-443)
+            req_eff = jnp.broadcast_to(req[None, :], free.shape)
+            req_eff = req_eff.at[:, 0].multiply(jnp.where(bind, amp, 1.0))
+            feas = jnp.all(req_eff <= free + EPS, axis=-1)
+            over = (thr_full > 0.0) & (
+                mask_ops.usage_percent(
+                    est_used + est[None, :], nodes.allocatable
+                )
+                > thr_full
             )
-        )
-        score = jnp.floor(
-            jnp.sum(per_dim * params.score_weights, axis=-1)
-            / (jnp.sum(params.score_weights) + 1e-9)
-        )
-        score = jnp.where(nodes.metric_fresh, score, 0.0)
-        score = jnp.where(feas, score, -jnp.inf)
-        best = jnp.argmax(score).astype(jnp.int32)
-        has = feas[best]
+            feas &= ~(nodes.metric_fresh & jnp.any(over, axis=-1))
+            pover = (pthr_full > 0.0) & (
+                mask_ops.usage_percent(
+                    prod_used + est[None, :], nodes.allocatable
+                )
+                > pthr_full
+            )
+            feas &= (
+                ~(is_prod & nodes.metric_fresh & jnp.any(pover, axis=-1))
+                | ~is_prod
+            )
+            feas &= nodes.schedulable & pod_gate
+            score = node_score(
+                est_used + est[None, :], nodes.allocatable, nodes.metric_fresh
+            )
+            score = jnp.where(feas, score, -jnp.inf)
+            best = jnp.argmax(score).astype(jnp.int32)
+            return best, feas[best]
+
+        if shortlist_on:
+            # gathered-column step over the pod's K candidates — the
+            # same elementwise arithmetic as full_nominate, so a
+            # candidate scores identically on both paths
+            alloc_c = nodes.allocatable[cand]
+            fresh_c = nodes.metric_fresh[cand]
+            free_c = alloc_c - requested[cand]
+            feas_c = jnp.all(req[None, :] <= free_c + EPS, axis=-1)
+            feas_c &= ~bind | (req[0] * amp[cand] <= free_c[:, 0] + EPS)
+            est_c = est_used[cand] + est[None, :]
+            thr_c = thr_full[cand]
+            over_c = (thr_c > 0.0) & (
+                mask_ops.usage_percent(est_c, alloc_c) > thr_c
+            )
+            feas_c &= ~(fresh_c & jnp.any(over_c, axis=-1))
+            pthr_c = pthr_full[cand]
+            pover_c = (pthr_c > 0.0) & (
+                mask_ops.usage_percent(prod_used[cand] + est[None, :], alloc_c)
+                > pthr_c
+            )
+            feas_c &= ~(is_prod & fresh_c & jnp.any(pover_c, axis=-1)) | ~is_prod
+            feas_c &= nodes.schedulable[cand] & pod_gate
+            score_c = jnp.where(
+                feas_c, node_score(est_c, alloc_c, fresh_c), -jnp.inf
+            )
+            bpos = jnp.argmax(score_c).astype(jnp.int32)
+            sc_best = score_c[bpos]
+            cand_any = jnp.isfinite(sc_best)
+            # safe ⇔ the shortlist provably contains the full-axis argmax:
+            # complete shortlist, or strictly beating every excluded
+            # node's score upper bound; a gated-out pod places nowhere on
+            # either path. Candidates ascend by node id, so the
+            # positional argmax tie-break equals the full-axis one.
+            safe = (
+                jnp.isneginf(bound) | (sc_best > bound) | ~pod_gate
+            )
+            unsafe = ~safe
+            best, has = jax.lax.cond(
+                unsafe,
+                full_nominate,
+                lambda _: (cand[bpos], feas_c[bpos]),
+                None,
+            )
+            fb = fb + jnp.stack(
+                [unsafe & cand_any, unsafe & ~cand_any]
+            ).astype(jnp.int32)
+        else:
+            best, has = full_nominate(None)
+        # commit row: the winner's effective request (amplified CPU for
+        # cpuset-bound pods) scattered onto the full-axis tables
+        req_commit = req.at[0].multiply(jnp.where(bind, amp[best], 1.0))
         onehot = (jnp.arange(n) == best)[:, None] & has
-        requested = requested + jnp.where(onehot, req_eff, 0.0)
+        requested = requested + jnp.where(onehot, req_commit[None, :], 0.0)
         est_used = est_used + jnp.where(onehot, est[None, :], 0.0)
         prod_used = prod_used + jnp.where(onehot & is_prod, est[None, :], 0.0)
         if quota_enabled:
@@ -1662,19 +2197,30 @@ def assign_sequential(
                 & has
             )
             qused = qused + jnp.any(charge, axis=1)[:, None] * req[None, :]
-        return (requested, est_used, prod_used, qused), jnp.where(has, best, -1)
+        return (requested, est_used, prod_used, qused, fb), jnp.where(
+            has, best, -1
+        )
 
-    (req_f, est_f, prod_f, qused_f), assigned_s = jax.lax.scan(
+    xs = (
+        spods.requests,
+        spods.estimate,
+        spods.is_prod,
+        spods.valid,
+        spods.quota_chain,
+        _cpu_bind(spods),
+    )
+    if shortlist_on:
+        xs = xs + (plan_cand, plan_bound)
+    (req_f, est_f, prod_f, qused_f, fb_f), assigned_s = jax.lax.scan(
         step,
-        (nodes.requested, nodes.estimated_used, nodes.prod_used, quotas.used),
         (
-            spods.requests,
-            spods.estimate,
-            spods.is_prod,
-            spods.valid,
-            spods.quota_chain,
-            _cpu_bind(spods),
+            nodes.requested,
+            nodes.estimated_used,
+            nodes.prod_used,
+            quotas.used,
+            jnp.zeros((2,), jnp.int32),
         ),
+        xs,
     )
     assignment = jnp.full((p,), -1, jnp.int32).at[order].set(assigned_s)
     result = SolveResult(
@@ -1690,5 +2236,6 @@ def assign_sequential(
         node_zone_free=jnp.zeros((n, 1, 1), jnp.float32),
         pod_zone=jnp.full((p,), -1, jnp.int32),
         pod_zone_charge=jnp.zeros((p, 1), jnp.float32),
+        shortlist_fallbacks=fb_f,
     )
     return enforce_gangs(result, pods)

@@ -234,6 +234,8 @@ class _HostSolve:
     assignment: np.ndarray
     pod_zone: Optional[np.ndarray]
     rounds_used: int
+    #: [2] int64 — shortlist escape-hatch rounds (bound, infeasible)
+    shortlist_fallbacks: Optional[np.ndarray] = None
 
 
 class _FetchStalled(RuntimeError):
@@ -511,6 +513,7 @@ class BatchScheduler:
         args: Optional[LoadAwareArgs] = None,
         batch_bucket: int = 4096,
         max_rounds: int = 16,
+        shortlist_k: Optional[int] = 64,
         pod_groups: Optional["PodGroupManager"] = None,
         quotas: Optional["GroupQuotaManager"] = None,
         numa: Optional["NUMAManager"] = None,
@@ -543,6 +546,14 @@ class BatchScheduler:
         self.snapshot.metric_expiry_s = self.args.node_metric_expiration_s
         self.batch_bucket = batch_bucket
         self.max_rounds = max_rounds
+        #: candidate-shortlist solve (node-axis pruning PR): per-pod
+        #: top-K build-time candidates bound the round loop's [P, N]
+        #: tensors to [P, K]; decisions stay identical via the exactness
+        #: bound + full-axis escape hatch (ops.solver). None/0 disables.
+        #: The effective static arg is power-of-two bucketed
+        #: (:meth:`_shortlist_bucket`) so a tuned knob can't mint a new
+        #: trace key per value.
+        self.shortlist_k = shortlist_k
         self.pod_groups = pod_groups or PodGroupManager()
         self.quotas = quotas or GroupQuotaManager(self.snapshot.config)
         self.numa = numa
@@ -2134,25 +2145,66 @@ class BatchScheduler:
                 self._cycle_rejects.append(
                     (pod, RejectStage.SOLVE, "scheduler", deferred_reason)
                 )
-        # rounds_used is diagnostics only — fetched AFTER the commit loop
-        # and in ONE stacked transfer (per-chunk int() fetches each cost
-        # a tunnel round trip); the scanned path already holds host ints.
-        # Skipped entirely when chunks were deferred: a stalled/failed
-        # fetch means the device may be wedged, and blocking here on
-        # another unbounded transfer would defeat the fetch deadline.
+        # rounds_used / shortlist_fallbacks are diagnostics only — fetched
+        # AFTER the commit loop and in ONE stacked transfer (per-chunk
+        # int() fetches each cost a tunnel round trip); the scanned path
+        # already holds host ints. Skipped entirely when chunks were
+        # deferred: a stalled/failed fetch means the device may be wedged,
+        # and blocking here on another unbounded transfer would defeat the
+        # fetch deadline.
+        fb_total = np.zeros((2,), np.int64)  # (bound, infeasible) rounds
         if solves and isinstance(solves[0][2], _HostSolve):
             for _chunk, _rows, result in solves:
                 rounds += result.rounds_used
+                if result.shortlist_fallbacks is not None:
+                    fb_total += np.asarray(
+                        result.shortlist_fallbacks, dtype=np.int64
+                    )
         elif deferred_reason is not None:
             pass
         elif len(solves) == 1:
-            rounds += int(solves[0][2].rounds_used)
+            res = solves[0][2]
+            if res.shortlist_fallbacks is not None:
+                packed = np.asarray(
+                    jnp.concatenate(
+                        [
+                            res.rounds_used.astype(jnp.int32)[None],
+                            res.shortlist_fallbacks,
+                        ]
+                    )
+                )
+                rounds += int(packed[0])
+                fb_total += packed[1:].astype(np.int64)
+            else:
+                rounds += int(res.rounds_used)
         elif solves:
-            rounds += int(
-                np.asarray(
-                    jnp.stack([r.rounds_used for _c, _r, r in solves])
-                ).sum()
-            )
+            # pack (rounds_used, fb[0], fb[1]) per chunk so the stacked
+            # diagnostics still ride a single transfer
+            packed = np.asarray(
+                jnp.stack(
+                    [
+                        jnp.concatenate(
+                            [
+                                r.rounds_used.astype(jnp.int32)[None],
+                                (
+                                    r.shortlist_fallbacks
+                                    if r.shortlist_fallbacks is not None
+                                    else jnp.zeros((2,), jnp.int32)
+                                ),
+                            ]
+                        )
+                        for _c, _r, r in solves
+                    ]
+                )
+            ).sum(axis=0)
+            rounds += int(packed[0])
+            fb_total += packed[1:].astype(np.int64)
+        if fb_total[0] or fb_total[1]:
+            ctr = fwext.registry.get("solver_shortlist_fallback_total")
+            if fb_total[0]:
+                ctr.labels(cause="bound").inc(int(fb_total[0]))
+            if fb_total[1]:
+                ctr.labels(cause="infeasible").inc(int(fb_total[1]))
         # PostFilter analog (reference elasticquota/preempt.go): a failed
         # quota-labeled pod may evict lower-priority same-quota pods, then
         # the batch retries once for the preemptors.
@@ -3294,6 +3346,72 @@ class BatchScheduler:
             chunks.append(cur)
         return chunks
 
+    def _shortlist_bucket(self) -> Optional[int]:
+        """Effective static ``shortlist_k`` for this dispatch: the
+        configured width rounded UP to the next power of two, or None
+        when pruning is disabled or the mesh owns the node axis.
+
+        Mesh exemption (written note, per the node-axis pruning PR): the
+        tp-sharded path keeps the full axis for now — ``plan_cand`` is a
+        per-pod gather across the WHOLE node axis, so on a tp-sharded
+        mesh every round's candidate gather would be a cross-shard
+        all-gather of the resident node tables, resharding the very
+        state the mesh keeps resident. The solver's static gate also
+        turns pruning off whenever K would cover the axis anyway."""
+        k = self.shortlist_k
+        if not k or k <= 0 or self.mesh is not None:
+            return None
+        return 1 << (int(k) - 1).bit_length()
+
+    def _shortlist_plan_probe(
+        self, stacked, nodes0, numa_state, device_state, mask_stacked=None
+    ) -> None:
+        """Observability-only re-run of the shortlist BUILD as its own
+        jitted entry (``ops.solver.shortlist_plan``). On the hot path
+        the plan cost is fused into the solve program, so a profile
+        window can't attribute it there; with the solver observatory
+        attached, time one representative chunk's plan under its own
+        ``shortlist`` stage so it shows up in
+        ``solve_breakdown_ms.stage_ms``. Never feeds decisions."""
+        dp = self.devprof
+        k = self._shortlist_bucket()
+        n = nodes0.allocatable.shape[0]
+        if (
+            dp is None
+            or k is None
+            or k >= n
+            or self._device_scoring() == "MostAllocated"
+        ):
+            return
+        from ..ops.solver import shortlist_plan
+
+        chunk0 = jax.tree.map(lambda a: a[0], stacked)
+        mask0 = mask_stacked[0] if mask_stacked is not None else None
+        with dp.watch(
+            "shortlist_plan",
+            stage="shortlist",
+            bucket=chunk0.requests.shape[0],
+            n=n,
+            kbucket=k,
+            numa=numa_state is not None,
+            devices=device_state is not None,
+            mask=mask0 is not None,
+            numa_scoring=self._numa_scoring(),
+            device_scoring=self._device_scoring(),
+        ) as w:
+            cand, _bound = shortlist_plan(
+                chunk0,
+                nodes0,
+                self._params,
+                numa=numa_state,
+                devices=device_state,
+                node_mask=mask0,
+                shortlist_k=k,
+                numa_scoring=self._numa_scoring(),
+                device_scoring=self._device_scoring(),
+            )
+            w.result(cand)
+
     def _dispatch_scanned(
         self, chunks: List[List[Pod]], sub: Optional[np.ndarray] = None
     ):
@@ -3394,11 +3512,12 @@ class BatchScheduler:
                     numa_scoring=self._numa_scoring(),
                     device_scoring=self._device_scoring(),
                     max_rounds=self.max_rounds,
+                    shortlist=self._shortlist_bucket(),
                 )
                 if dp is not None
                 else _NULL_WATCH
             ) as w:
-                assignments, zones, rounds = solve_stream_full(
+                assignments, zones, rounds, fallbacks = solve_stream_full(
                     stacked,
                     nodes0,
                     self._params,
@@ -3410,8 +3529,12 @@ class BatchScheduler:
                     numa_scoring=self._numa_scoring(),
                     device_scoring=self._device_scoring(),
                     node_mask=mask_stacked,
+                    shortlist_k=self._shortlist_bucket(),
                 )
                 w.result(assignments)
+            self._shortlist_plan_probe(
+                stacked, nodes0, numa_state, device_state, mask_stacked
+            )
             host_a = np.asarray(assignments)
             host_z = (
                 np.asarray(zones)
@@ -3419,6 +3542,7 @@ class BatchScheduler:
                 else None
             )
             host_r = np.asarray(rounds)
+            host_fb = np.asarray(fallbacks)
         out = []
         for i, (chunk, rows) in enumerate(zip(chunks, rows_list)):
             out.append(
@@ -3429,6 +3553,7 @@ class BatchScheduler:
                         assignment=host_a[i],
                         pod_zone=host_z[i] if host_z is not None else None,
                         rounds_used=int(host_r[i]),
+                        shortlist_fallbacks=host_fb[i],
                     ),
                 )
             )
@@ -3502,6 +3627,7 @@ class BatchScheduler:
                         numa_scoring=self._numa_scoring(),
                         device_scoring=self._device_scoring(),
                         max_rounds=self.max_rounds,
+                        shortlist=self._shortlist_bucket(),
                     )
                     if dp is not None
                     else _NULL_WATCH
@@ -3525,6 +3651,7 @@ class BatchScheduler:
                         numa_carry=numa_carry,
                         numa_scoring=self._numa_scoring(),
                         device_scoring=self._device_scoring(),
+                        shortlist_k=self._shortlist_bucket(),
                     )
                     w.result(result.assignment)
             if nodes_t is cur:
@@ -4328,6 +4455,7 @@ class BatchScheduler:
                         numa_scoring=self._numa_scoring(),
                         device_scoring=self._device_scoring(),
                         max_rounds=self.max_rounds,
+                        shortlist=self._shortlist_bucket(),
                     )
                     if dp is not None
                     else _NULL_WATCH
@@ -4354,6 +4482,7 @@ class BatchScheduler:
                         ),
                         numa_scoring=self._numa_scoring(),
                         device_scoring=self._device_scoring(),
+                        shortlist_k=self._shortlist_bucket(),
                     )
                     w.result(result.assignment)
             # zero-copy chain replace (the solver outputs ARE the chained
@@ -4685,6 +4814,7 @@ class BatchScheduler:
                     numa_scoring=self._numa_scoring(),
                     device_scoring=self._device_scoring(),
                     max_rounds=self.max_rounds,
+                    shortlist=self._shortlist_bucket(),
                 )
                 if dp is not None
                 else _NULL_WATCH
@@ -4706,6 +4836,7 @@ class BatchScheduler:
                     node_mask=node_mask,
                     numa_scoring=self._numa_scoring(),
                     device_scoring=self._device_scoring(),
+                    shortlist_k=self._shortlist_bucket(),
                 )
                 w.result(result.assignment)
                 return result

@@ -81,6 +81,21 @@ class ReservationArgs:
     gc_duration_s: float = 24 * 3600.0
 
 
+@dataclasses.dataclass
+class SolverTuningArgs:
+    """Rebuild-side solver tuning (no reference counterpart — the
+    reference has ``percentageOfNodesToScore`` sampling; the batched
+    solver's analog is the decision-identical candidate shortlist).
+
+    ``shortlist_k`` is the per-pod candidate-shortlist width for the
+    constrained round solver: the dispatcher rounds it UP to the next
+    power of two (static-arg bucketing, retrace hygiene) and the solver
+    statically disables pruning when K covers the node axis anyway.
+    0 disables pruning outright (full ``[P, N]`` round body)."""
+
+    shortlist_k: int = 64
+
+
 def _num(raw: Mapping[str, Any], key: str, default: float) -> float:
     if key not in raw:
         return default
@@ -269,6 +284,19 @@ def validate_device_share(args: DeviceShareArgs, path: str = "deviceShare") -> N
         raise ConfigError(
             f"{path}.scoringStrategy.type",
             f"unknown strategy {args.scoring_strategy!r}",
+        )
+
+
+def decode_solver_tuning(raw: Mapping[str, Any]) -> SolverTuningArgs:
+    return SolverTuningArgs(shortlist_k=_int(raw, "shortlistK", 64))
+
+
+def validate_solver_tuning(
+    args: SolverTuningArgs, path: str = "solverTuning"
+) -> None:
+    if args.shortlist_k < 0:
+        raise ConfigError(
+            f"{path}.shortlistK", "must be >= 0 (0 disables pruning)"
         )
 
 

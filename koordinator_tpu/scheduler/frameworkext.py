@@ -117,6 +117,15 @@ def scheduler_registry(reg: Optional[Registry] = None) -> Registry:
         labels=("level",),
     )
     reg.counter(
+        "solver_shortlist_fallback_total",
+        "solver rounds where the candidate-shortlist exactness bound could "
+        "not prove the pruned node axis decision-identical and the round "
+        "re-nominated over the full axis (cause: bound = a gathered best "
+        "cost reached the plan-time bound; infeasible = a gated pod had no "
+        "feasible shortlist candidate left)",
+        labels=("cause",),
+    )
+    reg.counter(
         "cycle_deadline_exceeded_total",
         "scheduling cycles that hit the per-cycle deadline and deferred "
         "their remaining chunks to the next cycle",

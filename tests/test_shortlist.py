@@ -1,0 +1,432 @@
+"""Candidate-shortlist solve: decision-identity pins.
+
+The shortlisted round solver (``assign(..., shortlist_k=K)``) prunes the
+per-pod node axis to each pod's top-K build-time candidates. The exactness
+bound (feasibility is monotone non-increasing and masked cost monotone
+non-decreasing as capacity commits, so the (K+1)-th best build cost
+lower-bounds every excluded node forever) plus the full-axis re-nomination
+escape hatch make the pruned solve DECISION-IDENTICAL, not approximately
+equal — these tests pin bit-exactness of the assignment, the per-pod zone
+pick and every post-commit capacity table against the full-axis solver
+across the constrained feature matrix, including runs where the fallback
+fires.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.ops.device import DeviceState
+from koordinator_tpu.ops.numa import NumaState
+from koordinator_tpu.ops.solver import (
+    NodeState,
+    PodBatch,
+    QuotaState,
+    SolverParams,
+    _jitter_hash,
+    assign,
+    assign_sequential,
+    enforce_gangs,
+    solve_stream_full,
+)
+from koordinator_tpu.sim import golden
+
+D = 2
+
+# Every decision-bearing SolveResult field: the assignment itself, the
+# on-device zone pick, and the post-commit capacity tables that chain
+# into the next chunk/cycle (ISSUE: "quota/slot/zone end-state tables
+# bit-exact").
+DECISION_FIELDS = (
+    "assignment",
+    "pod_zone",
+    "pod_zone_charge",
+    "node_requested",
+    "node_estimated_used",
+    "node_prod_used",
+    "quota_used",
+    "node_dev_slots",
+    "node_rdma_free",
+    "node_fpga_free",
+    "node_zone_free",
+    "rounds_used",
+)
+
+
+def assert_same_decisions(full, pruned):
+    for f in DECISION_FIELDS:
+        a, b = getattr(full, f), getattr(pruned, f)
+        if a is None or b is None:
+            assert a is None and b is None, f
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"SolveResult.{f} diverged"
+        )
+
+
+def rich_fixture(
+    p=96,
+    n=24,
+    seed=0,
+    pod_scale=1.0,
+    base_util=0.25,
+    thresholds=(70.0, 90.0),
+    quota=False,
+    numa=False,
+    devices=False,
+    mask=False,
+    gang=False,
+):
+    """Randomized constrained fixture over the full feature matrix."""
+    rng = np.random.default_rng(seed)
+    alloc = (
+        rng.choice([32.0, 64.0, 96.0], (n, 1)) * np.ones((1, D))
+    ).astype(np.float32)
+    requested = (alloc * rng.uniform(0.0, 0.2, (n, D))).astype(np.float32)
+    est_used = (alloc * base_util * rng.uniform(0.5, 1.5, (n, D))).astype(
+        np.float32
+    )
+    prod_used = (est_used * 0.6).astype(np.float32)
+    sched = np.ones(n, bool)
+    sched[rng.integers(0, n)] = False
+    fresh = np.ones(n, bool)
+    fresh[rng.integers(0, n)] = False
+
+    req = (rng.choice([1.0, 2.0, 4.0, 8.0], (p, D)) * pod_scale).astype(
+        np.float32
+    )
+    est = (req * 0.85).astype(np.float32)
+    prio = rng.integers(5000, 9999, p).astype(np.int32)
+
+    kw = {}
+    quotas = None
+    if quota:
+        # 3-quota tree: leaves 1..2 under root 0; leaf 1 deliberately
+        # tight so quota admission actually rejects pods mid-solve
+        chain = np.full((p, 4), -1, np.int32)
+        chain[:, 0] = rng.integers(1, 3, p)
+        chain[:, 1] = 0
+        kw["quota_chain"] = chain
+        total = req.sum(0)
+        runtime = np.full((3, D), np.inf, np.float32)
+        runtime[1] = total * 0.25
+        runtime[2] = total * 0.5
+        quotas = QuotaState(
+            runtime=jnp.asarray(runtime),
+            used=jnp.zeros((3, D), jnp.float32),
+        )
+    numa_state = None
+    if numa:
+        z = 2
+        zone_cap = np.repeat((alloc / z)[:, None, :], z, axis=1).astype(
+            np.float32
+        )
+        zone_used = (
+            zone_cap * rng.uniform(0.0, 0.4, zone_cap.shape)
+        ).astype(np.float32)
+        numa_state = NumaState(
+            zone_free=jnp.asarray(zone_cap - zone_used),
+            zone_cap=jnp.asarray(zone_cap),
+            policy=jnp.asarray(rng.choice([0, 3], n).astype(np.int8)),
+        )
+        kw["numa_required"] = rng.random(p) < 0.3
+    device_state = None
+    if devices:
+        g = 4
+        slot = rng.choice(
+            [0.0, 45.0, 100.0], (n, g), p=[0.2, 0.2, 0.6]
+        ).astype(np.float32)
+        device_state = DeviceState(
+            slot_free=jnp.asarray(slot),
+            rdma_free=jnp.asarray(rng.integers(0, 3, n).astype(np.float32)),
+            cap_total=jnp.asarray(np.full(n, g * 100.0, np.float32)),
+        )
+        gpu_whole = rng.choice([0, 0, 1, 2], p).astype(np.int32)
+        gpu_share = np.where(
+            (gpu_whole == 0) & (rng.random(p) < 0.4),
+            rng.choice([30.0, 55.0], p),
+            0.0,
+        ).astype(np.float32)
+        kw["gpu_whole"] = gpu_whole
+        kw["gpu_share"] = gpu_share
+        kw["rdma"] = (rng.random(p) < 0.2).astype(np.int32)
+    node_mask = None
+    if mask:
+        m = rng.random((p, n)) < 0.6
+        m[:, 1] = True  # keep every pod at least one allowed node
+        node_mask = jnp.asarray(m)
+    if gang:
+        gid = np.full(p, -1, np.int32)
+        gid[:12] = np.repeat(np.arange(3, dtype=np.int32), 4)
+        gmin = np.zeros(p, np.int32)
+        gmin[:12] = 3
+        kw["gang_id"] = gid
+        kw["gang_min"] = gmin
+
+    pods = PodBatch.create(requests=req, priority=prio, estimate=est, **kw)
+    nodes = NodeState.create(
+        allocatable=alloc,
+        requested=requested,
+        estimated_used=est_used,
+        prod_used=prod_used,
+        metric_fresh=fresh,
+        schedulable=sched,
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.asarray(thresholds, jnp.float32),
+        prod_thresholds=jnp.asarray((50.0, 95.0), jnp.float32),
+        score_weights=jnp.ones(D, jnp.float32),
+    )
+    return pods, nodes, params, quotas, numa_state, device_state, node_mask
+
+
+def run_pair(fix, k, **akw):
+    pods, nodes, params, quotas, numa_state, device_state, node_mask = fix
+    common = dict(
+        quotas=quotas,
+        numa=numa_state,
+        devices=device_state,
+        node_mask=node_mask,
+        **akw,
+    )
+    full = assign(pods, nodes, params, shortlist_k=None, **common)
+    pruned = assign(pods, nodes, params, shortlist_k=k, **common)
+    return full, pruned
+
+
+COMBOS = {
+    "plain": {},
+    "quota": {"quota": True},
+    "numa": {"numa": True},
+    "devices": {"devices": True},
+    "node_mask": {"mask": True},
+    "kitchen_sink": {
+        "quota": True,
+        "numa": True,
+        "devices": True,
+        "mask": True,
+    },
+}
+
+
+@pytest.mark.parametrize("combo", sorted(COMBOS))
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_decision_identity(combo, seed):
+    """ISSUE acceptance: the shortlisted solve is decision-identical to
+    the full-axis solver across quota+NUMA+device+node_mask combos."""
+    fix = rich_fixture(seed=seed, **COMBOS[combo])
+    akw = {}
+    if COMBOS[combo].get("numa"):
+        akw["numa_scoring"] = "LeastAllocated"
+    if COMBOS[combo].get("devices"):
+        akw["device_scoring"] = "LeastAllocated"
+    full, pruned = run_pair(fix, 8, **akw)
+    assert int(np.sum(np.asarray(full.assignment) >= 0)) > 0  # non-vacuous
+    assert_same_decisions(full, pruned)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_gang_rollback_identity(seed):
+    """Gang enforcement consumes the solve verbatim: identical solves →
+    identical all-or-nothing rollbacks, including the device-slot refunds."""
+    fix = rich_fixture(
+        seed=seed, devices=True, gang=True, pod_scale=3.0, base_util=0.4
+    )
+    pods = fix[0]
+    full, pruned = run_pair(fix, 8)
+    assert_same_decisions(enforce_gangs(full, pods), enforce_gangs(pruned, pods))
+
+
+def test_high_contention_forces_fallback_still_exact():
+    """Adversarial batch: near-identical pods hammering the same few cheap
+    nodes with a tiny K. The exactness bound must actually fire (the
+    shortlist alone cannot prove the decisions safe) and the full-axis
+    re-nomination escape hatch must keep the decisions bit-exact — the
+    fallback is a perf event, never a behavior change."""
+    rng = np.random.default_rng(7)
+    p, n = 384, 32
+    alloc = np.full((n, D), 64.0, np.float32)
+    est_used = (alloc * 0.2 * rng.uniform(0.9, 1.1, (n, D))).astype(np.float32)
+    req = np.full((p, D), 4.0, np.float32)
+    pods = PodBatch.create(
+        requests=req,
+        priority=rng.integers(5000, 9999, p).astype(np.int32),
+        estimate=req * 0.85,
+    )
+    nodes = NodeState.create(
+        allocatable=alloc,
+        estimated_used=est_used,
+        prod_used=est_used * 0.5,
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.asarray((60.0, 60.0), jnp.float32),
+        prod_thresholds=jnp.zeros(D, jnp.float32),
+        score_weights=jnp.ones(D, jnp.float32),
+    )
+    full = assign(pods, nodes, params, shortlist_k=None)
+    pruned = assign(pods, nodes, params, shortlist_k=4)
+    fb = np.asarray(pruned.shortlist_fallbacks)
+    assert fb.shape == (2,) and fb.sum() > 0, fb
+    assert_same_decisions(full, pruned)
+
+
+def test_shortlist_k_ge_n_degenerate():
+    """K >= N covers the whole axis: shortlisting is statically off, the
+    result is the plain full-axis solve and the fallback counter is the
+    all-zero sentinel (never None — stream outputs stay shape-stable)."""
+    fix = rich_fixture(seed=5, n=16)
+    full, pruned = run_pair(fix, 64)
+    assert_same_decisions(full, pruned)
+    np.testing.assert_array_equal(
+        np.asarray(pruned.shortlist_fallbacks), np.zeros(2, np.int32)
+    )
+
+
+def test_jitter_hash_gather_invariant():
+    """ISSUE satellite: add_jitter determinism under candidate gather.
+
+    The nomination tie-break band hashes ORIGINAL node ids, so a (pod,
+    node) pair perturbs identically whether the cost row is full-axis
+    [P, N] or a gathered [P, K] candidate sub-tensor — gathering then
+    hashing equals hashing then gathering."""
+    rng = np.random.default_rng(11)
+    p, n, k = 64, 128, 16
+    pi = jnp.arange(p, dtype=jnp.uint32)
+    ni = jnp.arange(n, dtype=jnp.uint32)
+    h_full = np.asarray(_jitter_hash(pi[:, None], ni[None, :]))
+    cand = np.stack(
+        [rng.choice(n, size=k, replace=False) for _ in range(p)]
+    ).astype(np.int32)
+    cand.sort(axis=1)  # build emits candidates ascending by node id
+    h_cols = np.asarray(
+        _jitter_hash(pi[:, None], jnp.asarray(cand).astype(jnp.uint32))
+    )
+    np.testing.assert_array_equal(
+        h_cols, np.take_along_axis(h_full, cand, axis=1)
+    )
+    # and the band is genuinely per-pair (not constant along either axis)
+    assert len(np.unique(h_full[0])) > 1 and len(np.unique(h_full[:, 0])) > 1
+
+
+# ---- sequential (golden-comparable) solver ----
+
+
+def seq_fixture(p=48, n=24, seed=0, pod_scale=1.0, base_util=0.3):
+    rng = np.random.default_rng(seed)
+    alloc = (
+        rng.choice([32.0, 64.0, 96.0], (n, 1)) * np.ones((1, D))
+    ).astype(np.float32)
+    requested = np.zeros((n, D), np.float32)
+    est_used = (alloc * base_util * rng.uniform(0.5, 1.5, (n, D))).astype(
+        np.float32
+    )
+    prod_used = (est_used * 0.6).astype(np.float32)
+    fresh = np.ones(n, bool)
+    sched = np.ones(n, bool)
+    req = (rng.choice([1.0, 2.0, 4.0, 8.0], (p, D)) * pod_scale).astype(
+        np.float32
+    )
+    est = (req * 0.85).astype(np.float32)
+    prio = rng.integers(5000, 9999, p).astype(np.int32)
+    is_prod = prio >= 9000
+    thresholds = (65.0, 95.0)
+    prod_thresholds = (50.0, 95.0)
+    pods = PodBatch.create(
+        requests=req, estimate=est, priority=prio, is_prod=is_prod
+    )
+    nodes = NodeState.create(
+        allocatable=alloc,
+        requested=requested,
+        estimated_used=est_used,
+        prod_used=prod_used,
+        metric_fresh=fresh,
+        schedulable=sched,
+    )
+    params = SolverParams(
+        usage_thresholds=jnp.asarray(thresholds, jnp.float32),
+        prod_thresholds=jnp.asarray(prod_thresholds, jnp.float32),
+        score_weights=jnp.ones(D, jnp.float32),
+    )
+    np_fix = dict(
+        pod_req=req,
+        pod_estimate=est,
+        pod_priority=prio,
+        pod_is_prod=is_prod,
+        allocatable=alloc,
+        requested0=requested,
+        estimated_used0=est_used,
+        prod_used0=prod_used,
+        metric_fresh=fresh,
+        schedulable=sched,
+        usage_thresholds=np.asarray(thresholds, np.float32),
+        prod_thresholds=np.asarray(prod_thresholds, np.float32),
+        score_weights=np.ones(D, np.float32),
+    )
+    return pods, nodes, params, np_fix
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("k", [4, 16])
+def test_sequential_shortlist_matches_full_and_host(seed, k):
+    """ISSUE acceptance: decision-identical to the full-axis solver AND
+    the host reference (``sim.golden.sequential_assign``)."""
+    pods, nodes, params, np_fix = seq_fixture(seed=seed)
+    full = assign_sequential(pods, nodes, params)
+    pruned = assign_sequential(pods, nodes, params, shortlist_k=k)
+    assert_same_decisions(full, pruned)
+    want = golden.sequential_assign(**np_fix)
+    np.testing.assert_array_equal(np.asarray(pruned.assignment), want)
+
+
+def test_sequential_fallback_fires_still_exact():
+    """Contended sequential solve with K=2: later pods' shortlists go
+    stale as earlier pods commit, the score-side bound cannot prove the
+    pick safe, and the per-step full-axis cond re-nominates — decisions
+    (and the golden host reference) stay bit-exact."""
+    pods, nodes, params, np_fix = seq_fixture(
+        seed=9, p=96, n=16, pod_scale=4.0, base_util=0.45
+    )
+    full = assign_sequential(pods, nodes, params)
+    pruned = assign_sequential(pods, nodes, params, shortlist_k=2)
+    fb = np.asarray(pruned.shortlist_fallbacks)
+    assert fb.shape == (2,) and fb.sum() > 0, fb
+    assert_same_decisions(full, pruned)
+    np.testing.assert_array_equal(
+        np.asarray(pruned.assignment), golden.sequential_assign(**np_fix)
+    )
+
+
+def test_sequential_shortlist_k_ge_n_degenerate():
+    pods, nodes, params, _ = seq_fixture(seed=6, n=12)
+    full = assign_sequential(pods, nodes, params)
+    pruned = assign_sequential(pods, nodes, params, shortlist_k=128)
+    assert_same_decisions(full, pruned)
+
+
+# ---- stream plumbing ----
+
+
+def test_solve_stream_full_carries_fallback_counts():
+    """The scanned stream returns a 4th output: per-chunk [C, 2] fallback
+    counts (all-zero sentinel when shortlisting is off) so the dispatcher
+    fetches them packed with rounds in the same transfer."""
+    fix = rich_fixture(seed=8, p=64, quota=True)
+    pods, nodes, params, quotas, _numa, _dev, _mask = fix
+    stacked = jax.tree.map(
+        lambda a: a.reshape((2, 32) + a.shape[1:]), pods
+    )
+    a_full, z_full, r_full, fb_full = solve_stream_full(
+        stacked, nodes, params, quotas=quotas, shortlist_k=None
+    )
+    a_sl, z_sl, r_sl, fb_sl = solve_stream_full(
+        stacked, nodes, params, quotas=quotas, shortlist_k=8
+    )
+    np.testing.assert_array_equal(np.asarray(a_full), np.asarray(a_sl))
+    np.testing.assert_array_equal(np.asarray(z_full), np.asarray(z_sl))
+    np.testing.assert_array_equal(np.asarray(r_full), np.asarray(r_sl))
+    assert np.asarray(fb_sl).shape == (2, 2)
+    np.testing.assert_array_equal(
+        np.asarray(fb_full), np.zeros((2, 2), np.int32)
+    )

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Candidate-shortlist A/B driver: the ``BENCH_SHORTLIST_rNN_cpu.json``
+artifact for the node-axis pruning PR.
+
+Runs the constrained scenarios — the three existing scheduler-level ones
+(``numa_binpack_2socket``, ``device_gang_8gpu``, ``quota_tree_3level``,
+sized down for a CPU round) plus the two fleet-scale 20k-node solver
+scenarios from ``bench_suite`` — each as a same-backend A/B between the
+full-axis solve (``shortlist_k=0``) and the shortlisted solve (the
+default ``shortlist_k=64``). Per the standing perf-claim rules every
+scheduler scenario entry embeds:
+
+- decision identity: the (pod, node) binding list of the two arms must
+  match exactly (the A/B is meaningless otherwise),
+- retrace evidence: a solver-observatory pass with the compile ledger
+  marked steady after warmup — ``steady_retraces`` must be 0,
+- the stage breakdown (``solve_breakdown_ms``) with the ``shortlist``
+  stage visible (the ``shortlist_plan`` probe's watch window).
+
+The artifact is a plain scenario list, so ``tools/bench_regress.py
+--scenario NAME`` gates it directly; the headline ``pods_per_sec`` on
+every entry is the SHORTLIST arm (the default config — a future round's
+regression gate judges what users run). A trailing
+``shortlist_ab_verdicts`` pseudo-entry carries the bench_regress verdict
+table of shortlist-vs-full (full axis as baseline), and the driver exits
+nonzero if any scenario's shortlist arm REGRESSES against its own
+full-axis arm — "no slower at small N" is enforced, not eyeballed.
+
+This is a CPU-round artifact: the committed accelerator
+``BENCH_SUITE.json`` is never touched.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_shortlist.py \
+        [--out BENCH_SHORTLIST_r12_cpu.json] [--passes 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+# runnable both as ``python tools/bench_shortlist.py`` and as
+# ``python -m tools.bench_shortlist``: bench_suite lives at the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_K = 64
+
+
+def _drain(sched, pods):
+    """One whole-backlog scheduling call; returns the binding list."""
+    out = sched.schedule(list(pods))
+    return [(p.meta.name, node) for p, node in out.bound]
+
+
+def _measure_sched_arm(build, k, passes):
+    """(median pods/s, passes, bindings) for one shortlist_k arm."""
+    sched, pods = build(k)
+    sched.extender.monitor.stop_background()
+    bindings = _drain(sched, pods)  # warmup: compiles land here
+    pps = []
+    for _ in range(passes):
+        sched, pods = build(k)
+        sched.extender.monitor.stop_background()
+        t0 = time.perf_counter()
+        _drain(sched, pods)
+        pps.append(round(len(pods) / (time.perf_counter() - t0), 1))
+    return sorted(pps)[len(pps) // 2], pps, bindings
+
+
+def _observatory_pass(build, k):
+    """Instrumented extra pass (never the measured one): attach the
+    solver observatory, drain once cold, mark the ledger steady, drain
+    a fresh instance again — any trace after the mark is a retrace. The
+    breakdown must show the ``shortlist`` stage (the plan probe)."""
+    from koordinator_tpu.obs.devprof import DevProf
+
+    dp = DevProf()
+    try:
+        for fresh in range(2):
+            sched, pods = build(k)
+            sched.extender.monitor.stop_background()
+            sched.attach_devprof(dp)
+            if fresh == 1:
+                dp.capture(1 << 30)  # fence + record the steady drain
+            _drain(sched, pods)
+            if fresh == 0:
+                dp.ledger.mark_steady()
+        dp.capture(0)
+        breakdown = dp.breakdown_ms()
+        return {
+            "steady_retraces": dp.ledger.steady_retraces(),
+            "retrace_causes": dp.ledger.steady_causes(),
+            "solve_breakdown_ms": breakdown,
+            "shortlist_stage_visible": (
+                "shortlist" in breakdown.get("stage_ms", {})
+            ),
+        }
+    finally:
+        dp.uninstall()
+
+
+def _sched_scenario(name, make_build, passes):
+    """Scheduler-level A/B: same builder, shortlist on (default K) vs
+    off (shortlist_k=0), identical seeds → the binding lists must be
+    identical."""
+    print(f"--- {name}", file=sys.stderr)
+    sl_pps, sl_passes, sl_bound = _measure_sched_arm(
+        make_build, DEFAULT_K, passes
+    )
+    full_pps, full_passes, full_bound = _measure_sched_arm(
+        make_build, 0, passes
+    )
+    if sl_bound != full_bound:
+        raise SystemExit(
+            f"{name}: shortlist arm diverged from full axis "
+            f"({len(sl_bound)} vs {len(full_bound)} bindings)"
+        )
+    entry = {
+        "scenario": name,
+        "pods_per_sec": sl_pps,
+        "passes": sl_passes,
+        "placed": len(sl_bound),
+        "shortlist_k": DEFAULT_K,
+        "shortlist_ab": {
+            "full_axis_pods_per_sec": full_pps,
+            "full_axis_passes": full_passes,
+            "speedup": round(sl_pps / full_pps, 2),
+            "identical_placements": True,
+        },
+    }
+    entry.update(_observatory_pass(make_build, DEFAULT_K))
+    return entry
+
+
+def _scenarios(passes):
+    import bench_suite
+
+    def numa(k):
+        return bench_suite._build_numa(
+            n_nodes=2000, n_pods=8192, batch_bucket=2048, shortlist_k=k
+        )
+
+    def gang(k):
+        return bench_suite._build_device_gang(
+            n_nodes=2000, n_gangs=2048, batch_bucket=1024, shortlist_k=k
+        )
+
+    def quota(k):
+        return bench_suite._build_quota(
+            n_nodes=2000, n_pods=8192, batch_bucket=2048, shortlist_k=k
+        )
+
+    entries = [
+        _sched_scenario("numa_binpack_2socket", numa, passes),
+        _sched_scenario("device_gang_8gpu", gang, passes),
+        _sched_scenario("quota_tree_3level", quota, passes),
+    ]
+    # fleet-scale solver scenarios: their bench_suite entries already
+    # embed the same-shape shortlist_ab (identical placements pinned by
+    # _solver_ab itself)
+    for fn in (bench_suite.bench_numa_20k, bench_suite.bench_device_gang_20k):
+        print(f"--- {fn.__name__}", file=sys.stderr)
+        entries.append(fn())
+    return entries
+
+
+def _verdicts(entries):
+    """bench_regress verdict table, full-axis arm as the baseline."""
+    from tools.bench_regress import compare
+
+    baseline, current = {}, {}
+    for e in entries:
+        ab = e.get("shortlist_ab")
+        if not ab:
+            continue
+        baseline[e["scenario"]] = {
+            "scenario": e["scenario"],
+            "pods_per_sec": ab["full_axis_pods_per_sec"],
+            "passes": ab["full_axis_passes"],
+        }
+        current[e["scenario"]] = {
+            "scenario": e["scenario"],
+            "pods_per_sec": e["pods_per_sec"],
+            "passes": e["passes"],
+        }
+    return compare(baseline, current)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--out", default="BENCH_SHORTLIST_r12_cpu.json")
+    ap.add_argument("--passes", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    entries = _scenarios(args.passes)
+    rows = _verdicts(entries)
+    entries.append(
+        {
+            "scenario": "shortlist_ab_verdicts",
+            "note": (
+                "shortlist arm judged against the SAME run's full-axis "
+                "arm (baseline = full axis); REGRESSION here means the "
+                "pruned solve was slower than not pruning"
+            ),
+            "rows": rows,
+        }
+    )
+    with open(args.out, "w") as f:
+        json.dump(entries, f, indent=1, sort_keys=True)
+        f.write("\n")
+    from tools.bench_regress import render_table
+
+    print(render_table(rows))
+    slower = [r for r in rows if r["verdict"] == "REGRESSION"]
+    if slower:
+        print(
+            "shortlist arm slower than full axis on: "
+            + ", ".join(r["scenario"] for r in slower),
+            file=sys.stderr,
+        )
+        return 1
+    print(f"wrote {args.out} ({len(entries)} entries)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

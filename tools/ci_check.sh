@@ -16,6 +16,18 @@ python -m tools.koordlint
 echo "== chaos-point catalog freshness =="
 python -m tools.gen_chaos_catalog --check
 
+echo "== shortlist equivalence subset (decision-identity pins) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_shortlist.py \
+  -q -p no:cacheprovider
+
+echo "== shortlist CPU bench artifact gate (committed vs itself: shape + scenarios present) =="
+python tools/bench_regress.py \
+  --baseline BENCH_SHORTLIST_r12_cpu.json \
+  --current BENCH_SHORTLIST_r12_cpu.json \
+  --scenario numa_binpack_2socket --scenario device_gang_8gpu \
+  --scenario quota_tree_3level \
+  --scenario numa_binpack_20k --scenario device_gang_20k
+
 echo "== tier-1 fast tests (pytest -m 'not slow') =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
